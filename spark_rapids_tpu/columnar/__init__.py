@@ -1,0 +1,8 @@
+"""Columnar substrate: dtypes, schema, device columns, batches.
+
+Reference analogue: GpuColumnVector.java / RapidsHostColumnVector.java and
+the ColumnarBatch contract every GpuExec consumes (SURVEY.md §2.3)."""
+from . import dtypes  # noqa: F401
+from .schema import Field, Schema  # noqa: F401
+from .column import Column, StringColumn, bucket_capacity, MIN_CAPACITY  # noqa: F401
+from .batch import ColumnarBatch, concat_batches  # noqa: F401

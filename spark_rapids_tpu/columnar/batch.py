@@ -1,0 +1,208 @@
+"""ColumnarBatch — a set of equal-capacity device columns + a live row count.
+
+Reference analogue: Spark ``ColumnarBatch`` wrapping ``GpuColumnVector``s
+(reference: sql-plugin/.../GpuColumnVector.java) produced/consumed by every
+``GpuExec.doExecuteColumnar``.
+
+TPU-first: capacity is a power-of-two bucket (static shape for XLA); the
+number of live rows is a host int known at batch boundaries, mirroring the
+reference where cuDF row counts are host-visible after each kernel.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import dtypes as T
+from .column import Column, StringColumn, bucket_capacity
+from .schema import Field, Schema
+
+
+class ColumnarBatch:
+    def __init__(self, schema: Schema, columns: Sequence[Column], num_rows: int):
+        assert len(schema) == len(columns), (len(schema), len(columns))
+        self.schema = schema
+        self.columns = list(columns)
+        self.num_rows = int(num_rows)
+        if columns:
+            caps = {c.capacity for c in columns}
+            assert len(caps) == 1, f"mixed capacities {caps}"
+            self._capacity = caps.pop()
+        else:
+            self._capacity = bucket_capacity(num_rows)
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    def column(self, key) -> Column:
+        if isinstance(key, str):
+            return self.columns[self.schema.index_of(key)]
+        return self.columns[key]
+
+    # -- constructors -----------------------------------------------------------
+    @staticmethod
+    def from_pydict(data: Dict[str, Sequence], schema: Optional[Schema] = None,
+                    capacity: Optional[int] = None) -> "ColumnarBatch":
+        names = list(data.keys())
+        n = len(next(iter(data.values()))) if data else 0
+        cap = capacity or bucket_capacity(n)
+        cols, fields = [], []
+        for name in names:
+            dtype = schema[name].dtype if schema is not None else None
+            col = Column.from_numpy(data[name], dtype=dtype, capacity=cap)
+            cols.append(col)
+            fields.append(Field(name, col.dtype))
+        return ColumnarBatch(schema or Schema(fields), cols, n)
+
+    @staticmethod
+    def from_numpy(arrays: Dict[str, np.ndarray],
+                   capacity: Optional[int] = None) -> "ColumnarBatch":
+        return ColumnarBatch.from_pydict(arrays, capacity=capacity)
+
+    @staticmethod
+    def empty(schema: Schema, capacity: int = 16) -> "ColumnarBatch":
+        cols = [Column.all_null(f.dtype, capacity) for f in schema]
+        return ColumnarBatch(schema, cols, 0)
+
+    # -- host interop -----------------------------------------------------------
+    def to_pydict(self) -> Dict[str, List]:
+        return {f.name: c.to_pylist(self.num_rows)
+                for f, c in zip(self.schema, self.columns)}
+
+    def to_pylist(self) -> List[tuple]:
+        cols = [c.to_pylist(self.num_rows) for c in self.columns]
+        return list(zip(*cols)) if cols else []
+
+    # -- structural -------------------------------------------------------------
+    def select(self, names: Iterable[str]) -> "ColumnarBatch":
+        names = list(names)
+        cols = [self.column(n) for n in names]
+        fields = [self.schema[n] for n in names]
+        return ColumnarBatch(Schema(fields), cols, self.num_rows)
+
+    def with_column(self, name: str, col: Column) -> "ColumnarBatch":
+        if name in self.schema.names:
+            idx = self.schema.index_of(name)
+            cols = list(self.columns)
+            cols[idx] = col
+            fields = list(self.schema.fields)
+            fields[idx] = Field(name, col.dtype)
+            return ColumnarBatch(Schema(fields), cols, self.num_rows)
+        return ColumnarBatch(
+            Schema(list(self.schema.fields) + [Field(name, col.dtype)]),
+            self.columns + [col], self.num_rows)
+
+    def with_capacity(self, capacity: int) -> "ColumnarBatch":
+        if capacity == self.capacity:
+            return self
+        cols = [c.with_capacity(capacity, self.num_rows) for c in self.columns]
+        b = ColumnarBatch(self.schema, cols, self.num_rows)
+        return b
+
+    def gather(self, indices, num_rows: int) -> "ColumnarBatch":
+        cols = [c.gather(indices) for c in self.columns]
+        return ColumnarBatch(self.schema, cols, num_rows)
+
+    def slice(self, start: int, length: int) -> "ColumnarBatch":
+        idx = jnp.arange(bucket_capacity(length)) + start
+        valid_rows = min(length, max(self.num_rows - start, 0))
+        b = self.gather(idx, valid_rows)
+        # rows past num_rows must be invalid
+        mask = jnp.arange(b.capacity) < valid_rows
+        cols = [c.mask_validity(mask) for c in b.columns]
+        return ColumnarBatch(self.schema, cols, valid_rows)
+
+    def nbytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns)
+
+    def device_buffers(self):
+        out = []
+        for c in self.columns:
+            out.extend(c.device_buffers())
+        return out
+
+    def __repr__(self):
+        return (f"ColumnarBatch(rows={self.num_rows}, cap={self.capacity}, "
+                f"schema={self.schema})")
+
+
+def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
+    """Concatenate batches of identical schema (the GpuCoalesceBatches core,
+
+    reference: GpuCoalesceBatches.scala:195)."""
+    batches = [b for b in batches]
+    assert batches, "concat of zero batches"
+    if len(batches) == 1:
+        return batches[0]
+    schema = batches[0].schema
+    total = sum(b.num_rows for b in batches)
+    cap = bucket_capacity(total)
+    out_cols: List[Column] = []
+    for ci, field in enumerate(schema):
+        if field.dtype == T.STRING:
+            out_cols.append(_concat_string_cols(
+                [b.columns[ci] for b in batches],
+                [b.num_rows for b in batches], cap))
+        else:
+            datas, valids = [], []
+            for b in batches:
+                c = b.columns[ci]
+                datas.append(c.data[:b.num_rows] if b.num_rows != c.capacity
+                             else c.data)
+                valids.append(c.validity[:b.num_rows]
+                              if b.num_rows != c.capacity else c.validity)
+            # trim to exact rows then pad to bucket
+            datas = [d[:n] for d, n in zip(datas, [b.num_rows for b in batches])]
+            valids = [v[:n] for v, n in zip(valids, [b.num_rows for b in batches])]
+            data = jnp.concatenate(datas) if datas else jnp.zeros(0)
+            valid = jnp.concatenate(valids)
+            pad = cap - int(data.shape[0])
+            if pad:
+                data = jnp.pad(data, (0, pad))
+                valid = jnp.pad(valid, (0, pad))
+            out_cols.append(Column(field.dtype, data, valid))
+    return ColumnarBatch(schema, out_cols, total)
+
+
+def _concat_string_cols(cols: Sequence[StringColumn], nrows: Sequence[int],
+                        cap: int) -> StringColumn:
+    offsets_parts, bytes_parts, valid_parts = [], [], []
+    base = 0
+    for c, n in zip(cols, nrows):
+        offs = c.offsets
+        nbytes_live = offs[n]
+        offsets_parts.append(offs[:n] + base)
+        base = base + nbytes_live
+        bytes_parts.append(c.data)
+        valid_parts.append(c.validity[:n])
+    # bytes: need exact live bytes from each column; do on host-free device ops
+    # by slicing with dynamic sizes is not static-shape friendly; instead gather
+    # via numpy on host for now (concat is a batch boundary; the reference also
+    # round-trips through host for shuffle concat of serialized batches).
+    np_bytes = []
+    for c, n in zip(cols, nrows):
+        offs = np.asarray(c.offsets)
+        live = int(offs[n])
+        np_bytes.append(np.asarray(c.data)[:live])
+    all_bytes = np.concatenate(np_bytes) if np_bytes else np.zeros(0, np.uint8)
+    byte_cap = bucket_capacity(max(1, all_bytes.shape[0]))
+    buf = np.zeros(byte_cap, np.uint8)
+    buf[: all_bytes.shape[0]] = all_bytes
+    offsets = jnp.concatenate(offsets_parts + [jnp.array([all_bytes.shape[0]],
+                                                         jnp.int32)])
+    total = sum(nrows)
+    pad = cap + 1 - int(offsets.shape[0])
+    if pad > 0:
+        offsets = jnp.pad(offsets, (0, pad), mode="edge")
+    valid = jnp.concatenate(valid_parts)
+    vpad = cap - int(valid.shape[0])
+    if vpad > 0:
+        valid = jnp.pad(valid, (0, vpad))
+    return StringColumn(offsets.astype(jnp.int32), jnp.asarray(buf), valid)

@@ -1,0 +1,239 @@
+"""Device column vectors — the TPU analogue of ``GpuColumnVector``.
+
+Role parity: reference sql-plugin/src/main/java/com/nvidia/spark/rapids/
+GpuColumnVector.java (cuDF-backed device vectors) and RapidsHostColumnVector.java.
+
+TPU-first design:
+- Every column is a set of dense JAX arrays padded to a *bucketed capacity*
+  (power of two).  XLA requires static shapes, so kernels are compiled per
+  (schema, capacity-bucket) and reused; the live row count travels as data.
+- Validity is a separate bool array (Arrow-style), True = valid.
+- Strings use Arrow offsets+bytes layout.  For key operations (sort/join/group)
+  strings are packed into big-endian uint64 "key words" so ordering/equality is
+  exact byte-wise UTF-8 order — which equals code-point order — using only
+  integer ops the MXU/VPU likes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtypes as T
+
+# Minimum capacity bucket; batches are padded up to powers of two so the
+# jit-cache stays small (SURVEY.md §7 "compile-cache keyed by padded size").
+MIN_CAPACITY = 16
+
+
+def bucket_capacity(n: int) -> int:
+    cap = MIN_CAPACITY
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def _pad_np(arr: np.ndarray, capacity: int, fill=0) -> np.ndarray:
+    if arr.shape[0] == capacity:
+        return arr
+    out = np.full((capacity,) + arr.shape[1:], fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+class Column:
+    """Fixed-width device column: data[capacity] + validity[capacity]."""
+
+    def __init__(self, dtype: T.DType, data, validity):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    # -- constructors -----------------------------------------------------------
+    @staticmethod
+    def from_numpy(values, dtype: Optional[T.DType] = None,
+                   capacity: Optional[int] = None) -> "Column":
+        """Build from a numpy array or a Python list that may contain None."""
+        if isinstance(values, (list, tuple)):
+            validity = np.array([v is not None for v in values], dtype=np.bool_)
+            if dtype is None:
+                probe = [v for v in values if v is not None]
+                np_arr = np.array(probe if probe else [0])
+                dtype = T.from_numpy_dtype(np_arr.dtype)
+            if dtype == T.STRING:
+                return StringColumn.from_pylist(list(values), capacity=capacity)
+            clean = [v if v is not None else dtype.default_value for v in values]
+            arr = np.array(clean, dtype=dtype.np_dtype)
+        else:
+            arr = np.asarray(values)
+            if dtype is None:
+                dtype = T.from_numpy_dtype(arr.dtype)
+            if dtype == T.STRING:
+                return StringColumn.from_pylist(list(arr), capacity=capacity)
+            if arr.dtype.kind == "M":
+                arr = arr.astype("datetime64[us]").astype(np.int64)
+            arr = arr.astype(dtype.np_dtype)
+            validity = np.ones(arr.shape[0], dtype=np.bool_)
+        n = arr.shape[0]
+        cap = capacity or bucket_capacity(n)
+        data = jnp.asarray(_pad_np(arr, cap))
+        valid = jnp.asarray(_pad_np(validity, cap, fill=False))
+        return Column(dtype, data, valid)
+
+    @staticmethod
+    def all_null(dtype: T.DType, capacity: int) -> "Column":
+        if dtype == T.STRING:
+            return StringColumn(
+                jnp.zeros(capacity + 1, jnp.int32),
+                jnp.zeros(MIN_CAPACITY, jnp.uint8),
+                jnp.zeros(capacity, jnp.bool_))
+        data = jnp.zeros(capacity, dtype=dtype.np_dtype)
+        return Column(dtype, data, jnp.zeros(capacity, jnp.bool_))
+
+    @staticmethod
+    def from_scalar(value, dtype: T.DType, capacity: int,
+                    num_rows: Optional[int] = None) -> "Column":
+        n = capacity if num_rows is None else num_rows
+        if dtype == T.STRING:
+            return StringColumn.from_pylist(
+                [value] * n, capacity=capacity)
+        if value is None:
+            return Column.all_null(dtype, capacity)
+        data = jnp.full((capacity,), value, dtype=dtype.np_dtype)
+        valid = (jnp.arange(capacity) < n)
+        return Column(dtype, data, valid)
+
+    # -- host interop -----------------------------------------------------------
+    def to_numpy(self, num_rows: int):
+        """Return (values ndarray, validity ndarray) truncated to num_rows."""
+        return (np.asarray(self.data)[:num_rows],
+                np.asarray(self.validity)[:num_rows])
+
+    def to_pylist(self, num_rows: int) -> List:
+        vals, valid = self.to_numpy(num_rows)
+        return [v.item() if ok else None for v, ok in zip(vals, valid)]
+
+    # -- structural ops (host-driven, device-executed) --------------------------
+    def with_capacity(self, capacity: int, num_rows: int) -> "Column":
+        if capacity == self.capacity:
+            return self
+        if capacity > self.capacity:
+            pad = capacity - self.capacity
+            data = jnp.pad(self.data, (0, pad))
+            valid = jnp.pad(self.validity, (0, pad))
+        else:
+            data = self.data[:capacity]
+            valid = self.validity[:capacity] & (jnp.arange(capacity) < num_rows)
+        return Column(self.dtype, data, valid)
+
+    def gather(self, indices) -> "Column":
+        """Take rows by index (device gather). indices: int array [new_cap]."""
+        return Column(self.dtype, jnp.take(self.data, indices, axis=0,
+                                           mode="clip"),
+                      jnp.take(self.validity, indices, axis=0, mode="clip"))
+
+    def mask_validity(self, keep_mask) -> "Column":
+        return Column(self.dtype, self.data, self.validity & keep_mask)
+
+    def nbytes(self) -> int:
+        return self.data.nbytes + self.validity.nbytes
+
+    def device_buffers(self):
+        return [self.data, self.validity]
+
+
+class StringColumn(Column):
+    """Arrow-layout string column: offsets int32[cap+1], bytes uint8[byte_cap].
+
+    Reference analogue: cuDF STRING columns used throughout stringFunctions.scala.
+    """
+
+    def __init__(self, offsets, data, validity):
+        self.dtype = T.STRING
+        self.offsets = offsets
+        self.data = data  # uint8 byte buffer
+        self.validity = validity
+
+    @property
+    def capacity(self) -> int:
+        return int(self.validity.shape[0])
+
+    @property
+    def byte_capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    @staticmethod
+    def from_pylist(values: Sequence[Optional[str]],
+                    capacity: Optional[int] = None) -> "StringColumn":
+        n = len(values)
+        cap = capacity or bucket_capacity(n)
+        validity = np.zeros(cap, dtype=np.bool_)
+        encoded: List[bytes] = []
+        for i, v in enumerate(values):
+            if v is None:
+                encoded.append(b"")
+            else:
+                validity[i] = True
+                encoded.append(str(v).encode("utf-8"))
+        offsets = np.zeros(cap + 1, dtype=np.int32)
+        lens = [len(e) for e in encoded]
+        offsets[1: n + 1] = np.cumsum(lens)
+        offsets[n + 1:] = offsets[n]
+        total = int(offsets[n])
+        byte_cap = bucket_capacity(max(total, 1))
+        buf = np.zeros(byte_cap, dtype=np.uint8)
+        if total:
+            buf[:total] = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+        return StringColumn(jnp.asarray(offsets), jnp.asarray(buf),
+                            jnp.asarray(validity))
+
+    def to_numpy(self, num_rows: int):
+        offs = np.asarray(self.offsets)
+        buf = np.asarray(self.data).tobytes()
+        valid = np.asarray(self.validity)[:num_rows]
+        vals = np.empty(num_rows, dtype=object)
+        for i in range(num_rows):
+            vals[i] = buf[offs[i]:offs[i + 1]].decode("utf-8", "replace")
+        return vals, valid
+
+    def to_pylist(self, num_rows: int) -> List:
+        vals, valid = self.to_numpy(num_rows)
+        return [v if ok else None for v, ok in zip(vals, valid)]
+
+    def with_capacity(self, capacity: int, num_rows: int) -> "StringColumn":
+        if capacity == self.capacity:
+            return self
+        if capacity > self.capacity:
+            pad = capacity - self.capacity
+            offsets = jnp.pad(self.offsets, (0, pad), mode="edge")
+            valid = jnp.pad(self.validity, (0, pad))
+        else:
+            offsets = self.offsets[:capacity + 1]
+            valid = self.validity[:capacity] & (jnp.arange(capacity) < num_rows)
+        return StringColumn(offsets, self.data, valid)
+
+    def gather(self, indices) -> "StringColumn":
+        # String gather rebuilds offsets on device and gathers bytes via a
+        # windowed index computation (kernels.strings.gather_strings).
+        from ..kernels import strings as skern
+        offs, buf, valid = skern.gather_strings(
+            self.offsets, self.data, self.validity, indices)
+        return StringColumn(offs, buf, valid)
+
+    def mask_validity(self, keep_mask) -> "StringColumn":
+        return StringColumn(self.offsets, self.data, self.validity & keep_mask)
+
+    def nbytes(self) -> int:
+        return self.offsets.nbytes + self.data.nbytes + self.validity.nbytes
+
+    def device_buffers(self):
+        return [self.offsets, self.data, self.validity]
+
+
+ColumnLike = Union[Column, StringColumn]

@@ -1,0 +1,293 @@
+"""Data type system for TPU columnar batches.
+
+Role parity: the reference's type universe is Spark's ``DataType`` filtered through
+``TypeSig`` (reference: sql-plugin/.../TypeChecks.scala:367).  Here we define the
+engine-native dtype lattice directly: every dtype knows its device representation
+(a JAX dtype for the data buffer) plus any auxiliary buffers (validity, string
+offsets).  Nulls are carried in a separate validity mask, Arrow-style, matching the
+reference's cuDF column layout (reference: sql-plugin/src/main/java/com/nvidia/
+spark/rapids/GpuColumnVector.java).
+
+TPU-first notes:
+- Integer/float/bool columns map 1:1 onto dense device buffers.
+- Decimal is DECIMAL64: unscaled int64 + (precision, scale) metadata, exactly the
+  reference's supported subset (reference: GpuOverrides.scala:659).
+- Strings are kept as UTF-8 bytes + int32 offsets (Arrow layout) with an optional
+  dictionary encoding; byte-level kernels operate on the int buffers since XLA has
+  no string type.
+- Date is days-since-epoch int32; timestamp is microseconds-since-epoch int64
+  (Spark semantics).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+
+class DType:
+    """Base class for engine dtypes. Instances are lightweight and hashable."""
+
+    #: short name used in schema strings and TypeSig docs
+    name: str = "invalid"
+    #: numpy dtype of the primary device buffer (None for nested types)
+    np_dtype: Optional[np.dtype] = None
+
+    def __repr__(self) -> str:
+        return self.name
+
+    def __eq__(self, other: Any) -> bool:
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, tuple(sorted(self.__dict__.items()))))
+
+    # -- classification helpers -------------------------------------------------
+    @property
+    def is_numeric(self) -> bool:
+        return isinstance(self, (IntegralType, FractionalType, DecimalType))
+
+    @property
+    def is_integral(self) -> bool:
+        return isinstance(self, IntegralType)
+
+    @property
+    def is_fractional(self) -> bool:
+        return isinstance(self, FractionalType)
+
+    @property
+    def is_nested(self) -> bool:
+        return isinstance(self, (ArrayType, StructType, MapType))
+
+    @property
+    def default_value(self):
+        """Value used to fill padding/null slots in dense buffers."""
+        if self.np_dtype is None:
+            return None
+        return np.zeros((), dtype=self.np_dtype)[()]
+
+
+class NumericType(DType):
+    pass
+
+
+class IntegralType(NumericType):
+    pass
+
+
+class FractionalType(NumericType):
+    pass
+
+
+class BooleanType(DType):
+    name = "boolean"
+    np_dtype = np.dtype(np.bool_)
+
+
+class ByteType(IntegralType):
+    name = "tinyint"
+    np_dtype = np.dtype(np.int8)
+
+
+class ShortType(IntegralType):
+    name = "smallint"
+    np_dtype = np.dtype(np.int16)
+
+
+class IntegerType(IntegralType):
+    name = "int"
+    np_dtype = np.dtype(np.int32)
+
+
+class LongType(IntegralType):
+    name = "bigint"
+    np_dtype = np.dtype(np.int64)
+
+
+class FloatType(FractionalType):
+    name = "float"
+    np_dtype = np.dtype(np.float32)
+
+
+class DoubleType(FractionalType):
+    name = "double"
+    np_dtype = np.dtype(np.float64)
+
+
+class StringType(DType):
+    """UTF-8 string; device layout is offsets int32[n+1] + bytes uint8[total]."""
+
+    name = "string"
+    np_dtype = None  # variable width; see StringColumn
+
+
+class DateType(DType):
+    """Days since unix epoch, int32 (Spark DateType semantics)."""
+
+    name = "date"
+    np_dtype = np.dtype(np.int32)
+
+
+class TimestampType(DType):
+    """Microseconds since unix epoch UTC, int64 (Spark TimestampType)."""
+
+    name = "timestamp"
+    np_dtype = np.dtype(np.int64)
+
+
+class NullType(DType):
+    name = "null"
+    np_dtype = np.dtype(np.bool_)  # all-null placeholder buffer
+
+
+@dataclasses.dataclass(frozen=True, eq=True, repr=False)
+class DecimalType(NumericType):
+    """Fixed-point decimal backed by an unscaled int64 (DECIMAL64 subset only,
+
+    matching the reference's precision<=18 gate, GpuOverrides.scala:659)."""
+
+    precision: int = 10
+    scale: int = 0
+    MAX_PRECISION = 18
+
+    def __post_init__(self):
+        if self.precision > self.MAX_PRECISION:
+            raise ValueError(
+                f"DecimalType precision {self.precision} exceeds DECIMAL64 max "
+                f"{self.MAX_PRECISION}")
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"decimal({self.precision},{self.scale})"
+
+    np_dtype = np.dtype(np.int64)
+
+    def __hash__(self):
+        return hash(("DecimalType", self.precision, self.scale))
+
+
+@dataclasses.dataclass(frozen=True, eq=True, repr=False)
+class ArrayType(DType):
+    element_type: DType = dataclasses.field(default_factory=IntegerType)
+    contains_null: bool = True
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"array<{self.element_type.name}>"
+
+    np_dtype = None
+
+    def __hash__(self):
+        return hash(("ArrayType", self.element_type, self.contains_null))
+
+
+@dataclasses.dataclass(frozen=True, eq=True, repr=False)
+class StructField:
+    name: str
+    dtype: DType
+    nullable: bool = True
+
+    def __hash__(self):
+        return hash((self.name, self.dtype, self.nullable))
+
+
+@dataclasses.dataclass(frozen=True, eq=True, repr=False)
+class StructType(DType):
+    fields: Tuple[StructField, ...] = ()
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        inner = ",".join(f"{f.name}:{f.dtype.name}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    np_dtype = None
+
+    def __hash__(self):
+        return hash(("StructType", self.fields))
+
+
+@dataclasses.dataclass(frozen=True, eq=True, repr=False)
+class MapType(DType):
+    key_type: DType = dataclasses.field(default_factory=StringType)
+    value_type: DType = dataclasses.field(default_factory=StringType)
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"map<{self.key_type.name},{self.value_type.name}>"
+
+    np_dtype = None
+
+    def __hash__(self):
+        return hash(("MapType", self.key_type, self.value_type))
+
+
+# Canonical singletons
+BOOL = BooleanType()
+INT8 = ByteType()
+INT16 = ShortType()
+INT32 = IntegerType()
+INT64 = LongType()
+FLOAT32 = FloatType()
+FLOAT64 = DoubleType()
+STRING = StringType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+NULL = NullType()
+
+_BY_NAME = {
+    t.name: t
+    for t in [BOOL, INT8, INT16, INT32, INT64, FLOAT32, FLOAT64, STRING, DATE,
+              TIMESTAMP, NULL]
+}
+_ALIASES = {
+    "long": INT64, "integer": INT32, "short": INT16, "byte": INT8,
+    "bool": BOOL, "str": STRING, "real": FLOAT32,
+}
+
+
+def dtype_from_name(name: str) -> DType:
+    name = name.strip().lower()
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    if name in _ALIASES:
+        return _ALIASES[name]
+    if name.startswith("decimal"):
+        inner = name[name.index("(") + 1:name.index(")")]
+        p, s = inner.split(",")
+        return DecimalType(int(p), int(s))
+    raise ValueError(f"unknown dtype name: {name}")
+
+
+def from_numpy_dtype(dt: np.dtype) -> DType:
+    dt = np.dtype(dt)
+    table = {
+        np.dtype(np.bool_): BOOL,
+        np.dtype(np.int8): INT8,
+        np.dtype(np.int16): INT16,
+        np.dtype(np.int32): INT32,
+        np.dtype(np.int64): INT64,
+        np.dtype(np.float32): FLOAT32,
+        np.dtype(np.float64): FLOAT64,
+    }
+    if dt in table:
+        return table[dt]
+    if dt.kind in ("U", "S", "O"):
+        return STRING
+    if dt.kind == "M":  # datetime64
+        return TIMESTAMP
+    raise ValueError(f"unsupported numpy dtype: {dt}")
+
+
+def common_type(a: DType, b: DType) -> DType:
+    """Numeric type promotion following Spark's binary-op coercion."""
+    if a == b:
+        return a
+    order = [INT8, INT16, INT32, INT64, FLOAT32, FLOAT64]
+    if a in order and b in order:
+        return order[max(order.index(a), order.index(b))]
+    if isinstance(a, DecimalType) and b.is_integral:
+        return a
+    if isinstance(b, DecimalType) and a.is_integral:
+        return b
+    raise ValueError(f"no common type for {a} and {b}")

@@ -1,0 +1,69 @@
+"""Schema: ordered named, typed, nullable fields.
+
+Reference analogue: Spark ``StructType`` as consumed by the plugin's type
+checks (TypeChecks.scala) and batch builders (GpuColumnVector.from(...)).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, List, Tuple
+
+from . import dtypes as T
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: T.DType
+    nullable: bool = True
+
+    def __repr__(self):
+        return f"{self.name}:{self.dtype.name}{'' if self.nullable else ' not null'}"
+
+
+class Schema:
+    def __init__(self, fields: Iterable[Field]):
+        self.fields: Tuple[Field, ...] = tuple(fields)
+        self._index = {f.name: i for i, f in enumerate(self.fields)}
+
+    @staticmethod
+    def of(*pairs) -> "Schema":
+        """Schema.of(("a", T.INT64), ("b", T.STRING, False))"""
+        fields = []
+        for p in pairs:
+            if len(p) == 2:
+                fields.append(Field(p[0], p[1]))
+            else:
+                fields.append(Field(p[0], p[1], p[2]))
+        return Schema(fields)
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            return self.fields[self._index[key]]
+        return self.fields[key]
+
+    def __eq__(self, other):
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def __hash__(self):
+        return hash(self.fields)
+
+    def index_of(self, name: str) -> int:
+        return self._index[name]
+
+    @property
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    @property
+    def dtypes(self) -> List[T.DType]:
+        return [f.dtype for f in self.fields]
+
+    def __repr__(self):
+        return "Schema(" + ", ".join(repr(f) for f in self.fields) + ")"

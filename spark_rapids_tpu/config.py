@@ -1,0 +1,260 @@
+"""Typed configuration registry — the RapidsConf role.
+
+Reference analogue: sql-plugin/.../RapidsConf.scala:116,288 — a registry of
+typed ``ConfEntry``s under ``spark.rapids.*`` with docs, defaults and
+converters, able to self-generate docs (RapidsConf.help/main,
+RapidsConf.scala:1229).  Here the namespace is ``spark.rapids.tpu.*`` and
+entries drive the same behaviors: enable/disable per-op replacement,
+batch-size goals, memory pool fractions, shuffle transport selection,
+explain verbosity, test-mode assertions.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ConfEntry:
+    key: str
+    converter: Callable[[str], Any]
+    default: Any
+    doc: str
+    internal: bool = False
+
+    def get(self, conf: "TpuConf") -> Any:
+        raw = conf._settings.get(self.key)
+        if raw is None:
+            return self.default
+        if isinstance(raw, str):
+            return self.converter(raw)
+        return raw
+
+
+_REGISTRY: Dict[str, ConfEntry] = {}
+
+
+def _register(entry: ConfEntry) -> ConfEntry:
+    assert entry.key not in _REGISTRY, f"duplicate conf {entry.key}"
+    _REGISTRY[entry.key] = entry
+    return entry
+
+
+def _bool(v: str) -> bool:
+    return str(v).strip().lower() in ("true", "1", "yes")
+
+
+def conf_bool(key, default, doc, internal=False):
+    return _register(ConfEntry(key, _bool, default, doc, internal))
+
+
+def conf_int(key, default, doc, internal=False):
+    return _register(ConfEntry(key, int, default, doc, internal))
+
+
+def conf_float(key, default, doc, internal=False):
+    return _register(ConfEntry(key, float, default, doc, internal))
+
+
+def conf_str(key, default, doc, internal=False):
+    return _register(ConfEntry(key, str, default, doc, internal))
+
+
+def conf_bytes(key, default, doc, internal=False):
+    def parse(v):
+        s = str(v).strip().lower()
+        mult = 1
+        for suffix, m in (("k", 2**10), ("m", 2**20), ("g", 2**30),
+                          ("t", 2**40)):
+            if s.endswith(suffix + "b"):
+                s, mult = s[:-2], m
+                break
+            if s.endswith(suffix):
+                s, mult = s[:-1], m
+                break
+        return int(float(s) * mult)
+    return _register(ConfEntry(key, parse, default, doc, internal))
+
+
+# ---------------------------------------------------------------------------
+# Entries (parity with the reference's major spark.rapids.* groups,
+# RapidsConf.scala — same knobs, TPU names)
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = conf_bool(
+    "spark.rapids.tpu.sql.enabled", True,
+    "Master enable for plan acceleration (reference: spark.rapids.sql.enabled)")
+EXPLAIN = conf_str(
+    "spark.rapids.tpu.sql.explain", "NONE",
+    "NONE/NOT_ON_TPU/ALL: log why operators did or didn't go to the TPU "
+    "(reference: spark.rapids.sql.explain)")
+BATCH_SIZE_ROWS = conf_int(
+    "spark.rapids.tpu.sql.batchSizeRows", 1 << 20,
+    "Target rows per columnar batch (coalesce goal; reference: "
+    "spark.rapids.sql.batchSizeBytes)")
+BATCH_SIZE_BYTES = conf_bytes(
+    "spark.rapids.tpu.sql.batchSizeBytes", 512 * 2**20,
+    "Target bytes per columnar batch for coalescing")
+CONCURRENT_TPU_TASKS = conf_int(
+    "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
+    "Max concurrent tasks admitted to the device (reference: "
+    "spark.rapids.sql.concurrentGpuTasks / GpuSemaphore)")
+MAX_READER_BATCH_ROWS = conf_int(
+    "spark.rapids.tpu.sql.reader.batchSizeRows", 1 << 20,
+    "Soft cap on rows per scan batch (reference: "
+    "spark.rapids.sql.reader.batchSizeRows)")
+HBM_POOL_FRACTION = conf_float(
+    "spark.rapids.tpu.memory.pool.fraction", 0.9,
+    "Fraction of device HBM managed by the arena (reference: "
+    "spark.rapids.memory.gpu.allocFraction)")
+HBM_RESERVE = conf_bytes(
+    "spark.rapids.tpu.memory.reserve", 1 << 30,
+    "HBM held back from the pool for XLA scratch (reference: "
+    "spark.rapids.memory.gpu.reserve)")
+HOST_SPILL_LIMIT = conf_bytes(
+    "spark.rapids.tpu.memory.host.spillStorageSize", 8 * 2**30,
+    "Bytes of host memory for spilled buffers before disk "
+    "(reference: spark.rapids.memory.host.spillStorageSize)")
+SPILL_DIR = conf_str(
+    "spark.rapids.tpu.memory.spill.dir", "/tmp/spark_rapids_tpu_spill",
+    "Directory for disk-tier spill files (reference: RapidsDiskStore)")
+MEMORY_DEBUG = conf_bool(
+    "spark.rapids.tpu.memory.debug", False,
+    "Log arena allocations (reference: spark.rapids.memory.gpu.debug)")
+SHUFFLE_TRANSPORT = conf_str(
+    "spark.rapids.tpu.shuffle.transport", "local",
+    "Shuffle transport: local | mesh (ICI collectives) "
+    "(reference: spark.rapids.shuffle.transport.enabled / UCX)")
+SHUFFLE_PARTITIONS = conf_int(
+    "spark.rapids.tpu.sql.shuffle.partitions", 8,
+    "Default partition count for exchanges (spark.sql.shuffle.partitions)")
+SHUFFLE_COMPRESS = conf_str(
+    "spark.rapids.tpu.shuffle.compression.codec", "none",
+    "none|lz4-like codec for shuffle buffers (reference: "
+    "spark.rapids.shuffle.compression.codec)")
+INCOMPATIBLE_OPS = conf_bool(
+    "spark.rapids.tpu.sql.incompatibleOps.enabled", False,
+    "Allow ops whose results can differ from CPU in corner cases "
+    "(reference: spark.rapids.sql.incompatibleOps.enabled)")
+HAS_NANS = conf_bool(
+    "spark.rapids.tpu.sql.hasNans", True,
+    "Assume float data may contain NaNs (reference: spark.rapids.sql.hasNans)")
+ANSI_ENABLED = conf_bool(
+    "spark.rapids.tpu.sql.ansi.enabled", False,
+    "ANSI mode: overflow/invalid-cast raise instead of null/wrap")
+TEST_ENABLED = conf_bool(
+    "spark.rapids.tpu.sql.test.enabled", False,
+    "Test mode: assert everything that should run on TPU did "
+    "(reference: spark.rapids.sql.test.enabled)")
+TEST_ALLOWED_NON_TPU = conf_str(
+    "spark.rapids.tpu.sql.test.allowedNonTpu", "",
+    "Comma-separated op names permitted to fall back in test mode "
+    "(reference: spark.rapids.sql.test.allowedNonGpu)")
+CBO_ENABLED = conf_bool(
+    "spark.rapids.tpu.sql.optimizer.enabled", False,
+    "Cost-based fallback optimizer (reference: "
+    "spark.rapids.sql.optimizer.enabled)")
+METRICS_LEVEL = conf_str(
+    "spark.rapids.tpu.sql.metrics.level", "MODERATE",
+    "ESSENTIAL/MODERATE/DEBUG metric collection level "
+    "(reference: spark.rapids.sql.metrics.level)")
+DECIMAL_ENABLED = conf_bool(
+    "spark.rapids.tpu.sql.decimalType.enabled", True,
+    "Enable decimal64 acceleration (reference: "
+    "spark.rapids.sql.decimalType.enabled)")
+CAST_STRING_TO_FLOAT = conf_bool(
+    "spark.rapids.tpu.sql.castStringToFloat.enabled", False,
+    "Enable string->float cast (tiny rounding diffs vs CPU; reference: "
+    "spark.rapids.sql.castStringToFloat.enabled)")
+FORMAT_PARQUET_ENABLED = conf_bool(
+    "spark.rapids.tpu.sql.format.parquet.enabled", True,
+    "Enable Parquet scan/write acceleration")
+FORMAT_CSV_ENABLED = conf_bool(
+    "spark.rapids.tpu.sql.format.csv.enabled", True,
+    "Enable CSV scan acceleration")
+FORMAT_ORC_ENABLED = conf_bool(
+    "spark.rapids.tpu.sql.format.orc.enabled", True,
+    "Enable ORC scan/write acceleration")
+PARQUET_READER_TYPE = conf_str(
+    "spark.rapids.tpu.sql.format.parquet.reader.type", "AUTO",
+    "AUTO/PERFILE/MULTITHREADED/COALESCING (reference: "
+    "spark.rapids.sql.format.parquet.reader.type)")
+MULTITHREAD_READ_THREADS = conf_int(
+    "spark.rapids.tpu.sql.format.parquet.multiThreadedRead.numThreads", 4,
+    "Prefetch threads for the multithreaded reader (reference: "
+    "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads)")
+UDF_COMPILER_ENABLED = conf_bool(
+    "spark.rapids.tpu.sql.udfCompiler.enabled", True,
+    "Compile Python UDF bytecode to native expressions when possible "
+    "(reference: com.nvidia.spark.udf.Plugin)")
+SHIM_PROVIDER_OVERRIDE = conf_str(
+    "spark.rapids.tpu.shims-provider-override", "",
+    "Force a specific compat shim (reference: "
+    "spark.rapids.shims-provider-override)")
+
+
+class TpuConf:
+    """Immutable-ish view over a settings dict; re-read per query plan like
+
+    the reference (GpuOverrides.scala:3105 constructs RapidsConf per apply)."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings = dict(settings or {})
+
+    def get(self, entry: ConfEntry):
+        return entry.get(self)
+
+    def get_key(self, key: str):
+        if key in _REGISTRY:
+            return _REGISTRY[key].get(self)
+        return self._settings.get(key)
+
+    def set(self, key: str, value) -> "TpuConf":
+        s = dict(self._settings)
+        s[key] = value
+        return TpuConf(s)
+
+    def with_overrides(self, overrides: Dict[str, Any]) -> "TpuConf":
+        s = dict(self._settings)
+        s.update(overrides)
+        return TpuConf(s)
+
+    @property
+    def is_sql_enabled(self):
+        return self.get(SQL_ENABLED)
+
+    @property
+    def allowed_non_tpu(self) -> List[str]:
+        raw = self.get(TEST_ALLOWED_NON_TPU)
+        return [s.strip() for s in raw.split(",") if s.strip()]
+
+
+def all_entries() -> List[ConfEntry]:
+    return sorted(_REGISTRY.values(), key=lambda e: e.key)
+
+
+def generate_docs() -> str:
+    """Self-generated config docs (reference: RapidsConf.help -> configs.md)."""
+    lines = ["# spark_rapids_tpu configuration", "",
+             "| Key | Default | Description |", "|---|---|---|"]
+    for e in all_entries():
+        if e.internal:
+            continue
+        lines.append(f"| `{e.key}` | `{e.default}` | {e.doc} |")
+    return "\n".join(lines) + "\n"
+
+
+# process-wide active conf (executor side), guarded for worker threads
+_ACTIVE = TpuConf()
+_ACTIVE_LOCK = threading.Lock()
+
+
+def get_active() -> TpuConf:
+    return _ACTIVE
+
+
+def set_active(conf: TpuConf):
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = conf

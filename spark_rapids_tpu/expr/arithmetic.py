@@ -1,0 +1,408 @@
+"""Arithmetic and math expressions.
+
+Reference analogue: arithmetic.scala, mathExpressions.scala and their
+registrations in GpuOverrides.scala:773+.  Non-ANSI Spark semantics:
+integer ops wrap (two's complement), x/0 -> null, nulls propagate.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.column import Column
+from .core import Expression, eval_data_valid
+
+
+class BinaryArithmetic(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def result_type(self, lt: T.DType, rt: T.DType) -> T.DType:
+        return T.common_type(lt, rt)
+
+    def dtype(self):
+        return self.result_type(self.children[0].dtype(),
+                                self.children[1].dtype())
+
+    def op(self, a, b):
+        raise NotImplementedError
+
+    def extra_null_mask(self, a, b) -> Optional[jnp.ndarray]:
+        return None
+
+    def columnar_eval(self, batch):
+        la, lv, lt = eval_data_valid(self.children[0], batch)
+        ra, rv, rt = eval_data_valid(self.children[1], batch)
+        out_t = self.result_type(lt, rt)
+        a = la.astype(out_t.np_dtype)
+        b = ra.astype(out_t.np_dtype)
+        valid = lv & rv
+        extra = self.extra_null_mask(a, b)
+        if extra is not None:
+            valid = valid & ~extra
+        data = self.op(a, b)
+        return Column(out_t, data, valid)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} {self.symbol} {self.children[1]!r})"
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def op(self, a, b):
+        return a + b
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+
+    def op(self, a, b):
+        return a - b
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def op(self, a, b):
+        return a * b
+
+
+class Divide(BinaryArithmetic):
+    """Spark Divide is always floating/decimal; int inputs promote to double."""
+    symbol = "/"
+
+    def result_type(self, lt, rt):
+        if isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType):
+            return T.FLOAT64  # decimal division via double in v0
+        return T.FLOAT64
+
+    def op(self, a, b):
+        return a / jnp.where(b == 0, jnp.ones_like(b), b)
+
+    def extra_null_mask(self, a, b):
+        return b == 0
+
+
+class IntegralDivide(BinaryArithmetic):
+    symbol = "div"
+
+    def result_type(self, lt, rt):
+        return T.INT64
+
+    def op(self, a, b):
+        safe_b = jnp.where(b == 0, jnp.ones_like(b), b)
+        # Spark div truncates toward zero (Java semantics)
+        q = jnp.trunc(a.astype(jnp.float64) / safe_b.astype(jnp.float64))
+        return q.astype(jnp.int64)
+
+    def extra_null_mask(self, a, b):
+        return b == 0
+
+
+class Remainder(BinaryArithmetic):
+    symbol = "%"
+
+    def op(self, a, b):
+        safe_b = jnp.where(b == 0, jnp.ones_like(b), b)
+        # Java remainder: sign follows dividend (fmod), not python mod
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            return jnp.fmod(a, safe_b)
+        q = jnp.trunc(a.astype(jnp.float64) / safe_b.astype(jnp.float64))
+        return (a - q.astype(a.dtype) * safe_b)
+
+    def extra_null_mask(self, a, b):
+        return b == 0
+
+
+class Pmod(BinaryArithmetic):
+    symbol = "pmod"
+
+    def op(self, a, b):
+        safe_b = jnp.where(b == 0, jnp.ones_like(b), b)
+        r = jnp.where(
+            jnp.issubdtype(a.dtype, jnp.floating) or True,
+            a, a)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            r = jnp.fmod(a, safe_b)
+        else:
+            q = jnp.trunc(a.astype(jnp.float64) / safe_b.astype(jnp.float64))
+            r = a - q.astype(a.dtype) * safe_b
+        return jnp.where(r < 0, r + jnp.abs(safe_b), r)
+
+    def extra_null_mask(self, a, b):
+        return b == 0
+
+
+class UnaryExpression(Expression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    def dtype(self):
+        return self.children[0].dtype()
+
+    def op(self, a):
+        raise NotImplementedError
+
+    def columnar_eval(self, batch):
+        a, v, t = eval_data_valid(self.children[0], batch)
+        return Column(self.dtype(), self.op(a).astype(
+            self.dtype().np_dtype), v)
+
+
+class UnaryMinus(UnaryExpression):
+    def op(self, a):
+        return -a
+
+
+class UnaryPositive(UnaryExpression):
+    def op(self, a):
+        return a
+
+
+class Abs(UnaryExpression):
+    def op(self, a):
+        return jnp.abs(a)
+
+
+class _MathUnary(UnaryExpression):
+    """Double-valued unary math fn (reference: mathExpressions.scala)."""
+    fn: Callable = staticmethod(jnp.sqrt)
+
+    def dtype(self):
+        return T.FLOAT64
+
+    def op(self, a):
+        return type(self).fn(a.astype(jnp.float64))
+
+
+def _make_math(name: str, fn) -> type:
+    cls = type(name, (_MathUnary,), {"fn": staticmethod(fn)})
+    return cls
+
+
+Sqrt = _make_math("Sqrt", jnp.sqrt)
+Exp = _make_math("Exp", jnp.exp)
+Expm1 = _make_math("Expm1", jnp.expm1)
+Log = _make_math("Log", jnp.log)
+Log1p = _make_math("Log1p", jnp.log1p)
+Log2 = _make_math("Log2", jnp.log2)
+Log10 = _make_math("Log10", jnp.log10)
+Sin = _make_math("Sin", jnp.sin)
+Cos = _make_math("Cos", jnp.cos)
+Tan = _make_math("Tan", jnp.tan)
+Asin = _make_math("Asin", jnp.arcsin)
+Acos = _make_math("Acos", jnp.arccos)
+Atan = _make_math("Atan", jnp.arctan)
+Sinh = _make_math("Sinh", jnp.sinh)
+Cosh = _make_math("Cosh", jnp.cosh)
+Tanh = _make_math("Tanh", jnp.tanh)
+Asinh = _make_math("Asinh", jnp.arcsinh)
+Acosh = _make_math("Acosh", jnp.arccosh)
+Atanh = _make_math("Atanh", jnp.arctanh)
+Cbrt = _make_math("Cbrt", jnp.cbrt)
+ToDegrees = _make_math("ToDegrees", jnp.degrees)
+ToRadians = _make_math("ToRadians", jnp.radians)
+Rint = _make_math("Rint", jnp.rint)
+
+
+class Signum(UnaryExpression):
+    def dtype(self):
+        return T.FLOAT64
+
+    def op(self, a):
+        return jnp.sign(a.astype(jnp.float64))
+
+
+class Floor(UnaryExpression):
+    def dtype(self):
+        ct = self.children[0].dtype()
+        return ct if ct.is_integral else T.INT64
+
+    def op(self, a):
+        return jnp.floor(a.astype(jnp.float64))
+
+
+class Ceil(UnaryExpression):
+    def dtype(self):
+        ct = self.children[0].dtype()
+        return ct if ct.is_integral else T.INT64
+
+    def op(self, a):
+        return jnp.ceil(a.astype(jnp.float64))
+
+
+class Round(Expression):
+    """round(x, scale) — Spark HALF_UP for non-ANSI."""
+
+    def __init__(self, child: Expression, scale: int = 0):
+        self.children = [child]
+        self.scale = scale
+
+    def with_children(self, children):
+        return Round(children[0], self.scale)
+
+    def dtype(self):
+        return self.children[0].dtype()
+
+    def columnar_eval(self, batch):
+        a, v, t = eval_data_valid(self.children[0], batch)
+        if t.is_integral and self.scale >= 0:
+            return Column(t, a, v)
+        f = a.astype(jnp.float64)
+        mult = 10.0 ** self.scale
+        # HALF_UP: round away from zero on ties
+        scaled = f * mult
+        r = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)
+        out = r / mult
+        return Column(self.dtype(), out.astype(self.dtype().np_dtype), v)
+
+
+class Pow(BinaryArithmetic):
+    symbol = "**"
+
+    def result_type(self, lt, rt):
+        return T.FLOAT64
+
+    def op(self, a, b):
+        return jnp.power(a.astype(jnp.float64), b.astype(jnp.float64))
+
+
+class Atan2(BinaryArithmetic):
+    symbol = "atan2"
+
+    def result_type(self, lt, rt):
+        return T.FLOAT64
+
+    def op(self, a, b):
+        return jnp.arctan2(a.astype(jnp.float64), b.astype(jnp.float64))
+
+
+class Least(Expression):
+    def __init__(self, *children):
+        self.children = list(children)
+
+    def with_children(self, children):
+        return Least(*children)
+
+    def dtype(self):
+        dt = self.children[0].dtype()
+        for c in self.children[1:]:
+            dt = T.common_type(dt, c.dtype())
+        return dt
+
+    def columnar_eval(self, batch):
+        out_t = self.dtype()
+        best = None
+        bestv = None
+        for c in self.children:
+            a, v, _ = eval_data_valid(c, batch)
+            a = a.astype(out_t.np_dtype)
+            if best is None:
+                best, bestv = a, v
+            else:
+                take_new = v & (~bestv | (a < best))
+                best = jnp.where(take_new, a, best)
+                bestv = bestv | v
+        return Column(out_t, best, bestv)
+
+
+class Greatest(Expression):
+    def __init__(self, *children):
+        self.children = list(children)
+
+    def with_children(self, children):
+        return Greatest(*children)
+
+    def dtype(self):
+        dt = self.children[0].dtype()
+        for c in self.children[1:]:
+            dt = T.common_type(dt, c.dtype())
+        return dt
+
+    def columnar_eval(self, batch):
+        out_t = self.dtype()
+        best = None
+        bestv = None
+        for c in self.children:
+            a, v, _ = eval_data_valid(c, batch)
+            a = a.astype(out_t.np_dtype)
+            if best is None:
+                best, bestv = a, v
+            else:
+                take_new = v & (~bestv | (a > best))
+                best = jnp.where(take_new, a, best)
+                bestv = bestv | v
+        return Column(out_t, best, bestv)
+
+
+# Bitwise (reference: bitwise.scala)
+class BitwiseAnd(BinaryArithmetic):
+    symbol = "&"
+
+    def op(self, a, b):
+        return a & b
+
+
+class BitwiseOr(BinaryArithmetic):
+    symbol = "|"
+
+    def op(self, a, b):
+        return a | b
+
+
+class BitwiseXor(BinaryArithmetic):
+    symbol = "^"
+
+    def op(self, a, b):
+        return a ^ b
+
+
+class BitwiseNot(UnaryExpression):
+    def op(self, a):
+        return ~a
+
+
+class ShiftLeft(BinaryArithmetic):
+    symbol = "<<"
+
+    def result_type(self, lt, rt):
+        return lt
+
+    def op(self, a, b):
+        nbits = a.dtype.itemsize * 8
+        return a << (b.astype(a.dtype) % nbits)
+
+
+class ShiftRight(BinaryArithmetic):
+    symbol = ">>"
+
+    def result_type(self, lt, rt):
+        return lt
+
+    def op(self, a, b):
+        nbits = a.dtype.itemsize * 8
+        return a >> (b.astype(a.dtype) % nbits)
+
+
+class ShiftRightUnsigned(BinaryArithmetic):
+    symbol = ">>>"
+
+    def result_type(self, lt, rt):
+        return lt
+
+    def op(self, a, b):
+        nbits = a.dtype.itemsize * 8
+        ua = a.view(jnp.uint64 if a.dtype == jnp.int64 else jnp.uint32)
+        return (ua >> (b.astype(ua.dtype) % nbits)).view(a.dtype)

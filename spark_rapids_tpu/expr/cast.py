@@ -1,0 +1,166 @@
+"""Cast matrix — the GpuCast role.
+
+Reference analogue: GpuCast.scala:166 (1,301 LoC) + per-pair CastChecks
+(TypeChecks.scala:879).  Non-ANSI semantics: numeric narrowing wraps,
+float->int saturates-then-wraps per Spark, invalid string parses -> null.
+ANSI mode (conf spark.rapids.tpu.sql.ansi.enabled) raises on overflow.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.column import Column, StringColumn
+from .core import Expression, eval_data_valid, as_column
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: T.DType, ansi: bool = False):
+        self.children = [child]
+        self.to = to
+        self.ansi = ansi
+
+    def with_children(self, c):
+        return Cast(c[0], self.to, self.ansi)
+
+    def dtype(self):
+        return self.to
+
+    @property
+    def name(self):
+        return f"Cast({self.to.name})"
+
+    def columnar_eval(self, batch):
+        src_t = self.children[0].dtype()
+        to = self.to
+        if src_t == to:
+            return self.children[0].columnar_eval(batch)
+        if src_t == T.STRING:
+            col = as_column(self.children[0].columnar_eval(batch),
+                            batch.capacity, batch.num_rows)
+            return _cast_from_string(col, to, batch.num_rows)
+        a, v, vt = eval_data_valid(self.children[0], batch)
+        if to == T.STRING:
+            return _cast_to_string(a, v, vt, batch.num_rows)
+        return _cast_numeric(a, v, vt, to)
+
+    def __repr__(self):
+        return f"CAST({self.children[0]!r} AS {self.to.name})"
+
+
+def _cast_numeric(a, v, src_t: T.DType, to: T.DType) -> Column:
+    if isinstance(to, T.DecimalType):
+        # value * 10^scale as unscaled int64
+        scaled = jnp.round(a.astype(jnp.float64) * (10.0 ** to.scale))
+        return Column(to, scaled.astype(jnp.int64), v)
+    if isinstance(src_t, T.DecimalType):
+        f = a.astype(jnp.float64) / (10.0 ** src_t.scale)
+        if to.is_fractional:
+            return Column(to, f.astype(to.np_dtype), v)
+        return _cast_numeric(f, v, T.FLOAT64, to)
+    if to == T.BOOL:
+        return Column(T.BOOL, a.astype(bool) if a.dtype != bool else a, v)
+    if src_t == T.BOOL:
+        return Column(to, a.astype(to.np_dtype), v)
+    if to.is_integral and src_t.is_fractional:
+        # Spark float->int: NaN -> null is FALSE; NaN->0? Spark casts NaN to 0
+        # and saturates to type bounds (non-ANSI).
+        info = np.iinfo(to.np_dtype)
+        clipped = jnp.clip(jnp.nan_to_num(a, nan=0.0), float(info.min),
+                           float(info.max))
+        return Column(to, jnp.trunc(clipped).astype(to.np_dtype), v)
+    if to in (T.DATE, T.TIMESTAMP):
+        if src_t == T.TIMESTAMP and to == T.DATE:
+            days = jnp.floor_divide(a, 86_400_000_000)
+            return Column(T.DATE, days.astype(jnp.int32), v)
+        if src_t == T.DATE and to == T.TIMESTAMP:
+            return Column(T.TIMESTAMP,
+                          a.astype(jnp.int64) * 86_400_000_000, v)
+        return Column(to, a.astype(to.np_dtype), v)
+    if src_t in (T.DATE, T.TIMESTAMP) and to.is_numeric:
+        return Column(to, a.astype(to.np_dtype), v)
+    return Column(to, a.astype(to.np_dtype), v)
+
+
+# -- string parse/format (host-assisted v0; device text kernels are a later
+#    milestone — reference gates these with conf flags too, e.g.
+#    spark.rapids.sql.castStringToFloat.enabled) -----------------------------
+
+def _cast_from_string(col: StringColumn, to: T.DType, num_rows: int) -> Column:
+    vals, valid = col.to_numpy(num_rows)
+    out = np.zeros(col.capacity, dtype=to.np_dtype if to.np_dtype else object)
+    ok = np.zeros(col.capacity, dtype=bool)
+    for i in range(num_rows):
+        if not valid[i]:
+            continue
+        s = vals[i].strip()
+        try:
+            if to.is_integral:
+                out[i] = int(s)
+            elif to.is_fractional:
+                out[i] = float(s)
+            elif to == T.BOOL:
+                sl = s.lower()
+                if sl in ("true", "t", "yes", "y", "1"):
+                    out[i] = True
+                elif sl in ("false", "f", "no", "n", "0"):
+                    out[i] = False
+                else:
+                    continue
+            elif to == T.DATE:
+                out[i] = np.datetime64(s, "D").astype(np.int32)
+            elif to == T.TIMESTAMP:
+                out[i] = np.datetime64(s, "us").astype(np.int64)
+            elif isinstance(to, T.DecimalType):
+                out[i] = int(round(float(s) * 10 ** to.scale))
+            else:
+                continue
+            ok[i] = True
+        except (ValueError, OverflowError):
+            continue
+    return Column(to, jnp.asarray(out.astype(to.np_dtype)), jnp.asarray(ok))
+
+
+def _format_float(x: float) -> str:
+    if np.isnan(x):
+        return "NaN"
+    if np.isinf(x):
+        return "Infinity" if x > 0 else "-Infinity"
+    if x == int(x) and abs(x) < 1e16:
+        return f"{x:.1f}"
+    return repr(float(x))
+
+
+def _cast_to_string(a, v, src_t: T.DType, num_rows: int) -> StringColumn:
+    an = np.asarray(a)[:num_rows]
+    vn = np.asarray(v)[:num_rows]
+    out = []
+    for i in range(num_rows):
+        if not vn[i]:
+            out.append(None)
+        elif src_t == T.BOOL:
+            out.append("true" if an[i] else "false")
+        elif src_t.is_integral:
+            out.append(str(int(an[i])))
+        elif src_t.is_fractional:
+            out.append(_format_float(float(an[i])))
+        elif isinstance(src_t, T.DecimalType):
+            unscaled = int(an[i])
+            s = src_t.scale
+            if s == 0:
+                out.append(str(unscaled))
+            else:
+                sign = "-" if unscaled < 0 else ""
+                digits = str(abs(unscaled)).rjust(s + 1, "0")
+                out.append(f"{sign}{digits[:-s]}.{digits[-s:]}")
+        elif src_t == T.DATE:
+            out.append(str(np.datetime64(int(an[i]), "D")))
+        elif src_t == T.TIMESTAMP:
+            ts = np.datetime64(int(an[i]), "us")
+            out.append(str(ts).replace("T", " "))
+        else:
+            out.append(str(an[i]))
+    cap = int(np.asarray(a).shape[0])
+    return StringColumn.from_pylist(out + [None] * (cap - num_rows),
+                                    capacity=cap)

@@ -1,0 +1,260 @@
+"""Expression framework core — the GpuExpression role.
+
+Reference analogue: GpuExpressions.scala (349 LoC): the contract is
+``columnarEval(batch) -> GpuColumnVector | Scalar``.  Here:
+``Expression.columnar_eval(batch) -> Column | Scalar``.
+
+Expressions are bound (name -> column ordinal) before execution, mirroring
+GpuBoundAttribute.scala.  Evaluation is pure: every op maps to jnp array
+ops over (data, validity) pairs, with SQL three-valued-logic nulls.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.column import Column, StringColumn
+from ..columnar.batch import ColumnarBatch
+
+
+@dataclasses.dataclass
+class Scalar:
+    """A host scalar result/literal (reference: cudf Scalar wrapper)."""
+    dtype: T.DType
+    value: Any  # None means null
+
+    @property
+    def is_null(self):
+        return self.value is None
+
+    def to_column(self, capacity: int, num_rows: int) -> Column:
+        return Column.from_scalar(self.value, self.dtype, capacity,
+                                  num_rows=num_rows)
+
+
+def as_column(x, capacity: int, num_rows: int) -> Column:
+    if isinstance(x, Scalar):
+        return x.to_column(capacity, num_rows)
+    return x
+
+
+class Expression:
+    """Base expression node."""
+
+    children: List["Expression"] = []
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def dtype(self) -> T.DType:
+        raise NotImplementedError
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        raise NotImplementedError
+
+    # -- binding ---------------------------------------------------------------
+    def bind(self, schema) -> "Expression":
+        """Replace AttributeReference with BoundReference by schema ordinal."""
+        return self.map_children(lambda c: c.bind(schema))
+
+    def map_children(self, fn) -> "Expression":
+        if not self.children:
+            return self
+        new = [fn(c) for c in self.children]
+        if all(a is b for a, b in zip(new, self.children)):
+            return self
+        return self.with_children(new)
+
+    def with_children(self, children: List["Expression"]) -> "Expression":
+        clone = dataclasses.replace(self) if dataclasses.is_dataclass(self) \
+            else self.__class__.__new__(self.__class__)
+        if not dataclasses.is_dataclass(self):
+            clone.__dict__.update(self.__dict__)
+        clone.children = list(children)
+        return clone
+
+    def collect(self, pred) -> List["Expression"]:
+        out = [self] if pred(self) else []
+        for c in self.children:
+            out.extend(c.collect(pred))
+        return out
+
+    def __repr__(self):
+        if self.children:
+            return f"{self.name}({', '.join(map(repr, self.children))})"
+        return self.name
+
+
+class LeafExpression(Expression):
+    children: List[Expression] = []
+
+
+class AttributeReference(LeafExpression):
+    """Unresolved column reference by name."""
+
+    def __init__(self, col_name: str, dt: Optional[T.DType] = None,
+                 _nullable: bool = True):
+        self.col_name = col_name
+        self._dtype = dt
+        self._nullable = _nullable
+
+    @property
+    def name(self):
+        return self.col_name
+
+    def dtype(self) -> T.DType:
+        if self._dtype is None:
+            raise ValueError(f"unresolved attribute {self.col_name}")
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def resolve(self, schema) -> "AttributeReference":
+        f = schema[self.col_name]
+        return AttributeReference(self.col_name, f.dtype, f.nullable)
+
+    def bind(self, schema) -> "BoundReference":
+        idx = schema.index_of(self.col_name)
+        f = schema[idx]
+        return BoundReference(idx, f.dtype, f.nullable, self.col_name)
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        return batch.column(self.col_name)
+
+    def __repr__(self):
+        return f"col({self.col_name})"
+
+
+class BoundReference(LeafExpression):
+    """Column reference by ordinal (reference: GpuBoundReference)."""
+
+    def __init__(self, ordinal: int, dt: T.DType, nullable: bool = True,
+                 col_name: str = ""):
+        self.ordinal = ordinal
+        self._dtype = dt
+        self._nullable = nullable
+        self.col_name = col_name
+
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def bind(self, schema):
+        return self
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        return batch.columns[self.ordinal]
+
+    def __repr__(self):
+        return f"input[{self.ordinal}:{self.col_name}]"
+
+
+class Literal(LeafExpression):
+    def __init__(self, value, dt: Optional[T.DType] = None):
+        if dt is None:
+            if value is None:
+                dt = T.NULL
+            elif isinstance(value, bool):
+                dt = T.BOOL
+            elif isinstance(value, int):
+                dt = T.INT64
+            elif isinstance(value, float):
+                dt = T.FLOAT64
+            elif isinstance(value, str):
+                dt = T.STRING
+            else:
+                raise ValueError(f"cannot infer literal type for {value!r}")
+        self.value = value
+        self._dtype = dt
+
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self.value is None
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        return Scalar(self._dtype, self.value)
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+def lit(value) -> Expression:
+    return value if isinstance(value, Expression) else Literal(value)
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, alias: str):
+        self.children = [child]
+        self.alias = alias
+
+    @property
+    def name(self):
+        return self.alias
+
+    def dtype(self):
+        return self.children[0].dtype()
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def with_children(self, children):
+        return Alias(children[0], self.alias)
+
+    def columnar_eval(self, batch):
+        return self.children[0].columnar_eval(batch)
+
+    def __repr__(self):
+        return f"{self.children[0]!r} AS {self.alias}"
+
+
+def output_name(e: Expression) -> str:
+    if isinstance(e, Alias):
+        return e.alias
+    if isinstance(e, (AttributeReference, BoundReference)):
+        return e.col_name
+    return repr(e)
+
+
+# ---------------------------------------------------------------------------
+# eval helpers
+# ---------------------------------------------------------------------------
+
+def eval_as_column(expr: Expression, batch: ColumnarBatch) -> Column:
+    return as_column(expr.columnar_eval(batch), batch.capacity, batch.num_rows)
+
+
+def eval_data_valid(expr: Expression, batch: ColumnarBatch):
+    """Evaluate to (data, validity, dtype) arrays; scalars broadcast."""
+    r = expr.columnar_eval(batch)
+    if isinstance(r, Scalar):
+        cap = batch.capacity
+        if r.is_null:
+            dt = r.dtype if r.dtype != T.NULL else T.BOOL
+            return (jnp.zeros(cap, dt.np_dtype if dt.np_dtype else jnp.bool_),
+                    jnp.zeros(cap, bool), r.dtype)
+        if r.dtype == T.STRING:
+            col = r.to_column(cap, batch.num_rows)
+            return col, col.validity, T.STRING
+        data = jnp.full((cap,), r.value, dtype=r.dtype.np_dtype)
+        return data, jnp.ones(cap, bool), r.dtype
+    if isinstance(r, StringColumn):
+        return r, r.validity, T.STRING
+    return r.data, r.validity, r.dtype

@@ -1,0 +1,276 @@
+"""Predicates, comparisons, null tests, three-valued logic.
+
+Reference analogue: predicates.scala, nullExpressions.scala and
+GpuEqualTo/GpuLessThan... registrations in GpuOverrides.scala.
+
+Comparisons on strings and floats route through the canonical key-word
+encoding (kernels/canon.py) so ordering matches sorts/joins exactly.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.column import Column, StringColumn
+from ..kernels import canon
+from .core import Expression, Scalar, eval_data_valid, as_column
+
+
+def _comparable_words(expr: Expression, batch):
+    col = as_column(expr.columnar_eval(batch), batch.capacity, batch.num_rows)
+    words = canon.value_words(col, batch.num_rows)
+    return words, col.validity
+
+
+class BinaryComparison(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def dtype(self):
+        return T.BOOL
+
+    def compare(self, lt, eq):
+        raise NotImplementedError
+
+    def columnar_eval(self, batch):
+        lw, lv = _comparable_words(self.children[0], batch)
+        rw, rv = _comparable_words(self.children[1], batch)
+        # unify word counts (strings of different max widths)
+        n = max(len(lw), len(rw))
+        lw = lw + [jnp.zeros_like(lw[0])] * (n - len(lw))
+        rw = rw + [jnp.zeros_like(rw[0])] * (n - len(rw))
+        # string keys append the length word last; keep padding before it
+        idx = jnp.arange(lw[0].shape[0])
+        lt = canon.words_less(lw, idx, rw, idx)
+        gt = canon.words_less(rw, idx, lw, idx)
+        eq = ~lt & ~gt
+        return Column(T.BOOL, self.compare(lt, eq), lv & rv)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} {self.symbol} {self.children[1]!r})"
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+
+    def compare(self, lt, eq):
+        return eq
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+
+    def compare(self, lt, eq):
+        return lt
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+
+    def compare(self, lt, eq):
+        return lt | eq
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+
+    def compare(self, lt, eq):
+        return ~lt & ~eq
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+
+    def compare(self, lt, eq):
+        return ~lt
+
+
+class EqualNullSafe(BinaryComparison):
+    """<=>: null <=> null is true; never returns null."""
+    symbol = "<=>"
+
+    def columnar_eval(self, batch):
+        lw, lv = _comparable_words(self.children[0], batch)
+        rw, rv = _comparable_words(self.children[1], batch)
+        n = max(len(lw), len(rw))
+        lw = lw + [jnp.zeros_like(lw[0])] * (n - len(lw))
+        rw = rw + [jnp.zeros_like(rw[0])] * (n - len(rw))
+        idx = jnp.arange(lw[0].shape[0])
+        lt = canon.words_less(lw, idx, rw, idx)
+        gt = canon.words_less(rw, idx, lw, idx)
+        eq = ~lt & ~gt
+        both_null = ~lv & ~rv
+        result = jnp.where(both_null, True, eq & lv & rv)
+        return Column(T.BOOL, result, jnp.ones_like(result))
+
+
+class Not(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    def with_children(self, children):
+        return Not(children[0])
+
+    def dtype(self):
+        return T.BOOL
+
+    def columnar_eval(self, batch):
+        a, v, _ = eval_data_valid(self.children[0], batch)
+        return Column(T.BOOL, ~a.astype(bool), v)
+
+    def __repr__(self):
+        return f"NOT {self.children[0]!r}"
+
+
+class And(Expression):
+    """3-valued AND: false & null = false."""
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def with_children(self, children):
+        return And(children[0], children[1])
+
+    def dtype(self):
+        return T.BOOL
+
+    def columnar_eval(self, batch):
+        la, lv, _ = eval_data_valid(self.children[0], batch)
+        ra, rv, _ = eval_data_valid(self.children[1], batch)
+        la = la.astype(bool)
+        ra = ra.astype(bool)
+        result = la & ra
+        # null unless: both valid, or one side is a valid False
+        valid = (lv & rv) | (lv & ~la) | (rv & ~ra)
+        return Column(T.BOOL, result & valid, valid)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} AND {self.children[1]!r})"
+
+
+class Or(Expression):
+    """3-valued OR: true | null = true."""
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def with_children(self, children):
+        return Or(children[0], children[1])
+
+    def dtype(self):
+        return T.BOOL
+
+    def columnar_eval(self, batch):
+        la, lv, _ = eval_data_valid(self.children[0], batch)
+        ra, rv, _ = eval_data_valid(self.children[1], batch)
+        la = la.astype(bool) & lv
+        ra = ra.astype(bool) & rv
+        result = la | ra
+        valid = (lv & rv) | la | ra
+        return Column(T.BOOL, result, valid)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} OR {self.children[1]!r})"
+
+
+class IsNull(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    def with_children(self, children):
+        return IsNull(children[0])
+
+    def dtype(self):
+        return T.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        _, v, _ = eval_data_valid(self.children[0], batch)
+        in_range = jnp.arange(batch.capacity) < batch.num_rows
+        return Column(T.BOOL, ~v & in_range, jnp.ones_like(v))
+
+
+class IsNotNull(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    def with_children(self, children):
+        return IsNotNull(children[0])
+
+    def dtype(self):
+        return T.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        _, v, _ = eval_data_valid(self.children[0], batch)
+        return Column(T.BOOL, v, jnp.ones_like(v))
+
+
+class IsNaN(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    def with_children(self, children):
+        return IsNaN(children[0])
+
+    def dtype(self):
+        return T.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        a, v, t = eval_data_valid(self.children[0], batch)
+        isnan = jnp.isnan(a) if t.is_fractional else jnp.zeros_like(v)
+        return Column(T.BOOL, isnan & v, jnp.ones_like(v))
+
+
+class In(Expression):
+    """IN over a literal list (reference: GpuInSet)."""
+
+    def __init__(self, child: Expression, values: List):
+        self.children = [child]
+        self.values = values
+
+    def with_children(self, children):
+        return In(children[0], self.values)
+
+    def dtype(self):
+        return T.BOOL
+
+    def columnar_eval(self, batch):
+        from .core import Literal
+        child = self.children[0]
+        acc_data = None
+        acc_valid = None
+        has_null_item = any(v is None for v in self.values)
+        for v in self.values:
+            if v is None:
+                continue
+            eq = EqualTo(child, Literal(v, child.dtype()))
+            a, va, _ = eval_data_valid(eq, batch)
+            a = a.astype(bool) & va
+            acc_data = a if acc_data is None else (acc_data | a)
+            acc_valid = va if acc_valid is None else (acc_valid | va)
+        if acc_data is None:
+            acc_data = jnp.zeros(batch.capacity, bool)
+            acc_valid = jnp.ones(batch.capacity, bool)
+        _, cv, _ = eval_data_valid(child, batch)
+        # SQL: x IN (..null..) is null when no match; match wins
+        valid = jnp.where(acc_data, True,
+                          cv & (not has_null_item))
+        return Column(T.BOOL, acc_data, valid)

@@ -1,0 +1,120 @@
+"""Group-by aggregation kernels — the device core of GpuHashAggregateExec
+
+(reference: aggregate.scala:240).
+
+TPU-first: instead of cuDF's open-addressing hash groupby, we sort by
+canonical key words and run segmented reductions (``jax.ops.segment_*``) —
+sort + segment-scan lowers to XLA's native sort and scatter-add, which tile
+onto the VPU far better than data-dependent hash probing (SURVEY.md §7
+"hard parts").  One compiled kernel per (schema, capacity) bucket.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from . import canon
+from .sort import sorted_words
+from .basic import compact_indices
+
+
+@dataclasses.dataclass
+class GroupPlan:
+    perm: jnp.ndarray          # sort permutation over the input rows
+    seg_id: jnp.ndarray        # segment id per sorted row (live rows: 0..G-1)
+    live_sorted: jnp.ndarray   # sorted-row liveness mask (in-range rows)
+    rep_indices: jnp.ndarray   # original row index of each group representative
+    num_groups: jnp.ndarray    # scalar int
+
+
+def groupby_plan(words: List[jnp.ndarray]) -> GroupPlan:
+    """Build the sort+segment plan for a set of canonical key words.
+
+    ``words`` must come from canon.batch_key_words (first word of each key is
+    the null/range rank; rank 2 == past-num_rows padding).
+    """
+    sorted_ws, perm = sorted_words(words)
+    live = sorted_ws[0] != jnp.uint64(2)
+    boundary = canon.words_equal_adjacent(sorted_ws) & live
+    seg_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg_id = jnp.maximum(seg_id, 0)
+    num_groups = jnp.sum(boundary)
+    rep_order, _ = compact_indices(boundary, boundary.shape[0])
+    rep_indices = jnp.take(perm, rep_order)
+    return GroupPlan(perm, seg_id, live, rep_indices, num_groups)
+
+
+def _sorted_vals(plan: GroupPlan, values, validity):
+    v = jnp.take(values, plan.perm)
+    ok = jnp.take(validity, plan.perm) & plan.live_sorted
+    return v, ok
+
+
+def seg_sum(plan: GroupPlan, values, validity, out_dtype=None):
+    cap = values.shape[0]
+    v, ok = _sorted_vals(plan, values, validity)
+    acc = v.astype(out_dtype or v.dtype)
+    contrib = jnp.where(ok, acc, jnp.zeros_like(acc))
+    return jax.ops.segment_sum(contrib, plan.seg_id, num_segments=cap)
+
+
+def seg_count(plan: GroupPlan, validity):
+    cap = validity.shape[0]
+    _, ok = _sorted_vals(plan, validity, validity)
+    return jax.ops.segment_sum(ok.astype(jnp.int64), plan.seg_id,
+                               num_segments=cap)
+
+
+def seg_count_all(plan: GroupPlan):
+    cap = plan.seg_id.shape[0]
+    return jax.ops.segment_sum(plan.live_sorted.astype(jnp.int64), plan.seg_id,
+                               num_segments=cap)
+
+
+def _type_extreme(dtype, want_max: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if not want_max else -jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if not want_max else info.min, dtype)
+
+
+def seg_min(plan: GroupPlan, values, validity):
+    cap = values.shape[0]
+    v, ok = _sorted_vals(plan, values, validity)
+    ident = _type_extreme(v.dtype, want_max=False)
+    contrib = jnp.where(ok, v, ident)
+    return jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
+
+
+def seg_max(plan: GroupPlan, values, validity):
+    cap = values.shape[0]
+    v, ok = _sorted_vals(plan, values, validity)
+    ident = _type_extreme(v.dtype, want_max=True)
+    contrib = jnp.where(ok, v, ident)
+    return jax.ops.segment_max(contrib, plan.seg_id, num_segments=cap)
+
+
+def seg_first_index(plan: GroupPlan, validity, ignore_nulls: bool = True):
+    """Original-row index of the first (valid) row per group."""
+    cap = validity.shape[0]
+    ok = jnp.take(validity, plan.perm) & plan.live_sorted if ignore_nulls \
+        else plan.live_sorted
+    pos = jnp.arange(cap, dtype=jnp.int64)
+    contrib = jnp.where(ok, pos, jnp.int64(cap))
+    first_pos = jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
+    safe = jnp.clip(first_pos, 0, cap - 1).astype(jnp.int32)
+    return jnp.take(plan.perm, safe), first_pos < cap
+
+
+def seg_last_index(plan: GroupPlan, validity, ignore_nulls: bool = True):
+    cap = validity.shape[0]
+    ok = jnp.take(validity, plan.perm) & plan.live_sorted if ignore_nulls \
+        else plan.live_sorted
+    pos = jnp.arange(cap, dtype=jnp.int64)
+    contrib = jnp.where(ok, pos, jnp.int64(-1))
+    last_pos = jax.ops.segment_max(contrib, plan.seg_id, num_segments=cap)
+    safe = jnp.clip(last_pos, 0, cap - 1).astype(jnp.int32)
+    return jnp.take(plan.perm, safe), last_pos >= 0

@@ -1,0 +1,133 @@
+"""Canonical sortable key-word encoding.
+
+Every orderable SQL value is mapped to one or more **uint64 words** whose
+unsigned lexicographic order equals the SQL ordering of the values.  Sorts,
+group-bys and joins all operate on these words, so there is exactly one
+comparison code path on the device and it is pure integer VPU work — the
+shape XLA tiles best (SURVEY.md §7 "hard parts": sort-based designs map
+better to XLA than open-addressing hash tables).
+
+Encodings:
+- signed ints  -> x XOR 0x8000...  (order-preserving bias to unsigned)
+- floats       -> IEEE-754 trick: if sign bit set, flip all bits, else set
+                  sign bit.  NaNs are canonicalized first (Spark treats all
+                  NaNs equal and greater than any other value; -0.0 == 0.0 —
+                  reference: NormalizeFloatingNumbers.scala).
+- bool/date/timestamp/decimal -> via their integer representation
+- strings      -> big-endian uint64 words of the UTF-8 bytes, zero padded,
+                  plus a final length word as tie-break (exact byte-wise
+                  order == code-point order for UTF-8)
+- null handling: a leading null-rank word per key (0/1/2) encodes
+  nulls-first/last and pushes rows past num_rows to the very end.
+- descending   -> bitwise NOT of every word (reverses unsigned order).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.column import Column, StringColumn
+
+SIGN64 = jnp.uint64(0x8000000000000000)
+
+
+def _ints_to_words(data, nbits: int):
+    x = data.astype(jnp.int64)
+    return (x.view(jnp.uint64) if nbits == 64
+            else x.astype(jnp.uint64)) ^ SIGN64
+
+
+def _float_to_words(data):
+    f64 = data.astype(jnp.float64)
+    # canonicalize: all NaNs -> +NaN quiet; -0.0 -> 0.0
+    f64 = jnp.where(jnp.isnan(f64), jnp.float64(jnp.nan), f64)
+    f64 = jnp.where(f64 == 0.0, jnp.float64(0.0), f64)
+    bits = f64.view(jnp.uint64)
+    sign = (bits & SIGN64) != 0
+    flipped = jnp.where(sign, ~bits, bits | SIGN64)
+    # place +NaN above +inf (flipping already does since NaN mantissa != 0)
+    return flipped
+
+
+def column_key_words(col: Column, num_rows: int, *, descending: bool = False,
+                     nulls_last: bool = False) -> List[jnp.ndarray]:
+    """Return the list of uint64 word arrays encoding this column as a key.
+
+    The first word is the null/range rank; the rest are value words.
+    """
+    cap = col.capacity
+    in_range = jnp.arange(cap) < num_rows
+    valid = col.validity & in_range
+    if nulls_last:
+        null_rank = jnp.where(valid, jnp.uint64(0), jnp.uint64(1))
+    else:
+        null_rank = jnp.where(valid, jnp.uint64(1), jnp.uint64(0))
+    # rows past num_rows always sort to the absolute end
+    null_rank = jnp.where(in_range, null_rank, jnp.uint64(2))
+
+    words = value_words(col, num_rows)
+    if descending:
+        words = [~w for w in words]
+        # null rank is NOT inverted: padding must stay at the end and spark's
+        # desc default is nulls_last which the caller passes explicitly.
+    # zero out words of invalid rows for determinism
+    words = [jnp.where(valid, w, jnp.uint64(0)) for w in words]
+    return [null_rank] + words
+
+
+def value_words(col: Column, num_rows: int) -> List[jnp.ndarray]:
+    """uint64 word list for the column values (no null rank)."""
+    dt = col.dtype
+    if isinstance(col, StringColumn):
+        from . import strings as skern
+        return skern.string_key_words(col, num_rows)
+    if dt == T.BOOL:
+        return [col.data.astype(jnp.uint64)]
+    if dt.is_integral or isinstance(dt, T.DecimalType) or dt in (T.DATE,
+                                                                 T.TIMESTAMP):
+        return [_ints_to_words(col.data, 64)]
+    if dt.is_fractional:
+        return [_float_to_words(col.data)]
+    if dt == T.NULL:
+        return [jnp.zeros(col.capacity, jnp.uint64)]
+    raise NotImplementedError(f"key encoding for {dt}")
+
+
+def batch_key_words(cols: List[Column], num_rows: int,
+                    descending: List[bool] = None,
+                    nulls_last: List[bool] = None) -> List[jnp.ndarray]:
+    descending = descending or [False] * len(cols)
+    nulls_last = nulls_last or [False] * len(cols)
+    out: List[jnp.ndarray] = []
+    for c, d, nl in zip(cols, descending, nulls_last):
+        out.extend(column_key_words(c, num_rows, descending=d, nulls_last=nl))
+    if not out:
+        # zero keys: single constant word (everything equal)
+        cap = cols[0].capacity if cols else 16
+        out = [jnp.zeros(cap, jnp.uint64)]
+    return out
+
+
+def words_equal_adjacent(words: List[jnp.ndarray]) -> jnp.ndarray:
+    """For sorted word arrays: mask[i] = row i differs from row i-1 (i>0)."""
+    diff = jnp.zeros(words[0].shape[0], dtype=bool)
+    for w in words:
+        prev = jnp.concatenate([w[:1], w[:-1]])
+        diff = diff | (w != prev)
+    return diff.at[0].set(True)
+
+
+def words_less(words_a: List[jnp.ndarray], idx_a, words_b: List[jnp.ndarray],
+               idx_b) -> jnp.ndarray:
+    """Vectorized lexicographic a[idx_a] < b[idx_b] over word lists."""
+    lt = jnp.zeros(jnp.broadcast_shapes(jnp.shape(idx_a), jnp.shape(idx_b)),
+                   dtype=bool)
+    eq = jnp.ones_like(lt)
+    for wa, wb in zip(words_a, words_b):
+        a = wa[idx_a]
+        b = wb[idx_b]
+        lt = lt | (eq & (a < b))
+        eq = eq & (a == b)
+    return lt

@@ -1,0 +1,32 @@
+"""Sort kernels — the device core of GpuSortExec (reference:
+
+GpuSortExec.scala:56, SortUtils.scala).
+
+TPU-first: a single multi-operand ``lax.sort`` over canonical uint64 key
+words (kernels/canon.py) + a trailing iota operand that yields the
+permutation.  One code path for every dtype, stable, fully on-device.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sort_permutation(words: List[jnp.ndarray]) -> jnp.ndarray:
+    """Stable ascending sort over word tuples; returns permutation indices."""
+    cap = words[0].shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    *_, perm = lax.sort(tuple(words) + (iota,), num_keys=len(words),
+                        is_stable=True)
+    return perm
+
+
+def sorted_words(words: List[jnp.ndarray]):
+    """Sort and also return the sorted word arrays (for boundary detection)."""
+    cap = words[0].shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    out = lax.sort(tuple(words) + (iota,), num_keys=len(words), is_stable=True)
+    return list(out[:-1]), out[-1]

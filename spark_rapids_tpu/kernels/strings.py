@@ -1,0 +1,211 @@
+"""String kernels over Arrow offsets+bytes device layout.
+
+Reference analogue: cuDF string kernels used by stringFunctions.scala.
+TPU-first: strings have no native XLA type, so every op here is integer
+arithmetic over the offsets/bytes buffers — gathers, searchsorted-style
+binary searches, and byte-table lookups — all static-shape.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import StringColumn, bucket_capacity
+
+
+def string_lengths(offsets) -> jnp.ndarray:
+    return (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("num_words",))
+def _pack_words(offsets, data, num_words: int):
+    """[cap, num_words] big-endian uint64 words of each string, zero-padded."""
+    cap = offsets.shape[0] - 1
+    starts = offsets[:-1]
+    lens = offsets[1:] - starts
+    # byte index matrix [cap, num_words*8]
+    k = jnp.arange(num_words * 8, dtype=jnp.int32)
+    idx = starts[:, None] + k[None, :]
+    inb = k[None, :] < lens[:, None]
+    byts = jnp.where(inb, jnp.take(data, jnp.clip(idx, 0, data.shape[0] - 1)),
+                     jnp.uint8(0)).astype(jnp.uint64)
+    w = byts.reshape(cap, num_words, 8)
+    shifts = jnp.uint64(8) * (jnp.uint64(7) - jnp.arange(8, dtype=jnp.uint64))
+    words = jnp.sum(w << shifts[None, None, :], axis=-1, dtype=jnp.uint64)
+    return words
+
+
+def string_key_words(col: StringColumn, num_rows: int) -> List[jnp.ndarray]:
+    """uint64 key words for sort/group/join: byte words + length tiebreak."""
+    # max length is host-known from offsets (one small sync per batch; the
+    # reference similarly reads cuDF column metadata host-side).
+    lens = np.asarray(col.offsets[1:]) - np.asarray(col.offsets[:-1])
+    max_len = int(lens[:num_rows].max()) if num_rows else 0
+    num_words = max(1, -(-max_len // 8))
+    # bucket to limit compile cache
+    num_words = 1 << (num_words - 1).bit_length()
+    words = _pack_words(col.offsets, col.data, num_words)
+    out = [words[:, i] for i in range(num_words)]
+    out.append(string_lengths(col.offsets).astype(jnp.uint64))
+    return out
+
+
+@jax.jit
+def _gather_offsets(offsets, validity, indices):
+    starts = offsets[:-1]
+    lens = offsets[1:] - starts
+    ncap = indices.shape[0]
+    src = jnp.clip(indices, 0, starts.shape[0] - 1)
+    glens = jnp.take(lens, src)
+    gvalid = jnp.take(validity, src)
+    glens = jnp.where(gvalid, glens, 0)
+    new_offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(glens).astype(jnp.int32)])
+    total = new_offsets[-1]
+    return new_offsets, gvalid, jnp.take(starts, src), total
+
+
+@functools.partial(jax.jit, static_argnames=("out_bytes",))
+def _materialize_bytes(data, new_offsets, src_starts, out_bytes: int):
+    j = jnp.arange(out_bytes, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offsets[1:], j, side="right").astype(jnp.int32)
+    row = jnp.clip(row, 0, new_offsets.shape[0] - 2)
+    within = j - new_offsets[row]
+    src_idx = jnp.take(src_starts, row) + within
+    live = j < new_offsets[-1]
+    return jnp.where(live,
+                     jnp.take(data, jnp.clip(src_idx, 0, data.shape[0] - 1)),
+                     jnp.uint8(0))
+
+
+def gather_strings(offsets, data, validity, indices):
+    """Row gather for string columns (two-phase: size on host, then fill)."""
+    new_offsets, gvalid, src_starts, total = _gather_offsets(
+        offsets, validity, indices)
+    out_bytes = bucket_capacity(max(1, int(total)))
+    buf = _materialize_bytes(data, new_offsets, src_starts, out_bytes)
+    return new_offsets, buf, gvalid
+
+
+# ---------------------------------------------------------------------------
+# value kernels
+# ---------------------------------------------------------------------------
+
+_UPPER_TBL = np.arange(256, dtype=np.uint8)
+_UPPER_TBL[ord("a"): ord("z") + 1] -= 32
+_LOWER_TBL = np.arange(256, dtype=np.uint8)
+_LOWER_TBL[ord("A"): ord("Z") + 1] += 32
+
+
+@jax.jit
+def upper_bytes(data):
+    return jnp.take(jnp.asarray(_UPPER_TBL), data.astype(jnp.int32))
+
+
+@jax.jit
+def lower_bytes(data):
+    return jnp.take(jnp.asarray(_LOWER_TBL), data.astype(jnp.int32))
+
+
+def upper(col: StringColumn) -> StringColumn:
+    return StringColumn(col.offsets, upper_bytes(col.data), col.validity)
+
+
+def lower(col: StringColumn) -> StringColumn:
+    return StringColumn(col.offsets, lower_bytes(col.data), col.validity)
+
+
+@jax.jit
+def _substring_offsets(offsets, start, length):
+    """Spark substring semantics: 1-based start, negative counts from end."""
+    starts = offsets[:-1]
+    lens = offsets[1:] - starts
+    s = jnp.where(start > 0, start - 1,
+                  jnp.where(start < 0, jnp.maximum(lens + start, 0), 0))
+    s = jnp.minimum(s, lens)
+    l = jnp.clip(length, 0, lens - s)
+    return (starts + s).astype(jnp.int32), l.astype(jnp.int32)
+
+
+def substring(col: StringColumn, start: int, length: int) -> StringColumn:
+    cap = col.capacity
+    start_a = jnp.full((cap,), start, jnp.int32)
+    len_a = jnp.full((cap,), length if length is not None else 2**31 - 1,
+                     jnp.int32)
+    src_starts, new_lens = _substring_offsets(col.offsets, start_a, len_a)
+    new_lens = jnp.where(col.validity, new_lens, 0)
+    new_offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(new_lens).astype(jnp.int32)])
+    total = int(new_offsets[-1])
+    out_bytes = bucket_capacity(max(1, total))
+    buf = _materialize_bytes(col.data, new_offsets, src_starts, out_bytes)
+    return StringColumn(new_offsets, buf, col.validity)
+
+
+def char_length(col: StringColumn) -> jnp.ndarray:
+    """UTF-8 code point count (Spark length()): count non-continuation bytes."""
+    is_cont = (col.data & jnp.uint8(0xC0)) == jnp.uint8(0x80)
+    cont_cum = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(is_cont.astype(jnp.int32))])
+    ends = jnp.clip(col.offsets[1:], 0, cont_cum.shape[0] - 1)
+    begs = jnp.clip(col.offsets[:-1], 0, cont_cum.shape[0] - 1)
+    byte_len = col.offsets[1:] - col.offsets[:-1]
+    cont = jnp.take(cont_cum, ends) - jnp.take(cont_cum, begs)
+    return (byte_len - cont).astype(jnp.int32)
+
+
+def byte_length(col: StringColumn) -> jnp.ndarray:
+    return (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+
+
+def starts_with(col: StringColumn, prefix: bytes) -> jnp.ndarray:
+    pat = np.frombuffer(prefix, np.uint8)
+    cap = col.capacity
+    if pat.size == 0:
+        return jnp.ones(cap, bool)
+    starts = col.offsets[:-1]
+    lens = col.offsets[1:] - starts
+    k = jnp.arange(pat.size, dtype=jnp.int32)
+    idx = jnp.clip(starts[:, None] + k[None, :], 0, col.data.shape[0] - 1)
+    byts = jnp.take(col.data, idx)
+    eq = jnp.all(byts == jnp.asarray(pat)[None, :], axis=1)
+    return eq & (lens >= pat.size)
+
+
+def ends_with(col: StringColumn, suffix: bytes) -> jnp.ndarray:
+    pat = np.frombuffer(suffix, np.uint8)
+    cap = col.capacity
+    if pat.size == 0:
+        return jnp.ones(cap, bool)
+    lens = col.offsets[1:] - col.offsets[:-1]
+    starts = col.offsets[1:] - pat.size
+    k = jnp.arange(pat.size, dtype=jnp.int32)
+    idx = jnp.clip(starts[:, None] + k[None, :], 0, col.data.shape[0] - 1)
+    byts = jnp.take(col.data, idx)
+    eq = jnp.all(byts == jnp.asarray(pat)[None, :], axis=1)
+    return eq & (lens >= pat.size)
+
+
+def contains(col: StringColumn, needle: bytes) -> jnp.ndarray:
+    """Substring containment via sliding window compare on the byte buffer."""
+    pat = np.frombuffer(needle, np.uint8)
+    if pat.size == 0:
+        return jnp.ones(col.capacity, bool)
+    data = col.data
+    B = data.shape[0]
+    k = jnp.arange(pat.size, dtype=jnp.int32)
+    idx = jnp.clip(jnp.arange(B, dtype=jnp.int32)[:, None] + k[None, :], 0,
+                   B - 1)
+    win_eq = jnp.all(jnp.take(data, idx) == jnp.asarray(pat)[None, :], axis=1)
+    # match position p counts for row i if starts[i] <= p <= ends[i]-len(pat)
+    hit_cum = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(win_eq.astype(jnp.int32))])
+    starts = col.offsets[:-1]
+    ends = jnp.maximum(col.offsets[1:] - pat.size + 1, starts)
+    a = jnp.take(hit_cum, jnp.clip(starts, 0, B))
+    b = jnp.take(hit_cum, jnp.clip(ends, 0, B))
+    return (b - a) > 0

@@ -1,0 +1,29 @@
+"""Test harness config: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): unit tests run
+against a local, clusterless backend; distributed logic is tested on
+virtual devices (their Mockito-mock-transport pattern) rather than real
+hardware.
+"""
+import os
+
+# The image's sitecustomize registers the axon TPU backend and forces
+# JAX_PLATFORMS=axon in every interpreter, so the env var alone is not
+# enough — override through the config API after import, before any
+# backend is initialized.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,77 @@
+"""Columnar substrate round-trip tests (SURVEY.md §7 build stage 1 oracle)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import (ColumnarBatch, Column, Schema, Field,
+                                       concat_batches, dtypes as T)
+
+
+def test_int_column_roundtrip():
+    col = Column.from_numpy([1, 2, None, 4], dtype=T.INT64)
+    assert col.capacity == 16
+    assert col.to_pylist(4) == [1, 2, None, 4]
+
+
+def test_float_column_roundtrip():
+    col = Column.from_numpy(np.array([1.5, -2.5, 3.25]))
+    assert col.dtype == T.FLOAT64
+    assert col.to_pylist(3) == [1.5, -2.5, 3.25]
+
+
+def test_string_column_roundtrip():
+    vals = ["hello", None, "", "wörld", "a" * 40]
+    col = Column.from_numpy(vals, dtype=T.STRING)
+    assert col.to_pylist(5) == vals
+
+
+def test_bucket_capacity_powers_of_two():
+    from spark_rapids_tpu.columnar import bucket_capacity
+    assert bucket_capacity(0) == 16
+    assert bucket_capacity(16) == 16
+    assert bucket_capacity(17) == 32
+    assert bucket_capacity(1000) == 1024
+
+
+def test_batch_from_pydict_roundtrip():
+    b = ColumnarBatch.from_pydict({
+        "a": [1, 2, 3], "b": [1.0, None, 3.0], "s": ["x", "y", None]})
+    assert b.num_rows == 3
+    assert b.to_pydict() == {
+        "a": [1, 2, 3], "b": [1.0, None, 3.0], "s": ["x", "y", None]}
+
+
+def test_batch_select_and_with_column():
+    b = ColumnarBatch.from_pydict({"a": [1, 2], "b": [3, 4]})
+    s = b.select(["b"])
+    assert s.to_pydict() == {"b": [3, 4]}
+    c = Column.from_numpy([9, 9], capacity=b.capacity)
+    b2 = b.with_column("c", c)
+    assert b2.to_pydict()["c"] == [9, 9]
+
+
+def test_concat_batches_mixed():
+    b1 = ColumnarBatch.from_pydict({"a": [1, None], "s": ["p", "q"]})
+    b2 = ColumnarBatch.from_pydict({"a": [3], "s": [None]}, schema=b1.schema)
+    out = concat_batches([b1, b2])
+    assert out.num_rows == 3
+    assert out.to_pydict() == {"a": [1, None, 3], "s": ["p", "q", None]}
+
+
+def test_gather_strings():
+    import jax.numpy as jnp
+    col = Column.from_numpy(["aa", "b", None, "cccc"], dtype=T.STRING)
+    g = col.gather(jnp.array([3, 0, 0, 1]))
+    assert g.to_pylist(4) == ["cccc", "aa", "aa", "b"]
+
+
+def test_slice():
+    b = ColumnarBatch.from_pydict({"a": list(range(10))})
+    s = b.slice(3, 4)
+    assert s.to_pydict() == {"a": [3, 4, 5, 6]}
+
+
+def test_decimal_dtype():
+    d = T.DecimalType(12, 2)
+    assert d.name == "decimal(12,2)"
+    with pytest.raises(ValueError):
+        T.DecimalType(25, 2)
