@@ -52,7 +52,8 @@ def _float_to_words(data):
 
 
 def column_key_words(col: Column, num_rows: int, *, descending: bool = False,
-                     nulls_last: bool = False) -> List[jnp.ndarray]:
+                     nulls_last: bool = False,
+                     str_words: int = None) -> List[jnp.ndarray]:
     """Return the list of uint64 word arrays encoding this column as a key.
 
     The first word is the null/range rank; the rest are value words.
@@ -67,7 +68,7 @@ def column_key_words(col: Column, num_rows: int, *, descending: bool = False,
     # rows past num_rows always sort to the absolute end
     null_rank = jnp.where(in_range, null_rank, jnp.uint64(2))
 
-    words = value_words(col, num_rows)
+    words = value_words(col, num_rows, str_words=str_words)
     if descending:
         words = [~w for w in words]
         # null rank is NOT inverted: padding must stay at the end and spark's
@@ -77,12 +78,13 @@ def column_key_words(col: Column, num_rows: int, *, descending: bool = False,
     return [null_rank] + words
 
 
-def value_words(col: Column, num_rows: int) -> List[jnp.ndarray]:
+def value_words(col: Column, num_rows: int,
+                str_words: int = None) -> List[jnp.ndarray]:
     """uint64 word list for the column values (no null rank)."""
     dt = col.dtype
     if isinstance(col, StringColumn):
         from . import strings as skern
-        return skern.string_key_words(col, num_rows)
+        return skern.string_key_words(col, num_rows, num_words=str_words)
     if dt == T.BOOL:
         return [col.data.astype(jnp.uint64)]
     if dt.is_integral or isinstance(dt, T.DecimalType) or dt in (T.DATE,
@@ -97,12 +99,15 @@ def value_words(col: Column, num_rows: int) -> List[jnp.ndarray]:
 
 def batch_key_words(cols: List[Column], num_rows: int,
                     descending: List[bool] = None,
-                    nulls_last: List[bool] = None) -> List[jnp.ndarray]:
+                    nulls_last: List[bool] = None,
+                    str_words: List[int] = None) -> List[jnp.ndarray]:
     descending = descending or [False] * len(cols)
     nulls_last = nulls_last or [False] * len(cols)
+    str_words = str_words or [None] * len(cols)
     out: List[jnp.ndarray] = []
-    for c, d, nl in zip(cols, descending, nulls_last):
-        out.extend(column_key_words(c, num_rows, descending=d, nulls_last=nl))
+    for c, d, nl, sw in zip(cols, descending, nulls_last, str_words):
+        out.extend(column_key_words(c, num_rows, descending=d, nulls_last=nl,
+                                    str_words=sw))
     if not out:
         # zero keys: single constant word (everything equal)
         cap = cols[0].capacity if cols else 16

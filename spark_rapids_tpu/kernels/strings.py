@@ -39,15 +39,25 @@ def _pack_words(offsets, data, num_words: int):
     return words
 
 
-def string_key_words(col: StringColumn, num_rows: int) -> List[jnp.ndarray]:
-    """uint64 key words for sort/group/join: byte words + length tiebreak."""
-    # max length is host-known from offsets (one small sync per batch; the
-    # reference similarly reads cuDF column metadata host-side).
+def needed_key_words(col: StringColumn, num_rows: int) -> int:
+    """Bucketed uint64 word count needed to encode this column's strings."""
     lens = np.asarray(col.offsets[1:]) - np.asarray(col.offsets[:-1])
     max_len = int(lens[:num_rows].max()) if num_rows else 0
     num_words = max(1, -(-max_len // 8))
-    # bucket to limit compile cache
-    num_words = 1 << (num_words - 1).bit_length()
+    return 1 << (num_words - 1).bit_length()
+
+
+def string_key_words(col: StringColumn, num_rows: int,
+                     num_words: int = None) -> List[jnp.ndarray]:
+    """uint64 key words for sort/group/join: byte words + length tiebreak.
+
+    ``num_words`` must be agreed across batches that will be compared
+    against each other (joins unify via needed_key_words over both sides).
+    """
+    if num_words is None:
+        # max length is host-known from offsets (one small sync per batch;
+        # the reference similarly reads cuDF column metadata host-side).
+        num_words = needed_key_words(col, num_rows)
     words = _pack_words(col.offsets, col.data, num_words)
     out = [words[:, i] for i in range(num_words)]
     out.append(string_lengths(col.offsets).astype(jnp.uint64))

@@ -103,7 +103,7 @@ class TestJoin:
         pw = canon.batch_key_words([pk], 4)
         bt = join.build(bw)
         jc = join.probe_counts(bt, pw, 4)
-        counts = np.asarray(jc.counts)
+        counts = np.asarray(jc.counts)[:4]
         assert list(counts) == [2, 0, 1, 2]
         total = join.total_matches(jc.counts)
         assert total == 5
@@ -118,7 +118,7 @@ class TestJoin:
         pk = _col([None, 1], dtype=T.INT64)
         bt = join.build(canon.batch_key_words([bk], 2))
         jc = join.probe_counts(bt, canon.batch_key_words([pk], 2), 2)
-        assert list(np.asarray(jc.counts)) == [0, 1]
+        assert list(np.asarray(jc.counts)[:2]) == [0, 1]
 
     def test_null_safe_join(self):
         bk = _col([1, None], dtype=T.INT64)
@@ -126,7 +126,7 @@ class TestJoin:
         bt = join.build(canon.batch_key_words([bk], 2))
         jc = join.probe_counts(bt, canon.batch_key_words([pk], 2), 2,
                                null_equals_null=True)
-        assert list(np.asarray(jc.counts)) == [1, 1]
+        assert list(np.asarray(jc.counts)[:2]) == [1, 1]
 
     def test_string_join(self):
         bk = _col(["x", "yy", "zzz"], dtype=T.STRING)
@@ -138,7 +138,7 @@ class TestJoin:
         assert len(bw) == len(pw)
         bt = join.build(bw)
         jc = join.probe_counts(bt, pw, 3)
-        assert list(np.asarray(jc.counts)) == [1, 0, 1]
+        assert list(np.asarray(jc.counts)[:3]) == [1, 0, 1]
 
     def test_large_random_inner(self, rng):
         n, m = 300, 400
