@@ -1,0 +1,34 @@
+"""Expression library — the reference's ~162 expression registry
+
+(GpuOverrides.scala:773-2684).  Exposed flat for the planner's rule
+registry (plan/overrides.py) and the DataFrame API (api/functions.py).
+"""
+from .core import (Expression, LeafExpression, AttributeReference,
+                   BoundReference, Literal, Alias, Scalar, lit,
+                   output_name, eval_as_column)  # noqa: F401
+from .arithmetic import (Add, Subtract, Multiply, Divide, IntegralDivide,
+                         Remainder, Pmod, UnaryMinus, UnaryPositive, Abs,
+                         Sqrt, Exp, Expm1, Log, Log1p, Log2, Log10, Sin, Cos,
+                         Tan, Asin, Acos, Atan, Sinh, Cosh, Tanh, Asinh,
+                         Acosh, Atanh, Cbrt, ToDegrees, ToRadians, Rint,
+                         Signum, Floor, Ceil, Round, Pow, Atan2, Least,
+                         Greatest, BitwiseAnd, BitwiseOr, BitwiseXor,
+                         BitwiseNot, ShiftLeft, ShiftRight,
+                         ShiftRightUnsigned)  # noqa: F401
+from .predicates import (EqualTo, EqualNullSafe, LessThan, LessThanOrEqual,
+                         GreaterThan, GreaterThanOrEqual, Not, And, Or,
+                         IsNull, IsNotNull, IsNaN, In)  # noqa: F401
+from .conditional import (If, CaseWhen, Coalesce, Nvl, NaNvl)  # noqa: F401
+from .cast import Cast  # noqa: F401
+from .string_ops import (Upper, Lower, Length, Substring, StartsWith,
+                         EndsWith, Contains, Like, RLike, ConcatStrings,
+                         StringTrim, StringTrimLeft,
+                         StringTrimRight)  # noqa: F401
+from .datetime import (Year, Month, DayOfMonth, Quarter, DayOfWeek, WeekDay,
+                       DayOfYear, LastDay, Hour, Minute, Second, DateAdd,
+                       DateSub, DateDiff, UnixTimestampToSeconds,
+                       ToDate)  # noqa: F401
+from .aggregates import (AggregateFunction, Sum, Count, Min, Max, Average,
+                         First, Last)  # noqa: F401
+from .misc import (Murmur3Hash, Md5, MonotonicallyIncreasingID,
+                   SparkPartitionID, Rand)  # noqa: F401

@@ -1,0 +1,211 @@
+"""Aggregate function expressions — reference analogue: AggregateFunctions.scala
+
+(GpuMin/GpuMax/GpuSum/GpuCount/GpuAverage/GpuFirst/GpuLast/CollectList/
+CollectSet/PivotFirst) with the partial/merge/final projection model of
+GpuHashAggregateExec (aggregate.scala:240).
+
+Each AggregateFunction declares:
+- update: how a partial value is computed from input rows within a batch
+  (via the sort+segment kernels)
+- merge: how partials combine across batches/partitions
+- final dtype and finalization (e.g. Average = sum/count)
+The exec layer (exec/aggregate.py) drives these against GroupPlan segments.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.column import Column
+from ..kernels import aggregate as agg_k
+from .core import Expression
+
+
+class AggregateFunction(Expression):
+    """Base for aggregate expressions. children[0] is the input (if any)."""
+
+    def __init__(self, child: Optional[Expression] = None):
+        self.children = [child] if child is not None else []
+
+    def with_children(self, c):
+        return type(self)(c[0]) if c else type(self)()
+
+    # number of internal buffer columns for partial aggregation
+    @property
+    def num_buffers(self) -> int:
+        return 1
+
+    def buffer_dtypes(self) -> List[T.DType]:
+        return [self.dtype()]
+
+    def update(self, plan: agg_k.GroupPlan, cols: List[Column]):
+        """Compute partial buffers from input columns (one per child)."""
+        raise NotImplementedError
+
+    def merge(self, plan: agg_k.GroupPlan, buffers: List[Column]):
+        """Merge partial buffers grouped by the same keys."""
+        raise NotImplementedError
+
+    def finalize(self, buffers: List[Column]) -> Column:
+        return buffers[0]
+
+    def columnar_eval(self, batch):
+        raise AssertionError(
+            f"{self.name} must be evaluated by an aggregate exec")
+
+
+def _col_of(data, valid, dt):
+    return Column(dt, data.astype(dt.np_dtype), valid)
+
+
+class Sum(AggregateFunction):
+    def dtype(self):
+        ct = self.children[0].dtype()
+        if ct.is_integral:
+            return T.INT64
+        if isinstance(ct, T.DecimalType):
+            return T.DecimalType(min(ct.precision + 10, 18), ct.scale)
+        return T.FLOAT64
+
+    def update(self, plan, cols):
+        c = cols[0]
+        out_t = self.dtype()
+        s = agg_k.seg_sum(plan, c.data, c.validity,
+                          out_dtype=out_t.np_dtype)
+        cnt = agg_k.seg_count(plan, c.validity)
+        return [_col_of(s, cnt > 0, out_t)]
+
+    def merge(self, plan, buffers):
+        b = buffers[0]
+        s = agg_k.seg_sum(plan, b.data, b.validity)
+        cnt = agg_k.seg_count(plan, b.validity)
+        return [_col_of(s, cnt > 0, self.dtype())]
+
+
+class Count(AggregateFunction):
+    """count(expr) or count(*) when child is None."""
+
+    @property
+    def nullable(self):
+        return False
+
+    def dtype(self):
+        return T.INT64
+
+    def update(self, plan, cols):
+        if not self.children or cols[0] is None:
+            cnt = agg_k.seg_count_all(plan)
+        else:
+            cnt = agg_k.seg_count(plan, cols[0].validity)
+        ones = jnp.ones_like(cnt, dtype=bool)
+        return [Column(T.INT64, cnt, ones)]
+
+    def merge(self, plan, buffers):
+        b = buffers[0]
+        s = agg_k.seg_sum(plan, b.data, b.validity)
+        return [Column(T.INT64, s, jnp.ones_like(s, dtype=bool))]
+
+
+class Min(AggregateFunction):
+    def dtype(self):
+        return self.children[0].dtype()
+
+    def update(self, plan, cols):
+        c = cols[0]
+        if c.dtype == T.STRING:
+            idx, has = agg_k.seg_first_index_by_order(plan, c, want_min=True)
+            return [c.gather(idx).mask_validity(has)]
+        m = agg_k.seg_min(plan, c.data, c.validity)
+        cnt = agg_k.seg_count(plan, c.validity)
+        return [_col_of(m, cnt > 0, self.dtype())]
+
+    merge = update
+
+
+class Max(AggregateFunction):
+    def dtype(self):
+        return self.children[0].dtype()
+
+    def update(self, plan, cols):
+        c = cols[0]
+        if c.dtype == T.STRING:
+            idx, has = agg_k.seg_first_index_by_order(plan, c, want_min=False)
+            return [c.gather(idx).mask_validity(has)]
+        m = agg_k.seg_max(plan, c.data, c.validity)
+        cnt = agg_k.seg_count(plan, c.validity)
+        return [_col_of(m, cnt > 0, self.dtype())]
+
+    merge = update
+
+
+class Average(AggregateFunction):
+    def dtype(self):
+        return T.FLOAT64
+
+    @property
+    def num_buffers(self):
+        return 2
+
+    def buffer_dtypes(self):
+        return [T.FLOAT64, T.INT64]
+
+    def update(self, plan, cols):
+        c = cols[0]
+        s = agg_k.seg_sum(plan, c.data, c.validity, out_dtype=jnp.float64)
+        cnt = agg_k.seg_count(plan, c.validity)
+        always = jnp.ones_like(cnt, dtype=bool)
+        return [Column(T.FLOAT64, s, always), Column(T.INT64, cnt, always)]
+
+    def merge(self, plan, buffers):
+        s = agg_k.seg_sum(plan, buffers[0].data, buffers[0].validity)
+        cnt = agg_k.seg_sum(plan, buffers[1].data, buffers[1].validity)
+        always = jnp.ones_like(cnt, dtype=bool)
+        return [Column(T.FLOAT64, s, always), Column(T.INT64, cnt, always)]
+
+    def finalize(self, buffers):
+        s, cnt = buffers[0].data, buffers[1].data
+        ok = cnt > 0
+        avg = s / jnp.where(ok, cnt, 1).astype(jnp.float64)
+        return Column(T.FLOAT64, avg, ok & buffers[0].validity)
+
+
+class First(AggregateFunction):
+    def __init__(self, child=None, ignore_nulls: bool = True):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def with_children(self, c):
+        return First(c[0], self.ignore_nulls)
+
+    def dtype(self):
+        return self.children[0].dtype()
+
+    def update(self, plan, cols):
+        c = cols[0]
+        idx, has = agg_k.seg_first_index(plan, c.validity, self.ignore_nulls)
+        out = c.gather(idx.astype(jnp.int32))
+        return [out.mask_validity(has)]
+
+    merge = update
+
+
+class Last(AggregateFunction):
+    def __init__(self, child=None, ignore_nulls: bool = True):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def with_children(self, c):
+        return Last(c[0], self.ignore_nulls)
+
+    def dtype(self):
+        return self.children[0].dtype()
+
+    def update(self, plan, cols):
+        c = cols[0]
+        idx, has = agg_k.seg_last_index(plan, c.validity, self.ignore_nulls)
+        out = c.gather(idx.astype(jnp.int32))
+        return [out.mask_validity(has)]
+
+    merge = update

@@ -90,7 +90,7 @@ def _select_strings(conds, cols, cap):
     out = jnp.zeros(out_bytes, jnp.uint8)
     for i, c in enumerate(cols):
         buf_i = _materialize_bytes(c.data, new_offsets, src_start, out_bytes)
-        j = jnp.arange(out_bytes, jnp.int32)
+        j = jnp.arange(out_bytes, dtype=jnp.int32)
         row_of_j = jnp.clip(
             jnp.searchsorted(new_offsets[1:], j, side="right"), 0, cap - 1)
         out = jnp.where(sel[row_of_j] == i, buf_i, out)

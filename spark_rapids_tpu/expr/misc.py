@@ -1,0 +1,128 @@
+"""Misc expressions: hashing, ids, metadata — reference analogues:
+
+HashFunctions.scala (Murmur3Hash/Md5), GpuMonotonicallyIncreasingID,
+GpuSparkPartitionID, GpuInputFileBlock, randomExpressions, literals.scala.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.column import Column, StringColumn
+from ..kernels import basic, canon
+from .core import Expression, LeafExpression, eval_data_valid, as_column
+
+
+class Murmur3Hash(Expression):
+    """hash(cols...) -> int64 (self-consistent mixing; reference GpuMurmur3Hash)."""
+
+    def __init__(self, *children, seed: int = 42):
+        self.children = list(children)
+        self.seed = seed
+
+    def with_children(self, c):
+        return Murmur3Hash(*c, seed=self.seed)
+
+    def dtype(self):
+        return T.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        word_lists = []
+        for ch in self.children:
+            col = as_column(ch.columnar_eval(batch), batch.capacity,
+                            batch.num_rows)
+            for w in canon.value_words(col, batch.num_rows):
+                # null contributes a distinct tag so hash(null) != hash(0)
+                word_lists.append(jnp.where(col.validity, w,
+                                            jnp.uint64(0x9E3779B97F4A7C15)))
+        h = basic.hash_words(word_lists, seed=self.seed)
+        return Column(T.INT64, h.view(jnp.int64),
+                      jnp.ones(batch.capacity, bool))
+
+
+class Md5(Expression):
+    """md5(string) -> hex string (host path: cryptographic, not a hot op)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def with_children(self, c):
+        return Md5(c[0])
+
+    def dtype(self):
+        return T.STRING
+
+    def columnar_eval(self, batch):
+        col = as_column(self.children[0].columnar_eval(batch), batch.capacity,
+                        batch.num_rows)
+        vals, valid = col.to_numpy(batch.num_rows)
+        out = []
+        for i in range(batch.num_rows):
+            if valid[i]:
+                out.append(hashlib.md5(
+                    vals[i].encode("utf-8")).hexdigest())
+            else:
+                out.append(None)
+        return StringColumn.from_pylist(
+            out + [None] * (batch.capacity - batch.num_rows),
+            capacity=batch.capacity)
+
+
+class MonotonicallyIncreasingID(LeafExpression):
+    """partition_id << 33 | row_index (Spark's contract)."""
+
+    def dtype(self):
+        return T.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        ctx = getattr(batch, "task_context", None)
+        pid = ctx.partition_id if ctx else 0
+        base = ctx.row_start if ctx else 0
+        ids = (jnp.int64(pid) << 33) | (jnp.arange(batch.capacity,
+                                                   dtype=jnp.int64) + base)
+        return Column(T.INT64, ids, jnp.ones(batch.capacity, bool))
+
+
+class SparkPartitionID(LeafExpression):
+    def dtype(self):
+        return T.INT32
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch):
+        ctx = getattr(batch, "task_context", None)
+        pid = ctx.partition_id if ctx else 0
+        return Column(T.INT32, jnp.full(batch.capacity, pid, jnp.int32),
+                      jnp.ones(batch.capacity, bool))
+
+
+class Rand(LeafExpression):
+    """rand(seed): deterministic per (seed, partition, row) via threefry."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def dtype(self):
+        return T.FLOAT64
+
+    def columnar_eval(self, batch):
+        import jax
+        ctx = getattr(batch, "task_context", None)
+        pid = ctx.partition_id if ctx else 0
+        base = ctx.row_start if ctx else 0
+        key = jax.random.key(self.seed ^ (pid << 20) ^ base)
+        vals = jax.random.uniform(key, (batch.capacity,), dtype=jnp.float64)
+        return Column(T.FLOAT64, vals, jnp.ones(batch.capacity, bool))

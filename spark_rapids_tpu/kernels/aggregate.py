@@ -109,6 +109,36 @@ def seg_first_index(plan: GroupPlan, validity, ignore_nulls: bool = True):
     return jnp.take(plan.perm, safe), first_pos < cap
 
 
+def seg_first_index_by_order(plan: GroupPlan, col, want_min: bool = True,
+                             num_rows: int = None):
+    """Index of the lexicographically min/max value per group (strings etc.).
+
+    Works on canonical value words: iteratively narrow candidates word by
+    word with segment_min, then take the first surviving index.
+    """
+    from . import canon
+    cap = col.capacity
+    if num_rows is None:
+        num_rows = cap
+    words = canon.value_words(col, num_rows)
+    if not want_min:
+        words = [~w for w in words]
+    ok = jnp.take(col.validity, plan.perm) & plan.live_sorted
+    cand = ok
+    for w in words:
+        ws = jnp.take(w, plan.perm).astype(jnp.uint64)
+        big = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        contrib = jnp.where(cand, ws, big)
+        m = jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
+        cand = cand & (ws == jnp.take(m, plan.seg_id))
+    pos = jnp.arange(cap, dtype=jnp.int64)
+    contrib = jnp.where(cand, pos, jnp.int64(cap))
+    first_pos = jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
+    has = first_pos < cap
+    safe = jnp.clip(first_pos, 0, cap - 1).astype(jnp.int32)
+    return jnp.take(plan.perm, safe), has
+
+
 def seg_last_index(plan: GroupPlan, validity, ignore_nulls: bool = True):
     cap = validity.shape[0]
     ok = jnp.take(validity, plan.perm) & plan.live_sorted if ignore_nulls \

@@ -1,0 +1,244 @@
+"""Expression library tests vs Python/numpy oracles.
+
+Pattern parity: reference CastOpSuite / arithmetic integration tests
+(integration_tests/src/main/python/arithmetic_ops_test.py).
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import ColumnarBatch, dtypes as T
+import spark_rapids_tpu.expr as E
+
+
+def _batch():
+    return ColumnarBatch.from_pydict({
+        "i": [1, 2, None, -4, 5],
+        "j": [10, 0, 3, None, 2],
+        "f": [1.5, -2.0, float("nan"), None, 0.0],
+        "s": ["foo", "Bar", None, "baz", "foobar"],
+        "b": [True, False, None, True, False],
+    }, schema=None)
+
+
+def _eval(expr, batch=None):
+    batch = batch or _batch()
+    bound = expr.bind(batch.schema)
+    col = E.eval_as_column(bound, batch)
+    return col.to_pylist(batch.num_rows)
+
+
+def col(name):
+    return E.AttributeReference(name)
+
+
+class TestArithmetic:
+    def test_add(self):
+        assert _eval(E.Add(col("i"), col("j"))) == [11, 2, None, None, 7]
+
+    def test_mul_lit(self):
+        assert _eval(E.Multiply(col("i"), E.lit(3))) == [3, 6, None, -12, 15]
+
+    def test_divide_by_zero_is_null(self):
+        got = _eval(E.Divide(col("i"), col("j")))
+        assert got[1] is None  # 2/0 -> null
+        assert got[0] == pytest.approx(0.1)
+
+    def test_remainder_sign(self):
+        b = ColumnarBatch.from_pydict({"x": [7, -7, 7, -7],
+                                       "y": [3, 3, -3, -3]})
+        got = _eval(E.Remainder(col("x"), col("y")), b)
+        assert got == [1, -1, 1, -1]  # Java remainder semantics
+
+    def test_abs_neg(self):
+        assert _eval(E.Abs(col("i"))) == [1, 2, None, 4, 5]
+        assert _eval(E.UnaryMinus(col("i"))) == [-1, -2, None, 4, -5]
+
+    def test_sqrt(self):
+        b = ColumnarBatch.from_pydict({"x": [4.0, 9.0, None]})
+        assert _eval(E.Sqrt(col("x")), b) == [2.0, 3.0, None]
+
+    def test_round(self):
+        b = ColumnarBatch.from_pydict({"x": [2.5, -2.5, 1.44, None]})
+        assert _eval(E.Round(col("x")), b) == [3.0, -3.0, 1.0, None]
+
+    def test_shift(self):
+        b = ColumnarBatch.from_pydict({"x": [1, 2, -8]})
+        assert _eval(E.ShiftLeft(col("x"), E.lit(2)), b) == [4, 8, -32]
+
+
+class TestPredicates:
+    def test_comparisons(self):
+        assert _eval(E.LessThan(col("i"), col("j"))) == [
+            True, False, None, None, False]
+        assert _eval(E.EqualTo(col("i"), E.lit(2))) == [
+            False, True, None, False, False]
+
+    def test_string_compare(self):
+        assert _eval(E.GreaterThan(col("s"), E.lit("baz"))) == [
+            True, False, None, False, True]
+
+    def test_and_or_three_valued(self):
+        t, f, n = E.lit(True), E.lit(False), E.Literal(None, T.BOOL)
+        b = _batch()
+        assert _eval(E.And(f, n), b) == [False] * 5
+        assert _eval(E.And(t, n), b) == [None] * 5
+        assert _eval(E.Or(t, n), b) == [True] * 5
+        assert _eval(E.Or(f, n), b) == [None] * 5
+
+    def test_is_null(self):
+        assert _eval(E.IsNull(col("i"))) == [
+            False, False, True, False, False]
+        assert _eval(E.IsNotNull(col("i"))) == [
+            True, True, False, True, True]
+
+    def test_isnan(self):
+        assert _eval(E.IsNaN(col("f"))) == [
+            False, False, True, False, False]
+
+    def test_equal_null_safe(self):
+        got = _eval(E.EqualNullSafe(col("i"), E.Literal(None, T.INT64)))
+        assert got == [False, False, True, False, False]
+
+    def test_in(self):
+        assert _eval(E.In(col("i"), [1, 5])) == [
+            True, False, None, False, True]
+
+
+class TestConditional:
+    def test_if(self):
+        got = _eval(E.If(E.GreaterThan(col("i"), E.lit(1)),
+                         col("i"), col("j")))
+        # null predicate falls through to the else branch (Spark CASE rules)
+        assert got == [10, 2, 3, None, 5]
+
+    def test_coalesce(self):
+        assert _eval(E.Coalesce(col("i"), col("j"))) == [1, 2, 3, -4, 5]
+
+    def test_case_when(self):
+        e = E.CaseWhen(
+            [(E.LessThan(col("i"), E.lit(0)), E.lit(-1)),
+             (E.GreaterThan(col("i"), E.lit(2)), E.lit(1))],
+            E.lit(0))
+        assert _eval(e) == [0, 0, 0, -1, 1]
+
+    def test_if_strings(self):
+        got = _eval(E.If(E.GreaterThan(col("i"), E.lit(1)),
+                         col("s"), E.lit("small")))
+        # null predicate -> else branch
+        assert got == ["small", "Bar", "small", "small", "foobar"]
+
+
+class TestCast:
+    def test_int_to_double(self):
+        assert _eval(E.Cast(col("i"), T.FLOAT64)) == [
+            1.0, 2.0, None, -4.0, 5.0]
+
+    def test_double_to_int_truncates(self):
+        b = ColumnarBatch.from_pydict({"x": [1.9, -1.9, float("nan")]})
+        assert _eval(E.Cast(col("x"), T.INT32), b) == [1, -1, 0]
+
+    def test_int_to_string(self):
+        assert _eval(E.Cast(col("i"), T.STRING)) == [
+            "1", "2", None, "-4", "5"]
+
+    def test_double_to_string(self):
+        b = ColumnarBatch.from_pydict({"x": [1.0, 2.5, None]})
+        assert _eval(E.Cast(col("x"), T.STRING), b) == ["1.0", "2.5", None]
+
+    def test_string_to_int(self):
+        b = ColumnarBatch.from_pydict({"x": ["12", " 7 ", "bad", None]})
+        assert _eval(E.Cast(col("x"), T.INT64), b) == [12, 7, None, None]
+
+    def test_string_to_date(self):
+        b = ColumnarBatch.from_pydict({"x": ["1970-01-02", "2020-02-29"]})
+        got = _eval(E.Cast(col("x"), T.DATE), b)
+        assert got == [1, 18321]
+
+    def test_bool_to_string(self):
+        assert _eval(E.Cast(col("b"), T.STRING)) == [
+            "true", "false", None, "true", "false"]
+
+
+class TestStrings:
+    def test_upper_lower(self):
+        assert _eval(E.Upper(col("s"))) == ["FOO", "BAR", None, "BAZ",
+                                            "FOOBAR"]
+        assert _eval(E.Lower(col("s"))) == ["foo", "bar", None, "baz",
+                                            "foobar"]
+
+    def test_length(self):
+        assert _eval(E.Length(col("s"))) == [3, 3, None, 3, 6]
+
+    def test_substring(self):
+        got = _eval(E.Substring(col("s"), E.lit(2), E.lit(2)))
+        assert got == ["oo", "ar", None, "az", "oo"]
+
+    def test_concat(self):
+        got = _eval(E.ConcatStrings(col("s"), E.lit("_x")))
+        assert got == ["foo_x", "Bar_x", None, "baz_x", "foobar_x"]
+
+    def test_like(self):
+        assert _eval(E.Like(col("s"), E.lit("foo%"))) == [
+            True, False, None, False, True]
+        assert _eval(E.Like(col("s"), E.lit("%a%"))) == [
+            False, True, None, True, True]
+        assert _eval(E.Like(col("s"), E.lit("_az"))) == [
+            False, False, None, True, False]
+
+    def test_trim(self):
+        b = ColumnarBatch.from_pydict({"x": ["  hi  ", "a", "   ", ""]})
+        assert _eval(E.StringTrim(col("x")), b) == ["hi", "a", "", ""]
+        assert _eval(E.StringTrimLeft(col("x")), b) == ["hi  ", "a", "", ""]
+        assert _eval(E.StringTrimRight(col("x")), b) == ["  hi", "a", "", ""]
+
+    def test_starts_ends_contains(self):
+        assert _eval(E.StartsWith(col("s"), E.lit("fo"))) == [
+            True, False, None, False, True]
+        assert _eval(E.EndsWith(col("s"), E.lit("ar"))) == [
+            False, True, None, False, True]
+        assert _eval(E.Contains(col("s"), E.lit("oba"))) == [
+            False, False, None, False, True]
+
+
+class TestDatetime:
+    def test_year_month_day(self):
+        b = ColumnarBatch.from_pydict(
+            {"d": [0, 59, 18321, -1]},
+            schema=None)
+        d = E.Cast(col("d"), T.DATE)
+        assert _eval(E.Year(d), b) == [1970, 1970, 2020, 1969]
+        assert _eval(E.Month(d), b) == [1, 3, 2, 12]
+        assert _eval(E.DayOfMonth(d), b) == [1, 1, 29, 31]
+
+    def test_day_of_week(self):
+        b = ColumnarBatch.from_pydict({"d": [0, 3]})
+        d = E.Cast(col("d"), T.DATE)
+        # 1970-01-01 Thursday=5 in Spark dayofweek (Sun=1)
+        assert _eval(E.DayOfWeek(d), b) == [5, 1]
+
+    def test_date_add_diff(self):
+        b = ColumnarBatch.from_pydict({"d": [10, 20]})
+        d = E.Cast(col("d"), T.DATE)
+        assert _eval(E.DateAdd(d, E.lit(5)), b) == [15, 25]
+        assert _eval(E.DateDiff(d, E.Cast(E.lit(0), T.DATE)), b) == [10, 20]
+
+    def test_timestamp_fields(self):
+        us = 3 * 3_600_000_000 + 25 * 60_000_000 + 45_000_000
+        b = ColumnarBatch.from_pydict({"t": [us, -1]})
+        t = E.Cast(col("t"), T.TIMESTAMP)
+        assert _eval(E.Hour(t), b) == [3, 23]
+        assert _eval(E.Minute(t), b) == [25, 59]
+        assert _eval(E.Second(t), b) == [45, 59]
+
+
+class TestMisc:
+    def test_hash_deterministic_not_null(self):
+        got1 = _eval(E.Murmur3Hash(col("i"), col("s")))
+        got2 = _eval(E.Murmur3Hash(col("i"), col("s")))
+        assert got1 == got2
+        assert all(v is not None for v in got1)
+
+    def test_md5(self):
+        b = ColumnarBatch.from_pydict({"x": ["abc", None]})
+        got = _eval(E.Md5(col("x")), b)
+        assert got == ["900150983cd24fb0d6963f7d28e17f72", None]
