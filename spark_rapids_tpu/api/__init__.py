@@ -1,0 +1,5 @@
+"""User-facing API: TpuSession, DataFrame, Col, functions."""
+from .session import TpuSession  # noqa: F401
+from .dataframe import DataFrame  # noqa: F401
+from .column import Col  # noqa: F401
+from . import functions  # noqa: F401

@@ -1,0 +1,162 @@
+"""Column API wrapper — PySpark-style ``Column`` over expression trees."""
+from __future__ import annotations
+
+from typing import Any
+
+from ..columnar import dtypes as T
+from ..expr import core as ec
+from ..expr import (arithmetic as ea, predicates as ep, conditional as econd,
+                    cast as ecast, string_ops as es)
+
+
+def _expr(v) -> ec.Expression:
+    if isinstance(v, Col):
+        return v.expr
+    if isinstance(v, ec.Expression):
+        return v
+    return ec.Literal(v)
+
+
+class Col:
+    def __init__(self, expr: ec.Expression):
+        self.expr = expr
+
+    # arithmetic
+    def __add__(self, o):
+        return Col(ea.Add(self.expr, _expr(o)))
+
+    def __radd__(self, o):
+        return Col(ea.Add(_expr(o), self.expr))
+
+    def __sub__(self, o):
+        return Col(ea.Subtract(self.expr, _expr(o)))
+
+    def __rsub__(self, o):
+        return Col(ea.Subtract(_expr(o), self.expr))
+
+    def __mul__(self, o):
+        return Col(ea.Multiply(self.expr, _expr(o)))
+
+    def __rmul__(self, o):
+        return Col(ea.Multiply(_expr(o), self.expr))
+
+    def __truediv__(self, o):
+        return Col(ea.Divide(self.expr, _expr(o)))
+
+    def __rtruediv__(self, o):
+        return Col(ea.Divide(_expr(o), self.expr))
+
+    def __mod__(self, o):
+        return Col(ea.Remainder(self.expr, _expr(o)))
+
+    def __neg__(self):
+        return Col(ea.UnaryMinus(self.expr))
+
+    # comparisons
+    def __eq__(self, o):  # type: ignore[override]
+        return Col(ep.EqualTo(self.expr, _expr(o)))
+
+    def __ne__(self, o):  # type: ignore[override]
+        return Col(ep.Not(ep.EqualTo(self.expr, _expr(o))))
+
+    def __lt__(self, o):
+        return Col(ep.LessThan(self.expr, _expr(o)))
+
+    def __le__(self, o):
+        return Col(ep.LessThanOrEqual(self.expr, _expr(o)))
+
+    def __gt__(self, o):
+        return Col(ep.GreaterThan(self.expr, _expr(o)))
+
+    def __ge__(self, o):
+        return Col(ep.GreaterThanOrEqual(self.expr, _expr(o)))
+
+    # boolean
+    def __and__(self, o):
+        return Col(ep.And(self.expr, _expr(o)))
+
+    def __or__(self, o):
+        return Col(ep.Or(self.expr, _expr(o)))
+
+    def __invert__(self):
+        return Col(ep.Not(self.expr))
+
+    # pyspark-style methods
+    def alias(self, name: str) -> "Col":
+        return Col(ec.Alias(self.expr, name))
+
+    def cast(self, to) -> "Col":
+        if isinstance(to, str):
+            to = T.dtype_from_name(to)
+        return Col(ecast.Cast(self.expr, to))
+
+    def is_null(self):
+        return Col(ep.IsNull(self.expr))
+
+    isNull = is_null
+
+    def is_not_null(self):
+        return Col(ep.IsNotNull(self.expr))
+
+    isNotNull = is_not_null
+
+    def isin(self, *values):
+        vals = values[0] if len(values) == 1 and \
+            isinstance(values[0], (list, tuple)) else list(values)
+        return Col(ep.In(self.expr, list(vals)))
+
+    def eq_null_safe(self, o):
+        return Col(ep.EqualNullSafe(self.expr, _expr(o)))
+
+    eqNullSafe = eq_null_safe
+
+    def like(self, pattern: str):
+        return Col(es.Like(self.expr, ec.Literal(pattern)))
+
+    def rlike(self, pattern: str):
+        return Col(es.RLike(self.expr, ec.Literal(pattern)))
+
+    def startswith(self, s):
+        return Col(es.StartsWith(self.expr, _expr(s)))
+
+    def endswith(self, s):
+        return Col(es.EndsWith(self.expr, _expr(s)))
+
+    def contains(self, s):
+        return Col(es.Contains(self.expr, _expr(s)))
+
+    def substr(self, start: int, length: int):
+        return Col(es.Substring(self.expr, ec.Literal(start),
+                                ec.Literal(length)))
+
+    def when(self, *a, **k):
+        raise AttributeError("use functions.when")
+
+    def otherwise(self, *a, **k):
+        raise AttributeError("use functions.when(...).otherwise(...)")
+
+    def asc(self):
+        from ..plan.logical import SortOrder
+        return SortOrder(self.expr, ascending=True)
+
+    def desc(self):
+        from ..plan.logical import SortOrder
+        return SortOrder(self.expr, ascending=False)
+
+    def asc_nulls_last(self):
+        from ..plan.logical import SortOrder
+        return SortOrder(self.expr, ascending=True, nulls_first=False)
+
+    def desc_nulls_first(self):
+        from ..plan.logical import SortOrder
+        return SortOrder(self.expr, ascending=False, nulls_first=True)
+
+    def __repr__(self):
+        return f"Col({self.expr!r})"
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        raise ValueError(
+            "Cannot convert Col to bool; use & | ~ for boolean logic")

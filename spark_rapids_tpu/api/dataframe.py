@@ -1,0 +1,382 @@
+"""DataFrame API — the user surface (PySpark-flavored).
+
+Role note: the reference accelerates Spark's DataFrame/SQL API without
+owning it; standalone, this module IS that surface, building the logical
+plans the planner consumes.  Method names follow PySpark so existing
+Spark jobs translate mechanically.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from ..columnar.schema import Schema
+from ..expr import core as ec
+from ..expr import aggregates as eagg
+from ..plan import logical as L
+from .column import Col, _expr
+
+
+def _resolve(expr: ec.Expression, schema: Schema) -> ec.Expression:
+    """Resolve AttributeReferences to typed refs against a schema."""
+    if isinstance(expr, ec.AttributeReference) and expr._dtype is None:
+        return expr.resolve(schema)
+    return expr.map_children(lambda c: _resolve(c, schema))
+
+
+def _to_expr(c, schema: Schema) -> ec.Expression:
+    if isinstance(c, str):
+        return ec.AttributeReference(c).resolve(schema)
+    if isinstance(c, Col):
+        return _resolve(c.expr, schema)
+    if isinstance(c, ec.Expression):
+        return _resolve(c, schema)
+    return ec.Literal(c)
+
+
+class DataFrame:
+    def __init__(self, logical: L.LogicalPlan, session):
+        self._plan = logical
+        self.session = session
+
+    # -- metadata ------------------------------------------------------------
+    @property
+    def schema(self) -> Schema:
+        return self._plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self.schema.names
+
+    def __getitem__(self, name: str) -> Col:
+        f = self.schema[name]
+        return Col(ec.AttributeReference(f.name, f.dtype, f.nullable))
+
+    # -- transformations -----------------------------------------------------
+    def select(self, *cols) -> "DataFrame":
+        exprs = []
+        for c in cols:
+            if isinstance(c, str) and c == "*":
+                exprs.extend(
+                    ec.AttributeReference(f.name, f.dtype, f.nullable)
+                    for f in self.schema)
+            else:
+                exprs.append(_to_expr(c, self.schema))
+        return DataFrame(L.Project(exprs, self._plan), self.session)
+
+    def with_column(self, name: str, col) -> "DataFrame":
+        exprs = []
+        replaced = False
+        e = _to_expr(col, self.schema)
+        for f in self.schema:
+            if f.name == name:
+                exprs.append(ec.Alias(e, name))
+                replaced = True
+            else:
+                exprs.append(
+                    ec.AttributeReference(f.name, f.dtype, f.nullable))
+        if not replaced:
+            exprs.append(ec.Alias(e, name))
+        return DataFrame(L.Project(exprs, self._plan), self.session)
+
+    withColumn = with_column
+
+    def with_column_renamed(self, old: str, new: str) -> "DataFrame":
+        exprs = []
+        for f in self.schema:
+            ref = ec.AttributeReference(f.name, f.dtype, f.nullable)
+            exprs.append(ec.Alias(ref, new) if f.name == old else ref)
+        return DataFrame(L.Project(exprs, self._plan), self.session)
+
+    withColumnRenamed = with_column_renamed
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [f for f in self.schema if f.name not in names]
+        exprs = [ec.AttributeReference(f.name, f.dtype, f.nullable)
+                 for f in keep]
+        return DataFrame(L.Project(exprs, self._plan), self.session)
+
+    def filter(self, cond) -> "DataFrame":
+        return DataFrame(L.Filter(_to_expr(cond, self.schema), self._plan),
+                         self.session)
+
+    where = filter
+
+    def group_by(self, *cols) -> "GroupedData":
+        keys = [_to_expr(c, self.schema) for c in cols]
+        return GroupedData(self, keys)
+
+    groupBy = group_by
+    groupby = group_by
+
+    def agg(self, *aggs, **named) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs, **named)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner"
+             ) -> "DataFrame":
+        how = {"left_outer": "left", "right_outer": "right",
+               "outer": "full", "full_outer": "full", "leftsemi": "semi",
+               "left_semi": "semi", "leftanti": "anti",
+               "left_anti": "anti", "crossjoin": "cross"}.get(how, how)
+        if how == "cross" or on is None:
+            return DataFrame(
+                L.Join(self._plan, other._plan, "cross", [], [], None),
+                self.session)
+        if isinstance(on, str):
+            on = [on]
+        if isinstance(on, (list, tuple)) and all(
+                isinstance(x, str) for x in on):
+            lkeys = [_to_expr(k, self.schema) for k in on]
+            rkeys = [_to_expr(k, other.schema) for k in on]
+            joined = L.Join(self._plan, other._plan, how, lkeys, rkeys, None)
+            df = DataFrame(joined, self.session)
+            if how in ("semi", "anti"):
+                return df
+            # spark semantics: dedupe the join columns (keep left's)
+            out_exprs = []
+            seen_right = set(on)
+            lsch = self._plan.schema
+            joined_schema = joined.schema
+            for i, f in enumerate(joined_schema):
+                if i < len(lsch):
+                    out_exprs.append(
+                        ec.BoundReference(i, f.dtype, f.nullable, f.name))
+                    continue
+                if f.name in seen_right:
+                    seen_right.discard(f.name)
+                    continue
+                out_exprs.append(ec.BoundReference(i, f.dtype, f.nullable,
+                                                   f.name))
+            return DataFrame(L.Project(out_exprs, joined), self.session)
+        # Col condition: only equi-joins extracted in v0
+        cond = on.expr if isinstance(on, Col) else on
+        lkeys, rkeys, residual = _extract_equi_keys(
+            cond, self._plan.schema, other._plan.schema)
+        return DataFrame(
+            L.Join(self._plan, other._plan, how, lkeys, rkeys, residual),
+            self.session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(L.Union([self._plan, other._plan]), self.session)
+
+    unionAll = union
+
+    def distinct(self) -> "DataFrame":
+        return DataFrame(L.Distinct(self._plan), self.session)
+
+    def drop_duplicates(self, subset: Optional[List[str]] = None
+                        ) -> "DataFrame":
+        if subset is None:
+            return self.distinct()
+        keys = [_to_expr(c, self.schema) for c in subset]
+        aggs = [L.AggExpr(eagg.First(
+            ec.AttributeReference(f.name, f.dtype, f.nullable)), f.name)
+            for f in self.schema if f.name not in subset]
+        agg_plan = L.Aggregate(keys, aggs, self._plan)
+        # restore column order
+        out = DataFrame(agg_plan, self.session)
+        return out.select(*self.schema.names)
+
+    dropDuplicates = drop_duplicates
+
+    def sort(self, *cols, ascending=None) -> "DataFrame":
+        orders = []
+        for c in cols:
+            if isinstance(c, L.SortOrder):
+                orders.append(L.SortOrder(
+                    _resolve(c.expr, self.schema), c.ascending,
+                    c.nulls_first))
+            else:
+                orders.append(L.SortOrder(_to_expr(c, self.schema)))
+        if ascending is not None:
+            flags = ascending if isinstance(ascending, (list, tuple)) else \
+                [ascending] * len(orders)
+            orders = [L.SortOrder(o.expr, bool(a), o.nulls_first)
+                      for o, a in zip(orders, flags)]
+        return DataFrame(L.Sort(orders, self._plan, is_global=True),
+                         self.session)
+
+    orderBy = sort
+    order_by = sort
+
+    def sort_within_partitions(self, *cols) -> "DataFrame":
+        orders = [L.SortOrder(_to_expr(c, self.schema)) for c in cols]
+        return DataFrame(L.Sort(orders, self._plan, is_global=False),
+                         self.session)
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(L.Limit(n, self._plan), self.session)
+
+    def offset(self, n: int) -> "DataFrame":
+        return DataFrame(L.Limit(1 << 60, self._plan, offset=n),
+                         self.session)
+
+    def repartition(self, num: int, *cols) -> "DataFrame":
+        by = [_to_expr(c, self.schema) for c in cols] or None
+        return DataFrame(L.Repartition(num, self._plan, by), self.session)
+
+    def coalesce(self, num: int) -> "DataFrame":
+        return DataFrame(L.Repartition(num, self._plan, None), self.session)
+
+    def with_window(self, alias: str, func, partition_by=None,
+                    order_by=None, frame=("rows", None, 0)) -> "DataFrame":
+        """Add a window-function column (functions.window helpers)."""
+        pb = [_to_expr(c, self.schema) for c in (partition_by or [])]
+        ob = []
+        for c in (order_by or []):
+            if isinstance(c, L.SortOrder):
+                ob.append(L.SortOrder(_resolve(c.expr, self.schema),
+                                      c.ascending, c.nulls_first))
+            else:
+                ob.append(L.SortOrder(_to_expr(c, self.schema)))
+        f = func.expr if isinstance(func, Col) else func
+        f = _resolve(f, self.schema)
+        spec = L.WindowSpec(pb, ob, frame)
+        wf = L.WindowFunc(f, spec, alias)
+        return DataFrame(L.Window([wf], self._plan), self.session)
+
+    # -- actions -------------------------------------------------------------
+    def collect(self) -> List[tuple]:
+        t = self.session.execute_to_arrow(self._plan)
+        cols = [t.column(i).to_pylist() for i in range(t.num_columns)]
+        return list(zip(*cols)) if cols else []
+
+    def to_arrow(self) -> pa.Table:
+        return self.session.execute_to_arrow(self._plan)
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    toPandas = to_pandas
+
+    def count(self) -> int:
+        agg = L.Aggregate([], [L.AggExpr(eagg.Count(), "count")], self._plan)
+        t = self.session.execute_to_arrow(agg)
+        return t.column(0)[0].as_py()
+
+    def show(self, n: int = 20):
+        t = self.limit(n).to_arrow()
+        print(t.to_pandas().to_string())
+
+    def explain(self, extended: bool = False):
+        print(self.session.explain(self._plan))
+
+    def first(self):
+        rows = self.limit(1).collect()
+        return rows[0] if rows else None
+
+    def head(self, n: int = 1):
+        return self.limit(n).collect()
+
+    def take(self, n: int):
+        return self.limit(n).collect()
+
+    @property
+    def write(self):
+        from .reader import DataFrameWriter
+        return DataFrameWriter(self)
+
+    def cache(self) -> "DataFrame":
+        """Materialize once into an in-memory relation (cache-serializer
+
+        role; reference: ParquetCachedBatchSerializer)."""
+        t = self.session.execute_to_arrow(self._plan)
+        return DataFrame(L.LocalRelation(t), self.session)
+
+    persist = cache
+
+
+def _extract_equi_keys(cond: ec.Expression, lschema: Schema,
+                       rschema: Schema):
+    """Split a join condition into equi-key pairs + residual."""
+    from ..expr import predicates as ep
+    conjuncts: List[ec.Expression] = []
+
+    def flatten(e):
+        if isinstance(e, ep.And):
+            flatten(e.children[0])
+            flatten(e.children[1])
+        else:
+            conjuncts.append(e)
+    flatten(cond)
+    lkeys, rkeys, residual = [], [], []
+    lnames = set(lschema.names)
+    rnames = set(rschema.names)
+    for c in conjuncts:
+        if isinstance(c, ep.EqualTo):
+            a, b = c.children
+            an = _ref_names(a)
+            bn = _ref_names(b)
+            if an and bn and an <= lnames and bn <= rnames:
+                lkeys.append(_resolve(a, lschema))
+                rkeys.append(_resolve(b, rschema))
+                continue
+            if an and bn and an <= rnames and bn <= lnames:
+                lkeys.append(_resolve(b, lschema))
+                rkeys.append(_resolve(a, rschema))
+                continue
+        residual.append(c)
+    res: Optional[ec.Expression] = None
+    for r in residual:
+        res = r if res is None else ep.And(res, r)
+    return lkeys, rkeys, res
+
+
+def _ref_names(e: ec.Expression) -> set:
+    return {x.col_name for x in e.collect(
+        lambda n: isinstance(n, ec.AttributeReference))}
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, keys: List[ec.Expression]):
+        self.df = df
+        self.keys = keys
+
+    def agg(self, *aggs, **named) -> DataFrame:
+        agg_exprs: List[L.AggExpr] = []
+        schema = self.df.schema
+        for a in aggs:
+            e = a.expr if isinstance(a, Col) else a
+            alias = None
+            if isinstance(e, ec.Alias):
+                alias = e.alias
+                e = e.children[0]
+            e = _resolve(e, schema)
+            assert isinstance(e, eagg.AggregateFunction), \
+                f"agg() requires aggregate functions, got {e!r}"
+            agg_exprs.append(L.AggExpr(e, alias or repr(e)))
+        for alias, a in named.items():
+            e = a.expr if isinstance(a, Col) else a
+            if isinstance(e, ec.Alias):
+                e = e.children[0]
+            e = _resolve(e, schema)
+            agg_exprs.append(L.AggExpr(e, alias))
+        return DataFrame(L.Aggregate(self.keys, agg_exprs, self.df._plan),
+                         self.df.session)
+
+    def count(self) -> DataFrame:
+        return self.agg(count=Col(eagg.Count()))
+
+    def _simple(self, fn, cols) -> DataFrame:
+        schema = self.df.schema
+        targets = cols or [f.name for f in schema if f.dtype.is_numeric]
+        aggs = []
+        for c in targets:
+            e = _to_expr(c, schema)
+            aggs.append(Col(ec.Alias(fn(e), f"{fn.__name__.lower()}({c})")))
+        return self.agg(*aggs)
+
+    def sum(self, *cols) -> DataFrame:
+        return self._simple(eagg.Sum, cols)
+
+    def min(self, *cols) -> DataFrame:
+        return self._simple(eagg.Min, cols)
+
+    def max(self, *cols) -> DataFrame:
+        return self._simple(eagg.Max, cols)
+
+    def avg(self, *cols) -> DataFrame:
+        return self._simple(eagg.Average, cols)
+
+    mean = avg

@@ -1,0 +1,249 @@
+"""functions — PySpark-style functions module (col, lit, sum, when, ...)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..columnar import dtypes as T
+from ..expr import core as ec
+from ..expr import (aggregates as eagg, arithmetic as ea, cast as ecast,
+                    conditional as econd, datetime as edt, misc as emisc,
+                    predicates as ep, string_ops as es, window_funcs as wf)
+from .column import Col, _expr
+
+
+def col(name: str) -> Col:
+    return Col(ec.AttributeReference(name))
+
+
+column = col
+
+
+def lit(v) -> Col:
+    return Col(ec.Literal(v)) if not isinstance(v, Col) else v
+
+
+def expr_col(e: ec.Expression) -> Col:
+    return Col(e)
+
+
+# -- aggregates ---------------------------------------------------------------
+
+def sum(c) -> Col:  # noqa: A001
+    return Col(eagg.Sum(_expr(c if not isinstance(c, str) else col(c))))
+
+
+def count(c="*") -> Col:
+    if c == "*":
+        return Col(eagg.Count())
+    return Col(eagg.Count(_expr(c if not isinstance(c, str) else col(c))))
+
+
+def min(c) -> Col:  # noqa: A001
+    return Col(eagg.Min(_expr(c if not isinstance(c, str) else col(c))))
+
+
+def max(c) -> Col:  # noqa: A001
+    return Col(eagg.Max(_expr(c if not isinstance(c, str) else col(c))))
+
+
+def avg(c) -> Col:
+    return Col(eagg.Average(_expr(c if not isinstance(c, str) else col(c))))
+
+
+mean = avg
+
+
+def first(c, ignore_nulls: bool = True) -> Col:
+    return Col(eagg.First(_expr(c if not isinstance(c, str) else col(c)),
+                          ignore_nulls))
+
+
+def last(c, ignore_nulls: bool = True) -> Col:
+    return Col(eagg.Last(_expr(c if not isinstance(c, str) else col(c)),
+                         ignore_nulls))
+
+
+def count_distinct(c) -> Col:
+    raise NotImplementedError("count_distinct lands with distinct-agg support")
+
+
+# -- conditional --------------------------------------------------------------
+
+class WhenBuilder(Col):
+    def __init__(self, branches):
+        self._branches = branches
+        super().__init__(econd.CaseWhen(branches, None))
+
+    def when(self, cond, value) -> "WhenBuilder":
+        return WhenBuilder(self._branches + [(_expr(cond), _expr(value))])
+
+    def otherwise(self, value) -> Col:
+        return Col(econd.CaseWhen(self._branches, _expr(value)))
+
+
+def when(cond, value) -> WhenBuilder:
+    return WhenBuilder([(_expr(cond), _expr(value))])
+
+
+def coalesce(*cols) -> Col:
+    return Col(econd.Coalesce(*[_expr(c if not isinstance(c, str)
+                                      else col(c)) for c in cols]))
+
+
+def isnull(c) -> Col:
+    return Col(ep.IsNull(_expr(c if not isinstance(c, str) else col(c))))
+
+
+def isnan(c) -> Col:
+    return Col(ep.IsNaN(_expr(c if not isinstance(c, str) else col(c))))
+
+
+def nanvl(a, b) -> Col:
+    return Col(econd.NaNvl(_expr(a), _expr(b)))
+
+
+# -- math ---------------------------------------------------------------------
+
+def _u(cls):
+    def f(c):
+        return Col(cls(_expr(c if not isinstance(c, str) else col(c))))
+    f.__name__ = cls.__name__.lower()
+    return f
+
+
+sqrt = _u(ea.Sqrt)
+exp = _u(ea.Exp)
+log = _u(ea.Log)
+log2 = _u(ea.Log2)
+log10 = _u(ea.Log10)
+sin = _u(ea.Sin)
+cos = _u(ea.Cos)
+tan = _u(ea.Tan)
+asin = _u(ea.Asin)
+acos = _u(ea.Acos)
+atan = _u(ea.Atan)
+floor = _u(ea.Floor)
+ceil = _u(ea.Ceil)
+abs = _u(ea.Abs)  # noqa: A001
+signum = _u(ea.Signum)
+degrees = _u(ea.ToDegrees)
+radians = _u(ea.ToRadians)
+
+
+def round(c, scale: int = 0) -> Col:  # noqa: A001
+    return Col(ea.Round(_expr(c if not isinstance(c, str) else col(c)),
+                        scale))
+
+
+def pow(a, b) -> Col:  # noqa: A001
+    return Col(ea.Pow(_expr(a), _expr(b)))
+
+
+def greatest(*cols) -> Col:
+    return Col(ea.Greatest(*[_expr(c if not isinstance(c, str) else col(c))
+                             for c in cols]))
+
+
+def least(*cols) -> Col:
+    return Col(ea.Least(*[_expr(c if not isinstance(c, str) else col(c))
+                          for c in cols]))
+
+
+# -- strings ------------------------------------------------------------------
+
+upper = _u(es.Upper)
+lower = _u(es.Lower)
+length = _u(es.Length)
+trim = _u(es.StringTrim)
+ltrim = _u(es.StringTrimLeft)
+rtrim = _u(es.StringTrimRight)
+
+
+def substring(c, pos: int, length_: int) -> Col:
+    return Col(es.Substring(_expr(c if not isinstance(c, str) else col(c)),
+                            ec.Literal(pos), ec.Literal(length_)))
+
+
+def concat(*cols) -> Col:
+    return Col(es.ConcatStrings(
+        *[_expr(c if not isinstance(c, str) else col(c)) for c in cols]))
+
+
+def md5(c) -> Col:
+    return Col(emisc.Md5(_expr(c if not isinstance(c, str) else col(c))))
+
+
+# -- datetime -----------------------------------------------------------------
+
+year = _u(edt.Year)
+month = _u(edt.Month)
+dayofmonth = _u(edt.DayOfMonth)
+quarter = _u(edt.Quarter)
+dayofweek = _u(edt.DayOfWeek)
+weekday = _u(edt.WeekDay)
+dayofyear = _u(edt.DayOfYear)
+hour = _u(edt.Hour)
+minute = _u(edt.Minute)
+second = _u(edt.Second)
+last_day = _u(edt.LastDay)
+to_date = _u(edt.ToDate)
+
+
+def date_add(c, days: int) -> Col:
+    return Col(edt.DateAdd(_expr(c if not isinstance(c, str) else col(c)),
+                           _expr(days)))
+
+
+def date_sub(c, days: int) -> Col:
+    return Col(edt.DateSub(_expr(c if not isinstance(c, str) else col(c)),
+                           _expr(days)))
+
+
+def datediff(end, start) -> Col:
+    return Col(edt.DateDiff(_expr(end if not isinstance(end, str)
+                                  else col(end)),
+                            _expr(start if not isinstance(start, str)
+                                  else col(start))))
+
+
+# -- misc ---------------------------------------------------------------------
+
+def hash(*cols) -> Col:  # noqa: A001
+    return Col(emisc.Murmur3Hash(
+        *[_expr(c if not isinstance(c, str) else col(c)) for c in cols]))
+
+
+def monotonically_increasing_id() -> Col:
+    return Col(emisc.MonotonicallyIncreasingID())
+
+
+def spark_partition_id() -> Col:
+    return Col(emisc.SparkPartitionID())
+
+
+def rand(seed: int = 0) -> Col:
+    return Col(emisc.Rand(seed))
+
+
+# -- window functions ---------------------------------------------------------
+
+def row_number() -> Col:
+    return Col(wf.RowNumber())
+
+
+def rank() -> Col:
+    return Col(wf.Rank())
+
+
+def dense_rank() -> Col:
+    return Col(wf.DenseRank())
+
+
+def lead(c, offset: int = 1) -> Col:
+    return Col(wf.Lead(_expr(c if not isinstance(c, str) else col(c)),
+                       offset))
+
+
+def lag(c, offset: int = 1) -> Col:
+    return Col(wf.Lag(_expr(c if not isinstance(c, str) else col(c)),
+                      offset))

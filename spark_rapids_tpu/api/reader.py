@@ -1,0 +1,81 @@
+"""DataFrameReader / DataFrameWriter — spark.read / df.write surface."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from ..columnar.schema import Schema
+from ..plan import logical as L
+
+
+class DataFrameReader:
+    def __init__(self, session):
+        self.session = session
+        self._options: Dict[str, object] = {}
+        self._schema: Optional[Schema] = None
+        self._format: str = "parquet"
+
+    def format(self, fmt: str) -> "DataFrameReader":  # noqa: A003
+        self._format = fmt
+        return self
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def schema(self, schema: Schema) -> "DataFrameReader":
+        self._schema = schema
+        return self
+
+    def load(self, path: Union[str, List[str]]):
+        from .dataframe import DataFrame
+        from ..io.readers import infer_schema
+        paths = [path] if isinstance(path, str) else list(path)
+        schema = self._schema or infer_schema(self._format, paths,
+                                              self._options)
+        return DataFrame(
+            L.Scan(self._format, paths, schema, self._options), self.session)
+
+    def parquet(self, *paths: str):
+        return self.format("parquet").load(list(paths))
+
+    def orc(self, *paths: str):
+        return self.format("orc").load(list(paths))
+
+    def csv(self, path, header: bool = True, sep: str = ","):
+        return (self.format("csv").option("header", header)
+                .option("sep", sep).load(path))
+
+    def json(self, path):
+        return self.format("json").load(path)
+
+
+class DataFrameWriter:
+    def __init__(self, df):
+        self.df = df
+        self._mode = "overwrite"
+        self._options: Dict[str, object] = {}
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m
+        return self
+
+    def option(self, key: str, value) -> "DataFrameWriter":
+        self._options[key] = value
+        return self
+
+    def _write(self, fmt: str, path: str):
+        plan = L.WriteFile(fmt, path, self.df._plan, self._mode,
+                           self._options)
+        phys = self.df.session._plan(plan)
+        for part in phys.execute():
+            for _ in part:
+                pass
+
+    def parquet(self, path: str):
+        self._write("parquet", path)
+
+    def orc(self, path: str):
+        self._write("orc", path)
+
+    def csv(self, path: str):
+        self._write("csv", path)

@@ -1,0 +1,134 @@
+"""TpuSession — the user entry point.
+
+Role parity: in the reference, users keep their SparkSession and the
+plugin hooks in via ``spark.plugins=com.nvidia.spark.SQLPlugin``
+(Plugin.scala:57).  Standalone, TpuSession plays both roles: it owns the
+conf, initializes the device (executor-plugin init, Plugin.scala:175 ->
+GpuDeviceManager), and runs the planner on every action.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from ..config import TpuConf, set_active, SQL_ENABLED
+from ..columnar.schema import Schema
+from ..memory.arena import DeviceManager
+from ..plan import logical as L
+from ..plan.overrides import Planner
+
+
+class TpuSessionBuilder:
+    def __init__(self):
+        self._conf: Dict[str, object] = {}
+
+    def config(self, key: str, value) -> "TpuSessionBuilder":
+        self._conf[key] = value
+        return self
+
+    def get_or_create(self) -> "TpuSession":
+        return TpuSession(TpuConf(self._conf))
+
+
+class TpuSession:
+    _active: Optional["TpuSession"] = None
+
+    def __init__(self, conf: Optional[TpuConf] = None):
+        self.conf = conf or TpuConf()
+        set_active(self.conf)
+        DeviceManager.initialize(self.conf)
+        TpuSession._active = self
+        self._last_planner: Optional[Planner] = None
+
+    builder = TpuSessionBuilder
+
+    @classmethod
+    def active(cls) -> "TpuSession":
+        if cls._active is None:
+            cls._active = TpuSession()
+        return cls._active
+
+    # -- conf ----------------------------------------------------------------
+    def set_conf(self, key: str, value):
+        self.conf = self.conf.set(key, value)
+        set_active(self.conf)
+
+    def get_conf(self, key: str):
+        return self.conf.get_key(key)
+
+    # -- data sources --------------------------------------------------------
+    def create_dataframe(self, data, schema: Optional[Schema] = None,
+                         num_partitions: int = 1):
+        from .dataframe import DataFrame
+        if isinstance(data, pa.Table):
+            table = data
+        elif isinstance(data, dict):
+            from ..columnar.batch import ColumnarBatch
+            from ..columnar.arrow import to_arrow
+            batch = ColumnarBatch.from_pydict(data, schema=schema)
+            table = to_arrow(batch)
+        elif isinstance(data, list):
+            # list of tuples + schema
+            assert schema is not None, "list data requires a schema"
+            cols = {f.name: [row[i] for row in data]
+                    for i, f in enumerate(schema)}
+            from ..columnar.batch import ColumnarBatch
+            from ..columnar.arrow import to_arrow
+            batch = ColumnarBatch.from_pydict(cols, schema=schema)
+            table = to_arrow(batch)
+        else:
+            raise TypeError(f"cannot create dataframe from {type(data)}")
+        return DataFrame(L.LocalRelation(table, num_partitions), self)
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_partitions: int = 1):
+        from .dataframe import DataFrame
+        if end is None:
+            start, end = 0, start
+        return DataFrame(L.Range(start, end, step, num_partitions), self)
+
+    @property
+    def read(self):
+        from .reader import DataFrameReader
+        return DataFrameReader(self)
+
+    # -- execution -----------------------------------------------------------
+    def _plan(self, logical: L.LogicalPlan):
+        planner = Planner(self.conf)
+        self._last_planner = planner
+        return planner.plan(logical)
+
+    def execute_to_arrow(self, logical: L.LogicalPlan) -> pa.Table:
+        """Run a logical plan and collect everything as one arrow table."""
+        from ..columnar.arrow import to_arrow, schema_to_arrow
+        phys = self._plan(logical)
+        tables: List[pa.Table] = []
+        for part in phys.execute():
+            for item in part:
+                t = item if isinstance(item, pa.Table) else to_arrow(item)
+                if t.num_rows:
+                    tables.append(t)
+        target = schema_to_arrow(phys.output_schema) if len(
+            phys.output_schema) else None
+        if not tables:
+            return target.empty_table() if target is not None else \
+                pa.table({})
+        out = pa.concat_tables(tables, promote_options="permissive")
+        if target is not None and out.schema != target:
+            import pyarrow.compute as pc
+            out = pa.Table.from_arrays(
+                [pc.cast(out.column(i).combine_chunks(), f.type, safe=False)
+                 for i, f in enumerate(target)], schema=target)
+        return out
+
+    def explain(self, logical: L.LogicalPlan) -> str:
+        """Planner explain: physical tree + fallback reasons."""
+        phys = self._plan(logical)
+        text = phys.tree_string()
+        if self._last_planner.fallbacks:
+            text += "\n-- CPU fallbacks --\n" + "\n".join(
+                self._last_planner.fallbacks)
+        return text

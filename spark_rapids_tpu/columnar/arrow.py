@@ -1,0 +1,145 @@
+"""Arrow interop: ColumnarBatch <-> pyarrow Table.
+
+Roles: host staging for IO (GpuParquetScan reads into host memory then
+device, SURVEY.md §2.6), the Python-UDF exchange format (reference:
+GpuArrowEvalPythonExec), and the bridge to the CPU fallback engine
+(exec/cpu.py) which executes on pyarrow compute.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from . import dtypes as T
+from .column import Column, StringColumn, bucket_capacity
+from .schema import Field, Schema
+from .batch import ColumnarBatch
+
+_TO_ARROW = {
+    T.BOOL: pa.bool_(),
+    T.INT8: pa.int8(),
+    T.INT16: pa.int16(),
+    T.INT32: pa.int32(),
+    T.INT64: pa.int64(),
+    T.FLOAT32: pa.float32(),
+    T.FLOAT64: pa.float64(),
+    T.STRING: pa.string(),
+    T.DATE: pa.date32(),
+    T.TIMESTAMP: pa.timestamp("us"),
+}
+
+
+def to_arrow_type(dt: T.DType) -> pa.DataType:
+    if isinstance(dt, T.DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    if dt in _TO_ARROW:
+        return _TO_ARROW[dt]
+    raise ValueError(f"no arrow type for {dt}")
+
+
+def from_arrow_type(at: pa.DataType) -> T.DType:
+    if pa.types.is_boolean(at):
+        return T.BOOL
+    if pa.types.is_int8(at):
+        return T.INT8
+    if pa.types.is_int16(at):
+        return T.INT16
+    if pa.types.is_int32(at):
+        return T.INT32
+    if pa.types.is_int64(at):
+        return T.INT64
+    if pa.types.is_float32(at):
+        return T.FLOAT32
+    if pa.types.is_float64(at):
+        return T.FLOAT64
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return T.STRING
+    if pa.types.is_date32(at):
+        return T.DATE
+    if pa.types.is_timestamp(at):
+        return T.TIMESTAMP
+    if pa.types.is_decimal(at):
+        if at.precision > T.DecimalType.MAX_PRECISION:
+            raise ValueError(f"decimal precision {at.precision} > 18")
+        return T.DecimalType(at.precision, at.scale)
+    raise ValueError(f"unsupported arrow type {at}")
+
+
+def schema_from_arrow(aschema: pa.Schema) -> Schema:
+    return Schema([Field(f.name, from_arrow_type(f.type), f.nullable)
+                   for f in aschema])
+
+
+def schema_to_arrow(schema: Schema) -> pa.Schema:
+    return pa.schema([pa.field(f.name, to_arrow_type(f.dtype), f.nullable)
+                      for f in schema])
+
+
+def column_to_arrow(col: Column, num_rows: int) -> pa.Array:
+    if isinstance(col, StringColumn):
+        vals, valid = col.to_numpy(num_rows)
+        return pa.array([v if ok else None for v, ok in zip(vals, valid)],
+                        type=pa.string())
+    vals, valid = col.to_numpy(num_rows)
+    mask = ~valid
+    at = to_arrow_type(col.dtype)
+    if isinstance(col.dtype, T.DecimalType):
+        from decimal import Decimal
+        scale = col.dtype.scale
+        items = [None if m else
+                 Decimal(int(v)).scaleb(-scale)
+                 for v, m in zip(vals, mask)]
+        return pa.array(items, type=at)
+    if col.dtype == T.DATE:
+        return pa.array(vals.astype("datetime64[D]"), type=at,
+                        mask=mask)
+    if col.dtype == T.TIMESTAMP:
+        return pa.array(vals.astype("datetime64[us]"), type=at, mask=mask)
+    return pa.array(vals, type=at, mask=mask)
+
+
+def to_arrow(batch: ColumnarBatch) -> pa.Table:
+    arrays = [column_to_arrow(c, batch.num_rows) for c in batch.columns]
+    return pa.Table.from_arrays(arrays, schema=schema_to_arrow(batch.schema))
+
+
+def column_from_arrow(arr: pa.ChunkedArray | pa.Array,
+                      capacity: Optional[int] = None) -> Column:
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    dt = from_arrow_type(arr.type)
+    n = len(arr)
+    cap = capacity or bucket_capacity(n)
+    if dt == T.STRING:
+        return StringColumn.from_pylist(arr.to_pylist(), capacity=cap)
+    valid_np = np.ones(n, dtype=bool) if arr.null_count == 0 else \
+        np.asarray(arr.is_valid())
+    if isinstance(dt, T.DecimalType):
+        scale = dt.scale
+        vals = np.array(
+            [int(v.scaleb(scale)) if v is not None else 0
+             for v in arr.to_pylist()], dtype=np.int64)
+    elif dt == T.DATE:
+        vals = np.asarray(arr.cast(pa.int32()).fill_null(0))
+    elif dt == T.TIMESTAMP:
+        vals = np.asarray(arr.cast(pa.int64()).fill_null(0))
+    elif dt == T.BOOL:
+        vals = np.asarray(arr.fill_null(False))
+    else:
+        vals = np.asarray(arr.fill_null(0))
+    col = Column.from_numpy(vals.astype(dt.np_dtype), dtype=dt, capacity=cap)
+    import jax.numpy as jnp
+    pad = np.zeros(cap, dtype=bool)
+    pad[:n] = valid_np
+    return Column(dt, col.data, jnp.asarray(pad))
+
+
+def from_arrow(table: pa.Table, capacity: Optional[int] = None
+               ) -> ColumnarBatch:
+    n = table.num_rows
+    cap = capacity or bucket_capacity(n)
+    cols = [column_from_arrow(table.column(i), capacity=cap)
+            for i in range(table.num_columns)]
+    return ColumnarBatch(schema_from_arrow(table.schema), cols, n)

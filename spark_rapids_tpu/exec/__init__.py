@@ -1,0 +1,1 @@
+"""Physical operators: TPU columnar execs + CPU fallback engine."""

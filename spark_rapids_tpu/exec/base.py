@@ -1,0 +1,132 @@
+"""Physical operator base — the GpuExec role (reference: GpuExec.scala:168).
+
+Contract: ``execute() -> List[Iterator[...]]`` (one lazy iterator per
+partition).  TPU operators stream ColumnarBatch; CPU fallback operators
+stream pa.Table.  ``columnar`` distinguishes them and the planner inserts
+RowToColumnar/ColumnarToRow transitions exactly like
+GpuTransitionOverrides (GpuTransitionOverrides.scala:40).
+
+Metrics: every node carries leveled metrics (ESSENTIAL/MODERATE/DEBUG),
+mirroring GpuMetric (GpuExec.scala:27-237).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List
+
+from ..columnar.schema import Schema
+
+ESSENTIAL, MODERATE, DEBUG = "ESSENTIAL", "MODERATE", "DEBUG"
+
+# standard metric names (reference: GpuExec.scala:40-95)
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+OP_TIME = "opTime"
+CONCAT_TIME = "concatTime"
+SORT_TIME = "sortTime"
+AGG_TIME = "computeAggTime"
+JOIN_TIME = "joinTime"
+BUILD_TIME = "buildTime"
+PARTITION_TIME = "partitionTime"
+SPILL_BYTES = "spillData"
+
+
+class Metric:
+    __slots__ = ("name", "level", "value")
+
+    def __init__(self, name: str, level: str = MODERATE):
+        self.name = name
+        self.level = level
+        self.value = 0
+
+    def add(self, v):
+        self.value += v
+
+    def __iadd__(self, v):
+        self.value += v
+        return self
+
+    def __repr__(self):
+        return f"{self.name}={self.value}"
+
+
+class MetricSet:
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+
+    def get(self, name: str, level: str = MODERATE) -> Metric:
+        if name not in self._metrics:
+            self._metrics[name] = Metric(name, level)
+        return self._metrics[name]
+
+    def __getitem__(self, name):
+        return self.get(name)
+
+    def __setitem__(self, name, value):
+        # supports `metrics[X] += n` (Metric.__iadd__ returns the Metric)
+        assert isinstance(value, Metric)
+        self._metrics[name] = value
+
+    def snapshot(self, level: str = DEBUG) -> Dict[str, int]:
+        rank = {ESSENTIAL: 0, MODERATE: 1, DEBUG: 2}
+        mx = rank[level]
+        return {m.name: m.value for m in self._metrics.values()
+                if rank[m.level] <= mx}
+
+
+class timed:
+    """Context manager adding elapsed ns to a metric (NvtxWithMetrics role)."""
+
+    def __init__(self, metric: Metric):
+        self.metric = metric
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *a):
+        self.metric.add(time.perf_counter_ns() - self.t0)
+        return False
+
+
+class PhysicalPlan:
+    columnar = True  # True: yields ColumnarBatch; False: pa.Table
+
+    def __init__(self, *children: "PhysicalPlan"):
+        self.children = list(children)
+        self.metrics = MetricSet()
+
+    @property
+    def output_schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def execute(self) -> List[Iterator]:
+        raise NotImplementedError
+
+    def num_partitions_hint(self) -> int:
+        if self.children:
+            return self.children[0].num_partitions_hint()
+        return 1
+
+    def tree_string(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        s = f"{pad}{self._node_string()}"
+        for c in self.children:
+            s += "\n" + c.tree_string(indent + 1)
+        return s
+
+    def _node_string(self):
+        return self.name
+
+    def collect_nodes(self) -> List["PhysicalPlan"]:
+        out = [self]
+        for c in self.children:
+            out.extend(c.collect_nodes())
+        return out
+
+    def __repr__(self):
+        return self.tree_string()

@@ -1,0 +1,555 @@
+"""CPU fallback physical operators over pyarrow — the "stock Spark" role.
+
+In the reference, anything not tagged for GPU stays a stock Spark CPU
+operator.  This standalone framework supplies its own CPU engine: each
+operator consumes/produces pa.Table chunks using pyarrow compute, with the
+same partitioned execution model as the TPU operators.  It doubles as the
+oracle engine for the equality test harness (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..columnar.schema import Schema
+from ..columnar.arrow import schema_from_arrow, schema_to_arrow
+from ..expr import core as ec
+from ..expr import aggregates as eagg
+from ..expr.cpu_eval import cpu_eval, _arr
+from ..plan import logical as L
+from .base import PhysicalPlan, NUM_OUTPUT_ROWS
+
+
+def _concat_tables(tables: List[pa.Table], schema: pa.Schema) -> pa.Table:
+    tables = [t for t in tables if t.num_rows >= 0]
+    if not tables:
+        return schema.empty_table()
+    return pa.concat_tables(tables, promote_options="permissive") \
+        if len(tables) > 1 else tables[0]
+
+
+class CpuExec(PhysicalPlan):
+    columnar = False
+
+
+class CpuLocalScan(CpuExec):
+    def __init__(self, table: pa.Table, num_partitions: int = 1):
+        super().__init__()
+        self.table = table
+        self.num_partitions = max(1, num_partitions)
+
+    @property
+    def output_schema(self):
+        return schema_from_arrow(self.table.schema)
+
+    def num_partitions_hint(self):
+        return self.num_partitions
+
+    def execute(self):
+        n = self.table.num_rows
+        per = -(-n // self.num_partitions) if n else 0
+        parts = []
+        for i in range(self.num_partitions):
+            lo = min(i * per, n)
+            hi = min(lo + per, n)
+            chunk = self.table.slice(lo, hi - lo)
+            parts.append(iter([chunk]))
+        return parts
+
+
+class CpuRange(CpuExec):
+    def __init__(self, start, end, step, num_partitions):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = max(1, num_partitions)
+
+    @property
+    def output_schema(self):
+        from ..columnar import dtypes as T
+        from ..columnar.schema import Field
+        return Schema([Field("id", T.INT64, False)])
+
+    def num_partitions_hint(self):
+        return self.num_partitions
+
+    def execute(self):
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self.num_partitions) if total else 0
+        parts = []
+        for i in range(self.num_partitions):
+            lo, hi = i * per, min((i + 1) * per, total)
+            vals = np.arange(self.start + lo * self.step,
+                             self.start + hi * self.step, self.step,
+                             dtype=np.int64) if hi > lo else \
+                np.zeros(0, np.int64)
+            parts.append(iter([pa.table({"id": vals})]))
+        return parts
+
+
+class CpuProject(CpuExec):
+    def __init__(self, exprs: List[ec.Expression], child: PhysicalPlan):
+        super().__init__(child)
+        self.exprs = exprs
+
+    @property
+    def output_schema(self):
+        from ..columnar.schema import Field
+        return Schema([Field(ec.output_name(e), e.dtype(), e.nullable)
+                       for e in self.exprs])
+
+    def execute(self):
+        out_schema = schema_to_arrow(self.output_schema)
+
+        def run(part):
+            for t in part:
+                arrays = []
+                for e, f in zip(self.exprs, out_schema):
+                    v = _arr(cpu_eval(e, t), t.num_rows)
+                    if isinstance(v, pa.ChunkedArray):
+                        v = v.combine_chunks()
+                    if v.type != f.type:
+                        v = pc.cast(v, f.type, safe=False)
+                    arrays.append(v)
+                self.metrics[NUM_OUTPUT_ROWS] += t.num_rows
+                yield pa.Table.from_arrays(arrays, schema=out_schema)
+        return [run(p) for p in self.children[0].execute()]
+
+
+class CpuFilter(CpuExec):
+    def __init__(self, condition: ec.Expression, child: PhysicalPlan):
+        super().__init__(child)
+        self.condition = condition
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def execute(self):
+        def run(part):
+            for t in part:
+                mask = pc.coalesce(
+                    pc.cast(_arr(cpu_eval(self.condition, t), t.num_rows),
+                            pa.bool_()),
+                    pa.scalar(False))
+                out = t.filter(mask)
+                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                yield out
+        return [run(p) for p in self.children[0].execute()]
+
+
+_F64_SIGN = np.uint64(0x8000000000000000)
+
+
+def _np_float_encode(arr: pa.Array) -> pa.Array:
+    """Spark float total order as uint64 (NaN greatest, -0.0 == 0.0)."""
+    a = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    vals = np.asarray(a.cast(pa.float64()).fill_null(0.0), dtype=np.float64)
+    vals = np.where(vals == 0.0, 0.0, vals)
+    bits = vals.view(np.uint64)
+    neg = (bits & _F64_SIGN) != 0
+    enc = np.where(neg, ~bits, bits | _F64_SIGN)
+    mask = None if a.null_count == 0 else np.asarray(
+        pc.is_null(a))
+    return pa.array(enc, pa.uint64(), mask=mask)
+
+
+def _np_float_decode(arr, out_type: pa.DataType) -> pa.Array:
+    a = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    enc = np.asarray(a.fill_null(0), dtype=np.uint64)
+    neg = (enc & _F64_SIGN) == 0
+    bits = np.where(neg, ~enc, enc & ~_F64_SIGN)
+    vals = bits.view(np.float64)
+    mask = None if a.null_count == 0 else np.asarray(pc.is_null(a))
+    return pa.array(vals, pa.float64(), mask=mask).cast(out_type)
+
+
+def _agg_arrow(func: eagg.AggregateFunction, table: pa.Table,
+               group_names: List[str], alias: str):
+    """Build (input column, arrow agg name, array, decode_float)."""
+    if isinstance(func, eagg.Count) and not func.children:
+        return (group_names[0] if group_names else table.column_names[0],
+                "count_all", None, False)
+    child = func.children[0]
+    colname = f"__agg_in_{alias}"
+    arr = _arr(cpu_eval(child, table), table.num_rows)
+    kind = {
+        eagg.Sum: "sum", eagg.Count: "count", eagg.Min: "min",
+        eagg.Max: "max", eagg.Average: "mean",
+        eagg.First: "first", eagg.Last: "last",
+    }[type(func)]
+    decode = False
+    at = arr.type if not isinstance(arr, pa.ChunkedArray) else arr.type
+    if kind in ("min", "max") and pa.types.is_floating(at):
+        arr = _np_float_encode(arr)
+        decode = True
+    return colname, kind, arr, decode
+
+
+class CpuAggregate(CpuExec):
+    """Whole-input aggregation (single partition input) via pa group_by."""
+
+    def __init__(self, group_exprs, aggs: List[L.AggExpr],
+                 child: PhysicalPlan):
+        super().__init__(child)
+        self.group_exprs = group_exprs
+        self.aggs = aggs
+
+    @property
+    def output_schema(self):
+        from ..columnar.schema import Field
+        fields = [Field(ec.output_name(e), e.dtype(), e.nullable)
+                  for e in self.group_exprs]
+        fields += [Field(a.alias, a.func.dtype(), a.func.nullable)
+                   for a in self.aggs]
+        return Schema(fields)
+
+    def num_partitions_hint(self):
+        return 1
+
+    def execute(self):
+        child_parts = self.children[0].execute()
+        child_schema = schema_to_arrow(self.children[0].output_schema)
+
+        def run():
+            tables = [t for p in child_parts for t in p]
+            t = _concat_tables(tables, child_schema)
+            yield self._aggregate(t)
+        return [run()]
+
+    def _aggregate(self, t: pa.Table) -> pa.Table:
+        out_schema = schema_to_arrow(self.output_schema)
+        group_names = []
+        work = t
+        for i, e in enumerate(self.group_exprs):
+            name = f"__key_{i}"
+            arr = _arr(cpu_eval(e, t), t.num_rows)
+            work = work.append_column(name, arr)
+            group_names.append(name)
+        agg_specs = []
+        decodes = []
+        for a in self.aggs:
+            colname, kind, arr, decode = _agg_arrow(a.func, t, group_names,
+                                                    a.alias)
+            decodes.append(decode)
+            if arr is not None:
+                work = work.append_column(colname, arr)
+            if kind == "count_all":
+                agg_specs.append(([], "count_all"))
+            else:
+                agg_specs.append((colname, kind))
+        if group_names:
+            gb = pa.TableGroupBy(work, group_names, use_threads=False)
+            res = gb.aggregate(agg_specs)
+            cols = []
+            for i, e in enumerate(self.group_exprs):
+                cols.append(res.column(f"__key_{i}"))
+            for (colname, kind), a, decode in zip(
+                    [(c if not isinstance(c, list) else "", k)
+                     for c, k in agg_specs], self.aggs, decodes):
+                res_name = "count_all" if kind == "count_all" else \
+                    f"{colname}_{kind}"
+                c = res.column(res_name)
+                if decode:
+                    c = _np_float_decode(
+                        c, schema_to_arrow(Schema([])).field if False else
+                        pa.float64())
+                cols.append(c)
+            arrays = []
+            for c, f in zip(cols, out_schema):
+                c = c.combine_chunks() if isinstance(c, pa.ChunkedArray) else c
+                if c.type != f.type:
+                    c = pc.cast(c, f.type, safe=False)
+                arrays.append(c)
+            out = pa.Table.from_arrays(arrays, schema=out_schema)
+        else:
+            # global aggregate -> single row
+            arrays = []
+            for (colname, kind), a, f in zip(agg_specs, self.aggs,
+                                             list(out_schema)):
+                if kind == "count_all":
+                    val = pa.scalar(work.num_rows, pa.int64())
+                else:
+                    col = work.column(colname)
+                    fn = {"sum": pc.sum, "count": pc.count, "min": pc.min,
+                          "max": pc.max, "mean": pc.mean,
+                          "first": pc.first, "last": pc.last}[kind]
+                    val = fn(col)
+                    if decodes[len(arrays)]:
+                        val = _np_float_decode(
+                            pa.array([val.as_py()], pa.uint64()),
+                            pa.float64())[0]
+                arr = pa.array([val.as_py()],
+                               type=val.type if val.type != pa.null()
+                               else f.type)
+                if arr.type != f.type:
+                    arr = pc.cast(arr, f.type, safe=False)
+                arrays.append(arr)
+            out = pa.Table.from_arrays(arrays, schema=out_schema)
+        self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+        return out
+
+
+class CpuJoin(CpuExec):
+    def __init__(self, logical: L.Join, left: PhysicalPlan,
+                 right: PhysicalPlan):
+        super().__init__(left, right)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def num_partitions_hint(self):
+        return 1
+
+    def execute(self):
+        lg = self.logical
+        lparts = self.children[0].execute()
+        rparts = self.children[1].execute()
+        lschema = schema_to_arrow(self.children[0].output_schema)
+        rschema = schema_to_arrow(self.children[1].output_schema)
+
+        def run():
+            lt = _concat_tables([t for p in lparts for t in p], lschema)
+            rt = _concat_tables([t for p in rparts for t in p], rschema)
+            yield self._join(lt, rt)
+        return [run()]
+
+    def _join(self, lt: pa.Table, rt: pa.Table) -> pa.Table:
+        lg = self.logical
+        out_schema = schema_to_arrow(self.output_schema)
+        if lg.join_type == "cross":
+            # cross via dummy constant keys
+            lk = lt.append_column("__ck", pa.array([1] * lt.num_rows))
+            rk = rt.append_column("__ck", pa.array([1] * rt.num_rows))
+            res = lk.join(rk, keys=["__ck"], join_type="inner",
+                          use_threads=False)
+            res = res.drop_columns(["__ck"])
+            return self._finish(res, out_schema)
+        lkeys, rkeys = [], []
+        lwork, rwork = lt, rt
+        for i, (le, re) in enumerate(zip(lg.left_keys, lg.right_keys)):
+            lname, rname = f"__lk_{i}", f"__rk_{i}"
+            lwork = lwork.append_column(lname,
+                                        _arr(cpu_eval(le, lt), lt.num_rows))
+            rwork = rwork.append_column(rname,
+                                        _arr(cpu_eval(re, rt), rt.num_rows))
+            lkeys.append(lname)
+            rkeys.append(rname)
+        jt = {"inner": "inner", "left": "left outer", "right": "right outer",
+              "full": "full outer", "semi": "left semi",
+              "anti": "left anti"}[lg.join_type]
+        res = lwork.join(rwork, keys=lkeys, right_keys=rkeys, join_type=jt,
+                         use_threads=False,
+                         coalesce_keys=False)
+        drop = [c for c in res.column_names if c.startswith("__lk_")
+                or c.startswith("__rk_")]
+        res = res.drop_columns(drop)
+        return self._finish(res, out_schema)
+
+    def _finish(self, res: pa.Table, out_schema: pa.Schema) -> pa.Table:
+        # positional mapping (duplicate column names are legal post-join)
+        assert res.num_columns == len(out_schema), \
+            f"join output width {res.num_columns} != {len(out_schema)}"
+        arrays = []
+        for i, f in enumerate(out_schema):
+            c = res.column(i).combine_chunks()
+            if c.type != f.type:
+                c = pc.cast(c, f.type, safe=False)
+            arrays.append(c)
+        out = pa.Table.from_arrays(arrays, schema=out_schema)
+        self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+        return out
+
+
+class CpuSort(CpuExec):
+    def __init__(self, orders: List[L.SortOrder], child: PhysicalPlan,
+                 is_global: bool = True):
+        super().__init__(child)
+        self.orders = orders
+        self.is_global = is_global
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return 1 if self.is_global else self.children[0].num_partitions_hint()
+
+    def execute(self):
+        child_schema = schema_to_arrow(self.children[0].output_schema)
+
+        def sort_table(t: pa.Table) -> pa.Table:
+            work = t
+            keys = []
+            for i, o in enumerate(self.orders):
+                name = f"__sort_{i}"
+                arr = _arr(cpu_eval(o.expr, t), t.num_rows)
+                at = arr.type
+                if pa.types.is_floating(at):
+                    # Spark float total order (NaN greatest); pyarrow groups
+                    # NaN with nulls under at_start placement
+                    arr = _np_float_encode(arr)
+                work = work.append_column(name, arr)
+                keys.append((name,
+                             "ascending" if o.ascending else "descending",
+                             "at_start" if o.effective_nulls_first
+                             else "at_end"))
+            idx = pc.sort_indices(work, sort_keys=keys)
+            return t.take(idx)
+
+        if self.is_global:
+            parts = self.children[0].execute()
+
+            def run():
+                t = _concat_tables([t for p in parts for t in p],
+                                   child_schema)
+                yield sort_table(t)
+            return [run()]
+
+        def run_local(part):
+            t = _concat_tables(list(part), child_schema)
+            yield sort_table(t)
+        return [run_local(p) for p in self.children[0].execute()]
+
+
+class CpuLimit(CpuExec):
+    def __init__(self, n: int, child: PhysicalPlan, offset: int = 0):
+        super().__init__(child)
+        self.n = n
+        self.offset = offset
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return 1
+
+    def execute(self):
+        child_schema = schema_to_arrow(self.children[0].output_schema)
+        parts = self.children[0].execute()
+
+        def run():
+            need = self.n + self.offset
+            got: List[pa.Table] = []
+            have = 0
+            for p in parts:
+                for t in p:
+                    if have >= need:
+                        break
+                    t = t.slice(0, need - have)
+                    got.append(t)
+                    have += t.num_rows
+            out = _concat_tables(got, child_schema)
+            yield out.slice(self.offset, self.n)
+        return [run()]
+
+
+class CpuUnion(CpuExec):
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return sum(c.num_partitions_hint() for c in self.children)
+
+    def execute(self):
+        parts = []
+        target = schema_to_arrow(self.output_schema)
+        for c in self.children:
+            for p in c.execute():
+                def conv(p=p):
+                    for t in p:
+                        if t.schema != target:
+                            t = pa.Table.from_arrays(
+                                [pc.cast(t.column(i).combine_chunks(),
+                                         f.type, safe=False)
+                                 for i, f in enumerate(target)],
+                                schema=target)
+                        yield t
+                parts.append(conv())
+        return parts
+
+
+class CpuCoalescePartitions(CpuExec):
+    """Merge all partitions into one (used before global ops)."""
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__(child)
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return 1
+
+    def execute(self):
+        parts = self.children[0].execute()
+
+        def run():
+            for p in parts:
+                for t in p:
+                    yield t
+        return [run()]
+
+
+class CpuShuffleExchange(CpuExec):
+    """Hash/round-robin repartition on the CPU engine."""
+
+    def __init__(self, child: PhysicalPlan, num_partitions: int,
+                 key_exprs: Optional[List[ec.Expression]] = None):
+        super().__init__(child)
+        self.num_partitions = num_partitions
+        self.key_exprs = key_exprs
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return self.num_partitions
+
+    def execute(self):
+        child_schema = schema_to_arrow(self.children[0].output_schema)
+        in_parts = self.children[0].execute()
+        buckets: List[List[pa.Table]] = [[] for _ in
+                                         range(self.num_partitions)]
+        rr = itertools.count()
+        for p in in_parts:
+            for t in p:
+                if t.num_rows == 0:
+                    continue
+                if not self.key_exprs:
+                    buckets[next(rr) % self.num_partitions].append(t)
+                    continue
+                pids = self._partition_ids(t)
+                for pid in np.unique(pids):
+                    mask = pa.array(pids == pid)
+                    buckets[int(pid)].append(t.filter(mask))
+        return [iter([_concat_tables(b, child_schema)]) for b in buckets]
+
+    def _partition_ids(self, t: pa.Table) -> np.ndarray:
+        # must match the TPU hash partitioner exactly so mixed CPU/TPU plans
+        # agree on row placement -> reuse the device kernel on CPU jax
+        from ..columnar.arrow import from_arrow
+        from ..kernels import basic, canon
+        batch = from_arrow(t)
+        cols = []
+        word_lists = []
+        for e in self.key_exprs:
+            bound = e.bind(batch.schema)
+            col = ec.eval_as_column(bound, batch)
+            for w in canon.value_words(col, batch.num_rows):
+                import jax.numpy as jnp
+                word_lists.append(
+                    jnp.where(col.validity, w,
+                              jnp.uint64(0x9E3779B97F4A7C15)))
+        h = basic.hash_words(word_lists)
+        pids = basic.hash_to_partition(h, self.num_partitions)
+        return np.asarray(pids)[:t.num_rows]

@@ -1,0 +1,119 @@
+"""CPU window operator (pandas-backed) — fallback + oracle for
+
+window functions until the TPU window exec lands.
+Reference counterpart: stock Spark WindowExec.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+import pyarrow as pa
+
+from ..columnar.arrow import schema_to_arrow
+from ..expr import core as ec
+from ..expr.cpu_eval import cpu_eval, _arr
+from ..plan import logical as L
+from .cpu import CpuExec, _concat_tables
+
+
+class CpuWindow(CpuExec):
+    def __init__(self, logical: L.Window, child):
+        super().__init__(child)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def num_partitions_hint(self):
+        return 1
+
+    def execute(self):
+        child_schema = schema_to_arrow(self.children[0].output_schema)
+        parts = self.children[0].execute()
+
+        def run():
+            t = _concat_tables([x for p in parts for x in p], child_schema)
+            yield self._apply(t)
+        return [run()]
+
+    def _apply(self, t: pa.Table) -> pa.Table:
+        import pandas as pd
+        df = t.to_pandas()
+        out_schema = schema_to_arrow(self.output_schema)
+        for wf in self.logical.window_funcs:
+            spec = wf.spec
+            pkeys = []
+            for i, e in enumerate(spec.partition_by):
+                name = f"__wp_{i}"
+                df[name] = _arr(cpu_eval(e, t), t.num_rows).to_pandas()
+                pkeys.append(name)
+            skeys, ascs = [], []
+            for i, o in enumerate(spec.order_by):
+                name = f"__ws_{i}"
+                df[name] = _arr(cpu_eval(o.expr, t), t.num_rows).to_pandas()
+                skeys.append(name)
+                ascs.append(o.ascending)
+            work = df.sort_values(skeys, ascending=ascs, kind="stable") \
+                if skeys else df
+            grouped = work.groupby(pkeys, dropna=False, sort=False) \
+                if pkeys else work.groupby(np.zeros(len(work)))
+            fname = type(wf.func).__name__
+            from ..expr import aggregates as eagg
+            from ..expr.window_funcs import (RowNumber, Rank, DenseRank,
+                                             Lead, Lag)
+            if isinstance(wf.func, RowNumber):
+                res = grouped.cumcount() + 1
+            elif isinstance(wf.func, Rank):
+                order_col = skeys[0] if skeys else pkeys[0]
+                res = grouped[skeys].apply(
+                    lambda g: g.rank(method="min").iloc[:, 0]) \
+                    .reset_index(level=list(range(len(pkeys))), drop=True) \
+                    if pkeys else work[skeys[0]].rank(method="min")
+                res = res.astype(np.int64)
+            elif isinstance(wf.func, DenseRank):
+                res = (grouped[skeys[0]].transform(
+                    lambda s: s.rank(method="dense"))).astype(np.int64) \
+                    if skeys else 1
+            elif isinstance(wf.func, (Lead, Lag)):
+                offset = wf.func.offset if isinstance(wf.func, Lead) \
+                    else -wf.func.offset
+                src = f"__wsrc_{wf.alias}"
+                work[src] = _arr(cpu_eval(wf.func.children[0], t),
+                                 t.num_rows).to_pandas()
+                res = grouped[src].shift(-offset)
+                work.drop(columns=[src], inplace=True)
+            elif isinstance(wf.func, eagg.AggregateFunction):
+                src = f"__wsrc_{wf.alias}"
+                child = wf.func.children[0] if wf.func.children else None
+                if child is None:
+                    work[src] = 1
+                else:
+                    work[src] = _arr(cpu_eval(child, t),
+                                     t.num_rows).to_pandas()
+                agg = {"Sum": "sum", "Count": "count", "Min": "min",
+                       "Max": "max", "Average": "mean"}[fname]
+                frame_kind, fstart, fend = spec.frame
+                if skeys and frame_kind == "rows" and fstart is None and \
+                        fend == 0:
+                    # running aggregate (unbounded preceding .. current row)
+                    res = grouped[src].transform(
+                        lambda s: getattr(s.expanding(), agg)())
+                else:
+                    res = grouped[src].transform(agg)
+                if agg == "count":
+                    res = res.astype(np.int64)
+                work.drop(columns=[src], inplace=True)
+            else:
+                raise NotImplementedError(f"window function {fname}")
+            df.loc[work.index, wf.alias] = res
+        # restore original row order and project output columns
+        names = [f.name for f in out_schema]
+        out_df = df[names]
+        arrays = []
+        for f in out_schema:
+            arr = pa.Array.from_pandas(out_df[f.name], type=f.type,
+                                       safe=False)
+            arrays.append(arr)
+        return pa.Table.from_arrays(arrays, schema=out_schema)

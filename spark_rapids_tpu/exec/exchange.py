@@ -1,0 +1,131 @@
+"""Exchange operators: shuffle + broadcast.
+
+Reference: GpuShuffleExchangeExecBase (org/.../GpuShuffleExchangeExec.scala:98,
+prepareBatchShuffleDependency :176) and GpuBroadcastExchangeExec.
+
+Execution model: the map side runs eagerly when the reduce side first
+pulls (a stage barrier, like Spark), splitting every batch with a device
+partitioner and registering the slices in the shuffle catalog; reduce
+partitions then stream from the catalog through the transport SPI.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..columnar.batch import ColumnarBatch, concat_batches
+from ..shuffle.manager import ShuffleManager
+from ..shuffle.partitioners import Partitioner, RangePartitioner
+from .base import PhysicalPlan, PARTITION_TIME, NUM_OUTPUT_ROWS, timed
+from .tpu_basic import TpuExec
+
+
+class TpuShuffleExchange(TpuExec):
+    def __init__(self, child: PhysicalPlan, partitioner: Partitioner):
+        super().__init__(child)
+        self.partitioner = partitioner
+        self._shuffle_id: Optional[int] = None
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return self.partitioner.num_partitions
+
+    def _node_string(self):
+        return (f"TpuShuffleExchange[{type(self.partitioner).__name__}"
+                f"({self.partitioner.num_partitions})]")
+
+    def _materialize_map_side(self):
+        mgr = ShuffleManager.get()
+        self._shuffle_id = mgr.new_shuffle_id()
+        in_parts = self.children[0].execute()
+        # range partitioner needs bounds from a sample pass first
+        if isinstance(self.partitioner, RangePartitioner) and \
+                self.partitioner.bound_words is None:
+            all_batches = [[b for b in p] for p in in_parts]
+            sample = [b for part in all_batches for b in part]
+            self.partitioner.fit(sample)
+            in_parts = [iter(p) for p in all_batches]
+        for map_id, part in enumerate(in_parts):
+            per_reduce = {}
+            for batch in part:
+                if batch.num_rows == 0:
+                    continue
+                with timed(self.metrics[PARTITION_TIME]):
+                    split = self.partitioner.split(batch)
+                for pid in range(self.partitioner.num_partitions):
+                    piece = split.partition_slice(pid)
+                    if piece is not None:
+                        per_reduce.setdefault(pid, []).append(piece)
+            mgr.write_map_output(self._shuffle_id, map_id, per_reduce)
+
+    def execute(self):
+        schema = self.output_schema
+        state = {"done": False}
+
+        def reduce_iter(reduce_id):
+            if not state["done"]:
+                self._materialize_map_side()
+                state["done"] = True
+            mgr = ShuffleManager.get()
+            got = False
+            for b in mgr.read_partition(self._shuffle_id, reduce_id):
+                got = True
+                self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
+                yield b
+            if not got:
+                yield ColumnarBatch.empty(schema)
+        return [reduce_iter(i)
+                for i in range(self.partitioner.num_partitions)]
+
+
+class TpuBroadcastExchange(TpuExec):
+    """Concat the whole input into one batch, replicated to consumers.
+
+    Reference: GpuBroadcastExchangeExec.scala:48."""
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__(child)
+        self._result: Optional[ColumnarBatch] = None
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return 1
+
+    def broadcast_batch(self) -> ColumnarBatch:
+        if self._result is None:
+            batches = [b for p in self.children[0].execute() for b in p
+                       if b.num_rows > 0]
+            self._result = concat_batches(batches) if batches else \
+                ColumnarBatch.empty(self.output_schema)
+        return self._result
+
+    def execute(self):
+        return [iter([self.broadcast_batch()])]
+
+
+class TpuCoalescePartitions(TpuExec):
+    """N partitions -> 1 without reordering (single partitioning exchange)."""
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__(child)
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return 1
+
+    def execute(self):
+        parts = self.children[0].execute()
+
+        def run():
+            for p in parts:
+                for b in p:
+                    yield b
+        return [run()]

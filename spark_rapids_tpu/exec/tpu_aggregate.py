@@ -1,0 +1,176 @@
+"""TPU hash-aggregate operator.
+
+Reference: GpuHashAggregateExec (aggregate.scala:240,282-460): per-batch
+update aggregation, then concat+merge of partials, with partial/final/
+complete modes driven by the planner around exchanges.
+
+TPU-first: grouping is the sort+segmented-reduce kernel
+(kernels/aggregate.py) — no hash tables; one compiled program per
+(schema, capacity) bucket.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.schema import Field, Schema
+from ..columnar.column import Column, bucket_capacity
+from ..columnar.batch import ColumnarBatch, concat_batches
+from ..expr import core as ec
+from ..expr.aggregates import AggregateFunction
+from ..kernels import canon, aggregate as agg_k
+from ..plan.logical import AggExpr
+from .base import PhysicalPlan, AGG_TIME, NUM_OUTPUT_ROWS, timed
+from .tpu_basic import TpuExec
+
+PARTIAL, FINAL, COMPLETE = "partial", "final", "complete"
+
+
+def buffer_schema(group_exprs, aggs: List[AggExpr]) -> Schema:
+    """Schema of partial-aggregation output: keys + flattened buffers."""
+    fields = [Field(ec.output_name(e), e.dtype(), True) for e in group_exprs]
+    for a in aggs:
+        for bi, bt in enumerate(a.func.buffer_dtypes()):
+            fields.append(Field(f"__{a.alias}__buf{bi}", bt, True))
+    return Schema(fields)
+
+
+class TpuHashAggregate(TpuExec):
+    def __init__(self, group_exprs: List[ec.Expression], aggs: List[AggExpr],
+                 child: PhysicalPlan, mode: str = COMPLETE):
+        super().__init__(child)
+        self.group_exprs = group_exprs
+        self.aggs = aggs
+        self.mode = mode
+
+    @property
+    def output_schema(self):
+        if self.mode == PARTIAL:
+            return buffer_schema(self.group_exprs, self.aggs)
+        fields = [Field(ec.output_name(e), e.dtype(), True)
+                  for e in self.group_exprs]
+        fields += [Field(a.alias, a.func.dtype(), a.func.nullable)
+                   for a in self.aggs]
+        return Schema(fields)
+
+    def _node_string(self):
+        return f"TpuHashAggregate[{self.mode}]"
+
+    def execute(self):
+        child_schema = self.children[0].output_schema
+        nkeys = len(self.group_exprs)
+
+        def run(part):
+            batches = [b for b in part]
+            with timed(self.metrics[AGG_TIME]):
+                if not batches:
+                    batch = ColumnarBatch.empty(child_schema)
+                else:
+                    batch = concat_batches(batches) if len(batches) > 1 \
+                        else batches[0]
+                out = self._aggregate_batch(batch)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+            yield out
+        return [run(p) for p in self.children[0].execute()]
+
+    # -- core -------------------------------------------------------------------
+    def _aggregate_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        child_schema = batch.schema
+        if self.mode in (PARTIAL, COMPLETE):
+            key_cols = [ec.eval_as_column(e.bind(child_schema), batch)
+                        for e in self.group_exprs]
+            input_cols = []
+            for a in self.aggs:
+                bound = [c.bind(child_schema) for c in a.func.children]
+                input_cols.append(
+                    [ec.eval_as_column(b, batch) for b in bound] or [None])
+        else:  # FINAL: input is keys + buffers laid out by buffer_schema
+            key_cols = [batch.columns[i] for i in range(len(self.group_exprs))]
+            input_cols = []
+            pos = len(self.group_exprs)
+            for a in self.aggs:
+                nb = a.func.num_buffers
+                input_cols.append(batch.columns[pos: pos + nb])
+                pos += nb
+
+        if not self.group_exprs:
+            return self._global_agg(batch, input_cols)
+
+        words = canon.batch_key_words(key_cols, batch.num_rows)
+        plan = agg_k.groupby_plan(words)
+        num_groups = int(plan.num_groups)
+        out_cap = bucket_capacity(max(num_groups, 1))
+
+        # aggregate buffers (indexed by segment id 0..G-1 in input capacity)
+        agg_buffers: List[List[Column]] = []
+        for a, cols in zip(self.aggs, input_cols):
+            if self.mode in (PARTIAL, COMPLETE):
+                bufs = a.func.update(plan, cols)
+            else:
+                bufs = a.func.merge(plan, cols)
+            agg_buffers.append(bufs)
+
+        # compact group keys: representative original-row indices
+        rep = plan.rep_indices
+        take = jnp.where(jnp.arange(out_cap) < num_groups,
+                         rep[:out_cap] if out_cap <= rep.shape[0] else
+                         jnp.pad(rep, (0, out_cap - rep.shape[0]))[:out_cap],
+                         0)
+        out_cols = [c.gather(take) for c in key_cols]
+        live = jnp.arange(out_cap) < num_groups
+        out_cols = [c.with_capacity(out_cap, num_groups).mask_validity(live)
+                    if c.capacity != out_cap else c.mask_validity(live)
+                    for c in out_cols]
+
+        # compact agg outputs: buffer arrays are already segment-indexed
+        for a, bufs in zip(self.aggs, agg_buffers):
+            if self.mode == PARTIAL:
+                outs = bufs
+            else:
+                outs = [a.func.finalize(bufs)]
+            for o in outs:
+                seg_take = jnp.where(live, jnp.arange(out_cap), 0)
+                c = o.gather(seg_take) if o.capacity >= out_cap else \
+                    o.with_capacity(out_cap, num_groups)
+                if c.capacity > out_cap:
+                    c = Column(c.dtype, c.data[:out_cap],
+                               c.validity[:out_cap]) \
+                        if not hasattr(c, "offsets") else \
+                        c.with_capacity(out_cap, num_groups)
+                out_cols.append(c.mask_validity(live))
+        return ColumnarBatch(self.output_schema, out_cols, num_groups)
+
+    def _global_agg(self, batch: ColumnarBatch,
+                    input_cols: List[List[Column]]) -> ColumnarBatch:
+        """No group keys: aggregate everything into one row (one segment)."""
+        cap = batch.capacity
+        const = Column(T.INT64, jnp.zeros(cap, jnp.int64),
+                       jnp.arange(cap) < batch.num_rows)
+        words = canon.batch_key_words([const], batch.num_rows)
+        plan = agg_k.groupby_plan(words)
+        out_cap = bucket_capacity(1)
+        out_cols: List[Column] = []
+        has_rows = batch.num_rows > 0
+        for a, cols in zip(self.aggs, input_cols):
+            if self.mode in (PARTIAL, COMPLETE):
+                bufs = a.func.update(plan, cols)
+            else:
+                bufs = a.func.merge(plan, cols)
+            outs = bufs if self.mode == PARTIAL else [a.func.finalize(bufs)]
+            for o in outs:
+                c = o.gather(jnp.zeros(out_cap, jnp.int32))
+                live = jnp.arange(out_cap) < 1
+                if not has_rows:
+                    # empty input: count-like aggs give 0, others null
+                    from ..expr.aggregates import Count
+                    if isinstance(a.func, Count):
+                        c = Column(T.INT64, jnp.zeros(out_cap, jnp.int64),
+                                   live)
+                    else:
+                        c = c.mask_validity(jnp.zeros(out_cap, bool))
+                else:
+                    c = c.mask_validity(live)
+                out_cols.append(c)
+        return ColumnarBatch(self.output_schema, out_cols, 1)

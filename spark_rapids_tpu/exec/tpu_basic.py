@@ -1,0 +1,358 @@
+"""TPU physical operators: scan/project/filter/limit/union/transitions.
+
+Reference analogues: GpuProjectExec/GpuFilterExec/GpuLocalLimitExec/
+GpuUnionExec (basicPhysicalOperators.scala, limit.scala),
+GpuRowToColumnarExec/GpuColumnarToRowExec (transitions),
+GpuCoalesceBatches (GpuCoalesceBatches.scala:195).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+import pyarrow as pa
+
+from ..columnar import dtypes as T
+from ..columnar.schema import Field, Schema
+from ..columnar.column import Column, bucket_capacity
+from ..columnar.batch import ColumnarBatch, concat_batches
+from ..columnar.arrow import from_arrow, to_arrow, schema_to_arrow
+from ..expr import core as ec
+from ..kernels import basic as bk
+from .base import (PhysicalPlan, NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES,
+                   OP_TIME, CONCAT_TIME, timed)
+
+
+class TpuExec(PhysicalPlan):
+    columnar = True
+
+
+class TpuLocalScan(TpuExec):
+    def __init__(self, table: pa.Table, num_partitions: int = 1,
+                 batch_rows: int = 1 << 20):
+        super().__init__()
+        self.table = table
+        self.num_partitions = max(1, num_partitions)
+        self.batch_rows = batch_rows
+
+    @property
+    def output_schema(self):
+        from ..columnar.arrow import schema_from_arrow
+        return schema_from_arrow(self.table.schema)
+
+    def num_partitions_hint(self):
+        return self.num_partitions
+
+    def execute(self):
+        n = self.table.num_rows
+        per = -(-n // self.num_partitions) if n else 0
+        parts = []
+        for i in range(self.num_partitions):
+            lo = min(i * per, n)
+            hi = min(lo + per, n)
+
+            def gen(lo=lo, hi=hi):
+                pos = lo
+                while pos < hi:
+                    k = min(self.batch_rows, hi - pos)
+                    yield from_arrow(self.table.slice(pos, k))
+                    pos += k
+                if lo == hi and lo == 0 and self.num_partitions == 1:
+                    # preserve empty-input schema
+                    yield from_arrow(self.table.slice(0, 0))
+            parts.append(gen())
+        return parts
+
+
+class TpuRange(TpuExec):
+    """Reference: GpuRangeExec (basicPhysicalOperators.scala:245)."""
+
+    def __init__(self, start, end, step, num_partitions,
+                 batch_rows: int = 1 << 20):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = max(1, num_partitions)
+        self.batch_rows = batch_rows
+
+    @property
+    def output_schema(self):
+        return Schema([Field("id", T.INT64, False)])
+
+    def num_partitions_hint(self):
+        return self.num_partitions
+
+    def execute(self):
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self.num_partitions) if total else 0
+        parts = []
+        for i in range(self.num_partitions):
+            lo, hi = i * per, min((i + 1) * per, total)
+
+            def gen(lo=lo, hi=hi):
+                pos = lo
+                while pos < hi:
+                    k = min(self.batch_rows, hi - pos)
+                    cap = bucket_capacity(k)
+                    ids = (self.start +
+                           (jnp.arange(cap, dtype=jnp.int64) + pos) *
+                           self.step)
+                    col = Column(T.INT64, ids, jnp.arange(cap) < k)
+                    yield ColumnarBatch(self.output_schema, [col], k)
+                    pos += k
+                if hi <= lo:
+                    yield ColumnarBatch.empty(self.output_schema)
+            parts.append(gen())
+        return parts
+
+
+class TpuProject(TpuExec):
+    """Reference: GpuProjectExec (basicPhysicalOperators.scala:83)."""
+
+    def __init__(self, exprs: List[ec.Expression], child: PhysicalPlan):
+        super().__init__(child)
+        self.exprs = exprs
+        self._bound: Optional[List[ec.Expression]] = None
+
+    @property
+    def output_schema(self):
+        return Schema([Field(ec.output_name(e), e.dtype(), e.nullable)
+                       for e in self.exprs])
+
+    def execute(self):
+        child_schema = self.children[0].output_schema
+        bound = [e.bind(child_schema) for e in self.exprs]
+        out_schema = self.output_schema
+
+        def run(part):
+            for batch in part:
+                with timed(self.metrics[OP_TIME]):
+                    cols = [ec.eval_as_column(b, batch) for b in bound]
+                out = ColumnarBatch(out_schema, cols, batch.num_rows)
+                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                yield out
+        return [run(p) for p in self.children[0].execute()]
+
+    def _node_string(self):
+        return f"TpuProject[{', '.join(ec.output_name(e) for e in self.exprs)}]"
+
+
+class TpuFilter(TpuExec):
+    """Reference: GpuFilterExec — boolean mask + compaction gather."""
+
+    def __init__(self, condition: ec.Expression, child: PhysicalPlan):
+        super().__init__(child)
+        self.condition = condition
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def execute(self):
+        child_schema = self.children[0].output_schema
+        bound = self.condition.bind(child_schema)
+
+        def run(part):
+            for batch in part:
+                with timed(self.metrics[OP_TIME]):
+                    pred = ec.eval_as_column(bound, batch)
+                    keep = pred.data.astype(bool) & pred.validity
+                    idx, cnt = bk.compact_indices(keep, batch.num_rows)
+                    n = int(cnt)
+                    out = batch.gather(idx, n)
+                    mask = jnp.arange(out.capacity) < n
+                    out = ColumnarBatch(
+                        out.schema,
+                        [c.mask_validity(mask) for c in out.columns], n)
+                self.metrics[NUM_OUTPUT_ROWS] += n
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                yield out
+        return [run(p) for p in self.children[0].execute()]
+
+    def _node_string(self):
+        return f"TpuFilter[{self.condition!r}]"
+
+
+class TpuCoalesceBatches(TpuExec):
+    """Concat small batches up to a rows/bytes goal.
+
+    Reference: GpuCoalesceBatches + AbstractGpuCoalesceIterator
+    (GpuCoalesceBatches.scala:195,402).
+    """
+
+    def __init__(self, child: PhysicalPlan, target_rows: int = 1 << 20,
+                 target_bytes: int = 512 << 20):
+        super().__init__(child)
+        self.target_rows = target_rows
+        self.target_bytes = target_bytes
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def execute(self):
+        def run(part):
+            pending: List[ColumnarBatch] = []
+            rows = 0
+            nbytes = 0
+            for batch in part:
+                if batch.num_rows == 0 and pending:
+                    continue
+                pending.append(batch)
+                rows += batch.num_rows
+                nbytes += batch.nbytes()
+                if rows >= self.target_rows or nbytes >= self.target_bytes:
+                    with timed(self.metrics[CONCAT_TIME]):
+                        yield concat_batches(pending)
+                    pending, rows, nbytes = [], 0, 0
+            if pending:
+                with timed(self.metrics[CONCAT_TIME]):
+                    yield concat_batches(pending)
+        return [run(p) for p in self.children[0].execute()]
+
+
+class TpuLocalLimit(TpuExec):
+    def __init__(self, n: int, child: PhysicalPlan):
+        super().__init__(child)
+        self.n = n
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def execute(self):
+        def run(part):
+            remaining = self.n
+            for batch in part:
+                if remaining <= 0:
+                    break
+                if batch.num_rows <= remaining:
+                    remaining -= batch.num_rows
+                    yield batch
+                else:
+                    yield batch.slice(0, remaining)
+                    remaining = 0
+        return [run(p) for p in self.children[0].execute()]
+
+
+class TpuGlobalLimit(TpuExec):
+    """Single-partition global limit with offset."""
+
+    def __init__(self, n: int, child: PhysicalPlan, offset: int = 0):
+        super().__init__(child)
+        self.n = n
+        self.offset = offset
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return 1
+
+    def execute(self):
+        parts = self.children[0].execute()
+
+        def run():
+            skip = self.offset
+            remaining = self.n
+            for p in parts:
+                for batch in p:
+                    if remaining <= 0:
+                        return
+                    if skip >= batch.num_rows:
+                        skip -= batch.num_rows
+                        continue
+                    if skip > 0:
+                        batch = batch.slice(skip, batch.num_rows - skip)
+                        skip = 0
+                    if batch.num_rows > remaining:
+                        batch = batch.slice(0, remaining)
+                    remaining -= batch.num_rows
+                    yield batch
+        return [run()]
+
+
+class TpuUnion(TpuExec):
+    def __init__(self, *children):
+        super().__init__(*children)
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return sum(c.num_partitions_hint() for c in self.children)
+
+    def execute(self):
+        target = self.output_schema
+        parts = []
+        for c in self.children:
+            for p in c.execute():
+                def conv(p=p, src=c.output_schema):
+                    for b in p:
+                        yield _align_schema(b, target)
+                parts.append(conv())
+        return parts
+
+
+def _align_schema(batch: ColumnarBatch, target: Schema) -> ColumnarBatch:
+    if batch.schema == target:
+        return batch
+    from ..expr.cast import Cast
+    from ..expr.core import BoundReference
+    cols = []
+    for i, f in enumerate(target):
+        src_f = batch.schema[i]
+        if src_f.dtype == f.dtype:
+            cols.append(batch.columns[i])
+        else:
+            e = Cast(BoundReference(i, src_f.dtype), f.dtype)
+            cols.append(ec.eval_as_column(e, batch))
+    return ColumnarBatch(target, cols, batch.num_rows)
+
+
+class RowToColumnar(TpuExec):
+    """CPU pa.Table partitions -> device batches.
+
+    Reference: GpuRowToColumnarExec (GpuRowToColumnarExec.scala:788).
+    """
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__(child)
+        assert not child.columnar
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def execute(self):
+        def run(part):
+            for t in part:
+                with timed(self.metrics[OP_TIME]):
+                    yield from_arrow(t)
+        return [run(p) for p in self.children[0].execute()]
+
+
+class ColumnarToRow(PhysicalPlan):
+    """Device batches -> CPU pa.Table partitions.
+
+    Reference: GpuColumnarToRowExec (GpuColumnarToRowExec.scala:341).
+    """
+    columnar = False
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__(child)
+        assert child.columnar
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def execute(self):
+        def run(part):
+            for b in part:
+                with timed(self.metrics[OP_TIME]):
+                    yield to_arrow(b)
+        return [run(p) for p in self.children[0].execute()]

@@ -1,0 +1,318 @@
+"""TPU join operators.
+
+Reference: GpuHashJoin.scala:62 (build+probe core), JoinGatherer.scala
+(bounded gather maps), GpuShuffledHashJoinBase / GpuBroadcastHashJoinExec /
+GpuBroadcastNestedLoopJoinExec / GpuCartesianProductExec.
+
+TPU-first: the build side is sorted once per partition by canonical key
+words; every probe batch runs a vectorized binary search + cumsum
+expansion (kernels/join.py).  Join types are realized by count surgery:
+  outer  -> unmatched probe rows get one null-extended output row
+  semi   -> filter probe rows with count > 0
+  anti   -> filter probe rows with count == 0
+  full   -> left-outer + unmatched build rows appended
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.schema import Field, Schema
+from ..columnar.column import Column, StringColumn, bucket_capacity
+from ..columnar.batch import ColumnarBatch, concat_batches
+from ..expr import core as ec
+from ..kernels import canon, join as join_k
+from ..kernels import strings as skern
+from .base import (PhysicalPlan, BUILD_TIME, JOIN_TIME, NUM_OUTPUT_ROWS,
+                   timed)
+from .tpu_basic import TpuExec
+
+
+def _key_words(cols: List[Column], num_rows: int,
+               str_words: List[Optional[int]]):
+    return canon.batch_key_words(cols, num_rows, str_words=str_words)
+
+
+def _null_column(dtype: T.DType, capacity: int) -> Column:
+    return Column.all_null(dtype, capacity)
+
+
+class TpuHashJoinBase(TpuExec):
+    """Shared build/probe logic.  children = [left, right]; the build side
+
+    is chosen by the subclass (broadcast: the broadcast side; shuffled:
+    right for inner/left, left for right joins)."""
+
+    def __init__(self, logical, left: PhysicalPlan, right: PhysicalPlan,
+                 build_right: bool = True):
+        super().__init__(left, right)
+        self.logical = logical
+        self.build_right = build_right
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.logical.schema
+
+    def _node_string(self):
+        return (f"{self.name}[{self.logical.join_type}, "
+                f"build={'right' if self.build_right else 'left'}]")
+
+    # ------------------------------------------------------------------
+    def _run_partition(self, left_iter, right_iter):
+        lg = self.logical
+        lschema = self.children[0].output_schema
+        rschema = self.children[1].output_schema
+        if self.build_right:
+            build_batches = list(right_iter)
+            stream_iter = left_iter
+            build_schema, stream_schema = rschema, lschema
+            build_keys = [e.bind(rschema) for e in lg.right_keys]
+            stream_keys = [e.bind(lschema) for e in lg.left_keys]
+        else:
+            build_batches = list(left_iter)
+            stream_iter = right_iter
+            build_schema, stream_schema = lschema, rschema
+            build_keys = [e.bind(lschema) for e in lg.left_keys]
+            stream_keys = [e.bind(rschema) for e in lg.right_keys]
+
+        with timed(self.metrics[BUILD_TIME]):
+            if build_batches:
+                build = concat_batches(build_batches)
+            else:
+                build = ColumnarBatch.empty(build_schema)
+            bkey_cols = [ec.eval_as_column(e, build) for e in build_keys]
+
+        stream_batches = list(stream_iter)
+        if not stream_batches:
+            stream_batches = [ColumnarBatch.empty(stream_schema)]
+
+        # unify string key widths across sides per key position
+        skey_cols_per_batch = []
+        str_words: List[Optional[int]] = []
+        for b in stream_batches:
+            skey_cols_per_batch.append(
+                [ec.eval_as_column(e, b) for e in stream_keys])
+        for ki in range(len(build_keys)):
+            if bkey_cols and isinstance(bkey_cols[ki], StringColumn):
+                w = skern.needed_key_words(bkey_cols[ki], build.num_rows)
+                for b, scols in zip(stream_batches, skey_cols_per_batch):
+                    w = max(w, skern.needed_key_words(scols[ki], b.num_rows))
+                str_words.append(w)
+            else:
+                str_words.append(None)
+
+        bwords = _key_words(bkey_cols, build.num_rows, str_words)
+        bt = join_k.build(bwords)
+
+        build_matched = np.zeros(build.capacity, dtype=bool) \
+            if lg.join_type == "full" else None
+
+        for sb, skey_cols in zip(stream_batches, skey_cols_per_batch):
+            with timed(self.metrics[JOIN_TIME]):
+                out = self._join_batch(sb, skey_cols, build, bt, str_words,
+                                       build_matched)
+            if out is not None:
+                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                yield out
+
+        if lg.join_type == "full" and build is not None:
+            out = self._unmatched_build_rows(build, build_matched,
+                                             stream_schema)
+            if out is not None and out.num_rows > 0:
+                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                yield out
+
+    # ------------------------------------------------------------------
+    def _join_batch(self, sb: ColumnarBatch, skey_cols, build, bt,
+                    str_words, build_matched) -> Optional[ColumnarBatch]:
+        lg = self.logical
+        jt = lg.join_type
+        swords = _key_words(skey_cols, sb.num_rows, str_words)
+        jc = join_k.probe_counts(bt, swords, sb.num_rows)
+
+        if jt in ("semi", "anti"):
+            from ..kernels import basic as bk
+            in_range = jnp.arange(sb.capacity) < sb.num_rows
+            keep = (jc.counts > 0) if jt == "semi" else \
+                ((jc.counts == 0) & in_range)
+            idx, cnt = bk.compact_indices(keep, sb.num_rows)
+            n = int(cnt)
+            out = sb.gather(idx, n)
+            mask = jnp.arange(out.capacity) < n
+            return ColumnarBatch(
+                self.output_schema,
+                [c.mask_validity(mask) for c in out.columns], n)
+
+        outer_stream = ((jt == "left" and self.build_right) or
+                        (jt == "right" and not self.build_right) or
+                        jt == "full")
+        counts = jc.counts
+        if outer_stream:
+            in_range = jnp.arange(sb.capacity) < sb.num_rows
+            unmatched = (counts == 0) & in_range
+            counts = jnp.where(unmatched, 1, counts)
+
+        total = join_k.total_matches(counts)
+        if total == 0:
+            return ColumnarBatch.empty(self.output_schema)
+        out_cap = bucket_capacity(total)
+        p_idx, b_idx, live, _ = join_k.expand_matches(
+            jc.lo, counts, bt.perm, out_cap)
+
+        stream_out = sb.gather(p_idx, total)
+        build_out = build.gather(b_idx, total)
+        if outer_stream:
+            # rows that came from the unmatched path carry null build side
+            row_matched = jnp.take(jc.counts > 0, jnp.clip(p_idx, 0,
+                                                           sb.capacity - 1))
+            build_out = ColumnarBatch(
+                build_out.schema,
+                [c.mask_validity(row_matched) for c in build_out.columns],
+                total)
+        if build_matched is not None:
+            matched_idx = np.asarray(jnp.where(
+                live & jnp.take(jc.counts > 0,
+                                jnp.clip(p_idx, 0, sb.capacity - 1)),
+                b_idx, 0))
+            flags = np.zeros(build.capacity, dtype=bool)
+            lv = np.asarray(live)
+            mi = np.asarray(matched_idx)
+            ok = np.asarray(jnp.take(jc.counts > 0,
+                                     jnp.clip(p_idx, 0, sb.capacity - 1)))
+            flags[mi[lv & ok]] = True
+            build_matched |= flags
+
+        live_mask = jnp.arange(out_cap) < total
+        scols = [c.mask_validity(live_mask) for c in stream_out.columns]
+        bcols = [c.mask_validity(live_mask) for c in build_out.columns]
+        out = self._assemble(scols, bcols, total)
+
+        # residual non-equi condition (inner-style filter)
+        if lg.condition is not None:
+            from .tpu_basic import TpuFilter
+            cond = lg.condition.bind(self.output_schema)
+            pred = ec.eval_as_column(cond, out)
+            from ..kernels import basic as bk
+            keep = pred.data.astype(bool) & pred.validity
+            idx, cnt = bk.compact_indices(keep, out.num_rows)
+            n = int(cnt)
+            g = out.gather(idx, n)
+            m = jnp.arange(g.capacity) < n
+            out = ColumnarBatch(self.output_schema,
+                                [c.mask_validity(m) for c in g.columns], n)
+        return out
+
+    def _assemble(self, stream_cols, build_cols, total) -> ColumnarBatch:
+        if self.build_right:
+            cols = stream_cols + build_cols
+        else:
+            cols = build_cols + stream_cols
+        return ColumnarBatch(self.output_schema, cols, total)
+
+    def _unmatched_build_rows(self, build, build_matched,
+                              stream_schema) -> Optional[ColumnarBatch]:
+        from ..kernels import basic as bk
+        in_range = np.arange(build.capacity) < build.num_rows
+        keep = jnp.asarray(~build_matched & in_range)
+        idx, cnt = bk.compact_indices(keep, build.num_rows)
+        n = int(cnt)
+        if n == 0:
+            return None
+        b_out = build.gather(idx, n)
+        mask = jnp.arange(b_out.capacity) < n
+        bcols = [c.mask_validity(mask) for c in b_out.columns]
+        scols = [_null_column(f.dtype, b_out.capacity)
+                 for f in stream_schema]
+        return self._assemble(scols, bcols, n)
+
+    def execute(self):
+        lparts = self.children[0].execute()
+        rparts = self.children[1].execute()
+        assert len(lparts) == len(rparts), \
+            f"join partition mismatch {len(lparts)} vs {len(rparts)}"
+        return [self._run_partition(lp, rp)
+                for lp, rp in zip(lparts, rparts)]
+
+
+class TpuShuffledHashJoin(TpuHashJoinBase):
+    """Both sides hash-partitioned by key (planner inserts exchanges).
+
+    Reference: GpuShuffledHashJoinBase.scala:28."""
+
+
+class TpuBroadcastHashJoin(TpuHashJoinBase):
+    """Build side broadcast (single concat batch replicated to every
+
+    stream partition).  Reference: GpuBroadcastHashJoinExec."""
+
+    def execute(self):
+        # broadcast side: materialize once, replicate per stream partition
+        if self.build_right:
+            stream_parts = self.children[0].execute()
+            bparts = self.children[1].execute()
+            build_batches = [b for p in bparts for b in p]
+            return [self._run_partition(sp, iter(list(build_batches)))
+                    for sp in stream_parts]
+        else:
+            stream_parts = self.children[1].execute()
+            bparts = self.children[0].execute()
+            build_batches = [b for p in bparts for b in p]
+            return [self._run_partition(iter(list(build_batches)), sp)
+                    for sp in stream_parts]
+
+
+class TpuNestedLoopJoin(TpuExec):
+    """Cartesian / nested-loop join for cross joins and non-equi conditions.
+
+    Reference: GpuBroadcastNestedLoopJoinExec, GpuCartesianProductExec."""
+
+    def __init__(self, logical, left: PhysicalPlan, right: PhysicalPlan):
+        super().__init__(left, right)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def execute(self):
+        lparts = self.children[0].execute()
+        rparts = self.children[1].execute()
+        right_batches = [b for p in rparts for b in p]
+        return [self._run(lp, right_batches) for lp in lparts]
+
+    def _run(self, left_iter, right_batches):
+        rb = concat_batches(right_batches) if right_batches else \
+            ColumnarBatch.empty(self.children[1].output_schema)
+        n_r = rb.num_rows
+        for lb in left_iter:
+            n_l = lb.num_rows
+            total = n_l * n_r
+            if total == 0:
+                continue
+            out_cap = bucket_capacity(total)
+            t = jnp.arange(out_cap)
+            li = (t // max(n_r, 1)).astype(jnp.int32)
+            ri = (t % max(n_r, 1)).astype(jnp.int32)
+            lout = lb.gather(li, total)
+            rout = rb.gather(ri, total)
+            live = t < total
+            cols = ([c.mask_validity(live) for c in lout.columns] +
+                    [c.mask_validity(live) for c in rout.columns])
+            out = ColumnarBatch(self.output_schema, cols, total)
+            if self.logical.condition is not None:
+                from ..kernels import basic as bk
+                cond = self.logical.condition.bind(self.output_schema)
+                pred = ec.eval_as_column(cond, out)
+                keep = pred.data.astype(bool) & pred.validity
+                idx, cnt = bk.compact_indices(keep, out.num_rows)
+                n = int(cnt)
+                g = out.gather(idx, n)
+                m = jnp.arange(g.capacity) < n
+                out = ColumnarBatch(self.output_schema,
+                                    [c.mask_validity(m) for c in g.columns],
+                                    n)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+            yield out

@@ -1,0 +1,115 @@
+"""TPU sort operator — reference: GpuSortExec.scala:56 (sort-each-batch /
+
+single-batch / out-of-core modes) + SortUtils.scala.
+
+TPU-first: one multi-operand lax.sort over canonical key words.  Global
+sorts are range-partitioned by the planner (RangePartitioner exchange)
+then locally sorted here, matching the reference's
+GpuRangePartitioning + GpuSortExec pipeline.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ..columnar.batch import ColumnarBatch, concat_batches
+from ..expr import core as ec
+from ..kernels import canon
+from ..kernels.sort import sort_permutation
+from ..plan.logical import SortOrder
+from .base import PhysicalPlan, SORT_TIME, NUM_OUTPUT_ROWS, timed
+from .tpu_basic import TpuExec
+
+
+class TpuSort(TpuExec):
+    def __init__(self, orders: List[SortOrder], child: PhysicalPlan,
+                 sort_each_batch: bool = False):
+        super().__init__(child)
+        self.orders = orders
+        self.sort_each_batch = sort_each_batch
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def _sort_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        if batch.num_rows == 0:
+            return batch
+        schema = batch.schema
+        cols = [ec.eval_as_column(o.expr.bind(schema), batch)
+                for o in self.orders]
+        words = canon.batch_key_words(
+            cols, batch.num_rows,
+            descending=[not o.ascending for o in self.orders],
+            nulls_last=[not o.effective_nulls_first for o in self.orders])
+        perm = sort_permutation(words)
+        out = batch.gather(perm, batch.num_rows)
+        mask = jnp.arange(out.capacity) < batch.num_rows
+        return ColumnarBatch(out.schema,
+                             [c.mask_validity(mask) for c in out.columns],
+                             batch.num_rows)
+
+    def execute(self):
+        def run(part):
+            if self.sort_each_batch:
+                for b in part:
+                    with timed(self.metrics[SORT_TIME]):
+                        out = self._sort_batch(b)
+                    self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                    yield out
+            else:
+                batches = [b for b in part]
+                if not batches:
+                    return
+                batch = concat_batches(batches) if len(batches) > 1 \
+                    else batches[0]
+                with timed(self.metrics[SORT_TIME]):
+                    out = self._sort_batch(batch)
+                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                yield out
+        return [run(p) for p in self.children[0].execute()]
+
+
+class TpuTopN(TpuExec):
+    """limit-over-sort: per-partition sort + slice, then final merge.
+
+    Reference: GpuTopN (limit.scala)."""
+
+    def __init__(self, n: int, orders: List[SortOrder], child: PhysicalPlan):
+        super().__init__(child)
+        self.n = n
+        self.orders = orders
+        self._sorter = TpuSort(orders, child)
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return 1
+
+    def execute(self):
+        parts = self.children[0].execute()
+
+        def run():
+            tops = []
+            for p in parts:
+                batches = [b for b in p]
+                if not batches:
+                    continue
+                batch = concat_batches(batches) if len(batches) > 1 else \
+                    batches[0]
+                s = self._sorter._sort_batch(batch)
+                if s.num_rows > self.n:
+                    s = s.slice(0, self.n)
+                tops.append(s)
+            if not tops:
+                return
+            merged = concat_batches(tops) if len(tops) > 1 else tops[0]
+            final = self._sorter._sort_batch(merged)
+            if final.num_rows > self.n:
+                final = final.slice(0, self.n)
+            self.metrics[NUM_OUTPUT_ROWS] += final.num_rows
+            yield final
+        return [run()]

@@ -1,0 +1,81 @@
+"""Window function expressions — reference: GpuWindowExpression.scala
+
+(Lead/Lag/RowNumber + frame specs), rank family.
+Evaluated only inside window execs (TPU: exec/tpu_window.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..columnar import dtypes as T
+from .core import Expression, LeafExpression
+
+
+class WindowFunction(Expression):
+    def columnar_eval(self, batch):
+        raise AssertionError(
+            f"{self.name} must be evaluated by a window exec")
+
+
+class RowNumber(WindowFunction, LeafExpression):
+    def dtype(self):
+        return T.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+
+class Rank(WindowFunction, LeafExpression):
+    def dtype(self):
+        return T.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+
+class DenseRank(WindowFunction, LeafExpression):
+    def dtype(self):
+        return T.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+
+class Lead(WindowFunction):
+    def __init__(self, child: Expression, offset: int = 1,
+                 default=None):
+        self.children = [child]
+        self.offset = offset
+        self.default = default
+
+    def with_children(self, c):
+        return Lead(c[0], self.offset, self.default)
+
+    def dtype(self):
+        return self.children[0].dtype()
+
+
+class Lag(WindowFunction):
+    def __init__(self, child: Expression, offset: int = 1,
+                 default=None):
+        self.children = [child]
+        self.offset = offset
+        self.default = default
+
+    def with_children(self, c):
+        return Lag(c[0], self.offset, self.default)
+
+    def dtype(self):
+        return self.children[0].dtype()
+
+
+class NTile(WindowFunction):
+    def __init__(self, n: int):
+        self.children = []
+        self.n = n
+
+    def dtype(self):
+        return T.INT64

@@ -1,0 +1,1 @@
+"""IO layer: file scans (reader strategies) and writers (SURVEY.md §2.6)."""

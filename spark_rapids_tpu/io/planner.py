@@ -1,0 +1,201 @@
+"""Scan/write physical operators for both engines.
+
+Reference: GpuFileSourceScanExec / GpuParquetFileFormat (write) and their
+CPU counterparts; the planner (plan/overrides.py) picks TPU vs CPU per
+tagging.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pyarrow.csv as pacsv
+
+from ..columnar.arrow import from_arrow, to_arrow, schema_to_arrow
+from ..columnar.schema import Schema
+from ..config import (TpuConf, PARQUET_READER_TYPE, MULTITHREAD_READ_THREADS,
+                      SHUFFLE_PARTITIONS, MAX_READER_BATCH_ROWS)
+from ..exec.base import PhysicalPlan, NUM_OUTPUT_ROWS
+from ..exec.cpu import CpuExec
+from ..exec.tpu_basic import TpuExec
+from ..plan import logical as L
+from .readers import (FilePartitionReader, expand_paths,
+                      split_files_into_partitions)
+
+
+def _strategy(fmt: str, conf: TpuConf) -> str:
+    if fmt != "parquet":
+        return "PERFILE"
+    s = conf.get(PARQUET_READER_TYPE).upper()
+    if s == "AUTO":
+        return "MULTITHREADED"
+    return s
+
+
+class TpuFileScan(TpuExec):
+    """Reference: GpuFileSourceScanExec + reader strategies (§2.6)."""
+
+    def __init__(self, logical: L.Scan, conf: TpuConf):
+        super().__init__()
+        self.logical = logical
+        self.conf = conf
+        self.files = expand_paths(logical.paths)
+        self.strategy = _strategy(logical.fmt, conf)
+        self._partitions = split_files_into_partitions(
+            self.files, conf.get(SHUFFLE_PARTITIONS))
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def num_partitions_hint(self):
+        return len(self._partitions)
+
+    def _node_string(self):
+        return (f"TpuFileScan[{self.logical.fmt}, {self.strategy}, "
+                f"{len(self.files)} files]")
+
+    def execute(self):
+        max_rows = self.conf.get(MAX_READER_BATCH_ROWS)
+
+        def run(files):
+            reader = FilePartitionReader(
+                self.logical.fmt, files,
+                strategy=self.strategy,
+                num_threads=self.conf.get(MULTITHREAD_READ_THREADS),
+                options=self.logical.options)
+            for table in reader:
+                pos = 0
+                n = table.num_rows
+                while pos < n or (n == 0 and pos == 0):
+                    k = min(max_rows, n - pos)
+                    chunk = table.slice(pos, k)
+                    self.metrics[NUM_OUTPUT_ROWS] += chunk.num_rows
+                    yield from_arrow(chunk)
+                    pos += max(k, 1)
+                    if n == 0:
+                        break
+        return [run(files) for files in self._partitions]
+
+
+class CpuFileScan(CpuExec):
+    def __init__(self, logical: L.Scan, conf: TpuConf):
+        super().__init__()
+        self.logical = logical
+        self.conf = conf
+        self.files = expand_paths(logical.paths)
+        self._partitions = split_files_into_partitions(
+            self.files, conf.get(SHUFFLE_PARTITIONS))
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def num_partitions_hint(self):
+        return len(self._partitions)
+
+    def execute(self):
+        def run(files):
+            reader = FilePartitionReader(self.logical.fmt, files,
+                                         options=self.logical.options)
+            for t in reader:
+                yield t
+        return [run(files) for files in self._partitions]
+
+
+def tpu_scan_exec(logical: L.Scan, conf: TpuConf) -> PhysicalPlan:
+    return TpuFileScan(logical, conf)
+
+
+def cpu_scan_exec(logical: L.Scan, conf: TpuConf) -> PhysicalPlan:
+    return CpuFileScan(logical, conf)
+
+
+# ---------------------------------------------------------------------------
+# writers (reference: GpuParquetFileFormat.scala:348, GpuFileFormatWriter)
+# ---------------------------------------------------------------------------
+
+class TpuFileWrite(TpuExec):
+    """Write device batches to part files (one per partition)."""
+
+    def __init__(self, logical: L.WriteFile, child: PhysicalPlan,
+                 conf: TpuConf):
+        super().__init__(child)
+        self.logical = logical
+        self.conf = conf
+
+    @property
+    def output_schema(self):
+        return Schema([])
+
+    def execute(self):
+        lg = self.logical
+        os.makedirs(lg.path, exist_ok=True)
+        if lg.mode == "overwrite":
+            for f in os.listdir(lg.path):
+                if f.startswith("part-"):
+                    os.unlink(os.path.join(lg.path, f))
+        parts = self.children[0].execute()
+        arrow_schema = schema_to_arrow(self.children[0].output_schema)
+
+        def run(i, part):
+            tables = [to_arrow(b) for b in part if b.num_rows > 0]
+            table = pa.concat_tables(tables) if tables else \
+                arrow_schema.empty_table()
+            _write_table(lg.fmt, table,
+                         os.path.join(lg.path, f"part-{i:05d}"))
+            self.metrics[NUM_OUTPUT_ROWS] += table.num_rows
+            return iter(())
+        return [run(i, p) for i, p in enumerate(parts)]
+
+
+class CpuFileWrite(CpuExec):
+    def __init__(self, logical: L.WriteFile, child: PhysicalPlan,
+                 conf: TpuConf):
+        super().__init__(child)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return Schema([])
+
+    def execute(self):
+        lg = self.logical
+        os.makedirs(lg.path, exist_ok=True)
+        if lg.mode == "overwrite":
+            for f in os.listdir(lg.path):
+                if f.startswith("part-"):
+                    os.unlink(os.path.join(lg.path, f))
+        parts = self.children[0].execute()
+        arrow_schema = schema_to_arrow(self.children[0].output_schema)
+
+        def run(i, part):
+            tables = list(part)
+            table = pa.concat_tables(tables) if tables else \
+                arrow_schema.empty_table()
+            _write_table(lg.fmt, table,
+                         os.path.join(lg.path, f"part-{i:05d}"))
+            return iter(())
+        return [run(i, p) for i, p in enumerate(parts)]
+
+
+def _write_table(fmt: str, table: pa.Table, base: str):
+    if fmt == "parquet":
+        papq.write_table(table, base + ".parquet")
+    elif fmt == "csv":
+        pacsv.write_csv(table, base + ".csv")
+    elif fmt == "orc":
+        from pyarrow import orc as paorc
+        paorc.write_table(table, base + ".orc")
+    else:
+        raise ValueError(f"unknown write format {fmt}")
+
+
+def tpu_write_exec(logical, child, conf):
+    return TpuFileWrite(logical, child, conf)
+
+
+def cpu_write_exec(logical, child, conf):
+    return CpuFileWrite(logical, child, conf)

@@ -81,9 +81,24 @@ def _type_extreme(dtype, want_max: bool):
     return jnp.array(info.max if not want_max else info.min, dtype)
 
 
+def _float_decode(words, dtype):
+    from .canon import SIGN64
+    sign = (words & SIGN64) != 0
+    bits = jnp.where(sign, words & ~SIGN64, ~words)
+    return bits.view(jnp.float64).astype(dtype)
+
+
 def seg_min(plan: GroupPlan, values, validity):
     cap = values.shape[0]
     v, ok = _sorted_vals(plan, values, validity)
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        # Spark total order: NaN greatest, -0.0 == 0.0 — min/max through
+        # the canonical uint64 encoding (kernels/canon.py)
+        from .canon import _float_to_words
+        enc = _float_to_words(v)
+        contrib = jnp.where(ok, enc, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        m = jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
+        return _float_decode(m, v.dtype)
     ident = _type_extreme(v.dtype, want_max=False)
     contrib = jnp.where(ok, v, ident)
     return jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
@@ -92,6 +107,12 @@ def seg_min(plan: GroupPlan, values, validity):
 def seg_max(plan: GroupPlan, values, validity):
     cap = values.shape[0]
     v, ok = _sorted_vals(plan, values, validity)
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        from .canon import _float_to_words
+        enc = _float_to_words(v)
+        contrib = jnp.where(ok, enc, jnp.uint64(0))
+        m = jax.ops.segment_max(contrib, plan.seg_id, num_segments=cap)
+        return _float_decode(m, v.dtype)
     ident = _type_extreme(v.dtype, want_max=True)
     contrib = jnp.where(ok, v, ident)
     return jax.ops.segment_max(contrib, plan.seg_id, num_segments=cap)

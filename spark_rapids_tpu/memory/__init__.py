@@ -1,0 +1,6 @@
+"""Memory management: tiered buffer catalog, spillable handles, device
+
+manager + semaphore (reference: SURVEY.md §2.3)."""
+from .catalog import BufferCatalog, StorageTier  # noqa: F401
+from .spillable import SpillableBatch  # noqa: F401
+from .arena import DeviceManager, DeviceSemaphore  # noqa: F401

@@ -1,0 +1,93 @@
+"""Device manager + admission control.
+
+Reference roles:
+- GpuDeviceManager (GpuDeviceManager.scala:36): acquire 1 device per
+  executor, size the memory pool from conf fractions.
+- GpuSemaphore (GpuSemaphore.scala:27): counting semaphore limiting
+  concurrent tasks on the device.
+- RMM arena + DeviceMemoryEventHandler: allocation budget whose pressure
+  triggers synchronous spill through the BufferCatalog.
+
+TPU adaptation: XLA/PJRT owns the physical HBM allocator, so the arena
+tracks logical live bytes and enforces the budget by spilling catalog
+buffers before admitting new ones (``reserve``).  On real TPU backends the
+HBM size is read from the device; on CPU test backends a configurable
+default is used.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+from ..config import (TpuConf, get_active, HBM_POOL_FRACTION, HBM_RESERVE,
+                      CONCURRENT_TPU_TASKS, HOST_SPILL_LIMIT, SPILL_DIR)
+from .catalog import BufferCatalog
+
+
+class DeviceSemaphore:
+    """Counting semaphore gating concurrent tasks on the device."""
+
+    def __init__(self, permits: int):
+        self.permits = permits
+        self._sem = threading.Semaphore(permits)
+        self._held = threading.local()
+
+    def acquire_if_necessary(self):
+        if getattr(self._held, "count", 0) == 0:
+            self._sem.acquire()
+        self._held.count = getattr(self._held, "count", 0) + 1
+
+    def release(self):
+        count = getattr(self._held, "count", 0)
+        if count > 0:
+            self._held.count = count - 1
+            if self._held.count == 0:
+                self._sem.release()
+
+
+class DeviceManager:
+    _instance: Optional["DeviceManager"] = None
+
+    def __init__(self, conf: Optional[TpuConf] = None):
+        conf = conf or get_active()
+        self.device = None
+        hbm_total = 16 << 30  # conservative default (v5e has 16 GiB/chip)
+        try:
+            devs = jax.devices()
+            self.device = devs[0]
+            stats = getattr(self.device, "memory_stats", lambda: None)()
+            if stats and "bytes_limit" in stats:
+                hbm_total = stats["bytes_limit"]
+        except Exception:
+            pass
+        frac = conf.get(HBM_POOL_FRACTION)
+        reserve = conf.get(HBM_RESERVE)
+        device_limit = max(int(hbm_total * frac) - reserve, 1 << 30)
+        self.catalog = BufferCatalog.reset(
+            spill_dir=conf.get(SPILL_DIR),
+            device_limit=device_limit,
+            host_limit=conf.get(HOST_SPILL_LIMIT))
+        self.semaphore = DeviceSemaphore(conf.get(CONCURRENT_TPU_TASKS))
+        self.hbm_total = hbm_total
+        self.device_limit = device_limit
+
+    @classmethod
+    def get(cls) -> "DeviceManager":
+        if cls._instance is None:
+            cls._instance = DeviceManager()
+        return cls._instance
+
+    @classmethod
+    def initialize(cls, conf: Optional[TpuConf] = None) -> "DeviceManager":
+        cls._instance = DeviceManager(conf)
+        return cls._instance
+
+    def reserve(self, nbytes: int):
+        """Admission: make room for nbytes, spilling catalog buffers if
+
+        needed (the DeviceMemoryEventHandler.onAllocFailure contract)."""
+        cat = self.catalog
+        if cat.device_bytes + nbytes > cat.device_limit:
+            cat.spill_device_to_fit(nbytes)

@@ -1,0 +1,229 @@
+"""Tiered buffer catalog: DEVICE -> HOST -> DISK spill framework.
+
+Reference: RapidsBufferCatalog.scala:40 + RapidsBufferStore.scala:41 +
+StorageTier (RapidsBuffer.scala:53), SpillPriorities.scala, and the
+DeviceMemoryEventHandler alloc-failure -> synchronous-spill contract
+(DeviceMemoryEventHandler.scala:33).
+
+TPU adaptation: XLA owns physical HBM, so the device tier tracks *logical*
+bytes of live device buffers and the memory budget is enforced by the
+arena (memory/arena.py) calling ``spill_to_fit`` before admitting new
+batches — the same synchronous-spill-on-pressure contract, with jax
+device_get/device_put as the tier movers.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import pickle
+import threading
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class StorageTier(enum.IntEnum):
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+
+# Spill priorities (reference: SpillPriorities.scala): lower value spills
+# first.  Shuffle output spills before active working buffers.
+SHUFFLE_OUTPUT_PRIORITY = -100
+ACTIVE_BATCH_PRIORITY = 0
+ACTIVE_ON_DECK_PRIORITY = 100
+
+
+@dataclasses.dataclass
+class BufferEntry:
+    buffer_id: str
+    tier: StorageTier
+    nbytes: int
+    priority: int
+    # DEVICE tier: the live object (ColumnarBatch); HOST: host_payload;
+    # DISK: file path
+    device_obj: object = None
+    host_payload: object = None
+    disk_path: Optional[str] = None
+    refcount: int = 0
+
+
+class BufferCatalog:
+    """Process-wide registry of spillable buffers."""
+
+    _instance: Optional["BufferCatalog"] = None
+
+    def __init__(self, spill_dir: str = "/tmp/spark_rapids_tpu_spill",
+                 device_limit: int = 28 << 30,
+                 host_limit: int = 8 << 30):
+        self._entries: Dict[str, BufferEntry] = {}
+        self._lock = threading.RLock()
+        self.spill_dir = spill_dir
+        self.device_limit = device_limit
+        self.host_limit = host_limit
+        self.device_bytes = 0
+        self.host_bytes = 0
+        self.disk_bytes = 0
+        self.spilled_device_to_host = 0
+        self.spilled_host_to_disk = 0
+
+    @classmethod
+    def get(cls) -> "BufferCatalog":
+        if cls._instance is None:
+            cls._instance = BufferCatalog()
+        return cls._instance
+
+    @classmethod
+    def reset(cls, **kwargs) -> "BufferCatalog":
+        cls._instance = BufferCatalog(**kwargs)
+        return cls._instance
+
+    # ------------------------------------------------------------------
+    def register(self, device_obj, nbytes: int,
+                 priority: int = ACTIVE_BATCH_PRIORITY) -> str:
+        buffer_id = uuid.uuid4().hex
+        with self._lock:
+            if buffer_id in self._entries:
+                raise ValueError(f"duplicate buffer {buffer_id}")
+            self._entries[buffer_id] = BufferEntry(
+                buffer_id, StorageTier.DEVICE, nbytes, priority,
+                device_obj=device_obj)
+            self.device_bytes += nbytes
+        return buffer_id
+
+    def unregister(self, buffer_id: str):
+        with self._lock:
+            e = self._entries.pop(buffer_id, None)
+            if e is None:
+                return
+            if e.tier == StorageTier.DEVICE:
+                self.device_bytes -= e.nbytes
+            elif e.tier == StorageTier.HOST:
+                self.host_bytes -= e.nbytes
+            else:
+                self.disk_bytes -= e.nbytes
+                if e.disk_path and os.path.exists(e.disk_path):
+                    os.unlink(e.disk_path)
+
+    # -- acquire (may unspill, like RapidsBufferCatalog.acquireBuffer) -----
+    def acquire(self, buffer_id: str):
+        with self._lock:
+            e = self._entries[buffer_id]
+            if e.tier == StorageTier.DEVICE:
+                return e.device_obj
+            if e.tier == StorageTier.HOST:
+                obj = self._unspill_host(e)
+            else:
+                obj = self._unspill_disk(e)
+            return obj
+
+    # ------------------------------------------------------------------
+    def _serialize(self, device_obj):
+        """ColumnarBatch -> host payload (schema, num_rows, numpy buffers)."""
+        from ..columnar.batch import ColumnarBatch
+        assert isinstance(device_obj, ColumnarBatch)
+        bufs = [np.asarray(a) for a in device_obj.device_buffers()]
+        return (device_obj.schema, device_obj.num_rows,
+                [type(c).__name__ for c in device_obj.columns], bufs)
+
+    def _deserialize(self, payload):
+        import jax.numpy as jnp
+        from ..columnar.batch import ColumnarBatch
+        from ..columnar.column import Column, StringColumn
+        schema, num_rows, kinds, bufs = payload
+        cols = []
+        i = 0
+        for f, kind in zip(schema, kinds):
+            if kind == "StringColumn":
+                offsets, data, validity = bufs[i], bufs[i + 1], bufs[i + 2]
+                cols.append(StringColumn(jnp.asarray(offsets),
+                                         jnp.asarray(data),
+                                         jnp.asarray(validity)))
+                i += 3
+            else:
+                data, validity = bufs[i], bufs[i + 1]
+                cols.append(Column(f.dtype, jnp.asarray(data),
+                                   jnp.asarray(validity)))
+                i += 2
+        return ColumnarBatch(schema, cols, num_rows)
+
+    def _spill_entry_to_host(self, e: BufferEntry):
+        e.host_payload = self._serialize(e.device_obj)
+        e.device_obj = None
+        e.tier = StorageTier.HOST
+        self.device_bytes -= e.nbytes
+        self.host_bytes += e.nbytes
+        self.spilled_device_to_host += e.nbytes
+
+    def _spill_entry_to_disk(self, e: BufferEntry):
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, f"{e.buffer_id}.spill")
+        with open(path, "wb") as f:
+            pickle.dump(e.host_payload, f, protocol=4)
+        e.host_payload = None
+        e.disk_path = path
+        e.tier = StorageTier.DISK
+        self.host_bytes -= e.nbytes
+        self.disk_bytes += e.nbytes
+        self.spilled_host_to_disk += e.nbytes
+
+    def _unspill_host(self, e: BufferEntry):
+        obj = self._deserialize(e.host_payload)
+        e.host_payload = None
+        e.device_obj = obj
+        e.tier = StorageTier.DEVICE
+        self.host_bytes -= e.nbytes
+        self.device_bytes += e.nbytes
+        return obj
+
+    def _unspill_disk(self, e: BufferEntry):
+        with open(e.disk_path, "rb") as f:
+            payload = pickle.load(f)
+        os.unlink(e.disk_path)
+        e.disk_path = None
+        e.host_payload = payload
+        e.tier = StorageTier.HOST
+        self.disk_bytes -= e.nbytes
+        self.host_bytes += e.nbytes
+        return self._unspill_host(e)
+
+    # -- synchronous spill (DeviceMemoryEventHandler.onAllocFailure role) --
+    def spill_device_to_fit(self, needed_bytes: int) -> int:
+        """Spill device-tier entries (lowest priority first) until at least
+
+        ``needed_bytes`` are free under device_limit.  Returns bytes spilled."""
+        spilled = 0
+        with self._lock:
+            target = self.device_limit - needed_bytes
+            candidates = sorted(
+                (e for e in self._entries.values()
+                 if e.tier == StorageTier.DEVICE and e.refcount == 0),
+                key=lambda e: e.priority)
+            for e in candidates:
+                if self.device_bytes <= target:
+                    break
+                self._spill_entry_to_host(e)
+                spilled += e.nbytes
+            # cascade host -> disk if host is over budget
+            if self.host_bytes > self.host_limit:
+                host_candidates = sorted(
+                    (e for e in self._entries.values()
+                     if e.tier == StorageTier.HOST and e.refcount == 0),
+                    key=lambda e: e.priority)
+                for e in host_candidates:
+                    if self.host_bytes <= self.host_limit:
+                        break
+                    self._spill_entry_to_disk(e)
+        return spilled
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(device_bytes=self.device_bytes,
+                        host_bytes=self.host_bytes,
+                        disk_bytes=self.disk_bytes,
+                        num_buffers=len(self._entries),
+                        spilled_device_to_host=self.spilled_device_to_host,
+                        spilled_host_to_disk=self.spilled_host_to_disk)

@@ -1,0 +1,39 @@
+"""Spillable batch handles — reference: SpillableColumnarBatch.scala:29.
+
+A task registers a batch with the catalog and holds only this handle; the
+catalog may move the underlying buffers down the tiers while the handle is
+live, and ``materialize()`` brings them back (unspill).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .catalog import BufferCatalog, ACTIVE_BATCH_PRIORITY
+
+
+class SpillableBatch:
+    def __init__(self, batch, priority: int = ACTIVE_BATCH_PRIORITY,
+                 catalog: Optional[BufferCatalog] = None):
+        self.catalog = catalog or BufferCatalog.get()
+        self.nbytes = batch.nbytes()
+        self.num_rows = batch.num_rows
+        self.schema = batch.schema
+        self.buffer_id = self.catalog.register(batch, self.nbytes, priority)
+        self._closed = False
+
+    def materialize(self):
+        """Bring the batch back to the device tier (may unspill)."""
+        assert not self._closed, "use after close"
+        return self.catalog.acquire(self.buffer_id)
+
+    def close(self):
+        if not self._closed:
+            self.catalog.unregister(self.buffer_id)
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False

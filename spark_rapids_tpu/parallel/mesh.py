@@ -1,0 +1,129 @@
+"""Device mesh + distributed aggregation/exchange over XLA collectives.
+
+Reference role (SURVEY.md §2.7 parallelism note): the reference's
+distributed primitives are partitioned all-to-all exchange, broadcast, and
+reduction-by-shuffle over UCX.  TPU-native, those map onto a
+jax.sharding.Mesh with ICI collectives: psum/all_gather for reductions and
+broadcast, ppermute/all_to_all for partitioned exchange — XLA inserts the
+collectives from sharding annotations (pjit/shard_map), no explicit
+transport code on the hot path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_name: str = "data") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (axis_name,))
+
+
+def shard_batch_arrays(arrays, mesh: Mesh, axis_name: str = "data"):
+    """Place [n_dev * rows, ...] arrays row-sharded across the mesh."""
+    sharding = NamedSharding(mesh, P(axis_name))
+    return [jax.device_put(a, sharding) for a in arrays]
+
+
+# ---------------------------------------------------------------------------
+# distributed aggregation step: the SPMD analogue of
+# partial-agg -> hash exchange -> final-agg (aggregate.scala modes + shuffle)
+# ---------------------------------------------------------------------------
+
+def distributed_sum_by_key(mesh: Mesh, axis_name: str = "data"):
+    """Build a pjit-able fn: (keys[n], vals[n]) row-sharded -> per-key sums.
+
+    Stage 1 (local): sort+segment partial aggregation per shard.
+    Stage 2 (exchange): all_to_all by key-hash so each device owns a key
+    range — the ICI realization of the reference's hash-partitioned
+    shuffle (RapidsShuffleManager role).
+    Stage 3 (local): final merge per device.
+    Output: dense [n_dev * cap_out] arrays (padded per shard).
+    """
+    from jax.experimental.shard_map import shard_map
+
+    n_dev = mesh.devices.size
+
+    def local_partial(keys, vals, valid):
+        cap = keys.shape[0]
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        krank = jnp.where(valid, jnp.uint64(1), jnp.uint64(2))
+        kwords = keys.astype(jnp.int64).view(jnp.uint64)
+        skr, skw, sv, perm = jax.lax.sort(
+            (krank, kwords, vals, iota), num_keys=2, is_stable=True)
+        live = skr != jnp.uint64(2)
+        prev = jnp.concatenate([skw[:1], skw[:-1]])
+        boundary = (jnp.concatenate(
+            [jnp.ones(1, bool), skw[1:] != skw[:-1]])) & live
+        seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+        seg = jnp.maximum(seg, 0)
+        sums = jax.ops.segment_sum(jnp.where(live, sv, 0), seg,
+                                   num_segments=cap)
+        # representative keys per segment
+        rep_key = jax.ops.segment_max(
+            jnp.where(live, keys[perm], jnp.int64(-2**62)), seg,
+            num_segments=cap)
+        ngroups = jnp.sum(boundary)
+        gvalid = jnp.arange(cap) < ngroups
+        return rep_key, sums, gvalid
+
+    def step(keys, vals, valid):
+        # keys/vals/valid are the local shard [rows_per_dev]
+        rep_key, sums, gvalid = local_partial(keys, vals, valid)
+        cap = rep_key.shape[0]
+        # exchange: route each group to owner = hash(key) % n_dev
+        owner = (rep_key.astype(jnp.uint64) *
+                 jnp.uint64(0x9E3779B97F4A7C15) >> jnp.uint64(33)) \
+            % jnp.uint64(n_dev)
+        owner = jnp.where(gvalid, owner.astype(jnp.int32), n_dev)
+        # bucket groups by owner into [n_dev, cap] slots (pad with invalid)
+        order = jnp.argsort(jnp.where(gvalid, owner, n_dev), stable=True)
+        skey = rep_key[order]
+        ssum = sums[order]
+        sowner = owner[order]
+        counts = jnp.bincount(jnp.clip(sowner, 0, n_dev - 1),
+                              weights=None, length=n_dev) * 0 + \
+            jax.ops.segment_sum(
+                jnp.where(sowner < n_dev, 1, 0),
+                jnp.clip(sowner, 0, n_dev - 1), num_segments=n_dev)
+        # slot layout: per-owner contiguous regions of size cap//n_dev
+        per = cap // n_dev
+        within = jnp.arange(cap) - jnp.concatenate(
+            [jnp.zeros(1, counts.dtype),
+             jnp.cumsum(counts)])[jnp.clip(sowner, 0, n_dev - 1)]
+        slot = jnp.clip(sowner, 0, n_dev - 1) * per + \
+            jnp.clip(within, 0, per - 1).astype(jnp.int32)
+        okey = jnp.full((n_dev * per,), jnp.int64(-2**62))
+        osum = jnp.zeros((n_dev * per,), vals.dtype)
+        oval = jnp.zeros((n_dev * per,), bool)
+        put = (sowner < n_dev) & (within < per)
+        okey = okey.at[jnp.where(put, slot, 0)].set(
+            jnp.where(put, skey, okey[0]))
+        osum = osum.at[jnp.where(put, slot, 0)].add(
+            jnp.where(put, ssum, 0))
+        oval = oval.at[jnp.where(put, slot, 0)].set(
+            jnp.where(put, True, oval[0]))
+        # all_to_all: [n_dev, per] -> every device gets its region
+        okey = jax.lax.all_to_all(okey.reshape(n_dev, per), axis_name, 0, 0,
+                                  tiled=False).reshape(-1)
+        osum = jax.lax.all_to_all(osum.reshape(n_dev, per), axis_name, 0, 0,
+                                  tiled=False).reshape(-1)
+        oval = jax.lax.all_to_all(oval.reshape(n_dev, per), axis_name, 0, 0,
+                                  tiled=False).reshape(-1)
+        # final local merge of received partials
+        fk, fs, fv = local_partial(okey, osum, oval)
+        return fk, fs, fv
+
+    smapped = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        check_rep=False)
+    return jax.jit(smapped)

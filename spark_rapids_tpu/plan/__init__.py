@@ -1,0 +1,1 @@
+"""Planner layer: logical IR, wrap/tag/convert overrides, type checks."""

@@ -1,0 +1,339 @@
+"""Logical plan IR.
+
+Role note: the reference is a plugin over Spark Catalyst, so its "logical
+plan" arrives from Spark.  This standalone framework owns the front end:
+the DataFrame API (api/dataframe.py) builds these nodes, and the planner
+(plan/overrides.py) wraps/tags/converts them into physical operators —
+exactly the GpuOverrides wrap->tag->convert pipeline (GpuOverrides.scala:3100),
+with the CPU (pyarrow) engine playing the role of stock Spark operators.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..columnar import dtypes as T
+from ..columnar.schema import Field, Schema
+from ..expr.core import Expression, AttributeReference, output_name
+from ..expr.aggregates import AggregateFunction
+
+
+class LogicalPlan:
+    children: List["LogicalPlan"] = []
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def name(self):
+        return type(self).__name__
+
+    def __repr__(self):
+        return self._tree_string()
+
+    def _tree_string(self, indent=0):
+        s = "  " * indent + self._node_string()
+        for c in self.children:
+            s += "\n" + c._tree_string(indent + 1)
+        return s
+
+    def _node_string(self):
+        return self.name
+
+
+class LocalRelation(LogicalPlan):
+    """In-memory data (pa.Table), possibly pre-split into partitions."""
+
+    def __init__(self, table, num_partitions: int = 1):
+        import pyarrow as pa
+        assert isinstance(table, pa.Table)
+        self.table = table
+        self.num_partitions = num_partitions
+        self.children = []
+
+    @property
+    def schema(self):
+        from ..columnar.arrow import schema_from_arrow
+        return schema_from_arrow(self.table.schema)
+
+    def _node_string(self):
+        return f"LocalRelation[rows={self.table.num_rows}]"
+
+
+class Range(LogicalPlan):
+    def __init__(self, start: int, end: int, step: int = 1,
+                 num_partitions: int = 1):
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+        self.children = []
+
+    @property
+    def schema(self):
+        return Schema([Field("id", T.INT64, nullable=False)])
+
+    def _node_string(self):
+        return f"Range({self.start}, {self.end}, {self.step})"
+
+
+class Scan(LogicalPlan):
+    """File scan (parquet/csv/orc) — reference: GpuParquetScan et al."""
+
+    def __init__(self, fmt: str, paths: List[str], schema: Schema,
+                 options: Optional[Dict[str, Any]] = None,
+                 pushed_filters: Optional[List[Expression]] = None):
+        self.fmt = fmt
+        self.paths = paths
+        self._schema = schema
+        self.options = options or {}
+        self.pushed_filters = pushed_filters or []
+        self.children = []
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def _node_string(self):
+        return f"Scan[{self.fmt}]({len(self.paths)} files)"
+
+
+class Project(LogicalPlan):
+    def __init__(self, exprs: List[Expression], child: LogicalPlan):
+        self.exprs = exprs
+        self.children = [child]
+
+    @property
+    def schema(self):
+        return Schema([Field(output_name(e), e.dtype(), e.nullable)
+                       for e in self.exprs])
+
+    def _node_string(self):
+        return f"Project[{', '.join(output_name(e) for e in self.exprs)}]"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expression, child: LogicalPlan):
+        self.condition = condition
+        self.children = [child]
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def _node_string(self):
+        return f"Filter[{self.condition!r}]"
+
+
+@dataclasses.dataclass
+class AggExpr:
+    func: AggregateFunction
+    alias: str
+
+
+class Aggregate(LogicalPlan):
+    def __init__(self, group_exprs: List[Expression], aggs: List[AggExpr],
+                 child: LogicalPlan):
+        self.group_exprs = group_exprs
+        self.aggs = aggs
+        self.children = [child]
+
+    @property
+    def schema(self):
+        fields = [Field(output_name(e), e.dtype(), e.nullable)
+                  for e in self.group_exprs]
+        fields += [Field(a.alias, a.func.dtype(), a.func.nullable)
+                   for a in self.aggs]
+        return Schema(fields)
+
+    def _node_string(self):
+        return (f"Aggregate[keys={[output_name(e) for e in self.group_exprs]},"
+                f" aggs={[a.alias for a in self.aggs]}]")
+
+
+JOIN_TYPES = ("inner", "left", "right", "full", "semi", "anti", "cross")
+
+
+class Join(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 join_type: str, left_keys: List[Expression],
+                 right_keys: List[Expression],
+                 condition: Optional[Expression] = None):
+        assert join_type in JOIN_TYPES, join_type
+        self.join_type = join_type
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.condition = condition
+        self.children = [left, right]
+
+    @property
+    def schema(self):
+        left, right = self.children
+        if self.join_type in ("semi", "anti"):
+            return left.schema
+        lfields = list(left.schema.fields)
+        rfields = list(right.schema.fields)
+        if self.join_type in ("left", "full"):
+            rfields = [Field(f.name, f.dtype, True) for f in rfields]
+        if self.join_type in ("right", "full"):
+            lfields = [Field(f.name, f.dtype, True) for f in lfields]
+        return Schema(lfields + rfields)
+
+    def _node_string(self):
+        return f"Join[{self.join_type}]"
+
+
+@dataclasses.dataclass
+class SortOrder:
+    expr: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # default: asc->first, desc->last
+
+    @property
+    def effective_nulls_first(self) -> bool:
+        if self.nulls_first is None:
+            return self.ascending
+        return self.nulls_first
+
+
+class Sort(LogicalPlan):
+    def __init__(self, orders: List[SortOrder], child: LogicalPlan,
+                 is_global: bool = True):
+        self.orders = orders
+        self.is_global = is_global
+        self.children = [child]
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def _node_string(self):
+        return f"Sort[global={self.is_global}]"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan, offset: int = 0):
+        self.n = n
+        self.offset = offset
+        self.children = [child]
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def _node_string(self):
+        return f"Limit[{self.n}]"
+
+
+class Union(LogicalPlan):
+    def __init__(self, children: List[LogicalPlan]):
+        self.children = list(children)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+class Distinct(LogicalPlan):
+    def __init__(self, child: LogicalPlan):
+        self.children = [child]
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+class Repartition(LogicalPlan):
+    def __init__(self, num_partitions: int, child: LogicalPlan,
+                 by_exprs: Optional[List[Expression]] = None):
+        self.num_partitions = num_partitions
+        self.by_exprs = by_exprs
+        self.children = [child]
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def _node_string(self):
+        by = "" if not self.by_exprs else \
+            f" by {[output_name(e) for e in self.by_exprs]}"
+        return f"Repartition[{self.num_partitions}{by}]"
+
+
+@dataclasses.dataclass
+class WindowSpec:
+    partition_by: List[Expression]
+    order_by: List[SortOrder]
+    # frame: ("rows"|"range", start, end) with None = unbounded
+    frame: Tuple[str, Optional[int], Optional[int]] = ("rows", None, None)
+
+
+class WindowFunc:
+    """Marker wrapper for a window function + its spec."""
+
+    def __init__(self, func: Expression, spec: WindowSpec, alias: str):
+        self.func = func
+        self.spec = spec
+        self.alias = alias
+
+
+class Window(LogicalPlan):
+    def __init__(self, window_funcs: List[WindowFunc], child: LogicalPlan):
+        self.window_funcs = window_funcs
+        self.children = [child]
+
+    @property
+    def schema(self):
+        base = list(self.children[0].schema.fields)
+        for wf in self.window_funcs:
+            base.append(Field(wf.alias, wf.func.dtype(), True))
+        return Schema(base)
+
+    def _node_string(self):
+        return f"Window[{[w.alias for w in self.window_funcs]}]"
+
+
+class Expand(LogicalPlan):
+    """Grouping-sets expand (reference: GpuExpandExec)."""
+
+    def __init__(self, projections: List[List[Expression]],
+                 output: Schema, child: LogicalPlan):
+        self.projections = projections
+        self._schema = output
+        self.children = [child]
+
+    @property
+    def schema(self):
+        return self._schema
+
+
+class Generate(LogicalPlan):
+    """explode/posexplode (reference: GpuGenerateExec)."""
+
+    def __init__(self, generator_col: str, output_name_: str,
+                 child: LogicalPlan, pos: bool = False):
+        self.generator_col = generator_col
+        self.output_name = output_name_
+        self.pos = pos
+        self.children = [child]
+
+    @property
+    def schema(self):
+        base = [f for f in self.children[0].schema.fields]
+        return Schema(base)
+
+
+class WriteFile(LogicalPlan):
+    def __init__(self, fmt: str, path: str, child: LogicalPlan,
+                 mode: str = "overwrite", options: Dict[str, Any] = None):
+        self.fmt = fmt
+        self.path = path
+        self.mode = mode
+        self.options = options or {}
+        self.children = [child]
+
+    @property
+    def schema(self):
+        return Schema([])
+
+    def _node_string(self):
+        return f"WriteFile[{self.fmt}]({self.path})"

@@ -1,0 +1,438 @@
+"""The planner: wrap -> tag -> convert, with explain and CPU fallback.
+
+Reference: GpuOverrides.scala:3100 (apply/applyOverrides), RapidsMeta.scala
+(wrapping/tagging framework), GpuTransitionOverrides.scala (transition
+insertion).  Differences are structural, not conceptual: the logical plan
+is ours (no Catalyst), and the CPU engine is the pyarrow fallback rather
+than stock Spark.
+
+Pipeline:
+  1. wrap every logical node in a PlanMeta; every expression in ExprMeta
+  2. tag: type checks (TypeSig), conf enables, per-op constraints; record
+     human-readable reasons (spark.rapids.tpu.sql.explain)
+  3. convert: tagged-ok nodes become TPU execs with exchanges inserted
+     (partial/final aggregation, hash-partitioned joins, range-partitioned
+     global sorts); tagged-out nodes become CPU execs with
+     RowToColumnar/ColumnarToRow transitions fused at the boundaries
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+from ..columnar import dtypes as T
+from ..config import (TpuConf, SQL_ENABLED, EXPLAIN, SHUFFLE_PARTITIONS,
+                      TEST_ENABLED, DECIMAL_ENABLED, CAST_STRING_TO_FLOAT,
+                      BATCH_SIZE_ROWS)
+from ..expr import core as ec
+from ..expr import (aggregates as eagg, arithmetic as ea, cast as ecast,
+                    conditional as econd, datetime as edt, misc as emisc,
+                    predicates as ep, string_ops as es)
+from . import logical as L
+from . import typesig as TS
+from ..exec import cpu as X
+from ..exec import tpu_basic as TB
+from ..exec import tpu_aggregate as TA
+from ..exec import tpu_join as TJ
+from ..exec import tpu_sort as TSOR
+from ..exec import exchange as EX
+from ..exec.base import PhysicalPlan
+from ..shuffle.partitioners import (HashPartitioner, RangePartitioner,
+                                    RoundRobinPartitioner,
+                                    SinglePartitioner)
+
+BROADCAST_ROW_THRESHOLD = 1 << 20  # rows; stand-in for byte-size stats
+
+
+# ---------------------------------------------------------------------------
+# expression rules (the expr[...] registry, GpuOverrides.scala:773)
+# ---------------------------------------------------------------------------
+
+_EXPR_RULES: Dict[Type[ec.Expression], TS.TypeSig] = {}
+
+
+def expr_rule(cls, sig: TS.TypeSig):
+    _EXPR_RULES[cls] = sig
+
+
+for _cls in [ec.AttributeReference, ec.BoundReference, ec.Literal, ec.Alias]:
+    expr_rule(_cls, TS.ALL_SUPPORTED)
+for _cls in [ea.Add, ea.Subtract, ea.Multiply, ea.Divide, ea.IntegralDivide,
+             ea.Remainder, ea.Pmod, ea.UnaryMinus, ea.UnaryPositive, ea.Abs,
+             ea.Least, ea.Greatest, ea.Round]:
+    expr_rule(_cls, TS.NUMERIC_WITH_DECIMAL)
+for _cls in [ea.Sqrt, ea.Exp, ea.Expm1, ea.Log, ea.Log1p, ea.Log2, ea.Log10,
+             ea.Sin, ea.Cos, ea.Tan, ea.Asin, ea.Acos, ea.Atan, ea.Sinh,
+             ea.Cosh, ea.Tanh, ea.Asinh, ea.Acosh, ea.Atanh, ea.Cbrt,
+             ea.ToDegrees, ea.ToRadians, ea.Rint, ea.Signum, ea.Floor,
+             ea.Ceil, ea.Pow, ea.Atan2]:
+    expr_rule(_cls, TS.NUMERIC)
+for _cls in [ea.BitwiseAnd, ea.BitwiseOr, ea.BitwiseXor, ea.BitwiseNot,
+             ea.ShiftLeft, ea.ShiftRight, ea.ShiftRightUnsigned]:
+    expr_rule(_cls, TS.INTEGRAL)
+for _cls in [ep.EqualTo, ep.EqualNullSafe, ep.LessThan, ep.LessThanOrEqual,
+             ep.GreaterThan, ep.GreaterThanOrEqual, ep.In]:
+    expr_rule(_cls, TS.ORDERABLE)
+for _cls in [ep.Not, ep.And, ep.Or]:
+    expr_rule(_cls, TS.BOOLEAN)
+for _cls in [ep.IsNull, ep.IsNotNull]:
+    expr_rule(_cls, TS.ALL_SUPPORTED)
+expr_rule(ep.IsNaN, TS.FP)
+for _cls in [econd.If, econd.CaseWhen, econd.Coalesce, econd.NaNvl]:
+    expr_rule(_cls, TS.ALL_SUPPORTED)
+expr_rule(ecast.Cast, TS.ALL_SUPPORTED)
+for _cls in [es.Upper, es.Lower, es.Length, es.Substring, es.StartsWith,
+             es.EndsWith, es.Contains, es.Like, es.RLike, es.ConcatStrings,
+             es.StringTrim, es.StringTrimLeft, es.StringTrimRight]:
+    expr_rule(_cls, TS.STRING_SIG)
+for _cls in [edt.Year, edt.Month, edt.DayOfMonth, edt.Quarter, edt.DayOfWeek,
+             edt.WeekDay, edt.DayOfYear, edt.LastDay, edt.Hour, edt.Minute,
+             edt.Second, edt.DateAdd, edt.DateSub, edt.DateDiff,
+             edt.UnixTimestampToSeconds, edt.ToDate]:
+    expr_rule(_cls, TS.DATETIME + TS.INTEGRAL)
+for _cls in [emisc.Murmur3Hash, emisc.Md5, emisc.MonotonicallyIncreasingID,
+             emisc.SparkPartitionID, emisc.Rand]:
+    expr_rule(_cls, TS.ALL_SUPPORTED)
+for _cls in [eagg.Sum, eagg.Count, eagg.Min, eagg.Max, eagg.Average,
+             eagg.First, eagg.Last]:
+    expr_rule(_cls, TS.ALL_SUPPORTED)
+
+
+class ExprMeta:
+    """Per-expression tagging (BaseExprMeta role, RapidsMeta.scala:686)."""
+
+    def __init__(self, expr: ec.Expression, conf: TpuConf):
+        self.expr = expr
+        self.conf = conf
+        self.reasons: List[str] = []
+        self.children = [ExprMeta(c, conf) for c in expr.children]
+
+    def tag(self):
+        cls = type(self.expr)
+        rule = _EXPR_RULES.get(cls)
+        if rule is None:
+            self.reasons.append(
+                f"expression {cls.__name__} has no TPU implementation")
+        else:
+            try:
+                dt = self.expr.dtype()
+                r = rule.reason(dt, cls.__name__)
+                if r:
+                    self.reasons.append(r)
+            except (ValueError, NotImplementedError) as e:
+                self.reasons.append(f"{cls.__name__}: {e}")
+        if isinstance(self.expr, ecast.Cast):
+            src = self.expr.children[0].dtype()
+            if (src == T.STRING and self.expr.to.is_fractional and
+                    not self.conf.get(CAST_STRING_TO_FLOAT)):
+                self.reasons.append(
+                    "Cast string->float disabled: set "
+                    "spark.rapids.tpu.sql.castStringToFloat.enabled=true")
+        if isinstance(self.expr.dtype() if not self.reasons else None,
+                      T.DecimalType) and not self.conf.get(DECIMAL_ENABLED):
+            self.reasons.append("decimal support disabled by conf")
+        for c in self.children:
+            c.tag()
+
+    @property
+    def can_replace(self) -> bool:
+        return not self.reasons and all(c.can_replace for c in self.children)
+
+    def all_reasons(self) -> List[str]:
+        out = list(self.reasons)
+        for c in self.children:
+            out.extend(c.all_reasons())
+        return out
+
+
+# ---------------------------------------------------------------------------
+# plan metas
+# ---------------------------------------------------------------------------
+
+class PlanMeta:
+    """SparkPlanMeta role (RapidsMeta.scala:512)."""
+
+    def __init__(self, plan: L.LogicalPlan, conf: TpuConf):
+        self.plan = plan
+        self.conf = conf
+        self.reasons: List[str] = []
+        self.children = [PlanMeta(c, conf) for c in plan.children]
+        self.expr_metas: List[ExprMeta] = [
+            ExprMeta(e, conf) for e in self._expressions()]
+
+    def _expressions(self) -> List[ec.Expression]:
+        p = self.plan
+        if isinstance(p, L.Project):
+            return list(p.exprs)
+        if isinstance(p, L.Filter):
+            return [p.condition]
+        if isinstance(p, L.Aggregate):
+            return list(p.group_exprs) + [a.func for a in p.aggs]
+        if isinstance(p, L.Join):
+            out = list(p.left_keys) + list(p.right_keys)
+            if p.condition is not None:
+                out.append(p.condition)
+            return out
+        if isinstance(p, L.Sort):
+            return [o.expr for o in p.orders]
+        if isinstance(p, L.Repartition):
+            return list(p.by_exprs or [])
+        if isinstance(p, L.Window):
+            out = []
+            for wf in p.window_funcs:
+                out.append(wf.func)
+                out.extend(wf.spec.partition_by)
+                out.extend(o.expr for o in wf.spec.order_by)
+            return out
+        return []
+
+    def tag(self):
+        if not self.conf.get(SQL_ENABLED):
+            self.reasons.append("spark.rapids.tpu.sql.enabled is false")
+        for em in self.expr_metas:
+            em.tag()
+            self.reasons.extend(em.all_reasons())
+        # per-node checks
+        p = self.plan
+        for f in p.schema:
+            if f.dtype.is_nested:
+                self.reasons.append(
+                    f"output column {f.name}: nested type {f.dtype.name} "
+                    f"not yet device-resident")
+        if isinstance(p, L.Window):
+            self.reasons.append("window exec not yet implemented on TPU")
+        for c in self.children:
+            c.tag()
+
+    @property
+    def can_replace(self) -> bool:
+        return not self.reasons
+
+    # -- explain (RapidsMeta.explain role) ---------------------------------
+    def explain(self, all_nodes: bool = False, indent: int = 0) -> str:
+        pad = "  " * indent
+        mark = "*" if self.can_replace else "!"
+        line = f"{pad}{mark} {self.plan._node_string()}"
+        if not self.can_replace:
+            for r in self.reasons:
+                line += f"\n{pad}    cannot run on TPU: {r}"
+        out = [line] if (all_nodes or not self.can_replace) else []
+        for c in self.children:
+            sub = c.explain(all_nodes, indent + 1)
+            if sub:
+                out.append(sub)
+        return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# conversion
+# ---------------------------------------------------------------------------
+
+def _as_columnar(p: PhysicalPlan) -> PhysicalPlan:
+    return p if p.columnar else TB.RowToColumnar(p)
+
+
+def _as_cpu(p: PhysicalPlan) -> PhysicalPlan:
+    return TB.ColumnarToRow(p) if p.columnar else p
+
+
+class Planner:
+    """applyOverrides + transitions, producing an executable physical plan."""
+
+    def __init__(self, conf: TpuConf):
+        self.conf = conf
+        self.default_partitions = conf.get(SHUFFLE_PARTITIONS)
+        self.batch_rows = conf.get(BATCH_SIZE_ROWS)
+        self.fallbacks: List[str] = []
+
+    def plan(self, logical: L.LogicalPlan) -> PhysicalPlan:
+        meta = PlanMeta(logical, self.conf)
+        meta.tag()
+        mode = self.conf.get(EXPLAIN).upper()
+        if mode in ("NOT_ON_TPU", "ALL"):
+            text = meta.explain(all_nodes=(mode == "ALL"))
+            if text:
+                print(text)
+        phys = self._convert(meta)
+        if self.conf.get(TEST_ENABLED):
+            self._assert_all_tpu(phys)
+        return phys
+
+    # ------------------------------------------------------------------
+    def _convert(self, meta: PlanMeta) -> PhysicalPlan:
+        p = meta.plan
+        if not meta.can_replace:
+            self.fallbacks.append(
+                f"{p.name}: {'; '.join(meta.reasons[:3])}")
+            return self._convert_cpu(meta)
+        children = [self._convert(c) for c in meta.children]
+        return self._convert_tpu(meta, p, children)
+
+    def _convert_cpu(self, meta: PlanMeta) -> PhysicalPlan:
+        """Run this node on the CPU engine; children still plan normally."""
+        p = meta.plan
+        children = [_as_cpu(self._convert(c)) for c in meta.children]
+        if isinstance(p, L.LocalRelation):
+            return X.CpuLocalScan(p.table, p.num_partitions)
+        if isinstance(p, L.Range):
+            return X.CpuRange(p.start, p.end, p.step, p.num_partitions)
+        if isinstance(p, L.Project):
+            return X.CpuProject(p.exprs, children[0])
+        if isinstance(p, L.Filter):
+            return X.CpuFilter(p.condition, children[0])
+        if isinstance(p, L.Aggregate):
+            return X.CpuAggregate(p.group_exprs, p.aggs, children[0])
+        if isinstance(p, L.Join):
+            return X.CpuJoin(p, children[0], children[1])
+        if isinstance(p, L.Sort):
+            return X.CpuSort(p.orders, children[0], p.is_global)
+        if isinstance(p, L.Limit):
+            return X.CpuLimit(p.n, children[0], p.offset)
+        if isinstance(p, L.Union):
+            return X.CpuUnion(*children)
+        if isinstance(p, L.Distinct):
+            agg = L.Aggregate(
+                [ec.AttributeReference(f.name, f.dtype, f.nullable)
+                 for f in p.schema], [], p.children[0])
+            return X.CpuAggregate(agg.group_exprs, [], children[0])
+        if isinstance(p, L.Repartition):
+            return X.CpuShuffleExchange(children[0], p.num_partitions,
+                                        p.by_exprs)
+        if isinstance(p, L.Window):
+            from ..exec.cpu_window import CpuWindow
+            return CpuWindow(p, children[0])
+        if isinstance(p, L.Scan):
+            from ..io.planner import cpu_scan_exec
+            return cpu_scan_exec(p, self.conf)
+        if isinstance(p, L.WriteFile):
+            from ..io.planner import cpu_write_exec
+            return cpu_write_exec(p, _as_cpu(children[0]), self.conf)
+        raise NotImplementedError(f"no CPU conversion for {p.name}")
+
+    # ------------------------------------------------------------------
+    def _convert_tpu(self, meta: PlanMeta, p: L.LogicalPlan,
+                     children: List[PhysicalPlan]) -> PhysicalPlan:
+        children = [_as_columnar(c) for c in children]
+        if isinstance(p, L.LocalRelation):
+            return TB.TpuLocalScan(p.table, p.num_partitions,
+                                   self.batch_rows)
+        if isinstance(p, L.Range):
+            return TB.TpuRange(p.start, p.end, p.step, p.num_partitions,
+                               self.batch_rows)
+        if isinstance(p, L.Scan):
+            from ..io.planner import tpu_scan_exec
+            return tpu_scan_exec(p, self.conf)
+        if isinstance(p, L.Project):
+            return TB.TpuProject(p.exprs, children[0])
+        if isinstance(p, L.Filter):
+            return TB.TpuFilter(p.condition, children[0])
+        if isinstance(p, L.Aggregate):
+            return self._plan_aggregate(p, children[0])
+        if isinstance(p, L.Distinct):
+            keys = [ec.AttributeReference(f.name, f.dtype, f.nullable)
+                    for f in p.schema]
+            agg = L.Aggregate(keys, [], p.children[0])
+            return self._plan_aggregate(agg, children[0])
+        if isinstance(p, L.Join):
+            return self._plan_join(p, children[0], children[1])
+        if isinstance(p, L.Sort):
+            return self._plan_sort(p, children[0])
+        if isinstance(p, L.Limit):
+            child = p.children[0]
+            if isinstance(child, L.Sort) and child.is_global and \
+                    p.offset == 0:
+                # fuse into TopN over the sort's input
+                return TSOR.TpuTopN(p.n, child.orders, children[0].children[0]
+                                    if isinstance(children[0], TSOR.TpuSort)
+                                    else children[0])
+            local = TB.TpuLocalLimit(p.n + p.offset, children[0])
+            return TB.TpuGlobalLimit(p.n, EX.TpuCoalescePartitions(local),
+                                     p.offset)
+        if isinstance(p, L.Union):
+            return TB.TpuUnion(*children)
+        if isinstance(p, L.Repartition):
+            if p.by_exprs:
+                part = HashPartitioner(p.by_exprs, p.num_partitions)
+            else:
+                part = RoundRobinPartitioner(p.num_partitions)
+            return EX.TpuShuffleExchange(children[0], part)
+        if isinstance(p, L.WriteFile):
+            from ..io.planner import tpu_write_exec
+            return tpu_write_exec(p, children[0], self.conf)
+        raise NotImplementedError(f"no TPU conversion for {p.name}")
+
+    # -- aggregate: partial -> exchange -> final (aggregate.scala modes) ---
+    def _plan_aggregate(self, p: L.Aggregate,
+                        child: PhysicalPlan) -> PhysicalPlan:
+        nparts = child.num_partitions_hint()
+        if nparts <= 1:
+            return TA.TpuHashAggregate(p.group_exprs, p.aggs, child,
+                                       mode=TA.COMPLETE)
+        partial = TA.TpuHashAggregate(p.group_exprs, p.aggs, child,
+                                      mode=TA.PARTIAL)
+        buf_schema = partial.output_schema
+        if p.group_exprs:
+            keys = [ec.AttributeReference(f.name, f.dtype, f.nullable)
+                    for f in list(buf_schema)[:len(p.group_exprs)]]
+            part = HashPartitioner(keys, min(self.default_partitions, nparts))
+            shuffled: PhysicalPlan = EX.TpuShuffleExchange(partial, part)
+        else:
+            shuffled = EX.TpuCoalescePartitions(partial)
+        return TA.TpuHashAggregate(p.group_exprs, p.aggs, shuffled,
+                                   mode=TA.FINAL)
+
+    # -- join strategy selection (GpuOverrides join metas role) ------------
+    def _plan_join(self, p: L.Join, left: PhysicalPlan,
+                   right: PhysicalPlan) -> PhysicalPlan:
+        if p.join_type == "cross" or not p.left_keys:
+            return TJ.TpuNestedLoopJoin(p, left, right)
+        lsize = self._estimate_rows(p.children[0])
+        rsize = self._estimate_rows(p.children[1])
+        build_right = p.join_type != "right"
+        # broadcast the build side when it is provably small
+        build_size = rsize if build_right else lsize
+        if build_size is not None and build_size <= BROADCAST_ROW_THRESHOLD \
+                and p.join_type not in ("full",):
+            if build_right:
+                bcast = EX.TpuBroadcastExchange(right)
+                return TJ.TpuBroadcastHashJoin(p, left, bcast,
+                                               build_right=True)
+            bcast = EX.TpuBroadcastExchange(left)
+            return TJ.TpuBroadcastHashJoin(p, bcast, right,
+                                           build_right=False)
+        n = self.default_partitions
+        lpart = HashPartitioner(p.left_keys, n)
+        rpart = HashPartitioner(p.right_keys, n)
+        lex = EX.TpuShuffleExchange(left, lpart)
+        rex = EX.TpuShuffleExchange(right, rpart)
+        return TJ.TpuShuffledHashJoin(p, lex, rex, build_right=build_right)
+
+    def _estimate_rows(self, p: L.LogicalPlan) -> Optional[int]:
+        if isinstance(p, L.LocalRelation):
+            return p.table.num_rows
+        if isinstance(p, L.Range):
+            return max(0, -(-(p.end - p.start) // p.step))
+        if isinstance(p, (L.Project, L.Filter, L.Sort)):
+            return self._estimate_rows(p.children[0])
+        if isinstance(p, L.Limit):
+            return p.n
+        return None
+
+    # -- global sort: range exchange + local sort --------------------------
+    def _plan_sort(self, p: L.Sort, child: PhysicalPlan) -> PhysicalPlan:
+        nparts = child.num_partitions_hint()
+        if not p.is_global or nparts <= 1:
+            return TSOR.TpuSort(p.orders, child)
+        part = RangePartitioner(p.orders, nparts)
+        ex = EX.TpuShuffleExchange(child, part)
+        return TSOR.TpuSort(p.orders, ex)
+
+    # -- test-mode assertion (spark.rapids.sql.test.enabled role) ----------
+    def _assert_all_tpu(self, phys: PhysicalPlan):
+        allowed = set(self.conf.allowed_non_tpu)
+        bad = [n.name for n in phys.collect_nodes()
+               if not n.columnar and n.name not in allowed
+               and not isinstance(n, TB.ColumnarToRow)]
+        if bad:
+            raise AssertionError(
+                f"test mode: operators fell back to CPU: {bad}; "
+                f"fallback reasons: {self.fallbacks}")

@@ -1,0 +1,61 @@
+"""TypeSig — the type-support algebra driving tagging and docs.
+
+Reference: TypeChecks.scala:367 (TypeSig), ExecChecks/ExprChecks, and the
+generated docs/supported_ops.md.  A TypeSig describes which dtypes an op
+supports; tagging intersects the actual plan types against it and records
+human-readable reasons on mismatch (RapidsMeta.explain role).
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional, Set, Type
+
+from ..columnar import dtypes as T
+
+
+class TypeSig:
+    def __init__(self, kinds: Iterable[type] = (), decimal: bool = False,
+                 note: str = ""):
+        self.kinds: Set[type] = set(kinds)
+        self.decimal = decimal
+        self.note = note
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        out = TypeSig(self.kinds | other.kinds,
+                      self.decimal or other.decimal)
+        return out
+
+    def supports(self, dt: T.DType) -> bool:
+        if isinstance(dt, T.DecimalType):
+            return self.decimal
+        return type(dt) in self.kinds
+
+    def reason(self, dt: T.DType, context: str) -> Optional[str]:
+        if self.supports(dt):
+            return None
+        return f"{context}: type {dt.name} is not supported on TPU"
+
+    def describe(self) -> str:
+        names = sorted(k().name if k not in (T.DecimalType,) else "decimal"
+                       for k in self.kinds)
+        if self.decimal:
+            names.append("decimal64")
+        return ", ".join(names)
+
+
+BOOLEAN = TypeSig([T.BooleanType])
+INTEGRAL = TypeSig([T.ByteType, T.ShortType, T.IntegerType, T.LongType])
+FP = TypeSig([T.FloatType, T.DoubleType])
+NUMERIC = INTEGRAL + FP
+DECIMAL_64 = TypeSig([], decimal=True)
+NUMERIC_WITH_DECIMAL = NUMERIC + DECIMAL_64
+STRING_SIG = TypeSig([T.StringType])
+DATETIME = TypeSig([T.DateType, T.TimestampType])
+NULL_SIG = TypeSig([T.NullType])
+
+# everything the columnar substrate can materialize today
+ALL_SUPPORTED = (BOOLEAN + NUMERIC + DECIMAL_64 + STRING_SIG + DATETIME +
+                 NULL_SIG)
+# orderable == groupable == joinable (canonical key words cover all of these)
+ORDERABLE = ALL_SUPPORTED
+# nested types are not yet device-resident
+UNSUPPORTED_NESTED = TypeSig([T.ArrayType, T.StructType, T.MapType])

@@ -1,0 +1,7 @@
+"""Shuffle layer: device partitioners, catalog-backed shuffle manager,
+
+transport SPI (reference: SURVEY.md §2.7)."""
+from .partitioners import (Partitioner, HashPartitioner, RangePartitioner,
+                           RoundRobinPartitioner, SinglePartitioner)  # noqa: F401
+from .manager import (ShuffleManager, ShuffleCatalog, ShuffleTransport,
+                      LocalTransport, ShuffleBlockId)  # noqa: F401

@@ -1,0 +1,127 @@
+"""Shuffle manager: catalog-backed map-output storage + transport SPI.
+
+Reference architecture (SURVEY.md §2.7): RapidsShuffleInternalManagerBase
+keeps map output **in device memory** (RapidsCachingWriter -> catalog) and
+serves reduce-side reads either locally (RapidsCachingReader) or over a
+pluggable transport (RapidsShuffleTransport SPI -> UCX).  Here:
+
+- ShuffleWriteSupport stores per-(shuffle, map, reduce) batches in a
+  process-wide catalog whose entries are spillable via the memory layer.
+- ShuffleTransport is the SPI; LocalTransport serves in-process reads
+  (the single-host case), MeshTransport (parallel/mesh_exchange.py) maps
+  the all-to-all onto jax.sharding collectives over ICI.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..columnar.batch import ColumnarBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class ShuffleBlockId:
+    shuffle_id: int
+    map_id: int
+    reduce_id: int
+
+
+class ShuffleTransport:
+    """Transport SPI (reference: shuffle/RapidsShuffleTransport.scala:338)."""
+
+    def fetch(self, blocks: List[ShuffleBlockId]) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class ShuffleCatalog:
+    """In-memory map-output catalog (ShuffleBufferCatalog role).
+
+    Batches are registered with the memory manager's spill framework when
+    available so device pressure can push them host-side.
+    """
+
+    def __init__(self):
+        self._store: Dict[ShuffleBlockId, List] = {}
+        self._lock = threading.Lock()
+
+    def put(self, block: ShuffleBlockId, batches: List[ColumnarBatch]):
+        from ..memory.spillable import SpillableBatch
+        with self._lock:
+            self._store[block] = [SpillableBatch(b) for b in batches]
+
+    def get(self, block: ShuffleBlockId) -> List[ColumnarBatch]:
+        with self._lock:
+            entries = self._store.get(block, [])
+        return [e.materialize() for e in entries]
+
+    def blocks_for_reduce(self, shuffle_id: int,
+                          reduce_id: int) -> List[ShuffleBlockId]:
+        with self._lock:
+            return sorted(
+                (b for b in self._store
+                 if b.shuffle_id == shuffle_id and b.reduce_id == reduce_id),
+                key=lambda b: b.map_id)
+
+    def remove_shuffle(self, shuffle_id: int):
+        with self._lock:
+            for b in [b for b in self._store if b.shuffle_id == shuffle_id]:
+                del self._store[b]
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for es in self._store.values() for e in es)
+
+
+class LocalTransport(ShuffleTransport):
+    def __init__(self, catalog: ShuffleCatalog):
+        self.catalog = catalog
+
+    def fetch(self, blocks):
+        for b in blocks:
+            for batch in self.catalog.get(b):
+                yield batch
+
+
+class ShuffleManager:
+    """Process-wide shuffle coordination (RapidsShuffleInternalManagerBase)."""
+
+    _instance: Optional["ShuffleManager"] = None
+
+    def __init__(self):
+        self.catalog = ShuffleCatalog()
+        self.transport: ShuffleTransport = LocalTransport(self.catalog)
+        self._next_shuffle = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "ShuffleManager":
+        if cls._instance is None:
+            cls._instance = ShuffleManager()
+        return cls._instance
+
+    def new_shuffle_id(self) -> int:
+        with self._lock:
+            sid = self._next_shuffle
+            self._next_shuffle += 1
+            return sid
+
+    # -- write side (RapidsCachingWriter role) -----------------------------
+    def write_map_output(self, shuffle_id: int, map_id: int,
+                         per_reduce: Dict[int, List[ColumnarBatch]]):
+        for reduce_id, batches in per_reduce.items():
+            if batches:
+                self.catalog.put(
+                    ShuffleBlockId(shuffle_id, map_id, reduce_id), batches)
+
+    # -- read side (RapidsCachingReader / RapidsShuffleIterator role) ------
+    def read_partition(self, shuffle_id: int,
+                       reduce_id: int) -> Iterator[ColumnarBatch]:
+        blocks = self.catalog.blocks_for_reduce(shuffle_id, reduce_id)
+        return self.transport.fetch(blocks)
+
+    def cleanup(self, shuffle_id: int):
+        self.catalog.remove_shuffle(shuffle_id)

@@ -1,0 +1,185 @@
+"""Device partitioners — reference: GpuHashPartitioning.scala,
+
+GpuRangePartitioner.scala + SamplingUtils.scala, GpuRoundRobinPartitioning,
+GpuSinglePartitioning, all slicing via contiguous split
+(GpuPartitioning.scala:31-73).
+
+TPU-first: partition ids are computed on device (hash of canonical key
+words / binary search against range bounds); the "contiguous split" is a
+stable sort by partition id + host-visible bincount boundaries, after
+which per-partition slices are plain device gathers.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, StringColumn, bucket_capacity
+from ..expr import core as ec
+from ..kernels import basic as bk
+from ..kernels import canon
+from ..kernels.sort import sort_permutation
+
+
+@dataclasses.dataclass
+class SplitBatch:
+    """A batch sorted by partition id + per-partition row ranges."""
+    batch: ColumnarBatch
+    offsets: np.ndarray  # [num_parts + 1] host row offsets
+
+    def partition_slice(self, pid: int) -> Optional[ColumnarBatch]:
+        lo, hi = int(self.offsets[pid]), int(self.offsets[pid + 1])
+        if hi <= lo:
+            return None
+        return self.batch.slice(lo, hi - lo)
+
+
+class Partitioner:
+    num_partitions: int = 1
+
+    def partition_ids(self, batch: ColumnarBatch) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def split(self, batch: ColumnarBatch) -> SplitBatch:
+        """Stable-sort the batch by partition id; contiguous-split analogue."""
+        pids = self.partition_ids(batch)
+        cap = batch.capacity
+        in_range = jnp.arange(cap) < batch.num_rows
+        sort_key = jnp.where(in_range, pids.astype(jnp.uint64),
+                             jnp.uint64(self.num_partitions))
+        perm = sort_permutation([sort_key])
+        sorted_batch = batch.gather(perm, batch.num_rows)
+        counts = np.bincount(
+            np.asarray(pids)[:batch.num_rows][
+                np.asarray(in_range)[:batch.num_rows]],
+            minlength=self.num_partitions)
+        offsets = np.zeros(self.num_partitions + 1, dtype=np.int64)
+        offsets[1:] = np.cumsum(counts)
+        return SplitBatch(sorted_batch, offsets)
+
+
+class SinglePartitioner(Partitioner):
+    num_partitions = 1
+
+    def partition_ids(self, batch):
+        return jnp.zeros(batch.capacity, jnp.int32)
+
+
+class HashPartitioner(Partitioner):
+    """murmur-style hash of key columns mod n (GpuHashPartitioning role)."""
+
+    def __init__(self, key_exprs: List[ec.Expression], num_partitions: int,
+                 schema=None):
+        self.key_exprs = key_exprs
+        self.num_partitions = num_partitions
+        self._schema = schema
+
+    def partition_ids(self, batch):
+        word_lists = []
+        for e in self.key_exprs:
+            bound = e.bind(batch.schema)
+            col = ec.eval_as_column(bound, batch)
+            for w in canon.value_words(col, batch.num_rows):
+                word_lists.append(jnp.where(col.validity, w,
+                                            jnp.uint64(0x9E3779B97F4A7C15)))
+        h = bk.hash_words(word_lists)
+        return bk.hash_to_partition(h, self.num_partitions)
+
+
+class RoundRobinPartitioner(Partitioner):
+    def __init__(self, num_partitions: int, start: int = 0):
+        self.num_partitions = num_partitions
+        self.start = start
+
+    def partition_ids(self, batch):
+        return ((jnp.arange(batch.capacity, dtype=jnp.int64) + self.start)
+                % self.num_partitions).astype(jnp.int32)
+
+
+class RangePartitioner(Partitioner):
+    """Sample-based range partitioning for global sort.
+
+    Reference: GpuRangePartitioner.scala + SamplingUtils.scala — sample
+    rows, sort the sample, pick n-1 bound rows, then binary-search each
+    row against the bounds.  Bounds here are canonical key words.
+    """
+
+    def __init__(self, orders, num_partitions: int):
+        self.orders = orders
+        self.num_partitions = num_partitions
+        self.bound_words: Optional[List[np.ndarray]] = None
+
+    def _order_words(self, batch: ColumnarBatch, str_words=None):
+        cols = [ec.eval_as_column(o.expr.bind(batch.schema), batch)
+                for o in self.orders]
+        sw = str_words or [None] * len(cols)
+        return canon.batch_key_words(
+            cols, batch.num_rows,
+            descending=[not o.ascending for o in self.orders],
+            nulls_last=[not o.effective_nulls_first for o in self.orders],
+            str_words=sw), cols
+
+    def fit(self, sample_batches: Sequence[ColumnarBatch],
+            sample_limit: int = 1 << 16):
+        """Compute partition bounds from sample batches (host-side pick)."""
+        all_words: Optional[List[np.ndarray]] = None
+        rows = 0
+        # unify string widths across samples
+        from ..kernels import strings as skern
+        ncols = len(self.orders)
+        self._str_words = [None] * ncols
+        col_sets = []
+        for b in sample_batches:
+            cols = [ec.eval_as_column(o.expr.bind(b.schema), b)
+                    for o in self.orders]
+            col_sets.append((b, cols))
+            for i, c in enumerate(cols):
+                if isinstance(c, StringColumn):
+                    w = skern.needed_key_words(c, b.num_rows)
+                    self._str_words[i] = max(self._str_words[i] or 1, w)
+        acc: List[List[np.ndarray]] = []
+        for b, cols in col_sets:
+            words = canon.batch_key_words(
+                cols, b.num_rows,
+                descending=[not o.ascending for o in self.orders],
+                nulls_last=[not o.effective_nulls_first
+                            for o in self.orders],
+                str_words=self._str_words)
+            acc.append([np.asarray(w)[:b.num_rows] for w in words])
+            rows += b.num_rows
+        if rows == 0:
+            self.bound_words = None
+            return
+        merged = [np.concatenate([a[i] for a in acc])
+                  for i in range(len(acc[0]))]
+        if rows > sample_limit:
+            sel = np.random.RandomState(0).choice(rows, sample_limit,
+                                                  replace=False)
+            merged = [m[sel] for m in merged]
+            rows = sample_limit
+        order = np.lexsort(tuple(reversed(merged)))
+        qpos = [int(rows * (i + 1) / self.num_partitions)
+                for i in range(self.num_partitions - 1)]
+        qpos = [min(q, rows - 1) for q in qpos]
+        self.bound_words = [m[order][qpos] for m in merged]
+
+    def partition_ids(self, batch):
+        if self.bound_words is None:
+            return jnp.zeros(batch.capacity, jnp.int32)
+        words, _ = self._order_words(batch, getattr(self, "_str_words", None))
+        bounds = [jnp.asarray(b) for b in self.bound_words]
+        # partition id = count of bounds <= row  (vectorized lexicographic)
+        pid = jnp.zeros(batch.capacity, jnp.int32)
+        for bi in range(self.num_partitions - 1):
+            idx_b = jnp.full(batch.capacity, bi)
+            # bound < row  => row goes to a later partition
+            blt = canon.words_less(bounds, idx_b, words,
+                                   jnp.arange(batch.capacity))
+            beq = ~blt & ~canon.words_less(words, jnp.arange(batch.capacity),
+                                           bounds, idx_b)
+            pid = pid + (blt | beq).astype(jnp.int32)
+        return jnp.clip(pid, 0, self.num_partitions - 1)

@@ -1,0 +1,139 @@
+"""Composable random typed data generators.
+
+Reference: integration_tests data_gen.py (928 LoC): nullable ratios,
+special values (NaN, +-0.0, int extremes, epoch edges), deterministic
+seeds.
+"""
+import string
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+
+
+class DataGen:
+    def __init__(self, dtype, nullable=True, null_ratio=0.1):
+        self.dtype = dtype
+        self.nullable = nullable
+        self.null_ratio = null_ratio if nullable else 0.0
+
+    def generate(self, rng, n):
+        vals = self._values(rng, n)
+        if self.null_ratio > 0:
+            mask = rng.random(n) < self.null_ratio
+            vals = [None if m else v for v, m in zip(vals, mask)]
+        return list(vals)
+
+    def _values(self, rng, n):
+        raise NotImplementedError
+
+
+class IntGen(DataGen):
+    SPECIALS = [0, 1, -1, 2**31 - 1, -2**31, 2**63 - 1, -2**63]
+
+    def __init__(self, dtype=T.INT64, lo=None, hi=None, **kw):
+        super().__init__(dtype, **kw)
+        info = np.iinfo(dtype.np_dtype)
+        self.lo = info.min if lo is None else lo
+        self.hi = info.max if hi is None else hi
+
+    def _values(self, rng, n):
+        vals = rng.integers(self.lo, self.hi, n, dtype=np.int64,
+                            endpoint=True)
+        out = [int(v) for v in vals]
+        specials = [s for s in self.SPECIALS if self.lo <= s <= self.hi]
+        for i in range(min(len(specials), n // 10)):
+            out[int(rng.integers(0, n))] = specials[i]
+        return out
+
+
+class FloatGen(DataGen):
+    SPECIALS = [0.0, -0.0, float("nan"), float("inf"), float("-inf"),
+                1.0, -1.0]
+
+    def __init__(self, dtype=T.FLOAT64, no_nans=False, **kw):
+        super().__init__(dtype, **kw)
+        self.no_nans = no_nans
+
+    def _values(self, rng, n):
+        out = list((rng.random(n) - 0.5) * 2e6)
+        specials = [s for s in self.SPECIALS
+                    if not (self.no_nans and (s != s))]
+        for i in range(min(len(specials), n // 10)):
+            out[int(rng.integers(0, n))] = specials[i]
+        return [float(v) for v in out]
+
+
+class BoolGen(DataGen):
+    def __init__(self, **kw):
+        super().__init__(T.BOOL, **kw)
+
+    def _values(self, rng, n):
+        return [bool(v) for v in rng.integers(0, 2, n)]
+
+
+class StringGen(DataGen):
+    def __init__(self, max_len=12, charset=string.ascii_letters + "0123456789",
+                 **kw):
+        super().__init__(T.STRING, **kw)
+        self.max_len = max_len
+        self.charset = charset
+
+    def _values(self, rng, n):
+        out = []
+        for _ in range(n):
+            k = int(rng.integers(0, self.max_len + 1))
+            out.append("".join(self.charset[int(i)] for i in
+                               rng.integers(0, len(self.charset), k)))
+        if n > 3:
+            out[0] = ""
+            out[1] = " lead"
+            out[2] = "trail "
+        return out
+
+
+class DateGen(DataGen):
+    def __init__(self, **kw):
+        super().__init__(T.DATE, **kw)
+
+    def _values(self, rng, n):
+        vals = [int(v) for v in rng.integers(-30000, 30000, n)]
+        if n > 2:
+            vals[0] = 0
+            vals[1] = -1
+        return vals
+
+
+class TimestampGen(DataGen):
+    def __init__(self, **kw):
+        super().__init__(T.TIMESTAMP, **kw)
+
+    def _values(self, rng, n):
+        return [int(v) for v in
+                rng.integers(-2**50, 2**50, n)]
+
+
+class KeyGen(DataGen):
+    """Low-cardinality int keys for join/group tests."""
+
+    def __init__(self, cardinality=20, **kw):
+        super().__init__(T.INT64, **kw)
+        self.cardinality = cardinality
+
+    def _values(self, rng, n):
+        return [int(v) for v in rng.integers(0, self.cardinality, n)]
+
+
+def gen_table(gens: dict, n: int, seed: int = 42):
+    """dict of name -> DataGen => dict of name -> list (pydict)."""
+    rng = np.random.default_rng(seed)
+    return {name: g.generate(rng, n) for name, g in gens.items()}
+
+
+def gen_df(session, gens: dict, n: int, seed: int = 42, num_partitions=1):
+    from spark_rapids_tpu.columnar import Schema, Field
+    data = gen_table(gens, n, seed)
+    schema = Schema([Field(name, g.dtype, g.nullable)
+                     for name, g in gens.items()])
+    return session.create_dataframe(data, schema=schema,
+                                    num_partitions=num_partitions)

@@ -1,0 +1,67 @@
+"""CPU-vs-TPU equality harness.
+
+Reference pattern (SURVEY.md §4): assert_gpu_and_cpu_are_equal_collect
+(integration_tests asserts.py:340) runs the same DataFrame function under
+CPU and GPU sessions by flipping spark.rapids.sql.enabled, then
+deep-compares rows with float-ulp tolerance.  This is that harness for
+the TPU build: the oracle engine is the pyarrow CPU path.
+"""
+import math
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.config import TpuConf
+
+
+def with_cpu_session(fn, conf=None):
+    settings = {"spark.rapids.tpu.sql.enabled": False}
+    settings.update(conf or {})
+    s = TpuSession(TpuConf(settings))
+    return fn(s)
+
+
+def with_tpu_session(fn, conf=None):
+    settings = {"spark.rapids.tpu.sql.enabled": True}
+    settings.update(conf or {})
+    s = TpuSession(TpuConf(settings))
+    return fn(s)
+
+
+def _normalize(v):
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        return v
+    return v
+
+
+def _row_key(row):
+    return tuple(str(_normalize(v)) for v in row)
+
+
+def _compare_rows(cpu_rows, tpu_rows, approx_float=True, rel=1e-9):
+    assert len(cpu_rows) == len(tpu_rows), \
+        f"row count: cpu={len(cpu_rows)} tpu={len(tpu_rows)}"
+    for i, (cr, tr) in enumerate(zip(cpu_rows, tpu_rows)):
+        assert len(cr) == len(tr), f"row {i} width differs"
+        for j, (cv, tv) in enumerate(zip(cr, tr)):
+            if isinstance(cv, float) and isinstance(tv, float):
+                if math.isnan(cv) and math.isnan(tv):
+                    continue
+                if approx_float:
+                    ok = (cv == tv or
+                          abs(cv - tv) <= rel * max(abs(cv), abs(tv), 1.0))
+                    assert ok, f"row {i} col {j}: cpu={cv!r} tpu={tv!r}"
+                    continue
+            assert cv == tv, f"row {i} col {j}: cpu={cv!r} tpu={tv!r}"
+
+
+def assert_tpu_and_cpu_are_equal_collect(df_fn, conf=None, ignore_order=True,
+                                         approx_float=True):
+    """Run df_fn(session) on both engines and compare collected rows."""
+    cpu_rows = with_cpu_session(lambda s: df_fn(s).collect(), conf)
+    tpu_rows = with_tpu_session(lambda s: df_fn(s).collect(), conf)
+    if ignore_order:
+        cpu_rows = sorted(cpu_rows, key=_row_key)
+        tpu_rows = sorted(tpu_rows, key=_row_key)
+    _compare_rows(cpu_rows, tpu_rows, approx_float=approx_float)
+    return tpu_rows

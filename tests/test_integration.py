@@ -1,0 +1,204 @@
+"""DataFrame-level CPU-vs-TPU equality tests.
+
+Reference pattern: integration_tests/src/main/python/{hash_aggregate_test,
+join_test,sort_test,arithmetic_ops_test}.py — same oracle, same shape.
+"""
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.columnar import dtypes as T
+
+from harness import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (IntGen, FloatGen, StringGen, BoolGen, KeyGen, DateGen,
+                      gen_df)
+
+N = 300
+
+
+def _base_gens():
+    return {
+        "k": KeyGen(cardinality=12),
+        "i": IntGen(lo=-10_000, hi=10_000),
+        "f": FloatGen(),
+        "s": StringGen(max_len=8),
+        "b": BoolGen(),
+    }
+
+
+class TestProjectFilter:
+    def test_project_arithmetic(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .select(F.col("i") + 1, F.col("i") * F.col("k"),
+                    (F.col("f") / 2).alias("h"),
+                    (F.col("i") % 7).alias("m")))
+
+    def test_filter_predicates(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .filter((F.col("i") > 0) & (F.col("k") < 8)))
+
+    def test_filter_or_null(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .filter(F.col("i").is_null() | (F.col("f") > 0)))
+
+    def test_conditional(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .select(F.when(F.col("i") > 0, "pos")
+                    .when(F.col("i") < 0, "neg")
+                    .otherwise("zero").alias("sign"),
+                    F.coalesce("i", "k").alias("c")))
+
+    def test_string_funcs(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .select(F.upper("s"), F.lower("s"), F.length("s"),
+                    F.substring("s", 2, 3),
+                    F.col("s").like("a%").alias("lk"),
+                    F.trim(F.col("s")).alias("t")))
+
+    def test_casts(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .select(F.col("i").cast("double"), F.col("f").cast("int"),
+                    F.col("i").cast("string"), F.col("b").cast("int")))
+
+    def test_date_funcs(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"d": DateGen()}, N)
+            .select(F.year(F.col("d").cast(T.DATE)).alias("y"),
+                    F.month(F.col("d").cast(T.DATE)).alias("m"),
+                    F.dayofmonth(F.col("d").cast(T.DATE)).alias("dom"),
+                    F.dayofweek(F.col("d").cast(T.DATE)).alias("dow")))
+
+
+class TestHashAggregate:
+    def test_groupby_sum_count(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .group_by("k")
+            .agg(F.sum("i").alias("si"), F.count().alias("c"),
+                 F.count("f").alias("cf")))
+
+    def test_groupby_min_max_avg(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .group_by("k")
+            .agg(F.min("i").alias("mn"), F.max("i").alias("mx"),
+                 F.avg("f").alias("av")))
+
+    def test_groupby_string_key(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .group_by("s").agg(F.count().alias("c"), F.sum("i").alias("si")))
+
+    def test_groupby_multi_key(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .group_by("k", "b").agg(F.sum("i").alias("si")))
+
+    def test_global_agg(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .agg(F.sum("i").alias("si"), F.count().alias("c"),
+                 F.min("f").alias("mn"), F.max("f").alias("mx")))
+
+    def test_groupby_min_max_string(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .group_by("k").agg(F.min("s").alias("mn"),
+                               F.max("s").alias("mx")))
+
+    def test_distinct(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"k": KeyGen(cardinality=8),
+                                 "b": BoolGen()}, N).distinct())
+
+    def test_groupby_partitioned_input(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N, num_partitions=4)
+            .group_by("k").agg(F.sum("i").alias("si"),
+                               F.count().alias("c")))
+
+
+class TestJoin:
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                     "semi", "anti"])
+    def test_join_types(self, how):
+        def fn(s):
+            left = gen_df(s, {"k": KeyGen(cardinality=15),
+                              "a": IntGen()}, N, seed=1)
+            right = gen_df(s, {"k": KeyGen(cardinality=20),
+                               "b": IntGen()}, N // 2, seed=2)
+            return left.join(right, on="k", how=how)
+        assert_tpu_and_cpu_are_equal_collect(fn)
+
+    def test_join_string_keys(self):
+        def fn(s):
+            left = gen_df(s, {"k": StringGen(max_len=3, null_ratio=0.05),
+                              "a": IntGen()}, N, seed=3)
+            right = gen_df(s, {"k": StringGen(max_len=3, null_ratio=0.05),
+                               "b": IntGen()}, N // 2, seed=4)
+            return left.join(right, on="k")
+        assert_tpu_and_cpu_are_equal_collect(fn)
+
+    def test_join_partitioned(self):
+        def fn(s):
+            left = gen_df(s, {"k": KeyGen(), "a": IntGen()}, N, seed=5,
+                          num_partitions=3)
+            right = gen_df(s, {"k": KeyGen(), "b": IntGen()}, N, seed=6,
+                           num_partitions=2)
+            return left.join(right, on="k")
+        assert_tpu_and_cpu_are_equal_collect(fn)
+
+    def test_cross_join(self):
+        def fn(s):
+            left = gen_df(s, {"a": IntGen()}, 20, seed=7)
+            right = gen_df(s, {"b": IntGen()}, 15, seed=8)
+            return left.join(right, how="cross")
+        assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+class TestSortLimit:
+    def test_global_sort(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N).sort("i", "k"),
+            ignore_order=False)
+
+    def test_sort_desc_nulls(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N)
+            .sort(F.col("i").desc(), F.col("s").asc()),
+            ignore_order=False)
+
+    def test_sort_strings(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"s": StringGen(max_len=20)}, N).sort("s"),
+            ignore_order=False)
+
+    def test_sort_partitioned(self):
+        # full tie-break: row order among key-ties is engine-dependent
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N, num_partitions=4)
+            .sort("f", "i", "k", "s", "b"),
+            ignore_order=False)
+
+    def test_limit(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, _base_gens(), N).sort("i", "k", "f", "s")
+            .limit(17), ignore_order=False)
+
+    def test_union(self):
+        def fn(s):
+            a = gen_df(s, {"x": IntGen()}, 50, seed=9)
+            b = gen_df(s, {"x": IntGen()}, 60, seed=10)
+            return a.union(b)
+        assert_tpu_and_cpu_are_equal_collect(fn)
+
+    def test_count_action(self):
+        from harness import with_cpu_session, with_tpu_session
+        fn = lambda s: gen_df(s, _base_gens(), N).filter(
+            F.col("i") > 0).count()
+        assert with_cpu_session(fn) == with_tpu_session(fn)
