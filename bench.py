@@ -1,0 +1,71 @@
+"""Benchmark driver: TPU engine vs CPU oracle engine on a representative
+
+SQL workload (scan -> filter -> project -> hash-aggregate -> join), the
+shape of the reference's headline mortgage-ETL / TPC queries
+(BASELINE.md).  Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+value        = TPU engine throughput (M rows/s through the pipeline)
+vs_baseline  = TPU time / CPU-engine time speedup (the reference's
+               headline metric is end-to-end speedup vs CPU Spark;
+               our CPU engine is the stand-in oracle)
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_df(session, n_rows: int, num_partitions: int):
+    rng = np.random.default_rng(7)
+    from spark_rapids_tpu.api import functions as F
+    data = {
+        "k": rng.integers(0, 1000, n_rows).astype(np.int64),
+        "a": rng.integers(-100_000, 100_000, n_rows).astype(np.int64),
+        "x": rng.random(n_rows),
+        "y": rng.random(n_rows),
+    }
+    df = session.create_dataframe(data, num_partitions=num_partitions)
+    agg = (df.filter((F.col("x") > 0.1) & (F.col("a") % 7 != 0))
+             .with_column("z", F.col("x") * F.col("y") + F.col("a"))
+             .group_by("k")
+             .agg(F.sum("z").alias("sz"), F.count().alias("c"),
+                  F.max("x").alias("mx")))
+    return agg
+
+
+def run_engine(enabled: bool, n_rows: int, num_partitions: int,
+               repeats: int) -> float:
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.config import TpuConf
+    s = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": enabled}))
+    # warmup (compile cache)
+    build_df(s, n_rows, num_partitions).to_arrow()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = build_df(s, n_rows, num_partitions).to_arrow()
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+    assert out.num_rows > 0
+    return best
+
+
+def main():
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 4_000_000
+    parts = 4
+    repeats = 3
+    tpu_t = run_engine(True, n_rows, parts, repeats)
+    cpu_t = run_engine(False, n_rows, parts, repeats)
+    throughput = n_rows / tpu_t / 1e6
+    print(json.dumps({
+        "metric": "sql_pipeline_throughput",
+        "value": round(throughput, 3),
+        "unit": "Mrows/s",
+        "vs_baseline": round(cpu_t / tpu_t, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

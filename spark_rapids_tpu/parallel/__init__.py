@@ -1,0 +1,3 @@
+"""Distributed execution over jax.sharding meshes (ICI/DCN collectives)."""
+from .mesh import (make_mesh, shard_rows, distributed_sum_by_key,
+                   distributed_global_sum)  # noqa: F401

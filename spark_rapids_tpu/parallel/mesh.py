@@ -3,10 +3,11 @@
 Reference role (SURVEY.md §2.7 parallelism note): the reference's
 distributed primitives are partitioned all-to-all exchange, broadcast, and
 reduction-by-shuffle over UCX.  TPU-native, those map onto a
-jax.sharding.Mesh with ICI collectives: psum/all_gather for reductions and
-broadcast, ppermute/all_to_all for partitioned exchange — XLA inserts the
-collectives from sharding annotations (pjit/shard_map), no explicit
-transport code on the hot path.
+jax.sharding.Mesh with ICI collectives: all_to_all for the
+hash-partitioned exchange, psum/all_gather for reductions and broadcast —
+XLA inserts and schedules the collectives; there is no explicit transport
+code on the hot path (the UCX client/server state machines collapse into
+one `lax.all_to_all`).
 """
 from __future__ import annotations
 
@@ -18,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+MIX = 0x9E3779B97F4A7C15
+
 
 def make_mesh(n_devices: Optional[int] = None,
               axis_name: str = "data") -> Mesh:
@@ -26,104 +29,109 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(np.array(devs[:n]), (axis_name,))
 
 
-def shard_batch_arrays(arrays, mesh: Mesh, axis_name: str = "data"):
+def shard_rows(arrays, mesh: Mesh, axis_name: str = "data"):
     """Place [n_dev * rows, ...] arrays row-sharded across the mesh."""
     sharding = NamedSharding(mesh, P(axis_name))
     return [jax.device_put(a, sharding) for a in arrays]
 
 
-# ---------------------------------------------------------------------------
-# distributed aggregation step: the SPMD analogue of
-# partial-agg -> hash exchange -> final-agg (aggregate.scala modes + shuffle)
-# ---------------------------------------------------------------------------
+def _local_sum_by_key(keys, vals, valid):
+    """Sort + segmented-sum partial aggregation on one shard.
+
+    Same design as kernels/aggregate.py, specialized to a single int64 key
+    so the whole step stays inside one jit/shard_map body.
+    """
+    cap = keys.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    krank = jnp.where(valid, jnp.uint64(1), jnp.uint64(2))
+    kwords = keys.astype(jnp.int64).view(jnp.uint64)
+    kwords = jnp.where(valid, kwords, jnp.uint64(0))
+    skr, skw, sv, perm = jax.lax.sort(
+        (krank, kwords, vals.astype(jnp.float64), iota), num_keys=2,
+        is_stable=True)
+    live = skr != jnp.uint64(2)
+    boundary = jnp.concatenate(
+        [jnp.ones(1, bool), (skw[1:] != skw[:-1]) | (skr[1:] != skr[:-1])])
+    boundary = boundary & live
+    seg = jnp.maximum(jnp.cumsum(boundary.astype(jnp.int32)) - 1, 0)
+    sums = jax.ops.segment_sum(jnp.where(live, sv, 0.0), seg,
+                               num_segments=cap)
+    skeys = jnp.take(keys, perm)
+    rep_key = jax.ops.segment_max(
+        jnp.where(live, skeys, jnp.int64(-2**62)), seg, num_segments=cap)
+    ngroups = jnp.sum(boundary)
+    gvalid = jnp.arange(cap) < ngroups
+    return rep_key, sums.astype(vals.dtype), gvalid
+
 
 def distributed_sum_by_key(mesh: Mesh, axis_name: str = "data"):
-    """Build a pjit-able fn: (keys[n], vals[n]) row-sharded -> per-key sums.
+    """Build the jitted SPMD step: row-sharded (keys, vals, valid) ->
 
-    Stage 1 (local): sort+segment partial aggregation per shard.
-    Stage 2 (exchange): all_to_all by key-hash so each device owns a key
-    range — the ICI realization of the reference's hash-partitioned
-    shuffle (RapidsShuffleManager role).
-    Stage 3 (local): final merge per device.
-    Output: dense [n_dev * cap_out] arrays (padded per shard).
+    per-key sums, keys owner-partitioned across devices.
+
+    Three stages, the TPU realization of the reference's
+    partial-agg -> hash-shuffle -> final-agg pipeline (aggregate.scala
+    modes + RapidsShuffleManager):
+      1. local partial aggregation (sort + segment_sum)
+      2. all_to_all exchange routing each key group to hash(key) % n_dev
+      3. local final merge of received partials
     """
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map
 
     n_dev = mesh.devices.size
 
-    def local_partial(keys, vals, valid):
-        cap = keys.shape[0]
-        iota = jnp.arange(cap, dtype=jnp.int32)
-        krank = jnp.where(valid, jnp.uint64(1), jnp.uint64(2))
-        kwords = keys.astype(jnp.int64).view(jnp.uint64)
-        skr, skw, sv, perm = jax.lax.sort(
-            (krank, kwords, vals, iota), num_keys=2, is_stable=True)
-        live = skr != jnp.uint64(2)
-        prev = jnp.concatenate([skw[:1], skw[:-1]])
-        boundary = (jnp.concatenate(
-            [jnp.ones(1, bool), skw[1:] != skw[:-1]])) & live
-        seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-        seg = jnp.maximum(seg, 0)
-        sums = jax.ops.segment_sum(jnp.where(live, sv, 0), seg,
-                                   num_segments=cap)
-        # representative keys per segment
-        rep_key = jax.ops.segment_max(
-            jnp.where(live, keys[perm], jnp.int64(-2**62)), seg,
-            num_segments=cap)
-        ngroups = jnp.sum(boundary)
-        gvalid = jnp.arange(cap) < ngroups
-        return rep_key, sums, gvalid
-
     def step(keys, vals, valid):
-        # keys/vals/valid are the local shard [rows_per_dev]
-        rep_key, sums, gvalid = local_partial(keys, vals, valid)
+        rep_key, sums, gvalid = _local_sum_by_key(keys, vals, valid)
         cap = rep_key.shape[0]
-        # exchange: route each group to owner = hash(key) % n_dev
-        owner = (rep_key.astype(jnp.uint64) *
-                 jnp.uint64(0x9E3779B97F4A7C15) >> jnp.uint64(33)) \
-            % jnp.uint64(n_dev)
-        owner = jnp.where(gvalid, owner.astype(jnp.int32), n_dev)
-        # bucket groups by owner into [n_dev, cap] slots (pad with invalid)
-        order = jnp.argsort(jnp.where(gvalid, owner, n_dev), stable=True)
-        skey = rep_key[order]
-        ssum = sums[order]
-        sowner = owner[order]
-        counts = jnp.bincount(jnp.clip(sowner, 0, n_dev - 1),
-                              weights=None, length=n_dev) * 0 + \
-            jax.ops.segment_sum(
-                jnp.where(sowner < n_dev, 1, 0),
-                jnp.clip(sowner, 0, n_dev - 1), num_segments=n_dev)
-        # slot layout: per-owner contiguous regions of size cap//n_dev
         per = cap // n_dev
-        within = jnp.arange(cap) - jnp.concatenate(
-            [jnp.zeros(1, counts.dtype),
-             jnp.cumsum(counts)])[jnp.clip(sowner, 0, n_dev - 1)]
-        slot = jnp.clip(sowner, 0, n_dev - 1) * per + \
-            jnp.clip(within, 0, per - 1).astype(jnp.int32)
-        okey = jnp.full((n_dev * per,), jnp.int64(-2**62))
-        osum = jnp.zeros((n_dev * per,), vals.dtype)
-        oval = jnp.zeros((n_dev * per,), bool)
+        owner = ((rep_key.view(jnp.uint64) * jnp.uint64(MIX))
+                 >> jnp.uint64(33)) % jnp.uint64(n_dev)
+        owner = jnp.where(gvalid, owner.astype(jnp.int32), n_dev)
+        # sort groups by owner -> contiguous per-owner regions
+        order = jnp.argsort(owner, stable=True)
+        skey = jnp.take(rep_key, order)
+        ssum = jnp.take(sums, order)
+        sowner = jnp.take(owner, order)
+        owner_c = jnp.clip(sowner, 0, n_dev - 1)
+        counts = jax.ops.segment_sum(
+            (sowner < n_dev).astype(jnp.int32), owner_c,
+            num_segments=n_dev)
+        excl = jnp.cumsum(counts) - counts
+        within = jnp.arange(cap, dtype=jnp.int32) - jnp.take(excl, owner_c)
+        slot = owner_c * per + within
+        oob = jnp.int32(n_dev * per)  # drop target
         put = (sowner < n_dev) & (within < per)
-        okey = okey.at[jnp.where(put, slot, 0)].set(
-            jnp.where(put, skey, okey[0]))
-        osum = osum.at[jnp.where(put, slot, 0)].add(
-            jnp.where(put, ssum, 0))
-        oval = oval.at[jnp.where(put, slot, 0)].set(
-            jnp.where(put, True, oval[0]))
-        # all_to_all: [n_dev, per] -> every device gets its region
-        okey = jax.lax.all_to_all(okey.reshape(n_dev, per), axis_name, 0, 0,
-                                  tiled=False).reshape(-1)
-        osum = jax.lax.all_to_all(osum.reshape(n_dev, per), axis_name, 0, 0,
-                                  tiled=False).reshape(-1)
-        oval = jax.lax.all_to_all(oval.reshape(n_dev, per), axis_name, 0, 0,
-                                  tiled=False).reshape(-1)
-        # final local merge of received partials
-        fk, fs, fv = local_partial(okey, osum, oval)
-        return fk, fs, fv
+        idx = jnp.where(put, slot, oob)
+        okey = jnp.zeros((n_dev * per,), skey.dtype).at[idx].set(
+            skey, mode="drop")
+        osum = jnp.zeros((n_dev * per,), ssum.dtype).at[idx].set(
+            ssum, mode="drop")
+        oval = jnp.zeros((n_dev * per,), bool).at[idx].set(
+            put, mode="drop")
+        # ICI all-to-all: region o of every device lands on device o
+        okey = jax.lax.all_to_all(okey.reshape(n_dev, per), axis_name,
+                                  0, 0).reshape(-1)
+        osum = jax.lax.all_to_all(osum.reshape(n_dev, per), axis_name,
+                                  0, 0).reshape(-1)
+        oval = jax.lax.all_to_all(oval.reshape(n_dev, per), axis_name,
+                                  0, 0).reshape(-1)
+        return _local_sum_by_key(okey, osum, oval)
 
     smapped = shard_map(
         step, mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name)),
-        out_specs=(P(axis_name), P(axis_name), P(axis_name)),
-        check_rep=False)
+        out_specs=(P(axis_name), P(axis_name), P(axis_name)))
     return jax.jit(smapped)
+
+
+def distributed_global_sum(mesh: Mesh, axis_name: str = "data"):
+    """psum-based global reduction (the broadcast/reduce primitive)."""
+    shard_map = jax.shard_map
+
+    def step(vals, valid):
+        local = jnp.sum(jnp.where(valid, vals, 0))
+        return jax.lax.psum(local, axis_name)[None]
+
+    return jax.jit(shard_map(
+        step, mesh=mesh, in_specs=(P(axis_name), P(axis_name)),
+        out_specs=P(axis_name)))
