@@ -1,0 +1,300 @@
+"""TPU window operator.
+
+Reference: GpuWindowExec.scala:338 + GpuWindowExpression.scala (cuDF
+rolling/scan windows, running-window optimization for row_number etc.).
+
+TPU-first: one sort by (partition keys, order keys) per spec, then every
+window function is a segmented scan/reduce over the sorted order:
+  row_number        position - segment_start
+  rank / dense_rank run boundaries + segment-min of run ids
+  lead / lag        shifted gather with same-segment mask
+  agg (whole part.) segment reduce broadcast back through seg ids
+  agg (running/rows frame) prefix sums with segment clamping
+Results are scattered back to the original row order (inverse perm), so
+row identity is preserved for downstream operators.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.schema import Field, Schema
+from ..columnar.column import Column
+from ..columnar.batch import ColumnarBatch, concat_batches
+from ..expr import core as ec
+from ..expr import aggregates as eagg
+from ..expr import window_funcs as wfn
+from ..kernels import canon
+from ..kernels.sort import sorted_words
+from ..plan.logical import Window, WindowFunc
+from .base import PhysicalPlan, OP_TIME, NUM_OUTPUT_ROWS, timed
+from .tpu_basic import TpuExec
+
+
+class TpuWindow(TpuExec):
+    def __init__(self, logical: Window, child: PhysicalPlan):
+        super().__init__(child)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def _node_string(self):
+        return f"TpuWindow[{[w.alias for w in self.logical.window_funcs]}]"
+
+    def execute(self):
+        def run(part):
+            batches = [b for b in part]
+            if not batches:
+                return
+            batch = concat_batches(batches) if len(batches) > 1 else \
+                batches[0]
+            with timed(self.metrics[OP_TIME]):
+                out = self._apply(batch)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+            yield out
+        return [run(p) for p in self.children[0].execute()]
+
+    # ------------------------------------------------------------------
+    def _apply(self, batch: ColumnarBatch) -> ColumnarBatch:
+        schema = batch.schema
+        new_cols: List[Column] = list(batch.columns)
+        fields = list(schema.fields)
+        for wf in self.logical.window_funcs:
+            col = self._eval_window(batch, wf)
+            new_cols.append(col)
+            fields.append(Field(wf.alias, col.dtype, True))
+        return ColumnarBatch(Schema(fields), new_cols, batch.num_rows)
+
+    def _eval_window(self, batch: ColumnarBatch, wf: WindowFunc) -> Column:
+        spec = wf.spec
+        cap = batch.capacity
+        n = batch.num_rows
+        pcols = [ec.eval_as_column(e.bind(batch.schema), batch)
+                 for e in spec.partition_by]
+        ocols = [ec.eval_as_column(o.expr.bind(batch.schema), batch)
+                 for o in spec.order_by]
+
+        pwords = canon.batch_key_words(pcols, n) if pcols else \
+            [jnp.where(jnp.arange(cap) < n, jnp.uint64(1), jnp.uint64(2))]
+        owords = canon.batch_key_words(
+            ocols, n,
+            descending=[not o.ascending for o in spec.order_by],
+            nulls_last=[not o.effective_nulls_first
+                        for o in spec.order_by]) if ocols else []
+
+        all_words = pwords + owords
+        sorted_ws, perm = sorted_words(all_words)
+        live = sorted_ws[0] != jnp.uint64(2)
+
+        npw = len(pwords)
+        seg_boundary = canon.words_equal_adjacent(sorted_ws[:npw]) & live
+        seg = jnp.maximum(jnp.cumsum(seg_boundary.astype(jnp.int32)) - 1, 0)
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        # position of segment start, broadcast per row
+        seg_start = jax.ops.segment_min(
+            jnp.where(live, pos, jnp.int64(cap)), seg, num_segments=cap)
+        row_in_seg = pos - jnp.take(seg_start, seg)
+
+        func = wf.func
+        if isinstance(func, wfn.RowNumber):
+            vals = (row_in_seg + 1).astype(jnp.int64)
+            out_valid = live
+            out_dtype = T.INT64
+        elif isinstance(func, (wfn.Rank, wfn.DenseRank)):
+            run_boundary = canon.words_equal_adjacent(sorted_ws) & live
+            run_id = jnp.maximum(
+                jnp.cumsum(run_boundary.astype(jnp.int32)) - 1, 0)
+            if isinstance(func, wfn.Rank):
+                run_first = jax.ops.segment_min(
+                    jnp.where(live, pos, jnp.int64(cap)), run_id,
+                    num_segments=cap)
+                vals = (jnp.take(run_first, run_id) -
+                        jnp.take(seg_start, seg) + 1).astype(jnp.int64)
+            else:
+                seg_first_run = jax.ops.segment_min(
+                    jnp.where(live, run_id.astype(jnp.int64),
+                              jnp.int64(cap)), seg, num_segments=cap)
+                vals = (run_id - jnp.take(seg_first_run, seg) + 1
+                        ).astype(jnp.int64)
+            out_valid = live
+            out_dtype = T.INT64
+        elif isinstance(func, (wfn.Lead, wfn.Lag)):
+            src = ec.eval_as_column(func.children[0].bind(batch.schema),
+                                    batch)
+            off = func.offset if isinstance(func, wfn.Lead) else -func.offset
+            shifted_pos = pos + off
+            inb = (shifted_pos >= 0) & (shifted_pos < cap)
+            sp = jnp.clip(shifted_pos, 0, cap - 1).astype(jnp.int32)
+            same_seg = inb & (jnp.take(seg, sp) == seg) & \
+                jnp.take(live, sp) & live
+            src_sorted_idx = jnp.take(perm, sp)
+            sorted_vals = src.gather(src_sorted_idx)
+            valid = sorted_vals.validity & same_seg
+            # scatter back to original order
+            inv = jnp.argsort(perm)
+            out = sorted_vals.gather(inv)
+            return out.mask_validity(jnp.take(valid, inv) &
+                                     (jnp.arange(cap) < n))
+        elif isinstance(func, eagg.AggregateFunction):
+            return self._window_agg(batch, func, spec, perm, seg, live,
+                                    row_in_seg, seg_start, n)
+        else:
+            raise NotImplementedError(f"window function {func.name}")
+
+        inv = jnp.argsort(perm)
+        vals_orig = jnp.take(vals, inv)
+        valid_orig = jnp.take(out_valid, inv) & (jnp.arange(cap) < n)
+        return Column(out_dtype, vals_orig.astype(out_dtype.np_dtype),
+                      valid_orig)
+
+    # ------------------------------------------------------------------
+    def _window_agg(self, batch, func, spec, perm, seg, live, row_in_seg,
+                    seg_start, n) -> Column:
+        cap = batch.capacity
+        child = func.children[0] if func.children else None
+        if child is not None:
+            src = ec.eval_as_column(child.bind(batch.schema), batch)
+            sv = jnp.take(src.data, perm) if not hasattr(src, "offsets") \
+                else None
+            if sv is None:
+                raise NotImplementedError("string window aggregates")
+            sok = jnp.take(src.validity, perm) & live
+        else:
+            sv = jnp.ones(cap, jnp.int64)
+            sok = live
+
+        kind, frame_lo, frame_hi = spec.frame
+        unbounded = frame_lo is None and frame_hi is None
+        out_dtype = func.dtype()
+
+        if unbounded or not spec.order_by:
+            # whole-partition aggregate broadcast back
+            vals, ok = self._seg_reduce(func, sv, sok, seg, cap)
+            vals = jnp.take(vals, seg)
+            ok = jnp.take(ok, seg) & live
+        else:
+            lo = frame_lo  # None = unbounded preceding
+            hi = frame_hi if frame_hi is not None else None
+            vals, ok = self._frame_agg(func, sv, sok, seg, row_in_seg,
+                                       seg_start, cap, lo, hi)
+            ok = ok & live
+        inv = jnp.argsort(perm)
+        vals_orig = jnp.take(vals, inv)
+        ok_orig = jnp.take(ok, inv) & (jnp.arange(cap) < n)
+        return Column(out_dtype, vals_orig.astype(out_dtype.np_dtype),
+                      ok_orig)
+
+    def _seg_reduce(self, func, sv, sok, seg, cap):
+        contrib_ok = sok
+        if isinstance(func, eagg.Sum):
+            vals = jax.ops.segment_sum(
+                jnp.where(contrib_ok, sv.astype(jnp.float64)
+                          if func.dtype().is_fractional else
+                          sv.astype(jnp.int64), 0), seg, num_segments=cap)
+            cnt = jax.ops.segment_sum(contrib_ok.astype(jnp.int64), seg,
+                                      num_segments=cap)
+            return vals, cnt > 0
+        if isinstance(func, eagg.Count):
+            vals = jax.ops.segment_sum(contrib_ok.astype(jnp.int64), seg,
+                                       num_segments=cap)
+            return vals, jnp.ones_like(vals, bool)
+        if isinstance(func, eagg.Average):
+            s = jax.ops.segment_sum(
+                jnp.where(contrib_ok, sv.astype(jnp.float64), 0.0), seg,
+                num_segments=cap)
+            c = jax.ops.segment_sum(contrib_ok.astype(jnp.int64), seg,
+                                    num_segments=cap)
+            return s / jnp.maximum(c, 1), c > 0
+        if isinstance(func, eagg.Min):
+            big = jnp.asarray(jnp.inf if jnp.issubdtype(sv.dtype,
+                                                        jnp.floating)
+                              else jnp.iinfo(sv.dtype).max, sv.dtype)
+            vals = jax.ops.segment_min(jnp.where(contrib_ok, sv, big), seg,
+                                       num_segments=cap)
+            cnt = jax.ops.segment_sum(contrib_ok.astype(jnp.int64), seg,
+                                      num_segments=cap)
+            return vals, cnt > 0
+        if isinstance(func, eagg.Max):
+            small = jnp.asarray(-jnp.inf if jnp.issubdtype(sv.dtype,
+                                                           jnp.floating)
+                                else jnp.iinfo(sv.dtype).min, sv.dtype)
+            vals = jax.ops.segment_max(jnp.where(contrib_ok, sv, small), seg,
+                                       num_segments=cap)
+            cnt = jax.ops.segment_sum(contrib_ok.astype(jnp.int64), seg,
+                                      num_segments=cap)
+            return vals, cnt > 0
+        raise NotImplementedError(f"window aggregate {func.name}")
+
+    def _frame_agg(self, func, sv, sok, seg, row_in_seg, seg_start, cap,
+                   lo: Optional[int], hi: Optional[int]):
+        """ROWS frame [lo, hi] relative offsets (None = unbounded)."""
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        if isinstance(func, (eagg.Sum, eagg.Count, eagg.Average)):
+            acc_dtype = jnp.float64 if not isinstance(func, eagg.Count) \
+                else jnp.int64
+            contrib = jnp.where(sok, sv.astype(acc_dtype)
+                                if not isinstance(func, eagg.Count)
+                                else jnp.ones(cap, jnp.int64),
+                                jnp.zeros(cap, acc_dtype))
+            ps = jnp.cumsum(contrib)          # inclusive prefix sum
+            cnt = jnp.cumsum(sok.astype(jnp.int64))
+            seg_start_pos = jnp.take(seg_start, seg)
+            seg_len = jax.ops.segment_sum(
+                jnp.ones(cap, jnp.int64), seg, num_segments=cap)
+            seg_end_pos = seg_start_pos + jnp.take(seg_len, seg) - 1
+            lo_pos = seg_start_pos if lo is None else \
+                jnp.maximum(pos + lo, seg_start_pos)
+            hi_pos = seg_end_pos if hi is None else \
+                jnp.minimum(pos + hi, seg_end_pos)
+            hi_c = jnp.clip(hi_pos, 0, cap - 1).astype(jnp.int32)
+            lo_c = jnp.clip(lo_pos - 1, -1, cap - 1)
+            ps_hi = jnp.take(ps, hi_c)
+            ps_lo = jnp.where(lo_c < 0, 0,
+                              jnp.take(ps, jnp.maximum(lo_c, 0)))
+            cnt_hi = jnp.take(cnt, hi_c)
+            cnt_lo = jnp.where(lo_c < 0, 0,
+                               jnp.take(cnt, jnp.maximum(lo_c, 0)))
+            s = ps_hi - ps_lo
+            c = cnt_hi - cnt_lo
+            empty = hi_pos < lo_pos
+            if isinstance(func, eagg.Count):
+                return jnp.where(empty, 0, c), jnp.ones(cap, bool)
+            if isinstance(func, eagg.Average):
+                return s / jnp.maximum(c, 1), (c > 0) & ~empty
+            return s, (c > 0) & ~empty
+        if isinstance(func, (eagg.Min, eagg.Max)) and lo is None and \
+                hi == 0:
+            # running min/max: segmented inclusive scan
+            is_min = isinstance(func, eagg.Min)
+            if jnp.issubdtype(sv.dtype, jnp.floating):
+                ident = jnp.asarray(jnp.inf if is_min else -jnp.inf,
+                                    sv.dtype)
+            else:
+                info = jnp.iinfo(sv.dtype)
+                ident = jnp.asarray(info.max if is_min else info.min,
+                                    sv.dtype)
+            x = jnp.where(sok, sv, ident)
+            reset = row_in_seg == 0
+
+            def combine(a, b):
+                av, ar = a
+                bv, br = b
+                merged = jnp.where(br, bv,
+                                   jnp.minimum(av, bv) if is_min
+                                   else jnp.maximum(av, bv))
+                return merged, ar | br
+            scanned, _ = jax.lax.associative_scan(combine, (x, reset))
+            cnt = jnp.cumsum(sok.astype(jnp.int64))
+            seg_start_pos = jnp.take(seg_start, seg)
+            cnt_before = jnp.where(
+                seg_start_pos > 0,
+                jnp.take(cnt, jnp.clip(seg_start_pos - 1, 0, cap - 1)), 0)
+            has = (cnt - cnt_before) > 0
+            return scanned, has
+        raise NotImplementedError(
+            f"window frame ({lo},{hi}) for {func.name}")

@@ -199,7 +199,28 @@ class PlanMeta:
                     f"output column {f.name}: nested type {f.dtype.name} "
                     f"not yet device-resident")
         if isinstance(p, L.Window):
-            self.reasons.append("window exec not yet implemented on TPU")
+            from ..expr import window_funcs as wfn
+            for wf in p.window_funcs:
+                f = wf.func
+                ok = isinstance(f, (wfn.RowNumber, wfn.Rank, wfn.DenseRank,
+                                    wfn.Lead, wfn.Lag, eagg.Sum, eagg.Count,
+                                    eagg.Min, eagg.Max, eagg.Average))
+                if not ok:
+                    self.reasons.append(
+                        f"window function {f.name} not implemented on TPU")
+                if f.children and f.children[0].dtype() == T.STRING and \
+                        isinstance(f, (eagg.Sum, eagg.Min, eagg.Max,
+                                       eagg.Average)):
+                    self.reasons.append(
+                        "string window aggregates not on TPU yet")
+                kind, lo, hi = wf.spec.frame
+                if kind != "rows":
+                    self.reasons.append("RANGE frames not on TPU yet")
+                if isinstance(f, (eagg.Min, eagg.Max)) and not (
+                        (lo is None and hi is None) or
+                        (lo is None and hi == 0) or not wf.spec.order_by):
+                    self.reasons.append(
+                        "bounded min/max window frames not on TPU yet")
         for c in self.children:
             c.tag()
 
@@ -358,7 +379,25 @@ class Planner:
         if isinstance(p, L.WriteFile):
             from ..io.planner import tpu_write_exec
             return tpu_write_exec(p, children[0], self.conf)
+        if isinstance(p, L.Window):
+            return self._plan_window(p, children[0])
         raise NotImplementedError(f"no TPU conversion for {p.name}")
+
+    def _plan_window(self, p: L.Window, child: PhysicalPlan) -> PhysicalPlan:
+        from ..exec.tpu_window import TpuWindow
+        nparts = child.num_partitions_hint()
+        pby = p.window_funcs[0].spec.partition_by
+        same_keys = all(
+            [repr(e) for e in wf.spec.partition_by] ==
+            [repr(e) for e in pby] for wf in p.window_funcs)
+        if nparts > 1:
+            if pby and same_keys:
+                part = HashPartitioner(pby, min(self.default_partitions,
+                                                nparts))
+                child = EX.TpuShuffleExchange(child, part)
+            else:
+                child = EX.TpuCoalescePartitions(child)
+        return TpuWindow(p, child)
 
     # -- aggregate: partial -> exchange -> final (aggregate.scala modes) ---
     def _plan_aggregate(self, p: L.Aggregate,

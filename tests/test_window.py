@@ -1,0 +1,62 @@
+"""Window function tests — reference: window_function_test.py pattern."""
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+
+from harness import assert_tpu_and_cpu_are_equal_collect
+from data_gen import IntGen, FloatGen, KeyGen, gen_df
+
+N = 200
+
+
+class TestWindow:
+    def test_row_number(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"k": KeyGen(cardinality=8),
+                                 "v": IntGen(lo=-100, hi=100)}, N)
+            .with_window("rn", F.row_number(), partition_by=["k"],
+                         order_by=["v", "k"]))
+
+    def test_rank_dense_rank(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"k": KeyGen(cardinality=6),
+                                 "v": KeyGen(cardinality=10,
+                                             null_ratio=0.0)}, N)
+            .with_window("rk", F.rank(), partition_by=["k"],
+                         order_by=["v"]))
+
+    def test_lead_lag(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"k": KeyGen(cardinality=5),
+                                 "v": IntGen(lo=0, hi=1000,
+                                             null_ratio=0.0),
+                                 "x": FloatGen(null_ratio=0.2)}, N)
+            .with_window("ld", F.lead("x"), partition_by=["k"],
+                         order_by=["v", "x"])
+            .with_window("lg", F.lag("x"), partition_by=["k"],
+                         order_by=["v", "x"]))
+
+    def test_partition_aggregate(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"k": KeyGen(cardinality=7),
+                                 "v": FloatGen(no_nans=True)}, N)
+            .with_window("s", F.sum("v"), partition_by=["k"],
+                         frame=("rows", None, None))
+            .with_window("c", F.count("v"), partition_by=["k"],
+                         frame=("rows", None, None)))
+
+    def test_running_sum(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"k": KeyGen(cardinality=4),
+                                 "o": IntGen(lo=0, hi=10**6,
+                                             null_ratio=0.0),
+                                 "v": IntGen(lo=-50, hi=50)}, N)
+            .with_window("rs", F.sum("v"), partition_by=["k"],
+                         order_by=["o"], frame=("rows", None, 0)))
+
+    def test_global_window(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"v": IntGen(lo=0, hi=100,
+                                             null_ratio=0.0)}, 50)
+            .with_window("rn", F.row_number(), partition_by=[],
+                         order_by=["v"]))
