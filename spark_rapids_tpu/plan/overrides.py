@@ -96,6 +96,16 @@ for _cls in [eagg.Sum, eagg.Count, eagg.Min, eagg.Max, eagg.Average,
              eagg.First, eagg.Last]:
     expr_rule(_cls, TS.ALL_SUPPORTED)
 
+# Python UDFs stay on the columnar plan with an Arrow host exchange,
+# the GpuArrowEvalPythonExec model (SURVEY.md §2.8)
+from ..udf.python_udf import PythonUDF as _PyUDF, PandasUDF as _PdUDF  # noqa: E402
+expr_rule(_PyUDF, TS.ALL_SUPPORTED)
+expr_rule(_PdUDF, TS.ALL_SUPPORTED)
+from ..expr import window_funcs as _wfn  # noqa: E402
+for _cls in [_wfn.RowNumber, _wfn.Rank, _wfn.DenseRank, _wfn.Lead,
+             _wfn.Lag]:
+    expr_rule(_cls, TS.ALL_SUPPORTED)
+
 
 class ExprMeta:
     """Per-expression tagging (BaseExprMeta role, RapidsMeta.scala:686)."""

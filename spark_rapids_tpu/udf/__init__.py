@@ -1,0 +1,58 @@
+"""UDF subsystem: bytecode->expression compiler + Python/pandas UDFs
+
+(reference: udf-compiler/ and the RapidsUDF interface, SURVEY.md §2.8).
+"""
+from typing import Callable, Optional
+
+from ..columnar import dtypes as T
+from ..expr import core as ec
+from .compiler import compile_udf  # noqa: F401
+from .python_udf import PythonUDF, PandasUDF  # noqa: F401
+
+
+def udf(fn: Callable = None, return_type=None):
+    """Decorator/factory: wrap a python function as a column UDF.
+
+    The bytecode compiler tries to translate the function body into
+    native expressions (runs fully on TPU); if it can't, the UDF runs
+    row-wise on the host — the reference's silent-fallback contract
+    (udf-compiler Plugin.scala:29).
+
+        my_udf = udf(lambda x: x * 2 + 1, return_type=T.INT64)
+        df.select(my_udf(F.col("a")))
+    """
+    if fn is None:
+        return lambda f: udf(f, return_type)
+    rt = return_type or T.FLOAT64
+    if isinstance(rt, str):
+        rt = T.dtype_from_name(rt)
+
+    def call(*cols):
+        from ..api.column import Col, _expr
+        arg_exprs = [_expr(c) for c in cols]
+        compiled = compile_udf(fn, arg_exprs)
+        if compiled is not None:
+            return Col(compiled)
+        return Col(PythonUDF(fn, rt, arg_exprs,
+                             name=getattr(fn, "__name__", "pyudf")))
+    call.fn = fn
+    call.return_type = rt
+    return call
+
+
+def pandas_udf(fn: Callable = None, return_type=None):
+    """Vectorized pandas UDF (Series -> Series)."""
+    if fn is None:
+        return lambda f: pandas_udf(f, return_type)
+    rt = return_type or T.FLOAT64
+    if isinstance(rt, str):
+        rt = T.dtype_from_name(rt)
+
+    def call(*cols):
+        from ..api.column import Col, _expr
+        arg_exprs = [_expr(c) for c in cols]
+        return Col(PandasUDF(fn, rt, arg_exprs,
+                             name=getattr(fn, "__name__", "pandas_udf")))
+    call.fn = fn
+    call.return_type = rt
+    return call

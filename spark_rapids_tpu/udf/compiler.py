@@ -1,0 +1,217 @@
+"""UDF bytecode -> expression compiler.
+
+Reference: udf-compiler/ (SURVEY.md §2.8): decompiles Scala UDF *JVM*
+bytecode with javassist, symbolically executes opcodes into Catalyst
+expressions (CFG.scala, Instruction.scala:198, CatalystExpressionBuilder),
+silently falling back when not compilable.
+
+TPU-native equivalent: the UDFs here are *Python* lambdas, so this module
+symbolically executes CPython bytecode (``dis``) into the framework's
+Expression trees.  Straight-line arithmetic/comparison/boolean code,
+ternaries and chained conditionals compile; anything else falls back to a
+row-wise Python UDF (udf/python_udf.py), mirroring the reference's silent
+fallback contract.
+"""
+from __future__ import annotations
+
+import dis
+import math
+from typing import Any, Dict, List, Optional
+
+from ..columnar import dtypes as T
+from ..expr import core as ec
+from ..expr import (arithmetic as ea, predicates as ep, conditional as econd,
+                    string_ops as es)
+
+
+class CannotCompile(Exception):
+    pass
+
+
+_BINARY_OPS = {
+    "+": ea.Add, "-": ea.Subtract, "*": ea.Multiply, "/": ea.Divide,
+    "//": ea.IntegralDivide, "%": ea.Remainder, "**": ea.Pow,
+    "&": ea.BitwiseAnd, "|": ea.BitwiseOr, "^": ea.BitwiseXor,
+    "<<": ea.ShiftLeft, ">>": ea.ShiftRight,
+}
+
+_COMPARE_OPS = {
+    "<": ep.LessThan, "<=": ep.LessThanOrEqual, ">": ep.GreaterThan,
+    ">=": ep.GreaterThanOrEqual, "==": ep.EqualTo,
+}
+
+_GLOBAL_FUNCS = {
+    "abs": lambda a: ea.Abs(a),
+    "min": lambda a, b: ea.Least(a, b),
+    "max": lambda a, b: ea.Greatest(a, b),
+    "len": lambda a: es.Length(a),
+}
+
+_MATH_FUNCS = {
+    "sqrt": ea.Sqrt, "exp": ea.Exp, "log": ea.Log, "log2": ea.Log2,
+    "log10": ea.Log10, "sin": ea.Sin, "cos": ea.Cos, "tan": ea.Tan,
+    "asin": ea.Asin, "acos": ea.Acos, "atan": ea.Atan, "sinh": ea.Sinh,
+    "cosh": ea.Cosh, "tanh": ea.Tanh, "floor": ea.Floor, "ceil": ea.Ceil,
+}
+
+_STR_METHODS = {
+    "upper": es.Upper, "lower": es.Lower, "strip": es.StringTrim,
+    "lstrip": es.StringTrimLeft, "rstrip": es.StringTrimRight,
+}
+
+
+class _Block:
+    """Basic-block symbolic executor (reference: CFG.scala basic blocks)."""
+
+    def __init__(self, instructions: List[dis.Instruction],
+                 offset_index: Dict[int, int]):
+        self.ins = instructions
+        self.offset_index = offset_index
+
+    def run(self, start: int, stack: List[Any],
+            local_vars: Dict[str, Any]) -> ec.Expression:
+        """Symbolically execute from instruction index ``start`` until
+
+        RETURN; returns the resulting expression.  Branches recurse into
+        both paths and merge with If/CaseWhen (State.scala fold analogue).
+        """
+        i = start
+        stack = list(stack)
+        local_vars = dict(local_vars)
+        while i < len(self.ins):
+            ins = self.ins[i]
+            op = ins.opname
+            if op in ("RESUME", "PRECALL", "CACHE", "PUSH_NULL", "NOP",
+                      "COPY_FREE_VARS", "MAKE_CELL"):
+                pass
+            elif op == "LOAD_FAST":
+                if ins.argval not in local_vars:
+                    raise CannotCompile(f"unbound local {ins.argval}")
+                stack.append(local_vars[ins.argval])
+            elif op == "STORE_FAST":
+                local_vars[ins.argval] = stack.pop()
+            elif op == "LOAD_CONST":
+                v = ins.argval
+                if v is None or isinstance(v, (bool, int, float, str)):
+                    stack.append(ec.Literal(v) if v is not None
+                                 else ec.Literal(None, T.NULL))
+                else:
+                    raise CannotCompile(f"const {v!r}")
+            elif op in ("LOAD_GLOBAL", "LOAD_NAME"):
+                name = ins.argval
+                if name in _GLOBAL_FUNCS:
+                    stack.append(("global_fn", name))
+                elif name == "math":
+                    stack.append(("module", "math"))
+                else:
+                    raise CannotCompile(f"global {name}")
+            elif op in ("LOAD_ATTR", "LOAD_METHOD"):
+                recv = stack.pop()
+                name = ins.argval
+                if isinstance(recv, tuple) and recv[0] == "module" and \
+                        recv[1] == "math":
+                    if name not in _MATH_FUNCS:
+                        raise CannotCompile(f"math.{name}")
+                    stack.append(("math_fn", name))
+                elif isinstance(recv, ec.Expression) and \
+                        name in _STR_METHODS:
+                    stack.append(("str_method", name, recv))
+                else:
+                    raise CannotCompile(f"attr {name}")
+            elif op == "BINARY_OP":
+                b = stack.pop()
+                a = stack.pop()
+                sym = ins.argrepr.rstrip("=")
+                cls = _BINARY_OPS.get(sym)
+                if cls is None:
+                    raise CannotCompile(f"binary op {ins.argrepr}")
+                stack.append(cls(_as_expr(a), _as_expr(b)))
+            elif op == "COMPARE_OP":
+                b = stack.pop()
+                a = stack.pop()
+                sym = ins.argval if isinstance(ins.argval, str) else \
+                    ins.argrepr
+                if sym == "!=":
+                    stack.append(ep.Not(ep.EqualTo(_as_expr(a),
+                                                   _as_expr(b))))
+                else:
+                    cls = _COMPARE_OPS.get(sym)
+                    if cls is None:
+                        raise CannotCompile(f"compare {sym}")
+                    stack.append(cls(_as_expr(a), _as_expr(b)))
+            elif op == "UNARY_NEGATIVE":
+                stack.append(ea.UnaryMinus(_as_expr(stack.pop())))
+            elif op == "UNARY_NOT":
+                stack.append(ep.Not(_as_expr(stack.pop())))
+            elif op in ("CALL", "CALL_FUNCTION", "CALL_METHOD"):
+                argc = ins.arg or 0
+                args = [stack.pop() for _ in range(argc)][::-1]
+                fn = stack.pop()
+                if isinstance(fn, tuple) and fn[0] == "global_fn":
+                    builder = _GLOBAL_FUNCS[fn[1]]
+                    stack.append(builder(*[_as_expr(a) for a in args]))
+                elif isinstance(fn, tuple) and fn[0] == "math_fn":
+                    stack.append(_MATH_FUNCS[fn[1]](_as_expr(args[0])))
+                elif isinstance(fn, tuple) and fn[0] == "str_method":
+                    stack.append(_STR_METHODS[fn[1]](_as_expr(fn[2])))
+                else:
+                    raise CannotCompile(f"call of {fn!r}")
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_FORWARD_IF_FALSE",
+                        "POP_JUMP_IF_TRUE", "POP_JUMP_FORWARD_IF_TRUE"):
+                cond = _as_expr(stack.pop())
+                if "TRUE" in op:
+                    cond = ep.Not(cond)
+                target = self.offset_index[ins.argval]
+                # true path: fall through; false path: jump target
+                true_val = self.run(i + 1, stack, local_vars)
+                false_val = self.run(target, stack, local_vars)
+                return econd.If(cond, true_val, false_val)
+            elif op in ("JUMP_FORWARD", "JUMP_BACKWARD"):
+                i = self.offset_index[ins.argval]
+                continue
+            elif op == "RETURN_VALUE":
+                return _as_expr(stack.pop())
+            elif op == "RETURN_CONST":
+                v = ins.argval
+                return ec.Literal(v) if v is not None else \
+                    ec.Literal(None, T.NULL)
+            elif op == "TO_BOOL":
+                pass  # 3.13 inserts explicit bool coercion before jumps
+            else:
+                raise CannotCompile(f"opcode {op}")
+            i += 1
+        raise CannotCompile("fell off end without RETURN")
+
+
+def _as_expr(v) -> ec.Expression:
+    if isinstance(v, ec.Expression):
+        return v
+    raise CannotCompile(f"non-expression value {v!r}")
+
+
+def compile_udf(fn, arg_exprs: List[ec.Expression]
+                ) -> Optional[ec.Expression]:
+    """Try to compile a Python function of N scalar args into an
+
+    Expression over ``arg_exprs``.  Returns None when not compilable
+    (the caller falls back to a row-wise Python UDF)."""
+    try:
+        code = fn.__code__
+    except AttributeError:
+        return None
+    if code.co_argcount != len(arg_exprs):
+        return None
+    if fn.__closure__:
+        return None
+    try:
+        instructions = list(dis.get_instructions(fn))
+        offset_index = {ins.offset: idx
+                        for idx, ins in enumerate(instructions)}
+        local_vars = {name: e for name, e in
+                      zip(code.co_varnames, arg_exprs)}
+        block = _Block(instructions, offset_index)
+        return block.run(0, [], local_vars)
+    except CannotCompile:
+        return None
+    except Exception:
+        return None

@@ -1,0 +1,93 @@
+"""Row-wise and vectorized (pandas) Python UDF expressions.
+
+Reference: GpuArrowEvalPythonExec + python/ worker integration (SURVEY.md
+§2.8): batches cross to Python through Arrow.  Here the "Python worker"
+is in-process: row UDFs evaluate over host lists, pandas UDFs over
+arrow->pandas Series — the same Arrow-batch exchange contract without a
+separate daemon (single-process runtime).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..columnar import dtypes as T
+from ..columnar.column import Column, StringColumn
+from ..columnar.batch import ColumnarBatch
+from ..expr import core as ec
+
+
+class PythonUDF(ec.Expression):
+    """Row-at-a-time python function over N columns (fallback path)."""
+
+    def __init__(self, fn: Callable, return_type: T.DType,
+                 children: List[ec.Expression], name: str = "pyudf"):
+        self.fn = fn
+        self.return_type = return_type
+        self.children = list(children)
+        self._name = name
+
+    @property
+    def name(self):
+        return self._name
+
+    def with_children(self, c):
+        return PythonUDF(self.fn, self.return_type, c, self._name)
+
+    def dtype(self):
+        return self.return_type
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        n = batch.num_rows
+        cols = [ec.eval_as_column(c, batch) for c in self.children]
+        lists = [c.to_pylist(n) for c in cols]
+        out = []
+        for row in zip(*lists) if lists else [()] * n:
+            try:
+                out.append(self.fn(*row))
+            except Exception:
+                out.append(None)
+        pad = [None] * (batch.capacity - n)
+        return Column.from_numpy(out + pad, dtype=self.return_type,
+                                 capacity=batch.capacity)
+
+
+class PandasUDF(ec.Expression):
+    """Vectorized UDF: fn(pandas.Series...) -> pandas.Series.
+
+    Reference: Pandas UDF execs (GpuArrowEvalPythonExec) — input batches
+    convert to Arrow then pandas, results convert back.
+    """
+
+    def __init__(self, fn: Callable, return_type: T.DType,
+                 children: List[ec.Expression], name: str = "pandas_udf"):
+        self.fn = fn
+        self.return_type = return_type
+        self.children = list(children)
+        self._name = name
+
+    @property
+    def name(self):
+        return self._name
+
+    def with_children(self, c):
+        return PandasUDF(self.fn, self.return_type, c, self._name)
+
+    def dtype(self):
+        return self.return_type
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        from ..columnar.arrow import column_to_arrow
+        n = batch.num_rows
+        series = []
+        for c in self.children:
+            col = ec.eval_as_column(c, batch)
+            series.append(column_to_arrow(col, n).to_pandas())
+        result = self.fn(*series)
+        vals = list(result)
+        pad = [None] * (batch.capacity - n)
+        clean = [None if v is None or (isinstance(v, float) and v != v and
+                                       self.return_type != T.FLOAT64 and
+                                       self.return_type != T.FLOAT32)
+                 else v for v in vals]
+        return Column.from_numpy(clean + pad, dtype=self.return_type,
+                                 capacity=batch.capacity)
