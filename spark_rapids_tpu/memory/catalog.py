@@ -58,7 +58,8 @@ class BufferCatalog:
 
     def __init__(self, spill_dir: str = "/tmp/spark_rapids_tpu_spill",
                  device_limit: int = 28 << 30,
-                 host_limit: int = 8 << 30):
+                 host_limit: int = 8 << 30,
+                 use_native_arena: bool = True):
         self._entries: Dict[str, BufferEntry] = {}
         self._lock = threading.RLock()
         self.spill_dir = spill_dir
@@ -69,6 +70,15 @@ class BufferCatalog:
         self.disk_bytes = 0
         self.spilled_device_to_host = 0
         self.spilled_host_to_disk = 0
+        # native host slab arena for the HOST tier (pinned-pool role);
+        # graceful fallback to python-heap payloads if the build fails
+        self.arena = None
+        if use_native_arena:
+            try:
+                from ..native import HostArena
+                self.arena = HostArena(min(host_limit, 2 << 30))
+            except Exception:
+                self.arena = None
 
     @classmethod
     def get(cls) -> "BufferCatalog":
@@ -103,10 +113,16 @@ class BufferCatalog:
                 self.device_bytes -= e.nbytes
             elif e.tier == StorageTier.HOST:
                 self.host_bytes -= e.nbytes
+                p = e.host_payload
+                if isinstance(p, tuple) and p and p[0] == "arena" and \
+                        self.arena is not None:
+                    self.arena.free(p[5])
             else:
                 self.disk_bytes -= e.nbytes
                 if e.disk_path and os.path.exists(e.disk_path):
                     os.unlink(e.disk_path)
+                if e.disk_path and os.path.exists(e.disk_path + ".raw"):
+                    os.unlink(e.disk_path + ".raw")
 
     # -- acquire (may unspill, like RapidsBufferCatalog.acquireBuffer) -----
     def acquire(self, buffer_id: str):
@@ -151,18 +167,67 @@ class BufferCatalog:
         return ColumnarBatch(schema, cols, num_rows)
 
     def _spill_entry_to_host(self, e: BufferEntry):
-        e.host_payload = self._serialize(e.device_obj)
+        payload = self._serialize(e.device_obj)
+        if self.arena is not None:
+            payload = self._pack_into_arena(payload)
+        e.host_payload = payload
         e.device_obj = None
         e.tier = StorageTier.HOST
         self.device_bytes -= e.nbytes
         self.host_bytes += e.nbytes
         self.spilled_device_to_host += e.nbytes
 
+    # -- native-arena packing (host staging slab; SURVEY.md §2.10.2) -------
+    def _pack_into_arena(self, payload):
+        schema, num_rows, kinds, bufs = payload
+        metas = [(b.dtype.str, b.shape) for b in bufs]
+        total = sum(int(b.nbytes) for b in bufs)
+        try:
+            off = self.arena.alloc(max(total, 1))
+        except MemoryError:
+            return payload  # arena full: keep python-heap payload
+        pos = off
+        for b in bufs:
+            nb = int(b.nbytes)
+            if nb:
+                self.arena.view(pos, nb)[:] = b.reshape(-1).view(np.uint8)
+            pos += nb
+        return ("arena", schema, num_rows, kinds, metas, off, total)
+
+    def _unpack_payload(self, payload):
+        if not (isinstance(payload, tuple) and payload
+                and payload[0] == "arena"):
+            return payload, None
+        _, schema, num_rows, kinds, metas, off, total = payload
+        bufs = []
+        pos = off
+        for dtype_str, shape in metas:
+            dt = np.dtype(dtype_str)
+            count = int(np.prod(shape)) if shape else 1
+            nb = count * dt.itemsize
+            arr = np.empty(shape, dtype=dt)
+            if nb:
+                arr.reshape(-1).view(np.uint8)[:] = self.arena.view(pos, nb)
+            bufs.append(arr)
+            pos += nb
+        self.arena.free(off)
+        return (schema, num_rows, kinds, bufs), (off, total)
+
     def _spill_entry_to_disk(self, e: BufferEntry):
         os.makedirs(self.spill_dir, exist_ok=True)
         path = os.path.join(self.spill_dir, f"{e.buffer_id}.spill")
-        with open(path, "wb") as f:
-            pickle.dump(e.host_payload, f, protocol=4)
+        payload = e.host_payload
+        if isinstance(payload, tuple) and payload and payload[0] == "arena":
+            # stream the slab region straight to the file (native fast path)
+            _, schema, num_rows, kinds, metas, off, total = payload
+            self.arena.write_file(off, max(total, 1), path + ".raw")
+            self.arena.free(off)
+            with open(path, "wb") as f:
+                pickle.dump(("arena_file", schema, num_rows, kinds, metas,
+                             total), f, protocol=4)
+        else:
+            with open(path, "wb") as f:
+                pickle.dump(payload, f, protocol=4)
         e.host_payload = None
         e.disk_path = path
         e.tier = StorageTier.DISK
@@ -171,7 +236,8 @@ class BufferCatalog:
         self.spilled_host_to_disk += e.nbytes
 
     def _unspill_host(self, e: BufferEntry):
-        obj = self._deserialize(e.host_payload)
+        payload, _ = self._unpack_payload(e.host_payload)
+        obj = self._deserialize(payload)
         e.host_payload = None
         e.device_obj = obj
         e.tier = StorageTier.DEVICE
@@ -182,6 +248,13 @@ class BufferCatalog:
     def _unspill_disk(self, e: BufferEntry):
         with open(e.disk_path, "rb") as f:
             payload = pickle.load(f)
+        if isinstance(payload, tuple) and payload and \
+                payload[0] == "arena_file":
+            _, schema, num_rows, kinds, metas, total = payload
+            off = self.arena.alloc(max(total, 1))
+            self.arena.read_file(off, max(total, 1), e.disk_path + ".raw")
+            os.unlink(e.disk_path + ".raw")
+            payload = ("arena", schema, num_rows, kinds, metas, off, total)
         os.unlink(e.disk_path)
         e.disk_path = None
         e.host_payload = payload

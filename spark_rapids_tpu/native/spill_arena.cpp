@@ -1,0 +1,163 @@
+// Host staging + spill arena.
+//
+// Role parity (SURVEY.md §2.10): the reference's native memory layer is
+// RMM (device pool) + pinned host staging buffers + RapidsDiskStore file
+// IO.  On TPU, XLA/PJRT owns HBM, so the native layer owns the *host*
+// side: a slab arena for staged/spilled buffers (no per-buffer malloc
+// churn, stable addresses for zero-copy numpy views) and streaming
+// spill-file IO for the disk tier.
+//
+// C API (ctypes-friendly), all thread-safe:
+//   arena_create(capacity)                -> handle
+//   arena_alloc(h, nbytes)               -> offset (or -1)
+//   arena_free(h, offset)
+//   arena_base(h)                        -> void* slab base
+//   arena_used(h) / arena_capacity(h)
+//   arena_write_file(h, off, n, path)    -> 0/errno  (spill to disk)
+//   arena_read_file(h, off, n, path)     -> 0/errno  (unspill)
+//   arena_destroy(h)
+//
+// Allocation strategy: first-fit free list with coalescing on free —
+// the same shape as RMM's arena allocator (SURVEY.md §2.3), simple and
+// predictable for large columnar buffers.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cerrno>
+#include <map>
+#include <mutex>
+#include <new>
+
+namespace {
+
+struct Arena {
+  uint8_t* slab = nullptr;
+  int64_t capacity = 0;
+  int64_t used = 0;
+  // offset -> size of free block (ordered for coalescing)
+  std::map<int64_t, int64_t> free_blocks;
+  // offset -> size of live allocations
+  std::map<int64_t, int64_t> live;
+  std::mutex mu;
+};
+
+constexpr int64_t kAlign = 64;
+
+int64_t align_up(int64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+}  // namespace
+
+extern "C" {
+
+void* arena_create(int64_t capacity) {
+  Arena* a = new (std::nothrow) Arena();
+  if (a == nullptr) return nullptr;
+  a->capacity = align_up(capacity);
+  a->slab = static_cast<uint8_t*>(std::malloc(a->capacity));
+  if (a->slab == nullptr) {
+    delete a;
+    return nullptr;
+  }
+  a->free_blocks[0] = a->capacity;
+  return a;
+}
+
+int64_t arena_alloc(void* handle, int64_t nbytes) {
+  Arena* a = static_cast<Arena*>(handle);
+  int64_t need = align_up(nbytes > 0 ? nbytes : 1);
+  std::lock_guard<std::mutex> lock(a->mu);
+  for (auto it = a->free_blocks.begin(); it != a->free_blocks.end(); ++it) {
+    if (it->second >= need) {
+      int64_t off = it->first;
+      int64_t remaining = it->second - need;
+      a->free_blocks.erase(it);
+      if (remaining > 0) a->free_blocks[off + need] = remaining;
+      a->live[off] = need;
+      a->used += need;
+      return off;
+    }
+  }
+  return -1;  // caller must spill (DeviceMemoryEventHandler contract)
+}
+
+void arena_free(void* handle, int64_t offset) {
+  Arena* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->live.find(offset);
+  if (it == a->live.end()) return;
+  int64_t size = it->second;
+  a->live.erase(it);
+  a->used -= size;
+  // insert and coalesce with neighbours
+  auto ins = a->free_blocks.emplace(offset, size).first;
+  if (ins != a->free_blocks.begin()) {
+    auto prev = std::prev(ins);
+    if (prev->first + prev->second == ins->first) {
+      prev->second += ins->second;
+      a->free_blocks.erase(ins);
+      ins = prev;
+    }
+  }
+  auto next = std::next(ins);
+  if (next != a->free_blocks.end() &&
+      ins->first + ins->second == next->first) {
+    ins->second += next->second;
+    a->free_blocks.erase(next);
+  }
+}
+
+void* arena_base(void* handle) {
+  return static_cast<Arena*>(handle)->slab;
+}
+
+int64_t arena_used(void* handle) {
+  Arena* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->used;
+}
+
+int64_t arena_capacity(void* handle) {
+  return static_cast<Arena*>(handle)->capacity;
+}
+
+int64_t arena_num_free_blocks(void* handle) {
+  Arena* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return static_cast<int64_t>(a->free_blocks.size());
+}
+
+int arena_write_file(void* handle, int64_t offset, int64_t nbytes,
+                     const char* path) {
+  Arena* a = static_cast<Arena*>(handle);
+  if (offset < 0 || offset + nbytes > a->capacity) return EINVAL;
+  FILE* f = std::fopen(path, "wb");
+  if (f == nullptr) return errno;
+  size_t written = std::fwrite(a->slab + offset, 1,
+                               static_cast<size_t>(nbytes), f);
+  int rc = (written == static_cast<size_t>(nbytes)) ? 0 : EIO;
+  if (std::fclose(f) != 0 && rc == 0) rc = errno;
+  return rc;
+}
+
+int arena_read_file(void* handle, int64_t offset, int64_t nbytes,
+                    const char* path) {
+  Arena* a = static_cast<Arena*>(handle);
+  if (offset < 0 || offset + nbytes > a->capacity) return EINVAL;
+  FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) return errno;
+  size_t got = std::fread(a->slab + offset, 1,
+                          static_cast<size_t>(nbytes), f);
+  int rc = (got == static_cast<size_t>(nbytes)) ? 0 : EIO;
+  std::fclose(f);
+  return rc;
+}
+
+void arena_destroy(void* handle) {
+  Arena* a = static_cast<Arena*>(handle);
+  std::free(a->slab);
+  delete a;
+}
+
+}  // extern "C"
