@@ -277,6 +277,43 @@ class DataFrame:
         from .reader import DataFrameWriter
         return DataFrameWriter(self)
 
+    def to_device_batches(self):
+        """Zero-copy export of device ColumnarBatches for ML libraries.
+
+        Reference: ColumnarRdd.scala:41 / InternalColumnarRddConverter —
+        the ml-integration handoff that gives XGBoost the raw device
+        tables.  Here the consumer gets jax arrays already resident on
+        device; no host round-trip.
+        """
+        phys = self.session._plan(self._plan)
+        from ..columnar.arrow import from_arrow
+        import pyarrow as pa
+        batches = []
+        for part in phys.execute():
+            for item in part:
+                b = from_arrow(item) if isinstance(item, pa.Table) else item
+                if b.num_rows:
+                    batches.append(b)
+        return batches
+
+    def to_jax(self):
+        """Collect numeric columns as a dict of dense jax arrays
+
+        (validity-masked rows dropped), ready for jit-ted ML training —
+        the XGBoost-style consumption path of to_device_batches."""
+        import jax.numpy as jnp
+        from ..columnar.batch import concat_batches
+        batches = self.to_device_batches()
+        if not batches:
+            return {}
+        b = concat_batches(batches) if len(batches) > 1 else batches[0]
+        out = {}
+        for f, c in zip(b.schema, b.columns):
+            if f.dtype.np_dtype is None:
+                continue
+            out[f.name] = c.data[:b.num_rows]
+        return out
+
     def cache(self) -> "DataFrame":
         """Materialize once into an in-memory relation (cache-serializer
 

@@ -103,7 +103,9 @@ class TpuSession:
 
     def execute_to_arrow(self, logical: L.LogicalPlan) -> pa.Table:
         """Run a logical plan and collect everything as one arrow table."""
+        import time as _time
         from ..columnar.arrow import to_arrow, schema_to_arrow
+        t0 = _time.perf_counter()
         phys = self._plan(logical)
         tables: List[pa.Table] = []
         for part in phys.execute():
@@ -111,6 +113,7 @@ class TpuSession:
                 t = item if isinstance(item, pa.Table) else to_arrow(item)
                 if t.num_rows:
                     tables.append(t)
+        self._log_query(phys, (_time.perf_counter() - t0) * 1000)
         target = schema_to_arrow(phys.output_schema) if len(
             phys.output_schema) else None
         if not tables:
@@ -123,6 +126,19 @@ class TpuSession:
                 [pc.cast(out.column(i).combine_chunks(), f.type, safe=False)
                  for i, f in enumerate(target)], schema=target)
         return out
+
+    def _log_query(self, phys, wall_ms: float):
+        from ..config import EVENT_LOG_PATH, METRICS_LEVEL
+        from ..tools.events import QueryEventLogger
+        path = self.conf.get(EVENT_LOG_PATH)
+        if not hasattr(self, "_event_logger") or \
+                (self._event_logger.path or "") != (path or ""):
+            self._event_logger = QueryEventLogger(path or None)
+        self.last_query_event = self._event_logger.log_query(
+            phys, wall_ms,
+            self._last_planner.fallbacks if self._last_planner else [],
+            dict(self.conf._settings),
+            metrics_level=self.conf.get(METRICS_LEVEL))
 
     def explain(self, logical: L.LogicalPlan) -> str:
         """Planner explain: physical tree + fallback reasons."""

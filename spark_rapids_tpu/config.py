@@ -188,6 +188,10 @@ UDF_COMPILER_ENABLED = conf_bool(
     "spark.rapids.tpu.sql.udfCompiler.enabled", True,
     "Compile Python UDF bytecode to native expressions when possible "
     "(reference: com.nvidia.spark.udf.Plugin)")
+EVENT_LOG_PATH = conf_str(
+    "spark.rapids.tpu.eventLog.path", "",
+    "Append per-query JSON event records here; consumed by the "
+    "qualification/profiling tools (reference: Spark event logs + tools/)")
 SHIM_PROVIDER_OVERRIDE = conf_str(
     "spark.rapids.tpu.shims-provider-override", "",
     "Force a specific compat shim (reference: "

@@ -1,0 +1,42 @@
+"""TPU expand operator (grouping sets) — reference: GpuExpandExec.scala.
+
+Each input row is replicated once per projection list; implemented as a
+tiled gather (row i of projection p reads input row i), fully static.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import bucket_capacity
+from ..expr import core as ec
+from ..plan.logical import Expand
+from .base import PhysicalPlan, NUM_OUTPUT_ROWS
+from .tpu_basic import TpuExec
+
+
+class TpuExpand(TpuExec):
+    def __init__(self, logical: Expand, child: PhysicalPlan):
+        super().__init__(child)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def execute(self):
+        child_schema = self.children[0].output_schema
+        bound = [[e.bind(child_schema) for e in proj]
+                 for proj in self.logical.projections]
+
+        def run(part):
+            for batch in part:
+                for proj in bound:
+                    cols = [ec.eval_as_column(e, batch) for e in proj]
+                    out = ColumnarBatch(self.output_schema, cols,
+                                        batch.num_rows)
+                    self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                    yield out
+        return [run(p) for p in self.children[0].execute()]

@@ -1,0 +1,65 @@
+"""Cost-based optimizer — reference: CostBasedOptimizer.scala:52
+
+(CpuCostModel/GpuCostModel/RowCountPlanVisitor): estimates CPU-vs-TPU cost
+per subtree and forces sections back to the CPU engine when host<->device
+transitions outweigh the speedup.  Off by default
+(spark.rapids.tpu.sql.optimizer.enabled), like the reference.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from . import logical as L
+
+# relative per-row operator costs (device is assumed ~8x faster on
+# compute-bound ops; transitions cost per byte-ish per row)
+TPU_SPEEDUP: Dict[type, float] = {
+    L.Project: 6.0, L.Filter: 6.0, L.Aggregate: 10.0, L.Join: 10.0,
+    L.Sort: 8.0, L.Window: 10.0, L.Expand: 6.0,
+}
+TRANSITION_COST_PER_ROW = 3.0
+CPU_COST_PER_ROW = 1.0
+
+
+def estimate_rows(p: L.LogicalPlan) -> Optional[float]:
+    """RowCountPlanVisitor role: best-effort cardinality estimates."""
+    if isinstance(p, L.LocalRelation):
+        return float(p.table.num_rows)
+    if isinstance(p, L.Range):
+        return float(max(0, -(-(p.end - p.start) // p.step)))
+    if isinstance(p, L.Filter):
+        r = estimate_rows(p.children[0])
+        return r * 0.5 if r is not None else None
+    if isinstance(p, L.Limit):
+        return float(p.n)
+    if isinstance(p, L.Aggregate):
+        r = estimate_rows(p.children[0])
+        return min(r, r * 0.1 + 100) if r is not None else None
+    if isinstance(p, L.Join):
+        l = estimate_rows(p.children[0])
+        r = estimate_rows(p.children[1])
+        if l is None or r is None:
+            return None
+        return max(l, r)
+    if isinstance(p, L.Union):
+        vals = [estimate_rows(c) for c in p.children]
+        return sum(v for v in vals if v is not None) or None
+    if p.children:
+        return estimate_rows(p.children[0])
+    return None
+
+
+def tpu_worthwhile(p: L.LogicalPlan) -> bool:
+    """Would accelerating this node pay for its transitions?
+
+    Used by the planner when the CBO is enabled: tiny inputs stay on the
+    CPU engine (the reference forces subtrees back to CPU the same way).
+    """
+    rows = estimate_rows(p)
+    if rows is None:
+        return True  # unknown: assume big (matches reference default-on)
+    speedup = TPU_SPEEDUP.get(type(p), 4.0)
+    cpu_cost = rows * CPU_COST_PER_ROW
+    tpu_cost = rows * CPU_COST_PER_ROW / speedup + \
+        rows * 0.0 + 2 * TRANSITION_COST_PER_ROW * min(rows, 1024) + 500
+    return tpu_cost < cpu_cost

@@ -295,6 +295,13 @@ class Planner:
             self.fallbacks.append(
                 f"{p.name}: {'; '.join(meta.reasons[:3])}")
             return self._convert_cpu(meta)
+        from ..config import CBO_ENABLED
+        if self.conf.get(CBO_ENABLED):
+            from .cbo import tpu_worthwhile
+            if not tpu_worthwhile(p):
+                self.fallbacks.append(
+                    f"{p.name}: cost model kept it on CPU")
+                return self._convert_cpu(meta)
         children = [self._convert(c) for c in meta.children]
         return self._convert_tpu(meta, p, children)
 
@@ -391,6 +398,9 @@ class Planner:
             return tpu_write_exec(p, children[0], self.conf)
         if isinstance(p, L.Window):
             return self._plan_window(p, children[0])
+        if isinstance(p, L.Expand):
+            from ..exec.tpu_expand import TpuExpand
+            return TpuExpand(p, children[0])
         raise NotImplementedError(f"no TPU conversion for {p.name}")
 
     def _plan_window(self, p: L.Window, child: PhysicalPlan) -> PhysicalPlan:

@@ -1,0 +1,4 @@
+"""Tooling: event logs, qualification and profiling CLIs
+
+(reference: tools/ module, SURVEY.md §2.9)."""
+from .events import QueryEventLogger, read_event_log  # noqa: F401

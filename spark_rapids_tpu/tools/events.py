@@ -1,0 +1,61 @@
+"""Query event logging — the substrate for the qualification/profiling
+
+tools (reference: Spark event logs consumed by tools/, SURVEY.md §2.9) and
+for the metrics/observability story (GpuMetric -> SQL UI role).
+
+Every executed query appends one JSON line to the event log:
+  {"query_id", "wall_ms", "physical_plan", "fallbacks": [...],
+   "node_metrics": {node: {metric: value}}, "conf": {...}}
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_LOCK = threading.Lock()
+
+
+class QueryEventLogger:
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or os.environ.get(
+            "SPARK_RAPIDS_TPU_EVENT_LOG", "")
+        self._next_id = 0
+
+    def enabled(self) -> bool:
+        return bool(self.path)
+
+    def log_query(self, phys_plan, wall_ms: float, fallbacks: List[str],
+                  conf_dict: Dict, metrics_level: str = "MODERATE"):
+        self._next_id += 1
+        record = {
+            "query_id": self._next_id,
+            "ts": time.time(),
+            "wall_ms": round(wall_ms, 3),
+            "physical_plan": phys_plan.tree_string(),
+            "nodes": [n.name for n in phys_plan.collect_nodes()],
+            "fallbacks": fallbacks,
+            "node_metrics": {
+                f"{i}:{n.name}": n.metrics.snapshot(metrics_level)
+                for i, n in enumerate(phys_plan.collect_nodes())},
+            "conf": {k: v for k, v in conf_dict.items()},
+        }
+        if not self.enabled():
+            return record
+        with _LOCK:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(self.path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        return record
+
+
+def read_event_log(path: str) -> List[Dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,106 @@
+"""Profiling tool — reference: tools/.../profiling/ProfileMain.scala:31 +
+
+Analysis.scala + GenerateDot.scala:40: extracts per-operator info from
+event logs, compares runs, and renders DOT plan graphs.
+
+Usage:
+  python -m spark_rapids_tpu.tools.profiling <event_log.jsonl> [--dot]
+  python -m spark_rapids_tpu.tools.profiling --compare a.jsonl b.jsonl
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List
+
+from .events import read_event_log
+
+
+def analyze(records: List[Dict]) -> Dict:
+    """Per-operator aggregated metrics across queries (Analysis.scala)."""
+    op_totals: Dict[str, Dict[str, float]] = {}
+    for r in records:
+        for node_key, metrics in r.get("node_metrics", {}).items():
+            name = node_key.split(":", 1)[1] if ":" in node_key else node_key
+            agg = op_totals.setdefault(name, {"occurrences": 0})
+            agg["occurrences"] += 1
+            for m, v in metrics.items():
+                agg[m] = agg.get(m, 0) + v
+    slowest = sorted(records, key=lambda r: -r.get("wall_ms", 0))[:10]
+    return {
+        "num_queries": len(records),
+        "total_wall_ms": round(sum(r.get("wall_ms", 0) for r in records), 1),
+        "operator_totals": op_totals,
+        "slowest_queries": [
+            {"query_id": r.get("query_id"), "wall_ms": r.get("wall_ms"),
+             "fallbacks": r.get("fallbacks", [])} for r in slowest],
+    }
+
+
+def compare(a: List[Dict], b: List[Dict]) -> Dict:
+    """Compare two runs query-by-query (reference: compare mode)."""
+    bm = {r.get("query_id"): r for r in b}
+    rows = []
+    for r in a:
+        other = bm.get(r.get("query_id"))
+        if other is None:
+            continue
+        wa, wb = r.get("wall_ms", 0), other.get("wall_ms", 0)
+        rows.append({"query_id": r.get("query_id"), "a_ms": wa, "b_ms": wb,
+                     "speedup": round(wa / wb, 3) if wb else None})
+    return {"queries": rows}
+
+
+def generate_dot(record: Dict) -> str:
+    """Render one query's physical plan as DOT (GenerateDot.scala:40)."""
+    lines = ["digraph plan {", "  rankdir=BT;",
+             "  node [shape=box, fontname=monospace];"]
+    plan = record.get("physical_plan", "")
+    nodes = []
+    for ln in plan.splitlines():
+        depth = (len(ln) - len(ln.lstrip())) // 2
+        nodes.append((depth, ln.strip()))
+    metrics = record.get("node_metrics", {})
+    keys = list(metrics.keys())
+    stack: List[int] = []
+    for i, (depth, label) in enumerate(nodes):
+        m = metrics.get(keys[i], {}) if i < len(keys) else {}
+        mtxt = "\\n".join(f"{k}={v}" for k, v in sorted(m.items())
+                          if k in ("numOutputRows", "opTime"))
+        color = "lightgreen" if label.startswith("Tpu") or \
+            label.startswith("RowToColumnar") else "lightsalmon" \
+            if label.startswith("Cpu") else "white"
+        lines.append(
+            f'  n{i} [label="{label}\\n{mtxt}", style=filled, '
+            f'fillcolor={color}];')
+        while stack and nodes[stack[-1]][0] >= depth:
+            stack.pop()
+        if stack:
+            lines.append(f"  n{i} -> n{stack[-1]};")
+        stack.append(i)
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = argv or sys.argv[1:]
+    if not argv:
+        print("usage: profiling <log.jsonl> [--dot] | "
+              "--compare a.jsonl b.jsonl", file=sys.stderr)
+        return 1
+    if argv[0] == "--compare":
+        a = read_event_log(argv[1])
+        b = read_event_log(argv[2])
+        print(json.dumps(compare(a, b), indent=2))
+        return 0
+    records = read_event_log(argv[0])
+    if "--dot" in argv:
+        for r in records:
+            print(generate_dot(r))
+    else:
+        print(json.dumps(analyze(records), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

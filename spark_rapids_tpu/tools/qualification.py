@@ -1,0 +1,72 @@
+"""Qualification tool — reference: tools/.../qualification/
+
+QualificationMain.scala:29: parses event logs and scores workloads for
+accelerator fit (what fraction of query time could go to the TPU).
+
+Usage:  python -m spark_rapids_tpu.tools.qualification <event_log.jsonl>
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List
+
+from .events import read_event_log
+
+# operators with TPU implementations (mirrors the planner registry)
+TPU_NODES = {
+    "TpuLocalScan", "TpuRange", "TpuProject", "TpuFilter",
+    "TpuHashAggregate", "TpuShuffledHashJoin", "TpuBroadcastHashJoin",
+    "TpuNestedLoopJoin", "TpuSort", "TpuTopN", "TpuWindow", "TpuExpand",
+    "TpuLocalLimit", "TpuGlobalLimit", "TpuUnion", "TpuShuffleExchange",
+    "TpuBroadcastExchange", "TpuCoalescePartitions", "TpuCoalesceBatches",
+    "TpuFileScan", "TpuFileWrite", "RowToColumnar", "ColumnarToRow",
+}
+
+
+def qualify(records: List[Dict]) -> Dict:
+    """Score each query + the app overall for TPU acceleration fit."""
+    per_query = []
+    total_ms = 0.0
+    accel_ms = 0.0
+    for r in records:
+        nodes = r.get("nodes", [])
+        n_tpu = sum(1 for n in nodes if n in TPU_NODES)
+        frac = n_tpu / len(nodes) if nodes else 0.0
+        wall = r.get("wall_ms", 0.0)
+        total_ms += wall
+        accel_ms += wall * frac
+        per_query.append({
+            "query_id": r.get("query_id"),
+            "wall_ms": wall,
+            "tpu_operator_fraction": round(frac, 3),
+            "fallbacks": r.get("fallbacks", []),
+            "recommendation": (
+                "STRONGLY RECOMMENDED" if frac >= 0.9 else
+                "RECOMMENDED" if frac >= 0.5 else
+                "NOT RECOMMENDED"),
+        })
+    score = accel_ms / total_ms if total_ms else 0.0
+    return {
+        "app_score": round(score, 3),
+        "estimated_accelerable_ms": round(accel_ms, 1),
+        "total_ms": round(total_ms, 1),
+        "recommendation": ("STRONGLY RECOMMENDED" if score >= 0.9 else
+                           "RECOMMENDED" if score >= 0.5 else
+                           "NOT RECOMMENDED"),
+        "queries": per_query,
+    }
+
+
+def main(argv=None):
+    argv = argv or sys.argv[1:]
+    if not argv:
+        print("usage: qualification <event_log.jsonl>", file=sys.stderr)
+        return 1
+    records = read_event_log(argv[0])
+    print(json.dumps(qualify(records), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

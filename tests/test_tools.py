@@ -1,0 +1,92 @@
+"""Tools tests: event logs, qualification, profiling, explain, exports."""
+import json
+import os
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.tools.events import read_event_log
+from spark_rapids_tpu.tools.qualification import qualify
+from spark_rapids_tpu.tools.profiling import analyze, generate_dot
+
+from data_gen import IntGen, KeyGen, gen_df
+
+
+def _run_queries(tmp_path, enabled=True):
+    log = str(tmp_path / "events.jsonl")
+    s = TpuSession(TpuConf({
+        "spark.rapids.tpu.sql.enabled": enabled,
+        "spark.rapids.tpu.eventLog.path": log,
+    }))
+    df = gen_df(s, {"k": KeyGen(), "v": IntGen()}, 100)
+    df.group_by("k").agg(F.sum("v").alias("s")).collect()
+    df.filter(F.col("v") > 0).collect()
+    return log
+
+
+class TestEventLog:
+    def test_event_log_written(self, tmp_path):
+        log = _run_queries(tmp_path)
+        records = read_event_log(log)
+        assert len(records) == 2
+        assert records[0]["wall_ms"] > 0
+        assert any("TpuHashAggregate" in n for n in records[0]["nodes"])
+        assert records[0]["node_metrics"]
+
+    def test_qualification(self, tmp_path):
+        log = _run_queries(tmp_path)
+        q = qualify(read_event_log(log))
+        assert q["app_score"] >= 0.9
+        assert q["recommendation"] == "STRONGLY RECOMMENDED"
+
+    def test_qualification_cpu_run(self, tmp_path):
+        log = _run_queries(tmp_path, enabled=False)
+        q = qualify(read_event_log(log))
+        assert q["app_score"] == 0.0
+        assert q["recommendation"] == "NOT RECOMMENDED"
+
+    def test_profiling_analyze_and_dot(self, tmp_path):
+        log = _run_queries(tmp_path)
+        records = read_event_log(log)
+        a = analyze(records)
+        assert a["num_queries"] == 2
+        assert any(k.startswith("Tpu") for k in a["operator_totals"])
+        dot = generate_dot(records[0])
+        assert dot.startswith("digraph") and "TpuHashAggregate" in dot
+
+
+class TestExplainAndExport:
+    def test_explain_mentions_tpu_ops(self):
+        s = TpuSession(TpuConf({}))
+        df = gen_df(s, {"k": KeyGen(), "v": IntGen()}, 50)
+        text = s.explain(df.group_by("k").agg(F.sum("v").alias("x"))._plan)
+        assert "TpuHashAggregate" in text
+
+    def test_explain_shows_fallback(self):
+        s = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": False}))
+        df = gen_df(s, {"k": KeyGen()}, 10)
+        text = s.explain(df._plan)
+        assert "CPU fallbacks" in text
+
+    def test_to_device_batches(self):
+        s = TpuSession(TpuConf({}))
+        df = gen_df(s, {"k": KeyGen(null_ratio=0), "v": IntGen(
+            null_ratio=0)}, 64)
+        batches = df.to_device_batches()
+        assert sum(b.num_rows for b in batches) == 64
+        arrs = df.to_jax()
+        assert set(arrs) == {"k", "v"}
+        assert int(arrs["k"].shape[0]) == 64
+
+    def test_test_mode_asserts_on_fallback(self):
+        import pytest
+        s = TpuSession(TpuConf({
+            "spark.rapids.tpu.sql.test.enabled": True}))
+        from spark_rapids_tpu.udf import udf
+        from spark_rapids_tpu.columnar import dtypes as T
+        df = gen_df(s, {"k": KeyGen()}, 10)
+        # window RANGE frame is not TPU-supported -> CPU fallback -> assert
+        from spark_rapids_tpu.plan import logical as L
+        bad = df.with_window("w", F.sum("k"), partition_by=["k"],
+                             frame=("range", None, 0))
+        with pytest.raises(AssertionError):
+            bad.collect()
