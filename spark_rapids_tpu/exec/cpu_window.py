@@ -55,8 +55,14 @@ class CpuWindow(CpuExec):
                 df[name] = _arr(cpu_eval(o.expr, t), t.num_rows).to_pandas()
                 skeys.append(name)
                 ascs.append(o.ascending)
-            work = df.sort_values(skeys, ascending=ascs, kind="stable") \
-                if skeys else df
+            # per-key stable sorts (last key first) so each order key gets
+            # its own null placement (Spark: asc->nulls first)
+            work = df
+            for name, o in reversed(list(zip(skeys, spec.order_by))):
+                work = work.sort_values(
+                    name, ascending=o.ascending, kind="stable",
+                    na_position="first" if o.effective_nulls_first
+                    else "last")
             grouped = work.groupby(pkeys, dropna=False, sort=False) \
                 if pkeys else work.groupby(np.zeros(len(work)))
             fname = type(wf.func).__name__
@@ -79,11 +85,35 @@ class CpuWindow(CpuExec):
             elif isinstance(wf.func, (Lead, Lag)):
                 offset = wf.func.offset if isinstance(wf.func, Lead) \
                     else -wf.func.offset
-                src = f"__wsrc_{wf.alias}"
-                work[src] = _arr(cpu_eval(wf.func.children[0], t),
-                                 t.num_rows).to_pandas()
-                res = grouped[src].shift(-offset)
-                work.drop(columns=[src], inplace=True)
+                # shift row *indices*, then gather from the arrow array so
+                # NaN values are not conflated with nulls by pandas
+                pos_col = f"__wpos_{wf.alias}"
+                work[pos_col] = np.arange(len(work))
+                src_pos = grouped[pos_col].shift(-offset)
+                work.drop(columns=[pos_col], inplace=True)
+                src_arr = _arr(cpu_eval(wf.func.children[0], t),
+                               t.num_rows)
+                if isinstance(src_arr, pa.ChunkedArray):
+                    src_arr = src_arr.combine_chunks()
+                # src_pos indexes into `work` order; map to original rows
+                work_orig_idx = work.index.to_numpy()
+                sp = src_pos.to_numpy()
+                valid = ~np.isnan(sp)
+                orig_src = np.full(len(work), -1, dtype=np.int64)
+                orig_src[valid] = work_orig_idx[
+                    sp[valid].astype(np.int64)]
+                take_idx = pa.array(
+                    [int(i) if i >= 0 else None for i in orig_src],
+                    pa.int64())
+                gathered = src_arr.take(take_idx)
+                # align gathered (in work order) back to df positions
+                df[wf.alias] = None
+                res_series = None
+                arr_np = np.empty(len(work), dtype=object)
+                for j, v in enumerate(gathered.to_pylist()):
+                    arr_np[j] = v
+                import pandas as pd
+                res = pd.Series(arr_np, index=work.index)
             elif isinstance(wf.func, eagg.AggregateFunction):
                 src = f"__wsrc_{wf.alias}"
                 child = wf.func.children[0] if wf.func.children else None
@@ -108,12 +138,15 @@ class CpuWindow(CpuExec):
             else:
                 raise NotImplementedError(f"window function {fname}")
             df.loc[work.index, wf.alias] = res
-        # restore original row order and project output columns
-        names = [f.name for f in out_schema]
-        out_df = df[names]
+        # output: original columns straight from the arrow table (no
+        # pandas NaN/null conflation); window columns from df
+        base_names = set(t.column_names)
         arrays = []
         for f in out_schema:
-            arr = pa.Array.from_pandas(out_df[f.name], type=f.type,
-                                       safe=False)
+            if f.name in base_names:
+                arrays.append(t.column(f.name).combine_chunks())
+                continue
+            vals = df[f.name].tolist()
+            arr = pa.array(vals, type=f.type)
             arrays.append(arr)
         return pa.Table.from_arrays(arrays, schema=out_schema)
