@@ -63,20 +63,54 @@ class TpuHashAggregate(TpuExec):
         nkeys = len(self.group_exprs)
 
         def run(part):
-            batches = [b for b in part]
+            # per-batch update aggregation, then concat+merge of partials —
+            # the reference's iterative model (aggregate.scala:366-390)
+            # keeps memory bounded by partial size, not input size.
+            partials = []
             with timed(self.metrics[AGG_TIME]):
-                if not batches:
-                    batch = ColumnarBatch.empty(child_schema)
-                else:
-                    batch = concat_batches(batches) if len(batches) > 1 \
-                        else batches[0]
-                out = self._aggregate_batch(batch)
+                for batch in part:
+                    if batch.num_rows == 0 and partials:
+                        continue
+                    partials.append(self._update_batch(batch))
+                if not partials:
+                    partials = [self._update_batch(
+                        ColumnarBatch.empty(child_schema))]
+                merged = concat_batches(partials) if len(partials) > 1 \
+                    else partials[0]
+                out = self._merge_finalize(merged,
+                                           multiple=len(partials) > 1)
             self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
             yield out
         return [run(p) for p in self.children[0].execute()]
 
+    def _update_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Partial (update) aggregation of one input batch -> buffer batch."""
+        inner = TpuHashAggregate(self.group_exprs, self.aggs,
+                                 self.children[0], mode=PARTIAL)
+        if self.mode == FINAL:
+            # input is already buffer-shaped: merge within the batch
+            inner = TpuHashAggregate(self.group_exprs, self.aggs,
+                                     self.children[0], mode=FINAL)
+            inner_out = inner._aggregate_batch(batch, emit_buffers=True)
+            return inner_out
+        return inner._aggregate_batch(batch)
+
+    def _merge_finalize(self, merged: ColumnarBatch,
+                        multiple: bool) -> ColumnarBatch:
+        if self.mode == PARTIAL:
+            if not multiple:
+                return merged
+            # merge duplicate keys across partials, stay in buffer form
+            inner = TpuHashAggregate(self.group_exprs, self.aggs,
+                                     self.children[0], mode=FINAL)
+            return inner._aggregate_batch(merged, emit_buffers=True)
+        inner = TpuHashAggregate(self.group_exprs, self.aggs,
+                                 self.children[0], mode=FINAL)
+        return inner._aggregate_batch(merged)
+
     # -- core -------------------------------------------------------------------
-    def _aggregate_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+    def _aggregate_batch(self, batch: ColumnarBatch,
+                         emit_buffers: bool = False) -> ColumnarBatch:
         child_schema = batch.schema
         if self.mode in (PARTIAL, COMPLETE):
             key_cols = [ec.eval_as_column(e.bind(child_schema), batch)
@@ -96,7 +130,7 @@ class TpuHashAggregate(TpuExec):
                 pos += nb
 
         if not self.group_exprs:
-            return self._global_agg(batch, input_cols)
+            return self._global_agg(batch, input_cols, emit_buffers)
 
         words = canon.batch_key_words(key_cols, batch.num_rows)
         plan = agg_k.groupby_plan(words)
@@ -126,7 +160,7 @@ class TpuHashAggregate(TpuExec):
 
         # compact agg outputs: buffer arrays are already segment-indexed
         for a, bufs in zip(self.aggs, agg_buffers):
-            if self.mode == PARTIAL:
+            if self.mode == PARTIAL or emit_buffers:
                 outs = bufs
             else:
                 outs = [a.func.finalize(bufs)]
@@ -140,10 +174,13 @@ class TpuHashAggregate(TpuExec):
                         if not hasattr(c, "offsets") else \
                         c.with_capacity(out_cap, num_groups)
                 out_cols.append(c.mask_validity(live))
-        return ColumnarBatch(self.output_schema, out_cols, num_groups)
+        out_schema = buffer_schema(self.group_exprs, self.aggs) \
+            if emit_buffers else self.output_schema
+        return ColumnarBatch(out_schema, out_cols, num_groups)
 
     def _global_agg(self, batch: ColumnarBatch,
-                    input_cols: List[List[Column]]) -> ColumnarBatch:
+                    input_cols: List[List[Column]],
+                    emit_buffers: bool = False) -> ColumnarBatch:
         """No group keys: aggregate everything into one row (one segment)."""
         cap = batch.capacity
         const = Column(T.INT64, jnp.zeros(cap, jnp.int64),
@@ -158,7 +195,8 @@ class TpuHashAggregate(TpuExec):
                 bufs = a.func.update(plan, cols)
             else:
                 bufs = a.func.merge(plan, cols)
-            outs = bufs if self.mode == PARTIAL else [a.func.finalize(bufs)]
+            outs = bufs if (self.mode == PARTIAL or emit_buffers) \
+                else [a.func.finalize(bufs)]
             for o in outs:
                 c = o.gather(jnp.zeros(out_cap, jnp.int32))
                 live = jnp.arange(out_cap) < 1
@@ -173,4 +211,6 @@ class TpuHashAggregate(TpuExec):
                 else:
                     c = c.mask_validity(live)
                 out_cols.append(c)
-        return ColumnarBatch(self.output_schema, out_cols, 1)
+        out_schema = buffer_schema(self.group_exprs, self.aggs) \
+            if emit_buffers else self.output_schema
+        return ColumnarBatch(out_schema, out_cols, 1)
