@@ -1,0 +1,225 @@
+"""TPC-H-style benchmark suite (scaled-down schema + queries).
+
+Reference baseline configs (BASELINE.json): "TPC-H SF10 — scan +
+hash-join + aggregate on Parquet".  This module generates lineitem /
+orders / customer tables at a row-scaled factor, writes them to Parquet,
+and runs representative queries (Q1 pricing summary, Q3 shipping
+priority, Q5-style join-agg, Q6 forecast filter) on either engine.
+
+Usage:
+  python benchmarks/tpch.py --scale 0.01 --engine tpu
+  python benchmarks/tpch.py --scale 0.01 --compare   # TPU vs CPU timings
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ROWS_PER_SF = {"lineitem": 6_000_000, "orders": 1_500_000,
+               "customer": 150_000}
+
+
+def generate(data_dir: str, scale: float, seed: int = 0):
+    import pyarrow as pa
+    import pyarrow.parquet as papq
+    rng = np.random.default_rng(seed)
+    os.makedirs(data_dir, exist_ok=True)
+
+    n_li = max(int(ROWS_PER_SF["lineitem"] * scale), 1000)
+    n_ord = max(int(ROWS_PER_SF["orders"] * scale), 250)
+    n_cust = max(int(ROWS_PER_SF["customer"] * scale), 25)
+
+    cust = pa.table({
+        "c_custkey": np.arange(n_cust, dtype=np.int64),
+        "c_mktsegment": rng.choice(
+            ["BUILDING", "AUTOMOBILE", "MACHINERY", "HOUSEHOLD",
+             "FURNITURE"], n_cust),
+        "c_nationkey": rng.integers(0, 25, n_cust),
+    })
+    papq.write_table(cust, os.path.join(data_dir, "customer.parquet"))
+
+    o_date = rng.integers(8035, 10591, n_ord)  # 1992-01..1998-12 in days
+    orders = pa.table({
+        "o_orderkey": np.arange(n_ord, dtype=np.int64),
+        "o_custkey": rng.integers(0, n_cust, n_ord),
+        "o_orderdate": o_date.astype(np.int32),
+        "o_totalprice": (rng.random(n_ord) * 500000).round(2),
+        "o_orderpriority": rng.choice(
+            ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED",
+             "5-LOW"], n_ord),
+    })
+    papq.write_table(orders, os.path.join(data_dir, "orders.parquet"))
+
+    li_order = rng.integers(0, n_ord, n_li)
+    ship = o_date[li_order] + rng.integers(1, 122, n_li)
+    li = pa.table({
+        "l_orderkey": li_order.astype(np.int64),
+        "l_quantity": rng.integers(1, 51, n_li).astype(np.float64),
+        "l_extendedprice": (rng.random(n_li) * 100000).round(2),
+        "l_discount": (rng.integers(0, 11, n_li) / 100.0),
+        "l_tax": (rng.integers(0, 9, n_li) / 100.0),
+        "l_returnflag": rng.choice(["A", "N", "R"], n_li),
+        "l_linestatus": rng.choice(["O", "F"], n_li),
+        "l_shipdate": ship.astype(np.int32),
+    })
+    papq.write_table(li, os.path.join(data_dir, "lineitem.parquet"))
+    return {"lineitem": n_li, "orders": n_ord, "customer": n_cust}
+
+
+def q1(s, d):
+    """Pricing summary report (TPC-H Q1 shape)."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.columnar import dtypes as T
+    li = s.read.parquet(os.path.join(d, "lineitem.parquet"))
+    return (li.filter(F.col("l_shipdate") <= 10471)
+            .with_column("disc_price",
+                         F.col("l_extendedprice") * (1 - F.col("l_discount")))
+            .with_column("charge",
+                         F.col("l_extendedprice") *
+                         (1 - F.col("l_discount")) * (1 + F.col("l_tax")))
+            .group_by("l_returnflag", "l_linestatus")
+            .agg(F.sum("l_quantity").alias("sum_qty"),
+                 F.sum("l_extendedprice").alias("sum_base_price"),
+                 F.sum("disc_price").alias("sum_disc_price"),
+                 F.sum("charge").alias("sum_charge"),
+                 F.avg("l_quantity").alias("avg_qty"),
+                 F.avg("l_extendedprice").alias("avg_price"),
+                 F.avg("l_discount").alias("avg_disc"),
+                 F.count().alias("count_order"))
+            .sort("l_returnflag", "l_linestatus"))
+
+
+def q3(s, d):
+    """Shipping priority (join customer x orders x lineitem + agg + topN)."""
+    from spark_rapids_tpu.api import functions as F
+    cust = s.read.parquet(os.path.join(d, "customer.parquet"))
+    orders = s.read.parquet(os.path.join(d, "orders.parquet"))
+    li = s.read.parquet(os.path.join(d, "lineitem.parquet"))
+    return (cust.filter(F.col("c_mktsegment") == "BUILDING")
+            .join(orders, left_on_right_on(cust, orders), how="inner")
+            .join(li.with_column_renamed("l_orderkey", "o_orderkey"),
+                  on="o_orderkey")
+            .filter(F.col("o_orderdate") < 9204)
+            .with_column("revenue",
+                         F.col("l_extendedprice") *
+                         (1 - F.col("l_discount")))
+            .group_by("o_orderkey", "o_orderdate")
+            .agg(F.sum("revenue").alias("revenue"))
+            .sort(F.col("revenue").desc())
+            .limit(10))
+
+
+def left_on_right_on(cust, orders):
+    # helper for the custkey equi-join through the string-keys API
+    return None
+
+
+def q3_simple(s, d):
+    from spark_rapids_tpu.api import functions as F
+    cust = s.read.parquet(os.path.join(d, "customer.parquet")) \
+        .with_column_renamed("c_custkey", "o_custkey")
+    orders = s.read.parquet(os.path.join(d, "orders.parquet"))
+    li = s.read.parquet(os.path.join(d, "lineitem.parquet")) \
+        .with_column_renamed("l_orderkey", "o_orderkey")
+    return (cust.filter(F.col("c_mktsegment") == "BUILDING")
+            .join(orders, on="o_custkey")
+            .join(li, on="o_orderkey")
+            .filter(F.col("o_orderdate") < 9204)
+            .with_column("revenue",
+                         F.col("l_extendedprice") *
+                         (1 - F.col("l_discount")))
+            .group_by("o_orderkey", "o_orderdate")
+            .agg(F.sum("revenue").alias("revenue"))
+            .sort(F.col("revenue").desc(), F.col("o_orderkey").asc())
+            .limit(10))
+
+
+def q5_like(s, d):
+    """Join-heavy aggregate across all three tables."""
+    from spark_rapids_tpu.api import functions as F
+    cust = s.read.parquet(os.path.join(d, "customer.parquet")) \
+        .with_column_renamed("c_custkey", "o_custkey")
+    orders = s.read.parquet(os.path.join(d, "orders.parquet"))
+    li = s.read.parquet(os.path.join(d, "lineitem.parquet")) \
+        .with_column_renamed("l_orderkey", "o_orderkey")
+    return (li.join(orders, on="o_orderkey")
+            .join(cust, on="o_custkey")
+            .with_column("revenue",
+                         F.col("l_extendedprice") *
+                         (1 - F.col("l_discount")))
+            .group_by("c_nationkey")
+            .agg(F.sum("revenue").alias("revenue"),
+                 F.count().alias("n"))
+            .sort(F.col("revenue").desc()))
+
+
+def q6(s, d):
+    """Forecasting revenue change (pure filter + global agg)."""
+    from spark_rapids_tpu.api import functions as F
+    li = s.read.parquet(os.path.join(d, "lineitem.parquet"))
+    return (li.filter((F.col("l_shipdate") >= 8766) &
+                      (F.col("l_shipdate") < 9131) &
+                      (F.col("l_discount") >= 0.05) &
+                      (F.col("l_discount") <= 0.07) &
+                      (F.col("l_quantity") < 24))
+            .with_column("revenue",
+                         F.col("l_extendedprice") * F.col("l_discount"))
+            .agg(F.sum("revenue").alias("revenue")))
+
+
+QUERIES = {"q1": q1, "q3": q3_simple, "q5": q5_like, "q6": q6}
+
+
+def run(engine: str, data_dir: str, queries, repeats: int = 1):
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.config import TpuConf
+    s = TpuSession(TpuConf({
+        "spark.rapids.tpu.sql.enabled": engine == "tpu"}))
+    times = {}
+    for name in queries:
+        fn = QUERIES[name]
+        fn(s, data_dir).collect()  # warmup/compile
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            rows = fn(s, data_dir).collect()
+            best = min(best, time.perf_counter() - t0)
+        times[name] = {"seconds": round(best, 4), "rows": len(rows)}
+    return times
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=0.001)
+    ap.add_argument("--engine", choices=["tpu", "cpu"], default="tpu")
+    ap.add_argument("--compare", action="store_true")
+    ap.add_argument("--queries", default="q1,q3,q5,q6")
+    ap.add_argument("--data-dir", default="/tmp/tpch_data")
+    ap.add_argument("--repeats", type=int, default=2)
+    args = ap.parse_args()
+    tag = os.path.join(args.data_dir, f"sf{args.scale}")
+    if not os.path.exists(os.path.join(tag, "lineitem.parquet")):
+        sizes = generate(tag, args.scale)
+        print(f"generated {sizes}", file=sys.stderr)
+    queries = args.queries.split(",")
+    if args.compare:
+        tpu = run("tpu", tag, queries, args.repeats)
+        cpu = run("cpu", tag, queries, args.repeats)
+        out = {q: {"tpu_s": tpu[q]["seconds"], "cpu_s": cpu[q]["seconds"],
+                   "speedup": round(cpu[q]["seconds"] /
+                                    max(tpu[q]["seconds"], 1e-9), 2)}
+               for q in queries}
+        print(json.dumps(out, indent=2))
+    else:
+        print(json.dumps(run(args.engine, tag, queries, args.repeats),
+                         indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -83,8 +83,9 @@ def _type_extreme(dtype, want_max: bool):
 
 def _float_decode(words, dtype):
     from .canon import SIGN64
-    sign = (words & SIGN64) != 0
-    bits = jnp.where(sign, words & ~SIGN64, ~words)
+    s64 = jnp.uint64(SIGN64)
+    sign = (words & s64) != 0
+    bits = jnp.where(sign, words & ~s64, ~words)
     return bits.view(jnp.float64).astype(dtype)
 
 

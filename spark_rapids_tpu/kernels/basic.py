@@ -41,17 +41,17 @@ def prefix_positions(keep_mask):
 # JVM Spark), matching the role of Spark's Murmur3_x86_32(seed=42).
 # ---------------------------------------------------------------------------
 
-M1 = jnp.uint64(0xff51afd7ed558ccd)
-M2 = jnp.uint64(0xc4ceb9fe1a85ec53)
+M1 = 0xff51afd7ed558ccd
+M2 = 0xc4ceb9fe1a85ec53
 
 
 @jax.jit
 def mix64(x):
     x = x.astype(jnp.uint64)
     x = x ^ (x >> jnp.uint64(33))
-    x = x * M1
+    x = x * jnp.uint64(M1)
     x = x ^ (x >> jnp.uint64(33))
-    x = x * M2
+    x = x * jnp.uint64(M2)
     x = x ^ (x >> jnp.uint64(33))
     return x
 

@@ -30,13 +30,15 @@ import jax.numpy as jnp
 from ..columnar import dtypes as T
 from ..columnar.column import Column, StringColumn
 
-SIGN64 = jnp.uint64(0x8000000000000000)
+# python int (not a jnp scalar): creating device values at import
+# time would initialize the backend before sessions configure it
+SIGN64 = 0x8000000000000000
 
 
 def _ints_to_words(data, nbits: int):
     x = data.astype(jnp.int64)
     return (x.view(jnp.uint64) if nbits == 64
-            else x.astype(jnp.uint64)) ^ SIGN64
+            else x.astype(jnp.uint64)) ^ jnp.uint64(SIGN64)
 
 
 def _float_to_words(data):
@@ -45,8 +47,8 @@ def _float_to_words(data):
     f64 = jnp.where(jnp.isnan(f64), jnp.float64(jnp.nan), f64)
     f64 = jnp.where(f64 == 0.0, jnp.float64(0.0), f64)
     bits = f64.view(jnp.uint64)
-    sign = (bits & SIGN64) != 0
-    flipped = jnp.where(sign, ~bits, bits | SIGN64)
+    sign = (bits & jnp.uint64(SIGN64)) != 0
+    flipped = jnp.where(sign, ~bits, bits | jnp.uint64(SIGN64))
     # place +NaN above +inf (flipping already does since NaN mantissa != 0)
     return flipped
 

@@ -43,6 +43,25 @@ from ..shuffle.partitioners import (HashPartitioner, RangePartitioner,
 BROADCAST_ROW_THRESHOLD = 1 << 20  # rows; stand-in for byte-size stats
 
 
+def _scan_row_estimate(p) -> "Optional[int]":
+    """Row-count estimate for file scans (parquet metadata is cheap)."""
+    if getattr(p, "_row_estimate", None) is not None:
+        return p._row_estimate
+    try:
+        if p.fmt == "parquet":
+            import pyarrow.parquet as papq
+            from ..io.readers import expand_paths
+            total = 0
+            for f in expand_paths(p.paths):
+                total += papq.ParquetFile(f).metadata.num_rows
+            p._row_estimate = total
+            return total
+    except Exception:
+        pass
+    p._row_estimate = None
+    return None
+
+
 # ---------------------------------------------------------------------------
 # expression rules (the expr[...] registry, GpuOverrides.scala:773)
 # ---------------------------------------------------------------------------
@@ -432,7 +451,8 @@ class Planner:
         if p.group_exprs:
             keys = [ec.AttributeReference(f.name, f.dtype, f.nullable)
                     for f in list(buf_schema)[:len(p.group_exprs)]]
-            part = HashPartitioner(keys, min(self.default_partitions, nparts))
+            n = min(self._pick_partitions(p), nparts)
+            part = HashPartitioner(keys, n)
             shuffled: PhysicalPlan = EX.TpuShuffleExchange(partial, part)
         else:
             shuffled = EX.TpuCoalescePartitions(partial)
@@ -458,7 +478,7 @@ class Planner:
             bcast = EX.TpuBroadcastExchange(left)
             return TJ.TpuBroadcastHashJoin(p, bcast, right,
                                            build_right=False)
-        n = self.default_partitions
+        n = self._pick_partitions(p.children[0], p.children[1])
         lpart = HashPartitioner(p.left_keys, n)
         rpart = HashPartitioner(p.right_keys, n)
         lex = EX.TpuShuffleExchange(left, lpart)
@@ -470,11 +490,34 @@ class Planner:
             return p.table.num_rows
         if isinstance(p, L.Range):
             return max(0, -(-(p.end - p.start) // p.step))
-        if isinstance(p, (L.Project, L.Filter, L.Sort)):
+        if isinstance(p, (L.Project, L.Filter, L.Sort, L.Window)):
             return self._estimate_rows(p.children[0])
         if isinstance(p, L.Limit):
             return p.n
+        if isinstance(p, L.Scan):
+            return _scan_row_estimate(p)
+        if isinstance(p, L.Join):
+            l = self._estimate_rows(p.children[0])
+            r = self._estimate_rows(p.children[1])
+            if l is None or r is None:
+                return None
+            return max(l, r)
+        if isinstance(p, L.Aggregate):
+            return self._estimate_rows(p.children[0])
         return None
+
+    def _pick_partitions(self, *plans: L.LogicalPlan) -> int:
+        """Exchange width from size estimates: avoid many tiny partitions
+
+        (each distinct slice size is a separate XLA compilation)."""
+        est = 0
+        for p in plans:
+            r = self._estimate_rows(p)
+            if r is None:
+                return self.default_partitions
+            est = max(est, r)
+        need = max(1, -(-est // max(self.batch_rows, 1)))
+        return max(1, min(self.default_partitions, need))
 
     # -- global sort: range exchange + local sort --------------------------
     def _plan_sort(self, p: L.Sort, child: PhysicalPlan) -> PhysicalPlan:

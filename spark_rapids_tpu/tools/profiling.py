@@ -9,6 +9,11 @@ Usage:
 """
 from __future__ import annotations
 
+import jax as _jax
+
+# host-side CLI: never touch the accelerator backend
+_jax.config.update("jax_platforms", "cpu")
+
 import json
 import sys
 from typing import Dict, List

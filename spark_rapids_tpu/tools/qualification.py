@@ -7,6 +7,11 @@ Usage:  python -m spark_rapids_tpu.tools.qualification <event_log.jsonl>
 """
 from __future__ import annotations
 
+import jax as _jax
+
+# host-side CLI: never touch the accelerator backend
+_jax.config.update("jax_platforms", "cpu")
+
 import json
 import sys
 from typing import Dict, List
