@@ -1,0 +1,86 @@
+"""Pallas TPU kernels for hot ops XLA doesn't fuse well.
+
+Reference analogue: the hand-written CUDA kernels inside libcudf that the
+plugin leans on for hashing/partitioning (GpuHashPartitioning ->
+murmur3 + contiguousSplit).  Here the fused hash+partition-id kernel is
+written in Pallas so the multi-word mixing chain stays in VMEM in one
+pass instead of N elementwise HLOs round-tripping through HBM.
+
+Falls back to interpret mode off-TPU (CPU tests) and to the plain jnp
+path on any Pallas failure — behavior is identical by construction.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from .basic import M1, M2, mix64, hash_words as _hash_words_jnp
+
+_BLOCK = 1024
+
+
+def _mix_body(h, w):
+    x = h ^ w
+    x = x ^ (x >> jnp.uint64(33))
+    x = x * jnp.uint64(M1)
+    x = x ^ (x >> jnp.uint64(33))
+    x = x * jnp.uint64(M2)
+    x = x ^ (x >> jnp.uint64(33))
+    return x
+
+
+def _make_kernel(num_words: int, num_parts: int):
+    from jax.experimental import pallas as pl
+
+    def kernel(*refs):
+        word_refs = refs[:num_words]
+        out_ref = refs[num_words]
+        h = jnp.full(word_refs[0].shape, jnp.uint64(42))
+        for wr in word_refs:
+            h = _mix_body(h, wr[...])
+        out_ref[...] = (h % jnp.uint64(num_parts)).astype(jnp.int32)
+
+    @functools.partial(jax.jit, static_argnames=())
+    def run(*words):
+        n = words[0].shape[0]
+        grid = (n // _BLOCK,) if n % _BLOCK == 0 and n >= _BLOCK else None
+        interpret = jax.default_backend() != "tpu"
+        if grid is None:
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+                interpret=interpret,
+            )(*words)
+        spec = pl.BlockSpec((_BLOCK,), lambda i: (i,))
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[spec] * num_words,
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+            interpret=interpret,
+        )(*words)
+
+    return run
+
+
+_KERNEL_CACHE = {}
+
+
+def hash_partition_ids(word_lists: List[jnp.ndarray],
+                       num_parts: int) -> jnp.ndarray:
+    """Fused murmur-mix + mod over N key words -> partition id per row.
+
+    Pallas fast path with jnp fallback (identical math either way).
+    """
+    key = (len(word_lists), num_parts)
+    try:
+        if key not in _KERNEL_CACHE:
+            _KERNEL_CACHE[key] = _make_kernel(*key)
+        return _KERNEL_CACHE[key](*word_lists)
+    except Exception:
+        h = _hash_words_jnp(word_lists)
+        return (h % jnp.uint64(num_parts)).astype(jnp.int32)

@@ -86,8 +86,8 @@ class HashPartitioner(Partitioner):
             for w in canon.value_words(col, batch.num_rows):
                 word_lists.append(jnp.where(col.validity, w,
                                             jnp.uint64(0x9E3779B97F4A7C15)))
-        h = bk.hash_words(word_lists)
-        return bk.hash_to_partition(h, self.num_partitions)
+        from ..kernels.pallas_ops import hash_partition_ids
+        return hash_partition_ids(word_lists, self.num_partitions)
 
 
 class RoundRobinPartitioner(Partitioner):
