@@ -22,7 +22,8 @@ from typing import Optional
 import jax
 
 from ..config import (TpuConf, get_active, HBM_POOL_FRACTION, HBM_RESERVE,
-                      CONCURRENT_TPU_TASKS, HOST_SPILL_LIMIT, SPILL_DIR)
+                      CONCURRENT_TPU_TASKS, HOST_SPILL_LIMIT, SPILL_DIR,
+                      SHUFFLE_COMPRESS)
 from .catalog import BufferCatalog
 
 
@@ -68,7 +69,8 @@ class DeviceManager:
         self.catalog = BufferCatalog.reset(
             spill_dir=conf.get(SPILL_DIR),
             device_limit=device_limit,
-            host_limit=conf.get(HOST_SPILL_LIMIT))
+            host_limit=conf.get(HOST_SPILL_LIMIT),
+            compression=conf.get(SHUFFLE_COMPRESS))
         self.semaphore = DeviceSemaphore(conf.get(CONCURRENT_TPU_TASKS))
         self.hbm_total = hbm_total
         self.device_limit = device_limit

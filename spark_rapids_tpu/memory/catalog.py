@@ -59,7 +59,8 @@ class BufferCatalog:
     def __init__(self, spill_dir: str = "/tmp/spark_rapids_tpu_spill",
                  device_limit: int = 28 << 30,
                  host_limit: int = 8 << 30,
-                 use_native_arena: bool = True):
+                 use_native_arena: bool = True,
+                 compression: str = "none"):
         self._entries: Dict[str, BufferEntry] = {}
         self._lock = threading.RLock()
         self.spill_dir = spill_dir
@@ -70,6 +71,8 @@ class BufferCatalog:
         self.disk_bytes = 0
         self.spilled_device_to_host = 0
         self.spilled_host_to_disk = 0
+        from ..shuffle.compression import get_codec
+        self.codec = get_codec(compression)
         # native host slab arena for the HOST tier (pinned-pool role);
         # graceful fallback to python-heap payloads if the build fails
         self.arena = None
@@ -217,14 +220,21 @@ class BufferCatalog:
         os.makedirs(self.spill_dir, exist_ok=True)
         path = os.path.join(self.spill_dir, f"{e.buffer_id}.spill")
         payload = e.host_payload
+        compressed = self.codec.name != "none"
         if isinstance(payload, tuple) and payload and payload[0] == "arena":
-            # stream the slab region straight to the file (native fast path)
             _, schema, num_rows, kinds, metas, off, total = payload
-            self.arena.write_file(off, max(total, 1), path + ".raw")
+            if compressed:
+                raw = bytes(self.arena.view(off, max(total, 1)))
+                with open(path + ".raw", "wb") as f:
+                    f.write(self.codec.compress(raw))
+            else:
+                # stream the slab region straight to the file (native path)
+                self.arena.write_file(off, max(total, 1), path + ".raw")
             self.arena.free(off)
             with open(path, "wb") as f:
                 pickle.dump(("arena_file", schema, num_rows, kinds, metas,
-                             total), f, protocol=4)
+                             total, self.codec.name if compressed
+                             else "none"), f, protocol=4)
         else:
             with open(path, "wb") as f:
                 pickle.dump(payload, f, protocol=4)
@@ -250,9 +260,18 @@ class BufferCatalog:
             payload = pickle.load(f)
         if isinstance(payload, tuple) and payload and \
                 payload[0] == "arena_file":
-            _, schema, num_rows, kinds, metas, total = payload
+            _, schema, num_rows, kinds, metas, total, codec_name = payload
             off = self.arena.alloc(max(total, 1))
-            self.arena.read_file(off, max(total, 1), e.disk_path + ".raw")
+            if codec_name != "none":
+                from ..shuffle.compression import get_codec
+                with open(e.disk_path + ".raw", "rb") as f:
+                    raw = get_codec(codec_name).decompress(
+                        f.read(), max(total, 1))
+                self.arena.view(off, max(total, 1))[:] = \
+                    np.frombuffer(raw, np.uint8)
+            else:
+                self.arena.read_file(off, max(total, 1),
+                                     e.disk_path + ".raw")
             os.unlink(e.disk_path + ".raw")
             payload = ("arena", schema, num_rows, kinds, metas, off, total)
         os.unlink(e.disk_path)

@@ -76,7 +76,8 @@ def distributed_sum_by_key(mesh: Mesh, axis_name: str = "data"):
       2. all_to_all exchange routing each key group to hash(key) % n_dev
       3. local final merge of received partials
     """
-    shard_map = jax.shard_map
+    from ..shims import get_shard_map
+    shard_map = get_shard_map()
 
     n_dev = mesh.devices.size
 
@@ -126,7 +127,8 @@ def distributed_sum_by_key(mesh: Mesh, axis_name: str = "data"):
 
 def distributed_global_sum(mesh: Mesh, axis_name: str = "data"):
     """psum-based global reduction (the broadcast/reduce primitive)."""
-    shard_map = jax.shard_map
+    from ..shims import get_shard_map
+    shard_map = get_shard_map()
 
     def step(vals, valid):
         local = jnp.sum(jnp.where(valid, vals, 0))

@@ -1,0 +1,115 @@
+"""Version-compat shim layer — the ShimLoader role.
+
+Reference: ShimLoader.scala:26 + shims/ (12 modules): every touchpoint
+with version-unstable Spark internals goes through a SparkShims trait
+selected at runtime.  The TPU build's unstable dependency surface is the
+**JAX API** (modules move between jax.experimental and core across
+releases), so the same pattern applies: all version-sensitive JAX access
+goes through the shim selected by version probe, with an override conf
+(spark.rapids.tpu.shims-provider-override) mirroring
+spark.rapids.shims-provider-override.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Callable, List, Optional, Type
+
+import jax
+
+
+class JaxShimBase:
+    """Shim interface: every version-sensitive JAX API in one place."""
+
+    version_prefixes: List[str] = []
+
+    @staticmethod
+    def shard_map():
+        raise NotImplementedError
+
+    @staticmethod
+    def pallas():
+        raise NotImplementedError
+
+    @staticmethod
+    def key_array(seed: int):
+        raise NotImplementedError
+
+    @staticmethod
+    def device_memory_stats(device) -> Optional[dict]:
+        try:
+            return device.memory_stats()
+        except Exception:
+            return None
+
+
+class JaxShim09(JaxShimBase):
+    """jax >= 0.7: shard_map promoted to jax.shard_map."""
+
+    version_prefixes = ["0.7", "0.8", "0.9", "1."]
+
+    @staticmethod
+    def shard_map():
+        return jax.shard_map
+
+    @staticmethod
+    def pallas():
+        from jax.experimental import pallas as pl
+        return pl
+
+    @staticmethod
+    def key_array(seed: int):
+        import jax.random as jr
+        return jr.key(seed)
+
+
+class JaxShimLegacy(JaxShimBase):
+    """jax < 0.7: experimental namespaces."""
+
+    version_prefixes = ["0.4", "0.5", "0.6"]
+
+    @staticmethod
+    def shard_map():
+        from jax.experimental.shard_map import shard_map
+        return shard_map
+
+    @staticmethod
+    def pallas():
+        from jax.experimental import pallas as pl
+        return pl
+
+    @staticmethod
+    def key_array(seed: int):
+        import jax.random as jr
+        return jr.PRNGKey(seed)
+
+
+_PROVIDERS: List[Type[JaxShimBase]] = [JaxShim09, JaxShimLegacy]
+_active: Optional[Type[JaxShimBase]] = None
+
+
+def detect_shim() -> Type[JaxShimBase]:
+    """ShimLoader.detectShimProvider role: probe the runtime version."""
+    global _active
+    if _active is not None:
+        return _active
+    from ..config import get_active, SHIM_PROVIDER_OVERRIDE
+    override = get_active().get(SHIM_PROVIDER_OVERRIDE)
+    if override:
+        mod, _, cls = override.rpartition(".")
+        _active = getattr(importlib.import_module(mod), cls)
+        return _active
+    ver = jax.__version__
+    for p in _PROVIDERS:
+        if any(ver.startswith(v) for v in p.version_prefixes):
+            _active = p
+            return p
+    _active = JaxShim09  # newest as default
+    return _active
+
+
+def get_shard_map():
+    return detect_shim().shard_map()
+
+
+def get_pallas():
+    return detect_shim().pallas()

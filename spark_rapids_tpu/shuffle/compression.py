@@ -1,0 +1,78 @@
+"""Shuffle/spill compression codec SPI.
+
+Reference: TableCompressionCodec.scala (378 LoC) + NvcompLZ4CompressionCodec
++ CopyCompressionCodec: a codec SPI compressing table buffers before
+shuffle, selected by spark.rapids.shuffle.compression.codec.
+
+TPU adaptation: compression happens at the host boundary (spill tier and
+DCN-edge shuffle), since ICI transfers of live device buffers don't
+round-trip through host codecs.  Codecs: none (copy), zlib (stdlib), and
+lz4-frame when the optional lz4 wheel exists.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Dict, Type
+
+
+class CompressionCodec:
+    name = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        return data
+
+    def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        return data
+
+
+class CopyCodec(CompressionCodec):
+    """Reference: CopyCompressionCodec — identity."""
+    name = "none"
+
+
+class ZlibCodec(CompressionCodec):
+    name = "zlib"
+
+    def __init__(self, level: int = 1):
+        self.level = level
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(data, self.level)
+
+    def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        return zlib.decompress(data)
+
+
+class Lz4Codec(CompressionCodec):
+    """Reference: NvcompLZ4CompressionCodec role (optional dependency)."""
+    name = "lz4"
+
+    def __init__(self):
+        import lz4.frame  # noqa: F401 — raises if unavailable
+        self._lz4 = __import__("lz4.frame", fromlist=["frame"])
+
+    def compress(self, data: bytes) -> bytes:
+        return self._lz4.compress(data)
+
+    def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        return self._lz4.decompress(data)
+
+
+_CODECS: Dict[str, Type[CompressionCodec]] = {
+    "none": CopyCodec,
+    "copy": CopyCodec,
+    "zlib": ZlibCodec,
+    "lz4": Lz4Codec,
+}
+
+
+def get_codec(name: str) -> CompressionCodec:
+    name = (name or "none").lower()
+    cls = _CODECS.get(name)
+    if cls is None:
+        raise ValueError(f"unknown compression codec {name}; "
+                         f"choices: {sorted(_CODECS)}")
+    try:
+        return cls()
+    except ImportError:
+        return ZlibCodec()
