@@ -63,6 +63,16 @@ def last(c, ignore_nulls: bool = True) -> Col:
                          ignore_nulls))
 
 
+def collect_list(c) -> Col:
+    return Col(eagg.CollectList(_expr(c if not isinstance(c, str)
+                                      else col(c))))
+
+
+def collect_set(c) -> Col:
+    return Col(eagg.CollectSet(_expr(c if not isinstance(c, str)
+                                     else col(c))))
+
+
 def count_distinct(c) -> Col:
     raise NotImplementedError("count_distinct lands with distinct-agg support")
 

@@ -34,6 +34,8 @@ _TO_ARROW = {
 def to_arrow_type(dt: T.DType) -> pa.DataType:
     if isinstance(dt, T.DecimalType):
         return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, T.ArrayType):
+        return pa.list_(to_arrow_type(dt.element_type))
     if dt in _TO_ARROW:
         return _TO_ARROW[dt]
     raise ValueError(f"no arrow type for {dt}")
@@ -60,6 +62,8 @@ def from_arrow_type(at: pa.DataType) -> T.DType:
         return T.DATE
     if pa.types.is_timestamp(at):
         return T.TIMESTAMP
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return T.ArrayType(from_arrow_type(at.value_type))
     if pa.types.is_decimal(at):
         if at.precision > T.DecimalType.MAX_PRECISION:
             raise ValueError(f"decimal precision {at.precision} > 18")

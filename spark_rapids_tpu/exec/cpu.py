@@ -180,6 +180,7 @@ def _agg_arrow(func: eagg.AggregateFunction, table: pa.Table,
         eagg.Sum: "sum", eagg.Count: "count", eagg.Min: "min",
         eagg.Max: "max", eagg.Average: "mean",
         eagg.First: "first", eagg.Last: "last",
+        eagg.CollectList: "list", eagg.CollectSet: "distinct",
     }[type(func)]
     decode = False
     at = arr.type if not isinstance(arr, pa.ChunkedArray) else arr.type

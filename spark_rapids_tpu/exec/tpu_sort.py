@@ -53,21 +53,38 @@ class TpuSort(TpuExec):
     def execute(self):
         def run(part):
             if self.sort_each_batch:
+                # mode 1: sort-each-batch (GpuSortExec.scala:56 first mode)
                 for b in part:
                     with timed(self.metrics[SORT_TIME]):
                         out = self._sort_batch(b)
                     self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
                     yield out
-            else:
-                batches = [b for b in part]
-                if not batches:
-                    return
-                batch = concat_batches(batches) if len(batches) > 1 \
-                    else batches[0]
+                return
+            # modes 2/3: buffer input as *sorted spillable runs* so device
+            # pressure can push pending runs down the tiers while more
+            # input streams in (the out-of-core design of
+            # GpuSortExec.scala:219), then merge.
+            from ..memory.spillable import SpillableBatch
+            from ..memory.arena import DeviceManager
+            runs = []
+            for b in part:
+                if b.num_rows == 0:
+                    continue
                 with timed(self.metrics[SORT_TIME]):
-                    out = self._sort_batch(batch)
-                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
-                yield out
+                    sorted_run = self._sort_batch(b)
+                DeviceManager.get().reserve(sorted_run.nbytes())
+                runs.append(SpillableBatch(sorted_run))
+            if not runs:
+                return
+            with timed(self.metrics[SORT_TIME]):
+                batches = [r.materialize() for r in runs]
+                merged = concat_batches(batches) if len(batches) > 1 \
+                    else batches[0]
+                out = self._sort_batch(merged)
+            for r in runs:
+                r.close()
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+            yield out
         return [run(p) for p in self.children[0].execute()]
 
 

@@ -29,6 +29,6 @@ from .datetime import (Year, Month, DayOfMonth, Quarter, DayOfWeek, WeekDay,
                        DateSub, DateDiff, UnixTimestampToSeconds,
                        ToDate)  # noqa: F401
 from .aggregates import (AggregateFunction, Sum, Count, Min, Max, Average,
-                         First, Last)  # noqa: F401
+                         First, Last, CollectList, CollectSet)  # noqa: F401
 from .misc import (Murmur3Hash, Md5, MonotonicallyIncreasingID,
                    SparkPartitionID, Rand)  # noqa: F401

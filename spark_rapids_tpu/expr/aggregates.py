@@ -209,3 +209,27 @@ class Last(AggregateFunction):
         return [out.mask_validity(has)]
 
     merge = update
+
+
+class CollectList(AggregateFunction):
+    """collect_list — CPU-engine only for now (ArrayType output is not yet
+
+    device-resident; the planner falls back, reference-style)."""
+
+    def dtype(self):
+        return T.ArrayType(self.children[0].dtype())
+
+    def update(self, plan, cols):
+        raise NotImplementedError("collect_list runs on the CPU engine")
+
+    merge = update
+
+
+class CollectSet(AggregateFunction):
+    def dtype(self):
+        return T.ArrayType(self.children[0].dtype())
+
+    def update(self, plan, cols):
+        raise NotImplementedError("collect_set runs on the CPU engine")
+
+    merge = update
