@@ -179,6 +179,61 @@ def concat(*cols) -> Col:
         *[_expr(c if not isinstance(c, str) else col(c)) for c in cols]))
 
 
+def replace(c, search: str, rep: str) -> Col:
+    return Col(es.Replace(_expr(c if not isinstance(c, str) else col(c)),
+                          ec.Literal(search), ec.Literal(rep)))
+
+
+def reverse(c) -> Col:
+    return Col(es.Reverse(_expr(c if not isinstance(c, str) else col(c))))
+
+
+def repeat(c, n: int) -> Col:
+    return Col(es.StringRepeat(_expr(c if not isinstance(c, str)
+                                     else col(c)), ec.Literal(n)))
+
+
+def lpad(c, n: int, pad: str = " ") -> Col:
+    return Col(es.Lpad(_expr(c if not isinstance(c, str) else col(c)),
+                       ec.Literal(n), ec.Literal(pad)))
+
+
+def rpad(c, n: int, pad: str = " ") -> Col:
+    return Col(es.Rpad(_expr(c if not isinstance(c, str) else col(c)),
+                       ec.Literal(n), ec.Literal(pad)))
+
+
+def initcap(c) -> Col:
+    return Col(es.InitCap(_expr(c if not isinstance(c, str) else col(c))))
+
+
+def instr(c, substr: str) -> Col:
+    return Col(es.StringLocate(ec.Literal(substr),
+                               _expr(c if not isinstance(c, str)
+                                     else col(c))))
+
+
+def locate(substr: str, c, pos: int = 1) -> Col:
+    return instr(c, substr)
+
+
+def concat_ws(sep: str, *cols) -> Col:
+    return Col(es.ConcatWs(sep, *[_expr(c if not isinstance(c, str)
+                                        else col(c)) for c in cols]))
+
+
+def regexp_replace(c, pattern: str, rep: str) -> Col:
+    return Col(es.RegexpReplace(_expr(c if not isinstance(c, str)
+                                      else col(c)),
+                                ec.Literal(pattern), ec.Literal(rep)))
+
+
+def regexp_extract(c, pattern: str, group: int = 1) -> Col:
+    return Col(es.RegexpExtract(_expr(c if not isinstance(c, str)
+                                      else col(c)),
+                                ec.Literal(pattern), group))
+
+
 def md5(c) -> Col:
     return Col(emisc.Md5(_expr(c if not isinstance(c, str) else col(c))))
 

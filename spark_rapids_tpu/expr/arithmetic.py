@@ -26,6 +26,17 @@ class BinaryArithmetic(Expression):
         return type(self)(children[0], children[1])
 
     def result_type(self, lt: T.DType, rt: T.DType) -> T.DType:
+        if (isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType)) \
+                and self.symbol in ("+", "-", "*"):
+            sa = lt.scale if isinstance(lt, T.DecimalType) else 0
+            sb = rt.scale if isinstance(rt, T.DecimalType) else 0
+            if self.symbol == "*":
+                return T.DecimalType(
+                    min(T.DecimalType.MAX_PRECISION, _prec(lt) + _prec(rt)),
+                    sa + sb)
+            return T.DecimalType(
+                min(T.DecimalType.MAX_PRECISION,
+                    max(_prec(lt), _prec(rt)) + 1), max(sa, sb))
         return T.common_type(lt, rt)
 
     def dtype(self):
@@ -38,7 +49,42 @@ class BinaryArithmetic(Expression):
     def extra_null_mask(self, a, b) -> Optional[jnp.ndarray]:
         return None
 
+    def _decimal_eval(self, batch, lt, rt):
+        """Decimal add/sub/mul on unscaled int64 with Spark scale rules
+
+        (reference: decimalExpressions.scala, DECIMAL64 subset)."""
+        la, lv, _ = eval_data_valid(self.children[0], batch)
+        ra, rv, _ = eval_data_valid(self.children[1], batch)
+
+        def unscaled(a, t):
+            if isinstance(t, T.DecimalType):
+                return a.astype(jnp.int64), t.scale
+            return a.astype(jnp.int64), 0
+        a, sa = unscaled(la, lt)
+        b, sb = unscaled(ra, rt)
+        kind = self.symbol
+        if kind in ("+", "-"):
+            s = max(sa, sb)
+            a = a * (10 ** (s - sa))
+            b = b * (10 ** (s - sb))
+            data = a + b if kind == "+" else a - b
+            prec = min(T.DecimalType.MAX_PRECISION,
+                       max(_prec(lt), _prec(rt)) + 1)
+            return Column(T.DecimalType(prec, s), data, lv & rv)
+        if kind == "*":
+            s = sa + sb
+            prec = min(T.DecimalType.MAX_PRECISION, _prec(lt) + _prec(rt))
+            if s > T.DecimalType.MAX_PRECISION:
+                raise ValueError("decimal multiply scale overflow")
+            return Column(T.DecimalType(prec, s), a * b, lv & rv)
+        raise NotImplementedError(f"decimal {kind}")
+
     def columnar_eval(self, batch):
+        lt = self.children[0].dtype()
+        rt = self.children[1].dtype()
+        if (isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType)) \
+                and self.symbol in ("+", "-", "*"):
+            return self._decimal_eval(batch, lt, rt)
         la, lv, lt = eval_data_valid(self.children[0], batch)
         ra, rv, rt = eval_data_valid(self.children[1], batch)
         out_t = self.result_type(lt, rt)
@@ -53,6 +99,12 @@ class BinaryArithmetic(Expression):
 
     def __repr__(self):
         return f"({self.children[0]!r} {self.symbol} {self.children[1]!r})"
+
+
+def _prec(t: T.DType) -> int:
+    if isinstance(t, T.DecimalType):
+        return t.precision
+    return 19  # int64 worst case; clamped by MAX_PRECISION anyway
 
 
 class Add(BinaryArithmetic):

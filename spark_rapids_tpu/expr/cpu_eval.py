@@ -166,6 +166,11 @@ def _common_arrow(at, bt):
              pa.float64()]
     if at in order and bt in order:
         return order[max(order.index(at), order.index(bt))]
+    if pa.types.is_decimal(at) or pa.types.is_decimal(bt):
+        # compare in a wide decimal so mixed scales/ints always fit
+        sa = at.scale if pa.types.is_decimal(at) else 0
+        sb = bt.scale if pa.types.is_decimal(bt) else 0
+        return pa.decimal128(38, max(sa, sb))
     return at
 
 
@@ -520,6 +525,27 @@ _DISPATCH = {
     S.StringTrim: _trim("both"),
     S.StringTrimLeft: _trim("left"),
     S.StringTrimRight: _trim("right"),
+    S.Replace: lambda e, t: pc.replace_substring(
+        _ev(e.children[0], t), pattern=e.children[1].value,
+        replacement=e.children[2].value),
+    S.Reverse: lambda e, t: pc.utf8_reverse(_ev(e.children[0], t)),
+    S.Lpad: lambda e, t: pc.utf8_lpad(
+        pc.utf8_slice_codeunits(_ev(e.children[0], t), 0,
+                                e.children[1].value),
+        width=e.children[1].value, padding=e.children[2].value),
+    S.Rpad: lambda e, t: pc.utf8_rpad(
+        pc.utf8_slice_codeunits(_ev(e.children[0], t), 0,
+                                e.children[1].value),
+        width=e.children[1].value, padding=e.children[2].value),
+    S.StringRepeat: lambda e, t: pc.binary_repeat(
+        _ev(e.children[0], t), e.children[1].value),
+    S.StringLocate: lambda e, t: pc.cast(
+        pc.add(pc.find_substring(_ev(e.children[1], t),
+                                 pattern=e.children[0].value), 1),
+        pa.int32()),
+    S.RegexpReplace: lambda e, t: pc.replace_substring_regex(
+        _ev(e.children[0], t), pattern=e.children[1].value,
+        replacement=e.children[2].value),
     DT.Year: _dt_field(pc.year),
     DT.Month: _dt_field(pc.month),
     DT.DayOfMonth: _dt_field(pc.day),

@@ -346,3 +346,207 @@ class StringTrimLeft(StringTrim):
 
 class StringTrimRight(StringTrim):
     side = "trailing"
+
+
+class _HostStringOp(Expression):
+    """Base for string ops evaluated via host round-trip (the reference
+
+    similarly keeps rare/irregular string ops off the fast path or gates
+    them by conf; device byte kernels can replace these incrementally)."""
+
+    def __init__(self, *children, **params):
+        self.children = list(children)
+        self.params = params
+
+    def with_children(self, c):
+        return type(self)(*c, **self.params)
+
+    def dtype(self):
+        return T.STRING
+
+    def host_fn(self, *vals):
+        raise NotImplementedError
+
+    def columnar_eval(self, batch):
+        n = batch.num_rows
+        cols = [as_column(c.columnar_eval(batch), batch.capacity, n)
+                for c in self.children]
+        lists = [c.to_pylist(n) for c in cols]
+        out = []
+        for row in zip(*lists):
+            if any(v is None for v in row):
+                out.append(None)
+            else:
+                out.append(self.host_fn(*row))
+        return StringColumn.from_pylist(
+            out + [None] * (batch.capacity - n), capacity=batch.capacity)
+
+
+class Replace(_HostStringOp):
+    """replace(str, search, replace) (reference: GpuStringReplace)."""
+
+    def host_fn(self, s, search, rep):
+        return s.replace(search, rep) if search else s
+
+
+class Reverse(Expression):
+    """reverse(str) — device kernel: per-row byte reversal via index math.
+
+    (Reverses code points; built from the same windowed-gather primitive
+    as substring.)"""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def with_children(self, c):
+        return Reverse(c[0])
+
+    def dtype(self):
+        return T.STRING
+
+    def columnar_eval(self, batch):
+        # correct for ASCII via pure byte reversal; multi-byte code points
+        # handled by host fallback when any non-ASCII byte present
+        col = _eval_string(self.children[0], batch)
+        import numpy as np
+        has_mb = bool(np.asarray((col.data & 0x80) != 0).any())
+        if has_mb:
+            vals, valid = col.to_numpy(batch.num_rows)
+            out = [v[::-1] if ok else None for v, ok in zip(vals, valid)]
+            return StringColumn.from_pylist(
+                out + [None] * (batch.capacity - batch.num_rows),
+                capacity=batch.capacity)
+        starts = col.offsets[:-1]
+        ends = col.offsets[1:]
+        B = col.data.shape[0]
+        j = jnp.arange(B, dtype=jnp.int32)
+        row = jnp.clip(jnp.searchsorted(col.offsets[1:], j, side="right"),
+                       0, col.capacity - 1)
+        src = jnp.clip(starts[row] + (ends[row] - 1 - j), 0, B - 1)
+        return StringColumn(col.offsets, jnp.take(col.data, src),
+                            col.validity)
+
+
+class StringRepeat(_HostStringOp):
+    def host_fn(self, s, n):
+        return s * max(int(n), 0)
+
+
+class Lpad(_HostStringOp):
+    def host_fn(self, s, n, pad):
+        n = int(n)
+        if len(s) >= n:
+            return s[:n]
+        if not pad:
+            return s
+        fill = (pad * n)[: n - len(s)]
+        return fill + s
+
+
+class Rpad(_HostStringOp):
+    def host_fn(self, s, n, pad):
+        n = int(n)
+        if len(s) >= n:
+            return s[:n]
+        if not pad:
+            return s
+        fill = (pad * n)[: n - len(s)]
+        return s + fill
+
+
+class InitCap(_HostStringOp):
+    def host_fn(self, s):
+        return " ".join(w[:1].upper() + w[1:].lower() if w else w
+                        for w in s.split(" "))
+
+
+class StringLocate(Expression):
+    """instr/locate: 1-based position of substring, 0 if absent."""
+
+    def __init__(self, substr: Expression, child: Expression):
+        self.children = [substr, child]
+
+    def with_children(self, c):
+        return StringLocate(c[0], c[1])
+
+    def dtype(self):
+        return T.INT32
+
+    def columnar_eval(self, batch):
+        import numpy as np
+        n = batch.num_rows
+        sub = as_column(self.children[0].columnar_eval(batch),
+                        batch.capacity, n)
+        s = as_column(self.children[1].columnar_eval(batch),
+                      batch.capacity, n)
+        subs, sv = sub.to_numpy(n)
+        vals, vv = s.to_numpy(n)
+        out = np.zeros(batch.capacity, np.int32)
+        ok = np.zeros(batch.capacity, bool)
+        for i in range(n):
+            if sv[i] and vv[i]:
+                ok[i] = True
+                out[i] = vals[i].find(subs[i]) + 1
+        return Column(T.INT32, jnp.asarray(out), jnp.asarray(ok))
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, cols...): nulls skipped (unlike concat)."""
+
+    def __init__(self, sep: str, *children):
+        self.sep = sep
+        self.children = list(children)
+
+    def with_children(self, c):
+        return ConcatWs(self.sep, *c)
+
+    def dtype(self):
+        return T.STRING
+
+    def columnar_eval(self, batch):
+        n = batch.num_rows
+        cols = [as_column(c.columnar_eval(batch), batch.capacity, n)
+                for c in self.children]
+        lists = [c.to_pylist(n) for c in cols]
+        out = []
+        for row in zip(*lists) if lists else [()] * n:
+            out.append(self.sep.join(str(v) for v in row if v is not None))
+        return StringColumn.from_pylist(
+            out + [None] * (batch.capacity - n), capacity=batch.capacity)
+
+
+class RegexpReplace(_HostStringOp):
+    """regexp_replace (host regex; reference gates regex similarly)."""
+
+    def host_fn(self, s, pattern, rep):
+        return re.sub(pattern, rep.replace("$", "\\\\"), s)
+
+
+class RegexpExtract(Expression):
+    def __init__(self, child, pattern: Expression, group: int = 1):
+        self.children = [child, pattern]
+        self.group = group
+
+    def with_children(self, c):
+        return RegexpExtract(c[0], c[1], self.group)
+
+    def dtype(self):
+        return T.STRING
+
+    def columnar_eval(self, batch):
+        pat = self.children[1]
+        assert isinstance(pat, Literal)
+        rx = re.compile(str(pat.value))
+        col = _eval_string(self.children[0], batch)
+        vals, valid = col.to_numpy(batch.num_rows)
+        out = []
+        for i in range(batch.num_rows):
+            if not valid[i]:
+                out.append(None)
+            else:
+                m = rx.search(vals[i])
+                out.append(m.group(self.group) if m and
+                           self.group <= (m.lastindex or 0) else "")
+        return StringColumn.from_pylist(
+            out + [None] * (batch.capacity - batch.num_rows),
+            capacity=batch.capacity)

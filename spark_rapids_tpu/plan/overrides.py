@@ -101,7 +101,10 @@ for _cls in [econd.If, econd.CaseWhen, econd.Coalesce, econd.NaNvl]:
 expr_rule(ecast.Cast, TS.ALL_SUPPORTED)
 for _cls in [es.Upper, es.Lower, es.Length, es.Substring, es.StartsWith,
              es.EndsWith, es.Contains, es.Like, es.RLike, es.ConcatStrings,
-             es.StringTrim, es.StringTrimLeft, es.StringTrimRight]:
+             es.StringTrim, es.StringTrimLeft, es.StringTrimRight,
+             es.Replace, es.Reverse, es.StringRepeat, es.Lpad, es.Rpad,
+             es.InitCap, es.StringLocate, es.ConcatWs, es.RegexpReplace,
+             es.RegexpExtract]:
     expr_rule(_cls, TS.STRING_SIG)
 for _cls in [edt.Year, edt.Month, edt.DayOfMonth, edt.Quarter, edt.DayOfWeek,
              edt.WeekDay, edt.DayOfYear, edt.LastDay, edt.Hour, edt.Minute,

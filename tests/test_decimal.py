@@ -1,0 +1,65 @@
+"""Decimal64 tests — reference: decimalExpressions.scala + the
+DECIMAL64-only gate (GpuOverrides.scala:659)."""
+from decimal import Decimal
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.config import TpuConf
+
+from harness import assert_tpu_and_cpu_are_equal_collect
+
+
+def _dec_table():
+    return pa.table({
+        "a": pa.array([Decimal("1.50"), Decimal("-2.25"), None,
+                       Decimal("1000.01")], pa.decimal128(10, 2)),
+        "b": pa.array([Decimal("0.5"), Decimal("1.5"), Decimal("2.0"),
+                       None], pa.decimal128(8, 1)),
+        "k": [1, 1, 2, 2],
+    })
+
+
+class TestDecimal:
+    def test_roundtrip(self):
+        s = TpuSession(TpuConf({}))
+        df = s.create_dataframe(_dec_table())
+        rows = df.collect()
+        assert rows[0][0] == Decimal("1.50")
+        assert rows[2][0] is None
+
+    def test_add_mixed_scale(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: s.create_dataframe(_dec_table())
+            .select((F.col("a") + F.col("b")).alias("s"),
+                    (F.col("a") - F.col("b")).alias("d")))
+
+    def test_multiply(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: s.create_dataframe(_dec_table())
+            .select((F.col("a") * F.col("b")).alias("m")))
+
+    def test_sum_group(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: s.create_dataframe(_dec_table())
+            .group_by("k").agg(F.sum("a").alias("sa")))
+
+    def test_compare_and_sort(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: s.create_dataframe(_dec_table())
+            .filter(F.col("a") > 0).sort("a"),
+            ignore_order=False)
+
+    def test_decimal_disabled_falls_back(self):
+        s = TpuSession(TpuConf({
+            "spark.rapids.tpu.sql.decimalType.enabled": False}))
+        df = s.create_dataframe(_dec_table()).select(
+            (F.col("a") + F.col("b")).alias("s"))
+        df.collect()  # runs on CPU engine
+        assert any("decimal" in f for f in s._last_planner.fallbacks)
+
+    def test_precision_over_18_rejected(self):
+        with pytest.raises(ValueError):
+            T.DecimalType(20, 2)

@@ -1,0 +1,48 @@
+"""Extended string expression tests (reference: string_test.py breadth)."""
+from spark_rapids_tpu.api import functions as F
+
+from harness import assert_tpu_and_cpu_are_equal_collect
+from data_gen import StringGen, IntGen, gen_df
+
+N = 120
+
+
+class TestStringsExtra:
+    def test_replace(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"s": StringGen(charset="abcab ")}, N)
+            .select(F.replace("s", "ab", "X").alias("r")))
+
+    def test_reverse_ascii(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"s": StringGen()}, N)
+            .select(F.reverse("s").alias("r")))
+
+    def test_reverse_unicode(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"s": StringGen(charset="aöü日")}, N)
+            .select(F.reverse("s").alias("r")))
+
+    def test_pad_repeat(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"s": StringGen(max_len=6)}, N)
+            .select(F.lpad("s", 8, "*").alias("l"),
+                    F.rpad("s", 8, "-").alias("r"),
+                    F.repeat("s", 2).alias("rep")))
+
+    def test_initcap_instr(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"s": StringGen(charset="ab AB")}, N)
+            .select(F.initcap("s").alias("ic"),
+                    F.instr("s", "b").alias("pos")))
+
+    def test_concat_ws(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"a": StringGen(), "b": StringGen()}, N)
+            .select(F.concat_ws("-", "a", "b").alias("c")))
+
+    def test_regexp(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"s": StringGen(charset="ab12")}, N)
+            .select(F.regexp_replace("s", "[0-9]+", "#").alias("rr"),
+                    F.regexp_extract("s", "([0-9]+)", 1).alias("rx")))
