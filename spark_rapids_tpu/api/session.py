@@ -21,6 +21,27 @@ from ..plan import logical as L
 from ..plan.overrides import Planner
 
 
+_CACHE_ENABLED = False
+
+
+def _enable_compilation_cache():
+    """Persistent XLA compilation cache: kernels are compiled per
+
+    (schema, capacity-bucket), so cross-process reuse pays off immediately
+    (first TPU compile is expensive; SURVEY.md §7 compile-cache note)."""
+    global _CACHE_ENABLED
+    if _CACHE_ENABLED:
+        return
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/spark_rapids_tpu_xla_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _CACHE_ENABLED = True
+    except Exception:
+        pass
+
+
 class TpuSessionBuilder:
     def __init__(self):
         self._conf: Dict[str, object] = {}
@@ -39,6 +60,7 @@ class TpuSession:
     def __init__(self, conf: Optional[TpuConf] = None):
         self.conf = conf or TpuConf()
         set_active(self.conf)
+        _enable_compilation_cache()
         DeviceManager.initialize(self.conf)
         TpuSession._active = self
         self._last_planner: Optional[Planner] = None

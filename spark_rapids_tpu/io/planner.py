@@ -45,6 +45,11 @@ class TpuFileScan(TpuExec):
         self.strategy = _strategy(logical.fmt, conf)
         self._partitions = split_files_into_partitions(
             self.files, conf.get(SHUFFLE_PARTITIONS))
+        self.pushed_filters = None
+
+    def set_pushed_filters(self, filters):
+        """Planner-pushed predicate (GpuParquetScan pushdown role)."""
+        self.pushed_filters = filters
 
     @property
     def output_schema(self):
@@ -54,8 +59,9 @@ class TpuFileScan(TpuExec):
         return len(self._partitions)
 
     def _node_string(self):
+        pf = f", pushed={self.pushed_filters}" if self.pushed_filters else ""
         return (f"TpuFileScan[{self.logical.fmt}, {self.strategy}, "
-                f"{len(self.files)} files]")
+                f"{len(self.files)} files{pf}]")
 
     def execute(self):
         max_rows = self.conf.get(MAX_READER_BATCH_ROWS)
@@ -65,7 +71,8 @@ class TpuFileScan(TpuExec):
                 self.logical.fmt, files,
                 strategy=self.strategy,
                 num_threads=self.conf.get(MULTITHREAD_READ_THREADS),
-                options=self.logical.options)
+                options=self.logical.options,
+                pushed_filters=self.pushed_filters)
             for table in reader:
                 pos = 0
                 n = table.num_rows

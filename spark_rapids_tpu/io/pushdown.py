@@ -1,0 +1,87 @@
+"""Scan predicate pushdown: Expression -> pyarrow filter DNF.
+
+Reference: GpuParquetScan predicate pushdown via re-written footer filters
+(GpuParquetScan.scala) and OrcFilters.  Here translatable conjuncts become
+pyarrow dataset filters (row-group/stripe pruning happens inside pyarrow);
+the engine keeps the full Filter above the scan, so partial translation is
+always safe — exactly the reference's belt-and-suspenders model.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..expr import core as ec
+from ..expr import predicates as ep
+
+_OPS = {
+    ep.EqualTo: "==", ep.LessThan: "<", ep.LessThanOrEqual: "<=",
+    ep.GreaterThan: ">", ep.GreaterThanOrEqual: ">=",
+}
+
+
+def _leaf(e: ec.Expression) -> Optional[Tuple[str, str, object]]:
+    cls = type(e)
+    if cls in _OPS:
+        a, b = e.children
+        if isinstance(a, ec.AttributeReference) and \
+                isinstance(b, ec.Literal) and b.value is not None:
+            return (a.col_name, _OPS[cls], b.value)
+        if isinstance(b, ec.AttributeReference) and \
+                isinstance(a, ec.Literal) and a.value is not None:
+            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                    "==": "=="}
+            return (b.col_name, flip[_OPS[cls]], a.value)
+    if isinstance(e, ep.IsNotNull) and isinstance(
+            e.children[0], ec.AttributeReference):
+        return (e.children[0].col_name, "is_not_null", None)
+    if isinstance(e, ep.In) and isinstance(e.children[0],
+                                           ec.AttributeReference):
+        vals = [v for v in e.values if v is not None]
+        if vals:
+            return (e.children[0].col_name, "in", vals)
+    return None
+
+
+def to_arrow_filters(cond: ec.Expression) -> Optional[List[Tuple]]:
+    """Translate the AND-conjuncts we can; None if nothing translates."""
+    conjuncts: List[ec.Expression] = []
+
+    def flatten(x):
+        if isinstance(x, ep.And):
+            flatten(x.children[0])
+            flatten(x.children[1])
+        else:
+            conjuncts.append(x)
+    flatten(cond)
+    out = []
+    for c in conjuncts:
+        leaf = _leaf(c)
+        if leaf is not None:
+            out.append(leaf)
+    return out or None
+
+
+def filters_to_arrow_expression(filters):
+    import pyarrow.dataset as ds
+    import pyarrow.compute as pc
+    expr = None
+    for name, op, val in filters:
+        f = ds.field(name)
+        if op == "==":
+            e = f == val
+        elif op == "<":
+            e = f < val
+        elif op == "<=":
+            e = f <= val
+        elif op == ">":
+            e = f > val
+        elif op == ">=":
+            e = f >= val
+        elif op == "in":
+            e = f.isin(val)
+        elif op == "is_not_null":
+            e = f.is_valid()
+        else:
+            continue
+        expr = e if expr is None else (expr & e)
+    return expr

@@ -98,7 +98,8 @@ class FilePartitionReader:
     def __init__(self, fmt: str, files: List[str],
                  columns: Optional[List[str]] = None,
                  strategy: str = "PERFILE", num_threads: int = 4,
-                 coalesce_target_rows: int = 1 << 20, options=None):
+                 coalesce_target_rows: int = 1 << 20, options=None,
+                 pushed_filters=None):
         self.fmt = fmt
         self.files = files
         self.columns = columns
@@ -106,6 +107,15 @@ class FilePartitionReader:
         self.num_threads = num_threads
         self.coalesce_target_rows = coalesce_target_rows
         self.options = options
+        self.pushed_filters = pushed_filters
+
+    def _read(self, path: str) -> pa.Table:
+        if self.fmt == "parquet" and self.pushed_filters:
+            import pyarrow.parquet as papq
+            return papq.read_table(path, columns=self.columns,
+                                   use_threads=False,
+                                   filters=self.pushed_filters)
+        return _read_file(self.fmt, path, self.columns, self.options)
 
     def __iter__(self) -> Iterator[pa.Table]:
         if self.strategy == "MULTITHREADED" and len(self.files) > 1:
@@ -114,16 +124,14 @@ class FilePartitionReader:
             yield from self._coalescing()
         else:
             for f in self.files:
-                yield _read_file(self.fmt, f, self.columns, self.options)
+                yield self._read(f)
 
     def _multithreaded(self):
         """Prefetch host buffers with a thread pool; preserve file order.
 
         (MultiFileCloudParquetPartitionReader role.)"""
         with concurrent.futures.ThreadPoolExecutor(self.num_threads) as pool:
-            futures = [pool.submit(_read_file, self.fmt, f, self.columns,
-                                   self.options)
-                       for f in self.files]
+            futures = [pool.submit(self._read, f) for f in self.files]
             for fut in futures:
                 yield fut.result()
 
@@ -134,7 +142,7 @@ class FilePartitionReader:
         pending: List[pa.Table] = []
         rows = 0
         for f in self.files:
-            t = _read_file(self.fmt, f, self.columns, self.options)
+            t = self._read(f)
             pending.append(t)
             rows += t.num_rows
             if rows >= self.coalesce_target_rows:

@@ -384,7 +384,14 @@ class Planner:
         if isinstance(p, L.Project):
             return TB.TpuProject(p.exprs, children[0])
         if isinstance(p, L.Filter):
-            return TB.TpuFilter(p.condition, children[0])
+            child = children[0]
+            if isinstance(p.children[0], L.Scan) and \
+                    p.children[0].fmt in ("parquet", "orc"):
+                from ..io.pushdown import to_arrow_filters
+                pushed = to_arrow_filters(p.condition)
+                if pushed and hasattr(child, "set_pushed_filters"):
+                    child.set_pushed_filters(pushed)
+            return TB.TpuFilter(p.condition, child)
         if isinstance(p, L.Aggregate):
             return self._plan_aggregate(p, children[0])
         if isinstance(p, L.Distinct):

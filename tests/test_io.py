@@ -89,3 +89,24 @@ class TestOrc:
         paorc.write_table(t, path)
         assert_tpu_and_cpu_are_equal_collect(
             lambda s: s.read.orc(str(path)))
+
+
+class TestPushdown:
+    def test_filter_pushdown_into_scan(self, pq_dir):
+        from harness import with_tpu_session
+        from spark_rapids_tpu.io.planner import TpuFileScan
+
+        def fn(s):
+            df = s.read.parquet(pq_dir).filter(
+                (F.col("i") > 0) & (F.col("k") < 5))
+            phys = s._plan(df._plan)
+            scans = [n for n in phys.collect_nodes()
+                     if isinstance(n, TpuFileScan)]
+            assert scans and scans[0].pushed_filters, \
+                "filters not pushed into scan"
+            return df
+        rows = with_tpu_session(lambda s: fn(s).collect())
+        # equality with CPU engine (no pushdown there -> same answer)
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: s.read.parquet(pq_dir).filter(
+                (F.col("i") > 0) & (F.col("k") < 5)))
