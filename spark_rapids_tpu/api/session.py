@@ -8,6 +8,7 @@ GpuDeviceManager), and runs the planner on every action.
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -33,9 +34,14 @@ def _enable_compilation_cache():
     if _CACHE_ENABLED:
         return
     try:
+        import getpass
+        import tempfile
         import jax
-        jax.config.update("jax_compilation_cache_dir",
-                          "/tmp/spark_rapids_tpu_xla_cache")
+        cache_dir = os.environ.get(
+            "SPARK_RAPIDS_TPU_XLA_CACHE",
+            os.path.join(tempfile.gettempdir(),
+                         f"spark_rapids_tpu_xla_cache_{getpass.getuser()}"))
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         _CACHE_ENABLED = True
     except Exception:

@@ -19,15 +19,29 @@ _OPS = {
 }
 
 
+def _comparable(attr: ec.AttributeReference, lit: ec.Literal) -> bool:
+    """Only push comparisons whose pyarrow row-level semantics match the
+    engine's.  Floats are excluded entirely: the engine compares with
+    Spark total order (NaN greatest, NaN == NaN, kernels/canon.py) while
+    pyarrow uses IEEE semantics, so a pushed `f > 0.0` would drop NaN rows
+    the engine's Filter keeps."""
+    if isinstance(lit.value, float):
+        return False
+    dt = attr._dtype
+    return not (dt is not None and dt.is_fractional)
+
+
 def _leaf(e: ec.Expression) -> Optional[Tuple[str, str, object]]:
     cls = type(e)
     if cls in _OPS:
         a, b = e.children
         if isinstance(a, ec.AttributeReference) and \
-                isinstance(b, ec.Literal) and b.value is not None:
+                isinstance(b, ec.Literal) and b.value is not None and \
+                _comparable(a, b):
             return (a.col_name, _OPS[cls], b.value)
         if isinstance(b, ec.AttributeReference) and \
-                isinstance(a, ec.Literal) and a.value is not None:
+                isinstance(a, ec.Literal) and a.value is not None and \
+                _comparable(b, a):
             flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
                     "==": "=="}
             return (b.col_name, flip[_OPS[cls]], a.value)
@@ -37,7 +51,7 @@ def _leaf(e: ec.Expression) -> Optional[Tuple[str, str, object]]:
     if isinstance(e, ep.In) and isinstance(e.children[0],
                                            ec.AttributeReference):
         vals = [v for v in e.values if v is not None]
-        if vals:
+        if vals and not any(isinstance(v, float) for v in vals):
             return (e.children[0].col_name, "in", vals)
     return None
 
@@ -61,27 +75,3 @@ def to_arrow_filters(cond: ec.Expression) -> Optional[List[Tuple]]:
     return out or None
 
 
-def filters_to_arrow_expression(filters):
-    import pyarrow.dataset as ds
-    import pyarrow.compute as pc
-    expr = None
-    for name, op, val in filters:
-        f = ds.field(name)
-        if op == "==":
-            e = f == val
-        elif op == "<":
-            e = f < val
-        elif op == "<=":
-            e = f <= val
-        elif op == ">":
-            e = f > val
-        elif op == ">=":
-            e = f >= val
-        elif op == "in":
-            e = f.isin(val)
-        elif op == "is_not_null":
-            e = f.is_valid()
-        else:
-            continue
-        expr = e if expr is None else (expr & e)
-    return expr

@@ -386,7 +386,7 @@ class Planner:
         if isinstance(p, L.Filter):
             child = children[0]
             if isinstance(p.children[0], L.Scan) and \
-                    p.children[0].fmt in ("parquet", "orc"):
+                    p.children[0].fmt == "parquet":
                 from ..io.pushdown import to_arrow_filters
                 pushed = to_arrow_filters(p.condition)
                 if pushed and hasattr(child, "set_pushed_filters"):

@@ -18,7 +18,10 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# TPU_TEST_PLATFORM=axon runs the suite against the real chip (smoke runs);
+# default is the virtual 8-device CPU mesh.
+jax.config.update("jax_platforms",
+                  os.environ.get("TPU_TEST_PLATFORM", "cpu"))
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,235 @@
+"""Bit-exactness tests for the integer softfloat kernels (kernels/binary64.py)
+
+against numpy's IEEE-754 float64, including subnormals, signed zeros,
+infinities, NaNs and round-to-nearest-even ties.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_tpu.kernels import binary64 as b64
+
+
+def _adversarial_pool(rng, n):
+    pools = [
+        rng.standard_normal(n),
+        rng.standard_normal(n) * 1e300,
+        rng.standard_normal(n) * 1e-300,
+        np.ldexp(rng.random(n), rng.integers(-1080, 1025, n)),
+        rng.integers(-1000, 1000, n).astype(np.float64),
+        rng.integers(1, 1000, n).astype(np.float64) * 5e-324,  # subnormals
+        np.ldexp(1.0, rng.integers(-1074, 1024, n)),            # powers of 2
+    ]
+    specials = np.array([
+        0.0, -0.0, np.inf, -np.inf, np.nan, 1.0, -1.0, 0.5, 1.5, 2.5, -2.5,
+        2.0 ** -1022, 2.0 ** -1074, np.nextafter(2.0 ** -1022, 0), 5e-324,
+        1.7976931348623157e308, -1.7976931348623157e308,
+        np.nextafter(1.0, 2.0), np.nextafter(1.0, 0.0), 2.0 ** 52,
+        2.0 ** 52 + 1, 2.0 ** 53, 2.0 ** 53 + 2, -2.0 ** 63, 2.0 ** 63,
+    ])
+    vals = np.concatenate(pools + [specials])
+    return rng.permutation(vals)
+
+
+def _bits(x):
+    return jnp.asarray(np.asarray(x, np.float64).view(np.int64))
+
+
+def _floats(bits):
+    return np.asarray(bits).view(np.float64)
+
+
+def _assert_bits_equal(got_f, expect_f, what, atol_ulp=0):
+    gb = got_f.view(np.int64)
+    eb = expect_f.view(np.int64)
+    both_nan = np.isnan(got_f) & np.isnan(expect_f)
+    same = (gb == eb) | both_nan
+    # -0.0 vs 0.0 for exact zero results: accept either sign only if asked
+    if not same.all():
+        i = np.nonzero(~same)[0][:10]
+        msg = "\n".join(
+            f"  in -> got {got_f[j]!r} ({hex(int(gb[j]))}) want "
+            f"{expect_f[j]!r} ({hex(int(eb[j]))})" for j in i)
+        raise AssertionError(f"{what}: {len(i)}+ mismatches\n{msg}")
+
+
+@pytest.fixture(scope="module")
+def pool():
+    rng = np.random.default_rng(42)
+    return _adversarial_pool(rng, 2000)
+
+
+def test_add(pool):
+    a = pool
+    b = np.roll(pool, 1)
+    with np.errstate(all="ignore"):
+        expect = a + b
+    got = _floats(b64.add(_bits(a), _bits(b)))
+    _assert_bits_equal(got, expect, "add")
+
+
+def test_add_cancellation():
+    a = np.array([1.0, 1e300, 3.5, 2.0 ** -1074, 1.0 + 2.0 ** -52])
+    b = -a
+    got = _floats(b64.add(_bits(a), _bits(b)))
+    expect = a + b
+    _assert_bits_equal(got, expect, "add-cancel")
+
+
+def test_sub(pool):
+    a = pool
+    b = np.roll(pool, 3)
+    with np.errstate(all="ignore"):
+        expect = a - b
+    got = _floats(b64.sub(_bits(a), _bits(b)))
+    _assert_bits_equal(got, expect, "sub")
+
+
+def test_mul(pool):
+    a = pool
+    b = np.roll(pool, 7)
+    with np.errstate(all="ignore"):
+        expect = a * b
+    got = _floats(b64.mul(_bits(a), _bits(b)))
+    _assert_bits_equal(got, expect, "mul")
+
+
+def test_div(pool):
+    a = pool
+    b = np.roll(pool, 11)
+    with np.errstate(all="ignore"):
+        expect = a / b
+    got = _floats(b64.div(_bits(a), _bits(b)))
+    _assert_bits_equal(got, expect, "div")
+
+
+def test_sqrt(pool):
+    a = np.abs(pool)
+    with np.errstate(all="ignore"):
+        expect = np.sqrt(a)
+    got = _floats(b64.sqrt(_bits(a)))
+    _assert_bits_equal(got, expect, "sqrt")
+    neg = _floats(b64.sqrt(_bits(np.array([-1.0, -np.inf]))))
+    assert np.isnan(neg).all()
+
+
+def test_neg_abs(pool):
+    _assert_bits_equal(_floats(b64.neg(_bits(pool))), -pool, "neg")
+    _assert_bits_equal(_floats(b64.abs_(_bits(pool))), np.abs(pool), "abs")
+
+
+def test_from_i64():
+    rng = np.random.default_rng(0)
+    x = np.concatenate([
+        rng.integers(-2 ** 62, 2 ** 62, 3000),
+        rng.integers(-2 ** 53, 2 ** 53, 1000),
+        np.array([0, 1, -1, 2 ** 53, 2 ** 53 + 1, -2 ** 63,
+                  2 ** 63 - 1, 2 ** 62 + 12345]),
+    ])
+    got = _floats(b64.from_i64(jnp.asarray(x)))
+    _assert_bits_equal(got, x.astype(np.float64), "from_i64")
+
+
+def test_to_i64(pool):
+    got = np.asarray(b64.to_i64(_bits(pool)))
+    # numpy int64 cast of double is UB-ish for out-of-range: emulate Java
+    expect = np.zeros(len(pool), np.int64)
+    for i, v in enumerate(pool):
+        if np.isnan(v):
+            expect[i] = 0
+        elif v >= 2.0 ** 63:
+            expect[i] = 2 ** 63 - 1
+        elif v <= -2.0 ** 63:
+            expect[i] = -2 ** 63
+        else:
+            expect[i] = np.int64(np.trunc(v))
+    assert (got == expect).all(), \
+        np.nonzero(got != expect)[0][:5]
+
+
+def test_f32_roundtrip(pool):
+    f32 = pool.astype(np.float32)
+    got = _floats(b64.from_f32(jnp.asarray(f32)))
+    _assert_bits_equal(got, f32.astype(np.float64), "from_f32")
+    narrowed = np.asarray(b64.to_f32(_bits(pool)))
+    expect32 = pool.astype(np.float32)
+    gb = narrowed.view(np.int32)
+    eb = expect32.view(np.int32)
+    ok = (gb == eb) | (np.isnan(narrowed) & np.isnan(expect32))
+    assert ok.all(), [(pool[j], narrowed[j], expect32[j])
+                      for j in np.nonzero(~ok)[0][:5]]
+
+
+def test_rounding_ops(pool):
+    with np.errstate(all="ignore"):
+        _assert_bits_equal(_floats(b64.trunc(_bits(pool))), np.trunc(pool),
+                           "trunc")
+        _assert_bits_equal(_floats(b64.floor(_bits(pool))), np.floor(pool),
+                           "floor")
+        _assert_bits_equal(_floats(b64.ceil(_bits(pool))), np.ceil(pool),
+                           "ceil")
+        _assert_bits_equal(_floats(b64.rint(_bits(pool))), np.rint(pool),
+                           "rint")
+
+
+def test_order_and_compare(pool):
+    a, b = pool, np.roll(pool, 5)
+    ga = np.asarray(b64.lt(_bits(a), _bits(b)))
+    # Spark total order: NaN greatest, NaN==NaN, -0==0
+    for i in range(len(a)):
+        x, y = a[i], b[i]
+        if np.isnan(x):
+            expect = False
+        elif np.isnan(y):
+            expect = True
+        else:
+            xx = 0.0 if x == 0 else x
+            yy = 0.0 if y == 0 else y
+            expect = bool(xx < yy)
+        assert ga[i] == expect, (x, y, ga[i])
+
+
+def test_word_roundtrip(pool):
+    w = b64.order_word(_bits(pool))
+    back = _floats(b64.word_to_bits(w))
+    canon = np.where(np.isnan(pool), np.nan, np.where(pool == 0, 0.0, pool))
+    _assert_bits_equal(back, canon.astype(np.float64), "word roundtrip")
+
+
+def test_segmented_sum():
+    rng = np.random.default_rng(3)
+    n = 256
+    vals = np.ldexp(rng.standard_normal(n), rng.integers(-30, 30, n))
+    seg = np.sort(rng.integers(0, 10, n))
+    mask = rng.random(n) > 0.2
+    got = _floats(b64.segmented_sum(
+        _bits(vals), jnp.asarray(mask), jnp.asarray(seg), 16))[:16]
+    for g in range(10):
+        sel = (seg == g) & mask
+        expect = float(np.sum(vals[sel]))
+        # float sums are association-order dependent (the scan reduces as a
+        # tree); compare with relative tolerance like the reference does
+        assert got[g] == pytest.approx(expect, rel=1e-12, abs=1e-300), \
+            (g, got[g], expect)
+
+
+def test_running_sum():
+    rng = np.random.default_rng(4)
+    n = 128
+    vals = rng.standard_normal(n)
+    head = np.zeros(n, bool)
+    head[[0, 40, 90]] = True
+    got = _floats(b64.running_sum(_bits(vals), jnp.ones(n, bool),
+                                  jnp.asarray(head)))
+    acc = np.float64(0)
+    for i in range(n):
+        acc = vals[i] if head[i] else acc + vals[i]
+        assert got[i] == pytest.approx(float(acc), rel=1e-12), \
+            (i, got[i], acc)
+
+
+def test_host_callback_transcendentals(pool):
+    finite = pool[np.isfinite(pool)][:500]
+    got = _floats(b64.host_unary(np.exp, _bits(finite)))
+    with np.errstate(all="ignore"):
+        _assert_bits_equal(got, np.exp(finite), "host exp")
