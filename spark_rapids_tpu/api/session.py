@@ -37,10 +37,13 @@ def _enable_compilation_cache():
         import getpass
         import tempfile
         import jax
-        cache_dir = os.environ.get(
-            "SPARK_RAPIDS_TPU_XLA_CACHE",
-            os.path.join(tempfile.gettempdir(),
-                         f"spark_rapids_tpu_xla_cache_{getpass.getuser()}"))
+        cache_dir = os.environ.get("SPARK_RAPIDS_TPU_XLA_CACHE")
+        if not cache_dir:
+            # computed lazily: getuser() can raise in uid-less containers,
+            # and must not take down an explicitly configured cache
+            cache_dir = os.path.join(
+                tempfile.gettempdir(),
+                f"spark_rapids_tpu_xla_cache_{getpass.getuser()}")
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         _CACHE_ENABLED = True

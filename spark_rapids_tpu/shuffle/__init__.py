@@ -1,7 +1,27 @@
 """Shuffle layer: device partitioners, catalog-backed shuffle manager,
 
-transport SPI (reference: SURVEY.md §2.7)."""
+transport SPI with server/client state machines, bounce buffers,
+heartbeat peer discovery (reference: SURVEY.md §2.7)."""
 from .partitioners import (Partitioner, HashPartitioner, RangePartitioner,
                            RoundRobinPartitioner, SinglePartitioner)  # noqa: F401
 from .manager import (ShuffleManager, ShuffleCatalog, ShuffleTransport,
-                      LocalTransport, ShuffleBlockId)  # noqa: F401
+                      LocalTransport, ShuffleBlockId, MapOutputTracker,
+                      ShuffleExecutorContext)  # noqa: F401
+from .meta import (TableMeta, BufferMeta, build_table_meta, batch_from_meta,
+                   encode_meta, decode_meta)  # noqa: F401
+from .bounce import (BounceBuffer, BounceBufferManager, BlockRange,
+                     WindowedBlockIterator)  # noqa: F401
+from .transport import (Transaction, TransactionStatus, BlockIdSpec,
+                        MetadataRequest, MetadataResponse, TransferRequest,
+                        TransferResponse, ClientConnection, ServerConnection,
+                        RapidsShuffleTransport)  # noqa: F401
+from .client import (RapidsShuffleClient, RapidsShuffleFetchHandler,
+                     ReceivedBufferCatalog, ReceivedBufferHandle,
+                     BufferReceiveState)  # noqa: F401
+from .server import (ShuffleServer, ShuffleRequestHandler,
+                     CatalogRequestHandler, BufferSendState)  # noqa: F401
+from .iterator import (RapidsShuffleIterator,
+                       ShuffleFetchFailedError)  # noqa: F401
+from .heartbeat import (PeerInfo, RapidsShuffleHeartbeatManager,
+                        RapidsShuffleHeartbeatEndpoint)  # noqa: F401
+from .inprocess import (InProcessTransport, EndpointRegistry)  # noqa: F401

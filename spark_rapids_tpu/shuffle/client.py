@@ -1,0 +1,215 @@
+"""Shuffle client: fetches remote shuffle blocks from peer executors.
+
+Reference parity: ``shuffle/RapidsShuffleClient.scala:96`` +
+``shuffle/BufferReceiveState.scala`` + ``ShuffleReceivedBufferCatalog``:
+
+fetch = MetadataRequest -> TableMetas -> TransferRequest (tags per
+table) -> tagged windows land in BufferReceiveState, which reassembles
+each table's contiguous blob -> completed tables are registered in the
+received-buffer catalog and surfaced to the iterator via a handler
+callback (batch_received / transfer_error).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .meta import TableMeta, batch_from_meta
+from .transport import (BlockIdSpec, ClientConnection, MetadataRequest,
+                        MetadataResponse, TransferRequest, TransferResponse)
+
+
+class RapidsShuffleFetchHandler:
+    """Iterator-facing callbacks (reference: RapidsShuffleFetchHandler)."""
+
+    def start(self, expected_batches: int):
+        pass
+
+    def batch_received(self, handle: "ReceivedBufferHandle"):
+        raise NotImplementedError
+
+    def transfer_error(self, message: str):
+        raise NotImplementedError
+
+
+class ReceivedBufferHandle:
+    """Handle to one reassembled table in the received catalog."""
+
+    def __init__(self, catalog: "ReceivedBufferCatalog", buffer_id: int,
+                 meta: TableMeta):
+        self._catalog = catalog
+        self.buffer_id = buffer_id
+        self.meta = meta
+
+    def materialize(self):
+        """Blob -> device ColumnarBatch; frees the host blob."""
+        return self._catalog.materialize(self.buffer_id, self.meta)
+
+
+class ReceivedBufferCatalog:
+    """Host-side staging of reassembled blobs until the task drains them
+
+    (reference: ShuffleReceivedBufferCatalog keyed by
+    ShuffleReceivedBufferId)."""
+
+    def __init__(self):
+        self._blobs: Dict[int, bytes] = {}
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self.bytes_received = 0
+
+    def register(self, blob: bytes) -> int:
+        with self._lock:
+            bid = next(self._ids)
+            self._blobs[bid] = blob
+            self.bytes_received += len(blob)
+            return bid
+
+    def materialize(self, buffer_id: int, meta: TableMeta):
+        with self._lock:
+            blob = self._blobs.pop(buffer_id)
+        return batch_from_meta(meta, blob)
+
+    @property
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._blobs)
+
+
+class PendingTable:
+    """Reassembly state for one in-flight table."""
+
+    def __init__(self, block: BlockIdSpec, batch_index: int, meta: TableMeta,
+                 tag: int):
+        self.block = block
+        self.batch_index = batch_index
+        self.meta = meta
+        self.tag = tag
+        self.blob = bytearray(meta.total_bytes)
+        self.received = 0
+
+    @property
+    def complete(self) -> bool:
+        return self.received >= self.meta.total_bytes
+
+
+class BufferReceiveState:
+    """Demuxes tagged windows into per-table blobs.
+
+    Reference: BufferReceiveState.scala — consumes bounce-buffer-sized
+    windows and advances per-table write cursors; here each window
+    carries (tag, offset) so reassembly is a plain slice write.
+    """
+
+    def __init__(self, tables: List[PendingTable],
+                 on_table_complete: Callable[[PendingTable], None]):
+        self._by_tag = {t.tag: t for t in tables}
+        self._on_complete = on_table_complete
+        self._lock = threading.Lock()
+
+    def on_data(self, tag: int, offset: int, payload: bytes):
+        with self._lock:
+            t = self._by_tag.get(tag)
+            if t is None:
+                return
+            t.blob[offset:offset + len(payload)] = payload
+            t.received += len(payload)
+            done = t.complete
+            if done:
+                del self._by_tag[t.tag]
+        if done:
+            self._on_complete(t)
+
+    @property
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._by_tag)
+
+
+class RapidsShuffleClient:
+    """Per-peer fetch driver (reference: RapidsShuffleClient.scala:96)."""
+
+    _tag_counter = itertools.count(1)
+    _req_counter = itertools.count(1)
+
+    def __init__(self, connection: ClientConnection,
+                 received_catalog: Optional[ReceivedBufferCatalog] = None,
+                 metadata_timeout: float = 30.0):
+        self.connection = connection
+        self.catalog = received_catalog or ReceivedBufferCatalog()
+        self.metadata_timeout = metadata_timeout
+        self._receive_states: List[BufferReceiveState] = []
+        self._lock = threading.Lock()
+        self.connection.register_data_handler(self._dispatch_data)
+
+    def _dispatch_data(self, tag: int, offset: int, payload: bytes):
+        with self._lock:
+            states = list(self._receive_states)
+        for s in states:
+            s.on_data(tag, offset, payload)
+
+    # -- fetch state machine ----------------------------------------------
+    def do_fetch(self, blocks: List[BlockIdSpec],
+                 handler: RapidsShuffleFetchHandler):
+        """Issue the metadata round; on response, kick off transfers."""
+        req = MetadataRequest(next(self._req_counter), list(blocks))
+
+        def on_meta(resp: MetadataResponse):
+            if resp.error:
+                handler.transfer_error(resp.error)
+                return
+            self._issue_transfer(blocks, resp, handler)
+
+        tx = self.connection.request_metadata(req, on_meta)
+        tx.on_complete(
+            lambda t: handler.transfer_error(
+                f"metadata request failed: {t.error_message}")
+            if t.status.value == "error" else None)
+
+    def _issue_transfer(self, blocks: List[BlockIdSpec],
+                        resp: MetadataResponse,
+                        handler: RapidsShuffleFetchHandler):
+        pending: List[PendingTable] = []
+        degenerate: List[PendingTable] = []
+        tables: List[Tuple[BlockIdSpec, int]] = []
+        tags: List[int] = []
+        for block, metas in zip(blocks, resp.tables):
+            for bi, meta in enumerate(metas):
+                t = PendingTable(block, bi, meta, next(self._tag_counter))
+                if meta.total_bytes == 0:
+                    # degenerate rows-only batches need no data transfer
+                    # (reference: RapidsShuffleClient degenerate handling)
+                    degenerate.append(t)
+                else:
+                    pending.append(t)
+                    tables.append((block, bi))
+                    tags.append(t.tag)
+        handler.start(len(pending) + len(degenerate))
+        for t in degenerate:
+            bid = self.catalog.register(b"")
+            handler.batch_received(
+                ReceivedBufferHandle(self.catalog, bid, t.meta))
+        if not pending:
+            return
+
+        def on_table(t: PendingTable):
+            bid = self.catalog.register(bytes(t.blob))
+            handler.batch_received(
+                ReceivedBufferHandle(self.catalog, bid, t.meta))
+
+        state = BufferReceiveState(pending, on_table)
+        with self._lock:
+            self._receive_states.append(state)
+
+        treq = TransferRequest(next(self._req_counter), tables, tags)
+
+        def on_transfer(tresp: TransferResponse):
+            if not tresp.accepted:
+                handler.transfer_error(tresp.error or "transfer rejected")
+
+        tx = self.connection.request_transfer(treq, on_transfer)
+        tx.on_complete(
+            lambda t: handler.transfer_error(
+                f"transfer request failed: {t.error_message}")
+            if t.status.value == "error" else None)

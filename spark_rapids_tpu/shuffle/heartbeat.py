@@ -1,0 +1,116 @@
+"""Heartbeat / peer discovery between executors and the driver plugin.
+
+Reference parity: ``RapidsShuffleHeartbeatManager.scala:51,114`` +
+``Plugin.scala:140-152`` — executors register with the driver on startup
+(RapidsExecutorStartupMsg) and heartbeat periodically; each response
+carries the peers that appeared since the executor's last beat, and the
+executor's endpoint pre-connects the transport to every new peer so
+fetches never pay connection-setup latency.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class PeerInfo:
+    """Advertised executor identity (BlockManagerId-with-topology role)."""
+
+    executor_id: str
+    host: str = "localhost"
+    port: int = 0
+
+
+class RapidsShuffleHeartbeatManager:
+    """Driver-side registry (reference :51).
+
+    Keeps registration order; each executor remembers the index of the
+    last peer list it saw, so a heartbeat returns only the delta.
+    """
+
+    def __init__(self, heartbeat_interval_s: float = 5.0,
+                 timeout_s: float = 30.0):
+        self._peers: List[PeerInfo] = []
+        self._last_seen_index: Dict[str, int] = {}
+        self._last_beat: Dict[str, float] = {}
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+
+    def register_executor(self, peer: PeerInfo) -> List[PeerInfo]:
+        """RapidsExecutorStartupMsg: returns ALL currently known peers."""
+        with self._lock:
+            known = [p for p in self._peers
+                     if p.executor_id != peer.executor_id]
+            if all(p.executor_id != peer.executor_id for p in self._peers):
+                self._peers.append(peer)
+            self._last_seen_index[peer.executor_id] = len(self._peers)
+            self._last_beat[peer.executor_id] = time.monotonic()
+            return known
+
+    def executor_heartbeat(self, executor_id: str) -> List[PeerInfo]:
+        """RapidsExecutorHeartbeatMsg: returns peers new since last beat."""
+        with self._lock:
+            start = self._last_seen_index.get(executor_id, 0)
+            new = [p for p in self._peers[start:]
+                   if p.executor_id != executor_id]
+            self._last_seen_index[executor_id] = len(self._peers)
+            self._last_beat[executor_id] = time.monotonic()
+            return new
+
+    def live_executors(self) -> List[PeerInfo]:
+        """Peers whose last beat is within the liveness timeout."""
+        now = time.monotonic()
+        with self._lock:
+            return [p for p in self._peers
+                    if now - self._last_beat.get(p.executor_id, 0)
+                    <= self.timeout_s]
+
+
+class RapidsShuffleHeartbeatEndpoint:
+    """Executor-side: beats the driver manager, pre-connects transport.
+
+    Reference: RapidsShuffleHeartbeatEndpoint (:114) — a scheduled task
+    calling the driver RPC and handing new peers to
+    ``transport.connect``.
+    """
+
+    def __init__(self, manager: RapidsShuffleHeartbeatManager,
+                 transport, peer: PeerInfo,
+                 auto_start: bool = False):
+        self.manager = manager
+        self.transport = transport
+        self.peer = peer
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        known = manager.register_executor(peer)
+        self._connect_all(known)
+        if auto_start:
+            self.start()
+
+    def _connect_all(self, peers: List[PeerInfo]):
+        for p in peers:
+            self.transport.connect(p.executor_id)
+
+    def beat(self) -> List[PeerInfo]:
+        new = self.manager.executor_heartbeat(self.peer.executor_id)
+        self._connect_all(new)
+        return new
+
+    def start(self):
+        def _loop():
+            while not self._stop.wait(self.manager.heartbeat_interval_s):
+                self.beat()
+
+        self._thread = threading.Thread(
+            target=_loop, daemon=True,
+            name=f"shuffle-heartbeat-{self.peer.executor_id}")
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)

@@ -1,0 +1,211 @@
+"""In-process transport: full shuffle protocol over an endpoint registry.
+
+The concrete wire for single-host deployments and for exercising the
+complete client/server state machines (metadata round, transfer round,
+bounce-buffer windowed data sends) without a pod — the role UCX plays in
+the reference, with the same SPI on top (transport.py).
+
+On real multi-host TPU deployments the data plane rides ICI/DCN
+collectives instead (parallel/mesh.py maps partitioned exchanges onto
+jax all_to_all); this transport remains the control-plane reference
+implementation and the §4.2-style test double.
+
+Each executor registers an endpoint; connections deliver requests on a
+per-endpoint dispatch thread (the UCX progress-thread role, UCX.scala
+:175) so completion ordering matches a real asynchronous wire.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Optional
+
+from .transport import (ClientConnection, MetadataRequest, MetadataResponse,
+                        RapidsShuffleTransport, ServerConnection, Transaction,
+                        TransferRequest, TransferResponse)
+
+
+class _Endpoint:
+    """One executor's receive side: handlers + a dispatch thread."""
+
+    def __init__(self, executor_id: str):
+        self.executor_id = executor_id
+        self.metadata_handler: Optional[Callable] = None
+        self.transfer_handler: Optional[Callable] = None
+        self.data_handlers: Dict[str, Callable] = {}   # by sender peer -> fn
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._progress_loop, daemon=True,
+            name=f"inproc-progress-{executor_id}")
+        self._closed = False
+        self._thread.start()
+
+    def _progress_loop(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            fn = item
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - progress thread must survive
+                pass
+
+    def post(self, fn):
+        self._queue.put(fn)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._queue.put(None)
+
+
+class EndpointRegistry:
+    """Process-wide executor-id -> endpoint map (the "fabric")."""
+
+    _instance: Optional["EndpointRegistry"] = None
+
+    def __init__(self):
+        self._endpoints: Dict[str, _Endpoint] = {}
+        self._lock = threading.Lock()
+        # fault injection for tests: peer -> error message
+        self.drop_peers: Dict[str, str] = {}
+
+    @classmethod
+    def get(cls) -> "EndpointRegistry":
+        if cls._instance is None:
+            cls._instance = EndpointRegistry()
+        return cls._instance
+
+    @classmethod
+    def reset(cls):
+        if cls._instance is not None:
+            for ep in cls._instance._endpoints.values():
+                ep.close()
+        cls._instance = EndpointRegistry()
+        return cls._instance
+
+    def endpoint(self, executor_id: str) -> _Endpoint:
+        with self._lock:
+            ep = self._endpoints.get(executor_id)
+            if ep is None:
+                ep = _Endpoint(executor_id)
+                self._endpoints[executor_id] = ep
+            return ep
+
+    def lookup(self, executor_id: str) -> Optional[_Endpoint]:
+        with self._lock:
+            return self._endpoints.get(executor_id)
+
+
+class InProcessClientConnection(ClientConnection):
+    def __init__(self, registry: EndpointRegistry, local_id: str,
+                 peer_executor_id: str):
+        super().__init__(peer_executor_id)
+        self.registry = registry
+        self.local_id = local_id
+
+    def _peer(self) -> Optional[_Endpoint]:
+        if self.peer_executor_id in self.registry.drop_peers:
+            return None
+        return self.registry.lookup(self.peer_executor_id)
+
+    def request_metadata(self, req: MetadataRequest,
+                         handler: Callable[[MetadataResponse], None]
+                         ) -> Transaction:
+        tx = Transaction()
+        peer = self._peer()
+        if peer is None or peer.metadata_handler is None:
+            tx.complete_error(
+                f"peer {self.peer_executor_id} unreachable")
+            return tx
+
+        local = self.registry.endpoint(self.local_id)
+
+        def _serve():
+            resp = peer.metadata_handler(self.local_id, req)
+            # response delivered on the requester's progress thread
+            local.post(lambda: (handler(resp),
+                                tx.complete_success())[-1])
+
+        peer.post(_serve)
+        return tx
+
+    def request_transfer(self, req: TransferRequest,
+                         handler: Callable[[TransferResponse], None]
+                         ) -> Transaction:
+        tx = Transaction()
+        peer = self._peer()
+        if peer is None or peer.transfer_handler is None:
+            tx.complete_error(
+                f"peer {self.peer_executor_id} unreachable")
+            return tx
+
+        local = self.registry.endpoint(self.local_id)
+
+        def _serve():
+            resp = peer.transfer_handler(self.local_id, req)
+            local.post(lambda: (handler(resp),
+                                tx.complete_success())[-1])
+
+        peer.post(_serve)
+        return tx
+
+    def register_data_handler(self, handler):
+        ep = self.registry.endpoint(self.local_id)
+        ep.data_handlers[self.peer_executor_id] = handler
+
+
+class InProcessServerConnection(ServerConnection):
+    def __init__(self, registry: EndpointRegistry, local_id: str):
+        self.registry = registry
+        self.local_id = local_id
+
+    def register_metadata_handler(self, handler):
+        self.registry.endpoint(self.local_id).metadata_handler = handler
+
+    def register_transfer_handler(self, handler):
+        self.registry.endpoint(self.local_id).transfer_handler = handler
+
+    def send_data(self, peer_executor_id: str, tag: int, offset: int,
+                  data: bytes) -> Transaction:
+        tx = Transaction(tag)
+        if peer_executor_id in self.registry.drop_peers:
+            tx.complete_error(self.registry.drop_peers[peer_executor_id])
+            return tx
+        peer = self.registry.lookup(peer_executor_id)
+        if peer is None:
+            tx.complete_error(f"peer {peer_executor_id} unreachable")
+            return tx
+        payload = bytes(data)   # copy out of the bounce buffer NOW
+
+        def _deliver():
+            fn = peer.data_handlers.get(self.local_id)
+            if fn is not None:
+                fn(tag, offset, payload)
+            tx.complete_success(len(payload))
+
+        peer.post(_deliver)
+        return tx
+
+
+class InProcessTransport(RapidsShuffleTransport):
+    """SPI implementation over the endpoint registry."""
+
+    def __init__(self, executor_id: str,
+                 registry: Optional[EndpointRegistry] = None):
+        super().__init__(executor_id)
+        self.registry = registry or EndpointRegistry.get()
+        self.registry.endpoint(executor_id)   # materialize our endpoint
+        self._clients: Dict[str, InProcessClientConnection] = {}
+
+    def make_client(self, peer_executor_id: str) -> InProcessClientConnection:
+        c = self._clients.get(peer_executor_id)
+        if c is None:
+            c = InProcessClientConnection(self.registry, self.executor_id,
+                                          peer_executor_id)
+            self._clients[peer_executor_id] = c
+        return c
+
+    def server_connection(self) -> InProcessServerConnection:
+        return InProcessServerConnection(self.registry, self.executor_id)

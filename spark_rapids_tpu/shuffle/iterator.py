@@ -1,0 +1,115 @@
+"""Per-task shuffle read iterator: local catalog hits + remote fetches.
+
+Reference parity: ``shuffle/RapidsShuffleIterator.scala:49,124,268,307``:
+
+- blocks are grouped by owning executor; local blocks resolve straight
+  from the catalog (RapidsCachingReader role), remote blocks fan out one
+  client fetch per peer;
+- the task thread polls a resolved-batch queue with a timeout;
+- transport errors surface as ``ShuffleFetchFailedError`` so the engine
+  can re-schedule the producing map stage (the Spark
+  FetchFailedException contract).
+"""
+from __future__ import annotations
+
+import queue
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..columnar.batch import ColumnarBatch
+from .client import (RapidsShuffleClient, RapidsShuffleFetchHandler,
+                     ReceivedBufferHandle)
+from .transport import BlockIdSpec, RapidsShuffleTransport
+
+
+class ShuffleFetchFailedError(Exception):
+    """Maps to Spark's FetchFailedException: the scheduler must re-run
+
+    the map stage that produced the missing block (reference:
+    RapidsShuffleFetchFailedException, RapidsShuffleIterator.scala:~330).
+    """
+
+    def __init__(self, block: Optional[BlockIdSpec], message: str):
+        super().__init__(message)
+        self.block = block
+
+
+class _QueueHandler(RapidsShuffleFetchHandler):
+    """Bridges client callbacks onto the task thread's queue."""
+
+    def __init__(self, sink: "queue.Queue"):
+        self.sink = sink
+        self.expected = 0
+
+    def start(self, expected_batches: int):
+        self.expected = expected_batches
+        self.sink.put(("count", expected_batches))
+
+    def batch_received(self, handle: ReceivedBufferHandle):
+        self.sink.put(("batch", handle))
+
+    def transfer_error(self, message: str):
+        self.sink.put(("error", message))
+
+
+class RapidsShuffleIterator(Iterator[ColumnarBatch]):
+    """Iterator over all batches of one reduce partition.
+
+    ``local_batches`` come from this executor's catalog;
+    ``remote_blocks`` maps peer executor id -> blocks to fetch there.
+    """
+
+    def __init__(self, transport: RapidsShuffleTransport,
+                 local_batches: List[ColumnarBatch],
+                 remote_blocks: Dict[str, List[BlockIdSpec]],
+                 timeout_s: float = 30.0):
+        self.transport = transport
+        self._local = list(local_batches)
+        self._remote = dict(remote_blocks)
+        self.timeout_s = timeout_s
+        self._queue: "queue.Queue" = queue.Queue()
+        self._expected_remote: Optional[int] = None
+        self._received_remote = 0
+        self._counts_pending = len(self._remote)
+        self._started = False
+        self._clients: List[RapidsShuffleClient] = []
+
+    def _start_fetches(self):
+        self._started = True
+        self._expected_remote = 0
+        handler = _QueueHandler(self._queue)
+        for peer, blocks in self._remote.items():
+            client = RapidsShuffleClient(self.transport.make_client(peer))
+            self._clients.append(client)
+            client.do_fetch(blocks, handler)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ColumnarBatch:
+        if self._local:
+            return self._local.pop(0)
+        if not self._started:
+            if not self._remote:
+                raise StopIteration
+            self._start_fetches()
+        while True:
+            if (self._counts_pending == 0
+                    and self._received_remote >= self._expected_remote):
+                raise StopIteration
+            try:
+                kind, payload = self._queue.get(timeout=self.timeout_s)
+            except queue.Empty:
+                raise ShuffleFetchFailedError(
+                    None, f"shuffle fetch timed out after "
+                          f"{self.timeout_s}s") from None
+            if kind == "count":
+                self._expected_remote += payload
+                self._counts_pending -= 1
+                continue
+            if kind == "error":
+                raise ShuffleFetchFailedError(None, payload)
+            handle: ReceivedBufferHandle = payload
+            self._received_remote += 1
+            # materialize = host blob -> device batch; this is where the
+            # reference acquires the GPU semaphore (:307)
+            return handle.materialize()

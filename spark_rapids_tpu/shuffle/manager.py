@@ -125,3 +125,105 @@ class ShuffleManager:
 
     def cleanup(self, shuffle_id: int):
         self.catalog.remove_shuffle(shuffle_id)
+
+
+# ---------------------------------------------------------------------------
+# multi-executor mode: map-output tracking + transport-backed reads
+# ---------------------------------------------------------------------------
+
+class MapOutputTracker:
+    """Driver-side block -> owning-executor registry.
+
+    Reference: the MapStatus/MapOutputTracker round trip — the caching
+    writer advertises a BlockManagerId (with the transport port folded
+    into the topology string, RapidsShuffleInternalManagerBase:164-186)
+    and reducers group fetches by owner.
+    """
+
+    def __init__(self):
+        self._owner: Dict[Tuple[int, int], str] = {}   # (shuffle,map)->exec
+        self._lock = threading.Lock()
+
+    def register_map_output(self, shuffle_id: int, map_id: int,
+                            executor_id: str):
+        with self._lock:
+            self._owner[(shuffle_id, map_id)] = executor_id
+
+    def owner_of(self, shuffle_id: int, map_id: int) -> Optional[str]:
+        with self._lock:
+            return self._owner.get((shuffle_id, map_id))
+
+    def map_ids(self, shuffle_id: int) -> List[int]:
+        with self._lock:
+            return sorted(m for s, m in self._owner if s == shuffle_id)
+
+    def outputs_for_shuffle(self, shuffle_id: int) -> Dict[int, str]:
+        """Atomic {map_id: owner} snapshot (one lock acquisition, so a
+        concurrent unregister can't yield a map id with a None owner)."""
+        with self._lock:
+            return {m: o for (s, m), o in self._owner.items()
+                    if s == shuffle_id}
+
+    def unregister_shuffle(self, shuffle_id: int):
+        with self._lock:
+            for k in [k for k in self._owner if k[0] == shuffle_id]:
+                del self._owner[k]
+
+
+class ShuffleExecutorContext:
+    """One executor's shuffle endpoint: catalog + transport + server.
+
+    Bundles the pieces a real deployment wires at executor-plugin init
+    (§3.4): the caching-writer catalog, the transport, the serving side
+    (ShuffleServer over a CatalogRequestHandler) and heartbeat
+    registration.  Used by tests and by the multi-process runner.
+    """
+
+    def __init__(self, executor_id: str, transport,
+                 tracker: MapOutputTracker,
+                 heartbeat_manager=None,
+                 bounce_buffer_size: int = 1 << 20,
+                 num_bounce_buffers: int = 4):
+        from .heartbeat import PeerInfo, RapidsShuffleHeartbeatEndpoint
+        from .server import CatalogRequestHandler, ShuffleServer
+        self.executor_id = executor_id
+        self.transport = transport
+        self.tracker = tracker
+        self.catalog = ShuffleCatalog()
+        self.server = ShuffleServer(
+            transport, CatalogRequestHandler(self.catalog),
+            bounce_buffer_size=bounce_buffer_size,
+            num_bounce_buffers=num_bounce_buffers)
+        self.server.start()
+        self.heartbeat = None
+        if heartbeat_manager is not None:
+            self.heartbeat = RapidsShuffleHeartbeatEndpoint(
+                heartbeat_manager, transport, PeerInfo(executor_id))
+
+    # -- write side (RapidsCachingWriter role) -----------------------------
+    def write_map_output(self, shuffle_id: int, map_id: int,
+                         per_reduce: Dict[int, List[ColumnarBatch]]):
+        for reduce_id, batches in per_reduce.items():
+            if batches:
+                self.catalog.put(
+                    ShuffleBlockId(shuffle_id, map_id, reduce_id), batches)
+        self.tracker.register_map_output(shuffle_id, map_id,
+                                         self.executor_id)
+
+    # -- read side (RapidsCachingReader + RapidsShuffleIterator) -----------
+    def read_partition(self, shuffle_id: int, reduce_id: int,
+                       timeout_s: float = 30.0):
+        from .iterator import RapidsShuffleIterator
+        from .transport import BlockIdSpec
+        local: List[ColumnarBatch] = []
+        remote: Dict[str, List[BlockIdSpec]] = {}
+        for map_id, owner in sorted(
+                self.tracker.outputs_for_shuffle(shuffle_id).items()):
+            if owner == self.executor_id:
+                local.extend(self.catalog.get(
+                    ShuffleBlockId(shuffle_id, map_id, reduce_id)))
+            else:
+                remote.setdefault(owner, []).append(
+                    BlockIdSpec(shuffle_id, map_id, reduce_id))
+        return RapidsShuffleIterator(self.transport, local, remote,
+                                     timeout_s=timeout_s)

@@ -1,0 +1,195 @@
+"""TableMeta: transport metadata protocol for shuffled columnar batches.
+
+Reference parity: the flatbuffer schemas under
+``sql-plugin/src/main/format/*.fbs`` (TableMeta/BufferMeta) plus
+``MetaUtils.scala:46,66,124`` which build metadata from contiguous tables
+(including degenerate rows-only batches) and reconstruct device tables
+from meta + a single contiguous buffer.
+
+TPU adaptation: a batch's device buffers are flattened into ONE
+contiguous host blob (the "contiguous table" role); ``TableMeta``
+records the schema and the (dtype, shape, offset, nbytes) of every
+sub-buffer so the receiver can reassemble the device batch with plain
+integer arithmetic.  The encoding is a compact hand-rolled binary format
+(little-endian struct packing) — language-neutral like the reference's
+flatbuffers, with no Python pickling on the wire.
+"""
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import dtypes as T
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, StringColumn
+from ..columnar.schema import Field, Schema
+
+_MAGIC = b"TMET"
+_VERSION = 1
+
+# column kinds on the wire
+_KIND_PLAIN = 0
+_KIND_STRING = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class BufferMeta:
+    """One device sub-buffer inside the contiguous blob (BufferMeta role)."""
+
+    np_dtype: str          # numpy dtype string, e.g. "<i8"
+    shape: Tuple[int, ...]
+    offset: int            # byte offset into the contiguous blob
+    nbytes: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TableMeta:
+    """Metadata describing one shuffled batch (TableMeta role).
+
+    ``degenerate`` batches carry rows but no columns (e.g. COUNT-only
+    aggregations after projection) — reference: MetaUtils.scala:124.
+    """
+
+    num_rows: int
+    fields: Tuple[Tuple[str, str, bool], ...]   # (name, dtype_name, nullable)
+    kinds: Tuple[int, ...]                      # per-column wire kind
+    buffers: Tuple[BufferMeta, ...]
+    total_bytes: int
+
+    @property
+    def degenerate(self) -> bool:
+        return not self.fields
+
+
+def build_table_meta(batch: ColumnarBatch) -> Tuple[TableMeta, bytes]:
+    """Flatten a batch into (meta, contiguous host blob).
+
+    The contiguous-copy role of cuDF ``contiguousSplit`` +
+    ``MetaUtils.buildTableMeta``: every device buffer is pulled to host
+    and packed back-to-back (8-byte aligned) into one blob.
+    """
+    fields = tuple((f.name, f.dtype.name, f.nullable) for f in batch.schema)
+    kinds = []
+    arrays: List[np.ndarray] = []
+    for col in batch.columns:
+        kinds.append(_KIND_STRING if isinstance(col, StringColumn)
+                     else _KIND_PLAIN)
+        for buf in col.device_buffers():
+            arrays.append(np.asarray(buf))
+    metas: List[BufferMeta] = []
+    pos = 0
+    chunks: List[bytes] = []
+    for a in arrays:
+        pad = (-pos) % 8
+        if pad:
+            chunks.append(b"\x00" * pad)
+            pos += pad
+        raw = a.tobytes()
+        metas.append(BufferMeta(a.dtype.str, tuple(a.shape), pos, len(raw)))
+        chunks.append(raw)
+        pos += len(raw)
+    blob = b"".join(chunks)
+    return TableMeta(batch.num_rows, fields, tuple(kinds), tuple(metas),
+                     len(blob)), blob
+
+
+def batch_from_meta(meta: TableMeta, blob: bytes) -> ColumnarBatch:
+    """Reassemble a device batch from meta + contiguous blob.
+
+    Reference: MetaUtils.getBatchFromMeta — reconstructs column views over
+    a single received buffer.
+    """
+    import jax.numpy as jnp
+
+    if meta.degenerate:
+        return ColumnarBatch(Schema(()), [], meta.num_rows)
+    arrays = []
+    for bm in meta.buffers:
+        arr = np.frombuffer(blob, dtype=np.dtype(bm.np_dtype),
+                            count=(bm.nbytes //
+                                   np.dtype(bm.np_dtype).itemsize),
+                            offset=bm.offset).reshape(bm.shape)
+        arrays.append(arr)
+    schema = Schema(Field(n, T.dtype_from_name(d), nul)
+                    for n, d, nul in meta.fields)
+    cols = []
+    i = 0
+    for f, kind in zip(schema, meta.kinds):
+        if kind == _KIND_STRING:
+            offsets, data, validity = arrays[i], arrays[i + 1], arrays[i + 2]
+            cols.append(StringColumn(jnp.asarray(offsets), jnp.asarray(data),
+                                     jnp.asarray(validity)))
+            i += 3
+        else:
+            data, validity = arrays[i], arrays[i + 1]
+            cols.append(Column(f.dtype, jnp.asarray(data),
+                               jnp.asarray(validity)))
+            i += 2
+    return ColumnarBatch(schema, cols, meta.num_rows)
+
+
+# ---------------------------------------------------------------------------
+# wire encoding (the .fbs-generated-code role; hand-rolled, little-endian)
+# ---------------------------------------------------------------------------
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack("<H", len(b)) + b
+
+
+def _unpack_str(buf: memoryview, pos: int) -> Tuple[str, int]:
+    (n,) = struct.unpack_from("<H", buf, pos)
+    pos += 2
+    return bytes(buf[pos:pos + n]).decode("utf-8"), pos + n
+
+
+def encode_meta(meta: TableMeta) -> bytes:
+    out = [_MAGIC, struct.pack("<HIQHH", _VERSION, meta.num_rows,
+                               meta.total_bytes, len(meta.fields),
+                               len(meta.buffers))]
+    for (name, dtype_name, nullable), kind in zip(meta.fields, meta.kinds):
+        out.append(_pack_str(name))
+        out.append(_pack_str(dtype_name))
+        out.append(struct.pack("<BB", 1 if nullable else 0, kind))
+    for bm in meta.buffers:
+        out.append(_pack_str(bm.np_dtype))
+        out.append(struct.pack("<B", len(bm.shape)))
+        out.append(struct.pack(f"<{len(bm.shape)}q", *bm.shape)
+                   if bm.shape else b"")
+        out.append(struct.pack("<QQ", bm.offset, bm.nbytes))
+    return b"".join(out)
+
+
+def decode_meta(data: bytes) -> TableMeta:
+    buf = memoryview(data)
+    if bytes(buf[:4]) != _MAGIC:
+        raise ValueError("bad TableMeta magic")
+    version, num_rows, total_bytes, nfields, nbufs = struct.unpack_from(
+        "<HIQHH", buf, 4)
+    if version != _VERSION:
+        raise ValueError(f"unsupported TableMeta version {version}")
+    pos = 4 + struct.calcsize("<HIQHH")
+    fields = []
+    kinds = []
+    for _ in range(nfields):
+        name, pos = _unpack_str(buf, pos)
+        dtype_name, pos = _unpack_str(buf, pos)
+        nullable, kind = struct.unpack_from("<BB", buf, pos)
+        pos += 2
+        fields.append((name, dtype_name, bool(nullable)))
+        kinds.append(kind)
+    buffers = []
+    for _ in range(nbufs):
+        np_dtype, pos = _unpack_str(buf, pos)
+        (ndim,) = struct.unpack_from("<B", buf, pos)
+        pos += 1
+        shape = struct.unpack_from(f"<{ndim}q", buf, pos) if ndim else ()
+        pos += 8 * ndim
+        offset, nbytes = struct.unpack_from("<QQ", buf, pos)
+        pos += 16
+        buffers.append(BufferMeta(np_dtype, tuple(shape), offset, nbytes))
+    return TableMeta(num_rows, tuple(fields), tuple(kinds), tuple(buffers),
+                     total_bytes)

@@ -1,0 +1,178 @@
+"""Shuffle server: serves metadata + table data to peer executors.
+
+Reference parity: ``shuffle/RapidsShuffleServer.scala:70`` +
+``shuffle/BufferSendState.scala``:
+
+- metadata requests are answered from the shuffle catalog (acquiring
+  buffers may *unspill* them — RapidsShuffleInternalManagerBase:287);
+- transfer requests stream each table's contiguous blob through a pool
+  of fixed-size **bounce buffers** (BufferSendState walking a
+  WindowedBlockIterator), bounding in-flight bytes per peer.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from .bounce import BounceBufferManager, WindowedBlockIterator
+from .meta import TableMeta, build_table_meta
+from .transport import (BlockIdSpec, MetadataRequest, MetadataResponse,
+                        RapidsShuffleTransport, TransferRequest,
+                        TransferResponse)
+
+
+class ShuffleRequestHandler:
+    """Catalog adapter the server calls to resolve blocks.
+
+    Reference: RapidsShuffleRequestHandler implemented by the shuffle
+    manager (RapidsShuffleInternalManagerBase.scala:287) — returns table
+    metas for a block and acquires (possibly unspilling) batch payloads.
+    """
+
+    def tables_for_block(self, block: BlockIdSpec) -> List[TableMeta]:
+        raise NotImplementedError
+
+    def acquire_table_blob(self, block: BlockIdSpec,
+                           batch_index: int) -> bytes:
+        """Return the contiguous blob for one batch (may unspill)."""
+        raise NotImplementedError
+
+
+class BufferSendState:
+    """Streams one TransferRequest through bounce buffers.
+
+    Reference: BufferSendState.scala — owns the windowed iterator over
+    the requested tables' byte ranges; each window acquires a bounce
+    buffer, copies the ranges into it, sends the tagged slices, and
+    releases the buffer when the transport confirms the send.
+    """
+
+    def __init__(self, server: "ShuffleServer", peer: str,
+                 req: TransferRequest, blobs: List[bytes]):
+        self.server = server
+        self.peer = peer
+        self.req = req
+        self.blobs = blobs
+        self.windows = WindowedBlockIterator(
+            [len(b) for b in blobs],
+            server.bounce_buffers.buffer_size)
+        self.bytes_sent = 0
+        self.error: Optional[str] = None
+
+    def send_all(self):
+        """Walk every window; blocks on bounce-buffer availability.
+
+        Flow control: at most ``num_buffers`` windows are in flight; a
+        buffer is only released once the transport completes the send,
+        mirroring UCXShuffleTransport's inflight-bytes limit
+        (UCXShuffleTransport.scala:47-60).
+        """
+        conn = self.server.transport.server_connection()
+        while self.windows.has_next():
+            ranges = next(self.windows)
+            bounce = self.server.bounce_buffers.acquire(blocking=True)
+            window_pos = 0
+            sends = []
+            for r in ranges:
+                if r.length:
+                    chunk = self.blobs[r.block_index][
+                        r.block_offset:r.block_offset + r.length]
+                    bounce.buffer[window_pos:window_pos + r.length] = \
+                        bytearray(chunk)
+                # send straight from the staging buffer slice
+                payload = bytes(
+                    bounce.buffer[window_pos:window_pos + r.length])
+                tag = self.req.tags[r.block_index]
+                sends.append(conn.send_data(self.peer, tag, r.block_offset,
+                                            payload))
+                window_pos += r.length
+                self.bytes_sent += r.length
+            for t in sends:
+                t.wait_for_completion(timeout=self.server.send_timeout)
+                if t.status.value == "error":
+                    self.error = t.error_message
+            bounce.close()
+            if self.error:
+                break
+
+
+class ShuffleServer:
+    """Registers request handlers on the transport and answers peers.
+
+    Reference: RapidsShuffleServer.scala:70 — doHandleMetadataRequest /
+    doHandleTransferRequest on a dedicated executor ("copy") thread.
+    """
+
+    def __init__(self, transport: RapidsShuffleTransport,
+                 handler: ShuffleRequestHandler,
+                 bounce_buffer_size: int = 1 << 20,
+                 num_bounce_buffers: int = 4,
+                 send_timeout: float = 30.0):
+        self.transport = transport
+        self.handler = handler
+        self.bounce_buffers = BounceBufferManager(
+            "send", bounce_buffer_size, num_bounce_buffers)
+        self.send_timeout = send_timeout
+        self.bytes_served = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        conn = self.transport.server_connection()
+        conn.register_metadata_handler(self.handle_metadata_request)
+        conn.register_transfer_handler(self.handle_transfer_request)
+
+    # -- request handlers --------------------------------------------------
+    def handle_metadata_request(self, peer: str,
+                                req: MetadataRequest) -> MetadataResponse:
+        try:
+            tables = [self.handler.tables_for_block(b) for b in req.blocks]
+            return MetadataResponse(req.request_id, tables)
+        except Exception as e:  # noqa: BLE001 - surfaced to the peer
+            return MetadataResponse(req.request_id, [], error=str(e))
+
+    def handle_transfer_request(self, peer: str,
+                                req: TransferRequest) -> TransferResponse:
+        try:
+            blobs = [self.handler.acquire_table_blob(block, bi)
+                     for block, bi in req.tables]
+        except Exception as e:  # noqa: BLE001
+            return TransferResponse(req.request_id, False, error=str(e))
+        state = BufferSendState(self, peer, req, blobs)
+
+        def _run():
+            state.send_all()
+            with self._lock:
+                self.bytes_served += state.bytes_sent
+
+        threading.Thread(target=_run, daemon=True,
+                         name=f"shuffle-send-{peer}").start()
+        return TransferResponse(req.request_id, True)
+
+
+class CatalogRequestHandler(ShuffleRequestHandler):
+    """Default handler backed by the process ShuffleCatalog."""
+
+    def __init__(self, catalog):
+        self.catalog = catalog
+        # blob cache so metadata+transfer don't flatten twice; entries are
+        # dropped once served
+        self._meta_cache: Dict = {}
+
+    def _flatten(self, block: BlockIdSpec):
+        from .manager import ShuffleBlockId
+        batches = self.catalog.get(
+            ShuffleBlockId(block.shuffle_id, block.map_id, block.reduce_id))
+        return [build_table_meta(b) for b in batches]
+
+    def tables_for_block(self, block: BlockIdSpec) -> List[TableMeta]:
+        pairs = self._flatten(block)
+        self._meta_cache[block] = [blob for _, blob in pairs]
+        return [meta for meta, _ in pairs]
+
+    def acquire_table_blob(self, block: BlockIdSpec,
+                           batch_index: int) -> bytes:
+        blobs = self._meta_cache.get(block)
+        if blobs is None:
+            blobs = [blob for _, blob in self._flatten(block)]
+            self._meta_cache[block] = blobs
+        return blobs[batch_index]

@@ -1,0 +1,249 @@
+"""Shuffle transport SPI: connections, transactions, request messages.
+
+Reference parity: ``shuffle/RapidsShuffleTransport.scala:38-600`` — the
+transport-neutral contract between the shuffle manager and a concrete
+wire (UCX there; ICI/DCN collectives or in-process loopback here):
+
+- ``Transaction``: one asynchronous send/receive/request with a status
+  (pending/success/error/cancelled), completion callback and
+  ``wait_for_completion`` — the unit the client/server state machines
+  are written (and unit-tested, §4.2) against.
+- ``ClientConnection`` / ``ServerConnection``: tag-based buffer
+  send/receive plus a request/response channel (MetadataRequest,
+  TransferRequest).
+- ``ShuffleTransport.make_transport``: reflection-style factory keyed by
+  a config class name (reference :573) so deployments can swap wires.
+
+Message types mirror the reference's flatbuffer protocol
+(MetadataRequest/MetadataResponse/TransferRequest/TransferResponse); the
+payloads are the binary TableMeta encoding from meta.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import importlib
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .meta import TableMeta
+
+
+class TransactionStatus(enum.Enum):
+    PENDING = "pending"
+    SUCCESS = "success"
+    ERROR = "error"
+    CANCELLED = "cancelled"
+
+
+class Transaction:
+    """One async transport operation (reference: Transaction trait).
+
+    The server/client state machines only ever see this interface, which
+    is what lets the protocol logic be tested with injected transactions
+    and no real wire (reference test pattern:
+    RapidsShuffleTestHelper.scala:27-31).
+    """
+
+    def __init__(self, tag: int = 0):
+        self.tag = tag
+        self._status = TransactionStatus.PENDING
+        self._error: Optional[str] = None
+        self._nbytes = 0
+        self._done = threading.Event()
+        self._callback: Optional[Callable[["Transaction"], None]] = None
+        self._lock = threading.Lock()
+
+    # -- state transitions (called by the transport) -----------------------
+    def complete_success(self, nbytes: int = 0):
+        self._finish(TransactionStatus.SUCCESS, nbytes=nbytes)
+
+    def complete_error(self, message: str):
+        self._finish(TransactionStatus.ERROR, error=message)
+
+    def complete_cancelled(self):
+        self._finish(TransactionStatus.CANCELLED)
+
+    def _finish(self, status: TransactionStatus, nbytes: int = 0,
+                error: Optional[str] = None):
+        with self._lock:
+            if self._status != TransactionStatus.PENDING:
+                return
+            self._status = status
+            self._nbytes = nbytes
+            self._error = error
+            cb = self._callback
+        self._done.set()
+        if cb is not None:
+            cb(self)
+
+    def on_complete(self, callback: Callable[["Transaction"], None]):
+        """Register completion callback; fires immediately if done."""
+        fire = False
+        with self._lock:
+            if self._status == TransactionStatus.PENDING:
+                self._callback = callback
+            else:
+                fire = True
+        if fire:
+            callback(self)
+        return self
+
+    # -- observers ---------------------------------------------------------
+    @property
+    def status(self) -> TransactionStatus:
+        return self._status
+
+    @property
+    def error_message(self) -> Optional[str]:
+        return self._error
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def wait_for_completion(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+# ---------------------------------------------------------------------------
+# protocol messages (flatbuffer-protocol role, sql-plugin/src/main/format)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class BlockIdSpec:
+    shuffle_id: int
+    map_id: int
+    reduce_id: int
+
+
+@dataclasses.dataclass
+class MetadataRequest:
+    """Ask a peer for TableMetas of the given shuffle blocks."""
+
+    request_id: int
+    blocks: List[BlockIdSpec]
+
+
+@dataclasses.dataclass
+class MetadataResponse:
+    request_id: int
+    # per requested block: list of TableMetas (a block holds >=1 batches)
+    tables: List[List[TableMeta]]
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TransferRequest:
+    """Ask a peer to stream the data blobs for (block, batch) pairs,
+
+    each tagged so receives can be matched (reference: TransferRequest
+    flatbuffer with per-table tags)."""
+
+    request_id: int
+    tables: List[Tuple[BlockIdSpec, int]]   # (block, batch_index)
+    tags: List[int]
+
+
+@dataclasses.dataclass
+class TransferResponse:
+    request_id: int
+    accepted: bool
+    error: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# connections
+# ---------------------------------------------------------------------------
+
+class ClientConnection:
+    """Executor-side view of a connection to one peer (reference:
+
+    ClientConnection trait — request() for the metadata/transfer channel,
+    receive() to post tagged buffer receives)."""
+
+    def __init__(self, peer_executor_id: str):
+        self.peer_executor_id = peer_executor_id
+
+    def request_metadata(self, req: MetadataRequest,
+                         handler: Callable[[MetadataResponse], None]
+                         ) -> Transaction:
+        raise NotImplementedError
+
+    def request_transfer(self, req: TransferRequest,
+                         handler: Callable[[TransferResponse], None]
+                         ) -> Transaction:
+        raise NotImplementedError
+
+    def register_data_handler(
+            self, handler: Callable[[int, int, bytes], None]):
+        """Register the tagged-data sink: ``handler(tag, offset, payload)``.
+
+        Active-message style (reference: UCX.scala ActiveMessage
+        :369-415): the transport invokes the handler as tagged windows
+        arrive; BufferReceiveState demuxes by tag.
+        """
+        raise NotImplementedError
+
+
+class ServerConnection:
+    """Server-side: register request handlers, send tagged buffers."""
+
+    def register_metadata_handler(
+            self, handler: Callable[[str, MetadataRequest],
+                                    MetadataResponse]):
+        raise NotImplementedError
+
+    def register_transfer_handler(
+            self, handler: Callable[[str, TransferRequest],
+                                    TransferResponse]):
+        raise NotImplementedError
+
+    def send_data(self, peer_executor_id: str, tag: int, offset: int,
+                  data: bytes) -> Transaction:
+        """Send one tagged window (``offset`` = position in the target
+
+        table's contiguous blob) to a peer.  Returns the send
+        Transaction; the bounce buffer backing ``data`` may be reused
+        once it completes."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# transport SPI + factory
+# ---------------------------------------------------------------------------
+
+class RapidsShuffleTransport:
+    """Transport SPI (reference: RapidsShuffleTransport.scala:338).
+
+    A transport owns: the server connection for this executor, a client
+    connection per peer, and the bounce-buffer pools that bound in-flight
+    bytes in each direction.
+    """
+
+    def __init__(self, executor_id: str):
+        self.executor_id = executor_id
+
+    def make_client(self, peer_executor_id: str) -> ClientConnection:
+        raise NotImplementedError
+
+    def server_connection(self) -> ServerConnection:
+        raise NotImplementedError
+
+    def connect(self, peer_executor_id: str):
+        """Pre-connect to a newly discovered peer (heartbeat callback)."""
+        self.make_client(peer_executor_id)
+
+    def close(self):
+        pass
+
+    # -- reflection factory (reference :573) -------------------------------
+    @staticmethod
+    def make_transport(class_name: str, executor_id: str,
+                       **kwargs) -> "RapidsShuffleTransport":
+        """Instantiate a transport from ``module.Class`` config string."""
+        module_name, _, cls_name = class_name.rpartition(".")
+        cls = getattr(importlib.import_module(module_name), cls_name)
+        if not issubclass(cls, RapidsShuffleTransport):
+            raise TypeError(f"{class_name} is not a RapidsShuffleTransport")
+        return cls(executor_id, **kwargs)

@@ -1,0 +1,413 @@
+"""Shuffle transport stack tests.
+
+Reference test pattern (SURVEY.md §4.2): the distributed protocol is
+tested WITHOUT real hardware by injecting transactions and mock
+connections into the client/server state machines
+(RapidsShuffleClientSuite / RapidsShuffleServerSuite /
+WindowedBlockIteratorSuite / RapidsShuffleHeartbeatManagerTest), plus an
+end-to-end two-executor exchange over the in-process transport.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.shuffle import (
+    BlockIdSpec, BounceBufferManager, EndpointRegistry, InProcessTransport,
+    MapOutputTracker, MetadataRequest, MetadataResponse, PeerInfo,
+    RapidsShuffleClient, RapidsShuffleFetchHandler,
+    RapidsShuffleHeartbeatEndpoint, RapidsShuffleHeartbeatManager,
+    ShuffleExecutorContext, ShuffleFetchFailedError, Transaction,
+    TransferRequest, TransferResponse, WindowedBlockIterator,
+    batch_from_meta, build_table_meta, decode_meta, encode_meta)
+from spark_rapids_tpu.shuffle.client import ClientConnection
+
+
+def make_batch(n=10, seed=0, with_strings=True):
+    rng = np.random.default_rng(seed)
+    data = {
+        "a": rng.integers(-100, 100, n).astype(np.int64),
+        "b": rng.standard_normal(n),
+    }
+    b = ColumnarBatch.from_pydict(data)
+    if with_strings:
+        words = [None if i % 7 == 3 else f"w{i}-{seed}" for i in range(n)]
+        b2 = ColumnarBatch.from_pydict({**data, "s": words})
+        return b2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# TableMeta protocol (MetaUtilsSuite role)
+# ---------------------------------------------------------------------------
+
+class TestTableMeta:
+    def test_roundtrip_plain_and_string(self):
+        b = make_batch(13, seed=1)
+        meta, blob = build_table_meta(b)
+        assert meta.num_rows == 13
+        assert meta.total_bytes == len(blob)
+        out = batch_from_meta(meta, blob)
+        assert out.to_pydict() == b.to_pydict()
+
+    def test_wire_encoding_roundtrip(self):
+        b = make_batch(5, seed=2)
+        meta, _ = build_table_meta(b)
+        again = decode_meta(encode_meta(meta))
+        assert again == meta
+
+    def test_degenerate_rows_only(self):
+        from spark_rapids_tpu.columnar.schema import Schema
+        b = ColumnarBatch(Schema(()), [], 42)
+        meta, blob = build_table_meta(b)
+        assert meta.degenerate and meta.total_bytes == 0
+        out = batch_from_meta(decode_meta(encode_meta(meta)), blob)
+        assert out.num_rows == 42 and out.num_cols == 0
+
+    def test_decimal_field_roundtrip(self):
+        from spark_rapids_tpu.columnar.column import Column
+        from spark_rapids_tpu.columnar.schema import Field, Schema
+        import jax.numpy as jnp
+        dt = T.DecimalType(12, 2)
+        col = Column(dt, jnp.asarray(np.array([100, -250], np.int64)),
+                     jnp.asarray(np.array([True, True])))
+        b = ColumnarBatch(Schema([Field("d", dt)]), [col], 2)
+        meta, blob = build_table_meta(b)
+        out = batch_from_meta(decode_meta(encode_meta(meta)), blob)
+        assert out.schema["d"].dtype == dt
+
+
+# ---------------------------------------------------------------------------
+# WindowedBlockIterator (WindowedBlockIteratorSuite role)
+# ---------------------------------------------------------------------------
+
+class TestWindowedBlockIterator:
+    def test_single_block_smaller_than_window(self):
+        it = WindowedBlockIterator([10], 100)
+        windows = list(it)
+        assert len(windows) == 1
+        (r,) = windows[0]
+        assert (r.block_index, r.block_offset, r.length,
+                r.window_offset) == (0, 0, 10, 0)
+
+    def test_block_split_across_windows(self):
+        it = WindowedBlockIterator([250], 100)
+        windows = list(it)
+        assert [w[0].length for w in windows] == [100, 100, 50]
+        assert [w[0].block_offset for w in windows] == [0, 100, 200]
+
+    def test_many_blocks_packed_into_one_window(self):
+        it = WindowedBlockIterator([10, 20, 30], 100)
+        (window,) = list(it)
+        assert [r.block_index for r in window] == [0, 1, 2]
+        assert [r.window_offset for r in window] == [0, 10, 30]
+
+    def test_mixed_sizes_cover_all_bytes(self):
+        sizes = [5, 1000, 0, 17, 256, 3]
+        it = WindowedBlockIterator(sizes, 64)
+        got = {i: 0 for i in range(len(sizes))}
+        for window in it:
+            used = 0
+            for r in window:
+                got[r.block_index] += r.length
+                assert r.window_offset == used
+                used += r.length
+            assert used <= 64
+        assert [got[i] for i in range(len(sizes))] == sizes
+
+    def test_rejects_bad_input(self):
+        with pytest.raises(ValueError):
+            WindowedBlockIterator([1], 0)
+        with pytest.raises(ValueError):
+            WindowedBlockIterator([-1], 10)
+
+
+# ---------------------------------------------------------------------------
+# BounceBufferManager
+# ---------------------------------------------------------------------------
+
+class TestBounceBuffers:
+    def test_acquire_release(self):
+        mgr = BounceBufferManager("t", 1024, 2)
+        a = mgr.acquire()
+        b = mgr.acquire()
+        assert mgr.num_free == 0
+        assert mgr.acquire(blocking=False) is None
+        a.close()
+        assert mgr.num_free == 1
+        c = mgr.acquire()
+        assert c is a
+        b.close()
+        c.close()
+        assert mgr.num_free == 2
+
+    def test_blocking_acquire_wakes_on_release(self):
+        mgr = BounceBufferManager("t", 16, 1)
+        held = mgr.acquire()
+        got = []
+
+        def waiter():
+            got.append(mgr.acquire(timeout=5.0))
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        time.sleep(0.05)
+        held.close()
+        th.join(timeout=5.0)
+        assert got and got[0] is held
+
+    def test_double_release_raises(self):
+        mgr = BounceBufferManager("t", 16, 1)
+        b = mgr.acquire()
+        b.close()
+        with pytest.raises(ValueError):
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# Transaction semantics
+# ---------------------------------------------------------------------------
+
+class TestTransaction:
+    def test_callback_after_completion_fires_immediately(self):
+        tx = Transaction()
+        tx.complete_success(7)
+        seen = []
+        tx.on_complete(lambda t: seen.append(t.nbytes))
+        assert seen == [7]
+
+    def test_only_first_completion_wins(self):
+        tx = Transaction()
+        tx.complete_error("boom")
+        tx.complete_success(1)
+        assert tx.status.value == "error"
+        assert tx.error_message == "boom"
+
+
+# ---------------------------------------------------------------------------
+# Client state machine with a mock connection (RapidsShuffleClientSuite)
+# ---------------------------------------------------------------------------
+
+class MockConnection(ClientConnection):
+    """Scripted connection: the test decides how each request resolves."""
+
+    def __init__(self):
+        super().__init__("mock-peer")
+        self.data_handler = None
+        self.metadata_requests = []
+        self.transfer_requests = []
+
+    def register_data_handler(self, handler):
+        self.data_handler = handler
+
+    def request_metadata(self, req, handler):
+        tx = Transaction()
+        self.metadata_requests.append((req, handler, tx))
+        return tx
+
+    def request_transfer(self, req, handler):
+        tx = Transaction()
+        self.transfer_requests.append((req, handler, tx))
+        return tx
+
+
+class CollectingHandler(RapidsShuffleFetchHandler):
+    def __init__(self):
+        self.batches = []
+        self.errors = []
+        self.expected = None
+
+    def start(self, expected_batches):
+        self.expected = expected_batches
+
+    def batch_received(self, handle):
+        self.batches.append(handle)
+
+    def transfer_error(self, message):
+        self.errors.append(message)
+
+
+class TestClientStateMachine:
+    def test_full_fetch_via_injected_messages(self):
+        conn = MockConnection()
+        client = RapidsShuffleClient(conn)
+        handler = CollectingHandler()
+        blocks = [BlockIdSpec(0, 0, 1)]
+        client.do_fetch(blocks, handler)
+
+        # respond to the metadata request with one table
+        src = make_batch(9, seed=3)
+        meta, blob = build_table_meta(src)
+        (req, meta_cb, tx) = conn.metadata_requests[0]
+        meta_cb(MetadataResponse(req.request_id, [[meta]]))
+        tx.complete_success()
+
+        assert handler.expected == 1
+        # client should now have issued a transfer request with one tag
+        (treq, transfer_cb, ttx) = conn.transfer_requests[0]
+        assert len(treq.tags) == 1
+        transfer_cb(TransferResponse(treq.request_id, True))
+        ttx.complete_success()
+
+        # deliver the blob in two windows, out of arrival order within
+        # a table is not required — windows are offset-addressed
+        tag = treq.tags[0]
+        half = len(blob) // 2
+        conn.data_handler(tag, half, blob[half:])
+        conn.data_handler(tag, 0, blob[:half])
+
+        assert len(handler.batches) == 1
+        out = handler.batches[0].materialize()
+        assert out.to_pydict() == src.to_pydict()
+
+    def test_metadata_error_surfaces(self):
+        conn = MockConnection()
+        client = RapidsShuffleClient(conn)
+        handler = CollectingHandler()
+        client.do_fetch([BlockIdSpec(0, 0, 0)], handler)
+        (req, meta_cb, tx) = conn.metadata_requests[0]
+        meta_cb(MetadataResponse(req.request_id, [], error="no such block"))
+        assert handler.errors == ["no such block"]
+
+    def test_transfer_rejection_surfaces(self):
+        conn = MockConnection()
+        client = RapidsShuffleClient(conn)
+        handler = CollectingHandler()
+        client.do_fetch([BlockIdSpec(0, 0, 0)], handler)
+        src = make_batch(3, seed=4)
+        meta, _ = build_table_meta(src)
+        (req, meta_cb, tx) = conn.metadata_requests[0]
+        meta_cb(MetadataResponse(req.request_id, [[meta]]))
+        (treq, transfer_cb, ttx) = conn.transfer_requests[0]
+        transfer_cb(TransferResponse(treq.request_id, False, error="busy"))
+        assert handler.errors == ["busy"]
+
+    def test_degenerate_table_needs_no_transfer(self):
+        from spark_rapids_tpu.columnar.schema import Schema
+        conn = MockConnection()
+        client = RapidsShuffleClient(conn)
+        handler = CollectingHandler()
+        client.do_fetch([BlockIdSpec(0, 0, 0)], handler)
+        meta, _ = build_table_meta(ColumnarBatch(Schema(()), [], 17))
+        (req, meta_cb, tx) = conn.metadata_requests[0]
+        meta_cb(MetadataResponse(req.request_id, [[meta]]))
+        assert not conn.transfer_requests
+        assert len(handler.batches) == 1
+        assert handler.batches[0].materialize().num_rows == 17
+
+
+# ---------------------------------------------------------------------------
+# End-to-end over the in-process transport (two executors)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def fresh_registry():
+    reg = EndpointRegistry.reset()
+    yield reg
+    EndpointRegistry.reset()
+
+
+class TestEndToEndExchange:
+    def test_remote_fetch_two_executors(self, fresh_registry):
+        tracker = MapOutputTracker()
+        ex_a = ShuffleExecutorContext(
+            "exec-a", InProcessTransport("exec-a", fresh_registry), tracker,
+            bounce_buffer_size=64, num_bounce_buffers=2)
+        ex_b = ShuffleExecutorContext(
+            "exec-b", InProcessTransport("exec-b", fresh_registry), tracker,
+            bounce_buffer_size=64, num_bounce_buffers=2)
+
+        # exec-a runs map task 0; partitions 0/1 both get data
+        b0 = make_batch(11, seed=5)
+        b1 = make_batch(7, seed=6)
+        ex_a.write_map_output(0, 0, {0: [b0], 1: [b1]})
+        # exec-b runs map task 1
+        b2 = make_batch(5, seed=7)
+        ex_b.write_map_output(0, 1, {0: [b2]})
+
+        # reduce partition 0 on exec-b: local hit (b2) + remote (b0)
+        out = list(ex_b.read_partition(0, 0, timeout_s=10.0))
+        assert len(out) == 2
+        dicts = [o.to_pydict() for o in out]
+        assert b2.to_pydict() in dicts
+        assert b0.to_pydict() in dicts
+
+        # reduce partition 1 on exec-b: purely remote, multi-window
+        # (batch bytes >> 64-byte bounce buffers)
+        out1 = list(ex_b.read_partition(0, 1, timeout_s=10.0))
+        assert len(out1) == 1
+        assert out1[0].to_pydict() == b1.to_pydict()
+
+    def test_fetch_failure_raises_for_scheduler(self, fresh_registry):
+        tracker = MapOutputTracker()
+        ex_a = ShuffleExecutorContext(
+            "exec-a", InProcessTransport("exec-a", fresh_registry), tracker)
+        ex_b = ShuffleExecutorContext(
+            "exec-b", InProcessTransport("exec-b", fresh_registry), tracker)
+        ex_a.write_map_output(0, 0, {0: [make_batch(4, seed=8)]})
+        # exec-a vanishes (executor loss)
+        fresh_registry.drop_peers["exec-a"] = "connection reset"
+        with pytest.raises(ShuffleFetchFailedError):
+            list(ex_b.read_partition(0, 0, timeout_s=2.0))
+
+    def test_server_bytes_accounting(self, fresh_registry):
+        tracker = MapOutputTracker()
+        ex_a = ShuffleExecutorContext(
+            "exec-a", InProcessTransport("exec-a", fresh_registry), tracker,
+            bounce_buffer_size=128, num_bounce_buffers=1)
+        ex_b = ShuffleExecutorContext(
+            "exec-b", InProcessTransport("exec-b", fresh_registry), tracker)
+        src = make_batch(50, seed=9)
+        meta, blob = build_table_meta(src)
+        ex_a.write_map_output(0, 0, {0: [src]})
+        out = list(ex_b.read_partition(0, 0, timeout_s=10.0))
+        assert out[0].to_pydict() == src.to_pydict()
+        deadline = time.time() + 5
+        while ex_a.server.bytes_served < len(blob) and time.time() < deadline:
+            time.sleep(0.01)
+        assert ex_a.server.bytes_served == len(blob)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat manager (RapidsShuffleHeartbeatManagerTest role)
+# ---------------------------------------------------------------------------
+
+class RecordingTransport:
+    def __init__(self):
+        self.connected = []
+
+    def connect(self, peer):
+        self.connected.append(peer)
+
+
+class TestHeartbeat:
+    def test_registration_returns_existing_peers(self):
+        mgr = RapidsShuffleHeartbeatManager()
+        t1, t2 = RecordingTransport(), RecordingTransport()
+        RapidsShuffleHeartbeatEndpoint(mgr, t1, PeerInfo("e1"))
+        assert t1.connected == []
+        RapidsShuffleHeartbeatEndpoint(mgr, t2, PeerInfo("e2"))
+        assert t2.connected == ["e1"]
+
+    def test_heartbeat_returns_only_new_peers(self):
+        mgr = RapidsShuffleHeartbeatManager()
+        t1 = RecordingTransport()
+        ep1 = RapidsShuffleHeartbeatEndpoint(mgr, t1, PeerInfo("e1"))
+        RapidsShuffleHeartbeatEndpoint(mgr, RecordingTransport(),
+                                       PeerInfo("e2"))
+        assert [p.executor_id for p in ep1.beat()] == ["e2"]
+        assert ep1.beat() == []          # no news on the next beat
+        RapidsShuffleHeartbeatEndpoint(mgr, RecordingTransport(),
+                                       PeerInfo("e3"))
+        assert [p.executor_id for p in ep1.beat()] == ["e3"]
+        assert t1.connected == ["e2", "e3"]
+
+    def test_liveness_timeout(self):
+        mgr = RapidsShuffleHeartbeatManager(timeout_s=0.05)
+        mgr.register_executor(PeerInfo("e1"))
+        assert [p.executor_id for p in mgr.live_executors()] == ["e1"]
+        time.sleep(0.1)
+        assert mgr.live_executors() == []
