@@ -129,6 +129,13 @@ class Col:
         return Col(es.Substring(self.expr, ec.Literal(start),
                                 ec.Literal(length)))
 
+    def getItem(self, key):
+        from ..expr import collections as ecoll
+        return Col(ecoll.GetArrayItem(self.expr, _expr(key)))
+
+    def __getitem__(self, key):
+        return self.getItem(key)
+
     def when(self, *a, **k):
         raise AttributeError("use functions.when")
 

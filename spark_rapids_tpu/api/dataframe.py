@@ -11,6 +11,7 @@ from typing import Dict, List, Optional, Sequence, Union
 
 import pyarrow as pa
 
+from ..columnar import dtypes as T
 from ..columnar.schema import Schema
 from ..expr import core as ec
 from ..expr import aggregates as eagg
@@ -63,7 +64,41 @@ class DataFrame:
                     for f in self.schema)
             else:
                 exprs.append(_to_expr(c, self.schema))
-        return DataFrame(L.Project(exprs, self._plan), self.session)
+        gen_plan, exprs = self._plan_generators(exprs)
+        return DataFrame(L.Project(exprs, gen_plan), self.session)
+
+    def _plan_generators(self, exprs):
+        """Pull top-level Explode generators into a Generate node.
+
+        Mirrors Spark's analyzer: SELECT with a generator becomes
+        Generate(generator, child) + Project over its output
+        (reference: GpuGenerateExec planning).
+        """
+        from ..expr import collections as ecoll
+        gens = [e for e in exprs
+                if isinstance(e, ecoll.Explode) or
+                (isinstance(e, ec.Alias) and
+                 isinstance(e.children[0], ecoll.Explode))]
+        if not gens:
+            return self._plan, exprs
+        if len(gens) > 1:
+            raise ValueError("only one generator allowed per select")
+        g = gens[0]
+        gen = g.children[0] if isinstance(g, ec.Alias) else g
+        val_name = g.alias if isinstance(g, ec.Alias) else "col"
+        names = (["pos", val_name] if gen.pos else [val_name])
+        plan = L.Generate(gen, names, self._plan)
+        out = []
+        for e in exprs:
+            if e is g:
+                if gen.pos:
+                    out.append(ec.AttributeReference("pos", T.INT32,
+                                                     gen.outer))
+                out.append(ec.AttributeReference(val_name, gen.dtype(),
+                                                 True))
+            else:
+                out.append(e)
+        return plan, out
 
     def with_column(self, name: str, col) -> "DataFrame":
         exprs = []
@@ -78,7 +113,8 @@ class DataFrame:
                     ec.AttributeReference(f.name, f.dtype, f.nullable))
         if not replaced:
             exprs.append(ec.Alias(e, name))
-        return DataFrame(L.Project(exprs, self._plan), self.session)
+        gen_plan, exprs = self._plan_generators(exprs)
+        return DataFrame(L.Project(exprs, gen_plan), self.session)
 
     withColumn = with_column
 

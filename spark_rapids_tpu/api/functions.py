@@ -312,3 +312,64 @@ def lead(c, offset: int = 1) -> Col:
 def lag(c, offset: int = 1) -> Col:
     return Col(wf.Lag(_expr(c if not isinstance(c, str) else col(c)),
                       offset))
+
+
+# -- collections (collectionOperations.scala role) ----------------------------
+
+def _c(c):
+    return _expr(col(c) if isinstance(c, str) else c)
+
+
+def array(*cols) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.CreateArray(*[_c(c) for c in cols]))
+
+
+def size(c) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.Size(_c(c)))
+
+
+def element_at(c, index) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.ElementAt(_c(c), _expr(index)))
+
+
+def array_contains(c, value) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.ArrayContains(_c(c), _expr(value)))
+
+
+def sort_array(c, asc: bool = True) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.SortArray(_c(c), asc))
+
+
+def array_min(c) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.ArrayMin(_c(c)))
+
+
+def array_max(c) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.ArrayMax(_c(c)))
+
+
+def explode(c) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.Explode(_c(c)))
+
+
+def explode_outer(c) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.Explode(_c(c), outer=True))
+
+
+def posexplode(c) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.Explode(_c(c), pos=True))
+
+
+def posexplode_outer(c) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.Explode(_c(c), pos=True, outer=True))

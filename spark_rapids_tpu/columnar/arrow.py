@@ -82,6 +82,21 @@ def schema_to_arrow(schema: Schema) -> pa.Schema:
 
 
 def column_to_arrow(col: Column, num_rows: int) -> pa.Array:
+    from .column import ListColumn
+    if isinstance(col, ListColumn):
+        offs = np.asarray(col.offsets)[:num_rows + 1].astype(np.int64)
+        valid = np.asarray(col.validity)[:num_rows]
+        n_elems = int(offs[num_rows]) if num_rows else 0
+        values = column_to_arrow(col.elements, n_elems)
+        if valid.all():
+            arrow_offs = pa.array(offs, type=pa.int32())
+        else:
+            # a null offset entry marks that list row null (Arrow semantics
+            # of ListArray.from_arrays with a nullable offsets array)
+            arrow_offs = pa.array(
+                [int(offs[i]) if i == num_rows or valid[i] else None
+                 for i in range(num_rows + 1)], type=pa.int32())
+        return pa.ListArray.from_arrays(arrow_offs, values)
     if isinstance(col, StringColumn):
         vals, valid = col.to_numpy(num_rows)
         return pa.array([v if ok else None for v, ok in zip(vals, valid)],
@@ -116,6 +131,27 @@ def column_from_arrow(arr: pa.ChunkedArray | pa.Array,
     dt = from_arrow_type(arr.type)
     n = len(arr)
     cap = capacity or bucket_capacity(n)
+    if isinstance(dt, T.ArrayType):
+        from .column import ListColumn
+        import jax.numpy as jnp
+        valid_np = np.ones(n, dtype=bool) if arr.null_count == 0 else \
+            np.asarray(arr.is_valid())
+        raw = np.asarray(arr.offsets.fill_null(0)).astype(np.int64)
+        # rebuild monotonic 0-based offsets with 0-length extents at null
+        # rows so device kernels see a clean buffer; flatten() yields the
+        # matching element sequence (it skips null/sliced-out extents)
+        lens = np.where(valid_np, raw[1:] - raw[:-1], 0)
+        offs = np.zeros(n + 1, np.int32)
+        offs[1:] = np.cumsum(lens)
+        flat = arr.flatten()
+        elements = column_from_arrow(flat) if len(flat) else \
+            column_from_arrow(pa.array([], type=arr.type.value_type))
+        out_offs = np.full(cap + 1, offs[n] if n else 0, np.int32)
+        out_offs[:n + 1] = offs[:n + 1]
+        out_valid = np.zeros(cap, bool)
+        out_valid[:n] = valid_np
+        return ListColumn(dt, jnp.asarray(out_offs), elements,
+                          jnp.asarray(out_valid))
     if dt == T.STRING:
         return StringColumn.from_pylist(arr.to_pylist(), capacity=cap)
     valid_np = np.ones(n, dtype=bool) if arr.null_count == 0 else \

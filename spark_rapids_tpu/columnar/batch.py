@@ -146,41 +146,85 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     cap = bucket_capacity(total)
     out_cols: List[Column] = []
     for ci, field in enumerate(schema):
-        if field.dtype == T.STRING:
-            out_cols.append(_concat_string_cols(
-                [b.columns[ci] for b in batches],
-                [b.num_rows for b in batches], cap))
-        else:
-            datas, valids = [], []
-            for b in batches:
-                c = b.columns[ci]
-                datas.append(c.data[:b.num_rows] if b.num_rows != c.capacity
-                             else c.data)
-                valids.append(c.validity[:b.num_rows]
-                              if b.num_rows != c.capacity else c.validity)
-            # trim to exact rows then pad to bucket
-            datas = [d[:n] for d, n in zip(datas, [b.num_rows for b in batches])]
-            valids = [v[:n] for v, n in zip(valids, [b.num_rows for b in batches])]
-            data = jnp.concatenate(datas) if datas else jnp.zeros(0)
-            valid = jnp.concatenate(valids)
-            pad = cap - int(data.shape[0])
-            if pad:
-                data = jnp.pad(data, (0, pad))
-                valid = jnp.pad(valid, (0, pad))
-            out_cols.append(Column(field.dtype, data, valid))
+        out_cols.append(_concat_cols(
+            field.dtype, [b.columns[ci] for b in batches],
+            [b.num_rows for b in batches], cap))
     return ColumnarBatch(schema, out_cols, total)
+
+
+def _concat_cols(dtype: T.DType, cols: Sequence[Column],
+                 nrows: Sequence[int], cap: int) -> Column:
+    if dtype == T.STRING:
+        return _concat_string_cols(cols, nrows, cap)
+    if isinstance(dtype, T.ArrayType):
+        return _concat_list_cols(cols, nrows, cap)
+    datas = [c.data[:n] for c, n in zip(cols, nrows)]
+    valids = [c.validity[:n] for c, n in zip(cols, nrows)]
+    data = jnp.concatenate(datas) if datas else jnp.zeros(0)
+    valid = jnp.concatenate(valids)
+    pad = cap - int(data.shape[0])
+    if pad:
+        data = jnp.pad(data, (0, pad))
+        valid = jnp.pad(valid, (0, pad))
+    return Column(dtype, data, valid)
+
+
+def _slice_elements(col: Column, o0: int, o1: int) -> Column:
+    """Child slice covering absolute element range [o0, o1)."""
+    from .column import ListColumn
+    if isinstance(col, ListColumn):
+        return ListColumn(col.dtype, col.offsets[o0:o1 + 1], col.elements,
+                          col.validity[o0:o1])
+    if isinstance(col, StringColumn):
+        return StringColumn(col.offsets[o0:o1 + 1], col.data,
+                            col.validity[o0:o1])
+    return Column(col.dtype, col.data[o0:o1], col.validity[o0:o1])
+
+
+def _concat_list_cols(cols: Sequence[Column], nrows: Sequence[int],
+                      cap: int) -> Column:
+    """Concat of ListColumns: rebase offsets, recursively concat children."""
+    from .column import ListColumn
+    offsets_parts: List = []
+    valid_parts: List = []
+    child_cols: List[Column] = []
+    child_ns: List[int] = []
+    base = 0
+    for c, n in zip(cols, nrows):
+        offs = np.asarray(c.offsets)
+        o0, o1 = int(offs[0]), int(offs[n])
+        offsets_parts.append(
+            c.offsets[:n].astype(jnp.int32) - jnp.int32(o0 - base))
+        valid_parts.append(c.validity[:n])
+        child_cols.append(_slice_elements(c.elements, o0, o1))
+        child_ns.append(o1 - o0)
+        base += o1 - o0
+    total = sum(nrows)
+    child_cap = bucket_capacity(max(1, sum(child_ns)))
+    elements = _concat_cols(cols[0].dtype.element_type, child_cols,
+                            child_ns, child_cap)
+    offsets = jnp.concatenate(
+        offsets_parts + [jnp.array([base], jnp.int32)])
+    pad = cap + 1 - int(offsets.shape[0])
+    if pad > 0:
+        offsets = jnp.pad(offsets, (0, pad), mode="edge")
+    valid = jnp.concatenate(valid_parts)
+    vpad = cap - int(valid.shape[0])
+    if vpad > 0:
+        valid = jnp.pad(valid, (0, vpad))
+    return ListColumn(cols[0].dtype, offsets.astype(jnp.int32), elements,
+                      valid)
 
 
 def _concat_string_cols(cols: Sequence[StringColumn], nrows: Sequence[int],
                         cap: int) -> StringColumn:
-    offsets_parts, bytes_parts, valid_parts = [], [], []
+    offsets_parts, valid_parts = [], []
     base = 0
     for c, n in zip(cols, nrows):
-        offs = c.offsets
-        nbytes_live = offs[n]
-        offsets_parts.append(offs[:n] + base)
-        base = base + nbytes_live
-        bytes_parts.append(c.data)
+        offs_np = np.asarray(c.offsets)
+        o0 = int(offs_np[0])
+        offsets_parts.append(c.offsets[:n] - jnp.int32(o0 - base))
+        base = base + int(offs_np[n]) - o0
         valid_parts.append(c.validity[:n])
     # bytes: need exact live bytes from each column; do on host-free device ops
     # by slicing with dynamic sizes is not static-shape friendly; instead gather
@@ -189,8 +233,7 @@ def _concat_string_cols(cols: Sequence[StringColumn], nrows: Sequence[int],
     np_bytes = []
     for c, n in zip(cols, nrows):
         offs = np.asarray(c.offsets)
-        live = int(offs[n])
-        np_bytes.append(np.asarray(c.data)[:live])
+        np_bytes.append(np.asarray(c.data)[int(offs[0]):int(offs[n])])
     all_bytes = np.concatenate(np_bytes) if np_bytes else np.zeros(0, np.uint8)
     byte_cap = bucket_capacity(max(1, all_bytes.shape[0]))
     buf = np.zeros(byte_cap, np.uint8)

@@ -64,8 +64,15 @@ class Column:
             validity = np.array([v is not None for v in values], dtype=np.bool_)
             if dtype is None:
                 probe = [v for v in values if v is not None]
+                if probe and isinstance(probe[0], (list, tuple)):
+                    return ListColumn.from_pylist(list(values),
+                                                  capacity=capacity)
                 np_arr = np.array(probe if probe else [0])
                 dtype = T.from_numpy_dtype(np_arr.dtype)
+            if isinstance(dtype, T.ArrayType):
+                return ListColumn.from_pylist(
+                    list(values), element_type=dtype.element_type,
+                    capacity=capacity)
             if dtype == T.STRING:
                 return StringColumn.from_pylist(list(values), capacity=capacity)
             clean = [v if v is not None else dtype.default_value for v in values]
@@ -92,6 +99,11 @@ class Column:
             return StringColumn(
                 jnp.zeros(capacity + 1, jnp.int32),
                 jnp.zeros(MIN_CAPACITY, jnp.uint8),
+                jnp.zeros(capacity, jnp.bool_))
+        if isinstance(dtype, T.ArrayType):
+            return ListColumn(
+                dtype, jnp.zeros(capacity + 1, jnp.int32),
+                Column.all_null(dtype.element_type, MIN_CAPACITY),
                 jnp.zeros(capacity, jnp.bool_))
         data = jnp.zeros(capacity, dtype=dtype.np_dtype)
         return Column(dtype, data, jnp.zeros(capacity, jnp.bool_))
@@ -236,4 +248,117 @@ class StringColumn(Column):
         return [self.offsets, self.data, self.validity]
 
 
-ColumnLike = Union[Column, StringColumn]
+class ListColumn(Column):
+    """Arrow-layout list column: offsets int32[cap+1] + element child column.
+
+    Reference analogue: cuDF LIST columns used by collectionOperations.scala
+    and GpuGenerateExec.  The child may itself be any Column (fixed-width,
+    StringColumn, or a nested ListColumn) — gathers recurse.
+    Offsets are absolute indices into the child and need not start at 0
+    (slices stay zero-copy); the invariant is monotonicity plus
+    edge-padding past the live row count.
+    """
+
+    def __init__(self, dtype: T.ArrayType, offsets, elements: Column,
+                 validity):
+        self.dtype = dtype
+        self.offsets = offsets
+        self.elements = elements
+        self.validity = validity
+
+    @property
+    def capacity(self) -> int:
+        return int(self.validity.shape[0])
+
+    @staticmethod
+    def from_pylist(values: Sequence, element_type: Optional[T.DType] = None,
+                    capacity: Optional[int] = None) -> "ListColumn":
+        n = len(values)
+        cap = capacity or bucket_capacity(n)
+        validity = np.zeros(cap, dtype=np.bool_)
+        flat: List = []
+        offsets = np.zeros(cap + 1, dtype=np.int32)
+        for i, v in enumerate(values):
+            if v is not None:
+                validity[i] = True
+                flat.extend(v)
+            offsets[i + 1] = len(flat)
+        offsets[n + 1:] = offsets[n]
+        if element_type is None:
+            probe = [x for x in flat if x is not None]
+            if probe and isinstance(probe[0], str):
+                element_type = T.STRING
+            elif probe and isinstance(probe[0], (list, tuple)):
+                raise ValueError("nested list needs explicit element_type")
+            else:
+                arr = np.array(probe if probe else [0])
+                element_type = T.from_numpy_dtype(arr.dtype)
+        if isinstance(element_type, T.ArrayType):
+            elems = ListColumn.from_pylist(
+                flat, element_type=element_type.element_type)
+        elif element_type == T.STRING:
+            elems = StringColumn.from_pylist(flat)
+        else:
+            elems = Column.from_numpy(flat, dtype=element_type)
+        return ListColumn(T.ArrayType(element_type), jnp.asarray(offsets),
+                          elems, jnp.asarray(validity))
+
+    @property
+    def element_capacity(self) -> int:
+        return self.elements.capacity
+
+    def to_pylist(self, num_rows: int) -> List:
+        offs = np.asarray(self.offsets)
+        valid = np.asarray(self.validity)[:num_rows]
+        n_elems = int(offs[num_rows]) if num_rows else 0
+        elems = self.elements.to_pylist(n_elems) if n_elems else []
+        out: List = []
+        for i in range(num_rows):
+            if not valid[i]:
+                out.append(None)
+            else:
+                out.append(elems[offs[i]:offs[i + 1]])
+        return out
+
+    def to_numpy(self, num_rows: int):
+        vals = np.empty(num_rows, dtype=object)
+        lst = self.to_pylist(num_rows)
+        for i, v in enumerate(lst):
+            vals[i] = v
+        return vals, np.asarray(self.validity)[:num_rows]
+
+    def with_capacity(self, capacity: int, num_rows: int) -> "ListColumn":
+        if capacity == self.capacity:
+            return self
+        if capacity > self.capacity:
+            pad = capacity - self.capacity
+            offsets = jnp.pad(self.offsets, (0, pad), mode="edge")
+            valid = jnp.pad(self.validity, (0, pad))
+        else:
+            offsets = self.offsets[:capacity + 1]
+            valid = self.validity[:capacity] & (jnp.arange(capacity) < num_rows)
+        return ListColumn(self.dtype, offsets, self.elements, valid)
+
+    def gather(self, indices) -> "ListColumn":
+        from ..kernels import lists as lkern
+        new_offsets, gvalid, src_starts, total = lkern.gather_list_offsets(
+            self.offsets, self.validity, indices)
+        elem_cap = bucket_capacity(max(1, int(total)))
+        src_idx, live = lkern.element_gather_indices(
+            new_offsets, src_starts, elem_cap)
+        elems = self.elements.gather(src_idx).mask_validity(live)
+        return ListColumn(self.dtype, new_offsets, elems, gvalid)
+
+    def mask_validity(self, keep_mask) -> "ListColumn":
+        return ListColumn(self.dtype, self.offsets, self.elements,
+                          self.validity & keep_mask)
+
+    def nbytes(self) -> int:
+        return (self.offsets.nbytes + self.elements.nbytes() +
+                self.validity.nbytes)
+
+    def device_buffers(self):
+        return [self.offsets, self.validity] + self.elements.device_buffers()
+
+
+ColumnLike = Union[Column, StringColumn, ListColumn]

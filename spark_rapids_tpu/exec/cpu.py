@@ -323,22 +323,65 @@ class CpuJoin(CpuExec):
     def _join(self, lt: pa.Table, rt: pa.Table) -> pa.Table:
         lg = self.logical
         out_schema = schema_to_arrow(self.output_schema)
+        # pyarrow's hash join rejects nested payload columns: replace them
+        # with row-index surrogates, join, then gather them back
+        nested_l = [n for n, f in zip(lt.column_names, lt.schema)
+                    if pa.types.is_nested(f.type)]
+        nested_r = [n for n, f in zip(rt.column_names, rt.schema)
+                    if pa.types.is_nested(f.type)]
+        if nested_l or nested_r:
+            lidx = pa.array(np.arange(lt.num_rows, dtype=np.int64))
+            ridx = pa.array(np.arange(rt.num_rows, dtype=np.int64))
+            lsub, rsub = lt, rt
+            for n in nested_l:
+                i = lsub.column_names.index(n)
+                lsub = lsub.set_column(
+                    i, pa.field("__sur_l_" + n, pa.int64()), lidx)
+            for n in nested_r:
+                i = rsub.column_names.index(n)
+                rsub = rsub.set_column(
+                    i, pa.field("__sur_r_" + n, pa.int64()), ridx)
+            joined = self._join_raw(lsub, rsub, key_src=(lt, rt))
+            arrays = []
+            for i, f in enumerate(out_schema):
+                c = joined.column(i).combine_chunks()
+                name = joined.column_names[i]
+                if name.startswith("__sur_l_"):
+                    c = lt.column(name[len("__sur_l_"):]) \
+                        .combine_chunks().take(c)
+                elif name.startswith("__sur_r_"):
+                    c = rt.column(name[len("__sur_r_"):]) \
+                        .combine_chunks().take(c)
+                if c.type != f.type:
+                    c = pc.cast(c, f.type, safe=False)
+                arrays.append(c)
+            out = pa.Table.from_arrays(arrays, schema=out_schema)
+            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+            return out
+        return self._finish(self._join_raw(lt, rt, key_src=(lt, rt)),
+                            out_schema)
+
+    def _join_raw(self, lt: pa.Table, rt: pa.Table, key_src) -> pa.Table:
+        """Hash join returning the positional result table (keys dropped);
+        key expressions evaluate against ``key_src`` (the pre-surrogate
+        originals)."""
+        lg = self.logical
         if lg.join_type == "cross":
             # cross via dummy constant keys
             lk = lt.append_column("__ck", pa.array([1] * lt.num_rows))
             rk = rt.append_column("__ck", pa.array([1] * rt.num_rows))
             res = lk.join(rk, keys=["__ck"], join_type="inner",
                           use_threads=False)
-            res = res.drop_columns(["__ck"])
-            return self._finish(res, out_schema)
+            return res.drop_columns(["__ck"])
+        lsrc, rsrc = key_src
         lkeys, rkeys = [], []
         lwork, rwork = lt, rt
         for i, (le, re) in enumerate(zip(lg.left_keys, lg.right_keys)):
             lname, rname = f"__lk_{i}", f"__rk_{i}"
-            lwork = lwork.append_column(lname,
-                                        _arr(cpu_eval(le, lt), lt.num_rows))
-            rwork = rwork.append_column(rname,
-                                        _arr(cpu_eval(re, rt), rt.num_rows))
+            lwork = lwork.append_column(
+                lname, _arr(cpu_eval(le, lsrc), lsrc.num_rows))
+            rwork = rwork.append_column(
+                rname, _arr(cpu_eval(re, rsrc), rsrc.num_rows))
             lkeys.append(lname)
             rkeys.append(rname)
         jt = {"inner": "inner", "left": "left outer", "right": "right outer",
@@ -349,8 +392,7 @@ class CpuJoin(CpuExec):
                          coalesce_keys=False)
         drop = [c for c in res.column_names if c.startswith("__lk_")
                 or c.startswith("__rk_")]
-        res = res.drop_columns(drop)
-        return self._finish(res, out_schema)
+        return res.drop_columns(drop)
 
     def _finish(self, res: pa.Table, out_schema: pa.Schema) -> pa.Table:
         # positional mapping (duplicate column names are legal post-join)
@@ -449,6 +491,54 @@ class CpuLimit(CpuExec):
             out = _concat_tables(got, child_schema)
             yield out.slice(self.offset, self.n)
         return [run()]
+
+
+class CpuGenerate(CpuExec):
+    """Oracle for explode/posexplode — plain Python row expansion.
+
+    Reference behavior: Spark GenerateExec with Explode/PosExplode
+    generators (outer variants emit one null row for empty/null input).
+    """
+
+    def __init__(self, logical, child: PhysicalPlan):
+        super().__init__(child)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def execute(self):
+        gen = self.logical.generator
+        out_schema = schema_to_arrow(self.output_schema)
+
+        def run(part):
+            for t in part:
+                lists = _arr(cpu_eval(gen.children[0], t),
+                             t.num_rows).to_pylist()
+                base = [t.column(i).to_pylist()
+                        for i in range(t.num_columns)]
+                n_extra = 2 if gen.pos else 1
+                out_cols = [[] for _ in range(t.num_columns + n_extra)]
+                for i, lst in enumerate(lists):
+                    if lst is None or len(lst) == 0:
+                        if not gen.outer:
+                            continue
+                        items = [(None, None)]
+                    else:
+                        items = list(enumerate(lst))
+                    for p, v in items:
+                        for ci in range(t.num_columns):
+                            out_cols[ci].append(base[ci][i])
+                        if gen.pos:
+                            out_cols[t.num_columns].append(p)
+                        out_cols[-1].append(v)
+                arrays = [pa.array(vals, type=f.type)
+                          for vals, f in zip(out_cols, out_schema)]
+                out = pa.Table.from_arrays(arrays, schema=out_schema)
+                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                yield out
+        return [run(p) for p in self.children[0].execute()]
 
 
 class CpuUnion(CpuExec):

@@ -1,0 +1,375 @@
+"""Collection (array) expressions.
+
+Reference analogues: complexTypeCreator.scala (CreateArray),
+complexTypeExtractors.scala (GetArrayItem, ElementAt) and
+collectionOperations.scala (Size, ArrayContains, SortArray), registered at
+GpuOverrides.scala:773+.  Explode/PosExplode are generator expressions
+consumed only by the Generate exec (GpuGenerateExec.scala role) — they do
+not evaluate standalone.
+
+TPU-first: all ops are offsets arithmetic + segmented reductions over the
+ListColumn layout (kernels/lists.py); no per-row Python.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.column import (Column, ListColumn, StringColumn,
+                               bucket_capacity)
+from ..columnar.batch import ColumnarBatch
+from ..kernels import lists as lk
+from ..kernels import canon
+from . import core as ec
+
+
+class CreateArray(ec.Expression):
+    """array(e1, e2, ...) — fixed-length list per row.
+
+    Reference: complexTypeCreator.scala GpuCreateArray.
+    """
+
+    def __init__(self, *children: ec.Expression):
+        self.children = list(children)
+
+    def with_children(self, c):
+        return CreateArray(*c)
+
+    def dtype(self):
+        if not self.children:
+            return T.ArrayType(T.NULL)
+        et = self.children[0].dtype()
+        for c in self.children[1:]:
+            et = T.common_type(et, c.dtype())
+        return T.ArrayType(et)
+
+    @property
+    def nullable(self):
+        return False  # the array itself is never null; elements may be
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        from .cast import Cast
+        k = len(self.children)
+        cap = batch.capacity
+        n = batch.num_rows
+        et = self.dtype().element_type
+        offsets = (jnp.arange(cap + 1, dtype=jnp.int32) *
+                   jnp.int32(k)).clip(max=np.int32(n * k))
+        kids = []
+        for c in self.children:
+            e = c if c.dtype() == et else Cast(c, et)
+            kids.append(ec.eval_as_column(e, batch))
+        if k == 0:
+            elems = Column.all_null(et, 16)
+        elif et == T.STRING:
+            # concat children byte-wise then interleave via gather:
+            # output element i*k+j reads child j's row i
+            from ..columnar.batch import _concat_string_cols
+            combined = _concat_string_cols(kids, [cap] * k,
+                                           bucket_capacity(cap * k))
+            j = jnp.arange(bucket_capacity(max(1, cap * k)), dtype=jnp.int32)
+            src = (j % k) * cap + (j // k)
+            elems = combined.gather(src)
+        else:
+            # [cap, k] stack -> row-major flatten is exactly interleaved
+            data = jnp.stack([c.data for c in kids], axis=1).reshape(-1)
+            valid = jnp.stack([c.validity for c in kids], axis=1).reshape(-1)
+            ecap = bucket_capacity(max(1, cap * k))
+            if data.shape[0] < ecap:
+                data = jnp.pad(data, (0, ecap - data.shape[0]))
+                valid = jnp.pad(valid, (0, ecap - valid.shape[0]))
+            elems = Column(et, data, valid)
+        live = jnp.arange(cap) < n
+        return ListColumn(T.ArrayType(et), offsets, elems, live)
+
+
+class Size(ec.Expression):
+    """size(array) — Spark legacy semantics: size(null) = -1.
+
+    Reference: collectionOperations.scala GpuSize.
+    """
+
+    def __init__(self, child: ec.Expression, legacy_null: bool = True):
+        self.children = [child]
+        self.legacy_null = legacy_null
+
+    def with_children(self, c):
+        return Size(c[0], self.legacy_null)
+
+    def dtype(self):
+        return T.INT32
+
+    @property
+    def nullable(self):
+        return not self.legacy_null
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        col: ListColumn = ec.eval_as_column(self.children[0], batch)
+        lens = lk.list_lengths(col.offsets)
+        if self.legacy_null:
+            data = jnp.where(col.validity, lens, jnp.int32(-1))
+            return Column(T.INT32, data,
+                          jnp.ones(col.capacity, jnp.bool_))
+        return Column(T.INT32, lens, col.validity)
+
+
+class GetArrayItem(ec.Expression):
+    """arr[i] — 0-based index; null when out of bounds or null input.
+
+    Reference: complexTypeExtractors.scala GpuGetArrayItem.
+    """
+
+    def __init__(self, child: ec.Expression, index: ec.Expression):
+        self.children = [child, index]
+
+    def with_children(self, c):
+        return GetArrayItem(c[0], c[1])
+
+    def dtype(self):
+        return self.children[0].dtype().element_type
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        return _extract_at(self.children[0], self.children[1], batch,
+                           one_based=False)
+
+
+class ElementAt(ec.Expression):
+    """element_at(arr, i) — 1-based; negative counts from the end.
+
+    Reference: collectionOperations.scala GpuElementAt (non-ANSI: null on
+    out-of-bound).
+    """
+
+    def __init__(self, child: ec.Expression, index: ec.Expression):
+        self.children = [child, index]
+
+    def with_children(self, c):
+        return ElementAt(c[0], c[1])
+
+    def dtype(self):
+        return self.children[0].dtype().element_type
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        return _extract_at(self.children[0], self.children[1], batch,
+                           one_based=True)
+
+
+def _extract_at(arr_e: ec.Expression, idx_e: ec.Expression,
+                batch: ColumnarBatch, one_based: bool):
+    col: ListColumn = ec.eval_as_column(arr_e, batch)
+    idx_col = ec.eval_as_column(idx_e, batch)
+    cap = col.capacity
+    starts = col.offsets[:-1]
+    lens = (col.offsets[1:] - starts).astype(jnp.int32)
+    raw = idx_col.data.astype(jnp.int32)
+    if one_based:
+        # 1-based; negative indexes from the end; 0 is invalid -> null
+        pos = jnp.where(raw > 0, raw - 1, lens + raw)
+        ok_idx = raw != 0
+    else:
+        pos = raw
+        ok_idx = raw >= 0
+    in_bounds = (pos >= 0) & (pos < lens)
+    valid = col.validity & idx_col.validity & ok_idx & in_bounds
+    src = starts + jnp.where(in_bounds, pos, 0)
+    # gather with one index per output row -> result capacity == cap
+    elems = col.elements.gather(jnp.where(valid, src, 0))
+    return elems.mask_validity(valid)
+
+
+class ArrayContains(ec.Expression):
+    """array_contains(arr, value).
+
+    Reference: collectionOperations.scala GpuArrayContains.  Spark
+    semantics: null if the array is null; true if any element equals the
+    value; null if no match but the array has null elements.
+    """
+
+    def __init__(self, child: ec.Expression, value: ec.Expression):
+        self.children = [child, value]
+
+    def with_children(self, c):
+        return ArrayContains(c[0], c[1])
+
+    def dtype(self):
+        return T.BOOL
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        col: ListColumn = ec.eval_as_column(self.children[0], batch)
+        needle = self.children[1].columnar_eval(batch)
+        cap = col.capacity
+        ecap = col.elements.capacity
+        seg = lk.segment_ids_for(col.offsets, ecap)
+        seg_rows = jnp.clip(seg, 0, cap - 1)
+        evalid = col.elements.validity
+        if isinstance(needle, ec.Scalar):
+            # Spark: a null needle yields NULL for every non-null array
+            needle_valid = jnp.full(cap, needle.value is not None)
+            needle = needle.to_column(cap, batch.num_rows)
+        else:
+            needle_valid = needle.validity
+        if isinstance(col.elements, StringColumn):
+            from ..kernels import strings as sk
+            nw = max(sk.needed_key_words(col.elements,
+                                         col.elements.capacity),
+                     sk.needed_key_words(needle, batch.num_rows))
+            ewords = sk._pack_words(col.elements.offsets, col.elements.data,
+                                    nw)
+            nwords = sk._pack_words(needle.offsets, needle.data, nw)
+            eq = jnp.all(ewords == jnp.take(nwords, seg_rows, axis=0),
+                         axis=1)
+            elens = col.elements.offsets[1:] - col.elements.offsets[:-1]
+            nlens = needle.offsets[1:] - needle.offsets[:-1]
+            eq = eq & (elens == jnp.take(nlens, seg_rows))
+        else:
+            # broadcast each row's needle value over its segment
+            eq = (col.elements.data ==
+                  jnp.take(needle.data, seg_rows).astype(
+                      col.elements.data.dtype))
+        eq = eq & jnp.take(needle_valid, seg_rows)
+        hit = lk.segmented_any(eq & evalid, seg, cap + 1)[:cap]
+        has_null_elem = lk.segmented_any(~evalid & (seg < cap), seg,
+                                         cap + 1)[:cap]
+        valid = col.validity & needle_valid[:cap] & (hit | ~has_null_elem)
+        return Column(T.BOOL, hit, valid)
+
+
+class SortArray(ec.Expression):
+    """sort_array(arr, asc) — sorts each list; nulls first when ascending,
+    last when descending (Spark semantics).
+
+    Reference: collectionOperations.scala GpuSortArray.
+    """
+
+    def __init__(self, child: ec.Expression, asc: bool = True):
+        self.children = [child]
+        self.asc = asc
+
+    def with_children(self, c):
+        return SortArray(c[0], self.asc)
+
+    def dtype(self):
+        return self.children[0].dtype()
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        col: ListColumn = ec.eval_as_column(self.children[0], batch)
+        ecap = col.elements.capacity
+        seg = lk.segment_ids_for(col.offsets, ecap)
+        n_elems = int(np.asarray(col.offsets)[min(batch.num_rows,
+                                                  col.capacity)])
+        words = canon.value_words(col.elements, n_elems)
+        # fold multi-word keys (strings) into one rank via stable repeated
+        # sorts: sort by least-significant word first
+        perm = jnp.arange(ecap)
+        evalid = col.elements.validity
+        # LSD passes: least-significant word first, each pass stable, so the
+        # final pass (null rank + segment) dominates
+        for w in reversed(words):
+            k = w if self.asc else ~w
+            k = jnp.take(k, perm)
+            segp = jnp.take(seg, perm)
+            order = jnp.lexsort((k, segp.astype(jnp.uint32)))
+            perm = jnp.take(perm, order)
+        # final pass: null rank then segment (stable keeps value order)
+        nk = jnp.where(evalid, jnp.uint64(1), jnp.uint64(0)) if self.asc \
+            else jnp.where(evalid, jnp.uint64(0), jnp.uint64(1))
+        nkp = jnp.take(nk, perm)
+        segp = jnp.take(seg, perm)
+        order = jnp.lexsort((nkp, segp.astype(jnp.uint32)))
+        perm = jnp.take(perm, order)
+        elems = col.elements.gather(perm)
+        return ListColumn(col.dtype, col.offsets, elems, col.validity)
+
+
+class ArrayMin(ec.Expression):
+    """array_min — segmented min ignoring nulls."""
+
+    def __init__(self, child: ec.Expression):
+        self.children = [child]
+
+    def with_children(self, c):
+        return ArrayMin(c[0])
+
+    def dtype(self):
+        return self.children[0].dtype().element_type
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        return _seg_minmax(self.children[0], batch, is_min=True)
+
+
+class ArrayMax(ec.Expression):
+    def __init__(self, child: ec.Expression):
+        self.children = [child]
+
+    def with_children(self, c):
+        return ArrayMax(c[0])
+
+    def dtype(self):
+        return self.children[0].dtype().element_type
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        return _seg_minmax(self.children[0], batch, is_min=False)
+
+
+def _seg_minmax(arr_e, batch, is_min: bool):
+    import jax
+    col: ListColumn = ec.eval_as_column(arr_e, batch)
+    cap = col.capacity
+    ecap = col.elements.capacity
+    seg = lk.segment_ids_for(col.offsets, ecap)
+    dt = col.dtype.element_type
+    data = col.elements.data
+    evalid = col.elements.validity
+    if dt.is_fractional:
+        # Spark float total order: NaN greatest, -0.0 == 0.0
+        data = jnp.where(data == 0.0, jnp.array(0.0, data.dtype), data)
+        nan = jnp.isnan(data)
+        neutral = jnp.array(jnp.inf if is_min else -jnp.inf, data.dtype)
+        masked = jnp.where(evalid & ~nan, data, neutral)
+        fn = jax.ops.segment_min if is_min else jax.ops.segment_max
+        red = fn(masked, seg, num_segments=cap + 1)[:cap]
+        if is_min:
+            has_num = lk.segmented_any(evalid & ~nan, seg, cap + 1)[:cap]
+            red = jnp.where(has_num, red, jnp.array(jnp.nan, data.dtype))
+        else:
+            has_nan = lk.segmented_any(evalid & nan, seg, cap + 1)[:cap]
+            red = jnp.where(has_nan, jnp.array(jnp.nan, data.dtype), red)
+        any_valid = lk.segmented_any(evalid, seg, cap + 1)[:cap]
+        return Column(dt, red, col.validity & any_valid)
+    if dt == T.BOOL:
+        neutral = is_min  # True for min, False for max
+    else:
+        info = np.iinfo(dt.np_dtype)
+        neutral = info.max if is_min else info.min
+    masked = jnp.where(evalid, data, jnp.asarray(neutral, data.dtype))
+    fn = jax.ops.segment_min if is_min else jax.ops.segment_max
+    red = fn(masked, seg, num_segments=cap + 1)[:cap]
+    any_valid = lk.segmented_any(evalid, seg, cap + 1)[:cap]
+    return Column(dt, red.astype(data.dtype), col.validity & any_valid)
+
+
+class Explode(ec.Expression):
+    """Generator marker — consumed by the Generate exec only.
+
+    Reference: GpuExplode in GpuGenerateExec.scala.
+    """
+
+    def __init__(self, child: ec.Expression, pos: bool = False,
+                 outer: bool = False):
+        self.children = [child]
+        self.pos = pos
+        self.outer = outer
+
+    def with_children(self, c):
+        return Explode(c[0], self.pos, self.outer)
+
+    def dtype(self):
+        return self.children[0].dtype().element_type
+
+    def columnar_eval(self, batch):
+        raise RuntimeError(
+            "Explode is a generator; it must be planned into a Generate "
+            "node (DataFrame.select handles this)")

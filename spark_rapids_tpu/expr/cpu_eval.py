@@ -560,3 +560,130 @@ _DISPATCH = {
     DT.DateSub: _date_sub,
     DT.DateDiff: _date_diff,
 }
+
+
+# -- collection expressions (independent pylist oracle) ----------------------
+
+def _pylist_of(e, t):
+    v = _arr(cpu_eval(e, t), t.num_rows)
+    if isinstance(v, pa.ChunkedArray):
+        v = v.combine_chunks()
+    return v.to_pylist()
+
+
+def _coll_create_array(e, t):
+    from . import collections as CO
+    kids = [_pylist_of(c, t) for c in e.children]
+    n = t.num_rows
+    rows = [[k[i] for k in kids] for i in range(n)]
+    return pa.array(rows, type=to_arrow_type(e.dtype()))
+
+
+def _coll_size(e, t):
+    vals = _pylist_of(e.children[0], t)
+    return pa.array([(-1 if v is None else len(v)) for v in vals],
+                    type=pa.int32())
+
+
+def _coll_get_item(e, t):
+    arrs = _pylist_of(e.children[0], t)
+    idxs = _pylist_of(e.children[1], t)
+    out = []
+    for a, i in zip(arrs, idxs):
+        if a is None or i is None or i < 0 or i >= len(a):
+            out.append(None)
+        else:
+            out.append(a[i])
+    return pa.array(out, type=to_arrow_type(e.dtype()))
+
+
+def _coll_element_at(e, t):
+    arrs = _pylist_of(e.children[0], t)
+    idxs = _pylist_of(e.children[1], t)
+    out = []
+    for a, i in zip(arrs, idxs):
+        if a is None or i is None or i == 0:
+            out.append(None)
+            continue
+        j = i - 1 if i > 0 else len(a) + i
+        out.append(a[j] if 0 <= j < len(a) else None)
+    return pa.array(out, type=to_arrow_type(e.dtype()))
+
+
+def _coll_contains(e, t):
+    arrs = _pylist_of(e.children[0], t)
+    needles = _pylist_of(e.children[1], t)
+    out = []
+    for a, nd in zip(arrs, needles):
+        if a is None or nd is None:
+            out.append(None)
+        elif nd in [x for x in a if x is not None]:
+            out.append(True)
+        elif any(x is None for x in a):
+            out.append(None)
+        else:
+            out.append(False)
+    return pa.array(out, type=pa.bool_())
+
+
+def _coll_sort_array(e, t):
+    import math
+
+    def key(x):
+        if isinstance(x, float):
+            if math.isnan(x):
+                return (1, 0.0)
+            return (0, x + 0.0)
+        return (0, x)
+
+    arrs = _pylist_of(e.children[0], t)
+    out = []
+    for a in arrs:
+        if a is None:
+            out.append(None)
+            continue
+        vals = sorted([x for x in a if x is not None], key=key,
+                      reverse=not e.asc)
+        nulls = [None] * (len(a) - len(vals))
+        out.append(nulls + vals if e.asc else vals + nulls)
+    return pa.array(out, type=to_arrow_type(e.dtype()))
+
+
+def _coll_minmax(is_min):
+    import math
+
+    def key(x):
+        # Spark float total order: NaN greatest, -0.0 == 0.0
+        if isinstance(x, float):
+            if math.isnan(x):
+                return (1, 0.0)
+            return (0, x + 0.0)
+        return (0, x)
+
+    def f(e, t):
+        arrs = _pylist_of(e.children[0], t)
+        out = []
+        for a in arrs:
+            vals = [x for x in (a or []) if x is not None]
+            if a is None or not vals:
+                out.append(None)
+            else:
+                out.append(min(vals, key=key) if is_min
+                           else max(vals, key=key))
+        return pa.array(out, type=to_arrow_type(e.dtype()))
+    return f
+
+
+def _register_collections():
+    from . import collections as CO
+    _DISPATCH[CO.CreateArray] = _coll_create_array
+    _DISPATCH[CO.Size] = _coll_size
+    _DISPATCH[CO.GetArrayItem] = _coll_get_item
+    _DISPATCH[CO.ElementAt] = _coll_element_at
+    _DISPATCH[CO.ArrayContains] = _coll_contains
+    _DISPATCH[CO.SortArray] = _coll_sort_array
+    _DISPATCH[CO.ArrayMin] = _coll_minmax(True)
+    _DISPATCH[CO.ArrayMax] = _coll_minmax(False)
+
+
+_register_collections()

@@ -81,25 +81,20 @@ def _type_extreme(dtype, want_max: bool):
     return jnp.array(info.max if not want_max else info.min, dtype)
 
 
-def _float_decode(words, dtype):
-    from .canon import SIGN64
-    s64 = jnp.uint64(SIGN64)
-    sign = (words & s64) != 0
-    bits = jnp.where(sign, words & ~s64, ~words)
-    return bits.view(jnp.float64).astype(dtype)
-
-
 def seg_min(plan: GroupPlan, values, validity):
     cap = values.shape[0]
     v, ok = _sorted_vals(plan, values, validity)
     if jnp.issubdtype(v.dtype, jnp.floating):
-        # Spark total order: NaN greatest, -0.0 == 0.0 — min/max through
-        # the canonical uint64 encoding (kernels/canon.py)
-        from .canon import _float_to_words
-        enc = _float_to_words(v)
-        contrib = jnp.where(ok, enc, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        # Spark total order: NaN greatest, -0.0 == 0.0.  No bit encoding
+        # (the chip cannot bitcast f64): min over non-NaN values, falling
+        # back to NaN only when a group has nothing else.
+        v = jnp.where(v == 0.0, jnp.array(0.0, v.dtype), v)
+        nan = jnp.isnan(v)
+        contrib = jnp.where(ok & ~nan, v, jnp.array(jnp.inf, v.dtype))
         m = jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
-        return _float_decode(m, v.dtype)
+        has_num = jax.ops.segment_max((ok & ~nan).astype(jnp.int32),
+                                      plan.seg_id, num_segments=cap) > 0
+        return jnp.where(has_num, m, jnp.array(jnp.nan, v.dtype))
     ident = _type_extreme(v.dtype, want_max=False)
     contrib = jnp.where(ok, v, ident)
     return jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
@@ -109,11 +104,14 @@ def seg_max(plan: GroupPlan, values, validity):
     cap = values.shape[0]
     v, ok = _sorted_vals(plan, values, validity)
     if jnp.issubdtype(v.dtype, jnp.floating):
-        from .canon import _float_to_words
-        enc = _float_to_words(v)
-        contrib = jnp.where(ok, enc, jnp.uint64(0))
+        # NaN is the greatest value: any NaN in the group wins
+        v = jnp.where(v == 0.0, jnp.array(0.0, v.dtype), v)
+        nan = jnp.isnan(v)
+        contrib = jnp.where(ok & ~nan, v, jnp.array(-jnp.inf, v.dtype))
         m = jax.ops.segment_max(contrib, plan.seg_id, num_segments=cap)
-        return _float_decode(m, v.dtype)
+        has_nan = jax.ops.segment_max((ok & nan).astype(jnp.int32),
+                                      plan.seg_id, num_segments=cap) > 0
+        return jnp.where(has_nan, jnp.array(jnp.nan, v.dtype), m)
     ident = _type_extreme(v.dtype, want_max=True)
     contrib = jnp.where(ok, v, ident)
     return jax.ops.segment_max(contrib, plan.seg_id, num_segments=cap)

@@ -41,16 +41,51 @@ def _ints_to_words(data, nbits: int):
             else x.astype(jnp.uint64)) ^ jnp.uint64(SIGN64)
 
 
-def _float_to_words(data):
+def _f32_order_word(x) -> jnp.ndarray:
+    """f32 array -> one u64 word whose unsigned order == numeric order
+    (NaNs canonicalized greatest, -0.0 == 0.0)."""
+    x = jnp.where(jnp.isnan(x), jnp.float32(jnp.nan), x)
+    x = jnp.where(x == 0.0, jnp.float32(0.0), x)
+    bits = x.view(jnp.uint32)
+    sign = (bits & jnp.uint32(0x80000000)) != 0
+    w = jnp.where(sign, ~bits, bits | jnp.uint32(0x80000000))
+    return w.astype(jnp.uint64)
+
+
+def _f64_bitcast_supported() -> bool:
+    """Real TPUs have no f64 ALU: XLA emulates f64 as an f32 pair and
+    cannot lower a 64-bit float bitcast.  CPU (tests, virtual meshes)
+    can, and there the single-word encoding is exact for full binary64."""
+    import jax
+    return jax.default_backend() == "cpu"
+
+
+def _float_to_words(data) -> List[jnp.ndarray]:
+    if data.dtype == jnp.dtype(jnp.float32):
+        return [_f32_order_word(data)]
     f64 = data.astype(jnp.float64)
     # canonicalize: all NaNs -> +NaN quiet; -0.0 -> 0.0
     f64 = jnp.where(jnp.isnan(f64), jnp.float64(jnp.nan), f64)
     f64 = jnp.where(f64 == 0.0, jnp.float64(0.0), f64)
-    bits = f64.view(jnp.uint64)
-    sign = (bits & jnp.uint64(SIGN64)) != 0
-    flipped = jnp.where(sign, ~bits, bits | jnp.uint64(SIGN64))
-    # place +NaN above +inf (flipping already does since NaN mantissa != 0)
-    return flipped
+    if _f64_bitcast_supported():
+        bits = f64.view(jnp.uint64)
+        sign = (bits & jnp.uint64(SIGN64)) != 0
+        flipped = jnp.where(sign, ~bits, bits | jnp.uint64(SIGN64))
+        # +NaN lands above +inf (flip keeps NaN mantissa bits set)
+        return [flipped]
+    # On chip: the emulated f64 is a double-double (hi, lo) f32 pair, so
+    # the exact order of representable values is the lexicographic order
+    # of the order-words of (hi, lo, residual) — three u32 bitcasts, each
+    # of which the chip CAN do.  The residual word covers the few bits a
+    # second rounding can still hold.
+    hi64 = f64.astype(jnp.float32).astype(jnp.float64)
+    ok = jnp.isfinite(f64) & jnp.isfinite(hi64)
+    rem1 = jnp.where(ok, f64 - hi64, 0.0)
+    lo64 = rem1.astype(jnp.float32).astype(jnp.float64)
+    rem2 = jnp.where(ok, rem1 - lo64, 0.0)
+    return [_f32_order_word(f64.astype(jnp.float32)),
+            _f32_order_word(rem1.astype(jnp.float32)),
+            _f32_order_word(rem2.astype(jnp.float32))]
 
 
 def column_key_words(col: Column, num_rows: int, *, descending: bool = False,
@@ -93,7 +128,7 @@ def value_words(col: Column, num_rows: int,
                                                                  T.TIMESTAMP):
         return [_ints_to_words(col.data, 64)]
     if dt.is_fractional:
-        return [_float_to_words(col.data)]
+        return _float_to_words(col.data)
     if dt == T.NULL:
         return [jnp.zeros(col.capacity, jnp.uint64)]
     raise NotImplementedError(f"key encoding for {dt}")

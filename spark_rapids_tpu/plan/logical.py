@@ -307,18 +307,28 @@ class Expand(LogicalPlan):
 
 
 class Generate(LogicalPlan):
-    """explode/posexplode (reference: GpuGenerateExec)."""
+    """explode/posexplode (reference: GpuGenerateExec.scala).
 
-    def __init__(self, generator_col: str, output_name_: str,
-                 child: LogicalPlan, pos: bool = False):
-        self.generator_col = generator_col
-        self.output_name = output_name_
-        self.pos = pos
+    Output = the required child columns followed by [pos,] value columns
+    of the generator, mirroring Spark's GenerateExec contract.
+    """
+
+    def __init__(self, generator, output_names: List[str],
+                 child: LogicalPlan):
+        # generator: expr.collections.Explode (pos/outer flags live on it)
+        self.generator = generator
+        self.output_names = list(output_names)
         self.children = [child]
 
     @property
     def schema(self):
+        from ..columnar import dtypes as T
         base = [f for f in self.children[0].schema.fields]
+        names = list(self.output_names)
+        if self.generator.pos:
+            base.append(Field(names.pop(0), T.INT32, self.generator.outer))
+        elem = self.generator.dtype()
+        base.append(Field(names.pop(0), elem, True))
         return Schema(base)
 
 

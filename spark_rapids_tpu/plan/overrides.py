@@ -74,7 +74,7 @@ def expr_rule(cls, sig: TS.TypeSig):
 
 
 for _cls in [ec.AttributeReference, ec.BoundReference, ec.Literal, ec.Alias]:
-    expr_rule(_cls, TS.ALL_SUPPORTED)
+    expr_rule(_cls, TS.WITH_ARRAYS)
 for _cls in [ea.Add, ea.Subtract, ea.Multiply, ea.Divide, ea.IntegralDivide,
              ea.Remainder, ea.Pmod, ea.UnaryMinus, ea.UnaryPositive, ea.Abs,
              ea.Least, ea.Greatest, ea.Round]:
@@ -117,6 +117,16 @@ for _cls in [emisc.Murmur3Hash, emisc.Md5, emisc.MonotonicallyIncreasingID,
 for _cls in [eagg.Sum, eagg.Count, eagg.Min, eagg.Max, eagg.Average,
              eagg.First, eagg.Last]:
     expr_rule(_cls, TS.ALL_SUPPORTED)
+# collection expressions (collectionOperations.scala registrations,
+# GpuOverrides.scala:773+)
+from ..expr import collections as ecoll  # noqa: E402
+for _cls in [ecoll.CreateArray, ecoll.GetArrayItem, ecoll.ElementAt,
+             ecoll.SortArray, ecoll.Explode]:
+    expr_rule(_cls, TS.WITH_ARRAYS)
+expr_rule(ecoll.Size, TS.WITH_ARRAYS + TS.INTEGRAL)
+expr_rule(ecoll.ArrayContains, TS.BOOLEAN)
+expr_rule(ecoll.ArrayMin, TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
+expr_rule(ecoll.ArrayMax, TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
 
 # Python UDFs stay on the columnar plan with an Arrow host exchange,
 # the GpuArrowEvalPythonExec model (SURVEY.md §2.8)
@@ -208,6 +218,10 @@ class PlanMeta:
             return [o.expr for o in p.orders]
         if isinstance(p, L.Repartition):
             return list(p.by_exprs or [])
+        if isinstance(p, L.Generate):
+            return [p.generator]
+        if isinstance(p, L.Expand):
+            return [e for proj in p.projections for e in proj]
         if isinstance(p, L.Window):
             out = []
             for wf in p.window_funcs:
@@ -226,10 +240,35 @@ class PlanMeta:
         # per-node checks
         p = self.plan
         for f in p.schema:
-            if f.dtype.is_nested:
+            if not TS.WITH_ARRAYS.supports(f.dtype) and \
+                    f.dtype.is_nested:
                 self.reasons.append(
                     f"output column {f.name}: nested type {f.dtype.name} "
                     f"not yet device-resident")
+        # array columns may flow through, but cannot be sort/group/join/
+        # partition keys (canonical key words cover scalars only)
+        def _keys_orderable(exprs, what):
+            for e in exprs:
+                try:
+                    dt = e.dtype()
+                except (ValueError, NotImplementedError):
+                    continue
+                if not TS.ORDERABLE.supports(dt):
+                    self.reasons.append(
+                        f"{what} key of type {dt.name} not supported on TPU")
+        if isinstance(p, L.Aggregate):
+            _keys_orderable(p.group_exprs, "group-by")
+        if isinstance(p, L.Sort):
+            _keys_orderable([o.expr for o in p.orders], "sort")
+        if isinstance(p, L.Join):
+            _keys_orderable(list(p.left_keys) + list(p.right_keys), "join")
+        if isinstance(p, L.Repartition):
+            _keys_orderable(list(p.by_exprs or []), "partition")
+        if isinstance(p, L.Window):
+            for wf in p.window_funcs:
+                _keys_orderable(wf.spec.partition_by, "window partition")
+                _keys_orderable([o.expr for o in wf.spec.order_by],
+                                "window order")
         if isinstance(p, L.Window):
             from ..expr import window_funcs as wfn
             for wf in p.window_funcs:
@@ -360,6 +399,8 @@ class Planner:
         if isinstance(p, L.Window):
             from ..exec.cpu_window import CpuWindow
             return CpuWindow(p, children[0])
+        if isinstance(p, L.Generate):
+            return X.CpuGenerate(p, children[0])
         if isinstance(p, L.Scan):
             from ..io.planner import cpu_scan_exec
             return cpu_scan_exec(p, self.conf)
@@ -430,6 +471,9 @@ class Planner:
         if isinstance(p, L.Expand):
             from ..exec.tpu_expand import TpuExpand
             return TpuExpand(p, children[0])
+        if isinstance(p, L.Generate):
+            from ..exec.tpu_generate import TpuGenerate
+            return TpuGenerate(p, children[0])
         raise NotImplementedError(f"no TPU conversion for {p.name}")
 
     def _plan_window(self, p: L.Window, child: PhysicalPlan) -> PhysicalPlan:

@@ -27,6 +27,10 @@ class TypeSig:
     def supports(self, dt: T.DType) -> bool:
         if isinstance(dt, T.DecimalType):
             return self.decimal
+        if isinstance(dt, T.ArrayType):
+            # arrays are supported when listed AND the element type is
+            return T.ArrayType in self.kinds and self.supports(
+                dt.element_type)
         return type(dt) in self.kinds
 
     def reason(self, dt: T.DType, context: str) -> Optional[str]:
@@ -52,10 +56,15 @@ STRING_SIG = TypeSig([T.StringType])
 DATETIME = TypeSig([T.DateType, T.TimestampType])
 NULL_SIG = TypeSig([T.NullType])
 
-# everything the columnar substrate can materialize today
+# scalar types every op can handle
 ALL_SUPPORTED = (BOOLEAN + NUMERIC + DECIMAL_64 + STRING_SIG + DATETIME +
                  NULL_SIG)
-# orderable == groupable == joinable (canonical key words cover all of these)
+ARRAY_SIG = TypeSig([T.ArrayType])
+# scalars + arrays of them: only for ops that understand ListColumn
+# (references, aliases, the collection expressions)
+WITH_ARRAYS = ALL_SUPPORTED + ARRAY_SIG
+# orderable == groupable == joinable (canonical key words cover scalars
+# only; arrays cannot be sort/join keys yet)
 ORDERABLE = ALL_SUPPORTED
-# nested types are not yet device-resident
-UNSUPPORTED_NESTED = TypeSig([T.ArrayType, T.StructType, T.MapType])
+# structs/maps are not yet device-resident
+UNSUPPORTED_NESTED = TypeSig([T.StructType, T.MapType])
