@@ -148,6 +148,11 @@ class RapidsShuffleClient:
             states = list(self._receive_states)
         for s in states:
             s.on_data(tag, offset, payload)
+        # prune fully-drained receive states so a long-lived client
+        # doesn't accumulate one state per completed fetch
+        with self._lock:
+            self._receive_states = [s for s in self._receive_states
+                                    if s.num_pending]
 
     # -- fetch state machine ----------------------------------------------
     def do_fetch(self, blocks: List[BlockIdSpec],

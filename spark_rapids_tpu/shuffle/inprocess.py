@@ -32,7 +32,10 @@ class _Endpoint:
         self.executor_id = executor_id
         self.metadata_handler: Optional[Callable] = None
         self.transfer_handler: Optional[Callable] = None
-        self.data_handlers: Dict[str, Callable] = {}   # by sender peer -> fn
+        # sender peer -> [fn]: additive, like tag-matched receives on a
+        # real wire — every client fetching from that peer registers its
+        # own dispatcher and claims payloads by tag
+        self.data_handlers: Dict[str, list] = {}
         self._queue: "queue.Queue" = queue.Queue()
         self._thread = threading.Thread(
             target=self._progress_loop, daemon=True,
@@ -153,7 +156,8 @@ class InProcessClientConnection(ClientConnection):
 
     def register_data_handler(self, handler):
         ep = self.registry.endpoint(self.local_id)
-        ep.data_handlers[self.peer_executor_id] = handler
+        ep.data_handlers.setdefault(self.peer_executor_id, []).append(
+            handler)
 
 
 class InProcessServerConnection(ServerConnection):
@@ -180,8 +184,7 @@ class InProcessServerConnection(ServerConnection):
         payload = bytes(data)   # copy out of the bounce buffer NOW
 
         def _deliver():
-            fn = peer.data_handlers.get(self.local_id)
-            if fn is not None:
+            for fn in list(peer.data_handlers.get(self.local_id, ())):
                 fn(tag, offset, payload)
             tx.complete_success(len(payload))
 

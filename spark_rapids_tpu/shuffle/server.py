@@ -70,26 +70,27 @@ class BufferSendState:
         conn = self.server.transport.server_connection()
         while self.windows.has_next():
             ranges = next(self.windows)
+            # the acquired buffer bounds in-flight windows (flow control);
+            # the payload is sliced straight from the source blob — one
+            # copy, since the in-process wire snapshots bytes on send
             bounce = self.server.bounce_buffers.acquire(blocking=True)
-            window_pos = 0
             sends = []
             for r in ranges:
-                if r.length:
-                    chunk = self.blobs[r.block_index][
-                        r.block_offset:r.block_offset + r.length]
-                    bounce.buffer[window_pos:window_pos + r.length] = \
-                        bytearray(chunk)
-                # send straight from the staging buffer slice
-                payload = bytes(
-                    bounce.buffer[window_pos:window_pos + r.length])
+                payload = self.blobs[r.block_index][
+                    r.block_offset:r.block_offset + r.length]
                 tag = self.req.tags[r.block_index]
                 sends.append(conn.send_data(self.peer, tag, r.block_offset,
                                             payload))
-                window_pos += r.length
                 self.bytes_sent += r.length
             for t in sends:
-                t.wait_for_completion(timeout=self.server.send_timeout)
-                if t.status.value == "error":
+                done = t.wait_for_completion(
+                    timeout=self.server.send_timeout)
+                if not done:
+                    # still PENDING: surface the timeout instead of
+                    # silently recycling the window
+                    self.error = (f"send to {self.peer} timed out after "
+                                  f"{self.server.send_timeout}s")
+                elif t.status.value == "error":
                     self.error = t.error_message
             bounce.close()
             if self.error:
@@ -154,9 +155,10 @@ class CatalogRequestHandler(ShuffleRequestHandler):
 
     def __init__(self, catalog):
         self.catalog = catalog
-        # blob cache so metadata+transfer don't flatten twice; entries are
-        # dropped once served
+        # blob cache so metadata+transfer don't flatten twice; each blob
+        # entry is dropped as it is served (a retry re-flattens)
         self._meta_cache: Dict = {}
+        self._cache_lock = threading.Lock()
 
     def _flatten(self, block: BlockIdSpec):
         from .manager import ShuffleBlockId
@@ -166,13 +168,20 @@ class CatalogRequestHandler(ShuffleRequestHandler):
 
     def tables_for_block(self, block: BlockIdSpec) -> List[TableMeta]:
         pairs = self._flatten(block)
-        self._meta_cache[block] = [blob for _, blob in pairs]
+        with self._cache_lock:
+            self._meta_cache[block] = [blob for _, blob in pairs]
         return [meta for meta, _ in pairs]
 
     def acquire_table_blob(self, block: BlockIdSpec,
                            batch_index: int) -> bytes:
-        blobs = self._meta_cache.get(block)
-        if blobs is None:
-            blobs = [blob for _, blob in self._flatten(block)]
-            self._meta_cache[block] = blobs
+        with self._cache_lock:
+            blobs = self._meta_cache.get(block)
+            if blobs is not None:
+                blob = blobs[batch_index]
+                if blob is not None:
+                    blobs[batch_index] = None  # served: release the ref
+                    if all(b is None for b in blobs):
+                        del self._meta_cache[block]
+                    return blob
+        blobs = [blob for _, blob in self._flatten(block)]
         return blobs[batch_index]

@@ -341,6 +341,40 @@ class TestEndToEndExchange:
         assert len(out1) == 1
         assert out1[0].to_pydict() == b1.to_pydict()
 
+    def test_concurrent_reduce_tasks_same_peer(self, fresh_registry):
+        """Two reduce tasks on one executor fetching from the same peer:
+        each client's data handler must keep receiving (registration is
+        additive, not a single clobbered slot)."""
+        tracker = MapOutputTracker()
+        ex_a = ShuffleExecutorContext(
+            "exec-a", InProcessTransport("exec-a", fresh_registry), tracker,
+            bounce_buffer_size=64, num_bounce_buffers=2)
+        ex_b = ShuffleExecutorContext(
+            "exec-b", InProcessTransport("exec-b", fresh_registry), tracker,
+            bounce_buffer_size=64, num_bounce_buffers=2)
+        b0 = make_batch(11, seed=5)
+        b1 = make_batch(7, seed=6)
+        ex_a.write_map_output(0, 0, {0: [b0], 1: [b1]})
+
+        results = {}
+        errors = []
+
+        def fetch(pid):
+            try:
+                results[pid] = list(ex_b.read_partition(0, pid,
+                                                        timeout_s=10.0))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=fetch, args=(p,)) for p in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=20)
+        assert not errors
+        assert results[0][0].to_pydict() == b0.to_pydict()
+        assert results[1][0].to_pydict() == b1.to_pydict()
+
     def test_fetch_failure_raises_for_scheduler(self, fresh_registry):
         tracker = MapOutputTracker()
         ex_a = ShuffleExecutorContext(
