@@ -131,7 +131,13 @@ class Col:
 
     def getItem(self, key):
         from ..expr import collections as ecoll
-        return Col(ecoll.GetArrayItem(self.expr, _expr(key)))
+        if isinstance(key, Col):
+            key = key.expr
+        return Col(ecoll.ExtractValue(self.expr, key))
+
+    def getField(self, name: str):
+        from ..expr import collections as ecoll
+        return Col(ecoll.GetStructField(self.expr, name))
 
     def __getitem__(self, key):
         return self.getItem(key)

@@ -373,3 +373,36 @@ def posexplode(c) -> Col:
 def posexplode_outer(c) -> Col:
     from ..expr import collections as ecoll
     return Col(ecoll.Explode(_c(c), pos=True, outer=True))
+
+
+def struct(*cols) -> Col:
+    from ..expr import collections as ecoll
+    from ..expr.core import output_name
+    exprs = [_c(c) for c in cols]
+    names = [c if isinstance(c, str) else output_name(e)
+             for c, e in zip(cols, exprs)]
+    return Col(ecoll.CreateNamedStruct(names, *exprs))
+
+
+def named_struct(*name_col_pairs) -> Col:
+    from ..expr import collections as ecoll
+    if len(name_col_pairs) % 2:
+        raise ValueError("named_struct expects name/value pairs")
+    names = [str(n) for n in name_col_pairs[0::2]]
+    exprs = [_c(c) for c in name_col_pairs[1::2]]
+    return Col(ecoll.CreateNamedStruct(names, *exprs))
+
+
+def create_map(*cols) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.CreateMap(*[_c(c) for c in cols]))
+
+
+def map_keys(c) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.MapKeys(_c(c)))
+
+
+def map_values(c) -> Col:
+    from ..expr import collections as ecoll
+    return Col(ecoll.MapValues(_c(c)))

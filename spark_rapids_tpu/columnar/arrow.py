@@ -36,6 +36,12 @@ def to_arrow_type(dt: T.DType) -> pa.DataType:
         return pa.decimal128(dt.precision, dt.scale)
     if isinstance(dt, T.ArrayType):
         return pa.list_(to_arrow_type(dt.element_type))
+    if isinstance(dt, T.StructType):
+        return pa.struct([pa.field(f.name, to_arrow_type(f.dtype),
+                                   f.nullable) for f in dt.fields])
+    if isinstance(dt, T.MapType):
+        return pa.map_(to_arrow_type(dt.key_type),
+                       to_arrow_type(dt.value_type))
     if dt in _TO_ARROW:
         return _TO_ARROW[dt]
     raise ValueError(f"no arrow type for {dt}")
@@ -62,8 +68,16 @@ def from_arrow_type(at: pa.DataType) -> T.DType:
         return T.DATE
     if pa.types.is_timestamp(at):
         return T.TIMESTAMP
+    if pa.types.is_map(at):
+        return T.MapType(from_arrow_type(at.key_type),
+                         from_arrow_type(at.item_type))
     if pa.types.is_list(at) or pa.types.is_large_list(at):
         return T.ArrayType(from_arrow_type(at.value_type))
+    if pa.types.is_struct(at):
+        return T.StructType([T.StructField(at.field(i).name,
+                                           from_arrow_type(at.field(i).type),
+                                           at.field(i).nullable)
+                             for i in range(at.num_fields)])
     if pa.types.is_decimal(at):
         if at.precision > T.DecimalType.MAX_PRECISION:
             raise ValueError(f"decimal precision {at.precision} > 18")
@@ -82,7 +96,26 @@ def schema_to_arrow(schema: Schema) -> pa.Schema:
 
 
 def column_to_arrow(col: Column, num_rows: int) -> pa.Array:
-    from .column import ListColumn
+    from .column import ListColumn, MapColumn, StructColumn
+    if isinstance(col, MapColumn):
+        offs = np.asarray(col.offsets)[:num_rows + 1].astype(np.int64)
+        valid = np.asarray(col.validity)[:num_rows]
+        n_elems = int(offs[num_rows]) if num_rows else 0
+        keys = column_to_arrow(col.keys, n_elems)
+        items = column_to_arrow(col.values, n_elems)
+        if valid.all():
+            arrow_offs = pa.array(offs, type=pa.int32())
+        else:
+            arrow_offs = pa.array(
+                [int(offs[i]) if i == num_rows or valid[i] else None
+                 for i in range(num_rows + 1)], type=pa.int32())
+        return pa.MapArray.from_arrays(arrow_offs, keys, items)
+    if isinstance(col, StructColumn):
+        valid = np.asarray(col.validity)[:num_rows]
+        kids = [column_to_arrow(c, num_rows) for c in col.children]
+        names = [f.name for f in col.dtype.fields]
+        return pa.StructArray.from_arrays(
+            kids, names, mask=pa.array(~valid, type=pa.bool_()))
     if isinstance(col, ListColumn):
         offs = np.asarray(col.offsets)[:num_rows + 1].astype(np.int64)
         valid = np.asarray(col.validity)[:num_rows]
@@ -131,6 +164,48 @@ def column_from_arrow(arr: pa.ChunkedArray | pa.Array,
     dt = from_arrow_type(arr.type)
     n = len(arr)
     cap = capacity or bucket_capacity(n)
+    if isinstance(dt, T.MapType):
+        from .column import MapColumn, StructColumn
+        import jax.numpy as jnp
+        valid_np = np.ones(n, dtype=bool) if arr.null_count == 0 else \
+            np.asarray(arr.is_valid())
+        raw = np.asarray(arr.offsets.fill_null(0)).astype(np.int64)
+        lens = np.where(valid_np, raw[1:] - raw[:-1], 0)
+        offs = np.zeros(n + 1, np.int32)
+        offs[1:] = np.cumsum(lens)
+        # keys/items are unsliced child arrays addressed by raw offsets;
+        # take the live entries per row to match the rebuilt offsets
+        take = np.concatenate(
+            [np.arange(raw[i], raw[i + 1])
+             for i in range(n) if valid_np[i]] or
+            [np.zeros(0, np.int64)])
+        keys = arr.keys.take(pa.array(take)) if len(take) else \
+            arr.keys.slice(0, 0)
+        items = arr.items.take(pa.array(take)) if len(take) else \
+            arr.items.slice(0, 0)
+        est = MapColumn.entry_struct_type(dt)
+        n_e = len(keys)
+        ecap = bucket_capacity(max(1, n_e))
+        kcol = column_from_arrow(keys, capacity=ecap)
+        vcol = column_from_arrow(items, capacity=ecap)
+        elems = StructColumn(est, [kcol, vcol],
+                             jnp.asarray(np.arange(ecap) < n_e))
+        out_offs = np.full(cap + 1, offs[n] if n else 0, np.int32)
+        out_offs[:n + 1] = offs[:n + 1]
+        out_valid = np.zeros(cap, bool)
+        out_valid[:n] = valid_np
+        return MapColumn(dt, jnp.asarray(out_offs), elems,
+                         jnp.asarray(out_valid))
+    if isinstance(dt, T.StructType):
+        from .column import StructColumn
+        import jax.numpy as jnp
+        valid_np = np.ones(n, dtype=bool) if arr.null_count == 0 else \
+            np.asarray(arr.is_valid())
+        kids = [column_from_arrow(arr.field(i), capacity=cap)
+                for i in range(arr.type.num_fields)]
+        out_valid = np.zeros(cap, bool)
+        out_valid[:n] = valid_np
+        return StructColumn(dt, kids, jnp.asarray(out_valid))
     if isinstance(dt, T.ArrayType):
         from .column import ListColumn
         import jax.numpy as jnp

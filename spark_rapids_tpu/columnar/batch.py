@@ -156,7 +156,9 @@ def _concat_cols(dtype: T.DType, cols: Sequence[Column],
                  nrows: Sequence[int], cap: int) -> Column:
     if dtype == T.STRING:
         return _concat_string_cols(cols, nrows, cap)
-    if isinstance(dtype, T.ArrayType):
+    if isinstance(dtype, T.StructType):
+        return _concat_struct_cols(dtype, cols, nrows, cap)
+    if isinstance(dtype, (T.ArrayType, T.MapType)):
         return _concat_list_cols(cols, nrows, cap)
     datas = [c.data[:n] for c, n in zip(cols, nrows)]
     valids = [c.validity[:n] for c, n in zip(cols, nrows)]
@@ -171,20 +173,40 @@ def _concat_cols(dtype: T.DType, cols: Sequence[Column],
 
 def _slice_elements(col: Column, o0: int, o1: int) -> Column:
     """Child slice covering absolute element range [o0, o1)."""
-    from .column import ListColumn
-    if isinstance(col, ListColumn):
-        return ListColumn(col.dtype, col.offsets[o0:o1 + 1], col.elements,
-                          col.validity[o0:o1])
+    from .column import ListColumn, MapColumn, StructColumn
+    if isinstance(col, (ListColumn, MapColumn)):
+        out = type(col)(col.dtype, col.offsets[o0:o1 + 1], col.elements,
+                        col.validity[o0:o1])
+        return out
+    if isinstance(col, StructColumn):
+        return StructColumn(
+            col.dtype, [_slice_elements(c, o0, o1) for c in col.children],
+            col.validity[o0:o1])
     if isinstance(col, StringColumn):
         return StringColumn(col.offsets[o0:o1 + 1], col.data,
                             col.validity[o0:o1])
     return Column(col.dtype, col.data[o0:o1], col.validity[o0:o1])
 
 
+def _concat_struct_cols(dtype: T.StructType, cols: Sequence[Column],
+                        nrows: Sequence[int], cap: int) -> Column:
+    from .column import StructColumn
+    kids = []
+    for fi, f in enumerate(dtype.fields):
+        kids.append(_concat_cols(f.dtype,
+                                 [c.children[fi] for c in cols],
+                                 nrows, cap))
+    valid = jnp.concatenate([c.validity[:n] for c, n in zip(cols, nrows)])
+    vpad = cap - int(valid.shape[0])
+    if vpad > 0:
+        valid = jnp.pad(valid, (0, vpad))
+    return StructColumn(dtype, kids, valid)
+
+
 def _concat_list_cols(cols: Sequence[Column], nrows: Sequence[int],
                       cap: int) -> Column:
-    """Concat of ListColumns: rebase offsets, recursively concat children."""
-    from .column import ListColumn
+    """Concat of List/MapColumns: rebase offsets, recursively concat
+    children."""
     offsets_parts: List = []
     valid_parts: List = []
     child_cols: List[Column] = []
@@ -199,10 +221,9 @@ def _concat_list_cols(cols: Sequence[Column], nrows: Sequence[int],
         child_cols.append(_slice_elements(c.elements, o0, o1))
         child_ns.append(o1 - o0)
         base += o1 - o0
-    total = sum(nrows)
     child_cap = bucket_capacity(max(1, sum(child_ns)))
-    elements = _concat_cols(cols[0].dtype.element_type, child_cols,
-                            child_ns, child_cap)
+    elem_dtype = cols[0].elements.dtype
+    elements = _concat_cols(elem_dtype, child_cols, child_ns, child_cap)
     offsets = jnp.concatenate(
         offsets_parts + [jnp.array([base], jnp.int32)])
     pad = cap + 1 - int(offsets.shape[0])
@@ -212,8 +233,8 @@ def _concat_list_cols(cols: Sequence[Column], nrows: Sequence[int],
     vpad = cap - int(valid.shape[0])
     if vpad > 0:
         valid = jnp.pad(valid, (0, vpad))
-    return ListColumn(cols[0].dtype, offsets.astype(jnp.int32), elements,
-                      valid)
+    return type(cols[0])(cols[0].dtype, offsets.astype(jnp.int32), elements,
+                         valid)
 
 
 def _concat_string_cols(cols: Sequence[StringColumn], nrows: Sequence[int],

@@ -105,6 +105,17 @@ class Column:
                 dtype, jnp.zeros(capacity + 1, jnp.int32),
                 Column.all_null(dtype.element_type, MIN_CAPACITY),
                 jnp.zeros(capacity, jnp.bool_))
+        if isinstance(dtype, T.StructType):
+            return StructColumn(
+                dtype, [Column.all_null(f.dtype, capacity)
+                        for f in dtype.fields],
+                jnp.zeros(capacity, jnp.bool_))
+        if isinstance(dtype, T.MapType):
+            est = MapColumn.entry_struct_type(dtype)
+            return MapColumn(
+                dtype, jnp.zeros(capacity + 1, jnp.int32),
+                Column.all_null(est, MIN_CAPACITY),
+                jnp.zeros(capacity, jnp.bool_))
         data = jnp.zeros(capacity, dtype=dtype.np_dtype)
         return Column(dtype, data, jnp.zeros(capacity, jnp.bool_))
 
@@ -293,13 +304,7 @@ class ListColumn(Column):
             else:
                 arr = np.array(probe if probe else [0])
                 element_type = T.from_numpy_dtype(arr.dtype)
-        if isinstance(element_type, T.ArrayType):
-            elems = ListColumn.from_pylist(
-                flat, element_type=element_type.element_type)
-        elif element_type == T.STRING:
-            elems = StringColumn.from_pylist(flat)
-        else:
-            elems = Column.from_numpy(flat, dtype=element_type)
+        elems = _column_from_pylist(flat, element_type)
         return ListColumn(T.ArrayType(element_type), jnp.asarray(offsets),
                           elems, jnp.asarray(validity))
 
@@ -361,4 +366,184 @@ class ListColumn(Column):
         return [self.offsets, self.validity] + self.elements.device_buffers()
 
 
-ColumnLike = Union[Column, StringColumn, ListColumn]
+class StructColumn(Column):
+    """Struct column: one child column per field + top-level validity.
+
+    Reference analogue: cuDF STRUCT columns (complexTypeCreator.scala /
+    complexTypeExtractors.scala).  All structural ops delegate to the
+    children, so structs nest freely with lists/strings/maps.
+    """
+
+    def __init__(self, dtype: T.StructType, children: List[Column],
+                 validity):
+        self.dtype = dtype
+        self.children = children
+        self.validity = validity
+
+    @property
+    def capacity(self) -> int:
+        return int(self.validity.shape[0])
+
+    @staticmethod
+    def from_pylist(values: Sequence, dtype: T.StructType,
+                    capacity: Optional[int] = None) -> "StructColumn":
+        n = len(values)
+        cap = capacity or bucket_capacity(n)
+        validity = np.zeros(cap, dtype=np.bool_)
+        per_field: List[List] = [[] for _ in dtype.fields]
+        for i, v in enumerate(values):
+            if v is None:
+                for lst in per_field:
+                    lst.append(None)
+            else:
+                validity[i] = True
+                if isinstance(v, dict):
+                    for lst, f in zip(per_field, dtype.fields):
+                        lst.append(v.get(f.name))
+                else:
+                    for lst, x in zip(per_field, v):
+                        lst.append(x)
+        kids = [_column_from_pylist(vals, f.dtype, cap)
+                for vals, f in zip(per_field, dtype.fields)]
+        return StructColumn(dtype, kids, jnp.asarray(validity))
+
+    def to_pylist(self, num_rows: int) -> List:
+        valid = np.asarray(self.validity)[:num_rows]
+        kid_vals = [c.to_pylist(num_rows) for c in self.children]
+        names = [f.name for f in self.dtype.fields]
+        return [dict(zip(names, vals)) if ok else None
+                for ok, *vals in zip(valid, *kid_vals)] if kid_vals else \
+            [{} if ok else None for ok in valid]
+
+    def to_numpy(self, num_rows: int):
+        vals = np.empty(num_rows, dtype=object)
+        for i, v in enumerate(self.to_pylist(num_rows)):
+            vals[i] = v
+        return vals, np.asarray(self.validity)[:num_rows]
+
+    def with_capacity(self, capacity: int, num_rows: int) -> "StructColumn":
+        if capacity == self.capacity:
+            return self
+        kids = [c.with_capacity(capacity, num_rows) for c in self.children]
+        if capacity > self.capacity:
+            valid = jnp.pad(self.validity, (0, capacity - self.capacity))
+        else:
+            valid = self.validity[:capacity] & (jnp.arange(capacity) < num_rows)
+        return StructColumn(self.dtype, kids, valid)
+
+    def gather(self, indices) -> "StructColumn":
+        return StructColumn(
+            self.dtype, [c.gather(indices) for c in self.children],
+            jnp.take(self.validity, indices, axis=0, mode="clip"))
+
+    def mask_validity(self, keep_mask) -> "StructColumn":
+        return StructColumn(self.dtype, self.children,
+                            self.validity & keep_mask)
+
+    def nbytes(self) -> int:
+        return sum(c.nbytes() for c in self.children) + self.validity.nbytes
+
+    def device_buffers(self):
+        out = [self.validity]
+        for c in self.children:
+            out.extend(c.device_buffers())
+        return out
+
+
+class MapColumn(ListColumn):
+    """Map column = list<struct<key, value>> (the Arrow model).
+
+    Reference analogue: cuDF LIST<STRUCT> maps (GetMapValue in
+    complexTypeExtractors.scala).  Inherits all gather/slice mechanics
+    from ListColumn; ``elements`` is a two-field StructColumn.
+    """
+
+    def __init__(self, dtype: T.MapType, offsets, elements: StructColumn,
+                 validity):
+        self.dtype = dtype
+        self.offsets = offsets
+        self.elements = elements
+        self.validity = validity
+
+    @property
+    def keys(self) -> Column:
+        return self.elements.children[0]
+
+    @property
+    def values(self) -> Column:
+        return self.elements.children[1]
+
+    @staticmethod
+    def entry_struct_type(dtype: T.MapType) -> T.StructType:
+        return T.StructType([T.StructField("key", dtype.key_type, False),
+                             T.StructField("value", dtype.value_type, True)])
+
+    @staticmethod
+    def from_pylist(values: Sequence, dtype: T.MapType,
+                    capacity: Optional[int] = None) -> "MapColumn":
+        n = len(values)
+        cap = capacity or bucket_capacity(n)
+        validity = np.zeros(cap, dtype=np.bool_)
+        offsets = np.zeros(cap + 1, dtype=np.int32)
+        entries: List = []
+        for i, v in enumerate(values):
+            if v is not None:
+                validity[i] = True
+                items = v.items() if isinstance(v, dict) else v
+                entries.extend(tuple(kv) for kv in items)
+            offsets[i + 1] = len(entries)
+        offsets[n + 1:] = offsets[n]
+        est = MapColumn.entry_struct_type(dtype)
+        elems = StructColumn.from_pylist(entries, est)
+        return MapColumn(dtype, jnp.asarray(offsets), elems,
+                         jnp.asarray(validity))
+
+    def to_pylist(self, num_rows: int) -> List:
+        offs = np.asarray(self.offsets)
+        valid = np.asarray(self.validity)[:num_rows]
+        n_elems = int(offs[num_rows]) if num_rows else 0
+        keys = self.keys.to_pylist(n_elems) if n_elems else []
+        vals = self.values.to_pylist(n_elems) if n_elems else []
+        out: List = []
+        for i in range(num_rows):
+            if not valid[i]:
+                out.append(None)
+            else:
+                out.append(dict(zip(keys[offs[i]:offs[i + 1]],
+                                    vals[offs[i]:offs[i + 1]])))
+        return out
+
+    def with_capacity(self, capacity: int, num_rows: int) -> "MapColumn":
+        lc = ListColumn.with_capacity(self, capacity, num_rows)
+        return MapColumn(self.dtype, lc.offsets, lc.elements, lc.validity)
+
+    def gather(self, indices) -> "MapColumn":
+        lc = ListColumn.gather(self, indices)
+        return MapColumn(self.dtype, lc.offsets, lc.elements, lc.validity)
+
+    def mask_validity(self, keep_mask) -> "MapColumn":
+        return MapColumn(self.dtype, self.offsets, self.elements,
+                         self.validity & keep_mask)
+
+    def as_list(self) -> ListColumn:
+        """View as list<struct<key,value>> (for MapKeys/MapValues/Size)."""
+        return ListColumn(T.ArrayType(self.elements.dtype), self.offsets,
+                          self.elements, self.validity)
+
+
+def _column_from_pylist(values: Sequence, dtype: T.DType,
+                        capacity: Optional[int] = None) -> Column:
+    """Build any column type from a python list (host staging path)."""
+    if isinstance(dtype, T.StructType):
+        return StructColumn.from_pylist(values, dtype, capacity)
+    if isinstance(dtype, T.MapType):
+        return MapColumn.from_pylist(values, dtype, capacity)
+    if isinstance(dtype, T.ArrayType):
+        return ListColumn.from_pylist(values, dtype.element_type, capacity)
+    if dtype == T.STRING:
+        return StringColumn.from_pylist(values, capacity)
+    return Column.from_numpy(list(values), dtype=dtype, capacity=capacity)
+
+
+ColumnLike = Union[Column, StringColumn, ListColumn, StructColumn,
+                   MapColumn]

@@ -246,8 +246,26 @@ _ALIASES = {
 }
 
 
+def _split_top(s: str, sep: str = ","):
+    """Split on ``sep`` at angle-bracket/paren depth 0."""
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(s):
+        if ch in "<(":
+            depth += 1
+        elif ch in ">)":
+            depth -= 1
+        elif ch == sep and depth == 0:
+            parts.append(s[start:i])
+            start = i + 1
+    parts.append(s[start:])
+    return parts
+
+
 def dtype_from_name(name: str) -> DType:
-    name = name.strip().lower()
+    """Parse a dtype display name back to a DType (the wire/schema-string
+    decoder; inverse of ``DType.name`` incl. nested array/struct/map)."""
+    raw = name.strip()
+    name = raw.lower()
     if name in _BY_NAME:
         return _BY_NAME[name]
     if name in _ALIASES:
@@ -256,6 +274,19 @@ def dtype_from_name(name: str) -> DType:
         inner = name[name.index("(") + 1:name.index(")")]
         p, s = inner.split(",")
         return DecimalType(int(p), int(s))
+    if name.startswith("array<") and name.endswith(">"):
+        return ArrayType(dtype_from_name(raw[6:-1]))
+    if name.startswith("map<") and name.endswith(">"):
+        k, v = _split_top(raw[4:-1])
+        return MapType(dtype_from_name(k), dtype_from_name(v))
+    if name.startswith("struct<") and name.endswith(">"):
+        inner = raw[7:-1]
+        fields = []
+        if inner:
+            for part in _split_top(inner):
+                fname, ftype = _split_top(part, ":")
+                fields.append(StructField(fname, dtype_from_name(ftype)))
+        return StructType(tuple(fields))
     raise ValueError(f"unknown dtype name: {name}")
 
 

@@ -137,10 +137,11 @@ class GetArrayItem(ec.Expression):
 
 
 class ElementAt(ec.Expression):
-    """element_at(arr, i) — 1-based; negative counts from the end.
+    """element_at(arr, i) — 1-based, negative counts from the end —
+    or element_at(map, key).
 
     Reference: collectionOperations.scala GpuElementAt (non-ANSI: null on
-    out-of-bound).
+    out-of-bound / missing key).
     """
 
     def __init__(self, child: ec.Expression, index: ec.Expression):
@@ -150,9 +151,15 @@ class ElementAt(ec.Expression):
         return ElementAt(c[0], c[1])
 
     def dtype(self):
-        return self.children[0].dtype().element_type
+        dt = self.children[0].dtype()
+        if isinstance(dt, T.MapType):
+            return dt.value_type
+        return dt.element_type
 
     def columnar_eval(self, batch: ColumnarBatch):
+        if isinstance(self.children[0].dtype(), T.MapType):
+            return GetMapValue(
+                self.children[0], self.children[1]).columnar_eval(batch)
         return _extract_at(self.children[0], self.children[1], batch,
                            one_based=True)
 
@@ -205,36 +212,42 @@ class ArrayContains(ec.Expression):
         seg = lk.segment_ids_for(col.offsets, ecap)
         seg_rows = jnp.clip(seg, 0, cap - 1)
         evalid = col.elements.validity
-        if isinstance(needle, ec.Scalar):
-            # Spark: a null needle yields NULL for every non-null array
-            needle_valid = jnp.full(cap, needle.value is not None)
-            needle = needle.to_column(cap, batch.num_rows)
-        else:
-            needle_valid = needle.validity
-        if isinstance(col.elements, StringColumn):
-            from ..kernels import strings as sk
-            nw = max(sk.needed_key_words(col.elements,
-                                         col.elements.capacity),
-                     sk.needed_key_words(needle, batch.num_rows))
-            ewords = sk._pack_words(col.elements.offsets, col.elements.data,
-                                    nw)
-            nwords = sk._pack_words(needle.offsets, needle.data, nw)
-            eq = jnp.all(ewords == jnp.take(nwords, seg_rows, axis=0),
-                         axis=1)
-            elens = col.elements.offsets[1:] - col.elements.offsets[:-1]
-            nlens = needle.offsets[1:] - needle.offsets[:-1]
-            eq = eq & (elens == jnp.take(nlens, seg_rows))
-        else:
-            # broadcast each row's needle value over its segment
-            eq = (col.elements.data ==
-                  jnp.take(needle.data, seg_rows).astype(
-                      col.elements.data.dtype))
-        eq = eq & jnp.take(needle_valid, seg_rows)
+        needle, needle_valid = _needle_column(needle, cap, batch.num_rows)
+        eq = _segment_equals(col.elements, needle, needle_valid, seg_rows,
+                             batch.num_rows)
         hit = lk.segmented_any(eq & evalid, seg, cap + 1)[:cap]
         has_null_elem = lk.segmented_any(~evalid & (seg < cap), seg,
                                          cap + 1)[:cap]
         valid = col.validity & needle_valid[:cap] & (hit | ~has_null_elem)
         return Column(T.BOOL, hit, valid)
+
+
+def _needle_column(needle, cap: int, num_rows: int):
+    """Normalize a scalar-or-column lookup value to (column, validity)."""
+    if isinstance(needle, ec.Scalar):
+        # Spark: a null needle yields NULL for every non-null container
+        valid = jnp.full(cap, needle.value is not None)
+        return needle.to_column(cap, num_rows), valid
+    return needle, needle.validity
+
+
+def _segment_equals(elements: Column, needle: Column, needle_valid,
+                    seg_rows, num_rows: int):
+    """eq[ecap]: does element e equal its row's needle value?"""
+    if isinstance(elements, StringColumn):
+        from ..kernels import strings as sk
+        nw = max(sk.needed_key_words(elements, elements.capacity),
+                 sk.needed_key_words(needle, num_rows))
+        ewords = sk._pack_words(elements.offsets, elements.data, nw)
+        nwords = sk._pack_words(needle.offsets, needle.data, nw)
+        eq = jnp.all(ewords == jnp.take(nwords, seg_rows, axis=0), axis=1)
+        elens = elements.offsets[1:] - elements.offsets[:-1]
+        nlens = needle.offsets[1:] - needle.offsets[:-1]
+        eq = eq & (elens == jnp.take(nlens, seg_rows))
+    else:
+        eq = (elements.data ==
+              jnp.take(needle.data, seg_rows).astype(elements.data.dtype))
+    return eq & jnp.take(needle_valid, seg_rows)
 
 
 class SortArray(ec.Expression):
@@ -349,6 +362,205 @@ def _seg_minmax(arr_e, batch, is_min: bool):
     red = fn(masked, seg, num_segments=cap + 1)[:cap]
     any_valid = lk.segmented_any(evalid, seg, cap + 1)[:cap]
     return Column(dt, red.astype(data.dtype), col.validity & any_valid)
+
+
+class CreateNamedStruct(ec.Expression):
+    """named_struct / struct(col...) — one child column per field.
+
+    Reference: complexTypeCreator.scala GpuCreateNamedStruct.
+    """
+
+    def __init__(self, names: List[str], *children: ec.Expression):
+        if len(names) != len(children):
+            raise ValueError("CreateNamedStruct: one name per child")
+        self.names = list(names)
+        self.children = list(children)
+
+    def with_children(self, c):
+        return CreateNamedStruct(self.names, *c)
+
+    def dtype(self):
+        return T.StructType([
+            T.StructField(n, c.dtype(), c.nullable)
+            for n, c in zip(self.names, self.children)])
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        from ..columnar.column import StructColumn
+        kids = [ec.eval_as_column(c, batch) for c in self.children]
+        live = jnp.arange(batch.capacity) < batch.num_rows
+        return StructColumn(self.dtype(), kids, live)
+
+
+class GetStructField(ec.Expression):
+    """struct.field extraction.
+
+    Reference: complexTypeExtractors.scala GpuGetStructField.
+    """
+
+    def __init__(self, child: ec.Expression, field_name: str):
+        self.children = [child]
+        self.field_name = field_name
+
+    def with_children(self, c):
+        return GetStructField(c[0], self.field_name)
+
+    def _field_index(self):
+        st = self.children[0].dtype()
+        for i, f in enumerate(st.fields):
+            if f.name == self.field_name:
+                return i, f
+        raise ValueError(f"no field {self.field_name} in {st.name}")
+
+    def dtype(self):
+        return self._field_index()[1].dtype
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        col = ec.eval_as_column(self.children[0], batch)
+        i, _ = self._field_index()
+        return col.children[i].mask_validity(col.validity)
+
+
+class CreateMap(ec.Expression):
+    """map(k1, v1, k2, v2, ...) — fixed entries per row.
+
+    Reference: complexTypeCreator.scala GpuCreateMap.
+    """
+
+    def __init__(self, *children: ec.Expression):
+        assert len(children) % 2 == 0, "map() needs key/value pairs"
+        self.children = list(children)
+
+    def with_children(self, c):
+        return CreateMap(*c)
+
+    def dtype(self):
+        kt = self.children[0].dtype() if self.children else T.STRING
+        vt = self.children[1].dtype() if self.children else T.STRING
+        return T.MapType(kt, vt)
+
+    @property
+    def nullable(self):
+        return False
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        from ..columnar.column import MapColumn, StructColumn
+        dt = self.dtype()
+        keys_arr = CreateArray(*self.children[0::2]).columnar_eval(batch)
+        vals_arr = CreateArray(*self.children[1::2]).columnar_eval(batch)
+        est = MapColumn.entry_struct_type(dt)
+        ecap = keys_arr.elements.capacity
+        elems = StructColumn(
+            est, [keys_arr.elements, vals_arr.elements],
+            jnp.ones(ecap, jnp.bool_))
+        return MapColumn(dt, keys_arr.offsets, elems, keys_arr.validity)
+
+
+class GetMapValue(ec.Expression):
+    """map[key] lookup: value of the matching key, null when absent.
+
+    Reference: complexTypeExtractors.scala GpuGetMapValue.
+    """
+
+    def __init__(self, child: ec.Expression, key: ec.Expression):
+        self.children = [child, key]
+
+    def with_children(self, c):
+        return GetMapValue(c[0], c[1])
+
+    def dtype(self):
+        return self.children[0].dtype().value_type
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        import jax
+        col = ec.eval_as_column(self.children[0], batch)
+        needle = self.children[1].columnar_eval(batch)
+        cap = col.capacity
+        ecap = col.elements.capacity
+        seg = lk.segment_ids_for(col.offsets, ecap)
+        seg_rows = jnp.clip(seg, 0, cap - 1)
+        needle, needle_valid = _needle_column(needle, cap, batch.num_rows)
+        eq = _segment_equals(col.keys, needle, needle_valid, seg_rows,
+                             batch.num_rows)
+        live_elem = seg < cap
+        # last matching entry wins (Spark keeps the last duplicate key)
+        idx = jnp.where(eq & live_elem, jnp.arange(ecap), -1)
+        best = jax.ops.segment_max(idx, seg, num_segments=cap + 1)[:cap]
+        found = best >= 0
+        vals = col.values.gather(jnp.where(found, best, 0))
+        return vals.mask_validity(col.validity & needle_valid[:cap] & found)
+
+
+class MapKeys(ec.Expression):
+    """map_keys(m) -> array of keys."""
+
+    def __init__(self, child: ec.Expression):
+        self.children = [child]
+
+    def with_children(self, c):
+        return MapKeys(c[0])
+
+    def dtype(self):
+        return T.ArrayType(self.children[0].dtype().key_type)
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        col = ec.eval_as_column(self.children[0], batch)
+        return ListColumn(self.dtype(), col.offsets, col.keys, col.validity)
+
+
+class MapValues(ec.Expression):
+    """map_values(m) -> array of values."""
+
+    def __init__(self, child: ec.Expression):
+        self.children = [child]
+
+    def with_children(self, c):
+        return MapValues(c[0])
+
+    def dtype(self):
+        return T.ArrayType(self.children[0].dtype().value_type)
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        col = ec.eval_as_column(self.children[0], batch)
+        return ListColumn(self.dtype(), col.offsets, col.values,
+                          col.validity)
+
+
+class ExtractValue(ec.Expression):
+    """Col.getItem: dispatches by the child's type once resolved —
+    array[int index], map[key], or struct.field (Spark's
+    UnresolvedExtractValue role)."""
+
+    def __init__(self, child: ec.Expression, key):
+        # the key rides as a child expression so bind()/resolve() reach it;
+        # a plain-str key additionally remembers the struct-field name
+        self.key = key
+        key_expr = key if isinstance(key, ec.Expression) else ec.lit(key)
+        self.children = [child, key_expr]
+
+    def with_children(self, c):
+        out = ExtractValue(c[0], self.key)
+        out.children = list(c)
+        return out
+
+    def _resolved(self) -> ec.Expression:
+        dt = self.children[0].dtype()
+        if isinstance(dt, T.StructType) and isinstance(self.key, str):
+            return GetStructField(self.children[0], self.key)
+        if isinstance(dt, T.MapType):
+            return GetMapValue(self.children[0], self.children[1])
+        if isinstance(dt, T.ArrayType):
+            return GetArrayItem(self.children[0], self.children[1])
+        raise ValueError(f"cannot extract {self.key!r} from {dt.name}")
+
+    def dtype(self):
+        return self._resolved().dtype()
+
+    def columnar_eval(self, batch: ColumnarBatch):
+        return self._resolved().columnar_eval(batch)
 
 
 class Explode(ec.Expression):

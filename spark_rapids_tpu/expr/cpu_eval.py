@@ -687,3 +687,85 @@ def _register_collections():
 
 
 _register_collections()
+
+
+def _coll_named_struct(e, t):
+    kids = [_pylist_of(c, t) for c in e.children]
+    n = t.num_rows
+    rows = [dict(zip(e.names, [k[i] for k in kids])) for i in range(n)]
+    return pa.array(rows, type=to_arrow_type(e.dtype()))
+
+
+def _coll_get_field(e, t):
+    rows = _pylist_of(e.children[0], t)
+    out = [None if r is None else r.get(e.field_name) for r in rows]
+    return pa.array(out, type=to_arrow_type(e.dtype()))
+
+
+def _coll_create_map(e, t):
+    kids = [_pylist_of(c, t) for c in e.children]
+    n = t.num_rows
+    rows = []
+    for i in range(n):
+        items = [(kids[j][i], kids[j + 1][i])
+                 for j in range(0, len(kids), 2)]
+        rows.append(items)
+    return pa.array(rows, type=to_arrow_type(e.dtype()))
+
+
+def _as_map_dict(v):
+    if v is None or isinstance(v, dict):
+        return v
+    return dict(v)  # pyarrow map pylist is [(k, v), ...]
+
+
+def _coll_get_map_value(e, t):
+    rows = [_as_map_dict(v) for v in _pylist_of(e.children[0], t)]
+    keys = _pylist_of(e.children[1], t)
+    out = [None if (r is None or k is None) else r.get(k)
+           for r, k in zip(rows, keys)]
+    return pa.array(out, type=to_arrow_type(e.dtype()))
+
+
+def _coll_map_keys(e, t):
+    rows = [_as_map_dict(v) for v in _pylist_of(e.children[0], t)]
+    out = [None if r is None else list(r.keys()) for r in rows]
+    return pa.array(out, type=to_arrow_type(e.dtype()))
+
+
+def _coll_map_values(e, t):
+    rows = [_as_map_dict(v) for v in _pylist_of(e.children[0], t)]
+    out = [None if r is None else list(r.values()) for r in rows]
+    return pa.array(out, type=to_arrow_type(e.dtype()))
+
+
+def _coll_size_any(e, t):
+    # arrays arrive as lists, maps as entry-lists/dicts; len covers all
+    vals = _pylist_of(e.children[0], t)
+    return pa.array([(-1 if v is None else len(v)) for v in vals],
+                    type=pa.int32())
+
+
+def _register_struct_map():
+    from . import collections as CO
+    _DISPATCH[CO.CreateNamedStruct] = _coll_named_struct
+    _DISPATCH[CO.GetStructField] = _coll_get_field
+    _DISPATCH[CO.CreateMap] = _coll_create_map
+    _DISPATCH[CO.GetMapValue] = _coll_get_map_value
+    _DISPATCH[CO.MapKeys] = _coll_map_keys
+    _DISPATCH[CO.MapValues] = _coll_map_values
+    _DISPATCH[CO.Size] = _coll_size_any
+    # element_at over maps routes through the map lookup
+    _elem_arr = _DISPATCH[CO.ElementAt]
+
+    def _element_at_any(e, t):
+        from ..columnar import dtypes as TT
+        if isinstance(e.children[0].dtype(), TT.MapType):
+            return _coll_get_map_value(e, t)
+        return _elem_arr(e, t)
+
+    _DISPATCH[CO.ElementAt] = _element_at_any
+    _DISPATCH[CO.ExtractValue] = lambda e, t: cpu_eval(e._resolved(), t)
+
+
+_register_struct_map()

@@ -74,7 +74,7 @@ def expr_rule(cls, sig: TS.TypeSig):
 
 
 for _cls in [ec.AttributeReference, ec.BoundReference, ec.Literal, ec.Alias]:
-    expr_rule(_cls, TS.WITH_ARRAYS)
+    expr_rule(_cls, TS.WITH_NESTED)
 for _cls in [ea.Add, ea.Subtract, ea.Multiply, ea.Divide, ea.IntegralDivide,
              ea.Remainder, ea.Pmod, ea.UnaryMinus, ea.UnaryPositive, ea.Abs,
              ea.Least, ea.Greatest, ea.Round]:
@@ -124,6 +124,11 @@ for _cls in [ecoll.CreateArray, ecoll.GetArrayItem, ecoll.ElementAt,
              ecoll.SortArray, ecoll.Explode]:
     expr_rule(_cls, TS.WITH_ARRAYS)
 expr_rule(ecoll.Size, TS.WITH_ARRAYS + TS.INTEGRAL)
+# struct/map expressions (complexTypeCreator/Extractors.scala)
+for _cls in [ecoll.CreateNamedStruct, ecoll.GetStructField,
+             ecoll.CreateMap, ecoll.GetMapValue, ecoll.MapKeys,
+             ecoll.MapValues, ecoll.ExtractValue]:
+    expr_rule(_cls, TS.WITH_NESTED)
 expr_rule(ecoll.ArrayContains, TS.BOOLEAN)
 expr_rule(ecoll.ArrayMin, TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
 expr_rule(ecoll.ArrayMax, TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
@@ -240,7 +245,7 @@ class PlanMeta:
         # per-node checks
         p = self.plan
         for f in p.schema:
-            if not TS.WITH_ARRAYS.supports(f.dtype) and \
+            if not TS.WITH_NESTED.supports(f.dtype) and \
                     f.dtype.is_nested:
                 self.reasons.append(
                     f"output column {f.name}: nested type {f.dtype.name} "

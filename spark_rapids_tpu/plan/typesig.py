@@ -27,10 +27,16 @@ class TypeSig:
     def supports(self, dt: T.DType) -> bool:
         if isinstance(dt, T.DecimalType):
             return self.decimal
+        # nested types are supported when listed AND their leaves are
         if isinstance(dt, T.ArrayType):
-            # arrays are supported when listed AND the element type is
             return T.ArrayType in self.kinds and self.supports(
                 dt.element_type)
+        if isinstance(dt, T.StructType):
+            return T.StructType in self.kinds and all(
+                self.supports(f.dtype) for f in dt.fields)
+        if isinstance(dt, T.MapType):
+            return T.MapType in self.kinds and self.supports(dt.key_type) \
+                and self.supports(dt.value_type)
         return type(dt) in self.kinds
 
     def reason(self, dt: T.DType, context: str) -> Optional[str]:
@@ -60,11 +66,14 @@ NULL_SIG = TypeSig([T.NullType])
 ALL_SUPPORTED = (BOOLEAN + NUMERIC + DECIMAL_64 + STRING_SIG + DATETIME +
                  NULL_SIG)
 ARRAY_SIG = TypeSig([T.ArrayType])
+STRUCT_SIG = TypeSig([T.StructType])
+MAP_SIG = TypeSig([T.MapType])
 # scalars + arrays of them: only for ops that understand ListColumn
 # (references, aliases, the collection expressions)
 WITH_ARRAYS = ALL_SUPPORTED + ARRAY_SIG
+# everything device-resident incl. structs and maps (nested leaves must
+# themselves be supported — TypeSig.supports recurses)
+WITH_NESTED = WITH_ARRAYS + STRUCT_SIG + MAP_SIG
 # orderable == groupable == joinable (canonical key words cover scalars
-# only; arrays cannot be sort/join keys yet)
+# only; nested types cannot be sort/join keys yet)
 ORDERABLE = ALL_SUPPORTED
-# structs/maps are not yet device-resident
-UNSUPPORTED_NESTED = TypeSig([T.StructType, T.MapType])

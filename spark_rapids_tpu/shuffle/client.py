@@ -141,7 +141,16 @@ class RapidsShuffleClient:
         self.metadata_timeout = metadata_timeout
         self._receive_states: List[BufferReceiveState] = []
         self._lock = threading.Lock()
+        self._closed = False
         self.connection.register_data_handler(self._dispatch_data)
+
+    def close(self):
+        """Unregister from the shared connection (a connection is cached
+        per peer; without this every fetch would leak its dispatcher —
+        reference: RapidsShuffleClient lifecycle)."""
+        if not self._closed:
+            self._closed = True
+            self.connection.unregister_data_handler(self._dispatch_data)
 
     def _dispatch_data(self, tag: int, offset: int, payload: bytes):
         with self._lock:

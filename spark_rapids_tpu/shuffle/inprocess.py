@@ -159,6 +159,12 @@ class InProcessClientConnection(ClientConnection):
         ep.data_handlers.setdefault(self.peer_executor_id, []).append(
             handler)
 
+    def unregister_data_handler(self, handler):
+        ep = self.registry.endpoint(self.local_id)
+        handlers = ep.data_handlers.get(self.peer_executor_id)
+        if handlers and handler in handlers:
+            handlers.remove(handler)
+
 
 class InProcessServerConnection(ServerConnection):
     def __init__(self, registry: EndpointRegistry, local_id: str):

@@ -85,6 +85,11 @@ class RapidsShuffleIterator(Iterator[ColumnarBatch]):
     def __iter__(self):
         return self
 
+    def _close_clients(self):
+        for c in self._clients:
+            c.close()
+        self._clients = []
+
     def __next__(self) -> ColumnarBatch:
         if self._local:
             return self._local.pop(0)
@@ -95,10 +100,12 @@ class RapidsShuffleIterator(Iterator[ColumnarBatch]):
         while True:
             if (self._counts_pending == 0
                     and self._received_remote >= self._expected_remote):
+                self._close_clients()
                 raise StopIteration
             try:
                 kind, payload = self._queue.get(timeout=self.timeout_s)
             except queue.Empty:
+                self._close_clients()
                 raise ShuffleFetchFailedError(
                     None, f"shuffle fetch timed out after "
                           f"{self.timeout_s}s") from None
@@ -107,6 +114,7 @@ class RapidsShuffleIterator(Iterator[ColumnarBatch]):
                 self._counts_pending -= 1
                 continue
             if kind == "error":
+                self._close_clients()
                 raise ShuffleFetchFailedError(None, payload)
             handle: ReceivedBufferHandle = payload
             self._received_remote += 1

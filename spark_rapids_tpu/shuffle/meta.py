@@ -30,9 +30,10 @@ from ..columnar.schema import Field, Schema
 _MAGIC = b"TMET"
 _VERSION = 1
 
-# column kinds on the wire
+# column kinds on the wire (informational; reconstruction is dtype-driven)
 _KIND_PLAIN = 0
 _KIND_STRING = 1
+_KIND_NESTED = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,9 +75,12 @@ def build_table_meta(batch: ColumnarBatch) -> Tuple[TableMeta, bytes]:
     fields = tuple((f.name, f.dtype.name, f.nullable) for f in batch.schema)
     kinds = []
     arrays: List[np.ndarray] = []
-    for col in batch.columns:
-        kinds.append(_KIND_STRING if isinstance(col, StringColumn)
+    for f, col in zip(batch.schema, batch.columns):
+        kinds.append(_KIND_NESTED if f.dtype.is_nested
+                     else _KIND_STRING if isinstance(col, StringColumn)
                      else _KIND_PLAIN)
+        # device_buffers() is recursive and its order is deterministic per
+        # dtype, so the receiver can re-consume it dtype-driven
         for buf in col.device_buffers():
             arrays.append(np.asarray(buf))
     metas: List[BufferMeta] = []
@@ -117,18 +121,42 @@ def batch_from_meta(meta: TableMeta, blob: bytes) -> ColumnarBatch:
                     for n, d, nul in meta.fields)
     cols = []
     i = 0
-    for f, kind in zip(schema, meta.kinds):
-        if kind == _KIND_STRING:
-            offsets, data, validity = arrays[i], arrays[i + 1], arrays[i + 2]
-            cols.append(StringColumn(jnp.asarray(offsets), jnp.asarray(data),
-                                     jnp.asarray(validity)))
-            i += 3
-        else:
-            data, validity = arrays[i], arrays[i + 1]
-            cols.append(Column(f.dtype, jnp.asarray(data),
-                               jnp.asarray(validity)))
-            i += 2
+    for f in schema:
+        col, i = _consume_column(f.dtype, arrays, i)
+        cols.append(col)
     return ColumnarBatch(schema, cols, meta.num_rows)
+
+
+def _consume_column(dtype: T.DType, arrays, i: int):
+    """Rebuild one column from the flat buffer list, mirroring the
+    deterministic ``device_buffers()`` order for each column type."""
+    import jax.numpy as jnp
+    from ..columnar.column import ListColumn, MapColumn, StructColumn
+
+    if dtype == T.STRING:
+        return StringColumn(jnp.asarray(arrays[i]), jnp.asarray(arrays[i + 1]),
+                            jnp.asarray(arrays[i + 2])), i + 3
+    if isinstance(dtype, T.ArrayType):
+        offsets, validity = arrays[i], arrays[i + 1]
+        elems, i = _consume_column(dtype.element_type, arrays, i + 2)
+        return ListColumn(dtype, jnp.asarray(offsets), elems,
+                          jnp.asarray(validity)), i
+    if isinstance(dtype, T.MapType):
+        offsets, validity = arrays[i], arrays[i + 1]
+        est = MapColumn.entry_struct_type(dtype)
+        elems, i = _consume_column(est, arrays, i + 2)
+        return MapColumn(dtype, jnp.asarray(offsets), elems,
+                         jnp.asarray(validity)), i
+    if isinstance(dtype, T.StructType):
+        validity = arrays[i]
+        i += 1
+        kids = []
+        for f in dtype.fields:
+            kid, i = _consume_column(f.dtype, arrays, i)
+            kids.append(kid)
+        return StructColumn(dtype, kids, jnp.asarray(validity)), i
+    return Column(dtype, jnp.asarray(arrays[i]),
+                  jnp.asarray(arrays[i + 1])), i + 2
 
 
 # ---------------------------------------------------------------------------

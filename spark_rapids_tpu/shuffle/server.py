@@ -11,6 +11,7 @@ Reference parity: ``shuffle/RapidsShuffleServer.scala:70`` +
 """
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Dict, List, Optional
 
@@ -19,6 +20,9 @@ from .meta import TableMeta, build_table_meta
 from .transport import (BlockIdSpec, MetadataRequest, MetadataResponse,
                         RapidsShuffleTransport, TransferRequest,
                         TransferResponse)
+
+
+_LOG = logging.getLogger("spark_rapids_tpu.shuffle.server")
 
 
 class ShuffleRequestHandler:
@@ -90,8 +94,11 @@ class BufferSendState:
                     # silently recycling the window
                     self.error = (f"send to {self.peer} timed out after "
                                   f"{self.server.send_timeout}s")
+                    _LOG.warning("shuffle server: %s", self.error)
                 elif t.status.value == "error":
                     self.error = t.error_message
+                    _LOG.warning("shuffle server: send to %s failed: %s",
+                                 self.peer, self.error)
             bounce.close()
             if self.error:
                 break
@@ -183,5 +190,12 @@ class CatalogRequestHandler(ShuffleRequestHandler):
                     if all(b is None for b in blobs):
                         del self._meta_cache[block]
                     return blob
+        # miss (concurrent transfer drained the entry): re-flatten once
+        # and re-seed the cache for this transfer's remaining batches
         blobs = [blob for _, blob in self._flatten(block)]
-        return blobs[batch_index]
+        out = blobs[batch_index]
+        blobs[batch_index] = None
+        with self._cache_lock:
+            if any(b is not None for b in blobs):
+                self._meta_cache[block] = blobs
+        return out

@@ -177,12 +177,19 @@ class ClientConnection:
 
     def register_data_handler(
             self, handler: Callable[[int, int, bytes], None]):
-        """Register the tagged-data sink: ``handler(tag, offset, payload)``.
+        """Register a tagged-data sink: ``handler(tag, offset, payload)``.
 
         Active-message style (reference: UCX.scala ActiveMessage
-        :369-415): the transport invokes the handler as tagged windows
-        arrive; BufferReceiveState demuxes by tag.
+        :369-415): the transport invokes every registered handler as
+        tagged windows arrive; BufferReceiveState demuxes by tag.
+        Registration is additive — unregister when the fetch driver is
+        done (RapidsShuffleClient.close).
         """
+        raise NotImplementedError
+
+    def unregister_data_handler(
+            self, handler: Callable[[int, int, bytes], None]):
+        """Remove a previously registered data sink (idempotent)."""
         raise NotImplementedError
 
 

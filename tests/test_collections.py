@@ -35,6 +35,12 @@ class TestCollectionOps:
                                     F.col("l").getItem(5).alias("oob"),
                                     F.col("sl")[1].alias("s1")))
 
+    def test_get_item_dynamic_index(self):
+        # getItem with a column index (ExtractValue must bind the key)
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: _df(s).select(
+                "a", F.col("l")[F.col("a") - 1].alias("x")))
+
     def test_element_at(self):
         assert_tpu_and_cpu_are_equal_collect(
             lambda s: _df(s).select(
@@ -164,3 +170,72 @@ class TestArrayFlow:
                 F.col("id"),
                 F.explode(F.array(F.col("id"), F.col("id") * 10,
                                   F.lit(99))).alias("x")))
+
+
+
+def _struct_df(s):
+    import pyarrow as pa
+    t = pa.table({
+        "a": [1, 2, 3, 4],
+        "st": pa.array([{"x": 1, "y": "u"}, None,
+                        {"x": None, "y": "w"}, {"x": 4, "y": None}]),
+        "mp": pa.array([{"k": 1, "j": 5}, None, {}, {"z": 9, "k": 2}],
+                       type=pa.map_(pa.string(), pa.int64())),
+    })
+    return s.create_dataframe(t)
+
+
+class TestStructs:
+    def test_get_field(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: _struct_df(s).select(
+                "a", F.col("st").getField("x").alias("sx"),
+                F.col("st")["y"].alias("sy")))
+
+    def test_create_struct(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: _struct_df(s).select(
+                F.struct("a", (F.col("a") * 2).alias("b")).alias("s2")))
+
+    def test_named_struct_roundtrip_field(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: _struct_df(s).select(
+                F.named_struct("p", "a", "q", F.lit("z"))
+                .getField("p").alias("p")))
+
+    def test_struct_through_union_and_sort(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: _struct_df(s).select("a", "st")
+            .union(_struct_df(s).select("a", "st")).order_by("a"),
+            ignore_order=False)
+
+
+class TestMaps:
+    def test_get_map_value(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: _struct_df(s).select(
+                "a", F.col("mp")["k"].alias("mk"),
+                F.element_at("mp", "z").alias("mz"),
+                F.element_at("mp", "nope").alias("mn")))
+
+    def test_map_keys_values_size(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: _struct_df(s).select(
+                "a", F.map_keys("mp").alias("ks"),
+                F.map_values("mp").alias("vs"),
+                F.size("mp").alias("n")))
+
+    def test_create_map(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: _struct_df(s).select(
+                F.create_map(F.lit("one"), F.col("a"),
+                             F.lit("two"), F.col("a") * 2).alias("m")))
+
+    def test_map_through_shuffle(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: _struct_df(s).select("a", "mp").repartition(3, "a"))
+
+    def test_explode_map_keys(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: _struct_df(s).select(
+                "a", F.explode(F.map_keys("mp")).alias("k")))

@@ -53,6 +53,27 @@ class TestTableMeta:
         out = batch_from_meta(meta, blob)
         assert out.to_pydict() == b.to_pydict()
 
+    def test_roundtrip_nested_columns(self):
+        """Lists, structs, and maps must survive the TableMeta wire
+        (dtype-driven recursive buffer reconstruction)."""
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar.arrow import from_arrow
+        t = pa.table({
+            "i": [1, 2, 3],
+            "l": [[1, 2], None, []],
+            "sl": [["x", None], ["yy"], None],
+            "st": pa.array([{"x": 1, "y": "u"}, None, {"x": 3, "y": None}]),
+            "mp": pa.array([{"k": 1}, None, {"a": 2, "b": 3}],
+                           type=pa.map_(pa.string(), pa.int64())),
+            "nn": pa.array([[[1], [2, 3]], None, [[]]],
+                           type=pa.list_(pa.list_(pa.int64()))),
+        })
+        b = from_arrow(t)
+        meta, blob = build_table_meta(b)
+        again = decode_meta(encode_meta(meta))
+        out = batch_from_meta(again, blob)
+        assert out.to_pydict() == b.to_pydict()
+
     def test_wire_encoding_roundtrip(self):
         b = make_batch(5, seed=2)
         meta, _ = build_table_meta(b)
