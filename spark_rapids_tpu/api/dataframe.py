@@ -275,6 +275,9 @@ class DataFrame:
     # -- actions -------------------------------------------------------------
     def collect(self) -> List[tuple]:
         t = self.session.execute_to_arrow(self._plan)
+        # the executed physical plan, for tests/tools inspecting runtime
+        # decisions (AQE strategies, fallbacks)
+        self._last_physical_plan = self.session.last_physical_plan
         cols = [t.column(i).to_pylist() for i in range(t.num_columns)]
         return list(zip(*cols)) if cols else []
 

@@ -138,6 +138,7 @@ class TpuSession:
         from ..columnar.arrow import to_arrow, schema_to_arrow
         t0 = _time.perf_counter()
         phys = self._plan(logical)
+        self.last_physical_plan = phys
         tables: List[pa.Table] = []
         for part in phys.execute():
             for item in part:

@@ -173,16 +173,22 @@ def column_from_arrow(arr: pa.ChunkedArray | pa.Array,
         lens = np.where(valid_np, raw[1:] - raw[:-1], 0)
         offs = np.zeros(n + 1, np.int32)
         offs[1:] = np.cumsum(lens)
-        # keys/items are unsliced child arrays addressed by raw offsets;
-        # take the live entries per row to match the rebuilt offsets
-        take = np.concatenate(
-            [np.arange(raw[i], raw[i + 1])
-             for i in range(n) if valid_np[i]] or
-            [np.zeros(0, np.int64)])
-        keys = arr.keys.take(pa.array(take)) if len(take) else \
-            arr.keys.slice(0, 0)
-        items = arr.items.take(pa.array(take)) if len(take) else \
-            arr.items.slice(0, 0)
+        # keys/items are unsliced child arrays addressed by raw offsets
+        if arr.null_count == 0:
+            # null-free fast path: live entries are one contiguous range
+            start, stop = (int(raw[0]), int(raw[n])) if n else (0, 0)
+            keys = arr.keys.slice(start, stop - start)
+            items = arr.items.slice(start, stop - start)
+        else:
+            # gather the live extents per row to match rebuilt offsets
+            take = np.concatenate(
+                [np.arange(raw[i], raw[i + 1])
+                 for i in range(n) if valid_np[i]] or
+                [np.zeros(0, np.int64)])
+            keys = arr.keys.take(pa.array(take)) if len(take) else \
+                arr.keys.slice(0, 0)
+            items = arr.items.take(pa.array(take)) if len(take) else \
+                arr.items.slice(0, 0)
         est = MapColumn.entry_struct_type(dt)
         n_e = len(keys)
         ecap = bucket_capacity(max(1, n_e))

@@ -155,6 +155,28 @@ CBO_ENABLED = conf_bool(
     "spark.rapids.tpu.sql.optimizer.enabled", False,
     "Cost-based fallback optimizer (reference: "
     "spark.rapids.sql.optimizer.enabled)")
+ADAPTIVE_ENABLED = conf_bool(
+    "spark.rapids.tpu.sql.adaptive.enabled", True,
+    "Adaptive query execution: re-plan exchanges/joins from materialized "
+    "shuffle statistics (reference: AQE handling in GpuOverrides/"
+    "GpuTransitionOverrides + GpuCustomShuffleReaderExec)")
+ADAPTIVE_TARGET_PARTITION_BYTES = conf_bytes(
+    "spark.rapids.tpu.sql.adaptive.targetPartitionBytes", 64 << 20,
+    "Advisory post-shuffle partition size: adjacent small reduce "
+    "partitions are coalesced up to this (the "
+    "spark.sql.adaptive.advisoryPartitionSizeInBytes role)")
+ADAPTIVE_BROADCAST_BYTES = conf_bytes(
+    "spark.rapids.tpu.sql.adaptive.autoBroadcastJoinBytes", 32 << 20,
+    "Runtime broadcast threshold: a shuffled join whose materialized "
+    "build side is under this skips the probe-side shuffle entirely "
+    "(AQE shuffled-hash-join -> broadcast conversion)")
+ADAPTIVE_SKEW_FACTOR = conf_float(
+    "spark.rapids.tpu.sql.adaptive.skewedPartitionFactor", 5.0,
+    "A probe partition is skewed when its bytes exceed this multiple of "
+    "the median partition size (spark.sql.adaptive.skewJoin role)")
+ADAPTIVE_SKEW_MIN_BYTES = conf_bytes(
+    "spark.rapids.tpu.sql.adaptive.skewedPartitionThresholdBytes", 16 << 20,
+    "Minimum bytes before a partition can be considered skewed")
 METRICS_LEVEL = conf_str(
     "spark.rapids.tpu.sql.metrics.level", "MODERATE",
     "ESSENTIAL/MODERATE/DEBUG metric collection level "

@@ -60,19 +60,44 @@ class TpuShuffleExchange(TpuExec):
                         per_reduce.setdefault(pid, []).append(piece)
             mgr.write_map_output(self._shuffle_id, map_id, per_reduce)
 
+    def ensure_materialized(self):
+        """Run the map side once (the AQE stage-materialization barrier)."""
+        if self._shuffle_id is None:
+            self._materialize_map_side()
+
+    def partition_stats(self):
+        """Per-reduce-partition (bytes, rows) from the materialized map
+        output — the MapOutputStatistics role AQE re-plans from."""
+        self.ensure_materialized()
+        mgr = ShuffleManager.get()
+        stats = []
+        for pid in range(self.partitioner.num_partitions):
+            nbytes = rows = 0
+            for block in mgr.catalog.blocks_for_reduce(self._shuffle_id,
+                                                       pid):
+                nb, nr = mgr.catalog.stats_for_block(block)
+                nbytes += nb
+                rows += nr
+            stats.append((nbytes, rows))
+        return stats
+
+    def read_reduce(self, reduce_id: int):
+        """All batches of one reduce partition (materializes if needed)."""
+        self.ensure_materialized()
+        mgr = ShuffleManager.get()
+        out = []
+        for b in mgr.read_partition(self._shuffle_id, reduce_id):
+            self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
+            out.append(b)
+        return out
+
     def execute(self):
         schema = self.output_schema
-        state = {"done": False}
 
         def reduce_iter(reduce_id):
-            if not state["done"]:
-                self._materialize_map_side()
-                state["done"] = True
-            mgr = ShuffleManager.get()
             got = False
-            for b in mgr.read_partition(self._shuffle_id, reduce_id):
+            for b in self.read_reduce(reduce_id):
                 got = True
-                self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
                 yield b
             if not got:
                 yield ColumnarBatch.empty(schema)

@@ -129,6 +129,15 @@ def _cmp(op):
         b = _ev(e.children[1], t)
         a_t = a.type
         b_t = b.type
+        if op == "equal" and (pa.types.is_nested(a_t) or
+                              pa.types.is_nested(b_t)):
+            # pyarrow has no nested equality kernel; row-wise python
+            # (Spark supports struct/array equality)
+            av = _arr(a, t.num_rows).to_pylist()
+            bv = _arr(b, t.num_rows).to_pylist()
+            return pa.array(
+                [None if (x is None or y is None) else x == y
+                 for x, y in zip(av, bv)], type=pa.bool_())
         if a_t != b_t:
             target = _common_arrow(a_t, b_t)
             a = pc.cast(a, target, safe=False)

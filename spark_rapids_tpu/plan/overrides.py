@@ -22,7 +22,10 @@ from typing import Dict, List, Optional, Type
 from ..columnar import dtypes as T
 from ..config import (TpuConf, SQL_ENABLED, EXPLAIN, SHUFFLE_PARTITIONS,
                       TEST_ENABLED, DECIMAL_ENABLED, CAST_STRING_TO_FLOAT,
-                      BATCH_SIZE_ROWS)
+                      BATCH_SIZE_ROWS, ADAPTIVE_ENABLED,
+                      ADAPTIVE_TARGET_PARTITION_BYTES,
+                      ADAPTIVE_BROADCAST_BYTES, ADAPTIVE_SKEW_FACTOR,
+                      ADAPTIVE_SKEW_MIN_BYTES)
 from ..expr import core as ec
 from ..expr import (aggregates as eagg, arithmetic as ea, cast as ecast,
                     conditional as econd, datetime as edt, misc as emisc,
@@ -153,6 +156,13 @@ class ExprMeta:
         self.reasons: List[str] = []
         self.children = [ExprMeta(c, conf) for c in expr.children]
 
+    # ops that canonical-key-encode their inputs: inputs must be ORDERABLE
+    # scalars (the per-param TypeSig role of the reference's ExprChecks)
+    _KEY_ENCODING = (ep.EqualTo, ep.EqualNullSafe, ep.LessThan,
+                     ep.LessThanOrEqual, ep.GreaterThan,
+                     ep.GreaterThanOrEqual, ep.In, emisc.Murmur3Hash,
+                     emisc.Md5)
+
     def tag(self):
         cls = type(self.expr)
         rule = _EXPR_RULES.get(cls)
@@ -167,6 +177,16 @@ class ExprMeta:
                     self.reasons.append(r)
             except (ValueError, NotImplementedError) as e:
                 self.reasons.append(f"{cls.__name__}: {e}")
+        if isinstance(self.expr, self._KEY_ENCODING):
+            for c in self.expr.children:
+                try:
+                    cdt = c.dtype()
+                except (ValueError, NotImplementedError):
+                    continue
+                if not TS.ORDERABLE.supports(cdt):
+                    self.reasons.append(
+                        f"{cls.__name__}: input type {cdt.name} cannot be "
+                        f"key-encoded on TPU")
         if isinstance(self.expr, ecast.Cast):
             src = self.expr.children[0].dtype()
             if (src == T.STRING and self.expr.to.is_fractional and
@@ -263,6 +283,10 @@ class PlanMeta:
                         f"{what} key of type {dt.name} not supported on TPU")
         if isinstance(p, L.Aggregate):
             _keys_orderable(p.group_exprs, "group-by")
+        if isinstance(p, L.Distinct):
+            _keys_orderable(
+                [ec.AttributeReference(f.name, f.dtype, f.nullable)
+                 for f in p.schema], "distinct")
         if isinstance(p, L.Sort):
             _keys_orderable([o.expr for o in p.orders], "sort")
         if isinstance(p, L.Join):
@@ -492,10 +516,20 @@ class Planner:
             if pby and same_keys:
                 part = HashPartitioner(pby, min(self.default_partitions,
                                                 nparts))
-                child = EX.TpuShuffleExchange(child, part)
+                child = self._aqe_read(EX.TpuShuffleExchange(child, part))
             else:
                 child = EX.TpuCoalescePartitions(child)
         return TpuWindow(p, child)
+
+    def _aqe_read(self, exchange):
+        """Wrap an exchange in a coalescing AQE read when enabled
+        (GpuCustomShuffleReaderExec insertion, GpuTransitionOverrides
+        role)."""
+        if not self.conf.get(ADAPTIVE_ENABLED):
+            return exchange
+        from ..exec.adaptive import TpuAQEShuffleRead
+        return TpuAQEShuffleRead(
+            exchange, self.conf.get(ADAPTIVE_TARGET_PARTITION_BYTES))
 
     # -- aggregate: partial -> exchange -> final (aggregate.scala modes) ---
     def _plan_aggregate(self, p: L.Aggregate,
@@ -512,7 +546,8 @@ class Planner:
                     for f in list(buf_schema)[:len(p.group_exprs)]]
             n = min(self._pick_partitions(p), nparts)
             part = HashPartitioner(keys, n)
-            shuffled: PhysicalPlan = EX.TpuShuffleExchange(partial, part)
+            shuffled: PhysicalPlan = self._aqe_read(
+                EX.TpuShuffleExchange(partial, part))
         else:
             shuffled = EX.TpuCoalescePartitions(partial)
         return TA.TpuHashAggregate(p.group_exprs, p.aggs, shuffled,
@@ -538,6 +573,14 @@ class Planner:
             return TJ.TpuBroadcastHashJoin(p, bcast, right,
                                            build_right=False)
         n = self._pick_partitions(p.children[0], p.children[1])
+        if self.conf.get(ADAPTIVE_ENABLED):
+            from ..exec.adaptive import TpuAdaptiveShuffledJoin
+            return TpuAdaptiveShuffledJoin(
+                p, left, right, build_right=build_right, num_partitions=n,
+                broadcast_bytes=self.conf.get(ADAPTIVE_BROADCAST_BYTES),
+                target_bytes=self.conf.get(ADAPTIVE_TARGET_PARTITION_BYTES),
+                skew_factor=self.conf.get(ADAPTIVE_SKEW_FACTOR),
+                skew_min_bytes=self.conf.get(ADAPTIVE_SKEW_MIN_BYTES))
         lpart = HashPartitioner(p.left_keys, n)
         rpart = HashPartitioner(p.right_keys, n)
         lex = EX.TpuShuffleExchange(left, lpart)

@@ -58,6 +58,14 @@ class ShuffleCatalog:
             entries = self._store.get(block, [])
         return [e.materialize() for e in entries]
 
+    def stats_for_block(self, block: ShuffleBlockId):
+        """(bytes, rows) without materializing (stays spilled —
+        SpillableBatch caches both; the MapOutputStatistics role)."""
+        with self._lock:
+            entries = self._store.get(block, [])
+            return (sum(e.nbytes for e in entries),
+                    sum(e.num_rows for e in entries))
+
     def blocks_for_reduce(self, shuffle_id: int,
                           reduce_id: int) -> List[ShuffleBlockId]:
         with self._lock:

@@ -191,11 +191,14 @@ class CatalogRequestHandler(ShuffleRequestHandler):
                         del self._meta_cache[block]
                     return blob
         # miss (concurrent transfer drained the entry): re-flatten once
-        # and re-seed the cache for this transfer's remaining batches
+        # and re-seed — but never overwrite an entry another transfer
+        # re-seeded meanwhile, or its partially-served blob list would be
+        # clobbered and stranded entries could never drain to all-None
         blobs = [blob for _, blob in self._flatten(block)]
         out = blobs[batch_index]
         blobs[batch_index] = None
         with self._cache_lock:
-            if any(b is not None for b in blobs):
+            if block not in self._meta_cache and \
+                    any(b is not None for b in blobs):
                 self._meta_cache[block] = blobs
         return out
