@@ -118,6 +118,24 @@ class DataFrame:
 
     withColumn = with_column
 
+    def cache(self) -> "DataFrame":
+        """Mark for parquet-encoded columnar caching (reference:
+        ParquetCachedBatchSerializer behind df.cache())."""
+        from ..plan.logical import CachedRelation
+        from ..exec.cache import CacheStorage
+        if not isinstance(self._plan, CachedRelation):
+            self._plan = CachedRelation(self._plan, CacheStorage())
+        return self
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        from ..plan.logical import CachedRelation
+        if isinstance(self._plan, CachedRelation):
+            self._plan.storage.invalidate()
+            self._plan = self._plan.children[0]
+        return self
+
     def with_column_renamed(self, old: str, new: str) -> "DataFrame":
         exprs = []
         for f in self.schema:
@@ -352,16 +370,6 @@ class DataFrame:
                 continue
             out[f.name] = c.data[:b.num_rows]
         return out
-
-    def cache(self) -> "DataFrame":
-        """Materialize once into an in-memory relation (cache-serializer
-
-        role; reference: ParquetCachedBatchSerializer)."""
-        t = self.session.execute_to_arrow(self._plan)
-        return DataFrame(L.LocalRelation(t), self.session)
-
-    persist = cache
-
 
 def _extract_equi_keys(cond: ec.Expression, lschema: Schema,
                        rschema: Schema):

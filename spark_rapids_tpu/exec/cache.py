@@ -1,0 +1,170 @@
+"""Columnar cache: df.cache()/persist() as parquet-encoded batches.
+
+Reference parity: ParquetCachedBatchSerializer
+(shims/spark311/ParquetCachedBatchSerializer.scala, ~1,500 LoC;
+docs/additional-functionality/cache-serializer.md): Spark's
+``df.cache()`` stores columnar batches as compressed Parquet bytes so
+cached data is small and deserializes straight back into columnar form.
+
+Here the cache storage holds one list of parquet blobs per partition
+(host memory — compressed parquet is the compact tier, exactly the
+reference's rationale).  The first full materialization fills the
+storage; later executions decode blobs straight to device batches and
+skip the child plan entirely.  A partially-consumed run (e.g. under a
+limit) discards its partial fill rather than caching a lie.
+"""
+from __future__ import annotations
+
+import io
+import threading
+from typing import List, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..columnar.arrow import from_arrow, to_arrow, schema_to_arrow
+from ..columnar.batch import ColumnarBatch
+from .base import PhysicalPlan, NUM_OUTPUT_ROWS
+from .tpu_basic import TpuExec
+
+
+class CacheStorage:
+    """Materialized cache state shared by every execution of a cached
+    plan (the CachedRDD/CachedBatch store role)."""
+
+    def __init__(self, compression: str = "snappy"):
+        self.compression = compression
+        self._partitions: Optional[List[List[bytes]]] = None
+        self._lock = threading.Lock()
+
+    @property
+    def ready(self) -> bool:
+        return self._partitions is not None
+
+    def offer(self, partitions: List[List[bytes]]):
+        with self._lock:
+            if self._partitions is None:
+                self._partitions = partitions
+
+    def partitions(self) -> List[List[bytes]]:
+        assert self._partitions is not None
+        return self._partitions
+
+    def invalidate(self):
+        with self._lock:
+            self._partitions = None
+
+    def nbytes(self) -> int:
+        with self._lock:
+            if self._partitions is None:
+                return 0
+            return sum(len(b) for p in self._partitions for b in p)
+
+
+def encode_batch(table: pa.Table, compression: str) -> bytes:
+    sink = io.BytesIO()
+    pq.write_table(table, sink, compression=compression)
+    return sink.getvalue()
+
+
+def decode_blob(blob: bytes) -> pa.Table:
+    return pq.read_table(io.BytesIO(blob))
+
+
+def fill_while_streaming(parts, storage: CacheStorage, to_table,
+                         on_batch=None):
+    """Shared fill protocol: tee each partition's stream into parquet
+    blobs; offer the fill only when EVERY partition was fully consumed
+    (a partial run — e.g. under a limit — must not cache a lie)."""
+    fill: List[List[bytes]] = [[] for _ in parts]
+    done = [False] * len(parts)
+
+    def run(part, idx):
+        for item in part:
+            if item.num_rows:
+                fill[idx].append(encode_batch(to_table(item),
+                                              storage.compression))
+            if on_batch is not None:
+                on_batch(item)
+            yield item
+        done[idx] = True
+        if all(done):
+            storage.offer(fill)
+    return [run(p, i) for i, p in enumerate(parts)]
+
+
+class TpuCachedExec(TpuExec):
+    """Serve from the parquet cache, or fill it while streaming through.
+
+    Reference: ParquetCachedBatchSerializer.convertColumnarBatchToCachedBatch
+    / convertCachedBatchToColumnarBatch.
+    """
+
+    def __init__(self, storage: CacheStorage, child: PhysicalPlan):
+        super().__init__(child)
+        self.storage = storage
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        if self.storage.ready:
+            return len(self.storage.partitions())
+        return self.children[0].num_partitions_hint()
+
+    def _node_string(self):
+        state = "hit" if self.storage.ready else "fill"
+        return f"TpuCachedExec[{state}, {self.storage.nbytes()}B]"
+
+    def execute(self):
+        if self.storage.ready:
+            return [self._decode_part(p) for p in self.storage.partitions()]
+        def count(b):
+            self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
+        return fill_while_streaming(
+            self.children[0].execute(), self.storage, to_arrow,
+            on_batch=count)
+
+    def _decode_part(self, blobs: List[bytes]):
+        got = False
+        for blob in blobs:
+            b = from_arrow(decode_blob(blob))
+            got = True
+            self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
+            yield b
+        if not got:
+            yield ColumnarBatch.empty(self.output_schema)
+
+
+class CpuCachedExec(PhysicalPlan):
+    """CPU-engine variant: serves/fills the same parquet blobs as
+    pa.Tables (the CPU codec path of the reference serializer)."""
+
+    columnar = False
+
+    def __init__(self, storage: CacheStorage, child: PhysicalPlan):
+        super().__init__(child)
+        self.storage = storage
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        if self.storage.ready:
+            return len(self.storage.partitions())
+        return self.children[0].num_partitions_hint()
+
+    def execute(self):
+        if self.storage.ready:
+            def decode(blobs):
+                got = False
+                for blob in blobs:
+                    got = True
+                    yield decode_blob(blob)
+                if not got:
+                    yield schema_to_arrow(self.output_schema).empty_table()
+            return [decode(p) for p in self.storage.partitions()]
+        return fill_while_streaming(
+            self.children[0].execute(), self.storage, lambda t: t)

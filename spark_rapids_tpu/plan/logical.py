@@ -332,6 +332,21 @@ class Generate(LogicalPlan):
         return Schema(base)
 
 
+class CachedRelation(LogicalPlan):
+    """df.cache(): parquet-encoded columnar cache over the child.
+
+    Reference: ParquetCachedBatchSerializer (shims/spark311) behind
+    Spark's InMemoryRelation."""
+
+    def __init__(self, child: LogicalPlan, storage):
+        self.children = [child]
+        self.storage = storage   # exec.cache.CacheStorage
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
 class WriteFile(LogicalPlan):
     def __init__(self, fmt: str, path: str, child: LogicalPlan,
                  mode: str = "overwrite", options: Dict[str, Any] = None):
