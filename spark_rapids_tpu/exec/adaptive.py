@@ -94,7 +94,7 @@ class TpuAQEShuffleRead(TpuExec):
         def read_group(pids):
             got = False
             for pid in pids:
-                for b in ex.read_reduce(pid):
+                for b in ex.stream_reduce(pid):
                     if b.num_rows == 0:
                         continue
                     got = True
@@ -179,7 +179,6 @@ class TpuAdaptiveShuffledJoin(TpuExec):
         # binding schemas (same pre- and post-exchange)
         join = TJ.TpuShuffledHashJoin(p, left, right,
                                       build_right=self.build_right)
-        self._joiner = join
 
         if can_broadcast:
             self.strategy = "broadcast"

@@ -81,22 +81,27 @@ class TpuShuffleExchange(TpuExec):
             stats.append((nbytes, rows))
         return stats
 
-    def read_reduce(self, reduce_id: int):
-        """All batches of one reduce partition (materializes if needed)."""
+    def stream_reduce(self, reduce_id: int):
+        """Stream one reduce partition batch-by-batch (batches unspill
+        one at a time — the memory-bounded path)."""
         self.ensure_materialized()
         mgr = ShuffleManager.get()
-        out = []
         for b in mgr.read_partition(self._shuffle_id, reduce_id):
             self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
-            out.append(b)
-        return out
+            yield b
+
+    def read_reduce(self, reduce_id: int):
+        """All batches of one reduce partition as a list — for AQE
+        callers that re-group/slice partitions; plain execution streams
+        via stream_reduce instead."""
+        return list(self.stream_reduce(reduce_id))
 
     def execute(self):
         schema = self.output_schema
 
         def reduce_iter(reduce_id):
             got = False
-            for b in self.read_reduce(reduce_id):
+            for b in self.stream_reduce(reduce_id):
                 got = True
                 yield b
             if not got:

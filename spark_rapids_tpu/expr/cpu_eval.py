@@ -123,6 +123,27 @@ def _nan_flags(x, n):
     return pc.coalesce(pc.is_nan(v), pa.scalar(False))
 
 
+def _nested_eq(x, y) -> bool:
+    """Recursive equality with Spark ordering semantics: NaN == NaN,
+    nulls inside containers compare equal to each other."""
+    import math
+    if x is None and y is None:
+        return True
+    if x is None or y is None:
+        return False
+    if isinstance(x, float) and isinstance(y, float):
+        if math.isnan(x) and math.isnan(y):
+            return True
+        return x == y
+    if isinstance(x, (list, tuple)) and isinstance(y, (list, tuple)):
+        return len(x) == len(y) and all(
+            _nested_eq(a, b) for a, b in zip(x, y))
+    if isinstance(x, dict) and isinstance(y, dict):
+        return set(x) == set(y) and all(
+            _nested_eq(v, y[k]) for k, v in x.items())
+    return x == y
+
+
 def _cmp(op):
     def f(e, t):
         a = _ev(e.children[0], t)
@@ -136,7 +157,7 @@ def _cmp(op):
             av = _arr(a, t.num_rows).to_pylist()
             bv = _arr(b, t.num_rows).to_pylist()
             return pa.array(
-                [None if (x is None or y is None) else x == y
+                [None if (x is None or y is None) else _nested_eq(x, y)
                  for x, y in zip(av, bv)], type=pa.bool_())
         if a_t != b_t:
             target = _common_arrow(a_t, b_t)
@@ -181,6 +202,28 @@ def _common_arrow(at, bt):
         sb = bt.scale if pa.types.is_decimal(bt) else 0
         return pa.decimal128(38, max(sa, sb))
     return at
+
+
+def _eq_null_safe(e, t):
+    a = _arr(_ev(e.children[0], t), t.num_rows).to_pylist()
+    b = _arr(_ev(e.children[1], t), t.num_rows).to_pylist()
+    return pa.array([_nested_eq(x, y) for x, y in zip(a, b)],
+                    type=pa.bool_())
+
+
+def _in_set(e, t):
+    vals = [v for v in e.values if v is not None]
+    has_null = any(v is None for v in e.values)
+    a = _arr(_ev(e.children[0], t), t.num_rows).to_pylist()
+    out = []
+    for x in a:
+        if x is None:
+            out.append(None)
+        elif any(_nested_eq(x, v) for v in vals):
+            out.append(True)
+        else:
+            out.append(None if has_null else False)
+    return pa.array(out, type=pa.bool_())
 
 
 def _and(e, t):
@@ -778,3 +821,22 @@ def _register_struct_map():
 
 
 _register_struct_map()
+
+
+def _register_predicates():
+    _DISPATCH[P.EqualNullSafe] = _eq_null_safe
+    _DISPATCH[P.In] = _in_set
+
+    def _hash_guard(e, t):
+        from ..columnar import dtypes as TT
+        for c in e.children:
+            if c.dtype().is_nested:
+                raise NotImplementedError(
+                    "hash over nested types is not supported on either "
+                    "engine yet")
+        return _fallback_rowwise(e, t)
+
+    _DISPATCH[M.Murmur3Hash] = _hash_guard
+
+
+_register_predicates()

@@ -141,6 +141,9 @@ expr_rule(ecoll.ArrayMax, TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
 from ..udf.python_udf import PythonUDF as _PyUDF, PandasUDF as _PdUDF  # noqa: E402
 expr_rule(_PyUDF, TS.ALL_SUPPORTED)
 expr_rule(_PdUDF, TS.ALL_SUPPORTED)
+# native device UDFs (RapidsUDF.java / GpuScalaUDF role)
+from ..udf.native_udf import TpuUDFExpression as _TpuUDF  # noqa: E402
+expr_rule(_TpuUDF, TS.WITH_NESTED)
 from ..expr import window_funcs as _wfn  # noqa: E402
 for _cls in [_wfn.RowNumber, _wfn.Rank, _wfn.DenseRank, _wfn.Lead,
              _wfn.Lag]:
@@ -160,8 +163,7 @@ class ExprMeta:
     # scalars (the per-param TypeSig role of the reference's ExprChecks)
     _KEY_ENCODING = (ep.EqualTo, ep.EqualNullSafe, ep.LessThan,
                      ep.LessThanOrEqual, ep.GreaterThan,
-                     ep.GreaterThanOrEqual, ep.In, emisc.Murmur3Hash,
-                     emisc.Md5)
+                     ep.GreaterThanOrEqual, ep.In, emisc.Murmur3Hash)
 
     def tag(self):
         cls = type(self.expr)
@@ -430,6 +432,9 @@ class Planner:
             return CpuWindow(p, children[0])
         if isinstance(p, L.Generate):
             return X.CpuGenerate(p, children[0])
+        if isinstance(p, L.CachedRelation):
+            from ..exec.cache import CpuCachedExec
+            return CpuCachedExec(p.storage, children[0])
         if isinstance(p, L.Scan):
             from ..io.planner import cpu_scan_exec
             return cpu_scan_exec(p, self.conf)
@@ -503,6 +508,9 @@ class Planner:
         if isinstance(p, L.Generate):
             from ..exec.tpu_generate import TpuGenerate
             return TpuGenerate(p, children[0])
+        if isinstance(p, L.CachedRelation):
+            from ..exec.cache import TpuCachedExec
+            return TpuCachedExec(p.storage, children[0])
         raise NotImplementedError(f"no TPU conversion for {p.name}")
 
     def _plan_window(self, p: L.Window, child: PhysicalPlan) -> PhysicalPlan:

@@ -8,6 +8,8 @@ from ..columnar import dtypes as T
 from ..expr import core as ec
 from .compiler import compile_udf  # noqa: F401
 from .python_udf import PythonUDF, PandasUDF  # noqa: F401
+from .native_udf import (TpuUDF, ArrayMathUDF, TpuUDFExpression,  # noqa: F401
+                         tpu_udf)
 
 
 def udf(fn: Callable = None, return_type=None):

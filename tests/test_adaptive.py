@@ -53,6 +53,17 @@ def _tables(s, n_left=200, n_right=20):
     return left, right
 
 
+def _find(plan, cls):
+    """Depth-first search for the first exec node of the given class."""
+    if isinstance(plan, cls):
+        return plan
+    for c in plan.children:
+        got = _find(c, cls)
+        if got:
+            return got
+    return None
+
+
 AQE_ON = {"spark.rapids.tpu.sql.adaptive.enabled": "true"}
 AQE_OFF = {"spark.rapids.tpu.sql.adaptive.enabled": "false"}
 
@@ -79,16 +90,7 @@ class TestAdaptiveJoin:
             return rows, plan
         rows, plan = with_tpu_session(fn, conf=AQE_ON)
         from spark_rapids_tpu.exec.adaptive import TpuAdaptiveShuffledJoin
-
-        def find(node):
-            if isinstance(node, TpuAdaptiveShuffledJoin):
-                return node
-            for c in node.children:
-                got = find(c)
-                if got:
-                    return got
-            return None
-        node = find(plan)
+        node = _find(plan, TpuAdaptiveShuffledJoin)
         assert node is not None
         assert node.strategy == "broadcast"
         # ids 0..499 joined on id%7 against keys 0..4
@@ -106,16 +108,7 @@ class TestAdaptiveJoin:
             return df._last_physical_plan
         plan = with_tpu_session(fn, conf=conf)
         from spark_rapids_tpu.exec.adaptive import TpuAdaptiveShuffledJoin
-
-        def find(node):
-            if isinstance(node, TpuAdaptiveShuffledJoin):
-                return node
-            for c in node.children:
-                got = find(c)
-                if got:
-                    return got
-            return None
-        node = find(plan)
+        node = _find(plan, TpuAdaptiveShuffledJoin)
         assert node is not None
         assert node.strategy == "shuffled"
 
@@ -160,16 +153,7 @@ class TestAdaptiveAggregate:
             return rows, df._last_physical_plan
         rows, plan = with_tpu_session(fn, conf=AQE_ON)
         from spark_rapids_tpu.exec.adaptive import TpuAQEShuffleRead
-
-        def find(node):
-            if isinstance(node, TpuAQEShuffleRead):
-                return node
-            for c in node.children:
-                got = find(c)
-                if got:
-                    return got
-            return None
-        node = find(plan)
+        node = _find(plan, TpuAQEShuffleRead)
         assert node is not None
         # tiny data: everything coalesces into one read group
         assert len(node._groups) == 1

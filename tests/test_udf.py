@@ -88,3 +88,81 @@ class TestUdfEndToEnd:
         assert_tpu_and_cpu_are_equal_collect(
             lambda s: gen_df(s, {"a": IntGen(lo=0, hi=30)}, N)
             .filter(my(F.col("a"))))
+
+
+class TestNativeTpuUDF:
+    """TpuUDF: the RapidsUDF.java-role interface — user columnar code
+    running natively on device."""
+
+    def test_array_math_udf_parity(self):
+        from spark_rapids_tpu.udf import tpu_udf
+        from spark_rapids_tpu.columnar import dtypes as T
+        from harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.api import functions as F
+
+        @tpu_udf(return_type=T.FLOAT64)
+        def scaled(x, y):
+            return x * 2.0 + y
+
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: s.range(0, 50).select(
+                F.col("id"),
+                scaled(F.col("id").cast("double"),
+                       (F.col("id") % 3).cast("double")).alias("u")))
+
+    def test_null_semantics(self):
+        import pyarrow as pa
+        from spark_rapids_tpu.udf import tpu_udf
+        from spark_rapids_tpu.columnar import dtypes as T
+        from harness import with_tpu_session
+        from spark_rapids_tpu.api import functions as F
+
+        @tpu_udf(return_type=T.INT64)
+        def inc(x):
+            return x + 1
+
+        rows = with_tpu_session(
+            lambda s: s.create_dataframe(pa.table({"a": [1, None, 3]}))
+            .select(inc(F.col("a")).alias("u")).collect())
+        assert rows == [(2,), (None,), (4,)]
+
+    def test_custom_udf_class_on_strings(self):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.udf import TpuUDF, tpu_udf
+        from spark_rapids_tpu.columnar import dtypes as T
+        from spark_rapids_tpu.columnar.column import Column, StringColumn
+        from harness import with_tpu_session
+        from spark_rapids_tpu.api import functions as F
+        import pyarrow as pa
+
+        class ByteLen(TpuUDF):
+            """Byte length via the offsets buffer — device int math (the
+            StringWordCount udf-examples pattern)."""
+            return_type = T.INT32
+
+            def evaluate_columnar(self, num_rows, col: StringColumn):
+                lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+                return Column(T.INT32, lens, col.validity)
+
+        fn = tpu_udf(ByteLen())
+        rows = with_tpu_session(
+            lambda s: s.create_dataframe(
+                pa.table({"s": ["ab", None, "xyzé"]}))
+            .select(fn(F.col("s")).alias("n")).collect())
+        assert rows == [(2,), (None,), (5,)]
+
+    def test_runs_on_tpu_plan(self):
+        from spark_rapids_tpu.udf import tpu_udf
+        from spark_rapids_tpu.columnar import dtypes as T
+        from harness import with_tpu_session
+        from spark_rapids_tpu.api import functions as F
+
+        @tpu_udf(return_type=T.INT64)
+        def tri(x):
+            return x * (x + 1) // 2
+
+        rows = with_tpu_session(
+            lambda s: s.range(0, 10).select(tri(F.col("id")).alias("t"))
+            .collect(),
+            conf={"spark.rapids.tpu.sql.test.enabled": "true"})
+        assert rows[-1] == (45,)
