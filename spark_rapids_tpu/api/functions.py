@@ -406,3 +406,7 @@ def map_keys(c) -> Col:
 def map_values(c) -> Col:
     from ..expr import collections as ecoll
     return Col(ecoll.MapValues(_c(c)))
+
+
+def pmod(a, b) -> Col:
+    return Col(ea.Pmod(_c(a), _expr(b)))

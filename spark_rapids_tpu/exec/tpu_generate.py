@@ -51,6 +51,9 @@ class TpuGenerate(TpuExec):
 
     def _generate(self, batch: ColumnarBatch, bound, pos: bool, outer: bool,
                   out_schema: Schema) -> ColumnarBatch:
+        fast = self._literal_array_fast_path(batch, bound, pos, out_schema)
+        if fast is not None:
+            return fast
         lcol = ec.eval_as_column(bound, batch)
         out_offsets, total = lk.explode_offsets(
             lcol.offsets, lcol.validity, batch.num_rows, outer)
@@ -69,6 +72,39 @@ class TpuGenerate(TpuExec):
         if gen_col.capacity != out_cap:
             gen_col = gen_col.with_capacity(out_cap, n)
         cols.append(gen_col)
+        return ColumnarBatch(out_schema, cols, n)
+
+    def _literal_array_fast_path(self, batch: ColumnarBatch, bound,
+                                 pos: bool, out_schema: Schema):
+        """explode(array(lit...)) is a pure k-way row repeat: out[j] =
+        in[j // k], value[j] = consts[j % k].  The reference's mortgage
+        ETL leans on exactly this idiom ("explode ... is actually
+        slightly more efficient than a cross join",
+        MortgageSpark.scala:271) — no offsets machinery, one gather.
+        """
+        from ..expr.collections import CreateArray
+        if not isinstance(bound, CreateArray) or not bound.children or \
+                not all(isinstance(c, ec.Literal) for c in bound.children):
+            return None
+        values = [c.value for c in bound.children]
+        if any(v is None for v in values):
+            return None
+        k = len(values)
+        n = batch.num_rows * k
+        out_cap = bucket_capacity(max(1, n))
+        j = jnp.arange(out_cap, dtype=jnp.int32)
+        row_idx = j // k
+        posv = j % k
+        live = j < n
+        cols = [c.gather(row_idx).mask_validity(live)
+                for c in batch.columns]
+        if pos:
+            cols.append(Column(T.INT32, posv, live))
+        et = bound.dtype().element_type
+        consts = Column.from_numpy(values, dtype=et,
+                                   capacity=bucket_capacity(k))
+        gen = consts.gather(posv).mask_validity(live)
+        cols.append(gen)
         return ColumnarBatch(out_schema, cols, n)
 
     def _node_string(self):

@@ -274,25 +274,17 @@ class SortArray(ec.Expression):
         n_elems = int(np.asarray(col.offsets)[min(batch.num_rows,
                                                   col.capacity)])
         words = canon.value_words(col.elements, n_elems)
-        # fold multi-word keys (strings) into one rank via stable repeated
-        # sorts: sort by least-significant word first
-        perm = jnp.arange(ecap)
         evalid = col.elements.validity
-        # LSD passes: least-significant word first, each pass stable, so the
-        # final pass (null rank + segment) dominates
-        for w in reversed(words):
-            k = w if self.asc else ~w
-            k = jnp.take(k, perm)
-            segp = jnp.take(seg, perm)
-            order = jnp.lexsort((k, segp.astype(jnp.uint32)))
-            perm = jnp.take(perm, order)
-        # final pass: null rank then segment (stable keeps value order)
         nk = jnp.where(evalid, jnp.uint64(1), jnp.uint64(0)) if self.asc \
             else jnp.where(evalid, jnp.uint64(0), jnp.uint64(1))
-        nkp = jnp.take(nk, perm)
-        segp = jnp.take(seg, perm)
-        order = jnp.lexsort((nkp, segp.astype(jnp.uint32)))
-        perm = jnp.take(perm, order)
+        # LSD chained pair-sorts (kernels/sort.py rationale): significance
+        # order is segment > null rank > value words, so least first
+        from ..kernels.sort import _stable_pair_sort
+        perm = jnp.arange(ecap, dtype=jnp.int32)
+        passes = list(reversed([seg.astype(jnp.uint64), nk] +
+                               [(w if self.asc else ~w) for w in words]))
+        for w in passes:
+            perm = _stable_pair_sort(jnp.take(w, perm), perm)
         elems = col.elements.gather(perm)
         return ListColumn(col.dtype, col.offsets, elems, col.validity)
 

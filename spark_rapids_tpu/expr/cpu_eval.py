@@ -828,7 +828,6 @@ def _register_predicates():
     _DISPATCH[P.In] = _in_set
 
     def _hash_guard(e, t):
-        from ..columnar import dtypes as TT
         for c in e.children:
             if c.dtype().is_nested:
                 raise NotImplementedError(

@@ -126,22 +126,3 @@ def segmented_any(flags, seg_ids, num_segments: int):
                                num_segments=num_segments) > 0
 
 
-@functools.partial(jax.jit, static_argnames=("asc", "nulls_first"))
-def sort_within_lists(seg_ids, keys, valid, asc: bool, nulls_first: bool):
-    """Stable segmented sort permutation: order elements inside each list.
-
-    ``keys``: uint64 canonical order words (kernels/canon.py encoding).
-    Returns a permutation [elem_cap] such that taking elements in that
-    order yields each list sorted.  Null placement per Spark sort_array:
-    asc -> nulls first, desc -> nulls last (caller passes nulls_first).
-    """
-    k = keys.astype(jnp.uint64)
-    if not asc:
-        k = ~k
-    if nulls_first:
-        null_key = jnp.where(valid, jnp.uint64(1), jnp.uint64(0))
-    else:
-        null_key = jnp.where(valid, jnp.uint64(0), jnp.uint64(1))
-    # lexsort: last key is primary
-    perm = jnp.lexsort((k, null_key, seg_ids.astype(jnp.uint32)))
-    return perm

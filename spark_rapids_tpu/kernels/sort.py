@@ -2,9 +2,17 @@
 
 GpuSortExec.scala:56, SortUtils.scala).
 
-TPU-first: a single multi-operand ``lax.sort`` over canonical uint64 key
-words (kernels/canon.py) + a trailing iota operand that yields the
-permutation.  One code path for every dtype, stable, fully on-device.
+TPU-first: multi-key sorts run as **LSD chained single-key passes** —
+for each canonical uint64 key word (kernels/canon.py), least-significant
+first, a stable (key, perm) ``lax.sort`` re-orders the permutation.
+Rationale: a variadic ``lax.sort`` compiles a distinct XLA comparator
+per (capacity, operand-count) pair, and on real TPU hardware each such
+compile costs tens of seconds through the compile tunnel (measured:
+~90s for a 6-key sort at 32k rows vs ~20s for the single-key kernel).
+Chaining means ONE compiled pair-sort per capacity bucket serves every
+sort/group-by/join/window in the engine, at the cost of K executions of
+that one cached kernel — the right trade on an architecture where
+compiles are expensive and reused kernels are nearly free.
 """
 from __future__ import annotations
 
@@ -15,18 +23,29 @@ import jax.numpy as jnp
 from jax import lax
 
 
+@jax.jit
+def _stable_pair_sort(key, perm):
+    """The one compiled sort primitive: stable ascending by ``key``,
+    carrying ``perm`` — shape-cached per capacity bucket only."""
+    _, out = lax.sort((key, perm), num_keys=1, is_stable=True)
+    return out
+
+
 def sort_permutation(words: List[jnp.ndarray]) -> jnp.ndarray:
     """Stable ascending sort over word tuples; returns permutation indices."""
     cap = words[0].shape[0]
-    iota = jnp.arange(cap, dtype=jnp.int32)
-    *_, perm = lax.sort(tuple(words) + (iota,), num_keys=len(words),
-                        is_stable=True)
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    if len(words) == 1:
+        return _stable_pair_sort(words[0].astype(jnp.uint64), perm)
+    # LSD: least-significant word first; stability makes later (more
+    # significant) passes dominate
+    for w in reversed(words):
+        k = jnp.take(w.astype(jnp.uint64), perm)
+        perm = _stable_pair_sort(k, perm)
     return perm
 
 
 def sorted_words(words: List[jnp.ndarray]):
     """Sort and also return the sorted word arrays (for boundary detection)."""
-    cap = words[0].shape[0]
-    iota = jnp.arange(cap, dtype=jnp.int32)
-    out = lax.sort(tuple(words) + (iota,), num_keys=len(words), is_stable=True)
-    return list(out[:-1]), out[-1]
+    perm = sort_permutation(words)
+    return [jnp.take(w, perm) for w in words], perm

@@ -1,0 +1,36 @@
+"""Mortgage ETL pipeline parity test (tiny scale).
+
+Pattern parity: reference mortgage_test.py (integration_tests) over the
+MortgageSpark.scala ETL — here the whole pipeline must agree with the
+CPU oracle, covering multi-key joins, conditional aggregation, the
+explode(array) expansion, and floor/pmod arithmetic in one plan.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from benchmarks.mortgage import generate, etl  # noqa: E402
+from harness import assert_tpu_and_cpu_are_equal_collect  # noqa: E402
+
+
+def test_mortgage_etl_parity(tmp_path):
+    d = str(tmp_path)
+    generate(d, scale=0.0004, seed=7)
+
+    def fn(s):
+        return etl(s, d)
+    rows = assert_tpu_and_cpu_are_equal_collect(
+        fn, conf={"spark.rapids.tpu.sql.shuffle.partitions": "2"})
+    assert len(rows) > 0
+
+
+def test_mortgage_counts(tmp_path):
+    from harness import with_tpu_session, with_cpu_session
+    d = str(tmp_path)
+    generate(d, scale=0.0004, seed=11)
+    n_tpu = with_tpu_session(
+        lambda s: etl(s, d).count(),
+        conf={"spark.rapids.tpu.sql.shuffle.partitions": "2"})
+    n_cpu = with_cpu_session(lambda s: etl(s, d).count())
+    assert n_tpu == n_cpu > 0
