@@ -1,0 +1,139 @@
+"""Fused expression evaluation: whole projection trees under one jit.
+
+TPU-first rationale (SURVEY.md §7 / pallas guide): the engine's eager
+mode dispatches every jnp op separately — on real hardware each dispatch
+is a host->device round trip, so a 20-op projection pays 20 RPCs.  Under
+``jax.jit`` the entire bound expression tree traces into ONE XLA
+computation: elementwise ops fuse, intermediates never materialize in
+HBM, and a batch is processed with a single dispatch.  This is the
+moral equivalent of the reference running a whole projection as one
+fused cuDF AST kernel instead of op-by-op JNI calls
+(GpuProjectExec + cuDF compute-on-columns).
+
+Fusion is per-expression: the fusable subset of a projection jits as one
+computation; the rest (strings/lists size buffers host-side; UDF/rand/
+partition-id expressions carry host state, flagged via
+``Expression.trace_safe``) evaluates eagerly, and outputs merge by
+position — one string passthrough column doesn't forfeit fusion for the
+numeric expressions beside it.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.column import Column
+from ..columnar.batch import ColumnarBatch
+from ..expr import core as ec
+
+_LOG = logging.getLogger("spark_rapids_tpu.exec.fused")
+
+
+def _tree_fusable(expr: ec.Expression) -> bool:
+    """Conservative gate: every node must be fixed-width (strings/nested
+    kernels size outputs on host and cannot trace) AND declared
+    trace-safe (no host state: UDFs, rand, partition ids)."""
+    if not expr.trace_safe:
+        return False
+    try:
+        dt = expr.dtype()
+    except (ValueError, NotImplementedError):
+        return False
+    if dt == T.STRING or dt.is_nested or dt == T.NULL:
+        return False
+    return all(_tree_fusable(c) for c in expr.children)
+
+
+def _needed_ordinals(exprs: Sequence[ec.Expression]) -> List[int]:
+    out = set()
+    for e in exprs:
+        for r in e.collect(lambda n: isinstance(n, ec.BoundReference)):
+            out.add(r.ordinal)
+    return sorted(out)
+
+
+class FusedEval:
+    """One jitted computation for the fusable subset of bound exprs.
+
+    ``__call__(batch) -> Optional[List[Column]]`` returns one Column per
+    input expression (fused and eager results merged by position), or
+    None when nothing could fuse — callers then use their own eager
+    path unchanged.  jax.jit's shape-keyed cache handles
+    per-capacity-bucket compilation automatically.
+    """
+
+    def __init__(self, bound_exprs: Sequence[ec.Expression], child_schema):
+        self.exprs = list(bound_exprs)
+        self.schema = child_schema
+        self.fusable = [_tree_fusable(e) for e in self.exprs]
+        self.fused_idx = [i for i, ok in enumerate(self.fusable) if ok]
+        self.out_dtypes = []
+        for e in self.exprs:
+            try:
+                self.out_dtypes.append(e.dtype())
+            except (ValueError, NotImplementedError):
+                self.out_dtypes.append(None)
+        self.needed = _needed_ordinals(
+            [self.exprs[i] for i in self.fused_idx])
+        self.ok = bool(self.fused_idx)
+        self._jitted = jax.jit(self._eval, static_argnums=(0,)) \
+            if self.ok else None
+
+    # traced function: capacity static; column buffers + live row count
+    # are device values
+    def _eval(self, capacity: int, datas, valids, num_rows):
+        by_ordinal = {}
+        for i, d, v in zip(self.needed, datas, valids):
+            by_ordinal[i] = Column(self.schema[i].dtype, d, v)
+        # only referenced ordinals are real; BoundReference never touches
+        # the rest
+        filled = [by_ordinal.get(i) for i in range(len(self.schema))]
+        batch = _TracedBatch(self.schema, filled, num_rows, capacity)
+        outs = []
+        for i in self.fused_idx:
+            r = self.exprs[i].columnar_eval(batch)
+            if isinstance(r, ec.Scalar):
+                r = r.to_column(capacity, None)
+                # scalar fills are valid only on live rows
+                live = jnp.arange(capacity) < num_rows
+                r = Column(r.dtype, r.data, r.validity & live)
+            outs.append((r.data, r.validity))
+        return outs
+
+    def __call__(self, batch: ColumnarBatch) -> Optional[List[Column]]:
+        if not self.ok:
+            return None
+        if not all(type(batch.columns[i]) is Column for i in self.needed):
+            return None
+        datas = tuple(batch.columns[i].data for i in self.needed)
+        valids = tuple(batch.columns[i].validity for i in self.needed)
+        try:
+            fused_out = self._jitted(batch.capacity, datas, valids,
+                                     jnp.int32(batch.num_rows))
+        except Exception:  # noqa: BLE001 - fall back, but loudly
+            _LOG.warning(
+                "fused evaluation failed for %s; falling back to eager",
+                [repr(self.exprs[i]) for i in self.fused_idx],
+                exc_info=True)
+            self.ok = False
+            return None
+        cols: List[Optional[Column]] = [None] * len(self.exprs)
+        for i, (d, v) in zip(self.fused_idx, fused_out):
+            cols[i] = Column(self.out_dtypes[i], d, v)
+        for i, c in enumerate(cols):
+            if c is None:
+                cols[i] = ec.eval_as_column(self.exprs[i], batch)
+        return cols
+
+class _TracedBatch(ColumnarBatch):
+    """ColumnarBatch whose num_rows is a traced scalar (no host int)."""
+
+    def __init__(self, schema, columns, num_rows, capacity):
+        self.schema = schema
+        self.columns = list(columns)
+        self.num_rows = num_rows        # jnp scalar under trace
+        self._capacity = capacity

@@ -120,14 +120,18 @@ class TpuProject(TpuExec):
                        for e in self.exprs])
 
     def execute(self):
+        from .fused import FusedEval
         child_schema = self.children[0].output_schema
         bound = [e.bind(child_schema) for e in self.exprs]
         out_schema = self.output_schema
+        fused = FusedEval(bound, child_schema)
 
         def run(part):
             for batch in part:
                 with timed(self.metrics[OP_TIME]):
-                    cols = [ec.eval_as_column(b, batch) for b in bound]
+                    cols = fused(batch)
+                    if cols is None:
+                        cols = [ec.eval_as_column(b, batch) for b in bound]
                 out = ColumnarBatch(out_schema, cols, batch.num_rows)
                 self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
@@ -150,13 +154,17 @@ class TpuFilter(TpuExec):
         return self.children[0].output_schema
 
     def execute(self):
+        from .fused import FusedEval
         child_schema = self.children[0].output_schema
         bound = self.condition.bind(child_schema)
+        fused = FusedEval([bound], child_schema)
 
         def run(part):
             for batch in part:
                 with timed(self.metrics[OP_TIME]):
-                    pred = ec.eval_as_column(bound, batch)
+                    fcols = fused(batch)
+                    pred = fcols[0] if fcols is not None else \
+                        ec.eval_as_column(bound, batch)
                     keep = pred.data.astype(bool) & pred.validity
                     idx, cnt = bk.compact_indices(keep, batch.num_rows)
                     n = int(cnt)

@@ -49,6 +49,33 @@ class Cast(Expression):
         return f"CAST({self.children[0]!r} AS {self.to.name})"
 
 
+def _float_to_i64_exact(x) -> jnp.ndarray:
+    """float -> int64, guarded against out-of-range UB.
+
+    f64 -> s64 is exact on both backends (verified on chip with x64
+    enabled), but values at/beyond +-2^63 are undefined in the
+    conversion, so clamp in float space at the nearest safely
+    representable bound first; the caller's integer clamp handles the
+    target-type saturation.  Note the subtlety this replaces: clamping
+    in FLOAT space at a narrower type's bound (e.g. 2147483647.0) then
+    converting via s32 lands one ulp short on chip — saturate with
+    integer comparisons instead.
+    """
+    from ..kernels.canon import _f64_bitcast_supported
+    if _f64_bitcast_supported():
+        # real f64 backend: every double below 2^63 converts exactly
+        lim = 9223372036854774784.0   # largest double below 2^63
+    else:
+        # on chip the (hi, lo) f32-pair representation needs hi strictly
+        # inside s64 range; values in the last 2^39-wide window saturate
+        # (documented incompat — emulated f64 ulp there is 2^15 anyway)
+        lim = 9223371487098961920.0   # 2^63 - 2^39, exact in f32 and f64
+    i64 = jnp.clip(x, -lim, lim).astype(jnp.int64)
+    i64 = jnp.where(x > lim, np.int64(2 ** 63 - 1), i64)
+    i64 = jnp.where(x < -lim, np.int64(-(2 ** 63)), i64)
+    return i64
+
+
 def _cast_numeric(a, v, src_t: T.DType, to: T.DType) -> Column:
     if isinstance(to, T.DecimalType):
         # value * 10^scale as unscaled int64
@@ -64,12 +91,14 @@ def _cast_numeric(a, v, src_t: T.DType, to: T.DType) -> Column:
     if src_t == T.BOOL:
         return Column(to, a.astype(to.np_dtype), v)
     if to.is_integral and src_t.is_fractional:
-        # Spark float->int: NaN -> null is FALSE; NaN->0? Spark casts NaN to 0
-        # and saturates to type bounds (non-ANSI).
+        # Spark float->int: NaN casts to 0 and values saturate to type
+        # bounds (non-ANSI).  Convert to int64 exactly, then clamp and
+        # narrow with INTEGER comparisons.
         info = np.iinfo(to.np_dtype)
-        clipped = jnp.clip(jnp.nan_to_num(a, nan=0.0), float(info.min),
-                           float(info.max))
-        return Column(to, jnp.trunc(clipped).astype(to.np_dtype), v)
+        x = jnp.trunc(jnp.nan_to_num(a, nan=0.0))
+        i64 = _float_to_i64_exact(x)
+        i64 = jnp.clip(i64, np.int64(info.min), np.int64(info.max))
+        return Column(to, i64.astype(to.np_dtype), v)
     if to in (T.DATE, T.TIMESTAMP):
         if src_t == T.TIMESTAMP and to == T.DATE:
             days = jnp.floor_divide(a, 86_400_000_000)

@@ -46,6 +46,10 @@ class Expression:
     """Base expression node."""
 
     children: List["Expression"] = []
+    #: safe to evaluate under jax.jit tracing (exec/fused.py): the eval
+    #: must be pure jnp over the batch — no host state, no side effects,
+    #: no batch attributes beyond columns/capacity/num_rows
+    trace_safe = True
 
     @property
     def name(self) -> str:

@@ -76,6 +76,7 @@ class Md5(Expression):
 
 
 class MonotonicallyIncreasingID(LeafExpression):
+    trace_safe = False
     """partition_id << 33 | row_index (Spark's contract)."""
 
     def dtype(self):
@@ -95,6 +96,7 @@ class MonotonicallyIncreasingID(LeafExpression):
 
 
 class SparkPartitionID(LeafExpression):
+    trace_safe = False
     def dtype(self):
         return T.INT32
 
@@ -110,6 +112,7 @@ class SparkPartitionID(LeafExpression):
 
 
 class Rand(LeafExpression):
+    trace_safe = False
     """rand(seed): deterministic per (seed, partition, row) via threefry."""
 
     def __init__(self, seed: int = 0):

@@ -24,11 +24,55 @@ def _comparable_words(expr: Expression, batch):
     return words, col.validity
 
 
+def promote_comparison_sides(left: Expression, right: Expression):
+    """Insert casts so both sides share one dtype before key-word
+    encoding (the Spark analyzer's binary-comparison coercion).
+
+    The canonical word encodings are only ordered WITHIN a type family:
+    an int64 bias word and a float sign-flip word (let alone the
+    on-chip f64 triple word) are not mutually comparable, so mixed
+    int/float comparisons must promote first.
+    """
+    try:
+        lt_, rt_ = left.dtype(), right.dtype()
+    except (ValueError, NotImplementedError):
+        return left, right
+    if lt_ == rt_:
+        return left, right
+    dec_l = isinstance(lt_, T.DecimalType)
+    dec_r = isinstance(rt_, T.DecimalType)
+    if dec_l and dec_r and lt_.scale == rt_.scale:
+        # same scale: unscaled words compare exactly as-is
+        return left, right
+    if (dec_l and rt_.is_fractional) or (dec_r and lt_.is_fractional) or \
+            (dec_l and dec_r):
+        # decimal vs float, or mismatched decimal scales: unscaled-int64
+        # words are only comparable at one scale — compare as double
+        # (Spark's decimal/double coercion)
+        common = T.FLOAT64
+    else:
+        try:
+            common = T.common_type(lt_, rt_)
+        except ValueError:
+            # date vs timestamp: compare in timestamp space
+            if {type(lt_), type(rt_)} == {T.DateType, T.TimestampType}:
+                common = T.TIMESTAMP
+            else:
+                return left, right
+    from .cast import Cast
+    if lt_ != common:
+        left = Cast(left, common)
+    if rt_ != common:
+        right = Cast(right, common)
+    return left, right
+
+
 class BinaryComparison(Expression):
     symbol = "?"
 
     def __init__(self, left: Expression, right: Expression):
         self.children = [left, right]
+        self._promoted = None
 
     def with_children(self, children):
         return type(self)(children[0], children[1])
@@ -39,9 +83,14 @@ class BinaryComparison(Expression):
     def compare(self, lt, eq):
         raise NotImplementedError
 
-    def columnar_eval(self, batch):
-        lw, lv = _comparable_words(self.children[0], batch)
-        rw, rv = _comparable_words(self.children[1], batch)
+    def _ordered_words(self, batch):
+        """Shared preamble: promote once (cached per plan node), encode
+        both sides, compute (lt, gt, eq, valid) word comparisons."""
+        if self._promoted is None:
+            self._promoted = promote_comparison_sides(*self.children)
+        left, right = self._promoted
+        lw, lv = _comparable_words(left, batch)
+        rw, rv = _comparable_words(right, batch)
         # unify word counts (strings of different max widths)
         n = max(len(lw), len(rw))
         lw = lw + [jnp.zeros_like(lw[0])] * (n - len(lw))
@@ -50,7 +99,10 @@ class BinaryComparison(Expression):
         idx = jnp.arange(lw[0].shape[0])
         lt = canon.words_less(lw, idx, rw, idx)
         gt = canon.words_less(rw, idx, lw, idx)
-        eq = ~lt & ~gt
+        return lt, gt, ~lt & ~gt, lv, rv
+
+    def columnar_eval(self, batch):
+        lt, gt, eq, lv, rv = self._ordered_words(batch)
         return Column(T.BOOL, self.compare(lt, eq), lv & rv)
 
     def __repr__(self):
@@ -97,15 +149,7 @@ class EqualNullSafe(BinaryComparison):
     symbol = "<=>"
 
     def columnar_eval(self, batch):
-        lw, lv = _comparable_words(self.children[0], batch)
-        rw, rv = _comparable_words(self.children[1], batch)
-        n = max(len(lw), len(rw))
-        lw = lw + [jnp.zeros_like(lw[0])] * (n - len(lw))
-        rw = rw + [jnp.zeros_like(rw[0])] * (n - len(rw))
-        idx = jnp.arange(lw[0].shape[0])
-        lt = canon.words_less(lw, idx, rw, idx)
-        gt = canon.words_less(rw, idx, lw, idx)
-        eq = ~lt & ~gt
+        lt, gt, eq, lv, rv = self._ordered_words(batch)
         both_null = ~lv & ~rv
         result = jnp.where(both_null, True, eq & lv & rv)
         return Column(T.BOOL, result, jnp.ones_like(result))
@@ -258,10 +302,18 @@ class In(Expression):
         acc_data = None
         acc_valid = None
         has_null_item = any(v is None for v in self.values)
+        cdt = child.dtype()
         for v in self.values:
             if v is None:
                 continue
-            eq = EqualTo(child, Literal(v, child.dtype()))
+            # fractional values against a non-fractional child must keep
+            # their own type so EqualTo's promotion coerces the CHILD up
+            # (forcing the child dtype would truncate 0.5 -> 0)
+            if isinstance(v, float) and not cdt.is_fractional:
+                lit_v = Literal(v)
+            else:
+                lit_v = Literal(v, cdt)
+            eq = EqualTo(child, lit_v)
             a, va, _ = eval_data_valid(eq, batch)
             a = a.astype(bool) & va
             acc_data = a if acc_data is None else (acc_data | a)

@@ -160,8 +160,16 @@ class Join(LogicalPlan):
                  condition: Optional[Expression] = None):
         assert join_type in JOIN_TYPES, join_type
         self.join_type = join_type
-        self.left_keys = left_keys
-        self.right_keys = right_keys
+        # analyzer-role coercion: key pairs must share one dtype or their
+        # canonical key words are not comparable across sides
+        from ..expr.predicates import promote_comparison_sides
+        lk, rk = [], []
+        for le, re in zip(left_keys, right_keys):
+            le, re = promote_comparison_sides(le, re)
+            lk.append(le)
+            rk.append(re)
+        self.left_keys = lk
+        self.right_keys = rk
         self.condition = condition
         self.children = [left, right]
 

@@ -74,6 +74,10 @@ class ArrayMathUDF(TpuUDF):
 class TpuUDFExpression(ec.Expression):
     """Expression node invoking a TpuUDF (GpuScalaUDF role)."""
 
+    # user code may carry host state; only explicitly-pure UDFs could
+    # ever fuse, so keep them out of jit traces
+    trace_safe = False
+
     def __init__(self, udf: TpuUDF, children: List[ec.Expression]):
         self.udf = udf
         self.children = list(children)

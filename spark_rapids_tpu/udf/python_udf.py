@@ -19,6 +19,8 @@ from ..expr import core as ec
 class PythonUDF(ec.Expression):
     """Row-at-a-time python function over N columns (fallback path)."""
 
+    trace_safe = False
+
     def __init__(self, fn: Callable, return_type: T.DType,
                  children: List[ec.Expression], name: str = "pyudf"):
         self.fn = fn
@@ -57,6 +59,8 @@ class PandasUDF(ec.Expression):
     Reference: Pandas UDF execs (GpuArrowEvalPythonExec) — input batches
     convert to Arrow then pandas, results convert back.
     """
+
+    trace_safe = False
 
     def __init__(self, fn: Callable, return_type: T.DType,
                  children: List[ec.Expression], name: str = "pandas_udf"):

@@ -8,7 +8,8 @@ import pytest
 from spark_rapids_tpu.api import functions as F
 from spark_rapids_tpu.columnar import dtypes as T
 
-from harness import assert_tpu_and_cpu_are_equal_collect
+from harness import (assert_tpu_and_cpu_are_equal_collect,
+                     with_tpu_session)
 from data_gen import (IntGen, FloatGen, StringGen, BoolGen, KeyGen, DateGen,
                       gen_df)
 
@@ -202,3 +203,66 @@ class TestSortLimit:
         fn = lambda s: gen_df(s, _base_gens(), N).filter(
             F.col("i") > 0).count()
         assert with_cpu_session(fn) == with_tpu_session(fn)
+
+
+class TestMixedTypeComparison:
+    """Comparisons/joins across int/float/date widths must promote to a
+    common type before key-word encoding (analyzer-coercion role); the
+    encodings are only ordered within one type family."""
+
+    def test_float_col_vs_int_literal(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"f": FloatGen()}, N)
+            .filter(F.col("f") > 0))
+
+    def test_fraction_vs_int_literal(self):
+        # 0.5 > 1 must be False (was silently wrong pre-promotion)
+        import pyarrow as pa
+        rows = with_tpu_session(
+            lambda s: s.create_dataframe(
+                pa.table({"f": [0.5, 1.5, -0.5, 2.0]}))
+            .filter(F.col("f") > 1).collect())
+        assert rows == [(1.5,), (2.0,)]
+
+    def test_int_col_vs_float_literal(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"i": IntGen(lo=-10, hi=10)}, N)
+            .filter(F.col("i") >= 2.5))
+
+    def test_mixed_type_join_keys(self):
+        import pyarrow as pa
+
+        def fn(s):
+            left = s.create_dataframe(pa.table({"a": [1, 2, 3, 4]}))
+            right = s.create_dataframe(
+                pa.table({"b": [1.0, 3.0, 9.5, 2.5]}))
+            return left.join(right, F.col("a") == F.col("b"), "inner")
+        assert_tpu_and_cpu_are_equal_collect(fn)
+
+    def test_decimal_vs_float_comparison(self):
+        import pyarrow as pa
+        from decimal import Decimal
+
+        def fn(s):
+            t = pa.table({"d": pa.array(
+                [Decimal("1.00"), Decimal("0.25"), Decimal("3.50"), None],
+                type=pa.decimal128(10, 2))})
+            return s.create_dataframe(t).filter(F.col("d") > 0.5)
+        assert_tpu_and_cpu_are_equal_collect(fn)
+
+    def test_isin_fractional_values(self):
+        import pyarrow as pa
+        rows = with_tpu_session(
+            lambda s: s.create_dataframe(pa.table({"i": [0, 1, 2]}))
+            .filter(F.col("i").isin(0.5, 2.0)).collect())
+        assert rows == [(2,)]
+
+    def test_double_to_long_boundary(self):
+        import pyarrow as pa
+        rows = with_tpu_session(
+            lambda s: s.create_dataframe(
+                pa.table({"f": [1e18, -1e18, 2.5, 9.3e18, -9.3e18]}))
+            .select(F.col("f").cast("bigint").alias("l")).collect())
+        assert rows == [(1000000000000000000,), (-1000000000000000000,),
+                        (2,), (9223372036854775807,),
+                        (-9223372036854775808,)]

@@ -166,3 +166,26 @@ class TestNativeTpuUDF:
             .collect(),
             conf={"spark.rapids.tpu.sql.test.enabled": "true"})
         assert rows[-1] == (45,)
+
+    def test_host_state_not_baked_into_trace(self):
+        """A UDF with mutable host state must NOT fuse into a jit trace
+        (it would run once at trace time and return stale constants)."""
+        from spark_rapids_tpu.udf import tpu_udf
+        from spark_rapids_tpu.columnar import dtypes as T
+        from harness import with_tpu_session
+        from spark_rapids_tpu.api import functions as F
+        calls = {"n": 0}
+
+        @tpu_udf(return_type=T.INT64)
+        def stateful(x):
+            calls["n"] += 1
+            return x + calls["n"]
+
+        def fn(s):
+            df = s.range(0, 8, num_partitions=2).select(
+                stateful(F.col("id")).alias("u"))
+            return df.collect()
+        rows = with_tpu_session(fn)
+        # two partitions -> two eager invocations with distinct state;
+        # under (wrong) fusion both batches would see the same constant
+        assert calls["n"] >= 2
