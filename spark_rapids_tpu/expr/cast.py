@@ -78,10 +78,36 @@ def _float_to_i64_exact(x) -> jnp.ndarray:
 
 def _cast_numeric(a, v, src_t: T.DType, to: T.DType) -> Column:
     if isinstance(to, T.DecimalType):
-        # value * 10^scale as unscaled int64
+        if isinstance(src_t, T.DecimalType):
+            # decimal -> decimal rescale: exact int64 arithmetic
+            ds = to.scale - src_t.scale
+            if ds >= 0:
+                scaled = a.astype(jnp.int64) * np.int64(10 ** ds)
+            else:
+                # round-half-up toward nearest on scale reduction
+                div = np.int64(10 ** (-ds))
+                x = a.astype(jnp.int64)
+                half = jnp.where(x >= 0, div // 2, -(div // 2))
+                scaled = (x + half) // div
+            return Column(to, scaled, v)
+        if src_t.is_integral or src_t == T.BOOL:
+            # int -> decimal: exact int64 multiply (no float round-trip)
+            scaled = a.astype(jnp.int64) * np.int64(10 ** to.scale)
+            return Column(to, scaled, v)
+        # float -> decimal: value * 10^scale via float (inherent rounding)
         scaled = jnp.round(a.astype(jnp.float64) * (10.0 ** to.scale))
-        return Column(to, scaled.astype(jnp.int64), v)
+        return Column(to, _float_to_i64_exact(scaled), v)
     if isinstance(src_t, T.DecimalType):
+        if to.is_integral:
+            # decimal -> int: exact truncating integer division
+            div = np.int64(10 ** src_t.scale)
+            q = a.astype(jnp.int64) // div
+            r = a.astype(jnp.int64) % div
+            # python floordiv rounds toward -inf; SQL truncates toward 0
+            q = jnp.where((a.astype(jnp.int64) < 0) & (r != 0), q + 1, q)
+            info = np.iinfo(to.np_dtype)
+            q = jnp.clip(q, np.int64(info.min), np.int64(info.max))
+            return Column(to, q.astype(to.np_dtype), v)
         f = a.astype(jnp.float64) / (10.0 ** src_t.scale)
         if to.is_fractional:
             return Column(to, f.astype(to.np_dtype), v)

@@ -41,14 +41,21 @@ def promote_comparison_sides(left: Expression, right: Expression):
         return left, right
     dec_l = isinstance(lt_, T.DecimalType)
     dec_r = isinstance(rt_, T.DecimalType)
-    if dec_l and dec_r and lt_.scale == rt_.scale:
-        # same scale: unscaled words compare exactly as-is
-        return left, right
-    if (dec_l and rt_.is_fractional) or (dec_r and lt_.is_fractional) or \
-            (dec_l and dec_r):
-        # decimal vs float, or mismatched decimal scales: unscaled-int64
-        # words are only comparable at one scale — compare as double
-        # (Spark's decimal/double coercion)
+    if dec_l and dec_r:
+        if lt_.scale == rt_.scale:
+            # same scale: unscaled words compare exactly as-is
+            return left, right
+        # widen both to the max scale — exact int64 rescale when the
+        # widened precision still fits DECIMAL64, else compare as double
+        smax = max(lt_.scale, rt_.scale)
+        pmax = max(lt_.precision + smax - lt_.scale,
+                   rt_.precision + smax - rt_.scale)
+        if pmax <= T.DecimalType.MAX_PRECISION:
+            common = T.DecimalType(pmax, smax)
+        else:
+            common = T.FLOAT64
+    elif (dec_l and rt_.is_fractional) or (dec_r and lt_.is_fractional):
+        # decimal vs float: Spark's decimal/double coercion
         common = T.FLOAT64
     else:
         try:

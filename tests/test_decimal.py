@@ -63,3 +63,62 @@ class TestDecimal:
     def test_precision_over_18_rejected(self):
         with pytest.raises(ValueError):
             T.DecimalType(20, 2)
+
+
+class TestDecimalComparisonPromotion:
+    """Mismatched-scale and int-vs-decimal comparisons must stay exact
+    (int64 rescale, not a float64 round-trip)."""
+
+    def test_mismatched_scale_exact(self):
+        import pyarrow as pa
+        from decimal import Decimal
+        from harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.api import functions as F
+
+        def fn(s):
+            t = pa.table({
+                "a": pa.array([Decimal("11111111111111.11"),
+                               Decimal("2.50")],
+                              type=pa.decimal128(16, 2)),
+                "b": pa.array([Decimal("11111111111111.112"),
+                               Decimal("2.500")],
+                              type=pa.decimal128(17, 3)),
+            })
+            return s.create_dataframe(t).select(
+                (F.col("a") == F.col("b")).alias("eq"),
+                (F.col("a") < F.col("b")).alias("lt"))
+        rows = assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=False)
+        # 16-digit values differing at the 3rd decimal must NOT collapse
+        assert rows[0] == (False, True)
+        assert rows[1] == (True, False)
+
+    def test_int_vs_decimal_above_2_53(self):
+        import pyarrow as pa
+        from decimal import Decimal
+        from harness import with_tpu_session
+        from spark_rapids_tpu.api import functions as F
+        v = 9007199254740993  # 2^53 + 1: not representable in float64
+
+        def fn(s):
+            t = pa.table({"d": pa.array([Decimal(v), Decimal(v + 2)],
+                                        type=pa.decimal128(18, 0))})
+            return s.create_dataframe(t).filter(
+                F.col("d") == F.lit(v)).collect()
+        rows = with_tpu_session(fn)
+        assert len(rows) == 1
+
+    def test_decimal_to_decimal_rescale_cast(self):
+        import pyarrow as pa
+        from decimal import Decimal
+        from harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.api import functions as F
+        from spark_rapids_tpu.columnar import dtypes as T
+
+        def fn(s):
+            t = pa.table({"d": pa.array(
+                [Decimal("12.345"), Decimal("-7.005"), None],
+                type=pa.decimal128(10, 3))})
+            return s.create_dataframe(t).select(
+                F.col("d").cast(T.DecimalType(12, 5)).alias("up"),
+                F.col("d").cast("bigint").alias("i"))
+        assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=False)
