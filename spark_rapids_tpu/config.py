@@ -131,7 +131,8 @@ SHUFFLE_PARTITIONS = conf_int(
     "Default partition count for exchanges (spark.sql.shuffle.partitions)")
 SHUFFLE_COMPRESS = conf_str(
     "spark.rapids.tpu.shuffle.compression.codec", "none",
-    "none|lz4-like codec for shuffle buffers (reference: "
+    "none|zlib|lz4|tplz codec for shuffle buffers; tplz is the native "
+    "C++ LZ block codec (the nvcomp-LZ4 role; reference: "
     "spark.rapids.shuffle.compression.codec)")
 INCOMPATIBLE_OPS = conf_bool(
     "spark.rapids.tpu.sql.incompatibleOps.enabled", False,

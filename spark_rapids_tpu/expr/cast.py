@@ -84,11 +84,12 @@ def _cast_numeric(a, v, src_t: T.DType, to: T.DType) -> Column:
             if ds >= 0:
                 scaled = a.astype(jnp.int64) * np.int64(10 ** ds)
             else:
-                # round-half-up toward nearest on scale reduction
+                # round-half-up toward nearest on scale reduction; jnp //
+                # floors, so divide magnitudes and reapply the sign
                 div = np.int64(10 ** (-ds))
                 x = a.astype(jnp.int64)
-                half = jnp.where(x >= 0, div // 2, -(div // 2))
-                scaled = (x + half) // div
+                mag = (jnp.abs(x) + div // 2) // div
+                scaled = jnp.where(x < 0, -mag, mag)
             return Column(to, scaled, v)
         if src_t.is_integral or src_t == T.BOOL:
             # int -> decimal: exact int64 multiply (no float round-trip)

@@ -14,17 +14,18 @@ import threading
 from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "spill_arena.cpp")
-_SO = os.path.join(_DIR, "libspill_arena.so")
+_SRCS = [os.path.join(_DIR, "spill_arena.cpp"),
+         os.path.join(_DIR, "block_codec.cpp")]
+_SO = os.path.join(_DIR, "libspark_rapids_tpu_native.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
 
 def _build() -> str:
-    if (os.path.exists(_SO) and
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+    if os.path.exists(_SO) and all(
+            os.path.getmtime(_SO) >= os.path.getmtime(s) for s in _SRCS):
         return _SO
-    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", _SRC, "-o",
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", *_SRCS, "-o",
            _SO + ".tmp"]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(_SO + ".tmp", _SO)
@@ -58,8 +59,38 @@ def load() -> ctypes.CDLL:
         lib.arena_read_file.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                         ctypes.c_int64, ctypes.c_char_p]
         lib.arena_destroy.argtypes = [ctypes.c_void_p]
+        lib.tplz_max_compressed_size.restype = ctypes.c_size_t
+        lib.tplz_max_compressed_size.argtypes = [ctypes.c_size_t]
+        lib.tplz_compress.restype = ctypes.c_size_t
+        lib.tplz_compress.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                      ctypes.c_void_p, ctypes.c_size_t]
+        lib.tplz_decompress.restype = ctypes.c_size_t
+        lib.tplz_decompress.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                        ctypes.c_void_p, ctypes.c_size_t]
         _lib = lib
         return lib
+
+
+def tplz_compress(data: bytes) -> bytes:
+    """Native LZ block compression (the nvcomp-LZ4 role)."""
+    lib = load()
+    cap = lib.tplz_max_compressed_size(len(data))
+    out = ctypes.create_string_buffer(cap)
+    n = lib.tplz_compress(data, len(data), out, cap)
+    if n == 0 and len(data):
+        raise RuntimeError("tplz compression failed")
+    return out.raw[:n]
+
+
+def tplz_decompress(data: bytes, uncompressed_size: int) -> bytes:
+    lib = load()
+    out = ctypes.create_string_buffer(max(uncompressed_size, 1))
+    n = lib.tplz_decompress(data, len(data), out, uncompressed_size)
+    if n != uncompressed_size:
+        raise RuntimeError(
+            f"tplz decompression produced {n} bytes, "
+            f"expected {uncompressed_size}")
+    return out.raw[:n]
 
 
 class HostArena:

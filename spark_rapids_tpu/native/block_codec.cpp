@@ -1,0 +1,181 @@
+// Native LZ-class block codec for shuffle buffers.
+//
+// Reference parity (SURVEY.md §2.10 item 4): the reference compresses
+// shuffle tables with nvcomp's batched LZ4 behind the
+// TableCompressionCodec SPI (TableCompressionCodec.scala:378,
+// NvcompLZ4CompressionCodec.scala).  This is the TPU build's native
+// equivalent: a byte-oriented LZ77 with an LZ4-style token stream,
+// tuned for the host-side shuffle bounce path (we own both wire ends,
+// so the format is our own — "tplz1").
+//
+// Format per token:
+//   1 byte   token = (literal_len:4 | match_len:4)
+//   varint   extra literal length  (if literal_len == 15)
+//   N bytes  literals
+//   2 bytes  little-endian match offset (0 => end of stream, no match)
+//   varint   extra match length    (if match_len == 15)
+// Matches are >= 4 bytes within a 64 KiB window.
+//
+// Build: g++ -O2 -fPIC -shared (see native/__init__.py).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int kMinMatch = 4;
+constexpr uint32_t kHashBits = 16;
+constexpr uint32_t kWindow = 65535;
+
+inline uint32_t hash4(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return (v * 2654435761u) >> (32 - kHashBits);
+}
+
+inline uint8_t* put_varint(uint8_t* dst, size_t v) {
+    while (v >= 255) {
+        *dst++ = 255;
+        v -= 255;
+    }
+    *dst++ = static_cast<uint8_t>(v);
+    return dst;
+}
+
+inline const uint8_t* get_varint(const uint8_t* src, const uint8_t* end,
+                                 size_t* v) {
+    size_t out = 0;
+    while (src < end) {
+        uint8_t b = *src++;
+        out += b;
+        if (b != 255) break;
+    }
+    *v = out;
+    return src;
+}
+
+}  // namespace
+
+extern "C" {
+
+// worst case: all literals + token/length overhead
+size_t tplz_max_compressed_size(size_t n) {
+    return n + n / 255 + 16;
+}
+
+// returns compressed size, or 0 if dst_cap is too small
+size_t tplz_compress(const uint8_t* src, size_t n, uint8_t* dst,
+                     size_t dst_cap) {
+    if (dst_cap < tplz_max_compressed_size(n)) return 0;
+    std::vector<int64_t> table(1u << kHashBits, -1);
+    uint8_t* out = dst;
+    size_t pos = 0;
+    size_t lit_start = 0;
+
+    auto emit = [&](size_t match_pos, size_t match_len, size_t offset) {
+        size_t lit_len = match_pos - lit_start;
+        size_t ml = match_len ? match_len - kMinMatch : 0;
+        uint8_t token =
+            static_cast<uint8_t>((lit_len < 15 ? lit_len : 15) << 4 |
+                                 (ml < 15 ? ml : 15));
+        *out++ = token;
+        if (lit_len >= 15) out = put_varint(out, lit_len - 15);
+        std::memcpy(out, src + lit_start, lit_len);
+        out += lit_len;
+        uint16_t off16 = static_cast<uint16_t>(offset);
+        std::memcpy(out, &off16, 2);
+        out += 2;
+        if (match_len && ml >= 15) out = put_varint(out, ml - 15);
+    };
+
+    if (n >= kMinMatch + 1) {
+        while (pos + kMinMatch < n) {
+            uint32_t h = hash4(src + pos);
+            int64_t cand = table[h];
+            table[h] = static_cast<int64_t>(pos);
+            if (cand >= 0 && pos - cand <= kWindow &&
+                std::memcmp(src + cand, src + pos, kMinMatch) == 0) {
+                size_t len = kMinMatch;
+                size_t max_len = n - pos;
+                while (len < max_len &&
+                       src[cand + len] == src[pos + len]) {
+                    ++len;
+                }
+                emit(pos, len, pos - cand);
+                lit_start = pos + len;
+                // index a few positions inside the match for chains
+                size_t step = len > 64 ? 8 : 1;
+                for (size_t i = pos + 1; i + kMinMatch < lit_start;
+                     i += step) {
+                    table[hash4(src + i)] = static_cast<int64_t>(i);
+                }
+                pos = lit_start;
+            } else {
+                ++pos;
+            }
+        }
+    }
+    // trailing literals with offset 0 terminator
+    {
+        size_t lit_len = n - lit_start;
+        size_t dummy_pos = lit_start + lit_len;
+        (void)dummy_pos;
+        uint8_t token = static_cast<uint8_t>(
+            (lit_len < 15 ? lit_len : 15) << 4);
+        *out++ = token;
+        if (lit_len >= 15) out = put_varint(out, lit_len - 15);
+        std::memcpy(out, src + lit_start, lit_len);
+        out += lit_len;
+        uint16_t zero = 0;
+        std::memcpy(out, &zero, 2);
+        out += 2;
+    }
+    return static_cast<size_t>(out - dst);
+}
+
+// returns decompressed size, or 0 on malformed input / small dst
+size_t tplz_decompress(const uint8_t* src, size_t n, uint8_t* dst,
+                       size_t dst_cap) {
+    const uint8_t* in = src;
+    const uint8_t* end = src + n;
+    uint8_t* out = dst;
+    uint8_t* out_end = dst + dst_cap;
+    while (in < end) {
+        uint8_t token = *in++;
+        size_t lit_len = token >> 4;
+        size_t match_len = token & 0xF;
+        if (lit_len == 15) {
+            size_t extra;
+            in = get_varint(in, end, &extra);
+            lit_len += extra;
+        }
+        if (in + lit_len > end || out + lit_len > out_end) return 0;
+        std::memcpy(out, in, lit_len);
+        in += lit_len;
+        out += lit_len;
+        if (in + 2 > end) return 0;
+        uint16_t off16;
+        std::memcpy(&off16, in, 2);
+        in += 2;
+        if (off16 == 0) {
+            // stream terminator (trailing-literal token)
+            break;
+        }
+        size_t ml = match_len;
+        if (ml == 15) {
+            size_t extra;
+            in = get_varint(in, end, &extra);
+            ml += extra;
+        }
+        ml += kMinMatch;
+        if (out - dst < off16 || out + ml > out_end) return 0;
+        const uint8_t* from = out - off16;
+        // overlapping copies must go byte-by-byte
+        for (size_t i = 0; i < ml; ++i) out[i] = from[i];
+        out += ml;
+    }
+    return static_cast<size_t>(out - dst);
+}
+
+}  // extern "C"

@@ -58,11 +58,30 @@ class Lz4Codec(CompressionCodec):
         return self._lz4.decompress(data)
 
 
+class TplzCodec(CompressionCodec):
+    """Native C++ LZ block codec (the nvcomp-LZ4 role; SURVEY.md §2.10
+    item 4 — native where the reference's codec is native)."""
+    name = "tplz"
+
+    def __init__(self):
+        from ..native import tplz_compress, tplz_decompress, load
+        load()   # build/load eagerly so failures surface at codec choice
+        self._c = tplz_compress
+        self._d = tplz_decompress
+
+    def compress(self, data: bytes) -> bytes:
+        return self._c(data)
+
+    def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        return self._d(data, uncompressed_size)
+
+
 _CODECS: Dict[str, Type[CompressionCodec]] = {
     "none": CopyCodec,
     "copy": CopyCodec,
     "zlib": ZlibCodec,
     "lz4": Lz4Codec,
+    "tplz": TplzCodec,
 }
 
 

@@ -120,5 +120,6 @@ class TestDecimalComparisonPromotion:
                 type=pa.decimal128(10, 3))})
             return s.create_dataframe(t).select(
                 F.col("d").cast(T.DecimalType(12, 5)).alias("up"),
+                F.col("d").cast(T.DecimalType(10, 1)).alias("down"),
                 F.col("d").cast("bigint").alias("i"))
         assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=False)

@@ -183,3 +183,36 @@ class TestShuffleManager:
         cat.spill_device_to_fit(cat.device_limit)  # push everything out
         got = list(mgr.read_partition(sid, 0))
         assert got[0].to_pydict() == expect
+
+
+class TestNativeBlockCodec:
+    """Native C++ LZ codec (nvcomp role, SURVEY §2.10 item 4)."""
+
+    def test_roundtrip_patterns(self):
+        import numpy as np
+        from spark_rapids_tpu.native import tplz_compress, tplz_decompress
+        rng = np.random.default_rng(5)
+        cases = [
+            b"",
+            b"x",
+            b"ab" * 10_000,
+            rng.integers(0, 50, 100_000).astype(np.int64).tobytes(),
+            rng.integers(0, 2**63, 5_000).astype(np.int64).tobytes(),
+        ]
+        for data in cases:
+            c = tplz_compress(data)
+            assert tplz_decompress(c, len(data)) == data
+
+    def test_codec_spi(self):
+        from spark_rapids_tpu.shuffle.compression import get_codec
+        codec = get_codec("tplz")
+        data = b"hello shuffle world " * 1000
+        c = codec.compress(data)
+        assert len(c) < len(data) // 10
+        assert codec.decompress(c, len(data)) == data
+
+    def test_corrupt_input_raises(self):
+        import pytest
+        from spark_rapids_tpu.native import tplz_decompress
+        with pytest.raises(RuntimeError):
+            tplz_decompress(b"\xff\xff\xff\xff\x10\x20", 1000)
