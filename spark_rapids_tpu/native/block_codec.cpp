@@ -119,8 +119,6 @@ size_t tplz_compress(const uint8_t* src, size_t n, uint8_t* dst,
     // trailing literals with offset 0 terminator
     {
         size_t lit_len = n - lit_start;
-        size_t dummy_pos = lit_start + lit_len;
-        (void)dummy_pos;
         uint8_t token = static_cast<uint8_t>(
             (lit_len < 15 ? lit_len : 15) << 4);
         *out++ = token;

@@ -1,3 +1,4 @@
 """Distributed execution over jax.sharding meshes (ICI/DCN collectives)."""
 from .mesh import (make_mesh, shard_rows, distributed_sum_by_key,
-                   distributed_global_sum)  # noqa: F401
+                   distributed_global_sum, distributed_join_sum,
+                   distributed_sort)  # noqa: F401
