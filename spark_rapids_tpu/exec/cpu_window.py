@@ -38,6 +38,74 @@ class CpuWindow(CpuExec):
             yield self._apply(t)
         return [run()]
 
+    @staticmethod
+    def _bounded_frame(grouped, work, src, okey, kind, lo, hi, agg,
+                       ascending: bool):
+        """Exact per-row frame aggregation (rows or range bounds)."""
+        import pandas as pd
+
+        def _as_num(x):
+            """Temporal order keys compare as epoch numbers so integer
+            range offsets add cleanly (dates: days; timestamps: us)."""
+            import datetime
+            if isinstance(x, pd.Timestamp):
+                return x.value // 1000
+            if isinstance(x, datetime.datetime):
+                return int(x.timestamp() * 1e6)
+            if isinstance(x, datetime.date):
+                return (x - datetime.date(1970, 1, 1)).days
+            return x
+
+        def one_group(g: "pd.DataFrame") -> "pd.Series":
+            vals = g[src].to_numpy(dtype=object)
+            n = len(g)
+            out = []
+            if kind == "range" and okey is not None:
+                order = [None if x is None or (isinstance(x, float) and
+                                               np.isnan(x))
+                         else _as_num(x)
+                         for x in g[okey].to_numpy(dtype=object)]
+            for i in range(n):
+                if kind == "rows":
+                    a = 0 if lo is None else max(0, i + lo)
+                    b = n - 1 if hi is None else min(n - 1, i + hi)
+                    window = vals[a:b + 1] if a <= b else []
+                else:
+                    v = order[i]
+                    if v is None:
+                        window = [vals[j] for j in range(n)
+                                  if order[j] is None]
+                    else:
+                        d1 = lo if lo is not None else None
+                        d2 = hi if hi is not None else None
+                        if not ascending:
+                            d1, d2 = (None if d2 is None else -d2,
+                                      None if d1 is None else -d1)
+                        window = [
+                            vals[j] for j in range(n)
+                            if order[j] is not None and
+                            (d1 is None or order[j] >= v + d1) and
+                            (d2 is None or order[j] <= v + d2)]
+                clean = [x for x in window
+                         if x is not None and not (
+                             isinstance(x, float) and np.isnan(x))]
+                if agg == "count":
+                    out.append(len(clean))
+                elif not clean:
+                    out.append(None)
+                elif agg == "sum":
+                    out.append(sum(clean))
+                elif agg == "mean":
+                    out.append(sum(clean) / len(clean))
+                elif agg == "min":
+                    out.append(min(clean))
+                else:
+                    out.append(max(clean))
+            return pd.Series(out, index=g.index, dtype=object)
+
+        parts = [one_group(g) for _, g in grouped]
+        return pd.concat(parts).reindex(work.index)
+
     def _apply(self, t: pa.Table) -> pa.Table:
         import pandas as pd
         df = t.to_pandas()
@@ -125,13 +193,22 @@ class CpuWindow(CpuExec):
                 agg = {"Sum": "sum", "Count": "count", "Min": "min",
                        "Max": "max", "Average": "mean"}[fname]
                 frame_kind, fstart, fend = spec.frame
-                if skeys and frame_kind == "rows" and fstart is None and \
-                        fend == 0:
-                    # running aggregate (unbounded preceding .. current row)
-                    res = grouped[src].transform(
-                        lambda s: getattr(s.expanding(), agg)())
-                else:
+                if not skeys or (fstart is None and fend is None):
                     res = grouped[src].transform(agg)
+                elif frame_kind == "rows" and fstart is None and fend == 0:
+                    # running aggregate: vectorized expanding() (the
+                    # exact per-row oracle below is O(n^2) python)
+                    res = grouped[src].transform(
+                        lambda s_: getattr(s_.expanding(), agg)())
+                else:
+                    # bounded frame oracle: per-row python slice (exact,
+                    # O(n*frame) — oracle only)
+                    okey = skeys[0] if skeys else None
+                    res = self._bounded_frame(
+                        grouped, work, src, okey, frame_kind, fstart,
+                        fend, agg,
+                        spec.order_by[0].ascending if spec.order_by
+                        else True)
                 if agg == "count":
                     res = res.astype(np.int64)
                 work.drop(columns=[src], inplace=True)

@@ -177,6 +177,14 @@ class TpuWindow(TpuExec):
             vals, ok = self._seg_reduce(func, sv, sok, seg, cap)
             vals = jnp.take(vals, seg)
             ok = jnp.take(ok, seg) & live
+        elif kind == "range":
+            lo_pos, hi_pos = self._range_positions(
+                batch, spec, perm, seg, seg_start, live, cap,
+                frame_lo, frame_hi)
+            vals, ok = self._frame_agg(func, sv, sok, seg, row_in_seg,
+                                       seg_start, cap, None, None,
+                                       lo_pos=lo_pos, hi_pos=hi_pos)
+            ok = ok & live
         else:
             lo = frame_lo  # None = unbounded preceding
             hi = frame_hi if frame_hi is not None else None
@@ -230,10 +238,83 @@ class TpuWindow(TpuExec):
             return vals, cnt > 0
         raise NotImplementedError(f"window aggregate {func.name}")
 
+    def _range_positions(self, batch, spec, perm, seg, seg_start, live,
+                         cap, frame_lo, frame_hi):
+        """RANGE frame bounds as sorted-row positions via rank search.
+
+        Reference: cuDF range-window support behind GpuWindowExec.  For
+        each row with order value v the frame covers rows of its
+        partition with value in [v+lo, v+hi] (direction-corrected for
+        DESC).  Computed without per-row loops: encode values as
+        order-preserving uint64 words, rank every row's word in the
+        batch-wide sorted word array, and binary-search composite
+        (segment, rank) keys — all vectorized searchsorted.
+        """
+        order = spec.order_by[0]
+        ocol = ec.eval_as_column(order.expr.bind(batch.schema), batch)
+        vals_sorted = jnp.take(ocol.data, perm).astype(jnp.int64)
+        ovalid = jnp.take(ocol.validity, perm) & live
+
+        def enc(x):
+            w = canon._ints_to_words(x, 64)
+            return ~w if not order.ascending else w
+
+        words = jnp.where(ovalid, enc(vals_sorted),
+                          jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        v_sorted = jnp.sort(words)
+        lo_off = jnp.int64(0 if frame_lo is None else frame_lo)
+        hi_off = jnp.int64(0 if frame_hi is None else frame_hi)
+        if order.ascending:
+            t1, t2 = vals_sorted + lo_off, vals_sorted + hi_off
+        else:
+            # DESC: "preceding" rows hold LARGER values, so the value
+            # interval flips to [v - hi, v - lo] (Spark range semantics)
+            t1, t2 = vals_sorted - hi_off, vals_sorted - lo_off
+        e1 = enc(t1)
+        e2 = enc(t2)
+        wlo = jnp.minimum(e1, e2)
+        whi = jnp.maximum(e1, e2)
+        r_lo = jnp.searchsorted(v_sorted, wlo, side="left")
+        r_hi = jnp.searchsorted(v_sorted, whi, side="right")
+        # composite (seg, rank) keys: valid rows at 1+rank, null-order
+        # rows pinned to the null end of their segment
+        BIG = jnp.int64(1) << jnp.int64(33)
+        nulls_first = order.effective_nulls_first
+        null_slot = jnp.int64(0) if nulls_first else BIG - 1
+        rank_row = jnp.where(
+            ovalid,
+            1 + jnp.searchsorted(v_sorted, words, side="left"), null_slot)
+        C = seg.astype(jnp.int64) * BIG + rank_row.astype(jnp.int64)
+        # padding rows past num_rows sort AFTER every live row: pin their
+        # composite to +inf or the searchsorted precondition breaks
+        C = jnp.where(live, C, jnp.int64(2 ** 62))
+        seg64 = seg.astype(jnp.int64)
+        t_lo = jnp.where(ovalid, seg64 * BIG + 1 + r_lo,
+                         seg64 * BIG + null_slot)
+        t_hi = jnp.where(ovalid, seg64 * BIG + 1 + r_hi,
+                         seg64 * BIG + null_slot + 1)
+        lo_pos = jnp.searchsorted(C, t_lo, side="left")
+        hi_pos = jnp.searchsorted(C, t_hi, side="left") - 1
+        # unbounded ends widen to the partition
+        seg_start_pos = jnp.take(seg_start, seg)
+        seg_len = jax.ops.segment_sum(
+            jnp.ones(cap, jnp.int64), seg, num_segments=cap)
+        seg_end_pos = seg_start_pos + jnp.take(seg_len, seg) - 1
+        if frame_lo is None:
+            lo_pos = seg_start_pos
+        if frame_hi is None:
+            hi_pos = seg_end_pos
+        lo_pos = jnp.maximum(lo_pos, seg_start_pos)
+        hi_pos = jnp.minimum(hi_pos, seg_end_pos)
+        return lo_pos, hi_pos
+
     def _frame_agg(self, func, sv, sok, seg, row_in_seg, seg_start, cap,
-                   lo: Optional[int], hi: Optional[int]):
-        """ROWS frame [lo, hi] relative offsets (None = unbounded)."""
+                   lo: Optional[int], hi: Optional[int],
+                   lo_pos=None, hi_pos=None):
+        """Frame [lo, hi] row offsets, or explicit positions
+        (lo_pos/hi_pos from a RANGE frame)."""
         pos = jnp.arange(cap, dtype=jnp.int64)
+        explicit = lo_pos is not None
         if isinstance(func, (eagg.Sum, eagg.Count, eagg.Average)):
             acc_dtype = jnp.float64 if not isinstance(func, eagg.Count) \
                 else jnp.int64
@@ -247,10 +328,11 @@ class TpuWindow(TpuExec):
             seg_len = jax.ops.segment_sum(
                 jnp.ones(cap, jnp.int64), seg, num_segments=cap)
             seg_end_pos = seg_start_pos + jnp.take(seg_len, seg) - 1
-            lo_pos = seg_start_pos if lo is None else \
-                jnp.maximum(pos + lo, seg_start_pos)
-            hi_pos = seg_end_pos if hi is None else \
-                jnp.minimum(pos + hi, seg_end_pos)
+            if not explicit:
+                lo_pos = seg_start_pos if lo is None else \
+                    jnp.maximum(pos + lo, seg_start_pos)
+                hi_pos = seg_end_pos if hi is None else \
+                    jnp.minimum(pos + hi, seg_end_pos)
             hi_c = jnp.clip(hi_pos, 0, cap - 1).astype(jnp.int32)
             lo_c = jnp.clip(lo_pos - 1, -1, cap - 1)
             ps_hi = jnp.take(ps, hi_c)

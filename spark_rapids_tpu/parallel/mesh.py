@@ -131,14 +131,16 @@ def distributed_sum_by_key(mesh: Mesh, axis_name: str = "data"):
         owner = ((rep_key.view(jnp.uint64) * jnp.uint64(MIX))
                  >> jnp.uint64(33)) % jnp.uint64(n_dev)
         owner = jnp.where(gvalid, owner.astype(jnp.int32), n_dev)
-        (okey, osum), oval, _overflow = _route_to_owners(
+        (okey, osum), oval, overflow = _route_to_owners(
             owner, [rep_key, sums], [0, 0.0], n_dev, axis_name, slack=2)
-        return _local_sum_by_key(okey, osum, oval)
+        k, v, gv = _local_sum_by_key(okey, osum, oval)
+        return k, v, gv, overflow[None]
 
     smapped = shard_map(
         step, mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name)),
-        out_specs=(P(axis_name), P(axis_name), P(axis_name)))
+        out_specs=(P(axis_name), P(axis_name), P(axis_name),
+                   P(axis_name)))
     return jax.jit(smapped)
 
 
@@ -202,8 +204,12 @@ def distributed_join_sum(mesh: Mesh, axis_name: str = "data"):
         bias = jnp.uint64(SIGN64_BIAS)
         rw = (rkey.view(jnp.uint64) ^ bias)
         rw = jnp.where(rgv, rw, jnp.uint64(0xFFFFFFFFFFFFFFFF))
-        srw, srv, srs = jax.lax.sort(
-            (rw, rgv, rsum), num_keys=1, is_stable=True)
+        # secondary key sorts valid entries before invalid sentinels so a
+        # REAL key of INT64_MAX (word == sentinel) is found by the
+        # left-search instead of an invalid slot
+        inv_rank = jnp.where(rgv, jnp.uint64(0), jnp.uint64(1))
+        srw, _, srv, srs = jax.lax.sort(
+            (rw, inv_rank, rgv, rsum), num_keys=2, is_stable=True)
         lw = (lkey.view(jnp.uint64) ^ bias)
         pos = jnp.clip(jnp.searchsorted(srw, lw), 0, cap - 1)
         hit = (jnp.take(srw, pos) == lw) & jnp.take(srv, pos) & lgv

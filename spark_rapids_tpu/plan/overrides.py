@@ -316,8 +316,22 @@ class PlanMeta:
                     self.reasons.append(
                         "string window aggregates not on TPU yet")
                 kind, lo, hi = wf.spec.frame
-                if kind != "rows":
-                    self.reasons.append("RANGE frames not on TPU yet")
+                if kind == "range" and not (lo is None and hi is None):
+                    # bounded RANGE: rank-search implementation covers a
+                    # single integral/date/timestamp order key with
+                    # sum/count/avg (tpu_window._range_positions)
+                    ok_range = (
+                        len(wf.spec.order_by) == 1 and
+                        isinstance(f, (eagg.Sum, eagg.Count,
+                                       eagg.Average)))
+                    if ok_range:
+                        odt = wf.spec.order_by[0].expr.dtype()
+                        ok_range = odt.is_integral or odt in (
+                            T.DATE, T.TIMESTAMP)
+                    if not ok_range:
+                        self.reasons.append(
+                            "RANGE frame limited to one integral order "
+                            "key with sum/count/avg on TPU")
                 if isinstance(f, (eagg.Min, eagg.Max)) and not (
                         (lo is None and hi is None) or
                         (lo is None and hi == 0) or not wf.spec.order_by):

@@ -34,7 +34,8 @@ def test_distributed_sum_by_key(mesh):
     sk, sv, sm = shard_rows(
         [jnp.asarray(keys), jnp.asarray(vals),
          jnp.asarray(np.ones(n, bool))], mesh)
-    k, s, v = distributed_sum_by_key(mesh)(sk, sv, sm)
+    k, s, v, overflow = distributed_sum_by_key(mesh)(sk, sv, sm)
+    assert not bool(np.asarray(overflow).any())
     got = {int(a): float(b)
            for a, b, c in zip(np.asarray(k), np.asarray(s),
                               np.asarray(v)) if c}

@@ -60,3 +60,60 @@ class TestWindow:
                                              null_ratio=0.0)}, 50)
             .with_window("rn", F.row_number(), partition_by=[],
                          order_by=["v"]))
+
+
+class TestFrames:
+    """Bounded ROWS and RANGE frames vs the exact CPU oracle."""
+
+    def _df(self, s, n=60):
+        import numpy as np
+        rng = np.random.default_rng(9)
+        return s.create_dataframe({
+            "g": rng.integers(0, 5, n).astype(np.int64),
+            "o": rng.integers(0, 40, n).astype(np.int64),
+            "v": rng.integers(-50, 50, n).astype(np.int64),
+        })
+
+    def test_bounded_rows_frame(self):
+        from spark_rapids_tpu.api import functions as F
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: self._df(s).with_window(
+                "w", F.sum("v"), partition_by=["g"], order_by=["o"],
+                frame=("rows", -2, 1)))
+
+    def test_range_frame_sum(self):
+        from spark_rapids_tpu.api import functions as F
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: self._df(s).with_window(
+                "w", F.sum("v"), partition_by=["g"], order_by=["o"],
+                frame=("range", -5, 5)))
+
+    def test_range_frame_count_avg(self):
+        from spark_rapids_tpu.api import functions as F
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: self._df(s)
+            .with_window("c", F.count("v"), partition_by=["g"],
+                         order_by=["o"], frame=("range", None, 0))
+            .with_window("a", F.avg("v"), partition_by=["g"],
+                         order_by=["o"], frame=("range", -3, 3)))
+
+    def test_range_frame_desc(self):
+        from spark_rapids_tpu.api import functions as F
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: self._df(s).with_window(
+                "w", F.sum("v"), partition_by=["g"],
+                order_by=[F.col("o").desc()], frame=("range", -4, 2)))
+
+    def test_range_frame_with_null_order(self):
+        from spark_rapids_tpu.api import functions as F
+
+        def fn(s):
+            df = s.create_dataframe({
+                "g": [1, 1, 1, 1, 2, 2],
+                "o": [1, None, 3, None, 2, 5],
+                "v": [10, 20, 30, 40, 50, 60],
+            })
+            return df.with_window(
+                "w", F.sum("v"), partition_by=["g"], order_by=["o"],
+                frame=("range", -2, 2))
+        assert_tpu_and_cpu_are_equal_collect(fn)
