@@ -334,6 +334,11 @@ class DataFrame:
         from .reader import DataFrameWriter
         return DataFrameWriter(self)
 
+    def create_or_replace_temp_view(self, name: str) -> None:
+        self.session.register_table(name, self)
+
+    createOrReplaceTempView = create_or_replace_temp_view
+
     def to_device_batches(self):
         """Zero-copy export of device ColumnarBatches for ML libraries.
 

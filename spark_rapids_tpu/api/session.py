@@ -73,6 +73,7 @@ class TpuSession:
         DeviceManager.initialize(self.conf)
         TpuSession._active = self
         self._last_planner: Optional[Planner] = None
+        self._views: dict = {}
 
     builder = TpuSessionBuilder
 
@@ -97,10 +98,16 @@ class TpuSession:
         if isinstance(data, pa.Table):
             table = data
         elif isinstance(data, dict):
-            from ..columnar.batch import ColumnarBatch
-            from ..columnar.arrow import to_arrow
-            batch = ColumnarBatch.from_pydict(data, schema=schema)
-            table = to_arrow(batch)
+            if schema is None:
+                # pyarrow inference handles date/datetime/decimal object
+                # arrays that numpy would stringify
+                table = pa.table({k: pa.array(v) for k, v in data.items()})
+            else:
+                from ..columnar.arrow import schema_to_arrow
+                target = schema_to_arrow(schema)
+                table = pa.table(
+                    {f.name: pa.array(data[f.name], type=target.field(
+                        f.name).type) for f in schema})
         elif isinstance(data, list):
             # list of tuples + schema
             assert schema is not None, "list data requires a schema"
@@ -125,6 +132,23 @@ class TpuSession:
     def read(self):
         from .reader import DataFrameReader
         return DataFrameReader(self)
+
+    # -- SQL -----------------------------------------------------------------
+    def sql(self, query: str):
+        """Parse + lower a SQL query against registered temp views.
+
+        Reference role: Spark's own parser/analyzer feed the plugin its
+        plans; standalone, api/sql.py supplies that front end."""
+        from .dataframe import DataFrame
+        from .sql import sql_to_plan
+        plan = sql_to_plan(query, self, self._views)
+        return DataFrame(plan, self)
+
+    def register_table(self, name: str, df) -> None:
+        self._views[name.lower()] = df._plan
+
+    def drop_temp_view(self, name: str) -> None:
+        self._views.pop(name.lower(), None)
 
     # -- execution -----------------------------------------------------------
     def _plan(self, logical: L.LogicalPlan):

@@ -323,6 +323,10 @@ class CpuJoin(CpuExec):
     def _join(self, lt: pa.Table, rt: pa.Table) -> pa.Table:
         lg = self.logical
         out_schema = schema_to_arrow(self.output_schema)
+        if lg.condition is not None and lg.join_type != "cross":
+            # residual restricts pairs, not rows: expand inner pairs,
+            # filter, then derive outer/semi/anti rows from survivors
+            return self._join_residual(lt, rt)
         # pyarrow's hash join rejects nested payload columns: replace them
         # with row-index surrogates, join, then gather them back
         nested_l = [n for n, f in zip(lt.column_names, lt.schema)
@@ -393,6 +397,64 @@ class CpuJoin(CpuExec):
         drop = [c for c in res.column_names if c.startswith("__lk_")
                 or c.startswith("__rk_")]
         return res.drop_columns(drop)
+
+    def _join_residual(self, lt: pa.Table, rt: pa.Table) -> pa.Table:
+        lg = self.logical
+        out_schema = schema_to_arrow(self.output_schema)
+        keys = {}
+        for i, (le, re) in enumerate(zip(lg.left_keys, lg.right_keys)):
+            keys[f"__k{i}"] = (_arr(cpu_eval(le, lt), lt.num_rows),
+                              _arr(cpu_eval(re, rt), rt.num_rows))
+        lkt = pa.table({**{k: v[0] for k, v in keys.items()},
+                        "__lidx": pa.array(
+                            np.arange(lt.num_rows, dtype=np.int64))})
+        rkt = pa.table({**{f"{k}_r": v[1] for k, v in keys.items()},
+                        "__ridx": pa.array(
+                            np.arange(rt.num_rows, dtype=np.int64))})
+        pairs = lkt.join(rkt, keys=list(keys),
+                         right_keys=[f"{k}_r" for k in keys],
+                         join_type="inner", use_threads=False,
+                         coalesce_keys=False)
+        lidx = pairs.column("__lidx").to_numpy().astype(np.int64)
+        ridx = pairs.column("__ridx").to_numpy().astype(np.int64)
+        ptab = pa.Table.from_arrays(
+            [lt.column(n).take(lidx) for n in lt.column_names] +
+            [rt.column(n).take(ridx) for n in rt.column_names],
+            names=list(lt.column_names) + list(rt.column_names))
+        m = pc.fill_null(pc.cast(
+            _arr(cpu_eval(lg.condition, ptab), ptab.num_rows),
+            pa.bool_()), False).to_numpy(zero_copy_only=False)
+        lidx, ridx = lidx[m], ridx[m]
+        jt = lg.join_type
+        if jt in ("semi", "anti"):
+            hit = np.zeros(lt.num_rows, dtype=bool)
+            hit[lidx] = True
+            sel = np.nonzero(hit if jt == "semi" else ~hit)[0]
+            return self._finish(lt.take(pa.array(sel)), out_schema)
+        li_parts, ri_parts = [lidx], [ridx]
+        lm_parts = [np.zeros(len(lidx), dtype=bool)]
+        rm_parts = [np.zeros(len(ridx), dtype=bool)]
+        if jt in ("left", "full"):
+            un = np.setdiff1d(np.arange(lt.num_rows, dtype=np.int64), lidx)
+            li_parts.append(un)
+            ri_parts.append(np.zeros(len(un), dtype=np.int64))
+            lm_parts.append(np.zeros(len(un), dtype=bool))
+            rm_parts.append(np.ones(len(un), dtype=bool))
+        if jt in ("right", "full"):
+            un = np.setdiff1d(np.arange(rt.num_rows, dtype=np.int64), ridx)
+            li_parts.append(np.zeros(len(un), dtype=np.int64))
+            ri_parts.append(un)
+            lm_parts.append(np.ones(len(un), dtype=bool))
+            rm_parts.append(np.zeros(len(un), dtype=bool))
+        l_take = pa.array(np.concatenate(li_parts),
+                          mask=np.concatenate(lm_parts))
+        r_take = pa.array(np.concatenate(ri_parts),
+                          mask=np.concatenate(rm_parts))
+        res = pa.Table.from_arrays(
+            [lt.column(n).take(l_take) for n in lt.column_names] +
+            [rt.column(n).take(r_take) for n in rt.column_names],
+            names=list(lt.column_names) + list(rt.column_names))
+        return self._finish(res, out_schema)
 
     def _finish(self, res: pa.Table, out_schema: pa.Schema) -> pa.Table:
         # positional mapping (duplicate column names are legal post-join)

@@ -40,7 +40,7 @@ class CpuWindow(CpuExec):
 
     @staticmethod
     def _bounded_frame(grouped, work, src, okey, kind, lo, hi, agg,
-                       ascending: bool):
+                       ascending: bool, nulls_first: bool = True):
         """Exact per-row frame aggregation (rows or range bounds)."""
         import pandas as pd
 
@@ -73,19 +73,34 @@ class CpuWindow(CpuExec):
                 else:
                     v = order[i]
                     if v is None:
-                        window = [vals[j] for j in range(n)
-                                  if order[j] is None]
+                        # null current row: null+offset = null, and null
+                        # sorts at the partition edge, so the bounded
+                        # side toward the values keeps only null peers —
+                        # unless that side is UNBOUNDED, which takes the
+                        # whole partition (Spark RangeBoundOrdering)
+                        if (hi is None) if nulls_first else (lo is None):
+                            window = list(vals)
+                        else:
+                            window = [vals[j] for j in range(n)
+                                      if order[j] is None]
                     else:
                         d1 = lo if lo is not None else None
                         d2 = hi if hi is not None else None
                         if not ascending:
                             d1, d2 = (None if d2 is None else -d2,
                                       None if d1 is None else -d1)
+                        # an UNBOUNDED side reaches the partition edge,
+                        # so it takes the null-order block in with it
+                        # (Spark RANGE semantics; matches the TPU
+                        # rank-search encoding of nulls)
+                        incl_null = (lo is None) if nulls_first \
+                            else (hi is None)
                         window = [
                             vals[j] for j in range(n)
-                            if order[j] is not None and
-                            (d1 is None or order[j] >= v + d1) and
-                            (d2 is None or order[j] <= v + d2)]
+                            if ((order[j] is None and incl_null) or
+                                (order[j] is not None and
+                                 (d1 is None or order[j] >= v + d1) and
+                                 (d2 is None or order[j] <= v + d2)))]
                 clean = [x for x in window
                          if x is not None and not (
                              isinstance(x, float) and np.isnan(x))]
@@ -208,7 +223,9 @@ class CpuWindow(CpuExec):
                         grouped, work, src, okey, frame_kind, fstart,
                         fend, agg,
                         spec.order_by[0].ascending if spec.order_by
-                        else True)
+                        else True,
+                        spec.order_by[0].effective_nulls_first
+                        if spec.order_by else True)
                 if agg == "count":
                     res = res.astype(np.int64)
                 work.drop(columns=[src], inplace=True)

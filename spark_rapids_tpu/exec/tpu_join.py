@@ -133,6 +133,13 @@ class TpuHashJoinBase(TpuExec):
         swords = _key_words(skey_cols, sb.num_rows, str_words)
         jc = join_k.probe_counts(bt, swords, sb.num_rows)
 
+        if lg.condition is not None:
+            # residual restricts which PAIRS match; outer/semi/anti row
+            # semantics are decided on the surviving pairs (a plain
+            # post-filter would wrongly drop null-extended outer rows)
+            return self._join_batch_residual(sb, jc, build, bt,
+                                             build_matched)
+
         if jt in ("semi", "anti"):
             from ..kernels import basic as bk
             in_range = jnp.arange(sb.capacity) < sb.num_rows
@@ -188,22 +195,91 @@ class TpuHashJoinBase(TpuExec):
         live_mask = jnp.arange(out_cap) < total
         scols = [c.mask_validity(live_mask) for c in stream_out.columns]
         bcols = [c.mask_validity(live_mask) for c in build_out.columns]
-        out = self._assemble(scols, bcols, total)
+        return self._assemble(scols, bcols, total)
 
-        # residual non-equi condition (inner-style filter)
-        if lg.condition is not None:
-            from .tpu_basic import TpuFilter
-            cond = lg.condition.bind(self.output_schema)
-            pred = ec.eval_as_column(cond, out)
-            from ..kernels import basic as bk
-            keep = pred.data.astype(bool) & pred.validity
-            idx, cnt = bk.compact_indices(keep, out.num_rows)
+    def _join_batch_residual(self, sb, jc, build, bt,
+                             build_matched) -> Optional[ColumnarBatch]:
+        """Join with a residual (non-equi) condition: expand the INNER
+        pairs, evaluate the condition per pair, then derive the join
+        type's row set from the surviving pairs."""
+        from ..kernels import basic as bk
+        lg = self.logical
+        jt = lg.join_type
+        lschema = self.children[0].output_schema
+        rschema = self.children[1].output_schema
+        pair_schema = Schema(
+            [Field(f.name, f.dtype, True) for f in lschema] +
+            [Field(f.name, f.dtype, True) for f in rschema])
+
+        total = int(join_k.total_matches(jc.counts))
+        out_cap = bucket_capacity(max(total, 1))
+        p_idx, b_idx, _live, _ = join_k.expand_matches(
+            jc.lo, jc.counts, bt.perm, out_cap)
+        stream_out = sb.gather(p_idx, total)
+        build_out = build.gather(b_idx, total)
+        live_mask = jnp.arange(out_cap) < total
+        scols = [c.mask_validity(live_mask) for c in stream_out.columns]
+        bcols = [c.mask_validity(live_mask) for c in build_out.columns]
+        if self.build_right:
+            pair_cols = scols + bcols
+        else:
+            pair_cols = bcols + scols
+        pairs = ColumnarBatch(pair_schema, pair_cols, total)
+        pred = ec.eval_as_column(lg.condition.bind(pair_schema), pairs)
+        keep = pred.data.astype(bool) & pred.validity & live_mask
+
+        # per-stream-row "has a surviving pair"
+        surv = jnp.zeros(sb.capacity, dtype=bool).at[
+            jnp.where(keep, p_idx, 0)].max(keep)
+        in_range = jnp.arange(sb.capacity) < sb.num_rows
+
+        if jt in ("semi", "anti"):
+            sel = surv if jt == "semi" else (~surv & in_range)
+            idx, cnt = bk.compact_indices(sel, sb.num_rows)
             n = int(cnt)
-            g = out.gather(idx, n)
-            m = jnp.arange(g.capacity) < n
-            out = ColumnarBatch(self.output_schema,
-                                [c.mask_validity(m) for c in g.columns], n)
-        return out
+            out = sb.gather(idx, n)
+            mask = jnp.arange(out.capacity) < n
+            return ColumnarBatch(
+                self.output_schema,
+                [c.mask_validity(mask) for c in out.columns], n)
+
+        if build_matched is not None and total:
+            midx = np.asarray(jnp.where(keep, b_idx, 0))
+            flags = np.zeros(build.capacity, dtype=bool)
+            flags[midx[np.asarray(keep)]] = True
+            build_matched |= flags
+
+        # surviving pairs
+        pidx2, pcnt = bk.compact_indices(keep, total)
+        n_pairs = int(pcnt)
+        sp = stream_out.gather(pidx2, n_pairs)
+        bp = build_out.gather(pidx2, n_pairs)
+        pmask = jnp.arange(sp.capacity) < n_pairs
+        sp_cols = [c.mask_validity(pmask) for c in sp.columns]
+        bp_cols = [c.mask_validity(pmask) for c in bp.columns]
+        parts = []
+        if n_pairs:
+            parts.append(self._assemble(sp_cols, bp_cols, n_pairs))
+
+        outer_stream = ((jt == "left" and self.build_right) or
+                        (jt == "right" and not self.build_right) or
+                        jt == "full")
+        if outer_stream:
+            un = ~surv & in_range
+            uidx, ucnt = bk.compact_indices(un, sb.num_rows)
+            n_un = int(ucnt)
+            if n_un:
+                su = sb.gather(uidx, n_un)
+                umask = jnp.arange(su.capacity) < n_un
+                su_cols = [c.mask_validity(umask) for c in su.columns]
+                nulls = [_null_column(f.dtype, su.capacity)
+                         for f in build.schema]
+                parts.append(self._assemble(su_cols, nulls, n_un))
+        if not parts:
+            return ColumnarBatch.empty(self.output_schema)
+        if len(parts) == 1:
+            return parts[0]
+        return concat_batches(parts)
 
     def _assemble(self, stream_cols, build_cols, total) -> ColumnarBatch:
         if self.build_right:

@@ -181,7 +181,22 @@ class Literal(LeafExpression):
             elif isinstance(value, str):
                 dt = T.STRING
             else:
-                raise ValueError(f"cannot infer literal type for {value!r}")
+                import datetime as _dtmod
+                if isinstance(value, _dtmod.datetime):
+                    dt = T.TIMESTAMP
+                    # aware datetimes must diff against a UTC epoch or
+                    # the zone offset silently cancels out
+                    epoch = _dtmod.datetime(
+                        1970, 1, 1,
+                        tzinfo=_dtmod.timezone.utc if value.tzinfo
+                        is not None else None)
+                    value = int((value - epoch).total_seconds() * 1_000_000)
+                elif isinstance(value, _dtmod.date):
+                    dt = T.DATE
+                    value = (value - _dtmod.date(1970, 1, 1)).days
+                else:
+                    raise ValueError(
+                        f"cannot infer literal type for {value!r}")
         self.value = value
         self._dtype = dt
 

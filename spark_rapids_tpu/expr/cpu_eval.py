@@ -490,7 +490,7 @@ def _date_diff(e, t):
 def _round(e: A.Round, t):
     v = pc.cast(_ev(e.children[0], t), pa.float64(), safe=False)
     return pc.round(v, ndigits=e.scale,
-                    round_mode="half_away_from_zero")
+                    round_mode="half_towards_infinity")
 
 
 def _fallback_rowwise(expr, table: pa.Table):

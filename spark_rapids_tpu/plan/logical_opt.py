@@ -1,0 +1,119 @@
+"""Logical-plan rewrites: predicate pushdown + equi-join extraction.
+
+Role note: the reference plugs into Spark *after* Catalyst's optimizer has
+already pushed predicates and chosen join keys (SparkPlan arrives
+optimized; GpuOverrides.scala:3100 only re-maps physical ops).  This
+standalone framework owns the front end, so the classical rewrites live
+here: conjuncts of a Filter over an inner/cross Join are split into
+per-side filters, cross-side equalities become hash-join keys (turning a
+cross join into an equi join the TPU hash-join exec can run), and the
+remainder stays as a residual filter.
+"""
+from __future__ import annotations
+
+import copy
+from typing import List, Optional, Set
+
+from ..expr import core as ec
+from ..expr import predicates as ep
+from . import logical as L
+
+
+def _flatten_and(e: ec.Expression) -> List[ec.Expression]:
+    if isinstance(e, ep.And):
+        return _flatten_and(e.children[0]) + _flatten_and(e.children[1])
+    return [e]
+
+
+def _and_all(conjuncts: List[ec.Expression]) -> ec.Expression:
+    out = conjuncts[0]
+    for c in conjuncts[1:]:
+        out = ep.And(out, c)
+    return out
+
+
+def _refs(e: ec.Expression) -> Optional[Set[str]]:
+    """Names of AttributeReferences in e; None if e contains anything
+    (BoundReference, subquery-ish) that makes pushdown unsafe."""
+    if isinstance(e, ec.BoundReference):
+        return None
+    if isinstance(e, ec.AttributeReference):
+        return {e.col_name}
+    out: Set[str] = set()
+    for c in e.children:
+        r = _refs(c)
+        if r is None:
+            return None
+        out |= r
+    return out
+
+
+def _filter_over(conjuncts: List[ec.Expression],
+                 plan: L.LogicalPlan) -> L.LogicalPlan:
+    if not conjuncts:
+        return plan
+    return L.Filter(_and_all(conjuncts), plan)
+
+
+def _rewrite_filter_join(f: L.Filter) -> L.LogicalPlan:
+    j = f.children[0]
+    if not isinstance(j, L.Join) or j.join_type not in ("inner", "cross"):
+        return f
+    left, right = j.children
+    lnames = set(left.schema.names)
+    rnames = set(right.schema.names)
+    if lnames & rnames:
+        return f  # ambiguous column names: leave untouched
+    lpush: List[ec.Expression] = []
+    rpush: List[ec.Expression] = []
+    lkeys = list(j.left_keys)
+    rkeys = list(j.right_keys)
+    rest: List[ec.Expression] = []
+    for c in _flatten_and(f.condition):
+        refs = _refs(c)
+        if refs is None or not refs:
+            rest.append(c)
+        elif refs <= lnames:
+            lpush.append(c)
+        elif refs <= rnames:
+            rpush.append(c)
+        elif isinstance(c, ep.EqualTo):
+            a, b = c.children
+            ra, rb = _refs(a), _refs(b)
+            if ra and rb and ra <= lnames and rb <= rnames:
+                lkeys.append(a)
+                rkeys.append(b)
+            elif ra and rb and ra <= rnames and rb <= lnames:
+                lkeys.append(b)
+                rkeys.append(a)
+            else:
+                rest.append(c)
+        else:
+            rest.append(c)
+    if not lpush and not rpush and len(lkeys) == len(j.left_keys):
+        return f
+    new_left = optimize(_filter_over(lpush, left))
+    new_right = optimize(_filter_over(rpush, right))
+    jt = "inner" if lkeys else j.join_type
+    nj = L.Join(new_left, new_right, jt, lkeys, rkeys, j.condition)
+    return _filter_over(rest, nj)
+
+
+def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Bottom-up: push Filter conjuncts through inner/cross joins and
+    promote cross-side equalities to join keys."""
+    new_children = [optimize(c) for c in plan.children]
+    if any(n is not o for n, o in zip(new_children, plan.children)):
+        plan = copy.copy(plan)
+        plan.children = new_children
+    if isinstance(plan, L.Filter):
+        # collapse Filter(Filter(..)) so conjuncts see the join below
+        child = plan.children[0]
+        if isinstance(child, L.Filter):
+            merged = L.Filter(
+                ep.And(plan.condition, child.condition), child.children[0])
+            return optimize(merged)
+        out = _rewrite_filter_join(plan)
+        if out is not plan:
+            return out
+    return plan

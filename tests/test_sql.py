@@ -1,0 +1,302 @@
+"""SQL front-end tests: parse+lower, then CPU-vs-TPU engine equality.
+
+Reference pattern: the reference's qa_nightly_select_test.py runs a large
+SQL sweep through Spark's parser and compares GPU vs CPU results; here
+the framework owns the parser (api/sql.py) and the oracle is the CPU
+engine (SURVEY.md §4).
+"""
+import datetime
+
+import numpy as np
+import pytest
+
+from harness import assert_tpu_and_cpu_are_equal_collect, with_cpu_session
+
+from spark_rapids_tpu.api.sql import parse_sql, SqlError
+
+
+def _tables(s):
+    rng = np.random.default_rng(42)
+    n = 500
+    t1 = s.create_dataframe({
+        "k": rng.integers(0, 20, n).astype(np.int64),
+        "v": rng.integers(-100, 100, n).astype(np.int64),
+        "x": np.round(rng.random(n) * 100, 3),
+        "name": np.array([f"item_{i % 37}" for i in range(n)]),
+        "d": np.array([datetime.date(1995, 1, 1) +
+                       datetime.timedelta(days=int(i)) for i in
+                       rng.integers(0, 1500, n)]),
+    }, num_partitions=3)
+    t2 = s.create_dataframe({
+        "k": np.arange(20, dtype=np.int64),
+        "label": np.array([f"grp_{i}" for i in range(20)]),
+        "w": rng.random(20),
+    })
+    t1.create_or_replace_temp_view("t1")
+    t2.create_or_replace_temp_view("t2")
+    return t1, t2
+
+
+def _sql(query):
+    def fn(s):
+        _tables(s)
+        return s.sql(query)
+    return fn
+
+
+# -- parser-level ----------------------------------------------------------
+
+def test_parse_errors():
+    with pytest.raises(SqlError):
+        parse_sql("select from t")
+    with pytest.raises(SqlError):
+        parse_sql("select * t1")   # trailing junk
+    with pytest.raises(SqlError):
+        parse_sql("select a from t where")
+
+
+def test_parse_shapes():
+    ast = parse_sql("""
+        with c as (select k from t1)
+        select k, sum(v) as sv from c join t2 on c.k = t2.k
+        where k > 2 group by k having sum(v) > 0
+        order by sv desc limit 5""")
+    assert ast.ctes[0][0] == "c"
+    assert ast.limit == 5
+    assert len(ast.group_by) == 1
+
+
+# -- end-to-end equality ---------------------------------------------------
+
+def test_select_where():
+    assert_tpu_and_cpu_are_equal_collect(_sql(
+        "SELECT k, v + 1 AS v1, x * 2 FROM t1 WHERE v > 0 AND x < 50"))
+
+
+def test_select_star():
+    assert_tpu_and_cpu_are_equal_collect(_sql(
+        "SELECT * FROM t2 WHERE w > 0.5"))
+
+
+def test_case_between_in_like():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k,
+               CASE WHEN v < 0 THEN 'neg' WHEN v = 0 THEN 'zero'
+                    ELSE 'pos' END AS sgn,
+               v BETWEEN -10 AND 10 AS near,
+               k IN (1, 3, 5, 7) AS odd_pick,
+               name LIKE 'item_1%' AS starts1
+        FROM t1"""))
+
+
+def test_is_null_and_not():
+    assert_tpu_and_cpu_are_equal_collect(_sql(
+        "SELECT k, v IS NOT NULL, NOT (v > 0) FROM t1 WHERE x IS NOT NULL"))
+
+
+def test_cast():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT CAST(x AS int) AS xi, CAST(k AS string) AS ks,
+               CAST(v AS double) / 4 AS vq FROM t1"""))
+
+
+def test_group_by_having():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k, sum(v) AS sv, count(*) AS n, avg(x) AS ax,
+               min(v) AS mn, max(v) AS mx
+        FROM t1 GROUP BY k HAVING count(*) > 5"""))
+
+
+def test_group_by_expr_and_ordinal():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k % 3 AS kg, sum(x) AS sx FROM t1 GROUP BY 1"""))
+
+
+def test_agg_arith_combo():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k, sum(v) * 1.0 / count(*) AS ratio,
+               sum(x + 1) - max(v) AS combo
+        FROM t1 GROUP BY k"""))
+
+
+def test_global_agg():
+    assert_tpu_and_cpu_are_equal_collect(_sql(
+        "SELECT count(*) AS n, sum(v) AS sv, avg(x) AS ax FROM t1"))
+
+
+def test_join_on():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT t1.k, t1.v, t2.label FROM t1
+        JOIN t2 ON t1.k = t2.k WHERE t2.w > 0.3"""))
+
+
+def test_join_comma_where():
+    """Comma join + WHERE equality must become an equi join."""
+    def fn(s):
+        _tables(s)
+        df = s.sql("""
+            SELECT t1.k, t2.label, t1.v FROM t1, t2
+            WHERE t1.k = t2.k AND t1.v > 0""")
+        return df
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_join_left_right_full():
+    for how in ("LEFT", "RIGHT", "FULL"):
+        assert_tpu_and_cpu_are_equal_collect(_sql(f"""
+            SELECT t1.k, t1.v, t2.label FROM t1
+            {how} JOIN t2 ON t1.k = t2.k AND t2.w > 0.5"""))
+
+
+def test_self_join_aliases():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT a.k, a.label, b.label AS label2
+        FROM t2 a JOIN t2 b ON a.k = b.k"""))
+
+
+def test_order_limit_offset():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k, v FROM t1 ORDER BY v DESC, k ASC LIMIT 17"""),
+        ignore_order=False)
+
+
+def test_order_by_alias_and_ordinal():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k, sum(v) AS sv FROM t1 GROUP BY k ORDER BY 2 DESC, k"""),
+        ignore_order=False)
+
+
+def test_order_by_hidden_column():
+    # sort key not in the select list
+    assert_tpu_and_cpu_are_equal_collect(_sql(
+        "SELECT k FROM t1 ORDER BY v, k, x"), ignore_order=False)
+
+
+def test_distinct():
+    assert_tpu_and_cpu_are_equal_collect(_sql(
+        "SELECT DISTINCT k FROM t1 ORDER BY k"), ignore_order=False)
+
+
+def test_union_all_and_union():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k, v FROM t1 WHERE v > 50
+        UNION ALL SELECT k, v FROM t1 WHERE v < -50"""))
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k FROM t1 WHERE v > 0 UNION SELECT k FROM t1 WHERE v < 0"""))
+
+
+def test_intersect_except():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k FROM t1 WHERE v > 0 INTERSECT SELECT k FROM t1
+        WHERE v < 0"""))
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k FROM t1 EXCEPT SELECT k FROM t1 WHERE v >= 0"""))
+
+
+def test_cte():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        WITH big AS (SELECT k, v FROM t1 WHERE v > 20),
+             agg AS (SELECT k, count(*) AS n FROM big GROUP BY k)
+        SELECT agg.k, agg.n, t2.label FROM agg JOIN t2 ON agg.k = t2.k"""))
+
+
+def test_from_subquery():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT sub.k, sub.sv * 2 AS sv2
+        FROM (SELECT k, sum(v) AS sv FROM t1 GROUP BY k) AS sub
+        WHERE sub.sv > 0"""))
+
+
+def test_scalar_subquery():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k, x FROM t1 WHERE x > (SELECT avg(x) FROM t1)"""))
+
+
+def test_in_subquery_semi_anti():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k, v FROM t1 WHERE k IN (SELECT k FROM t2 WHERE w > 0.5)"""))
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k, v FROM t1
+        WHERE k NOT IN (SELECT k FROM t2 WHERE w > 0.5) AND v > 0"""))
+
+
+def test_string_funcs():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT upper(name), substring(name, 1, 4), length(name),
+               name || '_sfx' AS cc, replace(name, 'item', 'it') AS rep
+        FROM t1 WHERE name LIKE '%3%'"""))
+
+
+def test_date_funcs_and_literals():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT year(d) AS y, month(d) AS m, dayofmonth(d) AS dd,
+               date_add(d, 10) AS d10
+        FROM t1 WHERE d >= DATE '1996-06-01'
+          AND d < DATE '1998-12-01' - INTERVAL '90' DAY"""))
+
+
+def test_math_funcs():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT abs(v) AS av, round(x, 1) AS rx, sqrt(abs(v)) AS sv,
+               floor(x) AS fx, ceil(x) AS cx, pmod(v, 7) AS pv,
+               greatest(v, 0) AS gv, least(x, 50.0) AS lx
+        FROM t1"""))
+
+
+def test_window_over():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k, v,
+               row_number() OVER (PARTITION BY k ORDER BY v, x) AS rn,
+               rank() OVER (PARTITION BY k ORDER BY v, x) AS rk,
+               sum(v) OVER (PARTITION BY k ORDER BY v, x
+                            ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)
+                   AS running
+        FROM t1"""))
+
+
+def test_window_over_aggregate():
+    # window over an aggregated relation (TPC-DS shape)
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k, sv, rank() OVER (ORDER BY sv DESC, k) AS rnk
+        FROM (SELECT k, sum(v) AS sv FROM t1 GROUP BY k) s
+        ORDER BY rnk"""), ignore_order=False)
+
+
+def test_no_from():
+    assert_tpu_and_cpu_are_equal_collect(_sql(
+        "SELECT 1 + 2 AS three, 'x' AS s, CAST(2.5 AS int) AS i"))
+
+
+def test_qa_style_sweep():
+    """A miniature qa_nightly_select_test-style battery."""
+    queries = [
+        "SELECT k+v, k-v, k*2, v/3, v%5 FROM t1",
+        "SELECT -v, +v, NOT (v>0) FROM t1",
+        "SELECT k FROM t1 WHERE v > 10 OR (x < 20 AND k <> 3)",
+        "SELECT coalesce(NULL, v, 0), nullif(k, 3), if(v>0, 'p', 'n') "
+        "FROM t1",
+        "SELECT count(v), first(k), last(k) FROM t1 GROUP BY k % 4",
+        "SELECT t2.label, max(t1.x) FROM t1 JOIN t2 ON t1.k = t2.k "
+        "GROUP BY t2.label",
+    ]
+    for q in queries:
+        assert_tpu_and_cpu_are_equal_collect(_sql(q))
+
+
+def test_sql_plan_uses_tpu():
+    """The SQL path must hit TPU execs, not fall back wholesale."""
+    def fn(s):
+        _tables(s)
+        df = s.sql("SELECT k, sum(v) AS sv FROM t1 WHERE x > 1 GROUP BY k")
+        return df
+    from harness import with_tpu_session
+
+    def run(s):
+        df = fn(s)
+        df.collect()
+        tree = df._last_physical_plan.tree_string()
+        assert "TpuHashAggregate" in tree, tree
+        assert "TpuFilter" in tree or "TpuFused" in tree or \
+            "Fused" in tree, tree
+        return []
+    with_tpu_session(run)

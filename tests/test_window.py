@@ -117,3 +117,25 @@ class TestFrames:
                 "w", F.sum("v"), partition_by=["g"], order_by=["o"],
                 frame=("range", -2, 2))
         assert_tpu_and_cpu_are_equal_collect(fn)
+
+    def test_range_frame_half_unbounded_with_null_order(self):
+        """UNBOUNDED sides reach the partition edge and take the
+        null-order block in with them (Spark RANGE semantics)."""
+        from spark_rapids_tpu.api import functions as F
+
+        def fn(frame, order_desc=False):
+            def run(s):
+                df = s.create_dataframe({
+                    "g": [1, 1, 1, 1, 2, 2],
+                    "o": [1, None, 3, None, 2, 5],
+                    "v": [10, 20, 30, 40, 50, 60],
+                })
+                ob = [F.col("o").desc()] if order_desc else ["o"]
+                return df.with_window(
+                    "w", F.sum("v"), partition_by=["g"], order_by=ob,
+                    frame=frame)
+            return run
+        assert_tpu_and_cpu_are_equal_collect(fn(("range", None, 0)))
+        assert_tpu_and_cpu_are_equal_collect(fn(("range", -1, None)))
+        assert_tpu_and_cpu_are_equal_collect(
+            fn(("range", None, 1), order_desc=True))
