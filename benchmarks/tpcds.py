@@ -1,0 +1,313 @@
+"""TPC-DS-style benchmark suite over the SQL front end.
+
+Reference baseline configs (BASELINE.json): "TPC-DS SF100 — full 99-query
+sweep, local shuffle".  This module generates the TPC-DS star schema
+(store_sales fact + date/item/store/customer/demographics/promotion/time
+dimensions) at a row-scaled factor, writes Parquet, registers the tables
+as temp views, and runs real TPC-DS query texts (Q3, Q7, Q19, Q42, Q52,
+Q55, Q96, Q98 — the star-join/agg/window shapes) through
+``session.sql()`` on either engine.
+
+Usage:
+  python benchmarks/tpcds.py --scale 0.01 --engine tpu
+  python benchmarks/tpcds.py --scale 0.01 --compare   # TPU vs CPU timings
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ROWS_PER_SF = {"store_sales": 2_880_000, "item": 18_000,
+               "customer": 100_000, "customer_address": 50_000,
+               "customer_demographics": 19_208, "store": 12,
+               "household_demographics": 7_200, "promotion": 300}
+
+DATE_SK0 = 2450815          # 1998-01-01
+N_DATES = 365 * 5           # 1998-2002
+
+
+def generate(data_dir: str, scale: float, seed: int = 0):
+    import pyarrow as pa
+    import pyarrow.parquet as papq
+    rng = np.random.default_rng(seed)
+    os.makedirs(data_dir, exist_ok=True)
+
+    def write(name, table):
+        papq.write_table(table, os.path.join(data_dir, f"{name}.parquet"))
+
+    n = {k: max(int(v * scale), 64) for k, v in ROWS_PER_SF.items()}
+    n["store"] = max(int(ROWS_PER_SF["store"] * max(scale, 1)), 4)
+
+    # date_dim: real calendar over 1998-2002
+    days = (np.datetime64("1998-01-01") +
+            np.arange(N_DATES).astype("timedelta64[D]"))
+    ymd = days.astype("datetime64[D]")
+    years = ymd.astype("datetime64[Y]").astype(int) + 1970
+    months = ymd.astype("datetime64[M]").astype(int) % 12 + 1
+    dom = (ymd - ymd.astype("datetime64[M]")).astype(int) + 1
+    write("date_dim", pa.table({
+        "d_date_sk": (DATE_SK0 + np.arange(N_DATES)).astype(np.int64),
+        "d_year": years.astype(np.int32),
+        "d_moy": months.astype(np.int32),
+        "d_dom": dom.astype(np.int32),
+    }))
+
+    write("time_dim", pa.table({
+        "t_time_sk": np.arange(86400, dtype=np.int64),
+        "t_hour": (np.arange(86400) // 3600).astype(np.int32),
+        "t_minute": ((np.arange(86400) % 3600) // 60).astype(np.int32),
+    }))
+
+    ni = n["item"]
+    write("item", pa.table({
+        "i_item_sk": np.arange(ni, dtype=np.int64),
+        "i_item_id": np.array([f"AAAAAAAA{i:08d}" for i in range(ni)]),
+        "i_item_desc": np.array([f"desc of item {i}" for i in range(ni)]),
+        "i_brand_id": rng.integers(1000000, 1000100, ni).astype(np.int64),
+        "i_brand": np.array([f"brand#{i % 100}" for i in range(ni)]),
+        "i_class": rng.choice(
+            ["dresses", "shirts", "pants", "football", "fishing",
+             "classical", "rock"], ni),
+        "i_category": rng.choice(
+            ["Women", "Men", "Sports", "Music", "Books", "Home"], ni),
+        "i_category_id": rng.integers(1, 11, ni).astype(np.int64),
+        "i_manufact_id": rng.integers(1, 1000, ni).astype(np.int64),
+        "i_manufact": np.array([f"manufact#{i % 1000}" for i in range(ni)]),
+        "i_manager_id": rng.integers(1, 100, ni).astype(np.int64),
+        "i_current_price": (rng.random(ni) * 100).round(2),
+    }))
+
+    ns = n["store"]
+    write("store", pa.table({
+        "s_store_sk": np.arange(ns, dtype=np.int64),
+        "s_store_name": rng.choice(["ese", "ought", "able", "pri"], ns),
+        "s_zip": np.array([f"{rng.integers(10000, 99999)}" for _ in
+                           range(ns)]),
+    }))
+
+    nc = n["customer"]
+    write("customer", pa.table({
+        "c_customer_sk": np.arange(nc, dtype=np.int64),
+        "c_current_addr_sk": rng.integers(
+            0, n["customer_address"], nc).astype(np.int64),
+    }))
+
+    na = n["customer_address"]
+    write("customer_address", pa.table({
+        "ca_address_sk": np.arange(na, dtype=np.int64),
+        "ca_zip": np.array([f"{rng.integers(10000, 99999)}"
+                            for _ in range(na)]),
+    }))
+
+    nd = n["customer_demographics"]
+    write("customer_demographics", pa.table({
+        "cd_demo_sk": np.arange(nd, dtype=np.int64),
+        "cd_gender": rng.choice(["M", "F"], nd),
+        "cd_marital_status": rng.choice(["S", "M", "D", "W", "U"], nd),
+        "cd_education_status": rng.choice(
+            ["Primary", "Secondary", "College", "2 yr Degree",
+             "4 yr Degree", "Advanced Degree", "Unknown"], nd),
+    }))
+
+    nh = n["household_demographics"]
+    write("household_demographics", pa.table({
+        "hd_demo_sk": np.arange(nh, dtype=np.int64),
+        "hd_dep_count": rng.integers(0, 10, nh).astype(np.int32),
+    }))
+
+    npx = n["promotion"]
+    write("promotion", pa.table({
+        "p_promo_sk": np.arange(npx, dtype=np.int64),
+        "p_channel_email": rng.choice(["Y", "N"], npx),
+        "p_channel_event": rng.choice(["Y", "N"], npx),
+    }))
+
+    nss = n["store_sales"]
+    price = (rng.random(nss) * 200).round(2)
+    write("store_sales", pa.table({
+        "ss_sold_date_sk": (DATE_SK0 + rng.integers(
+            0, N_DATES, nss)).astype(np.int64),
+        "ss_sold_time_sk": rng.integers(0, 86400, nss).astype(np.int64),
+        "ss_item_sk": rng.integers(0, ni, nss).astype(np.int64),
+        "ss_customer_sk": rng.integers(0, nc, nss).astype(np.int64),
+        "ss_cdemo_sk": rng.integers(0, nd, nss).astype(np.int64),
+        "ss_hdemo_sk": rng.integers(0, nh, nss).astype(np.int64),
+        "ss_store_sk": rng.integers(0, ns, nss).astype(np.int64),
+        "ss_promo_sk": rng.integers(0, npx, nss).astype(np.int64),
+        "ss_quantity": rng.integers(1, 100, nss).astype(np.int32),
+        "ss_list_price": (price * 1.2).round(2),
+        "ss_sales_price": price,
+        "ss_ext_sales_price": (price * rng.integers(1, 100, nss)).round(2),
+        "ss_coupon_amt": (rng.random(nss) * 50).round(2),
+    }))
+    return n
+
+
+TABLES = ["date_dim", "time_dim", "item", "store", "customer",
+          "customer_address", "customer_demographics",
+          "household_demographics", "promotion", "store_sales"]
+
+
+def register(s, data_dir: str):
+    for t in TABLES:
+        s.read.parquet(os.path.join(data_dir, f"{t}.parquet")) \
+            .create_or_replace_temp_view(t)
+
+
+QUERIES = {
+    # TPC-DS Q3: brand revenue by year for one manufacturer in November
+    "q3": """
+        select d_year, i_brand_id brand_id, i_brand brand,
+               sum(ss_ext_sales_price) sum_agg
+        from date_dim, store_sales, item
+        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+          and i_manufact_id = 128 and d_moy = 11
+        group by d_year, i_brand_id, i_brand
+        order by d_year, sum_agg desc, brand_id
+        limit 100""",
+    # TPC-DS Q7: average sales metrics for one demographic + promotion
+    "q7": """
+        select i_item_id,
+               avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+               avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+        from store_sales, customer_demographics, date_dim, item, promotion
+        where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+          and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+          and cd_gender = 'M' and cd_marital_status = 'S'
+          and cd_education_status = 'College'
+          and (p_channel_email = 'N' or p_channel_event = 'N')
+          and d_year = 2000
+        group by i_item_id
+        order by i_item_id
+        limit 100""",
+    # TPC-DS Q19: brand revenue where customer and store zips differ
+    "q19": """
+        select i_brand_id brand_id, i_brand brand, i_manufact_id,
+               i_manufact, sum(ss_ext_sales_price) ext_price
+        from date_dim, store_sales, item, customer, customer_address,
+             store
+        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+          and i_manager_id = 8 and d_moy = 11 and d_year = 1998
+          and ss_customer_sk = c_customer_sk
+          and c_current_addr_sk = ca_address_sk
+          and substring(ca_zip, 1, 5) <> substring(s_zip, 1, 5)
+          and ss_store_sk = s_store_sk
+        group by i_brand_id, i_brand, i_manufact_id, i_manufact
+        order by ext_price desc, brand_id
+        limit 100""",
+    # TPC-DS Q42: category revenue for one month
+    "q42": """
+        select d_year, i_category_id, i_category,
+               sum(ss_ext_sales_price) total_sales
+        from date_dim, store_sales, item
+        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+          and i_manager_id = 1 and d_moy = 11 and d_year = 2000
+        group by d_year, i_category_id, i_category
+        order by total_sales desc, d_year, i_category_id, i_category
+        limit 100""",
+    # TPC-DS Q52: brand revenue for one month
+    "q52": """
+        select d_year, i_brand_id brand_id, i_brand brand,
+               sum(ss_ext_sales_price) ext_price
+        from date_dim, store_sales, item
+        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+          and i_manager_id = 1 and d_moy = 11 and d_year = 2000
+        group by d_year, i_brand_id, i_brand
+        order by d_year, ext_price desc, brand_id
+        limit 100""",
+    # TPC-DS Q55: brand revenue for one manager/month
+    "q55": """
+        select i_brand_id brand_id, i_brand brand,
+               sum(ss_ext_sales_price) ext_price
+        from date_dim, store_sales, item
+        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+          and i_manager_id = 28 and d_moy = 11 and d_year = 1999
+        group by i_brand_id, i_brand
+        order by ext_price desc, brand_id
+        limit 100""",
+    # TPC-DS Q96: count of sales in a store/time/demographic slice
+    "q96": """
+        select count(*) cnt
+        from store_sales, household_demographics, time_dim, store
+        where ss_sold_time_sk = t_time_sk
+          and ss_hdemo_sk = hd_demo_sk and ss_store_sk = s_store_sk
+          and t_hour = 20 and t_minute >= 30 and hd_dep_count = 7
+          and s_store_name = 'ese'
+        order by cnt
+        limit 100""",
+    # TPC-DS Q98: item revenue with class-partitioned revenue ratio
+    # (aggregate + window-over-aggregate)
+    "q98": """
+        select i_item_id, i_item_desc, i_category, i_class,
+               i_current_price,
+               sum(ss_ext_sales_price) as itemrevenue,
+               sum(ss_ext_sales_price) * 100.0 /
+                 sum(sum(ss_ext_sales_price))
+                   over (partition by i_class) as revenueratio
+        from store_sales, item, date_dim
+        where ss_item_sk = i_item_sk
+          and i_category in ('Sports', 'Books', 'Home')
+          and ss_sold_date_sk = d_date_sk
+          and d_year = 1999 and d_moy between 2 and 3
+        group by i_item_id, i_item_desc, i_category, i_class,
+                 i_current_price
+        order by i_category, i_class, i_item_id, i_item_desc,
+                 revenueratio
+        limit 100""",
+}
+
+
+def run(engine: str, data_dir: str, queries, repeats: int = 1):
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.config import TpuConf
+    s = TpuSession(TpuConf({
+        "spark.rapids.tpu.sql.enabled": engine == "tpu"}))
+    register(s, data_dir)
+    times = {}
+    for name in queries:
+        sql = QUERIES[name]
+        s.sql(sql).collect()  # warmup/compile
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            rows = s.sql(sql).collect()
+            best = min(best, time.perf_counter() - t0)
+        times[name] = {"seconds": round(best, 4), "rows": len(rows)}
+    return times
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=0.001)
+    ap.add_argument("--engine", choices=["tpu", "cpu"], default="tpu")
+    ap.add_argument("--compare", action="store_true")
+    ap.add_argument("--queries", default=",".join(QUERIES))
+    ap.add_argument("--data-dir", default="/tmp/tpcds_data")
+    ap.add_argument("--repeats", type=int, default=2)
+    args = ap.parse_args()
+    tag = os.path.join(args.data_dir, f"sf{args.scale}")
+    if not os.path.exists(os.path.join(tag, "store_sales.parquet")):
+        sizes = generate(tag, args.scale)
+        print(f"generated {sizes}", file=sys.stderr)
+    queries = args.queries.split(",")
+    if args.compare:
+        tpu = run("tpu", tag, queries, args.repeats)
+        cpu = run("cpu", tag, queries, args.repeats)
+        out = {q: {"tpu_s": tpu[q]["seconds"], "cpu_s": cpu[q]["seconds"],
+                   "speedup": round(cpu[q]["seconds"] /
+                                    max(tpu[q]["seconds"], 1e-9), 2)}
+               for q in queries}
+        print(json.dumps(out, indent=2))
+    else:
+        print(json.dumps(run(args.engine, tag, queries, args.repeats),
+                         indent=2))
+
+
+if __name__ == "__main__":
+    main()

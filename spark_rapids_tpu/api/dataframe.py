@@ -19,6 +19,15 @@ from ..plan import logical as L
 from .column import Col, _expr
 
 
+def _as_out_schema(schema) -> Schema:
+    """Accept a Schema or a Spark-style DDL string ("a long, b double")."""
+    if isinstance(schema, Schema):
+        return schema
+    if isinstance(schema, str):
+        return Schema.from_ddl(schema)
+    raise TypeError(f"expected Schema or DDL string, got {type(schema)}")
+
+
 def _resolve(expr: ec.Expression, schema: Schema) -> ec.Expression:
     """Resolve AttributeReferences to typed refs against a schema."""
     if isinstance(expr, ec.AttributeReference) and expr._dtype is None:
@@ -339,6 +348,16 @@ class DataFrame:
 
     createOrReplaceTempView = create_or_replace_temp_view
 
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """fn(Iterator[pd.DataFrame]) -> Iterator[pd.DataFrame].
+
+        Reference: GpuMapInPandasExec (SURVEY.md §2.4 Python execs)."""
+        return DataFrame(
+            L.MapInPandas(fn, _as_out_schema(schema), self._plan),
+            self.session)
+
+    mapInPandas = map_in_pandas
+
     def to_device_batches(self):
         """Zero-copy export of device ColumnarBatches for ML libraries.
 
@@ -423,8 +442,10 @@ class GroupedData:
         self.keys = keys
 
     def agg(self, *aggs, **named) -> DataFrame:
+        from ..udf.python_udf import PandasAggUDFExpr
         agg_exprs: List[L.AggExpr] = []
         schema = self.df.schema
+        pandas_aggs: List[tuple] = []
         for a in aggs:
             e = a.expr if isinstance(a, Col) else a
             alias = None
@@ -432,9 +453,16 @@ class GroupedData:
                 alias = e.alias
                 e = e.children[0]
             e = _resolve(e, schema)
+            if isinstance(e, PandasAggUDFExpr):
+                pandas_aggs.append((alias or e.name, e))
+                continue
             assert isinstance(e, eagg.AggregateFunction), \
                 f"agg() requires aggregate functions, got {e!r}"
             agg_exprs.append(L.AggExpr(e, alias or repr(e)))
+        if pandas_aggs:
+            assert not agg_exprs and not named, \
+                "pandas grouped-agg UDFs cannot mix with builtin aggregates"
+            return self._agg_pandas(pandas_aggs)
         for alias, a in named.items():
             e = a.expr if isinstance(a, Col) else a
             if isinstance(e, ec.Alias):
@@ -446,6 +474,54 @@ class GroupedData:
 
     def count(self) -> DataFrame:
         return self.agg(count=Col(eagg.Count()))
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """fn(pdf) -> pdf (or fn(key_tuple, pdf) -> pdf) per key group.
+
+        Reference: GpuFlatMapGroupsInPandasExec (SURVEY.md §2.8)."""
+        return DataFrame(
+            L.GroupedMapInPandas(self.keys, fn, _as_out_schema(schema),
+                                 self.df._plan),
+            self.df.session)
+
+    applyInPandas = apply_in_pandas
+    apply = apply_in_pandas
+
+    def _agg_pandas(self, pandas_aggs) -> DataFrame:
+        """GROUPED_AGG pandas UDFs, routed through applyInPandas: the
+        generated group fn emits one row of keys + aggregated values.
+
+        Reference: GpuAggregateInPandasExec."""
+        from ..columnar.schema import Field, Schema
+        from ..expr.core import output_name
+        key_fields = []
+        key_names = []
+        for k in self.keys:
+            assert isinstance(k, ec.AttributeReference), \
+                "pandas grouped-agg requires plain column group keys"
+            key_fields.append(Field(k.col_name, k.dtype(), k.nullable))
+            key_names.append(k.col_name)
+        out_fields = list(key_fields)
+        specs = []
+        for alias, e in pandas_aggs:
+            for c in e.children:
+                assert isinstance(c, ec.AttributeReference), \
+                    "pandas grouped-agg arguments must be plain columns"
+            specs.append((alias, e.fn,
+                          [c.col_name for c in e.children]))
+            out_fields.append(Field(alias, e.return_type, True))
+
+        def grouped_agg(key, pdf):
+            import pandas as pd
+            row = {n: [v] for n, v in zip(key_names, key)}
+            for alias, fn, argcols in specs:
+                row[alias] = [fn(*[pdf[c] for c in argcols])]
+            return pd.DataFrame(row)
+
+        return DataFrame(
+            L.GroupedMapInPandas(self.keys, grouped_agg,
+                                 Schema(out_fields), self.df._plan),
+            self.df.session)
 
     def _simple(self, fn, cols) -> DataFrame:
         schema = self.df.schema

@@ -253,6 +253,7 @@ class SelectStmt(Ast):
     order_by: Tuple[OrderItem, ...]
     limit: Optional[int]
     offset: Optional[int]
+    parenthesized: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -263,6 +264,7 @@ class SetOp(Ast):
     right: Ast
     order_by: Tuple[OrderItem, ...] = ()
     limit: Optional[int] = None
+    offset: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------
@@ -364,19 +366,40 @@ class _Parser:
             left = SetOp(op, is_all, left, right)
         if isinstance(left, SetOp):
             order = ()
-            limit = None
+            limit = offset = None
             if self.eat_kw("order"):
                 self.expect_kw("by")
                 order = tuple(self.order_items())
             if self.eat_kw("limit"):
                 limit = int(self.next().text)
-            left = dataclasses.replace(left, order_by=order, limit=limit)
+            if self.eat_kw("offset"):
+                offset = int(self.next().text)
+            # an unparenthesized final SELECT grabs the trailing ORDER
+            # BY/LIMIT/OFFSET during its own parse; grammatically they
+            # belong to the whole set operation — hoist them
+            if not order and limit is None and offset is None:
+                rb = left.right
+                if isinstance(rb, SelectStmt) and not rb.parenthesized \
+                        and (rb.order_by or rb.limit is not None or
+                             rb.offset is not None):
+                    order = rb.order_by
+                    limit = rb.limit
+                    offset = rb.offset
+                    left = dataclasses.replace(
+                        left, right=dataclasses.replace(
+                            rb, order_by=(), limit=None, offset=None))
+            left = dataclasses.replace(left, order_by=order, limit=limit,
+                                       offset=offset)
         return left
 
     def query_term(self) -> Ast:
         if self.eat_op("("):
             q = self.query_expr()
             self.expect_op(")")
+            if isinstance(q, SelectStmt):
+                # remember the parens: a trailing ORDER BY/LIMIT inside
+                # them belongs to this branch, not the enclosing set op
+                q = dataclasses.replace(q, parenthesized=True)
             return q
         return self.select_stmt()
 
@@ -970,6 +993,21 @@ class _Lowerer:
         return self.lower_select(ast)
 
     def lower_setop(self, s: SetOp) -> L.LogicalPlan:
+        # a WITH on the leftmost SELECT scopes over the entire set
+        # operation; hoist its CTEs for the whole lowering
+        leftmost = s.left
+        while isinstance(leftmost, SetOp):
+            leftmost = leftmost.left
+        if isinstance(leftmost, SelectStmt) and leftmost.ctes:
+            saved = self.views
+            self.views = dict(saved)
+            for name, sub in leftmost.ctes:
+                self.views[name.lower()] = self.lower(sub)
+            try:
+                stripped = self._strip_leftmost_ctes(s)
+                return self.lower_setop(stripped)
+            finally:
+                self.views = saved
         left = self.lower(s.left)
         right = self.lower(s.right)
         if len(left.schema) != len(right.schema):
@@ -986,19 +1024,48 @@ class _Lowerer:
                 plan = L.Distinct(plan)
         else:
             jt = "semi" if s.op == "intersect" else "anti"
-            lkeys = [ec.AttributeReference(f.name, f.dtype, f.nullable)
-                     for f in left.schema]
-            rkeys = [ec.AttributeReference(f.name, f.dtype, f.nullable)
-                     for f in right.schema]
-            plan = L.Distinct(L.Join(left, right, jt, lkeys, rkeys, None))
+            # null-safe comparison (IS NOT DISTINCT FROM): equi-join keys
+            # reject nulls, so each column becomes (is-null flag,
+            # null-defaulted value) — NULL rows then match each other
+            either_nullable = [lf.nullable or rf.nullable for lf, rf in
+                               zip(left.schema, right.schema)]
+
+            def null_safe_keys(schema):
+                keys = []
+                for f, nullable in zip(schema, either_nullable):
+                    ref = ec.AttributeReference(f.name, f.dtype, f.nullable)
+                    if not nullable:
+                        keys.append(ref)
+                        continue
+                    keys.append(ep.IsNull(ref))
+                    default = f.dtype.default_value
+                    if default is not None:
+                        default = default.item() \
+                            if hasattr(default, "item") else default
+                    keys.append(econd.Coalesce(
+                        ref, ec.Literal(default if default is not None
+                                        else 0, f.dtype)))
+                return keys
+            plan = L.Distinct(L.Join(left, right, jt,
+                                     null_safe_keys(left.schema),
+                                     null_safe_keys(right.schema), None))
         if s.order_by:
             scope = _Scope.of(plan.schema)
             orders = [L.SortOrder(self.lower_expr(o.e, scope), o.asc,
                                   o.nulls_first) for o in s.order_by]
             plan = L.Sort(orders, plan, is_global=True)
-        if s.limit is not None:
-            plan = L.Limit(s.limit, plan)
+        if s.limit is not None or s.offset:
+            plan = L.Limit(s.limit if s.limit is not None else 1 << 60,
+                           plan, offset=s.offset or 0)
         return plan
+
+    @staticmethod
+    def _strip_leftmost_ctes(s: SetOp) -> SetOp:
+        if isinstance(s.left, SetOp):
+            return dataclasses.replace(
+                s, left=_Lowerer._strip_leftmost_ctes(s.left))
+        return dataclasses.replace(
+            s, left=dataclasses.replace(s.left, ctes=()))
 
     def lower_select(self, s: SelectStmt) -> L.LogicalPlan:
         views = self.views
@@ -1308,6 +1375,11 @@ class _Lowerer:
             return [a]
         rest: List[ec.Expression] = []
         for c in conjuncts(where):
+            # NOT EXISTS / NOT IN arrive as Un("not", ...) from the parser
+            if isinstance(c, Un) and c.op == "not" and \
+                    isinstance(c.operand, (InSub, Exists)):
+                c = dataclasses.replace(c.operand,
+                                        negated=not c.operand.negated)
             if isinstance(c, InSub):
                 sub = self.lower(c.query)
                 if len(sub.schema) != 1:
@@ -1315,8 +1387,24 @@ class _Lowerer:
                 sf = sub.schema.fields[0]
                 lkey = self.lower_expr(c.operand, scope)
                 rkey = ec.AttributeReference(sf.name, sf.dtype, sf.nullable)
-                plan = L.Join(plan, sub, "anti" if c.negated else "semi",
-                              [lkey], [rkey], None)
+                if c.negated:
+                    # SQL three-valued NOT IN: empty set -> everything
+                    # qualifies (even NULL); any NULL in the set ->
+                    # nothing qualifies; else NULL operands never match
+                    if self.session.execute_to_arrow(
+                            L.Limit(1, sub)).num_rows == 0:
+                        continue
+                    if sf.nullable:
+                        nulls = self.session.execute_to_arrow(L.Limit(
+                            1, L.Filter(ep.IsNull(rkey), sub))).num_rows
+                        if nulls:
+                            plan = L.Filter(ec.Literal(False, T.BOOL), plan)
+                            continue
+                    if lkey.nullable:
+                        plan = L.Filter(ep.IsNotNull(lkey), plan)
+                    plan = L.Join(plan, sub, "anti", [lkey], [rkey], None)
+                else:
+                    plan = L.Join(plan, sub, "semi", [lkey], [rkey], None)
                 continue
             if isinstance(c, Exists):
                 # uncorrelated EXISTS: evaluate eagerly to a constant

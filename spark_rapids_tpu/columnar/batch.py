@@ -20,18 +20,169 @@ from .column import Column, StringColumn, bucket_capacity
 from .schema import Field, Schema
 
 
+import weakref
+
+# Unresolved lazy counts/arrays, flushed together: on this backend every
+# device->host pull is a remote-execution round trip (~100ms fixed +
+# execution of the pulled graph), and pulling N values in one fused
+# transfer costs ~one round trip instead of N (measured 5x).
+_PENDING: List["weakref.ref"] = []
+
+
+def _flush_pending():
+    global _PENDING
+    items = []
+    for w in _PENDING:
+        x = w()
+        if x is not None and x._val is None:
+            items.append(x)
+    _PENDING = []
+    if not items:
+        return
+    parts = [jnp.ravel(jnp.asarray(x.dev)).astype(jnp.int64)
+             for x in items]
+    sizes = [p.shape[0] for p in parts]
+    flat = np.asarray(jnp.concatenate(parts) if len(parts) > 1
+                      else parts[0])
+    off = 0
+    for x, sz in zip(items, sizes):
+        x._resolve(flat[off:off + sz])
+        off += sz
+
+
+class LazyCount:
+    """A row count still resident on device.
+
+    Every device->host pull triggers a remote execution round trip on
+    this backend (fully lazy dispatch), which made per-batch
+    ``int(count)`` pulls the dominant cost of small queries.  Execs
+    producing data-dependent row counts (filter, group count, join size)
+    wrap the device scalar in a LazyCount; the first forced value
+    resolves EVERY outstanding lazy count in one fused transfer.
+    """
+    __slots__ = ("dev", "_val", "__weakref__")
+
+    def __init__(self, dev):
+        self.dev = dev
+        self._val: Optional[int] = None
+        _PENDING.append(weakref.ref(self))
+
+    def _resolve(self, arr):
+        self._val = int(arr[0])
+
+    @property
+    def value(self) -> int:
+        if self._val is None:
+            _flush_pending()
+        assert self._val is not None
+        return self._val
+
+    def __int__(self):
+        return self.value
+
+    __index__ = __int__
+
+    def __bool__(self):
+        return self.value > 0
+
+    def __eq__(self, o):
+        return self.value == int(o)
+
+    def __lt__(self, o):
+        return self.value < int(o)
+
+    def __le__(self, o):
+        return self.value <= int(o)
+
+    def __gt__(self, o):
+        return self.value > int(o)
+
+    def __ge__(self, o):
+        return self.value >= int(o)
+
+    def __add__(self, o):
+        return self.value + o
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self.value - o
+
+    def __rsub__(self, o):
+        return o - self.value
+
+    def __mul__(self, o):
+        return self.value * o
+
+    __rmul__ = __mul__
+
+    def __hash__(self):
+        return hash(self.value)
+
+    def __repr__(self):
+        return f"LazyCount({self._val if self._val is not None else '?'})"
+
+
+class LazyArray:
+    """A small device int vector resolved through the pending pool
+    (e.g. per-partition bincounts in the shuffle split)."""
+    __slots__ = ("dev", "_val", "__weakref__")
+
+    def __init__(self, dev):
+        self.dev = dev
+        self._val = None
+        _PENDING.append(weakref.ref(self))
+
+    def _resolve(self, arr):
+        self._val = arr
+
+    @property
+    def np(self) -> np.ndarray:
+        if self._val is None:
+            _flush_pending()
+        return self._val
+
+
 class ColumnarBatch:
-    def __init__(self, schema: Schema, columns: Sequence[Column], num_rows: int):
+    def __init__(self, schema: Schema, columns: Sequence[Column], num_rows):
         assert len(schema) == len(columns), (len(schema), len(columns))
         self.schema = schema
         self.columns = list(columns)
-        self.num_rows = int(num_rows)
+        self._rows = num_rows if isinstance(num_rows, LazyCount) \
+            else int(num_rows)
+        self._rows_dev = None
         if columns:
             caps = {c.capacity for c in columns}
             assert len(caps) == 1, f"mixed capacities {caps}"
             self._capacity = caps.pop()
         else:
-            self._capacity = bucket_capacity(num_rows)
+            self._capacity = bucket_capacity(int(num_rows))
+
+    @property
+    def num_rows(self) -> int:
+        r = self._rows
+        return r.value if isinstance(r, LazyCount) else r
+
+    @num_rows.setter
+    def num_rows(self, v):
+        self._rows = v if isinstance(v, LazyCount) else int(v)
+        self._rows_dev = None
+
+    @property
+    def rows_lazy(self):
+        """The count as-is (int or LazyCount) — pass to derived batches
+        so one eventual pull serves the whole lineage."""
+        return self._rows
+
+    @property
+    def rows_dev(self):
+        """The count as a device scalar, never forcing a host pull."""
+        r = self._rows
+        if isinstance(r, LazyCount):
+            return r.dev
+        if self._rows_dev is None:
+            self._rows_dev = jnp.int32(r)
+        return self._rows_dev
 
     @property
     def capacity(self) -> int:
@@ -85,7 +236,7 @@ class ColumnarBatch:
         names = list(names)
         cols = [self.column(n) for n in names]
         fields = [self.schema[n] for n in names]
-        return ColumnarBatch(Schema(fields), cols, self.num_rows)
+        return ColumnarBatch(Schema(fields), cols, self.rows_lazy)
 
     def with_column(self, name: str, col: Column) -> "ColumnarBatch":
         if name in self.schema.names:
@@ -106,7 +257,7 @@ class ColumnarBatch:
         b = ColumnarBatch(self.schema, cols, self.num_rows)
         return b
 
-    def gather(self, indices, num_rows: int) -> "ColumnarBatch":
+    def gather(self, indices, num_rows) -> "ColumnarBatch":
         cols = [c.gather(indices) for c in self.columns]
         return ColumnarBatch(self.schema, cols, num_rows)
 

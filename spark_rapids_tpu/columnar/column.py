@@ -124,8 +124,9 @@ class Column:
                     num_rows: Optional[int] = None) -> "Column":
         n = capacity if num_rows is None else num_rows
         if dtype == T.STRING:
+            # host-built buffer: needs the concrete count (may sync)
             return StringColumn.from_pylist(
-                [value] * n, capacity=capacity)
+                [value] * int(n), capacity=capacity)
         if value is None:
             return Column.all_null(dtype, capacity)
         data = jnp.full((capacity,), value, dtype=dtype.np_dtype)

@@ -37,6 +37,16 @@ class Schema:
                 fields.append(Field(p[0], p[1], p[2]))
         return Schema(fields)
 
+    @staticmethod
+    def from_ddl(ddl: str) -> "Schema":
+        """Parse a Spark-style DDL schema string: "a long, b double"."""
+        fields = []
+        for part in T._split_top(ddl):
+            part = part.strip()
+            name, tname = part.split(None, 1)
+            fields.append(Field(name, T.dtype_from_name(tname.strip())))
+        return Schema(fields)
+
     def __len__(self):
         return len(self.fields)
 

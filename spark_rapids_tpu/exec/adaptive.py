@@ -98,7 +98,7 @@ class TpuAQEShuffleRead(TpuExec):
                     if b.num_rows == 0:
                         continue
                     got = True
-                    self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
+                    self.metrics[NUM_OUTPUT_ROWS] += b.rows_lazy
                     yield b
             if not got:
                 yield ColumnarBatch.empty(schema)

@@ -32,18 +32,38 @@ SPILL_BYTES = "spillData"
 
 
 class Metric:
-    __slots__ = ("name", "level", "value")
+    __slots__ = ("name", "level", "_value", "_pending")
 
     def __init__(self, name: str, level: str = MODERATE):
         self.name = name
         self.level = level
-        self.value = 0
+        self._value = 0
+        self._pending = None
+
+    @property
+    def value(self):
+        # resolve deferred device counts only when the metric is read
+        # (pulling them eagerly would serialize the dispatch queue)
+        if self._pending:
+            self._value += sum(int(p) for p in self._pending)
+            self._pending = None
+        return self._value
+
+    @value.setter
+    def value(self, v):
+        self._value = int(v)
+        self._pending = None
 
     def add(self, v):
-        self.value += v
+        if isinstance(v, int):
+            self._value += v
+        else:
+            if self._pending is None:
+                self._pending = []
+            self._pending.append(v)
 
     def __iadd__(self, v):
-        self.value += v
+        self.add(v)
         return self
 
     def __repr__(self):

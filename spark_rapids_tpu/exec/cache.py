@@ -121,7 +121,7 @@ class TpuCachedExec(TpuExec):
         if self.storage.ready:
             return [self._decode_part(p) for p in self.storage.partitions()]
         def count(b):
-            self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
+            self.metrics[NUM_OUTPUT_ROWS] += b.rows_lazy
         return fill_while_streaming(
             self.children[0].execute(), self.storage, to_arrow,
             on_batch=count)
@@ -131,7 +131,7 @@ class TpuCachedExec(TpuExec):
         for blob in blobs:
             b = from_arrow(decode_blob(blob))
             got = True
-            self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
+            self.metrics[NUM_OUTPUT_ROWS] += b.rows_lazy
             yield b
         if not got:
             yield ColumnarBatch.empty(self.output_schema)

@@ -405,18 +405,25 @@ class CpuJoin(CpuExec):
         for i, (le, re) in enumerate(zip(lg.left_keys, lg.right_keys)):
             keys[f"__k{i}"] = (_arr(cpu_eval(le, lt), lt.num_rows),
                               _arr(cpu_eval(re, rt), rt.num_rows))
-        lkt = pa.table({**{k: v[0] for k, v in keys.items()},
-                        "__lidx": pa.array(
-                            np.arange(lt.num_rows, dtype=np.int64))})
-        rkt = pa.table({**{f"{k}_r": v[1] for k, v in keys.items()},
-                        "__ridx": pa.array(
-                            np.arange(rt.num_rows, dtype=np.int64))})
-        pairs = lkt.join(rkt, keys=list(keys),
-                         right_keys=[f"{k}_r" for k in keys],
-                         join_type="inner", use_threads=False,
-                         coalesce_keys=False)
-        lidx = pairs.column("__lidx").to_numpy().astype(np.int64)
-        ridx = pairs.column("__ridx").to_numpy().astype(np.int64)
+        if keys:
+            lkt = pa.table({**{k: v[0] for k, v in keys.items()},
+                            "__lidx": pa.array(
+                                np.arange(lt.num_rows, dtype=np.int64))})
+            rkt = pa.table({**{f"{k}_r": v[1] for k, v in keys.items()},
+                            "__ridx": pa.array(
+                                np.arange(rt.num_rows, dtype=np.int64))})
+            pairs = lkt.join(rkt, keys=list(keys),
+                             right_keys=[f"{k}_r" for k in keys],
+                             join_type="inner", use_threads=False,
+                             coalesce_keys=False)
+            lidx = pairs.column("__lidx").to_numpy().astype(np.int64)
+            ridx = pairs.column("__ridx").to_numpy().astype(np.int64)
+        else:
+            # pure non-equi ON: nested-loop pairs (cartesian indices)
+            lidx = np.repeat(np.arange(lt.num_rows, dtype=np.int64),
+                             rt.num_rows)
+            ridx = np.tile(np.arange(rt.num_rows, dtype=np.int64),
+                           lt.num_rows)
         ptab = pa.Table.from_arrays(
             [lt.column(n).take(lidx) for n in lt.column_names] +
             [rt.column(n).take(ridx) for n in rt.column_names],

@@ -47,13 +47,21 @@ class TpuShuffleExchange(TpuExec):
             sample = [b for part in all_batches for b in part]
             self.partitioner.fit(sample)
             in_parts = [iter(p) for p in all_batches]
+        # Per map partition: phase 1 enqueues device work for every
+        # batch (sort by pid + device bincount), phase 2 pulls the
+        # counts — one fused transfer per map task (LazyCount doc).
+        # Staging is bounded to ONE map partition so shuffles larger
+        # than device memory still stream+spill map task by map task.
         for map_id, part in enumerate(in_parts):
-            per_reduce = {}
+            staged = []
             for batch in part:
-                if batch.num_rows == 0:
-                    continue
                 with timed(self.metrics[PARTITION_TIME]):
-                    split = self.partitioner.split(batch)
+                    staged.append(self.partitioner.split_staged(batch))
+            per_reduce = {}
+            for sorted_batch, counts in staged:
+                split = self.partitioner.finalize_split(sorted_batch, counts)
+                if split.offsets[-1] == 0:
+                    continue
                 for pid in range(self.partitioner.num_partitions):
                     piece = split.partition_slice(pid)
                     if piece is not None:
@@ -87,7 +95,7 @@ class TpuShuffleExchange(TpuExec):
         self.ensure_materialized()
         mgr = ShuffleManager.get()
         for b in mgr.read_partition(self._shuffle_id, reduce_id):
-            self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
+            self.metrics[NUM_OUTPUT_ROWS] += b.rows_lazy
             yield b
 
     def read_reduce(self, reduce_id: int):

@@ -32,6 +32,9 @@ from ..expr import core as ec
 
 _LOG = logging.getLogger("spark_rapids_tpu.exec.fused")
 
+# (expr signatures, schema dtypes, needed ordinals) -> jitted callable
+_JIT_CACHE: dict = {}
+
 
 def _tree_fusable(expr: ec.Expression) -> bool:
     """Conservative gate: every node must be fixed-width (strings/nested
@@ -46,6 +49,47 @@ def _tree_fusable(expr: ec.Expression) -> bool:
     if dt == T.STRING or dt.is_nested or dt == T.NULL:
         return False
     return all(_tree_fusable(c) for c in expr.children)
+
+
+def expr_signature(e: ec.Expression) -> Optional[str]:
+    """Stable structural signature of an expression tree: identical
+    signatures trace to identical computations, so jitted callables can
+    be shared ACROSS query plans (a new FusedEval per query would
+    otherwise re-trace + re-lower every run — ~20ms per jit even on a
+    persistent-cache hit, dozens of jits per query).  Returns None when
+    any attribute is opaque (functions, host objects) — id()-based keys
+    would be unsound after GC address reuse, so such trees are simply
+    not shared."""
+    extras = []
+    for k in sorted(vars(e)):
+        if k in ("children", "_name"):
+            continue
+        sv = _sig_value(getattr(e, k))
+        if sv is None:
+            return None
+        extras.append(f"{k}={sv}")
+    kids = []
+    for c in e.children:
+        sc = expr_signature(c)
+        if sc is None:
+            return None
+        kids.append(sc)
+    return f"{type(e).__name__}({';'.join(extras)})[{','.join(kids)}]"
+
+
+def _sig_value(v) -> Optional[str]:
+    if isinstance(v, (int, float, str, bool, type(None), bytes)):
+        return repr(v)
+    if isinstance(v, T.DType):
+        return v.name
+    if isinstance(v, ec.Expression):
+        return expr_signature(v)
+    if isinstance(v, (list, tuple)):
+        parts = [_sig_value(x) for x in v]
+        if any(p is None for p in parts):
+            return None
+        return "[" + ",".join(parts) + "]"
+    return None
 
 
 def _needed_ordinals(exprs: Sequence[ec.Expression]) -> List[int]:
@@ -80,8 +124,24 @@ class FusedEval:
         self.needed = _needed_ordinals(
             [self.exprs[i] for i in self.fused_idx])
         self.ok = bool(self.fused_idx)
-        self._jitted = jax.jit(self._eval, static_argnums=(0,)) \
-            if self.ok else None
+        self._jitted = None
+        if self.ok:
+            # share one jitted callable across all query plans with the
+            # same expression structure (process-level trace cache);
+            # trees with opaque attributes (signature None) get a
+            # private jit instead of an unsound id()-keyed entry
+            sigs = [expr_signature(self.exprs[i]) for i in self.fused_idx]
+            if any(s is None for s in sigs):
+                self._jitted = jax.jit(self._eval, static_argnums=(0,))
+            else:
+                key = (tuple(sigs),
+                       tuple(f.dtype.name for f in self.schema),
+                       tuple(self.needed))
+                self._jitted = _JIT_CACHE.get(key)
+                if self._jitted is None:
+                    self._jitted = jax.jit(self._eval, static_argnums=(0,))
+                    if len(_JIT_CACHE) < 4096:
+                        _JIT_CACHE[key] = self._jitted
 
     # traced function: capacity static; column buffers + live row count
     # are device values
@@ -113,7 +173,7 @@ class FusedEval:
         valids = tuple(batch.columns[i].validity for i in self.needed)
         try:
             fused_out = self._jitted(batch.capacity, datas, valids,
-                                     jnp.int32(batch.num_rows))
+                                     batch.rows_dev)
         except Exception:  # noqa: BLE001 - fall back, but loudly
             _LOG.warning(
                 "fused evaluation failed for %s; falling back to eager",
@@ -135,5 +195,6 @@ class _TracedBatch(ColumnarBatch):
     def __init__(self, schema, columns, num_rows, capacity):
         self.schema = schema
         self.columns = list(columns)
-        self.num_rows = num_rows        # jnp scalar under trace
+        self._rows = num_rows           # jnp scalar under trace
+        self._rows_dev = num_rows
         self._capacity = capacity

@@ -1,0 +1,173 @@
+"""Pandas-exchange relational operators (mapInPandas / applyInPandas).
+
+Reference: the Python exec family (SURVEY.md §2.4/§2.8):
+GpuMapInPandasExec, GpuFlatMapGroupsInPandasExec, GpuAggregateInPandasExec
+(org/apache/spark/sql/rapids/execution/python/) — device batches are
+serialized to Arrow, streamed to a Python worker, and the Arrow results
+come back as device batches.  In this single-process runtime the "worker"
+is in-process, but the exchange contract is identical: the user function
+only ever sees pandas objects built from Arrow batches, and results are
+validated/cast against the declared output schema.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import pyarrow as pa
+
+from ..columnar.arrow import from_arrow, schema_to_arrow, to_arrow
+from ..expr import core as ec
+from ..expr.cpu_eval import cpu_eval, _arr
+from .base import NUM_OUTPUT_ROWS, PhysicalPlan
+from .cpu import CpuExec
+from .tpu_basic import TpuExec
+
+
+def _cast_result(pdf, out_schema: pa.Schema) -> pa.Table:
+    """User pandas result -> arrow table in the declared schema."""
+    t = pa.Table.from_pandas(pdf, preserve_index=False)
+    arrays = []
+    for f in out_schema:
+        if f.name not in t.column_names:
+            raise ValueError(
+                f"pandas UDF result is missing column {f.name!r}")
+        c = t.column(f.name).combine_chunks()
+        if c.type != f.type:
+            c = pa.compute.cast(c, f.type, safe=False)
+        arrays.append(c)
+    return pa.Table.from_arrays(arrays, schema=out_schema)
+
+
+def _run_map(fn, tables: Iterator[pa.Table], out_schema: pa.Schema):
+    def pdfs():
+        for t in tables:
+            if t.num_rows:
+                yield t.to_pandas()
+    for pdf in fn(pdfs()):
+        yield _cast_result(pdf, out_schema)
+
+
+def _run_grouped(fn, keys: List[ec.Expression], table: pa.Table,
+                 out_schema: pa.Schema):
+    """Evaluate key expressions, group, call fn per group."""
+    import numpy as np
+    import inspect
+    if table.num_rows == 0:
+        return
+    key_arrays = [_arr(cpu_eval(k, table), table.num_rows) for k in keys]
+    kt = pa.table({f"__gk{i}": a for i, a in enumerate(key_arrays)})
+    pdf_all = table.to_pandas()
+    kdf = kt.to_pandas()
+    takes_key = len(inspect.signature(fn).parameters) >= 2
+    grouped = pdf_all.groupby(
+        [kdf[c] for c in kdf.columns], dropna=False, sort=False)
+    for key, g in grouped:
+        if not isinstance(key, tuple):
+            key = (key,)
+        out = fn(key, g) if takes_key else fn(g)
+        yield _cast_result(out, out_schema)
+
+
+class CpuMapInPandas(CpuExec):
+    def __init__(self, logical, child: PhysicalPlan):
+        super().__init__(child)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def execute(self):
+        out = schema_to_arrow(self.output_schema)
+
+        def run(part):
+            for t in _run_map(self.logical.fn, iter(part), out):
+                self.metrics[NUM_OUTPUT_ROWS] += t.num_rows
+                yield t
+        return [run(p) for p in self.children[0].execute()]
+
+
+class CpuGroupedMapInPandas(CpuExec):
+    def __init__(self, logical, child: PhysicalPlan):
+        super().__init__(child)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def num_partitions_hint(self):
+        return 1
+
+    def execute(self):
+        out = schema_to_arrow(self.output_schema)
+        parts = self.children[0].execute()
+
+        def run():
+            tables = [t for p in parts for t in p if t.num_rows]
+            if not tables:
+                return
+            whole = pa.concat_tables(tables, promote_options="permissive")
+            for t in _run_grouped(self.logical.fn, self.logical.keys,
+                                  whole, out):
+                self.metrics[NUM_OUTPUT_ROWS] += t.num_rows
+                yield t
+        return [run()]
+
+
+class TpuMapInPandas(TpuExec):
+    """Device batches -> Arrow -> pandas fn -> Arrow -> device batches.
+
+    The host round-trip is inherent to the operator (the reference's GPU
+    version does the same through GpuArrowPythonRunner)."""
+
+    def __init__(self, logical, child: PhysicalPlan):
+        super().__init__(child)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def _node_string(self):
+        return f"TpuMapInPandas[{getattr(self.logical.fn, '__name__', 'fn')}]"
+
+    def execute(self):
+        out = schema_to_arrow(self.output_schema)
+
+        def run(part):
+            tables = (to_arrow(b) for b in part)
+            for t in _run_map(self.logical.fn, tables, out):
+                self.metrics[NUM_OUTPUT_ROWS] += t.num_rows
+                yield from_arrow(t)
+        return [run(p) for p in self.children[0].execute()]
+
+
+class TpuGroupedMapInPandas(TpuExec):
+    def __init__(self, logical, child: PhysicalPlan):
+        super().__init__(child)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def _node_string(self):
+        return ("TpuGroupedMapInPandas"
+                f"[{getattr(self.logical.fn, '__name__', 'fn')}]")
+
+    def execute(self):
+        out = schema_to_arrow(self.output_schema)
+        parts = self.children[0].execute()
+
+        def run():
+            tables = [to_arrow(b) for p in parts for b in p]
+            tables = [t for t in tables if t.num_rows]
+            if not tables:
+                return
+            whole = pa.concat_tables(tables, promote_options="permissive")
+            for t in _run_grouped(self.logical.fn, self.logical.keys,
+                                  whole, out):
+                self.metrics[NUM_OUTPUT_ROWS] += t.num_rows
+                yield from_arrow(t)
+        return [run()]

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from ..columnar import dtypes as T
 from ..columnar.schema import Field, Schema
 from ..columnar.column import Column, bucket_capacity
-from ..columnar.batch import ColumnarBatch, concat_batches
+from ..columnar.batch import ColumnarBatch, LazyCount, concat_batches
 from ..expr import core as ec
 from ..expr.aggregates import AggregateFunction
 from ..kernels import canon, aggregate as agg_k
@@ -69,19 +69,42 @@ class TpuHashAggregate(TpuExec):
             partials = []
             with timed(self.metrics[AGG_TIME]):
                 for batch in part:
-                    if batch.num_rows == 0 and partials:
+                    # only skip empties whose count is already host-known
+                    # (checking a lazy count would force a sync per batch)
+                    if isinstance(batch.rows_lazy, int) and \
+                            batch.num_rows == 0 and partials:
                         continue
                     partials.append(self._update_batch(batch))
                 if not partials:
                     partials = [self._update_batch(
                         ColumnarBatch.empty(child_schema))]
+                # update batches stay at input capacity (no per-batch
+                # sync).  A single PARTIAL stays uncompacted — the
+                # exchange downstream slices it small anyway, and
+                # compacting here would force a count pull per
+                # partition; everything else compacts together (one
+                # queue drain serves all counts).
+                if len(partials) > 1 or self.mode != PARTIAL:
+                    partials = [self._compact_partial(p) for p in partials]
                 merged = concat_batches(partials) if len(partials) > 1 \
                     else partials[0]
                 out = self._merge_finalize(merged,
                                            multiple=len(partials) > 1)
-            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+            self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
             yield out
         return [run(p) for p in self.children[0].execute()]
+
+    @staticmethod
+    def _compact_partial(b: ColumnarBatch) -> ColumnarBatch:
+        """Shrink a group-compact batch (rows 0..G-1 live) to its bucket
+        capacity once the group count is host-visible."""
+        n = b.num_rows
+        cap = bucket_capacity(max(n, 1))
+        if cap >= b.capacity:
+            return b
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        return ColumnarBatch(b.schema, [c.gather(idx) for c in b.columns],
+                             n)
 
     def _update_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
         """Partial (update) aggregation of one input batch -> buffer batch."""
@@ -132,12 +155,11 @@ class TpuHashAggregate(TpuExec):
             TpuHashAggregate._FUSABLE_FUNCS = (
                 ea.Sum, ea.Count, ea.Min, ea.Max, ea.Average, ea.First,
                 ea.Last)
-        # Only worthwhile when dispatch latency dominates: for big
-        # batches the eager path overlaps the num_groups sync with the
-        # buffer reductions (async dispatch), while one fused program
-        # serializes everything behind that sync — measured slower at
-        # 256k rows, 3x faster at <=32k (the mortgage shape).
-        if batch.capacity > (1 << 16):
+        # One fused program per batch beats the eager chain at every
+        # measured size now that group counts are LazyCounts (nothing
+        # serializes behind the num_groups pull anymore): 3x at <=32k,
+        # 2x at 256k.  The cap only guards pathological compile sizes.
+        if batch.capacity > (1 << 21):
             return None
         if not all(type(c) is Column for c in key_cols):
             return None
@@ -186,7 +208,7 @@ class TpuHashAggregate(TpuExec):
         key_arrays = tuple((c.data, c.validity) for c in key_cols)
         try:
             (perm, seg_id, live, rep, ng), bufs_flat = core(
-                key_arrays, in_arrays, jnp.int32(batch.num_rows))
+                key_arrays, in_arrays, batch.rows_dev)
         except Exception:  # noqa: BLE001 - fall back, but loudly
             logging.getLogger("spark_rapids_tpu.exec.aggregate").warning(
                 "fused aggregate core failed; falling back to eager",
@@ -231,7 +253,7 @@ class TpuHashAggregate(TpuExec):
         if fused is not None:
             plan, agg_buffers = fused
         else:
-            words = canon.batch_key_words(key_cols, batch.num_rows)
+            words = canon.batch_key_words(key_cols, batch.rows_dev)
             plan = agg_k.groupby_plan(words)
             # aggregate buffers (segment-id indexed, 0..G-1, input capacity)
             agg_buffers = []
@@ -239,20 +261,23 @@ class TpuHashAggregate(TpuExec):
                 bufs = a.func.update(plan, cols) if update_mode else \
                     a.func.merge(plan, cols)
                 agg_buffers.append(bufs)
-        num_groups = int(plan.num_groups)
-        out_cap = bucket_capacity(max(num_groups, 1))
+        # group count stays on device: per-batch int(num_groups) pulls
+        # were the engine's dominant cost on remote-dispatch hardware
+        # (LazyCount doc); output capacity = input capacity (groups <=
+        # rows) so no host value is needed to shape the result
+        ng = plan.num_groups
+        lazy_groups = LazyCount(ng)
+        out_cap = batch.capacity
 
         # compact group keys: representative original-row indices
         rep = plan.rep_indices
-        take = jnp.where(jnp.arange(out_cap) < num_groups,
+        take = jnp.where(jnp.arange(out_cap) < ng,
                          rep[:out_cap] if out_cap <= rep.shape[0] else
                          jnp.pad(rep, (0, out_cap - rep.shape[0]))[:out_cap],
                          0)
         out_cols = [c.gather(take) for c in key_cols]
-        live = jnp.arange(out_cap) < num_groups
-        out_cols = [c.with_capacity(out_cap, num_groups).mask_validity(live)
-                    if c.capacity != out_cap else c.mask_validity(live)
-                    for c in out_cols]
+        live = jnp.arange(out_cap) < ng
+        out_cols = [c.mask_validity(live) for c in out_cols]
 
         # compact agg outputs: buffer arrays are already segment-indexed
         for a, bufs in zip(self.aggs, agg_buffers):
@@ -262,17 +287,12 @@ class TpuHashAggregate(TpuExec):
                 outs = [a.func.finalize(bufs)]
             for o in outs:
                 seg_take = jnp.where(live, jnp.arange(out_cap), 0)
-                c = o.gather(seg_take) if o.capacity >= out_cap else \
-                    o.with_capacity(out_cap, num_groups)
-                if c.capacity > out_cap:
-                    c = Column(c.dtype, c.data[:out_cap],
-                               c.validity[:out_cap]) \
-                        if not hasattr(c, "offsets") else \
-                        c.with_capacity(out_cap, num_groups)
+                assert o.capacity >= out_cap, (o.capacity, out_cap)
+                c = o.gather(seg_take)
                 out_cols.append(c.mask_validity(live))
         out_schema = buffer_schema(self.group_exprs, self.aggs) \
             if emit_buffers else self.output_schema
-        return ColumnarBatch(out_schema, out_cols, num_groups)
+        return ColumnarBatch(out_schema, out_cols, lazy_groups)
 
     def _global_agg(self, batch: ColumnarBatch,
                     input_cols: List[List[Column]],
@@ -280,12 +300,13 @@ class TpuHashAggregate(TpuExec):
         """No group keys: aggregate everything into one row (one segment)."""
         cap = batch.capacity
         const = Column(T.INT64, jnp.zeros(cap, jnp.int64),
-                       jnp.arange(cap) < batch.num_rows)
-        words = canon.batch_key_words([const], batch.num_rows)
+                       jnp.arange(cap) < batch.rows_dev)
+        words = canon.batch_key_words([const], batch.rows_dev)
         plan = agg_k.groupby_plan(words)
         out_cap = bucket_capacity(1)
         out_cols: List[Column] = []
-        has_rows = batch.num_rows > 0
+        # device-side emptiness flag: no per-batch host sync
+        has_rows = batch.rows_dev > 0
         for a, cols in zip(self.aggs, input_cols):
             if self.mode in (PARTIAL, COMPLETE):
                 bufs = a.func.update(plan, cols)
@@ -296,16 +317,14 @@ class TpuHashAggregate(TpuExec):
             for o in outs:
                 c = o.gather(jnp.zeros(out_cap, jnp.int32))
                 live = jnp.arange(out_cap) < 1
-                if not has_rows:
-                    # empty input: count-like aggs give 0, others null
-                    from ..expr.aggregates import Count
-                    if isinstance(a.func, Count):
-                        c = Column(T.INT64, jnp.zeros(out_cap, jnp.int64),
-                                   live)
-                    else:
-                        c = c.mask_validity(jnp.zeros(out_cap, bool))
+                from ..expr.aggregates import Count
+                if isinstance(a.func, Count):
+                    # counts are valid even over empty input (0)
+                    c = Column(T.INT64,
+                               jnp.where(live, c.data.astype(jnp.int64), 0),
+                               live)
                 else:
-                    c = c.mask_validity(live)
+                    c = c.mask_validity(live & has_rows)
                 out_cols.append(c)
         out_schema = buffer_schema(self.group_exprs, self.aggs) \
             if emit_buffers else self.output_schema

@@ -37,6 +37,6 @@ class TpuExpand(TpuExec):
                     cols = [ec.eval_as_column(e, batch) for e in proj]
                     out = ColumnarBatch(self.output_schema, cols,
                                         batch.num_rows)
-                    self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                    self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
                     yield out
         return [run(p) for p in self.children[0].execute()]

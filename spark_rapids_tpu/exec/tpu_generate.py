@@ -44,7 +44,7 @@ class TpuGenerate(TpuExec):
                 with timed(self.metrics[OP_TIME]):
                     out = self._generate(batch, bound, pos, outer,
                                          out_schema)
-                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out
         return [run(p) for p in self.children[0].execute()]

@@ -115,14 +115,14 @@ class TpuHashJoinBase(TpuExec):
                 out = self._join_batch(sb, skey_cols, build, bt, str_words,
                                        build_matched)
             if out is not None:
-                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
                 yield out
 
         if lg.join_type == "full" and build is not None:
             out = self._unmatched_build_rows(build, build_matched,
                                              stream_schema)
             if out is not None and out.num_rows > 0:
-                self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
                 yield out
 
     # ------------------------------------------------------------------
@@ -357,16 +357,58 @@ class TpuNestedLoopJoin(TpuExec):
         lparts = self.children[0].execute()
         rparts = self.children[1].execute()
         right_batches = [b for p in rparts for b in p]
+        if self.logical.join_type in ("right", "full"):
+            # unmatched-right emission must observe EVERY left row, so
+            # the left side collapses to one partition
+            def all_left():
+                for p in lparts:
+                    yield from p
+            return [self._run(all_left(), right_batches)]
         return [self._run(lp, right_batches) for lp in lparts]
 
     def _run(self, left_iter, right_batches):
+        """Pair-level semantics for every join type: the condition
+        restricts MATCHES; outer rows null-extend, semi/anti select left
+        rows by surviving-pair existence (a plain post-filter would
+        silently degrade outer/semi/anti to inner)."""
+        from ..kernels import basic as bk
+        jt = self.logical.join_type
+        lschema = self.children[0].output_schema
+        rschema = self.children[1].output_schema
+        pair_schema = Schema(
+            [Field(f.name, f.dtype, True) for f in lschema] +
+            [Field(f.name, f.dtype, True) for f in rschema])
         rb = concat_batches(right_batches) if right_batches else \
-            ColumnarBatch.empty(self.children[1].output_schema)
+            ColumnarBatch.empty(rschema)
         n_r = rb.num_rows
+        right_matched = np.zeros(rb.capacity, dtype=bool) \
+            if jt in ("right", "full") else None
+
+        def select_left(lb, sel, n_hint):
+            idx, cnt = bk.compact_indices(sel, n_hint)
+            n = int(cnt)
+            out = lb.gather(idx, n)
+            m = jnp.arange(out.capacity) < n
+            return ColumnarBatch(self.output_schema,
+                                 [c.mask_validity(m) for c in out.columns],
+                                 n)
+
         for lb in left_iter:
             n_l = lb.num_rows
             total = n_l * n_r
             if total == 0:
+                if n_l and jt in ("left", "full", "anti"):
+                    # empty right side: anti keeps everything, outer
+                    # null-extends everything
+                    in_range = jnp.arange(lb.capacity) < n_l
+                    if jt == "anti":
+                        yield select_left(lb, in_range, n_l)
+                    else:
+                        nulls = [_null_column(f.dtype, lb.capacity)
+                                 for f in rschema]
+                        cols = [c.mask_validity(in_range)
+                                for c in lb.columns] + nulls
+                        yield ColumnarBatch(self.output_schema, cols, n_l)
                 continue
             out_cap = bucket_capacity(total)
             t = jnp.arange(out_cap)
@@ -375,20 +417,72 @@ class TpuNestedLoopJoin(TpuExec):
             lout = lb.gather(li, total)
             rout = rb.gather(ri, total)
             live = t < total
-            cols = ([c.mask_validity(live) for c in lout.columns] +
-                    [c.mask_validity(live) for c in rout.columns])
-            out = ColumnarBatch(self.output_schema, cols, total)
+            pair_cols = ([c.mask_validity(live) for c in lout.columns] +
+                         [c.mask_validity(live) for c in rout.columns])
+            pairs = ColumnarBatch(pair_schema, pair_cols, total)
             if self.logical.condition is not None:
-                from ..kernels import basic as bk
-                cond = self.logical.condition.bind(self.output_schema)
-                pred = ec.eval_as_column(cond, out)
-                keep = pred.data.astype(bool) & pred.validity
-                idx, cnt = bk.compact_indices(keep, out.num_rows)
-                n = int(cnt)
-                g = out.gather(idx, n)
-                m = jnp.arange(g.capacity) < n
-                out = ColumnarBatch(self.output_schema,
-                                    [c.mask_validity(m) for c in g.columns],
-                                    n)
-            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
-            yield out
+                cond = self.logical.condition.bind(pair_schema)
+                pred = ec.eval_as_column(cond, pairs)
+                keep = pred.data.astype(bool) & pred.validity & live
+            else:
+                keep = live
+
+            if right_matched is not None:
+                hit = jnp.zeros(rb.capacity, dtype=bool).at[
+                    jnp.where(keep, ri, 0)].max(keep)
+                right_matched |= np.asarray(hit)
+
+            if jt in ("semi", "anti"):
+                surv = jnp.zeros(lb.capacity, dtype=bool).at[
+                    jnp.where(keep, li, 0)].max(keep)
+                in_range = jnp.arange(lb.capacity) < n_l
+                sel = surv if jt == "semi" else (~surv & in_range)
+                out = select_left(lb, sel, n_l)
+                self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
+                yield out
+                continue
+
+            idx, cnt = bk.compact_indices(keep, total)
+            n_pairs = int(cnt)
+            parts = []
+            if n_pairs:
+                g = pairs.gather(idx, n_pairs)
+                m = jnp.arange(g.capacity) < n_pairs
+                parts.append(ColumnarBatch(
+                    self.output_schema,
+                    [c.mask_validity(m) for c in g.columns], n_pairs))
+            if jt in ("left", "full"):
+                surv = jnp.zeros(lb.capacity, dtype=bool).at[
+                    jnp.where(keep, li, 0)].max(keep)
+                un = ~surv & (jnp.arange(lb.capacity) < n_l)
+                uidx, ucnt = bk.compact_indices(un, n_l)
+                n_un = int(ucnt)
+                if n_un:
+                    lu = lb.gather(uidx, n_un)
+                    um = jnp.arange(lu.capacity) < n_un
+                    nulls = [_null_column(f.dtype, lu.capacity)
+                             for f in rschema]
+                    parts.append(ColumnarBatch(
+                        self.output_schema,
+                        [c.mask_validity(um) for c in lu.columns] + nulls,
+                        n_un))
+            for out in parts:
+                self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
+                yield out
+
+        if right_matched is not None:
+            un = jnp.asarray(~right_matched) & \
+                (jnp.arange(rb.capacity) < n_r)
+            uidx, ucnt = bk.compact_indices(un, n_r)
+            n_un = int(ucnt)
+            if n_un:
+                ru = rb.gather(uidx, n_un)
+                um = jnp.arange(ru.capacity) < n_un
+                nulls = [_null_column(f.dtype, ru.capacity)
+                         for f in lschema]
+                out = ColumnarBatch(
+                    self.output_schema,
+                    nulls + [c.mask_validity(um) for c in ru.columns],
+                    n_un)
+                self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
+                yield out

@@ -57,7 +57,7 @@ class TpuSort(TpuExec):
                 for b in part:
                     with timed(self.metrics[SORT_TIME]):
                         out = self._sort_batch(b)
-                    self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                    self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
                     yield out
                 return
             # modes 2/3: buffer input as *sorted spillable runs* so device
@@ -83,7 +83,7 @@ class TpuSort(TpuExec):
                 out = self._sort_batch(merged)
             for r in runs:
                 r.close()
-            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+            self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
             yield out
         return [run(p) for p in self.children[0].execute()]
 
@@ -127,6 +127,6 @@ class TpuTopN(TpuExec):
             final = self._sorter._sort_batch(merged)
             if final.num_rows > self.n:
                 final = final.slice(0, self.n)
-            self.metrics[NUM_OUTPUT_ROWS] += final.num_rows
+            self.metrics[NUM_OUTPUT_ROWS] += final.rows_lazy
             yield final
         return [run()]

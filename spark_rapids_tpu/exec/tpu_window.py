@@ -55,7 +55,7 @@ class TpuWindow(TpuExec):
                 batches[0]
             with timed(self.metrics[OP_TIME]):
                 out = self._apply(batch)
-            self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+            self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
             yield out
         return [run(p) for p in self.children[0].execute()]
 

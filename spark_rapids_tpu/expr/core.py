@@ -257,7 +257,10 @@ def output_name(e: Expression) -> str:
 # ---------------------------------------------------------------------------
 
 def eval_as_column(expr: Expression, batch: ColumnarBatch) -> Column:
-    return as_column(expr.columnar_eval(batch), batch.capacity, batch.num_rows)
+    # rows_dev: scalar results broadcast with a device-side live mask —
+    # batch.num_rows here would force a host sync per expression
+    n = batch.rows_dev if hasattr(batch, "rows_dev") else batch.num_rows
+    return as_column(expr.columnar_eval(batch), batch.capacity, n)
 
 
 def eval_data_valid(expr: Expression, batch: ColumnarBatch):
@@ -270,7 +273,7 @@ def eval_data_valid(expr: Expression, batch: ColumnarBatch):
             return (jnp.zeros(cap, dt.np_dtype if dt.np_dtype else jnp.bool_),
                     jnp.zeros(cap, bool), r.dtype)
         if r.dtype == T.STRING:
-            col = r.to_column(cap, batch.num_rows)
+            col = r.to_column(cap, batch.num_rows)  # host path (strings)
             return col, col.validity, T.STRING
         data = jnp.full((cap,), r.value, dtype=r.dtype.np_dtype)
         return data, jnp.ones(cap, bool), r.dtype

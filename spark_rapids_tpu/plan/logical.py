@@ -340,6 +340,48 @@ class Generate(LogicalPlan):
         return Schema(base)
 
 
+class MapInPandas(LogicalPlan):
+    """df.mapInPandas(fn, schema): fn(Iterator[pd.DataFrame]) ->
+    Iterator[pd.DataFrame] per partition.
+
+    Reference: GpuMapInPandasExec (SURVEY.md §2.4 Python execs) — batches
+    cross to the Python worker as Arrow; here the worker is in-process
+    but the Arrow exchange contract is the same."""
+
+    def __init__(self, fn, out_schema: Schema, child: LogicalPlan):
+        self.fn = fn
+        self._schema = out_schema
+        self.children = [child]
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def _node_string(self):
+        return f"MapInPandas[{getattr(self.fn, '__name__', 'fn')}]"
+
+
+class GroupedMapInPandas(LogicalPlan):
+    """df.groupBy(keys).applyInPandas(fn, schema): fn(pdf) -> pdf per
+    key group (fn may also take (key_tuple, pdf)).
+
+    Reference: GpuFlatMapGroupsInPandasExec."""
+
+    def __init__(self, keys: List[Expression], fn, out_schema: Schema,
+                 child: LogicalPlan):
+        self.keys = keys
+        self.fn = fn
+        self._schema = out_schema
+        self.children = [child]
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def _node_string(self):
+        return f"GroupedMapInPandas[{getattr(self.fn, '__name__', 'fn')}]"
+
+
 class CachedRelation(LogicalPlan):
     """df.cache(): parquet-encoded columnar cache over the child.
 

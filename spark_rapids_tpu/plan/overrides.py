@@ -247,6 +247,8 @@ class PlanMeta:
             return list(p.by_exprs or [])
         if isinstance(p, L.Generate):
             return [p.generator]
+        if isinstance(p, L.GroupedMapInPandas):
+            return list(p.keys)
         if isinstance(p, L.Expand):
             return [e for proj in p.projections for e in proj]
         if isinstance(p, L.Window):
@@ -449,6 +451,12 @@ class Planner:
         if isinstance(p, L.CachedRelation):
             from ..exec.cache import CpuCachedExec
             return CpuCachedExec(p.storage, children[0])
+        if isinstance(p, L.MapInPandas):
+            from ..exec.python_exec import CpuMapInPandas
+            return CpuMapInPandas(p, children[0])
+        if isinstance(p, L.GroupedMapInPandas):
+            from ..exec.python_exec import CpuGroupedMapInPandas
+            return CpuGroupedMapInPandas(p, children[0])
         if isinstance(p, L.Scan):
             from ..io.planner import cpu_scan_exec
             return cpu_scan_exec(p, self.conf)
@@ -525,6 +533,12 @@ class Planner:
         if isinstance(p, L.CachedRelation):
             from ..exec.cache import TpuCachedExec
             return TpuCachedExec(p.storage, children[0])
+        if isinstance(p, L.MapInPandas):
+            from ..exec.python_exec import TpuMapInPandas
+            return TpuMapInPandas(p, children[0])
+        if isinstance(p, L.GroupedMapInPandas):
+            from ..exec.python_exec import TpuGroupedMapInPandas
+            return TpuGroupedMapInPandas(p, children[0])
         raise NotImplementedError(f"no TPU conversion for {p.name}")
 
     def _plan_window(self, p: L.Window, child: PhysicalPlan) -> PhysicalPlan:

@@ -17,7 +17,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 import jax.numpy as jnp
 
-from ..columnar.batch import ColumnarBatch
+from ..columnar.batch import ColumnarBatch, LazyArray
 from ..columnar.column import Column, StringColumn, bucket_capacity
 from ..expr import core as ec
 from ..kernels import basic as bk
@@ -44,22 +44,33 @@ class Partitioner:
     def partition_ids(self, batch: ColumnarBatch) -> jnp.ndarray:
         raise NotImplementedError
 
-    def split(self, batch: ColumnarBatch) -> SplitBatch:
-        """Stable-sort the batch by partition id; contiguous-split analogue."""
+    def split_staged(self, batch: ColumnarBatch):
+        """Device half of the split: sort by partition id + device
+        bincount.  No host sync — callers stage many batches, then
+        finalize them together so one queue drain covers all."""
         pids = self.partition_ids(batch)
         cap = batch.capacity
-        in_range = jnp.arange(cap) < batch.num_rows
+        in_range = jnp.arange(cap) < batch.rows_dev
         sort_key = jnp.where(in_range, pids.astype(jnp.uint64),
                              jnp.uint64(self.num_partitions))
         perm = sort_permutation([sort_key])
-        sorted_batch = batch.gather(perm, batch.num_rows)
-        counts = np.bincount(
-            np.asarray(pids)[:batch.num_rows][
-                np.asarray(in_range)[:batch.num_rows]],
-            minlength=self.num_partitions)
-        offsets = np.zeros(self.num_partitions + 1, dtype=np.int64)
+        sorted_batch = batch.gather(perm, batch.rows_lazy)
+        counts = jnp.bincount(
+            jnp.where(in_range, pids, self.num_partitions),
+            length=self.num_partitions + 1)[:self.num_partitions]
+        return sorted_batch, LazyArray(counts)
+
+    @staticmethod
+    def finalize_split(sorted_batch: ColumnarBatch, counts) -> SplitBatch:
+        counts = counts.np if isinstance(counts, LazyArray) \
+            else np.asarray(counts)
+        offsets = np.zeros(len(counts) + 1, dtype=np.int64)
         offsets[1:] = np.cumsum(counts)
         return SplitBatch(sorted_batch, offsets)
+
+    def split(self, batch: ColumnarBatch) -> SplitBatch:
+        """Stable-sort the batch by partition id; contiguous-split analogue."""
+        return self.finalize_split(*self.split_staged(batch))
 
 
 class SinglePartitioner(Partitioner):
@@ -83,7 +94,9 @@ class HashPartitioner(Partitioner):
         for e in self.key_exprs:
             bound = e.bind(batch.schema)
             col = ec.eval_as_column(bound, batch)
-            for w in canon.value_words(col, batch.num_rows):
+            nr = batch.num_rows if isinstance(col, StringColumn) \
+                else batch.rows_dev
+            for w in canon.value_words(col, nr):
                 word_lists.append(jnp.where(col.validity, w,
                                             jnp.uint64(0x9E3779B97F4A7C15)))
         from ..kernels.pallas_ops import hash_partition_ids

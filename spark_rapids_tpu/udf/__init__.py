@@ -42,19 +42,29 @@ def udf(fn: Callable = None, return_type=None):
     return call
 
 
-def pandas_udf(fn: Callable = None, return_type=None):
-    """Vectorized pandas UDF (Series -> Series)."""
+def pandas_udf(fn: Callable = None, return_type=None,
+               function_type: str = "scalar"):
+    """Vectorized pandas UDF.
+
+    function_type="scalar": fn(Series...) -> Series, usable anywhere an
+    expression is.  function_type="grouped_agg": fn(Series...) -> scalar,
+    usable in GroupedData.agg() (reference: GpuAggregateInPandasExec)."""
     if fn is None:
-        return lambda f: pandas_udf(f, return_type)
+        return lambda f: pandas_udf(f, return_type, function_type)
     rt = return_type or T.FLOAT64
     if isinstance(rt, str):
         rt = T.dtype_from_name(rt)
 
     def call(*cols):
         from ..api.column import Col, _expr
-        arg_exprs = [_expr(c) for c in cols]
-        return Col(PandasUDF(fn, rt, arg_exprs,
-                             name=getattr(fn, "__name__", "pandas_udf")))
+        from ..api.functions import col as _col
+        from .python_udf import PandasAggUDFExpr
+        arg_exprs = [_expr(_col(c) if isinstance(c, str) else c)
+                     for c in cols]
+        name = getattr(fn, "__name__", "pandas_udf")
+        if function_type == "grouped_agg":
+            return Col(PandasAggUDFExpr(fn, rt, arg_exprs, name=name))
+        return Col(PandasUDF(fn, rt, arg_exprs, name=name))
     call.fn = fn
     call.return_type = rt
     return call

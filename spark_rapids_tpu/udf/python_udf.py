@@ -53,6 +53,37 @@ class PythonUDF(ec.Expression):
                                  capacity=batch.capacity)
 
 
+class PandasAggUDFExpr(ec.Expression):
+    """Marker for a GROUPED_AGG pandas UDF: fn(Series...) -> scalar.
+
+    Only legal inside GroupedData.agg(), which rewrites the aggregate
+    into a GroupedMapInPandas plan (reference: GpuAggregateInPandasExec
+    shuffles by key then runs the python aggregation per group)."""
+
+    trace_safe = False
+
+    def __init__(self, fn: Callable, return_type: T.DType,
+                 children: List[ec.Expression], name: str = "pandas_agg"):
+        self.fn = fn
+        self.return_type = return_type
+        self.children = list(children)
+        self._name = name
+
+    @property
+    def name(self):
+        return self._name
+
+    def with_children(self, c):
+        return PandasAggUDFExpr(self.fn, self.return_type, c, self._name)
+
+    def dtype(self):
+        return self.return_type
+
+    def columnar_eval(self, batch):
+        raise AssertionError(
+            "grouped-agg pandas UDFs are only valid in GroupedData.agg()")
+
+
 class PandasUDF(ec.Expression):
     """Vectorized UDF: fn(pandas.Series...) -> pandas.Series.
 

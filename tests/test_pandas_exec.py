@@ -1,0 +1,92 @@
+"""Pandas-exchange exec tests: mapInPandas / applyInPandas / grouped-agg
+pandas UDFs on both engines.
+
+Reference: the Python exec family (SURVEY.md §2.4/§2.8) —
+GpuMapInPandasExec, GpuFlatMapGroupsInPandasExec, GpuAggregateInPandasExec.
+"""
+import numpy as np
+
+from harness import (assert_tpu_and_cpu_are_equal_collect,
+                     with_tpu_session)
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.udf import pandas_udf
+
+
+def _df(s):
+    rng = np.random.default_rng(5)
+    n = 300
+    return s.create_dataframe({
+        "g": rng.integers(0, 8, n).astype(np.int64),
+        "v": rng.integers(-50, 50, n).astype(np.int64),
+        "x": np.round(rng.random(n), 4),
+    }, num_partitions=3)
+
+
+def test_map_in_pandas():
+    def double_up(it):
+        for pdf in it:
+            pdf = pdf.copy()
+            pdf["y"] = pdf["v"] * 2 + pdf["x"]
+            yield pdf[["g", "y"]]
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).map_in_pandas(double_up, "g long, y double"))
+
+
+def test_map_in_pandas_filtering():
+    """The fn may change the row count (flat-map semantics)."""
+    def keep_positive(it):
+        for pdf in it:
+            yield pdf[pdf["v"] > 0][["g", "v"]]
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).map_in_pandas(keep_positive, "g long, v long"))
+
+
+def test_apply_in_pandas():
+    def center(pdf):
+        pdf = pdf.copy()
+        pdf["v"] = pdf["v"] - pdf["v"].mean()
+        return pdf[["g", "v"]]
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).group_by("g").apply_in_pandas(
+            center, "g long, v double"))
+
+
+def test_apply_in_pandas_with_key():
+    import pandas as pd
+
+    def summarize(key, pdf):
+        return pd.DataFrame({"g": [key[0]], "n": [len(pdf)],
+                             "sv": [float(pdf["v"].sum())]})
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).group_by("g").apply_in_pandas(
+            summarize, "g long, n long, sv double"))
+
+
+def test_grouped_agg_pandas_udf():
+    mean_udf = pandas_udf(lambda v: float(v.mean()),
+                          return_type=T.FLOAT64,
+                          function_type="grouped_agg")
+    wsum = pandas_udf(lambda v, x: float((v * x).sum()),
+                      return_type=T.FLOAT64,
+                      function_type="grouped_agg")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).group_by("g").agg(
+            mean_udf("v").alias("mv"), wsum("v", "x").alias("wx")))
+
+
+def test_map_in_pandas_runs_on_tpu_engine():
+    def ident(it):
+        yield from it
+
+    def run(s):
+        df = _df(s).map_in_pandas(ident, "g long, v long, x double")
+        df.collect()
+        tree = df._last_physical_plan.tree_string()
+        assert "TpuMapInPandas" in tree, tree
+        return []
+    with_tpu_session(run)

@@ -300,3 +300,124 @@ def test_sql_plan_uses_tpu():
             "Fused" in tree, tree
         return []
     with_tpu_session(run)
+
+
+# -- review-fix regressions -------------------------------------------------
+
+def test_non_equi_join_conditions():
+    """Pure non-equi ON clauses: pair-level semantics on both engines."""
+    def fn(how):
+        def run(s):
+            a = s.create_dataframe({"k": [1, 2, 3, 4],
+                                    "v": [10, 20, 30, 40]})
+            b = s.create_dataframe({"x": [2, 3], "w": [100, 200]})
+            a.create_or_replace_temp_view("a")
+            b.create_or_replace_temp_view("b")
+            return s.sql(f"SELECT * FROM a {how} JOIN b ON a.k < b.x")
+        return run
+    for how in ("INNER", "LEFT", "RIGHT", "FULL"):
+        assert_tpu_and_cpu_are_equal_collect(fn(how))
+
+
+def test_union_trailing_order_limit():
+    """ORDER BY/LIMIT after a set op applies to the whole union."""
+    def fn(s):
+        _tables(s)
+        return s.sql("""
+            SELECT k FROM t1 WHERE k <= 2
+            UNION ALL SELECT k FROM t1 ORDER BY k DESC LIMIT 3""")
+    rows = with_cpu_session(lambda s: fn(s).collect())
+    assert len(rows) == 3
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=False)
+
+
+def test_not_in_subquery_with_nulls():
+    """NOT IN with a NULL in the subquery returns nothing (3VL)."""
+    def fn(s):
+        t = s.create_dataframe({"k": [1, 2, 3]})
+        u = s.create_dataframe({"x": [1, None]})
+        t.create_or_replace_temp_view("t")
+        u.create_or_replace_temp_view("u")
+        return s.sql("SELECT k FROM t WHERE k NOT IN (SELECT x FROM u)")
+    assert with_cpu_session(lambda s: fn(s).collect()) == []
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_not_exists():
+    def fn(s):
+        _tables(s)
+        return s.sql("""
+            SELECT count(*) FROM t1
+            WHERE NOT EXISTS (SELECT k FROM t2 WHERE w > 99)""")
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_intersect_except_null_safe():
+    """Set operations treat NULLs as equal (IS NOT DISTINCT FROM)."""
+    def mk(s):
+        a = s.create_dataframe({"x": [1, None, 5]})
+        b = s.create_dataframe({"x": [1, None, 7]})
+        a.create_or_replace_temp_view("a")
+        b.create_or_replace_temp_view("b")
+
+    def inter(s):
+        mk(s)
+        return s.sql("SELECT x FROM a INTERSECT SELECT x FROM b")
+
+    def exc(s):
+        mk(s)
+        return s.sql("SELECT x FROM a EXCEPT SELECT x FROM b")
+    got = sorted(with_cpu_session(lambda s: inter(s).collect()),
+                 key=lambda r: (r[0] is None, r))
+    assert got == [(1,), (None,)]
+    assert with_cpu_session(lambda s: exc(s).collect()) == [(5,)]
+    assert_tpu_and_cpu_are_equal_collect(inter)
+    assert_tpu_and_cpu_are_equal_collect(exc)
+
+
+def test_cte_visible_across_setop_branches():
+    def fn(s):
+        _tables(s)
+        return s.sql("""
+            WITH c AS (SELECT k FROM t1 WHERE v > 0)
+            SELECT k FROM c UNION ALL SELECT k FROM c""")
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_setop_parenthesized_branch_keeps_its_clauses():
+    """ORDER BY/LIMIT inside a parenthesized branch stays local."""
+    def fn(s):
+        t = s.create_dataframe({"k": [1, 2, 3]})
+        t.create_or_replace_temp_view("t")
+        return s.sql("""
+            SELECT k FROM t UNION ALL
+            (SELECT k FROM t ORDER BY k DESC LIMIT 1)""")
+    rows = sorted(with_cpu_session(lambda s: fn(s).collect()))
+    assert rows == [(1,), (2,), (3,), (3,)]
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_setop_trailing_offset():
+    def fn(s):
+        t = s.create_dataframe({"k": [1, 2, 3]})
+        t.create_or_replace_temp_view("t")
+        return s.sql("""
+            SELECT k FROM t UNION ALL SELECT k FROM t
+            ORDER BY k LIMIT 3 OFFSET 2""")
+    rows = with_cpu_session(lambda s: fn(s).collect())
+    assert rows == [(2,), (2,), (3,)]
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=False)
+
+
+def test_not_in_empty_subquery_keeps_nulls():
+    """x NOT IN (empty set) is TRUE for every x, including NULL."""
+    def fn(s):
+        t = s.create_dataframe({"k": [1, None]})
+        u = s.create_dataframe({"x": [5, None]})
+        t.create_or_replace_temp_view("t")
+        u.create_or_replace_temp_view("u")
+        return s.sql(
+            "SELECT k FROM t WHERE k NOT IN (SELECT x FROM u WHERE x > 100)")
+    rows = with_cpu_session(lambda s: fn(s).collect())
+    assert sorted(rows, key=lambda r: (r[0] is None, r)) == [(1,), (None,)]
+    assert_tpu_and_cpu_are_equal_collect(fn)

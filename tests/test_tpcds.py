@@ -1,0 +1,51 @@
+"""TPC-DS query-shape equality tests: every benchmark query must give
+identical results on the TPU and CPU engines at a small scale.
+
+Reference pattern: the reference validates its TPC-DS coverage through
+the same assert_gpu_and_cpu_are_equal oracle used everywhere (SURVEY.md
+§4); BASELINE.json config 3 is the TPC-DS sweep.
+"""
+import math
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+import tpcds  # noqa: E402
+
+from harness import with_cpu_session, with_tpu_session  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpcds") / "sf")
+    tpcds.generate(d, scale=0.002, seed=11)
+    return d
+
+
+def _rows(query, data_dir):
+    def fn(s):
+        tpcds.register(s, data_dir)
+        return s.sql(tpcds.QUERIES[query]).collect()
+    return fn
+
+
+def _eq(a, b):
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) and math.isnan(b):
+            return True
+        return a == b or abs(a - b) <= 1e-9 * max(abs(a), abs(b), 1.0)
+    return a == b
+
+
+@pytest.mark.parametrize("q", sorted(tpcds.QUERIES))
+def test_tpcds_query_equality(q, data_dir):
+    cpu = with_cpu_session(_rows(q, data_dir))
+    tpu = with_tpu_session(_rows(q, data_dir))
+    assert len(cpu) == len(tpu), f"{q}: {len(cpu)} vs {len(tpu)}"
+    for i, (cr, tr) in enumerate(zip(cpu, tpu)):
+        assert all(_eq(a, b) for a, b in zip(cr, tr)), \
+            f"{q} row {i}: {cr} vs {tr}"
