@@ -1,0 +1,206 @@
+"""Whole-stage fusion: chains of row-wise operators as ONE jitted program.
+
+TPU-first rationale (the engine's analog of Spark's whole-stage codegen,
+and of the reference running fused cuDF AST kernels): on real hardware
+every separately-dispatched program launch pays fixed overhead, so a
+pipeline of filter -> project -> ... executed op-by-op is
+launch-overhead-bound.  Here a chain of row-preserving/row-filtering
+operators is traced into one XLA computation per (chain structure,
+schema, capacity bucket): predicates compact via in-trace gathers, and
+the live row count stays a traced scalar throughout.
+
+The planner collapses physical TpuFilter/TpuProject chains into
+``TpuStagedCompute`` (plan/overrides.py post-pass), and the hash
+aggregate absorbs a leading chain into its own fused core
+(tpu_aggregate._fused_agg_core), so scan -> filter -> project ->
+partial-agg runs as a single program launch per batch.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as T
+from ..columnar.column import Column
+from ..columnar.batch import ColumnarBatch, LazyCount
+from ..columnar.schema import Schema
+from ..expr import core as ec
+from ..kernels import basic as bk
+from .base import NUM_OUTPUT_ROWS, OP_TIME, timed
+from .fused import FusedEval, _TracedBatch, _tree_fusable, expr_signature
+from .tpu_basic import TpuExec
+
+# op = ("filter", bound_condition, out_schema) |
+#      ("project", [bound_exprs], out_schema)
+Op = Tuple[str, object, Schema]
+
+
+def ops_signature(ops: Sequence[Op]) -> Optional[str]:
+    """Stable signature of an op chain; None if any expr is opaque."""
+    parts = []
+    for kind, payload, out_schema in ops:
+        exprs = [payload] if kind == "filter" else list(payload)
+        sigs = [expr_signature(e) for e in exprs]
+        if any(s is None for s in sigs):
+            return None
+        parts.append(f"{kind}({';'.join(sigs)})")
+    return ">".join(parts)
+
+
+def ops_fusable(ops: Sequence[Op]) -> bool:
+    for kind, payload, out_schema in ops:
+        exprs = [payload] if kind == "filter" else list(payload)
+        if not all(_tree_fusable(e) for e in exprs):
+            return False
+        # gathers re-order every column, so the whole row must be
+        # fixed-width for the filter steps
+        if kind == "filter" and any(
+                f.dtype == T.STRING or f.dtype.is_nested
+                for f in out_schema):
+            return False
+    return True
+
+
+def apply_ops_traced(ops: Sequence[Op], batch) -> "_TracedBatch":
+    """Run the chain under trace; batch.num_rows is a traced scalar."""
+    for kind, payload, out_schema in ops:
+        n = batch.num_rows
+        if kind == "filter":
+            pred = ec.eval_as_column(payload, batch)
+            cap = batch.capacity
+            keep = pred.data.astype(bool) & pred.validity
+            order, cnt = bk.compact_indices(keep, n)
+            cols = [c.gather(order) for c in batch.columns]
+            live = jnp.arange(cap) < cnt
+            cols = [c.mask_validity(live) for c in cols]
+            batch = _TracedBatch(out_schema, cols, cnt, cap)
+        else:
+            cols = [ec.eval_as_column(e, batch) for e in payload]
+            batch = _TracedBatch(out_schema, cols, n, batch.capacity)
+    return batch
+
+
+def apply_ops_eager(ops: Sequence[Op], batch: ColumnarBatch,
+                    fused_per_op: Optional[list] = None) -> ColumnarBatch:
+    """Host-driven fallback (strings/nested/host-state expressions).
+
+    Per-op FusedEval instances (pass fused_per_op from the exec so they
+    are built once, not per batch) keep the fusable SUBSET of each op
+    jitted even when the chain as a whole cannot trace."""
+    for i, (kind, payload, out_schema) in enumerate(ops):
+        fused = fused_per_op[i] if fused_per_op is not None else None
+        if kind == "filter":
+            pred = None
+            if fused is not None:
+                cols = fused(batch)
+                if cols is not None:
+                    pred = cols[0]
+            if pred is None:
+                pred = ec.eval_as_column(payload, batch)
+            keep = pred.data.astype(bool) & pred.validity
+            idx, cnt = bk.compact_indices(keep, batch.rows_dev)
+            n = LazyCount(cnt)
+            out = batch.gather(idx, n)
+            mask = jnp.arange(out.capacity) < cnt
+            batch = ColumnarBatch(
+                out_schema, [c.mask_validity(mask) for c in out.columns],
+                n)
+        else:
+            cols = fused(batch) if fused is not None else None
+            if cols is None:
+                cols = [ec.eval_as_column(e, batch) for e in payload]
+            batch = ColumnarBatch(out_schema, cols, batch.rows_lazy)
+    return batch
+
+
+def build_fused_per_op(ops: Sequence[Op], src_schema: Schema):
+    """One FusedEval per op for the eager fallback path."""
+    out = []
+    schema = src_schema
+    for kind, payload, out_schema in ops:
+        exprs = [payload] if kind == "filter" else list(payload)
+        out.append(FusedEval(exprs, schema))
+        schema = out_schema
+    return out
+
+
+class TpuStagedCompute(TpuExec):
+    """A collapsed chain of filters/projections (one launch per batch).
+
+    Reference analogue: GpuProjectExec/GpuFilterExec pipelines that the
+    reference executes as fused cuDF AST expressions; Spark's own
+    WholeStageCodegenExec plays the same role on CPU."""
+
+    _JIT_CACHE: dict = {}
+
+    def __init__(self, child, ops: List[Op], src_schema: Schema):
+        super().__init__(child)
+        self.ops = ops
+        self.src_schema = src_schema
+
+    @property
+    def output_schema(self):
+        return self.ops[-1][2]
+
+    def _node_string(self):
+        kinds = "+".join(k for k, _, _ in self.ops)
+        return f"TpuStagedCompute[{kinds}]"
+
+    def _jitted(self):
+        sig = ops_signature(self.ops)
+        key = None
+        if sig is not None:
+            key = (sig, tuple(f.dtype.name for f in self.src_schema))
+            hit = TpuStagedCompute._JIT_CACHE.get(key)
+            if hit is not None:
+                return hit
+
+        ops = self.ops
+        src_schema = self.src_schema
+
+        def _eval(capacity: int, datas, valids, num_rows):
+            cols = [Column(f.dtype, d, v)
+                    for f, d, v in zip(src_schema, datas, valids)]
+            batch = _TracedBatch(src_schema, cols, num_rows, capacity)
+            out = apply_ops_traced(ops, batch)
+            return ([(c.data, c.validity) for c in out.columns],
+                    out.num_rows)
+
+        fn = jax.jit(_eval, static_argnums=(0,))
+        if key is not None and len(TpuStagedCompute._JIT_CACHE) < 4096:
+            TpuStagedCompute._JIT_CACHE[key] = fn
+        return fn
+
+    def execute(self):
+        from .base import NUM_OUTPUT_BATCHES
+        fusable = ops_fusable(self.ops)
+        jitted = self._jitted() if fusable else None
+        fused_per_op = None if fusable else \
+            build_fused_per_op(self.ops, self.src_schema)
+        out_schema = self.output_schema
+        has_filter = any(k == "filter" for k, _, _ in self.ops)
+
+        def run(part):
+            for batch in part:
+                with timed(self.metrics[OP_TIME]):
+                    if jitted is not None and all(
+                            type(c) is Column for c in batch.columns):
+                        datas = tuple(c.data for c in batch.columns)
+                        valids = tuple(c.validity for c in batch.columns)
+                        pairs, cnt = jitted(batch.capacity, datas, valids,
+                                            batch.rows_dev)
+                        n = LazyCount(cnt) if has_filter else \
+                            batch.rows_lazy
+                        out = ColumnarBatch(
+                            out_schema,
+                            [Column(f.dtype, d, v) for f, (d, v) in
+                             zip(out_schema, pairs)], n)
+                    else:
+                        out = apply_ops_eager(self.ops, batch,
+                                              fused_per_op)
+                self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
+                self.metrics[NUM_OUTPUT_BATCHES] += 1
+                yield out
+        return [run(p) for p in self.children[0].execute()]

@@ -44,6 +44,12 @@ class TpuHashAggregate(TpuExec):
         self.group_exprs = group_exprs
         self.aggs = aggs
         self.mode = mode
+        # whole-stage fusion: a leading filter/project chain folded in by
+        # the planner post-pass (exec/staged.py) — applied before keys
+        self.pre_ops = None
+        # per-exec memo for whole-stage guards/signatures (shared with
+        # the throwaway inner instances _update_batch builds per batch)
+        self._ws_memo = {}
 
     @property
     def output_schema(self):
@@ -56,7 +62,8 @@ class TpuHashAggregate(TpuExec):
         return Schema(fields)
 
     def _node_string(self):
-        return f"TpuHashAggregate[{self.mode}]"
+        ws = f", staged={len(self.pre_ops)} ops" if self.pre_ops else ""
+        return f"TpuHashAggregate[{self.mode}{ws}]"
 
     def execute(self):
         child_schema = self.children[0].output_schema
@@ -110,6 +117,8 @@ class TpuHashAggregate(TpuExec):
         """Partial (update) aggregation of one input batch -> buffer batch."""
         inner = TpuHashAggregate(self.group_exprs, self.aggs,
                                  self.children[0], mode=PARTIAL)
+        inner.pre_ops = self.pre_ops
+        inner._ws_memo = self._ws_memo
         if self.mode == FINAL:
             # input is already buffer-shaped: merge within the batch
             inner = TpuHashAggregate(self.group_exprs, self.aggs,
@@ -223,11 +232,143 @@ class TpuHashAggregate(TpuExec):
                                 for dt, (d, v) in zip(dts, pairs)])
         return plan, agg_buffers
 
+    def _ws_prepare(self, src_schema):
+        """One-time guards + signature derivation for the whole-stage
+        core; False when this (pre_ops, schema) can never fuse."""
+        from .fused import _tree_fusable, expr_signature
+        from .staged import ops_fusable, ops_signature
+        if not ops_fusable(self.pre_ops):
+            return False
+        osig = ops_signature(self.pre_ops)
+        if osig is None:
+            return False
+        post_schema = self.pre_ops[-1][2]
+        try:
+            bound_keys = [e.bind(post_schema) for e in self.group_exprs]
+            bound_inputs = [[c.bind(post_schema) for c in a.func.children]
+                            for a in self.aggs]
+        except KeyError:
+            return False
+        if not all(_tree_fusable(e) for e in bound_keys):
+            return False
+        if any(e.dtype() == T.STRING or e.dtype().is_nested
+               for e in bound_keys):
+            return False
+        for bs in bound_inputs:
+            if not all(_tree_fusable(e) for e in bs):
+                return False
+        if not all(isinstance(a.func, TpuHashAggregate._FUSABLE_FUNCS)
+                   for a in self.aggs):
+            return False
+        ksigs = [expr_signature(e) for e in bound_keys]
+        isigs = [tuple(expr_signature(e) for e in bs)
+                 for bs in bound_inputs]
+        if any(s is None for s in ksigs) or \
+                any(s is None for t in isigs for s in t):
+            return False
+        cache_key = ("ws", osig, tuple(ksigs),
+                     tuple(x for t in isigs for x in t),
+                     tuple(f.dtype.name for f in src_schema),
+                     tuple((type(a.func).__name__, repr(a.func),
+                            getattr(a.func, "ignore_nulls", None))
+                           for a in self.aggs))
+        return cache_key, bound_keys, bound_inputs
+
+    def _fused_whole_stage_core(self, batch: ColumnarBatch):
+        """scan-side filter/project chain + key eval + grouping + update
+        as ONE jitted program (whole-stage codegen role, exec/staged.py).
+
+        Returns (GroupPlan, agg_buffers, key_cols) or None to fall back
+        (the caller then applies pre_ops eagerly)."""
+        import jax
+        import logging
+        from .fused import _TracedBatch, _tree_fusable, expr_signature
+        from .staged import ops_fusable, ops_signature, apply_ops_traced
+        if TpuHashAggregate._FUSABLE_FUNCS is None:
+            from ..expr import aggregates as ea
+            TpuHashAggregate._FUSABLE_FUNCS = (
+                ea.Sum, ea.Count, ea.Min, ea.Max, ea.Average, ea.First,
+                ea.Last)
+        if batch.capacity > (1 << 21) or not batch.columns:
+            return None
+        if not all(type(c) is Column for c in batch.columns):
+            return None
+        # the guard walks + signature derivation are schema-invariant:
+        # compute once per (source dtypes), not per batch
+        mkey = tuple(f.dtype.name for f in batch.schema)
+        prep = self._ws_memo.get(mkey)
+        if prep is None:
+            prep = self._ws_prepare(batch.schema)
+            self._ws_memo[mkey] = prep
+        if prep is False:
+            return None
+        cache_key, bound_keys, bound_inputs = prep
+        core = TpuHashAggregate._CORE_CACHE.get(cache_key)
+        if core is False:
+            return None
+        if core is None:
+            src_schema = batch.schema
+            pre_ops = self.pre_ops
+            aggs = self.aggs
+
+            def _core(datas, valids, num_rows):
+                cap = datas[0].shape[0]
+                cols = [Column(f.dtype, d, v)
+                        for f, d, v in zip(src_schema, datas, valids)]
+                b = _TracedBatch(src_schema, cols, num_rows, cap)
+                b = apply_ops_traced(pre_ops, b)
+                kcols = [ec.eval_as_column(e, b) for e in bound_keys]
+                words = canon.batch_key_words(kcols, b.num_rows)
+                plan = agg_k.groupby_plan(words)
+                outs = []
+                for a, bs in zip(aggs, bound_inputs):
+                    cols2 = [ec.eval_as_column(e, b) for e in bs] or [None]
+                    bufs = a.func.update(plan, cols2)
+                    outs.append([(x.data, x.validity) for x in bufs])
+                return ((plan.perm, plan.seg_id, plan.live_sorted,
+                         plan.rep_indices, plan.num_groups), outs,
+                        [(k.data, k.validity) for k in kcols])
+            core = jax.jit(_core)
+            TpuHashAggregate._CORE_CACHE[cache_key] = core
+        datas = tuple(c.data for c in batch.columns)
+        valids = tuple(c.validity for c in batch.columns)
+        try:
+            (perm, seg_id, live, rep, ng), bufs_flat, key_pairs = core(
+                datas, valids, batch.rows_dev)
+        except Exception:  # noqa: BLE001 - fall back, but loudly
+            logging.getLogger("spark_rapids_tpu.exec.aggregate").warning(
+                "whole-stage aggregate core failed; falling back",
+                exc_info=True)
+            TpuHashAggregate._CORE_CACHE[cache_key] = False
+            return None
+        plan = agg_k.GroupPlan(perm, seg_id, live, rep, ng)
+        agg_buffers = []
+        for a, pairs in zip(self.aggs, bufs_flat):
+            dts = a.func.buffer_dtypes()
+            agg_buffers.append([Column(dt, d, v)
+                                for dt, (d, v) in zip(dts, pairs)])
+        key_cols = [Column(e.dtype(), d, v)
+                    for e, (d, v) in zip(bound_keys, key_pairs)]
+        return plan, agg_buffers, key_cols
+
     # -- core -------------------------------------------------------------------
     def _aggregate_batch(self, batch: ColumnarBatch,
                          emit_buffers: bool = False) -> ColumnarBatch:
+        plan = agg_buffers = key_cols = None
+        if self.pre_ops and self.mode in (PARTIAL, COMPLETE):
+            if self.group_exprs:
+                ws = self._fused_whole_stage_core(batch)
+            else:
+                ws = None
+            if ws is not None:
+                plan, agg_buffers, key_cols = ws
+            else:
+                from .staged import apply_ops_eager
+                batch = apply_ops_eager(self.pre_ops, batch)
         child_schema = batch.schema
-        if self.mode in (PARTIAL, COMPLETE):
+        if plan is not None:
+            input_cols = None
+        elif self.mode in (PARTIAL, COMPLETE):
             key_cols = [ec.eval_as_column(e.bind(child_schema), batch)
                         for e in self.group_exprs]
             input_cols = []
@@ -248,8 +389,11 @@ class TpuHashAggregate(TpuExec):
             return self._global_agg(batch, input_cols, emit_buffers)
 
         update_mode = self.mode in (PARTIAL, COMPLETE)
-        fused = self._fused_agg_core(key_cols, input_cols, update_mode,
-                                     batch)
+        if plan is not None:
+            fused = (plan, agg_buffers)
+        else:
+            fused = self._fused_agg_core(key_cols, input_cols, update_mode,
+                                         batch)
         if fused is not None:
             plan, agg_buffers = fused
         else:

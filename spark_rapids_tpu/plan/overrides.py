@@ -392,9 +392,62 @@ class Planner:
             if text:
                 print(text)
         phys = self._convert(meta)
+        phys = self._collapse_stages(phys)
         if self.conf.get(TEST_ENABLED):
             self._assert_all_tpu(phys)
         return phys
+
+    # -- whole-stage collapse (GpuTransitionOverrides-style post-pass) ----
+    def _collapse_stages(self, node: PhysicalPlan) -> PhysicalPlan:
+        """Fuse TpuFilter/TpuProject chains into TpuStagedCompute, and
+        fold a leading chain into the hash aggregate's fused core — one
+        program launch per batch per stage (exec/staged.py)."""
+        from ..exec.staged import TpuStagedCompute
+        from ..exec import tpu_aggregate as TA
+        node.children = [self._collapse_stages(c) for c in node.children]
+        chain = []
+        cur = node
+        while isinstance(cur, (TB.TpuFilter, TB.TpuProject)):
+            chain.append(cur)
+            cur = cur.children[0]
+        # children were collapsed first, so an already-built staged node
+        # below the chain merges in (a 3+-op chain must stay ONE launch)
+        absorbed = None
+        if chain and isinstance(cur, TpuStagedCompute):
+            absorbed = cur
+            cur = cur.children[0]
+        if len(chain) >= 2 or (chain and absorbed is not None):
+            ops = list(absorbed.ops) if absorbed is not None else []
+            for n in reversed(chain):
+                src = n.children[0].output_schema
+                if isinstance(n, TB.TpuFilter):
+                    ops.append(("filter", n.condition.bind(src),
+                                n.output_schema))
+                else:
+                    ops.append(("project",
+                                [e.bind(src) for e in n.exprs],
+                                n.output_schema))
+            node = TpuStagedCompute(cur, ops, cur.output_schema)
+        if isinstance(node, TA.TpuHashAggregate) and \
+                node.mode in (TA.PARTIAL, TA.COMPLETE):
+            child = node.children[0]
+            ops = None
+            if isinstance(child, TpuStagedCompute):
+                ops = child.ops
+                src = child.children[0]
+            elif isinstance(child, (TB.TpuFilter, TB.TpuProject)):
+                s = child.children[0].output_schema
+                if isinstance(child, TB.TpuFilter):
+                    ops = [("filter", child.condition.bind(s),
+                            child.output_schema)]
+                else:
+                    ops = [("project", [e.bind(s) for e in child.exprs],
+                            child.output_schema)]
+                src = child.children[0]
+            if ops is not None:
+                node.pre_ops = ops
+                node.children = [src]
+        return node
 
     # ------------------------------------------------------------------
     def _convert(self, meta: PlanMeta) -> PhysicalPlan:

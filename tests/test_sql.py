@@ -296,8 +296,11 @@ def test_sql_plan_uses_tpu():
         df.collect()
         tree = df._last_physical_plan.tree_string()
         assert "TpuHashAggregate" in tree, tree
-        assert "TpuFilter" in tree or "TpuFused" in tree or \
-            "Fused" in tree, tree
+        # the filter either survives as its own exec, collapses into a
+        # staged chain, or is absorbed into the aggregate's fused core
+        # (marked "staged=N ops" in the node string)
+        assert ("TpuFilter" in tree or "TpuStagedCompute" in tree or
+                "staged=" in tree), tree
         return []
     with_tpu_session(run)
 
@@ -421,3 +424,25 @@ def test_not_in_empty_subquery_keeps_nulls():
     rows = with_cpu_session(lambda s: fn(s).collect())
     assert sorted(rows, key=lambda r: (r[0] is None, r)) == [(1,), (None,)]
     assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_staged_chain_single_node():
+    """A 4-op filter/project chain collapses into ONE staged node."""
+    from harness import with_tpu_session
+    from spark_rapids_tpu.api import functions as F
+
+    def run(s):
+        df = s.create_dataframe({"a": list(range(100)),
+                                 "b": [i * 0.5 for i in range(100)]})
+        out = (df.filter(F.col("a") > 1)
+                 .select((F.col("a") + 1).alias("a2"), "b")
+                 .filter(F.col("a2") < 80)
+                 .select((F.col("a2") * 2).alias("a4"), "b")
+                 .select("a4"))
+        rows = out.collect()
+        assert len(rows) == 77
+        tree = out._last_physical_plan.tree_string()
+        assert tree.count("TpuStagedCompute") == 1, tree
+        assert "TpuFilter" not in tree and "TpuProject" not in tree, tree
+        return []
+    with_tpu_session(run)
