@@ -87,6 +87,7 @@ def generate(data_dir: str, scale: float, seed: int = 0):
     write("store", pa.table({
         "s_store_sk": np.arange(ns, dtype=np.int64),
         "s_store_name": rng.choice(["ese", "ought", "able", "pri"], ns),
+        "s_state": rng.choice(["TN", "SD", "AL", "GA"], ns),
         "s_zip": np.array([f"{rng.integers(10000, 99999)}" for _ in
                            range(ns)]),
     }))
@@ -231,6 +232,20 @@ QUERIES = {
         group by i_brand_id, i_brand
         order by ext_price desc, brand_id
         limit 100""",
+    # TPC-DS Q27 (adapted: grouping() indicator column omitted):
+    # demographic item/state averages with ROLLUP subtotals
+    "q27": """
+        select i_item_id, s_state,
+               avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+               avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+        from store_sales, customer_demographics, date_dim, store, item
+        where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+          and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+          and cd_gender = 'M' and cd_marital_status = 'S'
+          and cd_education_status = 'College' and d_year = 2002
+        group by rollup (i_item_id, s_state)
+        order by i_item_id, s_state
+        limit 100""",
     # TPC-DS Q96: count of sales in a store/time/demographic slice
     "q96": """
         select count(*) cnt
@@ -291,7 +306,7 @@ def main():
     ap.add_argument("--data-dir", default="/tmp/tpcds_data")
     ap.add_argument("--repeats", type=int, default=2)
     args = ap.parse_args()
-    tag = os.path.join(args.data_dir, f"sf{args.scale}")
+    tag = os.path.join(args.data_dir, f"sf{args.scale}_v2")
     if not os.path.exists(os.path.join(tag, "store_sales.parquet")):
         sizes = generate(tag, args.scale)
         print(f"generated {sizes}", file=sys.stderr)

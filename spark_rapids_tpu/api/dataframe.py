@@ -173,6 +173,16 @@ class DataFrame:
     groupBy = group_by
     groupby = group_by
 
+    def rollup(self, *cols) -> "GroupedData":
+        keys = [_to_expr(c, self.schema) for c in cols]
+        sets = L.rollup_sets([ec.output_name(e) for e in keys])
+        return GroupedData(self, keys, grouping_sets=sets)
+
+    def cube(self, *cols) -> "GroupedData":
+        keys = [_to_expr(c, self.schema) for c in cols]
+        sets = L.cube_sets([ec.output_name(e) for e in keys])
+        return GroupedData(self, keys, grouping_sets=sets)
+
     def agg(self, *aggs, **named) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs, **named)
 
@@ -437,9 +447,11 @@ def _ref_names(e: ec.Expression) -> set:
 
 
 class GroupedData:
-    def __init__(self, df: DataFrame, keys: List[ec.Expression]):
+    def __init__(self, df: DataFrame, keys: List[ec.Expression],
+                 grouping_sets=None):
         self.df = df
         self.keys = keys
+        self.grouping_sets = grouping_sets
 
     def agg(self, *aggs, **named) -> DataFrame:
         from ..udf.python_udf import PandasAggUDFExpr
@@ -458,19 +470,38 @@ class GroupedData:
                 continue
             assert isinstance(e, eagg.AggregateFunction), \
                 f"agg() requires aggregate functions, got {e!r}"
-            agg_exprs.append(L.AggExpr(e, alias or repr(e)))
+            agg_exprs.append(L.AggExpr(e, alias or repr(e),
+                                       distinct=getattr(e, "_distinct",
+                                                        False)))
         if pandas_aggs:
             assert not agg_exprs and not named, \
                 "pandas grouped-agg UDFs cannot mix with builtin aggregates"
             return self._agg_pandas(pandas_aggs)
+        if self.grouping_sets is not None:
+            named_exprs = []
+            for alias, a in named.items():
+                e = a.expr if isinstance(a, Col) else a
+                if isinstance(e, ec.Alias):
+                    e = e.children[0]
+                e = _resolve(e, schema)
+                named_exprs.append(L.AggExpr(
+                    e, alias, distinct=getattr(e, "_distinct", False)))
+            return DataFrame(
+                L.build_grouping_sets(self.keys, self.grouping_sets,
+                                      agg_exprs + named_exprs,
+                                      self.df._plan),
+                self.df.session)
         for alias, a in named.items():
             e = a.expr if isinstance(a, Col) else a
             if isinstance(e, ec.Alias):
                 e = e.children[0]
             e = _resolve(e, schema)
-            agg_exprs.append(L.AggExpr(e, alias))
-        return DataFrame(L.Aggregate(self.keys, agg_exprs, self.df._plan),
-                         self.df.session)
+            agg_exprs.append(L.AggExpr(e, alias,
+                                       distinct=getattr(e, "_distinct",
+                                                        False)))
+        return DataFrame(
+            L.build_aggregate(self.keys, agg_exprs, self.df._plan),
+            self.df.session)
 
     def count(self) -> DataFrame:
         return self.agg(count=Col(eagg.Count()))

@@ -74,7 +74,18 @@ def collect_set(c) -> Col:
 
 
 def count_distinct(c) -> Col:
-    raise NotImplementedError("count_distinct lands with distinct-agg support")
+    e = eagg.Count(_expr(c if not isinstance(c, str) else col(c)))
+    e._distinct = True
+    return Col(e)
+
+
+def sum_distinct(c) -> Col:
+    e = eagg.Sum(_expr(c if not isinstance(c, str) else col(c)))
+    e._distinct = True
+    return Col(e)
+
+
+countDistinct = count_distinct
 
 
 # -- conditional --------------------------------------------------------------

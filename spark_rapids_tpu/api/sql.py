@@ -254,6 +254,8 @@ class SelectStmt(Ast):
     limit: Optional[int]
     offset: Optional[int]
     parenthesized: bool = False
+    # ROLLUP/CUBE/GROUPING SETS: tuples of subsets of group_by idents
+    group_sets: Optional[Tuple[Tuple[Ast, ...], ...]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -426,11 +428,53 @@ class _Parser:
             from_item = self.from_clause()
         where = self.expr() if self.eat_kw("where") else None
         group_by: List[Ast] = []
+        group_sets = None
         if self.eat_kw("group"):
             self.expect_kw("by")
-            group_by.append(self.expr())
-            while self.eat_op(","):
+            low = self.peek().text.lower()
+            if self.peek().kind == "id" and low in ("rollup", "cube") and \
+                    self.peek(1).text == "(":
+                self.next()
+                self.expect_op("(")
+                cols = [self.expr()]
+                while self.eat_op(","):
+                    cols.append(self.expr())
+                self.expect_op(")")
+                group_by = cols
+                from ..plan.logical import cube_sets, rollup_sets
+                mk = rollup_sets if low == "rollup" else cube_sets
+                group_sets = tuple(tuple(cols[i] for i in t)
+                                   for t in mk(list(range(len(cols)))))
+            elif self.peek().kind == "id" and low == "grouping" and \
+                    self.peek(1).text.lower() == "sets":
+                self.next()
+                self.next()
+                self.expect_op("(")
+                sets = []
+                cols_seen: List[Ast] = []
+                while True:
+                    if self.eat_op("("):
+                        one = []
+                        if not self.at_op(")"):
+                            one.append(self.expr())
+                            while self.eat_op(","):
+                                one.append(self.expr())
+                        self.expect_op(")")
+                    else:
+                        one = [self.expr()]   # bare member: SETS (k, ())
+                    sets.append(tuple(one))
+                    for c in one:
+                        if c not in cols_seen:
+                            cols_seen.append(c)
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+                group_by = cols_seen
+                group_sets = tuple(sets)
+            else:
                 group_by.append(self.expr())
+                while self.eat_op(","):
+                    group_by.append(self.expr())
         having = self.expr() if self.eat_kw("having") else None
         order_by: List[OrderItem] = []
         if self.eat_kw("order"):
@@ -443,7 +487,7 @@ class _Parser:
             offset = int(self.next().text)
         return SelectStmt(tuple(ctes), distinct, tuple(items), from_item,
                           where, tuple(group_by), having, tuple(order_by),
-                          limit, offset)
+                          limit, offset, group_sets=group_sets)
 
     def order_items(self) -> List[OrderItem]:
         out = [self.order_item()]
@@ -950,9 +994,9 @@ def _sql_type(name: str, p1, p2) -> T.DType:
 def _make_agg(f: Func, lower) -> eagg.AggregateFunction:
     n = f.fname
     if n == "count" and (not f.args or isinstance(f.args[0], Star)):
+        if f.distinct:
+            raise SqlError("COUNT(DISTINCT *) is not valid")
         return eagg.Count()
-    if f.distinct:
-        raise SqlError(f"DISTINCT aggregate {n} not supported yet")
     arg = lower(f.args[0]) if f.args else None
     if n == "sum":
         return eagg.Sum(arg)
@@ -1211,9 +1255,35 @@ class _Lowerer:
                         continue
                     name = self.fresh("agg")
                     aggs.append(L.AggExpr(
-                        _make_agg(call, lower_in), name))
+                        _make_agg(call, lower_in), name,
+                        distinct=call.distinct))
                     agg_map.append((call, name))
-            plan = L.Aggregate(group_exprs, aggs, plan)
+            if s.group_sets is not None:
+                # set members are the same ASTs as the GROUP BY columns,
+                # which already went through alias/ordinal substitution
+                # into key_asts — align by position
+                subst = {g: k for g, k in zip(s.group_by, key_asts)}
+                name_of = {k: n for (k, n) in key_map}
+                sets = []
+                for gset in s.group_sets:
+                    members = []
+                    for gcol in gset:
+                        k = subst.get(gcol)
+                        if k is None:
+                            try:
+                                k = canon(gcol)
+                            except SqlError:
+                                k = None
+                        if k is None or k not in name_of:
+                            raise SqlError(
+                                "grouping set member must appear in "
+                                "GROUP BY")
+                        members.append(name_of[k])
+                    sets.append(tuple(members))
+                plan = L.build_grouping_sets(group_exprs, sets, aggs,
+                                             plan)
+            else:
+                plan = L.build_aggregate(group_exprs, aggs, plan)
             scope = _Scope.of(plan.schema)
 
             def rw(ast: Ast) -> Ast:
@@ -1407,8 +1477,13 @@ class _Lowerer:
                     plan = L.Join(plan, sub, "semi", [lkey], [rkey], None)
                 continue
             if isinstance(c, Exists):
+                try:
+                    sub = self.lower(c.query)
+                except SqlError:
+                    # outer references: decorrelate to a semi/anti join
+                    plan = self._decorrelate_exists(c, plan, scope)
+                    continue
                 # uncorrelated EXISTS: evaluate eagerly to a constant
-                sub = self.lower(c.query)
                 n = self.session.execute_to_arrow(
                     L.Limit(1, sub)).num_rows
                 truth = (n > 0) != c.negated
@@ -1422,6 +1497,73 @@ class _Lowerer:
                 cond = ep.And(cond, r)
             plan = L.Filter(cond, plan)
         return plan
+
+    def _decorrelate_exists(self, c: Exists, plan: L.LogicalPlan,
+                            outer_scope: _Scope) -> L.LogicalPlan:
+        """Correlated [NOT] EXISTS -> semi/anti join.
+
+        Reference shape: TPC-DS q16/q94 ``exists (select * from t2 where
+        t1.k = t2.k and ...)``.  Equality conjuncts that straddle the
+        scopes become join keys; purely-inner conjuncts stay as a filter
+        under the join; anything else is unsupported."""
+        sub = c.query
+        if not isinstance(sub, SelectStmt) or sub.from_item is None or \
+                sub.group_by or sub.having or sub.distinct or sub.ctes:
+            raise SqlError("unsupported correlated EXISTS subquery")
+        inner_plan, inner_scope = self.lower_from(sub.from_item)
+
+        def canon_with(scope_: _Scope, ast: Ast) -> Ast:
+            def fn(n):
+                if isinstance(n, Ident):
+                    return Res(scope_.resolve_field(n.parts).name)
+                return n
+            return _transform(ast, fn)
+
+        def conjuncts(a: Ast) -> List[Ast]:
+            if isinstance(a, Bin) and a.op == "and":
+                return conjuncts(a.left) + conjuncts(a.right)
+            return [a]
+
+        inner_rest: List[Ast] = []
+        outer_keys: List[ec.Expression] = []
+        inner_keys: List[ec.Expression] = []
+        for cj in (conjuncts(sub.where) if sub.where is not None else []):
+            try:
+                inner_rest.append(canon_with(inner_scope, cj))
+                continue
+            except SqlError:
+                pass
+            matched = False
+            if isinstance(cj, Bin) and cj.op == "=":
+                for a, b in ((cj.left, cj.right), (cj.right, cj.left)):
+                    try:
+                        ia = canon_with(inner_scope, a)
+                        ob = canon_with(outer_scope, b)
+                    except SqlError:
+                        continue
+                    inner_keys.append(self.lower_expr(ia, inner_scope))
+                    outer_keys.append(self.lower_expr(ob, outer_scope))
+                    matched = True
+                    break
+            if not matched:
+                raise SqlError(
+                    "correlated EXISTS predicates must be equalities "
+                    "between inner and outer columns (plus inner-only "
+                    "conjuncts)")
+        if not inner_keys:
+            raise SqlError("EXISTS subquery references unknown columns")
+        if inner_rest:
+            cond = self.lower_expr(inner_rest[0], inner_scope)
+            for r in inner_rest[1:]:
+                cond = ep.And(cond, self.lower_expr(r, inner_scope))
+            inner_plan = L.Filter(cond, inner_plan)
+        proj = [ec.Alias(k, f"__ck{i}")
+                for i, k in enumerate(inner_keys)]
+        inner_proj = L.Project(proj, inner_plan)
+        rrefs = [ec.AttributeReference(f"__ck{i}", k.dtype(), True)
+                 for i, k in enumerate(inner_keys)]
+        return L.Join(plan, inner_proj, "anti" if c.negated else "semi",
+                      outer_keys, rrefs, None)
 
     # -- window -------------------------------------------------------------
     def lower_window(self, w: WindowE, alias: str,

@@ -562,6 +562,38 @@ class CpuLimit(CpuExec):
         return [run()]
 
 
+class CpuExpand(CpuExec):
+    """Oracle for grouping-sets Expand: one output table per projection.
+
+    Reference behavior: Spark ExpandExec (each input row emitted once per
+    projection, absent grouping keys null-filled)."""
+
+    def __init__(self, logical, child: PhysicalPlan):
+        super().__init__(child)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def execute(self):
+        out_schema = schema_to_arrow(self.output_schema)
+
+        def run(part):
+            for t in part:
+                for proj in self.logical.projections:
+                    arrays = []
+                    for e, f in zip(proj, out_schema):
+                        a = _arr(cpu_eval(e, t), t.num_rows)
+                        if a.type != f.type:
+                            a = pc.cast(a, f.type, safe=False)
+                        arrays.append(a)
+                    out = pa.Table.from_arrays(arrays, schema=out_schema)
+                    self.metrics[NUM_OUTPUT_ROWS] += out.num_rows
+                    yield out
+        return [run(p) for p in self.children[0].execute()]
+
+
 class CpuGenerate(CpuExec):
     """Oracle for explode/posexplode — plain Python row expansion.
 

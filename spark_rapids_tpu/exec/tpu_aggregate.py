@@ -363,8 +363,13 @@ class TpuHashAggregate(TpuExec):
             if ws is not None:
                 plan, agg_buffers, key_cols = ws
             else:
-                from .staged import apply_ops_eager
-                batch = apply_ops_eager(self.pre_ops, batch)
+                from .staged import apply_ops_eager, build_fused_per_op
+                fkey = ("fpo", tuple(f.dtype.name for f in batch.schema))
+                fpo = self._ws_memo.get(fkey)
+                if fpo is None:
+                    fpo = build_fused_per_op(self.pre_ops, batch.schema)
+                    self._ws_memo[fkey] = fpo
+                batch = apply_ops_eager(self.pre_ops, batch, fpo)
         child_schema = batch.schema
         if plan is not None:
             input_cols = None

@@ -30,7 +30,11 @@ class AggregateFunction(Expression):
         self.children = [child] if child is not None else []
 
     def with_children(self, c):
-        return type(self)(c[0]) if c else type(self)()
+        clone = type(self)(c[0]) if c else type(self)()
+        if getattr(self, "_distinct", False):
+            # DISTINCT marker set by the API layer (functions.count_distinct)
+            clone._distinct = True
+        return clone
 
     # number of internal buffer columns for partial aggregation
     @property

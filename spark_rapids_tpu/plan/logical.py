@@ -128,6 +128,103 @@ class Filter(LogicalPlan):
 class AggExpr:
     func: AggregateFunction
     alias: str
+    distinct: bool = False
+
+
+def build_aggregate(group_exprs, aggs: List["AggExpr"],
+                    child: "LogicalPlan") -> "LogicalPlan":
+    """Aggregate builder handling DISTINCT aggregate functions.
+
+    Reference: Spark rewrites distinct aggregates before the plugin sees
+    them (RewriteDistinctAggregates); standalone, the rewrite lives here:
+    a two-level aggregate — inner groups by (keys, distinct-arg) while
+    partially aggregating the non-distinct functions, outer re-aggregates
+    with the distinct functions applied to the now-unique arg.  All
+    distinct functions must share one argument expression (the common
+    count(distinct x) case; multi-arg distinct needs the Expand rewrite).
+    """
+    from ..expr import aggregates as eagg
+    from ..expr import core as ec
+    if not any(a.distinct for a in aggs):
+        return Aggregate(group_exprs, aggs, child)
+    dargs = {repr(a.func.children[0]) for a in aggs if a.distinct}
+    if len(dargs) != 1:
+        raise NotImplementedError(
+            "DISTINCT aggregates must share one argument expression")
+    dexpr = next(a.func.children[0] for a in aggs if a.distinct)
+    dalias = "__distinct_key"
+
+    key_names = [output_name(e) for e in group_exprs]
+    inner_keys = list(group_exprs) + [ec.Alias(dexpr, dalias)]
+    inner_aggs: List[AggExpr] = []
+    outer_aggs: List[AggExpr] = []
+    final_exprs: List[ec.Expression] = []
+
+    def key_ref(plan_schema_name, dtype, nullable=True):
+        return ec.AttributeReference(plan_schema_name, dtype, nullable)
+
+    for e, name in zip(group_exprs, key_names):
+        final_exprs.append(ec.AttributeReference(name, e.dtype(),
+                                                 e.nullable))
+    dref = ec.AttributeReference(dalias, dexpr.dtype(), True)
+    for i, a in enumerate(aggs):
+        f = a.func
+        if a.distinct:
+            outer_aggs.append(AggExpr(f.with_children([dref]), a.alias))
+            final_exprs.append(ec.AttributeReference(a.alias, f.dtype(),
+                                                     True))
+            continue
+        pname = f"__p{i}"
+        if isinstance(f, (eagg.Sum, eagg.Min, eagg.Max, eagg.First,
+                          eagg.Last)):
+            inner_aggs.append(AggExpr(f, pname))
+            pref = ec.AttributeReference(pname, f.dtype(), True)
+            merge = {eagg.Sum: eagg.Sum, eagg.Min: eagg.Min,
+                     eagg.Max: eagg.Max, eagg.First: eagg.First,
+                     eagg.Last: eagg.Last}[type(f)](pref)
+            outer_aggs.append(AggExpr(merge, a.alias))
+            final_exprs.append(ec.AttributeReference(a.alias, f.dtype(),
+                                                     True))
+        elif isinstance(f, eagg.Count):
+            inner_aggs.append(AggExpr(f, pname))
+            pref = ec.AttributeReference(pname, f.dtype(), False)
+            outer_aggs.append(AggExpr(eagg.Sum(pref), a.alias))
+            from ..expr import conditional as econd
+            from ..expr.cast import Cast
+            from ..columnar import dtypes as T
+            final_exprs.append(ec.Alias(econd.Coalesce(
+                Cast(ec.AttributeReference(a.alias, T.INT64, True),
+                     T.INT64), ec.Literal(0)), a.alias))
+        elif isinstance(f, eagg.Average):
+            sname, cname = f"__ps{i}", f"__pc{i}"
+            arg = f.children[0]
+            inner_aggs.append(AggExpr(eagg.Sum(arg), sname))
+            inner_aggs.append(AggExpr(eagg.Count(arg), cname))
+            sref = ec.AttributeReference(sname, eagg.Sum(arg).dtype(),
+                                         True)
+            cref = ec.AttributeReference(cname, eagg.Count(arg).dtype(),
+                                         False)
+            outer_aggs.append(AggExpr(eagg.Sum(sref), f"__s{i}"))
+            outer_aggs.append(AggExpr(eagg.Sum(cref), f"__c{i}"))
+            from ..expr import arithmetic as ea
+            from ..expr.cast import Cast
+            from ..columnar import dtypes as T
+            final_exprs.append(ec.Alias(ea.Divide(
+                Cast(ec.AttributeReference(f"__s{i}", sref.dtype(), True),
+                     T.FLOAT64),
+                Cast(ec.AttributeReference(f"__c{i}", cref.dtype(), True),
+                     T.FLOAT64)), a.alias))
+        else:
+            raise NotImplementedError(
+                f"{f.name} cannot combine with DISTINCT aggregates")
+
+    inner = Aggregate(inner_keys, inner_aggs, child)
+    outer_keys = []
+    for e, name in zip(group_exprs, key_names):
+        outer_keys.append(ec.AttributeReference(name, e.dtype(),
+                                                e.nullable))
+    outer = Aggregate(outer_keys, outer_aggs, inner)
+    return Project(final_exprs, outer)
 
 
 class Aggregate(LogicalPlan):
@@ -148,6 +245,84 @@ class Aggregate(LogicalPlan):
     def _node_string(self):
         return (f"Aggregate[keys={[output_name(e) for e in self.group_exprs]},"
                 f" aggs={[a.alias for a in self.aggs]}]")
+
+
+def build_grouping_sets(group_cols, sets, aggs: List["AggExpr"],
+                        child: "LogicalPlan") -> "LogicalPlan":
+    """GROUP BY ROLLUP/CUBE/GROUPING SETS via the Expand exec.
+
+    Reference: Spark lowers grouping sets to Expand (one projection per
+    set, absent keys null-filled, plus a grouping id) before the plugin
+    replaces it with GpuExpandExec; the same rewrite lives here.
+    group_cols must be plain column references.
+    """
+    from ..expr import core as ec
+    from ..columnar import dtypes as T
+
+    key_names = [output_name(e) for e in group_cols]
+    gid_name = "__gid"
+
+    # Pre-project: every grouping key AND every aggregate input gets its
+    # own column.  Aggregate inputs must NOT read the null-filled key
+    # copies (Spark's Expand rewrite does the same separation), and
+    # expression keys become named columns here.
+    pre_exprs: List[Expression] = []
+    key_fields: List[Field] = []
+    for e, n in zip(group_cols, key_names):
+        pre_exprs.append(e if isinstance(e, ec.Alias) else ec.Alias(e, n))
+        key_fields.append(Field(n, e.dtype(), True))
+    ain_fields: List[Field] = []
+    aggs2: List[AggExpr] = []
+    for i, a in enumerate(aggs):
+        new_children = []
+        for j, chx in enumerate(a.func.children):
+            nm = f"__ain{i}_{j}"
+            pre_exprs.append(ec.Alias(chx, nm))
+            new_children.append(ec.AttributeReference(nm, chx.dtype(),
+                                                      True))
+            ain_fields.append(Field(nm, chx.dtype(), True))
+        f2 = a.func.with_children(new_children) if a.func.children \
+            else a.func
+        aggs2.append(AggExpr(f2, a.alias, a.distinct))
+    base = Project(pre_exprs, child)
+
+    projections: List[List[Expression]] = []
+    for gid, s in enumerate(sets):
+        proj: List[Expression] = []
+        for f in key_fields:
+            if f.name in s:
+                proj.append(ec.AttributeReference(f.name, f.dtype, True))
+            else:
+                proj.append(ec.Alias(ec.Literal(None, f.dtype), f.name))
+        for f in ain_fields:
+            proj.append(ec.AttributeReference(f.name, f.dtype, True))
+        proj.append(ec.Alias(ec.Literal(gid), gid_name))
+        projections.append(proj)
+    out_fields = key_fields + ain_fields + [Field(gid_name, T.INT64,
+                                                  False)]
+    expand = Expand(projections, Schema(out_fields), base)
+
+    keys2 = [ec.AttributeReference(f.name, f.dtype, True)
+             for f in key_fields]
+    keys2.append(ec.AttributeReference(gid_name, T.INT64, False))
+    agg = build_aggregate(keys2, aggs2, expand)
+    final = [ec.AttributeReference(f.name, f.dtype, True)
+             for f in key_fields]
+    final += [ec.AttributeReference(a.alias, a.func.dtype(), True)
+              for a in aggs]
+    return Project(final, agg)
+
+
+def rollup_sets(names: List[str]) -> List[tuple]:
+    return [tuple(names[:i]) for i in range(len(names), -1, -1)]
+
+
+def cube_sets(names: List[str]) -> List[tuple]:
+    import itertools
+    out = []
+    for r in range(len(names), -1, -1):
+        out.extend(itertools.combinations(names, r))
+    return out
 
 
 JOIN_TYPES = ("inner", "left", "right", "full", "semi", "anti", "cross")

@@ -501,6 +501,8 @@ class Planner:
             return CpuWindow(p, children[0])
         if isinstance(p, L.Generate):
             return X.CpuGenerate(p, children[0])
+        if isinstance(p, L.Expand):
+            return X.CpuExpand(p, children[0])
         if isinstance(p, L.CachedRelation):
             from ..exec.cache import CpuCachedExec
             return CpuCachedExec(p.storage, children[0])

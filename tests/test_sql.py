@@ -446,3 +446,129 @@ def test_staged_chain_single_node():
         assert "TpuFilter" not in tree and "TpuProject" not in tree, tree
         return []
     with_tpu_session(run)
+
+
+# -- distinct aggregates / grouping sets / correlated exists ----------------
+
+def test_count_distinct():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k, count(DISTINCT v) AS dv, count(*) AS n,
+               sum(v) AS sv, max(v) AS mx
+        FROM t1 GROUP BY k"""))
+
+
+def test_count_distinct_global_and_avg():
+    assert_tpu_and_cpu_are_equal_collect(_sql(
+        "SELECT count(DISTINCT k) FROM t1"))
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k % 3 AS g, count(DISTINCT k) AS dk, avg(x) AS ax
+        FROM t1 GROUP BY k % 3"""))
+
+
+def test_sum_distinct_dataframe():
+    from spark_rapids_tpu.api import functions as F
+
+    def fn(s):
+        df = s.create_dataframe({"g": [1, 1, 1, 2, 2],
+                                 "v": [5, 5, 7, 3, 3]})
+        return df.group_by("g").agg(
+            F.count_distinct("v").alias("dv"),
+            F.sum_distinct("v").alias("sv"))
+    rows = sorted(with_cpu_session(lambda s: fn(s).collect()))
+    assert rows == [(1, 2, 12), (2, 1, 3)]
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_rollup_sql():
+    def fn(s):
+        _tables(s)
+        return s.sql("""
+            SELECT k % 2 AS a, k % 3 AS b, sum(v) AS sv, count(*) AS n
+            FROM t1 GROUP BY ROLLUP(k % 2, k % 3)""")
+    rows = with_cpu_session(lambda s: fn(s).collect())
+    # rollup produces (a,b), (a,), and grand-total rows
+    assert any(r[0] is None and r[1] is None for r in rows)
+    assert any(r[0] is not None and r[1] is None for r in rows)
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_cube_and_grouping_sets_sql():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k % 2 AS a, k % 3 AS b, sum(v) AS sv
+        FROM t1 GROUP BY CUBE(k % 2, k % 3)"""))
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k % 2 AS a, k % 3 AS b, count(*) AS n
+        FROM t1 GROUP BY GROUPING SETS ((k % 2, k % 3), (k % 2), ())"""))
+
+
+def test_rollup_dataframe():
+    from spark_rapids_tpu.api import functions as F
+
+    def fn(s):
+        df = s.create_dataframe({"a": [1, 1, 2], "b": [1, 2, 1],
+                                 "v": [10, 20, 30]})
+        return df.rollup("a", "b").agg(F.sum("v").alias("sv"))
+    rows = sorted(with_cpu_session(lambda s: fn(s).collect()),
+                  key=lambda r: (r[0] is None, r[0] or 0,
+                                 r[1] is None, r[1] or 0))
+    assert (1, None, 30) in rows and (None, None, 60) in rows
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_correlated_exists():
+    def fn(negated):
+        def run(s):
+            _tables(s)
+            op = "NOT EXISTS" if negated else "EXISTS"
+            return s.sql(f"""
+                SELECT k, v FROM t1
+                WHERE {op} (SELECT 1 FROM t2
+                            WHERE t2.k = t1.k AND t2.w > 0.5)""")
+        return run
+    assert_tpu_and_cpu_are_equal_collect(fn(False))
+    assert_tpu_and_cpu_are_equal_collect(fn(True))
+
+
+def test_rollup_aggregate_over_key_column():
+    """Aggregate inputs must not read the null-filled key copies."""
+    def fn(s):
+        t = s.create_dataframe({"k": [1, 1, 2]})
+        t.create_or_replace_temp_view("t")
+        return s.sql(
+            "SELECT k, count(k) AS c FROM t GROUP BY ROLLUP(k)")
+    rows = with_cpu_session(lambda s: fn(s).collect())
+    assert (None, 3) in rows, rows
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_rollup_alias_and_bare_grouping_set_member():
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k % 2 AS a, sum(v) AS sv FROM t1 GROUP BY ROLLUP(a)"""))
+    assert_tpu_and_cpu_are_equal_collect(_sql("""
+        SELECT k % 2 AS a, count(*) AS n
+        FROM t1 GROUP BY GROUPING SETS (a, ())"""))
+
+
+def test_rollup_expression_keys_dataframe():
+    from spark_rapids_tpu.api import functions as F
+
+    def fn(s):
+        df = s.create_dataframe({"a": [1, 2, 3, 4], "v": [10, 20, 30, 40]})
+        return df.rollup((F.col("a") % 2).alias("x")).agg(
+            F.sum("v").alias("sv"))
+    rows = sorted(with_cpu_session(lambda s: fn(s).collect()),
+                  key=lambda r: (r[0] is None, r[0] or 0))
+    assert rows == [(0, 60), (1, 40), (None, 100)]
+    assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_count_distinct_in_rollup():
+    from spark_rapids_tpu.api import functions as F
+
+    def fn(s):
+        df = s.create_dataframe({"g": [1, 1, 2], "v": [5, 5, 7]})
+        return df.rollup("g").agg(dv=F.count_distinct("v"))
+    rows = sorted(with_cpu_session(lambda s: fn(s).collect()),
+                  key=lambda r: (r[0] is None, r[0] or 0))
+    assert rows == [(1, 1), (2, 1), (None, 2)]
+    assert_tpu_and_cpu_are_equal_collect(fn)
