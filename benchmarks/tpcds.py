@@ -6,7 +6,8 @@ sweep, local shuffle".  This module generates the TPC-DS star schema
 dimensions) at a row-scaled factor, writes Parquet, registers the tables
 as temp views, and runs real TPC-DS query texts (Q3, Q7, Q19, Q42, Q52,
 Q55, Q96, Q98 — the star-join/agg/window shapes) through
-``session.sql()`` on either engine.
+``session.sql()`` on either engine.  Q27 exercises ROLLUP + grouping();
+Q98 exercises window-over-aggregate.
 
 Usage:
   python benchmarks/tpcds.py --scale 0.01 --engine tpu
@@ -232,10 +233,9 @@ QUERIES = {
         group by i_brand_id, i_brand
         order by ext_price desc, brand_id
         limit 100""",
-    # TPC-DS Q27 (adapted: grouping() indicator column omitted):
-    # demographic item/state averages with ROLLUP subtotals
+    # TPC-DS Q27: demographic item/state averages with ROLLUP subtotals
     "q27": """
-        select i_item_id, s_state,
+        select i_item_id, s_state, grouping(s_state) g_state,
                avg(ss_quantity) agg1, avg(ss_list_price) agg2,
                avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
         from store_sales, customer_demographics, date_dim, store, item

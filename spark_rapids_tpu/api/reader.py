@@ -54,6 +54,7 @@ class DataFrameWriter:
         self.df = df
         self._mode = "overwrite"
         self._options: Dict[str, object] = {}
+        self._partition_by: List[str] = []
 
     def mode(self, m: str) -> "DataFrameWriter":
         self._mode = m
@@ -63,9 +64,18 @@ class DataFrameWriter:
         self._options[key] = value
         return self
 
+    def partition_by(self, *cols: str) -> "DataFrameWriter":
+        """Hive-style dynamic partitioning: one col=value directory per
+        key combination (reference: GpuFileFormatWriter dynamic
+        partitioning)."""
+        self._partition_by = list(cols)
+        return self
+
+    partitionBy = partition_by
+
     def _write(self, fmt: str, path: str):
         plan = L.WriteFile(fmt, path, self.df._plan, self._mode,
-                           self._options)
+                           self._options, self._partition_by)
         phys = self.df.session._plan(plan)
         for part in phys.execute():
             for _ in part:

@@ -1280,8 +1280,43 @@ class _Lowerer:
                                 "GROUP BY")
                         members.append(name_of[k])
                     sets.append(tuple(members))
+                # grouping(col) -> 1 on subtotal rows where col is
+                # rolled up, else 0 (computed from the expand set id)
+                grouping_calls = []
+                for root in item_asts + [o.e for o in order_asts] + \
+                        ([having_ast] if having_ast is not None else []):
+                    for nd in _walk(root):
+                        if isinstance(nd, Func) and \
+                                nd.fname == "grouping" and \
+                                len(nd.args) == 1 and \
+                                not any(nd == g for g in grouping_calls):
+                            grouping_calls.append(nd)
+                gsub = {}
+                for gc in grouping_calls:
+                    k = subst.get(gc.args[0])
+                    if k is None:
+                        k = canon(gc.args[0])
+                    nm = name_of.get(k)
+                    if nm is None:
+                        raise SqlError(
+                            "grouping() argument must be a GROUP BY key")
+                    rolled = tuple(Lit(i) for i, st in enumerate(sets)
+                                   if nm not in st)
+                    gsub[gc] = Case(None, ((InList(Res("__gid"), rolled),
+                                            Lit(1)),), Lit(0))
+
+                def rwg(ast: Ast) -> Ast:
+                    def fn(n):
+                        return gsub.get(n, n)
+                    return _transform(ast, fn)
+                if gsub:
+                    item_asts = [rwg(a) for a in item_asts]
+                    order_asts = [dataclasses.replace(o, e=rwg(o.e))
+                                  for o in order_asts]
+                    if having_ast is not None:
+                        having_ast = rwg(having_ast)
                 plan = L.build_grouping_sets(group_exprs, sets, aggs,
-                                             plan)
+                                             plan, keep_gid=bool(gsub))
             else:
                 plan = L.build_aggregate(group_exprs, aggs, plan)
             scope = _Scope.of(plan.schema)

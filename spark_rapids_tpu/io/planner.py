@@ -21,7 +21,8 @@ from ..exec.base import PhysicalPlan, NUM_OUTPUT_ROWS
 from ..exec.cpu import CpuExec
 from ..exec.tpu_basic import TpuExec
 from ..plan import logical as L
-from .readers import (FilePartitionReader, expand_paths,
+from .readers import (FilePartitionReader,
+                      expand_paths_with_partitions,
                       split_files_into_partitions)
 
 
@@ -41,11 +42,13 @@ class TpuFileScan(TpuExec):
         super().__init__()
         self.logical = logical
         self.conf = conf
-        self.files = expand_paths(logical.paths)
+        self.files = expand_paths_with_partitions(logical.paths)
         self.strategy = _strategy(logical.fmt, conf)
         self._partitions = split_files_into_partitions(
             self.files, conf.get(SHUFFLE_PARTITIONS))
         self.pushed_filters = None
+        self._part_dtypes = {f.name: f.dtype
+                             for f in logical.schema.fields}
 
     def set_pushed_filters(self, filters):
         """Planner-pushed predicate (GpuParquetScan pushdown role)."""
@@ -72,7 +75,8 @@ class TpuFileScan(TpuExec):
                 strategy=self.strategy,
                 num_threads=self.conf.get(MULTITHREAD_READ_THREADS),
                 options=self.logical.options,
-                pushed_filters=self.pushed_filters)
+                pushed_filters=self.pushed_filters,
+                partition_dtypes=self._part_dtypes)
             for table in reader:
                 pos = 0
                 n = table.num_rows
@@ -92,9 +96,11 @@ class CpuFileScan(CpuExec):
         super().__init__()
         self.logical = logical
         self.conf = conf
-        self.files = expand_paths(logical.paths)
+        self.files = expand_paths_with_partitions(logical.paths)
         self._partitions = split_files_into_partitions(
             self.files, conf.get(SHUFFLE_PARTITIONS))
+        self._part_dtypes = {f.name: f.dtype
+                             for f in logical.schema.fields}
 
     @property
     def output_schema(self):
@@ -105,8 +111,9 @@ class CpuFileScan(CpuExec):
 
     def execute(self):
         def run(files):
-            reader = FilePartitionReader(self.logical.fmt, files,
-                                         options=self.logical.options)
+            reader = FilePartitionReader(
+                self.logical.fmt, files, options=self.logical.options,
+                partition_dtypes=self._part_dtypes)
             for t in reader:
                 yield t
         return [run(files) for files in self._partitions]
@@ -141,9 +148,15 @@ class TpuFileWrite(TpuExec):
         lg = self.logical
         os.makedirs(lg.path, exist_ok=True)
         if lg.mode == "overwrite":
+            import shutil
             for f in os.listdir(lg.path):
+                full = os.path.join(lg.path, f)
                 if f.startswith("part-"):
-                    os.unlink(os.path.join(lg.path, f))
+                    os.unlink(full)
+                elif "=" in f and os.path.isdir(full):
+                    # stale partition dirs from a previous partitioned
+                    # write must go even if THIS write is unpartitioned
+                    shutil.rmtree(full)
         parts = self.children[0].execute()
         arrow_schema = schema_to_arrow(self.children[0].output_schema)
 
@@ -151,8 +164,12 @@ class TpuFileWrite(TpuExec):
             tables = [to_arrow(b) for b in part if b.num_rows > 0]
             table = pa.concat_tables(tables) if tables else \
                 arrow_schema.empty_table()
-            _write_table(lg.fmt, table,
-                         os.path.join(lg.path, f"part-{i:05d}"))
+            if lg.partition_by:
+                _write_partitioned(lg.fmt, table, lg.path,
+                                   lg.partition_by, i)
+            else:
+                _write_table(lg.fmt, table,
+                             os.path.join(lg.path, f"part-{i:05d}"))
             self.metrics[NUM_OUTPUT_ROWS] += table.num_rows
             return iter(())
         return [run(i, p) for i, p in enumerate(parts)]
@@ -172,9 +189,15 @@ class CpuFileWrite(CpuExec):
         lg = self.logical
         os.makedirs(lg.path, exist_ok=True)
         if lg.mode == "overwrite":
+            import shutil
             for f in os.listdir(lg.path):
+                full = os.path.join(lg.path, f)
                 if f.startswith("part-"):
-                    os.unlink(os.path.join(lg.path, f))
+                    os.unlink(full)
+                elif "=" in f and os.path.isdir(full):
+                    # stale partition dirs from a previous partitioned
+                    # write must go even if THIS write is unpartitioned
+                    shutil.rmtree(full)
         parts = self.children[0].execute()
         arrow_schema = schema_to_arrow(self.children[0].output_schema)
 
@@ -182,10 +205,44 @@ class CpuFileWrite(CpuExec):
             tables = list(part)
             table = pa.concat_tables(tables) if tables else \
                 arrow_schema.empty_table()
-            _write_table(lg.fmt, table,
-                         os.path.join(lg.path, f"part-{i:05d}"))
+            if lg.partition_by:
+                _write_partitioned(lg.fmt, table, lg.path,
+                                   lg.partition_by, i)
+            else:
+                _write_table(lg.fmt, table,
+                             os.path.join(lg.path, f"part-{i:05d}"))
             return iter(())
         return [run(i, p) for i, p in enumerate(parts)]
+
+
+def _write_partitioned(fmt: str, table: pa.Table, root: str,
+                       part_cols, task_id: int):
+    """Hive-layout dynamic partitioned write: one file per key combo."""
+    import pyarrow.compute as pc
+    data_cols = [c for c in table.column_names if c not in part_cols]
+    keys = table.select(part_cols)
+    combos = keys.group_by(part_cols).aggregate([])
+    for row in range(combos.num_rows):
+        mask = None
+        comps = []
+        for c in part_cols:
+            v = combos.column(c)[row]
+            eq = pc.is_null(table.column(c)) if not v.is_valid else \
+                pc.equal(table.column(c), v)
+            eq = pc.fill_null(eq, False)
+            mask = eq if mask is None else pc.and_(mask, eq)
+            if not v.is_valid:
+                sval = "__HIVE_DEFAULT_PARTITION__"
+            else:
+                from urllib.parse import quote
+                # escape path separators/metacharacters (Spark's
+                # escapePathName role)
+                sval = quote(str(v.as_py()), safe="")
+            comps.append(f"{c}={sval}")
+        sub = table.filter(mask).select(data_cols)
+        d = os.path.join(root, *comps)
+        os.makedirs(d, exist_ok=True)
+        _write_table(fmt, sub, os.path.join(d, f"part-{task_id:05d}"))
 
 
 def _write_table(fmt: str, table: pa.Table, base: str):

@@ -34,18 +34,38 @@ from ..columnar.arrow import from_arrow, schema_from_arrow
 from ..columnar.schema import Schema
 
 
-def expand_paths(paths: List[str]) -> List[str]:
-    out: List[str] = []
+def expand_paths_with_partitions(paths: List[str]):
+    """Expand dirs/globs to files with Hive-style ``key=value`` directory
+    components decoded as partition values (reference:
+    ColumnarPartitionReaderWithPartitionValues — partition values are
+    appended as columns after the file read)."""
+    out = []
     for p in paths:
         if os.path.isdir(p):
-            for f in sorted(os.listdir(p)):
-                if not f.startswith(("_", ".")):
-                    out.append(os.path.join(p, f))
+            for root, dirs, files in os.walk(p):
+                dirs.sort()
+                pvals = {}
+                rel = os.path.relpath(root, p)
+                if rel != ".":
+                    from urllib.parse import unquote
+                    for comp in rel.split(os.sep):
+                        if "=" in comp:
+                            k, v = comp.split("=", 1)
+                            pvals[k] = None \
+                                if v == "__HIVE_DEFAULT_PARTITION__" \
+                                else unquote(v)
+                for f in sorted(files):
+                    if not f.startswith(("_", ".")):
+                        out.append((os.path.join(root, f), pvals))
         elif any(ch in p for ch in "*?["):
-            out.extend(sorted(globmod.glob(p)))
+            out.extend((f, {}) for f in sorted(globmod.glob(p)))
         else:
-            out.append(p)
+            out.append((p, {}))
     return out
+
+
+def expand_paths(paths: List[str]) -> List[str]:
+    return [f for f, _ in expand_paths_with_partitions(paths)]
 
 
 def _read_file(fmt: str, path: str, columns: Optional[List[str]] = None,
@@ -82,40 +102,100 @@ def _read_file(fmt: str, path: str, columns: Optional[List[str]] = None,
     raise ValueError(f"unknown format {fmt}")
 
 
+def _partition_fields(pairs) -> List:
+    """Infer partition-column fields from Hive path values (int64 when
+    every value parses as an integer, else string)."""
+    from ..columnar.schema import Field
+    from ..columnar import dtypes as T
+    keys: List[str] = []
+    values: dict = {}
+    for _, pvals in pairs:
+        for k, v in pvals.items():
+            if k not in values:
+                keys.append(k)
+                values[k] = []
+            values[k].append(v)
+    fields = []
+    for k in keys:
+        dt = T.INT64
+        for v in values[k]:
+            if v is None:
+                continue
+            try:
+                int(v)
+            except ValueError:
+                dt = T.STRING
+                break
+        fields.append(Field(k, dt, True))
+    return fields
+
+
 def infer_schema(fmt: str, paths: List[str], options=None) -> Schema:
-    files = expand_paths(paths)
-    if not files:
+    pairs = expand_paths_with_partitions(paths)
+    if not pairs:
         raise FileNotFoundError(f"no files match {paths}")
+    first = pairs[0][0]
     if fmt == "parquet":
-        return schema_from_arrow(papq.read_schema(files[0]))
-    t = _read_file(fmt, files[0], options=options)
-    return schema_from_arrow(t.schema)
+        base = schema_from_arrow(papq.read_schema(first))
+    else:
+        base = schema_from_arrow(
+            _read_file(fmt, first, options=options).schema)
+    pf = _partition_fields(pairs)
+    if not pf:
+        return base
+    names = set(base.names)
+    return Schema(list(base.fields) +
+                  [f for f in pf if f.name not in names])
 
 
 class FilePartitionReader:
     """Iterator of host arrow tables for a set of files under a strategy."""
 
-    def __init__(self, fmt: str, files: List[str],
+    def __init__(self, fmt: str, files: List,
                  columns: Optional[List[str]] = None,
                  strategy: str = "PERFILE", num_threads: int = 4,
                  coalesce_target_rows: int = 1 << 20, options=None,
-                 pushed_filters=None):
+                 pushed_filters=None, partition_dtypes=None):
         self.fmt = fmt
-        self.files = files
+        # files: plain paths or (path, {partition_col: raw_value}) pairs
+        self.files = [(f, {}) if isinstance(f, str) else f for f in files]
         self.columns = columns
         self.strategy = strategy
         self.num_threads = num_threads
         self.coalesce_target_rows = coalesce_target_rows
         self.options = options
         self.pushed_filters = pushed_filters
+        self.partition_dtypes = partition_dtypes or {}
 
-    def _read(self, path: str) -> pa.Table:
+    def _read(self, pair) -> pa.Table:
+        path, pvals = pair
         if self.fmt == "parquet" and self.pushed_filters:
             import pyarrow.parquet as papq
-            return papq.read_table(path, columns=self.columns,
-                                   use_threads=False,
-                                   filters=self.pushed_filters)
-        return _read_file(self.fmt, path, self.columns, self.options)
+            try:
+                t = papq.read_table(path, columns=self.columns,
+                                    use_threads=False,
+                                    filters=self.pushed_filters)
+            except Exception:
+                # e.g. a pushed predicate on a partition column that is
+                # not in the file: fall back to the plain read
+                t = _read_file(self.fmt, path, self.columns, self.options)
+        else:
+            t = _read_file(self.fmt, path, self.columns, self.options)
+        for k, v in pvals.items():
+            if k in t.column_names:
+                continue
+            dt = self.partition_dtypes.get(k)
+            from ..columnar.arrow import to_arrow_type
+            at = to_arrow_type(dt) if dt is not None else pa.string()
+            if v is None:
+                val = None
+            elif pa.types.is_integer(at):
+                val = int(v)
+            else:
+                val = v
+            t = t.append_column(
+                k, pa.array([val] * t.num_rows, type=at))
+        return t
 
     def __iter__(self) -> Iterator[pa.Table]:
         if self.strategy == "MULTITHREADED" and len(self.files) > 1:
@@ -152,10 +232,14 @@ class FilePartitionReader:
             yield pa.concat_tables(pending, promote_options="permissive")
 
 
-def split_files_into_partitions(files: List[str],
-                                num_partitions: int) -> List[List[str]]:
-    """Greedy size-balanced assignment of files to partitions."""
-    sizes = [(f, os.path.getsize(f) if os.path.exists(f) else 0)
+def split_files_into_partitions(files: List,
+                                num_partitions: int) -> List[List]:
+    """Greedy size-balanced assignment of files to partitions (accepts
+    plain paths or (path, partition_values) pairs)."""
+    def path_of(f):
+        return f[0] if isinstance(f, tuple) else f
+    sizes = [(f, os.path.getsize(path_of(f))
+              if os.path.exists(path_of(f)) else 0)
              for f in files]
     sizes.sort(key=lambda x: -x[1])
     num_partitions = max(1, min(num_partitions, len(files) or 1))

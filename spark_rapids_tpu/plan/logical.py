@@ -248,7 +248,8 @@ class Aggregate(LogicalPlan):
 
 
 def build_grouping_sets(group_cols, sets, aggs: List["AggExpr"],
-                        child: "LogicalPlan") -> "LogicalPlan":
+                        child: "LogicalPlan",
+                        keep_gid: bool = False) -> "LogicalPlan":
     """GROUP BY ROLLUP/CUBE/GROUPING SETS via the Expand exec.
 
     Reference: Spark lowers grouping sets to Expand (one projection per
@@ -310,6 +311,9 @@ def build_grouping_sets(group_cols, sets, aggs: List["AggExpr"],
              for f in key_fields]
     final += [ec.AttributeReference(a.alias, a.func.dtype(), True)
               for a in aggs]
+    if keep_gid:
+        # grouping() indicator expressions read the set id downstream
+        final.append(ec.AttributeReference(gid_name, T.INT64, False))
     return Project(final, agg)
 
 
@@ -574,11 +578,13 @@ class CachedRelation(LogicalPlan):
 
 class WriteFile(LogicalPlan):
     def __init__(self, fmt: str, path: str, child: LogicalPlan,
-                 mode: str = "overwrite", options: Dict[str, Any] = None):
+                 mode: str = "overwrite", options: Dict[str, Any] = None,
+                 partition_by: Optional[List[str]] = None):
         self.fmt = fmt
         self.path = path
         self.mode = mode
         self.options = options or {}
+        self.partition_by = list(partition_by or [])
         self.children = [child]
 
     @property

@@ -110,3 +110,107 @@ class TestPushdown:
         assert_tpu_and_cpu_are_equal_collect(
             lambda s: s.read.parquet(pq_dir).filter(
                 (F.col("i") > 0) & (F.col("k") < 5)))
+
+
+class TestPartitionedIO:
+    """Hive-style partitioned writes + partition-column discovery reads.
+
+    Reference: GpuFileFormatWriter dynamic partitioning +
+    ColumnarPartitionReaderWithPartitionValues (SURVEY.md §2.6)."""
+
+    def _df(self, s):
+        import numpy as np
+        rng = np.random.default_rng(9)
+        return s.create_dataframe({
+            "year": rng.choice([2020, 2021], 40).astype("int64"),
+            "cat": rng.choice(["a", "b"], 40),
+            "v": rng.integers(0, 100, 40).astype("int64"),
+        })
+
+    def test_partitioned_write_layout(self, tmp_path):
+        from harness import with_tpu_session
+        out = str(tmp_path / "p")
+
+        def run(s):
+            self._df(s).write.partition_by("year", "cat").parquet(out)
+            return []
+        with_tpu_session(run)
+        import os
+        years = sorted(d for d in os.listdir(out) if d.startswith("year="))
+        assert years == ["year=2020", "year=2021"]
+        assert any(d.startswith("cat=") for d in
+                   os.listdir(os.path.join(out, years[0])))
+
+    def test_partitioned_roundtrip_both_engines(self, tmp_path):
+        from harness import (assert_tpu_and_cpu_are_equal_collect,
+                             with_cpu_session)
+        out = str(tmp_path / "rt")
+
+        def write(s):
+            self._df(s).write.partition_by("year").parquet(out)
+            return []
+        with_cpu_session(write)
+
+        def read(s):
+            df = s.read.parquet(out)
+            # partition col is discovered and appended, typed int64
+            assert df.schema["year"].dtype.name == "bigint" or \
+                df.schema["year"].dtype.name == "long", df.schema
+            return df.group_by("year").count()
+        assert_tpu_and_cpu_are_equal_collect(read)
+
+    def test_partition_pruning_filter(self, tmp_path):
+        from harness import assert_tpu_and_cpu_are_equal_collect, \
+            with_cpu_session
+        out = str(tmp_path / "pr")
+
+        def write(s):
+            self._df(s).write.partition_by("cat").parquet(out)
+            return []
+        with_cpu_session(write)
+        from spark_rapids_tpu.api import functions as F
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: s.read.parquet(out).filter(F.col("cat") == "a")
+            .group_by("cat").count())
+
+    def test_unpartitioned_overwrite_clears_partition_dirs(self, tmp_path):
+        from harness import with_cpu_session
+        out = str(tmp_path / "ow")
+
+        def run(s):
+            self._df(s).write.partition_by("cat").parquet(out)
+            small = s.create_dataframe({"cat": ["c"], "v": [9]})
+            small.write.parquet(out)
+            return s.read.parquet(out).collect()
+        rows = with_cpu_session(run)
+        assert rows == [("c", 9)], rows
+
+    def test_null_and_special_partition_values(self, tmp_path):
+        from harness import with_cpu_session
+        out = str(tmp_path / "np")
+
+        def run(s):
+            df = s.create_dataframe({"year": [2020, 2021, None],
+                                     "v": [1, 2, 3]})
+            df.write.partition_by("year").parquet(out)
+            got = sorted(s.read.parquet(out).select("v", "year").collect())
+            assert got == [(1, 2020), (2, 2021), (3, None)], got
+            df2 = s.create_dataframe({"cat": ["a/b", "c"], "v": [1, 2]})
+            df2.write.partition_by("cat").parquet(str(tmp_path / "sp"))
+            got2 = sorted(s.read.parquet(str(tmp_path / "sp"))
+                          .select("v", "cat").collect())
+            assert got2 == [(1, "a/b"), (2, "c")], got2
+            return []
+        with_cpu_session(run)
+
+    def test_mixed_partition_value_types_infer_string(self, tmp_path):
+        from harness import with_cpu_session
+        out = str(tmp_path / "mx")
+
+        def run(s):
+            df = s.create_dataframe({"k": ["0", "abc"], "v": [1, 2]})
+            df.write.partition_by("k").parquet(out)
+            got = sorted(s.read.parquet(out).select("v", "k").collect())
+            assert got == [(1, "0"), (2, "abc")], got
+            return []
+        with_cpu_session(run)

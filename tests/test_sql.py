@@ -572,3 +572,20 @@ def test_count_distinct_in_rollup():
                   key=lambda r: (r[0] is None, r[0] or 0))
     assert rows == [(1, 1), (2, 1), (None, 2)]
     assert_tpu_and_cpu_are_equal_collect(fn)
+
+
+def test_grouping_indicator_function():
+    """grouping(col): 1 on subtotal rows where col is rolled up."""
+    def fn(s):
+        t = s.create_dataframe({"a": [1, 1, 2], "b": [1, 2, 1],
+                                "v": [10, 20, 30]})
+        t.create_or_replace_temp_view("t")
+        return s.sql("""
+            SELECT a, b, grouping(a) AS ga, grouping(b) AS gb,
+                   sum(v) AS sv
+            FROM t GROUP BY ROLLUP(a, b)
+            ORDER BY ga, gb, a, b""")
+    rows = with_cpu_session(lambda s: fn(s).collect())
+    assert (None, None, 1, 1, 60) in rows
+    assert all(r[2] == 0 for r in rows if r[0] is not None)
+    assert_tpu_and_cpu_are_equal_collect(fn, ignore_order=False)
