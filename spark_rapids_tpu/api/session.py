@@ -160,6 +160,19 @@ class TpuSession:
         """Run a logical plan and collect everything as one arrow table."""
         import time as _time
         from ..columnar.arrow import to_arrow, schema_to_arrow
+        from ..config import PROFILE_TRACE_DIR
+        trace_dir = self.conf.get(PROFILE_TRACE_DIR)
+        if trace_dir:
+            # xprof trace of the whole query — the NVTX+Nsight role
+            # (SURVEY.md §5); view with tensorboard / xprof
+            import jax
+            with jax.profiler.trace(trace_dir):
+                return self._execute_to_arrow_inner(logical)
+        return self._execute_to_arrow_inner(logical)
+
+    def _execute_to_arrow_inner(self, logical: L.LogicalPlan) -> pa.Table:
+        import time as _time
+        from ..columnar.arrow import to_arrow, schema_to_arrow
         t0 = _time.perf_counter()
         phys = self._plan(logical)
         self.last_physical_plan = phys

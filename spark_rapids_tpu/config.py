@@ -219,6 +219,17 @@ SHIM_PROVIDER_OVERRIDE = conf_str(
     "spark.rapids.tpu.shims-provider-override", "",
     "Force a specific compat shim (reference: "
     "spark.rapids.shims-provider-override)")
+SHUFFLE_MODE = conf_str(
+    "spark.rapids.tpu.shuffle.mode", "inprocess",
+    "Distributed exchange strategy: 'inprocess' (catalog-backed shuffle "
+    "manager) or 'mesh' (aggregations lower to ONE SPMD program over the "
+    "jax.sharding.Mesh: hash-routed lax.all_to_all over ICI in place of "
+    "the transport; reference: RapidsShuffleManager over UCX)")
+PROFILE_TRACE_DIR = conf_str(
+    "spark.rapids.tpu.profile.traceDir", "",
+    "Capture an XLA/jax profiler trace (xprof / trace-viewer format) "
+    "of each query execution into this directory (reference: NVTX "
+    "ranges + Nsight, docs/dev/nvtx_profiling.md)")
 
 
 class TpuConf:

@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Type
 from ..columnar import dtypes as T
 from ..config import (TpuConf, SQL_ENABLED, EXPLAIN, SHUFFLE_PARTITIONS,
                       TEST_ENABLED, DECIMAL_ENABLED, CAST_STRING_TO_FLOAT,
-                      BATCH_SIZE_ROWS, ADAPTIVE_ENABLED,
+                      BATCH_SIZE_ROWS, SHUFFLE_MODE, ADAPTIVE_ENABLED,
                       ADAPTIVE_TARGET_PARTITION_BYTES,
                       ADAPTIVE_BROADCAST_BYTES, ADAPTIVE_SKEW_FACTOR,
                       ADAPTIVE_SKEW_MIN_BYTES)
@@ -623,8 +623,27 @@ class Planner:
             exchange, self.conf.get(ADAPTIVE_TARGET_PARTITION_BYTES))
 
     # -- aggregate: partial -> exchange -> final (aggregate.scala modes) ---
+    def _plan_aggregate_mesh(self, p: L.Aggregate, child):
+        """shuffle.mode=mesh: the whole group-by as one SPMD program
+        (exec/tpu_mesh_aggregate.py) when the shapes allow it."""
+        import jax
+        from ..exec.tpu_mesh_aggregate import (TpuMeshAggregate,
+                                               mesh_aggregate_supported)
+        if self.conf.get(SHUFFLE_MODE) != "mesh":
+            return None
+        try:
+            n_dev = jax.device_count()
+        except Exception:
+            return None
+        if not mesh_aggregate_supported(p, n_dev):
+            return None
+        return TpuMeshAggregate(p, child)
+
     def _plan_aggregate(self, p: L.Aggregate,
                         child: PhysicalPlan) -> PhysicalPlan:
+        mesh_plan = self._plan_aggregate_mesh(p, child)
+        if mesh_plan is not None:
+            return mesh_plan
         nparts = child.num_partitions_hint()
         if nparts <= 1:
             return TA.TpuHashAggregate(p.group_exprs, p.aggs, child,

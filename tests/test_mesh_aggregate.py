@@ -1,0 +1,79 @@
+"""Mesh-mode distributed aggregation: the whole group-by as one SPMD
+program over the virtual 8-device CPU mesh.
+
+Reference: BASELINE.json config 4 (RapidsShuffleManager over multi-host
+ICI) — here the partial-agg -> shuffle -> final-agg pipeline is a single
+shard_map program with lax.all_to_all (exec/tpu_mesh_aggregate.py).
+"""
+import numpy as np
+import pytest
+
+from harness import with_cpu_session, with_tpu_session
+
+MESH_CONF = {"spark.rapids.tpu.shuffle.mode": "mesh"}
+
+
+def _df(s, n=4000):
+    rng = np.random.default_rng(12)
+    return s.create_dataframe({
+        "k": rng.integers(0, 50, n).astype(np.int64),
+        "v": rng.integers(-100, 100, n).astype(np.int64),
+        "x": rng.random(n),
+    }, num_partitions=4)
+
+
+def _agg(s):
+    from spark_rapids_tpu.api import functions as F
+    return _df(s).group_by("k").agg(
+        F.sum("v").alias("sv"), F.count().alias("n"),
+        F.min("v").alias("mn"), F.max("x").alias("mx"),
+        F.avg("x").alias("ax"))
+
+
+def test_mesh_aggregate_matches_cpu():
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device mesh")
+    cpu = sorted(with_cpu_session(lambda s: _agg(s).collect()))
+    tpu = sorted(with_tpu_session(lambda s: _agg(s).collect(),
+                                  conf=MESH_CONF))
+    assert len(cpu) == len(tpu)
+    for a, b in zip(cpu, tpu):
+        for x, y in zip(a, b):
+            if isinstance(x, float):
+                assert abs(x - y) <= 1e-9 * max(1.0, abs(x)), (a, b)
+            else:
+                assert x == y, (a, b)
+
+
+def test_mesh_aggregate_planned():
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device mesh")
+
+    def run(s):
+        df = _agg(s)
+        df.collect()
+        tree = df._last_physical_plan.tree_string()
+        assert "TpuMeshAggregate" in tree, tree
+        return []
+    with_tpu_session(run, conf=MESH_CONF)
+
+
+def test_mesh_aggregate_nulls_and_sql():
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device mesh")
+
+    def fn(s):
+        df = s.create_dataframe(
+            {"k": [1, 1, None, 2, None], "v": [10, 20, 30, 40, None]},
+            num_partitions=2)
+        df.create_or_replace_temp_view("t")
+        return s.sql("SELECT k, sum(v) AS sv, count(*) AS n "
+                     "FROM t GROUP BY k").collect()
+    cpu = sorted(with_cpu_session(fn),
+                 key=lambda r: (r[0] is None, r[0] or 0))
+    tpu = sorted(with_tpu_session(fn, conf=MESH_CONF),
+                 key=lambda r: (r[0] is None, r[0] or 0))
+    assert cpu == tpu

@@ -90,3 +90,23 @@ class TestExplainAndExport:
                              frame=("range", None, 0))
         with pytest.raises(AssertionError):
             bad.collect()
+
+
+def test_profile_trace_dir(tmp_path):
+    """spark.rapids.tpu.profile.traceDir captures an xprof trace
+    (reference: NVTX ranges + Nsight, SURVEY.md §5)."""
+    import os
+    from harness import with_tpu_session
+    d = str(tmp_path / "trace")
+
+    def run(s):
+        s.set_conf("spark.rapids.tpu.profile.traceDir", d)
+        df = s.create_dataframe({"a": [1, 2, 3]})
+        from spark_rapids_tpu.api import functions as F
+        df.agg(F.sum("a").alias("s")).collect()
+        return []
+    with_tpu_session(run)
+    found = []
+    for root, _, files in os.walk(d):
+        found.extend(files)
+    assert found, "no trace files captured"
