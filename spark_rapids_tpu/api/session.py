@@ -173,15 +173,24 @@ class TpuSession:
     def _execute_to_arrow_inner(self, logical: L.LogicalPlan) -> pa.Table:
         import time as _time
         from ..columnar.arrow import to_arrow, schema_to_arrow
+        from ..columnar.arrow import stage_batch
         t0 = _time.perf_counter()
         phys = self._plan(logical)
         self.last_physical_plan = phys
+        # drain all partitions first (device work + staged pulls), then one
+        # fused flush serves every batch's counts/buffers (columnar/pending)
+        from ..columnar.batch import resolve_speculative
+        items = [item if isinstance(item, pa.Table)
+                 else resolve_speculative(item)
+                 for part in phys.execute() for item in part]
+        for item in items:
+            if not isinstance(item, pa.Table):
+                stage_batch(item)
         tables: List[pa.Table] = []
-        for part in phys.execute():
-            for item in part:
-                t = item if isinstance(item, pa.Table) else to_arrow(item)
-                if t.num_rows:
-                    tables.append(t)
+        for item in items:
+            t = item if isinstance(item, pa.Table) else to_arrow(item)
+            if t.num_rows:
+                tables.append(t)
         self._log_query(phys, (_time.perf_counter() - t0) * 1000)
         target = schema_to_arrow(phys.output_schema) if len(
             phys.output_schema) else None

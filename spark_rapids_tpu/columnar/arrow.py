@@ -98,8 +98,8 @@ def schema_to_arrow(schema: Schema) -> pa.Schema:
 def column_to_arrow(col: Column, num_rows: int) -> pa.Array:
     from .column import ListColumn, MapColumn, StructColumn
     if isinstance(col, MapColumn):
-        offs = np.asarray(col.offsets)[:num_rows + 1].astype(np.int64)
-        valid = np.asarray(col.validity)[:num_rows]
+        offs = col._hnp("offsets")[:num_rows + 1].astype(np.int64)
+        valid = col._hnp("validity")[:num_rows]
         n_elems = int(offs[num_rows]) if num_rows else 0
         keys = column_to_arrow(col.keys, n_elems)
         items = column_to_arrow(col.values, n_elems)
@@ -111,14 +111,14 @@ def column_to_arrow(col: Column, num_rows: int) -> pa.Array:
                  for i in range(num_rows + 1)], type=pa.int32())
         return pa.MapArray.from_arrays(arrow_offs, keys, items)
     if isinstance(col, StructColumn):
-        valid = np.asarray(col.validity)[:num_rows]
+        valid = col._hnp("validity")[:num_rows]
         kids = [column_to_arrow(c, num_rows) for c in col.children]
         names = [f.name for f in col.dtype.fields]
         return pa.StructArray.from_arrays(
             kids, names, mask=pa.array(~valid, type=pa.bool_()))
     if isinstance(col, ListColumn):
-        offs = np.asarray(col.offsets)[:num_rows + 1].astype(np.int64)
-        valid = np.asarray(col.validity)[:num_rows]
+        offs = col._hnp("offsets")[:num_rows + 1].astype(np.int64)
+        valid = col._hnp("validity")[:num_rows]
         n_elems = int(offs[num_rows]) if num_rows else 0
         values = column_to_arrow(col.elements, n_elems)
         if valid.all():
@@ -153,8 +153,17 @@ def column_to_arrow(col: Column, num_rows: int) -> pa.Array:
 
 
 def to_arrow(batch: ColumnarBatch) -> pa.Table:
+    stage_batch(batch)
     arrays = [column_to_arrow(c, batch.num_rows) for c in batch.columns]
     return pa.Table.from_arrays(arrays, schema=schema_to_arrow(batch.schema))
+
+
+def stage_batch(batch: ColumnarBatch):
+    """Stage every device buffer of a batch for one fused host pull —
+    callers converting several batches stage them all first so counts,
+    validity and data cross the wire in a single transfer."""
+    for c in batch.columns:
+        c.stage_host()
 
 
 def column_from_arrow(arr: pa.ChunkedArray | pa.Array,

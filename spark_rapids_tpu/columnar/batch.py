@@ -20,34 +20,13 @@ from .column import Column, StringColumn, bucket_capacity
 from .schema import Field, Schema
 
 
-import weakref
-
-# Unresolved lazy counts/arrays, flushed together: on this backend every
-# device->host pull is a remote-execution round trip (~100ms fixed +
-# execution of the pulled graph), and pulling N values in one fused
-# transfer costs ~one round trip instead of N (measured 5x).
-_PENDING: List["weakref.ref"] = []
+from . import pending
 
 
 def _flush_pending():
-    global _PENDING
-    items = []
-    for w in _PENDING:
-        x = w()
-        if x is not None and x._val is None:
-            items.append(x)
-    _PENDING = []
-    if not items:
-        return
-    parts = [jnp.ravel(jnp.asarray(x.dev)).astype(jnp.int64)
-             for x in items]
-    sizes = [p.shape[0] for p in parts]
-    flat = np.asarray(jnp.concatenate(parts) if len(parts) > 1
-                      else parts[0])
-    off = 0
-    for x, sz in zip(items, sizes):
-        x._resolve(flat[off:off + sz])
-        off += sz
+    """Resolve every staged host value in one fused transfer
+    (columnar/pending.py)."""
+    pending.flush()
 
 
 class LazyCount:
@@ -58,23 +37,21 @@ class LazyCount:
     ``int(count)`` pulls the dominant cost of small queries.  Execs
     producing data-dependent row counts (filter, group count, join size)
     wrap the device scalar in a LazyCount; the first forced value
-    resolves EVERY outstanding lazy count in one fused transfer.
+    resolves EVERY outstanding staged pull (counts, bincounts, output
+    buffers — columnar/pending.py) in one fused transfer.
     """
-    __slots__ = ("dev", "_val", "__weakref__")
+    __slots__ = ("dev", "_staged", "_val")
 
     def __init__(self, dev):
         self.dev = dev
+        self._staged = pending.stage(jnp.ravel(jnp.asarray(dev)))
         self._val: Optional[int] = None
-        _PENDING.append(weakref.ref(self))
-
-    def _resolve(self, arr):
-        self._val = int(arr[0])
 
     @property
     def value(self) -> int:
         if self._val is None:
-            _flush_pending()
-        assert self._val is not None
+            self._val = int(self._staged.np[0])
+            self._staged = None
         return self._val
 
     def __int__(self):
@@ -126,21 +103,51 @@ class LazyCount:
 class LazyArray:
     """A small device int vector resolved through the pending pool
     (e.g. per-partition bincounts in the shuffle split)."""
-    __slots__ = ("dev", "_val", "__weakref__")
+    __slots__ = ("dev", "_staged", "_val")
 
     def __init__(self, dev):
         self.dev = dev
+        self._staged = pending.stage(jnp.asarray(dev))
         self._val = None
-        _PENDING.append(weakref.ref(self))
-
-    def _resolve(self, arr):
-        self._val = arr
 
     @property
     def np(self) -> np.ndarray:
         if self._val is None:
-            _flush_pending()
+            self._val = self._staged.np
+            self._staged = None
         return self._val
+
+
+class SpeculativeResult:
+    """Attached (as ``batch._speculative``) to a batch computed by a
+    speculative fast-path program whose data assumptions are verified by
+    a device-side flag (e.g. the sort-free bucket-table aggregate,
+    kernels/aggregate.py table_plan).  Consumers holding a natural flush
+    barrier (the shuffle exchange, the aggregate merge) call ``ok()``
+    after the fused flush and ``redo()`` for the rare non-fitting batch.
+    """
+
+    __slots__ = ("fits", "_redo")
+
+    def __init__(self, fits, redo):
+        self.fits = list(fits)   # LazyCounts: nonzero == assumption held
+        self._redo = redo
+
+    def ok(self) -> bool:
+        return all(int(f) != 0 for f in self.fits)
+
+    def redo(self) -> "ColumnarBatch":
+        return self._redo()
+
+
+def resolve_speculative(batch: "ColumnarBatch") -> "ColumnarBatch":
+    """Verify-and-replace helper: returns the batch itself when its
+    speculative assumptions held (or it has none), else the re-computed
+    exact batch."""
+    spec = getattr(batch, "_speculative", None)
+    if spec is None or spec.ok():
+        return batch
+    return spec.redo()
 
 
 class ColumnarBatch:
@@ -288,7 +295,9 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     """Concatenate batches of identical schema (the GpuCoalesceBatches core,
 
     reference: GpuCoalesceBatches.scala:195)."""
-    batches = [b for b in batches]
+    # concat reads num_rows (a flush barrier) — the right moment to
+    # verify any speculative fast-path batches before baking them in
+    batches = [resolve_speculative(b) for b in batches]
     assert batches, "concat of zero batches"
     if len(batches) == 1:
         return batches[0]

@@ -134,10 +134,38 @@ class Column:
         return Column(dtype, data, valid)
 
     # -- host interop -----------------------------------------------------------
+    # Device buffer names pulled to host via the one-flush pending pool
+    # (columnar/pending.py); subclasses override.
+    _HOST_ATTRS = ("data", "validity")
+
+    def _host_children(self):
+        return ()
+
+    def stage_host(self):
+        """Stage every device buffer (recursively) for the next fused
+        device->host flush; to_numpy/to_pylist then read the staged copy."""
+        from . import pending
+        cache = self.__dict__.setdefault("_host_staged", {})
+        for attr in self._HOST_ATTRS:
+            if attr not in cache:
+                cache[attr] = pending.stage(getattr(self, attr))
+        for child in self._host_children():
+            child.stage_host()
+
+    def _hnp(self, attr: str) -> np.ndarray:
+        """Host copy of a device buffer, via the fused pending pool."""
+        from . import pending
+        cache = self.__dict__.setdefault("_host_staged", {})
+        st = cache.get(attr)
+        if st is None:
+            st = pending.stage(getattr(self, attr))
+            cache[attr] = st
+        return st.np
+
     def to_numpy(self, num_rows: int):
         """Return (values ndarray, validity ndarray) truncated to num_rows."""
-        return (np.asarray(self.data)[:num_rows],
-                np.asarray(self.validity)[:num_rows])
+        return (self._hnp("data")[:num_rows],
+                self._hnp("validity")[:num_rows])
 
     def to_pylist(self, num_rows: int) -> List:
         vals, valid = self.to_numpy(num_rows)
@@ -217,10 +245,12 @@ class StringColumn(Column):
         return StringColumn(jnp.asarray(offsets), jnp.asarray(buf),
                             jnp.asarray(validity))
 
+    _HOST_ATTRS = ("offsets", "data", "validity")
+
     def to_numpy(self, num_rows: int):
-        offs = np.asarray(self.offsets)
-        buf = np.asarray(self.data).tobytes()
-        valid = np.asarray(self.validity)[:num_rows]
+        offs = self._hnp("offsets")
+        buf = self._hnp("data").tobytes()
+        valid = self._hnp("validity")[:num_rows]
         vals = np.empty(num_rows, dtype=object)
         for i in range(num_rows):
             vals[i] = buf[offs[i]:offs[i + 1]].decode("utf-8", "replace")
@@ -313,9 +343,14 @@ class ListColumn(Column):
     def element_capacity(self) -> int:
         return self.elements.capacity
 
+    _HOST_ATTRS = ("offsets", "validity")
+
+    def _host_children(self):
+        return (self.elements,)
+
     def to_pylist(self, num_rows: int) -> List:
-        offs = np.asarray(self.offsets)
-        valid = np.asarray(self.validity)[:num_rows]
+        offs = self._hnp("offsets")
+        valid = self._hnp("validity")[:num_rows]
         n_elems = int(offs[num_rows]) if num_rows else 0
         elems = self.elements.to_pylist(n_elems) if n_elems else []
         out: List = []
@@ -331,7 +366,7 @@ class ListColumn(Column):
         lst = self.to_pylist(num_rows)
         for i, v in enumerate(lst):
             vals[i] = v
-        return vals, np.asarray(self.validity)[:num_rows]
+        return vals, self._hnp("validity")[:num_rows]
 
     def with_capacity(self, capacity: int, num_rows: int) -> "ListColumn":
         if capacity == self.capacity:
@@ -408,8 +443,13 @@ class StructColumn(Column):
                 for vals, f in zip(per_field, dtype.fields)]
         return StructColumn(dtype, kids, jnp.asarray(validity))
 
+    _HOST_ATTRS = ("validity",)
+
+    def _host_children(self):
+        return tuple(self.children)
+
     def to_pylist(self, num_rows: int) -> List:
-        valid = np.asarray(self.validity)[:num_rows]
+        valid = self._hnp("validity")[:num_rows]
         kid_vals = [c.to_pylist(num_rows) for c in self.children]
         names = [f.name for f in self.dtype.fields]
         return [dict(zip(names, vals)) if ok else None
@@ -420,7 +460,7 @@ class StructColumn(Column):
         vals = np.empty(num_rows, dtype=object)
         for i, v in enumerate(self.to_pylist(num_rows)):
             vals[i] = v
-        return vals, np.asarray(self.validity)[:num_rows]
+        return vals, self._hnp("validity")[:num_rows]
 
     def with_capacity(self, capacity: int, num_rows: int) -> "StructColumn":
         if capacity == self.capacity:
@@ -500,8 +540,8 @@ class MapColumn(ListColumn):
                          jnp.asarray(validity))
 
     def to_pylist(self, num_rows: int) -> List:
-        offs = np.asarray(self.offsets)
-        valid = np.asarray(self.validity)[:num_rows]
+        offs = self._hnp("offsets")
+        valid = self._hnp("validity")[:num_rows]
         n_elems = int(offs[num_rows]) if num_rows else 0
         keys = self.keys.to_pylist(n_elems) if n_elems else []
         vals = self.values.to_pylist(n_elems) if n_elems else []

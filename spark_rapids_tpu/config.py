@@ -134,6 +134,26 @@ SHUFFLE_COMPRESS = conf_str(
     "none|zlib|lz4|tplz codec for shuffle buffers; tplz is the native "
     "C++ LZ block codec (the nvcomp-LZ4 role; reference: "
     "spark.rapids.shuffle.compression.codec)")
+VARIABLE_FLOAT_AGG = conf_bool(
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled", True,
+    "Allow float/double aggregations (sum/avg/min/max) to accumulate in "
+    "f32 on device.  TPUs have no 64-bit float ALU — XLA emulates f64 at "
+    "4-6x cost — so f32 accumulation is the TPU-native fast path; results "
+    "can differ from the CPU oracle in low-order bits (and any ordering "
+    "difference already makes float aggs non-deterministic, which is why "
+    "the reference gates them the same way: "
+    "spark.rapids.sql.variableFloatAgg.enabled).  Inputs whose f32 cast "
+    "would overflow are detected on device and re-run on the exact path.")
+AGG_TABLE_SIZE = conf_int(
+    "spark.rapids.tpu.sql.agg.tableSize", 4096,
+    "Bucket-table size for the sort-free small-domain group-by fast path "
+    "(kernels/aggregate.py table_plan).  Key sets whose combined "
+    "cardinality range fits are aggregated via one-hot MXU matmuls and "
+    "small-output scatters with no sort; a device-side fit flag reruns "
+    "non-fitting batches on the general sort path.")
+AGG_TABLE_ENABLED = conf_bool(
+    "spark.rapids.tpu.sql.agg.tablePath.enabled", True,
+    "Enable the sort-free bucket-table aggregation fast path")
 INCOMPATIBLE_OPS = conf_bool(
     "spark.rapids.tpu.sql.incompatibleOps.enabled", False,
     "Allow ops whose results can differ from CPU in corner cases "

@@ -37,6 +37,8 @@ class TpuShuffleExchange(TpuExec):
                 f"({self.partitioner.num_partitions})]")
 
     def _materialize_map_side(self):
+        from ..columnar import pending
+        from ..columnar.batch import resolve_speculative
         mgr = ShuffleManager.get()
         self._shuffle_id = mgr.new_shuffle_id()
         in_parts = self.children[0].execute()
@@ -47,18 +49,29 @@ class TpuShuffleExchange(TpuExec):
             sample = [b for part in all_batches for b in part]
             self.partitioner.fit(sample)
             in_parts = [iter(p) for p in all_batches]
-        # Per map partition: phase 1 enqueues device work for every
-        # batch (sort by pid + device bincount), phase 2 pulls the
-        # counts — one fused transfer per map task (LazyCount doc).
-        # Staging is bounded to ONE map partition so shuffles larger
-        # than device memory still stream+spill map task by map task.
-        for map_id, part in enumerate(in_parts):
+        # Phase 1 (device-only): drain EVERY map partition, staging the
+        # split sort + boundary counts per batch — nothing pulls yet.
+        # Phase 2: ONE fused flush resolves every count and every
+        # speculative fit flag (columnar/pending.py); the rare batch
+        # whose table-path assumptions failed is recomputed exactly here,
+        # at the stage barrier, before any result is exposed.
+        staged_by_map = []
+        for part in in_parts:
             staged = []
             for batch in part:
                 with timed(self.metrics[PARTITION_TIME]):
-                    staged.append(self.partitioner.split_staged(batch))
+                    staged.append(
+                        (batch, self.partitioner.split_staged(batch)))
+            staged_by_map.append(staged)
+        pending.flush()
+        for map_id, staged in enumerate(staged_by_map):
             per_reduce = {}
-            for sorted_batch, counts in staged:
+            for batch, (sorted_batch, counts) in staged:
+                checked = resolve_speculative(batch)
+                if checked is not batch:
+                    with timed(self.metrics[PARTITION_TIME]):
+                        sorted_batch, counts = \
+                            self.partitioner.split_staged(checked)
                 split = self.partitioner.finalize_split(sorted_batch, counts)
                 if split.offsets[-1] == 0:
                     continue
@@ -135,9 +148,11 @@ class TpuBroadcastExchange(TpuExec):
         return 1
 
     def broadcast_batch(self) -> ColumnarBatch:
+        from ..columnar.batch import resolve_speculative
         if self._result is None:
-            batches = [b for p in self.children[0].execute() for b in p
-                       if b.num_rows > 0]
+            batches = [resolve_speculative(b)
+                       for p in self.children[0].execute() for b in p]
+            batches = [b for b in batches if b.num_rows > 0]
             self._result = concat_batches(batches) if batches else \
                 ColumnarBatch.empty(self.output_schema)
         return self._result

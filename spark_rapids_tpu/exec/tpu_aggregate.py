@@ -17,7 +17,8 @@ import jax.numpy as jnp
 from ..columnar import dtypes as T
 from ..columnar.schema import Field, Schema
 from ..columnar.column import Column, bucket_capacity
-from ..columnar.batch import ColumnarBatch, LazyCount, concat_batches
+from ..columnar.batch import (ColumnarBatch, LazyCount, SpeculativeResult,
+                              concat_batches, resolve_speculative)
 from ..expr import core as ec
 from ..expr.aggregates import AggregateFunction
 from ..kernels import canon, aggregate as agg_k
@@ -85,13 +86,13 @@ class TpuHashAggregate(TpuExec):
                 if not partials:
                     partials = [self._update_batch(
                         ColumnarBatch.empty(child_schema))]
-                # update batches stay at input capacity (no per-batch
-                # sync).  A single PARTIAL stays uncompacted — the
-                # exchange downstream slices it small anyway, and
-                # compacting here would force a count pull per
-                # partition; everything else compacts together (one
-                # queue drain serves all counts).
+                # A single PARTIAL passes through unverified/uncompacted
+                # (zero syncs); the exchange downstream holds the flush
+                # barrier that verifies speculative table-path batches
+                # and slices them.  Any path that merges/finalizes here
+                # must verify first (the merge would bake garbage in).
                 if len(partials) > 1 or self.mode != PARTIAL:
+                    partials = [resolve_speculative(p) for p in partials]
                     partials = [self._compact_partial(p) for p in partials]
                 merged = concat_batches(partials) if len(partials) > 1 \
                     else partials[0]
@@ -232,6 +233,336 @@ class TpuHashAggregate(TpuExec):
                                 for dt, (d, v) in zip(dts, pairs)])
         return plan, agg_buffers
 
+    # -- sort-free bucket-table fast path ----------------------------------
+    # (kernels/aggregate.py table_plan; the cuDF-hash-groupby role done
+    # the TPU way: mixed-radix bucket ids + MXU one-hot matmuls, no sort,
+    # speculative dispatch verified by a device-side fit flag.)
+
+    _TABLE_KEY_DTYPES = None   # int-family key dtypes (lazily built)
+
+    @staticmethod
+    def _table_key_ok(dt) -> bool:
+        return (dt.is_integral or dt == T.BOOL or
+                dt in (T.DATE, T.TIMESTAMP) or
+                isinstance(dt, T.DecimalType))
+
+    def _table_prepare(self, src_schema):
+        """Guards + lowering descriptors for the table path; False when
+        this (pre_ops, schema, aggs) can never use it."""
+        from ..config import get_active, VARIABLE_FLOAT_AGG
+        from ..expr import aggregates as ea
+        from .fused import _tree_fusable, expr_signature
+        from .staged import ops_fusable, ops_signature
+        fast_float = get_active().get(VARIABLE_FLOAT_AGG)
+        if self.pre_ops:
+            if not ops_fusable(self.pre_ops):
+                return False
+            osig = ops_signature(self.pre_ops)
+            if osig is None:
+                return False
+            post_schema = self.pre_ops[-1][2]
+        else:
+            osig = ""
+            post_schema = src_schema
+        try:
+            bound_keys = [e.bind(post_schema) for e in self.group_exprs]
+            bound_inputs = [[c.bind(post_schema) for c in a.func.children]
+                            for a in self.aggs]
+        except KeyError:
+            return False
+        if not bound_keys:
+            return False
+        if not all(_tree_fusable(e) and self._table_key_ok(e.dtype())
+                   for e in bound_keys):
+            return False
+        for bs in bound_inputs:
+            if not all(_tree_fusable(e) for e in bs):
+                return False
+        # per-agg lowering descriptor
+        descs = []
+        for a, bs in zip(self.aggs, bound_inputs):
+            f = a.func
+            cdt = bs[0].dtype() if bs else None
+            if isinstance(f, ea.Count):
+                descs.append(("count",))
+            elif isinstance(f, ea.Sum):
+                if cdt is None or not cdt.is_fractional or not fast_float:
+                    return False    # exact int/decimal sums: sort path
+                descs.append(("fsum",))
+            elif isinstance(f, ea.Average):
+                if not fast_float:
+                    return False
+                descs.append(("avg",))
+            elif isinstance(f, (ea.Min, ea.Max)):
+                want_max = isinstance(f, ea.Max)
+                if cdt == T.FLOAT32:
+                    descs.append(("fminmax", want_max))
+                elif cdt is not None and cdt.is_fractional:
+                    if not fast_float:
+                        return False
+                    descs.append(("fminmax", want_max))
+                elif cdt is not None and self._table_key_ok(cdt):
+                    descs.append(("iminmax", want_max))
+                else:
+                    return False
+            elif isinstance(f, (ea.First, ea.Last)):
+                if cdt is None or cdt == T.STRING or cdt.is_nested:
+                    return False
+                descs.append(("firstlast", isinstance(f, ea.Last),
+                              getattr(f, "ignore_nulls", True)))
+            else:
+                return False
+        ksigs = [expr_signature(e) for e in bound_keys]
+        isigs = [tuple(expr_signature(e) for e in bs)
+                 for bs in bound_inputs]
+        if any(s is None for s in ksigs) or \
+                any(s is None for t in isigs for s in t):
+            return False
+        cache_key = ("table", osig, tuple(ksigs),
+                     tuple(x for t in isigs for x in t),
+                     tuple(f.dtype.name for f in src_schema),
+                     tuple(descs), fast_float)
+        return cache_key, bound_keys, bound_inputs, descs
+
+    def _fused_table_core(self, batch: ColumnarBatch):
+        """pre_ops + key eval + bucket-table aggregation as ONE program.
+
+        Returns a buffer-schema ColumnarBatch (capacity = table size)
+        carrying a SpeculativeResult, or None to use the general path."""
+        import jax
+        import logging
+        from ..config import get_active, AGG_TABLE_ENABLED, AGG_TABLE_SIZE
+        conf = get_active()
+        if not conf.get(AGG_TABLE_ENABLED):
+            return None
+        table = int(conf.get(AGG_TABLE_SIZE))
+        if batch.capacity < table or batch.capacity > (1 << 21) or \
+                not batch.columns:
+            return None
+        if not all(type(c) is Column for c in batch.columns):
+            return None
+        if self._ws_memo.get("table_state") == "off":
+            return None
+        mkey = ("tprep", tuple(f.dtype.name for f in batch.schema))
+        prep = self._ws_memo.get(mkey)
+        if prep is None:
+            prep = self._table_prepare(batch.schema)
+            self._ws_memo[mkey] = prep
+        if prep is False:
+            return None
+        cache_key, bound_keys, bound_inputs, descs = prep
+        core = TpuHashAggregate._CORE_CACHE.get((cache_key, table))
+        if core is False:
+            return None
+        if core is None:
+            core = jax.jit(self._build_table_core(
+                batch.schema, bound_keys, bound_inputs, descs, table))
+            TpuHashAggregate._CORE_CACHE[(cache_key, table)] = core
+        datas = tuple(c.data for c in batch.columns)
+        valids = tuple(c.validity for c in batch.columns)
+        try:
+            fit, ng, key_pairs, buf_groups = core(datas, valids,
+                                                  batch.rows_dev)
+        except Exception:  # noqa: BLE001 - fall back, but loudly
+            logging.getLogger("spark_rapids_tpu.exec.aggregate").warning(
+                "table aggregate core failed; falling back", exc_info=True)
+            TpuHashAggregate._CORE_CACHE[(cache_key, table)] = False
+            return None
+        out_cols = [Column(e.dtype(), d, v)
+                    for e, (d, v) in zip(bound_keys, key_pairs)]
+        for a, pairs in zip(self.aggs, buf_groups):
+            dts = a.func.buffer_dtypes()
+            out_cols.extend(Column(dt, d, v)
+                            for dt, (d, v) in zip(dts, pairs))
+        out = ColumnarBatch(buffer_schema(self.group_exprs, self.aggs),
+                            out_cols, LazyCount(ng))
+
+        def redo():
+            self._ws_memo["table_state"] = "off"
+            return self._aggregate_batch(batch, no_table=True)
+        out._speculative = SpeculativeResult([LazyCount(fit)], redo)
+        return out
+
+    def _build_table_core(self, src_schema, bound_keys, bound_inputs,
+                          descs, table: int):
+        """Build the traced table-aggregation program (see kernels)."""
+        import jax.numpy as jnp
+        from .fused import _TracedBatch
+        from .staged import apply_ops_traced
+        pre_ops = self.pre_ops
+        SIGN = 0x8000000000000000
+
+        def decode_word(dtype, word):
+            if dtype == T.BOOL:
+                return word != 0
+            v = (word ^ jnp.uint64(SIGN)).astype(jnp.int64)
+            return v.astype(dtype.np_dtype)
+
+        def _core(datas, valids, num_rows):
+            cap = datas[0].shape[0]
+            cols = [Column(f.dtype, d, v)
+                    for f, d, v in zip(src_schema, datas, valids)]
+            b = _TracedBatch(src_schema, cols, num_rows, cap)
+            if pre_ops:
+                b = apply_ops_traced(pre_ops, b)
+            live = jnp.arange(b.capacity) < b.num_rows
+            kcols = [ec.eval_as_column(e, b) for e in bound_keys]
+            kwords = [canon.value_words(c, b.num_rows)[0] for c in kcols]
+            kvalids = [c.validity for c in kcols]
+            plan, (mins, cards) = agg_k.table_plan(
+                kwords, kvalids, b.num_rows, table)
+            fit = plan.fit
+            icols = [[ec.eval_as_column(e, b) for e in bs] or [None]
+                     for bs in bound_inputs]
+            # one fused einsum for every sum/count row
+            rows, row_of = [], {}
+
+            def add_row(tag, arr):
+                if tag not in row_of:
+                    row_of[tag] = len(rows)
+                    rows.append(arr)
+            add_row("__ones__", jnp.where(live, 1.0, 0.0).astype(
+                jnp.float32))
+            for ai, (a, cols_a) in enumerate(zip(self.aggs, icols)):
+                kind = descs[ai][0]
+                c = cols_a[0]
+                if kind == "count" and c is not None:
+                    add_row(("cnt", ai),
+                            jnp.where(live & c.validity, 1.0, 0.0)
+                            .astype(jnp.float32))
+                elif kind in ("fsum", "avg"):
+                    ok = live & c.validity
+                    v32 = c.data.astype(jnp.float32)
+                    fit = fit & jnp.all(
+                        jnp.where(ok, jnp.isfinite(v32), True))
+                    add_row(("sum", ai),
+                            jnp.where(ok, v32, 0.0))
+                    add_row(("cnt", ai),
+                            jnp.where(ok, 1.0, 0.0).astype(jnp.float32))
+                elif kind == "iminmax":
+                    add_row(("cnt", ai),
+                            jnp.where(live & c.validity, 1.0, 0.0)
+                            .astype(jnp.float32))
+                elif kind == "fminmax":
+                    ok = live & c.validity
+                    add_row(("cnt", ai),
+                            jnp.where(ok, 1.0, 0.0).astype(jnp.float32))
+                    # Spark float order: NaN is greatest (kernels seg_min
+                    # doc) — count non-NaN contributions per bucket
+                    add_row(("nn", ai),
+                            jnp.where(ok & ~jnp.isnan(c.data), 1.0, 0.0)
+                            .astype(jnp.float32))
+            sums = agg_k.table_fsum(rows, plan.bucket, live, table)
+            order = plan.order
+            live_g = jnp.arange(table) < plan.num_groups
+
+            def compact(tab):
+                return jnp.take(tab, order)
+            # keys: decode bucket digits arithmetically (no gathers)
+            key_pairs = []
+            strides = []
+            s = jnp.int32(1)
+            for card in reversed(cards):
+                strides.append(s)
+                s = s * card
+            strides = list(reversed(strides))
+            for e, wmin, card, stride in zip(bound_keys, mins, cards,
+                                             strides):
+                digit = (order // stride) % card
+                word = wmin + (digit - 1).astype(jnp.uint64)
+                data = decode_word(e.dtype(), word)
+                key_pairs.append((data, (digit > 0) & live_g))
+            # agg buffers
+            buf_groups = []
+            for ai, (a, cols_a) in enumerate(zip(self.aggs, icols)):
+                kind = descs[ai][0]
+                c = cols_a[0]
+                if kind == "count":
+                    cnt = sums[row_of[("cnt", ai)] if c is not None
+                               else row_of["__ones__"]]
+                    cnt = compact(cnt)
+                    buf_groups.append([(
+                        jnp.where(live_g, cnt, 0.0).astype(jnp.int64),
+                        jnp.ones(table, bool))])
+                elif kind == "fsum":
+                    ssum = compact(sums[row_of[("sum", ai)]])
+                    cntv = compact(sums[row_of[("cnt", ai)]])
+                    dt = a.func.buffer_dtypes()[0]
+                    buf_groups.append([(
+                        ssum.astype(dt.np_dtype),
+                        (cntv > 0) & live_g)])
+                elif kind == "avg":
+                    ssum = compact(sums[row_of[("sum", ai)]])
+                    cntv = compact(sums[row_of[("cnt", ai)]])
+                    buf_groups.append([
+                        (ssum.astype(jnp.float64), live_g),
+                        (cntv.astype(jnp.int64), live_g)])
+                elif kind == "fminmax":
+                    want_max = descs[ai][1]
+                    ok = live & c.validity
+                    v32 = c.data.astype(jnp.float32)
+                    # Spark total order: NaN greatest, -0.0 == 0.0
+                    v32 = jnp.where(v32 == 0.0, jnp.float32(0.0), v32)
+                    nan = jnp.isnan(v32)
+                    m = agg_k.table_scatter_min(
+                        v32, ok & ~nan, plan.bucket, table,
+                        want_max=want_max)
+                    cntv = compact(sums[row_of[("cnt", ai)]])
+                    nnv = compact(sums[row_of[("nn", ai)]])
+                    m = compact(m)
+                    if want_max:
+                        # any NaN in the group wins
+                        m = jnp.where(cntv > nnv, jnp.float32(jnp.nan), m)
+                    else:
+                        # min ignores NaN unless the group is all-NaN
+                        m = jnp.where(nnv > 0, m, jnp.float32(jnp.nan))
+                    dt = a.func.buffer_dtypes()[0]
+                    buf_groups.append([(m.astype(dt.np_dtype),
+                                        (cntv > 0) & live_g)])
+                elif kind == "iminmax":
+                    want_max = descs[ai][1]
+                    ok = live & c.validity
+                    w = canon.value_words(c, b.num_rows)[0]
+                    any_v = jnp.any(ok)
+                    vmin = jnp.where(
+                        any_v,
+                        jnp.min(jnp.where(ok, w, jnp.uint64(2**64 - 1))),
+                        jnp.uint64(0))
+                    vmax = jnp.where(
+                        any_v, jnp.max(jnp.where(ok, w, jnp.uint64(0))),
+                        jnp.uint64(0))
+                    fit = fit & ((vmax - vmin) < (jnp.uint64(1) << 32))
+                    narrow = jnp.minimum(
+                        w - vmin, jnp.uint64(2**32 - 1)).astype(jnp.uint32)
+                    m = agg_k.table_scatter_min(narrow, ok, plan.bucket,
+                                                table, want_max=want_max)
+                    word = vmin + compact(m).astype(jnp.uint64)
+                    cntv = compact(sums[row_of[("cnt", ai)]])
+                    dt = a.func.buffer_dtypes()[0]
+                    buf_groups.append([(
+                        decode_word_minmax(dt, word),
+                        (cntv > 0) & live_g)])
+                elif kind == "firstlast":
+                    want_last, ignore_nulls = descs[ai][1], descs[ai][2]
+                    ok = (live & c.validity) if ignore_nulls else live
+                    pos, has = agg_k.table_first_pos(
+                        ok, plan.bucket, table, want_last=want_last)
+                    pos_g = compact(pos)
+                    has_g = compact(has) & live_g
+                    data = jnp.take(c.data, pos_g)
+                    vld = jnp.take(c.validity, pos_g)
+                    buf_groups.append([(data, has_g & vld)])
+            return (fit.astype(jnp.int32), plan.num_groups,
+                    key_pairs, buf_groups)
+
+        def decode_word_minmax(dt, word):
+            if dt == T.BOOL:
+                return word != 0
+            v = (word ^ jnp.uint64(SIGN)).astype(jnp.int64)
+            return v.astype(dt.np_dtype)
+
+        return _core
+
     def _ws_prepare(self, src_schema):
         """One-time guards + signature derivation for the whole-stage
         core; False when this (pre_ops, schema) can never fuse."""
@@ -353,8 +684,13 @@ class TpuHashAggregate(TpuExec):
 
     # -- core -------------------------------------------------------------------
     def _aggregate_batch(self, batch: ColumnarBatch,
-                         emit_buffers: bool = False) -> ColumnarBatch:
+                         emit_buffers: bool = False,
+                         no_table: bool = False) -> ColumnarBatch:
         plan = agg_buffers = key_cols = None
+        if not no_table and self.mode == PARTIAL and self.group_exprs:
+            t = self._fused_table_core(batch)
+            if t is not None:
+                return t
         if self.pre_ops and self.mode in (PARTIAL, COMPLETE):
             if self.group_exprs:
                 ws = self._fused_whole_stage_core(batch)

@@ -26,7 +26,11 @@ from jax import lax
 @jax.jit
 def _stable_pair_sort(key, perm):
     """The one compiled sort primitive: stable ascending by ``key``,
-    carrying ``perm`` — shape-cached per capacity bucket only."""
+    carrying ``perm`` — shape-cached per (capacity bucket, key dtype).
+
+    64-bit keys cost ~6x a u32 sort on real TPU (u64 ops lower to u32
+    pairs), so callers with provably-narrow keys (partition ids, table
+    buckets, range-rebased words) pass u32 keys directly."""
     _, out = lax.sort((key, perm), num_keys=1, is_stable=True)
     return out
 
@@ -36,7 +40,10 @@ def sort_permutation(words: List[jnp.ndarray]) -> jnp.ndarray:
     cap = words[0].shape[0]
     perm = jnp.arange(cap, dtype=jnp.int32)
     if len(words) == 1:
-        return _stable_pair_sort(words[0].astype(jnp.uint64), perm)
+        w = words[0]
+        if w.dtype != jnp.dtype(jnp.uint32):
+            w = w.astype(jnp.uint64)
+        return _stable_pair_sort(w, perm)
     # LSD: least-significant word first; stability makes later (more
     # significant) passes dominate
     for w in reversed(words):

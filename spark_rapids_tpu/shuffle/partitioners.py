@@ -12,10 +12,13 @@ which per-partition slices are plain device gathers.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import List, Optional, Sequence
 
 import numpy as np
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..columnar.batch import ColumnarBatch, LazyArray
 from ..columnar.column import Column, StringColumn, bucket_capacity
@@ -38,6 +41,20 @@ class SplitBatch:
         return self.batch.slice(lo, hi - lo)
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _split_sort_counts(pids, num_rows, num_partitions: int):
+    """One program: stable u32 sort by partition id (rows past num_rows
+    to the end) + per-partition counts via searchsorted boundaries."""
+    cap = pids.shape[0]
+    in_range = jnp.arange(cap) < num_rows
+    sort_key = jnp.where(in_range, pids, jnp.uint32(num_partitions))
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    sk, perm = lax.sort((sort_key, perm), num_keys=1, is_stable=True)
+    bounds = jnp.searchsorted(
+        sk, jnp.arange(num_partitions + 1, dtype=jnp.uint32), side="left")
+    return perm, jnp.diff(bounds)
+
+
 class Partitioner:
     num_partitions: int = 1
 
@@ -45,19 +62,18 @@ class Partitioner:
         raise NotImplementedError
 
     def split_staged(self, batch: ColumnarBatch):
-        """Device half of the split: sort by partition id + device
-        bincount.  No host sync — callers stage many batches, then
-        finalize them together so one queue drain covers all."""
+        """Device half of the split: sort by partition id + boundary
+        counts.  No host sync — callers stage many batches, then
+        finalize them together so one queue drain covers all.
+
+        TPU notes: partition ids always fit u32, so the pair sort runs
+        the cheap 32-bit kernel, and counts come from binary search over
+        the sorted ids instead of a scatter (TPU scatters are ~15x the
+        cost of a searchsorted at shuffle sizes)."""
         pids = self.partition_ids(batch)
-        cap = batch.capacity
-        in_range = jnp.arange(cap) < batch.rows_dev
-        sort_key = jnp.where(in_range, pids.astype(jnp.uint64),
-                             jnp.uint64(self.num_partitions))
-        perm = sort_permutation([sort_key])
+        perm, counts = _split_sort_counts(
+            pids.astype(jnp.uint32), batch.rows_dev, self.num_partitions)
         sorted_batch = batch.gather(perm, batch.rows_lazy)
-        counts = jnp.bincount(
-            jnp.where(in_range, pids, self.num_partitions),
-            length=self.num_partitions + 1)[:self.num_partitions]
         return sorted_batch, LazyArray(counts)
 
     @staticmethod
