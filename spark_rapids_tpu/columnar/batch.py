@@ -44,13 +44,13 @@ class LazyCount:
 
     def __init__(self, dev):
         self.dev = dev
-        self._staged = pending.stage(jnp.ravel(jnp.asarray(dev)))
+        self._staged = pending.stage(dev)
         self._val: Optional[int] = None
 
     @property
     def value(self) -> int:
         if self._val is None:
-            self._val = int(self._staged.np[0])
+            self._val = int(self._staged.np.ravel()[0])
             self._staged = None
         return self._val
 
@@ -268,9 +268,37 @@ class ColumnarBatch:
         cols = [c.gather(indices) for c in self.columns]
         return ColumnarBatch(self.schema, cols, num_rows)
 
+    # jitted slice programs keyed by (out_cap,); shapes key the rest.
+    # Eager per-column gathers cost ~7ms of client overhead EACH on the
+    # remote backend; one jit dispatch is ~free (columnar/pending.py doc).
+    _SLICE_JIT: dict = {}
+
     def slice(self, start: int, length: int) -> "ColumnarBatch":
-        idx = jnp.arange(bucket_capacity(length)) + start
         valid_rows = min(length, max(self.num_rows - start, 0))
+        out_cap = bucket_capacity(length)
+        if all(type(c) is Column for c in self.columns) and self.columns:
+            fn = ColumnarBatch._SLICE_JIT.get(out_cap)
+            if fn is None:
+                import jax
+
+                def _slice(datas, valids, start_, nvalid):
+                    idx = jnp.arange(out_cap) + start_
+                    live = jnp.arange(out_cap) < nvalid
+                    outs = []
+                    for d, v in zip(datas, valids):
+                        outs.append((
+                            jnp.take(d, idx, axis=0, mode="clip"),
+                            jnp.take(v, idx, axis=0, mode="clip") & live))
+                    return outs
+                fn = jax.jit(_slice)
+                ColumnarBatch._SLICE_JIT[out_cap] = fn
+            pairs = fn(tuple(c.data for c in self.columns),
+                       tuple(c.validity for c in self.columns),
+                       start, valid_rows)
+            cols = [Column(c.dtype, d, v)
+                    for c, (d, v) in zip(self.columns, pairs)]
+            return ColumnarBatch(self.schema, cols, valid_rows)
+        idx = jnp.arange(out_cap) + start
         b = self.gather(idx, valid_rows)
         # rows past num_rows must be invalid
         mask = jnp.arange(b.capacity) < valid_rows
@@ -304,12 +332,54 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     schema = batches[0].schema
     total = sum(b.num_rows for b in batches)
     cap = bucket_capacity(total)
+    if all(type(c) is Column for b in batches for c in b.columns) and \
+            len(schema):
+        return _concat_plain_jit(batches, schema, cap, total)
     out_cols: List[Column] = []
     for ci, field in enumerate(schema):
         out_cols.append(_concat_cols(
             field.dtype, [b.columns[ci] for b in batches],
             [b.num_rows for b in batches], cap))
     return ColumnarBatch(schema, out_cols, total)
+
+
+_CONCAT_JIT: dict = {}
+
+
+def _concat_plain_jit(batches, schema, cap: int, total: int):
+    """One jitted program for fixed-width concat (slice+concat+pad per
+    column) — the eager per-column path costs ~7ms/op on the remote
+    backend (columnar/pending.py doc)."""
+    import jax
+    nrows = tuple(b.num_rows for b in batches)
+    key = (nrows, cap, len(schema))
+    fn = _CONCAT_JIT.get(key)
+    if fn is None:
+        ncols = len(schema)
+
+        def _concat(datas, valids):
+            outs = []
+            for ci in range(ncols):
+                ds = [d[:n] for d, n in zip(datas[ci], nrows)]
+                vs = [v[:n] for v, n in zip(valids[ci], nrows)]
+                d = jnp.concatenate(ds)
+                v = jnp.concatenate(vs)
+                pad = cap - int(d.shape[0])
+                if pad:
+                    d = jnp.pad(d, (0, pad))
+                    v = jnp.pad(v, (0, pad))
+                outs.append((d, v))
+            return outs
+        fn = jax.jit(_concat)
+        if len(_CONCAT_JIT) < 4096:
+            _CONCAT_JIT[key] = fn
+    datas = tuple(tuple(b.columns[ci].data for b in batches)
+                  for ci in range(len(schema)))
+    valids = tuple(tuple(b.columns[ci].validity for b in batches)
+                   for ci in range(len(schema)))
+    pairs = fn(datas, valids)
+    cols = [Column(f.dtype, d, v) for f, (d, v) in zip(schema, pairs)]
+    return ColumnarBatch(schema, cols, total)
 
 
 def _concat_cols(dtype: T.DType, cols: Sequence[Column],

@@ -71,8 +71,19 @@ def stage(dev) -> Staged:
     return Staged(dev)
 
 
-def _pack_bytes(x):
-    """u8[n] -> u32[ceil(n/4)] little-endian (host unpacks via .view)."""
+# Encoders are jitted (cached per input shape): on the remote backend an
+# EAGER jnp op costs ~7ms of client overhead while a jit dispatch is ~free
+# (measured 200 chained jit calls enqueue in 2ms), so per-item encode work
+# must never run eagerly.
+
+@jax.jit
+def _enc_bytes(x):
+    """u8-ish[n] -> u32[ceil(n/4)] little-endian (host unpacks via .view)."""
+    x = jnp.ravel(x)
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    elif x.dtype != jnp.uint8:
+        x = lax.bitcast_convert_type(x, jnp.uint8)
     n = int(x.shape[0])
     pad = (-n) % 4
     if pad:
@@ -81,29 +92,47 @@ def _pack_bytes(x):
     return (w[:, 0] | (w[:, 1] << 8) | (w[:, 2] << 16) | (w[:, 3] << 24))
 
 
+@jax.jit
+def _enc_wide16(x):
+    x = jnp.ravel(x)
+    return (x.astype(jnp.int32).view(jnp.uint32)
+            if np.dtype(x.dtype).kind == "i" else x.astype(jnp.uint32))
+
+
+@jax.jit
+def _enc_u32(x):
+    return lax.bitcast_convert_type(jnp.ravel(x), jnp.uint32)
+
+
+@jax.jit
+def _enc_split64(x):
+    # 64-bit ints: exact shift/mask split (the chip rejects 64-bit
+    # bitcasts; masking the arithmetic-shifted high word is exact)
+    x = jnp.ravel(x)
+    mask = x.dtype.type(0xFFFFFFFF)
+    lo = (x & mask).astype(jnp.uint32)
+    hi = ((x >> x.dtype.type(32)) & mask).astype(jnp.uint32)
+    return lo, hi
+
+
+@jax.jit
+def _enc_f64(x):
+    return jnp.ravel(x)
+
+
 def _encode(x) -> Tuple[str, list]:
     """Device array -> (layout, [u32 parts] or [f64 parts])."""
     dt = np.dtype(x.dtype)
-    x = jnp.ravel(x)
-    if dt == np.bool_:
-        return "u8", [_pack_bytes(x.astype(jnp.uint8))]
-    if dt.itemsize == 1:
-        return "u8", [_pack_bytes(lax.bitcast_convert_type(x, jnp.uint8))]
+    if dt == np.bool_ or dt.itemsize == 1:
+        return "u8", [_enc_bytes(x)]
     if dt.itemsize == 2:
-        # widened: host view as u32 then narrow (rare dtypes)
-        return "u32", [x.astype(jnp.int32).view(jnp.uint32)
-                       if dt.kind == "i" else x.astype(jnp.uint32)]
+        return "u32", [_enc_wide16(x)]
     if dt.itemsize == 4:
-        return "u32", [lax.bitcast_convert_type(x, jnp.uint32)]
-    if dt.kind in "iu":  # 64-bit ints: exact shift/mask split (no bitcast
-        # — the chip rejects 64-bit bitcasts; arithmetic shifts work, and
-        # masking the arithmetic-shifted high word recovers the exact bits)
-        mask = x.dtype.type(0xFFFFFFFF)
-        lo = (x & mask).astype(jnp.uint32)
-        hi = ((x >> x.dtype.type(32)) & mask).astype(jnp.uint32)
-        return "split64", [lo, hi]
+        return "u32", [_enc_u32(x)]
+    if dt.kind in "iu":
+        return "split64", list(_enc_split64(x))
     assert dt == np.float64, f"unsupported staged dtype {dt}"
-    return "f64", [x]
+    return "f64", [_enc_f64(x)]
 
 
 def _decode(layout: str, np_dtype, shape, parts: List[np.ndarray]):

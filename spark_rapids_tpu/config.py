@@ -154,6 +154,11 @@ AGG_TABLE_SIZE = conf_int(
 AGG_TABLE_ENABLED = conf_bool(
     "spark.rapids.tpu.sql.agg.tablePath.enabled", True,
     "Enable the sort-free bucket-table aggregation fast path")
+AGG_TABLE_REDUCE_IMPL = conf_str(
+    "spark.rapids.tpu.sql.agg.tableReduceImpl", "scatter",
+    "Bucket-table reduction backend: 'scatter' (multi-column XLA "
+    "scatter) or 'pallas' (hand-written one-hot MXU kernel, "
+    "kernels/pallas_ops.table_reduce)")
 INCOMPATIBLE_OPS = conf_bool(
     "spark.rapids.tpu.sql.incompatibleOps.enabled", False,
     "Allow ops whose results can differ from CPU in corner cases "

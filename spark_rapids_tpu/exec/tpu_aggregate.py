@@ -29,6 +29,33 @@ from .tpu_basic import TpuExec
 PARTIAL, FINAL, COMPLETE = "partial", "final", "complete"
 
 
+def _assemble_group_output(plan, key_cols, aggs, agg_buffers, out_cap: int,
+                           emit_buffers: bool):
+    """Traced output assembly: compact keys + agg buffers to rows 0..G-1.
+
+    Runs INSIDE the fused cores — eager per-column gathers/masks after the
+    jitted plan cost ~7ms of client overhead each on the remote backend
+    (columnar/pending.py doc), which dominated the reduce side."""
+    ng = plan.num_groups
+    rep = plan.rep_indices
+    take = jnp.where(jnp.arange(out_cap) < ng,
+                     rep[:out_cap] if out_cap <= rep.shape[0] else
+                     jnp.pad(rep, (0, out_cap - rep.shape[0]))[:out_cap],
+                     0)
+    live = jnp.arange(out_cap) < ng
+    outs = []
+    for c in key_cols:
+        g = c.gather(take).mask_validity(live)
+        outs.append((g.data, g.validity))
+    seg_take = jnp.where(live, jnp.arange(out_cap), 0)
+    for a, bufs in zip(aggs, agg_buffers):
+        cols_out = bufs if emit_buffers else [a.func.finalize(bufs)]
+        for o in cols_out:
+            c2 = o.gather(seg_take).mask_validity(live)
+            outs.append((c2.data, c2.validity))
+    return ng, outs
+
+
 def buffer_schema(group_exprs, aggs: List[AggExpr]) -> Schema:
     """Schema of partial-aggregation output: keys + flattened buffers."""
     fields = [Field(ec.output_name(e), e.dtype(), True) for e in group_exprs]
@@ -76,7 +103,19 @@ class TpuHashAggregate(TpuExec):
             # keeps memory bounded by partial size, not input size.
             partials = []
             with timed(self.metrics[AGG_TIME]):
-                for batch in part:
+                batches = list(part)
+                if self.mode == FINAL:
+                    # FINAL inputs are post-shuffle slices with host-known
+                    # counts: concat them up front (one jitted program)
+                    # and run ONE merge core instead of one per piece —
+                    # per-piece cores dominated the reduce side.  Falls
+                    # back to the iterative path when sizes are unknown
+                    # or the coalesced batch would be huge.
+                    if len(batches) > 1 and all(
+                            isinstance(b.rows_lazy, int) for b in batches) \
+                            and sum(b.num_rows for b in batches) <= (1 << 21):
+                        batches = [concat_batches(batches)]
+                for batch in batches:
                     # only skip empties whose count is already host-known
                     # (checking a lazy count would force a sync per batch)
                     if isinstance(batch.rows_lazy, int) and \
@@ -110,9 +149,7 @@ class TpuHashAggregate(TpuExec):
         cap = bucket_capacity(max(n, 1))
         if cap >= b.capacity:
             return b
-        idx = jnp.arange(cap, dtype=jnp.int32)
-        return ColumnarBatch(b.schema, [c.gather(idx) for c in b.columns],
-                             n)
+        return b.slice(0, max(n, 1))
 
     def _update_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
         """Partial (update) aggregation of one input batch -> buffer batch."""
@@ -149,8 +186,10 @@ class TpuHashAggregate(TpuExec):
     _CORE_CACHE = {}
 
     def _fused_agg_core(self, key_cols, input_cols, update_mode: bool,
-                        batch: ColumnarBatch):
-        """Run keys->words->plan->update/merge as ONE jitted computation.
+                        batch: ColumnarBatch, emit_buffers: bool):
+        """keys->words->plan->update/merge->output assembly as ONE jitted
+        computation, returning (num_groups, [(data, validity)]) output
+        pairs in schema order.
 
         The whole grouping pipeline is device-pure (the only host sync is
         the group count, pulled after); fusing it collapses the ~40 eager
@@ -165,10 +204,6 @@ class TpuHashAggregate(TpuExec):
             TpuHashAggregate._FUSABLE_FUNCS = (
                 ea.Sum, ea.Count, ea.Min, ea.Max, ea.Average, ea.First,
                 ea.Last)
-        # One fused program per batch beats the eager chain at every
-        # measured size now that group counts are LazyCounts (nothing
-        # serializes behind the num_groups pull anymore): 3x at <=32k,
-        # 2x at 256k.  The cap only guards pathological compile sizes.
         if batch.capacity > (1 << 21):
             return None
         if not all(type(c) is Column for c in key_cols):
@@ -183,7 +218,7 @@ class TpuHashAggregate(TpuExec):
         in_dts = tuple(tuple(None if c is None else c.dtype for c in cols)
                        for cols in input_cols)
         aggs = self.aggs
-        cache_key = (update_mode, key_dts, in_dts,
+        cache_key = (update_mode, emit_buffers, key_dts, in_dts,
                      tuple((type(a.func).__name__, repr(a.func),
                             getattr(a.func, "ignore_nulls", None))
                            for a in aggs))
@@ -195,18 +230,20 @@ class TpuHashAggregate(TpuExec):
             def _core(key_arrays, in_arrays, num_rows):
                 kcols = [Column(dt, d, v)
                          for dt, (d, v) in zip(key_dts, key_arrays)]
+                out_cap = key_arrays[0][0].shape[0]
                 words = canon.batch_key_words(kcols, num_rows)
                 plan = agg_k.groupby_plan(words)
-                out = []
+                agg_buffers = []
                 it = iter(in_arrays)
                 for a, dts in zip(aggs, in_dts):
                     cols = [None if dt is None else
                             Column(dt, *next(it)) for dt in dts] or [None]
                     bufs = a.func.update(plan, cols) if update_mode \
                         else a.func.merge(plan, cols)
-                    out.append([(b.data, b.validity) for b in bufs])
-                return ((plan.perm, plan.seg_id, plan.live_sorted,
-                         plan.rep_indices, plan.num_groups), out)
+                    agg_buffers.append(bufs)
+                return _assemble_group_output(plan, kcols, aggs,
+                                              agg_buffers, out_cap,
+                                              emit_buffers)
             core = jax.jit(_core)
             TpuHashAggregate._CORE_CACHE[cache_key] = core
 
@@ -217,21 +254,13 @@ class TpuHashAggregate(TpuExec):
             for cols in input_cols for c in cols if c is not None)
         key_arrays = tuple((c.data, c.validity) for c in key_cols)
         try:
-            (perm, seg_id, live, rep, ng), bufs_flat = core(
-                key_arrays, in_arrays, batch.rows_dev)
+            return core(key_arrays, in_arrays, batch.rows_dev)
         except Exception:  # noqa: BLE001 - fall back, but loudly
             logging.getLogger("spark_rapids_tpu.exec.aggregate").warning(
                 "fused aggregate core failed; falling back to eager",
                 exc_info=True)
             TpuHashAggregate._CORE_CACHE[cache_key] = False
             return None
-        plan = agg_k.GroupPlan(perm, seg_id, live, rep, ng)
-        agg_buffers = []
-        for a, pairs in zip(self.aggs, bufs_flat):
-            dts = a.func.buffer_dtypes()
-            agg_buffers.append([Column(dt, d, v)
-                                for dt, (d, v) in zip(dts, pairs)])
-        return plan, agg_buffers
 
     # -- sort-free bucket-table fast path ----------------------------------
     # (kernels/aggregate.py table_plan; the cuDF-hash-groupby role done
@@ -385,12 +414,39 @@ class TpuHashAggregate(TpuExec):
 
     def _build_table_core(self, src_schema, bound_keys, bound_inputs,
                           descs, table: int):
-        """Build the traced table-aggregation program (see kernels)."""
+        """Build the traced table-aggregation program.
+
+        One pass: mixed-radix bucket ids (kernels/aggregate.table_bucket),
+        then a SINGLE fused Pallas table-reduce (pallas_ops.table_reduce)
+        covering every sum/count row (MXU one-hot dots) and every min/max
+        row (VPU masked reductions; mins ride negated).  All reduce rows
+        are f32; integer min/max and first/last positions are exact
+        because the fit flag restricts them to the f32-exact integer
+        range (2^24) — non-fitting batches re-run on the sort path."""
         import jax.numpy as jnp
+        from ..config import get_active, AGG_TABLE_REDUCE_IMPL
+        from ..kernels.pallas_ops import table_reduce
         from .fused import _TracedBatch
-        from .staged import apply_ops_traced
+        reduce_impl = get_active().get(AGG_TABLE_REDUCE_IMPL)
         pre_ops = self.pre_ops
         SIGN = 0x8000000000000000
+        NEG_INF = jnp.float32(-jnp.inf)
+        F32_EXACT = jnp.uint64(1 << 24)
+
+        def apply_ops_masked(b, live):
+            # Filters fold into the live mask instead of compacting — the
+            # sort path needs contiguous rows, the bucket table doesn't,
+            # and compaction's argsort + per-column 64-bit gathers were
+            # the dominant map-side cost.
+            for kind, payload, out_schema in (pre_ops or ()):
+                if kind == "filter":
+                    pred = ec.eval_as_column(payload, b)
+                    live = live & pred.data.astype(bool) & pred.validity
+                else:
+                    cols = [ec.eval_as_column(e, b) for e in payload]
+                    b = _TracedBatch(out_schema, cols, b.num_rows,
+                                     b.capacity)
+            return b, live
 
         def decode_word(dtype, word):
             if dtype == T.BOOL:
@@ -403,68 +459,112 @@ class TpuHashAggregate(TpuExec):
             cols = [Column(f.dtype, d, v)
                     for f, d, v in zip(src_schema, datas, valids)]
             b = _TracedBatch(src_schema, cols, num_rows, cap)
-            if pre_ops:
-                b = apply_ops_traced(pre_ops, b)
-            live = jnp.arange(b.capacity) < b.num_rows
+            live = jnp.arange(cap) < num_rows
+            b, live = apply_ops_masked(b, live)
             kcols = [ec.eval_as_column(e, b) for e in bound_keys]
             kwords = [canon.value_words(c, b.num_rows)[0] for c in kcols]
             kvalids = [c.validity for c in kcols]
-            plan, (mins, cards) = agg_k.table_plan(
-                kwords, kvalids, b.num_rows, table)
-            fit = plan.fit
+            bucket, fit, mins, cards = agg_k.table_bucket(
+                kwords, kvalids, live, table)
             icols = [[ec.eval_as_column(e, b) for e in bs] or [None]
                      for bs in bound_inputs]
-            # one fused einsum for every sum/count row
-            rows, row_of = [], {}
+            live_f = jnp.where(live, 1.0, 0.0).astype(jnp.float32)
 
-            def add_row(tag, arr):
-                if tag not in row_of:
-                    row_of[tag] = len(rows)
-                    rows.append(arr)
-            add_row("__ones__", jnp.where(live, 1.0, 0.0).astype(
-                jnp.float32))
+            # collect every reduce row for the ONE fused table-reduce
+            sum_rows, max_rows = [jnp.asarray(live_f)], []
+            srow_of, mrow_of = {"__ones__": 0}, {}
+            agg_meta = []   # per agg: lowering info for the output phase
+
+            def add_sum(tag, arr):
+                if tag not in srow_of:
+                    srow_of[tag] = len(sum_rows)
+                    sum_rows.append(arr)
+
+            def add_max(tag, arr):
+                mrow_of[tag] = len(max_rows)
+                max_rows.append(arr)
+
             for ai, (a, cols_a) in enumerate(zip(self.aggs, icols)):
                 kind = descs[ai][0]
                 c = cols_a[0]
-                if kind == "count" and c is not None:
-                    add_row(("cnt", ai),
-                            jnp.where(live & c.validity, 1.0, 0.0)
-                            .astype(jnp.float32))
+                if kind == "count":
+                    if c is not None:
+                        add_sum(("cnt", ai),
+                                jnp.where(live & c.validity, 1.0, 0.0)
+                                .astype(jnp.float32))
+                    agg_meta.append(None)
                 elif kind in ("fsum", "avg"):
                     ok = live & c.validity
                     v32 = c.data.astype(jnp.float32)
                     fit = fit & jnp.all(
                         jnp.where(ok, jnp.isfinite(v32), True))
-                    add_row(("sum", ai),
-                            jnp.where(ok, v32, 0.0))
-                    add_row(("cnt", ai),
+                    add_sum(("sum", ai), jnp.where(ok, v32, 0.0))
+                    add_sum(("cnt", ai),
                             jnp.where(ok, 1.0, 0.0).astype(jnp.float32))
-                elif kind == "iminmax":
-                    add_row(("cnt", ai),
-                            jnp.where(live & c.validity, 1.0, 0.0)
-                            .astype(jnp.float32))
+                    agg_meta.append(None)
                 elif kind == "fminmax":
+                    want_max = descs[ai][1]
                     ok = live & c.validity
-                    add_row(("cnt", ai),
+                    v32 = c.data.astype(jnp.float32)
+                    # Spark total order: NaN greatest, -0.0 == 0.0
+                    v32 = jnp.where(v32 == 0.0, jnp.float32(0.0), v32)
+                    nan = jnp.isnan(v32)
+                    add_sum(("cnt", ai),
                             jnp.where(ok, 1.0, 0.0).astype(jnp.float32))
-                    # Spark float order: NaN is greatest (kernels seg_min
-                    # doc) — count non-NaN contributions per bucket
-                    add_row(("nn", ai),
-                            jnp.where(ok & ~jnp.isnan(c.data), 1.0, 0.0)
+                    add_sum(("nn", ai),
+                            jnp.where(ok & ~nan, 1.0, 0.0)
                             .astype(jnp.float32))
-            sums = agg_k.table_fsum(rows, plan.bucket, live, table)
-            order = plan.order
-            live_g = jnp.arange(table) < plan.num_groups
+                    add_max(("m", ai),
+                            jnp.where(ok & ~nan,
+                                      v32 if want_max else -v32, NEG_INF))
+                    agg_meta.append(None)
+                elif kind == "iminmax":
+                    want_max = descs[ai][1]
+                    ok = live & c.validity
+                    w = canon.value_words(c, b.num_rows)[0]
+                    any_v = jnp.any(ok)
+                    vmin = jnp.where(
+                        any_v,
+                        jnp.min(jnp.where(ok, w, jnp.uint64(2**64 - 1))),
+                        jnp.uint64(0))
+                    vmax = jnp.where(
+                        any_v, jnp.max(jnp.where(ok, w, jnp.uint64(0))),
+                        jnp.uint64(0))
+                    # reduce rows are f32: exact only below 2^24
+                    fit = fit & ((vmax - vmin) < F32_EXACT)
+                    narrow = jnp.minimum(w - vmin, F32_EXACT) \
+                        .astype(jnp.float32)
+                    add_sum(("cnt", ai),
+                            jnp.where(ok, 1.0, 0.0).astype(jnp.float32))
+                    add_max(("m", ai),
+                            jnp.where(ok, narrow if want_max else -narrow,
+                                      NEG_INF))
+                    agg_meta.append(("vmin", vmin))
+                elif kind == "firstlast":
+                    want_last, ignore_nulls = descs[ai][1], descs[ai][2]
+                    ok = (live & c.validity) if ignore_nulls else live
+                    pos = jnp.arange(cap, dtype=jnp.int32) \
+                        .astype(jnp.float32)
+                    add_max(("m", ai),
+                            jnp.where(ok, pos if want_last else -pos,
+                                      NEG_INF))
+                    agg_meta.append(None)
+
+            sums, maxs = table_reduce(bucket, sum_rows, max_rows, table,
+                                      impl=reduce_impl)
+            counts_all = sums[0]
+            present, order, ng = agg_k.table_compact(counts_all, table)
+            live_g = jnp.arange(table) < ng
 
             def compact(tab):
                 return jnp.take(tab, order)
             # keys: decode bucket digits arithmetically (no gathers)
             key_pairs = []
             strides = []
-            s = jnp.int32(1)
+            st = jnp.int32(1)
             for card in reversed(cards):
-                strides.append(s)
-                s = s * card
+                strides.append(st)
+                st = st * card
             strides = list(reversed(strides))
             for e, wmin, card, stride in zip(bound_keys, mins, cards,
                                              strides):
@@ -478,38 +578,32 @@ class TpuHashAggregate(TpuExec):
                 kind = descs[ai][0]
                 c = cols_a[0]
                 if kind == "count":
-                    cnt = sums[row_of[("cnt", ai)] if c is not None
-                               else row_of["__ones__"]]
+                    cnt = sums[srow_of[("cnt", ai)] if c is not None
+                               else 0]
                     cnt = compact(cnt)
                     buf_groups.append([(
                         jnp.where(live_g, cnt, 0.0).astype(jnp.int64),
                         jnp.ones(table, bool))])
                 elif kind == "fsum":
-                    ssum = compact(sums[row_of[("sum", ai)]])
-                    cntv = compact(sums[row_of[("cnt", ai)]])
+                    ssum = compact(sums[srow_of[("sum", ai)]])
+                    cntv = compact(sums[srow_of[("cnt", ai)]])
                     dt = a.func.buffer_dtypes()[0]
                     buf_groups.append([(
                         ssum.astype(dt.np_dtype),
                         (cntv > 0) & live_g)])
                 elif kind == "avg":
-                    ssum = compact(sums[row_of[("sum", ai)]])
-                    cntv = compact(sums[row_of[("cnt", ai)]])
+                    ssum = compact(sums[srow_of[("sum", ai)]])
+                    cntv = compact(sums[srow_of[("cnt", ai)]])
                     buf_groups.append([
                         (ssum.astype(jnp.float64), live_g),
                         (cntv.astype(jnp.int64), live_g)])
                 elif kind == "fminmax":
                     want_max = descs[ai][1]
-                    ok = live & c.validity
-                    v32 = c.data.astype(jnp.float32)
-                    # Spark total order: NaN greatest, -0.0 == 0.0
-                    v32 = jnp.where(v32 == 0.0, jnp.float32(0.0), v32)
-                    nan = jnp.isnan(v32)
-                    m = agg_k.table_scatter_min(
-                        v32, ok & ~nan, plan.bucket, table,
-                        want_max=want_max)
-                    cntv = compact(sums[row_of[("cnt", ai)]])
-                    nnv = compact(sums[row_of[("nn", ai)]])
-                    m = compact(m)
+                    m = compact(maxs[mrow_of[("m", ai)]])
+                    if not want_max:
+                        m = -m
+                    cntv = compact(sums[srow_of[("cnt", ai)]])
+                    nnv = compact(sums[srow_of[("nn", ai)]])
                     if want_max:
                         # any NaN in the group wins
                         m = jnp.where(cntv > nnv, jnp.float32(jnp.nan), m)
@@ -521,45 +615,27 @@ class TpuHashAggregate(TpuExec):
                                         (cntv > 0) & live_g)])
                 elif kind == "iminmax":
                     want_max = descs[ai][1]
-                    ok = live & c.validity
-                    w = canon.value_words(c, b.num_rows)[0]
-                    any_v = jnp.any(ok)
-                    vmin = jnp.where(
-                        any_v,
-                        jnp.min(jnp.where(ok, w, jnp.uint64(2**64 - 1))),
-                        jnp.uint64(0))
-                    vmax = jnp.where(
-                        any_v, jnp.max(jnp.where(ok, w, jnp.uint64(0))),
-                        jnp.uint64(0))
-                    fit = fit & ((vmax - vmin) < (jnp.uint64(1) << 32))
-                    narrow = jnp.minimum(
-                        w - vmin, jnp.uint64(2**32 - 1)).astype(jnp.uint32)
-                    m = agg_k.table_scatter_min(narrow, ok, plan.bucket,
-                                                table, want_max=want_max)
-                    word = vmin + compact(m).astype(jnp.uint64)
-                    cntv = compact(sums[row_of[("cnt", ai)]])
+                    vmin = agg_meta[ai][1]
+                    m = compact(maxs[mrow_of[("m", ai)]])
+                    if not want_max:
+                        m = -m
+                    word = vmin + jnp.maximum(m, 0).astype(jnp.uint64)
+                    cntv = compact(sums[srow_of[("cnt", ai)]])
                     dt = a.func.buffer_dtypes()[0]
                     buf_groups.append([(
-                        decode_word_minmax(dt, word),
+                        decode_word(dt, word),
                         (cntv > 0) & live_g)])
                 elif kind == "firstlast":
-                    want_last, ignore_nulls = descs[ai][1], descs[ai][2]
-                    ok = (live & c.validity) if ignore_nulls else live
-                    pos, has = agg_k.table_first_pos(
-                        ok, plan.bucket, table, want_last=want_last)
-                    pos_g = compact(pos)
-                    has_g = compact(has) & live_g
+                    want_last = descs[ai][1]
+                    m = compact(maxs[mrow_of[("m", ai)]])
+                    has_g = (m > NEG_INF) & live_g
+                    if not want_last:
+                        m = -m
+                    pos_g = jnp.clip(m, 0, cap - 1).astype(jnp.int32)
                     data = jnp.take(c.data, pos_g)
                     vld = jnp.take(c.validity, pos_g)
                     buf_groups.append([(data, has_g & vld)])
-            return (fit.astype(jnp.int32), plan.num_groups,
-                    key_pairs, buf_groups)
-
-        def decode_word_minmax(dt, word):
-            if dt == T.BOOL:
-                return word != 0
-            v = (word ^ jnp.uint64(SIGN)).astype(jnp.int64)
-            return v.astype(dt.np_dtype)
+            return (fit.astype(jnp.int32), ng, key_pairs, buf_groups)
 
         return _core
 
@@ -605,12 +681,15 @@ class TpuHashAggregate(TpuExec):
                            for a in self.aggs))
         return cache_key, bound_keys, bound_inputs
 
-    def _fused_whole_stage_core(self, batch: ColumnarBatch):
+    def _fused_whole_stage_core(self, batch: ColumnarBatch,
+                                emit_buffers: bool = True):
         """scan-side filter/project chain + key eval + grouping + update
-        as ONE jitted program (whole-stage codegen role, exec/staged.py).
+        + output assembly as ONE jitted program (whole-stage codegen
+        role, exec/staged.py).
 
-        Returns (GroupPlan, agg_buffers, key_cols) or None to fall back
-        (the caller then applies pre_ops eagerly)."""
+        Returns (num_groups, [(data, validity)] output pairs in schema
+        order) or None to fall back (the caller then applies pre_ops
+        eagerly)."""
         import jax
         import logging
         from .fused import _TracedBatch, _tree_fusable, expr_signature
@@ -634,6 +713,7 @@ class TpuHashAggregate(TpuExec):
         if prep is False:
             return None
         cache_key, bound_keys, bound_inputs = prep
+        cache_key = cache_key + (emit_buffers,)
         core = TpuHashAggregate._CORE_CACHE.get(cache_key)
         if core is False:
             return None
@@ -651,65 +731,54 @@ class TpuHashAggregate(TpuExec):
                 kcols = [ec.eval_as_column(e, b) for e in bound_keys]
                 words = canon.batch_key_words(kcols, b.num_rows)
                 plan = agg_k.groupby_plan(words)
-                outs = []
+                agg_buffers = []
                 for a, bs in zip(aggs, bound_inputs):
                     cols2 = [ec.eval_as_column(e, b) for e in bs] or [None]
-                    bufs = a.func.update(plan, cols2)
-                    outs.append([(x.data, x.validity) for x in bufs])
-                return ((plan.perm, plan.seg_id, plan.live_sorted,
-                         plan.rep_indices, plan.num_groups), outs,
-                        [(k.data, k.validity) for k in kcols])
+                    agg_buffers.append(a.func.update(plan, cols2))
+                return _assemble_group_output(plan, kcols, aggs,
+                                              agg_buffers, cap,
+                                              emit_buffers)
             core = jax.jit(_core)
             TpuHashAggregate._CORE_CACHE[cache_key] = core
         datas = tuple(c.data for c in batch.columns)
         valids = tuple(c.validity for c in batch.columns)
         try:
-            (perm, seg_id, live, rep, ng), bufs_flat, key_pairs = core(
-                datas, valids, batch.rows_dev)
+            return core(datas, valids, batch.rows_dev)
         except Exception:  # noqa: BLE001 - fall back, but loudly
             logging.getLogger("spark_rapids_tpu.exec.aggregate").warning(
                 "whole-stage aggregate core failed; falling back",
                 exc_info=True)
             TpuHashAggregate._CORE_CACHE[cache_key] = False
             return None
-        plan = agg_k.GroupPlan(perm, seg_id, live, rep, ng)
-        agg_buffers = []
-        for a, pairs in zip(self.aggs, bufs_flat):
-            dts = a.func.buffer_dtypes()
-            agg_buffers.append([Column(dt, d, v)
-                                for dt, (d, v) in zip(dts, pairs)])
-        key_cols = [Column(e.dtype(), d, v)
-                    for e, (d, v) in zip(bound_keys, key_pairs)]
-        return plan, agg_buffers, key_cols
 
     # -- core -------------------------------------------------------------------
     def _aggregate_batch(self, batch: ColumnarBatch,
                          emit_buffers: bool = False,
                          no_table: bool = False) -> ColumnarBatch:
-        plan = agg_buffers = key_cols = None
         if not no_table and self.mode == PARTIAL and self.group_exprs:
             t = self._fused_table_core(batch)
             if t is not None:
                 return t
+        emit = emit_buffers or self.mode == PARTIAL
+        out_schema_obj = buffer_schema(self.group_exprs, self.aggs) \
+            if emit else self.output_schema
         if self.pre_ops and self.mode in (PARTIAL, COMPLETE):
-            if self.group_exprs:
-                ws = self._fused_whole_stage_core(batch)
-            else:
-                ws = None
+            ws = self._fused_whole_stage_core(batch, emit) \
+                if self.group_exprs else None
             if ws is not None:
-                plan, agg_buffers, key_cols = ws
-            else:
-                from .staged import apply_ops_eager, build_fused_per_op
-                fkey = ("fpo", tuple(f.dtype.name for f in batch.schema))
-                fpo = self._ws_memo.get(fkey)
-                if fpo is None:
-                    fpo = build_fused_per_op(self.pre_ops, batch.schema)
-                    self._ws_memo[fkey] = fpo
-                batch = apply_ops_eager(self.pre_ops, batch, fpo)
+                ng, pairs = ws
+                cols = [Column(f.dtype, d, v)
+                        for f, (d, v) in zip(out_schema_obj, pairs)]
+                return ColumnarBatch(out_schema_obj, cols, LazyCount(ng))
+            from .staged import apply_ops_eager, build_fused_per_op
+            fkey = ("fpo", tuple(f.dtype.name for f in batch.schema))
+            fpo = self._ws_memo.get(fkey)
+            if fpo is None:
+                fpo = build_fused_per_op(self.pre_ops, batch.schema)
+                self._ws_memo[fkey] = fpo
+            batch = apply_ops_eager(self.pre_ops, batch, fpo)
         child_schema = batch.schema
-        if plan is not None:
-            input_cols = None
-        elif self.mode in (PARTIAL, COMPLETE):
+        if self.mode in (PARTIAL, COMPLETE):
             key_cols = [ec.eval_as_column(e.bind(child_schema), batch)
                         for e in self.group_exprs]
             input_cols = []
@@ -730,22 +799,21 @@ class TpuHashAggregate(TpuExec):
             return self._global_agg(batch, input_cols, emit_buffers)
 
         update_mode = self.mode in (PARTIAL, COMPLETE)
-        if plan is not None:
-            fused = (plan, agg_buffers)
-        else:
-            fused = self._fused_agg_core(key_cols, input_cols, update_mode,
-                                         batch)
+        fused = self._fused_agg_core(key_cols, input_cols, update_mode,
+                                     batch, emit)
         if fused is not None:
-            plan, agg_buffers = fused
-        else:
-            words = canon.batch_key_words(key_cols, batch.rows_dev)
-            plan = agg_k.groupby_plan(words)
-            # aggregate buffers (segment-id indexed, 0..G-1, input capacity)
-            agg_buffers = []
-            for a, cols in zip(self.aggs, input_cols):
-                bufs = a.func.update(plan, cols) if update_mode else \
-                    a.func.merge(plan, cols)
-                agg_buffers.append(bufs)
+            ng, pairs = fused
+            cols = [Column(f.dtype, d, v)
+                    for f, (d, v) in zip(out_schema_obj, pairs)]
+            return ColumnarBatch(out_schema_obj, cols, LazyCount(ng))
+        words = canon.batch_key_words(key_cols, batch.rows_dev)
+        plan = agg_k.groupby_plan(words)
+        # aggregate buffers (segment-id indexed, 0..G-1, input capacity)
+        agg_buffers = []
+        for a, cols in zip(self.aggs, input_cols):
+            bufs = a.func.update(plan, cols) if update_mode else \
+                a.func.merge(plan, cols)
+            agg_buffers.append(bufs)
         # group count stays on device: per-batch int(num_groups) pulls
         # were the engine's dominant cost on remote-dispatch hardware
         # (LazyCount doc); output capacity = input capacity (groups <=
@@ -782,35 +850,81 @@ class TpuHashAggregate(TpuExec):
     def _global_agg(self, batch: ColumnarBatch,
                     input_cols: List[List[Column]],
                     emit_buffers: bool = False) -> ColumnarBatch:
-        """No group keys: aggregate everything into one row (one segment)."""
-        cap = batch.capacity
-        const = Column(T.INT64, jnp.zeros(cap, jnp.int64),
-                       jnp.arange(cap) < batch.rows_dev)
-        words = canon.batch_key_words([const], batch.rows_dev)
-        plan = agg_k.groupby_plan(words)
-        out_cap = bucket_capacity(1)
-        out_cols: List[Column] = []
-        # device-side emptiness flag: no per-batch host sync
-        has_rows = batch.rows_dev > 0
-        for a, cols in zip(self.aggs, input_cols):
-            if self.mode in (PARTIAL, COMPLETE):
-                bufs = a.func.update(plan, cols)
-            else:
-                bufs = a.func.merge(plan, cols)
-            outs = bufs if (self.mode == PARTIAL or emit_buffers) \
-                else [a.func.finalize(bufs)]
-            for o in outs:
-                c = o.gather(jnp.zeros(out_cap, jnp.int32))
-                live = jnp.arange(out_cap) < 1
-                from ..expr.aggregates import Count
-                if isinstance(a.func, Count):
-                    # counts are valid even over empty input (0)
-                    c = Column(T.INT64,
-                               jnp.where(live, c.data.astype(jnp.int64), 0),
-                               live)
-                else:
-                    c = c.mask_validity(live & has_rows)
-                out_cols.append(c)
+        """No group keys: aggregate everything into one row (one segment).
+
+        The whole computation is one jitted program (eager dispatches
+        cost ~7ms each on the remote backend, columnar/pending.py doc);
+        falls back to the traced body run eagerly for exotic columns."""
+        from ..expr.aggregates import Count
+        update_mode = self.mode in (PARTIAL, COMPLETE)
+        emit = emit_buffers or self.mode == PARTIAL
         out_schema = buffer_schema(self.group_exprs, self.aggs) \
-            if emit_buffers else self.output_schema
+            if emit else self.output_schema
+        aggs = self.aggs
+        in_dts = tuple(tuple(None if c is None else c.dtype for c in cols)
+                       for cols in input_cols)
+        cap0 = batch.capacity  # captured as int: the closure must not pin
+        # the batch (jit cores are cached class-level and would leak it)
+
+        def _core(in_arrays, num_rows):
+            const = Column(T.INT64, jnp.zeros(cap0, jnp.int64),
+                           jnp.arange(cap0) < num_rows)
+            words = canon.batch_key_words([const], num_rows)
+            plan = agg_k.groupby_plan(words)
+            out_cap = bucket_capacity(1)
+            has_rows = num_rows > 0
+            outs = []
+            it = iter(in_arrays)
+            for a, dts in zip(aggs, in_dts):
+                cols = [None if dt is None else Column(dt, *next(it))
+                        for dt in dts] or [None]
+                bufs = a.func.update(plan, cols) if update_mode \
+                    else a.func.merge(plan, cols)
+                cols_out = bufs if emit else [a.func.finalize(bufs)]
+                for o in cols_out:
+                    c = o.gather(jnp.zeros(out_cap, jnp.int32))
+                    live = jnp.arange(out_cap) < 1
+                    if isinstance(a.func, Count):
+                        # counts are valid even over empty input (0)
+                        c = Column(T.INT64,
+                                   jnp.where(live,
+                                             c.data.astype(jnp.int64), 0),
+                                   live)
+                    else:
+                        c = c.mask_validity(live & has_rows)
+                    outs.append((c.data, c.validity))
+            return outs
+
+        plain = all(c is None or type(c) is Column
+                    for cols in input_cols for c in cols)
+        in_arrays = tuple((c.data, c.validity)
+                          for cols in input_cols for c in cols
+                          if c is not None)
+        pairs = None
+        if plain:
+            import jax
+            import logging
+            cache_key = ("global", update_mode, emit, in_dts,
+                         batch.capacity,
+                         tuple((type(a.func).__name__, repr(a.func),
+                                getattr(a.func, "ignore_nulls", None))
+                               for a in aggs))
+            core = TpuHashAggregate._CORE_CACHE.get(cache_key)
+            if core is not False:
+                if core is None:
+                    core = jax.jit(_core)
+                    TpuHashAggregate._CORE_CACHE[cache_key] = core
+                try:
+                    pairs = core(in_arrays, batch.rows_dev)
+                except Exception:  # noqa: BLE001 - fall back, but loudly
+                    logging.getLogger(
+                        "spark_rapids_tpu.exec.aggregate").warning(
+                        "global aggregate core failed; falling back",
+                        exc_info=True)
+                    TpuHashAggregate._CORE_CACHE[cache_key] = False
+                    pairs = None
+        if pairs is None:
+            pairs = _core(in_arrays, batch.rows_dev)
+        out_cols = [Column(f.dtype, d, v)
+                    for f, (d, v) in zip(out_schema, pairs)]
         return ColumnarBatch(out_schema, out_cols, 1)

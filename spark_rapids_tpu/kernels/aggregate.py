@@ -189,33 +189,21 @@ def seg_last_index(plan: GroupPlan, validity, ignore_nulls: bool = True):
 # ---------------------------------------------------------------------------
 
 
-@dataclasses.dataclass
-class TablePlan:
-    """Per-row bucket assignment + compact group directory."""
-    bucket: jnp.ndarray      # int32[cap]; == table for dead/padding rows
-    table: int               # static table size (power of two)
-    live: jnp.ndarray        # bool[cap] rows inside num_rows
-    counts: jnp.ndarray      # f32[table] live rows per bucket
-    present: jnp.ndarray     # bool[table]
-    order: jnp.ndarray       # int32[table] bucket id of group g (ascending)
-    num_groups: jnp.ndarray  # scalar int32
-    fit: jnp.ndarray         # scalar bool — table assumptions held
-
-
-def table_plan(key_words, key_valids, num_rows, table: int):
-    """Mixed-radix bucket plan over single-word keys.
+def table_bucket(key_words, key_valids, live, table: int):
+    """Mixed-radix bucket assignment over single-word keys.
 
     key_words: one uint64 word per key (canon.value_words[0]);
-    key_valids: per-key validity.  Each key contributes digit 0 for null
-    and 1 + (word - min) otherwise; digits pack most-significant-first,
-    so bucket ascending == (null-first key tuple) ascending — matching
-    the sort path's group order.
-    Returns (TablePlan, (mins, cards)) — mins/cards feed key decode.
+    key_valids: per-key validity; live: row mask (in-range AND past any
+    folded-in filters).  Each key contributes digit 0 for null and
+    1 + (word - min) otherwise; digits pack most-significant-first, so
+    bucket ascending == (nulls-first key tuple) ascending — matching the
+    sort path's group order.  Dead rows get bucket == table.
+    Returns (bucket i32[cap], fit bool, mins, cards).
     """
     cap = key_words[0].shape[0]
-    live = jnp.arange(cap) < num_rows
     bucket = jnp.zeros(cap, jnp.int32)
     total = jnp.uint64(1)
+    fit = jnp.bool_(True)
     mins, cards = [], []
     for w, valid in zip(key_words, key_valids):
         lv = live & valid
@@ -240,63 +228,14 @@ def table_plan(key_words, key_valids, num_rows, table: int):
         cards.append(card)
     fit = total <= jnp.uint64(table)
     bucket = jnp.where(live, bucket, table).astype(jnp.int32)
-    counts = table_fsum([jnp.ones(cap, jnp.float32)], bucket, live, table)[0]
+    return bucket, fit, mins, cards
+
+
+def table_compact(counts, table: int):
+    """Group directory from per-bucket live counts: (present, order,
+    num_groups) where order[g] = bucket of group g, ascending."""
     present = counts > 0
     num_groups = jnp.sum(present).astype(jnp.int32)
-    # group g -> g-th present bucket, ascending (argsort of 4k bools)
     order = jnp.argsort(jnp.where(present, 0, 1), stable=True) \
         .astype(jnp.int32)
-    return TablePlan(bucket, table, live, counts, present, order,
-                     num_groups, fit), (mins, cards)
-
-
-def table_fsum(rows, bucket, live, table: int, chunk: int = 2048):
-    """Per-bucket f32 sums of several value rows via ONE one-hot matmul.
-
-    rows: list of f32[cap] contribution arrays (already masked: dead
-    rows must contribute 0).  Lowered as einsum('vrc,rcg->vg') — XLA
-    fuses the one-hot, so this rides the MXU at ~5x the speed of a
-    scatter and ~20x a 64-bit scatter.  Counts stay exact below 2^24
-    rows (batch capacities are capped well under that)."""
-    cap = bucket.shape[0]
-    c = min(cap, chunk)
-    r = cap // c
-    oh = jax.nn.one_hot(bucket.reshape(r, c), table + 1, dtype=jnp.float32)
-    vals = jnp.stack(rows, 0).reshape(len(rows), r, c)
-    # HIGHEST precision: the default TPU matmul path multiplies in bf16
-    # (3 significant digits), which is far outside float-agg tolerance;
-    # the f32 6-pass mode keeps accumulation at plain-f32 error.
-    out = jnp.einsum("vrc,rcg->vg", vals, oh,
-                     precision=jax.lax.Precision.HIGHEST)
-    return [out[i][:table] for i in range(len(rows))]
-
-
-def table_scatter_min(values, ok, bucket, table: int, want_max=False):
-    """Per-bucket min/max via a small-output f32/u32/i32 scatter.
-    values must be 32-bit; invalid rows are masked to the identity."""
-    if jnp.issubdtype(values.dtype, jnp.floating):
-        ident = jnp.array(jnp.inf if not want_max else -jnp.inf,
-                          values.dtype)
-    else:
-        info = jnp.iinfo(values.dtype)
-        ident = jnp.array(info.max if not want_max else info.min,
-                          values.dtype)
-    contrib = jnp.where(ok, values, ident)
-    op = jax.ops.segment_max if want_max else jax.ops.segment_min
-    return op(contrib, bucket, num_segments=table + 1)[:table]
-
-
-def table_first_pos(ok, bucket, table: int, want_last=False):
-    """Row position of the first/last qualifying row per bucket
-    (i32 scatter).  Returns (pos[table], has[table])."""
-    cap = bucket.shape[0]
-    pos = jnp.arange(cap, dtype=jnp.int32)
-    if want_last:
-        contrib = jnp.where(ok, pos, jnp.int32(-1))
-        best = jax.ops.segment_max(contrib, bucket,
-                                   num_segments=table + 1)[:table]
-        return jnp.maximum(best, 0), best >= 0
-    contrib = jnp.where(ok, pos, jnp.int32(cap))
-    best = jax.ops.segment_min(contrib, bucket,
-                               num_segments=table + 1)[:table]
-    return jnp.minimum(best, cap - 1), best < cap
+    return present, order, num_groups

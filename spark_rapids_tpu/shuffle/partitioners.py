@@ -99,6 +99,8 @@ class SinglePartitioner(Partitioner):
 class HashPartitioner(Partitioner):
     """murmur-style hash of key columns mod n (GpuHashPartitioning role)."""
 
+    _SPLIT_JIT: dict = {}
+
     def __init__(self, key_exprs: List[ec.Expression], num_partitions: int,
                  schema=None):
         self.key_exprs = key_exprs
@@ -117,6 +119,83 @@ class HashPartitioner(Partitioner):
                                             jnp.uint64(0x9E3779B97F4A7C15)))
         from ..kernels.pallas_ops import hash_partition_ids
         return hash_partition_ids(word_lists, self.num_partitions)
+
+    def split_staged(self, batch: ColumnarBatch):
+        """Whole split (key eval + hash + sort + counts + gather of every
+        column) as ONE jitted program for plain fixed-width batches —
+        eager dispatches cost ~7ms each on the remote backend
+        (columnar/pending.py doc)."""
+        from ..exec.fused import _TracedBatch, _tree_fusable, expr_signature
+        if not batch.columns or \
+                not all(type(c) is Column for c in batch.columns):
+            return super().split_staged(batch)
+        try:
+            bound = [e.bind(batch.schema) for e in self.key_exprs]
+        except KeyError:
+            return super().split_staged(batch)
+        if not all(_tree_fusable(e) for e in bound):
+            return super().split_staged(batch)
+        sigs = tuple(expr_signature(e) for e in bound)
+        if any(s is None for s in sigs):
+            return super().split_staged(batch)
+        key = (sigs, tuple(f.dtype.name for f in batch.schema),
+               self.num_partitions)
+        fn = HashPartitioner._SPLIT_JIT.get(key)
+        if fn is False:
+            return super().split_staged(batch)
+        if fn is None:
+            schema = batch.schema
+            nparts = self.num_partitions
+
+            def _prog(datas, valids, num_rows):
+                cap = datas[0].shape[0]
+                cols = [Column(f.dtype, d, v)
+                        for f, d, v in zip(schema, datas, valids)]
+                b = _TracedBatch(schema, cols, num_rows, cap)
+                word_lists = []
+                for e in bound:
+                    col = ec.eval_as_column(e, b)
+                    for w in canon.value_words(col, num_rows):
+                        word_lists.append(jnp.where(
+                            col.validity, w,
+                            jnp.uint64(0x9E3779B97F4A7C15)))
+                # plain jnp mixing chain: inside this jit XLA fuses it as
+                # well as the standalone Pallas kernel does (the Pallas
+                # call also fails to lower under an enclosing jit on the
+                # tunnelled backend)
+                h = bk.hash_words(word_lists)
+                pids = (h % jnp.uint64(nparts)).astype(jnp.int32)
+                in_range = jnp.arange(cap) < num_rows
+                sort_key = jnp.where(in_range, pids.astype(jnp.uint32),
+                                     jnp.uint32(nparts))
+                perm = jnp.arange(cap, dtype=jnp.int32)
+                sk, perm = lax.sort((sort_key, perm), num_keys=1,
+                                    is_stable=True)
+                bounds = jnp.searchsorted(
+                    sk, jnp.arange(nparts + 1, dtype=jnp.uint32),
+                    side="left")
+                pairs = [(jnp.take(d, perm, axis=0, mode="clip"),
+                          jnp.take(v, perm, axis=0, mode="clip"))
+                         for d, v in zip(datas, valids)]
+                return pairs, jnp.diff(bounds)
+            import jax as _jax
+            fn = _jax.jit(_prog)
+            if len(HashPartitioner._SPLIT_JIT) < 4096:
+                HashPartitioner._SPLIT_JIT[key] = fn
+        try:
+            pairs, counts = fn(tuple(c.data for c in batch.columns),
+                               tuple(c.validity for c in batch.columns),
+                               batch.rows_dev)
+        except Exception:  # noqa: BLE001 - fall back, but loudly
+            import logging
+            logging.getLogger("spark_rapids_tpu.shuffle").warning(
+                "fused split failed; falling back", exc_info=True)
+            HashPartitioner._SPLIT_JIT[key] = False
+            return super().split_staged(batch)
+        cols = [Column(c.dtype, d, v)
+                for c, (d, v) in zip(batch.columns, pairs)]
+        sorted_batch = ColumnarBatch(batch.schema, cols, batch.rows_lazy)
+        return sorted_batch, LazyArray(counts)
 
 
 class RoundRobinPartitioner(Partitioner):

@@ -194,3 +194,82 @@ class TestBasic:
         # deterministic
         h2 = basic.hash_words(canon.value_words(col, 100))
         assert (np.asarray(h) == np.asarray(h2)).all()
+
+
+class TestTableGroupby:
+    """Sort-free bucket-table group-by kernels (kernels/aggregate.py
+    table_bucket/table_compact + pallas_ops.table_reduce)."""
+
+    def test_table_bucket_single_key(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from spark_rapids_tpu.kernels import aggregate as agg_k
+        k = jnp.asarray(np.array([5, 7, 5, 9, 7, 5], np.int64))
+        w = (k.astype(jnp.int64).astype(jnp.uint64) ^
+             jnp.uint64(1 << 63))
+        valid = jnp.array([True, True, True, True, True, False])
+        live = jnp.ones(6, bool)
+        bucket, fit, mins, cards = agg_k.table_bucket(
+            [w], [valid], live, 64)
+        b = np.asarray(bucket)
+        assert bool(fit)
+        # same keys share buckets; invalid row gets the null digit 0
+        assert b[0] == b[2] == b[5 - 5]
+        assert b[1] == b[4]
+        assert b[5] == 0  # null digit (valid=False, live=True)
+
+    def test_table_bucket_overflow_sets_fit_false(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from spark_rapids_tpu.kernels import aggregate as agg_k
+        k = jnp.asarray(np.array([0, 10**12], np.int64))
+        w = (k.astype(jnp.uint64)) ^ jnp.uint64(1 << 63)
+        valid = jnp.ones(2, bool)
+        bucket, fit, _, _ = agg_k.table_bucket(
+            [w], [valid], jnp.ones(2, bool), 64)
+        assert not bool(fit)
+
+    def test_table_reduce_scatter_and_compact(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from spark_rapids_tpu.kernels import aggregate as agg_k
+        from spark_rapids_tpu.kernels.pallas_ops import table_reduce
+        n, T = 4096, 64
+        rng = np.random.default_rng(3)
+        b = jnp.asarray(rng.integers(0, 10, n).astype(np.int32))
+        v = jnp.asarray(rng.random(n).astype(np.float32))
+        ones = jnp.ones(n, jnp.float32)
+        sums, maxs = table_reduce(
+            b, [ones, v], [jnp.where(v > 0, v, -jnp.inf)], T)
+        ref_c = np.zeros(T)
+        np.add.at(ref_c, np.asarray(b), 1.0)
+        ref_s = np.zeros(T)
+        np.add.at(ref_s, np.asarray(b), np.asarray(v, np.float64))
+        ref_m = np.full(T, -np.inf)
+        np.maximum.at(ref_m, np.asarray(b), np.asarray(v))
+        assert np.allclose(np.asarray(sums[0]), ref_c)
+        assert np.allclose(np.asarray(sums[1]), ref_s, rtol=1e-5)
+        got_m = np.asarray(maxs[0])
+        assert np.allclose(np.where(np.isfinite(got_m), got_m, -1),
+                           np.where(np.isfinite(ref_m), ref_m, -1))
+        present, order, ng = agg_k.table_compact(sums[0], T)
+        assert int(ng) == 10
+        assert np.array_equal(np.asarray(order)[:10], np.arange(10))
+
+    def test_variable_float_agg_conf_off_matches_exact(self):
+        import numpy as np
+        from tests.harness import (assert_tpu_and_cpu_are_equal_collect)
+        from spark_rapids_tpu.api import functions as F
+        rng = np.random.default_rng(11)
+        n = 5000
+        data = {"k": rng.integers(0, 20, n).astype(np.int64),
+                "x": rng.random(n)}
+
+        def q(s):
+            df = s.create_dataframe(data, num_partitions=2)
+            return df.group_by("k").agg(F.sum("x").alias("sx"),
+                                        F.min("x").alias("mn"))
+        # exact mode: disable f32 accumulation -> bit-exact vs CPU
+        assert_tpu_and_cpu_are_equal_collect(
+            q, conf={"spark.rapids.tpu.sql.variableFloatAgg.enabled":
+                     False})
