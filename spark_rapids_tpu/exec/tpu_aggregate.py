@@ -365,7 +365,7 @@ class TpuHashAggregate(TpuExec):
         if not conf.get(AGG_TABLE_ENABLED):
             return None
         table = int(conf.get(AGG_TABLE_SIZE))
-        if batch.capacity < table or batch.capacity > (1 << 21) or \
+        if batch.capacity < table or batch.capacity > (1 << 25) or \
                 not batch.columns:
             return None
         if not all(type(c) is Column for c in batch.columns):

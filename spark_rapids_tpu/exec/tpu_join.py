@@ -79,11 +79,20 @@ class TpuHashJoinBase(TpuExec):
             stream_keys = [e.bind(rschema) for e in lg.right_keys]
 
         with timed(self.metrics[BUILD_TIME]):
-            if build_batches:
-                build = concat_batches(build_batches)
+            # broadcast joins run every stream partition against the SAME
+            # build batches: sort the build table once per exec
+            bb_key = tuple(id(b) for b in build_batches)
+            memo = getattr(self, "_build_memo", None)
+            if memo is not None and memo[0] == bb_key:
+                build, bkey_cols = memo[1], memo[2]
             else:
-                build = ColumnarBatch.empty(build_schema)
-            bkey_cols = [ec.eval_as_column(e, build) for e in build_keys]
+                if build_batches:
+                    build = concat_batches(build_batches)
+                else:
+                    build = ColumnarBatch.empty(build_schema)
+                bkey_cols = [ec.eval_as_column(e, build)
+                             for e in build_keys]
+                self._build_memo = (bb_key, build, bkey_cols)
 
         stream_batches = list(stream_iter)
         if not stream_batches:
@@ -104,16 +113,40 @@ class TpuHashJoinBase(TpuExec):
             else:
                 str_words.append(None)
 
-        bwords = _key_words(bkey_cols, build.num_rows, str_words)
-        bt = join_k.build(bwords)
+        memo = getattr(self, "_build_memo", None)
+        if memo is not None and len(memo) > 3 and memo[0] == bb_key:
+            bt, direct = memo[3], memo[4]
+        else:
+            bwords = _key_words(bkey_cols, build.num_rows, str_words)
+            bt = join_k.build(bwords)
+            direct = self._prepare_direct(bt, bkey_cols, build) \
+                if lg.condition is None and lg.join_type != "full" \
+                else None
+            self._build_memo = (bb_key, build, bkey_cols, bt, direct)
 
         build_matched = np.zeros(build.capacity, dtype=bool) \
             if lg.join_type == "full" else None
 
+        # Phase A: probe counts for EVERY stream batch first; the output
+        # sizes (total matches) stage into the pending pool so one fused
+        # flush covers all of them (columnar/pending.py).  Phase B then
+        # expands/gathers with host-known output capacities.
+        phase_a = []
         for sb, skey_cols in zip(stream_batches, skey_cols_per_batch):
             with timed(self.metrics[JOIN_TIME]):
-                out = self._join_batch(sb, skey_cols, build, bt, str_words,
-                                       build_matched)
+                phase_a.append(self._probe_phase(sb, skey_cols, bt,
+                                                 str_words,
+                                                 build_matched, direct))
+        from ..columnar import pending
+        pending.flush()
+        for (sb, skey_cols), pa in zip(
+                zip(stream_batches, skey_cols_per_batch), phase_a):
+            with timed(self.metrics[JOIN_TIME]):
+                if pa is None:   # legacy eager path (full/residual/etc)
+                    out = self._join_batch(sb, skey_cols, build, bt,
+                                           str_words, build_matched)
+                else:
+                    out = self._expand_phase(sb, build, bt, *pa)
             if out is not None:
                 self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
                 yield out
@@ -124,6 +157,231 @@ class TpuHashJoinBase(TpuExec):
             if out is not None and out.num_rows > 0:
                 self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
                 yield out
+
+    # -- fused probe/expand (one program each; totals via pending pool) --
+    _PROBE_JIT: dict = {}
+    _EXPAND_JIT: dict = {}
+
+    # max entries in the direct-address probe table (64 MB of i32 HBM)
+    _DIRECT_MAX_RANGE = 1 << 24
+
+    def _prepare_direct(self, bt, bkey_cols, build):
+        """Direct-address probe tables for single fixed-width int keys.
+
+        The general probe is a vectorized binary search — ~2*log2(build)
+        random 64-bit gathers per probe batch, the dominant join cost on
+        TPU.  When the build side has ONE int-family key whose value
+        range fits a table, matching becomes two i32 gathers: per key k,
+        hist[k - min] = #build rows, excl[k - min] = first position in
+        the SORTED build.  Dimension keys are dense ints in practice
+        (TPC-DS/mortgage), so this covers the hot joins; wide/multi/string
+        keys keep the binary search.  One host sync per build (cached).
+        """
+        if len(bkey_cols) != 1 or type(bkey_cols[0]) is not Column:
+            return None
+        dt = bkey_cols[0].dtype
+        if not (dt.is_integral or dt in (T.DATE, T.TIMESTAMP) or
+                isinstance(dt, T.DecimalType)):
+            return None
+        import jax
+        c = bkey_cols[0]
+        w = canon.value_words(c, build.num_rows)[0]
+
+        @jax.jit
+        def _minmax(w, validity, num_rows):
+            valid = validity & (jnp.arange(validity.shape[0]) < num_rows)
+            any_v = jnp.any(valid)
+            wmin = jnp.where(any_v,
+                             jnp.min(jnp.where(valid, w,
+                                               jnp.uint64(2**64 - 1))),
+                             jnp.uint64(0))
+            wmax = jnp.where(any_v,
+                             jnp.max(jnp.where(valid, w, jnp.uint64(0))),
+                             jnp.uint64(0))
+            nvalid = jnp.sum(valid)
+            return wmin, wmax, nvalid
+        wmin, wmax, nvalid = _minmax(w, c.validity,
+                                     jnp.int32(build.num_rows))
+        # one host pull per build table (cached on the exec)
+        import numpy as _np
+        wmin_h, wmax_h = int(_np.asarray(wmin)), int(_np.asarray(wmax))
+        nnull_h = build.num_rows - int(_np.asarray(nvalid))
+        rng = wmax_h - wmin_h + 1
+        if rng <= 0 or rng > self._DIRECT_MAX_RANGE:
+            return None
+        tbl = bucket_capacity(rng)
+
+        @jax.jit
+        def _tables(w, validity, num_rows, wmin, nnull):
+            valid = validity & (jnp.arange(validity.shape[0]) < num_rows)
+            idx = jnp.clip((w - wmin).astype(jnp.int32), 0, tbl - 1)
+            contrib = jnp.where(valid, idx, tbl)
+            hist = jnp.bincount(contrib, length=tbl + 1)[:tbl] \
+                .astype(jnp.int32)
+            excl = (jnp.cumsum(hist) - hist + nnull).astype(jnp.int32)
+            return hist, excl
+        hist, excl = _tables(w, c.validity, jnp.int32(build.num_rows),
+                             wmin, jnp.int32(nnull_h))
+        return (jnp.uint64(wmin_h), jnp.uint64(wmax_h), hist, excl, tbl)
+
+    def _probe_phase(self, sb, skey_cols, bt, str_words, build_matched,
+                     direct=None):
+        """Phase A: key eval + match lookup + join-type count surgery as
+        ONE jitted program; the total output size stages into the pending
+        pool.  The lookup is the direct-address table when available
+        (two i32 gathers) else the vectorized binary search.  Returns
+        None to use the legacy eager path."""
+        import jax
+        from ..columnar.batch import LazyCount
+        lg = self.logical
+        jt = lg.join_type
+        if jt == "full" or lg.condition is not None or build_matched \
+                is not None:
+            return None
+        if not all(type(c) is Column for c in skey_cols):
+            return None
+        key = ("probe", jt, tuple(c.dtype.name for c in skey_cols),
+               sb.capacity, bt.capacity, len(bt.sorted_words),
+               self.build_right, direct is not None and direct[4])
+        fn = TpuHashJoinBase._PROBE_JIT.get(key)
+        if fn is False:
+            return None
+        outer_stream = ((jt == "left" and self.build_right) or
+                        (jt == "right" and not self.build_right))
+        if fn is None:
+            key_dts = tuple(c.dtype for c in skey_cols)
+            tbl = direct[4] if direct is not None else 0
+
+            def _core(bws, dparams, key_arrays, num_rows):
+                kcols = [Column(dt, d, v)
+                         for dt, (d, v) in zip(key_dts, key_arrays)]
+                cap = key_arrays[0][0].shape[0]
+                in_range = jnp.arange(cap) < num_rows
+                if dparams is not None:
+                    wmin, wmax, hist, excl = dparams
+                    w = canon.value_words(kcols[0], num_rows)[0]
+                    idx = jnp.clip((w - wmin).astype(jnp.int32), 0,
+                                   tbl - 1)
+                    hit = (w >= wmin) & (w <= wmax) & \
+                        kcols[0].validity & in_range
+                    counts = jnp.where(hit, jnp.take(hist, idx), 0)
+                    lo = jnp.take(excl, idx)
+                else:
+                    swords = canon.batch_key_words(kcols, num_rows)
+                    bt2 = join_k.BuildTable(list(bws), None, None)
+                    jc = join_k.probe_counts(bt2, swords, num_rows)
+                    counts, lo = jc.counts, jc.lo
+                if jt in ("semi", "anti"):
+                    keep = (counts > 0) if jt == "semi" else \
+                        ((counts == 0) & in_range)
+                    eff = keep.astype(jnp.int32)
+                elif outer_stream:
+                    eff = jnp.where((counts == 0) & in_range, 1, counts)
+                else:
+                    eff = counts
+                total = jnp.sum(eff.astype(jnp.int64))
+                return lo, counts, eff, total
+            fn = jax.jit(_core, static_argnames=())
+            TpuHashJoinBase._PROBE_JIT[key] = fn
+        key_arrays = tuple((c.data, c.validity) for c in skey_cols)
+        dparams = tuple(direct[:4]) if direct is not None else None
+        try:
+            lo, counts, eff, total = fn(tuple(bt.sorted_words), dparams,
+                                        key_arrays, sb.rows_dev)
+        except Exception:  # noqa: BLE001 - fall back, but loudly
+            import logging
+            logging.getLogger("spark_rapids_tpu.exec.join").warning(
+                "fused probe failed; falling back", exc_info=True)
+            TpuHashJoinBase._PROBE_JIT[key] = False
+            return None
+        return (jt, outer_stream, lo, counts, eff, LazyCount(total))
+
+    def _expand_phase(self, sb, build, bt, jt, outer_stream, lo, counts,
+                      eff, total_lazy) -> Optional[ColumnarBatch]:
+        """Phase B: expansion + all output gathers as ONE jitted program
+        with a host-known output capacity."""
+        import jax
+        total = int(total_lazy)
+        if total == 0:
+            return ColumnarBatch.empty(self.output_schema)
+        out_cap = bucket_capacity(total)
+        if jt in ("semi", "anti"):
+            out = sb.slice_by_mask(eff > 0, total) if hasattr(
+                sb, "slice_by_mask") else None
+            if out is None:
+                from ..kernels import basic as bk
+                idx, _ = bk.compact_indices(eff > 0, sb.rows_dev)
+                out = sb.gather(idx[:out_cap] if out_cap <= sb.capacity
+                                else jnp.pad(idx, (0, out_cap -
+                                                   sb.capacity))[:out_cap],
+                                total)
+                mask = jnp.arange(out.capacity) < total
+                out = ColumnarBatch(
+                    self.output_schema,
+                    [c.mask_validity(mask) for c in out.columns], total)
+            return out
+        if not all(type(c) is Column for c in sb.columns) or \
+                not all(type(c) is Column for c in build.columns):
+            return self._expand_eager(sb, build, bt, outer_stream, lo,
+                                      counts, eff, total)
+        key = ("expand", out_cap, outer_stream,
+               tuple(f.dtype.name for f in sb.schema),
+               tuple(f.dtype.name for f in build.schema),
+               sb.capacity, build.capacity)
+        fn = TpuHashJoinBase._EXPAND_JIT.get(key)
+        if fn is None:
+            def _core(lo, counts, eff, perm, sdatas, svalids, bdatas,
+                      bvalids):
+                p_idx, b_idx, live, _ = join_k.expand_matches(
+                    lo, eff, perm, out_cap)
+                souts = [(jnp.take(d, p_idx, axis=0, mode="clip"),
+                          jnp.take(v, p_idx, axis=0, mode="clip") & live)
+                         for d, v in zip(sdatas, svalids)]
+                bvalid_mask = live
+                if outer_stream:
+                    matched = jnp.take(counts > 0, jnp.clip(
+                        p_idx, 0, counts.shape[0] - 1))
+                    bvalid_mask = live & matched
+                bouts = [(jnp.take(d, b_idx, axis=0, mode="clip"),
+                          jnp.take(v, b_idx, axis=0, mode="clip") &
+                          bvalid_mask)
+                         for d, v in zip(bdatas, bvalids)]
+                return souts, bouts
+            fn = jax.jit(_core)
+            if len(TpuHashJoinBase._EXPAND_JIT) < 4096:
+                TpuHashJoinBase._EXPAND_JIT[key] = fn
+        souts, bouts = fn(
+            lo, counts, eff, bt.perm,
+            tuple(c.data for c in sb.columns),
+            tuple(c.validity for c in sb.columns),
+            tuple(c.data for c in build.columns),
+            tuple(c.validity for c in build.columns))
+        scols = [Column(c.dtype, d, v)
+                 for c, (d, v) in zip(sb.columns, souts)]
+        bcols = [Column(c.dtype, d, v)
+                 for c, (d, v) in zip(build.columns, bouts)]
+        return self._assemble(scols, bcols, total)
+
+    def _expand_eager(self, sb, build, bt, outer_stream, lo, counts, eff,
+                      total):
+        """Non-plain columns (strings/nested): the original eager
+        expansion."""
+        out_cap = bucket_capacity(total)
+        p_idx, b_idx, live, _ = join_k.expand_matches(lo, eff, bt.perm,
+                                                      out_cap)
+        stream_out = sb.gather(p_idx, total)
+        build_out = build.gather(b_idx, total)
+        if outer_stream:
+            row_matched = jnp.take(counts > 0,
+                                   jnp.clip(p_idx, 0, sb.capacity - 1))
+            build_out = ColumnarBatch(
+                build_out.schema,
+                [c.mask_validity(row_matched)
+                 for c in build_out.columns], total)
+        live_mask = jnp.arange(out_cap) < total
+        scols = [c.mask_validity(live_mask) for c in stream_out.columns]
+        bcols = [c.mask_validity(live_mask) for c in build_out.columns]
+        return self._assemble(scols, bcols, total)
 
     # ------------------------------------------------------------------
     def _join_batch(self, sb: ColumnarBatch, skey_cols, build, bt,
