@@ -129,21 +129,32 @@ SHUFFLE_TRANSPORT = conf_str(
 SHUFFLE_PARTITIONS = conf_int(
     "spark.rapids.tpu.sql.shuffle.partitions", 8,
     "Default partition count for exchanges (spark.sql.shuffle.partitions)")
+SHUFFLE_MAP_STAGING_BYTES = conf_bytes(
+    "spark.rapids.tpu.shuffle.mapStagingBytes", 2 * 2**30,
+    "Device bytes of map-side shuffle input allowed to stage between "
+    "fused flushes.  Staging many map partitions before one flush "
+    "amortizes dispatch, but an unbounded stage could exhaust HBM on "
+    "shuffles larger than device memory; past this budget the exchange "
+    "flushes and finalizes what is staged so the catalog can spill it. "
+    "Applies to hash exchanges; RANGE exchanges (global sort) first "
+    "materialize the input for bound sampling and are not covered "
+    "(reference role: the bounded batch iteration in "
+    "GpuShuffleExchangeExec.scala:176)")
 SHUFFLE_COMPRESS = conf_str(
     "spark.rapids.tpu.shuffle.compression.codec", "none",
     "none|zlib|lz4|tplz codec for shuffle buffers; tplz is the native "
     "C++ LZ block codec (the nvcomp-LZ4 role; reference: "
     "spark.rapids.shuffle.compression.codec)")
 VARIABLE_FLOAT_AGG = conf_bool(
-    "spark.rapids.tpu.sql.variableFloatAgg.enabled", True,
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled", False,
     "Allow float/double aggregations (sum/avg/min/max) to accumulate in "
     "f32 on device.  TPUs have no 64-bit float ALU — XLA emulates f64 at "
     "4-6x cost — so f32 accumulation is the TPU-native fast path; results "
-    "can differ from the CPU oracle in low-order bits (and any ordering "
-    "difference already makes float aggs non-deterministic, which is why "
-    "the reference gates them the same way: "
-    "spark.rapids.sql.variableFloatAgg.enabled).  Inputs whose f32 cast "
-    "would overflow are detected on device and re-run on the exact path.")
+    "can differ from the CPU oracle in low-order bits.  Default OFF to "
+    "match the reference (spark.rapids.sql.variableFloatAgg.enabled "
+    "defaults false, RapidsConf.scala:556-562): exact results unless the "
+    "user opts in.  When enabled, inputs whose f32 cast would overflow "
+    "are detected on device and re-run on the exact path.")
 AGG_TABLE_SIZE = conf_int(
     "spark.rapids.tpu.sql.agg.tableSize", 4096,
     "Bucket-table size for the sort-free small-domain group-by fast path "

@@ -49,37 +49,61 @@ class TpuShuffleExchange(TpuExec):
             sample = [b for part in all_batches for b in part]
             self.partitioner.fit(sample)
             in_parts = [iter(p) for p in all_batches]
-        # Phase 1 (device-only): drain EVERY map partition, staging the
-        # split sort + boundary counts per batch — nothing pulls yet.
+        # Phase 1 (device-only): drain map partitions, staging the split
+        # sort + boundary counts per batch — nothing pulls yet.
         # Phase 2: ONE fused flush resolves every count and every
         # speculative fit flag (columnar/pending.py); the rare batch
         # whose table-path assumptions failed is recomputed exactly here,
         # at the stage barrier, before any result is exposed.
-        staged_by_map = []
-        for part in in_parts:
-            staged = []
-            for batch in part:
-                with timed(self.metrics[PARTITION_TIME]):
-                    staged.append(
-                        (batch, self.partitioner.split_staged(batch)))
-            staged_by_map.append(staged)
-        pending.flush()
-        for map_id, staged in enumerate(staged_by_map):
-            per_reduce = {}
-            for batch, (sorted_batch, counts) in staged:
+        # Staging is BOUNDED: past mapStagingBytes of staged device data
+        # (input + sorted copy) the exchange flushes, finalizes what is
+        # staged, and APPENDS the pieces straight into the (spillable)
+        # catalog — including the in-progress map partition — so device
+        # memory held between flushes never exceeds the budget and hash
+        # shuffles larger than device memory still stream.  (Range
+        # exchanges materialized everything above for bound sampling;
+        # the budget does not cover that path.)
+        from ..config import get_active, SHUFFLE_MAP_STAGING_BYTES
+        budget = int(get_active().get(SHUFFLE_MAP_STAGING_BYTES))
+        n_red = self.partitioner.num_partitions
+        staged = []            # (map_id, batch, (sorted_batch, counts))
+        staged_bytes = 0
+
+        def finalize_staged():
+            nonlocal staged_bytes
+            pending.flush()
+            per_reduce_by_map = {}
+            for map_id, batch, (sorted_batch, counts) in staged:
                 checked = resolve_speculative(batch)
                 if checked is not batch:
                     with timed(self.metrics[PARTITION_TIME]):
                         sorted_batch, counts = \
                             self.partitioner.split_staged(checked)
-                split = self.partitioner.finalize_split(sorted_batch, counts)
+                split = self.partitioner.finalize_split(sorted_batch,
+                                                        counts)
                 if split.offsets[-1] == 0:
                     continue
-                for pid in range(self.partitioner.num_partitions):
+                per_reduce = per_reduce_by_map.setdefault(map_id, {})
+                for pid in range(n_red):
                     piece = split.partition_slice(pid)
                     if piece is not None:
                         per_reduce.setdefault(pid, []).append(piece)
-            mgr.write_map_output(self._shuffle_id, map_id, per_reduce)
+            staged.clear()
+            staged_bytes = 0
+            for map_id, per_reduce in per_reduce_by_map.items():
+                mgr.append_map_output(self._shuffle_id, map_id,
+                                      per_reduce)
+
+        for map_id, part in enumerate(in_parts):
+            for batch in part:
+                with timed(self.metrics[PARTITION_TIME]):
+                    staged.append(
+                        (map_id, batch,
+                         self.partitioner.split_staged(batch)))
+                staged_bytes += 2 * batch.nbytes()
+                if staged_bytes > budget:
+                    finalize_staged()
+        finalize_staged()
 
     def ensure_materialized(self):
         """Run the map side once (the AQE stage-materialization barrier)."""

@@ -129,14 +129,48 @@ class TpuHashAggregate(TpuExec):
                 # (zero syncs); the exchange downstream holds the flush
                 # barrier that verifies speculative table-path batches
                 # and slices them.  Any path that merges/finalizes here
-                # must verify first (the merge would bake garbage in).
-                if len(partials) > 1 or self.mode != PARTIAL:
+                # must verify first (the merge would bake garbage in) —
+                # EXCEPT the single-partial deferred path below, which
+                # re-attaches the unverified flag to its own output so
+                # the next consumer's flush barrier (join phase A, the
+                # exchange, or to_arrow) performs the verification and
+                # the redo closure recomputes the whole chain exactly.
+                def _lazy_unresolved(v):
+                    st = getattr(v, "_staged", None)
+                    return st is not None and not st.resolved and \
+                        getattr(v, "_val", None) is None
+                spec = getattr(partials[0], "_speculative", None) \
+                    if len(partials) == 1 else None
+                spec_unresolved = spec is not None and any(
+                    _lazy_unresolved(f) for f in spec.fits)
+                count_unresolved = len(partials) == 1 and \
+                    _lazy_unresolved(partials[0]._rows)
+                # Deferring EITHER forcing point (the speculative fit
+                # flag, or the host count the compaction slice needs)
+                # saves a full device round trip — legal only when this
+                # node's consumer provably holds its own flush barrier.
+                defer = (self.mode != PARTIAL and len(partials) == 1 and
+                         getattr(self, "allow_deferred_verify", False) and
+                         (spec_unresolved or count_unresolved))
+                if not defer and (len(partials) > 1 or
+                                  self.mode != PARTIAL):
                     partials = [resolve_speculative(p) for p in partials]
                     partials = [self._compact_partial(p) for p in partials]
                 merged = concat_batches(partials) if len(partials) > 1 \
                     else partials[0]
                 out = self._merge_finalize(merged,
                                            multiple=len(partials) > 1)
+                if defer and spec is not None:
+                    out_spec = getattr(out, "_speculative", None)
+
+                    def redo_chain(spec=spec):
+                        fixed = resolve_speculative(spec.redo())
+                        fixed = self._compact_partial(fixed)
+                        return resolve_speculative(
+                            self._merge_finalize(fixed, multiple=False))
+                    fits = list(spec.fits) + (
+                        list(out_spec.fits) if out_spec is not None else [])
+                    out._speculative = SpeculativeResult(fits, redo_chain)
             self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
             yield out
         return [run(p) for p in self.children[0].execute()]
@@ -365,7 +399,11 @@ class TpuHashAggregate(TpuExec):
         if not conf.get(AGG_TABLE_ENABLED):
             return None
         table = int(conf.get(AGG_TABLE_SIZE))
-        if batch.capacity < table or batch.capacity > (1 << 25) or \
+        # capacity cap is 2^24: all reduce rows are f32, so per-group
+        # counts and first/last positions are exact only up to 2^24
+        # (f32 integer-exact range); a larger batch could silently
+        # saturate Count or round a First/Last position
+        if batch.capacity < table or batch.capacity > (1 << 24) or \
                 not batch.columns:
             return None
         if not all(type(c) is Column for c in batch.columns):
@@ -506,6 +544,13 @@ class TpuHashAggregate(TpuExec):
                     want_max = descs[ai][1]
                     ok = live & c.validity
                     v32 = c.data.astype(jnp.float32)
+                    # finite f64 whose f32 cast overflows to +/-inf would
+                    # silently corrupt min/max: detect on device and send
+                    # the batch to the exact path (same contract as
+                    # fsum/avg above)
+                    fit = fit & jnp.all(
+                        jnp.where(ok & jnp.isfinite(c.data),
+                                  jnp.isfinite(v32), True))
                     # Spark total order: NaN greatest, -0.0 == 0.0
                     v32 = jnp.where(v32 == 0.0, jnp.float32(0.0), v32)
                     nan = jnp.isnan(v32)

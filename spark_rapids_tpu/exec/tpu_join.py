@@ -80,11 +80,15 @@ class TpuHashJoinBase(TpuExec):
 
         with timed(self.metrics[BUILD_TIME]):
             # broadcast joins run every stream partition against the SAME
-            # build batches: sort the build table once per exec
+            # build batches: sort the build table once per exec.  The memo
+            # retains build_batches itself so the id()s in the key cannot
+            # be recycled by a later partition's freshly-allocated batches
+            # (a stale id()-only key could silently probe against the
+            # wrong build table).
             bb_key = tuple(id(b) for b in build_batches)
             memo = getattr(self, "_build_memo", None)
-            if memo is not None and memo[0] == bb_key:
-                build, bkey_cols = memo[1], memo[2]
+            if memo is not None and memo["key"] == bb_key:
+                build, bkey_cols = memo["build"], memo["bkey_cols"]
             else:
                 if build_batches:
                     build = concat_batches(build_batches)
@@ -92,7 +96,10 @@ class TpuHashJoinBase(TpuExec):
                     build = ColumnarBatch.empty(build_schema)
                 bkey_cols = [ec.eval_as_column(e, build)
                              for e in build_keys]
-                self._build_memo = (bb_key, build, bkey_cols)
+                self._build_memo = {"key": bb_key,
+                                    "batches": build_batches,
+                                    "build": build,
+                                    "bkey_cols": bkey_cols}
 
         stream_batches = list(stream_iter)
         if not stream_batches:
@@ -114,15 +121,33 @@ class TpuHashJoinBase(TpuExec):
                 str_words.append(None)
 
         memo = getattr(self, "_build_memo", None)
-        if memo is not None and len(memo) > 3 and memo[0] == bb_key:
-            bt, direct = memo[3], memo[4]
+        if (memo is not None and "bt" in memo and memo["key"] == bb_key
+                and memo.get("str_words") == str_words):
+            bt = memo["bt"]
         else:
             bwords = _key_words(bkey_cols, build.num_rows, str_words)
             bt = join_k.build(bwords)
-            direct = self._prepare_direct(bt, bkey_cols, build) \
-                if lg.condition is None and lg.join_type != "full" \
-                else None
-            self._build_memo = (bb_key, build, bkey_cols, bt, direct)
+            memo = {"key": bb_key,
+                    "batches": build_batches,
+                    "build": build,
+                    "bkey_cols": bkey_cols,
+                    "str_words": list(str_words),
+                    "bt": bt, "direct": None, "direct_done": False}
+            self._build_memo = memo
+        # the direct-address table costs ONE host sync to learn the
+        # build key range (it sizes the table) — worth it only when the
+        # probe side is large enough to amortize the round trip; small
+        # streams (dimension-sized post-agg probes) keep the sync-free
+        # binary search.  The decision is PER PARTITION (a broadcast
+        # join's first small partition must not freeze the strategy for
+        # later large ones); once built, the table is memoized.
+        stream_cap = sum(b.capacity for b in stream_batches)
+        if (not memo["direct_done"] and lg.condition is None
+                and lg.join_type != "full"
+                and stream_cap >= (1 << 19)):
+            memo["direct"] = self._prepare_direct(bt, bkey_cols, build)
+            memo["direct_done"] = True
+        direct = memo["direct"]
 
         build_matched = np.zeros(build.capacity, dtype=bool) \
             if lg.join_type == "full" else None
@@ -138,9 +163,24 @@ class TpuHashJoinBase(TpuExec):
                                                  str_words,
                                                  build_matched, direct))
         from ..columnar import pending
+        from ..columnar.batch import resolve_speculative
         pending.flush()
         for (sb, skey_cols), pa in zip(
                 zip(stream_batches, skey_cols_per_batch), phase_a):
+            # this flush is a verification barrier: upstream (the FINAL
+            # aggregate) may defer its speculative fit flag to here; the
+            # flags resolved in the fused flush above, so checking is
+            # free — the rare misfit batch recomputes exactly, and its
+            # probe phase re-runs on the exact rows
+            checked = resolve_speculative(sb)
+            if checked is not sb:
+                sb = checked
+                skey_cols = [ec.eval_as_column(e, sb)
+                             for e in stream_keys]
+                with timed(self.metrics[JOIN_TIME]):
+                    pa = self._probe_phase(sb, skey_cols, bt, str_words,
+                                           build_matched, direct)
+                pending.flush()
             with timed(self.metrics[JOIN_TIME]):
                 if pa is None:   # legacy eager path (full/residual/etc)
                     out = self._join_batch(sb, skey_cols, build, bt,

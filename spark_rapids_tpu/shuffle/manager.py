@@ -8,8 +8,9 @@ pluggable transport (RapidsShuffleTransport SPI -> UCX).  Here:
 - ShuffleWriteSupport stores per-(shuffle, map, reduce) batches in a
   process-wide catalog whose entries are spillable via the memory layer.
 - ShuffleTransport is the SPI; LocalTransport serves in-process reads
-  (the single-host case), MeshTransport (parallel/mesh_exchange.py) maps
-  the all-to-all onto jax.sharding collectives over ICI.
+  (the single-host case) and the executor-to-executor transports live in
+  transport.py / inprocess.py / tcp.py (the UCX role for the DCN edge);
+  mesh-collective exchanges ride exec/tpu_mesh_aggregate.py over ICI.
 """
 from __future__ import annotations
 
@@ -52,6 +53,15 @@ class ShuffleCatalog:
         from ..memory.spillable import SpillableBatch
         with self._lock:
             self._store[block] = [SpillableBatch(b) for b in batches]
+
+    def append(self, block: ShuffleBlockId, batches: List[ColumnarBatch]):
+        """Incremental put: extend a block's batch list (map-side
+        streaming writes register pieces as they finalize so they
+        become spillable immediately)."""
+        from ..memory.spillable import SpillableBatch
+        with self._lock:
+            self._store.setdefault(block, []).extend(
+                SpillableBatch(b) for b in batches)
 
     def get(self, block: ShuffleBlockId) -> List[ColumnarBatch]:
         with self._lock:
@@ -123,6 +133,16 @@ class ShuffleManager:
         for reduce_id, batches in per_reduce.items():
             if batches:
                 self.catalog.put(
+                    ShuffleBlockId(shuffle_id, map_id, reduce_id), batches)
+
+    def append_map_output(self, shuffle_id: int, map_id: int,
+                          per_reduce: Dict[int, List[ColumnarBatch]]):
+        """Streaming variant of write_map_output: pieces land in the
+        (spillable) catalog as they finalize, so a byte-budgeted map
+        stage releases device memory mid-partition."""
+        for reduce_id, batches in per_reduce.items():
+            if batches:
+                self.catalog.append(
                     ShuffleBlockId(shuffle_id, map_id, reduce_id), batches)
 
     # -- read side (RapidsCachingReader / RapidsShuffleIterator role) ------
