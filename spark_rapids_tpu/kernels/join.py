@@ -42,8 +42,11 @@ def _bsearch(build_words: List[jnp.ndarray], probe_words: List[jnp.ndarray],
     bcap = build_words[0].shape[0]
     pcap = probe_words[0].shape[0]
     steps = max(1, (bcap - 1).bit_length() + 1)
-    lo = jnp.zeros(pcap, jnp.int32)
-    hi = jnp.full(pcap, bcap, jnp.int32)
+    # zero derived from the probe words so the fori_loop carry keeps
+    # their varying-manual-axes type under shard_map (a plain
+    # jnp.zeros carry is unvarying and the loop rejects the mismatch)
+    lo = (probe_words[0] ^ probe_words[0]).astype(jnp.int32)
+    hi = lo + jnp.int32(bcap)
     prows = jnp.arange(pcap, dtype=jnp.int32)
 
     def body(_, state):

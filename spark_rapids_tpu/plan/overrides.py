@@ -393,9 +393,35 @@ class Planner:
                 print(text)
         phys = self._convert(meta)
         phys = self._collapse_stages(phys)
+        self._mark_deferred_verify(phys, parent=None)
         if self.conf.get(TEST_ENABLED):
             self._assert_all_tpu(phys)
         return phys
+
+    # -- deferred-verification marking ------------------------------------
+    def _mark_deferred_verify(self, node: PhysicalPlan, parent):
+        """Allow a FINAL/COMPLETE aggregate to hand its speculative fit
+        flag and unresolved group count to the NEXT flush barrier
+        instead of forcing a round trip of its own — but only when its
+        direct consumer provably verifies: the session collect (root),
+        an exchange (verify-at-flush), or a hash join (verifies stream
+        batches after its phase-A flush).  Everything else — including
+        projections, which re-evaluate columns into fresh batches and
+        would silently DROP the speculative flag — consumes the batch
+        without verifying, so the aggregate keeps its own barrier
+        there."""
+        from ..exec import tpu_aggregate as TA
+        from ..exec import tpu_join as TJ
+        from ..exec import exchange as TX
+        safe = (parent is None or
+                isinstance(parent, (TX.TpuShuffleExchange,
+                                    TX.TpuBroadcastExchange,
+                                    TJ.TpuHashJoinBase)))
+        if isinstance(node, TA.TpuHashAggregate) and \
+                node.mode in (TA.FINAL, TA.COMPLETE):
+            node.allow_deferred_verify = safe
+        for c in node.children:
+            self._mark_deferred_verify(c, parent=node)
 
     # -- whole-stage collapse (GpuTransitionOverrides-style post-pass) ----
     def _collapse_stages(self, node: PhysicalPlan) -> PhysicalPlan:
@@ -668,6 +694,9 @@ class Planner:
                    right: PhysicalPlan) -> PhysicalPlan:
         if p.join_type == "cross" or not p.left_keys:
             return TJ.TpuNestedLoopJoin(p, left, right)
+        mesh_plan = self._plan_join_mesh(p, left, right)
+        if mesh_plan is not None:
+            return mesh_plan
         lsize = self._estimate_rows(p.children[0])
         rsize = self._estimate_rows(p.children[1])
         build_right = p.join_type != "right"
@@ -731,8 +760,38 @@ class Planner:
         need = max(1, -(-est // max(self.batch_rows, 1)))
         return max(1, min(self.default_partitions, need))
 
+    # -- mesh-collective join/sort (shuffle.mode=mesh) ---------------------
+    def _plan_join_mesh(self, p: L.Join, left, right):
+        """shuffle.mode=mesh: the whole shuffled equi-join as one SPMD
+        program (exec/tpu_mesh_join.py) when the shapes allow it."""
+        if self.conf.get(SHUFFLE_MODE) != "mesh":
+            return None
+        import jax
+        from ..exec.tpu_mesh_join import (TpuMeshShuffledJoin,
+                                          mesh_join_supported)
+        n_dev = len(jax.devices())
+        if not mesh_join_supported(p, n_dev):
+            return None
+        return TpuMeshShuffledJoin(p, left, right)
+
+    def _plan_sort_mesh(self, p: L.Sort, child):
+        """shuffle.mode=mesh: sample-splitter global sort as one SPMD
+        program (exec/tpu_mesh_sort.py) when the shapes allow it."""
+        if self.conf.get(SHUFFLE_MODE) != "mesh":
+            return None
+        import jax
+        from ..exec.tpu_mesh_sort import TpuMeshSort, mesh_sort_supported
+        n_dev = len(jax.devices())
+        if not mesh_sort_supported(p, n_dev):
+            return None
+        return TpuMeshSort(p.orders, child)
+
     # -- global sort: range exchange + local sort --------------------------
     def _plan_sort(self, p: L.Sort, child: PhysicalPlan) -> PhysicalPlan:
+        if p.is_global:
+            mesh_plan = self._plan_sort_mesh(p, child)
+            if mesh_plan is not None:
+                return mesh_plan
         nparts = child.num_partitions_hint()
         if not p.is_global or nparts <= 1:
             return TSOR.TpuSort(p.orders, child)
