@@ -286,7 +286,8 @@ _KEYWORDS = {
 
 _AGG_FUNCS = {"sum", "count", "min", "max", "avg", "mean", "first", "last",
               "first_value", "last_value", "collect_list", "collect_set",
-              "count_distinct"}
+              "count_distinct", "stddev", "stddev_samp", "std",
+              "stddev_pop", "variance", "var_samp", "var_pop"}
 _WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "ntile", "lead",
                       "lag"}
 
@@ -1016,6 +1017,14 @@ def _make_agg(f: Func, lower) -> eagg.AggregateFunction:
         return eagg.CollectList(arg)
     if n == "collect_set":
         return eagg.CollectSet(arg)
+    if n in ("stddev", "stddev_samp", "std"):
+        return eagg.StddevSamp(arg)
+    if n == "stddev_pop":
+        return eagg.StddevPop(arg)
+    if n in ("variance", "var_samp"):
+        return eagg.VarianceSamp(arg)
+    if n == "var_pop":
+        return eagg.VariancePop(arg)
     raise SqlError(f"unknown aggregate {n}")
 
 
@@ -1086,6 +1095,18 @@ class _Lowerer:
                     if default is not None:
                         default = default.item() \
                             if hasattr(default, "item") else default
+                    # the default must be a value of the column's PYTHON
+                    # type — the CPU oracle evaluates the Coalesce with
+                    # pyarrow, which rejects e.g. int fills on
+                    # string/date columns
+                    if f.dtype == T.STRING:
+                        default = ""
+                    elif f.dtype == T.DATE:
+                        import datetime as _dt
+                        default = _dt.date(1970, 1, 1)
+                    elif f.dtype == T.TIMESTAMP:
+                        import datetime as _dt
+                        default = _dt.datetime(1970, 1, 1)
                     keys.append(econd.Coalesce(
                         ref, ec.Literal(default if default is not None
                                         else 0, f.dtype)))
@@ -1695,10 +1716,40 @@ class _Lowerer:
                 raise SqlError("scalar subquery returned more than one row")
             val = tbl.column(0)[0].as_py() if tbl.num_rows else None
             return ec.Literal(val, sub.schema.fields[0].dtype)
-        if isinstance(ast, (InSub, Exists)):
+        if isinstance(ast, InSub):
+            # expression position (inside OR / SELECT / CASE): an
+            # UNCORRELATED subquery evaluates eagerly to an IN-list
+            # (the q45 shape: ``... or i_item_id in (select ...)``);
+            # correlated ones only decorrelate as top-level conjuncts
+            try:
+                sub = self.lower(ast.query)
+            except SqlError as err:
+                raise SqlError(
+                    "IN (subquery) in expression position must be "
+                    "uncorrelated (correlated IN only decorrelates as "
+                    f"a top-level WHERE conjunct); subquery error: "
+                    f"{err}") from err
+            if len(sub.schema) != 1:
+                raise SqlError("IN subquery must return one column")
+            tbl = self.session.execute_to_arrow(sub)
+            vals = tbl.column(0).to_pylist()
+            has_null = any(v is None for v in vals)
+            vals = [v for v in vals if v is not None]
+            e = ep.In(self.lower_expr(ast.operand, scope), vals)
+            if ast.negated:
+                if has_null:
+                    # Spark 3VL: x NOT IN (set with NULL) is FALSE when
+                    # x matches a non-null member, else NULL — never
+                    # TRUE.  (Folding to plain FALSE would flip under
+                    # an enclosing NOT.)
+                    return econd.CaseWhen(
+                        [(e, ec.Literal(False, T.BOOL))],
+                        ec.Literal(None, T.BOOL))
+                return ep.Not(e)
+            return e
+        if isinstance(ast, Exists):
             raise SqlError(
-                "IN (subquery)/EXISTS only supported as top-level WHERE "
-                "conjuncts")
+                "EXISTS only supported as a top-level WHERE conjunct")
         if isinstance(ast, WindowE):
             raise SqlError("window functions only allowed in SELECT/ORDER BY")
         if isinstance(ast, Func):

@@ -197,6 +197,19 @@ class Literal(LeafExpression):
                 else:
                     raise ValueError(
                         f"cannot infer literal type for {value!r}")
+        else:
+            # explicit dtype: normalize python date/datetime payloads to
+            # the device representation (epoch days / microseconds) the
+            # same way the inference path does
+            import datetime as _dtmod
+            if isinstance(value, _dtmod.datetime):
+                epoch = _dtmod.datetime(
+                    1970, 1, 1,
+                    tzinfo=_dtmod.timezone.utc if value.tzinfo
+                    is not None else None)
+                value = int((value - epoch).total_seconds() * 1_000_000)
+            elif isinstance(value, _dtmod.date):
+                value = (value - _dtmod.date(1970, 1, 1)).days
         self.value = value
         self._dtype = dt
 

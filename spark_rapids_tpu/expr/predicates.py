@@ -21,7 +21,7 @@ from .core import Expression, Scalar, eval_data_valid, as_column
 def _comparable_words(expr: Expression, batch):
     col = as_column(expr.columnar_eval(batch), batch.capacity, batch.num_rows)
     words = canon.value_words(col, batch.num_rows)
-    return words, col.validity
+    return words, col.validity, isinstance(col, StringColumn)
 
 
 def promote_comparison_sides(left: Expression, right: Expression):
@@ -125,6 +125,13 @@ class BinaryComparison(Expression):
                        batch.num_rows)
         rc = as_column(right.columnar_eval(batch), batch.capacity,
                        batch.num_rows)
+        from ..columnar.binary64 import Binary64Column, require_same_kind
+        if isinstance(lc, Binary64Column) or isinstance(rc, Binary64Column):
+            require_same_kind(lc, rc)
+            from ..kernels import binary64 as b64
+            lt = b64.lt(lc.data, rc.data)
+            eq = b64.eq(lc.data, rc.data)
+            return lt, ~lt & ~eq, eq, lc.validity, rc.validity
         a, b = lc.data, rc.data
         if lf[0] == "float":
             if a.dtype != b.dtype:
@@ -150,13 +157,25 @@ class BinaryComparison(Expression):
         if native is not None:
             return native
         left, right = self._promoted
-        lw, lv = _comparable_words(left, batch)
-        rw, rv = _comparable_words(right, batch)
-        # unify word counts (strings of different max widths)
+        lw, lv, l_str = _comparable_words(left, batch)
+        rw, rv, r_str = _comparable_words(right, batch)
+        # unify word counts (strings of different max widths): the
+        # string encoding is [content words..., length word], so the
+        # zero padding must insert BEFORE the trailing length word — a
+        # shorter string's missing content words are zero by
+        # construction, and padding after the length word would compare
+        # content words against length words
         n = max(len(lw), len(rw))
-        lw = lw + [jnp.zeros_like(lw[0])] * (n - len(lw))
-        rw = rw + [jnp.zeros_like(rw[0])] * (n - len(rw))
-        # string keys append the length word last; keep padding before it
+
+        def _pad(ws, is_str):
+            if len(ws) == n:
+                return ws
+            fill = [jnp.zeros_like(ws[0])] * (n - len(ws))
+            if is_str and len(ws) > 1:
+                return ws[:-1] + fill + ws[-1:]
+            return ws + fill
+        lw = _pad(lw, l_str)
+        rw = _pad(rw, r_str)
         idx = jnp.arange(lw[0].shape[0])
         lt = canon.words_less(lw, idx, rw, idx)
         gt = canon.words_less(rw, idx, lw, idx)

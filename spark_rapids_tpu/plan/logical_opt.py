@@ -55,6 +55,53 @@ def _filter_over(conjuncts: List[ec.Expression],
     return L.Filter(_and_all(conjuncts), plan)
 
 
+def _flatten_or(e: ec.Expression) -> List[ec.Expression]:
+    if isinstance(e, ep.Or):
+        return _flatten_or(e.children[0]) + _flatten_or(e.children[1])
+    return [e]
+
+
+def _or_all(disjuncts: List[ec.Expression]) -> ec.Expression:
+    out = disjuncts[0]
+    for d in disjuncts[1:]:
+        out = ep.Or(out, d)
+    return out
+
+
+def _factor_or(e: ec.Expression) -> List[ec.Expression]:
+    """Factor conjuncts common to every OR arm out of the disjunction:
+    ``(A and B) or (A and C)  ->  A and (B or C)``.
+
+    Sound in SQL's three-valued logic (Kleene distributivity), and
+    load-bearing for the TPC-DS q13/q48 shape where the JOIN
+    EQUALITIES live inside each OR arm — without factoring they never
+    become hash-join keys and the plan degenerates to a cross join."""
+    disjuncts = _flatten_or(e)
+    if len(disjuncts) < 2:
+        return [e]
+    conj_lists = [_flatten_and(d) for d in disjuncts]
+    first_keys = {repr(c): c for c in conj_lists[0]}
+    common_keys = [k for k in first_keys
+                   if all(any(repr(x) == k for x in cl)
+                          for cl in conj_lists[1:])]
+    if not common_keys:
+        return [e]
+    common_set = set(common_keys)
+    remainders = []
+    for cl in conj_lists:
+        removed: Set[str] = set()
+        rem = []
+        for x in cl:
+            rx = repr(x)
+            if rx in common_set and rx not in removed:
+                removed.add(rx)
+                continue
+            rem.append(x)
+        remainders.append(_and_all(rem) if rem else
+                          ec.Literal(True))
+    return [first_keys[k] for k in common_keys] + [_or_all(remainders)]
+
+
 def _rewrite_filter_join(f: L.Filter) -> L.LogicalPlan:
     j = f.children[0]
     if not isinstance(j, L.Join) or j.join_type not in ("inner", "cross"):
@@ -69,7 +116,9 @@ def _rewrite_filter_join(f: L.Filter) -> L.LogicalPlan:
     lkeys = list(j.left_keys)
     rkeys = list(j.right_keys)
     rest: List[ec.Expression] = []
-    for c in _flatten_and(f.condition):
+    conjuncts = [x for c in _flatten_and(f.condition)
+                 for x in _factor_or(c)]
+    for c in conjuncts:
         refs = _refs(c)
         if refs is None or not refs:
             rest.append(c)
@@ -99,6 +148,34 @@ def _rewrite_filter_join(f: L.Filter) -> L.LogicalPlan:
     return _filter_over(rest, nj)
 
 
+def _rewrite_filter_semi(f: L.Filter) -> L.LogicalPlan:
+    """Filter over a semi/anti join: conjuncts that reference only the
+    left side commute with the join (its output IS the left rows), so
+    they push into the left child — where the inner/cross rewrite can
+    then lift equalities into hash-join keys.  Load-bearing for the
+    ``x IN (subquery)`` lowering, which stacks a semi join between the
+    WHERE filter and the comma-join chain it must decompose."""
+    j = f.children[0]
+    if not isinstance(j, L.Join) or j.join_type not in ("semi", "anti"):
+        return f
+    left = j.children[0]
+    lnames = set(left.schema.names)
+    push: List[ec.Expression] = []
+    rest: List[ec.Expression] = []
+    for c in _flatten_and(f.condition):
+        refs = _refs(c)
+        if refs is not None and refs and refs <= lnames:
+            push.append(c)
+        else:
+            rest.append(c)
+    if not push:
+        return f
+    new_left = optimize(_filter_over(push, left))
+    nj = L.Join(new_left, j.children[1], j.join_type, j.left_keys,
+                j.right_keys, j.condition)
+    return _filter_over(rest, nj)
+
+
 def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
     """Bottom-up: push Filter conjuncts through inner/cross joins and
     promote cross-side equalities to join keys."""
@@ -114,6 +191,9 @@ def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
                 ep.And(plan.condition, child.condition), child.children[0])
             return optimize(merged)
         out = _rewrite_filter_join(plan)
+        if out is not plan:
+            return out
+        out = _rewrite_filter_semi(plan)
         if out is not plan:
             return out
     return plan
