@@ -246,6 +246,12 @@ def column_from_arrow(arr: pa.ChunkedArray | pa.Array,
         return StringColumn.from_pylist(arr.to_pylist(), capacity=cap)
     valid_np = np.ones(n, dtype=bool) if arr.null_count == 0 else \
         np.asarray(arr.is_valid())
+    if dt == T.FLOAT64:
+        from .binary64 import Binary64Column, exact_double_enabled
+        if exact_double_enabled():
+            vals = np.asarray(arr.fill_null(0.0), np.float64)
+            return Binary64Column.from_f64_numpy(vals, valid_np,
+                                                 capacity=cap)
     if isinstance(dt, T.DecimalType):
         scale = dt.scale
         vals = np.array(

@@ -127,6 +127,10 @@ class Column:
             # host-built buffer: needs the concrete count (may sync)
             return StringColumn.from_pylist(
                 [value] * int(n), capacity=capacity)
+        if dtype == T.FLOAT64:
+            from .binary64 import Binary64Column, exact_double_enabled
+            if exact_double_enabled():
+                return Binary64Column.from_scalar_value(value, capacity, n)
         if value is None:
             return Column.all_null(dtype, capacity)
         data = jnp.full((capacity,), value, dtype=dtype.np_dtype)

@@ -100,6 +100,13 @@ CONCURRENT_TPU_TASKS = conf_int(
     "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
     "Max concurrent tasks admitted to the device (reference: "
     "spark.rapids.sql.concurrentGpuTasks / GpuSemaphore)")
+SCAN_PREFETCH = conf_bool(
+    "spark.rapids.tpu.sql.reader.prefetch.enabled", True,
+    "Decode scan files on background producer threads ahead of "
+    "consumption (bounded to 2 host tables per partition) so scan I/O "
+    "overlaps device compute; uploads are admitted under the device "
+    "semaphore (reference: the multithreaded cloud reader + "
+    "GpuSemaphore)")
 MAX_READER_BATCH_ROWS = conf_int(
     "spark.rapids.tpu.sql.reader.batchSizeRows", 1 << 20,
     "Soft cap on rows per scan batch (reference: "
@@ -155,6 +162,17 @@ VARIABLE_FLOAT_AGG = conf_bool(
     "defaults false, RapidsConf.scala:556-562): exact results unless the "
     "user opts in.  When enabled, inputs whose f32 cast would overflow "
     "are detected on device and re-run on the exact path.")
+EXACT_DOUBLE = conf_bool(
+    "spark.rapids.tpu.sql.exactDouble.enabled", False,
+    "Store DOUBLE columns as IEEE-754 bit patterns in int64 and route "
+    "arithmetic/comparison/aggregation through the exact softfloat "
+    "kernels (kernels/binary64.py).  The chip has no f64 ALU — XLA's "
+    "emulated f64 is an f32 pair (~48-bit precision, ~1e+/-38 range), "
+    "so values like 1e300 cannot even round-trip device memory without "
+    "this mode.  Wired surfaces: scan/literal/cast sources, +,-,*,/, "
+    "abs, negate, comparisons, sort/group/join keys, sum/min/max/avg. "
+    "Other DOUBLE ops raise loudly.  (Reference contract: bit-for-bit "
+    "DOUBLE, GpuCast.scala / arithmetic.scala.)")
 AGG_TABLE_SIZE = conf_int(
     "spark.rapids.tpu.sql.agg.tableSize", 4096,
     "Bucket-table size for the sort-free small-domain group-by fast path "

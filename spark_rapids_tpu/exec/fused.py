@@ -167,6 +167,13 @@ class FusedEval:
     def __call__(self, batch: ColumnarBatch) -> Optional[List[Column]]:
         if not self.ok:
             return None
+        from ..columnar.binary64 import exact_double_enabled
+        if exact_double_enabled():
+            # exactDouble: expressions may CREATE Binary64Columns inside
+            # the trace; reassembling traced arrays as plain Columns
+            # would silently reinterpret bit patterns as values, so the
+            # fused path stands down (exactness over fusion)
+            return None
         if not all(type(batch.columns[i]) is Column for i in self.needed):
             return None
         datas = tuple(batch.columns[i].data for i in self.needed)

@@ -183,9 +183,13 @@ class TpuStagedCompute(TpuExec):
         has_filter = any(k == "filter" for k, _, _ in self.ops)
 
         def run(part):
+            from ..columnar.binary64 import exact_double_enabled
             for batch in part:
                 with timed(self.metrics[OP_TIME]):
-                    if jitted is not None and all(
+                    # exactDouble: traced reassembly would strip
+                    # Binary64Columns created inside the program
+                    if jitted is not None and \
+                            not exact_double_enabled() and all(
                             type(c) is Column for c in batch.columns):
                         datas = tuple(c.data for c in batch.columns)
                         valids = tuple(c.validity for c in batch.columns)

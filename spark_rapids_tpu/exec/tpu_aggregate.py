@@ -233,11 +233,15 @@ class TpuHashAggregate(TpuExec):
         """
         import jax
         import logging
+        from ..columnar.binary64 import exact_double_enabled
+        if exact_double_enabled():
+            # traced reassembly would strip Binary64Columns
+            return None
         if TpuHashAggregate._FUSABLE_FUNCS is None:
             from ..expr import aggregates as ea
             TpuHashAggregate._FUSABLE_FUNCS = (
                 ea.Sum, ea.Count, ea.Min, ea.Max, ea.Average, ea.First,
-                ea.Last)
+                ea.Last, ea.CentralMoment)
         if batch.capacity > (1 << 21):
             return None
         if not all(type(c) is Column for c in key_cols):
@@ -395,8 +399,9 @@ class TpuHashAggregate(TpuExec):
         import jax
         import logging
         from ..config import get_active, AGG_TABLE_ENABLED, AGG_TABLE_SIZE
+        from ..columnar.binary64 import exact_double_enabled
         conf = get_active()
-        if not conf.get(AGG_TABLE_ENABLED):
+        if not conf.get(AGG_TABLE_ENABLED) or exact_double_enabled():
             return None
         table = int(conf.get(AGG_TABLE_SIZE))
         # capacity cap is 2^24: all reduce rows are f32, so per-group
@@ -743,7 +748,7 @@ class TpuHashAggregate(TpuExec):
             from ..expr import aggregates as ea
             TpuHashAggregate._FUSABLE_FUNCS = (
                 ea.Sum, ea.Count, ea.Min, ea.Max, ea.Average, ea.First,
-                ea.Last)
+                ea.Last, ea.CentralMoment)
         if batch.capacity > (1 << 21) or not batch.columns:
             return None
         if not all(type(c) is Column for c in batch.columns):

@@ -85,6 +85,11 @@ class BinaryArithmetic(Expression):
         if (isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType)) \
                 and self.symbol in ("+", "-", "*"):
             return self._decimal_eval(batch, lt, rt)
+        out_t = self.result_type(lt, rt)
+        if out_t == T.FLOAT64:
+            b64_result = self._try_binary64_eval(batch)
+            if b64_result is not None:
+                return b64_result
         la, lv, lt = eval_data_valid(self.children[0], batch)
         ra, rv, rt = eval_data_valid(self.children[1], batch)
         out_t = self.result_type(lt, rt)
@@ -96,6 +101,49 @@ class BinaryArithmetic(Expression):
             valid = valid & ~extra
         data = self.op(a, b)
         return Column(out_t, data, valid)
+
+    def _try_binary64_eval(self, batch):
+        """Exact-bits DOUBLE arithmetic (exactDouble mode): operands are
+        Binary64Columns (or int/f32 columns converted exactly on
+        device); +,-,*,/ run the softfloat kernels bit-for-bit
+        (kernels/binary64.py).  Returns None when exactDouble is off
+        (no operand carries bits)."""
+        from ..columnar.binary64 import (Binary64Column,
+                                         exact_double_enabled,
+                                         require_same_kind)
+        if not exact_double_enabled():
+            return None     # cheap guard: no double child evaluation
+        from .core import as_column
+        lc = as_column(self.children[0].columnar_eval(batch),
+                       batch.capacity, batch.num_rows)
+        rc = as_column(self.children[1].columnar_eval(batch),
+                       batch.capacity, batch.num_rows)
+        from ..kernels import binary64 as b64
+        require_same_kind(lc, rc)
+
+        def bits_of_col(c):
+            if isinstance(c, Binary64Column):
+                return c.data
+            if c.dtype.is_integral or c.dtype == T.BOOL:
+                return b64.from_i64(c.data.astype(jnp.int64))
+            if c.dtype == T.FLOAT32:
+                return b64.from_f32(c.data)
+            raise NotImplementedError(
+                f"exactDouble: cannot convert {c.dtype} operand")
+        a = bits_of_col(lc)
+        b = bits_of_col(rc)
+        fn = {"+": b64.add, "-": b64.sub, "*": b64.mul,
+              "/": b64.div}.get(self.symbol)
+        if fn is None:
+            raise NotImplementedError(
+                f"exactDouble: operator {self.symbol} not wired for "
+                f"DOUBLE; disable spark.rapids.tpu.sql.exactDouble")
+        valid = lc.validity & rc.validity
+        if self.symbol == "/":
+            # Spark double division: x/0 is NULL (matches Divide's
+            # emulated-path extra_null_mask)
+            valid = valid & ~b64.is_zero(b)
+        return Binary64Column(fn(a, b), valid)
 
     def __repr__(self):
         return f"({self.children[0]!r} {self.symbol} {self.children[1]!r})"
@@ -208,24 +256,50 @@ class UnaryExpression(Expression):
         raise NotImplementedError
 
     def columnar_eval(self, batch):
-        a, v, t = eval_data_valid(self.children[0], batch)
-        return Column(self.dtype(), self.op(a).astype(
-            self.dtype().np_dtype), v)
+        from ..columnar.binary64 import Binary64Column
+        from .core import as_column
+        c = as_column(self.children[0].columnar_eval(batch),
+                      batch.capacity, batch.num_rows)
+        if isinstance(c, Binary64Column):
+            out = self._binary64_op(c)
+            if out is not None:
+                return out
+            raise NotImplementedError(
+                f"exactDouble: {type(self).__name__} not wired for "
+                f"DOUBLE bits; disable spark.rapids.tpu.sql.exactDouble")
+        return Column(self.dtype(), self.op(c.data).astype(
+            self.dtype().np_dtype), c.validity)
+
+    def _binary64_op(self, c):
+        return None
 
 
 class UnaryMinus(UnaryExpression):
     def op(self, a):
         return -a
 
+    def _binary64_op(self, c):
+        from ..columnar.binary64 import Binary64Column
+        from ..kernels import binary64 as b64
+        return Binary64Column(b64.neg(c.data), c.validity)
+
 
 class UnaryPositive(UnaryExpression):
     def op(self, a):
         return a
 
+    def _binary64_op(self, c):
+        return c
+
 
 class Abs(UnaryExpression):
     def op(self, a):
         return jnp.abs(a)
+
+    def _binary64_op(self, c):
+        from ..columnar.binary64 import Binary64Column
+        from ..kernels import binary64 as b64
+        return Binary64Column(b64.abs_(c.data), c.validity)
 
 
 class _MathUnary(UnaryExpression):
@@ -237,6 +311,13 @@ class _MathUnary(UnaryExpression):
 
     def op(self, a):
         return type(self).fn(a.astype(jnp.float64))
+
+    def _binary64_op(self, c):
+        if type(self).fn is jnp.sqrt:
+            from ..columnar.binary64 import Binary64Column
+            from ..kernels import binary64 as b64
+            return Binary64Column(b64.sqrt(c.data), c.validity)
+        return None   # transcendental fns stay emulated: raise loudly
 
 
 def _make_math(name: str, fn) -> type:

@@ -40,10 +40,53 @@ class Cast(Expression):
             col = as_column(self.children[0].columnar_eval(batch),
                             batch.capacity, batch.num_rows)
             return _cast_from_string(col, to, batch.num_rows)
+        b64_out = self._binary64_cast(batch, src_t, to)
+        if b64_out is not None:
+            return b64_out
         a, v, vt = eval_data_valid(self.children[0], batch)
         if to == T.STRING:
             return _cast_to_string(a, v, vt, batch.num_rows)
         return _cast_numeric(a, v, vt, to)
+
+    def _binary64_cast(self, batch, src_t, to):
+        """exactDouble: casts in/out of bits-typed DOUBLE columns
+        (kernels/binary64.py from_i64/from_f32/to_int/to_f32)."""
+        from ..columnar.binary64 import (Binary64Column,
+                                         exact_double_enabled)
+        if to == T.FLOAT64:
+            if not exact_double_enabled():
+                return None
+            from ..kernels import binary64 as b64
+            c = as_column(self.children[0].columnar_eval(batch),
+                          batch.capacity, batch.num_rows)
+            if isinstance(c, Binary64Column):
+                return c
+            if src_t.is_integral or src_t == T.BOOL or \
+                    src_t in (T.DATE, T.TIMESTAMP):
+                import jax.numpy as jnp
+                return Binary64Column(
+                    b64.from_i64(c.data.astype(jnp.int64)), c.validity)
+            if src_t == T.FLOAT32:
+                return Binary64Column(b64.from_f32(c.data), c.validity)
+            return None
+        if src_t == T.FLOAT64:
+            if not exact_double_enabled():
+                return None     # cheap guard: no double child eval
+            c = as_column(self.children[0].columnar_eval(batch),
+                          batch.capacity, batch.num_rows)
+            if not isinstance(c, Binary64Column):
+                return None
+            from ..kernels import binary64 as b64
+            if to.is_integral:
+                data = b64.to_int(c.data, to.np_dtype)
+                valid = c.validity & ~b64.is_nan(c.data)
+                return Column(to, data, valid)
+            if to == T.FLOAT32:
+                return Column(to, b64.to_f32(c.data), c.validity)
+            raise NotImplementedError(
+                f"exactDouble: CAST(DOUBLE AS {to.name}) not wired; "
+                f"disable spark.rapids.tpu.sql.exactDouble")
+        return None
 
     def __repr__(self):
         return f"CAST({self.children[0]!r} AS {self.to.name})"

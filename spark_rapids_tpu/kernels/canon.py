@@ -122,6 +122,13 @@ def value_words(col: Column, num_rows: int,
     if isinstance(col, StringColumn):
         from . import strings as skern
         return skern.string_key_words(col, num_rows, num_words=str_words)
+    from ..columnar.binary64 import Binary64Column
+    if isinstance(col, Binary64Column):
+        # exact total-order word straight from the bit pattern (the
+        # order_word flip is exact integer work; Spark order: NaN
+        # greatest, -0.0 == 0.0)
+        from . import binary64 as b64
+        return [b64.order_word(col.data).astype(jnp.uint64)]
     if dt == T.BOOL:
         return [col.data.astype(jnp.uint64)]
     if dt.is_integral or isinstance(dt, T.DecimalType) or dt in (T.DATE,
