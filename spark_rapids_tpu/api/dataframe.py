@@ -453,6 +453,18 @@ class GroupedData:
         self.keys = keys
         self.grouping_sets = grouping_sets
 
+    def pivot(self, pivot_col, values) -> "PivotedData":
+        """Spark's ``groupBy(...).pivot(col, values).agg(f(x))``.
+
+        Lowered the way the reference's PivotFirst ultimately evaluates
+        (AggregateFunctions.scala PivotFirst): one conditional aggregate
+        per pivot value — ``f(when(col == v, x)) AS v`` — which runs on
+        the existing device aggregation paths with no new kernel.
+        Explicit ``values`` are required (the reference's implicit mode
+        runs a distinct query first; pass that yourself)."""
+        return PivotedData(self, _to_expr(pivot_col, self.df.schema),
+                           list(values))
+
     def agg(self, *aggs, **named) -> DataFrame:
         from ..udf.python_udf import PandasAggUDFExpr
         agg_exprs: List[L.AggExpr] = []
@@ -576,3 +588,46 @@ class GroupedData:
         return self._simple(eagg.Average, cols)
 
     mean = avg
+
+
+class PivotedData:
+    """groupBy().pivot(col, values) — rewrites agg() into one
+    conditional aggregate per pivot value (the PivotFirst lowering)."""
+
+    def __init__(self, grouped: GroupedData, pivot_expr: ec.Expression,
+                 values: list):
+        self.grouped = grouped
+        self.pivot_expr = pivot_expr
+        self.values = values
+
+    def agg(self, *aggs) -> DataFrame:
+        from ..expr import conditional as econd
+        from ..expr import predicates as epred
+        schema = self.grouped.df.schema
+        specs = []
+        for a in aggs:
+            e = a.expr if isinstance(a, Col) else a
+            alias = None
+            if isinstance(e, ec.Alias):
+                alias = e.alias
+                e = e.children[0]
+            e = _resolve(e, schema)
+            assert isinstance(e, eagg.AggregateFunction), \
+                f"pivot().agg() requires aggregate functions, got {e!r}"
+            specs.append((alias, e))
+        out = []
+        for v in self.values:
+            cond = epred.EqualTo(self.pivot_expr, ec.Literal(v))
+            for alias, f in specs:
+                child = f.children[0] if f.children else ec.Literal(1)
+                guarded = econd.CaseWhen([(cond, child)], None)
+                nf = f.with_children([guarded])
+                name = str(v) if len(specs) == 1 else \
+                    f"{v}_{alias or f.name.lower()}"
+                out.append(Col(ec.Alias(nf, name)))
+        return self.grouped.agg(*out)
+
+    def first(self, col) -> DataFrame:
+        """pivot_first shape: first(value) per pivot value."""
+        return self.agg(Col(eagg.First(_to_expr(col,
+                                                self.grouped.df.schema))))

@@ -63,6 +63,32 @@ def last(c, ignore_nulls: bool = True) -> Col:
                          ignore_nulls))
 
 
+def stddev(c) -> Col:
+    return Col(eagg.StddevSamp(_expr(c if not isinstance(c, str)
+                                     else col(c))))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c) -> Col:
+    return Col(eagg.StddevPop(_expr(c if not isinstance(c, str)
+                                    else col(c))))
+
+
+def variance(c) -> Col:
+    return Col(eagg.VarianceSamp(_expr(c if not isinstance(c, str)
+                                       else col(c))))
+
+
+var_samp = variance
+
+
+def var_pop(c) -> Col:
+    return Col(eagg.VariancePop(_expr(c if not isinstance(c, str)
+                                      else col(c))))
+
+
 def collect_list(c) -> Col:
     return Col(eagg.CollectList(_expr(c if not isinstance(c, str)
                                       else col(c))))

@@ -169,10 +169,10 @@ def _np_float_decode(arr, out_type: pa.DataType) -> pa.Array:
 
 def _agg_arrow(func: eagg.AggregateFunction, table: pa.Table,
                group_names: List[str], alias: str):
-    """Build (input column, arrow agg name, array, decode_float)."""
+    """Build (input col, arrow agg name, array, decode_float, options)."""
     if isinstance(func, eagg.Count) and not func.children:
         return (group_names[0] if group_names else table.column_names[0],
-                "count_all", None, False)
+                "count_all", None, False, None)
     child = func.children[0]
     colname = f"__agg_in_{alias}"
     arr = _arr(cpu_eval(child, table), table.num_rows)
@@ -181,13 +181,19 @@ def _agg_arrow(func: eagg.AggregateFunction, table: pa.Table,
         eagg.Max: "max", eagg.Average: "mean",
         eagg.First: "first", eagg.Last: "last",
         eagg.CollectList: "list", eagg.CollectSet: "distinct",
+        eagg.StddevSamp: "stddev", eagg.StddevPop: "stddev",
+        eagg.VarianceSamp: "variance", eagg.VariancePop: "variance",
     }[type(func)]
+    options = None
+    if isinstance(func, eagg.CentralMoment):
+        options = pc.VarianceOptions(ddof=func.ddof)
+        arr = pc.cast(arr, pa.float64(), safe=False)
     decode = False
     at = arr.type if not isinstance(arr, pa.ChunkedArray) else arr.type
     if kind in ("min", "max") and pa.types.is_floating(at):
         arr = _np_float_encode(arr)
         decode = True
-    return colname, kind, arr, decode
+    return colname, kind, arr, decode, options
 
 
 class CpuAggregate(CpuExec):
@@ -233,13 +239,15 @@ class CpuAggregate(CpuExec):
         agg_specs = []
         decodes = []
         for a in self.aggs:
-            colname, kind, arr, decode = _agg_arrow(a.func, t, group_names,
-                                                    a.alias)
+            colname, kind, arr, decode, options = _agg_arrow(
+                a.func, t, group_names, a.alias)
             decodes.append(decode)
             if arr is not None:
                 work = work.append_column(colname, arr)
             if kind == "count_all":
                 agg_specs.append(([], "count_all"))
+            elif options is not None:
+                agg_specs.append((colname, kind, options))
             else:
                 agg_specs.append((colname, kind))
         if group_names:
@@ -249,8 +257,9 @@ class CpuAggregate(CpuExec):
             for i, e in enumerate(self.group_exprs):
                 cols.append(res.column(f"__key_{i}"))
             for (colname, kind), a, decode in zip(
-                    [(c if not isinstance(c, list) else "", k)
-                     for c, k in agg_specs], self.aggs, decodes):
+                    [(c if not isinstance(c, list) else "", s[1])
+                     for s in agg_specs for c in [s[0]]],
+                    self.aggs, decodes):
                 res_name = "count_all" if kind == "count_all" else \
                     f"{colname}_{kind}"
                 c = res.column(res_name)
@@ -269,10 +278,16 @@ class CpuAggregate(CpuExec):
         else:
             # global aggregate -> single row
             arrays = []
-            for (colname, kind), a, f in zip(agg_specs, self.aggs,
-                                             list(out_schema)):
+            for spec, a, f in zip(agg_specs, self.aggs,
+                                  list(out_schema)):
+                colname, kind = spec[0], spec[1]
+                opts = spec[2] if len(spec) > 2 else None
                 if kind == "count_all":
                     val = pa.scalar(work.num_rows, pa.int64())
+                elif kind in ("stddev", "variance"):
+                    col = work.column(colname)
+                    fn = pc.stddev if kind == "stddev" else pc.variance
+                    val = fn(col, ddof=opts.ddof if opts else 0)
                 else:
                     col = work.column(colname)
                     fn = {"sum": pc.sum, "count": pc.count, "min": pc.min,

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..columnar import dtypes as T
@@ -64,6 +65,41 @@ def _col_of(data, valid, dt):
     return Column(dt, data.astype(dt.np_dtype), valid)
 
 
+def _is_b64(c) -> bool:
+    from ..columnar.binary64 import Binary64Column
+    return isinstance(c, Binary64Column)
+
+
+def _b64_seg_sum(plan, c):
+    """Exact DOUBLE segment sum: softfloat associative scan over the
+    plan's sorted order (kernels/binary64.segmented_sum)."""
+    from ..kernels import binary64 as b64
+    from ..columnar.binary64 import Binary64Column
+    v, ok = agg_k._sorted_vals(plan, c.data, c.validity)
+    s = b64.segmented_sum(v, ok, plan.seg_id, c.capacity)
+    cnt = agg_k.seg_count(plan, c.validity)
+    return Binary64Column(s, cnt > 0), cnt
+
+
+def _b64_seg_minmax(plan, c, want_max: bool):
+    """Exact DOUBLE min/max via the total-order word (Spark order: NaN
+    greatest, -0.0 == 0.0)."""
+    import jax
+    from ..kernels import binary64 as b64
+    from ..columnar.binary64 import Binary64Column
+    v, ok = agg_k._sorted_vals(plan, c.data, c.validity)
+    w = b64.order_word(v)
+    cap = c.capacity
+    if want_max:
+        contrib = jnp.where(ok, w, jnp.uint64(0))
+        m = jax.ops.segment_max(contrib, plan.seg_id, num_segments=cap)
+    else:
+        contrib = jnp.where(ok, w, jnp.uint64(2**64 - 1))
+        m = jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
+    cnt = agg_k.seg_count(plan, c.validity)
+    return Binary64Column(b64.word_to_bits(m), cnt > 0), cnt
+
+
 class Sum(AggregateFunction):
     def dtype(self):
         ct = self.children[0].dtype()
@@ -75,6 +111,9 @@ class Sum(AggregateFunction):
 
     def update(self, plan, cols):
         c = cols[0]
+        if _is_b64(c):
+            col, _cnt = _b64_seg_sum(plan, c)
+            return [col]
         out_t = self.dtype()
         s = agg_k.seg_sum(plan, c.data, c.validity,
                           out_dtype=out_t.np_dtype)
@@ -83,6 +122,9 @@ class Sum(AggregateFunction):
 
     def merge(self, plan, buffers):
         b = buffers[0]
+        if _is_b64(b):
+            col, _cnt = _b64_seg_sum(plan, b)
+            return [col]
         s = agg_k.seg_sum(plan, b.data, b.validity)
         cnt = agg_k.seg_count(plan, b.validity)
         return [_col_of(s, cnt > 0, self.dtype())]
@@ -118,6 +160,9 @@ class Min(AggregateFunction):
 
     def update(self, plan, cols):
         c = cols[0]
+        if _is_b64(c):
+            col, _cnt = _b64_seg_minmax(plan, c, want_max=False)
+            return [col]
         if c.dtype == T.STRING:
             idx, has = agg_k.seg_first_index_by_order(plan, c, want_min=True)
             return [c.gather(idx).mask_validity(has)]
@@ -134,6 +179,9 @@ class Max(AggregateFunction):
 
     def update(self, plan, cols):
         c = cols[0]
+        if _is_b64(c):
+            col, _cnt = _b64_seg_minmax(plan, c, want_max=True)
+            return [col]
         if c.dtype == T.STRING:
             idx, has = agg_k.seg_first_index_by_order(plan, c, want_min=False)
             return [c.gather(idx).mask_validity(has)]
@@ -157,18 +205,39 @@ class Average(AggregateFunction):
 
     def update(self, plan, cols):
         c = cols[0]
+        if _is_b64(c):
+            from ..columnar.binary64 import Binary64Column
+            col, cnt = _b64_seg_sum(plan, c)
+            always = jnp.ones_like(cnt, dtype=bool)
+            return [Binary64Column(col.data, always),
+                    Column(T.INT64, cnt, always)]
         s = agg_k.seg_sum(plan, c.data, c.validity, out_dtype=jnp.float64)
         cnt = agg_k.seg_count(plan, c.validity)
         always = jnp.ones_like(cnt, dtype=bool)
         return [Column(T.FLOAT64, s, always), Column(T.INT64, cnt, always)]
 
     def merge(self, plan, buffers):
+        if _is_b64(buffers[0]):
+            from ..columnar.binary64 import Binary64Column
+            col, _ = _b64_seg_sum(plan, buffers[0])
+            cnt = agg_k.seg_sum(plan, buffers[1].data, buffers[1].validity)
+            always = jnp.ones_like(cnt, dtype=bool)
+            return [Binary64Column(col.data, always),
+                    Column(T.INT64, cnt, always)]
         s = agg_k.seg_sum(plan, buffers[0].data, buffers[0].validity)
         cnt = agg_k.seg_sum(plan, buffers[1].data, buffers[1].validity)
         always = jnp.ones_like(cnt, dtype=bool)
         return [Column(T.FLOAT64, s, always), Column(T.INT64, cnt, always)]
 
     def finalize(self, buffers):
+        if _is_b64(buffers[0]):
+            from ..kernels import binary64 as b64
+            from ..columnar.binary64 import Binary64Column
+            cnt = buffers[1].data
+            ok = cnt > 0
+            avg = b64.div(buffers[0].data,
+                          b64.from_i64(jnp.where(ok, cnt, 1)))
+            return Binary64Column(avg, ok & buffers[0].validity)
         s, cnt = buffers[0].data, buffers[1].data
         ok = cnt > 0
         avg = s / jnp.where(ok, cnt, 1).astype(jnp.float64)
@@ -213,6 +282,127 @@ class Last(AggregateFunction):
         return [out.mask_validity(has)]
 
     merge = update
+
+
+class CentralMoment(AggregateFunction):
+    """Shared base for variance/stddev (sample + population).
+
+    Reference: AggregateFunctions.scala GpuStddevSamp/GpuStddevPop/
+    GpuVarianceSamp/GpuVariancePop (the M2 family).  Buffers are
+    (count, mean, M2) — Welford form, NOT sum/sum-of-squares, because
+    the naive sumsq - sum^2/n recovery is catastrophically
+    cancellative (variance of [1e8+1, 1e8+2, 1e8+3] comes out 0.0 in
+    f64; on the chip's ~48-bit emulated f64 the breakdown starts at
+    means around 1e4).  update is a stable two-pass over the plan's
+    segments (mean, then squared deltas); merge combines partials with
+    the delta formula M2 = sum(M2_i) + sum(n_i * (mean_i - mean)^2).
+    """
+
+    #: ddof: 1 for sample, 0 for population
+    ddof = 1
+    #: take sqrt at finalize (stddev) or not (variance)
+    sqrt = False
+
+    def dtype(self):
+        return T.FLOAT64
+
+    @property
+    def num_buffers(self):
+        return 3
+
+    def buffer_dtypes(self):
+        return [T.INT64, T.FLOAT64, T.FLOAT64]
+
+    def update(self, plan, cols):
+        c = cols[0]
+        cap = c.capacity
+        x, ok = agg_k._sorted_vals(plan, c.data.astype(jnp.float64),
+                                   c.validity)
+        cnt = jax.ops.segment_sum(ok.astype(jnp.int64), plan.seg_id,
+                                  num_segments=cap)
+        s = jax.ops.segment_sum(jnp.where(ok, x, 0.0), plan.seg_id,
+                                num_segments=cap)
+        mean = s / jnp.maximum(cnt, 1).astype(jnp.float64)
+        delta = x - jnp.take(mean, plan.seg_id)
+        m2 = jax.ops.segment_sum(jnp.where(ok, delta * delta, 0.0),
+                                 plan.seg_id, num_segments=cap)
+        always = jnp.ones_like(cnt, dtype=bool)
+        return [Column(T.INT64, cnt, always),
+                Column(T.FLOAT64, mean, always),
+                Column(T.FLOAT64, m2, always)]
+
+    def merge(self, plan, buffers):
+        cap = buffers[0].capacity
+        n_i, ok = agg_k._sorted_vals(
+            plan, buffers[0].data.astype(jnp.float64),
+            buffers[0].validity)
+        mean_i, _ = agg_k._sorted_vals(plan, buffers[1].data,
+                                       buffers[1].validity)
+        m2_i, _ = agg_k._sorted_vals(plan, buffers[2].data,
+                                     buffers[2].validity)
+        n_i = jnp.where(ok, n_i, 0.0)
+        n = jax.ops.segment_sum(n_i, plan.seg_id, num_segments=cap)
+        wsum = jax.ops.segment_sum(n_i * mean_i, plan.seg_id,
+                                   num_segments=cap)
+        mean = wsum / jnp.maximum(n, 1.0)
+        delta = mean_i - jnp.take(mean, plan.seg_id)
+        m2 = jax.ops.segment_sum(
+            jnp.where(ok, m2_i + n_i * delta * delta, 0.0),
+            plan.seg_id, num_segments=cap)
+        always = jnp.ones(cap, dtype=bool)
+        return [Column(T.INT64, n.astype(jnp.int64), always),
+                Column(T.FLOAT64, mean, always),
+                Column(T.FLOAT64, m2, always)]
+
+    def finalize(self, buffers):
+        n = buffers[0].data.astype(jnp.float64)
+        m2 = buffers[2].data
+        ok = n > self.ddof
+        denom = jnp.where(ok, n - self.ddof, 1.0)
+        v = jnp.maximum(m2, 0.0) / denom
+        if self.sqrt:
+            v = jnp.sqrt(v)
+        return Column(T.FLOAT64, v, ok)
+
+
+class VarianceSamp(CentralMoment):
+    ddof, sqrt = 1, False
+
+
+class VariancePop(CentralMoment):
+    ddof, sqrt = 0, False
+
+
+class StddevSamp(CentralMoment):
+    ddof, sqrt = 1, True
+
+
+class StddevPop(CentralMoment):
+    ddof, sqrt = 0, True
+
+
+class PivotFirst(AggregateFunction):
+    """Internal pivot aggregate (reference: PivotFirst in
+    AggregateFunctions.scala) — the API layer lowers
+    ``group_by().pivot(col, values).agg(f(x))`` to one conditional
+    aggregate per pivot value (``f(when(col == v, x))``), so this class
+    exists for the rule registry/docs; the rewrite path never
+    instantiates it on device."""
+
+    def __init__(self, pivot: Optional[Expression] = None,
+                 value: Optional[Expression] = None,
+                 pivot_values: Optional[list] = None):
+        self.children = [e for e in (pivot, value) if e is not None]
+        self.pivot_values = list(pivot_values or [])
+
+    def with_children(self, c):
+        return PivotFirst(c[0] if c else None,
+                          c[1] if len(c) > 1 else None,
+                          self.pivot_values)
+
+    def dtype(self):
+        return self.children[1].dtype() if len(self.children) > 1 \
+            else T.NULL
 
 
 class CollectList(AggregateFunction):

@@ -242,3 +242,56 @@ class TestMisc:
         b = ColumnarBatch.from_pydict({"x": ["abc", None]})
         got = _eval(E.Md5(col("x")), b)
         assert got == ["900150983cd24fb0d6963f7d28e17f72", None]
+
+
+from spark_rapids_tpu.api import functions as F  # noqa: E402
+
+
+class TestCentralMoments:
+    """stddev/variance use Welford (count, mean, M2) buffers: the naive
+    sumsq - sum^2/n recovery is catastrophically cancellative on
+    large-mean data (reference merges M2 buffers for the same reason,
+    AggregateFunctions.scala GpuStddevSamp family)."""
+
+    def test_stddev_variance_match_oracle(self):
+        import numpy as np
+        from harness import assert_tpu_and_cpu_are_equal_collect
+
+        def q(s):
+            rng = np.random.default_rng(4)
+            df = s.create_dataframe({
+                "k": rng.integers(0, 10, 3000).astype(np.int64),
+                "v": rng.standard_normal(3000)}, num_partitions=3)
+            return df.group_by("k").agg(
+                F.stddev("v").alias("sd"),
+                F.stddev_pop("v").alias("sp"),
+                F.variance("v").alias("vr"),
+                F.var_pop("v").alias("vp"))
+        rows = assert_tpu_and_cpu_are_equal_collect(q)
+        assert len(rows) == 10
+
+    def test_large_mean_no_cancellation(self):
+        import numpy as np
+        from harness import assert_tpu_and_cpu_are_equal_collect
+
+        def q(s):
+            rng = np.random.default_rng(4)
+            # mean 1e8, sd ~1: the sumsq formula returns 0.0 here
+            df = s.create_dataframe({
+                "k": rng.integers(0, 10, 3000).astype(np.int64),
+                "v": rng.standard_normal(3000) + 1e8})
+            return df.group_by("k").agg(F.stddev("v").alias("sd"))
+        rows = assert_tpu_and_cpu_are_equal_collect(q)
+        assert all(r[1] > 0.5 for r in rows)
+
+    def test_single_row_group_is_null_for_sample(self):
+        import numpy as np
+        from harness import assert_tpu_and_cpu_are_equal_collect
+
+        def q(s):
+            df = s.create_dataframe({
+                "k": np.array([1, 2, 2], np.int64),
+                "v": np.array([5.0, 1.0, 3.0])})
+            return df.group_by("k").agg(F.stddev("v").alias("sd"),
+                                        F.stddev_pop("v").alias("sp"))
+        assert_tpu_and_cpu_are_equal_collect(q)
