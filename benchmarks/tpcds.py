@@ -27,7 +27,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 ROWS_PER_SF = {"store_sales": 2_880_000, "item": 18_000,
                "customer": 100_000, "customer_address": 50_000,
                "customer_demographics": 19_208, "store": 12,
-               "household_demographics": 7_200, "promotion": 300}
+               "household_demographics": 7_200, "promotion": 300,
+               "catalog_sales": 1_440_000, "web_sales": 720_000,
+               "store_returns": 288_000, "catalog_returns": 144_000,
+               "web_returns": 72_000, "inventory": 1_000_000,
+               "catalog_page": 11_718}
 
 DATE_SK0 = 2450815          # 1998-01-01
 N_DATES = 365 * 5           # 1998-2002
@@ -52,11 +56,23 @@ def generate(data_dir: str, scale: float, seed: int = 0):
     years = ymd.astype("datetime64[Y]").astype(int) + 1970
     months = ymd.astype("datetime64[M]").astype(int) % 12 + 1
     dom = (ymd - ymd.astype("datetime64[M]")).astype(int) + 1
+    dow = ((ymd.astype("datetime64[D]").astype(int) + 4) % 7)  # 0=Sunday
+    qoy = (months - 1) // 3 + 1
+    month_seq = (years - 1900) * 12 + months - 1
+    week_seq = ((ymd.astype(int) - ymd.astype(int).min()) // 7 + 5200)
+    day_names = np.array(["Sunday", "Monday", "Tuesday", "Wednesday",
+                          "Thursday", "Friday", "Saturday"])
     write("date_dim", pa.table({
         "d_date_sk": (DATE_SK0 + np.arange(N_DATES)).astype(np.int64),
+        "d_date": ymd,
         "d_year": years.astype(np.int32),
         "d_moy": months.astype(np.int32),
         "d_dom": dom.astype(np.int32),
+        "d_qoy": qoy.astype(np.int32),
+        "d_dow": dow.astype(np.int32),
+        "d_day_name": day_names[dow],
+        "d_month_seq": month_seq.astype(np.int32),
+        "d_week_seq": week_seq.astype(np.int32),
     }))
 
     write("time_dim", pa.table({
@@ -75,6 +91,7 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "i_class": rng.choice(
             ["dresses", "shirts", "pants", "football", "fishing",
              "classical", "rock"], ni),
+        "i_class_id": rng.integers(1, 17, ni).astype(np.int64),
         "i_category": rng.choice(
             ["Women", "Men", "Sports", "Music", "Books", "Home"], ni),
         "i_category_id": rng.integers(1, 11, ni).astype(np.int64),
@@ -82,22 +99,58 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "i_manufact": np.array([f"manufact#{i % 1000}" for i in range(ni)]),
         "i_manager_id": rng.integers(1, 100, ni).astype(np.int64),
         "i_current_price": (rng.random(ni) * 100).round(2),
+        "i_wholesale_cost": (rng.random(ni) * 80).round(2),
+        "i_color": rng.choice(
+            ["red", "blue", "green", "yellow", "purple", "orange",
+             "white", "black"], ni),
+        "i_size": rng.choice(
+            ["small", "medium", "large", "extra large", "petite",
+             "economy"], ni),
+        "i_units": rng.choice(["Each", "Dozen", "Case", "Pallet"], ni),
+        "i_product_name": np.array([f"product{i}" for i in range(ni)]),
     }))
 
     ns = n["store"]
     write("store", pa.table({
         "s_store_sk": np.arange(ns, dtype=np.int64),
+        "s_store_id": np.array([f"AAAAAAAA{i:04d}" for i in range(ns)]),
         "s_store_name": rng.choice(["ese", "ought", "able", "pri"], ns),
         "s_state": rng.choice(["TN", "SD", "AL", "GA"], ns),
+        "s_county": rng.choice(
+            ["Williamson County", "Ziebach County", "Walker County"], ns),
+        "s_city": rng.choice(["Midway", "Fairview", "Oakland"], ns),
         "s_zip": np.array([f"{rng.integers(10000, 99999)}" for _ in
                            range(ns)]),
+        "s_number_employees": rng.integers(200, 300, ns).astype(np.int32),
+        "s_company_id": np.ones(ns, dtype=np.int32),
+        "s_gmt_offset": np.full(ns, -5.0),
     }))
 
     nc = n["customer"]
+    first_names = rng.choice(["John", "Mary", "Ann", "Sam", "Pat",
+                              "Lee", "Kim", "Dana"], nc)
+    last_names = rng.choice(["Smith", "Jones", "Brown", "Lee",
+                             "Walker", "Hill"], nc)
     write("customer", pa.table({
         "c_customer_sk": np.arange(nc, dtype=np.int64),
+        "c_customer_id": np.array([f"AAAAAAAA{i:08d}" for i in
+                                   range(nc)]),
         "c_current_addr_sk": rng.integers(
             0, n["customer_address"], nc).astype(np.int64),
+        "c_current_cdemo_sk": rng.integers(
+            0, n["customer_demographics"], nc).astype(np.int64),
+        "c_current_hdemo_sk": rng.integers(
+            0, n["household_demographics"], nc).astype(np.int64),
+        "c_first_name": first_names,
+        "c_last_name": last_names,
+        "c_salutation": rng.choice(["Mr.", "Mrs.", "Ms.", "Dr."], nc),
+        "c_birth_country": rng.choice(
+            ["UNITED STATES", "CANADA", "MEXICO", "GERMANY"], nc),
+        "c_birth_year": rng.integers(1930, 1995, nc).astype(np.int32),
+        "c_birth_month": rng.integers(1, 13, nc).astype(np.int32),
+        "c_preferred_cust_flag": rng.choice(["Y", "N"], nc),
+        "c_email_address": np.array(
+            [f"c{i}@example.com" for i in range(nc)]),
     }))
 
     na = n["customer_address"]
@@ -105,6 +158,17 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "ca_address_sk": np.arange(na, dtype=np.int64),
         "ca_zip": np.array([f"{rng.integers(10000, 99999)}"
                             for _ in range(na)]),
+        "ca_state": rng.choice(["TN", "SD", "AL", "GA", "CA", "TX",
+                                "NY", "OH"], na),
+        "ca_city": rng.choice(["Midway", "Fairview", "Oakland",
+                               "Springfield", "Salem"], na),
+        "ca_county": rng.choice(
+            ["Williamson County", "Ziebach County", "Walker County",
+             "Rush County"], na),
+        "ca_country": np.full(na, "United States"),
+        "ca_gmt_offset": rng.choice([-5.0, -6.0, -7.0, -8.0], na),
+        "ca_location_type": rng.choice(
+            ["apartment", "condo", "single family"], na),
     }))
 
     nd = n["customer_demographics"]
@@ -121,6 +185,17 @@ def generate(data_dir: str, scale: float, seed: int = 0):
     write("household_demographics", pa.table({
         "hd_demo_sk": np.arange(nh, dtype=np.int64),
         "hd_dep_count": rng.integers(0, 10, nh).astype(np.int32),
+        "hd_vehicle_count": rng.integers(-1, 5, nh).astype(np.int32),
+        "hd_income_band_sk": rng.integers(1, 21, nh).astype(np.int64),
+        "hd_buy_potential": rng.choice(
+            ["0-500", "501-1000", "1001-5000", "5001-10000", ">10000",
+             "Unknown"], nh),
+    }))
+
+    write("income_band", pa.table({
+        "ib_income_band_sk": np.arange(1, 21, dtype=np.int64),
+        "ib_lower_bound": (np.arange(20) * 10000).astype(np.int32),
+        "ib_upper_bound": ((np.arange(20) + 1) * 10000).astype(np.int32),
     }))
 
     npx = n["promotion"]
@@ -128,10 +203,64 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "p_promo_sk": np.arange(npx, dtype=np.int64),
         "p_channel_email": rng.choice(["Y", "N"], npx),
         "p_channel_event": rng.choice(["Y", "N"], npx),
+        "p_channel_dmail": rng.choice(["Y", "N"], npx),
+        "p_channel_tv": rng.choice(["Y", "N"], npx),
+    }))
+
+    write("warehouse", pa.table({
+        "w_warehouse_sk": np.arange(5, dtype=np.int64),
+        "w_warehouse_name": np.array([f"Warehouse {i}" for i in
+                                      range(5)]),
+        "w_warehouse_sq_ft": (np.arange(5) * 10000 + 50000)
+        .astype(np.int32),
+        "w_state": np.array(["TN", "SD", "AL", "GA", "CA"]),
+        "w_country": np.full(5, "United States"),
+    }))
+
+    write("ship_mode", pa.table({
+        "sm_ship_mode_sk": np.arange(20, dtype=np.int64),
+        "sm_type": np.array((["EXPRESS", "NEXT DAY", "OVERNIGHT",
+                              "REGULAR", "TWO DAY"] * 4)[:20]),
+        "sm_carrier": np.array((["UPS", "FEDEX", "AIRBORNE", "USPS",
+                                 "DHL"] * 4)[:20]),
+    }))
+
+    write("reason", pa.table({
+        "r_reason_sk": np.arange(35, dtype=np.int64),
+        "r_reason_desc": np.array([f"reason {i}" for i in range(35)]),
+    }))
+
+    write("call_center", pa.table({
+        "cc_call_center_sk": np.arange(6, dtype=np.int64),
+        "cc_name": np.array([f"call center {i}" for i in range(6)]),
+        "cc_manager": np.array([f"Manager {i}" for i in range(6)]),
+        "cc_county": np.full(6, "Williamson County"),
+    }))
+
+    ncp = n["catalog_page"]
+    write("catalog_page", pa.table({
+        "cp_catalog_page_sk": np.arange(ncp, dtype=np.int64),
+        "cp_catalog_page_id": np.array(
+            [f"AAAAAAAA{i:08d}" for i in range(ncp)]),
+    }))
+
+    write("web_site", pa.table({
+        "web_site_sk": np.arange(30, dtype=np.int64),
+        "web_site_id": np.array([f"AAAAAAAA{i:04d}" for i in range(30)]),
+        "web_name": np.array([f"site_{i}" for i in range(30)]),
+    }))
+
+    write("web_page", pa.table({
+        "wp_web_page_sk": np.arange(60, dtype=np.int64),
+        "wp_char_count": rng.integers(4000, 6000, 60).astype(np.int32),
     }))
 
     nss = n["store_sales"]
     price = (rng.random(nss) * 200).round(2)
+    qty = rng.integers(1, 100, nss)
+    wcost = (rng.random(nss) * 100).round(2)
+    ext_sales = (price * qty).round(2)
+    ext_wcost = (wcost * qty).round(2)
     write("store_sales", pa.table({
         "ss_sold_date_sk": (DATE_SK0 + rng.integers(
             0, N_DATES, nss)).astype(np.int64),
@@ -140,20 +269,182 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "ss_customer_sk": rng.integers(0, nc, nss).astype(np.int64),
         "ss_cdemo_sk": rng.integers(0, nd, nss).astype(np.int64),
         "ss_hdemo_sk": rng.integers(0, nh, nss).astype(np.int64),
+        "ss_addr_sk": rng.integers(0, na, nss).astype(np.int64),
         "ss_store_sk": rng.integers(0, ns, nss).astype(np.int64),
         "ss_promo_sk": rng.integers(0, npx, nss).astype(np.int64),
-        "ss_quantity": rng.integers(1, 100, nss).astype(np.int32),
+        "ss_ticket_number": (rng.integers(0, nss, nss) // 4)
+        .astype(np.int64),
+        "ss_quantity": qty.astype(np.int32),
+        "ss_wholesale_cost": wcost,
         "ss_list_price": (price * 1.2).round(2),
         "ss_sales_price": price,
-        "ss_ext_sales_price": (price * rng.integers(1, 100, nss)).round(2),
+        "ss_ext_discount_amt": (rng.random(nss) * 100).round(2),
+        "ss_ext_sales_price": ext_sales,
+        "ss_ext_wholesale_cost": ext_wcost,
+        "ss_ext_list_price": (price * 1.2 * qty).round(2),
+        "ss_ext_tax": (ext_sales * 0.08).round(2),
         "ss_coupon_amt": (rng.random(nss) * 50).round(2),
+        "ss_net_paid": (ext_sales * 0.95).round(2),
+        "ss_net_paid_inc_tax": (ext_sales * 1.03).round(2),
+        "ss_net_profit": (ext_sales - ext_wcost).round(2),
+    }))
+
+    ncs = n["catalog_sales"]
+    cprice = (rng.random(ncs) * 200).round(2)
+    cqty = rng.integers(1, 100, ncs)
+    cwcost = (rng.random(ncs) * 100).round(2)
+    cext = (cprice * cqty).round(2)
+    write("catalog_sales", pa.table({
+        "cs_sold_date_sk": (DATE_SK0 + rng.integers(
+            0, N_DATES, ncs)).astype(np.int64),
+        "cs_ship_date_sk": (DATE_SK0 + rng.integers(
+            0, N_DATES, ncs)).astype(np.int64),
+        "cs_bill_customer_sk": rng.integers(0, nc, ncs).astype(np.int64),
+        "cs_bill_cdemo_sk": rng.integers(0, nd, ncs).astype(np.int64),
+        "cs_bill_hdemo_sk": rng.integers(0, nh, ncs).astype(np.int64),
+        "cs_bill_addr_sk": rng.integers(0, na, ncs).astype(np.int64),
+        "cs_ship_mode_sk": rng.integers(0, 20, ncs).astype(np.int64),
+        "cs_call_center_sk": rng.integers(0, 6, ncs).astype(np.int64),
+        "cs_catalog_page_sk": rng.integers(
+            0, n["catalog_page"], ncs).astype(np.int64),
+        "cs_warehouse_sk": rng.integers(0, 5, ncs).astype(np.int64),
+        "cs_item_sk": rng.integers(0, ni, ncs).astype(np.int64),
+        "cs_promo_sk": rng.integers(0, npx, ncs).astype(np.int64),
+        "cs_order_number": (rng.integers(0, ncs, ncs) // 3)
+        .astype(np.int64),
+        "cs_quantity": cqty.astype(np.int32),
+        "cs_wholesale_cost": cwcost,
+        "cs_list_price": (cprice * 1.2).round(2),
+        "cs_sales_price": cprice,
+        "cs_ext_discount_amt": (rng.random(ncs) * 100).round(2),
+        "cs_ext_sales_price": cext,
+        "cs_ext_wholesale_cost": (cwcost * cqty).round(2),
+        "cs_ext_list_price": (cprice * 1.2 * cqty).round(2),
+        "cs_ext_ship_cost": (cext * 0.05).round(2),
+        "cs_coupon_amt": (rng.random(ncs) * 50).round(2),
+        "cs_net_paid": (cext * 0.95).round(2),
+        "cs_net_paid_inc_ship": (cext * 1.02).round(2),
+        "cs_net_profit": (cext - cwcost * cqty).round(2),
+    }))
+
+    nws = n["web_sales"]
+    wprice = (rng.random(nws) * 200).round(2)
+    wqty = rng.integers(1, 100, nws)
+    wwcost = (rng.random(nws) * 100).round(2)
+    wext = (wprice * wqty).round(2)
+    write("web_sales", pa.table({
+        "ws_sold_date_sk": (DATE_SK0 + rng.integers(
+            0, N_DATES, nws)).astype(np.int64),
+        "ws_sold_time_sk": rng.integers(0, 86400, nws).astype(np.int64),
+        "ws_ship_date_sk": (DATE_SK0 + rng.integers(
+            0, N_DATES, nws)).astype(np.int64),
+        "ws_item_sk": rng.integers(0, ni, nws).astype(np.int64),
+        "ws_bill_customer_sk": rng.integers(0, nc, nws).astype(np.int64),
+        "ws_bill_addr_sk": rng.integers(0, na, nws).astype(np.int64),
+        "ws_ship_customer_sk": rng.integers(0, nc, nws).astype(np.int64),
+        "ws_ship_addr_sk": rng.integers(0, na, nws).astype(np.int64),
+        "ws_web_page_sk": rng.integers(0, 60, nws).astype(np.int64),
+        "ws_web_site_sk": rng.integers(0, 30, nws).astype(np.int64),
+        "ws_ship_mode_sk": rng.integers(0, 20, nws).astype(np.int64),
+        "ws_warehouse_sk": rng.integers(0, 5, nws).astype(np.int64),
+        "ws_promo_sk": rng.integers(0, npx, nws).astype(np.int64),
+        "ws_order_number": (rng.integers(0, nws, nws) // 3)
+        .astype(np.int64),
+        "ws_quantity": wqty.astype(np.int32),
+        "ws_wholesale_cost": wwcost,
+        "ws_list_price": (wprice * 1.2).round(2),
+        "ws_sales_price": wprice,
+        "ws_ext_discount_amt": (rng.random(nws) * 100).round(2),
+        "ws_ext_sales_price": wext,
+        "ws_ext_wholesale_cost": (wwcost * wqty).round(2),
+        "ws_ext_list_price": (wprice * 1.2 * wqty).round(2),
+        "ws_ext_ship_cost": (wext * 0.05).round(2),
+        "ws_net_paid": (wext * 0.95).round(2),
+        "ws_net_profit": (wext - wwcost * wqty).round(2),
+    }))
+
+    nsr = n["store_returns"]
+    ramt = (rng.random(nsr) * 150).round(2)
+    write("store_returns", pa.table({
+        "sr_returned_date_sk": (DATE_SK0 + rng.integers(
+            0, N_DATES, nsr)).astype(np.int64),
+        "sr_item_sk": rng.integers(0, ni, nsr).astype(np.int64),
+        "sr_customer_sk": rng.integers(0, nc, nsr).astype(np.int64),
+        "sr_cdemo_sk": rng.integers(0, nd, nsr).astype(np.int64),
+        "sr_store_sk": rng.integers(0, ns, nsr).astype(np.int64),
+        "sr_reason_sk": rng.integers(0, 35, nsr).astype(np.int64),
+        "sr_ticket_number": (rng.integers(0, nss, nsr) // 4)
+        .astype(np.int64),
+        "sr_return_quantity": rng.integers(1, 50, nsr).astype(np.int32),
+        "sr_return_amt": ramt,
+        "sr_return_tax": (ramt * 0.08).round(2),
+        "sr_return_amt_inc_tax": (ramt * 1.08).round(2),
+        "sr_fee": (rng.random(nsr) * 20).round(2),
+        "sr_return_ship_cost": (rng.random(nsr) * 10).round(2),
+        "sr_refunded_cash": (ramt * 0.8).round(2),
+        "sr_reversed_charge": (ramt * 0.1).round(2),
+        "sr_store_credit": (ramt * 0.1).round(2),
+        "sr_net_loss": (rng.random(nsr) * 60).round(2),
+    }))
+
+    ncr = n["catalog_returns"]
+    cramt = (rng.random(ncr) * 150).round(2)
+    write("catalog_returns", pa.table({
+        "cr_returned_date_sk": (DATE_SK0 + rng.integers(
+            0, N_DATES, ncr)).astype(np.int64),
+        "cr_item_sk": rng.integers(0, ni, ncr).astype(np.int64),
+        "cr_returning_customer_sk": rng.integers(
+            0, nc, ncr).astype(np.int64),
+        "cr_returning_addr_sk": rng.integers(0, na, ncr).astype(np.int64),
+        "cr_call_center_sk": rng.integers(0, 6, ncr).astype(np.int64),
+        "cr_catalog_page_sk": rng.integers(
+            0, n["catalog_page"], ncr).astype(np.int64),
+        "cr_reason_sk": rng.integers(0, 35, ncr).astype(np.int64),
+        "cr_order_number": (rng.integers(0, ncs, ncr) // 3)
+        .astype(np.int64),
+        "cr_return_quantity": rng.integers(1, 50, ncr).astype(np.int32),
+        "cr_return_amount": cramt,
+        "cr_return_amt_inc_tax": (cramt * 1.08).round(2),
+        "cr_net_loss": (rng.random(ncr) * 60).round(2),
+    }))
+
+    nwr = n["web_returns"]
+    wramt = (rng.random(nwr) * 150).round(2)
+    write("web_returns", pa.table({
+        "wr_returned_date_sk": (DATE_SK0 + rng.integers(
+            0, N_DATES, nwr)).astype(np.int64),
+        "wr_item_sk": rng.integers(0, ni, nwr).astype(np.int64),
+        "wr_returning_customer_sk": rng.integers(
+            0, nc, nwr).astype(np.int64),
+        "wr_returning_addr_sk": rng.integers(0, na, nwr).astype(np.int64),
+        "wr_web_page_sk": rng.integers(0, 60, nwr).astype(np.int64),
+        "wr_reason_sk": rng.integers(0, 35, nwr).astype(np.int64),
+        "wr_order_number": (rng.integers(0, nws, nwr) // 3)
+        .astype(np.int64),
+        "wr_return_quantity": rng.integers(1, 50, nwr).astype(np.int32),
+        "wr_return_amt": wramt,
+        "wr_net_loss": (rng.random(nwr) * 60).round(2),
+    }))
+
+    nin = n["inventory"]
+    write("inventory", pa.table({
+        "inv_date_sk": (DATE_SK0 + (rng.integers(0, N_DATES // 7, nin)
+                                    * 7)).astype(np.int64),
+        "inv_item_sk": rng.integers(0, ni, nin).astype(np.int64),
+        "inv_warehouse_sk": rng.integers(0, 5, nin).astype(np.int64),
+        "inv_quantity_on_hand": rng.integers(
+            0, 1000, nin).astype(np.int32),
     }))
     return n
 
 
 TABLES = ["date_dim", "time_dim", "item", "store", "customer",
           "customer_address", "customer_demographics",
-          "household_demographics", "promotion", "store_sales"]
+          "household_demographics", "income_band", "promotion",
+          "warehouse", "ship_mode", "reason", "call_center",
+          "catalog_page", "web_site", "web_page", "store_sales",
+          "catalog_sales", "web_sales", "store_returns",
+          "catalog_returns", "web_returns", "inventory"]
 
 
 def register(s, data_dir: str):
@@ -162,120 +453,7 @@ def register(s, data_dir: str):
             .create_or_replace_temp_view(t)
 
 
-QUERIES = {
-    # TPC-DS Q3: brand revenue by year for one manufacturer in November
-    "q3": """
-        select d_year, i_brand_id brand_id, i_brand brand,
-               sum(ss_ext_sales_price) sum_agg
-        from date_dim, store_sales, item
-        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
-          and i_manufact_id = 128 and d_moy = 11
-        group by d_year, i_brand_id, i_brand
-        order by d_year, sum_agg desc, brand_id
-        limit 100""",
-    # TPC-DS Q7: average sales metrics for one demographic + promotion
-    "q7": """
-        select i_item_id,
-               avg(ss_quantity) agg1, avg(ss_list_price) agg2,
-               avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
-        from store_sales, customer_demographics, date_dim, item, promotion
-        where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
-          and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
-          and cd_gender = 'M' and cd_marital_status = 'S'
-          and cd_education_status = 'College'
-          and (p_channel_email = 'N' or p_channel_event = 'N')
-          and d_year = 2000
-        group by i_item_id
-        order by i_item_id
-        limit 100""",
-    # TPC-DS Q19: brand revenue where customer and store zips differ
-    "q19": """
-        select i_brand_id brand_id, i_brand brand, i_manufact_id,
-               i_manufact, sum(ss_ext_sales_price) ext_price
-        from date_dim, store_sales, item, customer, customer_address,
-             store
-        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
-          and i_manager_id = 8 and d_moy = 11 and d_year = 1998
-          and ss_customer_sk = c_customer_sk
-          and c_current_addr_sk = ca_address_sk
-          and substring(ca_zip, 1, 5) <> substring(s_zip, 1, 5)
-          and ss_store_sk = s_store_sk
-        group by i_brand_id, i_brand, i_manufact_id, i_manufact
-        order by ext_price desc, brand_id
-        limit 100""",
-    # TPC-DS Q42: category revenue for one month
-    "q42": """
-        select d_year, i_category_id, i_category,
-               sum(ss_ext_sales_price) total_sales
-        from date_dim, store_sales, item
-        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
-          and i_manager_id = 1 and d_moy = 11 and d_year = 2000
-        group by d_year, i_category_id, i_category
-        order by total_sales desc, d_year, i_category_id, i_category
-        limit 100""",
-    # TPC-DS Q52: brand revenue for one month
-    "q52": """
-        select d_year, i_brand_id brand_id, i_brand brand,
-               sum(ss_ext_sales_price) ext_price
-        from date_dim, store_sales, item
-        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
-          and i_manager_id = 1 and d_moy = 11 and d_year = 2000
-        group by d_year, i_brand_id, i_brand
-        order by d_year, ext_price desc, brand_id
-        limit 100""",
-    # TPC-DS Q55: brand revenue for one manager/month
-    "q55": """
-        select i_brand_id brand_id, i_brand brand,
-               sum(ss_ext_sales_price) ext_price
-        from date_dim, store_sales, item
-        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
-          and i_manager_id = 28 and d_moy = 11 and d_year = 1999
-        group by i_brand_id, i_brand
-        order by ext_price desc, brand_id
-        limit 100""",
-    # TPC-DS Q27: demographic item/state averages with ROLLUP subtotals
-    "q27": """
-        select i_item_id, s_state, grouping(s_state) g_state,
-               avg(ss_quantity) agg1, avg(ss_list_price) agg2,
-               avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
-        from store_sales, customer_demographics, date_dim, store, item
-        where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
-          and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
-          and cd_gender = 'M' and cd_marital_status = 'S'
-          and cd_education_status = 'College' and d_year = 2002
-        group by rollup (i_item_id, s_state)
-        order by i_item_id, s_state
-        limit 100""",
-    # TPC-DS Q96: count of sales in a store/time/demographic slice
-    "q96": """
-        select count(*) cnt
-        from store_sales, household_demographics, time_dim, store
-        where ss_sold_time_sk = t_time_sk
-          and ss_hdemo_sk = hd_demo_sk and ss_store_sk = s_store_sk
-          and t_hour = 20 and t_minute >= 30 and hd_dep_count = 7
-          and s_store_name = 'ese'
-        order by cnt
-        limit 100""",
-    # TPC-DS Q98: item revenue with class-partitioned revenue ratio
-    # (aggregate + window-over-aggregate)
-    "q98": """
-        select i_item_id, i_item_desc, i_category, i_class,
-               i_current_price,
-               sum(ss_ext_sales_price) as itemrevenue,
-               sum(ss_ext_sales_price) * 100.0 /
-                 sum(sum(ss_ext_sales_price))
-                   over (partition by i_class) as revenueratio
-        from store_sales, item, date_dim
-        where ss_item_sk = i_item_sk
-          and i_category in ('Sports', 'Books', 'Home')
-          and ss_sold_date_sk = d_date_sk
-          and d_year = 1999 and d_moy between 2 and 3
-        group by i_item_id, i_item_desc, i_category, i_class,
-                 i_current_price
-        order by i_category, i_class, i_item_id, i_item_desc,
-                 revenueratio
-        limit 100""",
-}
+from tpcds_queries import QUERIES  # noqa: E402
 
 
 def run(engine: str, data_dir: str, queries, repeats: int = 1):
@@ -297,20 +475,117 @@ def run(engine: str, data_dir: str, queries, repeats: int = 1):
     return times
 
 
+def _norm_rows(rows):
+    out = []
+    for r in rows:
+        out.append(tuple("NaN" if isinstance(v, float) and v != v else v
+                         for v in r))
+    return sorted(out, key=lambda r: tuple(str(v) for v in r))
+
+
+def _rows_equal(cpu_rows, tpu_rows, rel=1e-6):
+    if len(cpu_rows) != len(tpu_rows):
+        return False, f"row count {len(cpu_rows)} vs {len(tpu_rows)}"
+    for i, (a, b) in enumerate(zip(cpu_rows, tpu_rows)):
+        if len(a) != len(b):
+            return False, f"row {i} width"
+        for x, y in zip(a, b):
+            if isinstance(x, float) and isinstance(y, float):
+                if abs(x - y) > rel * max(abs(x), abs(y), 1.0):
+                    return False, f"row {i}: {x!r} vs {y!r}"
+            elif x != y:
+                return False, f"row {i}: {x!r} vs {y!r}"
+    return True, ""
+
+
+def verify(data_dir: str, queries, out_path: str,
+           resume: bool = False):
+    """TPU-vs-CPU row comparison per query; writes the pass/fail
+    matrix (the qa_nightly role: every query is an oracle check, not
+    just a timing).  ``resume`` keeps prior passes from an existing
+    matrix file and re-runs only failures/missing queries."""
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.config import TpuConf
+    s_tpu = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": True}))
+    s_cpu = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": False}))
+    register(s_tpu, data_dir)
+    register(s_cpu, data_dir)
+    matrix = {}
+    if resume and os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                prior = json.load(f).get("queries", {})
+            matrix = {q: e for q, e in prior.items()
+                      if e.get("status") == "pass" and q in queries}
+        except Exception:
+            matrix = {}
+    for name in queries:
+        if name in matrix:
+            continue
+        sql = QUERIES[name]
+        entry = {}
+        try:
+            t0 = time.perf_counter()
+            tpu_rows = _norm_rows(s_tpu.sql(sql).collect())
+            entry["tpu_s"] = round(time.perf_counter() - t0, 4)
+            t0 = time.perf_counter()
+            cpu_rows = _norm_rows(s_cpu.sql(sql).collect())
+            entry["cpu_s"] = round(time.perf_counter() - t0, 4)
+            ok, why = _rows_equal(cpu_rows, tpu_rows)
+            entry["rows"] = len(tpu_rows)
+            entry["status"] = "pass" if ok else "FAIL"
+            if not ok:
+                entry["mismatch"] = why
+        except Exception as e:  # noqa: BLE001 - recorded per query
+            entry["status"] = "ERROR"
+            entry["error"] = f"{type(e).__name__}: {e}"[:300]
+        matrix[name] = entry
+        print(f"{name}: {entry['status']}"
+              + (f" ({entry.get('mismatch', entry.get('error', ''))})"
+                 if entry["status"] != "pass" else ""),
+              file=sys.stderr, flush=True)
+        # write incrementally: a long sweep should leave partial
+        # evidence if interrupted
+        passed = sum(1 for e in matrix.values() if e["status"] == "pass")
+        summary = {"passed": passed, "total": len(matrix),
+                   "queries": matrix}
+        with open(out_path, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+    # recompute outside the loop: with --resume everything may already
+    # pass and the loop body never runs
+    passed = sum(1 for e in matrix.values() if e["status"] == "pass")
+    summary = {"passed": passed, "total": len(matrix),
+               "queries": matrix}
+    with open(out_path, "w") as f:
+        json.dump(summary, f, indent=1, sort_keys=True)
+    return summary
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=0.001)
     ap.add_argument("--engine", choices=["tpu", "cpu"], default="tpu")
     ap.add_argument("--compare", action="store_true")
+    ap.add_argument("--verify", action="store_true")
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--matrix-out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tpcds_matrix.json"))
     ap.add_argument("--queries", default=",".join(QUERIES))
     ap.add_argument("--data-dir", default="/tmp/tpcds_data")
     ap.add_argument("--repeats", type=int, default=2)
     args = ap.parse_args()
-    tag = os.path.join(args.data_dir, f"sf{args.scale}_v2")
+    tag = os.path.join(args.data_dir, f"sf{args.scale}_v3")
     if not os.path.exists(os.path.join(tag, "store_sales.parquet")):
         sizes = generate(tag, args.scale)
         print(f"generated {sizes}", file=sys.stderr)
     queries = args.queries.split(",")
+    if args.verify:
+        summary = verify(tag, queries, args.matrix_out,
+                         resume=args.resume)
+        print(json.dumps({"passed": summary["passed"],
+                          "total": summary["total"]}))
+        return
     if args.compare:
         tpu = run("tpu", tag, queries, args.repeats)
         cpu = run("cpu", tag, queries, args.repeats)

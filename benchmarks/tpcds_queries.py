@@ -1,0 +1,1186 @@
+"""TPC-DS query corpus for the scaled star schema in tpcds.py.
+
+Faithful renditions of the official query shapes (qualification
+parameter choices) over the columns the generator produces; queries
+whose official text uses a correlated SCALAR subquery (q1, q6, q32,
+q81, q92) are excluded — the SQL front end decorrelates EXISTS/IN but
+not scalar subqueries yet.  Reference surface:
+integration_tests qa_nightly + the official tpcds queries directory.
+
+Every query is verified TPU-vs-CPU by ``tpcds.py --verify`` (rows
+compared with float tolerance); the pass/fail matrix is written to
+``benchmarks/tpcds_matrix.json``.
+"""
+
+QUERIES = {}
+
+# --------------------------------------------------------------------------
+# star-join aggregates
+# --------------------------------------------------------------------------
+
+QUERIES["q3"] = """
+    select d_year, i_brand_id brand_id, i_brand brand,
+           sum(ss_ext_sales_price) sum_agg
+    from date_dim, store_sales, item
+    where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+      and i_manufact_id = 128 and d_moy = 11
+    group by d_year, i_brand_id, i_brand
+    order by d_year, sum_agg desc, brand_id
+    limit 100"""
+
+QUERIES["q7"] = """
+    select i_item_id,
+           avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+           avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+    from store_sales, customer_demographics, date_dim, item, promotion
+    where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+      and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+      and cd_gender = 'M' and cd_marital_status = 'S'
+      and cd_education_status = 'College'
+      and (p_channel_email = 'N' or p_channel_event = 'N')
+      and d_year = 2000
+    group by i_item_id
+    order by i_item_id
+    limit 100"""
+
+QUERIES["q12"] = """
+    select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+           sum(ws_ext_sales_price) as itemrevenue,
+           sum(ws_ext_sales_price) * 100.0 /
+             sum(sum(ws_ext_sales_price)) over (partition by i_class)
+             as revenueratio
+    from web_sales, item, date_dim
+    where ws_item_sk = i_item_sk
+      and i_category in ('Sports', 'Books', 'Home')
+      and ws_sold_date_sk = d_date_sk
+      and d_year = 1999 and d_moy between 2 and 3
+    group by i_item_id, i_item_desc, i_category, i_class,
+             i_current_price
+    order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+    limit 100"""
+
+QUERIES["q13"] = """
+    select avg(ss_quantity) avg_q, avg(ss_ext_sales_price) avg_esp,
+           avg(ss_ext_wholesale_cost) avg_ewc,
+           sum(ss_ext_wholesale_cost) sum_ewc
+    from store_sales, store, customer_demographics,
+         household_demographics, customer_address, date_dim
+    where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+      and d_year = 2001
+      and ((ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+            and cd_marital_status = 'M'
+            and cd_education_status = 'Advanced Degree'
+            and ss_sales_price between 100.00 and 150.00
+            and hd_dep_count = 3)
+        or (ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+            and cd_marital_status = 'S'
+            and cd_education_status = 'College'
+            and ss_sales_price between 50.00 and 100.00
+            and hd_dep_count = 1)
+        or (ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+            and cd_marital_status = 'W'
+            and cd_education_status = '2 yr Degree'
+            and ss_sales_price between 150.00 and 200.00
+            and hd_dep_count = 1))
+      and ((ss_addr_sk = ca_address_sk and ca_country = 'United States'
+            and ca_state in ('TX', 'OH', 'TX')
+            and ss_net_profit between 100 and 200)
+        or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+            and ca_state in ('OR', 'NM', 'KY')
+            and ss_net_profit between 150 and 300)
+        or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+            and ca_state in ('VA', 'TX', 'MS')
+            and ss_net_profit between 50 and 250))"""
+
+QUERIES["q15"] = """
+    select ca_zip, sum(cs_sales_price) sum_sales
+    from catalog_sales, customer, customer_address, date_dim
+    where cs_bill_customer_sk = c_customer_sk
+      and c_current_addr_sk = ca_address_sk
+      and (substring(ca_zip, 1, 5) in
+             ('85669', '86197', '88274', '83405', '86475', '85392',
+              '85460', '80348', '81792')
+           or ca_state in ('CA', 'WA', 'GA')
+           or cs_sales_price > 500)
+      and cs_sold_date_sk = d_date_sk
+      and d_qoy = 2 and d_year = 2001
+    group by ca_zip
+    order by ca_zip
+    limit 100"""
+
+QUERIES["q19"] = """
+    select i_brand_id brand_id, i_brand brand, i_manufact_id,
+           i_manufact, sum(ss_ext_sales_price) ext_price
+    from date_dim, store_sales, item, customer, customer_address, store
+    where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+      and i_manager_id = 8 and d_moy = 11 and d_year = 1998
+      and ss_customer_sk = c_customer_sk
+      and c_current_addr_sk = ca_address_sk
+      and substring(ca_zip, 1, 5) <> substring(s_zip, 1, 5)
+      and ss_store_sk = s_store_sk
+    group by i_brand_id, i_brand, i_manufact_id, i_manufact
+    order by ext_price desc, brand_id
+    limit 100"""
+
+QUERIES["q20"] = """
+    select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+           sum(cs_ext_sales_price) as itemrevenue,
+           sum(cs_ext_sales_price) * 100.0 /
+             sum(sum(cs_ext_sales_price)) over (partition by i_class)
+             as revenueratio
+    from catalog_sales, item, date_dim
+    where cs_item_sk = i_item_sk
+      and i_category in ('Sports', 'Books', 'Home')
+      and cs_sold_date_sk = d_date_sk
+      and d_year = 1999 and d_moy between 2 and 3
+    group by i_item_id, i_item_desc, i_category, i_class,
+             i_current_price
+    order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+    limit 100"""
+
+QUERIES["q21"] = """
+    select w_warehouse_name, i_item_id,
+           sum(case when d_moy < 3 then inv_quantity_on_hand else 0 end)
+             as inv_before,
+           sum(case when d_moy >= 3 then inv_quantity_on_hand else 0 end)
+             as inv_after
+    from inventory, warehouse, item, date_dim
+    where i_current_price between 0.99 and 1.49
+      and i_item_sk = inv_item_sk
+      and inv_warehouse_sk = w_warehouse_sk
+      and inv_date_sk = d_date_sk
+      and d_year = 2000
+    group by w_warehouse_name, i_item_id
+    having sum(case when d_moy < 3 then inv_quantity_on_hand else 0
+               end) > 0
+    order by w_warehouse_name, i_item_id
+    limit 100"""
+
+QUERIES["q22"] = """
+    select i_product_name, i_brand, i_class, i_category,
+           avg(inv_quantity_on_hand) qoh
+    from inventory, date_dim, item
+    where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+      and d_month_seq between 1200 and 1200 + 11
+    group by rollup(i_product_name, i_brand, i_class, i_category)
+    order by qoh, i_product_name, i_brand, i_class, i_category
+    limit 100"""
+
+QUERIES["q25"] = """
+    select i_item_id, i_item_desc, s_store_id, s_store_name,
+           sum(ss_net_profit) as store_sales_profit,
+           sum(sr_net_loss) as store_returns_loss,
+           sum(cs_net_profit) as catalog_sales_profit
+    from store_sales, store_returns, catalog_sales, date_dim d1,
+         date_dim d2, date_dim d3, store, item
+    where d1.d_moy = 4 and d1.d_year = 2001
+      and d1.d_date_sk = ss_sold_date_sk
+      and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+      and ss_customer_sk = sr_customer_sk
+      and ss_item_sk = sr_item_sk
+      and ss_ticket_number = sr_ticket_number
+      and sr_returned_date_sk = d2.d_date_sk
+      and d2.d_moy between 4 and 10 and d2.d_year = 2001
+      and sr_customer_sk = cs_bill_customer_sk
+      and sr_item_sk = cs_item_sk
+      and cs_sold_date_sk = d3.d_date_sk
+      and d3.d_moy between 4 and 10 and d3.d_year = 2001
+    group by i_item_id, i_item_desc, s_store_id, s_store_name
+    order by i_item_id, i_item_desc, s_store_id, s_store_name
+    limit 100"""
+
+QUERIES["q26"] = """
+    select i_item_id,
+           avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+           avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4
+    from catalog_sales, customer_demographics, date_dim, item, promotion
+    where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+      and cs_bill_cdemo_sk = cd_demo_sk and cs_promo_sk = p_promo_sk
+      and cd_gender = 'M' and cd_marital_status = 'S'
+      and cd_education_status = 'College'
+      and (p_channel_email = 'N' or p_channel_event = 'N')
+      and d_year = 2000
+    group by i_item_id
+    order by i_item_id
+    limit 100"""
+
+QUERIES["q27"] = """
+    select i_item_id, s_state, grouping(s_state) g_state,
+           avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+           avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+    from store_sales, customer_demographics, date_dim, store, item
+    where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+      and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+      and cd_gender = 'M' and cd_marital_status = 'S'
+      and cd_education_status = 'College' and d_year = 2002
+    group by rollup (i_item_id, s_state)
+    order by i_item_id, s_state
+    limit 100"""
+
+QUERIES["q29"] = """
+    select i_item_id, i_item_desc, s_store_id, s_store_name,
+           sum(ss_quantity) as store_sales_quantity,
+           sum(sr_return_quantity) as store_returns_quantity,
+           sum(cs_quantity) as catalog_sales_quantity
+    from store_sales, store_returns, catalog_sales, date_dim d1,
+         date_dim d2, date_dim d3, store, item
+    where d1.d_moy = 9 and d1.d_year = 1999
+      and d1.d_date_sk = ss_sold_date_sk
+      and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+      and ss_customer_sk = sr_customer_sk
+      and ss_item_sk = sr_item_sk
+      and ss_ticket_number = sr_ticket_number
+      and sr_returned_date_sk = d2.d_date_sk
+      and d2.d_moy between 9 and 12 and d2.d_year = 1999
+      and sr_customer_sk = cs_bill_customer_sk
+      and sr_item_sk = cs_item_sk
+      and cs_sold_date_sk = d3.d_date_sk
+      and d3.d_year in (1999, 2000, 2001)
+    group by i_item_id, i_item_desc, s_store_id, s_store_name
+    order by i_item_id, i_item_desc, s_store_id, s_store_name
+    limit 100"""
+
+QUERIES["q33"] = """
+    with ss as (
+      select i_manufact_id, sum(ss_ext_sales_price) total_sales
+      from store_sales, date_dim, customer_address, item
+      where i_manufact_id in (
+              select i_manufact_id from item where i_category = 'Books')
+        and ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+        and d_year = 1998 and d_moy = 1
+        and ss_addr_sk = ca_address_sk and ca_gmt_offset = -5
+      group by i_manufact_id),
+    cs as (
+      select i_manufact_id, sum(cs_ext_sales_price) total_sales
+      from catalog_sales, date_dim, customer_address, item
+      where i_manufact_id in (
+              select i_manufact_id from item where i_category = 'Books')
+        and cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+        and d_year = 1998 and d_moy = 1
+        and cs_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+      group by i_manufact_id),
+    ws as (
+      select i_manufact_id, sum(ws_ext_sales_price) total_sales
+      from web_sales, date_dim, customer_address, item
+      where i_manufact_id in (
+              select i_manufact_id from item where i_category = 'Books')
+        and ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+        and d_year = 1998 and d_moy = 1
+        and ws_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+      group by i_manufact_id)
+    select i_manufact_id, sum(total_sales) total_sales
+    from (select * from ss union all
+          select * from cs union all
+          select * from ws) tmp1
+    group by i_manufact_id
+    order by total_sales, i_manufact_id
+    limit 100"""
+
+QUERIES["q34"] = """
+    select c_last_name, c_first_name, c_salutation,
+           c_preferred_cust_flag, ss_ticket_number, cnt
+    from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+          from store_sales, date_dim, store, household_demographics
+          where ss_sold_date_sk = d_date_sk
+            and ss_store_sk = s_store_sk
+            and ss_hdemo_sk = hd_demo_sk
+            and (d_dom between 1 and 3 or d_dom between 25 and 28)
+            and (hd_buy_potential = '>10000'
+                 or hd_buy_potential = 'Unknown')
+            and hd_vehicle_count > 0
+            and d_year in (1999, 2000, 2001)
+            and s_county in ('Williamson County', 'Ziebach County',
+                             'Walker County', 'Rush County')
+          group by ss_ticket_number, ss_customer_sk) dn, customer
+    where ss_customer_sk = c_customer_sk and cnt between 15 and 20
+    order by c_last_name, c_first_name, c_salutation,
+             c_preferred_cust_flag desc, ss_ticket_number
+    limit 1000"""
+
+QUERIES["q36"] = """
+    select sum(ss_net_profit) / sum(ss_ext_sales_price)
+             as gross_margin,
+           i_category, i_class, grouping(i_category) + grouping(i_class)
+             as lochierarchy
+    from store_sales, date_dim d1, item, store
+    where d1.d_year = 2001 and d1.d_date_sk = ss_sold_date_sk
+      and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+      and s_state in ('TN', 'SD', 'AL', 'GA')
+    group by rollup(i_category, i_class)
+    order by lochierarchy desc, i_category, i_class
+    limit 100"""
+
+QUERIES["q37"] = """
+    select i_item_id, i_item_desc, i_current_price
+    from item, inventory, date_dim, catalog_sales
+    where i_current_price between 68 and 68 + 30
+      and inv_item_sk = i_item_sk
+      and d_date_sk = inv_date_sk
+      and d_year = 2000
+      and i_manufact_id in (677, 940, 694, 808)
+      and inv_quantity_on_hand between 100 and 500
+      and cs_item_sk = i_item_sk
+    group by i_item_id, i_item_desc, i_current_price
+    order by i_item_id
+    limit 100"""
+
+QUERIES["q40"] = """
+    select w_state, i_item_id,
+           sum(case when d_year < 2000 then cs_sales_price -
+               coalesce(cr_return_amount, 0) else 0 end)
+             as sales_before,
+           sum(case when d_year >= 2000 then cs_sales_price -
+               coalesce(cr_return_amount, 0) else 0 end)
+             as sales_after
+    from catalog_sales
+      left outer join catalog_returns
+        on (cs_order_number = cr_order_number
+            and cs_item_sk = cr_item_sk),
+      warehouse, item, date_dim
+    where i_current_price between 0.99 and 1.49
+      and i_item_sk = cs_item_sk
+      and cs_warehouse_sk = w_warehouse_sk
+      and cs_sold_date_sk = d_date_sk
+      and d_year in (1999, 2000, 2001)
+    group by w_state, i_item_id
+    order by w_state, i_item_id
+    limit 100"""
+
+QUERIES["q42"] = """
+    select d_year, i_category_id, i_category,
+           sum(ss_ext_sales_price) total_sales
+    from date_dim, store_sales, item
+    where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+      and i_manager_id = 1 and d_moy = 11 and d_year = 2000
+    group by d_year, i_category_id, i_category
+    order by total_sales desc, d_year, i_category_id, i_category
+    limit 100"""
+
+QUERIES["q43"] = """
+    select s_store_name, s_store_id,
+           sum(case when d_day_name = 'Sunday' then ss_sales_price
+                    else null end) sun_sales,
+           sum(case when d_day_name = 'Monday' then ss_sales_price
+                    else null end) mon_sales,
+           sum(case when d_day_name = 'Tuesday' then ss_sales_price
+                    else null end) tue_sales,
+           sum(case when d_day_name = 'Wednesday' then ss_sales_price
+                    else null end) wed_sales,
+           sum(case when d_day_name = 'Thursday' then ss_sales_price
+                    else null end) thu_sales,
+           sum(case when d_day_name = 'Friday' then ss_sales_price
+                    else null end) fri_sales,
+           sum(case when d_day_name = 'Saturday' then ss_sales_price
+                    else null end) sat_sales
+    from date_dim, store_sales, store
+    where d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+      and s_gmt_offset = -5 and d_year = 2000
+    group by s_store_name, s_store_id
+    order by s_store_name, s_store_id, sun_sales, mon_sales, tue_sales,
+             wed_sales, thu_sales, fri_sales, sat_sales
+    limit 100"""
+
+QUERIES["q45"] = """
+    select ca_zip, ca_city, sum(ws_sales_price) sum_sales
+    from web_sales, customer, customer_address, date_dim, item
+    where ws_bill_customer_sk = c_customer_sk
+      and c_current_addr_sk = ca_address_sk
+      and ws_item_sk = i_item_sk
+      and (substring(ca_zip, 1, 5) in
+             ('85669', '86197', '88274', '83405', '86475', '85392',
+              '85460', '80348', '81792')
+           or i_item_id in (
+               select i_item_id from item
+               where i_item_sk in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29)))
+      and ws_sold_date_sk = d_date_sk
+      and d_qoy = 2 and d_year = 2001
+    group by ca_zip, ca_city
+    order by ca_zip, ca_city
+    limit 100"""
+
+QUERIES["q46"] = """
+    select c_last_name, c_first_name, ca_city, bought_city,
+           ss_ticket_number, amt, profit
+    from (select ss_ticket_number, ss_customer_sk,
+                 ca_city bought_city, sum(ss_coupon_amt) amt,
+                 sum(ss_net_profit) profit
+          from store_sales, date_dim, store, household_demographics,
+               customer_address
+          where ss_sold_date_sk = d_date_sk
+            and ss_store_sk = s_store_sk
+            and ss_hdemo_sk = hd_demo_sk
+            and ss_addr_sk = ca_address_sk
+            and (hd_dep_count = 4 or hd_vehicle_count = 3)
+            and d_dow in (6, 0)
+            and d_year in (1999, 2000, 2001)
+            and s_city in ('Fairview', 'Midway')
+          group by ss_ticket_number, ss_customer_sk, ss_addr_sk,
+                   ca_city) dn,
+         customer, customer_address current_addr
+    where ss_customer_sk = c_customer_sk
+      and c_current_addr_sk = current_addr.ca_address_sk
+      and current_addr.ca_city <> bought_city
+    order by c_last_name, c_first_name, ca_city, bought_city,
+             ss_ticket_number
+    limit 100"""
+
+QUERIES["q48"] = """
+    select sum(ss_quantity) sum_q
+    from store_sales, store, customer_demographics, customer_address,
+         date_dim
+    where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+      and d_year = 2000
+      and ((cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'M'
+            and cd_education_status = '4 yr Degree'
+            and ss_sales_price between 100.00 and 150.00)
+        or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'D'
+            and cd_education_status = '2 yr Degree'
+            and ss_sales_price between 50.00 and 100.00)
+        or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'S'
+            and cd_education_status = 'College'
+            and ss_sales_price between 150.00 and 200.00))
+      and ((ss_addr_sk = ca_address_sk and ca_country = 'United States'
+            and ca_state in ('CO', 'OH', 'TX')
+            and ss_net_profit between 0 and 2000)
+        or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+            and ca_state in ('OR', 'MN', 'KY')
+            and ss_net_profit between 150 and 3000)
+        or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+            and ca_state in ('VA', 'CA', 'MS')
+            and ss_net_profit between 50 and 25000))"""
+
+QUERIES["q50"] = """
+    select s_store_name, s_company_id, s_state, s_zip,
+           sum(case when (sr_returned_date_sk - ss_sold_date_sk <= 30)
+               then 1 else 0 end) as d30,
+           sum(case when (sr_returned_date_sk - ss_sold_date_sk > 30)
+                     and (sr_returned_date_sk - ss_sold_date_sk <= 60)
+               then 1 else 0 end) as d31_60,
+           sum(case when (sr_returned_date_sk - ss_sold_date_sk > 60)
+                     and (sr_returned_date_sk - ss_sold_date_sk <= 90)
+               then 1 else 0 end) as d61_90,
+           sum(case when (sr_returned_date_sk - ss_sold_date_sk > 90)
+               then 1 else 0 end) as d90_plus
+    from store_sales, store_returns, store, date_dim d1, date_dim d2
+    where d2.d_year = 2001 and d2.d_moy = 8
+      and ss_ticket_number = sr_ticket_number
+      and ss_item_sk = sr_item_sk
+      and ss_sold_date_sk = d1.d_date_sk
+      and sr_returned_date_sk = d2.d_date_sk
+      and ss_customer_sk = sr_customer_sk
+      and ss_store_sk = s_store_sk
+    group by s_store_name, s_company_id, s_state, s_zip
+    order by s_store_name, s_company_id, s_state, s_zip
+    limit 100"""
+
+QUERIES["q52"] = """
+    select d_year, i_brand_id brand_id, i_brand brand,
+           sum(ss_ext_sales_price) ext_price
+    from date_dim, store_sales, item
+    where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+      and i_manager_id = 1 and d_moy = 11 and d_year = 2000
+    group by d_year, i_brand_id, i_brand
+    order by d_year, ext_price desc, brand_id
+    limit 100"""
+
+QUERIES["q55"] = """
+    select i_brand_id brand_id, i_brand brand,
+           sum(ss_ext_sales_price) ext_price
+    from date_dim, store_sales, item
+    where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+      and i_manager_id = 28 and d_moy = 11 and d_year = 1999
+    group by i_brand_id, i_brand
+    order by ext_price desc, brand_id
+    limit 100"""
+
+# --------------------------------------------------------------------------
+# windows, set operations, multi-channel CTEs
+# --------------------------------------------------------------------------
+
+QUERIES["q9"] = """
+    select case when (select count(*) from store_sales
+                      where ss_quantity between 1 and 20) > 10000
+                then (select avg(ss_ext_discount_amt) from store_sales
+                      where ss_quantity between 1 and 20)
+                else (select avg(ss_net_paid) from store_sales
+                      where ss_quantity between 1 and 20) end bucket1,
+           case when (select count(*) from store_sales
+                      where ss_quantity between 21 and 40) > 10000
+                then (select avg(ss_ext_discount_amt) from store_sales
+                      where ss_quantity between 21 and 40)
+                else (select avg(ss_net_paid) from store_sales
+                      where ss_quantity between 21 and 40) end bucket2,
+           case when (select count(*) from store_sales
+                      where ss_quantity between 41 and 60) > 10000
+                then (select avg(ss_ext_discount_amt) from store_sales
+                      where ss_quantity between 41 and 60)
+                else (select avg(ss_net_paid) from store_sales
+                      where ss_quantity between 41 and 60) end bucket3
+    from reason
+    where r_reason_sk = 1"""
+
+QUERIES["q18"] = """
+    select i_item_id, ca_country, ca_state, ca_county,
+           avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+           avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4,
+           avg(cs_net_profit) agg5, avg(c_birth_year) agg6
+    from catalog_sales, customer_demographics cd1, customer, item,
+         customer_address, date_dim
+    where cs_sold_date_sk = d_date_sk
+      and cs_item_sk = i_item_sk
+      and cs_bill_cdemo_sk = cd1.cd_demo_sk
+      and cs_bill_customer_sk = c_customer_sk
+      and cd1.cd_gender = 'F'
+      and cd1.cd_education_status = 'Unknown'
+      and c_current_addr_sk = ca_address_sk
+      and c_birth_month in (1, 6, 8, 9, 12, 2)
+      and d_year = 1998
+      and ca_state in ('MS', 'IN', 'ND', 'OK', 'NM', 'VA', 'TN')
+    group by rollup(i_item_id, ca_country, ca_state, ca_county)
+    order by ca_country, ca_state, ca_county, i_item_id
+    limit 100"""
+
+QUERIES["q28"] = """
+    select b1.lp b1_lp, b1.cnt b1_cnt, b2.lp b2_lp, b2.cnt b2_cnt,
+           b3.lp b3_lp, b3.cnt b3_cnt
+    from (select avg(ss_list_price) lp, count(ss_list_price) cnt
+          from store_sales
+          where ss_quantity between 0 and 5
+            and (ss_list_price between 8 and 18
+                 or ss_coupon_amt between 459 and 1459
+                 or ss_wholesale_cost between 57 and 77)) b1,
+         (select avg(ss_list_price) lp, count(ss_list_price) cnt
+          from store_sales
+          where ss_quantity between 6 and 10
+            and (ss_list_price between 90 and 100
+                 or ss_coupon_amt between 2323 and 3323
+                 or ss_wholesale_cost between 31 and 51)) b2,
+         (select avg(ss_list_price) lp, count(ss_list_price) cnt
+          from store_sales
+          where ss_quantity between 11 and 15
+            and (ss_list_price between 142 and 152
+                 or ss_coupon_amt between 12214 and 13214
+                 or ss_wholesale_cost between 79 and 99)) b3"""
+
+QUERIES["q38"] = """
+    select count(*) cnt from (
+      select distinct c_last_name, c_first_name, d_date
+      from store_sales, date_dim, customer
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_customer_sk = customer.c_customer_sk
+        and d_month_seq between 1200 and 1200 + 11
+      intersect
+      select distinct c_last_name, c_first_name, d_date
+      from catalog_sales, date_dim, customer
+      where catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+        and catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+        and d_month_seq between 1200 and 1200 + 11
+      intersect
+      select distinct c_last_name, c_first_name, d_date
+      from web_sales, date_dim, customer
+      where web_sales.ws_sold_date_sk = date_dim.d_date_sk
+        and web_sales.ws_bill_customer_sk = customer.c_customer_sk
+        and d_month_seq between 1200 and 1200 + 11
+    ) hot_cust
+    limit 100"""
+
+QUERIES["q53"] = """
+    select manufact_id, sum_sales, avg_quarterly_sales
+    from (select i_manufact_id manufact_id,
+                 sum(ss_sales_price) sum_sales,
+                 avg(sum(ss_sales_price))
+                   over (partition by i_manufact_id)
+                   avg_quarterly_sales
+          from item, store_sales, date_dim, store
+          where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+            and ss_store_sk = s_store_sk
+            and d_month_seq in (1200, 1201, 1202, 1203, 1204, 1205,
+                                1206, 1207, 1208, 1209, 1210, 1211)
+            and ((i_category in ('Books', 'Home', 'Sports')
+                  and i_class in ('classical', 'fishing', 'football'))
+              or (i_category in ('Women', 'Music', 'Men')
+                  and i_class in ('shirts', 'dresses', 'pants')))
+          group by i_manufact_id, d_qoy) tmp1
+    where case when avg_quarterly_sales > 0
+               then abs(sum_sales - avg_quarterly_sales) /
+                    avg_quarterly_sales else null end > 0.1
+    order by avg_quarterly_sales, sum_sales, manufact_id
+    limit 100"""
+
+QUERIES["q56"] = """
+    with ss as (
+      select i_item_id, sum(ss_ext_sales_price) total_sales
+      from store_sales, date_dim, customer_address, item
+      where i_item_id in (select i_item_id from item
+                          where i_color in ('red', 'blue', 'green'))
+        and ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+        and d_year = 2000 and d_moy = 2
+        and ss_addr_sk = ca_address_sk and ca_gmt_offset = -5
+      group by i_item_id),
+    cs as (
+      select i_item_id, sum(cs_ext_sales_price) total_sales
+      from catalog_sales, date_dim, customer_address, item
+      where i_item_id in (select i_item_id from item
+                          where i_color in ('red', 'blue', 'green'))
+        and cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+        and d_year = 2000 and d_moy = 2
+        and cs_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+      group by i_item_id),
+    ws as (
+      select i_item_id, sum(ws_ext_sales_price) total_sales
+      from web_sales, date_dim, customer_address, item
+      where i_item_id in (select i_item_id from item
+                          where i_color in ('red', 'blue', 'green'))
+        and ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+        and d_year = 2000 and d_moy = 2
+        and ws_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+      group by i_item_id)
+    select i_item_id, sum(total_sales) total_sales
+    from (select * from ss union all
+          select * from cs union all
+          select * from ws) tmp1
+    group by i_item_id
+    order by total_sales, i_item_id
+    limit 100"""
+
+QUERIES["q60"] = """
+    with ss as (
+      select i_item_id, sum(ss_ext_sales_price) total_sales
+      from store_sales, date_dim, customer_address, item
+      where i_item_id in (select i_item_id from item
+                          where i_category = 'Music')
+        and ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+        and d_year = 1998 and d_moy = 9
+        and ss_addr_sk = ca_address_sk and ca_gmt_offset = -5
+      group by i_item_id),
+    cs as (
+      select i_item_id, sum(cs_ext_sales_price) total_sales
+      from catalog_sales, date_dim, customer_address, item
+      where i_item_id in (select i_item_id from item
+                          where i_category = 'Music')
+        and cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+        and d_year = 1998 and d_moy = 9
+        and cs_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+      group by i_item_id),
+    ws as (
+      select i_item_id, sum(ws_ext_sales_price) total_sales
+      from web_sales, date_dim, customer_address, item
+      where i_item_id in (select i_item_id from item
+                          where i_category = 'Music')
+        and ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+        and d_year = 1998 and d_moy = 9
+        and ws_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+      group by i_item_id)
+    select i_item_id, sum(total_sales) total_sales
+    from (select * from ss union all
+          select * from cs union all
+          select * from ws) tmp1
+    group by i_item_id
+    order by i_item_id, total_sales
+    limit 100"""
+
+QUERIES["q61"] = """
+    select promotions, total,
+           cast(promotions as double) / cast(total as double) * 100
+             as promo_pct
+    from (select sum(ss_ext_sales_price) promotions
+          from store_sales, store, promotion, date_dim, customer,
+               customer_address, item
+          where ss_sold_date_sk = d_date_sk
+            and ss_store_sk = s_store_sk
+            and ss_promo_sk = p_promo_sk
+            and ss_customer_sk = c_customer_sk
+            and ca_address_sk = c_current_addr_sk
+            and ss_item_sk = i_item_sk
+            and ca_gmt_offset = -5 and i_category = 'Books'
+            and (p_channel_dmail = 'Y' or p_channel_email = 'Y'
+                 or p_channel_tv = 'Y')
+            and s_gmt_offset = -5 and d_year = 1998
+            and d_moy = 11) promotional_sales,
+         (select sum(ss_ext_sales_price) total
+          from store_sales, store, date_dim, customer,
+               customer_address, item
+          where ss_sold_date_sk = d_date_sk
+            and ss_store_sk = s_store_sk
+            and ss_customer_sk = c_customer_sk
+            and ca_address_sk = c_current_addr_sk
+            and ss_item_sk = i_item_sk
+            and ca_gmt_offset = -5 and i_category = 'Books'
+            and s_gmt_offset = -5 and d_year = 1998
+            and d_moy = 11) all_sales
+    order by promotions, total
+    limit 100"""
+
+QUERIES["q62"] = """
+    select w_warehouse_name, sm_type, web_name,
+           sum(case when (ws_ship_date_sk - ws_sold_date_sk <= 30)
+               then 1 else 0 end) as d30,
+           sum(case when (ws_ship_date_sk - ws_sold_date_sk > 30)
+                     and (ws_ship_date_sk - ws_sold_date_sk <= 60)
+               then 1 else 0 end) as d31_60,
+           sum(case when (ws_ship_date_sk - ws_sold_date_sk > 60)
+                     and (ws_ship_date_sk - ws_sold_date_sk <= 90)
+               then 1 else 0 end) as d61_90,
+           sum(case when (ws_ship_date_sk - ws_sold_date_sk > 90)
+               then 1 else 0 end) as d90_plus
+    from web_sales, warehouse, ship_mode, web_site, date_dim
+    where d_month_seq between 1200 and 1200 + 11
+      and ws_ship_date_sk = d_date_sk
+      and ws_warehouse_sk = w_warehouse_sk
+      and ws_ship_mode_sk = sm_ship_mode_sk
+      and ws_web_site_sk = web_site_sk
+    group by w_warehouse_name, sm_type, web_name
+    order by w_warehouse_name, sm_type, web_name
+    limit 100"""
+
+QUERIES["q63"] = """
+    select manager_id, sum_sales, avg_monthly_sales
+    from (select i_manager_id manager_id,
+                 sum(ss_sales_price) sum_sales,
+                 avg(sum(ss_sales_price))
+                   over (partition by i_manager_id) avg_monthly_sales
+          from item, store_sales, date_dim, store
+          where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+            and ss_store_sk = s_store_sk
+            and d_month_seq in (1200, 1201, 1202, 1203, 1204, 1205,
+                                1206, 1207, 1208, 1209, 1210, 1211)
+            and ((i_category in ('Books', 'Home', 'Sports')
+                  and i_class in ('classical', 'fishing', 'football'))
+              or (i_category in ('Women', 'Music', 'Men')
+                  and i_class in ('shirts', 'dresses', 'pants')))
+          group by i_manager_id, d_moy) tmp1
+    where case when avg_monthly_sales > 0
+               then abs(sum_sales - avg_monthly_sales) /
+                    avg_monthly_sales else null end > 0.1
+    order by manager_id, avg_monthly_sales, sum_sales
+    limit 100"""
+
+QUERIES["q65"] = """
+    select s_store_name, i_item_desc, sc.revenue, i_current_price,
+           i_wholesale_cost, i_brand
+    from store, item,
+         (select ss_store_sk, avg(revenue) as ave
+          from (select ss_store_sk, ss_item_sk,
+                       sum(ss_sales_price) as revenue
+                from store_sales, date_dim
+                where ss_sold_date_sk = d_date_sk
+                  and d_month_seq between 1176 and 1176 + 11
+                group by ss_store_sk, ss_item_sk) sa
+          group by ss_store_sk) sb,
+         (select ss_store_sk, ss_item_sk,
+                 sum(ss_sales_price) as revenue
+          from store_sales, date_dim
+          where ss_sold_date_sk = d_date_sk
+            and d_month_seq between 1176 and 1176 + 11
+          group by ss_store_sk, ss_item_sk) sc
+    where sb.ss_store_sk = sc.ss_store_sk
+      and sc.revenue <= 0.1 * sb.ave
+      and s_store_sk = sc.ss_store_sk
+      and i_item_sk = sc.ss_item_sk
+    order by s_store_name, i_item_desc, sc.revenue
+    limit 100"""
+
+QUERIES["q68"] = """
+    select c_last_name, c_first_name, ca_city, bought_city,
+           ss_ticket_number, extended_price, extended_tax,
+           list_price
+    from (select ss_ticket_number, ss_customer_sk,
+                 ca_city bought_city,
+                 sum(ss_ext_sales_price) extended_price,
+                 sum(ss_ext_list_price) list_price,
+                 sum(ss_ext_tax) extended_tax
+          from store_sales, date_dim, store, household_demographics,
+               customer_address
+          where ss_sold_date_sk = d_date_sk
+            and ss_store_sk = s_store_sk
+            and ss_hdemo_sk = hd_demo_sk
+            and ss_addr_sk = ca_address_sk
+            and d_dom between 1 and 2
+            and (hd_dep_count = 4 or hd_vehicle_count = 3)
+            and d_year in (1999, 2000, 2001)
+            and s_city in ('Fairview', 'Midway')
+          group by ss_ticket_number, ss_customer_sk, ss_addr_sk,
+                   ca_city) dn,
+         customer, customer_address current_addr
+    where ss_customer_sk = c_customer_sk
+      and c_current_addr_sk = current_addr.ca_address_sk
+      and current_addr.ca_city <> bought_city
+    order by c_last_name, ss_ticket_number
+    limit 100"""
+
+QUERIES["q69"] = """
+    select cd_gender, cd_marital_status, cd_education_status,
+           count(*) cnt1
+    from customer c, customer_address ca, customer_demographics
+    where c.c_current_addr_sk = ca.ca_address_sk
+      and ca_state in ('KY', 'GA', 'NM')
+      and cd_demo_sk = c.c_current_cdemo_sk
+      and exists (select * from store_sales, date_dim
+                  where c.c_customer_sk = ss_customer_sk
+                    and ss_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy between 4 and 6)
+      and not exists (select * from web_sales, date_dim
+                      where c.c_customer_sk = ws_bill_customer_sk
+                        and ws_sold_date_sk = d_date_sk
+                        and d_year = 2001 and d_moy between 4 and 6)
+    group by cd_gender, cd_marital_status, cd_education_status
+    order by cd_gender, cd_marital_status, cd_education_status
+    limit 100"""
+
+QUERIES["q71"] = """
+    select i_brand_id brand_id, i_brand brand, t_hour, t_minute,
+           sum(ext_price) ext_price
+    from item,
+         (select ws_ext_sales_price as ext_price,
+                 ws_sold_date_sk as sold_date_sk,
+                 ws_item_sk as sold_item_sk,
+                 ws_sold_time_sk as time_sk
+          from web_sales, date_dim
+          where d_date_sk = ws_sold_date_sk
+            and d_moy = 11 and d_year = 1999
+          union all
+          select ss_ext_sales_price as ext_price,
+                 ss_sold_date_sk as sold_date_sk,
+                 ss_item_sk as sold_item_sk,
+                 ss_sold_time_sk as time_sk
+          from store_sales, date_dim
+          where d_date_sk = ss_sold_date_sk
+            and d_moy = 11 and d_year = 1999) tmp, time_dim
+    where sold_item_sk = i_item_sk and i_manager_id = 1
+      and time_sk = t_time_sk
+      and (t_hour = 8 or t_hour = 9)
+    group by i_brand_id, i_brand, t_hour, t_minute
+    order by ext_price desc, brand_id
+    limit 100"""
+
+QUERIES["q73"] = """
+    select c_last_name, c_first_name, c_salutation,
+           c_preferred_cust_flag, ss_ticket_number, cnt
+    from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+          from store_sales, date_dim, store, household_demographics
+          where ss_sold_date_sk = d_date_sk
+            and ss_store_sk = s_store_sk
+            and ss_hdemo_sk = hd_demo_sk
+            and d_dom between 1 and 2
+            and (hd_buy_potential = '>10000'
+                 or hd_buy_potential = 'Unknown')
+            and hd_vehicle_count > 0
+            and d_year in (1999, 2000, 2001)
+            and s_county in ('Williamson County', 'Ziebach County')
+          group by ss_ticket_number, ss_customer_sk) dj, customer
+    where ss_customer_sk = c_customer_sk and cnt between 1 and 5
+    order by cnt desc, c_last_name asc, c_first_name, ss_ticket_number
+    limit 100"""
+
+QUERIES["q76"] = """
+    select channel, col_name, d_year, d_qoy, i_category,
+           count(*) sales_cnt, sum(ext_sales_price) sales_amt
+    from (
+      select 'store' as channel, 'ss_store_sk' col_name, d_year, d_qoy,
+             i_category, ss_ext_sales_price ext_sales_price
+      from store_sales, item, date_dim
+      where ss_store_sk is null and ss_sold_date_sk = d_date_sk
+        and ss_item_sk = i_item_sk
+      union all
+      select 'web' as channel, 'ws_ship_customer_sk' col_name, d_year,
+             d_qoy, i_category, ws_ext_sales_price ext_sales_price
+      from web_sales, item, date_dim
+      where ws_ship_customer_sk is null
+        and ws_sold_date_sk = d_date_sk and ws_item_sk = i_item_sk
+      union all
+      select 'catalog' as channel, 'cs_ship_mode_sk' col_name, d_year,
+             d_qoy, i_category, cs_ext_sales_price ext_sales_price
+      from catalog_sales, item, date_dim
+      where cs_ship_mode_sk is null
+        and cs_sold_date_sk = d_date_sk
+        and cs_item_sk = i_item_sk) foo
+    group by channel, col_name, d_year, d_qoy, i_category
+    order by channel, col_name, d_year, d_qoy, i_category
+    limit 100"""
+
+QUERIES["q79"] = """
+    select c_last_name, c_first_name,
+           substring(s_city, 1, 30) city, ss_ticket_number, amt, profit
+    from (select ss_ticket_number, ss_customer_sk, s_city,
+                 sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+          from store_sales, date_dim, store, household_demographics
+          where ss_sold_date_sk = d_date_sk
+            and ss_store_sk = s_store_sk
+            and ss_hdemo_sk = hd_demo_sk
+            and (hd_dep_count = 6 or hd_vehicle_count > 2)
+            and d_dow = 1
+            and d_year in (1999, 2000, 2001)
+            and s_number_employees between 200 and 295
+          group by ss_ticket_number, ss_customer_sk, ss_addr_sk,
+                   s_city) ms, customer
+    where ss_customer_sk = c_customer_sk
+    order by c_last_name, c_first_name, city, profit, ss_ticket_number
+    limit 100"""
+
+QUERIES["q82"] = """
+    select i_item_id, i_item_desc, i_current_price
+    from item, inventory, date_dim, store_sales
+    where i_current_price between 62 and 62 + 30
+      and inv_item_sk = i_item_sk
+      and d_date_sk = inv_date_sk
+      and d_year = 2000
+      and i_manufact_id in (129, 270, 821, 423)
+      and inv_quantity_on_hand between 100 and 500
+      and ss_item_sk = i_item_sk
+    group by i_item_id, i_item_desc, i_current_price
+    order by i_item_id
+    limit 100"""
+
+QUERIES["q84"] = """
+    select c_customer_id as customer_id,
+           c_last_name || ', ' || c_first_name as customername
+    from customer, customer_address, customer_demographics,
+         household_demographics, income_band, store_returns
+    where ca_city = 'Fairview'
+      and c_current_addr_sk = ca_address_sk
+      and ib_lower_bound >= 30000
+      and ib_upper_bound <= 30000 + 50000
+      and ib_income_band_sk = hd_income_band_sk
+      and cd_demo_sk = c_current_cdemo_sk
+      and hd_demo_sk = c_current_hdemo_sk
+      and sr_cdemo_sk = cd_demo_sk
+    order by c_customer_id
+    limit 100"""
+
+QUERIES["q86"] = """
+    select sum(ws_net_paid) as total_sum, i_category, i_class,
+           grouping(i_category) + grouping(i_class) as lochierarchy
+    from web_sales, date_dim d1, item
+    where d1.d_month_seq between 1200 and 1200 + 11
+      and d1.d_date_sk = ws_sold_date_sk
+      and i_item_sk = ws_item_sk
+    group by rollup(i_category, i_class)
+    order by lochierarchy desc, i_category, i_class
+    limit 100"""
+
+QUERIES["q87"] = """
+    select count(*) cnt from (
+      (select distinct c_last_name, c_first_name, d_date
+       from store_sales, date_dim, customer
+       where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+         and store_sales.ss_customer_sk = customer.c_customer_sk
+         and d_month_seq between 1200 and 1200 + 11)
+      except
+      (select distinct c_last_name, c_first_name, d_date
+       from catalog_sales, date_dim, customer
+       where catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+         and catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+         and d_month_seq between 1200 and 1200 + 11)
+      except
+      (select distinct c_last_name, c_first_name, d_date
+       from web_sales, date_dim, customer
+       where web_sales.ws_sold_date_sk = date_dim.d_date_sk
+         and web_sales.ws_bill_customer_sk = customer.c_customer_sk
+         and d_month_seq between 1200 and 1200 + 11)
+    ) cool_cust"""
+
+QUERIES["q88"] = """
+    select *
+    from (select count(*) h8_30_to_9
+          from store_sales, household_demographics, time_dim, store
+          where ss_sold_time_sk = t_time_sk
+            and ss_hdemo_sk = hd_demo_sk and ss_store_sk = s_store_sk
+            and t_hour = 8 and t_minute >= 30
+            and ((hd_dep_count = 4 and hd_vehicle_count <= 4 + 2)
+              or (hd_dep_count = 2 and hd_vehicle_count <= 2 + 2)
+              or (hd_dep_count = 0 and hd_vehicle_count <= 0 + 2))
+            and s_store_name = 'ese') s1,
+         (select count(*) h9_to_9_30
+          from store_sales, household_demographics, time_dim, store
+          where ss_sold_time_sk = t_time_sk
+            and ss_hdemo_sk = hd_demo_sk and ss_store_sk = s_store_sk
+            and t_hour = 9 and t_minute < 30
+            and ((hd_dep_count = 4 and hd_vehicle_count <= 4 + 2)
+              or (hd_dep_count = 2 and hd_vehicle_count <= 2 + 2)
+              or (hd_dep_count = 0 and hd_vehicle_count <= 0 + 2))
+            and s_store_name = 'ese') s2,
+         (select count(*) h9_30_to_10
+          from store_sales, household_demographics, time_dim, store
+          where ss_sold_time_sk = t_time_sk
+            and ss_hdemo_sk = hd_demo_sk and ss_store_sk = s_store_sk
+            and t_hour = 9 and t_minute >= 30
+            and ((hd_dep_count = 4 and hd_vehicle_count <= 4 + 2)
+              or (hd_dep_count = 2 and hd_vehicle_count <= 2 + 2)
+              or (hd_dep_count = 0 and hd_vehicle_count <= 0 + 2))
+            and s_store_name = 'ese') s3,
+         (select count(*) h10_to_10_30
+          from store_sales, household_demographics, time_dim, store
+          where ss_sold_time_sk = t_time_sk
+            and ss_hdemo_sk = hd_demo_sk and ss_store_sk = s_store_sk
+            and t_hour = 10 and t_minute < 30
+            and ((hd_dep_count = 4 and hd_vehicle_count <= 4 + 2)
+              or (hd_dep_count = 2 and hd_vehicle_count <= 2 + 2)
+              or (hd_dep_count = 0 and hd_vehicle_count <= 0 + 2))
+            and s_store_name = 'ese') s4"""
+
+QUERIES["q89"] = """
+    select i_category, i_class, i_brand, s_store_name, s_company_id,
+           d_moy, sum_sales, avg_monthly_sales
+    from (select i_category, i_class, i_brand, s_store_name,
+                 s_company_id, d_moy, sum(ss_sales_price) sum_sales,
+                 avg(sum(ss_sales_price)) over (partition by
+                   i_category, i_brand, s_store_name, s_company_id)
+                   avg_monthly_sales
+          from item, store_sales, date_dim, store
+          where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+            and ss_store_sk = s_store_sk and d_year = 1999
+            and ((i_category in ('Books', 'Music', 'Sports')
+                  and i_class in ('classical', 'fishing', 'football'))
+              or (i_category in ('Men', 'Women', 'Home')
+                  and i_class in ('pants', 'shirts', 'dresses')))
+          group by i_category, i_class, i_brand, s_store_name,
+                   s_company_id, d_moy) tmp1
+    where case when avg_monthly_sales <> 0
+               then abs(sum_sales - avg_monthly_sales) /
+                    avg_monthly_sales else null end > 0.1
+    order by sum_sales - avg_monthly_sales, s_store_name,
+             i_category, i_class, i_brand, d_moy
+    limit 100"""
+
+QUERIES["q90"] = """
+    select cast(amc as double) / cast(pmc as double) am_pm_ratio
+    from (select count(*) amc from web_sales, household_demographics,
+                 time_dim, web_page
+          where ws_sold_time_sk = t_time_sk
+            and ws_web_page_sk = wp_web_page_sk
+            and ws_ship_customer_sk is not null
+            and t_hour between 8 and 9
+            and household_demographics.hd_demo_sk =
+                web_sales.ws_web_page_sk % 7200
+            and hd_dep_count = 6
+            and wp_char_count between 5000 and 5200) at1,
+         (select count(*) pmc from web_sales, household_demographics,
+                 time_dim, web_page
+          where ws_sold_time_sk = t_time_sk
+            and ws_web_page_sk = wp_web_page_sk
+            and ws_ship_customer_sk is not null
+            and t_hour between 19 and 20
+            and household_demographics.hd_demo_sk =
+                web_sales.ws_web_page_sk % 7200
+            and hd_dep_count = 6
+            and wp_char_count between 5000 and 5200) pt
+    order by am_pm_ratio
+    limit 100"""
+
+QUERIES["q91"] = """
+    select cc_call_center_sk, cc_name, cc_manager,
+           sum(cr_net_loss) returns_loss
+    from call_center, catalog_returns, date_dim, customer,
+         customer_address, customer_demographics,
+         household_demographics
+    where cr_call_center_sk = cc_call_center_sk
+      and cr_returned_date_sk = d_date_sk
+      and cr_returning_customer_sk = c_customer_sk
+      and cd_demo_sk = c_current_cdemo_sk
+      and hd_demo_sk = c_current_hdemo_sk
+      and ca_address_sk = c_current_addr_sk
+      and d_year = 1998 and d_moy = 11
+      and ((cd_marital_status = 'M'
+            and cd_education_status = 'Unknown')
+        or (cd_marital_status = 'W'
+            and cd_education_status = 'Advanced Degree'))
+      and hd_buy_potential like '>10000%'
+      and ca_gmt_offset = -7
+    group by cc_call_center_sk, cc_name, cc_manager
+    order by returns_loss desc
+    limit 100"""
+
+QUERIES["q93"] = """
+    select ss_customer_sk, sum(act_sales) sumsales
+    from (select ss_item_sk, ss_ticket_number, ss_customer_sk,
+                 case when sr_return_quantity is not null
+                      then (ss_quantity - sr_return_quantity) *
+                           ss_sales_price
+                      else ss_quantity * ss_sales_price end act_sales
+          from store_sales
+            left outer join store_returns
+              on (sr_item_sk = ss_item_sk
+                  and sr_ticket_number = ss_ticket_number),
+            reason
+          where sr_reason_sk = r_reason_sk
+            and r_reason_desc = 'reason 28') t
+    group by ss_customer_sk
+    order by sumsales, ss_customer_sk
+    limit 100"""
+
+QUERIES["q96"] = """
+    select count(*) cnt
+    from store_sales, household_demographics, time_dim, store
+    where ss_sold_time_sk = t_time_sk
+      and ss_hdemo_sk = hd_demo_sk and ss_store_sk = s_store_sk
+      and t_hour = 20 and t_minute >= 30 and hd_dep_count = 7
+      and s_store_name = 'ese'
+    order by cnt
+    limit 100"""
+
+QUERIES["q97"] = """
+    with ssci as (
+      select ss_customer_sk customer_sk, ss_item_sk item_sk
+      from store_sales, date_dim
+      where ss_sold_date_sk = d_date_sk
+        and d_month_seq between 1200 and 1200 + 11
+      group by ss_customer_sk, ss_item_sk),
+    csci as (
+      select cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+      from catalog_sales, date_dim
+      where cs_sold_date_sk = d_date_sk
+        and d_month_seq between 1200 and 1200 + 11
+      group by cs_bill_customer_sk, cs_item_sk)
+    select sum(case when ssci.customer_sk is not null
+                     and csci.customer_sk is null
+               then 1 else 0 end) store_only,
+           sum(case when ssci.customer_sk is null
+                     and csci.customer_sk is not null
+               then 1 else 0 end) catalog_only,
+           sum(case when ssci.customer_sk is not null
+                     and csci.customer_sk is not null
+               then 1 else 0 end) store_and_catalog
+    from ssci full outer join csci
+      on (ssci.customer_sk = csci.customer_sk
+          and ssci.item_sk = csci.item_sk)
+    limit 100"""
+
+QUERIES["q98"] = """
+    select i_item_id, i_item_desc, i_category, i_class,
+           i_current_price,
+           sum(ss_ext_sales_price) as itemrevenue,
+           sum(ss_ext_sales_price) * 100.0 /
+             sum(sum(ss_ext_sales_price))
+               over (partition by i_class) as revenueratio
+    from store_sales, item, date_dim
+    where ss_item_sk = i_item_sk
+      and i_category in ('Sports', 'Books', 'Home')
+      and ss_sold_date_sk = d_date_sk
+      and d_year = 1999 and d_moy between 2 and 3
+    group by i_item_id, i_item_desc, i_category, i_class,
+             i_current_price
+    order by i_category, i_class, i_item_id, i_item_desc,
+             revenueratio
+    limit 100"""
+
+QUERIES["q99"] = """
+    select w_warehouse_name, sm_type, cc_name,
+           sum(case when (cs_ship_date_sk - cs_sold_date_sk <= 30)
+               then 1 else 0 end) as d30,
+           sum(case when (cs_ship_date_sk - cs_sold_date_sk > 30)
+                     and (cs_ship_date_sk - cs_sold_date_sk <= 60)
+               then 1 else 0 end) as d31_60,
+           sum(case when (cs_ship_date_sk - cs_sold_date_sk > 60)
+                     and (cs_ship_date_sk - cs_sold_date_sk <= 90)
+               then 1 else 0 end) as d61_90,
+           sum(case when (cs_ship_date_sk - cs_sold_date_sk > 90)
+               then 1 else 0 end) as d90_plus
+    from catalog_sales, warehouse, ship_mode, call_center, date_dim
+    where d_month_seq between 1200 and 1200 + 11
+      and cs_ship_date_sk = d_date_sk
+      and cs_warehouse_sk = w_warehouse_sk
+      and cs_ship_mode_sk = sm_ship_mode_sk
+      and cs_call_center_sk = cc_call_center_sk
+    group by w_warehouse_name, sm_type, cc_name
+    order by w_warehouse_name, sm_type, cc_name
+    limit 100"""
