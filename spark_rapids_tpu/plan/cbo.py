@@ -63,3 +63,73 @@ def tpu_worthwhile(p: L.LogicalPlan) -> bool:
     tpu_cost = rows * CPU_COST_PER_ROW / speedup + \
         rows * 0.0 + 2 * TRANSITION_COST_PER_ROW * min(rows, 1024) + 500
     return tpu_cost < cpu_cost
+
+
+# ---------------------------------------------------------------------------
+# transition-aware subtree placement (CostBasedOptimizer.scala:52,246)
+# ---------------------------------------------------------------------------
+
+#: fixed cost per host<->device boundary crossing (dispatch + copy setup)
+BOUNDARY_COST = 500.0
+#: unknown-cardinality default (assume big; matches reference default-on)
+DEFAULT_ROWS = 1 << 20
+
+
+def _node_costs(p: L.LogicalPlan):
+    """(cpu_cost, tpu_cost) of running THIS node on each engine."""
+    rows = estimate_rows(p)
+    if rows is None:
+        rows = float(DEFAULT_ROWS)
+    speedup = TPU_SPEEDUP.get(type(p), 4.0)
+    cpu = rows * CPU_COST_PER_ROW
+    tpu = rows * CPU_COST_PER_ROW / speedup
+    return cpu, tpu
+
+
+def _transition(rows, same_side: bool) -> float:
+    if same_side:
+        return 0.0
+    return BOUNDARY_COST + TRANSITION_COST_PER_ROW * min(
+        rows if rows is not None else DEFAULT_ROWS, 1 << 16)
+
+
+def choose_placement(root: L.LogicalPlan) -> Dict[int, str]:
+    """Two-state DP over the plan tree (the reference's
+    ``optimizeGpuPlanTransitions`` recursion, CostBasedOptimizer:246):
+    ``best(node, parent_side)`` = cheapest cost of the subtree when the
+    parent consumes its output on ``parent_side``, charging a
+    host<->device transition whenever node and parent sides differ.
+    Returns {id(node): 'cpu'|'tpu'} — the planner forces 'cpu' nodes to
+    the CPU engine even when a TPU conversion exists, exactly like the
+    reference forcing cheap sections back to the CPU plan."""
+    memo: Dict[tuple, tuple] = {}
+
+    def best(p: L.LogicalPlan, parent_side: str):
+        key = (id(p), parent_side)
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
+        rows = estimate_rows(p)
+        cpu_c, tpu_c = _node_costs(p)
+        totals = {}
+        for side, own in (("cpu", cpu_c), ("tpu", tpu_c)):
+            t = own + _transition(rows, side == parent_side)
+            for c in p.children:
+                t += best(c, side)[0]
+            totals[side] = t
+        side = "cpu" if totals["cpu"] <= totals["tpu"] else "tpu"
+        out = (totals[side], side)
+        memo[key] = out
+        return out
+
+    placement: Dict[int, str] = {}
+
+    def assign(p: L.LogicalPlan, parent_side: str):
+        _, side = best(p, parent_side)
+        placement[id(p)] = side
+        for c in p.children:
+            assign(c, side)
+
+    # the root hands rows to the session collector (host side)
+    assign(root, "cpu")
+    return placement

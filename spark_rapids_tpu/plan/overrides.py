@@ -69,11 +69,15 @@ def _scan_row_estimate(p) -> "Optional[int]":
 # expression rules (the expr[...] registry, GpuOverrides.scala:773)
 # ---------------------------------------------------------------------------
 
-_EXPR_RULES: Dict[Type[ec.Expression], TS.TypeSig] = {}
+_EXPR_RULES: Dict[Type[ec.Expression], "TS.ExprSig"] = {}
 
 
-def expr_rule(cls, sig: TS.TypeSig):
-    _EXPR_RULES[cls] = sig
+def expr_rule(cls, sig):
+    """Register an expression rule: a plain TypeSig (uniform across
+    params, back-compat) or a per-parameter ExprSig
+    (TypeChecks.scala:879 ExprChecks role)."""
+    _EXPR_RULES[cls] = sig if isinstance(sig, TS.ExprSig) else \
+        TS.ExprSig.uniform(sig)
 
 
 for _cls in [ec.AttributeReference, ec.BoundReference, ec.Literal, ec.Alias]:
@@ -117,15 +121,64 @@ for _cls in [edt.Year, edt.Month, edt.DayOfMonth, edt.Quarter, edt.DayOfWeek,
 for _cls in [emisc.Murmur3Hash, emisc.Md5, emisc.MonotonicallyIncreasingID,
              emisc.SparkPartitionID, emisc.Rand]:
     expr_rule(_cls, TS.ALL_SUPPORTED)
+
+# -- refined per-parameter contracts (ExprChecks role, the rules above
+# keep the legacy output-only check; these override with full param
+# signatures like TypeChecks.scala:879 declares per GPU expression) ----
+_P = TS.ParamSig
+expr_rule(es.Substring, TS.ExprSig(
+    [_P("str", TS.STRING_SIG), _P("pos", TS.INTEGRAL),
+     _P("len", TS.INTEGRAL)], TS.STRING_SIG))
+expr_rule(es.StringLocate, TS.ExprSig(
+    [_P("substr", TS.STRING_SIG), _P("str", TS.STRING_SIG),
+     _P("start", TS.INTEGRAL)], TS.INTEGRAL))
+expr_rule(es.Lpad, TS.ExprSig(
+    [_P("str", TS.STRING_SIG), _P("len", TS.INTEGRAL),
+     _P("pad", TS.STRING_SIG)], TS.STRING_SIG,
+    note="pad runs on the host string path"))
+expr_rule(es.Rpad, TS.ExprSig(
+    [_P("str", TS.STRING_SIG), _P("len", TS.INTEGRAL),
+     _P("pad", TS.STRING_SIG)], TS.STRING_SIG,
+    note="pad runs on the host string path"))
+expr_rule(es.StringRepeat, TS.ExprSig(
+    [_P("str", TS.STRING_SIG), _P("n", TS.INTEGRAL)], TS.STRING_SIG))
+expr_rule(es.RegexpExtract, TS.ExprSig(
+    [_P("str", TS.STRING_SIG), _P("regexp", TS.STRING_SIG),
+     _P("idx", TS.INTEGRAL)], TS.STRING_SIG,
+    note="pattern must be a literal; host regex engine"))
+expr_rule(es.RegexpReplace, TS.ExprSig(
+    [_P("str", TS.STRING_SIG), _P("regexp", TS.STRING_SIG),
+     _P("rep", TS.STRING_SIG)], TS.STRING_SIG,
+    note="pattern must be a literal; host regex engine"))
+expr_rule(edt.DateAdd, TS.ExprSig(
+    [_P("start", TS.DATETIME), _P("days", TS.INTEGRAL)], TS.DATETIME))
+expr_rule(edt.DateSub, TS.ExprSig(
+    [_P("start", TS.DATETIME), _P("days", TS.INTEGRAL)], TS.DATETIME))
+expr_rule(edt.DateDiff, TS.ExprSig(
+    [_P("end", TS.DATETIME), _P("start", TS.DATETIME)], TS.INTEGRAL))
+expr_rule(ep.And, TS.ExprSig(
+    [_P("lhs", TS.BOOLEAN), _P("rhs", TS.BOOLEAN)], TS.BOOLEAN))
+expr_rule(ep.Or, TS.ExprSig(
+    [_P("lhs", TS.BOOLEAN), _P("rhs", TS.BOOLEAN)], TS.BOOLEAN))
+expr_rule(ep.Not, TS.ExprSig([_P("input", TS.BOOLEAN)], TS.BOOLEAN))
+expr_rule(econd.If, TS.ExprSig(
+    [_P("predicate", TS.BOOLEAN), _P("trueValue", TS.ALL_SUPPORTED),
+     _P("falseValue", TS.ALL_SUPPORTED)], TS.ALL_SUPPORTED))
 for _cls in [eagg.Sum, eagg.Count, eagg.Min, eagg.Max, eagg.Average,
-             eagg.First, eagg.Last]:
+             eagg.First, eagg.Last, eagg.StddevSamp, eagg.StddevPop,
+             eagg.VarianceSamp, eagg.VariancePop, eagg.PivotFirst]:
     expr_rule(_cls, TS.ALL_SUPPORTED)
 # collection expressions (collectionOperations.scala registrations,
 # GpuOverrides.scala:773+)
 from ..expr import collections as ecoll  # noqa: E402
-for _cls in [ecoll.CreateArray, ecoll.GetArrayItem, ecoll.ElementAt,
-             ecoll.SortArray, ecoll.Explode]:
+for _cls in [ecoll.CreateArray, ecoll.SortArray, ecoll.Explode]:
     expr_rule(_cls, TS.WITH_ARRAYS)
+expr_rule(ecoll.GetArrayItem, TS.ExprSig(
+    [_P("array", TS.WITH_ARRAYS), _P("ordinal", TS.INTEGRAL)],
+    TS.WITH_ARRAYS + TS.ALL_SUPPORTED))
+expr_rule(ecoll.ElementAt, TS.ExprSig(
+    [_P("array", TS.WITH_ARRAYS), _P("index", TS.INTEGRAL)],
+    TS.WITH_ARRAYS + TS.ALL_SUPPORTED))
 expr_rule(ecoll.Size, TS.WITH_ARRAYS + TS.INTEGRAL)
 # struct/map expressions (complexTypeCreator/Extractors.scala)
 for _cls in [ecoll.CreateNamedStruct, ecoll.GetStructField,
@@ -172,13 +225,7 @@ class ExprMeta:
             self.reasons.append(
                 f"expression {cls.__name__} has no TPU implementation")
         else:
-            try:
-                dt = self.expr.dtype()
-                r = rule.reason(dt, cls.__name__)
-                if r:
-                    self.reasons.append(r)
-            except (ValueError, NotImplementedError) as e:
-                self.reasons.append(f"{cls.__name__}: {e}")
+            self.reasons.extend(rule.reasons_for(self.expr))
         if isinstance(self.expr, self._KEY_ENCODING):
             for c in self.expr.children:
                 try:
@@ -191,6 +238,11 @@ class ExprMeta:
                         f"key-encoded on TPU")
         if isinstance(self.expr, ecast.Cast):
             src = self.expr.children[0].dtype()
+            # cast-pair matrix (CastChecks role, TypeChecks.scala:367):
+            # pairs absent from the matrix tag the node to the CPU
+            r = TS.cast_reason(src, self.expr.to)
+            if r:
+                self.reasons.append(r)
             if (src == T.STRING and self.expr.to.is_fractional and
                     not self.conf.get(CAST_STRING_TO_FLOAT)):
                 self.reasons.append(
@@ -382,10 +434,16 @@ class Planner:
         self.default_partitions = conf.get(SHUFFLE_PARTITIONS)
         self.batch_rows = conf.get(BATCH_SIZE_ROWS)
         self.fallbacks: List[str] = []
+        self._placement = None
 
     def plan(self, logical: L.LogicalPlan) -> PhysicalPlan:
         meta = PlanMeta(logical, self.conf)
         meta.tag()
+        from ..config import CBO_ENABLED
+        self._placement = None
+        if self.conf.get(CBO_ENABLED):
+            from .cbo import choose_placement
+            self._placement = choose_placement(logical)
         mode = self.conf.get(EXPLAIN).upper()
         if mode in ("NOT_ON_TPU", "ALL"):
             text = meta.explain(all_nodes=(mode == "ALL"))
@@ -482,13 +540,12 @@ class Planner:
             self.fallbacks.append(
                 f"{p.name}: {'; '.join(meta.reasons[:3])}")
             return self._convert_cpu(meta)
-        from ..config import CBO_ENABLED
-        if self.conf.get(CBO_ENABLED):
-            from .cbo import tpu_worthwhile
-            if not tpu_worthwhile(p):
-                self.fallbacks.append(
-                    f"{p.name}: cost model kept it on CPU")
-                return self._convert_cpu(meta)
+        if self._placement is not None and \
+                self._placement.get(id(p)) == "cpu":
+            self.fallbacks.append(
+                f"{p.name}: cost model placed this subtree on CPU "
+                f"(transition-aware placement)")
+            return self._convert_cpu(meta)
         children = [self._convert(c) for c in meta.children]
         return self._convert_tpu(meta, p, children)
 

@@ -77,3 +77,150 @@ WITH_NESTED = WITH_ARRAYS + STRUCT_SIG + MAP_SIG
 # orderable == groupable == joinable (canonical key words cover scalars
 # only; nested types cannot be sort/join keys yet)
 ORDERABLE = ALL_SUPPORTED
+
+
+# ---------------------------------------------------------------------------
+# per-parameter signatures (ExprChecks role, TypeChecks.scala:879)
+# ---------------------------------------------------------------------------
+
+class ParamSig:
+    """One named parameter's accepted types (+ partial-support note)."""
+
+    def __init__(self, name: str, sig: TypeSig, note: str = ""):
+        self.name = name
+        self.sig = sig
+        self.note = note
+
+
+class ExprSig:
+    """Per-parameter + output type contract for one expression class.
+
+    Reference: ExprChecks (TypeChecks.scala:879) — each GPU expression
+    declares what each input parameter accepts and what it produces;
+    tagging walks ACTUAL child dtypes against the matching parameter
+    instead of only checking the output type.  ``repeat_last`` covers
+    variadic tails (Coalesce, Least, CreateArray...).
+    """
+
+    def __init__(self, params: list, output: TypeSig,
+                 repeat_last: bool = False, note: str = "",
+                 check_params: bool = True):
+        self.params = list(params)
+        self.output = output
+        self.repeat_last = repeat_last
+        self.note = note
+        self.check_params = check_params
+
+    @classmethod
+    def uniform(cls, sig: TypeSig) -> "ExprSig":
+        """Back-compat wrapper: output-type check only (legacy rules
+        never constrained parameters; per-param contracts register an
+        explicit ExprSig instead)."""
+        return cls([ParamSig("input", sig)], sig, repeat_last=True,
+                   check_params=False)
+
+    def _param_for(self, i: int) -> Optional[ParamSig]:
+        if i < len(self.params):
+            return self.params[i]
+        if self.repeat_last and self.params:
+            return self.params[-1]
+        return None
+
+    def describe(self) -> str:
+        if not self.check_params:
+            return self.output.describe()
+        parts = [f"{p.name}: {p.sig.describe()}" for p in self.params]
+        return "; ".join(parts) + f" -> {self.output.describe()}"
+
+    def reasons_for(self, expr) -> list:
+        out = []
+        cls_name = type(expr).__name__
+        try:
+            dt = expr.dtype()
+        except (ValueError, NotImplementedError) as e:
+            return [f"{cls_name}: {e}"]
+        r = self.output.reason(dt, f"{cls_name} output")
+        if r:
+            out.append(r)
+        if not self.check_params:
+            return out
+        for i, c in enumerate(expr.children):
+            p = self._param_for(i)
+            if p is None:
+                out.append(f"{cls_name}: unexpected argument {i}")
+                continue
+            try:
+                cdt = c.dtype()
+            except (ValueError, NotImplementedError):
+                continue
+            if not p.sig.supports(cdt):
+                note = f" ({p.note})" if p.note else ""
+                out.append(f"{cls_name} parameter '{p.name}': type "
+                           f"{cdt.name} is not supported on TPU{note}")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# cast-pair support matrix (CastChecks role, TypeChecks.scala:367)
+# ---------------------------------------------------------------------------
+
+def _family(dt: T.DType) -> str:
+    if isinstance(dt, T.DecimalType):
+        return "decimal"
+    if isinstance(dt, (T.ArrayType, T.StructType, T.MapType)):
+        return "nested"
+    if dt == T.BOOL:
+        return "bool"
+    if dt.is_integral:
+        return "integral"
+    if dt.is_fractional:
+        return "fp"
+    if dt == T.STRING:
+        return "string"
+    if dt == T.DATE:
+        return "date"
+    if dt == T.TIMESTAMP:
+        return "timestamp"
+    if dt == T.NULL:
+        return "null"
+    return "other"
+
+
+#: (from_family, to_family) -> None (supported) | reason note.
+#: Mirrors the reference's sparse cast matrix: everything listed as a
+#: key is a cast the engine has an implementation for; absent pairs tag
+#: the plan node to the CPU engine.
+CAST_MATRIX = {}
+
+
+def _allow(src: str, dsts: str, note: str = ""):
+    for d in dsts.split():
+        CAST_MATRIX[(src, d)] = note or None
+
+
+_allow("bool", "bool integral fp string")
+_allow("integral", "bool integral fp decimal string timestamp")
+_allow("fp", "bool integral fp decimal string",
+       "fp->string formats with Spark's toString rules")
+_allow("decimal", "integral fp decimal string")
+_allow("string", "bool integral fp decimal date timestamp string",
+       "string->fp/date/timestamp follow Spark parsing; malformed "
+       "values become NULL")
+_allow("date", "date timestamp string integral")
+_allow("timestamp", "date timestamp string integral fp")
+_allow("null", "bool integral fp decimal string date timestamp null "
+               "nested")
+
+
+def cast_reason(src: T.DType, dst: T.DType) -> Optional[str]:
+    """None when CAST(src AS dst) runs on the TPU; else the reason."""
+    key = (_family(src), _family(dst))
+    if key[0] == key[1] and key[0] == "nested":
+        return "nested-to-nested casts are not supported on TPU"
+    if key in CAST_MATRIX:
+        return None
+    return (f"Cast {src.name} -> {dst.name} is not supported on TPU")
+
+
+def cast_note(src: T.DType, dst: T.DType) -> Optional[str]:
+    return CAST_MATRIX.get((_family(src), _family(dst)))

@@ -19,6 +19,9 @@ from ..config import generate_docs
 from ..plan import overrides as ov
 from ..plan import typesig as TS
 
+TS_CAST_FAMILIES = ["bool", "integral", "fp", "decimal", "string",
+                    "date", "timestamp", "null"]
+
 
 def supported_ops_doc() -> str:
     lines = [
@@ -28,12 +31,30 @@ def supported_ops_doc() -> str:
         "(plan/overrides.py), the analogue of the reference's "
         "supported_ops.md generated from TypeChecks.scala.",
         "",
-        "| Expression | Supported input types |",
-        "|---|---|",
+        "| Expression | Signature (per-parameter where declared) | "
+        "Notes |",
+        "|---|---|---|",
     ]
     for cls, sig in sorted(ov._EXPR_RULES.items(),
                            key=lambda kv: kv[0].__name__):
-        lines.append(f"| `{cls.__name__}` | {sig.describe()} |")
+        note = getattr(sig, "note", "") or ""
+        lines.append(f"| `{cls.__name__}` | {sig.describe()} | {note} |")
+    lines += [
+        "",
+        "# Cast support matrix",
+        "",
+        "CAST pairs the TPU engine implements (absent pairs fall back "
+        "to the CPU engine; TypeChecks.scala:367 CastChecks role):",
+        "",
+        "| from \\\\ to | " + " | ".join(TS_CAST_FAMILIES) + " |",
+        "|---|" + "---|" * len(TS_CAST_FAMILIES),
+    ]
+    for src in TS_CAST_FAMILIES:
+        row = [f"| {src} "]
+        for dst in TS_CAST_FAMILIES:
+            ok = (src, dst) in TS.CAST_MATRIX or src == dst
+            row.append("| S " if ok else "|   ")
+        lines.append("".join(row) + "|")
     lines += [
         "",
         "# Supported operators on TPU",

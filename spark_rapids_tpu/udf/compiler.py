@@ -47,6 +47,21 @@ _GLOBAL_FUNCS = {
     "len": lambda a: es.Length(a),
 }
 
+#: bounded loop unrolling: literal-range for-loops expand into
+#: straight-line code (the reference compiles loops via CFG + state
+#: fold, CFG.scala:44; expressions have no iteration, so the TPU
+#: equivalent is unrolling with a hard cap)
+_MAX_UNROLL = 128
+
+
+class _RangeIter:
+    """Symbolic iterator over a literal range() (mutable cursor so the
+    JUMP_BACKWARD -> FOR_ITER cycle advances it)."""
+
+    def __init__(self, values: List[int]):
+        self.values = values
+        self.pos = 0
+
 _MATH_FUNCS = {
     "sqrt": ea.Sqrt, "exp": ea.Exp, "log": ea.Log, "log2": ea.Log2,
     "log10": ea.Log10, "sin": ea.Sin, "cos": ea.Cos, "tan": ea.Tan,
@@ -60,6 +75,12 @@ _STR_METHODS = {
 }
 
 
+#: hard budget on total symbolically-executed instructions: branch
+#: recursion inside an unrolled loop is exponential in the iteration
+#: count, so the unroll cap alone cannot bound compile time
+_MAX_COMPILE_STEPS = 200_000
+
+
 class _Block:
     """Basic-block symbolic executor (reference: CFG.scala basic blocks)."""
 
@@ -67,6 +88,7 @@ class _Block:
                  offset_index: Dict[int, int]):
         self.ins = instructions
         self.offset_index = offset_index
+        self.steps = 0
 
     def run(self, start: int, stack: List[Any],
             local_vars: Dict[str, Any]) -> ec.Expression:
@@ -79,6 +101,10 @@ class _Block:
         stack = list(stack)
         local_vars = dict(local_vars)
         while i < len(self.ins):
+            self.steps += 1
+            if self.steps > _MAX_COMPILE_STEPS:
+                raise CannotCompile(
+                    "compile budget exceeded (branchy loop blow-up)")
             ins = self.ins[i]
             op = ins.opname
             if op in ("RESUME", "PRECALL", "CACHE", "PUSH_NULL", "NOP",
@@ -103,6 +129,8 @@ class _Block:
                     stack.append(("global_fn", name))
                 elif name == "math":
                     stack.append(("module", "math"))
+                elif name == "range":
+                    stack.append(("range_fn",))
                 else:
                     raise CannotCompile(f"global {name}")
             elif op in ("LOAD_ATTR", "LOAD_METHOD"):
@@ -154,17 +182,69 @@ class _Block:
                     stack.append(_MATH_FUNCS[fn[1]](_as_expr(args[0])))
                 elif isinstance(fn, tuple) and fn[0] == "str_method":
                     stack.append(_STR_METHODS[fn[1]](_as_expr(fn[2])))
+                elif isinstance(fn, tuple) and fn[0] == "range_fn":
+                    bounds = []
+                    for a in args:
+                        if isinstance(a, ec.Literal) and \
+                                isinstance(a.value, int):
+                            bounds.append(a.value)
+                        else:
+                            raise CannotCompile(
+                                "range() bounds must be int literals")
+                    vals = list(range(*bounds))
+                    if len(vals) > _MAX_UNROLL:
+                        raise CannotCompile(
+                            f"loop of {len(vals)} > {_MAX_UNROLL} "
+                            f"iterations (unroll cap)")
+                    stack.append(("range_vals", vals))
                 else:
                     raise CannotCompile(f"call of {fn!r}")
+            elif op == "GET_ITER":
+                src = stack.pop()
+                if isinstance(src, tuple) and src[0] == "range_vals":
+                    stack.append(_RangeIter(src[1]))
+                else:
+                    raise CannotCompile("iteration over non-range value")
+            elif op == "FOR_ITER":
+                it = stack[-1]
+                if not isinstance(it, _RangeIter):
+                    raise CannotCompile("FOR_ITER over non-range iterator")
+                if it.pos < len(it.values):
+                    stack.append(ec.Literal(it.values[it.pos]))
+                    it.pos += 1
+                else:
+                    # exhausted: jump to the loop's END_FOR target; the
+                    # iterator stays on the stack for END_FOR to pop
+                    # (3.12+); on 3.11 the jump target follows the pop
+                    stack.append(None)   # placeholder END_FOR will pop
+                    i = self.offset_index[ins.argval]
+                    continue
+            elif op == "END_FOR":
+                # pops the placeholder/iterator pair left by FOR_ITER
+                stack.pop()
+                if stack and isinstance(stack[-1], _RangeIter):
+                    stack.pop()
             elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_FORWARD_IF_FALSE",
                         "POP_JUMP_IF_TRUE", "POP_JUMP_FORWARD_IF_TRUE"):
                 cond = _as_expr(stack.pop())
                 if "TRUE" in op:
                     cond = ep.Not(cond)
                 target = self.offset_index[ins.argval]
-                # true path: fall through; false path: jump target
-                true_val = self.run(i + 1, stack, local_vars)
-                false_val = self.run(target, stack, local_vars)
+                # true path: fall through; false path: jump target.
+                # Fork mutable loop iterators so both arms advance
+                # their own copy (State.scala fold analogue).
+                def _fork(st):
+                    out = []
+                    for v in st:
+                        if isinstance(v, _RangeIter):
+                            c = _RangeIter(v.values)
+                            c.pos = v.pos
+                            out.append(c)
+                        else:
+                            out.append(v)
+                    return out
+                true_val = self.run(i + 1, _fork(stack), local_vars)
+                false_val = self.run(target, _fork(stack), local_vars)
                 return econd.If(cond, true_val, false_val)
             elif op in ("JUMP_FORWARD", "JUMP_BACKWARD"):
                 i = self.offset_index[ins.argval]

@@ -110,3 +110,100 @@ def test_profile_trace_dir(tmp_path):
     for root, _, files in os.walk(d):
         found.extend(files)
     assert found, "no trace files captured"
+
+
+# ---------------------------------------------------------------------------
+# per-parameter TypeSig + cast matrix (TypeChecks.scala:367,879 roles)
+# ---------------------------------------------------------------------------
+
+class TestTypeSigDepth:
+    def test_per_param_mismatch_tags_fallback(self):
+        from spark_rapids_tpu.plan import typesig as TS
+        from spark_rapids_tpu.expr import string_ops as es
+        from spark_rapids_tpu.expr import core as ec
+        from spark_rapids_tpu.columnar import dtypes as T
+        sig = TS.ExprSig(
+            [TS.ParamSig("str", TS.STRING_SIG),
+             TS.ParamSig("pos", TS.INTEGRAL)], TS.STRING_SIG)
+        ok = es.Substring(ec.Literal("abc"), ec.Literal(1), ec.Literal(2))
+        # reuse the 'pos' param for the variadic tail
+        sig.repeat_last = True
+        assert sig.reasons_for(ok) == []
+        bad = es.Substring(ec.Literal("abc"), ec.Literal("x"),
+                           ec.Literal(2))
+        reasons = sig.reasons_for(bad)
+        assert any("parameter 'pos'" in r for r in reasons)
+
+    def test_cast_matrix(self):
+        from spark_rapids_tpu.plan import typesig as TS
+        from spark_rapids_tpu.columnar import dtypes as T
+        assert TS.cast_reason(T.INT64, T.FLOAT64) is None
+        assert TS.cast_reason(T.STRING, T.DATE) is None
+        assert TS.cast_reason(T.DATE, T.BOOL) is not None
+        nested = T.ArrayType(T.INT64)
+        assert TS.cast_reason(nested, nested) is not None
+
+    def test_unsupported_cast_plans_cpu_fallback(self):
+        from spark_rapids_tpu.api import TpuSession, functions as F
+        from spark_rapids_tpu.config import TpuConf
+        import datetime
+        s = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": True}))
+        df = s.create_dataframe({
+            "d": [datetime.date(2020, 1, 1), datetime.date(2021, 2, 2)]})
+        out = df.select(F.col("d").cast("boolean").alias("b"))
+        text = s.explain(out._plan)
+        assert "Cpu" in text
+        assert "not supported on TPU" in text
+
+
+class TestCboPlacement:
+    """Transition-aware subtree placement (CostBasedOptimizer.scala:246)."""
+
+    def test_tiny_plan_stays_on_cpu(self):
+        import numpy as np
+        from spark_rapids_tpu.api import TpuSession, functions as F
+        from spark_rapids_tpu.config import TpuConf
+        s = TpuSession(TpuConf({
+            "spark.rapids.tpu.sql.enabled": True,
+            "spark.rapids.tpu.sql.optimizer.enabled": True}))
+        df = s.create_dataframe({"x": np.arange(4, dtype=np.int64)})
+        out = df.filter(F.col("x") > 1)
+        text = s.explain(out._plan)
+        assert "cost model placed this subtree on CPU" in text or \
+            "Cpu" in text
+        assert out.collect()          # still correct
+
+    def test_large_plan_stays_on_tpu(self):
+        from spark_rapids_tpu.plan import cbo
+        from spark_rapids_tpu.plan import logical as L
+        import pyarrow as pa
+        import numpy as np
+        big = pa.table({"x": np.arange(200_000, dtype=np.int64)})
+        rel = L.LocalRelation(big, 1)
+        from spark_rapids_tpu.expr import core as ec
+        from spark_rapids_tpu.expr import predicates as ep
+        f = L.Filter(ep.GreaterThan(ec.AttributeReference("x"),
+                                    ec.Literal(5)), rel)
+        placement = cbo.choose_placement(f)
+        assert placement[id(f)] == "tpu"
+
+    def test_placement_is_transition_aware(self):
+        """A cheap node sandwiched between expensive TPU nodes stays on
+        TPU (two extra transitions would cost more than its speedup)."""
+        from spark_rapids_tpu.plan import cbo
+        from spark_rapids_tpu.plan import logical as L
+        from spark_rapids_tpu.expr import core as ec
+        from spark_rapids_tpu.expr import predicates as ep
+        import pyarrow as pa
+        import numpy as np
+        big = pa.table({"x": np.arange(500_000, dtype=np.int64)})
+        rel = L.LocalRelation(big, 1)
+        inner = L.Filter(ep.GreaterThan(ec.AttributeReference("x"),
+                                        ec.Literal(5)), rel)
+        proj = L.Project([ec.AttributeReference("x")], inner)
+        outer = L.Filter(ep.GreaterThan(ec.AttributeReference("x"),
+                                        ec.Literal(7)), proj)
+        placement = cbo.choose_placement(outer)
+        # the middle projection must NOT flip engines on its own
+        sides = {placement[id(n)] for n in (outer, proj, inner)}
+        assert sides == {"tpu"}

@@ -39,13 +39,15 @@ class TestCompiler:
         e = self._compiled(lambda x: math.sqrt(abs(x)))
         assert e is not None
 
-    def test_fallback_on_loop(self):
+    def test_literal_range_loop_now_compiles(self):
+        # round 3: literal-range loops unroll (CFG.scala loop role) —
+        # this shape used to be a fallback
         def f(x):
             total = 0
             for i in range(3):
                 total += x
             return total
-        assert self._compiled(f) is None
+        assert self._compiled(f) is not None
 
     def test_fallback_on_closure(self):
         y = 5
@@ -189,3 +191,74 @@ class TestNativeTpuUDF:
         # two partitions -> two eager invocations with distinct state;
         # under (wrong) fusion both batches would see the same constant
         assert calls["n"] >= 2
+
+
+class TestUdfLoopCompilation:
+    """Bounded loop unrolling (the CFG.scala:44 loop-compilation role:
+    literal-range for-loops become straight-line expressions)."""
+
+    def _batch(self):
+        import numpy as np
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        return ColumnarBatch.from_pydict(
+            {"x": np.array([2.0, 0.5, 3.0, -1.0])})
+
+    def _check(self, fn):
+        import numpy as np
+        from spark_rapids_tpu.udf.compiler import compile_udf
+        from spark_rapids_tpu.expr import core as ec
+        e = compile_udf(fn, [ec.AttributeReference("x")])
+        assert e is not None, "expected the loop to compile"
+        b = self._batch()
+        got = np.asarray(e.bind(b.schema).columnar_eval(b).data)[:4]
+        want = [fn(v) for v in [2.0, 0.5, 3.0, -1.0]]
+        assert np.allclose(got, want), (got, want)
+
+    def test_range_loop_unrolls(self):
+        def poly(x):
+            acc = 0.0
+            for i in range(4):
+                acc = acc + x ** i
+            return acc
+        self._check(poly)
+
+    def test_branch_inside_loop(self):
+        def f(x):
+            acc = 0.0
+            for i in range(3):
+                if x > i:
+                    acc = acc + i
+                else:
+                    acc = acc - 1.0
+            return acc
+        self._check(f)
+
+    def test_range_start_stop_step(self):
+        def f(x):
+            acc = x
+            for i in range(2, 10, 3):
+                acc = acc * 1.0 + i
+            return acc
+        self._check(f)
+
+    def test_unroll_cap_falls_back(self):
+        from spark_rapids_tpu.udf.compiler import compile_udf
+        from spark_rapids_tpu.expr import core as ec
+
+        def f(x):
+            acc = 0.0
+            for i in range(1000):
+                acc = acc + i
+            return acc
+        assert compile_udf(f, [ec.AttributeReference("x")]) is None
+
+    def test_data_dependent_loop_falls_back(self):
+        from spark_rapids_tpu.udf.compiler import compile_udf
+        from spark_rapids_tpu.expr import core as ec
+
+        def f(x):
+            acc = 0.0
+            for i in range(int(x)):
+                acc = acc + i
+            return acc
+        assert compile_udf(f, [ec.AttributeReference("x")]) is None
