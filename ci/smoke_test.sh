@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# CI smoke pipeline (the jenkins/spark-tests.sh role): install the
+# wheel-less package in-place, run the unit suite on the virtual
+# 8-device CPU mesh, compile-check the driver entry points, and run a
+# small end-to-end bench sanity pass.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== unit suite (virtual 8-device CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== multichip dryrun =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+echo "== entry compile check =="
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__
+fn, args = __graft_entry__.entry()
+jax.jit(fn).lower(*args).compile()
+print("entry() compiles")
+PY
+
+echo "== bench sanity (tiny) =="
+python bench.py 100000
+
+echo "CI smoke: OK"

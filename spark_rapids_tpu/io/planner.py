@@ -66,29 +66,102 @@ class TpuFileScan(TpuExec):
         return (f"TpuFileScan[{self.logical.fmt}, {self.strategy}, "
                 f"{len(self.files)} files{pf}]")
 
-    def execute(self):
-        max_rows = self.conf.get(MAX_READER_BATCH_ROWS)
+    def _reader(self, files):
+        return FilePartitionReader(
+            self.logical.fmt, files,
+            strategy=self.strategy,
+            num_threads=self.conf.get(MULTITHREAD_READ_THREADS),
+            options=self.logical.options,
+            pushed_filters=self.pushed_filters,
+            partition_dtypes=self._part_dtypes)
 
-        def run(files):
-            reader = FilePartitionReader(
-                self.logical.fmt, files,
-                strategy=self.strategy,
-                num_threads=self.conf.get(MULTITHREAD_READ_THREADS),
-                options=self.logical.options,
-                pushed_filters=self.pushed_filters,
-                partition_dtypes=self._part_dtypes)
-            for table in reader:
-                pos = 0
-                n = table.num_rows
-                while pos < n or (n == 0 and pos == 0):
-                    k = min(max_rows, n - pos)
-                    chunk = table.slice(pos, k)
-                    self.metrics[NUM_OUTPUT_ROWS] += chunk.num_rows
-                    yield from_arrow(chunk)
-                    pos += max(k, 1)
-                    if n == 0:
-                        break
-        return [run(files) for files in self._partitions]
+    def _chunks(self, table, max_rows):
+        pos = 0
+        n = table.num_rows
+        while pos < n or (n == 0 and pos == 0):
+            k = min(max_rows, n - pos)
+            yield table.slice(pos, k)
+            pos += max(k, 1)
+            if n == 0:
+                break
+
+    def execute(self):
+        from ..config import SCAN_PREFETCH
+        max_rows = self.conf.get(MAX_READER_BATCH_ROWS)
+        if not self.conf.get(SCAN_PREFETCH) or \
+                sum(len(f) for f in self._partitions) <= 1:
+            def run(files):
+                for table in self._reader(files):
+                    for chunk in self._chunks(table, max_rows):
+                        self.metrics[NUM_OUTPUT_ROWS] += chunk.num_rows
+                        yield from_arrow(chunk)
+            return [run(files) for files in self._partitions]
+        return self._execute_prefetch(max_rows)
+
+    def _execute_prefetch(self, max_rows):
+        """Producer threads decode host arrow tables AHEAD of
+        consumption (bounded queue per partition), so scan I/O for
+        partition N+1 overlaps device compute for partition N; the
+        host->device upload of each chunk runs under the
+        DeviceSemaphore (the GpuSemaphore.scala:27,101 admission gate —
+        at most concurrentTpuTasks partitions touch the device at
+        once)."""
+        import queue as _q
+        import threading
+        from ..memory.arena import DeviceManager
+
+        sem = DeviceManager.get().semaphore
+        sentinels = {"end": object(), "err": object()}
+
+        def start_producer(files):
+            qd: "_q.Queue" = _q.Queue(maxsize=2)
+            cancel = threading.Event()
+
+            def produce():
+                try:
+                    for table in self._reader(files):
+                        while not cancel.is_set():
+                            try:
+                                qd.put(table, timeout=0.5)
+                                break
+                            except _q.Full:
+                                continue
+                        if cancel.is_set():
+                            return
+                    qd.put(sentinels["end"])
+                except Exception as e:  # noqa: BLE001 - re-raised below
+                    qd.put((sentinels["err"], e))
+            t = threading.Thread(target=produce, daemon=True,
+                                 name="tpu-scan-prefetch")
+            t.start()
+            return qd, cancel
+
+        pairs = [start_producer(files) for files in self._partitions]
+
+        def run(qd, cancel):
+            try:
+                while True:
+                    item = qd.get()
+                    if item is sentinels["end"]:
+                        return
+                    if isinstance(item, tuple) and item and \
+                            item[0] is sentinels["err"]:
+                        raise item[1]
+                    for chunk in self._chunks(item, max_rows):
+                        self.metrics[NUM_OUTPUT_ROWS] += chunk.num_rows
+                        sem.acquire_if_necessary()
+                        try:
+                            batch = from_arrow(chunk)
+                        finally:
+                            sem.release()
+                        yield batch
+            finally:
+                # abandonment (LIMIT short-circuit, error, GC of the
+                # generator) must release the producer: without this
+                # the thread blocks forever on the bounded queue,
+                # pinning decoded tables for the process lifetime
+                cancel.set()
+        return [run(qd, cancel) for qd, cancel in pairs]
 
 
 class CpuFileScan(CpuExec):
