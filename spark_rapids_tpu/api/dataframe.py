@@ -292,6 +292,21 @@ class DataFrame:
     def coalesce(self, num: int) -> "DataFrame":
         return DataFrame(L.Repartition(num, self._plan, None), self.session)
 
+    def with_window_pandas(self, alias: str, fn, cols, out_dtype,
+                           partition_by=None) -> "DataFrame":
+        """Pandas aggregate UDF over an UNBOUNDED window partition:
+        every row receives ``fn(series...)`` computed over its whole
+        partition (GpuWindowInPandasExec role; bounded frames are not
+        lowered yet)."""
+        pb = [_to_expr(c, self.schema) for c in (partition_by or [])]
+        cols = [c if isinstance(c, str) else c.expr.col_name
+                for c in cols]
+        if isinstance(out_dtype, str):
+            out_dtype = Schema.from_ddl(f"x {out_dtype}").fields[0].dtype
+        return DataFrame(
+            L.WindowInPandas(alias, fn, cols, out_dtype, pb, self._plan),
+            self.session)
+
     def with_window(self, alias: str, func, partition_by=None,
                     order_by=None, frame=("rows", None, 0)) -> "DataFrame":
         """Add a window-function column (functions.window helpers)."""
@@ -453,6 +468,12 @@ class GroupedData:
         self.keys = keys
         self.grouping_sets = grouping_sets
 
+    def cogroup(self, other: "GroupedData") -> "CogroupedData":
+        """Spark's ``df1.groupBy(k).cogroup(df2.groupBy(k))``: pairs of
+        key groups from both sides feed one pandas fn
+        (GpuFlatMapCoGroupsInPandasExec role)."""
+        return CogroupedData(self, other)
+
     def pivot(self, pivot_col, values) -> "PivotedData":
         """Spark's ``groupBy(...).pivot(col, values).agg(f(x))``.
 
@@ -588,6 +609,24 @@ class GroupedData:
         return self._simple(eagg.Average, cols)
 
     mean = avg
+
+
+class CogroupedData:
+    """groupBy().cogroup(groupBy()) — applyInPandas over key pairs."""
+
+    def __init__(self, left: GroupedData, right: GroupedData):
+        assert len(left.keys) == len(right.keys), \
+            "cogroup requires the same number of grouping keys"
+        self.left = left
+        self.right = right
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        plan = L.CogroupedMapInPandas(
+            self.left.keys, self.right.keys, fn, _as_out_schema(schema),
+            self.left.df._plan, self.right.df._plan)
+        return DataFrame(plan, self.left.df.session)
+
+    applyInPandas = apply_in_pandas
 
 
 class PivotedData:

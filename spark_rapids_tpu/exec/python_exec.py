@@ -47,23 +47,33 @@ def _run_map(fn, tables: Iterator[pa.Table], out_schema: pa.Schema):
         yield _cast_result(pdf, out_schema)
 
 
-def _run_grouped(fn, keys: List[ec.Expression], table: pa.Table,
-                 out_schema: pa.Schema):
-    """Evaluate key expressions, group, call fn per group."""
-    import numpy as np
-    import inspect
-    if table.num_rows == 0:
+def _iter_key_groups(keys: List[ec.Expression], table: pa.Table):
+    """Shared group-by-keys plumbing for every pandas exec: evaluate
+    key expressions, group the pandas frame, yield (key_tuple, pdf).
+    Zero keys = one global group (the whole frame)."""
+    pdf_all = table.to_pandas()
+    if not keys:
+        yield (), pdf_all
         return
     key_arrays = [_arr(cpu_eval(k, table), table.num_rows) for k in keys]
-    kt = pa.table({f"__gk{i}": a for i, a in enumerate(key_arrays)})
-    pdf_all = table.to_pandas()
-    kdf = kt.to_pandas()
-    takes_key = len(inspect.signature(fn).parameters) >= 2
+    kdf = pa.table({f"__gk{i}": a for i, a in
+                    enumerate(key_arrays)}).to_pandas()
     grouped = pdf_all.groupby(
         [kdf[c] for c in kdf.columns], dropna=False, sort=False)
     for key, g in grouped:
         if not isinstance(key, tuple):
             key = (key,)
+        yield key, g
+
+
+def _run_grouped(fn, keys: List[ec.Expression], table: pa.Table,
+                 out_schema: pa.Schema):
+    """Evaluate key expressions, group, call fn per group."""
+    import inspect
+    if table.num_rows == 0:
+        return
+    takes_key = len(inspect.signature(fn).parameters) >= 2
+    for key, g in _iter_key_groups(keys, table):
         out = fn(key, g) if takes_key else fn(g)
         yield _cast_result(out, out_schema)
 
@@ -168,6 +178,187 @@ class TpuGroupedMapInPandas(TpuExec):
             whole = pa.concat_tables(tables, promote_options="permissive")
             for t in _run_grouped(self.logical.fn, self.logical.keys,
                                   whole, out):
+                self.metrics[NUM_OUTPUT_ROWS] += t.num_rows
+                yield from_arrow(t)
+        return [run()]
+
+
+def _grouped_frames(keys, table: pa.Table):
+    """{key_tuple: pdf} for one side of a cogroup."""
+    out = {}
+    if table.num_rows == 0:
+        return out, table.to_pandas()
+    empty = None
+    for key, g in _iter_key_groups(keys, table):
+        out[key] = g
+        empty = g.iloc[0:0] if empty is None else empty
+    return out, (empty if empty is not None else table.to_pandas())
+
+
+def _run_cogrouped(fn, left_keys, right_keys, ltable: pa.Table,
+                   rtable: pa.Table, out_schema: pa.Schema):
+    """Full-outer key union; fn(left_pdf, right_pdf) (or with key)."""
+    import inspect
+    lgroups, lempty = _grouped_frames(left_keys, ltable)
+    rgroups, rempty = _grouped_frames(right_keys, rtable)
+    takes_key = len(inspect.signature(fn).parameters) >= 3
+    seen = list(lgroups)
+    seen += [k for k in rgroups if k not in lgroups]
+    for key in seen:
+        lg = lgroups.get(key, lempty)
+        rg = rgroups.get(key, rempty)
+        out = fn(key, lg, rg) if takes_key else fn(lg, rg)
+        yield _cast_result(out, out_schema)
+
+
+class CpuCogroupedMapInPandas(CpuExec):
+    def __init__(self, logical, left: PhysicalPlan, right: PhysicalPlan):
+        super().__init__(left, right)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def num_partitions_hint(self):
+        return 1
+
+    def execute(self):
+        out = schema_to_arrow(self.output_schema)
+        lparts = self.children[0].execute()
+        rparts = self.children[1].execute()
+
+        def run():
+            lt = [t for p in lparts for t in p if t.num_rows]
+            rt = [t for p in rparts for t in p if t.num_rows]
+            lw = pa.concat_tables(lt, promote_options="permissive") \
+                if lt else schema_to_arrow(
+                    self.children[0].output_schema).empty_table()
+            rw = pa.concat_tables(rt, promote_options="permissive") \
+                if rt else schema_to_arrow(
+                    self.children[1].output_schema).empty_table()
+            for t in _run_cogrouped(self.logical.fn,
+                                    self.logical.left_keys,
+                                    self.logical.right_keys, lw, rw, out):
+                self.metrics[NUM_OUTPUT_ROWS] += t.num_rows
+                yield t
+        return [run()]
+
+
+class TpuCogroupedMapInPandas(TpuExec):
+    """Device batches -> Arrow per side -> cogrouped pandas fn -> device.
+
+    Reference: GpuFlatMapCoGroupsInPandasExec — both sides cross to the
+    Python worker as Arrow, cogrouped by the common keys."""
+
+    def __init__(self, logical, left: PhysicalPlan, right: PhysicalPlan):
+        super().__init__(left, right)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def _node_string(self):
+        return ("TpuCogroupedMapInPandas"
+                f"[{getattr(self.logical.fn, '__name__', 'fn')}]")
+
+    def execute(self):
+        out = schema_to_arrow(self.output_schema)
+        lparts = self.children[0].execute()
+        rparts = self.children[1].execute()
+
+        def run():
+            lt = [to_arrow(b) for p in lparts for b in p]
+            rt = [to_arrow(b) for p in rparts for b in p]
+            lt = [t for t in lt if t.num_rows]
+            rt = [t for t in rt if t.num_rows]
+            lw = pa.concat_tables(lt, promote_options="permissive") \
+                if lt else schema_to_arrow(
+                    self.children[0].output_schema).empty_table()
+            rw = pa.concat_tables(rt, promote_options="permissive") \
+                if rt else schema_to_arrow(
+                    self.children[1].output_schema).empty_table()
+            for t in _run_cogrouped(self.logical.fn,
+                                    self.logical.left_keys,
+                                    self.logical.right_keys, lw, rw, out):
+                self.metrics[NUM_OUTPUT_ROWS] += t.num_rows
+                yield from_arrow(t)
+        return [run()]
+
+
+def _run_window_pandas(logical, table: pa.Table, out_schema: pa.Schema):
+    """Unbounded-partition window: broadcast fn(series...) per group.
+    Empty partition_by = one global partition."""
+    import numpy as np
+    import pandas as pd
+    pdf = table.to_pandas()
+    if table.num_rows == 0:
+        pdf[logical.out_name] = pd.Series([], dtype="float64")
+        yield _cast_result(pdf, out_schema)
+        return
+    fn = logical.fn
+    cols = logical.fn_cols
+    vals = np.empty(len(pdf), dtype=object)
+    for _key, g in _iter_key_groups(logical.partition_by, table):
+        v = fn(*[g[c] for c in cols])
+        vals[g.index.to_numpy()] = v
+    pdf[logical.out_name] = vals
+    yield _cast_result(pdf, out_schema)
+
+
+class CpuWindowInPandas(CpuExec):
+    def __init__(self, logical, child: PhysicalPlan):
+        super().__init__(child)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def num_partitions_hint(self):
+        return 1
+
+    def execute(self):
+        out = schema_to_arrow(self.output_schema)
+        parts = self.children[0].execute()
+
+        def run():
+            ts = [t for p in parts for t in p if t.num_rows]
+            whole = pa.concat_tables(ts, promote_options="permissive") \
+                if ts else schema_to_arrow(
+                    self.children[0].output_schema).empty_table()
+            for t in _run_window_pandas(self.logical, whole, out):
+                self.metrics[NUM_OUTPUT_ROWS] += t.num_rows
+                yield t
+        return [run()]
+
+
+class TpuWindowInPandas(TpuExec):
+    """Reference: GpuWindowInPandasExec — unbounded-partition frames."""
+
+    def __init__(self, logical, child: PhysicalPlan):
+        super().__init__(child)
+        self.logical = logical
+
+    @property
+    def output_schema(self):
+        return self.logical.schema
+
+    def _node_string(self):
+        return f"TpuWindowInPandas[{self.logical.out_name}]"
+
+    def execute(self):
+        out = schema_to_arrow(self.output_schema)
+        parts = self.children[0].execute()
+
+        def run():
+            ts = [to_arrow(b) for p in parts for b in p]
+            ts = [t for t in ts if t.num_rows]
+            whole = pa.concat_tables(ts, promote_options="permissive") \
+                if ts else schema_to_arrow(
+                    self.children[0].output_schema).empty_table()
+            for t in _run_window_pandas(self.logical, whole, out):
                 self.metrics[NUM_OUTPUT_ROWS] += t.num_rows
                 yield from_arrow(t)
         return [run()]

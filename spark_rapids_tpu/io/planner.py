@@ -117,20 +117,23 @@ class TpuFileScan(TpuExec):
             qd: "_q.Queue" = _q.Queue(maxsize=2)
             cancel = threading.Event()
 
+            def put_or_cancel(item) -> bool:
+                while not cancel.is_set():
+                    try:
+                        qd.put(item, timeout=0.5)
+                        return True
+                    except _q.Full:
+                        continue
+                return False
+
             def produce():
                 try:
                     for table in self._reader(files):
-                        while not cancel.is_set():
-                            try:
-                                qd.put(table, timeout=0.5)
-                                break
-                            except _q.Full:
-                                continue
-                        if cancel.is_set():
+                        if not put_or_cancel(table):
                             return
-                    qd.put(sentinels["end"])
+                    put_or_cancel(sentinels["end"])
                 except Exception as e:  # noqa: BLE001 - re-raised below
-                    qd.put((sentinels["err"], e))
+                    put_or_cancel((sentinels["err"], e))
             t = threading.Thread(target=produce, daemon=True,
                                  name="tpu-scan-prefetch")
             t.start()

@@ -561,6 +561,58 @@ class GroupedMapInPandas(LogicalPlan):
         return f"GroupedMapInPandas[{getattr(self.fn, '__name__', 'fn')}]"
 
 
+class CogroupedMapInPandas(LogicalPlan):
+    """df1.groupBy(k).cogroup(df2.groupBy(k)).applyInPandas(fn, schema):
+    fn(left_pdf, right_pdf) (or (key, left, right)) per key present on
+    EITHER side (full-outer key union, empty frame for the absent side).
+
+    Reference: GpuFlatMapCoGroupsInPandasExec (SURVEY.md §2.4)."""
+
+    def __init__(self, left_keys: List[Expression],
+                 right_keys: List[Expression], fn, out_schema: Schema,
+                 left: LogicalPlan, right: LogicalPlan):
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.fn = fn
+        self._schema = out_schema
+        self.children = [left, right]
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def _node_string(self):
+        return (f"CogroupedMapInPandas"
+                f"[{getattr(self.fn, '__name__', 'fn')}]")
+
+
+class WindowInPandas(LogicalPlan):
+    """Pandas aggregate UDF evaluated over an UNBOUNDED window
+    partition: every row of a partition gets the UDF's value over the
+    whole partition (the common pandas-window shape).
+
+    Reference: GpuWindowInPandasExec (SURVEY.md §2.4); bounded frames
+    are not yet lowered (the planner rejects them loudly)."""
+
+    def __init__(self, out_name: str, fn, fn_cols: List[str], out_dtype,
+                 partition_by: List[Expression], child: LogicalPlan):
+        self.out_name = out_name
+        self.fn = fn
+        self.fn_cols = list(fn_cols)
+        self.out_dtype = out_dtype
+        self.partition_by = partition_by
+        self.children = [child]
+
+    @property
+    def schema(self):
+        from ..columnar.schema import Field
+        return Schema(list(self.children[0].schema.fields) +
+                      [Field(self.out_name, self.out_dtype, True)])
+
+    def _node_string(self):
+        return f"WindowInPandas[{self.out_name}]"
+
+
 class CachedRelation(LogicalPlan):
     """df.cache(): parquet-encoded columnar cache over the child.
 

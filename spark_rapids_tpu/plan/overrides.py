@@ -595,6 +595,12 @@ class Planner:
         if isinstance(p, L.GroupedMapInPandas):
             from ..exec.python_exec import CpuGroupedMapInPandas
             return CpuGroupedMapInPandas(p, children[0])
+        if isinstance(p, L.CogroupedMapInPandas):
+            from ..exec.python_exec import CpuCogroupedMapInPandas
+            return CpuCogroupedMapInPandas(p, children[0], children[1])
+        if isinstance(p, L.WindowInPandas):
+            from ..exec.python_exec import CpuWindowInPandas
+            return CpuWindowInPandas(p, children[0])
         if isinstance(p, L.Scan):
             from ..io.planner import cpu_scan_exec
             return cpu_scan_exec(p, self.conf)
@@ -674,6 +680,12 @@ class Planner:
         if isinstance(p, L.MapInPandas):
             from ..exec.python_exec import TpuMapInPandas
             return TpuMapInPandas(p, children[0])
+        if isinstance(p, L.CogroupedMapInPandas):
+            from ..exec.python_exec import TpuCogroupedMapInPandas
+            return TpuCogroupedMapInPandas(p, children[0], children[1])
+        if isinstance(p, L.WindowInPandas):
+            from ..exec.python_exec import TpuWindowInPandas
+            return TpuWindowInPandas(p, children[0])
         if isinstance(p, L.GroupedMapInPandas):
             from ..exec.python_exec import TpuGroupedMapInPandas
             return TpuGroupedMapInPandas(p, children[0])

@@ -42,6 +42,50 @@ def analyze(records: List[Dict]) -> Dict:
     }
 
 
+#: metric name suffix -> category (the reference's Analysis groups
+#: nanosecond timings apart from row/batch/byte counters)
+_TIME_SUFFIXES = ("Time", "time")
+
+
+def breakdown(records: List[Dict]) -> Dict:
+    """Where did the time go? (Analysis.scala stage/SQL breakdown.)
+
+    Splits aggregated node metrics into time (ns -> ms) vs counter
+    categories, computes per-operator shares of total attributed time,
+    and isolates the shuffle/io story (exchange + scan + transition
+    nodes) — the first things the reference's profiler surfaces.
+    """
+    time_by_op: Dict[str, float] = {}
+    counters_by_op: Dict[str, Dict[str, float]] = {}
+    for r in records:
+        for node_key, metrics in r.get("node_metrics", {}).items():
+            name = node_key.split(":", 1)[1] if ":" in node_key \
+                else node_key
+            name = name.split("[", 1)[0].strip()
+            for m, v in metrics.items():
+                if m.endswith(_TIME_SUFFIXES):
+                    time_by_op[name] = time_by_op.get(name, 0.0) + \
+                        v / 1e6
+                else:
+                    c = counters_by_op.setdefault(name, {})
+                    c[m] = c.get(m, 0) + v
+    total_t = sum(time_by_op.values()) or 1.0
+    shuffle_ops = {k: v for k, v in time_by_op.items()
+                   if "Exchange" in k or "Shuffle" in k}
+    io_ops = {k: v for k, v in time_by_op.items()
+              if "Scan" in k or "Write" in k}
+    return {
+        "attributed_time_ms": round(total_t, 1),
+        "time_by_operator_ms": {k: round(v, 1) for k, v in sorted(
+            time_by_op.items(), key=lambda kv: -kv[1])},
+        "time_share": {k: round(v / total_t, 3) for k, v in sorted(
+            time_by_op.items(), key=lambda kv: -kv[1])},
+        "shuffle_time_ms": round(sum(shuffle_ops.values()), 1),
+        "io_time_ms": round(sum(io_ops.values()), 1),
+        "counters_by_operator": counters_by_op,
+    }
+
+
 def compare(a: List[Dict], b: List[Dict]) -> Dict:
     """Compare two runs query-by-query (reference: compare mode)."""
     bm = {r.get("query_id"): r for r in b}
@@ -102,8 +146,12 @@ def main(argv=None):
     if "--dot" in argv:
         for r in records:
             print(generate_dot(r))
+    elif "--breakdown" in argv:
+        print(json.dumps(breakdown(records), indent=2))
     else:
-        print(json.dumps(analyze(records), indent=2))
+        out = analyze(records)
+        out["breakdown"] = breakdown(records)
+        print(json.dumps(out, indent=2))
     return 0
 
 

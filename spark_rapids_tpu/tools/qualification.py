@@ -26,25 +26,72 @@ TPU_NODES = {
     "TpuLocalLimit", "TpuGlobalLimit", "TpuUnion", "TpuShuffleExchange",
     "TpuBroadcastExchange", "TpuCoalescePartitions", "TpuCoalesceBatches",
     "TpuFileScan", "TpuFileWrite", "RowToColumnar", "ColumnarToRow",
+    "TpuMapInPandas", "TpuGroupedMapInPandas", "TpuCogroupedMapInPandas",
+    "TpuWindowInPandas", "TpuMeshAggregate", "TpuMeshShuffledJoin",
+    "TpuMeshSort", "TpuStagedCompute", "TpuAQEShuffleRead",
+    "TpuAdaptiveShuffledJoin", "TpuGenerate", "TpuCachedExec",
 }
 
 
+#: per-operator speedup estimates — the operatorsScore.csv role
+#: (reference tools score each exec/expr with an expected GPU speedup;
+#: these numbers are the CBO's calibrated TPU factors)
+OPERATOR_SPEEDUP = {
+    "TpuHashAggregate": 10.0, "TpuShuffledHashJoin": 10.0,
+    "TpuBroadcastHashJoin": 10.0, "TpuSort": 8.0, "TpuTopN": 8.0,
+    "TpuWindow": 10.0, "TpuProject": 6.0, "TpuFilter": 6.0,
+    "TpuExpand": 6.0, "TpuFileScan": 3.0, "TpuFileWrite": 3.0,
+    "TpuShuffleExchange": 4.0, "TpuBroadcastExchange": 4.0,
+}
+DEFAULT_SPEEDUP = 3.0
+#: transitions are overhead, not acceleration
+TRANSITION_NODES = {"RowToColumnar", "ColumnarToRow"}
+
+
+def _node_name(node: str) -> str:
+    return node.split("[", 1)[0].strip()
+
+
 def qualify(records: List[Dict]) -> Dict:
-    """Score each query + the app overall for TPU acceleration fit."""
+    """Score each query + the app overall for TPU acceleration fit.
+
+    Reference: QualificationMain/QualificationAppInfo — reports the
+    accelerable fraction, an ESTIMATED accelerated runtime using
+    per-operator speedup factors, the concrete unsupported operators
+    with their tag reasons, and per-query + app recommendations.
+    """
     per_query = []
     total_ms = 0.0
     accel_ms = 0.0
+    est_ms = 0.0
+    unsupported: Dict[str, int] = {}
     for r in records:
-        nodes = r.get("nodes", [])
-        n_tpu = sum(1 for n in nodes if n in TPU_NODES)
-        frac = n_tpu / len(nodes) if nodes else 0.0
+        nodes = [_node_name(n) for n in r.get("nodes", [])]
+        core = [n for n in nodes if n not in TRANSITION_NODES]
+        n_tpu = sum(1 for n in core if n in TPU_NODES)
+        frac = n_tpu / len(core) if core else 0.0
         wall = r.get("wall_ms", 0.0)
+        # estimated accelerated wall: accelerable share shrinks by the
+        # weighted operator speedup; the CPU share stays
+        speedups = [OPERATOR_SPEEDUP.get(n, DEFAULT_SPEEDUP)
+                    for n in core if n in TPU_NODES]
+        avg_speedup = (sum(speedups) / len(speedups)) if speedups \
+            else 1.0
+        est = wall * (1 - frac) + wall * frac / avg_speedup
         total_ms += wall
         accel_ms += wall * frac
+        est_ms += est
+        for n in core:
+            if n not in TPU_NODES:
+                unsupported[n] = unsupported.get(n, 0) + 1
         per_query.append({
             "query_id": r.get("query_id"),
             "wall_ms": wall,
             "tpu_operator_fraction": round(frac, 3),
+            "estimated_speedup": round(wall / est, 2) if est else None,
+            "estimated_accelerated_ms": round(est, 1),
+            "unsupported_ops": sorted({n for n in core
+                                       if n not in TPU_NODES}),
             "fallbacks": r.get("fallbacks", []),
             "recommendation": (
                 "STRONGLY RECOMMENDED" if frac >= 0.9 else
@@ -55,7 +102,11 @@ def qualify(records: List[Dict]) -> Dict:
     return {
         "app_score": round(score, 3),
         "estimated_accelerable_ms": round(accel_ms, 1),
+        "estimated_accelerated_ms": round(est_ms, 1),
+        "estimated_app_speedup": round(total_ms / est_ms, 2)
+        if est_ms else None,
         "total_ms": round(total_ms, 1),
+        "unsupported_operators": dict(sorted(unsupported.items())),
         "recommendation": ("STRONGLY RECOMMENDED" if score >= 0.9 else
                            "RECOMMENDED" if score >= 0.5 else
                            "NOT RECOMMENDED"),
@@ -63,13 +114,36 @@ def qualify(records: List[Dict]) -> Dict:
     }
 
 
+def to_csv(report: Dict) -> str:
+    """Per-query CSV (the reference writes qualification CSVs for
+    spreadsheet triage)."""
+    import io
+    import csv
+    buf = io.StringIO()
+    w = csv.writer(buf)
+    w.writerow(["query_id", "wall_ms", "tpu_operator_fraction",
+                "estimated_speedup", "estimated_accelerated_ms",
+                "recommendation", "unsupported_ops"])
+    for q in report["queries"]:
+        w.writerow([q["query_id"], q["wall_ms"],
+                    q["tpu_operator_fraction"], q["estimated_speedup"],
+                    q["estimated_accelerated_ms"], q["recommendation"],
+                    ";".join(q["unsupported_ops"])])
+    return buf.getvalue()
+
+
 def main(argv=None):
     argv = argv or sys.argv[1:]
     if not argv:
-        print("usage: qualification <event_log.jsonl>", file=sys.stderr)
+        print("usage: qualification <event_log.jsonl> [--csv]",
+              file=sys.stderr)
         return 1
     records = read_event_log(argv[0])
-    print(json.dumps(qualify(records), indent=2))
+    report = qualify(records)
+    if "--csv" in argv:
+        print(to_csv(report), end="")
+    else:
+        print(json.dumps(report, indent=2))
     return 0
 
 

@@ -118,6 +118,9 @@ class TestScanPrefetch:
         assert len(parts) > 1
         first = next(iter(parts[0]))
         assert first.num_rows > 0
+        # the remaining partitions' producer THREADS exist already —
+        # started eagerly at execute(), decoding while partition 0
+        # computes; without prefetch no such thread would ever run
         deadline = time.time() + 10
         names = []
         while time.time() < deadline:
@@ -126,7 +129,6 @@ class TestScanPrefetch:
             if names:
                 break
             time.sleep(0.01)
-        # the remaining partitions' producers were started eagerly
-        # (their data is being decoded while partition 0 computes)
+        assert names, "no prefetch producer threads observed"
         got_rows = sum(b.num_rows for p in parts[1:] for b in p)
         assert got_rows > 0

@@ -90,3 +90,93 @@ def test_map_in_pandas_runs_on_tpu_engine():
         assert "TpuMapInPandas" in tree, tree
         return []
     with_tpu_session(run)
+
+
+class TestCogroupedMapInPandas:
+    """GpuFlatMapCoGroupsInPandasExec role: key-paired pandas groups."""
+
+    def _dfs(self, s):
+        import numpy as np
+        left = s.create_dataframe({
+            "k": np.array([1, 1, 2, 3], np.int64),
+            "a": np.array([10.0, 20.0, 30.0, 40.0])})
+        right = s.create_dataframe({
+            "k": np.array([1, 2, 2, 4], np.int64),
+            "b": np.array([1.0, 2.0, 3.0, 4.0])})
+        return left, right
+
+    def _q(self, s):
+        left, right = self._dfs(s)
+
+        def merge(lg, rg):
+            import pandas as pd
+            return pd.DataFrame({
+                "k": [lg["k"].iloc[0] if len(lg) else rg["k"].iloc[0]],
+                "suma": [float(lg["a"].sum())],
+                "sumb": [float(rg["b"].sum())]})
+        return (left.group_by("k")
+                .cogroup(right.group_by("k"))
+                .apply_in_pandas(merge, "k long, suma double, sumb double"))
+
+    def test_matches_cpu(self):
+        from harness import assert_tpu_and_cpu_are_equal_collect
+        rows = sorted(assert_tpu_and_cpu_are_equal_collect(self._q))
+        # keys 1,2 on both sides; 3 left-only; 4 right-only
+        assert [r[0] for r in rows] == [1, 2, 3, 4]
+        by_k = {r[0]: r for r in rows}
+        assert by_k[1][1] == 30.0 and by_k[1][2] == 1.0
+        assert by_k[3][1] == 40.0 and by_k[3][2] == 0.0
+        assert by_k[4][1] == 0.0 and by_k[4][2] == 4.0
+
+    def test_with_key_argument(self):
+        from harness import with_tpu_session
+
+        def q(s):
+            left, right = self._dfs(s)
+
+            def merge(key, lg, rg):
+                import pandas as pd
+                return pd.DataFrame({"k": [key[0]],
+                                     "n": [len(lg) + len(rg)]})
+            return (left.group_by("k")
+                    .cogroup(right.group_by("k"))
+                    .apply_in_pandas(merge, "k long, n long"))
+        rows = sorted(with_tpu_session(lambda s: q(s).collect()))
+        assert rows == [(1, 3), (2, 3), (3, 1), (4, 1)]
+
+
+class TestWindowInPandas:
+    """GpuWindowInPandasExec role: pandas agg over unbounded
+    partitions, broadcast to every row."""
+
+    def test_partition_mean_broadcast(self):
+        from harness import assert_tpu_and_cpu_are_equal_collect
+
+        def q(s):
+            import numpy as np
+            df = s.create_dataframe({
+                "g": np.array([1, 1, 2, 2, 2], np.int64),
+                "v": np.array([1.0, 3.0, 10.0, 20.0, 30.0])})
+
+            def mean_of(v):
+                return float(v.mean())
+            return df.with_window_pandas("m", mean_of, ["v"], "double",
+                                         partition_by=["g"])
+        rows = sorted(assert_tpu_and_cpu_are_equal_collect(q))
+        for g, v, m in rows:
+            assert m == (2.0 if g == 1 else 20.0)
+
+
+def test_window_pandas_global_partition():
+    """Empty partition_by = one global unbounded window."""
+    from harness import assert_tpu_and_cpu_are_equal_collect
+
+    def q(s):
+        import numpy as np
+        df = s.create_dataframe({"v": np.array([1.0, 2.0, 3.0, 4.0])})
+
+        def total(v):
+            return float(v.sum())
+        return df.with_window_pandas("t", total, ["v"], "double")
+    rows = assert_tpu_and_cpu_are_equal_collect(q)
+    assert all(r[1] == 10.0 for r in rows)

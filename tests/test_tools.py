@@ -207,3 +207,27 @@ class TestCboPlacement:
         # the middle projection must NOT flip engines on its own
         sides = {placement[id(n)] for n in (outer, proj, inner)}
         assert sides == {"tpu"}
+
+
+class TestToolDepth:
+    """Round-3 tool depth: speedup estimates, unsupported-op report,
+    CSV output, time breakdown (QualificationAppInfo / Analysis roles)."""
+
+    def test_qualification_estimates_and_csv(self, tmp_path):
+        log = _run_queries(tmp_path)
+        q = qualify(read_event_log(log))
+        assert q["estimated_app_speedup"] and \
+            q["estimated_app_speedup"] > 1.0
+        assert q["unsupported_operators"] == {}
+        from spark_rapids_tpu.tools.qualification import to_csv
+        csv_text = to_csv(q)
+        assert csv_text.splitlines()[0].startswith("query_id,")
+        assert len(csv_text.splitlines()) == 1 + len(q["queries"])
+
+    def test_profiling_breakdown(self, tmp_path):
+        from spark_rapids_tpu.tools.profiling import breakdown
+        log = _run_queries(tmp_path)
+        b = breakdown(read_event_log(log))
+        assert b["attributed_time_ms"] >= 0
+        assert b["time_by_operator_ms"]
+        assert abs(sum(b["time_share"].values()) - 1.0) < 0.05

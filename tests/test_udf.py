@@ -262,3 +262,67 @@ class TestUdfLoopCompilation:
                 acc = acc + i
             return acc
         assert compile_udf(f, [ec.AttributeReference("x")]) is None
+
+
+class TestUdfExamples:
+    """The udf-examples/ role: each reference example flavor has a
+    working TPU-framework analogue (spark_rapids_tpu/udf/examples.py)."""
+
+    def test_url_roundtrip_and_word_count(self):
+        from harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.udf import examples as ex
+
+        def q(s):
+            df = s.create_dataframe({
+                "s": ["a b&c", "hello world x", None, "q=1&r=2 s"]})
+            enc = df.with_column("e", ex.url_encode(F.col("s")))
+            dec = enc.with_column("d", ex.url_decode(F.col("e")))
+            return dec.with_column("w", ex.word_count(F.col("s")))
+        rows = assert_tpu_and_cpu_are_equal_collect(q)
+        for s, e, d, w in rows:
+            assert d == s
+            assert w == (len(s.split()) if s is not None else None)
+
+    def test_polynomial_compiles_to_expressions(self):
+        from spark_rapids_tpu.udf import examples as ex
+        from spark_rapids_tpu.udf.compiler import compile_udf
+        from spark_rapids_tpu.expr import core as ec
+        # the example must be translatable (no python per row)
+        assert compile_udf(lambda x: 3.0 * x * x + 2.0 * x + 1.0,
+                           [ec.AttributeReference("x")]) is not None
+        from harness import assert_tpu_and_cpu_are_equal_collect
+
+        def q(s):
+            import numpy as np
+            df = s.create_dataframe({"x": np.array([0.0, 1.0, -2.0])})
+            return df.select(ex.polynomial(F.col("x")).alias("p"))
+        rows = sorted(assert_tpu_and_cpu_are_equal_collect(q))
+        assert [r[0] for r in rows] == [1.0, 6.0, 9.0]
+
+    def test_cosine_similarity_device_udf(self):
+        from harness import with_tpu_session
+        from spark_rapids_tpu.udf import examples as ex
+        import math
+
+        def q(s):
+            df = s.create_dataframe(
+                [([1.0, 0.0], [1.0, 0.0]),
+                 ([1.0, 0.0], [0.0, 1.0]),
+                 ([1.0, 2.0], [2.0, 4.0]),
+                 ([1.0, 2.0], [1.0, 2.0, 3.0])],
+                schema=_arr_schema())
+            return df.select(
+                ex.cosine_similarity(F.col("a"), F.col("b")).alias("c"))
+        rows = with_tpu_session(lambda s: q(s).collect())
+        vals = [r[0] for r in rows]
+        assert abs(vals[0] - 1.0) < 1e-9
+        assert abs(vals[1]) < 1e-9
+        assert abs(vals[2] - 1.0) < 1e-9
+        assert vals[3] is None          # length mismatch -> null
+
+
+def _arr_schema():
+    from spark_rapids_tpu.columnar.schema import Field, Schema
+    from spark_rapids_tpu.columnar import dtypes as T
+    at = T.ArrayType(T.FLOAT64)
+    return Schema([Field("a", at), Field("b", at)])
