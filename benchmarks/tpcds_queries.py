@@ -2,9 +2,9 @@
 
 Faithful renditions of the official query shapes (qualification
 parameter choices) over the columns the generator produces; queries
-whose official text uses a correlated SCALAR subquery (q1, q6, q32,
-q81, q92) are excluded — the SQL front end decorrelates EXISTS/IN but
-not scalar subqueries yet.  Reference surface:
+including the correlated-SCALAR-subquery family (q1/q6/q32/q81/q92),
+which the front end decorrelates to group-by + join.  Reference
+surface:
 integration_tests qa_nightly + the official tpcds queries directory.
 
 Every query is verified TPU-vs-CPU by ``tpcds.py --verify`` (rows
@@ -1183,4 +1183,100 @@ QUERIES["q99"] = """
       and cs_call_center_sk = cc_call_center_sk
     group by w_warehouse_name, sm_type, cc_name
     order by w_warehouse_name, sm_type, cc_name
+    limit 100"""
+
+# --------------------------------------------------------------------------
+# correlated scalar aggregate subqueries (decorrelated to group-by+join)
+# --------------------------------------------------------------------------
+
+QUERIES["q1"] = """
+    with customer_total_return as (
+      select sr_customer_sk as ctr_customer_sk,
+             sr_store_sk as ctr_store_sk,
+             sum(sr_return_amt) as ctr_total_return
+      from store_returns, date_dim
+      where sr_returned_date_sk = d_date_sk and d_year = 2000
+      group by sr_customer_sk, sr_store_sk)
+    select c_customer_id
+    from customer_total_return ctr1, store, customer
+    where ctr1.ctr_total_return >
+        (select avg(ctr_total_return) * 1.2
+         from customer_total_return ctr2
+         where ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+      and s_store_sk = ctr1.ctr_store_sk
+      and s_state = 'TN'
+      and ctr1.ctr_customer_sk = c_customer_sk
+    order by c_customer_id
+    limit 100"""
+
+QUERIES["q6"] = """
+    select a.ca_state state, count(*) cnt
+    from customer_address a, customer c, store_sales s, date_dim d,
+         item i
+    where a.ca_address_sk = c.c_current_addr_sk
+      and c.c_customer_sk = s.ss_customer_sk
+      and s.ss_sold_date_sk = d.d_date_sk
+      and s.ss_item_sk = i.i_item_sk
+      and d.d_month_seq =
+        (select distinct d_month_seq from date_dim
+         where d_year = 2001 and d_moy = 1)
+      and i.i_current_price >
+        (select avg(j.i_current_price) * 1.2 from item j
+         where j.i_category = i.i_category)
+    group by a.ca_state
+    having count(*) >= 10
+    order by cnt, a.ca_state
+    limit 100"""
+
+QUERIES["q32"] = """
+    select sum(cs_ext_discount_amt) as excess_discount_amount
+    from catalog_sales, item, date_dim
+    where i_manufact_id = 977
+      and i_item_sk = cs_item_sk
+      and d_date_sk = cs_sold_date_sk
+      and d_year = 2000 and d_moy between 1 and 4
+      and cs_ext_discount_amt >
+        (select 1.3 * avg(cs_ext_discount_amt)
+         from catalog_sales, date_dim
+         where cs_item_sk = i_item_sk
+           and d_year = 2000 and d_moy between 1 and 4
+           and d_date_sk = cs_sold_date_sk)
+    limit 100"""
+
+QUERIES["q81"] = """
+    with customer_total_return as (
+      select cr_returning_customer_sk as ctr_customer_sk,
+             ca_state as ctr_state,
+             sum(cr_return_amt_inc_tax) as ctr_total_return
+      from catalog_returns, date_dim, customer_address
+      where cr_returned_date_sk = d_date_sk and d_year = 2000
+        and cr_returning_addr_sk = ca_address_sk
+      group by cr_returning_customer_sk, ca_state)
+    select c_customer_id, c_salutation, c_first_name, c_last_name,
+           ctr_total_return
+    from customer_total_return ctr1, customer_address, customer
+    where ctr1.ctr_total_return >
+        (select avg(ctr_total_return) * 1.2
+         from customer_total_return ctr2
+         where ctr1.ctr_state = ctr2.ctr_state)
+      and ca_address_sk = c_current_addr_sk
+      and ca_state = 'GA'
+      and ctr1.ctr_customer_sk = c_customer_sk
+    order by c_customer_id, c_salutation, c_first_name, c_last_name,
+             ctr_total_return
+    limit 100"""
+
+QUERIES["q92"] = """
+    select sum(ws_ext_discount_amt) as excess_discount_amount
+    from web_sales, item, date_dim
+    where i_manufact_id = 350
+      and i_item_sk = ws_item_sk
+      and d_date_sk = ws_sold_date_sk
+      and d_year = 2000 and d_moy between 1 and 4
+      and ws_ext_discount_amt >
+        (select 1.3 * avg(ws_ext_discount_amt)
+         from web_sales, date_dim
+         where ws_item_sk = i_item_sk
+           and d_year = 2000 and d_moy between 1 and 4
+           and d_date_sk = ws_sold_date_sk)
     limit 100"""

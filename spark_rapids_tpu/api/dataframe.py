@@ -615,8 +615,10 @@ class CogroupedData:
     """groupBy().cogroup(groupBy()) — applyInPandas over key pairs."""
 
     def __init__(self, left: GroupedData, right: GroupedData):
-        assert len(left.keys) == len(right.keys), \
-            "cogroup requires the same number of grouping keys"
+        if len(left.keys) != len(right.keys):
+            raise ValueError(
+                "cogroup requires the same number of grouping keys "
+                f"({len(left.keys)} vs {len(right.keys)})")
         self.left = left
         self.right = right
 

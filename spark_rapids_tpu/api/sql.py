@@ -1028,6 +1028,26 @@ def _make_agg(f: Func, lower) -> eagg.AggregateFunction:
     raise SqlError(f"unknown aggregate {n}")
 
 
+
+
+def _split_conjuncts(a: Ast) -> List[Ast]:
+    """AND-flatten a predicate AST (shared by WHERE lowering and both
+    decorrelators)."""
+    if isinstance(a, Bin) and a.op == "and":
+        return _split_conjuncts(a.left) + _split_conjuncts(a.right)
+    return [a]
+
+
+def _canon_idents(scope_: "_Scope", ast: Ast) -> Ast:
+    """Resolve raw Idents against a scope (raises SqlError on unknown
+    columns) — shared by both decorrelators."""
+    def fn(n):
+        if isinstance(n, Ident):
+            return Res(scope_.resolve_field(n.parts).name)
+        return n
+    return _transform(ast, fn)
+
+
 class _Lowerer:
     def __init__(self, session, views):
         self.session = session
@@ -1546,6 +1566,34 @@ class _Lowerer:
                 if not truth:
                     plan = L.Filter(ec.Literal(False, T.BOOL), plan)
                 continue
+            if isinstance(c, Bin) and c.op in ("<", "<=", ">", ">=",
+                                               "=", "<>") and \
+                    (isinstance(c.left, ScalarSub) ^
+                     isinstance(c.right, ScalarSub)):
+                sub_ast = c.right if isinstance(c.right, ScalarSub) \
+                    else c.left
+                try:
+                    sub_plan = self.lower(sub_ast.query)
+                except SqlError as probe_err:
+                    plan = self._decorrelate_scalar_cmp(
+                        c, plan, scope, probe_err)
+                    continue
+                # uncorrelated: fold the ALREADY-lowered plan to a
+                # literal here (handing the raw AST to lower_expr
+                # would lower + execute the whole subquery a second
+                # time, including any nested subqueries)
+                lit = self._scalar_literal(sub_plan)
+                lhs = self.lower_expr(
+                    c.left if isinstance(c.right, ScalarSub) else
+                    c.right, scope)
+                a, b = (lhs, lit) if isinstance(c.right, ScalarSub) \
+                    else (lit, lhs)
+                cmp_cls = {"<": ep.LessThan, "<=": ep.LessThanOrEqual,
+                           ">": ep.GreaterThan,
+                           ">=": ep.GreaterThanOrEqual, "=": ep.EqualTo}
+                rest.append(ep.Not(ep.EqualTo(a, b)) if c.op == "<>"
+                            else cmp_cls[c.op](a, b))
+                continue
             rest.append(self.lower_expr(c, scope))
         if rest:
             cond = rest[0]
@@ -1567,25 +1615,13 @@ class _Lowerer:
                 sub.group_by or sub.having or sub.distinct or sub.ctes:
             raise SqlError("unsupported correlated EXISTS subquery")
         inner_plan, inner_scope = self.lower_from(sub.from_item)
-
-        def canon_with(scope_: _Scope, ast: Ast) -> Ast:
-            def fn(n):
-                if isinstance(n, Ident):
-                    return Res(scope_.resolve_field(n.parts).name)
-                return n
-            return _transform(ast, fn)
-
-        def conjuncts(a: Ast) -> List[Ast]:
-            if isinstance(a, Bin) and a.op == "and":
-                return conjuncts(a.left) + conjuncts(a.right)
-            return [a]
-
         inner_rest: List[Ast] = []
         outer_keys: List[ec.Expression] = []
         inner_keys: List[ec.Expression] = []
-        for cj in (conjuncts(sub.where) if sub.where is not None else []):
+        for cj in (_split_conjuncts(sub.where)
+                   if sub.where is not None else []):
             try:
-                inner_rest.append(canon_with(inner_scope, cj))
+                inner_rest.append(_canon_idents(inner_scope, cj))
                 continue
             except SqlError:
                 pass
@@ -1593,8 +1629,8 @@ class _Lowerer:
             if isinstance(cj, Bin) and cj.op == "=":
                 for a, b in ((cj.left, cj.right), (cj.right, cj.left)):
                     try:
-                        ia = canon_with(inner_scope, a)
-                        ob = canon_with(outer_scope, b)
+                        ia = _canon_idents(inner_scope, a)
+                        ob = _canon_idents(outer_scope, b)
                     except SqlError:
                         continue
                     inner_keys.append(self.lower_expr(ia, inner_scope))
@@ -1620,6 +1656,131 @@ class _Lowerer:
                  for i, k in enumerate(inner_keys)]
         return L.Join(plan, inner_proj, "anti" if c.negated else "semi",
                       outer_keys, rrefs, None)
+
+    def _scalar_literal(self, sub_plan: L.LogicalPlan) -> ec.Literal:
+        """Execute an (already lowered) uncorrelated scalar subquery to
+        a literal (at most one row, one column)."""
+        if len(sub_plan.schema) != 1:
+            raise SqlError("scalar subquery must return one column")
+        tbl = self.session.execute_to_arrow(sub_plan)
+        if tbl.num_rows > 1:
+            raise SqlError("scalar subquery returned more than one row")
+        val = tbl.column(0)[0].as_py() if tbl.num_rows else None
+        return ec.Literal(val, sub_plan.schema.fields[0].dtype)
+
+    def _decorrelate_scalar_cmp(self, c: Bin, plan: L.LogicalPlan,
+                                outer_scope: _Scope,
+                                probe_err=None) -> L.LogicalPlan:
+        """``x CMP (correlated scalar aggregate subquery)`` ->
+        group-by-correlation-keys + inner join + comparison filter.
+
+        Reference shape: TPC-DS q1/q6/q32/q81/q92 —
+        ``where ctr_total_return > (select avg(ctr_total_return)*1.2
+        from ctr ctr2 where ctr1.ctr_store_sk = ctr2.ctr_store_sk)``.
+        The subquery becomes ``select k, AGG as __sv ... group by k``;
+        each outer row joins its group's scalar and the comparison
+        filters.  Rows with no group drop either way (NULL compare),
+        so an inner join is exact."""
+        sub_ast = c.right if isinstance(c.right, ScalarSub) else c.left
+        outer_ast = c.left if isinstance(c.right, ScalarSub) else c.right
+        op = c.op
+        if isinstance(c.left, ScalarSub):
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        sub = sub_ast.query
+        if not isinstance(sub, SelectStmt) or sub.from_item is None or \
+                sub.group_by or sub.having or sub.distinct or sub.ctes \
+                or len(sub.items) != 1:
+            raise SqlError("unsupported correlated scalar subquery "
+                           "(single aggregate item expected)")
+
+        def has_agg(a: Ast) -> bool:
+            found = []
+
+            def fn(n):
+                if isinstance(n, Func) and n.fname in _AGG_FUNCS:
+                    found.append(n)
+                return n
+            _transform(a, fn)
+            return bool(found)
+        if not has_agg(sub.items[0].e):
+            # a non-aggregate correlated scalar would need runtime
+            # more-than-one-row enforcement; the group-by rewrite would
+            # silently dedup instead — refuse
+            raise SqlError(
+                "correlated scalar subquery must select a single "
+                "aggregate expression")
+        # probe scope: which conjuncts are inner-only vs correlation
+        # equalities (same split as _decorrelate_exists, but keeping
+        # the RAW inner asts so the rewritten SelectStmt re-lowers)
+        _, inner_scope = self.lower_from(sub.from_item)
+        inner_rest: List[Ast] = []
+        inner_key_asts: List[Ast] = []
+        outer_keys: List[ec.Expression] = []
+        for cj in (_split_conjuncts(sub.where)
+                   if sub.where is not None else []):
+            try:
+                _canon_idents(inner_scope, cj)
+                inner_rest.append(cj)
+                continue
+            except SqlError:
+                pass
+            matched = False
+            if isinstance(cj, Bin) and cj.op == "=":
+                for a, b in ((cj.left, cj.right), (cj.right, cj.left)):
+                    try:
+                        _canon_idents(inner_scope, a)
+                        ob = _canon_idents(outer_scope, b)
+                    except SqlError:
+                        continue
+                    inner_key_asts.append(a)
+                    outer_keys.append(self.lower_expr(ob, outer_scope))
+                    matched = True
+                    break
+            if not matched:
+                raise SqlError(
+                    "correlated scalar subquery predicates must be "
+                    "equalities between inner and outer columns (plus "
+                    "inner-only conjuncts)"
+                    + (f"; original subquery error: {probe_err}"
+                       if probe_err else ""))
+        if not inner_key_asts:
+            raise SqlError(
+                "scalar subquery references unknown columns"
+                + (f"; original subquery error: {probe_err}"
+                   if probe_err else ""))
+        # rebuild: select k0.., AGG as __sv from ... where inner_rest
+        # group by k0.. — then re-lower through the normal pipeline
+        where_ast = None
+        for r in inner_rest:
+            where_ast = r if where_ast is None else \
+                Bin("and", where_ast, r)
+        new_items = tuple(
+            SelectItem(a, f"__ck{i}")
+            for i, a in enumerate(inner_key_asts)
+        ) + (SelectItem(sub.items[0].e, "__sv"),)
+        new_sub = dataclasses.replace(
+            sub, items=new_items, where=where_ast,
+            group_by=tuple(inner_key_asts), group_sets=None,
+            order_by=(), limit=None, offset=None)
+        inner = self.lower(new_sub)
+        fields = list(inner.schema)
+        rrefs = [ec.AttributeReference(f.name, f.dtype, f.nullable)
+                 for f in fields[:-1]]
+        sv = fields[-1]
+        sv_ref = ec.AttributeReference(sv.name, sv.dtype, sv.nullable)
+        joined = L.Join(plan, inner, "inner", outer_keys, rrefs, None)
+        lhs = self.lower_expr(outer_ast, outer_scope)
+        cmp_cls = {"<": ep.LessThan, "<=": ep.LessThanOrEqual,
+                   ">": ep.GreaterThan, ">=": ep.GreaterThanOrEqual,
+                   "=": ep.EqualTo}
+        cond = ep.Not(ep.EqualTo(lhs, sv_ref)) if op == "<>" else \
+            cmp_cls[op](lhs, sv_ref)
+        filtered = L.Filter(cond, joined)
+        # restore the outer schema (the helper columns must not leak
+        # into star expansion or set operations downstream)
+        proj = [ec.AttributeReference(f.name, f.dtype, f.nullable)
+                for f in plan.schema]
+        return L.Project(proj, filtered)
 
     # -- window -------------------------------------------------------------
     def lower_window(self, w: WindowE, alias: str,

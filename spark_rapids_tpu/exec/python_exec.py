@@ -63,6 +63,10 @@ def _iter_key_groups(keys: List[ec.Expression], table: pa.Table):
     for key, g in grouped:
         if not isinstance(key, tuple):
             key = (key,)
+        # normalize null keys: pandas emits NaN for null numeric keys,
+        # and nan != nan would break cross-side pairing (cogroup)
+        key = tuple(None if (isinstance(v, float) and v != v) else v
+                    for v in key)
         yield key, g
 
 

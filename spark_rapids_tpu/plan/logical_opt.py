@@ -176,6 +176,58 @@ def _rewrite_filter_semi(f: L.Filter) -> L.LogicalPlan:
     return _filter_over(rest, nj)
 
 
+def _rewrite_filter_project(f: L.Filter) -> L.LogicalPlan:
+    """Push Filter conjuncts through a pass-through/renaming Project so
+    they can keep sinking into the join below (the scalar-subquery
+    decorrelation emits Project(Filter(Join(cross...))) shapes whose
+    outer WHERE conjuncts must still reach the cross join)."""
+    pj = f.children[0]
+    if not isinstance(pj, L.Project):
+        return f
+    # out name -> source name, only for pure column pass-throughs
+    mapping = {}
+    for e in pj.exprs:
+        src = e
+        name = None
+        if isinstance(e, ec.Alias):
+            name = e.alias
+            src = e.children[0]
+        if isinstance(src, ec.AttributeReference):
+            mapping[name or src.col_name] = src.col_name
+    push: List[ec.Expression] = []
+    rest: List[ec.Expression] = []
+
+    def rewrite(e: ec.Expression):
+        if isinstance(e, ec.AttributeReference):
+            if e.col_name not in mapping:
+                return None
+            return ec.AttributeReference(mapping[e.col_name], e._dtype,
+                                         e._nullable)
+        kids = []
+        for c in e.children:
+            r = rewrite(c)
+            if r is None:
+                return None
+            kids.append(r)
+        return e.with_children(kids) if kids else e
+
+    for c in _flatten_and(f.condition):
+        refs = _refs(c)
+        if refs is None:
+            rest.append(c)
+            continue
+        r = rewrite(c)
+        if r is not None:
+            push.append(r)
+        else:
+            rest.append(c)
+    if not push:
+        return f
+    new_child = optimize(_filter_over(push, pj.children[0]))
+    npj = L.Project(pj.exprs, new_child)
+    return _filter_over(rest, npj)
+
+
 def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
     """Bottom-up: push Filter conjuncts through inner/cross joins and
     promote cross-side equalities to join keys."""
@@ -194,6 +246,9 @@ def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
         if out is not plan:
             return out
         out = _rewrite_filter_semi(plan)
+        if out is not plan:
+            return out
+        out = _rewrite_filter_project(plan)
         if out is not plan:
             return out
     return plan
