@@ -179,6 +179,11 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "cd_education_status": rng.choice(
             ["Primary", "Secondary", "College", "2 yr Degree",
              "4 yr Degree", "Advanced Degree", "Unknown"], nd),
+        "cd_dep_count": rng.integers(0, 7, nd).astype(np.int32),
+        "cd_purchase_estimate": (rng.integers(1, 12, nd) * 500)
+        .astype(np.int32),
+        "cd_credit_rating": rng.choice(
+            ["Low Risk", "Good", "High Risk", "Unknown"], nd),
     }))
 
     nh = n["household_demographics"]
@@ -421,8 +426,16 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "wr_reason_sk": rng.integers(0, 35, nwr).astype(np.int64),
         "wr_order_number": (rng.integers(0, nws, nwr) // 3)
         .astype(np.int64),
+        "wr_refunded_cdemo_sk": rng.integers(0, nd, nwr)
+        .astype(np.int64),
+        "wr_returning_cdemo_sk": rng.integers(0, nd, nwr)
+        .astype(np.int64),
+        "wr_refunded_addr_sk": rng.integers(0, na, nwr)
+        .astype(np.int64),
         "wr_return_quantity": rng.integers(1, 50, nwr).astype(np.int32),
         "wr_return_amt": wramt,
+        "wr_refunded_cash": (wramt * 0.8).round(2),
+        "wr_fee": (rng.random(nwr) * 20).round(2),
         "wr_net_loss": (rng.random(nwr) * 60).round(2),
     }))
 
@@ -575,7 +588,7 @@ def main():
     ap.add_argument("--data-dir", default="/tmp/tpcds_data")
     ap.add_argument("--repeats", type=int, default=2)
     args = ap.parse_args()
-    tag = os.path.join(args.data_dir, f"sf{args.scale}_v3")
+    tag = os.path.join(args.data_dir, f"sf{args.scale}_v4")
     if not os.path.exists(os.path.join(tag, "store_sales.parquet")):
         sizes = generate(tag, args.scale)
         print(f"generated {sizes}", file=sys.stderr)

@@ -1280,3 +1280,532 @@ QUERIES["q92"] = """
            and d_year = 2000 and d_moy between 1 and 4
            and d_date_sk = ws_sold_date_sk)
     limit 100"""
+
+# --------------------------------------------------------------------------
+# round-3 extension batch 2
+# --------------------------------------------------------------------------
+
+QUERIES["q30"] = """
+    with customer_total_return as (
+      select wr_returning_customer_sk as ctr_customer_sk,
+             ca_state as ctr_state,
+             sum(wr_return_amt) as ctr_total_return
+      from web_returns, date_dim, customer_address
+      where wr_returned_date_sk = d_date_sk and d_year = 2002
+        and wr_returning_addr_sk = ca_address_sk
+      group by wr_returning_customer_sk, ca_state)
+    select c_customer_id, c_salutation, c_first_name, c_last_name,
+           c_preferred_cust_flag, c_birth_year, ctr_total_return
+    from customer_total_return ctr1, customer_address, customer
+    where ctr1.ctr_total_return >
+        (select avg(ctr_total_return) * 1.2
+         from customer_total_return ctr2
+         where ctr1.ctr_state = ctr2.ctr_state)
+      and ca_address_sk = c_current_addr_sk
+      and ca_state = 'GA'
+      and ctr1.ctr_customer_sk = c_customer_sk
+    order by c_customer_id, c_salutation, c_first_name, c_last_name,
+             c_preferred_cust_flag, c_birth_year, ctr_total_return
+    limit 100"""
+
+QUERIES["q31"] = """
+    with ss as (
+      select ca_county, d_qoy, d_year,
+             sum(ss_ext_sales_price) as store_sales
+      from store_sales, date_dim, customer_address
+      where ss_sold_date_sk = d_date_sk
+        and ss_addr_sk = ca_address_sk
+      group by ca_county, d_qoy, d_year),
+    ws as (
+      select ca_county, d_qoy, d_year,
+             sum(ws_ext_sales_price) as web_sales
+      from web_sales, date_dim, customer_address
+      where ws_sold_date_sk = d_date_sk
+        and ws_bill_addr_sk = ca_address_sk
+      group by ca_county, d_qoy, d_year)
+    select ss1.ca_county, ss1.d_year,
+           ws2.web_sales / ws1.web_sales web_q1_q2_increase,
+           ss2.store_sales / ss1.store_sales store_q1_q2_increase
+    from ss ss1, ss ss2, ws ws1, ws ws2
+    where ss1.d_qoy = 1 and ss1.d_year = 2000
+      and ss1.ca_county = ss2.ca_county
+      and ss2.d_qoy = 2 and ss2.d_year = 2000
+      and ss2.ca_county = ws1.ca_county
+      and ws1.d_qoy = 1 and ws1.d_year = 2000
+      and ws1.ca_county = ws2.ca_county
+      and ws2.d_qoy = 2 and ws2.d_year = 2000
+      and case when ws1.web_sales > 0
+               then ws2.web_sales / ws1.web_sales else null end >
+          case when ss1.store_sales > 0
+               then ss2.store_sales / ss1.store_sales else null end
+    order by ss1.ca_county
+    limit 100"""
+
+QUERIES["q35"] = """
+    select ca_state, cd_gender, cd_marital_status,
+           count(*) cnt1, avg(cd_dep_count) a1,
+           max(cd_dep_count) m1, sum(cd_dep_count) s1
+    from customer c, customer_address ca, customer_demographics
+    where c.c_current_addr_sk = ca.ca_address_sk
+      and cd_demo_sk = c.c_current_cdemo_sk
+      and exists (select * from store_sales, date_dim
+                  where c.c_customer_sk = ss_customer_sk
+                    and ss_sold_date_sk = d_date_sk
+                    and d_year = 2002 and d_qoy < 4)
+      and exists (select * from web_sales, date_dim
+                  where c.c_customer_sk = ws_bill_customer_sk
+                    and ws_sold_date_sk = d_date_sk
+                    and d_year = 2002 and d_qoy < 4)
+    group by ca_state, cd_gender, cd_marital_status
+    order by ca_state, cd_gender, cd_marital_status
+    limit 100"""
+
+QUERIES["q47"] = """
+    with v1 as (
+      select i_category, i_brand, s_store_name, s_company_id,
+             d_year, d_moy, sum(ss_sales_price) sum_sales,
+             avg(sum(ss_sales_price)) over (partition by
+               i_category, i_brand, s_store_name, s_company_id, d_year)
+               avg_monthly_sales,
+             rank() over (partition by
+               i_category, i_brand, s_store_name, s_company_id
+               order by d_year, d_moy) rn
+      from item, store_sales, date_dim, store
+      where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and d_year = 1999
+      group by i_category, i_brand, s_store_name, s_company_id,
+               d_year, d_moy)
+    select v1.i_category, v1.i_brand, v1.s_store_name, v1.d_year,
+           v1.d_moy, v1.avg_monthly_sales, v1.sum_sales
+    from v1
+    where v1.d_year = 1999
+      and v1.avg_monthly_sales > 0
+      and abs(v1.sum_sales - v1.avg_monthly_sales) /
+          v1.avg_monthly_sales > 0.1
+    order by v1.sum_sales - v1.avg_monthly_sales, v1.i_category,
+             v1.i_brand, v1.s_store_name, v1.d_moy
+    limit 100"""
+
+QUERIES["q57"] = """
+    with v1 as (
+      select i_category, i_brand, cc_name, d_year, d_moy,
+             sum(cs_sales_price) sum_sales,
+             avg(sum(cs_sales_price)) over (partition by
+               i_category, i_brand, cc_name, d_year)
+               avg_monthly_sales,
+             rank() over (partition by i_category, i_brand, cc_name
+               order by d_year, d_moy) rn
+      from item, catalog_sales, date_dim, call_center
+      where cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+        and cc_call_center_sk = cs_call_center_sk
+        and d_year = 1999
+      group by i_category, i_brand, cc_name, d_year, d_moy)
+    select v1.i_category, v1.i_brand, v1.cc_name, v1.d_year, v1.d_moy,
+           v1.avg_monthly_sales, v1.sum_sales
+    from v1
+    where v1.d_year = 1999
+      and v1.avg_monthly_sales > 0
+      and abs(v1.sum_sales - v1.avg_monthly_sales) /
+          v1.avg_monthly_sales > 0.1
+    order by v1.sum_sales - v1.avg_monthly_sales, v1.i_category,
+             v1.i_brand, v1.cc_name, v1.d_moy
+    limit 100"""
+
+QUERIES["q58"] = """
+    with ss_items as (
+      select i_item_id item_id, sum(ss_ext_sales_price) ss_item_rev
+      from store_sales, item, date_dim
+      where ss_item_sk = i_item_sk
+        and d_week_seq = (select d_week_seq from date_dim
+                          where d_year = 2000 and d_moy = 1
+                            and d_dom = 3)
+        and ss_sold_date_sk = d_date_sk
+      group by i_item_id),
+    cs_items as (
+      select i_item_id item_id, sum(cs_ext_sales_price) cs_item_rev
+      from catalog_sales, item, date_dim
+      where cs_item_sk = i_item_sk
+        and d_week_seq = (select d_week_seq from date_dim
+                          where d_year = 2000 and d_moy = 1
+                            and d_dom = 3)
+        and cs_sold_date_sk = d_date_sk
+      group by i_item_id),
+    ws_items as (
+      select i_item_id item_id, sum(ws_ext_sales_price) ws_item_rev
+      from web_sales, item, date_dim
+      where ws_item_sk = i_item_sk
+        and d_week_seq = (select d_week_seq from date_dim
+                          where d_year = 2000 and d_moy = 1
+                            and d_dom = 3)
+        and ws_sold_date_sk = d_date_sk
+      group by i_item_id)
+    select ss_items.item_id,
+           ss_item_rev,
+           ss_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3)
+             * 100 ss_dev,
+           cs_item_rev,
+           cs_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3)
+             * 100 cs_dev,
+           ws_item_rev,
+           ws_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3)
+             * 100 ws_dev,
+           (ss_item_rev + cs_item_rev + ws_item_rev) / 3 average
+    from ss_items, cs_items, ws_items
+    where ss_items.item_id = cs_items.item_id
+      and ss_items.item_id = ws_items.item_id
+      and ss_item_rev between 0.9 * cs_item_rev and 1.1 * cs_item_rev
+      and ss_item_rev between 0.9 * ws_item_rev and 1.1 * ws_item_rev
+      and cs_item_rev between 0.9 * ss_item_rev and 1.1 * ss_item_rev
+      and cs_item_rev between 0.9 * ws_item_rev and 1.1 * ws_item_rev
+      and ws_item_rev between 0.9 * ss_item_rev and 1.1 * ss_item_rev
+      and ws_item_rev between 0.9 * cs_item_rev and 1.1 * cs_item_rev
+    order by item_id, ss_item_rev
+    limit 100"""
+
+QUERIES["q59"] = """
+    with wss as (
+      select d_week_seq, ss_store_sk,
+             sum(case when d_day_name = 'Sunday' then ss_sales_price
+                      else null end) sun_sales,
+             sum(case when d_day_name = 'Monday' then ss_sales_price
+                      else null end) mon_sales,
+             sum(case when d_day_name = 'Tuesday' then ss_sales_price
+                      else null end) tue_sales,
+             sum(case when d_day_name = 'Wednesday' then ss_sales_price
+                      else null end) wed_sales,
+             sum(case when d_day_name = 'Thursday' then ss_sales_price
+                      else null end) thu_sales,
+             sum(case when d_day_name = 'Friday' then ss_sales_price
+                      else null end) fri_sales,
+             sum(case when d_day_name = 'Saturday' then ss_sales_price
+                      else null end) sat_sales
+      from store_sales, date_dim
+      where d_date_sk = ss_sold_date_sk
+      group by d_week_seq, ss_store_sk)
+    select s_store_name1, s_store_id1, d_week_seq1,
+           sun_sales1 / sun_sales2 r1, mon_sales1 / mon_sales2 r2,
+           tue_sales1 / tue_sales2 r3, wed_sales1 / wed_sales2 r4,
+           thu_sales1 / thu_sales2 r5, fri_sales1 / fri_sales2 r6,
+           sat_sales1 / sat_sales2 r7
+    from (select s_store_name s_store_name1, wss.d_week_seq d_week_seq1,
+                 s_store_id s_store_id1, sun_sales sun_sales1,
+                 mon_sales mon_sales1, tue_sales tue_sales1,
+                 wed_sales wed_sales1, thu_sales thu_sales1,
+                 fri_sales fri_sales1, sat_sales sat_sales1
+          from wss, store, date_dim d
+          where d.d_week_seq = wss.d_week_seq
+            and ss_store_sk = s_store_sk
+            and d_month_seq between 1200 and 1200 + 11) y,
+         (select s_store_name s_store_name2, wss.d_week_seq d_week_seq2,
+                 s_store_id s_store_id2, sun_sales sun_sales2,
+                 mon_sales mon_sales2, tue_sales tue_sales2,
+                 wed_sales wed_sales2, thu_sales thu_sales2,
+                 fri_sales fri_sales2, sat_sales sat_sales2
+          from wss, store, date_dim d
+          where d.d_week_seq = wss.d_week_seq
+            and ss_store_sk = s_store_sk
+            and d_month_seq between 1212 and 1212 + 11) x
+    where s_store_id1 = s_store_id2
+      and d_week_seq1 = d_week_seq2 - 52
+    order by s_store_name1, s_store_id1, d_week_seq1
+    limit 100"""
+
+QUERIES["q72"] = """
+    select i_item_desc, w_warehouse_name, d1.d_week_seq,
+           sum(case when p_promo_sk is null then 1 else 0 end) no_promo,
+           sum(case when p_promo_sk is not null then 1 else 0 end) promo,
+           count(*) total_cnt
+    from catalog_sales
+      join inventory on (cs_item_sk = inv_item_sk)
+      join warehouse on (w_warehouse_sk = inv_warehouse_sk)
+      join item on (i_item_sk = cs_item_sk)
+      join customer_demographics on (cs_bill_cdemo_sk = cd_demo_sk)
+      join household_demographics on (cs_bill_hdemo_sk = hd_demo_sk)
+      join date_dim d1 on (cs_sold_date_sk = d1.d_date_sk)
+      join date_dim d2 on (inv_date_sk = d2.d_date_sk)
+      join date_dim d3 on (cs_ship_date_sk = d3.d_date_sk)
+      left outer join promotion on (cs_promo_sk = p_promo_sk)
+    where d1.d_week_seq = d2.d_week_seq
+      and inv_quantity_on_hand < cs_quantity
+      and d3.d_date_sk > d1.d_date_sk + 3
+      and hd_buy_potential = '>10000'
+      and d1.d_year = 1999
+      and cd_marital_status = 'D'
+    group by i_item_desc, w_warehouse_name, d1.d_week_seq
+    order by total_cnt desc, i_item_desc, w_warehouse_name,
+             d1.d_week_seq
+    limit 100"""
+
+QUERIES["q74"] = """
+    with year_total as (
+      select c_customer_id customer_id, c_first_name customer_first_name,
+             c_last_name customer_last_name, d_year as year_,
+             sum(ss_net_paid) year_total, 's' sale_type
+      from customer, store_sales, date_dim
+      where c_customer_sk = ss_customer_sk
+        and ss_sold_date_sk = d_date_sk
+        and d_year in (1999, 2000)
+      group by c_customer_id, c_first_name, c_last_name, d_year
+      union all
+      select c_customer_id customer_id, c_first_name customer_first_name,
+             c_last_name customer_last_name, d_year as year_,
+             sum(ws_net_paid) year_total, 'w' sale_type
+      from customer, web_sales, date_dim
+      where c_customer_sk = ws_bill_customer_sk
+        and ws_sold_date_sk = d_date_sk
+        and d_year in (1999, 2000)
+      group by c_customer_id, c_first_name, c_last_name, d_year)
+    select t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+           t_s_secyear.customer_last_name
+    from year_total t_s_firstyear, year_total t_s_secyear,
+         year_total t_w_firstyear, year_total t_w_secyear
+    where t_s_secyear.customer_id = t_s_firstyear.customer_id
+      and t_s_firstyear.customer_id = t_w_secyear.customer_id
+      and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+      and t_s_firstyear.sale_type = 's'
+      and t_w_firstyear.sale_type = 'w'
+      and t_s_secyear.sale_type = 's'
+      and t_w_secyear.sale_type = 'w'
+      and t_s_firstyear.year_ = 1999
+      and t_s_secyear.year_ = 2000
+      and t_w_firstyear.year_ = 1999
+      and t_w_secyear.year_ = 2000
+      and t_s_firstyear.year_total > 0
+      and t_w_firstyear.year_total > 0
+      and case when t_w_firstyear.year_total > 0
+               then t_w_secyear.year_total / t_w_firstyear.year_total
+               else null end >
+          case when t_s_firstyear.year_total > 0
+               then t_s_secyear.year_total / t_s_firstyear.year_total
+               else null end
+    order by 1, 2, 3
+    limit 100"""
+
+QUERIES["q75"] = """
+    with all_sales as (
+      select d_year, i_brand_id, i_class_id, i_category_id,
+             i_manufact_id, sum(sales_cnt) sales_cnt,
+             sum(sales_amt) sales_amt
+      from (
+        select d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               cs_quantity - coalesce(cr_return_quantity, 0) sales_cnt,
+               cs_ext_sales_price -
+                 coalesce(cr_return_amount, 0.0) sales_amt
+        from catalog_sales
+          join item on i_item_sk = cs_item_sk
+          join date_dim on d_date_sk = cs_sold_date_sk
+          left join catalog_returns
+            on (cs_order_number = cr_order_number
+                and cs_item_sk = cr_item_sk)
+        where i_category = 'Books'
+        union all
+        select d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               ss_quantity - coalesce(sr_return_quantity, 0) sales_cnt,
+               ss_ext_sales_price -
+                 coalesce(sr_return_amt, 0.0) sales_amt
+        from store_sales
+          join item on i_item_sk = ss_item_sk
+          join date_dim on d_date_sk = ss_sold_date_sk
+          left join store_returns
+            on (ss_ticket_number = sr_ticket_number
+                and ss_item_sk = sr_item_sk)
+        where i_category = 'Books'
+        union all
+        select d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               ws_quantity - coalesce(wr_return_quantity, 0) sales_cnt,
+               ws_ext_sales_price -
+                 coalesce(wr_return_amt, 0.0) sales_amt
+        from web_sales
+          join item on i_item_sk = ws_item_sk
+          join date_dim on d_date_sk = ws_sold_date_sk
+          left join web_returns
+            on (ws_order_number = wr_order_number
+                and ws_item_sk = wr_item_sk)
+        where i_category = 'Books') sales_detail
+      group by d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id)
+    select prev_yr.d_year prev_year, curr_yr.d_year year_,
+           curr_yr.i_brand_id, curr_yr.i_class_id,
+           curr_yr.i_category_id, curr_yr.i_manufact_id,
+           prev_yr.sales_cnt prev_yr_cnt, curr_yr.sales_cnt curr_yr_cnt,
+           curr_yr.sales_cnt - prev_yr.sales_cnt sales_cnt_diff,
+           curr_yr.sales_amt - prev_yr.sales_amt sales_amt_diff
+    from all_sales curr_yr, all_sales prev_yr
+    where curr_yr.i_brand_id = prev_yr.i_brand_id
+      and curr_yr.i_class_id = prev_yr.i_class_id
+      and curr_yr.i_category_id = prev_yr.i_category_id
+      and curr_yr.i_manufact_id = prev_yr.i_manufact_id
+      and curr_yr.d_year = 2002 and prev_yr.d_year = 2001
+      and cast(curr_yr.sales_cnt as double) /
+          cast(prev_yr.sales_cnt as double) < 0.9
+    order by sales_cnt_diff, sales_amt_diff
+    limit 100"""
+
+QUERIES["q78"] = """
+    with ws as (
+      select d_year as ws_sold_year, ws_item_sk,
+             ws_bill_customer_sk ws_customer_sk,
+             sum(ws_quantity) ws_qty, sum(ws_wholesale_cost) ws_wc,
+             sum(ws_sales_price) ws_sp
+      from web_sales
+        left join web_returns on (wr_order_number = ws_order_number
+                                  and ws_item_sk = wr_item_sk)
+        join date_dim on ws_sold_date_sk = d_date_sk
+      where wr_order_number is null
+      group by d_year, ws_item_sk, ws_bill_customer_sk),
+    cs as (
+      select d_year as cs_sold_year, cs_item_sk,
+             cs_bill_customer_sk cs_customer_sk,
+             sum(cs_quantity) cs_qty, sum(cs_wholesale_cost) cs_wc,
+             sum(cs_sales_price) cs_sp
+      from catalog_sales
+        left join catalog_returns on (cr_order_number = cs_order_number
+                                      and cs_item_sk = cr_item_sk)
+        join date_dim on cs_sold_date_sk = d_date_sk
+      where cr_order_number is null
+      group by d_year, cs_item_sk, cs_bill_customer_sk),
+    ss as (
+      select d_year as ss_sold_year, ss_item_sk,
+             ss_customer_sk,
+             sum(ss_quantity) ss_qty, sum(ss_wholesale_cost) ss_wc,
+             sum(ss_sales_price) ss_sp
+      from store_sales
+        left join store_returns on (sr_ticket_number = ss_ticket_number
+                                    and ss_item_sk = sr_item_sk)
+        join date_dim on ss_sold_date_sk = d_date_sk
+      where sr_ticket_number is null
+      group by d_year, ss_item_sk, ss_customer_sk)
+    select ss_item_sk, round(ss_qty / (coalesce(ws_qty, 0) +
+           coalesce(cs_qty, 0)), 2) ratio,
+           ss_qty store_qty, ss_wc store_wholesale_cost,
+           ss_sp store_sales_price
+    from ss
+      left join ws on (ws_sold_year = ss_sold_year
+                       and ws_item_sk = ss_item_sk
+                       and ws_customer_sk = ss_customer_sk)
+      left join cs on (cs_sold_year = ss_sold_year
+                       and cs_item_sk = ss_item_sk
+                       and cs_customer_sk = ss_customer_sk)
+    where (coalesce(ws_qty, 0) > 0 or coalesce(cs_qty, 0) > 0)
+      and ss_sold_year = 2000
+    order by ss_item_sk, ss_qty desc, ss_wc desc, ss_sp desc
+    limit 100"""
+
+QUERIES["q83"] = """
+    with sr_items as (
+      select i_item_id item_id, sum(sr_return_quantity) sr_item_qty
+      from store_returns, item, date_dim
+      where sr_item_sk = i_item_sk
+        and d_date in (select d_date from date_dim
+                       where d_week_seq in
+                         (select d_week_seq from date_dim
+                          where d_year = 2000 and d_moy = 6
+                            and d_dom = 30))
+        and sr_returned_date_sk = d_date_sk
+      group by i_item_id),
+    cr_items as (
+      select i_item_id item_id, sum(cr_return_quantity) cr_item_qty
+      from catalog_returns, item, date_dim
+      where cr_item_sk = i_item_sk
+        and d_date in (select d_date from date_dim
+                       where d_week_seq in
+                         (select d_week_seq from date_dim
+                          where d_year = 2000 and d_moy = 6
+                            and d_dom = 30))
+        and cr_returned_date_sk = d_date_sk
+      group by i_item_id),
+    wr_items as (
+      select i_item_id item_id, sum(wr_return_quantity) wr_item_qty
+      from web_returns, item, date_dim
+      where wr_item_sk = i_item_sk
+        and d_date in (select d_date from date_dim
+                       where d_week_seq in
+                         (select d_week_seq from date_dim
+                          where d_year = 2000 and d_moy = 6
+                            and d_dom = 30))
+        and wr_returned_date_sk = d_date_sk
+      group by i_item_id)
+    select sr_items.item_id, sr_item_qty,
+           sr_item_qty / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0
+             * 100 sr_dev,
+           cr_item_qty,
+           cr_item_qty / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0
+             * 100 cr_dev,
+           wr_item_qty,
+           wr_item_qty / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0
+             * 100 wr_dev,
+           (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 average
+    from sr_items, cr_items, wr_items
+    where sr_items.item_id = cr_items.item_id
+      and sr_items.item_id = wr_items.item_id
+    order by sr_items.item_id, sr_item_qty
+    limit 100"""
+
+QUERIES["q85"] = """
+    select substring(r_reason_desc, 1, 20) reason,
+           avg(ws_quantity) aq, avg(wr_refunded_cash) arc,
+           avg(wr_fee) af
+    from web_sales, web_returns, web_page, customer_demographics cd1,
+         customer_demographics cd2, customer_address, date_dim, reason
+    where ws_web_page_sk = wp_web_page_sk
+      and ws_item_sk = wr_item_sk
+      and ws_order_number = wr_order_number
+      and ws_sold_date_sk = d_date_sk and d_year = 2000
+      and cd1.cd_demo_sk = wr_refunded_cdemo_sk
+      and cd2.cd_demo_sk = wr_returning_cdemo_sk
+      and ca_address_sk = wr_refunded_addr_sk
+      and r_reason_sk = wr_reason_sk
+      and ((cd1.cd_marital_status = 'M'
+            and cd1.cd_marital_status = cd2.cd_marital_status
+            and cd1.cd_education_status = 'Advanced Degree'
+            and cd1.cd_education_status = cd2.cd_education_status
+            and ws_sales_price between 100.00 and 150.00)
+        or (cd1.cd_marital_status = 'S'
+            and cd1.cd_marital_status = cd2.cd_marital_status
+            and cd1.cd_education_status = 'College'
+            and cd1.cd_education_status = cd2.cd_education_status
+            and ws_sales_price between 50.00 and 100.00)
+        or (cd1.cd_marital_status = 'W'
+            and cd1.cd_marital_status = cd2.cd_marital_status
+            and cd1.cd_education_status = '2 yr Degree'
+            and cd1.cd_education_status = cd2.cd_education_status
+            and ws_sales_price between 150.00 and 200.00))
+      and ((ca_country = 'United States'
+            and ca_state in ('IN', 'OH', 'NJ')
+            and ws_net_profit between 100 and 200)
+        or (ca_country = 'United States'
+            and ca_state in ('WI', 'CT', 'KY')
+            and ws_net_profit between 150 and 300)
+        or (ca_country = 'United States'
+            and ca_state in ('LA', 'IA', 'AR')
+            and ws_net_profit between 50 and 250))
+    group by r_reason_desc
+    order by reason, aq, arc, af
+    limit 100"""
+
+QUERIES["q95"] = """
+    with ws_wh as (
+      select ws1.ws_order_number won, ws1.ws_warehouse_sk wh1,
+             ws2.ws_warehouse_sk wh2
+      from web_sales ws1, web_sales ws2
+      where ws1.ws_order_number = ws2.ws_order_number
+        and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+    select count(distinct ws1.ws_order_number) as order_count,
+           sum(ws1.ws_ext_ship_cost) as total_shipping_cost,
+           sum(ws1.ws_net_profit) as total_net_profit
+    from web_sales ws1, date_dim, customer_address, web_site
+    where d_year = 1999 and d_moy between 2 and 3
+      and ws1.ws_ship_date_sk = d_date_sk
+      and ws1.ws_ship_addr_sk = ca_address_sk
+      and ca_state = 'CA'
+      and ws1.ws_web_site_sk = web_site_sk
+      and web_name = 'site_0'
+      and ws1.ws_order_number in (select won from ws_wh)
+      and ws1.ws_order_number in (select wr_order_number
+                                  from web_returns, ws_wh
+                                  where wr_order_number = ws_wh.won)
+    limit 100"""

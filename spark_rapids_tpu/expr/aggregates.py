@@ -405,25 +405,120 @@ class PivotFirst(AggregateFunction):
             else T.NULL
 
 
-class CollectList(AggregateFunction):
-    """collect_list — CPU-engine only for now (ArrayType output is not yet
+def _collect_update(plan, c):
+    """Device collect_list core: the group plan's stable key sort makes
+    each group's rows CONTIGUOUS in sorted order, so the list column is
+    just (compacted sorted values, per-group count offsets) — no
+    per-group loop, all static shapes.  Nulls drop (Spark collect_list
+    semantics); within-group order is input order (stable sort)."""
+    from ..columnar.column import ListColumn
+    from ..kernels.basic import compact_indices
+    cap = c.capacity
+    keep = jnp.take(c.validity, plan.perm) & plan.live_sorted
+    order2, _n = compact_indices(keep, cap)
+    take2 = jnp.take(plan.perm, order2)
+    elems = c.gather(take2).mask_validity(jnp.take(keep, order2))
+    cnt = jax.ops.segment_sum(keep.astype(jnp.int32), plan.seg_id,
+                              num_segments=cap)
+    ends = jnp.cumsum(cnt)
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               ends.astype(jnp.int32)])
+    valid = jnp.arange(cap) < plan.num_groups
+    return ListColumn(T.ArrayType(c.dtype), offsets, elems, valid)
 
-    device-resident; the planner falls back, reference-style)."""
+
+def _collect_merge(plan, b):
+    """Merge partial lists: gather partial rows into group-sorted order
+    (elements re-concatenate contiguously), then per-group offsets are
+    segment sums of row lengths."""
+    from ..columnar.column import ListColumn
+    cap = b.capacity
+    g = b.gather(plan.perm)          # contiguous rebuild, invalid len 0
+    mask = plan.live_sorted & jnp.take(b.validity, plan.perm)
+    lens = (g.offsets[1:] - g.offsets[:-1]).astype(jnp.int32)
+    lens = jnp.where(mask, lens, 0)
+    cnt = jax.ops.segment_sum(lens, plan.seg_id, num_segments=cap)
+    ends = jnp.cumsum(cnt)
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               ends.astype(jnp.int32)])
+    valid = jnp.arange(cap) < plan.num_groups
+    return ListColumn(b.dtype, offsets, g.elements, valid)
+
+
+class CollectList(AggregateFunction):
+    """collect_list on device (reference: GpuCollectList,
+    AggregateFunctions.scala) — the sort+segment plan gives group
+    contiguity for free, so lists assemble with pure gathers/cumsums
+    (strings included via StringColumn.gather; nested elements keep
+    the CPU engine)."""
 
     def dtype(self):
         return T.ArrayType(self.children[0].dtype())
 
     def update(self, plan, cols):
-        raise NotImplementedError("collect_list runs on the CPU engine")
+        return [_collect_update(plan, cols[0])]
 
-    merge = update
+    def merge(self, plan, buffers):
+        return [_collect_merge(plan, buffers[0])]
 
 
 class CollectSet(AggregateFunction):
+    """collect_set on device: collect_list plus per-group value dedupe
+    via canonical value words (fixed-width single-word elements; others
+    stay on the CPU engine)."""
+
     def dtype(self):
         return T.ArrayType(self.children[0].dtype())
 
-    def update(self, plan, cols):
-        raise NotImplementedError("collect_set runs on the CPU engine")
+    def _dedupe(self, plan, lst):
+        from ..columnar.column import ListColumn
+        from ..kernels import canon
+        cap = lst.capacity
+        ecap = lst.elements.capacity
+        # element -> group id via offsets
+        pos = jnp.arange(ecap)
+        grp = jnp.clip(
+            jnp.searchsorted(lst.offsets[1:cap + 1], pos, side="right"),
+            0, cap - 1).astype(jnp.int32)
+        live = pos < lst.offsets[cap]
+        words = canon.value_words(lst.elements, ecap)[0]
+        # VALUE equality, not ordering equality: the canonical order
+        # word conflates -0.0 with 0.0 (Spark total order), but
+        # collect_set's java-equality semantics keep them distinct, so
+        # a zero-sign word disambiguates for fractional elements
+        if lst.dtype.element_type.is_fractional:
+            zsign = (jnp.signbit(lst.elements.data) &
+                     (lst.elements.data == 0)).astype(jnp.uint64)
+        else:
+            zsign = jnp.zeros(ecap, jnp.uint64)
+        # sort by (live desc, group, value) then mark first-of-run
+        rank = jnp.where(live, jnp.uint64(0), jnp.uint64(1))
+        _, _, _, _, perm = jax.lax.sort(
+            (rank, grp.astype(jnp.uint64), words, zsign,
+             pos.astype(jnp.int32)), num_keys=4, is_stable=True)
+        sg = jnp.take(grp, perm)
+        sw = jnp.take(words, perm)
+        sz = jnp.take(zsign, perm)
+        slive = jnp.take(live, perm)
+        first = jnp.concatenate([
+            jnp.ones(1, bool),
+            (sg[1:] != sg[:-1]) | (sw[1:] != sw[:-1]) |
+            (sz[1:] != sz[:-1])]) & slive
+        # compact kept elements
+        from ..kernels.basic import compact_indices
+        korder, _n = compact_indices(first, first.shape[0])
+        ktake = jnp.take(perm, korder)
+        elems = lst.elements.gather(ktake).mask_validity(
+            jnp.take(first, korder))
+        cnt = jax.ops.segment_sum(first.astype(jnp.int32), sg,
+                                  num_segments=cap)
+        ends = jnp.cumsum(cnt)
+        offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                   ends.astype(jnp.int32)])
+        return ListColumn(lst.dtype, offsets, elems, lst.validity)
 
-    merge = update
+    def update(self, plan, cols):
+        return [self._dedupe(plan, _collect_update(plan, cols[0]))]
+
+    def merge(self, plan, buffers):
+        return [self._dedupe(plan, _collect_merge(plan, buffers[0]))]

@@ -168,6 +168,15 @@ for _cls in [eagg.Sum, eagg.Count, eagg.Min, eagg.Max, eagg.Average,
              eagg.First, eagg.Last, eagg.StddevSamp, eagg.StddevPop,
              eagg.VarianceSamp, eagg.VariancePop, eagg.PivotFirst]:
     expr_rule(_cls, TS.ALL_SUPPORTED)
+# device collect: lists assemble from the sort+segment plan; set dedupe
+# needs single-word value encoding, so string elements stay on CPU
+expr_rule(eagg.CollectList, TS.ExprSig(
+    [TS.ParamSig("input", TS.ALL_SUPPORTED)], TS.WITH_ARRAYS))
+expr_rule(eagg.CollectSet, TS.ExprSig(
+    [TS.ParamSig("input", TS.BOOLEAN + TS.NUMERIC + TS.DATETIME +
+                 TS.DECIMAL_64,
+                 note="string elements run on the CPU engine")],
+    TS.WITH_ARRAYS))
 # collection expressions (collectionOperations.scala registrations,
 # GpuOverrides.scala:773+)
 from ..expr import collections as ecoll  # noqa: E402

@@ -239,3 +239,93 @@ class TestMaps:
         assert_tpu_and_cpu_are_equal_collect(
             lambda s: _struct_df(s).select(
                 "a", F.explode(F.map_keys("mp")).alias("k")))
+
+
+class TestDeviceCollect:
+    """collect_list/collect_set on DEVICE (reference GpuCollectList/
+    GpuCollectSet): lists assemble from the sort+segment plan's group
+    contiguity; set dedupes via canonical value words.  Multi-partition
+    plans shuffle LIST buffer batches between partial and final."""
+
+    def _q(self, s, parts):
+        import numpy as np
+        from spark_rapids_tpu.api import functions as F
+        rng = np.random.default_rng(5)
+        df = s.create_dataframe({
+            "k": rng.integers(0, 8, 300).astype(np.int64),
+            "v": rng.integers(0, 6, 300).astype(np.int64)},
+            num_partitions=parts)
+        return (df.group_by("k")
+                  .agg(F.collect_list("v").alias("cl"),
+                       F.collect_set("v").alias("cs"),
+                       F.count().alias("c")))
+
+    def _check(self, parts):
+        from harness import with_cpu_session, with_tpu_session
+        cpu = {r[0]: r for r in with_cpu_session(
+            lambda s: self._q(s, parts).collect())}
+        tpu = {r[0]: r for r in with_tpu_session(
+            lambda s: self._q(s, parts).collect())}
+        assert set(cpu) == set(tpu)
+        for k in cpu:
+            assert sorted(cpu[k][1]) == sorted(tpu[k][1])
+            assert sorted(cpu[k][2]) == sorted(tpu[k][2])
+            assert cpu[k][3] == tpu[k][3]
+
+    def test_single_partition(self):
+        self._check(1)
+
+    def test_multi_partition_through_shuffle(self):
+        self._check(3)
+
+    def test_stays_on_device(self):
+        from spark_rapids_tpu.api import TpuSession
+        from spark_rapids_tpu.config import TpuConf
+        s = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": True}))
+        df = self._q(s, 2)
+        df.collect()
+        tree = df._last_physical_plan.tree_string()
+        assert "TpuHashAggregate" in tree and "Cpu" not in tree, tree
+
+    def test_collect_list_preserves_input_order(self):
+        import numpy as np
+        from harness import with_tpu_session
+        from spark_rapids_tpu.api import functions as F
+
+        def q(s):
+            df = s.create_dataframe({
+                "k": np.array([1, 1, 1, 2], np.int64),
+                "v": np.array([30, 10, 20, 5], np.int64)})
+            return df.group_by("k").agg(F.collect_list("v").alias("l"))
+        rows = {r[0]: r[1] for r in with_tpu_session(
+            lambda s: q(s).collect())}
+        assert rows[1] == [30, 10, 20]
+        assert rows[2] == [5]
+
+    def test_collect_with_nulls_dropped(self):
+        import pyarrow as pa
+        from harness import with_tpu_session
+        from spark_rapids_tpu.api import functions as F
+
+        def q(s):
+            df = s.create_dataframe(pa.table({
+                "k": pa.array([1, 1, 1], pa.int64()),
+                "v": pa.array([7, None, 7], pa.int64())}))
+            return df.group_by("k").agg(
+                F.collect_list("v").alias("l"),
+                F.collect_set("v").alias("st"))
+        rows = with_tpu_session(lambda s: q(s).collect())
+        assert rows[0][1] == [7, 7]
+        assert rows[0][2] == [7]
+
+    def test_collect_set_strings_fall_back(self):
+        from harness import with_tpu_session
+        from spark_rapids_tpu.api import TpuSession, functions as F
+        from spark_rapids_tpu.config import TpuConf
+        s = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": True}))
+        df = s.create_dataframe({"k": [1, 1], "v": ["a", "a"]})
+        out = df.group_by("k").agg(F.collect_set("v").alias("st"))
+        text = s.explain(out._plan)
+        assert "Cpu" in text
+        rows = out.collect()
+        assert rows[0][1] == ["a"]
