@@ -73,10 +73,13 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "d_day_name": day_names[dow],
         "d_month_seq": month_seq.astype(np.int32),
         "d_week_seq": week_seq.astype(np.int32),
+        "d_quarter_name": np.array(
+            [f"{y}Q{q}" for y, q in zip(years, qoy)]),
     }))
 
     write("time_dim", pa.table({
         "t_time_sk": np.arange(86400, dtype=np.int64),
+        "t_time": np.arange(86400, dtype=np.int64),
         "t_hour": (np.arange(86400) // 3600).astype(np.int32),
         "t_minute": ((np.arange(86400) % 3600) // 60).astype(np.int32),
     }))
@@ -124,6 +127,7 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "s_number_employees": rng.integers(200, 300, ns).astype(np.int32),
         "s_company_id": np.ones(ns, dtype=np.int32),
         "s_gmt_offset": np.full(ns, -5.0),
+        "s_market_id": rng.integers(1, 11, ns).astype(np.int32),
     }))
 
     nc = n["customer"]
@@ -151,6 +155,11 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "c_preferred_cust_flag": rng.choice(["Y", "N"], nc),
         "c_email_address": np.array(
             [f"c{i}@example.com" for i in range(nc)]),
+        "c_login": np.array([f"login{i}" for i in range(nc)]),
+        "c_first_sales_date_sk": (DATE_SK0 + rng.integers(
+            0, N_DATES, nc)).astype(np.int64),
+        "c_first_shipto_date_sk": (DATE_SK0 + rng.integers(
+            0, N_DATES, nc)).astype(np.int64),
     }))
 
     na = n["customer_address"]
@@ -169,6 +178,10 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "ca_gmt_offset": rng.choice([-5.0, -6.0, -7.0, -8.0], na),
         "ca_location_type": rng.choice(
             ["apartment", "condo", "single family"], na),
+        "ca_street_number": np.array(
+            [f"{rng.integers(1, 1000)}" for _ in range(na)]),
+        "ca_street_name": rng.choice(
+            ["Main", "Oak", "Elm", "Park", "First", "Second"], na),
     }))
 
     nd = n["customer_demographics"]
@@ -184,6 +197,8 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         .astype(np.int32),
         "cd_credit_rating": rng.choice(
             ["Low Risk", "Good", "High Risk", "Unknown"], nd),
+        "cd_dep_employed_count": rng.integers(0, 7, nd).astype(np.int32),
+        "cd_dep_college_count": rng.integers(0, 7, nd).astype(np.int32),
     }))
 
     nh = n["household_demographics"]
@@ -220,6 +235,9 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         .astype(np.int32),
         "w_state": np.array(["TN", "SD", "AL", "GA", "CA"]),
         "w_country": np.full(5, "United States"),
+        "w_city": np.array(["Midway", "Fairview", "Oakland",
+                            "Springfield", "Salem"]),
+        "w_county": np.full(5, "Williamson County"),
     }))
 
     write("ship_mode", pa.table({
@@ -253,6 +271,7 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "web_site_sk": np.arange(30, dtype=np.int64),
         "web_site_id": np.array([f"AAAAAAAA{i:04d}" for i in range(30)]),
         "web_name": np.array([f"site_{i}" for i in range(30)]),
+        "web_company_name": rng.choice(["pri", "ought", "able"], 30),
     }))
 
     write("web_page", pa.table({
@@ -302,12 +321,14 @@ def generate(data_dir: str, scale: float, seed: int = 0):
     write("catalog_sales", pa.table({
         "cs_sold_date_sk": (DATE_SK0 + rng.integers(
             0, N_DATES, ncs)).astype(np.int64),
+        "cs_sold_time_sk": rng.integers(0, 86400, ncs).astype(np.int64),
         "cs_ship_date_sk": (DATE_SK0 + rng.integers(
             0, N_DATES, ncs)).astype(np.int64),
         "cs_bill_customer_sk": rng.integers(0, nc, ncs).astype(np.int64),
         "cs_bill_cdemo_sk": rng.integers(0, nd, ncs).astype(np.int64),
         "cs_bill_hdemo_sk": rng.integers(0, nh, ncs).astype(np.int64),
         "cs_bill_addr_sk": rng.integers(0, na, ncs).astype(np.int64),
+        "cs_ship_addr_sk": rng.integers(0, na, ncs).astype(np.int64),
         "cs_ship_mode_sk": rng.integers(0, 20, ncs).astype(np.int64),
         "cs_call_center_sk": rng.integers(0, 6, ncs).astype(np.int64),
         "cs_catalog_page_sk": rng.integers(
@@ -411,6 +432,9 @@ def generate(data_dir: str, scale: float, seed: int = 0):
         "cr_return_amount": cramt,
         "cr_return_amt_inc_tax": (cramt * 1.08).round(2),
         "cr_net_loss": (rng.random(ncr) * 60).round(2),
+        "cr_refunded_cash": (cramt * 0.8).round(2),
+        "cr_reversed_charge": (cramt * 0.1).round(2),
+        "cr_store_credit": (cramt * 0.1).round(2),
     }))
 
     nwr = n["web_returns"]

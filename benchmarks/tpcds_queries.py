@@ -1809,3 +1809,1328 @@ QUERIES["q95"] = """
                                   from web_returns, ws_wh
                                   where wr_order_number = ws_wh.won)
     limit 100"""
+
+# --------------------------------------------------------------------------
+# round-4 additions: the 24 hardest plan shapes (multi-level CTE chains,
+# INTERSECT-in-CTE, rollup+window, full-outer over windows, NOT-EXISTS
+# pairs, the giant q64 multi-join).  Reference surface:
+# integration_tests qa_nightly_select_test + official tpcds query dir.
+# --------------------------------------------------------------------------
+
+QUERIES["q2"] = """
+    with wscs as (
+      select ws_sold_date_sk sold_date_sk,
+             ws_ext_sales_price sales_price
+      from web_sales
+      union all
+      select cs_sold_date_sk sold_date_sk,
+             cs_ext_sales_price sales_price
+      from catalog_sales),
+    wswscs as (
+      select d_week_seq,
+             sum(case when (d_day_name = 'Sunday')
+                 then sales_price else null end) sun_sales,
+             sum(case when (d_day_name = 'Monday')
+                 then sales_price else null end) mon_sales,
+             sum(case when (d_day_name = 'Tuesday')
+                 then sales_price else null end) tue_sales,
+             sum(case when (d_day_name = 'Wednesday')
+                 then sales_price else null end) wed_sales,
+             sum(case when (d_day_name = 'Thursday')
+                 then sales_price else null end) thu_sales,
+             sum(case when (d_day_name = 'Friday')
+                 then sales_price else null end) fri_sales,
+             sum(case when (d_day_name = 'Saturday')
+                 then sales_price else null end) sat_sales
+      from wscs, date_dim
+      where d_date_sk = sold_date_sk
+      group by d_week_seq)
+    select d_week_seq1,
+           round(sun_sales1 / sun_sales2, 2),
+           round(mon_sales1 / mon_sales2, 2),
+           round(tue_sales1 / tue_sales2, 2),
+           round(wed_sales1 / wed_sales2, 2),
+           round(thu_sales1 / thu_sales2, 2),
+           round(fri_sales1 / fri_sales2, 2),
+           round(sat_sales1 / sat_sales2, 2)
+    from (select wswscs.d_week_seq d_week_seq1, sun_sales sun_sales1,
+                 mon_sales mon_sales1, tue_sales tue_sales1,
+                 wed_sales wed_sales1, thu_sales thu_sales1,
+                 fri_sales fri_sales1, sat_sales sat_sales1
+          from wswscs, date_dim
+          where date_dim.d_week_seq = wswscs.d_week_seq
+            and d_year = 2000) y,
+         (select wswscs.d_week_seq d_week_seq2, sun_sales sun_sales2,
+                 mon_sales mon_sales2, tue_sales tue_sales2,
+                 wed_sales wed_sales2, thu_sales thu_sales2,
+                 fri_sales fri_sales2, sat_sales sat_sales2
+          from wswscs, date_dim
+          where date_dim.d_week_seq = wswscs.d_week_seq
+            and d_year = 2000 + 1) z
+    where d_week_seq1 = d_week_seq2 - 53
+    order by d_week_seq1"""
+
+QUERIES["q4"] = """
+    with year_total as (
+      select c_customer_id customer_id, c_first_name customer_first_name,
+             c_last_name customer_last_name,
+             c_preferred_cust_flag customer_preferred_cust_flag,
+             c_birth_country customer_birth_country,
+             c_login customer_login,
+             c_email_address customer_email_address,
+             d_year dyear,
+             sum(((ss_ext_list_price - ss_ext_wholesale_cost
+                   - ss_ext_discount_amt) + ss_ext_sales_price) / 2)
+               year_total,
+             's' sale_type
+      from customer, store_sales, date_dim
+      where c_customer_sk = ss_customer_sk
+        and ss_sold_date_sk = d_date_sk
+      group by c_customer_id, c_first_name, c_last_name,
+               c_preferred_cust_flag, c_birth_country, c_login,
+               c_email_address, d_year
+      union all
+      select c_customer_id customer_id, c_first_name customer_first_name,
+             c_last_name customer_last_name,
+             c_preferred_cust_flag customer_preferred_cust_flag,
+             c_birth_country customer_birth_country,
+             c_login customer_login,
+             c_email_address customer_email_address,
+             d_year dyear,
+             sum((((cs_ext_list_price - cs_ext_wholesale_cost
+                    - cs_ext_discount_amt) + cs_ext_sales_price) / 2))
+               year_total,
+             'c' sale_type
+      from customer, catalog_sales, date_dim
+      where c_customer_sk = cs_bill_customer_sk
+        and cs_sold_date_sk = d_date_sk
+      group by c_customer_id, c_first_name, c_last_name,
+               c_preferred_cust_flag, c_birth_country, c_login,
+               c_email_address, d_year
+      union all
+      select c_customer_id customer_id, c_first_name customer_first_name,
+             c_last_name customer_last_name,
+             c_preferred_cust_flag customer_preferred_cust_flag,
+             c_birth_country customer_birth_country,
+             c_login customer_login,
+             c_email_address customer_email_address,
+             d_year dyear,
+             sum((((ws_ext_list_price - ws_ext_wholesale_cost
+                    - ws_ext_discount_amt) + ws_ext_sales_price) / 2))
+               year_total,
+             'w' sale_type
+      from customer, web_sales, date_dim
+      where c_customer_sk = ws_bill_customer_sk
+        and ws_sold_date_sk = d_date_sk
+      group by c_customer_id, c_first_name, c_last_name,
+               c_preferred_cust_flag, c_birth_country, c_login,
+               c_email_address, d_year)
+    select t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+           t_s_secyear.customer_last_name,
+           t_s_secyear.customer_preferred_cust_flag
+    from year_total t_s_firstyear, year_total t_s_secyear,
+         year_total t_c_firstyear, year_total t_c_secyear,
+         year_total t_w_firstyear, year_total t_w_secyear
+    where t_s_secyear.customer_id = t_s_firstyear.customer_id
+      and t_s_firstyear.customer_id = t_c_secyear.customer_id
+      and t_s_firstyear.customer_id = t_c_firstyear.customer_id
+      and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+      and t_s_firstyear.customer_id = t_w_secyear.customer_id
+      and t_s_firstyear.sale_type = 's'
+      and t_c_firstyear.sale_type = 'c'
+      and t_w_firstyear.sale_type = 'w'
+      and t_s_secyear.sale_type = 's'
+      and t_c_secyear.sale_type = 'c'
+      and t_w_secyear.sale_type = 'w'
+      and t_s_firstyear.dyear = 2001
+      and t_s_secyear.dyear = 2001 + 1
+      and t_c_firstyear.dyear = 2001
+      and t_c_secyear.dyear = 2001 + 1
+      and t_w_firstyear.dyear = 2001
+      and t_w_secyear.dyear = 2001 + 1
+      and t_s_firstyear.year_total > 0
+      and t_c_firstyear.year_total > 0
+      and t_w_firstyear.year_total > 0
+      and case when t_c_firstyear.year_total > 0
+          then t_c_secyear.year_total / t_c_firstyear.year_total
+          else null end
+        > case when t_s_firstyear.year_total > 0
+          then t_s_secyear.year_total / t_s_firstyear.year_total
+          else null end
+      and case when t_c_firstyear.year_total > 0
+          then t_c_secyear.year_total / t_c_firstyear.year_total
+          else null end
+        > case when t_w_firstyear.year_total > 0
+          then t_w_secyear.year_total / t_w_firstyear.year_total
+          else null end
+    order by t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+             t_s_secyear.customer_last_name,
+             t_s_secyear.customer_preferred_cust_flag
+    limit 100"""
+
+QUERIES["q5"] = """
+    with ssr as (
+      select s_store_id,
+             sum(sales_price) as sales,
+             sum(profit) as profit,
+             sum(return_amt) as returns_amt,
+             sum(net_loss) as profit_loss
+      from (select ss_store_sk as store_sk,
+                   ss_sold_date_sk as date_sk,
+                   ss_ext_sales_price as sales_price,
+                   ss_net_profit as profit,
+                   cast(0 as double) as return_amt,
+                   cast(0 as double) as net_loss
+            from store_sales
+            union all
+            select sr_store_sk as store_sk,
+                   sr_returned_date_sk as date_sk,
+                   cast(0 as double) as sales_price,
+                   cast(0 as double) as profit,
+                   sr_return_amt as return_amt,
+                   sr_net_loss as net_loss
+            from store_returns) salesreturns, date_dim, store
+      where date_sk = d_date_sk
+        and d_date between date '2000-08-23'
+                       and date '2000-08-23' + interval 14 days
+        and store_sk = s_store_sk
+      group by s_store_id),
+    csr as (
+      select cp_catalog_page_id,
+             sum(sales_price) as sales,
+             sum(profit) as profit,
+             sum(return_amt) as returns_amt,
+             sum(net_loss) as profit_loss
+      from (select cs_catalog_page_sk as page_sk,
+                   cs_sold_date_sk as date_sk,
+                   cs_ext_sales_price as sales_price,
+                   cs_net_profit as profit,
+                   cast(0 as double) as return_amt,
+                   cast(0 as double) as net_loss
+            from catalog_sales
+            union all
+            select cr_catalog_page_sk as page_sk,
+                   cr_returned_date_sk as date_sk,
+                   cast(0 as double) as sales_price,
+                   cast(0 as double) as profit,
+                   cr_return_amount as return_amt,
+                   cr_net_loss as net_loss
+            from catalog_returns) salesreturns, date_dim, catalog_page
+      where date_sk = d_date_sk
+        and d_date between date '2000-08-23'
+                       and date '2000-08-23' + interval 14 days
+        and page_sk = cp_catalog_page_sk
+      group by cp_catalog_page_id),
+    wsr as (
+      select web_site_id,
+             sum(sales_price) as sales,
+             sum(profit) as profit,
+             sum(return_amt) as returns_amt,
+             sum(net_loss) as profit_loss
+      from (select ws_web_site_sk as wsr_web_site_sk,
+                   ws_sold_date_sk as date_sk,
+                   ws_ext_sales_price as sales_price,
+                   ws_net_profit as profit,
+                   cast(0 as double) as return_amt,
+                   cast(0 as double) as net_loss
+            from web_sales
+            union all
+            select ws_web_site_sk as wsr_web_site_sk,
+                   wr_returned_date_sk as date_sk,
+                   cast(0 as double) as sales_price,
+                   cast(0 as double) as profit,
+                   wr_return_amt as return_amt,
+                   wr_net_loss as net_loss
+            from web_returns
+            left outer join web_sales
+              on (wr_item_sk = ws_item_sk
+                  and wr_order_number = ws_order_number))
+           salesreturns, date_dim, web_site
+      where date_sk = d_date_sk
+        and d_date between date '2000-08-23'
+                       and date '2000-08-23' + interval 14 days
+        and wsr_web_site_sk = web_site_sk
+      group by web_site_id)
+    select channel, id, sum(sales) as sales,
+           sum(returns_amt) as returns_amt, sum(profit) as profit
+    from (select 'store channel' as channel,
+                 'store' || s_store_id as id,
+                 sales, returns_amt, profit - profit_loss as profit
+          from ssr
+          union all
+          select 'catalog channel' as channel,
+                 'catalog_page' || cp_catalog_page_id as id,
+                 sales, returns_amt, profit - profit_loss as profit
+          from csr
+          union all
+          select 'web channel' as channel,
+                 'web_site' || web_site_id as id,
+                 sales, returns_amt, profit - profit_loss as profit
+          from wsr) x
+    group by rollup(channel, id)
+    order by channel, id
+    limit 100"""
+
+QUERIES["q8"] = """
+    select s_store_name, sum(ss_net_profit)
+    from store_sales, date_dim, store,
+         (select ca_zip from (
+            select substring(ca_zip, 1, 5) ca_zip
+            from customer_address
+            where substring(ca_zip, 1, 2) in
+              ('24', '35', '46', '57', '68', '79', '80', '91', '12',
+               '23', '34', '45', '56', '67', '78', '89', '90', '10')
+            intersect
+            select ca_zip from (
+              select substring(ca_zip, 1, 5) ca_zip, count(*) cnt
+              from customer_address, customer
+              where ca_address_sk = c_current_addr_sk
+                and c_preferred_cust_flag = 'Y'
+              group by ca_zip
+              having count(*) > 1) a1) a2) v1
+    where ss_store_sk = s_store_sk
+      and ss_sold_date_sk = d_date_sk
+      and d_qoy = 2 and d_year = 1998
+      and substring(s_zip, 1, 2) = substring(v1.ca_zip, 1, 2)
+    group by s_store_name
+    order by s_store_name
+    limit 100"""
+
+QUERIES["q10"] = """
+    select cd_gender, cd_marital_status, cd_education_status,
+           count(*) cnt1, cd_purchase_estimate, count(*) cnt2,
+           cd_credit_rating, count(*) cnt3, cd_dep_count, count(*) cnt4,
+           cd_dep_employed_count, count(*) cnt5,
+           cd_dep_college_count, count(*) cnt6
+    from customer c, customer_address ca, customer_demographics
+    where c.c_current_addr_sk = ca.ca_address_sk
+      and ca_county in ('Williamson County', 'Ziebach County',
+                        'Walker County', 'Rush County')
+      and cd_demo_sk = c.c_current_cdemo_sk
+      and exists (select * from store_sales, date_dim
+                  where c.c_customer_sk = ss_customer_sk
+                    and ss_sold_date_sk = d_date_sk
+                    and d_year = 2002 and d_moy between 1 and 1 + 3)
+      and (exists (select * from web_sales, date_dim
+                   where c.c_customer_sk = ws_bill_customer_sk
+                     and ws_sold_date_sk = d_date_sk
+                     and d_year = 2002 and d_moy between 1 and 1 + 3)
+           or exists (select * from catalog_sales, date_dim
+                      where c.c_customer_sk = cs_bill_customer_sk
+                        and cs_sold_date_sk = d_date_sk
+                        and d_year = 2002 and d_moy between 1 and 1 + 3))
+    group by cd_gender, cd_marital_status, cd_education_status,
+             cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+             cd_dep_employed_count, cd_dep_college_count
+    order by cd_gender, cd_marital_status, cd_education_status,
+             cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+             cd_dep_employed_count, cd_dep_college_count
+    limit 100"""
+
+QUERIES["q11"] = """
+    with year_total as (
+      select c_customer_id customer_id, c_first_name customer_first_name,
+             c_last_name customer_last_name,
+             c_preferred_cust_flag customer_preferred_cust_flag,
+             c_birth_country customer_birth_country,
+             c_login customer_login,
+             c_email_address customer_email_address,
+             d_year dyear,
+             sum(ss_ext_list_price - ss_ext_discount_amt) year_total,
+             's' sale_type
+      from customer, store_sales, date_dim
+      where c_customer_sk = ss_customer_sk
+        and ss_sold_date_sk = d_date_sk
+      group by c_customer_id, c_first_name, c_last_name,
+               c_preferred_cust_flag, c_birth_country, c_login,
+               c_email_address, d_year
+      union all
+      select c_customer_id customer_id, c_first_name customer_first_name,
+             c_last_name customer_last_name,
+             c_preferred_cust_flag customer_preferred_cust_flag,
+             c_birth_country customer_birth_country,
+             c_login customer_login,
+             c_email_address customer_email_address,
+             d_year dyear,
+             sum(ws_ext_list_price - ws_ext_discount_amt) year_total,
+             'w' sale_type
+      from customer, web_sales, date_dim
+      where c_customer_sk = ws_bill_customer_sk
+        and ws_sold_date_sk = d_date_sk
+      group by c_customer_id, c_first_name, c_last_name,
+               c_preferred_cust_flag, c_birth_country, c_login,
+               c_email_address, d_year)
+    select t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+           t_s_secyear.customer_last_name,
+           t_s_secyear.customer_preferred_cust_flag
+    from year_total t_s_firstyear, year_total t_s_secyear,
+         year_total t_w_firstyear, year_total t_w_secyear
+    where t_s_secyear.customer_id = t_s_firstyear.customer_id
+      and t_s_firstyear.customer_id = t_w_secyear.customer_id
+      and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+      and t_s_firstyear.sale_type = 's'
+      and t_w_firstyear.sale_type = 'w'
+      and t_s_secyear.sale_type = 's'
+      and t_w_secyear.sale_type = 'w'
+      and t_s_firstyear.dyear = 2001
+      and t_s_secyear.dyear = 2001 + 1
+      and t_w_firstyear.dyear = 2001
+      and t_w_secyear.dyear = 2001 + 1
+      and t_s_firstyear.year_total > 0
+      and t_w_firstyear.year_total > 0
+      and case when t_w_firstyear.year_total > 0
+          then t_w_secyear.year_total / t_w_firstyear.year_total
+          else 0.0 end
+        > case when t_s_firstyear.year_total > 0
+          then t_s_secyear.year_total / t_s_firstyear.year_total
+          else 0.0 end
+    order by t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+             t_s_secyear.customer_last_name,
+             t_s_secyear.customer_preferred_cust_flag
+    limit 100"""
+
+QUERIES["q14"] = """
+    with cross_items as (
+      select i_item_sk ss_item_sk
+      from item,
+        (select iss.i_brand_id brand_id, iss.i_class_id class_id,
+                iss.i_category_id category_id
+         from store_sales, item iss, date_dim d1
+         where ss_item_sk = iss.i_item_sk
+           and ss_sold_date_sk = d1.d_date_sk
+           and d1.d_year between 1999 and 1999 + 2
+         intersect
+         select ics.i_brand_id, ics.i_class_id, ics.i_category_id
+         from catalog_sales, item ics, date_dim d2
+         where cs_item_sk = ics.i_item_sk
+           and cs_sold_date_sk = d2.d_date_sk
+           and d2.d_year between 1999 and 1999 + 2
+         intersect
+         select iws.i_brand_id, iws.i_class_id, iws.i_category_id
+         from web_sales, item iws, date_dim d3
+         where ws_item_sk = iws.i_item_sk
+           and ws_sold_date_sk = d3.d_date_sk
+           and d3.d_year between 1999 and 1999 + 2) x
+      where i_brand_id = brand_id
+        and i_class_id = class_id
+        and i_category_id = category_id),
+    avg_sales as (
+      select avg(quantity * list_price) average_sales
+      from (select ss_quantity quantity, ss_list_price list_price
+            from store_sales, date_dim
+            where ss_sold_date_sk = d_date_sk
+              and d_year between 1999 and 1999 + 2
+            union all
+            select cs_quantity quantity, cs_list_price list_price
+            from catalog_sales, date_dim
+            where cs_sold_date_sk = d_date_sk
+              and d_year between 1999 and 1999 + 2
+            union all
+            select ws_quantity quantity, ws_list_price list_price
+            from web_sales, date_dim
+            where ws_sold_date_sk = d_date_sk
+              and d_year between 1999 and 1999 + 2) x)
+    select channel, i_brand_id, i_class_id, i_category_id,
+           sum(sales), sum(number_sales)
+    from (select 'store' channel, i_brand_id, i_class_id, i_category_id,
+                 sum(ss_quantity * ss_list_price) sales,
+                 count(*) number_sales
+          from store_sales, item, date_dim
+          where ss_item_sk in (select ss_item_sk from cross_items)
+            and ss_item_sk = i_item_sk
+            and ss_sold_date_sk = d_date_sk
+            and d_year = 1999 + 2 and d_moy = 11
+          group by i_brand_id, i_class_id, i_category_id
+          having sum(ss_quantity * ss_list_price) >
+                 (select average_sales from avg_sales)
+          union all
+          select 'catalog' channel, i_brand_id, i_class_id,
+                 i_category_id,
+                 sum(cs_quantity * cs_list_price) sales,
+                 count(*) number_sales
+          from catalog_sales, item, date_dim
+          where cs_item_sk in (select ss_item_sk from cross_items)
+            and cs_item_sk = i_item_sk
+            and cs_sold_date_sk = d_date_sk
+            and d_year = 1999 + 2 and d_moy = 11
+          group by i_brand_id, i_class_id, i_category_id
+          having sum(cs_quantity * cs_list_price) >
+                 (select average_sales from avg_sales)
+          union all
+          select 'web' channel, i_brand_id, i_class_id, i_category_id,
+                 sum(ws_quantity * ws_list_price) sales,
+                 count(*) number_sales
+          from web_sales, item, date_dim
+          where ws_item_sk in (select ss_item_sk from cross_items)
+            and ws_item_sk = i_item_sk
+            and ws_sold_date_sk = d_date_sk
+            and d_year = 1999 + 2 and d_moy = 11
+          group by i_brand_id, i_class_id, i_category_id
+          having sum(ws_quantity * ws_list_price) >
+                 (select average_sales from avg_sales)) y
+    group by rollup(channel, i_brand_id, i_class_id, i_category_id)
+    order by channel, i_brand_id, i_class_id, i_category_id
+    limit 100"""
+
+QUERIES["q16"] = """
+    select count(distinct cs_order_number) as order_count,
+           sum(cs_ext_ship_cost) as total_shipping_cost,
+           sum(cs_net_profit) as total_net_profit
+    from catalog_sales cs1, date_dim, customer_address, call_center
+    where d_date between date '2002-02-01'
+                     and date '2002-02-01' + interval 60 days
+      and cs1.cs_ship_date_sk = d_date_sk
+      and cs1.cs_ship_addr_sk = ca_address_sk
+      and ca_state = 'GA'
+      and cs1.cs_call_center_sk = cc_call_center_sk
+      and cc_county in ('Williamson County')
+      and exists (select * from catalog_sales cs2
+                  where cs1.cs_order_number = cs2.cs_order_number
+                    and cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+      and not exists (select * from catalog_returns cr1
+                      where cs1.cs_order_number = cr1.cr_order_number)
+    order by count(distinct cs_order_number)
+    limit 100"""
+
+QUERIES["q17"] = """
+    select i_item_id, i_item_desc, s_state,
+           count(ss_quantity) as store_sales_quantitycount,
+           avg(ss_quantity) as store_sales_quantityave,
+           stddev_samp(ss_quantity) as store_sales_quantitystdev,
+           stddev_samp(ss_quantity) / avg(ss_quantity)
+             as store_sales_quantitycov,
+           count(sr_return_quantity) as store_returns_quantitycount,
+           avg(sr_return_quantity) as store_returns_quantityave,
+           stddev_samp(sr_return_quantity) as store_returns_quantitystdev,
+           stddev_samp(sr_return_quantity) / avg(sr_return_quantity)
+             as store_returns_quantitycov,
+           count(cs_quantity) as catalog_sales_quantitycount,
+           avg(cs_quantity) as catalog_sales_quantityave,
+           stddev_samp(cs_quantity) as catalog_sales_quantitystdev,
+           stddev_samp(cs_quantity) / avg(cs_quantity)
+             as catalog_sales_quantitycov
+    from store_sales, store_returns, catalog_sales,
+         date_dim d1, date_dim d2, date_dim d3, store, item
+    where d1.d_quarter_name = '2001Q1'
+      and d1.d_date_sk = ss_sold_date_sk
+      and i_item_sk = ss_item_sk
+      and s_store_sk = ss_store_sk
+      and ss_customer_sk = sr_customer_sk
+      and ss_item_sk = sr_item_sk
+      and ss_ticket_number = sr_ticket_number
+      and sr_returned_date_sk = d2.d_date_sk
+      and d2.d_quarter_name in ('2001Q1', '2001Q2', '2001Q3')
+      and sr_customer_sk = cs_bill_customer_sk
+      and sr_item_sk = cs_item_sk
+      and cs_sold_date_sk = d3.d_date_sk
+      and d3.d_quarter_name in ('2001Q1', '2001Q2', '2001Q3')
+    group by i_item_id, i_item_desc, s_state
+    order by i_item_id, i_item_desc, s_state
+    limit 100"""
+
+QUERIES["q23"] = """
+    with frequent_ss_items as (
+      select substring(i_item_desc, 1, 30) itemdesc, i_item_sk item_sk,
+             d_date solddate, count(*) cnt
+      from store_sales, date_dim, item
+      where ss_sold_date_sk = d_date_sk
+        and ss_item_sk = i_item_sk
+        and d_year in (2000, 2000 + 1, 2000 + 2, 2000 + 3)
+      group by substring(i_item_desc, 1, 30), i_item_sk, d_date
+      having count(*) > 4),
+    max_store_sales as (
+      select max(csales) tpcds_cmax
+      from (select c_customer_sk,
+                   sum(ss_quantity * ss_sales_price) csales
+            from store_sales, customer, date_dim
+            where ss_customer_sk = c_customer_sk
+              and ss_sold_date_sk = d_date_sk
+              and d_year in (2000, 2000 + 1, 2000 + 2, 2000 + 3)
+            group by c_customer_sk) t),
+    best_ss_customer as (
+      select c_customer_sk, sum(ss_quantity * ss_sales_price) ssales
+      from store_sales, customer
+      where ss_customer_sk = c_customer_sk
+      group by c_customer_sk
+      having sum(ss_quantity * ss_sales_price) >
+             (50 / 100.0) * (select tpcds_cmax from max_store_sales))
+    select sum(sales)
+    from (select cs_quantity * cs_list_price sales
+          from catalog_sales, date_dim
+          where d_year = 2000 and d_moy = 2
+            and cs_sold_date_sk = d_date_sk
+            and cs_item_sk in (select item_sk from frequent_ss_items)
+            and cs_bill_customer_sk in
+                (select c_customer_sk from best_ss_customer)
+          union all
+          select ws_quantity * ws_list_price sales
+          from web_sales, date_dim
+          where d_year = 2000 and d_moy = 2
+            and ws_sold_date_sk = d_date_sk
+            and ws_item_sk in (select item_sk from frequent_ss_items)
+            and ws_bill_customer_sk in
+                (select c_customer_sk from best_ss_customer)) x
+    limit 100"""
+
+QUERIES["q24"] = """
+    with ssales as (
+      select c_last_name, c_first_name, s_store_name, ca_state, s_state,
+             i_color, i_current_price, i_manager_id, i_units, i_size,
+             sum(ss_net_paid) netpaid
+      from store_sales, store_returns, store, item, customer,
+           customer_address
+      where ss_ticket_number = sr_ticket_number
+        and ss_item_sk = sr_item_sk
+        and ss_customer_sk = c_customer_sk
+        and ss_item_sk = i_item_sk
+        and ss_store_sk = s_store_sk
+        and c_birth_country = upper(ca_country)
+        and s_zip = ca_zip
+        and s_market_id = 8
+      group by c_last_name, c_first_name, s_store_name, ca_state,
+               s_state, i_color, i_current_price, i_manager_id,
+               i_units, i_size)
+    select c_last_name, c_first_name, s_store_name, sum(netpaid) paid
+    from ssales
+    where i_color = 'red'
+    group by c_last_name, c_first_name, s_store_name
+    having sum(netpaid) > (select 0.05 * avg(netpaid) from ssales)
+    order by c_last_name, c_first_name, s_store_name
+    limit 100"""
+
+QUERIES["q39"] = """
+    with inv as (
+      select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,
+             stdev, mean,
+             case when mean = 0 then null else stdev / mean end cov
+      from (select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,
+                   stddev_samp(inv_quantity_on_hand) stdev,
+                   avg(inv_quantity_on_hand) mean
+            from inventory, item, warehouse, date_dim
+            where inv_item_sk = i_item_sk
+              and inv_warehouse_sk = w_warehouse_sk
+              and inv_date_sk = d_date_sk
+              and d_year = 2001
+            group by w_warehouse_name, w_warehouse_sk, i_item_sk,
+                     d_moy) foo
+      where case when mean = 0 then 0 else stdev / mean end > 0.5)
+    select inv1.w_warehouse_sk wsk1, inv1.i_item_sk isk1,
+           inv1.d_moy moy1, inv1.mean mean1, inv1.cov cov1,
+           inv2.w_warehouse_sk wsk2, inv2.i_item_sk isk2,
+           inv2.d_moy moy2, inv2.mean mean2, inv2.cov cov2
+    from inv inv1, inv inv2
+    where inv1.i_item_sk = inv2.i_item_sk
+      and inv1.w_warehouse_sk = inv2.w_warehouse_sk
+      and inv1.d_moy = 1
+      and inv2.d_moy = 1 + 1
+    order by wsk1, isk1, moy1, mean1, cov1, wsk2, isk2, moy2, mean2,
+             cov2
+    limit 100"""
+
+QUERIES["q41"] = """
+    select distinct i_product_name
+    from item i1
+    where i_manufact_id between 700 and 700 + 40
+      and (select count(*) as item_cnt
+           from item
+           where (i_manufact = i1.i_manufact
+                  and ((i_category = 'Women'
+                        and (i_color = 'red' or i_color = 'blue')
+                        and (i_units = 'Each' or i_units = 'Dozen')
+                        and (i_size = 'small' or i_size = 'medium'))
+                       or (i_category = 'Women'
+                           and (i_color = 'green' or i_color = 'yellow')
+                           and (i_units = 'Case' or i_units = 'Pallet')
+                           and (i_size = 'large'
+                                or i_size = 'extra large'))
+                       or (i_category = 'Men'
+                           and (i_color = 'purple' or i_color = 'orange')
+                           and (i_units = 'Each' or i_units = 'Case')
+                           and (i_size = 'petite' or i_size = 'economy'))
+                       or (i_category = 'Men'
+                           and (i_color = 'white' or i_color = 'black')
+                           and (i_units = 'Dozen' or i_units = 'Pallet')
+                           and (i_size = 'small' or i_size = 'medium'))))
+              or (i_manufact = i1.i_manufact
+                  and ((i_category = 'Sports'
+                        and (i_color = 'red' or i_color = 'green')
+                        and (i_units = 'Each' or i_units = 'Dozen')
+                        and (i_size = 'small' or i_size = 'large'))
+                       or (i_category = 'Music'
+                           and (i_color = 'blue' or i_color = 'white')
+                           and (i_units = 'Case' or i_units = 'Each')
+                           and (i_size = 'medium' or i_size = 'petite'))
+                       or (i_category = 'Books'
+                           and (i_color = 'yellow' or i_color = 'black')
+                           and (i_units = 'Dozen' or i_units = 'Pallet')
+                           and (i_size = 'economy' or i_size = 'small'))
+                       or (i_category = 'Home'
+                           and (i_color = 'orange' or i_color = 'purple')
+                           and (i_units = 'Case' or i_units = 'Pallet')
+                           and (i_size = 'large'
+                                or i_size = 'extra large'))))) > 0
+    order by i_product_name
+    limit 100"""
+
+QUERIES["q44"] = """
+    select asceding.rnk, i1.i_product_name best_performing,
+           i2.i_product_name worst_performing
+    from (select * from (
+            select item_sk, rank() over (order by rank_col asc) rnk
+            from (select ss_item_sk item_sk,
+                         avg(ss_net_profit) rank_col
+                  from store_sales ss1
+                  where ss_store_sk = 4
+                  group by ss_item_sk
+                  having avg(ss_net_profit) > 0.9 *
+                    (select avg(ss_net_profit) rank_col
+                     from store_sales
+                     where ss_store_sk = 4
+                       and ss_hdemo_sk is null
+                     group by ss_store_sk)) v1) v11
+          where rnk < 11) asceding,
+         (select * from (
+            select item_sk, rank() over (order by rank_col desc) rnk
+            from (select ss_item_sk item_sk,
+                         avg(ss_net_profit) rank_col
+                  from store_sales ss1
+                  where ss_store_sk = 4
+                  group by ss_item_sk
+                  having avg(ss_net_profit) > 0.9 *
+                    (select avg(ss_net_profit) rank_col
+                     from store_sales
+                     where ss_store_sk = 4
+                       and ss_hdemo_sk is null
+                     group by ss_store_sk)) v2) v21
+          where rnk < 11) descending,
+         item i1, item i2
+    where asceding.rnk = descending.rnk
+      and i1.i_item_sk = asceding.item_sk
+      and i2.i_item_sk = descending.item_sk
+    order by asceding.rnk
+    limit 100"""
+
+QUERIES["q49"] = """
+    select channel, item, return_ratio, return_rank, currency_rank
+    from (select 'web' as channel, web.item, web.return_ratio,
+                 web.return_rank, web.currency_rank
+          from (select item, return_ratio, currency_ratio,
+                       rank() over (order by return_ratio) as return_rank,
+                       rank() over (order by currency_ratio)
+                         as currency_rank
+                from (select ws.ws_item_sk as item,
+                             cast(sum(coalesce(wr.wr_return_quantity, 0))
+                                  as double) /
+                             cast(sum(coalesce(ws.ws_quantity, 0))
+                                  as double) as return_ratio,
+                             cast(sum(coalesce(wr.wr_return_amt, 0))
+                                  as double) /
+                             cast(sum(coalesce(ws.ws_net_paid, 0))
+                                  as double) as currency_ratio
+                      from web_sales ws
+                      left outer join web_returns wr
+                        on (ws.ws_order_number = wr.wr_order_number
+                            and ws.ws_item_sk = wr.wr_item_sk),
+                      date_dim
+                      where wr.wr_return_amt > 100
+                        and ws.ws_net_profit > 1
+                        and ws.ws_net_paid > 0
+                        and ws.ws_quantity > 0
+                        and ws_sold_date_sk = d_date_sk
+                        and d_year = 2001 and d_moy = 12
+                      group by ws.ws_item_sk) in_web) web
+          where web.return_rank <= 10 or web.currency_rank <= 10
+          union all
+          select 'catalog' as channel, catalog.item,
+                 catalog.return_ratio, catalog.return_rank,
+                 catalog.currency_rank
+          from (select item, return_ratio, currency_ratio,
+                       rank() over (order by return_ratio) as return_rank,
+                       rank() over (order by currency_ratio)
+                         as currency_rank
+                from (select cs.cs_item_sk as item,
+                             cast(sum(coalesce(cr.cr_return_quantity, 0))
+                                  as double) /
+                             cast(sum(coalesce(cs.cs_quantity, 0))
+                                  as double) as return_ratio,
+                             cast(sum(coalesce(cr.cr_return_amount, 0))
+                                  as double) /
+                             cast(sum(coalesce(cs.cs_net_paid, 0))
+                                  as double) as currency_ratio
+                      from catalog_sales cs
+                      left outer join catalog_returns cr
+                        on (cs.cs_order_number = cr.cr_order_number
+                            and cs.cs_item_sk = cr.cr_item_sk),
+                      date_dim
+                      where cr.cr_return_amount > 100
+                        and cs.cs_net_profit > 1
+                        and cs.cs_net_paid > 0
+                        and cs.cs_quantity > 0
+                        and cs_sold_date_sk = d_date_sk
+                        and d_year = 2001 and d_moy = 12
+                      group by cs.cs_item_sk) in_cat) catalog
+          where catalog.return_rank <= 10
+             or catalog.currency_rank <= 10
+          union all
+          select 'store' as channel, store.item, store.return_ratio,
+                 store.return_rank, store.currency_rank
+          from (select item, return_ratio, currency_ratio,
+                       rank() over (order by return_ratio) as return_rank,
+                       rank() over (order by currency_ratio)
+                         as currency_rank
+                from (select sts.ss_item_sk as item,
+                             cast(sum(coalesce(sr.sr_return_quantity, 0))
+                                  as double) /
+                             cast(sum(coalesce(sts.ss_quantity, 0))
+                                  as double) as return_ratio,
+                             cast(sum(coalesce(sr.sr_return_amt, 0))
+                                  as double) /
+                             cast(sum(coalesce(sts.ss_net_paid, 0))
+                                  as double) as currency_ratio
+                      from store_sales sts
+                      left outer join store_returns sr
+                        on (sts.ss_ticket_number = sr.sr_ticket_number
+                            and sts.ss_item_sk = sr.sr_item_sk),
+                      date_dim
+                      where sr.sr_return_amt > 100
+                        and sts.ss_net_profit > 1
+                        and sts.ss_net_paid > 0
+                        and sts.ss_quantity > 0
+                        and ss_sold_date_sk = d_date_sk
+                        and d_year = 2001 and d_moy = 12
+                      group by sts.ss_item_sk) in_store) store
+          where store.return_rank <= 10
+             or store.currency_rank <= 10) sq1
+    order by 1, 4, 5, 2
+    limit 100"""
+
+QUERIES["q51"] = """
+    with web_v1 as (
+      select ws_item_sk item_sk, d_date,
+             sum(sum(ws_sales_price))
+               over (partition by ws_item_sk order by d_date
+                     rows between unbounded preceding and current row)
+               cume_sales
+      from web_sales, date_dim
+      where ws_sold_date_sk = d_date_sk
+        and d_month_seq between 1200 and 1200 + 11
+        and ws_item_sk is not null
+      group by ws_item_sk, d_date),
+    store_v1 as (
+      select ss_item_sk item_sk, d_date,
+             sum(sum(ss_sales_price))
+               over (partition by ss_item_sk order by d_date
+                     rows between unbounded preceding and current row)
+               cume_sales
+      from store_sales, date_dim
+      where ss_sold_date_sk = d_date_sk
+        and d_month_seq between 1200 and 1200 + 11
+        and ss_item_sk is not null
+      group by ss_item_sk, d_date)
+    select * from (
+      select item_sk, d_date, web_sales, store_sales,
+             max(web_sales)
+               over (partition by item_sk order by d_date
+                     rows between unbounded preceding and current row)
+               web_cumulative,
+             max(store_sales)
+               over (partition by item_sk order by d_date
+                     rows between unbounded preceding and current row)
+               store_cumulative
+      from (select case when web.item_sk is not null
+                        then web.item_sk else store.item_sk end item_sk,
+                   case when web.d_date is not null
+                        then web.d_date else store.d_date end d_date,
+                   web.cume_sales web_sales,
+                   store.cume_sales store_sales
+            from web_v1 web full outer join store_v1 store
+              on (web.item_sk = store.item_sk
+                  and web.d_date = store.d_date)) x) y
+    where web_cumulative > store_cumulative
+    order by item_sk, d_date
+    limit 100"""
+
+QUERIES["q54"] = """
+    with my_customers as (
+      select distinct c_customer_sk, c_current_addr_sk
+      from (select cs_sold_date_sk sold_date_sk,
+                   cs_bill_customer_sk customer_sk,
+                   cs_item_sk item_sk
+            from catalog_sales
+            union all
+            select ws_sold_date_sk sold_date_sk,
+                   ws_bill_customer_sk customer_sk,
+                   ws_item_sk item_sk
+            from web_sales) cs_or_ws_sales, item, date_dim, customer
+      where sold_date_sk = d_date_sk
+        and item_sk = i_item_sk
+        and i_category = 'Women'
+        and i_class = 'dresses'
+        and c_customer_sk = cs_or_ws_sales.customer_sk
+        and d_moy = 12 and d_year = 1998),
+    my_revenue as (
+      select c_customer_sk, sum(ss_ext_sales_price) as revenue
+      from my_customers, store_sales, customer_address, store, date_dim
+      where c_current_addr_sk = ca_address_sk
+        and ca_county = s_county and ca_state = s_state
+        and ss_customer_sk = c_customer_sk
+        and ss_sold_date_sk = d_date_sk
+        and d_month_seq between
+            (select distinct d_month_seq + 1 from date_dim
+             where d_year = 1998 and d_moy = 12)
+            and
+            (select distinct d_month_seq + 3 from date_dim
+             where d_year = 1998 and d_moy = 12)
+      group by c_customer_sk),
+    segments as (
+      select cast((revenue / 50) as int) as segment from my_revenue)
+    select segment, count(*) as num_customers,
+           segment * 50 as segment_base
+    from segments
+    group by segment
+    order by segment, num_customers
+    limit 100"""
+
+QUERIES["q64"] = """
+    with cs_ui as (
+      select cs_item_sk,
+             sum(cs_ext_list_price) as sale,
+             sum(cr_refunded_cash + cr_reversed_charge
+                 + cr_store_credit) as refund
+      from catalog_sales, catalog_returns
+      where cs_item_sk = cr_item_sk
+        and cs_order_number = cr_order_number
+      group by cs_item_sk
+      having sum(cs_ext_list_price) >
+             2 * sum(cr_refunded_cash + cr_reversed_charge
+                     + cr_store_credit)),
+    cross_sales as (
+      select i_product_name product_name, i_item_sk item_sk,
+             s_store_name store_name, s_zip store_zip,
+             ad1.ca_street_number b_street_number,
+             ad1.ca_street_name b_street_name,
+             ad1.ca_city b_city, ad1.ca_zip b_zip,
+             ad2.ca_street_number c_street_number,
+             ad2.ca_street_name c_street_name,
+             ad2.ca_city c_city, ad2.ca_zip c_zip,
+             d1.d_year as syear, d2.d_year as fsyear, d3.d_year s2year,
+             count(*) cnt,
+             sum(ss_wholesale_cost) s1, sum(ss_list_price) s2,
+             sum(ss_coupon_amt) s3
+      from store_sales, store_returns, cs_ui,
+           date_dim d1, date_dim d2, date_dim d3,
+           store, customer, customer_demographics cd1,
+           customer_demographics cd2, promotion,
+           household_demographics hd1, household_demographics hd2,
+           customer_address ad1, customer_address ad2,
+           income_band ib1, income_band ib2, item
+      where ss_store_sk = s_store_sk
+        and ss_sold_date_sk = d1.d_date_sk
+        and ss_customer_sk = c_customer_sk
+        and ss_cdemo_sk = cd1.cd_demo_sk
+        and ss_hdemo_sk = hd1.hd_demo_sk
+        and ss_addr_sk = ad1.ca_address_sk
+        and ss_item_sk = i_item_sk
+        and ss_item_sk = sr_item_sk
+        and ss_ticket_number = sr_ticket_number
+        and ss_item_sk = cs_ui.cs_item_sk
+        and c_current_cdemo_sk = cd2.cd_demo_sk
+        and c_current_hdemo_sk = hd2.hd_demo_sk
+        and c_current_addr_sk = ad2.ca_address_sk
+        and c_first_sales_date_sk = d2.d_date_sk
+        and c_first_shipto_date_sk = d3.d_date_sk
+        and ss_promo_sk = p_promo_sk
+        and hd1.hd_income_band_sk = ib1.ib_income_band_sk
+        and hd2.hd_income_band_sk = ib2.ib_income_band_sk
+        and cd1.cd_marital_status <> cd2.cd_marital_status
+        and i_color in ('red', 'blue', 'green', 'purple', 'white',
+                        'orange')
+        and i_current_price between 20 and 20 + 50
+      group by i_product_name, i_item_sk, s_store_name, s_zip,
+               ad1.ca_street_number, ad1.ca_street_name, ad1.ca_city,
+               ad1.ca_zip, ad2.ca_street_number, ad2.ca_street_name,
+               ad2.ca_city, ad2.ca_zip, d1.d_year, d2.d_year, d3.d_year)
+    select cs1.product_name, cs1.store_name, cs1.store_zip,
+           cs1.b_street_number, cs1.b_street_name, cs1.b_city,
+           cs1.b_zip, cs1.c_street_number, cs1.c_street_name,
+           cs1.c_city, cs1.c_zip, cs1.syear, cs1.cnt,
+           cs1.s1 as s11, cs1.s2 as s21, cs1.s3 as s31,
+           cs2.s1 as s12, cs2.s2 as s22, cs2.s3 as s32,
+           cs2.syear as syear2, cs2.cnt as cnt2
+    from cross_sales cs1, cross_sales cs2
+    where cs1.item_sk = cs2.item_sk
+      and cs1.syear = 1999
+      and cs2.syear = 1999 + 1
+      and cs2.cnt <= cs1.cnt
+      and cs1.store_name = cs2.store_name
+      and cs1.store_zip = cs2.store_zip
+    order by cs1.product_name, cs1.store_name, cnt2, cs1.s1, s12
+    limit 100"""
+
+QUERIES["q66"] = """
+    select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+           w_state, w_country, ship_carriers, year_,
+           sum(jan_sales) as jan_sales, sum(feb_sales) as feb_sales,
+           sum(mar_sales) as mar_sales, sum(apr_sales) as apr_sales,
+           sum(may_sales) as may_sales, sum(jun_sales) as jun_sales,
+           sum(jul_sales) as jul_sales, sum(aug_sales) as aug_sales,
+           sum(sep_sales) as sep_sales, sum(oct_sales) as oct_sales,
+           sum(nov_sales) as nov_sales, sum(dec_sales) as dec_sales,
+           sum(jan_net) as jan_net, sum(feb_net) as feb_net,
+           sum(mar_net) as mar_net, sum(apr_net) as apr_net,
+           sum(may_net) as may_net, sum(jun_net) as jun_net,
+           sum(jul_net) as jul_net, sum(aug_net) as aug_net,
+           sum(sep_net) as sep_net, sum(oct_net) as oct_net,
+           sum(nov_net) as nov_net, sum(dec_net) as dec_net
+    from (
+      select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+             w_state, w_country,
+             'DHL' || ',' || 'UPS' as ship_carriers,
+             d_year as year_,
+             sum(case when d_moy = 1 then ws_ext_sales_price
+                      * ws_quantity else 0 end) as jan_sales,
+             sum(case when d_moy = 2 then ws_ext_sales_price
+                      * ws_quantity else 0 end) as feb_sales,
+             sum(case when d_moy = 3 then ws_ext_sales_price
+                      * ws_quantity else 0 end) as mar_sales,
+             sum(case when d_moy = 4 then ws_ext_sales_price
+                      * ws_quantity else 0 end) as apr_sales,
+             sum(case when d_moy = 5 then ws_ext_sales_price
+                      * ws_quantity else 0 end) as may_sales,
+             sum(case when d_moy = 6 then ws_ext_sales_price
+                      * ws_quantity else 0 end) as jun_sales,
+             sum(case when d_moy = 7 then ws_ext_sales_price
+                      * ws_quantity else 0 end) as jul_sales,
+             sum(case when d_moy = 8 then ws_ext_sales_price
+                      * ws_quantity else 0 end) as aug_sales,
+             sum(case when d_moy = 9 then ws_ext_sales_price
+                      * ws_quantity else 0 end) as sep_sales,
+             sum(case when d_moy = 10 then ws_ext_sales_price
+                      * ws_quantity else 0 end) as oct_sales,
+             sum(case when d_moy = 11 then ws_ext_sales_price
+                      * ws_quantity else 0 end) as nov_sales,
+             sum(case when d_moy = 12 then ws_ext_sales_price
+                      * ws_quantity else 0 end) as dec_sales,
+             sum(case when d_moy = 1 then ws_net_paid * ws_quantity
+                      else 0 end) as jan_net,
+             sum(case when d_moy = 2 then ws_net_paid * ws_quantity
+                      else 0 end) as feb_net,
+             sum(case when d_moy = 3 then ws_net_paid * ws_quantity
+                      else 0 end) as mar_net,
+             sum(case when d_moy = 4 then ws_net_paid * ws_quantity
+                      else 0 end) as apr_net,
+             sum(case when d_moy = 5 then ws_net_paid * ws_quantity
+                      else 0 end) as may_net,
+             sum(case when d_moy = 6 then ws_net_paid * ws_quantity
+                      else 0 end) as jun_net,
+             sum(case when d_moy = 7 then ws_net_paid * ws_quantity
+                      else 0 end) as jul_net,
+             sum(case when d_moy = 8 then ws_net_paid * ws_quantity
+                      else 0 end) as aug_net,
+             sum(case when d_moy = 9 then ws_net_paid * ws_quantity
+                      else 0 end) as sep_net,
+             sum(case when d_moy = 10 then ws_net_paid * ws_quantity
+                      else 0 end) as oct_net,
+             sum(case when d_moy = 11 then ws_net_paid * ws_quantity
+                      else 0 end) as nov_net,
+             sum(case when d_moy = 12 then ws_net_paid * ws_quantity
+                      else 0 end) as dec_net
+      from web_sales, warehouse, date_dim, time_dim, ship_mode
+      where ws_warehouse_sk = w_warehouse_sk
+        and ws_sold_date_sk = d_date_sk
+        and ws_sold_time_sk = t_time_sk
+        and ws_ship_mode_sk = sm_ship_mode_sk
+        and d_year = 2001
+        and t_time between 30838 and 30838 + 28800
+        and sm_carrier in ('DHL', 'UPS')
+      group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+               w_state, w_country, d_year
+      union all
+      select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+             w_state, w_country,
+             'DHL' || ',' || 'UPS' as ship_carriers,
+             d_year as year_,
+             sum(case when d_moy = 1 then cs_sales_price * cs_quantity
+                      else 0 end) as jan_sales,
+             sum(case when d_moy = 2 then cs_sales_price * cs_quantity
+                      else 0 end) as feb_sales,
+             sum(case when d_moy = 3 then cs_sales_price * cs_quantity
+                      else 0 end) as mar_sales,
+             sum(case when d_moy = 4 then cs_sales_price * cs_quantity
+                      else 0 end) as apr_sales,
+             sum(case when d_moy = 5 then cs_sales_price * cs_quantity
+                      else 0 end) as may_sales,
+             sum(case when d_moy = 6 then cs_sales_price * cs_quantity
+                      else 0 end) as jun_sales,
+             sum(case when d_moy = 7 then cs_sales_price * cs_quantity
+                      else 0 end) as jul_sales,
+             sum(case when d_moy = 8 then cs_sales_price * cs_quantity
+                      else 0 end) as aug_sales,
+             sum(case when d_moy = 9 then cs_sales_price * cs_quantity
+                      else 0 end) as sep_sales,
+             sum(case when d_moy = 10 then cs_sales_price * cs_quantity
+                      else 0 end) as oct_sales,
+             sum(case when d_moy = 11 then cs_sales_price * cs_quantity
+                      else 0 end) as nov_sales,
+             sum(case when d_moy = 12 then cs_sales_price * cs_quantity
+                      else 0 end) as dec_sales,
+             sum(case when d_moy = 1 then cs_net_paid_inc_ship
+                      * cs_quantity else 0 end) as jan_net,
+             sum(case when d_moy = 2 then cs_net_paid_inc_ship
+                      * cs_quantity else 0 end) as feb_net,
+             sum(case when d_moy = 3 then cs_net_paid_inc_ship
+                      * cs_quantity else 0 end) as mar_net,
+             sum(case when d_moy = 4 then cs_net_paid_inc_ship
+                      * cs_quantity else 0 end) as apr_net,
+             sum(case when d_moy = 5 then cs_net_paid_inc_ship
+                      * cs_quantity else 0 end) as may_net,
+             sum(case when d_moy = 6 then cs_net_paid_inc_ship
+                      * cs_quantity else 0 end) as jun_net,
+             sum(case when d_moy = 7 then cs_net_paid_inc_ship
+                      * cs_quantity else 0 end) as jul_net,
+             sum(case when d_moy = 8 then cs_net_paid_inc_ship
+                      * cs_quantity else 0 end) as aug_net,
+             sum(case when d_moy = 9 then cs_net_paid_inc_ship
+                      * cs_quantity else 0 end) as sep_net,
+             sum(case when d_moy = 10 then cs_net_paid_inc_ship
+                      * cs_quantity else 0 end) as oct_net,
+             sum(case when d_moy = 11 then cs_net_paid_inc_ship
+                      * cs_quantity else 0 end) as nov_net,
+             sum(case when d_moy = 12 then cs_net_paid_inc_ship
+                      * cs_quantity else 0 end) as dec_net
+      from catalog_sales, warehouse, date_dim, time_dim, ship_mode
+      where cs_warehouse_sk = w_warehouse_sk
+        and cs_sold_date_sk = d_date_sk
+        and cs_sold_time_sk = t_time_sk
+        and cs_ship_mode_sk = sm_ship_mode_sk
+        and d_year = 2001
+        and t_time between 30838 and 30838 + 28800
+        and sm_carrier in ('DHL', 'UPS')
+      group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+               w_state, w_country, d_year) x
+    group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+             w_state, w_country, ship_carriers, year_
+    order by w_warehouse_name
+    limit 100"""
+
+QUERIES["q67"] = """
+    select * from (
+      select i_category, i_class, i_brand, i_product_name, d_year,
+             d_qoy, d_moy, s_store_id, sumsales,
+             rank() over (partition by i_category
+                          order by sumsales desc) rk
+      from (select i_category, i_class, i_brand, i_product_name,
+                   d_year, d_qoy, d_moy, s_store_id,
+                   sum(coalesce(ss_sales_price * ss_quantity, 0))
+                     sumsales
+            from store_sales, date_dim, store, item
+            where ss_sold_date_sk = d_date_sk
+              and ss_item_sk = i_item_sk
+              and ss_store_sk = s_store_sk
+              and d_month_seq between 1200 and 1200 + 11
+            group by rollup(i_category, i_class, i_brand,
+                            i_product_name, d_year, d_qoy, d_moy,
+                            s_store_id)) dw1) dw2
+    where rk <= 100
+    order by i_category, i_class, i_brand, i_product_name, d_year,
+             d_qoy, d_moy, s_store_id, sumsales, rk
+    limit 100"""
+
+QUERIES["q70"] = """
+    select sum(ss_net_profit) as total_sum, s_state, s_county,
+           grouping(s_state) + grouping(s_county) as lochierarchy,
+           rank() over (
+             partition by grouping(s_state) + grouping(s_county),
+               case when grouping(s_county) = 0 then s_state end
+             order by sum(ss_net_profit) desc) as rank_within_parent
+    from store_sales, date_dim d1, store
+    where d1.d_month_seq between 1200 and 1200 + 11
+      and d1.d_date_sk = ss_sold_date_sk
+      and s_store_sk = ss_store_sk
+      and s_state in (select s_state
+                      from (select s_state as s_state,
+                                   rank() over (partition by s_state
+                                     order by sum(ss_net_profit) desc)
+                                     as ranking
+                            from store_sales, store, date_dim
+                            where d_month_seq between 1200 and 1200 + 11
+                              and d_date_sk = ss_sold_date_sk
+                              and s_store_sk = ss_store_sk
+                            group by s_state) tmp1
+                      where ranking <= 5)
+    group by rollup(s_state, s_county)
+    order by lochierarchy desc,
+             case when lochierarchy = 0 then s_state end,
+             rank_within_parent
+    limit 100"""
+
+QUERIES["q77"] = """
+    with ss as (
+      select s_store_sk, sum(ss_ext_sales_price) as sales,
+             sum(ss_net_profit) as profit
+      from store_sales, date_dim, store
+      where ss_sold_date_sk = d_date_sk
+        and d_date between date '2000-08-03'
+                       and date '2000-08-03' + interval 30 days
+        and ss_store_sk = s_store_sk
+      group by s_store_sk),
+    sr as (
+      select s_store_sk, sum(sr_return_amt) as returns_amt,
+             sum(sr_net_loss) as profit_loss
+      from store_returns, date_dim, store
+      where sr_returned_date_sk = d_date_sk
+        and d_date between date '2000-08-03'
+                       and date '2000-08-03' + interval 30 days
+        and sr_store_sk = s_store_sk
+      group by s_store_sk),
+    cs as (
+      select cs_call_center_sk, sum(cs_ext_sales_price) as sales,
+             sum(cs_net_profit) as profit
+      from catalog_sales, date_dim
+      where cs_sold_date_sk = d_date_sk
+        and d_date between date '2000-08-03'
+                       and date '2000-08-03' + interval 30 days
+      group by cs_call_center_sk),
+    cr as (
+      select cr_call_center_sk, sum(cr_return_amount) as returns_amt,
+             sum(cr_net_loss) as profit_loss
+      from catalog_returns, date_dim
+      where cr_returned_date_sk = d_date_sk
+        and d_date between date '2000-08-03'
+                       and date '2000-08-03' + interval 30 days
+      group by cr_call_center_sk),
+    ws as (
+      select wp_web_page_sk, sum(ws_ext_sales_price) as sales,
+             sum(ws_net_profit) as profit
+      from web_sales, date_dim, web_page
+      where ws_sold_date_sk = d_date_sk
+        and d_date between date '2000-08-03'
+                       and date '2000-08-03' + interval 30 days
+        and ws_web_page_sk = wp_web_page_sk
+      group by wp_web_page_sk),
+    wr as (
+      select wp_web_page_sk, sum(wr_return_amt) as returns_amt,
+             sum(wr_net_loss) as profit_loss
+      from web_returns, date_dim, web_page
+      where wr_returned_date_sk = d_date_sk
+        and d_date between date '2000-08-03'
+                       and date '2000-08-03' + interval 30 days
+        and wr_web_page_sk = wp_web_page_sk
+      group by wp_web_page_sk)
+    select channel, id, sum(sales) as sales,
+           sum(returns_amt) as returns_amt, sum(profit) as profit
+    from (select 'store channel' as channel, ss.s_store_sk as id,
+                 sales, coalesce(returns_amt, 0) as returns_amt,
+                 profit - coalesce(profit_loss, 0) as profit
+          from ss left join sr on ss.s_store_sk = sr.s_store_sk
+          union all
+          select 'catalog channel' as channel,
+                 cs_call_center_sk as id, sales, returns_amt,
+                 profit - profit_loss as profit
+          from cs, cr
+          union all
+          select 'web channel' as channel, ws.wp_web_page_sk as id,
+                 sales, coalesce(returns_amt, 0) as returns_amt,
+                 profit - coalesce(profit_loss, 0) as profit
+          from ws left join wr
+            on ws.wp_web_page_sk = wr.wp_web_page_sk) x
+    group by rollup(channel, id)
+    order by channel, id
+    limit 100"""
+
+QUERIES["q80"] = """
+    with ssr as (
+      select s_store_id as store_id,
+             sum(ss_ext_sales_price) as sales,
+             sum(coalesce(sr_return_amt, 0)) as returns_amt,
+             sum(ss_net_profit - coalesce(sr_net_loss, 0)) as profit
+      from store_sales
+      left outer join store_returns
+        on (ss_item_sk = sr_item_sk
+            and ss_ticket_number = sr_ticket_number),
+      date_dim, store, item, promotion
+      where ss_sold_date_sk = d_date_sk
+        and d_date between date '2000-08-23'
+                       and date '2000-08-23' + interval 30 days
+        and ss_store_sk = s_store_sk
+        and ss_item_sk = i_item_sk
+        and i_current_price > 50
+        and ss_promo_sk = p_promo_sk
+        and p_channel_tv = 'N'
+      group by s_store_id),
+    csr as (
+      select cp_catalog_page_id as catalog_page_id,
+             sum(cs_ext_sales_price) as sales,
+             sum(coalesce(cr_return_amount, 0)) as returns_amt,
+             sum(cs_net_profit - coalesce(cr_net_loss, 0)) as profit
+      from catalog_sales
+      left outer join catalog_returns
+        on (cs_item_sk = cr_item_sk
+            and cs_order_number = cr_order_number),
+      date_dim, catalog_page, item, promotion
+      where cs_sold_date_sk = d_date_sk
+        and d_date between date '2000-08-23'
+                       and date '2000-08-23' + interval 30 days
+        and cs_catalog_page_sk = cp_catalog_page_sk
+        and cs_item_sk = i_item_sk
+        and i_current_price > 50
+        and cs_promo_sk = p_promo_sk
+        and p_channel_tv = 'N'
+      group by cp_catalog_page_id),
+    wsr as (
+      select web_site_id,
+             sum(ws_ext_sales_price) as sales,
+             sum(coalesce(wr_return_amt, 0)) as returns_amt,
+             sum(ws_net_profit - coalesce(wr_net_loss, 0)) as profit
+      from web_sales
+      left outer join web_returns
+        on (ws_item_sk = wr_item_sk
+            and ws_order_number = wr_order_number),
+      date_dim, web_site, item, promotion
+      where ws_sold_date_sk = d_date_sk
+        and d_date between date '2000-08-23'
+                       and date '2000-08-23' + interval 30 days
+        and ws_web_site_sk = web_site_sk
+        and ws_item_sk = i_item_sk
+        and i_current_price > 50
+        and ws_promo_sk = p_promo_sk
+        and p_channel_tv = 'N'
+      group by web_site_id)
+    select channel, id, sum(sales) as sales,
+           sum(returns_amt) as returns_amt, sum(profit) as profit
+    from (select 'store channel' as channel,
+                 'store' || store_id as id, sales, returns_amt, profit
+          from ssr
+          union all
+          select 'catalog channel' as channel,
+                 'catalog_page' || catalog_page_id as id,
+                 sales, returns_amt, profit
+          from csr
+          union all
+          select 'web channel' as channel,
+                 'web_site' || web_site_id as id,
+                 sales, returns_amt, profit
+          from wsr) x
+    group by rollup(channel, id)
+    order by channel, id
+    limit 100"""
+
+QUERIES["q94"] = """
+    select count(distinct ws_order_number) as order_count,
+           sum(ws_ext_ship_cost) as total_shipping_cost,
+           sum(ws_net_profit) as total_net_profit
+    from web_sales ws1, date_dim, customer_address, web_site
+    where d_date between date '1999-02-01'
+                     and date '1999-02-01' + interval 60 days
+      and ws1.ws_ship_date_sk = d_date_sk
+      and ws1.ws_ship_addr_sk = ca_address_sk
+      and ca_state = 'GA'
+      and ws1.ws_web_site_sk = web_site_sk
+      and web_company_name = 'pri'
+      and exists (select * from web_sales ws2
+                  where ws1.ws_order_number = ws2.ws_order_number
+                    and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+      and not exists (select * from web_returns wr1
+                      where ws1.ws_order_number = wr1.wr_order_number)
+    order by count(distinct ws_order_number)
+    limit 100"""

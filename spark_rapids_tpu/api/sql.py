@@ -1038,6 +1038,57 @@ def _split_conjuncts(a: Ast) -> List[Ast]:
     return [a]
 
 
+def _split_disjuncts(a: Ast) -> List[Ast]:
+    """OR-flatten a predicate AST."""
+    if isinstance(a, Bin) and a.op == "or":
+        return _split_disjuncts(a.left) + _split_disjuncts(a.right)
+    return [a]
+
+
+def _conj(parts: List[Ast]) -> Optional[Ast]:
+    if not parts:
+        return None
+    e = parts[0]
+    for p in parts[1:]:
+        e = Bin("and", e, p)
+    return e
+
+
+def _factor_or(a: Ast) -> Ast:
+    """``(A and P1) or (A and P2) -> A and (P1 or P2)``, recursively.
+
+    Exact in three-valued logic (AND distributes over OR).  TPC-DS q41
+    hides its correlation equality ``i_manufact = i1.i_manufact`` inside
+    both branches of a top-level OR; factoring it out lets the
+    decorrelators see it as a plain correlation conjunct."""
+    if isinstance(a, Bin) and a.op == "and":
+        return Bin("and", _factor_or(a.left), _factor_or(a.right))
+    if not (isinstance(a, Bin) and a.op == "or"):
+        return a
+    branches = [_split_conjuncts(_factor_or(d))
+                for d in _split_disjuncts(a)]
+    common = [c for c in branches[0]
+              if all(any(c == d for d in b) for b in branches[1:])]
+    if not common:
+        return a
+    rests = []
+    for b in branches:
+        rest = list(b)
+        for c in common:
+            for i, d in enumerate(rest):
+                if c == d:
+                    del rest[i]
+                    break
+        rests.append(_conj(rest))
+    if any(r is None for r in rests):
+        # (A) or (A and P) == A
+        return _conj(common)
+    disj = rests[0]
+    for r in rests[1:]:
+        disj = Bin("or", disj, r)
+    return _conj(common + [disj])
+
+
 def _canon_idents(scope_: "_Scope", ast: Ast) -> Ast:
     """Resolve raw Idents against a scope (raises SqlError on unknown
     columns) — shared by both decorrelators."""
@@ -1057,6 +1108,14 @@ class _Lowerer:
     def fresh(self, prefix: str) -> str:
         self._uid += 1
         return f"__{prefix}{self._uid}"
+
+    def _exec_sub(self, plan: L.LogicalPlan):
+        """Eagerly execute a lowered subquery (scalar / IN / EXISTS
+        position).  Runs the same logical optimizer as ``sql_to_plan``
+        first — without it the plan is raw cross-joins + filters and a
+        three-table subquery (TPC-DS q23's max_store_sales) explodes."""
+        from ..plan.logical_opt import optimize
+        return self.session.execute_to_arrow(optimize(plan))
 
     # -- statements ---------------------------------------------------------
     def lower(self, ast: Ast) -> L.LogicalPlan:
@@ -1223,7 +1282,22 @@ class _Lowerer:
                 else:
                     e = canon(e)
             else:
-                e = canon(e)
+                try:
+                    e = canon(e)
+                except SqlError:
+                    # select-list aliases may appear INSIDE an ORDER BY
+                    # expression (TPC-DS q70: ``order by case when
+                    # lochierarchy = 0 then s_state end``) — substitute
+                    # aliases through the tree, then canonicalize
+                    alias_map = {disp.lower(): it.e
+                                 for it, disp in zip(items, display_names)}
+
+                    def sub_alias(n):
+                        if isinstance(n, Ident) and len(n.parts) == 1 \
+                                and n.parts[0].lower() in alias_map:
+                            return alias_map[n.parts[0].lower()]
+                        return n
+                    e = canon(_transform(e, sub_alias))
             fixed_orders.append(dataclasses.replace(o, e=e))
         order_asts = fixed_orders
 
@@ -1537,11 +1611,11 @@ class _Lowerer:
                     # SQL three-valued NOT IN: empty set -> everything
                     # qualifies (even NULL); any NULL in the set ->
                     # nothing qualifies; else NULL operands never match
-                    if self.session.execute_to_arrow(
+                    if self._exec_sub(
                             L.Limit(1, sub)).num_rows == 0:
                         continue
                     if sf.nullable:
-                        nulls = self.session.execute_to_arrow(L.Limit(
+                        nulls = self._exec_sub(L.Limit(
                             1, L.Filter(ep.IsNull(rkey), sub))).num_rows
                         if nulls:
                             plan = L.Filter(ec.Literal(False, T.BOOL), plan)
@@ -1552,6 +1626,11 @@ class _Lowerer:
                 else:
                     plan = L.Join(plan, sub, "semi", [lkey], [rkey], None)
                 continue
+            disj = _split_disjuncts(c)
+            if len(disj) > 1 and all(isinstance(d, Exists)
+                                     and not d.negated for d in disj):
+                plan = self._decorrelate_exists_or(disj, plan, scope)
+                continue
             if isinstance(c, Exists):
                 try:
                     sub = self.lower(c.query)
@@ -1560,7 +1639,7 @@ class _Lowerer:
                     plan = self._decorrelate_exists(c, plan, scope)
                     continue
                 # uncorrelated EXISTS: evaluate eagerly to a constant
-                n = self.session.execute_to_arrow(
+                n = self._exec_sub(
                     L.Limit(1, sub)).num_rows
                 truth = (n > 0) != c.negated
                 if not truth:
@@ -1610,16 +1689,32 @@ class _Lowerer:
         t1.k = t2.k and ...)``.  Equality conjuncts that straddle the
         scopes become join keys; purely-inner conjuncts stay as a filter
         under the join; anything else is unsupported."""
+        outer_keys, inner_proj, rrefs, condition = \
+            self._exists_parts(c, outer_scope)
+        return L.Join(plan, inner_proj, "anti" if c.negated else "semi",
+                      outer_keys, rrefs, condition)
+
+    def _exists_parts(self, c: Exists, outer_scope: _Scope):
+        """Split a correlated EXISTS into (outer_keys, projected inner
+        plan, right key refs, residual condition).  Equality conjuncts
+        that straddle the scopes become join keys; purely-inner
+        conjuncts filter under the join; other straddling conjuncts
+        (q16/q94's ``cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk``)
+        become a residual pair-level condition with the referenced
+        inner columns projected alongside the keys."""
         sub = c.query
         if not isinstance(sub, SelectStmt) or sub.from_item is None or \
                 sub.group_by or sub.having or sub.distinct or sub.ctes:
             raise SqlError("unsupported correlated EXISTS subquery")
         inner_plan, inner_scope = self.lower_from(sub.from_item)
         inner_rest: List[Ast] = []
+        residual_asts: List[Ast] = []
         outer_keys: List[ec.Expression] = []
         inner_keys: List[ec.Expression] = []
-        for cj in (_split_conjuncts(sub.where)
-                   if sub.where is not None else []):
+        where_ast = _factor_or(sub.where) if sub.where is not None \
+            else None
+        for cj in (_split_conjuncts(where_ast)
+                   if where_ast is not None else []):
             try:
                 inner_rest.append(_canon_idents(inner_scope, cj))
                 continue
@@ -1638,10 +1733,7 @@ class _Lowerer:
                     matched = True
                     break
             if not matched:
-                raise SqlError(
-                    "correlated EXISTS predicates must be equalities "
-                    "between inner and outer columns (plus inner-only "
-                    "conjuncts)")
+                residual_asts.append(cj)
         if not inner_keys:
             raise SqlError("EXISTS subquery references unknown columns")
         if inner_rest:
@@ -1651,18 +1743,71 @@ class _Lowerer:
             inner_plan = L.Filter(cond, inner_plan)
         proj = [ec.Alias(k, f"__ck{i}")
                 for i, k in enumerate(inner_keys)]
+        # residual conjuncts: inner-resolvable idents are projected as
+        # extra __rc columns; the rewritten predicate then lowers
+        # against outer-scope + projected-inner and binds to the join's
+        # pair schema at execution
+        condition = None
+        if residual_asts:
+            extra: List[ec.Expression] = []
+            extra_fields: List[Field] = []
+
+            def sub_inner(n):
+                if isinstance(n, Ident):
+                    try:
+                        ie = self.lower_expr(
+                            _canon_idents(inner_scope, n), inner_scope)
+                    except SqlError:
+                        return n
+                    name = f"__rc{len(extra)}"
+                    extra.append(ec.Alias(ie, name))
+                    extra_fields.append(Field(name, ie.dtype(), True))
+                    return Res(name)
+                return n
+            lowered = []
+            for r in residual_asts:
+                r2 = _transform(r, sub_inner)
+                comb = _Scope(outer_scope.entries + [
+                    (None, {f.name.lower(): (f.name, f)
+                            for f in extra_fields})])
+                lowered.append(self.lower_expr(_canon_idents(comb, r2),
+                                               comb))
+            proj = proj + extra
+            condition = lowered[0]
+            for r in lowered[1:]:
+                condition = ep.And(condition, r)
         inner_proj = L.Project(proj, inner_plan)
         rrefs = [ec.AttributeReference(f"__ck{i}", k.dtype(), True)
                  for i, k in enumerate(inner_keys)]
-        return L.Join(plan, inner_proj, "anti" if c.negated else "semi",
-                      outer_keys, rrefs, None)
+        return outer_keys, inner_proj, rrefs, condition
+
+    def _decorrelate_exists_or(self, disj: List[Exists],
+                               plan: L.LogicalPlan,
+                               outer_scope: _Scope) -> L.LogicalPlan:
+        """``exists(E1) or exists(E2) ...`` where every disjunct
+        correlates on the SAME outer key expressions -> one semi join
+        against the UNION ALL of the inner key sets (TPC-DS q10's
+        web-or-catalog shape)."""
+        parts = [self._exists_parts(d, outer_scope) for d in disj]
+        ok0, _, rrefs0, cond0 = parts[0]
+        if cond0 is not None or any(p[3] is not None for p in parts):
+            raise SqlError("OR of EXISTS with residual conditions "
+                           "unsupported")
+        key_repr = [repr(k) for k in ok0]
+        for ok, _, _, _ in parts[1:]:
+            if [repr(k) for k in ok] != key_repr:
+                raise SqlError(
+                    "OR of EXISTS requires identical correlation keys "
+                    "in every disjunct")
+        inner = L.Union([p[1] for p in parts])
+        return L.Join(plan, inner, "semi", ok0, rrefs0, None)
 
     def _scalar_literal(self, sub_plan: L.LogicalPlan) -> ec.Literal:
         """Execute an (already lowered) uncorrelated scalar subquery to
         a literal (at most one row, one column)."""
         if len(sub_plan.schema) != 1:
             raise SqlError("scalar subquery must return one column")
-        tbl = self.session.execute_to_arrow(sub_plan)
+        tbl = self._exec_sub(sub_plan)
         if tbl.num_rows > 1:
             raise SqlError("scalar subquery returned more than one row")
         val = tbl.column(0)[0].as_py() if tbl.num_rows else None
@@ -1716,7 +1861,7 @@ class _Lowerer:
         inner_rest: List[Ast] = []
         inner_key_asts: List[Ast] = []
         outer_keys: List[ec.Expression] = []
-        for cj in (_split_conjuncts(sub.where)
+        for cj in (_split_conjuncts(_factor_or(sub.where))
                    if sub.where is not None else []):
             try:
                 _canon_idents(inner_scope, cj)
@@ -1872,7 +2017,7 @@ class _Lowerer:
             sub = self.lower(ast.query)
             if len(sub.schema) != 1:
                 raise SqlError("scalar subquery must return one column")
-            tbl = self.session.execute_to_arrow(sub)
+            tbl = self._exec_sub(sub)
             if tbl.num_rows > 1:
                 raise SqlError("scalar subquery returned more than one row")
             val = tbl.column(0)[0].as_py() if tbl.num_rows else None
@@ -1892,7 +2037,7 @@ class _Lowerer:
                     f"{err}") from err
             if len(sub.schema) != 1:
                 raise SqlError("IN subquery must return one column")
-            tbl = self.session.execute_to_arrow(sub)
+            tbl = self._exec_sub(sub)
             vals = tbl.column(0).to_pylist()
             has_null = any(v is None for v in vals)
             vals = [v for v in vals if v is not None]

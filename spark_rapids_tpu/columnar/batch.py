@@ -414,7 +414,7 @@ def _slice_elements(col: Column, o0: int, o1: int) -> Column:
             col.validity[o0:o1])
     if isinstance(col, StringColumn):
         return StringColumn(col.offsets[o0:o1 + 1], col.data,
-                            col.validity[o0:o1])
+                            col.validity[o0:o1], max_bytes=col.max_bytes)
     return Column(col.dtype, col.data[o0:o1], col.validity[o0:o1])
 
 
@@ -499,4 +499,7 @@ def _concat_string_cols(cols: Sequence[StringColumn], nrows: Sequence[int],
     vpad = cap - int(valid.shape[0])
     if vpad > 0:
         valid = jnp.pad(valid, (0, vpad))
-    return StringColumn(offsets.astype(jnp.int32), jnp.asarray(buf), valid)
+    mbs = [c.max_bytes for c in cols]
+    mb = max(mbs) if mbs and all(m is not None for m in mbs) else None
+    return StringColumn(offsets.astype(jnp.int32), jnp.asarray(buf), valid,
+                        max_bytes=mb)

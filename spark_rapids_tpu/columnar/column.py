@@ -15,6 +15,7 @@ TPU-first design:
 """
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence, Union
 
 import numpy as np
@@ -25,7 +26,7 @@ from . import dtypes as T
 
 # Minimum capacity bucket; batches are padded up to powers of two so the
 # jit-cache stays small (SURVEY.md §7 "compile-cache keyed by padded size").
-MIN_CAPACITY = 16
+MIN_CAPACITY = int(os.environ.get("SPARK_RAPIDS_TPU_MIN_CAPACITY", "1024"))
 
 
 def bucket_capacity(n: int) -> int:
@@ -210,11 +211,18 @@ class StringColumn(Column):
     Reference analogue: cuDF STRING columns used throughout stringFunctions.scala.
     """
 
-    def __init__(self, offsets, data, validity):
+    def __init__(self, offsets, data, validity, max_bytes=None):
         self.dtype = T.STRING
         self.offsets = offsets
         self.data = data  # uint8 byte buffer
         self.validity = validity
+        # host-known upper bound on any row's byte length, when cheap
+        # to carry (ingest, gather, slices).  None -> computed lazily
+        # with ONE device sync and cached; without the bound every
+        # key-word encoding syncs the offsets buffer to host
+        # (kernels/strings.needed_key_words), which serialized string
+        # comparisons behind all pending device work
+        self.max_bytes = max_bytes
 
     @property
     def capacity(self) -> int:
@@ -247,7 +255,8 @@ class StringColumn(Column):
         if total:
             buf[:total] = np.frombuffer(b"".join(encoded), dtype=np.uint8)
         return StringColumn(jnp.asarray(offsets), jnp.asarray(buf),
-                            jnp.asarray(validity))
+                            jnp.asarray(validity),
+                            max_bytes=int(max(lens)) if lens else 0)
 
     _HOST_ATTRS = ("offsets", "data", "validity")
 
@@ -274,7 +283,8 @@ class StringColumn(Column):
         else:
             offsets = self.offsets[:capacity + 1]
             valid = self.validity[:capacity] & (jnp.arange(capacity) < num_rows)
-        return StringColumn(offsets, self.data, valid)
+        return StringColumn(offsets, self.data, valid,
+                            max_bytes=self.max_bytes)
 
     def gather(self, indices) -> "StringColumn":
         # String gather rebuilds offsets on device and gathers bytes via a
@@ -282,10 +292,12 @@ class StringColumn(Column):
         from ..kernels import strings as skern
         offs, buf, valid = skern.gather_strings(
             self.offsets, self.data, self.validity, indices)
-        return StringColumn(offs, buf, valid)
+        return StringColumn(offs, buf, valid, max_bytes=self.max_bytes)
 
     def mask_validity(self, keep_mask) -> "StringColumn":
-        return StringColumn(self.offsets, self.data, self.validity & keep_mask)
+        return StringColumn(self.offsets, self.data,
+                            self.validity & keep_mask,
+                            max_bytes=self.max_bytes)
 
     def nbytes(self) -> int:
         return self.offsets.nbytes + self.data.nbytes + self.validity.nbytes

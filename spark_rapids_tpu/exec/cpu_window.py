@@ -210,11 +210,21 @@ class CpuWindow(CpuExec):
                 frame_kind, fstart, fend = spec.frame
                 if not skeys or (fstart is None and fend is None):
                     res = grouped[src].transform(agg)
+                    if agg != "count":
+                        # all-null partition: pandas yields NaN, SQL NULL
+                        cnt = grouped[src].transform("count")
+                        res = res.astype(object).mask(cnt == 0, None)
                 elif frame_kind == "rows" and fstart is None and fend == 0:
                     # running aggregate: vectorized expanding() (the
                     # exact per-row oracle below is O(n^2) python)
                     res = grouped[src].transform(
                         lambda s_: getattr(s_.expanding(), agg)())
+                    if agg != "count":
+                        # all-null prefix: pandas yields NaN, SQL NULL
+                        # (TPC-DS q51 full-outer cumulative windows)
+                        cnt = grouped[src].transform(
+                            lambda s_: s_.expanding().count())
+                        res = res.astype(object).mask(cnt == 0, None)
                 else:
                     # bounded frame oracle: per-row python slice (exact,
                     # O(n*frame) — oracle only)

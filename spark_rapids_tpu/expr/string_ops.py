@@ -337,7 +337,8 @@ class StringTrim(Expression):
         total = int(new_offsets[-1])
         buf = _materialize_bytes(col.data, new_offsets, src_starts,
                                  bucket_capacity(max(1, total)))
-        return StringColumn(new_offsets, buf, col.validity)
+        return StringColumn(new_offsets, buf, col.validity,
+                            max_bytes=col.max_bytes)
 
 
 class StringTrimLeft(StringTrim):
@@ -424,7 +425,7 @@ class Reverse(Expression):
                        0, col.capacity - 1)
         src = jnp.clip(starts[row] + (ends[row] - 1 - j), 0, B - 1)
         return StringColumn(col.offsets, jnp.take(col.data, src),
-                            col.validity)
+                            col.validity, max_bytes=col.max_bytes)
 
 
 class StringRepeat(_HostStringOp):

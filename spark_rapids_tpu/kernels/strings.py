@@ -40,9 +40,24 @@ def _pack_words(offsets, data, num_words: int):
 
 
 def needed_key_words(col: StringColumn, num_rows: int) -> int:
-    """Bucketed uint64 word count needed to encode this column's strings."""
-    lens = np.asarray(col.offsets[1:]) - np.asarray(col.offsets[:-1])
-    max_len = int(lens[:num_rows].max()) if num_rows else 0
+    """Bucketed uint64 word count needed to encode this column's strings.
+
+    Uses the column's host-known ``max_bytes`` bound when present; a
+    column derived purely on device pays ONE offsets sync and caches
+    the bound on the instance (each uncached call would otherwise
+    serialize behind all pending device work)."""
+    max_len = col.max_bytes
+    if max_len is None:
+        cached = getattr(col, "_live_max_bytes", None)
+        if cached is not None and cached[0] >= num_rows:
+            max_len = cached[1]
+        else:
+            lens = np.asarray(col.offsets[1:]) - np.asarray(
+                col.offsets[:-1])
+            # restrict to live rows: stale offsets beyond num_rows (a
+            # shrunk batch) must not inflate the bucket
+            max_len = int(lens[:num_rows].max()) if num_rows else 0
+            col._live_max_bytes = (num_rows, max_len)
     num_words = max(1, -(-max_len // 8))
     return 1 << (num_words - 1).bit_length()
 
@@ -122,11 +137,13 @@ def lower_bytes(data):
 
 
 def upper(col: StringColumn) -> StringColumn:
-    return StringColumn(col.offsets, upper_bytes(col.data), col.validity)
+    return StringColumn(col.offsets, upper_bytes(col.data), col.validity,
+                        max_bytes=col.max_bytes)
 
 
 def lower(col: StringColumn) -> StringColumn:
-    return StringColumn(col.offsets, lower_bytes(col.data), col.validity)
+    return StringColumn(col.offsets, lower_bytes(col.data), col.validity,
+                        max_bytes=col.max_bytes)
 
 
 @jax.jit
@@ -153,7 +170,10 @@ def substring(col: StringColumn, start: int, length: int) -> StringColumn:
     total = int(new_offsets[-1])
     out_bytes = bucket_capacity(max(1, total))
     buf = _materialize_bytes(col.data, new_offsets, src_starts, out_bytes)
-    return StringColumn(new_offsets, buf, col.validity)
+    mb = col.max_bytes
+    if mb is not None and length is not None:
+        mb = min(mb, max(length, 0))
+    return StringColumn(new_offsets, buf, col.validity, max_bytes=mb)
 
 
 def char_length(col: StringColumn) -> jnp.ndarray:

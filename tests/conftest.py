@@ -7,6 +7,12 @@ hardware.
 """
 import os
 
+# Tests exercise the 16-row capacity buckets (cheap compiles on the CPU
+# backend, and capacity-edge cases stay reachable with tiny inputs); the
+# TPU-production default is larger to keep the per-query program count
+# down (see columnar/column.py MIN_CAPACITY).
+os.environ.setdefault("SPARK_RAPIDS_TPU_MIN_CAPACITY", "16")
+
 # The image's sitecustomize registers the axon TPU backend and forces
 # JAX_PLATFORMS=axon in every interpreter, so the env var alone is not
 # enough — override through the config API after import, before any

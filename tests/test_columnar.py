@@ -75,3 +75,17 @@ def test_decimal_dtype():
     assert d.name == "decimal(12,2)"
     with pytest.raises(ValueError):
         T.DecimalType(25, 2)
+
+
+def test_large_min_capacity_padding():
+    """Production runs with a 1024-row minimum bucket
+    (SPARK_RAPIDS_TPU_MIN_CAPACITY); exercise a large pad ratio
+    explicitly since the suite pins the bucket to 16."""
+    col = Column.from_numpy([1, 2, None, 4], dtype=T.INT64, capacity=1024)
+    assert col.capacity == 1024
+    assert col.to_pylist(4) == [1, 2, None, 4]
+    from spark_rapids_tpu.columnar.column import StringColumn
+    sc = StringColumn.from_pylist(["ab", None, "c" * 40], capacity=1024)
+    assert sc.capacity == 1024
+    assert sc.max_bytes == 40
+    assert sc.to_pylist(3) == ["ab", None, "c" * 40]
