@@ -499,7 +499,6 @@ def _concat_string_cols(cols: Sequence[StringColumn], nrows: Sequence[int],
     vpad = cap - int(valid.shape[0])
     if vpad > 0:
         valid = jnp.pad(valid, (0, vpad))
-    mbs = [c.max_bytes for c in cols]
-    mb = max(mbs) if mbs and all(m is not None for m in mbs) else None
+    mb = StringColumn.combined_max_bytes(cols)
     return StringColumn(offsets.astype(jnp.int32), jnp.asarray(buf), valid,
                         max_bytes=mb)

@@ -302,6 +302,14 @@ class StringColumn(Column):
     def nbytes(self) -> int:
         return self.offsets.nbytes + self.data.nbytes + self.validity.nbytes
 
+    @staticmethod
+    def combined_max_bytes(cols):
+        """Upper bound for a column combined from ``cols`` (concat /
+        case-when select); None when any input bound is unknown."""
+        mbs = [c.max_bytes for c in cols]
+        return max(mbs) if mbs and all(m is not None for m in mbs) \
+            else None
+
     def device_buffers(self):
         return [self.offsets, self.data, self.validity]
 

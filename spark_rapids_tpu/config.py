@@ -96,6 +96,24 @@ BATCH_SIZE_ROWS = conf_int(
 BATCH_SIZE_BYTES = conf_bytes(
     "spark.rapids.tpu.sql.batchSizeBytes", 512 * 2**20,
     "Target bytes per columnar batch for coalescing")
+SORT_OOC_CHUNK_ROWS = conf_int(
+    "spark.rapids.tpu.sql.sort.outOfCore.chunkRows", 1 << 22,
+    "Out-of-core sort merge emits chunks of at most about this many "
+    "rows; a partition with more buffered rows than this merges via "
+    "range-sliced spillable runs instead of one concat "
+    "(reference: GpuSortExec.scala:219 out-of-core mode)")
+JOIN_GATHER_CHUNK_ROWS = conf_int(
+    "spark.rapids.tpu.sql.join.gather.chunkRows", 1 << 22,
+    "Join output rows gathered per expansion chunk; a (stream batch, "
+    "build) pair whose match total exceeds this expands incrementally "
+    "— splitting even one probe row's matches across chunks — so no "
+    "single output allocation exceeds the budget "
+    "(reference: JoinGatherer.scala bounded gather)")
+SORT_OOC_SAMPLES = conf_int(
+    "spark.rapids.tpu.sql.sort.outOfCore.samplesPerRun", 256,
+    "Sorted-run key samples kept per run for choosing merge range "
+    "boundaries (slack per run-boundary is ~run_rows/samples)",
+    internal=True)
 CONCURRENT_TPU_TASKS = conf_int(
     "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
     "Max concurrent tasks admitted to the device (reference: "

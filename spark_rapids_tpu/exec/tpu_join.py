@@ -181,15 +181,17 @@ class TpuHashJoinBase(TpuExec):
                     pa = self._probe_phase(sb, skey_cols, bt, str_words,
                                            build_matched, direct)
                 pending.flush()
-            with timed(self.metrics[JOIN_TIME]):
-                if pa is None:   # legacy eager path (full/residual/etc)
-                    out = self._join_batch(sb, skey_cols, build, bt,
-                                           str_words, build_matched)
-                else:
-                    out = self._expand_phase(sb, build, bt, *pa)
-            if out is not None:
-                self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
-                yield out
+            if pa is None:   # legacy eager path (full/residual/etc)
+                with timed(self.metrics[JOIN_TIME]):
+                    outs = [self._join_batch(sb, skey_cols, build, bt,
+                                             str_words, build_matched)]
+            else:
+                # generator: each chunk's expansion times itself
+                outs = self._expand_phases(sb, build, bt, *pa)
+            for out in outs:
+                if out is not None:
+                    self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
+                    yield out
 
         if lg.join_type == "full" and build is not None:
             out = self._unmatched_build_rows(build, build_matched,
@@ -335,6 +337,67 @@ class TpuHashJoinBase(TpuExec):
             TpuHashJoinBase._PROBE_JIT[key] = False
             return None
         return (jt, outer_stream, lo, counts, eff, LazyCount(total))
+
+    def _expand_phases(self, sb, build, bt, jt, outer_stream, lo, counts,
+                       eff, total_lazy):
+        """Bounded incremental gather (JoinGatherer.scala:1 role).
+
+        A skewed key can explode one (stream batch, build) pair far past
+        device memory; when the total exceeds the chunk budget, expand in
+        probe-row ranges — splitting even a single probe row's matches
+        across chunks by advancing its ``lo`` offset — so no single
+        output allocation exceeds the budget.  Yields chunks lazily so
+        downstream can consume (or spill) chunk k before chunk k+1's
+        gather allocates."""
+        from ..config import get_active, JOIN_GATHER_CHUNK_ROWS
+        total = int(total_lazy)
+        limit = int(get_active().get(JOIN_GATHER_CHUNK_ROWS))
+        if total <= limit or jt in ("semi", "anti"):
+            with timed(self.metrics[JOIN_TIME]):
+                out = self._expand_phase(sb, build, bt, jt, outer_stream,
+                                         lo, counts, eff, total)
+            if out is not None:
+                yield out
+            return
+        with timed(self.metrics[JOIN_TIME]):
+            eff_np = np.asarray(eff).astype(np.int64)
+            lo_np = np.asarray(lo).astype(np.int32)
+        nrows = eff_np.shape[0]
+        p0 = 0
+        off0 = 0          # matches of row p0 already emitted
+        while p0 < nrows:
+            budget = limit
+            chunk_eff = np.zeros(nrows, np.int64)
+            chunk_lo = lo_np.copy()
+            p, off = p0, off0
+            chunk_total = 0
+            while p < nrows and budget > 0:
+                avail = int(eff_np[p]) - off
+                if avail <= 0:
+                    p += 1
+                    off = 0
+                    continue
+                take = min(avail, budget)
+                chunk_eff[p] = take
+                if off:
+                    chunk_lo[p] = lo_np[p] + off
+                chunk_total += take
+                budget -= take
+                if take == avail:
+                    p += 1
+                    off = 0
+                else:
+                    off += take
+            if chunk_total == 0:
+                break
+            with timed(self.metrics[JOIN_TIME]):
+                out = self._expand_phase(
+                    sb, build, bt, jt, outer_stream,
+                    jnp.asarray(chunk_lo), counts,
+                    jnp.asarray(chunk_eff.astype(np.int32)), chunk_total)
+            if out is not None:
+                yield out
+            p0, off0 = p, off
 
     def _expand_phase(self, sb, build, bt, jt, outer_stream, lo, counts,
                       eff, total_lazy) -> Optional[ColumnarBatch]:

@@ -33,16 +33,22 @@ class TpuSort(TpuExec):
     def output_schema(self):
         return self.children[0].output_schema
 
+    def _key_cols(self, batch: ColumnarBatch):
+        schema = batch.schema
+        return [ec.eval_as_column(o.expr.bind(schema), batch)
+                for o in self.orders]
+
+    def _key_words(self, cols, num_rows, str_words=None):
+        return canon.batch_key_words(
+            cols, num_rows,
+            descending=[not o.ascending for o in self.orders],
+            nulls_last=[not o.effective_nulls_first for o in self.orders],
+            str_words=str_words)
+
     def _sort_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
         if batch.num_rows == 0:
             return batch
-        schema = batch.schema
-        cols = [ec.eval_as_column(o.expr.bind(schema), batch)
-                for o in self.orders]
-        words = canon.batch_key_words(
-            cols, batch.num_rows,
-            descending=[not o.ascending for o in self.orders],
-            nulls_last=[not o.effective_nulls_first for o in self.orders])
+        words = self._key_words(self._key_cols(batch), batch.num_rows)
         perm = sort_permutation(words)
         out = batch.gather(perm, batch.num_rows)
         mask = jnp.arange(out.capacity) < batch.num_rows
@@ -66,26 +72,192 @@ class TpuSort(TpuExec):
             # GpuSortExec.scala:219), then merge.
             from ..memory.spillable import SpillableBatch
             from ..memory.arena import DeviceManager
-            runs = []
+            from ..config import get_active, SORT_OOC_CHUNK_ROWS
+            runs = []          # (SpillableBatch, n_rows)
+            total = 0
             for b in part:
                 if b.num_rows == 0:
                     continue
                 with timed(self.metrics[SORT_TIME]):
                     sorted_run = self._sort_batch(b)
+                    n = int(sorted_run.num_rows)
                 DeviceManager.get().reserve(sorted_run.nbytes())
-                runs.append(SpillableBatch(sorted_run))
+                runs.append((SpillableBatch(sorted_run), n))
+                total += n
             if not runs:
                 return
+            chunk_rows = int(get_active().get(SORT_OOC_CHUNK_ROWS))
+            if len(runs) == 1 or total <= chunk_rows:
+                # in-core: one concat + resort (modes 1/2)
+                with timed(self.metrics[SORT_TIME]):
+                    batches = [r.materialize() for r, _ in runs]
+                    merged = concat_batches(batches) if len(batches) > 1 \
+                        else batches[0]
+                    out = self._sort_batch(merged)
+                for r, _ in runs:
+                    r.close()
+                self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
+                yield out
+                return
+            # mode 3: out-of-core range merge over spillable runs.
+            # Sampling happens HERE (not per-run above) so the common
+            # in-core path never pays it; one run materializes at a
+            # time, bounded by a single input batch.
+            sampled = []
+            for spill, n in runs:
+                was_spilled = spill.is_spilled()
+                with timed(self.metrics[SORT_TIME]):
+                    samples, strw = self._run_samples(
+                        spill.materialize(), n)
+                if was_spilled:
+                    # push the run straight back down: sampling must not
+                    # leave every run device-resident (that would defeat
+                    # the out-of-core mode in exactly its target case)
+                    spill.demote()
+                sampled.append((spill, n, samples, strw))
+            yield from self._merge_out_of_core(sampled, total, chunk_rows)
+        return [run(p) for p in self.children[0].execute()]
+
+    # -- out-of-core merge (GpuSortExec.scala:219 third mode) --------------
+    def _run_samples(self, sorted_run: ColumnarBatch, n: int):
+        """(sample key mini-batch positions+cols, string word counts)
+        recorded while the sorted run is still on device."""
+        import numpy as np
+        from ..config import get_active, SORT_OOC_SAMPLES
+        from ..columnar.column import StringColumn, bucket_capacity
+        from ..kernels.strings import needed_key_words
+        s = min(n, int(get_active().get(SORT_OOC_SAMPLES)))
+        pos = np.unique(np.linspace(0, n - 1, s).astype(np.int64))
+        key_cols = self._key_cols(sorted_run)
+        # pad sample positions to a capacity bucket so the gather kernel
+        # compiles once per bucket, not once per sample count
+        cap = bucket_capacity(len(pos))
+        padded = np.full(cap, pos[-1], np.int64)
+        padded[:len(pos)] = pos
+        idx = jnp.asarray(padded)
+        sample_cols = [c.gather(idx) for c in key_cols]
+        strw = [needed_key_words(c, n) if isinstance(c, StringColumn)
+                else None for c in key_cols]
+        return (pos, sample_cols), strw
+
+    def _merge_out_of_core(self, runs, total: int, chunk_rows: int):
+        """Range-partitioned k-way merge: choose boundary keys from the
+        runs' samples, then per output chunk upload only each run's
+        candidate slice (catalog.acquire_slice keeps spilled runs
+        spilled), filter to the exact range, and sort.
+
+        Exactness: a run's rows in [b_i, b_{i+1}) all lie between the
+        last sample < b_i and the first sample >= b_{i+1} (runs are
+        sorted), so slicing at sample positions over-covers and the
+        device-side range filter trims to exact, half-open ranges."""
+        import numpy as np
+
+        # global word count per string key so words compare across runs
+        nkeys = len(self.orders)
+        strw_global = []
+        for k in range(nkeys):
+            ws = [r[3][k] for r in runs]
+            strw_global.append(max(w for w in ws) if ws[0] is not None
+                               else None)
+
+        def to_void(word_arrays):
+            """[n] u64 word columns -> [n] big-endian void keys whose
+            memcmp order equals lexicographic word order.  byteswap AFTER
+            stacking: np.stack silently casts '>u8' inputs back to
+            native-endian."""
+            m = np.stack([np.asarray(w) for w in word_arrays],
+                         axis=1).astype(np.uint64).byteswap()
+            return np.ascontiguousarray(m).view(
+                np.dtype((np.void, 8 * m.shape[1]))).reshape(-1)
+
+        # sample words per run, encoded with the GLOBAL string widths
+        run_sample_void = []
+        all_void = []
+        for spill, n, (pos, sample_cols), _ in runs:
+            words = self._key_words(sample_cols, len(pos),
+                                    str_words=strw_global)
+            v = to_void([w[:len(pos)] for w in words])
+            run_sample_void.append(v)
+            all_void.append(v)
+        merged_samples = np.sort(np.concatenate(all_void))
+        n_chunks = max(1, -(-total // chunk_rows))
+        # boundary keys at sample quantiles (dedup keeps them strict)
+        cuts = np.unique(merged_samples[
+            (np.arange(1, n_chunks) * len(merged_samples)) // n_chunks])
+
+        bounds = [None] + list(cuts) + [None]
+        try:
+            yield from self._merge_chunks(runs, run_sample_void, bounds,
+                                          strw_global)
+        finally:
+            # close even if the consumer stops early (limit over sort):
+            # a leaked run keeps its catalog entry + spill files forever
+            for spill, _, _, _ in runs:
+                spill.close()
+
+    def _merge_chunks(self, runs, run_sample_void, bounds, strw_global):
+        import numpy as np
+        for ci in range(len(bounds) - 1):
+            b_lo, b_hi = bounds[ci], bounds[ci + 1]
+            pieces = []
+            for (spill, n, (pos, _), _), sv in zip(runs, run_sample_void):
+                lo_i = 0 if b_lo is None else \
+                    int(pos[max(np.searchsorted(sv, b_lo, "left") - 1, 0)])
+                if b_hi is None:
+                    hi_i = n
+                else:
+                    j = int(np.searchsorted(sv, b_hi, "left"))
+                    hi_i = n if j >= len(pos) else int(pos[j])
+                if hi_i > lo_i:
+                    pieces.append(spill.materialize_slice(lo_i, hi_i))
+            if not pieces:
+                continue
             with timed(self.metrics[SORT_TIME]):
-                batches = [r.materialize() for r in runs]
-                merged = concat_batches(batches) if len(batches) > 1 \
-                    else batches[0]
-                out = self._sort_batch(merged)
-            for r in runs:
-                r.close()
+                chunk = concat_batches(pieces) if len(pieces) > 1 \
+                    else pieces[0]
+                chunk = self._range_filter(chunk, b_lo, b_hi, strw_global)
+                if chunk.num_rows == 0:
+                    continue
+                out = self._sort_batch(chunk)
             self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
             yield out
-        return [run(p) for p in self.children[0].execute()]
+
+    def _range_filter(self, chunk: ColumnarBatch, b_lo, b_hi,
+                      strw_global) -> ColumnarBatch:
+        """Keep rows with b_lo <= key words < b_hi (None = unbounded)."""
+        import numpy as np
+        from ..kernels import basic as bk
+        if b_lo is None and b_hi is None:
+            return chunk
+        words = self._key_words(self._key_cols(chunk), chunk.num_rows,
+                                str_words=strw_global)
+
+        def unpack(v):
+            return np.frombuffer(bytes(v), dtype=">u8").astype(np.uint64)
+
+        def cmp_lt(ws, bound):
+            """row words < bound (lexicographic), vectorized."""
+            lt = jnp.zeros(ws[0].shape[0], bool)
+            eq = jnp.ones(ws[0].shape[0], bool)
+            for w, b in zip(ws, bound):
+                bv = jnp.uint64(int(b))
+                lt = lt | (eq & (w < bv))
+                eq = eq & (w == bv)
+            return lt, eq
+        keep = jnp.ones(words[0].shape[0], bool)
+        if b_lo is not None:
+            lt, _ = cmp_lt(words, unpack(b_lo))
+            keep = keep & ~lt
+        if b_hi is not None:
+            lt, _ = cmp_lt(words, unpack(b_hi))
+            keep = keep & lt
+        idx, cnt = bk.compact_indices(keep, chunk.num_rows)
+        n = int(cnt)
+        out = chunk.gather(idx, n)
+        mask = jnp.arange(out.capacity) < n
+        return ColumnarBatch(out.schema,
+                             [c.mask_validity(mask) for c in out.columns],
+                             n)
 
 
 class TpuTopN(TpuExec):

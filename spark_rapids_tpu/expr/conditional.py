@@ -94,8 +94,7 @@ def _select_strings(conds, cols, cap):
         row_of_j = jnp.clip(
             jnp.searchsorted(new_offsets[1:], j, side="right"), 0, cap - 1)
         out = jnp.where(sel[row_of_j] == i, buf_i, out)
-    mbs = [c.max_bytes for c in cols]
-    mb = max(mbs) if mbs and all(m is not None for m in mbs) else None
+    mb = StringColumn.combined_max_bytes(cols)
     return StringColumn(new_offsets, out, valid, max_bytes=mb), valid
 
 

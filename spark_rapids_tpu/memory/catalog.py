@@ -49,6 +49,9 @@ class BufferEntry:
     host_payload: object = None
     disk_path: Optional[str] = None
     refcount: int = 0
+    # decompressed .raw cache for repeated acquire_slice over a
+    # compressed DISK entry (cleared on any tier change)
+    raw_cache: Optional[bytes] = None
 
 
 class BufferCatalog:
@@ -127,6 +130,14 @@ class BufferCatalog:
                 if e.disk_path and os.path.exists(e.disk_path + ".raw"):
                     os.unlink(e.disk_path + ".raw")
 
+    def demote(self, buffer_id: str):
+        """Serialize a DEVICE-tier entry down to the HOST tier (used by
+        the out-of-core sort after sampling a materialized run)."""
+        with self._lock:
+            e = self._entries.get(buffer_id)
+            if e is not None and e.tier == StorageTier.DEVICE:
+                self._spill_entry_to_host(e)
+
     # -- acquire (may unspill, like RapidsBufferCatalog.acquireBuffer) -----
     def acquire(self, buffer_id: str):
         with self._lock:
@@ -152,22 +163,144 @@ class BufferCatalog:
         import jax.numpy as jnp
         from ..columnar.batch import ColumnarBatch
         from ..columnar.column import Column, StringColumn
+        from ..columnar.binary64 import Binary64Column
         schema, num_rows, kinds, bufs = payload
         cols = []
         i = 0
         for f, kind in zip(schema, kinds):
             if kind == "StringColumn":
                 offsets, data, validity = bufs[i], bufs[i + 1], bufs[i + 2]
+                max_b = int(np.diff(
+                    np.asarray(offsets)[:num_rows + 1]).max()) \
+                    if num_rows else 0
                 cols.append(StringColumn(jnp.asarray(offsets),
                                          jnp.asarray(data),
-                                         jnp.asarray(validity)))
+                                         jnp.asarray(validity),
+                                         max_bytes=max_b))
                 i += 3
+            elif kind == "Binary64Column":
+                # exact-double mode: data is int64 bit patterns, NOT a
+                # float payload — restoring as a plain Column would
+                # reinterpret bits as f64 values downstream
+                data, validity = bufs[i], bufs[i + 1]
+                cols.append(Binary64Column(jnp.asarray(data),
+                                           jnp.asarray(validity)))
+                i += 2
             else:
                 data, validity = bufs[i], bufs[i + 1]
                 cols.append(Column(f.dtype, jnp.asarray(data),
                                    jnp.asarray(validity)))
                 i += 2
         return ColumnarBatch(schema, cols, num_rows)
+
+    def acquire_slice(self, buffer_id: str, lo: int, hi: int):
+        """Materialize ONLY rows [lo, hi) of a spilled batch.
+
+        The out-of-core sort merge (GpuSortExec.scala:219 role) walks
+        spilled sorted runs in bounded chunks; bringing a whole run back
+        to the device tier per chunk would defeat the spill.  DEVICE-tier
+        entries slice on device; HOST/DISK entries slice the host numpy
+        payload and upload just the slice."""
+        with self._lock:
+            e = self._entries[buffer_id]
+            if e.tier == StorageTier.DEVICE:
+                return e.device_obj.slice(lo, hi - lo)
+            if e.tier == StorageTier.HOST:
+                schema, num_rows, kinds, fetch = self._host_fetcher(e)
+            else:
+                schema, num_rows, kinds, fetch = self._disk_fetcher(e)
+            return _slice_from_fetch(schema, num_rows, kinds, fetch,
+                                     lo, hi)
+
+    @staticmethod
+    def _meta_fetcher(metas, read_bytes):
+        """fetch(buf_idx, elem_lo, elem_hi) over a flat byte region
+        described by ``metas`` [(dtype_str, shape)], reading ONLY the
+        requested element range via ``read_bytes(byte_off, nbytes)``."""
+        starts = []
+        pos = 0
+        infos = []
+        for dtype_str, shape in metas:
+            dt = np.dtype(dtype_str)
+            count = int(np.prod(shape)) if shape else 1
+            starts.append(pos)
+            infos.append((dt, shape, count))
+            pos += count * dt.itemsize
+
+        def fetch(i, elem_lo, elem_hi):
+            dt, shape, count = infos[i]
+            if len(shape) != 1:   # nested layouts: read whole buffer
+                raw = read_bytes(starts[i], count * dt.itemsize)
+                return np.frombuffer(raw, dt).reshape(shape)
+            elem_lo = max(0, min(elem_lo, count))
+            elem_hi = max(elem_lo, min(elem_hi, count))
+            raw = read_bytes(starts[i] + elem_lo * dt.itemsize,
+                             (elem_hi - elem_lo) * dt.itemsize)
+            return np.frombuffer(raw, dt)
+        return fetch
+
+    def _host_fetcher(self, e: BufferEntry):
+        """(schema, num_rows, kinds, fetch) for a HOST-tier entry without
+        freeing its arena slab (the destructive reader is
+        _unpack_payload, used by full unspills)."""
+        p = e.host_payload
+        if isinstance(p, tuple) and p and p[0] == "arena":
+            _, schema, num_rows, kinds, metas, off, total = p
+
+            def read_bytes(boff, nb):
+                return bytes(self.arena.view(off + boff, nb)) if nb \
+                    else b""
+            return schema, num_rows, kinds, \
+                self._meta_fetcher(metas, read_bytes)
+        schema, num_rows, kinds, bufs = p
+
+        def fetch(i, elem_lo, elem_hi):
+            b = bufs[i]
+            if b.ndim != 1:
+                return b
+            return b[elem_lo:elem_hi]
+        return schema, num_rows, kinds, fetch
+
+    def _disk_fetcher(self, e: BufferEntry):
+        """(schema, num_rows, kinds, fetch) for a DISK-tier entry without
+        changing its tier.  Uncompressed raw files are read by seek/read
+        of just the requested ranges; compressed files decompress once
+        per call (no random access into the codec stream)."""
+        with open(e.disk_path, "rb") as f:
+            payload = pickle.load(f)
+        if not (isinstance(payload, tuple) and payload
+                and payload[0] == "arena_file"):
+            schema, num_rows, kinds, bufs = payload
+
+            def fetch(i, elem_lo, elem_hi):
+                b = bufs[i]
+                if b.ndim != 1:
+                    return b
+                return b[elem_lo:elem_hi]
+            return schema, num_rows, kinds, fetch
+        _, schema, num_rows, kinds, metas, total, codec_name = payload
+        if codec_name != "none":
+            raw = e.raw_cache
+            if raw is None:
+                from ..shuffle.compression import get_codec
+                with open(e.disk_path + ".raw", "rb") as f:
+                    raw = get_codec(codec_name).decompress(f.read(),
+                                                           max(total, 1))
+                e.raw_cache = raw
+
+            def read_bytes(boff, nb):
+                return raw[boff:boff + nb]
+        else:
+            path = e.disk_path + ".raw"
+
+            def read_bytes(boff, nb):
+                if not nb:
+                    return b""
+                with open(path, "rb") as f:
+                    f.seek(boff)
+                    return f.read(nb)
+        return schema, num_rows, kinds, \
+            self._meta_fetcher(metas, read_bytes)
 
     def _spill_entry_to_host(self, e: BufferEntry):
         payload = self._serialize(e.device_obj)
@@ -276,6 +409,7 @@ class BufferCatalog:
             payload = ("arena", schema, num_rows, kinds, metas, off, total)
         os.unlink(e.disk_path)
         e.disk_path = None
+        e.raw_cache = None
         e.host_payload = payload
         e.tier = StorageTier.HOST
         self.disk_bytes -= e.nbytes
@@ -319,3 +453,49 @@ class BufferCatalog:
                         num_buffers=len(self._entries),
                         spilled_device_to_host=self.spilled_device_to_host,
                         spilled_host_to_disk=self.spilled_host_to_disk)
+
+
+def _slice_from_fetch(schema, num_rows, kinds, fetch, lo: int, hi: int):
+    """Rows [lo, hi) of a serialized batch as a device batch, reading
+    only the slice's elements via ``fetch(buf_idx, elem_lo, elem_hi)``.
+
+    Only the slice's bytes cross to the device (the out-of-core merge
+    contract).  Strings rebase offsets onto a sliced byte buffer."""
+    import jax.numpy as jnp
+    from ..columnar.batch import ColumnarBatch
+    from ..columnar.column import (Column, StringColumn, bucket_capacity,
+                                   _pad_np)
+    from ..columnar.binary64 import Binary64Column
+    lo = max(0, min(lo, num_rows))
+    hi = max(lo, min(hi, num_rows))
+    n = hi - lo
+    cap = bucket_capacity(max(n, 1))
+    cols = []
+    i = 0
+    for f, kind in zip(schema, kinds):
+        if kind == "StringColumn":
+            offs = np.asarray(fetch(i, lo, hi + 1))
+            base, end = int(offs[0]), int(offs[n])
+            sub = np.zeros(cap + 1, np.int32)
+            sub[:n + 1] = offs[:n + 1] - base
+            sub[n + 1:] = sub[n]
+            byte_cap = bucket_capacity(max(end - base, 1))
+            buf = np.zeros(byte_cap, np.uint8)
+            buf[:end - base] = np.asarray(fetch(i + 1, base, end))
+            validity = np.asarray(fetch(i + 2, lo, hi))
+            mb = int(np.diff(offs[:n + 1]).max()) if n else 0
+            cols.append(StringColumn(
+                jnp.asarray(sub), jnp.asarray(buf),
+                jnp.asarray(_pad_np(validity, cap, fill=False)),
+                max_bytes=mb))
+            i += 3
+            continue
+        d = jnp.asarray(_pad_np(np.asarray(fetch(i, lo, hi)), cap))
+        v = jnp.asarray(_pad_np(np.asarray(fetch(i + 1, lo, hi)), cap,
+                                fill=False))
+        i += 2
+        if kind == "Binary64Column":
+            cols.append(Binary64Column(d, v))
+        else:
+            cols.append(Column(f.dtype, d, v))
+    return ColumnarBatch(schema, cols, n)

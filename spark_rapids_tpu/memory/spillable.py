@@ -26,6 +26,22 @@ class SpillableBatch:
         assert not self._closed, "use after close"
         return self.catalog.acquire(self.buffer_id)
 
+    def is_spilled(self) -> bool:
+        from .catalog import StorageTier
+        e = self.catalog._entries.get(self.buffer_id)
+        return e is not None and e.tier != StorageTier.DEVICE
+
+    def demote(self):
+        """Push this batch back off the device tier (host)."""
+        self.catalog.demote(self.buffer_id)
+
+    def materialize_slice(self, lo: int, hi: int):
+        """Device batch of rows [lo, hi) only; a spilled entry stays
+        spilled and only the slice's bytes are uploaded (out-of-core
+        sort-merge contract)."""
+        assert not self._closed, "use after close"
+        return self.catalog.acquire_slice(self.buffer_id, lo, hi)
+
     def close(self):
         if not self._closed:
             self.catalog.unregister(self.buffer_id)
