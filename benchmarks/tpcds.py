@@ -612,7 +612,7 @@ def main():
     ap.add_argument("--data-dir", default="/tmp/tpcds_data")
     ap.add_argument("--repeats", type=int, default=2)
     args = ap.parse_args()
-    tag = os.path.join(args.data_dir, f"sf{args.scale}_v4")
+    tag = os.path.join(args.data_dir, f"sf{args.scale}_v5")
     if not os.path.exists(os.path.join(tag, "store_sales.parquet")):
         sizes = generate(tag, args.scale)
         print(f"generated {sizes}", file=sys.stderr)

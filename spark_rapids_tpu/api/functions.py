@@ -341,6 +341,18 @@ def dense_rank() -> Col:
     return Col(wf.DenseRank())
 
 
+def ntile(n: int) -> Col:
+    return Col(wf.NTile(n))
+
+
+def percent_rank() -> Col:
+    return Col(wf.PercentRank())
+
+
+def cume_dist() -> Col:
+    return Col(wf.CumeDist())
+
+
 def lead(c, offset: int = 1) -> Col:
     return Col(wf.Lead(_expr(c if not isinstance(c, str) else col(c)),
                        offset))

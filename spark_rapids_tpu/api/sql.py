@@ -289,7 +289,7 @@ _AGG_FUNCS = {"sum", "count", "min", "max", "avg", "mean", "first", "last",
               "count_distinct", "stddev", "stddev_samp", "std",
               "stddev_pop", "variance", "var_samp", "var_pop"}
 _WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "ntile", "lead",
-                      "lag"}
+                      "lag", "percent_rank", "cume_dist"}
 
 
 class _Parser:
@@ -1941,6 +1941,10 @@ class _Lowerer:
             func = ewin.DenseRank()
         elif n == "ntile":
             func = ewin.NTile(_pyval(lower(f.args[0])))
+        elif n == "percent_rank":
+            func = ewin.PercentRank()
+        elif n == "cume_dist":
+            func = ewin.CumeDist()
         elif n in ("lead", "lag"):
             off = _pyval(lower(f.args[1])) if len(f.args) > 1 else 1
             dflt = _pyval(lower(f.args[2])) if len(f.args) > 2 else None

@@ -106,6 +106,8 @@ class CpuWindow(CpuExec):
                              isinstance(x, float) and np.isnan(x))]
                 if agg == "count":
                     out.append(len(clean))
+                elif agg == "collect_list":
+                    out.append(list(clean))
                 elif not clean:
                     out.append(None)
                 elif agg == "sum":
@@ -151,8 +153,55 @@ class CpuWindow(CpuExec):
             fname = type(wf.func).__name__
             from ..expr import aggregates as eagg
             from ..expr.window_funcs import (RowNumber, Rank, DenseRank,
-                                             Lead, Lag)
-            if isinstance(wf.func, RowNumber):
+                                             Lead, Lag, NTile,
+                                             PercentRank, CumeDist)
+
+            def _rank_stats(gdf):
+                """(rank_min, rank_max, size) per row of a sorted group,
+                via order-key run boundaries (exact for multi-key
+                orderings, unlike column-wise pandas rank)."""
+                m = len(gdf)
+                newrun = np.zeros(m, bool)
+                newrun[0] = True
+                for kcol in skeys:
+                    colv = gdf[kcol].to_numpy(dtype=object)
+                    for i in range(1, m):
+                        a, b = colv[i], colv[i - 1]
+                        same = (a is b) or (a == b) or (
+                            pd.isna(a) is True and pd.isna(b) is True)
+                        if not same:
+                            newrun[i] = True
+                rmin = np.zeros(m, np.int64)
+                rmax = np.zeros(m, np.int64)
+                start = 0
+                for i in range(1, m + 1):
+                    if i == m or newrun[i]:
+                        rmin[start:i] = start + 1
+                        rmax[start:i] = i
+                        start = i
+                return rmin, rmax, m
+
+            if isinstance(wf.func, (NTile, PercentRank, CumeDist)):
+                fn = wf.func
+                outs = []
+                for _, g in grouped:
+                    if isinstance(fn, NTile):
+                        m = len(g)
+                        r = np.arange(m, dtype=np.int64)
+                        base, rem = divmod(m, fn.n)
+                        cut = rem * (base + 1)
+                        vals = np.where(
+                            r < cut, r // max(base + 1, 1),
+                            rem + (r - cut) // max(base, 1)) + 1
+                    else:
+                        rmin, rmax, m = _rank_stats(g)
+                        if isinstance(fn, PercentRank):
+                            vals = (rmin - 1) / (m - 1) if m > 1 else                                 np.zeros(m)
+                        else:
+                            vals = rmax / m
+                    outs.append(pd.Series(vals, index=g.index))
+                res = pd.concat(outs).reindex(work.index)
+            elif isinstance(wf.func, RowNumber):
                 res = grouped.cumcount() + 1
             elif isinstance(wf.func, Rank):
                 order_col = skeys[0] if skeys else pkeys[0]
@@ -206,9 +255,23 @@ class CpuWindow(CpuExec):
                     work[src] = _arr(cpu_eval(child, t),
                                      t.num_rows).to_pandas()
                 agg = {"Sum": "sum", "Count": "count", "Min": "min",
-                       "Max": "max", "Average": "mean"}[fname]
+                       "Max": "max", "Average": "mean",
+                       "CollectList": "collect_list"}[fname]
                 frame_kind, fstart, fend = spec.frame
-                if not skeys or (fstart is None and fend is None):
+                if agg == "collect_list":
+                    # always the exact per-row oracle (rows kind with
+                    # unbounded ends covers the whole partition)
+                    res = self._bounded_frame(
+                        grouped, work, src,
+                        skeys[0] if skeys else None,
+                        frame_kind if (fstart, fend) != (None, None)
+                        else "rows",
+                        fstart, fend, agg,
+                        spec.order_by[0].ascending if spec.order_by
+                        else True,
+                        spec.order_by[0].effective_nulls_first
+                        if spec.order_by else True)
+                elif not skeys or (fstart is None and fend is None):
                     res = grouped[src].transform(agg)
                     if agg != "count":
                         # all-null partition: pandas yields NaN, SQL NULL

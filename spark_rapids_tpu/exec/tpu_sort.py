@@ -149,7 +149,10 @@ class TpuSort(TpuExec):
         Exactness: a run's rows in [b_i, b_{i+1}) all lie between the
         last sample < b_i and the first sample >= b_{i+1} (runs are
         sorted), so slicing at sample positions over-covers and the
-        device-side range filter trims to exact, half-open ranges."""
+        device-side range filter trims to exact, half-open ranges.
+        Keys are extended with (run index, row position) tiebreaker
+        words so heavily duplicated sort keys still split into bounded
+        chunks instead of collapsing every cut onto one key value."""
         import numpy as np
 
         # global word count per string key so words compare across runs
@@ -170,18 +173,21 @@ class TpuSort(TpuExec):
             return np.ascontiguousarray(m).view(
                 np.dtype((np.void, 8 * m.shape[1]))).reshape(-1)
 
-        # sample words per run, encoded with the GLOBAL string widths
+        # sample words per run, encoded with the GLOBAL string widths,
+        # extended with (run, position) tiebreakers for uniqueness
         run_sample_void = []
         all_void = []
-        for spill, n, (pos, sample_cols), _ in runs:
+        for ri, (spill, n, (pos, sample_cols), _) in enumerate(runs):
             words = self._key_words(sample_cols, len(pos),
                                     str_words=strw_global)
-            v = to_void([w[:len(pos)] for w in words])
+            words = [np.asarray(w[:len(pos)]) for w in words]
+            words.append(np.full(len(pos), ri, np.uint64))
+            words.append(pos.astype(np.uint64))
+            v = to_void(words)
             run_sample_void.append(v)
             all_void.append(v)
         merged_samples = np.sort(np.concatenate(all_void))
         n_chunks = max(1, -(-total // chunk_rows))
-        # boundary keys at sample quantiles (dedup keeps them strict)
         cuts = np.unique(merged_samples[
             (np.arange(1, n_chunks) * len(merged_samples)) // n_chunks])
 
@@ -200,7 +206,8 @@ class TpuSort(TpuExec):
         for ci in range(len(bounds) - 1):
             b_lo, b_hi = bounds[ci], bounds[ci + 1]
             pieces = []
-            for (spill, n, (pos, _), _), sv in zip(runs, run_sample_void):
+            for ri, ((spill, n, (pos, _), _), sv) in enumerate(
+                    zip(runs, run_sample_void)):
                 lo_i = 0 if b_lo is None else \
                     int(pos[max(np.searchsorted(sv, b_lo, "left") - 1, 0)])
                 if b_hi is None:
@@ -209,28 +216,37 @@ class TpuSort(TpuExec):
                     j = int(np.searchsorted(sv, b_hi, "left"))
                     hi_i = n if j >= len(pos) else int(pos[j])
                 if hi_i > lo_i:
-                    pieces.append(spill.materialize_slice(lo_i, hi_i))
+                    piece = spill.materialize_slice(lo_i, hi_i)
+                    # filter per piece: the (run, position) tiebreaker
+                    # words depend on the piece's run and offset
+                    piece = self._range_filter(piece, b_lo, b_hi,
+                                               strw_global, ri, lo_i)
+                    if piece.num_rows:
+                        pieces.append(piece)
             if not pieces:
                 continue
             with timed(self.metrics[SORT_TIME]):
                 chunk = concat_batches(pieces) if len(pieces) > 1 \
                     else pieces[0]
-                chunk = self._range_filter(chunk, b_lo, b_hi, strw_global)
-                if chunk.num_rows == 0:
-                    continue
                 out = self._sort_batch(chunk)
             self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
             yield out
 
     def _range_filter(self, chunk: ColumnarBatch, b_lo, b_hi,
-                      strw_global) -> ColumnarBatch:
-        """Keep rows with b_lo <= key words < b_hi (None = unbounded)."""
+                      strw_global, run_idx: int,
+                      row_offset: int) -> ColumnarBatch:
+        """Keep rows with b_lo <= (key words, run, pos) < b_hi."""
         import numpy as np
         from ..kernels import basic as bk
         if b_lo is None and b_hi is None:
             return chunk
+        cap = chunk.capacity
         words = self._key_words(self._key_cols(chunk), chunk.num_rows,
                                 str_words=strw_global)
+        words = list(words)
+        words.append(jnp.full(cap, run_idx, jnp.uint64))
+        words.append((jnp.arange(cap, dtype=jnp.int64) + row_offset)
+                     .astype(jnp.uint64))
 
         def unpack(v):
             return np.frombuffer(bytes(v), dtype=">u8").astype(np.uint64)

@@ -123,6 +123,47 @@ class TpuWindow(TpuExec):
                         ).astype(jnp.int64)
             out_valid = live
             out_dtype = T.INT64
+        elif isinstance(func, (wfn.NTile, wfn.PercentRank, wfn.CumeDist)):
+            seg_len = jax.ops.segment_sum(
+                jnp.where(live, jnp.int64(1), jnp.int64(0)), seg,
+                num_segments=cap)
+            L = jnp.take(seg_len, seg)
+            if isinstance(func, wfn.NTile):
+                # Spark NTile: first (L % n) buckets hold ceil(L/n) rows
+                nb = jnp.int64(func.n)
+                base = L // nb
+                rem = L % nb
+                cut = rem * (base + 1)
+                vals = jnp.where(
+                    row_in_seg < cut,
+                    row_in_seg // jnp.maximum(base + 1, 1),
+                    rem + (row_in_seg - cut) // jnp.maximum(base, 1)) + 1
+                out_valid = live
+                out_dtype = T.INT64
+            else:
+                run_boundary = canon.words_equal_adjacent(sorted_ws) & live
+                run_id = jnp.maximum(
+                    jnp.cumsum(run_boundary.astype(jnp.int32)) - 1, 0)
+                if isinstance(func, wfn.PercentRank):
+                    run_first = jax.ops.segment_min(
+                        jnp.where(live, pos, jnp.int64(cap)), run_id,
+                        num_segments=cap)
+                    rank = (jnp.take(run_first, run_id) -
+                            jnp.take(seg_start, seg) + 1)
+                    vals = jnp.where(
+                        L > 1,
+                        (rank - 1).astype(jnp.float64) /
+                        jnp.maximum(L - 1, 1).astype(jnp.float64), 0.0)
+                else:   # CumeDist: rows <= current / partition rows
+                    run_last = jax.ops.segment_max(
+                        jnp.where(live, pos, jnp.int64(-1)), run_id,
+                        num_segments=cap)
+                    vals = (jnp.take(run_last, run_id) -
+                            jnp.take(seg_start, seg) + 1).astype(
+                        jnp.float64) / jnp.maximum(L, 1).astype(
+                        jnp.float64)
+                out_valid = live
+                out_dtype = T.FLOAT64
         elif isinstance(func, (wfn.Lead, wfn.Lag)):
             src = ec.eval_as_column(func.children[0].bind(batch.schema),
                                     batch)
@@ -156,6 +197,9 @@ class TpuWindow(TpuExec):
     def _window_agg(self, batch, func, spec, perm, seg, live, row_in_seg,
                     seg_start, n) -> Column:
         cap = batch.capacity
+        if isinstance(func, eagg.CollectList):
+            return self._window_collect(batch, func, spec, perm, seg,
+                                        live, row_in_seg, seg_start, n)
         child = func.children[0] if func.children else None
         if child is not None:
             src = ec.eval_as_column(child.bind(batch.schema), batch)
@@ -196,6 +240,71 @@ class TpuWindow(TpuExec):
         ok_orig = jnp.take(ok, inv) & (jnp.arange(cap) < n)
         return Column(out_dtype, vals_orig.astype(out_dtype.np_dtype),
                       ok_orig)
+
+    def _window_collect(self, batch, func, spec, perm, seg, live,
+                        row_in_seg, seg_start, n) -> Column:
+        """collect_list over a window frame -> ListColumn.
+
+        Elements come from the globally valid-compacted sorted rows:
+        row i's list is vpos[c_lo_i .. c_hi_i) where cnt is the prefix
+        count of valid sorted rows — one cumsum + one expand, no
+        per-row loops (GpuWindowExpression collect_list role)."""
+        from ..columnar.column import ListColumn, bucket_capacity
+        from ..kernels import basic as bk
+        from ..kernels import join as join_k
+        cap = batch.capacity
+        src = ec.eval_as_column(func.children[0].bind(batch.schema),
+                                batch)
+        sorted_src = src.gather(perm)
+        valid = sorted_src.validity & live
+        kind, frame_lo, frame_hi = spec.frame
+        seg_start_pos = jnp.take(seg_start, seg)
+        seg_len = jax.ops.segment_sum(
+            jnp.ones(cap, jnp.int64), seg, num_segments=cap)
+        seg_end_pos = seg_start_pos + jnp.take(seg_len, seg) - 1
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        if (frame_lo is None and frame_hi is None) or not spec.order_by:
+            lo_pos, hi_pos = seg_start_pos, seg_end_pos
+        elif kind == "range":
+            lo_pos, hi_pos = self._range_positions(
+                batch, spec, perm, seg, seg_start, live, cap,
+                frame_lo, frame_hi)
+        else:
+            lo_pos = seg_start_pos if frame_lo is None else \
+                jnp.maximum(pos + frame_lo, seg_start_pos)
+            hi_pos = seg_end_pos if frame_hi is None else \
+                jnp.minimum(pos + frame_hi, seg_end_pos)
+        cnt = jnp.cumsum(valid.astype(jnp.int64))
+        hi_c = jnp.clip(hi_pos, 0, cap - 1).astype(jnp.int32)
+        lo_c = jnp.clip(lo_pos - 1, -1, cap - 1)
+        c_hi = jnp.take(cnt, hi_c)
+        c_lo = jnp.where(lo_c < 0, 0, jnp.take(cnt, jnp.maximum(lo_c, 0)))
+        m_sorted = jnp.where(hi_pos < lo_pos, 0, c_hi - c_lo)
+        vpos, _ = bk.compact_indices(valid, cap)
+        inv = jnp.argsort(perm)
+        m_orig = jnp.where(jnp.arange(cap) < n,
+                           jnp.take(m_sorted, inv), 0)
+        c_lo_orig = jnp.take(c_lo, inv)
+        total = int(jnp.sum(m_orig))
+        out_cap = bucket_capacity(max(total, 1))
+        _, elem_pos, live_e, _ = join_k.expand_matches(
+            c_lo_orig.astype(jnp.int32), m_orig.astype(jnp.int32),
+            vpos.astype(jnp.int32), out_cap)
+        elements = sorted_src.gather(elem_pos)
+        elements = elements.mask_validity(live_e)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int64),
+             jnp.cumsum(m_orig)]).astype(jnp.int32)
+        out_valid = jnp.arange(cap) < n
+        return ListColumn(T.ArrayType(src.dtype), offsets, elements,
+                          out_valid)
+
+    @staticmethod
+    def _minmax_ident(is_min: bool, dtype):
+        if jnp.issubdtype(dtype, jnp.floating):
+            return jnp.asarray(jnp.inf if is_min else -jnp.inf, dtype)
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(info.max if is_min else info.min, dtype)
 
     def _seg_reduce(self, func, sv, sok, seg, cap):
         contrib_ok = sok
@@ -251,6 +360,16 @@ class TpuWindow(TpuExec):
         (segment, rank) keys — all vectorized searchsorted.
         """
         order = spec.order_by[0]
+        odt = order.expr.dtype()
+        if isinstance(odt, T.DecimalType):
+            # decimal order key: data is unscaled int64, so literal
+            # frame offsets scale by 10^scale (exact when the offset
+            # has no more fractional digits than the key's scale)
+            sf = 10 ** odt.scale
+            frame_lo = None if frame_lo is None else \
+                int(round(frame_lo * sf))
+            frame_hi = None if frame_hi is None else \
+                int(round(frame_hi * sf))
         ocol = ec.eval_as_column(order.expr.bind(batch.schema), batch)
         vals_sorted = jnp.take(ocol.data, perm).astype(jnp.int64)
         ovalid = jnp.take(ocol.validity, perm) & live
@@ -353,13 +472,7 @@ class TpuWindow(TpuExec):
                 hi == 0:
             # running min/max: segmented inclusive scan
             is_min = isinstance(func, eagg.Min)
-            if jnp.issubdtype(sv.dtype, jnp.floating):
-                ident = jnp.asarray(jnp.inf if is_min else -jnp.inf,
-                                    sv.dtype)
-            else:
-                info = jnp.iinfo(sv.dtype)
-                ident = jnp.asarray(info.max if is_min else info.min,
-                                    sv.dtype)
+            ident = self._minmax_ident(is_min, sv.dtype)
             x = jnp.where(sok, sv, ident)
             reset = row_in_seg == 0
 
@@ -378,5 +491,87 @@ class TpuWindow(TpuExec):
                 jnp.take(cnt, jnp.clip(seg_start_pos - 1, 0, cap - 1)), 0)
             has = (cnt - cnt_before) > 0
             return scanned, has
+        if isinstance(func, (eagg.Min, eagg.Max)):
+            is_min = isinstance(func, eagg.Min)
+            ident = self._minmax_ident(is_min, sv.dtype)
+            seg_start_pos = jnp.take(seg_start, seg)
+            seg_len = jax.ops.segment_sum(
+                jnp.ones(cap, jnp.int64), seg, num_segments=cap)
+            seg_end_pos = seg_start_pos + jnp.take(seg_len, seg) - 1
+            x = jnp.where(sok, sv, ident)
+            comb = jnp.minimum if is_min else jnp.maximum
+
+            def seg_scan(values, reverse=False):
+                reset = (row_in_seg == 0) if not reverse else \
+                    (pos == seg_end_pos)
+                v = values[::-1] if reverse else values
+                r = reset[::-1] if reverse else reset
+
+                def combine(a, b):
+                    av, ar = a
+                    bv, br = b
+                    return jnp.where(br, bv, comb(av, bv)), ar | br
+                scanned, _ = jax.lax.associative_scan(combine, (v, r))
+                return scanned[::-1] if reverse else scanned
+            if not explicit:
+                lo_pos = seg_start_pos if lo is None else \
+                    jnp.maximum(pos + lo, seg_start_pos)
+                hi_pos = seg_end_pos if hi is None else \
+                    jnp.minimum(pos + hi, seg_end_pos)
+            if not explicit and (lo is None or hi is None):
+                # half-unbounded frame: one segmented scan + a gather,
+                # O(cap) memory, no host sync (no sparse table needed)
+                if lo is None:
+                    scanned = seg_scan(x)            # prefix from start
+                    vals = jnp.take(scanned,
+                                    jnp.clip(hi_pos, 0, cap - 1))
+                else:
+                    scanned = seg_scan(x, reverse=True)  # suffix to end
+                    vals = jnp.take(scanned,
+                                    jnp.clip(lo_pos, 0, cap - 1))
+            else:
+                # general bounded frame: log-doubling range-min/max
+                # table; range [l, r] = combine of the two overlapping
+                # 2^k blocks at its ends (sparse-table RMQ).  Levels
+                # stop at the widest frame actually present.
+                if not explicit:
+                    max_window = max(hi - lo + 1, 1)
+                else:
+                    # RANGE frame: one host sync learns the widest window
+                    max_window = max(
+                        int(jnp.max(hi_pos - lo_pos + 1)), 1)
+                tables = [x]
+                step = 1
+                while step < max_window:
+                    prev = tables[-1]
+                    shifted = jnp.concatenate(
+                        [prev[step:], jnp.full(step, ident, prev.dtype)])
+                    tables.append(comb(prev, shifted))
+                    step *= 2
+                rmq = jnp.stack(tables)            # [levels, cap]
+                length = jnp.maximum(hi_pos - lo_pos + 1, 0)
+                # k = floor(log2(length)) via static comparisons (no
+                # float log on the emulated-f64 chip); 2^k <= length
+                k = jnp.zeros(cap, jnp.int32)
+                for j in range(1, len(tables)):
+                    k = jnp.where(length >= (1 << j), j, k)
+                k = jnp.minimum(k, len(tables) - 1)
+                two_k = jnp.left_shift(jnp.int64(1),
+                                       k.astype(jnp.int64))
+                a_idx = jnp.clip(lo_pos, 0, cap - 1)
+                b_idx = jnp.clip(hi_pos - two_k + 1, 0, cap - 1)
+                flat = rmq.reshape(-1)
+                a = jnp.take(flat, k.astype(jnp.int64) * cap + a_idx)
+                b = jnp.take(flat, k.astype(jnp.int64) * cap + b_idx)
+                vals = comb(a, b)
+            cnt = jnp.cumsum(sok.astype(jnp.int64))
+            hi_c = jnp.clip(hi_pos, 0, cap - 1).astype(jnp.int32)
+            lo_c = jnp.clip(lo_pos - 1, -1, cap - 1)
+            cnt_hi = jnp.take(cnt, hi_c)
+            cnt_lo = jnp.where(lo_c < 0, 0,
+                               jnp.take(cnt, jnp.maximum(lo_c, 0)))
+            has = (cnt_hi - cnt_lo) > 0
+            empty = hi_pos < lo_pos
+            return vals, has & ~empty
         raise NotImplementedError(
             f"window frame ({lo},{hi}) for {func.name}")

@@ -79,3 +79,25 @@ class NTile(WindowFunction):
 
     def dtype(self):
         return T.INT64
+
+
+class PercentRank(WindowFunction, LeafExpression):
+    """(rank - 1) / (partition_rows - 1); 0.0 for a 1-row partition."""
+
+    def dtype(self):
+        return T.FLOAT64
+
+    @property
+    def nullable(self):
+        return False
+
+
+class CumeDist(WindowFunction, LeafExpression):
+    """rows <= current (last peer position + 1) / partition_rows."""
+
+    def dtype(self):
+        return T.FLOAT64
+
+    @property
+    def nullable(self):
+        return False

@@ -132,11 +132,21 @@ class BufferCatalog:
 
     def demote(self, buffer_id: str):
         """Serialize a DEVICE-tier entry down to the HOST tier (used by
-        the out-of-core sort after sampling a materialized run)."""
+        the out-of-core sort after sampling a materialized run), then
+        cascade host->disk while over host_limit so sampling runs that
+        lived on DISK do not silently blow the host budget."""
         with self._lock:
             e = self._entries.get(buffer_id)
             if e is not None and e.tier == StorageTier.DEVICE:
                 self._spill_entry_to_host(e)
+            while self.host_bytes > self.host_limit:
+                host_entries = sorted(
+                    (x for x in self._entries.values()
+                     if x.tier == StorageTier.HOST),
+                    key=lambda x: x.priority)
+                if not host_entries:
+                    break
+                self._spill_entry_to_disk(host_entries[0])
 
     # -- acquire (may unspill, like RapidsBufferCatalog.acquireBuffer) -----
     def acquire(self, buffer_id: str):
@@ -265,9 +275,17 @@ class BufferCatalog:
         """(schema, num_rows, kinds, fetch) for a DISK-tier entry without
         changing its tier.  Uncompressed raw files are read by seek/read
         of just the requested ranges; compressed files decompress once
-        per call (no random access into the codec stream)."""
-        with open(e.disk_path, "rb") as f:
-            payload = pickle.load(f)
+        and cache under a host budget.  The pickle header caches on the
+        entry so repeated slices skip re-deserializing it — note the
+        non-arena payload pickles the FULL buffers, so slicing that path
+        still loads the whole run."""
+        payload = getattr(e, "_pickle_cache", None)
+        if payload is None:
+            with open(e.disk_path, "rb") as f:
+                payload = pickle.load(f)
+            if isinstance(payload, tuple) and payload and \
+                    payload[0] == "arena_file":
+                e._pickle_cache = payload
         if not (isinstance(payload, tuple) and payload
                 and payload[0] == "arena_file"):
             schema, num_rows, kinds, bufs = payload
@@ -286,7 +304,14 @@ class BufferCatalog:
                 with open(e.disk_path + ".raw", "rb") as f:
                     raw = get_codec(codec_name).decompress(f.read(),
                                                            max(total, 1))
-                e.raw_cache = raw
+                # bounded cache: pinning every decompressed run would
+                # grow host RAM by the dataset size in exactly the
+                # memory-constrained case the OOC merge targets
+                cached = sum(len(x.raw_cache)
+                             for x in self._entries.values()
+                             if x.raw_cache is not None)
+                if cached + len(raw) <= self.host_limit // 4:
+                    e.raw_cache = raw
 
             def read_bytes(boff, nb):
                 return raw[boff:boff + nb]
@@ -410,6 +435,8 @@ class BufferCatalog:
         os.unlink(e.disk_path)
         e.disk_path = None
         e.raw_cache = None
+        if hasattr(e, "_pickle_cache"):
+            del e._pickle_cache
         e.host_payload = payload
         e.tier = StorageTier.HOST
         self.disk_bytes -= e.nbytes

@@ -368,8 +368,11 @@ class PlanMeta:
             for wf in p.window_funcs:
                 f = wf.func
                 ok = isinstance(f, (wfn.RowNumber, wfn.Rank, wfn.DenseRank,
-                                    wfn.Lead, wfn.Lag, eagg.Sum, eagg.Count,
-                                    eagg.Min, eagg.Max, eagg.Average))
+                                    wfn.Lead, wfn.Lag, wfn.NTile,
+                                    wfn.PercentRank, wfn.CumeDist,
+                                    eagg.Sum, eagg.Count,
+                                    eagg.Min, eagg.Max, eagg.Average,
+                                    eagg.CollectList))
                 if not ok:
                     self.reasons.append(
                         f"window function {f.name} not implemented on TPU")
@@ -380,26 +383,26 @@ class PlanMeta:
                         "string window aggregates not on TPU yet")
                 kind, lo, hi = wf.spec.frame
                 if kind == "range" and not (lo is None and hi is None):
-                    # bounded RANGE: rank-search implementation covers a
-                    # single integral/date/timestamp order key with
-                    # sum/count/avg (tpu_window._range_positions)
+                    # bounded RANGE: rank-search covers a single
+                    # integral/decimal/date/timestamp order key with
+                    # sum/count/avg/min/max/collect_list
+                    # (tpu_window._range_positions; the reference's own
+                    # bounded-RANGE support is one numeric key,
+                    # GpuWindowExpression.scala)
                     ok_range = (
                         len(wf.spec.order_by) == 1 and
                         isinstance(f, (eagg.Sum, eagg.Count,
-                                       eagg.Average)))
+                                       eagg.Average, eagg.Min, eagg.Max,
+                                       eagg.CollectList)))
                     if ok_range:
                         odt = wf.spec.order_by[0].expr.dtype()
                         ok_range = odt.is_integral or odt in (
-                            T.DATE, T.TIMESTAMP)
+                            T.DATE, T.TIMESTAMP) or isinstance(
+                            odt, T.DecimalType)
                     if not ok_range:
                         self.reasons.append(
-                            "RANGE frame limited to one integral order "
-                            "key with sum/count/avg on TPU")
-                if isinstance(f, (eagg.Min, eagg.Max)) and not (
-                        (lo is None and hi is None) or
-                        (lo is None and hi == 0) or not wf.spec.order_by):
-                    self.reasons.append(
-                        "bounded min/max window frames not on TPU yet")
+                            "RANGE frame limited to one "
+                            "integral/decimal/date order key on TPU")
         for c in self.children:
             c.tag()
 

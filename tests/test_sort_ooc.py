@@ -112,3 +112,17 @@ def test_acquire_slice_preserves_tier():
     assert e.tier == StorageTier.DISK
     assert sl2.columns[1].to_pylist(10) == strs[90:100]
     sb.close()
+
+
+def test_ooc_sort_duplicate_keys_still_chunks():
+    """All-equal sort keys must still split into bounded chunks (the
+    (run, position) tiebreaker words), not collapse to one concat."""
+    n = 4000
+    s = _session(chunk_rows=500)
+    df = s.create_dataframe(
+        {"k": np.full(n, 7, np.int64), "i": np.arange(n)},
+        num_partitions=1)
+    got = df.order_by("k").to_arrow()
+    assert got.num_rows == n
+    assert got.column("k").to_pylist() == [7] * n
+    assert sorted(got.column("i").to_pylist()) == list(range(n))

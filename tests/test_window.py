@@ -139,3 +139,110 @@ class TestFrames:
         assert_tpu_and_cpu_are_equal_collect(fn(("range", -1, None)))
         assert_tpu_and_cpu_are_equal_collect(
             fn(("range", None, 1), order_desc=True))
+
+
+class TestWindowCompleteness:
+    """Round-4 window breadth (GpuWindowExpression.scala parity):
+    ntile / percent_rank / cume_dist, bounded min/max frames, RANGE
+    min/max, collect_list over windows."""
+
+    def test_ntile(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"k": KeyGen(cardinality=5),
+                                 "v": IntGen(lo=0, hi=1000,
+                                             null_ratio=0.0)}, N)
+            .with_window("nt", F.ntile(4), partition_by=["k"],
+                         order_by=["v"]))
+
+    def test_percent_rank_cume_dist(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"k": KeyGen(cardinality=5),
+                                 "v": KeyGen(cardinality=12,
+                                             null_ratio=0.0)}, N)
+            .with_window("pr", F.percent_rank(), partition_by=["k"],
+                         order_by=["v"])
+            .with_window("cd", F.cume_dist(), partition_by=["k"],
+                         order_by=["v"]))
+
+    def test_bounded_min_max_rows(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"k": KeyGen(cardinality=6),
+                                 "o": IntGen(lo=0, hi=10000,
+                                             null_ratio=0.0),
+                                 "v": IntGen(lo=-500, hi=500,
+                                             null_ratio=0.15)}, N)
+            .with_window("mn", F.min("v"), partition_by=["k"],
+                         order_by=["o", "v"], frame=("rows", -3, 2))
+            .with_window("mx", F.max("v"), partition_by=["k"],
+                         order_by=["o", "v"], frame=("rows", -2, None)))
+
+    def test_range_min_max(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"k": KeyGen(cardinality=4),
+                                 "o": IntGen(lo=0, hi=60,
+                                             null_ratio=0.1),
+                                 "v": IntGen(lo=-500, hi=500,
+                                             null_ratio=0.1)}, N)
+            .with_window("mn", F.min("v"), partition_by=["k"],
+                         order_by=["o"], frame=("range", -5, 5))
+            .with_window("mx", F.max("v"), partition_by=["k"],
+                         order_by=["o"], frame=("range", None, 3)))
+
+    def test_collect_list_window(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"k": KeyGen(cardinality=5),
+                                 "o": IntGen(lo=0, hi=100000,
+                                             null_ratio=0.0),
+                                 "v": IntGen(lo=0, hi=50,
+                                             null_ratio=0.2)}, N)
+            .with_window("cl", F.collect_list("v"), partition_by=["k"],
+                         order_by=["o", "v"], frame=("rows", -2, 1)))
+
+    def test_collect_list_window_unbounded(self):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: gen_df(s, {"k": KeyGen(cardinality=4),
+                                 "o": IntGen(lo=0, hi=100000,
+                                             null_ratio=0.0),
+                                 "v": IntGen(lo=0, hi=50,
+                                             null_ratio=0.1)}, N)
+            .with_window("cl", F.collect_list("v"), partition_by=["k"],
+                         order_by=["o", "v"],
+                         frame=("rows", None, None)))
+
+    def test_sql_window_completeness(self):
+        """ntile/percent_rank/cume_dist + bounded ROWS min + bounded
+        RANGE max + windowed collect_list through session.sql()."""
+        import numpy as np
+        from harness import with_cpu_session, with_tpu_session
+        rng = np.random.default_rng(5)
+        data = {"k": rng.integers(0, 5, 200).astype(np.int64),
+                "o": rng.integers(0, 50, 200).astype(np.int64),
+                "v": rng.integers(-50, 50, 200).astype(np.int64)}
+        sql = """
+          select k, o, v,
+                 ntile(3) over (partition by k order by o, v) nt,
+                 percent_rank() over (partition by k order by o) pr,
+                 cume_dist() over (partition by k order by o) cd,
+                 min(v) over (partition by k order by o, v
+                              rows between 3 preceding and 2 following)
+                   mn,
+                 max(v) over (partition by k order by o
+                              range between 5 preceding and 5 following)
+                   mx,
+                 collect_list(v) over (partition by k order by o, v
+                              rows between 2 preceding and current row)
+                   cl
+          from t order by k, o, v"""
+
+        def run(s):
+            s.create_dataframe(data).create_or_replace_temp_view("t")
+            return s.sql(sql).collect()
+        cpu = with_cpu_session(run)
+        tpu = with_tpu_session(run)
+        assert len(cpu) == len(tpu) == 200
+        for a, b in zip(tpu, cpu):
+            for x, y in zip(a, b):
+                if isinstance(x, float):
+                    assert abs(x - y) < 1e-9
+                else:
+                    assert x == y
