@@ -1,0 +1,45 @@
+"""CI smoke: one query across two OS processes (map stage in a child
+executor over the TCP shuffle wire, reduce in the parent), plus the
+dead-executor fetch-failed -> local-map-retry path.  Must be a real
+file: multiprocessing 'spawn' re-imports __main__."""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("SPARK_RAPIDS_TPU_DIST_PLATFORM", "cpu")
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as papq
+    from spark_rapids_tpu.distributed import (run_two_process_query,
+                                              _make_session)
+    d = tempfile.mkdtemp(prefix="dist_smoke_")
+    rng = np.random.default_rng(1)
+    for i in range(3):
+        papq.write_table(pa.table({
+            "k": rng.integers(0, 100, 4000).astype(np.int64),
+            "v": rng.integers(-10, 10, 4000).astype(np.int64)}),
+            f"{d}/part-{i}.parquet")
+    sql = ("select k % 8 g, sum(v) s, count(*) c from t "
+           "group by k % 8 order by g")
+    out, recovered = run_two_process_query(sql, {"t": d})
+    assert not recovered
+    local = _make_session({"t": d}).sql(sql).collect()
+    got = list(zip(*[out.column(i).to_pylist() for i in range(3)]))
+    assert got == local, "two-process rows != local rows"
+    out2, recovered2 = run_two_process_query(
+        sql, {"t": d}, kill_child_before_reduce=True)
+    assert recovered2, "dead executor must surface fetch-failed + retry"
+    got2 = list(zip(*[out2.column(i).to_pylist() for i in range(3)]))
+    assert got2 == local
+    print("two-process query + dead-executor retry: OK")
+
+
+if __name__ == "__main__":
+    main()

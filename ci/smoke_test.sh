@@ -23,6 +23,9 @@ jax.jit(fn).lower(*args).compile()
 print("entry() compiles")
 PY
 
+echo "== two-process query (map in child executor, reduce in parent) =="
+python ci/dist_smoke.py
+
 echo "== bench sanity (tiny) =="
 python bench.py 100000
 

@@ -171,11 +171,17 @@ class TpuSession:
         return self._execute_to_arrow_inner(logical)
 
     def _execute_to_arrow_inner(self, logical: L.LogicalPlan) -> pa.Table:
+        phys = self._plan(logical)
+        return self.execute_physical(phys)
+
+    def execute_physical(self, phys) -> pa.Table:
+        """Run an ALREADY-PLANNED physical tree and collect one arrow
+        table (the distributed runner plans once, attaches executor
+        contexts to exchange nodes, then executes that exact tree)."""
         import time as _time
         from ..columnar.arrow import to_arrow, schema_to_arrow
         from ..columnar.arrow import stage_batch
         t0 = _time.perf_counter()
-        phys = self._plan(logical)
         self.last_physical_plan = phys
         # drain all partitions first (device work + staged pulls), then one
         # fused flush serves every batch's counts/buffers (columnar/pending)

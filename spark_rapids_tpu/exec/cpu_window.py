@@ -196,7 +196,8 @@ class CpuWindow(CpuExec):
                     else:
                         rmin, rmax, m = _rank_stats(g)
                         if isinstance(fn, PercentRank):
-                            vals = (rmin - 1) / (m - 1) if m > 1 else                                 np.zeros(m)
+                            vals = (rmin - 1) / (m - 1) if m > 1 \
+                            else np.zeros(m)
                         else:
                             vals = rmax / m
                     outs.append(pd.Series(vals, index=g.index))

@@ -19,11 +19,43 @@ from .base import PhysicalPlan, PARTITION_TIME, NUM_OUTPUT_ROWS, timed
 from .tpu_basic import TpuExec
 
 
+class _DistWriter:
+    """ShuffleManager facade writing into a ShuffleExecutorContext: map
+    output lands in the executor's own catalog + its map registration in
+    the (driver) tracker, so reducers in OTHER processes fetch it over
+    the transport (RapidsCachingWriter + MapStatus round trip)."""
+
+    def __init__(self, ctx, shuffle_id: int):
+        self.ctx = ctx
+        self.shuffle_id = shuffle_id
+
+    def new_shuffle_id(self) -> int:
+        return self.shuffle_id
+
+    def append_map_output(self, shuffle_id, map_id, per_reduce):
+        self.ctx.append_map_output(shuffle_id, map_id, per_reduce)
+
+
 class TpuShuffleExchange(TpuExec):
     def __init__(self, child: PhysicalPlan, partitioner: Partitioner):
         super().__init__(child)
         self.partitioner = partitioner
         self._shuffle_id: Optional[int] = None
+        # distributed mode (executor-process split): set by
+        # attach_distributed; None = in-process ShuffleManager
+        self._dist_ctx = None
+        self._dist_shuffle_id: Optional[int] = None
+        self._dist_run_map = True
+
+    def attach_distributed(self, ctx, shuffle_id: int, run_map: bool):
+        """Split this exchange across OS processes: ``run_map=True``
+        executes the map side into ``ctx``'s catalog (an executor
+        serving fetches); ``run_map=False`` skips the local map stage
+        (it ran in another process) and reduces via ``ctx``'s
+        transport-aware read path."""
+        self._dist_ctx = ctx
+        self._dist_shuffle_id = shuffle_id
+        self._dist_run_map = run_map
 
     @property
     def output_schema(self):
@@ -39,7 +71,8 @@ class TpuShuffleExchange(TpuExec):
     def _materialize_map_side(self):
         from ..columnar import pending
         from ..columnar.batch import resolve_speculative
-        mgr = ShuffleManager.get()
+        mgr = ShuffleManager.get() if self._dist_ctx is None else \
+            _DistWriter(self._dist_ctx, self._dist_shuffle_id)
         self._shuffle_id = mgr.new_shuffle_id()
         in_parts = self.children[0].execute()
         # range partitioner needs bounds from a sample pass first
@@ -108,19 +141,28 @@ class TpuShuffleExchange(TpuExec):
     def ensure_materialized(self):
         """Run the map side once (the AQE stage-materialization barrier)."""
         if self._shuffle_id is None:
+            if self._dist_ctx is not None and not self._dist_run_map:
+                # the map stage ran in another executor process; its
+                # outputs are registered in the shared tracker
+                self._shuffle_id = self._dist_shuffle_id
+                return
             self._materialize_map_side()
 
     def partition_stats(self):
         """Per-reduce-partition (bytes, rows) from the materialized map
         output — the MapOutputStatistics role AQE re-plans from."""
         self.ensure_materialized()
-        mgr = ShuffleManager.get()
+        # distributed mode: only THIS executor's blocks are visible
+        # (remote stats would need a tracker protocol extension); AQE
+        # then sees zeros for remote-only partitions and keeps the
+        # static plan, which is correct if conservative
+        cat = self._dist_ctx.catalog if self._dist_ctx is not None \
+            else ShuffleManager.get().catalog
         stats = []
         for pid in range(self.partitioner.num_partitions):
             nbytes = rows = 0
-            for block in mgr.catalog.blocks_for_reduce(self._shuffle_id,
-                                                       pid):
-                nb, nr = mgr.catalog.stats_for_block(block)
+            for block in cat.blocks_for_reduce(self._shuffle_id, pid):
+                nb, nr = cat.stats_for_block(block)
                 nbytes += nb
                 rows += nr
             stats.append((nbytes, rows))
@@ -130,6 +172,14 @@ class TpuShuffleExchange(TpuExec):
         """Stream one reduce partition batch-by-batch (batches unspill
         one at a time — the memory-bounded path)."""
         self.ensure_materialized()
+        if self._dist_ctx is not None:
+            # transport-aware read: local blocks from this executor's
+            # catalog, remote ones fetched over the wire
+            for b in self._dist_ctx.read_partition(self._shuffle_id,
+                                                   reduce_id):
+                self.metrics[NUM_OUTPUT_ROWS] += b.rows_lazy
+                yield b
+            return
         mgr = ShuffleManager.get()
         for b in mgr.read_partition(self._shuffle_id, reduce_id):
             self.metrics[NUM_OUTPUT_ROWS] += b.rows_lazy

@@ -227,7 +227,9 @@ class TpuWindow(TpuExec):
                 frame_lo, frame_hi)
             vals, ok = self._frame_agg(func, sv, sok, seg, row_in_seg,
                                        seg_start, cap, None, None,
-                                       lo_pos=lo_pos, hi_pos=hi_pos)
+                                       lo_pos=lo_pos, hi_pos=hi_pos,
+                                       lo_unbounded=frame_lo is None,
+                                       hi_unbounded=frame_hi is None)
             ok = ok & live
         else:
             lo = frame_lo  # None = unbounded preceding
@@ -258,10 +260,8 @@ class TpuWindow(TpuExec):
         sorted_src = src.gather(perm)
         valid = sorted_src.validity & live
         kind, frame_lo, frame_hi = spec.frame
-        seg_start_pos = jnp.take(seg_start, seg)
-        seg_len = jax.ops.segment_sum(
-            jnp.ones(cap, jnp.int64), seg, num_segments=cap)
-        seg_end_pos = seg_start_pos + jnp.take(seg_len, seg) - 1
+        seg_start_pos, seg_end_pos = self._seg_extents(seg, seg_start,
+                                                       cap)
         pos = jnp.arange(cap, dtype=jnp.int64)
         if (frame_lo is None and frame_hi is None) or not spec.order_by:
             lo_pos, hi_pos = seg_start_pos, seg_end_pos
@@ -298,6 +298,16 @@ class TpuWindow(TpuExec):
         out_valid = jnp.arange(cap) < n
         return ListColumn(T.ArrayType(src.dtype), offsets, elements,
                           out_valid)
+
+    @staticmethod
+    def _seg_extents(seg, seg_start, cap):
+        """(per-row segment start position, per-row segment end
+        position) — shared by every frame kind."""
+        seg_start_pos = jnp.take(seg_start, seg)
+        seg_len = jax.ops.segment_sum(
+            jnp.ones(cap, jnp.int64), seg, num_segments=cap)
+        seg_end_pos = seg_start_pos + jnp.take(seg_len, seg) - 1
+        return seg_start_pos, seg_end_pos
 
     @staticmethod
     def _minmax_ident(is_min: bool, dtype):
@@ -429,7 +439,9 @@ class TpuWindow(TpuExec):
 
     def _frame_agg(self, func, sv, sok, seg, row_in_seg, seg_start, cap,
                    lo: Optional[int], hi: Optional[int],
-                   lo_pos=None, hi_pos=None):
+                   lo_pos=None, hi_pos=None,
+                   lo_unbounded: bool = False,
+                   hi_unbounded: bool = False):
         """Frame [lo, hi] row offsets, or explicit positions
         (lo_pos/hi_pos from a RANGE frame)."""
         pos = jnp.arange(cap, dtype=jnp.int64)
@@ -443,10 +455,8 @@ class TpuWindow(TpuExec):
                                 jnp.zeros(cap, acc_dtype))
             ps = jnp.cumsum(contrib)          # inclusive prefix sum
             cnt = jnp.cumsum(sok.astype(jnp.int64))
-            seg_start_pos = jnp.take(seg_start, seg)
-            seg_len = jax.ops.segment_sum(
-                jnp.ones(cap, jnp.int64), seg, num_segments=cap)
-            seg_end_pos = seg_start_pos + jnp.take(seg_len, seg) - 1
+            seg_start_pos, seg_end_pos = self._seg_extents(
+                seg, seg_start, cap)
             if not explicit:
                 lo_pos = seg_start_pos if lo is None else \
                     jnp.maximum(pos + lo, seg_start_pos)
@@ -494,10 +504,8 @@ class TpuWindow(TpuExec):
         if isinstance(func, (eagg.Min, eagg.Max)):
             is_min = isinstance(func, eagg.Min)
             ident = self._minmax_ident(is_min, sv.dtype)
-            seg_start_pos = jnp.take(seg_start, seg)
-            seg_len = jax.ops.segment_sum(
-                jnp.ones(cap, jnp.int64), seg, num_segments=cap)
-            seg_end_pos = seg_start_pos + jnp.take(seg_len, seg) - 1
+            seg_start_pos, seg_end_pos = self._seg_extents(
+                seg, seg_start, cap)
             x = jnp.where(sok, sv, ident)
             comb = jnp.minimum if is_min else jnp.maximum
 
@@ -518,10 +526,12 @@ class TpuWindow(TpuExec):
                     jnp.maximum(pos + lo, seg_start_pos)
                 hi_pos = seg_end_pos if hi is None else \
                     jnp.minimum(pos + hi, seg_end_pos)
-            if not explicit and (lo is None or hi is None):
-                # half-unbounded frame: one segmented scan + a gather,
+            if (not explicit and (lo is None or hi is None)) or \
+                    (explicit and (lo_unbounded or hi_unbounded)):
+                # half-unbounded frame (ROWS offsets or RANGE with one
+                # unbounded side): one segmented scan + a gather,
                 # O(cap) memory, no host sync (no sparse table needed)
-                if lo is None:
+                if lo is None if not explicit else lo_unbounded:
                     scanned = seg_scan(x)            # prefix from start
                     vals = jnp.take(scanned,
                                     jnp.clip(hi_pos, 0, cap - 1))

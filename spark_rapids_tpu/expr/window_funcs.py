@@ -74,6 +74,9 @@ class Lag(WindowFunction):
 
 class NTile(WindowFunction):
     def __init__(self, n: int):
+        if not isinstance(n, int) or n <= 0:
+            raise ValueError(
+                f"ntile requires a positive bucket count, got {n!r}")
         self.children = []
         self.n = n
 

@@ -74,6 +74,7 @@ class BufferCatalog:
         self.disk_bytes = 0
         self.spilled_device_to_host = 0
         self.spilled_host_to_disk = 0
+        self.raw_cache_bytes = 0
         from ..shuffle.compression import get_codec
         self.codec = get_codec(compression)
         # native host slab arena for the HOST tier (pinned-pool role);
@@ -125,6 +126,9 @@ class BufferCatalog:
                     self.arena.free(p[5])
             else:
                 self.disk_bytes -= e.nbytes
+                if e.raw_cache is not None:
+                    self.raw_cache_bytes -= len(e.raw_cache)
+                    e.raw_cache = None
                 if e.disk_path and os.path.exists(e.disk_path):
                     os.unlink(e.disk_path)
                 if e.disk_path and os.path.exists(e.disk_path + ".raw"):
@@ -307,11 +311,10 @@ class BufferCatalog:
                 # bounded cache: pinning every decompressed run would
                 # grow host RAM by the dataset size in exactly the
                 # memory-constrained case the OOC merge targets
-                cached = sum(len(x.raw_cache)
-                             for x in self._entries.values()
-                             if x.raw_cache is not None)
-                if cached + len(raw) <= self.host_limit // 4:
+                if self.raw_cache_bytes + len(raw) <= \
+                        self.host_limit // 4:
                     e.raw_cache = raw
+                    self.raw_cache_bytes += len(raw)
 
             def read_bytes(boff, nb):
                 return raw[boff:boff + nb]
@@ -434,6 +437,8 @@ class BufferCatalog:
             payload = ("arena", schema, num_rows, kinds, metas, off, total)
         os.unlink(e.disk_path)
         e.disk_path = None
+        if e.raw_cache is not None:
+            self.raw_cache_bytes -= len(e.raw_cache)
         e.raw_cache = None
         if hasattr(e, "_pickle_cache"):
             del e._pickle_cache

@@ -238,6 +238,19 @@ class ShuffleExecutorContext:
         self.tracker.register_map_output(shuffle_id, map_id,
                                          self.executor_id)
 
+    def append_map_output(self, shuffle_id: int, map_id: int,
+                          per_reduce: Dict[int, List[ColumnarBatch]]):
+        """Streaming write: pieces append to this executor's catalog as
+        they finalize, then the map registers with the tracker (the
+        RapidsCachingWriter + MapStatus pairing in ONE place)."""
+        for reduce_id, batches in per_reduce.items():
+            if batches:
+                self.catalog.append(
+                    ShuffleBlockId(shuffle_id, map_id, reduce_id),
+                    batches)
+        self.tracker.register_map_output(shuffle_id, map_id,
+                                         self.executor_id)
+
     # -- read side (RapidsCachingReader + RapidsShuffleIterator) -----------
     def read_partition(self, shuffle_id: int, reduce_id: int,
                        timeout_s: float = 30.0):
