@@ -556,26 +556,49 @@ def verify(data_dir: str, queries, out_path: str,
                       if e.get("status") == "pass" and q in queries}
         except Exception:
             matrix = {}
+    def run_one(sql, entry):
+        t0 = time.perf_counter()
+        tpu_rows = _norm_rows(s_tpu.sql(sql).collect())
+        entry["tpu_s"] = round(time.perf_counter() - t0, 4)
+        t0 = time.perf_counter()
+        cpu_rows = _norm_rows(s_cpu.sql(sql).collect())
+        entry["cpu_s"] = round(time.perf_counter() - t0, 4)
+        ok, why = _rows_equal(cpu_rows, tpu_rows)
+        entry["rows"] = len(tpu_rows)
+        entry["status"] = "pass" if ok else "FAIL"
+        if not ok:
+            entry["mismatch"] = why
+
     for name in queries:
         if name in matrix:
             continue
         sql = QUERIES[name]
         entry = {}
         try:
-            t0 = time.perf_counter()
-            tpu_rows = _norm_rows(s_tpu.sql(sql).collect())
-            entry["tpu_s"] = round(time.perf_counter() - t0, 4)
-            t0 = time.perf_counter()
-            cpu_rows = _norm_rows(s_cpu.sql(sql).collect())
-            entry["cpu_s"] = round(time.perf_counter() - t0, 4)
-            ok, why = _rows_equal(cpu_rows, tpu_rows)
-            entry["rows"] = len(tpu_rows)
-            entry["status"] = "pass" if ok else "FAIL"
-            if not ok:
-                entry["mismatch"] = why
+            run_one(sql, entry)
         except Exception as e:  # noqa: BLE001 - recorded per query
-            entry["status"] = "ERROR"
-            entry["error"] = f"{type(e).__name__}: {e}"[:300]
+            if "RESOURCE_EXHAUSTED" in str(e):
+                # real HBM exhaustion mid-sweep: rebuild both sessions
+                # (drops lingering plan/shuffle references) and retry
+                # this query once before recording a failure
+                import gc
+                s_tpu = TpuSession(TpuConf(
+                    {"spark.rapids.tpu.sql.enabled": True}))
+                s_cpu = TpuSession(TpuConf(
+                    {"spark.rapids.tpu.sql.enabled": False}))
+                register(s_tpu, data_dir)
+                register(s_cpu, data_dir)
+                gc.collect()
+                try:
+                    entry = {}
+                    run_one(sql, entry)
+                    entry["oom_retried"] = True
+                except Exception as e2:  # noqa: BLE001
+                    entry["status"] = "ERROR"
+                    entry["error"] = f"{type(e2).__name__}: {e2}"[:300]
+            else:
+                entry["status"] = "ERROR"
+                entry["error"] = f"{type(e).__name__}: {e}"[:300]
         matrix[name] = entry
         print(f"{name}: {entry['status']}"
               + (f" ({entry.get('mismatch', entry.get('error', ''))})"

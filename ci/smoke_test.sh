@@ -26,6 +26,9 @@ PY
 echo "== two-process query (map in child executor, reduce in parent) =="
 python ci/dist_smoke.py
 
+echo "== api validation (docs vs live registry) =="
+python -m spark_rapids_tpu.tools.api_validation
+
 echo "== bench sanity (tiny) =="
 python bench.py 100000
 

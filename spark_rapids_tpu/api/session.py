@@ -198,6 +198,13 @@ class TpuSession:
             if t.num_rows:
                 tables.append(t)
         self._log_query(phys, (_time.perf_counter() - t0) * 1000)
+        # end-of-query shuffle release (ContextCleaner role): map
+        # outputs are per-query; holding them across a long sweep
+        # exhausts the real allocator.  Distributed-attached exchanges
+        # keep their executor-context outputs (peers may still fetch).
+        from ..shuffle.manager import ShuffleManager
+        if ShuffleManager._instance is not None:
+            ShuffleManager._instance.clear_all()
         target = schema_to_arrow(phys.output_schema) if len(
             phys.output_schema) else None
         if not tables:

@@ -274,8 +274,16 @@ def column_from_arrow(arr: pa.ChunkedArray | pa.Array,
 
 def from_arrow(table: pa.Table, capacity: Optional[int] = None
                ) -> ColumnarBatch:
-    n = table.num_rows
-    cap = capacity or bucket_capacity(n)
-    cols = [column_from_arrow(table.column(i), capacity=cap)
-            for i in range(table.num_columns)]
-    return ColumnarBatch(schema_from_arrow(table.schema), cols, n)
+    from ..memory.pressure import oom_retry
+
+    def build():
+        n = table.num_rows
+        cap = capacity or bucket_capacity(n)
+        cols = [column_from_arrow(table.column(i), capacity=cap)
+                for i in range(table.num_columns)]
+        return ColumnarBatch(schema_from_arrow(table.schema), cols, n)
+    # scan-side device puts can hit the real allocator's
+    # RESOURCE_EXHAUSTED under fragmentation even when the logical
+    # budget says there is room: spill everything spillable and retry
+    # (DeviceMemoryEventHandler.onAllocFailure contract)
+    return oom_retry(build)

@@ -107,7 +107,21 @@ class TwoProcessQueryRunner:
             args=(self.sql, self.tables, q_out, self._q_in),
             daemon=True)
         self._child.start()
-        msg, addr, map_ids = q_out.get(timeout=300)
+        import queue as _queue
+        import time as _time
+        deadline = _time.monotonic() + 300
+        while True:
+            try:
+                msg, addr, map_ids = q_out.get(timeout=2)
+                break
+            except _queue.Empty:
+                if not self._child.is_alive():
+                    raise RuntimeError(
+                        "child executor died before reporting ready "
+                        f"(exitcode={self._child.exitcode})") from None
+                if _time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "child executor timed out") from None
         if msg != "ready":
             raise RuntimeError(f"child executor failed: {addr}")
         return addr, map_ids

@@ -201,20 +201,40 @@ class CpuWindow(CpuExec):
                         else:
                             vals = rmax / m
                     outs.append(pd.Series(vals, index=g.index))
-                res = pd.concat(outs).reindex(work.index)
+                res = pd.concat(outs).reindex(work.index) if outs \
+                    else pd.Series([], dtype=object)
             elif isinstance(wf.func, RowNumber):
                 res = grouped.cumcount() + 1
-            elif isinstance(wf.func, Rank):
-                order_col = skeys[0] if skeys else pkeys[0]
-                res = grouped[skeys].apply(
-                    lambda g: g.rank(method="min").iloc[:, 0]) \
-                    .reset_index(level=list(range(len(pkeys))), drop=True) \
-                    if pkeys else work[skeys[0]].rank(method="min")
-                res = res.astype(np.int64)
-            elif isinstance(wf.func, DenseRank):
-                res = (grouped[skeys[0]].transform(
-                    lambda s: s.rank(method="dense"))).astype(np.int64) \
-                    if skeys else 1
+            elif isinstance(wf.func, (Rank, DenseRank)) and \
+                    len(skeys) == 1:
+                # single order key: pandas' vectorized rank is exact
+                if isinstance(wf.func, Rank):
+                    res = grouped[skeys[0]].transform(
+                        lambda s_: s_.rank(method="min")) \
+                        .astype(np.int64)
+                else:
+                    res = grouped[skeys[0]].transform(
+                        lambda s_: s_.rank(method="dense")) \
+                        .astype(np.int64)
+            elif isinstance(wf.func, (Rank, DenseRank)):
+                # exact multi-key ranking via order-key run boundaries
+                # (column-wise pandas rank ties only on the FIRST key)
+                dense = isinstance(wf.func, DenseRank)
+                outs = []
+                for _, g in grouped:
+                    rmin, _, m = _rank_stats(g)
+                    if dense:
+                        newrun = np.zeros(m, bool)
+                        if m:
+                            newrun[0] = True
+                            newrun[1:] = rmin[1:] != rmin[:-1]
+                        vals = np.cumsum(newrun).astype(np.int64)
+                    else:
+                        vals = rmin
+                    outs.append(pd.Series(vals, index=g.index))
+                res = (pd.concat(outs).reindex(work.index)
+                       .astype(np.int64)) if outs else \
+                    pd.Series([], dtype=np.int64)
             elif isinstance(wf.func, (Lead, Lag)):
                 offset = wf.func.offset if isinstance(wf.func, Lead) \
                     else -wf.func.offset

@@ -155,7 +155,9 @@ class Like(Expression):
         col = _eval_string(self.children[0], batch)
         p = str(pat.value)
         plain = p.replace("%", "").replace("_", "")
-        has_special = "_" in p
+        # escaped wildcards (literal %% / _) need the unescaping regex
+        # path: the byte fast paths would treat the escape as content
+        has_special = "_" in p or self.escape in p
         if not has_special:
             if p.startswith("%") and p.endswith("%") and \
                     "%" not in p[1:-1] and len(p) >= 2:
@@ -171,7 +173,34 @@ class Like(Expression):
                 from .predicates import EqualTo
                 return EqualTo(self.children[0],
                                Literal(p, T.STRING)).columnar_eval(batch)
+            # general %-only pattern ('a%b%c'): ordered device segment
+            # search via find_in_row — no host round trip (the
+            # JoinGatherer-era weak spot: string filters silently
+            # serializing through the host per batch)
+            if self.escape not in p and len(p) <= 256:
+                segs = [sg.encode() for sg in p.split("%")]
+                cap = col.capacity
+                ok = col.validity.astype(bool) & jnp.ones(cap, bool)
+                pos = jnp.zeros(cap, jnp.int32)
+                anchored_start = segs[0] != b""
+                anchored_end = segs[-1] != b""
+                if anchored_start:
+                    ok = ok & skern.starts_with(col, segs[0])
+                    pos = jnp.full(cap, len(segs[0]), jnp.int32)
+                middle = [sg for sg in segs[1:-1] if sg]
+                for sg in middle:
+                    f = skern.find_in_row(col, sg, pos)
+                    ok = ok & (f >= 0)
+                    pos = jnp.where(f >= 0, f + len(sg), pos)
+                if anchored_end:
+                    last = segs[-1]
+                    blen = skern.byte_length(col)
+                    end_rel = blen - len(last)
+                    ok = ok & skern.ends_with(col, last) & \
+                        (end_rel >= pos)
+                return Column(T.BOOL, ok, col.validity)
         # host regex fallback
+        _note_host_regex(f"LIKE {p!r}")
         rx = re.compile(_like_to_regex(p, self.escape), re.DOTALL)
         vals, valid = col.to_numpy(batch.num_rows)
         out = np.zeros(col.capacity, bool)
@@ -200,6 +229,23 @@ def _like_to_regex(pattern: str, escape: str) -> str:
     return "".join(out)
 
 
+#: host-regex fallback observability (the silent-serialization weak
+#: spot): per-process counter + one warning per distinct pattern
+HOST_REGEX_EVALS = {"count": 0}
+_WARNED_PATTERNS: set = set()
+
+
+def _note_host_regex(what: str):
+    HOST_REGEX_EVALS["count"] += 1
+    if what not in _WARNED_PATTERNS:
+        _WARNED_PATTERNS.add(what)
+        import logging
+        logging.getLogger(__name__).warning(
+            "host regex path for %s: this batch serializes through the "
+            "host string engine (device LIKE covers literal "
+            "prefix/suffix/contains/multi-%% patterns)", what)
+
+
 class RLike(Expression):
     """Regex match (host path; reference gates regex heavily too)."""
 
@@ -215,6 +261,7 @@ class RLike(Expression):
     def columnar_eval(self, batch):
         pat = self.children[1]
         assert isinstance(pat, Literal)
+        _note_host_regex(f"RLIKE {pat.value!r}")
         rx = re.compile(str(pat.value))
         col = _eval_string(self.children[0], batch)
         vals, valid = col.to_numpy(batch.num_rows)
@@ -537,6 +584,7 @@ class RegexpExtract(Expression):
     def columnar_eval(self, batch):
         pat = self.children[1]
         assert isinstance(pat, Literal)
+        _note_host_regex(f"REGEXP_EXTRACT {pat.value!r}")
         rx = re.compile(str(pat.value))
         col = _eval_string(self.children[0], batch)
         vals, valid = col.to_numpy(batch.num_rows)

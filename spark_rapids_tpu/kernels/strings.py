@@ -239,3 +239,35 @@ def contains(col: StringColumn, needle: bytes) -> jnp.ndarray:
     a = jnp.take(hit_cum, jnp.clip(starts, 0, B))
     b = jnp.take(hit_cum, jnp.clip(ends, 0, B))
     return (b - a) > 0
+
+
+def find_in_row(col: StringColumn, needle: bytes,
+                from_rel) -> jnp.ndarray:
+    """Per row: smallest byte offset >= ``from_rel[row]`` where
+    ``needle`` occurs, else -1.  Powers the device multi-%%-segment
+    LIKE path (GpuOverrides treats 'regexp like a regular string' the
+    same way) — ordered segment search without the host regex engine."""
+    import jax
+    pat = np.frombuffer(needle, np.uint8)
+    cap = col.capacity
+    if pat.size == 0:
+        return jnp.maximum(from_rel, 0).astype(jnp.int32)
+    data = col.data
+    B = data.shape[0]
+    k = jnp.arange(pat.size, dtype=jnp.int32)
+    idx = jnp.clip(jnp.arange(B, dtype=jnp.int32)[:, None] + k[None, :],
+                   0, B - 1)
+    win_eq = jnp.all(jnp.take(data, idx) == jnp.asarray(pat)[None, :],
+                     axis=1)
+    g = jnp.arange(B, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(col.offsets[1:], g, side="right"),
+                   0, cap - 1).astype(jnp.int32)
+    starts = jnp.take(col.offsets[:-1], row)
+    ends = jnp.take(col.offsets[1:], row)
+    rel = g - starts
+    ok = win_eq & (g + pat.size <= ends) & \
+        (rel >= jnp.take(from_rel.astype(jnp.int32), row))
+    inf = jnp.int32(2 ** 31 - 1)
+    cand = jnp.where(ok, rel, inf)
+    best = jax.ops.segment_min(cand, row, num_segments=cap)
+    return jnp.where(best == inf, jnp.int32(-1), best.astype(jnp.int32))

@@ -223,8 +223,9 @@ class BufferCatalog:
                 schema, num_rows, kinds, fetch = self._host_fetcher(e)
             else:
                 schema, num_rows, kinds, fetch = self._disk_fetcher(e)
-            return _slice_from_fetch(schema, num_rows, kinds, fetch,
-                                     lo, hi)
+            from .pressure import oom_retry
+            return oom_retry(_slice_from_fetch, schema, num_rows, kinds,
+                             fetch, lo, hi)
 
     @staticmethod
     def _meta_fetcher(metas, read_bytes):
@@ -407,8 +408,12 @@ class BufferCatalog:
         self.spilled_host_to_disk += e.nbytes
 
     def _unspill_host(self, e: BufferEntry):
+        from .pressure import oom_retry
         payload, _ = self._unpack_payload(e.host_payload)
-        obj = self._deserialize(payload)
+        # the device put can hit the REAL allocator's RESOURCE_EXHAUSTED
+        # even under the logical budget (fragmentation, temporaries):
+        # spill-everything-and-retry (DeviceMemoryEventHandler contract)
+        obj = oom_retry(self._deserialize, payload)
         e.host_payload = None
         e.device_obj = obj
         e.tier = StorageTier.DEVICE
@@ -484,7 +489,8 @@ class BufferCatalog:
                         disk_bytes=self.disk_bytes,
                         num_buffers=len(self._entries),
                         spilled_device_to_host=self.spilled_device_to_host,
-                        spilled_host_to_disk=self.spilled_host_to_disk)
+                        spilled_host_to_disk=self.spilled_host_to_disk,
+                        oom_retries=getattr(self, "oom_retries", 0))
 
 
 def _slice_from_fetch(schema, num_rows, kinds, fetch, lo: int, hi: int):

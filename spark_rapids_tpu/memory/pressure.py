@@ -1,0 +1,54 @@
+"""Device OOM -> synchronous spill -> retry.
+
+Reference contract: DeviceMemoryEventHandler.scala:42 — RMM's
+alloc-failure callback spills catalog buffers and retries the
+allocation.  PJRT exposes no Python alloc-failure callback, so the
+equivalent hook here is wrapping the operations that synchronously
+allocate device memory (host->device puts: ingestion, unspill, slice
+upload) and retrying them after pushing catalog buffers down the tiers.
+
+Compute launched asynchronously inside jit cannot be retried at the
+sync point (its output arrays are poisoned); those paths are protected
+by the PROACTIVE budget (DeviceManager.reserve -> spill_to_fit).  This
+module covers the reactive side the budget cannot see: allocator
+fragmentation and temporaries at put time.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+# markers PJRT uses for allocation failure across backends
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory",
+                "Failed to allocate")
+
+
+def is_device_oom(exc: BaseException) -> bool:
+    """True when ``exc`` is the backend's allocation failure (the
+    XlaRuntimeError RESOURCE_EXHAUSTED family)."""
+    name = type(exc).__name__
+    if name not in ("XlaRuntimeError", "RuntimeError", "MemoryError",
+                    "InternalError"):
+        return False
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def oom_retry(fn: Callable, *args, **kwargs):
+    """Call ``fn``; on a device allocation failure, spill EVERYTHING
+    spillable off the device tier and retry once (the
+    onAllocFailure(retry-once) contract).  Raises the original error if
+    nothing could be spilled or the retry fails too."""
+    from .catalog import BufferCatalog
+    try:
+        return fn(*args, **kwargs)
+    except Exception as e:  # noqa: BLE001 - filtered by is_device_oom
+        if not is_device_oom(e):
+            raise
+        cat = BufferCatalog.get()
+        # spill the whole device tier: the real allocator failed, so
+        # the logical budget underestimated true pressure
+        spilled = cat.spill_device_to_fit(cat.device_limit)
+        cat.oom_retries = getattr(cat, "oom_retries", 0) + 1
+        if spilled == 0:
+            raise
+        return fn(*args, **kwargs)

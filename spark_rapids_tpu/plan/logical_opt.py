@@ -228,6 +228,73 @@ def _rewrite_filter_project(f: L.Filter) -> L.LogicalPlan:
     return _filter_over(rest, npj)
 
 
+def _collect_cross_tree(p: L.LogicalPlan, rels: List[L.LogicalPlan]
+                        ) -> bool:
+    """Flatten a left-deep keyless cross/inner join tree into its
+    relations; False if the tree has keys/conditions (already shaped)."""
+    if isinstance(p, L.Join) and p.join_type in ("cross", "inner") and \
+            not p.left_keys and p.condition is None:
+        return _collect_cross_tree(p.children[0], rels) and \
+            _collect_cross_tree(p.children[1], rels)
+    rels.append(p)
+    return True
+
+
+def _reorder_cross_joins(f: L.Filter) -> L.Filter:
+    """Connectivity-first join ordering over a FROM comma-list.
+
+    The lowerer builds a left-deep cross-join tree in FROM order; when
+    a relation's only equi predicates reference relations that appear
+    LATER (TPC-DS q64 lists date_dim d2/d3 before customer), the
+    pairwise rewrite leaves a cartesian behind and the plan explodes.
+    Greedy fix (the classical heuristic): start from the first
+    relation, repeatedly attach a relation linked to the joined set by
+    an equality predicate; fall back to FROM order only when nothing
+    connects.  The pairwise _rewrite_filter_join pass then distributes
+    the predicates over the reordered tree."""
+    j = f.children[0]
+    rels: List[L.LogicalPlan] = []
+    if not (isinstance(j, L.Join) and _collect_cross_tree(j, rels)) or \
+            len(rels) < 3:
+        return f
+    names = [set(r.schema.names) for r in rels]
+    if len(set().union(*names)) != sum(len(n) for n in names):
+        return f                      # ambiguous columns: leave alone
+    # equality edges between relation indices
+    edges = []
+    for c in _flatten_and(f.condition):
+        if isinstance(c, ep.EqualTo):
+            ra = _refs(c.children[0])
+            rb = _refs(c.children[1])
+            if not ra or not rb:
+                continue
+            ia = [i for i, n in enumerate(names) if ra <= n]
+            ib = [i for i, n in enumerate(names) if rb <= n]
+            if len(ia) == 1 and len(ib) == 1 and ia[0] != ib[0]:
+                edges.append((ia[0], ib[0]))
+    joined = {0}
+    order = [0]
+    remaining = list(range(1, len(rels)))
+    while remaining:
+        pick = None
+        for i in remaining:           # FROM order among connected
+            if any((a in joined) != (b in joined) and i in (a, b)
+                   for a, b in edges):
+                pick = i
+                break
+        if pick is None:
+            pick = remaining[0]       # nothing connects: cross join
+        joined.add(pick)
+        order.append(pick)
+        remaining.remove(pick)
+    if order == list(range(len(rels))):
+        return f
+    tree: L.LogicalPlan = rels[order[0]]
+    for i in order[1:]:
+        tree = L.Join(tree, rels[i], "cross", [], [], None)
+    return L.Filter(f.condition, tree)
+
+
 def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
     """Bottom-up: push Filter conjuncts through inner/cross joins and
     promote cross-side equalities to join keys."""
@@ -242,6 +309,7 @@ def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
             merged = L.Filter(
                 ep.And(plan.condition, child.condition), child.children[0])
             return optimize(merged)
+        plan = _reorder_cross_joins(plan)
         out = _rewrite_filter_join(plan)
         if out is not plan:
             return out

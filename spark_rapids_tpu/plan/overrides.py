@@ -382,7 +382,12 @@ class PlanMeta:
                     self.reasons.append(
                         "string window aggregates not on TPU yet")
                 kind, lo, hi = wf.spec.frame
-                if kind == "range" and not (lo is None and hi is None):
+                if kind == "range" and not (lo is None and hi is None) \
+                        and isinstance(f, eagg.AggregateFunction):
+                    # frames only bind aggregate window functions;
+                    # the rank family ignores them (Spark semantics) —
+                    # SQL's default RANGE frame must not knock
+                    # row_number/rank/lead/lag off the TPU
                     # bounded RANGE: rank-search covers a single
                     # integral/decimal/date/timestamp order key with
                     # sum/count/avg/min/max/collect_list
@@ -446,7 +451,18 @@ class Planner:
         self.default_partitions = conf.get(SHUFFLE_PARTITIONS)
         self.batch_rows = conf.get(BATCH_SIZE_ROWS)
         self.fallbacks: List[str] = []
+        # plan decisions that silently REDUCE parallelism (a coalesce
+        # to one partition): surfaced in explain + logged, so a query
+        # that just went single-stream says so (round-3 Weak #9)
+        self.parallelism_warnings: List[str] = []
         self._placement = None
+
+    def _warn_collapse(self, why: str):
+        self.parallelism_warnings.append(why)
+        import logging
+        logging.getLogger(__name__).warning(
+            "parallelism collapse: %s (plan coalesces to ONE "
+            "partition)", why)
 
     def plan(self, logical: L.LogicalPlan) -> PhysicalPlan:
         meta = PlanMeta(logical, self.conf)
@@ -457,11 +473,15 @@ class Planner:
             from .cbo import choose_placement
             self._placement = choose_placement(logical)
         mode = self.conf.get(EXPLAIN).upper()
-        if mode in ("NOT_ON_TPU", "ALL"):
+        explain_on = mode in ("NOT_ON_TPU", "ALL")
+        if explain_on:
             text = meta.explain(all_nodes=(mode == "ALL"))
             if text:
                 print(text)
         phys = self._convert(meta)
+        if explain_on:
+            for w in self.parallelism_warnings:
+                print(f"! parallelism: {w}")
         phys = self._collapse_stages(phys)
         self._mark_deferred_verify(phys, parent=None)
         if self.conf.get(TEST_ENABLED):
@@ -716,6 +736,11 @@ class Planner:
                                                 nparts))
                 child = self._aqe_read(EX.TpuShuffleExchange(child, part))
             else:
+                self._warn_collapse(
+                    "window functions with "
+                    + ("mixed partition keys" if pby else
+                       "no PARTITION BY")
+                    + " run single-stream")
                 child = EX.TpuCoalescePartitions(child)
         return TpuWindow(p, child)
 

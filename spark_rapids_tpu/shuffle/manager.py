@@ -87,7 +87,16 @@ class ShuffleCatalog:
     def remove_shuffle(self, shuffle_id: int):
         with self._lock:
             for b in [b for b in self._store if b.shuffle_id == shuffle_id]:
+                for e in self._store[b]:
+                    e.close()           # release the catalog entry
                 del self._store[b]
+
+    def clear(self):
+        with self._lock:
+            for es in self._store.values():
+                for e in es:
+                    e.close()
+            self._store.clear()
 
     def nbytes(self) -> int:
         with self._lock:
@@ -126,6 +135,14 @@ class ShuffleManager:
             sid = self._next_shuffle
             self._next_shuffle += 1
             return sid
+
+    def clear_all(self):
+        """Drop every shuffle's map output (the ContextCleaner role:
+        shuffle blocks are per-query artifacts; without an end-of-query
+        release a long sweep accumulates them until the REAL device
+        allocator exhausts — the TPC-DS 99-query RESOURCE_EXHAUSTED
+        failure mode)."""
+        self.catalog.clear()
 
     # -- write side (RapidsCachingWriter role) -----------------------------
     def write_map_output(self, shuffle_id: int, map_id: int,

@@ -1,0 +1,73 @@
+"""API-validation audit: committed docs vs the LIVE registry.
+
+Reference role: api_validation/.../ApiValidation.scala — a build-time
+audit that the plugin's claimed API surface matches what actually
+exists.  Here the claims are docs/supported_ops.md and docs/configs.md
+(both generated); the audit regenerates them from the live registries
+(plan/overrides expression rules, the cast matrix, config entries) and
+reports any drift line by line, so stale docs fail CI instead of
+misleading users.
+
+Usage: python -m spark_rapids_tpu.tools.api_validation [docs_dir]
+Exit status 1 on drift.
+"""
+from __future__ import annotations
+
+import difflib
+import os
+import sys
+from typing import List
+
+from ..config import generate_docs
+from .docgen import supported_ops_doc
+
+
+def audit(docs_dir: str) -> List[str]:
+    """Drift lines between committed docs and the live registry."""
+    problems: List[str] = []
+    checks = [
+        ("supported_ops.md", supported_ops_doc()),
+        ("configs.md", generate_docs()),
+    ]
+    for fname, live in checks:
+        path = os.path.join(docs_dir, fname)
+        if not os.path.exists(path):
+            problems.append(f"{fname}: MISSING (never generated?)")
+            continue
+        with open(path) as f:
+            committed = f.read()
+        if committed == live:
+            continue
+        diff = list(difflib.unified_diff(
+            committed.splitlines(), live.splitlines(),
+            fromfile=f"docs/{fname} (committed)",
+            tofile=f"{fname} (live registry)", lineterm="", n=0))
+        # cap the report; the point is that drift EXISTS and where
+        problems.append(f"{fname}: drift ({len(diff) - 2} diff lines)")
+        problems.extend(diff[2:40])
+    return problems
+
+
+def main(argv=None):
+    # host-side CLI: never touch the accelerator backend.  Done HERE,
+    # not at import (tests import audit(); pinning the platform as an
+    # import side effect would silently move a whole TPU run to CPU).
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    argv = argv or sys.argv[1:]
+    docs_dir = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "docs")
+    problems = audit(docs_dir)
+    if problems:
+        print("api_validation: docs drift from the live registry "
+              "(regenerate with python -m spark_rapids_tpu.tools.docgen)")
+        for p in problems:
+            print("  " + p)
+        return 1
+    print("api_validation: docs match the live registry")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

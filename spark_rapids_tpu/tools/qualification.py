@@ -48,8 +48,110 @@ DEFAULT_SPEEDUP = 3.0
 TRANSITION_NODES = {"RowToColumnar", "ColumnarToRow"}
 
 
+#: foreign CPU-Spark physical operator -> the TPU exec that would
+#: replace it.  This is the tool's real purpose (QualificationMain
+#: analyzes CPU Spark event logs to forecast migration value; scoring
+#: this engine's own logs is circular).  Unmapped operators count as
+#: unsupported, exactly like the reference's unsupported-ops report.
+SPARK_CPU_NODE_MAP = {
+    "HashAggregate": "TpuHashAggregate",
+    "ObjectHashAggregate": "TpuHashAggregate",
+    "SortAggregate": "TpuHashAggregate",
+    "SortMergeJoin": "TpuShuffledHashJoin",
+    "ShuffledHashJoin": "TpuShuffledHashJoin",
+    "BroadcastHashJoin": "TpuBroadcastHashJoin",
+    "BroadcastNestedLoopJoin": "TpuNestedLoopJoin",
+    "CartesianProduct": "TpuNestedLoopJoin",
+    "Project": "TpuProject",
+    "Filter": "TpuFilter",
+    "Sort": "TpuSort",
+    "TakeOrderedAndProject": "TpuTopN",
+    "Window": "TpuWindow",
+    "Expand": "TpuExpand",
+    "Generate": "TpuGenerate",
+    "Union": "TpuUnion",
+    "LocalLimit": "TpuLocalLimit",
+    "GlobalLimit": "TpuGlobalLimit",
+    "Exchange": "TpuShuffleExchange",
+    "ShuffleExchange": "TpuShuffleExchange",
+    "BroadcastExchange": "TpuBroadcastExchange",
+    "AQEShuffleRead": "TpuAQEShuffleRead",
+    "CustomShuffleReader": "TpuAQEShuffleRead",
+    "FileSourceScan": "TpuFileScan",
+    "Scan parquet": "TpuFileScan",
+    "Scan orc": "TpuFileScan",
+    "Scan csv": "TpuFileScan",
+    "BatchScan": "TpuFileScan",
+    "LocalTableScan": "TpuLocalScan",
+    "Range": "TpuRange",
+    "Coalesce": "TpuCoalescePartitions",
+    "InMemoryTableScan": "TpuCachedExec",
+    "DataWritingCommand": "TpuFileWrite",
+    "InsertIntoHadoopFsRelationCommand": "TpuFileWrite",
+    "MapInPandas": "TpuMapInPandas",
+    "FlatMapGroupsInPandas": "TpuGroupedMapInPandas",
+    "ArrowEvalPython": "TpuMapInPandas",
+    "WindowInPandas": "TpuWindowInPandas",
+    "ColumnarToRow": "ColumnarToRow",
+    "RowToColumnar": "RowToColumnar",
+}
+
+#: structural containers in CPU Spark plans that are not operators
+_FOREIGN_CONTAINERS = {"WholeStageCodegen", "InputAdapter",
+                       "AdaptiveSparkPlan", "ReusedExchange", "Subquery",
+                       "SubqueryBroadcast", "ReusedSubquery"}
+
+
 def _node_name(node: str) -> str:
-    return node.split("[", 1)[0].strip()
+    return node.split("[", 1)[0].split("(", 1)[0].strip()
+
+
+def normalize_records(records: List[Dict]) -> List[Dict]:
+    """Map foreign (CPU Spark) operator names to their would-be TPU
+    execs so the same scoring applies; native Tpu* records pass
+    through.  Containers (WholeStageCodegen...) drop out."""
+    out = []
+    for r in records:
+        nodes = []
+        for n in r.get("nodes", []):
+            name = _node_name(str(n))
+            if name.startswith("Tpu") or name in TRANSITION_NODES:
+                nodes.append(name)
+                continue
+            base = name.split("#", 1)[0].strip()
+            if base in _FOREIGN_CONTAINERS or \
+                    any(base.startswith(c) for c in _FOREIGN_CONTAINERS):
+                continue
+            mapped = SPARK_CPU_NODE_MAP.get(base)
+            if mapped is None:
+                # plan lines carry detail suffixes ("Exchange
+                # hashpartitioning(...)", "Scan parquet db.t"): longest
+                # matching prefix wins
+                for key in sorted(SPARK_CPU_NODE_MAP, key=len,
+                                  reverse=True):
+                    if base.startswith(key):
+                        mapped = SPARK_CPU_NODE_MAP[key]
+                        break
+            nodes.append(mapped if mapped is not None else base)
+        r2 = dict(r)
+        r2["nodes"] = nodes
+        if "wall_ms" not in r2:
+            r2["wall_ms"] = float(r2.pop("duration_ms",
+                                         r2.pop("durationMs", 0.0)))
+        out.append(r2)
+    return out
+
+
+def read_foreign_json(path: str) -> List[Dict]:
+    """Foreign trace format: a JSON file with either a list of
+    {query_id, wall_ms|duration_ms, nodes:[operator names]} or
+    {"queries": [...]} — the simple operator-names+times contract any
+    CPU run can produce (from explain output + query timings)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        doc = doc.get("queries", [])
+    return list(doc)
 
 
 def qualify(records: List[Dict]) -> Dict:
@@ -65,7 +167,7 @@ def qualify(records: List[Dict]) -> Dict:
     accel_ms = 0.0
     est_ms = 0.0
     unsupported: Dict[str, int] = {}
-    for r in records:
+    for r in normalize_records(records):
         nodes = [_node_name(n) for n in r.get("nodes", [])]
         core = [n for n in nodes if n not in TRANSITION_NODES]
         n_tpu = sum(1 for n in core if n in TPU_NODES)
@@ -135,10 +237,14 @@ def to_csv(report: Dict) -> str:
 def main(argv=None):
     argv = argv or sys.argv[1:]
     if not argv:
-        print("usage: qualification <event_log.jsonl> [--csv]",
-              file=sys.stderr)
+        print("usage: qualification <event_log.jsonl|foreign.json> "
+              "[--csv]", file=sys.stderr)
         return 1
-    records = read_event_log(argv[0])
+    path = argv[0]
+    if path.endswith(".json"):
+        records = read_foreign_json(path)
+    else:
+        records = read_event_log(path)
     report = qualify(records)
     if "--csv" in argv:
         print(to_csv(report), end="")

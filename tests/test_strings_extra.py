@@ -1,4 +1,5 @@
 """Extended string expression tests (reference: string_test.py breadth)."""
+import pytest
 from spark_rapids_tpu.api import functions as F
 
 from harness import assert_tpu_and_cpu_are_equal_collect
@@ -46,3 +47,54 @@ class TestStringsExtra:
             lambda s: gen_df(s, {"s": StringGen(charset="ab12")}, N)
             .select(F.regexp_replace("s", "[0-9]+", "#").alias("rr"),
                     F.regexp_extract("s", "([0-9]+)", 1).alias("rx")))
+
+
+class TestDeviceMultiSegmentLike:
+    """Device path for general %-only LIKE patterns (ordered segment
+    search via find_in_row) — oracle vs python re."""
+
+    @pytest.mark.parametrize("pattern", [
+        "a%b", "%a%b%", "ab%cd%ef", "a%b%c", "x%", "%x", "%mid%dle%",
+        "a%a", "%%", "abc"])
+    def test_patterns_match_re_oracle(self, pattern):
+        import re as _re
+        from spark_rapids_tpu.columnar.column import StringColumn
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.columnar import Schema, Field, dtypes as T
+        from spark_rapids_tpu.expr.string_ops import Like, _like_to_regex
+        from spark_rapids_tpu.expr.core import (AttributeReference,
+                                                Literal)
+        vals = ["ab", "aXb", "abcdef", "ab-cd-ef", "abcdXef", "", "a",
+                "aa", "xax", "middle", "mid-dle", "ddmiddledd",
+                "bXa", None, "ababab", "x", "aba"]
+        col = StringColumn.from_pylist(vals)
+        batch = ColumnarBatch(Schema([Field("s", T.STRING)]), [col],
+                              len(vals))
+        e = Like(AttributeReference("s", T.STRING, True),
+                 Literal(pattern, T.STRING)).bind(batch.schema)
+        got = e.columnar_eval(batch)
+        rx = _re.compile(_like_to_regex(pattern, "\\"), _re.DOTALL)
+        out = got.data.astype(bool) & got.validity
+        for i, v in enumerate(vals):
+            want = v is not None and rx.fullmatch(v) is not None
+            assert bool(out[i]) == want, (pattern, v)
+
+    def test_host_regex_counter_and_device_path(self):
+        from spark_rapids_tpu.expr import string_ops as so
+        from spark_rapids_tpu.columnar.column import StringColumn
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.columnar import Schema, Field, dtypes as T
+        from spark_rapids_tpu.expr.core import (AttributeReference,
+                                                Literal)
+        col = StringColumn.from_pylist(["abc", "adc", "xbz"])
+        batch = ColumnarBatch(Schema([Field("s", T.STRING)]), [col], 3)
+        ref = AttributeReference("s", T.STRING, True)
+        before = so.HOST_REGEX_EVALS["count"]
+        # %-only pattern: device path, no counter bump
+        so.Like(ref, Literal("a%c", T.STRING)).bind(batch.schema) \
+            .columnar_eval(batch)
+        assert so.HOST_REGEX_EVALS["count"] == before
+        # underscore forces the host engine and bumps the counter
+        so.Like(ref, Literal("a_c", T.STRING)).bind(batch.schema) \
+            .columnar_eval(batch)
+        assert so.HOST_REGEX_EVALS["count"] == before + 1

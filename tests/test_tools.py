@@ -231,3 +231,75 @@ class TestToolDepth:
         assert b["attributed_time_ms"] >= 0
         assert b["time_by_operator_ms"]
         assert abs(sum(b["time_share"].values()) - 1.0) < 0.05
+
+
+class TestForeignQualification:
+    """De-circularized qualification (QualificationMain.scala:29 role):
+    score a FOREIGN CPU-Spark trace (operator names + times), not this
+    engine's own event logs."""
+
+    def test_foreign_spark_trace_scores(self, tmp_path):
+        import json
+        from spark_rapids_tpu.tools.qualification import (
+            qualify, read_foreign_json, to_csv)
+        trace = {"queries": [
+            {"query_id": "q1", "duration_ms": 4000.0, "nodes": [
+                "WholeStageCodegen (1)", "HashAggregate",
+                "Exchange hashpartitioning", "HashAggregate",
+                "Project", "Filter", "Scan parquet db.t"]},
+            {"query_id": "q2", "duration_ms": 1000.0, "nodes": [
+                "SortMergeJoin", "Sort", "Exchange", "MyWeirdUdfExec",
+                "Scan parquet x"]},
+        ]}
+        p = tmp_path / "trace.json"
+        p.write_text(json.dumps(trace))
+        report = qualify(read_foreign_json(str(p)))
+        assert report["total_ms"] == 5000.0
+        q1 = report["queries"][0]
+        # every q1 operator maps to a TPU exec
+        assert q1["tpu_operator_fraction"] == 1.0
+        assert q1["recommendation"] == "STRONGLY RECOMMENDED"
+        assert q1["estimated_speedup"] > 1.0
+        q2 = report["queries"][1]
+        assert "MyWeirdUdfExec" in q2["unsupported_ops"]
+        assert 0.0 < q2["tpu_operator_fraction"] < 1.0
+        assert "MyWeirdUdfExec" in report["unsupported_operators"]
+        csv_text = to_csv(report)
+        assert "q1" in csv_text and "q2" in csv_text
+
+    def test_native_records_still_score(self):
+        from spark_rapids_tpu.tools.qualification import qualify
+        report = qualify([
+            {"query_id": 0, "wall_ms": 100.0,
+             "nodes": ["TpuHashAggregate[k]", "TpuFileScan[parquet]"]}])
+        assert report["queries"][0]["tpu_operator_fraction"] == 1.0
+
+
+class TestApiValidation:
+    """ApiValidation.scala role: committed docs must match the live
+    registry."""
+
+    def test_committed_docs_match_registry(self):
+        import os
+        from spark_rapids_tpu.tools.api_validation import audit
+        docs = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "docs")
+        problems = audit(docs)
+        assert not problems, "\n".join(
+            ["docs drift from live registry — regenerate with "
+             "python -m spark_rapids_tpu.tools.docgen:"] + problems)
+
+    def test_audit_detects_drift(self, tmp_path):
+        import os
+        import shutil
+        from spark_rapids_tpu.tools.api_validation import audit
+        docs = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "docs")
+        bad = tmp_path / "docs"
+        bad.mkdir()
+        for f in ("supported_ops.md", "configs.md"):
+            shutil.copy(os.path.join(docs, f), bad / f)
+        text = (bad / "supported_ops.md").read_text()
+        (bad / "supported_ops.md").write_text(
+            text.replace("CollectList", "CollectEverything", 1))
+        assert any("supported_ops" in p for p in audit(str(bad)))
