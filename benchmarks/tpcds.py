@@ -578,10 +578,15 @@ def verify(data_dir: str, queries, out_path: str,
             run_one(sql, entry)
         except Exception as e:  # noqa: BLE001 - recorded per query
             if "RESOURCE_EXHAUSTED" in str(e):
-                # real HBM exhaustion mid-sweep: rebuild both sessions
-                # (drops lingering plan/shuffle references) and retry
-                # this query once before recording a failure
+                # real HBM exhaustion mid-sweep: drop the PROCESS-WIDE
+                # shuffle/catalog state a failed query left behind
+                # (clear_all only runs on success), rebuild sessions,
+                # and retry once before recording a failure
                 import gc
+                from spark_rapids_tpu.shuffle.manager import \
+                    ShuffleManager
+                if ShuffleManager._instance is not None:
+                    ShuffleManager._instance.clear_all()
                 s_tpu = TpuSession(TpuConf(
                     {"spark.rapids.tpu.sql.enabled": True}))
                 s_cpu = TpuSession(TpuConf(

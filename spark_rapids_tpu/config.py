@@ -96,6 +96,17 @@ BATCH_SIZE_ROWS = conf_int(
 BATCH_SIZE_BYTES = conf_bytes(
     "spark.rapids.tpu.sql.batchSizeBytes", 512 * 2**20,
     "Target bytes per columnar batch for coalescing")
+PYTHON_USE_WORKERS = conf_bool(
+    "spark.rapids.tpu.python.useWorkerProcesses", True,
+    "Run pandas UDFs in persistent out-of-process Python workers over "
+    "Arrow IPC with pipelined batch streaming (reference: "
+    "GpuArrowEvalPythonExec + BatchQueue); functions that cannot "
+    "pickle fall back in-process")
+PYTHON_WORKERS = conf_int(
+    "spark.rapids.tpu.python.concurrentPythonWorkers", 2,
+    "Max concurrently leased Python worker processes (reference: "
+    "spark.rapids.python.concurrentPythonWorkers / "
+    "PythonWorkerSemaphore)")
 SORT_OOC_CHUNK_ROWS = conf_int(
     "spark.rapids.tpu.sql.sort.outOfCore.chunkRows", 1 << 22,
     "Out-of-core sort merge emits chunks of at most about this many "

@@ -158,28 +158,35 @@ class CpuWindow(CpuExec):
 
             def _rank_stats(gdf):
                 """(rank_min, rank_max, size) per row of a sorted group,
-                via order-key run boundaries (exact for multi-key
-                orderings, unlike column-wise pandas rank)."""
+                via order-key run boundaries — exact for any key count,
+                ORDER BY direction and null placement (the rows arrive
+                already sorted; only EQUALITY between neighbors is
+                used, so direction cannot invert ranks the way
+                value-based pandas rank does)."""
                 m = len(gdf)
                 newrun = np.zeros(m, bool)
-                newrun[0] = True
+                if m:
+                    newrun[0] = True
                 for kcol in skeys:
                     colv = gdf[kcol].to_numpy(dtype=object)
-                    for i in range(1, m):
-                        a, b = colv[i], colv[i - 1]
-                        same = (a is b) or (a == b) or (
-                            pd.isna(a) is True and pd.isna(b) is True)
-                        if not same:
-                            newrun[i] = True
-                rmin = np.zeros(m, np.int64)
-                rmax = np.zeros(m, np.int64)
-                start = 0
-                for i in range(1, m + 1):
-                    if i == m or newrun[i]:
-                        rmin[start:i] = start + 1
-                        rmax[start:i] = i
-                        start = i
-                return rmin, rmax, m
+                    if m > 1:
+                        a, b = colv[1:], colv[:-1]
+                        both_na = pd.isna(a.astype(object)) & \
+                            pd.isna(b.astype(object))
+                        neq = np.array([x != y for x, y in zip(a, b)],
+                                       dtype=bool)
+                        newrun[1:] |= neq & ~both_na
+                runid = np.cumsum(newrun)
+                pos = np.arange(m, dtype=np.int64)
+                first = np.zeros(m, np.int64)
+                last = np.zeros(m, np.int64)
+                if m:
+                    # first/last position of each run, broadcast back
+                    starts = pos[newrun]
+                    ends = np.r_[starts[1:] - 1, m - 1]
+                    first = starts[runid - 1]
+                    last = ends[runid - 1]
+                return first + 1, last + 1, m
 
             if isinstance(wf.func, (NTile, PercentRank, CumeDist)):
                 fn = wf.func
@@ -205,17 +212,6 @@ class CpuWindow(CpuExec):
                     else pd.Series([], dtype=object)
             elif isinstance(wf.func, RowNumber):
                 res = grouped.cumcount() + 1
-            elif isinstance(wf.func, (Rank, DenseRank)) and \
-                    len(skeys) == 1:
-                # single order key: pandas' vectorized rank is exact
-                if isinstance(wf.func, Rank):
-                    res = grouped[skeys[0]].transform(
-                        lambda s_: s_.rank(method="min")) \
-                        .astype(np.int64)
-                else:
-                    res = grouped[skeys[0]].transform(
-                        lambda s_: s_.rank(method="dense")) \
-                        .astype(np.int64)
             elif isinstance(wf.func, (Rank, DenseRank)):
                 # exact multi-key ranking via order-key run boundaries
                 # (column-wise pandas rank ties only on the FIRST key)

@@ -47,6 +47,76 @@ def _run_map(fn, tables: Iterator[pa.Table], out_schema: pa.Schema):
         yield _cast_result(pdf, out_schema)
 
 
+def _use_workers() -> bool:
+    from ..config import get_active, PYTHON_USE_WORKERS
+    try:
+        return bool(get_active().get(PYTHON_USE_WORKERS))
+    except Exception:  # noqa: BLE001 - before config init
+        return False
+
+
+def _dispatch_to_worker(fn, worker_gen_factory, fallback_factory):
+    """Shared worker-vs-in-process dispatch: the fn pickles ONCE (the
+    bytes feed the pool), init failures fall back before any input is
+    consumed, and unpicklable fns never leave the process."""
+    if _use_workers():
+        import pickle as _pickle
+        from .python_worker import PythonWorkerInitError
+        try:
+            fn_bytes = _pickle.dumps(fn)
+        except Exception:  # noqa: BLE001 - closures: run in-process
+            fn_bytes = None
+        if fn_bytes is not None:
+            gen = worker_gen_factory(fn_bytes)
+            try:
+                first = next(gen)
+            except StopIteration:
+                return
+            except PythonWorkerInitError:
+                # fn unpickles only in the parent's import context
+                # (e.g. REPL-defined): no input consumed yet
+                yield from fallback_factory()
+                return
+            yield first
+            yield from gen
+            return
+    yield from fallback_factory()
+
+
+def _map_results(fn, tables: Iterator[pa.Table], out_schema: pa.Schema):
+    """mapInPandas results: out-of-process worker with pipelined Arrow
+    IPC when enabled and the fn pickles; else the in-process path
+    (GpuArrowEvalPythonExec -> in-JVM eval fallback role)."""
+    from .python_worker import PythonWorkerPool
+    yield from _dispatch_to_worker(
+        fn,
+        lambda fb: PythonWorkerPool.get().run_map(fn, tables,
+                                                  out_schema,
+                                                  fn_bytes=fb),
+        lambda: _run_map(fn, tables, out_schema))
+
+
+def _grouped_results(fn, keys, table: pa.Table, out_schema: pa.Schema):
+    """applyInPandas results: per-group tables stream through a worker
+    process when enabled and picklable; else in-process."""
+    import pickle as _pickle
+    from .python_worker import PythonWorkerPool
+
+    def group_tables():
+        for key, pdf in _iter_key_groups(keys, table):
+            gt = pa.Table.from_pandas(pdf, preserve_index=False)
+            gt = gt.replace_schema_metadata(
+                {b"__group_key": _pickle.dumps(key)})
+            yield gt
+    yield from _dispatch_to_worker(
+        fn,
+        lambda fb: PythonWorkerPool.get().run_grouped(fn,
+                                                      group_tables(),
+                                                      out_schema,
+                                                      fn_bytes=fb),
+        lambda: _run_grouped(fn, keys, table, out_schema))
+
+
 def _iter_key_groups(keys: List[ec.Expression], table: pa.Table):
     """Shared group-by-keys plumbing for every pandas exec: evaluate
     key expressions, group the pandas frame, yield (key_tuple, pdf).
@@ -151,7 +221,7 @@ class TpuMapInPandas(TpuExec):
 
         def run(part):
             tables = (to_arrow(b) for b in part)
-            for t in _run_map(self.logical.fn, tables, out):
+            for t in _map_results(self.logical.fn, tables, out):
                 self.metrics[NUM_OUTPUT_ROWS] += t.num_rows
                 yield from_arrow(t)
         return [run(p) for p in self.children[0].execute()]
@@ -180,8 +250,8 @@ class TpuGroupedMapInPandas(TpuExec):
             if not tables:
                 return
             whole = pa.concat_tables(tables, promote_options="permissive")
-            for t in _run_grouped(self.logical.fn, self.logical.keys,
-                                  whole, out):
+            for t in _grouped_results(self.logical.fn, self.logical.keys,
+                                      whole, out):
                 self.metrics[NUM_OUTPUT_ROWS] += t.num_rows
                 yield from_arrow(t)
         return [run()]

@@ -1,0 +1,258 @@
+"""Persistent Python worker processes for pandas UDF execution.
+
+Reference: GpuArrowEvalPythonExec.scala:470 (Arrow stream to an
+out-of-process Python worker), its BatchQueue (:187 — reader and writer
+sides pipeline so the JVM keeps producing while Python computes), and
+PythonWorkerSemaphore.scala (bounds concurrent workers so Python heap
+pressure cannot fork-bomb the host).
+
+Design here:
+- A process-wide :class:`PythonWorkerPool` keeps ``spawn``-ed workers
+  alive across queries (fork-per-batch would pay interpreter + import
+  startup every time).
+- The wire is Arrow IPC stream bytes over the multiprocessing pipe —
+  the same serialization contract as the reference's Arrow socket.
+- Pipelining: a writer THREAD streams input batches to the worker
+  while the consumer thread reads results — the producer stays ahead
+  of the Python compute (the BatchQueue role).  The pipe buffers give
+  the in-flight window.
+- A semaphore caps concurrently LEASED workers
+  (spark.rapids.tpu.python.concurrentPythonWorkers).
+
+The user function must be picklable (module-level def).  Functions that
+cannot pickle fall back to the in-process path transparently.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import threading
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+
+
+def _table_to_ipc(t: pa.Table) -> bytes:
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    return sink.getvalue()
+
+
+def _ipc_to_table(b: bytes) -> pa.Table:
+    with pa.ipc.open_stream(io.BytesIO(b)) as r:
+        return r.read_all()
+
+
+def _worker_main(conn):
+    """Worker process loop: ("init", mode, fn) then a stream of
+    ("batch", ipc) / ("end",) per task; results stream back as
+    ("result", ipc)... ("done",) or ("error", message)."""
+    import pandas as pd  # noqa: F401 - the udf contract is pandas
+
+    fn = None
+    mode = "map"
+    out_schema = None
+    while True:
+        try:
+            msg = conn.recv()
+        except EOFError:
+            return
+        kind = msg[0]
+        if kind == "shutdown":
+            return
+        if kind == "init":
+            _, mode, fn_bytes, schema_ipc = msg
+            try:
+                fn = pickle.loads(fn_bytes)
+                out_schema = _ipc_to_table(schema_ipc).schema
+                conn.send(("ok",))
+            except Exception as e:  # noqa: BLE001
+                conn.send(("error", f"init failed: {e}"))
+            continue
+        if kind == "task":
+            try:
+                _run_task(conn, fn, mode, out_schema)
+            except Exception as e:  # noqa: BLE001
+                import traceback
+                conn.send(("error",
+                           f"{type(e).__name__}: {e}\n"
+                           + traceback.format_exc(limit=5)))
+
+
+def _run_task(conn, fn, mode, out_schema):
+    from .python_exec import _cast_result
+
+    def batches() -> Iterator[pa.Table]:
+        while True:
+            msg = conn.recv()
+            if msg[0] == "end":
+                return
+            yield _ipc_to_table(msg[1])
+
+    if mode == "map":
+        # mapInPandas: fn(iterator of pdfs) -> iterator of pdfs; results
+        # stream back AS PRODUCED so the parent overlaps with compute
+        def pdfs():
+            for t in batches():
+                if t.num_rows:
+                    yield t.to_pandas()
+        for pdf in fn(pdfs()):
+            out = _cast_result(pdf, out_schema)
+            conn.send(("result", _table_to_ipc(out)))
+    else:  # grouped: one input table per group, fn(pdf) -> pdf
+        import inspect
+        takes_key = len(inspect.signature(fn).parameters) >= 2
+        for t in batches():
+            key = None
+            if takes_key and t.schema.metadata and \
+                    b"__group_key" in t.schema.metadata:
+                key = pickle.loads(t.schema.metadata[b"__group_key"])
+            pdf = t.to_pandas()
+            out = fn(key, pdf) if takes_key else fn(pdf)
+            conn.send(("result",
+                       _table_to_ipc(_cast_result(out, out_schema))))
+    conn.send(("done",))
+
+
+class _Worker:
+    def __init__(self):
+        import multiprocessing as mp
+        ctx = mp.get_context("spawn")
+        self.conn, child_conn = ctx.Pipe()
+        self.proc = ctx.Process(target=_worker_main, args=(child_conn,),
+                                daemon=True)
+        self.proc.start()
+        child_conn.close()
+
+    def alive(self) -> bool:
+        return self.proc.is_alive()
+
+    def close(self):
+        try:
+            self.conn.send(("shutdown",))
+        except Exception:  # noqa: BLE001
+            pass
+        self.proc.join(timeout=5)
+        if self.proc.is_alive():
+            self.proc.terminate()
+        self.conn.close()
+
+
+class PythonWorkerError(RuntimeError):
+    pass
+
+
+class PythonWorkerInitError(PythonWorkerError):
+    """Worker could not initialize (e.g. the fn unpickles only in the
+    parent's import context); raised BEFORE any input is consumed, so
+    callers can fall back in-process safely."""
+
+
+class PythonWorkerPool:
+    """Process-wide pool with a leasing semaphore
+    (PythonWorkerSemaphore role)."""
+
+    _instance: Optional["PythonWorkerPool"] = None
+
+    def __init__(self, max_workers: int = 2):
+        self.max_workers = max_workers
+        self._sem = threading.Semaphore(max_workers)
+        self._idle: List[_Worker] = []
+        self._lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "PythonWorkerPool":
+        from ..config import get_active, PYTHON_WORKERS
+        try:
+            n = int(get_active().get(PYTHON_WORKERS))
+        except Exception:  # noqa: BLE001 - before config init
+            n = 2
+        pool = cls._instance
+        if pool is None or pool.max_workers != n:
+            # a session with a different cap supersedes the pool (the
+            # conf is per-session; a frozen first-session cap would
+            # make it silently inoperative); idle workers shut down
+            if pool is not None:
+                pool.close()
+            cls._instance = pool = PythonWorkerPool(n)
+        return pool
+
+    def close(self):
+        with self._lock:
+            for w in self._idle:
+                w.close()
+            self._idle.clear()
+
+    def _acquire(self) -> _Worker:
+        self._sem.acquire()
+        with self._lock:
+            while self._idle:
+                w = self._idle.pop()
+                if w.alive():
+                    return w
+                w.close()
+        return _Worker()
+
+    def _release(self, w: _Worker, broken: bool):
+        with self._lock:
+            if broken or not w.alive():
+                w.close()
+            else:
+                self._idle.append(w)
+        self._sem.release()
+
+    def run_map(self, fn, input_tables: Iterator[pa.Table],
+                out_schema: pa.Schema,
+                fn_bytes: Optional[bytes] = None) -> Iterator[pa.Table]:
+        """mapInPandas through a worker process with pipelined writes:
+        a writer thread streams input while this thread consumes
+        results (the BatchQueue overlap)."""
+        yield from self._run(fn, "map", input_tables, out_schema,
+                             fn_bytes)
+
+    def run_grouped(self, fn, group_tables: Iterator[pa.Table],
+                    out_schema: pa.Schema,
+                    fn_bytes: Optional[bytes] = None
+                    ) -> Iterator[pa.Table]:
+        yield from self._run(fn, "grouped", group_tables, out_schema,
+                             fn_bytes)
+
+    def _run(self, fn, mode, input_tables, out_schema, fn_bytes=None):
+        if fn_bytes is None:
+            fn_bytes = pickle.dumps(fn)  # raises for closures: caller
+        w = self._acquire()              # falls back in-process
+        broken = True
+        try:
+            empty = pa.Table.from_arrays(
+                [pa.array([], type=f.type) for f in out_schema],
+                schema=out_schema)
+            w.conn.send(("init", mode, fn_bytes, _table_to_ipc(empty)))
+            resp = w.conn.recv()
+            if resp[0] != "ok":
+                raise PythonWorkerInitError(resp[1])
+            w.conn.send(("task",))
+            send_err = []
+
+            def writer():
+                try:
+                    for t in input_tables:
+                        w.conn.send(("batch", _table_to_ipc(t)))
+                    w.conn.send(("end",))
+                except Exception as e:  # noqa: BLE001
+                    send_err.append(e)
+            wt = threading.Thread(target=writer, daemon=True)
+            wt.start()
+            while True:
+                msg = w.conn.recv()
+                if msg[0] == "done":
+                    break
+                if msg[0] == "error":
+                    raise PythonWorkerError(msg[1])
+                yield _ipc_to_table(msg[1])
+            wt.join(timeout=10)
+            if send_err:
+                raise send_err[0]
+            broken = False
+        finally:
+            self._release(w, broken)

@@ -271,3 +271,27 @@ class TestWindowCompleteness:
             return s._last_planner.parallelism_warnings
         warnings = with_tpu_session(run)
         assert any("single-stream" in w for w in warnings)
+
+    def test_rank_descending_with_nulls(self):
+        """DESC single-key rank through BOTH engines (the CPU oracle
+        previously ranked by ascending value, inverting DESC ranks)."""
+        import numpy as np
+        from harness import with_cpu_session, with_tpu_session
+        k = [0, 0, 0, 1, 1, 1, 1]
+        v = [3, 1, 1, None, 5, 5, 2]
+
+        def run(s):
+            df = s.create_dataframe({"k": np.array(k, dtype=np.int64),
+                                     "v": v})
+            df.create_or_replace_temp_view("t")
+            return sorted(s.sql(
+                "select k, v, rank() over (partition by k "
+                "order by v desc) r, dense_rank() over (partition by k "
+                "order by v desc) d from t").collect(),
+                key=lambda r: (r[0], r[2]))
+        cpu = with_cpu_session(run)
+        tpu = with_tpu_session(run)
+        assert cpu == tpu
+        # spot-check Spark semantics: [3,1,1] desc -> ranks [1,2,2]
+        g0 = [(r[1], r[2], r[3]) for r in cpu if r[0] == 0]
+        assert g0 == [(3, 1, 1), (1, 2, 2), (1, 2, 2)]
