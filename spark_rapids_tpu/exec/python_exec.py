@@ -23,19 +23,9 @@ from .cpu import CpuExec
 from .tpu_basic import TpuExec
 
 
-def _cast_result(pdf, out_schema: pa.Schema) -> pa.Table:
-    """User pandas result -> arrow table in the declared schema."""
-    t = pa.Table.from_pandas(pdf, preserve_index=False)
-    arrays = []
-    for f in out_schema:
-        if f.name not in t.column_names:
-            raise ValueError(
-                f"pandas UDF result is missing column {f.name!r}")
-        c = t.column(f.name).combine_chunks()
-        if c.type != f.type:
-            c = pa.compute.cast(c, f.type, safe=False)
-        arrays.append(c)
-    return pa.Table.from_arrays(arrays, schema=out_schema)
+from .python_worker import cast_result as _cast_result  # noqa: E402
+# (pyarrow-only; lives in python_worker so worker processes never
+# import the engine)
 
 
 def _run_map(fn, tables: Iterator[pa.Table], out_schema: pa.Schema):

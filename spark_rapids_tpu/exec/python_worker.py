@@ -44,6 +44,24 @@ def _ipc_to_table(b: bytes) -> pa.Table:
         return r.read_all()
 
 
+def cast_result(pdf, out_schema: pa.Schema) -> pa.Table:
+    """User pandas result -> arrow table in the declared schema.
+    Lives HERE (pyarrow-only) so worker processes never import the
+    engine (python_exec pulls in jax: seconds of cold start and
+    hundreds of MB RSS per worker)."""
+    t = pa.Table.from_pandas(pdf, preserve_index=False)
+    arrays = []
+    for f in out_schema:
+        if f.name not in t.column_names:
+            raise ValueError(
+                f"pandas UDF result is missing column {f.name!r}")
+        c = t.column(f.name).combine_chunks()
+        if c.type != f.type:
+            c = pa.compute.cast(c, f.type, safe=False)
+        arrays.append(c)
+    return pa.Table.from_arrays(arrays, schema=out_schema)
+
+
 def _worker_main(conn):
     """Worker process loop: ("init", mode, fn) then a stream of
     ("batch", ipc) / ("end",) per task; results stream back as
@@ -81,7 +99,7 @@ def _worker_main(conn):
 
 
 def _run_task(conn, fn, mode, out_schema):
-    from .python_exec import _cast_result
+    _cast_result = cast_result
 
     def batches() -> Iterator[pa.Table]:
         while True:
@@ -154,12 +172,14 @@ class PythonWorkerPool:
     (PythonWorkerSemaphore role)."""
 
     _instance: Optional["PythonWorkerPool"] = None
+    _get_lock = threading.Lock()
 
     def __init__(self, max_workers: int = 2):
         self.max_workers = max_workers
         self._sem = threading.Semaphore(max_workers)
         self._idle: List[_Worker] = []
         self._lock = threading.Lock()
+        self._superseded = False
 
     @classmethod
     def get(cls) -> "PythonWorkerPool":
@@ -168,15 +188,18 @@ class PythonWorkerPool:
             n = int(get_active().get(PYTHON_WORKERS))
         except Exception:  # noqa: BLE001 - before config init
             n = 2
-        pool = cls._instance
-        if pool is None or pool.max_workers != n:
-            # a session with a different cap supersedes the pool (the
-            # conf is per-session; a frozen first-session cap would
-            # make it silently inoperative); idle workers shut down
-            if pool is not None:
-                pool.close()
-            cls._instance = pool = PythonWorkerPool(n)
-        return pool
+        with cls._get_lock:
+            pool = cls._instance
+            if pool is None or pool.max_workers != n:
+                # a session with a different cap supersedes the pool
+                # (the conf is per-session; a frozen first-session cap
+                # would make it silently inoperative); idle workers
+                # shut down, in-flight leases close on release below
+                if pool is not None:
+                    pool._superseded = True
+                    pool.close()
+                cls._instance = pool = PythonWorkerPool(n)
+            return pool
 
     def close(self):
         with self._lock:
@@ -196,7 +219,9 @@ class PythonWorkerPool:
 
     def _release(self, w: _Worker, broken: bool):
         with self._lock:
-            if broken or not w.alive():
+            if broken or self._superseded or not w.alive():
+                # a worker released into a superseded pool would leak
+                # (nothing drains that pool's idle list again)
                 w.close()
             else:
                 self._idle.append(w)
@@ -238,9 +263,16 @@ class PythonWorkerPool:
                 try:
                     for t in input_tables:
                         w.conn.send(("batch", _table_to_ipc(t)))
-                    w.conn.send(("end",))
                 except Exception as e:  # noqa: BLE001
                     send_err.append(e)
+                finally:
+                    # ALWAYS terminate the stream: without "end" the
+                    # worker blocks in recv and the parent waits for
+                    # "done" forever (upstream exec errors deadlocked)
+                    try:
+                        w.conn.send(("end",))
+                    except Exception:  # noqa: BLE001
+                        pass
             wt = threading.Thread(target=writer, daemon=True)
             wt.start()
             while True:
@@ -249,6 +281,8 @@ class PythonWorkerPool:
                     break
                 if msg[0] == "error":
                     raise PythonWorkerError(msg[1])
+                if send_err:
+                    raise send_err[0]
                 yield _ipc_to_table(msg[1])
             wt.join(timeout=10)
             if send_err:

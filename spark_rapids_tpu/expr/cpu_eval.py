@@ -339,11 +339,25 @@ def _cast(e: castmod.Cast, t):
         info = np.iinfo(to.np_dtype)
         clipped = pc.if_else(pc.coalesce(pc.is_nan(v), pa.scalar(False)),
                              pa.scalar(0.0), v)
+        # float(int64.max) rounds UP to 2^63, which then WRAPS in the
+        # integer cast; clamp to the largest double strictly below it
+        # so +inf / 1e300 saturate to Long.Max (Spark semantics)
+        hi = float(info.max)
+        if float(np.float64(hi)) > info.max:
+            hi = float(np.nextafter(np.float64(hi), 0.0))
         clipped = pc.min_element_wise(
             pc.max_element_wise(clipped, pa.scalar(float(info.min)),
                                 skip_nulls=False),
-            pa.scalar(float(info.max)), skip_nulls=False)
-        return pc.cast(pc.trunc(clipped), to_arrow_type(to), safe=False)
+            pa.scalar(hi), skip_nulls=False)
+        out = pc.cast(pc.trunc(clipped), to_arrow_type(to), safe=False)
+        if info.bits < 64:
+            return out        # float(info.max) exact: clamp saturates
+        # 64-bit: values at/above 2^63 must saturate to Long.Max (the
+        # nextafter clamp alone would give 2^63-1024)
+        return pc.if_else(
+            pc.greater_equal(pc.coalesce(v, pa.scalar(0.0)),
+                             pa.scalar(2.0 ** 63)),
+            pa.scalar(info.max, to_arrow_type(to)), out)
     if src_t == T.DATE and to == T.TIMESTAMP:
         return pc.cast(v, pa.timestamp("us"))
     if src_t == T.TIMESTAMP and to == T.DATE:

@@ -137,3 +137,74 @@ def gen_df(session, gens: dict, n: int, seed: int = 42, num_partitions=1):
                      for name, g in gens.items()])
     return session.create_dataframe(data, schema=schema,
                                     num_partitions=num_partitions)
+
+
+class DecimalGen(DataGen):
+    """DECIMAL(p, s) values (exact int64 unscaled under the hood)."""
+
+    SPECIAL_UNSCALED = [0, 1, -1]
+
+    def __init__(self, precision=10, scale=2, **kw):
+        import decimal
+        super().__init__(T.DecimalType(precision, scale), **kw)
+        self.precision = precision
+        self.scale = scale
+        self._dec = decimal.Decimal
+
+    def _values(self, rng, n):
+        import decimal
+        hi = 10 ** self.precision - 1
+        vals = [int(v) for v in rng.integers(-hi, hi, n)]
+        specials = self.SPECIAL_UNSCALED + [hi, -hi]
+        for i in range(min(len(specials), n // 10)):
+            vals[int(rng.integers(0, n))] = specials[i]
+        q = decimal.Decimal(1).scaleb(-self.scale)
+        return [(self._dec(v) * q) for v in vals]
+
+
+class EpochEdgeDateGen(DateGen):
+    """Dates clustered at epoch edges (the reference's epoch-edge
+    specials: 1969-12-31, 1970-01-01, far past/future)."""
+
+    def _values(self, rng, n):
+        vals = super()._values(rng, n)
+        edges = [0, -1, 1, -719162, 2932896]  # 0001-01-01, 9999-12-31
+        for i, e in enumerate(edges):
+            if i < n:
+                vals[int(rng.integers(0, n))] = e
+        return vals
+
+
+class UnicodeStringGen(StringGen):
+    """Multi-byte UTF-8 content (2/3/4-byte code points) exercising the
+    byte-vs-codepoint distinction in string kernels."""
+
+    def __init__(self, **kw):
+        kw.setdefault("charset",
+                      "aZ9éß中文\U0001f600-_ ")
+        super().__init__(**kw)
+
+
+ALL_GENS = {
+    "int64": lambda: IntGen(),
+    "int32": lambda: IntGen(T.INT32, lo=-2**31, hi=2**31 - 1),
+    "small_int": lambda: IntGen(lo=-1000, hi=1000),
+    "float64": lambda: FloatGen(),
+    "float_no_nan": lambda: FloatGen(no_nans=True),
+    "bool": lambda: BoolGen(),
+    "string": lambda: StringGen(),
+    "unicode": lambda: UnicodeStringGen(),
+    "date": lambda: DateGen(),
+    "edge_date": lambda: EpochEdgeDateGen(),
+    "timestamp": lambda: TimestampGen(),
+    "decimal": lambda: DecimalGen(),
+    "key": lambda: KeyGen(),
+}
+
+
+def random_schema_gens(rng, n_cols=None, pool=None):
+    """FuzzerUtils role: a random schema of named generators."""
+    names = sorted(pool or ALL_GENS)
+    k = int(n_cols or rng.integers(2, 6))
+    picks = [names[int(i)] for i in rng.integers(0, len(names), k)]
+    return {f"c{i}_{p}": ALL_GENS[p]() for i, p in enumerate(picks)}
