@@ -96,6 +96,12 @@ BATCH_SIZE_ROWS = conf_int(
 BATCH_SIZE_BYTES = conf_bytes(
     "spark.rapids.tpu.sql.batchSizeBytes", 512 * 2**20,
     "Target bytes per columnar batch for coalescing")
+ALLUXIO_PATHS_TO_REPLACE = conf_str(
+    "spark.rapids.tpu.alluxio.pathsToReplace", "",
+    "Semicolon-separated 'scheme://from->scheme://to' rules applied to "
+    "scan paths before reading, so queries planned against one store "
+    "transparently read a faster mirror (reference: "
+    "spark.rapids.alluxio.pathsToReplace, RapidsConf.scala:1072)")
 PYTHON_USE_WORKERS = conf_bool(
     "spark.rapids.tpu.python.useWorkerProcesses", True,
     "Run pandas UDFs in persistent out-of-process Python workers over "

@@ -34,13 +34,40 @@ from ..columnar.arrow import from_arrow, schema_from_arrow
 from ..columnar.schema import Schema
 
 
+def rewrite_paths(paths: List[str]) -> List[str]:
+    """Alluxio-role path rewrite (RapidsConf.scala:1072): apply
+    'from->to' prefix rules from spark.rapids.tpu.alluxio.pathsToReplace
+    so scans read the configured mirror."""
+    from ..config import get_active, ALLUXIO_PATHS_TO_REPLACE
+    try:
+        spec = str(get_active().get(ALLUXIO_PATHS_TO_REPLACE) or "")
+    except Exception:  # noqa: BLE001 - before config init
+        return paths
+    if not spec.strip():
+        return paths
+    rules = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if part and "->" in part:
+            src, dst = part.split("->", 1)
+            rules.append((src.strip(), dst.strip()))
+    out = []
+    for p in paths:
+        for src, dst in rules:
+            if p.startswith(src):
+                p = dst + p[len(src):]
+                break
+        out.append(p)
+    return out
+
+
 def expand_paths_with_partitions(paths: List[str]):
     """Expand dirs/globs to files with Hive-style ``key=value`` directory
     components decoded as partition values (reference:
     ColumnarPartitionReaderWithPartitionValues — partition values are
     appended as columns after the file read)."""
     out = []
-    for p in paths:
+    for p in rewrite_paths(paths):
         if os.path.isdir(p):
             for root, dirs, files in os.walk(p):
                 dirs.sort()

@@ -214,3 +214,24 @@ class TestPartitionedIO:
             assert got == [(1, "0"), (2, "abc")], got
             return []
         with_cpu_session(run)
+
+
+def test_alluxio_style_path_rewrite(tmp_path):
+    """spark.rapids.tpu.alluxio.pathsToReplace rewrites scan path
+    prefixes before reading (RapidsConf.scala:1072 role)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as papq
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.config import TpuConf
+    real = tmp_path / "mirror"
+    real.mkdir()
+    papq.write_table(pa.table({"x": np.arange(10, dtype=np.int64)}),
+                     str(real / "t.parquet"))
+    s = TpuSession(TpuConf({
+        "spark.rapids.tpu.sql.enabled": True,
+        "spark.rapids.tpu.alluxio.pathsToReplace":
+            f"/nonexistent/cold->{tmp_path}/mirror",
+    }))
+    df = s.read.parquet("/nonexistent/cold/t.parquet")
+    assert sorted(r[0] for r in df.collect()) == list(range(10))
