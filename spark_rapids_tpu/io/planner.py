@@ -42,7 +42,8 @@ class TpuFileScan(TpuExec):
         super().__init__()
         self.logical = logical
         self.conf = conf
-        self.files = expand_paths_with_partitions(logical.paths)
+        self.files = expand_paths_with_partitions(logical.paths,
+                                               conf)
         self.strategy = _strategy(logical.fmt, conf)
         self._partitions = split_files_into_partitions(
             self.files, conf.get(SHUFFLE_PARTITIONS))
@@ -172,7 +173,8 @@ class CpuFileScan(CpuExec):
         super().__init__()
         self.logical = logical
         self.conf = conf
-        self.files = expand_paths_with_partitions(logical.paths)
+        self.files = expand_paths_with_partitions(logical.paths,
+                                               conf)
         self._partitions = split_files_into_partitions(
             self.files, conf.get(SHUFFLE_PARTITIONS))
         self._part_dtypes = {f.name: f.dtype

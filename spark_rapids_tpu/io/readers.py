@@ -34,15 +34,15 @@ from ..columnar.arrow import from_arrow, schema_from_arrow
 from ..columnar.schema import Schema
 
 
-def rewrite_paths(paths: List[str]) -> List[str]:
+def rewrite_paths(paths: List[str], conf=None) -> List[str]:
     """Alluxio-role path rewrite (RapidsConf.scala:1072): apply
     'from->to' prefix rules from spark.rapids.tpu.alluxio.pathsToReplace
-    so scans read the configured mirror."""
+    so scans read the configured mirror.  ``conf`` is the scan's own
+    TpuConf when available (the active conf is last-session-wins and
+    would apply the WRONG session's rules)."""
     from ..config import get_active, ALLUXIO_PATHS_TO_REPLACE
-    try:
-        spec = str(get_active().get(ALLUXIO_PATHS_TO_REPLACE) or "")
-    except Exception:  # noqa: BLE001 - before config init
-        return paths
+    spec = str((conf or get_active()).get(ALLUXIO_PATHS_TO_REPLACE)
+               or "")
     if not spec.strip():
         return paths
     rules = []
@@ -50,6 +50,10 @@ def rewrite_paths(paths: List[str]) -> List[str]:
         part = part.strip()
         if part and "->" in part:
             src, dst = part.split("->", 1)
+            if not src.strip():
+                raise ValueError(
+                    "spark.rapids.tpu.alluxio.pathsToReplace rule has "
+                    f"an empty 'from' side: {part!r}")
             rules.append((src.strip(), dst.strip()))
     out = []
     for p in paths:
@@ -61,13 +65,13 @@ def rewrite_paths(paths: List[str]) -> List[str]:
     return out
 
 
-def expand_paths_with_partitions(paths: List[str]):
+def expand_paths_with_partitions(paths: List[str], conf=None):
     """Expand dirs/globs to files with Hive-style ``key=value`` directory
     components decoded as partition values (reference:
     ColumnarPartitionReaderWithPartitionValues — partition values are
     appended as columns after the file read)."""
     out = []
-    for p in rewrite_paths(paths):
+    for p in rewrite_paths(paths, conf):
         if os.path.isdir(p):
             for root, dirs, files in os.walk(p):
                 dirs.sort()
