@@ -31,7 +31,8 @@ class DataFrameReader:
         from ..io.readers import infer_schema
         paths = [path] if isinstance(path, str) else list(path)
         schema = self._schema or infer_schema(self._format, paths,
-                                              self._options)
+                                              self._options,
+                                              conf=self.session.conf)
         return DataFrame(
             L.Scan(self._format, paths, schema, self._options), self.session)
 

@@ -95,8 +95,8 @@ def expand_paths_with_partitions(paths: List[str], conf=None):
     return out
 
 
-def expand_paths(paths: List[str]) -> List[str]:
-    return [f for f, _ in expand_paths_with_partitions(paths)]
+def expand_paths(paths: List[str], conf=None) -> List[str]:
+    return [f for f, _ in expand_paths_with_partitions(paths, conf)]
 
 
 def _read_file(fmt: str, path: str, columns: Optional[List[str]] = None,
@@ -161,8 +161,9 @@ def _partition_fields(pairs) -> List:
     return fields
 
 
-def infer_schema(fmt: str, paths: List[str], options=None) -> Schema:
-    pairs = expand_paths_with_partitions(paths)
+def infer_schema(fmt: str, paths: List[str], options=None,
+                 conf=None) -> Schema:
+    pairs = expand_paths_with_partitions(paths, conf)
     if not pairs:
         raise FileNotFoundError(f"no files match {paths}")
     first = pairs[0][0]

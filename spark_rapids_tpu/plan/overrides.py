@@ -46,7 +46,7 @@ from ..shuffle.partitioners import (HashPartitioner, RangePartitioner,
 BROADCAST_ROW_THRESHOLD = 1 << 20  # rows; stand-in for byte-size stats
 
 
-def _scan_row_estimate(p) -> "Optional[int]":
+def _scan_row_estimate(p, conf=None) -> "Optional[int]":
     """Row-count estimate for file scans (parquet metadata is cheap)."""
     if getattr(p, "_row_estimate", None) is not None:
         return p._row_estimate
@@ -55,7 +55,7 @@ def _scan_row_estimate(p) -> "Optional[int]":
             import pyarrow.parquet as papq
             from ..io.readers import expand_paths
             total = 0
-            for f in expand_paths(p.paths):
+            for f in expand_paths(p.paths, conf):
                 total += papq.ParquetFile(f).metadata.num_rows
             p._row_estimate = total
             return total
@@ -842,7 +842,7 @@ class Planner:
         if isinstance(p, L.Limit):
             return p.n
         if isinstance(p, L.Scan):
-            return _scan_row_estimate(p)
+            return _scan_row_estimate(p, self.conf)
         if isinstance(p, L.Join):
             l = self._estimate_rows(p.children[0])
             r = self._estimate_rows(p.children[1])

@@ -121,6 +121,11 @@ class _Block:
                 if v is None or isinstance(v, (bool, int, float, str)):
                     stack.append(ec.Literal(v) if v is not None
                                  else ec.Literal(None, T.NULL))
+                elif isinstance(v, (tuple, frozenset)) and all(
+                        isinstance(x, (bool, int, float, str))
+                        for x in v):
+                    # membership-test operand: x in (1, 2, 3)
+                    stack.append(("const_seq", list(v)))
                 else:
                     raise CannotCompile(f"const {v!r}")
             elif op in ("LOAD_GLOBAL", "LOAD_NAME"):
@@ -170,7 +175,35 @@ class _Block:
             elif op == "UNARY_NEGATIVE":
                 stack.append(ea.UnaryMinus(_as_expr(stack.pop())))
             elif op == "UNARY_NOT":
-                stack.append(ep.Not(_as_expr(stack.pop())))
+                stack.append(ep.Not(_truthy(stack.pop())))
+            elif op == "UNARY_INVERT":
+                stack.append(ea.BitwiseNot(_as_expr(stack.pop())))
+            elif op == "CONTAINS_OP":
+                seq = stack.pop()
+                a = _as_expr(stack.pop())
+                if not (isinstance(seq, tuple) and seq[0] == "const_seq"):
+                    raise CannotCompile("in over non-literal sequence")
+                # PYTHON semantics, not SQL: None in (1, 2) is False
+                # (the compiled expression replaces a row-wise Python
+                # fallback, so null handling must match it exactly)
+                e = econd.Coalesce(ep.In(a, seq[1]),
+                                   ec.Literal(False))
+                stack.append(ep.Not(e) if ins.arg else e)
+            elif op == "IS_OP":
+                b = stack.pop()
+                a = stack.pop()
+                if isinstance(b, ec.Literal) and b.value is None:
+                    e = ep.IsNull(_as_expr(a))
+                    stack.append(ep.Not(e) if ins.arg else e)
+                else:
+                    raise CannotCompile("is/is not only supports None")
+            elif op == "POP_TOP":
+                stack.pop()
+            elif op == "COPY":
+                stack.append(stack[-(ins.arg or 1)])
+            elif op == "SWAP":
+                n = ins.arg or 2
+                stack[-1], stack[-n] = stack[-n], stack[-1]
             elif op in ("CALL", "CALL_FUNCTION", "CALL_METHOD"):
                 argc = ins.arg or 0
                 args = [stack.pop() for _ in range(argc)][::-1]
@@ -225,10 +258,18 @@ class _Block:
                 if stack and isinstance(stack[-1], _RangeIter):
                     stack.pop()
             elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_FORWARD_IF_FALSE",
-                        "POP_JUMP_IF_TRUE", "POP_JUMP_FORWARD_IF_TRUE"):
-                cond = _as_expr(stack.pop())
-                if "TRUE" in op:
-                    cond = ep.Not(cond)
+                        "POP_JUMP_IF_TRUE", "POP_JUMP_FORWARD_IF_TRUE",
+                        "POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
+                if op.endswith("NONE"):
+                    # 3.12 specializes `if x is None:` into dedicated
+                    # jumps; fall-through condition is the negation
+                    e = _as_expr(stack.pop())
+                    cond = ep.IsNotNull(e) if op.endswith("IF_NONE") \
+                        else ep.IsNull(e)
+                else:
+                    cond = _truthy(stack.pop())
+                    if "TRUE" in op:
+                        cond = ep.Not(cond)
                 target = self.offset_index[ins.argval]
                 # true path: fall through; false path: jump target.
                 # Fork mutable loop iterators so both arms advance
@@ -267,6 +308,26 @@ def _as_expr(v) -> ec.Expression:
     if isinstance(v, ec.Expression):
         return v
     raise CannotCompile(f"non-expression value {v!r}")
+
+
+def _truthy(v) -> ec.Expression:
+    """Python truthiness as a BOOL expression: bools pass through,
+    numbers test nonzero (the `a and b` / `if x:` patterns on ints);
+    anything else is refused rather than silently mis-branched."""
+    e = _as_expr(v)
+    try:
+        dt = e.dtype()
+    except Exception:  # noqa: BLE001 - unresolved dtype: refuse
+        raise CannotCompile("condition dtype unresolved") from None
+    if dt == T.BOOL:
+        cond = e
+    elif dt.is_integral or dt.is_fractional:
+        cond = ep.Not(ep.EqualTo(e, ec.Literal(0)))
+    else:
+        raise CannotCompile(f"truthiness of {dt} not supported")
+    # PYTHON truthiness of None is False (SQL three-valued NULL would
+    # silently change which branch a null row takes vs the fallback)
+    return econd.Coalesce(cond, ec.Literal(False))
 
 
 def compile_udf(fn, arg_exprs: List[ec.Expression]

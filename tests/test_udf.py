@@ -2,6 +2,7 @@
 
 Reference pattern: udf-compiler OpcodeSuite + udf_test.py.
 """
+import pytest
 import math
 
 from spark_rapids_tpu.api import functions as F
@@ -326,3 +327,89 @@ def _arr_schema():
     from spark_rapids_tpu.columnar import dtypes as T
     at = T.ArrayType(T.FLOAT64)
     return Schema([Field("a", at), Field("b", at)])
+
+
+class TestCompilerBreadth:
+    """Round-4 opcode breadth (Instruction.scala:198 role): boolean
+    short-circuit, chained comparisons, membership, is None, bitwise
+    invert — all must COMPILE (not fall back) and match the row-wise
+    Python evaluation."""
+
+    CASES = [
+        ("and_or", lambda x, y: (x > 0 and y < 5) or x == -3),
+        ("chained", lambda x, y: 0 < x < 10),
+        ("membership", lambda x, y: x in (1, 2, 3, 7)),
+        ("not_in", lambda x, y: y not in (0, 4)),
+        ("is_none_ternary", lambda x, y: 0 if x is None else x + y),
+        ("invert", lambda x, y: ~x + y),
+        ("truthy_int", lambda x, y: 1 if x and y else 0),
+    ]
+
+    @pytest.mark.parametrize("name,fn", CASES, ids=[c[0] for c in CASES])
+    def test_compiles_and_matches(self, name, fn):
+        from spark_rapids_tpu.udf.compiler import compile_udf
+        from spark_rapids_tpu.expr import core as ec
+        from spark_rapids_tpu.columnar import dtypes as T
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.columnar import Schema, Field
+        args = [ec.AttributeReference("x", T.INT64, True),
+                ec.AttributeReference("y", T.INT64, True)]
+        expr = compile_udf(fn, args)
+        assert expr is not None, f"{name} must compile"
+        xs = [1, 2, -3, 0, 7, 9, 11, 4]
+        ys = [4, 0, 1, 5, 7, -2, 3, 4]
+        batch = ColumnarBatch.from_pydict(
+            {"x": xs, "y": ys},
+            schema=Schema([Field("x", T.INT64), Field("y", T.INT64)]))
+        bound = expr.bind(batch.schema)
+        got = ec.eval_as_column(bound, batch).to_pylist(len(xs))
+        want = [fn(x, y) for x, y in zip(xs, ys)]
+        norm = lambda v: (None if v is None else
+                          bool(v) if isinstance(v, bool) else int(v))
+        assert [norm(g) for g in got] == [norm(w) for w in want], name
+
+    def test_is_none_with_actual_nulls(self):
+        """The is-None branch with REAL None inputs: compiled result
+        must match row-wise Python, including null rows."""
+        from spark_rapids_tpu.udf.compiler import compile_udf
+        from spark_rapids_tpu.expr import core as ec
+        from spark_rapids_tpu.columnar import dtypes as T
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.columnar import Schema, Field
+        fn = lambda x, y: 0 if x is None else x + y
+        args = [ec.AttributeReference("x", T.INT64, True),
+                ec.AttributeReference("y", T.INT64, True)]
+        expr = compile_udf(fn, args)
+        assert expr is not None
+        xs = [1, None, -3, None, 7]
+        ys = [4, 0, 1, 5, 7]
+        batch = ColumnarBatch.from_pydict(
+            {"x": xs, "y": ys},
+            schema=Schema([Field("x", T.INT64), Field("y", T.INT64)]))
+        got = ec.eval_as_column(expr.bind(batch.schema),
+                                batch).to_pylist(len(xs))
+        want = [fn(x, y) for x, y in zip(xs, ys)]
+        assert [int(g) for g in got] == want
+
+    def test_membership_null_matches_python(self):
+        """None in (1,2,3) is False in Python; the compiled form must
+        agree (not SQL NULL) — the silent-divergence hazard of
+        replacing a Python fallback with SQL expressions."""
+        from spark_rapids_tpu.udf.compiler import compile_udf
+        from spark_rapids_tpu.expr import core as ec
+        from spark_rapids_tpu.columnar import dtypes as T
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.columnar import Schema, Field
+        for fn in (lambda x: x in (1, 2, 3),
+                   lambda x: x not in (1, 2, 3)):
+            expr = compile_udf(
+                fn, [ec.AttributeReference("x", T.INT64, True)])
+            assert expr is not None
+            xs = [1, None, 5]
+            batch = ColumnarBatch.from_pydict(
+                {"x": xs}, schema=Schema([Field("x", T.INT64)]))
+            col = ec.eval_as_column(expr.bind(batch.schema), batch)
+            got = col.to_pylist(3)
+            want = [fn(x) for x in xs]
+            assert [bool(g) for g in got] == want
+            assert all(v is not None for v in got)
