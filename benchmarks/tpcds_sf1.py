@@ -1,0 +1,109 @@
+"""TPC-DS SF1 per-query perf: TPU engine vs the CPU oracle, EXACT
+float mode (variableFloatAgg stays at its default OFF).
+
+The round-3 verdict's bar: geomean TPU >= CPU oracle at SF1 across
+>= 20 TPC-DS queries, exact mode, numbers committed in the repo.
+Writes benchmarks/tpcds_sf1_times.json incrementally (a long sweep
+interrupted mid-way still leaves every finished query's numbers).
+
+Usage:
+  python benchmarks/tpcds_sf1.py [--queries q3,q7,...] [--scale 1.0]
+"""
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import tpcds                                    # noqa: E402
+from tpcds_queries import QUERIES               # noqa: E402
+
+# fact-table-heavy queries whose CPU-oracle runtime at SF1 stays
+# tractable (the oracle is single-process pyarrow): star-join
+# aggregates, window reports, returns joins — 26 queries
+DEFAULT_QUERIES = [
+    "q3", "q7", "q12", "q13", "q15", "q19", "q20", "q21", "q26",
+    "q27", "q34", "q36", "q42", "q43", "q46", "q48", "q52", "q53",
+    "q55", "q59", "q63", "q65", "q68", "q73", "q79", "q89", "q96",
+    "q98",
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--queries", default=",".join(DEFAULT_QUERIES))
+    ap.add_argument("--data-dir", default="/tmp/tpcds_data")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tpcds_sf1_times.json"))
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args()
+    tag = os.path.join(args.data_dir, f"sf{args.scale}_v5")
+    if not os.path.exists(os.path.join(tag, "store_sales.parquet")):
+        tpcds.generate(tag, args.scale)
+        print("generated", file=sys.stderr)
+
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.config import TpuConf
+
+    def mk(enabled):
+        s = TpuSession(TpuConf({
+            "spark.rapids.tpu.sql.enabled": enabled,
+            # large batches amortize dispatch at SF1 (exact float mode
+            # stays DEFAULT OFF — this is the apples-to-apples run)
+            "spark.rapids.tpu.sql.batchSizeRows": 1 << 22,
+            "spark.rapids.tpu.sql.reader.batchSizeRows": 1 << 22,
+        }))
+        tpcds.register(s, tag)
+        return s
+
+    results = {}
+    if args.resume and os.path.exists(args.out):
+        with open(args.out) as f:
+            results = json.load(f).get("queries", {})
+
+    queries = [q for q in args.queries.split(",") if q]
+    s_tpu = mk(True)
+    s_cpu = mk(False)
+    for name in queries:
+        if name in results:
+            continue
+        sql = QUERIES[name]
+        entry = {}
+        try:
+            t0 = time.perf_counter()
+            rows1 = s_tpu.sql(sql).collect()
+            entry["tpu_first_s"] = round(time.perf_counter() - t0, 3)
+            t0 = time.perf_counter()
+            rows = s_tpu.sql(sql).collect()
+            entry["tpu_s"] = round(time.perf_counter() - t0, 3)
+            entry["rows"] = len(rows)
+            t0 = time.perf_counter()
+            s_cpu.sql(sql).collect()
+            entry["cpu_s"] = round(time.perf_counter() - t0, 3)
+            entry["speedup"] = round(entry["cpu_s"] /
+                                     max(entry["tpu_s"], 1e-9), 3)
+        except Exception as e:  # noqa: BLE001 - recorded per query
+            entry["error"] = f"{type(e).__name__}: {e}"[:200]
+        results[name] = entry
+        ok = [r for r in results.values() if "speedup" in r]
+        geo = math.exp(sum(math.log(r["speedup"]) for r in ok)
+                       / len(ok)) if ok else None
+        doc = {"scale": args.scale, "float_mode": "exact",
+               "geomean_speedup": round(geo, 3) if geo else None,
+               "n_queries": len(ok), "queries": results}
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        print(f"{name}: {entry}", file=sys.stderr, flush=True)
+    print(json.dumps({"geomean_speedup": doc["geomean_speedup"],
+                      "n_queries": doc["n_queries"]}))
+
+
+if __name__ == "__main__":
+    main()

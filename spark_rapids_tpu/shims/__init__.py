@@ -41,6 +41,38 @@ class JaxShimBase:
         except Exception:
             return None
 
+    # -- additional version-sensitive touchpoints (ShimLoader breadth:
+    # every unstable API the engine uses goes through here) -----------
+    @staticmethod
+    def make_mesh(axis_shapes, axis_names):
+        raise NotImplementedError
+
+    @staticmethod
+    def named_sharding(mesh, *pspec):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(mesh, PartitionSpec(*pspec))
+
+    @staticmethod
+    def tree_map(fn, tree):
+        raise NotImplementedError
+
+    @staticmethod
+    def compilation_cache_dir(path: str):
+        """Point the persistent executable cache at ``path``."""
+        jax.config.update("jax_compilation_cache_dir", path)
+
+    @staticmethod
+    def live_arrays(backend=None):
+        """Device arrays currently alive (leak triage)."""
+        try:
+            return jax.live_arrays()
+        except Exception:
+            return []
+
+    @staticmethod
+    def donate_argnums_supported() -> bool:
+        return True
+
 
 class JaxShim09(JaxShimBase):
     """jax >= 0.7: shard_map promoted to jax.shard_map."""
@@ -60,6 +92,15 @@ class JaxShim09(JaxShimBase):
     def key_array(seed: int):
         import jax.random as jr
         return jr.key(seed)
+
+    @staticmethod
+    def make_mesh(axis_shapes, axis_names):
+        # jax.make_mesh picks the best device order for the topology
+        return jax.make_mesh(axis_shapes, axis_names)
+
+    @staticmethod
+    def tree_map(fn, tree):
+        return jax.tree.map(fn, tree)
 
 
 class JaxShimLegacy(JaxShimBase):
@@ -81,6 +122,18 @@ class JaxShimLegacy(JaxShimBase):
     def key_array(seed: int):
         import jax.random as jr
         return jr.PRNGKey(seed)
+
+    @staticmethod
+    def make_mesh(axis_shapes, axis_names):
+        import numpy as _np
+        from jax.sharding import Mesh
+        devs = _np.array(jax.devices()[:int(_np.prod(axis_shapes))])
+        return Mesh(devs.reshape(axis_shapes), axis_names)
+
+    @staticmethod
+    def tree_map(fn, tree):
+        from jax import tree_util
+        return tree_util.tree_map(fn, tree)
 
 
 _PROVIDERS: List[Type[JaxShimBase]] = [JaxShim09, JaxShimLegacy]
@@ -113,3 +166,11 @@ def get_shard_map():
 
 def get_pallas():
     return detect_shim().pallas()
+
+
+def get_make_mesh():
+    return detect_shim().make_mesh
+
+
+def get_tree_map():
+    return detect_shim().tree_map
