@@ -82,11 +82,16 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
 
 
 def main():
-    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 16_000_000
+    # 64M rows: fixed dispatch/flush overhead (the ~90ms tunnel round
+    # trips) amortizes and the measurement approaches the engines'
+    # sustained throughput (TPU ~25 Mrows/s through this pipeline)
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 64_000_000
     parts = 4
     repeats = 3
     tpu_t = run_engine(True, n_rows, parts, repeats)
-    tpu_exact_t = run_engine(True, n_rows, parts, repeats,
+    # exact f64 softfloat accumulation is an order of magnitude slower
+    # on this all-f64 synthetic: one timed run keeps bench wall bounded
+    tpu_exact_t = run_engine(True, n_rows, parts, 1,
                              variable_float=False)
     cpu_t = run_engine(False, n_rows, parts, repeats)
     throughput = n_rows / tpu_t / 1e6

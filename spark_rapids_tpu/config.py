@@ -135,6 +135,20 @@ CONCURRENT_TPU_TASKS = conf_int(
     "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
     "Max concurrent tasks admitted to the device (reference: "
     "spark.rapids.sql.concurrentGpuTasks / GpuSemaphore)")
+SCAN_CACHE = conf_bool(
+    "spark.rapids.tpu.io.deviceScanCache.enabled", True,
+    "Keep uploaded file-scan batches device-resident across queries, "
+    "keyed on (files, mtimes, columns, pushed filters, batching). "
+    "HBM residency makes repeat scans of the same tables skip decode "
+    "AND host->device transfer — the scarce resource on remote-"
+    "dispatch backends (ParquetCachedBatchSerializer role, applied "
+    "at the scan). Entries are dropped LRU past deviceScanCache.bytes "
+    "and on real device-OOM pressure")
+
+SCAN_CACHE_BYTES = conf_bytes(
+    "spark.rapids.tpu.io.deviceScanCache.bytes", 6 << 30,
+    "Device-byte budget for the scan cache (LRU beyond it)")
+
 SCAN_PREFETCH = conf_bool(
     "spark.rapids.tpu.sql.reader.prefetch.enabled", True,
     "Decode scan files on background producer threads ahead of "

@@ -70,6 +70,7 @@ class TpuFileScan(TpuExec):
     def _reader(self, files):
         return FilePartitionReader(
             self.logical.fmt, files,
+            columns=[f.name for f in self.logical.schema.fields],
             strategy=self.strategy,
             num_threads=self.conf.get(MULTITHREAD_READ_THREADS),
             options=self.logical.options,
@@ -86,9 +87,56 @@ class TpuFileScan(TpuExec):
             if n == 0:
                 break
 
+    def _cache_key(self, max_rows):
+        """Identity of this scan's device batches: files+mtimes+sizes,
+        column set/order, pushdown, and batching geometry."""
+        files = []
+        for part in self._partitions:
+            for f in part:
+                path = f[0] if isinstance(f, tuple) else f
+                pv = tuple(sorted(f[1].items())) if isinstance(f, tuple) \
+                    else ()
+                try:
+                    st = os.stat(path)
+                    files.append((path, st.st_mtime_ns, st.st_size, pv))
+                except OSError:
+                    return None
+            files.append(("|",))        # partition boundary
+        def freeze(x):
+            if isinstance(x, dict):
+                return tuple(sorted((k, freeze(v)) for k, v in x.items()))
+            if isinstance(x, (set, frozenset)):
+                return tuple(sorted(map(repr, x)))
+            if isinstance(x, (list, tuple)):
+                return tuple(freeze(v) for v in x)
+            return x
+        try:
+            pushed = freeze(self.pushed_filters) \
+                if self.pushed_filters else None
+            key = (self.logical.fmt, tuple(files),
+                   tuple((f.name, f.dtype.name)
+                         for f in self.logical.schema.fields),
+                   freeze(self.logical.options or {}),
+                   pushed, max_rows, self.strategy)
+            hash(key)                 # reject exotic unhashable leaves
+        except Exception:
+            return None               # unhashable option: never cache
+        return key
+
     def execute(self):
-        from ..config import SCAN_PREFETCH
+        from ..config import SCAN_PREFETCH, SCAN_CACHE
+        from .scan_cache import DeviceScanCache
         max_rows = self.conf.get(MAX_READER_BATCH_ROWS)
+        key = self._cache_key(max_rows) if self.conf.get(SCAN_CACHE) \
+            else None
+        if key is not None:
+            cached = DeviceScanCache.get().lookup(key)
+            if cached is not None:
+                def replay(batches):
+                    for b in batches:
+                        self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
+                        yield b
+                return [replay(part) for part in cached]
         if not self.conf.get(SCAN_PREFETCH) or \
                 sum(len(f) for f in self._partitions) <= 1:
             def run(files):
@@ -96,8 +144,43 @@ class TpuFileScan(TpuExec):
                     for chunk in self._chunks(table, max_rows):
                         self.metrics[NUM_OUTPUT_ROWS] += chunk.num_rows
                         yield from_arrow(chunk)
-            return [run(files) for files in self._partitions]
-        return self._execute_prefetch(max_rows)
+            parts = [run(files) for files in self._partitions]
+        else:
+            parts = self._execute_prefetch(max_rows)
+        if key is None:
+            return parts
+        return self._caching_iters(key, parts)
+
+    def _caching_iters(self, key, parts):
+        """Collect each partition's batches as they stream; install the
+        scan into the device cache only when EVERY partition was fully
+        consumed (a LIMIT short-circuit must not cache a prefix).
+        Collection must never pin more than the cache budget: past it
+        the scan cannot be cached anyway, so collection is abandoned
+        and batches stream through unpinned (out-of-HBM scans keep
+        their streaming memory profile)."""
+        from ..config import SCAN_CACHE_BYTES
+        from .scan_cache import DeviceScanCache
+        cap = int(self.conf.get(SCAN_CACHE_BYTES))
+        state = {"bytes": 0, "abandoned": False}
+        collected = [[] for _ in parts]
+        done = [False] * len(parts)
+
+        def wrap(i, it):
+            for b in it:
+                if not state["abandoned"]:
+                    state["bytes"] += b.nbytes()
+                    if state["bytes"] > cap:
+                        state["abandoned"] = True
+                        for part in collected:
+                            part.clear()
+                    else:
+                        collected[i].append(b)
+                yield b
+            done[i] = True
+            if all(done) and not state["abandoned"]:
+                DeviceScanCache.get().insert(key, collected, cap)
+        return [wrap(i, it) for i, it in enumerate(parts)]
 
     def _execute_prefetch(self, max_rows):
         """Producer threads decode host arrow tables AHEAD of
@@ -190,7 +273,9 @@ class CpuFileScan(CpuExec):
     def execute(self):
         def run(files):
             reader = FilePartitionReader(
-                self.logical.fmt, files, options=self.logical.options,
+                self.logical.fmt, files,
+                columns=[f.name for f in self.logical.schema.fields],
+                options=self.logical.options,
                 partition_dtypes=self._part_dtypes)
             for t in reader:
                 yield t

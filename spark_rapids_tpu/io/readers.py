@@ -201,18 +201,23 @@ class FilePartitionReader:
 
     def _read(self, pair) -> pa.Table:
         path, pvals = pair
+        # partition-value columns live in the directory layout, not the
+        # file: never ask the file reader for them
+        cols = self.columns
+        if cols is not None and pvals:
+            cols = [c for c in cols if c not in pvals]
         if self.fmt == "parquet" and self.pushed_filters:
             import pyarrow.parquet as papq
             try:
-                t = papq.read_table(path, columns=self.columns,
+                t = papq.read_table(path, columns=cols,
                                     use_threads=False,
                                     filters=self.pushed_filters)
             except Exception:
                 # e.g. a pushed predicate on a partition column that is
                 # not in the file: fall back to the plain read
-                t = _read_file(self.fmt, path, self.columns, self.options)
+                t = _read_file(self.fmt, path, cols, self.options)
         else:
-            t = _read_file(self.fmt, path, self.columns, self.options)
+            t = _read_file(self.fmt, path, cols, self.options)
         for k, v in pvals.items():
             if k in t.column_names:
                 continue
@@ -227,6 +232,11 @@ class FilePartitionReader:
                 val = v
             t = t.append_column(
                 k, pa.array([val] * t.num_rows, type=at))
+        if self.columns is not None:
+            # restore the requested order (partition values append last)
+            sel = [c for c in self.columns if c in t.column_names]
+            if sel != t.column_names:
+                t = t.select(sel)
         return t
 
     def __iter__(self) -> Iterator[pa.Table]:

@@ -45,10 +45,15 @@ def oom_retry(fn: Callable, *args, **kwargs):
         if not is_device_oom(e):
             raise
         cat = BufferCatalog.get()
+        # recomputable device residents go first: the scan cache is
+        # pure optimization, never correctness
+        from ..io.scan_cache import DeviceScanCache, clear_on_pressure
+        cache_bytes = DeviceScanCache.get().nbytes
+        clear_on_pressure()
         # spill the whole device tier: the real allocator failed, so
         # the logical budget underestimated true pressure
         spilled = cat.spill_device_to_fit(cat.device_limit)
         cat.oom_retries = getattr(cat, "oom_retries", 0) + 1
-        if spilled == 0:
+        if spilled == 0 and cache_bytes == 0:
             raise
         return fn(*args, **kwargs)

@@ -21,41 +21,87 @@ TRANSITION_COST_PER_ROW = 3.0
 CPU_COST_PER_ROW = 1.0
 
 
-def estimate_rows(p: L.LogicalPlan) -> Optional[float]:
+def _scan_rows(p: "L.Scan", conf=None) -> Optional[float]:
+    """Exact file-level cardinality from parquet footers.
+
+    The reference's RowCountPlanVisitor walks Spark's statistics, which
+    for file sources come from the same footer metadata.  Delegates to
+    the planner's estimator (handles directory/glob path expansion and
+    memoizes on the node itself)."""
+    from .overrides import _scan_row_estimate
+    total = _scan_row_estimate(p, conf)
+    return None if total is None else float(total)
+
+
+def _filter_selectivity(cond) -> float:
+    """Predicate-shape selectivity (the reference's filter default is
+    a flat multiplier; we refine by comparison kind)."""
+    name = type(cond).__name__
+    if name == "And":
+        return (_filter_selectivity(cond.children[0]) *
+                _filter_selectivity(cond.children[1]))
+    if name == "Or":
+        a = _filter_selectivity(cond.children[0])
+        b = _filter_selectivity(cond.children[1])
+        return min(1.0, a + b - a * b)
+    if name == "Not":
+        return max(0.0, 1.0 - _filter_selectivity(cond.children[0]))
+    if name in ("EqualTo", "EqualNullSafe"):
+        return 0.1
+    if name in ("LessThan", "LessThanOrEqual", "GreaterThan",
+                "GreaterThanOrEqual"):
+        return 0.33
+    if name == "In":
+        return 0.2
+    if name in ("IsNull",):
+        return 0.05
+    if name in ("IsNotNull",):
+        return 0.95
+    return 0.5
+
+
+def estimate_rows(p: L.LogicalPlan, conf=None) -> Optional[float]:
     """RowCountPlanVisitor role: best-effort cardinality estimates."""
     if isinstance(p, L.LocalRelation):
         return float(p.table.num_rows)
+    if isinstance(p, L.Scan):
+        return _scan_rows(p, conf)
     if isinstance(p, L.Range):
         return float(max(0, -(-(p.end - p.start) // p.step)))
     if isinstance(p, L.Filter):
-        r = estimate_rows(p.children[0])
-        return r * 0.5 if r is not None else None
+        r = estimate_rows(p.children[0], conf)
+        if r is None:
+            return None
+        try:
+            return r * _filter_selectivity(p.condition)
+        except Exception:
+            return r * 0.5
     if isinstance(p, L.Limit):
         return float(p.n)
     if isinstance(p, L.Aggregate):
-        r = estimate_rows(p.children[0])
+        r = estimate_rows(p.children[0], conf)
         return min(r, r * 0.1 + 100) if r is not None else None
     if isinstance(p, L.Join):
-        l = estimate_rows(p.children[0])
-        r = estimate_rows(p.children[1])
+        l = estimate_rows(p.children[0], conf)
+        r = estimate_rows(p.children[1], conf)
         if l is None or r is None:
             return None
         return max(l, r)
     if isinstance(p, L.Union):
-        vals = [estimate_rows(c) for c in p.children]
+        vals = [estimate_rows(c, conf) for c in p.children]
         return sum(v for v in vals if v is not None) or None
     if p.children:
-        return estimate_rows(p.children[0])
+        return estimate_rows(p.children[0], conf)
     return None
 
 
-def tpu_worthwhile(p: L.LogicalPlan) -> bool:
+def tpu_worthwhile(p: L.LogicalPlan, conf=None) -> bool:
     """Would accelerating this node pay for its transitions?
 
     Used by the planner when the CBO is enabled: tiny inputs stay on the
     CPU engine (the reference forces subtrees back to CPU the same way).
     """
-    rows = estimate_rows(p)
+    rows = estimate_rows(p, conf)
     if rows is None:
         return True  # unknown: assume big (matches reference default-on)
     speedup = TPU_SPEEDUP.get(type(p), 4.0)
@@ -75,9 +121,9 @@ BOUNDARY_COST = 500.0
 DEFAULT_ROWS = 1 << 20
 
 
-def _node_costs(p: L.LogicalPlan):
+def _node_costs(p: L.LogicalPlan, conf=None):
     """(cpu_cost, tpu_cost) of running THIS node on each engine."""
-    rows = estimate_rows(p)
+    rows = estimate_rows(p, conf)
     if rows is None:
         rows = float(DEFAULT_ROWS)
     speedup = TPU_SPEEDUP.get(type(p), 4.0)
@@ -93,7 +139,8 @@ def _transition(rows, same_side: bool) -> float:
         rows if rows is not None else DEFAULT_ROWS, 1 << 16)
 
 
-def choose_placement(root: L.LogicalPlan) -> Dict[int, str]:
+def choose_placement(root: L.LogicalPlan,
+                     conf=None) -> Dict[int, str]:
     """Two-state DP over the plan tree (the reference's
     ``optimizeGpuPlanTransitions`` recursion, CostBasedOptimizer:246):
     ``best(node, parent_side)`` = cheapest cost of the subtree when the
@@ -109,8 +156,8 @@ def choose_placement(root: L.LogicalPlan) -> Dict[int, str]:
         hit = memo.get(key)
         if hit is not None:
             return hit
-        rows = estimate_rows(p)
-        cpu_c, tpu_c = _node_costs(p)
+        rows = estimate_rows(p, conf)
+        cpu_c, tpu_c = _node_costs(p, conf)
         totals = {}
         for side, own in (("cpu", cpu_c), ("tpu", tpu_c)):
             t = own + _transition(rows, side == parent_side)

@@ -295,6 +295,141 @@ def _reorder_cross_joins(f: L.Filter) -> L.Filter:
     return L.Filter(f.condition, tree)
 
 
+# ---------------------------------------------------------------------------
+# scan column pruning (Spark's ColumnPruning rule; the reference relies on
+# Catalyst doing this before the plugin sees the plan — without it every
+# file scan decodes AND uploads all columns, and host->device bandwidth is
+# the scarcest resource on this backend)
+# ---------------------------------------------------------------------------
+
+def _u(*sets: "Optional[Set[str]]") -> "Optional[Set[str]]":
+    """Union of required-name sets; None ("need everything") poisons."""
+    out: Set[str] = set()
+    for s in sets:
+        if s is None:
+            return None
+        out |= s
+    return out
+
+
+def _refs_many(exprs) -> "Optional[Set[str]]":
+    return _u(*[_refs(e) for e in exprs]) if exprs else set()
+
+
+def _narrowest_field(fields):
+    """Cheapest single column to keep for pure-count scans."""
+    def width(f):
+        w = getattr(f.dtype, "itemsize", None)
+        if w is None:
+            w = 16 if f.dtype.name in ("string", "binary") else 8
+        return w
+    return min(fields, key=width)
+
+
+def prune_scan_columns(plan: L.LogicalPlan,
+                       need: "Optional[Set[str]]" = None) -> L.LogicalPlan:
+    """Top-down required-column propagation narrowing file scans.
+
+    ``need=None`` means the parent requires every output column (the
+    root, and any opaque consumer: pandas execs, writers, DISTINCT).
+    Nodes are copied, never mutated — Scan nodes are shared across
+    queries via registered views.
+    """
+    import copy as _copy
+
+    def rec(p: L.LogicalPlan, need):
+        if isinstance(p, L.Scan):
+            if need is None:
+                return p
+            kept = [f for f in p.schema.fields if f.name in need]
+            if len(kept) == len(p.schema.fields):
+                return p
+            if not kept:
+                kept = [_narrowest_field(p.schema.fields)]
+            from ..columnar.schema import Schema
+            out = _copy.copy(p)
+            out._schema = Schema(kept)
+            return out
+        if isinstance(p, (L.LocalRelation, L.Range, L.CachedRelation)) or \
+                not p.children:
+            return p
+
+        dropped = None            # replacement exprs/aggs when narrowed
+        if isinstance(p, L.Filter):
+            needs = [_u(need, _refs(p.condition))]
+        elif isinstance(p, L.Project):
+            kept = p.exprs if need is None else \
+                [e for e in p.exprs if L.output_name(e) in need]
+            if not kept:
+                kept = p.exprs[:1]
+            if len(kept) != len(p.exprs):
+                dropped = ("exprs", kept)
+            needs = [_refs_many(kept)]
+        elif isinstance(p, L.Aggregate):
+            kept_aggs = p.aggs if need is None else \
+                [a for a in p.aggs if a.alias in need]
+            if len(kept_aggs) != len(p.aggs):
+                dropped = ("aggs", kept_aggs)
+            needs = [_u(_refs_many(p.group_exprs),
+                        _refs_many([a.func for a in kept_aggs]))]
+        elif isinstance(p, L.Join):
+            cn = _u(need, _refs_many(p.left_keys),
+                    _refs_many(p.right_keys),
+                    _refs(p.condition) if p.condition is not None
+                    else set())
+            needs = [cn, cn]
+        elif isinstance(p, L.Sort):
+            needs = [_u(need, _refs_many([o.expr for o in p.orders]))]
+        elif isinstance(p, L.Limit):
+            needs = [need]
+        elif isinstance(p, L.Repartition):
+            needs = [_u(need, _refs_many(p.by_exprs or []))]
+        elif isinstance(p, L.Window):
+            aliases = {wf.alias for wf in p.window_funcs}
+            base = None if need is None else \
+                {n for n in need if n not in aliases}
+            wrefs = []
+            for wf in p.window_funcs:
+                wrefs.append(_refs(wf.func))
+                wrefs.append(_refs_many(wf.spec.partition_by))
+                wrefs.append(_refs_many([o.expr for o in wf.spec.order_by]))
+            needs = [_u(base, *wrefs)]
+        elif isinstance(p, L.Expand):
+            needs = [_refs_many([e for proj in p.projections for e in proj])]
+        elif isinstance(p, L.Generate):
+            gen_names = set(p.output_names)
+            base = None if need is None else \
+                {n for n in need if n not in gen_names}
+            needs = [_u(base, _refs(p.generator))]
+        elif isinstance(p, L.Union):
+            if need is None:
+                needs = [None] * len(p.children)
+            else:
+                try:
+                    pos = [i for i, f in enumerate(p.schema.fields)
+                           if f.name in need]
+                    needs = [{c.schema.fields[i].name for i in pos}
+                             for c in p.children]
+                except Exception:
+                    needs = [None] * len(p.children)
+        else:
+            # Distinct (whole-row semantics), writers, pandas execs,
+            # and anything unknown: require every column below
+            needs = [None] * len(p.children)
+
+        new_children = [rec(c, n) for c, n in zip(p.children, needs)]
+        if dropped is None and all(n is o for n, o in
+                                   zip(new_children, p.children)):
+            return p
+        out = _copy.copy(p)
+        out.children = new_children
+        if dropped is not None:
+            setattr(out, dropped[0], dropped[1])
+        return out
+
+    return rec(plan, need)
+
+
 def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
     """Bottom-up: push Filter conjuncts through inner/cross joins and
     promote cross-side equalities to join keys."""

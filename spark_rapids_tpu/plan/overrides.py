@@ -465,13 +465,18 @@ class Planner:
             "partition)", why)
 
     def plan(self, logical: L.LogicalPlan) -> PhysicalPlan:
+        # ColumnPruning (Catalyst does this before the reference plugin
+        # sees the plan): narrow file scans to referenced columns so the
+        # readers neither decode nor upload dead columns
+        from .logical_opt import prune_scan_columns
+        logical = prune_scan_columns(logical)
         meta = PlanMeta(logical, self.conf)
         meta.tag()
         from ..config import CBO_ENABLED
         self._placement = None
         if self.conf.get(CBO_ENABLED):
             from .cbo import choose_placement
-            self._placement = choose_placement(logical)
+            self._placement = choose_placement(logical, self.conf)
         mode = self.conf.get(EXPLAIN).upper()
         explain_on = mode in ("NOT_ON_TPU", "ALL")
         if explain_on:
@@ -806,6 +811,13 @@ class Planner:
         lsize = self._estimate_rows(p.children[0])
         rsize = self._estimate_rows(p.children[1])
         build_right = p.join_type != "right"
+        # inner joins may build on EITHER side: pick the smaller one
+        # (GpuShuffledHashJoinMeta's buildSide choice) — building the
+        # fact side of a star join forces a full fact-table shuffle
+        # where building the dimension side broadcasts it
+        if p.join_type == "inner" and lsize is not None and \
+                rsize is not None:
+            build_right = rsize <= lsize
         # broadcast the build side when it is provably small
         build_size = rsize if build_right else lsize
         if build_size is not None and build_size <= BROADCAST_ROW_THRESHOLD \

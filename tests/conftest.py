@@ -29,6 +29,16 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms",
                   os.environ.get("TPU_TEST_PLATFORM", "cpu"))
 
+# No PERSISTENT compile cache under the CPU test mesh: XLA:CPU AOT
+# executables re-loaded across processes trip a machine-feature
+# mismatch in cpu_aot_loader (flaky SIGILL/segfault mid-suite); CPU
+# compiles at the 16-row test sizes are cheap, so cache nothing.
+if os.environ.get("TPU_TEST_PLATFORM", "cpu") == "cpu":
+    try:
+        jax.config.update("jax_enable_compilation_cache", False)
+    except Exception:
+        pass
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -36,3 +46,21 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+# LLVM's JIT code arena fails hard (segfault on the next compile) once
+# a single process accumulates enough live XLA:CPU executables; the
+# engine's (op, schema, bucket) program caches pin them.  Dropping all
+# compile caches every 100 tests keeps the whole suite inside the
+# arena; test-size recompiles are cheap.
+_TESTS_RUN = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _suite_compile_arena_bound():
+    yield
+    _TESTS_RUN["n"] += 1
+    if _TESTS_RUN["n"] % 100 == 0:
+        from spark_rapids_tpu.shims.compile_caches import \
+            clear_compile_caches
+        clear_compile_caches()

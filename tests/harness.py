@@ -38,6 +38,15 @@ def _row_key(row):
     return tuple(str(_normalize(v)) for v in row)
 
 
+def canon_rows(rows):
+    """Canonical multiset form of a result set: NaN-normalized rows in
+    a None-safe total order.  For comparing engines on queries whose
+    ORDER BY (if any) does not fully determine row order — the
+    reference harness's ignore_order."""
+    return sorted((tuple(_normalize(v) for v in r) for r in rows),
+                  key=_row_key)
+
+
 # Default float tolerance is ulp-level: variableFloatAgg defaults OFF
 # (matching the reference's RapidsConf default), so the engines should
 # agree to reassociation-level error.  Tests that opt into f32

@@ -86,8 +86,9 @@ class TestScanPrefetch:
                  .agg(F.sum("v").alias("sv"), F.count().alias("c")))
 
     def test_prefetch_rows_identical(self, parquet_dir):
-        on = {"spark.rapids.tpu.sql.reader.prefetch.enabled": True}
-        off = {"spark.rapids.tpu.sql.reader.prefetch.enabled": False}
+        nc = {"spark.rapids.tpu.io.deviceScanCache.enabled": False}
+        on = {"spark.rapids.tpu.sql.reader.prefetch.enabled": True, **nc}
+        off = {"spark.rapids.tpu.sql.reader.prefetch.enabled": False, **nc}
         r_on = sorted(with_tpu_session(
             lambda s: self._q(s, parquet_dir).collect(), on))
         r_off = sorted(with_tpu_session(
@@ -108,7 +109,10 @@ class TestScanPrefetch:
         from spark_rapids_tpu.config import TpuConf
         s = TpuSession(TpuConf({
             "spark.rapids.tpu.sql.enabled": True,
-            "spark.rapids.tpu.sql.reader.prefetch.enabled": True}))
+            "spark.rapids.tpu.sql.reader.prefetch.enabled": True,
+            # this test asserts on the prefetch machinery itself: a
+            # device-cache replay (no reader threads) must not satisfy it
+            "spark.rapids.tpu.io.deviceScanCache.enabled": False}))
         df = s.read.parquet(parquet_dir)
         phys = s._plan(df._plan)
         scan = phys

@@ -235,3 +235,118 @@ def test_alluxio_style_path_rewrite(tmp_path):
     }))
     df = s.read.parquet("/nonexistent/cold/t.parquet")
     assert sorted(r[0] for r in df.collect()) == list(range(10))
+
+
+class TestDeviceScanCache:
+    """Device-resident scan cache (io/scan_cache.py): repeat scans of
+    unchanged files replay uploaded batches instead of re-reading."""
+
+    def _session(self, **extra):
+        from spark_rapids_tpu.api import TpuSession
+        from spark_rapids_tpu.config import TpuConf
+        conf = {"spark.rapids.tpu.sql.enabled": True}
+        conf.update(extra)
+        return TpuSession(TpuConf(conf))
+
+    @pytest.fixture(autouse=True)
+    def fresh_cache(self):
+        from spark_rapids_tpu.io.scan_cache import DeviceScanCache
+        DeviceScanCache.get().clear()
+        yield
+        DeviceScanCache.get().clear()
+
+    def test_repeat_scan_hits_cache(self, pq_dir, monkeypatch):
+        from spark_rapids_tpu.io import planner as iop
+        from spark_rapids_tpu.io.scan_cache import DeviceScanCache
+        reads = {"n": 0}
+        orig = iop.FilePartitionReader._read
+
+        def counting(self, pair):
+            reads["n"] += 1
+            return orig(self, pair)
+        monkeypatch.setattr(iop.FilePartitionReader, "_read", counting)
+        s = self._session()
+        df = s.read.parquet(pq_dir)
+        from harness import canon_rows as canon
+        a = canon(df.collect())
+        first_reads = reads["n"]
+        assert first_reads > 0
+        b = canon(s.read.parquet(pq_dir).collect())
+        assert reads["n"] == first_reads, "second scan must not re-read"
+        assert DeviceScanCache.get().hits >= 1
+        assert a == b
+
+    def test_modified_file_invalidates(self, pq_dir, monkeypatch):
+        import time
+        s = self._session()
+        before = s.read.parquet(pq_dir).collect()
+        f = os.path.join(pq_dir, "f0.parquet")
+        t = papq.read_table(f)
+        time.sleep(0.01)
+        papq.write_table(t.slice(0, 10), f)  # rewrite -> new mtime/size
+        after = s.read.parquet(pq_dir).collect()
+        assert len(after) < len(before)
+
+    def test_limit_prefix_not_cached(self, pq_dir):
+        from spark_rapids_tpu.io.scan_cache import DeviceScanCache
+        s = self._session()
+        few = s.read.parquet(pq_dir).limit(3).collect()
+        assert len(few) == 3
+        # a short-circuited scan must not poison the cache
+        assert DeviceScanCache.get().nbytes == 0 or \
+            len(s.read.parquet(pq_dir).collect()) == N
+        assert len(s.read.parquet(pq_dir).collect()) == N
+
+    def test_byte_budget_evicts(self, pq_dir):
+        from spark_rapids_tpu.io.scan_cache import DeviceScanCache
+        s = self._session(**{
+            "spark.rapids.tpu.io.deviceScanCache.bytes": 1})
+        s.read.parquet(pq_dir).collect()
+        assert DeviceScanCache.get().nbytes == 0
+
+    def test_disabled_by_conf(self, pq_dir, monkeypatch):
+        from spark_rapids_tpu.io import planner as iop
+        reads = {"n": 0}
+        orig = iop.FilePartitionReader._read
+
+        def counting(self, pair):
+            reads["n"] += 1
+            return orig(self, pair)
+        monkeypatch.setattr(iop.FilePartitionReader, "_read", counting)
+        s = self._session(**{
+            "spark.rapids.tpu.io.deviceScanCache.enabled": False})
+        s.read.parquet(pq_dir).collect()
+        n1 = reads["n"]
+        s.read.parquet(pq_dir).collect()
+        assert reads["n"] == 2 * n1
+
+    def test_options_and_dtypes_key_the_cache(self, tmp_path):
+        """Same file read with different parse options or column dtypes
+        must NOT collide in the device cache (silent wrong results)."""
+        from spark_rapids_tpu.columnar.schema import Schema
+        from spark_rapids_tpu.config import TpuConf
+        from spark_rapids_tpu.io.planner import TpuFileScan
+        from spark_rapids_tpu.plan import logical as L
+        f = tmp_path / "t.csv"
+        f.write_text("a,b\n1,2\n")
+        conf = TpuConf({"spark.rapids.tpu.sql.enabled": True})
+
+        def key(options, ddl):
+            scan = TpuFileScan(
+                L.Scan("csv", [str(f)], Schema.from_ddl(ddl), options),
+                conf)
+            return scan._cache_key(1 << 20)
+        base = key({"sep": ","}, "a string, b string")
+        assert base is not None
+        assert key({"sep": "|"}, "a string, b string") != base
+        assert key({"sep": ","}, "a long, b string") != base
+        assert key({"sep": ","}, "a string, b string") == base
+
+    def test_pressure_clears_cache(self, pq_dir):
+        from spark_rapids_tpu.io.scan_cache import (DeviceScanCache,
+                                                    clear_on_pressure)
+        s = self._session()
+        s.read.parquet(pq_dir).collect()
+        assert DeviceScanCache.get().nbytes > 0
+        clear_on_pressure()
+        assert DeviceScanCache.get().nbytes == 0

@@ -187,6 +187,35 @@ class TestCboPlacement:
         placement = cbo.choose_placement(f)
         assert placement[id(f)] == "tpu"
 
+    def test_scan_cardinality_from_parquet_footer(self, tmp_path):
+        """Scan estimates come from file footers (RowCountPlanVisitor
+        reads Spark's file-source statistics the same way)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from spark_rapids_tpu.plan import cbo
+        from spark_rapids_tpu.plan import logical as L
+        from spark_rapids_tpu.columnar.schema import Schema
+        f = str(tmp_path / "t.parquet")
+        pq.write_table(
+            pa.table({"a": pa.array(range(1234), type=pa.int64())}), f)
+        sc = L.Scan("parquet", [f], Schema.from_ddl("a long"))
+        assert cbo.estimate_rows(sc) == 1234.0
+
+    def test_filter_selectivity_by_predicate_shape(self):
+        """Equality is more selective than a range compare; AND
+        multiplies, OR unions."""
+        from spark_rapids_tpu.plan import cbo
+        from spark_rapids_tpu.expr import core as ec
+        from spark_rapids_tpu.expr import predicates as ep
+        x = ec.AttributeReference("x")
+        eq = ep.EqualTo(x, ec.Literal(1))
+        gt = ep.GreaterThan(x, ec.Literal(1))
+        assert cbo._filter_selectivity(eq) < cbo._filter_selectivity(gt)
+        both = cbo._filter_selectivity(ep.And(eq, gt))
+        either = cbo._filter_selectivity(ep.Or(eq, gt))
+        assert both < cbo._filter_selectivity(eq)
+        assert either > cbo._filter_selectivity(gt)
+
     def test_placement_is_transition_aware(self):
         """A cheap node sandwiched between expensive TPU nodes stays on
         TPU (two extra transitions would cost more than its speedup)."""

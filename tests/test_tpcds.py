@@ -41,10 +41,36 @@ def _eq(a, b):
     return a == b
 
 
+def _canon(rows):
+    """Most TPC-DS ORDER BYs do not fully determine the output (ties),
+    so engines may legally differ within tie groups — compare the
+    sorted multiset (the reference harness's ignore_order)."""
+    from harness import canon_rows
+    return canon_rows(rows)
+
+
+#: running 99 queries x 2 engines in ONE process accumulates thousands
+#: of XLA:CPU executables; past a threshold LLVM's JIT code memory
+#: segfaults on the next compile (observed deterministically at the
+#: 88th query).  Dropping the executable caches every 25 queries keeps
+#: the arena bounded; re-compiles at the 16-row test sizes are cheap.
+_QUERIES_RUN = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _bounded_compile_arena():
+    yield
+    _QUERIES_RUN["n"] += 1
+    if _QUERIES_RUN["n"] % 25 == 0:
+        from spark_rapids_tpu.shims.compile_caches import \
+            clear_compile_caches
+        clear_compile_caches()
+
+
 @pytest.mark.parametrize("q", sorted(tpcds.QUERIES))
 def test_tpcds_query_equality(q, data_dir):
-    cpu = with_cpu_session(_rows(q, data_dir))
-    tpu = with_tpu_session(_rows(q, data_dir))
+    cpu = _canon(with_cpu_session(_rows(q, data_dir)))
+    tpu = _canon(with_tpu_session(_rows(q, data_dir)))
     assert len(cpu) == len(tpu), f"{q}: {len(cpu)} vs {len(tpu)}"
     for i, (cr, tr) in enumerate(zip(cpu, tpu)):
         assert all(_eq(a, b) for a, b in zip(cr, tr)), \
