@@ -264,8 +264,10 @@ class ColumnarBatch:
         b = ColumnarBatch(self.schema, cols, self.num_rows)
         return b
 
-    def gather(self, indices, num_rows) -> "ColumnarBatch":
-        cols = [c.gather(indices) for c in self.columns]
+    def gather(self, indices, num_rows, live=None,
+               unique=False) -> "ColumnarBatch":
+        cols = [c.gather(indices, live=live, unique=unique)
+                for c in self.columns]
         return ColumnarBatch(self.schema, cols, num_rows)
 
     # jitted slice programs keyed by (out_cap,); shapes key the rest.

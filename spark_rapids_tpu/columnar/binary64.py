@@ -83,10 +83,12 @@ class Binary64Column(Column):
                 (jnp.arange(capacity) < num_rows)
         return Binary64Column(data, valid)
 
-    def gather(self, indices):
+    def gather(self, indices, live=None, unique=False):
+        valid = jnp.take(self.validity, indices, axis=0, mode="clip")
+        if live is not None:
+            valid = valid & live
         return Binary64Column(
-            jnp.take(self.data, indices, axis=0, mode="clip"),
-            jnp.take(self.validity, indices, axis=0, mode="clip"))
+            jnp.take(self.data, indices, axis=0, mode="clip"), valid)
 
     def mask_validity(self, keep_mask):
         return Binary64Column(self.data, self.validity & keep_mask)

@@ -189,11 +189,17 @@ class Column:
             valid = self.validity[:capacity] & (jnp.arange(capacity) < num_rows)
         return Column(self.dtype, data, valid)
 
-    def gather(self, indices) -> "Column":
-        """Take rows by index (device gather). indices: int array [new_cap]."""
+    def gather(self, indices, live=None, unique=False) -> "Column":
+        """Take rows by index (device gather). indices: int array [new_cap].
+
+        ``live``/``unique`` are sizing hints for variable-width columns
+        (kernels/strings.py gather_strings); fixed-width gathers ignore
+        them."""
+        valid = jnp.take(self.validity, indices, axis=0, mode="clip")
+        if live is not None:
+            valid = valid & live
         return Column(self.dtype, jnp.take(self.data, indices, axis=0,
-                                           mode="clip"),
-                      jnp.take(self.validity, indices, axis=0, mode="clip"))
+                                           mode="clip"), valid)
 
     def mask_validity(self, keep_mask) -> "Column":
         return Column(self.dtype, self.data, self.validity & keep_mask)
@@ -286,13 +292,21 @@ class StringColumn(Column):
         return StringColumn(offsets, self.data, valid,
                             max_bytes=self.max_bytes)
 
-    def gather(self, indices) -> "StringColumn":
-        # String gather rebuilds offsets on device and gathers bytes via a
-        # windowed index computation (kernels.strings.gather_strings).
-        from ..kernels import strings as skern
-        offs, buf, valid = skern.gather_strings(
-            self.offsets, self.data, self.validity, indices)
-        return StringColumn(offs, buf, valid, max_bytes=self.max_bytes)
+    def gather(self, indices, live=None,
+               unique=False) -> "StringColumn":
+        # Gathers are LAZY: the result is a view (row indices into this
+        # column) and byte materialization is deferred until something
+        # reads .offsets/.data.  Chained gathers compose into one index
+        # map, so a join expansion to fact capacity followed by an
+        # aggregate's 1000x row reduction never materializes the
+        # intermediate gigabytes (and never pays its sizing sync) —
+        # the cuDF-style dictionary/gather-map trick.
+        valid = jnp.take(self.validity, indices, axis=0, mode="clip")
+        if live is not None:
+            valid = valid & live
+        src_idx = jnp.clip(indices, 0, self.capacity - 1) \
+            .astype(jnp.int32)
+        return GatheredStringColumn(self, src_idx, valid, unique=unique)
 
     def mask_validity(self, keep_mask) -> "StringColumn":
         return StringColumn(self.offsets, self.data,
@@ -312,6 +326,99 @@ class StringColumn(Column):
 
     def device_buffers(self):
         return [self.offsets, self.data, self.validity]
+
+
+class GatheredStringColumn(StringColumn):
+    """Lazy string gather: row indices into a source StringColumn.
+
+    Produced by StringColumn.gather.  Byte materialization — the
+    expensive part of a string gather (an O(out_bytes) device windowed
+    copy PLUS a host sync to size it) — is deferred until .offsets or
+    .data is read.  Sort/group/join key words come straight from the
+    source column's words gathered by index (kernels/canon.value_words
+    fast path), so select-expand-reduce pipelines only ever materialize
+    their final small outputs.  Chained gathers compose index maps.
+    """
+
+    def __init__(self, src: "StringColumn", idx, validity, unique=False):
+        # deliberately no super().__init__: offsets/data are properties
+        self.dtype = T.STRING
+        while type(src) is GatheredStringColumn:
+            if src._mat is not None:
+                src = src._mat
+                continue
+            idx = jnp.take(src.idx, idx, axis=0, mode="clip")
+            # a composed map repeats source rows unless EVERY stage was
+            # repeat-free
+            unique = unique and src._unique
+            src = src.src
+        self.src = src
+        self.idx = idx
+        self.validity = validity
+        self.max_bytes = src.max_bytes
+        self._unique = unique
+        self._mat: Optional[StringColumn] = None
+
+    def _materialize(self) -> StringColumn:
+        if self._mat is None:
+            from ..kernels import strings as skern
+            offs, buf, valid = skern.gather_strings(
+                self.src.offsets, self.src.data, self.src.validity,
+                self.idx, live=self.validity, unique=self._unique,
+                max_bytes=self.max_bytes)
+            self._mat = StringColumn(offs, buf, valid,
+                                     max_bytes=self.max_bytes)
+        return self._mat
+
+    @property
+    def offsets(self):
+        return self._materialize().offsets
+
+    @property
+    def data(self):
+        return self._materialize().data
+
+    # gather() is inherited: StringColumn.gather already produces a
+    # composed view via this class's constructor.
+
+    def mask_validity(self, keep_mask) -> "StringColumn":
+        out = GatheredStringColumn(self.src, self.idx,
+                                   self.validity & keep_mask,
+                                   unique=self._unique)
+        out._mat = None if self._mat is None else \
+            self._mat.mask_validity(keep_mask)
+        return out
+
+    def with_capacity(self, capacity: int,
+                      num_rows: int) -> "StringColumn":
+        if capacity == self.capacity:
+            return self
+        if capacity > self.capacity:
+            pad = capacity - self.capacity
+            idx = jnp.pad(self.idx, (0, pad))
+            valid = jnp.pad(self.validity, (0, pad))
+        else:
+            idx = self.idx[:capacity]
+            valid = self.validity[:capacity] & \
+                (jnp.arange(capacity) < num_rows)
+        return GatheredStringColumn(self.src, idx, valid,
+                                    unique=self._unique)
+
+    def nbytes(self) -> int:
+        # a live view PINS its source buffers: memory accounting must
+        # see them or spill/coalesce budgets undercount by the whole
+        # source batch (several views over one source over-count — the
+        # safe direction for pressure decisions)
+        own = self.idx.nbytes + self.validity.nbytes
+        if self._mat is not None:
+            return own + self._mat.nbytes()
+        return own + self.src.nbytes()
+
+    def device_buffers(self):
+        # spill/wire serialization needs real buffers in StringColumn
+        # layout (a view pins its source; a spilled copy must not) —
+        # the materialized validity already folds the view's in
+        return self._materialize().device_buffers()
 
 
 class ListColumn(Column):
@@ -404,7 +511,7 @@ class ListColumn(Column):
             valid = self.validity[:capacity] & (jnp.arange(capacity) < num_rows)
         return ListColumn(self.dtype, offsets, self.elements, valid)
 
-    def gather(self, indices) -> "ListColumn":
+    def gather(self, indices, live=None, unique=False) -> "ListColumn":
         from ..kernels import lists as lkern
         new_offsets, gvalid, src_starts, total = lkern.gather_list_offsets(
             self.offsets, self.validity, indices)
@@ -496,9 +603,12 @@ class StructColumn(Column):
             valid = self.validity[:capacity] & (jnp.arange(capacity) < num_rows)
         return StructColumn(self.dtype, kids, valid)
 
-    def gather(self, indices) -> "StructColumn":
+    def gather(self, indices, live=None,
+               unique=False) -> "StructColumn":
         return StructColumn(
-            self.dtype, [c.gather(indices) for c in self.children],
+            self.dtype,
+            [c.gather(indices, live=live, unique=unique)
+             for c in self.children],
             jnp.take(self.validity, indices, axis=0, mode="clip"))
 
     def mask_validity(self, keep_mask) -> "StructColumn":
@@ -582,7 +692,7 @@ class MapColumn(ListColumn):
         lc = ListColumn.with_capacity(self, capacity, num_rows)
         return MapColumn(self.dtype, lc.offsets, lc.elements, lc.validity)
 
-    def gather(self, indices) -> "MapColumn":
+    def gather(self, indices, live=None, unique=False) -> "MapColumn":
         lc = ListColumn.gather(self, indices)
         return MapColumn(self.dtype, lc.offsets, lc.elements, lc.validity)
 

@@ -72,8 +72,9 @@ def apply_ops_traced(ops: Sequence[Op], batch) -> "_TracedBatch":
             cap = batch.capacity
             keep = pred.data.astype(bool) & pred.validity
             order, cnt = bk.compact_indices(keep, n)
-            cols = [c.gather(order) for c in batch.columns]
             live = jnp.arange(cap) < cnt
+            cols = [c.gather(order, live=live, unique=True)
+                    for c in batch.columns]
             cols = [c.mask_validity(live) for c in cols]
             batch = _TracedBatch(out_schema, cols, cnt, cap)
         else:
@@ -102,8 +103,8 @@ def apply_ops_eager(ops: Sequence[Op], batch: ColumnarBatch,
             keep = pred.data.astype(bool) & pred.validity
             idx, cnt = bk.compact_indices(keep, batch.rows_dev)
             n = LazyCount(cnt)
-            out = batch.gather(idx, n)
-            mask = jnp.arange(out.capacity) < cnt
+            mask = jnp.arange(batch.capacity) < cnt
+            out = batch.gather(idx, n, live=mask, unique=True)
             batch = ColumnarBatch(
                 out_schema, [c.mask_validity(mask) for c in out.columns],
                 n)

@@ -45,13 +45,13 @@ def _assemble_group_output(plan, key_cols, aggs, agg_buffers, out_cap: int,
     live = jnp.arange(out_cap) < ng
     outs = []
     for c in key_cols:
-        g = c.gather(take).mask_validity(live)
+        g = c.gather(take, live=live, unique=True).mask_validity(live)
         outs.append((g.data, g.validity))
     seg_take = jnp.where(live, jnp.arange(out_cap), 0)
     for a, bufs in zip(aggs, agg_buffers):
         cols_out = bufs if emit_buffers else [a.func.finalize(bufs)]
         for o in cols_out:
-            c2 = o.gather(seg_take).mask_validity(live)
+            c2 = o.gather(seg_take, live=live, unique=True).mask_validity(live)
             outs.append((c2.data, c2.validity))
     return ng, outs
 
@@ -878,8 +878,9 @@ class TpuHashAggregate(TpuExec):
                          rep[:out_cap] if out_cap <= rep.shape[0] else
                          jnp.pad(rep, (0, out_cap - rep.shape[0]))[:out_cap],
                          0)
-        out_cols = [c.gather(take) for c in key_cols]
         live = jnp.arange(out_cap) < ng
+        out_cols = [c.gather(take, live=live, unique=True)
+                    for c in key_cols]
         out_cols = [c.mask_validity(live) for c in out_cols]
 
         # compact agg outputs: buffer arrays are already segment-indexed
@@ -891,7 +892,7 @@ class TpuHashAggregate(TpuExec):
             for o in outs:
                 seg_take = jnp.where(live, jnp.arange(out_cap), 0)
                 assert o.capacity >= out_cap, (o.capacity, out_cap)
-                c = o.gather(seg_take)
+                c = o.gather(seg_take, live=live, unique=True)
                 out_cols.append(c.mask_validity(live))
         out_schema = buffer_schema(self.group_exprs, self.aggs) \
             if emit_buffers else self.output_schema

@@ -189,8 +189,8 @@ class TpuFilter(TpuExec):
                     # keep the count on device: pulling it per batch
                     # costs a full dispatch-queue sync (LazyCount doc)
                     n = LazyCount(cnt)
-                    out = batch.gather(idx, n)
-                    mask = jnp.arange(out.capacity) < cnt
+                    mask = jnp.arange(batch.capacity) < cnt
+                    out = batch.gather(idx, n, live=mask, unique=True)
                     out = ColumnarBatch(
                         out.schema,
                         [c.mask_validity(mask) for c in out.columns], n)

@@ -50,7 +50,7 @@ class TpuSort(TpuExec):
             return batch
         words = self._key_words(self._key_cols(batch), batch.num_rows)
         perm = sort_permutation(words)
-        out = batch.gather(perm, batch.num_rows)
+        out = batch.gather(perm, batch.num_rows, unique=True)
         mask = jnp.arange(out.capacity) < batch.num_rows
         return ColumnarBatch(out.schema,
                              [c.mask_validity(mask) for c in out.columns],

@@ -119,6 +119,20 @@ def value_words(col: Column, num_rows: int,
                 str_words: int = None) -> List[jnp.ndarray]:
     """uint64 word list for the column values (no null rank)."""
     dt = col.dtype
+    from ..columnar.column import GatheredStringColumn
+    if type(col) is GatheredStringColumn and col._mat is None:
+        # lazy gather view: gather the SOURCE column's words by index —
+        # pure integer device work, no byte materialization and no
+        # sizing sync.  num_words from the source's full capacity so
+        # every view over one source agrees on word count.
+        from . import strings as skern
+        src = col.src
+        if str_words is None:
+            str_words = skern.needed_key_words(src, src.capacity)
+        src_words = skern.string_key_words(src, src.capacity,
+                                           num_words=str_words)
+        return [jnp.take(w, col.idx, axis=0, mode="clip")
+                for w in src_words]
     if isinstance(col, StringColumn):
         from . import strings as skern
         return skern.string_key_words(col, num_rows, num_words=str_words)

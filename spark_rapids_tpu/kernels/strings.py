@@ -46,6 +46,11 @@ def needed_key_words(col: StringColumn, num_rows: int) -> int:
     column derived purely on device pays ONE offsets sync and caches
     the bound on the instance (each uncached call would otherwise
     serialize behind all pending device work)."""
+    from ..columnar.column import GatheredStringColumn
+    if type(col) is GatheredStringColumn and col._mat is None:
+        # lazy gather view: bound from the SOURCE without materializing
+        # (view rows are a subset of source rows)
+        return needed_key_words(col.src, col.src.capacity)
     max_len = col.max_bytes
     if max_len is None:
         cached = getattr(col, "_live_max_bytes", None)
@@ -80,13 +85,18 @@ def string_key_words(col: StringColumn, num_rows: int,
 
 
 @jax.jit
-def _gather_offsets(offsets, validity, indices):
+def _gather_offsets(offsets, validity, indices, live=None):
     starts = offsets[:-1]
     lens = offsets[1:] - starts
     ncap = indices.shape[0]
     src = jnp.clip(indices, 0, starts.shape[0] - 1)
     glens = jnp.take(lens, src)
     gvalid = jnp.take(validity, src)
+    if live is not None:
+        # dead output lanes (gather pads them with a repeated index)
+        # must contribute zero bytes, or the no-sync unique-gather byte
+        # bound below does not hold
+        gvalid = gvalid & live
     glens = jnp.where(gvalid, glens, 0)
     new_offsets = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(glens).astype(jnp.int32)])
@@ -107,11 +117,39 @@ def _materialize_bytes(data, new_offsets, src_starts, out_bytes: int):
                      jnp.uint8(0))
 
 
-def gather_strings(offsets, data, validity, indices):
-    """Row gather for string columns (two-phase: size on host, then fill)."""
+def gather_strings(offsets, data, validity, indices, live=None,
+                   unique=False, max_bytes=None):
+    """Row gather for string columns.
+
+    Sizing the output byte buffer needs a host-known bound.  The
+    default is the exact total — one device sync per gather (a full
+    dispatch-queue round trip on remote backends).  Two SYNC-FREE
+    static bounds are used when available:
+
+    - ``unique=True``: every live output lane reads a distinct source
+      row, so output bytes <= the source buffer — sort permutations,
+      filter compactions and aggregate representative gathers (callers
+      must pass ``live`` when their index vector pads dead lanes with
+      a repeated index).
+    - ``max_bytes``: rows * max-single-string-length, used when that
+      bound is not much larger than the source buffer.
+    """
     new_offsets, gvalid, src_starts, total = _gather_offsets(
-        offsets, validity, indices)
-    out_bytes = bucket_capacity(max(1, int(total)))
+        offsets, validity, indices, live)
+    # _materialize_bytes does O(out_bytes) device work, so a static
+    # bound only beats the ~0.1-0.2s sync when it is SMALL; large
+    # source buffers keep the exact-size sync
+    _NOSYNC_MAX = 1 << 22
+    src_bytes = max(int(data.shape[0]), 1)
+    mb_bound = indices.shape[0] * max_bytes if max_bytes else None
+    if unique and src_bytes <= _NOSYNC_MAX:
+        out_bytes = src_bytes
+        if mb_bound is not None:
+            out_bytes = min(out_bytes, bucket_capacity(max(1, mb_bound)))
+    elif mb_bound is not None and mb_bound <= _NOSYNC_MAX:
+        out_bytes = bucket_capacity(max(1, mb_bound))
+    else:
+        out_bytes = bucket_capacity(max(1, int(total)))
     buf = _materialize_bytes(data, new_offsets, src_starts, out_bytes)
     return new_offsets, buf, gvalid
 

@@ -170,8 +170,15 @@ class BufferCatalog:
         from ..columnar.batch import ColumnarBatch
         assert isinstance(device_obj, ColumnarBatch)
         bufs = [np.asarray(a) for a in device_obj.device_buffers()]
+        from ..columnar.column import StringColumn
+
+        def kind(c):
+            # gather views serialize in materialized StringColumn layout
+            if isinstance(c, StringColumn):
+                return "StringColumn"
+            return type(c).__name__
         return (device_obj.schema, device_obj.num_rows,
-                [type(c).__name__ for c in device_obj.columns], bufs)
+                [kind(c) for c in device_obj.columns], bufs)
 
     def _deserialize(self, payload):
         import jax.numpy as jnp
