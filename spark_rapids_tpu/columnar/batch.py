@@ -143,11 +143,18 @@ class SpeculativeResult:
 def resolve_speculative(batch: "ColumnarBatch") -> "ColumnarBatch":
     """Verify-and-replace helper: returns the batch itself when its
     speculative assumptions held (or it has none), else the re-computed
-    exact batch."""
+    exact batch.  Loops: a redo may itself return a speculative batch
+    (e.g. the bucket-table redo falls back to the sort path, which can
+    attach its own compaction fit flag)."""
+    for _ in range(4):
+        spec = getattr(batch, "_speculative", None)
+        if spec is None or spec.ok():
+            return batch
+        batch = spec.redo()
     spec = getattr(batch, "_speculative", None)
-    if spec is None or spec.ok():
-        return batch
-    return spec.redo()
+    assert spec is None or spec.ok(), \
+        "speculative redo did not converge to a verified batch"
+    return batch
 
 
 class ColumnarBatch:

@@ -232,6 +232,14 @@ AGG_TABLE_SIZE = conf_int(
 AGG_TABLE_ENABLED = conf_bool(
     "spark.rapids.tpu.sql.agg.tablePath.enabled", True,
     "Enable the sort-free bucket-table aggregation fast path")
+AGG_COMPACT_ROWS = conf_int(
+    "spark.rapids.tpu.sql.agg.speculativeCompactRows", 1 << 16,
+    "Sort-path group-by outputs are speculatively compacted on device "
+    "to this capacity (a fit flag verifies group count <= cap at the "
+    "consumer's flush barrier; the rare wider batch is recomputed "
+    "uncompacted).  Without it a 4M-row batch aggregating to 1k groups "
+    "hands a 4M-capacity batch to the exchange/join, and every "
+    "downstream program pays full-width work for dead rows.")
 AGG_TABLE_REDUCE_IMPL = conf_str(
     "spark.rapids.tpu.sql.agg.tableReduceImpl", "scatter",
     "Bucket-table reduction backend: 'scatter' (multi-column XLA "

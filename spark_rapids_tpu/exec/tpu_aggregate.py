@@ -220,10 +220,12 @@ class TpuHashAggregate(TpuExec):
     _CORE_CACHE = {}
 
     def _fused_agg_core(self, key_cols, input_cols, update_mode: bool,
-                        batch: ColumnarBatch, emit_buffers: bool):
+                        batch: ColumnarBatch, emit_buffers: bool,
+                        out_cap: Optional[int] = None):
         """keys->words->plan->update/merge->output assembly as ONE jitted
-        computation, returning (num_groups, [(data, validity)]) output
-        pairs in schema order.
+        computation, returning (num_groups, fit, [(data, validity)])
+        output pairs in schema order (``out_cap``/``fit``: speculative
+        device-side compaction, see _fused_whole_stage_core).
 
         The whole grouping pipeline is device-pure (the only host sync is
         the group count, pulled after); fusing it collapses the ~40 eager
@@ -242,7 +244,7 @@ class TpuHashAggregate(TpuExec):
             TpuHashAggregate._FUSABLE_FUNCS = (
                 ea.Sum, ea.Count, ea.Min, ea.Max, ea.Average, ea.First,
                 ea.Last, ea.CentralMoment)
-        if batch.capacity > (1 << 21):
+        if batch.capacity > (1 << 22):
             return None
         if not all(type(c) is Column for c in key_cols):
             return None
@@ -256,7 +258,7 @@ class TpuHashAggregate(TpuExec):
         in_dts = tuple(tuple(None if c is None else c.dtype for c in cols)
                        for cols in input_cols)
         aggs = self.aggs
-        cache_key = (update_mode, emit_buffers, key_dts, in_dts,
+        cache_key = (update_mode, emit_buffers, key_dts, in_dts, out_cap,
                      tuple((type(a.func).__name__, repr(a.func),
                             getattr(a.func, "ignore_nulls", None))
                            for a in aggs))
@@ -268,7 +270,7 @@ class TpuHashAggregate(TpuExec):
             def _core(key_arrays, in_arrays, num_rows):
                 kcols = [Column(dt, d, v)
                          for dt, (d, v) in zip(key_dts, key_arrays)]
-                out_cap = key_arrays[0][0].shape[0]
+                cap = key_arrays[0][0].shape[0]
                 words = canon.batch_key_words(kcols, num_rows)
                 plan = agg_k.groupby_plan(words)
                 agg_buffers = []
@@ -279,9 +281,13 @@ class TpuHashAggregate(TpuExec):
                     bufs = a.func.update(plan, cols) if update_mode \
                         else a.func.merge(plan, cols)
                     agg_buffers.append(bufs)
-                return _assemble_group_output(plan, kcols, aggs,
-                                              agg_buffers, out_cap,
-                                              emit_buffers)
+                ocap = min(out_cap, cap) if out_cap else cap
+                fit = (plan.num_groups <= ocap).astype(jnp.int32) \
+                    if out_cap else jnp.int32(1)
+                ng, outs = _assemble_group_output(plan, kcols, aggs,
+                                                  agg_buffers, ocap,
+                                                  emit_buffers)
+                return ng, fit, outs
             core = jax.jit(_core)
             TpuHashAggregate._CORE_CACHE[cache_key] = core
 
@@ -732,14 +738,17 @@ class TpuHashAggregate(TpuExec):
         return cache_key, bound_keys, bound_inputs
 
     def _fused_whole_stage_core(self, batch: ColumnarBatch,
-                                emit_buffers: bool = True):
+                                emit_buffers: bool = True,
+                                out_cap: Optional[int] = None):
         """scan-side filter/project chain + key eval + grouping + update
         + output assembly as ONE jitted program (whole-stage codegen
         role, exec/staged.py).
 
-        Returns (num_groups, [(data, validity)] output pairs in schema
-        order) or None to fall back (the caller then applies pre_ops
-        eagerly)."""
+        Returns (num_groups, fit, [(data, validity)] output pairs in
+        schema order) or None to fall back (the caller then applies
+        pre_ops eagerly).  ``out_cap`` requests speculative device-side
+        compaction to that capacity; ``fit`` is the device flag that the
+        group count fit (always-1 when uncompacted)."""
         import jax
         import logging
         from .fused import _TracedBatch, _tree_fusable, expr_signature
@@ -749,7 +758,7 @@ class TpuHashAggregate(TpuExec):
             TpuHashAggregate._FUSABLE_FUNCS = (
                 ea.Sum, ea.Count, ea.Min, ea.Max, ea.Average, ea.First,
                 ea.Last, ea.CentralMoment)
-        if batch.capacity > (1 << 21) or not batch.columns:
+        if batch.capacity > (1 << 22) or not batch.columns:
             return None
         if not all(type(c) is Column for c in batch.columns):
             return None
@@ -763,7 +772,7 @@ class TpuHashAggregate(TpuExec):
         if prep is False:
             return None
         cache_key, bound_keys, bound_inputs = prep
-        cache_key = cache_key + (emit_buffers,)
+        cache_key = cache_key + (emit_buffers, out_cap)
         core = TpuHashAggregate._CORE_CACHE.get(cache_key)
         if core is False:
             return None
@@ -785,9 +794,13 @@ class TpuHashAggregate(TpuExec):
                 for a, bs in zip(aggs, bound_inputs):
                     cols2 = [ec.eval_as_column(e, b) for e in bs] or [None]
                     agg_buffers.append(a.func.update(plan, cols2))
-                return _assemble_group_output(plan, kcols, aggs,
-                                              agg_buffers, cap,
-                                              emit_buffers)
+                ocap = min(out_cap, cap) if out_cap else cap
+                fit = (plan.num_groups <= ocap).astype(jnp.int32) \
+                    if out_cap else jnp.int32(1)
+                ng, outs = _assemble_group_output(plan, kcols, aggs,
+                                                  agg_buffers, ocap,
+                                                  emit_buffers)
+                return ng, fit, outs
             core = jax.jit(_core)
             TpuHashAggregate._CORE_CACHE[cache_key] = core
         datas = tuple(c.data for c in batch.columns)
@@ -804,7 +817,8 @@ class TpuHashAggregate(TpuExec):
     # -- core -------------------------------------------------------------------
     def _aggregate_batch(self, batch: ColumnarBatch,
                          emit_buffers: bool = False,
-                         no_table: bool = False) -> ColumnarBatch:
+                         no_table: bool = False,
+                         no_compact: bool = False) -> ColumnarBatch:
         if not no_table and self.mode == PARTIAL and self.group_exprs:
             t = self._fused_table_core(batch)
             if t is not None:
@@ -812,14 +826,41 @@ class TpuHashAggregate(TpuExec):
         emit = emit_buffers or self.mode == PARTIAL
         out_schema_obj = buffer_schema(self.group_exprs, self.aggs) \
             if emit else self.output_schema
+        # speculative device-side compaction: hand downstream a small-
+        # capacity batch instead of the input-capacity one (group counts
+        # are almost always << rows); the fit flag is verified at the
+        # consumer's flush barrier, a misfit recomputes uncompacted and
+        # turns compaction off for this exec
+        compact_cap = None
+        if not no_compact and self.group_exprs and \
+                self._ws_memo.get("compact_state") != "off":
+            from ..config import get_active, AGG_COMPACT_ROWS
+            cc = int(get_active().get(AGG_COMPACT_ROWS))
+            if cc > 0 and batch.capacity > cc:
+                compact_cap = cc
+
+        def _wrap_speculative(out: ColumnarBatch, fit) -> ColumnarBatch:
+            if compact_cap is None:
+                return out
+
+            def redo():
+                self._ws_memo["compact_state"] = "off"
+                return resolve_speculative(self._aggregate_batch(
+                    batch, emit_buffers=emit_buffers, no_table=no_table,
+                    no_compact=True))
+            out._speculative = SpeculativeResult([LazyCount(fit)], redo)
+            return out
         if self.pre_ops and self.mode in (PARTIAL, COMPLETE):
-            ws = self._fused_whole_stage_core(batch, emit) \
+            ws = self._fused_whole_stage_core(batch, emit,
+                                              out_cap=compact_cap) \
                 if self.group_exprs else None
             if ws is not None:
-                ng, pairs = ws
+                ng, fit, pairs = ws
                 cols = [Column(f.dtype, d, v)
                         for f, (d, v) in zip(out_schema_obj, pairs)]
-                return ColumnarBatch(out_schema_obj, cols, LazyCount(ng))
+                return _wrap_speculative(
+                    ColumnarBatch(out_schema_obj, cols, LazyCount(ng)),
+                    fit)
             from .staged import apply_ops_eager, build_fused_per_op
             fkey = ("fpo", tuple(f.dtype.name for f in batch.schema))
             fpo = self._ws_memo.get(fkey)
@@ -850,12 +891,13 @@ class TpuHashAggregate(TpuExec):
 
         update_mode = self.mode in (PARTIAL, COMPLETE)
         fused = self._fused_agg_core(key_cols, input_cols, update_mode,
-                                     batch, emit)
+                                     batch, emit, out_cap=compact_cap)
         if fused is not None:
-            ng, pairs = fused
+            ng, fit, pairs = fused
             cols = [Column(f.dtype, d, v)
                     for f, (d, v) in zip(out_schema_obj, pairs)]
-            return ColumnarBatch(out_schema_obj, cols, LazyCount(ng))
+            return _wrap_speculative(
+                ColumnarBatch(out_schema_obj, cols, LazyCount(ng)), fit)
         words = canon.batch_key_words(key_cols, batch.rows_dev)
         plan = agg_k.groupby_plan(words)
         # aggregate buffers (segment-id indexed, 0..G-1, input capacity)

@@ -71,31 +71,27 @@ def _is_b64(c) -> bool:
 
 
 def _b64_seg_sum(plan, c):
-    """Exact DOUBLE segment sum: softfloat associative scan over the
-    plan's sorted order (kernels/binary64.segmented_sum)."""
+    """Exact DOUBLE segment sum: windowed integer superaccumulator over
+    the plan's sorted order (kernels/binary64.segmented_sum)."""
     from ..kernels import binary64 as b64
     from ..columnar.binary64 import Binary64Column
     v, ok = agg_k._sorted_vals(plan, c.data, c.validity)
-    s = b64.segmented_sum(v, ok, plan.seg_id, c.capacity)
+    s = b64.segmented_sum(v, ok, plan.seg_id, c.capacity,
+                          head_pos=plan.head_pos, last_pos=plan.last_pos,
+                          num_groups=plan.num_groups)
     cnt = agg_k.seg_count(plan, c.validity)
     return Binary64Column(s, cnt > 0), cnt
 
 
 def _b64_seg_minmax(plan, c, want_max: bool):
     """Exact DOUBLE min/max via the total-order word (Spark order: NaN
-    greatest, -0.0 == 0.0)."""
-    import jax
+    greatest, -0.0 == 0.0); reduced with two 32-bit scatter passes
+    (agg_k.seg_minmax_u64 — no slow 64-bit scatter)."""
     from ..kernels import binary64 as b64
     from ..columnar.binary64 import Binary64Column
     v, ok = agg_k._sorted_vals(plan, c.data, c.validity)
     w = b64.order_word(v)
-    cap = c.capacity
-    if want_max:
-        contrib = jnp.where(ok, w, jnp.uint64(0))
-        m = jax.ops.segment_max(contrib, plan.seg_id, num_segments=cap)
-    else:
-        contrib = jnp.where(ok, w, jnp.uint64(2**64 - 1))
-        m = jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
+    m = agg_k.seg_minmax_u64(plan, w, ok, want_max=want_max)
     cnt = agg_k.seg_count(plan, c.validity)
     return Binary64Column(b64.word_to_bits(m), cnt > 0), cnt
 

@@ -89,7 +89,11 @@ class TpuFileScan(TpuExec):
 
     def _cache_key(self, max_rows):
         """Identity of this scan's device batches: files+mtimes+sizes,
-        column set/order, pushdown, and batching geometry."""
+        column set/order, pushdown, batching geometry, and every session
+        conf that changes the cached batch REPRESENTATION (exactDouble
+        decides Binary64Column-vs-f64 at from_arrow time; the cache is
+        process-global, so two sessions with different settings must not
+        share batches)."""
         files = []
         for part in self._partitions:
             for f in part:
@@ -110,6 +114,7 @@ class TpuFileScan(TpuExec):
             if isinstance(x, (list, tuple)):
                 return tuple(freeze(v) for v in x)
             return x
+        from ..columnar.binary64 import exact_double_enabled
         try:
             pushed = freeze(self.pushed_filters) \
                 if self.pushed_filters else None
@@ -117,7 +122,8 @@ class TpuFileScan(TpuExec):
                    tuple((f.name, f.dtype.name)
                          for f in self.logical.schema.fields),
                    freeze(self.logical.options or {}),
-                   pushed, max_rows, self.strategy)
+                   pushed, max_rows, self.strategy,
+                   exact_double_enabled())
             hash(key)                 # reject exotic unhashable leaves
         except Exception:
             return None               # unhashable option: never cache
@@ -159,26 +165,37 @@ class TpuFileScan(TpuExec):
         the scan cannot be cached anyway, so collection is abandoned
         and batches stream through unpinned (out-of-HBM scans keep
         their streaming memory profile)."""
+        import threading
         from ..config import SCAN_CACHE_BYTES
         from .scan_cache import DeviceScanCache
         cap = int(self.conf.get(SCAN_CACHE_BYTES))
-        state = {"bytes": 0, "abandoned": False}
+        # partition iterators may be consumed from concurrent tasks:
+        # byte accounting / completion state shares one lock so the
+        # budget cannot be overrun and insert happens exactly once
+        lock = threading.Lock()
+        state = {"bytes": 0, "abandoned": False, "inserted": False}
         collected = [[] for _ in parts]
         done = [False] * len(parts)
 
         def wrap(i, it):
             for b in it:
-                if not state["abandoned"]:
-                    state["bytes"] += b.nbytes()
-                    if state["bytes"] > cap:
-                        state["abandoned"] = True
-                        for part in collected:
-                            part.clear()
-                    else:
-                        collected[i].append(b)
+                with lock:
+                    if not state["abandoned"]:
+                        state["bytes"] += b.nbytes()
+                        if state["bytes"] > cap:
+                            state["abandoned"] = True
+                            for part in collected:
+                                part.clear()
+                        else:
+                            collected[i].append(b)
                 yield b
-            done[i] = True
-            if all(done) and not state["abandoned"]:
+            with lock:
+                done[i] = True
+                do_insert = (all(done) and not state["abandoned"]
+                             and not state["inserted"])
+                if do_insert:
+                    state["inserted"] = True
+            if do_insert:
                 DeviceScanCache.get().insert(key, collected, cap)
         return [wrap(i, it) for i, it in enumerate(parts)]
 

@@ -28,6 +28,8 @@ class GroupPlan:
     live_sorted: jnp.ndarray   # sorted-row liveness mask (in-range rows)
     rep_indices: jnp.ndarray   # original row index of each group representative
     num_groups: jnp.ndarray    # scalar int
+    head_pos: jnp.ndarray      # sorted position of each group's FIRST row
+    last_pos: jnp.ndarray      # sorted position of each group's LAST row
 
 
 def groupby_plan(words: List[jnp.ndarray]) -> GroupPlan:
@@ -35,6 +37,13 @@ def groupby_plan(words: List[jnp.ndarray]) -> GroupPlan:
 
     ``words`` must come from canon.batch_key_words (first word of each key is
     the null/range rank; rank 2 == past-num_rows padding).
+
+    Besides the segment ids, the plan carries each group's first/last
+    SORTED position (``head_pos``/``last_pos``): groups are contiguous
+    runs after the sort, so per-group reductions of sums/counts become
+    prefix-scan + two boundary gathers — a cumsum is near-free on the
+    VPU while a 64-bit scatter-add costs ~5x an f32 one (measured; XLA
+    emulates i64 as 32-bit pairs and scatters serialize badly).
     """
     sorted_ws, perm = sorted_words(words)
     live = sorted_ws[0] != jnp.uint64(2)
@@ -44,7 +53,16 @@ def groupby_plan(words: List[jnp.ndarray]) -> GroupPlan:
     num_groups = jnp.sum(boundary)
     rep_order, _ = compact_indices(boundary, boundary.shape[0])
     rep_indices = jnp.take(perm, rep_order)
-    return GroupPlan(perm, seg_id, live, rep_indices, num_groups)
+    # group g spans sorted rows [head_pos[g], last_pos[g]]; dead rows sort
+    # after all live rows, so the last live group ends at live_count-1
+    n = boundary.shape[0]
+    head_pos = rep_order.astype(jnp.int32)
+    live_count = jnp.sum(live.astype(jnp.int32))
+    gi = jnp.arange(n, dtype=jnp.int32)
+    nxt = jnp.concatenate([head_pos[1:], jnp.zeros(1, jnp.int32)])
+    last_pos = jnp.where(gi + 1 < num_groups, nxt - 1, live_count - 1)
+    return GroupPlan(perm, seg_id, live, rep_indices, num_groups,
+                     head_pos, last_pos)
 
 
 def _sorted_vals(plan: GroupPlan, values, validity):
@@ -53,25 +71,226 @@ def _sorted_vals(plan: GroupPlan, values, validity):
     return v, ok
 
 
+def seg_prefix_sum(plan: GroupPlan, contrib):
+    """Per-group sum of an already-masked per-SORTED-row integer array via
+    cumsum + boundary gathers (no scatter).  Exact for any integer dtype:
+    the whole-batch running sum may wrap, but wraparound cancels in the
+    boundary subtraction (two's complement), so each group's total is
+    exact whenever it fits the dtype — the same contract as a direct
+    per-group sum."""
+    cap = contrib.shape[0]
+    cum = jnp.cumsum(contrib)
+    ex = cum - contrib                       # exclusive prefix per row
+    hp = jnp.clip(plan.head_pos, 0, cap - 1)
+    lp = jnp.clip(plan.last_pos, 0, cap - 1)
+    total = jnp.take(cum, lp) - jnp.take(ex, hp)
+    gi = jnp.arange(cap, dtype=jnp.int32)
+    return jnp.where(gi < plan.num_groups, total,
+                     jnp.zeros_like(total))
+
+
 def seg_sum(plan: GroupPlan, values, validity, out_dtype=None):
     cap = values.shape[0]
     v, ok = _sorted_vals(plan, values, validity)
     acc = v.astype(out_dtype or v.dtype)
     contrib = jnp.where(ok, acc, jnp.zeros_like(acc))
+    if jnp.issubdtype(contrib.dtype, jnp.integer) or \
+            contrib.dtype == jnp.bool_:
+        return seg_prefix_sum(plan, contrib)
+    if contrib.dtype == jnp.float64 and jax.default_backend() != "cpu":
+        # On chip, f64 IS an (hi, lo) f32 pair: accumulate with the
+        # integer superaccumulator over the two components (no 64-bit
+        # scatter, no pair-rounding per add) — deterministic and
+        # faithful to everything the device representation can hold.
+        # The CPU backend has real f64: its native scatter-add is both
+        # exact to 53 bits and fast, so it keeps the direct path.
+        return _seg_sum_f64_pair(plan, acc, ok)
     return jax.ops.segment_sum(contrib, plan.seg_id, num_segments=cap)
 
 
+# -- f32-pair superaccumulator for FLOAT64 sums ------------------------------
+# The chip has no f64 ALU: XLA emulates f64 as an (hi, lo) f32 pair, so a
+# FLOAT64 column's device value IS hi+lo with 24-bit-exact components.
+# Summing with emulated adds costs a long pair-arithmetic chain per element
+# AND loses precision with batch size.  Instead: split each value into its
+# two f32 components (exact), decompose each component into <=2 signed
+# 32-bit limb contributions on a 160-bit integer window anchored at the
+# batch max exponent, reduce per limb with integer prefix sums over the
+# sorted segment order (seg_prefix_sum: cumsum + boundary gathers), and
+# reconstruct one f32-pair result per GROUP.  Deterministic,
+# order-independent, error <= 2^-47 relative to the window (terms >W0
+# bits below the batch max fold into sticky; W0 ~ 111 bits).
+
+_PAIR_NL = 5                 # 160-bit window
+
+
+def _pair_w0(n: int) -> int:
+    # 2n terms (hi+lo per row); keep c1 within limb NL-1: j = W0>>5 <= 3
+    return min(127, _PAIR_NL * 32 - 24 - (2 * max(n, 2)).bit_length() - 2)
+
+
+def _f32_parts(sig, e, fin_ok, emax, W0):
+    """One f32 component -> (limb index j, c0, c1, lost) contributions.
+
+    value = sig * 2^(e-150); window bit 0 weighs 2^(emax-150-W0)."""
+    d = emax - e
+    p = jnp.int32(W0) - d
+    keep = fin_ok & (p > jnp.int32(-24)) & (sig != jnp.uint64(0))
+    rs = jnp.clip(-p, 0, 31).astype(jnp.uint64)
+    sig2 = sig >> rs
+    lost = fin_ok & ((sig2 << rs) != sig)
+    lost = lost | (fin_ok & (p <= jnp.int32(-24)) & (sig != jnp.uint64(0)))
+    pc = jnp.clip(p, 0, W0)
+    j = pc >> jnp.int32(5)
+    r = (pc & jnp.int32(31)).astype(jnp.uint64)
+    l64 = sig2 << r                                  # <= 55 bits
+    c0 = (l64 & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64)
+    c1 = (l64 >> jnp.uint64(32)).astype(jnp.int64)
+    return j, c0, c1, keep, lost
+
+
+def _unpack_f32(f):
+    u = jax.lax.bitcast_convert_type(f, jnp.uint32)
+    neg = (u >> jnp.uint32(31)) != jnp.uint32(0)
+    e = ((u >> jnp.uint32(23)) & jnp.uint32(0xFF)).astype(jnp.int32)
+    m = (u & jnp.uint32(0x7FFFFF)).astype(jnp.uint64)
+    sig = jnp.where(e > 0, m | jnp.uint64(1 << 23), m)
+    ee = jnp.maximum(e, 1)
+    return neg, ee, sig, e
+
+
+def _pack_f32(sig24, e_biased):
+    """(up-to-24-bit significand, biased f32 exponent for bit 23)
+    -> f32, with left-normalization of leading zeros, subnormal squeeze
+    and overflow->inf.  No rounding: the caller passes truncated bits
+    (we keep 48 = 2x24 bits total, well past the pair's precision)."""
+    # normalize: shift the MSB up to bit 23 (the residual component can
+    # carry leading zeros when the sum's bits 39..16 start low)
+    lz = jnp.zeros(sig24.shape, jnp.int32)
+    x = sig24
+    for shift in (16, 8, 4, 2, 1):
+        m = x < (jnp.uint64(1) << jnp.uint64(24 - shift))
+        lz = jnp.where(m, lz + shift, lz)
+        x = jnp.where(m, x << jnp.uint64(shift), x)
+    lz = jnp.minimum(lz, jnp.int32(24))
+    sig24 = jnp.where(sig24 == jnp.uint64(0), sig24,
+                      sig24 << jnp.clip(lz, 0, 24).astype(jnp.uint64))
+    e_biased = e_biased - lz
+    squeeze = jnp.clip(jnp.int32(1) - e_biased, 0, 31).astype(jnp.uint64)
+    sig = sig24 >> squeeze
+    e = jnp.where(squeeze > 0, jnp.int32(1), e_biased)
+    subn = sig < jnp.uint64(1 << 23)
+    exp_field = jnp.where(subn | (sig == jnp.uint64(0)), jnp.int32(0), e)
+    u = ((exp_field.astype(jnp.uint32) & jnp.uint32(0xFF))
+         << jnp.uint32(23)) | \
+        (sig.astype(jnp.uint32) & jnp.uint32(0x7FFFFF))
+    u = jnp.where(e_biased > 254, jnp.uint32(0x7F800000), u)
+    return jax.lax.bitcast_convert_type(u, jnp.float32)
+
+
+def _seg_sum_f64_pair(plan: GroupPlan, v, ok):
+    n = v.shape[0]
+    W0 = _pair_w0(n)
+    fin = jnp.isfinite(v)
+    fin_ok = ok & fin
+    nan_f = ok & jnp.isnan(v)
+    pinf_f = ok & jnp.isposinf(v)
+    ninf_f = ok & jnp.isneginf(v)
+    vq = jnp.where(fin_ok, v, 0.0)
+    hi = vq.astype(jnp.float32)
+    lo = (vq - hi.astype(jnp.float64)).astype(jnp.float32)
+    hneg, he, hsig, _ = _unpack_f32(hi)
+    lneg, le, lsig, _ = _unpack_f32(lo)
+    # per-GROUP anchor: one large-magnitude group must not push other
+    # groups' rows below the window (i32 scatter-max is native)
+    emax_g = jax.ops.segment_max(jnp.where(fin_ok, he, jnp.int32(0)),
+                                 plan.seg_id, num_segments=n)
+    emax = jnp.take(emax_g, plan.seg_id)
+    hj, hc0, hc1, hkeep, hlost = _f32_parts(hsig, he, fin_ok, emax, W0)
+    lj, lc0, lc1, lkeep, llost = _f32_parts(lsig, le, fin_ok, emax, W0)
+    z = jnp.int64(0)
+    hs = jnp.where(hneg, jnp.int64(-1), jnp.int64(1))
+    ls = jnp.where(lneg, jnp.int64(-1), jnp.int64(1))
+    hc0 = jnp.where(hkeep, hc0 * hs, z)
+    hc1 = jnp.where(hkeep, hc1 * hs, z)
+    lc0 = jnp.where(lkeep, lc0 * ls, z)
+    lc1 = jnp.where(lkeep, lc1 * ls, z)
+    limbs = []
+    for L in range(_PAIR_NL):
+        lc = jnp.where(hj == L, hc0, z) + jnp.where(lj == L, lc0, z)
+        if L >= 1:
+            lc = lc + jnp.where(hj == L - 1, hc1, z) + \
+                jnp.where(lj == L - 1, lc1, z)
+        limbs.append(seg_prefix_sum(plan, lc))
+    nan_cnt = seg_prefix_sum(plan, nan_f.astype(jnp.int32))
+    pinf_cnt = seg_prefix_sum(plan, pinf_f.astype(jnp.int32))
+    ninf_cnt = seg_prefix_sum(plan, ninf_f.astype(jnp.int32))
+
+    # ---- per-group finalize ----
+    m32 = jnp.int64(0xFFFFFFFF)
+    carry = jnp.int64(0)
+    lo32s = []
+    for L in range(_PAIR_NL):
+        s = limbs[L] + carry
+        l32 = s & m32
+        carry = (s - l32) >> jnp.int64(32)
+        lo32s.append(l32)
+    total_neg = carry < 0
+    mags = []
+    c = jnp.where(total_neg, jnp.int64(1), jnp.int64(0))
+    for L in range(_PAIR_NL):
+        t = jnp.where(total_neg, (~lo32s[L]) & m32, lo32s[L]) + c
+        mags.append((t & m32).astype(jnp.uint64))
+        c = jnp.where(total_neg, t >> jnp.int64(32), jnp.int64(0))
+    words = [(mags[1] << jnp.uint64(32)) | mags[0],
+             (mags[3] << jnp.uint64(32)) | mags[2],
+             mags[4]]
+    nzs = [w != jnp.uint64(0) for w in words]
+    top = jnp.zeros(n, jnp.int32)
+    any_nz = jnp.zeros(n, bool)
+    for i in range(3):
+        top = jnp.where(nzs[i], jnp.int32(i), top)
+        any_nz = any_nz | nzs[i]
+
+    def pick(idx):
+        out = jnp.zeros(n, jnp.uint64)
+        for i in range(3):
+            out = jnp.where(idx == i, words[i], out)
+        return out
+    hiw = pick(top)
+    loww = pick(top - 1)
+    from .binary64 import _clz64
+    lz = _clz64(hiw)
+    lzu = jnp.clip(lz, 0, 63).astype(jnp.uint64)
+    combined = (hiw << lzu) | ((loww >> (jnp.uint64(63) - lzu))
+                               >> jnp.uint64(1))
+    b_msb = jnp.int64(64) * top.astype(jnp.int64) + 63 - lz
+    # f32-biased exponent of the MSB: 2^(b_msb + emax-150-W0) = 2^(e-127)
+    e1 = (b_msb + emax_g.astype(jnp.int64) -
+          jnp.int64(W0 + 23)).astype(jnp.int32)
+    f1 = _pack_f32(combined >> jnp.uint64(40), e1)
+    # second component: next 24 bits, 24 binades down
+    sig2 = (combined >> jnp.uint64(16)) & jnp.uint64(0xFFFFFF)
+    f2 = _pack_f32(sig2, e1 - 24)
+    mag_val = f1.astype(jnp.float64) + f2.astype(jnp.float64)
+    out = jnp.where(total_neg, -mag_val, mag_val)
+    out = jnp.where(any_nz, out, 0.0)
+    out = jnp.where(pinf_cnt > 0, jnp.float64(jnp.inf), out)
+    out = jnp.where(ninf_cnt > 0, jnp.float64(-jnp.inf), out)
+    out = jnp.where((nan_cnt > 0) | ((pinf_cnt > 0) & (ninf_cnt > 0)),
+                    jnp.float64(jnp.nan), out)
+    gi = jnp.arange(n, dtype=jnp.int32)
+    return jnp.where(gi < plan.num_groups, out, 0.0)
+
+
 def seg_count(plan: GroupPlan, validity):
-    cap = validity.shape[0]
     _, ok = _sorted_vals(plan, validity, validity)
-    return jax.ops.segment_sum(ok.astype(jnp.int64), plan.seg_id,
-                               num_segments=cap)
+    return seg_prefix_sum(plan, ok.astype(jnp.int32)).astype(jnp.int64)
 
 
 def seg_count_all(plan: GroupPlan):
-    cap = plan.seg_id.shape[0]
-    return jax.ops.segment_sum(plan.live_sorted.astype(jnp.int64), plan.seg_id,
-                               num_segments=cap)
+    return seg_prefix_sum(
+        plan, plan.live_sorted.astype(jnp.int32)).astype(jnp.int64)
 
 
 def _type_extreme(dtype, want_max: bool):
@@ -79,6 +298,43 @@ def _type_extreme(dtype, want_max: bool):
         return jnp.array(jnp.inf if not want_max else -jnp.inf, dtype)
     info = jnp.iinfo(dtype)
     return jnp.array(info.max if not want_max else info.min, dtype)
+
+
+def seg_minmax_u64(plan: GroupPlan, words, ok, want_max: bool):
+    """Per-group min/max of uint64 order-words WITHOUT a 64-bit scatter:
+    two u32 scatter passes (hi word, then lo word among hi-winners).
+    64-bit scatters are ~5x slower than 32-bit ones on the chip (XLA
+    lowers i64 as 32-bit pairs); this keeps the reduction native."""
+    cap = words.shape[0]
+    w = words.astype(jnp.uint64)
+    if not want_max:
+        w = ~w                               # min == max of complement
+    hi = (w >> jnp.uint64(32)).astype(jnp.uint32)
+    lo = (w & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    z = jnp.uint32(0)
+    mhi = jax.ops.segment_max(jnp.where(ok, hi, z), plan.seg_id,
+                              num_segments=cap)
+    on_hi = ok & (hi == jnp.take(mhi, plan.seg_id))
+    mlo = jax.ops.segment_max(jnp.where(on_hi, lo, z), plan.seg_id,
+                              num_segments=cap)
+    out = (mhi.astype(jnp.uint64) << jnp.uint64(32)) | \
+        mlo.astype(jnp.uint64)
+    if not want_max:
+        out = ~out
+    return out
+
+
+def _seg_minmax_i64(plan, v, ok, want_max: bool):
+    # order-preserving int64 -> uint64 (flip sign bit), two-stage u32
+    w = v.astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+    # masked-off rows contribute the identity via ok in seg_minmax_u64
+    m = seg_minmax_u64(plan, w, ok, want_max)
+    out = (m ^ jnp.uint64(1 << 63)).astype(jnp.int64)
+    # groups with no contributing rows keep the type identity (the
+    # caller masks validity by count anyway)
+    has = seg_prefix_sum(plan, ok.astype(jnp.int32)) > 0
+    ident = _type_extreme(jnp.int64, want_max)
+    return jnp.where(has, out, ident)
 
 
 def seg_min(plan: GroupPlan, values, validity):
@@ -92,9 +348,12 @@ def seg_min(plan: GroupPlan, values, validity):
         nan = jnp.isnan(v)
         contrib = jnp.where(ok & ~nan, v, jnp.array(jnp.inf, v.dtype))
         m = jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
-        has_num = jax.ops.segment_max((ok & ~nan).astype(jnp.int32),
-                                      plan.seg_id, num_segments=cap) > 0
+        has_num = seg_prefix_sum(plan, (ok & ~nan).astype(jnp.int32)) > 0
         return jnp.where(has_num, m, jnp.array(jnp.nan, v.dtype))
+    if v.dtype in (jnp.int64, jnp.uint64):
+        if v.dtype == jnp.uint64:
+            return seg_minmax_u64(plan, v, ok, want_max=False)
+        return _seg_minmax_i64(plan, v, ok, want_max=False)
     ident = _type_extreme(v.dtype, want_max=False)
     contrib = jnp.where(ok, v, ident)
     return jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
@@ -109,9 +368,12 @@ def seg_max(plan: GroupPlan, values, validity):
         nan = jnp.isnan(v)
         contrib = jnp.where(ok & ~nan, v, jnp.array(-jnp.inf, v.dtype))
         m = jax.ops.segment_max(contrib, plan.seg_id, num_segments=cap)
-        has_nan = jax.ops.segment_max((ok & nan).astype(jnp.int32),
-                                      plan.seg_id, num_segments=cap) > 0
+        has_nan = seg_prefix_sum(plan, (ok & nan).astype(jnp.int32)) > 0
         return jnp.where(has_nan, jnp.array(jnp.nan, v.dtype), m)
+    if v.dtype in (jnp.int64, jnp.uint64):
+        if v.dtype == jnp.uint64:
+            return seg_minmax_u64(plan, v, ok, want_max=True)
+        return _seg_minmax_i64(plan, v, ok, want_max=True)
     ident = _type_extreme(v.dtype, want_max=True)
     contrib = jnp.where(ok, v, ident)
     return jax.ops.segment_max(contrib, plan.seg_id, num_segments=cap)
@@ -122,8 +384,8 @@ def seg_first_index(plan: GroupPlan, validity, ignore_nulls: bool = True):
     cap = validity.shape[0]
     ok = jnp.take(validity, plan.perm) & plan.live_sorted if ignore_nulls \
         else plan.live_sorted
-    pos = jnp.arange(cap, dtype=jnp.int64)
-    contrib = jnp.where(ok, pos, jnp.int64(cap))
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    contrib = jnp.where(ok, pos, jnp.int32(cap))
     first_pos = jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
     safe = jnp.clip(first_pos, 0, cap - 1).astype(jnp.int32)
     return jnp.take(plan.perm, safe), first_pos < cap
@@ -147,12 +409,10 @@ def seg_first_index_by_order(plan: GroupPlan, col, want_min: bool = True,
     cand = ok
     for w in words:
         ws = jnp.take(w, plan.perm).astype(jnp.uint64)
-        big = jnp.uint64(0xFFFFFFFFFFFFFFFF)
-        contrib = jnp.where(cand, ws, big)
-        m = jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
+        m = seg_minmax_u64(plan, ws, cand, want_max=False)
         cand = cand & (ws == jnp.take(m, plan.seg_id))
-    pos = jnp.arange(cap, dtype=jnp.int64)
-    contrib = jnp.where(cand, pos, jnp.int64(cap))
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    contrib = jnp.where(cand, pos, jnp.int32(cap))
     first_pos = jax.ops.segment_min(contrib, plan.seg_id, num_segments=cap)
     has = first_pos < cap
     safe = jnp.clip(first_pos, 0, cap - 1).astype(jnp.int32)
@@ -163,8 +423,8 @@ def seg_last_index(plan: GroupPlan, validity, ignore_nulls: bool = True):
     cap = validity.shape[0]
     ok = jnp.take(validity, plan.perm) & plan.live_sorted if ignore_nulls \
         else plan.live_sorted
-    pos = jnp.arange(cap, dtype=jnp.int64)
-    contrib = jnp.where(ok, pos, jnp.int64(-1))
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    contrib = jnp.where(ok, pos, jnp.int32(-1))
     last_pos = jax.ops.segment_max(contrib, plan.seg_id, num_segments=cap)
     safe = jnp.clip(last_pos, 0, cap - 1).astype(jnp.int32)
     return jnp.take(plan.perm, safe), last_pos >= 0

@@ -690,37 +690,184 @@ def host_binary(np_fn, a_bits, b_bits):
 # segmented / scan reductions
 # ---------------------------------------------------------------------------
 
-def segmented_sum(sorted_bits, contrib_mask, seg_id, num_segments: int):
-    """Exact binary64 sum per segment over sorted segment ids.
+# ---------------------------------------------------------------------------
+# segmented sum: windowed integer superaccumulator
+# ---------------------------------------------------------------------------
+# Summing doubles exactly does NOT need a per-element softfloat adder: a
+# double is sig * 2^(e-1075) with a 53-bit integer sig, so a segment's sum
+# is an INTEGER sum in fixed point.  Each segment anchors a 256-bit window
+# at its max exponent; every element decomposes into <=3 signed 32-bit limb
+# contributions (pure shifts/masks), limbs accumulate with per-limb integer
+# prefix sums over the sorted segment order (cumsum is native on the VPU;
+# no 64-bit scatters, no associative_scan with a custom combiner — both
+# are catastrophically slow/slow-to-compile on this backend), and ONE
+# softfloat round-to-nearest-even runs per GROUP at the end.
+#
+# Accuracy: terms more than W0 bits below the segment max exponent fold
+# into the sticky bit.  With NL=8 limbs W0 >= 256-53-log2(n)-2 (capped
+# 191), so the result is the correctly-rounded exact sum unless the
+# segment both spans >W0 bits of exponent range AND cancels its top ~100
+# bits — far beyond f64 summation error in any order, which is the
+# reference's own contract (integration tests compare with ulp tolerance).
 
-    Uses an associative scan with the softfloat adder as the combiner —
-    log2(n) passes of integer ops, the XLA-native way to reduce with a
-    custom monoid.  Summation order within a segment is the sorted order
-    (deterministic; float sums are order-sensitive, which the reference
-    also accepts — integration tests compare with ulp tolerance).
-    """
-    zero = jnp.zeros_like(sorted_bits)
-    vals = jnp.where(contrib_mask, sorted_bits, zero)
-    n = sorted_bits.shape[0]
-    idx = jnp.arange(n, dtype=jnp.int64)
+_SUM_NL = 8          # 256-bit window
+
+
+def _sum_w0(n: int) -> int:
+    # max left-shift position: leave headroom for log2(n) carries above
+    # the top term bit and keep limb index j = W0>>5 <= 5 (c2 lands at 7)
+    return min(191, _SUM_NL * 32 - 53 - max(n, 2).bit_length() - 2)
+
+
+def _derive_bounds(seg_id, contrib_mask):
+    """Group boundary positions from sorted segment ids (fallback when no
+    GroupPlan is available: tests / standalone use)."""
+    n = seg_id.shape[0]
     if n > 1:
-        head = jnp.concatenate([jnp.ones(1, bool), seg_id[1:] != seg_id[:-1]])
+        head = jnp.concatenate([jnp.ones(1, bool),
+                                seg_id[1:] != seg_id[:-1]])
     else:
         head = jnp.ones(1, bool)
+    from .basic import compact_indices
+    head_pos, num_groups = compact_indices(head, n)
+    gi = jnp.arange(n, dtype=jnp.int32)
+    nxt = jnp.concatenate([head_pos[1:].astype(jnp.int32),
+                           jnp.zeros(1, jnp.int32)])
+    last_pos = jnp.where(gi + 1 < num_groups, nxt - 1, jnp.int32(n - 1))
+    return head_pos.astype(jnp.int32), last_pos, num_groups
 
-    def combine(left, right):
-        lv, lf = left
-        rv, rf = right
-        v = jnp.where(rf, rv, add(lv, rv))
-        return v, lf | rf
 
-    scanned, _ = jax.lax.associative_scan(combine, (vals, head))
-    # the last row of each segment holds that segment's total
-    last_idx = jax.ops.segment_max(idx, seg_id, num_segments=num_segments)
-    has = last_idx >= 0                 # empty segments get int-min identity
-    gathered = jnp.take(scanned, jnp.clip(last_idx, 0, n - 1).astype(
-        jnp.int32), mode="clip")
-    return jnp.where(has, gathered, jnp.int64(0))
+def segmented_sum(sorted_bits, contrib_mask, seg_id, num_segments: int,
+                  head_pos=None, last_pos=None, num_groups=None):
+    """Exact binary64 sum per segment over sorted segment ids.
+
+    ``head_pos``/``last_pos``/``num_groups`` are the GroupPlan boundary
+    arrays (kernels/aggregate.groupby_plan); when omitted they are
+    derived from ``seg_id`` (one extra argsort).
+    """
+    n = sorted_bits.shape[0]
+    if head_pos is None:
+        head_pos, last_pos, num_groups = _derive_bounds(seg_id,
+                                                        contrib_mask)
+    W0 = _sum_w0(n)
+    u = _u(sorted_bits)
+    exp_raw = ((u >> _c(52)) & _c(0x7FF)).astype(jnp.int32)
+    mant = u & _c(MANT_MASK)
+    sig = jnp.where(exp_raw > 0, mant | _c(IMPLICIT), mant)
+    e = jnp.maximum(exp_raw, 1)
+    negs = (u & _c(SIGN)) != _c(0)
+    mag = u & _c(MAG_MASK)
+    ok = contrib_mask
+    nan_f = ok & (mag > _c(INF))
+    pinf_f = ok & (u == _c(INF))
+    ninf_f = ok & (u == _c(SIGN | INF))
+    fin_ok = ok & (exp_raw != jnp.int32(2047))
+
+    hp = jnp.clip(head_pos, 0, n - 1)
+    lp = jnp.clip(last_pos, 0, n - 1)
+    gi = jnp.arange(n, dtype=jnp.int32)
+    glive = gi < num_groups
+
+    def group_total(contrib):
+        cum = jnp.cumsum(contrib)
+        ex = cum - contrib
+        total = jnp.take(cum, lp) - jnp.take(ex, hp)
+        return jnp.where(glive, total, jnp.zeros_like(total))
+
+    # group max exponent (i32 scatter-max: 32-bit scatters are native)
+    emax_g = jax.ops.segment_max(jnp.where(fin_ok, e, jnp.int32(0)),
+                                 seg_id, num_segments=n)
+    d = jnp.take(emax_g, seg_id) - e
+    p = jnp.int32(W0) - d
+    # contributions entirely below the window fold into sticky
+    keep = fin_ok & (p > jnp.int32(-53))
+    rs = jnp.clip(-p, 0, 63).astype(jnp.uint64)
+    sig2 = sig >> rs
+    lost_low = fin_ok & ((sig2 << rs) != sig)
+    dropped = fin_ok & (p <= jnp.int32(-53)) & (sig != _c(0))
+    pc = jnp.clip(p, 0, W0)
+    r = (pc & jnp.int32(31)).astype(jnp.uint64)
+    j = pc >> jnp.int32(5)
+    lo = sig2 << r
+    hi = (sig2 >> (_c(63) - r)) >> _c(1)
+    sgn = jnp.where(negs, jnp.int64(-1), jnp.int64(1))
+    zero64 = jnp.int64(0)
+    c0 = jnp.where(keep, (lo & _c(0xFFFFFFFF)).astype(jnp.int64) * sgn,
+                   zero64)
+    c1 = jnp.where(keep, (lo >> _c(32)).astype(jnp.int64) * sgn, zero64)
+    c2 = jnp.where(keep, hi.astype(jnp.int64) * sgn, zero64)
+
+    # per-limb group totals (each limb sum |.| <= n * 2^32 < 2^62: exact)
+    limbs = []
+    for L in range(_SUM_NL):
+        lc = jnp.where(j == L, c0, zero64)
+        if L >= 1:
+            lc = lc + jnp.where(j == L - 1, c1, zero64)
+        if L >= 2:
+            lc = lc + jnp.where(j == L - 2, c2, zero64)
+        limbs.append(group_total(lc))
+    sticky_grp = group_total((lost_low | dropped).astype(jnp.int32)) > 0
+    nan_cnt = group_total(nan_f.astype(jnp.int32))
+    pinf_cnt = group_total(pinf_f.astype(jnp.int32))
+    ninf_cnt = group_total(ninf_f.astype(jnp.int32))
+
+    # ---- per-group finalize (all arrays are group-indexed, length n) ----
+    m32 = jnp.int64(0xFFFFFFFF)
+    carry = jnp.int64(0)
+    lo32s = []
+    for L in range(_SUM_NL):
+        s = limbs[L] + carry
+        lo32 = s & m32
+        carry = (s - lo32) >> jnp.int64(32)
+        lo32s.append(lo32)
+    total_neg = carry < 0
+    # magnitude limbs: conditional two's complement
+    mags = []
+    c = jnp.where(total_neg, jnp.int64(1), jnp.int64(0))
+    for L in range(_SUM_NL):
+        t = jnp.where(total_neg, (~lo32s[L]) & m32, lo32s[L]) + c
+        mags.append((t & m32).astype(jnp.uint64))
+        c = jnp.where(total_neg, t >> jnp.int64(32), jnp.int64(0))
+    # combine to 4 u64 words, find top nonzero word
+    words = [(mags[2 * i + 1] << _c(32)) | mags[2 * i] for i in range(4)]
+    nzs = [w != _c(0) for w in words]
+    top = jnp.zeros(n, jnp.int32)
+    any_nz = jnp.zeros(n, bool)
+    for i in range(4):
+        top = jnp.where(nzs[i], jnp.int32(i), top)
+        any_nz = any_nz | nzs[i]
+
+    def pick(idx):
+        out = jnp.zeros(n, jnp.uint64)
+        for i in range(4):
+            out = jnp.where(idx == i, words[i], out)
+        return out
+    hiw = pick(top)
+    loww = pick(top - 1)                      # top == 0 -> stays zero
+    lz = _clz64(hiw)                          # 0..63 when any_nz
+    lzu = _u(jnp.clip(lz, 0, 63))
+    combined = (hiw << lzu) | ((loww >> (_c(63) - lzu)) >> _c(1))
+    dropped_low = (loww << lzu) != _c(0)
+    lower_nz = jnp.zeros(n, bool)
+    for i in range(4):
+        lower_nz = lower_nz | (nzs[i] & (jnp.int32(i) < top - 1))
+    sticky = dropped_low | lower_nz | sticky_grp | \
+        ((combined & _c(0xFF)) != _c(0))
+    sig57 = (combined >> _c(8)) | jnp.where(sticky, _c(1), _c(0))
+    b_msb = jnp.int64(64) * top.astype(jnp.int64) + 63 - lz
+    e_out = b_msb + emax_g.astype(jnp.int64) - jnp.int64(W0 + 52)
+    out = _round_pack(total_neg, e_out, sig57)
+    out = jnp.where(any_nz, out, jnp.int64(0))
+    # specials: any NaN, or +inf and -inf together -> NaN; else inf wins
+    out = jnp.where(pinf_cnt > 0, jnp.int64(INF), out)
+    out = jnp.where(ninf_cnt > 0, jnp.int64((SIGN | INF) - 2 ** 64), out)
+    out = jnp.where(
+        (nan_cnt > 0) | ((pinf_cnt > 0) & (ninf_cnt > 0)),
+        jnp.int64(QNAN), out)
+    out = jnp.where(glive, out, jnp.int64(0))
+    if n >= num_segments:
+        return out[:num_segments]
+    return jnp.pad(out, (0, num_segments - n))
 
 
 def running_sum(bits, contrib_mask, seg_head):

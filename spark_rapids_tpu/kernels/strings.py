@@ -49,8 +49,16 @@ def needed_key_words(col: StringColumn, num_rows: int) -> int:
     from ..columnar.column import GatheredStringColumn
     if type(col) is GatheredStringColumn and col._mat is None:
         # lazy gather view: bound from the SOURCE without materializing
-        # (view rows are a subset of source rows)
-        return needed_key_words(col.src, col.src.capacity)
+        # (view rows are a subset of source rows).  Prefer the source's
+        # cached live bound over full capacity — stale offsets past a
+        # shrunk source's live rows must not inflate the bucket here
+        # any more than they may in the non-view path below.
+        src = col.src
+        if src.max_bytes is None:
+            cached = getattr(src, "_live_max_bytes", None)
+            if cached is not None:
+                return needed_key_words(src, cached[0])
+        return needed_key_words(src, src.capacity)
     max_len = col.max_bytes
     if max_len is None:
         cached = getattr(col, "_live_max_bytes", None)

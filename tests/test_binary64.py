@@ -213,6 +213,116 @@ def test_segmented_sum():
             (g, got[g], expect)
 
 
+def test_segmented_sum_superaccumulator_exact():
+    """The windowed superaccumulator is the CORRECTLY ROUNDED exact sum
+    (math.fsum) for segments whose exponent spread fits the 256-bit
+    window — including cancellation, subnormal results, and ties."""
+    import math
+    rng = np.random.default_rng(11)
+    cases = []
+    # cancellation: big +x, -x, tiny residue
+    cases.append([1e16, 1.0, -1e16])
+    cases.append([3.0, 1e120, 2.0, -1e120])
+    # subnormal results
+    cases.append([5e-324, 5e-324, 5e-324])
+    cases.append([2.0 ** -1074, -2.0 ** -1073, 2.0 ** -1074])
+    # rounding ties (half-ulp residues)
+    cases.append([2.0 ** 53, 1.0])            # tie -> even (stays 2^53)
+    cases.append([2.0 ** 53, 1.0, 2.0 ** -40])  # sticky breaks the tie
+    cases.append([2.0 ** 53, 3.0])
+    # sub-byte residue below the 57-bit rounding window: the 1.0 lands
+    # in the dropped low byte of `combined` and must reach sticky
+    # (exact sum 2^63 + 1025 -> RNE up to 2^63 + 2048)
+    cases.append([2.0 ** 63, 2.0 ** 10, 1.0])
+    cases.append([2.0 ** 63, -(2.0 ** 10), -1.0])
+    # mixed magnitudes within the window
+    cases.append(list(np.ldexp(rng.standard_normal(50),
+                               rng.integers(-30, 90, 50))))
+    # negatives dominating
+    cases.append(list(-np.ldexp(rng.random(20) + 0.5,
+                                rng.integers(0, 40, 20))))
+    # single elements (incl. subnormal / max finite)
+    cases.append([5e-324])
+    cases.append([-1.7976931348623157e308])
+    # overflow to inf
+    cases.append([1.7976931348623157e308, 1.7976931348623157e308])
+    # specials
+    cases.append([np.inf, 1.0])
+    cases.append([-np.inf, 1e300])
+    cases.append([np.inf, -np.inf])
+    cases.append([np.nan, 1.0])
+    cases.append([0.0, -0.0])
+    cases.append([-0.0, -0.0])
+    vals, seg = [], []
+    for g, c in enumerate(cases):
+        vals.extend(c)
+        seg.extend([g] * len(c))
+    vals = np.array(vals, np.float64)
+    seg = np.array(seg, np.int32)
+    n = len(vals)
+    got = _floats(b64.segmented_sum(
+        _bits(vals), jnp.ones(n, bool), jnp.asarray(seg), n))[:len(cases)]
+    for g, c in enumerate(cases):
+        finite = all(np.isfinite(v) for v in c)
+        if finite:
+            try:
+                expect = math.fsum(c)
+            except OverflowError:
+                expect = math.inf if sum(c) > 0 else -math.inf
+            if abs(expect) > 1.7976931348623157e308:
+                expect = math.inf if expect > 0 else -math.inf
+            # window contract: fsum-exact when the segment's exponent
+            # spread fits the window; beyond it, error is bounded by
+            # max|v| * 2^-100 (better than f64 summation in ANY order)
+            amax = max(abs(v) for v in c)
+            spread = (math.frexp(amax)[1] -
+                      min(math.frexp(v)[1] for v in c if v != 0.0)) \
+                if amax > 0 else 0
+            if spread > 150:
+                assert abs(got[g] - expect) <= amax * 2.0 ** -100, \
+                    (g, c, float(got[g]), expect)
+                continue
+            if expect == 0.0:
+                assert got[g] == 0.0, (g, c, got[g])
+                continue
+            gb = np.float64(got[g]).view(np.int64)
+            eb = np.float64(expect).view(np.int64)
+            assert gb == eb, (g, c, float(got[g]), expect)
+        else:
+            expect = np.sum(np.array(c))
+            if np.isnan(expect):
+                assert np.isnan(got[g]), (g, c, got[g])
+            else:
+                assert got[g] == expect, (g, c, got[g], expect)
+
+
+def test_segmented_sum_matches_plan_bounds():
+    """Plan-provided boundary arrays give the same result as derived."""
+    rng = np.random.default_rng(13)
+    n = 512
+    vals = np.ldexp(rng.standard_normal(n), rng.integers(-40, 40, n))
+    seg = np.sort(rng.integers(0, 23, n)).astype(np.int32)
+    mask = rng.random(n) > 0.15
+    base = _floats(b64.segmented_sum(
+        _bits(vals), jnp.asarray(mask), jnp.asarray(seg), n))
+    # boundary arrays computed host-side
+    head = np.zeros(n, bool)
+    head[0] = True
+    head[1:] = seg[1:] != seg[:-1]
+    hp = np.nonzero(head)[0]
+    ng = len(hp)
+    head_pos = np.zeros(n, np.int32)
+    head_pos[:ng] = hp
+    last_pos = np.zeros(n, np.int32)
+    last_pos[:ng - 1] = hp[1:] - 1
+    last_pos[ng - 1] = n - 1
+    withp = _floats(b64.segmented_sum(
+        _bits(vals), jnp.asarray(mask), jnp.asarray(seg), n,
+        head_pos=jnp.asarray(head_pos), last_pos=jnp.asarray(last_pos),
+        num_groups=jnp.asarray(ng)))
+    _assert_bits_equal(withp[:ng], base[:ng], "plan-vs-derived bounds")
+
+
 def test_running_sum():
     rng = np.random.default_rng(4)
     n = 128
