@@ -1,0 +1,76 @@
+"""Bisect the ws core: which aggregate combination costs 1.2s at 1M."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.kernels import canon, aggregate as agg_k
+from spark_rapids_tpu.config import TpuConf, set_active
+set_active(TpuConf({}))
+
+N = 1 << 20
+G = 1000
+rng = np.random.default_rng(0)
+kd = jnp.asarray(rng.integers(0, G, N).astype(np.int64))
+xd = jnp.asarray(rng.random(N))
+yd = jnp.asarray(rng.random(N))
+ad = jnp.asarray(rng.integers(-100000, 100000, N).astype(np.int64))
+valid = jnp.ones(N, bool)
+nrows = jnp.int32(N)
+
+def force(v):
+    return float(jnp.sum(v).item())
+
+def bench(name, fn, *args, reps=3):
+    f = jax.jit(fn)
+    t0 = time.perf_counter(); force(f(*args))
+    tc = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+    force(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name}: {dt*1e3:.0f} ms (c {tc:.0f}s)", flush=True)
+
+def preplan(kd, xd, yd, ad):
+    # filter + project like bench: live = x>0.1 & a%7!=0; z = x*y+a
+    live = (xd > 0.1) & (ad % 7 != 0)
+    z = xd * yd + ad.astype(jnp.float64)
+    kcol = [Column(T.INT64, kd, valid & live)]
+    words = canon.batch_key_words(kcol, nrows)
+    plan = agg_k.groupby_plan(words)
+    return plan, z, live
+
+def out16(plan, arr):
+    take = jnp.where(jnp.arange(1 << 16) < plan.num_groups,
+                     jnp.arange(1 << 16), 0)
+    return jnp.take(arr, take).astype(jnp.float32)
+
+bench("A plan only", lambda *a: out16(preplan(*a)[0],
+      preplan(*a)[0].seg_id.astype(jnp.float32)), kd, xd, yd, ad)
+
+def vB(kd, xd, yd, ad):
+    plan, z, live = preplan(kd, xd, yd, ad)
+    c = agg_k.seg_count(plan, valid & live)
+    return out16(plan, c.astype(jnp.float32))
+bench("B plan+count", vB, kd, xd, yd, ad)
+
+def vC(kd, xd, yd, ad):
+    plan, z, live = preplan(kd, xd, yd, ad)
+    s = agg_k.seg_sum(plan, z, valid & live, out_dtype=jnp.float64)
+    return out16(plan, s.astype(jnp.float32))
+bench("C plan+pairsum", vC, kd, xd, yd, ad)
+
+def vD(kd, xd, yd, ad):
+    plan, z, live = preplan(kd, xd, yd, ad)
+    v, ok = agg_k._sorted_vals(plan, z, valid & live)
+    contrib = jnp.where(ok, v, 0.0)
+    s = jax.ops.segment_sum(contrib, plan.seg_id, num_segments=N)
+    return out16(plan, s.astype(jnp.float32))
+bench("D plan+scatter-f64-sum", vD, kd, xd, yd, ad)
+
+def vE(kd, xd, yd, ad):
+    plan, z, live = preplan(kd, xd, yd, ad)
+    m = agg_k.seg_max(plan, xd, valid & live)
+    return out16(plan, m.astype(jnp.float32))
+bench("E plan+f64max", vE, kd, xd, yd, ad)

@@ -1,0 +1,55 @@
+"""Grab the real ws agg core + args, time it standalone."""
+import time, sys
+import jax, jax.numpy as jnp
+import numpy as np
+from bench import build_df
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec import tpu_aggregate as TA
+
+captured = {}
+orig = TA.TpuHashAggregate._fused_whole_stage_core
+def spy(self, batch, emit_buffers=True, out_cap=None):
+    r = orig(self, batch, emit_buffers, out_cap)
+    if r is not None and "args" not in captured:
+        captured["args"] = (tuple(c.data for c in batch.columns),
+                            tuple(c.validity for c in batch.columns),
+                            batch.rows_dev)
+        captured["self"] = self
+        captured["emit"] = emit_buffers
+        captured["out_cap"] = out_cap
+    return r
+TA.TpuHashAggregate._fused_whole_stage_core = spy
+
+s = TpuSession(TpuConf({
+    "spark.rapids.tpu.sql.enabled": True,
+    "spark.rapids.tpu.sql.batchSizeRows": 1 << 22,
+    "spark.rapids.tpu.sql.reader.batchSizeRows": 1 << 22,
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": False,
+}))
+df = build_df(s, 4_000_000, 4)
+df.to_arrow()
+print("pipeline warm; core captured:", "args" in captured, flush=True)
+
+# find the cached jitted core
+self = captured["self"]
+mkey = [k for k in self._ws_memo if isinstance(k, tuple) and k and k[0] != "fpo" and k != ("tprep",)]
+core = None
+for k, v in TA.TpuHashAggregate._CORE_CACHE.items():
+    if v not in (None, False) and isinstance(k, tuple) and k and k[0] == "ws":
+        core = v; ck = k
+if core is None:
+    print("no ws core found", list(TA.TpuHashAggregate._CORE_CACHE.keys())[:5])
+    sys.exit(1)
+datas, valids, nrows = captured["args"]
+
+def force(out):
+    ng, fit, pairs = out
+    return float(jnp.sum(pairs[0][0].astype(jnp.float32)).item())
+
+t0 = time.perf_counter(); force(core(datas, valids, nrows))
+print(f"core 1st {time.perf_counter()-t0:.2f}s", flush=True)
+for i in range(3):
+    t0 = time.perf_counter()
+    force(core(datas, valids, nrows))
+    print(f"core run {time.perf_counter()-t0:.2f}s", flush=True)

@@ -1,0 +1,17 @@
+import cProfile, pstats, sys, time
+from bench import build_df
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.config import TpuConf
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 4_000_000
+s = TpuSession(TpuConf({
+    "spark.rapids.tpu.sql.enabled": True,
+    "spark.rapids.tpu.sql.batchSizeRows": 1 << 22,
+    "spark.rapids.tpu.sql.reader.batchSizeRows": 1 << 22,
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": False,
+}))
+df = build_df(s, n, 4)
+t0 = time.perf_counter(); df.to_arrow()
+print(f"first {time.perf_counter()-t0:.1f}s", flush=True)
+for i in range(3):
+    t0 = time.perf_counter(); out = df.to_arrow()
+    print(f"warm{i} {time.perf_counter()-t0:.1f}s rows={out.num_rows}", flush=True)

@@ -1,0 +1,48 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+N = 1 << 22
+rng = np.random.default_rng(0)
+b = jnp.asarray(rng.integers(0, 4096, N).astype(np.int32))
+blk = jnp.asarray((np.arange(N) >> 13).astype(np.int32))
+b2 = b * jnp.int32(512) + blk
+
+def force(v): return float(jnp.sum(v).item())
+
+def bench(name, fn, *args, reps=3):
+    f = jax.jit(fn)
+    t0 = time.perf_counter(); force(f(*args)); tc = time.perf_counter()-t0
+    t0 = time.perf_counter()
+    for _ in range(reps): out = f(*args)
+    force(out)
+    print(f"{name}: {(time.perf_counter()-t0)/reps*1e3:.0f} ms (c {tc:.0f}s)",
+          flush=True)
+
+for k in (1, 4, 13):
+    x = jnp.asarray(rng.random((N, k)).astype(np.float32))
+    bench(f"f32 scatter {k}-col 4097 segs",
+          lambda x, b: jnp.sum(jax.ops.segment_sum(x, b,
+              num_segments=4097), axis=0), x, b)
+
+x13 = jnp.asarray(rng.random((N, 13)).astype(np.float32))
+bench("f32 scatter 13-col 2.1M segs",
+      lambda x, s: jnp.sum(jax.ops.segment_sum(x, s,
+          num_segments=4097*512), axis=0), x13, b2)
+
+e = jnp.asarray(rng.integers(0, 254, N).astype(np.int32))
+bench("i32 scatter-max 4097 segs",
+      lambda e, b: jnp.sum(jax.ops.segment_max(e, b, num_segments=4097)),
+      e, b)
+
+xf64 = jnp.asarray(rng.random(N))
+bench("f64emul scatter 1-col 4097 segs",
+      lambda x, b: jnp.sum(jax.ops.segment_sum(x, b,
+          num_segments=4097)), xf64, b)
+bench("f64emul scatter-max 4097 segs",
+      lambda x, b: jnp.sum(jax.ops.segment_max(x, b,
+          num_segments=4097)), xf64, b)
+u = jnp.asarray(rng.integers(0, 2**32, N, dtype=np.uint64).astype(np.uint32))
+bench("u32 scatter-max 4097 segs",
+      lambda x, b: jnp.sum(jax.ops.segment_max(x, b,
+          num_segments=4097).astype(jnp.int64)), u, b)

@@ -1,0 +1,55 @@
+"""Bisect the table core by desc set (real _build_table_core)."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.config import TpuConf, set_active
+from spark_rapids_tpu.columnar.schema import Field, Schema
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.exec.tpu_aggregate import TpuHashAggregate
+from spark_rapids_tpu.expr import core as ec, aggregates as ea
+from spark_rapids_tpu.plan.logical import AggExpr
+
+set_active(TpuConf({}))
+N = 1 << 22
+rng = np.random.default_rng(0)
+kd = jnp.asarray(rng.integers(0, 1000, N).astype(np.int64))
+xd = jnp.asarray(rng.random(N))
+vl = jnp.ones(N, bool)
+nrows = jnp.int32(N)
+schema = Schema([Field("k", T.INT64, True), Field("x", T.FLOAT64, True)])
+datas = (kd, xd)
+valids = (vl, vl)
+
+def mk(aggfn):
+    h = TpuHashAggregate.__new__(TpuHashAggregate)
+    h.group_exprs = [ec.BoundReference(0, T.INT64, "k")]
+    h.pre_ops = None
+    h._ws_memo = {}
+    from spark_rapids_tpu.api.functions import col
+    bound = ec.BoundReference(1, T.FLOAT64, "x")
+    h.aggs = [AggExpr(aggfn(bound), "a")]
+    return h
+
+def force(out):
+    fit, ng, kp, bg = out
+    return float(jnp.sum(kp[0][0].astype(jnp.float32)).item())
+
+def bench(name, descs, aggfn, reps=3):
+    h = mk(aggfn)
+    bound = [ec.BoundReference(1, T.FLOAT64, "x")]
+    core = jax.jit(h._build_table_core(
+        schema, h.group_exprs, [bound], descs, 4096))
+    t0 = time.perf_counter(); force(core(datas, valids, nrows))
+    tc = time.perf_counter()-t0
+    t0 = time.perf_counter()
+    for _ in range(reps): out = core(datas, valids, nrows)
+    force(out)
+    print(f"{name}: {(time.perf_counter()-t0)/reps*1e3:.0f} ms (c {tc:.0f}s)",
+          flush=True)
+
+bench("count only", [("count",)], lambda b: ea.Count(b))
+bench("fsum (f32)", [("fsum",)], lambda b: ea.Sum(b))
+bench("fsum64", [("fsum64",)], lambda b: ea.Sum(b))
+bench("fminmax64", [("fminmax64", True)], lambda b: ea.Max(b))
+bench("fminmax f32", [("fminmax", True)], lambda b: ea.Max(b))

@@ -1,0 +1,44 @@
+"""Time the compiled table core: exact vs variable conf."""
+import time, sys
+import jax, jax.numpy as jnp
+from bench import build_df
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec import tpu_aggregate as TA
+
+variable = len(sys.argv) > 1 and sys.argv[1] == "var"
+captured = {}
+orig = TA.TpuHashAggregate._fused_table_core
+def spy(self, batch):
+    r = orig(self, batch)
+    if r is not None and "args" not in captured:
+        captured["args"] = (tuple(c.data for c in batch.columns),
+                            tuple(c.validity for c in batch.columns),
+                            batch.rows_dev)
+    return r
+TA.TpuHashAggregate._fused_table_core = spy
+
+s = TpuSession(TpuConf({
+    "spark.rapids.tpu.sql.enabled": True,
+    "spark.rapids.tpu.sql.batchSizeRows": 1 << 22,
+    "spark.rapids.tpu.sql.reader.batchSizeRows": 1 << 22,
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": variable,
+}))
+df = build_df(s, 4_000_000, 1)
+df.to_arrow()
+print("captured:", "args" in captured, flush=True)
+core = None
+for k, v in TA.TpuHashAggregate._CORE_CACHE.items():
+    if v not in (None, False) and isinstance(k, tuple) and k and \
+            isinstance(k[0], tuple) and k[0] and k[0][0] == "table":
+        core = v
+datas, valids, nrows = captured["args"]
+def force(out):
+    fit, ng, kp, bg = out
+    return float(jnp.sum(kp[0][0].astype(jnp.float32)).item())
+force(core(datas, valids, nrows))
+for i in range(3):
+    t0 = time.perf_counter()
+    force(core(datas, valids, nrows))
+    print(f"table core ({'var' if variable else 'exact'}) "
+          f"{time.perf_counter()-t0:.2f}s", flush=True)

@@ -232,6 +232,14 @@ AGG_TABLE_SIZE = conf_int(
 AGG_TABLE_ENABLED = conf_bool(
     "spark.rapids.tpu.sql.agg.tablePath.enabled", True,
     "Enable the sort-free bucket-table aggregation fast path")
+AGG_PAIR_SUM = conf_bool(
+    "spark.rapids.tpu.sql.agg.pairSum.enabled", False,
+    "Accumulate FLOAT64 sort-path sums with the f32-pair integer "
+    "superaccumulator (kernels/aggregate._seg_sum_f64_pair): "
+    "deterministic, order-independent, correctly rounded to the "
+    "device's 48-bit pair representation.  ~4x slower than the default "
+    "f64-emulated scatter-add on the chip's emulated 64-bit integer "
+    "ALU; enable when reduction determinism matters more than speed.")
 AGG_COMPACT_ROWS = conf_int(
     "spark.rapids.tpu.sql.agg.speculativeCompactRows", 1 << 16,
     "Sort-path group-by outputs are speculatively compacted on device "

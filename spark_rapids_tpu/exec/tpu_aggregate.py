@@ -56,6 +56,93 @@ def _assemble_group_output(plan, key_cols, aggs, agg_buffers, out_cap: int,
     return ng, outs
 
 
+# -- 32-bit device helpers for exact-float table aggregation ----------------
+# The chip's 64-bit scatters cost ~5x 32-bit ones, so exact FLOAT64 table
+# aggregation works entirely in 32-bit lanes: a value's two native f32
+# components decompose into signed 8-bit integer chunks (sums) or flip-
+# ordered u32 words (min/max).
+
+CH_B = 8          # bits per chunk lane
+CH_LANES = 15     # window = 120 bits
+CH_W0 = 88        # max chunk position (top term bit 88+23 < 120)
+
+
+def _flip32(f):
+    """f32 -> u32 whose unsigned order equals the float total order
+    (-0.0 handled by callers; NaNs must be masked out)."""
+    import jax
+    u = jax.lax.bitcast_convert_type(f, jnp.uint32)
+    neg = (u >> jnp.uint32(31)) != jnp.uint32(0)
+    return jnp.where(neg, ~u, u | jnp.uint32(0x80000000))
+
+
+def _unflip32(w):
+    import jax
+    neg = (w & jnp.uint32(0x80000000)) == jnp.uint32(0)
+    u = jnp.where(neg, ~w, w & jnp.uint32(0x7FFFFFFF))
+    return jax.lax.bitcast_convert_type(u, jnp.float32)
+
+
+def _pow2f(k):
+    """2^k as f32 from a traced i32 scalar, k in [-126, 127]."""
+    import jax
+    return jax.lax.bitcast_convert_type(
+        ((k + 127).astype(jnp.uint32) << jnp.uint32(23)), jnp.float32)
+
+
+def _f32_exp(f):
+    """(biased exponent clamped >=1, 24-bit significand, negative) of an
+    f32 array."""
+    import jax
+    u = jax.lax.bitcast_convert_type(f, jnp.uint32)
+    neg = (u >> jnp.uint32(31)) != jnp.uint32(0)
+    e = ((u >> jnp.uint32(23)) & jnp.uint32(0xFF)).astype(jnp.int32)
+    m = u & jnp.uint32(0x7FFFFF)
+    sig = jnp.where(e > 0, m | jnp.uint32(1 << 23), m)
+    return jnp.maximum(e, 1), sig, neg
+
+
+def _part_chunk_rows(f, ok, emax):
+    """One f32 component -> CH_LANES signed i32 chunk rows on the
+    window anchored at ``emax`` (value = sig * 2^(e-150); window bit 0
+    weighs 2^(emax-150-CH_W0)).  Exact for terms within the window;
+    the caller's fit flag excludes batches with wider spread."""
+    ee, sig, neg = _f32_exp(f)
+    p = jnp.int32(CH_W0) - (emax - ee)
+    keep = ok & (p >= 0) & (sig != jnp.uint32(0))
+    off = (jnp.maximum(p, 0) & jnp.int32(7)).astype(jnp.uint32)
+    q = jnp.maximum(p, 0) >> jnp.int32(3)
+    l32 = sig << off                       # <= 2^31: stays in u32
+    sgn = jnp.where(neg, jnp.int32(-1), jnp.int32(1))
+    z = jnp.int32(0)
+    cks = [jnp.where(keep, ((l32 >> jnp.uint32(CH_B * k)) &
+                            jnp.uint32(0xFF)).astype(jnp.int32) * sgn, z)
+           for k in range(4)]
+    rows = []
+    qmax = CH_W0 >> 3
+    for L in range(CH_LANES):
+        r = z
+        for k in range(4):
+            if 0 <= L - k <= qmax:
+                r = r + jnp.where(q == L - k, cks[k], z)
+        rows.append(r)
+    return rows
+
+
+def _chunk_recombine(lanes_f64, emax):
+    """[table, CH_LANES] per-bucket lane sums (as f64) + batch emax
+    -> per-bucket f64 totals.  Scale split into two in-range f32
+    powers of two."""
+    out = jnp.zeros(lanes_f64.shape[0], jnp.float64)
+    for L in range(CH_LANES):
+        k = jnp.int32(CH_B * L) + emax - jnp.int32(CH_W0 + 150)
+        k1 = k // 2
+        s1 = _pow2f(k1).astype(jnp.float64)
+        s2 = _pow2f(k - k1).astype(jnp.float64)
+        out = out + (lanes_f64[:, L] * s1) * s2
+    return out
+
+
 def buffer_schema(group_exprs, aggs: List[AggExpr]) -> Schema:
     """Schema of partial-aggregation output: keys + flattened buffers."""
     fields = [Field(ec.output_name(e), e.dtype(), True) for e in group_exprs]
@@ -258,7 +345,9 @@ class TpuHashAggregate(TpuExec):
         in_dts = tuple(tuple(None if c is None else c.dtype for c in cols)
                        for cols in input_cols)
         aggs = self.aggs
+        from ..kernels.aggregate import _pair_sum_enabled
         cache_key = (update_mode, emit_buffers, key_dts, in_dts, out_cap,
+                     _pair_sum_enabled(),
                      tuple((type(a.func).__name__, repr(a.func),
                             getattr(a.func, "ignore_nulls", None))
                            for a in aggs))
@@ -359,21 +448,24 @@ class TpuHashAggregate(TpuExec):
             if isinstance(f, ea.Count):
                 descs.append(("count",))
             elif isinstance(f, ea.Sum):
-                if cdt is None or not cdt.is_fractional or not fast_float:
+                if cdt is None or not cdt.is_fractional:
                     return False    # exact int/decimal sums: sort path
-                descs.append(("fsum",))
+                # exact (default) float mode: accumulate the row in the
+                # device's full f64 representation — a 64-bit scatter
+                # lane beside the f32 reduce rows, no f32 narrowing,
+                # no overflow fit constraint
+                descs.append(("fsum",) if fast_float else ("fsum64",))
             elif isinstance(f, ea.Average):
-                if not fast_float:
+                if cdt is None or not cdt.is_fractional:
                     return False
-                descs.append(("avg",))
+                descs.append(("avg",) if fast_float else ("favg64",))
             elif isinstance(f, (ea.Min, ea.Max)):
                 want_max = isinstance(f, ea.Max)
                 if cdt == T.FLOAT32:
                     descs.append(("fminmax", want_max))
                 elif cdt is not None and cdt.is_fractional:
-                    if not fast_float:
-                        return False
-                    descs.append(("fminmax", want_max))
+                    descs.append(("fminmax", want_max) if fast_float
+                                 else ("fminmax64", want_max))
                 elif cdt is not None and self._table_key_ok(cdt):
                     descs.append(("iminmax", want_max))
                 else:
@@ -429,6 +521,11 @@ class TpuHashAggregate(TpuExec):
         if prep is False:
             return None
         cache_key, bound_keys, bound_inputs, descs = prep
+        # i32 chunk-lane sums are exact only while a bucket's lane sum
+        # stays under 2^31: |hr+lr| <= 510/row/lane -> max 2^22 rows
+        if batch.capacity > (1 << 22) and \
+                any(d[0] in ("fsum64", "favg64") for d in descs):
+            return None
         core = TpuHashAggregate._CORE_CACHE.get((cache_key, table))
         if core is False:
             return None
@@ -468,12 +565,15 @@ class TpuHashAggregate(TpuExec):
         One pass: mixed-radix bucket ids (kernels/aggregate.table_bucket),
         then a SINGLE fused Pallas table-reduce (pallas_ops.table_reduce)
         covering every sum/count row (MXU one-hot dots) and every min/max
-        row (VPU masked reductions; mins ride negated).  All reduce rows
-        are f32; integer min/max and first/last positions are exact
+        row (VPU masked reductions; mins ride negated).  Exact float mode
+        adds 64-bit lanes (fsum64/favg64/fminmax64) reduced by direct
+        small-output scatters in the device's full f64 representation.
+        All f32 reduce rows; integer min/max and first/last positions are exact
         because the fit flag restricts them to the f32-exact integer
         range (2^24) — non-fitting batches re-run on the sort path."""
         import jax.numpy as jnp
         from ..config import get_active, AGG_TABLE_REDUCE_IMPL
+        import jax
         from ..kernels.pallas_ops import table_reduce
         from .fused import _TracedBatch
         reduce_impl = get_active().get(AGG_TABLE_REDUCE_IMPL)
@@ -519,9 +619,27 @@ class TpuHashAggregate(TpuExec):
                      for bs in bound_inputs]
             live_f = jnp.where(live, 1.0, 0.0).astype(jnp.float32)
 
-            # collect every reduce row for the ONE fused table-reduce
+            # collect every reduce row for the ONE fused table-reduce.
+            # Shared rows (counts, chunk decompositions) are keyed by the
+            # bound input expression, so sum(x)+avg(x)+min(x) share one
+            # count row and one 15-lane chunk decomposition.
             sum_rows, max_rows = [jnp.asarray(live_f)], []
             srow_of, mrow_of = {"__ones__": 0}, {}
+            dks = [repr(bs[0]) if bs else ("*", i)
+                   for i, bs in enumerate(bound_inputs)]
+            chunk_of = {}            # dk -> (lane0, emax)
+            # exact float mode, ALL 32-bit (64-bit scatters cost ~5x):
+            # - sums: each f64 value splits into its two f32 components,
+            #   each component into signed 8-bit integer chunks on a
+            #   120-bit window anchored at the column's batch max
+            #   exponent; the i32 chunk lanes ride ONE stacked i32
+            #   scatter (exact: lane sums < 2^31), recombined per
+            #   bucket in the output phase.  A fit flag sends batches
+            #   with >2^63 exponent spread to the sort path.
+            # - min/max: two-stage u32 scatter-max over the (hi, lo)
+            #   pair order-words.
+            chunk_rows = []                # i32 lanes, one scatter
+            mm_hi_rows, mm_lo_src = [], []  # two-stage u32 minmax
             agg_meta = []   # per agg: lowering info for the output phase
 
             def add_sum(tag, arr):
@@ -535,10 +653,11 @@ class TpuHashAggregate(TpuExec):
 
             for ai, (a, cols_a) in enumerate(zip(self.aggs, icols)):
                 kind = descs[ai][0]
+                dk = dks[ai]
                 c = cols_a[0]
                 if kind == "count":
                     if c is not None:
-                        add_sum(("cnt", ai),
+                        add_sum(("cnt", dk),
                                 jnp.where(live & c.validity, 1.0, 0.0)
                                 .astype(jnp.float32))
                     agg_meta.append(None)
@@ -547,10 +666,78 @@ class TpuHashAggregate(TpuExec):
                     v32 = c.data.astype(jnp.float32)
                     fit = fit & jnp.all(
                         jnp.where(ok, jnp.isfinite(v32), True))
-                    add_sum(("sum", ai), jnp.where(ok, v32, 0.0))
-                    add_sum(("cnt", ai),
+                    add_sum(("sum", dk), jnp.where(ok, v32, 0.0))
+                    add_sum(("cnt", dk),
                             jnp.where(ok, 1.0, 0.0).astype(jnp.float32))
                     agg_meta.append(None)
+                elif kind in ("fsum64", "favg64"):
+                    ok = live & c.validity
+                    v = c.data.astype(jnp.float64)
+                    fin = jnp.isfinite(v)
+                    okf = ok & fin
+                    add_sum(("cnt", dk),
+                            jnp.where(ok, 1.0, 0.0).astype(jnp.float32))
+                    add_sum(("nan", dk),
+                            jnp.where(ok & jnp.isnan(v), 1.0, 0.0)
+                            .astype(jnp.float32))
+                    add_sum(("pinf", dk),
+                            jnp.where(ok & jnp.isposinf(v), 1.0, 0.0)
+                            .astype(jnp.float32))
+                    add_sum(("ninf", dk),
+                            jnp.where(ok & jnp.isneginf(v), 1.0, 0.0)
+                            .astype(jnp.float32))
+                    vq = jnp.where(okf, v, 0.0)
+                    hi32 = vq.astype(jnp.float32)
+                    # finite f64 beyond f32 range: hi overflows to inf
+                    # and the chunk lattice cannot hold it (same
+                    # contract as the fminmax f32 path below)
+                    fit = fit & jnp.all(
+                        jnp.where(okf, jnp.isfinite(hi32), True))
+                    lo32 = (vq - hi32.astype(jnp.float64)) \
+                        .astype(jnp.float32)
+                    ehi, sighi, _ = _f32_exp(hi32)
+                    contrib = okf & (sighi != jnp.uint32(0))
+                    emax = jnp.max(jnp.where(contrib, ehi, jnp.int32(0)))
+                    emin = jnp.min(jnp.where(contrib, ehi,
+                                             jnp.int32(255)))
+                    # spread beyond the window -> exact sort path
+                    fit = fit & ((emax - emin) <= jnp.int32(CH_W0 - 25))
+                    hrows = _part_chunk_rows(hi32, contrib, emax)
+                    lrows = _part_chunk_rows(lo32, okf, emax)
+                    lane0 = len(chunk_rows)
+                    for hr, lr in zip(hrows, lrows):
+                        chunk_rows.append(hr + lr)
+                    agg_meta.append(("chunks", lane0, emax))
+                elif kind == "fminmax64":
+                    want_max = descs[ai][1]
+                    ok = live & c.validity
+                    v = c.data.astype(jnp.float64)
+                    # Spark total order: NaN greatest, -0.0 == 0.0
+                    v = jnp.where(v == 0.0, jnp.float64(0.0), v)
+                    nan = jnp.isnan(v)
+                    okn = ok & ~nan
+                    add_sum(("cnt", dk),
+                            jnp.where(ok, 1.0, 0.0).astype(jnp.float32))
+                    add_sum(("nn", dk),
+                            jnp.where(okn, 1.0, 0.0).astype(jnp.float32))
+                    hi32 = v.astype(jnp.float32)
+                    # finite f64 beyond f32 range would alias real inf
+                    fit = fit & jnp.all(
+                        jnp.where(ok & jnp.isfinite(v),
+                                  jnp.isfinite(hi32), True))
+                    # +/-inf: hi carries the order; v-hi is NaN -> 0
+                    lo32 = jnp.where(
+                        jnp.isfinite(v),
+                        (v - hi32.astype(jnp.float64)), 0.0) \
+                        .astype(jnp.float32)
+                    whi = _flip32(hi32)
+                    if not want_max:
+                        whi = ~whi
+                    whi = jnp.where(okn, whi, jnp.uint32(0))
+                    mi = len(mm_hi_rows)
+                    mm_hi_rows.append(whi)
+                    mm_lo_src.append((lo32, okn, want_max))
+                    agg_meta.append(("mm", mi))
                 elif kind == "fminmax":
                     want_max = descs[ai][1]
                     ok = live & c.validity
@@ -565,9 +752,9 @@ class TpuHashAggregate(TpuExec):
                     # Spark total order: NaN greatest, -0.0 == 0.0
                     v32 = jnp.where(v32 == 0.0, jnp.float32(0.0), v32)
                     nan = jnp.isnan(v32)
-                    add_sum(("cnt", ai),
+                    add_sum(("cnt", dk),
                             jnp.where(ok, 1.0, 0.0).astype(jnp.float32))
-                    add_sum(("nn", ai),
+                    add_sum(("nn", dk),
                             jnp.where(ok & ~nan, 1.0, 0.0)
                             .astype(jnp.float32))
                     add_max(("m", ai),
@@ -590,7 +777,7 @@ class TpuHashAggregate(TpuExec):
                     fit = fit & ((vmax - vmin) < F32_EXACT)
                     narrow = jnp.minimum(w - vmin, F32_EXACT) \
                         .astype(jnp.float32)
-                    add_sum(("cnt", ai),
+                    add_sum(("cnt", dk),
                             jnp.where(ok, 1.0, 0.0).astype(jnp.float32))
                     add_max(("m", ai),
                             jnp.where(ok, narrow if want_max else -narrow,
@@ -608,6 +795,30 @@ class TpuHashAggregate(TpuExec):
 
             sums, maxs = table_reduce(bucket, sum_rows, max_rows, table,
                                       impl=reduce_impl)
+            # i32 chunk lanes: ONE stacked scatter (multi-column scatter
+            # costs the same as single-column; lane sums < 2^31, exact)
+            chunk_out = None
+            if chunk_rows:
+                chunk_out = jax.ops.segment_sum(
+                    jnp.stack(chunk_rows, 1), bucket,
+                    num_segments=table + 1)[:table]
+            # two-stage u32 min/max: hi words, then lo among hi-winners
+            mm1 = mm2 = None
+            if mm_hi_rows:
+                mm1 = jax.ops.segment_max(
+                    jnp.stack(mm_hi_rows, 1), bucket,
+                    num_segments=table + 1)
+                lo_rows = []
+                for i, (lo32, okn, wmax) in enumerate(mm_lo_src):
+                    win = okn & (mm_hi_rows[i] ==
+                                 jnp.take(mm1[:, i], bucket))
+                    wlo = _flip32(lo32)
+                    if not wmax:
+                        wlo = ~wlo
+                    lo_rows.append(jnp.where(win, wlo, jnp.uint32(0)))
+                mm2 = jax.ops.segment_max(
+                    jnp.stack(lo_rows, 1), bucket,
+                    num_segments=table + 1)
             counts_all = sums[0]
             present, order, ng = agg_k.table_compact(counts_all, table)
             live_g = jnp.arange(table) < ng
@@ -632,34 +843,77 @@ class TpuHashAggregate(TpuExec):
             buf_groups = []
             for ai, (a, cols_a) in enumerate(zip(self.aggs, icols)):
                 kind = descs[ai][0]
+                dk = dks[ai]
                 c = cols_a[0]
                 if kind == "count":
-                    cnt = sums[srow_of[("cnt", ai)] if c is not None
+                    cnt = sums[srow_of[("cnt", dk)] if c is not None
                                else 0]
                     cnt = compact(cnt)
                     buf_groups.append([(
                         jnp.where(live_g, cnt, 0.0).astype(jnp.int64),
                         jnp.ones(table, bool))])
                 elif kind == "fsum":
-                    ssum = compact(sums[srow_of[("sum", ai)]])
-                    cntv = compact(sums[srow_of[("cnt", ai)]])
+                    ssum = compact(sums[srow_of[("sum", dk)]])
+                    cntv = compact(sums[srow_of[("cnt", dk)]])
                     dt = a.func.buffer_dtypes()[0]
                     buf_groups.append([(
                         ssum.astype(dt.np_dtype),
                         (cntv > 0) & live_g)])
                 elif kind == "avg":
-                    ssum = compact(sums[srow_of[("sum", ai)]])
-                    cntv = compact(sums[srow_of[("cnt", ai)]])
+                    ssum = compact(sums[srow_of[("sum", dk)]])
+                    cntv = compact(sums[srow_of[("cnt", dk)]])
                     buf_groups.append([
                         (ssum.astype(jnp.float64), live_g),
                         (cntv.astype(jnp.int64), live_g)])
+                elif kind in ("fsum64", "favg64"):
+                    _, lane0, emax = agg_meta[ai]
+                    lanes = chunk_out[:, lane0:lane0 + CH_LANES] \
+                        .astype(jnp.float64)
+                    lanes = jnp.take(lanes, order, axis=0)
+                    ssum = _chunk_recombine(lanes, emax)
+                    nanv = compact(sums[srow_of[("nan", dk)]])
+                    pinfv = compact(sums[srow_of[("pinf", dk)]])
+                    ninfv = compact(sums[srow_of[("ninf", dk)]])
+                    ssum = jnp.where(pinfv > 0, jnp.float64(jnp.inf),
+                                     ssum)
+                    ssum = jnp.where(ninfv > 0, jnp.float64(-jnp.inf),
+                                     ssum)
+                    ssum = jnp.where(
+                        (nanv > 0) | ((pinfv > 0) & (ninfv > 0)),
+                        jnp.float64(jnp.nan), ssum)
+                    cntv = compact(sums[srow_of[("cnt", dk)]])
+                    if kind == "fsum64":
+                        buf_groups.append([(ssum, (cntv > 0) & live_g)])
+                    else:
+                        buf_groups.append([
+                            (ssum, live_g),
+                            (cntv.astype(jnp.int64), live_g)])
+                elif kind == "fminmax64":
+                    want_max = descs[ai][1]
+                    mi = agg_meta[ai][1]
+                    w1 = compact(mm1[:table, mi])
+                    w2 = compact(mm2[:table, mi])
+                    if not want_max:
+                        w1, w2 = ~w1, ~w2
+                    m = _unflip32(w1).astype(jnp.float64) + \
+                        _unflip32(w2).astype(jnp.float64)
+                    cntv = compact(sums[srow_of[("cnt", dk)]])
+                    nnv = compact(sums[srow_of[("nn", dk)]])
+                    if want_max:
+                        # any NaN in the group wins
+                        m = jnp.where(cntv > nnv,
+                                      jnp.float64(jnp.nan), m)
+                    else:
+                        # min ignores NaN unless the group is all-NaN
+                        m = jnp.where(nnv > 0, m, jnp.float64(jnp.nan))
+                    buf_groups.append([(m, (cntv > 0) & live_g)])
                 elif kind == "fminmax":
                     want_max = descs[ai][1]
                     m = compact(maxs[mrow_of[("m", ai)]])
                     if not want_max:
                         m = -m
-                    cntv = compact(sums[srow_of[("cnt", ai)]])
-                    nnv = compact(sums[srow_of[("nn", ai)]])
+                    cntv = compact(sums[srow_of[("cnt", dk)]])
+                    nnv = compact(sums[srow_of[("nn", dk)]])
                     if want_max:
                         # any NaN in the group wins
                         m = jnp.where(cntv > nnv, jnp.float32(jnp.nan), m)
@@ -676,7 +930,7 @@ class TpuHashAggregate(TpuExec):
                     if not want_max:
                         m = -m
                     word = vmin + jnp.maximum(m, 0).astype(jnp.uint64)
-                    cntv = compact(sums[srow_of[("cnt", ai)]])
+                    cntv = compact(sums[srow_of[("cnt", dk)]])
                     dt = a.func.buffer_dtypes()[0]
                     buf_groups.append([(
                         decode_word(dt, word),
@@ -771,8 +1025,10 @@ class TpuHashAggregate(TpuExec):
             self._ws_memo[mkey] = prep
         if prep is False:
             return None
+        from ..kernels.aggregate import _pair_sum_enabled
         cache_key, bound_keys, bound_inputs = prep
-        cache_key = cache_key + (emit_buffers, out_cap)
+        cache_key = cache_key + (emit_buffers, out_cap,
+                                 _pair_sum_enabled())
         core = TpuHashAggregate._CORE_CACHE.get(cache_key)
         if core is False:
             return None
@@ -997,8 +1253,9 @@ class TpuHashAggregate(TpuExec):
         if plain:
             import jax
             import logging
+            from ..kernels.aggregate import _pair_sum_enabled
             cache_key = ("global", update_mode, emit, in_dts,
-                         batch.capacity,
+                         batch.capacity, _pair_sum_enabled(),
                          tuple((type(a.func).__name__, repr(a.func),
                                 getattr(a.func, "ignore_nulls", None))
                                for a in aggs))

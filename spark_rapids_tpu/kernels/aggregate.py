@@ -97,15 +97,25 @@ def seg_sum(plan: GroupPlan, values, validity, out_dtype=None):
     if jnp.issubdtype(contrib.dtype, jnp.integer) or \
             contrib.dtype == jnp.bool_:
         return seg_prefix_sum(plan, contrib)
-    if contrib.dtype == jnp.float64 and jax.default_backend() != "cpu":
-        # On chip, f64 IS an (hi, lo) f32 pair: accumulate with the
-        # integer superaccumulator over the two components (no 64-bit
-        # scatter, no pair-rounding per add) — deterministic and
-        # faithful to everything the device representation can hold.
-        # The CPU backend has real f64: its native scatter-add is both
-        # exact to 53 bits and fast, so it keeps the direct path.
+    if contrib.dtype == jnp.float64 and jax.default_backend() != "cpu" \
+            and _pair_sum_enabled():
+        # Opt-in accuracy mode: on chip f64 IS an (hi, lo) f32 pair;
+        # accumulate with the integer superaccumulator over the two
+        # components — deterministic, order-independent, and faithful
+        # to everything the device representation holds.  Costs ~4x the
+        # scatter (the chip's emulated 64-bit integer ALU is slow), so
+        # the default is the f64-emulated scatter (error ~(n/G)*2^-48,
+        # far inside the engines' 1e-9 comparison tolerance).
         return _seg_sum_f64_pair(plan, acc, ok)
     return jax.ops.segment_sum(contrib, plan.seg_id, num_segments=cap)
+
+
+def _pair_sum_enabled() -> bool:
+    from ..config import get_active, AGG_PAIR_SUM
+    try:
+        return bool(get_active().get(AGG_PAIR_SUM))
+    except Exception:  # noqa: BLE001 - before config init
+        return False
 
 
 # -- f32-pair superaccumulator for FLOAT64 sums ------------------------------

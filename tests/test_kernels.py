@@ -334,3 +334,61 @@ class TestPairSuperaccumulator:
         # 1e-9 group's rows out of the accumulation window
         self._run(np.array([1e38, 1e-9, 1e-9, 3e37, 2e-9]),
                   np.array([0, 1, 1, 0, 1]))
+
+
+class TestExactTableLanes:
+    """Exact-float table-path lanes (fsum64/favg64/fminmax64): 8-bit
+    chunk sums + two-stage u32 min/max, engaged when capacity >= table
+    size.  Compared against the pyarrow oracle at tight tolerance."""
+
+    def _q(self, data, conf=None):
+        from tests.harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.api import functions as F
+
+        def q(s):
+            df = s.create_dataframe(data, num_partitions=2)
+            return df.group_by("k").agg(
+                F.sum("x").alias("sx"), F.avg("x").alias("ax"),
+                F.min("x").alias("mn"), F.max("x").alias("mx"),
+                F.count().alias("c"))
+        assert_tpu_and_cpu_are_equal_collect(q, conf=conf or {})
+
+    def test_exact_float_agg_table_path(self):
+        rng = np.random.default_rng(3)
+        n = 6000  # capacity 8192 >= table 4096: table path engages
+        self._q({"k": rng.integers(0, 50, n).astype(np.int64),
+                 "x": rng.standard_normal(n) * 1e6})
+
+    def test_exact_float_agg_negatives_and_zeros(self):
+        rng = np.random.default_rng(4)
+        n = 5000
+        x = rng.standard_normal(n)
+        x[::17] = 0.0
+        x[1::17] = -0.0
+        self._q({"k": rng.integers(0, 20, n).astype(np.int64), "x": x})
+
+    def test_exact_float_agg_specials(self):
+        rng = np.random.default_rng(5)
+        n = 5000
+        x = rng.standard_normal(n)
+        x[100] = np.inf
+        x[200] = -np.inf
+        x[300] = np.nan
+        k = rng.integers(0, 8, n).astype(np.int64)
+        # isolate specials per group so inf/nan semantics are exercised
+        k[100], k[200], k[300] = 1, 2, 3
+        self._q({"k": k, "x": x})
+
+    def test_exact_float_agg_wide_spread_falls_back(self):
+        # exponent spread > 2^63: the fit flag must route the batch to
+        # the sort path and results stay correct
+        rng = np.random.default_rng(6)
+        n = 5000
+        x = np.ldexp(rng.standard_normal(n), rng.integers(-80, 80, n))
+        self._q({"k": rng.integers(0, 10, n).astype(np.int64), "x": x})
+
+    def test_exact_float_agg_tiny_magnitudes(self):
+        rng = np.random.default_rng(7)
+        n = 5000
+        x = rng.standard_normal(n) * 1e-30
+        self._q({"k": rng.integers(0, 10, n).astype(np.int64), "x": x})
