@@ -34,6 +34,42 @@ DEFAULT_QUERIES = [
 ]
 
 
+def _rows_equal(cpu_rows, tpu_rows, rel=1e-9):
+    """Canon-rows multiset equality with ulp-level float tolerance —
+    the tests/harness.py contract applied at real scale."""
+    import math as m
+    if len(cpu_rows) != len(tpu_rows):
+        return False
+
+    def norm(v):
+        if isinstance(v, float):
+            return "NaN" if m.isnan(v) else v
+        return v
+
+    def key(row):
+        # floats key on a 9-significant-digit rendering so ulp-level
+        # engine differences don't reorder one side's sort and
+        # misalign the row pairing
+        return tuple(f"{v:.9e}" if isinstance(v, float) and
+                     not m.isnan(v) else str(norm(v)) for v in row)
+    a = sorted(cpu_rows, key=key)
+    b = sorted(tpu_rows, key=key)
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) and isinstance(vb, float):
+                if m.isnan(va) and m.isnan(vb):
+                    continue
+                if va == vb or abs(va - vb) <= rel * max(
+                        abs(va), abs(vb), 1.0):
+                    continue
+                return False
+            elif va != vb:
+                return False
+    return True
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
@@ -43,6 +79,10 @@ def main():
         os.path.dirname(os.path.abspath(__file__)),
         "tpcds_sf1_times.json"))
     ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--verify", action="store_true",
+                    help="compare TPU vs CPU canon rows per query "
+                         "(ulp-level float tolerance) and record "
+                         "verified: true/false")
     args = ap.parse_args()
     tag = os.path.join(args.data_dir, f"sf{args.scale}_v5")
     if not os.path.exists(os.path.join(tag, "store_sales.parquet")):
@@ -77,18 +117,25 @@ def main():
         sql = QUERIES[name]
         entry = {}
         try:
+            from spark_rapids_tpu.columnar import pending
             t0 = time.perf_counter()
             rows1 = s_tpu.sql(sql).collect()
             entry["tpu_first_s"] = round(time.perf_counter() - t0, 3)
+            f0 = pending.FLUSH_COUNT
             t0 = time.perf_counter()
             rows = s_tpu.sql(sql).collect()
             entry["tpu_s"] = round(time.perf_counter() - t0, 3)
+            entry["flushes"] = pending.FLUSH_COUNT - f0
             entry["rows"] = len(rows)
             t0 = time.perf_counter()
-            s_cpu.sql(sql).collect()
+            cpu_rows = s_cpu.sql(sql).collect()
             entry["cpu_s"] = round(time.perf_counter() - t0, 3)
             entry["speedup"] = round(entry["cpu_s"] /
                                      max(entry["tpu_s"], 1e-9), 3)
+            if args.verify:
+                entry["verified"] = _rows_equal(cpu_rows, rows)
+                if not entry["verified"]:
+                    entry["error"] = "VERIFY MISMATCH"
         except Exception as e:  # noqa: BLE001 - recorded per query
             entry["error"] = f"{type(e).__name__}: {e}"[:200]
         results[name] = entry

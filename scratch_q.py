@@ -1,0 +1,44 @@
+"""Profile one TPC-DS query's warm flushes on chip."""
+import sys, time, traceback
+sys.path.insert(0, "benchmarks")
+import tpcds
+from tpcds_queries import QUERIES
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.columnar import pending
+
+qname = sys.argv[1] if len(sys.argv) > 1 else "q3"
+s = TpuSession(TpuConf({
+    "spark.rapids.tpu.sql.enabled": True,
+    "spark.rapids.tpu.sql.batchSizeRows": 1 << 22,
+    "spark.rapids.tpu.sql.reader.batchSizeRows": 1 << 22,
+}))
+tpcds.register(s, "/tmp/tpcds_data/sf1.0_v5")
+sql = QUERIES[qname]
+t0 = time.perf_counter()
+s.sql(sql).collect()
+print(f"first {time.perf_counter()-t0:.1f}s", flush=True)
+
+orig = pending.flush
+events = []
+def spy():
+    t0 = time.perf_counter()
+    orig()
+    dt = time.perf_counter() - t0
+    if dt > 0.005:
+        st = [f"{f.name}:{f.lineno}" for f in
+              traceback.extract_stack()[-8:-2]
+              if "spark_rapids_tpu" in (f.filename or "")
+              or "tpcds" in (f.filename or "")]
+        events.append((dt, " <- ".join(reversed(st))))
+pending.flush = spy
+
+for i in range(2):
+    events.clear()
+    t0 = time.perf_counter()
+    rows = s.sql(sql).collect()
+    wall = time.perf_counter() - t0
+    print(f"warm{i} {wall:.2f}s rows={len(rows)} flushes>5ms={len(events)}",
+          flush=True)
+for dt, st in events:
+    print(f"  {dt*1e3:7.0f} ms  {st}", flush=True)

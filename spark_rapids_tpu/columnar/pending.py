@@ -195,9 +195,15 @@ def pd_isnan(a: np.ndarray) -> np.ndarray:
     return np.zeros(a.shape, bool)
 
 
+# observability: device round trips this process (each non-empty flush
+# forces all queued device work — the per-query flush count is THE cost
+# model on remote-dispatch backends; see docs/perf.md)
+FLUSH_COUNT = 0
+
+
 def flush():
     """Pull every staged array in at most two fused transfers."""
-    global _POOL
+    global _POOL, FLUSH_COUNT
     items: List[Staged] = []
     for w in _POOL:
         it = w()
@@ -206,6 +212,7 @@ def flush():
     _POOL = []
     if not items:
         return
+    FLUSH_COUNT += 1
     if len(items) == 1 or not _check_encoding():
         for it in items:
             it._val = np.asarray(it.dev)

@@ -224,11 +224,20 @@ class TpuBroadcastExchange(TpuExec):
     def broadcast_batch(self) -> ColumnarBatch:
         from ..columnar.batch import resolve_speculative
         if self._result is None:
-            batches = [resolve_speculative(b)
-                       for p in self.children[0].execute() for b in p]
-            batches = [b for b in batches if b.num_rows > 0]
-            self._result = concat_batches(batches) if batches else \
-                ColumnarBatch.empty(self.output_schema)
+            raw = [b for p in self.children[0].execute() for b in p]
+            if len(raw) == 1:
+                # single-batch build side (the dominant dimension-table
+                # shape): pass through WITHOUT forcing the host count —
+                # consumers key off device counts (canon rank words mask
+                # dead rows) and resolve any speculative flag at their
+                # own flush barrier, so the broadcast costs zero round
+                # trips here
+                self._result = raw[0]
+            else:
+                batches = [resolve_speculative(b) for b in raw]
+                batches = [b for b in batches if b.num_rows > 0]
+                self._result = concat_batches(batches) if batches else \
+                    ColumnarBatch.empty(self.output_schema)
         return self._result
 
     def execute(self):

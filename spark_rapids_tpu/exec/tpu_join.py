@@ -65,14 +65,15 @@ class TpuHashJoinBase(TpuExec):
         lg = self.logical
         lschema = self.children[0].output_schema
         rschema = self.children[1].output_schema
+        from ..columnar.batch import resolve_speculative as _resolve
         if self.build_right:
-            build_batches = list(right_iter)
+            build_batches = [_resolve(b) for b in right_iter]
             stream_iter = left_iter
             build_schema, stream_schema = rschema, lschema
             build_keys = [e.bind(rschema) for e in lg.right_keys]
             stream_keys = [e.bind(lschema) for e in lg.left_keys]
         else:
-            build_batches = list(left_iter)
+            build_batches = [_resolve(b) for b in left_iter]
             stream_iter = right_iter
             build_schema, stream_schema = lschema, rschema
             build_keys = [e.bind(lschema) for e in lg.left_keys]
@@ -125,7 +126,13 @@ class TpuHashJoinBase(TpuExec):
                 and memo.get("str_words") == str_words):
             bt = memo["bt"]
         else:
-            bwords = _key_words(bkey_cols, build.num_rows, str_words)
+            # non-string keys never need the host count: the canon rank
+            # word masks dead rows with the device count, keeping a
+            # lazily-counted broadcast build sync-free
+            b_nr = build.num_rows if any(w is not None
+                                         for w in str_words) \
+                else build.rows_dev
+            bwords = _key_words(bkey_cols, b_nr, str_words)
             bt = join_k.build(bwords)
             memo = {"key": bb_key,
                     "batches": build_batches,

@@ -294,13 +294,57 @@ class TpuTopN(TpuExec):
     def num_partitions_hint(self):
         return 1
 
+    def _sort_lazy(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Sort + head-n entirely on device counts — no host pull.
+        Dead rows carry the past-rows rank word, so they sort last and
+        the head-n prefix is exactly the top rows."""
+        from ..columnar.batch import LazyCount
+        from ..columnar.column import bucket_capacity
+        nr = batch.rows_dev
+        words = self._sorter._key_words(
+            self._sorter._key_cols(batch), nr)
+        perm = sort_permutation(words)
+        srt = batch.gather(perm, batch.rows_lazy, unique=True)
+        cap = min(bucket_capacity(max(self.n, 1)), srt.capacity)
+        take = jnp.arange(cap)
+        out_n = jnp.minimum(nr, jnp.int32(self.n))
+        live = take < out_n
+        cols = [c.gather(take, live=live).mask_validity(live)
+                for c in srt.columns]
+        return ColumnarBatch(batch.schema, cols, LazyCount(out_n))
+
     def execute(self):
+        from ..columnar.batch import (SpeculativeResult,
+                                      resolve_speculative)
         parts = self.children[0].execute()
 
         def run():
+            if len(parts) == 1:
+                batches = [b for b in parts[0]]
+                if len(batches) == 1 and not (
+                        isinstance(batches[0].rows_lazy, int) and
+                        batches[0].num_rows == 0):
+                    # single-batch fast path: sort + head-n on device
+                    # counts, PROPAGATING any speculative flag so an
+                    # upstream aggregate's verify merges into the root
+                    # collect's flush instead of costing its own
+                    b = batches[0]
+                    spec = getattr(b, "_speculative", None)
+                    out = self._sort_lazy(b)
+                    if spec is not None:
+                        def redo(spec=spec):
+                            fixed = resolve_speculative(spec.redo())
+                            return self._sort_lazy(fixed)
+                        out._speculative = SpeculativeResult(
+                            list(spec.fits), redo)
+                    self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
+                    yield out
+                    return
+                parts[0] = iter(batches)      # replay consumed batches
             tops = []
             for p in parts:
-                batches = [b for b in p]
+                batches = [resolve_speculative(b) for b in p]
+                batches = [b for b in batches if b.num_rows > 0]
                 if not batches:
                     continue
                 batch = concat_batches(batches) if len(batches) > 1 else \

@@ -508,10 +508,16 @@ class Planner:
         from ..exec import tpu_aggregate as TA
         from ..exec import tpu_join as TJ
         from ..exec import exchange as TX
+        from ..exec import tpu_sort as TS
         safe = (parent is None or
                 isinstance(parent, (TX.TpuShuffleExchange,
                                     TX.TpuBroadcastExchange,
-                                    TJ.TpuHashJoinBase)))
+                                    TJ.TpuHashJoinBase,
+                                    # TopN re-attaches the speculative
+                                    # flag to its own (sorted, head-n)
+                                    # output with a redo chain, so the
+                                    # verify rides the NEXT barrier
+                                    TS.TpuTopN)))
         if isinstance(node, TA.TpuHashAggregate) and \
                 node.mode in (TA.FINAL, TA.COMPLETE):
             node.allow_deferred_verify = safe

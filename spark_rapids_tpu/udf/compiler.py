@@ -30,7 +30,11 @@ class CannotCompile(Exception):
 
 _BINARY_OPS = {
     "+": ea.Add, "-": ea.Subtract, "*": ea.Multiply, "/": ea.Divide,
-    "//": ea.IntegralDivide, "%": ea.Remainder, "**": ea.Pow,
+    # python % follows the DIVISOR's sign = Spark pmod (NOT Spark %,
+    # which follows the dividend); // floor-divides while Spark's
+    # integral divide truncates toward zero, so // is refused and
+    # falls back rather than silently flipping negative results
+    "%": ea.Pmod, "**": ea.Pow,
     "&": ea.BitwiseAnd, "|": ea.BitwiseOr, "^": ea.BitwiseXor,
     "<<": ea.ShiftLeft, ">>": ea.ShiftRight,
 }
@@ -45,7 +49,28 @@ _GLOBAL_FUNCS = {
     "min": lambda a, b: ea.Least(a, b),
     "max": lambda a, b: ea.Greatest(a, b),
     "len": lambda a: es.Length(a),
+    # NOTE: python round() is HALF_EVEN while the engine's Round is
+    # Spark HALF_UP — compiling it would silently change results, so
+    # round() stays on the row-wise fallback.
+    "int": lambda a: _make_cast(a, T.INT64),
+    "float": lambda a: _make_cast(a, T.FLOAT64),
+    "bool": lambda a: _make_cast(a, T.BOOL),
 }
+
+
+def _make_cast(a, to):
+    from ..expr.cast import Cast
+    # numeric/bool sources only: python int('abc') RAISES while a SQL
+    # cast returns NULL — compiling string casts would silently swallow
+    # what the row-wise fallback reports as an error
+    try:
+        src = a.dtype()
+    except Exception:  # noqa: BLE001 - unresolved dtype
+        raise CannotCompile("cast source dtype unresolved") from None
+    if not (src.is_integral or src.is_fractional or src == T.BOOL):
+        raise CannotCompile(f"{to.name} cast of {src.name} (python "
+                            f"raises on bad input; SQL cast nulls)")
+    return Cast(a, to)
 
 #: bounded loop unrolling: literal-range for-loops expand into
 #: straight-line code (the reference compiles loops via CFG + state
@@ -67,11 +92,28 @@ _MATH_FUNCS = {
     "log10": ea.Log10, "sin": ea.Sin, "cos": ea.Cos, "tan": ea.Tan,
     "asin": ea.Asin, "acos": ea.Acos, "atan": ea.Atan, "sinh": ea.Sinh,
     "cosh": ea.Cosh, "tanh": ea.Tanh, "floor": ea.Floor, "ceil": ea.Ceil,
+    # python math.fabs ALWAYS returns float, even for int inputs
+    "fabs": lambda a: ea.Abs(_make_cast(a, T.FLOAT64)),
 }
+
+#: two-argument math intrinsics
+_MATH_FUNCS2 = {"pow": ea.Pow, "atan2": ea.Atan2}
+
+#: math module constants fold to literals
+_MATH_CONSTS = {"pi": math.pi, "e": math.e, "tau": math.tau,
+                "inf": math.inf}
 
 _STR_METHODS = {
     "upper": es.Upper, "lower": es.Lower, "strip": es.StringTrim,
     "lstrip": es.StringTrimLeft, "rstrip": es.StringTrimRight,
+}
+
+#: string methods taking literal arguments (the device predicates
+#: require literal patterns — reference restriction GpuOverrides:470)
+_STR_ARG_METHODS = {
+    "startswith": lambda recv, pat: es.StartsWith(recv, pat),
+    "endswith": lambda recv, pat: es.EndsWith(recv, pat),
+    "replace": lambda recv, a, b: es.Replace(recv, a, b),
 }
 
 
@@ -104,7 +146,10 @@ class _Block:
             self.steps += 1
             if self.steps > _MAX_COMPILE_STEPS:
                 raise CannotCompile(
-                    "compile budget exceeded (branchy loop blow-up)")
+                    "compile budget exceeded — data-dependent or "
+                    "unbounded loop (while conditions must fold to "
+                    "literals within the unroll budget); row-wise "
+                    "fallback")
             ins = self.ins[i]
             op = ins.opname
             if op in ("RESUME", "PRECALL", "CACHE", "PUSH_NULL", "NOP",
@@ -143,11 +188,14 @@ class _Block:
                 name = ins.argval
                 if isinstance(recv, tuple) and recv[0] == "module" and \
                         recv[1] == "math":
-                    if name not in _MATH_FUNCS:
+                    if name in _MATH_CONSTS:
+                        stack.append(ec.Literal(_MATH_CONSTS[name]))
+                    elif name in _MATH_FUNCS or name in _MATH_FUNCS2:
+                        stack.append(("math_fn", name))
+                    else:
                         raise CannotCompile(f"math.{name}")
-                    stack.append(("math_fn", name))
                 elif isinstance(recv, ec.Expression) and \
-                        name in _STR_METHODS:
+                        (name in _STR_METHODS or name in _STR_ARG_METHODS):
                     stack.append(("str_method", name, recv))
                 else:
                     raise CannotCompile(f"attr {name}")
@@ -155,16 +203,36 @@ class _Block:
                 b = stack.pop()
                 a = stack.pop()
                 sym = ins.argrepr.rstrip("=")
-                cls = _BINARY_OPS.get(sym)
-                if cls is None:
-                    raise CannotCompile(f"binary op {ins.argrepr}")
-                stack.append(cls(_as_expr(a), _as_expr(b)))
+                folded = _fold_binary(sym, a, b)
+                if folded is not None:
+                    stack.append(folded)
+                else:
+                    ae, be = _as_expr(a), _as_expr(b)
+                    if sym == "+" and (_is_str(ae) or _is_str(be)):
+                        stack.append(es.ConcatStrings(ae, be))
+                    elif sym == "%" and not (
+                            isinstance(be, ec.Literal) and
+                            isinstance(be.value, int) and
+                            be.value > 0):
+                        # python % == Pmod only for a positive divisor;
+                        # other shapes fall back row-wise
+                        raise CannotCompile(
+                            "% needs a positive literal divisor")
+                    else:
+                        cls = _BINARY_OPS.get(sym)
+                        if cls is None:
+                            raise CannotCompile(
+                                f"binary op {ins.argrepr}")
+                        stack.append(cls(ae, be))
             elif op == "COMPARE_OP":
                 b = stack.pop()
                 a = stack.pop()
                 sym = ins.argval if isinstance(ins.argval, str) else \
                     ins.argrepr
-                if sym == "!=":
+                folded = _fold_compare(sym, a, b)
+                if folded is not None:
+                    stack.append(folded)
+                elif sym == "!=":
                     stack.append(ep.Not(ep.EqualTo(_as_expr(a),
                                                    _as_expr(b))))
                 else:
@@ -212,9 +280,29 @@ class _Block:
                     builder = _GLOBAL_FUNCS[fn[1]]
                     stack.append(builder(*[_as_expr(a) for a in args]))
                 elif isinstance(fn, tuple) and fn[0] == "math_fn":
-                    stack.append(_MATH_FUNCS[fn[1]](_as_expr(args[0])))
+                    if fn[1] in _MATH_FUNCS2:
+                        if len(args) != 2:
+                            raise CannotCompile(f"math.{fn[1]} arity")
+                        stack.append(_MATH_FUNCS2[fn[1]](
+                            _as_expr(args[0]), _as_expr(args[1])))
+                    else:
+                        stack.append(_MATH_FUNCS[fn[1]](
+                            _as_expr(args[0])))
                 elif isinstance(fn, tuple) and fn[0] == "str_method":
-                    stack.append(_STR_METHODS[fn[1]](_as_expr(fn[2])))
+                    if fn[1] in _STR_ARG_METHODS:
+                        for a in args:
+                            if not (isinstance(a, ec.Literal) and
+                                    isinstance(a.value, str)):
+                                raise CannotCompile(
+                                    f"{fn[1]} needs literal string "
+                                    f"arguments (device string "
+                                    f"predicates take literal "
+                                    f"patterns)")
+                        stack.append(_STR_ARG_METHODS[fn[1]](
+                            _as_expr(fn[2]), *args))
+                    else:
+                        stack.append(
+                            _STR_METHODS[fn[1]](_as_expr(fn[2])))
                 elif isinstance(fn, tuple) and fn[0] == "range_fn":
                     bounds = []
                     for a in args:
@@ -267,7 +355,22 @@ class _Block:
                     cond = ep.IsNotNull(e) if op.endswith("IF_NONE") \
                         else ep.IsNull(e)
                 else:
-                    cond = _truthy(stack.pop())
+                    raw = stack.pop()
+                    static = _static_bool(raw)
+                    if static is not None:
+                        # statically-decided branch (folded literal
+                        # condition): follow ONE path iteratively —
+                        # this is what unrolls bounded while-loops
+                        # (counter updates fold to literals, so the
+                        # loop test is a literal each iteration)
+                        take_jump = static if "TRUE" in op \
+                            else not static
+                        if take_jump:
+                            i = self.offset_index[ins.argval]
+                        else:
+                            i += 1
+                        continue
+                    cond = _truthy(raw)
                     if "TRUE" in op:
                         cond = ep.Not(cond)
                 target = self.offset_index[ins.argval]
@@ -308,6 +411,75 @@ def _as_expr(v) -> ec.Expression:
     if isinstance(v, ec.Expression):
         return v
     raise CannotCompile(f"non-expression value {v!r}")
+
+
+def _is_str(e) -> bool:
+    try:
+        return e.dtype() == T.STRING
+    except Exception:  # noqa: BLE001 - unresolved dtype
+        return False
+
+
+def _lit_val(v):
+    """Python literal behind a stack value, or a no-value sentinel."""
+    if isinstance(v, ec.Literal) and \
+            isinstance(v.value, (bool, int, float, str)):
+        return v.value
+    return _NO_FOLD
+
+
+_NO_FOLD = object()
+
+_PY_FOLD_BIN = {
+    "+": lambda a, b: a + b, "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b, "/": lambda a, b: a / b,
+    "%": lambda a, b: a % b, "**": lambda a, b: a ** b,
+    "//": lambda a, b: a // b,
+    "&": lambda a, b: a & b, "|": lambda a, b: a | b,
+    "^": lambda a, b: a ^ b,
+    "<<": lambda a, b: a << b, ">>": lambda a, b: a >> b,
+}
+
+_PY_FOLD_CMP = {
+    "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+    "==": lambda a, b: a == b, "!=": lambda a, b: a != b,
+}
+
+
+def _fold_binary(sym, a, b):
+    """Literal op literal -> folded Literal (PYTHON semantics, which is
+    exactly what the compiled function would have computed).  This is
+    what lets literal-counter while-loops unroll: the counter update
+    stays a literal, so the loop test stays statically decidable."""
+    va, vb = _lit_val(a), _lit_val(b)
+    if va is _NO_FOLD or vb is _NO_FOLD:
+        return None
+    fn = _PY_FOLD_BIN.get(sym)
+    if fn is None:
+        return None
+    try:
+        return ec.Literal(fn(va, vb))
+    except Exception as e:  # noqa: BLE001 - 1/0 etc: refuse, don't raise
+        raise CannotCompile(f"constant fold {sym}: {e}") from None
+
+
+def _fold_compare(sym, a, b):
+    va, vb = _lit_val(a), _lit_val(b)
+    if va is _NO_FOLD or vb is _NO_FOLD:
+        return None
+    fn = _PY_FOLD_CMP.get(sym)
+    if fn is None:
+        return None
+    return ec.Literal(bool(fn(va, vb)))
+
+
+def _static_bool(v):
+    """bool() of a literal condition, or None when data-dependent."""
+    val = _lit_val(v)
+    if val is _NO_FOLD:
+        return None
+    return bool(val)
 
 
 def _truthy(v) -> ec.Expression:

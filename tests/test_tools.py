@@ -332,3 +332,61 @@ class TestApiValidation:
         (bad / "supported_ops.md").write_text(
             text.replace("CollectList", "CollectEverything", 1))
         assert any("supported_ops" in p for p in audit(str(bad)))
+
+
+class TestSparkEventLogQualification:
+    """Real Spark event-log ingestion (EventsProcessor.scala role): the
+    tool parses the history-server JSON-lines format, takes the LAST
+    plan per execution (AQE updates replace the original), derives wall
+    time from SQLExecutionStart/End, and scores foreign operators."""
+
+    FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "data", "spark_eventlog.jsonl")
+
+    def test_parses_executions_and_walls(self):
+        from spark_rapids_tpu.tools.qualification import \
+            read_spark_eventlog
+        recs = read_spark_eventlog(self.FIXTURE)
+        assert len(recs) == 3
+        by_id = {r["query_id"]: r for r in recs}
+        assert "etl-nightly:sql-0" in by_id
+        assert by_id["etl-nightly:sql-0"]["wall_ms"] == 8000.0
+        assert by_id["etl-nightly:sql-1"]["wall_ms"] == 4500.0
+        nodes0 = by_id["etl-nightly:sql-0"]["nodes"]
+        assert "HashAggregate" in nodes0 and "Exchange" in nodes0
+        assert "Scan parquet" in nodes0
+
+    def test_aqe_update_replaces_plan(self):
+        from spark_rapids_tpu.tools.qualification import \
+            read_spark_eventlog
+        recs = read_spark_eventlog(self.FIXTURE)
+        nodes1 = [r for r in recs
+                  if r["query_id"].endswith("sql-1")][0]["nodes"]
+        # the AQE final plan (broadcast join) must have replaced the
+        # original sort-merge plan
+        assert "BroadcastHashJoin" in nodes1
+        assert "SortMergeJoin" not in nodes1
+
+    def test_qualify_scores_foreign_plans(self):
+        from spark_rapids_tpu.tools.qualification import (
+            read_spark_eventlog, qualify)
+        report = qualify(read_spark_eventlog(self.FIXTURE))
+        per_q = {q["query_id"]: q for q in report["queries"]}
+        # the aggregation query maps fully onto TPU execs
+        assert per_q["etl-nightly:sql-0"]["tpu_operator_fraction"] == 1.0
+        assert per_q["etl-nightly:sql-0"]["recommendation"] == \
+            "STRONGLY RECOMMENDED"
+        assert per_q["etl-nightly:sql-0"]["estimated_speedup"] > 1.0
+        # the stateful-streaming exec has no TPU mapping
+        assert "FlatMapGroupsWithState" in \
+            per_q["etl-nightly:sql-2"]["unsupported_ops"]
+        assert "FlatMapGroupsWithState" in \
+            report["unsupported_operators"]
+
+    def test_cli_detects_spark_format(self, capsys):
+        from spark_rapids_tpu.tools import qualification as Q
+        rc = Q.main([self.FIXTURE])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["total_ms"] == 13500.0
+        assert len(out["queries"]) == 3

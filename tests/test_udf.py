@@ -413,3 +413,213 @@ class TestCompilerBreadth:
             want = [fn(x) for x in xs]
             assert [bool(g) for g in got] == want
             assert all(v is not None for v in got)
+
+
+class TestCompilerMatrix:
+    """Wide compile-vs-fallback matrix (udf-compiler test coverage
+    role): every compilable shape's device expression must match the
+    pure-Python row result EXACTLY (the compiled expression replaces a
+    row-wise fallback); refused shapes must return None (silent
+    fallback contract)."""
+
+    def _eval_compiled(self, fn, values, dtype=T.INT64):
+        import numpy as np
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.columnar.schema import Field, Schema
+        from spark_rapids_tpu.columnar import Column
+        e = compile_udf(fn, [ec.BoundReference(0, dtype, "a0")])
+        if e is None:
+            return None
+        if dtype == T.STRING:
+            col = Column.from_numpy(list(values), dtype=T.STRING)
+        else:
+            col = Column.from_numpy(
+                np.asarray(values, dtype.np_dtype), dtype=dtype)
+        schema = Schema([Field("a0", dtype, True)])
+        batch = ColumnarBatch(schema, [col], len(values))
+        out = ec.eval_as_column(e, batch)
+        vals, valid = out.to_numpy(len(values)) if not hasattr(
+            out, "to_pylist") or out.dtype != T.STRING else (None, None)
+        if out.dtype == T.STRING:
+            return out.to_pylist(len(values))
+        return [v if ok else None for v, ok in zip(vals, valid)]
+
+    def _check(self, fn, values, dtype=T.INT64, approx=False):
+        got = self._eval_compiled(fn, values, dtype)
+        assert got is not None, "expected shape to compile"
+        expect = [fn(v) for v in values]
+        for g, w in zip(got, expect):
+            if approx and isinstance(w, float):
+                assert abs(g - w) <= 1e-9 * max(abs(w), 1.0), (g, w)
+            elif isinstance(w, bool):
+                assert bool(g) == w, (g, w)
+            elif isinstance(w, float):
+                assert g == w or abs(g - w) < 1e-12, (g, w)
+            else:
+                assert g == w, (g, w)
+
+    def _refused(self, fn, nargs=1):
+        args = [ec.BoundReference(i, T.INT64, f"a{i}")
+                for i in range(nargs)]
+        assert compile_udf(fn, args) is None
+
+    I = list(range(-20, 21, 3)) + [0, 1, -1, 17]
+    F = [(-2.5 + 0.37 * k) for k in range(12)]
+    S = ["Hello", "world", "  pad  ", "", "Ab", "prefix_x"]
+
+    # -- arithmetic / comparison shapes ---------------------------------
+    def test_m01_linear(self):
+        self._check(lambda x: x * 3 - 7, self.I)
+
+    def test_m02_nested_arith(self):
+        self._check(lambda x: (x + 1) * (x - 1) + x, self.I)
+
+    def test_m03_pymod_negative_dividend(self):
+        # python % follows the divisor sign — compiled as Pmod
+        self._check(lambda x: x % 5, self.I)
+
+    def test_m04_pymod_negative_divisor_refused(self):
+        # python's % with a negative divisor differs from Pmod: fallback
+        self._refused(lambda x: x % -3)
+
+    def test_m05_floordiv_refused(self):
+        # // floor-divides in python but truncates in SQL: fallback
+        self._refused(lambda x: x // 3)
+
+    def test_m06_power(self):
+        self._check(lambda x: x ** 2, self.I)
+
+    def test_m07_bitops(self):
+        self._check(lambda x: (x & 12) | (x ^ 5), self.I)
+
+    def test_m08_shifts(self):
+        self._check(lambda x: (x << 2) >> 1, [v for v in self.I
+                                              if v >= 0])
+
+    def test_m09_ternary(self):
+        self._check(lambda x: x if x > 0 else -x, self.I)
+
+    def test_m10_chained_compare(self):
+        self._check(lambda x: 1 if 0 < x < 10 else 0, self.I)
+
+    def test_m11_bool_ops(self):
+        self._check(lambda x: (x > 2) and (x < 15), self.I)
+
+    def test_m12_not(self):
+        self._check(lambda x: not (x > 0), self.I)
+
+    def test_m13_membership(self):
+        self._check(lambda x: x in (1, 4, 17), self.I)
+
+    def test_m14_min_max_abs(self):
+        self._check(lambda x: max(min(abs(x), 10), 2), self.I)
+
+    # -- math intrinsics -------------------------------------------------
+    def test_m15_sqrt_abs(self):
+        self._check(lambda x: math.sqrt(abs(x)), self.F, T.FLOAT64,
+                    approx=True)
+
+    def test_m16_exp_log(self):
+        self._check(lambda x: math.log(math.exp(x) + 1.0), self.F,
+                    T.FLOAT64, approx=True)
+
+    def test_m17_trig(self):
+        self._check(lambda x: math.sin(x) * math.cos(x) + math.tan(x),
+                    self.F, T.FLOAT64, approx=True)
+
+    def test_m18_floor_ceil(self):
+        self._check(lambda x: math.floor(x) + math.ceil(x), self.F,
+                    T.FLOAT64)
+
+    def test_m19_atan2(self):
+        self._check(lambda x: math.atan2(x, 2.0), self.F, T.FLOAT64,
+                    approx=True)
+
+    def test_m20_pow2(self):
+        self._check(lambda x: math.pow(abs(x) + 0.5, 1.5), self.F,
+                    T.FLOAT64, approx=True)
+
+    def test_m21_pi_const(self):
+        self._check(lambda x: x * math.pi + math.e, self.F, T.FLOAT64,
+                    approx=True)
+
+    def test_m22_fabs(self):
+        self._check(lambda x: math.fabs(x), self.F, T.FLOAT64)
+
+    # -- casts ----------------------------------------------------------
+    def test_m23_int_cast(self):
+        self._check(lambda x: int(x), self.F, T.FLOAT64)
+
+    def test_m24_float_cast(self):
+        self._check(lambda x: float(x) / 2.0, self.I)
+
+    # -- string methods --------------------------------------------------
+    def test_m25_upper(self):
+        self._check(lambda s: s.upper(), self.S, T.STRING)
+
+    def test_m26_lower_strip(self):
+        self._check(lambda s: s.strip().lower(), self.S, T.STRING)
+
+    def test_m27_len(self):
+        self._check(lambda s: len(s), self.S, T.STRING)
+
+    def test_m28_startswith(self):
+        self._check(lambda s: s.startswith("pre"), self.S, T.STRING)
+
+    def test_m29_endswith(self):
+        self._check(lambda s: s.endswith("x"), self.S, T.STRING)
+
+    def test_m30_replace(self):
+        self._check(lambda s: s.replace("l", "L"), self.S, T.STRING)
+
+    def test_m31_concat(self):
+        self._check(lambda s: s + "_suffix", self.S, T.STRING)
+
+    def test_m32_replace_nonliteral_arg_refused(self):
+        # device string predicates take LITERAL patterns only
+        self._refused(lambda s: s.replace(s, "X"))
+
+    # -- loops -----------------------------------------------------------
+    def test_m33_for_range(self):
+        def f(x):
+            acc = 0
+            for i in range(4):
+                acc = acc + x * i
+            return acc
+        self._check(f, self.I)
+
+    def test_m34_while_literal_counter(self):
+        def f(x):
+            acc = x
+            i = 0
+            while i < 5:
+                acc = acc + i
+                i = i + 1
+            return acc
+        self._check(f, self.I)
+
+    def test_m35_while_data_dependent_refused(self):
+        def f(x):
+            while x > 0:
+                x = x - 1
+            return x
+        self._refused(f)
+
+    def test_m36_nested_loop(self):
+        def f(x):
+            acc = 0
+            for i in range(3):
+                for j in range(2):
+                    acc = acc + x + i * j
+            return acc
+        self._check(f, self.I)
+
+    def test_m37_branch_in_while(self):
+        def f(x):
+            acc = 0
+            i = 0
+            while i < 4:
+                acc = acc + (x if x > i else i)
+                i = i + 1
+            return acc
+        self._check(f, self.I)
