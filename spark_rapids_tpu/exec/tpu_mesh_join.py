@@ -44,10 +44,16 @@ from .tpu_mesh_aggregate import _SINGLE_WORD
 
 _AXIS = "data"
 
-_MESH_JOIN_TYPES = ("inner", "left", "semi", "anti")
+_MESH_JOIN_TYPES = ("inner", "left", "right", "semi", "anti")
 
 
 def mesh_join_supported(p, n_devices: int) -> bool:
+    """Mesh-joinable: equi condition, inner/left/right/semi/anti, and
+    fixed-width OUTPUT columns.  Keys may be STRINGS (multi-word): key
+    words are computed eagerly per batch with statically-unified widths
+    and routed through the all_to_all as plain u64 arrays; only the
+    PAYLOAD columns must be fixed-width (a string key that is also
+    projected into the output still blocks, via out_ts)."""
     if n_devices < 2 or p.condition is not None or not p.left_keys:
         return False
     if p.join_type not in _MESH_JOIN_TYPES:
@@ -58,7 +64,13 @@ def mesh_join_supported(p, n_devices: int) -> bool:
         out_ts = [f.dtype for f in p.schema]
     except (ValueError, NotImplementedError):
         return False
-    return all(isinstance(t, _SINGLE_WORD) for t in key_ts + out_ts)
+    if not all(isinstance(t, _SINGLE_WORD) or t == T.STRING
+               for t in key_ts):
+        return False
+    required = getattr(p, "required_out", None)
+    if required is not None:
+        out_ts = [f.dtype for f in p.schema if f.name in set(required)]
+    return all(isinstance(t, _SINGLE_WORD) for t in out_ts)
 
 
 class TpuMeshShuffledJoin(TpuExec):
@@ -72,7 +84,12 @@ class TpuMeshShuffledJoin(TpuExec):
 
     @property
     def output_schema(self) -> Schema:
-        return self.logical.schema
+        required = getattr(self.logical, "required_out", None)
+        if required is None:
+            return self.logical.schema
+        req = set(required)
+        return Schema([f for f in self.logical.schema.fields
+                       if f.name in req])
 
     def _node_string(self):
         n = self.mesh.devices.size if self.mesh is not None else "?"
@@ -80,29 +97,32 @@ class TpuMeshShuffledJoin(TpuExec):
                 f"{n} devices]")
 
     # ------------------------------------------------------------------
-    def _program(self, mesh: Mesh, jt: str, nk: int, key_dts,
-                 l_dts, r_dts, emit_right: bool):
+    def _program(self, mesh: Mesh, jt: str, key_groups, l_dts, r_dts,
+                 emit_right: bool):
+        """``key_groups``: static word-count of each key column's canon
+        encoding (1 rank word + value words; strings contribute several
+        value words).  Key words are computed EAGERLY per batch (string
+        kernels need host-known widths) and routed as plain u64 inputs,
+        so the shard program itself is dtype-agnostic about keys."""
         from ..shims import get_shard_map
         shard_map = get_shard_map()
-        key = (id(mesh), jt, nk, tuple(d.name for d in key_dts),
+        key = (id(mesh), jt, tuple(key_groups),
                tuple(d.name for d in l_dts), tuple(d.name for d in r_dts),
                emit_right)
         hit = TpuMeshShuffledJoin._PROGRAM_CACHE.get(key)
         if hit is not None:
             return hit
         n_dev = mesh.devices.size
+        nw = sum(key_groups)
+        rank_pos = []
+        off = 0
+        for g in key_groups:
+            rank_pos.append(off)
+            off += g
 
-        def key_words(datas, valids, live, dts):
-            words: List[jnp.ndarray] = []
-            for d, v, dt in zip(datas, valids, dts):
-                col = Column(dt, d, v & live)
-                w = canon.column_key_words(col, d.shape[0])
-                words.extend(w)
+        def side_route(words, datas, valids, live):
+            words = list(words)
             words[0] = jnp.where(live, words[0], jnp.uint64(2))
-            return words
-
-        def side_route(datas, valids, live, dts, nw):
-            words = key_words(datas[:nk], valids[:nk], live, key_dts)
             h = jnp.zeros_like(words[0])
             for w in words:
                 h = (h ^ w) * jnp.uint64(MIX)
@@ -123,28 +143,28 @@ class TpuMeshShuffledJoin(TpuExec):
 
         def step(*flat):
             pos = 0
+            lwords = list(flat[pos:pos + nw]); pos += nw
             ld = list(flat[pos:pos + len(l_dts)]); pos += len(l_dts)
             lv = list(flat[pos:pos + len(l_dts)]); pos += len(l_dts)
             llive = flat[pos]; pos += 1
+            rwords = list(flat[pos:pos + nw]); pos += nw
             rd = list(flat[pos:pos + len(r_dts)]); pos += len(r_dts)
             rv = list(flat[pos:pos + len(r_dts)]); pos += len(r_dts)
             rlive = flat[pos]
 
-            lw, lrd, lrv, lrl, ovf_l = side_route(ld, lv, llive, l_dts,
-                                                  nk)
-            rw, rrd, rrv, rrl, ovf_r = side_route(rd, rv, rlive, r_dts,
-                                                  nk)
+            lw, lrd, lrv, lrl, ovf_l = side_route(lwords, ld, lv, llive)
+            rw, rrd, rrv, rrl, ovf_r = side_route(rwords, rd, rv, rlive)
 
             # local join on the owner shard: sorted build + binary probe
             bt = join_k.build(rw)
             lo = join_k._bsearch(bt.sorted_words, lw, upper=False)
             hi = join_k._bsearch(bt.sorted_words, lw, upper=True)
             counts = (hi - lo).astype(jnp.int32)
-            # null keys never match: every _SINGLE_WORD key encodes as
-            # (rank, value) word pairs, rank 1 == valid
+            # null keys never match: each key group leads with its
+            # null/range rank word, rank 1 == valid
             usable = lrl
-            for ki in range(nk):
-                usable = usable & (lw[2 * ki] == jnp.uint64(1))
+            for rp in rank_pos:
+                usable = usable & (lw[rp] == jnp.uint64(1))
             counts = jnp.where(usable, counts, 0)
 
             if jt == "inner":
@@ -179,7 +199,7 @@ class TpuMeshShuffledJoin(TpuExec):
             out_flat.append(ovf[None])
             return tuple(out_flat)
 
-        n_in = 2 * len(l_dts) + 1 + 2 * len(r_dts) + 1
+        n_in = nw + 2 * len(l_dts) + 1 + nw + 2 * len(r_dts) + 1
         n_out = 2 * len(l_dts) + (2 * len(r_dts) if emit_right else 0) + 2
         fn = jax.jit(shard_map(
             step, mesh=mesh,
@@ -213,50 +233,90 @@ class TpuMeshShuffledJoin(TpuExec):
         mesh = self.mesh or make_mesh()
         n_dev = mesh.devices.size
         jt = p.join_type
-        emit_right = jt in ("inner", "left")
+        # RIGHT outer = LEFT outer with the sides swapped: the probe
+        # side is the row-preserving one, so probe on the original
+        # RIGHT and reorder output columns back afterwards
+        swapped = jt == "right"
+        prog_jt = "left" if swapped else jt
+        emit_right = prog_jt in ("inner", "left")
 
         def run():
-            lbatch, lkeys, lcols, llive = self._gather_side(
-                self.children[0], p.left_keys, n_dev)
-            rbatch, rkeys, rcols, rlive = self._gather_side(
-                self.children[1], p.right_keys, n_dev)
-            key_dts = [c.dtype for c in lkeys]
-            # payload layout: key cols first, then the remaining output
-            # columns of each side (the program probes on the first nk)
-            l_all = lkeys + lcols
-            r_all = rkeys + rcols
-            l_dts = [c.dtype for c in l_all]
-            r_dts = [c.dtype for c in r_all]
+            from ..kernels import strings as skern
+            if swapped:
+                lbatch, lkeys, lcols, llive = self._gather_side(
+                    self.children[1], p.right_keys, n_dev)
+                rbatch, rkeys, rcols, rlive = self._gather_side(
+                    self.children[0], p.left_keys, n_dev)
+            else:
+                lbatch, lkeys, lcols, llive = self._gather_side(
+                    self.children[0], p.left_keys, n_dev)
+                rbatch, rkeys, rcols, rlive = self._gather_side(
+                    self.children[1], p.right_keys, n_dev)
+            # only the REQUIRED output columns ride the all_to_all
+            # (a string join key the parent projects away is words-only)
+            required = getattr(p, "required_out", None)
+            if required is not None:
+                req = set(required)
+                lcols_f, rcols_f = [], []
+                for c, f in zip(lcols, lbatch.schema.fields):
+                    if f.name in req:
+                        lcols_f.append(c)
+                for c, f in zip(rcols, rbatch.schema.fields):
+                    if f.name in req:
+                        rcols_f.append(c)
+                lcols, rcols = lcols_f, rcols_f
+            # key WORDS are computed eagerly with statically-unified
+            # string widths (strings are multi-word; the program routes
+            # words, not key columns)
+            str_widths = []
+            for lk, rk in zip(lkeys, rkeys):
+                if lk.dtype == T.STRING:
+                    w = max(skern.needed_key_words(lk, lbatch.num_rows),
+                            skern.needed_key_words(rk, rbatch.num_rows))
+                    str_widths.append(w)
+                else:
+                    str_widths.append(None)
+            lparts = [canon.batch_key_words([c], lbatch.num_rows,
+                                            str_words=[w])
+                      for c, w in zip(lkeys, str_widths)]
+            rparts = [canon.batch_key_words([c], rbatch.num_rows,
+                                            str_words=[w])
+                      for c, w in zip(rkeys, str_widths)]
+            key_groups = tuple(len(ws) for ws in lparts)
+            assert key_groups == tuple(len(ws) for ws in rparts), \
+                (key_groups, [len(ws) for ws in rparts])
+            lwords = [w for ws in lparts for w in ws]
+            rwords = [w for ws in rparts for w in ws]
+            l_dts = [c.dtype for c in lcols]
+            r_dts = [c.dtype for c in rcols]
 
             sharding = NamedSharding(mesh, P(_AXIS))
-            flat = ([c.data for c in l_all] +
-                    [c.validity for c in l_all] + [llive] +
-                    [c.data for c in r_all] +
-                    [c.validity for c in r_all] + [rlive])
+            flat = (list(lwords) + [c.data for c in lcols] +
+                    [c.validity for c in lcols] + [llive] +
+                    list(rwords) + [c.data for c in rcols] +
+                    [c.validity for c in rcols] + [rlive])
             flat = [jax.device_put(a, sharding) for a in flat]
 
-            program = self._program(mesh, jt, len(lkeys), key_dts,
+            program = self._program(mesh, prog_jt, key_groups,
                                     l_dts, r_dts, emit_right)
             with timed(self.metrics[JOIN_TIME]):
                 out = program(*flat)
             if bool(np.asarray(out[-1]).any()):
-                yield from self._fallback(lbatch, rbatch)
+                yield from self._fallback(lbatch, rbatch, swapped)
                 return
             totals = np.asarray(out[-2]).reshape(-1)
             per = out[0].shape[0] // n_dev
             out_schema = self.output_schema
-            # output columns: left payload (skip the nk key dup cols),
-            # then right payload (skip right keys)
-            nk = len(lkeys)
-            col_slots = []
-            for i in range(len(l_all)):
-                if i >= nk:
-                    col_slots.append(2 * i)
-            if emit_right:
-                base = 2 * len(l_all)
-                for i in range(len(r_all)):
-                    if i >= nk:
-                        col_slots.append(base + 2 * i)
+            # program output layout: probe payload then build payload;
+            # output schema wants original-left columns then
+            # original-right — for a swapped (right outer) run the
+            # build side (original left) comes FIRST in the schema
+            probe_slots = [2 * i for i in range(len(lcols))]
+            build_slots = [2 * len(lcols) + 2 * i
+                           for i in range(len(rcols))] if emit_right \
+                else []
+            col_slots = (build_slots + probe_slots) if swapped else \
+                (probe_slots + build_slots)
             for d in range(n_dev):
                 nr = int(totals[d])
                 if nr == 0:
@@ -276,10 +336,14 @@ class TpuMeshShuffledJoin(TpuExec):
         return [run()]
 
     # ------------------------------------------------------------------
-    def _fallback(self, lbatch: ColumnarBatch, rbatch: ColumnarBatch):
+    def _fallback(self, lbatch: ColumnarBatch, rbatch: ColumnarBatch,
+                  swapped: bool = False):
         """Receive/output region overflowed: rerun via the in-process
         join on the materialized inputs (loud fallback, never silent)."""
         from .tpu_join import TpuShuffledHashJoin
+        if swapped:
+            # the swapped (right outer) run gathered sides reversed
+            lbatch, rbatch = rbatch, lbatch
 
         class _One(PhysicalPlan):
             columnar = True
@@ -295,7 +359,19 @@ class TpuMeshShuffledJoin(TpuExec):
             def execute(self):
                 return [iter([self._b])]
 
-        j = TpuShuffledHashJoin(self.logical, _One(lbatch), _One(rbatch),
-                                build_right=True)
+        j = TpuShuffledHashJoin(
+            self.logical, _One(lbatch), _One(rbatch),
+            # the in-process join realizes RIGHT outer by building on
+            # the LEFT (planner contract: build opposite the preserved
+            # side)
+            build_right=self.logical.join_type != "right")
+        out_schema = self.output_schema
+        prune = len(out_schema) != len(self.logical.schema)
         for part in j.execute():
-            yield from part
+            for b in part:
+                if prune:
+                    keep = {f.name for f in out_schema.fields}
+                    cols = [c for c, f in zip(b.columns, b.schema.fields)
+                            if f.name in keep]
+                    b = ColumnarBatch(out_schema, cols, b.rows_lazy)
+                yield b

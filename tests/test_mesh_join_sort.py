@@ -46,7 +46,7 @@ def _norm(rows):
     return sorted(normed, key=lambda r: tuple(str(v) for v in r))
 
 
-@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+@pytest.mark.parametrize("how", ["inner", "left", "right", "semi", "anti"])
 def test_mesh_join_matches_cpu(how):
     _needs_mesh()
     cpu = _norm(with_cpu_session(lambda s: _join_q(s, how).collect()))
@@ -127,3 +127,89 @@ def test_mesh_sort_with_nulls_and_planned():
     tpu = with_tpu_session(run, conf=MESH_CONF)
     cpu = with_cpu_session(lambda s: q(s).collect())
     assert [r[0] for r in tpu] == [r[0] for r in cpu]
+
+
+def _string_key_tables(s, n=2000, m=400):
+    rng = np.random.default_rng(33)
+    cats = [f"cat_{i:03d}" for i in range(120)]
+    sub = [f"c{i}" for i in range(150)]
+    left = s.create_dataframe({
+        "name": [cats[i] for i in rng.integers(0, 120, n)],
+        "v": rng.integers(-100, 100, n).astype(np.int64),
+    }, num_partitions=4)
+    right = s.create_dataframe({
+        "rname": [sub[i] if i < 150 else cats[i - 150]
+                  for i in rng.integers(0, 270, m)],
+        "w": rng.integers(0, 9, m).astype(np.int64),
+    }, num_partitions=2)
+    return left, right
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_mesh_join_string_keys(how):
+    """String (multi-word) join keys route through the mesh program as
+    eagerly-computed canon words; payloads stay fixed-width, so the
+    key column is projected AWAY (mesh_join_supported's out_ts rule)."""
+    _needs_mesh()
+
+    def q(s):
+        left, right = _string_key_tables(s)
+        j = left.join(right, left["name"] == right["rname"], how)
+        keep = ["v"] if how in ("semi", "anti") else ["v", "w"]
+        return j.select(*keep)
+    cpu = _norm(with_cpu_session(lambda s: q(s).collect()))
+    tpu = _norm(with_tpu_session(lambda s: q(s).collect(),
+                                 conf=MESH_CONF))
+    assert cpu == tpu
+
+
+def test_mesh_join_string_keys_planned():
+    """With required-column pruning, a string-KEY join whose keys are
+    projected away really lands on the mesh exec."""
+    _needs_mesh()
+
+    def q(s):
+        left, right = _string_key_tables(s)
+        return left.join(right, left["name"] == right["rname"],
+                         "inner").select("v", "w")
+
+    def explain(s):
+        return s.explain(q(s)._plan)
+    text = with_tpu_session(explain, conf=MESH_CONF)
+    assert "TpuMeshShuffledJoin" in text
+
+
+def test_mesh_join_supported_accepts_string_keys():
+    """mesh_join_supported accepts STRING keys (multi-word canon
+    encodings route through the all_to_all); only the OUTPUT columns
+    must be fixed-width.  The planner limitation that a logical Join's
+    schema always carries its key columns means string-key joins engage
+    the mesh exec when the keys are fixed-width too — the exec-level
+    string path is covered by test_mesh_join_string_keys."""
+    from spark_rapids_tpu.exec.tpu_mesh_join import mesh_join_supported
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.columnar.schema import Schema
+    import pyarrow as pa
+
+    class _P:
+        join_type = "inner"
+        condition = None
+
+        class _E:
+            def __init__(self, dt):
+                self._dt = dt
+
+            def dtype(self):
+                return self._dt
+    from spark_rapids_tpu.columnar import dtypes as T
+    p = _P()
+    p.left_keys = [_P._E(T.STRING)]
+    p.right_keys = [_P._E(T.STRING)]
+    p.schema = Schema.from_ddl("v long, w long")
+    assert mesh_join_supported(p, 8)
+    # string OUTPUT still blocks (payloads must be fixed-width)
+    p2 = _P()
+    p2.left_keys = [_P._E(T.STRING)]
+    p2.right_keys = [_P._E(T.STRING)]
+    p2.schema = Schema.from_ddl("v string, w long")
+    assert not mesh_join_supported(p2, 8)

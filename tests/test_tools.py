@@ -390,3 +390,46 @@ class TestSparkEventLogQualification:
         out = json.loads(capsys.readouterr().out)
         assert out["total_ms"] == 13500.0
         assert len(out["queries"]) == 3
+
+
+class TestCboExpressionCosts:
+    """Expression-level cost model (GpuExpressionCost role, :296):
+    host-fallback expressions erase the device advantage, flipping the
+    evaluating node to CPU even at large cardinality."""
+
+    def test_regex_project_flips_to_cpu(self):
+        from spark_rapids_tpu.plan import cbo, logical as L
+        from spark_rapids_tpu.expr import core as ec
+        from spark_rapids_tpu.expr import string_ops as es
+        rng = L.Range(0, 600_000, 1, 1)
+        plain = L.Project([ec.AttributeReference("id")], rng)
+        assert cbo.choose_placement(plain)[id(plain)] == "tpu"
+        rx = es.RegexpExtract(
+            ec.AttributeReference("id"), ec.Literal("a(b+)"),
+            ec.Literal(1))
+        heavy = L.Project([rx], rng)
+        # host-round-trip regex taxes the device side per row: CPU wins
+        assert cbo.choose_placement(heavy)[id(heavy)] == "cpu"
+
+    def test_join_type_cardinalities(self):
+        import pyarrow as pa
+        import numpy as np
+        from spark_rapids_tpu.plan import cbo, logical as L
+        from spark_rapids_tpu.expr import core as ec
+        left = L.LocalRelation(
+            pa.table({"a": np.arange(1000, dtype=np.int64)}), 1)
+        right = L.LocalRelation(
+            pa.table({"b": np.arange(100, dtype=np.int64)}), 1)
+        a = ec.AttributeReference("a")
+        b = ec.AttributeReference("b")
+        inner = L.Join(left, right, "inner", [a], [b])
+        semi = L.Join(left, right, "semi", [a], [b])
+        full = L.Join(left, right, "full", [a], [b])
+        cross = L.Join(left, right, "cross", [], [])
+        assert cbo.estimate_rows(inner) == 1000.0
+        assert cbo.estimate_rows(semi) == 500.0
+        assert cbo.estimate_rows(full) == 1100.0
+        assert cbo.estimate_rows(cross) == 100_000.0
+        # global aggregate collapses to one row
+        agg = L.Aggregate([], [], left)
+        assert cbo.estimate_rows(agg) == 1.0
