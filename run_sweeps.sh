@@ -1,0 +1,5 @@
+#!/bin/bash
+cd /root/repo
+python benchmarks/tpcds_sf1.py --verify --resume --queries "q3,q7,q12,q13,q15,q19,q20,q21,q26,q27,q34,q36,q42,q43,q46,q48,q52,q53,q55,q59,q63,q65,q68,q73,q79,q89,q96,q98,q22,q25,q29,q33,q37,q40,q45,q50,q9,q18,q28,q38,q56,q60,q61,q62,q69,q71,q76,q82,q84,q86,q87,q88,q90,q91,q93,q97,q99,q1,q6,q32,q81,q92,q30,q31,q35,q47,q57,q58,q72,q74,q75,q78,q83,q85,q95,q2,q4,q5,q8,q10,q11,q14,q16,q17,q23,q24,q39,q41,q44,q49,q51,q54,q64,q66,q67,q70,q77,q80,q94" >> sf1_sweep.log 2>&1
+python benchmarks/tpcds_sf1.py --scale 10.0 --out benchmarks/tpcds_sf10_times.json --resume --queries "q3,q7,q12,q19,q20,q21,q26,q27,q42,q43,q52,q55,q63,q68,q73,q79,q89,q96,q98,q34" >> sf10_sweep.log 2>&1
+echo SWEEPS_DONE >> sf1_sweep.log

@@ -258,6 +258,14 @@ class TpuHashAggregate(TpuExec):
                     fits = list(spec.fits) + (
                         list(out_spec.fits) if out_spec is not None else [])
                     out._speculative = SpeculativeResult(fits, redo_chain)
+                elif self.mode != PARTIAL and not getattr(
+                        self, "allow_deferred_verify", False):
+                    # the merge itself may have attached a compaction
+                    # fit flag; an unmarked consumer (e.g. a Project)
+                    # would silently DROP it and consume a truncated
+                    # batch, so verify here (PARTIAL outputs flow to
+                    # the exchange, which always verifies)
+                    out = resolve_speculative(out)
             self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
             yield out
         return [run(p) for p in self.children[0].execute()]

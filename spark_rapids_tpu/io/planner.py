@@ -357,9 +357,10 @@ class WriteCommitProtocol:
     deleting its attempt dir, leaving the output untouched.  Job commit
     drops the temp tree and writes the ``_SUCCESS`` marker."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, overwrite: bool = False):
         import uuid
         self.path = path
+        self.overwrite = overwrite
         self.tmp = os.path.join(path, f"_temporary-{uuid.uuid4().hex[:8]}")
         #: job-level stats (BasicColumnarWriteJobStatsTracker metric
         #: names: numFiles / numOutputBytes / numOutputRows / numParts)
@@ -409,8 +410,18 @@ class WriteCommitProtocol:
     def commit_job(self):
         """Promote every committed task's staged files atomically
         (per-file os.replace) into the final directory, then drop the
-        temp tree and write the _SUCCESS marker."""
+        temp tree and write the _SUCCESS marker.  Overwrite mode
+        deletes the PREVIOUS dataset here — after every task has
+        committed — so a failed overwrite leaves the old data intact.
+        """
         import shutil
+        if self.overwrite:
+            for f in os.listdir(self.path):
+                full = os.path.join(self.path, f)
+                if f.startswith("part-") or f == "_SUCCESS":
+                    os.unlink(full)
+                elif "=" in f and os.path.isdir(full):
+                    shutil.rmtree(full)
         staged = os.path.join(self.tmp, "__committed__")
         if os.path.isdir(staged):
             for root, _dirs, files in os.walk(staged):
@@ -476,25 +487,24 @@ def _run_committed_write(lg, child, tables_of, metrics):
     """Shared commit-protocol write driver for both engines:
     ``tables_of(part)`` yields the partition's arrow tables."""
     os.makedirs(lg.path, exist_ok=True)
-    if lg.mode == "overwrite":
-        import shutil
-        for f in os.listdir(lg.path):
-            full = os.path.join(lg.path, f)
-            if f.startswith("part-") or f == "_SUCCESS":
-                # a stale _SUCCESS from the previous dataset must not
-                # survive into a failed overwrite (a consumer would see
-                # a "complete" empty directory)
-                os.unlink(full)
-            elif f.startswith("_temporary") and os.path.isdir(full):
-                # leftover attempt dirs from a crashed writer
-                shutil.rmtree(full)
-            elif "=" in f and os.path.isdir(full):
-                # stale partition dirs from a previous partitioned
-                # write must go even if THIS write is unpartitioned
-                shutil.rmtree(full)
+    if lg.partition_by and any(c.startswith(("_", "."))
+                               for c in lg.partition_by):
+        # readers treat _/. prefixed directories as hidden (commit
+        # temp dirs live there); such partition columns would write
+        # data that every scan silently skips
+        raise ValueError(
+            "partition column names must not start with '_' or '.'")
+    import shutil
+    for f in os.listdir(lg.path):
+        full = os.path.join(lg.path, f)
+        if f.startswith("_temporary") and os.path.isdir(full):
+            # leftover attempt dirs from a crashed writer
+            shutil.rmtree(full)
     parts = child.execute()
     arrow_schema = schema_to_arrow(child.output_schema)
-    proto = WriteCommitProtocol(lg.path)
+    # overwrite deletes the previous dataset at JOB COMMIT, not here:
+    # a failed overwrite must leave the old data intact
+    proto = WriteCommitProtocol(lg.path, overwrite=lg.mode == "overwrite")
     proto.setup_job()
 
     def run(i, part):

@@ -378,26 +378,70 @@ class TestWriteCommitProtocol:
         assert not [n for n in names if n.startswith("_temporary")]
         assert [n for n in names if n.startswith("part-")]
 
-    def test_write_stats_metrics(self, tmp_path):
-        import numpy as np
-        from spark_rapids_tpu.api import TpuSession
-        from spark_rapids_tpu.config import TpuConf
-        s = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": True}))
+    def test_write_stats_tracking(self, tmp_path):
+        """WriteCommitProtocol stats (BasicColumnarWriteStatsTracker
+        role): numFiles/numOutputBytes/numOutputRows and DISTINCT
+        numParts across tasks."""
+        from spark_rapids_tpu.io.planner import WriteCommitProtocol
         out = str(tmp_path / "t2")
-        df = s.create_dataframe(
-            {"v": np.arange(123, dtype=np.int64)}, num_partitions=2)
-        phys = s._plan_physical(df._write_plan("parquet", out)) \
-            if hasattr(s, "_plan_physical") else None
-        if phys is None:
-            # drive through the public API and read execs' metrics via
-            # the write's stats on disk instead
-            df.write.parquet(out)
-            files = [n for n in os.listdir(out)
-                     if n.startswith("part-")]
-            assert files
-            total = sum(os.path.getsize(os.path.join(out, n))
-                        for n in files)
-            assert total > 0
+        os.makedirs(out)
+        proto = WriteCommitProtocol(out)
+        proto.setup_job()
+        for task, rows in ((0, 10), (1, 7)):
+            d = proto.task_dir(task)
+            for part in ("k=0", "k=1"):
+                os.makedirs(os.path.join(d, part), exist_ok=True)
+                with open(os.path.join(d, part,
+                                       f"part-{task:05d}.parquet"),
+                          "wb") as f:
+                    f.write(b"x" * 100)
+            proto.commit_task(task, rows)
+        proto.commit_job()
+        assert proto.stats["numFiles"] == 4
+        assert proto.stats["numOutputBytes"] == 400
+        assert proto.stats["numOutputRows"] == 17
+        # k=0 and k=1 are DISTINCT partitions regardless of task count
+        assert proto.stats["numParts"] == 2
+        assert os.path.exists(os.path.join(out, "_SUCCESS"))
+        assert os.path.exists(os.path.join(out, "k=0",
+                                           "part-00000.parquet"))
+
+    def test_overwrite_failure_keeps_old_data(self, tmp_path,
+                                              monkeypatch):
+        """mode=overwrite deletes the previous dataset at JOB COMMIT:
+        a failed overwrite leaves the old dataset intact."""
+        from tests.harness import with_tpu_session
+        from spark_rapids_tpu.io import planner as P
+        out = str(tmp_path / "t2b")
+        with_tpu_session(lambda s: self._write(s, out, n=30))
+        old = sorted(n for n in os.listdir(out)
+                     if n.startswith("part-"))
+        assert old
+
+        def boom(fmt, table, base):
+            raise RuntimeError("disk exploded")
+        monkeypatch.setattr(P, "_write_table", boom)
+        import pytest as _pytest
+
+        def overwrite(s):
+            import numpy as np
+            df = s.create_dataframe(
+                {"k": np.zeros(5, np.int64), "v": np.zeros(5, np.int64)})
+            df.write.mode("overwrite").parquet(out)
+        with _pytest.raises(Exception, match="disk exploded"):
+            with_tpu_session(overwrite)
+        now = sorted(n for n in os.listdir(out)
+                     if n.startswith("part-"))
+        assert now == old            # old dataset untouched
+        assert "_SUCCESS" in os.listdir(out)
+
+    def test_hidden_partition_column_rejected(self, tmp_path):
+        from tests.harness import with_tpu_session
+        import pytest as _pytest
+        out = str(tmp_path / "t2c")
+        with _pytest.raises(Exception, match="partition column"):
+            with_tpu_session(
+                lambda s: self._write(s, out, partition_by=["_k"]))
 
     def test_abort_leaves_target_clean(self, tmp_path, monkeypatch):
         from tests.harness import with_tpu_session

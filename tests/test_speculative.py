@@ -138,3 +138,31 @@ class TestSpeculativeMisfit:
         conf["spark.rapids.tpu.sql.agg.tableSize"] = 16
         rows = assert_tpu_and_cpu_are_equal_collect(_agg_df, conf=conf)
         assert len(rows) == BANDS * KEYS_PER_BAND
+
+
+class TestCompactionMisfitUnderProject:
+    """Round-5 regression (TPC-DS q97 at SF1): a COMPLETE/FINAL
+    aggregate whose group count exceeds the speculative compaction cap
+    must NOT hand the truncated batch to a consumer that drops the fit
+    flag (a Project re-evaluates columns into fresh batches).  The
+    aggregate verifies its own merge output unless the planner marked
+    the consumer as a deferred-verify barrier."""
+
+    def test_high_cardinality_agg_under_project(self):
+        import numpy as np
+        from tests.harness import assert_tpu_and_cpu_are_equal_collect
+        from spark_rapids_tpu.api import functions as F
+        rng = np.random.default_rng(9)
+        n = 4000
+        data = {"k": rng.integers(0, 1500, n).astype(np.int64),
+                "v": rng.integers(0, 100, n).astype(np.int64)}
+
+        def q(s):
+            df = s.create_dataframe(data, num_partitions=1)
+            agg = df.group_by("k").agg(F.sum("v").alias("sv"))
+            # projection consumer: drops any speculative flag
+            proj = agg.select((F.col("sv") * 2).alias("d"))
+            return proj.agg(F.sum("d").alias("t"), F.count().alias("c"))
+        assert_tpu_and_cpu_are_equal_collect(
+            q, conf={"spark.rapids.tpu.sql.agg.speculativeCompactRows":
+                     64})
