@@ -1,0 +1,90 @@
+"""CI smoke: the concurrent query service under client pressure.
+
+8 client threads push 40 short queries through a QueryService with a
+deliberately tiny admission queue, so some submissions are load-shed.
+Every ACCEPTED query must return row-exact results; shed submissions
+must fail fast with ServiceOverloaded (never hang); the summary reports
+the shed count.  Runs on the virtual 8-device CPU mesh.
+"""
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+N_CLIENTS = 8
+PER_CLIENT = 5          # 40 submissions total
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.api import TpuSession, functions as F
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.service import QueryService, ServiceOverloaded
+
+    s = TpuSession(TpuConf({
+        "spark.rapids.tpu.sql.enabled": True,
+        "spark.rapids.tpu.sql.shuffle.partitions": 4,
+        "spark.rapids.tpu.service.workerThreads": 2,
+        "spark.rapids.tpu.service.admission.maxQueueDepth": 4,
+    }))
+
+    def expected(client):
+        lo, hi = client * 13, client * 13 + 400
+        return sorted(v for v in range(lo, hi) if v % 9 == 0)
+
+    shed = [0] * N_CLIENTS
+    errors = []
+
+    def client_thread(client):
+        lo, hi = client * 13, client * 13 + 400
+        df = s.range(lo, hi, num_partitions=2) \
+            .filter(F.col("id") % 9 == 0)
+        for _ in range(PER_CLIENT):
+            try:
+                h = svc.submit(df, tenant=f"client{client}")
+            except ServiceOverloaded:
+                shed[client] += 1
+                continue
+            try:
+                got = sorted(r["id"]
+                             for r in h.result(timeout=120).to_pylist())
+                if got != expected(client):
+                    errors.append(f"client {client}: wrong rows")
+            except Exception as e:   # noqa: BLE001 - reported below
+                errors.append(f"client {client}: {e!r}")
+
+    with QueryService(s) as svc:
+        threads = [threading.Thread(target=client_thread, args=(c,))
+                   for c in range(N_CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+            if t.is_alive():
+                errors.append("client thread hung")
+    snap = svc.snapshot()
+
+    total_shed = sum(shed)
+    print(f"service smoke: submitted={snap['submitted']} "
+          f"admitted={snap['admitted']} completed={snap['completed']} "
+          f"shed={snap['shed']} (clients saw {total_shed})")
+    assert snap["submitted"] == N_CLIENTS * PER_CLIENT, snap
+    assert snap["shed"] == total_shed, snap
+    assert snap["admitted"] == snap["completed"], snap
+    assert snap["admitted"] + snap["shed"] == snap["submitted"], snap
+    if errors:
+        for e in errors:
+            print("ERROR:", e, file=sys.stderr)
+        sys.exit(1)
+    print("service smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

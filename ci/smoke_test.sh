@@ -26,6 +26,9 @@ PY
 echo "== two-process query (map in child executor, reduce in parent) =="
 python ci/dist_smoke.py
 
+echo "== concurrent query service (8 clients, bounded admission queue) =="
+JAX_PLATFORMS=cpu python ci/service_smoke.py
+
 echo "== api validation (docs vs live registry) =="
 python -m spark_rapids_tpu.tools.api_validation
 

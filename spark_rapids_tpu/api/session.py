@@ -64,24 +64,40 @@ class TpuSessionBuilder:
 
 
 class TpuSession:
+    # Active-session registry: per-thread with a lock-guarded global
+    # fallback, so concurrent client threads each see the session THEY
+    # activated (and its conf) rather than whichever thread activated
+    # last.  The conf registry (config.set_active) follows the same
+    # thread-local-with-global-fallback discipline.
     _active: Optional["TpuSession"] = None
+    _active_tls = threading.local()
+    _active_lock = threading.Lock()
 
     def __init__(self, conf: Optional[TpuConf] = None):
         self.conf = conf or TpuConf()
         set_active(self.conf)
         _enable_compilation_cache()
-        DeviceManager.initialize(self.conf)
-        TpuSession._active = self
+        with TpuSession._active_lock:
+            # device (re)init mutates process-wide state (catalog,
+            # semaphore); serialize concurrent session construction
+            DeviceManager.initialize(self.conf)
+            TpuSession._active = self
+        TpuSession._active_tls.session = self
         self._last_planner: Optional[Planner] = None
         self._views: dict = {}
+        self._logger_lock = threading.Lock()
 
     builder = TpuSessionBuilder
 
     @classmethod
     def active(cls) -> "TpuSession":
-        if cls._active is None:
-            cls._active = TpuSession()
-        return cls._active
+        s = getattr(cls._active_tls, "session", None)
+        if s is not None:
+            return s
+        with cls._active_lock:
+            if cls._active is not None:
+                return cls._active
+        return TpuSession()   # constructor registers itself
 
     # -- conf ----------------------------------------------------------------
     def set_conf(self, key: str, value):
@@ -151,8 +167,8 @@ class TpuSession:
         self._views.pop(name.lower(), None)
 
     # -- execution -----------------------------------------------------------
-    def _plan(self, logical: L.LogicalPlan):
-        planner = Planner(self.conf)
+    def _plan(self, logical: L.LogicalPlan, conf: Optional[TpuConf] = None):
+        planner = Planner(conf or self.conf)
         self._last_planner = planner
         return planner.plan(logical)
 
@@ -174,37 +190,78 @@ class TpuSession:
         phys = self._plan(logical)
         return self.execute_physical(phys)
 
-    def execute_physical(self, phys) -> pa.Table:
+    def execute_physical(self, phys, conf: Optional[TpuConf] = None,
+                         fallbacks: Optional[List[str]] = None) -> pa.Table:
         """Run an ALREADY-PLANNED physical tree and collect one arrow
         table (the distributed runner plans once, attaches executor
-        contexts to exchange nodes, then executes that exact tree)."""
+        contexts to exchange nodes, then executes that exact tree).
+
+        ``conf``/``fallbacks`` override the session's own for callers
+        that planned with an overlay (the query service executes many
+        queries with per-query confs on worker threads; passing them
+        explicitly keeps this method thread-safe against session-level
+        mutation).  Execution drains through cancellation checkpoints
+        and surfaces per-query semaphore-wait and spill-bytes metrics
+        in the event log."""
         import time as _time
         from ..columnar.arrow import to_arrow, schema_to_arrow
         from ..columnar.arrow import stage_batch
+        from ..memory.arena import DeviceManager
+        from ..memory.catalog import BufferCatalog
+        from ..service.cancellation import current_token, observe
+        conf = conf or self.conf
+        if fallbacks is None:
+            fallbacks = self._last_planner.fallbacks \
+                if self._last_planner else []
         t0 = _time.perf_counter()
         self.last_physical_plan = phys
-        # drain all partitions first (device work + staged pulls), then one
-        # fused flush serves every batch's counts/buffers (columnar/pending)
-        from ..columnar.batch import resolve_speculative
-        items = [item if isinstance(item, pa.Table)
-                 else resolve_speculative(item)
-                 for part in phys.execute() for item in part]
-        for item in items:
-            if not isinstance(item, pa.Table):
-                stage_batch(item)
-        tables: List[pa.Table] = []
-        for item in items:
-            t = item if isinstance(item, pa.Table) else to_arrow(item)
-            if t.num_rows:
-                tables.append(t)
-        self._log_query(phys, (_time.perf_counter() - t0) * 1000)
-        # end-of-query shuffle release (ContextCleaner role): map
-        # outputs are per-query; holding them across a long sweep
-        # exhausts the real allocator.  Distributed-attached exchanges
-        # keep their executor-context outputs (peers may still fetch).
-        from ..shuffle.manager import ShuffleManager
-        if ShuffleManager._instance is not None:
-            ShuffleManager._instance.clear_all()
+        sem = DeviceManager.get().semaphore
+        sem.pop_wait_ns()                     # reset this thread's counter
+        cat = BufferCatalog.get()
+        spill0 = cat.spilled_device_to_host + cat.spilled_host_to_disk
+        token = current_token()
+        try:
+            # drain all partitions first (device work + staged pulls),
+            # then one fused flush serves every batch's counts/buffers
+            # (columnar/pending)
+            from ..columnar.batch import resolve_speculative
+            items = [item if isinstance(item, pa.Table)
+                     else resolve_speculative(item)
+                     for part in phys.execute_checkpointed()
+                     for item in part]
+            for item in items:
+                if not isinstance(item, pa.Table):
+                    stage_batch(item)
+            tables: List[pa.Table] = []
+            for item in items:
+                t = item if isinstance(item, pa.Table) else to_arrow(item)
+                if t.num_rows:
+                    tables.append(t)
+        finally:
+            # end-of-query shuffle release (ContextCleaner role): map
+            # outputs are per-query; holding them across a long sweep
+            # exhausts the real allocator.  Under a query context only
+            # THIS query's shuffles are dropped (concurrent peers may
+            # still be draining theirs); distributed-attached exchanges
+            # keep their executor-context outputs (peers may still
+            # fetch).
+            from ..shuffle.manager import ShuffleManager
+            mgr = ShuffleManager._instance
+            if mgr is not None:
+                if token is not None:
+                    for sid in token.pop_owned_shuffles():
+                        mgr.cleanup(sid)
+                else:
+                    mgr.clear_all()
+        sem_wait_ms = sem.pop_wait_ns() / 1e6
+        spill_bytes = (cat.spilled_device_to_host +
+                       cat.spilled_host_to_disk) - spill0
+        observe("sem_wait_ms", sem_wait_ms)
+        observe("spill_bytes", spill_bytes)
+        self._log_query(phys, (_time.perf_counter() - t0) * 1000,
+                        conf=conf, fallbacks=fallbacks,
+                        extra={"sem_wait_ms": round(sem_wait_ms, 3),
+                               "spill_bytes": int(spill_bytes)})
         target = schema_to_arrow(phys.output_schema) if len(
             phys.output_schema) else None
         if not tables:
@@ -218,18 +275,31 @@ class TpuSession:
                  for i, f in enumerate(target)], schema=target)
         return out
 
-    def _log_query(self, phys, wall_ms: float):
+    def _log_query(self, phys, wall_ms: float,
+                   conf: Optional[TpuConf] = None,
+                   fallbacks: Optional[List[str]] = None,
+                   extra: Optional[Dict] = None):
         from ..config import EVENT_LOG_PATH, METRICS_LEVEL
+        from ..service.cancellation import current_token
         from ..tools.events import QueryEventLogger
-        path = self.conf.get(EVENT_LOG_PATH)
-        if not hasattr(self, "_event_logger") or \
-                (self._event_logger.path or "") != (path or ""):
-            self._event_logger = QueryEventLogger(path or None)
-        self.last_query_event = self._event_logger.log_query(
+        conf = conf or self.conf
+        path = conf.get(EVENT_LOG_PATH)
+        with self._logger_lock:
+            if not hasattr(self, "_event_logger") or \
+                    (self._event_logger.path or "") != (path or ""):
+                self._event_logger = QueryEventLogger(path or None)
+            logger = self._event_logger
+        # a service-managed query logs under its stable service query_id
+        # so admission / retry / outcome lines join with engine metrics
+        token = current_token()
+        self.last_query_event = logger.log_query(
             phys, wall_ms,
-            self._last_planner.fallbacks if self._last_planner else [],
-            dict(self.conf._settings),
-            metrics_level=self.conf.get(METRICS_LEVEL))
+            fallbacks if fallbacks is not None else (
+                self._last_planner.fallbacks if self._last_planner else []),
+            dict(conf._settings),
+            metrics_level=conf.get(METRICS_LEVEL),
+            query_id=token.query_id if token is not None else None,
+            extra=extra)
 
     def explain(self, logical: L.LogicalPlan) -> str:
         """Planner explain: physical tree + fallback reasons."""

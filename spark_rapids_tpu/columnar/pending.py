@@ -26,6 +26,7 @@ high-latency remote device.
 """
 from __future__ import annotations
 
+import threading
 import weakref
 from typing import List, Optional, Tuple
 
@@ -35,7 +36,11 @@ import jax.numpy as jnp
 from jax import lax
 
 # Staged items: weakrefs so abandoned handles are never transferred.
+# The pool is process-wide and queries run concurrently under the query
+# service, so stage/flush swaps are serialized by _POOL_LOCK (a lost
+# append would leave a Staged unresolvable).
 _POOL: List["weakref.ref"] = []
+_POOL_LOCK = threading.Lock()
 
 
 class Staged:
@@ -48,7 +53,8 @@ class Staged:
         self._np_dtype = np.dtype(dev.dtype)
         self._shape = tuple(dev.shape)
         self._val: Optional[np.ndarray] = None
-        _POOL.append(weakref.ref(self))
+        with _POOL_LOCK:
+            _POOL.append(weakref.ref(self))
 
     @property
     def resolved(self) -> bool:
@@ -58,6 +64,11 @@ class Staged:
     def np(self) -> np.ndarray:
         if self._val is None:
             flush()
+        if self._val is None and self.dev is not None:
+            # a concurrent flush captured this item but has not decoded
+            # it yet: pull directly (same value; the duplicate transfer
+            # only happens on this narrow race)
+            self._val = np.asarray(self.dev)
         return self._val
 
     def _count(self) -> int:
@@ -204,12 +215,13 @@ FLUSH_COUNT = 0
 def flush():
     """Pull every staged array in at most two fused transfers."""
     global _POOL, FLUSH_COUNT
+    with _POOL_LOCK:
+        pool, _POOL = _POOL, []
     items: List[Staged] = []
-    for w in _POOL:
+    for w in pool:
         it = w()
         if it is not None and it._val is None:
             items.append(it)
-    _POOL = []
     if not items:
         return
     FLUSH_COUNT += 1
@@ -248,4 +260,6 @@ def flush():
 
 
 def pool_size() -> int:
-    return sum(1 for w in _POOL if w() is not None and not w().resolved)
+    with _POOL_LOCK:
+        pool = list(_POOL)
+    return sum(1 for w in pool if w() is not None and not w().resolved)

@@ -349,6 +349,44 @@ PROFILE_TRACE_DIR = conf_str(
     "Capture an XLA/jax profiler trace (xprof / trace-viewer format) "
     "of each query execution into this directory (reference: NVTX "
     "ranges + Nsight, docs/dev/nvtx_profiling.md)")
+SERVICE_WORKERS = conf_int(
+    "spark.rapids.tpu.service.workerThreads", 4,
+    "Executor threads of the in-process query service; each runs one "
+    "admitted query at a time (device concurrency is still bounded "
+    "separately by concurrentTpuTasks / the DeviceSemaphore)")
+SERVICE_MAX_QUEUE_DEPTH = conf_int(
+    "spark.rapids.tpu.service.admission.maxQueueDepth", 64,
+    "Bounded admission queue: submissions beyond this many waiting "
+    "queries are shed with ServiceOverloaded (load shedding keeps "
+    "client latency bounded instead of queueing without limit)")
+SERVICE_MAX_QUEUED_BYTES = conf_bytes(
+    "spark.rapids.tpu.service.admission.maxQueuedBytes", 4 << 30,
+    "Shed submissions once the estimated bytes of queued queries "
+    "(client-provided est_bytes) exceed this; 0 disables the byte "
+    "bound and sheds on depth only")
+SERVICE_DEFAULT_DEADLINE_MS = conf_int(
+    "spark.rapids.tpu.service.defaultDeadlineMs", 0,
+    "Deadline applied to queries submitted without one, in ms from "
+    "admission; past it the query is cooperatively cancelled at the "
+    "next operator checkpoint. 0 = no default deadline")
+SERVICE_RETRY_MAX_ATTEMPTS = conf_int(
+    "spark.rapids.tpu.service.retry.maxAttempts", 3,
+    "Total attempts per query for retryable failures (device OOM, "
+    "shuffle fetch failure) before the error is surfaced (reference: "
+    "the bounded spill-and-retry of DeviceMemoryEventHandler and "
+    "Spark's stage-retry on FetchFailedException)")
+SERVICE_RETRY_BACKOFF_MS = conf_int(
+    "spark.rapids.tpu.service.retry.initialBackoffMs", 50,
+    "Backoff before the first retry; grows by backoffMultiplier per "
+    "attempt. Sleeps are interruptible by cancellation")
+SERVICE_RETRY_BACKOFF_MULT = conf_float(
+    "spark.rapids.tpu.service.retry.backoffMultiplier", 2.0,
+    "Exponential backoff multiplier between retry attempts")
+SERVICE_RETRY_BATCH_DECAY = conf_float(
+    "spark.rapids.tpu.service.retry.batchSizeDecay", 0.5,
+    "Each retry scales the query's batch-size goals (batchSizeRows/"
+    "Bytes, reader batch rows) by this factor so a memory-pressured "
+    "query re-runs at a smaller device footprint")
 
 
 class TpuConf:
@@ -402,16 +440,32 @@ def generate_docs() -> str:
     return "\n".join(lines) + "\n"
 
 
-# process-wide active conf (executor side), guarded for worker threads
-_ACTIVE = TpuConf()
+# Active conf: thread-local with a process-global fallback.  Query
+# threads (service workers, concurrent client sessions) each activate
+# their own conf without clobbering one another; helper threads that
+# never activated one (scan-prefetch producers, shuffle servers) read
+# the process-global, which tracks the most recent activation.
+_ACTIVE_GLOBAL = TpuConf()
 _ACTIVE_LOCK = threading.Lock()
+_ACTIVE_TLS = threading.local()
 
 
 def get_active() -> TpuConf:
-    return _ACTIVE
+    conf = getattr(_ACTIVE_TLS, "conf", None)
+    return conf if conf is not None else _ACTIVE_GLOBAL
 
 
-def set_active(conf: TpuConf):
-    global _ACTIVE
-    with _ACTIVE_LOCK:
-        _ACTIVE = conf
+def set_active(conf: TpuConf, thread_only: bool = False):
+    """Activate ``conf`` for the calling thread (and, unless
+    ``thread_only``, as the process-global fallback for threads that
+    never activate one themselves)."""
+    global _ACTIVE_GLOBAL
+    _ACTIVE_TLS.conf = conf
+    if not thread_only:
+        with _ACTIVE_LOCK:
+            _ACTIVE_GLOBAL = conf
+
+
+def clear_thread_active():
+    """Drop this thread's conf override (falls back to the global)."""
+    _ACTIVE_TLS.conf = None

@@ -15,6 +15,7 @@ import time
 from typing import Dict, Iterator, List
 
 from ..columnar.schema import Schema
+from ..service.cancellation import cancel_checkpoint
 
 ESSENTIAL, MODERATE, DEBUG = "ESSENTIAL", "MODERATE", "DEBUG"
 
@@ -95,12 +96,19 @@ class MetricSet:
 
 
 class timed:
-    """Context manager adding elapsed ns to a metric (NvtxWithMetrics role)."""
+    """Context manager adding elapsed ns to a metric (NvtxWithMetrics role).
+
+    Doubles as the per-operator cancellation checkpoint: entering a
+    timed region is exactly an operator boundary (one batch about to be
+    processed by one node), so a cancelled/deadline-exceeded query
+    unwinds here instead of running its remaining operators — the
+    TaskContext.isInterrupted pattern at columnar granularity."""
 
     def __init__(self, metric: Metric):
         self.metric = metric
 
     def __enter__(self):
+        cancel_checkpoint()
         self.t0 = time.perf_counter_ns()
         return self
 
@@ -126,6 +134,20 @@ class PhysicalPlan:
 
     def execute(self) -> List[Iterator]:
         raise NotImplementedError
+
+    def execute_checkpointed(self) -> List[Iterator]:
+        """execute() with a cooperative cancellation checkpoint at every
+        batch hand-off of every partition (in addition to the per-
+        operator checkpoints inside ``timed``).  The session's collect
+        path drains through this so even plans whose operators never
+        enter a timed region stay cancellable."""
+        cancel_checkpoint()
+
+        def wrap(it):
+            for item in it:
+                cancel_checkpoint()
+                yield item
+        return [wrap(it) for it in self.execute()]
 
     def num_partitions_hint(self) -> int:
         if self.children:

@@ -17,6 +17,7 @@ default is used.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -24,21 +25,77 @@ import jax
 from ..config import (TpuConf, get_active, HBM_POOL_FRACTION, HBM_RESERVE,
                       CONCURRENT_TPU_TASKS, HOST_SPILL_LIMIT, SPILL_DIR,
                       SHUFFLE_COMPRESS)
+from ..service.cancellation import cancel_checkpoint
 from .catalog import BufferCatalog
+
+# blocked acquires poll at this period so cooperative cancellation and
+# deadlines interrupt a queued task instead of leaving it parked on the
+# semaphore until a permit happens to free up
+_ACQUIRE_POLL_S = 0.05
 
 
 class DeviceSemaphore:
-    """Counting semaphore gating concurrent tasks on the device."""
+    """Counting semaphore gating concurrent tasks on the device.
+
+    Waits are observable: time spent blocked accumulates into a
+    per-thread counter (``pop_wait_ns``) that the session surfaces as
+    the per-query ``sem_wait_ms`` metric, and blocked acquires honor the
+    calling thread's query cancellation token (service deadlines do not
+    deadlock behind a saturated device).
+    """
 
     def __init__(self, permits: int):
         self.permits = permits
         self._sem = threading.Semaphore(permits)
         self._held = threading.local()
+        self._wait = threading.local()
 
-    def acquire_if_necessary(self):
+    def acquire_if_necessary(self, deadline: Optional[float] = None):
+        """Acquire one permit for this thread (re-entrant per thread).
+
+        ``deadline`` is an optional time.monotonic() instant; past it a
+        TimeoutError is raised.  While blocked, the active query's
+        CancelToken is checked every poll, so cancellation unwinds a
+        queued task promptly."""
         if getattr(self._held, "count", 0) == 0:
-            self._sem.acquire()
+            if not self._sem.acquire(blocking=False):
+                t0 = time.perf_counter_ns()
+                try:
+                    while True:
+                        cancel_checkpoint()
+                        if deadline is not None and \
+                                time.monotonic() >= deadline:
+                            raise TimeoutError(
+                                "DeviceSemaphore acquire deadline exceeded")
+                        if self._sem.acquire(timeout=_ACQUIRE_POLL_S):
+                            break
+                finally:
+                    self._wait.ns = getattr(self._wait, "ns", 0) + (
+                        time.perf_counter_ns() - t0)
         self._held.count = getattr(self._held, "count", 0) + 1
+
+    def try_acquire(self, timeout: float = 0.0,
+                    deadline: Optional[float] = None) -> bool:
+        """Non-raising acquire: True when a permit was obtained within
+        ``timeout`` seconds (and before ``deadline``, if given)."""
+        if getattr(self._held, "count", 0) > 0:
+            self._held.count += 1
+            return True
+        limit = time.monotonic() + max(0.0, timeout)
+        if deadline is not None:
+            limit = min(limit, deadline)
+        t0 = time.perf_counter_ns()
+        try:
+            while True:
+                step = min(_ACQUIRE_POLL_S, limit - time.monotonic())
+                if self._sem.acquire(timeout=max(step, 0)):
+                    self._held.count = 1
+                    return True
+                if time.monotonic() >= limit:
+                    return False
+        finally:
+            self._wait.ns = getattr(self._wait, "ns", 0) + (
+                time.perf_counter_ns() - t0)
 
     def release(self):
         count = getattr(self._held, "count", 0)
@@ -46,6 +103,26 @@ class DeviceSemaphore:
             self._held.count = count - 1
             if self._held.count == 0:
                 self._sem.release()
+
+    def release_all(self) -> int:
+        """Drop every permit level this THREAD holds (task-completion /
+        cancellation cleanup, the GpuSemaphore.releaseIfNecessary-on-
+        task-end role).  Returns the held count released."""
+        count = getattr(self._held, "count", 0)
+        if count > 0:
+            self._held.count = 0
+            self._sem.release()
+        return count
+
+    def held_count(self) -> int:
+        """Re-entrant hold depth of the calling thread."""
+        return getattr(self._held, "count", 0)
+
+    def pop_wait_ns(self) -> int:
+        """Return and reset this thread's accumulated blocked-wait ns."""
+        ns = getattr(self._wait, "ns", 0)
+        self._wait.ns = 0
+        return ns
 
 
 class DeviceManager:

@@ -109,6 +109,14 @@ class BufferCatalog:
                 buffer_id, StorageTier.DEVICE, nbytes, priority,
                 device_obj=device_obj)
             self.device_bytes += nbytes
+        # attribute the buffer to the active query (if any) so a
+        # cancelled/failed query's leftover registrations can be
+        # unwound by the service (unregister of an already-released id
+        # is a no-op, so double-accounting is harmless)
+        from ..service.cancellation import current_token
+        tok = current_token()
+        if tok is not None:
+            tok.own_buffer(buffer_id)
         return buffer_id
 
     def unregister(self, buffer_id: str):

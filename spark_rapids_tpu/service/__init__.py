@@ -1,0 +1,29 @@
+"""Multi-tenant in-process query service.
+
+Layering (SURVEY §2.3 mechanisms lifted to a serving subsystem):
+  errors.py        typed service errors (overload / cancel / retry budget)
+  cancellation.py  CancelToken + thread-local query context + checkpoints
+  queue.py         bounded admission queue, per-tenant fair scheduling
+  retry.py         OOM / shuffle-fetch retry policy with degradation
+  metrics.py       per-query lifecycle metrics + service counters
+  server.py        QueryService: workers, deadlines, event emission
+
+This package root stays import-light (errors + cancellation only) so
+the memory/ and exec/ layers can use the cancellation primitives
+without dragging the server (and its api/ dependencies) into their
+import graph; ``QueryService`` & co. load lazily on first attribute
+access.
+"""
+from .errors import (ServiceError, ServiceOverloaded,  # noqa: F401
+                     QueryCancelledError, RetryBudgetExhausted)
+from .cancellation import (CancelToken, query_context,  # noqa: F401
+                           cancel_checkpoint, current_token)
+
+_SERVER_NAMES = ("QueryService", "QueryHandle", "QueryRequest")
+
+
+def __getattr__(name):
+    if name in _SERVER_NAMES:
+        from . import server
+        return getattr(server, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

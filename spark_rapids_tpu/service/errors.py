@@ -1,0 +1,61 @@
+"""Typed errors of the query service.
+
+Reference contracts being lifted to the serving layer:
+- load shedding  -> ``ServiceOverloaded`` (the bounded-queue reject path;
+  a serving front-end's 429/RESOURCE_EXHAUSTED analogue);
+- cancellation   -> ``QueryCancelledError`` (Spark's TaskKilledException /
+  job-group cancel contract: cooperative, observed at operator
+  checkpoints, never mid-kernel);
+- retry budget   -> ``RetryBudgetExhausted`` (DeviceMemoryEventHandler's
+  bounded spill-and-retry, generalized to whole-query attempts).
+
+Stdlib-only on purpose: the memory and exec layers import these without
+pulling the server (and its api/ dependencies) into their import graph.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ServiceError(Exception):
+    """Base class for query-service errors."""
+
+
+class ServiceOverloaded(ServiceError):
+    """Admission reject: the bounded queue is full (load shedding).
+
+    Carries the observed queue state so clients can back off
+    intelligently (depth-based vs bytes-based shedding differ).
+    """
+
+    def __init__(self, message: str, queue_depth: int = 0,
+                 queued_bytes: int = 0, max_depth: int = 0,
+                 max_bytes: int = 0):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.queued_bytes = queued_bytes
+        self.max_depth = max_depth
+        self.max_bytes = max_bytes
+
+
+class QueryCancelledError(ServiceError):
+    """The query was cancelled (explicitly or by deadline) and unwound
+    at a cooperative checkpoint.  ``reason`` is 'cancelled' or
+    'deadline'."""
+
+    def __init__(self, reason: str = "cancelled",
+                 query_id: Optional[str] = None):
+        super().__init__(f"query {query_id or '?'} {reason}")
+        self.reason = reason
+        self.query_id = query_id
+
+
+class RetryBudgetExhausted(ServiceError):
+    """A retryable failure (device OOM / shuffle fetch) persisted past
+    the per-query attempt budget; ``last_error`` is the final cause."""
+
+    def __init__(self, attempts: int, last_error: BaseException):
+        super().__init__(
+            f"query failed after {attempts} attempts: {last_error}")
+        self.attempts = attempts
+        self.last_error = last_error

@@ -1,0 +1,68 @@
+"""Per-query service metrics + process-wide service counters.
+
+Every admitted query accumulates one ``QueryMetrics`` across its whole
+lifecycle (admission -> N attempts -> outcome); the server emits it as a
+structured event-log line through QueryEventLogger so the qualification
+and profiling tools can join service-level latency (queue wait,
+semaphore wait) with the per-node engine metrics that already flow
+through ``log_query`` — both carry the same stable ``query_id``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class QueryMetrics:
+    query_id: str
+    tenant: str
+    priority: int
+    est_bytes: int = 0
+    submitted_ts: float = dataclasses.field(default_factory=time.time)
+    queue_wait_ms: float = 0.0
+    sem_wait_ms: float = 0.0
+    execute_ms: float = 0.0
+    spill_bytes: int = 0
+    attempts: int = 1
+    retries: int = 0
+    outcome: str = "pending"   # completed|failed|cancelled|shed
+    error: Optional[str] = None
+
+    def to_record(self) -> Dict:
+        return {
+            "query_id": self.query_id,
+            "tenant": self.tenant,
+            "priority": self.priority,
+            "est_bytes": self.est_bytes,
+            "submitted_ts": round(self.submitted_ts, 6),
+            "queue_wait_ms": round(self.queue_wait_ms, 3),
+            "sem_wait_ms": round(self.sem_wait_ms, 3),
+            "execute_ms": round(self.execute_ms, 3),
+            "spill_bytes": int(self.spill_bytes),
+            "attempts": self.attempts,
+            "retries": self.retries,
+            "outcome": self.outcome,
+            "error": self.error,
+        }
+
+
+class ServiceStats:
+    """Thread-safe monotonic counters for the whole service."""
+
+    _NAMES = ("submitted", "admitted", "shed", "completed", "failed",
+              "cancelled", "deadline_exceeded", "retries")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {n: 0 for n in self._NAMES}
+
+    def inc(self, name: str, by: int = 1):
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + by
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)

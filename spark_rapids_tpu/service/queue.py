@@ -1,0 +1,131 @@
+"""Bounded multi-tenant admission queue with fair scheduling.
+
+Admission control (the GpuSemaphore idea lifted one level up): the
+device semaphore bounds *executing* queries; this queue bounds *waiting*
+ones and sheds load past configurable depth/bytes limits instead of
+letting latency grow without bound (a serving front-end's bounded
+request queue).
+
+Scheduling order:
+1. priority class, higher first (strict: an urgent class always beats a
+   background class);
+2. round-robin across tenants inside a class (a tenant that floods the
+   queue gets 1/N of dequeues, not head-of-line dominance);
+3. FIFO within one tenant.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Dict, Optional
+
+from .errors import ServiceOverloaded
+
+
+class FairQueryQueue:
+    """Items need ``.tenant`` (str), ``.priority`` (int, higher = more
+    urgent) and ``.est_bytes`` (int) attributes."""
+
+    def __init__(self, max_depth: int = 64, max_bytes: int = 0):
+        self.max_depth = max_depth
+        self.max_bytes = max_bytes          # 0 = unlimited
+        self.depth = 0
+        self.queued_bytes = 0
+        self._closed = False
+        # priority -> (tenant -> deque); tenant order IS the round-robin
+        # rotation: serve the first tenant, then move it to the back.
+        self._classes: Dict[int, "OrderedDict[str, deque]"] = {}
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+
+    # -- producer side -----------------------------------------------------
+    def offer(self, item) -> None:
+        """Enqueue or raise ServiceOverloaded (load shedding).  Never
+        blocks: shedding at admission keeps client latency bounded."""
+        est = int(getattr(item, "est_bytes", 0) or 0)
+        with self._not_empty:
+            if self._closed:
+                raise ServiceOverloaded("service is shut down",
+                                        self.depth, self.queued_bytes,
+                                        self.max_depth, self.max_bytes)
+            if self.depth + 1 > self.max_depth:
+                raise ServiceOverloaded(
+                    f"queue depth limit reached ({self.depth}/"
+                    f"{self.max_depth})", self.depth, self.queued_bytes,
+                    self.max_depth, self.max_bytes)
+            if self.max_bytes and self.queued_bytes + est > self.max_bytes:
+                raise ServiceOverloaded(
+                    f"queued-bytes limit reached ({self.queued_bytes}"
+                    f"+{est}>{self.max_bytes})", self.depth,
+                    self.queued_bytes, self.max_depth, self.max_bytes)
+            tenants = self._classes.setdefault(int(item.priority),
+                                               OrderedDict())
+            tenants.setdefault(str(item.tenant), deque()).append(item)
+            self.depth += 1
+            self.queued_bytes += est
+            self._not_empty.notify()
+
+    # -- consumer side -----------------------------------------------------
+    def take(self, timeout: Optional[float] = None):
+        """Next item by (priority desc, tenant round-robin, FIFO), or
+        None on timeout / after close with an empty queue."""
+        with self._not_empty:
+            while True:
+                item = self._pop_locked()
+                if item is not None:
+                    return item
+                if self._closed:
+                    return None
+                if not self._not_empty.wait(timeout):
+                    return None
+
+    def _pop_locked(self):
+        for prio in sorted(self._classes, reverse=True):
+            tenants = self._classes[prio]
+            if not tenants:
+                continue
+            tenant, dq = next(iter(tenants.items()))
+            item = dq.popleft()
+            del tenants[tenant]
+            if dq:                      # re-append at the back: round-robin
+                tenants[tenant] = dq
+            if not tenants:
+                del self._classes[prio]
+            self.depth -= 1
+            self.queued_bytes -= int(getattr(item, "est_bytes", 0) or 0)
+            return item
+        return None
+
+    def remove(self, item) -> bool:
+        """Cancel-while-queued: drop ``item`` if still enqueued."""
+        with self._lock:
+            tenants = self._classes.get(int(item.priority))
+            if not tenants:
+                return False
+            dq = tenants.get(str(item.tenant))
+            if not dq:
+                return False
+            try:
+                dq.remove(item)
+            except ValueError:
+                return False
+            if not dq:
+                del tenants[str(item.tenant)]
+                if not tenants:
+                    del self._classes[int(item.priority)]
+            self.depth -= 1
+            self.queued_bytes -= int(getattr(item, "est_bytes", 0) or 0)
+            return True
+
+    def close(self):
+        """Stop admitting; wake blocked consumers (they drain what is
+        left, then take() returns None)."""
+        with self._not_empty:
+            self._closed = True
+            self._not_empty.notify_all()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"depth": self.depth, "queued_bytes": self.queued_bytes,
+                    "max_depth": self.max_depth,
+                    "max_bytes": self.max_bytes}

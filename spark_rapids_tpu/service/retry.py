@@ -1,0 +1,85 @@
+"""Per-query retry policy: classification, backoff, degradation.
+
+Reference contracts generalized to whole-query attempts:
+- device OOM -> spill-and-retry (DeviceMemoryEventHandler.onAllocFailure;
+  the in-engine oom_retry covers single allocations, this covers the
+  cases it cannot — poisoned async compute, allocator fragmentation that
+  persists across a spill);
+- ShuffleFetchFailedError -> re-run the producing stage (Spark's
+  FetchFailedException / stage-retry contract; the standalone engine
+  re-runs the whole query, which re-runs the map stage).
+
+Each retry degrades the query to smaller batches via a per-attempt conf
+overlay (batchSizeRows/Bytes scaled by ``batchSizeDecay`` ** attempt),
+so an OOM-prone query converges to a footprint that fits instead of
+thrashing the spill tiers at full width.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..config import (TpuConf, BATCH_SIZE_ROWS, BATCH_SIZE_BYTES,
+                      MAX_READER_BATCH_ROWS, SERVICE_RETRY_MAX_ATTEMPTS,
+                      SERVICE_RETRY_BACKOFF_MS, SERVICE_RETRY_BACKOFF_MULT,
+                      SERVICE_RETRY_BATCH_DECAY)
+
+# never degrade below these floors: a 1-row batch makes no progress
+# against fixed per-batch overhead and can underflow capacity bucketing
+_MIN_BATCH_ROWS = 256
+_MIN_BATCH_BYTES = 1 << 20
+
+
+class RetryPolicy:
+    def __init__(self, max_attempts: int = 3, backoff_ms: float = 50.0,
+                 multiplier: float = 2.0, batch_decay: float = 0.5):
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff_ms = float(backoff_ms)
+        self.multiplier = float(multiplier)
+        self.batch_decay = float(batch_decay)
+
+    @classmethod
+    def from_conf(cls, conf: TpuConf) -> "RetryPolicy":
+        return cls(conf.get(SERVICE_RETRY_MAX_ATTEMPTS),
+                   conf.get(SERVICE_RETRY_BACKOFF_MS),
+                   conf.get(SERVICE_RETRY_BACKOFF_MULT),
+                   conf.get(SERVICE_RETRY_BATCH_DECAY))
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        from ..memory.pressure import is_device_oom
+        if is_device_oom(exc):
+            return True
+        from ..shuffle.iterator import ShuffleFetchFailedError
+        return isinstance(exc, ShuffleFetchFailedError)
+
+    def classify(self, exc: BaseException) -> str:
+        from ..memory.pressure import is_device_oom
+        if is_device_oom(exc):
+            return "device_oom"
+        from ..shuffle.iterator import ShuffleFetchFailedError
+        if isinstance(exc, ShuffleFetchFailedError):
+            return "shuffle_fetch_failed"
+        return "fatal"
+
+    def backoff_s(self, attempt: int) -> float:
+        """Exponential backoff before retry ``attempt`` (1-based)."""
+        return (self.backoff_ms / 1000.0) * (
+            self.multiplier ** max(0, attempt - 1))
+
+    def overlay(self, attempt: int, base: TpuConf) -> Dict[str, object]:
+        """Conf overrides for retry ``attempt`` (0 = first try: none).
+
+        Scales the batch-size goals down so the retried query runs at a
+        smaller device footprint."""
+        if attempt <= 0:
+            return {}
+        factor = self.batch_decay ** attempt
+        return {
+            BATCH_SIZE_ROWS.key:
+                max(_MIN_BATCH_ROWS, int(base.get(BATCH_SIZE_ROWS) * factor)),
+            BATCH_SIZE_BYTES.key:
+                max(_MIN_BATCH_BYTES,
+                    int(base.get(BATCH_SIZE_BYTES) * factor)),
+            MAX_READER_BATCH_ROWS.key:
+                max(_MIN_BATCH_ROWS,
+                    int(base.get(MAX_READER_BATCH_ROWS) * factor)),
+        }

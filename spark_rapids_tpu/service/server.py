@@ -1,0 +1,363 @@
+"""QueryService — in-process multi-tenant query serving front-end.
+
+Shape: many client threads submit queries against ONE TPU-backed engine;
+a bounded fair admission queue (queue.py) hands them to a small pool of
+worker threads; each worker plans and executes with a per-query conf
+overlay under a per-query CancelToken (cancellation.py), retrying
+device-OOM / shuffle-fetch failures with exponential backoff and batch
+degradation (retry.py); every lifecycle transition emits a structured
+event-log line keyed by a stable query_id (metrics.py + tools/events).
+
+This lifts the reference's per-task mechanisms (GpuSemaphore admission,
+DeviceMemoryEventHandler spill-and-retry, FetchFailed stage re-run) into
+the serving subsystem an inference-style front-end needs; later scaling
+PRs (multi-process serving, replica routing) plug in above this.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ..api.session import TpuSession
+from ..config import (TpuConf, set_active, EVENT_LOG_PATH,
+                      SERVICE_WORKERS, SERVICE_MAX_QUEUE_DEPTH,
+                      SERVICE_MAX_QUEUED_BYTES, SERVICE_DEFAULT_DEADLINE_MS)
+from ..plan import logical as L
+from ..plan.overrides import Planner
+from .cancellation import CancelToken, query_context
+from .errors import QueryCancelledError, ServiceOverloaded
+from .metrics import QueryMetrics, ServiceStats
+from .queue import FairQueryQueue
+from .retry import RetryPolicy
+
+QUEUED, RUNNING, DONE, FAILED, CANCELLED = (
+    "QUEUED", "RUNNING", "DONE", "FAILED", "CANCELLED")
+
+
+class QueryHandle:
+    """Client-side future for one submitted query."""
+
+    def __init__(self, service: "QueryService", query_id: str,
+                 logical: L.LogicalPlan, tenant: str, priority: int,
+                 est_bytes: int, token: CancelToken,
+                 conf_overrides: Optional[Dict] = None):
+        self._service = service
+        self.query_id = query_id
+        self.logical = logical
+        self.tenant = tenant
+        self.priority = priority
+        self.est_bytes = est_bytes
+        self.token = token
+        self.conf_overrides = dict(conf_overrides or {})
+        self.metrics = QueryMetrics(query_id, tenant, priority, est_bytes)
+        self.status = QUEUED
+        self._done = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    # -- client API --------------------------------------------------------
+    def result(self, timeout: Optional[float] = None):
+        """Block for the outcome: the pa.Table on success, raises the
+        query's error (QueryCancelledError on cancel/deadline) on
+        failure, TimeoutError if not done within ``timeout``."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"query {self.query_id} not done within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def cancel(self, reason: str = "cancelled") -> bool:
+        """Request cooperative cancellation.  A still-queued query is
+        finalized immediately; a running one unwinds at its next
+        checkpoint.  Returns False if the query already finished."""
+        if self._done.is_set():
+            return False
+        self.token.cancel(reason)
+        self._service._cancel_queued(self)
+        return True
+
+    # -- service side ------------------------------------------------------
+    def _finish(self, status: str, result=None,
+                error: Optional[BaseException] = None):
+        self.status = status
+        self._result = result
+        self._error = error
+        self._done.set()
+
+
+class QueryService:
+    """In-process concurrent query service over one engine session."""
+
+    def __init__(self, session: Optional[TpuSession] = None,
+                 num_workers: Optional[int] = None):
+        self.session = session or TpuSession.active()
+        conf = self.session.conf
+        self.num_workers = int(num_workers or conf.get(SERVICE_WORKERS))
+        self.queue = FairQueryQueue(
+            max_depth=conf.get(SERVICE_MAX_QUEUE_DEPTH),
+            max_bytes=conf.get(SERVICE_MAX_QUEUED_BYTES))
+        self.retry = RetryPolicy.from_conf(conf)
+        self.stats = ServiceStats()
+        from ..tools.events import QueryEventLogger
+        self._events = QueryEventLogger(conf.get(EVENT_LOG_PATH) or None)
+        self._default_deadline_ms = conf.get(SERVICE_DEFAULT_DEADLINE_MS)
+        self._seq = itertools.count(1)
+        self._inflight: Dict[str, QueryHandle] = {}
+        self._inflight_lock = threading.Lock()
+        self._workers: List[threading.Thread] = []
+        self._shutdown = False
+        self._start_lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "QueryService":
+        with self._start_lock:
+            if self._workers:
+                return self
+            for i in range(self.num_workers):
+                t = threading.Thread(target=self._worker_loop, daemon=True,
+                                     name=f"tpu-query-service-{i}")
+                t.start()
+                self._workers.append(t)
+        return self
+
+    def shutdown(self, wait: bool = True, timeout: Optional[float] = None,
+                 cancel_running: bool = False):
+        """Stop admitting.  Queued work drains (workers exit once the
+        queue is empty); ``cancel_running`` additionally cancels every
+        in-flight query at its next checkpoint."""
+        self._shutdown = True
+        self.queue.close()
+        if cancel_running:
+            with self._inflight_lock:
+                handles = list(self._inflight.values())
+            for h in handles:
+                h.cancel("cancelled")
+        if wait:
+            deadline = (time.monotonic() + timeout) if timeout else None
+            for t in self._workers:
+                left = None if deadline is None else \
+                    max(0.0, deadline - time.monotonic())
+                t.join(left)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown(wait=True, timeout=30.0, cancel_running=True)
+        return False
+
+    # -- submission --------------------------------------------------------
+    def _to_logical(self, query) -> L.LogicalPlan:
+        if isinstance(query, L.LogicalPlan):
+            return query
+        if isinstance(query, str):
+            return self.session.sql(query)._plan
+        plan = getattr(query, "_plan", None)   # DataFrame
+        if isinstance(plan, L.LogicalPlan):
+            return plan
+        raise TypeError(f"cannot submit {type(query)}: expected a "
+                        "DataFrame, LogicalPlan or SQL string")
+
+    def submit(self, query, tenant: str = "default", priority: int = 0,
+               deadline_ms: Optional[float] = None,
+               conf: Optional[Dict] = None,
+               est_bytes: int = 0) -> QueryHandle:
+        """Admit a query or raise ServiceOverloaded (load shedding).
+
+        ``deadline_ms`` counts from submission (queue wait included —
+        the serving-level definition); falls back to the
+        service.defaultDeadlineMs knob.  ``conf`` is a per-query conf
+        overlay applied on top of the session conf for this query only.
+        """
+        if self._shutdown:
+            raise ServiceOverloaded("service is shut down")
+        self.start()
+        logical = self._to_logical(query)
+        self.stats.inc("submitted")
+        query_id = f"q{next(self._seq):06d}-{uuid.uuid4().hex[:8]}"
+        ms = deadline_ms if deadline_ms is not None else \
+            (self._default_deadline_ms or None)
+        deadline = (time.monotonic() + ms / 1000.0) if ms else None
+        token = CancelToken(query_id, deadline)
+        handle = QueryHandle(self, query_id, logical, tenant, priority,
+                             est_bytes, token, conf)
+        # register BEFORE offering: a fast worker may finish (and
+        # _forget) the query before submit() returns
+        with self._inflight_lock:
+            self._inflight[query_id] = handle
+        try:
+            self.queue.offer(handle)
+        except ServiceOverloaded as e:
+            self._forget(handle)
+            self.stats.inc("shed")
+            handle.metrics.outcome = "shed"
+            handle._finish(FAILED, error=e)
+            self._events.log_service_event(
+                "shed", query_id, tenant=tenant, priority=priority,
+                queue_depth=e.queue_depth, queued_bytes=e.queued_bytes,
+                reason=str(e))
+            raise
+        self.stats.inc("admitted")
+        self._events.log_service_event(
+            "admitted", query_id, tenant=tenant, priority=priority,
+            est_bytes=est_bytes, queue_depth=self.queue.depth,
+            deadline_ms=ms)
+        return handle
+
+    def _cancel_queued(self, handle: QueryHandle):
+        """Finalize a cancel() on a query that has not started yet."""
+        if self.queue.remove(handle):
+            self._finalize_cancel(handle)
+
+    # -- execution ---------------------------------------------------------
+    def _worker_loop(self):
+        while True:
+            handle = self.queue.take(timeout=0.2)
+            if handle is None:
+                if self._shutdown:
+                    return
+                continue
+            try:
+                self._run_one(handle)
+            except BaseException as e:  # noqa: BLE001 - last-resort guard
+                if not handle.done():
+                    handle.metrics.outcome = "failed"
+                    handle.metrics.error = repr(e)
+                    handle._finish(FAILED, error=e)
+                self._forget(handle)
+
+    def _run_one(self, handle: QueryHandle):
+        m = handle.metrics
+        m.queue_wait_ms = (time.time() - m.submitted_ts) * 1000.0
+        if handle.token.cancelled:
+            self._finalize_cancel(handle)
+            return
+        handle.status = RUNNING
+        base_conf = self.session.conf.with_overrides(handle.conf_overrides)
+        attempt = 0
+        while True:
+            m.attempts = attempt + 1
+            try:
+                table = self._execute_attempt(handle, base_conf, attempt)
+            except QueryCancelledError:
+                self._cleanup_failed_attempt(handle)
+                self._finalize_cancel(handle)
+                return
+            except Exception as e:  # noqa: BLE001 - classified below
+                self._cleanup_failed_attempt(handle)
+                retryable = self.retry.is_retryable(e)
+                if retryable and attempt + 1 < self.retry.max_attempts \
+                        and not handle.token.cancelled:
+                    attempt += 1
+                    m.retries += 1
+                    self.stats.inc("retries")
+                    backoff = self.retry.backoff_s(attempt)
+                    self._events.log_service_event(
+                        "retry", handle.query_id, tenant=handle.tenant,
+                        attempt=attempt, reason=self.retry.classify(e),
+                        error=repr(e), backoff_ms=round(backoff * 1e3, 1),
+                        conf_overlay=self.retry.overlay(attempt, base_conf))
+                    if handle.token.wait_cancelled(backoff):
+                        self._finalize_cancel(handle)
+                        return
+                    continue
+                m.outcome = "failed"
+                m.error = repr(e)
+                self.stats.inc("failed")
+                handle._finish(FAILED, error=e)
+                self._emit_outcome(
+                    "failed", handle,
+                    reason=self.retry.classify(e), retryable=retryable)
+                self._forget(handle)
+                return
+            m.outcome = "completed"
+            self.stats.inc("completed")
+            handle._finish(DONE, result=table)
+            self._emit_outcome("completed", handle, rows=table.num_rows)
+            self._forget(handle)
+            return
+
+    def _execute_attempt(self, handle: QueryHandle, base_conf: TpuConf,
+                         attempt: int):
+        """One planning+execution attempt under the query's context,
+        with the retry overlay for this attempt applied."""
+        m = handle.metrics
+        conf = base_conf.with_overrides(self.retry.overlay(attempt,
+                                                           base_conf))
+        with query_context(handle.token) as token:
+            token.observed.clear()
+            token.check()
+            # thread-only: the worker's conf must not leak into other
+            # client threads' get_active()
+            set_active(conf, thread_only=True)
+            t0 = time.perf_counter()
+            planner = Planner(conf)
+            phys = planner.plan(handle.logical)
+            table = self.session.execute_physical(
+                phys, conf=conf, fallbacks=planner.fallbacks)
+            m.execute_ms += (time.perf_counter() - t0) * 1000.0
+            m.sem_wait_ms += token.observed.get("sem_wait_ms", 0.0)
+            m.spill_bytes += int(token.observed.get("spill_bytes", 0))
+            return table
+
+    def _emit_outcome(self, kind: str, handle: QueryHandle, **fields):
+        """Outcome event line = full metrics record + extra fields."""
+        rec = handle.metrics.to_record()
+        rec.pop("query_id", None)       # passed positionally below
+        rec.update(fields)
+        self._events.log_service_event(kind, handle.query_id, **rec)
+
+    # -- cleanup / finalization -------------------------------------------
+    def _cleanup_failed_attempt(self, handle: QueryHandle):
+        """Release everything a dead attempt may still hold: this
+        thread's semaphore permits, the query's shuffle map outputs,
+        and any catalog buffers still registered to it (unregister of
+        an already-released id is a no-op)."""
+        from ..memory.arena import DeviceManager
+        from ..memory.catalog import BufferCatalog
+        from ..shuffle.manager import ShuffleManager
+        DeviceManager.get().semaphore.release_all()
+        mgr = ShuffleManager._instance
+        for sid in handle.token.pop_owned_shuffles():
+            if mgr is not None:
+                mgr.cleanup(sid)
+        cat = BufferCatalog.get()
+        for bid in handle.token.pop_owned_buffers():
+            cat.unregister(bid)
+
+    def _finalize_cancel(self, handle: QueryHandle):
+        reason = handle.token.reason or "cancelled"
+        m = handle.metrics
+        m.outcome = "cancelled"
+        m.error = reason
+        self.stats.inc("cancelled")
+        if reason == "deadline":
+            self.stats.inc("deadline_exceeded")
+        handle._finish(CANCELLED, error=QueryCancelledError(
+            reason, handle.query_id))
+        self._emit_outcome("cancelled", handle, reason=reason)
+        self._forget(handle)
+
+    def _forget(self, handle: QueryHandle):
+        with self._inflight_lock:
+            self._inflight.pop(handle.query_id, None)
+
+    # -- introspection -----------------------------------------------------
+    def snapshot(self) -> Dict:
+        """Service counters + queue state (monitoring endpoint shape)."""
+        out = self.stats.snapshot()
+        out.update(self.queue.stats())
+        with self._inflight_lock:
+            out["inflight"] = len(self._inflight)
+        return out
+
+
+# back-compat alias: a submitted query is the "request"
+QueryRequest = QueryHandle

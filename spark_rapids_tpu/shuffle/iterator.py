@@ -16,9 +16,15 @@ import queue
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..columnar.batch import ColumnarBatch
+from ..service.cancellation import cancel_checkpoint
 from .client import (RapidsShuffleClient, RapidsShuffleFetchHandler,
                      ReceivedBufferHandle)
 from .transport import BlockIdSpec, RapidsShuffleTransport
+
+# queue polls are sliced to this period so a cancelled/deadline-exceeded
+# query unwinds out of a shuffle wait promptly instead of sitting the
+# full fetch timeout
+_POLL_SLICE_S = 0.25
 
 
 class ShuffleFetchFailedError(Exception):
@@ -90,8 +96,27 @@ class RapidsShuffleIterator(Iterator[ColumnarBatch]):
             c.close()
         self._clients = []
 
+    def _poll(self):
+        """One queue item, polling in short slices: cancellation is
+        checked between slices (a cancelled query must not sit out the
+        whole fetch timeout), and only contiguous waiting counts toward
+        ``timeout_s``."""
+        import time as _time
+        deadline = _time.monotonic() + self.timeout_s
+        while True:
+            cancel_checkpoint()
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise queue.Empty
+            try:
+                return self._queue.get(
+                    timeout=min(_POLL_SLICE_S, remaining))
+            except queue.Empty:
+                continue
+
     def __next__(self) -> ColumnarBatch:
         if self._local:
+            cancel_checkpoint()
             return self._local.pop(0)
         if not self._started:
             if not self._remote:
@@ -103,12 +128,17 @@ class RapidsShuffleIterator(Iterator[ColumnarBatch]):
                 self._close_clients()
                 raise StopIteration
             try:
-                kind, payload = self._queue.get(timeout=self.timeout_s)
+                kind, payload = self._poll()
             except queue.Empty:
                 self._close_clients()
                 raise ShuffleFetchFailedError(
                     None, f"shuffle fetch timed out after "
                           f"{self.timeout_s}s") from None
+            except BaseException:
+                # cancellation (or any other unwind) must not orphan
+                # the fetch clients' socket threads
+                self._close_clients()
+                raise
             if kind == "count":
                 self._expected_remote += payload
                 self._counts_pending -= 1

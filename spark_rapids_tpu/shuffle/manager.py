@@ -134,7 +134,15 @@ class ShuffleManager:
         with self._lock:
             sid = self._next_shuffle
             self._next_shuffle += 1
-            return sid
+        # attribute the shuffle to the active query (if any): concurrent
+        # queries through the service clean up per-shuffle-id instead of
+        # clear_all(), which would drop map outputs a peer query is
+        # still draining
+        from ..service.cancellation import current_token
+        tok = current_token()
+        if tok is not None:
+            tok.own_shuffle(sid)
+        return sid
 
     def clear_all(self):
         """Drop every shuffle's map output (the ContextCleaner role:

@@ -23,15 +23,27 @@ class QueryEventLogger:
         self.path = path or os.environ.get(
             "SPARK_RAPIDS_TPU_EVENT_LOG", "")
         self._next_id = 0
+        self._id_lock = threading.Lock()
 
     def enabled(self) -> bool:
         return bool(self.path)
 
     def log_query(self, phys_plan, wall_ms: float, fallbacks: List[str],
-                  conf_dict: Dict, metrics_level: str = "MODERATE"):
-        self._next_id += 1
+                  conf_dict: Dict, metrics_level: str = "MODERATE",
+                  query_id=None, extra: Optional[Dict] = None):
+        """One engine-execution record.  ``query_id``, when provided by
+        the caller (the query service), is STABLE across every event of
+        that query — admission, each retry attempt, engine metrics,
+        final outcome — so the qualification/profiling tools can join
+        attempts of the same query; otherwise a logger-local id is
+        assigned."""
+        if query_id is None:
+            with self._id_lock:
+                self._next_id += 1
+                query_id = self._next_id
         record = {
-            "query_id": self._next_id,
+            "event": "query",
+            "query_id": query_id,
             "ts": time.time(),
             "wall_ms": round(wall_ms, 3),
             "physical_plan": phys_plan.tree_string(),
@@ -42,20 +54,46 @@ class QueryEventLogger:
                 for i, n in enumerate(phys_plan.collect_nodes())},
             "conf": {k: v for k, v in conf_dict.items()},
         }
+        if extra:
+            record.update(extra)
+        self._append(record)
+        return record
+
+    def log_service_event(self, kind: str, query_id, **fields):
+        """One service-lifecycle line: kind is admitted | shed | retry |
+        cancelled | completed | failed.  Shares the query's stable
+        ``query_id`` with the engine records."""
+        record = {"event": kind, "query_id": query_id, "ts": time.time()}
+        record.update(fields)
+        self._append(record)
+        return record
+
+    def _append(self, record: Dict):
         if not self.enabled():
-            return record
+            return
         with _LOCK:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
             with open(self.path, "a") as f:
                 f.write(json.dumps(record) + "\n")
-        return record
 
 
-def read_event_log(path: str) -> List[Dict]:
+def read_event_log(path: str, events: Optional[str] = "query") -> List[Dict]:
+    """Parsed event-log records.
+
+    ``events`` filters by record kind: the default "query" returns only
+    engine-execution records (what the qualification/profiling tools
+    consume — service lifecycle lines would skew their per-query
+    statistics); pass a specific kind ("retry", "shed", ...) or None
+    for everything."""
     out = []
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
-                out.append(json.loads(line))
+            if not line:
+                continue
+            rec = json.loads(line)
+            kind = rec.get("event", "query")
+            if events is not None and kind != events:
+                continue
+            out.append(rec)
     return out

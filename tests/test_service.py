@@ -1,0 +1,584 @@
+"""Concurrent query service tests.
+
+Covers the serving subsystem end to end on the virtual CPU mesh:
+admission control + fair queueing + load shedding, per-query deadlines
+and cooperative cancellation (with resource release back to baseline),
+device-OOM retry with batch degradation, thread-safe conf/session
+activation, the per-query semaphore-wait metric, stable query_id across
+the event log, and the multi-tenant stress acceptance test.
+"""
+import threading
+import time
+import types
+
+import pytest
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.config import (
+    TpuConf, get_active, BATCH_SIZE_ROWS, BATCH_SIZE_BYTES)
+from spark_rapids_tpu.memory.arena import DeviceManager, DeviceSemaphore
+from spark_rapids_tpu.memory.catalog import BufferCatalog
+from spark_rapids_tpu.service import (
+    CancelToken, QueryCancelledError, ServiceOverloaded, QueryService,
+    cancel_checkpoint, query_context)
+from spark_rapids_tpu.service.queue import FairQueryQueue
+from spark_rapids_tpu.service.retry import RetryPolicy
+from spark_rapids_tpu.tools.events import read_event_log
+from spark_rapids_tpu.udf import pandas_udf
+
+
+def _item(tenant, priority, est_bytes=0, tag=None):
+    return types.SimpleNamespace(tenant=tenant, priority=priority,
+                                 est_bytes=est_bytes, tag=tag)
+
+
+def _tpu_session(extra=None):
+    settings = {"spark.rapids.tpu.sql.enabled": True,
+                "spark.rapids.tpu.sql.shuffle.partitions": 4}
+    settings.update(extra or {})
+    return TpuSession(TpuConf(settings))
+
+
+def _rows(table):
+    return sorted(tuple(r.values()) for r in table.to_pylist())
+
+
+def _drain_semaphore():
+    """Every permit must be takeable => nothing leaked a hold."""
+    sem = DeviceManager.get().semaphore
+    got = [sem._sem.acquire(blocking=False) for _ in range(sem.permits)]
+    for ok in got:
+        if ok:
+            sem._sem.release()
+    return all(got)
+
+
+# ---------------------------------------------------------------------------
+# unit: fair queue
+# ---------------------------------------------------------------------------
+
+class TestFairQueue:
+    def test_depth_shedding(self):
+        q = FairQueryQueue(max_depth=2)
+        q.offer(_item("a", 0))
+        q.offer(_item("a", 0))
+        with pytest.raises(ServiceOverloaded) as ei:
+            q.offer(_item("a", 0))
+        assert ei.value.queue_depth == 2
+        assert ei.value.max_depth == 2
+
+    def test_bytes_shedding(self):
+        q = FairQueryQueue(max_depth=10, max_bytes=100)
+        q.offer(_item("a", 0, est_bytes=60))
+        with pytest.raises(ServiceOverloaded):
+            q.offer(_item("a", 0, est_bytes=50))
+        # a small one still fits
+        q.offer(_item("b", 0, est_bytes=40))
+        assert q.stats()["queued_bytes"] == 100
+
+    def test_priority_then_tenant_round_robin(self):
+        q = FairQueryQueue(max_depth=16)
+        for tag in ("a1", "a2", "a3"):
+            q.offer(_item("A", 0, tag=tag))
+        for tag in ("b1", "b2"):
+            q.offer(_item("B", 0, tag=tag))
+        q.offer(_item("C", 5, tag="hi"))
+        order = [q.take(0.1).tag for _ in range(6)]
+        # strict priority first, then A/B alternate, FIFO within tenant
+        assert order[0] == "hi"
+        assert order[1:] == ["a1", "b1", "a2", "b2", "a3"]
+
+    def test_remove_and_close(self):
+        q = FairQueryQueue(max_depth=4)
+        it = _item("a", 0, tag="x")
+        q.offer(it)
+        assert q.remove(it) is True
+        assert q.remove(it) is False
+        assert q.stats()["depth"] == 0
+        q.close()
+        assert q.take(0.1) is None
+        with pytest.raises(ServiceOverloaded):
+            q.offer(_item("a", 0))
+
+
+# ---------------------------------------------------------------------------
+# unit: retry policy + cancel token + semaphore integration
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_classification(self):
+        from spark_rapids_tpu.shuffle.iterator import ShuffleFetchFailedError
+        p = RetryPolicy()
+        oom = RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating")
+        assert p.is_retryable(oom)
+        assert p.classify(oom) == "device_oom"
+        fetch = ShuffleFetchFailedError(None, "peer gone")
+        assert p.is_retryable(fetch)
+        assert p.classify(fetch) == "shuffle_fetch_failed"
+        assert not p.is_retryable(ValueError("nope"))
+        assert p.classify(ValueError("nope")) == "fatal"
+
+    def test_backoff_and_overlay(self):
+        p = RetryPolicy(max_attempts=4, backoff_ms=10, multiplier=2.0,
+                        batch_decay=0.5)
+        assert p.backoff_s(1) == pytest.approx(0.010)
+        assert p.backoff_s(3) == pytest.approx(0.040)
+        base = TpuConf({BATCH_SIZE_ROWS.key: 4096})
+        assert p.overlay(0, base) == {}
+        o1 = p.overlay(1, base)
+        assert o1[BATCH_SIZE_ROWS.key] == 2048
+        # floors hold: decay never goes below the minimum batch
+        o9 = p.overlay(9, base)
+        assert o9[BATCH_SIZE_ROWS.key] == 256
+        assert o9[BATCH_SIZE_BYTES.key] == 1 << 20
+
+
+class TestCancelToken:
+    def test_deadline_auto_cancel(self):
+        tok = CancelToken("q1", deadline=time.monotonic() + 0.05)
+        assert not tok.cancelled
+        time.sleep(0.08)
+        assert tok.cancelled
+        assert tok.reason == "deadline"
+        with pytest.raises(QueryCancelledError):
+            tok.check()
+
+    def test_checkpoint_only_fires_inside_context(self):
+        cancel_checkpoint()          # no active query: must be a no-op
+        tok = CancelToken("q2")
+        tok.cancel("cancelled")
+        with query_context(tok):
+            with pytest.raises(QueryCancelledError):
+                cancel_checkpoint()
+        cancel_checkpoint()          # context restored
+
+    def test_wait_cancelled_interrupts(self):
+        tok = CancelToken("q3")
+        threading.Timer(0.05, tok.cancel).start()
+        t0 = time.monotonic()
+        assert tok.wait_cancelled(5.0) is True
+        assert time.monotonic() - t0 < 1.0
+
+    def test_semaphore_wait_is_cancellable_and_accounted(self):
+        sem = DeviceSemaphore(1)
+        holder_ready = threading.Event()
+        release = threading.Event()
+
+        def hold():
+            sem.acquire_if_necessary()
+            holder_ready.set()
+            release.wait(10)
+            sem.release()
+
+        t = threading.Thread(target=hold)
+        t.start()
+        holder_ready.wait(10)
+        # a cancelled query blocked on the semaphore unwinds promptly
+        tok = CancelToken("q4", deadline=time.monotonic() + 0.1)
+        sem.pop_wait_ns()
+        with query_context(tok):
+            with pytest.raises(QueryCancelledError):
+                sem.acquire_if_necessary()
+        assert sem.pop_wait_ns() > 0       # blocked time was recorded
+        release.set()
+        t.join(10)
+        assert sem.held_count() == 0
+        assert _drain_semaphore()
+
+
+# ---------------------------------------------------------------------------
+# satellite: thread-safe conf/session activation
+# ---------------------------------------------------------------------------
+
+class TestActiveConfThreadSafety:
+    def test_two_threads_do_not_cross_observe_confs(self):
+        rows_a, rows_b = 111, 222
+        barrier = threading.Barrier(2, timeout=30)
+        errors = []
+
+        def client(batch_rows, results):
+            try:
+                s = _tpu_session({BATCH_SIZE_ROWS.key: batch_rows})
+                barrier.wait()
+                for _ in range(5):
+                    assert get_active().get(BATCH_SIZE_ROWS) == batch_rows
+                    assert TpuSession.active() is s
+                    got = s.range(0, 100, num_partitions=2) \
+                        .filter(F.col("id") % 9 == 0).collect()
+                    assert sorted(v for v, in got) == list(range(0, 100, 9))
+                    assert get_active().get(BATCH_SIZE_ROWS) == batch_rows
+                    assert TpuSession.active() is s
+                results.append(s)
+            except Exception as e:       # noqa: BLE001 - surfaced below
+                errors.append(e)
+                try:
+                    barrier.abort()
+                except Exception:
+                    pass
+
+        res_a, res_b = [], []
+        ta = threading.Thread(target=client, args=(rows_a, res_a))
+        tb = threading.Thread(target=client, args=(rows_b, res_b))
+        ta.start(); tb.start()
+        ta.join(60); tb.join(60)
+        assert not errors, errors
+        assert res_a and res_b and res_a[0] is not res_b[0]
+
+
+# ---------------------------------------------------------------------------
+# service: basic completion, shedding, deadlines, cancellation
+# ---------------------------------------------------------------------------
+
+class TestServiceBasic:
+    def test_concurrent_queries_row_exact(self):
+        s = _tpu_session()
+        expected = sorted((v,) for v in range(1000) if v % 7 == 0)
+        with QueryService(s, num_workers=3) as svc:
+            handles = [svc.submit(
+                s.range(0, 1000, num_partitions=2)
+                .filter(F.col("id") % 7 == 0),
+                tenant=f"t{i % 3}", priority=i % 2)
+                for i in range(9)]
+            for h in handles:
+                assert _rows(h.result(timeout=120)) == expected
+                assert h.status == "DONE"
+        snap = svc.snapshot()
+        assert snap["submitted"] == snap["admitted"] == 9
+        assert snap["completed"] == 9
+        assert snap["shed"] == snap["failed"] == snap["cancelled"] == 0
+        assert snap["inflight"] == 0 and snap["depth"] == 0
+
+    def test_sql_and_dataframe_submission(self):
+        s = _tpu_session()
+        df = s.create_dataframe(
+            {"k": [1, 2, 1, 2], "v": [10, 20, 30, 40]})
+        s.register_table("tv", df)
+        with QueryService(s, num_workers=2) as svc:
+            h_sql = svc.submit("SELECT k, SUM(v) AS sv FROM tv GROUP BY k")
+            h_df = svc.submit(df.group_by("k").agg(F.sum("v").alias("sv")))
+            assert _rows(h_sql.result(60)) == [(1, 40), (2, 60)]
+            assert _rows(h_df.result(60)) == [(1, 40), (2, 60)]
+        with pytest.raises(TypeError):
+            QueryService(s)._to_logical(12345)
+
+    def test_load_shedding_when_saturated(self):
+        s = _tpu_session()
+        gate = threading.Event()
+        started = threading.Event()
+
+        def _blocked(series):
+            started.set()
+            gate.wait(30)
+            return series
+        blocker = pandas_udf(_blocked, return_type=T.INT64)
+        df_slow = s.range(0, 8).select(blocker(F.col("id")).alias("id"))
+        df_fast = s.range(0, 8)
+        svc = QueryService(
+            s, num_workers=1)
+        svc.queue = FairQueryQueue(max_depth=1)
+        svc.start()
+        try:
+            h_run = svc.submit(df_slow, tenant="slow")
+            assert started.wait(30)          # worker is now busy
+            h_q = svc.submit(df_fast, tenant="fast")     # fills the queue
+            with pytest.raises(ServiceOverloaded):
+                svc.submit(df_fast, tenant="fast")       # shed
+            gate.set()
+            assert h_run.result(60).num_rows == 8
+            assert h_q.result(60).num_rows == 8
+        finally:
+            gate.set()
+            svc.shutdown(wait=True, timeout=30)
+        snap = svc.snapshot()
+        assert snap["shed"] == 1
+        assert snap["completed"] == 2
+
+
+class TestDeadlinesAndCancellation:
+    def _slow_df(self, s, started=None, sleep_s=0.05):
+        def _slow(series):
+            if started is not None:
+                started.set()
+            time.sleep(sleep_s)
+            return series
+        slow = pandas_udf(_slow, return_type=T.INT64)
+        return s.create_dataframe(
+            {"k": [i % 4 for i in range(64)],
+             "v": list(range(64))}, num_partitions=2) \
+            .group_by("k").agg(F.sum("v").alias("sv")) \
+            .select(F.col("k"), slow(F.col("sv")).alias("sv"))
+
+    def test_deadline_exceeded_reports_cancelled(self):
+        s = _tpu_session()
+        with QueryService(s, num_workers=2) as svc:
+            h = svc.submit(self._slow_df(s), tenant="dl", deadline_ms=60)
+            t0 = time.monotonic()
+            with pytest.raises(QueryCancelledError) as ei:
+                h.result(timeout=60)        # bounded: no deadlock
+            assert time.monotonic() - t0 < 30
+            assert ei.value.reason == "deadline"
+            assert h.status == "CANCELLED"
+            assert h.metrics.outcome == "cancelled"
+        assert svc.snapshot()["deadline_exceeded"] == 1
+
+    def test_cancel_while_queued(self):
+        s = _tpu_session()
+        gate = threading.Event()
+        started = threading.Event()
+
+        def _blocked(series):
+            started.set()
+            gate.wait(30)
+            return series
+        blocker = pandas_udf(_blocked, return_type=T.INT64)
+        svc = QueryService(s, num_workers=1).start()
+        try:
+            h_run = svc.submit(
+                s.range(0, 8).select(blocker(F.col("id")).alias("id")))
+            assert started.wait(30)
+            h_q = svc.submit(s.range(0, 8))
+            assert h_q.cancel() is True
+            with pytest.raises(QueryCancelledError):
+                h_q.result(timeout=10)
+            assert h_q.status == "CANCELLED"
+            gate.set()
+            assert h_run.result(60).num_rows == 8
+        finally:
+            gate.set()
+            svc.shutdown(wait=True, timeout=30)
+
+    def test_mid_execution_cancel_releases_resources(self):
+        s = _tpu_session()
+        cat = BufferCatalog.get()
+        # settle baseline with one warmup through the service
+        with QueryService(s, num_workers=1) as warm:
+            warm.submit(self._slow_df(s, sleep_s=0.0)).result(60)
+        base_bytes = cat.device_bytes
+        base_entries = len(cat._entries)
+
+        started = threading.Event()
+        with QueryService(s, num_workers=1) as svc:
+            h = svc.submit(self._slow_df(s, started=started, sleep_s=0.1),
+                           tenant="victim")
+            assert started.wait(30)          # mid-execution now
+            assert h.cancel("cancelled") is True
+            t0 = time.monotonic()
+            with pytest.raises(QueryCancelledError) as ei:
+                h.result(timeout=60)
+            assert time.monotonic() - t0 < 30     # unwound, no deadlock
+            assert ei.value.reason == "cancelled"
+            assert h.status == "CANCELLED"
+        # arena back to baseline: no leaked catalog buffers, no held
+        # semaphore permits, no orphaned shuffle map outputs
+        assert cat.device_bytes == base_bytes
+        assert len(cat._entries) == base_entries
+        assert _drain_semaphore()
+        assert not h.token.pop_owned_buffers()
+        assert not h.token.pop_owned_shuffles()
+
+
+# ---------------------------------------------------------------------------
+# retry + event log: stable query_id, sem-wait metric, OOM degradation
+# ---------------------------------------------------------------------------
+
+class TestRetryAndEventLog:
+    def test_oom_retry_succeeds_with_stable_query_id(self, tmp_path):
+        log = str(tmp_path / "events.jsonl")
+        s = _tpu_session({"spark.rapids.tpu.eventLog.path": log,
+                          "spark.rapids.tpu.service.retry"
+                          ".initialBackoffMs": 5})
+        calls = {"n": 0}
+
+        def _flaky(series):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("RESOURCE_EXHAUSTED: injected test OOM")
+            return series
+        flaky = pandas_udf(_flaky, return_type=T.INT64)
+        df = s.create_dataframe(
+            {"k": [1, 2, 1, 2], "v": [5, 6, 7, 8]}) \
+            .group_by("k").agg(F.sum("v").alias("sv")) \
+            .select(F.col("k"), flaky(F.col("sv")).alias("sv"))
+        with QueryService(s, num_workers=1) as svc:
+            h = svc.submit(df, tenant="flaky")
+            assert _rows(h.result(120)) == [(1, 12), (2, 14)]
+        assert h.metrics.attempts == 2
+        assert h.metrics.retries == 1
+        assert svc.snapshot()["retries"] == 1
+
+        recs = read_event_log(log, events=None)
+        mine = [r for r in recs if r.get("query_id") == h.query_id]
+        kinds = [r["event"] for r in mine]
+        # one stable id joins admission -> retry -> engine runs -> outcome
+        assert kinds.count("admitted") == 1
+        assert kinds.count("retry") == 1
+        assert kinds.count("completed") == 1
+        assert kinds.count("query") >= 1     # attempt 2's engine record
+        retry_rec = next(r for r in mine if r["event"] == "retry")
+        assert retry_rec["reason"] == "device_oom"
+        # the retry attempt ran degraded: smaller batch-size overlay
+        overlay = retry_rec["conf_overlay"]
+        assert overlay[BATCH_SIZE_ROWS.key] < \
+            s.conf.get(BATCH_SIZE_ROWS)
+        done_rec = next(r for r in mine if r["event"] == "completed")
+        assert done_rec["outcome"] == "completed"
+        assert done_rec["attempts"] == 2
+        for key in ("queue_wait_ms", "sem_wait_ms", "execute_ms",
+                    "spill_bytes"):
+            assert key in done_rec
+        # engine records carry the per-query device metrics too
+        for r in mine:
+            if r["event"] == "query":
+                assert "sem_wait_ms" in r and "spill_bytes" in r
+
+    def test_fatal_error_not_retried(self):
+        s = _tpu_session()
+
+        def _boom(series):
+            raise ValueError("schema drift: not retryable")
+        boom = pandas_udf(_boom, return_type=T.INT64)
+        df = s.range(0, 8).select(boom(F.col("id")).alias("id"))
+        with QueryService(s, num_workers=1) as svc:
+            h = svc.submit(df)
+            with pytest.raises(ValueError):
+                h.result(60)
+        assert h.status == "FAILED"
+        assert h.metrics.attempts == 1
+        assert svc.snapshot()["retries"] == 0
+        assert svc.snapshot()["failed"] == 1
+
+    def test_default_event_log_read_hides_service_lines(self, tmp_path):
+        log = str(tmp_path / "events.jsonl")
+        s = _tpu_session({"spark.rapids.tpu.eventLog.path": log})
+        with QueryService(s, num_workers=1) as svc:
+            svc.submit(s.range(0, 16)).result(60)
+        engine_only = read_event_log(log)
+        assert engine_only and all(
+            r["event"] == "query" for r in engine_only)
+        everything = read_event_log(log, events=None)
+        assert {"admitted", "completed", "query"} <= {
+            r["event"] for r in everything}
+
+
+# ---------------------------------------------------------------------------
+# acceptance: multi-tenant stress under a spill-forcing arena budget
+# ---------------------------------------------------------------------------
+
+class TestServiceStress:
+    N_CLIENTS = 8
+    PER_CLIENT = 7          # 56 queries total
+
+    N_ROWS = 600
+
+    def _expected_groupby(self, client):
+        sums = {}
+        for i in range(self.N_ROWS):
+            sums[i % 5] = sums.get(i % 5, 0) + (i + client)
+        return sorted(sums.items())
+
+    def test_stress_multi_tenant_spill_deadlines_no_leaks(self):
+        s = _tpu_session({
+            "spark.rapids.tpu.sql.concurrentTpuTasks": 2,
+            # several sorted runs per partition + ooc merge: the sort
+            # shape below must go through the spillable-run path
+            "spark.rapids.tpu.sql.batchSizeRows": 512,
+            "spark.rapids.tpu.sql.reader.batchSizeRows": 512,
+            "spark.rapids.tpu.sql.sort.outOfCore.chunkRows": 600})
+        cat = BufferCatalog.get()
+        base_bytes = cat.device_bytes
+        base_entries = len(cat._entries)
+        spill0 = cat.spilled_device_to_host + cat.spilled_host_to_disk
+
+        def _slow(series):
+            time.sleep(0.02)
+            return series
+        slow = pandas_udf(_slow, return_type=T.INT64)
+
+        def make_df(client, j):
+            if j == 0:
+                # out-of-core sort: 4000 rows >> chunkRows under a
+                # 16 KiB device budget — buffered runs must spill
+                vals = [(i * 2654435761 + client) % 100003
+                        for i in range(4000)]
+                return (s.create_dataframe({"k": vals}, num_partitions=1)
+                        .order_by("k"),
+                        sorted((v,) for v in vals))
+            data = {"k": [i % 5 for i in range(self.N_ROWS)],
+                    "v": [i + client for i in range(self.N_ROWS)]}
+            if j % 2 == 0:
+                df = s.create_dataframe(data, num_partitions=2) \
+                    .group_by("k").agg(F.sum("v").alias("sv")) \
+                    .order_by("k")
+                if client == 0:       # the artificially slow tenant
+                    df = df.select(F.col("k"),
+                                   slow(F.col("sv")).alias("sv"))
+                return df, self._expected_groupby(client)
+            lo, hi = client * 10, client * 10 + 300
+            return (s.range(lo, hi, num_partitions=2)
+                    .filter(F.col("id") % 11 == 0),
+                    sorted((v,) for v in range(lo, hi) if v % 11 == 0))
+
+        old_limit = cat.device_limit
+        cat.device_limit = 1 << 14        # tiny budget: force spilling
+        errors = []
+        deadline_handles = []
+        try:
+            with QueryService(s, num_workers=4) as svc:
+                def client_thread(client):
+                    try:
+                        pairs = [make_df(client, j)
+                                 for j in range(self.PER_CLIENT)]
+                        handles = [
+                            (svc.submit(df, tenant=f"tenant{client}",
+                                        priority=j % 2), exp)
+                            for j, (df, exp) in enumerate(pairs)]
+                        for h, exp in handles:
+                            got = _rows(h.result(timeout=300))
+                            assert got == [tuple(e) if isinstance(e, tuple)
+                                           else e for e in exp] or \
+                                got == list(exp), \
+                                f"client {client}: wrong rows"
+                    except Exception as e:   # noqa: BLE001
+                        errors.append((client, e))
+
+                threads = [threading.Thread(target=client_thread, args=(c,))
+                           for c in range(self.N_CLIENTS)]
+                t0 = time.monotonic()
+                for t in threads:
+                    t.start()
+                # two doomed queries: deadline far shorter than the slow
+                # tenant's execution; they must report CANCELLED, not hang
+                for _ in range(2):
+                    df, _exp = make_df(0, 0)
+                    deadline_handles.append(
+                        svc.submit(df, tenant="tenant0", deadline_ms=1))
+                for t in threads:
+                    t.join(600)
+                    assert not t.is_alive(), "client thread hung"
+                for h in deadline_handles:
+                    with pytest.raises(QueryCancelledError):
+                        h.result(timeout=60)
+                    assert h.status == "CANCELLED"
+                wall = time.monotonic() - t0
+                assert wall < 500, f"stress took {wall:.0f}s"
+        finally:
+            cat.device_limit = old_limit
+        assert not errors, errors
+
+        snap = svc.snapshot()
+        total = self.N_CLIENTS * self.PER_CLIENT + 2
+        assert snap["submitted"] == total
+        assert snap["completed"] == self.N_CLIENTS * self.PER_CLIENT
+        assert snap["cancelled"] == 2
+        assert snap["deadline_exceeded"] == 2
+        assert snap["inflight"] == 0 and snap["depth"] == 0
+        # the tiny arena budget really exercised the spill path
+        spilled = (cat.spilled_device_to_host +
+                   cat.spilled_host_to_disk) - spill0
+        assert spilled > 0
+        # zero leaks at shutdown: permits takeable, catalog at baseline
+        assert _drain_semaphore()
+        assert cat.device_bytes == base_bytes
+        assert len(cat._entries) == base_entries
