@@ -1,0 +1,74 @@
+"""CI smoke for the observability subsystem: run a traced query through
+the service, then assert (1) the Chrome trace JSON parses and carries
+nested engine/exec spans, (2) the Prometheus snapshot covers the arena
+and semaphore series, (3) the report tool renders the per-query story.
+"""
+import json
+import os
+import sys
+import tempfile
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from spark_rapids_tpu.api import TpuSession, functions as F  # noqa: E402
+from spark_rapids_tpu.config import TpuConf  # noqa: E402
+from spark_rapids_tpu.service.server import QueryService  # noqa: E402
+
+
+def main():
+    td = tempfile.mkdtemp(prefix="obs_smoke_")
+    trace_path = os.path.join(td, "trace.json")
+    log_path = os.path.join(td, "events.jsonl")
+    s = TpuSession(TpuConf({
+        "spark.rapids.tpu.eventLog.path": log_path,
+        "spark.rapids.tpu.obs.trace.enabled": True,
+        "spark.rapids.tpu.obs.trace.path": trace_path,
+    }))
+    df = s.create_dataframe(
+        {"k": [i % 7 for i in range(2000)],
+         "v": [float(i) for i in range(2000)]})
+    s.register_table("obs_smoke", df)
+    with QueryService(s, num_workers=2) as svc:
+        for _ in range(3):
+            svc.submit(
+                "SELECT k, SUM(v), COUNT(v) FROM obs_smoke GROUP BY k"
+            ).result(120)
+        metrics = svc.metrics_text()
+
+    # 1. trace JSON parses and has the span hierarchy
+    doc = json.load(open(trace_path))
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert events, "no spans recorded"
+    cats = {e["cat"] for e in events}
+    assert {"engine", "exec"} <= cats, cats
+    names = {e["name"] for e in events}
+    assert "query" in names and "attempt" in names, names
+    qids = {e["args"].get("query_id") for e in events
+            if e["name"] == "attempt"}
+    assert len(qids) == 3, qids
+    print(f"trace OK: {len(events)} spans, cats={sorted(cats)}")
+
+    # 2. Prometheus exposition covers arena + semaphore + queue series
+    for series in ("tpu_arena_device_bytes", "tpu_arena_device_peak_bytes",
+                   "tpu_semaphore_wait_seconds_bucket",
+                   "tpu_service_queue_wait_seconds_count",
+                   "tpu_compile_cache_requests_total",
+                   'tpu_service_queries_total{event="completed"}'):
+        assert series in metrics, f"missing series {series}"
+    print("prometheus OK:", len(metrics.splitlines()), "lines")
+
+    # 3. report tool renders the joined story
+    from spark_rapids_tpu.tools.report import main as report_main
+    assert report_main([log_path, "--trace", trace_path,
+                        "--html", os.path.join(td, "report.html")]) == 0
+    html = open(os.path.join(td, "report.html")).read()
+    assert "plan + time shares" in html
+    print("report OK")
+    print("obs smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

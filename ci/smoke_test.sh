@@ -29,6 +29,9 @@ python ci/dist_smoke.py
 echo "== concurrent query service (8 clients, bounded admission queue) =="
 JAX_PLATFORMS=cpu python ci/service_smoke.py
 
+echo "== observability (trace JSON + prometheus + report) =="
+JAX_PLATFORMS=cpu python ci/obs_smoke.py
+
 echo "== api validation (docs vs live registry) =="
 python -m spark_rapids_tpu.tools.api_validation
 

@@ -18,6 +18,7 @@ import pyarrow as pa
 from ..config import TpuConf, set_active, SQL_ENABLED
 from ..columnar.schema import Schema
 from ..memory.arena import DeviceManager
+from ..obs import trace as _obs_trace
 from ..plan import logical as L
 from ..plan.overrides import Planner
 
@@ -77,6 +78,7 @@ class TpuSession:
         self.conf = conf or TpuConf()
         set_active(self.conf)
         _enable_compilation_cache()
+        _obs_trace.configure(self.conf)
         with TpuSession._active_lock:
             # device (re)init mutates process-wide state (catalog,
             # semaphore); serialize concurrent session construction
@@ -202,7 +204,18 @@ class TpuSession:
         explicitly keeps this method thread-safe against session-level
         mutation).  Execution drains through cancellation checkpoints
         and surfaces per-query semaphore-wait and spill-bytes metrics
-        in the event log."""
+        in the event log.  With tracing on, the whole collect is one
+        "query" span (exec-node/kernel/memory spans nest under it) and
+        the span buffer flushes to the configured trace path."""
+        with _obs_trace.span("query", "engine", root=phys.name):
+            out = self._execute_physical_traced(phys, conf, fallbacks)
+        if _obs_trace.is_enabled():
+            _obs_trace.flush()
+        return out
+
+    def _execute_physical_traced(self, phys, conf: Optional[TpuConf] = None,
+                                 fallbacks: Optional[List[str]] = None
+                                 ) -> pa.Table:
         import time as _time
         from ..columnar.arrow import to_arrow, schema_to_arrow
         from ..columnar.arrow import stage_batch

@@ -334,6 +334,30 @@ EVENT_LOG_PATH = conf_str(
     "spark.rapids.tpu.eventLog.path", "",
     "Append per-query JSON event records here; consumed by the "
     "qualification/profiling tools (reference: Spark event logs + tools/)")
+EVENT_LOG_ROTATE_BYTES = conf_bytes(
+    "spark.rapids.tpu.eventLog.rotation.maxBytes", 0,
+    "Rotate the event log (rename to <path>.N, start fresh) when it "
+    "would exceed this many bytes, so long service runs don't grow one "
+    "unbounded JSONL file.  0 disables rotation.  Env override: "
+    "SPARK_RAPIDS_TPU_EVENT_LOG_MAX_BYTES")
+EVENT_LOG_FLUSH_PER_RECORD = conf_bool(
+    "spark.rapids.tpu.eventLog.flushPerRecord", True,
+    "Flush the event log after every record (durability for crash "
+    "forensics); false trades durability for fewer syscalls on "
+    "high-QPS services.  Env override: SPARK_RAPIDS_TPU_EVENT_LOG_FLUSH")
+OBS_TRACE_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.trace.enabled", False,
+    "Record hierarchical engine spans (service -> exec node -> kernel/"
+    "shuffle/memory; the NvtxRange role) into an in-process buffer.  "
+    "Disabled, the tracer costs one flag read per instrumented site")
+OBS_TRACE_PATH = conf_str(
+    "spark.rapids.tpu.obs.trace.path", "",
+    "Write the Chrome trace-event JSON (Perfetto/chrome://tracing "
+    "loadable) here after each query when tracing is enabled")
+OBS_TRACE_MAX_SPANS = conf_int(
+    "spark.rapids.tpu.obs.trace.maxBufferedSpans", 100000,
+    "Bound on buffered spans; past it new spans are dropped (and "
+    "counted) instead of growing host memory without limit")
 SHIM_PROVIDER_OVERRIDE = conf_str(
     "spark.rapids.tpu.shims-provider-override", "",
     "Force a specific compat shim (reference: "

@@ -15,6 +15,7 @@ import time
 from typing import Dict, Iterator, List
 
 from ..columnar.schema import Schema
+from ..obs import trace as _trace
 from ..service.cancellation import cancel_checkpoint
 
 ESSENTIAL, MODERATE, DEBUG = "ESSENTIAL", "MODERATE", "DEBUG"
@@ -89,10 +90,20 @@ class MetricSet:
         self._metrics[name] = value
 
     def snapshot(self, level: str = DEBUG) -> Dict[str, int]:
+        """Stable-key-order metric snapshot at ``level``.
+
+        Filters BEFORE reading ``.value``: a metric excluded by level
+        never resolves its deferred device counts, so an ESSENTIAL
+        snapshot cannot force a device sync for MODERATE/DEBUG counters
+        still pending on the dispatch queue."""
         rank = {ESSENTIAL: 0, MODERATE: 1, DEBUG: 2}
         mx = rank[level]
-        return {m.name: m.value for m in self._metrics.values()
-                if rank[m.level] <= mx}
+        out: Dict[str, int] = {}
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            if rank[m.level] <= mx:
+                out[name] = m.value
+        return out
 
 
 class timed:
@@ -102,18 +113,36 @@ class timed:
     timed region is exactly an operator boundary (one batch about to be
     processed by one node), so a cancelled/deadline-exceeded query
     unwinds here instead of running its remaining operators — the
-    TaskContext.isInterrupted pattern at columnar granularity."""
+    TaskContext.isInterrupted pattern at columnar granularity.
 
-    def __init__(self, metric: Metric):
+    Span-aware: with tracing on, each timed region is an "exec" span
+    named after ``node`` (the operator), nesting under the service
+    attempt span and over kernel/shuffle/memory spans.  Disabled, the
+    extra cost is one module-flag read (no allocation)."""
+
+    __slots__ = ("metric", "node", "t0", "_span")
+
+    def __init__(self, metric: Metric, node: "PhysicalPlan" = None):
         self.metric = metric
+        self.node = node
 
     def __enter__(self):
         cancel_checkpoint()
+        if _trace._ENABLED:
+            self._span = _trace.Span(
+                self.node.name if self.node is not None
+                else self.metric.name,
+                "exec", {"metric": self.metric.name})
+            self._span.__enter__()
+        else:
+            self._span = None
         self.t0 = time.perf_counter_ns()
         return self
 
     def __exit__(self, *a):
         self.metric.add(time.perf_counter_ns() - self.t0)
+        if self._span is not None:
+            self._span.__exit__(*a)
         return False
 
 

@@ -109,7 +109,7 @@ class TpuShuffleExchange(TpuExec):
             for map_id, batch, (sorted_batch, counts) in staged:
                 checked = resolve_speculative(batch)
                 if checked is not batch:
-                    with timed(self.metrics[PARTITION_TIME]):
+                    with timed(self.metrics[PARTITION_TIME], self):
                         sorted_batch, counts = \
                             self.partitioner.split_staged(checked)
                 split = self.partitioner.finalize_split(sorted_batch,
@@ -129,7 +129,7 @@ class TpuShuffleExchange(TpuExec):
 
         for map_id, part in enumerate(in_parts):
             for batch in part:
-                with timed(self.metrics[PARTITION_TIME]):
+                with timed(self.metrics[PARTITION_TIME], self):
                     staged.append(
                         (map_id, batch,
                          self.partitioner.split_staged(batch)))

@@ -29,6 +29,7 @@ from ..columnar import dtypes as T
 from ..columnar.column import Column
 from ..columnar.batch import ColumnarBatch
 from ..expr import core as ec
+from ..obs.registry import compile_cache_event
 
 _LOG = logging.getLogger("spark_rapids_tpu.exec.fused")
 
@@ -138,6 +139,8 @@ class FusedEval:
                        tuple(f.dtype.name for f in self.schema),
                        tuple(self.needed))
                 self._jitted = _JIT_CACHE.get(key)
+                compile_cache_event("fused_project",
+                                    self._jitted is not None)
                 if self._jitted is None:
                     self._jitted = jax.jit(self._eval, static_argnums=(0,))
                     if len(_JIT_CACHE) < 4096:

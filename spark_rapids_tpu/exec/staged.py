@@ -28,6 +28,7 @@ from ..columnar.batch import ColumnarBatch, LazyCount
 from ..columnar.schema import Schema
 from ..expr import core as ec
 from ..kernels import basic as bk
+from ..obs.registry import compile_cache_event
 from .base import NUM_OUTPUT_ROWS, OP_TIME, timed
 from .fused import FusedEval, _TracedBatch, _tree_fusable, expr_signature
 from .tpu_basic import TpuExec
@@ -155,6 +156,7 @@ class TpuStagedCompute(TpuExec):
         if sig is not None:
             key = (sig, tuple(f.dtype.name for f in self.src_schema))
             hit = TpuStagedCompute._JIT_CACHE.get(key)
+            compile_cache_event("staged_compute", hit is not None)
             if hit is not None:
                 return hit
 
@@ -186,7 +188,7 @@ class TpuStagedCompute(TpuExec):
         def run(part):
             from ..columnar.binary64 import exact_double_enabled
             for batch in part:
-                with timed(self.metrics[OP_TIME]):
+                with timed(self.metrics[OP_TIME], self):
                     # exactDouble: traced reassembly would strip
                     # Binary64Columns created inside the program
                     if jitted is not None and \

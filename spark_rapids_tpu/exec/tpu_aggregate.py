@@ -22,6 +22,7 @@ from ..columnar.batch import (ColumnarBatch, LazyCount, SpeculativeResult,
 from ..expr import core as ec
 from ..expr.aggregates import AggregateFunction
 from ..kernels import canon, aggregate as agg_k
+from ..obs.registry import compile_cache_event
 from ..plan.logical import AggExpr
 from .base import PhysicalPlan, AGG_TIME, NUM_OUTPUT_ROWS, timed
 from .tpu_basic import TpuExec
@@ -189,7 +190,7 @@ class TpuHashAggregate(TpuExec):
             # the reference's iterative model (aggregate.scala:366-390)
             # keeps memory bounded by partial size, not input size.
             partials = []
-            with timed(self.metrics[AGG_TIME]):
+            with timed(self.metrics[AGG_TIME], self):
                 batches = list(part)
                 if self.mode == FINAL:
                     # FINAL inputs are post-shuffle slices with host-known
@@ -360,6 +361,7 @@ class TpuHashAggregate(TpuExec):
                             getattr(a.func, "ignore_nulls", None))
                            for a in aggs))
         core = TpuHashAggregate._CORE_CACHE.get(cache_key)
+        compile_cache_event("hash_aggregate", core is not None)
         if core is False:
             return None
 

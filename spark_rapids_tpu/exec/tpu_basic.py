@@ -147,7 +147,7 @@ class TpuProject(TpuExec):
 
         def run(part):
             for batch in part:
-                with timed(self.metrics[OP_TIME]):
+                with timed(self.metrics[OP_TIME], self):
                     cols = fused(batch)
                     if cols is None:
                         cols = [ec.eval_as_column(b, batch) for b in bound]
@@ -180,7 +180,7 @@ class TpuFilter(TpuExec):
 
         def run(part):
             for batch in part:
-                with timed(self.metrics[OP_TIME]):
+                with timed(self.metrics[OP_TIME], self):
                     fcols = fused(batch)
                     pred = fcols[0] if fcols is not None else \
                         ec.eval_as_column(bound, batch)
@@ -232,11 +232,11 @@ class TpuCoalesceBatches(TpuExec):
                 rows += batch.num_rows
                 nbytes += batch.nbytes()
                 if rows >= self.target_rows or nbytes >= self.target_bytes:
-                    with timed(self.metrics[CONCAT_TIME]):
+                    with timed(self.metrics[CONCAT_TIME], self):
                         yield concat_batches(pending)
                     pending, rows, nbytes = [], 0, 0
             if pending:
-                with timed(self.metrics[CONCAT_TIME]):
+                with timed(self.metrics[CONCAT_TIME], self):
                     yield concat_batches(pending)
         return [run(p) for p in self.children[0].execute()]
 
@@ -359,7 +359,7 @@ class RowToColumnar(TpuExec):
     def execute(self):
         def run(part):
             for t in part:
-                with timed(self.metrics[OP_TIME]):
+                with timed(self.metrics[OP_TIME], self):
                     yield from_arrow(t)
         return [run(p) for p in self.children[0].execute()]
 
@@ -382,6 +382,6 @@ class ColumnarToRow(PhysicalPlan):
     def execute(self):
         def run(part):
             for b in part:
-                with timed(self.metrics[OP_TIME]):
+                with timed(self.metrics[OP_TIME], self):
                     yield to_arrow(b)
         return [run(p) for p in self.children[0].execute()]

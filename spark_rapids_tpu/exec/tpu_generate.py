@@ -41,7 +41,7 @@ class TpuGenerate(TpuExec):
 
         def run(part):
             for batch in part:
-                with timed(self.metrics[OP_TIME]):
+                with timed(self.metrics[OP_TIME], self):
                     out = self._generate(batch, bound, pos, outer,
                                          out_schema)
                 self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy

@@ -79,7 +79,7 @@ class TpuHashJoinBase(TpuExec):
             build_keys = [e.bind(lschema) for e in lg.left_keys]
             stream_keys = [e.bind(rschema) for e in lg.right_keys]
 
-        with timed(self.metrics[BUILD_TIME]):
+        with timed(self.metrics[BUILD_TIME], self):
             # broadcast joins run every stream partition against the SAME
             # build batches: sort the build table once per exec.  The memo
             # retains build_batches itself so the id()s in the key cannot
@@ -165,7 +165,7 @@ class TpuHashJoinBase(TpuExec):
         # expands/gathers with host-known output capacities.
         phase_a = []
         for sb, skey_cols in zip(stream_batches, skey_cols_per_batch):
-            with timed(self.metrics[JOIN_TIME]):
+            with timed(self.metrics[JOIN_TIME], self):
                 phase_a.append(self._probe_phase(sb, skey_cols, bt,
                                                  str_words,
                                                  build_matched, direct))
@@ -184,12 +184,12 @@ class TpuHashJoinBase(TpuExec):
                 sb = checked
                 skey_cols = [ec.eval_as_column(e, sb)
                              for e in stream_keys]
-                with timed(self.metrics[JOIN_TIME]):
+                with timed(self.metrics[JOIN_TIME], self):
                     pa = self._probe_phase(sb, skey_cols, bt, str_words,
                                            build_matched, direct)
                 pending.flush()
             if pa is None:   # legacy eager path (full/residual/etc)
-                with timed(self.metrics[JOIN_TIME]):
+                with timed(self.metrics[JOIN_TIME], self):
                     outs = [self._join_batch(sb, skey_cols, build, bt,
                                              str_words, build_matched)]
             else:
@@ -360,13 +360,13 @@ class TpuHashJoinBase(TpuExec):
         total = int(total_lazy)
         limit = int(get_active().get(JOIN_GATHER_CHUNK_ROWS))
         if total <= limit or jt in ("semi", "anti"):
-            with timed(self.metrics[JOIN_TIME]):
+            with timed(self.metrics[JOIN_TIME], self):
                 out = self._expand_phase(sb, build, bt, jt, outer_stream,
                                          lo, counts, eff, total)
             if out is not None:
                 yield out
             return
-        with timed(self.metrics[JOIN_TIME]):
+        with timed(self.metrics[JOIN_TIME], self):
             eff_np = np.asarray(eff).astype(np.int64)
             lo_np = np.asarray(lo).astype(np.int32)
         nrows = eff_np.shape[0]
@@ -397,7 +397,7 @@ class TpuHashJoinBase(TpuExec):
                     off += take
             if chunk_total == 0:
                 break
-            with timed(self.metrics[JOIN_TIME]):
+            with timed(self.metrics[JOIN_TIME], self):
                 out = self._expand_phase(
                     sb, build, bt, jt, outer_stream,
                     jnp.asarray(chunk_lo), counts,

@@ -31,6 +31,7 @@ from ..columnar.column import Column, bucket_capacity
 from ..columnar.batch import ColumnarBatch, concat_batches
 from ..expr import core as ec
 from ..kernels import canon, aggregate as agg_k
+from ..obs.registry import compile_cache_event
 from ..parallel.mesh import MIX, _route_to_owners, make_mesh
 from .base import PhysicalPlan, AGG_TIME, NUM_OUTPUT_ROWS, timed
 from .tpu_basic import TpuExec
@@ -101,6 +102,7 @@ class TpuMeshAggregate(TpuExec):
                       getattr(a.func, "ignore_nulls", None))
                      for a in p.aggs))
         hit = TpuMeshAggregate._PROGRAM_CACHE.get(key)
+        compile_cache_event("mesh_aggregate", hit is not None)
         if hit is not None:
             return hit
         n_dev = mesh.devices.size
@@ -245,7 +247,7 @@ class TpuMeshAggregate(TpuExec):
             program = self._program(mesh, len(key_cols),
                                     [c.dtype for c in key_cols],
                                     in_layout, in_dts)
-            with timed(self.metrics[AGG_TIME]):
+            with timed(self.metrics[AGG_TIME], self):
                 out = program(*flat)
             overflow = bool(np.asarray(out[-1]).any())
             if overflow:

@@ -37,6 +37,7 @@ from ..columnar.batch import ColumnarBatch, concat_batches
 from ..expr import core as ec
 from ..kernels import canon
 from ..kernels import join as join_k
+from ..obs.registry import compile_cache_event
 from ..parallel.mesh import MIX, _route_to_owners, make_mesh
 from .base import PhysicalPlan, JOIN_TIME, NUM_OUTPUT_ROWS, timed
 from .tpu_basic import TpuExec
@@ -110,6 +111,7 @@ class TpuMeshShuffledJoin(TpuExec):
                tuple(d.name for d in l_dts), tuple(d.name for d in r_dts),
                emit_right)
         hit = TpuMeshShuffledJoin._PROGRAM_CACHE.get(key)
+        compile_cache_event("mesh_join", hit is not None)
         if hit is not None:
             return hit
         n_dev = mesh.devices.size
@@ -299,7 +301,7 @@ class TpuMeshShuffledJoin(TpuExec):
 
             program = self._program(mesh, prog_jt, key_groups,
                                     l_dts, r_dts, emit_right)
-            with timed(self.metrics[JOIN_TIME]):
+            with timed(self.metrics[JOIN_TIME], self):
                 out = program(*flat)
             if bool(np.asarray(out[-1]).any()):
                 yield from self._fallback(lbatch, rbatch, swapped)

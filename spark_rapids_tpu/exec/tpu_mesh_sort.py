@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..columnar.schema import Schema
 from ..columnar.column import Column, bucket_capacity
+from ..obs.registry import compile_cache_event
 from ..columnar.batch import ColumnarBatch, concat_batches
 from ..expr import core as ec
 from ..kernels import canon
@@ -82,6 +83,7 @@ class TpuMeshSort(TpuExec):
         key = (id(mesh), nkeys, tuple(d.name for d in key_dts),
                tuple(d.name for d in pay_dts), tuple(desc), tuple(nlast))
         hit = TpuMeshSort._PROGRAM_CACHE.get(key)
+        compile_cache_event("mesh_sort", hit is not None)
         if hit is not None:
             return hit
         n_dev = mesh.devices.size
@@ -195,7 +197,7 @@ class TpuMeshSort(TpuExec):
             program = self._program(
                 mesh, len(key_cols), [c.dtype for c in key_cols],
                 [c.dtype for c in batch.columns], desc, nlast)
-            with timed(self.metrics[SORT_TIME]):
+            with timed(self.metrics[SORT_TIME], self):
                 out = program(*flat)
             if bool(np.asarray(out[-1]).any()):
                 # skewed splitters overflowed a receive region: loud

@@ -61,7 +61,7 @@ class TpuSort(TpuExec):
             if self.sort_each_batch:
                 # mode 1: sort-each-batch (GpuSortExec.scala:56 first mode)
                 for b in part:
-                    with timed(self.metrics[SORT_TIME]):
+                    with timed(self.metrics[SORT_TIME], self):
                         out = self._sort_batch(b)
                     self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
                     yield out
@@ -78,7 +78,7 @@ class TpuSort(TpuExec):
             for b in part:
                 if b.num_rows == 0:
                     continue
-                with timed(self.metrics[SORT_TIME]):
+                with timed(self.metrics[SORT_TIME], self):
                     sorted_run = self._sort_batch(b)
                     n = int(sorted_run.num_rows)
                 DeviceManager.get().reserve(sorted_run.nbytes())
@@ -89,7 +89,7 @@ class TpuSort(TpuExec):
             chunk_rows = int(get_active().get(SORT_OOC_CHUNK_ROWS))
             if len(runs) == 1 or total <= chunk_rows:
                 # in-core: one concat + resort (modes 1/2)
-                with timed(self.metrics[SORT_TIME]):
+                with timed(self.metrics[SORT_TIME], self):
                     batches = [r.materialize() for r, _ in runs]
                     merged = concat_batches(batches) if len(batches) > 1 \
                         else batches[0]
@@ -106,7 +106,7 @@ class TpuSort(TpuExec):
             sampled = []
             for spill, n in runs:
                 was_spilled = spill.is_spilled()
-                with timed(self.metrics[SORT_TIME]):
+                with timed(self.metrics[SORT_TIME], self):
                     samples, strw = self._run_samples(
                         spill.materialize(), n)
                 if was_spilled:
@@ -225,7 +225,7 @@ class TpuSort(TpuExec):
                         pieces.append(piece)
             if not pieces:
                 continue
-            with timed(self.metrics[SORT_TIME]):
+            with timed(self.metrics[SORT_TIME], self):
                 chunk = concat_batches(pieces) if len(pieces) > 1 \
                     else pieces[0]
                 out = self._sort_batch(chunk)

@@ -53,7 +53,7 @@ class TpuWindow(TpuExec):
                 return
             batch = concat_batches(batches) if len(batches) > 1 else \
                 batches[0]
-            with timed(self.metrics[OP_TIME]):
+            with timed(self.metrics[OP_TIME], self):
                 out = self._apply(batch)
             self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
             yield out

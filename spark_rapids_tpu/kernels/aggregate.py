@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from . import canon
 from .sort import sorted_words
 from .basic import compact_indices
+from ..obs.trace import traced
 
 
 @dataclasses.dataclass
@@ -32,6 +33,7 @@ class GroupPlan:
     last_pos: jnp.ndarray      # sorted position of each group's LAST row
 
 
+@traced("groupby_plan")
 def groupby_plan(words: List[jnp.ndarray]) -> GroupPlan:
     """Build the sort+segment plan for a set of canonical key words.
 

@@ -11,6 +11,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..obs.trace import traced
+
 
 @jax.jit
 def compact_indices(keep_mask, num_rows):
@@ -56,6 +58,7 @@ def mix64(x):
     return x
 
 
+@traced("hash_words")
 def hash_words(word_lists, seed: int = 42):
     """Combine lists of uint64 word arrays into one 64-bit hash per row."""
     h = jnp.full(word_lists[0].shape, jnp.uint64(seed))

@@ -17,6 +17,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
+from ..obs.registry import compile_cache_event
 from .basic import M1, M2, mix64, hash_words as _hash_words_jnp
 
 _BLOCK = 1024
@@ -79,7 +80,10 @@ def hash_partition_ids(word_lists: List[jnp.ndarray],
     key = (len(word_lists), num_parts)
     try:
         if key not in _KERNEL_CACHE:
+            compile_cache_event("pallas_hash_partition", False)
             _KERNEL_CACHE[key] = _make_kernel(*key)
+        else:
+            compile_cache_event("pallas_hash_partition", True)
         return _KERNEL_CACHE[key](*word_lists)
     except Exception:
         h = _hash_words_jnp(word_lists)

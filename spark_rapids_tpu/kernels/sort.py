@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.trace import traced
+
 
 @jax.jit
 def _stable_pair_sort(key, perm):
@@ -35,6 +37,7 @@ def _stable_pair_sort(key, perm):
     return out
 
 
+@traced("sort_permutation")
 def sort_permutation(words: List[jnp.ndarray]) -> jnp.ndarray:
     """Stable ascending sort over word tuples; returns permutation indices."""
     cap = words[0].shape[0]
@@ -52,6 +55,7 @@ def sort_permutation(words: List[jnp.ndarray]) -> jnp.ndarray:
     return perm
 
 
+@traced("sorted_words")
 def sorted_words(words: List[jnp.ndarray]):
     """Sort and also return the sorted word arrays (for boundary detection)."""
     perm = sort_permutation(words)

@@ -25,6 +25,8 @@ import jax
 from ..config import (TpuConf, get_active, HBM_POOL_FRACTION, HBM_RESERVE,
                       CONCURRENT_TPU_TASKS, HOST_SPILL_LIMIT, SPILL_DIR,
                       SHUFFLE_COMPRESS)
+from ..obs import trace as _trace
+from ..obs.registry import SEM_WAIT_SECONDS
 from ..service.cancellation import cancel_checkpoint
 from .catalog import BufferCatalog
 
@@ -70,8 +72,9 @@ class DeviceSemaphore:
                         if self._sem.acquire(timeout=_ACQUIRE_POLL_S):
                             break
                 finally:
-                    self._wait.ns = getattr(self._wait, "ns", 0) + (
-                        time.perf_counter_ns() - t0)
+                    waited = time.perf_counter_ns() - t0
+                    self._wait.ns = getattr(self._wait, "ns", 0) + waited
+                    self._observe_wait(t0, waited)
         self._held.count = getattr(self._held, "count", 0) + 1
 
     def try_acquire(self, timeout: float = 0.0,
@@ -94,8 +97,19 @@ class DeviceSemaphore:
                 if time.monotonic() >= limit:
                     return False
         finally:
-            self._wait.ns = getattr(self._wait, "ns", 0) + (
-                time.perf_counter_ns() - t0)
+            waited = time.perf_counter_ns() - t0
+            self._wait.ns = getattr(self._wait, "ns", 0) + waited
+            self._observe_wait(t0, waited)
+
+    @staticmethod
+    def _observe_wait(t0_ns: int, waited_ns: int):
+        """One blocked-acquire observation: wait histogram + (when
+        tracing) a retroactive "memory" span covering the blocked
+        region.  Only blocked acquires reach here — the immediate-grant
+        fast path stays observation-free."""
+        SEM_WAIT_SECONDS.observe(waited_ns / 1e9)
+        if _trace._ENABLED:
+            _trace.emit("sem_wait", "memory", t0_ns, waited_ns)
 
     def release(self):
         count = getattr(self._held, "count", 0)

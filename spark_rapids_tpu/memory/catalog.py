@@ -23,6 +23,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import trace as _trace
+
 
 class StorageTier(enum.IntEnum):
     DEVICE = 0
@@ -70,6 +72,7 @@ class BufferCatalog:
         self.device_limit = device_limit
         self.host_limit = host_limit
         self.device_bytes = 0
+        self.device_peak_bytes = 0
         self.host_bytes = 0
         self.disk_bytes = 0
         self.spilled_device_to_host = 0
@@ -109,6 +112,8 @@ class BufferCatalog:
                 buffer_id, StorageTier.DEVICE, nbytes, priority,
                 device_obj=device_obj)
             self.device_bytes += nbytes
+            if self.device_bytes > self.device_peak_bytes:
+                self.device_peak_bytes = self.device_bytes
         # attribute the buffer to the active query (if any) so a
         # cancelled/failed query's leftover registrations can be
         # unwound by the service (unregister of an already-released id
@@ -347,15 +352,16 @@ class BufferCatalog:
             self._meta_fetcher(metas, read_bytes)
 
     def _spill_entry_to_host(self, e: BufferEntry):
-        payload = self._serialize(e.device_obj)
-        if self.arena is not None:
-            payload = self._pack_into_arena(payload)
-        e.host_payload = payload
-        e.device_obj = None
-        e.tier = StorageTier.HOST
-        self.device_bytes -= e.nbytes
-        self.host_bytes += e.nbytes
-        self.spilled_device_to_host += e.nbytes
+        with _trace.span("spill_device_to_host", "memory", bytes=e.nbytes):
+            payload = self._serialize(e.device_obj)
+            if self.arena is not None:
+                payload = self._pack_into_arena(payload)
+            e.host_payload = payload
+            e.device_obj = None
+            e.tier = StorageTier.HOST
+            self.device_bytes -= e.nbytes
+            self.host_bytes += e.nbytes
+            self.spilled_device_to_host += e.nbytes
 
     # -- native-arena packing (host staging slab; SURVEY.md §2.10.2) -------
     def _pack_into_arena(self, payload):
@@ -394,6 +400,10 @@ class BufferCatalog:
         return (schema, num_rows, kinds, bufs), (off, total)
 
     def _spill_entry_to_disk(self, e: BufferEntry):
+        with _trace.span("spill_host_to_disk", "memory", bytes=e.nbytes):
+            self._spill_entry_to_disk_inner(e)
+
+    def _spill_entry_to_disk_inner(self, e: BufferEntry):
         os.makedirs(self.spill_dir, exist_ok=True)
         path = os.path.join(self.spill_dir, f"{e.buffer_id}.spill")
         payload = e.host_payload
@@ -424,19 +434,29 @@ class BufferCatalog:
 
     def _unspill_host(self, e: BufferEntry):
         from .pressure import oom_retry
-        payload, _ = self._unpack_payload(e.host_payload)
-        # the device put can hit the REAL allocator's RESOURCE_EXHAUSTED
-        # even under the logical budget (fragmentation, temporaries):
-        # spill-everything-and-retry (DeviceMemoryEventHandler contract)
-        obj = oom_retry(self._deserialize, payload)
-        e.host_payload = None
-        e.device_obj = obj
-        e.tier = StorageTier.DEVICE
-        self.host_bytes -= e.nbytes
-        self.device_bytes += e.nbytes
+        with _trace.span("unspill_host_to_device", "memory",
+                         bytes=e.nbytes):
+            payload, _ = self._unpack_payload(e.host_payload)
+            # the device put can hit the REAL allocator's
+            # RESOURCE_EXHAUSTED even under the logical budget
+            # (fragmentation, temporaries): spill-everything-and-retry
+            # (DeviceMemoryEventHandler contract)
+            obj = oom_retry(self._deserialize, payload)
+            e.host_payload = None
+            e.device_obj = obj
+            e.tier = StorageTier.DEVICE
+            self.host_bytes -= e.nbytes
+            self.device_bytes += e.nbytes
+            if self.device_bytes > self.device_peak_bytes:
+                self.device_peak_bytes = self.device_bytes
         return obj
 
     def _unspill_disk(self, e: BufferEntry):
+        with _trace.span("unspill_disk_to_host", "memory", bytes=e.nbytes):
+            self._unspill_disk_inner(e)
+        return self._unspill_host(e)
+
+    def _unspill_disk_inner(self, e: BufferEntry):
         with open(e.disk_path, "rb") as f:
             payload = pickle.load(f)
         if isinstance(payload, tuple) and payload and \
@@ -466,7 +486,6 @@ class BufferCatalog:
         e.tier = StorageTier.HOST
         self.disk_bytes -= e.nbytes
         self.host_bytes += e.nbytes
-        return self._unspill_host(e)
 
     # -- synchronous spill (DeviceMemoryEventHandler.onAllocFailure role) --
     def spill_device_to_fit(self, needed_bytes: int) -> int:
@@ -500,6 +519,7 @@ class BufferCatalog:
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return dict(device_bytes=self.device_bytes,
+                        device_peak_bytes=self.device_peak_bytes,
                         host_bytes=self.host_bytes,
                         disk_bytes=self.disk_bytes,
                         num_buffers=len(self._entries),

@@ -1,0 +1,20 @@
+"""Observability subsystem: span tracing + process-wide metrics.
+
+Three layers, mirroring the reference plugin's observability story
+(SURVEY.md §tools):
+
+- ``obs.trace``   — hierarchical span tracer (the NvtxRange role):
+  thread-local nested spans with query_id attribution, exported as
+  Chrome trace-event JSON loadable in Perfetto/chrome://tracing.
+- ``obs.registry``— process-wide metrics registry (counters, gauges,
+  fixed-bucket histograms): arena bytes, semaphore/queue waits, spill
+  bytes, compile-cache hits, shuffle bytes.
+- ``obs.prom``    — Prometheus text-format exposition over the registry
+  (``QueryService.metrics_text()`` / scrape handler).
+
+The per-query report generator that joins the event log with these
+streams lives in ``tools/report.py`` (the SQL-UI stand-in).
+"""
+from . import trace, registry, prom  # noqa: F401
+from .registry import get_registry  # noqa: F401
+from .trace import span, traced     # noqa: F401

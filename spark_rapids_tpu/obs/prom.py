@@ -1,0 +1,100 @@
+"""Prometheus text-format exposition over the metrics registry.
+
+Renders the 0.0.4 text format (`# HELP` / `# TYPE` + samples;
+histograms as cumulative `_bucket{le=...}` series plus `_sum`/`_count`)
+so a scrape of ``QueryService.metrics_text()`` — or the tiny stdlib
+scrape handler started by ``serve_scrapes()`` — drops straight into a
+Prometheus/Grafana stack.  Stdlib-only.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from .registry import COUNTER, GAUGE, HISTOGRAM, MetricsRegistry, \
+    get_registry
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _label_str(labels: Tuple[Tuple[str, str], ...],
+               extra: Optional[Tuple[str, str]] = None) -> str:
+    items = list(labels)
+    if extra is not None:
+        items.append(extra)
+    if not items:
+        return ""
+    return "{" + ",".join(
+        f'{k}="{_escape_label(str(v))}"' for k, v in items) + "}"
+
+
+def render_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """The whole registry in Prometheus text exposition format."""
+    registry = registry or get_registry()
+    lines = []
+    for fam in registry.families():
+        lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+        lines.append(f"# TYPE {fam.name} {fam.type}")
+        children = fam.children() if fam.label_names else \
+            [fam._default()]
+        for c in children:
+            if fam.type in (COUNTER, GAUGE):
+                lines.append(f"{fam.name}{_label_str(c.labels)} "
+                             f"{_fmt_value(c.value)}")
+            elif fam.type == HISTOGRAM:
+                h = c.hist_snapshot()
+                for le, cum in h["buckets"].items():
+                    le_s = "+Inf" if le == "+Inf" else _fmt_value(le)
+                    lines.append(
+                        f"{fam.name}_bucket"
+                        f"{_label_str(c.labels, ('le', le_s))} {cum}")
+                lines.append(f"{fam.name}_sum{_label_str(c.labels)} "
+                             f"{_fmt_value(h['sum'])}")
+                lines.append(f"{fam.name}_count{_label_str(c.labels)} "
+                             f"{h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def serve_scrapes(port: int = 0, host: str = "127.0.0.1",
+                  registry: Optional[MetricsRegistry] = None):
+    """Start a daemon-thread HTTP scrape endpoint serving ``/metrics``.
+
+    Returns (server, bound_port); ``server.shutdown()`` stops it.
+    ``port=0`` binds an ephemeral port (tests/CI)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    reg = registry or get_registry()
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            body = render_text(reg).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):   # scrapes must not spam stderr
+            pass
+
+    server = ThreadingHTTPServer((host, port), _Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="tpu-metrics-scrape")
+    t.start()
+    return server, server.server_address[1]

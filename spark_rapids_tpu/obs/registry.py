@@ -1,0 +1,314 @@
+"""Process-wide metrics registry — counters, gauges, fixed-bucket
+histograms (the GpuMetric -> Spark-SQL-UI role lifted to a serving
+process: one registry every subsystem writes into, scraped as a whole).
+
+Instruments are get-or-create by name (re-registering returns the
+existing family), optionally labeled, and cheap on the hot path: a
+counter ``inc`` is one lock-free float add under a per-child lock;
+gauges for arena/queue state are *collect-time callbacks* so the memory
+and service layers pay nothing per operation.  ``snapshot()`` returns a
+plain dict for tests; ``obs.prom`` renders the Prometheus text format.
+
+Stdlib-only; the default instrument callbacks lazy-import engine layers
+at collect time to stay import-cycle-free.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+COUNTER, GAUGE, HISTOGRAM = "counter", "gauge", "histogram"
+
+#: wait-time buckets (seconds) shared by the semaphore/queue histograms
+WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5,
+                1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class _Child:
+    """One sample series (a family's instance for one label set)."""
+    __slots__ = ("labels", "_lock", "_value", "_fn",
+                 "buckets", "_bucket_counts", "_sum", "_count")
+
+    def __init__(self, labels: Tuple[Tuple[str, str], ...],
+                 buckets: Optional[Sequence[float]] = None):
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+        self.buckets = tuple(buckets) if buckets is not None else None
+        if self.buckets is not None:
+            self._bucket_counts = [0] * (len(self.buckets) + 1)  # +Inf
+            self._sum = 0.0
+            self._count = 0
+
+    # -- counter/gauge -----------------------------------------------------
+    def inc(self, by: float = 1.0):
+        with self._lock:
+            self._value += by
+
+    def dec(self, by: float = 1.0):
+        with self._lock:
+            self._value -= by
+
+    def set(self, v: float):
+        with self._lock:
+            self._value = float(v)
+
+    def set_function(self, fn: Callable[[], float]):
+        """Collect-time callback: the series' value is ``fn()`` at each
+        scrape/snapshot instead of a stored number (zero hot-path
+        cost for state another subsystem already tracks)."""
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:
+                return 0.0
+        with self._lock:
+            return self._value
+
+    # -- histogram ---------------------------------------------------------
+    def observe(self, v: float):
+        with self._lock:
+            i = 0
+            for b in self.buckets:
+                if v <= b:
+                    break
+                i += 1
+            self._bucket_counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def hist_snapshot(self) -> Dict:
+        """Cumulative bucket counts keyed by upper bound + sum/count."""
+        with self._lock:
+            counts = list(self._bucket_counts)
+            total, s = self._count, self._sum
+        cum, out = 0, {}
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            out[b] = cum
+        out["+Inf"] = total
+        return {"buckets": out, "sum": s, "count": total}
+
+
+class Family:
+    """A named metric family: type + help + labeled children."""
+
+    def __init__(self, name: str, typ: str, help: str = "",
+                 label_names: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.type = typ
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._buckets = tuple(buckets) if buckets is not None else None
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], _Child] = {}
+
+    def labels(self, **kv) -> _Child:
+        assert set(kv) == set(self.label_names), \
+            f"{self.name}: expected labels {self.label_names}, got {kv}"
+        key = tuple(str(kv[n]) for n in self.label_names)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    child = _Child(tuple(zip(self.label_names, key)),
+                                   self._buckets)
+                    self._children[key] = child
+        return child
+
+    def _default(self) -> _Child:
+        assert not self.label_names, \
+            f"{self.name} is labeled; use .labels(...)"
+        return self.labels()
+
+    # unlabeled families delegate straight to their single child
+    def inc(self, by: float = 1.0):
+        self._default().inc(by)
+
+    def dec(self, by: float = 1.0):
+        self._default().dec(by)
+
+    def set(self, v: float):
+        self._default().set(v)
+
+    def set_function(self, fn: Callable[[], float]):
+        self._default().set_function(fn)
+
+    def observe(self, v: float):
+        self._default().observe(v)
+
+    def hist_snapshot(self) -> Dict:
+        return self._default().hist_snapshot()
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    def children(self) -> List[_Child]:
+        with self._lock:
+            return [self._children[k]
+                    for k in sorted(self._children)]
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, Family] = {}
+
+    def _get_or_create(self, name: str, typ: str, help: str,
+                       label_names: Sequence[str],
+                       buckets: Optional[Sequence[float]] = None) -> Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = Family(name, typ, help, label_names, buckets)
+                self._families[name] = fam
+            else:
+                assert fam.type == typ, \
+                    f"{name} re-registered as {typ}, was {fam.type}"
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = ()) -> Family:
+        return self._get_or_create(name, COUNTER, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = (),
+              fn: Optional[Callable[[], float]] = None) -> Family:
+        fam = self._get_or_create(name, GAUGE, help, labels)
+        if fn is not None:
+            fam.set_function(fn)
+        return fam
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = WAIT_BUCKETS,
+                  labels: Sequence[str] = ()) -> Family:
+        return self._get_or_create(name, HISTOGRAM, help, labels, buckets)
+
+    def families(self) -> List[Family]:
+        with self._lock:
+            return [self._families[n] for n in sorted(self._families)]
+
+    def snapshot(self) -> Dict:
+        """Deterministic plain-dict view (sorted names/labels) for
+        tests and the report tool."""
+        out: Dict = {}
+        for fam in self.families():
+            if fam.type == HISTOGRAM:
+                if fam.label_names:
+                    out[fam.name] = {
+                        _label_key(c.labels): c.hist_snapshot()
+                        for c in fam.children()}
+                else:
+                    out[fam.name] = fam._default().hist_snapshot()
+            elif fam.label_names:
+                out[fam.name] = {_label_key(c.labels): c.value
+                                 for c in fam.children()}
+            else:
+                out[fam.name] = fam.value
+        return out
+
+
+def _label_key(labels: Tuple[Tuple[str, str], ...]) -> str:
+    return ",".join(f"{k}={v}" for k, v in labels)
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# Default engine instruments.  Gauges over state other layers already
+# track are collect-time callbacks (lazy imports: no cycle, no hot-path
+# cost); counters the layers push into are bound here once so call
+# sites skip label resolution.
+# ---------------------------------------------------------------------------
+
+def _catalog():
+    from ..memory.catalog import BufferCatalog
+    return BufferCatalog.get()
+
+
+ARENA_DEVICE_BYTES = _REGISTRY.gauge(
+    "tpu_arena_device_bytes",
+    "Logical live bytes on the device tier of the buffer catalog",
+    fn=lambda: _catalog().device_bytes)
+ARENA_DEVICE_PEAK_BYTES = _REGISTRY.gauge(
+    "tpu_arena_device_peak_bytes",
+    "High-water mark of device-tier live bytes since catalog reset",
+    fn=lambda: _catalog().device_peak_bytes)
+ARENA_DEVICE_LIMIT_BYTES = _REGISTRY.gauge(
+    "tpu_arena_device_limit_bytes",
+    "Device-tier byte budget enforced by the arena",
+    fn=lambda: _catalog().device_limit)
+ARENA_HOST_BYTES = _REGISTRY.gauge(
+    "tpu_arena_host_bytes",
+    "Bytes of spilled buffers on the host tier",
+    fn=lambda: _catalog().host_bytes)
+ARENA_DISK_BYTES = _REGISTRY.gauge(
+    "tpu_arena_disk_bytes",
+    "Bytes of spilled buffers on the disk tier",
+    fn=lambda: _catalog().disk_bytes)
+
+SPILL_BYTES = _REGISTRY.counter(
+    "tpu_spill_bytes_total",
+    "Bytes moved down the spill tiers since catalog reset",
+    labels=("direction",))
+SPILL_BYTES.labels(direction="device_to_host").set_function(
+    lambda: _catalog().spilled_device_to_host)
+SPILL_BYTES.labels(direction="host_to_disk").set_function(
+    lambda: _catalog().spilled_host_to_disk)
+
+SEM_WAIT_SECONDS = _REGISTRY.histogram(
+    "tpu_semaphore_wait_seconds",
+    "Time tasks spent blocked on the device semaphore "
+    "(only blocked acquires observe; immediate grants are free)")
+
+QUEUE_WAIT_SECONDS = _REGISTRY.histogram(
+    "tpu_service_queue_wait_seconds",
+    "Admission-to-start wait of service queries")
+
+SERVICE_QUEUE_DEPTH = _REGISTRY.gauge(
+    "tpu_service_queue_depth",
+    "Queries waiting in the service admission queue")
+SERVICE_QUEUED_BYTES = _REGISTRY.gauge(
+    "tpu_service_queued_bytes",
+    "Estimated bytes of queries waiting in the admission queue")
+SERVICE_INFLIGHT = _REGISTRY.gauge(
+    "tpu_service_inflight_queries",
+    "Queries admitted and not yet finished")
+
+SERVICE_EVENTS = _REGISTRY.counter(
+    "tpu_service_queries_total",
+    "Service lifecycle transitions (submitted/admitted/shed/completed/"
+    "failed/cancelled/deadline_exceeded/retries)",
+    labels=("event",))
+
+COMPILE_CACHE = _REGISTRY.counter(
+    "tpu_compile_cache_requests_total",
+    "Engine JIT compile-cache lookups by cache and outcome",
+    labels=("cache", "outcome"))
+
+SHUFFLE_BYTES = _REGISTRY.counter(
+    "tpu_shuffle_bytes_total",
+    "Shuffle bytes moved through the map-output catalog",
+    labels=("direction",))
+SHUFFLE_WRITE_BYTES = SHUFFLE_BYTES.labels(direction="write")
+SHUFFLE_READ_BYTES = SHUFFLE_BYTES.labels(direction="read")
+
+
+def compile_cache_event(cache: str, hit: bool):
+    """One compile-cache lookup (called from the exec/kernels JIT
+    caches; compile paths, not per-batch hot paths)."""
+    COMPILE_CACHE.labels(cache=cache,
+                         outcome="hit" if hit else "miss").inc()

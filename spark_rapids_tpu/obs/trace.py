@@ -1,0 +1,260 @@
+"""Hierarchical span tracer — the NvtxRange role (NvtxWithMetrics /
+nvtx_profiling.md in the reference, SURVEY.md §5) adapted to a
+multi-tenant serving process.
+
+Spans are thread-local nested regions with query_id/attempt attribution
+pulled from the active :class:`~..service.cancellation.CancelToken`, so
+overlapping queries through the service disentangle by query_id even
+when their spans interleave on the same worker thread.  Finished spans
+buffer in-process and export as Chrome trace-event JSON ("X" complete
+events) loadable in Perfetto / chrome://tracing.
+
+Overhead contract: with tracing disabled (the default) the fast path is
+ONE module-global flag read — ``span()`` returns a shared no-op context
+manager (no allocation), ``traced`` wrappers call straight through, and
+hot call sites additionally guard with ``if trace._ENABLED`` so not even
+an argument dict is built.  Stdlib-only: imported by exec/, memory/,
+shuffle/ and kernels/ layers.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..service.cancellation import current_token
+
+#: module-level fast-path flag.  Read directly (``trace._ENABLED``) by
+#: hot call sites; everything else goes through enable()/disable().
+_ENABLED = False
+
+_PID = os.getpid()
+_TLS = threading.local()
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled-path return value of
+    ``span()``.  A singleton so the disabled fast path allocates
+    nothing."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    """One live span; finishes (records) on ``__exit__``."""
+    __slots__ = ("name", "cat", "args", "t0")
+
+    def __init__(self, name: str, cat: str, args: Dict):
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        tok = current_token()
+        if tok is not None and tok.query_id is not None and \
+                "query_id" not in self.args:
+            self.args["query_id"] = tok.query_id
+        d = _TLS.__dict__
+        d["depth"] = d.get("depth", 0) + 1
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def set(self, **attrs) -> "Span":
+        self.args.update(attrs)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter_ns() - self.t0
+        d = _TLS.__dict__
+        depth = d.get("depth", 1)
+        d["depth"] = depth - 1
+        if exc_type is not None:
+            self.args["error"] = exc_type.__name__
+        tr = _TRACER
+        if tr is not None:
+            tr.record(self.name, self.cat, self.t0, dur, depth, self.args)
+        return False
+
+
+class SpanTracer:
+    """Process-wide finished-span buffer + Chrome trace export.
+
+    The buffer is bounded (``max_spans``): past it new spans are counted
+    as dropped instead of growing without limit — a long service run
+    with tracing left on must not OOM the host."""
+
+    def __init__(self, max_spans: int = 100_000,
+                 path: Optional[str] = None):
+        self.max_spans = max_spans
+        self.path = path
+        self.epoch_ns = time.perf_counter_ns()
+        self._lock = threading.Lock()
+        self._events: List[Dict] = []
+        self._thread_names: Dict[int, str] = {}
+        self.dropped = 0
+
+    def record(self, name: str, cat: str, t0_ns: int, dur_ns: int,
+               depth: int, args: Dict):
+        tid = threading.get_ident()
+        ev = {"name": name, "cat": cat, "ph": "X",
+              "ts": (t0_ns - self.epoch_ns) / 1e3,
+              "dur": dur_ns / 1e3,
+              "pid": _PID, "tid": tid,
+              "args": dict(args, depth=depth)}
+        with self._lock:
+            if len(self._events) >= self.max_spans:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+            if tid not in self._thread_names:
+                self._thread_names[tid] = threading.current_thread().name
+
+    def num_spans(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def to_chrome_trace(self) -> Dict:
+        """Perfetto/chrome://tracing-loadable trace object."""
+        with self._lock:
+            events = list(self._events)
+            meta = [{"name": "thread_name", "ph": "M", "pid": _PID,
+                     "tid": tid, "args": {"name": tname}}
+                    for tid, tname in sorted(self._thread_names.items())]
+            dropped = self.dropped
+        return {"traceEvents": meta + events,
+                "displayTimeUnit": "ms",
+                "otherData": {"producer": "spark_rapids_tpu.obs.trace",
+                              "dropped_spans": dropped}}
+
+    def write(self, path: Optional[str] = None) -> str:
+        path = path or self.path
+        assert path, "no trace output path configured"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        os.replace(tmp, path)
+        return path
+
+    def reset(self):
+        with self._lock:
+            self._events.clear()
+            self._thread_names.clear()
+            self.dropped = 0
+            self.epoch_ns = time.perf_counter_ns()
+
+
+_TRACER: Optional[SpanTracer] = None
+_TRACER_LOCK = threading.Lock()
+
+
+def get_tracer() -> SpanTracer:
+    global _TRACER
+    if _TRACER is None:
+        with _TRACER_LOCK:
+            if _TRACER is None:
+                _TRACER = SpanTracer()
+    return _TRACER
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def enable(path: Optional[str] = None,
+           max_spans: Optional[int] = None) -> SpanTracer:
+    """Turn tracing on (fresh buffer).  ``path`` is where ``flush()``
+    writes the Chrome trace JSON."""
+    global _ENABLED
+    tr = get_tracer()
+    tr.reset()
+    if path is not None:
+        tr.path = path
+    if max_spans is not None:
+        tr.max_spans = max_spans
+    _ENABLED = True
+    return tr
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.trace.*`` conf group.  Only
+    acts when the conf enables tracing — an unset conf must not tear
+    down a tracer a test/tool enabled explicitly."""
+    from ..config import (OBS_TRACE_ENABLED, OBS_TRACE_PATH,
+                          OBS_TRACE_MAX_SPANS)
+    if conf.get(OBS_TRACE_ENABLED):
+        enable(path=conf.get(OBS_TRACE_PATH) or None,
+               max_spans=conf.get(OBS_TRACE_MAX_SPANS))
+
+
+def span(name: str, cat: str = "engine", **args):
+    """Open a span context.  Disabled-path cost: one flag read + the
+    shared no-op singleton (call sites hotter than per-batch should
+    guard with ``if trace._ENABLED`` to skip the kwargs dict too)."""
+    if not _ENABLED:
+        return _NOOP
+    return Span(name, cat, args)
+
+
+def emit(name: str, cat: str, start_ns: int, dur_ns: int, **args):
+    """Record an already-elapsed region retroactively (e.g. a queue or
+    semaphore wait measured by its own clock).  ``start_ns`` is a
+    time.perf_counter_ns() instant."""
+    if not _ENABLED:
+        return
+    tok = current_token()
+    if tok is not None and tok.query_id is not None and \
+            "query_id" not in args:
+        args["query_id"] = tok.query_id
+    tr = _TRACER
+    if tr is not None:
+        depth = _TLS.__dict__.get("depth", 0) + 1
+        tr.record(name, cat, start_ns, dur_ns, depth, args)
+
+
+def traced(name: str, cat: str = "kernel"):
+    """Decorator form for kernel entry points: spans the call when
+    tracing is on, calls straight through (one flag read) when off."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            if not _ENABLED:
+                return fn(*a, **k)
+            with Span(name, cat, {}):
+                return fn(*a, **k)
+        return wrapper
+    return deco
+
+
+def flush(path: Optional[str] = None) -> Optional[str]:
+    """Write the current buffer to ``path`` (or the enable()-time path).
+    Returns the written path; None when tracing never started or no
+    output path is configured (in-memory tracing: tests/tools read the
+    buffer through ``get_tracer()`` instead)."""
+    if _TRACER is None or not (path or _TRACER.path):
+        return None
+    return _TRACER.write(path)
+
+
+def reset():
+    if _TRACER is not None:
+        _TRACER.reset()

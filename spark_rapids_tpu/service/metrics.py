@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..obs.registry import SERVICE_EVENTS
+
 
 @dataclasses.dataclass
 class QueryMetrics:
@@ -62,6 +64,9 @@ class ServiceStats:
     def inc(self, name: str, by: int = 1):
         with self._lock:
             self._counts[name] = self._counts.get(name, 0) + by
+        # mirror into the process registry so scrapes see service
+        # lifecycle counters without reaching into a QueryService
+        SERVICE_EVENTS.labels(event=name).inc(by)
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:

@@ -25,6 +25,9 @@ from ..api.session import TpuSession
 from ..config import (TpuConf, set_active, EVENT_LOG_PATH,
                       SERVICE_WORKERS, SERVICE_MAX_QUEUE_DEPTH,
                       SERVICE_MAX_QUEUED_BYTES, SERVICE_DEFAULT_DEADLINE_MS)
+from ..obs import trace as _trace
+from ..obs.registry import (QUEUE_WAIT_SECONDS, SERVICE_INFLIGHT,
+                            SERVICE_QUEUE_DEPTH, SERVICE_QUEUED_BYTES)
 from ..plan import logical as L
 from ..plan.overrides import Planner
 from .cancellation import CancelToken, query_context
@@ -104,7 +107,7 @@ class QueryService:
             max_depth=conf.get(SERVICE_MAX_QUEUE_DEPTH),
             max_bytes=conf.get(SERVICE_MAX_QUEUED_BYTES))
         self.retry = RetryPolicy.from_conf(conf)
-        self.stats = ServiceStats()
+        self._stats = ServiceStats()
         from ..tools.events import QueryEventLogger
         self._events = QueryEventLogger(conf.get(EVENT_LOG_PATH) or None)
         self._default_deadline_ms = conf.get(SERVICE_DEFAULT_DEADLINE_MS)
@@ -114,6 +117,13 @@ class QueryService:
         self._workers: List[threading.Thread] = []
         self._shutdown = False
         self._start_lock = threading.Lock()
+        self._scrape_server = None
+        # queue/inflight gauges read live service state at collect time
+        # (scrapes pay the cost, the submit/run hot path pays nothing)
+        SERVICE_QUEUE_DEPTH.set_function(lambda: self.queue.depth)
+        SERVICE_QUEUED_BYTES.set_function(
+            lambda: self.queue.stats().get("queued_bytes", 0))
+        SERVICE_INFLIGHT.set_function(lambda: len(self._inflight))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "QueryService":
@@ -145,6 +155,9 @@ class QueryService:
                 left = None if deadline is None else \
                     max(0.0, deadline - time.monotonic())
                 t.join(left)
+        if self._scrape_server is not None:
+            self._scrape_server.shutdown()
+            self._scrape_server = None
 
     def __enter__(self):
         return self.start()
@@ -180,7 +193,7 @@ class QueryService:
             raise ServiceOverloaded("service is shut down")
         self.start()
         logical = self._to_logical(query)
-        self.stats.inc("submitted")
+        self._stats.inc("submitted")
         query_id = f"q{next(self._seq):06d}-{uuid.uuid4().hex[:8]}"
         ms = deadline_ms if deadline_ms is not None else \
             (self._default_deadline_ms or None)
@@ -196,7 +209,7 @@ class QueryService:
             self.queue.offer(handle)
         except ServiceOverloaded as e:
             self._forget(handle)
-            self.stats.inc("shed")
+            self._stats.inc("shed")
             handle.metrics.outcome = "shed"
             handle._finish(FAILED, error=e)
             self._events.log_service_event(
@@ -204,7 +217,7 @@ class QueryService:
                 queue_depth=e.queue_depth, queued_bytes=e.queued_bytes,
                 reason=str(e))
             raise
-        self.stats.inc("admitted")
+        self._stats.inc("admitted")
         self._events.log_service_event(
             "admitted", query_id, tenant=tenant, priority=priority,
             est_bytes=est_bytes, queue_depth=self.queue.depth,
@@ -236,6 +249,14 @@ class QueryService:
     def _run_one(self, handle: QueryHandle):
         m = handle.metrics
         m.queue_wait_ms = (time.time() - m.submitted_ts) * 1000.0
+        QUEUE_WAIT_SECONDS.observe(m.queue_wait_ms / 1e3)
+        if _trace._ENABLED:
+            # retroactive span: the admission-to-start wait, on the
+            # worker thread's track just before the attempt spans
+            wait_ns = int(m.queue_wait_ms * 1e6)
+            _trace.emit("queue_wait", "service",
+                        time.perf_counter_ns() - wait_ns, wait_ns,
+                        query_id=handle.query_id)
         if handle.token.cancelled:
             self._finalize_cancel(handle)
             return
@@ -257,7 +278,7 @@ class QueryService:
                         and not handle.token.cancelled:
                     attempt += 1
                     m.retries += 1
-                    self.stats.inc("retries")
+                    self._stats.inc("retries")
                     backoff = self.retry.backoff_s(attempt)
                     self._events.log_service_event(
                         "retry", handle.query_id, tenant=handle.tenant,
@@ -270,7 +291,7 @@ class QueryService:
                     continue
                 m.outcome = "failed"
                 m.error = repr(e)
-                self.stats.inc("failed")
+                self._stats.inc("failed")
                 handle._finish(FAILED, error=e)
                 self._emit_outcome(
                     "failed", handle,
@@ -278,7 +299,7 @@ class QueryService:
                 self._forget(handle)
                 return
             m.outcome = "completed"
-            self.stats.inc("completed")
+            self._stats.inc("completed")
             handle._finish(DONE, result=table)
             self._emit_outcome("completed", handle, rows=table.num_rows)
             self._forget(handle)
@@ -291,7 +312,9 @@ class QueryService:
         m = handle.metrics
         conf = base_conf.with_overrides(self.retry.overlay(attempt,
                                                            base_conf))
-        with query_context(handle.token) as token:
+        with _trace.span("attempt", "service", query_id=handle.query_id,
+                         tenant=handle.tenant, attempt=attempt), \
+                query_context(handle.token) as token:
             token.observed.clear()
             token.check()
             # thread-only: the worker's conf must not leak into other
@@ -337,9 +360,9 @@ class QueryService:
         m = handle.metrics
         m.outcome = "cancelled"
         m.error = reason
-        self.stats.inc("cancelled")
+        self._stats.inc("cancelled")
         if reason == "deadline":
-            self.stats.inc("deadline_exceeded")
+            self._stats.inc("deadline_exceeded")
         handle._finish(CANCELLED, error=QueryCancelledError(
             reason, handle.query_id))
         self._emit_outcome("cancelled", handle, reason=reason)
@@ -348,15 +371,44 @@ class QueryService:
     def _forget(self, handle: QueryHandle):
         with self._inflight_lock:
             self._inflight.pop(handle.query_id, None)
+        # the query's "attempt" span closes after the session-level
+        # flush inside execute_physical; re-flush so the trace file on
+        # disk always includes the finished query's full span tree
+        # (no-op when tracing is off or no path is configured)
+        if _trace.is_enabled():
+            _trace.flush()
 
     # -- introspection -----------------------------------------------------
+    def stats(self) -> "ServiceStats":
+        """The service's lifecycle counters (public accessor; the
+        counter object itself stays private so callers observe through
+        ``snapshot()``/the registry rather than mutating it)."""
+        return self._stats
+
     def snapshot(self) -> Dict:
         """Service counters + queue state (monitoring endpoint shape)."""
-        out = self.stats.snapshot()
+        out = self._stats.snapshot()
         out.update(self.queue.stats())
         with self._inflight_lock:
             out["inflight"] = len(self._inflight)
         return out
+
+    def metrics_text(self) -> str:
+        """Process metrics registry (arena, semaphore/queue waits,
+        compile caches, shuffle bytes, service lifecycle counters) in
+        Prometheus text exposition format."""
+        from ..obs.prom import render_text
+        return render_text()
+
+    def start_metrics_server(self, port: int = 0,
+                             host: str = "127.0.0.1") -> int:
+        """Start (once) a daemon-thread ``/metrics`` scrape endpoint;
+        returns the bound port."""
+        if self._scrape_server is None:
+            from ..obs.prom import serve_scrapes
+            self._scrape_server, port = serve_scrapes(port=port, host=host)
+            self._scrape_port = port
+        return self._scrape_port
 
 
 # back-compat alias: a submitted query is the "request"

@@ -16,6 +16,8 @@ import queue
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..columnar.batch import ColumnarBatch
+from ..obs import trace as _trace
+from ..obs.registry import SHUFFLE_READ_BYTES
 from ..service.cancellation import cancel_checkpoint
 from .client import (RapidsShuffleClient, RapidsShuffleFetchHandler,
                      ReceivedBufferHandle)
@@ -102,6 +104,7 @@ class RapidsShuffleIterator(Iterator[ColumnarBatch]):
         whole fetch timeout), and only contiguous waiting counts toward
         ``timeout_s``."""
         import time as _time
+        t0 = _time.perf_counter_ns()
         deadline = _time.monotonic() + self.timeout_s
         while True:
             cancel_checkpoint()
@@ -109,10 +112,16 @@ class RapidsShuffleIterator(Iterator[ColumnarBatch]):
             if remaining <= 0:
                 raise queue.Empty
             try:
-                return self._queue.get(
+                item = self._queue.get(
                     timeout=min(_POLL_SLICE_S, remaining))
             except queue.Empty:
                 continue
+            if _trace._ENABLED:
+                # retroactive span over the blocked region: the remote
+                # fetch wait shows up on the task's timeline
+                _trace.emit("shuffle_fetch_wait", "shuffle", t0,
+                            _time.perf_counter_ns() - t0)
+            return item
 
     def __next__(self) -> ColumnarBatch:
         if self._local:
@@ -150,4 +159,9 @@ class RapidsShuffleIterator(Iterator[ColumnarBatch]):
             self._received_remote += 1
             # materialize = host blob -> device batch; this is where the
             # reference acquires the GPU semaphore (:307)
-            return handle.materialize()
+            batch = handle.materialize()
+            try:
+                SHUFFLE_READ_BYTES.inc(int(batch.nbytes()))
+            except Exception:
+                pass
+            return batch

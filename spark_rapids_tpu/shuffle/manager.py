@@ -19,6 +19,8 @@ import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..columnar.batch import ColumnarBatch
+from ..obs import trace as _trace
+from ..obs.registry import SHUFFLE_READ_BYTES, SHUFFLE_WRITE_BYTES
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,22 +53,29 @@ class ShuffleCatalog:
 
     def put(self, block: ShuffleBlockId, batches: List[ColumnarBatch]):
         from ..memory.spillable import SpillableBatch
+        with _trace.span("shuffle_write", "shuffle"):
+            entries = [SpillableBatch(b) for b in batches]
+        SHUFFLE_WRITE_BYTES.inc(sum(e.nbytes for e in entries))
         with self._lock:
-            self._store[block] = [SpillableBatch(b) for b in batches]
+            self._store[block] = entries
 
     def append(self, block: ShuffleBlockId, batches: List[ColumnarBatch]):
         """Incremental put: extend a block's batch list (map-side
         streaming writes register pieces as they finalize so they
         become spillable immediately)."""
         from ..memory.spillable import SpillableBatch
+        with _trace.span("shuffle_write", "shuffle"):
+            entries = [SpillableBatch(b) for b in batches]
+        SHUFFLE_WRITE_BYTES.inc(sum(e.nbytes for e in entries))
         with self._lock:
-            self._store.setdefault(block, []).extend(
-                SpillableBatch(b) for b in batches)
+            self._store.setdefault(block, []).extend(entries)
 
     def get(self, block: ShuffleBlockId) -> List[ColumnarBatch]:
         with self._lock:
             entries = self._store.get(block, [])
-        return [e.materialize() for e in entries]
+        SHUFFLE_READ_BYTES.inc(sum(e.nbytes for e in entries))
+        with _trace.span("shuffle_read", "shuffle"):
+            return [e.materialize() for e in entries]
 
     def stats_for_block(self, block: ShuffleBlockId):
         """(bytes, rows) without materializing (stays spilled —

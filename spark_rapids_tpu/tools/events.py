@@ -6,6 +6,16 @@ for the metrics/observability story (GpuMetric -> SQL UI role).
 Every executed query appends one JSON line to the event log:
   {"query_id", "wall_ms", "physical_plan", "fallbacks": [...],
    "node_metrics": {node: {metric: value}}, "conf": {...}}
+
+Durability: the logger keeps a persistent append-mode handle, flushes
+per record by default (conf ``eventLog.flushPerRecord`` / env
+``SPARK_RAPIDS_TPU_EVENT_LOG_FLUSH``), and rotates size-bounded files
+(conf ``eventLog.rotation.maxBytes`` / env
+``SPARK_RAPIDS_TPU_EVENT_LOG_MAX_BYTES``: current file renamed to
+``<path>.N``, N increasing) so long service runs never grow one
+unbounded JSONL file.  Multiple logger instances on the same path (the
+session's and the service's) serialize through a module lock and
+re-open after a peer's rotation (the WatchedFileHandler discipline).
 """
 from __future__ import annotations
 
@@ -18,10 +28,43 @@ from typing import Dict, List, Optional
 _LOCK = threading.Lock()
 
 
+def _env_bytes(name: str) -> Optional[int]:
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    s = str(raw).strip().lower()
+    mult = 1
+    for suffix, m in (("k", 2**10), ("m", 2**20), ("g", 2**30)):
+        if s.endswith(suffix + "b"):
+            s, mult = s[:-2], m
+            break
+        if s.endswith(suffix):
+            s, mult = s[:-1], m
+            break
+    return int(float(s) * mult)
+
+
 class QueryEventLogger:
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None,
+                 max_bytes: Optional[int] = None,
+                 flush_each: Optional[bool] = None):
         self.path = path or os.environ.get(
             "SPARK_RAPIDS_TPU_EVENT_LOG", "")
+        # precedence: explicit arg > env > active conf
+        from ..config import (get_active, EVENT_LOG_ROTATE_BYTES,
+                              EVENT_LOG_FLUSH_PER_RECORD)
+        if max_bytes is None:
+            max_bytes = _env_bytes("SPARK_RAPIDS_TPU_EVENT_LOG_MAX_BYTES")
+        if max_bytes is None:
+            max_bytes = get_active().get(EVENT_LOG_ROTATE_BYTES)
+        if flush_each is None:
+            env = os.environ.get("SPARK_RAPIDS_TPU_EVENT_LOG_FLUSH")
+            flush_each = env.strip().lower() in ("true", "1", "yes") \
+                if env else get_active().get(EVENT_LOG_FLUSH_PER_RECORD)
+        self.max_bytes = int(max_bytes or 0)
+        self.flush_each = bool(flush_each)
+        self.rotations = 0
+        self._file = None
         self._next_id = 0
         self._id_lock = threading.Lock()
 
@@ -68,32 +111,93 @@ class QueryEventLogger:
         self._append(record)
         return record
 
+    # -- durable append with size-based rotation ---------------------------
+    def _open_locked(self):
+        """(Re)open the append handle; detects a peer instance's
+        rotation by inode mismatch and follows the fresh file."""
+        if self._file is not None and not self._file.closed:
+            try:
+                if os.path.exists(self.path) and \
+                        os.stat(self.path).st_ino == \
+                        os.fstat(self._file.fileno()).st_ino:
+                    return self._file
+            except OSError:
+                pass
+            self._file.close()
+            self._file = None
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._file = open(self.path, "a")
+        return self._file
+
+    def _rotate_locked(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        n = 1
+        while os.path.exists(f"{self.path}.{n}"):
+            n += 1
+        os.replace(self.path, f"{self.path}.{n}")
+        self.rotations += 1
+
     def _append(self, record: Dict):
         if not self.enabled():
             return
+        line = json.dumps(record) + "\n"
         with _LOCK:
-            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            with open(self.path, "a") as f:
-                f.write(json.dumps(record) + "\n")
+            f = self._open_locked()
+            if self.max_bytes:
+                try:
+                    size = os.fstat(f.fileno()).st_size
+                except OSError:
+                    size = 0
+                if size and size + len(line) > self.max_bytes:
+                    self._rotate_locked()
+                    f = self._open_locked()
+            f.write(line)
+            if self.flush_each:
+                f.flush()
+
+    def close(self):
+        with _LOCK:
+            if self._file is not None and not self._file.closed:
+                self._file.close()
+            self._file = None
 
 
-def read_event_log(path: str, events: Optional[str] = "query") -> List[Dict]:
+def rotated_paths(path: str) -> List[str]:
+    """Every file of a (possibly rotated) event log, oldest first:
+    ``path.1``, ``path.2``, ..., then the live ``path``."""
+    out = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        out.append(f"{path}.{n}")
+        n += 1
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def read_event_log(path: str, events: Optional[str] = "query",
+                   include_rotated: bool = False) -> List[Dict]:
     """Parsed event-log records.
 
     ``events`` filters by record kind: the default "query" returns only
     engine-execution records (what the qualification/profiling tools
     consume — service lifecycle lines would skew their per-query
     statistics); pass a specific kind ("retry", "shed", ...) or None
-    for everything."""
+    for everything.  ``include_rotated`` also reads ``path.N`` rotation
+    segments, oldest first."""
     out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            rec = json.loads(line)
-            kind = rec.get("event", "query")
-            if events is not None and kind != events:
-                continue
-            out.append(rec)
+    paths = rotated_paths(path) if include_rotated else [path]
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                kind = rec.get("event", "query")
+                if events is not None and kind != events:
+                    continue
+                out.append(rec)
     return out

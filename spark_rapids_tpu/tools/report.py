@@ -1,0 +1,272 @@
+"""Per-query report generator — the SQL-UI / profiling-report stand-in.
+
+Joins the structured event log (engine ``query`` records + service
+lifecycle lines, both keyed by the stable ``query_id``) and, when given,
+the span tracer's Chrome trace JSON, into one readable per-query story:
+
+- the physical plan tree annotated with each operator's attributed time
+  and share of the total (the SQL UI's "time in operator" view);
+- the retry/spill story: admission, queue wait, each attempt's outcome,
+  backoffs, semaphore wait and spill bytes;
+- the critical-path spans from the trace (longest exclusive regions).
+
+Usage:
+  python -m spark_rapids_tpu.tools.report <event_log.jsonl>
+      [--query QID] [--trace trace.json] [--html out.html]
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# host-side CLI: never touch the accelerator backend
+_jax.config.update("jax_platforms", "cpu")
+
+import html as _html
+import json
+import sys
+from typing import Dict, List, Optional
+
+from .events import read_event_log
+
+#: lifecycle kinds emitted by the query service, in story order
+_LIFECYCLE = ("admitted", "shed", "retry", "cancelled", "completed",
+              "failed")
+
+
+# ---------------------------------------------------------------------------
+# event-log join
+# ---------------------------------------------------------------------------
+
+def load_query_stories(path: str) -> Dict:
+    """{query_id: {"engine": [query records], "service": [lifecycle
+    records]}} across the log and its rotation segments, preserving
+    file order within each stream."""
+    stories: Dict = {}
+    for rec in read_event_log(path, events=None, include_rotated=True):
+        qid = rec.get("query_id")
+        story = stories.setdefault(
+            qid, {"engine": [], "service": []})
+        if rec.get("event", "query") == "query":
+            story["engine"].append(rec)
+        else:
+            story["service"].append(rec)
+    return stories
+
+
+# ---------------------------------------------------------------------------
+# plan tree with time shares
+# ---------------------------------------------------------------------------
+
+def plan_time_shares(record: Dict) -> List[Dict]:
+    """One row per plan node: {depth, label, time_ms, share} — the
+    node_metrics keys are "<preorder-index>:<Name>" in the same order
+    the tree string prints, so the join is positional (the
+    generate_dot discipline)."""
+    nodes = []
+    for ln in record.get("physical_plan", "").splitlines():
+        depth = (len(ln) - len(ln.lstrip())) // 2
+        nodes.append((depth, ln.strip()))
+    metrics = record.get("node_metrics", {})
+    keys = list(metrics.keys())
+    rows = []
+    for i, (depth, label) in enumerate(nodes):
+        m = metrics.get(keys[i], {}) if i < len(keys) else {}
+        t_ns = sum(v for k, v in m.items()
+                   if k.endswith("Time") or k.endswith("time"))
+        rows.append({"depth": depth, "label": label,
+                     "time_ms": t_ns / 1e6,
+                     "rows": m.get("numOutputRows")})
+    total = sum(r["time_ms"] for r in rows)
+    for r in rows:
+        r["share"] = (r["time_ms"] / total) if total else 0.0
+    return rows
+
+
+def _format_plan(rows: List[Dict]) -> List[str]:
+    out = []
+    for r in rows:
+        bar = "#" * int(round(r["share"] * 20))
+        annot = f"{r['share'] * 100:5.1f}% {r['time_ms']:9.2f}ms"
+        if r.get("rows") is not None:
+            annot += f"  rows={r['rows']}"
+        out.append(f"  {annot:<44s} {bar:<20s} "
+                   f"{'  ' * r['depth']}{r['label']}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# trace join (critical-path spans)
+# ---------------------------------------------------------------------------
+
+def load_trace(path: str) -> List[Dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    return [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+
+
+def critical_spans(events: List[Dict], query_id,
+                   top: int = 12) -> List[Dict]:
+    """Longest spans attributed to ``query_id`` (or unattributed when
+    the trace holds a single query), grouped by (name, cat)."""
+    qid = str(query_id)
+    mine = [e for e in events
+            if str(e.get("args", {}).get("query_id", qid)) == qid]
+    agg: Dict = {}
+    for e in mine:
+        key = (e["name"], e.get("cat", ""))
+        a = agg.setdefault(key, {"name": e["name"],
+                                 "cat": e.get("cat", ""),
+                                 "count": 0, "total_ms": 0.0,
+                                 "max_ms": 0.0})
+        dur_ms = e.get("dur", 0.0) / 1e3
+        a["count"] += 1
+        a["total_ms"] += dur_ms
+        a["max_ms"] = max(a["max_ms"], dur_ms)
+    out = sorted(agg.values(), key=lambda a: -a["total_ms"])[:top]
+    for a in out:
+        a["total_ms"] = round(a["total_ms"], 3)
+        a["max_ms"] = round(a["max_ms"], 3)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def _service_story(service: List[Dict]) -> List[str]:
+    """The retry/spill story in chronological lines."""
+    out = []
+    for rec in sorted(service, key=lambda r: r.get("ts", 0)):
+        kind = rec.get("event")
+        if kind == "admitted":
+            out.append(f"admitted    tenant={rec.get('tenant')} "
+                       f"priority={rec.get('priority')} "
+                       f"queue_depth={rec.get('queue_depth')} "
+                       f"deadline_ms={rec.get('deadline_ms')}")
+        elif kind == "retry":
+            out.append(f"retry #{rec.get('attempt')}    "
+                       f"reason={rec.get('reason')} "
+                       f"backoff_ms={rec.get('backoff_ms')} "
+                       f"overlay={rec.get('conf_overlay')}")
+        elif kind == "shed":
+            out.append(f"shed        {rec.get('reason')}")
+        elif kind in ("completed", "failed", "cancelled"):
+            out.append(
+                f"{kind:<11s} attempts={rec.get('attempts')} "
+                f"queue_wait_ms={rec.get('queue_wait_ms')} "
+                f"execute_ms={rec.get('execute_ms')} "
+                f"sem_wait_ms={rec.get('sem_wait_ms')} "
+                f"spill_bytes={rec.get('spill_bytes')}"
+                + (f" error={rec.get('error')}"
+                   if rec.get("error") else ""))
+    return out
+
+
+def render_query_report(query_id, story: Dict,
+                        trace_events: Optional[List[Dict]] = None) -> str:
+    """One query's full text report."""
+    lines = [f"=== query {query_id} " + "=" * 40]
+    engine = story.get("engine", [])
+    service = story.get("service", [])
+    if service:
+        lines.append("-- service story --")
+        lines.extend("  " + s for s in _service_story(service))
+    for i, rec in enumerate(engine):
+        tag = f" (attempt record {i + 1}/{len(engine)})" \
+            if len(engine) > 1 else ""
+        lines.append(f"-- plan + time shares{tag}: "
+                     f"wall_ms={rec.get('wall_ms')} "
+                     f"sem_wait_ms={rec.get('sem_wait_ms')} "
+                     f"spill_bytes={rec.get('spill_bytes')} --")
+        lines.extend(_format_plan(plan_time_shares(rec)))
+        if rec.get("fallbacks"):
+            lines.append("  CPU fallbacks:")
+            lines.extend(f"    {f}" for f in rec["fallbacks"])
+    if trace_events:
+        spans = critical_spans(trace_events, query_id)
+        if spans:
+            lines.append("-- critical-path spans --")
+            lines.append(f"  {'name':<28s}{'cat':<10s}"
+                         f"{'count':>6s}{'total_ms':>12s}{'max_ms':>10s}")
+            for s in spans:
+                lines.append(f"  {s['name']:<28s}{s['cat']:<10s}"
+                             f"{s['count']:>6d}{s['total_ms']:>12.3f}"
+                             f"{s['max_ms']:>10.3f}")
+    return "\n".join(lines)
+
+
+def render_report(stories: Dict,
+                  trace_events: Optional[List[Dict]] = None,
+                  query_id=None) -> str:
+    ids = [query_id] if query_id is not None else sorted(
+        stories, key=lambda q: str(q))
+    parts = []
+    for qid in ids:
+        if qid not in stories:
+            raise KeyError(f"query {qid!r} not in event log")
+        parts.append(render_query_report(qid, stories[qid], trace_events))
+    return "\n\n".join(parts)
+
+
+def render_html(stories: Dict,
+                trace_events: Optional[List[Dict]] = None,
+                query_id=None) -> str:
+    """Self-contained single-file HTML wrapping the text report
+    per-query (monospace <pre> sections with a query index)."""
+    ids = [query_id] if query_id is not None else sorted(
+        stories, key=lambda q: str(q))
+    body = ["<h1>spark_rapids_tpu query report</h1>",
+            "<ul>" + "".join(
+                f'<li><a href="#q{_html.escape(str(q))}">'
+                f"{_html.escape(str(q))}</a></li>" for q in ids) + "</ul>"]
+    for qid in ids:
+        txt = render_query_report(qid, stories[qid], trace_events)
+        body.append(f'<h2 id="q{_html.escape(str(qid))}">'
+                    f"query {_html.escape(str(qid))}</h2>")
+        body.append(f"<pre>{_html.escape(txt)}</pre>")
+    return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>query report</title><style>"
+            "body{font-family:sans-serif;margin:2em}"
+            "pre{background:#f6f8fa;padding:1em;overflow-x:auto}"
+            "</style></head><body>" + "\n".join(body) + "</body></html>")
+
+
+def main(argv=None):
+    argv = list(argv if argv is not None else sys.argv[1:])
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: report <event_log.jsonl> [--query QID] "
+              "[--trace trace.json] [--html out.html]", file=sys.stderr)
+        return 1
+
+    def _opt(flag):
+        if flag in argv:
+            i = argv.index(flag)
+            v = argv[i + 1]
+            del argv[i:i + 2]
+            return v
+        return None
+
+    qid = _opt("--query")
+    trace_path = _opt("--trace")
+    html_out = _opt("--html")
+    log_path = argv[0]
+    stories = load_query_stories(log_path)
+    trace_events = load_trace(trace_path) if trace_path else None
+    # query ids are ints for session-local logs, strings for service ones
+    if qid is not None and qid not in stories:
+        try:
+            if int(qid) in stories:
+                qid = int(qid)
+        except ValueError:
+            pass
+    if html_out:
+        with open(html_out, "w") as f:
+            f.write(render_html(stories, trace_events, qid))
+        print(f"wrote {html_out}")
+    else:
+        print(render_report(stories, trace_events, qid))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

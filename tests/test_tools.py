@@ -433,3 +433,122 @@ class TestCboExpressionCosts:
         # global aggregate collapses to one row
         agg = L.Aggregate([], [], left)
         assert cbo.estimate_rows(agg) == 1.0
+
+
+class TestEventLogDurability:
+    """Rotation + flush-per-record + concurrent writers (the
+    WatchedFileHandler discipline in tools/events.py)."""
+
+    def test_flush_per_record_is_default(self, tmp_path):
+        from spark_rapids_tpu.tools.events import QueryEventLogger
+        log = str(tmp_path / "ev.jsonl")
+        logger = QueryEventLogger(log)
+        assert logger.flush_each
+        logger.log_service_event("admitted", "q1")
+        # readable immediately, without close()
+        assert len(read_event_log(log, events=None)) == 1
+        logger.close()
+
+    def test_size_based_rotation(self, tmp_path):
+        from spark_rapids_tpu.tools.events import (QueryEventLogger,
+                                                   rotated_paths)
+        log = str(tmp_path / "ev.jsonl")
+        logger = QueryEventLogger(log, max_bytes=300)
+        for i in range(20):
+            logger.log_service_event("admitted", f"q{i}", pad="x" * 60)
+        logger.close()
+        assert logger.rotations > 0
+        paths = rotated_paths(log)
+        assert len(paths) == logger.rotations + 1
+        assert paths[-1] == log
+        # every record survives across segments, oldest first
+        recs = read_event_log(log, events=None, include_rotated=True)
+        assert [r["query_id"] for r in recs] == \
+            [f"q{i}" for i in range(20)]
+        # non-rotated read sees only the live tail
+        assert len(read_event_log(log, events=None)) < 20
+
+    def test_env_conf_precedence(self, tmp_path, monkeypatch):
+        from spark_rapids_tpu.tools.events import QueryEventLogger
+        monkeypatch.setenv("SPARK_RAPIDS_TPU_EVENT_LOG_MAX_BYTES", "1k")
+        logger = QueryEventLogger(str(tmp_path / "e.jsonl"))
+        assert logger.max_bytes == 1024
+        # explicit arg beats env
+        logger2 = QueryEventLogger(str(tmp_path / "e.jsonl"),
+                                   max_bytes=77)
+        assert logger2.max_bytes == 77
+        logger.close()
+        logger2.close()
+
+    def test_concurrent_writers_one_path(self, tmp_path):
+        """Multiple logger instances on one path (session + service)
+        under concurrent writes: every line lands intact, including
+        across rotations triggered by either instance."""
+        import threading
+        from spark_rapids_tpu.tools.events import QueryEventLogger
+        log = str(tmp_path / "ev.jsonl")
+        loggers = [QueryEventLogger(log, max_bytes=2000)
+                   for _ in range(3)]
+        n_per = 40
+        errs = []
+
+        def writer(idx):
+            try:
+                for i in range(n_per):
+                    loggers[idx].log_service_event(
+                        "admitted", f"w{idx}-{i}", pad="y" * 40)
+            except Exception as e:   # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(len(loggers))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for lg in loggers:
+            lg.close()
+        assert not errs
+        recs = read_event_log(log, events=None, include_rotated=True)
+        ids = [r["query_id"] for r in recs]
+        assert len(ids) == len(loggers) * n_per
+        assert len(set(ids)) == len(ids)       # no torn/duplicated lines
+
+
+class TestProfilingMultiAttempt:
+    """analyze/breakdown over service logs where one query_id carries
+    several engine records (retry attempts)."""
+
+    def _multi_attempt_records(self):
+        mk = lambda qid, op_ns: {               # noqa: E731
+            "event": "query", "query_id": qid, "wall_ms": op_ns / 1e6,
+            "physical_plan": "TpuProject\n  TpuLocalScan",
+            "nodes": ["TpuProject", "TpuLocalScan"],
+            "fallbacks": [],
+            "node_metrics": {
+                "0:TpuProject": {"opTime": op_ns, "numOutputRows": 10},
+                "1:TpuLocalScan": {"opTime": op_ns // 4},
+            },
+            "conf": {},
+        }
+        # q1 ran twice (one retry), q2 once
+        return [mk("q1", 8_000_000), mk("q1", 2_000_000),
+                mk("q2", 4_000_000)]
+
+    def test_analyze_counts_attempts(self):
+        recs = self._multi_attempt_records()
+        a = analyze(recs)
+        assert a["num_queries"] == 3           # records, i.e. attempts
+        assert a["operator_totals"]["TpuProject"]["occurrences"] == 3
+        assert a["operator_totals"]["TpuProject"]["opTime"] == 14_000_000
+        assert a["slowest_queries"][0]["query_id"] == "q1"
+
+    def test_breakdown_aggregates_attempts(self):
+        from spark_rapids_tpu.tools.profiling import breakdown
+        recs = self._multi_attempt_records()
+        b = breakdown(recs)
+        assert b["time_by_operator_ms"]["TpuProject"] == 14.0
+        assert b["time_by_operator_ms"]["TpuLocalScan"] == 3.5
+        assert abs(sum(b["time_share"].values()) - 1.0) < 0.01
+        assert b["counters_by_operator"]["TpuProject"][
+            "numOutputRows"] == 30
