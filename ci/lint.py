@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Project lint CLI — the CI gate over ``analysis/lint.py``.
+
+Usage:
+  python ci/lint.py                  # full project lint (exit 1 on findings)
+  python ci/lint.py PATH [PATH...]   # lint specific files/dirs, ALL rules
+                                     # (the seeded-fixture surface)
+  python ci/lint.py --plan-smoke     # plan-verifier smoke over TPC-DS-style
+                                     # query plans (exit 1 on violations)
+
+Runs under JAX_PLATFORMS=cpu (the conf/doc checks import the live
+registry; the plan smoke lowers real queries) — set by ci/smoke_test.sh.
+"""
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _plan_smoke() -> int:
+    """Lower TPC-DS-style queries (star-join aggregate, global sort +
+    limit, semi-join) and run the invariant verifier on each physical
+    tree — the pre-execution gate CI exercises end to end."""
+    import tempfile
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(REPO_ROOT, "benchmarks"))
+    import tpcds
+
+    from spark_rapids_tpu.analysis import verify_or_raise
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.config import TpuConf
+
+    queries = ["q3", "q42", "q52", "q96"]
+    with tempfile.TemporaryDirectory() as d:
+        data = os.path.join(d, "sf")
+        tpcds.generate(data, scale=0.001, seed=7)
+        s = TpuSession(TpuConf({
+            "spark.rapids.tpu.sql.shuffle.partitions": 4,
+        }))
+        tpcds.register(s, data)
+        for q in queries:
+            phys = s._plan(s.sql(tpcds.QUERIES[q])._plan)
+            report = verify_or_raise(phys)
+            print(f"plan-verify {q}: ok "
+                  f"({len(phys.collect_nodes())} nodes)")
+            _ = report
+    print("plan-verify smoke: OK")
+    return 0
+
+
+def main(argv) -> int:
+    from spark_rapids_tpu.analysis.lint import (format_findings,
+                                                lint_paths, lint_project)
+    if "--plan-smoke" in argv:
+        return _plan_smoke()
+    if argv:
+        findings = lint_paths(argv)
+    else:
+        findings = lint_project(REPO_ROOT)
+    if findings:
+        print(format_findings(findings))
+        return 1
+    print("lint: no findings")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -6,6 +6,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== static analysis (project lint + race analysis) =="
+JAX_PLATFORMS=cpu python ci/lint.py
+
+echo "== plan-invariant verifier smoke (TPC-DS-style plans) =="
+JAX_PLATFORMS=cpu python ci/lint.py --plan-smoke
+
 echo "== unit suite (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q
 

@@ -1,0 +1,31 @@
+"""Static verification layer.
+
+Two heads (ISSUE 3 / the tag-time-checking discipline of the reference
+plugin, applied end-to-end):
+
+- ``plan_verify``: multi-pass invariant verifier over a lowered
+  ``PhysicalPlan`` tree, run BEFORE execution (behind
+  ``spark.rapids.tpu.sql.planVerify``, forced on under the test
+  harness).  The reference catches misconfigured plans when tagging
+  (TypeChecks/ExecChecks intersect plan dtypes against TypeSig); this
+  re-checks the *converted* tree so planner rewrites (stage collapse,
+  AQE, mesh placement) cannot silently break schema propagation,
+  dtype supportability, partitioning contracts, or cancellation
+  coverage.
+
+- ``lint``: Python-AST project lint / race-analysis harness over the
+  ``spark_rapids_tpu`` source tree (lock discipline, host-sync bans,
+  conf/doc drift, hygiene).  CLI entry: ``ci/lint.py``.
+
+Shared finding format: (rule id, file:line, message) — see
+``docs/analysis.md`` for the rule catalog.
+"""
+from .plan_verify import (PlanVerificationError, PlanVerificationReport,
+                          Violation, verify_plan, verify_or_raise)
+from .lint import Finding, lint_paths, lint_project, lint_source
+
+__all__ = [
+    "PlanVerificationError", "PlanVerificationReport", "Violation",
+    "verify_plan", "verify_or_raise",
+    "Finding", "lint_paths", "lint_project", "lint_source",
+]

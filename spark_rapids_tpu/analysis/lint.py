@@ -1,0 +1,637 @@
+"""Project lint / race-analysis harness (Python-AST based).
+
+Project-specific static checks over the ``spark_rapids_tpu`` source
+tree — the defect classes a heavily locked multi-tenant service plus a
+device hot path accumulate and that cheap static analysis catches:
+
+==========  =============================================================
+rule id     meaning
+==========  =============================================================
+LOCK001     blocking call (socket I/O, ``time.sleep``, device syncs)
+            made while holding a lock
+LOCK002     lock-acquisition-order inversion (cycle in the cross-file
+            lock-order graph built from nested ``with <lock>`` regions)
+SYNC001     host-device synchronization (``jax.device_get``,
+            ``block_until_ready``, numpy ``asarray`` pulls in kernels/)
+            inside the device hot path (``kernels/``, ``exec/tpu_*``)
+CONF001     ``ConfEntry`` in the live registry missing from
+            ``docs/configs.md`` (or a documented key missing from the
+            registry)
+CONF002     committed docgen output (``docs/configs.md`` /
+            ``docs/supported_ops.md``) differs from a fresh
+            ``tools/docgen.py`` render
+HYG001      bare ``except:``
+HYG002      ``time.time()`` in ``obs/`` timing paths where
+            ``time.perf_counter_ns`` is required (trace timestamps must
+            be monotonic)
+HYG003      exec-node class defining ``execute`` without an
+            ``output_schema`` override (same-file inheritance resolved;
+            cross-file bases are skipped, stay permissive)
+==========  =============================================================
+
+Suppressions: a finding whose source line (or the line directly above)
+carries ``# lint: allow(<RULE>)`` — optionally
+``# lint: allow(<RULE>): justification`` — is dropped.  Suppressions
+are for *intentional* cases and should carry the justification.
+
+Lock model (intra-procedural, permissive):
+
+- a lock is (a) any attribute/name assigned from
+  ``threading.Lock/RLock/Condition/Semaphore/BoundedSemaphore`` in the
+  same file, or (b) any ``with`` context whose dotted name matches
+  ``lock``/``mutex`` (case-insensitive);
+- ``with a: ... with b:`` records the order edge ``a -> b``; inversions
+  are cycles in the cross-file transitive closure;
+- ``Condition.wait``/``wait_for`` RELEASE the lock while blocked and are
+  never flagged;
+- nested ``def``/``lambda`` bodies are not attributed to the enclosing
+  held region (they run later).
+
+CLI: ``ci/lint.py`` (exits nonzero on findings).  Programmatic:
+``lint_source`` (one buffer — the self-test surface), ``lint_paths``,
+``lint_project``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+LOCK001 = "LOCK001"
+LOCK002 = "LOCK002"
+SYNC001 = "SYNC001"
+CONF001 = "CONF001"
+CONF002 = "CONF002"
+HYG001 = "HYG001"
+HYG002 = "HYG002"
+HYG003 = "HYG003"
+
+ALL_RULES = (LOCK001, LOCK002, SYNC001, CONF001, CONF002,
+             HYG001, HYG002, HYG003)
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([A-Z0-9, ]+)\)")
+
+#: blocking attribute calls under a lock (LOCK001).  ``wait``/
+#: ``wait_for`` are deliberately absent: Condition waits release the
+#: lock.  ``asarray`` is only blocking for device arrays, but inside a
+#: lock region in service/shuffle/memory code a device pull is exactly
+#: the hazard being policed.
+_BLOCKING_ATTRS = {
+    "sendall", "recv", "recv_into", "accept", "connect", "connect_ex",
+    "sleep", "block_until_ready", "device_get", "create_connection",
+    "getaddrinfo", "asarray",
+}
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_LOCK_NAME_RE = re.compile(r"lock|mutex", re.IGNORECASE)
+
+#: numpy module aliases for the SYNC001 asarray check
+_NP_ALIASES = {"np", "_np", "numpy"}
+
+#: hot-path files where numpy pulls are intentional — the explicit
+#: SYNC001 allowlist.  asarray is exempt in these files (each with its
+#: justification); the unambiguous sync APIs (device_get /
+#: block_until_ready) are still banned everywhere.
+_SYNC_NP_FILE_ALLOWLIST = {
+    # host trampolines for jax.pure_callback: the whole point is to run
+    # the exact-binary64 op on host
+    "binary64.py",
+    # host-side string offset/byte-table prep feeding device uploads
+    "strings.py",
+    # verify-at-flush barriers: the join/sort execution model pulls
+    # count words ONCE per flush (gather-map surgery, out-of-core merge
+    # staging) — the sanctioned sync points of SURVEY §"speculative"
+    "tpu_join.py", "tpu_sort.py",
+    # mesh collectives hand results back to the host once per SPMD
+    # program (the shard gather at program exit)
+    "tpu_mesh_aggregate.py", "tpu_mesh_join.py", "tpu_mesh_sort.py",
+}
+
+
+class Finding:
+    """One lint finding — shared (rule, file:line, message) format."""
+
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule: str, path: str, line: int, message: str):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.message = message
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+    def __repr__(self):
+        return f"Finding({self})"
+
+
+# ---------------------------------------------------------------------------
+# suppression handling
+# ---------------------------------------------------------------------------
+
+def _suppressions(source: str) -> Dict[int, Set[str]]:
+    """{line_number: {rule ids allowed}} from ``# lint: allow(...)``
+    comments.  A trailing allow covers its own line; a comment-only
+    allow covers the next following non-comment, non-blank source line
+    (the justification may continue over several comment lines)."""
+    out: Dict[int, Set[str]] = {}
+    lines = source.splitlines()
+    for i, line in enumerate(lines, start=1):
+        m = _ALLOW_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        if not line.strip().startswith("#"):
+            continue    # trailing comment: own line only
+        j = i
+        while j < len(lines):
+            nxt = lines[j].strip()
+            if nxt and not nxt.startswith("#"):
+                out.setdefault(j + 1, set()).update(rules)
+                break
+            j += 1
+    return out
+
+
+def _apply_suppressions(findings: List[Finding],
+                        sup: Dict[int, Set[str]]) -> List[Finding]:
+    return [f for f in findings
+            if f.rule not in sup.get(f.line, ()) ]
+
+
+# ---------------------------------------------------------------------------
+# per-file AST analysis
+# ---------------------------------------------------------------------------
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'self._lock' for Attribute chains / plain Names; None otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _collect_lock_names(tree: ast.AST) -> Set[str]:
+    """Final attribute/variable names assigned from threading lock
+    factories anywhere in the file (``self._lock = threading.Lock()``,
+    ``_LOCK = threading.Lock()``, ``wlock = Lock()``...)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        fname = value.func.attr if isinstance(value.func, ast.Attribute) \
+            else (value.func.id if isinstance(value.func, ast.Name)
+                  else None)
+        if fname not in _LOCK_FACTORIES:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            d = _dotted(t)
+            if d:
+                names.add(d.split(".")[-1])
+    return names
+
+
+class _FileLockAnalysis(ast.NodeVisitor):
+    """Walks one file: with-lock regions, blocking calls inside them,
+    and lock-order edges for the cross-file graph."""
+
+    def __init__(self, path: str, tree: ast.AST, lock_names: Set[str]):
+        self.path = path
+        self.lock_names = lock_names
+        self.findings: List[Finding] = []
+        #: (src_lock, dst_lock, line) — dst acquired while src held
+        self.edges: List[Tuple[str, str, int]] = []
+        self._class_stack: List[str] = []
+        self._held: List[str] = []
+        self.visit(tree)
+
+    # -- lock identity ------------------------------------------------------
+    def _lock_id(self, dotted: str) -> str:
+        """Qualified lock identity for the order graph: instance locks
+        qualify by enclosing class (every instance shares the
+        discipline), everything else by file stem."""
+        leaf = dotted.split(".")[-1]
+        if dotted.startswith("self.") and self._class_stack:
+            return f"{self._class_stack[-1]}.{leaf}"
+        stem = os.path.splitext(os.path.basename(self.path))[0]
+        return f"{stem}.{leaf}"
+
+    def _is_lock_expr(self, expr: ast.AST) -> Optional[str]:
+        d = _dotted(expr)
+        if d is None:
+            return None
+        leaf = d.split(".")[-1]
+        if leaf in self.lock_names or _LOCK_NAME_RE.search(leaf):
+            return self._lock_id(d)
+        return None
+
+    # -- traversal ----------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_held_body(self, body):
+        for stmt in body:
+            self.visit(stmt)
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            lock = self._is_lock_expr(item.context_expr)
+            if lock is not None:
+                for held in self._held:
+                    if held != lock:
+                        self.edges.append(
+                            (held, lock, item.context_expr.lineno))
+                acquired.append(lock)
+        self._held.extend(acquired)
+        try:
+            for item in node.items:
+                self.visit(item.context_expr)
+            self._visit_held_body(node.body)
+        finally:
+            for _ in acquired:
+                self._held.pop()
+
+    # nested function/lambda bodies run later, outside the held region
+    def visit_FunctionDef(self, node):
+        saved, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        saved, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = saved
+
+    def visit_Call(self, node: ast.Call):
+        if self._held:
+            attr = None
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                attr = node.func.id
+            if attr in _BLOCKING_ATTRS:
+                self.findings.append(Finding(
+                    LOCK001, self.path, node.lineno,
+                    f"blocking call '{attr}' while holding lock "
+                    f"{self._held[-1]} (held: "
+                    f"{', '.join(self._held)}): a stalled peer/device "
+                    f"parks every thread contending on that lock"))
+        self.generic_visit(node)
+
+
+class _SyncVisitor(ast.NodeVisitor):
+    """SYNC001: device-hot-path host synchronization."""
+
+    def __init__(self, path: str, tree: ast.AST, check_asarray: bool):
+        self.path = path
+        self.check_asarray = check_asarray
+        self.findings: List[Finding] = []
+        self.visit(tree)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in ("device_get", "block_until_ready"):
+                self.findings.append(Finding(
+                    SYNC001, self.path, node.lineno,
+                    f"'{f.attr}' forces a device->host round trip in "
+                    f"the hot path"))
+            elif f.attr == "asarray" and self.check_asarray and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in _NP_ALIASES:
+                self.findings.append(Finding(
+                    SYNC001, self.path, node.lineno,
+                    "numpy asarray on (potentially device) data pulls "
+                    "to host and serializes the dispatch queue"))
+        self.generic_visit(node)
+
+
+class _HygieneVisitor(ast.NodeVisitor):
+    """HYG001 bare except; HYG002 time.time in obs/; HYG003 exec nodes
+    missing output_schema (same-file inheritance only)."""
+
+    _EXEC_ROOT_BASES = {"PhysicalPlan", "TpuExec", "CpuExec"}
+
+    def __init__(self, path: str, tree: ast.AST, in_obs: bool,
+                 check_exec_schema: bool):
+        self.path = path
+        self.in_obs = in_obs
+        self.check_exec_schema = check_exec_schema
+        self.findings: List[Finding] = []
+        self._classes: Dict[str, ast.ClassDef] = {
+            n.name: n for n in ast.walk(tree)
+            if isinstance(n, ast.ClassDef)}
+        self.visit(tree)
+        if check_exec_schema:
+            self._check_exec_schemas()
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if node.type is None:
+            self.findings.append(Finding(
+                HYG001, self.path, node.lineno,
+                "bare 'except:' swallows KeyboardInterrupt/SystemExit "
+                "(and the service's cancellation unwind)"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        if self.in_obs and isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "time" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "time":
+            self.findings.append(Finding(
+                HYG002, self.path, node.lineno,
+                "time.time() in an obs/ timing path: trace/metric "
+                "timestamps must be monotonic (use "
+                "time.perf_counter_ns)"))
+        self.generic_visit(node)
+
+    # -- HYG003 -------------------------------------------------------------
+    def _defines(self, cls: ast.ClassDef, method: str) -> bool:
+        return any(isinstance(n, ast.FunctionDef) and n.name == method
+                   for n in cls.body)
+
+    def _resolved_chain(self, cls: ast.ClassDef
+                        ) -> Optional[List[ast.ClassDef]]:
+        """[cls + same-file ancestors], or None when a base cannot be
+        resolved in-file (other than the known schema-less roots) —
+        permissive on cross-file inheritance."""
+        chain, todo = [], [cls]
+        while todo:
+            c = todo.pop()
+            chain.append(c)
+            for b in c.bases:
+                name = b.id if isinstance(b, ast.Name) else (
+                    b.attr if isinstance(b, ast.Attribute) else None)
+                if name is None or name in self._EXEC_ROOT_BASES:
+                    if name is None:
+                        return None
+                    continue
+                base = self._classes.get(name)
+                if base is None:
+                    return None
+                todo.append(base)
+        return chain
+
+    def _check_exec_schemas(self):
+        for cls in self._classes.values():
+            if not self._defines(cls, "execute"):
+                continue
+            base_names = {b.id if isinstance(b, ast.Name) else
+                          (b.attr if isinstance(b, ast.Attribute)
+                           else "") for b in cls.bases}
+            chain = self._resolved_chain(cls)
+            if chain is None:
+                continue
+            if len(chain) == 1 and not (base_names &
+                                        self._EXEC_ROOT_BASES):
+                continue    # not an exec node
+            if not any(self._defines(c, "output_schema")
+                       for c in chain):
+                self.findings.append(Finding(
+                    HYG003, self.path, cls.lineno,
+                    f"exec node {cls.name} defines execute() without an "
+                    f"output_schema override (schema propagation would "
+                    f"raise at plan time)"))
+
+
+# ---------------------------------------------------------------------------
+# lock-order graph -> inversions (LOCK002)
+# ---------------------------------------------------------------------------
+
+def lock_order_inversions(
+        edges: List[Tuple[str, str, str, int]]) -> List[Finding]:
+    """Cycle detection over the cross-file lock-order graph.
+
+    ``edges``: (src_lock, dst_lock, path, line).  Any pair of locks
+    reachable from each other is an inversion; reported once per
+    offending edge direction."""
+    graph: Dict[str, Set[str]] = {}
+    sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for src, dst, path, line in edges:
+        graph.setdefault(src, set()).add(dst)
+        sites.setdefault((src, dst), (path, line))
+
+    def reachable(frm: str) -> Set[str]:
+        seen, todo = set(), [frm]
+        while todo:
+            n = todo.pop()
+            for m in graph.get(n, ()):
+                if m not in seen:
+                    seen.add(m)
+                    todo.append(m)
+        return seen
+
+    out, reported = [], set()
+    for src, dsts in graph.items():
+        back = reachable(src)
+        for dst in dsts:
+            if src in reachable(dst) and src != dst:
+                key = frozenset((src, dst))
+                if key in reported:
+                    continue
+                reported.add(key)
+                path, line = sites[(src, dst)]
+                opath, oline = sites.get((dst, src), (path, line))
+                out.append(Finding(
+                    LOCK002, path, line,
+                    f"lock-order inversion: {src} -> {dst} here, but "
+                    f"{dst} -> {src} at {opath}:{oline} — concurrent "
+                    f"threads taking opposite orders deadlock"))
+        _ = back
+    return out
+
+
+# ---------------------------------------------------------------------------
+# conf/doc drift (CONF001) + docgen currency (CONF002)
+# ---------------------------------------------------------------------------
+
+# segments may contain hyphens/underscores (shims-provider-override);
+# no trailing-dot capture
+_CONF_KEY_RE = re.compile(
+    r"spark\.rapids\.tpu(?:\.[A-Za-z0-9_-]+)+")
+
+
+def conf_doc_findings(public_keys: Set[str], internal_keys: Set[str],
+                      docs_text: str,
+                      docs_path: str = "docs/configs.md"
+                      ) -> List[Finding]:
+    """CONF001 both directions: every public registry entry documented,
+    every documented key live in the registry."""
+    out = []
+    documented = set(_CONF_KEY_RE.findall(docs_text))
+    for key in sorted(public_keys - documented):
+        out.append(Finding(
+            CONF001, docs_path, 1,
+            f"registered conf {key} is not documented (run "
+            f"tools/docgen.py)"))
+    for key in sorted(documented - public_keys - internal_keys):
+        out.append(Finding(
+            CONF001, docs_path, 1,
+            f"documented conf {key} does not exist in the registry "
+            f"(stale docs — run tools/docgen.py)"))
+    return out
+
+
+def docgen_currency_findings(repo_root: str) -> List[Finding]:
+    """CONF002: committed docgen output must match a fresh render."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from ..config import generate_docs
+    from ..tools.docgen import supported_ops_doc
+    out = []
+    for rel, render in (("docs/configs.md", generate_docs),
+                        ("docs/supported_ops.md", supported_ops_doc)):
+        path = os.path.join(repo_root, rel)
+        try:
+            with open(path) as f:
+                committed = f.read()
+        except OSError:
+            out.append(Finding(CONF002, rel, 1,
+                               "docgen output file is missing (run "
+                               "python -m spark_rapids_tpu.tools.docgen)"))
+            continue
+        if committed.strip() != render().strip():
+            out.append(Finding(
+                CONF002, rel, 1,
+                "committed file differs from a fresh docgen render "
+                "(run python -m spark_rapids_tpu.tools.docgen)"))
+    return out
+
+
+def registry_conf_findings(repo_root: str) -> List[Finding]:
+    """CONF001 against the LIVE registry + committed docs/configs.md."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from .. import config as _config
+    public = {e.key for e in _config._REGISTRY.values() if not e.internal}
+    internal = {e.key for e in _config._REGISTRY.values() if e.internal}
+    docs_path = os.path.join(repo_root, "docs", "configs.md")
+    try:
+        with open(docs_path) as f:
+            text = f.read()
+    except OSError:
+        return [Finding(CONF001, "docs/configs.md", 1,
+                        "docs/configs.md is missing")]
+    return conf_doc_findings(public, internal, text)
+
+
+# ---------------------------------------------------------------------------
+# file / project drivers
+# ---------------------------------------------------------------------------
+
+def _scopes_for(rel: str) -> Set[str]:
+    """Which rule families apply to a repo-relative path."""
+    rel = rel.replace(os.sep, "/")
+    scopes = {HYG001}
+    parts = rel.split("/")
+    if any(p in ("service", "shuffle", "memory") for p in parts):
+        scopes |= {LOCK001, LOCK002}
+    if "kernels" in parts or \
+            os.path.basename(rel).startswith("tpu_"):
+        scopes |= {SYNC001}
+    if "obs" in parts:
+        scopes |= {HYG002}
+    if "exec" in parts:
+        scopes |= {HYG003}
+    return scopes
+
+
+def lint_source(source: str, path: str = "<string>",
+                scopes: Optional[Set[str]] = None,
+                collect_edges: Optional[List] = None) -> List[Finding]:
+    """Lint one source buffer.  ``scopes=None`` runs every per-file
+    rule (the fixture/self-test surface); pass ``_scopes_for(rel)`` for
+    project-scoped behavior.  Same-file lock inversions are reported
+    here; pass ``collect_edges`` to defer cross-file cycle detection to
+    the caller."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(HYG001, path, e.lineno or 1,
+                        f"syntax error: {e.msg}")]
+    if scopes is None:
+        scopes = set(ALL_RULES)
+    findings: List[Finding] = []
+    edges: List[Tuple[str, str, str, int]] = []
+    if LOCK001 in scopes or LOCK002 in scopes:
+        lock_names = _collect_lock_names(tree)
+        la = _FileLockAnalysis(path, tree, lock_names)
+        if LOCK001 in scopes:
+            findings += la.findings
+        if LOCK002 in scopes:
+            edges = [(s, d, path, ln) for s, d, ln in la.edges]
+            if collect_edges is not None:
+                collect_edges.extend(edges)
+            else:
+                findings += lock_order_inversions(edges)
+    if SYNC001 in scopes:
+        check_asarray = os.path.basename(path) not in \
+            _SYNC_NP_FILE_ALLOWLIST
+        findings += _SyncVisitor(path, tree, check_asarray).findings
+    hyg = _HygieneVisitor(
+        path, tree,
+        in_obs=HYG002 in scopes,
+        check_exec_schema=HYG003 in scopes)
+    findings += [f for f in hyg.findings if f.rule in scopes]
+    return _apply_suppressions(findings, _suppressions(source))
+
+
+def lint_paths(paths: List[str],
+               scoped: bool = False,
+               root: Optional[str] = None) -> List[Finding]:
+    """Lint files/directories.  ``scoped=True`` applies each rule only
+    in its project scope (service/shuffle/memory for lock rules, ...);
+    default applies every per-file rule everywhere (fixtures)."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _dirs, names in os.walk(p):
+                files += [os.path.join(dirpath, n)
+                          for n in sorted(names) if n.endswith(".py")]
+        else:
+            files.append(p)
+    findings: List[Finding] = []
+    edges: List[Tuple[str, str, str, int]] = []
+    for path in files:
+        with open(path) as f:
+            src = f.read()
+        rel = os.path.relpath(path, root) if root else path
+        scopes = _scopes_for(rel) if scoped else None
+        findings += lint_source(src, rel, scopes=scopes,
+                                collect_edges=edges)
+    findings += lock_order_inversions(edges)
+    return findings
+
+
+def lint_project(repo_root: str) -> List[Finding]:
+    """The full CI surface: scoped AST rules over ``spark_rapids_tpu/``
+    plus the import-based conf/doc checks."""
+    pkg = os.path.join(repo_root, "spark_rapids_tpu")
+    findings = lint_paths([pkg], scoped=True, root=repo_root)
+    findings += registry_conf_findings(repo_root)
+    findings += docgen_currency_findings(repo_root)
+    return findings
+
+
+def format_findings(findings: List[Finding]) -> str:
+    lines = [str(f) for f in findings]
+    lines.append(f"{len(findings)} finding(s)")
+    return "\n".join(lines)

@@ -1,0 +1,474 @@
+"""Pre-execution invariant verifier for lowered PhysicalPlan trees.
+
+Reference role: the tag-time checks of GpuOverrides (TypeChecks /
+ExecChecks intersecting plan dtypes against TypeSig, RapidsMeta.explain
+recording human-readable reasons) — applied to the CONVERTED tree, after
+every planner rewrite, so stage collapse / AQE wrapping / mesh placement
+cannot silently break the contracts execution assumes.
+
+Four passes, each appending structured ``Violation``s (never raising on
+the first):
+
+SCHEMA   output_schema of every node resolves; expressions attached to a
+         node bind against the child schema they are evaluated over.
+DTYPE    every expression on a TPU exec has a registered rule in
+         ``plan.overrides._EXPR_RULES`` and its dtypes intersect that
+         rule's TypeSig (ExprSig.reasons_for — the same explain-style
+         reasons tagging produces); output schema dtypes are device-
+         representable (TS.WITH_NESTED).
+PART     partitioning/distribution contracts: shuffle partitioner arity,
+         hash partitioners carry keys, shuffled-join inputs agree on
+         partition counts, broadcast builds are single-partition, FINAL
+         aggregates sit over an exchange, PARTIAL aggregates have a
+         FINAL ancestor, mesh execs are their own distribution point.
+CKPT     cancellation-checkpoint coverage: a materializing operator (one
+         that drains unbounded input before emitting) must reach a
+         ``timed``/``cancel_checkpoint`` region itself or via a
+         descendant, so service deadlines/cancellation can unwind it.
+
+Verification is permissive by design: unknown node classes pass, and a
+pass that cannot evaluate a property (e.g. an exotic node without the
+attribute it inspects) records nothing.  Only provable violations fail.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Dict, List, Optional
+
+from ..exec.base import PhysicalPlan
+
+# rule ids (shared format with analysis.lint findings)
+SCHEMA = "PV-SCHEMA"
+DTYPE = "PV-DTYPE"
+PART = "PV-PART"
+CKPT = "PV-CKPT"
+
+
+class Violation:
+    """One failed invariant, anchored to a plan node.
+
+    ``node_index`` is the preorder index (the same numbering
+    ``QueryEventLogger`` uses for node_metrics keys), so reports can
+    join violations onto the printed tree positionally."""
+
+    __slots__ = ("rule", "node_index", "node_name", "message")
+
+    def __init__(self, rule: str, node_index: int, node_name: str,
+                 message: str):
+        self.rule = rule
+        self.node_index = node_index
+        self.node_name = node_name
+        self.message = message
+
+    def __str__(self):
+        return (f"[{self.rule}] node {self.node_index} "
+                f"({self.node_name}): {self.message}")
+
+    def __repr__(self):
+        return f"Violation({self})"
+
+
+class PlanVerificationError(RuntimeError):
+    """Raised when a plan fails verification.  Carries EVERY violation,
+    not just the first — the multi-reason explain discipline."""
+
+    def __init__(self, violations: List[Violation], plan=None):
+        self.violations = list(violations)
+        self.plan = plan
+        lines = [f"plan verification failed "
+                 f"({len(self.violations)} violation(s)):"]
+        lines += [f"  {v}" for v in self.violations]
+        if plan is not None:
+            lines.append("plan:")
+            lines.append(plan.tree_string(
+                annotate=annotator(self.violations)))
+        super().__init__("\n".join(lines))
+
+
+class PlanVerificationReport:
+    """Result of ``verify_plan``: all violations plus per-node lookup."""
+
+    def __init__(self, plan: PhysicalPlan, violations: List[Violation]):
+        self.plan = plan
+        self.violations = list(violations)
+        self.by_node: Dict[int, List[Violation]] = {}
+        for v in self.violations:
+            self.by_node.setdefault(v.node_index, []).append(v)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def raise_if_failed(self):
+        if self.violations:
+            raise PlanVerificationError(self.violations, self.plan)
+
+    def annotated_tree(self) -> str:
+        """The plan tree with a per-node verified/violation annotation
+        (feeds tools/report.py)."""
+        return self.plan.tree_string(annotate=self.annotator())
+
+    def annotator(self):
+        return annotator(self.violations)
+
+
+def annotator(violations: List[Violation]):
+    """An ``annotate`` callable for ``PhysicalPlan.tree_string``:
+    maps preorder index -> ``[ok]`` or ``[!! RULE: msg; ...]``."""
+    by_node: Dict[int, List[Violation]] = {}
+    for v in violations:
+        by_node.setdefault(v.node_index, []).append(v)
+
+    def fn(index: int, node: PhysicalPlan) -> str:
+        vs = by_node.get(index)
+        if not vs:
+            return "[ok]"
+        return "[!! " + "; ".join(
+            f"{v.rule}: {v.message}" for v in vs) + "]"
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# node classification helpers
+# ---------------------------------------------------------------------------
+
+def _preorder(plan: PhysicalPlan):
+    """[(index, node, ancestors)] in the event-log preorder."""
+    out = []
+
+    def walk(node, ancestors):
+        out.append((len(out), node, tuple(ancestors)))
+        for c in node.children:
+            walk(c, ancestors + [node])
+    walk(plan, [])
+    return out
+
+
+def _is_cpu_node(node: PhysicalPlan) -> bool:
+    """CPU fallback operators (pa.Table stream) — dtype supportability
+    on TPU does not apply to them."""
+    return not getattr(node, "columnar", True) or \
+        type(node).__name__.startswith("Cpu")
+
+
+def _expr_children(e) -> list:
+    return list(getattr(e, "children", ()) or ())
+
+
+def _walk_expr(e):
+    yield e
+    for c in _expr_children(e):
+        yield from _walk_expr(c)
+
+
+def _node_expressions(node: PhysicalPlan):
+    """[(expr, child_index_for_binding | None)] attached to ``node``.
+
+    child index None means "do not attempt to bind" (mode-dependent
+    layouts like FINAL aggregates evaluate over buffer layouts, not the
+    textual child schema)."""
+    out = []
+    exprs = getattr(node, "exprs", None)
+    if exprs:
+        out += [(e, 0) for e in exprs]
+    cond = getattr(node, "condition", None)
+    if cond is not None:
+        out.append((cond, 0))
+    orders = getattr(node, "orders", None)
+    if orders:
+        out += [(o.expr, 0) for o in orders]
+    mode = getattr(node, "mode", None)
+    group = getattr(node, "group_exprs", None)
+    if group is not None:
+        # whole-stage fusion (pre_ops) interposes folded project/filter
+        # ops between the child schema and the keys — they no longer
+        # bind against the textual child output
+        bindable = 0 if mode in ("partial", "complete") and \
+            not getattr(node, "pre_ops", None) else None
+        out += [(e, bindable) for e in group]
+        for a in getattr(node, "aggs", ()) or ():
+            out.append((a.func, None))
+    logical = getattr(node, "logical", None)
+    if logical is not None and len(node.children) == 2:
+        for e in getattr(logical, "left_keys", ()) or ():
+            out.append((e, 0))
+        for e in getattr(logical, "right_keys", ()) or ():
+            out.append((e, 1))
+    return out
+
+
+def _child_schema(node: PhysicalPlan, idx: int):
+    try:
+        return node.children[idx].output_schema
+    except Exception:
+        return None     # pass 1 reports the child's own schema failure
+
+
+# ---------------------------------------------------------------------------
+# pass 1: schema propagation
+# ---------------------------------------------------------------------------
+
+def _check_schema(nodes, out: List[Violation]):
+    for i, node, _anc in nodes:
+        try:
+            schema = node.output_schema
+            if schema is None:
+                raise ValueError("output_schema returned None")
+            list(schema)    # force field materialization
+        except NotImplementedError:
+            out.append(Violation(
+                SCHEMA, i, node.name,
+                "output_schema is not implemented"))
+            continue
+        except Exception as e:
+            out.append(Violation(
+                SCHEMA, i, node.name,
+                f"output_schema unresolvable: {e!r}"))
+            continue
+        for expr, child_idx in _node_expressions(node):
+            if child_idx is None or child_idx >= len(node.children):
+                continue
+            src = _child_schema(node, child_idx)
+            if src is None:
+                continue
+            try:
+                expr.bind(src)
+            except KeyError as e:
+                out.append(Violation(
+                    SCHEMA, i, node.name,
+                    f"attribute {e.args[0]!r} in {expr!r} not found in "
+                    f"child {child_idx} schema {list(src.names)}"))
+            except (ValueError, NotImplementedError) as e:
+                out.append(Violation(
+                    SCHEMA, i, node.name,
+                    f"cannot bind {expr!r} against child {child_idx}: "
+                    f"{e}"))
+
+
+# ---------------------------------------------------------------------------
+# pass 2: dtype supportability (TypeSig intersection, explain reasons)
+# ---------------------------------------------------------------------------
+
+def _check_dtypes(nodes, out: List[Violation]):
+    from ..plan import typesig as TS
+    from ..plan.overrides import _EXPR_RULES
+    from ..expr import core as ec
+    for i, node, _anc in nodes:
+        if _is_cpu_node(node):
+            continue
+        try:
+            fields = list(node.output_schema)
+        except Exception:
+            fields = []     # schema pass already reported
+        for f in fields:
+            r = TS.WITH_NESTED.reason(f.dtype, f"{node.name} output "
+                                               f"column '{f.name}'")
+            if r:
+                out.append(Violation(DTYPE, i, node.name, r))
+        seen = set()
+        for root, _bind in _node_expressions(node):
+            for e in _walk_expr(root):
+                if id(e) in seen:
+                    continue
+                seen.add(id(e))
+                rule = _EXPR_RULES.get(type(e))
+                if rule is None:
+                    # unknown-but-registered-superclass lookup mirrors
+                    # tagging; a truly unregistered expression on a TPU
+                    # node would have fallen back at tag time
+                    for cls in type(e).__mro__[1:]:
+                        rule = _EXPR_RULES.get(cls)
+                        if rule is not None:
+                            break
+                if rule is None:
+                    if isinstance(e, ec.Expression):
+                        out.append(Violation(
+                            DTYPE, i, node.name,
+                            f"{type(e).__name__} has no TPU rule "
+                            f"registered (would not have passed "
+                            f"tagging)"))
+                    continue
+                for reason in rule.reasons_for(e):
+                    out.append(Violation(DTYPE, i, node.name, reason))
+
+
+# ---------------------------------------------------------------------------
+# pass 3: partitioning / distribution contracts
+# ---------------------------------------------------------------------------
+
+_EXCHANGE_NAMES = ("TpuShuffleExchange", "TpuCoalescePartitions",
+                   "TpuAQEShuffleRead")
+_MESH_NAMES = ("TpuMeshAggregate", "TpuMeshShuffledJoin", "TpuMeshSort")
+
+
+def _cls_name(node) -> str:
+    return type(node).__name__
+
+
+def _check_partitioning(nodes, out: List[Violation]):
+    for i, node, anc in nodes:
+        cname = _cls_name(node)
+        if cname == "TpuShuffleExchange":
+            part = getattr(node, "partitioner", None)
+            n = getattr(part, "num_partitions", None)
+            if not isinstance(n, int) or n < 1:
+                out.append(Violation(
+                    PART, i, node.name,
+                    f"shuffle partitioner arity must be a positive int, "
+                    f"got {n!r}"))
+            if type(part).__name__ == "HashPartitioner" and \
+                    not getattr(part, "key_exprs", None):
+                out.append(Violation(
+                    PART, i, node.name,
+                    "hash partitioner has no partitioning keys"))
+        elif cname == "TpuShuffledHashJoin" and len(node.children) == 2:
+            try:
+                ln = node.children[0].num_partitions_hint()
+                rn = node.children[1].num_partitions_hint()
+            except Exception:
+                continue
+            if ln != rn:
+                out.append(Violation(
+                    PART, i, node.name,
+                    f"partition-count skew across join inputs: "
+                    f"left={ln} right={rn} (co-partitioning violated)"))
+        elif cname == "TpuBroadcastHashJoin" and len(node.children) == 2:
+            build = node.children[1] if getattr(node, "build_right", True) \
+                else node.children[0]
+            try:
+                bn = build.num_partitions_hint()
+            except Exception:
+                continue
+            if bn != 1:
+                out.append(Violation(
+                    PART, i, node.name,
+                    f"broadcast build side must be single-partition, "
+                    f"got {bn} partitions from {build.name}"))
+        elif cname == "TpuHashAggregate":
+            mode = getattr(node, "mode", None)
+            if mode == "final":
+                child = node.children[0] if node.children else None
+                if child is not None and \
+                        _cls_name(child) not in _EXCHANGE_NAMES:
+                    out.append(Violation(
+                        PART, i, node.name,
+                        f"FINAL aggregate must consume an exchange "
+                        f"(partial buffers need repartitioning by group "
+                        f"key), found {child.name}"))
+            elif mode == "partial":
+                if not any(_cls_name(a) == "TpuHashAggregate" and
+                           getattr(a, "mode", None) == "final"
+                           for a in anc):
+                    out.append(Violation(
+                        PART, i, node.name,
+                        "PARTIAL aggregate without a FINAL ancestor: "
+                        "partial buffers would leak to the consumer"))
+        elif cname in _MESH_NAMES:
+            for c in node.children:
+                if _cls_name(c) == "TpuShuffleExchange":
+                    out.append(Violation(
+                        PART, i, node.name,
+                        f"mesh exec redistributes over ICI collectives "
+                        f"itself; a {c.name} child is a redundant "
+                        f"double shuffle"))
+
+
+# ---------------------------------------------------------------------------
+# pass 4: cancellation-checkpoint coverage
+# ---------------------------------------------------------------------------
+
+#: operators that drain unbounded input before emitting their first
+#: batch — a cancelled/deadline-exceeded service query must be able to
+#: unwind DURING that drain, not only at the root batch hand-off
+_MATERIALIZING = frozenset({
+    "TpuHashAggregate", "TpuSort", "TpuTopN", "TpuShuffledHashJoin",
+    "TpuBroadcastHashJoin", "TpuNestedLoopJoin", "TpuShuffleExchange",
+    "TpuBroadcastExchange", "TpuMeshAggregate", "TpuMeshShuffledJoin",
+    "TpuMeshSort", "TpuWindow", "TpuStagedCompute",
+})
+
+#: materializers whose checkpoint coverage is constructed at execute
+#: time (TpuAdaptiveShuffledJoin builds covered TpuShuffleExchange
+#: nodes internally), invisible to a static tree walk
+_CKPT_ALLOWLIST = frozenset({"TpuAdaptiveShuffledJoin"})
+
+_CKPT_MARKERS = ("timed(", "cancel_checkpoint")
+_covered_cache: Dict[type, bool] = {}
+
+
+def _class_covered(cls: type) -> bool:
+    """True when ``cls`` (or a base below PhysicalPlan) references a
+    ``timed`` region or ``cancel_checkpoint`` anywhere in its source —
+    the static stand-in for "this operator's execute path enters a
+    cooperative cancellation checkpoint"."""
+    hit = _covered_cache.get(cls)
+    if hit is not None:
+        return hit
+    covered = False
+    for base in cls.__mro__:
+        if base is PhysicalPlan or base is object:
+            break
+        try:
+            src = inspect.getsource(base)
+        except (OSError, TypeError):
+            covered = True      # unknown source: stay permissive
+            break
+        if any(m in src for m in _CKPT_MARKERS):
+            covered = True
+            break
+    _covered_cache[cls] = covered
+    return covered
+
+
+def _check_checkpoints(nodes, out: List[Violation]):
+    covered_nodes = {id(node) for _i, node, _anc in nodes
+                     if _class_covered(type(node))}
+    for i, node, _anc in nodes:
+        cname = _cls_name(node)
+        if cname not in _MATERIALIZING or cname in _CKPT_ALLOWLIST:
+            continue
+        if id(node) in covered_nodes:
+            continue
+        if any(id(d) in covered_nodes
+               for d in node.collect_nodes()[1:]):
+            continue    # a descendant checkpoints every pulled batch
+        out.append(Violation(
+            CKPT, i, node.name,
+            "materializing operator has no cancellation checkpoint in "
+            "its execute path (and none below it): a service "
+            "cancel/deadline could not unwind its input drain"))
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def verify_plan(plan: PhysicalPlan,
+                passes: Optional[List[str]] = None
+                ) -> PlanVerificationReport:
+    """Run the verifier passes over ``plan``; never raises.
+
+    ``passes`` optionally restricts to a subset of
+    {SCHEMA, DTYPE, PART, CKPT}."""
+    nodes = _preorder(plan)
+    run = set(passes) if passes is not None else \
+        {SCHEMA, DTYPE, PART, CKPT}
+    violations: List[Violation] = []
+    if SCHEMA in run:
+        _check_schema(nodes, violations)
+    if DTYPE in run:
+        _check_dtypes(nodes, violations)
+    if PART in run:
+        _check_partitioning(nodes, violations)
+    if CKPT in run:
+        _check_checkpoints(nodes, violations)
+    return PlanVerificationReport(plan, violations)
+
+
+def verify_or_raise(plan: PhysicalPlan,
+                    passes: Optional[List[str]] = None
+                    ) -> PlanVerificationReport:
+    """verify_plan + raise PlanVerificationError listing ALL failures."""
+    report = verify_plan(plan, passes)
+    report.raise_if_failed()
+    return report

@@ -89,6 +89,15 @@ EXPLAIN = conf_str(
     "spark.rapids.tpu.sql.explain", "NONE",
     "NONE/NOT_ON_TPU/ALL: log why operators did or didn't go to the TPU "
     "(reference: spark.rapids.sql.explain)")
+PLAN_VERIFY = conf_bool(
+    "spark.rapids.tpu.sql.planVerify", False,
+    "Run the static plan-invariant verifier on every physical plan "
+    "before execution: schema propagation, dtype supportability, "
+    "partitioning/distribution contracts, and cancellation-checkpoint "
+    "coverage.  Violations raise PlanVerificationError listing every "
+    "failure with an annotated plan tree.  Forced on under pytest; "
+    "default OFF in production to keep planning latency flat "
+    "(reference: the tagging/validation passes of GpuOverrides)")
 BATCH_SIZE_ROWS = conf_int(
     "spark.rapids.tpu.sql.batchSizeRows", 1 << 20,
     "Target rows per columnar batch (coalesce goal; reference: "

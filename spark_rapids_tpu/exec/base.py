@@ -183,12 +183,37 @@ class PhysicalPlan:
             return self.children[0].num_partitions_hint()
         return 1
 
-    def tree_string(self, indent: int = 0) -> str:
-        pad = "  " * indent
-        s = f"{pad}{self._node_string()}"
-        for c in self.children:
-            s += "\n" + c.tree_string(indent + 1)
-        return s
+    def tree_string(self, indent: int = 0, annotate=None) -> str:
+        """Indented tree rendering (one node per line, preorder — the
+        order node_metrics keys are emitted in, so consumers join
+        positionally).
+
+        ``annotate``: optional ``(preorder_index, node) -> str``; a
+        non-empty result is appended after the node label (the plan
+        verifier's verified/violation markers ride here).  Annotations
+        never change line order or leading indentation, so positional
+        consumers (tools/report.py) keep working."""
+        if annotate is None:
+            pad = "  " * indent
+            s = f"{pad}{self._node_string()}"
+            for c in self.children:
+                s += "\n" + c.tree_string(indent + 1)
+            return s
+        lines: List[str] = []
+        counter = [0]
+
+        def walk(node, depth):
+            idx = counter[0]
+            counter[0] += 1
+            line = f"{'  ' * (indent + depth)}{node._node_string()}"
+            tag = annotate(idx, node)
+            if tag:
+                line += f"  {tag}"
+            lines.append(line)
+            for c in node.children:
+                walk(c, depth + 1)
+        walk(self, 0)
+        return "\n".join(lines)
 
     def _node_string(self):
         return self.name

@@ -223,8 +223,16 @@ class TpuBroadcastExchange(TpuExec):
 
     def broadcast_batch(self) -> ColumnarBatch:
         from ..columnar.batch import resolve_speculative
+        from ..service.cancellation import cancel_checkpoint
         if self._result is None:
-            raw = [b for p in self.children[0].execute() for b in p]
+            # the build side materializes in full before the first probe
+            # batch: checkpoint per pulled batch so cancellation can
+            # unwind the drain
+            raw = []
+            for p in self.children[0].execute():
+                for b in p:
+                    cancel_checkpoint()
+                    raw.append(b)
             if len(raw) == 1:
                 # single-batch build side (the dominant dimension-table
                 # shape): pass through WITHOUT forcing the host count —

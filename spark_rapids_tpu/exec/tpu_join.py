@@ -722,9 +722,17 @@ class TpuNestedLoopJoin(TpuExec):
         return self.logical.schema
 
     def execute(self):
+        from ..service.cancellation import cancel_checkpoint
         lparts = self.children[0].execute()
         rparts = self.children[1].execute()
-        right_batches = [b for p in rparts for b in p]
+        # the whole right side materializes before the first output
+        # batch: checkpoint per pulled batch so service cancellation
+        # can unwind the drain
+        right_batches = []
+        for p in rparts:
+            for b in p:
+                cancel_checkpoint()
+                right_batches.append(b)
         if self.logical.join_type in ("right", "full"):
             # unmatched-right emission must observe EVERY left row, so
             # the left side collapses to one partition
@@ -761,7 +769,9 @@ class TpuNestedLoopJoin(TpuExec):
                                  [c.mask_validity(m) for c in out.columns],
                                  n)
 
+        from ..service.cancellation import cancel_checkpoint
         for lb in left_iter:
+            cancel_checkpoint()
             n_l = lb.num_rows
             total = n_l * n_r
             if total == 0:

@@ -18,6 +18,7 @@ from ..expr import core as ec
 from ..kernels import canon
 from ..kernels.sort import sort_permutation
 from ..plan.logical import SortOrder
+from ..service.cancellation import cancel_checkpoint
 from .base import PhysicalPlan, SORT_TIME, NUM_OUTPUT_ROWS, timed
 from .tpu_basic import TpuExec
 
@@ -319,8 +320,14 @@ class TpuTopN(TpuExec):
         parts = self.children[0].execute()
 
         def run():
+            # TopN drains its entire input before emitting: checkpoint
+            # per pulled batch so a cancelled/deadline-exceeded service
+            # query unwinds mid-drain, not after it
             if len(parts) == 1:
-                batches = [b for b in parts[0]]
+                batches = []
+                for b in parts[0]:
+                    cancel_checkpoint()
+                    batches.append(b)
                 if len(batches) == 1 and not (
                         isinstance(batches[0].rows_lazy, int) and
                         batches[0].num_rows == 0):
@@ -343,6 +350,7 @@ class TpuTopN(TpuExec):
                 parts[0] = iter(batches)      # replay consumed batches
             tops = []
             for p in parts:
+                cancel_checkpoint()
                 batches = [resolve_speculative(b) for b in p]
                 batches = [b for b in batches if b.num_rows > 0]
                 if not batches:

@@ -17,6 +17,7 @@ Pipeline:
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Type
 
 from ..columnar import dtypes as T
@@ -491,6 +492,11 @@ class Planner:
         self._mark_deferred_verify(phys, parent=None)
         if self.conf.get(TEST_ENABLED):
             self._assert_all_tpu(phys)
+        from ..config import PLAN_VERIFY
+        if self.conf.get(PLAN_VERIFY) or os.environ.get(
+                "SPARK_RAPIDS_TPU_FORCE_PLAN_VERIFY"):
+            from ..analysis.plan_verify import verify_or_raise
+            verify_or_raise(phys)
         return phys
 
     # -- deferred-verification marking ------------------------------------

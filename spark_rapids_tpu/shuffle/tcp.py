@@ -68,6 +68,10 @@ def _send_frame(sock: socket.socket, lock: threading.Lock, ftype: int,
                 *parts: bytes):
     body = b"".join(parts)
     with lock:
+        # lint: allow(LOCK001): per-socket write serialization IS the
+        # framing protocol — interleaved sendalls would corrupt the
+        # frame stream, and socket backpressure here is the in-flight
+        # flow control under the bounce-buffer window bound.
         sock.sendall(_HDR.pack(len(body), ftype) + body)
 
 
@@ -231,19 +235,43 @@ class TcpClientConnection(ClientConnection):
             = {}
         self._data_handlers: List[Callable] = []
         self._lock = threading.Lock()
+        # dedicated dial mutex: connection establishment is single-
+        # flight but must NOT hold _lock — _on_frame/_on_close take
+        # _lock to resolve pending transactions, so a slow/unreachable
+        # peer dialing under _lock would park response dispatch (and
+        # every requester) behind a 10s connect timeout
+        self._dial_lock = threading.Lock()
 
     # -- wire ----------------------------------------------------------------
     def _ensure_socket(self) -> _Socket:
         with self._lock:
-            if self._sock is None:
-                raw = socket.create_connection(self.address, timeout=10)
-                raw.settimeout(None)
-                raw.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._sock = _Socket(raw, self._on_frame, self._on_close,
-                                     f"tcp-client-{self.peer_executor_id}")
-                self._sock.send(
-                    HELLO, _pack_str(self.transport.executor_id))
-            return self._sock
+            if self._sock is not None:
+                return self._sock
+        with self._dial_lock:
+            with self._lock:
+                if self._sock is not None:
+                    return self._sock   # lost the dial race to a peer
+            # lint: allow(LOCK001): _dial_lock is a dedicated single-
+            # flight dial mutex; nothing else contends on it and the
+            # state lock is NOT held across the blocking connect.
+            raw = socket.create_connection(self.address, timeout=10)
+            raw.settimeout(None)
+            raw.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s = _Socket(raw, self._on_frame, self._on_close,
+                        f"tcp-client-{self.peer_executor_id}")
+            # HELLO goes out before the socket is published, so no
+            # request frame can beat it onto the wire
+            s.send(HELLO, _pack_str(self.transport.executor_id))
+            with self._lock:
+                self._sock = s
+            if not s.thread.is_alive():
+                # reader died before publication (peer closed on us);
+                # _on_close's identity check missed it — drop it so the
+                # next call re-dials instead of reusing a dead socket
+                with self._lock:
+                    if self._sock is s:
+                        self._sock = None
+            return s
 
     def _on_frame(self, _s: _Socket, ftype: int, body: memoryview):
         if ftype == DATA:
@@ -271,7 +299,8 @@ class TcpClientConnection(ClientConnection):
         with self._lock:
             pending = list(self._pending.values())
             self._pending.clear()
-            self._sock = None
+            if self._sock is _s:    # a racing re-dial may have replaced it
+                self._sock = None
         for _handler, tx in pending:
             tx.complete_error(
                 f"connection to {self.peer_executor_id} closed")

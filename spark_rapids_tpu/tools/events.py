@@ -28,6 +28,28 @@ from typing import Dict, List, Optional
 _LOCK = threading.Lock()
 
 
+def _plan_verify_record(phys_plan, conf_dict: Dict) -> Optional[Dict]:
+    """Structured verifier verdicts for the event log — {"ok", "violations":
+    [{"node_index", "rule", "message"}]} — so tools/report.py can annotate
+    the recorded plan tree per node.  Only when the verifier is enabled
+    (conf or the test-harness force env); never fails the log path."""
+    on = os.environ.get("SPARK_RAPIDS_TPU_FORCE_PLAN_VERIFY") or \
+        str(conf_dict.get("spark.rapids.tpu.sql.planVerify", "")
+            ).strip().lower() in ("true", "1", "yes")
+    if not on:
+        return None
+    try:
+        from ..analysis.plan_verify import verify_plan
+        rep = verify_plan(phys_plan)
+        return {"ok": rep.ok,
+                "violations": [{"node_index": v.node_index,
+                                "rule": v.rule,
+                                "message": v.message}
+                               for v in rep.violations]}
+    except Exception:
+        return None
+
+
 def _env_bytes(name: str) -> Optional[int]:
     raw = os.environ.get(name)
     if not raw:
@@ -97,6 +119,9 @@ class QueryEventLogger:
                 for i, n in enumerate(phys_plan.collect_nodes())},
             "conf": {k: v for k, v in conf_dict.items()},
         }
+        verdicts = _plan_verify_record(phys_plan, conf_dict)
+        if verdicts is not None:
+            record["plan_verify"] = verdicts
         if extra:
             record.update(extra)
         self._append(record)

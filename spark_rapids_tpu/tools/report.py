@@ -68,14 +68,27 @@ def plan_time_shares(record: Dict) -> List[Dict]:
         nodes.append((depth, ln.strip()))
     metrics = record.get("node_metrics", {})
     keys = list(metrics.keys())
+    # per-node verifier verdicts (analysis/plan_verify via the event
+    # logger): node_index keys the same preorder the tree prints
+    pv = record.get("plan_verify")
+    by_node: Dict[int, List[str]] = {}
+    if pv:
+        for v in pv.get("violations", []):
+            by_node.setdefault(int(v["node_index"]), []).append(
+                f"{v['rule']}: {v['message']}")
     rows = []
     for i, (depth, label) in enumerate(nodes):
         m = metrics.get(keys[i], {}) if i < len(keys) else {}
         t_ns = sum(v for k, v in m.items()
                    if k.endswith("Time") or k.endswith("time"))
+        verify = None
+        if pv:
+            verify = "[!! " + "; ".join(by_node[i]) + "]" \
+                if i in by_node else "[ok]"
         rows.append({"depth": depth, "label": label,
                      "time_ms": t_ns / 1e6,
-                     "rows": m.get("numOutputRows")})
+                     "rows": m.get("numOutputRows"),
+                     "verify": verify})
     total = sum(r["time_ms"] for r in rows)
     for r in rows:
         r["share"] = (r["time_ms"] / total) if total else 0.0
@@ -89,8 +102,11 @@ def _format_plan(rows: List[Dict]) -> List[str]:
         annot = f"{r['share'] * 100:5.1f}% {r['time_ms']:9.2f}ms"
         if r.get("rows") is not None:
             annot += f"  rows={r['rows']}"
-        out.append(f"  {annot:<44s} {bar:<20s} "
-                   f"{'  ' * r['depth']}{r['label']}")
+        line = (f"  {annot:<44s} {bar:<20s} "
+                f"{'  ' * r['depth']}{r['label']}")
+        if r.get("verify"):
+            line += f"  {r['verify']}"
+        out.append(line)
     return out
 
 

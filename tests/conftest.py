@@ -13,6 +13,11 @@ import os
 # down (see columnar/column.py MIN_CAPACITY).
 os.environ.setdefault("SPARK_RAPIDS_TPU_MIN_CAPACITY", "16")
 
+# Force the static plan-invariant verifier on for every plan the suite
+# lowers, regardless of per-test conf: every tier-1 query plan doubles
+# as a verifier regression fixture (spark.rapids.tpu.sql.planVerify).
+os.environ.setdefault("SPARK_RAPIDS_TPU_FORCE_PLAN_VERIFY", "1")
+
 # The image's sitecustomize registers the axon TPU backend and forces
 # JAX_PLATFORMS=axon in every interpreter, so the env var alone is not
 # enough — override through the config API after import, before any

@@ -1,0 +1,25 @@
+"""Seeded HYG001/HYG002/HYG003 fixture — ``ci/lint.py`` must exit
+NONZERO.
+
+A bare except, a wall-clock timestamp where monotonic is required, and
+an exec-node class that defines ``execute`` without an ``output_schema``
+override.  Never imported by the engine (``TpuExec``/``risky`` are
+deliberately unresolved — lint is AST-only).
+"""
+import time
+
+
+class BadExec(TpuExec):  # noqa: F821
+    def execute(self):
+        return []
+
+
+def swallow():
+    try:
+        risky()  # noqa: F821
+    except:
+        return None
+
+
+def stamp():
+    return time.time()

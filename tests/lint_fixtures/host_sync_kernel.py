@@ -1,0 +1,15 @@
+"""Seeded SYNC001 fixture — ``ci/lint.py`` must exit NONZERO.
+
+Every banned host-synchronization shape in one device-hot-path-shaped
+buffer: an explicit barrier, a device pull, and a numpy materialization.
+Never imported by the engine.
+"""
+import jax
+import numpy as np
+
+
+def bad_kernel(x):
+    jax.block_until_ready(x)
+    host = jax.device_get(x)
+    arr = np.asarray(x)
+    return host, arr

@@ -1,0 +1,30 @@
+"""Seeded LOCK001/LOCK002 fixture — ``ci/lint.py`` must exit NONZERO.
+
+Two module locks acquired in opposite orders from two call paths (the
+classic AB/BA deadlock), plus a sleep and socket write performed while
+holding a lock.  Never imported by the engine; exists only so the lint
+self-tests can prove the analyzer fires.
+"""
+import threading
+import time
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def forward():
+    with lock_a:
+        with lock_b:
+            return 1
+
+
+def backward():
+    with lock_b:
+        with lock_a:
+            return 2
+
+
+def blocking_under_lock(sock):
+    with lock_a:
+        time.sleep(0.1)
+        sock.sendall(b"x")

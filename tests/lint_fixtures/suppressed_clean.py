@@ -1,0 +1,26 @@
+"""Suppression fixture — ``ci/lint.py`` must exit ZERO here.
+
+The same violation shapes as the seeded-bad fixtures, each carrying a
+justified ``# lint: allow(<RULE>)`` suppression: a comment-only allow
+(covers the next source line, justification may span comment lines) and
+a trailing allow (covers its own line).
+"""
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def heartbeat():
+    with _lock:
+        # lint: allow(LOCK001): fixture — demonstrates a justified
+        # comment-only suppression spanning multiple justification
+        # lines; the sleep under this uncontended lock is intentional
+        time.sleep(0.01)
+
+
+def swallow():
+    try:
+        return 1
+    except:  # lint: allow(HYG001): fixture — trailing-allow form
+        return None

@@ -1,0 +1,455 @@
+"""Static verification layer tests: plan invariant verifier + lint.
+
+Verifier half: hand-built malformed plans — schema mismatch, unsupported
+dtype, FINAL aggregate without an exchange, partition-count skew, missing
+cancellation checkpoint — assert each pass fires, violations aggregate
+(never first-failure-only), and the annotated tree renders per-node
+verdicts.  Lint half: self-tests over seeded bad-code buffers and the
+committed fixture files, plus the shipped-tree-is-clean assertion that
+doubles as the docgen-currency gate.
+"""
+import importlib.util
+import os
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.analysis import (PlanVerificationError, verify_plan,
+                                       verify_or_raise)
+from spark_rapids_tpu.analysis import lint as AL
+from spark_rapids_tpu.analysis.plan_verify import (CKPT, DTYPE, PART,
+                                                   SCHEMA)
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar.schema import Field, Schema
+from spark_rapids_tpu.exec.base import PhysicalPlan
+from spark_rapids_tpu.exec.exchange import (TpuCoalescePartitions,
+                                            TpuShuffleExchange)
+from spark_rapids_tpu.exec.tpu_aggregate import TpuHashAggregate
+from spark_rapids_tpu.exec.tpu_basic import TpuLocalScan, TpuProject
+from spark_rapids_tpu.exec.tpu_join import TpuShuffledHashJoin
+from spark_rapids_tpu.expr import core as ec
+from spark_rapids_tpu.shuffle.partitioners import HashPartitioner
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+
+# ---------------------------------------------------------------------------
+# plan-building helpers
+# ---------------------------------------------------------------------------
+
+def _table(n=8):
+    return pa.table({"a": pa.array(range(n), pa.int64()),
+                     "b": pa.array([float(i) for i in range(n)],
+                                   pa.float64())})
+
+
+def _scan(parts=1):
+    return TpuLocalScan(_table(), num_partitions=parts)
+
+
+def _attr(name, dt=T.INT64):
+    return ec.AttributeReference(name, dt)
+
+
+class _UnsupportedBinary(T.DType):
+    name = "binary"
+
+
+class _BinaryLeaf(PhysicalPlan):
+    """Leaf whose output schema carries a dtype no TypeSig admits."""
+
+    @property
+    def output_schema(self):
+        return Schema([Field("x", _UnsupportedBinary(), True)])
+
+    def execute(self):
+        return [iter([])]
+
+
+class _JoinLogical:
+    """Minimal stand-in for a logical Join feeding TpuShuffledHashJoin."""
+
+    join_type = "inner"
+
+    def __init__(self, schema, left_keys, right_keys):
+        self.schema = schema
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+
+
+def _shuffled_join(left_n, right_n):
+    ls, rs = _scan(), _scan()
+    lex = TpuShuffleExchange(ls, HashPartitioner([_attr("a")], left_n))
+    rex = TpuShuffleExchange(rs, HashPartitioner([_attr("a")], right_n))
+    schema = Schema(list(ls.output_schema) + list(rs.output_schema))
+    return TpuShuffledHashJoin(
+        _JoinLogical(schema, [_attr("a")], [_attr("a")]),
+        lex, rex, build_right=True)
+
+
+# ---------------------------------------------------------------------------
+# verifier: good plans pass
+# ---------------------------------------------------------------------------
+
+class TestVerifierGoodPlans:
+    def test_project_over_scan(self):
+        plan = TpuProject([_attr("a"), _attr("b", T.FLOAT64)], _scan())
+        assert verify_plan(plan).ok
+
+    def test_final_agg_over_exchange(self):
+        agg = TpuHashAggregate([_attr("a")], [],
+                               TpuCoalescePartitions(_scan()),
+                               mode="final")
+        report = verify_plan(agg, passes=[PART])
+        assert report.ok
+
+    def test_shuffled_join_copartitioned(self):
+        plan = _shuffled_join(4, 4)
+        report = verify_plan(plan, passes=[SCHEMA, PART])
+        assert report.ok, report.violations
+
+    def test_verify_or_raise_returns_report(self):
+        plan = TpuProject([_attr("a")], _scan())
+        report = verify_or_raise(plan)
+        assert report.ok and report.plan is plan
+
+
+# ---------------------------------------------------------------------------
+# verifier: each malformed-plan fixture trips its pass
+# ---------------------------------------------------------------------------
+
+class TestVerifierMalformedPlans:
+    def test_schema_mismatch_unbound_attribute(self):
+        # projection references a column the child never produces
+        plan = TpuProject([_attr("zzz")], _scan())
+        report = verify_plan(plan)
+        assert not report.ok
+        vs = [v for v in report.violations if v.rule == SCHEMA]
+        assert vs and "zzz" in vs[0].message
+        assert vs[0].node_index == 0    # anchored to the projection
+
+    def test_schema_unresolvable_output(self):
+        # untyped attribute: the projection cannot even render its own
+        # output schema
+        plan = TpuProject([ec.AttributeReference("zzz")], _scan())
+        report = verify_plan(plan)
+        assert any(v.rule == SCHEMA and "unresolvable" in v.message
+                   for v in report.violations)
+
+    def test_unsupported_dtype(self):
+        report = verify_plan(TpuProject([_attr("x", _UnsupportedBinary())],
+                                        _BinaryLeaf()))
+        vs = [v for v in report.violations if v.rule == DTYPE]
+        assert vs and any("binary" in v.message for v in vs)
+
+    def test_final_aggregate_missing_exchange(self):
+        agg = TpuHashAggregate([_attr("a")], [], _scan(), mode="final")
+        report = verify_plan(agg, passes=[PART])
+        assert [v.rule for v in report.violations] == [PART]
+        assert "exchange" in report.violations[0].message
+
+    def test_partial_aggregate_without_final_ancestor(self):
+        agg = TpuHashAggregate([_attr("a")], [], _scan(), mode="partial")
+        report = verify_plan(agg, passes=[PART])
+        assert any("FINAL ancestor" in v.message
+                   for v in report.violations)
+
+    def test_partition_count_skew(self):
+        plan = _shuffled_join(4, 2)
+        report = verify_plan(plan, passes=[PART])
+        assert len(report.violations) == 1
+        v = report.violations[0]
+        assert v.rule == PART and "left=4 right=2" in v.message
+
+    def test_shuffle_arity_and_empty_keys(self):
+        ex = TpuShuffleExchange(_scan(), HashPartitioner([], 0))
+        report = verify_plan(ex, passes=[PART])
+        msgs = "\n".join(v.message for v in report.violations)
+        assert "positive int" in msgs and "no partitioning keys" in msgs
+
+    def test_missing_cancellation_checkpoint(self):
+        # locally defined materializer: its source has no timed/
+        # cancel_checkpoint region and nothing below it checkpoints
+        class TpuSort(PhysicalPlan):   # name places it in _MATERIALIZING
+            @property
+            def output_schema(self):
+                return self.children[0].output_schema
+
+            def execute(self):
+                return [iter(sorted([]))]
+
+        class _PlainLeaf(PhysicalPlan):
+            @property
+            def output_schema(self):
+                return Schema([Field("a", T.INT64, True)])
+
+            def execute(self):
+                return [iter([])]
+
+        report = verify_plan(TpuSort(_PlainLeaf()), passes=[CKPT])
+        assert [v.rule for v in report.violations] == [CKPT]
+
+    def test_real_sort_is_checkpoint_covered(self):
+        from spark_rapids_tpu.exec.tpu_sort import TpuSort
+        from spark_rapids_tpu.plan.logical import SortOrder
+        plan = TpuSort([SortOrder(_attr("a"), True)], _scan())
+        assert verify_plan(plan, passes=[CKPT]).ok
+
+    def test_multi_violation_error_lists_everything(self):
+        # skewed join whose projection also references a ghost column:
+        # one raise carries BOTH failures plus the annotated tree
+        plan = TpuProject([_attr("ghost")], _shuffled_join(4, 2))
+        with pytest.raises(PlanVerificationError) as ei:
+            verify_or_raise(plan)
+        err = ei.value
+        rules = {v.rule for v in err.violations}
+        assert {SCHEMA, PART} <= rules
+        text = str(err)
+        assert "ghost" in text and "left=4 right=2" in text
+        assert "[!!" in text and "[ok]" in text   # annotated tree
+
+
+# ---------------------------------------------------------------------------
+# annotated tree plumbing (satellite: tree_string annotation mode)
+# ---------------------------------------------------------------------------
+
+class TestAnnotatedTree:
+    def test_default_tree_string_unchanged(self):
+        plan = TpuProject([_attr("a")], _scan())
+        assert plan.tree_string() == plan.tree_string(annotate=None)
+        assert "[ok]" not in plan.tree_string()
+
+    def test_annotations_append_per_node(self):
+        plan = TpuProject([_attr("ghost")], _scan())
+        report = verify_plan(plan)
+        tree = report.annotated_tree()
+        lines = tree.splitlines()
+        assert len(lines) == 2
+        assert "[!!" in lines[0] and "ghost" in lines[0]
+        assert lines[1].rstrip().endswith("[ok]")
+        # indentation (the positional join key of tools/report.py) is
+        # untouched by annotations
+        plain = plan.tree_string().splitlines()
+        for got, want in zip(lines, plain):
+            assert got.startswith(want)
+
+    def test_report_renders_verify_column(self):
+        from spark_rapids_tpu.tools.report import plan_time_shares
+        plan = TpuProject([_attr("ghost")], _scan())
+        rep = verify_plan(plan)
+        record = {
+            "physical_plan": plan.tree_string(),
+            "node_metrics": {},
+            "plan_verify": {
+                "ok": rep.ok,
+                "violations": [{"node_index": v.node_index,
+                                "rule": v.rule,
+                                "message": v.message}
+                               for v in rep.violations]},
+        }
+        rows = plan_time_shares(record)
+        assert rows[0]["verify"].startswith("[!!")
+        assert rows[1]["verify"] == "[ok]"
+
+
+# ---------------------------------------------------------------------------
+# lint self-tests (seeded bad-code buffers)
+# ---------------------------------------------------------------------------
+
+class TestLint:
+    def test_lock_inversion_detected(self):
+        src = (
+            "import threading\n"
+            "a = threading.Lock()\n"
+            "b = threading.Lock()\n"
+            "def f():\n"
+            "    with a:\n"
+            "        with b:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with b:\n"
+            "        with a:\n"
+            "            pass\n")
+        rules = {f.rule for f in AL.lint_source(src, "x.py")}
+        assert AL.LOCK002 in rules
+
+    def test_blocking_call_under_lock(self):
+        src = (
+            "import threading, time\n"
+            "_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        time.sleep(1)\n")
+        fs = AL.lint_source(src, "x.py")
+        assert any(f.rule == AL.LOCK001 and "sleep" in f.message
+                   for f in fs)
+
+    def test_condition_wait_not_flagged(self):
+        src = (
+            "import threading\n"
+            "_cv = threading.Condition()\n"
+            "def f():\n"
+            "    with _cv:\n"
+            "        _cv.wait()\n")
+        assert AL.lint_source(src, "x.py") == []
+
+    def test_nested_function_not_attributed_to_lock(self):
+        src = (
+            "import threading, time\n"
+            "_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        def later():\n"
+            "            time.sleep(1)\n"
+            "        return later\n")
+        assert AL.lint_source(src, "x.py") == []
+
+    def test_host_sync_in_kernel_scope(self):
+        src = ("import jax, numpy as np\n"
+               "def k(x):\n"
+               "    jax.device_get(x)\n"
+               "    np.asarray(x)\n")
+        fs = AL.lint_source(src, "kernels/bad.py",
+                            scopes={AL.SYNC001})
+        assert len(fs) == 2 and all(f.rule == AL.SYNC001 for f in fs)
+
+    def test_sync_allowlist_exempts_asarray_only(self):
+        src = ("import jax, numpy as np\n"
+               "def k(x):\n"
+               "    jax.device_get(x)\n"
+               "    np.asarray(x)\n")
+        fs = AL.lint_source(src, "exec/tpu_sort.py",
+                            scopes={AL.SYNC001})
+        assert [f.rule for f in fs] == [AL.SYNC001]
+        assert "device_get" in fs[0].message
+
+    def test_undocumented_conf(self):
+        fs = AL.conf_doc_findings(
+            {"spark.rapids.tpu.sql.enabled",
+             "spark.rapids.tpu.brand.new.key"},
+            set(),
+            "only `spark.rapids.tpu.sql.enabled` is documented")
+        assert len(fs) == 1
+        assert fs[0].rule == AL.CONF001
+        assert "brand.new.key" in fs[0].message
+
+    def test_stale_documented_conf(self):
+        fs = AL.conf_doc_findings(
+            {"spark.rapids.tpu.sql.enabled"}, set(),
+            "`spark.rapids.tpu.sql.enabled` and "
+            "`spark.rapids.tpu.gone.key`")
+        assert len(fs) == 1 and "gone.key" in fs[0].message
+
+    def test_internal_confs_tolerated_in_docs(self):
+        fs = AL.conf_doc_findings(
+            {"spark.rapids.tpu.sql.enabled"},
+            {"spark.rapids.tpu.internal.knob"},
+            "`spark.rapids.tpu.sql.enabled` "
+            "`spark.rapids.tpu.internal.knob`")
+        assert fs == []
+
+    def test_hygiene_rules(self):
+        src = ("import time\n"
+               "class BadExec(TpuExec):\n"
+               "    def execute(self):\n"
+               "        try:\n"
+               "            return time.time()\n"
+               "        except:\n"
+               "            return None\n")
+        rules = sorted(f.rule for f in AL.lint_source(src, "x.py"))
+        assert rules == [AL.HYG001, AL.HYG002, AL.HYG003]
+
+    def test_exec_schema_via_same_file_base(self):
+        src = ("class Base(TpuExec):\n"
+               "    @property\n"
+               "    def output_schema(self):\n"
+               "        return None\n"
+               "class Child(Base):\n"
+               "    def execute(self):\n"
+               "        return []\n")
+        assert AL.lint_source(src, "x.py", scopes={AL.HYG003}) == []
+
+    def test_cross_file_base_stays_permissive(self):
+        src = ("class Child(SomewhereElse):\n"
+               "    def execute(self):\n"
+               "        return []\n")
+        assert AL.lint_source(src, "x.py", scopes={AL.HYG003}) == []
+
+    def test_suppression_trailing_and_comment_only(self):
+        src = (
+            "import threading, time\n"
+            "_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        # lint: allow(LOCK001): intentional pacing\n"
+            "        time.sleep(1)\n"
+            "def g():\n"
+            "    try:\n"
+            "        return 1\n"
+            "    except:  # lint: allow(HYG001): fixture\n"
+            "        return None\n")
+        assert AL.lint_source(src, "x.py") == []
+
+    def test_suppression_is_rule_specific(self):
+        src = (
+            "import threading, time\n"
+            "_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        # lint: allow(HYG001): wrong rule id\n"
+            "        time.sleep(1)\n")
+        assert any(f.rule == AL.LOCK001
+                   for f in AL.lint_source(src, "x.py"))
+
+    def test_syntax_error_is_reported_not_raised(self):
+        fs = AL.lint_source("def f(:\n", "x.py")
+        assert len(fs) == 1 and "syntax error" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# CLI + project surface
+# ---------------------------------------------------------------------------
+
+def _cli():
+    spec = importlib.util.spec_from_file_location(
+        "ci_lint", os.path.join(REPO_ROOT, "ci", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCliAndProject:
+    @pytest.mark.parametrize("fixture", [
+        "lock_inversion.py", "host_sync_kernel.py", "bad_hygiene.py"])
+    def test_cli_nonzero_on_each_seeded_fixture(self, fixture, capsys):
+        assert _cli().main([os.path.join(FIXTURES, fixture)]) == 1
+        out = capsys.readouterr().out
+        assert "finding(s)" in out
+
+    def test_cli_zero_on_suppressed_fixture(self, capsys):
+        path = os.path.join(FIXTURES, "suppressed_clean.py")
+        assert _cli().main([path]) == 0
+
+    def test_shipped_tree_lints_clean(self):
+        # the full CI gate: scoped AST rules + conf/doc drift + docgen
+        # currency.  A failure here means a true finding shipped or
+        # docs/*.md were not regenerated after a registry change.
+        findings = AL.lint_project(REPO_ROOT)
+        assert findings == [], AL.format_findings(findings)
+
+    def test_planner_hook_invokes_verifier(self, monkeypatch):
+        import spark_rapids_tpu.analysis.plan_verify as pv
+        from spark_rapids_tpu.api import TpuSession
+        from spark_rapids_tpu.config import TpuConf
+        calls = []
+        real = pv.verify_or_raise
+        monkeypatch.setattr(
+            pv, "verify_or_raise",
+            lambda plan, passes=None: calls.append(plan) or
+            real(plan, passes))
+        s = TpuSession(TpuConf({"spark.rapids.tpu.sql.planVerify": True}))
+        df = s.create_dataframe(_table())
+        df.collect()
+        assert calls, "Planner.plan never reached the verifier hook"
