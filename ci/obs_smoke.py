@@ -1,7 +1,10 @@
 """CI smoke for the observability subsystem: run a traced query through
 the service, then assert (1) the Chrome trace JSON parses and carries
 nested engine/exec spans, (2) the Prometheus snapshot covers the arena
-and semaphore series, (3) the report tool renders the per-query story.
+and semaphore series, (3) the report tool renders the per-query story,
+(4) a forced query failure produces a diagnostic bundle — flight tail,
+thread stacks, arena map — that tools/diagnose.py renders, and the
+failure event-log record links it.
 """
 import json
 import os
@@ -21,21 +24,44 @@ def main():
     td = tempfile.mkdtemp(prefix="obs_smoke_")
     trace_path = os.path.join(td, "trace.json")
     log_path = os.path.join(td, "events.jsonl")
+    diag_dir = os.path.join(td, "diag")
     s = TpuSession(TpuConf({
         "spark.rapids.tpu.eventLog.path": log_path,
         "spark.rapids.tpu.obs.trace.enabled": True,
         "spark.rapids.tpu.obs.trace.path": trace_path,
+        "spark.rapids.tpu.obs.diagnostics.dir": diag_dir,
+        "spark.rapids.tpu.service.retry.maxAttempts": 2,
+        "spark.rapids.tpu.service.retry.initialBackoffMs": 5,
     }))
     df = s.create_dataframe(
         {"k": [i % 7 for i in range(2000)],
          "v": [float(i) for i in range(2000)]})
     s.register_table("obs_smoke", df)
+    from spark_rapids_tpu.columnar import dtypes as T
+    from spark_rapids_tpu.udf import pandas_udf
+
+    def _doomed(series):
+        raise RuntimeError("RESOURCE_EXHAUSTED: obs_smoke forced OOM")
+    doomed = pandas_udf(_doomed, return_type=T.INT64)
+    failing = s.range(0, 64, num_partitions=2) \
+        .select(doomed(F.col("id")).alias("id"))
+
     with QueryService(s, num_workers=2) as svc:
         for _ in range(3):
             svc.submit(
                 "SELECT k, SUM(v), COUNT(v) FROM obs_smoke GROUP BY k"
             ).result(120)
+        # one forced failure: every retry attempt OOMs
+        h_fail = svc.submit(failing, tenant="doomed")
+        try:
+            h_fail.result(120)
+            raise AssertionError("forced-failure query succeeded")
+        except RuntimeError:
+            pass
         metrics = svc.metrics_text()
+        snap = svc.stats().snapshot()
+        assert snap["flight_recorder"]["events_recorded"] > 0, snap
+        assert snap["watchdog"]["enabled"], snap
 
     # 1. trace JSON parses and has the span hierarchy
     doc = json.load(open(trace_path))
@@ -47,7 +73,7 @@ def main():
     assert "query" in names and "attempt" in names, names
     qids = {e["args"].get("query_id") for e in events
             if e["name"] == "attempt"}
-    assert len(qids) == 3, qids
+    assert len(qids) == 4, qids       # 3 healthy + the forced failure
     print(f"trace OK: {len(events)} spans, cats={sorted(cats)}")
 
     # 2. Prometheus exposition covers arena + semaphore + queue series
@@ -66,6 +92,26 @@ def main():
     html = open(os.path.join(td, "report.html")).read()
     assert "plan + time shares" in html
     print("report OK")
+
+    # 4. the forced failure produced one diagnostic bundle with the
+    #    flight tail + thread stacks + arena map, linked from the event
+    #    log, and diagnose renders it
+    from spark_rapids_tpu.tools.diagnose import main as diagnose_main
+    from spark_rapids_tpu.tools.events import read_event_log
+    bundles = sorted(os.path.join(diag_dir, n)
+                     for n in os.listdir(diag_dir)
+                     if n.startswith("diag-") and n.endswith(".json"))
+    assert len(bundles) == 1, bundles
+    bundle = json.load(open(bundles[0]))
+    assert bundle["trigger"] == "oom", bundle["trigger"]
+    assert bundle["flight"]["query_events"], "empty flight tail"
+    assert bundle["threads"], "no thread stacks"
+    assert "stats" in bundle["arena"], bundle["arena"]
+    failed = [r for r in read_event_log(log_path, events="failed")
+              if r["query_id"] == h_fail.query_id]
+    assert failed and failed[0]["diag_bundle"] == bundles[0], failed
+    assert diagnose_main([bundles[0], "--no-stacks"]) == 0
+    print("diagnostics OK:", os.path.basename(bundles[0]))
     print("obs smoke: OK")
     return 0
 

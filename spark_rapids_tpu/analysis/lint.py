@@ -27,6 +27,13 @@ HYG002      ``time.time()`` in ``obs/`` timing paths where
 HYG003      exec-node class defining ``execute`` without an
             ``output_schema`` override (same-file inheritance resolved;
             cross-file bases are skipped, stay permissive)
+OBS002      flight-recorder ``record()`` call in the device hot path
+            (``kernels/``, ``exec/tpu_*``) with an allocating argument
+            (f-string, ``%``/``str.format``/concat formatting, dict/
+            list/tuple/set literal or comprehension): the recorder is
+            always-on, so its hot-path call sites must pass interned
+            constants and plain ints only (lazy formatting belongs in
+            the reader, obs/diagnostics + tools/diagnose)
 ==========  =============================================================
 
 Suppressions: a finding whose source line (or the line directly above)
@@ -66,9 +73,10 @@ CONF002 = "CONF002"
 HYG001 = "HYG001"
 HYG002 = "HYG002"
 HYG003 = "HYG003"
+OBS002 = "OBS002"
 
 ALL_RULES = (LOCK001, LOCK002, SYNC001, CONF001, CONF002,
-             HYG001, HYG002, HYG003)
+             HYG001, HYG002, HYG003, OBS002)
 
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([A-Z0-9, ]+)\)")
 
@@ -324,6 +332,74 @@ class _SyncVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+#: receiver names under which the flight recorder is imported at call
+#: sites (``from ..obs import flight [as _flight]``)
+_FLIGHT_ALIASES = {"flight", "_flight"}
+
+
+class _ObsRecordVisitor(ast.NodeVisitor):
+    """OBS002: allocating arguments to flight-recorder ``record()``
+    calls in the device hot path.  The recorder is always-on, so each
+    call site in ``kernels/`` / ``exec/tpu_*`` must cost a few slot
+    writes — an f-string, ``%``/``str.format``/``str()`` formatting, or
+    a container literal at the call site allocates on every record even
+    when nobody ever reads the event."""
+
+    def __init__(self, path: str, tree: ast.AST):
+        self.path = path
+        self.findings: List[Finding] = []
+        self.visit(tree)
+
+    @staticmethod
+    def _is_record_call(node: ast.Call) -> bool:
+        f = node.func
+        return (isinstance(f, ast.Attribute) and f.attr == "record" and
+                isinstance(f.value, ast.Name) and
+                f.value.id in _FLIGHT_ALIASES)
+
+    @staticmethod
+    def _allocating(arg: ast.AST) -> Optional[str]:
+        """Why ``arg`` allocates per call, or None if it is cheap."""
+        for n in ast.walk(arg):
+            if isinstance(n, ast.JoinedStr):
+                return "f-string"
+            if isinstance(n, (ast.Dict, ast.List, ast.Tuple, ast.Set)):
+                return "container literal"
+            if isinstance(n, (ast.DictComp, ast.ListComp, ast.SetComp,
+                              ast.GeneratorExp)):
+                return "comprehension"
+            if isinstance(n, ast.Call):
+                cf = n.func
+                if isinstance(cf, ast.Attribute) and cf.attr in (
+                        "format", "join"):
+                    return f"str.{cf.attr}()"
+                if isinstance(cf, ast.Name) and cf.id in ("str", "repr",
+                                                          "format"):
+                    return f"{cf.id}()"
+            if isinstance(n, ast.BinOp) and \
+                    isinstance(n.op, (ast.Mod, ast.Add)) and (
+                    isinstance(n.left, ast.Constant) and
+                    isinstance(n.left.value, str) or
+                    isinstance(n.right, ast.Constant) and
+                    isinstance(n.right.value, str)):
+                return "string formatting/concat"
+        return None
+
+    def visit_Call(self, node: ast.Call):
+        if self._is_record_call(node):
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                why = self._allocating(arg)
+                if why:
+                    self.findings.append(Finding(
+                        OBS002, self.path, node.lineno,
+                        f"flight-recorder record() in the device hot "
+                        f"path with an allocating argument ({why}): "
+                        f"pass interned constants and plain ints; "
+                        f"format lazily in the reader"))
+                    break
+        self.generic_visit(node)
+
+
 class _HygieneVisitor(ast.NodeVisitor):
     """HYG001 bare except; HYG002 time.time in obs/; HYG003 exec nodes
     missing output_schema (same-file inheritance only)."""
@@ -546,7 +622,7 @@ def _scopes_for(rel: str) -> Set[str]:
         scopes |= {LOCK001, LOCK002}
     if "kernels" in parts or \
             os.path.basename(rel).startswith("tpu_"):
-        scopes |= {SYNC001}
+        scopes |= {SYNC001, OBS002}
     if "obs" in parts:
         scopes |= {HYG002}
     if "exec" in parts:
@@ -586,6 +662,8 @@ def lint_source(source: str, path: str = "<string>",
         check_asarray = os.path.basename(path) not in \
             _SYNC_NP_FILE_ALLOWLIST
         findings += _SyncVisitor(path, tree, check_asarray).findings
+    if OBS002 in scopes:
+        findings += _ObsRecordVisitor(path, tree).findings
     hyg = _HygieneVisitor(
         path, tree,
         in_obs=HYG002 in scopes,

@@ -79,6 +79,8 @@ class TpuSession:
         set_active(self.conf)
         _enable_compilation_cache()
         _obs_trace.configure(self.conf)
+        from ..obs import flight as _obs_flight
+        _obs_flight.configure(self.conf)
         with TpuSession._active_lock:
             # device (re)init mutates process-wide state (catalog,
             # semaphore); serialize concurrent session construction

@@ -420,6 +420,49 @@ SERVICE_RETRY_BATCH_DECAY = conf_float(
     "Each retry scales the query's batch-size goals (batchSizeRows/"
     "Bytes, reader batch rows) by this factor so a memory-pressured "
     "query re-runs at a smaller device footprint")
+OBS_FLIGHT_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.flightRecorder.enabled", True,
+    "Always-on flight recorder: every engine thread keeps a bounded "
+    "ring of compact structured events (span boundaries, retries, "
+    "spill/unspill, semaphore, shuffle fetch, admission transitions) "
+    "recorded unconditionally with no allocation or locking on the hot "
+    "path; the recent tail lands in failure diagnostic bundles even "
+    "with tracing disabled (the airplane-black-box counterpart to "
+    "obs.trace.*)")
+OBS_FLIGHT_CAPACITY = conf_int(
+    "spark.rapids.tpu.obs.flightRecorder.capacityPerThread", 512,
+    "Event slots preallocated per thread ring; past it the recorder "
+    "overwrites oldest (fixed memory, recent history only).  Applies "
+    "to rings created after the change")
+OBS_WATCHDOG_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.watchdog.enabled", True,
+    "Service stall watchdog: a daemon that flags RUNNING queries whose "
+    "worker thread records no flight-recorder events for "
+    "watchdog.stallSeconds while holding an inflight slot (and "
+    "typically the device semaphore), then captures thread stacks, the "
+    "arena map, shuffle state and queue depths into a diagnostic "
+    "bundle and logs a 'watchdog' service event (once per query)")
+OBS_WATCHDOG_INTERVAL_MS = conf_int(
+    "spark.rapids.tpu.obs.watchdog.intervalMs", 1000,
+    "Watchdog poll period; each poll reads one per-thread event-count "
+    "map — nothing on any query hot path")
+OBS_WATCHDOG_STALL_S = conf_int(
+    "spark.rapids.tpu.obs.watchdog.stallSeconds", 120,
+    "A RUNNING query with no flight-recorder progress for this long is "
+    "declared stalled and triggers the watchdog")
+OBS_DIAG_DIR = conf_str(
+    "spark.rapids.tpu.obs.diagnostics.dir", "",
+    "Directory for automatic failure diagnostic bundles: on query "
+    "failure, device OOM, deadline expiry, cancellation, or watchdog "
+    "trigger the service writes one JSON bundle (flight-recorder tail, "
+    "all thread stacks, metrics snapshot, arena map, plan tree with "
+    "verifier verdicts, conf dump with secrets redacted) named "
+    "diag-<utc>-<query_id>-<trigger>.json; render with tools/"
+    "diagnose.py.  Empty disables bundle capture")
+OBS_DIAG_MAX_BUNDLES = conf_int(
+    "spark.rapids.tpu.obs.diagnostics.maxBundles", 20,
+    "Rotation bound on the diagnostics dir: after each write the "
+    "oldest diag-*.json beyond this many are deleted")
 
 
 class TpuConf:

@@ -15,6 +15,7 @@ import time
 from typing import Dict, Iterator, List
 
 from ..columnar.schema import Schema
+from ..obs import flight as _flight
 from ..obs import trace as _trace
 from ..service.cancellation import cancel_checkpoint
 
@@ -128,6 +129,12 @@ class timed:
 
     def __enter__(self):
         cancel_checkpoint()
+        # flight recorder shares this operator boundary (always-on;
+        # interned node/metric name only, so the record is
+        # allocation-free)
+        _flight.record(_flight.EV_BEGIN,
+                       self.node.name if self.node is not None
+                       else self.metric.name)
         if _trace._ENABLED:
             self._span = _trace.Span(
                 self.node.name if self.node is not None
@@ -141,6 +148,9 @@ class timed:
 
     def __exit__(self, *a):
         self.metric.add(time.perf_counter_ns() - self.t0)
+        _flight.record(_flight.EV_END,
+                       self.node.name if self.node is not None
+                       else self.metric.name)
         if self._span is not None:
             self._span.__exit__(*a)
         return False

@@ -25,6 +25,7 @@ import jax
 from ..config import (TpuConf, get_active, HBM_POOL_FRACTION, HBM_RESERVE,
                       CONCURRENT_TPU_TASKS, HOST_SPILL_LIMIT, SPILL_DIR,
                       SHUFFLE_COMPRESS)
+from ..obs import flight as _flight
 from ..obs import trace as _trace
 from ..obs.registry import SEM_WAIT_SECONDS
 from ..service.cancellation import cancel_checkpoint
@@ -51,6 +52,33 @@ class DeviceSemaphore:
         self._sem = threading.Semaphore(permits)
         self._held = threading.local()
         self._wait = threading.local()
+        # thread idents currently holding a permit — read by the stall
+        # watchdog/diagnostics to tell "stalled while holding the
+        # device" from "stalled in line"; updated only on the 0<->1
+        # hold transitions, never on re-entrant bumps
+        self._holders = set()
+        self._holders_lock = threading.Lock()
+
+    def _note_acquired(self, waited_ns: int = 0):
+        ident = threading.get_ident()
+        with self._holders_lock:
+            self._holders.add(ident)
+        _flight.record(_flight.EV_SEM_ACQUIRE, "device", a=waited_ns)
+
+    def _note_released(self):
+        ident = threading.get_ident()
+        with self._holders_lock:
+            self._holders.discard(ident)
+        _flight.record(_flight.EV_SEM_RELEASE, "device")
+
+    def holder_idents(self):
+        """Thread idents currently holding a permit (snapshot)."""
+        with self._holders_lock:
+            return list(self._holders)
+
+    def available(self) -> int:
+        """Permits not currently held (approximate, for diagnostics)."""
+        return self._sem._value
 
     def acquire_if_necessary(self, deadline: Optional[float] = None):
         """Acquire one permit for this thread (re-entrant per thread).
@@ -60,8 +88,11 @@ class DeviceSemaphore:
         CancelToken is checked every poll, so cancellation unwinds a
         queued task promptly."""
         if getattr(self._held, "count", 0) == 0:
-            if not self._sem.acquire(blocking=False):
+            if self._sem.acquire(blocking=False):
+                self._note_acquired()
+            else:
                 t0 = time.perf_counter_ns()
+                acquired = False
                 try:
                     while True:
                         cancel_checkpoint()
@@ -70,11 +101,14 @@ class DeviceSemaphore:
                             raise TimeoutError(
                                 "DeviceSemaphore acquire deadline exceeded")
                         if self._sem.acquire(timeout=_ACQUIRE_POLL_S):
+                            acquired = True
                             break
                 finally:
                     waited = time.perf_counter_ns() - t0
                     self._wait.ns = getattr(self._wait, "ns", 0) + waited
                     self._observe_wait(t0, waited)
+                    if acquired:
+                        self._note_acquired(waited)
         self._held.count = getattr(self._held, "count", 0) + 1
 
     def try_acquire(self, timeout: float = 0.0,
@@ -88,11 +122,13 @@ class DeviceSemaphore:
         if deadline is not None:
             limit = min(limit, deadline)
         t0 = time.perf_counter_ns()
+        acquired = False
         try:
             while True:
                 step = min(_ACQUIRE_POLL_S, limit - time.monotonic())
                 if self._sem.acquire(timeout=max(step, 0)):
                     self._held.count = 1
+                    acquired = True
                     return True
                 if time.monotonic() >= limit:
                     return False
@@ -100,6 +136,8 @@ class DeviceSemaphore:
             waited = time.perf_counter_ns() - t0
             self._wait.ns = getattr(self._wait, "ns", 0) + waited
             self._observe_wait(t0, waited)
+            if acquired:
+                self._note_acquired(waited)
 
     @staticmethod
     def _observe_wait(t0_ns: int, waited_ns: int):
@@ -117,6 +155,7 @@ class DeviceSemaphore:
             self._held.count = count - 1
             if self._held.count == 0:
                 self._sem.release()
+                self._note_released()
 
     def release_all(self) -> int:
         """Drop every permit level this THREAD holds (task-completion /
@@ -126,6 +165,7 @@ class DeviceSemaphore:
         if count > 0:
             self._held.count = 0
             self._sem.release()
+            self._note_released()
         return count
 
     def held_count(self) -> int:

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import flight as _flight
 from ..obs import trace as _trace
 
 
@@ -352,6 +353,7 @@ class BufferCatalog:
             self._meta_fetcher(metas, read_bytes)
 
     def _spill_entry_to_host(self, e: BufferEntry):
+        _flight.record(_flight.EV_SPILL, "device_to_host", a=e.nbytes)
         with _trace.span("spill_device_to_host", "memory", bytes=e.nbytes):
             payload = self._serialize(e.device_obj)
             if self.arena is not None:
@@ -400,6 +402,7 @@ class BufferCatalog:
         return (schema, num_rows, kinds, bufs), (off, total)
 
     def _spill_entry_to_disk(self, e: BufferEntry):
+        _flight.record(_flight.EV_SPILL, "host_to_disk", a=e.nbytes)
         with _trace.span("spill_host_to_disk", "memory", bytes=e.nbytes):
             self._spill_entry_to_disk_inner(e)
 
@@ -434,6 +437,7 @@ class BufferCatalog:
 
     def _unspill_host(self, e: BufferEntry):
         from .pressure import oom_retry
+        _flight.record(_flight.EV_UNSPILL, "host_to_device", a=e.nbytes)
         with _trace.span("unspill_host_to_device", "memory",
                          bytes=e.nbytes):
             payload, _ = self._unpack_payload(e.host_payload)
@@ -452,6 +456,7 @@ class BufferCatalog:
         return obj
 
     def _unspill_disk(self, e: BufferEntry):
+        _flight.record(_flight.EV_UNSPILL, "disk_to_host", a=e.nbytes)
         with _trace.span("unspill_disk_to_host", "memory", bytes=e.nbytes):
             self._unspill_disk_inner(e)
         return self._unspill_host(e)

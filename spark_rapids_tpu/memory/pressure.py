@@ -17,6 +17,8 @@ from __future__ import annotations
 
 from typing import Callable
 
+from ..obs import flight as _flight
+
 # markers PJRT uses for allocation failure across backends
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory",
                 "Failed to allocate")
@@ -45,6 +47,10 @@ def oom_retry(fn: Callable, *args, **kwargs):
         if not is_device_oom(e):
             raise
         cat = BufferCatalog.get()
+        # black-box breadcrumb: the OOM instant with the live device
+        # bytes at failure (the bundle's flight tail shows what led in)
+        _flight.record(_flight.EV_OOM, "device_alloc",
+                       a=cat.device_bytes, b=cat.device_limit)
         # recomputable device residents go first: the scan cache is
         # pure optimization, never correctness
         from ..io.scan_cache import DeviceScanCache, clear_on_pressure

@@ -1,20 +1,32 @@
-"""Observability subsystem: span tracing + process-wide metrics.
+"""Observability subsystem: span tracing, process-wide metrics, and
+the always-on flight recorder with automatic failure diagnostics.
 
-Three layers, mirroring the reference plugin's observability story
-(SURVEY.md §tools):
+Layers, mirroring the reference plugin's observability story
+(SURVEY.md §tools) plus the black-box additions:
 
 - ``obs.trace``   — hierarchical span tracer (the NvtxRange role):
   thread-local nested spans with query_id attribution, exported as
   Chrome trace-event JSON loadable in Perfetto/chrome://tracing.
+  Opt-in (near-zero cost disabled).
 - ``obs.registry``— process-wide metrics registry (counters, gauges,
   fixed-bucket histograms): arena bytes, semaphore/queue waits, spill
   bytes, compile-cache hits, shuffle bytes.
 - ``obs.prom``    — Prometheus text-format exposition over the registry
   (``QueryService.metrics_text()`` / scrape handler).
+- ``obs.flight``  — always-on flight recorder: per-thread bounded rings
+  of compact structured events recorded unconditionally (preallocated
+  slots, no allocation/locking on the hot path, overwrite-oldest) at
+  the same boundaries the tracer instruments.
+- ``obs.watchdog``— service stall watchdog: flags RUNNING queries with
+  no flight-recorder progress and captures the evidence.
+- ``obs.diagnostics`` — one-JSON-file incident bundles (flight tail,
+  thread stacks, metrics, arena map, plan verdicts, redacted conf)
+  written automatically on failure/OOM/deadline/watchdog; rendered by
+  ``tools/diagnose.py``.
 
 The per-query report generator that joins the event log with these
 streams lives in ``tools/report.py`` (the SQL-UI stand-in).
 """
-from . import trace, registry, prom  # noqa: F401
+from . import trace, registry, prom, flight  # noqa: F401
 from .registry import get_registry  # noqa: F401
 from .trace import span, traced     # noqa: F401

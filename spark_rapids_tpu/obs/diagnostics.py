@@ -1,0 +1,304 @@
+"""Automatic failure-diagnostic bundles.
+
+When a query dies — failure, device OOM, deadline expiry,
+cancellation, or a stall-watchdog trigger — the service calls
+``write_bundle()`` and one self-contained JSON artifact lands in the
+conf'd directory (``spark.rapids.tpu.obs.diagnostics.dir``):
+
+- the flight-recorder tail (obs/flight.py): the query's own events
+  plus the recent merged tail of every thread, captured with tracing
+  fully disabled;
+- every thread's Python stack at capture time;
+- the metrics-registry snapshot (obs/registry.py);
+- the arena live/peak/spill map down to per-buffer tier/bytes/priority
+  and device-semaphore holders;
+- shuffle client/server state and service queue depths;
+- the physical plan tree with per-node verifier verdicts;
+- the conf dump with secret-looking values redacted.
+
+The directory rotates (oldest ``diag-*.json`` beyond
+``…diagnostics.maxBundles`` deleted) so an incident loop cannot fill
+the disk.  ``tools/diagnose.py`` renders a bundle human-readable.
+
+Capture never raises into the failing query's unwind path: every
+section is best-effort and records its own error string instead.
+"""
+from __future__ import annotations
+
+import datetime
+import io
+import json
+import os
+import re
+import sys
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+from . import flight as _flight
+
+#: conf keys whose values never belong in an artifact that gets
+#: attached to tickets and mailed around
+_REDACT_RE = re.compile(
+    r"secret|password|passwd|token|credential|apikey|api[._-]key|auth",
+    re.IGNORECASE)
+
+#: minimum flight-recorder events preserved per bundle (acceptance
+#: floor: the last 64 events for the failing query when available)
+FLIGHT_TAIL_EVENTS = 256
+
+
+def thread_stacks() -> List[Dict[str, Any]]:
+    """Every live thread's Python stack (sys._current_frames)."""
+    frames = sys._current_frames()
+    by_ident = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        t = by_ident.get(ident)
+        stack = traceback.format_stack(frame)
+        out.append({
+            "ident": ident,
+            "name": t.name if t else "<unknown>",
+            "daemon": bool(t.daemon) if t else None,
+            "stack": [line.rstrip("\n") for line in stack],
+        })
+    out.sort(key=lambda d: d["name"])
+    return out
+
+
+def arena_map() -> Dict[str, Any]:
+    """Arena live/peak/spill map: catalog totals, per-buffer entries,
+    and device-semaphore state."""
+    out: Dict[str, Any] = {}
+    try:
+        from ..memory.catalog import BufferCatalog
+        cat = BufferCatalog.get()
+        out["stats"] = dict(cat.stats())
+        entries = []
+        with cat._lock:
+            for e in cat._entries.values():
+                entries.append({
+                    "buffer_id": e.buffer_id,
+                    "tier": getattr(e.tier, "name", str(e.tier)),
+                    "nbytes": e.nbytes,
+                    "priority": e.priority,
+                })
+        entries.sort(key=lambda d: (-d["nbytes"], d["buffer_id"]))
+        out["entries"] = entries
+    except Exception as exc:
+        out["error"] = repr(exc)
+    try:
+        from ..memory.arena import DeviceManager
+        dm = DeviceManager._instance
+        if dm is not None:
+            sem = dm.semaphore
+            out["semaphore"] = {
+                "permits": getattr(sem, "permits", None),
+                "available": sem.available(),
+                "holders": sorted(sem.holder_idents()),
+            }
+    except Exception as exc:
+        out["semaphore_error"] = repr(exc)
+    return out
+
+
+def shuffle_state() -> Dict[str, Any]:
+    """In-process shuffle manager occupancy (blocks/bytes) — the
+    client/server side state that matters for a stalled fetch."""
+    out: Dict[str, Any] = {}
+    try:
+        from ..shuffle.manager import ShuffleManager
+        mgr = ShuffleManager._instance
+        if mgr is None:
+            return {"active": False}
+        with mgr.catalog._lock:
+            blocks = len(mgr.catalog._store)
+        out.update({
+            "active": True,
+            "blocks": blocks,
+            "buffered_bytes": mgr.catalog.nbytes(),
+            "next_shuffle_id": mgr._next_shuffle,
+        })
+    except Exception as exc:
+        out["error"] = repr(exc)
+    try:
+        from ..shuffle.inprocess import EndpointRegistry
+        reg = EndpointRegistry._instance
+        if reg is not None:
+            out["endpoints"] = len(getattr(reg, "_endpoints", {}))
+    except Exception as exc:
+        out["endpoints_error"] = repr(exc)
+    return out
+
+
+def redacted_conf(conf) -> Dict[str, Any]:
+    """The conf's explicit settings with secret-looking values masked."""
+    try:
+        settings = dict(getattr(conf, "_settings", {}) or {})
+    except Exception:
+        return {}
+    return {k: ("***" if _REDACT_RE.search(str(k)) else v)
+            for k, v in sorted(settings.items())}
+
+
+def _plan_section(phys) -> Dict[str, Any]:
+    """Plan tree with per-node verifier verdicts."""
+    out: Dict[str, Any] = {}
+    try:
+        out["tree"] = phys.tree_string()
+    except Exception as exc:
+        return {"error": repr(exc)}
+    try:
+        from ..analysis.plan_verify import verify_plan
+        rep = verify_plan(phys)
+        out["verify"] = {
+            "ok": rep.ok,
+            "violations": [{"node_index": v.node_index,
+                            "rule": v.rule,
+                            "message": v.message}
+                           for v in rep.violations]}
+    except Exception as exc:
+        out["verify_error"] = repr(exc)
+    return out
+
+
+def collect_bundle(trigger: str,
+                   query_id: Optional[str] = None,
+                   error: Optional[BaseException] = None,
+                   handle=None,
+                   service=None,
+                   conf=None) -> Dict[str, Any]:
+    """Assemble one diagnostic bundle dict.  Every section is
+    best-effort; a section that fails records its own error instead of
+    propagating into the caller's unwind path."""
+    if query_id is None and handle is not None:
+        query_id = getattr(handle, "query_id", None)
+    bundle: Dict[str, Any] = {
+        "version": 1,
+        "trigger": trigger,
+        "captured_at": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+        "query_id": query_id,
+    }
+    if error is not None:
+        bundle["error"] = {
+            "type": type(error).__name__,
+            "message": str(error),
+            "traceback": traceback.format_exception(
+                type(error), error, error.__traceback__),
+        }
+    try:
+        bundle["flight"] = {
+            "occupancy": _flight.occupancy(),
+            "query_events": _flight.snapshot(query_id=query_id)
+            if query_id else [],
+            "recent_events": _flight.snapshot(last=FLIGHT_TAIL_EVENTS),
+        }
+    except Exception as exc:
+        bundle["flight"] = {"error": repr(exc)}
+    try:
+        bundle["threads"] = thread_stacks()
+    except Exception as exc:
+        bundle["threads"] = [{"error": repr(exc)}]
+    try:
+        from .registry import MetricsRegistry
+        bundle["metrics"] = MetricsRegistry.get().snapshot()
+    except Exception as exc:
+        bundle["metrics"] = {"error": repr(exc)}
+    bundle["arena"] = arena_map()
+    bundle["shuffle"] = shuffle_state()
+    if service is not None:
+        try:
+            bundle["service"] = service.snapshot()
+        except Exception as exc:
+            bundle["service"] = {"error": repr(exc)}
+    if handle is not None:
+        try:
+            bundle["query"] = {
+                "status": getattr(handle, "status", None),
+                "tenant": getattr(handle, "tenant", None),
+                "attempts": getattr(
+                    getattr(handle, "metrics", None), "attempts", None),
+                "record": handle.metrics.to_record()
+                if getattr(handle, "metrics", None) is not None else None,
+            }
+        except Exception as exc:
+            bundle["query"] = {"error": repr(exc)}
+        phys = getattr(handle, "_last_phys", None)
+        if phys is not None:
+            bundle["plan"] = _plan_section(phys)
+        tok = getattr(handle, "token", None)
+        if tok is not None:
+            try:
+                bundle["cancel"] = {
+                    "cancelled": bool(tok.cancelled),
+                    "reason": getattr(tok, "reason", None),
+                    "observed": dict(getattr(tok, "observed", {}) or {}),
+                }
+            except Exception as exc:
+                bundle["cancel"] = {"error": repr(exc)}
+    if conf is None and handle is not None:
+        conf = getattr(handle, "conf", None)
+    if conf is None:
+        try:
+            from ..config import get_active
+            conf = get_active()
+        except Exception:
+            conf = None
+    if conf is not None:
+        bundle["conf"] = redacted_conf(conf)
+    return bundle
+
+
+def _rotate(directory: str, max_bundles: int) -> List[str]:
+    """Delete oldest ``diag-*.json`` beyond ``max_bundles`` (by name —
+    the UTC timestamp prefix makes lexical order chronological).
+    Returns the deleted paths."""
+    if max_bundles <= 0:
+        return []
+    try:
+        names = sorted(n for n in os.listdir(directory)
+                       if n.startswith("diag-") and n.endswith(".json"))
+    except OSError:
+        return []
+    deleted = []
+    for n in names[:-max_bundles] if len(names) > max_bundles else []:
+        p = os.path.join(directory, n)
+        try:
+            os.remove(p)
+            deleted.append(p)
+        except OSError:
+            pass
+    return deleted
+
+
+def write_bundle(bundle: Dict[str, Any], directory: str,
+                 max_bundles: int = 20) -> str:
+    """Serialize one bundle into ``directory`` and rotate.  Filename:
+    ``diag-<utc-compact>-<query_id>-<trigger>.json``."""
+    os.makedirs(directory, exist_ok=True)
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%S.%f")
+    qid = re.sub(r"[^A-Za-z0-9._-]", "_",
+                 str(bundle.get("query_id") or "noquery"))
+    trig = re.sub(r"[^A-Za-z0-9._-]", "_",
+                  str(bundle.get("trigger") or "unknown"))
+    path = os.path.join(directory, f"diag-{ts}-{qid}-{trig}.json")
+    tmp = path + ".tmp"
+    with io.open(tmp, "w", encoding="utf-8") as f:
+        json.dump(bundle, f, indent=1, default=repr)
+        f.write("\n")
+    os.replace(tmp, path)
+    _rotate(directory, max_bundles)
+    return path
+
+
+def capture(trigger: str, directory: str, max_bundles: int = 20,
+            **kwargs) -> Optional[str]:
+    """collect + write, returning the bundle path; never raises (the
+    caller is a failing query's unwind path)."""
+    try:
+        bundle = collect_bundle(trigger, **kwargs)
+        return write_bundle(bundle, directory, max_bundles)
+    except Exception:
+        return None

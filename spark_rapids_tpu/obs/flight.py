@@ -1,0 +1,225 @@
+"""Always-on flight recorder — the airplane-black-box counterpart to
+the opt-in span tracer (obs/trace.py).
+
+Every engine thread owns a bounded ring of preallocated event slots and
+records compact structured events (span begin/end, kernel entry, retry,
+spill/unspill, semaphore acquire/release, shuffle fetch, admission
+transitions) *unconditionally*: when a production query OOMs, deadlocks
+on the semaphore, or blows its deadline with tracing disabled, the
+recent past is still in memory and lands in the diagnostic bundle
+(obs/diagnostics.py) without a repro.
+
+Overhead contract (the reason this can stay always-on):
+
+- **no allocation on the hot path** — slots are preallocated lists and
+  ``record()`` only mutates them in place; event names must be
+  constant/interned strings (lint rule OBS002 polices the kernels/ and
+  ``exec/tpu_*`` call sites: no f-strings or dict literals);
+- **no locking on the hot path** — each ring has exactly one writer
+  (its owning thread); the registry lock is taken once per thread
+  lifetime, at ring creation;
+- **overwrite-oldest semantics** — a ring past capacity wraps, so the
+  recorder holds the recent tail forever at fixed memory.
+
+``snapshot()`` merges every thread's tail and time-orders it on the
+shared ``time.perf_counter_ns`` clock.  Readers are lock-free with
+respect to writers: a slot being overwritten concurrently can surface
+one torn (mixed-field) event per ring per snapshot — acceptable for a
+post-mortem artifact, and impossible once the writer thread is parked
+(the watchdog/diagnostics case).
+
+Stdlib-only; imported by the service, exec, memory, shuffle and
+kernels layers.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..service.cancellation import current_token
+
+# -- event kinds (interned constants: assigning them allocates nothing) ----
+EV_BEGIN = "begin"            # span/operator region entered (a=depth hint)
+EV_END = "end"                # span/operator region left
+EV_KERNEL = "kernel"          # kernel entry (@traced fast path)
+EV_KERNEL_END = "kernel_end"
+EV_RETRY = "retry"            # query retry (name=reason, a=attempt)
+EV_SPILL = "spill"            # tier move down (name=edge, a=bytes)
+EV_UNSPILL = "unspill"        # tier move up (name=edge, a=bytes)
+EV_SEM_ACQUIRE = "sem_acquire"  # device semaphore granted (a=waited_ns)
+EV_SEM_RELEASE = "sem_release"  # device semaphore released (a=permits)
+EV_SHUFFLE = "shuffle"        # shuffle fetch/transfer progress (a=bytes)
+EV_STATE = "state"            # service admission transition (name=state)
+EV_OOM = "oom"                # device allocation failure observed
+EV_WATCHDOG = "watchdog"      # stall watchdog fired (name=query_id)
+
+#: module fast-path flag — read directly by ``record()``; the recorder
+#: is ON by default (that is the point of a flight recorder).
+_ENABLED = True
+
+#: slots preallocated per new ring (confed via ``configure``; applies
+#: to rings created after the change)
+_CAPACITY = 512
+
+_TLS = threading.local()
+_REG_LOCK = threading.Lock()
+_RINGS: Dict[int, "_Ring"] = {}
+
+
+class _Ring:
+    """One thread's bounded event ring: preallocated slots, single
+    writer, overwrite-oldest."""
+
+    __slots__ = ("ident", "name", "cap", "slots", "pos", "count")
+
+    def __init__(self, ident: int, name: str, cap: int):
+        self.ident = ident
+        self.name = name
+        self.cap = cap
+        # slot layout: [ts_ns, kind, name, query_id, a, b]
+        self.slots = [[0, "", "", None, 0, 0] for _ in range(cap)]
+        self.pos = 0
+        self.count = 0
+
+
+def _ring() -> _Ring:
+    """The calling thread's ring (created on first record)."""
+    r = getattr(_TLS, "ring", None)
+    if r is None:
+        ident = threading.get_ident()
+        r = _Ring(ident, threading.current_thread().name, _CAPACITY)
+        with _REG_LOCK:
+            # ident reuse after a thread dies replaces the dead ring:
+            # its tail has been snapshot-able since the thread parked,
+            # and keeping both would grow without bound
+            _RINGS[ident] = r
+        _TLS.ring = r
+    return r
+
+
+def record(kind: str, name: str = "", a: int = 0, b: int = 0,
+           query_id: Optional[str] = None):
+    """Record one event into the calling thread's ring.
+
+    Hot-path contract: callers pass constant/interned ``kind``/``name``
+    strings and plain ints — no formatting, no dict building (OBS002).
+    ``query_id`` defaults to the active CancelToken's; pass it
+    explicitly on threads outside a query context (submit path)."""
+    if not _ENABLED:
+        return
+    r = getattr(_TLS, "ring", None)
+    if r is None:
+        r = _ring()
+    if query_id is None:
+        tok = current_token()
+        if tok is not None:
+            query_id = tok.query_id
+    slot = r.slots[r.pos]
+    slot[0] = time.perf_counter_ns()
+    slot[1] = kind
+    slot[2] = name
+    slot[3] = query_id
+    slot[4] = a
+    slot[5] = b
+    pos = r.pos + 1
+    r.pos = 0 if pos == r.cap else pos
+    r.count += 1
+
+
+# ---------------------------------------------------------------------------
+# snapshot / introspection (cold paths)
+# ---------------------------------------------------------------------------
+
+def _ring_tail(r: _Ring) -> List[Dict]:
+    """The ring's buffered events, oldest first."""
+    n = min(r.count, r.cap)
+    if n == 0:
+        return []
+    pos = r.pos
+    if r.count <= r.cap:
+        ordered = r.slots[:n]
+    else:
+        ordered = r.slots[pos:] + r.slots[:pos]
+    out = []
+    for s in ordered:
+        out.append({"ts_ns": s[0], "kind": s[1], "name": s[2],
+                    "query_id": s[3], "a": s[4], "b": s[5],
+                    "thread": r.name})
+    return out
+
+
+def snapshot(query_id: Optional[str] = None,
+             last: Optional[int] = None) -> List[Dict]:
+    """Merge every thread's tail, time-ordered on the shared
+    perf_counter_ns clock.  ``query_id`` filters to one query's events
+    (plus none-attributed events are dropped); ``last`` keeps only the
+    most recent N after the merge."""
+    with _REG_LOCK:
+        rings = list(_RINGS.values())
+    events: List[Dict] = []
+    for r in rings:
+        events.extend(_ring_tail(r))
+    if query_id is not None:
+        qid = str(query_id)
+        events = [e for e in events
+                  if e["query_id"] is not None
+                  and str(e["query_id"]) == qid]
+    events.sort(key=lambda e: e["ts_ns"])
+    if last is not None and len(events) > last:
+        events = events[-last:]
+    return events
+
+
+def thread_counts() -> Dict[int, int]:
+    """{thread ident: total events recorded} — the watchdog's progress
+    signal: a parked thread's count stops advancing."""
+    with _REG_LOCK:
+        return {ident: r.count for ident, r in _RINGS.items()}
+
+
+def occupancy() -> Dict[str, int]:
+    """Recorder occupancy for ``Service.stats()``/monitoring."""
+    with _REG_LOCK:
+        rings = list(_RINGS.values())
+    return {
+        "enabled": bool(_ENABLED),
+        "threads": len(rings),
+        "capacity_per_thread": _CAPACITY,
+        "events_buffered": sum(min(r.count, r.cap) for r in rings),
+        "events_recorded": sum(r.count for r in rings),
+    }
+
+
+def enable():
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.flightRecorder.*`` conf group.
+    A capacity change applies to rings created afterwards (existing
+    rings keep their preallocated slots)."""
+    global _ENABLED, _CAPACITY
+    from ..config import OBS_FLIGHT_ENABLED, OBS_FLIGHT_CAPACITY
+    _ENABLED = bool(conf.get(OBS_FLIGHT_ENABLED))
+    cap = int(conf.get(OBS_FLIGHT_CAPACITY))
+    if cap > 0:
+        _CAPACITY = cap
+
+
+def reset():
+    """Drop every ring (tests).  Threads re-register on next record."""
+    global _RINGS
+    with _REG_LOCK:
+        _RINGS = {}
+    _TLS.__dict__.pop("ring", None)

@@ -233,14 +233,23 @@ def emit(name: str, cat: str, start_ns: int, dur_ns: int, **args):
 
 def traced(name: str, cat: str = "kernel"):
     """Decorator form for kernel entry points: spans the call when
-    tracing is on, calls straight through (one flag read) when off."""
+    tracing is on, calls nearly straight through (one flag read each
+    for the tracer and the flight recorder) when off.  The flight
+    recorder (obs/flight.py) shares this boundary so the always-on
+    black box and full tracing instrument one code path; its record
+    call passes only the interned ``name`` (OBS002: allocation-free)."""
+    from . import flight as _flight
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*a, **k):
-            if not _ENABLED:
-                return fn(*a, **k)
-            with Span(name, cat, {}):
-                return fn(*a, **k)
+            _flight.record(_flight.EV_KERNEL, name)
+            try:
+                if not _ENABLED:
+                    return fn(*a, **k)
+                with Span(name, cat, {}):
+                    return fn(*a, **k)
+            finally:
+                _flight.record(_flight.EV_KERNEL_END, name)
         return wrapper
     return deco
 

@@ -1,0 +1,166 @@
+"""Stall watchdog — detects queries that stopped making progress while
+still holding contended resources, and captures the evidence.
+
+A query is *stalled* when its worker thread's flight-recorder event
+count (obs/flight.py ``thread_counts()``) has not advanced for the
+conf'd window while the query is RUNNING — i.e. it occupies an
+inflight slot and typically the device semaphore.  The flight recorder
+is the progress signal precisely because every interesting transition
+(kernel entry, spill, semaphore, shuffle fetch, retry) records an
+event: a worker that records nothing for minutes is wedged in a
+foreign call, a lost lock, or a dead socket.
+
+On trigger the watchdog samples every thread's Python stack, the arena
+live/peak/spill map, shuffle client/server state, and service queue
+depths into a diagnostic bundle (obs/diagnostics.py), logs a
+``watchdog`` service event, and fires at most once per query so a
+genuinely wedged worker does not flood the bundle directory.
+
+The daemon is owned by ``QueryService`` (started/stopped with it) and
+costs one ``thread_counts()`` dict per poll interval — nothing on any
+query hot path.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from . import flight as _flight
+
+
+class Watchdog:
+    """Daemon polling flight-recorder progress of inflight queries.
+
+    ``service`` is duck-typed: the watchdog uses ``_inflight_items()``
+    (list of (query_id, handle)), ``_write_diag_bundle(trigger, handle,
+    error)`` and ``_events.log_service_event`` — all provided by
+    ``service.server.QueryService``.
+    """
+
+    def __init__(self, service, interval_s: float = 1.0,
+                 stall_s: float = 120.0):
+        self._service = service
+        self._interval_s = max(0.05, float(interval_s))
+        self._stall_s = max(self._interval_s, float(stall_s))
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # query_id -> (last observed ring count, perf_ns of last change)
+        self._progress: Dict[str, tuple] = {}
+        self._triggered: set = set()
+        self._trigger_count = 0
+        self._last_trigger: Optional[dict] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        t = threading.Thread(target=self._loop, name="tpu-watchdog",
+                             daemon=True)
+        self._thread = t
+        t.start()
+
+    def stop(self):
+        t = self._thread
+        if t is None:
+            return
+        self._stop.set()
+        t.join(timeout=5.0)
+        self._thread = None
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    # -- polling -----------------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.wait(self._interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                # the watchdog must never take the service down
+                pass
+
+    def poll_once(self, now_ns: Optional[int] = None):
+        """One progress scan (exposed for deterministic tests)."""
+        now = time.perf_counter_ns() if now_ns is None else now_ns
+        counts = _flight.thread_counts()
+        inflight = self._service._inflight_items()
+        live_ids = set()
+        stalled = []
+        with self._lock:
+            for query_id, handle in inflight:
+                live_ids.add(query_id)
+                if getattr(handle, "status", None) != "RUNNING":
+                    self._progress.pop(query_id, None)
+                    continue
+                ident = getattr(handle, "_worker_ident", None)
+                if ident is None:
+                    continue
+                count = counts.get(ident)
+                if count is None:
+                    continue
+                prev = self._progress.get(query_id)
+                if prev is None or prev[0] != count:
+                    self._progress[query_id] = (count, now)
+                    continue
+                idle_s = (now - prev[1]) / 1e9
+                if idle_s >= self._stall_s and query_id not in self._triggered:
+                    self._triggered.add(query_id)
+                    stalled.append((query_id, handle, idle_s))
+            # drop book-keeping for finished queries
+            for qid in list(self._progress):
+                if qid not in live_ids:
+                    self._progress.pop(qid, None)
+            for qid in list(self._triggered):
+                if qid not in live_ids:
+                    self._triggered.discard(qid)
+        for query_id, handle, idle_s in stalled:
+            self._fire(query_id, handle, idle_s)
+        return [qid for qid, _, _ in stalled]
+
+    def _fire(self, query_id: str, handle, idle_s: float):
+        _flight.record(_flight.EV_WATCHDOG, query_id, a=int(idle_s * 1000),
+                       query_id=query_id)
+        bundle_path = None
+        try:
+            bundle_path = self._service._write_diag_bundle(
+                "watchdog", handle,
+                error=TimeoutError(
+                    "no flight-recorder progress for %.1fs" % idle_s))
+        except Exception:
+            pass
+        try:
+            self._service._events.log_service_event(
+                "watchdog", query_id,
+                stalled_s=round(idle_s, 3),
+                diag_bundle=bundle_path)
+        except Exception:
+            pass
+        with self._lock:
+            self._trigger_count += 1
+            self._last_trigger = {
+                "query_id": query_id,
+                "stalled_s": round(idle_s, 3),
+                "diag_bundle": bundle_path,
+            }
+
+    # -- introspection -----------------------------------------------------
+
+    def state(self) -> dict:
+        """Watchdog state for ``Service.stats()`` / bundles."""
+        with self._lock:
+            return {
+                "enabled": self.running,
+                "interval_s": self._interval_s,
+                "stall_s": self._stall_s,
+                "watched": len(self._progress),
+                "triggers": self._trigger_count,
+                "last_trigger": dict(self._last_trigger)
+                if self._last_trigger else None,
+            }

@@ -52,7 +52,13 @@ class QueryMetrics:
 
 
 class ServiceStats:
-    """Thread-safe monotonic counters for the whole service."""
+    """Thread-safe monotonic counters for the whole service.
+
+    Beyond the counters, a snapshot can carry *extras* — live state
+    sections contributed by the owning service (watchdog state,
+    flight-recorder occupancy) so ``Service.stats().snapshot()`` is the
+    one-stop monitoring view without the counter object growing
+    service back-references."""
 
     _NAMES = ("submitted", "admitted", "shed", "completed", "failed",
               "cancelled", "deadline_exceeded", "retries")
@@ -60,6 +66,7 @@ class ServiceStats:
     def __init__(self):
         self._lock = threading.Lock()
         self._counts = {n: 0 for n in self._NAMES}
+        self._extras = None
 
     def inc(self, name: str, by: int = 1):
         with self._lock:
@@ -68,6 +75,18 @@ class ServiceStats:
         # lifecycle counters without reaching into a QueryService
         SERVICE_EVENTS.labels(event=name).inc(by)
 
-    def snapshot(self) -> Dict[str, int]:
+    def set_extras(self, fn):
+        """Register a zero-arg callable returning a dict merged into
+        every ``snapshot()`` (collect-time cost only)."""
+        self._extras = fn
+
+    def snapshot(self) -> Dict:
         with self._lock:
-            return dict(self._counts)
+            out: Dict = dict(self._counts)
+            fn = self._extras
+        if fn is not None:
+            try:
+                out.update(fn())
+            except Exception:
+                pass
+        return out

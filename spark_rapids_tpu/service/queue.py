@@ -19,6 +19,7 @@ import threading
 from collections import OrderedDict, deque
 from typing import Dict, Optional
 
+from ..obs import flight as _flight
 from .errors import ServiceOverloaded
 
 
@@ -64,6 +65,11 @@ class FairQueryQueue:
             self.depth += 1
             self.queued_bytes += est
             self._not_empty.notify()
+        # admission transition for the flight recorder (outside the
+        # lock: the recorder is lock-free but queue hold time stays
+        # minimal)
+        _flight.record(_flight.EV_STATE, "queued", a=self.depth,
+                       query_id=getattr(item, "query_id", None))
 
     # -- consumer side -----------------------------------------------------
     def take(self, timeout: Optional[float] = None):
@@ -73,11 +79,14 @@ class FairQueryQueue:
             while True:
                 item = self._pop_locked()
                 if item is not None:
-                    return item
+                    break
                 if self._closed:
                     return None
                 if not self._not_empty.wait(timeout):
                     return None
+        _flight.record(_flight.EV_STATE, "dequeued", a=self.depth,
+                       query_id=getattr(item, "query_id", None))
+        return item
 
     def _pop_locked(self):
         for prio in sorted(self._classes, reverse=True):

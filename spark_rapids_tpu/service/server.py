@@ -24,7 +24,11 @@ from typing import Dict, List, Optional
 from ..api.session import TpuSession
 from ..config import (TpuConf, set_active, EVENT_LOG_PATH,
                       SERVICE_WORKERS, SERVICE_MAX_QUEUE_DEPTH,
-                      SERVICE_MAX_QUEUED_BYTES, SERVICE_DEFAULT_DEADLINE_MS)
+                      SERVICE_MAX_QUEUED_BYTES, SERVICE_DEFAULT_DEADLINE_MS,
+                      OBS_WATCHDOG_ENABLED, OBS_WATCHDOG_INTERVAL_MS,
+                      OBS_WATCHDOG_STALL_S, OBS_DIAG_DIR,
+                      OBS_DIAG_MAX_BUNDLES)
+from ..obs import flight as _flight
 from ..obs import trace as _trace
 from ..obs.registry import (QUEUE_WAIT_SECONDS, SERVICE_INFLIGHT,
                             SERVICE_QUEUE_DEPTH, SERVICE_QUEUED_BYTES)
@@ -60,6 +64,11 @@ class QueryHandle:
         self._done = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
+        # observability side-car state: the worker thread running this
+        # query (stall-watchdog progress key) and the last planned
+        # physical tree (diagnostic-bundle plan section)
+        self._worker_ident: Optional[int] = None
+        self._last_phys = None
 
     # -- client API --------------------------------------------------------
     def result(self, timeout: Optional[float] = None):
@@ -118,12 +127,29 @@ class QueryService:
         self._shutdown = False
         self._start_lock = threading.Lock()
         self._scrape_server = None
+        # failure diagnostics: bundle directory ("" disables) + rotation
+        self._diag_dir = conf.get(OBS_DIAG_DIR) or ""
+        self._diag_max = conf.get(OBS_DIAG_MAX_BUNDLES)
+        self._last_shed_bundle_mono = 0.0
+        # stall watchdog (daemon; started/stopped with the service)
+        from ..obs.watchdog import Watchdog
+        self._watchdog_enabled = bool(conf.get(OBS_WATCHDOG_ENABLED))
+        self.watchdog = Watchdog(
+            self,
+            interval_s=conf.get(OBS_WATCHDOG_INTERVAL_MS) / 1000.0,
+            stall_s=float(conf.get(OBS_WATCHDOG_STALL_S)))
         # queue/inflight gauges read live service state at collect time
         # (scrapes pay the cost, the submit/run hot path pays nothing)
         SERVICE_QUEUE_DEPTH.set_function(lambda: self.queue.depth)
         SERVICE_QUEUED_BYTES.set_function(
             lambda: self.queue.stats().get("queued_bytes", 0))
         SERVICE_INFLIGHT.set_function(lambda: len(self._inflight))
+        # stats().snapshot() carries the live obs sections alongside the
+        # lifecycle counters (the monitoring one-stop view)
+        self._stats.set_extras(lambda: {
+            "watchdog": self.watchdog.state(),
+            "flight_recorder": _flight.occupancy(),
+        })
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "QueryService":
@@ -135,6 +161,8 @@ class QueryService:
                                      name=f"tpu-query-service-{i}")
                 t.start()
                 self._workers.append(t)
+            if self._watchdog_enabled:
+                self.watchdog.start()
         return self
 
     def shutdown(self, wait: bool = True, timeout: Optional[float] = None,
@@ -155,6 +183,7 @@ class QueryService:
                 left = None if deadline is None else \
                     max(0.0, deadline - time.monotonic())
                 t.join(left)
+        self.watchdog.stop()
         if self._scrape_server is not None:
             self._scrape_server.shutdown()
             self._scrape_server = None
@@ -212,12 +241,15 @@ class QueryService:
             self._stats.inc("shed")
             handle.metrics.outcome = "shed"
             handle._finish(FAILED, error=e)
+            _flight.record(_flight.EV_STATE, "shed", query_id=query_id)
+            bundle = self._maybe_shed_bundle(handle, e)
             self._events.log_service_event(
                 "shed", query_id, tenant=tenant, priority=priority,
                 queue_depth=e.queue_depth, queued_bytes=e.queued_bytes,
-                reason=str(e))
+                reason=str(e), diag_bundle=bundle)
             raise
         self._stats.inc("admitted")
+        _flight.record(_flight.EV_STATE, "admitted", query_id=query_id)
         self._events.log_service_event(
             "admitted", query_id, tenant=tenant, priority=priority,
             est_bytes=est_bytes, queue_depth=self.queue.depth,
@@ -248,6 +280,8 @@ class QueryService:
 
     def _run_one(self, handle: QueryHandle):
         m = handle.metrics
+        # progress key for the stall watchdog: this worker's flight ring
+        handle._worker_ident = threading.get_ident()
         m.queue_wait_ms = (time.time() - m.submitted_ts) * 1000.0
         QUEUE_WAIT_SECONDS.observe(m.queue_wait_ms / 1e3)
         if _trace._ENABLED:
@@ -261,6 +295,8 @@ class QueryService:
             self._finalize_cancel(handle)
             return
         handle.status = RUNNING
+        _flight.record(_flight.EV_STATE, "running",
+                       query_id=handle.query_id)
         base_conf = self.session.conf.with_overrides(handle.conf_overrides)
         attempt = 0
         while True:
@@ -279,6 +315,9 @@ class QueryService:
                     attempt += 1
                     m.retries += 1
                     self._stats.inc("retries")
+                    _flight.record(_flight.EV_RETRY,
+                                   self.retry.classify(e), a=attempt,
+                                   query_id=handle.query_id)
                     backoff = self.retry.backoff_s(attempt)
                     self._events.log_service_event(
                         "retry", handle.query_id, tenant=handle.tenant,
@@ -293,14 +332,22 @@ class QueryService:
                 m.error = repr(e)
                 self._stats.inc("failed")
                 handle._finish(FAILED, error=e)
+                _flight.record(_flight.EV_STATE, "failed",
+                               query_id=handle.query_id)
+                reason = self.retry.classify(e)
+                bundle = self._write_diag_bundle(
+                    "oom" if reason == "device_oom" else "failed",
+                    handle, e)
                 self._emit_outcome(
-                    "failed", handle,
-                    reason=self.retry.classify(e), retryable=retryable)
+                    "failed", handle, reason=reason, retryable=retryable,
+                    diag_bundle=bundle)
                 self._forget(handle)
                 return
             m.outcome = "completed"
             self._stats.inc("completed")
             handle._finish(DONE, result=table)
+            _flight.record(_flight.EV_STATE, "completed",
+                           query_id=handle.query_id)
             self._emit_outcome("completed", handle, rows=table.num_rows)
             self._forget(handle)
             return
@@ -323,6 +370,7 @@ class QueryService:
             t0 = time.perf_counter()
             planner = Planner(conf)
             phys = planner.plan(handle.logical)
+            handle._last_phys = phys
             table = self.session.execute_physical(
                 phys, conf=conf, fallbacks=planner.fallbacks)
             m.execute_ms += (time.perf_counter() - t0) * 1000.0
@@ -363,10 +411,48 @@ class QueryService:
         self._stats.inc("cancelled")
         if reason == "deadline":
             self._stats.inc("deadline_exceeded")
-        handle._finish(CANCELLED, error=QueryCancelledError(
-            reason, handle.query_id))
-        self._emit_outcome("cancelled", handle, reason=reason)
+        err = QueryCancelledError(reason, handle.query_id)
+        handle._finish(CANCELLED, error=err)
+        _flight.record(_flight.EV_STATE, "cancelled",
+                       query_id=handle.query_id)
+        bundle = self._write_diag_bundle(
+            "deadline" if reason == "deadline" else "cancelled",
+            handle, err)
+        self._emit_outcome("cancelled", handle, reason=reason,
+                           diag_bundle=bundle)
         self._forget(handle)
+
+    # -- failure diagnostics ----------------------------------------------
+    def _write_diag_bundle(self, trigger: str, handle: Optional[QueryHandle],
+                           error: Optional[BaseException]) -> Optional[str]:
+        """Capture one diagnostic bundle (obs/diagnostics.py) into the
+        conf'd directory.  Returns the bundle path, or None when
+        diagnostics are disabled or capture failed — this runs on a
+        failing query's unwind path and must never raise."""
+        if not self._diag_dir:
+            return None
+        from ..obs import diagnostics as _diag
+        return _diag.capture(trigger, self._diag_dir, self._diag_max,
+                             handle=handle, error=error, service=self)
+
+    def _maybe_shed_bundle(self, handle: QueryHandle,
+                           error: BaseException) -> Optional[str]:
+        """Shed is the overload path: a bundle per shed submission would
+        turn one incident into thousands of files, so shed bundles are
+        rate-limited to one per 10s (the event-log line still records
+        every shed)."""
+        if not self._diag_dir:
+            return None
+        now = time.monotonic()
+        if now - self._last_shed_bundle_mono < 10.0:
+            return None
+        self._last_shed_bundle_mono = now
+        return self._write_diag_bundle("shed", handle, error)
+
+    def _inflight_items(self) -> List:
+        """(query_id, handle) snapshot for the stall watchdog."""
+        with self._inflight_lock:
+            return list(self._inflight.items())
 
     def _forget(self, handle: QueryHandle):
         with self._inflight_lock:
@@ -382,7 +468,10 @@ class QueryService:
     def stats(self) -> "ServiceStats":
         """The service's lifecycle counters (public accessor; the
         counter object itself stays private so callers observe through
-        ``snapshot()``/the registry rather than mutating it)."""
+        ``snapshot()``/the registry rather than mutating it).
+        ``stats().snapshot()`` additionally carries the live
+        ``watchdog`` state and ``flight_recorder`` occupancy
+        sections."""
         return self._stats
 
     def snapshot(self) -> Dict:

@@ -15,6 +15,7 @@ import itertools
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..obs import flight as _flight
 from .meta import TableMeta, batch_from_meta
 from .transport import (BlockIdSpec, ClientConnection, MetadataRequest,
                         MetadataResponse, TransferRequest, TransferResponse)
@@ -167,10 +168,12 @@ class RapidsShuffleClient:
     def do_fetch(self, blocks: List[BlockIdSpec],
                  handler: RapidsShuffleFetchHandler):
         """Issue the metadata round; on response, kick off transfers."""
+        _flight.record(_flight.EV_SHUFFLE, "fetch_start", a=len(blocks))
         req = MetadataRequest(next(self._req_counter), list(blocks))
 
         def on_meta(resp: MetadataResponse):
             if resp.error:
+                _flight.record(_flight.EV_SHUFFLE, "fetch_error")
                 handler.transfer_error(resp.error)
                 return
             self._issue_transfer(blocks, resp, handler)
@@ -208,6 +211,8 @@ class RapidsShuffleClient:
             return
 
         def on_table(t: PendingTable):
+            _flight.record(_flight.EV_SHUFFLE, "table_received",
+                           a=t.meta.total_bytes)
             bid = self.catalog.register(bytes(t.blob))
             handler.batch_received(
                 ReceivedBufferHandle(self.catalog, bid, t.meta))

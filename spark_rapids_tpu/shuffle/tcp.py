@@ -38,6 +38,7 @@ import struct
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..obs import flight as _flight
 from .meta import decode_meta, encode_meta
 from .transport import (BlockIdSpec, ClientConnection, MetadataRequest,
                         MetadataResponse, RapidsShuffleTransport,
@@ -262,6 +263,7 @@ class TcpClientConnection(ClientConnection):
             # HELLO goes out before the socket is published, so no
             # request frame can beat it onto the wire
             s.send(HELLO, _pack_str(self.transport.executor_id))
+            _flight.record(_flight.EV_SHUFFLE, "dial")
             with self._lock:
                 self._sock = s
             if not s.thread.is_alive():
@@ -296,6 +298,7 @@ class TcpClientConnection(ClientConnection):
             tx.complete_success()
 
     def _on_close(self, _s: _Socket):
+        _flight.record(_flight.EV_SHUFFLE, "conn_closed")
         with self._lock:
             pending = list(self._pending.values())
             self._pending.clear()

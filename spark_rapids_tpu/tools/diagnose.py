@@ -1,0 +1,229 @@
+"""Diagnostic-bundle renderer — turns one ``diag-*.json`` incident
+bundle (obs/diagnostics.py) into a human-readable report.
+
+A bundle is the automatic post-mortem the service writes on query
+failure, device OOM, deadline expiry, cancellation, or a stall-watchdog
+trigger.  This tool is the reading side: the incident timeline from the
+flight-recorder tail, the stacks of every thread at capture time, the
+arena and shuffle occupancy, the plan tree with verifier verdicts, and
+the (redacted) conf — one artifact, no repro needed.
+
+Usage:
+  python -m spark_rapids_tpu.tools.diagnose <bundle.json>
+      [--events N] [--no-stacks]
+  python -m spark_rapids_tpu.tools.diagnose --list <bundle_dir>
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List
+
+
+def _fmt_bytes(n) -> str:
+    try:
+        n = int(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return str(n)
+
+
+def _flight_lines(bundle: Dict, max_events: int) -> List[str]:
+    fl = bundle.get("flight") or {}
+    out = []
+    occ = fl.get("occupancy")
+    if occ:
+        out.append(f"recorder: threads={occ.get('threads')} "
+                   f"buffered={occ.get('events_buffered')} "
+                   f"recorded={occ.get('events_recorded')} "
+                   f"cap/thread={occ.get('capacity_per_thread')}")
+    events = fl.get("query_events") or []
+    source = "query"
+    if not events:
+        events = fl.get("recent_events") or []
+        source = "recent (no query-attributed events)"
+    if not events:
+        out.append("  <no flight-recorder events captured>")
+        return out
+    shown = events[-max_events:]
+    out.append(f"last {len(shown)} of {len(events)} {source} events "
+               "(oldest first; t=0 at first shown):")
+    t_base = shown[0].get("ts_ns", 0)
+    for e in shown:
+        dt_ms = (e.get("ts_ns", 0) - t_base) / 1e6
+        extra = ""
+        if e.get("a"):
+            extra += f" a={e['a']}"
+        if e.get("b"):
+            extra += f" b={e['b']}"
+        out.append(f"  +{dt_ms:10.3f}ms  {e.get('thread', ''):<24s}"
+                   f"{e.get('kind', ''):<12s}{e.get('name', '')}{extra}")
+    return out
+
+
+def _thread_lines(bundle: Dict) -> List[str]:
+    out = []
+    for t in bundle.get("threads") or []:
+        if "error" in t and "name" not in t:
+            out.append(f"  <stack capture error: {t['error']}>")
+            continue
+        out.append(f"thread {t.get('name')} (ident={t.get('ident')}"
+                   f"{', daemon' if t.get('daemon') else ''}):")
+        for frame in t.get("stack") or []:
+            out.append("  " + frame.replace("\n", "\n  "))
+    return out
+
+
+def _arena_lines(bundle: Dict) -> List[str]:
+    arena = bundle.get("arena") or {}
+    out = []
+    stats = arena.get("stats") or {}
+    if stats:
+        out.append("  ".join(f"{k}={_fmt_bytes(v) if 'bytes' in k else v}"
+                             for k, v in sorted(stats.items())))
+    sem = arena.get("semaphore")
+    if sem:
+        out.append(f"semaphore: permits={sem.get('permits')} "
+                   f"available={sem.get('available')} "
+                   f"holders={sem.get('holders')}")
+    entries = arena.get("entries") or []
+    if entries:
+        out.append(f"{len(entries)} catalog entries (largest first):")
+        for e in entries[:20]:
+            out.append(f"  {e.get('tier', ''):<8s}"
+                       f"{_fmt_bytes(e.get('nbytes')):>12s}  "
+                       f"prio={e.get('priority')}  {e.get('buffer_id')}")
+        if len(entries) > 20:
+            out.append(f"  ... {len(entries) - 20} more")
+    if "error" in arena:
+        out.append(f"  <arena capture error: {arena['error']}>")
+    return out
+
+
+def render_bundle(bundle: Dict, max_events: int = 64,
+                  show_stacks: bool = True) -> str:
+    lines = ["=" * 72,
+             f"incident bundle: trigger={bundle.get('trigger')} "
+             f"query={bundle.get('query_id')} "
+             f"captured={bundle.get('captured_at')}",
+             "=" * 72]
+    err = bundle.get("error")
+    if err:
+        lines.append(f"error: {err.get('type')}: {err.get('message')}")
+        tb = err.get("traceback") or []
+        if tb:
+            lines.append("-- traceback --")
+            lines.extend("  " + ln.rstrip("\n") for ln in tb)
+    q = bundle.get("query")
+    if q:
+        lines.append(f"query: status={q.get('status')} "
+                     f"tenant={q.get('tenant')} "
+                     f"attempts={q.get('attempts')}")
+        rec = q.get("record") or {}
+        if rec:
+            lines.append(f"  outcome={rec.get('outcome')} "
+                         f"queue_wait_ms={rec.get('queue_wait_ms')} "
+                         f"execute_ms={rec.get('execute_ms')} "
+                         f"sem_wait_ms={rec.get('sem_wait_ms')} "
+                         f"spill_bytes={rec.get('spill_bytes')}")
+    c = bundle.get("cancel")
+    if c:
+        lines.append(f"cancel token: cancelled={c.get('cancelled')} "
+                     f"reason={c.get('reason')} "
+                     f"observed={c.get('observed')}")
+    svc = bundle.get("service")
+    if svc:
+        lines.append("-- service snapshot --")
+        lines.append("  " + "  ".join(
+            f"{k}={v}" for k, v in sorted(svc.items())
+            if not isinstance(v, (dict, list))))
+        wd = svc.get("watchdog")
+        if isinstance(wd, dict):
+            lines.append(f"  watchdog: {wd}")
+    lines.append("-- flight recorder --")
+    lines.extend("  " + ln for ln in _flight_lines(bundle, max_events))
+    lines.append("-- arena --")
+    lines.extend("  " + ln for ln in _arena_lines(bundle))
+    sh = bundle.get("shuffle")
+    if sh:
+        lines.append("-- shuffle --")
+        lines.append("  " + "  ".join(f"{k}={v}"
+                                      for k, v in sorted(sh.items())))
+    plan = bundle.get("plan")
+    if plan:
+        lines.append("-- plan --")
+        for ln in (plan.get("tree") or "").splitlines():
+            lines.append("  " + ln)
+        pv = plan.get("verify")
+        if pv:
+            if pv.get("ok"):
+                lines.append("  verifier: ok")
+            else:
+                lines.append("  verifier violations:")
+                for v in pv.get("violations") or []:
+                    lines.append(f"    node {v.get('node_index')}: "
+                                 f"{v.get('rule')}: {v.get('message')}")
+    if show_stacks:
+        lines.append("-- thread stacks --")
+        lines.extend("  " + ln for ln in _thread_lines(bundle))
+    conf = bundle.get("conf")
+    if conf:
+        lines.append("-- conf (explicit settings, secrets redacted) --")
+        for k, v in sorted(conf.items()):
+            lines.append(f"  {k} = {v}")
+    metrics = bundle.get("metrics")
+    if isinstance(metrics, dict) and "error" not in metrics:
+        lines.append(f"-- metrics snapshot: {len(metrics)} series "
+                     "(full values in the JSON) --")
+    return "\n".join(lines)
+
+
+def list_bundles(directory: str) -> List[str]:
+    """Bundle paths in ``directory``, oldest first (the rotation
+    order)."""
+    try:
+        names = sorted(n for n in os.listdir(directory)
+                       if n.startswith("diag-") and n.endswith(".json"))
+    except OSError:
+        return []
+    return [os.path.join(directory, n) for n in names]
+
+
+def main(argv=None):
+    argv = list(argv if argv is not None else sys.argv[1:])
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: diagnose <bundle.json> [--events N] [--no-stacks]\n"
+              "       diagnose --list <bundle_dir>", file=sys.stderr)
+        return 1
+    if argv[0] == "--list":
+        paths = list_bundles(argv[1]) if len(argv) > 1 else []
+        for p in paths:
+            print(p)
+        return 0 if paths else 1
+
+    def _opt(flag, default=None):
+        if flag in argv:
+            i = argv.index(flag)
+            v = argv[i + 1]
+            del argv[i:i + 2]
+            return v
+        return default
+
+    max_events = int(_opt("--events", 64))
+    show_stacks = "--no-stacks" not in argv
+    if not show_stacks:
+        argv.remove("--no-stacks")
+    with open(argv[0], encoding="utf-8") as f:
+        bundle = json.load(f)
+    print(render_bundle(bundle, max_events=max_events,
+                        show_stacks=show_stacks))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

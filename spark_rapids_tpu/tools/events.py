@@ -129,8 +129,13 @@ class QueryEventLogger:
 
     def log_service_event(self, kind: str, query_id, **fields):
         """One service-lifecycle line: kind is admitted | shed | retry |
-        cancelled | completed | failed.  Shares the query's stable
-        ``query_id`` with the engine records."""
+        watchdog | cancelled | completed | failed.  Shares the query's
+        stable ``query_id`` with the engine records.  Failure-class
+        records (shed/cancelled/failed/watchdog) carry ``diag_bundle``
+        — the path of the automatic diagnostic bundle written for the
+        incident (obs/diagnostics.py; None when diagnostics are
+        disabled) — which tools/report.py surfaces as the bundle link
+        in the retry/failure story."""
         record = {"event": kind, "query_id": query_id, "ts": time.time()}
         record.update(fields)
         self._append(record)

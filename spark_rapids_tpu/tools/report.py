@@ -29,8 +29,8 @@ from typing import Dict, List, Optional
 from .events import read_event_log
 
 #: lifecycle kinds emitted by the query service, in story order
-_LIFECYCLE = ("admitted", "shed", "retry", "cancelled", "completed",
-              "failed")
+_LIFECYCLE = ("admitted", "shed", "retry", "watchdog", "cancelled",
+              "completed", "failed")
 
 
 # ---------------------------------------------------------------------------
@@ -165,9 +165,16 @@ def _service_story(service: List[Dict]) -> List[str]:
                        f"backoff_ms={rec.get('backoff_ms')} "
                        f"overlay={rec.get('conf_overlay')}")
         elif kind == "shed":
-            out.append(f"shed        {rec.get('reason')}")
+            line = f"shed        {rec.get('reason')}"
+            if rec.get("diag_bundle"):
+                line += f"  bundle={rec['diag_bundle']}"
+            out.append(line)
+        elif kind == "watchdog":
+            out.append(f"watchdog    stalled_s={rec.get('stalled_s')}"
+                       + (f"  bundle={rec['diag_bundle']}"
+                          if rec.get("diag_bundle") else ""))
         elif kind in ("completed", "failed", "cancelled"):
-            out.append(
+            line = (
                 f"{kind:<11s} attempts={rec.get('attempts')} "
                 f"queue_wait_ms={rec.get('queue_wait_ms')} "
                 f"execute_ms={rec.get('execute_ms')} "
@@ -175,6 +182,11 @@ def _service_story(service: List[Dict]) -> List[str]:
                 f"spill_bytes={rec.get('spill_bytes')}"
                 + (f" error={rec.get('error')}"
                    if rec.get("error") else ""))
+            if rec.get("diag_bundle"):
+                # the incident artifact for this outcome (render it
+                # with tools/diagnose.py)
+                line += f"  bundle={rec['diag_bundle']}"
+            out.append(line)
     return out
 
 

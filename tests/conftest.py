@@ -48,6 +48,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: non-gating perf/soak checks excluded from the tier-1 "
+        "run (-m 'not slow')")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,22 @@
+"""Seeded OBS002 fixture — ``ci/lint.py`` must exit NONZERO.
+
+Flight-recorder ``record()`` call sites shaped like a device hot path
+(``kernels/`` / ``exec/tpu_*``) but with per-call allocation: an
+f-string name, a dict-literal payload, and eager ``str.format``.  The
+recorder is always-on, so these allocate on every event even when
+nobody ever reads the ring.  Never imported by the engine.
+"""
+from spark_rapids_tpu.obs import flight as _flight
+
+
+def bad_kernel(table, rows):
+    _flight.record(_flight.EV_KERNEL, f"gather:{rows}")
+    _flight.record(_flight.EV_KERNEL, "gather", a={"rows": rows})
+    _flight.record(_flight.EV_KERNEL, "gather:{}".format(rows))
+    return table
+
+
+def good_kernel(table, rows):
+    # the allocation-free shape: interned constants + plain ints
+    _flight.record(_flight.EV_KERNEL, "gather", a=rows)
+    return table

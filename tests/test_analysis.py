@@ -407,6 +407,39 @@ class TestLint:
         fs = AL.lint_source("def f(:\n", "x.py")
         assert len(fs) == 1 and "syntax error" in fs[0].message
 
+    def test_flight_record_allocating_args_flagged(self):
+        src = ("from spark_rapids_tpu.obs import flight as _flight\n"
+               "def k(x, n):\n"
+               "    _flight.record(_flight.EV_KERNEL, f'gather:{n}')\n"
+               "    _flight.record('kernel', 'gather', a={'rows': n})\n"
+               "    _flight.record('kernel', 'g:{}'.format(n))\n")
+        fs = AL.lint_source(src, "kernels/bad.py",
+                            scopes={AL.OBS002})
+        assert len(fs) == 3 and all(f.rule == AL.OBS002 for f in fs)
+        msgs = "\n".join(f.message for f in fs)
+        assert "f-string" in msgs and "container literal" in msgs
+
+    def test_flight_record_lazy_call_site_clean(self):
+        src = ("from spark_rapids_tpu.obs import flight\n"
+               "def k(x, n):\n"
+               "    flight.record(flight.EV_KERNEL, 'gather', a=n, b=0)\n")
+        assert AL.lint_source(src, "kernels/ok.py",
+                              scopes={AL.OBS002}) == []
+
+    def test_flight_record_rule_scoped_to_hot_path(self):
+        # same allocating call is fine outside kernels/ / exec/tpu_*
+        # (the service layer formats eagerly where latency is cheap)
+        src = ("from spark_rapids_tpu.obs import flight as _flight\n"
+               "def f(n):\n"
+               "    _flight.record('state', f'shed:{n}')\n")
+        scopes = AL._scopes_for("service/server.py")
+        assert AL.OBS002 not in scopes
+        assert AL.lint_source(src, "service/server.py",
+                              scopes=scopes) == []
+        assert AL.OBS002 in AL._scopes_for("exec/tpu_sort.py")
+        assert AL.OBS002 in AL._scopes_for(
+            "spark_rapids_tpu/kernels/gather.py")
+
 
 # ---------------------------------------------------------------------------
 # CLI + project surface
@@ -422,7 +455,8 @@ def _cli():
 
 class TestCliAndProject:
     @pytest.mark.parametrize("fixture", [
-        "lock_inversion.py", "host_sync_kernel.py", "bad_hygiene.py"])
+        "lock_inversion.py", "host_sync_kernel.py", "bad_hygiene.py",
+        "flight_alloc.py"])
     def test_cli_nonzero_on_each_seeded_fixture(self, fixture, capsys):
         assert _cli().main([os.path.join(FIXTURES, fixture)]) == 1
         out = capsys.readouterr().out

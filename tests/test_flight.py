@@ -1,0 +1,443 @@
+"""Flight recorder, stall watchdog, and diagnostic-bundle tests.
+
+Ring half: overwrite-oldest semantics at capacity, concurrent writers
+(one ring per thread, no cross-thread loss), global snapshot
+time-ordering and query filtering.  Watchdog half: deterministic
+``poll_once(now_ns=...)`` firing on a stalled RUNNING handle, once per
+query, with pruning after the query leaves the inflight set.  Bundle
+half: the acceptance path — an OOM-failed and a deadline-killed query
+(tracing disabled, the default) each produce one ``diag-*.json`` with
+the query's flight tail, every thread's stack, and the arena map; the
+event-log outcome record links the bundle; rotation bounds the
+directory; tools/diagnose.py renders it.
+"""
+import json
+import os
+import threading
+import time
+import types
+
+import pytest
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.obs import diagnostics, flight
+from spark_rapids_tpu.obs.watchdog import Watchdog
+from spark_rapids_tpu.service import QueryCancelledError, QueryService
+from spark_rapids_tpu.tools import diagnose
+from spark_rapids_tpu.tools.events import read_event_log
+from spark_rapids_tpu.udf import pandas_udf
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """Isolate every test's rings; restore capacity + enabled state."""
+    old_cap = flight._CAPACITY
+    flight.reset()
+    flight.enable()
+    yield
+    flight._CAPACITY = old_cap
+    flight.reset()
+    flight.enable()
+
+
+def _tpu_session(extra=None):
+    settings = {"spark.rapids.tpu.sql.enabled": True,
+                "spark.rapids.tpu.sql.shuffle.partitions": 4}
+    settings.update(extra or {})
+    return TpuSession(TpuConf(settings))
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+class TestRing:
+    def test_overwrite_oldest_at_capacity(self):
+        flight._CAPACITY = 8
+        for i in range(20):
+            flight.record(flight.EV_KERNEL, "k", a=i)
+        events = flight.snapshot()
+        # only the most recent 8 survive, oldest first
+        assert [e["a"] for e in events] == list(range(12, 20))
+        occ = flight.occupancy()
+        assert occ["events_recorded"] == 20
+        assert occ["events_buffered"] == 8
+        assert occ["capacity_per_thread"] == 8
+
+    def test_disable_suppresses_recording(self):
+        flight.record(flight.EV_KERNEL, "k")
+        before = flight.occupancy()["events_recorded"]
+        flight.disable()
+        flight.record(flight.EV_KERNEL, "k")
+        assert flight.occupancy()["events_recorded"] == before
+        assert not flight.is_enabled()
+        flight.enable()
+        flight.record(flight.EV_KERNEL, "k")
+        assert flight.occupancy()["events_recorded"] == before + 1
+
+    def test_concurrent_writers_one_ring_each(self):
+        n_threads, n_events = 4, 200
+        flight._CAPACITY = 256
+        barrier = threading.Barrier(n_threads)
+
+        def _writer(tid):
+            barrier.wait()
+            for i in range(n_events):
+                flight.record(flight.EV_KERNEL, "k", a=i,
+                              query_id="q%d" % tid)
+        threads = [threading.Thread(target=_writer, args=(t,),
+                                    name="writer-%d" % t)
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        occ = flight.occupancy()
+        assert occ["threads"] == n_threads
+        assert occ["events_recorded"] == n_threads * n_events
+        # no cross-thread loss: every thread's full sequence is present
+        for tid in range(n_threads):
+            mine = flight.snapshot(query_id="q%d" % tid)
+            assert [e["a"] for e in mine] == list(range(n_events))
+
+    def test_snapshot_is_globally_time_ordered(self):
+        def _writer(qid):
+            for i in range(50):
+                flight.record(flight.EV_STATE, "s", a=i, query_id=qid)
+        threads = [threading.Thread(target=_writer, args=("q%d" % t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ts = [e["ts_ns"] for e in flight.snapshot()]
+        assert ts == sorted(ts)
+        tail = flight.snapshot(last=10)
+        assert len(tail) == 10
+        assert [e["ts_ns"] for e in tail] == ts[-10:]
+
+    def test_query_filter_drops_unattributed(self):
+        flight.record(flight.EV_KERNEL, "mine", query_id="qA")
+        flight.record(flight.EV_KERNEL, "other", query_id="qB")
+        flight.record(flight.EV_KERNEL, "orphan")      # no query context
+        names = [e["name"] for e in flight.snapshot(query_id="qA")]
+        assert names == ["mine"]
+
+    def test_configure_applies_conf_group(self):
+        conf = TpuConf({
+            "spark.rapids.tpu.obs.flightRecorder.enabled": False,
+            "spark.rapids.tpu.obs.flightRecorder.capacityPerThread": 32})
+        try:
+            flight.configure(conf)
+            assert not flight.is_enabled()
+            assert flight._CAPACITY == 32
+        finally:
+            flight.enable()
+
+
+# ---------------------------------------------------------------------------
+# watchdog (deterministic: injected clock, fake service)
+# ---------------------------------------------------------------------------
+
+class _FakeService:
+    """Duck-typed QueryService surface the watchdog consumes."""
+
+    def __init__(self):
+        self.items = []
+        self.bundles = []
+        self.events = []
+        self._events = self
+
+    def _inflight_items(self):
+        return list(self.items)
+
+    def _write_diag_bundle(self, trigger, handle, error=None):
+        self.bundles.append((trigger, getattr(handle, "query_id", None),
+                             error))
+        return "/dev/null/diag-%d.json" % len(self.bundles)
+
+    def log_service_event(self, kind, query_id, **fields):
+        self.events.append((kind, query_id, fields))
+
+
+def _handle(query_id, ident, status="RUNNING"):
+    return types.SimpleNamespace(query_id=query_id, status=status,
+                                 _worker_ident=ident)
+
+
+class TestWatchdog:
+    def test_fires_once_on_stalled_query(self):
+        svc = _FakeService()
+        wd = Watchdog(svc, interval_s=0.05, stall_s=1.0)
+        ident = threading.get_ident()
+        h = _handle("qS", ident)
+        svc.items = [("qS", h)]
+        flight.record(flight.EV_STATE, "running", query_id="qS")
+
+        t0 = 1_000_000
+        assert wd.poll_once(now_ns=t0) == []          # baseline observed
+        # half the window: quiet but not yet stalled
+        assert wd.poll_once(now_ns=t0 + int(0.5e9)) == []
+        # past the window with an unchanged ring count: fire
+        assert wd.poll_once(now_ns=t0 + int(1.5e9)) == ["qS"]
+        assert svc.bundles and svc.bundles[0][:2] == ("watchdog", "qS")
+        kind, qid, fields = svc.events[0]
+        assert (kind, qid) == ("watchdog", "qS")
+        assert fields["stalled_s"] >= 1.0
+        assert fields["diag_bundle"].endswith("diag-1.json")
+        # still stalled: at most one trigger per query
+        assert wd.poll_once(now_ns=t0 + int(9e9)) == []
+        st = wd.state()
+        assert st["triggers"] == 1
+        assert st["last_trigger"]["query_id"] == "qS"
+
+    def test_progress_resets_the_window(self):
+        svc = _FakeService()
+        wd = Watchdog(svc, interval_s=0.05, stall_s=1.0)
+        h = _handle("qP", threading.get_ident())
+        svc.items = [("qP", h)]
+        flight.record(flight.EV_STATE, "running", query_id="qP")
+        t0 = 1_000_000
+        wd.poll_once(now_ns=t0)
+        flight.record(flight.EV_KERNEL, "k", query_id="qP")   # progress
+        assert wd.poll_once(now_ns=t0 + int(2e9)) == []
+        # window restarts from the progress observation
+        assert wd.poll_once(now_ns=t0 + int(2.5e9)) == []
+        assert wd.poll_once(now_ns=t0 + int(3.5e9)) == ["qP"]
+
+    def test_finished_queries_are_pruned(self):
+        svc = _FakeService()
+        wd = Watchdog(svc, interval_s=0.05, stall_s=1.0)
+        h = _handle("qF", threading.get_ident())
+        svc.items = [("qF", h)]
+        flight.record(flight.EV_STATE, "running", query_id="qF")
+        wd.poll_once(now_ns=1_000_000)
+        assert wd.state()["watched"] == 1
+        svc.items = []                       # query left the inflight set
+        wd.poll_once(now_ns=2_000_000)
+        assert wd.state()["watched"] == 0
+
+    def test_non_running_handles_ignored(self):
+        svc = _FakeService()
+        wd = Watchdog(svc, interval_s=0.05, stall_s=1.0)
+        h = _handle("qQ", threading.get_ident(), status="QUEUED")
+        svc.items = [("qQ", h)]
+        wd.poll_once(now_ns=1_000_000)
+        assert wd.poll_once(now_ns=int(1e12)) == []
+        assert wd.state()["watched"] == 0
+
+    def test_daemon_lifecycle(self):
+        svc = _FakeService()
+        wd = Watchdog(svc, interval_s=0.05, stall_s=60.0)
+        assert not wd.running
+        wd.start()
+        try:
+            assert wd.running
+            assert wd.state()["enabled"]
+        finally:
+            wd.stop()
+        assert not wd.running
+
+
+# ---------------------------------------------------------------------------
+# bundles: collection, rotation, rendering
+# ---------------------------------------------------------------------------
+
+class TestBundles:
+    def test_collect_bundle_core_sections(self):
+        flight.record(flight.EV_OOM, "device_alloc", a=1, b=2,
+                      query_id="q9")
+        bundle = diagnostics.collect_bundle(
+            "oom", query_id="q9",
+            error=RuntimeError("RESOURCE_EXHAUSTED: boom"))
+        assert bundle["trigger"] == "oom"
+        assert bundle["error"]["type"] == "RuntimeError"
+        assert any(e["kind"] == flight.EV_OOM
+                   for e in bundle["flight"]["query_events"])
+        # every live thread's stack, this one included
+        names = {t.get("name") for t in bundle["threads"]}
+        assert threading.current_thread().name in names
+        assert "stats" in bundle["arena"]
+
+    def test_write_bundle_rotation(self, tmp_path):
+        d = str(tmp_path / "diag")
+        paths = []
+        for i in range(5):
+            paths.append(diagnostics.write_bundle(
+                {"trigger": "failed", "query_id": "q%d" % i}, d,
+                max_bundles=3))
+        names = sorted(os.listdir(d))
+        assert len(names) == 3
+        # newest survive, oldest rotated away
+        assert os.path.basename(paths[-1]) in names
+        assert os.path.basename(paths[0]) not in names
+        assert diagnose.list_bundles(d) == \
+            [os.path.join(d, n) for n in names]
+
+    def test_redaction(self):
+        conf = TpuConf({"spark.rapids.tpu.secret.apiKey": "hunter2",
+                        "spark.rapids.tpu.sql.enabled": True})
+        red = diagnostics.redacted_conf(conf)
+        assert red["spark.rapids.tpu.secret.apiKey"] == "***"
+        assert red["spark.rapids.tpu.sql.enabled"] is True
+
+    def test_diagnose_renders_and_cli(self, tmp_path, capsys):
+        flight.record(flight.EV_KERNEL, "gather", a=7, query_id="q1")
+        bundle = diagnostics.collect_bundle(
+            "failed", query_id="q1", error=ValueError("boom"))
+        path = diagnostics.write_bundle(bundle, str(tmp_path))
+        text = diagnose.render_bundle(bundle)
+        assert "incident bundle" in text and "boom" in text
+        assert "flight recorder" in text and "thread stacks" in text
+        assert diagnose.main([path]) == 0
+        assert "trigger=failed" in capsys.readouterr().out
+        assert diagnose.main(["--list", str(tmp_path)]) == 0
+        assert diagnose.main(["--list", str(tmp_path / "empty")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# service integration: the acceptance path
+# ---------------------------------------------------------------------------
+
+def _bundle_files(d):
+    return [os.path.join(d, n) for n in sorted(os.listdir(d))
+            if n.startswith("diag-") and n.endswith(".json")]
+
+
+class TestServiceBundles:
+    def _failing_df(self, s, noisy=32):
+        """A query whose UDF records plenty of flight events (inside the
+        worker's query context, so they attribute) and then OOMs —
+        every attempt fails, so the outcome is a device_oom failure."""
+        def _oom(series):
+            for _ in range(noisy):
+                flight.record(flight.EV_KERNEL, "doomed_kernel",
+                              a=len(series))
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected test OOM")
+        oom = pandas_udf(_oom, return_type=T.INT64)
+        return s.range(0, 64, num_partitions=2) \
+            .select(oom(F.col("id")).alias("id"))
+
+    def test_oom_failure_writes_bundle_with_flight_tail(self, tmp_path):
+        d = str(tmp_path / "diag")
+        log = str(tmp_path / "events.jsonl")
+        s = _tpu_session({
+            "spark.rapids.tpu.obs.diagnostics.dir": d,
+            "spark.rapids.tpu.eventLog.path": log,
+            "spark.rapids.tpu.service.retry.maxAttempts": 2,
+            "spark.rapids.tpu.service.retry.initialBackoffMs": 5})
+        # tracing stays disabled (the default): the flight recorder is
+        # the only always-on signal — exactly the acceptance scenario
+        with QueryService(s, num_workers=1) as svc:
+            h = svc.submit(self._failing_df(s), tenant="doomed")
+            with pytest.raises(RuntimeError):
+                h.result(timeout=120)
+        files = _bundle_files(d)
+        assert len(files) == 1 and "-oom.json" in files[0]
+        with open(files[0]) as f:
+            bundle = json.load(f)
+        assert bundle["trigger"] == "oom"
+        assert str(bundle["query_id"]) == str(h.query_id)
+        assert "RESOURCE_EXHAUSTED" in bundle["error"]["message"]
+        # >= the last 64 flight events for this query made the bundle
+        q_events = bundle["flight"]["query_events"]
+        assert len(q_events) >= 64
+        assert any(e["name"] == "doomed_kernel" for e in q_events)
+        assert any(e["kind"] == "retry" for e in q_events)
+        # every thread's stack + the arena map are in the artifact
+        assert bundle["threads"]
+        assert "stats" in bundle["arena"]
+        # the event-log failure record links the bundle (satellite a)
+        recs = read_event_log(log, events="failed")
+        mine = [r for r in recs if r["query_id"] == h.query_id]
+        assert mine and mine[0]["diag_bundle"] == files[0]
+        assert mine[0]["reason"] == "device_oom"
+        # tools/diagnose.py renders it
+        assert "doomed_kernel" in diagnose.render_bundle(bundle)
+
+    def test_deadline_kill_writes_bundle(self, tmp_path):
+        d = str(tmp_path / "diag")
+        log = str(tmp_path / "events.jsonl")
+        s = _tpu_session({
+            "spark.rapids.tpu.obs.diagnostics.dir": d,
+            "spark.rapids.tpu.eventLog.path": log})
+
+        def _slow(series):
+            time.sleep(0.05)
+            return series
+        slow = pandas_udf(_slow, return_type=T.INT64)
+        df = s.range(0, 64, num_partitions=2) \
+            .select(slow(F.col("id")).alias("id"))
+        with QueryService(s, num_workers=1) as svc:
+            h = svc.submit(df, tenant="dl", deadline_ms=40)
+            with pytest.raises(QueryCancelledError):
+                h.result(timeout=60)
+        files = _bundle_files(d)
+        assert len(files) == 1 and "-deadline.json" in files[0]
+        with open(files[0]) as f:
+            bundle = json.load(f)
+        assert bundle["trigger"] == "deadline"
+        assert bundle["cancel"]["reason"] == "deadline"
+        assert bundle["threads"]
+        recs = read_event_log(log, events="cancelled")
+        mine = [r for r in recs if r["query_id"] == h.query_id]
+        assert mine and mine[0]["diag_bundle"] == files[0]
+
+    def test_no_diag_dir_means_no_bundle(self, tmp_path):
+        log = str(tmp_path / "events.jsonl")
+        s = _tpu_session({
+            "spark.rapids.tpu.eventLog.path": log,
+            "spark.rapids.tpu.service.retry.maxAttempts": 1})
+        with QueryService(s, num_workers=1) as svc:
+            h = svc.submit(self._failing_df(s, noisy=1), tenant="t")
+            with pytest.raises(RuntimeError):
+                h.result(timeout=120)
+        recs = read_event_log(log, events="failed")
+        mine = [r for r in recs if r["query_id"] == h.query_id]
+        assert mine and mine[0]["diag_bundle"] is None
+
+    def test_stats_expose_watchdog_and_flight(self, tmp_path):
+        s = _tpu_session()
+        with QueryService(s, num_workers=1) as svc:
+            svc.submit(s.range(0, 16)).result(timeout=60)
+            snap = svc.stats().snapshot()
+            assert snap["flight_recorder"]["enabled"] is True
+            assert snap["flight_recorder"]["events_recorded"] > 0
+            wd = snap["watchdog"]
+            assert wd["enabled"] is True and wd["triggers"] == 0
+            assert svc.watchdog.running
+        assert not svc.watchdog.running     # stopped with the service
+
+    @pytest.mark.slow
+    def test_recorder_overhead_is_small(self):
+        """Loose, non-gating sanity bound on the always-on cost: the
+        same query batch with the recorder on vs off stays within a
+        generous ratio (scheduling noise dominates at this scale)."""
+        s = _tpu_session()
+        df = s.range(0, 20_000, num_partitions=4) \
+            .filter(F.col("id") % 3 == 0) \
+            .group_by((F.col("id") % 8).alias("k")) \
+            .agg(F.sum("id").alias("sv"))
+
+        def _run(n=6):
+            with QueryService(s, num_workers=2) as svc:
+                handles = [svc.submit(df) for _ in range(n)]
+                for h in handles:
+                    h.result(timeout=120)
+            t0 = time.perf_counter()
+            with QueryService(s, num_workers=2) as svc:
+                handles = [svc.submit(df) for _ in range(n)]
+                for h in handles:
+                    h.result(timeout=120)
+            return time.perf_counter() - t0
+
+        flight.disable()
+        try:
+            t_off = _run()
+        finally:
+            flight.enable()
+        t_on = _run()
+        assert t_on <= t_off * 2.0 + 0.25, (t_on, t_off)
