@@ -16,6 +16,23 @@ Float mode: the HEADLINE numbers are the DEFAULT configuration
 (variableFloatAgg off — exact-results parity with the reference's
 default).  The opt-in f32-accumulation fast path is reported in the
 secondary keys (variable_Mrows_s / variable_vs_baseline).
+
+History note (the apparent r04 -> r05 "drop"): BENCH_r04's headline
+value (32.15 Mrows/s) was measured in VARIABLE float mode — at r04 the
+exact path ran at 1.29 Mrows/s and the headline reported the fast
+path.  r05 switched the headline to the exact-mode default (17.63
+Mrows/s) while the variable number *improved* to 33.59.  So the
+32.2 -> 17.6 move is a headline *definition* change, not a regression:
+across the same interval exact-mode throughput went 1.29 -> 17.63 (13x)
+and variable-mode 32.15 -> 33.59.
+
+Pipeline split: since r06 the engine drains partitions morsel-parallel
+(spark.rapids.tpu.exec.pipeline.*, exec/pipeline.py).  The headline
+runs with the pipeline ON (parallelism/prefetch pinned to 4, like the
+batch-size tuning above — the auto default is min(4, cpu) and bench
+hosts vary); pipeline_off_Mrows_s re-measures exact mode with the
+pipeline disabled so each BENCH_r shows the on/off delta.  Output is
+bit-identical either way (tests/test_pipeline.py).
 """
 import json
 import sys
@@ -51,7 +68,8 @@ def build_df(session, n_rows: int, num_partitions: int):
 
 
 def run_engine(enabled: bool, n_rows: int, num_partitions: int,
-               repeats: int, variable_float: bool = True) -> float:
+               repeats: int, variable_float: bool = True,
+               pipeline: bool = True) -> float:
     from spark_rapids_tpu.api import TpuSession
     from spark_rapids_tpu.config import TpuConf
     # tuned like the reference's benchmark guides tune Spark: large
@@ -66,6 +84,12 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
         # the EXACT-mode number is measured separately and reported in
         # the same line as exact_vs_baseline)
         "spark.rapids.tpu.sql.variableFloatAgg.enabled": variable_float,
+        # morsel pipeline pinned (not auto) so the measurement does not
+        # depend on the bench host's core count; pipeline=False is the
+        # pipeline_off_Mrows_s measurement
+        "spark.rapids.tpu.exec.pipeline.enabled": pipeline,
+        "spark.rapids.tpu.exec.pipelineParallelism": 4,
+        "spark.rapids.tpu.exec.pipelinePrefetchDepth": 4,
     }))
     # build the query ONCE: the measurement is query execution over
     # loaded data (the reference's benchmark shape), not datagen/upload
@@ -92,6 +116,8 @@ def main():
     # chunk-lane / two-stage-u32 exact table path (exec/tpu_aggregate)
     tpu_exact_t = run_engine(True, n_rows, parts, repeats,
                              variable_float=False)
+    tpu_off_t = run_engine(True, n_rows, parts, repeats,
+                           variable_float=False, pipeline=False)
     tpu_var_t = run_engine(True, n_rows, parts, repeats,
                            variable_float=True)
     cpu_t = run_engine(False, n_rows, parts, repeats)
@@ -106,6 +132,10 @@ def main():
         "variable_vs_baseline": round(cpu_t / tpu_var_t, 3),
         "exact_Mrows_s": round(n_rows / tpu_exact_t / 1e6, 3),
         "exact_vs_baseline": round(cpu_t / tpu_exact_t, 3),
+        # exact mode with the morsel pipeline disabled: the on/off
+        # delta of intra-query pipelined drains (exec/pipeline.py)
+        "pipeline_off_Mrows_s": round(n_rows / tpu_off_t / 1e6, 3),
+        "pipeline_on_vs_off": round(tpu_off_t / tpu_exact_t, 3),
     }))
 
 

@@ -38,6 +38,9 @@ JAX_PLATFORMS=cpu python ci/service_smoke.py
 echo "== observability (trace JSON + prometheus + report) =="
 JAX_PLATFORMS=cpu python ci/obs_smoke.py
 
+echo "== morsel pipeline (parallel drains under stall watchdog) =="
+JAX_PLATFORMS=cpu python ci/pipeline_smoke.py
+
 echo "== api validation (docs vs live registry) =="
 python -m spark_rapids_tpu.tools.api_validation
 

@@ -7,8 +7,10 @@ device hot path accumulate and that cheap static analysis catches:
 ==========  =============================================================
 rule id     meaning
 ==========  =============================================================
-LOCK001     blocking call (socket I/O, ``time.sleep``, device syncs)
-            made while holding a lock
+LOCK001     blocking call (socket I/O, ``time.sleep``, device syncs,
+            queue-ish ``.get()`` receives) made while holding a lock;
+            the pipeline pool's intentional parked-worker queue waits
+            are allowlisted (``_LOCK001_QUEUE_GET_ALLOWLIST``)
 LOCK002     lock-acquisition-order inversion (cycle in the cross-file
             lock-order graph built from nested ``with <lock>`` regions)
 SYNC001     host-device synchronization (``jax.device_get``,
@@ -89,6 +91,23 @@ _BLOCKING_ATTRS = {
     "sendall", "recv", "recv_into", "accept", "connect", "connect_ex",
     "sleep", "block_until_ready", "device_get", "create_connection",
     "getaddrinfo", "asarray",
+}
+
+#: queue-style blocking receives (LOCK001): ``<queueish>.get()`` under a
+#: held lock parks every thread contending on that lock behind a
+#: producer that may itself need the lock.  Only receivers whose dotted
+#: name looks queue-ish are flagged — a plain dict ``.get(key)`` lookup
+#: is not blocking.
+_BLOCKING_QUEUE_ATTRS = {"get"}
+_QUEUE_RECV_RE = re.compile(r"queue|tasks|inbox|mailbox", re.IGNORECASE)
+
+#: files whose queue receives are intentional parked-worker waits — the
+#: pipeline pool's workers idle on their task queue by design and hold
+#: no engine lock while parked (exec/pipeline.py PipelinePool), so the
+#: queue-receive rule skips them wholesale instead of requiring a
+#: suppression on every park site (precedent: _SYNC_NP_FILE_ALLOWLIST)
+_LOCK001_QUEUE_GET_ALLOWLIST = {
+    "pipeline.py",
 }
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
@@ -302,6 +321,18 @@ class _FileLockAnalysis(ast.NodeVisitor):
                     f"{self._held[-1]} (held: "
                     f"{', '.join(self._held)}): a stalled peer/device "
                     f"parks every thread contending on that lock"))
+            elif attr in _BLOCKING_QUEUE_ATTRS and \
+                    isinstance(node.func, ast.Attribute) and \
+                    os.path.basename(self.path) not in \
+                    _LOCK001_QUEUE_GET_ALLOWLIST:
+                recv = _dotted(node.func.value)
+                if recv is not None and _QUEUE_RECV_RE.search(recv):
+                    self.findings.append(Finding(
+                        LOCK001, self.path, node.lineno,
+                        f"blocking queue receive '{recv}.{attr}()' "
+                        f"while holding lock {self._held[-1]}: the "
+                        f"producer that would satisfy the receive may "
+                        f"itself contend on that lock"))
         self.generic_visit(node)
 
 
@@ -618,10 +649,15 @@ def _scopes_for(rel: str) -> Set[str]:
     rel = rel.replace(os.sep, "/")
     scopes = {HYG001}
     parts = rel.split("/")
-    if any(p in ("service", "shuffle", "memory") for p in parts):
+    base = os.path.basename(rel)
+    if any(p in ("service", "shuffle", "memory") for p in parts) or \
+            base in ("pipeline.py", "exchange.py", "tpu_basic.py"):
+        # the morsel pipeline + the exec files it made concurrent
+        # (exchange build/materialize locks, scan-cache lock) carry the
+        # same lock discipline as the service/shuffle/memory layers
         scopes |= {LOCK001, LOCK002}
-    if "kernels" in parts or \
-            os.path.basename(rel).startswith("tpu_"):
+    if "kernels" in parts or base.startswith("tpu_") or \
+            base == "pipeline.py":
         scopes |= {SYNC001, OBS002}
     if "obs" in parts:
         scopes |= {HYG002}

@@ -238,12 +238,19 @@ class TpuSession:
         try:
             # drain all partitions first (device work + staged pulls),
             # then one fused flush serves every batch's counts/buffers
-            # (columnar/pending)
+            # (columnar/pending).  The drain is morsel-parallel
+            # (exec/pipeline.py): partitions are pulled + resolved on
+            # the pipeline pool, reassembled here in partition order —
+            # same items, same order as the serial loop it replaced
             from ..columnar.batch import resolve_speculative
-            items = [item if isinstance(item, pa.Table)
-                     else resolve_speculative(item)
-                     for part in phys.execute_checkpointed()
-                     for item in part]
+            from ..exec.pipeline import drain_parallel
+
+            def _resolve(item):
+                return item if isinstance(item, pa.Table) \
+                    else resolve_speculative(item)
+            items = [item for _pid, item in drain_parallel(
+                phys.execute_checkpointed(), sink=_resolve,
+                token=token, label="collect")]
             for item in items:
                 if not isinstance(item, pa.Table):
                     stage_batch(item)

@@ -463,6 +463,39 @@ OBS_DIAG_MAX_BUNDLES = conf_int(
     "spark.rapids.tpu.obs.diagnostics.maxBundles", 20,
     "Rotation bound on the diagnostics dir: after each write the "
     "oldest diag-*.json beyond this many are deleted")
+PIPELINE_ENABLED = conf_bool(
+    "spark.rapids.tpu.exec.pipeline.enabled", True,
+    "Morsel-parallel partition drains (exec/pipeline.py): the shuffle "
+    "map-side materialization, the broadcast build and the session "
+    "collect loop pull partition iterators on a bounded per-process "
+    "worker pool with per-partition prefetch, so host-side staging "
+    "(arrow conversion, partition-split prep, spill/unspill) overlaps "
+    "in-flight device compute.  Results are reassembled in "
+    "deterministic partition order, so output is bit-identical to the "
+    "serial drains.  Off = the pre-pipeline one-thread-per-query "
+    "behavior")
+PIPELINE_PARALLELISM = conf_int(
+    "spark.rapids.tpu.exec.pipelineParallelism", 0,
+    "Worker threads in the per-process pipeline pool (the bound on "
+    "concurrent partition pulls; the device itself is still gated by "
+    "sql.concurrentTpuTasks through the DeviceSemaphore, which "
+    "pipeline workers hold only around device dispatch).  0 = auto: "
+    "min(4, cpu count).  1 degenerates every drain to the serial path")
+PIPELINE_PREFETCH_DEPTH = conf_int(
+    "spark.rapids.tpu.exec.pipelinePrefetchDepth", 2,
+    "Batches each pipeline worker may buffer ahead of the consumer per "
+    "partition; past it the producer parks until the consumer catches "
+    "up (per-partition backpressure on top of the global "
+    "pipelineBufferBytes budget)")
+PIPELINE_BUFFER_BYTES = conf_bytes(
+    "spark.rapids.tpu.exec.pipelineBufferBytes", 1 << 30,
+    "Per-drain byte budget for buffered prefetched batches "
+    "(backpressure: producers park past it, except the head partition "
+    "when it has nothing queued — the liveness bypass that keeps the "
+    "budget deadlock-free).  Spill-aware: at drain start the budget is "
+    "additionally capped at half the free device tier, so prefetch "
+    "never plans to out-buffer what the arena could hold without "
+    "forced spilling")
 
 
 class TpuConf:

@@ -10,12 +10,14 @@ partitions then stream from the catalog through the transport SPI.
 """
 from __future__ import annotations
 
+import threading
 from typing import List, Optional
 
 from ..columnar.batch import ColumnarBatch, concat_batches
 from ..shuffle.manager import ShuffleManager
 from ..shuffle.partitioners import Partitioner, RangePartitioner
 from .base import PhysicalPlan, PARTITION_TIME, NUM_OUTPUT_ROWS, timed
+from .pipeline import drain_parallel
 from .tpu_basic import TpuExec
 
 
@@ -41,6 +43,10 @@ class TpuShuffleExchange(TpuExec):
         super().__init__(child)
         self.partitioner = partitioner
         self._shuffle_id: Optional[int] = None
+        # parallel reduce pulls (pipelined drains) race to trigger the
+        # map stage; the barrier must run exactly once
+        self._mat_lock = threading.Lock()
+        self._materialized = False
         # distributed mode (executor-process split): set by
         # attach_distributed; None = in-process ShuffleManager
         self._dist_ctx = None
@@ -127,26 +133,46 @@ class TpuShuffleExchange(TpuExec):
                 mgr.append_map_output(self._shuffle_id, map_id,
                                       per_reduce)
 
-        for map_id, part in enumerate(in_parts):
-            for batch in part:
-                with timed(self.metrics[PARTITION_TIME], self):
-                    staged.append(
-                        (map_id, batch,
-                         self.partitioner.split_staged(batch)))
-                staged_bytes += 2 * batch.nbytes()
-                if staged_bytes > budget:
-                    finalize_staged()
+        def split_one(batch):
+            # runs on pipeline producers (under the DeviceSemaphore):
+            # the split's device dispatch + host prep for one map batch
+            # overlaps the splits of other partitions in flight
+            with timed(self.metrics[PARTITION_TIME], self):
+                return batch, self.partitioner.split_staged(batch)
+
+        # morsel-parallel map drain (exec/pipeline.py): partitions are
+        # pulled + split concurrently, but arrive here in deterministic
+        # (map_id, batch) order, so staging/flush boundaries — and the
+        # map output — are identical to the serial drain's
+        for map_id, (batch, split) in drain_parallel(
+                in_parts, sink=split_one, label="shuffle_map"):
+            staged.append((map_id, batch, split))
+            staged_bytes += 2 * batch.nbytes()
+            if staged_bytes > budget:
+                finalize_staged()
         finalize_staged()
 
     def ensure_materialized(self):
-        """Run the map side once (the AQE stage-materialization barrier)."""
-        if self._shuffle_id is None:
+        """Run the map side once (the AQE stage-materialization barrier).
+
+        Double-checked lock: concurrent reduce pulls (the pipelined
+        collect drains partitions in parallel) must not double-run the
+        map stage; losers block until the winner's outputs are fully
+        registered.  ``_materialized`` is set only after the drain
+        completes — ``_shuffle_id`` alone is assigned early inside
+        ``_materialize_map_side`` and would leak a half-built stage."""
+        if self._materialized:
+            return
+        with self._mat_lock:
+            if self._materialized:
+                return
             if self._dist_ctx is not None and not self._dist_run_map:
                 # the map stage ran in another executor process; its
                 # outputs are registered in the shared tracker
                 self._shuffle_id = self._dist_shuffle_id
-                return
-            self._materialize_map_side()
+            else:
+                self._materialize_map_side()
+            self._materialized = True
 
     def partition_stats(self):
         """Per-reduce-partition (bytes, rows) from the materialized map
@@ -213,6 +239,10 @@ class TpuBroadcastExchange(TpuExec):
     def __init__(self, child: PhysicalPlan):
         super().__init__(child)
         self._result: Optional[ColumnarBatch] = None
+        # concurrent probes (pipelined drains pull both join sides in
+        # parallel) must build once; losers block until the winner
+        # publishes — the double-checked lock below
+        self._build_lock = threading.Lock()
 
     @property
     def output_schema(self):
@@ -224,15 +254,20 @@ class TpuBroadcastExchange(TpuExec):
     def broadcast_batch(self) -> ColumnarBatch:
         from ..columnar.batch import resolve_speculative
         from ..service.cancellation import cancel_checkpoint
-        if self._result is None:
+        if self._result is not None:
+            return self._result
+        with self._build_lock:
+            if self._result is not None:
+                return self._result
             # the build side materializes in full before the first probe
             # batch: checkpoint per pulled batch so cancellation can
-            # unwind the drain
+            # unwind the drain; the pull itself is a (possibly nested)
+            # morsel-parallel drain
             raw = []
-            for p in self.children[0].execute():
-                for b in p:
-                    cancel_checkpoint()
-                    raw.append(b)
+            for _pid, b in drain_parallel(self.children[0].execute(),
+                                          label="broadcast_build"):
+                cancel_checkpoint()
+                raw.append(b)
             if len(raw) == 1:
                 # single-batch build side (the dominant dimension-table
                 # shape): pass through WITHOUT forcing the host count —

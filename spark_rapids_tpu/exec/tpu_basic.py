@@ -7,6 +7,7 @@ GpuCoalesceBatches (GpuCoalesceBatches.scala:195).
 """
 from __future__ import annotations
 
+import threading
 from typing import Iterator, List, Optional
 
 import numpy as np
@@ -49,17 +50,25 @@ class TpuLocalScan(TpuExec):
     # so uploaded batches are kept device-resident per source table —
     # a small LRU so HBM stays bounded.
     _DEVICE_CACHE: "OrderedDict" = None
+    # concurrent scans (pipelined drains + concurrent service queries)
+    # mutate the class-level LRU; all get/move_to_end/set/evict steps
+    # run under this lock.  The upload loop below stays OUTSIDE it:
+    # from_arrow only dispatches (lazy device upload, no blocking), but
+    # serializing uploads under a class-wide lock would still defeat
+    # the pipeline's overlap — only the dict ops need the lock.
+    _DEVICE_CACHE_LOCK = threading.Lock()
 
     def _cached_batches(self):
         from collections import OrderedDict
         cls = TpuLocalScan
-        if cls._DEVICE_CACHE is None:
-            cls._DEVICE_CACHE = OrderedDict()
         key = (id(self.table), self.num_partitions, self.batch_rows)
-        hit = cls._DEVICE_CACHE.get(key)
-        if hit is not None and hit[0] is self.table:
-            cls._DEVICE_CACHE.move_to_end(key)
-            return hit[1]
+        with cls._DEVICE_CACHE_LOCK:
+            if cls._DEVICE_CACHE is None:
+                cls._DEVICE_CACHE = OrderedDict()
+            hit = cls._DEVICE_CACHE.get(key)
+            if hit is not None and hit[0] is self.table:
+                cls._DEVICE_CACHE.move_to_end(key)
+                return hit[1]
         n = self.table.num_rows
         per = -(-n // self.num_partitions) if n else 0
         parts = []
@@ -75,9 +84,10 @@ class TpuLocalScan(TpuExec):
             if lo == hi and lo == 0 and self.num_partitions == 1:
                 batches.append(from_arrow(self.table.slice(0, 0)))
             parts.append(batches)
-        cls._DEVICE_CACHE[key] = (self.table, parts)
-        while len(cls._DEVICE_CACHE) > 8:
-            cls._DEVICE_CACHE.popitem(last=False)
+        with cls._DEVICE_CACHE_LOCK:
+            cls._DEVICE_CACHE[key] = (self.table, parts)
+            while len(cls._DEVICE_CACHE) > 8:
+                cls._DEVICE_CACHE.popitem(last=False)
         return parts
 
     def execute(self):

@@ -53,6 +53,9 @@ EV_SHUFFLE = "shuffle"        # shuffle fetch/transfer progress (a=bytes)
 EV_STATE = "state"            # service admission transition (name=state)
 EV_OOM = "oom"                # device allocation failure observed
 EV_WATCHDOG = "watchdog"      # stall watchdog fired (name=query_id)
+EV_PIPELINE = "pipeline"      # morsel-pipeline drain progress
+#                               (name=stage constant, a=partition/count,
+#                                b=bytes or permille ratio)
 
 #: module fast-path flag — read directly by ``record()``; the recorder
 #: is ON by default (that is the point of a flight recorder).

@@ -307,6 +307,43 @@ SHUFFLE_WRITE_BYTES = SHUFFLE_BYTES.labels(direction="write")
 SHUFFLE_READ_BYTES = SHUFFLE_BYTES.labels(direction="read")
 
 
+def _pipeline_mod():
+    from ..exec import pipeline
+    return pipeline
+
+
+PIPELINE_QUEUE_DEPTH = _REGISTRY.gauge(
+    "tpu_pipeline_queue_depth",
+    "Prefetched batches buffered across all live morsel-pipeline drains",
+    fn=lambda: _pipeline_mod().buffered_items())
+PIPELINE_BUFFERED_BYTES = _REGISTRY.gauge(
+    "tpu_pipeline_buffered_bytes",
+    "Bytes of prefetched batches buffered across all live pipeline "
+    "drains (bounded by exec.pipelineBufferBytes per drain)",
+    fn=lambda: _pipeline_mod().buffered_bytes())
+PIPELINE_WORKERS_BUSY = _REGISTRY.gauge(
+    "tpu_pipeline_workers_busy",
+    "Pipeline-pool workers currently serving a drain",
+    fn=lambda: _pipeline_mod().busy_workers())
+PIPELINE_WORKER_BUSY_SECONDS = _REGISTRY.histogram(
+    "tpu_pipeline_worker_busy_seconds",
+    "Per-batch produce time on pipeline producers (partition pull + "
+    "sink, device dispatch under the semaphore)")
+PIPELINE_OVERLAP_RATIO = _REGISTRY.gauge(
+    "tpu_pipeline_overlap_ratio",
+    "Summed produce time / wall time of the last finished parallel "
+    "drain (>1 means host staging overlapped device compute)")
+PIPELINE_BATCHES = _REGISTRY.counter(
+    "tpu_pipeline_batches_total",
+    "Batches produced through drain_parallel, by producer "
+    "(worker = pool thread, inline = consumer-assist)",
+    labels=("source",))
+PIPELINE_DRAINS = _REGISTRY.counter(
+    "tpu_pipeline_drains_total",
+    "drain_parallel invocations by mode (parallel vs serial fallback)",
+    labels=("mode",))
+
+
 def compile_cache_event(cache: str, hit: bool):
     """One compile-cache lookup (called from the exec/kernels JIT
     caches; compile paths, not per-batch hot paths)."""

@@ -29,6 +29,24 @@ from typing import Dict, Optional
 from . import flight as _flight
 
 
+def _pipeline_progress(counts: Dict[int, int],
+                       query_id: str) -> int:
+    """Flight progress of pipeline-pool workers currently serving
+    ``query_id``.  A pipelined query's service worker spends most of
+    its time blocked in the drain consumer (recording little), while
+    the pool workers it fanned out to record the actual pulls — their
+    counts are the query's heartbeat.  A genuinely wedged query still
+    fires: parked pipeline workers stop advancing too."""
+    try:
+        from ..exec.pipeline import worker_idents
+    except Exception:
+        return 0
+    total = 0
+    for ident in worker_idents(query_id):
+        total += counts.get(ident, 0)
+    return total
+
+
 class Watchdog:
     """Daemon polling flight-recorder progress of inflight queries.
 
@@ -105,6 +123,10 @@ class Watchdog:
                 count = counts.get(ident)
                 if count is None:
                     continue
+                # fold in the pipeline workers' rings: any change in
+                # the aggregate (new events, or the worker set itself
+                # turning over) is progress for the owning query
+                count += _pipeline_progress(counts, query_id)
                 prev = self._progress.get(query_id)
                 if prev is None or prev[0] != count:
                     self._progress[query_id] = (count, now)

@@ -44,6 +44,16 @@ QUEUED, RUNNING, DONE, FAILED, CANCELLED = (
     "QUEUED", "RUNNING", "DONE", "FAILED", "CANCELLED")
 
 
+def _pipeline_stats() -> Dict:
+    """Pipeline-pool occupancy for ``stats().snapshot()`` (lazy import:
+    the service must not pull exec/ at module load)."""
+    try:
+        from ..exec.pipeline import pool_stats
+        return pool_stats()
+    except Exception:
+        return {}
+
+
 class QueryHandle:
     """Client-side future for one submitted query."""
 
@@ -149,6 +159,7 @@ class QueryService:
         self._stats.set_extras(lambda: {
             "watchdog": self.watchdog.state(),
             "flight_recorder": _flight.occupancy(),
+            "pipeline": _pipeline_stats(),
         })
 
     # -- lifecycle ---------------------------------------------------------

@@ -1,0 +1,400 @@
+"""Morsel-parallel drain tests (exec/pipeline.py).
+
+Three surfaces:
+
+1. ``drain_parallel`` unit contract on synthetic iterators — order
+   preservation, sink placement, backpressure liveness (byte-budget
+   head bypass), error propagation + pool recovery, nesting
+   (consumer-assist), cancellation unwind, watchdog ident attribution.
+2. Engine determinism — the SAME query under pipeline parallelism
+   {1, 4} x prefetch {1, 4} must produce BIT-IDENTICAL output (the
+   drain reorders work across threads, never results): the bench-shape
+   query hashed over its arrow IPC stream, plus TPC-DS q3/q42 row-list
+   equality.
+3. The thread-safety satellites the pipeline forced: concurrent
+   broadcast probes build once; the scan device cache survives
+   concurrent executes; the lint queue-receive rule fires elsewhere
+   but allowlists pipeline.py's intentional pool park.
+"""
+import hashlib
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+import tpcds  # noqa: E402
+
+from harness import with_tpu_session  # noqa: E402
+
+from spark_rapids_tpu.analysis import lint as AL
+from spark_rapids_tpu.exec import pipeline as P
+from spark_rapids_tpu.exec.exchange import TpuBroadcastExchange
+from spark_rapids_tpu.exec.tpu_basic import TpuLocalScan
+from spark_rapids_tpu.memory.arena import DeviceManager
+from spark_rapids_tpu.service.cancellation import CancelToken, query_context
+from spark_rapids_tpu.service.errors import QueryCancelledError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pipe_conf(par, depth):
+    return {"spark.rapids.tpu.exec.pipelineParallelism": par,
+            "spark.rapids.tpu.exec.pipelinePrefetchDepth": depth}
+
+
+def _wait_until(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# drain_parallel unit contract (synthetic iterators, no session)
+# ---------------------------------------------------------------------------
+
+class TestDrainParallel:
+    def test_order_preserved_and_sink_applied(self):
+        parts = [iter([(p, i) for i in range(5)]) for p in range(6)]
+        out = list(P.drain_parallel(parts, sink=lambda t: t + ("s",),
+                                    parallelism=4, prefetch_depth=2,
+                                    label="order"))
+        assert out == [(p, (p, i, "s"))
+                       for p in range(6) for i in range(5)]
+
+    def test_serial_and_pipelined_agree(self):
+        def make():
+            return [iter(range(p, p + 3)) for p in range(5)]
+        serial = list(P.drain_parallel(make(), parallelism=1))
+        pipelined = list(P.drain_parallel(make(), parallelism=4,
+                                          prefetch_depth=3))
+        assert serial == pipelined
+        assert serial == [(p, v) for p in range(5)
+                          for v in range(p, p + 3)]
+
+    def test_single_partition_stays_serial(self):
+        # one partition cannot overlap: the drain must degrade to the
+        # plain loop (no pool dispatch, pure generator)
+        out = list(P.drain_parallel([iter([1, 2, 3])], parallelism=8))
+        assert out == [(0, 1), (0, 2), (0, 3)]
+
+    def test_byte_budget_head_bypass_liveness(self):
+        # a 1-byte budget is saturated by ANY buffered item; without
+        # the head-partition bypass the drain would deadlock — with it,
+        # the head's producer may always stage the one item the
+        # consumer needs next
+        class _Sized:
+            def __init__(self, v):
+                self.v = v
+                self.nbytes = 1 << 20
+
+        parts = [iter([_Sized((p, i)) for i in range(4)])
+                 for p in range(4)]
+        out = list(P.drain_parallel(parts, parallelism=4,
+                                    prefetch_depth=4, byte_budget=1,
+                                    label="budget"))
+        assert [(pid, item.v) for pid, item in out] == \
+            [(p, (p, i)) for p in range(4) for i in range(4)]
+
+    def test_producer_error_propagates_and_pool_recovers(self):
+        def bad():
+            yield 1
+            raise ValueError("boom")
+
+        parts = [iter(range(3)), bad(), iter(range(3))]
+        with pytest.raises(ValueError, match="boom"):
+            list(P.drain_parallel(parts, parallelism=3,
+                                  prefetch_depth=2, label="err"))
+        # a failed drain must not wedge the pool: the next drain works
+        out = list(P.drain_parallel([iter([0, 1]), iter([2, 3])],
+                                    parallelism=2, label="after-err"))
+        assert out == [(0, 0), (0, 1), (1, 2), (1, 3)]
+        assert _wait_until(lambda: P.busy_workers() == 0)
+
+    def test_nested_drain_makes_progress(self):
+        # a sink that itself drains (collect pull -> shuffle
+        # materialization nesting): consumer-assist keeps the inner
+        # drain live even when every pool worker is busy outside
+        def sink(x):
+            inner = [iter([x * 10]), iter([x * 10 + 1])]
+            return [v for _pid, v in P.drain_parallel(
+                inner, parallelism=2, prefetch_depth=1, label="inner")]
+
+        parts = [iter([1, 2]), iter([3]), iter([4, 5])]
+        out = list(P.drain_parallel(parts, sink=sink, parallelism=3,
+                                    prefetch_depth=2, label="outer"))
+        assert out == [(0, [10, 11]), (0, [20, 21]), (1, [30, 31]),
+                       (2, [40, 41]), (2, [50, 51])]
+
+    def test_cancellation_unwinds_workers_and_semaphore(self):
+        sem = DeviceManager.get().semaphore
+        token = CancelToken(query_id="pipe-cancel")
+
+        def slow(pid):
+            for i in range(50):
+                time.sleep(0.02)
+                yield (pid, i)
+
+        parts = [slow(p) for p in range(4)]
+        got = []
+        with query_context(token):
+            with pytest.raises(QueryCancelledError):
+                for out in P.drain_parallel(parts, parallelism=4,
+                                            prefetch_depth=1,
+                                            token=token, label="cancel"):
+                    got.append(out)
+                    if len(got) == 2:
+                        token.cancel("test-cancel")
+        # workers unwind (deregister) and every permit they held — or
+        # were waiting on — is returned to the device semaphore
+        assert _wait_until(lambda: P.busy_workers() == 0)
+        assert _wait_until(lambda: sem.available() == sem.permits)
+
+    def test_worker_idents_attributed_to_query(self):
+        # the stall watchdog folds pipeline-worker progress into the
+        # owning query via worker_idents(query_id): during a drain the
+        # serving pool workers must be registered under the token's id
+        token = CancelToken(query_id="pipe-wid")
+        started = threading.Event()
+        release = threading.Event()
+
+        def part(pid):
+            started.set()
+            release.wait(30)
+            yield pid
+
+        parts = [part(p) for p in range(4)]
+        results, errs = [], []
+
+        def consume():
+            try:
+                with query_context(token):
+                    for out in P.drain_parallel(parts, parallelism=4,
+                                                prefetch_depth=1,
+                                                token=token,
+                                                label="wid"):
+                        results.append(out)
+            except BaseException as e:  # pragma: no cover - diagnostic
+                errs.append(e)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        try:
+            assert started.wait(15)
+            # registration happens at pool-worker entry (before any
+            # semaphore wait), so at least the non-consumer claimers
+            # show up under the query id while the drain is in flight
+            assert _wait_until(
+                lambda: len(P.worker_idents("pipe-wid")) >= 1)
+        finally:
+            release.set()
+        t.join(30)
+        assert not errs
+        assert results == [(p, p) for p in range(4)]
+        # ...and the registration is scoped to the drain
+        assert _wait_until(lambda: P.worker_idents("pipe-wid") == [])
+
+    def test_resolve_parallelism_conf(self):
+        from spark_rapids_tpu.config import TpuConf
+        assert P.resolve_parallelism(TpuConf(
+            {"spark.rapids.tpu.exec.pipeline.enabled": False})) == 1
+        assert P.resolve_parallelism(TpuConf(
+            {"spark.rapids.tpu.exec.pipelineParallelism": 7})) == 7
+        # 0 = auto: min(4, cpu count)
+        assert 1 <= P.resolve_parallelism(TpuConf({})) <= 4
+
+    def test_pool_stats_shape(self):
+        stats = P.pool_stats()
+        for key in ("threads", "queued", "busy", "live_drains",
+                    "buffered_items", "buffered_bytes"):
+            assert key in stats
+
+
+# ---------------------------------------------------------------------------
+# determinism: bit-identical output across parallelism settings
+# ---------------------------------------------------------------------------
+
+def _bench_shape_df(s, n_rows=60_000, parts=4):
+    """The bench.py query shape (filter -> project -> agg -> join) at
+    test scale."""
+    from spark_rapids_tpu.api import functions as F
+    rng = np.random.default_rng(7)
+    df = s.create_dataframe({
+        "k": rng.integers(0, 1000, n_rows).astype(np.int64),
+        "a": rng.integers(-100_000, 100_000, n_rows).astype(np.int64),
+        "x": rng.random(n_rows),
+        "y": rng.random(n_rows),
+    }, num_partitions=parts)
+    dim = s.create_dataframe({
+        "dk": np.arange(1000, dtype=np.int64),
+        "w": rng.random(1000),
+    }, num_partitions=1)
+    agg = (df.filter((F.col("x") > 0.1) & (F.col("a") % 7 != 0))
+             .with_column("z", F.col("x") * F.col("y") + F.col("a"))
+             .group_by("k")
+             .agg(F.sum("z").alias("sz"), F.count().alias("c"),
+                  F.max("x").alias("mx")))
+    return (agg.join(dim, agg["k"] == dim["dk"], "inner")
+               .select(F.col("k"), F.col("sz"), F.col("c"),
+                       (F.col("mx") * F.col("w")).alias("mw")))
+
+
+def _ipc_hash(table: pa.Table) -> str:
+    table = table.combine_chunks()
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return hashlib.sha256(sink.getvalue().to_pybytes()).hexdigest()
+
+
+def test_bench_shape_bit_identical_across_parallelism():
+    hashes = {}
+    for par in (1, 4):
+        for depth in (1, 4):
+            tbl = with_tpu_session(
+                lambda s: _bench_shape_df(s).to_arrow(),
+                _pipe_conf(par, depth))
+            hashes[(par, depth)] = _ipc_hash(tbl)
+    assert len(set(hashes.values())) == 1, hashes
+
+
+def test_pipeline_disabled_bit_identical():
+    on = with_tpu_session(lambda s: _bench_shape_df(s).to_arrow(),
+                          _pipe_conf(4, 4))
+    off = with_tpu_session(
+        lambda s: _bench_shape_df(s).to_arrow(),
+        {"spark.rapids.tpu.exec.pipeline.enabled": False})
+    assert _ipc_hash(on) == _ipc_hash(off)
+
+
+@pytest.fixture(scope="module")
+def tpcds_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpcds_pipe") / "sf")
+    tpcds.generate(d, scale=0.002, seed=11)
+    return d
+
+
+@pytest.mark.parametrize("query", ["q3", "q42"])
+def test_tpcds_identical_across_parallelism(tpcds_dir, query):
+    def run(conf):
+        def fn(s):
+            tpcds.register(s, tpcds_dir)
+            return s.sql(tpcds.QUERIES[query]).collect()
+        return with_tpu_session(fn, conf)
+
+    serial_rows = run(_pipe_conf(1, 1))
+    parallel_rows = run(_pipe_conf(4, 4))
+    # exact row-for-row equality INCLUDING order: the pipelined drain
+    # must not even reorder rows relative to the serial drain
+    assert serial_rows == parallel_rows
+
+
+# ---------------------------------------------------------------------------
+# thread-safety satellites: broadcast build, scan device cache
+# ---------------------------------------------------------------------------
+
+def test_broadcast_builds_once_under_concurrent_probes():
+    tbl = pa.table({"a": pa.array(range(64), pa.int64()),
+                    "b": pa.array([float(i) for i in range(64)],
+                                  pa.float64())})
+    scan = TpuLocalScan(tbl, num_partitions=4)
+    calls = []
+    orig_execute = scan.execute
+    scan.execute = lambda: (calls.append(1), orig_execute())[1]
+    bx = TpuBroadcastExchange(scan)
+
+    barrier = threading.Barrier(2)
+    out = [None, None]
+    errs = []
+
+    def probe(i):
+        try:
+            barrier.wait(10)
+            out[i] = bx.broadcast_batch()
+        except BaseException as e:  # pragma: no cover - diagnostic
+            errs.append(e)
+
+    ts = [threading.Thread(target=probe, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs
+    # the double-checked lock: one build, both probes share the result
+    assert len(calls) == 1
+    assert out[0] is not None and out[0] is out[1]
+    assert out[0].num_rows == 64
+
+
+def test_scan_device_cache_concurrent_executes():
+    tbl = pa.table({"a": pa.array(range(1000), pa.int64())})
+    totals, errs = [], []
+
+    def run():
+        try:
+            scan = TpuLocalScan(tbl, num_partitions=2)
+            n = 0
+            for part in scan.execute():
+                for b in part:
+                    n += b.num_rows
+            totals.append(n)
+        except BaseException as e:  # pragma: no cover - diagnostic
+            errs.append(e)
+
+    ts = [threading.Thread(target=run) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs
+    assert totals == [1000] * 4
+
+
+# ---------------------------------------------------------------------------
+# lint: the queue-receive rule and pipeline.py's allowlisted park
+# ---------------------------------------------------------------------------
+
+_QUEUE_GET_SRC = ("import threading, queue\n"
+                  "_lock = threading.Lock()\n"
+                  "_tasks = queue.SimpleQueue()\n"
+                  "def f():\n"
+                  "    with _lock:\n"
+                  "        return _tasks.get()\n")
+
+
+class TestPipelineLint:
+    def test_queue_get_under_lock_flagged(self):
+        fs = AL.lint_source(_QUEUE_GET_SRC, "service/worker.py",
+                            scopes={AL.LOCK001})
+        assert any(f.rule == AL.LOCK001 and "queue receive" in f.message
+                   for f in fs)
+
+    def test_queue_get_without_lock_clean(self):
+        src = ("import queue\n"
+               "_tasks = queue.SimpleQueue()\n"
+               "def f():\n"
+               "    return _tasks.get()\n")
+        assert AL.lint_source(src, "service/worker.py",
+                              scopes={AL.LOCK001}) == []
+
+    def test_pipeline_pool_park_allowlisted(self):
+        fs = AL.lint_source(_QUEUE_GET_SRC,
+                            "spark_rapids_tpu/exec/pipeline.py",
+                            scopes={AL.LOCK001})
+        assert fs == []
+
+    def test_pipeline_module_clean_under_project_scopes(self):
+        rel = "spark_rapids_tpu/exec/pipeline.py"
+        with open(os.path.join(REPO_ROOT, rel)) as f:
+            src = f.read()
+        assert AL.lint_source(src, rel,
+                              scopes=AL._scopes_for(rel)) == []
