@@ -160,19 +160,30 @@ class TpuShuffleExchange(TpuExec):
         map stage; losers block until the winner's outputs are fully
         registered.  ``_materialized`` is set only after the drain
         completes — ``_shuffle_id`` alone is assigned early inside
-        ``_materialize_map_side`` and would leak a half-built stage."""
+        ``_materialize_map_side`` and would leak a half-built stage.
+
+        The whole barrier runs with the calling thread's device permits
+        dropped (``sem.released()``): a reduce pull reaches here from
+        inside a pipeline producer's permit-held dispatch region, and
+        pinning that permit while a loser parks on ``_mat_lock`` — or
+        while the winner runs the entire map-side drain, which acquires
+        permits of its own — starves concurrent queries and can
+        deadlock the nested drain's pool workers behind it.  Permits
+        are reacquired to the same depth before returning to the pull."""
         if self._materialized:
             return
-        with self._mat_lock:
-            if self._materialized:
-                return
-            if self._dist_ctx is not None and not self._dist_run_map:
-                # the map stage ran in another executor process; its
-                # outputs are registered in the shared tracker
-                self._shuffle_id = self._dist_shuffle_id
-            else:
-                self._materialize_map_side()
-            self._materialized = True
+        from ..memory.arena import DeviceManager
+        with DeviceManager.get().semaphore.released():
+            with self._mat_lock:
+                if self._materialized:
+                    return
+                if self._dist_ctx is not None and not self._dist_run_map:
+                    # the map stage ran in another executor process; its
+                    # outputs are registered in the shared tracker
+                    self._shuffle_id = self._dist_shuffle_id
+                else:
+                    self._materialize_map_side()
+                self._materialized = True
 
     def partition_stats(self):
         """Per-reduce-partition (bytes, rows) from the materialized map
@@ -253,34 +264,41 @@ class TpuBroadcastExchange(TpuExec):
 
     def broadcast_batch(self) -> ColumnarBatch:
         from ..columnar.batch import resolve_speculative
+        from ..memory.arena import DeviceManager
         from ..service.cancellation import cancel_checkpoint
         if self._result is not None:
             return self._result
-        with self._build_lock:
-            if self._result is not None:
-                return self._result
-            # the build side materializes in full before the first probe
-            # batch: checkpoint per pulled batch so cancellation can
-            # unwind the drain; the pull itself is a (possibly nested)
-            # morsel-parallel drain
-            raw = []
-            for _pid, b in drain_parallel(self.children[0].execute(),
-                                          label="broadcast_build"):
-                cancel_checkpoint()
-                raw.append(b)
-            if len(raw) == 1:
-                # single-batch build side (the dominant dimension-table
-                # shape): pass through WITHOUT forcing the host count —
-                # consumers key off device counts (canon rank words mask
-                # dead rows) and resolve any speculative flag at their
-                # own flush barrier, so the broadcast costs zero round
-                # trips here
-                self._result = raw[0]
-            else:
-                batches = [resolve_speculative(b) for b in raw]
-                batches = [b for b in batches if b.num_rows > 0]
-                self._result = concat_batches(batches) if batches else \
-                    ColumnarBatch.empty(self.output_schema)
+        # probes reach this barrier from inside a pipeline producer's
+        # permit-held pull region; the build (and the loser park on
+        # _build_lock) runs with those permits dropped — same deadlock/
+        # starvation rationale as ensure_materialized — and reacquires
+        # them before the probe resumes
+        with DeviceManager.get().semaphore.released():
+            with self._build_lock:
+                if self._result is not None:
+                    return self._result
+                # the build side materializes in full before the first
+                # probe batch: checkpoint per pulled batch so
+                # cancellation can unwind the drain; the pull itself is
+                # a (possibly nested) morsel-parallel drain
+                raw = []
+                for _pid, b in drain_parallel(self.children[0].execute(),
+                                              label="broadcast_build"):
+                    cancel_checkpoint()
+                    raw.append(b)
+                if len(raw) == 1:
+                    # single-batch build side (the dominant dimension-
+                    # table shape): pass through WITHOUT forcing the
+                    # host count — consumers key off device counts
+                    # (canon rank words mask dead rows) and resolve any
+                    # speculative flag at their own flush barrier, so
+                    # the broadcast costs zero round trips here
+                    self._result = raw[0]
+                else:
+                    batches = [resolve_speculative(b) for b in raw]
+                    batches = [b for b in batches if b.num_rows > 0]
+                    self._result = concat_batches(batches) if batches \
+                        else ColumnarBatch.empty(self.output_schema)
         return self._result
 
     def execute(self):

@@ -32,13 +32,19 @@ api/session.py).  Contract:
 - **semaphore discipline** — workers hold the DeviceSemaphore only
   around the pull + sink (the device-dispatch region), release between
   items, ``release_all()`` on exit, and attribute their blocked-wait
-  time to the owning query's token (``sem_wait_ms``).
+  time to the owning query's token (``sem_wait_ms``).  A pool worker
+  never parks on the semaphore unboundedly: past ``_SEM_TRY_S`` it
+  hands its partition back (``_UNSTARTED``) and moves on, so a claimed
+  partition cannot wedge behind permits pinned elsewhere.
 - **liveness under nesting** — pool workers themselves may hit a nested
   drain (a collect pull forces a shuffle materialization).  The
   consumer never depends on the pool: when it reaches a partition no
   worker has claimed, it produces that partition inline
   (consumer-assist), so an exhausted pool degrades to the serial drain
-  instead of deadlocking.
+  instead of deadlocking.  The permit handback above keeps this true
+  even when IDLE workers grab a nested drain's partitions while every
+  permit is pinned by the outer drain: they time out, hand back, and
+  the nested consumer (holding its permit re-entrantly) assists.
 - **cancellation** — producers and the consumer run cooperative cancel
   checkpoints; a mid-drain cancel (or any producer error) fails the
   drain once, wakes everybody, and the workers unwind — semaphore
@@ -73,10 +79,20 @@ _N_PULL = "pull"
 _N_INLINE = "inline"
 _N_PART_DONE = "part_done"
 _N_DRAIN_END = "drain_end"
+_N_HANDBACK = "sem_handback"
 
 #: producer/consumer park-poll period; every wakeup re-runs the cancel
 #: checkpoint, so cancellation latency is bounded by it
 _POLL_S = 0.05
+
+#: how long a pool worker tries for a device permit before handing its
+#: partition back to the drain.  Normal permit waits are per-batch
+#: (milliseconds — producers release between items); a wait this long
+#: means the permits are pinned by threads that may themselves be
+#: waiting on THIS drain (a nested drain under an outer pull region),
+#: so the worker must yield the partition to the consumer instead of
+#: parking forever
+_SEM_TRY_S = 0.25
 
 # partition drain states
 _UNSTARTED, _RUNNING, _DONE = 0, 1, 2
@@ -220,9 +236,16 @@ class PipelinePool:
 # ---------------------------------------------------------------------------
 
 def _item_nbytes(item) -> int:
-    """Best-effort size of a produced item for the byte budget."""
-    if isinstance(item, tuple):
+    """Best-effort size of a produced item for the byte budget.
+
+    Sinks return containers, not just batches — the shuffle map sink
+    yields ``(batch, (sorted_batch, counts))`` and pieces may arrive in
+    lists — so every common container recurses; an unsized leaf counts
+    as 0 (best effort, never a raise)."""
+    if isinstance(item, (tuple, list)):
         return sum(_item_nbytes(x) for x in item)
+    if isinstance(item, dict):
+        return sum(_item_nbytes(v) for v in item.values())
     try:
         nb = getattr(item, "nbytes", None)
         if nb is None:
@@ -276,12 +299,12 @@ class _ParallelDrain:
             return not (pid == self._head and not self._queues[pid])
         return False
 
-    def _claim_next(self) -> Optional[int]:
+    def _claim_next(self, skip=()) -> Optional[int]:
         with self._cond:
             if self._closed or self._error is not None:
                 return None
             for pid in range(self._head, self._n):
-                if self._state[pid] == _UNSTARTED:
+                if self._state[pid] == _UNSTARTED and pid not in skip:
                     self._state[pid] = _RUNNING
                     return pid
         return None
@@ -292,9 +315,32 @@ class _ParallelDrain:
                 self._error = exc
             self._cond.notify_all()
 
-    def _produce_loop(self, pid: int, sem, inline: bool):
+    @staticmethod
+    def _try_acquire_bounded(sem) -> bool:
+        """Permit acquire for pool workers: bounded at ``_SEM_TRY_S``,
+        cancel-checkpointed each poll.  False = hand the partition back."""
+        deadline = time.monotonic() + _SEM_TRY_S
+        while True:
+            cancel_checkpoint()
+            if sem.try_acquire(timeout=_POLL_S):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+
+    def _produce_loop(self, pid: int, sem, inline: bool) -> bool:
         """Pull ``pid``'s iterator until exhausted (or one item when
-        ``inline`` — the consumer produces exactly what it needs)."""
+        ``inline`` — the consumer produces exactly what it needs).
+
+        Returns False when the partition was handed back instead of
+        finished: a pool worker that cannot obtain a device permit
+        within ``_SEM_TRY_S`` reverts ``pid`` to ``_UNSTARTED`` and
+        yields it — every permit may be pinned by threads that are
+        themselves waiting on this drain (nested drains), so only the
+        consumer, which holds its permit re-entrantly across the nested
+        pull, is guaranteed able to produce.  Handover is safe at any
+        point: the iterator keeps its position in ``self._parts`` and
+        exactly one owner pulls it at a time (the state machine under
+        ``self._cond``)."""
         it = self._parts[pid]
         while True:
             with self._cond:
@@ -303,14 +349,26 @@ class _ParallelDrain:
                     self._cond.wait(_POLL_S)
                     cancel_checkpoint()
                 if self._closed or self._error is not None:
-                    return
+                    return True
             cancel_checkpoint()
-            t0 = time.perf_counter_ns()
-            produced = True
             # DeviceSemaphore held only around the device-dispatch
             # region (the pull + sink), released between items so
-            # prefetch never starves concurrent queries of permits
-            sem.acquire_if_necessary()
+            # prefetch never starves concurrent queries of permits.
+            # The consumer (inline) may block — everyone else's
+            # progress funnels through it — but pool workers must not:
+            # they hand back on timeout (see docstring)
+            if inline:
+                sem.acquire_if_necessary()
+            elif not self._try_acquire_bounded(sem):
+                with self._cond:
+                    if self._closed or self._error is not None:
+                        return True
+                    self._state[pid] = _UNSTARTED
+                    self._cond.notify_all()
+                _flight.record(_flight.EV_PIPELINE, _N_HANDBACK, a=pid)
+                return False
+            t0 = time.perf_counter_ns()
+            produced = True
             try:
                 try:
                     item = next(it)
@@ -328,7 +386,7 @@ class _ParallelDrain:
                     self._busy_ns += dt
                     self._cond.notify_all()
                 _flight.record(_flight.EV_PIPELINE, _N_PART_DONE, a=pid)
-                return
+                return True
             nb = _item_nbytes(item)
             PIPELINE_WORKER_BUSY_SECONDS.observe(dt / 1e9)
             _flight.record(_flight.EV_PIPELINE,
@@ -340,7 +398,7 @@ class _ParallelDrain:
                 self._busy_ns += dt
                 self._cond.notify_all()
             if inline:
-                return
+                return True
 
     def _serve(self):
         """Pool-worker entry: claim partitions until none remain."""
@@ -358,11 +416,19 @@ class _ParallelDrain:
             set_active(self._conf, thread_only=True)
             with query_context(self._token):
                 try:
+                    handed_back = set()
                     while True:
-                        pid = self._claim_next()
+                        pid = self._claim_next(handed_back)
                         if pid is None:
                             break
-                        self._produce_loop(pid, sem, inline=False)
+                        if not self._produce_loop(pid, sem,
+                                                  inline=False):
+                            # handed back for want of a device permit:
+                            # never re-claim it here (re-claiming would
+                            # shut the consumer-assist window back out)
+                            # — the consumer or a luckier worker takes
+                            # it over
+                            handed_back.add(pid)
                 finally:
                     # ownership unwind + per-query wait attribution:
                     # permits this worker still holds are returned and

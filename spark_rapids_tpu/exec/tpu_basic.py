@@ -57,37 +57,62 @@ class TpuLocalScan(TpuExec):
     # serializing uploads under a class-wide lock would still defeat
     # the pipeline's overlap — only the dict ops need the lock.
     _DEVICE_CACHE_LOCK = threading.Lock()
+    # key -> (table, Event) while a miss is uploading: concurrent
+    # misses on the same key wait for the first builder instead of each
+    # uploading the full partition set (transient double HBM residency
+    # for large tables, last-write-wins churn).  A builder that fails
+    # pops its sentinel in the finally, so waiters retry and one of
+    # them becomes the next builder.
+    _DEVICE_CACHE_BUILDING: dict = {}
 
     def _cached_batches(self):
         from collections import OrderedDict
+        from ..service.cancellation import cancel_checkpoint
         cls = TpuLocalScan
         key = (id(self.table), self.num_partitions, self.batch_rows)
-        with cls._DEVICE_CACHE_LOCK:
-            if cls._DEVICE_CACHE is None:
-                cls._DEVICE_CACHE = OrderedDict()
-            hit = cls._DEVICE_CACHE.get(key)
-            if hit is not None and hit[0] is self.table:
-                cls._DEVICE_CACHE.move_to_end(key)
-                return hit[1]
-        n = self.table.num_rows
-        per = -(-n // self.num_partitions) if n else 0
-        parts = []
-        for i in range(self.num_partitions):
-            lo = min(i * per, n)
-            hi = min(lo + per, n)
-            batches = []
-            pos = lo
-            while pos < hi:
-                k = min(self.batch_rows, hi - pos)
-                batches.append(from_arrow(self.table.slice(pos, k)))
-                pos += k
-            if lo == hi and lo == 0 and self.num_partitions == 1:
-                batches.append(from_arrow(self.table.slice(0, 0)))
-            parts.append(batches)
-        with cls._DEVICE_CACHE_LOCK:
-            cls._DEVICE_CACHE[key] = (self.table, parts)
-            while len(cls._DEVICE_CACHE) > 8:
-                cls._DEVICE_CACHE.popitem(last=False)
+        while True:
+            with cls._DEVICE_CACHE_LOCK:
+                if cls._DEVICE_CACHE is None:
+                    cls._DEVICE_CACHE = OrderedDict()
+                hit = cls._DEVICE_CACHE.get(key)
+                if hit is not None and hit[0] is self.table:
+                    cls._DEVICE_CACHE.move_to_end(key)
+                    return hit[1]
+                building = cls._DEVICE_CACHE_BUILDING.get(key)
+                if building is None:
+                    done = threading.Event()
+                    cls._DEVICE_CACHE_BUILDING[key] = (self.table, done)
+                    break
+                done = building[1]
+            # a peer is uploading this key (ours, or — after id reuse —
+            # another table's): park OUTSIDE the lock, checkpointed so
+            # cancellation unwinds a waiter, then re-check from the top
+            while not done.wait(0.05):
+                cancel_checkpoint()
+        try:
+            n = self.table.num_rows
+            per = -(-n // self.num_partitions) if n else 0
+            parts = []
+            for i in range(self.num_partitions):
+                lo = min(i * per, n)
+                hi = min(lo + per, n)
+                batches = []
+                pos = lo
+                while pos < hi:
+                    k = min(self.batch_rows, hi - pos)
+                    batches.append(from_arrow(self.table.slice(pos, k)))
+                    pos += k
+                if lo == hi and lo == 0 and self.num_partitions == 1:
+                    batches.append(from_arrow(self.table.slice(0, 0)))
+                parts.append(batches)
+            with cls._DEVICE_CACHE_LOCK:
+                cls._DEVICE_CACHE[key] = (self.table, parts)
+                while len(cls._DEVICE_CACHE) > 8:
+                    cls._DEVICE_CACHE.popitem(last=False)
+        finally:
+            with cls._DEVICE_CACHE_LOCK:
+                cls._DEVICE_CACHE_BUILDING.pop(key, None)
+            done.set()
         return parts
 
     def execute(self):

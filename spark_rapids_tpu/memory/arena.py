@@ -16,6 +16,7 @@ default is used.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Optional
@@ -171,6 +172,24 @@ class DeviceSemaphore:
     def held_count(self) -> int:
         """Re-entrant hold depth of the calling thread."""
         return getattr(self._held, "count", 0)
+
+    @contextlib.contextmanager
+    def released(self):
+        """Drop every permit level this THREAD holds for the duration
+        of the block, restoring the same re-entrant depth on exit.
+
+        For blocking waits that must not pin the device: a thread that
+        parks on a stage barrier (shuffle map materialization, broadcast
+        build) while holding a permit starves concurrent queries of
+        device access — and deadlocks outright when the barrier winner
+        needs pool workers that are queued behind that very permit.  A
+        thread holding nothing passes through untouched."""
+        held = self.release_all()
+        try:
+            yield
+        finally:
+            for _ in range(held):
+                self.acquire_if_necessary()
 
     def pop_wait_ns(self) -> int:
         """Return and reset this thread's accumulated blocked-wait ns."""

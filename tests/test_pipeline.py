@@ -135,6 +135,84 @@ class TestDrainParallel:
         assert out == [(0, [10, 11]), (0, [20, 21]), (1, [30, 31]),
                        (2, [40, 41]), (2, [50, 51])]
 
+    def test_workers_hand_back_partitions_when_permits_pinned(self):
+        # regression (REVIEW r06 high), distilled to its deterministic
+        # core: a nested drain's consumer holds its device permit
+        # re-entrantly (the outer pull region) while every OTHER permit
+        # is pinned elsewhere.  Idle pool workers that claim this
+        # drain's partitions can never acquire a permit; pre-fix they
+        # parked forever in acquire_if_necessary with the partitions
+        # stuck _RUNNING, so the consumer — which only assists
+        # _UNSTARTED partitions — waited forever too.  Post-fix the
+        # workers hand the partitions back within _SEM_TRY_S and the
+        # consumer produces them inline on its re-entrant permit.
+        sem = DeviceManager.get().semaphore
+        permits = sem.permits
+        # grow the pool so idle workers exist to claim partitions
+        warm = [iter([i]) for i in range(permits + 4)]
+        assert len(list(P.drain_parallel(
+            warm, parallelism=permits + 4, label="warm"))) == permits + 4
+
+        release = threading.Event()
+        pinned = []
+
+        def pin():
+            sem.acquire_if_necessary()
+            pinned.append(1)
+            release.wait(60)
+            sem.release_all()
+
+        def part0():
+            # keep the consumer busy on pid 0 long past _SEM_TRY_S so
+            # pool workers have claimed pids 1..3 (and handed them
+            # back) before the consumer reaches them
+            time.sleep(0.4)
+            yield 0
+
+        out, errs = [], []
+
+        def consume():
+            sem.acquire_if_necessary()    # the outer pull region
+            try:
+                parts = [part0()] + [iter([p]) for p in range(1, 4)]
+                out.extend(P.drain_parallel(
+                    parts, parallelism=4, prefetch_depth=1,
+                    label="pinned"))
+            except BaseException as e:  # pragma: no cover - diagnostic
+                errs.append(e)
+            finally:
+                sem.release_all()
+
+        pinners = [threading.Thread(target=pin, daemon=True)
+                   for _ in range(permits - 1)]
+        t = threading.Thread(target=consume, daemon=True)
+        try:
+            for p in pinners:
+                p.start()
+            assert _wait_until(lambda: len(pinned) == permits - 1)
+            t.start()
+            t.join(60)
+            alive = t.is_alive()
+        finally:
+            release.set()
+        assert not alive, "drain deadlocked behind pinned permits"
+        assert not errs
+        assert out == [(p, p) for p in range(4)]
+        for p in pinners:
+            p.join(30)
+        assert _wait_until(lambda: sem.available() == sem.permits)
+
+    def test_item_nbytes_counts_containers(self):
+        # regression (REVIEW r06): the shuffle sink yields nested
+        # containers; list/dict contents must count toward the budget
+        class _Sized:
+            nbytes = 100
+        s = _Sized()
+        assert P._item_nbytes(s) == 100
+        assert P._item_nbytes((s, [s, s])) == 300
+        assert P._item_nbytes([s, {"k": s}]) == 200
+        assert P._item_nbytes("unsized") == 0
+
     def test_cancellation_unwinds_workers_and_semaphore(self):
         sem = DeviceManager.get().semaphore
         token = CancelToken(query_id="pipe-cancel")
@@ -333,6 +411,124 @@ def test_broadcast_builds_once_under_concurrent_probes():
     assert len(calls) == 1
     assert out[0] is not None and out[0] is out[1]
     assert out[0].num_rows == 64
+
+
+def test_semaphore_released_restores_reentrant_depth():
+    from spark_rapids_tpu.memory.arena import DeviceSemaphore
+    sem = DeviceSemaphore(1)
+    sem.acquire_if_necessary()
+    sem.acquire_if_necessary()          # depth 2, one real permit
+    with sem.released():
+        assert sem.held_count() == 0
+        assert sem.available() == 1     # the permit is actually free
+    assert sem.held_count() == 2
+    assert sem.available() == 0
+    sem.release_all()
+    assert sem.available() == 1
+    # a thread holding nothing passes through untouched
+    with sem.released():
+        assert sem.held_count() == 0
+    assert sem.held_count() == 0
+    assert sem.available() == 1
+
+
+def test_broadcast_loser_releases_device_permit_while_blocked():
+    # regression (REVIEW r06 medium): a probe that reaches the
+    # broadcast barrier from a permit-held pull region must not pin the
+    # permit while parked behind the winner's build — the permit goes
+    # back to the semaphore for the duration and is reacquired after
+    sem = DeviceManager.get().semaphore
+    gate = threading.Event()
+    entered = threading.Event()
+    loser_acquired = threading.Event()
+
+    class _GatedScan(TpuLocalScan):
+        def execute(self):
+            entered.set()
+            gate.wait(30)
+            return super().execute()
+
+    tbl = pa.table({"a": pa.array(range(8), pa.int64())})
+    bx = TpuBroadcastExchange(_GatedScan(tbl, num_partitions=1))
+    out = [None, None]
+    errs = []
+
+    def winner():
+        try:
+            out[0] = bx.broadcast_batch()
+        except BaseException as e:  # pragma: no cover - diagnostic
+            errs.append(e)
+
+    def loser():
+        try:
+            sem.acquire_if_necessary()      # simulate the pull region
+            loser_acquired.set()
+            try:
+                out[1] = bx.broadcast_batch()
+                # permit depth restored once the barrier is crossed
+                assert sem.held_count() == 1
+            finally:
+                sem.release_all()
+        except BaseException as e:  # pragma: no cover - diagnostic
+            errs.append(e)
+
+    tw = threading.Thread(target=winner)
+    tw.start()
+    try:
+        # the winner owns the build lock, parked inside it on the gate
+        assert entered.wait(30)
+        tl = threading.Thread(target=loser)
+        tl.start()
+        assert loser_acquired.wait(30)
+        # the loser's permit must return to the semaphore while it
+        # parks on the barrier (pre-fix this stayed pinned: permits-1)
+        assert _wait_until(lambda: sem.available() == sem.permits)
+    finally:
+        gate.set()
+    tw.join(60)
+    tl.join(60)
+    assert not errs
+    assert out[0] is out[1] and out[0].num_rows == 8
+    assert sem.available() == sem.permits
+
+
+def test_scan_device_cache_single_build_under_concurrent_miss(monkeypatch):
+    # regression (REVIEW r06): concurrent misses on the same table must
+    # not each upload the full partition set (transient double HBM
+    # residency) — the in-progress sentinel makes late arrivals wait
+    # for the first builder and share its published parts
+    import spark_rapids_tpu.exec.tpu_basic as TB
+    tbl = pa.table({"a": pa.array(range(256), pa.int64())})
+    builders = []
+    orig = TB.from_arrow
+
+    def slow_from_arrow(t):
+        builders.append(threading.get_ident())
+        time.sleep(0.05)
+        return orig(t)
+
+    monkeypatch.setattr(TB, "from_arrow", slow_from_arrow)
+    barrier = threading.Barrier(4)
+    outs, errs = [], []
+
+    def run():
+        try:
+            barrier.wait(10)
+            outs.append(TB.TpuLocalScan(tbl, num_partitions=2)
+                        ._cached_batches())
+        except BaseException as e:  # pragma: no cover - diagnostic
+            errs.append(e)
+
+    ts = [threading.Thread(target=run) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs
+    assert len(outs) == 4
+    # exactly one thread uploaded; everyone shares the same parts
+    assert len(set(builders)) == 1
+    assert all(o is outs[0] for o in outs)
 
 
 def test_scan_device_cache_concurrent_executes():
