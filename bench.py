@@ -33,6 +33,14 @@ batch-size tuning above — the auto default is min(4, cpu) and bench
 hosts vary); pipeline_off_Mrows_s re-measures exact mode with the
 pipeline disabled so each BENCH_r shows the on/off delta.  Output is
 bit-identical either way (tests/test_pipeline.py).
+
+Superstage split: since r06 the planner carves exchange-delimited
+regions into one-dispatch superstages (spark.rapids.tpu.sql.superstage,
+compile/).  superstage_off_Mrows_s re-measures exact mode with carving
+disabled, and the flushes / superstage_off_flushes keys report the warm
+per-query device round trips under each mode (the cost model the
+compiler optimizes).  Output is bit-identical either way
+(tests/test_compile.py).
 """
 import json
 import sys
@@ -69,7 +77,7 @@ def build_df(session, n_rows: int, num_partitions: int):
 
 def run_engine(enabled: bool, n_rows: int, num_partitions: int,
                repeats: int, variable_float: bool = True,
-               pipeline: bool = True) -> float:
+               pipeline: bool = True, superstage: bool = True):
     from spark_rapids_tpu.api import TpuSession
     from spark_rapids_tpu.config import TpuConf
     # tuned like the reference's benchmark guides tune Spark: large
@@ -90,6 +98,9 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
         "spark.rapids.tpu.exec.pipeline.enabled": pipeline,
         "spark.rapids.tpu.exec.pipelineParallelism": 4,
         "spark.rapids.tpu.exec.pipelinePrefetchDepth": 4,
+        # superstage carving (compile/): superstage=False is the
+        # superstage_off measurement of the same exact-mode query
+        "spark.rapids.tpu.sql.superstage": superstage,
     }))
     # build the query ONCE: the measurement is query execution over
     # loaded data (the reference's benchmark shape), not datagen/upload
@@ -102,7 +113,11 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
         dt = time.perf_counter() - t0
         best = min(best, dt)
     assert out.num_rows > 0
-    return best
+    # warm per-query device round trips (api/session.py counts the
+    # pending-pool flush delta around each execution) — the flushes
+    # column every BENCH_r now reports alongside throughput
+    flushes = getattr(s, "last_query_flushes", None)
+    return best, flushes
 
 
 def main():
@@ -114,13 +129,16 @@ def main():
     repeats = 3
     # headline: the DEFAULT conf (exact float aggregation) — the 8-bit
     # chunk-lane / two-stage-u32 exact table path (exec/tpu_aggregate)
-    tpu_exact_t = run_engine(True, n_rows, parts, repeats,
-                             variable_float=False)
-    tpu_off_t = run_engine(True, n_rows, parts, repeats,
-                           variable_float=False, pipeline=False)
-    tpu_var_t = run_engine(True, n_rows, parts, repeats,
-                           variable_float=True)
-    cpu_t = run_engine(False, n_rows, parts, repeats)
+    tpu_exact_t, tpu_flushes = run_engine(True, n_rows, parts, repeats,
+                                          variable_float=False)
+    tpu_off_t, _ = run_engine(True, n_rows, parts, repeats,
+                              variable_float=False, pipeline=False)
+    tpu_nostage_t, nostage_flushes = run_engine(
+        True, n_rows, parts, repeats, variable_float=False,
+        superstage=False)
+    tpu_var_t, _ = run_engine(True, n_rows, parts, repeats,
+                              variable_float=True)
+    cpu_t, _ = run_engine(False, n_rows, parts, repeats)
     print(json.dumps({
         "metric": "sql_pipeline_throughput",
         "value": round(n_rows / tpu_exact_t / 1e6, 3),
@@ -136,6 +154,13 @@ def main():
         # delta of intra-query pipelined drains (exec/pipeline.py)
         "pipeline_off_Mrows_s": round(n_rows / tpu_off_t / 1e6, 3),
         "pipeline_on_vs_off": round(tpu_off_t / tpu_exact_t, 3),
+        # exact mode with superstage carving disabled (compile/): the
+        # on/off split of one-dispatch-per-stage execution, plus the
+        # warm per-query device round trips under each mode
+        "superstage_off_Mrows_s": round(n_rows / tpu_nostage_t / 1e6, 3),
+        "superstage_on_vs_off": round(tpu_nostage_t / tpu_exact_t, 3),
+        "flushes": tpu_flushes,
+        "superstage_off_flushes": nostage_flushes,
     }))
 
 

@@ -1,0 +1,122 @@
+"""CI smoke for the superstage compiler (compile/, exec/superstage.py):
+run the TPC-DS quartet q3/q42/q52/q96 at tiny scale and assert
+
+1. plan smoke — every carved plan passes the full verifier pass set
+   including PV-STAGE, and the quartet's star-join plans actually carve
+   (at least one TpuSuperstage with a join member);
+2. flush budget — each warm carved query runs in at most 2 fused device
+   round trips, and strictly fewer than its uncarved run;
+3. determinism — carved results are row-identical (including order) to
+   the eager superstage-off results;
+4. the compile-scoped lint rules are clean on the compiler's own files
+   (the layer that removes host syncs must not contain any).
+"""
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+sys.path.insert(0, os.path.join(REPO_ROOT, "benchmarks"))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import tpcds  # noqa: E402
+
+from spark_rapids_tpu.analysis import lint as AL  # noqa: E402
+from spark_rapids_tpu.analysis.plan_verify import verify_or_raise  # noqa: E402
+from spark_rapids_tpu.api import TpuSession  # noqa: E402
+from spark_rapids_tpu.columnar import pending  # noqa: E402
+from spark_rapids_tpu.config import TpuConf  # noqa: E402
+from spark_rapids_tpu.exec.superstage import TpuSuperstage  # noqa: E402
+from spark_rapids_tpu.exec.tpu_join import TpuHashJoinBase  # noqa: E402
+
+QUERIES = ("q3", "q42", "q52", "q96")
+# Warm fused-round-trip budget per query.  q3 is the acceptance
+# criterion (star-join collapses to ONE flush).  q96's second join
+# BUILDS from the first join's output — a build table needs exact row
+# counts, so that hand-off keeps its own resolve (docs/compile.md);
+# tiny-scale data can also drop a build side under the speculative
+# path's capacity gate, costing one extra exact barrier.
+FLUSH_BUDGET = {"q3": 1, "q42": 2, "q52": 2, "q96": 3}
+
+
+def _session(superstage: bool) -> TpuSession:
+    return TpuSession(TpuConf({
+        "spark.rapids.tpu.sql.enabled": True,
+        "spark.rapids.tpu.sql.superstage": superstage,
+        "spark.rapids.tpu.sql.batchSizeRows": 1 << 22,
+        "spark.rapids.tpu.sql.reader.batchSizeRows": 1 << 22,
+    }))
+
+
+def _stages(node):
+    out = [node] if isinstance(node, TpuSuperstage) else []
+    for c in node.children:
+        out.extend(_stages(c))
+    return out
+
+
+def main():
+    data_dir = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "tpcds_compile_smoke", "sf")
+    if not os.path.exists(os.path.join(data_dir, "store_sales.parquet")):
+        tpcds.generate(data_dir, scale=0.002, seed=11)
+
+    s_on = _session(True)
+    s_off = _session(False)
+    tpcds.register(s_on, data_dir)
+    tpcds.register(s_off, data_dir)
+
+    for q in QUERIES:
+        sql = tpcds.QUERIES[q]
+        # -- plan smoke: carved tree passes all five verifier passes
+        phys = s_on._plan(s_on.sql(sql)._plan)
+        verify_or_raise(phys)
+        stages = _stages(phys)
+        assert stages, f"{q}: no superstage carved"
+        joins = [m for st in stages for m in st.members
+                 if isinstance(m, TpuHashJoinBase)]
+        assert joins, f"{q}: no join fused into any superstage"
+        assert all(getattr(j, "_superstage", False) for j in joins), \
+            f"{q}: carved join not armed for one-dispatch probing"
+
+        # -- determinism + flush budget (warm: second run of each)
+        rows_on = s_on.sql(sql).collect()
+        f0 = pending.FLUSH_COUNT
+        rows_on = s_on.sql(sql).collect()
+        warm_on = pending.FLUSH_COUNT - f0
+
+        rows_off = s_off.sql(sql).collect()
+        f0 = pending.FLUSH_COUNT
+        rows_off = s_off.sql(sql).collect()
+        warm_off = pending.FLUSH_COUNT - f0
+
+        assert rows_on == rows_off, f"{q}: superstage changed results"
+        assert warm_on <= FLUSH_BUDGET[q], \
+            f"{q}: warm carved run took {warm_on} flushes " \
+            f"(budget {FLUSH_BUDGET[q]})"
+        assert warm_on < warm_off, \
+            f"{q}: carving did not reduce flushes " \
+            f"(on={warm_on} off={warm_off})"
+        print(f"  {q}: rows={len(rows_on)} warm_flushes "
+              f"on={warm_on} off={warm_off} "
+              f"stages={len(stages)} fused_joins={len(joins)}")
+
+    # -- compile-scoped lint clean on the compiler's own files
+    findings = []
+    for rel in ("spark_rapids_tpu/compile/lower.py",
+                "spark_rapids_tpu/compile/carve.py",
+                "spark_rapids_tpu/exec/superstage.py"):
+        with open(os.path.join(REPO_ROOT, rel)) as f:
+            src = f.read()
+        findings += AL.lint_source(src, rel,
+                                   scopes=AL._scopes_for(rel))
+    assert findings == [], AL.format_findings(findings)
+
+    print("compile smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

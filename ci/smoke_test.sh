@@ -41,6 +41,9 @@ JAX_PLATFORMS=cpu python ci/obs_smoke.py
 echo "== morsel pipeline (parallel drains under stall watchdog) =="
 JAX_PLATFORMS=cpu python ci/pipeline_smoke.py
 
+echo "== superstage compiler (carve smoke, flush budget, determinism) =="
+JAX_PLATFORMS=cpu python ci/compile_smoke.py
+
 echo "== api validation (docs vs live registry) =="
 python -m spark_rapids_tpu.tools.api_validation
 

@@ -650,14 +650,21 @@ def _scopes_for(rel: str) -> Set[str]:
     scopes = {HYG001}
     parts = rel.split("/")
     base = os.path.basename(rel)
-    if any(p in ("service", "shuffle", "memory") for p in parts) or \
-            base in ("pipeline.py", "exchange.py", "tpu_basic.py"):
+    if any(p in ("service", "shuffle", "memory", "compile")
+           for p in parts) or \
+            base in ("pipeline.py", "exchange.py", "tpu_basic.py",
+                     "superstage.py"):
         # the morsel pipeline + the exec files it made concurrent
         # (exchange build/materialize locks, scan-cache lock) carry the
-        # same lock discipline as the service/shuffle/memory layers
+        # same lock discipline as the service/shuffle/memory layers;
+        # compile/ + the superstage wrapper run inside those drains
         scopes |= {LOCK001, LOCK002}
-    if "kernels" in parts or base.startswith("tpu_") or \
-            base == "pipeline.py":
+    if "kernels" in parts or "compile" in parts or \
+            base.startswith("tpu_") or \
+            base in ("pipeline.py", "superstage.py"):
+        # the superstage compiler exists to ELIMINATE host round trips:
+        # a stray device_get/np.asarray in compile/ or the wrapper
+        # would silently reintroduce the cost it removes
         scopes |= {SYNC001, OBS002}
     if "obs" in parts:
         scopes |= {HYG002}

@@ -6,7 +6,7 @@ recording human-readable reasons) — applied to the CONVERTED tree, after
 every planner rewrite, so stage collapse / AQE wrapping / mesh placement
 cannot silently break the contracts execution assumes.
 
-Four passes, each appending structured ``Violation``s (never raising on
+Five passes, each appending structured ``Violation``s (never raising on
 the first):
 
 SCHEMA   output_schema of every node resolves; expressions attached to a
@@ -25,6 +25,10 @@ CKPT     cancellation-checkpoint coverage: a materializing operator (one
          that drains unbounded input before emitting) must reach a
          ``timed``/``cancel_checkpoint`` region itself or via a
          descendant, so service deadlines/cancellation can unwind it.
+STAGE    superstage carving contracts (compile/carve.py): stage
+         boundaries coincide with exchanges, each lowered stage keeps
+         at most one flush barrier, cancel checkpoints survive fusion,
+         and sync-free flags only appear inside carved regions.
 
 Verification is permissive by design: unknown node classes pass, and a
 pass that cannot evaluate a property (e.g. an exotic node without the
@@ -42,6 +46,7 @@ SCHEMA = "PV-SCHEMA"
 DTYPE = "PV-DTYPE"
 PART = "PV-PART"
 CKPT = "PV-CKPT"
+STAGE = "PV-STAGE"
 
 
 class Violation:
@@ -440,6 +445,65 @@ def _check_checkpoints(nodes, out: List[Violation]):
 
 
 # ---------------------------------------------------------------------------
+# pass 5: superstage carving contracts
+# ---------------------------------------------------------------------------
+
+def _check_superstages(nodes, out: List[Violation]):
+    """Contracts on carved TpuSuperstage regions (compile/carve.py):
+    boundaries coincide with exchanges (no exchange/boundary class may
+    be a member), the wrapped region root IS the wrapper's child, at
+    most one flush barrier survives lowering, cancel checkpoints
+    survive fusion (the wrapper class itself enters a ``timed``
+    region), and sync-free ``_superstage`` flags are only armed inside
+    carved regions.  A plan without superstages passes vacuously."""
+    from ..compile import lower
+    member_ids = set()
+    for i, node, anc in nodes:
+        if _cls_name(node) != "TpuSuperstage":
+            continue
+        members = list(getattr(node, "members", ()) or ())
+        member_ids.update(id(m) for m in members)
+        if not members or not node.children or \
+                members[0] is not node.children[0]:
+            out.append(Violation(
+                STAGE, i, node.name,
+                "superstage region root is not the wrapper's child: "
+                "the carve pass must wrap in place"))
+        for m in members:
+            if not lower.is_member(m):
+                out.append(Violation(
+                    STAGE, i, node.name,
+                    f"stage member {m.name} is a stage-boundary class: "
+                    f"exchanges/scans/transitions must delimit stages, "
+                    f"never fuse into them"))
+        nb = lower.barrier_count(getattr(node, "lowering", ()) or ())
+        if nb > 1:
+            out.append(Violation(
+                STAGE, i, node.name,
+                f"lowered stage retains {nb} flush barriers; a "
+                f"superstage is allowed at most ONE host round trip"))
+        if not _class_covered(type(node)):
+            out.append(Violation(
+                STAGE, i, node.name,
+                "superstage wrapper has no cancellation checkpoint: "
+                "fusing operators must not drop cancel coverage"))
+        if anc and lower.is_member(anc[-1]):
+            out.append(Violation(
+                STAGE, i, node.name,
+                f"superstage under member operator {anc[-1].name}: "
+                f"regions must be maximal (the parent belongs in this "
+                f"stage)"))
+    for i, node, _anc in nodes:
+        if getattr(node, "_superstage", False) and \
+                id(node) not in member_ids:
+            out.append(Violation(
+                STAGE, i, node.name,
+                "sync-free _superstage flag armed outside any carved "
+                "region: its speculative output has no verifying "
+                "consumer chain"))
+
+
+# ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
 
@@ -449,10 +513,10 @@ def verify_plan(plan: PhysicalPlan,
     """Run the verifier passes over ``plan``; never raises.
 
     ``passes`` optionally restricts to a subset of
-    {SCHEMA, DTYPE, PART, CKPT}."""
+    {SCHEMA, DTYPE, PART, CKPT, STAGE}."""
     nodes = _preorder(plan)
     run = set(passes) if passes is not None else \
-        {SCHEMA, DTYPE, PART, CKPT}
+        {SCHEMA, DTYPE, PART, CKPT, STAGE}
     violations: List[Violation] = []
     if SCHEMA in run:
         _check_schema(nodes, violations)
@@ -462,6 +526,8 @@ def verify_plan(plan: PhysicalPlan,
         _check_partitioning(nodes, violations)
     if CKPT in run:
         _check_checkpoints(nodes, violations)
+    if STAGE in run:
+        _check_superstages(nodes, violations)
     return PlanVerificationReport(plan, violations)
 
 

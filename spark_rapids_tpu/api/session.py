@@ -234,6 +234,12 @@ class TpuSession:
         sem.pop_wait_ns()                     # reset this thread's counter
         cat = BufferCatalog.get()
         spill0 = cat.spilled_device_to_host + cat.spilled_host_to_disk
+        # device round trips this query (process-wide counter delta:
+        # concurrent peers' flushes land in whichever query's window
+        # they fall — exact when queries run serially, which is how the
+        # flush budget is benchmarked)
+        from ..columnar import pending
+        flushes0 = pending.FLUSH_COUNT
         token = current_token()
         try:
             # drain all partitions first (device work + staged pulls),
@@ -246,14 +252,20 @@ class TpuSession:
             from ..exec.pipeline import drain_parallel
 
             def _resolve(item):
-                return item if isinstance(item, pa.Table) \
-                    else resolve_speculative(item)
+                if isinstance(item, pa.Table):
+                    return item
+                # stage output buffers BEFORE the fit-flag check: the
+                # flush the verification forces then carries the values
+                # too, so a fully speculative chain (superstage join ->
+                # agg -> sort -> limit) collects in ONE round trip
+                stage_batch(item)
+                fixed = resolve_speculative(item)
+                if fixed is not item:
+                    stage_batch(fixed)
+                return fixed
             items = [item for _pid, item in drain_parallel(
                 phys.execute_checkpointed(), sink=_resolve,
                 token=token, label="collect")]
-            for item in items:
-                if not isinstance(item, pa.Table):
-                    stage_batch(item)
             tables: List[pa.Table] = []
             for item in items:
                 t = item if isinstance(item, pa.Table) else to_arrow(item)
@@ -280,10 +292,14 @@ class TpuSession:
                        cat.spilled_host_to_disk) - spill0
         observe("sem_wait_ms", sem_wait_ms)
         observe("spill_bytes", spill_bytes)
+        flushes = pending.FLUSH_COUNT - flushes0
+        self.last_query_flushes = flushes
+        observe("flushes", flushes)
         self._log_query(phys, (_time.perf_counter() - t0) * 1000,
                         conf=conf, fallbacks=fallbacks,
                         extra={"sem_wait_ms": round(sem_wait_ms, 3),
-                               "spill_bytes": int(spill_bytes)})
+                               "spill_bytes": int(spill_bytes),
+                               "flushes": int(flushes)})
         target = schema_to_arrow(phys.output_schema) if len(
             phys.output_schema) else None
         if not tables:

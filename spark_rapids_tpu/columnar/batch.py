@@ -140,6 +140,27 @@ class SpeculativeResult:
         return self._redo()
 
 
+def chain_speculative(out: "ColumnarBatch", inp: "ColumnarBatch",
+                      recompute) -> "ColumnarBatch":
+    """Carry ``inp``'s unverified fit flags onto ``out``, a batch computed
+    FROM ``inp`` by a count-preserving device transform (project, staged
+    chain, lazy sort/limit): the consumer's flush barrier then vouches
+    for the whole chain at once, and a failed fit recomputes via
+    ``recompute(exact_input)``.  No-op when the input is not speculative
+    — the superstage sync-free paths are the only producers."""
+    spec = getattr(inp, "_speculative", None)
+    if spec is None:
+        return out
+    own = getattr(out, "_speculative", None)
+
+    def _redo():
+        return recompute(resolve_speculative(inp))
+    out._speculative = SpeculativeResult(
+        list(spec.fits) + (list(own.fits) if own is not None else []),
+        _redo)
+    return out
+
+
 def resolve_speculative(batch: "ColumnarBatch") -> "ColumnarBatch":
     """Verify-and-replace helper: returns the batch itself when its
     speculative assumptions held (or it has none), else the re-computed

@@ -1,0 +1,107 @@
+"""Superstage carving: the planner post-pass that splits a verified
+physical plan into maximal exchange-delimited regions and wraps each
+qualifying region in one TpuSuperstage dispatch.
+
+Runs AFTER the invariant verifier (analysis/plan_verify.py) so it only
+ever sees plans whose schema/dtype/partitioning/checkpoint contracts
+hold; the PV-STAGE pass re-verifies the carved tree (boundaries coincide
+with exchanges, cancel checkpoints survive fusion, at most one flush
+barrier per stage).
+
+A region is the maximal connected component of *member* operators
+(compile/lower.classify) reachable from a region root — the first
+member found under a boundary (exchange, scan, row transition, mesh
+exec) or under the plan root.  Join build sides typically end at a
+broadcast exchange, so the natural carve reproduces Spark's stage
+graph: stages begin and end at exchanges.
+
+Carving arms the members' sync-free device-resident paths (the join's
+speculative unique-match program rides ``node._superstage``); a member
+whose boundary child is NOT a natural stage delimiter is an *ejection*
+— that operator keeps per-operator dispatch and the region simply does
+not extend through it (``tpu_compile_superstages_total{event=
+"ejected"}``).  Regions smaller than
+``spark.rapids.tpu.sql.superstage.minOps`` are left uncarved: a
+single-operator stage gains nothing from the wrapper.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..exec.base import PhysicalPlan
+from . import lower
+
+
+def _natural_boundary(node: PhysicalPlan) -> bool:
+    """Boundaries that END a stage by design (no ejection event):
+    exchanges — the stage graph's edges — and leaves (scans)."""
+    from ..exec import exchange as TX
+    if isinstance(node, (TX.TpuShuffleExchange, TX.TpuBroadcastExchange)):
+        return True
+    return not node.children
+
+
+def _resolving_consumer(parent) -> bool:
+    """True when ``parent`` provably resolves speculative fit flags on
+    the batches it consumes: the session collect sink (parent None),
+    exchange finalize, and the join's build/stream intake.  Any other
+    boundary consumer gets exact batches — the stage resolves its own
+    output at the edge instead of trusting an unknown operator not to
+    bake an unverified count."""
+    if parent is None:
+        return True
+    from ..exec import exchange as TX
+    from ..exec import tpu_join as TJ
+    return isinstance(parent, (TX.TpuShuffleExchange,
+                               TX.TpuBroadcastExchange,
+                               TJ.TpuHashJoinBase))
+
+
+def carve_plan(phys: PhysicalPlan, conf) -> PhysicalPlan:
+    """Return ``phys`` with every qualifying region wrapped in a
+    TpuSuperstage (in place below the wrappers; the returned root may
+    be a new wrapper node)."""
+    from ..config import SUPERSTAGE_MIN_OPS
+    from ..exec.superstage import TpuSuperstage
+    from ..obs import flight
+    from ..obs.registry import superstage_event
+    min_ops = int(conf.get(SUPERSTAGE_MIN_OPS))
+
+    def _collect(node: PhysicalPlan, members: List[PhysicalPlan]):
+        """DFS the connected member component under ``node``; carve the
+        boundary subtrees below it in the same walk."""
+        members.append(node)
+        for i, c in enumerate(node.children):
+            if lower.is_member(c):
+                _collect(c, members)
+            else:
+                if not _natural_boundary(c):
+                    # unfusable operator inside the would-be stage:
+                    # ejected into its own dispatch, region splits here
+                    superstage_event("ejected")
+                    flight.record(flight.EV_COMPILE, "ejected",
+                                  len(c.children))
+                node.children[i] = _carve(c, node)
+
+    def _carve(node: PhysicalPlan, parent) -> PhysicalPlan:
+        if not lower.is_member(node):
+            for i, c in enumerate(node.children):
+                node.children[i] = _carve(c, node)
+            return node
+        members: List[PhysicalPlan] = []
+        _collect(node, members)
+        if len(members) < min_ops:
+            return node
+        # arm the members' sync-free paths: inside a carved region every
+        # consumer provably resolves or chains speculative fit flags, so
+        # the join may emit its one-dispatch speculative output
+        for m in members:
+            m._superstage = True
+        lowering = lower.lower_region(members)
+        superstage_event("carved")
+        flight.record(flight.EV_COMPILE, "carved", len(members))
+        return TpuSuperstage(node, members, lowering,
+                             resolve_output=not _resolving_consumer(
+                                 parent))
+
+    return _carve(phys, None)

@@ -1,0 +1,83 @@
+"""Superstage lowering: classify each physical operator by HOW it
+participates in a carved superstage's single-dispatch execution.
+
+The compiler does not re-trace operators into one literal XLA graph —
+every member already runs its hot path as ONE jitted program
+(exec/fused.py row-op chains, the join's fused probe+compact+gather,
+the aggregate's fused grouping core, the partitioner's fused split).
+What kept a stage at one host round trip PER OPERATOR was the host
+count pull between them.  Lowering therefore assigns each member a
+*dispatch strategy* describing how its program chains device-resident
+onto the next:
+
+PROGRAM   the member's whole batch path is one traced program whose
+          output row count stays on device (project/filter via
+          FusedEval, staged chains, the speculative unique-match join,
+          the fused aggregate core, the lazy sort/limit heads).
+CHAIN     a count-preserving transport: it forwards batches (and any
+          speculative fit flags) without forcing a host value
+          (partition coalesce, top-n propagation).
+BARRIER   a member that legitimately forces the fused flush — the
+          single host round trip the stage is allowed (the shuffle
+          map-side finalize, the collect staging).
+BOUNDARY  not a member: superstages end here (exchanges, scans, row
+          transitions, mesh execs).  A BOUNDARY found where a member
+          was expected is an *ejection*: the region splits around it
+          and the operator keeps its own per-operator dispatch.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..exec.base import PhysicalPlan
+
+PROGRAM = "program"
+CHAIN = "chain"
+BARRIER = "barrier"
+BOUNDARY = "boundary"
+
+
+def classify(node: PhysicalPlan) -> str:
+    """Dispatch strategy for one operator (see module doc)."""
+    from ..exec import tpu_basic as TB
+    from ..exec import tpu_aggregate as TA
+    from ..exec import tpu_join as TJ
+    from ..exec import tpu_sort as TS
+    from ..exec.staged import TpuStagedCompute
+    if isinstance(node, (TB.TpuProject, TB.TpuFilter, TpuStagedCompute,
+                         TA.TpuHashAggregate, TJ.TpuHashJoinBase,
+                         TS.TpuSort, TB.TpuLocalLimit,
+                         TB.TpuGlobalLimit)):
+        return PROGRAM
+    if isinstance(node, TS.TpuTopN):
+        return CHAIN
+    if isinstance(node, TB.TpuCoalesceBatches):
+        # coalesce reads host counts to pack batches: inside a stage it
+        # acts as the stage's one permitted flush
+        return BARRIER
+    from ..exec.exchange import TpuCoalescePartitions
+    if isinstance(node, TpuCoalescePartitions):
+        return CHAIN
+    # everything else — exchanges, scans, row transitions, windows,
+    # unions, mesh/distributed execs, CPU fallbacks — delimits (or
+    # ejects from) the superstage
+    return BOUNDARY
+
+
+def is_member(node: PhysicalPlan) -> bool:
+    return classify(node) is not BOUNDARY
+
+
+def lower_region(members: List[PhysicalPlan]
+                 ) -> List[Tuple[str, str]]:
+    """(node name, strategy) per member, region order — the stage's
+    dispatch plan, surfaced by TpuSuperstage explain and the PV-STAGE
+    verifier."""
+    return [(m.name, classify(m)) for m in members]
+
+
+def barrier_count(lowering: List[Tuple[str, str]]) -> int:
+    """How many one-flush barriers the lowered stage retains (the
+    per-stage flush budget PV-STAGE and ci/compile_smoke.py check
+    against)."""
+    return sum(1 for _n, s in lowering if s == BARRIER)

@@ -463,6 +463,33 @@ OBS_DIAG_MAX_BUNDLES = conf_int(
     "spark.rapids.tpu.obs.diagnostics.maxBundles", 20,
     "Rotation bound on the diagnostics dir: after each write the "
     "oldest diag-*.json beyond this many are deleted")
+SUPERSTAGE = conf_bool(
+    "spark.rapids.tpu.sql.superstage", True,
+    "Superstage compiler (compile/): a planner post-pass after the "
+    "plan-invariant verifier carves the physical plan into maximal "
+    "exchange-delimited superstages (scan->project->filter->partial-agg"
+    "->shuffle-split, join->agg->topn) and lowers each to ONE traced "
+    "XLA program where possible, with intermediates staying device-"
+    "resident between stages: inner-join probes run the speculative "
+    "unique-match path, aggregates hand fit flags to the stage "
+    "barrier, and the whole map side of an exchange resolves in a "
+    "single fused flush.  Per-node fallback ejects an unfusable "
+    "operator into its own dispatch instead of failing the stage; "
+    "off restores one-dispatch-per-operator execution bit-identically")
+SUPERSTAGE_MIN_OPS = conf_int(
+    "spark.rapids.tpu.sql.superstage.minOps", 2,
+    "Minimum member operators before a carved region is wrapped in a "
+    "TpuSuperstage (singleton regions gain nothing over the "
+    "per-operator fused paths)", internal=True)
+SUPERSTAGE_SPEC_JOIN = conf_bool(
+    "spark.rapids.tpu.sql.superstage.speculativeJoin", True,
+    "Inside a superstage, lower no-condition inner hash-join probes to "
+    "the sync-free speculative unique-match program: output capacity "
+    "is the probe capacity (static), the match count stays on device, "
+    "and a fit flag (max matches per probe row <= 1) rides the "
+    "existing speculative redo machinery to the stage flush barrier; "
+    "a violating batch (duplicate build keys) recomputes on the exact "
+    "path.  Star-schema dimension joins always fit", internal=True)
 PIPELINE_ENABLED = conf_bool(
     "spark.rapids.tpu.exec.pipeline.enabled", True,
     "Morsel-parallel partition drains (exec/pipeline.py): the shuffle "

@@ -187,26 +187,30 @@ class TpuStagedCompute(TpuExec):
 
         def run(part):
             from ..columnar.binary64 import exact_double_enabled
+            from ..columnar.batch import chain_speculative
+
+            def stage_one(batch):
+                # exactDouble: traced reassembly would strip
+                # Binary64Columns created inside the program
+                if jitted is not None and \
+                        not exact_double_enabled() and all(
+                        type(c) is Column for c in batch.columns):
+                    datas = tuple(c.data for c in batch.columns)
+                    valids = tuple(c.validity for c in batch.columns)
+                    pairs, cnt = jitted(batch.capacity, datas, valids,
+                                        batch.rows_dev)
+                    n = LazyCount(cnt) if has_filter else \
+                        batch.rows_lazy
+                    return ColumnarBatch(
+                        out_schema,
+                        [Column(f.dtype, d, v) for f, (d, v) in
+                         zip(out_schema, pairs)], n)
+                return apply_ops_eager(self.ops, batch, fused_per_op)
+
             for batch in part:
                 with timed(self.metrics[OP_TIME], self):
-                    # exactDouble: traced reassembly would strip
-                    # Binary64Columns created inside the program
-                    if jitted is not None and \
-                            not exact_double_enabled() and all(
-                            type(c) is Column for c in batch.columns):
-                        datas = tuple(c.data for c in batch.columns)
-                        valids = tuple(c.validity for c in batch.columns)
-                        pairs, cnt = jitted(batch.capacity, datas, valids,
-                                            batch.rows_dev)
-                        n = LazyCount(cnt) if has_filter else \
-                            batch.rows_lazy
-                        out = ColumnarBatch(
-                            out_schema,
-                            [Column(f.dtype, d, v) for f, (d, v) in
-                             zip(out_schema, pairs)], n)
-                    else:
-                        out = apply_ops_eager(self.ops, batch,
-                                              fused_per_op)
+                    out = chain_speculative(stage_one(batch), batch,
+                                            stage_one)
                 self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out

@@ -1,0 +1,110 @@
+"""TpuSuperstage: one carved, exchange-delimited region executing with
+device-resident handoff between its member operators.
+
+The carve pass (compile/carve.py) wraps each qualifying region root in
+this exec and arms the members' sync-free paths (the join's speculative
+unique-match program, the aggregate's deferred fit flags, the lazy
+sort/limit heads, fit-flag chaining through projections).  Intermediates
+never pull a host count between members: size-dependent shapes ride the
+speculative fit-flag/redo machinery (columnar/batch.py) to the stage's
+single barrier — the exchange finalize or the collect staging — where
+ONE fused flush (columnar/pending.py) resolves every count, fit flag and
+output buffer of the stage.
+
+Fallback layers, outermost to innermost:
+- stage setup: if arming/executing the region raises during setup, the
+  member flags are stripped and the region re-executes with plain
+  per-operator dispatch (``tpu_compile_superstages_total{event=
+  "fallback"}``).
+- per node: the carve pass ejects unfusable operators into their own
+  dispatch by splitting the region around them (event="ejected").
+- per batch: each sync-free program falls back to its operator's exact
+  sized path when its jit fails (the _SPEC_JIT/_PROBE_JIT False
+  sentinels) or its fit flag fails at the barrier (redo closures).
+
+Each pulled batch passes a ``timed`` region, so cancel checkpoints and
+flight/trace coverage survive fusion (the PV-STAGE verifier pass checks
+this statically).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .base import OP_TIME, NUM_OUTPUT_BATCHES, timed
+from .tpu_basic import TpuExec
+
+# per-stage flush tally (resolved lazily like every Metric)
+STAGE_FLUSHES = "superstageFlushes"
+
+_SENTINEL = object()
+
+
+class TpuSuperstage(TpuExec):
+    def __init__(self, region_root, members: List, lowering,
+                 resolve_output: bool = False):
+        super().__init__(region_root)
+        self.members = list(members)
+        self.lowering = lowering   # [(node name, strategy)] region order
+        # True when the stage's consumer is not a known speculative-
+        # resolving boundary (exchange finalize / collect sink / join
+        # intake): the stage then verifies its own fit flags at the edge
+        # rather than handing unresolved counts to an unknown operator
+        self.resolve_output = resolve_output
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return self.children[0].num_partitions_hint()
+
+    def _node_string(self):
+        progs = sum(1 for _n, s in self.lowering if s == "program")
+        return (f"TpuSuperstage[{len(self.members)} ops, "
+                f"{progs} programs]")
+
+    def _disarm(self):
+        """Strip the members' sync-free flags: the region then executes
+        exactly as the uncarved plan would."""
+        for m in self.members:
+            if getattr(m, "_superstage", False):
+                m._superstage = False
+
+    def execute(self):
+        from ..obs import flight
+        from ..obs.registry import superstage_event
+        try:
+            parts = self.children[0].execute()
+        except Exception:
+            # eager fallback: per-operator dispatch, one retry
+            self._disarm()
+            superstage_event("fallback")
+            flight.record(flight.EV_COMPILE, "fallback",
+                          len(self.members))
+            parts = self.children[0].execute()
+        return [self._drain(p, pid) for pid, p in enumerate(parts)]
+
+    def _drain(self, part, pid: int):
+        from ..columnar import pending
+        from ..obs import flight
+        from ..obs.registry import COMPILE_SUPERSTAGE_FLUSHES
+        f0 = pending.FLUSH_COUNT
+        flight.record(flight.EV_COMPILE, "stage_begin", pid,
+                      len(self.members))
+        it = iter(part)
+        while True:
+            # the timed region is the stage's cancel checkpoint + span:
+            # one entry per pulled batch, like any member operator
+            with timed(self.metrics[OP_TIME], self):
+                batch = next(it, _SENTINEL)
+            if batch is _SENTINEL:
+                break
+            if self.resolve_output:
+                from ..columnar.batch import resolve_speculative
+                batch = resolve_speculative(batch)
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield batch
+        flushes = pending.FLUSH_COUNT - f0
+        self.metrics[STAGE_FLUSHES] += flushes
+        COMPILE_SUPERSTAGE_FLUSHES.inc(flushes)
+        flight.record(flight.EV_COMPILE, "stage_end", pid, flushes)

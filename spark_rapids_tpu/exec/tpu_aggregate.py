@@ -209,7 +209,24 @@ class TpuHashAggregate(TpuExec):
                     if isinstance(batch.rows_lazy, int) and \
                             batch.num_rows == 0 and partials:
                         continue
-                    partials.append(self._update_batch(batch))
+                    in_spec = getattr(batch, "_speculative", None)
+                    p = self._update_batch(batch)
+                    if in_spec is not None:
+                        # the update ran on a speculative input (e.g. a
+                        # superstage's sync-free join): carry the input
+                        # fits so the barrier that checks this partial
+                        # also vouches for the rows it aggregated, and
+                        # redo the update on the exactly-recomputed input
+                        own = getattr(p, "_speculative", None)
+
+                        def _redo_update(batch=batch):
+                            return self._update_batch(
+                                resolve_speculative(batch))
+                        p._speculative = SpeculativeResult(
+                            list(in_spec.fits) +
+                            (list(own.fits) if own is not None else []),
+                            _redo_update)
+                    partials.append(p)
                 if not partials:
                     partials = [self._update_batch(
                         ColumnarBatch.empty(child_schema))]

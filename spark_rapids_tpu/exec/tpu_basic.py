@@ -180,13 +180,21 @@ class TpuProject(TpuExec):
         out_schema = self.output_schema
         fused = FusedEval(bound, child_schema)
 
+        def project_one(batch):
+            cols = fused(batch)
+            if cols is None:
+                cols = [ec.eval_as_column(b, batch) for b in bound]
+            return ColumnarBatch(out_schema, cols, batch.rows_lazy)
+
         def run(part):
+            from ..columnar.batch import chain_speculative
             for batch in part:
                 with timed(self.metrics[OP_TIME], self):
-                    cols = fused(batch)
-                    if cols is None:
-                        cols = [ec.eval_as_column(b, batch) for b in bound]
-                out = ColumnarBatch(out_schema, cols, batch.rows_lazy)
+                    # chain, don't drop, a speculative input's fit flags:
+                    # projection preserves row identity, so the consumer's
+                    # barrier can vouch for input + output together
+                    out = chain_speculative(project_one(batch), batch,
+                                            project_one)
                 self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out
@@ -213,23 +221,28 @@ class TpuFilter(TpuExec):
         bound = self.condition.bind(child_schema)
         fused = FusedEval([bound], child_schema)
 
+        def filter_one(batch):
+            fcols = fused(batch)
+            pred = fcols[0] if fcols is not None else \
+                ec.eval_as_column(bound, batch)
+            keep = pred.data.astype(bool) & pred.validity
+            idx, cnt = bk.compact_indices(keep, batch.rows_dev)
+            # keep the count on device: pulling it per batch
+            # costs a full dispatch-queue sync (LazyCount doc)
+            n = LazyCount(cnt)
+            mask = jnp.arange(batch.capacity) < cnt
+            out = batch.gather(idx, n, live=mask, unique=True)
+            return ColumnarBatch(
+                out.schema,
+                [c.mask_validity(mask) for c in out.columns], n)
+
         def run(part):
+            from ..columnar.batch import chain_speculative
             for batch in part:
                 with timed(self.metrics[OP_TIME], self):
-                    fcols = fused(batch)
-                    pred = fcols[0] if fcols is not None else \
-                        ec.eval_as_column(bound, batch)
-                    keep = pred.data.astype(bool) & pred.validity
-                    idx, cnt = bk.compact_indices(keep, batch.rows_dev)
-                    # keep the count on device: pulling it per batch
-                    # costs a full dispatch-queue sync (LazyCount doc)
-                    n = LazyCount(cnt)
-                    mask = jnp.arange(batch.capacity) < cnt
-                    out = batch.gather(idx, n, live=mask, unique=True)
-                    out = ColumnarBatch(
-                        out.schema,
-                        [c.mask_validity(mask) for c in out.columns], n)
-                self.metrics[NUM_OUTPUT_ROWS] += n
+                    out = chain_speculative(filter_one(batch), batch,
+                                            filter_one)
+                self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out
         return [run(p) for p in self.children[0].execute()]
@@ -257,10 +270,15 @@ class TpuCoalesceBatches(TpuExec):
 
     def execute(self):
         def run(part):
+            from ..columnar.batch import resolve_speculative
             pending: List[ColumnarBatch] = []
             rows = 0
             nbytes = 0
             for batch in part:
+                # the count reads below are a forcing point: verify a
+                # speculative input first (forcing an unverified count
+                # would bake a wrong value into the limit bookkeeping)
+                batch = resolve_speculative(batch)
                 if batch.num_rows == 0 and pending:
                     continue
                 pending.append(batch)
@@ -276,6 +294,33 @@ class TpuCoalesceBatches(TpuExec):
         return [run(p) for p in self.children[0].execute()]
 
 
+def _limit_head_lazy(batch: ColumnarBatch, n: int):
+    """head-n entirely on device counts — no host pull, propagating any
+    speculative flag (superstage path: the collect/exchange barrier then
+    resolves limit + sort + agg + join fits in ONE fused flush)."""
+    from ..columnar.batch import LazyCount, chain_speculative
+    from ..columnar.column import bucket_capacity
+    cap = min(bucket_capacity(max(n, 1)), batch.capacity)
+    out_n = jnp.minimum(batch.rows_dev, jnp.int32(n))
+    take = jnp.arange(cap)
+    live = take < out_n
+    cols = [c.gather(take, live=live).mask_validity(live)
+            for c in batch.columns]
+    out = ColumnarBatch(batch.schema, cols, LazyCount(out_n))
+
+    def redo(fixed):
+        return fixed if fixed.num_rows <= n else fixed.slice(0, n)
+    return chain_speculative(out, batch, redo)
+
+
+def _limit_lazy_ok(batch: ColumnarBatch) -> bool:
+    """A lazy head pays off (and is needed for correctness ordering)
+    only when the count is still device-resident or the batch carries
+    unverified fit flags."""
+    return not isinstance(batch.rows_lazy, int) or \
+        getattr(batch, "_speculative", None) is not None
+
+
 class TpuLocalLimit(TpuExec):
     def __init__(self, n: int, child: PhysicalPlan):
         super().__init__(child)
@@ -287,10 +332,23 @@ class TpuLocalLimit(TpuExec):
 
     def execute(self):
         def run(part):
+            from ..columnar.batch import resolve_speculative
+            it = iter(part)
+            first = next(it, None)
+            if first is None:
+                return
+            second = next(it, None)
+            if second is None and _limit_lazy_ok(first):
+                # single device-counted batch: take the head without a
+                # host round trip
+                yield _limit_head_lazy(first, self.n)
+                return
             remaining = self.n
-            for batch in part:
+            for batch in [b for b in (first, second)
+                          if b is not None] + list(it):
                 if remaining <= 0:
                     break
+                batch = resolve_speculative(batch)
                 if batch.num_rows <= remaining:
                     remaining -= batch.num_rows
                     yield batch
@@ -319,12 +377,25 @@ class TpuGlobalLimit(TpuExec):
         parts = self.children[0].execute()
 
         def run():
+            from ..columnar.batch import resolve_speculative
+            if len(parts) == 1 and self.offset == 0:
+                it = iter(parts[0])
+                first = next(it, None)
+                if first is None:
+                    return
+                second = next(it, None)
+                if second is None and _limit_lazy_ok(first):
+                    yield _limit_head_lazy(first, self.n)
+                    return
+                parts[0] = [b for b in (first, second)
+                            if b is not None] + list(it)
             skip = self.offset
             remaining = self.n
             for p in parts:
                 for batch in p:
                     if remaining <= 0:
                         return
+                    batch = resolve_speculative(batch)
                     if skip >= batch.num_rows:
                         skip -= batch.num_rows
                         continue

@@ -159,6 +159,33 @@ class TpuHashJoinBase(TpuExec):
         build_matched = np.zeros(build.capacity, dtype=bool) \
             if lg.join_type == "full" else None
 
+        # Superstage path (compile/): sync-free speculative unique-match
+        # join — no flush barrier at all; the fit flag rides to the next
+        # superstage boundary.  Only the carve pass sets _superstage, and
+        # only under a consumer that resolves speculative batches.
+        if getattr(self, "_superstage", False) and lg.join_type == "inner" \
+                and lg.condition is None and build_matched is None \
+                and all(w is None for w in str_words) \
+                and build.capacity > 0:
+            from ..config import get_active, SUPERSTAGE_SPEC_JOIN
+            if get_active().get(SUPERSTAGE_SPEC_JOIN):
+                spec_outs = []
+                for sb, skey_cols in zip(stream_batches,
+                                         skey_cols_per_batch):
+                    with timed(self.metrics[JOIN_TIME], self):
+                        out = self._spec_join_batch(
+                            sb, skey_cols, bt, build, direct,
+                            stream_keys, str_words)
+                    if out is None:
+                        spec_outs = None
+                        break
+                    spec_outs.append(out)
+                if spec_outs is not None:
+                    for out in spec_outs:
+                        self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
+                        yield out
+                    return
+
         # Phase A: probe counts for EVERY stream batch first; the output
         # sizes (total matches) stage into the pending pool so one fused
         # flush covers all of them (columnar/pending.py).  Phase B then
@@ -210,6 +237,7 @@ class TpuHashJoinBase(TpuExec):
     # -- fused probe/expand (one program each; totals via pending pool) --
     _PROBE_JIT: dict = {}
     _EXPAND_JIT: dict = {}
+    _SPEC_JIT: dict = {}
 
     # max entries in the direct-address probe table (64 MB of i32 HBM)
     _DIRECT_MAX_RANGE = 1 << 24
@@ -344,6 +372,146 @@ class TpuHashJoinBase(TpuExec):
             TpuHashJoinBase._PROBE_JIT[key] = False
             return None
         return (jt, outer_stream, lo, counts, eff, LazyCount(total))
+
+    def _spec_join_batch(self, sb, skey_cols, bt, build, direct,
+                         stream_keys, str_words):
+        """Speculative unique-match inner join: probe + compact + ALL
+        output gathers as ONE program with a STATIC output capacity (the
+        probe capacity), so no host round trip sizes the result.
+
+        Valid when every probe row matches at most one build row — the
+        star-schema dimension case.  The match total stays a LazyCount
+        and a fit flag (max matches per probe row <= 1) rides the
+        speculative redo machinery to the consumer's flush barrier; a
+        violating batch (duplicate build keys) recomputes on the exact
+        sized path.  Returns None to use the barrier path."""
+        import jax
+        from ..kernels import basic as bk
+        from ..columnar.batch import (LazyCount, SpeculativeResult,
+                                      resolve_speculative)
+        if not all(type(c) is Column for c in skey_cols):
+            return None
+        # plain columns gather inside the program; strings gather as lazy
+        # views outside it (zero dispatches); nested gathers host-sync,
+        # so their presence keeps the exact path
+        for c in list(sb.columns) + list(build.columns):
+            if not isinstance(c, (Column, StringColumn)):
+                return None
+        plain_s = [i for i, c in enumerate(sb.columns)
+                   if type(c) is Column]
+        plain_b = [i for i, c in enumerate(build.columns)
+                   if type(c) is Column]
+        key = ("spec", tuple(c.dtype.name for c in skey_cols),
+               sb.capacity, bt.capacity, len(bt.sorted_words),
+               tuple(sb.columns[i].dtype.name for i in plain_s),
+               tuple(build.columns[i].dtype.name for i in plain_b),
+               tuple(plain_s), tuple(plain_b), self.build_right,
+               direct is not None and direct[4])
+        fn = TpuHashJoinBase._SPEC_JIT.get(key)
+        if fn is False:
+            return None
+        if fn is None:
+            key_dts = tuple(c.dtype for c in skey_cols)
+            tbl = direct[4] if direct is not None else 0
+
+            def _core(bws, dparams, key_arrays, num_rows, perm,
+                      sdatas, svalids, bdatas, bvalids):
+                kcols = [Column(dt, d, v)
+                         for dt, (d, v) in zip(key_dts, key_arrays)]
+                cap = key_arrays[0][0].shape[0]
+                in_range = jnp.arange(cap) < num_rows
+                if dparams is not None:
+                    wmin, wmax, hist, excl = dparams
+                    w = canon.value_words(kcols[0], num_rows)[0]
+                    idx = jnp.clip((w - wmin).astype(jnp.int32), 0,
+                                   tbl - 1)
+                    hit = (w >= wmin) & (w <= wmax) & \
+                        kcols[0].validity & in_range
+                    counts = jnp.where(hit, jnp.take(hist, idx), 0)
+                    lo = jnp.take(excl, idx)
+                else:
+                    swords = canon.batch_key_words(kcols, num_rows)
+                    bt2 = join_k.BuildTable(list(bws), None, None)
+                    jc = join_k.probe_counts(bt2, swords, num_rows)
+                    counts, lo = jc.counts, jc.lo
+                eff = jnp.where(in_range, counts, 0)
+                fit = (jnp.max(eff) <= 1).astype(jnp.int32)
+                p_idx, cnt = bk.compact_indices(eff > 0, num_rows)
+                live = jnp.arange(cap) < cnt
+                b_pos = jnp.clip(jnp.take(lo, p_idx, mode="clip"), 0,
+                                 perm.shape[0] - 1)
+                b_idx = jnp.take(perm, b_pos)
+                souts = [(jnp.take(d, p_idx, axis=0, mode="clip"),
+                          jnp.take(v, p_idx, axis=0, mode="clip") & live)
+                         for d, v in zip(sdatas, svalids)]
+                bouts = [(jnp.take(d, b_idx, axis=0, mode="clip"),
+                          jnp.take(v, b_idx, axis=0, mode="clip") & live)
+                         for d, v in zip(bdatas, bvalids)]
+                return souts, bouts, p_idx, b_idx, live, \
+                    cnt.astype(jnp.int64), fit
+            fn = jax.jit(_core)
+            if len(TpuHashJoinBase._SPEC_JIT) < 4096:
+                TpuHashJoinBase._SPEC_JIT[key] = fn
+        key_arrays = tuple((c.data, c.validity) for c in skey_cols)
+        dparams = tuple(direct[:4]) if direct is not None else None
+        try:
+            souts, bouts, p_idx, b_idx, live, cnt, fit = fn(
+                tuple(bt.sorted_words), dparams, key_arrays, sb.rows_dev,
+                bt.perm,
+                tuple(sb.columns[i].data for i in plain_s),
+                tuple(sb.columns[i].validity for i in plain_s),
+                tuple(build.columns[i].data for i in plain_b),
+                tuple(build.columns[i].validity for i in plain_b))
+        except Exception:  # noqa: BLE001 - fall back, but loudly
+            import logging
+            logging.getLogger("spark_rapids_tpu.exec.join").warning(
+                "speculative join failed; falling back", exc_info=True)
+            TpuHashJoinBase._SPEC_JIT[key] = False
+            return None
+        s_it = iter(souts)
+        scols = []
+        for c in sb.columns:
+            if type(c) is Column:
+                d, v = next(s_it)
+                scols.append(Column(c.dtype, d, v))
+            else:
+                scols.append(c.gather(p_idx, live=live))
+        b_it = iter(bouts)
+        bcols = []
+        for c in build.columns:
+            if type(c) is Column:
+                d, v = next(b_it)
+                bcols.append(Column(c.dtype, d, v))
+            else:
+                bcols.append(c.gather(b_idx, live=live))
+        out = self._assemble(scols, bcols, LazyCount(cnt))
+        # the probe ran on possibly-speculative input: compose its fits
+        # with ours so one failed assumption anywhere redoes the chain
+        in_spec = getattr(sb, "_speculative", None)
+        fits = (list(in_spec.fits) if in_spec is not None else []) \
+            + [LazyCount(fit)]
+
+        def _redo(sb=sb, skey_cols=skey_cols):
+            from ..columnar import pending
+            fixed = resolve_speculative(sb)
+            kc = skey_cols if fixed is sb else \
+                [ec.eval_as_column(e, fixed) for e in stream_keys]
+            with timed(self.metrics[JOIN_TIME], self):
+                pa = self._probe_phase(fixed, kc, bt, str_words, None,
+                                       direct)
+            pending.flush()
+            if pa is None:
+                with timed(self.metrics[JOIN_TIME], self):
+                    return self._join_batch(fixed, kc, build, bt,
+                                            str_words, None)
+            outs = [o for o in self._expand_phases(fixed, build, bt, *pa)
+                    if o is not None]
+            if not outs:
+                return ColumnarBatch.empty(self.output_schema)
+            return outs[0] if len(outs) == 1 else concat_batches(outs)
+
+        out._speculative = SpeculativeResult(fits, _redo)
+        return out
 
     def _expand_phases(self, sb, build, bt, jt, outer_stream, lo, counts,
                        eff, total_lazy):

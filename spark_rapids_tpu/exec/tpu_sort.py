@@ -13,7 +13,8 @@ from typing import List
 
 import jax.numpy as jnp
 
-from ..columnar.batch import ColumnarBatch, concat_batches
+from ..columnar.batch import (ColumnarBatch, concat_batches,
+                              resolve_speculative)
 from ..expr import core as ec
 from ..kernels import canon
 from ..kernels.sort import sort_permutation
@@ -47,6 +48,11 @@ class TpuSort(TpuExec):
             str_words=str_words)
 
     def _sort_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        # a sort is a flush barrier: it needs the host count anyway, so
+        # verifying a speculative input (superstage join/agg chain) here
+        # is free — the fit flags resolve in the same fused flush the
+        # count pull triggers
+        batch = resolve_speculative(batch)
         if batch.num_rows == 0:
             return batch
         words = self._key_words(self._key_cols(batch), batch.num_rows)
@@ -56,6 +62,23 @@ class TpuSort(TpuExec):
         return ColumnarBatch(out.schema,
                              [c.mask_validity(mask) for c in out.columns],
                              batch.num_rows)
+
+    def _sort_lazy_spec(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Sort on device counts — no host pull.  Dead rows carry the
+        past-rows rank word (canon), so they sort last and the valid
+        prefix is exactly the sorted rows.  The input's speculative fit
+        flags (superstage join/agg chain) ride onto the output; a failed
+        fit re-sorts the exactly-recomputed input."""
+        from ..columnar.batch import chain_speculative
+        nr = batch.rows_dev
+        words = self._key_words(self._key_cols(batch), nr)
+        perm = sort_permutation(words)
+        out = batch.gather(perm, batch.rows_lazy, unique=True)
+        mask = jnp.arange(out.capacity) < nr
+        out = ColumnarBatch(out.schema,
+                            [c.mask_validity(mask) for c in out.columns],
+                            batch.rows_lazy)
+        return chain_speculative(out, batch, self._sort_batch)
 
     def execute(self):
         def run(part):
@@ -73,10 +96,32 @@ class TpuSort(TpuExec):
             # GpuSortExec.scala:219), then merge.
             from ..memory.spillable import SpillableBatch
             from ..memory.arena import DeviceManager
-            from ..config import get_active, SORT_OOC_CHUNK_ROWS
+            from ..config import (get_active, SORT_OOC_CHUNK_ROWS,
+                                  SUPERSTAGE)
+            if get_active().get(SUPERSTAGE):
+                # superstage fast path: a single device-counted batch
+                # (the common post-agg shape) sorts WITHOUT the host
+                # count pull, carrying any fit flags downstream so the
+                # collect/exchange barrier resolves the whole chain in
+                # one fused flush
+                it = iter(part)
+                first = next(it, None)
+                if first is None:
+                    return
+                second = next(it, None)
+                if second is None and (
+                        not isinstance(first.rows_lazy, int) or
+                        getattr(first, "_speculative", None) is not None):
+                    out = self._sort_lazy_spec(first)
+                    self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
+                    yield out
+                    return
+                part = [b for b in (first, second)
+                        if b is not None] + list(it)
             runs = []          # (SpillableBatch, n_rows)
             total = 0
             for b in part:
+                b = resolve_speculative(b)
                 if b.num_rows == 0:
                     continue
                 with timed(self.metrics[SORT_TIME], self):

@@ -54,6 +54,7 @@ EV_STATE = "state"            # service admission transition (name=state)
 EV_OOM = "oom"                # device allocation failure observed
 EV_WATCHDOG = "watchdog"      # stall watchdog fired (name=query_id)
 EV_PIPELINE = "pipeline"      # morsel-pipeline drain progress
+EV_COMPILE = "compile"        # superstage compiler (name=event, a=size)
 #                               (name=stage constant, a=partition/count,
 #                                b=bytes or permille ratio)
 

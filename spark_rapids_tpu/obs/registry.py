@@ -299,6 +299,21 @@ COMPILE_CACHE = _REGISTRY.counter(
     "Engine JIT compile-cache lookups by cache and outcome",
     labels=("cache", "outcome"))
 
+COMPILE_SUPERSTAGES = _REGISTRY.counter(
+    "tpu_compile_superstages_total",
+    "Superstage compiler carve outcomes: carved (region wrapped), "
+    "ejected (unfusable member split a region), fallback (stage setup "
+    "failed, re-ran with per-operator dispatch), spec_redo (a member's "
+    "speculative fit flag failed and the exact path recomputed)",
+    labels=("event",))
+
+COMPILE_SUPERSTAGE_FLUSHES = _REGISTRY.counter(
+    "tpu_compile_superstage_flushes_total",
+    "Host round trips (pending-pool flushes) observed while draining "
+    "superstage output partitions — the quantity the compiler exists "
+    "to minimize (approximate under concurrent queries: the flush "
+    "counter is process-wide)")
+
 SHUFFLE_BYTES = _REGISTRY.counter(
     "tpu_shuffle_bytes_total",
     "Shuffle bytes moved through the map-output catalog",
@@ -349,3 +364,9 @@ def compile_cache_event(cache: str, hit: bool):
     caches; compile paths, not per-batch hot paths)."""
     COMPILE_CACHE.labels(cache=cache,
                          outcome="hit" if hit else "miss").inc()
+
+
+def superstage_event(event: str, n: int = 1):
+    """One superstage compiler event (carve/eject/fallback/spec_redo —
+    plan-time and stage-setup paths, not per-batch hot paths)."""
+    COMPILE_SUPERSTAGES.labels(event=event).inc(n)

@@ -493,10 +493,22 @@ class Planner:
         if self.conf.get(TEST_ENABLED):
             self._assert_all_tpu(phys)
         from ..config import PLAN_VERIFY
-        if self.conf.get(PLAN_VERIFY) or os.environ.get(
-                "SPARK_RAPIDS_TPU_FORCE_PLAN_VERIFY"):
+        verify_on = self.conf.get(PLAN_VERIFY) or os.environ.get(
+            "SPARK_RAPIDS_TPU_FORCE_PLAN_VERIFY")
+        if verify_on:
             from ..analysis.plan_verify import verify_or_raise
             verify_or_raise(phys)
+        # superstage carving is a post-pass over the VERIFIED plan: it
+        # only rearranges dispatch (wrappers + sync-free flags), so the
+        # invariant passes above see the uncarved operator tree and the
+        # PV-STAGE re-verify below checks the carving contracts
+        from ..config import SUPERSTAGE
+        if self.conf.get(SUPERSTAGE):
+            from ..compile import carve_plan
+            phys = carve_plan(phys, self.conf)
+            if verify_on:
+                from ..analysis.plan_verify import STAGE, verify_or_raise
+                verify_or_raise(phys, passes=[STAGE])
         return phys
 
     # -- deferred-verification marking ------------------------------------
@@ -515,15 +527,21 @@ class Planner:
         from ..exec import tpu_join as TJ
         from ..exec import exchange as TX
         from ..exec import tpu_sort as TS
-        safe = (parent is None or
-                isinstance(parent, (TX.TpuShuffleExchange,
-                                    TX.TpuBroadcastExchange,
-                                    TJ.TpuHashJoinBase,
-                                    # TopN re-attaches the speculative
-                                    # flag to its own (sorted, head-n)
-                                    # output with a redo chain, so the
-                                    # verify rides the NEXT barrier
-                                    TS.TpuTopN)))
+        safe_types = [TX.TpuShuffleExchange,
+                      TX.TpuBroadcastExchange,
+                      TJ.TpuHashJoinBase,
+                      # TopN re-attaches the speculative
+                      # flag to its own (sorted, head-n)
+                      # output with a redo chain, so the
+                      # verify rides the NEXT barrier
+                      TS.TpuTopN]
+        from ..config import SUPERSTAGE
+        if self.conf.get(SUPERSTAGE):
+            # superstage mode: TpuSort resolves speculative inputs at
+            # its own count pull (same fused flush), so an aggregate
+            # under a sort may defer too — the quartet's agg->sort edge
+            safe_types.append(TS.TpuSort)
+        safe = parent is None or isinstance(parent, tuple(safe_types))
         if isinstance(node, TA.TpuHashAggregate) and \
                 node.mode in (TA.FINAL, TA.COMPLETE):
             node.allow_deferred_verify = safe

@@ -202,10 +202,15 @@ def render_query_report(query_id, story: Dict,
     for i, rec in enumerate(engine):
         tag = f" (attempt record {i + 1}/{len(engine)})" \
             if len(engine) > 1 else ""
-        lines.append(f"-- plan + time shares{tag}: "
-                     f"wall_ms={rec.get('wall_ms')} "
-                     f"sem_wait_ms={rec.get('sem_wait_ms')} "
-                     f"spill_bytes={rec.get('spill_bytes')} --")
+        head = (f"-- plan + time shares{tag}: "
+                f"wall_ms={rec.get('wall_ms')} "
+                f"sem_wait_ms={rec.get('sem_wait_ms')} "
+                f"spill_bytes={rec.get('spill_bytes')}")
+        if rec.get("flushes") is not None:
+            # device round trips this query — THE cost model on
+            # remote-dispatch backends (columnar/pending.py)
+            head += f" flushes={rec.get('flushes')}"
+        lines.append(head + " --")
         lines.extend(_format_plan(plan_time_shares(rec)))
         if rec.get("fallbacks"):
             lines.append("  CPU fallbacks:")

@@ -1,0 +1,23 @@
+"""Seeded SYNC001/LOCK001 fixture shaped like a superstage compiler
+helper — ``ci/lint.py`` must exit NONZERO.
+
+The compile/ layer exists to eliminate host round trips, so its lint
+scope bans exactly what this buffer does: a device pull inside the
+carving path and a blocking sleep under the stage lock.  Never imported
+by the engine.
+"""
+import threading
+import time
+
+import jax
+import numpy as np
+
+_STAGE_LOCK = threading.Lock()
+
+
+def bad_carve(node, dev):
+    rows = int(jax.device_get(dev))          # SYNC001: host pull
+    buf = np.asarray(dev)                    # SYNC001: materialization
+    with _STAGE_LOCK:
+        time.sleep(0.01)                     # LOCK001: blocking hold
+    return rows, buf

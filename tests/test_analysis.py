@@ -440,6 +440,24 @@ class TestLint:
         assert AL.OBS002 in AL._scopes_for(
             "spark_rapids_tpu/kernels/gather.py")
 
+    def test_compile_layer_in_sync_and_lock_scopes(self):
+        # the superstage compiler eliminates host round trips; its own
+        # files must not reintroduce them (SYNC001) nor block under the
+        # stage locks of the drains it runs inside (LOCK001/LOCK002)
+        for rel in ("spark_rapids_tpu/compile/carve.py",
+                    "spark_rapids_tpu/compile/lower.py",
+                    "spark_rapids_tpu/exec/superstage.py"):
+            scopes = AL._scopes_for(rel)
+            assert AL.SYNC001 in scopes, rel
+            assert AL.LOCK001 in scopes and AL.LOCK002 in scopes, rel
+        src = ("import jax\n"
+               "def carve(dev):\n"
+               "    return jax.device_get(dev)\n")
+        fs = AL.lint_source(
+            src, "spark_rapids_tpu/compile/carve.py",
+            scopes=AL._scopes_for("spark_rapids_tpu/compile/carve.py"))
+        assert any(f.rule == AL.SYNC001 for f in fs)
+
 
 # ---------------------------------------------------------------------------
 # CLI + project surface
@@ -456,7 +474,7 @@ def _cli():
 class TestCliAndProject:
     @pytest.mark.parametrize("fixture", [
         "lock_inversion.py", "host_sync_kernel.py", "bad_hygiene.py",
-        "flight_alloc.py"])
+        "flight_alloc.py", "superstage_sync.py"])
     def test_cli_nonzero_on_each_seeded_fixture(self, fixture, capsys):
         assert _cli().main([os.path.join(FIXTURES, fixture)]) == 1
         out = capsys.readouterr().out

@@ -1,0 +1,411 @@
+"""Superstage compiler tests (compile/, exec/superstage.py, PV-STAGE).
+
+Four surfaces:
+
+1. Carve/lower unit contract on synthetic plans — dispatch-strategy
+   classification, region wrapping, min-ops threshold, unfusable-node
+   ejection, resolve-at-edge for non-resolving consumers, and the
+   PV-STAGE verifier pass (clean carves pass; hand-built violations of
+   each carving contract are caught).
+2. Engine determinism — the SAME query with superstage carving on vs
+   off must produce BIT-IDENTICAL output (carving changes dispatch,
+   never results): the bench-shape query hashed over its arrow IPC
+   stream across the pipeline parallelism matrix, plus TPC-DS
+   q3/q42/q52/q96 row-list equality.
+3. The flush budget — a warm carved star-join collapses to ~one fused
+   device round trip (the per-query ``flushes`` field the session now
+   logs), strictly fewer than the uncarved run.
+4. Fallbacks — duplicate-key builds fail the speculative join's fit
+   flag and redo exactly; a failing region setup disarms and retries
+   eagerly; a cancelled query unwinds from inside a superstage drain.
+"""
+import hashlib
+import os
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+import tpcds  # noqa: E402
+
+from harness import with_tpu_session  # noqa: E402
+
+from spark_rapids_tpu import compile as C
+from spark_rapids_tpu.analysis.plan_verify import STAGE, verify_plan
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar import pending
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec.base import PhysicalPlan
+from spark_rapids_tpu.exec.exchange import TpuBroadcastExchange
+from spark_rapids_tpu.exec.superstage import TpuSuperstage
+from spark_rapids_tpu.exec.tpu_basic import (TpuFilter, TpuLocalLimit,
+                                             TpuLocalScan, TpuProject)
+from spark_rapids_tpu.expr import core as ec
+from spark_rapids_tpu.expr.predicates import GreaterThan
+from spark_rapids_tpu.service.cancellation import (CancelToken,
+                                                   query_context)
+from spark_rapids_tpu.service.errors import QueryCancelledError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _table(n=64):
+    return pa.table({"a": pa.array(range(n), pa.int64()),
+                     "b": pa.array([float(i) for i in range(n)],
+                                   pa.float64())})
+
+
+def _attr(name, dt=T.INT64):
+    return ec.AttributeReference(name, dt)
+
+
+def _chain(n_ops=2, parts=1):
+    """Project(...Project(Filter(scan))) with ``n_ops`` member nodes."""
+    node = TpuLocalScan(_table(), num_partitions=parts)
+    node = TpuFilter(GreaterThan(_attr("a"), ec.Literal(3)), node)
+    for _ in range(n_ops - 1):
+        node = TpuProject([_attr("a"), _attr("b", T.FLOAT64)], node)
+    return node
+
+
+class _OpaqueExec(PhysicalPlan):
+    """Unknown passthrough operator: classify() must treat it as a
+    BOUNDARY, and carve must eject it from any region."""
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def num_partitions_hint(self):
+        return self.children[0].num_partitions_hint()
+
+    def execute(self):
+        return self.children[0].execute()
+
+
+# ---------------------------------------------------------------------------
+# lowering classification
+# ---------------------------------------------------------------------------
+
+class TestLower:
+    def test_strategies(self):
+        scan = TpuLocalScan(_table())
+        filt = TpuFilter(GreaterThan(_attr("a"), ec.Literal(3)), scan)
+        proj = TpuProject([_attr("a")], filt)
+        lim = TpuLocalLimit(5, proj)
+        assert C.classify(scan) == C.BOUNDARY
+        assert C.classify(filt) == C.PROGRAM
+        assert C.classify(proj) == C.PROGRAM
+        assert C.classify(lim) == C.PROGRAM
+        assert C.classify(TpuBroadcastExchange(scan)) == C.BOUNDARY
+        assert C.classify(_OpaqueExec(scan)) == C.BOUNDARY
+
+    def test_lower_region_and_barrier_count(self):
+        plan = _chain(3)
+        members = [plan, plan.children[0], plan.children[0].children[0]]
+        lowering = C.lower_region(members)
+        assert [s for _n, s in lowering] == [C.PROGRAM] * 3
+        assert C.barrier_count(lowering) == 0
+
+
+# ---------------------------------------------------------------------------
+# carving
+# ---------------------------------------------------------------------------
+
+class TestCarve:
+    def test_wraps_member_region(self):
+        conf = TpuConf({})
+        carved = C.carve_plan(_chain(3), conf)
+        assert isinstance(carved, TpuSuperstage)
+        assert len(carved.members) == 3          # 2 projects + filter
+        assert all(getattr(m, "_superstage", False)
+                   for m in carved.members)
+        # root consumer is the collect sink -> no edge resolve needed
+        assert carved.resolve_output is False
+        assert verify_plan(carved, passes=[STAGE]).ok
+
+    def test_min_ops_threshold(self):
+        conf = TpuConf({"spark.rapids.tpu.sql.superstage.minOps": 99})
+        carved = C.carve_plan(_chain(3), conf)
+        assert not isinstance(carved, TpuSuperstage)
+
+    def test_opaque_node_ejected_and_regions_split(self):
+        # Project over Opaque over (Project, Filter): the opaque node
+        # stays on its own dispatch; the region below it still carves
+        top = TpuProject([_attr("a"), _attr("b", T.FLOAT64)],
+                         TpuLocalLimit(8, _OpaqueExec(_chain(2))))
+        from spark_rapids_tpu.obs.registry import COMPILE_SUPERSTAGES
+        before = COMPILE_SUPERSTAGES.labels(event="ejected").value
+        carved = C.carve_plan(top, TpuConf({}))
+        after = COMPILE_SUPERSTAGES.labels(event="ejected").value
+        assert after == before + 1
+        assert isinstance(carved, TpuSuperstage)          # {proj, limit}
+        opaque = carved.children[0].children[0].children[0]
+        assert isinstance(opaque, _OpaqueExec)
+        assert isinstance(opaque.children[0], TpuSuperstage)  # below
+        report = verify_plan(carved, passes=[STAGE])
+        assert report.ok, report.violations
+
+    def test_unsafe_consumer_gets_edge_resolve(self):
+        # a region whose parent is an unknown boundary must verify its
+        # own speculative output at the stage edge
+        top = _OpaqueExec(_chain(2))
+        carved = C.carve_plan(top, TpuConf({}))
+        inner = carved.children[0]
+        assert isinstance(inner, TpuSuperstage)
+        assert inner.resolve_output is True
+
+    def test_planner_carves_only_when_enabled(self):
+        def phys_for(conf_extra):
+            def fn(s):
+                df = s.create_dataframe(_table(), num_partitions=1)
+                df.collect()
+                return s.last_physical_plan
+            return with_tpu_session(fn, conf_extra)
+
+        on = phys_for({})
+        off = phys_for({"spark.rapids.tpu.sql.superstage": False})
+
+        def has_stage(node):
+            return isinstance(node, TpuSuperstage) or \
+                any(has_stage(c) for c in node.children)
+        assert not has_stage(off)
+        # a bare scan-collect may be below min-ops (whole-stage fusion
+        # folds filter+project into ONE staged member); adding a limit
+        # gives the region a second member and it carves
+        def shaped(s):
+            from spark_rapids_tpu.api import functions as F
+            df = s.create_dataframe(_table(), num_partitions=1)
+            df = df.filter(F.col("a") > 3).select(
+                F.col("a"), (F.col("b") * 2.0).alias("b2")).limit(4)
+            df.collect()
+            return s.last_physical_plan
+        assert has_stage(with_tpu_session(shaped, {}))
+        assert on is not None
+
+
+# ---------------------------------------------------------------------------
+# PV-STAGE verifier pass
+# ---------------------------------------------------------------------------
+
+class TestStageVerifier:
+    def test_boundary_member_violation(self):
+        scan = TpuLocalScan(_table())
+        bad = TpuSuperstage(scan, [scan], C.lower_region([scan]))
+        report = verify_plan(bad, passes=[STAGE])
+        assert any("boundary class" in v.message
+                   for v in report.violations)
+
+    def test_flag_outside_region_violation(self):
+        plan = _chain(2)
+        plan._superstage = True      # armed but never carved
+        report = verify_plan(plan, passes=[STAGE])
+        assert any("outside any carved region" in v.message
+                   for v in report.violations)
+
+    def test_multi_barrier_violation(self):
+        plan = _chain(2)
+        members = [plan, plan.children[0]]
+        stage = TpuSuperstage(plan, members,
+                              [("A", C.BARRIER), ("B", C.BARRIER)])
+        for m in members:
+            m._superstage = True
+        report = verify_plan(stage, passes=[STAGE])
+        assert any("flush barriers" in v.message
+                   for v in report.violations)
+
+    def test_wrong_root_violation(self):
+        plan = _chain(2)
+        other = _chain(2)
+        stage = TpuSuperstage(plan, [other], C.lower_region([other]))
+        other._superstage = True
+        report = verify_plan(stage, passes=[STAGE])
+        assert any("wrapper's child" in v.message
+                   for v in report.violations)
+
+    def test_full_default_pass_set_on_carved_plan(self):
+        carved = C.carve_plan(_chain(3), TpuConf({}))
+        report = verify_plan(carved)        # all five passes
+        assert report.ok, report.violations
+
+
+# ---------------------------------------------------------------------------
+# determinism: bit-identical on/off, across the parallelism matrix
+# ---------------------------------------------------------------------------
+
+def _bench_shape_df(s, n_rows=60_000, parts=4):
+    from spark_rapids_tpu.api import functions as F
+    rng = np.random.default_rng(7)
+    df = s.create_dataframe({
+        "k": rng.integers(0, 1000, n_rows).astype(np.int64),
+        "a": rng.integers(-100_000, 100_000, n_rows).astype(np.int64),
+        "x": rng.random(n_rows),
+        "y": rng.random(n_rows),
+    }, num_partitions=parts)
+    dim = s.create_dataframe({
+        "dk": np.arange(1000, dtype=np.int64),
+        "w": rng.random(1000),
+    }, num_partitions=1)
+    agg = (df.filter((F.col("x") > 0.1) & (F.col("a") % 7 != 0))
+             .with_column("z", F.col("x") * F.col("y") + F.col("a"))
+             .group_by("k")
+             .agg(F.sum("z").alias("sz"), F.count().alias("c"),
+                  F.max("x").alias("mx")))
+    return (agg.join(dim, agg["k"] == dim["dk"], "inner")
+               .select(F.col("k"), F.col("sz"), F.col("c"),
+                       (F.col("mx") * F.col("w")).alias("mw")))
+
+
+def _ipc_hash(table: pa.Table) -> str:
+    table = table.combine_chunks()
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return hashlib.sha256(sink.getvalue().to_pybytes()).hexdigest()
+
+
+def test_bench_shape_identical_across_superstage_and_parallelism():
+    hashes = {}
+    for stage_on in (True, False):
+        for par in (1, 4):
+            conf = {"spark.rapids.tpu.sql.superstage": stage_on,
+                    "spark.rapids.tpu.exec.pipelineParallelism": par,
+                    "spark.rapids.tpu.exec.pipelinePrefetchDepth": par}
+            tbl = with_tpu_session(
+                lambda s: _bench_shape_df(s).to_arrow(), conf)
+            hashes[(stage_on, par)] = _ipc_hash(tbl)
+    assert len(set(hashes.values())) == 1, hashes
+
+
+@pytest.fixture(scope="module")
+def tpcds_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpcds_compile") / "sf")
+    tpcds.generate(d, scale=0.002, seed=11)
+    return d
+
+
+def _run_tpcds(tpcds_dir, query, conf):
+    def fn(s):
+        tpcds.register(s, tpcds_dir)
+        rows = s.sql(tpcds.QUERIES[query]).collect()
+        return rows, getattr(s, "last_query_flushes", None)
+    return with_tpu_session(fn, conf)
+
+
+@pytest.mark.parametrize("query", ["q3", "q42", "q52", "q96"])
+def test_tpcds_identical_superstage_on_off(tpcds_dir, query):
+    on_rows, on_flushes = _run_tpcds(tpcds_dir, query, {})
+    off_rows, off_flushes = _run_tpcds(
+        tpcds_dir, query, {"spark.rapids.tpu.sql.superstage": False})
+    # exact row-for-row equality INCLUDING order
+    assert on_rows == off_rows
+    h_on = hashlib.sha256(repr(on_rows).encode()).hexdigest()
+    h_off = hashlib.sha256(repr(off_rows).encode()).hexdigest()
+    assert h_on == h_off
+    assert on_flushes is not None and off_flushes is not None
+
+
+def test_tpcds_q3_warm_flush_budget(tpcds_dir):
+    # the acceptance criterion at test scale: a warm carved star-join
+    # runs in at most 2 fused round trips, strictly fewer than uncarved
+    def fn(s):
+        tpcds.register(s, tpcds_dir)
+        sql = tpcds.QUERIES["q3"]
+        s.sql(sql).collect()               # warm (compile caches)
+        f0 = pending.FLUSH_COUNT
+        s.sql(sql).collect()
+        return pending.FLUSH_COUNT - f0
+
+    warm_on = with_tpu_session(fn, {})
+    warm_off = with_tpu_session(
+        fn, {"spark.rapids.tpu.sql.superstage": False})
+    assert warm_on <= 2, f"carved warm q3 took {warm_on} flushes"
+    assert warm_on < warm_off, (warm_on, warm_off)
+
+
+def test_flushes_in_event_log(tmp_path):
+    from spark_rapids_tpu.tools.events import read_event_log
+    log = str(tmp_path / "events.jsonl")
+
+    def fn(s):
+        _bench_shape_df(s, n_rows=5_000, parts=2).to_arrow()
+    with_tpu_session(fn, {"spark.rapids.tpu.eventLog.path": log})
+    recs = read_event_log(log)
+    assert recs and isinstance(recs[-1].get("flushes"), int)
+    assert recs[-1]["flushes"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# fallbacks
+# ---------------------------------------------------------------------------
+
+def test_duplicate_key_build_redoes_exactly():
+    # build side holds duplicate keys -> the speculative unique-match
+    # fit flag FAILS and the join must redo on the exact sized path,
+    # matching the uncarved engine row-for-row
+    def q(s):
+        from spark_rapids_tpu.api import functions as F
+        left = s.create_dataframe({
+            "k": np.array([1, 2, 3, 4, 5, 2, 7, 8], np.int64),
+            "v": np.arange(8, dtype=np.int64)}, num_partitions=1)
+        right = s.create_dataframe({
+            "rk": np.array([2, 2, 3, 3, 9], np.int64),
+            "w": np.arange(5, dtype=np.int64)}, num_partitions=1)
+        j = (left.join(right, left["k"] == right["rk"], "inner")
+                 .select(F.col("k"), F.col("v"), F.col("w")))
+        return sorted(map(tuple, j.collect()))
+
+    on = with_tpu_session(q, {})
+    off = with_tpu_session(q, {"spark.rapids.tpu.sql.superstage": False})
+    assert on == off
+    assert len(on) == 6                    # 2x(k=2 twice) + 2 for k=3
+
+
+def test_stage_setup_failure_falls_back_eagerly():
+    plan = _chain(2)
+    carved = C.carve_plan(plan, TpuConf({}))
+    assert isinstance(carved, TpuSuperstage)
+    root = carved.children[0]
+    orig_execute = root.execute
+    calls = []
+
+    def boom():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("trace failure")
+        return orig_execute()
+
+    root.execute = boom
+    from spark_rapids_tpu.obs.registry import COMPILE_SUPERSTAGES
+    before = COMPILE_SUPERSTAGES.labels(event="fallback").value
+    parts = carved.execute()
+    rows = sum(b.num_rows for p in parts for b in p)
+    assert rows == 60                      # 64 rows, filter a > 3
+    assert len(calls) == 2
+    # the retry ran DISARMED: per-operator dispatch, flags stripped
+    assert all(not getattr(m, "_superstage", False)
+               for m in carved.members)
+    assert COMPILE_SUPERSTAGES.labels(event="fallback").value == \
+        before + 1
+
+
+def test_cancel_unwinds_mid_superstage():
+    # the per-batch timed region inside TpuSuperstage._drain is a
+    # cancellation checkpoint: a token cancelled between batches must
+    # unwind the drain with QueryCancelledError
+    carved = C.carve_plan(_chain(2, parts=4), TpuConf({}))
+    assert isinstance(carved, TpuSuperstage)
+    token = CancelToken(query_id="stage-cancel")
+    with query_context(token):
+        parts = carved.execute()
+        got = 0
+        with pytest.raises(QueryCancelledError):
+            for part in parts:
+                for _b in part:
+                    got += 1
+                    token.cancel("test-cancel")
+    assert got >= 1
