@@ -41,6 +41,15 @@ disabled, and the flushes / superstage_off_flushes keys report the warm
 per-query device round trips under each mode (the cost model the
 compiler optimizes).  Output is bit-identical either way
 (tests/test_compile.py).
+
+Stats split: since r07 the runtime stats plane (obs/stats.py,
+spark.rapids.tpu.obs.stats.*) is ON in the headline configuration —
+it is designed to add zero device flushes, so its cost is pure host
+work.  stats_off_Mrows_s re-measures the exact headline with stats
+collection disabled and stats_overhead_pct reports the on/off overhead
+(budget: <= 2%, asserted by ci/stats_smoke.py with a loose bound).
+dispatch_p50_ms / dispatch_p95_ms are the warm query's device-dispatch
+duration percentiles from the StatsProfile's "all" roll-up.
 """
 import json
 import sys
@@ -77,7 +86,8 @@ def build_df(session, n_rows: int, num_partitions: int):
 
 def run_engine(enabled: bool, n_rows: int, num_partitions: int,
                repeats: int, variable_float: bool = True,
-               pipeline: bool = True, superstage: bool = True):
+               pipeline: bool = True, superstage: bool = True,
+               stats: bool = True):
     from spark_rapids_tpu.api import TpuSession
     from spark_rapids_tpu.config import TpuConf
     # tuned like the reference's benchmark guides tune Spark: large
@@ -101,6 +111,9 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
         # superstage carving (compile/): superstage=False is the
         # superstage_off measurement of the same exact-mode query
         "spark.rapids.tpu.sql.superstage": superstage,
+        # runtime stats plane (obs/stats.py): stats=False is the
+        # stats_off measurement behind stats_overhead_pct
+        "spark.rapids.tpu.obs.stats.enabled": stats,
     }))
     # build the query ONCE: the measurement is query execution over
     # loaded data (the reference's benchmark shape), not datagen/upload
@@ -117,7 +130,8 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
     # pending-pool flush delta around each execution) — the flushes
     # column every BENCH_r now reports alongside throughput
     flushes = getattr(s, "last_query_flushes", None)
-    return best, flushes
+    prof = getattr(s, "last_stats_profile", None)
+    return best, flushes, (prof.to_dict() if prof is not None else None)
 
 
 def main():
@@ -129,16 +143,22 @@ def main():
     repeats = 3
     # headline: the DEFAULT conf (exact float aggregation) — the 8-bit
     # chunk-lane / two-stage-u32 exact table path (exec/tpu_aggregate)
-    tpu_exact_t, tpu_flushes = run_engine(True, n_rows, parts, repeats,
-                                          variable_float=False)
-    tpu_off_t, _ = run_engine(True, n_rows, parts, repeats,
-                              variable_float=False, pipeline=False)
-    tpu_nostage_t, nostage_flushes = run_engine(
+    tpu_exact_t, tpu_flushes, tpu_prof = run_engine(
+        True, n_rows, parts, repeats, variable_float=False)
+    # stats-off runs ADJACENT to the headline: the on/off overhead is a
+    # fixed ~10-15ms of host work per query, so at small n the pair
+    # must share process cache state or session-order drift swamps it
+    tpu_nostats_t, _, _ = run_engine(True, n_rows, parts, repeats,
+                                     variable_float=False, stats=False)
+    tpu_off_t, _, _ = run_engine(True, n_rows, parts, repeats,
+                                 variable_float=False, pipeline=False)
+    tpu_nostage_t, nostage_flushes, _ = run_engine(
         True, n_rows, parts, repeats, variable_float=False,
         superstage=False)
-    tpu_var_t, _ = run_engine(True, n_rows, parts, repeats,
-                              variable_float=True)
-    cpu_t, _ = run_engine(False, n_rows, parts, repeats)
+    tpu_var_t, _, _ = run_engine(True, n_rows, parts, repeats,
+                                 variable_float=True)
+    cpu_t, _, _ = run_engine(False, n_rows, parts, repeats)
+    disp = (tpu_prof or {}).get("dispatches", {}).get("all", {})
     print(json.dumps({
         "metric": "sql_pipeline_throughput",
         "value": round(n_rows / tpu_exact_t / 1e6, 3),
@@ -161,6 +181,15 @@ def main():
         "superstage_on_vs_off": round(tpu_nostage_t / tpu_exact_t, 3),
         "flushes": tpu_flushes,
         "superstage_off_flushes": nostage_flushes,
+        # runtime stats plane (obs/stats.py): on/off overhead of the
+        # exact headline (the plane adds zero flushes, so this is pure
+        # host-side cost; budget <= 2%) + the warm query's dispatch
+        # duration percentiles from the StatsProfile
+        "stats_off_Mrows_s": round(n_rows / tpu_nostats_t / 1e6, 3),
+        "stats_overhead_pct": round(
+            (tpu_exact_t - tpu_nostats_t) / tpu_nostats_t * 100, 2),
+        "dispatch_p50_ms": disp.get("p50_ms"),
+        "dispatch_p95_ms": disp.get("p95_ms"),
     }))
 
 

@@ -44,6 +44,9 @@ JAX_PLATFORMS=cpu python ci/pipeline_smoke.py
 echo "== superstage compiler (carve smoke, flush budget, determinism) =="
 JAX_PLATFORMS=cpu python ci/compile_smoke.py
 
+echo "== runtime stats plane (attribution, skew stats, zero extra flushes) =="
+JAX_PLATFORMS=cpu python ci/stats_smoke.py
+
 echo "== api validation (docs vs live registry) =="
 python -m spark_rapids_tpu.tools.api_validation
 

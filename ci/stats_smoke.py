@@ -1,0 +1,169 @@
+"""CI smoke for the runtime stats plane (obs/stats.py, obs/profile.py):
+run TPC-DS q3 and q96 at tiny scale with stats ON and assert
+
+1. profile smoke — every warm query yields a StatsProfile where every
+   exchange and scan carries per-partition rows (q3/q96 at tiny scale
+   carve to broadcast-only plans, so a multi-partition shuffle query
+   rides along to cover the shuffle skew/sketch path), and whose
+   superstage entries (q3/q96 carve) have member time shares summing
+   to exactly 1.0 over attributed device time;
+2. the zero-flush contract — the warm flush count with stats on equals
+   the warm flush count with stats off, per query (the sketch rides the
+   exchange's own finalize dispatch);
+3. report rendering — tools/report.py --stats renders the stats
+   sections from the event log the queries just wrote;
+4. overhead sanity — a LOOSE wall-time bound on the warm stats-on/off
+   ratio (the exact <=2% headline budget is measured by bench.py into
+   BENCH_r as stats_overhead_pct; CI hosts are too noisy to pin 2%);
+5. the stats-scoped lint rules are clean on the plane's own files (the
+   layer that promises zero flushes must not contain a hidden sync).
+"""
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+sys.path.insert(0, os.path.join(REPO_ROOT, "benchmarks"))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import tpcds  # noqa: E402
+
+from spark_rapids_tpu.analysis import lint as AL  # noqa: E402
+from spark_rapids_tpu.api import TpuSession  # noqa: E402
+from spark_rapids_tpu.columnar import pending  # noqa: E402
+from spark_rapids_tpu.config import TpuConf  # noqa: E402
+from spark_rapids_tpu.tools import report  # noqa: E402
+
+QUERIES = ("q3", "q96")
+
+
+def _session(stats: bool, event_log: str | None = None) -> TpuSession:
+    conf = {
+        "spark.rapids.tpu.sql.enabled": True,
+        "spark.rapids.tpu.sql.batchSizeRows": 1 << 22,
+        "spark.rapids.tpu.sql.reader.batchSizeRows": 1 << 22,
+        "spark.rapids.tpu.obs.stats.enabled": stats,
+    }
+    if event_log:
+        conf["spark.rapids.tpu.eventLog.path"] = event_log
+    return TpuSession(TpuConf(conf))
+
+
+def _warm_run(sess, sql):
+    """Second (warm) run of a query: rows, flush delta, wall seconds."""
+    sess.sql(sql).collect()
+    f0 = pending.FLUSH_COUNT
+    t0 = time.perf_counter()
+    rows = sess.sql(sql).collect()
+    wall = time.perf_counter() - t0
+    return rows, pending.FLUSH_COUNT - f0, wall
+
+
+def main():
+    data_dir = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "tpcds_compile_smoke", "sf")
+    if not os.path.exists(os.path.join(data_dir, "store_sales.parquet")):
+        tpcds.generate(data_dir, scale=0.002, seed=11)
+
+    event_log = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "stats_smoke_events.jsonl")
+    if os.path.exists(event_log):
+        os.remove(event_log)
+
+    s_on = _session(True, event_log)
+    s_off = _session(False)
+    tpcds.register(s_on, data_dir)
+    tpcds.register(s_off, data_dir)
+
+    on_wall = off_wall = 0.0
+    for q in QUERIES:
+        sql = tpcds.QUERIES[q]
+        rows_on, flushes_on, wall_on = _warm_run(s_on, sql)
+        rows_off, flushes_off, wall_off = _warm_run(s_off, sql)
+        on_wall += wall_on
+        off_wall += wall_off
+
+        # -- determinism + the zero-flush contract
+        assert rows_on == rows_off, f"{q}: stats changed results"
+        assert flushes_on == flushes_off, \
+            f"{q}: stats added device flushes " \
+            f"(on={flushes_on} off={flushes_off})"
+
+        # -- profile smoke
+        prof = s_on.last_stats_profile
+        assert prof is not None, f"{q}: no StatsProfile recorded"
+        d = prof.to_dict()
+        assert d["flushes"] == flushes_on
+        assert d["exchanges"], f"{q}: no exchange stats"
+        for e in d["exchanges"]:
+            assert e["partitions"], f"{q}: exchange without partitions"
+            if e["kind"] == "shuffle":
+                assert e["rows"] == sum(p["rows"] for p in e["partitions"])
+                assert "skewed" in e["skew"] and "ratio" in e["skew"]
+        assert d["scans"], f"{q}: no scan stats"
+        assert all(e["partitions"] for e in d["scans"])
+        assert d["superstages"], f"{q}: no superstage attribution"
+        for st in d["superstages"]:
+            total = sum(st["member_share"].values())
+            assert abs(total - 1.0) < 1e-9, \
+                f"{q}: member shares sum to {total}"
+        assert d["dispatches"].get("all", {}).get("count", 0) >= 1
+        print(f"  {q}: rows={len(rows_on)} flushes={flushes_on} "
+              f"exchanges={len(d['exchanges'])} scans={len(d['scans'])} "
+              f"stages={len(d['superstages'])}")
+
+    # -- shuffle skew/sketch path: q3/q96's tiny-scale plans are
+    # broadcast-only, so a multi-partition aggregate covers the
+    # partition-split sketch and the skew verdict
+    from spark_rapids_tpu.api import functions as F
+    df = s_on.range(0, 40_000, 1, 4)
+    df = df.with_column("k", df["id"] % 97)
+    df = df.group_by("k").agg(F.sum("id").alias("s"))
+    df.collect()
+    df.collect()
+    d = s_on.last_stats_profile.to_dict()
+    shuffles = [e for e in d["exchanges"] if e["kind"] == "shuffle"]
+    assert shuffles, "no shuffle exchange stats in the shuffle query"
+    for e in shuffles:
+        assert e["rows"] == sum(p["rows"] for p in e["partitions"])
+        assert "skewed" in e["skew"] and "ratio" in e["skew"]
+        assert e["distinct_est"] is not None
+        err = abs(e["distinct_est"] - 97) / 97
+        assert err < 0.25, f"distinct est {e['distinct_est']} vs 97"
+    print(f"  shuffle query: exchanges={len(shuffles)} "
+          f"distinct_est={shuffles[0]['distinct_est']:.1f} "
+          f"skew_ratio={shuffles[0]['skew']['ratio']}")
+
+    # -- report rendering from the event log the queries just wrote
+    stories = report.load_query_stories(event_log)
+    txt = report.render_report(stories, show_stats=True)
+    assert "exchange data statistics" in txt
+    assert "superstage device-time attribution" in txt
+    assert "dispatch durations" in txt
+
+    # -- overhead sanity: loose CI bound (exact budget lives in bench.py)
+    assert on_wall <= off_wall * 1.5 + 0.25, \
+        f"stats overhead implausible: on={on_wall:.3f}s off={off_wall:.3f}s"
+    print(f"  overhead: warm on={on_wall * 1e3:.1f}ms "
+          f"off={off_wall * 1e3:.1f}ms")
+
+    # -- stats-scoped lint clean on the plane's own files
+    findings = []
+    for rel in ("spark_rapids_tpu/obs/stats.py",
+                "spark_rapids_tpu/obs/profile.py",
+                "spark_rapids_tpu/exec/exchange.py"):
+        with open(os.path.join(REPO_ROOT, rel)) as f:
+            src = f.read()
+        findings += AL.lint_source(src, rel,
+                                   scopes=AL._scopes_for(rel))
+    assert findings == [], AL.format_findings(findings)
+
+    print("stats smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -661,10 +661,14 @@ def _scopes_for(rel: str) -> Set[str]:
         scopes |= {LOCK001, LOCK002}
     if "kernels" in parts or "compile" in parts or \
             base.startswith("tpu_") or \
-            base in ("pipeline.py", "superstage.py"):
+            base in ("pipeline.py", "superstage.py", "exchange.py",
+                     "stats.py", "profile.py"):
         # the superstage compiler exists to ELIMINATE host round trips:
         # a stray device_get/np.asarray in compile/ or the wrapper
-        # would silently reintroduce the cost it removes
+        # would silently reintroduce the cost it removes; the stats
+        # plane (obs/stats.py, obs/profile.py) and its exchange call
+        # sites carry the same zero-flush + allocation-free-record
+        # contract
         scopes |= {SYNC001, OBS002}
     if "obs" in parts:
         scopes |= {HYG002}

@@ -225,6 +225,12 @@ class TpuSession:
         from ..memory.catalog import BufferCatalog
         from ..service.cancellation import current_token, observe
         conf = conf or self.conf
+        # the executing query's conf is the ambient conf for THIS
+        # thread for the duration of the drain: with several live
+        # sessions, "last constructed wins" would hand exec-layer
+        # get_active() callers (shuffle staging budget, stats plane)
+        # another session's settings
+        set_active(conf, thread_only=True)
         if fallbacks is None:
             fallbacks = self._last_planner.fallbacks \
                 if self._last_planner else []
@@ -239,7 +245,15 @@ class TpuSession:
         # they fall — exact when queries run serially, which is how the
         # flush budget is benchmarked)
         from ..columnar import pending
+        from ..obs import profile as _profile
+        from ..obs import stats as _stats
         flushes0 = pending.FLUSH_COUNT
+        disp_marker = _profile.begin_query()
+        # collect-sink flushes belong to the root-most fused superstage
+        # when the plan has one (obs/profile.py attribution scopes)
+        _attrib = next((n for n in phys.collect_nodes()
+                        if getattr(n, "lowering", None) is not None),
+                       phys)
         token = current_token()
         try:
             # drain all partitions first (device work + staged pulls),
@@ -258,10 +272,11 @@ class TpuSession:
                 # flush the verification forces then carries the values
                 # too, so a fully speculative chain (superstage join ->
                 # agg -> sort -> limit) collects in ONE round trip
-                stage_batch(item)
-                fixed = resolve_speculative(item)
-                if fixed is not item:
-                    stage_batch(fixed)
+                with _profile.attrib_scope(_attrib):
+                    stage_batch(item)
+                    fixed = resolve_speculative(item)
+                    if fixed is not item:
+                        stage_batch(fixed)
                 return fixed
             items = [item for _pid, item in drain_parallel(
                 phys.execute_checkpointed(), sink=_resolve,
@@ -295,11 +310,28 @@ class TpuSession:
         flushes = pending.FLUSH_COUNT - flushes0
         self.last_query_flushes = flushes
         observe("flushes", flushes)
+        extra = {"sem_wait_ms": round(sem_wait_ms, 3),
+                 "spill_bytes": int(spill_bytes),
+                 "flushes": int(flushes)}
+        # per-query StatsProfile (obs/stats.py): read-only over resolved
+        # values — built AFTER the final flush, never adds a round trip
+        self.last_stats_profile = None
+        if _stats.enabled(conf):
+            from ..config import OBS_STATS_IN_EVENT_LOG
+            try:
+                prof = _stats.build_profile(
+                    phys,
+                    query_id=token.query_id if token is not None else None,
+                    flushes=int(flushes), dispatch_marker=disp_marker)
+                self.last_stats_profile = prof
+                if conf.get(OBS_STATS_IN_EVENT_LOG):
+                    extra["stats_profile"] = prof.to_dict()
+            except Exception:  # noqa: BLE001 — stats never fail a query
+                import logging
+                logging.getLogger("spark_rapids_tpu.obs.stats").warning(
+                    "stats profile build failed", exc_info=True)
         self._log_query(phys, (_time.perf_counter() - t0) * 1000,
-                        conf=conf, fallbacks=fallbacks,
-                        extra={"sem_wait_ms": round(sem_wait_ms, 3),
-                               "spill_bytes": int(spill_bytes),
-                               "flushes": int(flushes)})
+                        conf=conf, fallbacks=fallbacks, extra=extra)
         target = schema_to_arrow(phys.output_schema) if len(
             phys.output_schema) else None
         if not tables:

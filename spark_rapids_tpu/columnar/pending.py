@@ -27,6 +27,7 @@ high-latency remote device.
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from typing import List, Optional, Tuple
 
@@ -211,6 +212,12 @@ def pd_isnan(a: np.ndarray) -> np.ndarray:
 # model on remote-dispatch backends; see docs/perf.md)
 FLUSH_COUNT = 0
 
+#: stats-plane hook (obs/profile.py): called as ``observer(dur_ns,
+#: n_items)`` after each non-empty flush completes.  Module attribute,
+#: not a registry: this is the hottest host path in the engine and one
+#: global load + None-check is all it may cost when unset.
+_FLUSH_OBSERVER = None
+
 
 def flush():
     """Pull every staged array in at most two fused transfers."""
@@ -225,6 +232,20 @@ def flush():
     if not items:
         return
     FLUSH_COUNT += 1
+    obs = _FLUSH_OBSERVER
+    if obs is None:
+        return _flush_items(items)
+    t0 = time.perf_counter_ns()
+    try:
+        return _flush_items(items)
+    finally:
+        try:
+            obs(time.perf_counter_ns() - t0, len(items))
+        except Exception:  # noqa: BLE001 — observers never break a flush
+            pass
+
+
+def _flush_items(items: List[Staged]):
     if len(items) == 1 or not _check_encoding():
         for it in items:
             it._val = np.asarray(it.dev)

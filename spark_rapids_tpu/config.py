@@ -463,6 +463,33 @@ OBS_DIAG_MAX_BUNDLES = conf_int(
     "spark.rapids.tpu.obs.diagnostics.maxBundles", 20,
     "Rotation bound on the diagnostics dir: after each write the "
     "oldest diag-*.json beyond this many are deleted")
+OBS_STATS_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.stats.enabled", True,
+    "Runtime stats plane (obs/stats.py + obs/profile.py): per-dispatch "
+    "device-time attribution under superstage fusion plus exchange-"
+    "boundary data statistics (per-partition rows/bytes/null counts/"
+    "key min-max, an on-device HLL distinct-key sketch, and a skew "
+    "verdict), assembled into a per-query StatsProfile persisted with "
+    "the event log and exported as tpu_stats_* metrics.  All device-"
+    "side collection rides dispatches the query already makes: the "
+    "plane adds ZERO pending-pool flushes (tests/test_stats.py asserts "
+    "the FLUSH_COUNT delta)")
+OBS_STATS_SKETCH_REGISTERS = conf_int(
+    "spark.rapids.tpu.obs.stats.sketchRegisters", 512,
+    "Register count (m) of the HLL-style distinct-key sketch computed "
+    "in the same dispatch window as each hash-exchange split.  Rounded "
+    "down to a power of two, minimum 64; relative error is about "
+    "1.04/sqrt(m) (~4.6% at the default 512)")
+OBS_STATS_SKEW_FACTOR = conf_float(
+    "spark.rapids.tpu.obs.stats.skewFactor", 4.0,
+    "An exchange is flagged skewed when its largest partition holds "
+    "more than this multiple of the median partition's rows (the AQE "
+    "skew-join threshold role; ROADMAP item 3 consumes the verdict)")
+OBS_STATS_IN_EVENT_LOG = conf_bool(
+    "spark.rapids.tpu.obs.stats.profileInEventLog", True,
+    "Persist the per-query StatsProfile artifact inside the engine "
+    "event-log record (tools/report.py --stats renders it); off keeps "
+    "the profile reachable only via session.last_stats_profile")
 SUPERSTAGE = conf_bool(
     "spark.rapids.tpu.sql.superstage", True,
     "Superstage compiler (compile/): a planner post-pass after the "

@@ -103,54 +103,87 @@ class TpuShuffleExchange(TpuExec):
         # exchanges materialized everything above for bound sampling;
         # the budget does not cover that path.)
         from ..config import get_active, SHUFFLE_MAP_STAGING_BYTES
-        budget = int(get_active().get(SHUFFLE_MAP_STAGING_BYTES))
+        from ..obs import profile
+        from ..obs import stats as obs_stats
+        conf = get_active()
+        budget = int(conf.get(SHUFFLE_MAP_STAGING_BYTES))
         n_red = self.partitioner.num_partitions
-        staged = []            # (map_id, batch, (sorted_batch, counts))
+        stats_on = obs_stats.enabled(conf)
+        if stats_on:
+            acc = obs_stats.exchange_acc(
+                self, n_red, obs_stats.sketch_registers(conf),
+                obs_stats._row_width(self.output_schema), "shuffle",
+                type(self.partitioner).__name__)
+        # flushes forced at this barrier belong to the producing stage:
+        # attribute to the fused superstage feeding the exchange when
+        # there is one, else to the exchange itself (obs/profile.py)
+        child = self.children[0]
+        attrib_target = child if getattr(child, "lowering", None) \
+            is not None else self
+        staged = []        # (map_id, batch, (sorted_batch, counts), st)
         staged_bytes = 0
 
         def finalize_staged():
             nonlocal staged_bytes
-            pending.flush()
-            per_reduce_by_map = {}
-            for map_id, batch, (sorted_batch, counts) in staged:
-                checked = resolve_speculative(batch)
-                if checked is not batch:
-                    with timed(self.metrics[PARTITION_TIME], self):
-                        sorted_batch, counts = \
-                            self.partitioner.split_staged(checked)
-                split = self.partitioner.finalize_split(sorted_batch,
-                                                        counts)
-                if split.offsets[-1] == 0:
-                    continue
-                per_reduce = per_reduce_by_map.setdefault(map_id, {})
-                for pid in range(n_red):
-                    piece = split.partition_slice(pid)
-                    if piece is not None:
-                        per_reduce.setdefault(pid, []).append(piece)
-            staged.clear()
-            staged_bytes = 0
-            for map_id, per_reduce in per_reduce_by_map.items():
-                mgr.append_map_output(self._shuffle_id, map_id,
-                                      per_reduce)
+            with profile.attrib_scope(attrib_target):
+                pending.flush()
+                per_reduce_by_map = {}
+                for map_id, batch, (sorted_batch, counts), st in staged:
+                    checked = resolve_speculative(batch)
+                    if checked is not batch:
+                        with timed(self.metrics[PARTITION_TIME], self):
+                            sorted_batch, counts = \
+                                self.partitioner.split_staged(checked)
+                        if stats_on:
+                            # the staged sketch saw the failed
+                            # speculative batch; re-stage from the exact
+                            # one BEFORE finalize_split forces the redo
+                            # flush, which then resolves it for free
+                            st = obs_stats.stage_exchange_batch(
+                                self.partitioner, checked, acc.m)
+                    split = self.partitioner.finalize_split(sorted_batch,
+                                                            counts)
+                    if stats_on:
+                        acc.absorb(split.offsets, st)
+                    if split.offsets[-1] == 0:
+                        continue
+                    per_reduce = per_reduce_by_map.setdefault(map_id, {})
+                    for pid in range(n_red):
+                        piece = split.partition_slice(pid)
+                        if piece is not None:
+                            per_reduce.setdefault(pid, []).append(piece)
+                staged.clear()
+                staged_bytes = 0
+                for map_id, per_reduce in per_reduce_by_map.items():
+                    mgr.append_map_output(self._shuffle_id, map_id,
+                                          per_reduce)
 
         def split_one(batch):
             # runs on pipeline producers (under the DeviceSemaphore):
             # the split's device dispatch + host prep for one map batch
-            # overlaps the splits of other partitions in flight
-            with timed(self.metrics[PARTITION_TIME], self):
-                return batch, self.partitioner.split_staged(batch)
+            # overlaps the splits of other partitions in flight; the
+            # stats sketch is enqueued in the SAME dispatch window so
+            # it rides the finalize flush (zero extra round trips)
+            with timed(self.metrics[PARTITION_TIME], self), \
+                    profile.dispatch(profile.SITE_SPLIT):
+                split = self.partitioner.split_staged(batch)
+                st = obs_stats.stage_exchange_batch(
+                    self.partitioner, batch, acc.m) if stats_on else None
+                return batch, split, st
 
         # morsel-parallel map drain (exec/pipeline.py): partitions are
         # pulled + split concurrently, but arrive here in deterministic
         # (map_id, batch) order, so staging/flush boundaries — and the
         # map output — are identical to the serial drain's
-        for map_id, (batch, split) in drain_parallel(
+        for map_id, (batch, split, st) in drain_parallel(
                 in_parts, sink=split_one, label="shuffle_map"):
-            staged.append((map_id, batch, split))
+            staged.append((map_id, batch, split, st))
             staged_bytes += 2 * batch.nbytes()
             if staged_bytes > budget:
                 finalize_staged()
         finalize_staged()
+        if stats_on:
+            obs_stats.finish_exchange(self, conf)
 
     def ensure_materialized(self):
         """Run the map side once (the AQE stage-materialization barrier).
@@ -295,10 +328,23 @@ class TpuBroadcastExchange(TpuExec):
                     # the broadcast costs zero round trips here
                     self._result = raw[0]
                 else:
-                    batches = [resolve_speculative(b) for b in raw]
-                    batches = [b for b in batches if b.num_rows > 0]
+                    from ..obs import profile
+                    child = self.children[0]
+                    target = child if getattr(child, "lowering", None) \
+                        is not None else self
+                    with profile.attrib_scope(target):
+                        batches = [resolve_speculative(b) for b in raw]
+                        batches = [b for b in batches if b.num_rows > 0]
                     self._result = concat_batches(batches) if batches \
                         else ColumnarBatch.empty(self.output_schema)
+                from ..obs import stats as obs_stats
+                # unconditional: a bare attribute store, so no conf
+                # lookup on this helper thread (ambient-conf fallback
+                # is unreliable off the session/pipeline threads); the
+                # session's own conf gates everything at profile-build
+                # time, and rows read lazily there — the single-batch
+                # path stays zero-round-trip
+                obs_stats.note_broadcast(self, self._result)
         return self._result
 
     def execute(self):

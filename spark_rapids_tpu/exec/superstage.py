@@ -86,7 +86,7 @@ class TpuSuperstage(TpuExec):
 
     def _drain(self, part, pid: int):
         from ..columnar import pending
-        from ..obs import flight
+        from ..obs import flight, profile
         from ..obs.registry import COMPILE_SUPERSTAGE_FLUSHES
         f0 = pending.FLUSH_COUNT
         flight.record(flight.EV_COMPILE, "stage_begin", pid,
@@ -94,14 +94,19 @@ class TpuSuperstage(TpuExec):
         it = iter(part)
         while True:
             # the timed region is the stage's cancel checkpoint + span:
-            # one entry per pulled batch, like any member operator
-            with timed(self.metrics[OP_TIME], self):
+            # one entry per pulled batch, like any member operator; the
+            # attrib scope makes this stage the owner of any flush the
+            # chain step forces (stats plane, obs/profile.py)
+            with timed(self.metrics[OP_TIME], self), \
+                    profile.attrib_scope(self), \
+                    profile.dispatch(profile.SITE_CHAIN_STEP):
                 batch = next(it, _SENTINEL)
             if batch is _SENTINEL:
                 break
             if self.resolve_output:
                 from ..columnar.batch import resolve_speculative
-                batch = resolve_speculative(batch)
+                with profile.attrib_scope(self):
+                    batch = resolve_speculative(batch)
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield batch
         flushes = pending.FLUSH_COUNT - f0

@@ -116,6 +116,15 @@ class TpuLocalScan(TpuExec):
         return parts
 
     def execute(self):
+        from ..obs import stats as obs_stats
+        if obs_stats.enabled():
+            # exact per-partition sizes from the slicing arithmetic —
+            # zero device work (stats plane, obs/stats.py)
+            n = self.table.num_rows
+            per = -(-n // self.num_partitions) if n else 0
+            obs_stats.note_scan(self, [
+                min(i * per + per, n) - min(i * per, n)
+                for i in range(self.num_partitions)])
         return [iter(batches) for batches in self._cached_batches()]
 
 
@@ -139,6 +148,11 @@ class TpuRange(TpuExec):
     def execute(self):
         total = max(0, -(-(self.end - self.start) // self.step))
         per = -(-total // self.num_partitions) if total else 0
+        from ..obs import stats as obs_stats
+        if obs_stats.enabled():
+            obs_stats.note_scan(self, [
+                max(0, min((i + 1) * per, total) - i * per)
+                for i in range(self.num_partitions)])
         parts = []
         for i in range(self.num_partitions):
             lo, hi = i * per, min((i + 1) * per, total)

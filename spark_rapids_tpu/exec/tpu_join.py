@@ -169,10 +169,12 @@ class TpuHashJoinBase(TpuExec):
                 and build.capacity > 0:
             from ..config import get_active, SUPERSTAGE_SPEC_JOIN
             if get_active().get(SUPERSTAGE_SPEC_JOIN):
+                from ..obs import profile
                 spec_outs = []
                 for sb, skey_cols in zip(stream_batches,
                                          skey_cols_per_batch):
-                    with timed(self.metrics[JOIN_TIME], self):
+                    with timed(self.metrics[JOIN_TIME], self), \
+                            profile.dispatch(profile.SITE_SPEC_PROBE):
                         out = self._spec_join_batch(
                             sb, skey_cols, bt, build, direct,
                             stream_keys, str_words)
@@ -493,22 +495,28 @@ class TpuHashJoinBase(TpuExec):
 
         def _redo(sb=sb, skey_cols=skey_cols):
             from ..columnar import pending
-            fixed = resolve_speculative(sb)
-            kc = skey_cols if fixed is sb else \
-                [ec.eval_as_column(e, fixed) for e in stream_keys]
-            with timed(self.metrics[JOIN_TIME], self):
-                pa = self._probe_phase(fixed, kc, bt, str_words, None,
-                                       direct)
-            pending.flush()
-            if pa is None:
+            from ..obs import profile
+            from ..obs.registry import superstage_event
+            superstage_event("spec_redo")
+            with profile.dispatch(profile.SITE_SPEC_REDO):
+                fixed = resolve_speculative(sb)
+                kc = skey_cols if fixed is sb else \
+                    [ec.eval_as_column(e, fixed) for e in stream_keys]
                 with timed(self.metrics[JOIN_TIME], self):
-                    return self._join_batch(fixed, kc, build, bt,
-                                            str_words, None)
-            outs = [o for o in self._expand_phases(fixed, build, bt, *pa)
-                    if o is not None]
-            if not outs:
-                return ColumnarBatch.empty(self.output_schema)
-            return outs[0] if len(outs) == 1 else concat_batches(outs)
+                    pa = self._probe_phase(fixed, kc, bt, str_words,
+                                           None, direct)
+                pending.flush()
+                if pa is None:
+                    with timed(self.metrics[JOIN_TIME], self):
+                        return self._join_batch(fixed, kc, build, bt,
+                                                str_words, None)
+                outs = [o for o in
+                        self._expand_phases(fixed, build, bt, *pa)
+                        if o is not None]
+                if not outs:
+                    return ColumnarBatch.empty(self.output_schema)
+                return outs[0] if len(outs) == 1 \
+                    else concat_batches(outs)
 
         out._speculative = SpeculativeResult(fits, _redo)
         return out

@@ -142,7 +142,7 @@ class TpuFileScan(TpuExec):
                     for b in batches:
                         self.metrics[NUM_OUTPUT_ROWS] += b.num_rows
                         yield b
-                return [replay(part) for part in cached]
+                return self._stats_wrap([replay(part) for part in cached])
         if not self.conf.get(SCAN_PREFETCH) or \
                 sum(len(f) for f in self._partitions) <= 1:
             def run(files):
@@ -154,8 +154,17 @@ class TpuFileScan(TpuExec):
         else:
             parts = self._execute_prefetch(max_rows)
         if key is None:
+            return self._stats_wrap(parts)
+        return self._stats_wrap(self._caching_iters(key, parts))
+
+    def _stats_wrap(self, parts):
+        """Per-partition output-row stats for the stats plane; the
+        counting wrapper sits OUTSIDE the caching layer so the device
+        cache stores unwrapped batches."""
+        from ..obs import stats as obs_stats
+        if not obs_stats.enabled(self.conf):
             return parts
-        return self._caching_iters(key, parts)
+        return obs_stats.count_scan_partitions(self, parts)
 
     def _caching_iters(self, key, parts):
         """Collect each partition's batches as they stream; install the
@@ -233,6 +242,14 @@ class TpuFileScan(TpuExec):
                         if not put_or_cancel(table):
                             return
                     put_or_cancel(sentinels["end"])
+                    # linger until the consumer drains the queue (or
+                    # abandons the partition): a producer mid-decode
+                    # already pins its thread on the bounded put, so a
+                    # finished one holding its decoded tables until
+                    # they're taken keeps the lifetime discipline
+                    # uniform regardless of table count
+                    while not cancel.is_set() and not qd.empty():
+                        cancel.wait(0.05)
                 except Exception as e:  # noqa: BLE001 - re-raised below
                     put_or_cancel((sentinels["err"], e))
             t = threading.Thread(target=produce, daemon=True,

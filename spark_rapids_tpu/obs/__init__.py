@@ -19,6 +19,15 @@ Layers, mirroring the reference plugin's observability story
   the same boundaries the tracer instruments.
 - ``obs.watchdog``— service stall watchdog: flags RUNNING queries with
   no flight-recorder progress and captures the evidence.
+- ``obs.profile`` — runtime stats plane, timing half: flush-level
+  device-time attribution (which exec node owned each fused device
+  round trip), deterministic per-member time shares inside fused
+  superstages, and per-site dispatch duration summaries.
+- ``obs.stats``   — runtime stats plane, data half: exchange-boundary
+  per-partition rows/bytes/null/min-max statistics, an on-device
+  HLL-style distinct-key sketch computed in the split's own dispatch
+  window (zero extra flushes), skew verdicts, and the per-query
+  ``StatsProfile`` artifact (imported lazily by exec/ and api/).
 - ``obs.diagnostics`` — one-JSON-file incident bundles (flight tail,
   thread stacks, metrics, arena map, plan verdicts, redacted conf)
   written automatically on failure/OOM/deadline/watchdog; rendered by
@@ -27,6 +36,9 @@ Layers, mirroring the reference plugin's observability story
 The per-query report generator that joins the event log with these
 streams lives in ``tools/report.py`` (the SQL-UI stand-in).
 """
-from . import trace, registry, prom, flight  # noqa: F401
+from . import trace, registry, prom, flight, profile  # noqa: F401
 from .registry import get_registry  # noqa: F401
 from .trace import span, traced     # noqa: F401
+
+# install the pending-pool flush observer (idempotent module hook)
+profile.install()

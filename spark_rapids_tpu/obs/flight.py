@@ -57,6 +57,9 @@ EV_PIPELINE = "pipeline"      # morsel-pipeline drain progress
 EV_COMPILE = "compile"        # superstage compiler (name=event, a=size)
 #                               (name=stage constant, a=partition/count,
 #                                b=bytes or permille ratio)
+EV_STATS = "stats"            # stats plane (name=site/kind; a,b = plain
+#                               ints: flush item count + duration ms, or
+#                               skew permille + distinct estimate)
 
 #: module fast-path flag — read directly by ``record()``; the recorder
 #: is ON by default (that is the point of a flight recorder).

@@ -359,6 +359,58 @@ PIPELINE_DRAINS = _REGISTRY.counter(
     labels=("mode",))
 
 
+# -- runtime stats plane (obs/stats.py + obs/profile.py) --------------------
+# Buckets sized to the remote-dispatch cost model: one fused flush is a
+# ~65-100ms round trip, so the interesting resolution is 10ms-10s.
+_DISPATCH_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.075, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+#: per-partition row-count buckets for the exchange skew histogram
+_PARTITION_ROW_BUCKETS = (0.0, 1.0, 100.0, 1_000.0, 10_000.0,
+                          100_000.0, 1_000_000.0, 10_000_000.0,
+                          100_000_000.0)
+
+STATS_FLUSH_SECONDS = _REGISTRY.histogram(
+    "tpu_stats_flush_seconds",
+    "Wall duration of each fused pending-pool flush (one device round "
+    "trip; columnar/pending.py) as observed by the stats plane",
+    buckets=_DISPATCH_BUCKETS)
+STATS_ATTRIBUTED_DEVICE_SECONDS = _REGISTRY.counter(
+    "tpu_stats_attributed_device_seconds_total",
+    "Flush wall time accrued by attribution target (attributed=yes: a "
+    "superstage/exchange/collect scope owned the flush; no: the flush "
+    "fired outside any declared scope)",
+    labels=("attributed",))
+STATS_DISPATCH_SECONDS = _REGISTRY.histogram(
+    "tpu_stats_dispatch_seconds",
+    "Wall duration of explicit dispatch sites the stats plane times "
+    "(flush, superstage chain_step, exchange split, speculative join "
+    "spec_probe/spec_redo)",
+    buckets=_DISPATCH_BUCKETS,
+    labels=("site",))
+STATS_EXCHANGES = _REGISTRY.counter(
+    "tpu_stats_exchanges_total",
+    "Exchange materializations the stats plane profiled, by kind "
+    "(shuffle/broadcast) — each contributes per-partition rows/bytes, "
+    "null counts, min/max and an HLL distinct-key estimate",
+    labels=("kind",))
+STATS_SKEWED_EXCHANGES = _REGISTRY.counter(
+    "tpu_stats_skewed_exchanges_total",
+    "Exchanges whose max/median partition-row ratio exceeded "
+    "spark.rapids.tpu.obs.stats.skewFactor")
+STATS_LAST_SKEW_RATIO = _REGISTRY.gauge(
+    "tpu_stats_last_skew_ratio",
+    "max/median partition-row ratio of the most recently profiled "
+    "shuffle exchange (1.0 = perfectly balanced)")
+STATS_LAST_DISTINCT_KEYS = _REGISTRY.gauge(
+    "tpu_stats_last_distinct_keys",
+    "HLL distinct-key estimate of the most recently profiled hash "
+    "exchange")
+STATS_PARTITION_ROWS = _REGISTRY.histogram(
+    "tpu_stats_partition_rows",
+    "Rows per reduce partition across profiled shuffle exchanges",
+    buckets=_PARTITION_ROW_BUCKETS)
+
+
 def compile_cache_event(cache: str, hit: bool):
     """One compile-cache lookup (called from the exec/kernels JIT
     caches; compile paths, not per-batch hot paths)."""

@@ -8,11 +8,19 @@ the span tracer's Chrome trace JSON, into one readable per-query story:
   and share of the total (the SQL UI's "time in operator" view);
 - the retry/spill story: admission, queue wait, each attempt's outcome,
   backoffs, semaphore wait and spill bytes;
-- the critical-path spans from the trace (longest exclusive regions).
+- the critical-path spans from the trace (longest exclusive regions);
+- with ``--stats``, the runtime stats plane (obs/stats.py): per-member
+  device-time shares inside fused superstages, the per-exchange
+  partition/skew/distinct table, and dispatch-duration percentiles
+  (degrades to a one-line notice on logs without a StatsProfile).
+
+Tolerant of older logs: records missing newer fields (``flushes``,
+``stats_profile``, ``sem_wait_ms``...) render with "-" placeholders
+rather than failing.
 
 Usage:
   python -m spark_rapids_tpu.tools.report <event_log.jsonl>
-      [--query QID] [--trace trace.json] [--html out.html]
+      [--query QID] [--trace trace.json] [--html out.html] [--stats]
 """
 from __future__ import annotations
 
@@ -190,8 +198,62 @@ def _service_story(service: List[Dict]) -> List[str]:
     return out
 
 
+def _fmt(v):
+    """Missing-field placeholder: older event logs predate newer record
+    fields (flushes, sem_wait_ms, stats_profile) and must still render."""
+    return "-" if v is None else v
+
+
+def stats_lines(prof: Dict) -> List[str]:
+    """Text sections for one record's StatsProfile (obs/stats.py)."""
+    lines: List[str] = []
+    stages = prof.get("superstages") or []
+    if stages:
+        lines.append("-- superstage device-time attribution --")
+        for s in stages:
+            lines.append(f"  {s.get('node')} (node "
+                         f"{s.get('node_index')}): "
+                         f"device_ms={_fmt(s.get('device_ms'))} "
+                         f"flushes={_fmt(s.get('flushes'))}")
+            shares = s.get("member_share") or {}
+            dms = s.get("member_device_ms") or {}
+            for k, share in shares.items():
+                lines.append(f"    {k:<38s}{share * 100:6.1f}%"
+                             f"{dms.get(k, 0.0):>11.2f}ms")
+    exchanges = prof.get("exchanges") or []
+    if exchanges:
+        lines.append("-- exchange data statistics --")
+        lines.append(f"  {'node':<26s}{'kind':<11s}{'rows':>10s}"
+                     f"{'est_bytes':>12s}{'nulls':>8s}"
+                     f"{'distinct':>10s}{'skew':>9s}")
+        for e in exchanges:
+            skew = e.get("skew") or {}
+            ratio = skew.get("ratio")
+            skew_cell = "-" if ratio is None else (
+                f"{ratio}{'!' if skew.get('skewed') else ''}")
+            lines.append(f"  {str(e.get('node')):<26s}"
+                         f"{str(e.get('kind')):<11s}"
+                         f"{_fmt(e.get('rows')):>10}"
+                         f"{_fmt(e.get('est_bytes')):>12}"
+                         f"{_fmt(e.get('null_count')):>8}"
+                         f"{_fmt(e.get('distinct_est')):>10}"
+                         f"{skew_cell:>9s}")
+            if skew.get("skewed"):
+                rows = [p.get("rows") for p in e.get("partitions", [])]
+                lines.append(f"    partition rows: {rows}")
+    disp = prof.get("dispatches") or {}
+    if disp:
+        lines.append("-- dispatch durations --")
+        for site, d in disp.items():
+            lines.append(f"  {site:<12s} count={d.get('count', 0):<6d} "
+                         f"p50={_fmt(d.get('p50_ms'))}ms "
+                         f"p95={_fmt(d.get('p95_ms'))}ms")
+    return lines
+
+
 def render_query_report(query_id, story: Dict,
-                        trace_events: Optional[List[Dict]] = None) -> str:
+                        trace_events: Optional[List[Dict]] = None,
+                        show_stats: bool = False) -> str:
     """One query's full text report."""
     lines = [f"=== query {query_id} " + "=" * 40]
     engine = story.get("engine", [])
@@ -203,9 +265,9 @@ def render_query_report(query_id, story: Dict,
         tag = f" (attempt record {i + 1}/{len(engine)})" \
             if len(engine) > 1 else ""
         head = (f"-- plan + time shares{tag}: "
-                f"wall_ms={rec.get('wall_ms')} "
-                f"sem_wait_ms={rec.get('sem_wait_ms')} "
-                f"spill_bytes={rec.get('spill_bytes')}")
+                f"wall_ms={_fmt(rec.get('wall_ms'))} "
+                f"sem_wait_ms={_fmt(rec.get('sem_wait_ms'))} "
+                f"spill_bytes={_fmt(rec.get('spill_bytes'))}")
         if rec.get("flushes") is not None:
             # device round trips this query — THE cost model on
             # remote-dispatch backends (columnar/pending.py)
@@ -215,6 +277,13 @@ def render_query_report(query_id, story: Dict,
         if rec.get("fallbacks"):
             lines.append("  CPU fallbacks:")
             lines.extend(f"    {f}" for f in rec["fallbacks"])
+        if show_stats:
+            prof = rec.get("stats_profile")
+            if prof:
+                lines.extend(stats_lines(prof))
+            else:
+                lines.append("  (no StatsProfile recorded — run with "
+                             "spark.rapids.tpu.obs.stats.enabled=true)")
     if trace_events:
         spans = critical_spans(trace_events, query_id)
         if spans:
@@ -230,20 +299,21 @@ def render_query_report(query_id, story: Dict,
 
 def render_report(stories: Dict,
                   trace_events: Optional[List[Dict]] = None,
-                  query_id=None) -> str:
+                  query_id=None, show_stats: bool = False) -> str:
     ids = [query_id] if query_id is not None else sorted(
         stories, key=lambda q: str(q))
     parts = []
     for qid in ids:
         if qid not in stories:
             raise KeyError(f"query {qid!r} not in event log")
-        parts.append(render_query_report(qid, stories[qid], trace_events))
+        parts.append(render_query_report(qid, stories[qid], trace_events,
+                                         show_stats=show_stats))
     return "\n\n".join(parts)
 
 
 def render_html(stories: Dict,
                 trace_events: Optional[List[Dict]] = None,
-                query_id=None) -> str:
+                query_id=None, show_stats: bool = False) -> str:
     """Self-contained single-file HTML wrapping the text report
     per-query (monospace <pre> sections with a query index)."""
     ids = [query_id] if query_id is not None else sorted(
@@ -253,7 +323,8 @@ def render_html(stories: Dict,
                 f'<li><a href="#q{_html.escape(str(q))}">'
                 f"{_html.escape(str(q))}</a></li>" for q in ids) + "</ul>"]
     for qid in ids:
-        txt = render_query_report(qid, stories[qid], trace_events)
+        txt = render_query_report(qid, stories[qid], trace_events,
+                                  show_stats=show_stats)
         body.append(f'<h2 id="q{_html.escape(str(qid))}">'
                     f"query {_html.escape(str(qid))}</h2>")
         body.append(f"<pre>{_html.escape(txt)}</pre>")
@@ -268,7 +339,8 @@ def main(argv=None):
     argv = list(argv if argv is not None else sys.argv[1:])
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: report <event_log.jsonl> [--query QID] "
-              "[--trace trace.json] [--html out.html]", file=sys.stderr)
+              "[--trace trace.json] [--html out.html] [--stats]",
+              file=sys.stderr)
         return 1
 
     def _opt(flag):
@@ -279,9 +351,16 @@ def main(argv=None):
             return v
         return None
 
+    def _flag(flag):
+        if flag in argv:
+            argv.remove(flag)
+            return True
+        return False
+
     qid = _opt("--query")
     trace_path = _opt("--trace")
     html_out = _opt("--html")
+    show_stats = _flag("--stats")
     log_path = argv[0]
     stories = load_query_stories(log_path)
     trace_events = load_trace(trace_path) if trace_path else None
@@ -294,10 +373,12 @@ def main(argv=None):
             pass
     if html_out:
         with open(html_out, "w") as f:
-            f.write(render_html(stories, trace_events, qid))
+            f.write(render_html(stories, trace_events, qid,
+                                show_stats=show_stats))
         print(f"wrote {html_out}")
     else:
-        print(render_report(stories, trace_events, qid))
+        print(render_report(stories, trace_events, qid,
+                            show_stats=show_stats))
     return 0
 
 
