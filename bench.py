@@ -131,7 +131,34 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
     # column every BENCH_r now reports alongside throughput
     flushes = getattr(s, "last_query_flushes", None)
     prof = getattr(s, "last_stats_profile", None)
-    return best, flushes, (prof.to_dict() if prof is not None else None)
+    # performance plane (obs/timeline.py, obs/compile_watch.py): the
+    # warm query's device-utilization lane + inline-compile ms
+    perf = {"timeline": getattr(s, "last_query_timeline", None),
+            "inline_compile_ms": getattr(
+                s, "last_query_inline_compile_ms", None)}
+    return best, flushes, (prof.to_dict() if prof is not None
+                           else None), perf
+
+
+def measure_service_p99(n_rows: int = 200_000, submissions: int = 8):
+    """Tenant p99 through the serving front-end (service/server.py):
+    submit a small burst as tenant "bench" and read the SLO plane's
+    reservoir percentile from stats().  Small rows on purpose — this
+    measures the serving overhead distribution, not throughput."""
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.service.server import QueryService
+    s = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": True}))
+    df = build_df(s, n_rows, 2)
+    df.to_arrow()          # warm the compile caches first
+    with QueryService(session=s, num_workers=2) as svc:
+        handles = [svc.submit(df, tenant="bench")
+                   for _ in range(submissions)]
+        for h in handles:
+            h.result(timeout=120)
+        snap = svc.stats().snapshot()
+    return snap.get("slo", {}).get("tenants", {}).get(
+        "bench", {}).get("p99_ms")
 
 
 def main():
@@ -143,22 +170,24 @@ def main():
     repeats = 3
     # headline: the DEFAULT conf (exact float aggregation) — the 8-bit
     # chunk-lane / two-stage-u32 exact table path (exec/tpu_aggregate)
-    tpu_exact_t, tpu_flushes, tpu_prof = run_engine(
+    tpu_exact_t, tpu_flushes, tpu_prof, tpu_perf = run_engine(
         True, n_rows, parts, repeats, variable_float=False)
     # stats-off runs ADJACENT to the headline: the on/off overhead is a
     # fixed ~10-15ms of host work per query, so at small n the pair
     # must share process cache state or session-order drift swamps it
-    tpu_nostats_t, _, _ = run_engine(True, n_rows, parts, repeats,
-                                     variable_float=False, stats=False)
-    tpu_off_t, _, _ = run_engine(True, n_rows, parts, repeats,
-                                 variable_float=False, pipeline=False)
-    tpu_nostage_t, nostage_flushes, _ = run_engine(
+    tpu_nostats_t, _, _, _ = run_engine(True, n_rows, parts, repeats,
+                                        variable_float=False, stats=False)
+    tpu_off_t, _, _, _ = run_engine(True, n_rows, parts, repeats,
+                                    variable_float=False, pipeline=False)
+    tpu_nostage_t, nostage_flushes, _, _ = run_engine(
         True, n_rows, parts, repeats, variable_float=False,
         superstage=False)
-    tpu_var_t, _, _ = run_engine(True, n_rows, parts, repeats,
-                                 variable_float=True)
-    cpu_t, _, _ = run_engine(False, n_rows, parts, repeats)
+    tpu_var_t, _, _, _ = run_engine(True, n_rows, parts, repeats,
+                                    variable_float=True)
+    cpu_t, _, _, _ = run_engine(False, n_rows, parts, repeats)
+    service_p99 = measure_service_p99()
     disp = (tpu_prof or {}).get("dispatches", {}).get("all", {})
+    tl = tpu_perf.get("timeline") or {}
     print(json.dumps({
         "metric": "sql_pipeline_throughput",
         "value": round(n_rows / tpu_exact_t / 1e6, 3),
@@ -190,6 +219,16 @@ def main():
             (tpu_exact_t - tpu_nostats_t) / tpu_nostats_t * 100, 2),
         "dispatch_p50_ms": disp.get("p50_ms"),
         "dispatch_p95_ms": disp.get("p95_ms"),
+        # serving-grade performance plane (obs/timeline, compile_watch,
+        # slo): the warm query's device utilization + WHY idle time
+        # exists, the inline-compile ms that landed in its window
+        # (~0 warm — the cold cost lives in tpu_compile_seconds), and
+        # the tenant p99 through the service front-end
+        "device_util_pct": tl.get("util_pct"),
+        "util_gap_breakdown": tl.get("gaps"),
+        "inline_compile_ms": round(
+            tpu_perf.get("inline_compile_ms") or 0.0, 3),
+        "service_p99_ms": service_p99,
     }))
 
 

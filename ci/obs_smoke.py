@@ -63,6 +63,33 @@ def main():
         assert snap["flight_recorder"]["events_recorded"] > 0, snap
         assert snap["watchdog"]["enabled"], snap
 
+    # 0. performance plane (obs/timeline, compile_watch, slo): this is
+    #    a fresh process, so the aggregate query's first run was a COLD
+    #    compile under an active query context — inline by definition
+    tl = snap["timeline"]
+    assert tl["busy_ms"] > 0, tl
+    total_share = tl["util_pct"] + sum(tl["gaps"].values())
+    assert abs(total_share - 100.0) < 0.1, (total_share, tl)
+    comp = snap["compile"]
+    assert comp["top"], comp
+    assert all(r["dur_ms"] > 0 for r in comp["top"]), comp["top"]
+    assert any(r["inline"] for r in comp["top"]), comp["top"]
+    assert comp["inline_compile_ms"] > 0, comp
+    slo = snap["slo"]
+    t_default = slo["tenants"]["default"]
+    assert t_default["count"] == 3, t_default
+    assert t_default["p99_ms"] >= t_default["p50_ms"] > 0, t_default
+    # the victim query's event-log record carries the same compile cost
+    from spark_rapids_tpu.tools.events import read_event_log as _rel
+    completed = [r for r in _rel(log_path, events="completed")]
+    assert completed and all("queue_wait_ms" in r and "execute_ms" in r
+                             for r in completed), completed
+    assert any(r.get("inline_compile_ms", 0) > 0
+               for r in completed), completed
+    print(f"perf plane OK: busy_ms={tl['busy_ms']}, "
+          f"util={tl['util_pct']}%, compiles={comp['compiles']}, "
+          f"default p99={t_default['p99_ms']}ms")
+
     # 1. trace JSON parses and has the span hierarchy
     doc = json.load(open(trace_path))
     events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
@@ -81,6 +108,11 @@ def main():
                    "tpu_semaphore_wait_seconds_bucket",
                    "tpu_service_queue_wait_seconds_count",
                    "tpu_compile_cache_requests_total",
+                   "tpu_compile_seconds_bucket",
+                   "tpu_device_busy_seconds_total",
+                   "tpu_device_util_pct",
+                   "tpu_device_idle_pct",
+                   "tpu_slo_latency_seconds_bucket",
                    'tpu_service_queries_total{event="completed"}'):
         assert series in metrics, f"missing series {series}"
     print("prometheus OK:", len(metrics.splitlines()), "lines")

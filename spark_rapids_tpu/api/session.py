@@ -245,10 +245,18 @@ class TpuSession:
         # they fall — exact when queries run serially, which is how the
         # flush budget is benchmarked)
         from ..columnar import pending
+        from ..obs import compile_watch as _cwatch
         from ..obs import profile as _profile
         from ..obs import stats as _stats
+        from ..obs import timeline as _timeline
         flushes0 = pending.FLUSH_COUNT
         disp_marker = _profile.begin_query()
+        # performance-plane windows: compile ns + busy intervals are
+        # process-wide counters deltaed around this execution (the
+        # FLUSH_COUNT discipline — exact when queries run serially)
+        compile0 = _cwatch.total_ns()
+        cw_marker = _cwatch.begin_query()
+        tl_marker = _timeline.begin_query()
         # collect-sink flushes belong to the root-most fused superstage
         # when the plan has one (obs/profile.py attribution scopes)
         _attrib = next((n for n in phys.collect_nodes()
@@ -310,9 +318,27 @@ class TpuSession:
         flushes = pending.FLUSH_COUNT - flushes0
         self.last_query_flushes = flushes
         observe("flushes", flushes)
+        # compile telemetry: compiles that landed in this query's window
+        # (engine path; the service separately harvests the token's
+        # inline_compile_ms observed at compile time)
+        inline_compile_ms = (_cwatch.total_ns() - compile0) / 1e6
+        self.last_query_inline_compile_ms = inline_compile_ms
+        # device-utilization lane for this query's window
+        tl = _timeline.query_summary(tl_marker)
+        self.last_query_timeline = tl
         extra = {"sem_wait_ms": round(sem_wait_ms, 3),
                  "spill_bytes": int(spill_bytes),
-                 "flushes": int(flushes)}
+                 "flushes": int(flushes),
+                 "inline_compile_ms": round(inline_compile_ms, 3),
+                 "device_busy_ms": tl["busy_ms"],
+                 "device_util_pct": tl["util_pct"],
+                 "util_gap_breakdown": tl["gaps"]}
+        compiles = _cwatch.records_since(cw_marker)
+        if compiles:
+            extra["compiles"] = [
+                {"cache": r["cache"], "dur_ms": r["dur_ms"],
+                 "inline": r["inline"], "signature": r["signature"]}
+                for r in compiles]
         # per-query StatsProfile (obs/stats.py): read-only over resolved
         # values — built AFTER the final flush, never adds a round trip
         self.last_stats_profile = None

@@ -490,6 +490,50 @@ OBS_STATS_IN_EVENT_LOG = conf_bool(
     "Persist the per-query StatsProfile artifact inside the engine "
     "event-log record (tools/report.py --stats renders it); off keeps "
     "the profile reachable only via session.last_stats_profile")
+OBS_TIMELINE_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.timeline.enabled", True,
+    "Device-utilization timeline (obs/timeline.py): accumulate the "
+    "busy interval of every fused pending-pool flush and mesh SPMD "
+    "dispatch into a bounded per-process store, reconstruct device "
+    "busy/idle, classify idle gaps by cause (inline compile, "
+    "semaphore wait, admission queue, pipeline starvation, host "
+    "staging) from flight-recorder evidence, and report per-query + "
+    "process device_util_pct.  Fed by observers the stats plane "
+    "already runs: zero extra flushes, one bounded append per flush")
+OBS_TIMELINE_MAX_INTERVALS = conf_int(
+    "spark.rapids.tpu.obs.timeline.maxIntervals", 1 << 16,
+    "Bound on buffered busy intervals in the utilization timeline; "
+    "past it new intervals are dropped and counted (fixed memory — "
+    "the flight-recorder discipline).  Applies on the next reset")
+OBS_COMPILE_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.compile.enabled", True,
+    "Compile telemetry (obs/compile_watch.py): time the first call of "
+    "every compile-cache miss across the seven engine JIT caches, "
+    "recording duration, cache name, shape/dtype signature and an "
+    "inline-vs-warm flag (inline = a query context was blocked on "
+    "it), exported as the tpu_compile_seconds histogram and the "
+    "top-N slowest-compiles table in Service.stats().  The direct "
+    "measurement the AOT shape-bucketed compile cache (ROADMAP item "
+    "4) is built and judged against")
+OBS_COMPILE_TOP_N = conf_int(
+    "spark.rapids.tpu.obs.compile.topN", 20,
+    "Rows of the slowest-compiles table in Service.stats() (the "
+    "bounded record store keeps the slowest 256 compiles)")
+OBS_SLO_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.slo.enabled", True,
+    "Per-tenant SLO latency plane (obs/slo.py): end-to-end latency "
+    "histograms labeled by tenant with admission wait and execution "
+    "recorded separately, p50/p95/p99 in Prometheus and "
+    "Service.stats(), and breach/burn accounting against "
+    "obs.slo.targetMs with every breach attributed to exactly one "
+    "cause (shed / deadline / inline_compile / slow_exec)")
+OBS_SLO_TARGET_MS = conf_float(
+    "spark.rapids.tpu.obs.slo.targetMs", 0.0,
+    "End-to-end latency SLO per query in ms (queue wait + execution). "
+    "A served query past it is a breach attributed to one cause; shed "
+    "and deadline-cancelled queries always breach.  The burn counter "
+    "accumulates overshoot ms per tenant.  0 disables breach/burn "
+    "accounting (latency histograms still record)")
 SUPERSTAGE = conf_bool(
     "spark.rapids.tpu.sql.superstage", True,
     "Superstage compiler (compile/): a planner post-pass after the "

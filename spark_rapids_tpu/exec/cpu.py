@@ -521,12 +521,21 @@ class CpuSort(CpuExec):
                     # Spark float total order (NaN greatest); pyarrow groups
                     # NaN with nulls under at_start placement
                     arr = _np_float_encode(arr)
+                # pyarrow sort_keys are (name, order) pairs with ONE
+                # global null_placement; per-key placement is encoded
+                # as a leading null-indicator key instead (nulls tie
+                # within their group, so the value key is unaffected)
+                null_ind = pc.is_null(arr)
+                if o.effective_nulls_first:
+                    null_ind = pc.invert(null_ind)
+                work = work.append_column(
+                    f"{name}_nulls", pc.cast(null_ind, pa.int8()))
                 work = work.append_column(name, arr)
+                keys.append((f"{name}_nulls", "ascending"))
                 keys.append((name,
-                             "ascending" if o.ascending else "descending",
-                             "at_start" if o.effective_nulls_first
-                             else "at_end"))
-            idx = pc.sort_indices(work, sort_keys=keys)
+                             "ascending" if o.ascending else "descending"))
+            idx = pc.sort_indices(work, sort_keys=keys,
+                                  null_placement="at_end")
             return t.take(idx)
 
         if self.is_global:

@@ -29,6 +29,7 @@ from ..columnar import dtypes as T
 from ..columnar.column import Column
 from ..columnar.batch import ColumnarBatch
 from ..expr import core as ec
+from ..obs import compile_watch as _compile_watch
 from ..obs.registry import compile_cache_event
 
 _LOG = logging.getLogger("spark_rapids_tpu.exec.fused")
@@ -133,7 +134,9 @@ class FusedEval:
             # private jit instead of an unsound id()-keyed entry
             sigs = [expr_signature(self.exprs[i]) for i in self.fused_idx]
             if any(s is None for s in sigs):
-                self._jitted = jax.jit(self._eval, static_argnums=(0,))
+                self._jitted = _compile_watch.wrap_miss(
+                    "fused_project",
+                    jax.jit(self._eval, static_argnums=(0,)), "opaque")
             else:
                 key = (tuple(sigs),
                        tuple(f.dtype.name for f in self.schema),
@@ -142,7 +145,10 @@ class FusedEval:
                 compile_cache_event("fused_project",
                                     self._jitted is not None)
                 if self._jitted is None:
-                    self._jitted = jax.jit(self._eval, static_argnums=(0,))
+                    self._jitted = _compile_watch.wrap_miss(
+                        "fused_project",
+                        jax.jit(self._eval, static_argnums=(0,)),
+                        str(key))
                     if len(_JIT_CACHE) < 4096:
                         _JIT_CACHE[key] = self._jitted
 

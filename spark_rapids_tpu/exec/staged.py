@@ -28,6 +28,7 @@ from ..columnar.batch import ColumnarBatch, LazyCount
 from ..columnar.schema import Schema
 from ..expr import core as ec
 from ..kernels import basic as bk
+from ..obs import compile_watch as _compile_watch
 from ..obs.registry import compile_cache_event
 from .base import NUM_OUTPUT_ROWS, OP_TIME, timed
 from .fused import FusedEval, _TracedBatch, _tree_fusable, expr_signature
@@ -172,6 +173,10 @@ class TpuStagedCompute(TpuExec):
                     out.num_rows)
 
         fn = jax.jit(_eval, static_argnums=(0,))
+        # compile telemetry: the first call (trace + XLA compile) is
+        # wall-timed into the tpu_compile_seconds plane
+        fn = _compile_watch.wrap_miss(
+            "staged_compute", fn, "opaque" if key is None else str(key))
         if key is not None and len(TpuStagedCompute._JIT_CACHE) < 4096:
             TpuStagedCompute._JIT_CACHE[key] = fn
         return fn

@@ -22,6 +22,7 @@ from ..columnar.batch import (ColumnarBatch, LazyCount, SpeculativeResult,
 from ..expr import core as ec
 from ..expr.aggregates import AggregateFunction
 from ..kernels import canon, aggregate as agg_k
+from ..obs import compile_watch as _compile_watch
 from ..obs.registry import compile_cache_event
 from ..plan.logical import AggExpr
 from .base import PhysicalPlan, AGG_TIME, NUM_OUTPUT_ROWS, timed
@@ -404,7 +405,8 @@ class TpuHashAggregate(TpuExec):
                                                   agg_buffers, ocap,
                                                   emit_buffers)
                 return ng, fit, outs
-            core = jax.jit(_core)
+            core = _compile_watch.wrap_miss(
+                "hash_aggregate", jax.jit(_core), str(cache_key))
             TpuHashAggregate._CORE_CACHE[cache_key] = core
 
         # flat arg list, None inputs omitted (the dtypes tuple encodes
@@ -1084,7 +1086,8 @@ class TpuHashAggregate(TpuExec):
                                                   agg_buffers, ocap,
                                                   emit_buffers)
                 return ng, fit, outs
-            core = jax.jit(_core)
+            core = _compile_watch.wrap_miss(
+                "hash_aggregate", jax.jit(_core), str(cache_key))
             TpuHashAggregate._CORE_CACHE[cache_key] = core
         datas = tuple(c.data for c in batch.columns)
         valids = tuple(c.validity for c in batch.columns)
@@ -1289,7 +1292,8 @@ class TpuHashAggregate(TpuExec):
             core = TpuHashAggregate._CORE_CACHE.get(cache_key)
             if core is not False:
                 if core is None:
-                    core = jax.jit(_core)
+                    core = _compile_watch.wrap_miss(
+                        "hash_aggregate", jax.jit(_core), str(cache_key))
                     TpuHashAggregate._CORE_CACHE[cache_key] = core
                 try:
                     pairs = core(in_arrays, batch.rows_dev)

@@ -31,6 +31,8 @@ from ..columnar.column import Column, bucket_capacity
 from ..columnar.batch import ColumnarBatch, concat_batches
 from ..expr import core as ec
 from ..kernels import canon, aggregate as agg_k
+from ..obs import compile_watch as _compile_watch
+from ..obs import timeline as _timeline
 from ..obs.registry import compile_cache_event
 from ..parallel.mesh import MIX, _route_to_owners, make_mesh
 from .base import PhysicalPlan, AGG_TIME, NUM_OUTPUT_ROWS, timed
@@ -202,6 +204,12 @@ class TpuMeshAggregate(TpuExec):
             in_specs=tuple(P(_AXIS) for _ in
                            range(2 * (nkeys + sum(in_layout)) + 1)),
             out_specs=tuple(P(_AXIS) for _ in range(n_out))))
+        # perf plane: per-device busy windows + first-call compile
+        # telemetry (signature drops the unstable id(mesh))
+        fn = _timeline.device_busy_wrap(
+            fn, tuple(str(d.id) for d in mesh.devices.ravel()))
+        fn = _compile_watch.wrap_miss("mesh_aggregate", fn,
+                                      str(key[1:]))
         TpuMeshAggregate._PROGRAM_CACHE[key] = fn
         return fn
 

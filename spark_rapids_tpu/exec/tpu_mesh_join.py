@@ -37,6 +37,8 @@ from ..columnar.batch import ColumnarBatch, concat_batches
 from ..expr import core as ec
 from ..kernels import canon
 from ..kernels import join as join_k
+from ..obs import compile_watch as _compile_watch
+from ..obs import timeline as _timeline
 from ..obs.registry import compile_cache_event
 from ..parallel.mesh import MIX, _route_to_owners, make_mesh
 from .base import PhysicalPlan, JOIN_TIME, NUM_OUTPUT_ROWS, timed
@@ -207,6 +209,12 @@ class TpuMeshShuffledJoin(TpuExec):
             step, mesh=mesh,
             in_specs=tuple(P(_AXIS) for _ in range(n_in)),
             out_specs=tuple(P(_AXIS) for _ in range(n_out))))
+        # perf plane: each dispatch window is busy time on every mesh
+        # device; the first call (jit compile) lands in compile_watch
+        # with the cache key (minus the unstable id(mesh)) as signature
+        fn = _timeline.device_busy_wrap(
+            fn, tuple(str(d.id) for d in mesh.devices.ravel()))
+        fn = _compile_watch.wrap_miss("mesh_join", fn, str(key[1:]))
         TpuMeshShuffledJoin._PROGRAM_CACHE[key] = fn
         return fn
 

@@ -32,6 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..columnar.schema import Schema
 from ..columnar.column import Column, bucket_capacity
+from ..obs import compile_watch as _compile_watch
+from ..obs import timeline as _timeline
 from ..obs.registry import compile_cache_event
 from ..columnar.batch import ColumnarBatch, concat_batches
 from ..expr import core as ec
@@ -160,6 +162,11 @@ class TpuMeshSort(TpuExec):
             step, mesh=mesh,
             in_specs=tuple(P(_AXIS) for _ in range(n_in)),
             out_specs=tuple(P(_AXIS) for _ in range(n_out))))
+        # perf plane: per-device busy windows + first-call compile
+        # telemetry (signature drops the unstable id(mesh))
+        fn = _timeline.device_busy_wrap(
+            fn, tuple(str(d.id) for d in mesh.devices.ravel()))
+        fn = _compile_watch.wrap_miss("mesh_sort", fn, str(key[1:]))
         TpuMeshSort._PROGRAM_CACHE[key] = fn
         return fn
 

@@ -17,6 +17,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
+from ..obs import compile_watch as _compile_watch
 from ..obs.registry import compile_cache_event
 from .basic import M1, M2, mix64, hash_words as _hash_words_jnp
 
@@ -81,7 +82,8 @@ def hash_partition_ids(word_lists: List[jnp.ndarray],
     try:
         if key not in _KERNEL_CACHE:
             compile_cache_event("pallas_hash_partition", False)
-            _KERNEL_CACHE[key] = _make_kernel(*key)
+            _KERNEL_CACHE[key] = _compile_watch.wrap_miss(
+                "pallas_hash_partition", _make_kernel(*key), str(key))
         else:
             compile_cache_event("pallas_hash_partition", True)
         return _KERNEL_CACHE[key](*word_lists)

@@ -32,11 +32,21 @@ Layers, mirroring the reference plugin's observability story
   thread stacks, metrics, arena map, plan verdicts, redacted conf)
   written automatically on failure/OOM/deadline/watchdog; rendered by
   ``tools/diagnose.py``.
+- ``obs.timeline`` — device-utilization timeline: busy/idle intervals
+  reconstructed from flush/mesh dispatch windows, idle gaps classified
+  by cause (staging, inline compile, semaphore, admission,
+  starvation), per-device busy counters.
+- ``obs.compile_watch`` — compile telemetry: every compile-cache
+  miss's duration, signature and inline-vs-warm flag, across all
+  seven engine JIT caches.
+- ``obs.slo`` — per-tenant SLO latency accounting: p50/p95/p99,
+  breach/burn counters with single-cause attribution.
 
 The per-query report generator that joins the event log with these
 streams lives in ``tools/report.py`` (the SQL-UI stand-in).
 """
-from . import trace, registry, prom, flight, profile  # noqa: F401
+from . import (trace, registry, prom, flight, timeline,  # noqa: F401
+               compile_watch, slo, profile)              # noqa: F401
 from .registry import get_registry  # noqa: F401
 from .trace import span, traced     # noqa: F401
 

@@ -1,0 +1,167 @@
+"""Compile telemetry — where multi-second inline XLA compiles land.
+
+Seven engine JIT caches (fused project, staged compute, hash
+aggregate, the three mesh SPMD programs, the Pallas hash-partition
+kernel) already report hit/miss counts to Prometheus.  What they could
+not answer is the question the AOT shape-bucketed compile cache
+(ROADMAP item 4) will be built and judged against: *how long does each
+miss actually cost, and did a query block on it?*
+
+``wrap_miss(cache, fn, signature)`` is the single instrumentation
+point: a cache miss wraps the freshly created callable so its FIRST
+call — where ``jax.jit`` traces, lowers and compiles — is wall-timed
+and recorded; afterwards the wrapper degenerates to one flag read per
+call.  Each recorded compile carries:
+
+- the cache name and a compact shape/dtype signature (from the cache
+  key the miss was stored under);
+- the wall duration (the same number lands in the
+  ``tpu_compile_seconds{cache=...}`` histogram, the bounded top-N
+  record store rendered by ``Service.stats()``, and — via the
+  process-wide ns counter the session deltas around each execution —
+  the victim query's event-log record, so all three surfaces agree
+  exactly);
+- an inline-vs-warm flag: inline means a query context (an active
+  ``CancelToken``) was blocked on the compile, in which case the
+  duration is also observed onto the token as ``inline_compile_ms``
+  for the service's per-query metrics.
+
+Hot-path discipline (this file is on the SYNC001/OBS002 lint scope):
+the warm path is one list-index check; recording happens once per
+compile (seconds-scale events) and allocates one small dict there.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from . import flight
+from .registry import COMPILE_SECONDS
+
+_SIG_MAX = 160          #: stored signature strings are truncated here
+_RECORD_CAP = 256       #: bounded record store (slowest kept on evict)
+
+_ENABLED = True
+_TOP_N = 20
+
+_LOCK = threading.Lock()
+_TOTAL_NS = 0           #: process-wide compile ns (session window deltas)
+_INLINE_NS = 0          #: subset recorded under an active query context
+_RECORDS: List[Dict] = []
+
+
+def note_compile(cache: str, dur_ns: int, signature: Optional[str] = None,
+                 ) -> None:
+    """Record one finished compile: histogram, bounded record store,
+    process counters, the victim token's ``inline_compile_ms``, and a
+    flight breadcrumb (constant name + plain ints — OBS002)."""
+    global _TOTAL_NS, _INLINE_NS
+    if not _ENABLED:
+        return
+    from ..service.cancellation import current_token, observe
+    tok = current_token()
+    inline = tok is not None
+    COMPILE_SECONDS.labels(cache=cache).observe(dur_ns / 1e9)
+    sig = "" if signature is None else str(signature)[:_SIG_MAX]
+    rec = {"cache": cache, "dur_ms": round(dur_ns / 1e6, 3),
+           "signature": sig, "inline": inline,
+           "query_id": tok.query_id if inline else None,
+           "end_ns": time.perf_counter_ns()}
+    with _LOCK:
+        _TOTAL_NS += dur_ns
+        if inline:
+            _INLINE_NS += dur_ns
+        _RECORDS.append(rec)
+        if len(_RECORDS) > _RECORD_CAP:
+            # evict the cheapest compile: the store's job is the
+            # slowest-compiles table, so the tail worth keeping is
+            # the expensive one
+            _RECORDS.sort(key=lambda r: -r["dur_ms"])
+            del _RECORDS[_RECORD_CAP:]
+    if inline:
+        observe("inline_compile_ms", dur_ns / 1e6)
+    flight.record(flight.EV_COMPILE, cache, dur_ns // 1_000_000,
+                  1 if inline else 0)
+
+
+def wrap_miss(cache: str, fn: Callable, signature=None) -> Callable:
+    """Wrap a compile-cache miss's freshly built callable so its first
+    call (where jit traces + compiles) is timed into ``note_compile``.
+    Warm calls afterwards pay one list-index check."""
+    if not _ENABLED:
+        return fn
+    compiled = [False]
+
+    def _timed(*args, **kwargs):
+        if compiled[0]:
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter_ns()
+        out = fn(*args, **kwargs)
+        compiled[0] = True
+        note_compile(cache, time.perf_counter_ns() - t0, signature)
+        return out
+
+    return _timed
+
+
+# ---------------------------------------------------------------------------
+# accessors (cold paths: session window deltas, Service.stats())
+# ---------------------------------------------------------------------------
+
+def total_ns() -> int:
+    """Process-wide compile wall ns.  The session deltas this around
+    each execution for the engine record's ``inline_compile_ms`` (the
+    FLUSH_COUNT discipline: exact when queries run serially)."""
+    with _LOCK:
+        return _TOTAL_NS
+
+
+def inline_ns() -> int:
+    with _LOCK:
+        return _INLINE_NS
+
+
+def records_since(marker: int) -> List[Dict]:
+    """Compiles recorded after a ``begin_query()`` marker (store index
+    snapshot).  Evictions only drop pre-existing cheap entries, so a
+    per-query slice right after the query is reliable."""
+    with _LOCK:
+        return [dict(r) for r in _RECORDS[marker:]]
+
+
+def begin_query() -> int:
+    with _LOCK:
+        return len(_RECORDS)
+
+
+def stats_section(top_n: Optional[int] = None) -> Dict:
+    """The ``compile`` section of ``Service.stats().snapshot()``: the
+    top-N slowest compiles plus cumulative counters."""
+    n = top_n if top_n is not None else _TOP_N
+    with _LOCK:
+        recs = sorted(_RECORDS, key=lambda r: -r["dur_ms"])[:n]
+        tot, inl = _TOTAL_NS, _INLINE_NS
+    return {
+        "total_compile_ms": round(tot / 1e6, 3),
+        "inline_compile_ms": round(inl / 1e6, 3),
+        "compiles": len(recs),
+        "top": [dict(r) for r in recs],
+    }
+
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.compile.*`` conf group."""
+    global _ENABLED, _TOP_N
+    from ..config import OBS_COMPILE_ENABLED, OBS_COMPILE_TOP_N
+    _ENABLED = bool(conf.get(OBS_COMPILE_ENABLED))
+    _TOP_N = int(conf.get(OBS_COMPILE_TOP_N))
+
+
+def reset() -> None:
+    """Test hook: drop records and counters."""
+    global _TOTAL_NS, _INLINE_NS
+    with _LOCK:
+        _TOTAL_NS = 0
+        _INLINE_NS = 0
+        del _RECORDS[:]

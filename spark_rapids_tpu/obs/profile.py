@@ -38,7 +38,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from . import flight
+from . import flight, timeline
 from .registry import (STATS_ATTRIBUTED_DEVICE_SECONDS,
                        STATS_DISPATCH_SECONDS, STATS_FLUSH_SECONDS)
 
@@ -122,6 +122,7 @@ def _on_flush(dur_ns: int, n_items: int):
         sp.device_ns += dur_ns
         sp.flushes += 1
     _note_dispatch(SITE_FLUSH, dur_ns)
+    timeline.note_flush(dur_ns)
     STATS_FLUSH_SECONDS.observe(dur_ns / 1e9)
     STATS_ATTRIBUTED_DEVICE_SECONDS.labels(
         attributed="yes" if node is not None else "no").inc(dur_ns / 1e9)

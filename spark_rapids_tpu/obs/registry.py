@@ -411,11 +411,82 @@ STATS_PARTITION_ROWS = _REGISTRY.histogram(
     buckets=_PARTITION_ROW_BUCKETS)
 
 
-def compile_cache_event(cache: str, hit: bool):
+# -- serving-grade performance plane (obs/timeline, compile_watch, slo) -----
+# Compile buckets span the real range: a warm-trace re-jit is ~10ms, a
+# cold XLA compile of a fused superstage is seconds to minutes.
+_COMPILE_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+COMPILE_SECONDS = _REGISTRY.histogram(
+    "tpu_compile_seconds",
+    "Wall duration of each compile-cache miss's first call (jit trace "
+    "+ XLA compile) by cache — the inline-compile cost ROADMAP item "
+    "4's AOT cache exists to remove (obs/compile_watch.py)",
+    buckets=_COMPILE_BUCKETS,
+    labels=("cache",))
+
+DEVICE_BUSY_SECONDS = _REGISTRY.counter(
+    "tpu_device_busy_seconds_total",
+    "Device-busy wall time by device id: fused pending-pool flush "
+    "windows on the dispatch device, plus mesh SPMD dispatch windows "
+    "attributed to every participating device (obs/timeline.py)",
+    labels=("device",))
+
+#: idle-gap taxonomy of the utilization timeline (docs/observability.md)
+TIMELINE_GAP_CAUSES = ("inline_compile", "sem_wait", "admission_queue",
+                       "host_staging", "pipeline_starvation", "idle")
+
+
+def _timeline_mod():
+    from . import timeline
+    return timeline
+
+
+DEVICE_UTIL_PCT = _REGISTRY.gauge(
+    "tpu_device_util_pct",
+    "Process-wide device utilization percent: merged busy intervals / "
+    "wall window since the first observed dispatch (obs/timeline.py)",
+    fn=lambda: _timeline_mod().process_util_pct())
+DEVICE_IDLE_PCT = _REGISTRY.gauge(
+    "tpu_device_idle_pct",
+    "Idle share of the process wall window by attributed cause; busy "
+    "pct + all idle-cause pcts sum to 100 (obs/timeline.py)",
+    labels=("cause",))
+for _cause in TIMELINE_GAP_CAUSES:
+    DEVICE_IDLE_PCT.labels(cause=_cause).set_function(
+        lambda c=_cause: _timeline_mod().process_gap_pct(c))
+
+SLO_LATENCY_SECONDS = _REGISTRY.histogram(
+    "tpu_slo_latency_seconds",
+    "Per-tenant service latency by phase: end_to_end (queue wait + "
+    "execution), queue_wait, exec (obs/slo.py)",
+    labels=("tenant", "phase"))
+SLO_BREACHES = _REGISTRY.counter(
+    "tpu_slo_breaches_total",
+    "Queries past spark.rapids.tpu.obs.slo.targetMs by tenant, each "
+    "attributed to exactly one cause "
+    "(shed/deadline/inline_compile/slow_exec)",
+    labels=("tenant", "cause"))
+SLO_BURN_MS = _REGISTRY.counter(
+    "tpu_slo_burn_ms_total",
+    "Cumulative ms of SLO overshoot per tenant (the error-budget burn "
+    "counter: breach count says how often, burn says how badly)",
+    labels=("tenant",))
+
+
+def compile_cache_event(cache: str, hit: bool, dur_ns: int = 0,
+                        signature=None):
     """One compile-cache lookup (called from the exec/kernels JIT
-    caches; compile paths, not per-batch hot paths)."""
+    caches; compile paths, not per-batch hot paths).  A miss whose
+    compile duration is already known may pass ``dur_ns``/``signature``
+    to feed the compile-telemetry plane directly; callers that only
+    learn the duration at the jitted callable's first invocation use
+    ``compile_watch.wrap_miss`` instead."""
     COMPILE_CACHE.labels(cache=cache,
                          outcome="hit" if hit else "miss").inc()
+    if dur_ns > 0:
+        from . import compile_watch
+        compile_watch.note_compile(cache, dur_ns, signature)
 
 
 def superstage_event(event: str, n: int = 1):

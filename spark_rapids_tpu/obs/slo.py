@@ -1,0 +1,168 @@
+"""Per-tenant SLO latency plane — what end-to-end latency each tenant
+actually experiences, and when it breaches, WHY.
+
+The admission controller (service/server.py) already measures every
+query's queue wait and execution wall separately (QueryMetrics); this
+module folds those per-query figures into per-tenant accounting:
+
+- latency histograms ``tpu_slo_latency_seconds{tenant,phase}`` with
+  phase = total (queue + exec), queue, exec — admission wait stays
+  separable from execution in Prometheus, matching the event-log
+  split;
+- bounded per-tenant reservoirs feeding nearest-rank p50/p95/p99 into
+  ``Service.stats()`` (the "tenant p99" number the north star's
+  serving story is judged by);
+- breach/burn accounting against ``spark.rapids.tpu.obs.slo.targetMs``
+  (0 = no target: histograms still record, breach counters stay
+  silent): every breach is attributed to EXACTLY ONE cause —
+
+  - ``shed``           — admission rejected the query outright;
+  - ``deadline``       — cancelled by its deadline;
+  - ``inline_compile`` — the query finished late and its recorded
+    inline-compile time covers the overshoot (the compile WAS the
+    breach — the AOT cache roadmap item's target population);
+  - ``slow_exec``      — finished late for any other reason.
+
+  Shed and deadline-cancelled queries always count as breaches when a
+  target is set: the tenant asked and did not get an answer in time.
+  ``tpu_slo_burn_ms_total`` accumulates the overshoot magnitude —
+  breaches say how often, burn says how badly.
+
+Latency is derived purely from QueryMetrics fields the server already
+stamped (this module never reads wall clocks — obs/ lint scope HYG002
+bans ``time.time()`` and nothing here needs a clock).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from .registry import SLO_BREACHES, SLO_BURN_MS, SLO_LATENCY_SECONDS
+
+#: breach causes (exactly one per breach; docs/observability.md)
+BREACH_CAUSES = ("shed", "deadline", "inline_compile", "slow_exec")
+
+_RESERVOIR_CAP = 1 << 14
+
+_ENABLED = True
+_TARGET_MS = 0.0
+_LOCK = threading.Lock()
+
+
+class _Tenant:
+    """One tenant's bounded latency reservoirs + breach accounting."""
+
+    __slots__ = ("total_ms", "queue_ms", "exec_ms", "count",
+                 "breaches", "burn_ms", "causes")
+
+    def __init__(self):
+        self.total_ms: List[float] = []
+        self.queue_ms: List[float] = []
+        self.exec_ms: List[float] = []
+        self.count = 0
+        self.breaches = 0
+        self.burn_ms = 0.0
+        self.causes: Dict[str, int] = {}
+
+
+_TENANTS: Dict[str, _Tenant] = {}
+
+
+def record(m) -> None:
+    """Fold one finished query's QueryMetrics into its tenant's
+    accounting.  Called by the service at every terminal transition
+    (completed, failed, shed, cancelled) — exactly once per query."""
+    if not _ENABLED:
+        return
+    tenant = str(getattr(m, "tenant", None) or "default")
+    queue = float(m.queue_wait_ms or 0.0)
+    execd = float(m.execute_ms or 0.0)
+    total = queue + execd
+    SLO_LATENCY_SECONDS.labels(tenant=tenant,
+                               phase="total").observe(total / 1e3)
+    SLO_LATENCY_SECONDS.labels(tenant=tenant,
+                               phase="queue").observe(queue / 1e3)
+    SLO_LATENCY_SECONDS.labels(tenant=tenant,
+                               phase="exec").observe(execd / 1e3)
+
+    cause = None
+    if _TARGET_MS > 0:
+        if m.outcome == "shed":
+            cause = "shed"
+        elif m.outcome == "cancelled" and "deadline" in (m.error or ""):
+            cause = "deadline"
+        elif total > _TARGET_MS:
+            overshoot = total - _TARGET_MS
+            inline = float(getattr(m, "inline_compile_ms", 0.0) or 0.0)
+            cause = "inline_compile" if inline >= overshoot \
+                else "slow_exec"
+
+    with _LOCK:
+        t = _TENANTS.get(tenant)
+        if t is None:
+            t = _TENANTS[tenant] = _Tenant()
+        t.count += 1
+        if len(t.total_ms) < _RESERVOIR_CAP:
+            t.total_ms.append(total)
+            t.queue_ms.append(queue)
+            t.exec_ms.append(execd)
+        if cause is not None:
+            t.breaches += 1
+            t.causes[cause] = t.causes.get(cause, 0) + 1
+            burn = max(total - _TARGET_MS, 0.0)
+            t.burn_ms += burn
+    if cause is not None:
+        SLO_BREACHES.labels(tenant=tenant, cause=cause).inc()
+        SLO_BURN_MS.labels(tenant=tenant).inc(
+            max(total - _TARGET_MS, 0.0))
+
+
+def _pctl(sorted_ms: List[float], q: float) -> float:
+    """Nearest-rank percentile over a pre-sorted ms sample."""
+    if not sorted_ms:
+        return 0.0
+    i = min(len(sorted_ms) - 1, int(q * (len(sorted_ms) - 1) + 0.5))
+    return sorted_ms[i]
+
+
+def stats_section() -> Dict:
+    """The ``slo`` section of ``Service.stats().snapshot()``."""
+    with _LOCK:
+        tenants = {name: (list(t.total_ms), list(t.queue_ms),
+                          list(t.exec_ms), t.count, t.breaches,
+                          t.burn_ms, dict(t.causes))
+                   for name, t in _TENANTS.items()}
+    out: Dict = {"target_ms": _TARGET_MS, "tenants": {}}
+    for name in sorted(tenants):
+        total, queue, execd, count, breaches, burn, causes = tenants[name]
+        total.sort()
+        queue.sort()
+        execd.sort()
+        out["tenants"][name] = {
+            "count": count,
+            "p50_ms": round(_pctl(total, 0.5), 3),
+            "p95_ms": round(_pctl(total, 0.95), 3),
+            "p99_ms": round(_pctl(total, 0.99), 3),
+            "queue_p95_ms": round(_pctl(queue, 0.95), 3),
+            "exec_p95_ms": round(_pctl(execd, 0.95), 3),
+            "breaches": breaches,
+            "burn_ms": round(burn, 3),
+            "breach_causes": causes,
+        }
+    return out
+
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.slo.*`` conf group (called by
+    QueryService.__init__; last-configured service wins — the plane is
+    process-wide like the rest of the registry)."""
+    global _ENABLED, _TARGET_MS
+    from ..config import OBS_SLO_ENABLED, OBS_SLO_TARGET_MS
+    _ENABLED = bool(conf.get(OBS_SLO_ENABLED))
+    _TARGET_MS = float(conf.get(OBS_SLO_TARGET_MS))
+
+
+def reset() -> None:
+    """Test hook: drop all tenant accounting."""
+    with _LOCK:
+        _TENANTS.clear()

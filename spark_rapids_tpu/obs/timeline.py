@@ -1,0 +1,362 @@
+"""Device-utilization timeline — busy/idle reconstruction with gap blame.
+
+The stats plane (PR 7) times every pending-pool flush; this module
+keeps those timings as *intervals* instead of bare durations, so the
+engine can finally answer the question the mesh-scaling and AOT-cache
+roadmap items hinge on: what fraction of wall-clock is the device
+actually busy, and what eats the idle gaps?
+
+Busy intervals come from two sources:
+
+- ``note_flush(dur_ns)`` — chained from the flush observer
+  (obs/profile.py): a fused pending-pool flush ran on the dispatch
+  device for ``[now - dur, now]``;
+- ``device_busy_wrap(fn, device_ids)`` — mesh SPMD programs
+  (parallel/mesh.py) wrap their jitted callable so each call window is
+  attributed to EVERY participating device id, which is what makes the
+  8-device multichip smoke show per-chip occupancy instead of one
+  blended number.
+
+Both feed the ``tpu_device_busy_seconds_total{device=...}`` counter and
+a bounded process-wide interval list.  Idle gaps between busy intervals
+are classified post-hoc (cold path only) by joining evidence streams:
+
+- ``inline_compile``      — compile_watch record windows;
+- ``sem_wait``            — flight EV_SEM_ACQUIRE (a = waited ns, so
+                            the wait interval is ``[ts - a, ts]``);
+- ``admission_queue``     — flight EV_STATE admitted -> running spans;
+- ``host_staging``        — remainder inside a morsel-pipeline drain
+                            window (EV_PIPELINE dispatch -> drain_end,
+                            paired per thread) whose recorded
+                            staging/compute overlap ratio was healthy
+                            (>= 0.5): the host kept the pipeline fed
+                            and the residual idleness is staging
+                            throughput.  In per-query summaries the
+                            unexplained remainder also lands here —
+                            the query was running, the device was not,
+                            and nothing else claimed the time;
+- ``pipeline_starvation`` — drain-window remainder whose overlap ratio
+                            was poor (< 0.5): producers sat idle and
+                            under-fed the device;
+- ``idle``                — process-summary remainder outside any
+                            query evidence (import, datagen, the time
+                            between queries).
+
+Classification subtracts the evidence streams in that priority order,
+so every idle nanosecond lands in exactly one bucket and
+``util_pct + sum(gap shares) == 100`` by construction (asserted in
+tests and ci/obs_smoke.py).
+
+Agreement contract: a summary's ``busy_ms`` is the UNMERGED sum of the
+interval durations recorded in the window — identical arithmetic to
+summing the flush observer's dispatch durations, which is the <=1%
+acceptance criterion.  ``util_pct`` uses the MERGED intervals so
+overlapping mesh windows cannot push utilization past 100.
+
+Hot-path discipline (this file is on the SYNC001/OBS002 lint scope):
+``note_flush`` is one perf_counter read, one bounded list append and
+one cached counter-child inc; classification allocates only on the
+cold summary paths.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import flight
+from .registry import DEVICE_BUSY_SECONDS, TIMELINE_GAP_CAUSES
+
+_ENABLED = True
+_CAP = 1 << 16          #: bounded interval store (conf maxIntervals)
+
+#: (start_ns, end_ns) busy intervals, append-only and GIL-atomic like
+#: profile._DISPATCH; readers slice, never mutate.
+_INTERVALS: List[Tuple[int, int]] = []
+_DROPPED = 0
+_FIRST_NS: Optional[int] = None
+
+#: cached counter child for the single-dispatch-device flush path
+_BUSY0 = DEVICE_BUSY_SECONDS.labels(device="0")
+
+#: process_summary memo for collect-time gauge scrapes (7 children per
+#: scrape would otherwise recompute the classification 7 times)
+_MEMO: List = [0, None]
+_MEMO_TTL_NS = 200_000_000
+
+#: drain overlap ratio (permille, from EV_PIPELINE drain_end b) at or
+#: above which drain-window idleness blames staging throughput rather
+#: than pipeline starvation
+_HEALTHY_OVERLAP_PERMILLE = 500
+
+
+def note_flush(dur_ns: int) -> None:
+    """One pending-pool flush ended now, having run ``dur_ns`` on the
+    dispatch device (chained from profile._on_flush)."""
+    global _FIRST_NS, _DROPPED
+    if not _ENABLED:
+        return
+    end = time.perf_counter_ns()
+    start = end - dur_ns
+    if _FIRST_NS is None:
+        _FIRST_NS = start
+    if len(_INTERVALS) < _CAP:
+        _INTERVALS.append((start, end))
+    else:
+        _DROPPED += 1
+    _BUSY0.inc(dur_ns / 1e9)
+
+
+def device_busy_wrap(fn, device_ids: Sequence):
+    """Wrap a mesh SPMD callable so each call window counts as busy
+    time on every participating device id (parallel/mesh.py)."""
+    if not _ENABLED:
+        return fn
+    children = tuple(DEVICE_BUSY_SECONDS.labels(device=str(d))
+                     for d in device_ids)
+
+    def _timed(*args, **kwargs):
+        global _FIRST_NS, _DROPPED
+        t0 = time.perf_counter_ns()
+        out = fn(*args, **kwargs)
+        t1 = time.perf_counter_ns()
+        if _FIRST_NS is None:
+            _FIRST_NS = t0
+        if len(_INTERVALS) < _CAP:
+            _INTERVALS.append((t0, t1))
+        else:
+            _DROPPED += 1
+        secs = (t1 - t0) / 1e9
+        for child in children:
+            child.inc(secs)
+        return out
+
+    return _timed
+
+
+def begin_query() -> Tuple[int, int]:
+    """Marker for a per-query summary window: (interval store index,
+    start ns).  The FLUSH_COUNT discipline — exact when queries run
+    serially, which is how the bench and the report use it."""
+    return (len(_INTERVALS), time.perf_counter_ns())
+
+
+# ---------------------------------------------------------------------------
+# interval arithmetic (cold paths only)
+# ---------------------------------------------------------------------------
+
+def _merge(segs: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    if not segs:
+        return []
+    segs = sorted(segs)
+    out = [segs[0]]
+    for s, e in segs[1:]:
+        ls, le = out[-1]
+        if s <= le:
+            if e > le:
+                out[-1] = (ls, e)
+        else:
+            out.append((s, e))
+    return out
+
+def _clip(segs: List[Tuple[int, int]], t0: int,
+          t1: int) -> List[Tuple[int, int]]:
+    out = []
+    for s, e in segs:
+        s2, e2 = max(s, t0), min(e, t1)
+        if e2 > s2:
+            out.append((s2, e2))
+    return out
+
+
+def _subtract(base: List[Tuple[int, int]],
+              cover: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    """base minus cover; both merged+sorted.  Returns what remains."""
+    if not base or not cover:
+        return list(base)
+    out = []
+    ci = 0
+    for s, e in base:
+        cur = s
+        while ci < len(cover) and cover[ci][1] <= cur:
+            ci += 1
+        j = ci
+        while j < len(cover) and cover[j][0] < e:
+            cs, ce = cover[j]
+            if cs > cur:
+                out.append((cur, cs))
+            cur = max(cur, ce)
+            if cur >= e:
+                break
+            j += 1
+        if cur < e:
+            out.append((cur, e))
+    return out
+
+
+def _total(segs: List[Tuple[int, int]]) -> int:
+    return sum(e - s for s, e in segs)
+
+
+# ---------------------------------------------------------------------------
+# evidence streams for gap classification
+# ---------------------------------------------------------------------------
+
+def _compile_segs(t0: int, t1: int) -> List[Tuple[int, int]]:
+    from . import compile_watch
+    segs = []
+    for rec in compile_watch.records_since(0):
+        end = rec["end_ns"]
+        start = end - int(rec["dur_ms"] * 1e6)
+        if end > t0 and start < t1:
+            segs.append((start, end))
+    return segs
+
+
+def _flight_evidence(t0: int, t1: int):
+    """(sem_wait segs, admission segs, drain windows) from the flight
+    recorder tail, clipped to [t0, t1].  Drain windows pair EV_PIPELINE
+    "dispatch" with the next "drain_end" on the same thread and carry
+    that drain's overlap permille."""
+    sem: List[Tuple[int, int]] = []
+    admission: List[Tuple[int, int]] = []
+    drains: List[Tuple[int, int, int]] = []
+    admitted_at: Dict[str, int] = {}
+    drain_open: Dict[str, int] = {}
+    for ev in flight.snapshot():
+        ts = ev["ts_ns"]
+        kind = ev["kind"]
+        if kind == flight.EV_SEM_ACQUIRE:
+            waited = ev["a"]
+            if waited > 0:
+                sem.append((ts - waited, ts))
+        elif kind == flight.EV_STATE:
+            qid = ev["query_id"]
+            if ev["name"] == "admitted":
+                admitted_at[str(qid)] = ts
+            elif ev["name"] == "running":
+                start = admitted_at.pop(str(qid), None)
+                if start is not None:
+                    admission.append((start, ts))
+        elif kind == flight.EV_PIPELINE:
+            # name constants from exec/pipeline.py (_N_DISPATCH /
+            # _N_DRAIN_END; drain_end b = overlap ratio x1000)
+            if ev["name"] == "dispatch":
+                drain_open[ev["thread"]] = ts
+            elif ev["name"] == "drain_end":
+                start = drain_open.pop(ev["thread"], None)
+                if start is not None:
+                    drains.append((start, ts, ev["b"]))
+    sem = _clip(_merge(sem), t0, t1)
+    admission = _clip(_merge(admission), t0, t1)
+    drains = [(max(s, t0), min(e, t1), r) for s, e, r in drains
+              if e > t0 and s < t1]
+    return sem, admission, drains
+
+
+def _summarize(idx: int, t0: int, t1: int, is_query: bool) -> Dict:
+    """Busy/idle breakdown of [t0, t1] over intervals[idx:].  See the
+    module docstring for the taxonomy and the priority order."""
+    segs = _INTERVALS[idx:]
+    window_ns = max(t1 - t0, 1)
+    busy_raw_ns = _total(segs)          # matches summed flush durations
+    merged = _clip(_merge(list(segs)), t0, t1)
+    idle = _subtract([(t0, t1)], merged)
+
+    gaps_ns = {cause: 0 for cause in TIMELINE_GAP_CAUSES}
+
+    compile_segs = _clip(_merge(_compile_segs(t0, t1)), t0, t1)
+    taken = _subtract(idle, compile_segs)
+    gaps_ns["inline_compile"] = _total(idle) - _total(taken)
+    idle = taken
+
+    sem, admission, drains = _flight_evidence(t0, t1)
+    taken = _subtract(idle, sem)
+    gaps_ns["sem_wait"] = _total(idle) - _total(taken)
+    idle = taken
+    taken = _subtract(idle, admission)
+    gaps_ns["admission_queue"] = _total(idle) - _total(taken)
+    idle = taken
+
+    healthy = _merge([(s, e) for s, e, r in drains
+                      if r >= _HEALTHY_OVERLAP_PERMILLE])
+    starved = _merge([(s, e) for s, e, r in drains
+                      if r < _HEALTHY_OVERLAP_PERMILLE])
+    taken = _subtract(idle, healthy)
+    gaps_ns["host_staging"] = _total(idle) - _total(taken)
+    idle = taken
+    taken = _subtract(idle, starved)
+    gaps_ns["pipeline_starvation"] = _total(idle) - _total(taken)
+    idle = taken
+
+    # remainder: inside a query window the device sat idle while the
+    # query ran — host staging by elimination; process-wide it is
+    # genuinely idle time (between queries, import, datagen)
+    rest = _total(idle)
+    gaps_ns["host_staging" if is_query else "idle"] += rest
+
+    util_pct = _total(merged) / window_ns * 100.0
+    return {
+        "busy_ms": round(busy_raw_ns / 1e6, 3),
+        "window_ms": round(window_ns / 1e6, 3),
+        "util_pct": round(util_pct, 3),
+        "intervals": len(segs),
+        "dropped": _DROPPED,
+        "gaps": {cause: round(ns / window_ns * 100.0, 3)
+                 for cause, ns in gaps_ns.items()},
+    }
+
+
+def query_summary(marker: Tuple[int, int]) -> Dict:
+    """Summary of the window since a ``begin_query()`` marker (the
+    per-query utilization lane in tools/report.py)."""
+    idx, t0 = marker
+    return _summarize(idx, t0, time.perf_counter_ns(), is_query=True)
+
+
+def process_summary() -> Dict:
+    """Process-wide summary since the first observed dispatch; memoized
+    briefly so a Prometheus scrape of the 7 gauge children classifies
+    once, not 7 times."""
+    now = time.perf_counter_ns()
+    memo_ts, memo = _MEMO
+    if memo is not None and now - memo_ts < _MEMO_TTL_NS:
+        return memo
+    if _FIRST_NS is None:
+        out = {"busy_ms": 0.0, "window_ms": 0.0, "util_pct": 0.0,
+               "intervals": 0, "dropped": 0,
+               "gaps": {cause: 0.0 for cause in TIMELINE_GAP_CAUSES}}
+    else:
+        out = _summarize(0, _FIRST_NS, now, is_query=False)
+    _MEMO[0] = now
+    _MEMO[1] = out
+    return out
+
+
+def process_util_pct() -> float:
+    """Collect-time callback for the tpu_device_util_pct gauge."""
+    return process_summary()["util_pct"]
+
+
+def process_gap_pct(cause: str) -> float:
+    """Collect-time callback for tpu_device_idle_pct{cause=...}."""
+    return process_summary()["gaps"].get(cause, 0.0)
+
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.timeline.*`` conf group."""
+    global _ENABLED, _CAP
+    from ..config import OBS_TIMELINE_ENABLED, OBS_TIMELINE_MAX_INTERVALS
+    _ENABLED = bool(conf.get(OBS_TIMELINE_ENABLED))
+    cap = int(conf.get(OBS_TIMELINE_MAX_INTERVALS))
+    if cap > 0:
+        _CAP = cap
+
+
+def reset() -> None:
+    """Test hook: drop intervals and the process window origin."""
+    global _FIRST_NS, _DROPPED
+    del _INTERVALS[:]
+    _FIRST_NS = None
+    _DROPPED = 0
+    _MEMO[0] = 0
+    _MEMO[1] = None

@@ -30,6 +30,16 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(np.array(devs[:n]), (axis_name,))
 
 
+def _instrumented(fn, mesh: Mesh):
+    """Wrap a jitted SPMD program so each dispatch window counts as
+    busy time on EVERY participating device id (obs/timeline.py): an
+    SPMD step runs lock-step across the mesh, so the multichip smoke
+    shows per-chip occupancy instead of one blended number."""
+    from ..obs import timeline as _timeline
+    ids = tuple(str(d.id) for d in np.asarray(mesh.devices).ravel())
+    return _timeline.device_busy_wrap(fn, ids)
+
+
 def shard_rows(arrays, mesh: Mesh, axis_name: str = "data"):
     """Place [n_dev * rows, ...] arrays row-sharded across the mesh."""
     sharding = NamedSharding(mesh, P(axis_name))
@@ -141,7 +151,7 @@ def distributed_sum_by_key(mesh: Mesh, axis_name: str = "data"):
         in_specs=(P(axis_name), P(axis_name), P(axis_name)),
         out_specs=(P(axis_name), P(axis_name), P(axis_name),
                    P(axis_name)))
-    return jax.jit(smapped)
+    return _instrumented(jax.jit(smapped), mesh)
 
 
 def distributed_global_sum(mesh: Mesh, axis_name: str = "data"):
@@ -153,9 +163,9 @@ def distributed_global_sum(mesh: Mesh, axis_name: str = "data"):
         local = jnp.sum(jnp.where(valid, vals, 0))
         return jax.lax.psum(local, axis_name)[None]
 
-    return jax.jit(shard_map(
+    return _instrumented(jax.jit(shard_map(
         step, mesh=mesh, in_specs=(P(axis_name), P(axis_name)),
-        out_specs=P(axis_name)))
+        out_specs=P(axis_name))), mesh)
 
 
 def distributed_join_sum(mesh: Mesh, axis_name: str = "data"):
@@ -222,7 +232,7 @@ def distributed_join_sum(mesh: Mesh, axis_name: str = "data"):
         in_specs=(P(axis_name),) * 6,
         out_specs=(P(axis_name), P(axis_name), P(axis_name),
                    P(axis_name)))
-    return jax.jit(smapped)
+    return _instrumented(jax.jit(smapped), mesh)
 
 
 def distributed_sort(mesh: Mesh, axis_name: str = "data",
@@ -267,4 +277,4 @@ def distributed_sort(mesh: Mesh, axis_name: str = "data",
         step, mesh=mesh,
         in_specs=(P(axis_name), P(axis_name)),
         out_specs=(P(axis_name), P(axis_name), P(axis_name)))
-    return jax.jit(smapped)
+    return _instrumented(jax.jit(smapped), mesh)

@@ -27,6 +27,7 @@ class QueryMetrics:
     queue_wait_ms: float = 0.0
     sem_wait_ms: float = 0.0
     execute_ms: float = 0.0
+    inline_compile_ms: float = 0.0
     spill_bytes: int = 0
     attempts: int = 1
     retries: int = 0
@@ -43,6 +44,7 @@ class QueryMetrics:
             "queue_wait_ms": round(self.queue_wait_ms, 3),
             "sem_wait_ms": round(self.sem_wait_ms, 3),
             "execute_ms": round(self.execute_ms, 3),
+            "inline_compile_ms": round(self.inline_compile_ms, 3),
             "spill_bytes": int(self.spill_bytes),
             "attempts": self.attempts,
             "retries": self.retries,

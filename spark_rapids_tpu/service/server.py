@@ -28,7 +28,10 @@ from ..config import (TpuConf, set_active, EVENT_LOG_PATH,
                       OBS_WATCHDOG_ENABLED, OBS_WATCHDOG_INTERVAL_MS,
                       OBS_WATCHDOG_STALL_S, OBS_DIAG_DIR,
                       OBS_DIAG_MAX_BUNDLES)
+from ..obs import compile_watch as _cwatch
 from ..obs import flight as _flight
+from ..obs import slo as _slo
+from ..obs import timeline as _timeline
 from ..obs import trace as _trace
 from ..obs.registry import (QUEUE_WAIT_SECONDS, SERVICE_INFLIGHT,
                             SERVICE_QUEUE_DEPTH, SERVICE_QUEUED_BYTES)
@@ -154,12 +157,20 @@ class QueryService:
         SERVICE_QUEUED_BYTES.set_function(
             lambda: self.queue.stats().get("queued_bytes", 0))
         SERVICE_INFLIGHT.set_function(lambda: len(self._inflight))
+        # serving-grade performance plane: conf the three obs planes
+        # (process-wide, like the registry — last service wins)
+        _slo.configure(conf)
+        _cwatch.configure(conf)
+        _timeline.configure(conf)
         # stats().snapshot() carries the live obs sections alongside the
         # lifecycle counters (the monitoring one-stop view)
         self._stats.set_extras(lambda: {
             "watchdog": self.watchdog.state(),
             "flight_recorder": _flight.occupancy(),
             "pipeline": _pipeline_stats(),
+            "slo": _slo.stats_section(),
+            "compile": _cwatch.stats_section(),
+            "timeline": _timeline.process_summary(),
         })
 
     # -- lifecycle ---------------------------------------------------------
@@ -251,6 +262,7 @@ class QueryService:
             self._forget(handle)
             self._stats.inc("shed")
             handle.metrics.outcome = "shed"
+            _slo.record(handle.metrics)
             handle._finish(FAILED, error=e)
             _flight.record(_flight.EV_STATE, "shed", query_id=query_id)
             bundle = self._maybe_shed_bundle(handle, e)
@@ -286,6 +298,7 @@ class QueryService:
                 if not handle.done():
                     handle.metrics.outcome = "failed"
                     handle.metrics.error = repr(e)
+                    _slo.record(handle.metrics)
                     handle._finish(FAILED, error=e)
                 self._forget(handle)
 
@@ -342,6 +355,7 @@ class QueryService:
                 m.outcome = "failed"
                 m.error = repr(e)
                 self._stats.inc("failed")
+                _slo.record(m)
                 handle._finish(FAILED, error=e)
                 _flight.record(_flight.EV_STATE, "failed",
                                query_id=handle.query_id)
@@ -356,6 +370,7 @@ class QueryService:
                 return
             m.outcome = "completed"
             self._stats.inc("completed")
+            _slo.record(m)
             handle._finish(DONE, result=table)
             _flight.record(_flight.EV_STATE, "completed",
                            query_id=handle.query_id)
@@ -386,6 +401,8 @@ class QueryService:
                 phys, conf=conf, fallbacks=planner.fallbacks)
             m.execute_ms += (time.perf_counter() - t0) * 1000.0
             m.sem_wait_ms += token.observed.get("sem_wait_ms", 0.0)
+            m.inline_compile_ms += token.observed.get(
+                "inline_compile_ms", 0.0)
             m.spill_bytes += int(token.observed.get("spill_bytes", 0))
             return table
 
@@ -420,6 +437,7 @@ class QueryService:
         m.outcome = "cancelled"
         m.error = reason
         self._stats.inc("cancelled")
+        _slo.record(m)
         if reason == "deadline":
             self._stats.inc("deadline_exceeded")
         err = QueryCancelledError(reason, handle.query_id)

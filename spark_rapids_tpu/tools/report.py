@@ -182,10 +182,13 @@ def _service_story(service: List[Dict]) -> List[str]:
                        + (f"  bundle={rec['diag_bundle']}"
                           if rec.get("diag_bundle") else ""))
         elif kind in ("completed", "failed", "cancelled"):
+            # wall-clock split: queue wait / execution / the inline
+            # compile time hidden inside execution (perf plane)
             line = (
                 f"{kind:<11s} attempts={rec.get('attempts')} "
                 f"queue_wait_ms={rec.get('queue_wait_ms')} "
                 f"execute_ms={rec.get('execute_ms')} "
+                f"inline_compile_ms={_fmt(rec.get('inline_compile_ms'))} "
                 f"sem_wait_ms={rec.get('sem_wait_ms')} "
                 f"spill_bytes={rec.get('spill_bytes')}"
                 + (f" error={rec.get('error')}"
@@ -202,6 +205,43 @@ def _fmt(v):
     """Missing-field placeholder: older event logs predate newer record
     fields (flushes, sem_wait_ms, stats_profile) and must still render."""
     return "-" if v is None else v
+
+
+def util_lines(rec: Dict) -> List[str]:
+    """The device-utilization lane of one engine record: busy share of
+    the query window plus the idle-gap attribution breakdown
+    (obs/timeline.py gap taxonomy)."""
+    util = rec.get("device_util_pct")
+    if util is None:
+        return []
+    lines = ["-- device utilization --"]
+    bar = "#" * int(round(util / 5.0))
+    lines.append(f"  busy {util:6.1f}%  {bar:<20s} "
+                 f"busy_ms={_fmt(rec.get('device_busy_ms'))}")
+    gaps = rec.get("util_gap_breakdown") or {}
+    for cause, pct in sorted(gaps.items(), key=lambda kv: -kv[1]):
+        if pct > 0:
+            bar = "." * int(round(pct / 5.0))
+            lines.append(f"  {cause:<21s}{pct:6.1f}%  {bar}")
+    return lines
+
+
+def compile_lines(rec: Dict) -> List[str]:
+    """The compile story of one engine record: every compile that
+    landed in the query's window, slowest first — the same dur_ms the
+    tpu_compile_seconds histogram observed."""
+    compiles = rec.get("compiles") or []
+    if not compiles:
+        return []
+    lines = ["-- compiles in query window --"]
+    lines.append(f"  {'cache':<22s}{'dur_ms':>10s}  {'inline':<7s}"
+                 "signature")
+    for c in sorted(compiles, key=lambda c: -(c.get("dur_ms") or 0)):
+        lines.append(f"  {str(c.get('cache')):<22s}"
+                     f"{_fmt(c.get('dur_ms')):>10}  "
+                     f"{str(bool(c.get('inline'))).lower():<7s}"
+                     f"{str(c.get('signature', ''))[:60]}")
+    return lines
 
 
 def stats_lines(prof: Dict) -> List[str]:
@@ -272,11 +312,18 @@ def render_query_report(query_id, story: Dict,
             # device round trips this query — THE cost model on
             # remote-dispatch backends (columnar/pending.py)
             head += f" flushes={rec.get('flushes')}"
+        if rec.get("inline_compile_ms") is not None:
+            head += (f" inline_compile_ms="
+                     f"{rec.get('inline_compile_ms')}")
+        if rec.get("device_util_pct") is not None:
+            head += f" device_util_pct={rec.get('device_util_pct')}"
         lines.append(head + " --")
         lines.extend(_format_plan(plan_time_shares(rec)))
         if rec.get("fallbacks"):
             lines.append("  CPU fallbacks:")
             lines.extend(f"    {f}" for f in rec["fallbacks"])
+        lines.extend(util_lines(rec))
+        lines.extend(compile_lines(rec))
         if show_stats:
             prof = rec.get("stats_profile")
             if prof:
@@ -297,12 +344,48 @@ def render_query_report(query_id, story: Dict,
     return "\n".join(lines)
 
 
+def slo_header(stories: Dict) -> List[str]:
+    """Per-tenant latency header over every terminal service record in
+    the log: nearest-rank p50/p95/p99 of queue_wait + execute (the same
+    end-to-end definition obs/slo.py uses)."""
+    by_tenant: Dict[str, List[float]] = {}
+    for story in stories.values():
+        for rec in story.get("service", []):
+            if rec.get("event") not in ("completed", "failed",
+                                        "cancelled"):
+                continue
+            total = (float(rec.get("queue_wait_ms") or 0.0) +
+                     float(rec.get("execute_ms") or 0.0))
+            by_tenant.setdefault(
+                str(rec.get("tenant") or "default"), []).append(total)
+    if not by_tenant:
+        return []
+
+    def pctl(xs, q):
+        i = min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))
+        return xs[i]
+
+    lines = ["=== per-tenant latency (SLO plane) " + "=" * 27]
+    lines.append(f"  {'tenant':<16s}{'queries':>8s}{'p50_ms':>10s}"
+                 f"{'p95_ms':>10s}{'p99_ms':>10s}")
+    for tenant in sorted(by_tenant):
+        xs = sorted(by_tenant[tenant])
+        lines.append(f"  {tenant:<16s}{len(xs):>8d}"
+                     f"{pctl(xs, 0.5):>10.1f}{pctl(xs, 0.95):>10.1f}"
+                     f"{pctl(xs, 0.99):>10.1f}")
+    return lines
+
+
 def render_report(stories: Dict,
                   trace_events: Optional[List[Dict]] = None,
                   query_id=None, show_stats: bool = False) -> str:
     ids = [query_id] if query_id is not None else sorted(
         stories, key=lambda q: str(q))
     parts = []
+    if query_id is None:
+        header = slo_header(stories)
+        if header:
+            parts.append("\n".join(header))
     for qid in ids:
         if qid not in stories:
             raise KeyError(f"query {qid!r} not in event log")

@@ -1,0 +1,404 @@
+"""Performance-plane tests: device-utilization timeline (obs/timeline),
+compile telemetry (obs/compile_watch), per-tenant SLO accounting
+(obs/slo), the Prometheus exposition grammar over the new families, and
+the report tool's utilization/compile/SLO rendering."""
+import os
+import re
+import time
+
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.obs import compile_watch, slo, timeline
+from spark_rapids_tpu.obs.prom import render_text
+from spark_rapids_tpu.obs.registry import (TIMELINE_GAP_CAUSES,
+                                           get_registry)
+from spark_rapids_tpu.service.cancellation import CancelToken, \
+    query_context
+from spark_rapids_tpu.service.metrics import QueryMetrics
+
+MS = 1_000_000          # ns per ms
+
+
+@pytest.fixture(autouse=True)
+def _plane_reset():
+    """Isolate the process-wide planes from other tests (and restore
+    the default config afterwards — last-configured service wins)."""
+    timeline.reset()
+    compile_watch.reset()
+    slo.reset()
+    yield
+    default = TpuConf({})
+    timeline.configure(default)
+    compile_watch.configure(default)
+    slo.configure(default)
+    timeline.reset()
+    compile_watch.reset()
+    slo.reset()
+
+
+def _shares_total(summary):
+    return summary["util_pct"] + sum(summary["gaps"].values())
+
+
+# ---------------------------------------------------------------------------
+# timeline: interval accounting + gap classification
+# ---------------------------------------------------------------------------
+
+class TestTimeline:
+    def test_busy_ms_is_raw_sum_and_shares_sum_to_100(self):
+        marker = timeline.begin_query()
+        for dur_ms in (5, 3, 2):
+            time.sleep(0.001)
+            timeline.note_flush(dur_ms * MS)
+        s = timeline.query_summary(marker)
+        assert s["busy_ms"] == pytest.approx(10.0, abs=1e-6)
+        assert s["intervals"] == 3
+        assert _shares_total(s) == pytest.approx(100.0, abs=0.05)
+        assert set(s["gaps"]) == set(TIMELINE_GAP_CAUSES)
+
+    def test_overlapping_intervals_cap_util_below_100(self):
+        # two 6ms windows overlapping by 3ms inside a 10ms window:
+        # busy_ms reports the raw (unmerged) sum, util the merged share
+        now = time.perf_counter_ns()
+        t0 = now - 10 * MS
+        timeline._INTERVALS.extend([(t0, t0 + 6 * MS),
+                                    (t0 + 3 * MS, t0 + 9 * MS)])
+        s = timeline._summarize(0, t0, now, is_query=True)
+        assert s["busy_ms"] == pytest.approx(12.0, abs=1e-6)
+        assert s["util_pct"] == pytest.approx(90.0, abs=0.01)
+        assert _shares_total(s) == pytest.approx(100.0, abs=0.05)
+
+    def test_gap_blames_inline_compile_then_host_staging(self):
+        # 20ms window: [0,5) busy, [5,9) covered by a compile record,
+        # the rest unexplained -> host_staging in a QUERY summary
+        now = time.perf_counter_ns()
+        t0 = now - 20 * MS
+        timeline._INTERVALS.append((t0, t0 + 5 * MS))
+        compile_watch._RECORDS.append({
+            "cache": "ut", "dur_ms": 4.0, "signature": "", "inline": True,
+            "query_id": None, "end_ns": t0 + 9 * MS})
+        s = timeline._summarize(0, t0, now, is_query=True)
+        assert s["gaps"]["inline_compile"] == pytest.approx(20.0, abs=0.1)
+        assert s["gaps"]["host_staging"] == pytest.approx(55.0, abs=0.1)
+        assert s["gaps"]["idle"] == 0.0
+        assert _shares_total(s) == pytest.approx(100.0, abs=0.05)
+        # the same remainder is "idle" in a PROCESS summary
+        p = timeline._summarize(0, t0, now, is_query=False)
+        assert p["gaps"]["host_staging"] == 0.0
+        assert p["gaps"]["idle"] == pytest.approx(55.0, abs=0.1)
+
+    def test_process_summary_memoizes_and_feeds_gauges(self):
+        timeline.note_flush(2 * MS)
+        p1 = timeline.process_summary()
+        assert timeline.process_summary() is p1       # memo hit
+        assert timeline.process_util_pct() == p1["util_pct"]
+        total = (timeline.process_util_pct() +
+                 sum(timeline.process_gap_pct(c)
+                     for c in TIMELINE_GAP_CAUSES))
+        assert total == pytest.approx(100.0, abs=0.05)
+
+    def test_disabled_timeline_records_nothing(self):
+        timeline.configure(TpuConf({
+            "spark.rapids.tpu.obs.timeline.enabled": False}))
+        timeline.note_flush(5 * MS)
+        assert not timeline._INTERVALS
+
+    def test_warm_query_busy_agrees_with_flush_sum_within_1pct(self):
+        # the acceptance contract: a warm engine query's timeline
+        # busy_ms equals the flush observer's summed dispatch durations
+        from spark_rapids_tpu.obs import profile
+        s = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": True}))
+        df = (s.create_dataframe(
+                {"k": [i % 7 for i in range(4000)],
+                 "v": [float(i) for i in range(4000)]}, num_partitions=2)
+              .group_by("k").agg(F.sum("v").alias("sv")))
+        df.to_arrow()                                  # warm
+        marker = profile.begin_query()
+        df.to_arrow()
+        tl = s.last_query_timeline
+        flushes = profile._DISPATCH.get(profile.SITE_FLUSH, [])
+        flush_ms = sum(flushes[marker.get(profile.SITE_FLUSH, 0):]) / 1e6
+        assert flush_ms > 0
+        assert tl["busy_ms"] == pytest.approx(flush_ms, rel=0.01)
+        assert _shares_total(tl) == pytest.approx(100.0, abs=0.05)
+
+
+# ---------------------------------------------------------------------------
+# compile_watch: wrap_miss timing, inline attribution, agreement
+# ---------------------------------------------------------------------------
+
+class TestCompileWatch:
+    def _snap_hist(self, cache):
+        hists = get_registry().snapshot()["tpu_compile_seconds"]
+        return hists.get(f"cache={cache}", {"count": 0, "sum": 0.0})
+
+    def test_wrap_miss_times_first_call_only(self):
+        before = self._snap_hist("ut_cache")
+
+        def fn(x):
+            time.sleep(0.02)
+            return x + 1
+
+        wrapped = compile_watch.wrap_miss("ut_cache", fn, "(i64[4],)")
+        assert wrapped(1) == 2 and wrapped(2) == 3
+        recs = compile_watch.records_since(0)
+        assert len(recs) == 1                          # first call only
+        rec = recs[0]
+        assert rec["cache"] == "ut_cache"
+        assert rec["dur_ms"] >= 20
+        assert rec["signature"] == "(i64[4],)"
+        assert not rec["inline"] and rec["query_id"] is None
+        after = self._snap_hist("ut_cache")
+        # the histogram observed the SAME duration the record stores
+        assert after["count"] - before["count"] == 1
+        hist_ms = (after["sum"] - before["sum"]) * 1e3
+        assert hist_ms == pytest.approx(rec["dur_ms"], abs=1.0)
+        assert compile_watch.total_ns() / 1e6 == pytest.approx(
+            rec["dur_ms"], abs=1e-3)
+        assert compile_watch.inline_ns() == 0
+
+    def test_inline_compile_attributes_to_the_victim_token(self):
+        tok = CancelToken("q-inline")
+        wrapped = compile_watch.wrap_miss(
+            "ut_inline", lambda: time.sleep(0.01), "sig")
+        with query_context(tok):
+            wrapped()
+        rec = compile_watch.records_since(0)[0]
+        assert rec["inline"] and rec["query_id"] == "q-inline"
+        assert tok.observed["inline_compile_ms"] == pytest.approx(
+            rec["dur_ms"], abs=1e-3)
+        assert compile_watch.inline_ns() == compile_watch.total_ns()
+
+    def test_stats_section_ranks_slowest_first(self):
+        for i, ms in enumerate((1, 30, 5)):
+            compile_watch.note_compile(f"c{i}", ms * MS, f"s{i}")
+        sec = compile_watch.stats_section(top_n=2)
+        assert sec["compiles"] == 2
+        assert [r["cache"] for r in sec["top"]] == ["c1", "c2"]
+        assert sec["total_compile_ms"] == pytest.approx(36.0, abs=1e-3)
+
+    def test_record_store_evicts_cheapest(self):
+        cap = compile_watch._RECORD_CAP
+        for i in range(cap + 10):
+            compile_watch.note_compile("bulk", (i + 1) * 1000, None)
+        recs = compile_watch.records_since(0)
+        assert len(recs) == cap
+        # the cheapest entries were evicted, the slowest survived
+        assert min(r["dur_ms"] for r in recs) >= 10 / 1e3
+
+    def test_disabled_watch_is_passthrough(self):
+        compile_watch.configure(TpuConf({
+            "spark.rapids.tpu.obs.compile.enabled": False}))
+        fn = lambda: 7                                 # noqa: E731
+        assert compile_watch.wrap_miss("off", fn) is fn
+        compile_watch.note_compile("off", 5 * MS)
+        assert not compile_watch.records_since(0)
+
+
+# ---------------------------------------------------------------------------
+# slo: per-tenant accounting + exactly-one-cause breach attribution
+# ---------------------------------------------------------------------------
+
+def _metrics(tenant, queue=0.0, execute=0.0, outcome="completed",
+             error=None, inline=0.0):
+    m = QueryMetrics("q1", tenant, 0)
+    m.queue_wait_ms = queue
+    m.execute_ms = execute
+    m.outcome = outcome
+    m.error = error
+    m.inline_compile_ms = inline
+    return m
+
+
+class TestSlo:
+    TARGET = {"spark.rapids.tpu.obs.slo.targetMs": 100}
+
+    def test_each_breach_cause_attributed_exactly_once(self):
+        slo.configure(TpuConf(self.TARGET))
+        slo.record(_metrics("t", outcome="shed"))
+        slo.record(_metrics("t", execute=5.0, outcome="cancelled",
+                            error="deadline"))
+        slo.record(_metrics("t", queue=10.0, execute=200.0, inline=150.0))
+        slo.record(_metrics("t", queue=10.0, execute=200.0, inline=1.0))
+        slo.record(_metrics("t", execute=50.0))        # under target
+        sec = slo.stats_section()
+        t = sec["tenants"]["t"]
+        assert t["count"] == 5
+        assert t["breaches"] == 4
+        assert t["breach_causes"] == {"shed": 1, "deadline": 1,
+                                      "inline_compile": 1, "slow_exec": 1}
+        assert sum(t["breach_causes"].values()) == t["breaches"]
+        # burn is the overshoot of the two late completions (110 each)
+        assert t["burn_ms"] == pytest.approx(220.0, abs=1e-3)
+
+    def test_no_target_means_histograms_only(self):
+        slo.configure(TpuConf({}))                     # targetMs = 0
+        slo.record(_metrics("quiet", execute=10_000.0, outcome="shed"))
+        t = slo.stats_section()["tenants"]["quiet"]
+        assert t["count"] == 1 and t["breaches"] == 0
+        assert t["breach_causes"] == {} and t["burn_ms"] == 0.0
+
+    def test_percentiles_are_ordered_and_phase_split(self):
+        slo.configure(TpuConf({}))
+        for i in range(100):
+            slo.record(_metrics("p", queue=float(i), execute=float(2 * i)))
+        t = slo.stats_section()["tenants"]["p"]
+        assert 0 < t["p50_ms"] <= t["p95_ms"] <= t["p99_ms"]
+        assert t["p50_ms"] == pytest.approx(148.5, abs=3.5)
+        assert t["queue_p95_ms"] < t["exec_p95_ms"]
+
+    def test_disabled_slo_records_nothing(self):
+        slo.configure(TpuConf({
+            "spark.rapids.tpu.obs.slo.enabled": False}))
+        slo.record(_metrics("gone", execute=1.0))
+        assert "gone" not in slo.stats_section()["tenants"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition grammar over the populated new families
+# ---------------------------------------------------------------------------
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+_SAMPLE_RE = re.compile(
+    rf"^{_NAME}(?:\{{{_LABEL}(?:,{_LABEL})*\}})? "
+    r"(?:[+-]?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|Inf)|NaN)$")
+_HELP_RE = re.compile(rf"^# HELP {_NAME} [^\n]*$")
+_TYPE_RE = re.compile(rf"^# TYPE {_NAME} (?:counter|gauge|histogram)$")
+
+
+class TestPrometheusExposition:
+    # a tenant name exercising every label-escape rule in the format
+    EVIL = 'te"nant\\with\nnewline'
+
+    def test_metrics_text_lints_with_new_families_populated(self):
+        s = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": True}))
+        timeline.note_flush(2 * MS)
+        compile_watch.note_compile("lint", 3 * MS, "(f64[8],)")
+        slo.configure(TpuConf({"spark.rapids.tpu.obs.slo.targetMs": 1}))
+        slo.record(_metrics(self.EVIL, execute=50.0))
+        from spark_rapids_tpu.service.server import QueryService
+        with QueryService(s, num_workers=1) as svc:
+            svc.submit(s.range(0, 16)).result(60)
+            text = svc.metrics_text()
+
+        for family in ("tpu_compile_seconds_bucket",
+                       "tpu_compile_seconds_sum",
+                       "tpu_device_busy_seconds_total",
+                       "tpu_device_util_pct",
+                       "tpu_slo_latency_seconds_bucket",
+                       "tpu_slo_breaches_total",
+                       "tpu_slo_burn_ms_total"):
+            assert family in text, f"missing family {family}"
+        for cause in TIMELINE_GAP_CAUSES:
+            assert f'tpu_device_idle_pct{{cause="{cause}"}}' in text
+        # the adversarial tenant renders escaped, never raw
+        assert r'tenant="te\"nant\\with\nnewline"' in text
+
+        # line-by-line grammar lint of the whole exposition
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# HELP"):
+                assert _HELP_RE.match(line), line
+            elif line.startswith("# TYPE"):
+                assert _TYPE_RE.match(line), line
+            else:
+                assert _SAMPLE_RE.match(line), line
+
+    def test_idle_gauge_children_sum_with_util_to_100(self):
+        timeline.note_flush(1 * MS)
+        text = render_text()
+        got = {}
+        for line in text.splitlines():
+            m = re.match(r'tpu_device_idle_pct\{cause="([^"]+)"\} (\S+)',
+                         line)
+            if m:
+                got[m.group(1)] = float(m.group(2))
+            m = re.match(r"tpu_device_util_pct (\S+)", line)
+            if m:
+                got["util"] = float(m.group(1))
+        assert set(got) == set(TIMELINE_GAP_CAUSES) | {"util"}
+        assert sum(got.values()) == pytest.approx(100.0, abs=0.05)
+
+
+# ---------------------------------------------------------------------------
+# report: utilization lane, compile table, SLO header
+# ---------------------------------------------------------------------------
+
+class TestReportRendering:
+    def test_util_lines_render_sorted_gap_breakdown(self):
+        from spark_rapids_tpu.tools.report import util_lines
+        rec = {"device_util_pct": 40.0, "device_busy_ms": 12.5,
+               "util_gap_breakdown": {"host_staging": 35.0,
+                                      "inline_compile": 25.0,
+                                      "sem_wait": 0.0}}
+        lines = util_lines(rec)
+        assert lines[0] == "-- device utilization --"
+        assert "40.0%" in lines[1] and "12.5" in lines[1]
+        body = "\n".join(lines)
+        assert body.index("host_staging") < body.index("inline_compile")
+        assert "sem_wait" not in body                  # zero shares hidden
+        assert util_lines({}) == []
+
+    def test_compile_lines_render_slowest_first(self):
+        from spark_rapids_tpu.tools.report import compile_lines
+        rec = {"compiles": [
+            {"cache": "fused_project", "dur_ms": 12.0, "inline": True,
+             "signature": "(i64[4],)"},
+            {"cache": "hash_aggregate", "dur_ms": 90.0, "inline": False,
+             "signature": "(f64[8],)"}]}
+        lines = compile_lines(rec)
+        assert lines[0] == "-- compiles in query window --"
+        body = "\n".join(lines)
+        assert body.index("hash_aggregate") < body.index("fused_project")
+        assert compile_lines({}) == []
+
+    def test_slo_header_groups_terminal_records_by_tenant(self):
+        from spark_rapids_tpu.tools.report import slo_header
+        stories = {f"q{i}": {"service": [
+            {"event": "completed", "tenant": "alpha",
+             "queue_wait_ms": 1.0, "execute_ms": float(10 * (i + 1))},
+            {"event": "admitted", "tenant": "ignored"}]}
+            for i in range(4)}
+        stories["qx"] = {"service": [
+            {"event": "cancelled", "tenant": "beta",
+             "queue_wait_ms": 2.0, "execute_ms": 3.0}]}
+        lines = slo_header(stories)
+        body = "\n".join(lines)
+        assert "per-tenant latency" in lines[0]
+        assert "alpha" in body and "beta" in body
+        assert "ignored" not in body
+        assert slo_header({}) == []
+
+    def test_end_to_end_report_carries_the_new_lanes(self, tmp_path):
+        log = str(tmp_path / "events.jsonl")
+        s = TpuSession(TpuConf({
+            "spark.rapids.tpu.sql.enabled": True,
+            "spark.rapids.tpu.eventLog.path": log}))
+        from spark_rapids_tpu.columnar import dtypes as T
+        from spark_rapids_tpu.udf import pandas_udf
+
+        # record a compile from INSIDE the query window so the report's
+        # compile lane renders even when the process JIT caches are warm
+        def _noting(series):
+            compile_watch.note_compile("ut_report", 5 * MS, "(i64[n],)")
+            return series
+        noting = pandas_udf(_noting, return_type=T.FLOAT64)
+        df = (s.create_dataframe(
+                {"k": [i % 3 for i in range(512)],
+                 "v": [float(i) for i in range(512)]})
+              .group_by("k").agg(F.sum("v").alias("sv"))
+              .select(F.col("k"), noting(F.col("sv")).alias("sv")))
+        df.to_arrow()
+        from spark_rapids_tpu.tools.report import main as report_main
+        out_html = str(tmp_path / "report.html")
+        assert report_main([log, "--html", out_html]) == 0
+        html = open(out_html).read()
+        assert "device utilization" in html
+        assert "inline_compile_ms=" in html
+        assert "device_util_pct=" in html
+        assert "compiles in query window" in html
+        assert os.path.getsize(out_html) > 0

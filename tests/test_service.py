@@ -485,7 +485,12 @@ class TestServiceStress:
             # shape below must go through the spillable-run path
             "spark.rapids.tpu.sql.batchSizeRows": 512,
             "spark.rapids.tpu.sql.reader.batchSizeRows": 512,
-            "spark.rapids.tpu.sql.sort.outOfCore.chunkRows": 600})
+            "spark.rapids.tpu.sql.sort.outOfCore.chunkRows": 600,
+            # tight latency target: the slow tenant + the deadline pair
+            # must show up as attributed SLO breaches (obs/slo.py)
+            "spark.rapids.tpu.obs.slo.targetMs": 50})
+        from spark_rapids_tpu.obs import slo as _slo_mod
+        _slo_mod.reset()   # isolate tenant accounting from other tests
         cat = BufferCatalog.get()
         base_bytes = cat.device_bytes
         base_entries = len(cat._entries)
@@ -582,3 +587,22 @@ class TestServiceStress:
         assert _drain_semaphore()
         assert cat.device_bytes == base_bytes
         assert len(cat._entries) == base_entries
+
+        # per-tenant SLO plane (obs/slo.py): every tenant has ordered
+        # percentiles, every breach is attributed to exactly one cause
+        slo = snap["slo"]
+        assert slo["target_ms"] == 50
+        tenants = slo["tenants"]
+        for c in range(self.N_CLIENTS):
+            t = tenants[f"tenant{c}"]
+            expected = self.PER_CLIENT + (2 if c == 0 else 0)
+            assert t["count"] == expected, (c, t)
+            assert 0 < t["p50_ms"] <= t["p95_ms"] <= t["p99_ms"], t
+            assert set(t["breach_causes"]) <= set(_slo_mod.BREACH_CAUSES)
+            assert sum(t["breach_causes"].values()) == t["breaches"], t
+        # the two deadline_ms=1 queries breached with cause=deadline,
+        # and the slow tenant's >50ms queries landed in the late causes
+        t0_causes = tenants["tenant0"]["breach_causes"]
+        assert t0_causes.get("deadline", 0) == 2, t0_causes
+        assert tenants["tenant0"]["breaches"] >= 2
+        assert tenants["tenant0"]["burn_ms"] > 0
