@@ -135,7 +135,8 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
     # warm query's device-utilization lane + inline-compile ms
     perf = {"timeline": getattr(s, "last_query_timeline", None),
             "inline_compile_ms": getattr(
-                s, "last_query_inline_compile_ms", None)}
+                s, "last_query_inline_compile_ms", None),
+            "netplane": getattr(s, "last_query_netplane", None)}
     return best, flushes, (prof.to_dict() if prof is not None
                            else None), perf
 
@@ -188,6 +189,7 @@ def main():
     service_p99 = measure_service_p99()
     disp = (tpu_prof or {}).get("dispatches", {}).get("all", {})
     tl = tpu_perf.get("timeline") or {}
+    net = tpu_perf.get("netplane") or {}
     print(json.dumps({
         "metric": "sql_pipeline_throughput",
         "value": round(n_rows / tpu_exact_t / 1e6, 3),
@@ -229,6 +231,13 @@ def main():
         "inline_compile_ms": round(
             tpu_perf.get("inline_compile_ms") or 0.0, 3),
         "service_p99_ms": service_p99,
+        # shuffle transport plane (obs/netplane.py): the warm query's
+        # host-drop tax (active serialize+wire+deserialize ms — the
+        # baseline ROADMAP item 2's ICI shuffle must beat), wire
+        # throughput and the worst per-shuffle edge skew
+        "host_drop_tax_ms": net.get("host_drop_tax_ms"),
+        "shuffle_wire_MBps": net.get("wire_MBps"),
+        "shuffle_edge_skew": net.get("edge_skew"),
     }))
 
 

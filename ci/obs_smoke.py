@@ -4,7 +4,10 @@ nested engine/exec spans, (2) the Prometheus snapshot covers the arena
 and semaphore series, (3) the report tool renders the per-query story,
 (4) a forced query failure produces a diagnostic bundle — flight tail,
 thread stacks, arena map — that tools/diagnose.py renders, and the
-failure event-log record links it.
+failure event-log record links it, (5) a multi-partition shuffle
+populates the transport plane (obs/netplane.py): nonzero edge matrix,
+host-drop phases summing to the exchange wall, and a real TCP fetch
+whose client/server spans join on span_id in the same trace.
 """
 import json
 import os
@@ -51,6 +54,39 @@ def main():
             svc.submit(
                 "SELECT k, SUM(v), COUNT(v) FROM obs_smoke GROUP BY k"
             ).result(120)
+        # a multi-partition aggregate: the group-by exchange gives the
+        # transport plane real map->reduce traffic to account for
+        shuf_df = s.range(0, 4096, num_partitions=4) \
+            .select((F.col("id") % 13).alias("k"),
+                    F.col("id").alias("v")) \
+            .group_by("k").agg(F.sum("v").alias("sv"))
+        h_shuf = svc.submit(shuf_df, tenant="shuffle")
+        h_shuf.result(120)
+        # cross-boundary correlation: one real TCP fetch inside the
+        # traced process, so the client's shuffle_fetch span and the
+        # server's serve spans land in the same Perfetto trace
+        import numpy as np
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.shuffle import (MapOutputTracker,
+                                              ShuffleExecutorContext)
+        from spark_rapids_tpu.shuffle.tcp import TcpTransport
+        ta, tb = TcpTransport("exec-a"), TcpTransport("exec-b")
+        ta.add_peer("exec-b", tb.address)
+        tb.add_peer("exec-a", ta.address)
+        trk = MapOutputTracker()
+        ex_a = ShuffleExecutorContext("exec-a", ta, trk,
+                                      bounce_buffer_size=4096,
+                                      num_bounce_buffers=2)
+        ex_b = ShuffleExecutorContext("exec-b", tb, trk,
+                                      bounce_buffer_size=4096,
+                                      num_bounce_buffers=2)
+        ex_a.write_map_output(97, 0, {0: [ColumnarBatch.from_pydict({
+            "k": np.arange(64, dtype=np.int64),
+            "v": np.arange(64, dtype=np.float64)})]})
+        fetched = list(ex_b.read_partition(97, 0, timeout_s=30.0))
+        assert sum(len(b.to_pydict()["k"]) for b in fetched) == 64
+        ta.close()
+        tb.close()
         # one forced failure: every retry attempt OOMs
         h_fail = svc.submit(failing, tenant="doomed")
         try:
@@ -100,8 +136,17 @@ def main():
     assert "query" in names and "attempt" in names, names
     qids = {e["args"].get("query_id") for e in events
             if e["name"] == "attempt"}
-    assert len(qids) == 4, qids       # 3 healthy + the forced failure
-    print(f"trace OK: {len(events)} spans, cats={sorted(cats)}")
+    # 3 healthy + the shuffle aggregate + the forced failure
+    assert len(qids) == 5, qids
+    # the TCP fetch's client/server halves join on span_id
+    fetch_ids = {e["args"].get("span_id") for e in events
+                 if e["name"] == "shuffle_fetch"}
+    serve_ids = {e["args"].get("span_id") for e in events
+                 if e["name"].startswith("shuffle_serve")}
+    assert fetch_ids and fetch_ids & serve_ids, (fetch_ids, serve_ids)
+    assert any(e["name"] == "exchange_map_side" for e in events), names
+    print(f"trace OK: {len(events)} spans, cats={sorted(cats)}, "
+          f"joined fetch spans={len(fetch_ids & serve_ids)}")
 
     # 2. Prometheus exposition covers arena + semaphore + queue series
     for series in ("tpu_arena_device_bytes", "tpu_arena_device_peak_bytes",
@@ -113,16 +158,56 @@ def main():
                    "tpu_device_util_pct",
                    "tpu_device_idle_pct",
                    "tpu_slo_latency_seconds_bucket",
+                   "tpu_shuffle_host_drop_seconds_total",
+                   "tpu_shuffle_fetch_seconds_bucket",
+                   "tpu_shuffle_conn_events_total",
+                   "tpu_shuffle_edges_tracked",
+                   "tpu_shuffle_pending_fetches",
                    'tpu_service_queries_total{event="completed"}'):
         assert series in metrics, f"missing series {series}"
     print("prometheus OK:", len(metrics.splitlines()), "lines")
 
+    # 2b. shuffle transport plane (obs/netplane.py): the edge matrix
+    #     saw the exchange, the four-phase host-drop split sums to the
+    #     exchange wall, and the TCP fetch left pool + peer evidence
+    net = snap["shuffle"]
+    assert net["enabled"], net
+    assert net["edges_tracked"] > 0 and net["top_edges"], net
+    ph = net["host_drop"]["phases_ms"]
+    wall = net["host_drop"]["exchange_wall_ms"]
+    assert wall > 0, net["host_drop"]
+    assert abs(sum(ph.values()) - wall) <= max(wall * 0.01, 0.02), \
+        (ph, wall)
+    assert net["wire_bytes"] > 0 and ph["wire"] > 0, net
+    assert net["connections"]["dial"] >= 1, net["connections"]
+    assert net["fetch_peers"].get("exec-a", {}).get("count", 0) >= 1, \
+        net["fetch_peers"]
+    assert net["pending_fetches"] == 0, net
+    # the shuffle query's event-log records carry the same roll-up:
+    # the engine record the full netplane dict, the service's
+    # completed-outcome record the host_drop_tax_ms scalar
+    engine = [r for r in _rel(log_path)
+              if r.get("query_id") == h_shuf.query_id]
+    assert engine, h_shuf.query_id
+    sn = engine[0]["shuffle_netplane"]
+    assert sn["edges"] > 0 and sn["blocks"] > 0, sn
+    assert engine[0]["host_drop_tax_ms"] == sn["host_drop_tax_ms"] > 0
+    assert abs(sum(sn["phases_ms"].values()) - sn["exchange_wall_ms"]) \
+        <= max(sn["exchange_wall_ms"] * 0.01, 0.02), sn
+    shuf_rec = [r for r in completed if r["query_id"] == h_shuf.query_id]
+    assert shuf_rec and shuf_rec[0]["host_drop_tax_ms"] > 0, shuf_rec
+    print(f"shuffle plane OK: edges={net['edges_tracked']}, "
+          f"host_drop_tax_ms={net['host_drop']['host_drop_tax_ms']}, "
+          f"wire_bytes={net['wire_bytes']}")
+
     # 3. report tool renders the joined story
     from spark_rapids_tpu.tools.report import main as report_main
-    assert report_main([log_path, "--trace", trace_path,
+    assert report_main([log_path, "--trace", trace_path, "--shuffle",
                         "--html", os.path.join(td, "report.html")]) == 0
     html = open(os.path.join(td, "report.html")).read()
     assert "plan + time shares" in html
+    assert "shuffle transport (netplane)" in html
+    assert "top edges (map" in html      # "->" is HTML-escaped
     print("report OK")
 
     # 4. the forced failure produced one diagnostic bundle with the

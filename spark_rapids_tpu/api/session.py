@@ -246,11 +246,13 @@ class TpuSession:
         # flush budget is benchmarked)
         from ..columnar import pending
         from ..obs import compile_watch as _cwatch
+        from ..obs import netplane as _netplane
         from ..obs import profile as _profile
         from ..obs import stats as _stats
         from ..obs import timeline as _timeline
         flushes0 = pending.FLUSH_COUNT
         disp_marker = _profile.begin_query()
+        np_marker = _netplane.begin_query()
         # performance-plane windows: compile ns + busy intervals are
         # process-wide counters deltaed around this execution (the
         # FLUSH_COUNT discipline — exact when queries run serially)
@@ -326,13 +328,28 @@ class TpuSession:
         # device-utilization lane for this query's window
         tl = _timeline.query_summary(tl_marker)
         self.last_query_timeline = tl
+        # shuffle host-drop roll-up for this query's window (same
+        # process-wide-counter-delta discipline as FLUSH_COUNT); the
+        # edge heat rows + per-peer fetch aggregate ride the record so
+        # tools/report.py --shuffle renders offline
+        net = _netplane.query_summary(np_marker)
+        net["top_edges"] = _netplane.query_edges(np_marker, limit=8)
+        peers = _netplane.fetch_peer_stats()
+        if peers:
+            net["fetch_peers"] = peers
+        self.last_query_netplane = net
+        # the service harvests this into the completed-outcome record
+        # (service/metrics.py), like sem_wait_ms above
+        observe("host_drop_tax_ms", net["host_drop_tax_ms"])
         extra = {"sem_wait_ms": round(sem_wait_ms, 3),
                  "spill_bytes": int(spill_bytes),
                  "flushes": int(flushes),
                  "inline_compile_ms": round(inline_compile_ms, 3),
                  "device_busy_ms": tl["busy_ms"],
                  "device_util_pct": tl["util_pct"],
-                 "util_gap_breakdown": tl["gaps"]}
+                 "util_gap_breakdown": tl["gaps"],
+                 "host_drop_tax_ms": net["host_drop_tax_ms"],
+                 "shuffle_netplane": net}
         compiles = _cwatch.records_since(cw_marker)
         if compiles:
             extra["compiles"] = [

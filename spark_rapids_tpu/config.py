@@ -534,6 +534,28 @@ OBS_SLO_TARGET_MS = conf_float(
     "and deadline-cancelled queries always breach.  The burn counter "
     "accumulates overshoot ms per tenant.  0 disables breach/burn "
     "accounting (latency histograms still record)")
+OBS_NET_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.net.enabled", True,
+    "Shuffle-transport observability plane (obs/netplane.py): per-edge "
+    "(shuffle, map partition -> reduce partition) transfer matrix, "
+    "host-drop tax accounting splitting every exchange into serialize/"
+    "dwell/wire/deserialize phases (rolled up per query as "
+    "host_drop_tax_ms and fed to the utilization timeline as the "
+    "shuffle_host gap cause), connection-pool and bounce-buffer state, "
+    "and cross-boundary (query_id, span_id) trace correlation over "
+    "the shuffle wire.  Host-side timestamps only: zero extra device "
+    "flushes by construction")
+OBS_NET_MAX_EDGES = conf_int(
+    "spark.rapids.tpu.obs.net.maxEdges", 1 << 16,
+    "Bound on distinct (shuffle, map, reduce) edges held in the "
+    "transfer matrix; past it new edges are dropped and counted in "
+    "tpu_shuffle_edges_evicted_total (fixed memory — the "
+    "flight-recorder discipline)")
+OBS_NET_MAX_INTERVALS = conf_int(
+    "spark.rapids.tpu.obs.net.maxIntervals", 1 << 16,
+    "Bound on buffered host-drop work windows (the shuffle_host "
+    "timeline evidence) and per-block edge-log entries; past it new "
+    "records are dropped, keeping netplane memory fixed")
 SUPERSTAGE = conf_bool(
     "spark.rapids.tpu.sql.superstage", True,
     "Superstage compiler (compile/): a planner post-pass after the "

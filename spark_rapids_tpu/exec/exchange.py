@@ -11,9 +11,13 @@ partitions then stream from the catalog through the transport SPI.
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional
 
 from ..columnar.batch import ColumnarBatch, concat_batches
+from ..obs import flight as _flight
+from ..obs import netplane as _netplane
+from ..obs import trace as _trace
 from ..shuffle.manager import ShuffleManager
 from ..shuffle.partitioners import Partitioner, RangePartitioner
 from .base import PhysicalPlan, PARTITION_TIME, NUM_OUTPUT_ROWS, timed
@@ -75,6 +79,11 @@ class TpuShuffleExchange(TpuExec):
                 f"({self.partitioner.num_partitions})]")
 
     def _materialize_map_side(self):
+        t_map0 = time.perf_counter_ns()
+        # netplane snapshot: attributes this exchange's serialize volume
+        # in the map-side trace span (best-effort under concurrent
+        # exchanges — the global matrix stays exact either way)
+        np_marker = _netplane.begin_query()
         from ..columnar import pending
         from ..columnar.batch import resolve_speculative
         mgr = ShuffleManager.get() if self._dist_ctx is None else \
@@ -184,6 +193,14 @@ class TpuShuffleExchange(TpuExec):
         finalize_staged()
         if stats_on:
             obs_stats.finish_exchange(self, conf)
+        _flight.record(_flight.EV_NET, "map_side", n_red)
+        if _trace._ENABLED:
+            net = _netplane.query_summary(np_marker)
+            _trace.emit("exchange_map_side", "shuffle", t_map0,
+                        time.perf_counter_ns() - t_map0,
+                        shuffle_id=self._shuffle_id, partitions=n_red,
+                        staged_bytes=net["staged_bytes"],
+                        serialize_ms=net["phases_ms"]["serialize"])
 
     def ensure_materialized(self):
         """Run the map side once (the AQE stage-materialization barrier).
@@ -242,6 +259,7 @@ class TpuShuffleExchange(TpuExec):
         """Stream one reduce partition batch-by-batch (batches unspill
         one at a time — the memory-bounded path)."""
         self.ensure_materialized()
+        _flight.record(_flight.EV_NET, "reduce_stream", reduce_id)
         if self._dist_ctx is not None:
             # transport-aware read: local blocks from this executor's
             # catalog, remote ones fetched over the wire

@@ -41,12 +41,17 @@ Layers, mirroring the reference plugin's observability story
   seven engine JIT caches.
 - ``obs.slo`` — per-tenant SLO latency accounting: p50/p95/p99,
   breach/burn counters with single-cause attribution.
+- ``obs.netplane`` — shuffle-transport plane: bounded per-edge
+  transfer matrix, host-drop tax accounting (serialize/dwell/wire/
+  deserialize phase split per exchange, ``shuffle_host`` timeline gap
+  cause), connection-pool/bounce-buffer state and cross-boundary
+  (query_id, span_id) trace correlation over the shuffle wire.
 
 The per-query report generator that joins the event log with these
 streams lives in ``tools/report.py`` (the SQL-UI stand-in).
 """
 from . import (trace, registry, prom, flight, timeline,  # noqa: F401
-               compile_watch, slo, profile)              # noqa: F401
+               compile_watch, slo, profile, netplane)    # noqa: F401
 from .registry import get_registry  # noqa: F401
 from .trace import span, traced     # noqa: F401
 

@@ -128,6 +128,15 @@ def shuffle_state() -> Dict[str, Any]:
             out["endpoints"] = len(getattr(reg, "_endpoints", {}))
     except Exception as exc:
         out["endpoints_error"] = repr(exc)
+    try:
+        # transport observability plane: host-drop phase totals, pool
+        # state, pending fetches and the hottest matrix edges — the
+        # evidence for a stalled/slow fetch incident
+        from . import netplane as _netplane
+        out["netplane"] = _netplane.stats_section()
+        out["netplane"]["top_edges"] = _netplane.edge_matrix(limit=10)
+    except Exception as exc:
+        out["netplane_error"] = repr(exc)
     return out
 
 

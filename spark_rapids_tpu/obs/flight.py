@@ -60,6 +60,9 @@ EV_COMPILE = "compile"        # superstage compiler (name=event, a=size)
 EV_STATS = "stats"            # stats plane (name=site/kind; a,b = plain
 #                               ints: flush item count + duration ms, or
 #                               skew permille + distinct estimate)
+EV_NET = "net"                # shuffle-transport plane (name=phase
+#                               constant from obs/netplane.py; a=bytes,
+#                               b=duration ms)
 
 #: module fast-path flag — read directly by ``record()``; the recorder
 #: is ON by default (that is the point of a flight recorder).

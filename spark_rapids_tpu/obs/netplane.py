@@ -1,0 +1,526 @@
+"""Shuffle-transport observability plane — the fifth plane, covering
+the one layer the trace/flight/stats/perf planes cannot see into: what
+happens to a batch between the map-side split and the reduce-side read.
+
+Our shuffle drops every exchange to host (SpillableBatch staging, an
+optional TCP hop through bounce buffers, then a host->device upload on
+read); the reference keeps shuffle data on-device over UCX.  ROADMAP
+item 2 (HBM-resident ICI shuffle) needs a measured baseline before we
+lower exchanges to ``all_to_all`` — and the same instruments to prove
+the win afterwards.  Three pillars:
+
+- **per-edge transfer matrix** — bounded (shuffle_id, map partition ->
+  reduce partition) accumulation of rows/bytes/batches, fed by the
+  shuffle catalog's put/append/get paths; per-peer fetch-latency
+  histograms, connection-pool dial/reuse/reset counters (shuffle/tcp),
+  bounce-buffer occupancy and dwell gauges (shuffle/bounce).
+- **host-drop tax accounting** — every staged block's life splits into
+  four phases: ``serialize`` (device->host pull into the spillable
+  batch / TableMeta build), ``dwell`` (host residency between the
+  serialize end and the read), ``wire`` (TCP transfer incl. the bounce
+  hop), ``deserialize`` (host->device upload on read).  serialize,
+  wire and deserialize are measured; dwell is the block-lifecycle
+  remainder, so the four phases sum to the exchange wall time by
+  construction.  ``host_drop_tax_ms`` (the per-query roll-up bench.py
+  and the event log carry) is the ACTIVE portion — serialize + wire +
+  deserialize — because dwell overlaps useful compute.  The active
+  windows also feed the PR 8 timeline as the ``shuffle_host`` gap
+  cause, so ``util_gap_breakdown`` distinguishes shuffle host-staging
+  from generic pipeline drains.
+- **cross-boundary correlation** — (query_id, span_id) ride the
+  shuffle metadata/transfer requests (shuffle/transport.py dataclasses,
+  optional trailing fields on the TCP wire) so server serve spans and
+  client fetch spans join into one Perfetto trace; EV_NET flight
+  events mark the same boundaries allocation-free.
+
+Hot-path discipline (this file is on the SYNC001/OBS002 lint scope):
+no numpy, no device pulls, no formatted flight-record args; the note_*
+paths run once per staged block / wire transaction — hundreds per
+exchange at most — and never force a flush (the zero-extra-flush
+acceptance criterion is an exact FLUSH_COUNT delta, tested).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+from . import flight
+from .registry import (SHUFFLE_BOUNCE_DWELL_SECONDS, SHUFFLE_CONN_EVENTS,
+                       SHUFFLE_EDGES_EVICTED, SHUFFLE_FETCH_SECONDS,
+                       SHUFFLE_HOST_DROP_SECONDS)
+
+# host-drop phase constants (interned: flight records pass them verbatim)
+PH_SERIALIZE = "serialize"
+PH_DWELL = "dwell"
+PH_WIRE = "wire"
+PH_DESERIALIZE = "deserialize"
+PHASES = (PH_SERIALIZE, PH_DWELL, PH_WIRE, PH_DESERIALIZE)
+
+_ENABLED = True
+_MAX_EDGES = 1 << 16      #: edge-matrix bound (conf obs.net.maxEdges)
+_SEG_CAP = 1 << 16        #: active-window / edge-log bound
+
+_LOCK = threading.Lock()
+
+#: the transfer matrix: (shuffle_id, map_id, reduce_id) -> [rows,
+#: bytes, batches].  Bounded: past _MAX_EDGES new edges are counted as
+#: evicted instead of growing without limit.
+_EDGES: Dict[Tuple[int, int, int], List[int]] = {}
+_EVICTED = 0
+
+#: append-only per-block log for per-query summaries (skew, heat
+#: table); GIL-atomic appends like profile._DISPATCH, readers slice.
+_EDGE_LOG: List[Tuple[int, int, int, int, int]] = []
+
+#: active host-drop work windows (start_ns, end_ns) — serialize, wire
+#: and deserialize only (dwell is passive) — the timeline's
+#: ``shuffle_host`` gap evidence.  Append-only, bounded.
+_ACTIVE: List[Tuple[int, int]] = []
+_ACTIVE_DROPPED = 0
+
+#: measured phase totals (ns / bytes) and the block-lifecycle wall
+_PHASE_NS = {PH_SERIALIZE: 0, PH_WIRE: 0, PH_DESERIALIZE: 0}
+_WALL_NS = 0
+_STAGED_BYTES = 0
+_WIRE_BYTES = 0
+
+#: serialize-start stamp per staged block: the dwell clock
+_BORN: Dict[Tuple[int, int, int], int] = {}
+
+_PENDING_FETCHES = 0
+_CONN_EVENTS = {"dial": 0, "reuse": 0, "reset": 0}
+
+#: codec traffic through the host boundary (shuffle/compression.py):
+#: raw vs compressed bytes, so per-query records and the report can
+#: print the effective compression ratio next to the wire bytes it
+#: explains
+_COMP_RAW = 0
+_COMP_BYTES = 0
+_COMP_CODECS: set = set()
+
+#: per-peer fetch aggregate: peer -> [count, total_ns, bytes, max_ns]
+#: (the offline-report view of what tpu_shuffle_fetch_seconds observes)
+_FETCH_PEERS: Dict[str, List[int]] = {}
+
+#: span-id sequence for cross-boundary correlation (itertools.count is
+#: GIL-atomic in CPython)
+_SPAN_SEQ = itertools.count(1)
+
+#: weakrefs to live BounceBufferManagers / heartbeat managers so the
+#: collect-time gauges and stats() read state those layers already hold
+_BOUNCE_MGRS: List = []
+_HEARTBEAT_MGRS: List = []
+
+
+def next_span_id() -> int:
+    """Fresh correlation id for one fetch; rides the metadata/transfer
+    requests so the server's serve span joins the client's fetch span."""
+    return next(_SPAN_SEQ)
+
+
+def _note_active(start_ns: int, end_ns: int):
+    global _ACTIVE_DROPPED
+    if end_ns <= start_ns:
+        return
+    if len(_ACTIVE) < _SEG_CAP:
+        _ACTIVE.append((start_ns, end_ns))
+    else:
+        _ACTIVE_DROPPED += 1
+
+
+def note_serialize(shuffle_id: int, map_id: int, reduce_id: int,
+                   rows: int, nbytes: int, dur_ns: int) -> None:
+    """One block landed on host: device->host serialize finished now,
+    having taken ``dur_ns``.  Records the matrix edge, starts the
+    block's dwell clock, and opens the serialize phase accounting."""
+    global _EVICTED, _STAGED_BYTES
+    if not _ENABLED:
+        return
+    now = time.perf_counter_ns()
+    key = (shuffle_id, map_id, reduce_id)
+    with _LOCK:
+        cell = _EDGES.get(key)
+        if cell is None:
+            if len(_EDGES) >= _MAX_EDGES:
+                _EVICTED += 1
+                SHUFFLE_EDGES_EVICTED.inc()
+            else:
+                cell = _EDGES[key] = [0, 0, 0]
+        if cell is not None:
+            cell[0] += rows
+            cell[1] += nbytes
+            cell[2] += 1
+        _PHASE_NS[PH_SERIALIZE] += dur_ns
+        _STAGED_BYTES += nbytes
+        if key not in _BORN:
+            _BORN[key] = now - dur_ns
+    if len(_EDGE_LOG) < _SEG_CAP:
+        _EDGE_LOG.append((shuffle_id, map_id, reduce_id, rows, nbytes))
+    _note_active(now - dur_ns, now)
+    SHUFFLE_HOST_DROP_SECONDS.labels(phase=PH_SERIALIZE).inc(dur_ns / 1e9)
+    flight.record(flight.EV_NET, PH_SERIALIZE, nbytes, dur_ns // 1_000_000)
+
+
+def note_wire(nbytes: int, dur_ns: int) -> None:
+    """One wire transaction (TCP send incl. the bounce-buffer hop)
+    moved ``nbytes`` in ``dur_ns``."""
+    global _WIRE_BYTES
+    if not _ENABLED:
+        return
+    now = time.perf_counter_ns()
+    with _LOCK:
+        _PHASE_NS[PH_WIRE] += dur_ns
+        _WIRE_BYTES += nbytes
+    _note_active(now - dur_ns, now)
+    SHUFFLE_HOST_DROP_SECONDS.labels(phase=PH_WIRE).inc(dur_ns / 1e9)
+    flight.record(flight.EV_NET, PH_WIRE, nbytes, dur_ns // 1_000_000)
+
+
+def note_deserialize(shuffle_id: int, map_id: int, reduce_id: int,
+                     nbytes: int, dur_ns: int) -> None:
+    """One staged block was read back (host->device upload took
+    ``dur_ns``); closes the block's lifecycle, so the dwell phase —
+    wall minus the measured phases — is final for this block."""
+    global _WALL_NS
+    if not _ENABLED:
+        return
+    now = time.perf_counter_ns()
+    key = (shuffle_id, map_id, reduce_id)
+    with _LOCK:
+        _PHASE_NS[PH_DESERIALIZE] += dur_ns
+        born = _BORN.pop(key, None)
+        # a re-read (retry) block's clock was already consumed: cover
+        # at least the upload itself so phases can't exceed the wall
+        _WALL_NS += (now - born) if born is not None else dur_ns
+    _note_active(now - dur_ns, now)
+    SHUFFLE_HOST_DROP_SECONDS.labels(phase=PH_DESERIALIZE).inc(dur_ns / 1e9)
+    flight.record(flight.EV_NET, PH_DESERIALIZE, nbytes,
+                  dur_ns // 1_000_000)
+
+
+def note_fetch(peer: str, dur_ns: int, nbytes: int) -> None:
+    """One remote fetch (metadata request -> last table landed)
+    completed against ``peer`` (cold path: once per peer per read)."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        cell = _FETCH_PEERS.setdefault(peer, [0, 0, 0, 0])
+        cell[0] += 1
+        cell[1] += dur_ns
+        cell[2] += nbytes
+        cell[3] = max(cell[3], dur_ns)
+    SHUFFLE_FETCH_SECONDS.labels(peer=peer).observe(dur_ns / 1e9)
+    flight.record(flight.EV_NET, "fetch", nbytes, dur_ns // 1_000_000)
+
+
+def note_conn(event: str) -> None:
+    """Connection-pool transition from shuffle/tcp.py: ``dial`` (new
+    socket), ``reuse`` (pooled socket served a request batch), or
+    ``reset`` (connection torn down, pending transactions errored)."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _CONN_EVENTS[event] = _CONN_EVENTS.get(event, 0) + 1
+    SHUFFLE_CONN_EVENTS.labels(event=event).inc()
+
+
+def note_compression(codec: str, raw_bytes: int,
+                     compressed_bytes: int) -> None:
+    """One codec transaction (compress or decompress) moved
+    ``raw_bytes`` of table data into/out of ``compressed_bytes`` on the
+    wire/spill side; both directions accumulate, so the ratio stays
+    compressed/raw either way."""
+    global _COMP_RAW, _COMP_BYTES
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _COMP_RAW += raw_bytes
+        _COMP_BYTES += compressed_bytes
+        _COMP_CODECS.add(codec)
+
+
+def note_bounce_dwell(dur_ns: int) -> None:
+    """One bounce buffer went acquire->release in ``dur_ns``."""
+    if not _ENABLED:
+        return
+    SHUFFLE_BOUNCE_DWELL_SECONDS.observe(dur_ns / 1e9)
+
+
+def fetch_begun() -> None:
+    global _PENDING_FETCHES
+    with _LOCK:
+        _PENDING_FETCHES += 1
+
+
+def fetch_done() -> None:
+    global _PENDING_FETCHES
+    with _LOCK:
+        _PENDING_FETCHES -= 1
+
+
+def fetch_peer_stats() -> Dict[str, Dict]:
+    """Per-peer fetch-latency aggregate (process-lifetime): the report's
+    offline stand-in for the tpu_shuffle_fetch_seconds histogram."""
+    with _LOCK:
+        items = [(p, list(c)) for p, c in _FETCH_PEERS.items()]
+    return {
+        p: {"count": c[0],
+            "avg_ms": round(c[1] / c[0] / 1e6, 3) if c[0] else 0.0,
+            "max_ms": round(c[3] / 1e6, 3),
+            "bytes": c[2]}
+        for p, c in items
+    }
+
+
+def pending_fetches() -> int:
+    """Collect-time callback for the tpu_shuffle_pending_fetches gauge
+    — the instrument that surfaced the client.close() drop bug."""
+    return _PENDING_FETCHES
+
+
+def edges_tracked() -> int:
+    """Collect-time callback for the tpu_shuffle_edges_tracked gauge."""
+    return len(_EDGES)
+
+
+def register_bounce(mgr) -> None:
+    """Track a live BounceBufferManager (weakly) for the occupancy
+    gauges."""
+    _BOUNCE_MGRS.append(weakref.ref(mgr))
+
+
+def register_heartbeat(mgr) -> None:
+    """Track a live RapidsShuffleHeartbeatManager (weakly) for the
+    per-peer last-seen ages in stats()."""
+    _HEARTBEAT_MGRS.append(weakref.ref(mgr))
+
+
+def _live(refs: List) -> List:
+    out = []
+    dead = False
+    for r in refs:
+        obj = r()
+        if obj is None:
+            dead = True
+        else:
+            out.append(obj)
+    if dead:
+        refs[:] = [r for r in refs if r() is not None]
+    return out
+
+
+def bounce_free() -> int:
+    return sum(m.num_free for m in _live(_BOUNCE_MGRS))
+
+
+def bounce_total() -> int:
+    return sum(m.num_total for m in _live(_BOUNCE_MGRS))
+
+
+# ---------------------------------------------------------------------------
+# timeline evidence (cold path, called from obs/timeline._summarize)
+# ---------------------------------------------------------------------------
+
+def active_segments(t0: int, t1: int) -> List[Tuple[int, int]]:
+    """Host-drop work windows overlapping [t0, t1] — the timeline's
+    ``shuffle_host`` gap-cause evidence."""
+    if not _ENABLED:
+        return []
+    return [(s, e) for s, e in _ACTIVE[:] if e > t0 and s < t1]
+
+
+# ---------------------------------------------------------------------------
+# per-query roll-up (cold paths)
+# ---------------------------------------------------------------------------
+
+def begin_query() -> Dict[str, int]:
+    """Value/length snapshot marker for a per-query summary."""
+    with _LOCK:
+        return {
+            "ser_ns": _PHASE_NS[PH_SERIALIZE],
+            "wire_ns": _PHASE_NS[PH_WIRE],
+            "deser_ns": _PHASE_NS[PH_DESERIALIZE],
+            "wall_ns": _WALL_NS,
+            "staged_bytes": _STAGED_BYTES,
+            "wire_bytes": _WIRE_BYTES,
+            "comp_raw": _COMP_RAW,
+            "comp_bytes": _COMP_BYTES,
+            "edge_log_len": len(_EDGE_LOG),
+        }
+
+
+def _skew(entries: List[Tuple[int, int, int, int, int]]) -> float:
+    """max/mean bytes-per-reduce-partition ratio, worst shuffle wins
+    (1.0 = perfectly balanced; 0.0 = no shuffle traffic)."""
+    per: Dict[Tuple[int, int], int] = {}
+    for sid, _mid, rid, _rows, nbytes in entries:
+        k = (sid, rid)
+        per[k] = per.get(k, 0) + nbytes
+    by_shuffle: Dict[int, List[int]] = {}
+    for (sid, _rid), b in per.items():
+        by_shuffle.setdefault(sid, []).append(b)
+    worst = 0.0
+    for vals in by_shuffle.values():
+        mean = sum(vals) / len(vals)
+        if mean > 0:
+            worst = max(worst, max(vals) / mean)
+    return round(worst, 3)
+
+
+def query_summary(marker: Optional[Dict[str, int]] = None) -> Dict:
+    """Host-drop roll-up since a ``begin_query()`` marker: the four-
+    phase split (summing to ``exchange_wall_ms`` by construction), the
+    active-work tax, wire throughput and the per-edge skew verdict."""
+    m = marker or {}
+    with _LOCK:
+        ser = _PHASE_NS[PH_SERIALIZE] - m.get("ser_ns", 0)
+        wire = _PHASE_NS[PH_WIRE] - m.get("wire_ns", 0)
+        deser = _PHASE_NS[PH_DESERIALIZE] - m.get("deser_ns", 0)
+        wall = _WALL_NS - m.get("wall_ns", 0)
+        staged = _STAGED_BYTES - m.get("staged_bytes", 0)
+        wire_b = _WIRE_BYTES - m.get("wire_bytes", 0)
+        comp_raw = _COMP_RAW - m.get("comp_raw", 0)
+        comp_b = _COMP_BYTES - m.get("comp_bytes", 0)
+        codecs = sorted(_COMP_CODECS)
+        lo = m.get("edge_log_len", 0)
+    entries = _EDGE_LOG[lo:]
+    dwell = max(wall - ser - wire - deser, 0)
+    mbps = (wire_b / 1e6) / (wire / 1e9) if wire > 0 else 0.0
+    return {
+        "phases_ms": {
+            PH_SERIALIZE: round(ser / 1e6, 3),
+            PH_DWELL: round(dwell / 1e6, 3),
+            PH_WIRE: round(wire / 1e6, 3),
+            PH_DESERIALIZE: round(deser / 1e6, 3),
+        },
+        "exchange_wall_ms": round(max(wall, ser + wire + deser) / 1e6, 3),
+        "host_drop_tax_ms": round((ser + wire + deser) / 1e6, 3),
+        "staged_bytes": staged,
+        "wire_bytes": wire_b,
+        "wire_MBps": round(mbps, 3),
+        "compression": {
+            "raw_bytes": comp_raw,
+            "compressed_bytes": comp_b,
+            # effective ratio raw/compressed (e.g. 3.2 = wire carries
+            # ~31% of the raw bytes); 1.0 when no codec traffic
+            "ratio": round(comp_raw / comp_b, 3) if comp_b else 1.0,
+            "codecs": codecs,
+        },
+        "edge_skew": _skew(entries),
+        "edges": len({(s, mp, r) for s, mp, r, _w, _b in entries}),
+        "blocks": len(entries),
+    }
+
+
+def query_edges(marker: Optional[Dict[str, int]] = None,
+                limit: int = 0) -> List[Dict]:
+    """Per-edge rows for the report's heat table, biggest bytes first,
+    aggregated over the edge log since ``marker``."""
+    lo = (marker or {}).get("edge_log_len", 0)
+    agg: Dict[Tuple[int, int, int], List[int]] = {}
+    for sid, mid, rid, rows, nbytes in _EDGE_LOG[lo:]:
+        cell = agg.setdefault((sid, mid, rid), [0, 0, 0])
+        cell[0] += rows
+        cell[1] += nbytes
+        cell[2] += 1
+    out = [{"shuffle_id": k[0], "map_id": k[1], "reduce_id": k[2],
+            "rows": v[0], "bytes": v[1], "batches": v[2]}
+           for k, v in agg.items()]
+    out.sort(key=lambda e: (-e["bytes"], e["shuffle_id"], e["map_id"],
+                            e["reduce_id"]))
+    return out[:limit] if limit else out
+
+
+def edge_matrix(limit: int = 0) -> List[Dict]:
+    """Process-wide matrix view (diag bundles / stats), biggest first."""
+    with _LOCK:
+        items = [(k, list(v)) for k, v in _EDGES.items()]
+    out = [{"shuffle_id": k[0], "map_id": k[1], "reduce_id": k[2],
+            "rows": v[0], "bytes": v[1], "batches": v[2]}
+           for k, v in items]
+    out.sort(key=lambda e: (-e["bytes"], e["shuffle_id"], e["map_id"],
+                            e["reduce_id"]))
+    return out[:limit] if limit else out
+
+
+def stats_section() -> Dict:
+    """The ``shuffle`` block of ``Service.stats()``."""
+    with _LOCK:
+        conn = dict(_CONN_EVENTS)
+        edges_tracked = len(_EDGES)
+        evicted = _EVICTED
+        pending = _PENDING_FETCHES
+    summary = query_summary(None)
+    peers: Dict[str, Dict] = {}
+    for mgr in _live(_HEARTBEAT_MGRS):
+        try:
+            peers.update(mgr.peer_stats())
+        except Exception:
+            pass
+    return {
+        "enabled": bool(_ENABLED),
+        "edges_tracked": edges_tracked,
+        "edges_evicted": evicted,
+        "host_drop": {"phases_ms": summary["phases_ms"],
+                      "exchange_wall_ms": summary["exchange_wall_ms"],
+                      "host_drop_tax_ms": summary["host_drop_tax_ms"]},
+        "staged_bytes": summary["staged_bytes"],
+        "wire_bytes": summary["wire_bytes"],
+        "wire_MBps": summary["wire_MBps"],
+        "compression": summary["compression"],
+        "edge_skew": summary["edge_skew"],
+        "connections": conn,
+        "pending_fetches": pending,
+        "bounce": {"free": bounce_free(), "total": bounce_total()},
+        "peers": peers,
+        "fetch_peers": fetch_peer_stats(),
+        "top_edges": edge_matrix(limit=5),
+    }
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.net.*`` conf group."""
+    global _ENABLED, _MAX_EDGES, _SEG_CAP
+    from ..config import (OBS_NET_ENABLED, OBS_NET_MAX_EDGES,
+                          OBS_NET_MAX_INTERVALS)
+    _ENABLED = bool(conf.get(OBS_NET_ENABLED))
+    edges = int(conf.get(OBS_NET_MAX_EDGES))
+    if edges > 0:
+        _MAX_EDGES = edges
+    cap = int(conf.get(OBS_NET_MAX_INTERVALS))
+    if cap > 0:
+        _SEG_CAP = cap
+
+
+def reset() -> None:
+    """Test hook: drop the matrix, logs, phase totals and registrations."""
+    global _EVICTED, _WALL_NS, _STAGED_BYTES, _WIRE_BYTES
+    global _PENDING_FETCHES, _ACTIVE_DROPPED, _COMP_RAW, _COMP_BYTES
+    with _LOCK:
+        _EDGES.clear()
+        _BORN.clear()
+        _EVICTED = 0
+        for ph in _PHASE_NS:
+            _PHASE_NS[ph] = 0
+        _WALL_NS = 0
+        _STAGED_BYTES = 0
+        _WIRE_BYTES = 0
+        _PENDING_FETCHES = 0
+        _ACTIVE_DROPPED = 0
+        _COMP_RAW = 0
+        _COMP_BYTES = 0
+        _COMP_CODECS.clear()
+        _CONN_EVENTS.clear()
+        _CONN_EVENTS.update({"dial": 0, "reuse": 0, "reset": 0})
+        _FETCH_PEERS.clear()
+    del _EDGE_LOG[:]
+    del _ACTIVE[:]
+    del _BOUNCE_MGRS[:]
+    del _HEARTBEAT_MGRS[:]

@@ -322,6 +322,79 @@ SHUFFLE_WRITE_BYTES = SHUFFLE_BYTES.labels(direction="write")
 SHUFFLE_READ_BYTES = SHUFFLE_BYTES.labels(direction="read")
 
 
+# -- shuffle-transport observability plane (obs/netplane.py) ----------------
+# Fetch/RTT buckets sized to a LAN TCP hop: sub-ms loopback to tens of
+# seconds for a stalled peer.
+_NET_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _netplane_mod():
+    from . import netplane
+    return netplane
+
+
+SHUFFLE_HOST_DROP_SECONDS = _REGISTRY.counter(
+    "tpu_shuffle_host_drop_seconds_total",
+    "Measured host-drop phase time of shuffle exchanges: serialize "
+    "(device->host pull into the staged block), wire (TCP transfer "
+    "incl. bounce hop), deserialize (host->device upload on read); "
+    "dwell is derived per query as the lifecycle remainder "
+    "(obs/netplane.py)",
+    labels=("phase",))
+SHUFFLE_FETCH_SECONDS = _REGISTRY.histogram(
+    "tpu_shuffle_fetch_seconds",
+    "Remote shuffle fetch latency by peer (metadata request to last "
+    "table landed, shuffle/iterator.py)",
+    buckets=_NET_BUCKETS,
+    labels=("peer",))
+SHUFFLE_CONN_EVENTS = _REGISTRY.counter(
+    "tpu_shuffle_conn_events_total",
+    "Shuffle connection-pool transitions (shuffle/tcp.py): dial = new "
+    "socket, reuse = pooled socket served a request, reset = "
+    "connection torn down with pending transactions errored",
+    labels=("event",))
+SHUFFLE_BOUNCE_DWELL_SECONDS = _REGISTRY.histogram(
+    "tpu_shuffle_bounce_dwell_seconds",
+    "Bounce-buffer hold time, acquire to release (shuffle/bounce.py)",
+    buckets=_NET_BUCKETS)
+SHUFFLE_BOUNCE_FREE = _REGISTRY.gauge(
+    "tpu_shuffle_bounce_free",
+    "Free bounce buffers across live shuffle servers",
+    fn=lambda: _netplane_mod().bounce_free())
+SHUFFLE_BOUNCE_TOTAL = _REGISTRY.gauge(
+    "tpu_shuffle_bounce_total",
+    "Total bounce buffers across live shuffle servers",
+    fn=lambda: _netplane_mod().bounce_total())
+SHUFFLE_PENDING_FETCHES = _REGISTRY.gauge(
+    "tpu_shuffle_pending_fetches",
+    "Shuffle fetches issued and not yet completed or errored — a "
+    "nonzero steady state means waiters are stuck on a torn-down "
+    "connection (shuffle/client.py)",
+    fn=lambda: _netplane_mod().pending_fetches())
+SHUFFLE_EDGES_TRACKED = _REGISTRY.gauge(
+    "tpu_shuffle_edges_tracked",
+    "Distinct (shuffle, map, reduce) edges held in the bounded "
+    "transfer matrix",
+    fn=lambda: _netplane_mod().edges_tracked())
+SHUFFLE_EDGES_EVICTED = _REGISTRY.counter(
+    "tpu_shuffle_edges_evicted_total",
+    "Edge records dropped because the transfer matrix hit "
+    "spark.rapids.tpu.obs.net.maxEdges")
+SHUFFLE_PEER_RTT_SECONDS = _REGISTRY.histogram(
+    "tpu_shuffle_peer_rtt_seconds",
+    "Heartbeat round-trip time by executor peer "
+    "(shuffle/heartbeat.py)",
+    buckets=_NET_BUCKETS,
+    labels=("peer",))
+SHUFFLE_COMPRESSION_BYTES = _REGISTRY.counter(
+    "tpu_shuffle_compression_bytes_total",
+    "Shuffle codec traffic by codec and side: raw = uncompressed "
+    "payload, compressed = encoded payload (ratio = raw/compressed; "
+    "shuffle/compression.py)",
+    labels=("codec", "direction"))
+
+
 def _pipeline_mod():
     from ..exec import pipeline
     return pipeline
@@ -432,9 +505,12 @@ DEVICE_BUSY_SECONDS = _REGISTRY.counter(
     "attributed to every participating device (obs/timeline.py)",
     labels=("device",))
 
-#: idle-gap taxonomy of the utilization timeline (docs/observability.md)
+#: idle-gap taxonomy of the utilization timeline (docs/observability.md;
+#: shuffle_host = active shuffle host-drop work windows from
+#: obs/netplane.py, classified ahead of the generic drain causes)
 TIMELINE_GAP_CAUSES = ("inline_compile", "sem_wait", "admission_queue",
-                       "host_staging", "pipeline_starvation", "idle")
+                       "shuffle_host", "host_staging",
+                       "pipeline_starvation", "idle")
 
 
 def _timeline_mod():

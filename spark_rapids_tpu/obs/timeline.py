@@ -25,6 +25,10 @@ are classified post-hoc (cold path only) by joining evidence streams:
 - ``sem_wait``            — flight EV_SEM_ACQUIRE (a = waited ns, so
                             the wait interval is ``[ts - a, ts]``);
 - ``admission_queue``     — flight EV_STATE admitted -> running spans;
+- ``shuffle_host``        — active shuffle host-drop work windows
+                            (serialize/wire/deserialize from
+                            obs/netplane.py): the device sat idle while
+                            an exchange paid the host-drop tax;
 - ``host_staging``        — remainder inside a morsel-pipeline drain
                             window (EV_PIPELINE dispatch -> drain_end,
                             paired per thread) whose recorded
@@ -275,6 +279,17 @@ def _summarize(idx: int, t0: int, t1: int, is_query: bool) -> Dict:
     idle = taken
     taken = _subtract(idle, admission)
     gaps_ns["admission_queue"] = _total(idle) - _total(taken)
+    idle = taken
+
+    # shuffle host-drop work (obs/netplane.py serialize/wire/
+    # deserialize windows) outranks the generic drain causes: an idle
+    # device under an exchange materialization is paying the host-drop
+    # tax, not waiting on pipeline staging (lazy import: netplane is
+    # initialized after timeline in obs/__init__)
+    from . import netplane
+    shuffle_segs = _clip(_merge(netplane.active_segments(t0, t1)), t0, t1)
+    taken = _subtract(idle, shuffle_segs)
+    gaps_ns["shuffle_host"] = _total(idle) - _total(taken)
     idle = taken
 
     healthy = _merge([(s, e) for s, e, r in drains

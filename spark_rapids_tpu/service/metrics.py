@@ -28,6 +28,7 @@ class QueryMetrics:
     sem_wait_ms: float = 0.0
     execute_ms: float = 0.0
     inline_compile_ms: float = 0.0
+    host_drop_tax_ms: float = 0.0
     spill_bytes: int = 0
     attempts: int = 1
     retries: int = 0
@@ -45,6 +46,7 @@ class QueryMetrics:
             "sem_wait_ms": round(self.sem_wait_ms, 3),
             "execute_ms": round(self.execute_ms, 3),
             "inline_compile_ms": round(self.inline_compile_ms, 3),
+            "host_drop_tax_ms": round(self.host_drop_tax_ms, 3),
             "spill_bytes": int(self.spill_bytes),
             "attempts": self.attempts,
             "retries": self.retries,

@@ -30,6 +30,7 @@ from ..config import (TpuConf, set_active, EVENT_LOG_PATH,
                       OBS_DIAG_MAX_BUNDLES)
 from ..obs import compile_watch as _cwatch
 from ..obs import flight as _flight
+from ..obs import netplane as _netplane
 from ..obs import slo as _slo
 from ..obs import timeline as _timeline
 from ..obs import trace as _trace
@@ -162,6 +163,7 @@ class QueryService:
         _slo.configure(conf)
         _cwatch.configure(conf)
         _timeline.configure(conf)
+        _netplane.configure(conf)
         # stats().snapshot() carries the live obs sections alongside the
         # lifecycle counters (the monitoring one-stop view)
         self._stats.set_extras(lambda: {
@@ -171,6 +173,7 @@ class QueryService:
             "slo": _slo.stats_section(),
             "compile": _cwatch.stats_section(),
             "timeline": _timeline.process_summary(),
+            "shuffle": _netplane.stats_section(),
         })
 
     # -- lifecycle ---------------------------------------------------------
@@ -403,6 +406,8 @@ class QueryService:
             m.sem_wait_ms += token.observed.get("sem_wait_ms", 0.0)
             m.inline_compile_ms += token.observed.get(
                 "inline_compile_ms", 0.0)
+            m.host_drop_tax_ms += token.observed.get(
+                "host_drop_tax_ms", 0.0)
             m.spill_bytes += int(token.observed.get("spill_bytes", 0))
             return table
 

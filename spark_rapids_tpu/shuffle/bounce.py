@@ -18,9 +18,12 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
+
+from ..obs import netplane as _netplane
 
 
 class BounceBuffer:
@@ -30,6 +33,7 @@ class BounceBuffer:
         self._manager = manager
         self.index = index
         self.buffer = np.zeros(size, dtype=np.uint8)
+        self._acquired_ns: Optional[int] = None
 
     @property
     def size(self) -> int:
@@ -37,6 +41,11 @@ class BounceBuffer:
 
     def close(self):
         """Return the buffer to the pool (Arm/withResource idiom)."""
+        if self._acquired_ns is not None:
+            # dwell = acquire -> release (outside the pool lock)
+            _netplane.note_bounce_dwell(
+                time.perf_counter_ns() - self._acquired_ns)
+            self._acquired_ns = None
         self._manager._release(self)
 
     def __enter__(self):
@@ -61,16 +70,23 @@ class BounceBufferManager:
         self._total = num_buffers
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        # occupancy gauges (tpu_shuffle_bounce_free/_total) read this
+        # pool's counts at collect time through a weakref
+        _netplane.register_bounce(self)
 
     def acquire(self, blocking: bool = True,
                 timeout: Optional[float] = None) -> Optional[BounceBuffer]:
         with self._cond:
             if not blocking:
-                return self._free.pop() if self._free else None
-            if not self._cond.wait_for(lambda: bool(self._free),
-                                       timeout=timeout):
-                return None
-            return self._free.pop()
+                buf = self._free.pop() if self._free else None
+            elif not self._cond.wait_for(lambda: bool(self._free),
+                                         timeout=timeout):
+                buf = None
+            else:
+                buf = self._free.pop()
+        if buf is not None:
+            buf._acquired_ns = time.perf_counter_ns()
+        return buf
 
     def _release(self, buf: BounceBuffer):
         with self._cond:

@@ -16,6 +16,8 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..obs import flight as _flight
+from ..obs import netplane as _netplane
+from ..service.cancellation import current_token
 from .meta import TableMeta, batch_from_meta
 from .transport import (BlockIdSpec, ClientConnection, MetadataRequest,
                         MetadataResponse, TransferRequest, TransferResponse)
@@ -35,13 +37,18 @@ class RapidsShuffleFetchHandler:
 
 
 class ReceivedBufferHandle:
-    """Handle to one reassembled table in the received catalog."""
+    """Handle to one reassembled table in the received catalog.
+
+    ``block`` identifies the (shuffle, map, reduce) edge the table
+    belongs to so the reduce-side deserialize can be attributed in the
+    netplane transfer matrix."""
 
     def __init__(self, catalog: "ReceivedBufferCatalog", buffer_id: int,
-                 meta: TableMeta):
+                 meta: TableMeta, block: Optional[BlockIdSpec] = None):
         self._catalog = catalog
         self.buffer_id = buffer_id
         self.meta = meta
+        self.block = block
 
     def materialize(self):
         """Blob -> device ColumnarBatch; frees the host blob."""
@@ -122,6 +129,14 @@ class BufferReceiveState:
         if done:
             self._on_complete(t)
 
+    def drain_pending(self) -> List[PendingTable]:
+        """Abort reassembly: remove and return every incomplete table
+        (client teardown — the caller errors their waiters)."""
+        with self._lock:
+            dropped = list(self._by_tag.values())
+            self._by_tag.clear()
+        return dropped
+
     @property
     def num_pending(self) -> int:
         with self._lock:
@@ -140,7 +155,12 @@ class RapidsShuffleClient:
         self.connection = connection
         self.catalog = received_catalog or ReceivedBufferCatalog()
         self.metadata_timeout = metadata_timeout
-        self._receive_states: List[BufferReceiveState] = []
+        # (receive state, its fetch handler): close() must be able to
+        # error the waiters of every in-flight table, so the handler
+        # rides alongside the state instead of living only inside the
+        # completion closures
+        self._receive_states: List[
+            Tuple[BufferReceiveState, RapidsShuffleFetchHandler]] = []
         self._lock = threading.Lock()
         self._closed = False
         self.connection.register_data_handler(self._dispatch_data)
@@ -148,45 +168,75 @@ class RapidsShuffleClient:
     def close(self):
         """Unregister from the shared connection (a connection is cached
         per peer; without this every fetch would leak its dispatcher —
-        reference: RapidsShuffleClient lifecycle)."""
-        if not self._closed:
-            self._closed = True
-            self.connection.unregister_data_handler(self._dispatch_data)
+        reference: RapidsShuffleClient lifecycle) and complete every
+        pending receive with a transfer_error: a table still reassembling
+        when the client tears down can never finish, and silently
+        dropping it would leave fetch waiters hung (the netplane
+        pending-fetch gauge surfaced exactly that)."""
+        if self._closed:
+            return
+        self._closed = True
+        self.connection.unregister_data_handler(self._dispatch_data)
+        with self._lock:
+            states = list(self._receive_states)
+            self._receive_states = []
+        for state, handler in states:
+            dropped = state.drain_pending()
+            if dropped:
+                _flight.record(_flight.EV_SHUFFLE, "close_dropped",
+                               a=len(dropped))
+                try:
+                    handler.transfer_error(
+                        "shuffle client closed with "
+                        f"{len(dropped)} tables in flight")
+                except Exception:
+                    pass    # teardown path: waiters may be gone already
 
     def _dispatch_data(self, tag: int, offset: int, payload: bytes):
         with self._lock:
-            states = list(self._receive_states)
+            states = [s for s, _h in self._receive_states]
         for s in states:
             s.on_data(tag, offset, payload)
         # prune fully-drained receive states so a long-lived client
         # doesn't accumulate one state per completed fetch
         with self._lock:
-            self._receive_states = [s for s in self._receive_states
+            self._receive_states = [(s, h) for s, h in self._receive_states
                                     if s.num_pending]
 
     # -- fetch state machine ----------------------------------------------
     def do_fetch(self, blocks: List[BlockIdSpec],
-                 handler: RapidsShuffleFetchHandler):
-        """Issue the metadata round; on response, kick off transfers."""
+                 handler: RapidsShuffleFetchHandler) -> int:
+        """Issue the metadata round; on response, kick off transfers.
+        Returns the fetch's correlation span_id — the same id rides the
+        requests so the server's serve spans join this fetch in one
+        Perfetto trace (obs/netplane.py)."""
         _flight.record(_flight.EV_SHUFFLE, "fetch_start", a=len(blocks))
-        req = MetadataRequest(next(self._req_counter), list(blocks))
+        tok = current_token()
+        query_id = tok.query_id if tok is not None else None
+        span_id = _netplane.next_span_id()
+        req = MetadataRequest(next(self._req_counter), list(blocks),
+                              query_id=query_id, span_id=span_id)
 
         def on_meta(resp: MetadataResponse):
             if resp.error:
                 _flight.record(_flight.EV_SHUFFLE, "fetch_error")
                 handler.transfer_error(resp.error)
                 return
-            self._issue_transfer(blocks, resp, handler)
+            self._issue_transfer(blocks, resp, handler,
+                                 query_id=query_id, span_id=span_id)
 
         tx = self.connection.request_metadata(req, on_meta)
         tx.on_complete(
             lambda t: handler.transfer_error(
                 f"metadata request failed: {t.error_message}")
             if t.status.value == "error" else None)
+        return span_id
 
     def _issue_transfer(self, blocks: List[BlockIdSpec],
                         resp: MetadataResponse,
-                        handler: RapidsShuffleFetchHandler):
+                        handler: RapidsShuffleFetchHandler,
+                        query_id: Optional[str] = None,
+                        span_id: int = 0):
         pending: List[PendingTable] = []
         degenerate: List[PendingTable] = []
         tables: List[Tuple[BlockIdSpec, int]] = []
@@ -206,7 +256,7 @@ class RapidsShuffleClient:
         for t in degenerate:
             bid = self.catalog.register(b"")
             handler.batch_received(
-                ReceivedBufferHandle(self.catalog, bid, t.meta))
+                ReceivedBufferHandle(self.catalog, bid, t.meta, t.block))
         if not pending:
             return
 
@@ -215,13 +265,21 @@ class RapidsShuffleClient:
                            a=t.meta.total_bytes)
             bid = self.catalog.register(bytes(t.blob))
             handler.batch_received(
-                ReceivedBufferHandle(self.catalog, bid, t.meta))
+                ReceivedBufferHandle(self.catalog, bid, t.meta, t.block))
 
         state = BufferReceiveState(pending, on_table)
         with self._lock:
-            self._receive_states.append(state)
+            lost_close_race = self._closed
+            if not lost_close_race:
+                self._receive_states.append((state, handler))
+        if lost_close_race:
+            # nothing will dispatch data into this state: error its
+            # waiters immediately instead of letting them hang
+            handler.transfer_error("shuffle client closed")
+            return
 
-        treq = TransferRequest(next(self._req_counter), tables, tags)
+        treq = TransferRequest(next(self._req_counter), tables, tags,
+                               query_id=query_id, span_id=span_id)
 
         def on_transfer(tresp: TransferResponse):
             if not tresp.accepted:

@@ -85,6 +85,41 @@ _CODECS: Dict[str, Type[CompressionCodec]] = {
 }
 
 
+def _instrument(codec: CompressionCodec) -> CompressionCodec:
+    """Count raw/compressed bytes through this codec instance
+    (tpu_shuffle_compression_bytes_total{codec,direction}): compress
+    reads raw and writes compressed, decompress the reverse, so the
+    two directions never double-count and ratio = compressed/raw.
+    The same byte pairs feed the transport plane (obs/netplane.py) so
+    per-query records and the report print the effective ratio."""
+    from ..obs import netplane
+    from ..obs.registry import SHUFFLE_COMPRESSION_BYTES
+    raw_c, raw_d = codec.compress, codec.decompress
+    name = codec.name
+    by_raw = SHUFFLE_COMPRESSION_BYTES.labels(codec=codec.name,
+                                              direction="raw")
+    by_comp = SHUFFLE_COMPRESSION_BYTES.labels(codec=codec.name,
+                                               direction="compressed")
+
+    def compress(data: bytes) -> bytes:
+        out = raw_c(data)
+        by_raw.inc(len(data))
+        by_comp.inc(len(out))
+        netplane.note_compression(name, len(data), len(out))
+        return out
+
+    def decompress(data: bytes, uncompressed_size: int) -> bytes:
+        out = raw_d(data, uncompressed_size)
+        by_comp.inc(len(data))
+        by_raw.inc(len(out))
+        netplane.note_compression(name, len(out), len(data))
+        return out
+
+    codec.compress = compress
+    codec.decompress = decompress
+    return codec
+
+
 def get_codec(name: str) -> CompressionCodec:
     name = (name or "none").lower()
     cls = _CODECS.get(name)
@@ -92,6 +127,6 @@ def get_codec(name: str) -> CompressionCodec:
         raise ValueError(f"unknown compression codec {name}; "
                          f"choices: {sorted(_CODECS)}")
     try:
-        return cls()
+        return _instrument(cls())
     except ImportError:
-        return ZlibCodec()
+        return _instrument(ZlibCodec())

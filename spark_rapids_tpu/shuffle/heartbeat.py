@@ -14,6 +14,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..obs import netplane as _netplane
+from ..obs.registry import SHUFFLE_PEER_RTT_SECONDS
+
 
 @dataclasses.dataclass(frozen=True)
 class PeerInfo:
@@ -39,6 +42,9 @@ class RapidsShuffleHeartbeatManager:
         self.heartbeat_interval_s = heartbeat_interval_s
         self.timeout_s = timeout_s
         self._lock = threading.Lock()
+        # peer liveness section of Service.stats()'s shuffle block
+        # (read weakly at stats time through the netplane registry)
+        _netplane.register_heartbeat(self)
 
     def register_executor(self, peer: PeerInfo) -> List[PeerInfo]:
         """RapidsExecutorStartupMsg: returns ALL currently known peers."""
@@ -69,6 +75,24 @@ class RapidsShuffleHeartbeatManager:
                     if now - self._last_beat.get(p.executor_id, 0)
                     <= self.timeout_s]
 
+    def peer_stats(self) -> Dict[str, Dict]:
+        """Per-executor last-seen age for Service.stats(): an executor
+        is ``stale`` after 3 missed heartbeat intervals (still short of
+        the hard liveness ``timeout_s`` that drops it from
+        live_executors) — the early-warning signal."""
+        now = time.monotonic()
+        stale_after = 3.0 * self.heartbeat_interval_s
+        with self._lock:
+            return {
+                p.executor_id: {
+                    "last_seen_age_s": round(
+                        now - self._last_beat.get(p.executor_id, 0.0), 3),
+                    "stale": (now - self._last_beat.get(p.executor_id, 0.0))
+                    > stale_after,
+                }
+                for p in self._peers
+            }
+
 
 class RapidsShuffleHeartbeatEndpoint:
     """Executor-side: beats the driver manager, pre-connects transport.
@@ -96,7 +120,14 @@ class RapidsShuffleHeartbeatEndpoint:
             self.transport.connect(p.executor_id)
 
     def beat(self) -> List[PeerInfo]:
+        # RTT of the driver round trip (in-process today, an RPC in a
+        # deployment): tpu_shuffle_peer_rtt_seconds{peer} — rising RTT
+        # precedes the stale/timeout transitions in peer_stats()
+        t0 = time.perf_counter_ns()
         new = self.manager.executor_heartbeat(self.peer.executor_id)
+        SHUFFLE_PEER_RTT_SECONDS.labels(
+            peer=self.peer.executor_id).observe(
+            (time.perf_counter_ns() - t0) / 1e9)
         self._connect_all(new)
         return new
 

@@ -116,6 +116,10 @@ class InProcessClientConnection(ClientConnection):
     def request_metadata(self, req: MetadataRequest,
                          handler: Callable[[MetadataResponse], None]
                          ) -> Transaction:
+        # requests cross by object reference, so the cross-boundary
+        # trace context (req.query_id/span_id — obs/netplane.py) arrives
+        # at the server handler with no codec involved; tcp.py is the
+        # transport that has to carry it explicitly
         tx = Transaction()
         peer = self._peer()
         if peer is None or peer.metadata_handler is None:

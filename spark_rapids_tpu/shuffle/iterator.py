@@ -13,9 +13,11 @@ Reference parity: ``shuffle/RapidsShuffleIterator.scala:49,124,268,307``:
 from __future__ import annotations
 
 import queue
+import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..columnar.batch import ColumnarBatch
+from ..obs import netplane as _netplane
 from ..obs import trace as _trace
 from ..obs.registry import SHUFFLE_READ_BYTES
 from ..service.cancellation import cancel_checkpoint
@@ -42,21 +44,58 @@ class ShuffleFetchFailedError(Exception):
 
 
 class _QueueHandler(RapidsShuffleFetchHandler):
-    """Bridges client callbacks onto the task thread's queue."""
+    """Bridges one peer's client callbacks onto the task thread's
+    queue (one handler per peer so fetch latency attributes per
+    peer)."""
 
-    def __init__(self, sink: "queue.Queue"):
+    def __init__(self, sink: "queue.Queue", peer: str = ""):
         self.sink = sink
+        self.peer = peer
         self.expected = 0
 
     def start(self, expected_batches: int):
         self.expected = expected_batches
-        self.sink.put(("count", expected_batches))
+        self.sink.put(("count", self.peer, expected_batches))
 
     def batch_received(self, handle: ReceivedBufferHandle):
-        self.sink.put(("batch", handle))
+        self.sink.put(("batch", self.peer, handle))
 
     def transfer_error(self, message: str):
-        self.sink.put(("error", message))
+        self.sink.put(("error", self.peer, message))
+
+
+class _PeerFetch:
+    """Progress of one peer's in-flight fetch: per-peer latency, byte
+    totals and the netplane pending-fetch accounting."""
+
+    __slots__ = ("peer", "t0_ns", "span_id", "expected", "received",
+                 "nbytes", "done")
+
+    def __init__(self, peer: str):
+        self.peer = peer
+        self.t0_ns = time.perf_counter_ns()
+        self.span_id = 0
+        self.expected: Optional[int] = None
+        self.received = 0
+        self.nbytes = 0
+        self.done = False
+        _netplane.fetch_begun()
+
+    def finish(self, error: bool = False):
+        if self.done:
+            return
+        self.done = True
+        _netplane.fetch_done()
+        dur = time.perf_counter_ns() - self.t0_ns
+        if not error:
+            _netplane.note_fetch(self.peer, dur, self.nbytes)
+        if _trace._ENABLED:
+            # the client half of the cross-boundary pair: joins the
+            # server's serve spans on (query_id, span_id)
+            _trace.emit("shuffle_fetch", "shuffle",
+                        self.t0_ns, dur, peer=self.peer,
+                        span_id=self.span_id, bytes=self.nbytes,
+                        error=error)
 
 
 class RapidsShuffleIterator(Iterator[ColumnarBatch]):
@@ -80,15 +119,18 @@ class RapidsShuffleIterator(Iterator[ColumnarBatch]):
         self._counts_pending = len(self._remote)
         self._started = False
         self._clients: List[RapidsShuffleClient] = []
+        self._peer_fetches: Dict[str, _PeerFetch] = {}
 
     def _start_fetches(self):
         self._started = True
         self._expected_remote = 0
-        handler = _QueueHandler(self._queue)
         for peer, blocks in self._remote.items():
             client = RapidsShuffleClient(self.transport.make_client(peer))
             self._clients.append(client)
-            client.do_fetch(blocks, handler)
+            pf = _PeerFetch(peer)
+            self._peer_fetches[peer] = pf
+            pf.span_id = client.do_fetch(
+                blocks, _QueueHandler(self._queue, peer))
 
     def __iter__(self):
         return self
@@ -97,6 +139,18 @@ class RapidsShuffleIterator(Iterator[ColumnarBatch]):
         for c in self._clients:
             c.close()
         self._clients = []
+        for pf in self._peer_fetches.values():
+            pf.finish(error=True)
+
+    def _peer_progress(self, peer: str, nbytes: int = 0):
+        """One batch (or the expected count) landed for ``peer``; when
+        the peer's expectation is met its fetch completes."""
+        pf = self._peer_fetches.get(peer)
+        if pf is None:
+            return
+        pf.nbytes += nbytes
+        if pf.expected is not None and pf.received >= pf.expected:
+            pf.finish()
 
     def _poll(self):
         """One queue item, polling in short slices: cancellation is
@@ -137,7 +191,7 @@ class RapidsShuffleIterator(Iterator[ColumnarBatch]):
                 self._close_clients()
                 raise StopIteration
             try:
-                kind, payload = self._poll()
+                kind, peer, payload = self._poll()
             except queue.Empty:
                 self._close_clients()
                 raise ShuffleFetchFailedError(
@@ -151,17 +205,36 @@ class RapidsShuffleIterator(Iterator[ColumnarBatch]):
             if kind == "count":
                 self._expected_remote += payload
                 self._counts_pending -= 1
+                pf = self._peer_fetches.get(peer)
+                if pf is not None:
+                    pf.expected = payload
+                self._peer_progress(peer)
                 continue
             if kind == "error":
+                pf = self._peer_fetches.get(peer)
+                if pf is not None:
+                    pf.finish(error=True)
                 self._close_clients()
                 raise ShuffleFetchFailedError(None, payload)
             handle: ReceivedBufferHandle = payload
             self._received_remote += 1
+            pf = self._peer_fetches.get(peer)
+            if pf is not None:
+                pf.received += 1
             # materialize = host blob -> device batch; this is where the
             # reference acquires the GPU semaphore (:307)
+            t0 = time.perf_counter_ns()
             batch = handle.materialize()
+            nbytes = 0
             try:
-                SHUFFLE_READ_BYTES.inc(int(batch.nbytes()))
+                nbytes = int(batch.nbytes())
+                SHUFFLE_READ_BYTES.inc(nbytes)
             except Exception:
                 pass
+            if handle.block is not None:
+                _netplane.note_deserialize(
+                    handle.block.shuffle_id, handle.block.map_id,
+                    handle.block.reduce_id, nbytes,
+                    time.perf_counter_ns() - t0)
+            self._peer_progress(peer, nbytes)
             return batch

@@ -16,9 +16,11 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..columnar.batch import ColumnarBatch
+from ..obs import netplane as _netplane
 from ..obs import trace as _trace
 from ..obs.registry import SHUFFLE_READ_BYTES, SHUFFLE_WRITE_BYTES
 
@@ -53,9 +55,15 @@ class ShuffleCatalog:
 
     def put(self, block: ShuffleBlockId, batches: List[ColumnarBatch]):
         from ..memory.spillable import SpillableBatch
+        t0 = time.perf_counter_ns()
         with _trace.span("shuffle_write", "shuffle"):
             entries = [SpillableBatch(b) for b in batches]
-        SHUFFLE_WRITE_BYTES.inc(sum(e.nbytes for e in entries))
+        nbytes = sum(e.nbytes for e in entries)
+        SHUFFLE_WRITE_BYTES.inc(nbytes)
+        _netplane.note_serialize(block.shuffle_id, block.map_id,
+                                 block.reduce_id,
+                                 sum(e.num_rows for e in entries), nbytes,
+                                 time.perf_counter_ns() - t0)
         with self._lock:
             self._store[block] = entries
 
@@ -64,18 +72,31 @@ class ShuffleCatalog:
         streaming writes register pieces as they finalize so they
         become spillable immediately)."""
         from ..memory.spillable import SpillableBatch
+        t0 = time.perf_counter_ns()
         with _trace.span("shuffle_write", "shuffle"):
             entries = [SpillableBatch(b) for b in batches]
-        SHUFFLE_WRITE_BYTES.inc(sum(e.nbytes for e in entries))
+        nbytes = sum(e.nbytes for e in entries)
+        SHUFFLE_WRITE_BYTES.inc(nbytes)
+        _netplane.note_serialize(block.shuffle_id, block.map_id,
+                                 block.reduce_id,
+                                 sum(e.num_rows for e in entries), nbytes,
+                                 time.perf_counter_ns() - t0)
         with self._lock:
             self._store.setdefault(block, []).extend(entries)
 
     def get(self, block: ShuffleBlockId) -> List[ColumnarBatch]:
         with self._lock:
             entries = self._store.get(block, [])
-        SHUFFLE_READ_BYTES.inc(sum(e.nbytes for e in entries))
+        nbytes = sum(e.nbytes for e in entries)
+        SHUFFLE_READ_BYTES.inc(nbytes)
+        t0 = time.perf_counter_ns()
         with _trace.span("shuffle_read", "shuffle"):
-            return [e.materialize() for e in entries]
+            out = [e.materialize() for e in entries]
+        if entries:
+            _netplane.note_deserialize(block.shuffle_id, block.map_id,
+                                       block.reduce_id, nbytes,
+                                       time.perf_counter_ns() - t0)
+        return out
 
     def stats_for_block(self, block: ShuffleBlockId):
         """(bytes, rows) without materializing (stays spilled —

@@ -13,8 +13,12 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
+from ..obs import flight as _flight
+from ..obs import netplane as _netplane
+from ..obs import trace as _trace
 from .bounce import BounceBufferManager, WindowedBlockIterator
 from .meta import TableMeta, build_table_meta
 from .transport import (BlockIdSpec, MetadataRequest, MetadataResponse,
@@ -74,6 +78,10 @@ class BufferSendState:
         conn = self.server.transport.server_connection()
         while self.windows.has_next():
             ranges = next(self.windows)
+            # wire-phase window: bounce acquire (flow control) through
+            # transport completion — the host-drop "wire" cost
+            w0 = time.perf_counter_ns()
+            window_bytes = 0
             # the acquired buffer bounds in-flight windows (flow control);
             # the payload is sliced straight from the source blob — one
             # copy, since the in-process wire snapshots bytes on send
@@ -86,6 +94,7 @@ class BufferSendState:
                 sends.append(conn.send_data(self.peer, tag, r.block_offset,
                                             payload))
                 self.bytes_sent += r.length
+                window_bytes += r.length
             for t in sends:
                 done = t.wait_for_completion(
                     timeout=self.server.send_timeout)
@@ -100,6 +109,8 @@ class BufferSendState:
                     _LOG.warning("shuffle server: send to %s failed: %s",
                                  self.peer, self.error)
             bounce.close()
+            _netplane.note_wire(window_bytes,
+                                time.perf_counter_ns() - w0)
             if self.error:
                 break
 
@@ -132,25 +143,48 @@ class ShuffleServer:
     # -- request handlers --------------------------------------------------
     def handle_metadata_request(self, peer: str,
                                 req: MetadataRequest) -> MetadataResponse:
+        t0 = time.perf_counter_ns()
+        _flight.record(_flight.EV_NET, "serve_meta", len(req.blocks),
+                       query_id=getattr(req, "query_id", None))
         try:
             tables = [self.handler.tables_for_block(b) for b in req.blocks]
-            return MetadataResponse(req.request_id, tables)
+            resp = MetadataResponse(req.request_id, tables)
         except Exception as e:  # noqa: BLE001 - surfaced to the peer
-            return MetadataResponse(req.request_id, [], error=str(e))
+            resp = MetadataResponse(req.request_id, [], error=str(e))
+        if _trace._ENABLED:
+            # server half of the cross-boundary pair: carries the
+            # requester's (query_id, span_id) so Perfetto joins it with
+            # the client's shuffle_fetch span
+            _trace.emit("shuffle_serve_meta", "shuffle", t0,
+                        time.perf_counter_ns() - t0, peer=peer,
+                        query_id=getattr(req, "query_id", None),
+                        span_id=getattr(req, "span_id", 0),
+                        error=resp.error)
+        return resp
 
     def handle_transfer_request(self, peer: str,
                                 req: TransferRequest) -> TransferResponse:
+        t0 = time.perf_counter_ns()
+        _flight.record(_flight.EV_NET, "serve_data", len(req.tables),
+                       query_id=getattr(req, "query_id", None))
         try:
             blobs = [self.handler.acquire_table_blob(block, bi)
                      for block, bi in req.tables]
         except Exception as e:  # noqa: BLE001
             return TransferResponse(req.request_id, False, error=str(e))
         state = BufferSendState(self, peer, req, blobs)
+        query_id = getattr(req, "query_id", None)
+        span_id = getattr(req, "span_id", 0)
 
         def _run():
             state.send_all()
             with self._lock:
                 self.bytes_served += state.bytes_sent
+            if _trace._ENABLED:
+                _trace.emit("shuffle_serve_data", "shuffle", t0,
+                            time.perf_counter_ns() - t0, peer=peer,
+                            query_id=query_id, span_id=span_id,
+                            bytes=state.bytes_sent, error=state.error)
 
         threading.Thread(target=_run, daemon=True,
                          name=f"shuffle-send-{peer}").start()
@@ -171,7 +205,17 @@ class CatalogRequestHandler(ShuffleRequestHandler):
         from .manager import ShuffleBlockId
         batches = self.catalog.get(
             ShuffleBlockId(block.shuffle_id, block.map_id, block.reduce_id))
-        return [build_table_meta(b) for b in batches]
+        t0 = time.perf_counter_ns()
+        pairs = [build_table_meta(b) for b in batches]
+        if pairs:
+            # serve-side serialize: flattening device batches into wire
+            # blobs re-stages the block on host (a second host drop)
+            _netplane.note_serialize(
+                block.shuffle_id, block.map_id, block.reduce_id,
+                sum(int(b.num_rows) for b in batches),
+                sum(len(blob) for _m, blob in pairs),
+                time.perf_counter_ns() - t0)
+        return pairs
 
     def tables_for_block(self, block: BlockIdSpec) -> List[TableMeta]:
         pairs = self._flatten(block)

@@ -39,6 +39,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..obs import flight as _flight
+from ..obs import netplane as _netplane
 from .meta import decode_meta, encode_meta
 from .transport import (BlockIdSpec, ClientConnection, MetadataRequest,
                         MetadataResponse, RapidsShuffleTransport,
@@ -103,6 +104,10 @@ def _enc_mdreq(req: MetadataRequest) -> bytes:
     out = [struct.pack("<QI", req.request_id, len(req.blocks))]
     out += [_BLOCK.pack(b.shuffle_id, b.map_id, b.reduce_id)
             for b in req.blocks]
+    # trailing trace-context extension (obs/netplane.py): old decoders
+    # stop at the block list, old encoders omit it — both interoperate
+    out.append(_pack_str(req.query_id or ""))
+    out.append(struct.pack("<Q", req.span_id))
     return b"".join(out)
 
 
@@ -114,7 +119,12 @@ def _dec_mdreq(view: memoryview) -> MetadataRequest:
         s, m, r = _BLOCK.unpack_from(view, pos)
         pos += _BLOCK.size
         blocks.append(BlockIdSpec(s, m, r))
-    return MetadataRequest(rid, blocks)
+    query_id, span_id = None, 0
+    if pos < len(view):   # frame from a trace-context-aware peer
+        qid, pos = _unpack_str(view, pos)
+        query_id = qid or None
+        (span_id,) = struct.unpack_from("<Q", view, pos)
+    return MetadataRequest(rid, blocks, query_id=query_id, span_id=span_id)
 
 
 def _enc_mdresp(resp: MetadataResponse) -> bytes:
@@ -159,6 +169,9 @@ def _enc_trreq(req: TransferRequest) -> bytes:
     for (block, bi), tag in zip(req.tables, req.tags):
         out.append(_TRITEM.pack(block.shuffle_id, block.map_id,
                                 block.reduce_id, bi, tag))
+    # trailing trace-context extension (see _enc_mdreq)
+    out.append(_pack_str(req.query_id or ""))
+    out.append(struct.pack("<Q", req.span_id))
     return b"".join(out)
 
 
@@ -171,7 +184,13 @@ def _dec_trreq(view: memoryview) -> TransferRequest:
         pos += _TRITEM.size
         tables.append((BlockIdSpec(s, m, r), bi))
         tags.append(tag)
-    return TransferRequest(rid, tables, tags)
+    query_id, span_id = None, 0
+    if pos < len(view):   # frame from a trace-context-aware peer
+        qid, pos = _unpack_str(view, pos)
+        query_id = qid or None
+        (span_id,) = struct.unpack_from("<Q", view, pos)
+    return TransferRequest(rid, tables, tags, query_id=query_id,
+                           span_id=span_id)
 
 
 def _enc_trresp(resp: TransferResponse) -> bytes:
@@ -246,12 +265,17 @@ class TcpClientConnection(ClientConnection):
     # -- wire ----------------------------------------------------------------
     def _ensure_socket(self) -> _Socket:
         with self._lock:
-            if self._sock is not None:
-                return self._sock
+            s = self._sock
+        if s is not None:
+            _netplane.note_conn("reuse")
+            return s
         with self._dial_lock:
             with self._lock:
-                if self._sock is not None:
-                    return self._sock   # lost the dial race to a peer
+                s = self._sock
+            if s is not None:
+                # lost the dial race to a peer thread: still a pool hit
+                _netplane.note_conn("reuse")
+                return s
             # lint: allow(LOCK001): _dial_lock is a dedicated single-
             # flight dial mutex; nothing else contends on it and the
             # state lock is NOT held across the blocking connect.
@@ -264,6 +288,7 @@ class TcpClientConnection(ClientConnection):
             # request frame can beat it onto the wire
             s.send(HELLO, _pack_str(self.transport.executor_id))
             _flight.record(_flight.EV_SHUFFLE, "dial")
+            _netplane.note_conn("dial")
             with self._lock:
                 self._sock = s
             if not s.thread.is_alive():
@@ -299,6 +324,7 @@ class TcpClientConnection(ClientConnection):
 
     def _on_close(self, _s: _Socket):
         _flight.record(_flight.EV_SHUFFLE, "conn_closed")
+        _netplane.note_conn("reset")
         with self._lock:
             pending = list(self._pending.values())
             self._pending.clear()

@@ -119,10 +119,17 @@ class BlockIdSpec:
 
 @dataclasses.dataclass
 class MetadataRequest:
-    """Ask a peer for TableMetas of the given shuffle blocks."""
+    """Ask a peer for TableMetas of the given shuffle blocks.
+
+    ``query_id``/``span_id`` are the cross-boundary trace context
+    (obs/netplane.py): optional so older encoders/peers interoperate —
+    the TCP codec appends them as a trailing extension the decoder
+    tolerates missing."""
 
     request_id: int
     blocks: List[BlockIdSpec]
+    query_id: Optional[str] = None
+    span_id: int = 0
 
 
 @dataclasses.dataclass
@@ -143,6 +150,9 @@ class TransferRequest:
     request_id: int
     tables: List[Tuple[BlockIdSpec, int]]   # (block, batch_index)
     tags: List[int]
+    # cross-boundary trace context (see MetadataRequest)
+    query_id: Optional[str] = None
+    span_id: int = 0
 
 
 @dataclasses.dataclass

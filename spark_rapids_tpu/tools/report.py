@@ -244,6 +244,66 @@ def compile_lines(rec: Dict) -> List[str]:
     return lines
 
 
+def shuffle_lines(rec: Dict) -> List[str]:
+    """The shuffle-transport (netplane) section of one engine record:
+    the four-phase host-drop split (summing to the exchange wall by
+    construction), the per-edge heat table and the per-peer fetch
+    latency aggregate — obs/netplane.py's event-log surface."""
+    net = rec.get("shuffle_netplane")
+    if not net:
+        return ["  (no shuffle netplane recorded — older log or "
+                "spark.rapids.tpu.obs.net.enabled=false)"]
+    lines = ["-- shuffle transport (netplane) --"]
+    lines.append(
+        f"  host_drop_tax_ms={_fmt(net.get('host_drop_tax_ms'))} "
+        f"exchange_wall_ms={_fmt(net.get('exchange_wall_ms'))} "
+        f"wire_MBps={_fmt(net.get('wire_MBps'))} "
+        f"edge_skew={_fmt(net.get('edge_skew'))} "
+        f"edges={_fmt(net.get('edges'))} "
+        f"blocks={_fmt(net.get('blocks'))}")
+    phases = net.get("phases_ms") or {}
+    wall = float(net.get("exchange_wall_ms") or 0.0)
+    for phase in ("serialize", "dwell", "wire", "deserialize"):
+        ms = phases.get(phase)
+        if ms is None:
+            continue
+        share = (ms / wall * 100.0) if wall else 0.0
+        bar = "#" * int(round(share / 5.0))
+        lines.append(f"  {phase:<13s}{share:6.1f}%{ms:>12.3f}ms  {bar}")
+    comp = net.get("compression") or {}
+    if comp.get("raw_bytes"):
+        codecs = ",".join(comp.get("codecs") or []) or "-"
+        lines.append(
+            f"  compression [{codecs}]: "
+            f"raw={_fmt(comp.get('raw_bytes'))} "
+            f"compressed={_fmt(comp.get('compressed_bytes'))} "
+            f"ratio={_fmt(comp.get('ratio'))}x")
+    edges = net.get("top_edges") or []
+    if edges:
+        lines.append("  top edges (map -> reduce):")
+        lines.append(f"    {'shuffle':>7s}{'map':>6s}{'reduce':>8s}"
+                     f"{'rows':>10s}{'bytes':>12s}{'batches':>9s}")
+        for e in edges:
+            lines.append(f"    {_fmt(e.get('shuffle_id')):>7}"
+                         f"{_fmt(e.get('map_id')):>6}"
+                         f"{_fmt(e.get('reduce_id')):>8}"
+                         f"{_fmt(e.get('rows')):>10}"
+                         f"{_fmt(e.get('bytes')):>12}"
+                         f"{_fmt(e.get('batches')):>9}")
+    peers = net.get("fetch_peers") or {}
+    if peers:
+        lines.append("  per-peer fetch latency:")
+        lines.append(f"    {'peer':<18s}{'count':>6s}{'avg_ms':>10s}"
+                     f"{'max_ms':>10s}{'bytes':>12s}")
+        for peer in sorted(peers):
+            p = peers[peer]
+            lines.append(f"    {peer:<18s}{_fmt(p.get('count')):>6}"
+                         f"{_fmt(p.get('avg_ms')):>10}"
+                         f"{_fmt(p.get('max_ms')):>10}"
+                         f"{_fmt(p.get('bytes')):>12}")
+    return lines
+
+
 def stats_lines(prof: Dict) -> List[str]:
     """Text sections for one record's StatsProfile (obs/stats.py)."""
     lines: List[str] = []
@@ -293,7 +353,8 @@ def stats_lines(prof: Dict) -> List[str]:
 
 def render_query_report(query_id, story: Dict,
                         trace_events: Optional[List[Dict]] = None,
-                        show_stats: bool = False) -> str:
+                        show_stats: bool = False,
+                        show_shuffle: bool = False) -> str:
     """One query's full text report."""
     lines = [f"=== query {query_id} " + "=" * 40]
     engine = story.get("engine", [])
@@ -324,6 +385,8 @@ def render_query_report(query_id, story: Dict,
             lines.extend(f"    {f}" for f in rec["fallbacks"])
         lines.extend(util_lines(rec))
         lines.extend(compile_lines(rec))
+        if show_shuffle:
+            lines.extend(shuffle_lines(rec))
         if show_stats:
             prof = rec.get("stats_profile")
             if prof:
@@ -378,7 +441,8 @@ def slo_header(stories: Dict) -> List[str]:
 
 def render_report(stories: Dict,
                   trace_events: Optional[List[Dict]] = None,
-                  query_id=None, show_stats: bool = False) -> str:
+                  query_id=None, show_stats: bool = False,
+                  show_shuffle: bool = False) -> str:
     ids = [query_id] if query_id is not None else sorted(
         stories, key=lambda q: str(q))
     parts = []
@@ -390,13 +454,15 @@ def render_report(stories: Dict,
         if qid not in stories:
             raise KeyError(f"query {qid!r} not in event log")
         parts.append(render_query_report(qid, stories[qid], trace_events,
-                                         show_stats=show_stats))
+                                         show_stats=show_stats,
+                                         show_shuffle=show_shuffle))
     return "\n\n".join(parts)
 
 
 def render_html(stories: Dict,
                 trace_events: Optional[List[Dict]] = None,
-                query_id=None, show_stats: bool = False) -> str:
+                query_id=None, show_stats: bool = False,
+                show_shuffle: bool = False) -> str:
     """Self-contained single-file HTML wrapping the text report
     per-query (monospace <pre> sections with a query index)."""
     ids = [query_id] if query_id is not None else sorted(
@@ -407,7 +473,8 @@ def render_html(stories: Dict,
                 f"{_html.escape(str(q))}</a></li>" for q in ids) + "</ul>"]
     for qid in ids:
         txt = render_query_report(qid, stories[qid], trace_events,
-                                  show_stats=show_stats)
+                                  show_stats=show_stats,
+                                  show_shuffle=show_shuffle)
         body.append(f'<h2 id="q{_html.escape(str(qid))}">'
                     f"query {_html.escape(str(qid))}</h2>")
         body.append(f"<pre>{_html.escape(txt)}</pre>")
@@ -422,7 +489,8 @@ def main(argv=None):
     argv = list(argv if argv is not None else sys.argv[1:])
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: report <event_log.jsonl> [--query QID] "
-              "[--trace trace.json] [--html out.html] [--stats]",
+              "[--trace trace.json] [--html out.html] [--stats] "
+              "[--shuffle]",
               file=sys.stderr)
         return 1
 
@@ -444,6 +512,7 @@ def main(argv=None):
     trace_path = _opt("--trace")
     html_out = _opt("--html")
     show_stats = _flag("--stats")
+    show_shuffle = _flag("--shuffle")
     log_path = argv[0]
     stories = load_query_stories(log_path)
     trace_events = load_trace(trace_path) if trace_path else None
@@ -457,11 +526,13 @@ def main(argv=None):
     if html_out:
         with open(html_out, "w") as f:
             f.write(render_html(stories, trace_events, qid,
-                                show_stats=show_stats))
+                                show_stats=show_stats,
+                                show_shuffle=show_shuffle))
         print(f"wrote {html_out}")
     else:
         print(render_report(stories, trace_events, qid,
-                            show_stats=show_stats))
+                            show_stats=show_stats,
+                            show_shuffle=show_shuffle))
     return 0
 
 

@@ -1,0 +1,504 @@
+"""Shuffle-transport observability plane tests (obs/netplane.py): the
+bounded per-edge transfer matrix, the four-phase host-drop tax split,
+cross-boundary (query_id, span_id) trace correlation over both
+transports, the Prometheus/stats/report/event-log surfaces, and the
+satellite instruments (compression byte counters, heartbeat peer
+liveness, the client.close() pending-fetch regression)."""
+import os
+import struct
+import time
+
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.obs import netplane, trace
+from spark_rapids_tpu.obs.prom import render_text
+from spark_rapids_tpu.obs.registry import get_registry
+
+MS = 1_000_000          # ns per ms
+
+
+@pytest.fixture(autouse=True)
+def _netplane_reset():
+    """Isolate the process-wide plane from other tests and restore the
+    default config afterwards (last-configured service wins)."""
+    netplane.reset()
+    yield
+    netplane.configure(TpuConf({}))
+    netplane.reset()
+    trace.disable()
+    trace.reset()
+
+
+# ---------------------------------------------------------------------------
+# transfer matrix
+# ---------------------------------------------------------------------------
+
+class TestTransferMatrix:
+    def test_edges_accumulate_rows_bytes_batches(self):
+        netplane.note_serialize(1, 0, 0, rows=10, nbytes=100, dur_ns=MS)
+        netplane.note_serialize(1, 0, 0, rows=5, nbytes=50, dur_ns=MS)
+        netplane.note_serialize(1, 1, 0, rows=7, nbytes=700, dur_ns=MS)
+        m = {(e["shuffle_id"], e["map_id"], e["reduce_id"]): e
+             for e in netplane.edge_matrix()}
+        assert m[(1, 0, 0)]["rows"] == 15
+        assert m[(1, 0, 0)]["bytes"] == 150
+        assert m[(1, 0, 0)]["batches"] == 2
+        assert m[(1, 1, 0)]["batches"] == 1
+        assert netplane.edges_tracked() == 2
+        # biggest-bytes-first ordering
+        assert netplane.edge_matrix()[0]["bytes"] == 700
+
+    def test_matrix_bound_evicts_not_grows(self):
+        netplane.configure(TpuConf({
+            "spark.rapids.tpu.obs.net.maxEdges": 2}))
+        for rid in range(4):
+            netplane.note_serialize(9, 0, rid, rows=1, nbytes=1, dur_ns=1)
+        assert netplane.edges_tracked() == 2
+        assert netplane.stats_section()["edges_evicted"] == 2
+
+    def test_disabled_plane_records_nothing(self):
+        netplane.configure(TpuConf({
+            "spark.rapids.tpu.obs.net.enabled": False}))
+        assert not netplane.is_enabled()
+        netplane.note_serialize(1, 0, 0, rows=1, nbytes=1, dur_ns=MS)
+        netplane.note_wire(1, MS)
+        netplane.note_deserialize(1, 0, 0, nbytes=1, dur_ns=MS)
+        netplane.note_conn("dial")
+        assert netplane.edges_tracked() == 0
+        s = netplane.query_summary(None)
+        assert s["host_drop_tax_ms"] == 0.0 and s["blocks"] == 0
+
+    def test_edge_skew_flags_hot_reduce_partition(self):
+        for rid in range(4):
+            netplane.note_serialize(3, 0, rid, rows=1, nbytes=100, dur_ns=1)
+        assert netplane.query_summary(None)["edge_skew"] == \
+            pytest.approx(1.0)
+        netplane.note_serialize(3, 1, 0, rows=1, nbytes=900, dur_ns=1)
+        # partition 0 holds 1000 of 1300 bytes: max/mean = 1000/325
+        assert netplane.query_summary(None)["edge_skew"] == \
+            pytest.approx(1000 / 325, abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# host-drop tax accounting
+# ---------------------------------------------------------------------------
+
+class TestHostDropTax:
+    def test_phases_sum_to_exchange_wall(self):
+        marker = netplane.begin_query()
+        netplane.note_serialize(5, 0, 0, rows=4, nbytes=400, dur_ns=2 * MS)
+        time.sleep(0.02)                      # host dwell
+        netplane.note_wire(400, MS)
+        netplane.note_deserialize(5, 0, 0, nbytes=400, dur_ns=MS)
+        s = netplane.query_summary(marker)
+        ph = s["phases_ms"]
+        assert ph["serialize"] == pytest.approx(2.0, abs=1e-6)
+        assert ph["wire"] == pytest.approx(1.0, abs=1e-6)
+        assert ph["deserialize"] == pytest.approx(1.0, abs=1e-6)
+        assert ph["dwell"] > 10.0             # the sleep shows up as dwell
+        # the acceptance contract: four phases sum to the wall within 1%
+        assert sum(ph.values()) == pytest.approx(
+            s["exchange_wall_ms"], rel=0.01, abs=0.02)
+        # the tax is the ACTIVE portion only
+        assert s["host_drop_tax_ms"] == pytest.approx(4.0, abs=1e-6)
+        assert s["staged_bytes"] == 400 and s["wire_bytes"] == 400
+        assert s["wire_MBps"] == pytest.approx(0.4, rel=0.01)
+
+    def test_reread_block_cannot_exceed_wall(self):
+        marker = netplane.begin_query()
+        netplane.note_serialize(6, 0, 0, rows=1, nbytes=10, dur_ns=MS)
+        netplane.note_deserialize(6, 0, 0, nbytes=10, dur_ns=MS)
+        netplane.note_deserialize(6, 0, 0, nbytes=10, dur_ns=MS)  # retry
+        s = netplane.query_summary(marker)
+        assert s["exchange_wall_ms"] >= s["host_drop_tax_ms"]
+        assert s["phases_ms"]["dwell"] >= 0.0
+        assert sum(s["phases_ms"].values()) == pytest.approx(
+            s["exchange_wall_ms"], rel=0.01, abs=0.02)
+
+    def test_query_marker_isolates_window(self):
+        netplane.note_serialize(7, 0, 0, rows=1, nbytes=111, dur_ns=MS)
+        marker = netplane.begin_query()
+        netplane.note_serialize(7, 1, 1, rows=2, nbytes=222, dur_ns=MS)
+        s = netplane.query_summary(marker)
+        assert s["blocks"] == 1 and s["staged_bytes"] == 222
+        assert s["phases_ms"]["serialize"] == pytest.approx(1.0, abs=1e-6)
+        edges = netplane.query_edges(marker)
+        assert [(e["map_id"], e["reduce_id"]) for e in edges] == [(1, 1)]
+
+    def test_active_windows_blame_shuffle_host_timeline_gap(self):
+        # a 20ms idle window where the only evidence is netplane
+        # serialize work -> the timeline classifies it shuffle_host
+        from spark_rapids_tpu.obs import timeline
+        timeline.reset()
+        try:
+            netplane.note_serialize(8, 0, 0, rows=1, nbytes=1,
+                                    dur_ns=15 * MS)
+            now = time.perf_counter_ns()
+            t0 = now - 20 * MS
+            s = timeline._summarize(0, t0, now, is_query=True)
+            assert s["gaps"]["shuffle_host"] == pytest.approx(75.0, abs=5.0)
+            assert netplane.active_segments(t0, now)
+        finally:
+            timeline.reset()
+
+
+# ---------------------------------------------------------------------------
+# cross-boundary trace correlation
+# ---------------------------------------------------------------------------
+
+class TestTraceContext:
+    def test_tcp_frames_carry_trace_context(self):
+        from spark_rapids_tpu.shuffle import (BlockIdSpec, MetadataRequest,
+                                              TransferRequest)
+        from spark_rapids_tpu.shuffle.tcp import (_dec_mdreq, _dec_trreq,
+                                                  _enc_mdreq, _enc_trreq)
+        req = MetadataRequest(3, [BlockIdSpec(1, 2, 3)],
+                              query_id="q-42", span_id=77)
+        out = _dec_mdreq(memoryview(_enc_mdreq(req)))
+        assert (out.query_id, out.span_id) == ("q-42", 77)
+        assert out.blocks == req.blocks
+        treq = TransferRequest(4, [(BlockIdSpec(1, 2, 3), 0)], [9],
+                               query_id="q-42", span_id=77)
+        tout = _dec_trreq(memoryview(_enc_trreq(treq)))
+        assert (tout.query_id, tout.span_id) == ("q-42", 77)
+
+    def test_legacy_frame_without_trailer_decodes(self):
+        # a frame from a pre-netplane peer stops at the block list: the
+        # decoder must tolerate the missing trailer (wire back-compat)
+        from spark_rapids_tpu.shuffle.tcp import _BLOCK, _dec_mdreq
+        body = struct.pack("<QI", 11, 1) + _BLOCK.pack(1, 2, 3)
+        out = _dec_mdreq(memoryview(body))
+        assert out.request_id == 11
+        assert out.query_id is None and out.span_id == 0
+
+    def test_client_and_server_spans_join_on_span_id(self, tmp_path):
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.shuffle import (EndpointRegistry,
+                                              InProcessTransport,
+                                              MapOutputTracker,
+                                              ShuffleExecutorContext)
+        reg = EndpointRegistry.reset()
+        trace.enable()
+        try:
+            tracker = MapOutputTracker()
+            ex_a = ShuffleExecutorContext(
+                "exec-a", InProcessTransport("exec-a", reg), tracker,
+                bounce_buffer_size=64, num_bounce_buffers=2)
+            ex_b = ShuffleExecutorContext(
+                "exec-b", InProcessTransport("exec-b", reg), tracker,
+                bounce_buffer_size=64, num_bounce_buffers=2)
+            ex_a.write_map_output(0, 0, {0: [ColumnarBatch.from_pydict(
+                {"k": list(range(8))})]})
+            out = list(ex_b.read_partition(0, 0, timeout_s=10.0))
+            assert sum(b.num_rows for b in out) == 8
+            events = trace.get_tracer().to_chrome_trace()["traceEvents"]
+            fetch = {e["args"]["span_id"] for e in events
+                     if e.get("name") == "shuffle_fetch"}
+            serve = {e["args"]["span_id"] for e in events
+                     if str(e.get("name", "")).startswith("shuffle_serve")}
+            assert fetch and fetch & serve, (fetch, serve)
+        finally:
+            EndpointRegistry.reset()
+
+    def test_span_ids_are_unique(self):
+        ids = {netplane.next_span_id() for _ in range(100)}
+        assert len(ids) == 100
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a real multi-partition exchange through the session
+# ---------------------------------------------------------------------------
+
+def _shuffle_df(s):
+    return (s.create_dataframe(
+                {"k": [i % 7 for i in range(2000)],
+                 "v": [float(i) for i in range(2000)]}, num_partitions=2)
+            .group_by("k").agg(F.sum("v").alias("sv")))
+
+
+class TestEndToEnd:
+    def test_session_rollup_and_zero_extra_flushes(self):
+        from spark_rapids_tpu.columnar import pending
+        s = TpuSession(TpuConf({}))
+        df = _shuffle_df(s)
+        df.to_arrow()                                  # warm
+        df.to_arrow()
+        net_on = s.last_query_netplane
+        assert net_on["edges"] > 0 and net_on["blocks"] > 0
+        assert net_on["host_drop_tax_ms"] > 0
+        assert sum(net_on["phases_ms"].values()) == pytest.approx(
+            net_on["exchange_wall_ms"], rel=0.01, abs=0.02)
+        assert net_on["top_edges"]
+        flushes_on = s.last_query_flushes
+        f0 = pending.FLUSH_COUNT
+        df.to_arrow()
+        assert pending.FLUSH_COUNT - f0 == flushes_on
+        # the acceptance contract: disabling the plane changes NOTHING
+        # about device flushes — an exact FLUSH_COUNT delta
+        netplane.configure(TpuConf({
+            "spark.rapids.tpu.obs.net.enabled": False}))
+        df.to_arrow()
+        assert s.last_query_flushes == flushes_on
+        assert s.last_query_netplane["blocks"] == 0    # plane was off
+
+    def test_event_log_record_carries_netplane(self, tmp_path):
+        from spark_rapids_tpu.tools.events import read_event_log
+        log = str(tmp_path / "events.jsonl")
+        s = TpuSession(TpuConf({"spark.rapids.tpu.eventLog.path": log}))
+        _shuffle_df(s).to_arrow()
+        recs = list(read_event_log(log))
+        assert recs
+        rec = recs[-1]
+        assert rec["host_drop_tax_ms"] == \
+            rec["shuffle_netplane"]["host_drop_tax_ms"] > 0
+        sn = rec["shuffle_netplane"]
+        assert sn["edges"] > 0 and sn["top_edges"]
+        assert set(sn["phases_ms"]) == set(netplane.PHASES)
+
+
+# ---------------------------------------------------------------------------
+# surfaces: Prometheus, Service.stats(), tools/report.py
+# ---------------------------------------------------------------------------
+
+class TestSurfaces:
+    def test_prometheus_exposition_covers_shuffle_families(self):
+        netplane.note_serialize(1, 0, 0, rows=1, nbytes=64, dur_ns=MS)
+        netplane.note_conn("dial")
+        netplane.note_fetch("exec-x", 2 * MS, 64)
+        text = render_text(get_registry())
+        for series in (
+                'tpu_shuffle_host_drop_seconds_total{phase="serialize"}',
+                'tpu_shuffle_conn_events_total{event="dial"}',
+                'tpu_shuffle_fetch_seconds_bucket',
+                "tpu_shuffle_edges_tracked 1",
+                "tpu_shuffle_pending_fetches 0"):
+            assert series in text, series
+
+    def test_stats_section_shape(self):
+        netplane.note_serialize(2, 1, 0, rows=3, nbytes=30, dur_ns=MS)
+        netplane.note_fetch("exec-y", MS, 30)
+        sec = netplane.stats_section()
+        assert sec["enabled"] and sec["edges_tracked"] == 1
+        assert set(sec["host_drop"]["phases_ms"]) == set(netplane.PHASES)
+        assert sec["connections"] == {"dial": 0, "reuse": 0, "reset": 0}
+        assert sec["bounce"] == {"free": 0, "total": 0}
+        assert sec["fetch_peers"]["exec-y"]["count"] == 1
+        assert sec["fetch_peers"]["exec-y"]["avg_ms"] == \
+            pytest.approx(1.0, abs=1e-6)
+        assert sec["top_edges"][0]["rows"] == 3
+
+    def test_pending_fetch_gauge_tracks_inflight(self):
+        assert netplane.pending_fetches() == 0
+        netplane.fetch_begun()
+        netplane.fetch_begun()
+        assert netplane.pending_fetches() == 2
+        netplane.fetch_done()
+        netplane.fetch_done()
+        assert netplane.pending_fetches() == 0
+
+    def test_report_renders_shuffle_section(self):
+        from spark_rapids_tpu.tools.report import shuffle_lines
+        rec = {"shuffle_netplane": {
+            "host_drop_tax_ms": 4.0, "exchange_wall_ms": 16.0,
+            "wire_MBps": 100.0, "edge_skew": 1.5, "edges": 2, "blocks": 3,
+            "phases_ms": {"serialize": 2.0, "dwell": 12.0, "wire": 1.0,
+                          "deserialize": 1.0},
+            "top_edges": [{"shuffle_id": 0, "map_id": 1, "reduce_id": 2,
+                           "rows": 10, "bytes": 1000, "batches": 1}],
+            "fetch_peers": {"exec-z": {"count": 2, "avg_ms": 1.5,
+                                       "max_ms": 2.0, "bytes": 2000}}}}
+        text = "\n".join(shuffle_lines(rec))
+        assert "host_drop_tax_ms=4.0" in text
+        for phase in netplane.PHASES:
+            assert phase in text
+        assert "dwell          75.0%" in text      # 12 of 16ms
+        assert "top edges (map -> reduce):" in text
+        assert "exec-z" in text
+
+    def test_report_tolerates_pre_netplane_records(self):
+        from spark_rapids_tpu.tools.report import shuffle_lines
+        (line,) = shuffle_lines({"query_id": "old"})
+        assert "no shuffle netplane recorded" in line
+
+
+# ---------------------------------------------------------------------------
+# satellites: compression counters, heartbeat liveness, client.close()
+# ---------------------------------------------------------------------------
+
+class TestCompressionCounters:
+    def test_incompressible_data_counted_and_bounded(self):
+        from spark_rapids_tpu.obs.registry import SHUFFLE_COMPRESSION_BYTES
+        from spark_rapids_tpu.shuffle.compression import get_codec
+        codec = get_codec("zlib")
+        raw_c = SHUFFLE_COMPRESSION_BYTES.labels(codec="zlib",
+                                                 direction="raw")
+        comp_c = SHUFFLE_COMPRESSION_BYTES.labels(codec="zlib",
+                                                  direction="compressed")
+        raw0, comp0 = raw_c.value, comp_c.value
+        data = os.urandom(1 << 16)
+        out = codec.compress(data)
+        # regression: incompressible payload must not blow up in size
+        assert len(out) <= len(data) + len(data) // 64 + 256
+        assert raw_c.value - raw0 == len(data)
+        assert comp_c.value - comp0 == len(out)
+        back = codec.decompress(out, len(data))
+        assert back == data
+        # decompress counts the same traffic once more, same directions
+        assert raw_c.value - raw0 == 2 * len(data)
+        assert comp_c.value - comp0 == 2 * len(out)
+
+    def test_compressible_data_shows_ratio_win(self):
+        from spark_rapids_tpu.shuffle.compression import get_codec
+        codec = get_codec("zlib")
+        data = b"spark-rapids-tpu" * 4096
+        out = codec.compress(data)
+        assert len(out) < len(data) // 10
+
+    def test_codec_traffic_feeds_per_exchange_ratio_and_report(self):
+        from spark_rapids_tpu.shuffle.compression import get_codec
+        from spark_rapids_tpu.tools.report import shuffle_lines
+        marker = netplane.begin_query()
+        codec = get_codec("zlib")
+        data = b"spark-rapids-tpu" * 4096
+        out = codec.compress(data)
+        summary = netplane.query_summary(marker)
+        comp = summary["compression"]
+        assert comp["raw_bytes"] == len(data)
+        assert comp["compressed_bytes"] == len(out)
+        assert comp["ratio"] == pytest.approx(len(data) / len(out),
+                                              abs=0.01)
+        assert comp["codecs"] == ["zlib"]
+        assert netplane.stats_section()["compression"]["raw_bytes"] \
+            >= len(data)
+        text = "\n".join(shuffle_lines({"shuffle_netplane": summary}))
+        assert "compression [zlib]" in text and "ratio=" in text
+
+
+class TestHeartbeatLiveness:
+    def test_peer_stats_flags_stale_after_three_intervals(self):
+        from spark_rapids_tpu.shuffle import (PeerInfo,
+                                              RapidsShuffleHeartbeatManager)
+        mgr = RapidsShuffleHeartbeatManager(heartbeat_interval_s=0.02,
+                                            timeout_s=30.0)
+        mgr.register_executor(PeerInfo("exec-a"))
+        stats = mgr.peer_stats()
+        assert not stats["exec-a"]["stale"]
+        time.sleep(0.08)                       # > 3 * 0.02s interval
+        stats = mgr.peer_stats()
+        assert stats["exec-a"]["stale"]
+        assert stats["exec-a"]["last_seen_age_s"] >= 0.06
+        # a beat un-stales the peer, and the manager feeds stats()
+        mgr.executor_heartbeat("exec-a")
+        assert not mgr.peer_stats()["exec-a"]["stale"]
+        assert netplane.stats_section()["peers"]["exec-a"]["stale"] is False
+
+    def test_beat_observes_rtt_histogram(self):
+        from spark_rapids_tpu.shuffle import (
+            PeerInfo, RapidsShuffleHeartbeatEndpoint,
+            RapidsShuffleHeartbeatManager)
+
+        class _NoTransport:
+            def connect(self, peer):
+                pass
+
+        mgr = RapidsShuffleHeartbeatManager(heartbeat_interval_s=0.02)
+        ep = RapidsShuffleHeartbeatEndpoint(mgr, _NoTransport(),
+                                            PeerInfo("exec-rtt"))
+        ep.beat()
+        text = render_text(get_registry())
+        assert 'tpu_shuffle_peer_rtt_seconds_count{peer="exec-rtt"}' in text
+
+
+class _ScriptedConnection:
+    """Minimal scripted ClientConnection (the Mockito-mock pattern)."""
+
+    def __init__(self):
+        from spark_rapids_tpu.shuffle import ClientConnection
+        ClientConnection.__init__(self, "mock-peer")
+        self.data_handler = None
+        self.metadata_requests = []
+        self.transfer_requests = []
+
+    def register_data_handler(self, handler):
+        self.data_handler = handler
+
+    def unregister_data_handler(self, handler):
+        if self.data_handler is handler:
+            self.data_handler = None
+
+    def request_metadata(self, req, handler):
+        from spark_rapids_tpu.shuffle import Transaction
+        tx = Transaction()
+        self.metadata_requests.append((req, handler, tx))
+        return tx
+
+    def request_transfer(self, req, handler):
+        from spark_rapids_tpu.shuffle import Transaction
+        tx = Transaction()
+        self.transfer_requests.append((req, handler, tx))
+        return tx
+
+
+class _Collecting:
+    def __init__(self):
+        self.batches, self.errors, self.expected = [], [], None
+
+    def start(self, expected):
+        self.expected = expected
+
+    def batch_received(self, handle):
+        self.batches.append(handle)
+
+    def transfer_error(self, message):
+        self.errors.append(message)
+
+
+class TestClientCloseRegression:
+    def test_close_errors_pending_receives(self):
+        # the bug the pending-fetch gauge surfaced: close() silently
+        # dropped in-flight tables, leaving fetch waiters hung forever
+        import numpy as np
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.shuffle import (BlockIdSpec, MetadataResponse,
+                                              RapidsShuffleClient,
+                                              TransferResponse,
+                                              build_table_meta)
+        conn = _ScriptedConnection()
+        client = RapidsShuffleClient(conn)
+        handler = _Collecting()
+        span_id = client.do_fetch([BlockIdSpec(0, 0, 1)], handler)
+        assert span_id > 0
+        src = ColumnarBatch.from_pydict(
+            {"a": np.arange(16, dtype=np.int64)})
+        meta, blob = build_table_meta(src)
+        (req, meta_cb, _tx) = conn.metadata_requests[0]
+        assert req.span_id == span_id          # context rides the request
+        meta_cb(MetadataResponse(req.request_id, [[meta]]))
+        (treq, transfer_cb, _ttx) = conn.transfer_requests[0]
+        transfer_cb(TransferResponse(treq.request_id, True))
+        # only half the blob lands before teardown
+        conn.data_handler(treq.tags[0], 0, blob[:len(blob) // 2])
+        client.close()
+        assert handler.errors and "closed" in handler.errors[0]
+        assert not handler.batches
+        client.close()                          # idempotent
+
+    def test_fetch_after_close_errors_immediately(self):
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.shuffle import (BlockIdSpec, MetadataResponse,
+                                              RapidsShuffleClient,
+                                              build_table_meta)
+        import numpy as np
+        conn = _ScriptedConnection()
+        client = RapidsShuffleClient(conn)
+        handler = _Collecting()
+        client.do_fetch([BlockIdSpec(0, 0, 0)], handler)
+        client.close()
+        # the metadata response races past close(): waiters still error
+        meta, _ = build_table_meta(ColumnarBatch.from_pydict(
+            {"a": np.arange(4, dtype=np.int64)}))
+        (req, meta_cb, _tx) = conn.metadata_requests[0]
+        meta_cb(MetadataResponse(req.request_id, [[meta]]))
+        assert handler.errors and "closed" in handler.errors[0]
