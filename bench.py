@@ -136,9 +136,35 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
     perf = {"timeline": getattr(s, "last_query_timeline", None),
             "inline_compile_ms": getattr(
                 s, "last_query_inline_compile_ms", None),
-            "netplane": getattr(s, "last_query_netplane", None)}
+            "netplane": getattr(s, "last_query_netplane", None),
+            # static PV-FLUSH prediction for the same warm query
+            # (analysis/flush_budget.py — must equal `flushes`)
+            "predicted_flushes": getattr(
+                s, "last_query_predicted_flushes", None)}
     return best, flushes, (prof.to_dict() if prof is not None
                            else None), perf
+
+
+def audited_programs():
+    """Run the jaxpr program audit (analysis/program_audit.py) and
+    return the audited program names — the bench record documents WHICH
+    device programs the numbers were measured over, statically vetted
+    (no host callbacks / float surprises / data-dependent shapes).
+    Mesh programs need >= 2 devices to build; on a single-device bench
+    host the rest are still audited."""
+    try:
+        import jax
+        from spark_rapids_tpu.analysis.program_audit import (audit_all,
+                                                             collect_specs)
+        specs = collect_specs()
+        if jax.local_device_count() < 2:
+            specs = [s for s in specs if not s.name.startswith("mesh_")]
+        report = audit_all(specs)
+        if not report.ok:
+            return {"findings": [str(f) for f in report.findings]}
+        return sorted(report.audited)
+    except Exception:  # noqa: BLE001 - reporting only, never gate bench
+        return None
 
 
 def measure_service_p99(n_rows: int = 200_000, submissions: int = 8):
@@ -212,6 +238,11 @@ def main():
         "superstage_on_vs_off": round(tpu_nostage_t / tpu_exact_t, 3),
         "flushes": tpu_flushes,
         "superstage_off_flushes": nostage_flushes,
+        # static PV-FLUSH prediction for the warm headline query — the
+        # cross-checked dispatch model (analysis/flush_budget.py)
+        "predicted_flushes": tpu_perf.get("predicted_flushes"),
+        # device programs statically vetted by the jaxpr auditor
+        "audited_programs": audited_programs(),
         # runtime stats plane (obs/stats.py): on/off overhead of the
         # exact headline (the plane adds zero flushes, so this is pure
         # host-side cost; budget <= 2%) + the warm query's dispatch

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Program-audit CLI — the CI gate over ``analysis/program_audit.py``.
+
+Usage:
+  python ci/audit.py                   # audit every registered program
+                                       # (exit 1 on findings, exit 2 on
+                                       # build/coverage errors)
+  python ci/audit.py --census          # also print the per-program
+                                       # fusion-breaker census
+  python ci/audit.py --fixture AUD001  # run ONE seeded negative spec;
+                                       # exit NONZERO iff the expected
+                                       # rule fires (the self-test CI
+                                       # inverts: nonzero here is PASS)
+
+Shares the lint layer's finding format and exit-code convention
+(``format_findings``; 0 clean, 1 findings).  Runs fully host-side:
+JAX_PLATFORMS=cpu plus the 8-virtual-device flag are forced below so
+the mesh programs (which need >=2 devices for non-degenerate splitter
+and routing structure) trace exactly as they do under the test harness.
+"""
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _fixture(rule: str) -> int:
+    """Audit one seeded negative spec; exit 1 iff its rule fires."""
+    from spark_rapids_tpu.analysis.lint import format_findings
+    from spark_rapids_tpu.analysis.program_audit import (
+        ALL_RULES, audit_spec, seeded_negative_specs)
+    if rule not in ALL_RULES:
+        print(f"unknown audit rule {rule!r}; expected one of "
+              f"{', '.join(ALL_RULES)}", file=sys.stderr)
+        return 2
+    spec = seeded_negative_specs()[rule]
+    findings, _census = audit_spec(spec)
+    print(format_findings(findings))
+    return 1 if any(f.rule == rule for f in findings) else 0
+
+
+def main(argv) -> int:
+    from spark_rapids_tpu.analysis.lint import format_findings
+    from spark_rapids_tpu.analysis.program_audit import (AuditBuildError,
+                                                         audit_all)
+    if "--fixture" in argv:
+        i = argv.index("--fixture")
+        if i + 1 >= len(argv):
+            print("--fixture requires a rule id", file=sys.stderr)
+            return 2
+        return _fixture(argv[i + 1])
+    try:
+        report = audit_all(repo_root=REPO_ROOT)
+    except AuditBuildError as e:
+        # a spec that cannot even build is a broken audit surface, not
+        # a clean one — fail louder than a finding
+        print(f"audit: BUILD ERROR: {e}", file=sys.stderr)
+        return 2
+    if "--census" in argv:
+        for name in sorted(report.census):
+            counts = dict(sorted(report.census[name].items()))
+            print(f"census {name}: {counts or '{}'}")
+    if report.findings:
+        print(format_findings(report.findings))
+        return 1
+    print(f"audit: no findings ({len(report.audited)} programs)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

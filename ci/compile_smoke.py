@@ -82,6 +82,14 @@ def main():
         assert all(getattr(j, "_superstage", False) for j in joins), \
             f"{q}: carved join not armed for one-dispatch probing"
 
+        # -- static flush prediction (PV-FLUSH): computed BEFORE any
+        # execution, then asserted EXACTLY equal to the runtime
+        # pending.FLUSH_COUNT delta of the warm run below
+        from spark_rapids_tpu.analysis import predict_flushes
+        pred_on = predict_flushes(phys, conf=s_on.conf)
+        phys_off = s_off._plan(s_off.sql(sql)._plan)
+        pred_off = predict_flushes(phys_off, conf=s_off.conf)
+
         # -- determinism + flush budget (warm: second run of each)
         rows_on = s_on.sql(sql).collect()
         f0 = pending.FLUSH_COUNT
@@ -94,6 +102,15 @@ def main():
         warm_off = pending.FLUSH_COUNT - f0
 
         assert rows_on == rows_off, f"{q}: superstage changed results"
+        assert pred_on.expected(len(rows_on)) == warm_on, \
+            f"{q}: PV-FLUSH predicted {pred_on.expected(len(rows_on))} " \
+            f"warm flushes (superstage on), runtime took {warm_on}\n" \
+            f"{pred_on.explain()}"
+        assert pred_off.expected(len(rows_off)) == warm_off, \
+            f"{q}: PV-FLUSH predicted " \
+            f"{pred_off.expected(len(rows_off))} warm flushes " \
+            f"(superstage off), runtime took {warm_off}\n" \
+            f"{pred_off.explain()}"
         assert warm_on <= FLUSH_BUDGET[q], \
             f"{q}: warm carved run took {warm_on} flushes " \
             f"(budget {FLUSH_BUDGET[q]})"
@@ -102,6 +119,8 @@ def main():
             f"(on={warm_on} off={warm_off})"
         print(f"  {q}: rows={len(rows_on)} warm_flushes "
               f"on={warm_on} off={warm_off} "
+              f"(predicted on={pred_on.expected(len(rows_on))} "
+              f"off={pred_off.expected(len(rows_off))}) "
               f"stages={len(stages)} fused_joins={len(joins)}")
 
     # -- compile-scoped lint clean on the compiler's own files

@@ -9,6 +9,15 @@ cd "$(dirname "$0")/.."
 echo "== static analysis (project lint + race analysis) =="
 JAX_PLATFORMS=cpu python ci/lint.py
 
+echo "== program audit (jaxpr device-purity over every jitted program) =="
+JAX_PLATFORMS=cpu python ci/audit.py
+for rule in AUD001 AUD002 AUD003 AUD004; do
+  # seeded negatives: the gate must FAIL on each planted defect
+  if JAX_PLATFORMS=cpu python ci/audit.py --fixture "$rule" >/dev/null; then
+    echo "audit fixture $rule did NOT trip the gate" >&2; exit 1
+  fi
+done
+
 echo "== plan-invariant verifier smoke (TPC-DS-style plans) =="
 JAX_PLATFORMS=cpu python ci/lint.py --plan-smoke
 

@@ -1,7 +1,7 @@
 """Static verification layer.
 
-Two heads (ISSUE 3 / the tag-time-checking discipline of the reference
-plugin, applied end-to-end):
+Four heads (ISSUE 3 / ISSUE 10: the tag-time-checking discipline of
+the reference plugin, applied end-to-end):
 
 - ``plan_verify``: multi-pass invariant verifier over a lowered
   ``PhysicalPlan`` tree, run BEFORE execution (behind
@@ -13,6 +13,19 @@ plugin, applied end-to-end):
   dtype supportability, partitioning contracts, or cancellation
   coverage.
 
+- ``flush_budget``: static warm-flush predictor — how many pending-
+  pool device round trips one warm collect of a physical plan costs,
+  derived from compile/lower.py dispatch classifications.  Surfaced
+  as the PV-FLUSH verifier pass and cross-checked EXACTLY against the
+  runtime ``pending.FLUSH_COUNT`` delta by ci/compile_smoke.py.
+
+- ``program_audit``: jaxpr-level auditor over every registered jitted
+  program (the compile_watch JIT caches plus the speculative join
+  probe and exchange stats programs): abstract tracing via
+  ``jax.make_jaxpr`` enforces AUD001 no host callbacks, AUD002 no
+  float primitives in exact-mode programs, AUD003 no data-dependent
+  shapes, AUD004 fusion-breaker budgets.  CLI entry: ``ci/audit.py``.
+
 - ``lint``: Python-AST project lint / race-analysis harness over the
   ``spark_rapids_tpu`` source tree (lock discipline, host-sync bans,
   conf/doc drift, hygiene).  CLI entry: ``ci/lint.py``.
@@ -23,9 +36,15 @@ Shared finding format: (rule id, file:line, message) — see
 from .plan_verify import (PlanVerificationError, PlanVerificationReport,
                           Violation, verify_plan, verify_or_raise)
 from .lint import Finding, lint_paths, lint_project, lint_source
+from .flush_budget import FlushPrediction, predict_flushes
+from .program_audit import (AuditBuildError, AuditReport, AuditSpec,
+                            audit_all, audit_spec, collect_specs)
 
 __all__ = [
     "PlanVerificationError", "PlanVerificationReport", "Violation",
     "verify_plan", "verify_or_raise",
     "Finding", "lint_paths", "lint_project", "lint_source",
+    "FlushPrediction", "predict_flushes",
+    "AuditBuildError", "AuditReport", "AuditSpec",
+    "audit_all", "audit_spec", "collect_specs",
 ]

@@ -69,6 +69,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 LOCK001 = "LOCK001"
 LOCK002 = "LOCK002"
+LOCK003 = "LOCK003"
 SYNC001 = "SYNC001"
 CONF001 = "CONF001"
 CONF002 = "CONF002"
@@ -77,7 +78,7 @@ HYG002 = "HYG002"
 HYG003 = "HYG003"
 OBS002 = "OBS002"
 
-ALL_RULES = (LOCK001, LOCK002, SYNC001, CONF001, CONF002,
+ALL_RULES = (LOCK001, LOCK002, LOCK003, SYNC001, CONF001, CONF002,
              HYG001, HYG002, HYG003, OBS002)
 
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([A-Z0-9, ]+)\)")
@@ -113,6 +114,37 @@ _LOCK001_QUEUE_GET_ALLOWLIST = {
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
                    "BoundedSemaphore"}
 _LOCK_NAME_RE = re.compile(r"lock|mutex", re.IGNORECASE)
+
+#: receivers whose ``.flush()`` is the pending-pool device barrier
+#: (LOCK003).  Restricting to the pending module's aliases keeps file
+#: handles (``f.flush()``) and trace-buffer flushes out of scope.
+_PENDING_ALIASES = {"pending", "_pending"}
+
+
+def _is_pending_flush(node: ast.Call) -> bool:
+    """True when ``node`` is a pending-pool device flush: the module
+    call ``pending.flush()`` or a bare ``flush()`` (inside the pending
+    module itself)."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "flush":
+        d = _dotted(f.value)
+        return d is not None and d.split(".")[-1] in _PENDING_ALIASES
+    return isinstance(f, ast.Name) and f.id == "flush"
+
+
+def _collect_flushing_funcs(tree: ast.AST) -> Set[str]:
+    """Names of functions/methods in this file whose body (including
+    nested defs — the outer call may invoke them) reaches a pending
+    flush.  One level of same-file indirection is enough for the
+    LOCK003 surface: the flush sites live in small local helpers."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for n in ast.walk(node):
+                if isinstance(n, ast.Call) and _is_pending_flush(n):
+                    out.add(node.name)
+                    break
+    return out
 
 #: numpy module aliases for the SYNC001 asarray check
 _NP_ALIASES = {"np", "_np", "numpy"}
@@ -235,9 +267,12 @@ class _FileLockAnalysis(ast.NodeVisitor):
     """Walks one file: with-lock regions, blocking calls inside them,
     and lock-order edges for the cross-file graph."""
 
-    def __init__(self, path: str, tree: ast.AST, lock_names: Set[str]):
+    def __init__(self, path: str, tree: ast.AST, lock_names: Set[str],
+                 flushing_funcs: Optional[Set[str]] = None):
         self.path = path
         self.lock_names = lock_names
+        self.flushing_funcs = flushing_funcs if flushing_funcs \
+            is not None else _collect_flushing_funcs(tree)
         self.findings: List[Finding] = []
         #: (src_lock, dst_lock, line) — dst acquired while src held
         self.edges: List[Tuple[str, str, int]] = []
@@ -314,7 +349,24 @@ class _FileLockAnalysis(ast.NodeVisitor):
                 attr = node.func.attr
             elif isinstance(node.func, ast.Name):
                 attr = node.func.id
-            if attr in _BLOCKING_ATTRS:
+            if _is_pending_flush(node):
+                self.findings.append(Finding(
+                    LOCK003, self.path, node.lineno,
+                    f"pending-pool flush while holding lock "
+                    f"{self._held[-1]} (held: "
+                    f"{', '.join(self._held)}): the flush blocks on "
+                    f"device dispatch (and may re-enter allocator/"
+                    f"shuffle paths that contend on the same lock) — "
+                    f"every thread behind the lock stalls for the "
+                    f"whole round trip"))
+            elif attr in self.flushing_funcs:
+                self.findings.append(Finding(
+                    LOCK003, self.path, node.lineno,
+                    f"call to '{attr}' (which flushes the pending "
+                    f"pool) while holding lock {self._held[-1]} "
+                    f"(held: {', '.join(self._held)}): the device "
+                    f"barrier runs inside the critical section"))
+            elif attr in _BLOCKING_ATTRS:
                 self.findings.append(Finding(
                     LOCK001, self.path, node.lineno,
                     f"blocking call '{attr}' while holding lock "
@@ -658,7 +710,7 @@ def _scopes_for(rel: str) -> Set[str]:
         # (exchange build/materialize locks, scan-cache lock) carry the
         # same lock discipline as the service/shuffle/memory layers;
         # compile/ + the superstage wrapper run inside those drains
-        scopes |= {LOCK001, LOCK002}
+        scopes |= {LOCK001, LOCK002, LOCK003}
     if "kernels" in parts or "compile" in parts or \
             base.startswith("tpu_") or \
             base in ("pipeline.py", "superstage.py", "exchange.py",
@@ -697,11 +749,10 @@ def lint_source(source: str, path: str = "<string>",
         scopes = set(ALL_RULES)
     findings: List[Finding] = []
     edges: List[Tuple[str, str, str, int]] = []
-    if LOCK001 in scopes or LOCK002 in scopes:
+    if LOCK001 in scopes or LOCK002 in scopes or LOCK003 in scopes:
         lock_names = _collect_lock_names(tree)
         la = _FileLockAnalysis(path, tree, lock_names)
-        if LOCK001 in scopes:
-            findings += la.findings
+        findings += [f for f in la.findings if f.rule in scopes]
         if LOCK002 in scopes:
             edges = [(s, d, path, ln) for s, d, ln in la.edges]
             if collect_edges is not None:

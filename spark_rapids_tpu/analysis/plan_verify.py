@@ -29,6 +29,13 @@ STAGE    superstage carving contracts (compile/carve.py): stage
          boundaries coincide with exchanges, each lowered stage keeps
          at most one flush barrier, cancel checkpoints survive fusion,
          and sync-free flags only appear inside carved regions.
+FLUSH    static flush-budget prediction (analysis/flush_budget.py):
+         the warm per-collect device-round-trip count the plan will
+         cost, computed from compile/lower.py dispatch
+         classifications.  Advisory by default (the prediction rides
+         on the report for tools/report.py and the smoke cross-check);
+         fails only when ``spark.rapids.tpu.sql.planVerify.flushBudget``
+         sets a positive budget the prediction exceeds.
 
 Verification is permissive by design: unknown node classes pass, and a
 pass that cannot evaluate a property (e.g. an exotic node without the
@@ -47,6 +54,7 @@ DTYPE = "PV-DTYPE"
 PART = "PV-PART"
 CKPT = "PV-CKPT"
 STAGE = "PV-STAGE"
+FLUSH = "PV-FLUSH"
 
 
 class Violation:
@@ -97,6 +105,9 @@ class PlanVerificationReport:
         self.plan = plan
         self.violations = list(violations)
         self.by_node: Dict[int, List[Violation]] = {}
+        # FlushPrediction from the PV-FLUSH pass (None when the pass
+        # was skipped or the prediction itself failed)
+        self.flush_prediction = None
         for v in self.violations:
             self.by_node.setdefault(v.node_index, []).append(v)
 
@@ -504,6 +515,38 @@ def _check_superstages(nodes, out: List[Violation]):
 
 
 # ---------------------------------------------------------------------------
+# pass 6: static flush-budget prediction
+# ---------------------------------------------------------------------------
+
+def _check_flush_budget(plan, out: List[Violation]):
+    """Predict the warm flush count (analysis/flush_budget.py) and fail
+    only against an explicitly configured budget.  Returns the
+    prediction so the report can carry it (tools/report.py shows
+    predicted vs observed; ci/compile_smoke.py asserts equality)."""
+    from . import flush_budget
+    try:
+        pred = flush_budget.predict_flushes(plan)
+    except Exception as e:
+        out.append(Violation(
+            FLUSH, 0, plan.name,
+            f"flush prediction failed: {e!r}"))
+        return None
+    from ..config import get_active, PLAN_VERIFY_FLUSH_BUDGET
+    try:
+        budget = int(get_active().get(PLAN_VERIFY_FLUSH_BUDGET))
+    except Exception:
+        budget = 0
+    if budget > 0 and pred.warm > budget:
+        out.append(Violation(
+            FLUSH, 0, plan.name,
+            f"predicted warm flush count {pred.warm} exceeds the "
+            f"configured budget {budget}: "
+            + "; ".join(str(c) for c in pred.contributions
+                        if c.count)))
+    return pred
+
+
+# ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
 
@@ -513,10 +556,10 @@ def verify_plan(plan: PhysicalPlan,
     """Run the verifier passes over ``plan``; never raises.
 
     ``passes`` optionally restricts to a subset of
-    {SCHEMA, DTYPE, PART, CKPT, STAGE}."""
+    {SCHEMA, DTYPE, PART, CKPT, STAGE, FLUSH}."""
     nodes = _preorder(plan)
     run = set(passes) if passes is not None else \
-        {SCHEMA, DTYPE, PART, CKPT, STAGE}
+        {SCHEMA, DTYPE, PART, CKPT, STAGE, FLUSH}
     violations: List[Violation] = []
     if SCHEMA in run:
         _check_schema(nodes, violations)
@@ -528,7 +571,12 @@ def verify_plan(plan: PhysicalPlan,
         _check_checkpoints(nodes, violations)
     if STAGE in run:
         _check_superstages(nodes, violations)
-    return PlanVerificationReport(plan, violations)
+    prediction = None
+    if FLUSH in run:
+        prediction = _check_flush_budget(plan, violations)
+    report = PlanVerificationReport(plan, violations)
+    report.flush_prediction = prediction
+    return report
 
 
 def verify_or_raise(plan: PhysicalPlan,

@@ -236,6 +236,17 @@ class TpuSession:
                 if self._last_planner else []
         t0 = _time.perf_counter()
         self.last_physical_plan = phys
+        # static PV-FLUSH prediction, computed BEFORE any execution so
+        # the predicted-vs-observed comparison below cannot be informed
+        # by the run it predicts.  A predictor gap must never block a
+        # query: the comparison is observability, the exactness contract
+        # is enforced by ci/compile_smoke.py + tests/test_audit.py.
+        _flush_pred = None
+        try:
+            from ..analysis.flush_budget import predict_flushes
+            _flush_pred = predict_flushes(phys, conf=conf)
+        except Exception:  # noqa: BLE001 - observability only
+            pass
         sem = DeviceManager.get().semaphore
         sem.pop_wait_ns()                     # reset this thread's counter
         cat = BufferCatalog.get()
@@ -341,9 +352,15 @@ class TpuSession:
         # the service harvests this into the completed-outcome record
         # (service/metrics.py), like sem_wait_ms above
         observe("host_drop_tax_ms", net["host_drop_tax_ms"])
+        result_rows = sum(t.num_rows for t in tables)
+        predicted_flushes = None
+        if _flush_pred is not None:
+            predicted_flushes = _flush_pred.expected(result_rows)
+        self.last_query_predicted_flushes = predicted_flushes
         extra = {"sem_wait_ms": round(sem_wait_ms, 3),
                  "spill_bytes": int(spill_bytes),
                  "flushes": int(flushes),
+                 "predicted_flushes": predicted_flushes,
                  "inline_compile_ms": round(inline_compile_ms, 3),
                  "device_busy_ms": tl["busy_ms"],
                  "device_util_pct": tl["util_pct"],

@@ -98,6 +98,23 @@ PLAN_VERIFY = conf_bool(
     "failure with an annotated plan tree.  Forced on under pytest; "
     "default OFF in production to keep planning latency flat "
     "(reference: the tagging/validation passes of GpuOverrides)")
+PLAN_VERIFY_FLUSH_BUDGET = conf_int(
+    "spark.rapids.tpu.sql.planVerify.flushBudget", 0,
+    "When > 0, the PV-FLUSH verifier pass fails any plan whose "
+    "statically predicted warm flush count (analysis/flush_budget.py) "
+    "exceeds this many device round trips per collect.  0 keeps the "
+    "pass advisory: the prediction is still computed and surfaced "
+    "(tools/report.py, bench predicted_flushes) but never fails "
+    "verification")
+AUDIT_ENABLED = conf_bool(
+    "spark.rapids.tpu.analysis.audit.enabled", True,
+    "Enable the jaxpr program auditor (analysis/program_audit.py): "
+    "ci/audit.py and bench coverage reporting abstractly trace every "
+    "registered jitted program and enforce device-purity rules "
+    "AUD001-AUD004 (no host callbacks, no float primitives in exact "
+    "programs, no data-dependent shapes, fusion-breaker budgets).  "
+    "Disabling skips the audit sweep; it never affects query "
+    "execution")
 BATCH_SIZE_ROWS = conf_int(
     "spark.rapids.tpu.sql.batchSizeRows", 1 << 20,
     "Target rows per columnar batch (coalesce goal; reference: "

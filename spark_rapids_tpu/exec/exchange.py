@@ -232,6 +232,13 @@ class TpuShuffleExchange(TpuExec):
                     # outputs are registered in the shared tracker
                     self._shuffle_id = self._dist_shuffle_id
                 else:
+                    # the flush inside the map-side drain is the POINT
+                    # of this barrier: stage outputs must be on device
+                    # before any reduce pull proceeds, losers are
+                    # SUPPOSED to park until then, and device permits
+                    # are dropped for the whole region (above) so the
+                    # wait cannot deadlock the dispatch pool
+                    # lint: allow(LOCK003)
                     self._materialize_map_side()
                 self._materialized = True
 

@@ -214,3 +214,37 @@ class _TracedBatch(ColumnarBatch):
         self._rows = num_rows           # jnp scalar under trace
         self._rows_dev = num_rows
         self._capacity = capacity
+
+
+# ---------------------------------------------------------------------------
+# program audit registration (analysis/program_audit.py): the audited
+# object is the REAL cached program (wrap_miss + jit), traced over
+# representative avals — never a re-implementation.
+# ---------------------------------------------------------------------------
+
+def _audit_specs():
+    from ..analysis.program_audit import AuditSpec
+
+    def _build():
+        import jax
+        import numpy as np
+        from ..columnar.schema import Field, Schema
+        from ..expr.arithmetic import Add
+        schema = Schema([Field("a", T.INT64, True),
+                         Field("b", T.INT64, True)])
+        fe = FusedEval(
+            [Add(ec.BoundReference(0, T.INT64),
+                 ec.BoundReference(1, T.INT64))], schema)
+        assert fe.ok, "representative fused projection did not fuse"
+        cap = 64
+        d = jax.ShapeDtypeStruct((cap,), np.int64)
+        v = jax.ShapeDtypeStruct((cap,), np.bool_)
+        args = (cap, tuple(d for _ in fe.needed),
+                tuple(v for _ in fe.needed),
+                jax.ShapeDtypeStruct((), np.int32))
+        return fe._jitted, args, {"static_argnums": (0,)}
+
+    return [AuditSpec(
+        "fused_project", "fused_project", _build,
+        notes="int64 a+b projection over a 64-row bucket",
+        budgets={"gather": 2, "scatter": 2, "transpose": 2, "sort": 1})]

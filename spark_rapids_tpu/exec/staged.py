@@ -220,3 +220,40 @@ class TpuStagedCompute(TpuExec):
                 self.metrics[NUM_OUTPUT_BATCHES] += 1
                 yield out
         return [run(p) for p in self.children[0].execute()]
+
+
+# ---------------------------------------------------------------------------
+# program audit registration (analysis/program_audit.py)
+# ---------------------------------------------------------------------------
+
+def _audit_specs():
+    from ..analysis.program_audit import AuditSpec
+
+    def _build():
+        import numpy as np
+        from ..columnar.schema import Field
+        from ..expr.arithmetic import Add
+        from ..expr.predicates import GreaterThan
+        schema = Schema([Field("a", T.INT64, True),
+                         Field("b", T.INT64, True)])
+        pred = GreaterThan(ec.BoundReference(0, T.INT64), ec.lit(3))
+        proj = Add(ec.BoundReference(0, T.INT64),
+                   ec.BoundReference(1, T.INT64))
+        out_schema = Schema([Field("s", T.INT64, True)])
+        ops = [("filter", pred, schema), ("project", [proj], out_schema)]
+        assert ops_fusable(ops), "representative chain did not fuse"
+        st = object.__new__(TpuStagedCompute)
+        st.ops = ops
+        st.src_schema = schema
+        fn = st._jitted()
+        cap = 64
+        d = jax.ShapeDtypeStruct((cap,), np.int64)
+        v = jax.ShapeDtypeStruct((cap,), np.bool_)
+        args = (cap, (d, d), (v, v),
+                jax.ShapeDtypeStruct((), np.int32))
+        return fn, args, {"static_argnums": (0,)}
+
+    return [AuditSpec(
+        "staged_compute", "staged_compute", _build,
+        notes="filter(a>3) -> project(a+b) chain as one program",
+        budgets={"gather": 8, "scatter": 2, "transpose": 2, "sort": 2})]

@@ -1309,3 +1309,125 @@ class TpuHashAggregate(TpuExec):
         out_cols = [Column(f.dtype, d, v)
                     for f, (d, v) in zip(out_schema, pairs)]
         return ColumnarBatch(out_schema, out_cols, 1)
+
+
+# ---------------------------------------------------------------------------
+# program audit registration (analysis/program_audit.py): the three
+# hash_aggregate core sites (_fused_agg_core, _fused_whole_stage_core,
+# _global_agg) build their programs per-batch inside the exec, so each
+# provider DRIVES a tiny CPU batch through the real site and then pulls
+# the freshly cached core out of _CORE_CACHE for abstract tracing.
+# ---------------------------------------------------------------------------
+
+def _int_col(cap, fill=None):
+    data = jnp.arange(cap, dtype=jnp.int64) if fill is None \
+        else jnp.full((cap,), fill, jnp.int64)
+    return Column(T.INT64, data, jnp.ones((cap,), bool))
+
+
+def _audit_agg(group=True):
+    from ..expr import aggregates as ea
+    agg = object.__new__(TpuHashAggregate)
+    agg.aggs = [AggExpr(ea.Sum(ec.BoundReference(1 if group else 0,
+                                                 T.INT64)), "s")]
+    agg.group_exprs = [ec.BoundReference(0, T.INT64)] if group else []
+    agg.pre_ops = None
+    agg._ws_memo = {}
+    return agg
+
+
+def _cached_core(cache_key, what):
+    core = TpuHashAggregate._CORE_CACHE.get(cache_key)
+    if core is None or core is False:
+        raise RuntimeError(
+            f"audit drive did not populate the {what} core under the "
+            f"reconstructed cache key {cache_key!r}")
+    return core
+
+
+def _audit_specs():
+    import jax
+    import numpy as np
+    from ..analysis.program_audit import AuditSpec
+    from ..kernels.aggregate import _pair_sum_enabled
+
+    def _agg_sig(agg):
+        return tuple((type(a.func).__name__, repr(a.func),
+                      getattr(a.func, "ignore_nulls", None))
+                     for a in agg.aggs)
+
+    def _pair_sds(cap):
+        return (jax.ShapeDtypeStruct((cap,), np.int64),
+                jax.ShapeDtypeStruct((cap,), np.bool_))
+
+    def _grouped():
+        agg = _audit_agg()
+        cap = 16
+        key_col, val_col = _int_col(cap), _int_col(cap, 1)
+        schema = Schema([Field("k", T.INT64, True),
+                         Field("v", T.INT64, True)])
+        batch = ColumnarBatch(schema, [key_col, val_col], 8)
+        out = agg._fused_agg_core([key_col], [[val_col]], True, batch,
+                                  False)
+        assert out is not None, "grouped agg core fell back"
+        cache_key = (True, False, (T.INT64,), ((T.INT64,),), None,
+                     _pair_sum_enabled(), _agg_sig(agg))
+        core = _cached_core(cache_key, "grouped")
+        c = batch.capacity
+        args = ((_pair_sds(c),), (_pair_sds(c),),
+                jax.ShapeDtypeStruct((), np.int32))
+        return core, args, {}
+
+    def _whole_stage():
+        from ..expr.predicates import GreaterThan
+        agg = _audit_agg()
+        schema = Schema([Field("k", T.INT64, True),
+                         Field("v", T.INT64, True)])
+        agg.pre_ops = [("filter",
+                        GreaterThan(ec.BoundReference(1, T.INT64),
+                                    ec.lit(0)), schema)]
+        cap = 16
+        batch = ColumnarBatch(schema, [_int_col(cap), _int_col(cap, 1)],
+                              8)
+        out = agg._fused_whole_stage_core(batch, emit_buffers=True)
+        assert out is not None, "whole-stage agg core fell back"
+        mkey = tuple(f.dtype.name for f in batch.schema)
+        prep = agg._ws_memo[mkey]
+        cache_key = prep[0] + (True, None, _pair_sum_enabled())
+        core = _cached_core(cache_key, "whole-stage")
+        c = batch.capacity
+        d = jax.ShapeDtypeStruct((c,), np.int64)
+        v = jax.ShapeDtypeStruct((c,), np.bool_)
+        args = ((d, d), (v, v), jax.ShapeDtypeStruct((), np.int32))
+        return core, args, {}
+
+    def _global():
+        agg = _audit_agg(group=False)
+        agg.mode = PARTIAL
+        cap = 16
+        val_col = _int_col(cap, 1)
+        schema = Schema([Field("v", T.INT64, True)])
+        batch = ColumnarBatch(schema, [val_col], 8)
+        agg._global_agg(batch, [[val_col]], emit_buffers=False)
+        cache_key = ("global", True, True, ((T.INT64,),),
+                     batch.capacity, _pair_sum_enabled(), _agg_sig(agg))
+        core = _cached_core(cache_key, "global")
+        c = batch.capacity
+        args = ((_pair_sds(c),), jax.ShapeDtypeStruct((), np.int32))
+        return core, args, {}
+
+    return [
+        AuditSpec("hash_aggregate_grouped", "hash_aggregate", _grouped,
+                  notes="sum(v) group by k, update mode",
+                  budgets={"gather": 34, "scatter": 4, "transpose": 4,
+                           "sort": 6}),
+        AuditSpec("hash_aggregate_whole_stage", "hash_aggregate",
+                  _whole_stage,
+                  notes="filter(v>0) chain folded into sum(v) by k",
+                  budgets={"gather": 42, "scatter": 4, "transpose": 4,
+                           "sort": 8}),
+        AuditSpec("hash_aggregate_global", "hash_aggregate", _global,
+                  notes="global (no group keys) sum, partial mode",
+                  budgets={"gather": 30, "scatter": 4, "transpose": 4,
+                           "sort": 6}),
+    ]

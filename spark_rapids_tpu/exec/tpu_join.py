@@ -1040,3 +1040,81 @@ class TpuNestedLoopJoin(TpuExec):
                     n_un)
                 self.metrics[NUM_OUTPUT_ROWS] += out.rows_lazy
                 yield out
+
+
+# ---------------------------------------------------------------------------
+# program audit registration (analysis/program_audit.py): the probe and
+# speculative-probe programs build per (shape, dtype) signature inside
+# _run_partition, so each provider drives a tiny CPU build+probe and
+# pulls the freshly cached program for abstract tracing.
+# ---------------------------------------------------------------------------
+
+def _audit_specs():
+    import jax
+    from types import SimpleNamespace
+    from ..analysis.program_audit import AuditSpec
+
+    def _fixture():
+        cap = 16
+        sschema = Schema([Field("sk", T.INT64, True)])
+        bschema = Schema([Field("bk", T.INT64, True)])
+        j = object.__new__(TpuHashJoinBase)
+        j.logical = SimpleNamespace(
+            join_type="inner", condition=None,
+            schema=Schema(list(sschema.fields) + list(bschema.fields)))
+        j.build_right = True
+        bcol = Column(T.INT64, jnp.arange(cap, dtype=jnp.int64),
+                      jnp.ones((cap,), bool))
+        build = ColumnarBatch(bschema, [bcol], cap)
+        bt = join_k.build(_key_words([bcol], build.rows_dev, [None]))
+        scol = Column(T.INT64, jnp.arange(cap, dtype=jnp.int64),
+                      jnp.ones((cap,), bool))
+        sb = ColumnarBatch(sschema, [scol], cap)
+        return j, sb, scol, bt, build
+
+    def _sds_args(sb, bt):
+        import numpy as np
+        sws = tuple(jax.ShapeDtypeStruct(w.shape, w.dtype)
+                    for w in bt.sorted_words)
+        ka = ((jax.ShapeDtypeStruct((sb.capacity,), np.int64),
+               jax.ShapeDtypeStruct((sb.capacity,), np.bool_)),)
+        return sws, ka, jax.ShapeDtypeStruct((), np.int32)
+
+    def _probe_build():
+        j, sb, scol, bt, _build_b = _fixture()
+        out = j._probe_phase(sb, [scol], bt, [None], None, None)
+        assert out is not None, "probe phase fell back"
+        key = ("probe", "inner", (T.INT64.name,), sb.capacity,
+               bt.capacity, len(bt.sorted_words), True, False)
+        fn = TpuHashJoinBase._PROBE_JIT[key]
+        sws, ka, nr = _sds_args(sb, bt)
+        return fn, (sws, None, ka, nr), {}
+
+    def _spec_build():
+        import numpy as np
+        j, sb, scol, bt, build = _fixture()
+        out = j._spec_join_batch(sb, [scol], bt, build, None,
+                                 [ec.BoundReference(0, T.INT64)],
+                                 [None])
+        assert out is not None, "speculative join fell back"
+        key = ("spec", (T.INT64.name,), sb.capacity, bt.capacity,
+               len(bt.sorted_words), (T.INT64.name,), (T.INT64.name,),
+               (0,), (0,), True, False)
+        fn = TpuHashJoinBase._SPEC_JIT[key]
+        sws, ka, nr = _sds_args(sb, bt)
+        perm = jax.ShapeDtypeStruct(bt.perm.shape, bt.perm.dtype)
+        d = jax.ShapeDtypeStruct((sb.capacity,), np.int64)
+        v = jax.ShapeDtypeStruct((sb.capacity,), np.bool_)
+        args = (sws, None, ka, nr, perm, (d,), (v,), (d,), (v,))
+        return fn, args, {}
+
+    return [
+        AuditSpec("join_probe", "join_probe", _probe_build,
+                  notes="phase-A probe counts, inner join, int64 key",
+                  budgets={"gather": 16, "scatter": 2, "transpose": 2,
+                           "sort": 2}),
+        AuditSpec("join_spec_probe", "join_spec_probe", _spec_build,
+                  notes="speculative unique-match inner join program",
+                  budgets={"gather": 28, "scatter": 2, "transpose": 2,
+                           "sort": 2}),
+    ]

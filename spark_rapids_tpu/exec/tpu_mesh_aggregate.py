@@ -301,3 +301,41 @@ class TpuMeshAggregate(TpuExec):
                 self.metrics[NUM_OUTPUT_ROWS] += ng
                 yield ob
         return [run()]
+
+
+# ---------------------------------------------------------------------------
+# program audit registration (analysis/program_audit.py)
+# ---------------------------------------------------------------------------
+
+def _audit_specs():
+    from types import SimpleNamespace
+    from ..analysis.program_audit import AuditSpec
+
+    def _build():
+        import jax
+        import numpy as np
+        from ..expr import aggregates as ea
+        from ..expr import core as ec
+        from ..parallel.mesh import make_mesh
+        from ..plan.logical import AggExpr
+        # 2-device mesh: 1 device degenerates the splitter /
+        # routing structure (empty splitter gathers); the test harness
+        # and ci/audit.py force >=2 host devices via XLA_FLAGS
+        mesh = make_mesh(2)
+        a = object.__new__(TpuMeshAggregate)
+        a.logical = SimpleNamespace(
+            aggs=[AggExpr(ea.Sum(ec.BoundReference(1, T.INT64)), "s")])
+        fn = a._program(mesh, 1, (T.INT64,), (1,), (T.INT64,))
+        cap = 64
+        d = jax.ShapeDtypeStruct((cap,), np.int64)
+        v = jax.ShapeDtypeStruct((cap,), np.bool_)
+        # interleaved flat layout: (key data, key valid) per key, then
+        # (input data, input valid) per agg input, then live
+        args = (d, v, d, v, v)
+        return fn, args, {}
+
+    return [AuditSpec(
+        "mesh_aggregate", "mesh_aggregate", _build,
+        notes="2-device mesh, sum(v) group by one int64 key",
+        budgets={"gather": 50, "scatter": 18, "transpose": 4,
+                 "sort": 8})]

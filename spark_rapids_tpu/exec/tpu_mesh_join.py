@@ -385,3 +385,37 @@ class TpuMeshShuffledJoin(TpuExec):
                             if f.name in keep]
                     b = ColumnarBatch(out_schema, cols, b.rows_lazy)
                 yield b
+
+
+# ---------------------------------------------------------------------------
+# program audit registration (analysis/program_audit.py)
+# ---------------------------------------------------------------------------
+
+def _audit_specs():
+    from ..analysis.program_audit import AuditSpec
+
+    def _build():
+        import jax
+        import numpy as np
+        from ..parallel.mesh import make_mesh
+        # 2-device mesh: 1 device degenerates the splitter /
+        # routing structure (empty splitter gathers); the test harness
+        # and ci/audit.py force >=2 host devices via XLA_FLAGS
+        mesh = make_mesh(2)
+        j = object.__new__(TpuMeshShuffledJoin)
+        fn = j._program(mesh, "inner", (2,), (T.INT64,), (T.INT64,),
+                        True)
+        cap = 64
+        w = jax.ShapeDtypeStruct((cap,), np.uint64)
+        d = jax.ShapeDtypeStruct((cap,), np.int64)
+        v = jax.ShapeDtypeStruct((cap,), np.bool_)
+        # flat layout: lwords + l payload (data, valid) + l live, then
+        # the same for the right side
+        args = (w, w, d, v, v, w, w, d, v, v)
+        return fn, args, {}
+
+    return [AuditSpec(
+        "mesh_join", "mesh_join", _build,
+        notes="2-device mesh, inner join, one int64 payload per side",
+        budgets={"gather": 66, "scatter": 24, "transpose": 4,
+                 "sort": 8})]

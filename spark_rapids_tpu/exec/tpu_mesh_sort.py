@@ -247,3 +247,37 @@ class TpuMeshSort(TpuExec):
                 self.metrics[NUM_OUTPUT_ROWS] += nr
                 yield ob
         return [run()]
+
+
+# ---------------------------------------------------------------------------
+# program audit registration (analysis/program_audit.py)
+# ---------------------------------------------------------------------------
+
+def _audit_specs():
+    from ..analysis.program_audit import AuditSpec
+
+    def _build():
+        import jax
+        import numpy as np
+        from ..columnar import dtypes as T
+        from ..parallel.mesh import make_mesh
+        # 2-device mesh: 1 device degenerates the splitter /
+        # routing structure (empty splitter gathers); the test harness
+        # and ci/audit.py force >=2 host devices via XLA_FLAGS
+        mesh = make_mesh(2)
+        s = object.__new__(TpuMeshSort)
+        fn = s._program(mesh, 1, (T.INT64,), (T.INT64,), (False,),
+                        (False,))
+        cap = 64
+        d = jax.ShapeDtypeStruct((cap,), np.int64)
+        v = jax.ShapeDtypeStruct((cap,), np.bool_)
+        # flat layout: key datas, key valids, payload datas, payload
+        # valids, live
+        args = (d, v, d, v, v)
+        return fn, args, {}
+
+    return [AuditSpec(
+        "mesh_sort", "mesh_sort", _build,
+        notes="2-device mesh, one int64 asc key, one int64 payload",
+        budgets={"gather": 52, "scatter": 12, "transpose": 4,
+                 "sort": 14})]

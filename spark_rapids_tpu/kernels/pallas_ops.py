@@ -254,3 +254,28 @@ def table_reduce(bucket, sum_rows, max_rows, table: int,
     maxs = [jax.ops.segment_max(r, bucket, num_segments=table + 1)[:table]
             for r in max_rows]
     return sums, maxs
+
+
+# ---------------------------------------------------------------------------
+# program audit registration (analysis/program_audit.py)
+# ---------------------------------------------------------------------------
+
+def _audit_specs():
+    from ..analysis.program_audit import AuditSpec
+
+    def _build():
+        import jax
+        import numpy as np
+        key = (1, 8)
+        fn = _KERNEL_CACHE.get(key)
+        if fn is None:
+            fn = _compile_watch.wrap_miss(
+                "pallas_hash_partition", _make_kernel(*key), str(key))
+            _KERNEL_CACHE[key] = fn
+        args = (jax.ShapeDtypeStruct((256,), np.uint64),)
+        return fn, args, {}
+
+    return [AuditSpec(
+        "pallas_hash_partition", "pallas_hash_partition", _build,
+        notes="1 key word -> 8 partitions over a 256-row block",
+        budgets={"gather": 2, "scatter": 2, "transpose": 2, "sort": 1})]

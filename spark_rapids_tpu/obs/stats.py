@@ -522,3 +522,31 @@ def build_profile(phys, query_id=None, flushes: Optional[int] = None,
         "superstages": stages,
         "dispatches": _profile.dispatch_summary(dispatch_marker),
     })
+
+
+# ---------------------------------------------------------------------------
+# program audit registration (analysis/program_audit.py): exact=False —
+# the stats program intentionally uses float log2 for the distinct-
+# count sketch; it produces observability estimates, never query data.
+# ---------------------------------------------------------------------------
+
+def _audit_specs():
+    from ..analysis.program_audit import AuditSpec
+
+    def _build():
+        import jax
+        import numpy as np
+        cap = 128
+        args = (jax.ShapeDtypeStruct((cap,), np.uint64),
+                jax.ShapeDtypeStruct((cap,), np.int32),
+                jax.ShapeDtypeStruct((cap,), np.bool_),
+                jax.ShapeDtypeStruct((cap,), np.uint64),
+                jax.ShapeDtypeStruct((), np.int32),
+                4, 64)
+        return _stats_prog, args, {"static_argnums": (5, 6)}
+
+    return [AuditSpec(
+        "exchange_stats", "exchange_stats", _build, exact=False,
+        notes="exchange-boundary stats sketch (float log2 is "
+              "intentional: estimates, not query data)",
+        budgets={"gather": 4, "scatter": 8, "transpose": 2, "sort": 2})]

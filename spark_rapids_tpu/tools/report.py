@@ -373,6 +373,15 @@ def render_query_report(query_id, story: Dict,
             # device round trips this query — THE cost model on
             # remote-dispatch backends (columnar/pending.py)
             head += f" flushes={rec.get('flushes')}"
+        pred = rec.get("predicted_flushes")
+        if pred is not None:
+            head += f" predicted_flushes={pred}"
+            if rec.get("flushes") is not None and \
+                    pred != rec.get("flushes"):
+                # the static PV-FLUSH model disagreed with the runtime
+                # counter — either the plan dispatched an unmodeled
+                # barrier or the predictor regressed; both are bugs
+                head += " [!! PV-FLUSH mismatch]"
         if rec.get("inline_compile_ms") is not None:
             head += (f" inline_compile_ms="
                      f"{rec.get('inline_compile_ms')}")

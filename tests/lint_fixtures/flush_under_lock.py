@@ -1,0 +1,30 @@
+"""Seeded LOCK003 fixture — ``ci/lint.py`` must exit NONZERO.
+
+A pending-pool device flush performed while holding a lock, both
+directly (``pending.flush()`` in the critical section) and through a
+same-file helper whose body reaches the flush.  Never imported by the
+engine; exists only so the lint self-tests can prove the analyzer
+fires on both shapes.
+"""
+import threading
+
+from spark_rapids_tpu.columnar import pending
+
+state_lock = threading.Lock()
+
+
+def direct_flush_under_lock():
+    with state_lock:
+        pending.flush()            # LOCK003: device barrier under lock
+        return 1
+
+
+def _drain_helper():
+    # the helper itself is lock-free; calling it under a lock is not
+    pending.flush()
+
+
+def indirect_flush_under_lock():
+    with state_lock:
+        _drain_helper()            # LOCK003: helper reaches the flush
+        return 2

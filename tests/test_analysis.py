@@ -306,6 +306,49 @@ class TestLint:
             "        return later\n")
         assert AL.lint_source(src, "x.py") == []
 
+    def test_flush_under_lock_direct(self):
+        src = (
+            "import threading\n"
+            "from spark_rapids_tpu.columnar import pending\n"
+            "_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        pending.flush()\n")
+        fs = AL.lint_source(src, "x.py")
+        assert any(f.rule == AL.LOCK003 and "flush" in f.message
+                   for f in fs)
+
+    def test_flush_under_lock_via_helper(self):
+        src = (
+            "import threading\n"
+            "from spark_rapids_tpu.columnar import pending\n"
+            "_lock = threading.Lock()\n"
+            "def drain():\n"
+            "    pending.flush()\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        drain()\n")
+        fs = AL.lint_source(src, "x.py")
+        assert any(f.rule == AL.LOCK003 and "drain" in f.message
+                   for f in fs)
+
+    def test_file_flush_not_flagged(self):
+        # file-handle / trace-buffer flushes are not device barriers
+        src = (
+            "import threading\n"
+            "_lock = threading.Lock()\n"
+            "def f(fh):\n"
+            "    with _lock:\n"
+            "        fh.flush()\n")
+        assert AL.lint_source(src, "x.py") == []
+
+    def test_flush_outside_lock_not_flagged(self):
+        src = (
+            "from spark_rapids_tpu.columnar import pending\n"
+            "def f():\n"
+            "    pending.flush()\n")
+        assert AL.lint_source(src, "x.py") == []
+
     def test_host_sync_in_kernel_scope(self):
         src = ("import jax, numpy as np\n"
                "def k(x):\n"
@@ -474,7 +517,7 @@ def _cli():
 class TestCliAndProject:
     @pytest.mark.parametrize("fixture", [
         "lock_inversion.py", "host_sync_kernel.py", "bad_hygiene.py",
-        "flight_alloc.py", "superstage_sync.py"])
+        "flight_alloc.py", "superstage_sync.py", "flush_under_lock.py"])
     def test_cli_nonzero_on_each_seeded_fixture(self, fixture, capsys):
         assert _cli().main([os.path.join(FIXTURES, fixture)]) == 1
         out = capsys.readouterr().out

@@ -1,0 +1,243 @@
+"""Program-audit tests (analysis/program_audit.py + PV-FLUSH runtime
+cross-check).
+
+Four surfaces:
+
+1. Rule unit contract — each seeded negative spec trips exactly its
+   rule; clean integer programs pass; ``exact=False`` admits float
+   math; spec-level ``# audit: allow(RULE)`` suppresses.
+2. Jaxpr recursion — defects hidden inside ``lax.scan`` / ``lax.cond``
+   bodies and nested ``jit`` (pjit) calls are still found.
+3. Coverage contract — every REQUIRED_PROGRAMS entry has a registered
+   spec, and the shipped program surface audits clean end to end (the
+   same gate ``ci/audit.py`` runs).
+4. PV-FLUSH vs runtime — the static warm-flush prediction equals the
+   runtime ``pending.FLUSH_COUNT`` delta EXACTLY on the TPC-DS quartet
+   with superstage on and off, and the prediction is invariant under
+   pipeline parallelism (dispatch structure is a plan property, not a
+   scheduling property).
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+import tpcds  # noqa: E402
+
+from harness import with_tpu_session  # noqa: E402
+
+from spark_rapids_tpu.analysis import predict_flushes
+from spark_rapids_tpu.analysis import program_audit as PA
+from spark_rapids_tpu.columnar import pending
+
+QUARTET = ("q3", "q42", "q52", "q96")
+
+
+def _spec(name, build, exact=True, budgets=None):
+    return PA.AuditSpec(name, name, build, exact=exact, budgets=budgets)
+
+
+def _i64(n=8):
+    return jax.ShapeDtypeStruct((n,), np.int64)
+
+
+# ---------------------------------------------------------------------------
+# 1. rule unit contract
+# ---------------------------------------------------------------------------
+
+class TestRules:
+    @pytest.mark.parametrize("rule", sorted(PA.ALL_RULES))
+    def test_seeded_negative_trips_exactly_its_rule(self, rule):
+        spec = PA.seeded_negative_specs()[rule]
+        findings, _census = PA.audit_spec(spec)
+        assert {f.rule for f in findings} == {rule}, findings
+        assert all(spec.name in f.message for f in findings)
+
+    def test_clean_integer_program_passes(self):
+        def build():
+            def f(x):
+                return x * 2 + 1
+            return f, (_i64(),), {}
+        findings, census = PA.audit_spec(_spec("clean", build))
+        assert findings == []
+        assert census == {}
+
+    def test_float_math_admitted_when_exact_false(self):
+        def build():
+            def f(x):
+                return (x.astype(jnp.float32) * 0.5).astype(jnp.int64)
+            return f, (_i64(),), {}
+        findings, _ = PA.audit_spec(_spec("f32", build, exact=False))
+        assert findings == []
+
+    def test_budget_at_exact_count_passes(self):
+        def build():
+            def f(x, idx):
+                return jnp.take(x, idx)
+            return f, (_i64(), jax.ShapeDtypeStruct((4,), np.int32)), {}
+        findings, census = PA.audit_spec(
+            _spec("one_gather", build, budgets={"gather": 1}))
+        assert findings == []
+        assert census.get("gather") == 1
+
+    def test_build_failure_is_loud_not_clean(self):
+        def build():
+            raise RuntimeError("provider broke")
+        with pytest.raises(PA.AuditBuildError):
+            PA.audit_spec(_spec("broken", build))
+
+
+# ---------------------------------------------------------------------------
+# 2. recursion into scan / cond / pjit sub-jaxprs
+# ---------------------------------------------------------------------------
+
+class TestRecursion:
+    def test_float_inside_scan_body_found(self):
+        def build():
+            def f(x):
+                def body(carry, t):
+                    y = (t.astype(jnp.float32) * 2.0).astype(jnp.int64)
+                    return carry + y, y
+                total, _ = jax.lax.scan(body, jnp.int64(0), x)
+                return total
+            return f, (_i64(),), {}
+        findings, _ = PA.audit_spec(_spec("scan_f32", build))
+        assert any(f.rule == PA.AUD002 for f in findings)
+
+    def test_float_inside_cond_branch_found(self):
+        def build():
+            def f(x):
+                return jax.lax.cond(
+                    x[0] > 0,
+                    lambda v: (v.astype(jnp.float64) + 0.5)
+                    .astype(jnp.int64),
+                    lambda v: v,
+                    x)
+            return f, (_i64(),), {}
+        findings, _ = PA.audit_spec(_spec("cond_f64", build))
+        assert any(f.rule == PA.AUD002 for f in findings)
+
+    def test_callback_inside_nested_jit_found(self):
+        def build():
+            @jax.jit
+            def inner(x):
+                return jax.pure_callback(
+                    lambda v: np.asarray(v),
+                    jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+            def f(x):
+                return inner(x) + 1
+            return f, (_i64(),), {}
+        findings, _ = PA.audit_spec(_spec("pjit_cb", build))
+        assert any(f.rule == PA.AUD001 for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+class TestSuppressions:
+    def test_spec_level_allow_suppresses(self):
+        def build():
+            def f(x):
+                return (x.astype(jnp.float32) * 2.0).astype(jnp.int64)
+            return f, (_i64(),), {}
+        spec = PA.AuditSpec("sup", "sup", build)  # audit: allow(AUD002)
+        assert PA.spec_allowed_rules(spec) == {PA.AUD002}
+        findings, _ = PA.audit_spec(spec)
+        assert findings == []
+
+    def test_allow_does_not_leak_to_other_rules(self):
+        def build():
+            def f(x):
+                return jax.pure_callback(
+                    lambda v: np.asarray(v),
+                    jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+            return f, (_i64(),), {}
+        spec = PA.AuditSpec("sup2", "sup2", build)  # audit: allow(AUD002)
+        findings, _ = PA.audit_spec(spec)
+        assert any(f.rule == PA.AUD001 for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# 3. coverage contract + the shipped surface audits clean
+# ---------------------------------------------------------------------------
+
+class TestCoverage:
+    def test_every_required_program_has_a_spec(self):
+        specs = PA.collect_specs()
+        assert PA.coverage_gaps(specs) == []
+        assert PA.REQUIRED_PROGRAMS <= {s.name for s in specs}
+
+    def test_shipped_programs_audit_clean(self):
+        report = PA.audit_all()
+        assert report.ok, "\n".join(str(f) for f in report.findings)
+        assert set(report.audited) >= PA.REQUIRED_PROGRAMS
+        # the stats program is the one sanctioned float surface
+        exact = {s.name: s.exact for s in PA.collect_specs()}
+        assert exact["exchange_stats"] is False
+        assert all(v for k, v in exact.items() if k != "exchange_stats")
+
+
+# ---------------------------------------------------------------------------
+# 4. PV-FLUSH prediction == runtime FLUSH_COUNT delta
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tpcds_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpcds_audit") / "sf")
+    tpcds.generate(d, scale=0.002, seed=11)
+    return d
+
+
+def _predicted_and_observed(tpcds_dir, query, conf):
+    def fn(s):
+        tpcds.register(s, tpcds_dir)
+        sql = tpcds.QUERIES[query]
+        phys = s._plan(s.sql(sql)._plan)
+        pred = predict_flushes(phys, conf=s.conf)
+        s.sql(sql).collect()               # warm (compile caches)
+        f0 = pending.FLUSH_COUNT
+        rows = s.sql(sql).collect()
+        return pred.expected(len(rows)), pending.FLUSH_COUNT - f0
+    return with_tpu_session(fn, conf)
+
+
+class TestFlushPredictionMatchesRuntime:
+    @pytest.mark.parametrize("superstage", [True, False])
+    def test_q42_prediction_exact(self, tpcds_dir, superstage):
+        pred, obs = _predicted_and_observed(
+            tpcds_dir, "q42",
+            {"spark.rapids.tpu.sql.superstage": superstage})
+        assert pred == obs, (pred, obs)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("query", QUARTET)
+    @pytest.mark.parametrize("superstage", [True, False])
+    def test_quartet_prediction_exact(self, tpcds_dir, query,
+                                      superstage):
+        pred, obs = _predicted_and_observed(
+            tpcds_dir, query,
+            {"spark.rapids.tpu.sql.superstage": superstage})
+        assert pred == obs, (query, superstage, pred, obs)
+
+    @pytest.mark.parametrize("superstage", [True, False])
+    def test_prediction_invariant_under_parallelism(self, tpcds_dir,
+                                                    superstage):
+        def predict(par):
+            def fn(s):
+                tpcds.register(s, tpcds_dir)
+                phys = s._plan(s.sql(tpcds.QUERIES["q3"])._plan)
+                return predict_flushes(phys, conf=s.conf).warm
+            return with_tpu_session(fn, {
+                "spark.rapids.tpu.sql.superstage": superstage,
+                "spark.rapids.tpu.exec.pipelineParallelism": par,
+                "spark.rapids.tpu.exec.pipelinePrefetchDepth": par,
+            })
+        assert predict(1) == predict(4)
