@@ -50,6 +50,15 @@ collection disabled and stats_overhead_pct reports the on/off overhead
 (budget: <= 2%, asserted by ci/stats_smoke.py with a loose bound).
 dispatch_p50_ms / dispatch_p95_ms are the warm query's device-dispatch
 duration percentiles from the StatsProfile's "all" roll-up.
+
+Memory split: since r11 the memory plane (obs/memplane.py,
+spark.rapids.tpu.obs.mem.*) prices every tier move the catalog makes.
+peak_device_bytes is the headline session's device-byte peak (set by
+the cold warmup run — warm reruns free their buffers and do not
+advance it), spill_ms the active spill time inside the warm window, and
+spill_tax_pct the share of the headline wall spent moving buffers
+between tiers (spill + unspill) — 0.0 on a bench host whose budget
+fits the working set, which is itself the claim the key documents.
 """
 import json
 import sys
@@ -90,6 +99,7 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
                stats: bool = True):
     from spark_rapids_tpu.api import TpuSession
     from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.obs import memplane as _memplane
     # tuned like the reference's benchmark guides tune Spark: large
     # scan batches keep the per-batch fixed costs (dispatch + transfer
     # round trips) amortized on the accelerator
@@ -137,6 +147,12 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
             "inline_compile_ms": getattr(
                 s, "last_query_inline_compile_ms", None),
             "netplane": getattr(s, "last_query_netplane", None),
+            # memory plane (obs/memplane.py): the same warm query's
+            # spill-pricing roll-up, plus the session's device-byte
+            # peak (warm reruns do not advance the peak themselves —
+            # the cold warmup run is what set it)
+            "memplane": getattr(s, "last_query_memplane", None),
+            "mem_peak_bytes": _memplane.stats_section()["peak"]["bytes"],
             # static PV-FLUSH prediction for the same warm query
             # (analysis/flush_budget.py — must equal `flushes`)
             "predicted_flushes": getattr(
@@ -216,6 +232,9 @@ def main():
     disp = (tpu_prof or {}).get("dispatches", {}).get("all", {})
     tl = tpu_perf.get("timeline") or {}
     net = tpu_perf.get("netplane") or {}
+    mem = tpu_perf.get("memplane") or {}
+    tier_ms = (mem.get("spill_ms") or 0.0) + (mem.get("unspill_ms")
+                                              or 0.0)
     print(json.dumps({
         "metric": "sql_pipeline_throughput",
         "value": round(n_rows / tpu_exact_t / 1e6, 3),
@@ -269,6 +288,12 @@ def main():
         "host_drop_tax_ms": net.get("host_drop_tax_ms"),
         "shuffle_wire_MBps": net.get("wire_MBps"),
         "shuffle_edge_skew": net.get("edge_skew"),
+        # memory plane (obs/memplane.py): the warm headline query's
+        # device-byte peak and the share of its wall spent moving
+        # buffers between tiers (spill + unspill active ms)
+        "peak_device_bytes": tpu_perf.get("mem_peak_bytes"),
+        "spill_ms": mem.get("spill_ms"),
+        "spill_tax_pct": round(tier_ms / (tpu_exact_t * 1000) * 100, 2),
     }))
 
 

@@ -7,7 +7,11 @@ thread stacks, arena map — that tools/diagnose.py renders, and the
 failure event-log record links it, (5) a multi-partition shuffle
 populates the transport plane (obs/netplane.py): nonzero edge matrix,
 host-drop phases summing to the exchange wall, and a real TCP fetch
-whose client/server spans join on span_id in the same trace.
+whose client/server spans join on span_id in the same trace, (6) the
+memory plane (obs/memplane.py) attributes every live device byte to an
+owner, prices forced tier moves in a ledger whose totals equal the
+catalog's own spill counters, and surfaces it all through
+Service.stats(), Prometheus, the event log, and the report tool.
 """
 import json
 import os
@@ -163,6 +167,11 @@ def main():
                    "tpu_shuffle_conn_events_total",
                    "tpu_shuffle_edges_tracked",
                    "tpu_shuffle_pending_fetches",
+                   'tpu_mem_live_bytes{site="exchange"}',
+                   "tpu_mem_headroom_bytes",
+                   "tpu_mem_pinned_bytes",
+                   "tpu_mem_spillable_bytes",
+                   "tpu_mem_leaked_entries_total",
                    'tpu_service_queries_total{event="completed"}'):
         assert series in metrics, f"missing series {series}"
     print("prometheus OK:", len(metrics.splitlines()), "lines")
@@ -200,14 +209,76 @@ def main():
           f"host_drop_tax_ms={net['host_drop']['host_drop_tax_ms']}, "
           f"wire_bytes={net['wire_bytes']}")
 
+    # 2c. memory plane (obs/memplane.py): the service snapshot carries
+    #     the memory section, the engine record the full per-query
+    #     roll-up (registrations attributed by site with zero leaks),
+    #     and every admission logged a headroom forecast
+    mem = snap["memory"]
+    assert mem["enabled"], mem
+    assert mem["spill_skipped"] >= 0 and "headroom" in mem, mem
+    assert mem["headroom"]["device_limit"] > 0, mem["headroom"]
+    em = engine[0]["memplane"]
+    assert em["registered"]["count"] > 0, em
+    assert any(r["site"] == "exchange"
+               for r in em["registered"]["by_site"]), em["registered"]
+    assert em["peak_device_bytes"] > 0 and em["peak_advanced"], em
+    assert sum(em["peak_by_site"].values()) == em["peak_device_bytes"]
+    assert engine[0]["peak_device_bytes"] == em["peak_device_bytes"]
+    assert em["leaked_entries"] == 0, em
+    assert all("spill_ms" in r and "unspill_count" in r
+               for r in completed), completed
+    admitted = [r for r in _rel(log_path, events="admitted")]
+    assert admitted and all(
+        "headroom_bytes" in r and "forecast_fits" in r
+        for r in admitted), admitted
+    # forced tier moves on a deliberately tiny budget: the priced
+    # ledger must balance against the catalog's own spill counters
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch as _CB
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+    from spark_rapids_tpu.memory.spillable import SpillableBatch
+    from spark_rapids_tpu.obs import memplane as _memplane
+    from spark_rapids_tpu.service.cancellation import (CancelToken,
+                                                       query_context)
+    cat = BufferCatalog.reset(spill_dir=os.path.join(td, "spill"),
+                              device_limit=16 * 1024)
+    with query_context(CancelToken("mem-smoke", None)):
+        handles = [SpillableBatch(_CB.from_pydict(
+            {"a": list(range(512))}), op="SmokeOp", site="operator")
+            for _ in range(3)]
+    view = _memplane.owners()
+    assert view["device_bytes"] == cat.device_bytes > 0, view
+    assert sum(r["bytes"] for r in view["owners"]) == cat.device_bytes
+    assert all(r["query_id"] == "mem-smoke" for r in view["owners"])
+    cat.spill_device_to_fit(cat.device_limit, reason="pressure")
+    rows = _memplane.ledger()
+    assert rows, "forced budget produced no ledger records"
+    d2h = sum(r["nbytes"] for r in rows
+              if r["direction"] == "device_to_host")
+    assert d2h == cat.spilled_device_to_host > 0, (d2h, rows)
+    # the histogram family only emits buckets once a spill is priced —
+    # so this series is asserted here, after the forced tier moves
+    from spark_rapids_tpu.obs.prom import render_text
+    from spark_rapids_tpu.obs.registry import get_registry
+    assert "tpu_mem_spill_seconds_bucket" in render_text(get_registry())
+    for h in handles:
+        h.close()
+    assert _memplane.leak_check("mem-smoke") == []
+    BufferCatalog.reset()          # restore default budgets
+    print(f"memory plane OK: peak={em['peak_device_bytes']}B, "
+          f"admissions forecast={len(admitted)}, "
+          f"ledger d2h={d2h}B")
+
     # 3. report tool renders the joined story
     from spark_rapids_tpu.tools.report import main as report_main
     assert report_main([log_path, "--trace", trace_path, "--shuffle",
+                        "--memory",
                         "--html", os.path.join(td, "report.html")]) == 0
     html = open(os.path.join(td, "report.html")).read()
     assert "plan + time shares" in html
     assert "shuffle transport (netplane)" in html
     assert "top edges (map" in html      # "->" is HTML-escaped
+    assert "HBM memory (memplane)" in html
+    assert "peak_device_bytes=" in html
     print("report OK")
 
     # 4. the forced failure produced one diagnostic bundle with the

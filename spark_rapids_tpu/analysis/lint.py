@@ -715,15 +715,16 @@ def _scopes_for(rel: str) -> Set[str]:
             base.startswith("tpu_") or \
             base in ("pipeline.py", "superstage.py", "exchange.py",
                      "stats.py", "profile.py", "timeline.py",
-                     "compile_watch.py", "slo.py", "netplane.py"):
+                     "compile_watch.py", "slo.py", "netplane.py",
+                     "memplane.py"):
         # the superstage compiler exists to ELIMINATE host round trips:
         # a stray device_get/np.asarray in compile/ or the wrapper
         # would silently reintroduce the cost it removes; the stats
         # plane (obs/stats.py, obs/profile.py), the performance plane
         # (obs/timeline.py, obs/compile_watch.py, obs/slo.py), the
-        # transport plane (obs/netplane.py) and their exchange call
-        # sites carry the same zero-flush + allocation-free-record
-        # contract
+        # transport plane (obs/netplane.py), the memory plane
+        # (obs/memplane.py) and their exchange call sites carry the
+        # same zero-flush + allocation-free-record contract
         scopes |= {SYNC001, OBS002}
     if "obs" in parts:
         scopes |= {HYG002}

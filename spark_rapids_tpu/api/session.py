@@ -257,6 +257,7 @@ class TpuSession:
         # flush budget is benchmarked)
         from ..columnar import pending
         from ..obs import compile_watch as _cwatch
+        from ..obs import memplane as _memplane
         from ..obs import netplane as _netplane
         from ..obs import profile as _profile
         from ..obs import stats as _stats
@@ -264,6 +265,7 @@ class TpuSession:
         flushes0 = pending.FLUSH_COUNT
         disp_marker = _profile.begin_query()
         np_marker = _netplane.begin_query()
+        mem_marker = _memplane.begin_query()
         # performance-plane windows: compile ns + busy intervals are
         # process-wide counters deltaed around this execution (the
         # FLUSH_COUNT discipline — exact when queries run serially)
@@ -352,6 +354,24 @@ class TpuSession:
         # the service harvests this into the completed-outcome record
         # (service/metrics.py), like sem_wait_ms above
         observe("host_drop_tax_ms", net["host_drop_tax_ms"])
+        # retention check (obs/memplane.py): anything still owned by
+        # this query past the shuffle release above that is not an
+        # expected survivor (scan cache, shuffle materializations a
+        # live reader may still fetch) leaked its registration
+        leaks = []
+        if token is not None and _memplane.is_enabled():
+            from ..shuffle.manager import live_spill_buffer_ids
+            leaks = _memplane.leak_check(
+                token.query_id, survivors=live_spill_buffer_ids())
+        # memory roll-up for this query's window: peak + owner set at
+        # peak, per-direction spill totals, the ledger slice
+        mem = _memplane.query_summary(mem_marker)
+        if leaks:
+            mem["leaks"] = leaks
+        self.last_query_memplane = mem
+        observe("spill_ms", mem["spill_ms"])
+        observe("unspill_count", mem["unspill_count"])
+        observe("leaked_entries", mem["leaked_entries"])
         result_rows = sum(t.num_rows for t in tables)
         predicted_flushes = None
         if _flush_pred is not None:
@@ -366,7 +386,12 @@ class TpuSession:
                  "device_util_pct": tl["util_pct"],
                  "util_gap_breakdown": tl["gaps"],
                  "host_drop_tax_ms": net["host_drop_tax_ms"],
-                 "shuffle_netplane": net}
+                 "shuffle_netplane": net,
+                 "peak_device_bytes": mem["peak_device_bytes"],
+                 "spill_ms": mem["spill_ms"],
+                 "unspill_count": mem["unspill_count"],
+                 "leaked_entries": mem["leaked_entries"],
+                 "memplane": mem}
         compiles = _cwatch.records_since(cw_marker)
         if compiles:
             extra["compiles"] = [

@@ -573,6 +573,24 @@ OBS_NET_MAX_INTERVALS = conf_int(
     "Bound on buffered host-drop work windows (the shuffle_host "
     "timeline evidence) and per-block edge-log entries; past it new "
     "records are dropped, keeping netplane memory fixed")
+OBS_MEM_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.mem.enabled", True,
+    "HBM memory observability plane (obs/memplane.py): allocation "
+    "provenance on every BufferCatalog registration (owner query_id, "
+    "operator, site) with per-owner live-byte decomposition summing "
+    "exactly to device_bytes and peak attribution, a spill ledger "
+    "pricing every tier move (victim, owner, trigger reason, victim "
+    "rank, serialize/deserialize duration — fed to the utilization "
+    "timeline as the mem_spill gap cause), retention/leak detection "
+    "at query terminal states, and headroom forecasting for the "
+    "admission path.  Host-side timestamps only: zero extra device "
+    "flushes by construction")
+OBS_MEM_MAX_LEDGER = conf_int(
+    "spark.rapids.tpu.obs.mem.maxLedger", 1 << 16,
+    "Bound on retained spill-ledger records and on buffered spill "
+    "work windows (the mem_spill timeline evidence); past it new "
+    "records are dropped and counted in tpu_mem_ledger_dropped_total "
+    "(fixed memory — the flight-recorder discipline)")
 SUPERSTAGE = conf_bool(
     "spark.rapids.tpu.sql.superstage", True,
     "Superstage compiler (compile/): a planner post-pass after the "

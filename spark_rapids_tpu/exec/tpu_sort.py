@@ -128,7 +128,8 @@ class TpuSort(TpuExec):
                     sorted_run = self._sort_batch(b)
                     n = int(sorted_run.num_rows)
                 DeviceManager.get().reserve(sorted_run.nbytes())
-                runs.append((SpillableBatch(sorted_run), n))
+                runs.append((SpillableBatch(sorted_run, op="TpuSortExec",
+                                            site="operator"), n))
                 total += n
             if not runs:
                 return

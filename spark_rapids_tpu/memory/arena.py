@@ -242,4 +242,6 @@ class DeviceManager:
         needed (the DeviceMemoryEventHandler.onAllocFailure contract)."""
         cat = self.catalog
         if cat.device_bytes + nbytes > cat.device_limit:
-            cat.spill_device_to_fit(nbytes)
+            from ..obs import memplane as _memplane
+            cat.spill_device_to_fit(nbytes,
+                                    reason=_memplane.REASON_BUDGET)

@@ -18,12 +18,14 @@ import enum
 import os
 import pickle
 import threading
+import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..obs import flight as _flight
+from ..obs import memplane as _memplane
 from ..obs import trace as _trace
 
 
@@ -55,6 +57,13 @@ class BufferEntry:
     # decompressed .raw cache for repeated acquire_slice over a
     # compressed DISK entry (cleared on any tier change)
     raw_cache: Optional[bytes] = None
+    # allocation provenance (obs/memplane.py): the query that owned the
+    # registration, the operator class and site it came from, and the
+    # registration call-site tag the leak report prints
+    owner_query: Optional[str] = None
+    owner_op: str = ""
+    owner_site: str = _memplane.SITE_OTHER
+    owner_tag: str = ""
 
 
 class BufferCatalog:
@@ -100,27 +109,39 @@ class BufferCatalog:
     @classmethod
     def reset(cls, **kwargs) -> "BufferCatalog":
         cls._instance = BufferCatalog(**kwargs)
+        # the plane's incremental decomposition mirrors THIS catalog's
+        # entries; a new epoch starts both from zero (otherwise stale
+        # owner bytes would survive the reset and the per-site gauges
+        # would stop summing to device_bytes)
+        _memplane.reset()
         return cls._instance
 
     # ------------------------------------------------------------------
     def register(self, device_obj, nbytes: int,
-                 priority: int = ACTIVE_BATCH_PRIORITY) -> str:
+                 priority: int = ACTIVE_BATCH_PRIORITY,
+                 op: str = "", site: str = _memplane.SITE_OTHER) -> str:
         buffer_id = uuid.uuid4().hex
+        # attribute the buffer to the active query (if any) so a
+        # cancelled/failed query's leftover registrations can be
+        # unwound by the service (unregister of an already-released id
+        # is a no-op, so double-accounting is harmless) — and so the
+        # memory plane can decompose live bytes per owner
+        from ..service.cancellation import current_token
+        tok = current_token()
+        owner_query = tok.query_id if tok is not None else None
+        tag = _memplane.call_tag()
         with self._lock:
             if buffer_id in self._entries:
                 raise ValueError(f"duplicate buffer {buffer_id}")
             self._entries[buffer_id] = BufferEntry(
                 buffer_id, StorageTier.DEVICE, nbytes, priority,
-                device_obj=device_obj)
+                device_obj=device_obj, owner_query=owner_query,
+                owner_op=op, owner_site=site, owner_tag=tag)
             self.device_bytes += nbytes
             if self.device_bytes > self.device_peak_bytes:
                 self.device_peak_bytes = self.device_bytes
-        # attribute the buffer to the active query (if any) so a
-        # cancelled/failed query's leftover registrations can be
-        # unwound by the service (unregister of an already-released id
-        # is a no-op, so double-accounting is harmless)
-        from ..service.cancellation import current_token
-        tok = current_token()
+            _memplane.note_register(nbytes, owner_query, site, op,
+                                    self.device_bytes)
         if tok is not None:
             tok.own_buffer(buffer_id)
         return buffer_id
@@ -132,6 +153,9 @@ class BufferCatalog:
                 return
             if e.tier == StorageTier.DEVICE:
                 self.device_bytes -= e.nbytes
+                _memplane.note_unregister(e.nbytes, e.owner_query,
+                                          e.owner_site, e.owner_op,
+                                          self.device_bytes)
             elif e.tier == StorageTier.HOST:
                 self.host_bytes -= e.nbytes
                 p = e.host_payload
@@ -352,8 +376,9 @@ class BufferCatalog:
         return schema, num_rows, kinds, \
             self._meta_fetcher(metas, read_bytes)
 
-    def _spill_entry_to_host(self, e: BufferEntry):
+    def _spill_entry_to_host(self, e: BufferEntry, rank: int = 0):
         _flight.record(_flight.EV_SPILL, "device_to_host", a=e.nbytes)
+        t0 = time.perf_counter_ns()
         with _trace.span("spill_device_to_host", "memory", bytes=e.nbytes):
             payload = self._serialize(e.device_obj)
             if self.arena is not None:
@@ -364,6 +389,11 @@ class BufferCatalog:
             self.device_bytes -= e.nbytes
             self.host_bytes += e.nbytes
             self.spilled_device_to_host += e.nbytes
+        _memplane.note_spill(
+            _memplane.DIR_DEVICE_TO_HOST, e.buffer_id, e.owner_query,
+            e.owner_site, e.owner_op, e.nbytes,
+            _memplane.current_reason(), rank,
+            time.perf_counter_ns() - t0, self.device_bytes)
 
     # -- native-arena packing (host staging slab; SURVEY.md §2.10.2) -------
     def _pack_into_arena(self, payload):
@@ -401,10 +431,16 @@ class BufferCatalog:
         self.arena.free(off)
         return (schema, num_rows, kinds, bufs), (off, total)
 
-    def _spill_entry_to_disk(self, e: BufferEntry):
+    def _spill_entry_to_disk(self, e: BufferEntry, rank: int = 0):
         _flight.record(_flight.EV_SPILL, "host_to_disk", a=e.nbytes)
+        t0 = time.perf_counter_ns()
         with _trace.span("spill_host_to_disk", "memory", bytes=e.nbytes):
             self._spill_entry_to_disk_inner(e)
+        _memplane.note_spill(
+            _memplane.DIR_HOST_TO_DISK, e.buffer_id, e.owner_query,
+            e.owner_site, e.owner_op, e.nbytes,
+            _memplane.current_reason(), rank,
+            time.perf_counter_ns() - t0, self.device_bytes)
 
     def _spill_entry_to_disk_inner(self, e: BufferEntry):
         os.makedirs(self.spill_dir, exist_ok=True)
@@ -435,9 +471,10 @@ class BufferCatalog:
         self.disk_bytes += e.nbytes
         self.spilled_host_to_disk += e.nbytes
 
-    def _unspill_host(self, e: BufferEntry):
+    def _unspill_host(self, e: BufferEntry, extra_ns: int = 0):
         from .pressure import oom_retry
         _flight.record(_flight.EV_UNSPILL, "host_to_device", a=e.nbytes)
+        t0 = time.perf_counter_ns()
         with _trace.span("unspill_host_to_device", "memory",
                          bytes=e.nbytes):
             payload, _ = self._unpack_payload(e.host_payload)
@@ -453,13 +490,22 @@ class BufferCatalog:
             self.device_bytes += e.nbytes
             if self.device_bytes > self.device_peak_bytes:
                 self.device_peak_bytes = self.device_bytes
+        # one ledger record per unspill covering the whole read-back
+        # path (extra_ns carries the disk->host hop when there was one)
+        _memplane.note_spill(
+            _memplane.DIR_UNSPILL, e.buffer_id, e.owner_query,
+            e.owner_site, e.owner_op, e.nbytes,
+            _memplane.current_reason(), 0,
+            time.perf_counter_ns() - t0 + extra_ns, self.device_bytes)
         return obj
 
     def _unspill_disk(self, e: BufferEntry):
         _flight.record(_flight.EV_UNSPILL, "disk_to_host", a=e.nbytes)
+        t0 = time.perf_counter_ns()
         with _trace.span("unspill_disk_to_host", "memory", bytes=e.nbytes):
             self._unspill_disk_inner(e)
-        return self._unspill_host(e)
+        return self._unspill_host(e,
+                                  extra_ns=time.perf_counter_ns() - t0)
 
     def _unspill_disk_inner(self, e: BufferEntry):
         with open(e.disk_path, "rb") as f:
@@ -493,32 +539,58 @@ class BufferCatalog:
         self.host_bytes += e.nbytes
 
     # -- synchronous spill (DeviceMemoryEventHandler.onAllocFailure role) --
-    def spill_device_to_fit(self, needed_bytes: int) -> int:
+    def spill_device_to_fit(self, needed_bytes: int,
+                            reason: Optional[str] = None) -> int:
         """Spill device-tier entries (lowest priority first) until at least
 
-        ``needed_bytes`` are free under device_limit.  Returns bytes spilled."""
+        ``needed_bytes`` are free under device_limit.  Returns bytes spilled.
+
+        ``reason`` names the trigger for the spill ledger (budget /
+        pressure / explicit); omitted, the thread's active
+        ``memplane.spill_reason`` scope (or ``explicit``) applies.
+        When the walk exhausts its candidates with the target still
+        unmet — only pinned (refcount>0) entries remain — the
+        shortfall is signalled (tpu_mem_spill_skipped_total + an
+        EV_MEM flight event) instead of silently short-returning."""
+        if reason is None:
+            reason = _memplane.current_reason()
         spilled = 0
-        with self._lock:
+        with self._lock, _memplane.spill_reason(reason):
             target = self.device_limit - needed_bytes
             candidates = sorted(
                 (e for e in self._entries.values()
                  if e.tier == StorageTier.DEVICE and e.refcount == 0),
                 key=lambda e: e.priority)
+            rank = 0
             for e in candidates:
                 if self.device_bytes <= target:
                     break
-                self._spill_entry_to_host(e)
+                self._spill_entry_to_host(e, rank=rank)
+                rank += 1
                 spilled += e.nbytes
+            if self.device_bytes > max(target, 0):
+                pinned_count = 0
+                pinned_bytes = 0
+                for e in self._entries.values():
+                    if e.tier == StorageTier.DEVICE and e.refcount > 0:
+                        pinned_count += 1
+                        pinned_bytes += e.nbytes
+                if pinned_count:
+                    _memplane.note_spill_skipped(
+                        _memplane.REASON_PINNED, pinned_count,
+                        pinned_bytes)
             # cascade host -> disk if host is over budget
             if self.host_bytes > self.host_limit:
                 host_candidates = sorted(
                     (e for e in self._entries.values()
                      if e.tier == StorageTier.HOST and e.refcount == 0),
                     key=lambda e: e.priority)
+                rank = 0
                 for e in host_candidates:
                     if self.host_bytes <= self.host_limit:
                         break
-                    self._spill_entry_to_disk(e)
+                    self._spill_entry_to_disk(e, rank=rank)
+                    rank += 1
         return spilled
 
     def stats(self) -> Dict[str, int]:

@@ -58,7 +58,9 @@ def oom_retry(fn: Callable, *args, **kwargs):
         clear_on_pressure()
         # spill the whole device tier: the real allocator failed, so
         # the logical budget underestimated true pressure
-        spilled = cat.spill_device_to_fit(cat.device_limit)
+        from ..obs import memplane as _memplane
+        spilled = cat.spill_device_to_fit(
+            cat.device_limit, reason=_memplane.REASON_PRESSURE)
         cat.oom_retries = getattr(cat, "oom_retries", 0) + 1
         if spilled == 0 and cache_bytes == 0:
             raise

@@ -13,12 +13,16 @@ from .catalog import BufferCatalog, ACTIVE_BATCH_PRIORITY
 
 class SpillableBatch:
     def __init__(self, batch, priority: int = ACTIVE_BATCH_PRIORITY,
-                 catalog: Optional[BufferCatalog] = None):
+                 catalog: Optional[BufferCatalog] = None,
+                 op: str = "", site: str = "other"):
         self.catalog = catalog or BufferCatalog.get()
         self.nbytes = batch.nbytes()
         self.num_rows = batch.num_rows
         self.schema = batch.schema
-        self.buffer_id = self.catalog.register(batch, self.nbytes, priority)
+        # op/site ride through to the catalog's provenance stamping
+        # (obs/memplane.py): who to bill this batch's device bytes to
+        self.buffer_id = self.catalog.register(batch, self.nbytes, priority,
+                                               op=op, site=site)
         self._closed = False
 
     def materialize(self):

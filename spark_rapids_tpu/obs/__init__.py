@@ -46,12 +46,19 @@ Layers, mirroring the reference plugin's observability story
   deserialize phase split per exchange, ``shuffle_host`` timeline gap
   cause), connection-pool/bounce-buffer state and cross-boundary
   (query_id, span_id) trace correlation over the shuffle wire.
+- ``obs.memplane`` — HBM memory plane: allocation provenance (owner
+  query/site/op decomposition of live device bytes, exact to
+  ``device_bytes``, with peak attribution), the priced spill ledger
+  (victim/owner/reason/rank/duration per tier move, ``mem_spill``
+  timeline gap cause), retention/leak detection at query terminal
+  states, and the admission headroom forecast.
 
 The per-query report generator that joins the event log with these
 streams lives in ``tools/report.py`` (the SQL-UI stand-in).
 """
-from . import (trace, registry, prom, flight, timeline,  # noqa: F401
-               compile_watch, slo, profile, netplane)    # noqa: F401
+from . import (trace, registry, prom, flight, timeline,     # noqa: F401
+               compile_watch, slo, profile, netplane,       # noqa: F401
+               memplane)                                    # noqa: F401
 from .registry import get_registry  # noqa: F401
 from .trace import span, traced     # noqa: F401
 

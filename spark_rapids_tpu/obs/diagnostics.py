@@ -82,6 +82,12 @@ def arena_map() -> Dict[str, Any]:
                     "tier": getattr(e.tier, "name", str(e.tier)),
                     "nbytes": e.nbytes,
                     "priority": e.priority,
+                    # allocation provenance (obs/memplane.py): who
+                    # registered this buffer and from where
+                    "owner_query": e.owner_query,
+                    "site": e.owner_site,
+                    "op": e.owner_op,
+                    "tag": e.owner_tag,
                 })
         entries.sort(key=lambda d: (-d["nbytes"], d["buffer_id"]))
         out["entries"] = entries
@@ -215,6 +221,15 @@ def collect_bundle(trigger: str,
     except Exception as exc:
         bundle["metrics"] = {"error": repr(exc)}
     bundle["arena"] = arena_map()
+    try:
+        # memory plane: live owner decomposition, spill ledger tail,
+        # headroom — the evidence for an OOM/spill-storm incident
+        from . import memplane as _memplane
+        mem: Dict[str, Any] = _memplane.stats_section()
+        mem["ledger_tail"] = _memplane.ledger(limit=100)
+        bundle["memory"] = mem
+    except Exception as exc:
+        bundle["memory"] = {"error": repr(exc)}
     bundle["shuffle"] = shuffle_state()
     if service is not None:
         try:

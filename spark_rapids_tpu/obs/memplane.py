@@ -1,0 +1,574 @@
+"""HBM memory observability plane — the sixth plane: who owns live
+device memory, why every spill happened, what a query left behind, and
+how much headroom the next admission would have.
+
+The memory tier (memory/catalog.py + memory/arena.py, the
+RapidsBufferCatalog role) was the one layer the trace/flight/stats/
+perf/net planes could not see into: four coarse collect-time gauges
+(live/peak/limit bytes, spill bytes by direction) with no record of
+*who* owns live HBM, *why* a spill happened, or *what* a query failed
+to release.  ROADMAP items 1 (HBM-resident ICI shuffle), 3
+(admission-aware warmup) and 7 (device-resident streaming state) all
+plan to keep far more state device-resident — none of them can be
+built or debugged without this plane.  Four pillars:
+
+- **allocation provenance** — every ``BufferCatalog.register()``
+  stamps an owner (query_id from the active CancelToken, operator
+  class, site: superstage/exchange/broadcast/scan_cache/stream_state/
+  operator/other, plus a call-site tag).  The plane keeps an
+  incremental per-site / per-owner live-byte decomposition maintained
+  under the catalog lock, so it sums EXACTLY to ``device_bytes`` at
+  every snapshot, and the high-water mark carries the owner set that
+  was live at peak time.
+- **spill ledger** — every tier move (device->host, host->disk,
+  unspill) is one bounded ledger record: victim id, owner, nbytes,
+  trigger reason (budget / pressure / explicit, a thread-local the
+  requester sets via ``spill_reason()``), victim-selection rank, and
+  the measured serialize/deserialize duration.  Feeds the
+  ``tpu_mem_spill_seconds{direction}`` histograms and the
+  ``mem_spill`` gap cause of the utilization timeline (the spill work
+  windows are the evidence, like netplane's ``shuffle_host``).
+- **retention / leak detection** — at a query's terminal state
+  ``leak_check()`` diffs catalog entries owned by that query_id
+  against the expected survivor set (scan cache, live shuffle
+  materializations); leaks are reported with their registration tag
+  into the event log and diag bundle.
+- **headroom forecasting** — ``headroom()`` (limit − live − pinned,
+  plus spillable-at-zero-refcount bytes) for ``Service.stats()``,
+  Prometheus and the per-admission forecast the service logs.
+
+Hot-path discipline (this file is on the SYNC001/OBS002 lint scope):
+no numpy, no device pulls, no formatted flight-record args.  The
+note_* paths are called by memory/catalog.py UNDER the catalog RLock
+(once per register/unregister/tier move) and only mutate bounded
+module state under the plane lock — the lock order is
+``catalog._lock -> _LOCK``, never the reverse, so the catalog-scanning
+views (``owners()``, ``headroom()``, ``leak_check()``) take the
+catalog lock themselves and are only ever entered outside the plane
+lock.  Host-side timestamps only: zero extra device flushes by
+construction (asserted as an exact FLUSH_COUNT delta, tested).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import flight
+from .registry import MEM_LEAKED_TOTAL, MEM_SPILL_SECONDS, MEM_SPILL_SKIPPED
+
+# provenance sites (interned: stamped on entries and ledger rows
+# verbatim; registry.MEM_SITES mirrors this tuple for the gauges)
+SITE_SUPERSTAGE = "superstage"
+SITE_EXCHANGE = "exchange"
+SITE_BROADCAST = "broadcast"
+SITE_SCAN_CACHE = "scan_cache"
+SITE_STREAM_STATE = "stream_state"
+SITE_OPERATOR = "operator"
+SITE_OTHER = "other"
+SITES = (SITE_SUPERSTAGE, SITE_EXCHANGE, SITE_BROADCAST, SITE_SCAN_CACHE,
+         SITE_STREAM_STATE, SITE_OPERATOR, SITE_OTHER)
+
+# tier-move directions (ledger rows + tpu_mem_spill_seconds labels);
+# ``unspill`` covers the whole read-back path (a disk hop included)
+DIR_DEVICE_TO_HOST = "device_to_host"
+DIR_HOST_TO_DISK = "host_to_disk"
+DIR_UNSPILL = "unspill"
+DIRECTIONS = (DIR_DEVICE_TO_HOST, DIR_HOST_TO_DISK, DIR_UNSPILL)
+
+# trigger reasons: budget = arena reserve over device_limit, pressure =
+# a real allocator RESOURCE_EXHAUSTED retry, explicit = demote()/direct
+# spill_device_to_fit callers, pinned = nothing spillable remained
+REASON_BUDGET = "budget"
+REASON_PRESSURE = "pressure"
+REASON_EXPLICIT = "explicit"
+REASON_PINNED = "pinned"
+
+#: flight-event name for a leak report (EV_MEM, a=bytes, b=entries)
+N_LEAK = "leak"
+
+_ENABLED = True
+_MAX_LEDGER = 1 << 16     #: ledger + spill-window bound (obs.mem.maxLedger)
+_LEDGER_VIEW_CAP = 100    #: ledger rows carried per query summary
+
+_LOCK = threading.Lock()
+_TLS = threading.local()
+
+#: incremental live device-tier bytes by site / by owner tuple
+#: (query_id, site, op) — maintained by the note_* calls under the
+#: catalog lock, keys pruned at <= 0, so both stay bounded by the live
+#: owner set and sum exactly to catalog.device_bytes at all times
+_SITE_DEV: Dict[str, int] = {}
+_OWNER_DEV: Dict[Tuple[Optional[str], str, str], int] = {}
+_CUR_DEV_BYTES = 0
+
+#: high-water mark + the owner decomposition live at peak time; ``seq``
+#: advances on every new peak so per-query summaries can tell whether
+#: THIS query moved it
+_PEAK: Dict = {"bytes": 0, "seq": 0, "sites": {}, "owners": {}}
+
+#: cumulative registrations by (site, op): [count, bytes] — the
+#: parallelism-invariant provenance surface (what ran registers the
+#: same batches regardless of interleaving)
+_REG_TOTALS: Dict[Tuple[str, str], List[int]] = {}
+
+#: the spill ledger: (ts_ns, direction, buffer_id, query_id, site, op,
+#: nbytes, reason, rank, dur_ns).  Append-only, bounded.
+_LEDGER: List[Tuple] = []
+_LEDGER_DROPPED = 0
+
+#: active tier-move work windows (start_ns, end_ns) — the timeline's
+#: ``mem_spill`` gap evidence.  Append-only, bounded by _MAX_LEDGER.
+_ACTIVE: List[Tuple[int, int]] = []
+_ACTIVE_DROPPED = 0
+
+#: cumulative per-direction totals (ns / bytes / moves)
+_SPILL_NS = {d: 0 for d in DIRECTIONS}
+_SPILL_BYTES = {d: 0 for d in DIRECTIONS}
+_SPILL_COUNT = {d: 0 for d in DIRECTIONS}
+
+_SKIPPED = 0        #: spill_device_to_fit calls short-returned (pinned)
+_LEAKED_TOTAL = 0   #: leaked entries reported across all queries
+
+
+def _catalog():
+    from ..memory.catalog import BufferCatalog
+    return BufferCatalog.get()
+
+
+# ---------------------------------------------------------------------------
+# trigger-reason context (thread-local: the spill requester names why)
+# ---------------------------------------------------------------------------
+
+class _ReasonCtx:
+    __slots__ = ("reason", "prev")
+
+    def __init__(self, reason: str):
+        self.reason = reason
+        self.prev = None
+
+    def __enter__(self):
+        self.prev = getattr(_TLS, "reason", None)
+        _TLS.reason = self.reason
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.reason = self.prev
+        return False
+
+
+def spill_reason(reason: str) -> _ReasonCtx:
+    """Scope a trigger reason for tier moves on this thread (the arena
+    budget path wraps its spill_device_to_fit in ``budget``, the OOM
+    retry in ``pressure``; everything else defaults to ``explicit``)."""
+    return _ReasonCtx(reason)
+
+
+def current_reason() -> str:
+    return getattr(_TLS, "reason", None) or REASON_EXPLICIT
+
+
+def call_tag() -> str:
+    """Registration call-site tag (``file.py:lineno``): the nearest
+    frame outside the memory/obs layers, stamped on the entry so a
+    leak report names the code that created the buffer."""
+    if not _ENABLED:
+        return ""
+    f = sys._getframe(1)
+    depth = 0
+    while f is not None and depth < 16:
+        fn = f.f_code.co_filename
+        if "/memory/" not in fn and "/obs/" not in fn:
+            return "%s:%d" % (fn.rsplit("/", 1)[-1], f.f_lineno)
+        f = f.f_back
+        depth += 1
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# hot-path notes (called by memory/catalog.py under the catalog lock)
+# ---------------------------------------------------------------------------
+
+def _inc(key, site: str, nbytes: int) -> None:
+    _SITE_DEV[site] = _SITE_DEV.get(site, 0) + nbytes
+    _OWNER_DEV[key] = _OWNER_DEV.get(key, 0) + nbytes
+
+
+def _dec(key, site: str, nbytes: int) -> None:
+    v = _SITE_DEV.get(site, 0) - nbytes
+    if v > 0:
+        _SITE_DEV[site] = v
+    else:
+        _SITE_DEV.pop(site, None)
+    w = _OWNER_DEV.get(key, 0) - nbytes
+    if w > 0:
+        _OWNER_DEV[key] = w
+    else:
+        _OWNER_DEV.pop(key, None)
+
+
+def _peak_update(device_bytes: int) -> None:
+    # _LOCK held: snapshot the owner decomposition live right now
+    # (both dicts are bounded by distinct owners, not buffers)
+    _PEAK["bytes"] = device_bytes
+    _PEAK["seq"] += 1
+    _PEAK["sites"] = dict(_SITE_DEV)
+    _PEAK["owners"] = dict(_OWNER_DEV)
+
+
+def _note_active(start_ns: int, end_ns: int) -> None:
+    global _ACTIVE_DROPPED
+    if end_ns <= start_ns:
+        return
+    if len(_ACTIVE) < _MAX_LEDGER:
+        _ACTIVE.append((start_ns, end_ns))
+    else:
+        _ACTIVE_DROPPED += 1
+
+
+def note_register(nbytes: int, query_id: Optional[str], site: str,
+                  op: str, device_bytes: int) -> None:
+    """One device-tier registration landed (catalog lock held);
+    ``device_bytes`` is the catalog total after it."""
+    global _CUR_DEV_BYTES
+    if not _ENABLED:
+        return
+    key = (query_id, site, op)
+    with _LOCK:
+        _inc(key, site, nbytes)
+        cell = _REG_TOTALS.get((site, op))
+        if cell is None:
+            cell = _REG_TOTALS[(site, op)] = [0, 0]
+        cell[0] += 1
+        cell[1] += nbytes
+        _CUR_DEV_BYTES = device_bytes
+        if device_bytes > _PEAK["bytes"]:
+            _peak_update(device_bytes)
+
+
+def note_unregister(nbytes: int, query_id: Optional[str], site: str,
+                    op: str, device_bytes: int) -> None:
+    """One DEVICE-tier entry released (catalog lock held)."""
+    global _CUR_DEV_BYTES
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _dec((query_id, site, op), site, nbytes)
+        _CUR_DEV_BYTES = device_bytes
+
+
+def note_spill(direction: str, buffer_id: str, query_id: Optional[str],
+               site: str, op: str, nbytes: int, reason: str, rank: int,
+               dur_ns: int, device_bytes: int) -> None:
+    """One tier move finished now, having taken ``dur_ns`` (catalog
+    lock held).  Appends the ledger record, keeps the live
+    decomposition in step with ``device_bytes``, and opens a
+    ``mem_spill`` timeline evidence window."""
+    global _LEDGER_DROPPED, _CUR_DEV_BYTES
+    if not _ENABLED:
+        return
+    now = time.perf_counter_ns()
+    key = (query_id, site, op)
+    with _LOCK:
+        _SPILL_NS[direction] += dur_ns
+        _SPILL_BYTES[direction] += nbytes
+        _SPILL_COUNT[direction] += 1
+        if direction == DIR_DEVICE_TO_HOST:
+            _dec(key, site, nbytes)
+        elif direction == DIR_UNSPILL:
+            _inc(key, site, nbytes)
+        _CUR_DEV_BYTES = device_bytes
+        if direction == DIR_UNSPILL and device_bytes > _PEAK["bytes"]:
+            _peak_update(device_bytes)
+        if len(_LEDGER) < _MAX_LEDGER:
+            _LEDGER.append((now, direction, buffer_id, query_id, site,
+                            op, nbytes, reason, rank, dur_ns))
+        else:
+            _LEDGER_DROPPED += 1
+    _note_active(now - dur_ns, now)
+    MEM_SPILL_SECONDS.labels(direction=direction).observe(dur_ns / 1e9)
+
+
+def note_spill_skipped(reason: str, pinned_count: int,
+                       pinned_bytes: int) -> None:
+    """``spill_device_to_fit`` could not free the requested bytes —
+    only pinned (refcount>0) entries remained.  Counted so OOM
+    forensics can tell 'nothing spillable' from 'spill too slow'."""
+    global _SKIPPED
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _SKIPPED += 1
+    MEM_SPILL_SKIPPED.labels(reason=reason).inc()
+    flight.record(flight.EV_MEM, reason, pinned_bytes, pinned_count)
+
+
+# ---------------------------------------------------------------------------
+# catalog-scanning views (cold paths; take the catalog lock themselves,
+# NEVER called under _LOCK or the catalog lock)
+# ---------------------------------------------------------------------------
+
+def _owner_rows(d: Dict) -> List[Dict]:
+    rows = [{"query_id": q, "site": s, "op": o, "bytes": b}
+            for (q, s, o), b in d.items() if b > 0]
+    rows.sort(key=lambda r: (-r["bytes"], r["site"], r["op"],
+                             str(r["query_id"])))
+    return rows
+
+
+def owners() -> Dict:
+    """Exact live decomposition: device-tier catalog entries grouped by
+    (query_id, site, op) under the catalog lock, so the owner bytes sum
+    to ``device_bytes`` by construction."""
+    cat = _catalog()
+    agg: Dict[Tuple, List[int]] = {}
+    with cat._lock:
+        dev = cat.device_bytes
+        for e in cat._entries.values():
+            if int(e.tier) == 0:
+                k = (e.owner_query, e.owner_site, e.owner_op)
+                cell = agg.get(k)
+                if cell is None:
+                    cell = agg[k] = [0, 0]
+                cell[0] += e.nbytes
+                cell[1] += 1
+    rows = [{"query_id": q, "site": s, "op": o, "bytes": c[0],
+             "buffers": c[1]} for (q, s, o), c in agg.items()]
+    rows.sort(key=lambda r: (-r["bytes"], r["site"], r["op"],
+                             str(r["query_id"])))
+    return {"device_bytes": dev, "owners": rows}
+
+
+def headroom() -> Dict:
+    """Admission headroom forecast: free device bytes plus what a
+    synchronous spill could reclaim (refcount==0 device entries)."""
+    cat = _catalog()
+    pinned = 0
+    spillable = 0
+    with cat._lock:
+        limit = cat.device_limit
+        live = cat.device_bytes
+        for e in cat._entries.values():
+            if int(e.tier) == 0:
+                if e.refcount > 0:
+                    pinned += e.nbytes
+                else:
+                    spillable += e.nbytes
+    free = max(limit - live, 0)
+    return {"device_limit": limit, "device_bytes": live,
+            "pinned_bytes": pinned, "spillable_bytes": spillable,
+            "free_bytes": free, "headroom_bytes": free + spillable}
+
+
+def leak_check(query_id: Optional[str], survivors=()) -> List[Dict]:
+    """Catalog entries still owned by ``query_id`` at its terminal
+    state, minus the expected survivor set (scan-cache registrations
+    and buffer ids in ``survivors`` — live shuffle materializations).
+    Each leak carries the registration call-site tag."""
+    global _LEAKED_TOTAL
+    if not _ENABLED or not query_id:
+        return []
+    cat = _catalog()
+    surv = frozenset(survivors)
+    leaks = []
+    with cat._lock:
+        for bid, e in cat._entries.items():
+            if getattr(e, "owner_query", None) != query_id:
+                continue
+            if e.owner_site == SITE_SCAN_CACHE or bid in surv:
+                continue
+            leaks.append({"buffer_id": bid, "tier": int(e.tier),
+                          "nbytes": e.nbytes, "site": e.owner_site,
+                          "op": e.owner_op, "tag": e.owner_tag,
+                          "refcount": e.refcount})
+    if leaks:
+        nbytes = 0
+        for rec in leaks:
+            nbytes += rec["nbytes"]
+        with _LOCK:
+            _LEAKED_TOTAL += len(leaks)
+        MEM_LEAKED_TOTAL.inc(len(leaks))
+        flight.record(flight.EV_MEM, N_LEAK, nbytes, len(leaks))
+    return leaks
+
+
+# ---------------------------------------------------------------------------
+# collect-time accessors (registry gauge callbacks)
+# ---------------------------------------------------------------------------
+
+def live_site_bytes(site: str) -> int:
+    return _SITE_DEV.get(site, 0)
+
+
+def ledger_dropped() -> int:
+    return _LEDGER_DROPPED
+
+
+# ---------------------------------------------------------------------------
+# timeline evidence (cold path, called from obs/timeline._summarize)
+# ---------------------------------------------------------------------------
+
+def active_segments(t0: int, t1: int) -> List[Tuple[int, int]]:
+    """Tier-move work windows overlapping [t0, t1] — the timeline's
+    ``mem_spill`` gap-cause evidence."""
+    if not _ENABLED:
+        return []
+    return [(s, e) for s, e in _ACTIVE[:] if e > t0 and s < t1]
+
+
+# ---------------------------------------------------------------------------
+# per-query roll-up (cold paths)
+# ---------------------------------------------------------------------------
+
+def begin_query() -> Dict:
+    """Value/length snapshot marker for a per-query summary."""
+    with _LOCK:
+        m: Dict = {"peak_seq": _PEAK["seq"], "dev_bytes": _CUR_DEV_BYTES,
+                   "ledger_len": len(_LEDGER), "skipped": _SKIPPED,
+                   "leaked": _LEAKED_TOTAL,
+                   "reg_totals": {k: tuple(v)
+                                  for k, v in _REG_TOTALS.items()}}
+        for d in DIRECTIONS:
+            m[d + "_ns"] = _SPILL_NS[d]
+            m[d + "_bytes"] = _SPILL_BYTES[d]
+            m[d + "_count"] = _SPILL_COUNT[d]
+        return m
+
+
+def _ledger_rows(raw: List[Tuple]) -> List[Dict]:
+    return [{"direction": d, "buffer_id": b, "query_id": q, "site": s,
+             "op": o, "nbytes": n, "reason": r, "rank": k,
+             "ms": round(ns / 1e6, 3)}
+            for _ts, d, b, q, s, o, n, r, k, ns in raw]
+
+
+def query_summary(marker: Optional[Dict] = None) -> Dict:
+    """Memory roll-up since a ``begin_query()`` marker: peak bytes with
+    the owner set live at peak (when this window advanced the peak; the
+    live bytes at the marker otherwise), per-direction spill totals,
+    the ledger slice, and the parallelism-invariant registration
+    decomposition by (site, op)."""
+    m = marker or {}
+    reg0 = m.get("reg_totals", {})
+    with _LOCK:
+        spill = {}
+        for d in DIRECTIONS:
+            spill[d] = {
+                "count": _SPILL_COUNT[d] - m.get(d + "_count", 0),
+                "bytes": _SPILL_BYTES[d] - m.get(d + "_bytes", 0),
+                "ms": round((_SPILL_NS[d] - m.get(d + "_ns", 0)) / 1e6,
+                            3),
+            }
+        advanced = _PEAK["seq"] > m.get("peak_seq", 0) or (
+            marker is None and _PEAK["seq"] > 0)
+        peak_bytes = _PEAK["bytes"] if advanced \
+            else m.get("dev_bytes", _CUR_DEV_BYTES)
+        peak_sites = dict(_PEAK["sites"]) if advanced else {}
+        peak_owners = _owner_rows(_PEAK["owners"]) if advanced else []
+        reg_rows = []
+        reg_count = 0
+        reg_bytes = 0
+        for (site, op), cell in _REG_TOTALS.items():
+            c0, b0 = reg0.get((site, op), (0, 0))
+            dc, db = cell[0] - c0, cell[1] - b0
+            if dc > 0:
+                reg_rows.append({"site": site, "op": op, "count": dc,
+                                 "bytes": db})
+                reg_count += dc
+                reg_bytes += db
+        skipped = _SKIPPED - m.get("skipped", 0)
+        leaked = _LEAKED_TOTAL - m.get("leaked", 0)
+        lo = m.get("ledger_len", 0)
+    reg_rows.sort(key=lambda r: (r["site"], r["op"]))
+    rows = _ledger_rows(_LEDGER[lo:])
+    spill_ms = spill[DIR_DEVICE_TO_HOST]["ms"] + \
+        spill[DIR_HOST_TO_DISK]["ms"]
+    return {
+        "peak_device_bytes": int(peak_bytes),
+        "peak_advanced": bool(advanced),
+        "peak_by_site": peak_sites,
+        "peak_owners": peak_owners,
+        "spill": spill,
+        "spill_ms": round(spill_ms, 3),
+        "unspill_ms": spill[DIR_UNSPILL]["ms"],
+        "unspill_count": spill[DIR_UNSPILL]["count"],
+        "spill_skipped": skipped,
+        "leaked_entries": leaked,
+        "registered": {"count": reg_count, "bytes": reg_bytes,
+                       "by_site": reg_rows},
+        "ledger": rows[:_LEDGER_VIEW_CAP],
+        "ledger_records": len(rows),
+    }
+
+
+def ledger(limit: int = 0) -> List[Dict]:
+    """Process-lifetime ledger view (diag bundles), oldest first."""
+    rows = _ledger_rows(_LEDGER[:])
+    return rows[-limit:] if limit else rows
+
+
+def stats_section() -> Dict:
+    """The ``memory`` block of ``Service.stats()``."""
+    with _LOCK:
+        spill = {d: {"count": _SPILL_COUNT[d], "bytes": _SPILL_BYTES[d],
+                     "ms": round(_SPILL_NS[d] / 1e6, 3)}
+                 for d in DIRECTIONS}
+        skipped = _SKIPPED
+        leaked = _LEAKED_TOTAL
+        records = len(_LEDGER)
+        dropped = _LEDGER_DROPPED
+        peak = {"bytes": _PEAK["bytes"], "by_site": dict(_PEAK["sites"])}
+        sites = dict(_SITE_DEV)
+    out = {
+        "enabled": bool(_ENABLED),
+        "live_by_site": sites,
+        "peak": peak,
+        "spill": spill,
+        "spill_skipped": skipped,
+        "leaked_total": leaked,
+        "ledger_records": records,
+        "ledger_dropped": dropped,
+    }
+    if _ENABLED:
+        out["headroom"] = headroom()
+        ow = owners()
+        out["device_bytes"] = ow["device_bytes"]
+        out["owners"] = ow["owners"][:10]
+    return out
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.mem.*`` conf group."""
+    global _ENABLED, _MAX_LEDGER
+    from ..config import OBS_MEM_ENABLED, OBS_MEM_MAX_LEDGER
+    _ENABLED = bool(conf.get(OBS_MEM_ENABLED))
+    cap = int(conf.get(OBS_MEM_MAX_LEDGER))
+    if cap > 0:
+        _MAX_LEDGER = cap
+
+
+def reset() -> None:
+    """Test hook: drop the decomposition, peak, ledger and counters."""
+    global _LEDGER_DROPPED, _ACTIVE_DROPPED, _SKIPPED, _LEAKED_TOTAL
+    global _CUR_DEV_BYTES
+    with _LOCK:
+        _SITE_DEV.clear()
+        _OWNER_DEV.clear()
+        _REG_TOTALS.clear()
+        for d in DIRECTIONS:
+            _SPILL_NS[d] = 0
+            _SPILL_BYTES[d] = 0
+            _SPILL_COUNT[d] = 0
+        _PEAK.update({"bytes": 0, "seq": 0, "sites": {}, "owners": {}})
+        _LEDGER_DROPPED = 0
+        _ACTIVE_DROPPED = 0
+        _SKIPPED = 0
+        _LEAKED_TOTAL = 0
+        _CUR_DEV_BYTES = 0
+    del _LEDGER[:]
+    del _ACTIVE[:]

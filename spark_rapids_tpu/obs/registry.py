@@ -395,6 +395,73 @@ SHUFFLE_COMPRESSION_BYTES = _REGISTRY.counter(
     labels=("codec", "direction"))
 
 
+# -- HBM memory observability plane (obs/memplane.py) -----------------------
+#: provenance sites a registration can be attributed to (mirrors
+#: memplane.SITES; a fixed tuple here keeps the gauge children stable)
+MEM_SITES = ("superstage", "exchange", "broadcast", "scan_cache",
+             "stream_state", "operator", "other")
+# Tier-move buckets: a device->host pull of one batch is ~1-100ms, a
+# compressed disk write of a big sorted run can take seconds.
+_MEM_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.075, 0.1,
+                0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _memplane_mod():
+    from . import memplane
+    return memplane
+
+
+MEM_SPILL_SECONDS = _REGISTRY.histogram(
+    "tpu_mem_spill_seconds",
+    "Wall duration of each buffer-catalog tier move by direction: "
+    "device_to_host serialize, host_to_disk write, unspill = the "
+    "whole read-back path incl. a disk hop when present "
+    "(obs/memplane.py spill ledger)",
+    buckets=_MEM_BUCKETS,
+    labels=("direction",))
+MEM_SPILL_SKIPPED = _REGISTRY.counter(
+    "tpu_mem_spill_skipped_total",
+    "spill_device_to_fit calls that could not free the requested "
+    "bytes because only pinned (refcount>0) entries remained on the "
+    "device tier — OOM forensics: 'nothing spillable' vs 'spill too "
+    "slow'",
+    labels=("reason",))
+MEM_LEAKED_TOTAL = _REGISTRY.counter(
+    "tpu_mem_leaked_entries_total",
+    "Catalog entries still owned by a query at its terminal state "
+    "outside the expected survivor set (scan cache, live shuffle "
+    "materializations); each is reported with its registration "
+    "call-site tag in the event log and diag bundle")
+MEM_LIVE_BYTES = _REGISTRY.gauge(
+    "tpu_mem_live_bytes",
+    "Live device-tier bytes by provenance site; the sites sum to "
+    "tpu_arena_device_bytes at every scrape (obs/memplane.py)",
+    labels=("site",))
+for _site in MEM_SITES:
+    MEM_LIVE_BYTES.labels(site=_site).set_function(
+        lambda s=_site: _memplane_mod().live_site_bytes(s))
+MEM_HEADROOM_BYTES = _REGISTRY.gauge(
+    "tpu_mem_headroom_bytes",
+    "Admission headroom forecast: free device bytes plus spillable-"
+    "at-zero-refcount bytes (obs/memplane.py headroom())",
+    fn=lambda: _memplane_mod().headroom()["headroom_bytes"])
+MEM_PINNED_BYTES = _REGISTRY.gauge(
+    "tpu_mem_pinned_bytes",
+    "Device-tier bytes pinned by refcount>0 entries (unspillable)",
+    fn=lambda: _memplane_mod().headroom()["pinned_bytes"])
+MEM_SPILLABLE_BYTES = _REGISTRY.gauge(
+    "tpu_mem_spillable_bytes",
+    "Device-tier bytes in refcount==0 entries (reclaimable by a "
+    "synchronous spill)",
+    fn=lambda: _memplane_mod().headroom()["spillable_bytes"])
+MEM_LEDGER_DROPPED = _REGISTRY.counter(
+    "tpu_mem_ledger_dropped_total",
+    "Spill-ledger records dropped past "
+    "spark.rapids.tpu.obs.mem.maxLedger (fixed memory)")
+MEM_LEDGER_DROPPED.set_function(
+    lambda: _memplane_mod().ledger_dropped())
+
+
 def _pipeline_mod():
     from ..exec import pipeline
     return pipeline
@@ -507,9 +574,10 @@ DEVICE_BUSY_SECONDS = _REGISTRY.counter(
 
 #: idle-gap taxonomy of the utilization timeline (docs/observability.md;
 #: shuffle_host = active shuffle host-drop work windows from
-#: obs/netplane.py, classified ahead of the generic drain causes)
+#: obs/netplane.py and mem_spill = active tier-move work windows from
+#: obs/memplane.py, both classified ahead of the generic drain causes)
 TIMELINE_GAP_CAUSES = ("inline_compile", "sem_wait", "admission_queue",
-                       "shuffle_host", "host_staging",
+                       "shuffle_host", "mem_spill", "host_staging",
                        "pipeline_starvation", "idle")
 
 

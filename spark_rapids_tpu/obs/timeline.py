@@ -292,6 +292,17 @@ def _summarize(idx: int, t0: int, t1: int, is_query: bool) -> Dict:
     gaps_ns["shuffle_host"] = _total(idle) - _total(taken)
     idle = taken
 
+    # spill/unspill tier-move work (obs/memplane.py windows) likewise
+    # outranks the generic drain causes: an idle device during a
+    # serialize/deserialize is paying the memory tax, not waiting on
+    # pipeline staging (and the shuffle_host subtraction above already
+    # claimed any window that was both)
+    from . import memplane
+    spill_segs = _clip(_merge(memplane.active_segments(t0, t1)), t0, t1)
+    taken = _subtract(idle, spill_segs)
+    gaps_ns["mem_spill"] = _total(idle) - _total(taken)
+    idle = taken
+
     healthy = _merge([(s, e) for s, e, r in drains
                       if r >= _HEALTHY_OVERLAP_PERMILLE])
     starved = _merge([(s, e) for s, e, r in drains

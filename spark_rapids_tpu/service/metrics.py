@@ -30,6 +30,9 @@ class QueryMetrics:
     inline_compile_ms: float = 0.0
     host_drop_tax_ms: float = 0.0
     spill_bytes: int = 0
+    spill_ms: float = 0.0
+    unspill_count: int = 0
+    leaked_entries: int = 0
     attempts: int = 1
     retries: int = 0
     outcome: str = "pending"   # completed|failed|cancelled|shed
@@ -48,6 +51,9 @@ class QueryMetrics:
             "inline_compile_ms": round(self.inline_compile_ms, 3),
             "host_drop_tax_ms": round(self.host_drop_tax_ms, 3),
             "spill_bytes": int(self.spill_bytes),
+            "spill_ms": round(self.spill_ms, 3),
+            "unspill_count": int(self.unspill_count),
+            "leaked_entries": int(self.leaked_entries),
             "attempts": self.attempts,
             "retries": self.retries,
             "outcome": self.outcome,

@@ -30,6 +30,7 @@ from ..config import (TpuConf, set_active, EVENT_LOG_PATH,
                       OBS_DIAG_MAX_BUNDLES)
 from ..obs import compile_watch as _cwatch
 from ..obs import flight as _flight
+from ..obs import memplane as _memplane
 from ..obs import netplane as _netplane
 from ..obs import slo as _slo
 from ..obs import timeline as _timeline
@@ -164,6 +165,7 @@ class QueryService:
         _cwatch.configure(conf)
         _timeline.configure(conf)
         _netplane.configure(conf)
+        _memplane.configure(conf)
         # stats().snapshot() carries the live obs sections alongside the
         # lifecycle counters (the monitoring one-stop view)
         self._stats.set_extras(lambda: {
@@ -174,6 +176,7 @@ class QueryService:
             "compile": _cwatch.stats_section(),
             "timeline": _timeline.process_summary(),
             "shuffle": _netplane.stats_section(),
+            "memory": _memplane.stats_section(),
         })
 
     # -- lifecycle ---------------------------------------------------------
@@ -276,10 +279,20 @@ class QueryService:
             raise
         self._stats.inc("admitted")
         _flight.record(_flight.EV_STATE, "admitted", query_id=query_id)
+        # admission-time headroom forecast (obs/memplane.py): device
+        # bytes the arena could still grant vs what this query claims it
+        # needs — the event-log row operators grep when deciding whether
+        # an admission preceded a spill storm
+        hr = _memplane.headroom()
         self._events.log_service_event(
             "admitted", query_id, tenant=tenant, priority=priority,
             est_bytes=est_bytes, queue_depth=self.queue.depth,
-            deadline_ms=ms)
+            deadline_ms=ms,
+            headroom_bytes=hr["headroom_bytes"],
+            device_bytes=hr["device_bytes"],
+            spillable_bytes=hr["spillable_bytes"],
+            forecast_fits=(est_bytes <= hr["headroom_bytes"]
+                           + hr["spillable_bytes"]))
         return handle
 
     def _cancel_queued(self, handle: QueryHandle):
@@ -409,6 +422,10 @@ class QueryService:
             m.host_drop_tax_ms += token.observed.get(
                 "host_drop_tax_ms", 0.0)
             m.spill_bytes += int(token.observed.get("spill_bytes", 0))
+            m.spill_ms += float(token.observed.get("spill_ms", 0.0))
+            m.unspill_count += int(token.observed.get("unspill_count", 0))
+            m.leaked_entries += int(
+                token.observed.get("leaked_entries", 0))
             return table
 
     def _emit_outcome(self, kind: str, handle: QueryHandle, **fields):

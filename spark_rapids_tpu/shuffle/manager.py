@@ -17,7 +17,8 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, Iterator, List, Optional, Tuple
+import weakref
+from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from ..columnar.batch import ColumnarBatch
 from ..obs import netplane as _netplane
@@ -42,6 +43,35 @@ class ShuffleTransport:
         pass
 
 
+# every live ShuffleCatalog (manager singleton + per-executor contexts):
+# the memory plane's end-of-query leak check treats batches still held by
+# ANY of them as expected survivors, not leaks (a peer query's reducer may
+# still fetch them)
+_ALL_CATALOGS: List["weakref.ref[ShuffleCatalog]"] = []
+_ALL_CATALOGS_LOCK = threading.Lock()
+
+
+def live_spill_buffer_ids() -> Set[int]:
+    """Buffer ids of every shuffle batch still materialized in a live
+    catalog (survivor set for ``obs.memplane.leak_check``)."""
+    with _ALL_CATALOGS_LOCK:
+        cats = [r() for r in _ALL_CATALOGS]
+        if any(c is None for c in cats):
+            _ALL_CATALOGS[:] = [r for r in _ALL_CATALOGS
+                                if r() is not None]
+    out: Set[int] = set()
+    for c in cats:
+        if c is None:
+            continue
+        with c._lock:
+            for es in c._store.values():
+                for e in es:
+                    bid = getattr(e, "buffer_id", None)
+                    if bid is not None:
+                        out.add(bid)
+    return out
+
+
 class ShuffleCatalog:
     """In-memory map-output catalog (ShuffleBufferCatalog role).
 
@@ -52,12 +82,15 @@ class ShuffleCatalog:
     def __init__(self):
         self._store: Dict[ShuffleBlockId, List] = {}
         self._lock = threading.Lock()
+        with _ALL_CATALOGS_LOCK:
+            _ALL_CATALOGS.append(weakref.ref(self))
 
     def put(self, block: ShuffleBlockId, batches: List[ColumnarBatch]):
         from ..memory.spillable import SpillableBatch
         t0 = time.perf_counter_ns()
         with _trace.span("shuffle_write", "shuffle"):
-            entries = [SpillableBatch(b) for b in batches]
+            entries = [SpillableBatch(b, op="TpuShuffleExchange",
+                                      site="exchange") for b in batches]
         nbytes = sum(e.nbytes for e in entries)
         SHUFFLE_WRITE_BYTES.inc(nbytes)
         _netplane.note_serialize(block.shuffle_id, block.map_id,
@@ -74,7 +107,8 @@ class ShuffleCatalog:
         from ..memory.spillable import SpillableBatch
         t0 = time.perf_counter_ns()
         with _trace.span("shuffle_write", "shuffle"):
-            entries = [SpillableBatch(b) for b in batches]
+            entries = [SpillableBatch(b, op="TpuShuffleExchange",
+                                      site="exchange") for b in batches]
         nbytes = sum(e.nbytes for e in entries)
         SHUFFLE_WRITE_BYTES.inc(nbytes)
         _netplane.note_serialize(block.shuffle_id, block.map_id,

@@ -190,7 +190,11 @@ def _service_story(service: List[Dict]) -> List[str]:
                 f"execute_ms={rec.get('execute_ms')} "
                 f"inline_compile_ms={_fmt(rec.get('inline_compile_ms'))} "
                 f"sem_wait_ms={rec.get('sem_wait_ms')} "
-                f"spill_bytes={rec.get('spill_bytes')}"
+                f"spill_bytes={rec.get('spill_bytes')} "
+                f"spill_ms={_fmt(rec.get('spill_ms'))} "
+                f"unspill_count={_fmt(rec.get('unspill_count'))}"
+                + (f" leaked_entries={rec.get('leaked_entries')}"
+                   if rec.get("leaked_entries") else "")
                 + (f" error={rec.get('error')}"
                    if rec.get("error") else ""))
             if rec.get("diag_bundle"):
@@ -304,6 +308,80 @@ def shuffle_lines(rec: Dict) -> List[str]:
     return lines
 
 
+def memory_lines(rec: Dict) -> List[str]:
+    """The HBM memory (memplane) section of one engine record: peak
+    device bytes with the owner set at peak time, the per-direction
+    spill totals, the priced ledger tail and any retention leaks —
+    obs/memplane.py's event-log surface."""
+    mem = rec.get("memplane")
+    if not mem:
+        return ["  (no memplane recorded — older log or "
+                "spark.rapids.tpu.obs.mem.enabled=false)"]
+    lines = ["-- HBM memory (memplane) --"]
+    lines.append(
+        f"  peak_device_bytes={_fmt(mem.get('peak_device_bytes'))} "
+        f"spill_ms={_fmt(mem.get('spill_ms'))} "
+        f"unspill_ms={_fmt(mem.get('unspill_ms'))} "
+        f"unspill_count={_fmt(mem.get('unspill_count'))} "
+        f"spill_skipped={_fmt(mem.get('spill_skipped'))} "
+        f"leaked_entries={_fmt(mem.get('leaked_entries'))}")
+    peak_sites = mem.get("peak_by_site") or {}
+    peak = float(mem.get("peak_device_bytes") or 0)
+    if peak_sites:
+        lines.append("  live bytes at peak, by site:")
+        for site, nbytes in sorted(peak_sites.items(),
+                                   key=lambda kv: -kv[1]):
+            share = (nbytes / peak * 100.0) if peak else 0.0
+            bar = "#" * int(round(share / 5.0))
+            lines.append(f"    {site:<14s}{share:6.1f}%"
+                         f"{nbytes:>14,d}  {bar}")
+    owners = mem.get("peak_owners") or []
+    if owners:
+        lines.append("  owners at peak:")
+        for o in owners[:8]:
+            lines.append(f"    {str(o.get('query_id')):<22s}"
+                         f"{str(o.get('site')):<12s}"
+                         f"{str(o.get('op'))[:24]:<26s}"
+                         f"{_fmt(o.get('bytes')):>14}")
+    spill = mem.get("spill") or {}
+    if any((spill.get(d) or {}).get("count") for d in spill):
+        lines.append("  tier moves:")
+        lines.append(f"    {'direction':<16s}{'count':>6s}"
+                     f"{'bytes':>14s}{'ms':>10s}")
+        for d in ("device_to_host", "host_to_disk", "unspill"):
+            row = spill.get(d) or {}
+            lines.append(f"    {d:<16s}{_fmt(row.get('count')):>6}"
+                         f"{_fmt(row.get('bytes')):>14}"
+                         f"{_fmt(row.get('ms')):>10}")
+    ledger = mem.get("ledger") or []
+    if ledger:
+        shown = len(ledger)
+        total = mem.get("ledger_records") or shown
+        lines.append(f"  spill ledger (last {shown} of {total}):")
+        lines.append(f"    {'direction':<16s}{'site':<12s}"
+                     f"{'op':<22s}{'bytes':>12s}{'reason':<10s}"
+                     f"{'rank':>5s}{'ms':>9s}")
+        for r in ledger:
+            lines.append(f"    {str(r.get('direction')):<16s}"
+                         f"{str(r.get('site')):<12s}"
+                         f"{str(r.get('op'))[:20]:<22s}"
+                         f"{_fmt(r.get('nbytes')):>12}"
+                         f" {str(r.get('reason')):<9s}"
+                         f"{_fmt(r.get('rank')):>5}"
+                         f"{_fmt(r.get('ms')):>9}")
+    leaks = mem.get("leaks") or []
+    if leaks:
+        lines.append("  !! leaked registrations at query end:")
+        for lk in leaks[:8]:
+            lines.append(f"    buffer={lk.get('buffer_id')} "
+                         f"tier={lk.get('tier')} "
+                         f"bytes={lk.get('nbytes')} "
+                         f"site={lk.get('site')} op={lk.get('op')} "
+                         f"refcount={lk.get('refcount')} "
+                         f"registered_at={lk.get('tag')}")
+    return lines
+
+
 def stats_lines(prof: Dict) -> List[str]:
     """Text sections for one record's StatsProfile (obs/stats.py)."""
     lines: List[str] = []
@@ -354,7 +432,8 @@ def stats_lines(prof: Dict) -> List[str]:
 def render_query_report(query_id, story: Dict,
                         trace_events: Optional[List[Dict]] = None,
                         show_stats: bool = False,
-                        show_shuffle: bool = False) -> str:
+                        show_shuffle: bool = False,
+                        show_memory: bool = False) -> str:
     """One query's full text report."""
     lines = [f"=== query {query_id} " + "=" * 40]
     engine = story.get("engine", [])
@@ -396,6 +475,8 @@ def render_query_report(query_id, story: Dict,
         lines.extend(compile_lines(rec))
         if show_shuffle:
             lines.extend(shuffle_lines(rec))
+        if show_memory:
+            lines.extend(memory_lines(rec))
         if show_stats:
             prof = rec.get("stats_profile")
             if prof:
@@ -451,7 +532,8 @@ def slo_header(stories: Dict) -> List[str]:
 def render_report(stories: Dict,
                   trace_events: Optional[List[Dict]] = None,
                   query_id=None, show_stats: bool = False,
-                  show_shuffle: bool = False) -> str:
+                  show_shuffle: bool = False,
+                  show_memory: bool = False) -> str:
     ids = [query_id] if query_id is not None else sorted(
         stories, key=lambda q: str(q))
     parts = []
@@ -464,14 +546,16 @@ def render_report(stories: Dict,
             raise KeyError(f"query {qid!r} not in event log")
         parts.append(render_query_report(qid, stories[qid], trace_events,
                                          show_stats=show_stats,
-                                         show_shuffle=show_shuffle))
+                                         show_shuffle=show_shuffle,
+                                         show_memory=show_memory))
     return "\n\n".join(parts)
 
 
 def render_html(stories: Dict,
                 trace_events: Optional[List[Dict]] = None,
                 query_id=None, show_stats: bool = False,
-                show_shuffle: bool = False) -> str:
+                show_shuffle: bool = False,
+                show_memory: bool = False) -> str:
     """Self-contained single-file HTML wrapping the text report
     per-query (monospace <pre> sections with a query index)."""
     ids = [query_id] if query_id is not None else sorted(
@@ -483,7 +567,8 @@ def render_html(stories: Dict,
     for qid in ids:
         txt = render_query_report(qid, stories[qid], trace_events,
                                   show_stats=show_stats,
-                                  show_shuffle=show_shuffle)
+                                  show_shuffle=show_shuffle,
+                                  show_memory=show_memory)
         body.append(f'<h2 id="q{_html.escape(str(qid))}">'
                     f"query {_html.escape(str(qid))}</h2>")
         body.append(f"<pre>{_html.escape(txt)}</pre>")
@@ -499,7 +584,7 @@ def main(argv=None):
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: report <event_log.jsonl> [--query QID] "
               "[--trace trace.json] [--html out.html] [--stats] "
-              "[--shuffle]",
+              "[--shuffle] [--memory]",
               file=sys.stderr)
         return 1
 
@@ -522,6 +607,7 @@ def main(argv=None):
     html_out = _opt("--html")
     show_stats = _flag("--stats")
     show_shuffle = _flag("--shuffle")
+    show_memory = _flag("--memory")
     log_path = argv[0]
     stories = load_query_stories(log_path)
     trace_events = load_trace(trace_path) if trace_path else None
@@ -536,12 +622,14 @@ def main(argv=None):
         with open(html_out, "w") as f:
             f.write(render_html(stories, trace_events, qid,
                                 show_stats=show_stats,
-                                show_shuffle=show_shuffle))
+                                show_shuffle=show_shuffle,
+                                show_memory=show_memory))
         print(f"wrote {html_out}")
     else:
         print(render_report(stories, trace_events, qid,
                             show_stats=show_stats,
-                            show_shuffle=show_shuffle))
+                            show_shuffle=show_shuffle,
+                            show_memory=show_memory))
     return 0
 
 

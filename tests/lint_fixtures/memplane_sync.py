@@ -1,0 +1,27 @@
+"""Seeded SYNC001/OBS002 fixture shaped like a memory-plane helper —
+``ci/lint.py`` must exit NONZERO.
+
+The memory observability plane (obs/memplane.py) prices spills from
+catalog transitions the memory layer already makes, so its lint scope
+bans exactly what this buffer does: a device pull while sizing a
+victim, and a flight-recorder event that allocates per spill.  Never
+imported by the engine.
+"""
+import jax
+import numpy as np
+
+from spark_rapids_tpu.obs import flight as _flight
+
+
+def bad_note_spill(entry, dev):
+    nbytes = np.asarray(dev).nbytes           # SYNC001: materialization
+    host = jax.device_get(dev)                # SYNC001: host pull
+    _flight.record(_flight.EV_MEM, f"spill:{nbytes}")   # OBS002: f-string
+    return host
+
+
+def good_note_spill(entry, nbytes, dur_ns):
+    # the allocation-free shape: sizes from the catalog entry, interned
+    # name constants, plain ints
+    _flight.record(_flight.EV_MEM, "spill", a=nbytes, b=dur_ns)
+    return nbytes

@@ -501,6 +501,20 @@ class TestLint:
             scopes=AL._scopes_for("spark_rapids_tpu/compile/carve.py"))
         assert any(f.rule == AL.SYNC001 for f in fs)
 
+    def test_memplane_in_sync_and_obs_scopes(self):
+        # the memory plane prices spills from catalog transitions the
+        # memory layer already makes; its own file must not pull device
+        # buffers (SYNC001) nor allocate per flight event (OBS002)
+        scopes = AL._scopes_for("spark_rapids_tpu/obs/memplane.py")
+        assert AL.SYNC001 in scopes
+        assert AL.OBS002 in scopes
+        src = ("import jax\n"
+               "def note_spill(dev):\n"
+               "    return jax.device_get(dev)\n")
+        fs = AL.lint_source(src, "spark_rapids_tpu/obs/memplane.py",
+                            scopes=scopes)
+        assert any(f.rule == AL.SYNC001 for f in fs)
+
 
 # ---------------------------------------------------------------------------
 # CLI + project surface
@@ -517,7 +531,8 @@ def _cli():
 class TestCliAndProject:
     @pytest.mark.parametrize("fixture", [
         "lock_inversion.py", "host_sync_kernel.py", "bad_hygiene.py",
-        "flight_alloc.py", "superstage_sync.py", "flush_under_lock.py"])
+        "flight_alloc.py", "superstage_sync.py", "flush_under_lock.py",
+        "memplane_sync.py"])
     def test_cli_nonzero_on_each_seeded_fixture(self, fixture, capsys):
         assert _cli().main([os.path.join(FIXTURES, fixture)]) == 1
         out = capsys.readouterr().out

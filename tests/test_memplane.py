@@ -1,0 +1,459 @@
+"""HBM memory observability plane tests (obs/memplane.py): allocation
+provenance (owner decomposition exact to device_bytes, peak
+attribution), the priced spill ledger (totals equal the catalog's spill
+counters), trigger-reason threading, the pinned-skip signal, leak
+detection at query terminal states, headroom, the
+Prometheus/stats/report/event-log surfaces, and the zero-extra-flush +
+parallelism-stability acceptance contracts."""
+import json
+import time
+
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.memory.catalog import BufferCatalog
+from spark_rapids_tpu.memory.spillable import SpillableBatch
+from spark_rapids_tpu.obs import flight, memplane
+from spark_rapids_tpu.obs.prom import render_text
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.service.cancellation import CancelToken, query_context
+
+MS = 1_000_000          # ns per ms
+
+
+@pytest.fixture(autouse=True)
+def _memplane_reset():
+    """Isolate the process-wide plane AND the catalog singleton the
+    tests shrink (restore default budgets afterwards; catalog reset
+    also resets the plane's decomposition epoch)."""
+    BufferCatalog.reset(spill_dir="/tmp/srt_test_spill")
+    yield
+    memplane.configure(TpuConf({}))
+    BufferCatalog.reset(spill_dir="/tmp/srt_test_spill")
+
+
+def _batch(rows=256):
+    return ColumnarBatch.from_pydict(
+        {"a": list(range(rows)), "b": [float(i) for i in range(rows)]})
+
+
+# ---------------------------------------------------------------------------
+# allocation provenance
+# ---------------------------------------------------------------------------
+
+class TestProvenance:
+    def test_owner_decomposition_sums_exactly_to_device_bytes(self):
+        cat = BufferCatalog.get()
+        with query_context(CancelToken("q-own-1", None)):
+            a = SpillableBatch(_batch(), op="TpuSortExec", site="operator")
+        with query_context(CancelToken("q-own-2", None)):
+            b = SpillableBatch(_batch(128), op="TpuShuffleExchange",
+                               site="exchange")
+        view = memplane.owners()
+        # the acceptance contract: EXACT equality, not approximate —
+        # both sides mutate under the same catalog lock
+        assert view["device_bytes"] == cat.device_bytes > 0
+        assert sum(r["bytes"] for r in view["owners"]) == cat.device_bytes
+        by_q = {r["query_id"]: r for r in view["owners"]}
+        assert by_q["q-own-1"]["site"] == "operator"
+        assert by_q["q-own-1"]["op"] == "TpuSortExec"
+        assert by_q["q-own-2"]["site"] == "exchange"
+        # the incremental per-site counters agree with the exact scan
+        assert memplane.live_site_bytes("operator") == \
+            by_q["q-own-1"]["bytes"]
+        assert memplane.live_site_bytes("exchange") == \
+            by_q["q-own-2"]["bytes"]
+        a.close()
+        view = memplane.owners()
+        assert view["device_bytes"] == cat.device_bytes
+        assert sum(r["bytes"] for r in view["owners"]) == cat.device_bytes
+        assert memplane.live_site_bytes("operator") == 0
+        b.close()
+        assert memplane.owners()["device_bytes"] == 0
+
+    def test_registration_tag_names_the_calling_code(self):
+        cat = BufferCatalog.get()
+        sb = SpillableBatch(_batch(), op="TagOp")
+        e = cat._entries[sb.buffer_id]
+        # the tag walks past memory/ and obs/ frames to the real caller
+        assert e.owner_tag.startswith("test_memplane.py:")
+        sb.close()
+
+    def test_peak_attribution_snapshots_owner_set_at_peak(self):
+        marker = memplane.begin_query()
+        big = SpillableBatch(_batch(512), op="BigOp", site="operator")
+        small = SpillableBatch(_batch(32), op="SmallOp", site="other")
+        peak_expected = BufferCatalog.get().device_bytes
+        small.close()          # live bytes drop below the peak
+        s = memplane.query_summary(marker)
+        assert s["peak_advanced"]
+        assert s["peak_device_bytes"] == peak_expected
+        assert sum(s["peak_by_site"].values()) == s["peak_device_bytes"]
+        assert {"operator", "other"} <= set(s["peak_by_site"])
+        ops = {r["op"] for r in s["peak_owners"]}
+        assert {"BigOp", "SmallOp"} <= ops
+        big.close()
+
+    def test_query_marker_isolates_window(self):
+        keep = SpillableBatch(_batch(64), op="Before")
+        marker = memplane.begin_query()
+        mine = SpillableBatch(_batch(64), op="Mine", site="operator")
+        s = memplane.query_summary(marker)
+        assert s["registered"]["count"] == 1
+        assert [r["op"] for r in s["registered"]["by_site"]] == ["Mine"]
+        keep.close()
+        mine.close()
+
+
+# ---------------------------------------------------------------------------
+# spill ledger
+# ---------------------------------------------------------------------------
+
+def _tiny_catalog(device_limit=16 * 1024, host_limit=8 << 30):
+    return BufferCatalog.reset(spill_dir="/tmp/srt_test_spill",
+                               device_limit=device_limit,
+                               host_limit=host_limit)
+
+
+class TestSpillLedger:
+    def test_ledger_totals_equal_catalog_spill_counters(self):
+        cat = _tiny_catalog(host_limit=16 * 1024)
+        handles = [SpillableBatch(_batch(), op="TpuSortExec",
+                                  site="operator") for _ in range(4)]
+        cat.spill_device_to_fit(cat.device_limit, reason="budget")
+        rows = memplane.ledger()
+        d2h = [r for r in rows if r["direction"] == "device_to_host"]
+        h2d = [r for r in rows if r["direction"] == "host_to_disk"]
+        assert d2h, "forced budget produced no device spills"
+        # the acceptance contract: ledger byte totals equal the
+        # catalog's own spill counters
+        assert sum(r["nbytes"] for r in d2h) == cat.spilled_device_to_host
+        assert sum(r["nbytes"] for r in h2d) == cat.spilled_host_to_disk
+        assert all(r["reason"] == "budget" for r in d2h)
+        assert [r["rank"] for r in d2h] == list(range(len(d2h)))
+        assert all(r["ms"] >= 0.0 for r in rows)
+        assert all(r["site"] == "operator" and r["op"] == "TpuSortExec"
+                   for r in rows)
+        # unspill prices the whole read-back (disk hop included) as ONE
+        # ledger record per materialize
+        n0 = len(memplane.ledger())
+        handles[0].materialize()
+        rows = memplane.ledger()
+        back = [r for r in rows[n0:] if r["direction"] == "unspill"]
+        assert len(back) == 1
+        assert back[0]["nbytes"] == handles[0].nbytes
+        for h in handles:
+            h.close()
+
+    def test_reason_threads_from_arena_and_pressure_paths(self):
+        from spark_rapids_tpu.memory.arena import DeviceManager
+        dm = DeviceManager.get()   # may itself reset the catalog: first
+        cat = _tiny_catalog()
+        saved = dm.catalog
+        dm.catalog = cat           # point admission at the tiny budget
+        try:
+            a = SpillableBatch(_batch(), op="A")
+            dm.reserve(cat.device_limit)                  # budget path
+            b = SpillableBatch(_batch(), op="B")
+            from spark_rapids_tpu.memory.pressure import oom_retry
+            calls = [0]
+
+            def flaky():
+                calls[0] += 1
+                if calls[0] == 1:
+                    raise RuntimeError(
+                        "RESOURCE_EXHAUSTED: out of memory")
+                return 1
+
+            assert oom_retry(flaky) == 1              # pressure path
+            reasons = [r["reason"] for r in memplane.ledger()]
+            assert "budget" in reasons and "pressure" in reasons
+            a.close()
+            b.close()
+        finally:
+            dm.catalog = saved
+
+    def test_pinned_working_set_signals_skip_not_silence(self):
+        cat = _tiny_catalog()
+        pinned = SpillableBatch(_batch(), op="PinnedOp", site="operator")
+        cat._entries[pinned.buffer_id].refcount = 1       # in active use
+        skipped0 = memplane.stats_section()["spill_skipped"]
+        spilled = cat.spill_device_to_fit(cat.device_limit)
+        assert spilled == 0                    # nothing evictable
+        sec = memplane.stats_section()
+        assert sec["spill_skipped"] == skipped0 + 1
+        evs = [e for e in flight.snapshot()
+               if e["kind"] == flight.EV_MEM and e["name"] == "pinned"]
+        assert evs and evs[-1]["a"] == pinned.nbytes
+        assert evs[-1]["b"] == 1               # pinned entry count
+        cat._entries[pinned.buffer_id].refcount = 0
+        pinned.close()
+
+    def test_ledger_bound_drops_and_counts(self):
+        memplane.configure(TpuConf({
+            "spark.rapids.tpu.obs.mem.maxLedger": 2}))
+        cat = _tiny_catalog()
+        handles = [SpillableBatch(_batch(64), op="X") for _ in range(6)]
+        cat.spill_device_to_fit(cat.device_limit)
+        assert len(memplane.ledger()) <= 2
+        assert memplane.ledger_dropped() > 0
+        assert memplane.stats_section()["ledger_dropped"] > 0
+        for h in handles:
+            h.close()
+
+    def test_disabled_plane_records_nothing(self):
+        memplane.configure(TpuConf({
+            "spark.rapids.tpu.obs.mem.enabled": False}))
+        assert not memplane.is_enabled()
+        cat = _tiny_catalog()
+        # note: catalog reset re-reads nothing; the off switch persists
+        memplane.configure(TpuConf({
+            "spark.rapids.tpu.obs.mem.enabled": False}))
+        handles = [SpillableBatch(_batch(), op="X") for _ in range(3)]
+        cat.spill_device_to_fit(cat.device_limit)
+        assert memplane.ledger() == []
+        assert memplane.owners()["owners"] == []
+        s = memplane.query_summary(None)
+        assert s["spill_ms"] == 0.0 and s["registered"]["count"] == 0
+        for h in handles:
+            h.close()
+
+    def test_active_windows_blame_mem_spill_timeline_gap(self):
+        # a 20ms idle window where the only evidence is ledger spill
+        # work -> the timeline classifies it mem_spill
+        from spark_rapids_tpu.obs import timeline
+        timeline.reset()
+        try:
+            memplane.note_spill(
+                memplane.DIR_DEVICE_TO_HOST, "b0", "q", "operator",
+                "Op", 1024, "budget", 0, 15 * MS, 0)
+            now = time.perf_counter_ns()
+            t0 = now - 20 * MS
+            s = timeline._summarize(0, t0, now, is_query=True)
+            assert s["gaps"]["mem_spill"] == pytest.approx(75.0, abs=5.0)
+            assert sum(s["gaps"].values()) + s["util_pct"] == \
+                pytest.approx(100.0, abs=0.5)
+            assert memplane.active_segments(t0, now)
+        finally:
+            timeline.reset()
+
+
+# ---------------------------------------------------------------------------
+# leak detection + headroom
+# ---------------------------------------------------------------------------
+
+class TestLeakAndHeadroom:
+    def test_leak_check_flags_unreleased_non_survivors(self):
+        with query_context(CancelToken("q-leak", None)):
+            leaked = SpillableBatch(_batch(), op="LeakyOp",
+                                    site="operator")
+            kept = SpillableBatch(_batch(64), op="ShuffleOut",
+                                  site="exchange")
+        leaks = memplane.leak_check("q-leak",
+                                    survivors=(kept.buffer_id,))
+        assert [lk["buffer_id"] for lk in leaks] == [leaked.buffer_id]
+        lk = leaks[0]
+        assert lk["op"] == "LeakyOp" and lk["site"] == "operator"
+        assert lk["tag"].startswith("test_memplane.py:")
+        assert lk["nbytes"] == leaked.nbytes
+        evs = [e for e in flight.snapshot()
+               if e["kind"] == flight.EV_MEM and e["name"] == "leak"]
+        assert evs and evs[-1]["b"] == 1
+        leaked.close()
+        kept.close()
+        assert memplane.leak_check("q-leak") == []
+
+    def test_headroom_decomposes_limit(self):
+        cat = _tiny_catalog(device_limit=1 << 20)
+        free_h = SpillableBatch(_batch(), op="Spillable")
+        pin = SpillableBatch(_batch(64), op="Pinned")
+        cat._entries[pin.buffer_id].refcount = 2
+        h = memplane.headroom()
+        assert h["device_limit"] == 1 << 20
+        assert h["device_bytes"] == cat.device_bytes
+        assert h["pinned_bytes"] == pin.nbytes
+        assert h["spillable_bytes"] == free_h.nbytes
+        assert h["free_bytes"] == h["device_limit"] - h["device_bytes"]
+        # what an admission could count on: free + evictable
+        assert h["headroom_bytes"] == \
+            h["free_bytes"] + h["spillable_bytes"]
+        cat._entries[pin.buffer_id].refcount = 0
+        free_h.close()
+        pin.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: session roll-up, event log, zero extra flushes, stability
+# ---------------------------------------------------------------------------
+
+def _shuffle_df(s):
+    return (s.create_dataframe(
+                {"k": [i % 7 for i in range(2000)],
+                 "v": [float(i) for i in range(2000)]}, num_partitions=2)
+            .group_by("k").agg(F.sum("v").alias("sv")))
+
+
+class TestEndToEnd:
+    def test_session_rollup_and_zero_extra_flushes(self):
+        from spark_rapids_tpu.columnar import pending
+        s = TpuSession(TpuConf({}))
+        df = _shuffle_df(s)
+        df.to_arrow()          # first run is the one that sets the peak
+        mem_first = s.last_query_memplane
+        assert mem_first["peak_advanced"]
+        assert mem_first["peak_device_bytes"] > 0
+        assert sum(mem_first["peak_by_site"].values()) == \
+            mem_first["peak_device_bytes"]
+        df.to_arrow()                                  # warm
+        mem_on = s.last_query_memplane
+        assert mem_on["registered"]["count"] > 0
+        assert mem_on["leaked_entries"] == 0           # no false leaks
+        by_site = {r["site"] for r in mem_on["registered"]["by_site"]}
+        assert "exchange" in by_site
+        flushes_on = s.last_query_flushes
+        f0 = pending.FLUSH_COUNT
+        df.to_arrow()
+        assert pending.FLUSH_COUNT - f0 == flushes_on
+        # the acceptance contract: disabling the plane changes NOTHING
+        # about device flushes — an exact FLUSH_COUNT delta
+        memplane.configure(TpuConf({
+            "spark.rapids.tpu.obs.mem.enabled": False}))
+        df.to_arrow()
+        assert s.last_query_flushes == flushes_on
+        assert s.last_query_memplane["registered"]["count"] == 0
+
+    def test_event_log_record_carries_memplane(self, tmp_path):
+        from spark_rapids_tpu.tools.events import read_event_log
+        log = str(tmp_path / "events.jsonl")
+        s = TpuSession(TpuConf({"spark.rapids.tpu.eventLog.path": log}))
+        _shuffle_df(s).to_arrow()
+        rec = list(read_event_log(log))[-1]
+        assert rec["peak_device_bytes"] > 0
+        assert rec["spill_ms"] == rec["memplane"]["spill_ms"]
+        assert rec["unspill_count"] == rec["memplane"]["unspill_count"]
+        assert rec["leaked_entries"] == 0
+        assert rec["memplane"]["registered"]["count"] > 0
+
+    def test_seeded_leak_lands_in_event_log_and_bundle(self, tmp_path):
+        from spark_rapids_tpu.obs import diagnostics
+        from spark_rapids_tpu.tools.events import read_event_log
+        log = str(tmp_path / "events.jsonl")
+        s = TpuSession(TpuConf({"spark.rapids.tpu.eventLog.path": log}))
+        with query_context(CancelToken("q-leak-e2e", None)):
+            leaked = SpillableBatch(_batch(), op="LeakyOp",
+                                    site="operator")
+            _shuffle_df(s).to_arrow()
+        rec = list(read_event_log(log))[-1]
+        assert rec["leaked_entries"] >= 1
+        tags = [lk["tag"] for lk in rec["memplane"]["leaks"]]
+        assert any(t.startswith("test_memplane.py:") for t in tags)
+        bundle = diagnostics.collect_bundle("test")
+        assert bundle["memory"]["leaked_total"] >= 1
+        assert "ledger_tail" in bundle["memory"]
+        mine = [e for e in bundle["arena"]["entries"]
+                if e["buffer_id"] == leaked.buffer_id]
+        assert mine and mine[0]["op"] == "LeakyOp"
+        assert mine[0]["owner_query"] == "q-leak-e2e"
+        assert mine[0]["tag"].startswith("test_memplane.py:")
+        leaked.close()
+
+    def test_registration_digest_stable_across_parallelism(self):
+        # the provenance surface must not depend on pipeline
+        # interleaving: the same batches register whatever the worker
+        # count (spill totals are timing-dependent, so the digest runs
+        # spill-free and covers registered.by_site)
+        digests = []
+        for par in (1, 4):
+            BufferCatalog.reset(spill_dir="/tmp/srt_test_spill")
+            s = TpuSession(TpuConf({
+                "spark.rapids.tpu.exec.pipelineParallelism": par}))
+            df = _shuffle_df(s)
+            df.to_arrow()                              # warm
+            df.to_arrow()
+            mem = s.last_query_memplane
+            digests.append(json.dumps(mem["registered"]["by_site"],
+                                      sort_keys=True))
+        assert digests[0] == digests[1]
+
+
+# ---------------------------------------------------------------------------
+# surfaces: Prometheus, stats section, tools/report.py
+# ---------------------------------------------------------------------------
+
+class TestSurfaces:
+    def test_prometheus_exposition_covers_mem_families(self):
+        cat = _tiny_catalog()
+        handles = [SpillableBatch(_batch(), op="X", site="operator")
+                   for _ in range(3)]
+        cat.spill_device_to_fit(cat.device_limit, reason="pressure")
+        text = render_text(get_registry())
+        for series in (
+                'tpu_mem_live_bytes{site="operator"}',
+                'tpu_mem_live_bytes{site="exchange"}',
+                'tpu_mem_spill_seconds_bucket',
+                "tpu_mem_headroom_bytes",
+                "tpu_mem_pinned_bytes",
+                "tpu_mem_spillable_bytes",
+                "tpu_mem_leaked_entries_total",
+                "tpu_mem_ledger_dropped_total"):
+            assert series in text, series
+        for h in handles:
+            h.close()
+
+    def test_stats_section_shape(self):
+        sb = SpillableBatch(_batch(), op="StatOp", site="operator")
+        sec = memplane.stats_section()
+        assert sec["enabled"]
+        assert sec["live_by_site"].get("operator") == sb.nbytes
+        assert sec["device_bytes"] == sb.nbytes
+        assert set(sec["spill"]) == set(memplane.DIRECTIONS)
+        assert sec["headroom"]["device_bytes"] == sb.nbytes
+        assert sec["owners"][0]["op"] == "StatOp"
+        sb.close()
+
+    def test_service_stats_carries_memory_section(self):
+        from spark_rapids_tpu.service import QueryService
+        s = TpuSession(TpuConf({}))
+        svc = QueryService(session=s, num_workers=1)
+        try:
+            snap = svc.stats().snapshot()
+            assert "memory" in snap
+            assert set(snap["memory"]["spill"]) == \
+                set(memplane.DIRECTIONS)
+        finally:
+            svc.shutdown(wait=True, timeout=10.0)
+
+    def test_report_renders_memory_section(self):
+        from spark_rapids_tpu.tools.report import memory_lines
+        rec = {"memplane": {
+            "peak_device_bytes": 4096, "spill_ms": 2.5,
+            "unspill_ms": 1.0, "unspill_count": 1, "spill_skipped": 0,
+            "leaked_entries": 1,
+            "peak_by_site": {"operator": 3072, "exchange": 1024},
+            "peak_owners": [{"query_id": "q1", "site": "operator",
+                             "op": "TpuSortExec", "bytes": 3072}],
+            "spill": {"device_to_host": {"count": 2, "bytes": 2048,
+                                         "ms": 2.5},
+                      "host_to_disk": {"count": 0, "bytes": 0,
+                                       "ms": 0.0},
+                      "unspill": {"count": 1, "bytes": 1024, "ms": 1.0}},
+            "ledger": [{"direction": "device_to_host", "site": "operator",
+                        "op": "TpuSortExec", "nbytes": 1024,
+                        "reason": "budget", "rank": 0, "ms": 1.2}],
+            "ledger_records": 3,
+            "leaks": [{"buffer_id": "b1", "tier": 0, "nbytes": 512,
+                       "site": "operator", "op": "LeakyOp",
+                       "tag": "exec.py:42", "refcount": 0}]}}
+        text = "\n".join(memory_lines(rec))
+        assert "peak_device_bytes=4096" in text
+        assert "operator" in text and "75.0%" in text   # 3072 of 4096
+        assert "device_to_host" in text and "budget" in text
+        assert "leaked registrations" in text
+        assert "registered_at=exec.py:42" in text
+
+    def test_report_tolerates_pre_memplane_records(self):
+        from spark_rapids_tpu.tools.report import memory_lines
+        (line,) = memory_lines({"query_id": "old"})
+        assert "no memplane recorded" in line
