@@ -156,7 +156,10 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
             # static PV-FLUSH prediction for the same warm query
             # (analysis/flush_budget.py — must equal `flushes`)
             "predicted_flushes": getattr(
-                s, "last_query_predicted_flushes", None)}
+                s, "last_query_predicted_flushes", None),
+            # cross-plane doctor verdict for the same warm query
+            # (obs/doctor.py)
+            "diagnosis": getattr(s, "last_query_diagnosis", None)}
     return best, flushes, (prof.to_dict() if prof is not None
                            else None), perf
 
@@ -230,6 +233,7 @@ def main():
     cpu_t, _, _, _ = run_engine(False, n_rows, parts, repeats)
     service_p99 = measure_service_p99()
     disp = (tpu_prof or {}).get("dispatches", {}).get("all", {})
+    diag = tpu_perf.get("diagnosis")
     tl = tpu_perf.get("timeline") or {}
     net = tpu_perf.get("netplane") or {}
     mem = tpu_perf.get("memplane") or {}
@@ -294,6 +298,17 @@ def main():
         "peak_device_bytes": tpu_perf.get("mem_peak_bytes"),
         "spill_ms": mem.get("spill_ms"),
         "spill_tax_pct": round(tier_ms / (tpu_exact_t * 1000) * 100, 2),
+        # cross-plane query doctor (obs/doctor.py): the warm headline
+        # query's primary-bottleneck verdict and the Amdahl speedup
+        # bound for eliminating it — the one-line answer the six
+        # plane keys above feed
+        "doctor_primary_cause": (diag.primary_cause
+                                 if diag is not None else None),
+        "doctor_primary_share_pct": (diag.primary_share_pct
+                                     if diag is not None else None),
+        "doctor_headroom_x": (diag.headroom[0]["bound_x"]
+                              if diag is not None and diag.headroom
+                              else None),
     }))
 
 

@@ -117,11 +117,33 @@ def main():
         assert warm_on < warm_off, \
             f"{q}: carving did not reduce flushes " \
             f"(on={warm_on} off={warm_off})"
+        # -- cross-plane doctor (obs/doctor.py): the acceptance sweep —
+        # exactly one primary-bottleneck verdict per query, contribution
+        # shares summing to 100, and every headroom bound equal to the
+        # Amdahl bound of its timeline gap share, at zero extra flushes
+        # (the warm_on delta above already ran with the doctor enabled)
+        diag = s_on.last_query_diagnosis
+        assert diag is not None, f"{q}: no doctor verdict"
+        shares = diag.data["shares"]
+        assert abs(sum(shares.values()) - 100.0) < 1e-6, \
+            f"{q}: doctor shares sum to {sum(shares.values())}"
+        assert diag.primary_cause in shares, q
+        tl_gaps = s_on.last_query_timeline["gaps"]
+        by_cause = {c["cause"]: c for c in diag.headroom}
+        for cause, share in tl_gaps.items():
+            if share <= 0:
+                continue
+            bound = by_cause[cause]["bound_x"]
+            want = 1.0 / (1.0 - by_cause[cause]["share_pct"] / 100.0)
+            assert abs(bound - want) < 1e-2, \
+                f"{q}: {cause} headroom {bound} != Amdahl {want:.3f}"
         print(f"  {q}: rows={len(rows_on)} warm_flushes "
               f"on={warm_on} off={warm_off} "
               f"(predicted on={pred_on.expected(len(rows_on))} "
               f"off={pred_off.expected(len(rows_off))}) "
-              f"stages={len(stages)} fused_joins={len(joins)}")
+              f"stages={len(stages)} fused_joins={len(joins)} "
+              f"doctor={diag.primary_cause}"
+              f"@{diag.primary_share_pct:.1f}%")
 
     # -- compile-scoped lint clean on the compiler's own files
     findings = []

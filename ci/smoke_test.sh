@@ -59,7 +59,18 @@ JAX_PLATFORMS=cpu python ci/stats_smoke.py
 echo "== api validation (docs vs live registry) =="
 python -m spark_rapids_tpu.tools.api_validation
 
-echo "== bench sanity (tiny) =="
-python bench.py 100000
+echo "== perf regression gate (newest BENCH_r* vs PERF_BASELINE) =="
+JAX_PLATFORMS=cpu python ci/perf_gate.py
+# seeded self-tests: a -20% throughput record must TRIP the gate...
+if JAX_PLATFORMS=cpu python ci/perf_gate.py --fixture regression >/dev/null; then
+  echo "perf-gate regression fixture did NOT trip the gate" >&2; exit 1
+fi
+# ...and a +50% record must pass AND suggest a baseline bump
+JAX_PLATFORMS=cpu python ci/perf_gate.py --fixture improvement \
+  | grep -q "baseline bump" \
+  || { echo "perf-gate improvement fixture missing bump suggestion" >&2; exit 1; }
+
+echo "== bench sanity (tiny, gated on row-count-independent keys) =="
+JAX_PLATFORMS=cpu python ci/perf_gate.py --run 100000
 
 echo "CI smoke: OK"

@@ -30,6 +30,12 @@ the reference plugin, applied end-to-end):
   ``spark_rapids_tpu`` source tree (lock discipline, host-sync bans,
   conf/doc drift, hygiene).  CLI entry: ``ci/lint.py``.
 
+- ``regression``: performance regression sentinel — the longitudinal
+  ``BENCH_r*.json`` ledger loader (tolerant of the legacy wrapper and
+  bare key-set shapes, placeholder rows for pre-r06 key gaps), the
+  committed ``PERF_BASELINE.json`` schema, and the noise-aware
+  baseline comparison behind ``ci/perf_gate.py``.
+
 Shared finding format: (rule id, file:line, message) — see
 ``docs/analysis.md`` for the rule catalog.
 """
@@ -39,6 +45,9 @@ from .lint import Finding, lint_paths, lint_project, lint_source
 from .flush_budget import FlushPrediction, predict_flushes
 from .program_audit import (AuditBuildError, AuditReport, AuditSpec,
                             audit_all, audit_spec, collect_specs)
+from .regression import (BenchRound, Delta, compare, improvements,
+                         load_baseline, load_history, make_baseline,
+                         parse_record, regressions, seeded_record)
 
 __all__ = [
     "PlanVerificationError", "PlanVerificationReport", "Violation",
@@ -47,4 +56,7 @@ __all__ = [
     "FlushPrediction", "predict_flushes",
     "AuditBuildError", "AuditReport", "AuditSpec",
     "audit_all", "audit_spec", "collect_specs",
+    "BenchRound", "Delta", "compare", "improvements",
+    "load_baseline", "load_history", "make_baseline",
+    "parse_record", "regressions", "seeded_record",
 ]

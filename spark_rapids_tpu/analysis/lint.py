@@ -716,17 +716,22 @@ def _scopes_for(rel: str) -> Set[str]:
             base in ("pipeline.py", "superstage.py", "exchange.py",
                      "stats.py", "profile.py", "timeline.py",
                      "compile_watch.py", "slo.py", "netplane.py",
-                     "memplane.py"):
+                     "memplane.py", "doctor.py", "regression.py"):
         # the superstage compiler exists to ELIMINATE host round trips:
         # a stray device_get/np.asarray in compile/ or the wrapper
         # would silently reintroduce the cost it removes; the stats
         # plane (obs/stats.py, obs/profile.py), the performance plane
         # (obs/timeline.py, obs/compile_watch.py, obs/slo.py), the
         # transport plane (obs/netplane.py), the memory plane
-        # (obs/memplane.py) and their exchange call sites carry the
-        # same zero-flush + allocation-free-record contract
+        # (obs/memplane.py), the cross-plane doctor (obs/doctor.py),
+        # the regression sentinel (analysis/regression.py) and their
+        # exchange call sites carry the same zero-flush +
+        # allocation-free-record contract
         scopes |= {SYNC001, OBS002}
-    if "obs" in parts:
+    if "obs" in parts or base == "regression.py":
+        # the doctor lives in obs/ (covered by the parts check); the
+        # sentinel sits in analysis/ but carries the same timing-
+        # hygiene contract as the planes whose artifacts it gates
         scopes |= {HYG002}
     if "exec" in parts:
         scopes |= {HYG003}

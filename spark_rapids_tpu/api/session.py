@@ -257,6 +257,7 @@ class TpuSession:
         # flush budget is benchmarked)
         from ..columnar import pending
         from ..obs import compile_watch as _cwatch
+        from ..obs import doctor as _doctor
         from ..obs import memplane as _memplane
         from ..obs import netplane as _netplane
         from ..obs import profile as _profile
@@ -415,6 +416,27 @@ class TpuSession:
                 import logging
                 logging.getLogger("spark_rapids_tpu.obs.stats").warning(
                     "stats profile build failed", exc_info=True)
+        # cross-plane query doctor (obs/doctor.py): joins the summaries
+        # gathered above into one primary-bottleneck verdict — pure
+        # host arithmetic over dicts already in hand, after the final
+        # flush, so the FLUSH_COUNT delta above is unchanged
+        self.last_query_diagnosis = None
+        if _doctor.enabled(conf):
+            try:
+                diag = _doctor.diagnose(
+                    tl, inline_compile_ms=inline_compile_ms,
+                    netplane=net, memplane=mem, flushes=int(flushes),
+                    predicted_flushes=predicted_flushes,
+                    sem_wait_ms=sem_wait_ms,
+                    stats_profile=self.last_stats_profile,
+                    query_id=token.query_id if token is not None
+                    else None)
+                self.last_query_diagnosis = diag
+                extra["doctor"] = diag.to_dict()
+            except Exception:  # noqa: BLE001 — doctor never fails a query
+                import logging
+                logging.getLogger("spark_rapids_tpu.obs.doctor").warning(
+                    "query diagnosis failed", exc_info=True)
         self._log_query(phys, (_time.perf_counter() - t0) * 1000,
                         conf=conf, fallbacks=fallbacks, extra=extra)
         target = schema_to_arrow(phys.output_schema) if len(

@@ -591,6 +591,19 @@ OBS_MEM_MAX_LEDGER = conf_int(
     "work windows (the mem_spill timeline evidence); past it new "
     "records are dropped and counted in tpu_mem_ledger_dropped_total "
     "(fixed memory — the flight-recorder discipline)")
+OBS_DOCTOR_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.doctor.enabled", True,
+    "Cross-plane query doctor (obs/doctor.py): joins the per-query "
+    "plane artifacts (utilization-gap taxonomy, inline_compile_ms, "
+    "shuffle host-drop tax, memplane spill ledger, predicted-vs-"
+    "observed flushes, StatsProfile digest) into one QueryDiagnosis "
+    "with exactly one primary bottleneck, contribution shares summing "
+    "to 100, Amdahl-modeled headroom per candidate fix, and a ranked "
+    "mapping onto ROADMAP items 1-4.  Surfaced on "
+    "session.last_query_diagnosis, the event-log record, "
+    "Service.stats() and tpu_doctor_verdicts_total.  Pure post-query "
+    "host arithmetic over already-collected summaries: zero extra "
+    "device flushes by construction")
 SUPERSTAGE = conf_bool(
     "spark.rapids.tpu.sql.superstage", True,
     "Superstage compiler (compile/): a planner post-pass after the "

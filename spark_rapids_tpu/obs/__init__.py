@@ -53,12 +53,19 @@ Layers, mirroring the reference plugin's observability story
   timeline gap cause), retention/leak detection at query terminal
   states, and the admission headroom forecast.
 
+- ``obs.doctor`` — cross-plane query doctor: joins the per-query
+  artifacts of every plane above into one ``QueryDiagnosis`` —
+  exactly one primary bottleneck with priority-ordered evidence,
+  contribution shares summing to 100 (the PR 8 gap taxonomy plus the
+  busy share as ``device_compute``), Amdahl-modeled headroom per
+  candidate fix, and a ranked mapping onto ROADMAP items 1-4.
+
 The per-query report generator that joins the event log with these
 streams lives in ``tools/report.py`` (the SQL-UI stand-in).
 """
 from . import (trace, registry, prom, flight, timeline,     # noqa: F401
                compile_watch, slo, profile, netplane,       # noqa: F401
-               memplane)                                    # noqa: F401
+               memplane, doctor)                            # noqa: F401
 from .registry import get_registry  # noqa: F401
 from .trace import span, traced     # noqa: F401
 

@@ -1,0 +1,368 @@
+"""Cross-plane query doctor: one verdict from six observability planes.
+
+PRs 7-11 each added a per-query telemetry plane (dispatch stats,
+utilization timeline, compile watch, shuffle netplane, memplane,
+PV-FLUSH prediction) but left the *join* to the operator: deciding
+whether a query is shuffle-host-bound, compile-bound or spill-bound
+meant reading six report sections side by side.  The doctor is that
+join — the profiling-tools role of the reference plugin (workload
+qualification + profile analysis) applied to our own planes.
+
+``diagnose()`` consumes the artifacts the session already collected at
+end of query (timeline summary, ``inline_compile_ms``, netplane and
+memplane roll-ups, observed vs predicted flushes) and produces a
+:class:`QueryDiagnosis`:
+
+- **contribution shares summing to 100**: the timeline's gap taxonomy
+  (PR 8) already satisfies ``util_pct + sum(gap shares) == 100`` by
+  construction; the doctor re-labels ``util_pct`` as the
+  ``device_compute`` cause and carries the gap causes through, so the
+  breakdown stays a partition of the query's wall window.
+- **exactly one primary bottleneck**: the largest share, ties broken
+  by the fixed taxonomy priority order (never by dict order).
+- **Amdahl headroom per candidate fix**: eliminating a cause with
+  share ``s`` bounds end-to-end speedup at ``1 / (1 - s/100)`` —
+  "eliminating ``shuffle_host`` bounds speedup at <=1.31x".
+- **ranked ROADMAP mapping**: every cause maps to one of ROADMAP
+  open items 1-4, so the verdict names the planned fix, not just the
+  symptom.
+- **cross-plane evidence**: each candidate cites the corroborating
+  plane counter (``host_drop_tax_ms`` for ``shuffle_host``,
+  ``spill_ms`` for ``mem_spill``, ``inline_compile_ms`` for
+  ``inline_compile``, observed-vs-predicted flushes for
+  ``device_compute``), so a share is never asserted without the raw
+  number behind it.
+
+Pure post-query host arithmetic over already-collected summaries:
+zero extra device flushes by construction, no hot-path presence at
+all.  ``stable_digest()`` covers only timing-independent structure
+(primary cause + the fixed cause->roadmap table), so it is stable
+across pipeline parallelism and superstage on/off whenever the
+dominant cause is — the doctor-determinism acceptance criterion.
+
+``diagnose_bench()`` applies the same model to a ``BENCH_r*.json``
+record (``util_gap_breakdown`` + ``device_util_pct`` keys), which is
+how ``ci/perf_gate.py`` prints a verdict for a regressed benchmark.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Dict, List, Optional
+
+from .registry import DOCTOR_VERDICTS, TIMELINE_GAP_CAUSES
+
+#: model version — bumped whenever the share model or the
+#: cause->roadmap table changes (part of stable_digest()).
+MODEL_VERSION = 1
+
+#: verdict taxonomy, in PRIORITY ORDER: ``device_compute`` (the busy
+#: share, re-labeled from the timeline's ``util_pct``) first, then the
+#: PR 8 idle-gap causes in their registry order.  Ties on share are
+#: broken by position here, so the primary verdict is deterministic.
+#: Each entry: (cause, roadmap item 1-4 or None, one-line fix).
+#: ``idle`` is process-only (query windows fold the remainder into
+#: ``host_staging``) and maps to no fix.
+TAXONOMY = (
+    ("device_compute", 4,
+     "Pallas-native operator core: make the busy share itself cheaper "
+     "(fewer fusion breakers, kernel-level join/agg)"),
+    ("inline_compile", 3,
+     "AOT shape-bucketed compile cache + warmup: move first-touch "
+     "compiles off the query path"),
+    ("sem_wait", 1,
+     "mesh-sharded multi-query execution: stop serializing on the "
+     "single-device dispatch semaphore"),
+    ("admission_queue", 3,
+     "admission-aware warmup + capacity tuning: drain the queue wait "
+     "before the query window opens"),
+    ("shuffle_host", 1,
+     "HBM-resident ICI shuffle: keep exchange payloads on-device "
+     "instead of the host bounce path"),
+    ("mem_spill", 2,
+     "adaptive query execution from live stats: right-size partitions "
+     "so working sets fit the device tier"),
+    ("host_staging", 4,
+     "wider superstages / Pallas scan path: fewer host->device "
+     "staging handoffs per batch"),
+    ("pipeline_starvation", 2,
+     "adaptive partition coalescing: keep producer morsels large "
+     "enough to feed the device pipeline"),
+    ("idle", None, ""),
+)
+
+_CAUSE_ORDER = {c: i for i, (c, _item, _fix) in enumerate(TAXONOMY)}
+_CAUSE_ROADMAP = {c: item for c, item, _fix in TAXONOMY}
+_CAUSE_FIX = {c: fix for c, _item, fix in TAXONOMY}
+
+_ENABLED = True
+_LOCK = threading.Lock()
+_VERDICT_COUNTS: Dict[str, int] = {}
+_LAST: Optional[Dict] = None
+
+
+class QueryDiagnosis:
+    """The doctor's verdict for one query window.
+
+    ``data`` keys: ``query_id``, ``primary_cause``,
+    ``primary_share_pct``, ``shares`` (cause -> pct, summing to 100),
+    ``headroom`` (ranked candidate list of ``{cause, share_pct,
+    bound_x, roadmap_item, fix, evidence}``), ``flushes``,
+    ``predicted_flushes``, ``model_version``.
+    """
+
+    def __init__(self, data: Dict):
+        self.data = data
+
+    @property
+    def primary_cause(self) -> str:
+        return self.data["primary_cause"]
+
+    @property
+    def primary_share_pct(self) -> float:
+        return self.data["primary_share_pct"]
+
+    @property
+    def headroom(self) -> List[Dict]:
+        return self.data["headroom"]
+
+    def to_dict(self) -> Dict:
+        return dict(self.data)
+
+    def stable_digest(self) -> str:
+        """sha256 over the timing-independent verdict structure.
+
+        Follows the StatsProfile discipline exactly: timings are
+        excluded (StatsProfile.stable_digest drops dispatch
+        durations; here the measured shares, bounds and the primary
+        cause they select are all wall-time observations and move
+        with execution config), and what remains is the cause+
+        headroom MODEL — the taxonomy with its cause->roadmap
+        mapping and Amdahl bound rule — keyed by the query's
+        data-dependent identity (the StatsProfile digest when the
+        stats plane ran).  Same query x same model -> same digest
+        across pipeline parallelism {1,4} x superstage on/off, the
+        doctor-determinism acceptance contract.
+        """
+        payload = {
+            "model_version": MODEL_VERSION,
+            "taxonomy": [(c, item) for c, item, _fix in TAXONOMY],
+            "headroom_model": "amdahl:1/(1-share/100)",
+            "stats_digest": self.data.get("stats_digest"),
+        }
+        blob = json.dumps(payload, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def verdict_line(self) -> str:
+        """One-line human verdict ("shuffle_host 23.7% -> <=1.31x …)."""
+        d = self.data
+        item = _CAUSE_ROADMAP.get(d["primary_cause"])
+        where = f" (ROADMAP item {item})" if item else ""
+        return (f"primary bottleneck {d['primary_cause']} at "
+                f"{d['primary_share_pct']:.1f}% — eliminating it bounds "
+                f"speedup at <={d['headroom'][0]['bound_x']:.2f}x"
+                f"{where}") if d["headroom"] else \
+            f"primary bottleneck {d['primary_cause']}"
+
+
+def _amdahl_bound(share_pct: float) -> float:
+    """Upper bound on end-to-end speedup from eliminating a phase
+    that occupies ``share_pct`` of the wall window (Amdahl's law)."""
+    s = max(0.0, min(share_pct, 100.0)) / 100.0
+    if s >= 1.0:
+        return float("inf")
+    return 1.0 / (1.0 - s)
+
+
+def _normalized_shares(util_pct: float, gaps: Dict[str, float]
+                       ) -> Dict[str, float]:
+    """Busy + gap shares as one partition summing to exactly 100.
+
+    The timeline rounds each component to 3 decimals, so the raw sum
+    can drift by a few millipercent; the residue is folded into the
+    largest component so downstream consumers can assert the
+    sum-to-100 invariant exactly (to float epsilon).
+    """
+    shares = {"device_compute": max(0.0, float(util_pct))}
+    for cause in TIMELINE_GAP_CAUSES:
+        shares[cause] = max(0.0, float(gaps.get(cause, 0.0)))
+    total = sum(shares.values())
+    if total <= 0.0:
+        # no observed window at all (e.g. a metadata-only query):
+        # attribute everything to host staging
+        shares["host_staging"] = 100.0
+        return shares
+    top = max(shares, key=lambda c: (shares[c], -_CAUSE_ORDER[c]))
+    shares[top] = round(shares[top] + (100.0 - total), 6)
+    return shares
+
+
+def _evidence(cause: str, *, inline_compile_ms: float,
+              netplane: Optional[Dict], memplane: Optional[Dict],
+              flushes: int, predicted_flushes: Optional[int],
+              sem_wait_ms: float, busy_ms: float) -> str:
+    """Corroborating raw counter from the owning plane, as a string."""
+    if cause == "device_compute":
+        pred = ("?" if predicted_flushes is None
+                else str(int(predicted_flushes)))
+        return (f"busy_ms={busy_ms:.1f} over flushes={int(flushes)} "
+                f"(predicted={pred})")
+    if cause == "inline_compile":
+        return f"inline_compile_ms={inline_compile_ms:.1f}"
+    if cause == "sem_wait":
+        return f"sem_wait_ms={sem_wait_ms:.1f}"
+    if cause == "shuffle_host" and netplane:
+        edges = netplane.get("edges", 0)
+        if not isinstance(edges, (int, float)):
+            edges = len(edges or [])
+        return (f"host_drop_tax_ms={netplane.get('host_drop_tax_ms', 0)} "
+                f"over edges={int(edges)} "
+                f"skew={netplane.get('edge_skew', 0)}")
+    if cause == "mem_spill" and memplane:
+        spill = memplane.get("spill", {}) or {}
+        moves = sum(int(v.get("count", 0)) for v in spill.values()
+                    if isinstance(v, dict))
+        return (f"spill_ms={memplane.get('spill_ms', 0)} over "
+                f"{moves} tier moves, "
+                f"peak_device_bytes={memplane.get('peak_device_bytes', 0)}")
+    return ""
+
+
+def diagnose(timeline_summary: Dict, *,
+             inline_compile_ms: float = 0.0,
+             netplane: Optional[Dict] = None,
+             memplane: Optional[Dict] = None,
+             flushes: int = 0,
+             predicted_flushes: Optional[int] = None,
+             sem_wait_ms: float = 0.0,
+             stats_profile=None,
+             query_id: Optional[str] = None) -> QueryDiagnosis:
+    """Join the per-query plane summaries into one verdict.
+
+    Called by the session AFTER every plane summary is already
+    collected — reads dictionaries only, never touches the device.
+    """
+    util_pct = float(timeline_summary.get("util_pct", 0.0))
+    gaps = timeline_summary.get("gaps", {}) or {}
+    shares = _normalized_shares(util_pct, gaps)
+
+    # exactly one primary: max share, fixed taxonomy order as the
+    # deterministic tie-break
+    primary = min(shares, key=lambda c: (-shares[c], _CAUSE_ORDER[c]))
+
+    candidates = []
+    for cause, _item, _fix in TAXONOMY:
+        share = shares.get(cause, 0.0)
+        if share <= 0.0 or cause == "idle":
+            continue
+        candidates.append({
+            "cause": cause,
+            "share_pct": round(share, 3),
+            "bound_x": round(_amdahl_bound(share), 3),
+            "roadmap_item": _CAUSE_ROADMAP[cause],
+            "fix": _CAUSE_FIX[cause],
+            "evidence": _evidence(
+                cause, inline_compile_ms=inline_compile_ms,
+                netplane=netplane, memplane=memplane, flushes=flushes,
+                predicted_flushes=predicted_flushes,
+                sem_wait_ms=sem_wait_ms,
+                busy_ms=float(timeline_summary.get("busy_ms", 0.0))),
+        })
+    # ranked: largest modeled headroom first, taxonomy order on ties
+    candidates.sort(key=lambda c: (-c["share_pct"],
+                                   _CAUSE_ORDER[c["cause"]]))
+
+    data = {
+        "query_id": query_id,
+        "model_version": MODEL_VERSION,
+        "primary_cause": primary,
+        "primary_share_pct": round(shares[primary], 3),
+        "shares": {c: round(v, 3) for c, v in shares.items()},
+        "headroom": candidates,
+        "flushes": int(flushes),
+        "predicted_flushes": predicted_flushes,
+    }
+    if stats_profile is not None:
+        try:
+            data["stats_digest"] = stats_profile.stable_digest()
+        except Exception:  # noqa: BLE001 — diagnosis never fails a query
+            pass
+    diag = QueryDiagnosis(data)
+    _record_verdict(diag)
+    return diag
+
+
+def diagnose_bench(record: Dict) -> Optional[QueryDiagnosis]:
+    """Build a verdict from a parsed ``BENCH_r*.json`` key set.
+
+    Returns ``None`` when the record predates the timeline keys
+    (rounds before r08 have no ``util_gap_breakdown``) — the perf
+    gate's placeholder tolerance.
+    """
+    gaps = record.get("util_gap_breakdown")
+    util = record.get("device_util_pct")
+    if not isinstance(gaps, dict) or util is None:
+        return None
+    tl = {"util_pct": float(util), "gaps": gaps,
+          "busy_ms": float(record.get("device_busy_ms", 0.0))}
+    net = {"host_drop_tax_ms": record.get("host_drop_tax_ms", 0),
+           "edge_skew": record.get("shuffle_edge_skew", 0),
+           "edges": []}
+    mem = {"spill_ms": record.get("spill_ms", 0), "spill": {},
+           "peak_device_bytes": record.get("peak_device_bytes", 0)}
+    return diagnose(
+        tl,
+        inline_compile_ms=float(record.get("inline_compile_ms") or 0.0),
+        netplane=net, memplane=mem,
+        flushes=int(record.get("flushes") or 0),
+        predicted_flushes=record.get("predicted_flushes"),
+        query_id=record.get("metric"))
+
+
+def _record_verdict(diag: QueryDiagnosis) -> None:
+    global _LAST
+    cause = diag.primary_cause
+    DOCTOR_VERDICTS.labels(cause=cause).inc()
+    with _LOCK:
+        _VERDICT_COUNTS[cause] = _VERDICT_COUNTS.get(cause, 0) + 1
+        _LAST = {"query_id": diag.data.get("query_id"),
+                 "primary_cause": cause,
+                 "primary_share_pct": diag.primary_share_pct}
+
+
+def stats_section() -> Dict:
+    """The ``doctor`` block of ``Service.stats()``."""
+    with _LOCK:
+        out = {"enabled": bool(_ENABLED),
+               "verdicts": dict(_VERDICT_COUNTS)}
+        if _LAST is not None:
+            out["last"] = dict(_LAST)
+    return out
+
+
+def enabled(conf=None) -> bool:
+    """Plane gate: module default, overridden per-session by conf."""
+    if conf is not None:
+        from ..config import OBS_DOCTOR_ENABLED
+        return bool(conf.get(OBS_DOCTOR_ENABLED))
+    return _ENABLED
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.doctor.*`` conf group."""
+    global _ENABLED
+    from ..config import OBS_DOCTOR_ENABLED
+    _ENABLED = bool(conf.get(OBS_DOCTOR_ENABLED))
+
+
+def reset() -> None:
+    """Test hook: drop verdict counts and the last-verdict cache."""
+    global _LAST
+    with _LOCK:
+        _VERDICT_COUNTS.clear()
+        _LAST = None

@@ -600,6 +600,14 @@ for _cause in TIMELINE_GAP_CAUSES:
     DEVICE_IDLE_PCT.labels(cause=_cause).set_function(
         lambda c=_cause: _timeline_mod().process_gap_pct(c))
 
+DOCTOR_VERDICTS = _REGISTRY.counter(
+    "tpu_doctor_verdicts_total",
+    "Primary-bottleneck verdicts issued by the cross-plane query "
+    "doctor (obs/doctor.py), by cause; one increment per diagnosed "
+    "query, exactly one cause each — the cause set is device_compute "
+    "plus the TIMELINE_GAP_CAUSES taxonomy",
+    labels=("cause",))
+
 SLO_LATENCY_SECONDS = _REGISTRY.histogram(
     "tpu_slo_latency_seconds",
     "Per-tenant service latency by phase: end_to_end (queue wait + "

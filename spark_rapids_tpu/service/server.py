@@ -29,6 +29,7 @@ from ..config import (TpuConf, set_active, EVENT_LOG_PATH,
                       OBS_WATCHDOG_STALL_S, OBS_DIAG_DIR,
                       OBS_DIAG_MAX_BUNDLES)
 from ..obs import compile_watch as _cwatch
+from ..obs import doctor as _doctor
 from ..obs import flight as _flight
 from ..obs import memplane as _memplane
 from ..obs import netplane as _netplane
@@ -166,6 +167,7 @@ class QueryService:
         _timeline.configure(conf)
         _netplane.configure(conf)
         _memplane.configure(conf)
+        _doctor.configure(conf)
         # stats().snapshot() carries the live obs sections alongside the
         # lifecycle counters (the monitoring one-stop view)
         self._stats.set_extras(lambda: {
@@ -177,6 +179,7 @@ class QueryService:
             "timeline": _timeline.process_summary(),
             "shuffle": _netplane.stats_section(),
             "memory": _memplane.stats_section(),
+            "doctor": _doctor.stats_section(),
         })
 
     # -- lifecycle ---------------------------------------------------------

@@ -382,6 +382,55 @@ def memory_lines(rec: Dict) -> List[str]:
     return lines
 
 
+def doctor_lines(rec: Dict) -> List[str]:
+    """The cross-plane doctor section of one engine record: the
+    primary-bottleneck verdict, the sum-to-100 contribution shares and
+    the ranked Amdahl-headroom candidates mapped onto ROADMAP items —
+    obs/doctor.py's event-log surface.  Placeholder-tolerant on
+    pre-r12 logs (same convention as ``--memory`` on pre-r11 logs)."""
+    doc = rec.get("doctor")
+    if not doc:
+        return ["  (no doctor verdict recorded — older log or "
+                "spark.rapids.tpu.obs.doctor.enabled=false)"]
+    lines = ["-- query doctor (cross-plane verdict) --"]
+    lines.append(
+        f"  primary bottleneck: {doc.get('primary_cause')} at "
+        f"{_fmt(doc.get('primary_share_pct'))}% of the query window")
+    shares = doc.get("shares") or {}
+    if shares:
+        lines.append("  contribution shares (sum to 100):")
+        for cause, pct in sorted(shares.items(), key=lambda kv: -kv[1]):
+            if not pct:
+                continue
+            bar = "#" * int(round(float(pct) / 5.0))
+            lines.append(f"    {cause:<20s}{float(pct):6.1f}%  {bar}")
+    cands = doc.get("headroom") or []
+    if cands:
+        lines.append("  modeled headroom per candidate fix "
+                     "(Amdahl bound):")
+        lines.append(f"    {'cause':<20s}{'share':>7s}{'bound':>8s}"
+                     f"  {'roadmap':<9s}fix")
+        for c in cands:
+            item = c.get("roadmap_item")
+            lines.append(
+                f"    {str(c.get('cause')):<20s}"
+                f"{_fmt(c.get('share_pct')):>6}%"
+                f"  <={_fmt(c.get('bound_x'))}x"
+                f"  {('item ' + str(item)) if item else '-':<9s}"
+                f"{str(c.get('fix'))[:46]}")
+            if c.get("evidence"):
+                lines.append(f"      evidence: {c['evidence']}")
+    flushes, pred = doc.get("flushes"), doc.get("predicted_flushes")
+    if flushes is not None:
+        line = f"  flushes={flushes} predicted={_fmt(pred)}"
+        if pred is not None and pred != flushes:
+            line += " [!! PV-FLUSH mismatch]"
+        lines.append(line)
+    if doc.get("stats_digest"):
+        lines.append(f"  stats_digest={doc['stats_digest'][:16]}…")
+    return lines
+
+
 def stats_lines(prof: Dict) -> List[str]:
     """Text sections for one record's StatsProfile (obs/stats.py)."""
     lines: List[str] = []
@@ -433,7 +482,8 @@ def render_query_report(query_id, story: Dict,
                         trace_events: Optional[List[Dict]] = None,
                         show_stats: bool = False,
                         show_shuffle: bool = False,
-                        show_memory: bool = False) -> str:
+                        show_memory: bool = False,
+                        show_doctor: bool = False) -> str:
     """One query's full text report."""
     lines = [f"=== query {query_id} " + "=" * 40]
     engine = story.get("engine", [])
@@ -477,6 +527,8 @@ def render_query_report(query_id, story: Dict,
             lines.extend(shuffle_lines(rec))
         if show_memory:
             lines.extend(memory_lines(rec))
+        if show_doctor:
+            lines.extend(doctor_lines(rec))
         if show_stats:
             prof = rec.get("stats_profile")
             if prof:
@@ -533,7 +585,8 @@ def render_report(stories: Dict,
                   trace_events: Optional[List[Dict]] = None,
                   query_id=None, show_stats: bool = False,
                   show_shuffle: bool = False,
-                  show_memory: bool = False) -> str:
+                  show_memory: bool = False,
+                  show_doctor: bool = False) -> str:
     ids = [query_id] if query_id is not None else sorted(
         stories, key=lambda q: str(q))
     parts = []
@@ -547,7 +600,8 @@ def render_report(stories: Dict,
         parts.append(render_query_report(qid, stories[qid], trace_events,
                                          show_stats=show_stats,
                                          show_shuffle=show_shuffle,
-                                         show_memory=show_memory))
+                                         show_memory=show_memory,
+                                         show_doctor=show_doctor))
     return "\n\n".join(parts)
 
 
@@ -555,7 +609,8 @@ def render_html(stories: Dict,
                 trace_events: Optional[List[Dict]] = None,
                 query_id=None, show_stats: bool = False,
                 show_shuffle: bool = False,
-                show_memory: bool = False) -> str:
+                show_memory: bool = False,
+                show_doctor: bool = False) -> str:
     """Self-contained single-file HTML wrapping the text report
     per-query (monospace <pre> sections with a query index)."""
     ids = [query_id] if query_id is not None else sorted(
@@ -568,7 +623,8 @@ def render_html(stories: Dict,
         txt = render_query_report(qid, stories[qid], trace_events,
                                   show_stats=show_stats,
                                   show_shuffle=show_shuffle,
-                                  show_memory=show_memory)
+                                  show_memory=show_memory,
+                                  show_doctor=show_doctor)
         body.append(f'<h2 id="q{_html.escape(str(qid))}">'
                     f"query {_html.escape(str(qid))}</h2>")
         body.append(f"<pre>{_html.escape(txt)}</pre>")
@@ -584,7 +640,7 @@ def main(argv=None):
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: report <event_log.jsonl> [--query QID] "
               "[--trace trace.json] [--html out.html] [--stats] "
-              "[--shuffle] [--memory]",
+              "[--shuffle] [--memory] [--doctor]",
               file=sys.stderr)
         return 1
 
@@ -608,6 +664,7 @@ def main(argv=None):
     show_stats = _flag("--stats")
     show_shuffle = _flag("--shuffle")
     show_memory = _flag("--memory")
+    show_doctor = _flag("--doctor")
     log_path = argv[0]
     stories = load_query_stories(log_path)
     trace_events = load_trace(trace_path) if trace_path else None
@@ -623,13 +680,15 @@ def main(argv=None):
             f.write(render_html(stories, trace_events, qid,
                                 show_stats=show_stats,
                                 show_shuffle=show_shuffle,
-                                show_memory=show_memory))
+                                show_memory=show_memory,
+                                show_doctor=show_doctor))
         print(f"wrote {html_out}")
     else:
         print(render_report(stories, trace_events, qid,
                             show_stats=show_stats,
                             show_shuffle=show_shuffle,
-                            show_memory=show_memory))
+                            show_memory=show_memory,
+                            show_doctor=show_doctor))
     return 0
 
 

@@ -1,0 +1,31 @@
+"""Seeded SYNC001/OBS002/HYG002 fixture shaped like a query-doctor
+helper — ``ci/lint.py`` must exit NONZERO.
+
+The cross-plane doctor (obs/doctor.py) and the regression sentinel
+(analysis/regression.py) diagnose from summaries the planes already
+collected, so their lint scope bans exactly what this helper does: a
+device pull while "corroborating" a share, a flight-recorder event
+that allocates per verdict, and a wall-clock read where a monotonic
+one is required.  Never imported by the engine.
+"""
+import time
+
+import jax
+import numpy as np
+
+from spark_rapids_tpu.obs import flight as _flight
+
+
+def bad_corroborate(dev, cause):
+    share = np.asarray(dev).mean()            # SYNC001: materialization
+    evidence = jax.device_get(dev)            # SYNC001: host pull
+    _flight.record(_flight.EV_MEM, f"verdict:{cause}")  # OBS002: f-string
+    stamp = time.time()                       # HYG002: wall clock
+    return share, evidence, stamp
+
+
+def good_corroborate(summary, cause, share_pct):
+    # the doctor's real shape: host arithmetic over dicts already in
+    # hand, interned name constants, monotonic clocks only
+    _flight.record(_flight.EV_MEM, "verdict", a=int(share_pct))
+    return summary.get(cause, 0.0)

@@ -54,6 +54,45 @@ class TestEventLog:
         assert dot.startswith("digraph") and "TpuHashAggregate" in dot
 
 
+class TestDoctorReportTolerance:
+    """``report.py --doctor`` on PRE-r12 event logs: records written
+    before the doctor plane existed carry no ``doctor`` block and must
+    render a one-line placeholder, not crash (the same convention as
+    ``--memory`` on pre-r11 logs)."""
+
+    def test_doctor_lines_placeholder_on_old_record(self):
+        from spark_rapids_tpu.tools.report import doctor_lines
+        (line,) = doctor_lines({"query_id": "old"})
+        assert "no doctor verdict recorded" in line
+
+    def test_report_cli_doctor_on_pre_r12_log(self, tmp_path, capsys):
+        from spark_rapids_tpu.tools import report
+        log = _run_queries(tmp_path)
+        # strip the doctor blocks to reconstruct a pre-r12 log
+        stripped = []
+        with open(log) as f:
+            for line in f:
+                rec = json.loads(line)
+                rec.pop("doctor", None)
+                stripped.append(rec)
+        with open(log, "w") as f:
+            for rec in stripped:
+                f.write(json.dumps(rec) + "\n")
+        rc = report.main([log, "--doctor"])
+        out = capsys.readouterr().out
+        assert rc in (0, None)
+        assert "no doctor verdict recorded" in out
+
+    def test_report_cli_doctor_on_current_log(self, tmp_path, capsys):
+        from spark_rapids_tpu.tools import report
+        log = _run_queries(tmp_path)
+        rc = report.main([log, "--doctor"])
+        out = capsys.readouterr().out
+        assert rc in (0, None)
+        assert "query doctor (cross-plane verdict)" in out
+        assert "no doctor verdict recorded" not in out
+
+
 class TestExplainAndExport:
     def test_explain_mentions_tpu_ops(self):
         s = TpuSession(TpuConf({}))
