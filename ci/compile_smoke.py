@@ -9,10 +9,19 @@ run the TPC-DS quartet q3/q42/q52/q96 at tiny scale and assert
 3. determinism — carved results are row-identical (including order) to
    the eager superstage-off results;
 4. the compile-scoped lint rules are clean on the compiler's own files
-   (the layer that removes host syncs must not contain any).
+   (the layer that removes host syncs must not contain any);
+5. cold start (compile/aot.py) — a fresh process against a cache dir
+   seeded by an earlier process satisfies every q3 first-call from the
+   persistent executable cache (zero new compiles) and its first q3
+   lands within max(1.5x its own warm q3, half the unseeded child's
+   first q3) — at tiny smoke scale the process-fixed IO/tracing floor
+   dominates the warm run, so the second bound is the operative one.
 """
+import json
 import os
+import subprocess
 import sys
+import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
@@ -56,6 +65,92 @@ def _stages(node):
     for c in node.children:
         out.extend(_stages(c))
     return out
+
+
+# Child process for the cold-start stage: run q3 twice against a
+# persistent cache dir, report per-run wall seconds + compile counts.
+_COLD_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, sys.argv[1])
+sys.path.insert(0, os.path.join(sys.argv[1], "benchmarks"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tpcds
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.obs import compile_watch
+
+cache_dir, data_dir = sys.argv[2], sys.argv[3]
+s = TpuSession(TpuConf({
+    "spark.rapids.tpu.sql.enabled": True,
+    "spark.rapids.tpu.sql.batchSizeRows": 1 << 22,
+    "spark.rapids.tpu.sql.reader.batchSizeRows": 1 << 22,
+    "spark.rapids.tpu.compile.aot.cacheDir": cache_dir,
+}))
+tpcds.register(s, data_dir)
+sql = tpcds.QUERIES["q3"]
+t0 = time.perf_counter()
+first = s.sql(sql).collect()
+t_first = time.perf_counter() - t0
+t0 = time.perf_counter()
+warm = s.sql(sql).collect()
+t_warm = time.perf_counter() - t0
+assert warm == first
+recs = compile_watch.records_since(0)
+print(json.dumps({
+    "t_first_s": t_first, "t_warm_s": t_warm, "rows": len(first),
+    "compiles": sum(1 for r in recs if r.get("origin") != "persistent"),
+    "persistent_hits": compile_watch.persistent_hits(),
+}))
+"""
+
+
+def _cold_child(cache_dir: str, data_dir: str) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-c", _COLD_CHILD, REPO_ROOT, cache_dir,
+         data_dir],
+        capture_output=True, text=True, timeout=600, cwd=REPO_ROOT)
+    assert out.returncode == 0, \
+        f"cold-start child failed:\n{out.stderr[-2000:]}"
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _cold_start_stage(data_dir: str) -> None:
+    """Stage 5: persistent-reuse acceptance across fresh processes."""
+    cache_dir = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "tpcds_compile_smoke",
+        f"aot_cache_{os.getpid()}_{time.monotonic_ns()}")
+    cold = _cold_child(cache_dir, data_dir)
+    assert cold["compiles"] > 0, \
+        f"seed child recorded no compiles: {cold}"
+    assert os.path.exists(os.path.join(cache_dir, "aot_manifest.json")), \
+        "seed child wrote no AOT manifest"
+    warmed = _cold_child(cache_dir, data_dir)
+    assert warmed["rows"] == cold["rows"]
+    assert warmed["compiles"] == 0, \
+        f"warmed-dir child still compiled: {warmed}"
+    assert warmed["persistent_hits"] > 0, warmed
+    # the acceptance ratio: a warmed cold process's FIRST q3 lands
+    # within 1.5x warm once the query wall dominates process-fixed
+    # costs (at bench scale, cold_vs_warm_ratio in BENCH_r*.json
+    # tracks exactly that).  At this 0.002-scale smoke the warm run
+    # is ~80ms while parquet IO + first-touch upload + jit TRACING
+    # (which no executable cache can skip) cost ~1.5s per process, so
+    # the tiny-scale proxy is the cold-start tax itself: the warmed
+    # child must run its first q3 in at most half the seed child's —
+    # the XLA-compile share is gone, proven exactly by compiles == 0
+    # above
+    budget = max(1.5 * warmed["t_warm_s"], 0.5 * cold["t_first_s"])
+    assert warmed["t_first_s"] <= budget, \
+        f"warmed cold-process q3 {warmed['t_first_s']:.3f}s exceeds " \
+        f"budget {budget:.3f}s (warm {warmed['t_warm_s']:.3f}s, seed " \
+        f"cold {cold['t_first_s']:.3f}s)"
+    print(f"  cold-start: seed first={cold['t_first_s']:.2f}s "
+          f"compiles={cold['compiles']}; warmed-dir "
+          f"first={warmed['t_first_s']:.2f}s "
+          f"warm={warmed['t_warm_s']:.2f}s "
+          f"persistent_hits={warmed['persistent_hits']} "
+          f"compiles=0")
 
 
 def main():
@@ -145,11 +240,15 @@ def main():
               f"doctor={diag.primary_cause}"
               f"@{diag.primary_share_pct:.1f}%")
 
+    _cold_start_stage(data_dir)
+
     # -- compile-scoped lint clean on the compiler's own files
     findings = []
     for rel in ("spark_rapids_tpu/compile/lower.py",
                 "spark_rapids_tpu/compile/carve.py",
-                "spark_rapids_tpu/exec/superstage.py"):
+                "spark_rapids_tpu/exec/superstage.py",
+                "spark_rapids_tpu/compile/aot.py",
+                "spark_rapids_tpu/service/warmup.py"):
         with open(os.path.join(REPO_ROOT, rel)) as f:
             src = f.read()
         findings += AL.lint_source(src, rel,

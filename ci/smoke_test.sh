@@ -50,7 +50,7 @@ JAX_PLATFORMS=cpu python ci/obs_smoke.py
 echo "== morsel pipeline (parallel drains under stall watchdog) =="
 JAX_PLATFORMS=cpu python ci/pipeline_smoke.py
 
-echo "== superstage compiler (carve smoke, flush budget, determinism) =="
+echo "== superstage compiler (carve smoke, flush budget, determinism, cold start) =="
 JAX_PLATFORMS=cpu python ci/compile_smoke.py
 
 echo "== runtime stats plane (attribution, skew stats, zero extra flushes) =="

@@ -716,8 +716,13 @@ def _scopes_for(rel: str) -> Set[str]:
             base in ("pipeline.py", "superstage.py", "exchange.py",
                      "stats.py", "profile.py", "timeline.py",
                      "compile_watch.py", "slo.py", "netplane.py",
-                     "memplane.py", "doctor.py", "regression.py"):
+                     "memplane.py", "doctor.py", "regression.py",
+                     "warmup.py"):
         # the superstage compiler exists to ELIMINATE host round trips:
+        # the AOT warmup daemon (service/warmup.py) calls jitted
+        # programs from a background thread and carries the same
+        # contract — a sync there would stall warm compiles behind
+        # device work;
         # a stray device_get/np.asarray in compile/ or the wrapper
         # would silently reintroduce the cost it removes; the stats
         # plane (obs/stats.py, obs/profile.py), the performance plane
@@ -728,10 +733,14 @@ def _scopes_for(rel: str) -> Set[str]:
         # exchange call sites carry the same zero-flush +
         # allocation-free-record contract
         scopes |= {SYNC001, OBS002}
-    if "obs" in parts or base == "regression.py":
+    if "obs" in parts or base in ("regression.py", "aot.py",
+                                  "warmup.py"):
         # the doctor lives in obs/ (covered by the parts check); the
         # sentinel sits in analysis/ but carries the same timing-
-        # hygiene contract as the planes whose artifacts it gates
+        # hygiene contract as the planes whose artifacts it gates;
+        # the AOT compile service (compile/aot.py, service/warmup.py)
+        # prices compiles into the same telemetry and must use the
+        # same monotonic clocks
         scopes |= {HYG002}
     if "exec" in parts:
         scopes |= {HYG003}

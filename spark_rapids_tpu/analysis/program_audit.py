@@ -175,6 +175,19 @@ def coverage_gaps(specs: Sequence[AuditSpec]) -> List[str]:
     return sorted(REQUIRED_PROGRAMS - have)
 
 
+def aot_coverage_gaps(specs: Optional[Sequence[AuditSpec]] = None,
+                      ) -> List[str]:
+    """Bucketed-program registry entries (compile/aot.py
+    BUCKETED_PROGRAMS — the programs whose shapes the AOT lattice
+    buckets and the warmup daemon pre-compiles) that no audit spec
+    covers.  Must stay empty: a program cannot join the bucketed
+    registry unaudited, and the registry cannot drift from
+    REQUIRED_PROGRAMS silently (tests/test_audit.py asserts both)."""
+    from ..compile.aot import BUCKETED_PROGRAMS
+    have = {s.name for s in (collect_specs() if specs is None else specs)}
+    return sorted(p for p in BUCKETED_PROGRAMS if p not in have)
+
+
 # ---------------------------------------------------------------------------
 # suppressions: # audit: allow(RULE) at the spec construction site
 # ---------------------------------------------------------------------------

@@ -61,6 +61,16 @@ GATE_KEYS: Tuple[Tuple[str, str, float], ...] = (
     ("superstage_off_flushes", "exact", 0.0),
     ("predicted_flushes", "exact", 0.0),
     ("device_util_pct", "higher", 18.0),
+    # AOT compile service (compile/aot.py): cold-start throughput of
+    # the headline config, cold/warm spread (lower = persistent cache +
+    # warmup absorbing compiles), JIT cache hit share, and how many
+    # compiles the warmup daemon took off the query path (lower-bounded
+    # by the floor — any count is fine, the key exists so the ledger
+    # tracks it)
+    ("cold_exact_Mrows_s", "higher", 18.0),
+    ("cold_vs_warm_ratio", "lower", 150.0),
+    ("compile_cache_hit_pct", "higher", 18.0),
+    ("warmup_compiles", "lower", 400.0),
     ("host_drop_tax_ms", "lower", 150.0),
     ("spill_ms", "lower", 150.0),
     ("inline_compile_ms", "lower", 150.0),
@@ -76,6 +86,8 @@ THROUGHPUT_KEYS = tuple(k for k, d, _b in GATE_KEYS if d == "higher")
 #: positive jitter; the regression threshold is
 #: ``max(value * (1 + band), abs_floor)``.
 ABS_FLOORS = {
+    "cold_vs_warm_ratio": 10.0,
+    "warmup_compiles": 50.0,
     "host_drop_tax_ms": 5.0,
     "spill_ms": 5.0,
     "inline_compile_ms": 5.0,

@@ -81,6 +81,8 @@ class TpuSession:
         _obs_trace.configure(self.conf)
         from ..obs import flight as _obs_flight
         _obs_flight.configure(self.conf)
+        from ..compile import aot as _aot
+        _aot.configure(self.conf)
         with TpuSession._active_lock:
             # device (re)init mutates process-wide state (catalog,
             # semaphore); serialize concurrent session construction
@@ -397,7 +399,12 @@ class TpuSession:
         if compiles:
             extra["compiles"] = [
                 {"cache": r["cache"], "dur_ms": r["dur_ms"],
-                 "inline": r["inline"], "signature": r["signature"]}
+                 "inline": r["inline"], "signature": r["signature"],
+                 # AOT dimensions (compile/aot.py): which capacity
+                 # bucket the compile was for and who paid for it
+                 # (inline/warm/warmup/persistent)
+                 "origin": r.get("origin", "inline"),
+                 "bucket": r.get("bucket")}
                 for r in compiles]
         # per-query StatsProfile (obs/stats.py): read-only over resolved
         # values — built AFTER the final flush, never adds a round trip
@@ -430,7 +437,8 @@ class TpuSession:
                     sem_wait_ms=sem_wait_ms,
                     stats_profile=self.last_stats_profile,
                     query_id=token.query_id if token is not None
-                    else None)
+                    else None,
+                    compiles=extra.get("compiles"))
                 self.last_query_diagnosis = diag
                 extra["doctor"] = diag.to_dict()
             except Exception:  # noqa: BLE001 — doctor never fails a query

@@ -29,7 +29,22 @@ from . import dtypes as T
 MIN_CAPACITY = int(os.environ.get("SPARK_RAPIDS_TPU_MIN_CAPACITY", "1024"))
 
 
+#: capacity-bucketing override installed by the AOT compile subsystem
+#: (compile/aot.py configure): a lattice with a conf'd growth ratio.
+#: None = the classic pow2 padding below.  A plain module slot (not an
+#: import) so columnar never depends on compile/.
+_BUCKET_FN = None
+
+
+def set_bucket_fn(fn) -> None:
+    global _BUCKET_FN
+    _BUCKET_FN = fn
+
+
 def bucket_capacity(n: int) -> int:
+    fn = _BUCKET_FN
+    if fn is not None:
+        return fn(n)
     cap = MIN_CAPACITY
     while cap < n:
         cap *= 2

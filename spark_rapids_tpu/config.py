@@ -631,6 +631,57 @@ SUPERSTAGE_SPEC_JOIN = conf_bool(
     "existing speculative redo machinery to the stage flush barrier; "
     "a violating batch (duplicate build keys) recomputes on the exact "
     "path.  Star-schema dimension joins always fit", internal=True)
+AOT_ENABLED = conf_bool(
+    "spark.rapids.tpu.compile.aot.enabled", True,
+    "AOT compile subsystem (compile/aot.py): shape-bucket batch "
+    "capacities onto a small geometric lattice so the seven engine "
+    "JIT caches share executables across queries instead of "
+    "compiling per exact shape.  Padded rows carry validity, so "
+    "bucketed execution is bit-identical to unbucketed.  Also "
+    "enables the per-(program, bucket) demand ledger the warmup "
+    "daemon and the compile report read")
+AOT_BUCKET_RATIO = conf_int(
+    "spark.rapids.tpu.compile.aot.bucketRatio", 2,
+    "Growth factor between adjacent capacity buckets in the shape "
+    "lattice (power of two).  2 reproduces the classic pow2 padding; "
+    "4 quarters the number of distinct shapes each program compiles "
+    "for, trading up to 4x padding waste for executable reuse")
+AOT_CACHE_DIR = conf_str(
+    "spark.rapids.tpu.compile.aot.cacheDir", "",
+    "Directory for the persistent executable cache.  When set, the "
+    "JAX persistent compilation cache is pointed here (so a fresh "
+    "process deserializes prior XLA executables instead of "
+    "recompiling) and compile/aot.py keeps a manifest keyed by "
+    "(program id, bucket, dtype tuple, conf fingerprint) so "
+    "first-calls satisfied by the cache are counted as persistent "
+    "hits, not new compiles.  Empty = in-process caching only")
+AOT_XLA_CACHE = conf_bool(
+    "spark.rapids.tpu.compile.aot.xlaCache.enabled", True,
+    "Wire the JAX/XLA persistent compilation cache to aot.cacheDir "
+    "(jax_compilation_cache_dir with the min-compile-time and "
+    "min-entry-size thresholds dropped to zero so every engine "
+    "program persists).  Off keeps the manifest bookkeeping without "
+    "touching the JAX cache config — the escape hatch for platforms "
+    "where cross-process executable deserialization misbehaves")
+AOT_WARMUP_ENABLED = conf_bool(
+    "spark.rapids.tpu.compile.aot.warmup.enabled", True,
+    "Admission-aware warmup daemon (service/warmup.py): a "
+    "QueryService background thread that observes the admission "
+    "queue's (program, bucket) demand mix and pre-compiles "
+    "likely-missing buckets off the query critical path.  Warmup "
+    "compiles are attributed to the dedicated 'warmup' origin by "
+    "obs/compile_watch.py — never to a tenant query's "
+    "inline_compile_ms")
+AOT_WARMUP_INTERVAL_MS = conf_int(
+    "spark.rapids.tpu.compile.aot.warmup.intervalMs", 500,
+    "Fallback wakeup period of the warmup daemon between admission "
+    "signals (each admission also wakes it immediately)",
+    internal=True)
+AOT_WARMUP_MAX_PER_CYCLE = conf_int(
+    "spark.rapids.tpu.compile.aot.warmup.maxCompilesPerCycle", 4,
+    "Bound on background compiles per warmup sweep, so a cold "
+    "process warms incrementally instead of monopolizing the device "
+    "semaphore with dummy-batch executions", internal=True)
 PIPELINE_ENABLED = conf_bool(
     "spark.rapids.tpu.exec.pipeline.enabled", True,
     "Morsel-parallel partition drains (exec/pipeline.py): the shuffle "

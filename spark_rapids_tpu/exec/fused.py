@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from ..columnar import dtypes as T
 from ..columnar.column import Column
 from ..columnar.batch import ColumnarBatch
+from ..compile import aot as _aot
 from ..expr import core as ec
 from ..obs import compile_watch as _compile_watch
 from ..obs.registry import compile_cache_event
@@ -151,6 +152,23 @@ class FusedEval:
                         str(key))
                     if len(_JIT_CACHE) < 4096:
                         _JIT_CACHE[key] = self._jitted
+                self._register_warmer(str(hash(key)))
+
+    def _register_warmer(self, variant: str) -> None:
+        """Hand the AOT subsystem a closure that drives this cached
+        program at an arbitrary bucket capacity with zero-filled
+        columns and num_rows=0 (every padded row invalid — the
+        masking contract makes the dummy batch safe for any fused
+        tree)."""
+        jitted = self._jitted
+        dts = tuple(self.schema[i].dtype.np_dtype for i in self.needed)
+        if jitted is None or any(d is None for d in dts):
+            return
+        def warm(bucket: int) -> None:
+            datas = tuple(jnp.zeros(bucket, d) for d in dts)
+            valids = tuple(jnp.zeros(bucket, jnp.bool_) for _ in dts)
+            jitted(bucket, datas, valids, jnp.int32(0))
+        _aot.register_warmer("fused_project", warm, variant)
 
     # traced function: capacity static; column buffers + live row count
     # are device values
@@ -187,6 +205,7 @@ class FusedEval:
             return None
         datas = tuple(batch.columns[i].data for i in self.needed)
         valids = tuple(batch.columns[i].validity for i in self.needed)
+        _aot.note_demand("fused_project", batch.capacity)
         try:
             fused_out = self._jitted(batch.capacity, datas, valids,
                                      batch.rows_dev)

@@ -26,6 +26,7 @@ from ..columnar import dtypes as T
 from ..columnar.column import Column
 from ..columnar.batch import ColumnarBatch, LazyCount
 from ..columnar.schema import Schema
+from ..compile import aot as _aot
 from ..expr import core as ec
 from ..kernels import basic as bk
 from ..obs import compile_watch as _compile_watch
@@ -179,6 +180,15 @@ class TpuStagedCompute(TpuExec):
             "staged_compute", fn, "opaque" if key is None else str(key))
         if key is not None and len(TpuStagedCompute._JIT_CACHE) < 4096:
             TpuStagedCompute._JIT_CACHE[key] = fn
+            dts = tuple(f.dtype.np_dtype for f in src_schema)
+            if not any(d is None for d in dts):
+                def warm(bucket: int) -> None:
+                    datas = tuple(jnp.zeros(bucket, d) for d in dts)
+                    valids = tuple(jnp.zeros(bucket, jnp.bool_)
+                                   for _ in dts)
+                    fn(bucket, datas, valids, jnp.int32(0))
+                _aot.register_warmer("staged_compute", warm,
+                                     str(hash(key)))
         return fn
 
     def execute(self):
@@ -202,6 +212,7 @@ class TpuStagedCompute(TpuExec):
                         type(c) is Column for c in batch.columns):
                     datas = tuple(c.data for c in batch.columns)
                     valids = tuple(c.validity for c in batch.columns)
+                    _aot.note_demand("staged_compute", batch.capacity)
                     pairs, cnt = jitted(batch.capacity, datas, valids,
                                         batch.rows_dev)
                     n = LazyCount(cnt) if has_filter else \

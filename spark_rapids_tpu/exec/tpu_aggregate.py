@@ -21,6 +21,7 @@ from ..columnar.batch import (ColumnarBatch, LazyCount, SpeculativeResult,
                               concat_batches, resolve_speculative)
 from ..expr import core as ec
 from ..expr.aggregates import AggregateFunction
+from ..compile import aot as _aot
 from ..kernels import canon, aggregate as agg_k
 from ..obs import compile_watch as _compile_watch
 from ..obs.registry import compile_cache_event
@@ -408,6 +409,20 @@ class TpuHashAggregate(TpuExec):
             core = _compile_watch.wrap_miss(
                 "hash_aggregate", jax.jit(_core), str(cache_key))
             TpuHashAggregate._CORE_CACHE[cache_key] = core
+            key_nps = tuple(dt.np_dtype for dt in key_dts)
+            in_nps = tuple(dt.np_dtype for dts in in_dts for dt in dts
+                           if dt is not None)
+            if not any(d is None for d in key_nps + in_nps):
+                def warm(bucket: int) -> None:
+                    ka = tuple((jnp.zeros(bucket, d),
+                                jnp.zeros(bucket, jnp.bool_))
+                               for d in key_nps)
+                    ia = tuple((jnp.zeros(bucket, d),
+                                jnp.zeros(bucket, jnp.bool_))
+                               for d in in_nps)
+                    core(ka, ia, jnp.int32(0))
+                _aot.register_warmer("hash_aggregate_grouped", warm,
+                                     str(hash(cache_key)))
 
         # flat arg list, None inputs omitted (the dtypes tuple encodes
         # which are None — no placeholder transfers)
@@ -415,6 +430,7 @@ class TpuHashAggregate(TpuExec):
             (c.data, c.validity)
             for cols in input_cols for c in cols if c is not None)
         key_arrays = tuple((c.data, c.validity) for c in key_cols)
+        _aot.note_demand("hash_aggregate", batch.capacity)
         try:
             return core(key_arrays, in_arrays, batch.rows_dev)
         except Exception:  # noqa: BLE001 - fall back, but loudly
@@ -1089,8 +1105,18 @@ class TpuHashAggregate(TpuExec):
             core = _compile_watch.wrap_miss(
                 "hash_aggregate", jax.jit(_core), str(cache_key))
             TpuHashAggregate._CORE_CACHE[cache_key] = core
+            ws_nps = tuple(f.dtype.np_dtype for f in batch.schema)
+            if not any(d is None for d in ws_nps):
+                def warm(bucket: int) -> None:
+                    ds = tuple(jnp.zeros(bucket, d) for d in ws_nps)
+                    vs = tuple(jnp.zeros(bucket, jnp.bool_)
+                               for _ in ws_nps)
+                    core(ds, vs, jnp.int32(0))
+                _aot.register_warmer("hash_aggregate_whole_stage", warm,
+                                     str(hash(cache_key)))
         datas = tuple(c.data for c in batch.columns)
         valids = tuple(c.validity for c in batch.columns)
+        _aot.note_demand("hash_aggregate", batch.capacity)
         try:
             return core(datas, valids, batch.rows_dev)
         except Exception:  # noqa: BLE001 - fall back, but loudly
@@ -1295,6 +1321,7 @@ class TpuHashAggregate(TpuExec):
                     core = _compile_watch.wrap_miss(
                         "hash_aggregate", jax.jit(_core), str(cache_key))
                     TpuHashAggregate._CORE_CACHE[cache_key] = core
+                _aot.note_demand("hash_aggregate", batch.capacity)
                 try:
                     pairs = core(in_arrays, batch.rows_dev)
                 except Exception:  # noqa: BLE001 - fall back, but loudly

@@ -364,6 +364,8 @@ class TpuHashJoinBase(TpuExec):
             TpuHashJoinBase._PROBE_JIT[key] = fn
         key_arrays = tuple((c.data, c.validity) for c in skey_cols)
         dparams = tuple(direct[:4]) if direct is not None else None
+        from ..compile import aot as _aot
+        _aot.note_demand("join_probe", sb.capacity)
         try:
             lo, counts, eff, total = fn(tuple(bt.sorted_words), dparams,
                                         key_arrays, sb.rows_dev)
@@ -456,6 +458,8 @@ class TpuHashJoinBase(TpuExec):
                 TpuHashJoinBase._SPEC_JIT[key] = fn
         key_arrays = tuple((c.data, c.validity) for c in skey_cols)
         dparams = tuple(direct[:4]) if direct is not None else None
+        from ..compile import aot as _aot
+        _aot.note_demand("join_spec_probe", sb.capacity)
         try:
             souts, bouts, p_idx, b_idx, live, cnt, fit = fn(
                 tuple(bt.sorted_words), dparams, key_arrays, sb.rows_dev,

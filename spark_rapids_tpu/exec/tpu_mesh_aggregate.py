@@ -255,6 +255,8 @@ class TpuMeshAggregate(TpuExec):
             program = self._program(mesh, len(key_cols),
                                     [c.dtype for c in key_cols],
                                     in_layout, in_dts)
+            from ..compile import aot as _aot
+            _aot.note_demand("mesh_aggregate", flat[0].shape[0])
             with timed(self.metrics[AGG_TIME], self):
                 out = program(*flat)
             overflow = bool(np.asarray(out[-1]).any())

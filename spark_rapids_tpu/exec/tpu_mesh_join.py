@@ -309,6 +309,8 @@ class TpuMeshShuffledJoin(TpuExec):
 
             program = self._program(mesh, prog_jt, key_groups,
                                     l_dts, r_dts, emit_right)
+            from ..compile import aot as _aot
+            _aot.note_demand("mesh_join", flat[0].shape[0])
             with timed(self.metrics[JOIN_TIME], self):
                 out = program(*flat)
             if bool(np.asarray(out[-1]).any()):

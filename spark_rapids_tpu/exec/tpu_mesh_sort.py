@@ -204,6 +204,8 @@ class TpuMeshSort(TpuExec):
             program = self._program(
                 mesh, len(key_cols), [c.dtype for c in key_cols],
                 [c.dtype for c in batch.columns], desc, nlast)
+            from ..compile import aot as _aot
+            _aot.note_demand("mesh_sort", flat[0].shape[0])
             with timed(self.metrics[SORT_TIME], self):
                 out = program(*flat)
             if bool(np.asarray(out[-1]).any()):

@@ -79,11 +79,18 @@ def hash_partition_ids(word_lists: List[jnp.ndarray],
     Pallas fast path with jnp fallback (identical math either way).
     """
     key = (len(word_lists), num_parts)
+    from ..compile import aot as _aot
+    _aot.note_demand("pallas_hash_partition", word_lists[0].shape[0])
     try:
         if key not in _KERNEL_CACHE:
             compile_cache_event("pallas_hash_partition", False)
             _KERNEL_CACHE[key] = _compile_watch.wrap_miss(
                 "pallas_hash_partition", _make_kernel(*key), str(key))
+            kfn, nw = _KERNEL_CACHE[key], key[0]
+            def _warm(bucket: int) -> None:
+                kfn(*[jnp.zeros(bucket, jnp.uint64) for _ in range(nw)])
+            _aot.register_warmer("pallas_hash_partition", _warm,
+                                 str(key))
         else:
             compile_cache_event("pallas_hash_partition", True)
         return _KERNEL_CACHE[key](*word_lists)

@@ -21,10 +21,23 @@ call.  Each recorded compile carries:
   process-wide ns counter the session deltas around each execution —
   the victim query's event-log record, so all three surfaces agree
   exactly);
-- an inline-vs-warm flag: inline means a query context (an active
+- an origin: ``inline`` means a query context (an active
   ``CancelToken``) was blocked on the compile, in which case the
   duration is also observed onto the token as ``inline_compile_ms``
-  for the service's per-query metrics.
+  for the service's per-query metrics; ``warm`` means no query was
+  waiting; ``warmup`` means the AOT warmup daemon compiled it in the
+  background (``compile/aot.py warmup_scope`` — the scope outranks
+  any ambient CancelToken, so a background compile can NEVER land on
+  a tenant query's inline_compile_ms, and the utilization timeline
+  classifies its window as process-idle, not ``inline_compile``);
+  ``persistent`` means the first call was satisfied by the persistent
+  executable cache (manifest hit from an earlier process run) — a
+  deserialization, not a compile, so it is counted in
+  ``tpu_compile_persistent_hits_total`` and kept OUT of the
+  ``tpu_compile_seconds`` histogram and the inline/total ns counters;
+- the capacity bucket the compile served (the thread's last
+  ``aot.note_demand`` for that cache), rendered per-bucket by
+  ``tools/report.py``.
 
 Hot-path discipline (this file is on the SYNC001/OBS002 lint scope):
 the warm path is one list-index check; recording happens once per
@@ -46,49 +59,101 @@ _ENABLED = True
 _TOP_N = 20
 
 _LOCK = threading.Lock()
-_TOTAL_NS = 0           #: process-wide compile ns (session window deltas)
+_TOTAL_NS = 0           #: process-wide compile ns (session window deltas;
+                        #: warmup + persistent loads deliberately excluded)
 _INLINE_NS = 0          #: subset recorded under an active query context
+_WARMUP_NS = 0          #: background warmup compiles (the pseudo-victim)
+_PERSISTENT_NS = 0      #: persistent-cache deserializations (not compiles)
+_PERSISTENT_HITS = 0
 _RECORDS: List[Dict] = []
+
+
+def _store(rec: Dict) -> None:
+    _RECORDS.append(rec)
+    if len(_RECORDS) > _RECORD_CAP:
+        # evict the cheapest compile: the store's job is the
+        # slowest-compiles table, so the tail worth keeping is
+        # the expensive one
+        _RECORDS.sort(key=lambda r: -r["dur_ms"])
+        del _RECORDS[_RECORD_CAP:]
 
 
 def note_compile(cache: str, dur_ns: int, signature: Optional[str] = None,
                  ) -> None:
     """Record one finished compile: histogram, bounded record store,
     process counters, the victim token's ``inline_compile_ms``, and a
-    flight breadcrumb (constant name + plain ints — OBS002)."""
-    global _TOTAL_NS, _INLINE_NS
+    flight breadcrumb (constant name + plain ints — OBS002).
+
+    Origin resolution order is the PR 13 bugfix: the warmup scope is
+    checked BEFORE the cancellation token, so a background warmup
+    compile running while tenant queries are in flight lands under
+    the ``warmup`` pseudo-victim instead of charging whichever query
+    context happens to be ambient on the thread."""
+    global _TOTAL_NS, _INLINE_NS, _WARMUP_NS
     if not _ENABLED:
         return
+    from ..compile import aot
     from ..service.cancellation import current_token, observe
-    tok = current_token()
+    warmup = aot.in_warmup()
+    tok = None if warmup else current_token()
     inline = tok is not None
+    origin = "warmup" if warmup else ("inline" if inline else "warm")
+    bucket = aot.last_demand(cache)
     COMPILE_SECONDS.labels(cache=cache).observe(dur_ns / 1e9)
     sig = "" if signature is None else str(signature)[:_SIG_MAX]
     rec = {"cache": cache, "dur_ms": round(dur_ns / 1e6, 3),
-           "signature": sig, "inline": inline,
+           "signature": sig, "inline": inline, "origin": origin,
+           "bucket": bucket,
            "query_id": tok.query_id if inline else None,
            "end_ns": time.perf_counter_ns()}
     with _LOCK:
-        _TOTAL_NS += dur_ns
-        if inline:
-            _INLINE_NS += dur_ns
-        _RECORDS.append(rec)
-        if len(_RECORDS) > _RECORD_CAP:
-            # evict the cheapest compile: the store's job is the
-            # slowest-compiles table, so the tail worth keeping is
-            # the expensive one
-            _RECORDS.sort(key=lambda r: -r["dur_ms"])
-            del _RECORDS[_RECORD_CAP:]
+        if warmup:
+            _WARMUP_NS += dur_ns
+        else:
+            _TOTAL_NS += dur_ns
+            if inline:
+                _INLINE_NS += dur_ns
+        _store(rec)
     if inline:
         observe("inline_compile_ms", dur_ns / 1e6)
     flight.record(flight.EV_COMPILE, cache, dur_ns // 1_000_000,
                   1 if inline else 0)
 
 
+def note_persistent_hit(cache: str, dur_ns: int,
+                        signature: Optional[str] = None) -> None:
+    """Record a first call satisfied by the persistent executable
+    cache: an earlier process compiled this (program, signature, conf
+    fingerprint) and this call deserialized it.  Counted under
+    ``tpu_compile_persistent_hits_total`` and the record store (so the
+    report can show the load), but NOT in ``tpu_compile_seconds`` or
+    the inline/total ns counters — nothing was compiled."""
+    global _PERSISTENT_NS, _PERSISTENT_HITS
+    if not _ENABLED:
+        return
+    from ..compile import aot
+    from .registry import COMPILE_PERSISTENT_HITS
+    COMPILE_PERSISTENT_HITS.labels(cache=cache).inc()
+    sig = "" if signature is None else str(signature)[:_SIG_MAX]
+    rec = {"cache": cache, "dur_ms": round(dur_ns / 1e6, 3),
+           "signature": sig, "inline": False, "origin": "persistent",
+           "bucket": aot.last_demand(cache), "query_id": None,
+           "end_ns": time.perf_counter_ns()}
+    with _LOCK:
+        _PERSISTENT_NS += dur_ns
+        _PERSISTENT_HITS += 1
+        _store(rec)
+    flight.record(flight.EV_COMPILE, "persistent_hit",
+                  dur_ns // 1_000_000, 0)
+
+
 def wrap_miss(cache: str, fn: Callable, signature=None) -> Callable:
     """Wrap a compile-cache miss's freshly built callable so its first
-    call (where jit traces + compiles) is timed into ``note_compile``.
-    Warm calls afterwards pay one list-index check."""
+    call (where jit traces + compiles) is timed into ``note_compile``
+    — or, when the AOT manifest proves an earlier process already
+    compiled it into the persistent cache, into
+    ``note_persistent_hit``.  Warm calls afterwards pay one list-index
+    check."""
     if not _ENABLED:
         return fn
     compiled = [False]
@@ -96,10 +161,19 @@ def wrap_miss(cache: str, fn: Callable, signature=None) -> Callable:
     def _timed(*args, **kwargs):
         if compiled[0]:
             return fn(*args, **kwargs)
+        from ..compile import aot
+        key = aot.first_call_key(cache, signature)
         t0 = time.perf_counter_ns()
         out = fn(*args, **kwargs)
         compiled[0] = True
-        note_compile(cache, time.perf_counter_ns() - t0, signature)
+        dur_ns = time.perf_counter_ns() - t0
+        if aot.persistent_ready(key):
+            note_persistent_hit(cache, dur_ns, signature)
+        else:
+            note_compile(cache, dur_ns, signature)
+            if key is not None:
+                aot.manifest_add(key, cache, signature,
+                                 aot.last_demand(cache), dur_ns / 1e6)
         return out
 
     return _timed
@@ -122,6 +196,17 @@ def inline_ns() -> int:
         return _INLINE_NS
 
 
+def warmup_ns() -> int:
+    """Background warmup compile ns (the pseudo-victim's bill)."""
+    with _LOCK:
+        return _WARMUP_NS
+
+
+def persistent_hits() -> int:
+    with _LOCK:
+        return _PERSISTENT_HITS
+
+
 def records_since(marker: int) -> List[Dict]:
     """Compiles recorded after a ``begin_query()`` marker (store index
     snapshot).  Evictions only drop pre-existing cheap entries, so a
@@ -142,9 +227,13 @@ def stats_section(top_n: Optional[int] = None) -> Dict:
     with _LOCK:
         recs = sorted(_RECORDS, key=lambda r: -r["dur_ms"])[:n]
         tot, inl = _TOTAL_NS, _INLINE_NS
+        wrm, pns, phits = _WARMUP_NS, _PERSISTENT_NS, _PERSISTENT_HITS
     return {
         "total_compile_ms": round(tot / 1e6, 3),
         "inline_compile_ms": round(inl / 1e6, 3),
+        "warmup_compile_ms": round(wrm / 1e6, 3),
+        "persistent_hits": phits,
+        "persistent_load_ms": round(pns / 1e6, 3),
         "compiles": len(recs),
         "top": [dict(r) for r in recs],
     }
@@ -160,8 +249,12 @@ def configure(conf) -> None:
 
 def reset() -> None:
     """Test hook: drop records and counters."""
-    global _TOTAL_NS, _INLINE_NS
+    global _TOTAL_NS, _INLINE_NS, _WARMUP_NS, _PERSISTENT_NS
+    global _PERSISTENT_HITS
     with _LOCK:
         _TOTAL_NS = 0
         _INLINE_NS = 0
+        _WARMUP_NS = 0
+        _PERSISTENT_NS = 0
+        _PERSISTENT_HITS = 0
         del _RECORDS[:]

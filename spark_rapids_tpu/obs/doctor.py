@@ -69,8 +69,9 @@ TAXONOMY = (
      "Pallas-native operator core: make the busy share itself cheaper "
      "(fewer fusion breakers, kernel-level join/agg)"),
     ("inline_compile", 3,
-     "AOT shape-bucketed compile cache + warmup: move first-touch "
-     "compiles off the query path"),
+     "AOT compile service (compile/aot.py): widen the bucket lattice "
+     "coverage / seed the persistent cache so first-touch compiles "
+     "land on the warmup daemon, not the query path"),
     ("sem_wait", 1,
      "mesh-sharded multi-query execution: stop serializing on the "
      "single-device dispatch semaphore"),
@@ -198,10 +199,31 @@ def _normalized_shares(util_pct: float, gaps: Dict[str, float]
     return shares
 
 
+def _compile_mix(compiles: Optional[List[Dict]]) -> str:
+    """Bucket/origin breakdown of the query window's compile records
+    (compile/aot.py dimensions).  Placeholder-tolerant: pre-r13 records
+    carry neither key and fold into ``inline``/``-`` so old event logs
+    keep diagnosing."""
+    if not compiles:
+        return ""
+    origins: Dict[str, int] = {}
+    buckets: Dict[str, int] = {}
+    for r in compiles:
+        o = r.get("origin") or "inline"
+        origins[o] = origins.get(o, 0) + 1
+        b = r.get("bucket")
+        bk = "-" if b is None else str(b)
+        buckets[bk] = buckets.get(bk, 0) + 1
+    omix = ",".join(f"{o}={n}" for o, n in sorted(origins.items()))
+    bmix = ",".join(f"{b}={n}" for b, n in sorted(buckets.items()))
+    return f" origins[{omix}] buckets[{bmix}]"
+
+
 def _evidence(cause: str, *, inline_compile_ms: float,
               netplane: Optional[Dict], memplane: Optional[Dict],
               flushes: int, predicted_flushes: Optional[int],
-              sem_wait_ms: float, busy_ms: float) -> str:
+              sem_wait_ms: float, busy_ms: float,
+              compiles: Optional[List[Dict]] = None) -> str:
     """Corroborating raw counter from the owning plane, as a string."""
     if cause == "device_compute":
         pred = ("?" if predicted_flushes is None
@@ -209,7 +231,8 @@ def _evidence(cause: str, *, inline_compile_ms: float,
         return (f"busy_ms={busy_ms:.1f} over flushes={int(flushes)} "
                 f"(predicted={pred})")
     if cause == "inline_compile":
-        return f"inline_compile_ms={inline_compile_ms:.1f}"
+        return (f"inline_compile_ms={inline_compile_ms:.1f}"
+                f"{_compile_mix(compiles)}")
     if cause == "sem_wait":
         return f"sem_wait_ms={sem_wait_ms:.1f}"
     if cause == "shuffle_host" and netplane:
@@ -237,7 +260,8 @@ def diagnose(timeline_summary: Dict, *,
              predicted_flushes: Optional[int] = None,
              sem_wait_ms: float = 0.0,
              stats_profile=None,
-             query_id: Optional[str] = None) -> QueryDiagnosis:
+             query_id: Optional[str] = None,
+             compiles: Optional[List[Dict]] = None) -> QueryDiagnosis:
     """Join the per-query plane summaries into one verdict.
 
     Called by the session AFTER every plane summary is already
@@ -267,7 +291,8 @@ def diagnose(timeline_summary: Dict, *,
                 netplane=netplane, memplane=memplane, flushes=flushes,
                 predicted_flushes=predicted_flushes,
                 sem_wait_ms=sem_wait_ms,
-                busy_ms=float(timeline_summary.get("busy_ms", 0.0))),
+                busy_ms=float(timeline_summary.get("busy_ms", 0.0)),
+                compiles=compiles),
         })
     # ranked: largest modeled headroom first, taxonomy order on ties
     candidates.sort(key=lambda c: (-c["share_pct"],

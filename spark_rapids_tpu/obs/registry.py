@@ -299,6 +299,30 @@ COMPILE_CACHE = _REGISTRY.counter(
     "Engine JIT compile-cache lookups by cache and outcome",
     labels=("cache", "outcome"))
 
+AOT_BUCKET_DEMAND = _REGISTRY.counter(
+    "tpu_aot_bucket_demand_total",
+    "JIT-cache lookups by (program, capacity bucket, outcome) — the "
+    "demand mix the admission-aware warmup daemon pre-compiles "
+    "against (compile/aot.py; bucket cardinality is bounded by the "
+    "geometric lattice)",
+    labels=("cache", "bucket", "outcome"))
+
+AOT_WARMUP_COMPILES = _REGISTRY.counter(
+    "tpu_aot_warmup_compiles_total",
+    "Background warmup compiles by program: (program, bucket) pairs "
+    "pre-compiled off the query critical path by the service warmup "
+    "daemon (service/warmup.py), attributed to the 'warmup' "
+    "pseudo-victim by obs/compile_watch.py",
+    labels=("program",))
+
+COMPILE_PERSISTENT_HITS = _REGISTRY.counter(
+    "tpu_compile_persistent_hits_total",
+    "First calls satisfied by the persistent executable cache "
+    "(compile/aot.py manifest + JAX persistent compilation cache): "
+    "the program was compiled by an earlier process run and "
+    "deserialized here, so it is NOT counted in tpu_compile_seconds",
+    labels=("cache",))
+
 COMPILE_SUPERSTAGES = _REGISTRY.counter(
     "tpu_compile_superstages_total",
     "Superstage compiler carve outcomes: carved (region wrapped), "

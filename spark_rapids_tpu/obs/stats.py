@@ -191,6 +191,8 @@ def stage_exchange_batch(partitioner, batch,
         h = bk.hash_words(word_lists)
         pids = (h % jnp.uint64(partitioner.num_partitions)
                 ).astype(jnp.int32)
+        from ..compile import aot as _aot
+        _aot.note_demand("exchange_stats", batch.capacity)
         regs, nulls, wmin, wmax = _stats_prog(
             h, pids, valid, word0, batch.rows_dev,
             partitioner.num_partitions, m)

@@ -209,6 +209,13 @@ def _compile_segs(t0: int, t1: int) -> List[Tuple[int, int]]:
     from . import compile_watch
     segs = []
     for rec in compile_watch.records_since(0):
+        # warmup compiles belong to the background pseudo-victim and
+        # persistent-cache loads are deserializations, not compiles:
+        # neither is inline_compile evidence, so their windows fall
+        # through to the remaining causes / process-idle (pre-r13
+        # records carry no origin and default to compile evidence)
+        if rec.get("origin") in ("warmup", "persistent"):
+            continue
         end = rec["end_ns"]
         start = end - int(rec["dur_ms"] * 1e6)
         if end > t0 and start < t1:

@@ -27,7 +27,9 @@ from ..config import (TpuConf, set_active, EVENT_LOG_PATH,
                       SERVICE_MAX_QUEUED_BYTES, SERVICE_DEFAULT_DEADLINE_MS,
                       OBS_WATCHDOG_ENABLED, OBS_WATCHDOG_INTERVAL_MS,
                       OBS_WATCHDOG_STALL_S, OBS_DIAG_DIR,
-                      OBS_DIAG_MAX_BUNDLES)
+                      OBS_DIAG_MAX_BUNDLES, AOT_WARMUP_ENABLED,
+                      AOT_WARMUP_INTERVAL_MS, AOT_WARMUP_MAX_PER_CYCLE)
+from ..compile import aot as _aot
 from ..obs import compile_watch as _cwatch
 from ..obs import doctor as _doctor
 from ..obs import flight as _flight
@@ -168,6 +170,15 @@ class QueryService:
         _netplane.configure(conf)
         _memplane.configure(conf)
         _doctor.configure(conf)
+        _aot.configure(conf)
+        # admission-aware AOT warmup daemon (service/warmup.py): watches
+        # the (program, bucket) demand ledger and pre-compiles missing
+        # bucket executables off the query path
+        from .warmup import WarmupDaemon
+        self._warmup_enabled = bool(conf.get(AOT_WARMUP_ENABLED))
+        self.warmup = WarmupDaemon(
+            interval_ms=conf.get(AOT_WARMUP_INTERVAL_MS),
+            max_per_cycle=conf.get(AOT_WARMUP_MAX_PER_CYCLE))
         # stats().snapshot() carries the live obs sections alongside the
         # lifecycle counters (the monitoring one-stop view)
         self._stats.set_extras(lambda: {
@@ -180,6 +191,8 @@ class QueryService:
             "shuffle": _netplane.stats_section(),
             "memory": _memplane.stats_section(),
             "doctor": _doctor.stats_section(),
+            "aot": _aot.stats_section(),
+            "warmup": self.warmup.state(),
         })
 
     # -- lifecycle ---------------------------------------------------------
@@ -194,6 +207,8 @@ class QueryService:
                 self._workers.append(t)
             if self._watchdog_enabled:
                 self.watchdog.start()
+            if self._warmup_enabled:
+                self.warmup.start()
         return self
 
     def shutdown(self, wait: bool = True, timeout: Optional[float] = None,
@@ -215,6 +230,7 @@ class QueryService:
                     max(0.0, deadline - time.monotonic())
                 t.join(left)
         self.watchdog.stop()
+        self.warmup.stop()
         if self._scrape_server is not None:
             self._scrape_server.shutdown()
             self._scrape_server = None
@@ -296,6 +312,7 @@ class QueryService:
             spillable_bytes=hr["spillable_bytes"],
             forecast_fits=(est_bytes <= hr["headroom_bytes"]
                            + hr["spillable_bytes"]))
+        self.warmup.note_admission(query_id)
         return handle
 
     def _cancel_queued(self, handle: QueryHandle):

@@ -1,0 +1,146 @@
+"""Admission-aware AOT warmup daemon.
+
+Owned by :class:`~spark_rapids_tpu.service.server.QueryService`.  The
+daemon watches the demand ledger maintained by
+:mod:`spark_rapids_tpu.compile.aot` — every JIT call site records which
+(program, bucket) pair it is about to execute — and pre-compiles
+likely-missing bucket executables on a background thread so tenant
+queries arriving after the warmup sweep hit an already-populated jit
+cache instead of paying inline compile latency.
+
+Design points:
+
+- **Admission-triggered.**  ``note_admission()`` is called by
+  ``QueryService.submit()`` after a query clears admission; it wakes the
+  sweep loop immediately instead of waiting out the poll interval, so
+  warmup reacts to a shifting (program, bucket) mix with sub-interval
+  latency.  Between admissions the loop still sweeps on a timer: demand
+  recorded mid-query (new buckets discovered while a plan executes)
+  gets picked up even when no new query arrives.
+- **Device-polite.**  Each per-cycle batch of warm compiles holds a
+  device-semaphore permit acquired with a bounded non-raising
+  ``try_acquire`` — warmup never queues behind a saturated device for
+  longer than one poll interval and never raises out of the daemon.
+- **Attribution-correct.**  All compiles run under
+  ``aot.warmup_scope()`` so compile_watch classifies them as origin
+  ``warmup`` (process-idle on the timeline), never as a tenant query's
+  ``inline_compile_ms`` — even when an admitted query's CancelToken is
+  active somewhere on another thread.
+"""
+
+import threading
+
+from ..compile import aot as _aot
+from ..obs import flight as _flight
+
+_JOIN_TIMEOUT_S = 5.0
+# Bounded wait for a device permit before a warm batch; on timeout the
+# cycle is skipped (the device is saturated with real work — warming
+# now would only add to the queue it is trying to shorten).
+_SEM_WAIT_S = 0.25
+
+
+class WarmupDaemon:
+    """Background sweeper pre-compiling missing (program, bucket) pairs."""
+
+    def __init__(self, interval_ms: int = 500, max_per_cycle: int = 4):
+        self.interval_s = max(0.05, interval_ms / 1000.0)
+        self.max_per_cycle = max(1, int(max_per_cycle))
+        self._thread = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._lock = threading.Lock()
+        self._cycles = 0
+        self._compiled = 0
+        self._skipped_busy = 0
+        self._admissions = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="tpu-aot-warmup", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=_JOIN_TIMEOUT_S)
+            self._thread = None
+
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    # -- signals -------------------------------------------------------
+
+    def note_admission(self, query_id: str = ""):
+        """Wake the sweep loop: a query just cleared admission, so its
+        (program, bucket) demand is about to land in the ledger."""
+        with self._lock:
+            self._admissions += 1
+        self._wake.set()
+
+    # -- sweep loop ----------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.interval_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self._sweep()
+            except Exception:
+                # A failed sweep must never kill the daemon; individual
+                # warm failures are already counted by the aot ledger.
+                pass
+
+    def _sweep(self):
+        with self._lock:
+            self._cycles += 1
+        if not _aot.warm_candidates():
+            return
+        sem = self._device_semaphore()
+        if sem is not None:
+            if not sem.try_acquire(timeout=_SEM_WAIT_S):
+                with self._lock:
+                    self._skipped_busy += 1
+                return
+            try:
+                done = _aot.warm_missing(self.max_per_cycle)
+            finally:
+                sem.release()
+        else:
+            done = _aot.warm_missing(self.max_per_cycle)
+        if done:
+            with self._lock:
+                self._compiled += done
+            _flight.record(_flight.EV_STATE, "warmup_sweep", a=done)
+
+    @staticmethod
+    def _device_semaphore():
+        try:
+            from ..memory.arena import DeviceManager
+            return DeviceManager.get().semaphore
+        except Exception:
+            return None
+
+    # -- observability -------------------------------------------------
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "running": self.running(),
+                "interval_ms": int(self.interval_s * 1000),
+                "max_per_cycle": self.max_per_cycle,
+                "cycles": self._cycles,
+                "compiled": self._compiled,
+                "skipped_device_busy": self._skipped_busy,
+                "admissions_observed": self._admissions,
+            }

@@ -238,12 +238,18 @@ def compile_lines(rec: Dict) -> List[str]:
     if not compiles:
         return []
     lines = ["-- compiles in query window --"]
-    lines.append(f"  {'cache':<22s}{'dur_ms':>10s}  {'inline':<7s}"
-                 "signature")
+    lines.append(f"  {'cache':<22s}{'dur_ms':>10s}  {'origin':<11s}"
+                 f"{'bucket':>8s}  signature")
     for c in sorted(compiles, key=lambda c: -(c.get("dur_ms") or 0)):
+        # AOT dimensions (compile/aot.py); pre-r13 records carry
+        # neither key — inline flag maps to origin, bucket renders "-"
+        origin = c.get("origin") or (
+            "inline" if c.get("inline") else "warm")
+        bucket = c.get("bucket")
         lines.append(f"  {str(c.get('cache')):<22s}"
                      f"{_fmt(c.get('dur_ms')):>10}  "
-                     f"{str(bool(c.get('inline'))).lower():<7s}"
+                     f"{str(origin):<11s}"
+                     f"{('-' if bucket is None else str(bucket)):>8s}  "
                      f"{str(c.get('signature', ''))[:60]}")
     return lines
 

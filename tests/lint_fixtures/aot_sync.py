@@ -1,0 +1,38 @@
+"""Seeded SYNC001/OBS002/HYG002 fixture shaped like an AOT warmup
+sweep — ``ci/lint.py`` must exit NONZERO.
+
+The AOT compile service (compile/aot.py) and its admission-aware
+warmup daemon (service/warmup.py) run jitted programs from a
+background thread and price compiles into the shared telemetry, so
+their lint scope bans exactly what this helper does: a blocking
+device sync after a warm call (jit compiles synchronously on first
+invocation — waiting on the dummy result only stalls the sweep behind
+real device work), a flight-recorder event that allocates per warm,
+and a wall-clock read where the compile ledger requires a monotonic
+one.  Never imported by the engine.
+"""
+import time
+
+import jax
+import numpy as np
+
+from spark_rapids_tpu.obs import flight as _flight
+
+
+def bad_warm_one(warm, bucket):
+    out = warm(bucket)
+    out.block_until_ready()                   # SYNC001: blocking sync
+    rows = np.asarray(out).shape[0]           # SYNC001: materialization
+    host = jax.device_get(out)                # SYNC001: host pull
+    _flight.record(_flight.EV_STATE, f"warmed:{bucket}")  # OBS002
+    stamp = time.time()                       # HYG002: wall clock
+    return rows, host, stamp
+
+
+def good_warm_one(warm, bucket):
+    # the daemon's real shape: call the jitted program (first-call
+    # compile is synchronous), drop the result, interned event name,
+    # bucket rides the integer payload slot
+    warm(bucket)
+    _flight.record(_flight.EV_STATE, "warmed", a=int(bucket))
+    return True

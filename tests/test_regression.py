@@ -79,10 +79,11 @@ class TestHistory:
                     "host_drop_tax_ms", "peak_device_bytes"):
             assert r01.get(key) is None, key
         assert r01.get("value") is not None
-        # the newest round carries the full gated key set
-        r12 = rounds[12]
+        # the newest round carries the full gated key set (the four
+        # cold-path keys exist only from r13 on)
+        r13 = rounds[13]
         for key, _d, _b in R.GATE_KEYS:
-            assert r12.get(key) is not None, key
+            assert r13.get(key) is not None, key
 
     def test_history_table_has_placeholder_rows(self):
         rounds = R.load_history(REPO_ROOT)
@@ -161,15 +162,15 @@ class TestCompare:
 # ---------------------------------------------------------------------------
 
 class TestCommittedBaseline:
-    def test_baseline_values_equal_r12(self):
+    def test_baseline_values_equal_r13(self):
         base = R.load_baseline(BASELINE)
-        assert base["round"] == 12
-        r12 = R.load_round(os.path.join(REPO_ROOT,
-                                        "BENCH_r12.json")).keys
+        assert base["round"] == 13
+        r13 = R.load_round(os.path.join(REPO_ROOT,
+                                        "BENCH_r13.json")).keys
         for key, spec in base["keys"].items():
-            assert spec["value"] == r12[key], key
+            assert spec["value"] == r13[key], key
         # so the committed pair passes the gate by construction
-        assert not R.regressions(R.compare(r12, base))
+        assert not R.regressions(R.compare(r13, base))
 
     def test_true_r12_numbers_pass_the_gate(self, capsys):
         rc = _gate().main(["--current",
@@ -221,7 +222,7 @@ class TestGateCli:
         out_path = tmp_path / "PERF_BASELINE.json"
         monkeypatch.setattr(gate, "BASELINE_PATH", str(out_path))
         rc = gate._seed_baseline(
-            os.path.join(REPO_ROOT, "BENCH_r12.json"))
+            os.path.join(REPO_ROOT, "BENCH_r13.json"))
         assert rc == 0
         reseeded = R.load_baseline(str(out_path))
         committed = R.load_baseline(BASELINE)
