@@ -163,6 +163,10 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
             # (analysis/flush_budget.py — must equal `flushes`)
             "predicted_flushes": getattr(
                 s, "last_query_predicted_flushes", None),
+            # device-compute cost roll-up (obs/costplane.py): the
+            # warm query's roofline verdict, achieved rates and the
+            # padding-waste tax of the AOT bucket lattice
+            "costplane": getattr(s, "last_query_costplane", None),
             # cross-plane doctor verdict for the same warm query
             # (obs/doctor.py)
             "diagnosis": getattr(s, "last_query_diagnosis", None),
@@ -272,6 +276,7 @@ def main():
     tl = tpu_perf.get("timeline") or {}
     net = tpu_perf.get("netplane") or {}
     mem = tpu_perf.get("memplane") or {}
+    cost = tpu_perf.get("costplane") or {}
     tier_ms = (mem.get("spill_ms") or 0.0) + (mem.get("unspill_ms")
                                               or 0.0)
     print(json.dumps({
@@ -343,9 +348,17 @@ def main():
         "peak_device_bytes": tpu_perf.get("mem_peak_bytes"),
         "spill_ms": mem.get("spill_ms"),
         "spill_tax_pct": round(tier_ms / (tpu_exact_t * 1000) * 100, 2),
+        # device-compute cost plane (obs/costplane.py): the warm
+        # headline query's achieved HBM bandwidth against the
+        # conf-declared peak, the padding-waste share of its padded
+        # bucket dispatches (the bucketRatio tax), and the roofline
+        # verdict the doctor's device_compute sub-split is built on
+        "achieved_GBps": cost.get("achieved_gbps"),
+        "padding_waste_pct": cost.get("padding_waste_pct"),
+        "roofline_verdict": cost.get("verdict"),
         # cross-plane query doctor (obs/doctor.py): the warm headline
         # query's primary-bottleneck verdict and the Amdahl speedup
-        # bound for eliminating it — the one-line answer the six
+        # bound for eliminating it — the one-line answer the seven
         # plane keys above feed
         "doctor_primary_cause": (diag.primary_cause
                                  if diag is not None else None),

@@ -11,7 +11,12 @@ whose client/server spans join on span_id in the same trace, (6) the
 memory plane (obs/memplane.py) attributes every live device byte to an
 owner, prices forced tier moves in a ledger whose totals equal the
 catalog's own spill counters, and surfaces it all through
-Service.stats(), Prometheus, the event log, and the report tool.
+Service.stats(), Prometheus, the event log, and the report tool, (7)
+the device-compute cost plane (obs/costplane.py) costs the workload's
+own programs, splits the roofline shares to 100 within 1e-6, prices
+padding waste >0 under a forced non-power-of-two batch, decomposes
+the doctor's device_compute share exactly, and adds zero device
+flushes against a cost-off run of the same query.
 """
 import json
 import os
@@ -172,6 +177,9 @@ def main():
                    "tpu_mem_pinned_bytes",
                    "tpu_mem_spillable_bytes",
                    "tpu_mem_leaked_entries_total",
+                   "tpu_cost_records",
+                   "tpu_cost_padding_waste_pct",
+                   "tpu_cost_captures_total",
                    'tpu_service_queries_total{event="completed"}'):
         assert series in metrics, f"missing series {series}"
     print("prometheus OK:", len(metrics.splitlines()), "lines")
@@ -268,10 +276,55 @@ def main():
           f"admissions forecast={len(admitted)}, "
           f"ledger d2h={d2h}B")
 
+    # 2e. device-compute cost plane (obs/costplane.py): the warm query
+    #     joins static XLA costs with the dispatch ledger, the roofline
+    #     split partitions the busy share, a non-power-of-two batch
+    #     (1300 rows on a power-of-two bucket lattice) prices padding
+    #     waste, the doctor sub-verdict sums exactly, and the plane
+    #     adds ZERO device flushes against a cost-off run
+    from spark_rapids_tpu.columnar import pending as _pending
+
+    def _cost_query(sess):
+        cdf = sess.range(0, 1300, 1, 2)
+        cdf = cdf.with_column("k", cdf["id"] % 13)
+        return cdf.group_by("k").agg(F.sum("id").alias("sv"))
+
+    cs = TpuSession(TpuConf({}))
+    cq = _cost_query(cs)
+    cq.collect()                      # warm: programs compiled + costed
+    f0 = _pending.FLUSH_COUNT
+    cq.collect()
+    on_flushes = _pending.FLUSH_COUNT - f0
+    cost = cs.last_query_costplane
+    assert cost and cost["costed_records"] > 0, cost
+    assert cost["programs"], cost
+    share_sum = cost["compute_share_pct"] + cost["memory_share_pct"]
+    assert abs(share_sum - 100.0) < 1e-6, cost
+    assert (cost["padding_waste_pct"] or 0) > 0, cost
+    diag = cs.last_query_diagnosis
+    sub = diag.data.get("device_compute_breakdown")
+    assert sub is not None, diag.data
+    assert abs(sum(sub.values()) -
+               diag.data["shares"]["device_compute"]) < 1e-9, \
+        (sub, diag.data["shares"])
+    offs = TpuSession(TpuConf(
+        {"spark.rapids.tpu.obs.cost.enabled": False}))
+    oq = _cost_query(offs)
+    oq.collect()
+    f0 = _pending.FLUSH_COUNT
+    oq.collect()
+    off_flushes = _pending.FLUSH_COUNT - f0
+    assert on_flushes == off_flushes, (on_flushes, off_flushes)
+    assert offs.last_query_costplane is None
+    print(f"cost plane OK: records={cost['costed_records']}, "
+          f"verdict={cost['verdict']}, "
+          f"padding_waste={cost['padding_waste_pct']}%, "
+          f"flushes on/off={on_flushes}/{off_flushes}")
+
     # 3. report tool renders the joined story
     from spark_rapids_tpu.tools.report import main as report_main
     assert report_main([log_path, "--trace", trace_path, "--shuffle",
-                        "--memory",
+                        "--memory", "--cost",
                         "--html", os.path.join(td, "report.html")]) == 0
     html = open(os.path.join(td, "report.html")).read()
     assert "plan + time shares" in html
@@ -279,6 +332,7 @@ def main():
     assert "top edges (map" in html      # "->" is HTML-escaped
     assert "HBM memory (memplane)" in html
     assert "peak_device_bytes=" in html
+    assert "device-compute cost (roofline)" in html
     print("report OK")
 
     # 4. the forced failure produced one diagnostic bundle with the

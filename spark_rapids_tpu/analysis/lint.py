@@ -716,8 +716,8 @@ def _scopes_for(rel: str) -> Set[str]:
             base in ("pipeline.py", "superstage.py", "exchange.py",
                      "stats.py", "profile.py", "timeline.py",
                      "compile_watch.py", "slo.py", "netplane.py",
-                     "memplane.py", "doctor.py", "regression.py",
-                     "warmup.py"):
+                     "memplane.py", "doctor.py", "costplane.py",
+                     "regression.py", "warmup.py"):
         # the superstage compiler exists to ELIMINATE host round trips:
         # the AOT warmup daemon (service/warmup.py) calls jitted
         # programs from a background thread and carries the same
@@ -729,6 +729,7 @@ def _scopes_for(rel: str) -> Set[str]:
         # (obs/timeline.py, obs/compile_watch.py, obs/slo.py), the
         # transport plane (obs/netplane.py), the memory plane
         # (obs/memplane.py), the cross-plane doctor (obs/doctor.py),
+        # the device-compute cost plane (obs/costplane.py),
         # the regression sentinel (analysis/regression.py) and their
         # exchange call sites carry the same zero-flush +
         # allocation-free-record contract

@@ -75,6 +75,15 @@ GATE_KEYS: Tuple[Tuple[str, str, float], ...] = (
     ("spill_ms", "lower", 150.0),
     ("inline_compile_ms", "lower", 150.0),
     ("service_p99_ms", "lower", 150.0),
+    # device-compute cost plane (obs/costplane.py): achieved HBM
+    # bandwidth of the warm headline query (roofline numerator — a
+    # throughput, so higher) and the padding-waste tax of the AOT
+    # bucket lattice (the bucketRatio price; wide band + floor, the
+    # waste share is shape-dependent noise at bench scale).  The
+    # string ``roofline_verdict`` key rides the record but is not a
+    # gate key (make_baseline skips non-numerics by design).
+    ("achieved_GBps", "higher", 18.0),
+    ("padding_waste_pct", "lower", 150.0),
 )
 
 #: keys scaled by the seeded perf-gate fixtures (throughput-like).
@@ -92,6 +101,7 @@ ABS_FLOORS = {
     "spill_ms": 5.0,
     "inline_compile_ms": 5.0,
     "service_p99_ms": 100.0,
+    "padding_waste_pct": 50.0,
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -259,6 +259,7 @@ class TpuSession:
         # flush budget is benchmarked)
         from ..columnar import pending
         from ..obs import compile_watch as _cwatch
+        from ..obs import costplane as _costplane
         from ..obs import doctor as _doctor
         from ..obs import memplane as _memplane
         from ..obs import netplane as _netplane
@@ -269,6 +270,7 @@ class TpuSession:
         disp_marker = _profile.begin_query()
         np_marker = _netplane.begin_query()
         mem_marker = _memplane.begin_query()
+        cost_marker = _costplane.begin_query()
         # performance-plane windows: compile ns + busy intervals are
         # process-wide counters deltaed around this execution (the
         # FLUSH_COUNT discipline — exact when queries run serially)
@@ -380,6 +382,20 @@ class TpuSession:
         if _flush_pred is not None:
             predicted_flushes = _flush_pred.expected(result_rows)
         self.last_query_predicted_flushes = predicted_flushes
+        # device-compute cost roll-up (obs/costplane.py): joins the
+        # static XLA costs already captured at compile time with this
+        # window's dispatch ledger and the timeline busy span — pure
+        # host arithmetic, after the final flush, zero extra round trips
+        cost = None
+        if _costplane.enabled(conf):
+            try:
+                cost = _costplane.query_summary(
+                    cost_marker, busy_ms=float(tl["busy_ms"]))
+            except Exception:  # noqa: BLE001 — cost never fails a query
+                import logging
+                logging.getLogger("spark_rapids_tpu.obs.costplane").warning(
+                    "cost summary failed", exc_info=True)
+        self.last_query_costplane = cost
         extra = {"sem_wait_ms": round(sem_wait_ms, 3),
                  "spill_bytes": int(spill_bytes),
                  "flushes": int(flushes),
@@ -395,6 +411,8 @@ class TpuSession:
                  "unspill_count": mem["unspill_count"],
                  "leaked_entries": mem["leaked_entries"],
                  "memplane": mem}
+        if cost is not None:
+            extra["costplane"] = cost
         compiles = _cwatch.records_since(cw_marker)
         if compiles:
             extra["compiles"] = [
@@ -438,7 +456,8 @@ class TpuSession:
                     stats_profile=self.last_stats_profile,
                     query_id=token.query_id if token is not None
                     else None,
-                    compiles=extra.get("compiles"))
+                    compiles=extra.get("compiles"),
+                    costplane=cost)
                 self.last_query_diagnosis = diag
                 extra["doctor"] = diag.to_dict()
             except Exception:  # noqa: BLE001 — doctor never fails a query

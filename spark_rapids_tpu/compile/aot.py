@@ -322,16 +322,26 @@ def manifest_entries() -> int:
 # demand ledger
 # ---------------------------------------------------------------------------
 
-def note_demand(cache: str, capacity: int) -> None:
+def note_demand(cache: str, capacity: int,
+                rows: Optional[int] = None) -> None:
     """One program invocation at a bucketed capacity (called on the
     batch path next to each JIT cache).  A first demand against an
     unseen (program, bucket) pair is a *miss* — the call that makes
     jit's shape-keyed cache build the per-bucket executable; every
     later demand (including the first, when warmup pre-compiled the
     pair) is a *hit*.  Feeds the per-bucket hit/miss ledger, the
-    Prometheus bucket-demand counter, and the thread-local
-    last-demand the compile-telemetry plane reads to attribute a
-    compile to its bucket."""
+    Prometheus bucket-demand counter, the thread-local last-demand
+    the compile-telemetry plane reads to attribute a compile to its
+    bucket, and the cost plane's dispatch ledger (``rows`` is the
+    effective row count when the call site's host already knows it —
+    obs/costplane.py padding-waste accounting)."""
+    try:
+        # the cost plane is its own plane with its own conf: dispatch
+        # accounting runs even when the AOT ledger below is disabled
+        from ..obs import costplane as _costplane
+        _costplane.note_dispatch(cache, capacity, rows)
+    except Exception:  # noqa: BLE001 — observability never fails a call
+        pass
     if not _ENABLED:
         return
     cap = int(capacity)

@@ -604,6 +604,42 @@ OBS_DOCTOR_ENABLED = conf_bool(
     "Service.stats() and tpu_doctor_verdicts_total.  Pure post-query "
     "host arithmetic over already-collected summaries: zero extra "
     "device flushes by construction")
+OBS_COST_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.cost.enabled", True,
+    "Device-compute cost plane (obs/costplane.py): captures XLA "
+    "static cost analysis (flops, bytes accessed, IO working set) per "
+    "(program, bucket) at every JIT-cache first call — inline miss, "
+    "AOT warmup and persistent-cache load alike — into a bounded "
+    "static-cost store, records effective rows vs padded bucket "
+    "capacity on every dispatch, and at query end joins the static "
+    "costs with the flush-observer busy window into per-program "
+    "achieved FLOP/s, achieved GB/s, arithmetic intensity, a roofline "
+    "verdict (compute_bound/memory_bound) against the conf-declared "
+    "peak rates, and a padding-waste fraction pricing the AOT "
+    "lattice's bucketRatio.  Feeds the doctor's device_compute "
+    "sub-cause decomposition.  Host-side trace analysis only: zero "
+    "extra device flushes and zero extra backend compiles by "
+    "construction")
+OBS_COST_PEAK_TFLOPS = conf_float(
+    "spark.rapids.tpu.obs.cost.peakTeraflops", 275.0,
+    "Declared peak dense compute rate of one accelerator core in "
+    "TFLOP/s — the roofline ceiling achieved FLOP/s is scored "
+    "against.  The default matches a TPU v4-class part; override per "
+    "deployment (and on the CPU test mesh it is a model constant, "
+    "not a measurement).  With peakHbmGBps it fixes the ridge "
+    "intensity that splits compute_bound from memory_bound verdicts")
+OBS_COST_PEAK_HBM_GBPS = conf_float(
+    "spark.rapids.tpu.obs.cost.peakHbmGBps", 1200.0,
+    "Declared peak HBM bandwidth of one accelerator core in GB/s — "
+    "the roofline memory ceiling.  Programs whose arithmetic "
+    "intensity (flops per byte accessed) falls below "
+    "peakTeraflops*1e3/peakHbmGBps are verdicted memory_bound")
+OBS_COST_MAX_RECORDS = conf_int(
+    "spark.rapids.tpu.obs.cost.maxRecords", 256,
+    "Bound on retained (program, bucket) static-cost records and on "
+    "dispatch-ledger keys; past it new entries are dropped and "
+    "counted in tpu_cost_records_dropped (fixed memory — the "
+    "flight-recorder discipline)")
 SUPERSTAGE = conf_bool(
     "spark.rapids.tpu.sql.superstage", True,
     "Superstage compiler (compile/): a planner post-pass after the "

@@ -31,6 +31,7 @@ from ..columnar.batch import ColumnarBatch
 from ..compile import aot as _aot
 from ..expr import core as ec
 from ..obs import compile_watch as _compile_watch
+from ..obs import costplane as _costplane
 from ..obs.registry import compile_cache_event
 
 _LOG = logging.getLogger("spark_rapids_tpu.exec.fused")
@@ -205,7 +206,8 @@ class FusedEval:
             return None
         datas = tuple(batch.columns[i].data for i in self.needed)
         valids = tuple(batch.columns[i].validity for i in self.needed)
-        _aot.note_demand("fused_project", batch.capacity)
+        _aot.note_demand("fused_project", batch.capacity,
+                         _costplane.rows_if_resolved(batch))
         try:
             fused_out = self._jitted(batch.capacity, datas, valids,
                                      batch.rows_dev)

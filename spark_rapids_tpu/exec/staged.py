@@ -30,6 +30,7 @@ from ..compile import aot as _aot
 from ..expr import core as ec
 from ..kernels import basic as bk
 from ..obs import compile_watch as _compile_watch
+from ..obs import costplane as _costplane
 from ..obs.registry import compile_cache_event
 from .base import NUM_OUTPUT_ROWS, OP_TIME, timed
 from .fused import FusedEval, _TracedBatch, _tree_fusable, expr_signature
@@ -212,7 +213,9 @@ class TpuStagedCompute(TpuExec):
                         type(c) is Column for c in batch.columns):
                     datas = tuple(c.data for c in batch.columns)
                     valids = tuple(c.validity for c in batch.columns)
-                    _aot.note_demand("staged_compute", batch.capacity)
+                    _aot.note_demand(
+                        "staged_compute", batch.capacity,
+                        _costplane.rows_if_resolved(batch))
                     pairs, cnt = jitted(batch.capacity, datas, valids,
                                         batch.rows_dev)
                     n = LazyCount(cnt) if has_filter else \

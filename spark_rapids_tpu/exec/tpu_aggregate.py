@@ -24,6 +24,7 @@ from ..expr.aggregates import AggregateFunction
 from ..compile import aot as _aot
 from ..kernels import canon, aggregate as agg_k
 from ..obs import compile_watch as _compile_watch
+from ..obs import costplane as _costplane
 from ..obs.registry import compile_cache_event
 from ..plan.logical import AggExpr
 from .base import PhysicalPlan, AGG_TIME, NUM_OUTPUT_ROWS, timed
@@ -430,7 +431,8 @@ class TpuHashAggregate(TpuExec):
             (c.data, c.validity)
             for cols in input_cols for c in cols if c is not None)
         key_arrays = tuple((c.data, c.validity) for c in key_cols)
-        _aot.note_demand("hash_aggregate", batch.capacity)
+        _aot.note_demand("hash_aggregate", batch.capacity,
+                         _costplane.rows_if_resolved(batch))
         try:
             return core(key_arrays, in_arrays, batch.rows_dev)
         except Exception:  # noqa: BLE001 - fall back, but loudly
@@ -1116,7 +1118,8 @@ class TpuHashAggregate(TpuExec):
                                      str(hash(cache_key)))
         datas = tuple(c.data for c in batch.columns)
         valids = tuple(c.validity for c in batch.columns)
-        _aot.note_demand("hash_aggregate", batch.capacity)
+        _aot.note_demand("hash_aggregate", batch.capacity,
+                         _costplane.rows_if_resolved(batch))
         try:
             return core(datas, valids, batch.rows_dev)
         except Exception:  # noqa: BLE001 - fall back, but loudly
@@ -1321,7 +1324,8 @@ class TpuHashAggregate(TpuExec):
                     core = _compile_watch.wrap_miss(
                         "hash_aggregate", jax.jit(_core), str(cache_key))
                     TpuHashAggregate._CORE_CACHE[cache_key] = core
-                _aot.note_demand("hash_aggregate", batch.capacity)
+                _aot.note_demand("hash_aggregate", batch.capacity,
+                                 _costplane.rows_if_resolved(batch))
                 try:
                     pairs = core(in_arrays, batch.rows_dev)
                 except Exception:  # noqa: BLE001 - fall back, but loudly

@@ -360,12 +360,16 @@ class TpuHashJoinBase(TpuExec):
                     eff = counts
                 total = jnp.sum(eff.astype(jnp.int64))
                 return lo, counts, eff, total
-            fn = jax.jit(_core, static_argnames=())
+            from ..obs import costplane as _costplane
+            fn = _costplane.wrap_capture(
+                "join_probe", jax.jit(_core, static_argnames=()))
             TpuHashJoinBase._PROBE_JIT[key] = fn
         key_arrays = tuple((c.data, c.validity) for c in skey_cols)
         dparams = tuple(direct[:4]) if direct is not None else None
         from ..compile import aot as _aot
-        _aot.note_demand("join_probe", sb.capacity)
+        from ..obs import costplane as _costplane
+        _aot.note_demand("join_probe", sb.capacity,
+                         _costplane.rows_if_resolved(sb))
         try:
             lo, counts, eff, total = fn(tuple(bt.sorted_words), dparams,
                                         key_arrays, sb.rows_dev)
@@ -453,13 +457,17 @@ class TpuHashJoinBase(TpuExec):
                          for d, v in zip(bdatas, bvalids)]
                 return souts, bouts, p_idx, b_idx, live, \
                     cnt.astype(jnp.int64), fit
-            fn = jax.jit(_core)
+            from ..obs import costplane as _costplane
+            fn = _costplane.wrap_capture("join_spec_probe",
+                                         jax.jit(_core))
             if len(TpuHashJoinBase._SPEC_JIT) < 4096:
                 TpuHashJoinBase._SPEC_JIT[key] = fn
         key_arrays = tuple((c.data, c.validity) for c in skey_cols)
         dparams = tuple(direct[:4]) if direct is not None else None
         from ..compile import aot as _aot
-        _aot.note_demand("join_spec_probe", sb.capacity)
+        from ..obs import costplane as _costplane
+        _aot.note_demand("join_spec_probe", sb.capacity,
+                         _costplane.rows_if_resolved(sb))
         try:
             souts, bouts, p_idx, b_idx, live, cnt, fit = fn(
                 tuple(bt.sorted_words), dparams, key_arrays, sb.rows_dev,

@@ -53,6 +53,15 @@ Layers, mirroring the reference plugin's observability story
   timeline gap cause), retention/leak detection at query terminal
   states, and the admission headroom forecast.
 
+- ``obs.costplane`` — device-compute cost plane: XLA static cost
+  analysis (flops / bytes accessed / IO working set) captured per
+  (program, bucket) at every JIT-cache first call, joined at query
+  end with the flush-observer busy window into per-program achieved
+  FLOP/s, achieved GB/s, arithmetic intensity and a roofline verdict
+  (``compute_bound``/``memory_bound``) against conf-declared peaks —
+  plus padding-waste accounting (effective rows vs padded bucket
+  capacity per dispatch) pricing the AOT lattice's ``bucketRatio``.
+
 - ``obs.doctor`` — cross-plane query doctor: joins the per-query
   artifacts of every plane above into one ``QueryDiagnosis`` —
   exactly one primary bottleneck with priority-ordered evidence,
@@ -65,7 +74,7 @@ streams lives in ``tools/report.py`` (the SQL-UI stand-in).
 """
 from . import (trace, registry, prom, flight, timeline,     # noqa: F401
                compile_watch, slo, profile, netplane,       # noqa: F401
-               memplane, doctor)                            # noqa: F401
+               memplane, costplane, doctor)                 # noqa: F401
 from .registry import get_registry  # noqa: F401
 from .trace import span, traced     # noqa: F401
 

@@ -155,6 +155,12 @@ def wrap_miss(cache: str, fn: Callable, signature=None) -> Callable:
     ``note_persistent_hit``.  Warm calls afterwards pay one list-index
     check."""
     if not _ENABLED:
+        # compile telemetry off: the cost plane still needs the
+        # first-call choke point — but when it is off too, the old
+        # identity-passthrough contract holds exactly
+        from . import costplane as _costplane
+        if _costplane._ENABLED:
+            return _costplane.wrap_capture(cache, fn)
         return fn
     compiled = [False]
 
@@ -167,13 +173,26 @@ def wrap_miss(cache: str, fn: Callable, signature=None) -> Callable:
         out = fn(*args, **kwargs)
         compiled[0] = True
         dur_ns = time.perf_counter_ns() - t0
-        if aot.persistent_ready(key):
+        persistent = aot.persistent_ready(key)
+        if persistent:
             note_persistent_hit(cache, dur_ns, signature)
         else:
             note_compile(cache, dur_ns, signature)
             if key is not None:
                 aot.manifest_add(key, cache, signature,
                                  aot.last_demand(cache), dur_ns / 1e6)
+        try:
+            # device-compute cost plane: static cost analysis of the
+            # just-compiled program — one trace-only lowering pass per
+            # (program, bucket), same hook for miss/warmup/persistent
+            from . import costplane as _costplane
+            _costplane.capture(
+                cache, fn, args, kwargs,
+                origin=_costplane.ORIGIN_PERSISTENT if persistent
+                else _costplane.ORIGIN_WARMUP if aot.in_warmup()
+                else _costplane.ORIGIN_MISS)
+        except Exception:  # noqa: BLE001 — capture never fails the call
+            pass
         return out
 
     return _timed

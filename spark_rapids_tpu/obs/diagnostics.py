@@ -230,6 +230,13 @@ def collect_bundle(trigger: str,
         bundle["memory"] = mem
     except Exception as exc:
         bundle["memory"] = {"error": repr(exc)}
+    try:
+        # cost plane: static-cost store occupancy, process padding
+        # waste, last achieved rates — the roofline evidence
+        from . import costplane as _costplane
+        bundle["cost"] = _costplane.stats_section()
+    except Exception as exc:
+        bundle["cost"] = {"error": repr(exc)}
     bundle["shuffle"] = shuffle_state()
     if service is not None:
         try:

@@ -54,8 +54,10 @@ from typing import Dict, List, Optional
 from .registry import DOCTOR_VERDICTS, TIMELINE_GAP_CAUSES
 
 #: model version — bumped whenever the share model or the
-#: cause->roadmap table changes (part of stable_digest()).
-MODEL_VERSION = 1
+#: cause->roadmap table changes (part of stable_digest()).  v2: the
+#: device_compute share decomposes into compute_bound / memory_bound /
+#: padding_waste sub-causes from the cost plane (obs/costplane.py).
+MODEL_VERSION = 2
 
 #: verdict taxonomy, in PRIORITY ORDER: ``device_compute`` (the busy
 #: share, re-labeled from the timeline's ``util_pct``) first, then the
@@ -150,6 +152,8 @@ class QueryDiagnosis:
             "model_version": MODEL_VERSION,
             "taxonomy": [(c, item) for c, item, _fix in TAXONOMY],
             "headroom_model": "amdahl:1/(1-share/100)",
+            "device_compute_submodel":
+                "roofline_split+padding_waste,residue_folded",
             "stats_digest": self.data.get("stats_digest"),
         }
         blob = json.dumps(payload, sort_keys=True).encode()
@@ -219,17 +223,70 @@ def _compile_mix(compiles: Optional[List[Dict]]) -> str:
     return f" origins[{omix}] buckets[{bmix}]"
 
 
+def _cost_mix(costplane: Optional[Dict]) -> str:
+    """Cost-plane corroboration for the device_compute evidence line:
+    the roofline verdict, achieved rates and the padding-waste tax.
+    Empty string when the cost plane was off or captured nothing."""
+    if not costplane or not costplane.get("costed_records"):
+        return ""
+    verdict = costplane.get("verdict") or "?"
+    gf = costplane.get("achieved_gflops")
+    gb = costplane.get("achieved_gbps")
+    waste = costplane.get("padding_waste_pct")
+    gf_s = "?" if gf is None else f"{float(gf):.1f}"
+    gb_s = "?" if gb is None else f"{float(gb):.1f}"
+    w_s = "?" if waste is None else f"{float(waste):.1f}"
+    return (f" roofline[{verdict} achieved={gf_s}GF/s,{gb_s}GB/s "
+            f"padding_waste={w_s}%]")
+
+
+def _device_compute_breakdown(share: float, costplane: Optional[Dict]
+                              ) -> Optional[Dict[str, float]]:
+    """Split the ``device_compute`` share into exact sub-causes.
+
+    ``padding_waste`` is the share fraction spent computing padded
+    rows (share x waste/100); the remainder splits between
+    ``compute_bound`` and ``memory_bound`` by the cost plane's busy
+    apportionment.  Components are rounded to 3 decimals with the
+    residue folded into the largest, so the sub-shares sum EXACTLY to
+    the rounded ``device_compute`` share published in ``shares``.
+    Returns ``None`` when the cost plane was off or costed nothing —
+    pre-r14 records keep their old (breakdown-free) shape.
+    """
+    if not costplane or not costplane.get("costed_records"):
+        return None
+    waste = costplane.get("padding_waste_pct")
+    wf = float(waste) / 100.0 if isinstance(waste, (int, float)) else 0.0
+    wf = min(max(wf, 0.0), 1.0)
+    comp = float(costplane.get("compute_share_pct") or 0.0)
+    memr = float(costplane.get("memory_share_pct") or 0.0)
+    target = round(max(0.0, float(share)), 3)
+    padding = target * wf
+    rest = target - padding
+    denom = comp + memr
+    cb = rest * comp / denom if denom > 0.0 else rest
+    out = {"compute_bound": round(cb, 3),
+           "memory_bound": round(rest - cb, 3),
+           "padding_waste": round(padding, 3)}
+    residue = round(target - sum(out.values()), 3)
+    if residue:
+        top = max(out, key=lambda k: (out[k], k))
+        out[top] = round(out[top] + residue, 3)
+    return out
+
+
 def _evidence(cause: str, *, inline_compile_ms: float,
               netplane: Optional[Dict], memplane: Optional[Dict],
               flushes: int, predicted_flushes: Optional[int],
               sem_wait_ms: float, busy_ms: float,
-              compiles: Optional[List[Dict]] = None) -> str:
+              compiles: Optional[List[Dict]] = None,
+              costplane: Optional[Dict] = None) -> str:
     """Corroborating raw counter from the owning plane, as a string."""
     if cause == "device_compute":
         pred = ("?" if predicted_flushes is None
                 else str(int(predicted_flushes)))
         return (f"busy_ms={busy_ms:.1f} over flushes={int(flushes)} "
-                f"(predicted={pred})")
+                f"(predicted={pred}){_cost_mix(costplane)}")
     if cause == "inline_compile":
         return (f"inline_compile_ms={inline_compile_ms:.1f}"
                 f"{_compile_mix(compiles)}")
@@ -261,7 +318,8 @@ def diagnose(timeline_summary: Dict, *,
              sem_wait_ms: float = 0.0,
              stats_profile=None,
              query_id: Optional[str] = None,
-             compiles: Optional[List[Dict]] = None) -> QueryDiagnosis:
+             compiles: Optional[List[Dict]] = None,
+             costplane: Optional[Dict] = None) -> QueryDiagnosis:
     """Join the per-query plane summaries into one verdict.
 
     Called by the session AFTER every plane summary is already
@@ -292,7 +350,7 @@ def diagnose(timeline_summary: Dict, *,
                 predicted_flushes=predicted_flushes,
                 sem_wait_ms=sem_wait_ms,
                 busy_ms=float(timeline_summary.get("busy_ms", 0.0)),
-                compiles=compiles),
+                compiles=compiles, costplane=costplane),
         })
     # ranked: largest modeled headroom first, taxonomy order on ties
     candidates.sort(key=lambda c: (-c["share_pct"],
@@ -308,6 +366,10 @@ def diagnose(timeline_summary: Dict, *,
         "flushes": int(flushes),
         "predicted_flushes": predicted_flushes,
     }
+    breakdown = _device_compute_breakdown(
+        shares.get("device_compute", 0.0), costplane)
+    if breakdown is not None:
+        data["device_compute_breakdown"] = breakdown
     if stats_profile is not None:
         try:
             data["stats_digest"] = stats_profile.stable_digest()
@@ -336,13 +398,25 @@ def diagnose_bench(record: Dict) -> Optional[QueryDiagnosis]:
            "edges": []}
     mem = {"spill_ms": record.get("spill_ms", 0), "spill": {},
            "peak_device_bytes": record.get("peak_device_bytes", 0)}
+    # cost-plane keys land in r14 records; older rounds diagnose
+    # without the device_compute breakdown (placeholder tolerance)
+    cp = None
+    verdict = record.get("roofline_verdict")
+    if verdict is not None:
+        v = str(verdict)
+        cp = {"costed_records": 1, "verdict": v,
+              "compute_share_pct": 100.0 if v == "compute_bound" else 0.0,
+              "memory_share_pct": 0.0 if v == "compute_bound" else 100.0,
+              "padding_waste_pct": record.get("padding_waste_pct"),
+              "achieved_gbps": record.get("achieved_GBps"),
+              "achieved_gflops": None}
     return diagnose(
         tl,
         inline_compile_ms=float(record.get("inline_compile_ms") or 0.0),
         netplane=net, memplane=mem,
         flushes=int(record.get("flushes") or 0),
         predicted_flushes=record.get("predicted_flushes"),
-        query_id=record.get("metric"))
+        query_id=record.get("metric"), costplane=cp)
 
 
 def _record_verdict(diag: QueryDiagnosis) -> None:

@@ -63,6 +63,9 @@ EV_STATS = "stats"            # stats plane (name=site/kind; a,b = plain
 EV_NET = "net"                # shuffle-transport plane (name=phase
 #                               constant from obs/netplane.py; a=bytes,
 #                               b=duration ms)
+EV_COST = "cost"              # device-compute cost plane (name=program
+#                               constant; a=bucket capacity, b=flops
+#                               captured, truncated to int)
 EV_MEM = "mem"                # memory plane (name=direction/reason
 #                               constant from obs/memplane.py; a=bytes,
 #                               b=duration ms or count)

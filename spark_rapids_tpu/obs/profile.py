@@ -15,10 +15,13 @@ module restores attribution WITHOUT adding dispatches or host syncs:
   flushes forced while the scope is active accrue to that node's
   ``StageProfile`` (device-attributed wall ns + flush count);
 - member-level time shares inside a fused stage are apportioned
-  deterministically: a static per-operator FLOP/byte intensity factor
-  (derived from XLA cost analysis of the member programs over the
-  bench shapes) weighted by each member's output rows x nominal row
-  width, normalized so the shares sum to exactly 1.0;
+  deterministically: a per-operator FLOP/byte intensity factor —
+  MEASURED from the cost plane's live static-cost store
+  (obs/costplane.py, XLA ``cost_analysis()`` per program x bucket)
+  when that plane has costed the class's programs, the static
+  ``_INTENSITY`` table otherwise (the deterministic fallback when the
+  plane is off or cold) — weighted by each member's output rows x
+  nominal row width, normalized so the shares sum to exactly 1.0;
 - explicit dispatch sites (speculative join probe/redo, superstage
   chain steps, exchange splits, flushes) record bounded wall-duration
   samples per site for the per-query p50/p95 dispatch summary.
@@ -195,11 +198,17 @@ def dispatch_summary(marker: Optional[Dict[str, int]] = None) -> Dict:
 # member apportioning: deterministic time shares inside a fused stage
 # ---------------------------------------------------------------------------
 
-#: Relative per-output-row FLOP+byte intensity by operator class,
-#: derived from XLA cost analysis (jitted member programs lowered over
-#: the bench shapes: flops + bytes-accessed per row, normalized to the
-#: project program).  Coarse on purpose: rows x row-width carries the
-#: data-dependent scale, this factor only ranks operator classes.
+#: FALLBACK per-output-row FLOP+byte intensity by operator class,
+#: used only when the cost plane (obs/costplane.py) has no live XLA
+#: measurement for the class's programs (plane disabled, or nothing
+#: compiled yet).  When the plane is warm, ``_intensity()`` prefers
+#: ``costplane.measured_intensity()`` — (flops + bytes accessed) per
+#: bucket row from the captured ``cost_analysis()`` records,
+#: normalized to the fused_project program.  Coarse on purpose:
+#: rows x row-width carries the data-dependent scale, this factor
+#: only ranks operator classes.  Contract: both paths return a
+#: strictly positive float and the static ranks below stay aligned
+#: with the measured ranks (cross-checked in tests/test_costplane.py).
 _INTENSITY = (
     ("sort", 8.0), ("topn", 8.0), ("join", 6.0), ("aggregate", 5.0),
     ("agg", 5.0), ("exchange", 3.0), ("filter", 1.5), ("project", 1.0),
@@ -216,6 +225,16 @@ _NOMINAL_WIDTH = {"boolean": 1, "tinyint": 1, "smallint": 2, "int": 4,
 
 def _intensity(name: str) -> float:
     low = name.lower()
+    # measured weight first: the cost plane's live per-row XLA cost
+    # for this operator class (None when the plane is off/cold — the
+    # static table below is the deterministic fallback)
+    try:
+        from . import costplane as _costplane
+        measured = _costplane.measured_intensity(low)
+        if measured is not None and measured > 0.0:
+            return float(measured)
+    except Exception:  # noqa: BLE001 — attribution never fails a query
+        pass
     for key, factor in _INTENSITY:
         if key in low:
             return factor

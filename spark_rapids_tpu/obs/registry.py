@@ -632,6 +632,53 @@ DOCTOR_VERDICTS = _REGISTRY.counter(
     "plus the TIMELINE_GAP_CAUSES taxonomy",
     labels=("cause",))
 
+def _costplane_mod():
+    from . import costplane
+    return costplane
+
+
+COST_CAPTURES = _REGISTRY.counter(
+    "tpu_cost_captures_total",
+    "Static-cost captures by the device-compute cost plane "
+    "(obs/costplane.py) at JIT-cache first calls, by source: live XLA "
+    "cost analysis (xla) vs the deterministic static-intensity "
+    "fallback (static)",
+    labels=("source",))
+COST_RECORDS = _REGISTRY.gauge(
+    "tpu_cost_records",
+    "Retained (program, bucket) static-cost records in the bounded "
+    "store (spark.rapids.tpu.obs.cost.maxRecords)",
+    fn=lambda: float(_costplane_mod().record_count()))
+COST_RECORDS_DROPPED = _REGISTRY.gauge(
+    "tpu_cost_records_dropped",
+    "Static-cost records and dispatch-ledger keys dropped at the "
+    "maxRecords bound (fixed memory — the flight-recorder discipline)",
+    fn=lambda: float(_costplane_mod().dropped_count()))
+COST_PADDING_WASTE_PCT = _REGISTRY.gauge(
+    "tpu_cost_padding_waste_pct",
+    "Capacity-weighted padded-compute waste percent over every "
+    "rows-known dispatch since process start: 100 * (1 - effective "
+    "rows / padded bucket capacity) — the price of the AOT lattice's "
+    "bucketRatio (obs/costplane.py)",
+    fn=lambda: float(_costplane_mod().process_waste_pct()))
+COST_VERDICTS = _REGISTRY.counter(
+    "tpu_cost_roofline_verdicts_total",
+    "Per-program roofline verdicts issued at query end by the "
+    "device-compute cost plane: compute_bound when arithmetic "
+    "intensity clears the conf-declared ridge, memory_bound below it",
+    labels=("verdict",))
+COST_ACHIEVED_GFLOPS = _REGISTRY.gauge(
+    "tpu_cost_achieved_gflops",
+    "Last query's achieved GFLOP/s: total captured static flops "
+    "dispatched / flush-observer busy window (obs/costplane.py)",
+    fn=lambda: _costplane_mod().last_achieved("achieved_gflops"))
+COST_ACHIEVED_GBPS = _REGISTRY.gauge(
+    "tpu_cost_achieved_gbps",
+    "Last query's achieved GB/s: total captured static bytes "
+    "accessed dispatched / flush-observer busy window "
+    "(obs/costplane.py)",
+    fn=lambda: _costplane_mod().last_achieved("achieved_gbps"))
+
 SLO_LATENCY_SECONDS = _REGISTRY.histogram(
     "tpu_slo_latency_seconds",
     "Per-tenant service latency by phase: end_to_end (queue wait + "

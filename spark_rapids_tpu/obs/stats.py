@@ -118,6 +118,15 @@ def _stats_prog(h, pids, valid, word0, num_rows, nparts: int, m: int):
     return regs, nulls, wmin, wmax
 
 
+# device-compute cost plane first-call capture: exchange_stats has no
+# wrap_miss site (one module-level jit, not a keyed cache), so the
+# plane's own wrapper supplies the static-cost record.  The program
+# auditor keeps lowering the unwrapped jit via _audit_specs below.
+_stats_prog_jit = _stats_prog
+from . import costplane as _costplane  # noqa: E402
+_stats_prog = _costplane.wrap_capture("exchange_stats", _stats_prog_jit)
+
+
 class ExchangeBatchStats:
     """Staged (unresolved) stats of one map batch: resolves for free in
     the exchange's own finalize flush."""
@@ -192,7 +201,8 @@ def stage_exchange_batch(partitioner, batch,
         pids = (h % jnp.uint64(partitioner.num_partitions)
                 ).astype(jnp.int32)
         from ..compile import aot as _aot
-        _aot.note_demand("exchange_stats", batch.capacity)
+        _aot.note_demand("exchange_stats", batch.capacity,
+                         _rows_if_resolved(batch))
         regs, nulls, wmin, wmax = _stats_prog(
             h, pids, valid, word0, batch.rows_dev,
             partitioner.num_partitions, m)
@@ -545,7 +555,7 @@ def _audit_specs():
                 jax.ShapeDtypeStruct((cap,), np.uint64),
                 jax.ShapeDtypeStruct((), np.int32),
                 4, 64)
-        return _stats_prog, args, {"static_argnums": (5, 6)}
+        return _stats_prog_jit, args, {"static_argnums": (5, 6)}
 
     return [AuditSpec(
         "exchange_stats", "exchange_stats", _build, exact=False,

@@ -31,6 +31,7 @@ from ..config import (TpuConf, set_active, EVENT_LOG_PATH,
                       AOT_WARMUP_INTERVAL_MS, AOT_WARMUP_MAX_PER_CYCLE)
 from ..compile import aot as _aot
 from ..obs import compile_watch as _cwatch
+from ..obs import costplane as _costplane
 from ..obs import doctor as _doctor
 from ..obs import flight as _flight
 from ..obs import memplane as _memplane
@@ -169,6 +170,7 @@ class QueryService:
         _timeline.configure(conf)
         _netplane.configure(conf)
         _memplane.configure(conf)
+        _costplane.configure(conf)
         _doctor.configure(conf)
         _aot.configure(conf)
         # admission-aware AOT warmup daemon (service/warmup.py): watches
@@ -190,6 +192,7 @@ class QueryService:
             "timeline": _timeline.process_summary(),
             "shuffle": _netplane.stats_section(),
             "memory": _memplane.stats_section(),
+            "cost": _costplane.stats_section(),
             "doctor": _doctor.stats_section(),
             "aot": _aot.stats_section(),
             "warmup": self.warmup.state(),

@@ -437,6 +437,69 @@ def doctor_lines(rec: Dict) -> List[str]:
     return lines
 
 
+def cost_lines(rec: Dict) -> List[str]:
+    """The device-compute cost (costplane) section of one engine
+    record: per-program roofline rows (achieved rates, arithmetic
+    intensity, verdict), padding-waste bars against the padded bucket
+    capacities, and the doctor's device_compute sub-verdict split —
+    obs/costplane.py's event-log surface.  Placeholder-tolerant on
+    pre-r14 logs (same convention as ``--memory``/``--doctor``)."""
+    cost = rec.get("costplane")
+    if not cost:
+        return ["  (no costplane recorded — older log or "
+                "spark.rapids.tpu.obs.cost.enabled=false)"]
+    lines = ["-- device-compute cost (roofline) --"]
+    lines.append(
+        f"  verdict={cost.get('verdict')} "
+        f"achieved={_fmt(cost.get('achieved_gflops'))}GF/s,"
+        f"{_fmt(cost.get('achieved_gbps'))}GB/s "
+        f"padding_waste={_fmt(cost.get('padding_waste_pct'))}% "
+        f"(peaks {_fmt(cost.get('peak_tflops'))}TF/s,"
+        f"{_fmt(cost.get('peak_gbps'))}GB/s "
+        f"ridge={_fmt(cost.get('ridge_intensity'))} flop/B)")
+    progs = cost.get("programs") or []
+    if progs:
+        lines.append(f"  {'program':<26s}{'bucket':>8s}{'disp':>6s}"
+                     f"{'intensity':>10s}{'GF/s':>9s}{'GB/s':>9s}"
+                     f"{'share':>9s}  {'verdict':<14s}src")
+        for p in progs:
+            lines.append(
+                f"  {str(p.get('program')):<26s}"
+                f"{_fmt(p.get('bucket')):>8}"
+                f"{_fmt(p.get('dispatches')):>6}"
+                f"{_fmt(p.get('intensity')):>10}"
+                f"{_fmt(p.get('achieved_gflops')):>9}"
+                f"{_fmt(p.get('achieved_gbps')):>9}"
+                f"{_fmt(p.get('est_share_pct')):>8}%"
+                f"  {str(p.get('verdict') or '-'):<14s}"
+                f"{str(p.get('source') or '-')}")
+        wasted = [p for p in progs
+                  if p.get("padding_waste_pct") is not None]
+        if wasted:
+            lines.append("  padding waste (padded rows beyond the "
+                         "effective batch), by program:")
+            for p in sorted(wasted,
+                            key=lambda q: -q["padding_waste_pct"]):
+                pct = float(p["padding_waste_pct"])
+                bar = "#" * int(round(pct / 5.0))
+                lines.append(f"    {str(p.get('program')):<26s}"
+                             f"{pct:6.1f}%  {bar}")
+    uncosted = cost.get("uncosted_dispatches")
+    if uncosted:
+        lines.append(f"  uncosted_dispatches={uncosted} "
+                     "(no static cost captured for these buckets)")
+    doc = rec.get("doctor") or {}
+    sub = doc.get("device_compute_breakdown")
+    if sub:
+        d = (doc.get("shares") or {}).get("device_compute")
+        lines.append(
+            f"  doctor device_compute={_fmt(d)}% splits: "
+            f"compute_bound={_fmt(sub.get('compute_bound'))}% "
+            f"memory_bound={_fmt(sub.get('memory_bound'))}% "
+            f"padding_waste={_fmt(sub.get('padding_waste'))}%")
+    return lines
+
+
 def stats_lines(prof: Dict) -> List[str]:
     """Text sections for one record's StatsProfile (obs/stats.py)."""
     lines: List[str] = []
@@ -489,7 +552,8 @@ def render_query_report(query_id, story: Dict,
                         show_stats: bool = False,
                         show_shuffle: bool = False,
                         show_memory: bool = False,
-                        show_doctor: bool = False) -> str:
+                        show_doctor: bool = False,
+                        show_cost: bool = False) -> str:
     """One query's full text report."""
     lines = [f"=== query {query_id} " + "=" * 40]
     engine = story.get("engine", [])
@@ -535,6 +599,8 @@ def render_query_report(query_id, story: Dict,
             lines.extend(memory_lines(rec))
         if show_doctor:
             lines.extend(doctor_lines(rec))
+        if show_cost:
+            lines.extend(cost_lines(rec))
         if show_stats:
             prof = rec.get("stats_profile")
             if prof:
@@ -592,7 +658,8 @@ def render_report(stories: Dict,
                   query_id=None, show_stats: bool = False,
                   show_shuffle: bool = False,
                   show_memory: bool = False,
-                  show_doctor: bool = False) -> str:
+                  show_doctor: bool = False,
+                  show_cost: bool = False) -> str:
     ids = [query_id] if query_id is not None else sorted(
         stories, key=lambda q: str(q))
     parts = []
@@ -607,7 +674,8 @@ def render_report(stories: Dict,
                                          show_stats=show_stats,
                                          show_shuffle=show_shuffle,
                                          show_memory=show_memory,
-                                         show_doctor=show_doctor))
+                                         show_doctor=show_doctor,
+                                         show_cost=show_cost))
     return "\n\n".join(parts)
 
 
@@ -616,7 +684,8 @@ def render_html(stories: Dict,
                 query_id=None, show_stats: bool = False,
                 show_shuffle: bool = False,
                 show_memory: bool = False,
-                show_doctor: bool = False) -> str:
+                show_doctor: bool = False,
+                show_cost: bool = False) -> str:
     """Self-contained single-file HTML wrapping the text report
     per-query (monospace <pre> sections with a query index)."""
     ids = [query_id] if query_id is not None else sorted(
@@ -630,7 +699,8 @@ def render_html(stories: Dict,
                                   show_stats=show_stats,
                                   show_shuffle=show_shuffle,
                                   show_memory=show_memory,
-                                  show_doctor=show_doctor)
+                                  show_doctor=show_doctor,
+                                  show_cost=show_cost)
         body.append(f'<h2 id="q{_html.escape(str(qid))}">'
                     f"query {_html.escape(str(qid))}</h2>")
         body.append(f"<pre>{_html.escape(txt)}</pre>")
@@ -646,7 +716,7 @@ def main(argv=None):
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: report <event_log.jsonl> [--query QID] "
               "[--trace trace.json] [--html out.html] [--stats] "
-              "[--shuffle] [--memory] [--doctor]",
+              "[--shuffle] [--memory] [--doctor] [--cost] [--all]",
               file=sys.stderr)
         return 1
 
@@ -667,10 +737,14 @@ def main(argv=None):
     qid = _opt("--query")
     trace_path = _opt("--trace")
     html_out = _opt("--html")
-    show_stats = _flag("--stats")
-    show_shuffle = _flag("--shuffle")
-    show_memory = _flag("--memory")
-    show_doctor = _flag("--doctor")
+    # --all turns on every per-plane section in one go (each section
+    # stays placeholder-tolerant, so --all is safe on any-age log)
+    show_all = _flag("--all")
+    show_stats = _flag("--stats") or show_all
+    show_shuffle = _flag("--shuffle") or show_all
+    show_memory = _flag("--memory") or show_all
+    show_doctor = _flag("--doctor") or show_all
+    show_cost = _flag("--cost") or show_all
     log_path = argv[0]
     stories = load_query_stories(log_path)
     trace_events = load_trace(trace_path) if trace_path else None
@@ -687,14 +761,16 @@ def main(argv=None):
                                 show_stats=show_stats,
                                 show_shuffle=show_shuffle,
                                 show_memory=show_memory,
-                                show_doctor=show_doctor))
+                                show_doctor=show_doctor,
+                                show_cost=show_cost))
         print(f"wrote {html_out}")
     else:
         print(render_report(stories, trace_events, qid,
                             show_stats=show_stats,
                             show_shuffle=show_shuffle,
                             show_memory=show_memory,
-                            show_doctor=show_doctor))
+                            show_doctor=show_doctor,
+                            show_cost=show_cost))
     return 0
 
 

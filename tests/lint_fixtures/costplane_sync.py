@@ -1,0 +1,36 @@
+"""Seeded SYNC001/OBS002/HYG002 fixture shaped like a cost-plane
+helper — ``ci/lint.py`` must exit NONZERO.
+
+The device-compute cost plane (obs/costplane.py) captures static XLA
+costs at compile time and joins them with dispatch counters the exec
+layer already maintains, so its lint scope bans exactly what this
+helper does: pulling a device buffer to "measure" achieved rates,
+materializing args to size a bucket, a flight-recorder event that
+allocates per capture, and a wall-clock read where the busy window
+must come from the monotonic flush observer.  Never imported by the
+engine.
+"""
+import time
+
+import jax
+import numpy as np
+
+from spark_rapids_tpu.obs import flight as _flight
+
+
+def bad_capture(cache, dev, bucket):
+    host = jax.device_get(dev)                # SYNC001: host pull
+    rows = np.asarray(dev).shape[0]           # SYNC001: materialization
+    jax.block_until_ready(dev)                # SYNC001: forced sync
+    _flight.record(_flight.EV_COST, f"{cache}:{bucket}")  # OBS002
+    stamp = time.time()                       # HYG002: wall clock
+    return host, rows, stamp
+
+
+def good_capture(cache, lowered, bucket):
+    # the cost plane's real shape: static cost_analysis() of an
+    # already-lowered program, interned name constants, int args only
+    costs = lowered.cost_analysis() or {}
+    _flight.record(_flight.EV_COST, name=cache, a=int(bucket),
+                   b=int(costs.get("flops", 0.0)))
+    return costs

@@ -189,10 +189,20 @@ class TestCompileWatch:
         assert min(r["dur_ms"] for r in recs) >= 10 / 1e3
 
     def test_disabled_watch_is_passthrough(self):
+        from spark_rapids_tpu.obs import costplane
         compile_watch.configure(TpuConf({
             "spark.rapids.tpu.obs.compile.enabled": False}))
         fn = lambda: 7                                 # noqa: E731
-        assert compile_watch.wrap_miss("off", fn) is fn
+        # the cost plane still needs the first-call choke point, so
+        # identity passthrough requires BOTH planes off
+        costplane.configure(TpuConf({
+            "spark.rapids.tpu.obs.cost.enabled": False}))
+        try:
+            assert compile_watch.wrap_miss("off", fn) is fn
+        finally:
+            costplane.configure(TpuConf({}))
+        wrapped = compile_watch.wrap_miss("off", fn)
+        assert wrapped is not fn and wrapped() == 7
         compile_watch.note_compile("off", 5 * MS)
         assert not compile_watch.records_since(0)
 

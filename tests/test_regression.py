@@ -80,10 +80,11 @@ class TestHistory:
             assert r01.get(key) is None, key
         assert r01.get("value") is not None
         # the newest round carries the full gated key set (the four
-        # cold-path keys exist only from r13 on)
-        r13 = rounds[13]
+        # cold-path keys exist only from r13 on, the three roofline
+        # keys only from r14 on)
+        r14 = rounds[14]
         for key, _d, _b in R.GATE_KEYS:
-            assert r13.get(key) is not None, key
+            assert r14.get(key) is not None, key
 
     def test_history_table_has_placeholder_rows(self):
         rounds = R.load_history(REPO_ROOT)
@@ -162,15 +163,15 @@ class TestCompare:
 # ---------------------------------------------------------------------------
 
 class TestCommittedBaseline:
-    def test_baseline_values_equal_r13(self):
+    def test_baseline_values_equal_r14(self):
         base = R.load_baseline(BASELINE)
-        assert base["round"] == 13
-        r13 = R.load_round(os.path.join(REPO_ROOT,
-                                        "BENCH_r13.json")).keys
+        assert base["round"] == 14
+        r14 = R.load_round(os.path.join(REPO_ROOT,
+                                        "BENCH_r14.json")).keys
         for key, spec in base["keys"].items():
-            assert spec["value"] == r13[key], key
+            assert spec["value"] == r14[key], key
         # so the committed pair passes the gate by construction
-        assert not R.regressions(R.compare(r13, base))
+        assert not R.regressions(R.compare(r14, base))
 
     def test_true_r12_numbers_pass_the_gate(self, capsys):
         rc = _gate().main(["--current",
@@ -222,7 +223,7 @@ class TestGateCli:
         out_path = tmp_path / "PERF_BASELINE.json"
         monkeypatch.setattr(gate, "BASELINE_PATH", str(out_path))
         rc = gate._seed_baseline(
-            os.path.join(REPO_ROOT, "BENCH_r13.json"))
+            os.path.join(REPO_ROOT, "BENCH_r14.json"))
         assert rc == 0
         reseeded = R.load_baseline(str(out_path))
         committed = R.load_baseline(BASELINE)
