@@ -59,6 +59,14 @@ advance it), spill_ms the active spill time inside the warm window, and
 spill_tax_pct the share of the headline wall spent moving buffers
 between tiers (spill + unspill) — 0.0 on a bench host whose budget
 fits the working set, which is itself the claim the key documents.
+
+Fleet split: since r15 the service stage runs with a history dir
+configured (obs/history.py, obs/anomaly.py), so the burst prices the
+fleet longitudinal plane: history_rows must equal the submission
+count exactly (gated "exact" — any drop or double-count is a
+regression), anomaly_checks counts the sentinel's EWMA folds, and
+history_write_p99_us bounds the background writer's append latency
+(the plane's only I/O, strictly off the query path).
 """
 import json
 import sys
@@ -228,21 +236,49 @@ def measure_service_p99(n_rows: int = 200_000, submissions: int = 8):
     """Tenant p99 through the serving front-end (service/server.py):
     submit a small burst as tenant "bench" and read the SLO plane's
     reservoir percentile from stats().  Small rows on purpose — this
-    measures the serving overhead distribution, not throughput."""
+    measures the serving overhead distribution, not throughput.
+
+    The same burst prices the fleet plane (obs/history.py,
+    obs/anomaly.py): the service runs with a history dir configured,
+    so every terminal query folds one JSONL row through the bounded
+    background writer and the sentinel.  history_rows must equal the
+    submission count exactly (nothing dropped, nothing double-counted),
+    anomaly_checks counts the sentinel's per-(fingerprint, key) folds,
+    and history_write_p99_us is the background append p99 — the
+    off-query-path budget the perf gate bounds."""
+    import tempfile
     from spark_rapids_tpu.api import TpuSession
     from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.obs import anomaly as _anomaly
+    from spark_rapids_tpu.obs import history as _history
     from spark_rapids_tpu.service.server import QueryService
-    s = TpuSession(TpuConf({"spark.rapids.tpu.sql.enabled": True}))
+    hist_dir = tempfile.mkdtemp(prefix="bench_history_")
+    s = TpuSession(TpuConf({
+        "spark.rapids.tpu.sql.enabled": True,
+        "spark.rapids.tpu.obs.history.dir": hist_dir,
+    }))
     df = build_df(s, n_rows, 2)
     df.to_arrow()          # warm the compile caches first
     with QueryService(session=s, num_workers=2) as svc:
+        # only the measured burst below lands in the fleet counters
+        _history.reset()
+        _anomaly.reset()
         handles = [svc.submit(df, tenant="bench")
                    for _ in range(submissions)]
         for h in handles:
             h.result(timeout=120)
         snap = svc.stats().snapshot()
-    return snap.get("slo", {}).get("tenants", {}).get(
-        "bench", {}).get("p99_ms")
+    # read fleet counters AFTER shutdown: stop() drains the writer
+    # queue, so write_p99_us covers every appended row
+    hist = _history.stats_section()
+    anom = _anomaly.stats_section()
+    return {
+        "service_p99_ms": snap.get("slo", {}).get("tenants", {}).get(
+            "bench", {}).get("p99_ms"),
+        "history_rows": hist.get("rows"),
+        "history_write_p99_us": hist.get("write_p99_us"),
+        "anomaly_checks": anom.get("checks"),
+    }
 
 
 def main():
@@ -270,7 +306,8 @@ def main():
     tpu_var_t, _, _, _ = run_engine(True, n_rows, parts, repeats,
                                     variable_float=True)
     cpu_t, _, _, _ = run_engine(False, n_rows, parts, repeats)
-    service_p99 = measure_service_p99()
+    svc_keys = measure_service_p99()
+    service_p99 = svc_keys["service_p99_ms"]
     disp = (tpu_prof or {}).get("dispatches", {}).get("all", {})
     diag = tpu_perf.get("diagnosis")
     tl = tpu_perf.get("timeline") or {}
@@ -367,6 +404,14 @@ def main():
         "doctor_headroom_x": (diag.headroom[0]["bound_x"]
                               if diag is not None and diag.headroom
                               else None),
+        # fleet longitudinal plane (obs/history.py, obs/anomaly.py):
+        # the service burst's history-row count (must equal the
+        # submission count exactly — zero drops), the sentinel's
+        # per-(fingerprint, key) fold count, and the background
+        # writer's append p99 (the off-query-path budget)
+        "history_rows": svc_keys["history_rows"],
+        "anomaly_checks": svc_keys["anomaly_checks"],
+        "history_write_p99_us": svc_keys["history_write_p99_us"],
     }))
 
 

@@ -16,7 +16,13 @@ the device-compute cost plane (obs/costplane.py) costs the workload's
 own programs, splits the roofline shares to 100 within 1e-6, prices
 padding waste >0 under a forced non-power-of-two batch, decomposes
 the doctor's device_compute share exactly, and adds zero device
-flushes against a cost-off run of the same query.
+flushes against a cost-off run of the same query, (8) the fleet plane
+(obs/fingerprint, obs/history, obs/anomaly, obs/dashboard) writes one
+history row per terminal query of a two-tenant repeated mix, flags an
+injected sleep-shim slowdown on exactly the shimmed plan fingerprint
+across the event log, Prometheus, the doctor trend and the dashboard,
+reads the same story back through tools/history.py, and adds zero
+device flushes against a fleet-off run of the same query.
 """
 import json
 import os
@@ -354,6 +360,127 @@ def main():
     assert failed and failed[0]["diag_bundle"] == bundles[0], failed
     assert diagnose_main([bundles[0], "--no-stacks"]) == 0
     print("diagnostics OK:", os.path.basename(bundles[0]))
+
+    # 5. fleet plane (obs/fingerprint, history, anomaly, dashboard): a
+    #    repeated query mix on two tenants writes one history row per
+    #    terminal query, a sleep-shimmed slowdown injected into ONE
+    #    plan's UDF drifts exactly that fingerprint — the sentinel
+    #    breaches it (and no other) into the event log, Prometheus,
+    #    the doctor trend and the dashboard — and the offline
+    #    tools/history.py CLI reads the same story back from disk
+    import time as _time_mod
+    import urllib.request
+    from spark_rapids_tpu.obs import anomaly as _anomaly
+    from spark_rapids_tpu.obs import history as _histplane
+    hist_dir = os.path.join(td, "history")
+    fleet_log = os.path.join(td, "fleet_events.jsonl")
+    fleet_diag = os.path.join(td, "fleet_diag")
+    _histplane.reset()
+    _anomaly.reset()
+    fs = TpuSession(TpuConf({
+        "spark.rapids.tpu.obs.history.dir": hist_dir,
+        "spark.rapids.tpu.eventLog.path": fleet_log,
+        "spark.rapids.tpu.obs.diagnostics.dir": fleet_diag,
+        "spark.rapids.tpu.obs.anomaly.warmupMinRuns": 5,
+        "spark.rapids.tpu.obs.anomaly.breachRuns": 3,
+        "spark.rapids.tpu.obs.anomaly.sigma": 2.0,
+    }))
+    fast_df = fs.range(0, 256, num_partitions=2) \
+        .select((F.col("id") % 5).alias("k")) \
+        .group_by("k").agg(F.count("k").alias("c"))
+    shim = {"sleep_s": 0.05}
+
+    def _shimmed(series):
+        _time_mod.sleep(shim["sleep_s"])
+        return series
+    shim_udf = pandas_udf(_shimmed, return_type=T.INT64)
+    shim_df = fs.range(0, 32, num_partitions=1) \
+        .select(shim_udf(F.col("id")).alias("id"))
+    fast_df.collect()        # warm the compiles OUTSIDE the service:
+    shim_df.collect()        # cold-compile wall must not skew the
+    _histplane.reset()       # sentinel's warm-up baseline
+    _anomaly.reset()
+    with QueryService(fs, num_workers=1) as fsvc:
+        fp_fast = fp_shim = None
+        for i in range(6):            # warm-up: both plans healthy
+            fsvc.submit(fast_df,
+                        tenant="red" if i % 2 else "blue").result(120)
+            fp_fast = fs.last_query_fingerprint
+            fsvc.submit(shim_df, tenant="red").result(120)
+            fp_shim = fs.last_query_fingerprint
+        shim["sleep_s"] = 0.5         # the injected regression
+        for _ in range(4):
+            fsvc.submit(fast_df, tenant="blue").result(120)
+            fsvc.submit(shim_df, tenant="red").result(120)
+        fleet_snap = fsvc.stats().snapshot()
+        fleet_metrics = fsvc.metrics_text()
+        port = fsvc.start_metrics_server()
+        dash = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/dashboard", timeout=10) \
+            .read().decode()
+    assert fp_fast and fp_shim and fp_fast != fp_shim
+    # one history row per terminal query, none dropped
+    h = fleet_snap["history"]
+    assert h["rows"] == 20, h
+    assert h["dropped"] == 0 and h["segments"] >= 1, h
+    aggs = _histplane.fleet_aggregates()
+    assert aggs[fp_fast]["count"] == 10 and aggs[fp_shim]["count"] == 10
+    assert set(aggs[fp_fast]["tenants"]) == {"red", "blue"}, aggs
+    # the sentinel breached exactly the shimmed fingerprint
+    assert fleet_snap["anomaly"]["active"] >= 1, fleet_snap["anomaly"]
+    anomalies = _rel(fleet_log, events="anomaly")
+    assert anomalies, "no anomaly events logged"
+    breached = {r["fingerprint"] for r in anomalies
+                if r["anomaly_kind"] == "breach"}
+    assert breached == {fp_shim}, (breached, fp_shim, fp_fast)
+    breach = [r for r in anomalies if r["anomaly_kind"] == "breach"][0]
+    assert breach["key"] == "exec_ms" and breach["drift_pct"] > 100
+    assert breach["diag_bundle"] and os.path.exists(
+        breach["diag_bundle"]), breach
+    assert 'tpu_anomaly_events_total{kind="breach"}' in fleet_metrics
+    assert "tpu_anomaly_active" in fleet_metrics
+    assert "tpu_history_rows_total" in fleet_metrics
+    # the doctor trend section carries the drift for that fingerprint
+    trend = fleet_snap["doctor"]["trend"]
+    assert "exec_ms" in trend[fp_shim]["active"], trend[fp_shim]
+    drift = trend[fp_shim]["drift"]["exec_ms"]
+    assert drift["last"] > 2 * drift["baseline"], drift
+    assert not trend.get(fp_fast, {}).get("active"), trend
+    # the dashboard served beside /metrics shows the breach
+    assert fp_shim in dash and "Active anomalies" in dash
+    # the offline CLI reads the same story back from the segments
+    from spark_rapids_tpu.tools.history import main as history_main
+    assert history_main(["summary", hist_dir]) == 0
+    assert history_main(["trend", hist_dir, "--fingerprint", fp_shim,
+                         "--key", "exec_ms"]) == 0
+    assert history_main(["compare", hist_dir, "--fingerprint",
+                         fp_shim]) == 0
+    from spark_rapids_tpu.tools.history import (compare_windows,
+                                                load_rows)
+    disk_rows = load_rows(hist_dir)
+    assert len(disk_rows) == 20, len(disk_rows)
+    delta = compare_windows(load_rows(hist_dir, fingerprint=fp_shim),
+                            keys=("exec_ms",))
+    assert delta["keys"]["exec_ms"]["delta_pct"] > 100, delta
+    # zero extra device flushes: history+anomaly on vs off, same query
+    def _fleet_flush_delta(conf):
+        zs = TpuSession(conf)
+        zq = zs.range(0, 64, num_partitions=2) \
+            .select((F.col("id") % 7).alias("k")) \
+            .group_by("k").agg(F.count("k").alias("c"))
+        zq.collect()
+        f0 = _pending.FLUSH_COUNT
+        zq.collect()
+        return _pending.FLUSH_COUNT - f0
+    on_f = _fleet_flush_delta(TpuConf({}))
+    off_f = _fleet_flush_delta(TpuConf({
+        "spark.rapids.tpu.obs.history.enabled": False,
+        "spark.rapids.tpu.obs.anomaly.enabled": False}))
+    assert on_f == off_f, (on_f, off_f)
+    print(f"fleet plane OK: rows={h['rows']}, "
+          f"breached={sorted(breached)}, "
+          f"drift={breach['drift_pct']}%, "
+          f"flushes on/off={on_f}/{off_f}")
     print("obs smoke: OK")
     return 0
 

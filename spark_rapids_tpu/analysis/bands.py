@@ -1,0 +1,63 @@
+"""Shared band/direction compare core — ONE definition of "regressed"
+for both longitudinal sentinels.
+
+The offline perf gate (``analysis/regression.py`` + ``ci/perf_gate.py``,
+gating committed ``BENCH_r*.json`` rounds against ``PERF_BASELINE.json``)
+and the online anomaly sentinel (``obs/anomaly.py``, folding live
+history rows into per-fingerprint EWMA state) classify a current value
+against a baseline with identical semantics:
+
+- ``higher`` (throughput-like): regression below
+  ``base * (1 - band_pct/100)``, improvement above
+  ``base * (1 + band_pct/100)``;
+- ``lower`` (tax/latency-like): regression above
+  ``max(base * (1 + band_pct/100), abs_floor)`` — the absolute floor
+  guards a 0.0 baseline from gating at 0 — improvement below the low
+  edge;
+- ``exact`` (deterministic counts): any mismatch is a regression,
+  never an improvement.
+
+Pure host arithmetic, stdlib only: never imports jax, never touches
+the device (the ``analysis/`` discipline).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+#: classification outcomes (a shared vocabulary, not an enum: both
+#: consumers serialize these strings into reports/events)
+OK, REGRESSION, IMPROVEMENT = "ok", "regression", "improvement"
+
+
+def band_limits(base: float, band_pct: float, direction: str = "higher",
+                abs_floor: float = 0.0) -> Tuple[float, float]:
+    """(low edge, high edge) of the tolerated band around ``base``.
+    For ``lower``-direction keys the high edge is floored at
+    ``abs_floor`` (the zero-baseline guard)."""
+    lo = base * (1.0 - band_pct / 100.0)
+    hi = base * (1.0 + band_pct / 100.0)
+    if direction == "lower":
+        hi = max(hi, float(abs_floor))
+    return lo, hi
+
+
+def band_status(cur: float, base: float, direction: str,
+                band_pct: float = 0.0, abs_floor: float = 0.0) -> str:
+    """Classify ``cur`` against ``base``: :data:`OK`,
+    :data:`REGRESSION` or :data:`IMPROVEMENT` under the shared
+    direction semantics documented in the module header."""
+    if direction == "exact":
+        return REGRESSION if cur != base else OK
+    lo, hi = band_limits(base, band_pct, direction, abs_floor)
+    if direction == "higher":
+        if cur < lo:
+            return REGRESSION
+        if cur > hi:
+            return IMPROVEMENT
+        return OK
+    # lower is better
+    if cur > hi:
+        return REGRESSION
+    if cur < lo:
+        return IMPROVEMENT
+    return OK

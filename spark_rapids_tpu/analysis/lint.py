@@ -717,7 +717,9 @@ def _scopes_for(rel: str) -> Set[str]:
                      "stats.py", "profile.py", "timeline.py",
                      "compile_watch.py", "slo.py", "netplane.py",
                      "memplane.py", "doctor.py", "costplane.py",
-                     "regression.py", "warmup.py"):
+                     "regression.py", "warmup.py", "fingerprint.py",
+                     "history.py", "anomaly.py", "dashboard.py",
+                     "bands.py"):
         # the superstage compiler exists to ELIMINATE host round trips:
         # the AOT warmup daemon (service/warmup.py) calls jitted
         # programs from a background thread and carries the same
@@ -730,12 +732,16 @@ def _scopes_for(rel: str) -> Set[str]:
         # transport plane (obs/netplane.py), the memory plane
         # (obs/memplane.py), the cross-plane doctor (obs/doctor.py),
         # the device-compute cost plane (obs/costplane.py),
-        # the regression sentinel (analysis/regression.py) and their
-        # exchange call sites carry the same zero-flush +
+        # the regression sentinel (analysis/regression.py), the fleet
+        # plane (obs/fingerprint.py, obs/history.py, obs/anomaly.py,
+        # obs/dashboard.py + the tools/history.py CLI over its store),
+        # the shared band core (analysis/bands.py) and their exchange
+        # call sites carry the same zero-flush +
         # allocation-free-record contract
         scopes |= {SYNC001, OBS002}
     if "obs" in parts or base in ("regression.py", "aot.py",
-                                  "warmup.py"):
+                                  "warmup.py", "bands.py",
+                                  "history.py"):
         # the doctor lives in obs/ (covered by the parts check); the
         # sentinel sits in analysis/ but carries the same timing-
         # hygiene contract as the planes whose artifacts it gates;

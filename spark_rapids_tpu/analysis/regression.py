@@ -24,6 +24,10 @@ and gates CI on a committed baseline:
   the current record are *skipped* (placeholder tolerance), and a
   result beyond the band in the good direction is flagged as an
   improvement so ``ci/perf_gate.py`` can suggest a baseline bump.
+  The per-key classification core lives in ``analysis/bands.py`` —
+  shared verbatim with the online anomaly sentinel
+  (``obs/anomaly.py``), so "regressed" means the same thing offline
+  and live.
 
 The CLI gate lives in ``ci/perf_gate.py``; on a regression it prints
 the cross-plane doctor's verdict for the record
@@ -40,6 +44,8 @@ import os
 import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
+
+from .bands import band_status
 
 #: keys gated by default when seeding a baseline: (key, direction,
 #: band_pct).  Directions: ``higher`` = higher is better (throughput),
@@ -84,6 +90,16 @@ GATE_KEYS: Tuple[Tuple[str, str, float], ...] = (
     # gate key (make_baseline skips non-numerics by design).
     ("achieved_GBps", "higher", 18.0),
     ("padding_waste_pct", "lower", 150.0),
+    # longitudinal fleet plane (obs/history.py + obs/anomaly.py):
+    # history rows are one-per-terminal-query by contract (exact, like
+    # the flush counts), anomaly folds scale with rows x gated keys
+    # (higher would mask a silently disabled sentinel), and the
+    # background JSONL append must stay cheap (wide band + floor — a
+    # p99 in single-digit ms is still off the query path, the gate
+    # only catches an accidental sync write)
+    ("history_rows", "exact", 0.0),
+    ("anomaly_checks", "higher", 18.0),
+    ("history_write_p99_us", "lower", 150.0),
 )
 
 #: keys scaled by the seeded perf-gate fixtures (throughput-like).
@@ -102,6 +118,7 @@ ABS_FLOORS = {
     "inline_compile_ms": 5.0,
     "service_p99_ms": 100.0,
     "padding_waste_pct": 50.0,
+    "history_write_p99_us": 2000.0,
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -276,33 +293,18 @@ def compare(current: Dict, baseline: Dict) -> List[Delta]:
                              f"no current value (baseline {_fmt(base)})"))
             continue
         if direction == "exact":
-            if cur != base:
-                status, msg = "regression", (
-                    f"expected exactly {_fmt(base)}, got {_fmt(cur)}")
+            status = band_status(cur, base, "exact")
+            if status == "regression":
+                msg = f"expected exactly {_fmt(base)}, got {_fmt(cur)}"
             else:
-                status, msg = "ok", f"{_fmt(cur)} (exact match)"
+                msg = f"{_fmt(cur)} (exact match)"
             out.append(Delta(key, direction, base, band, cur, status, msg))
             continue
-        lo = base * (1.0 - band / 100.0)
-        hi = base * (1.0 + band / 100.0)
         pct = (0.0 if base == 0 else (cur - base) / abs(base) * 100.0)
         detail = (f"{_fmt(cur)} vs baseline {_fmt(base)} "
                   f"({pct:+.1f}%, band ±{band:g}%)")
-        if direction == "higher":
-            if cur < lo:
-                status = "regression"
-            elif cur > hi:
-                status = "improvement"
-            else:
-                status = "ok"
-        else:  # lower is better
-            floor = float(spec.get("abs_floor", 0.0))
-            if cur > max(hi, floor):
-                status = "regression"
-            elif cur < lo:
-                status = "improvement"
-            else:
-                status = "ok"
+        status = band_status(cur, base, direction, band,
+                             float(spec.get("abs_floor", 0.0)))
         out.append(Delta(key, direction, base, band, cur, status, detail))
     return out
 

@@ -464,6 +464,42 @@ class TpuSession:
                 import logging
                 logging.getLogger("spark_rapids_tpu.obs.doctor").warning(
                     "query diagnosis failed", exc_info=True)
+        # longitudinal fleet plane: the stable plan fingerprint groups
+        # this query with every recurrence of its shape
+        # (obs/fingerprint.py), and the engine-side artifacts are
+        # deposited for the history store's terminal join keyed by the
+        # same query_id the service folds at the terminal transition
+        # (obs/history.py).  Pure host arithmetic after the final
+        # flush: the FLUSH_COUNT delta above is unchanged.
+        self.last_query_fingerprint = None
+        try:
+            from ..obs import fingerprint as _fingerprint
+            from ..obs import history as _qhistory
+            fp = _fingerprint.plan_fingerprint(phys, conf)
+            self.last_query_fingerprint = fp
+            extra["plan_fingerprint"] = fp
+            if token is not None and _qhistory.enabled():
+                art = {
+                    "fingerprint": fp,
+                    "flushes": int(flushes),
+                    "flushes_predicted": predicted_flushes,
+                    "device_util_pct": tl["util_pct"],
+                    "gaps": tl["gaps"],
+                }
+                if cost is not None:
+                    art["roofline_verdict"] = cost.get("verdict")
+                    art["achieved_GBps"] = cost.get("achieved_gbps")
+                    art["padding_waste_pct"] = \
+                        cost.get("padding_waste_pct")
+                if self.last_query_diagnosis is not None:
+                    d = self.last_query_diagnosis.to_dict()
+                    art["doctor_cause"] = d.get("primary_cause")
+                    art["doctor_share_pct"] = d.get("primary_share_pct")
+                _qhistory.note_query(token.query_id, art)
+        except Exception:  # noqa: BLE001 — fleet plane never fails a query
+            import logging
+            logging.getLogger("spark_rapids_tpu.obs.history").warning(
+                "fingerprint/history deposit failed", exc_info=True)
         self._log_query(phys, (_time.perf_counter() - t0) * 1000,
                         conf=conf, fallbacks=fallbacks, extra=extra)
         target = schema_to_arrow(phys.output_schema) if len(

@@ -640,6 +640,100 @@ OBS_COST_MAX_RECORDS = conf_int(
     "dispatch-ledger keys; past it new entries are dropped and "
     "counted in tpu_cost_records_dropped (fixed memory — the "
     "flight-recorder discipline)")
+OBS_HISTORY_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.history.enabled", True,
+    "Persistent query-history store (obs/history.py): one compact row "
+    "per terminal query — plan fingerprint, tenant, outcome, latency "
+    "phases, predicted/observed flushes, device_util_pct + gap "
+    "breakdown, host_drop_tax_ms, spill/compile/roofline keys and the "
+    "doctor verdict — appended to JSONL segments off the query path "
+    "through a bounded writer queue (full queue drops the row and "
+    "counts it in tpu_history_dropped_total; a history failure never "
+    "fails a query).  The longitudinal substrate the anomaly "
+    "sentinel, fleet dashboard and tools/history.py CLI read.  "
+    "Host-side arithmetic over already-stamped QueryMetrics: zero "
+    "extra device flushes by construction")
+OBS_HISTORY_DIR = conf_str(
+    "spark.rapids.tpu.obs.history.dir", "",
+    "Directory for the history store's history-*.jsonl segments.  "
+    "Empty (the default) keeps the store in-memory only: fleet "
+    "aggregates, the sentinel and the dashboard all still work for "
+    "the life of the process, but nothing persists across restarts")
+OBS_HISTORY_MAX_SEGMENT_BYTES = conf_bytes(
+    "spark.rapids.tpu.obs.history.rotation.maxBytes", 4 * 1024 * 1024,
+    "Size-based segment rotation: when the active history segment "
+    "exceeds this many bytes the writer seals it and opens a new one "
+    "(0 disables size rotation)")
+OBS_HISTORY_MAX_SEGMENT_AGE_S = conf_int(
+    "spark.rapids.tpu.obs.history.rotation.maxAgeSeconds", 0,
+    "Age-based segment rotation: a segment whose first row is older "
+    "than this many seconds relative to the row being appended is "
+    "sealed first (0 disables age rotation).  Ages compare the rows' "
+    "own submitted_ts stamps — the writer never reads a wall clock")
+OBS_HISTORY_MAX_SEGMENTS = conf_int(
+    "spark.rapids.tpu.obs.history.retention.maxSegments", 8,
+    "Retention bound on sealed history segments: after each rotation "
+    "the oldest segments beyond this count are deleted, keeping the "
+    "store's disk footprint fixed")
+OBS_HISTORY_QUEUE_DEPTH = conf_int(
+    "spark.rapids.tpu.obs.history.queueDepth", 1024,
+    "Bound on rows buffered between the terminal-state hook and the "
+    "background writer thread; a full queue drops the new row (never "
+    "blocks the query path) and increments tpu_history_dropped_total",
+    internal=True)
+OBS_HISTORY_MAX_FINGERPRINTS = conf_int(
+    "spark.rapids.tpu.obs.history.maxFingerprints", 1024,
+    "Bound on distinct plan fingerprints held in the in-memory fleet "
+    "aggregates (and per-fingerprint EWMA state in the anomaly "
+    "sentinel); past it rows still persist to JSONL but new "
+    "fingerprints are not aggregated (fixed memory — the "
+    "flight-recorder discipline)",
+    internal=True)
+OBS_ANOMALY_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.anomaly.enabled", True,
+    "Online anomaly sentinel (obs/anomaly.py): folds every history "
+    "row into per-(fingerprint, key) EWMA mean/variance state and on "
+    "sustained breach — breachRuns consecutive sigma-outliers after a "
+    "warmupMinRuns warm-up — emits an anomaly event to the event log, "
+    "the tpu_anomaly_* Prometheus families, a rate-limited diag "
+    "bundle and the doctor's trend section.  Band/direction semantics "
+    "are shared with the offline perf gate (analysis/bands.py).  "
+    "Pure host arithmetic over history rows: zero extra device "
+    "flushes by construction")
+OBS_ANOMALY_EWMA_ALPHA = conf_float(
+    "spark.rapids.tpu.obs.anomaly.ewmaAlpha", 0.15,
+    "Smoothing factor of the per-(fingerprint, key) EWMA mean/"
+    "variance: higher tracks drift faster but is noisier; 0.15 "
+    "weights roughly the last ~13 runs")
+OBS_ANOMALY_WARMUP_MIN_RUNS = conf_int(
+    "spark.rapids.tpu.obs.anomaly.warmupMinRuns", 8,
+    "Runs of a fingerprint folded before its EWMA state may flag "
+    "outliers (and before the trend baseline is frozen): fresh plans "
+    "never alarm on compile-warmup noise")
+OBS_ANOMALY_BREACH_RUNS = conf_int(
+    "spark.rapids.tpu.obs.anomaly.breachRuns", 3,
+    "Consecutive sigma-outlier runs (same fingerprint, key, "
+    "direction) required before an anomaly event fires; the same "
+    "count of consecutive in-band runs recovers it")
+OBS_ANOMALY_SIGMA = conf_float(
+    "spark.rapids.tpu.obs.anomaly.sigma", 3.0,
+    "Outlier threshold in EWMA standard deviations; a run is an "
+    "outlier only when it is ALSO outside the key's perf-gate band "
+    "(analysis/bands.py), so tight-variance fingerprints do not alarm "
+    "on noise within the documented tolerance")
+OBS_ANOMALY_BUNDLE_INTERVAL_S = conf_float(
+    "spark.rapids.tpu.obs.anomaly.bundleIntervalSeconds", 300.0,
+    "Rate limit on anomaly-triggered diagnostics bundles: at most one "
+    "bundle per this many seconds process-wide (0 disables anomaly "
+    "bundles); breach events and Prometheus counters are never "
+    "rate-limited")
+OBS_DASHBOARD_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.dashboard.enabled", True,
+    "Fleet dashboard (obs/dashboard.py): a self-contained HTML view — "
+    "top fingerprints by volume/latency/SLO burn, active anomalies, "
+    "doctor verdict mix, per-tenant table — served at /dashboard "
+    "beside the Prometheus text endpoint and renderable offline via "
+    "tools/history.py")
 SUPERSTAGE = conf_bool(
     "spark.rapids.tpu.sql.superstage", True,
     "Superstage compiler (compile/): a planner post-pass after the "

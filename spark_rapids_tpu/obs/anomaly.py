@@ -1,0 +1,339 @@
+"""Online anomaly sentinel — the perf-regression gate made live.
+
+The offline sentinel (``analysis/regression.py`` + ``ci/perf_gate.py``)
+only fires when someone hand-runs a bench round; at serving scale
+regressions arrive via live traffic between rounds.  This module folds
+every history row (``obs/history.py``) into per-(fingerprint, key)
+EWMA mean/variance state and flags *sustained* drift:
+
+- **model**: for each watched key, an exponentially weighted mean and
+  variance (``ewmaAlpha``).  The first ``warmupMinRuns`` rows of a
+  fingerprint only train the model (fresh plans never alarm on
+  compile-warmup noise); at warm-up end the mean is frozen as the
+  fingerprint's **trend baseline**.
+- **outlier**: a run is an outlier when it is BOTH beyond ``sigma``
+  EWMA standard deviations from the baseline AND classified a
+  regression by the shared band/direction core (``analysis/bands.py``
+  — the exact semantics the offline gate applies to ``BENCH_r*``
+  rounds).  Outliers do NOT update the model: a level shift stays
+  visible instead of being absorbed.
+- **breach / recovery**: ``breachRuns`` consecutive outliers open an
+  anomaly (one ``breach`` event, ``tpu_anomaly_events_total``,
+  ``tpu_anomaly_active``); the same count of consecutive in-band runs
+  closes it with a ``recovery`` event.  :func:`fold` returns the
+  event dicts — the *caller* (service/server.py) owns the side
+  effects: event-log lines, the rate-limited diag bundle.
+- **trend**: per fingerprint, drift of the recent window's p50 vs the
+  frozen baseline plus the doctor-cause mix shift ("exec_ms p50
+  drifted +42% over last 50 runs, primary cause shifted
+  host_staging→shuffle_host"), surfaced through the doctor's
+  ``stats_section()["trend"]``.
+
+Pure host arithmetic over history rows (lint scope HYG002: no wall
+clocks — rate limiting uses the monotonic clock): zero extra device
+flushes by construction.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..analysis.bands import REGRESSION, band_status
+from .registry import ANOMALY_CHECKS, ANOMALY_EVENTS
+
+#: watched history-row keys: (key, direction, band_pct, abs_floor) —
+#: direction/band semantics are the offline gate's (analysis/bands.py);
+#: floors guard near-zero baselines (an exec_ms baseline of 2ms must
+#: not alarm at 3ms)
+WATCH_KEYS: Tuple[Tuple[str, str, float, float], ...] = (
+    ("exec_ms", "lower", 25.0, 50.0),
+    ("queue_ms", "lower", 50.0, 50.0),
+    ("host_drop_tax_ms", "lower", 50.0, 5.0),
+    ("spill_ms", "lower", 50.0, 5.0),
+    ("device_util_pct", "higher", 25.0, 0.0),
+    ("flushes", "exact", 0.0, 0.0),
+)
+
+#: recent-window length the trend drift is computed over
+_TREND_WINDOW = 50
+
+_ENABLED = True
+_ALPHA = 0.15
+_MIN_N = 8
+_K = 3
+_SIGMA = 3.0
+_BUNDLE_INTERVAL_S = 300.0
+_MAX_FPS = 1024
+
+_LOCK = threading.Lock()
+_LAST_BUNDLE_MONO: Optional[float] = None
+_FP_OVERFLOW = 0
+
+
+class _KeyState:
+    """EWMA state of one (fingerprint, key) series."""
+
+    __slots__ = ("count", "mean", "var", "baseline", "streak_bad",
+                 "streak_good", "active", "last", "recent")
+
+    def __init__(self):
+        self.count = 0
+        self.mean = 0.0
+        self.var = 0.0
+        self.baseline: Optional[float] = None  # frozen at warm-up end
+        self.streak_bad = 0
+        self.streak_good = 0
+        self.active = False
+        self.last = 0.0
+        self.recent: deque = deque(maxlen=_TREND_WINDOW)
+
+
+class _FpState:
+    __slots__ = ("keys", "runs", "warmup_causes", "recent_causes")
+
+    def __init__(self):
+        self.keys: Dict[str, _KeyState] = {}
+        self.runs = 0
+        self.warmup_causes: Dict[str, int] = {}
+        self.recent_causes: deque = deque(maxlen=_TREND_WINDOW)
+
+
+_FPS: Dict[str, _FpState] = {}
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def active_count() -> int:
+    """Open (breached, unrecovered) anomalies — the
+    ``tpu_anomaly_active`` gauge."""
+    with _LOCK:
+        return sum(1 for fp in _FPS.values()
+                   for ks in fp.keys.values() if ks.active)
+
+
+def _fold_key(fp: str, key: str, direction: str, band: float,
+              floor: float, cur: float, ks: _KeyState,
+              events: List[Dict]) -> None:
+    ks.count += 1
+    ks.last = cur
+    ks.recent.append(cur)
+    ANOMALY_CHECKS.inc()
+    if ks.count <= _MIN_N:
+        # warm-up: train only
+        if ks.count == 1:
+            ks.mean, ks.var = cur, 0.0
+        else:
+            diff = cur - ks.mean
+            incr = _ALPHA * diff
+            ks.mean += incr
+            ks.var = (1.0 - _ALPHA) * (ks.var + diff * incr)
+        if ks.count == _MIN_N:
+            ks.baseline = ks.mean
+        return
+    base = ks.baseline if ks.baseline is not None else ks.mean
+    std = math.sqrt(max(ks.var, 0.0))
+    is_reg = band_status(cur, base, direction, band, floor) == REGRESSION
+    outlier = is_reg and (direction == "exact"
+                          or abs(cur - base) > _SIGMA * std)
+    if outlier:
+        ks.streak_bad += 1
+        ks.streak_good = 0
+        if not ks.active and ks.streak_bad >= _K:
+            ks.active = True
+            drift = (0.0 if base == 0
+                     else (cur - base) / abs(base) * 100.0)
+            events.append({
+                "kind": "breach", "fingerprint": fp, "key": key,
+                "direction": direction, "baseline": round(base, 3),
+                "current": round(cur, 3),
+                "drift_pct": round(drift, 1),
+                "sigma": round(abs(cur - base) / std, 1)
+                if std > 0 else None,
+                "runs": ks.count,
+            })
+            ANOMALY_EVENTS.labels(kind="breach").inc()
+        return
+    # in-band (or improved): train the model, count toward recovery
+    diff = cur - ks.mean
+    incr = _ALPHA * diff
+    ks.mean += incr
+    ks.var = (1.0 - _ALPHA) * (ks.var + diff * incr)
+    ks.streak_bad = 0
+    if ks.active:
+        ks.streak_good += 1
+        if ks.streak_good >= _K:
+            ks.active = False
+            ks.streak_good = 0
+            events.append({
+                "kind": "recovery", "fingerprint": fp, "key": key,
+                "direction": direction,
+                "baseline": round(base, 3),
+                "current": round(cur, 3), "runs": ks.count,
+            })
+            ANOMALY_EVENTS.labels(kind="recovery").inc()
+
+
+def fold(row: Dict) -> List[Dict]:
+    """Fold one history row into the sentinel.  Returns the anomaly
+    lifecycle events this row caused (usually none); the caller owns
+    event-log/bundle side effects."""
+    global _FP_OVERFLOW
+    if not _ENABLED or not isinstance(row, dict):
+        return []
+    fp = str(row.get("fingerprint") or "unknown")
+    events: List[Dict] = []
+    with _LOCK:
+        st = _FPS.get(fp)
+        if st is None:
+            if len(_FPS) >= _MAX_FPS:
+                _FP_OVERFLOW += 1
+                return []
+            st = _FPS[fp] = _FpState()
+        st.runs += 1
+        cause = row.get("doctor_cause")
+        if cause:
+            if st.runs <= _MIN_N:
+                st.warmup_causes[cause] = \
+                    st.warmup_causes.get(cause, 0) + 1
+            st.recent_causes.append(cause)
+        for key, direction, band, floor in WATCH_KEYS:
+            val = row.get(key)
+            if val is None or not isinstance(val, (int, float)):
+                continue
+            ks = st.keys.get(key)
+            if ks is None:
+                ks = st.keys[key] = _KeyState()
+            _fold_key(fp, key, direction, band, floor, float(val),
+                      ks, events)
+    return events
+
+
+def should_bundle() -> bool:
+    """Rate limit for anomaly-triggered diag bundles: at most one per
+    ``bundleIntervalSeconds`` process-wide (monotonic clock)."""
+    global _LAST_BUNDLE_MONO
+    if _BUNDLE_INTERVAL_S <= 0:
+        return False
+    now = time.monotonic()
+    with _LOCK:
+        if (_LAST_BUNDLE_MONO is not None
+                and now - _LAST_BUNDLE_MONO < _BUNDLE_INTERVAL_S):
+            return False
+        _LAST_BUNDLE_MONO = now
+        return True
+
+
+# ---------------------------------------------------------------------------
+# read-side views
+# ---------------------------------------------------------------------------
+
+def _mode(counts: Dict[str, int]) -> Optional[str]:
+    return max(counts, key=counts.get) if counts else None
+
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def trend_section() -> Dict[str, Dict]:
+    """Per-fingerprint trend summary (the doctor's ``trend`` section
+    and the dashboard's drift column): recent-window p50 drift vs the
+    frozen warm-up baseline per watched key, active anomalies, and
+    the doctor-cause shift."""
+    with _LOCK:
+        snap = {fp: ({k: (ks.baseline, sorted(ks.recent), ks.active,
+                          ks.last)
+                      for k, ks in st.keys.items()},
+                     st.runs, dict(st.warmup_causes),
+                     list(st.recent_causes))
+                for fp, st in _FPS.items()}
+    out: Dict[str, Dict] = {}
+    for fp, (keys, runs, warm_causes, recent_causes) in snap.items():
+        drifts: Dict[str, Dict] = {}
+        active: List[str] = []
+        for k, (baseline, recent, is_active, last) in keys.items():
+            if is_active:
+                active.append(k)
+            if baseline is None or baseline == 0 or not recent:
+                continue
+            p50 = _pctl(recent, 0.5)
+            drifts[k] = {
+                "baseline": round(baseline, 3),
+                "recent_p50": round(p50, 3),
+                "drift_pct": round(
+                    (p50 - baseline) / abs(baseline) * 100.0, 1),
+                "last": round(last, 3),
+            }
+        cause_from = _mode(warm_causes)
+        recent_counts: Dict[str, int] = {}
+        for c in recent_causes:
+            recent_counts[c] = recent_counts.get(c, 0) + 1
+        cause_to = _mode(recent_counts)
+        entry: Dict = {"runs": runs, "active": sorted(active),
+                       "drift": drifts}
+        if cause_from and cause_to and cause_from != cause_to:
+            entry["cause_shift"] = {"from": cause_from, "to": cause_to}
+        out[fp] = entry
+    return out
+
+
+def stats_section() -> Dict:
+    """The ``anomaly`` section of ``Service.stats().snapshot()``."""
+    with _LOCK:
+        fps = len(_FPS)
+        overflow = _FP_OVERFLOW
+        checks = sum(ks.count for st in _FPS.values()
+                     for ks in st.keys.values())
+    return {
+        "enabled": _ENABLED,
+        "fingerprints": fps,
+        "fingerprint_overflow": overflow,
+        "checks": checks,
+        "active": active_count(),
+        "min_runs": _MIN_N,
+        "breach_runs": _K,
+        "sigma": _SIGMA,
+    }
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.anomaly.*`` conf group (called
+    by QueryService.__init__; last-configured service wins — the plane
+    is process-wide like the rest of the registry)."""
+    global _ENABLED, _ALPHA, _MIN_N, _K, _SIGMA
+    global _BUNDLE_INTERVAL_S, _MAX_FPS
+    from ..config import (OBS_ANOMALY_BREACH_RUNS,
+                          OBS_ANOMALY_BUNDLE_INTERVAL_S,
+                          OBS_ANOMALY_ENABLED, OBS_ANOMALY_EWMA_ALPHA,
+                          OBS_ANOMALY_SIGMA,
+                          OBS_ANOMALY_WARMUP_MIN_RUNS,
+                          OBS_HISTORY_MAX_FINGERPRINTS)
+    _ENABLED = bool(conf.get(OBS_ANOMALY_ENABLED))
+    _ALPHA = min(max(float(conf.get(OBS_ANOMALY_EWMA_ALPHA)), 0.01), 1.0)
+    _MIN_N = max(2, int(conf.get(OBS_ANOMALY_WARMUP_MIN_RUNS)))
+    _K = max(1, int(conf.get(OBS_ANOMALY_BREACH_RUNS)))
+    _SIGMA = max(0.5, float(conf.get(OBS_ANOMALY_SIGMA)))
+    _BUNDLE_INTERVAL_S = float(conf.get(OBS_ANOMALY_BUNDLE_INTERVAL_S))
+    _MAX_FPS = max(1, int(conf.get(OBS_HISTORY_MAX_FINGERPRINTS)))
+
+
+def reset() -> None:
+    """Test hook: drop all sentinel state."""
+    global _FP_OVERFLOW, _LAST_BUNDLE_MONO
+    with _LOCK:
+        _FPS.clear()
+        _FP_OVERFLOW = 0
+        _LAST_BUNDLE_MONO = None

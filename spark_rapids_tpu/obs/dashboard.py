@@ -1,0 +1,200 @@
+"""Fleet dashboard — one self-contained HTML view of the longitudinal
+layer, served at ``/dashboard`` beside the Prometheus text endpoint
+(obs/prom.py) and renderable offline by ``tools/history.py``.
+
+Reads only what the planes already aggregated — the history store's
+per-fingerprint fleet view (obs/history.py), the anomaly sentinel's
+active set and trend drifts (obs/anomaly.py), the doctor's verdict
+mix (obs/doctor.py) and the per-tenant SLO table (obs/slo.py) — and
+renders static HTML with zero external assets and zero scripts: the
+page is safe to serve from the scrape port and to archive into a diag
+bundle.  Every dynamic string is escaped; a failing section renders
+as a note instead of breaking the page (the dashboard must never be
+the component that goes down during an incident).
+
+Pure host string formatting over in-memory snapshots: zero extra
+device flushes by construction.
+"""
+from __future__ import annotations
+
+import html
+from typing import Dict, List
+
+_STYLE = """
+body{font-family:system-ui,sans-serif;margin:1.5em;background:#fafafa;
+     color:#222}
+h1{font-size:1.4em} h2{font-size:1.1em;margin-top:1.4em}
+table{border-collapse:collapse;margin:.5em 0;background:#fff}
+th,td{border:1px solid #ccc;padding:.25em .6em;font-size:.85em;
+      text-align:left}
+th{background:#eee}
+.bad{color:#b00020;font-weight:bold} .ok{color:#1b5e20}
+.mono{font-family:ui-monospace,monospace}
+.note{color:#666;font-size:.85em}
+"""
+
+
+def _esc(v) -> str:
+    return html.escape(str(v))
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> List[str]:
+    out = ["<table><tr>"]
+    out += [f"<th>{_esc(h)}</th>" for h in headers]
+    out.append("</tr>")
+    for row in rows:
+        out.append("<tr>" + "".join(f"<td>{c}</td>" for c in row)
+                   + "</tr>")
+    out.append("</table>")
+    return out
+
+
+def _mix(counts: Dict[str, int]) -> str:
+    return _esc(" ".join(f"{k}:{v}" for k, v in sorted(counts.items()))
+                or "-")
+
+
+def _fingerprint_rows(aggs: Dict, trend: Dict) -> List[List[str]]:
+    rows = []
+    order = sorted(aggs, key=lambda fp: -aggs[fp]["count"])[:20]
+    for fp in order:
+        a = aggs[fp]
+        t = trend.get(fp, {})
+        active = t.get("active") or []
+        drifts = t.get("drift") or {}
+        worst = ""
+        if drifts:
+            key = max(drifts, key=lambda k: abs(drifts[k]["drift_pct"]))
+            worst = f"{_esc(key)} {drifts[key]['drift_pct']:+.1f}%"
+        shift = t.get("cause_shift")
+        cause = _mix(a.get("doctor_causes") or {})
+        if shift:
+            cause += (f" <span class=bad>({_esc(shift['from'])}"
+                      f"&rarr;{_esc(shift['to'])})</span>")
+        rows.append([
+            f"<span class=mono>{_esc(fp)}</span>",
+            _esc(a["count"]),
+            _mix(a.get("outcomes") or {}),
+            _esc(a["exec_p50_ms"]),
+            _esc(a["exec_p95_ms"]),
+            worst or "-",
+            (f"<span class=bad>{_esc(', '.join(active))}</span>"
+             if active else "<span class=ok>none</span>"),
+            cause,
+            _mix(a.get("tenants") or {}),
+        ])
+    return rows
+
+
+def _anomaly_rows(trend: Dict) -> List[List[str]]:
+    rows = []
+    for fp in sorted(trend):
+        t = trend[fp]
+        for key in t.get("active") or []:
+            d = (t.get("drift") or {}).get(key, {})
+            rows.append([
+                f"<span class=mono>{_esc(fp)}</span>",
+                f"<span class=bad>{_esc(key)}</span>",
+                _esc(d.get("baseline", "-")),
+                _esc(d.get("recent_p50", "-")),
+                (f"{d['drift_pct']:+.1f}%"
+                 if "drift_pct" in d else "-"),
+            ])
+    return rows
+
+
+def _tenant_rows(slo: Dict) -> List[List[str]]:
+    rows = []
+    for name, t in sorted((slo.get("tenants") or {}).items()):
+        rows.append([
+            _esc(name), _esc(t.get("count", 0)),
+            _esc(t.get("p50_ms", 0)), _esc(t.get("p99_ms", 0)),
+            (f"<span class=bad>{_esc(t['breaches'])}</span>"
+             if t.get("breaches") else "0"),
+            _esc(t.get("burn_ms", 0)),
+            _mix(t.get("breach_causes") or {}),
+        ])
+    return rows
+
+
+def render_html() -> str:
+    """The whole dashboard page from the live plane snapshots."""
+    from . import anomaly as _anomaly
+    from . import history as _history
+    parts: List[str] = [
+        "<!doctype html><html><head><meta charset='utf-8'>",
+        "<title>TPU fleet dashboard</title>",
+        f"<style>{_STYLE}</style></head><body>",
+        "<h1>TPU fleet dashboard</h1>",
+    ]
+    try:
+        hstats = _history.stats_section()
+        astats = _anomaly.stats_section()
+        parts.append(
+            "<p class=note>history rows: "
+            f"{_esc(hstats['rows'])} (dropped {_esc(hstats['dropped'])},"
+            f" segments {_esc(hstats['segments'])}) &middot; "
+            f"fingerprints: {_esc(hstats['fingerprints'])} &middot; "
+            f"anomaly checks: {_esc(astats['checks'])} &middot; "
+            "active anomalies: "
+            + (f"<span class=bad>{_esc(astats['active'])}</span>"
+               if astats["active"] else "<span class=ok>0</span>")
+            + "</p>")
+    except Exception as e:
+        parts.append(f"<p class=note>summary unavailable: {_esc(e)}</p>")
+
+    try:
+        aggs = _history.fleet_aggregates()
+        trend = _anomaly.trend_section()
+    except Exception as e:
+        aggs, trend = {}, {}
+        parts.append(f"<p class=note>fleet view unavailable: "
+                     f"{_esc(e)}</p>")
+
+    parts.append("<h2>Top fingerprints</h2>")
+    fp_rows = _fingerprint_rows(aggs, trend)
+    if fp_rows:
+        parts += _table(["fingerprint", "runs", "outcomes",
+                         "exec p50 ms", "exec p95 ms", "worst drift",
+                         "active anomalies", "doctor causes",
+                         "tenants"], fp_rows)
+    else:
+        parts.append("<p class=note>no history rows yet</p>")
+
+    parts.append("<h2>Active anomalies</h2>")
+    an_rows = _anomaly_rows(trend)
+    if an_rows:
+        parts += _table(["fingerprint", "key", "baseline",
+                         "recent p50", "drift"], an_rows)
+    else:
+        parts.append("<p class=note ><span class=ok>none</span></p>")
+
+    parts.append("<h2>Doctor verdict mix</h2>")
+    try:
+        from . import doctor as _doctor
+        verdicts = (_doctor.stats_section() or {}).get("verdicts") or {}
+    except Exception:
+        verdicts = {}
+    if verdicts:
+        parts += _table(["primary cause", "queries"],
+                        [[_esc(k), _esc(v)]
+                         for k, v in sorted(verdicts.items(),
+                                            key=lambda kv: -kv[1])])
+    else:
+        parts.append("<p class=note>no diagnosed queries yet</p>")
+
+    parts.append("<h2>Tenants (SLO)</h2>")
+    try:
+        from . import slo as _slo
+        slo = _slo.stats_section()
+    except Exception:
+        slo = {}
+    tn_rows = _tenant_rows(slo)
+    if tn_rows:
+        parts += _table(["tenant", "queries", "p50 ms", "p99 ms",
+                         "breaches", "burn ms", "causes"], tn_rows)
+    else:
+        parts.append("<p class=note>no tenant traffic yet</p>")
+
+    parts.append("</body></html>")
+    return "".join(parts)

@@ -437,6 +437,16 @@ def stats_section() -> Dict:
                "verdicts": dict(_VERDICT_COUNTS)}
         if _LAST is not None:
             out["last"] = dict(_LAST)
+    try:
+        # longitudinal view: the anomaly sentinel's per-fingerprint
+        # drift ledger rides along so one doctor read shows both the
+        # per-query verdict mix and the fleet trend behind it
+        from . import anomaly as _anomaly
+        trend = _anomaly.trend_section()
+        if trend:
+            out["trend"] = trend
+    except Exception:  # noqa: BLE001 — trend is advisory
+        pass
     return out
 
 

@@ -1,0 +1,115 @@
+"""Stable plan fingerprint — the longitudinal grouping key of the
+fleet observability layer (obs/history.py, obs/anomaly.py).
+
+Two queries get the SAME fingerprint exactly when they would run the
+same device programs over the same column shapes:
+
+- **plan shape**: a preorder walk of the physical tree recording each
+  operator's class, child count, output dtype signature and — for
+  shuffle exchanges — partitioner arity.  ``TpuSuperstage`` wrappers
+  are unwrapped transparently (``children[0]`` is the intact region
+  root), so carving the same plan into superstages does not move its
+  fingerprint; the region structure itself is still captured
+  conf-independently by each node's ``compile.lower`` membership
+  classification (members fuse, boundaries delimit).
+- **conf fingerprint**: the ``compile/aot.py`` discipline — a hash of
+  every program-affecting conf — with the execution-mode groups that
+  are documented bit-identical additionally excluded
+  (``exec.pipeline*``, ``sql.superstage*``) plus logging/diagnostics
+  paths (``eventLog.*``, ``profile.*``): pipelineParallelism {1,4} x
+  superstage on/off land on one digest.
+
+Literal values (filter constants, projected literals) never enter the
+walk — ``WHERE x > 5`` and ``WHERE x > 7`` group together — while any
+shape change (an extra join, a different aggregate arity, a changed
+dtype) moves the digest.  Tenant, session and query_id are likewise
+absent: the same plan from two sessions or tenants groups into one
+longitudinal series.
+
+Pure host arithmetic over the already-built physical tree: zero extra
+device flushes by construction.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import List
+
+#: conf prefixes excluded from the fingerprint on top of the aot skip
+#: list: execution-mode groups proven bit-identical (the stability
+#: matrix pipelineParallelism {1,4} x superstage on/off) and pure
+#: logging/diagnostics sinks
+_SKIP_PREFIXES = (
+    "spark.rapids.tpu.obs.",
+    "spark.rapids.tpu.service.",
+    "spark.rapids.tpu.compile.aot.",
+    "spark.rapids.tpu.test.",
+    "spark.rapids.tpu.exec.pipeline",
+    "spark.rapids.tpu.sql.superstage",
+    "spark.rapids.tpu.eventLog.",
+    "spark.rapids.tpu.profile.",
+)
+
+
+def conf_fingerprint(conf) -> str:
+    """Hash of every plan-affecting conf (the aot discipline minus the
+    bit-identical execution-mode groups)."""
+    from ..config import all_entries
+    h = hashlib.sha256()
+    for e in all_entries():
+        if any(e.key.startswith(p) for p in _SKIP_PREFIXES):
+            continue
+        h.update(f"{e.key}={conf.get(e)}\n".encode())
+    return h.hexdigest()[:16]
+
+
+def _schema_sig(node) -> str:
+    try:
+        schema = node.output_schema
+        return ",".join(f"{f.dtype.name}{'?' if f.nullable else ''}"
+                        for f in schema.fields)
+    except Exception:
+        return "?"
+
+
+def _walk(node, depth: int, out: List[str]) -> None:
+    from ..exec.exchange import TpuShuffleExchange
+    from ..exec.superstage import TpuSuperstage
+    if isinstance(node, TpuSuperstage):
+        # the wrapper's first child is the intact region root: carving
+        # must not move the fingerprint
+        _walk(node.children[0], depth, out)
+        return
+    from ..compile import lower as _lower
+    try:
+        member = "m" if _lower.is_member(node) else "b"
+    except Exception:
+        member = "?"
+    arity = ""
+    if isinstance(node, TpuShuffleExchange):
+        try:
+            arity = f"x{int(node.partitioner.num_partitions)}"
+        except Exception:
+            arity = "x?"
+    out.append(f"{depth}:{type(node).__name__}{arity}"
+               f"/{len(node.children)}{member}[{_schema_sig(node)}]")
+    for child in node.children:
+        _walk(child, depth + 1, out)
+
+
+def plan_shape(phys) -> str:
+    """The canonical shape text hashed into the fingerprint (one line
+    per operator, preorder) — surfaced for tests and the CLI's
+    ``--explain`` view."""
+    lines: List[str] = []
+    _walk(phys, 0, lines)
+    return "\n".join(lines)
+
+
+def plan_fingerprint(phys, conf) -> str:
+    """16-hex digest over (plan shape, conf fingerprint) — the
+    longitudinal grouping key."""
+    h = hashlib.sha256()
+    h.update(plan_shape(phys).encode())
+    h.update(b"\n--conf--\n")
+    h.update(conf_fingerprint(conf).encode())
+    return h.hexdigest()[:16]

@@ -1,0 +1,439 @@
+"""Persistent query-history store — the longitudinal substrate of the
+fleet observability layer (the Spark History Server role for a serving
+process).
+
+Every terminal query (completed, failed, cancelled, shed) folds into
+ONE compact row joining what the service measured (QueryMetrics:
+latency phases, retries, outcome) with what the engine planes already
+collected for that query_id (plan fingerprint, predicted/observed
+flushes, device_util_pct + gap breakdown, host-drop tax, spill,
+roofline verdict, doctor verdict).  Rows flow three ways:
+
+- **persistence**: appended as JSONL to ``history-NNNNNN.jsonl``
+  segments under ``spark.rapids.tpu.obs.history.dir`` by a background
+  writer thread behind a bounded queue — a full queue DROPS the row
+  (counted in ``tpu_history_dropped_total``) rather than ever
+  blocking or failing the query path.  Segments rotate by size and by
+  row-timestamp age and are retained up to ``retention.maxSegments``.
+  An empty dir (the default) keeps the store in-memory only.
+- **fleet aggregates**: bounded per-fingerprint accounting (count,
+  outcome mix, latency reservoir, tenants, doctor causes) feeding
+  ``Service.stats()``, the dashboard and the doctor trend section.
+- **the sentinel**: ``record()`` returns the row so the caller can
+  hand it to ``obs/anomaly.py`` — the history store itself never
+  emits events.
+
+The engine side deposits its artifacts through :func:`note_query`
+*before* the service's terminal transition calls :func:`record` (the
+session executes strictly before the worker marks the query terminal,
+and both key by the same ``query_id``), so the join needs no
+session-global state and is safe under concurrent workers.
+
+Wall-clock discipline (lint scope HYG002): this module never calls
+``time.time()`` — row timestamps are the ``submitted_ts`` the server
+already stamped, age rotation compares row timestamps to each other,
+and write durations use the monotonic ``perf_counter_ns``.  Zero
+extra device flushes by construction: pure host dict/file work.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import queue as _queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .registry import HISTORY_DROPPED, HISTORY_ROWS, HISTORY_WRITE_SECONDS
+
+#: cap on deposited engine artifacts awaiting their terminal join
+#: (orphans from crashed attempts age out oldest-first)
+_ARTIFACT_CAP = 4096
+#: per-fingerprint latency reservoir length (nearest-rank percentiles)
+_RESERVOIR = 256
+#: recent rows kept for the dashboard's in-memory view
+_RECENT_CAP = 512
+
+_ENABLED = True
+_DIR = ""
+_MAX_SEG_BYTES = 4 * 1024 * 1024
+_MAX_SEG_AGE_S = 0
+_MAX_SEGMENTS = 8
+_QUEUE_DEPTH = 1024
+_MAX_FPS = 1024
+
+_LOCK = threading.Lock()
+_ARTIFACTS: Dict[str, Dict] = {}
+_RECENT: deque = deque(maxlen=_RECENT_CAP)
+_WRITE_NS: deque = deque(maxlen=4096)
+_ROWS = 0
+_DROPPED = 0
+_FP_OVERFLOW = 0
+
+_Q: Optional[_queue.Queue] = None
+_WRITER: Optional[threading.Thread] = None
+
+# active-segment state, owned by the writer thread
+_SEG_PATH: Optional[str] = None
+_SEG_BYTES = 0
+_SEG_FIRST_TS: Optional[float] = None
+
+
+class _FpAgg:
+    """One fingerprint's bounded fleet aggregate."""
+
+    __slots__ = ("count", "outcomes", "exec_ms", "total_ms", "tenants",
+                 "causes", "burn_ms", "last_ts")
+
+    def __init__(self):
+        self.count = 0
+        self.outcomes: Dict[str, int] = {}
+        self.exec_ms: deque = deque(maxlen=_RESERVOIR)
+        self.total_ms: deque = deque(maxlen=_RESERVOIR)
+        self.tenants: Dict[str, int] = {}
+        self.causes: Dict[str, int] = {}
+        self.burn_ms = 0.0
+        self.last_ts = 0.0
+
+
+_AGGS: Dict[str, _FpAgg] = {}
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+# ---------------------------------------------------------------------------
+# engine-side artifact deposit
+# ---------------------------------------------------------------------------
+
+def note_query(query_id: str, artifacts: Dict) -> None:
+    """Deposit the engine planes' per-query artifacts (fingerprint,
+    flushes, util, roofline, doctor verdict) for the terminal join.
+    Called by the session right after query execution; bounded, never
+    raises into the query path."""
+    if not _ENABLED or not query_id:
+        return
+    with _LOCK:
+        _ARTIFACTS[str(query_id)] = dict(artifacts)
+        while len(_ARTIFACTS) > _ARTIFACT_CAP:
+            _ARTIFACTS.pop(next(iter(_ARTIFACTS)))
+
+
+# ---------------------------------------------------------------------------
+# terminal-state fold
+# ---------------------------------------------------------------------------
+
+def _build_row(m, art: Dict) -> Dict:
+    err = getattr(m, "error", None)
+    row = {
+        "ts": round(float(getattr(m, "submitted_ts", 0.0) or 0.0), 6),
+        "query_id": m.query_id,
+        "fingerprint": str(art.get("fingerprint") or "unknown"),
+        "tenant": str(getattr(m, "tenant", None) or "default"),
+        "outcome": m.outcome,
+        "error": (str(err)[:160] if err else None),
+        "retries": int(getattr(m, "retries", 0) or 0),
+        "queue_ms": round(float(m.queue_wait_ms or 0.0), 3),
+        "sem_ms": round(float(getattr(m, "sem_wait_ms", 0.0) or 0.0), 3),
+        "exec_ms": round(float(m.execute_ms or 0.0), 3),
+        "inline_compile_ms": round(
+            float(getattr(m, "inline_compile_ms", 0.0) or 0.0), 3),
+        "host_drop_tax_ms": round(
+            float(getattr(m, "host_drop_tax_ms", 0.0) or 0.0), 3),
+        "spill_bytes": int(getattr(m, "spill_bytes", 0) or 0),
+        "spill_ms": round(float(getattr(m, "spill_ms", 0.0) or 0.0), 3),
+    }
+    for key in ("flushes", "flushes_predicted", "device_util_pct",
+                "gaps", "roofline_verdict", "achieved_GBps",
+                "padding_waste_pct", "doctor_cause",
+                "doctor_share_pct"):
+        if key in art:
+            row[key] = art[key]
+    return row
+
+
+def record(m) -> Optional[Dict]:
+    """Fold one finished query's QueryMetrics (+ deposited engine
+    artifacts) into the store.  Called by the service at every
+    terminal transition — exactly once per query.  Returns the
+    history row so the caller can feed the anomaly sentinel, or
+    ``None`` when the plane is off."""
+    global _ROWS, _DROPPED, _FP_OVERFLOW
+    if not _ENABLED:
+        return None
+    with _LOCK:
+        art = _ARTIFACTS.pop(str(m.query_id), None) or {}
+    row = _build_row(m, art)
+    HISTORY_ROWS.labels(outcome=row["outcome"]).inc()
+    total = row["queue_ms"] + row["exec_ms"]
+    with _LOCK:
+        _ROWS += 1
+        _RECENT.append(row)
+        fp = row["fingerprint"]
+        agg = _AGGS.get(fp)
+        if agg is None:
+            if len(_AGGS) >= _MAX_FPS:
+                _FP_OVERFLOW += 1
+                agg = None
+            else:
+                agg = _AGGS[fp] = _FpAgg()
+        if agg is not None:
+            agg.count += 1
+            agg.outcomes[row["outcome"]] = \
+                agg.outcomes.get(row["outcome"], 0) + 1
+            agg.exec_ms.append(row["exec_ms"])
+            agg.total_ms.append(total)
+            t = row["tenant"]
+            agg.tenants[t] = agg.tenants.get(t, 0) + 1
+            cause = row.get("doctor_cause")
+            if cause:
+                agg.causes[cause] = agg.causes.get(cause, 0) + 1
+            agg.last_ts = max(agg.last_ts, row["ts"])
+        q = _Q
+    if q is not None:
+        try:
+            q.put_nowait(row)
+        except _queue.Full:
+            HISTORY_DROPPED.inc()
+            with _LOCK:
+                _DROPPED += 1
+    return row
+
+
+# ---------------------------------------------------------------------------
+# background writer (persistence)
+# ---------------------------------------------------------------------------
+
+def _segments(d: str) -> List[str]:
+    return sorted(glob.glob(os.path.join(d, "history-*.jsonl")))
+
+
+def _next_segment_path(d: str) -> str:
+    seq = 0
+    for p in _segments(d):
+        name = os.path.basename(p)
+        try:
+            seq = max(seq, int(name[len("history-"):-len(".jsonl")]))
+        except ValueError:
+            continue
+    return os.path.join(d, f"history-{seq + 1:06d}.jsonl")
+
+
+def _adopt_segment(d: str) -> None:
+    """Resume appending to the newest existing segment (append-only
+    across process restarts)."""
+    global _SEG_PATH, _SEG_BYTES, _SEG_FIRST_TS
+    segs = _segments(d)
+    if not segs:
+        _SEG_PATH, _SEG_BYTES, _SEG_FIRST_TS = None, 0, None
+        return
+    _SEG_PATH = segs[-1]
+    try:
+        _SEG_BYTES = os.path.getsize(_SEG_PATH)
+        with open(_SEG_PATH, "r", encoding="utf-8") as f:
+            first = f.readline().strip()
+        _SEG_FIRST_TS = (float(json.loads(first).get("ts") or 0.0)
+                         if first else None)
+    except (OSError, ValueError):
+        _SEG_BYTES, _SEG_FIRST_TS = 0, None
+
+
+def _roll_segment(d: str) -> None:
+    global _SEG_PATH, _SEG_BYTES, _SEG_FIRST_TS
+    _SEG_PATH = _next_segment_path(d)
+    _SEG_BYTES = 0
+    _SEG_FIRST_TS = None
+    if _MAX_SEGMENTS > 0:
+        segs = _segments(d)
+        while len(segs) >= _MAX_SEGMENTS:
+            victim = segs.pop(0)
+            try:
+                os.remove(victim)
+            except OSError:
+                break
+
+
+def _append_row(d: str, row: Dict) -> None:
+    global _SEG_BYTES, _SEG_FIRST_TS
+    data = (json.dumps(row, separators=(",", ":"), sort_keys=True)
+            + "\n").encode()
+    ts = float(row.get("ts") or 0.0)
+    need_new = _SEG_PATH is None
+    if (not need_new and _MAX_SEG_BYTES > 0 and _SEG_BYTES > 0
+            and _SEG_BYTES + len(data) > _MAX_SEG_BYTES):
+        need_new = True
+    if (not need_new and _MAX_SEG_AGE_S > 0
+            and _SEG_FIRST_TS is not None
+            and ts - _SEG_FIRST_TS > _MAX_SEG_AGE_S):
+        need_new = True
+    if need_new:
+        _roll_segment(d)
+    with open(_SEG_PATH, "ab") as f:
+        f.write(data)
+    _SEG_BYTES += len(data)
+    if _SEG_FIRST_TS is None:
+        _SEG_FIRST_TS = ts
+
+
+def _writer_loop(q: _queue.Queue, d: str) -> None:
+    while True:
+        row = q.get()
+        if row is None:
+            return
+        t0 = time.perf_counter_ns()
+        try:
+            _append_row(d, row)
+        except Exception:
+            pass  # persistence failure never propagates anywhere hot
+        dt = time.perf_counter_ns() - t0
+        HISTORY_WRITE_SECONDS.observe(dt / 1e9)
+        with _LOCK:
+            _WRITE_NS.append(dt)
+
+
+def stop() -> None:
+    """Drain and join the writer thread (called on Service shutdown;
+    idempotent)."""
+    global _Q, _WRITER
+    q, w = _Q, _WRITER
+    _Q, _WRITER = None, None
+    if q is not None:
+        try:
+            q.put_nowait(None)
+        except _queue.Full:
+            # make room for the sentinel: the victim row is lost but
+            # accounted, and shutdown never hangs
+            HISTORY_DROPPED.inc()
+            try:
+                q.get_nowait()
+            except _queue.Empty:
+                pass
+            q.put(None)
+    if w is not None and w.is_alive():
+        w.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# read-side views
+# ---------------------------------------------------------------------------
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def write_p99_us() -> float:
+    """p99 of background row-append durations in microseconds (the
+    bench's ``history_write_p99_us`` key)."""
+    with _LOCK:
+        ns = sorted(_WRITE_NS)
+    return round(_pctl(ns, 0.99) / 1e3, 3)
+
+
+def fleet_aggregates() -> Dict[str, Dict]:
+    """Per-fingerprint fleet view (dashboard + doctor trend): count,
+    outcome mix, latency percentiles, tenants, doctor-cause mix."""
+    with _LOCK:
+        snap = {fp: (a.count, dict(a.outcomes), list(a.exec_ms),
+                     list(a.total_ms), dict(a.tenants), dict(a.causes),
+                     a.last_ts)
+                for fp, a in _AGGS.items()}
+    out: Dict[str, Dict] = {}
+    for fp, (count, outcomes, execs, totals, tenants, causes,
+             last_ts) in snap.items():
+        execs.sort()
+        totals.sort()
+        out[fp] = {
+            "count": count,
+            "outcomes": outcomes,
+            "exec_p50_ms": round(_pctl(execs, 0.5), 3),
+            "exec_p95_ms": round(_pctl(execs, 0.95), 3),
+            "total_p50_ms": round(_pctl(totals, 0.5), 3),
+            "total_p95_ms": round(_pctl(totals, 0.95), 3),
+            "tenants": tenants,
+            "doctor_causes": causes,
+            "last_ts": last_ts,
+        }
+    return out
+
+
+def recent_rows(n: int = 50) -> List[Dict]:
+    with _LOCK:
+        rows = list(_RECENT)
+    return rows[-n:]
+
+
+def segment_paths() -> List[str]:
+    return _segments(_DIR) if _DIR else []
+
+
+def stats_section() -> Dict:
+    """The ``history`` section of ``Service.stats().snapshot()``."""
+    with _LOCK:
+        rows, dropped, overflow = _ROWS, _DROPPED, _FP_OVERFLOW
+        fps = len(_AGGS)
+        depth = _Q.qsize() if _Q is not None else 0
+    return {
+        "enabled": _ENABLED,
+        "dir": _DIR,
+        "rows": rows,
+        "dropped": dropped,
+        "queue_depth": depth,
+        "fingerprints": fps,
+        "fingerprint_overflow": overflow,
+        "segments": len(segment_paths()),
+        "write_p99_us": write_p99_us(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.history.*`` conf group (called
+    by QueryService.__init__; last-configured service wins — the plane
+    is process-wide like the rest of the registry).  Restarts the
+    background writer against the configured directory."""
+    global _ENABLED, _DIR, _MAX_SEG_BYTES, _MAX_SEG_AGE_S
+    global _MAX_SEGMENTS, _QUEUE_DEPTH, _MAX_FPS, _Q, _WRITER
+    from ..config import (OBS_HISTORY_DIR, OBS_HISTORY_ENABLED,
+                          OBS_HISTORY_MAX_FINGERPRINTS,
+                          OBS_HISTORY_MAX_SEGMENT_AGE_S,
+                          OBS_HISTORY_MAX_SEGMENT_BYTES,
+                          OBS_HISTORY_MAX_SEGMENTS,
+                          OBS_HISTORY_QUEUE_DEPTH)
+    stop()
+    _ENABLED = bool(conf.get(OBS_HISTORY_ENABLED))
+    _DIR = str(conf.get(OBS_HISTORY_DIR) or "").strip()
+    _MAX_SEG_BYTES = int(conf.get(OBS_HISTORY_MAX_SEGMENT_BYTES))
+    _MAX_SEG_AGE_S = int(conf.get(OBS_HISTORY_MAX_SEGMENT_AGE_S))
+    _MAX_SEGMENTS = int(conf.get(OBS_HISTORY_MAX_SEGMENTS))
+    _QUEUE_DEPTH = max(1, int(conf.get(OBS_HISTORY_QUEUE_DEPTH)))
+    _MAX_FPS = max(1, int(conf.get(OBS_HISTORY_MAX_FINGERPRINTS)))
+    if not (_ENABLED and _DIR):
+        return
+    os.makedirs(_DIR, exist_ok=True)
+    _adopt_segment(_DIR)
+    _Q = _queue.Queue(maxsize=_QUEUE_DEPTH)
+    _WRITER = threading.Thread(target=_writer_loop, args=(_Q, _DIR),
+                               name="tpu-history-writer", daemon=True)
+    _WRITER.start()
+
+
+def reset() -> None:
+    """Test hook: drop all in-memory accounting (the on-disk segments
+    and the configured writer survive)."""
+    global _ROWS, _DROPPED, _FP_OVERFLOW
+    with _LOCK:
+        _ARTIFACTS.clear()
+        _AGGS.clear()
+        _RECENT.clear()
+        _WRITE_NS.clear()
+        _ROWS = _DROPPED = _FP_OVERFLOW = 0

@@ -68,24 +68,49 @@ def render_text(registry: Optional[MetricsRegistry] = None) -> str:
     return "\n".join(lines) + "\n"
 
 
-def serve_scrapes(port: int = 0, host: str = "127.0.0.1",
-                  registry: Optional[MetricsRegistry] = None):
-    """Start a daemon-thread HTTP scrape endpoint serving ``/metrics``.
+class ScrapeServerBusyError(OSError):
+    """The requested scrape port is already bound by another process
+    (raised instead of a bare EADDRINUSE traceback so operators see
+    which conf to change)."""
 
-    Returns (server, bound_port); ``server.shutdown()`` stops it.
-    ``port=0`` binds an ephemeral port (tests/CI)."""
+
+def serve_scrapes(port: int = 0, host: str = "127.0.0.1",
+                  registry: Optional[MetricsRegistry] = None,
+                  dashboard: bool = True):
+    """Start a daemon-thread HTTP endpoint serving ``/metrics`` (and,
+    when the dashboard plane is up, ``/dashboard``).
+
+    Returns (server, bound_port).  The server binds with
+    ``SO_REUSEADDR`` so a restart can reclaim a port still in
+    TIME_WAIT, and grows an explicit :meth:`stop` that shuts the
+    accept loop down AND joins the serving thread — two back-to-back
+    servers on one port work (``QueryService.shutdown()`` calls it).
+    A port actively bound by another listener raises
+    :class:`ScrapeServerBusyError` with the offending (host, port)
+    instead of a raw traceback.  ``port=0`` binds an ephemeral port
+    (tests/CI)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
     reg = registry or get_registry()
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path.split("?")[0] not in ("/metrics", "/"):
+            path = self.path.split("?")[0]
+            if dashboard and path == "/dashboard":
+                try:
+                    from . import dashboard as _dash
+                    body = _dash.render_html().encode()
+                except Exception as e:
+                    self.send_error(500, explain=str(e))
+                    return
+                ctype = "text/html; charset=utf-8"
+            elif path in ("/metrics", "/"):
+                body = render_text(reg).encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
                 self.send_error(404)
                 return
-            body = render_text(reg).encode()
             self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -93,8 +118,30 @@ def serve_scrapes(port: int = 0, host: str = "127.0.0.1",
         def log_message(self, *a):   # scrapes must not spam stderr
             pass
 
-    server = ThreadingHTTPServer((host, port), _Handler)
+    class _Server(ThreadingHTTPServer):
+        # reclaim TIME_WAIT ports across service restarts; a port with
+        # a LIVE listener still refuses the bind (see below)
+        allow_reuse_address = True
+        _thread: Optional[threading.Thread] = None
+
+        def stop(self):
+            """Shut down the accept loop, close the socket and JOIN
+            the serving thread (idempotent)."""
+            self.shutdown()
+            self.server_close()
+            t, self._thread = self._thread, None
+            if t is not None and t.is_alive():
+                t.join(timeout=5.0)
+
+    try:
+        server = _Server((host, port), _Handler)
+    except OSError as e:
+        raise ScrapeServerBusyError(
+            f"metrics scrape port {host}:{port} is unavailable "
+            f"({e.strerror or e}): another process is listening — "
+            "stop it or change the metrics port") from e
     t = threading.Thread(target=server.serve_forever, daemon=True,
                          name="tpu-metrics-scrape")
+    server._thread = t
     t.start()
     return server, server.server_address[1]

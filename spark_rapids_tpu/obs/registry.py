@@ -697,6 +697,53 @@ SLO_BURN_MS = _REGISTRY.counter(
     labels=("tenant",))
 
 
+# -- longitudinal fleet plane (obs/history.py + obs/anomaly.py) -------------
+# Write buckets sized to a host JSONL append: single-digit µs for the
+# in-memory enqueue, tens of µs to low ms for the fsync-free file write.
+_HISTORY_WRITE_BUCKETS = (0.00001, 0.00005, 0.0001, 0.00025, 0.0005,
+                          0.001, 0.0025, 0.005, 0.01, 0.05, 0.1)
+
+
+def _anomaly_mod():
+    from . import anomaly
+    return anomaly
+
+
+HISTORY_ROWS = _REGISTRY.counter(
+    "tpu_history_rows_total",
+    "Query-history rows appended by the persistent history store "
+    "(obs/history.py), by terminal outcome — one row per terminal "
+    "query when the plane is enabled",
+    labels=("outcome",))
+HISTORY_DROPPED = _REGISTRY.counter(
+    "tpu_history_dropped_total",
+    "History rows dropped because the bounded writer queue was full "
+    "(the store never blocks or fails the query path)")
+HISTORY_WRITE_SECONDS = _REGISTRY.histogram(
+    "tpu_history_write_seconds",
+    "Wall duration of each background JSONL row append (serialize + "
+    "write + rotation check; obs/history.py writer thread — off the "
+    "query path by construction)",
+    buckets=_HISTORY_WRITE_BUCKETS)
+
+ANOMALY_CHECKS = _REGISTRY.counter(
+    "tpu_anomaly_checks_total",
+    "Per-(fingerprint, key) EWMA folds performed by the online "
+    "anomaly sentinel (obs/anomaly.py) — one per gated key per "
+    "history row once the store is enabled")
+ANOMALY_EVENTS = _REGISTRY.counter(
+    "tpu_anomaly_events_total",
+    "Anomaly lifecycle events by kind: breach = K consecutive "
+    "sigma-outliers opened an anomaly, recovery = K consecutive "
+    "in-band runs closed it (obs/anomaly.py)",
+    labels=("kind",))
+ANOMALY_ACTIVE = _REGISTRY.gauge(
+    "tpu_anomaly_active",
+    "Currently open (breached, not yet recovered) anomalies across "
+    "all fingerprints and keys",
+    fn=lambda: float(_anomaly_mod().active_count()))
+
+
 def compile_cache_event(cache: str, hit: bool, dur_ns: int = 0,
                         signature=None):
     """One compile-cache lookup (called from the exec/kernels JIT

@@ -30,7 +30,9 @@ from ..config import (TpuConf, set_active, EVENT_LOG_PATH,
                       OBS_DIAG_MAX_BUNDLES, AOT_WARMUP_ENABLED,
                       AOT_WARMUP_INTERVAL_MS, AOT_WARMUP_MAX_PER_CYCLE)
 from ..compile import aot as _aot
+from ..obs import anomaly as _anomaly
 from ..obs import compile_watch as _cwatch
+from ..obs import history as _history
 from ..obs import costplane as _costplane
 from ..obs import doctor as _doctor
 from ..obs import flight as _flight
@@ -173,6 +175,11 @@ class QueryService:
         _costplane.configure(conf)
         _doctor.configure(conf)
         _aot.configure(conf)
+        # longitudinal fleet planes: the persistent history store and
+        # the online anomaly sentinel it feeds (process-wide, last
+        # service wins, like every other plane)
+        _history.configure(conf)
+        _anomaly.configure(conf)
         # admission-aware AOT warmup daemon (service/warmup.py): watches
         # the (program, bucket) demand ledger and pre-compiles missing
         # bucket executables off the query path
@@ -196,6 +203,8 @@ class QueryService:
             "doctor": _doctor.stats_section(),
             "aot": _aot.stats_section(),
             "warmup": self.warmup.state(),
+            "history": _history.stats_section(),
+            "anomaly": _anomaly.stats_section(),
         })
 
     # -- lifecycle ---------------------------------------------------------
@@ -234,8 +243,14 @@ class QueryService:
                 t.join(left)
         self.watchdog.stop()
         self.warmup.stop()
+        _history.stop()
         if self._scrape_server is not None:
-            self._scrape_server.shutdown()
+            # hardened lifecycle: stop() joins the serving thread and
+            # closes the socket so a successor service can rebind the
+            # same port immediately
+            stop = getattr(self._scrape_server, "stop",
+                           self._scrape_server.shutdown)
+            stop()
             self._scrape_server = None
 
     def __enter__(self):
@@ -291,6 +306,7 @@ class QueryService:
             self._stats.inc("shed")
             handle.metrics.outcome = "shed"
             _slo.record(handle.metrics)
+            self._record_terminal(handle.metrics, handle)
             handle._finish(FAILED, error=e)
             _flight.record(_flight.EV_STATE, "shed", query_id=query_id)
             bundle = self._maybe_shed_bundle(handle, e)
@@ -338,6 +354,7 @@ class QueryService:
                     handle.metrics.outcome = "failed"
                     handle.metrics.error = repr(e)
                     _slo.record(handle.metrics)
+                    self._record_terminal(handle.metrics, handle)
                     handle._finish(FAILED, error=e)
                 self._forget(handle)
 
@@ -395,6 +412,7 @@ class QueryService:
                 m.error = repr(e)
                 self._stats.inc("failed")
                 _slo.record(m)
+                self._record_terminal(m, handle)
                 handle._finish(FAILED, error=e)
                 _flight.record(_flight.EV_STATE, "failed",
                                query_id=handle.query_id)
@@ -410,6 +428,7 @@ class QueryService:
             m.outcome = "completed"
             self._stats.inc("completed")
             _slo.record(m)
+            self._record_terminal(m, handle)
             handle._finish(DONE, result=table)
             _flight.record(_flight.EV_STATE, "completed",
                            query_id=handle.query_id)
@@ -458,6 +477,31 @@ class QueryService:
         rec.update(fields)
         self._events.log_service_event(kind, handle.query_id, **rec)
 
+    def _record_terminal(self, m, handle: Optional[QueryHandle] = None):
+        """Fold one terminal query into the longitudinal planes: the
+        history row (obs/history.py) and, through it, the anomaly
+        sentinel (obs/anomaly.py).  The sentinel's lifecycle events
+        get their side effects here — an ``anomaly`` event-log line
+        each, plus a rate-limited diag bundle on breach.  Runs on the
+        terminal transition path and must never raise."""
+        try:
+            row = _history.record(m)
+            if row is None:
+                return
+            for ev in _anomaly.fold(row):
+                fields = dict(ev)
+                kind = fields.pop("kind", "breach")
+                bundle = None
+                if kind == "breach" and self._diag_dir \
+                        and _anomaly.should_bundle():
+                    bundle = self._write_diag_bundle("anomaly", handle,
+                                                     None)
+                self._events.log_service_event(
+                    "anomaly", m.query_id, anomaly_kind=kind,
+                    diag_bundle=bundle, **fields)
+        except Exception:
+            pass
+
     # -- cleanup / finalization -------------------------------------------
     def _cleanup_failed_attempt(self, handle: QueryHandle):
         """Release everything a dead attempt may still hold: this
@@ -483,6 +527,7 @@ class QueryService:
         m.error = reason
         self._stats.inc("cancelled")
         _slo.record(m)
+        self._record_terminal(m, handle)
         if reason == "deadline":
             self._stats.inc("deadline_exceeded")
         err = QueryCancelledError(reason, handle.query_id)

@@ -1,0 +1,276 @@
+"""Offline fleet-history explorer — reads the JSONL segments the
+query-history store (obs/history.py) persisted under
+``spark.rapids.tpu.obs.history.dir`` and answers the longitudinal
+questions without a live service:
+
+  summary  — per-fingerprint fleet table (runs, outcome mix, latency
+             percentiles, doctor causes, tenants), worst-latency first
+  trend    — one fingerprint's key over time, bucketed into equal-count
+             windows with a sparkline-style bar per bucket
+  compare  — before/after split of the whole history (by timestamp or
+             by fraction) with per-key deltas — the "did the rollout
+             regress fingerprint X" question
+
+Usage:
+  python -m spark_rapids_tpu.tools.history summary <history_dir> [--top N]
+  python -m spark_rapids_tpu.tools.history trend <history_dir>
+      --fingerprint FP [--key exec_ms] [--buckets N]
+  python -m spark_rapids_tpu.tools.history compare <history_dir>
+      [--fingerprint FP] [--split-frac F | --split-ts TS]
+      [--keys k1,k2,...]
+
+Stdlib-only and read-only; timestamps come from the rows themselves
+(this tool never consults the wall clock).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_DEFAULT_COMPARE_KEYS = ("exec_ms", "queue_ms", "host_drop_tax_ms",
+                         "spill_ms", "device_util_pct", "flushes")
+
+
+def load_rows(history_dir: str,
+              fingerprint: Optional[str] = None) -> List[Dict]:
+    """Every parseable row from every ``history-*.jsonl`` segment,
+    oldest segment first, ordered by row timestamp within the load."""
+    rows: List[Dict] = []
+    pattern = os.path.join(history_dir, "history-*.jsonl")
+    for path in sorted(glob.glob(pattern)):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    if fingerprint and \
+                            row.get("fingerprint") != fingerprint:
+                        continue
+                    rows.append(row)
+        except OSError:
+            continue
+    rows.sort(key=lambda r: float(r.get("ts") or 0.0))
+    return rows
+
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def _mix(counts: Dict[str, int]) -> str:
+    return " ".join(f"{k}:{v}" for k, v in sorted(counts.items())) or "-"
+
+
+def _vals(rows: List[Dict], key: str) -> List[float]:
+    out = []
+    for r in rows:
+        v = r.get(key)
+        if isinstance(v, (int, float)):
+            out.append(float(v))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# summary
+# ---------------------------------------------------------------------------
+
+def summarize(rows: List[Dict]) -> Dict[str, Dict]:
+    """Per-fingerprint aggregate over the loaded rows (the offline
+    twin of obs/history.fleet_aggregates, but unbounded)."""
+    by_fp: Dict[str, List[Dict]] = {}
+    for r in rows:
+        by_fp.setdefault(str(r.get("fingerprint") or "unknown"),
+                         []).append(r)
+    out: Dict[str, Dict] = {}
+    for fp, rs in by_fp.items():
+        execs = sorted(_vals(rs, "exec_ms"))
+        outcomes: Dict[str, int] = {}
+        tenants: Dict[str, int] = {}
+        causes: Dict[str, int] = {}
+        for r in rs:
+            o = str(r.get("outcome") or "?")
+            outcomes[o] = outcomes.get(o, 0) + 1
+            t = str(r.get("tenant") or "default")
+            tenants[t] = tenants.get(t, 0) + 1
+            c = r.get("doctor_cause")
+            if c:
+                causes[str(c)] = causes.get(str(c), 0) + 1
+        out[fp] = {
+            "count": len(rs),
+            "outcomes": outcomes,
+            "exec_p50_ms": round(_pctl(execs, 0.5), 3),
+            "exec_p95_ms": round(_pctl(execs, 0.95), 3),
+            "tenants": tenants,
+            "doctor_causes": causes,
+        }
+    return out
+
+
+def _cmd_summary(args) -> int:
+    rows = load_rows(args.history_dir)
+    if not rows:
+        print(f"no history rows under {args.history_dir}")
+        return 1
+    summ = summarize(rows)
+    order = sorted(summ, key=lambda fp: -summ[fp]["exec_p95_ms"])
+    print(f"{len(rows)} rows, {len(summ)} fingerprints "
+          f"(worst exec p95 first)")
+    hdr = (f"{'fingerprint':<18} {'runs':>5} {'p50ms':>9} {'p95ms':>9}"
+           f"  {'outcomes':<24} {'doctor causes':<28} tenants")
+    print(hdr)
+    print("-" * len(hdr))
+    for fp in order[:args.top]:
+        s = summ[fp]
+        print(f"{fp:<18} {s['count']:>5} {s['exec_p50_ms']:>9.2f} "
+              f"{s['exec_p95_ms']:>9.2f}  {_mix(s['outcomes']):<24} "
+              f"{_mix(s['doctor_causes']):<28} {_mix(s['tenants'])}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# trend
+# ---------------------------------------------------------------------------
+
+def trend(rows: List[Dict], key: str,
+          buckets: int = 10) -> List[Dict]:
+    """The key's trajectory over the (time-ordered) rows, split into
+    up to ``buckets`` equal-count windows."""
+    vals = [(float(r.get("ts") or 0.0), float(r[key])) for r in rows
+            if isinstance(r.get(key), (int, float))]
+    if not vals:
+        return []
+    n = len(vals)
+    buckets = max(1, min(buckets, n))
+    size = n / buckets
+    out = []
+    for b in range(buckets):
+        chunk = vals[int(b * size):int((b + 1) * size)] or \
+            [vals[min(n - 1, int(b * size))]]
+        ys = sorted(v for _, v in chunk)
+        out.append({"first_ts": chunk[0][0], "last_ts": chunk[-1][0],
+                    "n": len(chunk), "p50": round(_pctl(ys, 0.5), 3),
+                    "max": round(ys[-1], 3)})
+    return out
+
+
+def _cmd_trend(args) -> int:
+    rows = load_rows(args.history_dir, fingerprint=args.fingerprint)
+    if not rows:
+        print(f"no rows for fingerprint {args.fingerprint} under "
+              f"{args.history_dir}")
+        return 1
+    series = trend(rows, args.key, buckets=args.buckets)
+    if not series:
+        print(f"no numeric values for key {args.key!r}")
+        return 1
+    peak = max(b["p50"] for b in series) or 1.0
+    first = series[0]["p50"]
+    last = series[-1]["p50"]
+    drift = ((last - first) / first * 100.0) if first else 0.0
+    print(f"{args.fingerprint} {args.key}: {len(rows)} rows in "
+          f"{len(series)} windows, p50 {first} -> {last} "
+          f"({drift:+.1f}%)")
+    for b in series:
+        bar = "#" * max(1, int(round(b["p50"] / peak * 40))) \
+            if peak > 0 else ""
+        print(f"  n={b['n']:>4} p50={b['p50']:>10.3f} "
+              f"max={b['max']:>10.3f} {bar}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# compare
+# ---------------------------------------------------------------------------
+
+def compare_windows(rows: List[Dict], keys=_DEFAULT_COMPARE_KEYS,
+                    split_frac: float = 0.5,
+                    split_ts: Optional[float] = None) -> Dict:
+    """Before/after medians per key; the split is a timestamp or a
+    fraction of the (time-ordered) row count."""
+    if split_ts is not None:
+        before = [r for r in rows
+                  if float(r.get("ts") or 0.0) < split_ts]
+        after = [r for r in rows
+                 if float(r.get("ts") or 0.0) >= split_ts]
+    else:
+        cut = int(len(rows) * split_frac)
+        before, after = rows[:cut], rows[cut:]
+    out = {"before_n": len(before), "after_n": len(after), "keys": {}}
+    for key in keys:
+        b = sorted(_vals(before, key))
+        a = sorted(_vals(after, key))
+        if not b or not a:
+            continue
+        bp, ap = _pctl(b, 0.5), _pctl(a, 0.5)
+        out["keys"][key] = {
+            "before_p50": round(bp, 3), "after_p50": round(ap, 3),
+            "delta_pct": round((ap - bp) / bp * 100.0, 2) if bp
+            else 0.0,
+        }
+    return out
+
+
+def _cmd_compare(args) -> int:
+    rows = load_rows(args.history_dir, fingerprint=args.fingerprint)
+    if len(rows) < 2:
+        print("not enough rows to compare")
+        return 1
+    keys = tuple(k.strip() for k in args.keys.split(",") if k.strip())
+    res = compare_windows(rows, keys=keys or _DEFAULT_COMPARE_KEYS,
+                          split_frac=args.split_frac,
+                          split_ts=args.split_ts)
+    scope = args.fingerprint or "all fingerprints"
+    print(f"{scope}: before n={res['before_n']} / "
+          f"after n={res['after_n']}")
+    for key, d in res["keys"].items():
+        print(f"  {key:<18} p50 {d['before_p50']:>10.3f} -> "
+              f"{d['after_p50']:>10.3f}  ({d['delta_pct']:+.2f}%)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.tools.history",
+        description="Offline explorer for the persistent query-history "
+                    "store (obs/history.py JSONL segments)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("summary", help="per-fingerprint fleet table")
+    p.add_argument("history_dir")
+    p.add_argument("--top", type=int, default=20)
+    p.set_defaults(fn=_cmd_summary)
+
+    p = sub.add_parser("trend", help="one fingerprint's key over time")
+    p.add_argument("history_dir")
+    p.add_argument("--fingerprint", required=True)
+    p.add_argument("--key", default="exec_ms")
+    p.add_argument("--buckets", type=int, default=10)
+    p.set_defaults(fn=_cmd_trend)
+
+    p = sub.add_parser("compare", help="before/after window deltas")
+    p.add_argument("history_dir")
+    p.add_argument("--fingerprint", default=None)
+    p.add_argument("--split-frac", type=float, default=0.5)
+    p.add_argument("--split-ts", type=float, default=None)
+    p.add_argument("--keys", default=",".join(_DEFAULT_COMPARE_KEYS))
+    p.set_defaults(fn=_cmd_compare)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""Seeded SYNC001/OBS002/HYG002 fixture shaped like a fleet-plane
+helper — ``ci/lint.py`` must exit NONZERO.
+
+The fleet plane (obs/fingerprint.py, obs/history.py, obs/anomaly.py,
+obs/dashboard.py) folds rows the planes already collected into host
+dicts, so its lint scope bans exactly what this helper does: a device
+pull while "enriching" a history row, a flight-recorder event that
+allocates per fold, and a wall-clock read where the row's own
+timestamp is required.  Never imported by the engine.
+"""
+import time
+
+import jax
+import numpy as np
+
+from spark_rapids_tpu.obs import flight as _flight
+
+
+def bad_fold(dev, fingerprint):
+    drift = np.asarray(dev).mean()            # SYNC001: materialization
+    evidence = jax.device_get(dev)            # SYNC001: host pull
+    _flight.record(_flight.EV_MEM, f"anomaly:{fingerprint}")  # OBS002
+    stamp = time.time()                       # HYG002: wall clock
+    return drift, evidence, stamp
+
+
+def good_fold(row, state):
+    # the sentinel's real shape: host arithmetic over the row already
+    # in hand, interned name constants, the row's own timestamp
+    _flight.record(_flight.EV_MEM, "anomaly", a=int(row.get("ts", 0)))
+    return state.get(row.get("fingerprint"), 0.0)

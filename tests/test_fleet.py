@@ -1,0 +1,563 @@
+"""Fleet observability tests: the stable plan fingerprint
+(obs/fingerprint), the persistent query-history store (obs/history),
+the online anomaly sentinel (obs/anomaly), the shared band/direction
+core (analysis/bands), the hardened scrape-server lifecycle (obs/prom)
+and the dashboard + offline history CLI."""
+import json
+import os
+import queue as _pyqueue
+import urllib.request
+
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.analysis import bands
+from spark_rapids_tpu.analysis.regression import Delta, compare
+from spark_rapids_tpu.obs import anomaly, fingerprint, history
+from spark_rapids_tpu.service.metrics import QueryMetrics
+
+
+@pytest.fixture(autouse=True)
+def _fleet_reset():
+    """Isolate the process-wide fleet planes (and restore the default
+    config afterwards — last-configured service wins)."""
+    history.stop()
+    history.reset()
+    anomaly.reset()
+    yield
+    history.stop()
+    default = TpuConf({})
+    history.configure(default)
+    anomaly.configure(default)
+    history.reset()
+    anomaly.reset()
+
+
+def _metrics(i=0, tenant="default", exec_ms=100.0, outcome="completed",
+             ts=None):
+    m = QueryMetrics(query_id=f"q{i}", tenant=tenant, priority=0)
+    m.execute_ms = exec_ms
+    m.queue_wait_ms = 1.0
+    m.outcome = outcome
+    if ts is not None:
+        m.submitted_ts = ts
+    return m
+
+
+def _row(fp="fpA", exec_ms=100.0, i=0, flushes=2, cause=None):
+    return {"fingerprint": fp, "exec_ms": exec_ms, "queue_ms": 1.0,
+            "host_drop_tax_ms": 0.0, "spill_ms": 0.0,
+            "device_util_pct": 60.0, "flushes": flushes,
+            "doctor_cause": cause, "ts": 1000.0 + i}
+
+
+# ---------------------------------------------------------------------------
+# plan fingerprint
+# ---------------------------------------------------------------------------
+
+def _fp_for(conf_extra=None, lit=5, extra_agg=False, tenant_tag=None):
+    s = TpuSession(TpuConf(dict(conf_extra or {})))
+    df = s.range(0, 64, num_partitions=2) \
+        .select((F.col("id") % 7).alias("k"), F.col("id").alias("v")) \
+        .filter(F.col("v") > lit).group_by("k")
+    if extra_agg:
+        df = df.agg(F.sum("v").alias("sv"), F.count("v").alias("cv"))
+    else:
+        df = df.agg(F.sum("v").alias("sv"))
+    df.collect()
+    assert s.last_query_fingerprint
+    return s.last_query_fingerprint
+
+
+class TestFingerprint:
+    def test_stable_across_pipeline_and_superstage_matrix(self):
+        digests = {
+            _fp_for({"spark.rapids.tpu.exec.pipelineParallelism": pp,
+                     "spark.rapids.tpu.sql.superstage.enabled": ss})
+            for pp in (1, 4) for ss in (True, False)}
+        assert len(digests) == 1, digests
+
+    def test_same_plan_two_sessions_same_digest(self):
+        assert _fp_for() == _fp_for()
+
+    def test_literal_change_same_digest(self):
+        assert _fp_for(lit=5) == _fp_for(lit=50)
+
+    def test_shape_change_moves_digest(self):
+        assert _fp_for() != _fp_for(extra_agg=True)
+
+    def test_obs_and_logging_confs_do_not_move_conf_fingerprint(self):
+        base = fingerprint.conf_fingerprint(TpuConf({}))
+        same = fingerprint.conf_fingerprint(TpuConf({
+            "spark.rapids.tpu.obs.history.enabled": False,
+            "spark.rapids.tpu.obs.anomaly.sigma": 9.0,
+            "spark.rapids.tpu.eventLog.path": "/tmp/x.jsonl",
+            "spark.rapids.tpu.exec.pipelineParallelism": 4,
+            "spark.rapids.tpu.sql.superstage.enabled": False,
+        }))
+        assert base == same
+
+    def test_plan_affecting_conf_moves_conf_fingerprint(self):
+        base = fingerprint.conf_fingerprint(TpuConf({}))
+        moved = fingerprint.conf_fingerprint(TpuConf({
+            "spark.rapids.tpu.sql.shuffle.partitions": 3}))
+        assert base != moved
+
+    def test_plan_shape_has_no_literals_or_ids(self):
+        s = TpuSession(TpuConf({}))
+        df = s.range(0, 64, num_partitions=2) \
+            .filter(F.col("id") > 42424242)
+        df.collect()
+        # re-derive the shape from a fresh identical plan: one line per
+        # operator, literals absent
+        df2 = s.range(0, 64, num_partitions=2) \
+            .filter(F.col("id") > 42424242)
+        df2.collect()
+        assert s.last_query_fingerprint
+
+
+# ---------------------------------------------------------------------------
+# shared band/direction core
+# ---------------------------------------------------------------------------
+
+class TestBands:
+    def test_higher_direction(self):
+        assert bands.band_status(79.0, 100.0, "higher", 20.0) \
+            == bands.REGRESSION
+        assert bands.band_status(121.0, 100.0, "higher", 20.0) \
+            == bands.IMPROVEMENT
+        assert bands.band_status(100.0, 100.0, "higher", 20.0) \
+            == bands.OK
+
+    def test_lower_direction_with_floor(self):
+        # floor guards near-zero baselines
+        assert bands.band_status(3.0, 2.0, "lower", 25.0,
+                                 abs_floor=50.0) == bands.OK
+        assert bands.band_status(300.0, 100.0, "lower", 25.0,
+                                 abs_floor=50.0) == bands.REGRESSION
+
+    def test_exact_direction_never_improves(self):
+        assert bands.band_status(2.0, 2.0, "exact") == bands.OK
+        assert bands.band_status(1.0, 2.0, "exact") == bands.REGRESSION
+        assert bands.band_status(3.0, 2.0, "exact") == bands.REGRESSION
+
+    def test_parity_with_regression_compare(self):
+        # the offline gate and the shared core agree on the same inputs
+        baseline = {"keys": {"rows_per_sec": {
+            "value": 100.0, "band_pct": 10.0, "direction": "higher"}}}
+        deltas = compare({"rows_per_sec": 85.0}, baseline)
+        d = [x for x in deltas if x.key == "rows_per_sec"][0]
+        assert isinstance(d, Delta) and d.status == "regression"
+        assert bands.band_status(85.0, 100.0, "higher", 10.0) \
+            == bands.REGRESSION
+
+
+# ---------------------------------------------------------------------------
+# history store
+# ---------------------------------------------------------------------------
+
+class TestHistory:
+    def test_note_query_record_join(self, tmp_path):
+        history.configure(TpuConf({
+            "spark.rapids.tpu.obs.history.dir": str(tmp_path)}))
+        history.note_query("q0", {"fingerprint": "fpJ", "flushes": 3,
+                                  "device_util_pct": 44.0,
+                                  "doctor_cause": "host_staging"})
+        row = history.record(_metrics(0, tenant="t9", exec_ms=12.5))
+        assert row["fingerprint"] == "fpJ"
+        assert row["flushes"] == 3
+        assert row["tenant"] == "t9"
+        assert row["exec_ms"] == 12.5
+        assert row["doctor_cause"] == "host_staging"
+        # the artifact is consumed: a second record has no join
+        row2 = history.record(_metrics(0))
+        assert row2["fingerprint"] == "unknown"
+        history.stop()
+        rows = [json.loads(ln) for p in history.segment_paths()
+                for ln in open(p)]
+        assert len(rows) == 2 and rows[0]["fingerprint"] == "fpJ"
+
+    def test_size_rotation_and_retention(self, tmp_path):
+        history.configure(TpuConf({
+            "spark.rapids.tpu.obs.history.dir": str(tmp_path),
+            "spark.rapids.tpu.obs.history.rotation.maxBytes": 600,
+            "spark.rapids.tpu.obs.history.retention.maxSegments": 3}))
+        for i in range(30):
+            history.note_query(f"q{i}", {"fingerprint": "fpR"})
+            history.record(_metrics(i))
+        history.stop()
+        segs = history.segment_paths()
+        assert 1 < len(segs) <= 3, segs
+        # retention deleted the oldest: the surviving sequence numbers
+        # are the highest ones and every surviving file is bounded
+        for p in segs:
+            assert os.path.getsize(p) <= 600 + 400  # one-row overshoot
+        names = [os.path.basename(p) for p in segs]
+        assert names == sorted(names)
+        assert names[-1] != "history-000001.jsonl"
+
+    def test_age_rotation_uses_row_timestamps(self, tmp_path):
+        history.configure(TpuConf({
+            "spark.rapids.tpu.obs.history.dir": str(tmp_path),
+            "spark.rapids.tpu.obs.history.rotation.maxAgeSeconds": 500}))
+        history.record(_metrics(0, ts=1000.0))
+        history.record(_metrics(1, ts=1100.0))   # same segment
+        history.record(_metrics(2, ts=1700.0))   # > 500s later: rolls
+        history.stop()
+        segs = history.segment_paths()
+        assert len(segs) == 2, segs
+        first = [json.loads(ln) for ln in open(segs[0])]
+        second = [json.loads(ln) for ln in open(segs[1])]
+        assert [r["ts"] for r in first] == [1000.0, 1100.0]
+        assert [r["ts"] for r in second] == [1700.0]
+
+    def test_full_queue_drops_and_counts_never_blocks(self, tmp_path):
+        history.configure(TpuConf({
+            "spark.rapids.tpu.obs.history.dir": str(tmp_path)}))
+        history.stop()                      # kill the writer...
+        history._Q = _pyqueue.Queue(maxsize=1)   # ...and leave a full q
+        history._Q.put_nowait(_row())
+        before = history.stats_section()["dropped"]
+        row = history.record(_metrics(0))        # must not block
+        assert row is not None
+        assert history.stats_section()["dropped"] == before + 1
+        history._Q = None
+
+    def test_in_memory_only_without_dir(self):
+        history.configure(TpuConf({}))
+        row = history.record(_metrics(0))
+        assert row is not None
+        assert history.segment_paths() == []
+        assert history.stats_section()["rows"] == 1
+        assert history.fleet_aggregates()["unknown"]["count"] == 1
+
+    def test_adopts_newest_segment_across_restart(self, tmp_path):
+        conf = TpuConf({
+            "spark.rapids.tpu.obs.history.dir": str(tmp_path)})
+        history.configure(conf)
+        history.record(_metrics(0))
+        history.stop()
+        history.configure(conf)             # simulated restart
+        history.record(_metrics(1))
+        history.stop()
+        segs = history.segment_paths()
+        assert len(segs) == 1
+        assert len(open(segs[0]).readlines()) == 2
+
+    def test_disabled_records_nothing(self):
+        history.configure(TpuConf({
+            "spark.rapids.tpu.obs.history.enabled": False}))
+        assert history.record(_metrics(0)) is None
+        assert history.stats_section()["rows"] == 0
+
+
+# ---------------------------------------------------------------------------
+# anomaly sentinel
+# ---------------------------------------------------------------------------
+
+def _sentinel_conf(minn=5, k=3, sigma=2.0):
+    return TpuConf({
+        "spark.rapids.tpu.obs.anomaly.warmupMinRuns": minn,
+        "spark.rapids.tpu.obs.anomaly.breachRuns": k,
+        "spark.rapids.tpu.obs.anomaly.sigma": sigma,
+    })
+
+
+class TestAnomaly:
+    def test_warmup_never_alarms(self):
+        anomaly.configure(_sentinel_conf(minn=10))
+        events = []
+        for i in range(10):
+            events += anomaly.fold(_row(exec_ms=100.0 * (i + 1), i=i))
+        assert events == []
+
+    def test_k_consecutive_outliers_breach_once(self):
+        anomaly.configure(_sentinel_conf())
+        for i in range(6):
+            assert anomaly.fold(_row(exec_ms=100.0 + i % 3, i=i)) == []
+        got = []
+        for i in range(6, 12):
+            got += anomaly.fold(_row(exec_ms=300.0, i=i))
+        breaches = [e for e in got if e["kind"] == "breach"]
+        assert len(breaches) == 1
+        assert breaches[0]["fingerprint"] == "fpA"
+        assert breaches[0]["key"] == "exec_ms"
+        assert breaches[0]["drift_pct"] > 100
+        assert anomaly.active_count() == 1
+
+    def test_single_spike_below_k_never_breaches(self):
+        anomaly.configure(_sentinel_conf(k=3))
+        for i in range(6):
+            anomaly.fold(_row(exec_ms=100.0, i=i))
+        evs = list(anomaly.fold(_row(exec_ms=900.0, i=6)))
+        evs += anomaly.fold(_row(exec_ms=100.0, i=7))
+        assert [e for e in evs if e["kind"] == "breach"] == []
+        assert anomaly.active_count() == 0
+
+    def test_level_shift_not_absorbed_then_recovery(self):
+        # outliers never train the model, so a sustained shift stays
+        # active until the metric actually returns to the baseline
+        anomaly.configure(_sentinel_conf())
+        for i in range(6):
+            anomaly.fold(_row(exec_ms=100.0, i=i))
+        for i in range(6, 16):
+            anomaly.fold(_row(exec_ms=300.0, i=i))
+        assert anomaly.active_count() == 1
+        rec = []
+        for i in range(16, 22):
+            rec += anomaly.fold(_row(exec_ms=100.0, i=i))
+        assert [e for e in rec if e["kind"] == "recovery"]
+        assert anomaly.active_count() == 0
+
+    def test_exact_key_flush_count_change_breaches(self):
+        anomaly.configure(_sentinel_conf())
+        for i in range(6):
+            anomaly.fold(_row(flushes=2, i=i))
+        got = []
+        for i in range(6, 10):
+            got += anomaly.fold(_row(flushes=3, i=i))
+        keys = {e["key"] for e in got if e["kind"] == "breach"}
+        assert "flushes" in keys
+
+    def test_breach_isolated_to_drifting_fingerprint(self):
+        anomaly.configure(_sentinel_conf())
+        for i in range(6):
+            anomaly.fold(_row(fp="good", exec_ms=100.0, i=i))
+            anomaly.fold(_row(fp="bad", exec_ms=100.0, i=i))
+        got = []
+        for i in range(6, 12):
+            got += anomaly.fold(_row(fp="good", exec_ms=100.0, i=i))
+            got += anomaly.fold(_row(fp="bad", exec_ms=400.0, i=i))
+        assert {e["fingerprint"] for e in got
+                if e["kind"] == "breach"} == {"bad"}
+
+    def test_trend_and_cause_shift(self):
+        anomaly.configure(_sentinel_conf())
+        for i in range(5):
+            anomaly.fold(_row(exec_ms=100.0, i=i, cause="host_staging"))
+        for i in range(5, 60):
+            anomaly.fold(_row(exec_ms=100.0, i=i,
+                              cause="device_compute"))
+        t = anomaly.trend_section()["fpA"]
+        assert t["runs"] == 60
+        assert t["drift"]["exec_ms"]["baseline"] > 0
+        assert t["cause_shift"] == {"from": "host_staging",
+                                    "to": "device_compute"}
+
+    def test_doctor_stats_carry_trend(self):
+        from spark_rapids_tpu.obs import doctor
+        anomaly.configure(_sentinel_conf())
+        for i in range(8):
+            anomaly.fold(_row(exec_ms=100.0, i=i))
+        assert "fpA" in doctor.stats_section().get("trend", {})
+
+    def test_bundle_rate_limit(self):
+        anomaly.configure(TpuConf({
+            "spark.rapids.tpu.obs.anomaly.bundleIntervalSeconds": 3600}))
+        assert anomaly.should_bundle() is True
+        assert anomaly.should_bundle() is False
+
+    def test_disabled_folds_nothing(self):
+        anomaly.configure(TpuConf({
+            "spark.rapids.tpu.obs.anomaly.enabled": False}))
+        for i in range(20):
+            assert anomaly.fold(_row(exec_ms=100.0 * (i + 1), i=i)) == []
+        assert anomaly.stats_section()["fingerprints"] == 0
+
+
+# ---------------------------------------------------------------------------
+# scrape-server lifecycle + dashboard
+# ---------------------------------------------------------------------------
+
+class TestScrapeServer:
+    def test_back_to_back_servers_on_one_port(self):
+        from spark_rapids_tpu.obs.prom import serve_scrapes
+        s1, port = serve_scrapes(0)
+        s1.stop()
+        s2, p2 = serve_scrapes(port)     # rebind right after stop()
+        try:
+            assert p2 == port
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).read()
+            assert b"tpu_history_rows_total" in body
+        finally:
+            s2.stop()
+        s2.stop()                        # idempotent
+
+    def test_live_port_raises_clear_error(self):
+        from spark_rapids_tpu.obs.prom import (ScrapeServerBusyError,
+                                               serve_scrapes)
+        s1, port = serve_scrapes(0)
+        try:
+            with pytest.raises(ScrapeServerBusyError) as ei:
+                serve_scrapes(port)
+            assert str(port) in str(ei.value)
+        finally:
+            s1.stop()
+
+    def test_dashboard_route(self):
+        from spark_rapids_tpu.obs.prom import serve_scrapes
+        history.configure(TpuConf({}))
+        history.note_query("q0", {"fingerprint": "fpDash"})
+        history.record(_metrics(0))
+        s1, port = serve_scrapes(0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/dashboard", timeout=5) \
+                .read().decode()
+            assert "TPU fleet dashboard" in body
+            assert "fpDash" in body
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=5)
+        finally:
+            s1.stop()
+
+
+class TestDashboard:
+    def test_render_escapes_and_degrades(self):
+        from spark_rapids_tpu.obs import dashboard
+        history.configure(TpuConf({}))
+        history.note_query("q0", {
+            "fingerprint": "<script>alert(1)</script>",
+            "doctor_cause": "device_compute"})
+        history.record(_metrics(0))
+        html = dashboard.render_html()
+        assert "<script>alert(1)</script>" not in html
+        assert "&lt;script&gt;" in html
+
+    def test_render_empty_state(self):
+        from spark_rapids_tpu.obs import dashboard
+        html = dashboard.render_html()
+        assert "no history rows yet" in html
+
+
+# ---------------------------------------------------------------------------
+# offline CLI
+# ---------------------------------------------------------------------------
+
+class TestHistoryCli:
+    def _seed(self, tmp_path, n=20):
+        history.configure(TpuConf({
+            "spark.rapids.tpu.obs.history.dir": str(tmp_path)}))
+        for i in range(n):
+            history.note_query(f"q{i}", {"fingerprint": "fpCli"})
+            history.record(_metrics(
+                i, exec_ms=100.0 if i < n // 2 else 200.0,
+                ts=1000.0 + i))
+        history.stop()
+
+    def test_load_and_summary(self, tmp_path):
+        self._seed(tmp_path)
+        from spark_rapids_tpu.tools import history as cli
+        rows = cli.load_rows(str(tmp_path))
+        assert len(rows) == 20
+        summ = cli.summarize(rows)
+        assert summ["fpCli"]["count"] == 20
+        assert summ["fpCli"]["outcomes"] == {"completed": 20}
+
+    def test_trend_and_compare(self, tmp_path, capsys):
+        self._seed(tmp_path)
+        from spark_rapids_tpu.tools import history as cli
+        rows = cli.load_rows(str(tmp_path), fingerprint="fpCli")
+        series = cli.trend(rows, "exec_ms", buckets=4)
+        assert len(series) == 4
+        assert series[-1]["p50"] > series[0]["p50"]
+        res = cli.compare_windows(rows, keys=("exec_ms",))
+        assert res["keys"]["exec_ms"]["delta_pct"] == pytest.approx(
+            100.0, abs=1.0)
+        assert cli.main(["summary", str(tmp_path)]) == 0
+        assert cli.main(["trend", str(tmp_path), "--fingerprint",
+                         "fpCli"]) == 0
+        assert cli.main(["compare", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "fpCli" in out
+
+    def test_empty_dir_exits_nonzero(self, tmp_path):
+        from spark_rapids_tpu.tools import history as cli
+        assert cli.main(["summary", str(tmp_path)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# lint scope extension + seeded fixture
+# ---------------------------------------------------------------------------
+
+class TestFleetLint:
+    MODULES = ("spark_rapids_tpu/obs/fingerprint.py",
+               "spark_rapids_tpu/obs/history.py",
+               "spark_rapids_tpu/obs/anomaly.py",
+               "spark_rapids_tpu/obs/dashboard.py",
+               "spark_rapids_tpu/analysis/bands.py",
+               "spark_rapids_tpu/tools/history.py")
+
+    def test_fleet_modules_in_sync_obs_hyg_scopes(self):
+        from spark_rapids_tpu.analysis import lint as AL
+        for rel in self.MODULES:
+            scopes = AL._scopes_for(rel)
+            assert AL.SYNC001 in scopes, rel
+            assert AL.OBS002 in scopes, rel
+            assert AL.HYG002 in scopes, rel
+
+    def test_seeded_fleet_fixture_trips_all_three_rules(self):
+        from spark_rapids_tpu.analysis import lint as AL
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "lint_fixtures", "fleet_sync.py")
+        with open(path) as f:
+            fs = AL.lint_source(f.read(), path)
+        rules = {f.rule for f in fs}
+        assert {AL.SYNC001, AL.OBS002, AL.HYG002} <= rules
+
+    def test_shipped_fleet_modules_lint_clean(self):
+        from spark_rapids_tpu.analysis import lint as AL
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in self.MODULES:
+            path = os.path.join(repo, rel)
+            with open(path) as f:
+                fs = AL.lint_source(f.read(), rel,
+                                    scopes=AL._scopes_for(rel))
+            assert fs == [], (rel, AL.format_findings(fs))
+
+
+# ---------------------------------------------------------------------------
+# service integration: one row per terminal query, zero extra flushes
+# ---------------------------------------------------------------------------
+
+class TestServiceIntegration:
+    def test_history_rows_match_terminal_queries(self, tmp_path):
+        from spark_rapids_tpu.service.server import QueryService
+        s = TpuSession(TpuConf({
+            "spark.rapids.tpu.obs.history.dir": str(tmp_path)}))
+        df = s.range(0, 64, num_partitions=2) \
+            .select((F.col("id") % 7).alias("k"),
+                    F.col("id").alias("v")) \
+            .group_by("k").agg(F.sum("v").alias("sv"))
+        with QueryService(s, num_workers=1) as svc:
+            for _ in range(3):
+                svc.submit(df).result(60)
+            snap = svc.stats().snapshot()
+        assert snap["history"]["rows"] == 3
+        assert snap["history"]["dropped"] == 0
+        assert snap["history"]["fingerprints"] == 1
+        assert snap["anomaly"]["checks"] > 0
+        fp = next(iter(history.fleet_aggregates()))
+        assert fp != "unknown" and len(fp) == 16
+
+    def test_history_off_adds_zero_device_flushes(self):
+        from spark_rapids_tpu.columnar import pending as _pending
+
+        def _run(conf):
+            s = TpuSession(conf)
+            df = s.range(0, 64, num_partitions=2) \
+                .select((F.col("id") % 7).alias("k")) \
+                .group_by("k").agg(F.count("k").alias("c"))
+            df.collect()                  # warm
+            f0 = _pending.FLUSH_COUNT
+            df.collect()
+            return _pending.FLUSH_COUNT - f0
+
+        on = _run(TpuConf({}))
+        off = _run(TpuConf({
+            "spark.rapids.tpu.obs.history.enabled": False,
+            "spark.rapids.tpu.obs.anomaly.enabled": False}))
+        assert on == off
