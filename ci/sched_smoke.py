@@ -1,0 +1,146 @@
+"""CI smoke for the plan cache + predictive admission scheduler: drive
+a repeat-heavy two-tenant burst through the service and assert (1) the
+fingerprint-keyed plan cache converts the repeats into hits (hit rate
+> 0, warm planner path recorded), (2) zero correctness drift — every
+cached result is sha-identical to the same query planned cold with the
+cache disabled, and the runtime FLUSH_COUNT delta is unchanged, (3) a
+query whose frozen exec_ms baseline predicts a certain SLO breach is
+shed at admission as ``predicted_breach`` — its own SLO cause,
+distinct from load shedding — with the event-log record carrying a
+diagnostic bundle, and zero false sheds on the in-band traffic.
+"""
+import hashlib
+import json
+import os
+import sys
+import tempfile
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spark_rapids_tpu.api import TpuSession, functions as F  # noqa: E402
+from spark_rapids_tpu.cache import plan_cache  # noqa: E402
+from spark_rapids_tpu.config import TpuConf  # noqa: E402
+from spark_rapids_tpu.obs import anomaly, slo as _slo  # noqa: E402
+from spark_rapids_tpu.service.scheduler import PredictedBreach  # noqa: E402
+from spark_rapids_tpu.service.server import QueryService  # noqa: E402
+
+LITS = [5, 15, 25, 35, 45, 55]
+
+
+def _agg_df(s, lit):
+    return s.range(0, 4096, num_partitions=2) \
+        .select((F.col("id") % 13).alias("k"), F.col("id").alias("v")) \
+        .filter(F.col("v") > lit) \
+        .group_by("k").agg(F.sum("v").alias("sv"))
+
+
+def _sha(table):
+    cols = [table.column(i).to_pylist() for i in range(table.num_columns)]
+    rows = sorted(str(r) for r in zip(*cols)) if cols else []
+    return hashlib.sha256(json.dumps(rows).encode()).hexdigest()
+
+
+def main():
+    td = tempfile.mkdtemp(prefix="sched_smoke_")
+    log_path = os.path.join(td, "events.jsonl")
+    diag_dir = os.path.join(td, "diag")
+
+    # reference shas: the same literals planned cold every time
+    off = TpuSession(TpuConf(
+        {"spark.rapids.tpu.cache.plan.enabled": False}))
+    want = {lit: _sha(_agg_df(off, lit).to_arrow()) for lit in LITS}
+    assert off.last_query_plan_cache is None
+
+    plan_cache.reset()
+    anomaly.reset()
+    _slo.reset()
+    s = TpuSession(TpuConf({
+        "spark.rapids.tpu.eventLog.path": log_path,
+        "spark.rapids.tpu.obs.diagnostics.dir": diag_dir,
+        "spark.rapids.tpu.obs.slo.targetMs": 60000.0,
+    }))
+
+    # 1. repeat-heavy two-tenant burst: one shape, literals churning
+    with QueryService(s, num_workers=2) as svc:
+        handles = [(lit, svc.submit(_agg_df(s, lit),
+                                    tenant="red" if i % 2 else "blue"))
+                   for i, lit in enumerate(LITS)]
+        for lit, h in handles:
+            got = _sha(h.result(120))
+            assert got == want[lit], f"drift on lit={lit}"
+        snap = svc.stats().snapshot()
+        fp = s.last_query_fingerprint
+
+        pc = snap["plan_cache"]
+        assert pc["hit_pct"] > 0, pc
+        assert pc["hits"] >= len(LITS) - 1, pc
+        assert pc["misses"] == 1, pc
+        top = pc["top"][0]
+        assert top["warm_ms"] is not None, top
+        assert snap["completed"] == len(LITS), snap
+
+        # 2. flush parity: a cached hit costs exactly the device round
+        #    trips the cold plan did
+        from spark_rapids_tpu.columnar import pending as _pending
+        f0 = _pending.FLUSH_COUNT
+        _agg_df(s, 65).collect()
+        on_flushes = _pending.FLUSH_COUNT - f0
+        assert s.last_query_plan_cache[0] == "hit"
+        f0 = _pending.FLUSH_COUNT
+        _agg_df(off, 65).collect()
+        off_flushes = _pending.FLUSH_COUNT - f0
+        assert on_flushes == off_flushes, (on_flushes, off_flushes)
+
+        # 3. predicted breach: freeze a hopeless baseline for the shape
+        #    and submit it with a deadline it cannot make — shed at
+        #    admission, BEFORE any device work.  The sentinel is reset
+        #    first so the frozen baseline is exactly the seeded series
+        #    (mixing it with the burst's real exec_ms would inflate the
+        #    variance and the conservative floor would — correctly —
+        #    refuse to shed).
+        anomaly.reset()
+        for _ in range(10):
+            anomaly.fold({"fingerprint": fp, "exec_ms": 30000.0})
+        try:
+            svc.submit(_agg_df(s, 75), tenant="red", deadline_ms=100)
+            raise AssertionError("predicted breach was admitted")
+        except PredictedBreach as e:
+            assert e.predicted_ms > e.budget_ms > 0, e
+        snap = svc.stats().snapshot()
+        sched = snap["scheduler"]
+        assert sched["predicted_breach_shed"] == 1, sched
+        assert snap["shed"] == 1, snap
+
+        # in-band zero false sheds: the generous SLO target admits the
+        # same (predicted) shape without a deadline
+        svc.submit(_agg_df(s, 85), tenant="blue").result(120)
+        snap = svc.stats().snapshot()
+        assert snap["scheduler"]["predicted_breach_shed"] == 1, snap
+        assert snap["completed"] == len(LITS) + 1, snap
+
+    causes = _slo.stats_section()["tenants"]["red"]["breach_causes"]
+    assert causes.get("predicted_breach") == 1, causes
+    with open(log_path) as f:
+        shed = [r for r in (json.loads(l) for l in f)
+                if r.get("event") == "shed"]
+    assert len(shed) == 1, shed
+    assert "predicted_breach" in shed[0]["reason"], shed[0]
+    assert shed[0]["predicted_exec_ms"] > 0, shed[0]
+    bundle = shed[0].get("diag_bundle")
+    assert bundle and os.path.exists(bundle), shed[0]
+    assert json.load(open(bundle))["trigger"] == "shed", bundle
+
+    print(f"sched smoke OK: hit_pct={pc['hit_pct']}%, "
+          f"cold={top['cold_ms']}ms warm={top['warm_ms']}ms, "
+          f"flushes on/off={on_flushes}/{off_flushes}, "
+          f"predicted_breach sheds=1, bundle={os.path.basename(bundle)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,9 @@ JAX_PLATFORMS=cpu python ci/service_smoke.py
 echo "== observability (trace JSON + prometheus + report) =="
 JAX_PLATFORMS=cpu python ci/obs_smoke.py
 
+echo "== plan cache + predictive scheduler (repeat burst, breach shed) =="
+JAX_PLATFORMS=cpu python ci/sched_smoke.py
+
 echo "== morsel pipeline (parallel drains under stall watchdog) =="
 JAX_PLATFORMS=cpu python ci/pipeline_smoke.py
 

@@ -702,7 +702,7 @@ def _scopes_for(rel: str) -> Set[str]:
     scopes = {HYG001}
     parts = rel.split("/")
     base = os.path.basename(rel)
-    if any(p in ("service", "shuffle", "memory", "compile")
+    if any(p in ("service", "shuffle", "memory", "compile", "cache")
            for p in parts) or \
             base in ("pipeline.py", "exchange.py", "tpu_basic.py",
                      "superstage.py"):
@@ -719,7 +719,7 @@ def _scopes_for(rel: str) -> Set[str]:
                      "memplane.py", "doctor.py", "costplane.py",
                      "regression.py", "warmup.py", "fingerprint.py",
                      "history.py", "anomaly.py", "dashboard.py",
-                     "bands.py"):
+                     "bands.py", "plan_cache.py", "scheduler.py"):
         # the superstage compiler exists to ELIMINATE host round trips:
         # the AOT warmup daemon (service/warmup.py) calls jitted
         # programs from a background thread and carries the same
@@ -735,13 +735,16 @@ def _scopes_for(rel: str) -> Set[str]:
         # the regression sentinel (analysis/regression.py), the fleet
         # plane (obs/fingerprint.py, obs/history.py, obs/anomaly.py,
         # obs/dashboard.py + the tools/history.py CLI over its store),
-        # the shared band core (analysis/bands.py) and their exchange
-        # call sites carry the same zero-flush +
-        # allocation-free-record contract
+        # the shared band core (analysis/bands.py), the plan cache +
+        # predictive scheduler (cache/plan_cache.py,
+        # service/scheduler.py — both sit on the admission/planning
+        # path) and their exchange call sites carry the same
+        # zero-flush + allocation-free-record contract
         scopes |= {SYNC001, OBS002}
     if "obs" in parts or base in ("regression.py", "aot.py",
                                   "warmup.py", "bands.py",
-                                  "history.py"):
+                                  "history.py", "plan_cache.py",
+                                  "scheduler.py"):
         # the doctor lives in obs/ (covered by the parts check); the
         # sentinel sits in analysis/ but carries the same timing-
         # hygiene contract as the planes whose artifacts it gates;

@@ -100,6 +100,19 @@ GATE_KEYS: Tuple[Tuple[str, str, float], ...] = (
     ("history_rows", "exact", 0.0),
     ("anomaly_checks", "higher", 18.0),
     ("history_write_p99_us", "lower", 150.0),
+    # plan cache + predictive scheduler (cache/plan_cache.py,
+    # service/scheduler.py): the service burst's repeat hit rate
+    # (higher — a drop means certificates stopped replaying), the cold
+    # planner pass vs the certificate-replay warm path (both lower,
+    # wide band + floor — sub-ms host timings jitter; the warm ≪ cold
+    # relationship is what the pair documents), and the scheduler's
+    # predicted-vs-actual exec_ms honesty mean (lower, very wide — the
+    # EWMA baseline converges over rounds, the gate only catches a
+    # model that stops predicting sanely)
+    ("plan_cache_hit_pct", "higher", 18.0),
+    ("planner_path_ms_cold", "lower", 150.0),
+    ("planner_path_ms_warm", "lower", 150.0),
+    ("predicted_exec_err_pct", "lower", 400.0),
 )
 
 #: keys scaled by the seeded perf-gate fixtures (throughput-like).
@@ -119,6 +132,9 @@ ABS_FLOORS = {
     "service_p99_ms": 100.0,
     "padding_waste_pct": 50.0,
     "history_write_p99_us": 2000.0,
+    "planner_path_ms_cold": 5.0,
+    "planner_path_ms_warm": 5.0,
+    "predicted_exec_err_pct": 50.0,
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

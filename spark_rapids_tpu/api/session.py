@@ -92,6 +92,9 @@ class TpuSession:
         self._last_planner: Optional[Planner] = None
         self._views: dict = {}
         self._logger_lock = threading.Lock()
+        # plan-cache disposition of the most recent collect:
+        # ("hit"|"miss", planner_path_ms) or None (cache off)
+        self.last_query_plan_cache = None
 
     builder = TpuSessionBuilder
 
@@ -174,9 +177,14 @@ class TpuSession:
 
     # -- execution -----------------------------------------------------------
     def _plan(self, logical: L.LogicalPlan, conf: Optional[TpuConf] = None):
-        planner = Planner(conf or self.conf)
+        # plan through the fingerprint-keyed cache (cache/plan_cache.py)
+        # so repeat shapes skip the planner tail in standalone sessions
+        # exactly as they do under the query service
+        from ..cache import plan_cache as _plan_cache
+        phys, planner = _plan_cache.plan_with_cache(
+            logical, conf or self.conf)
         self._last_planner = planner
-        return planner.plan(logical)
+        return phys
 
     def execute_to_arrow(self, logical: L.LogicalPlan) -> pa.Table:
         """Run a logical plan and collect everything as one arrow table."""
@@ -245,8 +253,14 @@ class TpuSession:
         # is enforced by ci/compile_smoke.py + tests/test_audit.py.
         _flush_pred = None
         try:
-            from ..analysis.flush_budget import predict_flushes
-            _flush_pred = predict_flushes(phys, conf=conf)
+            # a plan that came through the plan cache carries its
+            # prediction already (replayed from the stored certificate
+            # on a hit, computed once at store time on a miss) — the
+            # PV-FLUSH exactness contract holds on both paths
+            _flush_pred = getattr(phys, "_plan_cache_flush_pred", None)
+            if _flush_pred is None:
+                from ..analysis.flush_budget import predict_flushes
+                _flush_pred = predict_flushes(phys, conf=conf)
         except Exception:  # noqa: BLE001 - observability only
             pass
         sem = DeviceManager.get().semaphore
@@ -413,6 +427,14 @@ class TpuSession:
                  "memplane": mem}
         if cost is not None:
             extra["costplane"] = cost
+        # plan-cache disposition (cache/plan_cache.py): stamped on the
+        # physical root by plan_with_cache — hit/miss plus the wall ms
+        # the planner path actually took for THIS query
+        pc_status = getattr(phys, "_plan_cache_status", None)
+        self.last_query_plan_cache = pc_status
+        if pc_status is not None:
+            extra["plan_cache"] = pc_status[0]
+            extra["planner_path_ms"] = round(pc_status[1], 3)
         compiles = _cwatch.records_since(cw_marker)
         if compiles:
             extra["compiles"] = [

@@ -1,0 +1,3 @@
+"""Plan-reuse layer: fingerprint-keyed caches over planning artifacts
+(cache/plan_cache.py) — the consumer side of the obs/fingerprint.py
+identity plane."""

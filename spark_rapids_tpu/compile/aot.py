@@ -59,7 +59,8 @@ import uuid
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..obs import flight
-from ..obs.registry import AOT_BUCKET_DEMAND, AOT_WARMUP_COMPILES
+from ..obs.registry import (AOT_BUCKET_DEMAND, AOT_HINT_COMPILES,
+                            AOT_WARMUP_COMPILES)
 
 #: every program participating in bucketed execution — the PR 10
 #: auditor must keep full coverage over this registry
@@ -147,6 +148,16 @@ _WARMED: Set[Tuple[str, str, int]] = set()
 _WARMUP_TOTAL = 0
 _WARMUP_FAILED = 0
 
+#: externally hinted (program, bucket) pairs awaiting pre-warm — the
+#: predictive scheduler's PREDICTED demand (service/scheduler.py via
+#: service/warmup.py note_hint), as opposed to the observed demand
+#: ledger above.  A compile whose pair arrived ONLY through a hint is
+#: counted under tpu_compile_hint_warmup_total, separate from the
+#: admission-driven warmup counter.
+_HINTS: Set[Tuple[str, int]] = set()
+_HINTS_NOTED = 0
+_HINT_COMPILES = 0
+
 _TLS = threading.local()
 
 
@@ -165,7 +176,8 @@ def conf_fingerprint(conf) -> str:
     from ..columnar import column as _col
     from ..config import all_entries
     skip = ("spark.rapids.tpu.obs.", "spark.rapids.tpu.service.",
-            "spark.rapids.tpu.compile.aot.", "spark.rapids.tpu.test.")
+            "spark.rapids.tpu.compile.aot.", "spark.rapids.tpu.cache.",
+            "spark.rapids.tpu.test.")
     h = hashlib.sha256()
     for e in all_entries():
         if any(e.key.startswith(p) for p in skip):
@@ -385,6 +397,25 @@ def demanded_buckets() -> List[int]:
     return sorted({b for (_c, b) in list(_DEMAND.keys())})
 
 
+def note_hint(program: str, bucket: int) -> bool:
+    """Predicted demand from the admission scheduler: mark a
+    (program, bucket) pair worth pre-warming even though no tenant
+    query has demanded it yet.  Pairs the demand ledger already saw
+    are dropped (nothing left to predict).  Returns True when the
+    hint was accepted."""
+    if program not in BUCKETED_PROGRAMS:
+        raise ValueError(f"unregistered bucketed program: {program}")
+    global _HINTS_NOTED
+    if not _ENABLED:
+        return False
+    pair = (program, int(bucket))
+    if pair in _DEMAND_SEEN:
+        return False
+    _HINTS.add(pair)
+    _HINTS_NOTED += 1
+    return True
+
+
 # ---------------------------------------------------------------------------
 # warmup registry
 # ---------------------------------------------------------------------------
@@ -437,8 +468,13 @@ def warm_candidates() -> List[Tuple[str, str, int]]:
     buckets = demanded_buckets()
     out = []
     for program in sorted(_WARMERS.keys()):
+        # hinted buckets extend the observed mix per program: the
+        # scheduler predicted this pair, so pre-warm it even though
+        # the ledger has never seen the bucket
+        hinted = sorted({b for (p, b) in _HINTS if p == program})
+        merged = sorted(set(buckets) | set(hinted))
         for variant in list(_WARMERS[program].keys()):
-            for b in buckets:
+            for b in merged:
                 if (program, variant, b) not in _WARMED:
                     out.append((program, variant, b))
     return out
@@ -450,9 +486,14 @@ def warm_one(program: str, variant: str, bucket: int) -> bool:
     retry-storm the background thread.  A successful warm also marks
     the (program, bucket) pair demand-seen: the next tenant demand
     against it counts as a hit."""
-    global _WARMUP_TOTAL, _WARMUP_FAILED
+    global _WARMUP_TOTAL, _WARMUP_FAILED, _HINT_COMPILES
     warm = _WARMERS.get(program, {}).get(variant)
     _WARMED.add((program, variant, bucket))
+    # hint-origin = the pair reached the candidate set ONLY through a
+    # scheduler prediction (never organically demanded)
+    hint_origin = (program, bucket) in _HINTS and \
+        (program, bucket) not in _DEMAND
+    _HINTS.discard((program, bucket))
     if warm is None:
         return False
     try:
@@ -464,7 +505,11 @@ def warm_one(program: str, variant: str, bucket: int) -> bool:
         return False
     _WARMUP_TOTAL += 1
     _DEMAND_SEEN.add((program, bucket))
-    AOT_WARMUP_COMPILES.labels(program=program).inc()
+    if hint_origin:
+        _HINT_COMPILES += 1
+        AOT_HINT_COMPILES.labels(program=program).inc()
+    else:
+        AOT_WARMUP_COMPILES.labels(program=program).inc()
     flight.record(flight.EV_COMPILE, "warmup", bucket, 1)
     return True
 
@@ -508,6 +553,9 @@ def stats_section() -> Dict:
         "warmers": {p: len(v) for p, v in sorted(_WARMERS.items())},
         "warmup_compiles": _WARMUP_TOTAL,
         "warmup_failed": _WARMUP_FAILED,
+        "hints_noted": _HINTS_NOTED,
+        "hints_pending": len(_HINTS),
+        "hint_compiles": _HINT_COMPILES,
     }
 
 
@@ -516,6 +564,7 @@ def reset() -> None:
     lattice (keeps the process usable for unbucketed baselines)."""
     global _ENABLED, _LATTICE, _CACHE_DIR, _XLA_CACHE_WIRED, _CONF_FP
     global _WARMUP_TOTAL, _WARMUP_FAILED, _MANIFEST_DIRTY
+    global _HINTS_NOTED, _HINT_COMPILES
     from ..columnar import column as _col
     with _LOCK:
         _MANIFEST.clear()
@@ -525,6 +574,9 @@ def reset() -> None:
     _DEMAND_CTR.clear()
     _WARMERS.clear()
     _WARMED.clear()
+    _HINTS.clear()
+    _HINTS_NOTED = 0
+    _HINT_COMPILES = 0
     _WARMUP_TOTAL = 0
     _WARMUP_FAILED = 0
     _ENABLED = True

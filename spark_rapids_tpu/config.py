@@ -845,6 +845,51 @@ PIPELINE_BUFFER_BYTES = conf_bytes(
     "additionally capped at half the free device tier, so prefetch "
     "never plans to out-buffer what the arena could hold without "
     "forced spilling")
+CACHE_PLAN_ENABLED = conf_bool(
+    "spark.rapids.tpu.cache.plan.enabled", True,
+    "Fingerprint-keyed plan cache (cache/plan_cache.py): repeat query "
+    "shapes — keyed by a literal-normalized logical-plan digest scoped "
+    "to the plan-affecting conf fingerprint — skip the planner's "
+    "analysis passes (CBO costing, the six-pass plan verifier, the "
+    "PV-FLUSH budget prediction) by replaying the certificates "
+    "recorded when the shape was first verified.  Hits are validated "
+    "against the stored physical plan_fingerprint; a conf-fingerprint "
+    "change invalidates the entry and re-runs the full verifier.  The "
+    "cached path is sha-identical to the cold path with PV-FLUSH "
+    "predictions still exact")
+CACHE_PLAN_MAX_ENTRIES = conf_int(
+    "spark.rapids.tpu.cache.plan.maxEntries", 256,
+    "Bound on cached plan shapes (LRU eviction past it).  Each entry "
+    "holds the shape's analysis certificates (verification verdict, "
+    "plan fingerprint, flush-budget contributions), not the physical "
+    "tree itself, so entries are small")
+SERVICE_SCHED_ENABLED = conf_bool(
+    "spark.rapids.tpu.service.sched.enabled", True,
+    "Predictive admission scheduler (service/scheduler.py): predicts "
+    "each submitted query's exec_ms from its plan fingerprint's "
+    "frozen EWMA baseline (obs/anomaly.py), reorders the per-tenant "
+    "admission queue so queries predicted to finish inside the SLO "
+    "target run ahead of predicted breaches, and hands predicted "
+    "(program, bucket) pairs to the AOT warmup daemon as pre-warm "
+    "hints.  Queries without a frozen baseline keep plain FIFO order "
+    "and are never shed predictively")
+SERVICE_SCHED_PREDICT_SHED = conf_bool(
+    "spark.rapids.tpu.service.sched.predictShed.enabled", True,
+    "Shed queries predicted to breach BEFORE they burn device time: "
+    "when the fingerprint's conservative predicted floor (baseline "
+    "mean minus two EWMA sigmas) already exceeds the latency budget "
+    "(the tighter of the query deadline and obs.slo.targetMs) by "
+    "sched.shedMarginPct, submit fails with PredictedBreach and the "
+    "SLO plane records the dedicated predicted_breach cause — "
+    "distinct from queue-overload load shedding.  No-op without a "
+    "frozen baseline or a latency budget (zero false sheds on "
+    "never-seen or in-band work)")
+SERVICE_SCHED_SHED_MARGIN_PCT = conf_float(
+    "spark.rapids.tpu.service.sched.shedMarginPct", 20.0,
+    "Safety margin for predictive shedding: the predicted floor must "
+    "exceed the latency budget by this percentage before a query is "
+    "shed as predicted_breach — absorbs baseline noise so in-band "
+    "workloads are never falsely shed")
 
 
 class TpuConf:

@@ -232,6 +232,25 @@ def should_bundle() -> bool:
 # read-side views
 # ---------------------------------------------------------------------------
 
+def baseline(fingerprint: str, key: str) -> Optional[Tuple[float, float]]:
+    """Frozen EWMA ``(mean, variance)`` of one (fingerprint, key)
+    series, or None while the series is still warming up (the first
+    ``warmupMinRuns`` rows train silently and must never drive
+    decisions).  The one public read path onto the sentinel's model:
+    the predictive admission scheduler (service/scheduler.py) predicts
+    ``exec_ms`` through this accessor, and the sentinel's own fold
+    reads the identical ``_KeyState`` under the identical ``_LOCK`` —
+    snapshot under the lock, decide outside it."""
+    with _LOCK:
+        st = _FPS.get(str(fingerprint))
+        if st is None:
+            return None
+        ks = st.keys.get(key)
+        if ks is None or ks.baseline is None:
+            return None
+        return (ks.baseline, ks.var)
+
+
 def _mode(counts: Dict[str, int]) -> Optional[str]:
     return max(counts, key=counts.get) if counts else None
 

@@ -183,6 +183,37 @@ def render_html() -> str:
     else:
         parts.append("<p class=note>no diagnosed queries yet</p>")
 
+    parts.append("<h2>Plan cache</h2>")
+    try:
+        from ..cache import plan_cache as _plan_cache
+        pc = _plan_cache.stats_section()
+    except Exception:
+        pc = {}
+    if pc.get("hits", 0) or pc.get("misses", 0):
+        parts.append(
+            "<p class=note>"
+            f"entries: {_esc(pc.get('entries', 0))}/"
+            f"{_esc(pc.get('max_entries', 0))} &middot; "
+            f"hits: {_esc(pc.get('hits', 0))} &middot; "
+            f"misses: {_esc(pc.get('misses', 0))} &middot; "
+            f"hit rate: {_esc(pc.get('hit_pct', 0.0))}% &middot; "
+            f"invalidated: {_esc(pc.get('invalidated', 0))} &middot; "
+            f"validation misses: "
+            f"{_esc(pc.get('validation_misses', 0))} &middot; "
+            f"evicted: {_esc(pc.get('evicted', 0))}</p>")
+        top = pc.get("top") or []
+        if top:
+            parts += _table(
+                ["shape digest", "plan fingerprint", "hits",
+                 "planner cold ms", "planner warm ms"],
+                [[_esc(e.get("digest")), _esc(e.get("plan_fingerprint")),
+                  _esc(e.get("hits")), _esc(e.get("cold_ms")),
+                  _esc(e.get("warm_ms") if e.get("warm_ms") is not None
+                       else "-")]
+                 for e in top])
+    else:
+        parts.append("<p class=note>no plan-cache lookups yet</p>")
+
     parts.append("<h2>Tenants (SLO)</h2>")
     try:
         from . import slo as _slo

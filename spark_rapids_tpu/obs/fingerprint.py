@@ -42,6 +42,7 @@ _SKIP_PREFIXES = (
     "spark.rapids.tpu.obs.",
     "spark.rapids.tpu.service.",
     "spark.rapids.tpu.compile.aot.",
+    "spark.rapids.tpu.cache.",
     "spark.rapids.tpu.test.",
     "spark.rapids.tpu.exec.pipeline",
     "spark.rapids.tpu.sql.superstage",
@@ -110,6 +111,135 @@ def plan_fingerprint(phys, conf) -> str:
     longitudinal grouping key."""
     h = hashlib.sha256()
     h.update(plan_shape(phys).encode())
+    h.update(b"\n--conf--\n")
+    h.update(conf_fingerprint(conf).encode())
+    return h.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# logical-plan digest (plan-cache key, computed BEFORE planning)
+# ---------------------------------------------------------------------------
+
+def _expr_sig(e, out: List[str]) -> None:
+    """Literal-normalized expression signature: class + column names +
+    dtypes; Literal VALUES never enter (``x > 5`` and ``x > 7`` share a
+    signature) — the same invariance contract plan_fingerprint keeps on
+    the physical side."""
+    cls = type(e).__name__
+    if cls == "Literal":
+        try:
+            out.append(f"lit:{e.dtype().name}")
+        except Exception:
+            out.append("lit:?")
+        return
+    if cls == "AttributeReference":
+        try:
+            dt = e.dtype().name
+        except Exception:
+            dt = "?"
+        out.append(f"col:{e.col_name}:{dt}")
+        return
+    extra = ""
+    if cls == "Alias":
+        extra = f":{getattr(e, 'alias', '')}"
+    out.append(f"{cls}{extra}(")
+    for c in getattr(e, "children", []) or []:
+        _expr_sig(c, out)
+    out.append(")")
+
+
+def _exprs_sig(exprs) -> str:
+    out: List[str] = []
+    for e in exprs or []:
+        _expr_sig(e, out)
+        out.append(";")
+    return "".join(out)
+
+
+def _logical_members(node) -> str:
+    """The shape-relevant structural members of one logical node —
+    everything that steers the planner toward a different physical tree
+    (join type, aggregate function classes, sort orders, partition
+    arity) with literal values normalized away."""
+    cls = type(node).__name__
+    bits: List[str] = []
+    if cls == "Project":
+        bits.append(_exprs_sig(node.exprs))
+    elif cls == "Filter":
+        bits.append(_exprs_sig([node.condition]))
+    elif cls == "Aggregate":
+        bits.append(_exprs_sig(node.group_exprs))
+        for a in node.aggs:
+            bits.append(f"agg:{type(a.func).__name__}"
+                        f"{'!d' if a.distinct else ''}:"
+                        f"{_exprs_sig(a.func.children)}")
+    elif cls == "Join":
+        bits.append(f"jt:{node.join_type}")
+        bits.append(_exprs_sig(node.left_keys))
+        bits.append(_exprs_sig(node.right_keys))
+        if node.condition is not None:
+            bits.append(_exprs_sig([node.condition]))
+    elif cls == "Sort":
+        for o in node.orders:
+            bits.append(f"ord:{int(o.ascending)}"
+                        f"{int(o.effective_nulls_first)}:"
+                        f"{_exprs_sig([o.expr])}")
+        bits.append(f"g:{int(node.is_global)}")
+    elif cls == "Repartition":
+        bits.append(f"n:{node.num_partitions}")
+        bits.append(_exprs_sig(node.by_exprs or []))
+    elif cls in ("LocalRelation", "Range"):
+        bits.append(f"n:{getattr(node, 'num_partitions', 1)}")
+    elif cls == "Scan":
+        bits.append(f"fmt:{node.fmt}:{len(node.paths)}")
+        bits.append(_exprs_sig(node.pushed_filters))
+    elif cls == "Window":
+        for wf in node.window_funcs:
+            bits.append(f"wf:{type(wf.func).__name__}:"
+                        f"{_exprs_sig(wf.spec.partition_by)}:"
+                        f"{wf.spec.frame[0]}")
+    elif cls == "Expand":
+        bits.append(f"p:{len(node.projections)}")
+    elif cls == "Generate":
+        g = node.generator
+        bits.append(f"gen:{int(getattr(g, 'pos', False))}"
+                    f"{int(getattr(g, 'outer', False))}")
+    return "|".join(bits)
+
+
+def _schema_sig_logical(node) -> str:
+    try:
+        return ",".join(f"{f.dtype.name}{'?' if f.nullable else ''}"
+                        for f in node.schema.fields)
+    except Exception:
+        return "?"
+
+
+def _walk_logical(node, depth: int, out: List[str]) -> None:
+    out.append(f"{depth}:{type(node).__name__}"
+               f"/{len(node.children)}"
+               f"{{{_logical_members(node)}}}"
+               f"[{_schema_sig_logical(node)}]")
+    for child in node.children:
+        _walk_logical(child, depth + 1, out)
+
+
+def logical_shape(logical) -> str:
+    """The canonical literal-normalized shape text of a LOGICAL plan
+    (one line per node, preorder) — the plan cache's key material,
+    computable before any planning work."""
+    lines: List[str] = []
+    _walk_logical(logical, 0, lines)
+    return "\n".join(lines)
+
+
+def logical_digest(logical, conf) -> str:
+    """16-hex digest over (logical shape, conf fingerprint) — the plan
+    cache key (cache/plan_cache.py).  Shares plan_fingerprint's
+    invariance contract: literals/tenants/sessions never move it, any
+    shape or plan-affecting-conf change does."""
+    h = hashlib.sha256()
+    h.update(logical_shape(logical).encode())
     h.update(b"\n--conf--\n")
     h.update(conf_fingerprint(conf).encode())
     return h.hexdigest()[:16]

@@ -315,6 +315,15 @@ AOT_WARMUP_COMPILES = _REGISTRY.counter(
     "pseudo-victim by obs/compile_watch.py",
     labels=("program",))
 
+AOT_HINT_COMPILES = _REGISTRY.counter(
+    "tpu_aot_hint_warmup_compiles_total",
+    "Background warmup compiles whose (program, bucket) pair arrived "
+    "ONLY through a predictive-scheduler pre-warm hint "
+    "(service/scheduler.py -> service/warmup.py note_hint) — never "
+    "organically demanded before the compile; counted separately "
+    "from the admission-driven tpu_aot_warmup_compiles_total",
+    labels=("program",))
+
 COMPILE_PERSISTENT_HITS = _REGISTRY.counter(
     "tpu_compile_persistent_hits_total",
     "First calls satisfied by the persistent executable cache "
@@ -687,8 +696,9 @@ SLO_LATENCY_SECONDS = _REGISTRY.histogram(
 SLO_BREACHES = _REGISTRY.counter(
     "tpu_slo_breaches_total",
     "Queries past spark.rapids.tpu.obs.slo.targetMs by tenant, each "
-    "attributed to exactly one cause "
-    "(shed/deadline/inline_compile/slow_exec)",
+    "attributed to exactly one cause (shed/predicted_breach/deadline/"
+    "inline_compile/slow_exec; predicted_breach = the admission "
+    "scheduler shed the query BEFORE it burned device time)",
     labels=("tenant", "cause"))
 SLO_BURN_MS = _REGISTRY.counter(
     "tpu_slo_burn_ms_total",
@@ -742,6 +752,37 @@ ANOMALY_ACTIVE = _REGISTRY.gauge(
     "Currently open (breached, not yet recovered) anomalies across "
     "all fingerprints and keys",
     fn=lambda: float(_anomaly_mod().active_count()))
+
+
+# -- plan cache + predictive scheduler (cache/plan_cache.py,
+#    service/scheduler.py) --------------------------------------------------
+
+def _plan_cache_mod():
+    from ..cache import plan_cache
+    return plan_cache
+
+
+PLAN_CACHE_EVENTS = _REGISTRY.counter(
+    "tpu_plan_cache_events_total",
+    "Fingerprint-keyed plan-cache lifecycle events "
+    "(cache/plan_cache.py): hit = repeat logical shape replayed its "
+    "stored certificates (verify + PV-FLUSH skipped), miss = cold "
+    "plan + store, validation_miss = rebuilt plan's fingerprint "
+    "diverged from the stored one (fell back to the cold path), "
+    "invalidated = conf-fingerprint change dropped the entry, "
+    "evicted = LRU bound pushed the entry out",
+    labels=("event",))
+PLAN_CACHE_ENTRIES = _REGISTRY.gauge(
+    "tpu_plan_cache_entries",
+    "Plan shapes currently resident in the bounded plan cache",
+    fn=lambda: float(_plan_cache_mod().entry_count()))
+SCHED_PREDICTIONS = _REGISTRY.counter(
+    "tpu_sched_predictions_total",
+    "Admission-time exec_ms predictions by the predictive scheduler "
+    "(service/scheduler.py), by source: baseline = a frozen EWMA "
+    "baseline for the query's fingerprint existed, none = no cache "
+    "entry or no frozen baseline yet (query admitted unranked)",
+    labels=("source",))
 
 
 def compile_cache_event(cache: str, hit: bool, dur_ns: int = 0,

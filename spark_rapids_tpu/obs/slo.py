@@ -16,7 +16,12 @@ module folds those per-query figures into per-tenant accounting:
   (0 = no target: histograms still record, breach counters stay
   silent): every breach is attributed to EXACTLY ONE cause —
 
-  - ``shed``           — admission rejected the query outright;
+  - ``shed``             — admission rejected the query outright
+    (queue depth/bytes overload);
+  - ``predicted_breach`` — the predictive scheduler
+    (service/scheduler.py) shed the query at admission because its
+    fingerprint's learned baseline predicted it would breach —
+    rejected BEFORE burning device time, distinct from load shedding;
   - ``deadline``       — cancelled by its deadline;
   - ``inline_compile`` — the query finished late and its recorded
     inline-compile time covers the overshoot (the compile WAS the
@@ -40,7 +45,8 @@ from typing import Dict, List
 from .registry import SLO_BREACHES, SLO_BURN_MS, SLO_LATENCY_SECONDS
 
 #: breach causes (exactly one per breach; docs/observability.md)
-BREACH_CAUSES = ("shed", "deadline", "inline_compile", "slow_exec")
+BREACH_CAUSES = ("shed", "predicted_breach", "deadline",
+                 "inline_compile", "slow_exec")
 
 _RESERVOIR_CAP = 1 << 14
 
@@ -87,7 +93,9 @@ def record(m) -> None:
 
     cause = None
     if _TARGET_MS > 0:
-        if m.outcome == "shed":
+        if m.outcome == "shed" and "predicted_breach" in (m.error or ""):
+            cause = "predicted_breach"
+        elif m.outcome == "shed":
             cause = "shed"
         elif m.outcome == "cancelled" and "deadline" in (m.error or ""):
             cause = "deadline"

@@ -465,7 +465,17 @@ class Planner:
             "parallelism collapse: %s (plan coalesces to ONE "
             "partition)", why)
 
-    def plan(self, logical: L.LogicalPlan) -> PhysicalPlan:
+    def plan(self, logical: L.LogicalPlan, *,
+             skip_verify: bool = False) -> PhysicalPlan:
+        # ``skip_verify=True`` is the plan cache's certificate-replay
+        # path (cache/plan_cache.py): the full structural pipeline
+        # still runs on the INCOMING logical plan (fresh literals are
+        # correct by construction), but the invariant verifier passes
+        # are skipped because the cached entry carries the verdict of a
+        # fingerprint-identical plan — the caller MUST validate the
+        # rebuilt plan_fingerprint against the stored one before
+        # trusting the result.
+        #
         # ColumnPruning (Catalyst does this before the reference plugin
         # sees the plan): narrow file scans to referenced columns so the
         # readers neither decode nor upload dead columns
@@ -493,8 +503,9 @@ class Planner:
         if self.conf.get(TEST_ENABLED):
             self._assert_all_tpu(phys)
         from ..config import PLAN_VERIFY
-        verify_on = self.conf.get(PLAN_VERIFY) or os.environ.get(
-            "SPARK_RAPIDS_TPU_FORCE_PLAN_VERIFY")
+        verify_on = (not skip_verify) and (
+            self.conf.get(PLAN_VERIFY) or os.environ.get(
+                "SPARK_RAPIDS_TPU_FORCE_PLAN_VERIFY"))
         if verify_on:
             from ..analysis.plan_verify import verify_or_raise
             verify_or_raise(phys)

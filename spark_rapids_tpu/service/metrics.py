@@ -37,6 +37,9 @@ class QueryMetrics:
     retries: int = 0
     outcome: str = "pending"   # completed|failed|cancelled|shed
     error: Optional[str] = None
+    # admission-time exec_ms prediction (service/scheduler.py) — None
+    # when the scheduler had no frozen baseline for this shape
+    predicted_exec_ms: Optional[float] = None
 
     def to_record(self) -> Dict:
         return {
@@ -58,6 +61,9 @@ class QueryMetrics:
             "retries": self.retries,
             "outcome": self.outcome,
             "error": self.error,
+            "predicted_exec_ms": (round(self.predicted_exec_ms, 3)
+                                  if self.predicted_exec_ms is not None
+                                  else None),
         }
 
 

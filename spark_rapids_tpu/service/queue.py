@@ -61,7 +61,8 @@ class FairQueryQueue:
                     self.queued_bytes, self.max_depth, self.max_bytes)
             tenants = self._classes.setdefault(int(item.priority),
                                                OrderedDict())
-            tenants.setdefault(str(item.tenant), deque()).append(item)
+            dq = tenants.setdefault(str(item.tenant), deque())
+            self._insert_ranked(dq, item)
             self.depth += 1
             self.queued_bytes += est
             self._not_empty.notify()
@@ -70,6 +71,29 @@ class FairQueryQueue:
         # minimal)
         _flight.record(_flight.EV_STATE, "queued", a=self.depth,
                        query_id=getattr(item, "query_id", None))
+
+    @staticmethod
+    def _insert_ranked(dq: deque, item) -> None:
+        """Predictive-scheduler ordering inside one tenant's deque:
+        items carry an optional ``_sched_rank`` tier stamped at
+        admission (service/scheduler.py) — 0 = predicted to finish
+        within the SLO target, 1 = no prediction, 2 = predicted breach
+        (admitted anyway).  The deque stays sorted by ascending tier,
+        strictly FIFO within a tier; an unstamped item counts as tier 1,
+        so with the scheduler off every item ties and this degrades to
+        the plain FIFO append it replaced."""
+        rank = getattr(item, "_sched_rank", None)
+        er = 1 if rank is None else int(rank)
+        idx = len(dq)
+        while idx > 0:
+            prev = getattr(dq[idx - 1], "_sched_rank", None)
+            if (1 if prev is None else int(prev)) <= er:
+                break
+            idx -= 1
+        if idx == len(dq):
+            dq.append(item)
+        else:
+            dq.insert(idx, item)
 
     # -- consumer side -----------------------------------------------------
     def take(self, timeout: Optional[float] = None):

@@ -1,0 +1,245 @@
+"""Predictive SLO admission scheduler — learned per-shape costs drive
+admission-time decisions.
+
+The reference's CostBasedOptimizer prices operators with static
+constants; this module turns the idea inside-out at the serving layer:
+every query's fingerprint carries a learned ``exec_ms`` baseline (the
+anomaly sentinel's frozen EWMA, ``obs/anomaly.baseline``), and the
+scheduler consumes it at ``QueryService.submit()`` time, BEFORE any
+device work:
+
+- **predict**: logical shape → plan-cache certificate
+  (``cache/plan_cache.entry_for``) → stored physical
+  ``plan_fingerprint`` → frozen baseline ``(mean, variance)``.  No
+  cached entry or still-warming baseline ⇒ no prediction (the query is
+  admitted unranked; the scheduler NEVER guesses).
+- **reorder**: the prediction ranks the query inside its tenant's
+  admission deque (``FairQueryQueue._insert_ranked``): tier 0 =
+  predicted within the SLO budget, tier 1 = unpredicted, tier 2 =
+  predicted over budget but admitted.  Tenant fairness and priority
+  classes are untouched — ranking only reorders ONE tenant's own
+  waiting queries.
+- **shed**: a query whose conservative prediction FLOOR
+  (mean − 2σ) exceeds its budget — the tighter of its deadline and the
+  SLO target — by more than ``shedMarginPct`` is rejected at admission
+  as :class:`PredictedBreach` (SLO cause ``predicted_breach``,
+  distinct from load shedding): it would breach anyway, so it never
+  burns device time.  The floor/margin/frozen-baseline gates are what
+  make the zero-false-shed property hold on in-band workloads.
+- **pre-warm**: the admitted query's shape maps to the (program,
+  bucket) pairs it will execute; they go to the warmup daemon as
+  hints (``WarmupDaemon.note_hint``) so AOT compiles land before the
+  predicted repeat traffic does.
+- **score**: every terminal query folds its |predicted − actual|
+  error back in (``observe``) — the honesty metric the bench gates as
+  ``predicted_exec_err_pct``.
+
+Pure host arithmetic at admission; lock discipline: counters under
+``self._lock``, predictions and cache peeks outside it (LOCK001).
+"""
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from .errors import ServiceOverloaded
+
+#: conservative floor: how many EWMA standard deviations below the
+#: predicted mean the shed test uses (never shed on a noisy baseline)
+_FLOOR_SIGMA = 2.0
+
+#: bounded sample of |predicted - actual| relative errors
+_ERR_WINDOW = 512
+
+
+class PredictedBreach(ServiceOverloaded):
+    """Admission reject because the query's learned baseline predicts
+    an SLO/deadline breach.  Subclasses :class:`ServiceOverloaded` so
+    existing client back-off handling catches both shed kinds; the
+    message always contains ``predicted_breach`` — the SLO plane's
+    cause attribution keys on it."""
+
+    def __init__(self, message: str, predicted_ms: float,
+                 budget_ms: float):
+        super().__init__(message)
+        self.predicted_ms = predicted_ms
+        self.budget_ms = budget_ms
+
+
+class Decision:
+    """One admission assessment (immutable value object)."""
+
+    __slots__ = ("predicted_ms", "floor_ms", "budget_ms", "rank",
+                 "shed_reason", "hints")
+
+    def __init__(self, predicted_ms: Optional[float] = None,
+                 floor_ms: Optional[float] = None,
+                 budget_ms: Optional[float] = None,
+                 rank: Optional[int] = None,
+                 shed_reason: Optional[str] = None,
+                 hints: Optional[List[Tuple[str, int]]] = None):
+        self.predicted_ms = predicted_ms
+        self.floor_ms = floor_ms
+        self.budget_ms = budget_ms
+        self.rank = rank
+        self.shed_reason = shed_reason
+        self.hints = hints or []
+
+
+class AdmissionScheduler:
+    """Owned by :class:`~spark_rapids_tpu.service.server.QueryService`;
+    one ``assess`` per submit, one ``observe`` per terminal query."""
+
+    def __init__(self, conf):
+        from ..config import (OBS_SLO_TARGET_MS, SERVICE_SCHED_ENABLED,
+                              SERVICE_SCHED_PREDICT_SHED,
+                              SERVICE_SCHED_SHED_MARGIN_PCT)
+        self.enabled = bool(conf.get(SERVICE_SCHED_ENABLED))
+        self.predict_shed = bool(conf.get(SERVICE_SCHED_PREDICT_SHED))
+        self.margin_pct = max(
+            0.0, float(conf.get(SERVICE_SCHED_SHED_MARGIN_PCT)))
+        self.slo_target_ms = float(conf.get(OBS_SLO_TARGET_MS))
+        self._lock = threading.Lock()
+        self._assessed = 0
+        self._predicted = 0
+        self._shed = 0
+        self._ranks: Dict[int, int] = {0: 0, 1: 0, 2: 0}
+        self._errs: deque = deque(maxlen=_ERR_WINDOW)
+
+    # -- admission ---------------------------------------------------------
+
+    def assess(self, logical, conf,
+               deadline_ms: Optional[float]) -> Decision:
+        """Predict this query's ``exec_ms`` from its shape's learned
+        baseline and decide rank / shed / pre-warm hints.  Never
+        raises; a query the model cannot price is admitted unranked."""
+        from ..cache import plan_cache as _plan_cache
+        from ..obs import anomaly as _anomaly
+        from ..obs.registry import SCHED_PREDICTIONS
+        if not self.enabled:
+            return Decision()
+        with self._lock:
+            self._assessed += 1
+        hints = self._prewarm_hints(logical, conf)
+        entry = _plan_cache.entry_for(logical, conf)
+        bl = None
+        if entry is not None:
+            bl = _anomaly.baseline(entry["plan_fingerprint"], "exec_ms")
+        if bl is None:
+            SCHED_PREDICTIONS.labels(source="none").inc()
+            with self._lock:
+                self._ranks[1] += 1
+            return Decision(hints=hints)
+        mean, var = bl
+        predicted = max(0.0, float(mean))
+        floor = max(0.0, predicted
+                    - _FLOOR_SIGMA * math.sqrt(max(float(var), 0.0)))
+        SCHED_PREDICTIONS.labels(source="baseline").inc()
+        budget = self._budget_ms(deadline_ms)
+        if budget is None:
+            # nothing to schedule against: prediction recorded for the
+            # honesty metric, ordering left alone
+            with self._lock:
+                self._predicted += 1
+                self._ranks[1] += 1
+            return Decision(predicted_ms=predicted, hints=hints)
+        rank = 0 if predicted <= budget else 2
+        shed_reason = None
+        if (rank == 2 and self.predict_shed
+                and floor > budget * (1.0 + self.margin_pct / 100.0)):
+            # even the conservative floor clears the budget plus the
+            # safety margin: the query cannot make its SLO — reject it
+            # before it burns device time
+            shed_reason = (
+                f"predicted_breach: baseline exec_ms {predicted:.1f} "
+                f"(floor {floor:.1f}) exceeds budget {budget:.1f}ms "
+                f"by >{self.margin_pct:.0f}%")
+        with self._lock:
+            self._predicted += 1
+            self._ranks[rank] += 1
+            if shed_reason is not None:
+                self._shed += 1
+        return Decision(predicted_ms=predicted, floor_ms=floor,
+                        budget_ms=budget, rank=rank,
+                        shed_reason=shed_reason, hints=hints)
+
+    def _budget_ms(self, deadline_ms: Optional[float]) -> Optional[float]:
+        """The tighter of the query's deadline and the SLO target; None
+        when neither is configured (then nothing is ever shed)."""
+        candidates = [b for b in (deadline_ms, self.slo_target_ms or None)
+                      if b and b > 0]
+        return min(candidates) if candidates else None
+
+    @staticmethod
+    def _prewarm_hints(logical, conf) -> List[Tuple[str, int]]:
+        """Map the logical shape's operator mix to the (program,
+        bucket) pairs its execution will demand — the warmup daemon
+        pre-compiles them before the query (and its repeat traffic)
+        reaches the device."""
+        from ..compile import aot as _aot
+        lat = _aot.lattice()
+        if lat is None or not _aot.enabled():
+            return []
+        try:
+            from ..config import BATCH_SIZE_ROWS
+            bucket = lat.bucket(max(1, int(conf.get(BATCH_SIZE_ROWS))))
+        except Exception:
+            return []
+        names = set()
+        stack = [logical]
+        while stack:
+            node = stack.pop()
+            names.add(type(node).__name__)
+            stack.extend(getattr(node, "children", []) or [])
+        progs = {"staged_compute"}
+        if names & {"Aggregate", "Distinct"}:
+            progs |= {"hash_aggregate_grouped",
+                      "hash_aggregate_whole_stage",
+                      "hash_aggregate_global"}
+        if "Join" in names:
+            progs |= {"join_probe", "join_spec_probe"}
+        if names & {"Project", "Filter"}:
+            progs.add("fused_project")
+        return [(p, bucket) for p in sorted(progs)
+                if p in _aot.BUCKETED_PROGRAMS]
+
+    # -- feedback ----------------------------------------------------------
+
+    def observe(self, m) -> Optional[float]:
+        """Fold one terminal query's predicted-vs-actual error into the
+        honesty window.  Returns the |error| pct, or None when the
+        query carried no prediction or did not complete."""
+        pred = getattr(m, "predicted_exec_ms", None)
+        if pred is None or getattr(m, "outcome", None) != "completed":
+            return None
+        actual = float(getattr(m, "execute_ms", 0.0) or 0.0)
+        err = abs(float(pred) - actual) / max(actual, 1e-6) * 100.0
+        with self._lock:
+            self._errs.append(err)
+        return err
+
+    # -- observability -----------------------------------------------------
+
+    def stats_section(self) -> Dict:
+        """The ``scheduler`` section of ``Service.stats().snapshot()``."""
+        with self._lock:
+            errs = sorted(self._errs)
+            out = {
+                "enabled": self.enabled,
+                "predict_shed": self.predict_shed,
+                "margin_pct": self.margin_pct,
+                "assessed": self._assessed,
+                "predicted": self._predicted,
+                "predicted_breach_shed": self._shed,
+                "ranks": dict(self._ranks),
+            }
+        if errs:
+            out["pred_err_pct"] = {
+                "n": len(errs),
+                "mean": round(sum(errs) / len(errs), 1),
+                "p50": round(errs[len(errs) // 2], 1),
+                "max": round(errs[-1], 1),
+            }
+        return out

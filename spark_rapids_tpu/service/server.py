@@ -29,6 +29,7 @@ from ..config import (TpuConf, set_active, EVENT_LOG_PATH,
                       OBS_WATCHDOG_STALL_S, OBS_DIAG_DIR,
                       OBS_DIAG_MAX_BUNDLES, AOT_WARMUP_ENABLED,
                       AOT_WARMUP_INTERVAL_MS, AOT_WARMUP_MAX_PER_CYCLE)
+from ..cache import plan_cache as _plan_cache
 from ..compile import aot as _aot
 from ..obs import anomaly as _anomaly
 from ..obs import compile_watch as _cwatch
@@ -44,12 +45,12 @@ from ..obs import trace as _trace
 from ..obs.registry import (QUEUE_WAIT_SECONDS, SERVICE_INFLIGHT,
                             SERVICE_QUEUE_DEPTH, SERVICE_QUEUED_BYTES)
 from ..plan import logical as L
-from ..plan.overrides import Planner
 from .cancellation import CancelToken, query_context
 from .errors import QueryCancelledError, ServiceOverloaded
 from .metrics import QueryMetrics, ServiceStats
 from .queue import FairQueryQueue
 from .retry import RetryPolicy
+from .scheduler import AdmissionScheduler, PredictedBreach
 
 QUEUED, RUNNING, DONE, FAILED, CANCELLED = (
     "QUEUED", "RUNNING", "DONE", "FAILED", "CANCELLED")
@@ -90,6 +91,9 @@ class QueryHandle:
         # physical tree (diagnostic-bundle plan section)
         self._worker_ident: Optional[int] = None
         self._last_phys = None
+        # admission-scheduler rank tier (queue.py _insert_ranked);
+        # None = unranked (scheduler off or no prediction)
+        self._sched_rank: Optional[int] = None
 
     # -- client API --------------------------------------------------------
     def result(self, timeout: Optional[float] = None):
@@ -180,6 +184,11 @@ class QueryService:
         # service wins, like every other plane)
         _history.configure(conf)
         _anomaly.configure(conf)
+        # plan cache + predictive admission scheduler (cache/
+        # plan_cache.py, service/scheduler.py): repeat shapes skip the
+        # planner tail; learned baselines rank/shed at admission
+        _plan_cache.configure(conf)
+        self.scheduler = AdmissionScheduler(conf)
         # admission-aware AOT warmup daemon (service/warmup.py): watches
         # the (program, bucket) demand ledger and pre-compiles missing
         # bucket executables off the query path
@@ -205,6 +214,8 @@ class QueryService:
             "warmup": self.warmup.state(),
             "history": _history.stats_section(),
             "anomaly": _anomaly.stats_section(),
+            "plan_cache": _plan_cache.stats_section(),
+            "scheduler": self.scheduler.stats_section(),
         })
 
     # -- lifecycle ---------------------------------------------------------
@@ -295,6 +306,37 @@ class QueryService:
         token = CancelToken(query_id, deadline)
         handle = QueryHandle(self, query_id, logical, tenant, priority,
                              est_bytes, token, conf)
+        # predictive admission assessment (service/scheduler.py): rank
+        # the query against its fingerprint's learned exec_ms baseline
+        # and shed a certain breach BEFORE it burns device time
+        decision = None
+        if self.scheduler.enabled:
+            sched_conf = self.session.conf.with_overrides(conf or {})
+            decision = self.scheduler.assess(logical, sched_conf, ms)
+            handle._sched_rank = decision.rank
+            if decision.predicted_ms is not None:
+                handle.metrics.predicted_exec_ms = decision.predicted_ms
+            if decision.shed_reason:
+                self._stats.inc("shed")
+                handle.metrics.outcome = "shed"
+                handle.metrics.error = decision.shed_reason
+                _slo.record(handle.metrics)
+                self._record_terminal(handle.metrics, handle)
+                e = PredictedBreach(decision.shed_reason,
+                                    decision.predicted_ms or 0.0,
+                                    decision.budget_ms or 0.0)
+                handle._finish(FAILED, error=e)
+                _flight.record(_flight.EV_STATE, "shed",
+                               query_id=query_id)
+                bundle = self._maybe_shed_bundle(handle, e)
+                self._events.log_service_event(
+                    "shed", query_id, tenant=tenant, priority=priority,
+                    reason=decision.shed_reason,
+                    predicted_exec_ms=round(decision.predicted_ms or 0.0,
+                                            3),
+                    budget_ms=round(decision.budget_ms or 0.0, 3),
+                    diag_bundle=bundle)
+                raise e
         # register BEFORE offering: a fast worker may finish (and
         # _forget) the query before submit() returns
         with self._inflight_lock:
@@ -332,6 +374,11 @@ class QueryService:
             forecast_fits=(est_bytes <= hr["headroom_bytes"]
                            + hr["spillable_bytes"]))
         self.warmup.note_admission(query_id)
+        if decision is not None:
+            # predicted shape-buckets → pre-warm hints: AOT compiles
+            # for the repeat traffic land before the traffic does
+            for prog, bucket in decision.hints:
+                self.warmup.note_hint(prog, bucket)
         return handle
 
     def _cancel_queued(self, handle: QueryHandle):
@@ -452,8 +499,12 @@ class QueryService:
             # client threads' get_active()
             set_active(conf, thread_only=True)
             t0 = time.perf_counter()
-            planner = Planner(conf)
-            phys = planner.plan(handle.logical)
+            # plan through the fingerprint-keyed cache: a repeat shape
+            # replays its stored certificates (verify + PV-FLUSH
+            # skipped, prediction re-attached) instead of the full
+            # planner tail
+            phys, planner = _plan_cache.plan_with_cache(
+                handle.logical, conf)
             handle._last_phys = phys
             table = self.session.execute_physical(
                 phys, conf=conf, fallbacks=planner.fallbacks)
@@ -484,6 +535,10 @@ class QueryService:
         get their side effects here — an ``anomaly`` event-log line
         each, plus a rate-limited diag bundle on breach.  Runs on the
         terminal transition path and must never raise."""
+        try:
+            self.scheduler.observe(m)
+        except Exception:
+            pass
         try:
             row = _history.record(m)
             if row is None:

@@ -54,6 +54,8 @@ class WarmupDaemon:
         self._compiled = 0
         self._skipped_busy = 0
         self._admissions = 0
+        self._hints = 0
+        self._hints_fresh = 0
 
     # -- lifecycle -----------------------------------------------------
 
@@ -85,6 +87,28 @@ class WarmupDaemon:
         with self._lock:
             self._admissions += 1
         self._wake.set()
+
+    def note_hint(self, program: str, bucket: int) -> bool:
+        """External pre-warm hint from the predictive scheduler
+        (service/scheduler.py): a (program, bucket) pair PREDICTED to
+        arrive — from a cached plan shape's node mix — rather than
+        observed in the demand ledger.  Registers it with the aot hint
+        ledger (hint-origin compiles are counted separately:
+        ``tpu_aot_hint_warmup_compiles_total``) and wakes the sweep
+        loop so the compile can land before the predicted query
+        executes.  Returns True when the hint was fresh (enabled, not
+        already organically demanded)."""
+        try:
+            fresh = _aot.note_hint(program, int(bucket))
+        except (ValueError, TypeError):
+            fresh = False
+        with self._lock:
+            self._hints += 1
+            if fresh:
+                self._hints_fresh += 1
+        if fresh:
+            self._wake.set()
+        return fresh
 
     # -- sweep loop ----------------------------------------------------
 
@@ -143,4 +167,6 @@ class WarmupDaemon:
                 "compiled": self._compiled,
                 "skipped_device_busy": self._skipped_busy,
                 "admissions_observed": self._admissions,
+                "hints_observed": self._hints,
+                "hints_fresh": self._hints_fresh,
             }

@@ -202,6 +202,19 @@ def _service_story(service: List[Dict]) -> List[str]:
                 # with tools/diagnose.py)
                 line += f"  bundle={rec['diag_bundle']}"
             out.append(line)
+            pred_ms = rec.get("predicted_exec_ms")
+            if pred_ms is not None:
+                # admission-time prediction vs what actually happened
+                # (service/scheduler.py honesty metric)
+                pline = f"predicted   exec_ms={pred_ms}"
+                actual = rec.get("execute_ms")
+                if kind == "completed" and isinstance(
+                        actual, (int, float)) and actual > 0:
+                    err = abs(float(pred_ms) - float(actual)) \
+                        / float(actual) * 100.0
+                    pline += (f" actual_ms={actual} "
+                              f"err={err:.1f}%")
+                out.append(pline)
     return out
 
 
@@ -586,6 +599,13 @@ def render_query_report(query_id, story: Dict,
                      f"{rec.get('inline_compile_ms')}")
         if rec.get("device_util_pct") is not None:
             head += f" device_util_pct={rec.get('device_util_pct')}"
+        if rec.get("plan_cache") is not None:
+            # plan-cache disposition (cache/plan_cache.py): hit =
+            # verify + PV-FLUSH replayed from the shape's stored
+            # certificates; warm planner_path_ms ≪ cold is the win
+            head += (f" plan_cache={rec.get('plan_cache')} "
+                     f"planner_path_ms="
+                     f"{_fmt(rec.get('planner_path_ms'))}")
         lines.append(head + " --")
         lines.extend(_format_plan(plan_time_shares(rec)))
         if rec.get("fallbacks"):

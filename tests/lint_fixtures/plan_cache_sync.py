@@ -1,0 +1,33 @@
+"""Seeded SYNC001/OBS002/HYG002 fixture shaped like a plan-cache /
+scheduler helper — ``ci/lint.py`` must exit NONZERO.
+
+The plan cache (cache/plan_cache.py) and admission scheduler
+(service/scheduler.py) are pure host bookkeeping over certificates and
+frozen baselines, so their lint scope bans exactly what this helper
+does: a device pull while "validating" a cached plan, a
+flight-recorder event that allocates per lookup, and a wall-clock read
+where a monotonic planner-path timer is required.  Never imported by
+the engine.
+"""
+import time
+
+import jax
+import numpy as np
+
+from spark_rapids_tpu.obs import flight as _flight
+
+
+def bad_lookup(dev, digest):
+    probe = np.asarray(dev).sum()             # SYNC001: materialization
+    sample = jax.device_get(dev)              # SYNC001: host pull
+    _flight.record(_flight.EV_STATE, f"plan_cache:{digest}")  # OBS002
+    t0 = time.time()                          # HYG002: wall clock
+    return probe, sample, t0
+
+
+def good_lookup(entry, baselines):
+    # the cache's real shape: host dict reads over the certificate
+    # already in hand, interned event names, counts as int kwargs
+    _flight.record(_flight.EV_STATE, "plan_cache",
+                   a=int(entry.get("hits", 0)))
+    return baselines.get(entry.get("plan_fingerprint"))

@@ -1,0 +1,576 @@
+"""Plan-cache + predictive-scheduler tests (cache/plan_cache.py +
+service/scheduler.py).
+
+Six surfaces:
+
+1. Correctness — cache-on results are sha-identical to cache-off
+   across pipelineParallelism {1,4} x superstage on/off, including a
+   hit whose literals differ from the entry's cold run (the
+   literal-normalized key contract).
+2. Certificate replay — a hit replays the stored FlushPrediction
+   EXACTLY (runtime FLUSH_COUNT delta == predicted), skipping the
+   verifier and the flush-budget walk.
+3. Lifecycle — conf-fingerprint invalidation, bounded LRU eviction,
+   and the validation-miss safety net (a poisoned certificate is never
+   trusted).
+4. Scheduler — frozen-baseline predictions (obs/anomaly.baseline),
+   rank tiers inside FairQueryQueue, predicted-breach shed vs deadline
+   breach as DISTINCT SLO causes, and the zero-false-shed gates.
+5. Pre-warm hints — shape → (program, bucket) mapping into the AOT
+   warmup daemon, hint-origin compiles counted separately.
+6. Hygiene — lint scopes extended to both new modules + the seeded
+   fixture, and report/dashboard rendering (placeholder-tolerant on
+   pre-r16 event logs).
+"""
+import hashlib
+import json
+import os
+import time
+import types
+
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.cache import plan_cache
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar import pending
+from spark_rapids_tpu.compile import aot
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.obs import anomaly, slo
+from spark_rapids_tpu.service.errors import ServiceOverloaded
+from spark_rapids_tpu.service.queue import FairQueryQueue
+from spark_rapids_tpu.service.scheduler import (AdmissionScheduler,
+                                                PredictedBreach)
+from spark_rapids_tpu.service.server import QueryService
+from spark_rapids_tpu.service.warmup import WarmupDaemon
+from spark_rapids_tpu.udf import pandas_udf
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _plan_cache_reset():
+    """Isolate the process-wide cache/scheduler planes (and restore the
+    default config afterwards — last-configured service wins)."""
+    plan_cache.reset()
+    anomaly.reset()
+    slo.reset()
+    aot.reset()
+    yield
+    default = TpuConf({})
+    plan_cache.configure(default)
+    anomaly.configure(default)
+    slo.configure(default)
+    plan_cache.reset()
+    anomaly.reset()
+    slo.reset()
+    aot.reset()
+
+
+def _session(extra=None):
+    settings = {"spark.rapids.tpu.sql.enabled": True,
+                "spark.rapids.tpu.sql.shuffle.partitions": 4}
+    settings.update(extra or {})
+    return TpuSession(TpuConf(settings))
+
+
+def _df(s, lit=5):
+    return s.range(0, 256, num_partitions=2) \
+        .select((F.col("id") % 7).alias("k"), F.col("id").alias("v")) \
+        .filter(F.col("v") > lit) \
+        .group_by("k").agg(F.sum("v").alias("sv"))
+
+
+def _sha(rows):
+    return hashlib.sha256(
+        json.dumps(sorted(str(r) for r in rows)).encode()).hexdigest()
+
+
+def _seed_baseline(fp, exec_ms, n=10):
+    """Freeze an EWMA exec_ms baseline for ``fp`` (constant series:
+    baseline == exec_ms, variance == 0 — the conservative floor equals
+    the mean, so shed decisions in tests are deterministic)."""
+    for _ in range(n):
+        anomaly.fold({"fingerprint": fp, "exec_ms": float(exec_ms),
+                      "flushes": 1})
+
+
+# ---------------------------------------------------------------------------
+# 1. correctness: cache-on == cache-off, literals free to differ
+# ---------------------------------------------------------------------------
+
+class TestCacheCorrectness:
+    @pytest.mark.parametrize("pp", [1, 4])
+    @pytest.mark.parametrize("ss", [True, False])
+    def test_hit_sha_identical_to_cache_off(self, pp, ss):
+        base = {"spark.rapids.tpu.exec.pipelineParallelism": pp,
+                "spark.rapids.tpu.sql.superstage": ss}
+        off = _session(dict(base,
+                            **{"spark.rapids.tpu.cache.plan.enabled":
+                               False}))
+        sha_off = _sha(_df(off, lit=50).collect())
+        assert off.last_query_plan_cache is None
+
+        on = _session(base)
+        _df(on, lit=5).collect()                      # cold: stores
+        assert on.last_query_plan_cache[0] == "miss"
+        rows = _df(on, lit=50).collect()              # DIFFERENT literal
+        assert on.last_query_plan_cache[0] == "hit"
+        assert _sha(rows) == sha_off
+        st = plan_cache.stats_section()
+        assert st["hits"] == 1 and st["misses"] == 1
+        assert st["hit_pct"] == 50.0
+
+    def test_shape_change_is_a_miss(self):
+        s = _session()
+        _df(s).collect()
+        s.range(0, 256, num_partitions=2) \
+            .select((F.col("id") % 7).alias("k"), F.col("id").alias("v")) \
+            .group_by("k").agg(F.sum("v").alias("sv"),
+                               F.count("v").alias("cv")).collect()
+        assert s.last_query_plan_cache[0] == "miss"
+        assert plan_cache.stats_section()["hits"] == 0
+        assert plan_cache.entry_count() == 2
+
+
+# ---------------------------------------------------------------------------
+# 2. certificate replay: PV-FLUSH stays exact on the cached path
+# ---------------------------------------------------------------------------
+
+class TestFlushReplay:
+    def _proj(self, s, lit):
+        # a shape the PV-FLUSH model covers exactly (single pipeline,
+        # no exchange) — predicted == runtime delta holds bit-exact
+        return s.range(0, 256, num_partitions=2) \
+            .select((F.col("id") % 7).alias("k"),
+                    F.col("id").alias("v")) \
+            .filter(F.col("v") > lit)
+
+    def test_hit_replays_exact_flush_count(self):
+        s = _session()
+        self._proj(s, 5).collect()                    # cold
+        self._proj(s, 25).collect()                   # hit: warms caches
+        assert s.last_query_plan_cache[0] == "hit"
+        f0 = pending.FLUSH_COUNT
+        self._proj(s, 50).collect()                   # measured hit
+        delta = pending.FLUSH_COUNT - f0
+        assert s.last_query_plan_cache[0] == "hit"
+        assert s.last_query_predicted_flushes is not None
+        assert delta == s.last_query_predicted_flushes
+        assert s.last_query_flushes == s.last_query_predicted_flushes
+
+    def test_replayed_prediction_matches_cold_path(self):
+        # replay fidelity on an exchange-bearing shape: a hit reports
+        # EXACTLY the prediction and runtime cost the cold path did
+        s = _session()
+        _df(s, lit=5).collect()                       # cold
+        pred_cold = s.last_query_predicted_flushes
+        flushes_cold = s.last_query_flushes
+        _df(s, lit=50).collect()                      # hit
+        assert s.last_query_plan_cache[0] == "hit"
+        assert s.last_query_predicted_flushes == pred_cold
+        assert s.last_query_flushes == flushes_cold
+
+    def test_warm_planner_path_recorded(self):
+        s = _session()
+        _df(s, lit=5).collect()
+        cold_ms = s.last_query_plan_cache[1]
+        _df(s, lit=50).collect()
+        warm_ms = s.last_query_plan_cache[1]
+        assert cold_ms > 0 and warm_ms > 0
+        top = plan_cache.top_entries(1)[0]
+        assert top["hits"] == 1
+        assert top["cold_ms"] == pytest.approx(cold_ms, abs=0.01)
+        assert top["warm_ms"] == pytest.approx(warm_ms, abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# 3. lifecycle: invalidation, bounded eviction, validation miss
+# ---------------------------------------------------------------------------
+
+class TestCacheLifecycle:
+    def test_conf_fingerprint_change_invalidates(self):
+        _df(_session()).collect()
+        assert plan_cache.entry_count() == 1
+        # a plan-affecting conf moved: stored certificates out of scope
+        _df(_session({"spark.rapids.tpu.sql.batchSizeRows":
+                      1 << 19})).collect()
+        st = plan_cache.stats_section()
+        assert st["invalidated"] == 1
+        assert st["misses"] == 2 and st["hits"] == 0
+        assert plan_cache.entry_count() == 1
+
+    def test_obs_conf_overlay_does_not_invalidate(self):
+        _df(_session()).collect()
+        s2 = _session({"spark.rapids.tpu.obs.slo.targetMs": 250.0})
+        _df(s2, lit=50).collect()
+        assert s2.last_query_plan_cache[0] == "hit"
+        assert plan_cache.stats_section()["invalidated"] == 0
+
+    def test_bounded_lru_eviction(self):
+        s = _session({"spark.rapids.tpu.cache.plan.maxEntries": 2})
+        base = s.range(0, 256, num_partitions=2) \
+            .select((F.col("id") % 7).alias("k"), F.col("id").alias("v"))
+        base.filter(F.col("v") > 5).group_by("k") \
+            .agg(F.sum("v").alias("sv")).collect()
+        base.group_by("k").agg(F.sum("v").alias("sv"),
+                               F.count("v").alias("cv")).collect()
+        base.filter(F.col("v") > 5).group_by("k") \
+            .agg(F.count("v").alias("cv")).collect()
+        assert plan_cache.entry_count() <= 2
+        assert plan_cache.stats_section()["evicted"] >= 1
+
+    def test_poisoned_certificate_never_trusted(self):
+        s = _session()
+        expected = _sha(_df(s, lit=50).collect())
+        key = plan_cache.shape_key(_df(s)._plan)
+        with plan_cache._LOCK:
+            plan_cache._ENTRIES[key]["plan_fingerprint"] = "poisoned!"
+        rows = _df(s, lit=50).collect()
+        assert _sha(rows) == expected                 # cold path result
+        assert s.last_query_plan_cache[0] == "miss"
+        st = plan_cache.stats_section()
+        assert st["validation_misses"] == 1
+        # the shape re-stored with its REAL fingerprint: next repeat hits
+        _df(s, lit=25).collect()
+        assert s.last_query_plan_cache[0] == "hit"
+
+
+# ---------------------------------------------------------------------------
+# 4. scheduler: baseline accessor, assess, queue ranking, SLO causes
+# ---------------------------------------------------------------------------
+
+class TestBaselineAccessor:
+    def test_none_until_frozen_then_mean_var(self):
+        assert anomaly.baseline("nofp", "exec_ms") is None
+        for _ in range(7):
+            anomaly.fold({"fingerprint": "fpX", "exec_ms": 100.0})
+        assert anomaly.baseline("fpX", "exec_ms") is None   # warming
+        anomaly.fold({"fingerprint": "fpX", "exec_ms": 100.0})
+        mean, var = anomaly.baseline("fpX", "exec_ms")
+        assert abs(mean - 100.0) < 1e-6
+        assert var >= 0.0
+        assert anomaly.baseline("fpX", "queue_ms") is None  # other key
+
+
+class TestSchedulerAssess:
+    def _seeded(self, exec_ms=5000.0):
+        s = _session()
+        df = _df(s)
+        df.collect()
+        _seed_baseline(s.last_query_fingerprint, exec_ms)
+        return s, df
+
+    def test_predicted_breach_shed_over_tight_budget(self):
+        s, df = self._seeded(5000.0)
+        sched = AdmissionScheduler(s.conf.with_overrides(
+            {"spark.rapids.tpu.obs.slo.targetMs": 100.0}))
+        d = sched.assess(df._plan, s.conf, None)
+        assert abs(d.predicted_ms - 5000.0) < 1.0
+        assert d.rank == 2
+        assert d.budget_ms == 100.0
+        assert "predicted_breach" in d.shed_reason
+        st = sched.stats_section()
+        assert st["predicted_breach_shed"] == 1
+        assert st["ranks"][2] == 1
+
+    def test_in_budget_ranks_zero_no_shed(self):
+        s, df = self._seeded(5000.0)
+        sched = AdmissionScheduler(s.conf.with_overrides(
+            {"spark.rapids.tpu.obs.slo.targetMs": 60000.0}))
+        d = sched.assess(df._plan, s.conf, None)
+        assert d.rank == 0 and d.shed_reason is None
+
+    def test_deadline_is_the_tighter_budget(self):
+        s, df = self._seeded(5000.0)
+        sched = AdmissionScheduler(s.conf.with_overrides(
+            {"spark.rapids.tpu.obs.slo.targetMs": 60000.0}))
+        d = sched.assess(df._plan, s.conf, 50.0)
+        assert d.budget_ms == 50.0
+        assert d.rank == 2 and "predicted_breach" in d.shed_reason
+
+    def test_no_baseline_never_sheds(self):
+        # zero-false-shed gate: an unpredictable query is admitted
+        # unranked no matter how tight the budget is
+        s = _session()
+        df = _df(s)                                   # never planned
+        sched = AdmissionScheduler(s.conf.with_overrides(
+            {"spark.rapids.tpu.obs.slo.targetMs": 0.001}))
+        d = sched.assess(df._plan, s.conf, 0.001)
+        assert d.predicted_ms is None
+        assert d.rank is None and d.shed_reason is None
+
+    def test_no_budget_never_sheds(self):
+        s, df = self._seeded(5000.0)
+        sched = AdmissionScheduler(s.conf)            # targetMs = 0
+        d = sched.assess(df._plan, s.conf, None)
+        assert d.predicted_ms is not None
+        assert d.shed_reason is None and d.rank is None
+
+    def test_disabled_scheduler_is_inert(self):
+        s, df = self._seeded(5000.0)
+        sched = AdmissionScheduler(s.conf.with_overrides(
+            {"spark.rapids.tpu.service.sched.enabled": False,
+             "spark.rapids.tpu.obs.slo.targetMs": 1.0}))
+        d = sched.assess(df._plan, s.conf, 1.0)
+        assert d.predicted_ms is None and d.shed_reason is None
+
+    def test_observe_folds_honesty_error(self):
+        s, df = self._seeded(5000.0)
+        sched = AdmissionScheduler(s.conf)
+        m = types.SimpleNamespace(predicted_exec_ms=120.0,
+                                  outcome="completed", execute_ms=100.0)
+        assert abs(sched.observe(m) - 20.0) < 1e-6
+        assert sched.observe(types.SimpleNamespace(
+            predicted_exec_ms=None, outcome="completed",
+            execute_ms=1.0)) is None
+        err = sched.stats_section()["pred_err_pct"]
+        assert err["n"] == 1 and abs(err["mean"] - 20.0) < 0.11
+
+
+def _q(i, tenant="t1", rank=None, priority=0):
+    return types.SimpleNamespace(tenant=tenant, priority=priority,
+                                 est_bytes=0, query_id=f"q{i}",
+                                 _sched_rank=rank)
+
+
+class TestQueueRanking:
+    def test_ranked_insert_orders_tiers_fifo_within(self):
+        q = FairQueryQueue(max_depth=16)
+        for i, rank in enumerate([2, None, 0, 2, 0, None]):
+            q.offer(_q(i, rank=rank))
+        order = [q.take(timeout=1).query_id for _ in range(6)]
+        assert order == ["q2", "q4", "q1", "q5", "q0", "q3"]
+
+    def test_tenant_fairness_beats_rank(self):
+        # ranking reorders ONE tenant's deque; cross-tenant round-robin
+        # is untouched — t1's predicted breach still dequeues first
+        q = FairQueryQueue(max_depth=16)
+        q.offer(_q(0, tenant="t1", rank=2))
+        q.offer(_q(1, tenant="t2", rank=0))
+        assert q.take(timeout=1).query_id == "q0"
+        assert q.take(timeout=1).query_id == "q1"
+
+    def test_priority_classes_beat_rank(self):
+        q = FairQueryQueue(max_depth=16)
+        q.offer(_q(0, rank=0, priority=0))
+        q.offer(_q(1, rank=2, priority=5))
+        assert q.take(timeout=1).query_id == "q1"
+
+    def test_unstamped_degrades_to_fifo(self):
+        q = FairQueryQueue(max_depth=16)
+        for i in range(4):
+            q.offer(types.SimpleNamespace(tenant="t", priority=0,
+                                          est_bytes=0, query_id=f"q{i}"))
+        order = [q.take(timeout=1).query_id for _ in range(4)]
+        assert order == ["q0", "q1", "q2", "q3"]
+
+
+# ---------------------------------------------------------------------------
+# service integration: predicted-breach vs deadline causes, zero false
+# sheds in-band
+# ---------------------------------------------------------------------------
+
+class TestServiceIntegration:
+    def test_predicted_breach_shed_and_cause(self, tmp_path):
+        ev = str(tmp_path / "events.jsonl")
+        s = _session({"spark.rapids.tpu.obs.slo.targetMs": 50.0,
+                      "spark.rapids.tpu.eventLog.path": ev})
+        df = _df(s)
+        df.collect()                                  # seed cache entry
+        _seed_baseline(s.last_query_fingerprint, 10000.0)
+        with QueryService(s, num_workers=1) as svc:
+            with pytest.raises(PredictedBreach) as ei:
+                svc.submit(df)
+            assert isinstance(ei.value, ServiceOverloaded)
+            assert ei.value.predicted_ms > ei.value.budget_ms
+            snap = svc.stats().snapshot()
+        assert snap["shed"] == 1
+        assert snap["scheduler"]["predicted_breach_shed"] == 1
+        assert snap["plan_cache"]["entries"] == 1
+        causes = slo.stats_section()["tenants"]["default"]["breach_causes"]
+        assert causes == {"predicted_breach": 1}
+        with open(ev) as f:
+            shed = [r for r in (json.loads(l) for l in f)
+                    if r.get("event") == "shed"]
+        assert shed and "predicted_breach" in shed[-1]["reason"]
+        assert shed[-1]["predicted_exec_ms"] == pytest.approx(
+            10000.0, rel=0.01)
+        assert "diag_bundle" in shed[-1]
+
+    def test_deadline_breach_is_a_distinct_cause(self):
+        s = _session({"spark.rapids.tpu.obs.slo.targetMs": 50.0})
+
+        def _slow(series):
+            time.sleep(0.2)
+            return series
+        slow = pandas_udf(_slow, return_type=T.INT64)
+        df = s.range(0, 64, num_partitions=2) \
+            .select(slow(F.col("id")).alias("id"))
+        with QueryService(s, num_workers=1) as svc:
+            h = svc.submit(df, deadline_ms=60)
+            with pytest.raises(Exception):
+                h.result(timeout=60)
+            snap = svc.stats().snapshot()
+        assert snap["scheduler"]["predicted_breach_shed"] == 0
+        causes = slo.stats_section()["tenants"]["default"]["breach_causes"]
+        assert causes.get("deadline") == 1
+        assert "predicted_breach" not in causes
+
+    def test_in_band_traffic_zero_false_sheds(self):
+        s = _session({"spark.rapids.tpu.obs.slo.targetMs": 60000.0})
+        df = _df(s)
+        df.collect()
+        _seed_baseline(s.last_query_fingerprint, 80.0)
+        with QueryService(s, num_workers=1) as svc:
+            h = svc.submit(df)
+            h.result(timeout=60)
+            snap = svc.stats().snapshot()
+        assert snap["shed"] == 0 and snap["completed"] == 1
+        assert h.metrics.predicted_exec_ms == pytest.approx(80.0,
+                                                            rel=0.01)
+        assert h.metrics.to_record()["predicted_exec_ms"] is not None
+        # the honesty loop closed: |predicted - actual| folded in
+        assert snap["scheduler"]["pred_err_pct"]["n"] == 1
+
+    def test_mixed_burst_repeat_shapes_hit(self):
+        s = _session()
+        df = _df(s)
+        with QueryService(s, num_workers=2) as svc:
+            handles = [svc.submit(_df(s, lit=5 + i), tenant=f"t{i % 2}")
+                       for i in range(6)]
+            for h in handles:
+                h.result(timeout=120)
+            snap = svc.stats().snapshot()
+        assert snap["completed"] == 6
+        pc = snap["plan_cache"]
+        assert pc["hits"] >= 5 and pc["misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 5. pre-warm hints
+# ---------------------------------------------------------------------------
+
+class TestPrewarmHints:
+    def test_note_hint_contract(self):
+        s = _session()                                # wires the lattice
+        with pytest.raises(ValueError):
+            aot.note_hint("not_a_program", 1024)
+        assert aot.note_hint("fused_project", 2048) is True
+        assert aot.note_hint("fused_project", 2048) is True  # re-note ok
+        st = aot.stats_section()
+        assert st["hints_noted"] == 2
+        assert st["hints_pending"] == 1
+
+    def test_hinted_bucket_joins_candidates_and_counts(self):
+        _session()
+        compiled = []
+        aot.register_warmer("fused_project", compiled.append)
+        aot.note_hint("fused_project", 4096)
+        cands = aot.warm_candidates()
+        assert ("fused_project", "default", 4096) in cands
+        assert aot.warm_one("fused_project", "default", 4096)
+        assert compiled == [4096]
+        st = aot.stats_section()
+        assert st["hint_compiles"] == 1               # hint-origin
+        assert st["warmup_compiles"] == 1
+        assert st["hints_pending"] == 0
+
+    def test_daemon_note_hint_counts(self):
+        _session()
+        d = WarmupDaemon()
+        assert d.note_hint("fused_project", 2048) is True
+        assert d.note_hint("bogus", 2048) is False    # swallowed
+        st = d.state()
+        assert st["hints_observed"] == 2
+        assert st["hints_fresh"] == 1
+
+    def test_shape_maps_to_programs(self):
+        s = _session()
+        hints = AdmissionScheduler._prewarm_hints(_df(s)._plan, s.conf)
+        progs = {p for p, _ in hints}
+        assert "staged_compute" in progs
+        assert "hash_aggregate_grouped" in progs
+        assert "fused_project" in progs
+        buckets = {b for _, b in hints}
+        assert len(buckets) == 1 and all(b >= 1 for b in buckets)
+
+
+# ---------------------------------------------------------------------------
+# 6. lint scopes + seeded fixture + rendering
+# ---------------------------------------------------------------------------
+
+class TestPlanCacheLint:
+    MODULES = ("spark_rapids_tpu/cache/plan_cache.py",
+               "spark_rapids_tpu/service/scheduler.py")
+
+    def test_new_modules_in_sync_obs_hyg_scopes(self):
+        from spark_rapids_tpu.analysis import lint as AL
+        for rel in self.MODULES:
+            scopes = AL._scopes_for(rel)
+            assert AL.SYNC001 in scopes, rel
+            assert AL.OBS002 in scopes, rel
+            assert AL.HYG002 in scopes, rel
+
+    def test_seeded_fixture_trips_all_three_rules(self):
+        from spark_rapids_tpu.analysis import lint as AL
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "lint_fixtures", "plan_cache_sync.py")
+        with open(path) as f:
+            fs = AL.lint_source(f.read(), path)
+        rules = {f.rule for f in fs}
+        assert {AL.SYNC001, AL.OBS002, AL.HYG002} <= rules
+
+    def test_shipped_modules_lint_clean(self):
+        from spark_rapids_tpu.analysis import lint as AL
+        for rel in self.MODULES:
+            path = os.path.join(REPO_ROOT, rel)
+            with open(path) as f:
+                fs = AL.lint_source(f.read(), rel,
+                                    scopes=AL._scopes_for(rel))
+            assert fs == [], (rel, AL.format_findings(fs))
+
+
+class TestRendering:
+    def test_report_header_shows_plan_cache_disposition(self):
+        from spark_rapids_tpu.tools.report import render_query_report
+        rec = {"wall_ms": 5.0, "plan_cache": "hit",
+               "planner_path_ms": 0.8, "physical_plan": "",
+               "node_metrics": {}}
+        out = render_query_report("q1", {"engine": [rec], "service": []})
+        assert "plan_cache=hit" in out
+        assert "planner_path_ms=0.8" in out
+
+    def test_pre_r16_engine_record_still_renders(self):
+        from spark_rapids_tpu.tools.report import render_query_report
+        rec = {"wall_ms": 5.0, "physical_plan": "", "node_metrics": {}}
+        out = render_query_report("q1", {"engine": [rec], "service": []})
+        assert "plan_cache" not in out
+
+    def test_service_story_predicted_vs_actual(self):
+        from spark_rapids_tpu.tools.report import render_query_report
+        rec = {"event": "completed", "ts": 1.0, "attempts": 1,
+               "queue_wait_ms": 1.0, "execute_ms": 80.0,
+               "sem_wait_ms": 0.0, "spill_bytes": 0,
+               "predicted_exec_ms": 100.0}
+        out = render_query_report("q1", {"engine": [], "service": [rec]})
+        assert "predicted   exec_ms=100.0" in out
+        assert "err=25.0%" in out
+
+    def test_service_story_pre_r16_has_no_predicted_line(self):
+        from spark_rapids_tpu.tools.report import render_query_report
+        rec = {"event": "completed", "ts": 1.0, "attempts": 1,
+               "queue_wait_ms": 1.0, "execute_ms": 80.0,
+               "sem_wait_ms": 0.0, "spill_bytes": 0}
+        out = render_query_report("q1", {"engine": [], "service": [rec]})
+        assert "predicted " not in out
+
+    def test_dashboard_plan_cache_panel(self):
+        from spark_rapids_tpu.obs import dashboard
+        s = _session()
+        _df(s, lit=5).collect()
+        _df(s, lit=50).collect()
+        page = dashboard.render_html()
+        assert "Plan cache" in page
+        assert "hit rate: 50.0%" in page
+        assert plan_cache.top_entries(1)[0]["digest"] in page

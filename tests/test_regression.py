@@ -81,10 +81,11 @@ class TestHistory:
         assert r01.get("value") is not None
         # the newest round carries the full gated key set (the four
         # cold-path keys exist only from r13 on, the three roofline
-        # keys from r14, the three fleet keys from r15)
-        r15 = rounds[15]
+        # keys from r14, the three fleet keys from r15, the four
+        # plan-cache/scheduler keys from r16)
+        r16 = rounds[16]
         for key, _d, _b in R.GATE_KEYS:
-            assert r15.get(key) is not None, key
+            assert r16.get(key) is not None, key
 
     def test_history_table_has_placeholder_rows(self):
         rounds = R.load_history(REPO_ROOT)
@@ -163,15 +164,15 @@ class TestCompare:
 # ---------------------------------------------------------------------------
 
 class TestCommittedBaseline:
-    def test_baseline_values_equal_r15(self):
+    def test_baseline_values_equal_r16(self):
         base = R.load_baseline(BASELINE)
-        assert base["round"] == 15
-        r15 = R.load_round(os.path.join(REPO_ROOT,
-                                        "BENCH_r15.json")).keys
+        assert base["round"] == 16
+        r16 = R.load_round(os.path.join(REPO_ROOT,
+                                        "BENCH_r16.json")).keys
         for key, spec in base["keys"].items():
-            assert spec["value"] == r15[key], key
+            assert spec["value"] == r16[key], key
         # so the committed pair passes the gate by construction
-        assert not R.regressions(R.compare(r15, base))
+        assert not R.regressions(R.compare(r16, base))
 
     def test_true_r12_numbers_pass_the_gate(self, capsys):
         rc = _gate().main(["--current",
@@ -223,7 +224,7 @@ class TestGateCli:
         out_path = tmp_path / "PERF_BASELINE.json"
         monkeypatch.setattr(gate, "BASELINE_PATH", str(out_path))
         rc = gate._seed_baseline(
-            os.path.join(REPO_ROOT, "BENCH_r15.json"))
+            os.path.join(REPO_ROOT, "BENCH_r16.json"))
         assert rc == 0
         reseeded = R.load_baseline(str(out_path))
         committed = R.load_baseline(BASELINE)
