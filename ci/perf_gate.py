@@ -21,6 +21,13 @@ Usage:
   python ci/perf_gate.py --fixture improvement # seeded +50% record; must
                                                # pass AND suggest a
                                                # baseline bump
+  python ci/perf_gate.py --fixture obs_tax     # seeded -5% record; must
+                                               # trip ONLY the 2%-band
+                                               # all_planes_on_vs_off
+                                               # key (the obs-overhead
+                                               # budget; the wide
+                                               # throughput bands let
+                                               # -5% through)
   python ci/perf_gate.py --seed-baseline FILE  # (re)write
                                                # PERF_BASELINE.json from a
                                                # bench record file
@@ -97,6 +104,10 @@ def _fixture(kind: str) -> int:
     (exit 1), which the smoke harness inverts into its own pass.
     ``improvement``: +50% — the gate must pass and print the
     baseline-bump suggestion.
+    ``obs_tax``: -5% on every throughput key — small enough to slip
+    through the 15-18% throughput bands, but the 2%-band
+    ``all_planes_on_vs_off`` ratio MUST trip: the seeded self-test of
+    the observability ≤2%-overhead budget.
 
     The seeded record starts from the newest recorded round's FULL
     key set (so it carries ``util_gap_breakdown`` and the doctor can
@@ -108,9 +119,11 @@ def _fixture(kind: str) -> int:
         scaled = R.seeded_record(base, 0.8)
     elif kind == "improvement":
         scaled = R.seeded_record(base, 1.5)
+    elif kind == "obs_tax":
+        scaled = R.seeded_record(base, 0.95)
     else:
-        print(f"unknown fixture {kind!r}; expected regression or "
-              "improvement", file=sys.stderr)
+        print(f"unknown fixture {kind!r}; expected regression, "
+              "improvement or obs_tax", file=sys.stderr)
         return 2
     newest = _newest_round()
     rec = dict(newest.keys) if newest is not None else {}
@@ -175,7 +188,7 @@ def main(argv) -> int:
     if "--fixture" in argv:
         i = argv.index("--fixture")
         if i + 1 >= len(argv):
-            print("--fixture requires regression|improvement",
+            print("--fixture requires regression|improvement|obs_tax",
                   file=sys.stderr)
             return 2
         return _fixture(argv[i + 1])

@@ -36,6 +36,12 @@ OBS002      flight-recorder ``record()`` call in the device hot path
             always-on, so its hot-path call sites must pass interned
             constants and plain ints only (lazy formatting belongs in
             the reader, obs/diagnostics + tools/diagnose)
+OBS003      allocation in the observability self-meter's record path
+            (``obs/overhead.py`` — functions named ``clock``/
+            ``note*``/``record*``): a dict/list/set/str literal,
+            comprehension, f-string or str-producing call there bills
+            EVERY metered plane call, so the meter's hot functions
+            must stay two clock reads and two preallocated-list writes
 ==========  =============================================================
 
 Suppressions: a finding whose source line (or the line directly above)
@@ -77,9 +83,10 @@ HYG001 = "HYG001"
 HYG002 = "HYG002"
 HYG003 = "HYG003"
 OBS002 = "OBS002"
+OBS003 = "OBS003"
 
 ALL_RULES = (LOCK001, LOCK002, LOCK003, SYNC001, CONF001, CONF002,
-             HYG001, HYG002, HYG003, OBS002)
+             HYG001, HYG002, HYG003, OBS002, OBS003)
 
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([A-Z0-9, ]+)\)")
 
@@ -483,6 +490,43 @@ class _ObsRecordVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _ObsOverheadVisitor(ast.NodeVisitor):
+    """OBS003: allocation inside the self-meter's record path.
+
+    The meter (obs/overhead.py) brackets every default-on plane's hot
+    entry points, so ITS record functions are the hottest observability
+    code in the process — an allocation there is a tax on the tax.
+    Functions named ``clock`` / ``note*`` / ``record*`` must stay
+    allocation-free: the interning discipline is module-level plane-id
+    ints indexing preallocated counter lists.  Reuses the OBS002
+    allocation classifier over every statement of the hot bodies."""
+
+    _HOT_NAME_RE = re.compile(r"^(clock|note\w*|record\w*)$")
+
+    def __init__(self, path: str, tree: ast.AST):
+        self.path = path
+        self.findings: List[Finding] = []
+        self.visit(tree)
+
+    def _check_fn(self, node):
+        for stmt in node.body:
+            why = _ObsRecordVisitor._allocating(stmt)
+            if why:
+                self.findings.append(Finding(
+                    OBS003, self.path, stmt.lineno,
+                    f"self-meter record path ({node.name}) allocates "
+                    f"per call ({why}): the meter brackets every "
+                    f"default-on plane hot path — keep it to interned "
+                    f"plane ids and preallocated counter writes"))
+
+    def visit_FunctionDef(self, node):
+        if self._HOT_NAME_RE.match(node.name):
+            self._check_fn(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
 class _HygieneVisitor(ast.NodeVisitor):
     """HYG001 bare except; HYG002 time.time in obs/; HYG003 exec nodes
     missing output_schema (same-file inheritance only)."""
@@ -741,6 +785,10 @@ def _scopes_for(rel: str) -> Set[str]:
         # path) and their exchange call sites carry the same
         # zero-flush + allocation-free-record contract
         scopes |= {SYNC001, OBS002}
+    if base == "overhead.py":
+        # the self-meter's own record path: an allocation there bills
+        # every metered plane call (the tax on the tax)
+        scopes |= {OBS003}
     if "obs" in parts or base in ("regression.py", "aot.py",
                                   "warmup.py", "bands.py",
                                   "history.py", "plan_cache.py",
@@ -790,6 +838,8 @@ def lint_source(source: str, path: str = "<string>",
         findings += _SyncVisitor(path, tree, check_asarray).findings
     if OBS002 in scopes:
         findings += _ObsRecordVisitor(path, tree).findings
+    if OBS003 in scopes:
+        findings += _ObsOverheadVisitor(path, tree).findings
     hyg = _HygieneVisitor(
         path, tree,
         in_obs=HYG002 in scopes,

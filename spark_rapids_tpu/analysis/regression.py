@@ -113,6 +113,14 @@ GATE_KEYS: Tuple[Tuple[str, str, float], ...] = (
     ("planner_path_ms_cold", "lower", 150.0),
     ("planner_path_ms_warm", "lower", 150.0),
     ("predicted_exec_err_pct", "lower", 400.0),
+    # observability self-cost (obs/overhead.py, bench.py planes-off
+    # stage): headline throughput with every obs plane ON over the
+    # same run with every plane OFF.  A ratio, already normalized, so
+    # the band is DELIBERATELY tight (2% — the ≤2% total-overhead
+    # budget): a 5% obs tax would hide inside the 15% throughput
+    # bands above but trips here (the 0.95 seeded perf-gate fixture
+    # pins exactly that)
+    ("all_planes_on_vs_off", "higher", 2.0),
 )
 
 #: keys scaled by the seeded perf-gate fixtures (throughput-like).

@@ -507,6 +507,26 @@ OBS_STATS_IN_EVENT_LOG = conf_bool(
     "Persist the per-query StatsProfile artifact inside the engine "
     "event-log record (tools/report.py --stats renders it); off keeps "
     "the profile reachable only via session.last_stats_profile")
+OBS_STATS_SAMPLE_EVERY = conf_int(
+    "spark.rapids.tpu.obs.stats.sampleEvery", 4,
+    "Sampling rate of the per-map-batch exchange stats sketch (HLL "
+    "distinct / null counts / key min-max): only every Nth staged map "
+    "batch per exchange runs the sketch program.  Per-partition rows, "
+    "bytes and the skew verdict stay EXACT regardless (they come from "
+    "the split offsets the finalize flush already pulls); sampled "
+    "sketch verdicts are labeled with their rate in the entry's "
+    "'sample' block.  1 forces exact mode (every batch sketched) — "
+    "the test harness forces it via SPARK_RAPIDS_TPU_OBS_STATS_EXACT "
+    "so digest-stability assertions see exact entries")
+OBS_OVERHEAD_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.overhead.enabled", True,
+    "Observability self-metering (obs/overhead.py): per-plane host-"
+    "time meter bracketing each plane's hot-path entry points "
+    "(interned plane ids, preallocated ns counters, zero allocation "
+    "on record), exported as tpu_obs_self_seconds_total{plane} and "
+    "the stats()['obs_overhead'] section so the observability tax is "
+    "attributed per plane, not just measured as one on-vs-off delta. "
+    "The flight recorder is exempt by construction")
 OBS_TIMELINE_ENABLED = conf_bool(
     "spark.rapids.tpu.obs.timeline.enabled", True,
     "Device-utilization timeline (obs/timeline.py): accumulate the "

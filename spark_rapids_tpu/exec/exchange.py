@@ -122,7 +122,8 @@ class TpuShuffleExchange(TpuExec):
             acc = obs_stats.exchange_acc(
                 self, n_red, obs_stats.sketch_registers(conf),
                 obs_stats._row_width(self.output_schema), "shuffle",
-                type(self.partitioner).__name__)
+                type(self.partitioner).__name__,
+                obs_stats.sample_every(conf))
         # flushes forced at this barrier belong to the producing stage:
         # attribute to the fused superstage feeding the exchange when
         # there is one, else to the exchange itself (obs/profile.py)
@@ -147,9 +148,14 @@ class TpuShuffleExchange(TpuExec):
                             # the staged sketch saw the failed
                             # speculative batch; re-stage from the exact
                             # one BEFORE finalize_split forces the redo
-                            # flush, which then resolves it for free
-                            st = obs_stats.stage_exchange_batch(
-                                self.partitioner, checked, acc.m)
+                            # flush, which then resolves it for free.
+                            # force only when a sketch was actually
+                            # staged — a sampling-skipped batch stays
+                            # skipped, keeping acc.sketched consistent
+                            if st is not None:
+                                st = obs_stats.stage_exchange_batch(
+                                    self.partitioner, checked, acc.m,
+                                    acc, force=True)
                     split = self.partitioner.finalize_split(sorted_batch,
                                                             counts)
                     if stats_on:
@@ -177,7 +183,8 @@ class TpuShuffleExchange(TpuExec):
                     profile.dispatch(profile.SITE_SPLIT):
                 split = self.partitioner.split_staged(batch)
                 st = obs_stats.stage_exchange_batch(
-                    self.partitioner, batch, acc.m) if stats_on else None
+                    self.partitioner, batch, acc.m,
+                    acc) if stats_on else None
                 return batch, split, st
 
         # morsel-parallel map drain (exec/pipeline.py): partitions are

@@ -69,12 +69,19 @@ Layers, mirroring the reference plugin's observability story
   busy share as ``device_compute``), Amdahl-modeled headroom per
   candidate fix, and a ranked mapping onto ROADMAP items 1-4.
 
+- ``obs.overhead`` — observability self-metering: a per-plane host-
+  time meter (interned plane ids, preallocated ns counters, zero
+  allocation on record) bracketing each plane's hot-path entry
+  points, exported as ``tpu_obs_self_seconds_total{plane}`` and the
+  ``stats()["obs_overhead"]`` section so the tax every plane above
+  levies is attributed, not just measured as one on-vs-off delta.
+
 The per-query report generator that joins the event log with these
 streams lives in ``tools/report.py`` (the SQL-UI stand-in).
 """
 from . import (trace, registry, prom, flight, timeline,     # noqa: F401
                compile_watch, slo, profile, netplane,       # noqa: F401
-               memplane, costplane, doctor)                 # noqa: F401
+               memplane, costplane, doctor, overhead)       # noqa: F401
 from .registry import get_registry  # noqa: F401
 from .trace import span, traced     # noqa: F401
 

@@ -59,6 +59,9 @@ _ENABLED = True
 _TOP_N = 20
 
 _LOCK = threading.Lock()
+_SEQ = 0                #: compile sequence — advances once per recorded
+                        #: compile (warmup included); read lock-free by
+                        #: obs/profile.py's dispatch_cold routing
 _TOTAL_NS = 0           #: process-wide compile ns (session window deltas;
                         #: warmup + persistent loads deliberately excluded)
 _INLINE_NS = 0          #: subset recorded under an active query context
@@ -89,7 +92,7 @@ def note_compile(cache: str, dur_ns: int, signature: Optional[str] = None,
     compile running while tenant queries are in flight lands under
     the ``warmup`` pseudo-victim instead of charging whichever query
     context happens to be ambient on the thread."""
-    global _TOTAL_NS, _INLINE_NS, _WARMUP_NS
+    global _SEQ, _TOTAL_NS, _INLINE_NS, _WARMUP_NS
     if not _ENABLED:
         return
     from ..compile import aot
@@ -107,6 +110,7 @@ def note_compile(cache: str, dur_ns: int, signature: Optional[str] = None,
            "query_id": tok.query_id if inline else None,
            "end_ns": time.perf_counter_ns()}
     with _LOCK:
+        _SEQ += 1
         if warmup:
             _WARMUP_NS += dur_ns
         else:
@@ -201,6 +205,14 @@ def wrap_miss(cache: str, fn: Callable, signature=None) -> Callable:
 # ---------------------------------------------------------------------------
 # accessors (cold paths: session window deltas, Service.stats())
 # ---------------------------------------------------------------------------
+
+def compile_seq() -> int:
+    """Lock-free read of the compile sequence number: dispatch windows
+    snapshot it to learn whether a compile landed inside them
+    (dispatch_cold routing in obs/profile.py).  An int read is atomic
+    under the GIL — no torn values, worst case one late tick."""
+    return _SEQ
+
 
 def total_ns() -> int:
     """Process-wide compile wall ns.  The session deltas this around

@@ -51,6 +51,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import flight
+from . import overhead as _overhead
 
 MODEL_VERSION = 1
 
@@ -296,6 +297,7 @@ def note_dispatch(cache: str, capacity: int,
     rows-known dispatches so the fraction is exact, never guessed."""
     if not _ENABLED:
         return
+    _mt0 = _overhead.clock()
     key = (cache, int(capacity))
     cell = _DISPATCH.get(key)
     if cell is None:
@@ -309,6 +311,7 @@ def note_dispatch(cache: str, capacity: int,
     if rows is not None:
         cell[1] += 1
         cell[2] += int(rows)
+    _overhead.note(_overhead.P_COST, _mt0)
 
 
 # ---------------------------------------------------------------------------

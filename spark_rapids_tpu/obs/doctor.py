@@ -51,6 +51,7 @@ import json
 import threading
 from typing import Dict, List, Optional
 
+from . import overhead as _overhead
 from .registry import DOCTOR_VERDICTS, TIMELINE_GAP_CAUSES
 
 #: model version — bumped whenever the share model or the
@@ -325,6 +326,7 @@ def diagnose(timeline_summary: Dict, *,
     Called by the session AFTER every plane summary is already
     collected — reads dictionaries only, never touches the device.
     """
+    _mt0 = _overhead.clock()
     util_pct = float(timeline_summary.get("util_pct", 0.0))
     gaps = timeline_summary.get("gaps", {}) or {}
     shares = _normalized_shares(util_pct, gaps)
@@ -377,6 +379,7 @@ def diagnose(timeline_summary: Dict, *,
             pass
     diag = QueryDiagnosis(data)
     _record_verdict(diag)
+    _overhead.note(_overhead.P_DOCTOR, _mt0)
     return diag
 
 

@@ -56,6 +56,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from . import flight
+from . import overhead as _overhead
 from .registry import MEM_LEAKED_TOTAL, MEM_SPILL_SECONDS, MEM_SPILL_SKIPPED
 
 # provenance sites (interned: stamped on entries and ledger rows
@@ -234,6 +235,7 @@ def note_register(nbytes: int, query_id: Optional[str], site: str,
     global _CUR_DEV_BYTES
     if not _ENABLED:
         return
+    _mt0 = _overhead.clock()
     key = (query_id, site, op)
     with _LOCK:
         _inc(key, site, nbytes)
@@ -245,6 +247,7 @@ def note_register(nbytes: int, query_id: Optional[str], site: str,
         _CUR_DEV_BYTES = device_bytes
         if device_bytes > _PEAK["bytes"]:
             _peak_update(device_bytes)
+    _overhead.note(_overhead.P_MEM, _mt0)
 
 
 def note_unregister(nbytes: int, query_id: Optional[str], site: str,
@@ -253,9 +256,11 @@ def note_unregister(nbytes: int, query_id: Optional[str], site: str,
     global _CUR_DEV_BYTES
     if not _ENABLED:
         return
+    _mt0 = _overhead.clock()
     with _LOCK:
         _dec((query_id, site, op), site, nbytes)
         _CUR_DEV_BYTES = device_bytes
+    _overhead.note(_overhead.P_MEM, _mt0)
 
 
 def note_spill(direction: str, buffer_id: str, query_id: Optional[str],
@@ -288,6 +293,8 @@ def note_spill(direction: str, buffer_id: str, query_id: Optional[str],
             _LEDGER_DROPPED += 1
     _note_active(now - dur_ns, now)
     MEM_SPILL_SECONDS.labels(direction=direction).observe(dur_ns / 1e9)
+    # self-meter (obs/overhead.py): the now stamp doubles as meter start
+    _overhead.note(_overhead.P_MEM, now)
 
 
 def note_spill_skipped(reason: str, pinned_count: int,

@@ -48,6 +48,7 @@ import weakref
 from typing import Dict, List, Optional, Tuple
 
 from . import flight
+from . import overhead as _overhead
 from .registry import (SHUFFLE_BOUNCE_DWELL_SECONDS, SHUFFLE_CONN_EVENTS,
                        SHUFFLE_EDGES_EVICTED, SHUFFLE_FETCH_SECONDS,
                        SHUFFLE_HOST_DROP_SECONDS)
@@ -162,6 +163,8 @@ def note_serialize(shuffle_id: int, map_id: int, reduce_id: int,
     _note_active(now - dur_ns, now)
     SHUFFLE_HOST_DROP_SECONDS.labels(phase=PH_SERIALIZE).inc(dur_ns / 1e9)
     flight.record(flight.EV_NET, PH_SERIALIZE, nbytes, dur_ns // 1_000_000)
+    # self-meter: the now stamp above doubles as the meter start
+    _overhead.note(_overhead.P_NET, now)
 
 
 def note_wire(nbytes: int, dur_ns: int) -> None:
@@ -177,6 +180,7 @@ def note_wire(nbytes: int, dur_ns: int) -> None:
     _note_active(now - dur_ns, now)
     SHUFFLE_HOST_DROP_SECONDS.labels(phase=PH_WIRE).inc(dur_ns / 1e9)
     flight.record(flight.EV_NET, PH_WIRE, nbytes, dur_ns // 1_000_000)
+    _overhead.note(_overhead.P_NET, now)
 
 
 def note_deserialize(shuffle_id: int, map_id: int, reduce_id: int,
@@ -199,6 +203,7 @@ def note_deserialize(shuffle_id: int, map_id: int, reduce_id: int,
     SHUFFLE_HOST_DROP_SECONDS.labels(phase=PH_DESERIALIZE).inc(dur_ns / 1e9)
     flight.record(flight.EV_NET, PH_DESERIALIZE, nbytes,
                   dur_ns // 1_000_000)
+    _overhead.note(_overhead.P_NET, now)
 
 
 def note_fetch(peer: str, dur_ns: int, nbytes: int) -> None:
@@ -206,14 +211,18 @@ def note_fetch(peer: str, dur_ns: int, nbytes: int) -> None:
     completed against ``peer`` (cold path: once per peer per read)."""
     if not _ENABLED:
         return
+    _mt0 = _overhead.clock()
     with _LOCK:
-        cell = _FETCH_PEERS.setdefault(peer, [0, 0, 0, 0])
+        cell = _FETCH_PEERS.get(peer)
+        if cell is None:
+            cell = _FETCH_PEERS[peer] = [0, 0, 0, 0]
         cell[0] += 1
         cell[1] += dur_ns
         cell[2] += nbytes
         cell[3] = max(cell[3], dur_ns)
     SHUFFLE_FETCH_SECONDS.labels(peer=peer).observe(dur_ns / 1e9)
     flight.record(flight.EV_NET, "fetch", nbytes, dur_ns // 1_000_000)
+    _overhead.note(_overhead.P_NET, _mt0)
 
 
 def note_conn(event: str) -> None:

@@ -1,0 +1,134 @@
+"""Observability self-metering — the tax collector's own books.
+
+Eight default-on planes instrument every query; ROADMAP item 9 records
+what that buildout grew to cost (``stats_overhead_pct`` 0.07 in r12 ->
+18.2 in r15).  This module prices the observability layer itself, per
+plane, so the tax is *attributed* — not just measured as one global
+on-vs-off delta the bench can report but nobody can act on.
+
+Design (the interning discipline, enforced by lint rule OBS003):
+
+- plane ids are interned module-level ints (``P_STATS`` ...) indexing
+  PREALLOCATED nanosecond/call counter lists — recording is two list
+  writes and two ``perf_counter_ns`` reads, no dict/list/str
+  allocation anywhere on the record path;
+- each plane's hot-path entry points bracket their body with
+  ``t0 = clock()`` / ``note(P_X, t0)``: stats staging
+  (obs/stats.py), timeline note_flush, netplane put/get accounting,
+  memplane register/sweep, costplane dispatch accounting, history row
+  build, doctor assembly.  The flight recorder is exempt by
+  construction — it IS the allocation-free baseline the others are
+  measured against;
+- unsynchronized ``+=`` on the counter cells races benignly under
+  concurrent producers (a lost update shaves nanoseconds off a meter,
+  never off a query) — the profile._DISPATCH discipline, chosen over
+  a lock because a lock here would bill its own cost to every plane;
+- ``clock()`` returns 0 when the meter is disabled, and ``note``
+  treats 0 as "skip", so the disabled path is one module-global read.
+
+Surfaces: ``tpu_obs_self_seconds_total{plane=...}`` (collect-time
+callbacks — scrapes pay the cost, the note path pays nothing),
+``stats()["obs_overhead"]`` via :func:`stats_section`, and the bench's
+per-plane ``obs_self_ms`` breakdown via :func:`snapshot` /
+:func:`delta_ms` around the headline run.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Sequence, Tuple
+
+#: plane name order — the label set of tpu_obs_self_seconds_total and
+#: the key order of every snapshot/section built from the counters
+PLANES = ("stats", "timeline", "net", "mem", "cost", "history",
+          "doctor")
+
+P_STATS = 0
+P_TIMELINE = 1
+P_NET = 2
+P_MEM = 3
+P_COST = 4
+P_HISTORY = 5
+P_DOCTOR = 6
+
+_N = len(PLANES)
+
+_ENABLED = True
+
+#: preallocated per-plane counters (ns / record calls); fixed length,
+#: never reallocated — readers index, writers +=
+_NS = [0] * _N
+_CALLS = [0] * _N
+
+
+def clock() -> int:
+    """Stamp the start of one metered plane-hot-path call.  Returns 0
+    when the meter is off, which ``note`` treats as "skip"."""
+    if not _ENABLED:
+        return 0
+    return time.perf_counter_ns()
+
+
+def note(plane: int, t0: int) -> None:
+    """Close the metered window opened by ``clock()`` (or by any
+    ``perf_counter_ns`` stamp the caller already took) and bill it to
+    ``plane``.  Two list writes; no allocation (OBS003)."""
+    if t0 and _ENABLED:
+        _NS[plane] += time.perf_counter_ns() - t0
+        _CALLS[plane] += 1
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+# ---------------------------------------------------------------------------
+# cold-path readers (registry callbacks, stats(), bench windows)
+# ---------------------------------------------------------------------------
+
+def plane_seconds(plane: str) -> float:
+    """Collect-time callback for tpu_obs_self_seconds_total{plane}."""
+    return _NS[PLANES.index(plane)] / 1e9
+
+
+def snapshot() -> Tuple[int, ...]:
+    """Value snapshot of the per-plane ns counters (bench windows —
+    the FLUSH_COUNT process-wide-counter-delta discipline)."""
+    return tuple(_NS)
+
+
+def delta_ms(since: Sequence[int]) -> Dict[str, float]:
+    """Per-plane self-cost in ms accrued since a ``snapshot()``."""
+    return {PLANES[i]: round((_NS[i] - since[i]) / 1e6, 3)
+            for i in range(_N)}
+
+
+def total_ms() -> float:
+    return round(sum(_NS) / 1e6, 3)
+
+
+def stats_section() -> Dict:
+    """The ``obs_overhead`` block of ``Service.stats()``: where the
+    observability tax lives, by plane."""
+    total_ns = sum(_NS)
+    return {
+        "enabled": bool(_ENABLED),
+        "total_ms": round(total_ns / 1e6, 3),
+        "planes": {
+            PLANES[i]: {"ms": round(_NS[i] / 1e6, 3),
+                        "calls": _CALLS[i]}
+            for i in range(_N)},
+    }
+
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.overhead.*`` conf group."""
+    global _ENABLED
+    from ..config import OBS_OVERHEAD_ENABLED
+    _ENABLED = bool(conf.get(OBS_OVERHEAD_ENABLED))
+
+
+def reset() -> None:
+    """Test hook: zero the counters (lengths never change)."""
+    for i in range(_N):
+        _NS[i] = 0
+        _CALLS[i] = 0

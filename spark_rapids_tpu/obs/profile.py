@@ -41,6 +41,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from . import compile_watch as _cwatch
 from . import flight, timeline
 from .registry import (STATS_ATTRIBUTED_DEVICE_SECONDS,
                        STATS_DISPATCH_SECONDS, STATS_FLUSH_SECONDS)
@@ -51,6 +52,18 @@ SITE_CHAIN_STEP = "chain_step"
 SITE_SPLIT = "split"
 SITE_SPEC_PROBE = "spec_probe"
 SITE_SPEC_REDO = "spec_redo"
+
+# compile-bearing windows route to the site's _cold twin so warm
+# dispatch percentiles stop absorbing first-call compile walls
+# (BENCH_r16 read dispatch_p95_ms = 2155 — that was XLA, not
+# dispatch).  Pre-interned: the routing decision allocates nothing.
+SITE_FLUSH_COLD = "flush_cold"
+_COLD_SITES = {SITE_FLUSH: SITE_FLUSH_COLD,
+               SITE_CHAIN_STEP: "chain_step_cold",
+               SITE_SPLIT: "split_cold",
+               SITE_SPEC_PROBE: "spec_probe_cold",
+               SITE_SPEC_REDO: "spec_redo_cold"}
+_COLD_SUFFIX = "_cold"
 
 _TLS = threading.local()
 
@@ -111,6 +124,21 @@ def _note_dispatch(site: str, dur_ns: int):
         lst.append(dur_ns)
 
 
+def _cold_site(site: str) -> str:
+    cold = _COLD_SITES.get(site)
+    if cold is None:  # unknown caller-defined site: intern once
+        with _DISP_LOCK:
+            cold = _COLD_SITES.setdefault(site, site + _COLD_SUFFIX)
+    return cold
+
+
+#: compile_seq as of the last observed flush — a flush whose window
+#: advanced it carried (or directly followed) an XLA compile and lands
+#: under flush_cold.  One-element list so the benign-race update stays
+#: a plain item write (the _DISPATCH discipline: no lock on this path).
+_FLUSH_SEQ = [0]
+
+
 def _on_flush(dur_ns: int, n_items: int):
     """pending.flush observer: attribute one fused device round trip.
 
@@ -124,34 +152,60 @@ def _on_flush(dur_ns: int, n_items: int):
         sp = stage_profile(node)
         sp.device_ns += dur_ns
         sp.flushes += 1
-    _note_dispatch(SITE_FLUSH, dur_ns)
+    seq = _cwatch.compile_seq()
+    if seq != _FLUSH_SEQ[0]:
+        _FLUSH_SEQ[0] = seq
+        site = SITE_FLUSH_COLD
+    else:
+        site = SITE_FLUSH
+    _note_dispatch(site, dur_ns)
     timeline.note_flush(dur_ns)
     STATS_FLUSH_SECONDS.observe(dur_ns / 1e9)
     STATS_ATTRIBUTED_DEVICE_SECONDS.labels(
         attributed="yes" if node is not None else "no").inc(dur_ns / 1e9)
-    flight.record(flight.EV_STATS, SITE_FLUSH, n_items,
+    flight.record(flight.EV_STATS, site, n_items,
                   dur_ns // 1_000_000)
 
 
-class dispatch:
+class _DispatchCM:
     """Wall-time one explicit dispatch site (speculative probe/redo,
     superstage chain step, exchange split) into the per-site summary
-    and the ``tpu_stats_dispatch_seconds{site}`` histogram."""
+    and the ``tpu_stats_dispatch_seconds{site}`` histogram.  Windows
+    that a compile landed inside (compile_seq advanced) route to the
+    site's ``_cold`` twin."""
 
-    __slots__ = ("site", "t0")
+    __slots__ = ("site", "t0", "c0")
 
     def __init__(self, site: str):
         self.site = site
 
     def __enter__(self):
+        self.c0 = _cwatch.compile_seq()
         self.t0 = time.perf_counter_ns()
         return self
 
     def __exit__(self, *exc):
         dur = time.perf_counter_ns() - self.t0
-        _note_dispatch(self.site, dur)
-        STATS_DISPATCH_SECONDS.labels(site=self.site).observe(dur / 1e9)
+        site = self.site if _cwatch.compile_seq() == self.c0 \
+            else _cold_site(self.site)
+        _note_dispatch(site, dur)
+        STATS_DISPATCH_SECONDS.labels(site=site).observe(dur / 1e9)
         return False
+
+
+def dispatch(site: str) -> _DispatchCM:
+    """Pooled per-(thread, site) timing CM — dispatch attribution used
+    to allocate one CM object per device dispatch; hot loops now reuse
+    a thread-local instance (first use per thread allocates).  Safe
+    because no site self-nests on one thread: a reentered CM would
+    clobber its own ``t0``."""
+    cms = getattr(_TLS, "cms", None)
+    if cms is None:
+        cms = _TLS.cms = {}
+    cm = cms.get(site)
+    if cm is None:
+        cm = cms[site] = _DispatchCM(site)
+    return cm
 
 
 def begin_query() -> Dict[str, int]:
@@ -172,9 +226,13 @@ def _pctl(sorted_ns: List[int], q: float) -> float:
 
 def dispatch_summary(marker: Optional[Dict[str, int]] = None) -> Dict:
     """{site: {count, p50_ms, p95_ms}} over samples recorded since
-    ``marker`` (a ``begin_query()`` snapshot), plus an "all" roll-up."""
+    ``marker`` (a ``begin_query()`` snapshot), plus two roll-ups:
+    "all" over the warm sites only, "cold" over the ``*_cold`` twins
+    (compile-bearing windows) — so ``dispatch_p95_ms`` prices
+    dispatch, not XLA's first call."""
     out: Dict = {}
     merged: List[int] = []
+    merged_cold: List[int] = []
     with _DISP_LOCK:
         sites = [(s, list(lst)) for s, lst in _DISPATCH.items()]
     for site, lst in sorted(sites):
@@ -182,7 +240,8 @@ def dispatch_summary(marker: Optional[Dict[str, int]] = None) -> Dict:
         samples = sorted(lst[lo:])
         if not samples:
             continue
-        merged.extend(samples)
+        (merged_cold if site.endswith(_COLD_SUFFIX)
+         else merged).extend(samples)
         out[site] = {"count": len(samples),
                      "p50_ms": round(_pctl(samples, 0.5), 3),
                      "p95_ms": round(_pctl(samples, 0.95), 3)}
@@ -191,6 +250,11 @@ def dispatch_summary(marker: Optional[Dict[str, int]] = None) -> Dict:
         out["all"] = {"count": len(merged),
                       "p50_ms": round(_pctl(merged, 0.5), 3),
                       "p95_ms": round(_pctl(merged, 0.95), 3)}
+    if merged_cold:
+        merged_cold.sort()
+        out["cold"] = {"count": len(merged_cold),
+                       "p50_ms": round(_pctl(merged_cold, 0.5), 3),
+                       "p95_ms": round(_pctl(merged_cold, 0.95), 3)}
     return out
 
 

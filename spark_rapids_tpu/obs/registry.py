@@ -754,6 +754,30 @@ ANOMALY_ACTIVE = _REGISTRY.gauge(
     fn=lambda: float(_anomaly_mod().active_count()))
 
 
+# -- observability self-metering (obs/overhead.py) --------------------------
+
+def _overhead_mod():
+    from . import overhead
+    return overhead
+
+
+OBS_SELF_SECONDS = _REGISTRY.counter(
+    "tpu_obs_self_seconds_total",
+    "Host time the observability layer spent inside its own hot-path "
+    "entry points, by plane (obs/overhead.py self-meter): stats "
+    "staging, timeline note_flush, netplane put/get accounting, "
+    "memplane register/sweep, costplane dispatch accounting, history "
+    "row build, doctor assembly.  Collect-time callbacks over "
+    "preallocated ns counters — scrapes pay the read, the record path "
+    "pays two clock reads and two list writes.  The flight recorder "
+    "is exempt by construction",
+    labels=("plane",))
+for _plane in ("stats", "timeline", "net", "mem", "cost", "history",
+               "doctor"):
+    OBS_SELF_SECONDS.labels(plane=_plane).set_function(
+        lambda p=_plane: _overhead_mod().plane_seconds(p))
+
+
 # -- plan cache + predictive scheduler (cache/plan_cache.py,
 #    service/scheduler.py) --------------------------------------------------
 

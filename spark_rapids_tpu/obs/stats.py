@@ -41,8 +41,10 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import itertools
 import json
 import logging
+import os
 import threading
 from typing import Dict, List, Optional
 
@@ -51,6 +53,7 @@ import jax
 import jax.numpy as jnp
 
 from . import flight
+from . import overhead as _overhead
 
 _LOG = logging.getLogger("spark_rapids_tpu.obs.stats")
 
@@ -80,6 +83,19 @@ def sketch_registers(conf=None) -> int:
     m = int((conf or get_active()).get(OBS_STATS_SKETCH_REGISTERS))
     m = max(64, m)
     return 1 << (m.bit_length() - 1)   # round down to a power of two
+
+
+def sample_every(conf=None) -> int:
+    """Sketch-sampling period: stage the stats program for the first
+    map batch of each exchange and every Nth after; 1 means exact
+    (every batch).  Rows/bytes/skew stay EXACT regardless — they come
+    free from the split offsets.  The test harness forces exact mode
+    via ``SPARK_RAPIDS_TPU_OBS_STATS_EXACT`` (tests/conftest.py) so
+    stats digests stay deterministic under test."""
+    if os.environ.get("SPARK_RAPIDS_TPU_OBS_STATS_EXACT"):
+        return 1
+    from ..config import get_active, OBS_STATS_SAMPLE_EVERY
+    return max(1, int((conf or get_active()).get(OBS_STATS_SAMPLE_EVERY)))
 
 
 # ---------------------------------------------------------------------------
@@ -159,16 +175,28 @@ def _rows_if_resolved(batch) -> Optional[int]:
     return None
 
 
-def stage_exchange_batch(partitioner, batch,
-                         m: int) -> Optional[ExchangeBatchStats]:
+def stage_exchange_batch(partitioner, batch, m: int, acc=None,
+                         force: bool = False
+                         ) -> Optional[ExchangeBatchStats]:
     """Enqueue the stats program for one map batch (hash exchanges
     only) and stage its outputs.  Lazy device work in the split's own
-    dispatch window — nothing here pulls."""
+    dispatch window — nothing here pulls.
+
+    When ``acc`` is passed, its sampling gate decides whether this
+    batch is sketched at all (every Nth; ``sample_every``): the skip
+    path costs one counter tick and none of the expression/hash/
+    program staging below.  ``force`` bypasses the gate — the
+    speculative-redo path uses it to replace a sketch that was already
+    staged (and counted) for a batch whose table-path assumptions
+    failed, keeping ``acc.sketched`` consistent."""
     global _SKETCH_OK
     from ..shuffle.partitioners import HashPartitioner
     if not _SKETCH_OK or not isinstance(partitioner, HashPartitioner) \
             or not partitioner.key_exprs or batch.capacity == 0:
         return None
+    if acc is not None and not force and not acc.want_sketch():
+        return None
+    _mt0 = _overhead.clock()
     try:
         from ..columnar import pending
         from ..columnar.column import StringColumn
@@ -206,15 +234,18 @@ def stage_exchange_batch(partitioner, batch,
         regs, nulls, wmin, wmax = _stats_prog(
             h, pids, valid, word0, batch.rows_dev,
             partitioner.num_partitions, m)
-        return ExchangeBatchStats(
+        st = ExchangeBatchStats(
             pending.stage(regs), pending.stage(nulls),
             pending.stage(wmin), pending.stage(wmax), key_dtype)
+        _overhead.note(_overhead.P_STATS, _mt0)
+        return st
     except Exception:  # noqa: BLE001 — stats must never fail the query
         with _SKETCH_LOCK:
             if _SKETCH_OK:
                 _SKETCH_OK = False
                 _LOG.warning("exchange stats sketch failed; disabled "
                              "for this process", exc_info=True)
+        _overhead.note(_overhead.P_STATS, _mt0)
         return None
 
 
@@ -225,12 +256,14 @@ def stage_exchange_batch(partitioner, batch,
 
 class ExchangeAcc:
     def __init__(self, nparts: int, m: int, row_width: float, kind: str,
-                 partitioner_name: str):
+                 partitioner_name: str, every: int = 1):
         self.kind = kind
         self.partitioner = partitioner_name
         self.nparts = nparts
         self.m = m
         self.row_width = row_width
+        self.sample_every = max(1, int(every))
+        self._sampler = itertools.count()
         self.rows = np.zeros(nparts, np.int64)
         self.nulls = np.zeros(nparts, np.int64)
         self.regs: Optional[np.ndarray] = None
@@ -240,6 +273,16 @@ class ExchangeAcc:
         self.key_dtype = None
         self.batches = 0
         self.sketched = 0
+
+    def want_sketch(self) -> bool:
+        """Sampling gate (stage_exchange_batch): sketch the first
+        batch and every Nth after.  ``next`` on an itertools.count is
+        a single GIL-atomic tick, so concurrent pipelined map
+        producers need no lock — each staged batch draws exactly one
+        ticket."""
+        if self.sample_every <= 1:
+            return True
+        return next(self._sampler) % self.sample_every == 0
 
     def absorb(self, offsets: np.ndarray,
                handles: Optional[ExchangeBatchStats]):
@@ -264,11 +307,13 @@ class ExchangeAcc:
 
 
 def exchange_acc(node, nparts: int, m: int, row_width: float, kind: str,
-                 partitioner_name: str) -> ExchangeAcc:
+                 partitioner_name: str,
+                 every: Optional[int] = None) -> ExchangeAcc:
     acc = getattr(node, "_stats_acc", None)
     if acc is None:
-        acc = node._stats_acc = ExchangeAcc(nparts, m, row_width, kind,
-                                            partitioner_name)
+        acc = node._stats_acc = ExchangeAcc(
+            nparts, m, row_width, kind, partitioner_name,
+            every if every is not None else sample_every())
     return acc
 
 
@@ -315,13 +360,22 @@ def finish_exchange(node, conf=None) -> Optional[Dict]:
     acc: Optional[ExchangeAcc] = getattr(node, "_stats_acc", None)
     if acc is None:
         return None
+    _mt0 = _overhead.clock()
     from ..config import get_active, OBS_STATS_SKEW_FACTOR
     from .registry import (STATS_EXCHANGES, STATS_LAST_DISTINCT_KEYS,
                            STATS_LAST_SKEW_RATIO, STATS_PARTITION_ROWS,
                            STATS_SKEWED_EXCHANGES)
     factor = float((conf or get_active()).get(OBS_STATS_SKEW_FACTOR))
     skew = _skew_verdict(acc.rows, factor)
-    have_sketch = acc.regs is not None and acc.sketched == acc.batches
+    # exact: every finalized batch carried a resolved sketch.  Under
+    # sampling (obs.stats.sampleEvery > 1) only every Nth did — the
+    # sketch-derived fields then come from the sampled subset and the
+    # entry says so via its "sample" block.  rows/bytes/skew are from
+    # the split offsets and stay exact regardless; null counts are
+    # per-row tallies that cannot be extrapolated honestly, so they
+    # stay exact-mode-only.
+    exact = acc.regs is not None and acc.sketched == acc.batches
+    have_sketch = acc.regs is not None and acc.sketched > 0
     distinct = hll_estimate(acc.regs.max(axis=0)) if have_sketch else None
     entry = {
         "kind": acc.kind,
@@ -329,11 +383,11 @@ def finish_exchange(node, conf=None) -> Optional[Dict]:
         "partitions": [
             {"rows": int(r),
              "bytes": int(round(r * acc.row_width)),
-             "nulls": int(n) if have_sketch else None}
+             "nulls": int(n) if exact else None}
             for r, n in zip(acc.rows, acc.nulls)],
         "rows": int(acc.rows.sum()),
         "est_bytes": int(round(float(acc.rows.sum()) * acc.row_width)),
-        "null_count": int(acc.nulls.sum()) if have_sketch else None,
+        "null_count": int(acc.nulls.sum()) if exact else None,
         "key_min": _decode_word(int(acc.wmin.min()), acc.key_dtype)
         if have_sketch and acc.rows.sum() else None,
         "key_max": _decode_word(int(acc.wmax.max()), acc.key_dtype)
@@ -342,6 +396,10 @@ def finish_exchange(node, conf=None) -> Optional[Dict]:
         else None,
         "skew": skew,
     }
+    if have_sketch and not exact:
+        entry["sample"] = {"every": acc.sample_every,
+                           "sketched": acc.sketched,
+                           "batches": acc.batches}
     node._stats_entry = entry
     STATS_EXCHANGES.labels(kind=acc.kind).inc()
     for r in acc.rows:
@@ -355,6 +413,7 @@ def finish_exchange(node, conf=None) -> Optional[Dict]:
     permille = min(int((ratio or 0.0) * 1000), 10_000_000)
     dist_i = int(distinct or 0)
     flight.record(flight.EV_STATS, _EV_EXCHANGE, permille, dist_i)
+    _overhead.note(_overhead.P_STATS, _mt0)
     return entry
 
 
@@ -491,8 +550,11 @@ def build_profile(phys, query_id=None, flushes: Optional[int] = None,
                   ) -> StatsProfile:
     """Harvest the per-node stats state of an executed plan into one
     StatsProfile.  Read-only over resolved values: never forces a
-    flush (the profile is built AFTER the query's flush window)."""
+    flush (the profile is built AFTER the query's flush window) —
+    and, since r17, after the query's recorded wall clock stops: the
+    session defers this call to event-log write time."""
     from . import profile as _profile
+    _mt0 = _overhead.clock()
     exchanges: List[Dict] = []
     scans: List[Dict] = []
     stages: List[Dict] = []
@@ -525,7 +587,7 @@ def build_profile(phys, query_id=None, flushes: Optional[int] = None,
                     k: round(v * device_ns / 1e6, 3)
                     for k, v in shares.items()},
             })
-    return StatsProfile({
+    prof = StatsProfile({
         "version": StatsProfile.VERSION,
         "query_id": query_id,
         "flushes": flushes,
@@ -534,6 +596,8 @@ def build_profile(phys, query_id=None, flushes: Optional[int] = None,
         "superstages": stages,
         "dispatches": _profile.dispatch_summary(dispatch_marker),
     })
+    _overhead.note(_overhead.P_STATS, _mt0)
+    return prof
 
 
 # ---------------------------------------------------------------------------

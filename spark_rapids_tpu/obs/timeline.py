@@ -68,6 +68,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import flight
+from . import overhead as _overhead
 from .registry import DEVICE_BUSY_SECONDS, TIMELINE_GAP_CAUSES
 
 _ENABLED = True
@@ -108,6 +109,9 @@ def note_flush(dur_ns: int) -> None:
     else:
         _DROPPED += 1
     _BUSY0.inc(dur_ns / 1e9)
+    # self-meter (obs/overhead.py): this call's own host time — the
+    # end stamp above doubles as the meter's start stamp
+    _overhead.note(_overhead.P_TIMELINE, end)
 
 
 def device_busy_wrap(fn, device_ids: Sequence):

@@ -39,6 +39,7 @@ from ..obs import doctor as _doctor
 from ..obs import flight as _flight
 from ..obs import memplane as _memplane
 from ..obs import netplane as _netplane
+from ..obs import overhead as _overhead
 from ..obs import slo as _slo
 from ..obs import timeline as _timeline
 from ..obs import trace as _trace
@@ -178,6 +179,7 @@ class QueryService:
         _memplane.configure(conf)
         _costplane.configure(conf)
         _doctor.configure(conf)
+        _overhead.configure(conf)
         _aot.configure(conf)
         # longitudinal fleet planes: the persistent history store and
         # the online anomaly sentinel it feeds (process-wide, last
@@ -216,6 +218,7 @@ class QueryService:
             "anomaly": _anomaly.stats_section(),
             "plan_cache": _plan_cache.stats_section(),
             "scheduler": self.scheduler.stats_section(),
+            "obs_overhead": _overhead.stats_section(),
         })
 
     # -- lifecycle ---------------------------------------------------------

@@ -243,6 +243,21 @@ def util_lines(rec: Dict) -> List[str]:
     return lines
 
 
+def obs_lines(rec: Dict) -> List[str]:
+    """The observability self-cost line of one engine record: host ms
+    the default-on planes billed to THEMSELVES inside this query's
+    window, with the per-plane split (obs/overhead.py self-meter).
+    Pre-r17 logs carry no ``obs_self`` key and render nothing — the
+    same tolerance convention as the other per-plane sections."""
+    obs = rec.get("obs_self")
+    if not obs:
+        return []
+    planes = obs.get("planes") or {}
+    split = " ".join(f"{k}={_fmt(planes.get(k))}" for k in planes)
+    return ["-- observability self-cost (obs tax) --",
+            f"  obs_self_ms={_fmt(obs.get('total_ms'))}  {split}"]
+
+
 def compile_lines(rec: Dict) -> List[str]:
     """The compile story of one engine record: every compile that
     landed in the query's window, slowest first — the same dur_ms the
@@ -612,6 +627,7 @@ def render_query_report(query_id, story: Dict,
             lines.append("  CPU fallbacks:")
             lines.extend(f"    {f}" for f in rec["fallbacks"])
         lines.extend(util_lines(rec))
+        lines.extend(obs_lines(rec))
         lines.extend(compile_lines(rec))
         if show_shuffle:
             lines.extend(shuffle_lines(rec))

@@ -18,6 +18,13 @@ os.environ.setdefault("SPARK_RAPIDS_TPU_MIN_CAPACITY", "16")
 # as a verifier regression fixture (spark.rapids.tpu.sql.planVerify).
 os.environ.setdefault("SPARK_RAPIDS_TPU_FORCE_PLAN_VERIFY", "1")
 
+# Force EXACT exchange-stats mode: every map batch sketched, no
+# sampling (spark.rapids.tpu.obs.stats.sampleEvery), so stats digests
+# and skew/distinct verdicts stay deterministic under test.  Sampling
+# behavior itself is tested by setting the conf explicitly with an acc
+# built directly (tests/test_obs_overhead.py).
+os.environ.setdefault("SPARK_RAPIDS_TPU_OBS_STATS_EXACT", "1")
+
 # The image's sitecustomize registers the axon TPU backend and forces
 # JAX_PLATFORMS=axon in every interpreter, so the env var alone is not
 # enough — override through the config API after import, before any

@@ -1,0 +1,29 @@
+"""Seeded OBS003 fixture — ``ci/lint.py`` must exit NONZERO.
+
+Self-meter record-path functions shaped like ``obs/overhead.py`` but
+allocating per call: a dict literal where the plane counters should be
+preallocated lists, an f-string label, and an eager ``str()``.  The
+meter brackets every default-on plane's hot entry points, so any
+allocation here is paid on every metered call — a tax on the tax.
+Never imported by the engine.
+"""
+import time
+
+_NS = [0] * 4
+
+
+def note_bad_dict(plane, t0):
+    # per-call dict allocation instead of a preallocated counter list
+    cell = {"plane": plane, "ns": time.perf_counter_ns() - t0}
+    return cell
+
+
+def record_bad_label(plane, t0):
+    name = f"plane:{plane}"
+    _NS[plane] += time.perf_counter_ns() - t0
+    return name
+
+
+def note_good(plane, t0):
+    # the allocation-free shape: interned id, preallocated list write
+    _NS[plane] += time.perf_counter_ns() - t0

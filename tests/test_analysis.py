@@ -532,7 +532,7 @@ class TestCliAndProject:
     @pytest.mark.parametrize("fixture", [
         "lock_inversion.py", "host_sync_kernel.py", "bad_hygiene.py",
         "flight_alloc.py", "superstage_sync.py", "flush_under_lock.py",
-        "memplane_sync.py"])
+        "memplane_sync.py", "obs_overhead.py"])
     def test_cli_nonzero_on_each_seeded_fixture(self, fixture, capsys):
         assert _cli().main([os.path.join(FIXTURES, fixture)]) == 1
         out = capsys.readouterr().out

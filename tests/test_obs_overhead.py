@@ -1,0 +1,468 @@
+"""Observability tax diet tests (obs/overhead.py + the r17 hot-path
+diet across the planes).
+
+Five surfaces:
+
+1. The self-meter — clock/note bill nanoseconds to interned plane
+   counters with zero allocation; disabled mode costs one global read
+   and records nothing; snapshot/delta_ms follow the FLUSH_COUNT
+   counter-delta discipline; plane shares sum exactly to the total.
+2. Query integration — a collected query's event record carries an
+   ``obs_self`` block whose plane keys are the meter's PLANES and
+   whose total is the sum of the shares; the Prometheus exposition
+   exports ``tpu_obs_self_seconds_total{plane=...}`` via collect-time
+   callbacks; a session with the meter off records neither.
+3. The planes-on/planes-off contract — the SAME query with every obs
+   conf disabled returns a sha-identical arrow table and the exact
+   same warm FLUSH_COUNT delta (observability adds zero device round
+   trips and never touches results).
+4. Sketch sampling (obs.stats.sampleEvery) — the want_sketch gate
+   draws every Nth ticket; a sampled exchange entry keeps rows/bytes/
+   skew exact, drops per-row null counts (cannot extrapolate
+   honestly), and labels itself with a ``sample`` block; exact mode
+   (the test-harness default via SPARK_RAPIDS_TPU_OBS_STATS_EXACT)
+   has no label and exact nulls.
+5. The history-writer diet — rows are serialized ONCE caller-side
+   into opaque bytes, the writer drains bursts into batches with one
+   open per batch (the r16 write-p99 regression: dumps+open per row),
+   nothing is lost across a contended burst, and the cold routing of
+   compile-bearing dispatch windows keeps the warm summary clean.
+"""
+import hashlib
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.columnar import pending
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.obs import (compile_watch, history, overhead,
+                                  profile, stats)
+from spark_rapids_tpu.obs.prom import render_text
+from spark_rapids_tpu.obs.registry import get_registry
+from spark_rapids_tpu.service.metrics import QueryMetrics
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: every default-on observability conf, off — the bench's
+#: all_planes_on_vs_off denominator configuration (bench.py run_engine)
+ALL_PLANES_OFF = {
+    "spark.rapids.tpu.obs.trace.enabled": False,
+    "spark.rapids.tpu.obs.flightRecorder.enabled": False,
+    "spark.rapids.tpu.obs.stats.enabled": False,
+    "spark.rapids.tpu.obs.timeline.enabled": False,
+    "spark.rapids.tpu.obs.compile.enabled": False,
+    "spark.rapids.tpu.obs.slo.enabled": False,
+    "spark.rapids.tpu.obs.net.enabled": False,
+    "spark.rapids.tpu.obs.mem.enabled": False,
+    "spark.rapids.tpu.obs.cost.enabled": False,
+    "spark.rapids.tpu.obs.doctor.enabled": False,
+    "spark.rapids.tpu.obs.history.enabled": False,
+    "spark.rapids.tpu.obs.anomaly.enabled": False,
+    "spark.rapids.tpu.obs.overhead.enabled": False,
+}
+
+
+@pytest.fixture(autouse=True)
+def _meter_reset():
+    """The meter is process-global: restore the default-on config and
+    zero the counters around every test."""
+    overhead.configure(TpuConf({}))
+    overhead.reset()
+    yield
+    overhead.configure(TpuConf({}))
+    overhead.reset()
+
+
+# ---------------------------------------------------------------------------
+# 1. self-meter unit
+# ---------------------------------------------------------------------------
+
+class TestMeter:
+    def test_clock_note_bills_one_plane(self):
+        t0 = overhead.clock()
+        assert t0 > 0
+        overhead.note(overhead.P_STATS, t0)
+        sec = overhead.stats_section()
+        assert sec["enabled"] is True
+        assert sec["planes"]["stats"]["calls"] == 1
+        assert sec["planes"]["stats"]["ms"] >= 0.0
+        for plane in overhead.PLANES:
+            if plane != "stats":
+                assert sec["planes"][plane]["calls"] == 0
+
+    def test_note_accepts_caller_stamp(self):
+        # timeline/netplane pass an existing perf_counter_ns stamp so
+        # the close of their own timing window doubles as the meter
+        # start — no extra clock read on those paths
+        stamp = time.perf_counter_ns()
+        overhead.note(overhead.P_NET, stamp)
+        assert overhead.stats_section()["planes"]["net"]["calls"] == 1
+
+    def test_disabled_clock_zero_and_note_skips(self):
+        overhead.configure(TpuConf(
+            {"spark.rapids.tpu.obs.overhead.enabled": False}))
+        assert overhead.is_enabled() is False
+        assert overhead.clock() == 0
+        overhead.note(overhead.P_STATS, 0)           # the clock() path
+        overhead.note(overhead.P_STATS,
+                      time.perf_counter_ns())        # a caller stamp
+        sec = overhead.stats_section()
+        assert sec["enabled"] is False
+        assert all(p["calls"] == 0 for p in sec["planes"].values())
+
+    def test_snapshot_delta_ms_counter_discipline(self):
+        since = overhead.snapshot()
+        assert since == tuple([0] * len(overhead.PLANES))
+        t0 = overhead.clock()
+        overhead.note(overhead.P_HISTORY, t0)
+        d = overhead.delta_ms(since)
+        assert set(d) == set(overhead.PLANES)
+        assert d["history"] >= 0.0
+        assert all(d[p] == 0.0 for p in overhead.PLANES
+                   if p != "history")
+        # a fresh snapshot zeroes the window
+        assert all(v == 0.0 for v in
+                   overhead.delta_ms(overhead.snapshot()).values())
+
+    def test_shares_sum_exactly_to_total(self):
+        for i, _plane in enumerate(overhead.PLANES):
+            t0 = overhead.clock()
+            time.sleep(0.001 * (i % 3 + 1))
+            overhead.note(i, t0)
+        sec = overhead.stats_section()
+        total = sum(p["ms"] for p in sec["planes"].values())
+        # both sides are the same _NS cells — rounding is the only slack
+        assert sec["total_ms"] == pytest.approx(total, abs=0.01)
+        assert overhead.total_ms() == pytest.approx(total, abs=0.01)
+
+    def test_reset_zeroes_without_reallocating(self):
+        ns_list = overhead._NS
+        overhead.note(overhead.P_COST, overhead.clock())
+        overhead.reset()
+        assert overhead._NS is ns_list           # preallocated, kept
+        assert overhead.snapshot() == tuple([0] * len(overhead.PLANES))
+
+
+# ---------------------------------------------------------------------------
+# 2. query integration + export
+# ---------------------------------------------------------------------------
+
+def _small_query(sess):
+    df = sess.range(0, 512, num_partitions=2) \
+        .select((F.col("id") % 7).alias("k"), F.col("id").alias("v")) \
+        .group_by("k").agg(F.sum("v").alias("sv"))
+    return df
+
+
+class TestQueryMetered:
+    def test_event_record_carries_obs_self(self):
+        s = TpuSession(TpuConf({}))
+        _small_query(s).collect()
+        rec = s.last_query_event
+        assert rec is not None and "obs_self" in rec
+        obs = rec["obs_self"]
+        assert set(obs["planes"]) == set(overhead.PLANES)
+        assert obs["total_ms"] == pytest.approx(
+            sum(obs["planes"].values()), abs=0.01)
+        # default-on planes did real work inside this query's window
+        assert obs["total_ms"] >= 0.0
+        assert overhead.stats_section()["planes"]["stats"]["calls"] > 0
+
+    def test_prometheus_export_collect_time(self):
+        overhead.note(overhead.P_MEM, overhead.clock())
+        text = render_text(get_registry())
+        assert "tpu_obs_self_seconds_total" in text
+        for plane in overhead.PLANES:
+            assert f'plane="{plane}"' in text
+
+    def test_meter_off_session_records_nothing(self):
+        s = TpuSession(TpuConf(
+            {"spark.rapids.tpu.obs.overhead.enabled": False}))
+        _small_query(s).collect()
+        rec = s.last_query_event
+        assert rec is not None and "obs_self" not in rec
+        sec = overhead.stats_section()
+        assert all(p["calls"] == 0 for p in sec["planes"].values())
+
+
+# ---------------------------------------------------------------------------
+# 3. planes-on vs planes-off: identical results, identical flushes
+# ---------------------------------------------------------------------------
+
+def _table_sha(t) -> str:
+    import pyarrow as pa
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    return hashlib.sha256(sink.getvalue().to_pybytes()).hexdigest()
+
+
+def _run_mode(conf_extra):
+    """Warm a deterministic query, then return (sha, warm flush
+    delta) — the FLUSH_COUNT process-wide-counter-delta discipline."""
+    s = TpuSession(TpuConf(dict(conf_extra)))
+    df = s.range(0, 4096, num_partitions=4) \
+        .select((F.col("id") % 13).alias("k"), F.col("id").alias("v")) \
+        .filter(F.col("v") % 3 != 0) \
+        .group_by("k").agg(F.sum("v").alias("sv"),
+                           F.count().alias("c")) \
+        .sort("k")
+    df.to_arrow()                                  # warm
+    f0 = pending.FLUSH_COUNT
+    out = df.to_arrow()
+    return _table_sha(out), pending.FLUSH_COUNT - f0
+
+
+class TestPlanesOnOff:
+    def test_results_sha_identical_and_flush_delta_exact(self):
+        sha_on, flushes_on = _run_mode({})
+        sha_off, flushes_off = _run_mode(ALL_PLANES_OFF)
+        assert sha_on == sha_off
+        assert flushes_on == flushes_off
+
+
+# ---------------------------------------------------------------------------
+# 4. sketch sampling
+# ---------------------------------------------------------------------------
+
+class _Resolved:
+    """Stand-in for a resolved pending-pool staged value."""
+
+    def __init__(self, arr):
+        self.np = np.asarray(arr)
+        self.resolved = True
+
+
+def _handles(m=64, nparts=2):
+    return stats.ExchangeBatchStats(
+        _Resolved(np.ones((nparts, m), np.int8)),
+        _Resolved(np.zeros(nparts, np.int64)),
+        _Resolved(np.zeros(nparts, np.uint64)),
+        _Resolved(np.zeros(nparts, np.uint64)),
+        None)
+
+
+class TestSampling:
+    def test_harness_forces_exact_mode(self):
+        # tests/conftest.py sets SPARK_RAPIDS_TPU_OBS_STATS_EXACT so
+        # stats digests stay deterministic under test
+        assert os.environ.get("SPARK_RAPIDS_TPU_OBS_STATS_EXACT")
+        assert stats.sample_every(TpuConf({})) == 1
+
+    def test_sample_every_reads_conf_without_env(self, monkeypatch):
+        monkeypatch.delenv("SPARK_RAPIDS_TPU_OBS_STATS_EXACT",
+                           raising=False)
+        assert stats.sample_every(TpuConf({})) == 4   # default
+        assert stats.sample_every(TpuConf(
+            {"spark.rapids.tpu.obs.stats.sampleEvery": 7})) == 7
+        assert stats.sample_every(TpuConf(
+            {"spark.rapids.tpu.obs.stats.sampleEvery": 0})) == 1
+
+    def test_want_sketch_first_batch_then_every_nth(self):
+        acc = stats.ExchangeAcc(2, 64, 8.0, "shuffle", "hash", every=3)
+        assert [acc.want_sketch() for _ in range(7)] == \
+            [True, False, False, True, False, False, True]
+        exact = stats.ExchangeAcc(2, 64, 8.0, "shuffle", "hash",
+                                  every=1)
+        assert all(exact.want_sketch() for _ in range(5))
+
+    def test_sampled_entry_labeled_rows_exact_nulls_dropped(self):
+        acc = stats.ExchangeAcc(2, 64, 8.0, "shuffle", "hash", every=2)
+        offsets = np.array([0, 5, 9], np.int64)
+        for i in range(4):
+            acc.absorb(offsets, _handles() if i % 2 == 0 else None)
+        node = SimpleNamespace(_stats_acc=acc)
+        entry = stats.finish_exchange(node, conf=TpuConf({}))
+        # rows/bytes/skew from the split offsets: exact regardless
+        assert entry["rows"] == 36
+        assert entry["partitions"][0]["rows"] == 20
+        # per-row null tallies cannot be extrapolated from a sample
+        assert entry["null_count"] is None
+        assert all(p["nulls"] is None for p in entry["partitions"])
+        # sketch-derived fields come from the sampled subset, labeled
+        assert entry["distinct_est"] is not None
+        assert entry["sample"] == {"every": 2, "sketched": 2,
+                                   "batches": 4}
+
+    def test_exact_entry_has_no_sample_label(self):
+        acc = stats.ExchangeAcc(2, 64, 8.0, "shuffle", "hash", every=1)
+        offsets = np.array([0, 5, 9], np.int64)
+        for _ in range(3):
+            acc.absorb(offsets, _handles())
+        node = SimpleNamespace(_stats_acc=acc)
+        entry = stats.finish_exchange(node, conf=TpuConf({}))
+        assert "sample" not in entry
+        assert entry["null_count"] == 0
+        assert entry["partitions"][0]["nulls"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 5. history-writer diet + dispatch cold routing
+# ---------------------------------------------------------------------------
+
+def _metrics(i=0, exec_ms=10.0):
+    m = QueryMetrics(query_id=f"q{i}", tenant="t", priority=0)
+    m.execute_ms = exec_ms
+    m.queue_wait_ms = 1.0
+    m.outcome = "completed"
+    return m
+
+
+@pytest.fixture
+def _history_reset():
+    history.stop()
+    history.reset()
+    yield
+    history.stop()
+    history.configure(TpuConf({}))
+    history.reset()
+
+
+class TestHistoryWriterDiet:
+    def test_rows_serialized_once_caller_side(self, tmp_path,
+                                              _history_reset):
+        history.configure(TpuConf(
+            {"spark.rapids.tpu.obs.history.dir": str(tmp_path)}))
+        history.stop()                 # keep rows queued, writer gone
+        import queue as _pyqueue
+        q = _pyqueue.Queue(16)
+        history._Q = q
+        row = history.record(_metrics(0))
+        data, ts = q.get_nowait()
+        # the writer handles opaque bytes: dumps ran HERE, not in its
+        # timed window (the r16 p99 regression)
+        assert isinstance(data, bytes) and data.endswith(b"\n")
+        assert json.loads(data) == json.loads(
+            json.dumps(row, sort_keys=True))
+        assert ts == row["ts"]
+        history._Q = None
+
+    def test_contended_burst_batches_without_loss(self, tmp_path,
+                                                  _history_reset,
+                                                  monkeypatch):
+        batches = []
+        orig = history._append_batch
+
+        def slow_append(d, batch):
+            batches.append(len(batch))
+            time.sleep(0.002)          # force queue buildup per drain
+            orig(d, batch)
+
+        monkeypatch.setattr(history, "_append_batch", slow_append)
+        history.configure(TpuConf({
+            "spark.rapids.tpu.obs.history.dir": str(tmp_path),
+            "spark.rapids.tpu.obs.history.queueDepth": 4096,
+        }))
+        n_threads, per_thread = 4, 50
+
+        def flood(tid):
+            for i in range(per_thread):
+                history.record(_metrics(tid * per_thread + i))
+
+        threads = [threading.Thread(target=flood, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        history.stop()                 # sentinel drains pending batch
+        total = n_threads * per_thread
+        assert sum(batches) == total, (sum(batches), total)
+        # batching actually happened: far fewer opens than rows
+        assert len(batches) < total / 2, len(batches)
+        # every row landed on disk exactly once, parseable
+        rows = []
+        for seg in sorted(tmp_path.glob("history-*.jsonl")):
+            with open(seg, "r", encoding="utf-8") as f:
+                rows += [json.loads(ln) for ln in f if ln.strip()]
+        assert len(rows) == total
+        assert history.stats_section()["dropped"] == 0
+
+    def test_write_p99_regression_pin(self, tmp_path, _history_reset):
+        """Amortized per-row append cost under a contended burst stays
+        ORDERS below the r16 regression reading (3920us at bench
+        scale); the strict pin is PERF_BASELINE.json's
+        history_write_p99_us band — this is the unit-level guard."""
+        history.configure(TpuConf({
+            "spark.rapids.tpu.obs.history.dir": str(tmp_path),
+            "spark.rapids.tpu.obs.history.queueDepth": 4096,
+        }))
+        for i in range(300):
+            history.record(_metrics(i))
+        history.stop()
+        p99 = history.write_p99_us()
+        assert 0 < p99 < 2000.0, p99
+
+
+class TestDispatchColdRouting:
+    def test_compile_bearing_window_routes_to_cold_twin(self):
+        marker = profile.begin_query()
+        with profile.dispatch(profile.SITE_SPLIT):
+            compile_watch.note_compile("test_cold_route", 1_000_000)
+        summary = profile.dispatch_summary(marker)
+        assert summary["split_cold"]["count"] == 1
+        assert "split" not in summary
+        # warm roll-up excludes the compile-bearing window entirely
+        assert "all" not in summary
+        assert summary["cold"]["count"] == 1
+
+    def test_warm_window_stays_warm_and_all_excludes_cold(self):
+        marker = profile.begin_query()
+        with profile.dispatch(profile.SITE_SPLIT):
+            compile_watch.note_compile("test_cold_route2", 1_000_000)
+        with profile.dispatch(profile.SITE_SPLIT):
+            pass                       # no compile in this window
+        summary = profile.dispatch_summary(marker)
+        assert summary["split"]["count"] == 1
+        assert summary["split_cold"]["count"] == 1
+        assert summary["all"]["count"] == 1
+        assert summary["cold"]["count"] == 1
+
+    def test_dispatch_cm_pooled_per_thread_site(self):
+        cm1 = profile.dispatch(profile.SITE_SPLIT)
+        cm2 = profile.dispatch(profile.SITE_SPLIT)
+        assert cm1 is cm2
+        assert profile.dispatch(profile.SITE_CHAIN_STEP) is not cm1
+
+
+# ---------------------------------------------------------------------------
+# lint rule OBS003 + report surface
+# ---------------------------------------------------------------------------
+
+class TestObs003AndSurfaces:
+    def test_obs003_flags_allocation_in_record_path(self):
+        from spark_rapids_tpu.analysis import lint as AL
+        src = ("def note(plane, t0):\n"
+               "    cell = {'plane': plane}\n"
+               "    return cell\n")
+        findings = AL.lint_source(src, "obs/overhead.py")
+        assert any(f.rule == AL.OBS003 for f in findings), findings
+
+    def test_obs003_clean_on_preallocated_shape(self):
+        from spark_rapids_tpu.analysis import lint as AL
+        src = ("_NS = [0] * 4\n\n"
+               "def note(plane, t0):\n"
+               "    _NS[plane] += t0\n")
+        assert [f for f in AL.lint_source(src, "obs/overhead.py")
+                if f.rule == AL.OBS003] == []
+
+    def test_shipped_meter_lints_clean(self):
+        from spark_rapids_tpu.analysis import lint as AL
+        path = os.path.join(REPO_ROOT, "spark_rapids_tpu", "obs",
+                            "overhead.py")
+        findings = AL.lint_paths([path], scoped=True)
+        assert findings == [], AL.format_findings(findings)
+
+    def test_report_renders_obs_self_line_and_tolerates_old_logs(self):
+        from spark_rapids_tpu.tools.report import obs_lines
+        rec = {"obs_self": {"total_ms": 1.5,
+                            "planes": {"stats": 1.0, "net": 0.5}}}
+        lines = obs_lines(rec)
+        assert any("obs_self_ms=1.5" in ln for ln in lines)
+        assert obs_lines({}) == []     # pre-r17 record: no key, no line
