@@ -203,6 +203,11 @@ def run_engine(enabled: bool, n_rows: int, num_partitions: int,
             # (analysis/flush_budget.py — must equal `flushes`)
             "predicted_flushes": getattr(
                 s, "last_query_predicted_flushes", None),
+            # per-site declared-transfer counts of the same warm query
+            # (analysis/residency.py registry — the event-log field
+            # the doctor joins against host_staging)
+            "declared_transfer_sites": dict(getattr(
+                s, "last_query_declared_transfers", None) or {}),
             # device-compute cost roll-up (obs/costplane.py): the
             # warm query's roofline verdict, achieved rates and the
             # padding-waste tax of the AOT bucket lattice
@@ -237,6 +242,24 @@ def audited_programs():
         if not report.ok:
             return {"findings": [str(f) for f in report.findings]}
         return sorted(report.audited)
+    except Exception:  # noqa: BLE001 - reporting only, never gate bench
+        return None
+
+
+def undeclared_transfers():
+    """Static residency verdict for the measured build
+    (analysis/residency.py): RES findings the interprocedural escape
+    analysis proves on the execution spine, plus declared-site registry
+    coverage gaps and parse errors.  Must be 0 — the perf baseline
+    gates it exact, so a change that reintroduces a hidden device->host
+    sync fails the perf gate, not a profiling session."""
+    try:
+        import os
+        from spark_rapids_tpu.analysis import residency
+        root = os.path.dirname(os.path.abspath(__file__))
+        report = residency.analyze_project(root)
+        gaps = residency.coverage_gaps(root)
+        return len(report.findings) + len(report.errors) + len(gaps)
     except Exception:  # noqa: BLE001 - reporting only, never gate bench
         return None
 
@@ -461,6 +484,13 @@ def main():
         # static PV-FLUSH prediction for the warm headline query — the
         # cross-checked dispatch model (analysis/flush_budget.py)
         "predicted_flushes": tpu_perf.get("predicted_flushes"),
+        # device residency (analysis/residency.py): the warm headline
+        # query's per-site declared-transfer counts, and the static
+        # escape analysis verdict over the execution spine — MUST be 0
+        # (gated exact by PERF_BASELINE, so a reintroduced hidden sync
+        # fails ci/perf_gate.py rather than a profiling session)
+        "declared_transfer_sites": tpu_perf.get("declared_transfer_sites"),
+        "undeclared_transfers": undeclared_transfers(),
         # device programs statically vetted by the jaxpr auditor
         "audited_programs": audited_programs(),
         # runtime stats plane (obs/stats.py): on/off overhead of the

@@ -57,7 +57,7 @@ BASELINE_PATH = os.path.join(REPO_ROOT, "PERF_BASELINE.json")
 
 #: keys safe to gate on a scaled-down --run (row-count independent)
 _SCALE_INVARIANT = ("flushes", "superstage_off_flushes",
-                    "predicted_flushes")
+                    "predicted_flushes", "undeclared_transfers")
 
 
 def _print_doctor_verdict(record):

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Device-residency CLI — the CI gate over ``analysis/residency.py``.
+
+Usage:
+  python ci/residency.py                 # interprocedural escape
+                                         # analysis over the execution
+                                         # spine (exit 1 on findings or
+                                         # registry coverage gaps, exit
+                                         # 2 on parse errors)
+  python ci/residency.py --census        # also print the per-module
+                                         # declared-transfer census
+  python ci/residency.py --fixture RES001  # analyze ONE seeded negative
+                                         # fixture; exit NONZERO iff the
+                                         # expected rule fires (the
+                                         # self-test CI inverts: nonzero
+                                         # here is PASS)
+
+Shares the lint layer's finding format and exit-code convention
+(``format_findings``; 0 clean, 1 findings).  The pass is pure AST —
+no device needed — but JAX_PLATFORMS=cpu plus the 8-virtual-device
+flag are forced anyway so an accidental jax import in the analyzed
+modules can never reach for a real accelerator from CI.
+"""
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _fixture(rule: str) -> int:
+    """Analyze one seeded negative fixture; exit 1 iff its rule fires."""
+    from spark_rapids_tpu.analysis.lint import format_findings
+    from spark_rapids_tpu.analysis import residency
+    if rule not in residency.ALL_RULES:
+        print(f"unknown residency rule {rule!r}; expected one of "
+              f"{', '.join(residency.ALL_RULES)}", file=sys.stderr)
+        return 2
+    path = os.path.join(REPO_ROOT, "tests", "lint_fixtures",
+                        f"residency_{rule.lower()}.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    except OSError as e:
+        print(f"residency: fixture missing: {e}", file=sys.stderr)
+        return 2
+    findings, _declared = residency.analyze_source(src, path)
+    print(format_findings(findings))
+    return 1 if any(f.rule == rule for f in findings) else 0
+
+
+def main(argv) -> int:
+    from spark_rapids_tpu.analysis.lint import format_findings
+    from spark_rapids_tpu.analysis import residency
+    if "--fixture" in argv:
+        i = argv.index("--fixture")
+        if i + 1 >= len(argv):
+            print("--fixture requires a rule id", file=sys.stderr)
+            return 2
+        return _fixture(argv[i + 1])
+    report = residency.analyze_project(repo_root=REPO_ROOT)
+    if report.errors:
+        # a spine file that cannot even parse is a broken analysis
+        # surface, not a clean one — fail louder than a finding
+        for err in report.errors:
+            print(f"residency: PARSE ERROR: {err}", file=sys.stderr)
+        return 2
+    if "--census" in argv:
+        for mod in sorted(report.census):
+            counts = dict(sorted(report.census[mod].items()))
+            print(f"census {mod}: {counts or '{}'}")
+    rc = 0
+    if report.findings:
+        print(format_findings(report.findings))
+        rc = 1
+    gaps = residency.coverage_gaps(repo_root=REPO_ROOT)
+    for gap in gaps:
+        print(f"residency: COVERAGE GAP: {gap}")
+        rc = 1
+    stale = residency.stale_sync_allowlist(repo_root=REPO_ROOT)
+    for entry in stale:
+        print(f"residency: STALE ALLOWLIST: {entry}")
+        rc = 1
+    if rc == 0:
+        declared = sum(len(v) for v in report.call_sites.values())
+        print(f"residency: no findings ({declared} declared-transfer "
+              f"sites across {len(report.census)} modules, "
+              f"{len(residency.SITES)} registry entries)")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -18,6 +18,15 @@ for rule in AUD001 AUD002 AUD003 AUD004; do
   fi
 done
 
+echo "== device residency (interprocedural host-transfer escape analysis) =="
+JAX_PLATFORMS=cpu python ci/residency.py
+for rule in RES001 RES002 RES003; do
+  # seeded negatives: the gate must FAIL on each planted defect
+  if JAX_PLATFORMS=cpu python ci/residency.py --fixture "$rule" >/dev/null; then
+    echo "residency fixture $rule did NOT trip the gate" >&2; exit 1
+  fi
+done
+
 echo "== plan-invariant verifier smoke (TPC-DS-style plans) =="
 JAX_PLATFORMS=cpu python ci/lint.py --plan-smoke
 

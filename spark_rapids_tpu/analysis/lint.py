@@ -153,27 +153,20 @@ def _collect_flushing_funcs(tree: ast.AST) -> Set[str]:
                     break
     return out
 
-#: numpy module aliases for the SYNC001 asarray check
-_NP_ALIASES = {"np", "_np", "numpy"}
+#: numpy module aliases for the SYNC001 asarray check — re-exported
+#: from the residency analyzer, the single source of truth for the
+#: host-sync classifier since the SYNC001 consolidation
+from .residency import NP_ALIASES as _NP_ALIASES  # noqa: E402
 
-#: hot-path files where numpy pulls are intentional — the explicit
-#: SYNC001 allowlist.  asarray is exempt in these files (each with its
-#: justification); the unambiguous sync APIs (device_get /
-#: block_until_ready) are still banned everywhere.
-_SYNC_NP_FILE_ALLOWLIST = {
-    # host trampolines for jax.pure_callback: the whole point is to run
-    # the exact-binary64 op on host
-    "binary64.py",
-    # host-side string offset/byte-table prep feeding device uploads
-    "strings.py",
-    # verify-at-flush barriers: the join/sort execution model pulls
-    # count words ONCE per flush (gather-map surgery, out-of-core merge
-    # staging) — the sanctioned sync points of SURVEY §"speculative"
-    "tpu_join.py", "tpu_sort.py",
-    # mesh collectives hand results back to the host once per SPMD
-    # program (the shard gather at program exit)
-    "tpu_mesh_aggregate.py", "tpu_mesh_join.py", "tpu_mesh_sort.py",
-}
+#: hot-path files where numpy pulls are intentional — DERIVED from the
+#: declared-transfer registry's ``covers_files`` attributions
+#: (analysis/residency.py SITES): an allowlisted file is exactly a
+#: file some registered declared site covers, so the justification
+#: text lives on the Site entry and ``residency.coverage_gaps()``
+#: prunes stale entries.  asarray is exempt in these files; the
+#: unambiguous sync APIs (device_get / block_until_ready) are still
+#: banned everywhere.
+from .residency import SYNC_NP_FILE_ALLOWLIST as _SYNC_NP_FILE_ALLOWLIST  # noqa: E402,E501
 
 
 class Finding:
@@ -395,31 +388,22 @@ class _FileLockAnalysis(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-class _SyncVisitor(ast.NodeVisitor):
-    """SYNC001: device-hot-path host synchronization."""
+class _SyncVisitor:
+    """SYNC001: device-hot-path host synchronization.
+
+    Rebased on the residency analyzer's shared classifier
+    (``residency.host_sync_sites``) by the SYNC001 consolidation: the
+    sync-attr set, numpy-alias set, and declared-region exemption all
+    live in one place, so lint and the interprocedural taint engine can
+    never disagree about what counts as a host pull.
+    """
 
     def __init__(self, path: str, tree: ast.AST, check_asarray: bool):
-        self.path = path
-        self.check_asarray = check_asarray
-        self.findings: List[Finding] = []
-        self.visit(tree)
-
-    def visit_Call(self, node: ast.Call):
-        f = node.func
-        if isinstance(f, ast.Attribute):
-            if f.attr in ("device_get", "block_until_ready"):
-                self.findings.append(Finding(
-                    SYNC001, self.path, node.lineno,
-                    f"'{f.attr}' forces a device->host round trip in "
-                    f"the hot path"))
-            elif f.attr == "asarray" and self.check_asarray and \
-                    isinstance(f.value, ast.Name) and \
-                    f.value.id in _NP_ALIASES:
-                self.findings.append(Finding(
-                    SYNC001, self.path, node.lineno,
-                    "numpy asarray on (potentially device) data pulls "
-                    "to host and serializes the dispatch queue"))
-        self.generic_visit(node)
+        from .residency import host_sync_sites
+        self.findings = [
+            Finding(SYNC001, path, lineno, msg)
+            for lineno, msg in host_sync_sites(
+                tree, path, check_asarray=check_asarray)]
 
 
 #: receiver names under which the flight recorder is imported at call

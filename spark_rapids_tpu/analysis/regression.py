@@ -66,6 +66,11 @@ GATE_KEYS: Tuple[Tuple[str, str, float], ...] = (
     ("flushes", "exact", 0.0),
     ("superstage_off_flushes", "exact", 0.0),
     ("predicted_flushes", "exact", 0.0),
+    # device residency (analysis/residency.py): undeclared device->host
+    # transfers the escape analysis proves on the execution spine, plus
+    # registry coverage gaps.  Exact at 0 — a change that reintroduces
+    # a hidden sync fails the perf gate, not a profiling session
+    ("undeclared_transfers", "exact", 0.0),
     ("device_util_pct", "higher", 18.0),
     # AOT compile service (compile/aot.py): cold-start throughput of
     # the headline config, cold/warm spread (lower = persistent cache +

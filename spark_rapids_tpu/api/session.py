@@ -273,6 +273,7 @@ class TpuSession:
         # concurrent peers' flushes land in whichever query's window
         # they fall — exact when queries run serially, which is how the
         # flush budget is benchmarked)
+        from ..analysis import residency as _residency
         from ..columnar import pending
         from ..obs import compile_watch as _cwatch
         from ..obs import costplane as _costplane
@@ -284,6 +285,10 @@ class TpuSession:
         from ..obs import stats as _stats
         from ..obs import timeline as _timeline
         flushes0 = pending.FLUSH_COUNT
+        # declared device->host transfers this query (same counter-delta
+        # discipline; analysis/residency.py) — the runtime half of the
+        # residency contract
+        res_marker = _residency.snapshot()
         # self-meter window (obs/overhead.py): per-plane observability
         # self-cost accrued inside this query, same process-wide
         # counter-delta discipline as FLUSH_COUNT
@@ -327,14 +332,24 @@ class TpuSession:
                     if fixed is not item:
                         stage_batch(fixed)
                 return fixed
-            items = [item for _pid, item in drain_parallel(
-                phys.execute_checkpointed(), sink=_resolve,
-                token=token, label="collect")]
-            tables: List[pa.Table] = []
-            for item in items:
-                t = item if isinstance(item, pa.Table) else to_arrow(item)
-                if t.num_rows:
-                    tables.append(t)
+            # the scoped transfer guard (analysis/residency.py): any
+            # device->host pull on this thread that is not inside a
+            # declared_transfer region fails loudly.  Pool workers arm
+            # the same guard per-thread in _ParallelDrain._serve.
+            with _residency.guard_scope(conf):
+                items = [item for _pid, item in drain_parallel(
+                    phys.execute_checkpointed(), sink=_resolve,
+                    token=token, label="collect")]
+                tables: List[pa.Table] = []
+                for item in items:
+                    if isinstance(item, pa.Table):
+                        t = item
+                    else:
+                        with _residency.declared_transfer(
+                                site="collect_sink"):
+                            t = to_arrow(item)
+                    if t.num_rows:
+                        tables.append(t)
         finally:
             # end-of-query shuffle release (ContextCleaner role): map
             # outputs are per-query; holding them across a long sweep
@@ -359,6 +374,9 @@ class TpuSession:
         flushes = pending.FLUSH_COUNT - flushes0
         self.last_query_flushes = flushes
         observe("flushes", flushes)
+        declared_total, declared_sites = _residency.delta(res_marker)
+        self.last_query_declared_transfers = declared_sites
+        observe("declared_transfers", declared_total)
         # compile telemetry: compiles that landed in this query's window
         # (engine path; the service separately harvests the token's
         # inline_compile_ms observed at compile time)
@@ -421,6 +439,8 @@ class TpuSession:
                  "spill_bytes": int(spill_bytes),
                  "flushes": int(flushes),
                  "predicted_flushes": predicted_flushes,
+                 "declared_transfers": int(declared_total),
+                 "declared_transfer_sites": dict(declared_sites),
                  "inline_compile_ms": round(inline_compile_ms, 3),
                  "device_busy_ms": tl["busy_ms"],
                  "device_util_pct": tl["util_pct"],
@@ -432,6 +452,10 @@ class TpuSession:
                  "unspill_count": mem["unspill_count"],
                  "leaked_entries": mem["leaked_entries"],
                  "memplane": mem}
+        from ..config import RESIDENCY_IN_EVENT_LOG
+        if not conf.get(RESIDENCY_IN_EVENT_LOG):
+            extra.pop("declared_transfers")
+            extra.pop("declared_transfer_sites")
         if cost is not None:
             extra["costplane"] = cost
         # plan-cache disposition (cache/plan_cache.py): stamped on the
@@ -488,6 +512,7 @@ class TpuSession:
                     tl, inline_compile_ms=inline_compile_ms,
                     netplane=net, memplane=mem, flushes=int(flushes),
                     predicted_flushes=predicted_flushes,
+                    declared_transfers=declared_sites,
                     sem_wait_ms=sem_wait_ms,
                     stats_profile=self.last_stats_profile,
                     query_id=token.query_id if token is not None

@@ -467,20 +467,22 @@ def _concat_list_cols(cols: Sequence[Column], nrows: Sequence[int],
                       cap: int) -> Column:
     """Concat of List/MapColumns: rebase offsets, recursively concat
     children."""
+    from ..analysis import residency  # lazy: avoids import cycle
     offsets_parts: List = []
     valid_parts: List = []
     child_cols: List[Column] = []
     child_ns: List[int] = []
     base = 0
-    for c, n in zip(cols, nrows):
-        offs = np.asarray(c.offsets)
-        o0, o1 = int(offs[0]), int(offs[n])
-        offsets_parts.append(
-            c.offsets[:n].astype(jnp.int32) - jnp.int32(o0 - base))
-        valid_parts.append(c.validity[:n])
-        child_cols.append(_slice_elements(c.elements, o0, o1))
-        child_ns.append(o1 - o0)
-        base += o1 - o0
+    with residency.declared_transfer(site="batch_concat"):
+        for c, n in zip(cols, nrows):
+            offs = np.asarray(c.offsets)
+            o0, o1 = int(offs[0]), int(offs[n])
+            offsets_parts.append(
+                c.offsets[:n].astype(jnp.int32) - jnp.int32(o0 - base))
+            valid_parts.append(c.validity[:n])
+            child_cols.append(_slice_elements(c.elements, o0, o1))
+            child_ns.append(o1 - o0)
+            base += o1 - o0
     child_cap = bucket_capacity(max(1, sum(child_ns)))
     elem_dtype = cols[0].elements.dtype
     elements = _concat_cols(elem_dtype, child_cols, child_ns, child_cap)
@@ -499,22 +501,25 @@ def _concat_list_cols(cols: Sequence[Column], nrows: Sequence[int],
 
 def _concat_string_cols(cols: Sequence[StringColumn], nrows: Sequence[int],
                         cap: int) -> StringColumn:
+    from ..analysis import residency  # lazy: avoids import cycle
     offsets_parts, valid_parts = [], []
     base = 0
-    for c, n in zip(cols, nrows):
-        offs_np = np.asarray(c.offsets)
-        o0 = int(offs_np[0])
-        offsets_parts.append(c.offsets[:n] - jnp.int32(o0 - base))
-        base = base + int(offs_np[n]) - o0
-        valid_parts.append(c.validity[:n])
-    # bytes: need exact live bytes from each column; do on host-free device ops
-    # by slicing with dynamic sizes is not static-shape friendly; instead gather
-    # via numpy on host for now (concat is a batch boundary; the reference also
-    # round-trips through host for shuffle concat of serialized batches).
-    np_bytes = []
-    for c, n in zip(cols, nrows):
-        offs = np.asarray(c.offsets)
-        np_bytes.append(np.asarray(c.data)[int(offs[0]):int(offs[n])])
+    with residency.declared_transfer(site="batch_concat"):
+        for c, n in zip(cols, nrows):
+            offs_np = np.asarray(c.offsets)
+            o0 = int(offs_np[0])
+            offsets_parts.append(c.offsets[:n] - jnp.int32(o0 - base))
+            base = base + int(offs_np[n]) - o0
+            valid_parts.append(c.validity[:n])
+        # bytes: need exact live bytes from each column; slicing with
+        # dynamic sizes is not static-shape friendly on device, so
+        # gather via numpy on host (concat is a batch boundary; the
+        # reference also round-trips host for shuffle concat of
+        # serialized batches).
+        np_bytes = []
+        for c, n in zip(cols, nrows):
+            offs = np.asarray(c.offsets)
+            np_bytes.append(np.asarray(c.data)[int(offs[0]):int(offs[n])])
     all_bytes = np.concatenate(np_bytes) if np_bytes else np.zeros(0, np.uint8)
     byte_cap = bucket_capacity(max(1, all_bytes.shape[0]))
     buf = np.zeros(byte_cap, np.uint8)

@@ -141,8 +141,11 @@ class Column:
         n = capacity if num_rows is None else num_rows
         if dtype == T.STRING:
             # host-built buffer: needs the concrete count (may sync)
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="size_probe"):
+                n = int(n)
             return StringColumn.from_pylist(
-                [value] * int(n), capacity=capacity)
+                [value] * n, capacity=capacity)
         if dtype == T.FLOAT64:
             from .binary64 import Binary64Column, exact_double_enabled
             if exact_double_enabled():
@@ -528,9 +531,11 @@ class ListColumn(Column):
 
     def gather(self, indices, live=None, unique=False) -> "ListColumn":
         from ..kernels import lists as lkern
+        from ..analysis import residency  # lazy: avoids import cycle
         new_offsets, gvalid, src_starts, total = lkern.gather_list_offsets(
             self.offsets, self.validity, indices)
-        elem_cap = bucket_capacity(max(1, int(total)))
+        with residency.declared_transfer(site="size_probe"):
+            elem_cap = bucket_capacity(max(1, int(total)))
         src_idx, live = lkern.element_gather_indices(
             new_offsets, src_starts, elem_cap)
         elems = self.elements.gather(src_idx).mask_validity(live)

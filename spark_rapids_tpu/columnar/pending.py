@@ -44,6 +44,13 @@ _POOL: List["weakref.ref"] = []
 _POOL_LOCK = threading.Lock()
 
 
+def _residency():
+    # lazy: analysis/__init__ pulls exec.base, which would cycle back
+    # through the columnar package at import time
+    from ..analysis import residency
+    return residency
+
+
 class Staged:
     """Handle for one staged device array; resolves at the next flush."""
 
@@ -69,7 +76,8 @@ class Staged:
             # a concurrent flush captured this item but has not decoded
             # it yet: pull directly (same value; the duplicate transfer
             # only happens on this narrow race)
-            self._val = np.asarray(self.dev)
+            with _residency().declared_transfer(site="pending_race"):
+                self._val = np.asarray(self.dev)
         return self._val
 
     def _count(self) -> int:
@@ -182,19 +190,22 @@ def _check_encoding() -> bool:
             probef = np.array([0.0, -0.0, 1.5, -1e30, 1e-30,
                                3.141592653589793, np.inf, np.nan], np.float64)
             ok = True
-            for arr in (probe64, probef,
-                        np.array([True, False]), np.arange(5, dtype=np.int32)):
-                dev = jnp.asarray(arr)
-                # reference = what the DEVICE itself round-trips (on-chip
-                # f64 is an f32 double-double — values a plain pull can't
-                # recover aren't the encoder's job to recover either)
-                want = np.asarray(dev)
-                layout, parts = _encode(dev)
-                host = [np.asarray(p) for p in parts]
-                back = _decode(layout, np.dtype(arr.dtype), arr.shape, host)
-                same = bool(np.all((back == want) |
-                                   (pd_isnan(back) & pd_isnan(want))))
-                ok = ok and same
+            with _residency().declared_transfer(site="pending_probe"):
+                for arr in (probe64, probef, np.array([True, False]),
+                            np.arange(5, dtype=np.int32)):
+                    dev = jnp.asarray(arr)
+                    # reference = what the DEVICE itself round-trips
+                    # (on-chip f64 is an f32 double-double — values a
+                    # plain pull can't recover aren't the encoder's job
+                    # to recover either)
+                    want = np.asarray(dev)
+                    layout, parts = _encode(dev)
+                    host = [np.asarray(p) for p in parts]
+                    back = _decode(layout, np.dtype(arr.dtype), arr.shape,
+                                   host)
+                    same = bool(np.all((back == want) |
+                                       (pd_isnan(back) & pd_isnan(want))))
+                    ok = ok and same
             _ENCODING_OK = ok
         except Exception:  # noqa: BLE001 — any backend quirk: safe path
             _ENCODING_OK = False
@@ -246,38 +257,42 @@ def flush():
 
 
 def _flush_items(items: List[Staged]):
-    if len(items) == 1 or not _check_encoding():
+    # ONE declared region per flush event: the declared-transfer count
+    # for this site tracks FLUSH_COUNT one-to-one, whatever the fused
+    # transfer decomposes into
+    with _residency().declared_transfer(site="pending_flush"):
+        if len(items) == 1 or not _check_encoding():
+            for it in items:
+                it._val = np.asarray(it.dev)
+                it.dev = None
+            return
+        encoded = []
+        streams = {"u32": [], "f64": []}
         for it in items:
-            it._val = np.asarray(it.dev)
+            layout, parts = _encode(it.dev)
+            stream = streams["f64" if layout == "f64" else "u32"]
+            idx = []
+            for p in parts:
+                idx.append((len(stream), int(p.shape[0])))
+                stream.append(p)
+            encoded.append((it, layout, idx))
+        flats, offs = {}, {}
+        for name, parts in streams.items():
+            if parts:
+                flats[name] = np.asarray(jnp.concatenate(parts)
+                                         if len(parts) > 1 else parts[0])
+                o, lst = 0, []
+                for p in parts:
+                    lst.append(o)
+                    o += int(p.shape[0])
+                offs[name] = lst
+        for it, layout, idx in encoded:
+            name = "f64" if layout == "f64" else "u32"
+            flat, off = flats[name], offs[name]
+            parts = [flat[off[i]:off[i] + n] for i, n in idx]
+            it._val = _decode(layout, it._np_dtype, it._shape, parts)
             it.dev = None
         return
-    encoded = []
-    streams = {"u32": [], "f64": []}
-    for it in items:
-        layout, parts = _encode(it.dev)
-        stream = streams["f64" if layout == "f64" else "u32"]
-        idx = []
-        for p in parts:
-            idx.append((len(stream), int(p.shape[0])))
-            stream.append(p)
-        encoded.append((it, layout, idx))
-    flats, offs = {}, {}
-    for name, parts in streams.items():
-        if parts:
-            flats[name] = np.asarray(jnp.concatenate(parts)
-                                     if len(parts) > 1 else parts[0])
-            o, lst = 0, []
-            for p in parts:
-                lst.append(o)
-                o += int(p.shape[0])
-            offs[name] = lst
-    for it, layout, idx in encoded:
-        name = "f64" if layout == "f64" else "u32"
-        flat, off = flats[name], offs[name]
-        parts = [flat[off[i]:off[i] + n] for i, n in idx]
-        it._val = _decode(layout, it._np_dtype, it._shape, parts)
-        it.dev = None
-    return
 
 
 def pool_size() -> int:

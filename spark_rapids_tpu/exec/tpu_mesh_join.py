@@ -305,7 +305,9 @@ class TpuMeshShuffledJoin(TpuExec):
                     [c.validity for c in lcols] + [llive] +
                     list(rwords) + [c.data for c in rcols] +
                     [c.validity for c in rcols] + [rlive])
-            flat = [jax.device_put(a, sharding) for a in flat]
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="mesh_reshard"):
+                flat = [jax.device_put(a, sharding) for a in flat]
 
             program = self._program(mesh, prog_jt, key_groups,
                                     l_dts, r_dts, emit_right)
@@ -313,10 +315,14 @@ class TpuMeshShuffledJoin(TpuExec):
             _aot.note_demand("mesh_join", flat[0].shape[0])
             with timed(self.metrics[JOIN_TIME], self):
                 out = program(*flat)
-            if bool(np.asarray(out[-1]).any()):
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="mesh_collect"):
+                overflowed = bool(np.asarray(out[-1]).any())
+            if overflowed:
                 yield from self._fallback(lbatch, rbatch, swapped)
                 return
-            totals = np.asarray(out[-2]).reshape(-1)
+            with residency.declared_transfer(site="mesh_collect"):
+                totals = np.asarray(out[-2]).reshape(-1)
             per = out[0].shape[0] // n_dev
             out_schema = self.output_schema
             # program output layout: probe payload then build payload;

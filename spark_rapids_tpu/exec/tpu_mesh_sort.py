@@ -199,7 +199,9 @@ class TpuMeshSort(TpuExec):
                    [c.validity for c in batch.columns] + \
                    [jnp.asarray(live)]
             sharding = NamedSharding(mesh, P(_AXIS))
-            flat = [jax.device_put(a, sharding) for a in flat]
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="mesh_reshard"):
+                flat = [jax.device_put(a, sharding) for a in flat]
 
             program = self._program(
                 mesh, len(key_cols), [c.dtype for c in key_cols],
@@ -208,7 +210,10 @@ class TpuMeshSort(TpuExec):
             _aot.note_demand("mesh_sort", flat[0].shape[0])
             with timed(self.metrics[SORT_TIME], self):
                 out = program(*flat)
-            if bool(np.asarray(out[-1]).any()):
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="mesh_collect"):
+                overflowed = bool(np.asarray(out[-1]).any())
+            if overflowed:
                 # skewed splitters overflowed a receive region: loud
                 # fallback to the in-process out-of-core sort
                 from .tpu_sort import TpuSort
@@ -230,7 +235,8 @@ class TpuMeshSort(TpuExec):
                 for part in srt.execute():
                     yield from part
                 return
-            counts = np.asarray(out[-2]).reshape(-1)
+            with residency.declared_transfer(site="mesh_collect"):
+                counts = np.asarray(out[-2]).reshape(-1)
             per = out[0].shape[0] // n_dev
             for d in range(n_dev):
                 nr = int(counts[d])

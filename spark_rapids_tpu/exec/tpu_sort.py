@@ -215,8 +215,10 @@ class TpuSort(TpuExec):
             memcmp order equals lexicographic word order.  byteswap AFTER
             stacking: np.stack silently casts '>u8' inputs back to
             native-endian."""
-            m = np.stack([np.asarray(w) for w in word_arrays],
-                         axis=1).astype(np.uint64).byteswap()
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="sort_ooc"):
+                m = np.stack([np.asarray(w) for w in word_arrays],
+                             axis=1).astype(np.uint64).byteswap()
             return np.ascontiguousarray(m).view(
                 np.dtype((np.void, 8 * m.shape[1]))).reshape(-1)
 
@@ -227,7 +229,9 @@ class TpuSort(TpuExec):
         for ri, (spill, n, (pos, sample_cols), _) in enumerate(runs):
             words = self._key_words(sample_cols, len(pos),
                                     str_words=strw_global)
-            words = [np.asarray(w[:len(pos)]) for w in words]
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="sort_ooc"):
+                words = [np.asarray(w[:len(pos)]) for w in words]
             words.append(np.full(len(pos), ri, np.uint64))
             words.append(pos.astype(np.uint64))
             v = to_void(words)
@@ -315,7 +319,9 @@ class TpuSort(TpuExec):
             lt, _ = cmp_lt(words, unpack(b_hi))
             keep = keep & lt
         idx, cnt = bk.compact_indices(keep, chunk.num_rows)
-        n = int(cnt)
+        from ..analysis import residency  # lazy: avoids import cycle
+        with residency.declared_transfer(site="sort_ooc"):
+            n = int(cnt)
         out = chunk.gather(idx, n)
         mask = jnp.arange(out.capacity) < n
         return ColumnarBatch(out.schema,

@@ -285,7 +285,9 @@ class TpuWindow(TpuExec):
         m_orig = jnp.where(jnp.arange(cap) < n,
                            jnp.take(m_sorted, inv), 0)
         c_lo_orig = jnp.take(c_lo, inv)
-        total = int(jnp.sum(m_orig))
+        from ..analysis import residency  # lazy: avoids import cycle
+        with residency.declared_transfer(site="size_probe"):
+            total = int(jnp.sum(m_orig))
         out_cap = bucket_capacity(max(total, 1))
         _, elem_pos, live_e, _ = join_k.expand_matches(
             c_lo_orig.astype(jnp.int32), m_orig.astype(jnp.int32),
@@ -548,8 +550,10 @@ class TpuWindow(TpuExec):
                     max_window = max(hi - lo + 1, 1)
                 else:
                     # RANGE frame: one host sync learns the widest window
-                    max_window = max(
-                        int(jnp.max(hi_pos - lo_pos + 1)), 1)
+                    from ..analysis import residency  # lazy import
+                    with residency.declared_transfer(site="size_probe"):
+                        max_window = max(
+                            int(jnp.max(hi_pos - lo_pos + 1)), 1)
                 tables = [x]
                 step = 1
                 while step < max_window:

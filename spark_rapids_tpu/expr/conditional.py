@@ -83,7 +83,9 @@ def _select_strings(conds, cols, cap):
     src_len = jnp.where(valid, src_len, 0)
     new_offsets = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(src_len).astype(jnp.int32)])
-    total = int(new_offsets[-1])
+    from ..analysis import residency  # lazy: avoids import cycle
+    with residency.declared_transfer(site="size_probe"):
+        total = int(new_offsets[-1])
     out_bytes = bucket_capacity(max(1, total))
     # byte source: per-row from its chosen column's byte buffer; buffers
     # differ per column, so materialize per column then select

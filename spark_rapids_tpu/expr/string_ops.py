@@ -298,7 +298,9 @@ class ConcatStrings(Expression):
         lens = jnp.where(valid, lens, 0)
         new_offsets = jnp.concatenate(
             [jnp.zeros(1, jnp.int32), jnp.cumsum(lens).astype(jnp.int32)])
-        total = int(new_offsets[-1])
+        from ..analysis import residency  # lazy: avoids import cycle
+        with residency.declared_transfer(site="size_probe"):
+            total = int(new_offsets[-1])
         out_bytes = bucket_capacity(max(1, total))
         out = jnp.zeros(out_bytes, jnp.uint8)
         # lay out piece k of each row after pieces 0..k-1
@@ -344,8 +346,10 @@ class StringTrim(Expression):
         data = col.data
         starts = col.offsets[:-1]
         lens = col.offsets[1:] - starts
-        max_len_host = int(np.asarray(lens[:batch.num_rows]).max()) \
-            if batch.num_rows else 0
+        from ..analysis import residency  # lazy: avoids import cycle
+        with residency.declared_transfer(site="strings_prep"):
+            max_len_host = int(np.asarray(lens[:batch.num_rows]).max()) \
+                if batch.num_rows else 0
         K = max(1, 1 << (max(max_len_host, 1) - 1).bit_length())
         k = jnp.arange(K, dtype=jnp.int32)
         idx = jnp.clip(starts[:, None] + k[None, :], 0, data.shape[0] - 1)
@@ -381,7 +385,9 @@ class StringTrim(Expression):
         from ..kernels.strings import _materialize_bytes
         new_offsets = jnp.concatenate(
             [jnp.zeros(1, jnp.int32), jnp.cumsum(new_lens).astype(jnp.int32)])
-        total = int(new_offsets[-1])
+        from ..analysis import residency  # lazy: avoids import cycle
+        with residency.declared_transfer(site="size_probe"):
+            total = int(new_offsets[-1])
         buf = _materialize_bytes(col.data, new_offsets, src_starts,
                                  bucket_capacity(max(1, total)))
         return StringColumn(new_offsets, buf, col.validity,
@@ -457,7 +463,9 @@ class Reverse(Expression):
         # handled by host fallback when any non-ASCII byte present
         col = _eval_string(self.children[0], batch)
         import numpy as np
-        has_mb = bool(np.asarray((col.data & 0x80) != 0).any())
+        from ..analysis import residency  # lazy: avoids import cycle
+        with residency.declared_transfer(site="strings_prep"):
+            has_mb = bool(np.asarray((col.data & 0x80) != 0).any())
         if has_mb:
             vals, valid = col.to_numpy(batch.num_rows)
             out = [v[::-1] if ok else None for v, ok in zip(vals, valid)]

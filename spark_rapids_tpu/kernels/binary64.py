@@ -672,15 +672,19 @@ def host_unary(np_fn, bits):
     and the axon PJRT backend has no host-callback support, so this is a
     plain device->host->device round-trip.
     """
-    arr = np.asarray(_i(bits)).view(np.float64)
+    from ..analysis import residency  # lazy: avoids import cycle
+    with residency.declared_transfer(site="binary64_host_libm"):
+        arr = np.asarray(_i(bits)).view(np.float64)
     with np.errstate(all="ignore"):
         out = np.asarray(np_fn(arr), dtype=np.float64)
     return jnp.asarray(out.view(np.int64))
 
 
 def host_binary(np_fn, a_bits, b_bits):
-    a = np.asarray(_i(a_bits)).view(np.float64)
-    b = np.asarray(_i(b_bits)).view(np.float64)
+    from ..analysis import residency  # lazy: avoids import cycle
+    with residency.declared_transfer(site="binary64_host_libm"):
+        a = np.asarray(_i(a_bits)).view(np.float64)
+        b = np.asarray(_i(b_bits)).view(np.float64)
     with np.errstate(all="ignore"):
         out = np.asarray(np_fn(a, b), dtype=np.float64)
     return jnp.asarray(out.view(np.int64))

@@ -122,4 +122,6 @@ def expand_matches(lo, counts, perm, out_cap: int):
 
 def total_matches(counts) -> int:
     """Host sync: total output rows (sizes the output capacity bucket)."""
-    return int(jnp.sum(counts.astype(jnp.int64)))
+    from ..analysis import residency  # lazy: avoids import cycle
+    with residency.declared_transfer(site="size_probe"):
+        return int(jnp.sum(counts.astype(jnp.int64)))

@@ -61,12 +61,20 @@ def needed_key_words(col: StringColumn, num_rows: int) -> int:
         return needed_key_words(src, src.capacity)
     max_len = col.max_bytes
     if max_len is None:
+        if not isinstance(num_rows, (int, np.integer)):
+            # a device/lazy row count (batch.rows_dev): the live-bound
+            # scan below needs the concrete value — one declared pull
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="strings_prep"):
+                num_rows = int(num_rows)
         cached = getattr(col, "_live_max_bytes", None)
         if cached is not None and cached[0] >= num_rows:
             max_len = cached[1]
         else:
-            lens = np.asarray(col.offsets[1:]) - np.asarray(
-                col.offsets[:-1])
+            from ..analysis import residency  # lazy: avoids import cycle
+            with residency.declared_transfer(site="strings_prep"):
+                lens = np.asarray(col.offsets[1:]) - np.asarray(
+                    col.offsets[:-1])
             # restrict to live rows: stale offsets beyond num_rows (a
             # shrunk batch) must not inflate the bucket
             max_len = int(lens[:num_rows].max()) if num_rows else 0
@@ -157,7 +165,9 @@ def gather_strings(offsets, data, validity, indices, live=None,
     elif mb_bound is not None and mb_bound <= _NOSYNC_MAX:
         out_bytes = bucket_capacity(max(1, mb_bound))
     else:
-        out_bytes = bucket_capacity(max(1, int(total)))
+        from ..analysis import residency  # lazy: avoids import cycle
+        with residency.declared_transfer(site="size_probe"):
+            out_bytes = bucket_capacity(max(1, int(total)))
     buf = _materialize_bytes(data, new_offsets, src_starts, out_bytes)
     return new_offsets, buf, gvalid
 
@@ -213,7 +223,9 @@ def substring(col: StringColumn, start: int, length: int) -> StringColumn:
     new_lens = jnp.where(col.validity, new_lens, 0)
     new_offsets = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(new_lens).astype(jnp.int32)])
-    total = int(new_offsets[-1])
+    from ..analysis import residency  # lazy: avoids import cycle
+    with residency.declared_transfer(site="size_probe"):
+        total = int(new_offsets[-1])
     out_bytes = bucket_capacity(max(1, total))
     buf = _materialize_bytes(col.data, new_offsets, src_starts, out_bytes)
     mb = col.max_bytes

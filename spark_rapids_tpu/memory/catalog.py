@@ -206,8 +206,10 @@ class BufferCatalog:
     def _serialize(self, device_obj):
         """ColumnarBatch -> host payload (schema, num_rows, numpy buffers)."""
         from ..columnar.batch import ColumnarBatch
+        from ..analysis import residency  # lazy: avoids import cycle
         assert isinstance(device_obj, ColumnarBatch)
-        bufs = [np.asarray(a) for a in device_obj.device_buffers()]
+        with residency.declared_transfer(site="spill_d2h"):
+            bufs = [np.asarray(a) for a in device_obj.device_buffers()]
         from ..columnar.column import StringColumn
 
         def kind(c):

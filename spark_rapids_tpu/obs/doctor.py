@@ -281,7 +281,8 @@ def _evidence(cause: str, *, inline_compile_ms: float,
               flushes: int, predicted_flushes: Optional[int],
               sem_wait_ms: float, busy_ms: float,
               compiles: Optional[List[Dict]] = None,
-              costplane: Optional[Dict] = None) -> str:
+              costplane: Optional[Dict] = None,
+              declared_transfers: Optional[Dict] = None) -> str:
     """Corroborating raw counter from the owning plane, as a string."""
     if cause == "device_compute":
         pred = ("?" if predicted_flushes is None
@@ -300,6 +301,12 @@ def _evidence(cause: str, *, inline_compile_ms: float,
         return (f"host_drop_tax_ms={netplane.get('host_drop_tax_ms', 0)} "
                 f"over edges={int(edges)} "
                 f"skew={netplane.get('edge_skew', 0)}")
+    if cause == "host_staging" and declared_transfers:
+        top = sorted(declared_transfers.items(),
+                     key=lambda kv: (-int(kv[1]), kv[0]))[:3]
+        mix = ", ".join(f"{site}={int(n)}" for site, n in top)
+        total = sum(int(n) for n in declared_transfers.values())
+        return f"declared_transfers={total} ({mix})"
     if cause == "mem_spill" and memplane:
         spill = memplane.get("spill", {}) or {}
         moves = sum(int(v.get("count", 0)) for v in spill.values()
@@ -320,7 +327,8 @@ def diagnose(timeline_summary: Dict, *,
              stats_profile=None,
              query_id: Optional[str] = None,
              compiles: Optional[List[Dict]] = None,
-             costplane: Optional[Dict] = None) -> QueryDiagnosis:
+             costplane: Optional[Dict] = None,
+             declared_transfers: Optional[Dict] = None) -> QueryDiagnosis:
     """Join the per-query plane summaries into one verdict.
 
     Called by the session AFTER every plane summary is already
@@ -352,7 +360,8 @@ def diagnose(timeline_summary: Dict, *,
                 predicted_flushes=predicted_flushes,
                 sem_wait_ms=sem_wait_ms,
                 busy_ms=float(timeline_summary.get("busy_ms", 0.0)),
-                compiles=compiles, costplane=costplane),
+                compiles=compiles, costplane=costplane,
+                declared_transfers=declared_transfers),
         })
     # ranked: largest modeled headroom first, taxonomy order on ties
     candidates.sort(key=lambda c: (-c["share_pct"],

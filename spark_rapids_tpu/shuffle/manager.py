@@ -86,6 +86,11 @@ class ShuffleCatalog:
             _ALL_CATALOGS.append(weakref.ref(self))
 
     def put(self, block: ShuffleBlockId, batches: List[ColumnarBatch]):
+        # residency-audited: registering a block serializes nothing by
+        # itself — SpillableBatch pulls device buffers only on a spill
+        # or transport serialize, and both pull paths run inside
+        # declared regions (spill_d2h in memory/catalog.py,
+        # shuffle_serialize in shuffle/meta.py)
         from ..memory.spillable import SpillableBatch
         t0 = time.perf_counter_ns()
         with _trace.span("shuffle_write", "shuffle"):

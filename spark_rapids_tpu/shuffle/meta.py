@@ -72,17 +72,20 @@ def build_table_meta(batch: ColumnarBatch) -> Tuple[TableMeta, bytes]:
     ``MetaUtils.buildTableMeta``: every device buffer is pulled to host
     and packed back-to-back (8-byte aligned) into one blob.
     """
+    from ..analysis import residency  # lazy: avoids import cycle
     fields = tuple((f.name, f.dtype.name, f.nullable) for f in batch.schema)
     kinds = []
     arrays: List[np.ndarray] = []
-    for f, col in zip(batch.schema, batch.columns):
-        kinds.append(_KIND_NESTED if f.dtype.is_nested
-                     else _KIND_STRING if isinstance(col, StringColumn)
-                     else _KIND_PLAIN)
-        # device_buffers() is recursive and its order is deterministic per
-        # dtype, so the receiver can re-consume it dtype-driven
-        for buf in col.device_buffers():
-            arrays.append(np.asarray(buf))
+    with residency.declared_transfer(site="shuffle_serialize"):
+        for f, col in zip(batch.schema, batch.columns):
+            kinds.append(_KIND_NESTED if f.dtype.is_nested
+                         else _KIND_STRING if isinstance(col, StringColumn)
+                         else _KIND_PLAIN)
+            # device_buffers() is recursive and its order is
+            # deterministic per dtype, so the receiver can re-consume
+            # it dtype-driven
+            for buf in col.device_buffers():
+                arrays.append(np.asarray(buf))
     metas: List[BufferMeta] = []
     pos = 0
     chunks: List[bytes] = []

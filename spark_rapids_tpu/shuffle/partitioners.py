@@ -78,8 +78,10 @@ class Partitioner:
 
     @staticmethod
     def finalize_split(sorted_batch: ColumnarBatch, counts) -> SplitBatch:
-        counts = counts.np if isinstance(counts, LazyArray) \
-            else np.asarray(counts)
+        from ..analysis import residency  # lazy: avoids import cycle
+        with residency.declared_transfer(site="shuffle_fit"):
+            counts = counts.np if isinstance(counts, LazyArray) \
+                else np.asarray(counts)
         offsets = np.zeros(len(counts) + 1, dtype=np.int64)
         offsets[1:] = np.cumsum(counts)
         return SplitBatch(sorted_batch, offsets)
@@ -249,16 +251,18 @@ class RangePartitioner(Partitioner):
                 if isinstance(c, StringColumn):
                     w = skern.needed_key_words(c, b.num_rows)
                     self._str_words[i] = max(self._str_words[i] or 1, w)
+        from ..analysis import residency  # lazy: avoids import cycle
         acc: List[List[np.ndarray]] = []
-        for b, cols in col_sets:
-            words = canon.batch_key_words(
-                cols, b.num_rows,
-                descending=[not o.ascending for o in self.orders],
-                nulls_last=[not o.effective_nulls_first
-                            for o in self.orders],
-                str_words=self._str_words)
-            acc.append([np.asarray(w)[:b.num_rows] for w in words])
-            rows += b.num_rows
+        with residency.declared_transfer(site="shuffle_fit"):
+            for b, cols in col_sets:
+                words = canon.batch_key_words(
+                    cols, b.num_rows,
+                    descending=[not o.ascending for o in self.orders],
+                    nulls_last=[not o.effective_nulls_first
+                                for o in self.orders],
+                    str_words=self._str_words)
+                acc.append([np.asarray(w)[:b.num_rows] for w in words])
+                rows += b.num_rows
         if rows == 0:
             self.bound_words = None
             return

@@ -25,6 +25,14 @@ os.environ.setdefault("SPARK_RAPIDS_TPU_FORCE_PLAN_VERIFY", "1")
 # built directly (tests/test_obs_overhead.py).
 os.environ.setdefault("SPARK_RAPIDS_TPU_OBS_STATS_EXACT", "1")
 
+# Force the residency transfer guard on for every query the suite
+# drains: undeclared device->host pulls raise UndeclaredTransferError
+# instead of silently stalling the pipeline.  Declared sites
+# (analysis/residency.py SITES) lift the guard for their scoped pull.
+# Export SPARK_RAPIDS_TPU_FORCE_TRANSFER_GUARD=0 to switch off when
+# bisecting (spark.rapids.tpu.analysis.residency.transferGuard).
+os.environ.setdefault("SPARK_RAPIDS_TPU_FORCE_TRANSFER_GUARD", "1")
+
 # The image's sitecustomize registers the axon TPU backend and forces
 # JAX_PLATFORMS=axon in every interpreter, so the env var alone is not
 # enough — override through the config API after import, before any

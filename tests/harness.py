@@ -85,8 +85,15 @@ def _compare_rows(cpu_rows, tpu_rows, approx_float=True,
 def assert_tpu_and_cpu_are_equal_collect(df_fn, conf=None, ignore_order=True,
                                          approx_float=True):
     """Run df_fn(session) on both engines and compare collected rows."""
+    from spark_rapids_tpu.analysis import residency
     cpu_rows = with_cpu_session(lambda s: df_fn(s).collect(), conf)
-    tpu_rows = with_tpu_session(lambda s: df_fn(s).collect(), conf)
+    # The oracle collect is itself a declared d2h pull: the entire TPU
+    # result set is materialized host-side for row comparison.  The
+    # region is entered BEFORE the session snapshots its per-query
+    # declared-transfer window, so oracle runs don't skew the
+    # declared_transfer_sites exactness contract (test_residency.py).
+    with residency.declared_transfer(site="oracle_compare"):
+        tpu_rows = with_tpu_session(lambda s: df_fn(s).collect(), conf)
     if ignore_order:
         cpu_rows = sorted(cpu_rows, key=_row_key)
         tpu_rows = sorted(tpu_rows, key=_row_key)

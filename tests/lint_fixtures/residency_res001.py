@@ -1,0 +1,21 @@
+"""Seeded RES001 fixture — ``ci/residency.py --fixture RES001`` must
+exit NONZERO.
+
+An undeclared device->host transfer on the execution spine: a value the
+taint walk PROVES device-resident (produced by ``jnp.*``, carried
+through a local helper) is materialized with ``np.asarray`` outside any
+``residency.declared_transfer`` region.  Never imported by the engine.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+
+def _device_counts(col):
+    # helper return taint: DEVICE (interprocedural — the call graph
+    # must carry it back to the caller's np.asarray argument)
+    return jnp.cumsum(col.astype(jnp.int32))
+
+
+def bad_finalize(col):
+    counts = _device_counts(col)
+    return np.asarray(counts)          # RES001: undeclared transfer

@@ -1,0 +1,19 @@
+"""Seeded RES002 fixture — ``ci/residency.py --fixture RES002`` must
+exit NONZERO.
+
+A device->host sync while HOLDING the device semaphore: every
+concurrent dispatcher queues behind a host round trip, the exact stall
+the admission semaphore exists to prevent.  Never imported by the
+engine.
+"""
+import threading
+
+import jax.numpy as jnp
+
+_DISPATCH_SEM = threading.Semaphore(4)
+
+
+def bad_dispatch(col):
+    dev = jnp.sum(col)
+    with _DISPATCH_SEM:
+        return float(dev)              # RES002: sync under the semaphore

@@ -1,0 +1,17 @@
+"""Seeded RES003 fixture — ``ci/residency.py --fixture RES003`` must
+exit NONZERO.
+
+A device->host transfer INSIDE a pipeline drain loop: one sync per
+batch serializes the whole pipeline per iteration instead of amortizing
+a single pull at the stage barrier.  Never imported by the engine.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+
+def bad_drain(batches):
+    out = []
+    for batch in batches:
+        dev = jnp.nonzero(batch, size=16)[0]
+        out.append(np.asarray(dev))    # RES003: transfer in drain loop
+    return out

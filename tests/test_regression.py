@@ -83,7 +83,7 @@ class TestHistory:
         # cold-path keys exist only from r13 on, the three roofline
         # keys from r14, the three fleet keys from r15, the four
         # plan-cache/scheduler keys from r16, the obs-tax key from
-        # r17)
+        # r17, the residency key from r18)
         newest = rounds[max(rounds)]
         for key, _d, _b in R.GATE_KEYS:
             assert newest.get(key) is not None, key
@@ -165,15 +165,29 @@ class TestCompare:
 # ---------------------------------------------------------------------------
 
 class TestCommittedBaseline:
-    def test_baseline_values_equal_r17(self):
+    def test_baseline_values_equal_r18(self):
         base = R.load_baseline(BASELINE)
-        assert base["round"] == 17
-        r17 = R.load_round(os.path.join(REPO_ROOT,
-                                        "BENCH_r17.json")).keys
+        assert base["round"] == 18
+        r18 = R.load_round(os.path.join(REPO_ROOT,
+                                        "BENCH_r18.json")).keys
         for key, spec in base["keys"].items():
-            assert spec["value"] == r17[key], key
+            assert spec["value"] == r18[key], key
         # so the committed pair passes the gate by construction
-        assert not R.regressions(R.compare(r17, base))
+        assert not R.regressions(R.compare(r18, base))
+
+    def test_residency_key_gated_exact_at_zero(self):
+        # r18's contract: a change that reintroduces a hidden
+        # device->host sync (any nonzero undeclared_transfers) must
+        # fail the gate, not a profiling session
+        base = R.load_baseline(BASELINE)
+        spec = base["keys"]["undeclared_transfers"]
+        assert spec["direction"] == "exact"
+        assert spec["value"] == 0
+        dirty = dict(R.load_round(os.path.join(
+            REPO_ROOT, "BENCH_r18.json")).keys)
+        dirty["undeclared_transfers"] = 1
+        bad = [d.key for d in R.regressions(R.compare(dirty, base))]
+        assert bad == ["undeclared_transfers"], bad
 
     def test_true_r16_numbers_trip_only_the_r17_discontinuities(self):
         # the r17 obs-tax diet changed what two gated keys MEASURE:
@@ -182,12 +196,17 @@ class TestCommittedBaseline:
         # ~52% to ~99%), and history_write_p99_us dropped ~10x when
         # the background writer stopped paying dumps+open per row.
         # The true r16 record must regress on exactly those two keys
-        # against the r17 baseline — any third key tripping means a
-        # band is too tight for real round-over-round noise
+        # against a baseline seeded from r17 — any third key tripping
+        # means a band is too tight for real round-over-round noise.
+        # (The committed baseline moved on to r18, so the r17 baseline
+        # is reconstructed here with the same seeding path.)
         r16 = R.load_round(os.path.join(REPO_ROOT,
                                         "BENCH_r16.json")).keys
-        base = R.load_baseline(BASELINE)
-        bad = sorted(d.key for d in R.regressions(R.compare(r16, base)))
+        r17 = R.load_round(os.path.join(REPO_ROOT,
+                                        "BENCH_r17.json")).keys
+        base17 = R.make_baseline(r17, round_n=17)
+        bad = sorted(d.key
+                     for d in R.regressions(R.compare(r16, base17)))
         assert bad == ["device_util_pct", "history_write_p99_us"], bad
 
 
@@ -233,7 +252,7 @@ class TestGateCli:
         out_path = tmp_path / "PERF_BASELINE.json"
         monkeypatch.setattr(gate, "BASELINE_PATH", str(out_path))
         rc = gate._seed_baseline(
-            os.path.join(REPO_ROOT, "BENCH_r17.json"))
+            os.path.join(REPO_ROOT, "BENCH_r18.json"))
         assert rc == 0
         reseeded = R.load_baseline(str(out_path))
         committed = R.load_baseline(BASELINE)
