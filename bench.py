@@ -388,6 +388,49 @@ def measure_service_p99(n_rows: int = 200_000, submissions: int = 8,
     }
 
 
+def measure_soak(total_queries: int = 80, qps: float = 10.0,
+                 rows: int = 4096):
+    """Sustained mixed-traffic stage (service/soak.py): drive the
+    repeat-heavy fingerprint mix through the service at open-loop QPS
+    with ONE seeded worker-kill fault, and read the soak plane's six
+    gated keys from the report.  Quota-driven (total_queries) rather
+    than wall-driven so the stage is seconds-scale and deterministic
+    in shape; the fault lands at 2s — late enough for a measured
+    pre-fault p99, early enough that every run exercises the kill ->
+    recovery -> re-convergence path.  leak_drift_bytes is the
+    pool-idle-floor regression over the run and MUST be exactly 0
+    (scale-invariant in the perf gate); anomaly_fp_rate is the
+    sentinel's false-positive share over the stationary traffic."""
+    import tempfile
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.obs import anomaly as _anomaly
+    from spark_rapids_tpu.obs import history as _history
+    from spark_rapids_tpu.service.soak import SoakConfig, run_soak
+    hist_dir = tempfile.mkdtemp(prefix="bench_soak_history_")
+    s = TpuSession(TpuConf({
+        "spark.rapids.tpu.sql.enabled": True,
+        "spark.rapids.tpu.obs.history.dir": hist_dir,
+    }))
+    _history.reset()
+    _anomaly.reset()
+    cfg = SoakConfig(
+        duration_s=60.0, total_queries=total_queries, qps=qps,
+        rows=rows, partitions=2, seed=42,
+        faults=((2.0, "kill_pipeline_worker"),), num_workers=2)
+    report = run_soak(s, cfg).to_dict()
+    anom = report.get("anomaly") or {}
+    return {
+        "sustained_Mrows_s": round(
+            (report["totals"].get("sustained_rows_s") or 0.0) / 1e6, 4),
+        "soak_p99_ms": report["latency"]["p99_ms"],
+        "shed_rate_pct": report["shed_rate_pct"],
+        "leak_drift_bytes": report["leak_drift_bytes"],
+        "anomaly_fp_rate": anom.get("fp_rate_pct", 0.0),
+        "fault_recovery_ratio": report["fault_recovery_ratio"],
+    }
+
+
 def main():
     # 64M rows: fixed dispatch/flush overhead (the ~90ms tunnel round
     # trips) amortizes and the measurement approaches the engines'
@@ -441,6 +484,7 @@ def main():
     cpu_t, _, _, _ = run_engine(False, n_rows, parts, repeats)
     svc_keys = measure_service_p99(cold_ms=planner_cold)
     service_p99 = svc_keys["service_p99_ms"]
+    soak_keys = measure_soak()
     disp = (tpu_prof or {}).get("dispatches", {}).get("all", {})
     diag = tpu_perf.get("diagnosis")
     tl = tpu_perf.get("timeline") or {}
@@ -570,6 +614,19 @@ def main():
         "planner_path_ms_cold": svc_keys["planner_path_ms_cold"],
         "planner_path_ms_warm": svc_keys["planner_path_ms_warm"],
         "predicted_exec_err_pct": svc_keys["predicted_exec_err_pct"],
+        # soak plane (service/soak.py, obs/burn.py, service/faults.py):
+        # sustained mixed-traffic throughput and p99 through the
+        # service under ONE seeded worker-kill fault, the open-loop
+        # shed share, the pool-idle-floor memory drift over the run
+        # (gated exact 0 — a nonzero value IS a leak), the anomaly
+        # sentinel's false-positive share over stationary traffic, and
+        # the fraction of injected fault windows whose p99 recovered
+        "sustained_Mrows_s": soak_keys["sustained_Mrows_s"],
+        "soak_p99_ms": soak_keys["soak_p99_ms"],
+        "shed_rate_pct": soak_keys["shed_rate_pct"],
+        "leak_drift_bytes": soak_keys["leak_drift_bytes"],
+        "anomaly_fp_rate": soak_keys["anomaly_fp_rate"],
+        "fault_recovery_ratio": soak_keys["fault_recovery_ratio"],
     }))
 
 

@@ -28,6 +28,15 @@ Usage:
                                                # budget; the wide
                                                # throughput bands let
                                                # -5% through)
+  python ci/perf_gate.py --fixture soak_drift  # seeded record with a
+                                               # nonzero leak_drift_bytes
+                                               # and a high
+                                               # anomaly_fp_rate; the
+                                               # exact-0 drift gate and
+                                               # the fp-rate band MUST
+                                               # trip (self-test of the
+                                               # soak-plane gates; the
+                                               # smoke harness inverts)
   python ci/perf_gate.py --seed-baseline FILE  # (re)write
                                                # PERF_BASELINE.json from a
                                                # bench record file
@@ -57,7 +66,8 @@ BASELINE_PATH = os.path.join(REPO_ROOT, "PERF_BASELINE.json")
 
 #: keys safe to gate on a scaled-down --run (row-count independent)
 _SCALE_INVARIANT = ("flushes", "superstage_off_flushes",
-                    "predicted_flushes", "undeclared_transfers")
+                    "predicted_flushes", "undeclared_transfers",
+                    "leak_drift_bytes")
 
 
 def _print_doctor_verdict(record):
@@ -108,6 +118,12 @@ def _fixture(kind: str) -> int:
     through the 15-18% throughput bands, but the 2%-band
     ``all_planes_on_vs_off`` ratio MUST trip: the seeded self-test of
     the observability ≤2%-overhead budget.
+    ``soak_drift``: throughput untouched (scale 1.0) but
+    ``leak_drift_bytes`` forced nonzero and ``anomaly_fp_rate``
+    pushed past its band+floor — the exact-0 drift gate and the
+    fp-rate band MUST trip: the seeded self-test of the soak-plane
+    gates (a reintroduced inter-query leak or a sentinel that cries
+    wolf on stationary traffic fails CI, not a soak postmortem).
 
     The seeded record starts from the newest recorded round's FULL
     key set (so it carries ``util_gap_breakdown`` and the doctor can
@@ -121,9 +137,15 @@ def _fixture(kind: str) -> int:
         scaled = R.seeded_record(base, 1.5)
     elif kind == "obs_tax":
         scaled = R.seeded_record(base, 0.95)
+    elif kind == "soak_drift":
+        scaled = R.seeded_record(base, 1.0)
+        # a 4 KiB idle-floor regression — any nonzero drift IS a leak
+        scaled["leak_drift_bytes"] = 4096
+        # past both the 150% band and the 50-point abs floor
+        scaled["anomaly_fp_rate"] = 90.0
     else:
         print(f"unknown fixture {kind!r}; expected regression, "
-              "improvement or obs_tax", file=sys.stderr)
+              "improvement, obs_tax or soak_drift", file=sys.stderr)
         return 2
     newest = _newest_round()
     rec = dict(newest.keys) if newest is not None else {}
@@ -188,8 +210,8 @@ def main(argv) -> int:
     if "--fixture" in argv:
         i = argv.index("--fixture")
         if i + 1 >= len(argv):
-            print("--fixture requires regression|improvement|obs_tax",
-                  file=sys.stderr)
+            print("--fixture requires regression|improvement|obs_tax"
+                  "|soak_drift", file=sys.stderr)
             return 2
         return _fixture(argv[i + 1])
     if "--seed-baseline" in argv:

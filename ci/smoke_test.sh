@@ -68,6 +68,9 @@ JAX_PLATFORMS=cpu python ci/compile_smoke.py
 echo "== runtime stats plane (attribution, skew stats, zero extra flushes) =="
 JAX_PLATFORMS=cpu python ci/stats_smoke.py
 
+echo "== soak plane (chaos soak, fault markers, burn monitors, flush parity) =="
+JAX_PLATFORMS=cpu python ci/soak_smoke.py
+
 echo "== api validation (docs vs live registry) =="
 python -m spark_rapids_tpu.tools.api_validation
 
@@ -81,6 +84,11 @@ fi
 JAX_PLATFORMS=cpu python ci/perf_gate.py --fixture improvement \
   | grep -q "baseline bump" \
   || { echo "perf-gate improvement fixture missing bump suggestion" >&2; exit 1; }
+# ...and a record with nonzero leak drift + a crying-wolf sentinel must
+# trip the soak-plane gates (exact-0 drift, fp-rate band)
+if JAX_PLATFORMS=cpu python ci/perf_gate.py --fixture soak_drift >/dev/null; then
+  echo "perf-gate soak_drift fixture did NOT trip the gate" >&2; exit 1
+fi
 
 echo "== bench sanity (tiny, gated on row-count-independent keys) =="
 JAX_PLATFORMS=cpu python ci/perf_gate.py --run 100000

@@ -1,0 +1,130 @@
+"""CI smoke for the soak plane (service/soak.py, service/faults.py,
+obs/burn.py): one short chaos soak through the real service, then
+assert (1) correctness under fault — every completed result
+sha-verified, zero failures, zero shed at the modest smoke QPS, (2)
+the injected worker kill left the full marker trail: a fault window
+in the report with measured before/during/after p99 and a recovery
+verdict, begin/end ``fault`` records on the event log carrying the
+kind and the diag bundle path, and a diagnostic bundle on disk with
+trigger ``fault`` citing the injected kind, (3) bounded p99 impact —
+the run's overall p99 stays inside the smoke bound and the service
+recovered (recovery ratio 1.0), (4) the leak-drift monitor read
+exactly 0 bytes over the run, (5) ``tools/report.py --soak`` renders
+the written report, (6) the monitors are free at the device: an
+identical fixed-quota soak with the burn plane ON and OFF produces
+the SAME device flush count (the soak plane folds rows the service
+already collected — it never touches the device).
+"""
+import json
+import os
+import sys
+import tempfile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from spark_rapids_tpu.api import TpuSession  # noqa: E402
+from spark_rapids_tpu.config import TpuConf  # noqa: E402
+
+#: loose smoke bound on the chaos run's overall p99 — a worker kill
+#: must dent latency, not detonate it (steady-state runs measure
+#: ~30ms on this host class; CI noise gets an order of magnitude)
+_P99_BOUND_MS = 1000.0
+
+
+def _run(session, **kw):
+    from spark_rapids_tpu.service.soak import SoakConfig, run_soak
+    cfg = SoakConfig(rows=2048, partitions=2, seed=42, num_workers=2,
+                     **kw)
+    return run_soak(session, cfg).to_dict()
+
+
+def main():
+    td = tempfile.mkdtemp(prefix="soak_smoke_")
+    log_path = os.path.join(td, "events.jsonl")
+    diag_dir = os.path.join(td, "diag")
+    s = TpuSession(TpuConf({
+        "spark.rapids.tpu.eventLog.path": log_path,
+        "spark.rapids.tpu.obs.diagnostics.dir": diag_dir,
+        "spark.rapids.tpu.obs.history.dir": os.path.join(td, "history"),
+    }))
+
+    # 1+2+3+4: the chaos soak — fixed quota, one seeded worker kill
+    rep = _run(s, duration_s=30.0, total_queries=40, qps=8.0,
+               faults=((1.5, "kill_pipeline_worker"),))
+    tot = rep["totals"]
+    assert tot["completed"] == 40, tot
+    assert tot["failed"] == 0 and tot["sha_mismatch"] == 0, tot
+    assert tot["shed"] == 0, tot
+    assert rep["latency"]["p99_ms"] <= _P99_BOUND_MS, rep["latency"]
+    assert rep["leak_drift_bytes"] == 0, rep["leak_drift_bytes"]
+    assert rep["fault_recovery_ratio"] == 1.0, rep["faults"]
+    windows = rep["faults"]
+    assert len(windows) == 1, windows
+    w = windows[0]
+    assert w["kind"] == "kill_pipeline_worker", w
+    assert w["end_s"] is not None and w["recovered"], w
+    assert w["p99_during_ms"] is not None, w
+    # the window's bundle exists and cites the injected fault
+    assert w["diag_bundle"] and os.path.exists(w["diag_bundle"]), w
+    bundle = json.load(open(w["diag_bundle"]))
+    assert bundle["trigger"] == "fault", bundle["trigger"]
+    assert "kill_pipeline_worker" in \
+        (bundle.get("error") or {}).get("message", ""), bundle
+    # the event log carries the begin/end fault markers with the same
+    # kind and bundle path the report's window cites
+    from spark_rapids_tpu.tools.events import read_event_log
+    marks = list(read_event_log(log_path, events="fault"))
+    phases = [(r["phase"], r["fault_kind"]) for r in marks]
+    assert ("begin", "kill_pipeline_worker") in phases, phases
+    assert ("end", "kill_pipeline_worker") in phases, phases
+    assert any(r.get("diag_bundle") == w["diag_bundle"]
+               for r in marks), marks
+    # the timeline annotated the fault's bucket(s)
+    annotated = [b for b in rep["timeline"] if b["faults"]]
+    assert annotated and all(
+        "kill_pipeline_worker" in b["faults"] for b in annotated), \
+        rep["timeline"]
+    print(f"chaos soak OK: completed={tot['completed']}, "
+          f"p99={rep['latency']['p99_ms']}ms, "
+          f"recovery_s={w['recovery_s']}, "
+          f"drift={rep['leak_drift_bytes']}B")
+
+    # 5: the report tool renders the written artifact
+    rep_path = os.path.join(td, "soak_report.json")
+    with open(rep_path, "w", encoding="utf-8") as f:
+        json.dump(rep, f)
+    from spark_rapids_tpu.tools.report import main as report_main
+    assert report_main([rep_path, "--soak"]) == 0
+    print("soak report OK")
+
+    # 6: exact flush parity — the same fixed-quota soak with the burn
+    # plane on vs off adds ZERO device flushes (process is warm from
+    # the chaos run above, so both measurements start from the same
+    # compiled state)
+    from spark_rapids_tpu.columnar import pending as _pending
+
+    def _flushes(conf):
+        sess = TpuSession(conf)
+        f0 = _pending.FLUSH_COUNT
+        r = _run(sess, duration_s=30.0, total_queries=12, qps=8.0)
+        assert r["totals"]["failed"] == 0, r["totals"]
+        return _pending.FLUSH_COUNT - f0
+    on_f = _flushes(TpuConf({}))
+    off_f = _flushes(TpuConf({
+        "spark.rapids.tpu.obs.burn.enabled": False}))
+    assert on_f == off_f, (on_f, off_f)
+    # restore the default-on burn plane for anything after us
+    from spark_rapids_tpu.obs import burn as _burn
+    _burn.configure(TpuConf({}))
+    print(f"flush parity OK: on/off={on_f}/{off_f}")
+    print("soak smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -747,7 +747,8 @@ def _scopes_for(rel: str) -> Set[str]:
                      "memplane.py", "doctor.py", "costplane.py",
                      "regression.py", "warmup.py", "fingerprint.py",
                      "history.py", "anomaly.py", "dashboard.py",
-                     "bands.py", "plan_cache.py", "scheduler.py"):
+                     "bands.py", "plan_cache.py", "scheduler.py",
+                     "burn.py", "soak.py", "faults.py"):
         # the superstage compiler exists to ELIMINATE host round trips:
         # the AOT warmup daemon (service/warmup.py) calls jitted
         # programs from a background thread and carries the same
@@ -766,8 +767,12 @@ def _scopes_for(rel: str) -> Set[str]:
         # the shared band core (analysis/bands.py), the plan cache +
         # predictive scheduler (cache/plan_cache.py,
         # service/scheduler.py — both sit on the admission/planning
-        # path) and their exchange call sites carry the same
-        # zero-flush + allocation-free-record contract
+        # path), the soak plane (obs/burn.py folds on the terminal
+        # path; service/soak.py + service/faults.py drive the REAL
+        # service and must add zero device flushes of their own —
+        # the on-vs-off FLUSH_COUNT parity test pins it) and their
+        # exchange call sites carry the same zero-flush +
+        # allocation-free-record contract
         scopes |= {SYNC001, OBS002}
     if base == "overhead.py":
         # the self-meter's own record path: an allocation there bills
@@ -776,7 +781,8 @@ def _scopes_for(rel: str) -> Set[str]:
     if "obs" in parts or base in ("regression.py", "aot.py",
                                   "warmup.py", "bands.py",
                                   "history.py", "plan_cache.py",
-                                  "scheduler.py"):
+                                  "scheduler.py", "soak.py",
+                                  "faults.py"):
         # the doctor lives in obs/ (covered by the parts check); the
         # sentinel sits in analysis/ but carries the same timing-
         # hygiene contract as the planes whose artifacts it gates;

@@ -126,6 +126,26 @@ GATE_KEYS: Tuple[Tuple[str, str, float], ...] = (
     # bands above but trips here (the 0.95 seeded perf-gate fixture
     # pins exactly that)
     ("all_planes_on_vs_off", "higher", 2.0),
+    # soak plane (service/soak.py, obs/burn.py, service/faults.py):
+    # sustained mixed-traffic throughput and p99 through the service
+    # under one seeded worker-kill fault (wide p99 band + floor —
+    # service-burst latency at bench scale is host-jitter-dominated),
+    # the open-loop shed share (lower, floored — a small shed count on
+    # a saturated burst is fine, the gate catches the service starting
+    # to refuse its steady load), the pool-idle-floor memory drift
+    # over the run (EXACT 0 — a nonzero drift IS a leak; also
+    # scale-invariant in ci/perf_gate.py so --run at any row count
+    # still gates it), the anomaly sentinel's false-positive share
+    # over stationary traffic (lower, floored — the sentinel must not
+    # cry wolf on a steady soak), and the fraction of injected fault
+    # windows whose p99 recovered (higher — 1.0 means every fault
+    # healed within its guard window)
+    ("sustained_Mrows_s", "higher", 18.0),
+    ("soak_p99_ms", "lower", 150.0),
+    ("shed_rate_pct", "lower", 150.0),
+    ("leak_drift_bytes", "exact", 0.0),
+    ("anomaly_fp_rate", "lower", 150.0),
+    ("fault_recovery_ratio", "higher", 18.0),
 )
 
 #: keys scaled by the seeded perf-gate fixtures (throughput-like).
@@ -148,6 +168,9 @@ ABS_FLOORS = {
     "planner_path_ms_cold": 5.0,
     "planner_path_ms_warm": 5.0,
     "predicted_exec_err_pct": 50.0,
+    "soak_p99_ms": 200.0,
+    "shed_rate_pct": 20.0,
+    "anomaly_fp_rate": 50.0,
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -486,6 +486,14 @@ OBS_WATCHDOG_STALL_S = conf_int(
     "spark.rapids.tpu.obs.watchdog.stallSeconds", 120,
     "A RUNNING query with no flight-recorder progress for this long is "
     "declared stalled and triggers the watchdog")
+OBS_WATCHDOG_REFIRE_S = conf_float(
+    "spark.rapids.tpu.obs.watchdog.refireSeconds", 0.0,
+    "Rate-limited periodic re-fire for a query that STAYS stalled: "
+    "after the first trigger the watchdog fires again (fresh stacks + "
+    "diag bundle + event) every this many seconds while the stall "
+    "persists, so a soak-length hang keeps producing evidence instead "
+    "of going silent after one bundle.  0 keeps the legacy "
+    "once-per-query behavior")
 OBS_DIAG_DIR = conf_str(
     "spark.rapids.tpu.obs.diagnostics.dir", "",
     "Directory for automatic failure diagnostic bundles: on query "
@@ -766,6 +774,53 @@ OBS_ANOMALY_BUNDLE_INTERVAL_S = conf_float(
     "bundle per this many seconds process-wide (0 disables anomaly "
     "bundles); breach events and Prometheus counters are never "
     "rate-limited")
+OBS_BURN_ENABLED = conf_bool(
+    "spark.rapids.tpu.obs.burn.enabled", True,
+    "Longitudinal burn-rate plane (obs/burn.py): folds every terminal "
+    "history row into per-tenant fast/slow SLO burn-rate windows, an "
+    "EWMA-slope steady-state detector and a sampled memplane "
+    "leak-drift regression — the live monitors of a soak run "
+    "(service/soak.py).  Pure host arithmetic over rows the history "
+    "store already built: zero extra device flushes; self-cost billed "
+    "to the overhead meter's 'burn' plane")
+OBS_BURN_FAST_WINDOW_S = conf_float(
+    "spark.rapids.tpu.obs.burn.fastWindowSeconds", 60.0,
+    "Span of the fast burn-rate window (incident detection: a "
+    "burn rate >> 1 here means the error budget is being consumed "
+    "far faster than allowed).  Keyed on the rows' own submit "
+    "timestamps, so the math replays identically from history "
+    "segments")
+OBS_BURN_SLOW_WINDOW_S = conf_float(
+    "spark.rapids.tpu.obs.burn.slowWindowSeconds", 600.0,
+    "Span of the slow burn-rate window (sustained-burn confirmation; "
+    "the SRE multi-window pattern pages only when BOTH windows burn)")
+OBS_BURN_BUDGET_PCT = conf_float(
+    "spark.rapids.tpu.obs.burn.budgetPct", 1.0,
+    "Error budget as a percent of queries allowed to breach the "
+    "obs.slo.targetMs target (shed/failed queries always count as "
+    "breaches); burn rate 1.0 = consuming the budget exactly as fast "
+    "as allowed")
+OBS_BURN_EWMA_ALPHA = conf_float(
+    "spark.rapids.tpu.obs.burn.ewmaAlpha", 0.2,
+    "Smoothing factor of the steady-state detector's end-to-end "
+    "latency EWMA")
+OBS_BURN_STEADY_SLOPE_PCT = conf_float(
+    "spark.rapids.tpu.obs.burn.steadySlopePct", 5.0,
+    "Per-fold relative EWMA slope (percent) under which a fold counts "
+    "toward the steady-state streak; a fold above it breaks the "
+    "streak (and drops an established steady state — counted as a "
+    "loss, e.g. across an injected fault)")
+OBS_BURN_STEADY_RUNS = conf_int(
+    "spark.rapids.tpu.obs.burn.steadyRuns", 8,
+    "Consecutive in-slope folds required before the run is declared "
+    "stationary (stamped with the qualifying row's timestamp)")
+OBS_BURN_MEM_SAMPLES = conf_int(
+    "spark.rapids.tpu.obs.burn.memSamples", 512,
+    "Bound on buffered memplane live-bytes samples for the leak-drift "
+    "regression (oldest dropped past it — fixed memory); drift "
+    "compares the min of the newest half against the min of the "
+    "oldest half, so a clean run reads exactly 0 bytes",
+    internal=True)
 OBS_DASHBOARD_ENABLED = conf_bool(
     "spark.rapids.tpu.obs.dashboard.enabled", True,
     "Fleet dashboard (obs/dashboard.py): a self-contained HTML view — "
@@ -773,6 +828,11 @@ OBS_DASHBOARD_ENABLED = conf_bool(
     "doctor verdict mix, per-tenant table — served at /dashboard "
     "beside the Prometheus text endpoint and renderable offline via "
     "tools/history.py")
+OBS_DASHBOARD_REFRESH_S = conf_float(
+    "spark.rapids.tpu.obs.dashboard.refreshSeconds", 5.0,
+    "Meta auto-refresh interval of the served /dashboard page, so it "
+    "works as a live soak console; 0 renders a static page (offline "
+    "rendering via tools/history.py is always static)")
 SUPERSTAGE = conf_bool(
     "spark.rapids.tpu.sql.superstage", True,
     "Superstage compiler (compile/): a planner post-pass after the "

@@ -42,7 +42,7 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ..analysis.bands import REGRESSION, band_status
-from .registry import ANOMALY_CHECKS, ANOMALY_EVENTS
+from .registry import ANOMALY_CHECKS, ANOMALY_EVENTS, ANOMALY_FP
 
 #: watched history-row keys: (key, direction, band_pct, abs_floor) —
 #: direction/band semantics are the offline gate's (analysis/bands.py);
@@ -71,6 +71,14 @@ _MAX_FPS = 1024
 _LOCK = threading.Lock()
 _LAST_BUNDLE_MONO: Optional[float] = None
 _FP_OVERFLOW = 0
+
+#: false-positive accounting: outliers never train the model and the
+#: baseline stays frozen, so a breach that recovers did NOT reflect a
+#: confirmed level shift — it was transient.  On stationary traffic
+#: (a soak run's steady window) the fp/breach ratio is the sentinel's
+#: false-positive rate (the soak gate's ``anomaly_fp_rate``).
+_BREACH_TOTAL = 0
+_FP_TOTAL = 0
 
 
 class _KeyState:
@@ -119,6 +127,7 @@ def active_count() -> int:
 def _fold_key(fp: str, key: str, direction: str, band: float,
               floor: float, cur: float, ks: _KeyState,
               events: List[Dict]) -> None:
+    global _BREACH_TOTAL, _FP_TOTAL
     ks.count += 1
     ks.last = cur
     ks.recent.append(cur)
@@ -157,6 +166,7 @@ def _fold_key(fp: str, key: str, direction: str, band: float,
                 "runs": ks.count,
             })
             ANOMALY_EVENTS.labels(kind="breach").inc()
+            _BREACH_TOTAL += 1
         return
     # in-band (or improved): train the model, count toward recovery
     diff = cur - ks.mean
@@ -174,8 +184,14 @@ def _fold_key(fp: str, key: str, direction: str, band: float,
                 "direction": direction,
                 "baseline": round(base, 3),
                 "current": round(cur, 3), "runs": ks.count,
+                "false_positive": True,
             })
             ANOMALY_EVENTS.labels(kind="recovery").inc()
+            # the baseline never re-trained while breached, so this
+            # recovery closed a breach with NO confirmed level shift:
+            # a transient false positive (soak fp accounting)
+            _FP_TOTAL += 1
+            ANOMALY_FP.inc()
 
 
 def fold(row: Dict) -> List[Dict]:
@@ -312,6 +328,7 @@ def stats_section() -> Dict:
         overflow = _FP_OVERFLOW
         checks = sum(ks.count for st in _FPS.values()
                      for ks in st.keys.values())
+        breaches, fp_count = _BREACH_TOTAL, _FP_TOTAL
     return {
         "enabled": _ENABLED,
         "fingerprints": fps,
@@ -321,7 +338,20 @@ def stats_section() -> Dict:
         "min_runs": _MIN_N,
         "breach_runs": _K,
         "sigma": _SIGMA,
+        "breach_total": breaches,
+        "fp_total": fp_count,
+        "fp_rate_pct": fp_rate_pct(),
     }
+
+
+def fp_rate_pct() -> float:
+    """False positives over breach-opens, percent (0.0 with no
+    breaches — a clean stationary run).  The soak gate's
+    ``anomaly_fp_rate`` bench key."""
+    with _LOCK:
+        if _BREACH_TOTAL <= 0:
+            return 0.0
+        return round(100.0 * _FP_TOTAL / _BREACH_TOTAL, 2)
 
 
 # ---------------------------------------------------------------------------
@@ -351,8 +381,10 @@ def configure(conf) -> None:
 
 def reset() -> None:
     """Test hook: drop all sentinel state."""
-    global _FP_OVERFLOW, _LAST_BUNDLE_MONO
+    global _FP_OVERFLOW, _LAST_BUNDLE_MONO, _BREACH_TOTAL, _FP_TOTAL
     with _LOCK:
         _FPS.clear()
         _FP_OVERFLOW = 0
         _LAST_BUNDLE_MONO = None
+        _BREACH_TOTAL = 0
+        _FP_TOTAL = 0

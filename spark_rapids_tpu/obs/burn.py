@@ -1,0 +1,282 @@
+"""Longitudinal soak monitors — the burn-rate plane (obs/burn.py).
+
+The per-query planes (slo, history, anomaly, memplane) answer "what did
+THIS query cost"; a soak run needs the longitudinal view: is the
+service *staying* inside its SLO budget, has throughput settled into a
+stationary regime, and is device memory creeping between queries?
+This module folds every terminal history row (service/server.py
+``_record_terminal``) into four monitors:
+
+- **multi-window burn rate** per tenant: the fraction of the error
+  budget (``obs.burn.budgetPct`` of queries allowed to breach the
+  ``obs.slo.targetMs`` target) consumed inside a fast and a slow
+  sliding window, the SRE multi-window alerting shape — a fast-window
+  spike catches an incident in seconds, the slow window filters
+  flapping.  Windows are keyed on the rows' own submit timestamps, so
+  the math is replayable from history segments (no wall clock here).
+- **EWMA-slope steady-state detector**: an exponentially weighted
+  moving average of end-to-end latency; when its per-fold relative
+  slope stays under ``steadySlopePct`` for ``steadyRuns`` consecutive
+  folds the run is declared stationary (stamped with the row ts).  A
+  fault or load shift breaks the streak (a "loss"); re-convergence is
+  counted, so a soak report can show the detector recovering after
+  every injected fault.
+- **leak-drift tracking**: sampled memplane live device bytes
+  (``sample_memplane`` — the soak harness calls it between
+  completions).  Drift compares the *minimum* of the newest half of
+  samples against the minimum of the oldest half: pool-idle floors,
+  so transient per-query peaks cancel and a clean run's drift is
+  exactly 0 bytes (gated exact by ci/perf_gate.py).
+- **history-writer contention**: re-exports the history store's
+  background append p99 so the soak report carries the off-query-path
+  write cost under sustained load.
+
+Self-cost discipline: ``fold`` brackets itself with the PR 17 overhead
+meter (plane ``burn``), holds one lock, appends bounded deque entries
+and mutates preallocated state — no device work, zero extra flushes by
+construction.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Dict, Optional
+
+from . import overhead as _overhead
+from .registry import BURN_RATE, BURN_STEADY_STATE
+
+_ENABLED = True
+_FAST_S = 60.0
+_SLOW_S = 600.0
+_BUDGET_PCT = 1.0
+_ALPHA = 0.2
+_SLOPE_EPS_PCT = 5.0
+_STEADY_RUNS = 8
+_MAX_MEM_SAMPLES = 512
+
+_LOCK = threading.Lock()
+
+
+class _TenantBurn:
+    """One tenant's fast/slow breach windows: deques of (ts, breach)."""
+
+    __slots__ = ("fast", "slow", "count", "breaches")
+
+    def __init__(self):
+        self.fast: Deque = deque()
+        self.slow: Deque = deque()
+        self.count = 0
+        self.breaches = 0
+
+
+_TENANTS: Dict[str, _TenantBurn] = {}
+
+# steady-state detector state (global across tenants: the soak regime
+# is a property of the whole service, not one tenant's slice)
+_EWMA_MS: Optional[float] = None
+_SLOPE_PCT = 0.0
+_STREAK = 0
+_STEADY = False
+_STEADY_SINCE_TS: Optional[float] = None
+_CONVERGE_COUNT = 0
+_STEADY_LOSSES = 0
+_FOLDS = 0
+
+#: sampled memplane live-bytes floor (leak drift input)
+_MEM_SAMPLES: Deque[int] = deque(maxlen=_MAX_MEM_SAMPLES)
+
+
+def _slo_target_ms() -> float:
+    from . import slo as _slo
+    return float(getattr(_slo, "_TARGET_MS", 0.0) or 0.0)
+
+
+def _window_rate(win: Deque, now_ts: float, span_s: float,
+                 budget_frac: float) -> float:
+    """Burn rate of one window: breach fraction over the allowed
+    fraction.  1.0 = burning the budget exactly as fast as allowed."""
+    cutoff = now_ts - span_s
+    while win and win[0][0] < cutoff:
+        win.popleft()
+    if not win:
+        return 0.0
+    frac = sum(b for _, b in win) / len(win)
+    return frac / budget_frac if budget_frac > 0 else 0.0
+
+
+def fold(row: Dict) -> None:
+    """Fold one terminal history row into the burn/steady monitors.
+
+    Called by the service right after the history store accepts the
+    row; self-cost is billed to the overhead meter's ``burn`` plane."""
+    global _EWMA_MS, _SLOPE_PCT, _STREAK, _STEADY, _STEADY_SINCE_TS
+    global _CONVERGE_COUNT, _STEADY_LOSSES, _FOLDS
+    if not _ENABLED or row is None:
+        return
+    _t0 = _overhead.clock()
+    ts = float(row.get("ts") or 0.0)
+    tenant = row.get("tenant") or "default"
+    total_ms = float(row.get("queue_ms") or 0.0) \
+        + float(row.get("exec_ms") or 0.0)
+    target = _slo_target_ms()
+    breach = 1 if (row.get("outcome") != "completed"
+                   or (target > 0.0 and total_ms > target)) else 0
+    budget_frac = _BUDGET_PCT / 100.0
+    with _LOCK:
+        _FOLDS += 1
+        tb = _TENANTS.get(tenant)
+        if tb is None:
+            tb = _TENANTS[tenant] = _TenantBurn()
+        tb.count += 1
+        tb.breaches += breach
+        tb.fast.append((ts, breach))
+        tb.slow.append((ts, breach))
+        fast = _window_rate(tb.fast, ts, _FAST_S, budget_frac)
+        slow = _window_rate(tb.slow, ts, _SLOW_S, budget_frac)
+        # steady-state EWMA slope over completed-query latency only
+        # (shed/failed latencies are not the service's operating point)
+        if row.get("outcome") == "completed":
+            if _EWMA_MS is None:
+                _EWMA_MS = total_ms
+                _SLOPE_PCT = 100.0
+            else:
+                prev = _EWMA_MS
+                _EWMA_MS = prev + _ALPHA * (total_ms - prev)
+                _SLOPE_PCT = (abs(_EWMA_MS - prev)
+                              / max(prev, 1e-9) * 100.0)
+            if _SLOPE_PCT <= _SLOPE_EPS_PCT:
+                _STREAK += 1
+                if _STREAK >= _STEADY_RUNS and not _STEADY:
+                    _STEADY = True
+                    _STEADY_SINCE_TS = ts
+                    _CONVERGE_COUNT += 1
+            else:
+                if _STEADY:
+                    _STEADY_LOSSES += 1
+                _STEADY = False
+                _STEADY_SINCE_TS = None
+                _STREAK = 0
+        steady = _STEADY
+    BURN_RATE.labels(tenant=tenant, window="fast").set(round(fast, 4))
+    BURN_RATE.labels(tenant=tenant, window="slow").set(round(slow, 4))
+    BURN_STEADY_STATE.set(1 if steady else 0)
+    _overhead.note(_overhead.P_BURN, _t0)
+
+
+def sample_memplane() -> int:
+    """Sample the memplane's live device bytes into the drift window.
+
+    The soak harness calls this between completions (the pool-idle
+    floor); self-cost is billed to the ``burn`` plane."""
+    _t0 = _overhead.clock()
+    from . import memplane as _memplane
+    live = int(_memplane.headroom().get("device_bytes") or 0)
+    with _LOCK:
+        _MEM_SAMPLES.append(live)
+    _overhead.note(_overhead.P_BURN, _t0)
+    return live
+
+
+def leak_drift_bytes() -> int:
+    """min(newest half of samples) - min(oldest half), floored at 0.
+
+    Minima compare pool-idle floors, so per-query transients cancel:
+    a clean soak run's drift is exactly 0 bytes."""
+    with _LOCK:
+        samples = list(_MEM_SAMPLES)
+    if len(samples) < 4:
+        return 0
+    half = len(samples) // 2
+    return max(0, min(samples[half:]) - min(samples[:half]))
+
+
+def burn_rates() -> Dict[str, Dict]:
+    """Current per-tenant burn rates (recomputed on the stored
+    windows' own newest timestamps — a pure read)."""
+    budget_frac = _BUDGET_PCT / 100.0
+    out: Dict[str, Dict] = {}
+    with _LOCK:
+        for tenant, tb in _TENANTS.items():
+            now_ts = tb.slow[-1][0] if tb.slow else 0.0
+            out[tenant] = {
+                "fast": round(_window_rate(tb.fast, now_ts, _FAST_S,
+                                           budget_frac), 4),
+                "slow": round(_window_rate(tb.slow, now_ts, _SLOW_S,
+                                           budget_frac), 4),
+                "count": tb.count,
+                "breaches": tb.breaches,
+            }
+    return out
+
+
+def steady_state() -> Dict:
+    with _LOCK:
+        return {
+            "steady": _STEADY,
+            "since_ts": _STEADY_SINCE_TS,
+            "streak": _STREAK,
+            "ewma_ms": (round(_EWMA_MS, 3)
+                        if _EWMA_MS is not None else None),
+            "slope_pct": round(_SLOPE_PCT, 3),
+            "converge_count": _CONVERGE_COUNT,
+            "losses": _STEADY_LOSSES,
+        }
+
+
+def stats_section() -> Dict:
+    """The ``stats()['burn']`` section."""
+    from . import history as _history
+    with _LOCK:
+        mem_n = len(_MEM_SAMPLES)
+    return {
+        "enabled": bool(_ENABLED),
+        "folds": _FOLDS,
+        "budget_pct": _BUDGET_PCT,
+        "fast_window_s": _FAST_S,
+        "slow_window_s": _SLOW_S,
+        "tenants": burn_rates(),
+        "steady": steady_state(),
+        "leak": {"samples": mem_n,
+                 "drift_bytes": leak_drift_bytes()},
+        "history_write_p99_us": _history.write_p99_us(),
+    }
+
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.burn.*`` conf group."""
+    global _ENABLED, _FAST_S, _SLOW_S, _BUDGET_PCT, _ALPHA
+    global _SLOPE_EPS_PCT, _STEADY_RUNS, _MAX_MEM_SAMPLES, _MEM_SAMPLES
+    from ..config import (OBS_BURN_BUDGET_PCT, OBS_BURN_ENABLED,
+                          OBS_BURN_EWMA_ALPHA, OBS_BURN_FAST_WINDOW_S,
+                          OBS_BURN_MEM_SAMPLES, OBS_BURN_SLOW_WINDOW_S,
+                          OBS_BURN_STEADY_RUNS, OBS_BURN_STEADY_SLOPE_PCT)
+    _ENABLED = bool(conf.get(OBS_BURN_ENABLED))
+    _FAST_S = max(float(conf.get(OBS_BURN_FAST_WINDOW_S)), 0.001)
+    _SLOW_S = max(float(conf.get(OBS_BURN_SLOW_WINDOW_S)), _FAST_S)
+    _BUDGET_PCT = max(float(conf.get(OBS_BURN_BUDGET_PCT)), 0.0)
+    _ALPHA = min(max(float(conf.get(OBS_BURN_EWMA_ALPHA)), 0.001), 1.0)
+    _SLOPE_EPS_PCT = max(float(conf.get(OBS_BURN_STEADY_SLOPE_PCT)), 0.0)
+    _STEADY_RUNS = max(int(conf.get(OBS_BURN_STEADY_RUNS)), 1)
+    n = max(int(conf.get(OBS_BURN_MEM_SAMPLES)), 4)
+    if n != _MAX_MEM_SAMPLES:
+        _MAX_MEM_SAMPLES = n
+        with _LOCK:
+            _MEM_SAMPLES = deque(_MEM_SAMPLES, maxlen=n)
+
+
+def reset() -> None:
+    """Drop all burn/steady/drift state (tests, soak-run start)."""
+    global _TENANTS, _EWMA_MS, _SLOPE_PCT, _STREAK, _STEADY
+    global _STEADY_SINCE_TS, _CONVERGE_COUNT, _STEADY_LOSSES, _FOLDS
+    global _MEM_SAMPLES
+    with _LOCK:
+        _TENANTS = {}
+        _EWMA_MS = None
+        _SLOPE_PCT = 0.0
+        _STREAK = 0
+        _STEADY = False
+        _STEADY_SINCE_TS = None
+        _CONVERGE_COUNT = 0
+        _STEADY_LOSSES = 0
+        _FOLDS = 0
+        _MEM_SAMPLES = deque(maxlen=_MAX_MEM_SAMPLES)

@@ -20,6 +20,18 @@ from __future__ import annotations
 import html
 from typing import Dict, List
 
+#: meta-refresh cadence in seconds; <= 0 renders a static page.  Set
+#: by :func:`configure` from ``obs.dashboard.refreshSeconds`` so a
+#: soak operator's browser tab tracks the run without manual reloads.
+_REFRESH_S = 5.0
+
+
+def configure(conf) -> None:
+    """Apply the ``spark.rapids.tpu.obs.dashboard.*`` conf group."""
+    global _REFRESH_S
+    from ..config import OBS_DASHBOARD_REFRESH_S
+    _REFRESH_S = float(conf.get(OBS_DASHBOARD_REFRESH_S))
+
 _STYLE = """
 body{font-family:system-ui,sans-serif;margin:1.5em;background:#fafafa;
      color:#222}
@@ -117,6 +129,73 @@ def _tenant_rows(slo: Dict) -> List[List[str]]:
     return rows
 
 
+def _soak_panel() -> List[str]:
+    """Live soak-run state: the harness counters (service/soak.py),
+    the per-tenant burn rates and the steady-state verdict (burn.py).
+    An idle process (no soak running, no folds) renders one note."""
+    from . import burn as _burn
+    try:
+        from ..service.soak import stats_section as _soak_section
+        soak = _soak_section()
+    except Exception:
+        soak = {}
+    burn = _burn.stats_section()
+    parts: List[str] = []
+    if not soak.get("running") and not burn.get("folds"):
+        return ["<p class=note>no soak traffic yet</p>"]
+    status = ("<span class=ok>running</span>" if soak.get("running")
+              else "idle")
+    faults = soak.get("active_faults") or []
+    fault_html = (f"<span class=bad>{_esc(', '.join(faults))}</span>"
+                  if faults else "<span class=ok>none</span>")
+    parts.append(
+        f"<p class=note>status: {status} &middot; "
+        f"elapsed: {_esc(soak.get('elapsed_s', 0))}s &middot; "
+        f"qps: {_esc(soak.get('qps_actual', 0))}/"
+        f"{_esc(soak.get('qps_target', 0))} &middot; "
+        f"submitted: {_esc(soak.get('submitted', 0))} &middot; "
+        f"completed: {_esc(soak.get('completed', 0))} &middot; "
+        f"failed: {_esc(soak.get('failed', 0))} &middot; "
+        f"shed: {_esc(soak.get('shed', 0))} &middot; "
+        f"inflight: {_esc(soak.get('inflight', 0))} &middot; "
+        f"active faults: {fault_html}</p>")
+    steady = burn.get("steady") or {}
+    if steady.get("steady"):
+        parts.append(
+            "<p class=note>steady state: <span class=ok>reached</span>"
+            f" (ewma {_esc(steady.get('ewma_ms', 0))} ms, slope "
+            f"{_esc(steady.get('slope_pct', 0))}%, converged "
+            f"{_esc(steady.get('converge_count', 0))}x)</p>")
+    else:
+        parts.append(
+            "<p class=note>steady state: not reached (ewma "
+            f"{_esc(steady.get('ewma_ms', 0))} ms, slope "
+            f"{_esc(steady.get('slope_pct', 0))}%)</p>")
+    rates = _burn.burn_rates()
+    if rates:
+        rows = []
+        for tenant in sorted(rates):
+            r = rates[tenant]
+            fast, slow = r.get("fast", 0.0), r.get("slow", 0.0)
+            rows.append([
+                _esc(tenant),
+                (f"<span class=bad>{fast:.2f}</span>" if fast >= 1.0
+                 else f"{fast:.2f}"),
+                (f"<span class=bad>{slow:.2f}</span>" if slow >= 1.0
+                 else f"{slow:.2f}"),
+                _esc(r.get("count", 0)),
+                _esc(r.get("breaches", 0)),
+            ])
+        parts += _table(["tenant", "fast burn", "slow burn",
+                         "queries", "breaches"], rows)
+    leak = burn.get("leak") or {}
+    parts.append(
+        "<p class=note>leak drift: "
+        f"{_esc(leak.get('drift_bytes', 0))} bytes over "
+        f"{_esc(leak.get('samples', 0))} samples</p>")
+    return parts
+
+
 def render_html() -> str:
     """The whole dashboard page from the live plane snapshots."""
     from . import anomaly as _anomaly
@@ -124,6 +203,11 @@ def render_html() -> str:
     parts: List[str] = [
         "<!doctype html><html><head><meta charset='utf-8'>",
         "<title>TPU fleet dashboard</title>",
+    ]
+    if _REFRESH_S > 0:
+        parts.append("<meta http-equiv='refresh' "
+                     f"content='{_REFRESH_S:g}'>")
+    parts += [
         f"<style>{_STYLE}</style></head><body>",
         "<h1>TPU fleet dashboard</h1>",
     ]
@@ -213,6 +297,12 @@ def render_html() -> str:
                  for e in top])
     else:
         parts.append("<p class=note>no plan-cache lookups yet</p>")
+
+    parts.append("<h2>Soak</h2>")
+    try:
+        parts += _soak_panel()
+    except Exception as e:
+        parts.append(f"<p class=note>soak view unavailable: {_esc(e)}</p>")
 
     parts.append("<h2>Tenants (SLO)</h2>")
     try:

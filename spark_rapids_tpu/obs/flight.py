@@ -69,6 +69,9 @@ EV_COST = "cost"              # device-compute cost plane (name=program
 EV_MEM = "mem"                # memory plane (name=direction/reason
 #                               constant from obs/memplane.py; a=bytes,
 #                               b=duration ms or count)
+EV_FAULT = "fault"            # injected fault marker (service/faults.py;
+#                               name=fault kind, a=fault sequence,
+#                               query_id=fault id)
 
 #: module fast-path flag — read directly by ``record()``; the recorder
 #: is ON by default (that is the point of a flight recorder).

@@ -40,7 +40,7 @@ from typing import Dict, Sequence, Tuple
 #: plane name order — the label set of tpu_obs_self_seconds_total and
 #: the key order of every snapshot/section built from the counters
 PLANES = ("stats", "timeline", "net", "mem", "cost", "history",
-          "doctor")
+          "doctor", "burn")
 
 P_STATS = 0
 P_TIMELINE = 1
@@ -49,6 +49,7 @@ P_MEM = 3
 P_COST = 4
 P_HISTORY = 5
 P_DOCTOR = 6
+P_BURN = 7
 
 _N = len(PLANES)
 

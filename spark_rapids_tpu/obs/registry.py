@@ -752,6 +752,75 @@ ANOMALY_ACTIVE = _REGISTRY.gauge(
     "Currently open (breached, not yet recovered) anomalies across "
     "all fingerprints and keys",
     fn=lambda: float(_anomaly_mod().active_count()))
+ANOMALY_FP = _REGISTRY.counter(
+    "tpu_anomaly_fp_total",
+    "Anomaly breach-opens that closed again without a confirmed level "
+    "shift (the recovery arrived from the frozen baseline, not a "
+    "re-baselining) — transient false positives; on stationary soak "
+    "traffic their rate over breaches is the sentinel's "
+    "false-positive accounting (obs/anomaly.py, gated by the soak "
+    "bench key anomaly_fp_rate)")
+
+
+# -- soak plane: burn-rate monitors (obs/burn.py) + load harness
+#    (service/soak.py) -------------------------------------------------------
+
+def _burn_mod():
+    from . import burn
+    return burn
+
+
+def _soak_mod():
+    from ..service import soak
+    return soak
+
+
+BURN_RATE = _REGISTRY.gauge(
+    "tpu_burn_rate",
+    "Multi-window SLO burn rate per tenant (obs/burn.py): fraction of "
+    "the obs.burn.budgetPct error budget consumed inside the window "
+    "over the fraction allowed — 1.0 burns the budget exactly as fast "
+    "as permitted, >1 is an incident.  window=fast catches spikes, "
+    "window=slow confirms sustained burn (the SRE multi-window "
+    "alerting shape)",
+    labels=("tenant", "window"))
+BURN_STEADY_STATE = _REGISTRY.gauge(
+    "tpu_burn_steady_state",
+    "1 while the EWMA-slope steady-state detector declares the "
+    "service stationary (obs/burn.py); drops to 0 when a fault or "
+    "load shift breaks the latency slope streak")
+BURN_LEAK_DRIFT_BYTES = _REGISTRY.gauge(
+    "tpu_burn_leak_drift_bytes",
+    "Leak-drift regression over the sampled memplane live-bytes "
+    "floor: min of the newest half of samples minus min of the oldest "
+    "half (obs/burn.py) — exactly 0 on a clean soak run, gated exact "
+    "by ci/perf_gate.py",
+    fn=lambda: float(_burn_mod().leak_drift_bytes()))
+SOAK_QPS = _REGISTRY.gauge(
+    "tpu_soak_qps",
+    "Achieved completions/second of the live (or last) soak run "
+    "(service/soak.py harness state)",
+    fn=lambda: float(_soak_mod().stats_section()["qps_actual"]))
+SOAK_INFLIGHT = _REGISTRY.gauge(
+    "tpu_soak_inflight",
+    "Queries submitted by the soak harness and not yet terminal",
+    fn=lambda: float(_soak_mod().stats_section()["inflight"]))
+SOAK_SUBMITTED = _REGISTRY.gauge(
+    "tpu_soak_submitted_total",
+    "Soak-harness submissions accepted by the service this run",
+    fn=lambda: float(_soak_mod().stats_section()["submitted"]))
+SOAK_COMPLETED = _REGISTRY.gauge(
+    "tpu_soak_completed_total",
+    "Soak-harness queries completed this run",
+    fn=lambda: float(_soak_mod().stats_section()["completed"]))
+SOAK_SHED = _REGISTRY.gauge(
+    "tpu_soak_shed_total",
+    "Soak-harness submissions shed by admission control this run",
+    fn=lambda: float(_soak_mod().stats_section()["shed"]))
+SOAK_ACTIVE_FAULTS = _REGISTRY.gauge(
+    "tpu_soak_active_faults",
+    "Injected fault windows currently open (service/faults.py)",
+    fn=lambda: float(len(_soak_mod().stats_section()["active_faults"])))
 
 
 # -- observability self-metering (obs/overhead.py) --------------------------
@@ -773,7 +842,7 @@ OBS_SELF_SECONDS = _REGISTRY.counter(
     "is exempt by construction",
     labels=("plane",))
 for _plane in ("stats", "timeline", "net", "mem", "cost", "history",
-               "doctor"):
+               "doctor", "burn"):
     OBS_SELF_SECONDS.labels(plane=_plane).set_function(
         lambda p=_plane: _overhead_mod().plane_seconds(p))
 

@@ -14,7 +14,11 @@ On trigger the watchdog samples every thread's Python stack, the arena
 live/peak/spill map, shuffle client/server state, and service queue
 depths into a diagnostic bundle (obs/diagnostics.py), logs a
 ``watchdog`` service event, and fires at most once per query so a
-genuinely wedged worker does not flood the bundle directory.
+genuinely wedged worker does not flood the bundle directory.  With
+``obs.watchdog.refireSeconds`` > 0 a query that STAYS stalled re-fires
+at that rate-limited cadence (fresh stacks, fresh bundle, ``refire=N``
+on the event), so a soak-length hang keeps producing evidence instead
+of going silent after one bundle.
 
 The daemon is owned by ``QueryService`` (started/stopped with it) and
 costs one ``thread_counts()`` dict per poll interval — nothing on any
@@ -57,16 +61,20 @@ class Watchdog:
     """
 
     def __init__(self, service, interval_s: float = 1.0,
-                 stall_s: float = 120.0):
+                 stall_s: float = 120.0, refire_s: float = 0.0):
         self._service = service
         self._interval_s = max(0.05, float(interval_s))
         self._stall_s = max(self._interval_s, float(stall_s))
+        self._refire_s = max(0.0, float(refire_s))
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
         # query_id -> (last observed ring count, perf_ns of last change)
         self._progress: Dict[str, tuple] = {}
         self._triggered: set = set()
+        # query_id -> (perf_ns of last fire, fire count) for the
+        # rate-limited periodic re-fire of a persisting stall
+        self._last_fired: Dict[str, tuple] = {}
         self._trigger_count = 0
         self._last_trigger: Optional[dict] = None
 
@@ -132,9 +140,18 @@ class Watchdog:
                     self._progress[query_id] = (count, now)
                     continue
                 idle_s = (now - prev[1]) / 1e9
-                if idle_s >= self._stall_s and query_id not in self._triggered:
+                if idle_s < self._stall_s:
+                    continue
+                if query_id not in self._triggered:
                     self._triggered.add(query_id)
-                    stalled.append((query_id, handle, idle_s))
+                    self._last_fired[query_id] = (now, 1)
+                    stalled.append((query_id, handle, idle_s, 0))
+                elif self._refire_s > 0:
+                    fired_ns, n = self._last_fired.get(query_id,
+                                                       (now, 1))
+                    if (now - fired_ns) / 1e9 >= self._refire_s:
+                        self._last_fired[query_id] = (now, n + 1)
+                        stalled.append((query_id, handle, idle_s, n))
             # drop book-keeping for finished queries
             for qid in list(self._progress):
                 if qid not in live_ids:
@@ -142,11 +159,13 @@ class Watchdog:
             for qid in list(self._triggered):
                 if qid not in live_ids:
                     self._triggered.discard(qid)
-        for query_id, handle, idle_s in stalled:
-            self._fire(query_id, handle, idle_s)
-        return [qid for qid, _, _ in stalled]
+                    self._last_fired.pop(qid, None)
+        for query_id, handle, idle_s, refire in stalled:
+            self._fire(query_id, handle, idle_s, refire)
+        return [qid for qid, _, _, _ in stalled]
 
-    def _fire(self, query_id: str, handle, idle_s: float):
+    def _fire(self, query_id: str, handle, idle_s: float,
+              refire: int = 0):
         _flight.record(_flight.EV_WATCHDOG, query_id, a=int(idle_s * 1000),
                        query_id=query_id)
         bundle_path = None
@@ -161,6 +180,7 @@ class Watchdog:
             self._service._events.log_service_event(
                 "watchdog", query_id,
                 stalled_s=round(idle_s, 3),
+                refire=refire,
                 diag_bundle=bundle_path)
         except Exception:
             pass
@@ -169,6 +189,7 @@ class Watchdog:
             self._last_trigger = {
                 "query_id": query_id,
                 "stalled_s": round(idle_s, 3),
+                "refire": refire,
                 "diag_bundle": bundle_path,
             }
 
@@ -181,6 +202,7 @@ class Watchdog:
                 "enabled": self.running,
                 "interval_s": self._interval_s,
                 "stall_s": self._stall_s,
+                "refire_s": self._refire_s,
                 "watched": len(self._progress),
                 "triggers": self._trigger_count,
                 "last_trigger": dict(self._last_trigger)

@@ -1,0 +1,194 @@
+"""Deterministic fault injection for soak runs (service/faults.py).
+
+A soak harness proves resilience only if the faults it survives are
+*reproducible*: the injector takes an explicit schedule — ``(at_s,
+kind)`` pairs, or one derived from a seed — and fires each fault when
+the harness's own elapsed clock passes its mark.  Three fault kinds
+cover the failure modes the service already claims to absorb:
+
+- ``kill_pipeline_worker``: posts the morsel pipeline pool's poison
+  pill (exec/pipeline.py), so one worker thread exits at its next
+  park; dead threads are pruned from the pool under its own lock so
+  the next dispatch regrows to full parallelism — the recovery the
+  soak report then measures.
+- ``poison_query``: submits a query whose UDF always raises — the
+  failure path (retry, diag bundle, history fold) under live load.
+- ``forced_oom_storm``: submits a burst of queries raising
+  RESOURCE_EXHAUSTED — the retry/backoff machinery under pressure.
+
+The poison/OOM submissions are *actions* supplied by the harness (the
+injector owns timing and bookkeeping, not DataFrame construction).
+
+Every fired fault leaves three correlated markers: a ``fault`` event
+on the service event log (phase begin/end), an ``EV_FAULT`` entry on
+the flight recorder, and a diagnostic bundle captured with trigger
+``fault`` — so ``tools/report.py --soak`` and ``tools/diagnose.py``
+can join the fault window to its measured p99 impact.
+
+Elapsed time comes from the caller's monotonic origin; no wall clocks
+here (HYG002).
+"""
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..obs import flight as _flight
+
+#: supported fault kinds, in severity order
+FAULT_KINDS = ("kill_pipeline_worker", "poison_query",
+               "forced_oom_storm")
+
+
+def build_schedule(seed: int, duration_s: float,
+                   kinds: Sequence[str] = FAULT_KINDS,
+                   count: Optional[int] = None
+                   ) -> List[Tuple[float, str]]:
+    """A reproducible fault schedule: ``count`` faults (default one
+    per kind) spread over the middle 60% of the run, shuffled and
+    jittered by ``seed``.  Same seed + duration -> same schedule."""
+    rng = random.Random(seed)
+    kinds = list(kinds)
+    n = count if count is not None else len(kinds)
+    picks = [kinds[i % len(kinds)] for i in range(n)]
+    rng.shuffle(picks)
+    lo, hi = 0.2 * duration_s, 0.8 * duration_s
+    slots = sorted(rng.uniform(lo, hi) for _ in range(n))
+    return [(round(at, 3), kind) for at, kind in zip(slots, picks)]
+
+
+def _kill_pipeline_worker() -> int:
+    """Poison one pool worker; prune exited threads so the next
+    dispatch regrows the pool.  Returns live threads after the kill."""
+    from ..exec.pipeline import PipelinePool
+    pool = PipelinePool._instance
+    if pool is None:
+        return 0
+    pool._tasks.put(None)
+    with pool._lock:
+        pool._threads[:] = [t for t in pool._threads if t.is_alive()]
+        return len(pool._threads)
+
+
+def prune_dead_workers() -> int:
+    """Drop exited worker threads from the pipeline pool (the
+    just-poisoned thread is usually still unwinding when the kill
+    returns).  Called on every injector poll; returns live threads."""
+    from ..exec.pipeline import PipelinePool
+    pool = PipelinePool._instance
+    if pool is None:
+        return 0
+    with pool._lock:
+        pool._threads[:] = [t for t in pool._threads if t.is_alive()]
+        return len(pool._threads)
+
+
+class FaultInjector:
+    """Fire a deterministic fault schedule against a live service.
+
+    ``actions`` maps fault kinds to zero-arg callables supplied by the
+    harness (submit-a-poison-query, submit-an-OOM-burst); the
+    ``kill_pipeline_worker`` default acts on the process pipeline
+    pool directly.  ``poll(elapsed_s)`` is called from the harness
+    loop and fires every due, not-yet-fired fault."""
+
+    def __init__(self, service, schedule: Sequence[Tuple[float, str]],
+                 actions: Optional[Dict[str, Callable[[], object]]] = None,
+                 guard_s: float = 2.0):
+        self._service = service
+        self._schedule = sorted(
+            (float(at), str(kind)) for at, kind in schedule)
+        for _, kind in self._schedule:
+            if kind not in FAULT_KINDS:
+                raise ValueError(f"unknown fault kind {kind!r}; "
+                                 f"expected one of {FAULT_KINDS}")
+        self._actions = dict(actions or {})
+        self._guard_s = float(guard_s)
+        self._next = 0
+        self._seq = 0
+        #: fired fault windows, chronological; each dict is mutated in
+        #: place when its window closes (end_s) and when the harness
+        #: attributes p99 impact/recovery
+        self.windows: List[Dict] = []
+
+    # -- harness API -------------------------------------------------------
+    def poll(self, elapsed_s: float) -> List[Dict]:
+        """Fire every scheduled fault whose mark has passed; close
+        windows older than the guard.  Returns the newly fired
+        windows (already appended to ``self.windows``)."""
+        prune_dead_workers()
+        fired = []
+        while (self._next < len(self._schedule)
+               and self._schedule[self._next][0] <= elapsed_s):
+            at, kind = self._schedule[self._next]
+            self._next += 1
+            fired.append(self._fire(kind, at, elapsed_s))
+        for w in self.windows:
+            if w["end_s"] is None and elapsed_s >= w["at_s"] + self._guard_s:
+                w["end_s"] = round(elapsed_s, 3)
+                self._mark(w, "end")
+        return fired
+
+    def done(self) -> bool:
+        return self._next >= len(self._schedule)
+
+    def active(self) -> List[str]:
+        """Kinds of currently open fault windows (dashboard/metrics)."""
+        return [w["kind"] for w in self.windows if w["end_s"] is None]
+
+    def close_all(self, elapsed_s: float) -> None:
+        for w in self.windows:
+            if w["end_s"] is None:
+                w["end_s"] = round(elapsed_s, 3)
+                self._mark(w, "end")
+
+    # -- internals ---------------------------------------------------------
+    def _fire(self, kind: str, at_s: float, elapsed_s: float) -> Dict:
+        self._seq += 1
+        fault_id = f"fault-{self._seq}-{kind}"
+        detail = None
+        try:
+            action = self._actions.get(kind)
+            if action is not None:
+                detail = action()
+            elif kind == "kill_pipeline_worker":
+                detail = _kill_pipeline_worker()
+        except Exception as e:          # a fault action must not kill
+            detail = f"action error: {e}"   # the harness loop
+        window = {
+            "id": fault_id,
+            "kind": kind,
+            "at_s": round(max(at_s, 0.0), 3),
+            "fired_s": round(elapsed_s, 3),
+            "end_s": None,
+            "detail": detail if isinstance(detail, (int, str)) else None,
+            "diag_bundle": None,
+            "p99_before_ms": None,
+            "p99_during_ms": None,
+            "p99_after_ms": None,
+            "recovered": None,
+            "recovery_s": None,
+        }
+        self.windows.append(window)
+        self._mark(window, "begin")
+        try:
+            window["diag_bundle"] = self._service._write_diag_bundle(
+                "fault", None, RuntimeError(
+                    f"injected fault {kind} at t+{window['fired_s']}s"))
+        except Exception:
+            window["diag_bundle"] = None
+        return window
+
+    def _mark(self, window: Dict, phase: str) -> None:
+        """One fault marker on the flight recorder + event log."""
+        _flight.record(_flight.EV_FAULT, window["kind"],
+                       a=self._seq, query_id=window["id"])
+        try:
+            self._service._events.log_service_event(
+                "fault", window["id"], fault_kind=window["kind"],
+                phase=phase,
+                at_s=window["at_s"],
+                end_s=window["end_s"] if phase == "end" else None,
+                diag_bundle=window["diag_bundle"])
+        except Exception:
+            pass

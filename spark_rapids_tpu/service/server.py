@@ -26,13 +26,16 @@ from ..config import (TpuConf, set_active, EVENT_LOG_PATH,
                       SERVICE_WORKERS, SERVICE_MAX_QUEUE_DEPTH,
                       SERVICE_MAX_QUEUED_BYTES, SERVICE_DEFAULT_DEADLINE_MS,
                       OBS_WATCHDOG_ENABLED, OBS_WATCHDOG_INTERVAL_MS,
-                      OBS_WATCHDOG_STALL_S, OBS_DIAG_DIR,
+                      OBS_WATCHDOG_STALL_S, OBS_WATCHDOG_REFIRE_S,
+                      OBS_DIAG_DIR,
                       OBS_DIAG_MAX_BUNDLES, AOT_WARMUP_ENABLED,
                       AOT_WARMUP_INTERVAL_MS, AOT_WARMUP_MAX_PER_CYCLE)
 from ..cache import plan_cache as _plan_cache
 from ..compile import aot as _aot
 from ..obs import anomaly as _anomaly
+from ..obs import burn as _burn
 from ..obs import compile_watch as _cwatch
+from ..obs import dashboard as _dashboard
 from ..obs import history as _history
 from ..obs import costplane as _costplane
 from ..obs import doctor as _doctor
@@ -63,6 +66,17 @@ def _pipeline_stats() -> Dict:
     try:
         from ..exec.pipeline import pool_stats
         return pool_stats()
+    except Exception:
+        return {}
+
+
+def _soak_stats() -> Dict:
+    """Live soak-harness counters for ``stats().snapshot()`` (lazy
+    import: service/soak.py imports QueryService, so the module-load
+    direction must stay soak -> server only)."""
+    try:
+        from .soak import stats_section
+        return stats_section()
     except Exception:
         return {}
 
@@ -163,7 +177,8 @@ class QueryService:
         self.watchdog = Watchdog(
             self,
             interval_s=conf.get(OBS_WATCHDOG_INTERVAL_MS) / 1000.0,
-            stall_s=float(conf.get(OBS_WATCHDOG_STALL_S)))
+            stall_s=float(conf.get(OBS_WATCHDOG_STALL_S)),
+            refire_s=float(conf.get(OBS_WATCHDOG_REFIRE_S)))
         # queue/inflight gauges read live service state at collect time
         # (scrapes pay the cost, the submit/run hot path pays nothing)
         SERVICE_QUEUE_DEPTH.set_function(lambda: self.queue.depth)
@@ -186,6 +201,8 @@ class QueryService:
         # service wins, like every other plane)
         _history.configure(conf)
         _anomaly.configure(conf)
+        _burn.configure(conf)
+        _dashboard.configure(conf)
         # plan cache + predictive admission scheduler (cache/
         # plan_cache.py, service/scheduler.py): repeat shapes skip the
         # planner tail; learned baselines rank/shed at admission
@@ -216,6 +233,8 @@ class QueryService:
             "warmup": self.warmup.state(),
             "history": _history.stats_section(),
             "anomaly": _anomaly.stats_section(),
+            "burn": _burn.stats_section(),
+            "soak": _soak_stats(),
             "plan_cache": _plan_cache.stats_section(),
             "scheduler": self.scheduler.stats_section(),
             "obs_overhead": _overhead.stats_section(),
@@ -546,6 +565,7 @@ class QueryService:
             row = _history.record(m)
             if row is None:
                 return
+            _burn.fold(row)
             for ev in _anomaly.fold(row):
                 fields = dict(ev)
                 kind = fields.pop("kind", "breach")

@@ -1,0 +1,492 @@
+"""Soak harness (service/soak.py): sustained mixed-traffic load through
+the real QueryService, to steady state, with fault correlation.
+
+Every bench number before this module was a short burst; production
+claims need the missing regime — minutes of open-loop QPS with every
+observability plane on.  ``run_soak`` drives it:
+
+- **workload**: a repeat-heavy, long-tailed fingerprint mix (four
+  query shapes, ~55/25/12/8 weights, chosen by a seeded RNG) submitted
+  round-robin across multiple tenants at a target *open-loop* QPS —
+  submissions are paced by the clock, not by completions, so an
+  overloaded service sheds instead of silently slowing the generator.
+- **correctness**: each shape's expected arrow table is computed once
+  up front (which also warms the compile caches) and every completed
+  result is sha-verified against it — a soak that returns wrong bytes
+  fails loudly, not statistically.
+- **monitors**: terminal queries fold into the burn/steady-state plane
+  (obs/burn.py) via the service's own ``_record_terminal`` hook; the
+  harness samples memplane live bytes between completions for the
+  leak-drift regression and snapshots per-second timeline buckets.
+- **faults**: an optional deterministic schedule (service/faults.py)
+  fires worker kills / poison queries / OOM storms mid-run; the report
+  correlates each fault window with its measured p99 impact
+  (before/during/after) and recovery time.
+
+The harness itself uses only monotonic clocks (HYG002); report
+timestamps are elapsed seconds from the run origin.  Chaos
+submissions (poison/OOM actions) run as tenant ``chaos`` and are
+accounted separately — their intentional failures never pollute the
+workload's sha/failure totals.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..obs import burn as _burn
+from .errors import ServiceOverloaded
+from .faults import FAULT_KINDS, FaultInjector
+
+#: live run state for Prometheus (tpu_soak_*), Service.stats()["soak"]
+#: and the dashboard soak panel; replaced wholesale under _CUR_LOCK
+_CUR_LOCK = threading.Lock()
+_CURRENT: Dict = {
+    "running": False, "elapsed_s": 0.0, "qps_target": 0.0,
+    "qps_actual": 0.0, "submitted": 0, "completed": 0, "failed": 0,
+    "shed": 0, "inflight": 0, "faults_fired": 0, "active_faults": [],
+    "tenants": [],
+}
+
+
+def stats_section() -> Dict:
+    """The ``stats()['soak']`` section: the live (or last) run."""
+    with _CUR_LOCK:
+        out = dict(_CURRENT)
+    out["active_faults"] = list(out["active_faults"])
+    out["tenants"] = list(out["tenants"])
+    return out
+
+
+def _publish(**kv) -> None:
+    with _CUR_LOCK:
+        _CURRENT.update(kv)
+
+
+class SoakConfig:
+    """Soak run parameters.  ``total_queries`` > 0 makes the run
+    deterministic in submission count (tests, bench); otherwise the
+    run is time-bound by ``duration_s``."""
+
+    def __init__(self, duration_s: float = 30.0, total_queries: int = 0,
+                 qps: float = 20.0, rows: int = 4096,
+                 partitions: int = 2,
+                 tenants: Sequence[str] = ("tenant-a", "tenant-b",
+                                           "tenant-c"),
+                 seed: int = 42,
+                 faults: Sequence[Tuple[float, str]] = (),
+                 fault_guard_s: float = 2.0, bucket_s: float = 1.0,
+                 num_workers: int = 2, sample_every: int = 4,
+                 verify_sha: bool = True, reset_monitors: bool = True,
+                 warm_service: bool = True,
+                 drain_timeout_s: float = 120.0):
+        self.duration_s = float(duration_s)
+        self.total_queries = int(total_queries)
+        self.qps = max(float(qps), 0.1)
+        self.rows = int(rows)
+        self.partitions = int(partitions)
+        self.tenants = tuple(tenants) or ("default",)
+        self.seed = int(seed)
+        self.faults = tuple((float(at), str(kind))
+                            for at, kind in faults)
+        self.fault_guard_s = float(fault_guard_s)
+        self.bucket_s = max(float(bucket_s), 0.05)
+        self.num_workers = int(num_workers)
+        self.sample_every = max(int(sample_every), 1)
+        self.verify_sha = bool(verify_sha)
+        self.reset_monitors = bool(reset_monitors)
+        self.warm_service = bool(warm_service)
+        self.drain_timeout_s = float(drain_timeout_s)
+
+    def to_dict(self) -> Dict:
+        return {
+            "duration_s": self.duration_s,
+            "total_queries": self.total_queries, "qps": self.qps,
+            "rows": self.rows, "partitions": self.partitions,
+            "tenants": list(self.tenants), "seed": self.seed,
+            "faults": [list(f) for f in self.faults],
+            "fault_guard_s": self.fault_guard_s,
+            "bucket_s": self.bucket_s,
+            "num_workers": self.num_workers,
+        }
+
+
+def _table_sha(t) -> str:
+    import pyarrow as pa
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    return hashlib.sha256(sink.getvalue().to_pybytes()).hexdigest()
+
+
+def build_mix(session, rows: int, partitions: int) -> List[Dict]:
+    """The repeat-heavy long-tailed shape mix: four distinct plan
+    fingerprints with hot-head/long-tail submission weights."""
+    from ..api import functions as F
+
+    def base():
+        return session.range(0, rows, num_partitions=partitions)
+
+    return [
+        {"name": "hot_agg", "weight": 0.55,
+         "df": base().select((F.col("id") % 7).alias("k"),
+                             F.col("id").alias("v"))
+                     .group_by("k").agg(F.sum("v").alias("sv"),
+                                        F.count().alias("c"))
+                     .sort("k")},
+        {"name": "warm_agg", "weight": 0.25,
+         "df": base().select((F.col("id") % 13).alias("k"),
+                             F.col("id").alias("v"))
+                     .group_by("k").agg(F.sum("v").alias("sv"))
+                     .sort("k")},
+        {"name": "filter_agg", "weight": 0.12,
+         "df": base().select((F.col("id") % 5).alias("k"),
+                             F.col("id").alias("v"))
+                     .filter(F.col("v") % 3 != 0)
+                     .group_by("k").agg(F.count().alias("c"))
+                     .sort("k")},
+        {"name": "tail_agg", "weight": 0.08,
+         "df": base().select((F.col("id") % 29).alias("k"),
+                             F.col("id").alias("v"))
+                     .group_by("k").agg(F.sum("v").alias("sv"),
+                                        F.count().alias("c"))
+                     .sort("k")},
+    ]
+
+
+def _chaos_df(session, message: str):
+    """A query whose UDF always raises ``message`` (poison / OOM)."""
+    from ..api import functions as F
+    from ..columnar import dtypes as T
+    from ..udf import pandas_udf
+
+    def _boom(series):
+        raise RuntimeError(message)
+    boom = pandas_udf(_boom, return_type=T.INT64)
+    return session.range(0, 64, num_partitions=1) \
+        .select(boom(F.col("id")).alias("id"))
+
+
+def _pctl(vals: Sequence[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    vs = sorted(vals)
+    i = min(len(vs) - 1, int(round(q / 100.0 * (len(vs) - 1))))
+    return round(vs[i], 3)
+
+
+class SoakReport:
+    """The soak run artifact: one JSON-serializable dict."""
+
+    def __init__(self, data: Dict):
+        self.data = data
+
+    def to_dict(self) -> Dict:
+        return self.data
+
+    def write(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.data, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return path
+
+
+def _buckets(samples: List[Tuple], shed_times: List[float],
+             windows: List[Dict], bucket_s: float,
+             duration_s: float) -> List[Dict]:
+    """Per-bucket timeline: completions, qps, p50/p99, failures, shed
+    and the fault kinds whose window overlaps the bucket."""
+    n_buckets = max(int(duration_s / bucket_s) + 1, 1)
+    out = []
+    for i in range(n_buckets):
+        lo, hi = i * bucket_s, (i + 1) * bucket_s
+        lats = [s[1] for s in samples if lo <= s[0] < hi]
+        fails = sum(1 for s in samples if lo <= s[0] < hi and not s[4])
+        shed = sum(1 for t in shed_times if lo <= t < hi)
+        if not lats and not shed and hi > duration_s:
+            continue
+        active = sorted({w["kind"] for w in windows
+                         if w["at_s"] < hi
+                         and (w["end_s"] is None or w["end_s"] > lo)})
+        out.append({
+            "t_s": round(lo, 3), "n": len(lats),
+            "qps": round(len(lats) / bucket_s, 2),
+            "p50_ms": _pctl(lats, 50), "p99_ms": _pctl(lats, 99),
+            "failed": fails, "shed": shed, "faults": active,
+        })
+    return out
+
+
+def _attribute_faults(windows: List[Dict], samples: List[Tuple],
+                      guard_s: float) -> None:
+    """Annotate each fault window with measured p99 impact and
+    recovery time, from the harness's own completion samples."""
+    lat_at = [(s[0], s[1]) for s in samples]
+    for w in windows:
+        at = w["at_s"]
+        end = w["end_s"] if w["end_s"] is not None else at + guard_s
+        before = [l for t, l in lat_at if t < at]
+        during = [l for t, l in lat_at if at <= t < end]
+        after = [l for t, l in lat_at if t >= end]
+        w["p99_before_ms"] = _pctl(before, 99)
+        w["p99_during_ms"] = _pctl(during, 99)
+        w["p99_after_ms"] = _pctl(after, 99)
+        if w["p99_before_ms"] is None:
+            # no pre-fault traffic: recovery is "the run kept serving"
+            w["recovered"] = bool(after)
+            w["recovery_s"] = round(guard_s, 3) if after else None
+            continue
+        threshold = max(2.0 * w["p99_before_ms"],
+                        w["p99_before_ms"] + 50.0)
+        w["recovered"] = False
+        # first guard-sized bucket after the window whose p99 is back
+        # inside the pre-fault band marks recovery
+        t = end
+        while before and t < (lat_at[-1][0] if lat_at else end) + guard_s:
+            bucket = [l for ts, l in lat_at if t <= ts < t + guard_s]
+            p99 = _pctl(bucket, 99)
+            if p99 is not None and p99 <= threshold:
+                w["recovered"] = True
+                w["recovery_s"] = round(t + guard_s - at, 3)
+                break
+            t += guard_s
+
+
+def run_soak(session, config: SoakConfig,
+             on_tick: Optional[Callable[[Dict], None]] = None
+             ) -> SoakReport:
+    """Drive one soak run through a fresh QueryService on ``session``.
+
+    Returns the :class:`SoakReport`; the live state is continuously
+    published to ``stats_section()`` / the ``tpu_soak_*`` gauges (and
+    to ``on_tick`` when given — the CLI's progress line)."""
+    from .server import QueryService
+
+    for _, kind in config.faults:
+        if kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}")
+    mix = build_mix(session, config.rows, config.partitions)
+    # expected results first: sha oracle + compile-cache warmup, so
+    # the measured window starts warm (steady state, not cold ramp)
+    for m in mix:
+        m["sha"] = _table_sha(m["df"].to_arrow())
+    rng = random.Random(config.seed)
+    cum: List[Tuple[float, int]] = []
+    acc = 0.0
+    for i, m in enumerate(mix):
+        acc += m["weight"]
+        cum.append((acc, i))
+
+    def _pick() -> int:
+        r = rng.random() * acc
+        for edge, i in cum:
+            if r <= edge:
+                return i
+        return cum[-1][1]
+
+    target_n = config.total_queries
+    duration = config.duration_s
+    samples: List[Tuple] = []     # (done_s, lat_ms, tenant, shape, ok)
+    shed_times: List[float] = []
+    inflight: Dict = {}           # handle -> (t_submit_s, shape_i, tenant)
+    chaos_handles: List = []
+    counts = {"submitted": 0, "completed": 0, "failed": 0, "shed": 0,
+              "sha_mismatch": 0, "chaos_submitted": 0,
+              "chaos_failed": 0}
+    per_tenant: Dict[str, int] = {t: 0 for t in config.tenants}
+    per_shape: Dict[str, int] = {m["name"]: 0 for m in mix}
+
+    svc = QueryService(session, num_workers=config.num_workers)
+    if config.warm_service:
+        # one pass of the mix THROUGH the service before the clock
+        # starts: the session-direct ``to_arrow`` above warms the
+        # engine caches but not the service execution path (plan
+        # cache entries, AOT bucket executables, the per-shape
+        # baselines) — without this the first measured seconds carry
+        # cold ~1s compile spikes that are ramp, not steady state
+        # ... TWICE, draining the AOT warmup daemon between passes:
+        # the daemon's background XLA compiles hold the GIL for ~1s
+        # each and would land as phantom latency spikes inside the
+        # measured window (they did, before this wait).  The second
+        # pass matters because the predictive scheduler only emits its
+        # bucket hints once the plan cache has entries to predict from
+        # — i.e. on the pass AFTER the one that populated it.
+        from ..compile import aot as _aot
+        warm_deadline = time.monotonic() + config.drain_timeout_s
+        for _ in range(2):
+            for m in mix:
+                svc.submit(m["df"], tenant=config.tenants[0]) \
+                    .result(timeout=config.drain_timeout_s)
+            while _aot.warm_candidates() \
+                    and time.monotonic() < warm_deadline:
+                time.sleep(0.05)
+    if config.reset_monitors:
+        # reset AFTER warmup so its folds never pollute the measured
+        # burn/steady/drift window
+        _burn.reset()
+    origin = time.monotonic()
+
+    def _elapsed() -> float:
+        return time.monotonic() - origin
+
+    def _submit_chaos(message: str, burst: int) -> int:
+        df = _chaos_df(session, message)
+        fired = 0
+        for _ in range(burst):
+            try:
+                chaos_handles.append(
+                    svc.submit(df, tenant="chaos", priority=-1))
+                fired += 1
+            except ServiceOverloaded:
+                break
+        counts["chaos_submitted"] += fired
+        return fired
+
+    injector = None
+    if config.faults:
+        injector = FaultInjector(
+            svc, config.faults, guard_s=config.fault_guard_s,
+            actions={
+                "poison_query": lambda: _submit_chaos(
+                    "soak poison query", 1),
+                "forced_oom_storm": lambda: _submit_chaos(
+                    "RESOURCE_EXHAUSTED: soak forced OOM storm", 3),
+            })
+    _publish(running=True, qps_target=config.qps,
+             tenants=list(config.tenants), elapsed_s=0.0, submitted=0,
+             completed=0, failed=0, shed=0, inflight=0, faults_fired=0,
+             active_faults=[], qps_actual=0.0)
+    mem_countdown = config.sample_every
+    try:
+        _burn.sample_memplane()               # pre-run idle floor
+        while True:
+            now = _elapsed()
+            if injector is not None:
+                injector.poll(now)
+            # -- open-loop submission: the clock owns the pace --------
+            due = (counts["submitted"] + counts["shed"] < target_n
+                   if target_n > 0 else now < duration)
+            while due and (counts["submitted"] + counts["shed"]) \
+                    / config.qps <= now:
+                i = _pick()
+                n_sub = counts["submitted"] + counts["shed"]
+                tenant = config.tenants[n_sub % len(config.tenants)]
+                try:
+                    h = svc.submit(mix[i]["df"], tenant=tenant)
+                    inflight[h] = (_elapsed(), i, tenant)
+                    counts["submitted"] += 1
+                    per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
+                    per_shape[mix[i]["name"]] += 1
+                except ServiceOverloaded:
+                    counts["shed"] += 1
+                    shed_times.append(_elapsed())
+                due = (counts["submitted"] + counts["shed"] < target_n
+                       if target_n > 0 else _elapsed() < duration)
+            # -- completions ------------------------------------------
+            for h in [h for h in inflight if h.done()]:
+                t_sub, i, tenant = inflight.pop(h)
+                done_s = _elapsed()
+                lat_ms = (done_s - t_sub) * 1000.0
+                ok = True
+                try:
+                    tbl = h.result(0)
+                    if config.verify_sha \
+                            and _table_sha(tbl) != mix[i]["sha"]:
+                        ok = False
+                        counts["sha_mismatch"] += 1
+                    counts["completed"] += 1
+                except Exception:
+                    ok = False
+                    counts["failed"] += 1
+                samples.append((done_s, lat_ms, tenant,
+                                mix[i]["name"], ok))
+                mem_countdown -= 1
+                if mem_countdown <= 0:
+                    _burn.sample_memplane()
+                    mem_countdown = config.sample_every
+            for h in [h for h in chaos_handles if h.done()]:
+                chaos_handles.remove(h)
+                try:
+                    h.result(0)
+                except Exception:
+                    counts["chaos_failed"] += 1
+            # -- liveness + stop condition ----------------------------
+            now = _elapsed()
+            done_submitting = (
+                counts["submitted"] + counts["shed"] >= target_n
+                if target_n > 0 else now >= duration)
+            tick = {
+                "running": True, "elapsed_s": round(now, 3),
+                "qps_actual": round(len(samples) / now, 2)
+                if now > 0 else 0.0,
+                "inflight": len(inflight) + len(chaos_handles),
+                "active_faults": (injector.active()
+                                  if injector is not None else []),
+                "faults_fired": (len(injector.windows)
+                                 if injector is not None else 0),
+                **{k: counts[k] for k in
+                   ("submitted", "completed", "failed", "shed")},
+            }
+            _publish(**tick)
+            if on_tick is not None:
+                on_tick(tick)
+            if done_submitting and not inflight and not chaos_handles:
+                break
+            if done_submitting \
+                    and now > duration + config.drain_timeout_s:
+                for h in list(inflight) + chaos_handles:
+                    h.cancel("soak drain timeout")
+                break
+            time.sleep(0.002)
+        end_s = _elapsed()
+        if injector is not None:
+            injector.poll(end_s)
+            injector.close_all(end_s)
+        _burn.sample_memplane()               # post-run idle floor
+        snap = svc.stats().snapshot()
+    finally:
+        svc.shutdown()
+        _publish(running=False, active_faults=[], inflight=0)
+
+    windows = list(injector.windows) if injector is not None else []
+    _attribute_faults(windows, samples, config.fault_guard_s)
+    lats = [s[1] for s in samples]
+    recovered = sum(1 for w in windows if w["recovered"])
+    wall_s = max(end_s, 1e-9)
+    report = SoakReport({
+        "config": config.to_dict(),
+        "totals": {
+            **counts,
+            "duration_s": round(wall_s, 3),
+            "qps_actual": round(len(samples) / wall_s, 2),
+            "sustained_rows_s": round(
+                counts["completed"] * config.rows / wall_s, 1),
+        },
+        "latency": {"p50_ms": _pctl(lats, 50),
+                    "p95_ms": _pctl(lats, 95),
+                    "p99_ms": _pctl(lats, 99)},
+        "shed_rate_pct": round(
+            100.0 * counts["shed"]
+            / max(counts["submitted"] + counts["shed"], 1), 3),
+        "per_tenant": per_tenant,
+        "per_shape": per_shape,
+        "timeline": _buckets(samples, shed_times, windows,
+                             config.bucket_s, wall_s),
+        "burn": _burn.stats_section(),
+        "steady": _burn.steady_state(),
+        "leak_drift_bytes": _burn.leak_drift_bytes(),
+        "anomaly": snap.get("anomaly") or {},
+        "faults": windows,
+        "fault_recovery_ratio": (
+            round(recovered / len(windows), 3) if windows else 1.0),
+        "service": {
+            "slo": snap.get("slo") or {},
+            "scheduler": snap.get("scheduler") or {},
+            "history": snap.get("history") or {},
+        },
+    })
+    return report

@@ -10,6 +10,11 @@ questions without a live service:
   compare  — before/after split of the whole history (by timestamp or
              by fraction) with per-key deltas — the "did the rollout
              regress fingerprint X" question
+  soak     — soak-run trend over the whole history: per-window p99,
+             throughput and outcome mix across equal-count time
+             windows, plus an optional before/after fault compare
+             (``--fault-ts`` reuses the compare split at a fault
+             window's timestamp)
 
 Usage:
   python -m spark_rapids_tpu.tools.history summary <history_dir> [--top N]
@@ -18,6 +23,8 @@ Usage:
   python -m spark_rapids_tpu.tools.history compare <history_dir>
       [--fingerprint FP] [--split-frac F | --split-ts TS]
       [--keys k1,k2,...]
+  python -m spark_rapids_tpu.tools.history soak <history_dir>
+      [--buckets N] [--fault-ts TS] [--keys k1,k2,...]
 
 Stdlib-only and read-only; timestamps come from the rows themselves
 (this tool never consults the wall clock).
@@ -241,6 +248,81 @@ def _cmd_compare(args) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# soak
+# ---------------------------------------------------------------------------
+
+def soak_windows(rows: List[Dict], buckets: int = 10) -> List[Dict]:
+    """Soak-grade longitudinal windows over ALL fingerprints: each
+    equal-count window's end-to-end p50/p99 (queue + exec, the SLO
+    plane's definition), its throughput from the rows' own timestamp
+    span, and its outcome mix — the offline twin of the live burn
+    plane's view."""
+    if not rows:
+        return []
+    n = len(rows)
+    buckets = max(1, min(buckets, n))
+    size = n / buckets
+    out = []
+    for b in range(buckets):
+        chunk = rows[int(b * size):int((b + 1) * size)] or \
+            [rows[min(n - 1, int(b * size))]]
+        totals = sorted(
+            float(r.get("queue_ms") or 0.0) + float(r.get("exec_ms")
+                                                    or 0.0)
+            for r in chunk)
+        outcomes: Dict[str, int] = {}
+        for r in chunk:
+            o = str(r.get("outcome") or "?")
+            outcomes[o] = outcomes.get(o, 0) + 1
+        first = float(chunk[0].get("ts") or 0.0)
+        last = float(chunk[-1].get("ts") or 0.0)
+        span = max(last - first, 1e-9)
+        out.append({
+            "first_ts": first, "last_ts": last, "n": len(chunk),
+            "qps": round(len(chunk) / span, 2) if len(chunk) > 1
+            else 0.0,
+            "p50_ms": round(_pctl(totals, 0.5), 3),
+            "p99_ms": round(_pctl(totals, 0.99), 3),
+            "outcomes": outcomes,
+        })
+    return out
+
+
+def _cmd_soak(args) -> int:
+    rows = load_rows(args.history_dir)
+    if not rows:
+        print(f"no history rows under {args.history_dir}")
+        return 1
+    series = soak_windows(rows, buckets=args.buckets)
+    t0 = float(rows[0].get("ts") or 0.0)
+    peak = max(b["p99_ms"] for b in series) or 1.0
+    print(f"{len(rows)} rows in {len(series)} windows "
+          f"(p99 = queue + exec, end-to-end)")
+    print(f"  {'t_s':>8} {'n':>5} {'qps':>8} {'p50ms':>9} {'p99ms':>9}"
+          f"  {'p99':<20} outcomes")
+    for b in series:
+        bar = "#" * max(1, int(round(b["p99_ms"] / peak * 20))) \
+            if peak > 0 else ""
+        print(f"  {b['first_ts'] - t0:>8.1f} {b['n']:>5} "
+              f"{b['qps']:>8.1f} {b['p50_ms']:>9.2f} "
+              f"{b['p99_ms']:>9.2f}  {bar:<20} {_mix(b['outcomes'])}")
+    if args.fault_ts is not None:
+        # before/after the fault window, via the compare split — the
+        # "did the service recover to its pre-fault operating point"
+        # question
+        keys = tuple(k.strip() for k in args.keys.split(",")
+                     if k.strip())
+        res = compare_windows(rows, keys=keys or _DEFAULT_COMPARE_KEYS,
+                              split_ts=args.fault_ts)
+        print(f"before/after fault at ts={args.fault_ts}: "
+              f"n={res['before_n']}/{res['after_n']}")
+        for key, d in res["keys"].items():
+            print(f"  {key:<18} p50 {d['before_p50']:>10.3f} -> "
+                  f"{d['after_p50']:>10.3f}  ({d['delta_pct']:+.2f}%)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_tpu.tools.history",
@@ -267,6 +349,14 @@ def main(argv=None) -> int:
     p.add_argument("--split-ts", type=float, default=None)
     p.add_argument("--keys", default=",".join(_DEFAULT_COMPARE_KEYS))
     p.set_defaults(fn=_cmd_compare)
+
+    p = sub.add_parser("soak", help="soak-run p99/throughput/outcome "
+                                    "trend + before/after-fault compare")
+    p.add_argument("history_dir")
+    p.add_argument("--buckets", type=int, default=10)
+    p.add_argument("--fault-ts", type=float, default=None)
+    p.add_argument("--keys", default=",".join(_DEFAULT_COMPARE_KEYS))
+    p.set_defaults(fn=_cmd_soak)
 
     args = ap.parse_args(argv)
     return args.fn(args)

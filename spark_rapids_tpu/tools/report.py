@@ -747,12 +747,122 @@ def render_html(stories: Dict,
             "</style></head><body>" + "\n".join(body) + "</body></html>")
 
 
+def render_soak_report(report: Dict) -> str:
+    """The ``--soak`` view: one soak run's QPS/p99 timeline with the
+    injected fault windows annotated in-line, the per-tenant burn
+    table, the steady-state verdict and the per-fault impact/recovery
+    correlation — rendered from a ``SoakReport`` JSON artifact
+    (service/soak.py, written by ``tools/soak.py --out``)."""
+    lines = ["=== soak run " + "=" * 49]
+    cfg = report.get("config") or {}
+    lines.append(
+        f"  duration_s={_fmt(cfg.get('duration_s'))} "
+        f"qps_target={_fmt(cfg.get('qps'))} "
+        f"rows={_fmt(cfg.get('rows'))} "
+        f"tenants={','.join(cfg.get('tenants') or [])} "
+        f"seed={_fmt(cfg.get('seed'))} "
+        f"faults={len(cfg.get('faults') or [])}")
+    tot = report.get("totals") or {}
+    lines.append(
+        f"  submitted={_fmt(tot.get('submitted'))} "
+        f"completed={_fmt(tot.get('completed'))} "
+        f"failed={_fmt(tot.get('failed'))} "
+        f"shed={_fmt(tot.get('shed'))} "
+        f"sha_mismatch={_fmt(tot.get('sha_mismatch'))} "
+        f"qps_actual={_fmt(tot.get('qps_actual'))} "
+        f"sustained_rows_s={_fmt(tot.get('sustained_rows_s'))}")
+    lat = report.get("latency") or {}
+    lines.append(
+        f"  p50_ms={_fmt(lat.get('p50_ms'))} "
+        f"p95_ms={_fmt(lat.get('p95_ms'))} "
+        f"p99_ms={_fmt(lat.get('p99_ms'))} "
+        f"shed_rate_pct={_fmt(report.get('shed_rate_pct'))} "
+        f"leak_drift_bytes={_fmt(report.get('leak_drift_bytes'))}")
+    steady = report.get("steady") or {}
+    lines.append(
+        f"  steady_state={'yes' if steady.get('steady') else 'no'} "
+        f"ewma_ms={_fmt(steady.get('ewma_ms'))} "
+        f"slope_pct={_fmt(steady.get('slope_pct'))} "
+        f"converged={_fmt(steady.get('converge_count'))}x "
+        f"losses={_fmt(steady.get('losses'))}")
+    anomaly = report.get("anomaly") or {}
+    lines.append(
+        f"  anomaly breaches={_fmt(anomaly.get('breach_total'))} "
+        f"false_positives={_fmt(anomaly.get('fp_total'))} "
+        f"fp_rate_pct={_fmt(anomaly.get('fp_rate_pct'))}")
+
+    tenants = (report.get("burn") or {}).get("tenants") or {}
+    if tenants:
+        lines.append("-- per-tenant burn rate --")
+        lines.append(f"  {'tenant':<16s}{'queries':>8s}{'breaches':>9s}"
+                     f"{'fast':>8s}{'slow':>8s}")
+        for name in sorted(tenants):
+            t = tenants[name]
+            fast = float(t.get("fast") or 0.0)
+            mark = "  [!! budget]" if fast >= 1.0 else ""
+            lines.append(f"  {name:<16s}{_fmt(t.get('count')):>8}"
+                         f"{_fmt(t.get('breaches')):>9}"
+                         f"{fast:>8.2f}"
+                         f"{float(t.get('slow') or 0.0):>8.2f}{mark}")
+
+    timeline = report.get("timeline") or []
+    if timeline:
+        lines.append("-- timeline (per-bucket QPS / p99, faults "
+                     "annotated) --")
+        lines.append(f"  {'t_s':>6s}{'n':>5s}{'qps':>8s}"
+                     f"{'p50_ms':>9s}{'p99_ms':>9s}{'shed':>6s}"
+                     f"{'fail':>6s}  {'p99':<22s}faults")
+        peak_p99 = max((float(b.get("p99_ms") or 0.0)
+                        for b in timeline), default=0.0) or 1.0
+        for b in timeline:
+            p99 = float(b.get("p99_ms") or 0.0)
+            bar = "#" * int(round(p99 / peak_p99 * 20))
+            faults = ",".join(b.get("faults") or [])
+            lines.append(
+                f"  {float(b.get('t_s') or 0.0):>6.1f}"
+                f"{_fmt(b.get('n')):>5}"
+                f"{float(b.get('qps') or 0.0):>8.1f}"
+                f"{_fmt(b.get('p50_ms')):>9}"
+                f"{_fmt(b.get('p99_ms')):>9}"
+                f"{_fmt(b.get('shed')):>6}"
+                f"{_fmt(b.get('failed')):>6}  {bar:<22s}"
+                + (f"[{faults}]" if faults else ""))
+
+    windows = report.get("faults") or []
+    lines.append("-- fault windows --")
+    if windows:
+        lines.append(f"  {'id':<32s}{'kind':<22s}{'at_s':>7s}"
+                     f"{'end_s':>7s}{'p99_before':>11s}"
+                     f"{'p99_during':>11s}{'p99_after':>10s}"
+                     f"{'recovered':>10s}{'rec_s':>7s}")
+        for w in windows:
+            lines.append(
+                f"  {str(w.get('id')):<32s}"
+                f"{str(w.get('kind')):<22s}"
+                f"{_fmt(w.get('at_s')):>7}"
+                f"{_fmt(w.get('end_s')):>7}"
+                f"{_fmt(w.get('p99_before_ms')):>11}"
+                f"{_fmt(w.get('p99_during_ms')):>11}"
+                f"{_fmt(w.get('p99_after_ms')):>10}"
+                f"{'yes' if w.get('recovered') else 'NO':>10}"
+                f"{_fmt(w.get('recovery_s')):>7}")
+            if w.get("diag_bundle"):
+                lines.append(f"    bundle={w['diag_bundle']}")
+        lines.append(
+            f"  fault_recovery_ratio="
+            f"{_fmt(report.get('fault_recovery_ratio'))}")
+    else:
+        lines.append("  (no faults injected)")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     argv = list(argv if argv is not None else sys.argv[1:])
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: report <event_log.jsonl> [--query QID] "
               "[--trace trace.json] [--html out.html] [--stats] "
-              "[--shuffle] [--memory] [--doctor] [--cost] [--all]",
+              "[--shuffle] [--memory] [--doctor] [--cost] [--all]\n"
+              "       report <soak_report.json> --soak",
               file=sys.stderr)
         return 1
 
@@ -769,6 +879,13 @@ def main(argv=None):
             argv.remove(flag)
             return True
         return False
+
+    if _flag("--soak"):
+        # the positional is a SoakReport JSON artifact, not an event
+        # log — one self-contained view, no joins needed
+        with open(argv[0]) as f:
+            print(render_soak_report(json.load(f)))
+        return 0
 
     qid = _opt("--query")
     trace_path = _opt("--trace")

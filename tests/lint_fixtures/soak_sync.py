@@ -1,0 +1,32 @@
+"""Seeded SYNC001/OBS002/HYG002 fixture shaped like a soak-plane
+helper — ``ci/lint.py`` must exit NONZERO.
+
+The soak plane (obs/burn.py, service/soak.py, service/faults.py)
+drives the REAL service and folds rows the planes already collected —
+its lint scope bans exactly what this helper does: a device pull
+while "sampling" the drift window, a fault marker that allocates its
+name per fire, and a wall-clock read where the row's own timestamp
+(or a monotonic clock) is required.  Never imported by the engine.
+"""
+import time
+
+import jax
+import numpy as np
+
+from spark_rapids_tpu.obs import flight as _flight
+
+
+def bad_sample(dev, window):
+    floor = np.asarray(dev).min()             # SYNC001: materialization
+    evidence = jax.device_get(dev)            # SYNC001: host pull
+    _flight.record(_flight.EV_FAULT, f"fault:{window}")  # OBS002
+    stamp = time.time()                       # HYG002: wall clock
+    return floor, evidence, stamp
+
+
+def good_sample(row, samples):
+    # the burn plane's real shape: host arithmetic over bytes already
+    # sampled, interned name constants, the row's own timestamp
+    _flight.record(_flight.EV_FAULT, "fault", a=int(row.get("ts", 0)))
+    samples.append(int(row.get("device_bytes", 0)))
+    return min(samples)

@@ -83,7 +83,8 @@ class TestHistory:
         # cold-path keys exist only from r13 on, the three roofline
         # keys from r14, the three fleet keys from r15, the four
         # plan-cache/scheduler keys from r16, the obs-tax key from
-        # r17, the residency key from r18)
+        # r17, the residency key from r18, the six soak keys from
+        # r19)
         newest = rounds[max(rounds)]
         for key, _d, _b in R.GATE_KEYS:
             assert newest.get(key) is not None, key
@@ -165,15 +166,15 @@ class TestCompare:
 # ---------------------------------------------------------------------------
 
 class TestCommittedBaseline:
-    def test_baseline_values_equal_r18(self):
+    def test_baseline_values_equal_r19(self):
         base = R.load_baseline(BASELINE)
-        assert base["round"] == 18
-        r18 = R.load_round(os.path.join(REPO_ROOT,
-                                        "BENCH_r18.json")).keys
+        assert base["round"] == 19
+        r19 = R.load_round(os.path.join(REPO_ROOT,
+                                        "BENCH_r19.json")).keys
         for key, spec in base["keys"].items():
-            assert spec["value"] == r18[key], key
+            assert spec["value"] == r19[key], key
         # so the committed pair passes the gate by construction
-        assert not R.regressions(R.compare(r18, base))
+        assert not R.regressions(R.compare(r19, base))
 
     def test_residency_key_gated_exact_at_zero(self):
         # r18's contract: a change that reintroduces a hidden
@@ -184,10 +185,24 @@ class TestCommittedBaseline:
         assert spec["direction"] == "exact"
         assert spec["value"] == 0
         dirty = dict(R.load_round(os.path.join(
-            REPO_ROOT, "BENCH_r18.json")).keys)
+            REPO_ROOT, "BENCH_r19.json")).keys)
         dirty["undeclared_transfers"] = 1
         bad = [d.key for d in R.regressions(R.compare(dirty, base))]
         assert bad == ["undeclared_transfers"], bad
+
+    def test_leak_drift_key_gated_exact_at_zero(self):
+        # r19's contract: the soak leak-drift monitor reading ANY
+        # nonzero byte drift over the measured window must fail the
+        # gate — a leak is never inside a noise band
+        base = R.load_baseline(BASELINE)
+        spec = base["keys"]["leak_drift_bytes"]
+        assert spec["direction"] == "exact"
+        assert spec["value"] == 0
+        dirty = dict(R.load_round(os.path.join(
+            REPO_ROOT, "BENCH_r19.json")).keys)
+        dirty["leak_drift_bytes"] = 4096
+        bad = [d.key for d in R.regressions(R.compare(dirty, base))]
+        assert bad == ["leak_drift_bytes"], bad
 
     def test_true_r16_numbers_trip_only_the_r17_discontinuities(self):
         # the r17 obs-tax diet changed what two gated keys MEASURE:
@@ -252,7 +267,7 @@ class TestGateCli:
         out_path = tmp_path / "PERF_BASELINE.json"
         monkeypatch.setattr(gate, "BASELINE_PATH", str(out_path))
         rc = gate._seed_baseline(
-            os.path.join(REPO_ROOT, "BENCH_r18.json"))
+            os.path.join(REPO_ROOT, "BENCH_r19.json"))
         assert rc == 0
         reseeded = R.load_baseline(str(out_path))
         committed = R.load_baseline(BASELINE)

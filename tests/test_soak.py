@@ -1,0 +1,642 @@
+"""Soak-plane tests: the burn-rate/steady-state/leak-drift monitors
+(obs/burn.py), the deterministic fault injector (service/faults.py),
+the sustained-load harness (service/soak.py), the watchdog re-fire,
+the anomaly false-positive accounting, the offline surfaces
+(tools/report.py --soak, tools/history.py soak) and the lint scope
+extension with its seeded fixture."""
+import json
+import os
+from collections import deque
+
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F  # noqa: F401
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.obs import anomaly, burn, history
+from spark_rapids_tpu.service import faults as faults_mod
+from spark_rapids_tpu.service import soak as soak_mod
+from spark_rapids_tpu.service.faults import FaultInjector, build_schedule
+from spark_rapids_tpu.service.soak import SoakConfig, run_soak
+
+
+@pytest.fixture(autouse=True)
+def _soak_reset():
+    """Isolate the process-wide burn/history/anomaly planes and restore
+    the default config afterwards (last-configured service wins)."""
+    history.stop()
+    history.reset()
+    anomaly.reset()
+    burn.reset()
+    yield
+    history.stop()
+    default = TpuConf({})
+    history.configure(default)
+    anomaly.configure(default)
+    burn.configure(default)
+    history.reset()
+    anomaly.reset()
+    burn.reset()
+
+
+def _row(i=0, tenant="tenant-a", queue_ms=1.0, exec_ms=20.0,
+         outcome="completed", ts=None):
+    return {"ts": 1000.0 + i if ts is None else ts, "tenant": tenant,
+            "queue_ms": queue_ms, "exec_ms": exec_ms,
+            "outcome": outcome}
+
+
+# ---------------------------------------------------------------------------
+# burn-rate windows
+# ---------------------------------------------------------------------------
+
+class TestBurnWindows:
+    def test_window_rate_prunes_and_normalizes(self):
+        win = deque([(0.0, 1), (5.0, 0), (9.0, 1), (10.0, 0)])
+        # span 6s from ts=10 keeps [5, 9, 10]: 1 breach of 3, 1% budget
+        rate = burn._window_rate(win, 10.0, 6.0, 0.01)
+        assert rate == pytest.approx((1 / 3) / 0.01)
+        assert [t for t, _ in win] == [5.0, 9.0, 10.0]
+
+    def test_window_rate_empty_and_zero_budget(self):
+        assert burn._window_rate(deque(), 10.0, 60.0, 0.01) == 0.0
+        assert burn._window_rate(deque([(9.0, 1)]), 10.0, 60.0, 0.0) \
+            == 0.0
+
+    def test_fold_tracks_per_tenant_breaches(self, monkeypatch):
+        from spark_rapids_tpu.obs import slo as _slo
+        monkeypatch.setattr(_slo, "_TARGET_MS", 100.0)
+        for i in range(8):
+            burn.fold(_row(i=i, tenant="a", exec_ms=20.0))
+        for i in range(8, 12):
+            burn.fold(_row(i=i, tenant="b", exec_ms=500.0))
+        rates = burn.burn_rates()
+        assert rates["a"]["breaches"] == 0 and rates["a"]["count"] == 8
+        assert rates["b"]["breaches"] == 4 and rates["b"]["count"] == 4
+        assert rates["a"]["fast"] == 0.0
+        # 100% breaching over a 1% budget burns at 100x
+        assert rates["b"]["fast"] == pytest.approx(100.0)
+
+    def test_failed_outcome_is_a_breach_regardless_of_latency(self,
+                                                              monkeypatch):
+        from spark_rapids_tpu.obs import slo as _slo
+        monkeypatch.setattr(_slo, "_TARGET_MS", 1000.0)
+        burn.fold(_row(exec_ms=1.0, outcome="failed"))
+        assert burn.burn_rates()["tenant-a"]["breaches"] == 1
+
+    def test_disabled_fold_is_a_noop(self):
+        burn.configure(TpuConf({
+            "spark.rapids.tpu.obs.burn.enabled": False}))
+        burn.fold(_row())
+        assert burn.stats_section()["folds"] == 0
+
+
+# ---------------------------------------------------------------------------
+# steady-state detector
+# ---------------------------------------------------------------------------
+
+class TestSteadyState:
+    def test_convergence_loss_and_reconvergence(self):
+        # constant latency converges after the configured streak...
+        for i in range(10):
+            burn.fold(_row(i=i, exec_ms=50.0))
+        st = burn.steady_state()
+        assert st["steady"] and st["converge_count"] == 1
+        assert st["since_ts"] is not None
+        # ...a fault-sized spike breaks it (one loss)...
+        burn.fold(_row(i=10, exec_ms=2000.0))
+        st = burn.steady_state()
+        assert not st["steady"] and st["losses"] == 1
+        assert st["streak"] == 0 and st["since_ts"] is None
+        # ...and the detector re-converges afterwards (the EWMA decays
+        # back from the spike at (1 - alpha) per fold, then the streak
+        # has to rebuild from zero)
+        for i in range(11, 45):
+            burn.fold(_row(i=i, exec_ms=50.0))
+        st = burn.steady_state()
+        assert st["steady"] and st["converge_count"] == 2
+
+    def test_non_completed_rows_never_move_the_ewma(self):
+        for i in range(10):
+            burn.fold(_row(i=i, exec_ms=50.0))
+        ewma = burn.steady_state()["ewma_ms"]
+        burn.fold(_row(i=10, exec_ms=9999.0, outcome="failed"))
+        st = burn.steady_state()
+        assert st["ewma_ms"] == ewma and st["steady"]
+
+
+# ---------------------------------------------------------------------------
+# leak drift
+# ---------------------------------------------------------------------------
+
+class TestLeakDrift:
+    def _seed(self, samples):
+        with burn._LOCK:
+            burn._MEM_SAMPLES.clear()
+            burn._MEM_SAMPLES.extend(samples)
+
+    def test_clean_floor_is_exactly_zero(self):
+        self._seed([4096, 8192, 4096, 9000, 4096, 4096])
+        assert burn.leak_drift_bytes() == 0
+
+    def test_creeping_floor_is_the_drift(self):
+        self._seed([100, 100, 100, 228, 228, 228])
+        assert burn.leak_drift_bytes() == 128
+
+    def test_too_few_samples_and_shrinking_floor(self):
+        self._seed([0, 10**9])
+        assert burn.leak_drift_bytes() == 0
+        self._seed([500, 500, 100, 100])
+        assert burn.leak_drift_bytes() == 0
+
+    def test_sample_memplane_appends_live_bytes(self):
+        n0 = burn.stats_section()["leak"]["samples"]
+        live = burn.sample_memplane()
+        sec = burn.stats_section()["leak"]
+        assert live >= 0 and sec["samples"] == n0 + 1
+
+    def test_configure_resizes_sample_window(self):
+        burn.configure(TpuConf({
+            "spark.rapids.tpu.obs.burn.memSamples": 8}))
+        self._seed(range(100))
+        with burn._LOCK:
+            assert burn._MEM_SAMPLES.maxlen == 8
+            assert len(burn._MEM_SAMPLES) == 8
+
+
+# ---------------------------------------------------------------------------
+# fault injector
+# ---------------------------------------------------------------------------
+
+class _StubService:
+    def __init__(self):
+        self.events = []
+        self.bundles = 0
+
+        class _Ev:
+            def __init__(ev):
+                pass
+
+            def log_service_event(ev, kind, query_id, **fields):
+                self.events.append((kind, query_id, fields))
+        self._events = _Ev()
+
+    def _write_diag_bundle(self, trigger, handle, error):
+        self.bundles += 1
+        return f"/tmp/stub-bundle-{self.bundles}.json"
+
+
+class TestFaultInjector:
+    def test_build_schedule_is_seed_deterministic(self):
+        a = build_schedule(7, 60.0)
+        assert a == build_schedule(7, 60.0)
+        assert a != build_schedule(8, 60.0)
+        assert len(a) == len(faults_mod.FAULT_KINDS)
+        assert sorted(k for _, k in a) == \
+            sorted(faults_mod.FAULT_KINDS)
+        # the middle 60% of the run, in firing order
+        assert all(12.0 <= at <= 48.0 for at, _ in a)
+        assert [at for at, _ in a] == sorted(at for at, _ in a)
+
+    def test_build_schedule_count_wraps_kinds(self):
+        sched = build_schedule(1, 10.0, kinds=("poison_query",), count=3)
+        assert [k for _, k in sched] == ["poison_query"] * 3
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultInjector(_StubService(), [(1.0, "meteor_strike")])
+
+    def test_poll_fires_marks_and_closes(self):
+        svc = _StubService()
+        inj = FaultInjector(svc, [(1.0, "poison_query")],
+                            actions={"poison_query": lambda: 1},
+                            guard_s=2.0)
+        assert inj.poll(0.5) == [] and not inj.done()
+        fired = inj.poll(1.2)
+        assert len(fired) == 1 and inj.done()
+        w = fired[0]
+        assert w["kind"] == "poison_query" and w["detail"] == 1
+        assert w["diag_bundle"] and inj.active() == ["poison_query"]
+        begin = [e for e in svc.events if e[2]["phase"] == "begin"]
+        assert begin and begin[0][0] == "fault"
+        assert begin[0][2]["fault_kind"] == "poison_query"
+        # guard passes: the window closes with an end marker
+        inj.poll(3.5)
+        assert w["end_s"] == 3.5 and inj.active() == []
+        phases = [e[2]["phase"] for e in svc.events]
+        assert phases == ["begin", "end"]
+        end = svc.events[-1][2]
+        assert end["end_s"] == 3.5
+        assert end["diag_bundle"] == w["diag_bundle"]
+
+    def test_action_error_is_contained(self):
+        def _boom():
+            raise RuntimeError("action exploded")
+        inj = FaultInjector(_StubService(), [(0.0, "poison_query")],
+                            actions={"poison_query": _boom})
+        w = inj.poll(0.1)[0]
+        assert "action exploded" in w["detail"]
+
+    def test_close_all_ends_open_windows(self):
+        inj = FaultInjector(_StubService(),
+                            [(0.0, "forced_oom_storm")],
+                            actions={"forced_oom_storm": lambda: 3})
+        inj.poll(0.1)
+        inj.close_all(0.5)
+        assert inj.windows[0]["end_s"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# fault attribution math
+# ---------------------------------------------------------------------------
+
+def _window(at_s, end_s, guard=2.0):
+    return {"id": "fault-1-kill_pipeline_worker",
+            "kind": "kill_pipeline_worker", "at_s": at_s,
+            "fired_s": at_s, "end_s": end_s, "detail": None,
+            "diag_bundle": None, "p99_before_ms": None,
+            "p99_during_ms": None, "p99_after_ms": None,
+            "recovered": None, "recovery_s": None}
+
+
+class TestFaultAttribution:
+    def test_pctl_nearest_rank_and_empty(self):
+        assert soak_mod._pctl([], 99) is None
+        assert soak_mod._pctl([5.0], 99) == 5.0
+        vals = [float(i) for i in range(1, 101)]
+        # nearest-rank on 100 values: index round(q/100 * 99)
+        assert soak_mod._pctl(vals, 50) == 51.0
+        assert soak_mod._pctl(vals, 99) == 99.0
+
+    def test_recovery_detected_after_spike(self):
+        samples = [(t * 0.5, 30.0, "a", "s", True) for t in range(8)]
+        samples += [(4.0 + t * 0.5, 500.0, "a", "s", True)
+                    for t in range(4)]
+        samples += [(6.0 + t * 0.5, 30.0, "a", "s", True)
+                    for t in range(8)]
+        w = _window(4.0, 6.0)
+        soak_mod._attribute_faults([w], samples, 2.0)
+        assert w["p99_before_ms"] == 30.0
+        assert w["p99_during_ms"] == 500.0
+        assert w["recovered"] and w["recovery_s"] == 4.0
+
+    def test_never_recovering_spike(self):
+        samples = [(t * 0.5, 30.0, "a", "s", True) for t in range(8)]
+        samples += [(4.0 + t * 0.5, 900.0, "a", "s", True)
+                    for t in range(10)]
+        w = _window(4.0, 6.0)
+        soak_mod._attribute_faults([w], samples, 2.0)
+        assert w["recovered"] is False and w["recovery_s"] is None
+
+    def test_no_prefault_traffic_counts_serving_as_recovery(self):
+        samples = [(5.0, 30.0, "a", "s", True)]
+        w = _window(1.0, 3.0)
+        soak_mod._attribute_faults([w], samples, 2.0)
+        assert w["p99_before_ms"] is None
+        assert w["recovered"] and w["recovery_s"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# the harness, end to end (short, deterministic quotas)
+# ---------------------------------------------------------------------------
+
+class TestSoakRun:
+    def test_clean_run_report_shape_and_totals(self, tmp_path):
+        s = TpuSession(TpuConf({
+            "spark.rapids.tpu.obs.history.dir": str(tmp_path)}))
+        rep = run_soak(s, SoakConfig(
+            duration_s=30.0, total_queries=12, qps=30.0, rows=64,
+            partitions=2, seed=7, num_workers=2)).to_dict()
+        tot = rep["totals"]
+        assert tot["submitted"] == 12
+        assert tot["completed"] + tot["failed"] == 12
+        assert tot["failed"] == 0 and tot["sha_mismatch"] == 0
+        assert rep["latency"]["p99_ms"] >= rep["latency"]["p50_ms"] > 0
+        assert sum(rep["per_tenant"].values()) == tot["submitted"]
+        assert sum(rep["per_shape"].values()) == tot["submitted"]
+        assert rep["timeline"] and all(
+            b["n"] >= 0 for b in rep["timeline"])
+        assert rep["leak_drift_bytes"] == 0
+        assert rep["fault_recovery_ratio"] == 1.0  # vacuous: no faults
+        assert rep["burn"]["folds"] >= 12
+        assert "steady" in rep and "service" in rep
+        # the live section settles back to not-running
+        sec = soak_mod.stats_section()
+        assert sec["running"] is False
+        assert sec["submitted"] == 12
+
+    def test_fault_markers_on_event_log_and_flight(self, tmp_path):
+        from spark_rapids_tpu.obs import flight as _flight
+        from spark_rapids_tpu.tools.events import read_event_log
+        log = str(tmp_path / "events.jsonl")
+        s = TpuSession(TpuConf({
+            "spark.rapids.tpu.eventLog.path": log,
+            "spark.rapids.tpu.obs.history.dir":
+                str(tmp_path / "hist")}))
+        rep = run_soak(s, SoakConfig(
+            duration_s=30.0, total_queries=10, qps=20.0, rows=64,
+            partitions=2, seed=7, num_workers=2,
+            faults=((0.05, "kill_pipeline_worker"),),
+            fault_guard_s=0.2)).to_dict()
+        assert rep["totals"]["failed"] == 0
+        assert rep["totals"]["sha_mismatch"] == 0
+        windows = rep["faults"]
+        assert len(windows) == 1
+        w = windows[0]
+        assert w["kind"] == "kill_pipeline_worker"
+        assert w["end_s"] is not None and w["recovered"] is not None
+        marks = list(read_event_log(log, events="fault"))
+        assert [(m["phase"], m["fault_kind"]) for m in marks] == \
+            [("begin", "kill_pipeline_worker"),
+             ("end", "kill_pipeline_worker")]
+        assert all(m["query_id"] == w["id"] for m in marks)
+        ev = [e for e in _flight.snapshot(query_id=w["id"])
+              if e["kind"] == _flight.EV_FAULT]
+        assert ev, "no EV_FAULT on the flight recorder"
+
+    def test_monitors_add_zero_device_flushes(self, tmp_path):
+        from spark_rapids_tpu.columnar import pending as _pending
+
+        def _soak_flushes(conf_extra, sub):
+            s = TpuSession(TpuConf({
+                "spark.rapids.tpu.obs.history.dir":
+                    str(tmp_path / sub), **conf_extra}))
+            f0 = _pending.FLUSH_COUNT
+            rep = run_soak(s, SoakConfig(
+                duration_s=30.0, total_queries=8, qps=20.0, rows=64,
+                partitions=2, seed=7, num_workers=2)).to_dict()
+            assert rep["totals"]["failed"] == 0
+            return _pending.FLUSH_COUNT - f0
+
+        on = _soak_flushes({}, "on")
+        off = _soak_flushes(
+            {"spark.rapids.tpu.obs.burn.enabled": False}, "off")
+        assert on == off, (on, off)
+
+    def test_unknown_fault_kind_rejected_before_any_traffic(self):
+        s = TpuSession(TpuConf({}))
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            run_soak(s, SoakConfig(faults=((1.0, "nope"),)))
+
+
+# ---------------------------------------------------------------------------
+# anomaly false-positive accounting
+# ---------------------------------------------------------------------------
+
+def _sentinel_conf(minn=5, k=3, sigma=2.0):
+    return TpuConf({
+        "spark.rapids.tpu.obs.anomaly.warmupMinRuns": minn,
+        "spark.rapids.tpu.obs.anomaly.breachRuns": k,
+        "spark.rapids.tpu.obs.anomaly.sigma": sigma,
+    })
+
+
+def _hist_row(fp="fpA", exec_ms=100.0, i=0):
+    return {"fingerprint": fp, "exec_ms": exec_ms, "queue_ms": 1.0,
+            "host_drop_tax_ms": 0.0, "spill_ms": 0.0,
+            "device_util_pct": 60.0, "flushes": 2,
+            "doctor_cause": None, "ts": 1000.0 + i}
+
+
+class TestAnomalyFpAccounting:
+    def test_transient_breach_recovery_counts_one_fp(self):
+        anomaly.configure(_sentinel_conf())
+        for i in range(6):
+            anomaly.fold(_hist_row(exec_ms=100.0, i=i))
+        for i in range(6, 9):          # transient: breach...
+            anomaly.fold(_hist_row(exec_ms=300.0, i=i))
+        for i in range(9, 14):         # ...then full recovery
+            anomaly.fold(_hist_row(exec_ms=100.0, i=i))
+        sec = anomaly.stats_section()
+        assert sec["breach_total"] == 1
+        assert sec["fp_total"] == 1
+        assert anomaly.fp_rate_pct() == 100.0
+
+    def test_sustained_breach_is_not_a_false_positive(self):
+        anomaly.configure(_sentinel_conf())
+        for i in range(6):
+            anomaly.fold(_hist_row(exec_ms=100.0, i=i))
+        for i in range(6, 20):
+            anomaly.fold(_hist_row(exec_ms=300.0, i=i))
+        sec = anomaly.stats_section()
+        assert sec["breach_total"] == 1 and sec["fp_total"] == 0
+        assert anomaly.fp_rate_pct() == 0.0
+
+    def test_no_breaches_reads_zero_rate(self):
+        assert anomaly.fp_rate_pct() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# watchdog re-fire
+# ---------------------------------------------------------------------------
+
+class _StubHandle:
+    status = "RUNNING"
+    _worker_ident = 0xdead
+
+
+class TestWatchdogRefire:
+    def _dog(self, monkeypatch, refire_s):
+        from spark_rapids_tpu.obs import flight as _flight
+        from spark_rapids_tpu.obs.watchdog import Watchdog
+        svc = _StubService()
+        svc._inflight_items = lambda: [("q-stall", _StubHandle())]
+        monkeypatch.setattr(_flight, "thread_counts",
+                            lambda: {0xdead: 5})
+        return svc, Watchdog(svc, interval_s=0.1, stall_s=1.0,
+                             refire_s=refire_s)
+
+    def test_stalled_query_refires_at_rate_limit(self, monkeypatch):
+        svc, dog = self._dog(monkeypatch, refire_s=2.0)
+        t0 = 10**12
+        assert dog.poll_once(now_ns=t0) == []       # baseline sample
+        assert dog.poll_once(now_ns=t0 + int(1.5e9)) == ["q-stall"]
+        # still stalled, but inside the re-fire window: silent
+        assert dog.poll_once(now_ns=t0 + int(2.5e9)) == []
+        # past the re-fire cadence: fires again with refire=1
+        assert dog.poll_once(now_ns=t0 + int(3.6e9)) == ["q-stall"]
+        refires = [f["refire"] for _, _, f in svc.events]
+        assert refires == [0, 1]
+        assert svc.bundles == 2
+        assert dog.state()["refire_s"] == 2.0
+        assert dog.state()["triggers"] == 2
+
+    def test_refire_disabled_fires_once(self, monkeypatch):
+        svc, dog = self._dog(monkeypatch, refire_s=0.0)
+        t0 = 10**12
+        dog.poll_once(now_ns=t0)
+        assert dog.poll_once(now_ns=t0 + int(1.5e9)) == ["q-stall"]
+        assert dog.poll_once(now_ns=t0 + int(9e9)) == []
+        assert dog.state()["triggers"] == 1
+
+
+# ---------------------------------------------------------------------------
+# offline surfaces
+# ---------------------------------------------------------------------------
+
+def _mini_report():
+    return {
+        "config": {"duration_s": 5.0, "total_queries": 8, "qps": 4.0,
+                   "rows": 64, "partitions": 2,
+                   "tenants": ["a", "b"], "seed": 1,
+                   "faults": [[1.0, "kill_pipeline_worker"]],
+                   "fault_guard_s": 2.0, "bucket_s": 1.0,
+                   "num_workers": 2},
+        "totals": {"submitted": 8, "completed": 8, "failed": 0,
+                   "shed": 0, "sha_mismatch": 0, "chaos_submitted": 0,
+                   "chaos_failed": 0, "duration_s": 2.0,
+                   "qps_actual": 4.0, "sustained_rows_s": 256.0},
+        "latency": {"p50_ms": 20.0, "p95_ms": 30.0, "p99_ms": 40.0},
+        "shed_rate_pct": 0.0,
+        "per_tenant": {"a": 4, "b": 4},
+        "per_shape": {"hot_agg": 8},
+        "timeline": [
+            {"t_s": 0.0, "n": 4, "qps": 4.0, "p50_ms": 20.0,
+             "p99_ms": 25.0, "failed": 0, "shed": 0, "faults": []},
+            {"t_s": 1.0, "n": 4, "qps": 4.0, "p50_ms": 22.0,
+             "p99_ms": 80.0, "failed": 0, "shed": 0,
+             "faults": ["kill_pipeline_worker"]}],
+        "burn": {"tenants": {"a": {"fast": 0.0, "slow": 0.0,
+                                   "count": 4, "breaches": 0},
+                             "b": {"fast": 2.5, "slow": 1.0,
+                                   "count": 4, "breaches": 1}}},
+        "steady": {"steady": True, "streak": 9, "ewma_ms": 21.0,
+                   "slope_pct": 0.3, "converge_count": 1, "losses": 0,
+                   "since_ts": 123.0},
+        "leak_drift_bytes": 0,
+        "anomaly": {"breach_total": 0, "fp_total": 0,
+                    "fp_rate_pct": 0.0},
+        "faults": [{"id": "fault-1-kill_pipeline_worker",
+                    "kind": "kill_pipeline_worker", "at_s": 1.0,
+                    "fired_s": 1.0, "end_s": 3.0, "detail": 1,
+                    "diag_bundle": "/tmp/x.json",
+                    "p99_before_ms": 25.0, "p99_during_ms": 80.0,
+                    "p99_after_ms": 26.0, "recovered": True,
+                    "recovery_s": 4.0}],
+        "fault_recovery_ratio": 1.0,
+        "service": {"slo": {}, "scheduler": {}, "history": {}},
+    }
+
+
+class TestSoakSurfaces:
+    def test_render_soak_report_carries_the_story(self):
+        from spark_rapids_tpu.tools.report import render_soak_report
+        text = render_soak_report(_mini_report())
+        assert "soak run" in text
+        assert "kill_pipeline_worker" in text
+        assert "steady" in text and "leak_drift_bytes=0" in text
+        assert "[!! budget]" in text      # tenant b burns >= 1.0
+        assert "fault_recovery_ratio=1.0" in text
+        assert "bundle=/tmp/x.json" in text
+
+    def test_report_main_soak_flag(self, tmp_path, capsys):
+        from spark_rapids_tpu.tools.report import main as report_main
+        p = tmp_path / "soak.json"
+        p.write_text(json.dumps(_mini_report()))
+        assert report_main([str(p), "--soak"]) == 0
+        out = capsys.readouterr().out
+        assert "fault windows" in out
+
+    def test_history_soak_windows_math(self):
+        rows = [{"ts": 100.0 + i, "queue_ms": 1.0,
+                 "exec_ms": 20.0 if i < 20 else 200.0,
+                 "outcome": "completed"} for i in range(40)]
+        from spark_rapids_tpu.tools.history import soak_windows
+        wins = soak_windows(rows, buckets=4)
+        assert len(wins) == 4
+        assert sum(w["n"] for w in wins) == 40
+        assert wins[0]["p99_ms"] == pytest.approx(21.0)
+        assert wins[-1]["p99_ms"] == pytest.approx(201.0)
+        assert all(w["qps"] > 0 for w in wins)
+        assert wins[0]["outcomes"] == {"completed": 10}
+
+    def test_history_soak_cli_empty_dir(self, tmp_path):
+        from spark_rapids_tpu.tools.history import main as history_main
+        assert history_main(["soak", str(tmp_path)]) == 1
+
+    def test_stats_section_shapes(self):
+        sec = burn.stats_section()
+        assert {"enabled", "folds", "tenants", "steady", "leak",
+                "history_write_p99_us"} <= set(sec)
+        live = soak_mod.stats_section()
+        assert {"running", "qps_target", "submitted", "completed",
+                "active_faults"} <= set(live)
+
+
+# ---------------------------------------------------------------------------
+# lint scope extension + seeded fixture
+# ---------------------------------------------------------------------------
+
+class TestSoakLint:
+    MODULES = ("spark_rapids_tpu/obs/burn.py",
+               "spark_rapids_tpu/service/soak.py",
+               "spark_rapids_tpu/service/faults.py")
+
+    def test_soak_modules_in_sync_obs_hyg_scopes(self):
+        from spark_rapids_tpu.analysis import lint as AL
+        for rel in self.MODULES:
+            scopes = AL._scopes_for(rel)
+            assert AL.SYNC001 in scopes, rel
+            assert AL.OBS002 in scopes, rel
+            assert AL.HYG002 in scopes, rel
+
+    def test_seeded_soak_fixture_trips_all_three_rules(self):
+        from spark_rapids_tpu.analysis import lint as AL
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "lint_fixtures", "soak_sync.py")
+        with open(path) as f:
+            fs = AL.lint_source(f.read(), path)
+        rules = {f.rule for f in fs}
+        assert {AL.SYNC001, AL.OBS002, AL.HYG002} <= rules
+
+    def test_shipped_soak_modules_lint_clean(self):
+        from spark_rapids_tpu.analysis import lint as AL
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in self.MODULES:
+            path = os.path.join(repo, rel)
+            with open(path) as f:
+                fs = AL.lint_source(f.read(), rel,
+                                    scopes=AL._scopes_for(rel))
+            assert fs == [], (rel, AL.format_findings(fs))
+
+
+# ---------------------------------------------------------------------------
+# the long one: a seeded three-kind chaos schedule
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestChaosSoakSlow:
+    def test_seeded_chaos_schedule_correct_and_correlated(self, tmp_path):
+        from spark_rapids_tpu.tools.events import read_event_log
+        log = str(tmp_path / "events.jsonl")
+        s = TpuSession(TpuConf({
+            "spark.rapids.tpu.eventLog.path": log,
+            "spark.rapids.tpu.obs.history.dir":
+                str(tmp_path / "hist")}))
+        sched = build_schedule(42, 12.0)
+        rep = run_soak(s, SoakConfig(
+            duration_s=12.0, qps=8.0, rows=256, partitions=2,
+            seed=42, num_workers=2, faults=sched,
+            fault_guard_s=1.0)).to_dict()
+        tot = rep["totals"]
+        # the workload never fails or mis-hashes; the chaos tenant's
+        # intentional failures are accounted separately
+        assert tot["failed"] == 0 and tot["sha_mismatch"] == 0
+        assert tot["chaos_submitted"] >= 4      # poison + OOM burst
+        assert tot["chaos_failed"] == tot["chaos_submitted"]
+        windows = rep["faults"]
+        assert sorted(w["kind"] for w in windows) == \
+            sorted(faults_mod.FAULT_KINDS)
+        # every window closed and carries its measured p99 attribution
+        assert all(w["end_s"] is not None for w in windows)
+        assert all(w["p99_before_ms"] is not None for w in windows)
+        assert rep["fault_recovery_ratio"] >= 2.0 / 3.0
+        assert rep["leak_drift_bytes"] == 0
+        # the detector converged at least once and the event log saw a
+        # begin AND an end marker per fault kind
+        assert rep["steady"]["converge_count"] >= 1
+        marks = list(read_event_log(log, events="fault"))
+        for kind in faults_mod.FAULT_KINDS:
+            assert ("begin", kind) in [(m["phase"], m["fault_kind"])
+                                       for m in marks]
+            assert ("end", kind) in [(m["phase"], m["fault_kind"])
+                                     for m in marks]
